@@ -1,0 +1,36 @@
+"""karpenter_tpu — a TPU-native autonomous node provisioner.
+
+A ground-up rebuild of the capability surface of
+``kubernetes-sigs/karpenter-provider-ibm-cloud`` (a Karpenter cloud-provider
+operator, see ``/root/reference``) re-centered on one idea: the provisioning
+scheduler's placement core (greedy bin-packing over pending pods x instance
+offerings) is a **pure function over dense arrays, jitted on TPU** via
+JAX/XLA.  Everything else — catalog refresh, actuation, drift, interruption,
+circuit breaking — is thin host-side orchestration around that solve.
+
+Package map (reference parity cited per-module; see SURVEY.md):
+
+- ``apis``        — NodeClass / NodeClaim / Pod typed objects + validation
+                    (ref: pkg/apis/v1alpha1/ibmnodeclass_types.go)
+- ``catalog``     — instance-type + pricing + offering catalog as dense
+                    device-resident arrays (ref: pkg/providers/common/{instancetype,pricing})
+- ``cloud``       — cloud client layer: error taxonomy, retry, fake cloud,
+                    subnet scoring, image resolution (ref: pkg/cloudprovider/ibm, pkg/providers/vpc)
+- ``solver``      — the placement core: host greedy oracle + jax backend
+                    (ref: karpenter-core Scheduler.Solve reframed per BASELINE.json north star)
+- ``ops``         — low-level device ops (segment reductions, pallas kernels)
+- ``parallel``    — mesh / fleet-scale sharded solve (pjit / shard_map)
+- ``core``        — provisioner loop, solve-window coalescer, actuator,
+                    circuit breaker, drift, disruption (ref: pkg/batcher, pkg/cloudprovider)
+- ``controllers`` — the 16-controller reconcile plane (ref: pkg/controllers)
+- ``utils``       — TTL cache, generic batcher, metrics, logging (ref: pkg/cache, pkg/batcher, pkg/metrics)
+- ``models``      — solver formulations (FFD, right-sizing LP refinement, repack)
+"""
+
+__version__ = "0.1.0"
+
+from karpenter_tpu.apis import (  # noqa: F401
+    NodeClass,
+    NodeClaim,
+    PodSpec,
+)

@@ -1,0 +1,24 @@
+from karpenter_tpu.apis.requirements import Requirement, Requirements, Operator
+from karpenter_tpu.apis.pod import (
+    PodSpec, Toleration, Taint, TopologySpreadConstraint, PodAffinityTerm,
+    ResourceRequests,
+)
+from karpenter_tpu.apis.nodeclass import (
+    NodeClass, NodeClassSpec, NodeClassStatus, InstanceRequirements,
+    PlacementStrategy, SubnetSelectionCriteria, ImageSelector, VolumeSpec,
+    BlockDeviceMapping, KubeletConfig, LoadBalancerIntegration, LoadBalancerTarget,
+    DynamicPoolConfig, ValidationError, Condition,
+)
+from karpenter_tpu.apis.nodeclaim import NodeClaim, Node, NodePool
+
+__all__ = [
+    "Requirement", "Requirements", "Operator",
+    "PodSpec", "Toleration", "Taint", "TopologySpreadConstraint",
+    "PodAffinityTerm", "ResourceRequests",
+    "NodeClass", "NodeClassSpec", "NodeClassStatus", "InstanceRequirements",
+    "PlacementStrategy", "SubnetSelectionCriteria", "ImageSelector",
+    "VolumeSpec", "BlockDeviceMapping", "KubeletConfig",
+    "LoadBalancerIntegration", "LoadBalancerTarget", "DynamicPoolConfig",
+    "ValidationError", "Condition",
+    "NodeClaim", "Node", "NodePool",
+]

@@ -1,0 +1,102 @@
+"""NodeClaim / Node / NodePool typed objects.
+
+Capability parity with karpenter-core's NodeClaim lifecycle as driven by the
+reference (pkg/cloudprovider/cloudprovider.go:420-494 builds NodeClaims with
+labels from requirements + instance type; registration controller syncs
+node<->claim, registration/controller.go:67).
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+from karpenter_tpu.apis.pod import Taint
+from karpenter_tpu.apis.requirements import Requirements
+
+
+@dataclass
+class NodeClaim:
+    name: str
+    nodeclass_name: str = ""
+    nodepool_name: str = ""
+    instance_type: str = ""
+    zone: str = ""
+    capacity_type: str = "on-demand"
+    provider_id: str = ""            # "tpu:///<region>/<instance-id>" once launched
+    node_name: str = ""
+    labels: Dict[str, str] = field(default_factory=dict)
+    annotations: Dict[str, str] = field(default_factory=dict)
+    taints: Tuple[Taint, ...] = ()
+    startup_taints: Tuple[Taint, ...] = ()
+    requirements: Requirements = field(default_factory=Requirements)
+    # lifecycle
+    created_at: float = field(default_factory=time.time)
+    registered: bool = False
+    initialized: bool = False
+    launched: bool = False
+    deleted: bool = False
+    finalizers: List[str] = field(default_factory=list)
+    resource_version: int = 0
+    uid: str = ""
+    # resolved placement (written by the actuator from the solve plan)
+    subnet_id: str = ""
+    image_id: str = ""
+    security_group_ids: Tuple[str, ...] = ()
+    hourly_price: float = 0.0
+
+
+@dataclass
+class Node:
+    """A registered cluster node (the k8s Node analogue)."""
+
+    name: str
+    provider_id: str = ""
+    labels: Dict[str, str] = field(default_factory=dict)
+    annotations: Dict[str, str] = field(default_factory=dict)
+    taints: List[Taint] = field(default_factory=list)
+    ready: bool = False
+    conditions: Dict[str, str] = field(default_factory=dict)  # type -> status
+    addresses: List[str] = field(default_factory=list)
+    created_at: float = field(default_factory=time.time)
+    deleted: bool = False
+    resource_version: int = 0
+    uid: str = ""
+
+
+@dataclass
+class NodePool:
+    """Provisioning pool: requirements + nodeclass ref + disruption policy
+    (karpenter-core NodePool analogue; the reference consumes these through
+    GetInstanceTypes per-NodePool filtering, cloudprovider.go:553)."""
+
+    name: str
+    nodeclass_name: str = ""
+    requirements: Requirements = field(default_factory=Requirements)
+    taints: Tuple[Taint, ...] = ()
+    startup_taints: Tuple[Taint, ...] = ()
+    labels: Dict[str, str] = field(default_factory=dict)
+    weight: int = 10
+    cpu_limit_milli: int = 0         # 0 = unlimited
+    memory_limit_mib: int = 0
+    consolidation_policy: str = "WhenEmptyOrUnderutilized"
+    consolidate_after_seconds: float = 30.0
+    resource_version: int = 0
+
+
+def provider_id(region: str, instance_id: str) -> str:
+    """(ref builds 'ibm:///<region>/<id>', vpc/instance/provider.go:841-880)"""
+    return f"tpu:///{region}/{instance_id}"
+
+
+def parse_provider_id(pid: str) -> Optional[Tuple[str, str]]:
+    """-> (region, instance_id) or None (ref extractInstanceIDFromProviderID,
+    vpc/instance/provider.go:1176)."""
+    if not pid or not pid.startswith("tpu:///"):
+        return None
+    rest = pid[len("tpu:///"):]
+    parts = rest.split("/", 1)
+    if len(parts) != 2 or not parts[0] or not parts[1]:
+        return None
+    return parts[0], parts[1]

@@ -1,0 +1,267 @@
+"""NodeClass: the user-facing provisioning config object.
+
+Capability parity with the reference's ``IBMNodeClass`` CRD
+(pkg/apis/v1alpha1/ibmnodeclass_types.go): spec fields, the CEL cross-field
+validation rules (:481-488), and the resolved status surface (:663-726).
+Validation here is plain Python (``validate()``) instead of CEL, enforced by
+the nodeclass status controller and at admission by the fake kube store.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import time
+from dataclasses import dataclass, field, asdict
+from typing import Dict, List, Optional, Tuple
+
+
+class ValidationError(ValueError):
+    pass
+
+
+# --- sub-specs -------------------------------------------------------------
+
+@dataclass(frozen=True)
+class InstanceRequirements:
+    """Automatic instance-type selection criteria
+    (ibmnodeclass_types.go:250-284)."""
+
+    architecture: str = ""          # amd64 | arm64 | s390x
+    min_cpu: int = 0                # cores
+    min_memory_gib: int = 0
+    max_hourly_price: float = 0.0   # 0 = no ceiling
+    gpu: bool = False
+
+
+@dataclass(frozen=True)
+class SubnetSelectionCriteria:
+    minimum_available_ips: int = 0
+    required_tags: Tuple[Tuple[str, str], ...] = ()
+
+
+@dataclass(frozen=True)
+class PlacementStrategy:
+    """Zone/subnet placement strategy (ibmnodeclass_types.go:41-82)."""
+
+    zone_balance: str = "Balanced"  # Balanced | AvailabilityFirst | CostOptimized
+    subnet_selection: SubnetSelectionCriteria = SubnetSelectionCriteria()
+
+
+@dataclass(frozen=True)
+class ImageSelector:
+    """Semantic image selection os/major/minor/arch/variant
+    (ibmnodeclass_types.go:441-479)."""
+
+    os: str = "ubuntu"
+    major_version: str = ""
+    minor_version: str = ""
+    architecture: str = "amd64"
+    variant: str = ""
+
+
+@dataclass(frozen=True)
+class VolumeSpec:
+    """(ibmnodeclass_types.go:302-436)"""
+
+    capacity_gb: int = 100
+    profile: str = "general-purpose"
+    iops: int = 0
+    bandwidth: int = 0
+    encryption_key: str = ""
+    delete_on_termination: bool = True
+
+
+@dataclass(frozen=True)
+class BlockDeviceMapping:
+    device_name: str = ""
+    root_volume: bool = False
+    volume: VolumeSpec = VolumeSpec()
+
+
+@dataclass(frozen=True)
+class KubeletConfig:
+    """Subset mirrored from ibmnodeclass_types.go:318-387."""
+
+    max_pods: int = 0               # 0 = provider heuristic
+    system_reserved: Tuple[Tuple[str, str], ...] = ()
+    kube_reserved: Tuple[Tuple[str, str], ...] = ()
+    eviction_hard: Tuple[Tuple[str, str], ...] = ()
+    cluster_dns: Tuple[str, ...] = ()
+
+
+@dataclass(frozen=True)
+class HealthCheck:
+    protocol: str = "tcp"
+    port: int = 0
+    interval: int = 5
+    timeout: int = 2
+    retries: int = 2
+
+
+@dataclass(frozen=True)
+class LoadBalancerTarget:
+    load_balancer_id: str = ""
+    pool_name: str = ""
+    port: int = 0
+    weight: int = 50
+    health_check: Optional[HealthCheck] = None
+
+
+@dataclass(frozen=True)
+class LoadBalancerIntegration:
+    """(ibmnodeclass_types.go:146-244)"""
+
+    enabled: bool = False
+    target_groups: Tuple[LoadBalancerTarget, ...] = ()
+    auto_deregister: bool = True
+    registration_timeout: int = 300
+
+
+@dataclass(frozen=True)
+class DynamicPoolConfig:
+    """IKS dynamic worker-pool config (ibmnodeclass_types.go:84-144)."""
+
+    enabled: bool = False
+    pool_name_prefix: str = "karpenter"
+    empty_pool_ttl_seconds: int = 600
+    cleanup_policy: str = "Delete"  # Delete | Retain
+
+
+# --- spec / status ---------------------------------------------------------
+
+@dataclass
+class NodeClassSpec:
+    region: str = ""
+    zone: str = ""
+    instance_profile: str = ""
+    instance_requirements: Optional[InstanceRequirements] = None
+    image: str = ""
+    image_selector: Optional[ImageSelector] = None
+    vpc: str = ""
+    subnet: str = ""
+    security_groups: Tuple[str, ...] = ()
+    ssh_keys: Tuple[str, ...] = ()
+    resource_group: str = ""
+    placement_target: str = ""
+    tags: Tuple[Tuple[str, str], ...] = ()
+    placement_strategy: Optional[PlacementStrategy] = None
+    user_data: str = ""
+    user_data_append: str = ""
+    bootstrap_mode: str = "auto"    # auto | cloud-init | iks-api
+    iks_cluster_id: str = ""
+    iks_worker_pool_id: str = ""
+    iks_dynamic_pools: Optional[DynamicPoolConfig] = None
+    load_balancer_integration: Optional[LoadBalancerIntegration] = None
+    block_device_mappings: Tuple[BlockDeviceMapping, ...] = ()
+    kubelet: Optional[KubeletConfig] = None
+    api_server_endpoint: str = ""
+
+
+@dataclass(frozen=True)
+class Condition:
+    type: str
+    status: str                      # "True" | "False" | "Unknown"
+    reason: str = ""
+    message: str = ""
+    last_transition: float = 0.0
+
+
+@dataclass
+class NodeClassStatus:
+    """(ibmnodeclass_types.go:663-726)"""
+
+    last_validation_time: float = 0.0
+    validation_error: str = ""
+    selected_instance_types: List[str] = field(default_factory=list)
+    selected_subnets: List[str] = field(default_factory=list)
+    resolved_security_groups: List[str] = field(default_factory=list)
+    resolved_image_id: str = ""
+    conditions: List[Condition] = field(default_factory=list)
+
+    def set_condition(self, type_: str, status: str, reason: str = "",
+                      message: str = "", now: Optional[float] = None) -> None:
+        now = time.time() if now is None else now
+        for i, c in enumerate(self.conditions):
+            if c.type == type_:
+                if c.status == status and c.reason == reason and c.message == message:
+                    return
+                # Keep last_transition when only reason/message change.
+                transition = now if c.status != status else c.last_transition
+                self.conditions[i] = Condition(type_, status, reason, message, transition)
+                return
+        self.conditions.append(Condition(type_, status, reason, message, now))
+
+    def condition(self, type_: str) -> Optional[Condition]:
+        for c in self.conditions:
+            if c.type == type_:
+                return c
+        return None
+
+    def is_ready(self) -> bool:
+        c = self.condition("Ready")
+        return c is not None and c.status == "True"
+
+
+@dataclass
+class NodeClass:
+    name: str
+    spec: NodeClassSpec = field(default_factory=NodeClassSpec)
+    status: NodeClassStatus = field(default_factory=NodeClassStatus)
+    annotations: Dict[str, str] = field(default_factory=dict)
+    labels: Dict[str, str] = field(default_factory=dict)
+    finalizers: List[str] = field(default_factory=list)
+    deleted: bool = False            # deletionTimestamp analogue
+    resource_version: int = 0
+    uid: str = ""
+
+    # -- hash for drift (ref hash/controller.go:62-84, hashstructure/v2) ---
+
+    def spec_hash(self) -> str:
+        """Deterministic hash of the spec for drift detection."""
+        def default(o):
+            if hasattr(o, "__dataclass_fields__"):
+                return asdict(o)
+            return str(o)
+        payload = json.dumps(asdict(self.spec), sort_keys=True, default=default)
+        return hashlib.sha256(payload.encode()).hexdigest()[:16]
+
+    # -- CEL-equivalent cross-field validation (ibmnodeclass_types.go:481-488)
+
+    def validate(self) -> List[str]:
+        """Returns a list of violations (empty = valid)."""
+        s = self.spec
+        errs: List[str] = []
+        if not s.region:
+            errs.append("spec.region is required")
+        if bool(s.instance_profile) == bool(s.instance_requirements):
+            errs.append("exactly one of spec.instanceProfile or "
+                        "spec.instanceRequirements must be set")
+        if s.image and s.image_selector:
+            errs.append("spec.image and spec.imageSelector are mutually exclusive")
+        if not s.image and not s.image_selector:
+            errs.append("one of spec.image or spec.imageSelector must be set")
+        if s.bootstrap_mode not in ("auto", "cloud-init", "iks-api"):
+            errs.append(f"spec.bootstrapMode invalid: {s.bootstrap_mode!r}")
+        if s.bootstrap_mode == "iks-api" and not s.iks_cluster_id:
+            errs.append("spec.bootstrapMode=iks-api requires spec.iksClusterID")
+        if s.zone and s.region and not s.zone.startswith(s.region):
+            errs.append(f"spec.zone {s.zone!r} not in region {s.region!r}")
+        if s.subnet and not s.subnet.startswith("subnet-") and not s.subnet.startswith("0"):
+            errs.append(f"spec.subnet {s.subnet!r} is not a subnet id")
+        if s.placement_strategy and s.placement_strategy.zone_balance not in (
+                "Balanced", "AvailabilityFirst", "CostOptimized"):
+            errs.append("spec.placementStrategy.zoneBalance invalid")
+        root_vols = [b for b in s.block_device_mappings if b.root_volume]
+        if len(root_vols) > 1:
+            errs.append("at most one blockDeviceMapping may be rootVolume")
+        return errs
+
+
+# Annotation keys (ref pkg/apis/v1alpha1/annotations.go:17-36).
+ANNOTATION_NODECLASS_HASH = "karpenter-tpu.sh/nodeclass-hash"
+ANNOTATION_NODECLASS_HASH_VERSION = "karpenter-tpu.sh/nodeclass-hash-version"
+ANNOTATION_SUBNET = "karpenter-tpu.sh/subnet-id"
+ANNOTATION_SECURITY_GROUPS = "karpenter-tpu.sh/security-groups"
+ANNOTATION_IMAGE = "karpenter-tpu.sh/image-id"
+NODECLASS_HASH_VERSION = "v1"

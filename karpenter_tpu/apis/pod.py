@@ -1,0 +1,183 @@
+"""Pod-side scheduling inputs: requests, tolerations, spread, affinity.
+
+These are the *demand* half of the placement problem.  Resource quantities
+are normalized to integer units at parse time (milliCPU, MiB, GPU count,
+one pod slot) so the device solve is exact integer arithmetic — no float
+floor-division hazards on TPU.
+"""
+
+from __future__ import annotations
+
+import math
+import re
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+from karpenter_tpu.apis.requirements import Requirements
+
+# Resource axis order used by every dense tensor in the system.
+RESOURCE_AXES = ("cpu", "memory", "gpu", "pods")
+NUM_RESOURCES = len(RESOURCE_AXES)
+
+_QTY_RE = re.compile(r"^([0-9]*\.?[0-9]+)([a-zA-Z]*)$")
+
+_MEM_MULT = {  # to MiB
+    "": 1 / (1024 * 1024), "k": 1000 / (1024 * 1024), "M": 1000_000 / (1024 * 1024),
+    "G": 1e9 / (1024 * 1024), "T": 1e12 / (1024 * 1024),
+    "Ki": 1 / 1024, "Mi": 1.0, "Gi": 1024.0, "Ti": 1024.0 * 1024,
+}
+
+
+def parse_cpu_milli(q) -> int:
+    """'500m' -> 500; '2' -> 2000; 1.5 -> 1500."""
+    if isinstance(q, (int, float)):
+        return int(round(q * 1000))
+    m = _QTY_RE.match(q.strip())
+    if not m:
+        raise ValueError(f"bad cpu quantity {q!r}")
+    num, suffix = float(m.group(1)), m.group(2)
+    if suffix == "m":
+        return int(round(num))
+    if suffix == "":
+        return int(round(num * 1000))
+    raise ValueError(f"bad cpu suffix {q!r}")
+
+
+def parse_memory_mib(q) -> int:
+    """'4Gi' -> 4096; '512Mi' -> 512; bytes int -> MiB.
+
+    Rounds *up* so sub-MiB requests never vanish from capacity accounting
+    (a request of '512Ki' must cost 1 MiB, not 0).
+    """
+    if isinstance(q, (int, float)):
+        return int(math.ceil(q / (1024 * 1024)))
+    m = _QTY_RE.match(q.strip())
+    if not m:
+        raise ValueError(f"bad memory quantity {q!r}")
+    num, suffix = float(m.group(1)), m.group(2)
+    if suffix not in _MEM_MULT:
+        raise ValueError(f"bad memory suffix {q!r}")
+    return int(math.ceil(num * _MEM_MULT[suffix] - 1e-9))
+
+
+@dataclass(frozen=True)
+class ResourceRequests:
+    """Integer-normalized resource vector (cpu milli, memory MiB, gpu, pods)."""
+
+    cpu_milli: int = 0
+    memory_mib: int = 0
+    gpu: int = 0
+    pods: int = 1
+
+    @classmethod
+    def parse(cls, requests: Dict[str, object]) -> "ResourceRequests":
+        return cls(
+            cpu_milli=parse_cpu_milli(requests.get("cpu", 0)),
+            memory_mib=parse_memory_mib(requests.get("memory", 0)),
+            gpu=int(requests.get("nvidia.com/gpu", requests.get("gpu", 0)) or 0),
+            pods=1,
+        )
+
+    def as_tuple(self) -> Tuple[int, int, int, int]:
+        return (self.cpu_milli, self.memory_mib, self.gpu, self.pods)
+
+    def __add__(self, other: "ResourceRequests") -> "ResourceRequests":
+        return ResourceRequests(self.cpu_milli + other.cpu_milli,
+                                self.memory_mib + other.memory_mib,
+                                self.gpu + other.gpu,
+                                self.pods + other.pods)
+
+
+@dataclass(frozen=True)
+class Taint:
+    key: str
+    value: str = ""
+    effect: str = "NoSchedule"  # NoSchedule | PreferNoSchedule | NoExecute
+
+
+@dataclass(frozen=True)
+class Toleration:
+    key: str = ""               # "" + Exists tolerates everything
+    operator: str = "Equal"     # Equal | Exists
+    value: str = ""
+    effect: str = ""            # "" matches all effects
+
+    def tolerates(self, taint: Taint) -> bool:
+        if self.effect and self.effect != taint.effect:
+            return False
+        if self.operator == "Exists":
+            return self.key == "" or self.key == taint.key
+        return self.key == taint.key and self.value == taint.value
+
+
+def tolerates_all(tolerations: Tuple[Toleration, ...], taints: Tuple[Taint, ...]) -> bool:
+    """A pod can schedule onto a node iff every NoSchedule/NoExecute taint is
+    tolerated (PreferNoSchedule is soft and ignored for feasibility)."""
+    for t in taints:
+        if t.effect == "PreferNoSchedule":
+            continue
+        if not any(tol.tolerates(t) for tol in tolerations):
+            return False
+    return True
+
+
+@dataclass(frozen=True)
+class TopologySpreadConstraint:
+    max_skew: int = 1
+    topology_key: str = "topology.kubernetes.io/zone"
+    when_unsatisfiable: str = "DoNotSchedule"  # or ScheduleAnyway
+    label_selector: Tuple[Tuple[str, str], ...] = ()
+
+
+@dataclass(frozen=True)
+class PodAffinityTerm:
+    """Simplified (anti-)affinity: match pods by label selector within a
+    topology domain."""
+
+    label_selector: Tuple[Tuple[str, str], ...] = ()
+    topology_key: str = "kubernetes.io/hostname"
+    anti: bool = False
+
+
+@dataclass(frozen=True)
+class PodSpec:
+    """A pending pod as seen by the provisioner."""
+
+    name: str
+    namespace: str = "default"
+    requests: ResourceRequests = field(default_factory=ResourceRequests)
+    node_selector: Tuple[Tuple[str, str], ...] = ()
+    required_requirements: Tuple = ()      # tuple of Requirement (nodeAffinity required)
+    tolerations: Tuple[Toleration, ...] = ()
+    topology_spread: Tuple[TopologySpreadConstraint, ...] = ()
+    affinity: Tuple[PodAffinityTerm, ...] = ()
+    labels: Tuple[Tuple[str, str], ...] = ()
+
+    def scheduling_requirements(self) -> Requirements:
+        reqs = Requirements.from_selector(dict(self.node_selector))
+        for r in self.required_requirements:
+            reqs.add(r)
+        return reqs
+
+    @property
+    def labels_dict(self) -> Dict[str, str]:
+        return dict(self.labels)
+
+    def constraint_signature(self) -> Tuple:
+        """Pods with identical signatures are interchangeable for placement —
+        the host-side grouping key for the solver (solver/encode.py)."""
+        return (
+            self.requests.as_tuple(),
+            tuple(sorted(self.labels)),
+            tuple(sorted(self.node_selector)),
+            tuple(sorted(r.signature for r in self.required_requirements)),
+            tuple(sorted((t.key, t.operator, t.value, t.effect) for t in self.tolerations)),
+            tuple(sorted((c.max_skew, c.topology_key, c.when_unsatisfiable, c.label_selector)
+                         for c in self.topology_spread)),
+            tuple(sorted((a.label_selector, a.topology_key, a.anti) for a in self.affinity)),
+        )
+
+
+def make_pods(count: int, name_prefix: str = "pod", **kwargs) -> List[PodSpec]:
+    """Convenience fan-out for tests/benchmarks."""
+    return [PodSpec(name=f"{name_prefix}-{i}", **kwargs) for i in range(count)]

@@ -1,0 +1,6 @@
+from karpenter_tpu.utils.cache import TTLCache
+from karpenter_tpu.utils.batcher import Batcher, BatcherOptions
+from karpenter_tpu.utils import metrics
+from karpenter_tpu.utils.logging import get_logger
+
+__all__ = ["TTLCache", "Batcher", "BatcherOptions", "metrics", "get_logger"]

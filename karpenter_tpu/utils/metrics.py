@@ -1,0 +1,256 @@
+"""Dependency-free Prometheus-style metric registry.
+
+Parity with the reference's 11 metric families (pkg/metrics/metrics.go:24-117)
+plus autoplacement metrics (autoplacement/metrics.go:81).  Exposes counters,
+gauges, and histograms with labels, and a ``render()`` that emits Prometheus
+text exposition format so the numbers are scrapeable without client libs.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Sequence, Tuple
+
+_DEFAULT_BUCKETS = (0.001, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0,
+                    2.5, 5.0, 10.0, 30.0, 60.0, 120.0, 300.0, 600.0)
+
+_registry_lock = threading.Lock()
+_registry: List["_Metric"] = []
+
+
+class _Child:
+    __slots__ = ("_metric", "_labels")
+
+    def __init__(self, metric: "_Metric", labels: Tuple[str, ...]):
+        self._metric = metric
+        self._labels = labels
+
+    def inc(self, amount: float = 1.0) -> None:
+        self._metric._inc(self._labels, amount)
+
+    def dec(self, amount: float = 1.0) -> None:
+        self._metric._inc(self._labels, -amount)
+
+    def set(self, value: float) -> None:
+        self._metric._set(self._labels, value)
+
+    def observe(self, value: float) -> None:
+        self._metric._observe(self._labels, value)
+
+    def get(self) -> float:
+        return self._metric._get(self._labels)
+
+
+class _Metric:
+    kind = "untyped"
+
+    def __init__(self, name: str, help_: str, label_names: Sequence[str] = ()):
+        self.name = name
+        self.help = help_
+        self.label_names = tuple(label_names)
+        self._lock = threading.Lock()
+        self._values: Dict[Tuple[str, ...], float] = {}
+        with _registry_lock:
+            _registry.append(self)
+
+    def labels(self, *label_values: str) -> _Child:
+        if len(label_values) != len(self.label_names):
+            raise ValueError(f"{self.name}: expected {len(self.label_names)} labels, "
+                             f"got {len(label_values)}")
+        return _Child(self, tuple(str(v) for v in label_values))
+
+    # default (no-label) passthroughs
+    def inc(self, amount: float = 1.0) -> None:
+        self.labels().inc(amount)
+
+    def set(self, value: float) -> None:
+        self.labels().set(value)
+
+    def observe(self, value: float) -> None:
+        self.labels().observe(value)
+
+    def get(self, *label_values: str) -> float:
+        return self._get(tuple(str(v) for v in label_values))
+
+    def _inc(self, labels, amount):
+        with self._lock:
+            self._values[labels] = self._values.get(labels, 0.0) + amount
+
+    def _set(self, labels, value):
+        with self._lock:
+            self._values[labels] = value
+
+    def _observe(self, labels, value):
+        raise TypeError(f"{self.kind} does not support observe()")
+
+    def _get(self, labels):
+        with self._lock:
+            return self._values.get(labels, 0.0)
+
+    def samples(self):
+        with self._lock:
+            return dict(self._values)
+
+    def reset(self):
+        with self._lock:
+            self._values.clear()
+
+    def _render(self) -> List[str]:
+        lines = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} {self.kind}"]
+        for labels, value in sorted(self.samples().items()):
+            lines.append(f"{self.name}{_fmt_labels(self.label_names, labels)} {value}")
+        return lines
+
+
+def _escape(v: str) -> str:
+    return v.replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+
+
+def _fmt_labels(names, values, extra: str = "") -> str:
+    parts = [f'{n}="{_escape(v)}"' for n, v in zip(names, values)]
+    if extra:
+        parts.append(extra)
+    return "{" + ",".join(parts) + "}" if parts else ""
+
+
+class Counter(_Metric):
+    kind = "counter"
+
+
+class Gauge(_Metric):
+    kind = "gauge"
+
+
+class Histogram(_Metric):
+    kind = "histogram"
+
+    def __init__(self, name, help_, label_names=(), buckets: Sequence[float] = _DEFAULT_BUCKETS):
+        super().__init__(name, help_, label_names)
+        self.buckets = tuple(sorted(buckets))
+        self._counts: Dict[Tuple[str, ...], List[int]] = {}
+        self._sums: Dict[Tuple[str, ...], float] = {}
+        self._totals: Dict[Tuple[str, ...], int] = {}
+
+    def _observe(self, labels, value):
+        with self._lock:
+            counts = self._counts.setdefault(labels, [0] * len(self.buckets))
+            idx = next((j for j, b in enumerate(self.buckets) if value <= b), None)
+            if idx is not None:
+                counts[idx] += 1
+            self._sums[labels] = self._sums.get(labels, 0.0) + value
+            self._totals[labels] = self._totals.get(labels, 0) + 1
+
+    def _get(self, labels):
+        with self._lock:
+            return float(self._totals.get(labels, 0))
+
+    def sum(self, *label_values: str) -> float:
+        with self._lock:
+            return self._sums.get(tuple(str(v) for v in label_values), 0.0)
+
+    def count(self, *label_values: str) -> int:
+        with self._lock:
+            return self._totals.get(tuple(str(v) for v in label_values), 0)
+
+    def reset(self):
+        with self._lock:
+            self._counts.clear()
+            self._sums.clear()
+            self._totals.clear()
+
+    def _render(self) -> List[str]:
+        lines = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} histogram"]
+        with self._lock:
+            items = [(lv, list(c), self._sums.get(lv, 0.0), self._totals.get(lv, 0))
+                     for lv, c in self._counts.items()]
+        for labels, counts, s, total in sorted(items):
+            cum = 0
+            for b, c in zip(self.buckets, counts):
+                cum += c
+                lines.append(f"{self.name}_bucket"
+                             f"{_fmt_labels(self.label_names, labels, f'le=\"{b}\"')} {cum}")
+            lines.append(f"{self.name}_bucket"
+                         f"{_fmt_labels(self.label_names, labels, 'le=\"+Inf\"')} {total}")
+            lines.append(f"{self.name}_sum{_fmt_labels(self.label_names, labels)} {s}")
+            lines.append(f"{self.name}_count{_fmt_labels(self.label_names, labels)} {total}")
+        return lines
+
+
+def render() -> str:
+    """Prometheus text exposition of every registered metric."""
+    with _registry_lock:
+        metrics_ = list(_registry)
+    out: List[str] = []
+    for m in metrics_:
+        out.extend(m._render())
+    return "\n".join(out) + "\n"
+
+
+def reset_all() -> None:
+    with _registry_lock:
+        for m in _registry:
+            m.reset()
+
+
+# ---------------------------------------------------------------------------
+# The reference's metric families (pkg/metrics/metrics.go:24-117), renamed to
+# this project's prefix.
+# ---------------------------------------------------------------------------
+
+API_REQUESTS = Counter(
+    "karpenter_tpu_api_requests_total",
+    "Cloud API requests by service, operation, status",
+    ("service", "operation", "status"))
+PROVISIONING_DURATION = Histogram(
+    "karpenter_tpu_provisioning_duration_seconds",
+    "Instance provisioning duration",
+    ("instance_type", "zone", "status"))
+COST_PER_HOUR = Gauge(
+    "karpenter_tpu_cost_per_hour",
+    "Hourly cost of provisioned capacity",
+    ("instance_type", "zone", "capacity_type"))
+QUOTA_UTILIZATION = Gauge(
+    "karpenter_tpu_quota_utilization",
+    "Quota utilization ratio", ("resource", "region"))
+INSTANCE_LIFECYCLE = Counter(
+    "karpenter_tpu_instance_lifecycle_total",
+    "Instance lifecycle events", ("event", "instance_type", "zone"))
+ERRORS = Counter(
+    "karpenter_tpu_errors_total",
+    "Errors by component and kind", ("component", "kind"))
+TIMEOUT_ERRORS = Counter(
+    "karpenter_tpu_timeout_errors_total",
+    "Timeout errors by component", ("component",))
+DRIFT_DETECTIONS = Counter(
+    "karpenter_tpu_drift_detections_total",
+    "Drift detections by reason", ("reason",))
+DRIFT_DETECTION_DURATION = Histogram(
+    "karpenter_tpu_drift_detection_duration_seconds",
+    "Drift check duration", ())
+BATCH_WINDOW_SECONDS = Histogram(
+    "karpenter_tpu_batcher_batch_time_seconds",
+    "Age of fired batch windows", ("batcher",))
+BATCH_SIZE = Histogram(
+    "karpenter_tpu_batcher_batch_size",
+    "Items per fired batch", ("batcher",),
+    buckets=(1, 2, 5, 10, 20, 50, 100, 200, 500, 1000, 10000))
+
+# Solver-specific families (new in the TPU build).
+SOLVE_DURATION = Histogram(
+    "karpenter_tpu_solve_duration_seconds",
+    "End-to-end placement solve latency", ("backend",))
+SOLVE_PODS = Histogram(
+    "karpenter_tpu_solve_pods",
+    "Pods per solve window", ("backend",),
+    buckets=(1, 10, 100, 1000, 10000, 100000))
+SOLVE_COST = Gauge(
+    "karpenter_tpu_solve_plan_cost_per_hour",
+    "Hourly cost of the last plan", ("backend",))
+
+# Autoplacement families (autoplacement/metrics.go:81).
+AUTOPLACEMENT_SELECTIONS = Counter(
+    "karpenter_tpu_autoplacement_selections_total",
+    "Autoplacement selection runs", ("kind", "status"))
+AUTOPLACEMENT_DURATION = Histogram(
+    "karpenter_tpu_autoplacement_duration_seconds",
+    "Autoplacement selection latency", ("kind",))

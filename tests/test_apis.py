@@ -1,0 +1,133 @@
+"""Tests for requirements semantics, quantity parsing, NodeClass validation.
+
+Parity targets: NodeSelectorRequirement operator behavior used by
+cloudprovider.go:321-352 and the CRD CEL rules ibmnodeclass_types.go:481-488.
+"""
+
+import pytest
+
+from karpenter_tpu.apis import (
+    NodeClass, NodeClassSpec, InstanceRequirements, ImageSelector,
+    PodSpec, Toleration, Taint,
+)
+from karpenter_tpu.apis.pod import (
+    ResourceRequests, parse_cpu_milli, parse_memory_mib, tolerates_all,
+)
+from karpenter_tpu.apis.requirements import Operator, Requirement, Requirements
+
+
+class TestQuantities:
+    @pytest.mark.parametrize("q,want", [
+        ("500m", 500), ("2", 2000), (1.5, 1500), ("0", 0), ("250m", 250)])
+    def test_cpu(self, q, want):
+        assert parse_cpu_milli(q) == want
+
+    @pytest.mark.parametrize("q,want", [
+        ("4Gi", 4096), ("512Mi", 512), ("1Ti", 1024 * 1024), ("1G", 954)])
+    def test_memory(self, q, want):
+        assert parse_memory_mib(q) == want
+
+    def test_parse_requests(self):
+        r = ResourceRequests.parse({"cpu": "500m", "memory": "1Gi",
+                                    "nvidia.com/gpu": 2})
+        assert r.as_tuple() == (500, 1024, 2, 1)
+
+
+class TestRequirements:
+    def test_in(self):
+        r = Requirement("zone", Operator.IN, ("a", "b"))
+        assert r.matches({"zone": "a"})
+        assert not r.matches({"zone": "c"})
+        assert not r.matches({})
+
+    def test_not_in_allows_absent(self):
+        r = Requirement("zone", Operator.NOT_IN, ("a",))
+        assert r.matches({})
+        assert r.matches({"zone": "b"})
+        assert not r.matches({"zone": "a"})
+
+    def test_exists_and_absent(self):
+        assert Requirement("k", Operator.EXISTS).matches({"k": "x"})
+        assert not Requirement("k", Operator.EXISTS).matches({})
+        assert Requirement("k", Operator.DOES_NOT_EXIST).matches({})
+
+    def test_gt_lt(self):
+        assert Requirement("cpu", Operator.GT, ("4",)).matches({"cpu": "8"})
+        assert not Requirement("cpu", Operator.GT, ("4",)).matches({"cpu": "4"})
+        assert Requirement("cpu", Operator.LT, ("4",)).matches({"cpu": "2"})
+
+    def test_allowed_values(self):
+        reqs = Requirements([Requirement("zone", Operator.IN, ("a", "b")),
+                             Requirement("zone", Operator.NOT_IN, ("b",))])
+        assert reqs.allowed_values("zone", ["a", "b", "c"]) == ["a"]
+
+    def test_signature_stable(self):
+        a = Requirements([Requirement("x", Operator.IN, ("1", "2"))])
+        b = Requirements([Requirement("x", Operator.IN, ("2", "1"))])
+        assert a.signature == b.signature
+
+
+class TestTolerations:
+    def test_exact_match(self):
+        taints = (Taint("dedicated", "gpu", "NoSchedule"),)
+        assert tolerates_all((Toleration("dedicated", "Equal", "gpu", "NoSchedule"),), taints)
+        assert not tolerates_all((Toleration("dedicated", "Equal", "cpu"),), taints)
+        assert not tolerates_all((), taints)
+
+    def test_exists_wildcard(self):
+        taints = (Taint("any", "x", "NoExecute"),)
+        assert tolerates_all((Toleration(operator="Exists"),), taints)
+
+    def test_prefer_no_schedule_is_soft(self):
+        taints = (Taint("soft", "x", "PreferNoSchedule"),)
+        assert tolerates_all((), taints)
+
+
+class TestNodeClassValidation:
+    def make(self, **kw):
+        spec = NodeClassSpec(region="us-south", instance_profile="bx2-4x16",
+                             image="img-1", vpc="vpc-1", **kw)
+        return NodeClass(name="default", spec=spec)
+
+    def test_valid(self):
+        assert self.make().validate() == []
+
+    def test_profile_xor_requirements(self):
+        nc = self.make()
+        nc.spec.instance_requirements = InstanceRequirements(architecture="amd64")
+        assert any("exactly one" in e for e in nc.validate())
+        nc.spec.instance_profile = ""
+        assert nc.validate() == []
+
+    def test_image_xor_selector(self):
+        nc = self.make()
+        nc.spec.image_selector = ImageSelector(os="ubuntu", major_version="22")
+        assert any("mutually exclusive" in e for e in nc.validate())
+
+    def test_iks_api_requires_cluster(self):
+        nc = self.make(bootstrap_mode="iks-api")
+        assert any("iksClusterID" in e for e in nc.validate())
+
+    def test_zone_in_region(self):
+        nc = self.make(zone="eu-de-1")
+        assert any("not in region" in e for e in nc.validate())
+        nc.spec.zone = "us-south-2"
+        assert nc.validate() == []
+
+    def test_spec_hash_changes_with_spec(self):
+        a, b = self.make(), self.make()
+        assert a.spec_hash() == b.spec_hash()
+        b.spec.subnet = "subnet-123"
+        assert a.spec_hash() != b.spec_hash()
+
+
+class TestPodSignature:
+    def test_identical_pods_group(self):
+        a = PodSpec("a", requests=ResourceRequests(500, 1024, 0, 1))
+        b = PodSpec("b", requests=ResourceRequests(500, 1024, 0, 1))
+        assert a.constraint_signature() == b.constraint_signature()
+
+    def test_different_requests_split(self):
+        a = PodSpec("a", requests=ResourceRequests(500, 1024, 0, 1))
+        b = PodSpec("b", requests=ResourceRequests(501, 1024, 0, 1))
+        assert a.constraint_signature() != b.constraint_signature()

@@ -1,0 +1,182 @@
+"""Tests for TTL cache, batcher window semantics, metrics.
+
+Mirrors the reference's dedicated cache/batcher tests
+(pkg/cache/race_condition_test.go, pkg/batcher/batcher_test.go).
+"""
+
+import threading
+import time
+
+import pytest
+
+from karpenter_tpu.utils.batcher import Batcher, BatcherOptions, default_hasher
+from karpenter_tpu.utils.cache import TTLCache
+from karpenter_tpu.utils import metrics
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+
+class TestTTLCache:
+    def test_set_get(self):
+        c = TTLCache(default_ttl=10)
+        c.set("a", 1)
+        assert c.get("a") == 1
+        assert c.get("missing", "dflt") == "dflt"
+
+    def test_expiry(self):
+        clock = FakeClock()
+        c = TTLCache(default_ttl=10, clock=clock)
+        c.set("a", 1)
+        clock.t = 9.9
+        assert c.get("a") == 1
+        clock.t = 10.1
+        assert c.get("a") is None
+
+    def test_per_entry_ttl(self):
+        clock = FakeClock()
+        c = TTLCache(default_ttl=10, clock=clock)
+        c.set("short", 1, ttl=1)
+        c.set("long", 2, ttl=100)
+        clock.t = 5
+        assert c.get("short") is None
+        assert c.get("long") == 2
+
+    def test_get_or_set_computes_once(self):
+        c = TTLCache(default_ttl=100)
+        calls = []
+
+        def compute():
+            calls.append(1)
+            time.sleep(0.05)
+            return 42
+
+        results = []
+        threads = [threading.Thread(target=lambda: results.append(c.get_or_set("k", compute)))
+                   for _ in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert results == [42] * 8
+        assert len(calls) == 1
+
+    def test_cleanup(self):
+        clock = FakeClock()
+        c = TTLCache(default_ttl=10, clock=clock)
+        for i in range(5):
+            c.set(i, i)
+        clock.t = 11
+        assert c.cleanup() == 5
+        assert len(c) == 0
+
+    def test_concurrent_mixed_ops(self):
+        c = TTLCache(default_ttl=100)
+        errors = []
+
+        def worker(n):
+            try:
+                for i in range(200):
+                    c.set((n, i % 10), i)
+                    c.get((n, i % 10))
+                    if i % 50 == 0:
+                        c.cleanup()
+            except Exception as e:  # pragma: no cover
+                errors.append(e)
+
+        threads = [threading.Thread(target=worker, args=(n,)) for n in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert not errors
+
+
+class TestBatcher:
+    def test_batches_concurrent_adds(self):
+        seen = []
+
+        def handler(items):
+            seen.append(list(items))
+            return [i * 2 for i in items]
+
+        b = Batcher(handler, BatcherOptions(idle_timeout=0.05, max_timeout=1.0,
+                                            max_items=100))
+        futs = [b.add(i) for i in range(10)]
+        assert [f.result(timeout=5) for f in futs] == [i * 2 for i in range(10)]
+        assert len(seen) == 1 and sorted(seen[0]) == list(range(10))
+        b.close()
+
+    def test_max_items_fires_immediately(self):
+        fired = threading.Event()
+
+        def handler(items):
+            fired.set()
+            return items
+
+        b = Batcher(handler, BatcherOptions(idle_timeout=10.0, max_timeout=30.0,
+                                            max_items=5))
+        futs = [b.add(i) for i in range(5)]
+        assert fired.wait(timeout=2)
+        for f in futs:
+            f.result(timeout=2)
+        b.close()
+
+    def test_handler_error_propagates_to_all(self):
+        def handler(items):
+            raise RuntimeError("boom")
+
+        b = Batcher(handler, BatcherOptions(idle_timeout=0.02, max_timeout=0.5))
+        futs = [b.add(i) for i in range(3)]
+        for f in futs:
+            with pytest.raises(RuntimeError, match="boom"):
+                f.result(timeout=5)
+        b.close()
+
+    def test_buckets_are_independent(self):
+        batches = []
+
+        def handler(items):
+            batches.append(sorted(items))
+            return items
+
+        b = Batcher(handler, BatcherOptions(idle_timeout=0.05, max_timeout=1.0),
+                    hasher=lambda x: x % 2)
+        futs = [b.add(i) for i in range(6)]
+        for f in futs:
+            f.result(timeout=5)
+        assert sorted(map(tuple, batches)) == [(0, 2, 4), (1, 3, 5)]
+        b.close()
+
+    def test_result_count_mismatch_errors(self):
+        b = Batcher(lambda items: [1], BatcherOptions(idle_timeout=0.02))
+        futs = [b.add(i) for i in range(3)]
+        for f in futs:
+            with pytest.raises(ValueError):
+                f.result(timeout=5)
+        b.close()
+
+
+class TestMetrics:
+    def test_counter_and_labels(self):
+        metrics.ERRORS.labels("solver", "timeout").inc()
+        metrics.ERRORS.labels("solver", "timeout").inc(2)
+        assert metrics.ERRORS.get("solver", "timeout") == 3.0
+
+    def test_histogram(self):
+        h = metrics.SOLVE_DURATION
+        h.labels("jax").observe(0.004)
+        h.labels("jax").observe(0.2)
+        assert h.count("jax") == 2
+        assert abs(h.sum("jax") - 0.204) < 1e-9
+
+    def test_render_exposition(self):
+        metrics.COST_PER_HOUR.labels("bx2-4x16", "us-south-1", "on-demand").set(0.2)
+        text = metrics.render()
+        assert "# TYPE karpenter_tpu_cost_per_hour gauge" in text
+        assert 'instance_type="bx2-4x16"' in text
