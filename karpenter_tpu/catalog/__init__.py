@@ -1,0 +1,14 @@
+from karpenter_tpu.catalog.instancetype import (
+    InstanceProfile, InstanceType, Offering, InstanceTypeProvider,
+    instance_type_score, filter_instance_types,
+)
+from karpenter_tpu.catalog.pricing import PricingProvider, StaticPricingProvider
+from karpenter_tpu.catalog.unavailable import UnavailableOfferings, offering_key
+from karpenter_tpu.catalog.arrays import CatalogArrays
+
+__all__ = [
+    "InstanceProfile", "InstanceType", "Offering", "InstanceTypeProvider",
+    "instance_type_score", "filter_instance_types",
+    "PricingProvider", "StaticPricingProvider",
+    "UnavailableOfferings", "offering_key", "CatalogArrays",
+]

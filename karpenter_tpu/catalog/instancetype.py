@@ -1,0 +1,277 @@
+"""Instance-type catalog: profiles -> InstanceTypes with offerings.
+
+Capability parity with ``pkg/providers/common/instancetype/instancetype.go``:
+- profile -> capacity conversion with the pods heuristic (30/60/110 by cpu,
+  instancetype.go:711-718) and family/size labels (:862-880);
+- per-zone x per-capacity-type offerings with spot price = on-demand x
+  discount% and availability from UnavailableOfferings (:749-773);
+- kubelet-config-driven overhead (defaults kube/system-reserved 100m+1Gi
+  each, eviction 500Mi — :792-858);
+- FilterInstanceTypes by InstanceRequirements incl. price ceiling (:259-356);
+- cost-efficiency ranking score = avg(price/cpu, price/memGB), lower better,
+  falling back to cpu+memGB when price unknown (:88-110);
+- exponential-backoff retry around the cloud list call (:440-446).
+"""
+
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from karpenter_tpu.apis.nodeclass import InstanceRequirements, KubeletConfig, NodeClass
+from karpenter_tpu.apis.pod import parse_cpu_milli, parse_memory_mib
+from karpenter_tpu.apis.requirements import (
+    CAPACITY_TYPE_ON_DEMAND, CAPACITY_TYPE_SPOT,
+    LABEL_ARCH, LABEL_CAPACITY_TYPE, LABEL_INSTANCE_FAMILY, LABEL_INSTANCE_SIZE,
+    LABEL_INSTANCE_TYPE, LABEL_ZONE, Requirements,
+)
+from karpenter_tpu.cloud.profile import InstanceProfile
+from karpenter_tpu.cloud.retry import retry_with_backoff
+from karpenter_tpu.utils.cache import TTLCache
+from karpenter_tpu.utils.logging import get_logger
+
+log = get_logger("catalog.instancetype")
+
+DEFAULT_SPOT_DISCOUNT_PERCENT = 60  # options.go:76
+
+
+def profile_family(name: str) -> str:
+    """"bx2-4x16" -> "bx2" (instancetype.go:862-868)."""
+    head = name.split("-", 1)[0]
+    return head if head else "balanced"
+
+
+def profile_size(name: str) -> str:
+    """"bx2-4x16" -> "4x16" (instancetype.go:871-880)."""
+    i = name.find("-")
+    return name[i + 1:] if 0 <= i < len(name) - 1 else "small"
+
+
+def pods_capacity(cpu: int) -> int:
+    """Pods-per-node heuristic (instancetype.go:711-718)."""
+    if cpu <= 2:
+        return 30
+    if cpu <= 4:
+        return 60
+    return 110
+
+
+@dataclass(frozen=True)
+class Offering:
+    zone: str
+    capacity_type: str           # on-demand | spot
+    price: float                 # $/hour
+    available: bool = True
+
+
+@dataclass
+class InstanceType:
+    """A schedulable instance type: capacity + requirements + offerings."""
+
+    name: str
+    cpu_milli: int
+    memory_mib: int
+    gpu: int
+    pods: int
+    architecture: str
+    family: str
+    size: str
+    offerings: List[Offering] = field(default_factory=list)
+    # overhead (reserved out of capacity before pods fit)
+    overhead_cpu_milli: int = 0
+    overhead_memory_mib: int = 0
+
+    @property
+    def allocatable_cpu_milli(self) -> int:
+        return max(0, self.cpu_milli - self.overhead_cpu_milli)
+
+    @property
+    def allocatable_memory_mib(self) -> int:
+        return max(0, self.memory_mib - self.overhead_memory_mib)
+
+    def label_values(self) -> Dict[str, str]:
+        return {
+            LABEL_INSTANCE_TYPE: self.name,
+            LABEL_ARCH: self.architecture,
+            LABEL_INSTANCE_FAMILY: self.family,
+            LABEL_INSTANCE_SIZE: self.size,
+        }
+
+    def cheapest_offering(self) -> Optional[Offering]:
+        avail = [o for o in self.offerings if o.available and o.price > 0]
+        return min(avail, key=lambda o: o.price) if avail else None
+
+
+def compute_overhead(kubelet: Optional[KubeletConfig]) -> Tuple[int, int]:
+    """-> (cpu_milli, memory_mib) reserved (instancetype.go:792-858).
+
+    Defaults: kubeReserved 100m/1Gi + systemReserved 100m/1Gi +
+    evictionHard memory 500Mi.
+    """
+    kube_cpu, kube_mem = 100, 1024
+    sys_cpu, sys_mem = 100, 1024
+    evict_mem = 500  # 500Mi
+    if kubelet:
+        kube = dict(kubelet.kube_reserved)
+        system = dict(kubelet.system_reserved)
+        evict = dict(kubelet.eviction_hard)
+        try:
+            if "cpu" in kube:
+                kube_cpu = parse_cpu_milli(kube["cpu"])
+            if "memory" in kube:
+                kube_mem = parse_memory_mib(kube["memory"])
+            if "cpu" in system:
+                sys_cpu = parse_cpu_milli(system["cpu"])
+            if "memory" in system:
+                sys_mem = parse_memory_mib(system["memory"])
+            if "memory.available" in evict:
+                evict_mem = parse_memory_mib(evict["memory.available"])
+        except ValueError as e:
+            log.warning("invalid kubelet reservation, using defaults", error=str(e))
+    return kube_cpu + sys_cpu, kube_mem + sys_mem + evict_mem
+
+
+def instance_type_score(it: InstanceType, price: float) -> float:
+    """Cost-efficiency rank, lower better (instancetype.go:88-110)."""
+    cpu = it.cpu_milli / 1000.0
+    mem_gb = it.memory_mib / 1024.0
+    if price <= 0:
+        return cpu + mem_gb
+    return (price / max(cpu, 1e-9) + price / max(mem_gb, 1e-9)) / 2.0
+
+
+def filter_instance_types(types: Sequence[InstanceType],
+                          reqs: InstanceRequirements) -> List[InstanceType]:
+    """Auto-selection filter (instancetype.go:259-356): architecture, minCPU,
+    minMemory, maxHourlyPrice (vs cheapest available offering), gpu."""
+    out = []
+    for it in types:
+        if reqs.architecture and it.architecture != reqs.architecture:
+            continue
+        if reqs.min_cpu and it.cpu_milli < reqs.min_cpu * 1000:
+            continue
+        if reqs.min_memory_gib and it.memory_mib < reqs.min_memory_gib * 1024:
+            continue
+        if reqs.gpu and it.gpu == 0:
+            continue
+        if reqs.max_hourly_price > 0:
+            cheapest = it.cheapest_offering()
+            if cheapest is None or cheapest.price > reqs.max_hourly_price:
+                continue
+        out.append(it)
+    # Rank by cost efficiency (instancetype.go:359).
+    def key(it: InstanceType):
+        o = it.cheapest_offering()
+        return instance_type_score(it, o.price if o else 0.0)
+    out.sort(key=key)
+    return out
+
+
+class InstanceTypeProvider:
+    """Builds and caches the InstanceType catalog from the cloud client.
+
+    Ref ``NewProvider`` instancetype.go:71; list retry :440-446; zone cache
+    1h :594-648; 30m catalog TTL.
+    """
+
+    def __init__(self, client, pricing_provider, unavailable: "UnavailableOfferings" = None,
+                 spot_discount_percent: int = DEFAULT_SPOT_DISCOUNT_PERCENT,
+                 catalog_ttl: float = 1800.0, clock=None):
+        from karpenter_tpu.catalog.unavailable import UnavailableOfferings
+        self._client = client
+        self._pricing = pricing_provider
+        self._unavailable = unavailable or UnavailableOfferings()
+        self._spot_discount = spot_discount_percent or DEFAULT_SPOT_DISCOUNT_PERCENT
+        self._clock = clock
+        self._cache = TTLCache(default_ttl=catalog_ttl,
+                               **({"clock": clock} if clock else {}))
+        self._zone_cache = TTLCache(default_ttl=3600.0,
+                                    **({"clock": clock} if clock else {}))
+
+    @property
+    def unavailable_offerings(self):
+        return self._unavailable
+
+    def zones(self) -> List[str]:
+        return self._zone_cache.get_or_set(
+            "zones", lambda: retry_with_backoff(self._client.list_zones))
+
+    def list(self, nodeclass: Optional[NodeClass] = None) -> List[InstanceType]:
+        """Full catalog with offerings; availability is applied fresh on every
+        call (the blackout set changes faster than the catalog)."""
+        kubelet = nodeclass.spec.kubelet if nodeclass else None
+        base: List[InstanceType] = self._cache.get_or_set(
+            ("catalog", self._kubelet_key(kubelet)),
+            lambda: self._build(kubelet))
+        return [self._with_fresh_availability(it) for it in base]
+
+    def get(self, name: str, nodeclass: Optional[NodeClass] = None) -> Optional[InstanceType]:
+        for it in self.list(nodeclass):
+            if it.name == name:
+                return it
+        return None
+
+    def refresh(self) -> None:
+        """Hourly singleton hook (controllers/providers/instancetype)."""
+        self._cache = TTLCache(default_ttl=self._cache._default_ttl,
+                               **({"clock": self._clock} if self._clock else {}))
+        self._unavailable.cleanup()
+
+    # -- internals ---------------------------------------------------------
+
+    @staticmethod
+    def _kubelet_key(kubelet: Optional[KubeletConfig]):
+        return kubelet if kubelet is None else (
+            kubelet.max_pods, kubelet.system_reserved, kubelet.kube_reserved,
+            kubelet.eviction_hard)
+
+    def _build(self, kubelet: Optional[KubeletConfig]) -> List[InstanceType]:
+        profiles: List[InstanceProfile] = retry_with_backoff(
+            self._client.list_instance_profiles)
+        zones = self.zones()
+        if not zones:
+            raise RuntimeError(f"no zones found for region {self._client.region}")
+        oh_cpu, oh_mem = compute_overhead(kubelet)
+        out = []
+        for p in profiles:
+            pods = kubelet.max_pods if (kubelet and kubelet.max_pods) else pods_capacity(p.cpu)
+            it = InstanceType(
+                name=p.name,
+                cpu_milli=p.cpu * 1000,
+                memory_mib=p.memory_gib * 1024,
+                gpu=p.gpu,
+                pods=pods,
+                architecture=p.architecture,
+                family=profile_family(p.name),
+                size=profile_size(p.name),
+                overhead_cpu_milli=oh_cpu,
+                overhead_memory_mib=oh_mem,
+            )
+            caps = [CAPACITY_TYPE_ON_DEMAND] + (
+                [CAPACITY_TYPE_SPOT] if p.supports_spot else [])
+            for zone in zones:
+                od_price = self._pricing.get_price(p.name, zone)
+                for cap in caps:
+                    price = od_price
+                    if cap == CAPACITY_TYPE_SPOT:
+                        price = od_price * self._spot_discount / 100.0
+                    it.offerings.append(Offering(zone=zone, capacity_type=cap,
+                                                 price=price, available=True))
+            out.append(it)
+        log.info("built instance-type catalog", types=len(out), zones=len(zones))
+        return out
+
+    def _with_fresh_availability(self, it: InstanceType) -> InstanceType:
+        offerings = [
+            Offering(o.zone, o.capacity_type, o.price,
+                     available=not self._unavailable.is_unavailable(
+                         it.name, o.zone, o.capacity_type))
+            for o in it.offerings
+        ]
+        return InstanceType(
+            name=it.name, cpu_milli=it.cpu_milli, memory_mib=it.memory_mib,
+            gpu=it.gpu, pods=it.pods, architecture=it.architecture,
+            family=it.family, size=it.size, offerings=offerings,
+            overhead_cpu_milli=it.overhead_cpu_milli,
+            overhead_memory_mib=it.overhead_memory_mib)

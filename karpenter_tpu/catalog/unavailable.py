@@ -1,0 +1,59 @@
+"""Unavailable-offerings cache: TTL'd blackout set for (type, zone, capacity).
+
+Parity with the reference's ``pkg/cache/unavailable_offerings.go:24-87`` —
+the shared availability feedback channel between the catalog, interruption
+controller, and spot-preemption controller (wired at operator.go:62-63).
+In the TPU build this is the *writer* of the availability mask column of the
+device-resident catalog tensors.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Callable, List, Tuple
+
+from karpenter_tpu.utils.cache import TTLCache
+
+
+def offering_key(instance_type: str, zone: str, capacity_type: str) -> str:
+    return f"{instance_type}:{zone}:{capacity_type}"
+
+
+class UnavailableOfferings:
+    DEFAULT_TTL = 3600.0  # spot preemption blacks out for 1h (preemption/controller.go:97)
+
+    def __init__(self, clock: Callable[[], float] = time.monotonic):
+        self._cache = TTLCache(default_ttl=self.DEFAULT_TTL, clock=clock)
+        self._generation = 0
+
+    def mark_unavailable(self, instance_type: str, zone: str, capacity_type: str,
+                         ttl: float = None, reason: str = "") -> None:
+        self._cache.set(offering_key(instance_type, zone, capacity_type),
+                        reason or "unavailable", ttl)
+        self._generation += 1
+
+    def is_unavailable(self, instance_type: str, zone: str, capacity_type: str) -> bool:
+        return self._cache.contains(offering_key(instance_type, zone, capacity_type))
+
+    def is_unavailable_key(self, key: str) -> bool:
+        return self._cache.contains(key)
+
+    def unavailable_keys(self) -> List[str]:
+        return list(self._cache.keys())
+
+    def cleanup(self) -> int:
+        """Called by the hourly catalog-refresh singleton
+        (controllers/providers/instancetype/instancetype.go:58)."""
+        purged = self._cache.cleanup()
+        if purged:
+            self._generation += 1
+        return purged
+
+    @property
+    def generation(self) -> int:
+        """Bumped on every write *and* on TTL expiry — lets the catalog
+        arrays know when the availability mask must be re-derived.  Reading
+        the generation purges expired entries first so expiry is observable
+        without waiting for the hourly cleanup sweep."""
+        self.cleanup()
+        return self._generation

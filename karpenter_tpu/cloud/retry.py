@@ -1,0 +1,60 @@
+"""Retry stack: exponential backoff + 429 Retry-After honoring.
+
+Parity with the reference's two retry layers:
+- exponential backoff 1s -> 15s cap, 10 steps for catalog listing
+  (instancetype.go:440-446);
+- generic rate-limit retry that honors Retry-After
+  (ratelimit_retry.go:39).
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass
+from typing import Callable, TypeVar
+
+from karpenter_tpu.cloud.errors import CloudError, is_rate_limit, is_retryable, parse_error
+from karpenter_tpu.utils.logging import get_logger
+
+log = get_logger("cloud.retry")
+
+T = TypeVar("T")
+
+
+@dataclass
+class RetryConfig:
+    initial: float = 1.0
+    factor: float = 2.0
+    cap: float = 15.0
+    steps: int = 10
+    honor_retry_after: bool = True
+
+
+def retry_with_backoff(fn: Callable[[], T], config: RetryConfig = None,
+                       sleep: Callable[[float], None] = time.sleep,
+                       operation: str = "") -> T:
+    """Call ``fn`` with exponential backoff on retryable errors.
+
+    Non-retryable errors raise immediately; the last error raises after
+    ``steps`` attempts.
+    """
+    cfg = config or RetryConfig()
+    delay = cfg.initial
+    last: Exception = RuntimeError("retry_with_backoff: no attempts")
+    for attempt in range(cfg.steps):
+        try:
+            return fn()
+        except Exception as e:  # noqa: BLE001 — classified below
+            err = parse_error(e, operation)
+            if not is_retryable(err):
+                raise
+            last = e
+            wait = delay
+            if cfg.honor_retry_after and is_rate_limit(err) and err.retry_after > 0:
+                wait = err.retry_after
+            if attempt < cfg.steps - 1:
+                log.debug("retrying after error", operation=operation,
+                          attempt=attempt + 1, wait=wait, error=str(e))
+                sleep(wait)
+                delay = min(delay * cfg.factor, cfg.cap)
+    raise last

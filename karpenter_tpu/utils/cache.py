@@ -86,9 +86,11 @@ class TTLCache:
             for k in dead:
                 del self._data[k]
             # Drop in-flight locks with no live entry so churning key sets
-            # don't leak lock objects.
+            # don't leak lock objects — but never one currently held by a
+            # computing thread, which would let a second caller race past
+            # the anti-stampede guarantee.
             for k in list(self._inflight):
-                if k not in self._data:
+                if k not in self._data and not self._inflight[k].locked():
                     del self._inflight[k]
             return len(dead)
 

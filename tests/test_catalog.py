@@ -1,0 +1,185 @@
+"""Tests for the instance-type catalog, pricing, offerings, dense arrays.
+
+Parity targets: pods heuristic (instancetype.go:711-718), spot discounting
+(:744-756), filter semantics (:259-356), ranking (:88-110), unavailable
+offerings (cache/unavailable_offerings.go).
+"""
+
+import numpy as np
+import pytest
+
+from karpenter_tpu.apis.nodeclass import InstanceRequirements, KubeletConfig
+from karpenter_tpu.catalog import (
+    CatalogArrays, InstanceTypeProvider, PricingProvider, StaticPricingProvider,
+    UnavailableOfferings, filter_instance_types, instance_type_score,
+)
+from karpenter_tpu.catalog.instancetype import (
+    compute_overhead, pods_capacity, profile_family, profile_size,
+)
+from karpenter_tpu.cloud.fake import FakeCloud
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+
+@pytest.fixture
+def cloud():
+    return FakeCloud()
+
+
+@pytest.fixture
+def provider(cloud):
+    pricing = PricingProvider(cloud)
+    yield InstanceTypeProvider(cloud, pricing)
+    pricing.close()
+
+
+class TestProfiles:
+    def test_family_size(self):
+        assert profile_family("bx2-4x16") == "bx2"
+        assert profile_size("bx2-4x16") == "4x16"
+        assert profile_family("bx3d-2x8") == "bx3d"
+
+    def test_pods_heuristic(self):
+        assert pods_capacity(2) == 30
+        assert pods_capacity(4) == 60
+        assert pods_capacity(8) == 110
+
+    def test_overhead_defaults(self):
+        cpu, mem = compute_overhead(None)
+        assert cpu == 200          # 100m kube + 100m system
+        assert mem == 1024 + 1024 + 500
+
+    def test_overhead_custom(self):
+        kc = KubeletConfig(kube_reserved=(("cpu", "200m"), ("memory", "2Gi")),
+                           system_reserved=(("cpu", "50m"),),
+                           eviction_hard=(("memory.available", "1Gi"),))
+        cpu, mem = compute_overhead(kc)
+        assert cpu == 250
+        assert mem == 2048 + 1024 + 1024
+
+
+class TestInstanceTypeProvider:
+    def test_list_builds_offerings(self, provider):
+        types = provider.list()
+        assert len(types) == 20
+        it = types[0]
+        # 3 zones x 2 capacity types
+        assert len(it.offerings) == 6
+        spot = [o for o in it.offerings if o.capacity_type == "spot"]
+        od = [o for o in it.offerings if o.capacity_type == "on-demand"]
+        assert spot[0].price == pytest.approx(od[0].price * 0.6)
+
+    def test_catalog_cached(self, cloud, provider):
+        provider.list()
+        n = cloud.recorder.call_count("list_instance_profiles")
+        provider.list()
+        assert cloud.recorder.call_count("list_instance_profiles") == n
+
+    def test_unavailable_applied_fresh(self, provider):
+        provider.list()
+        provider.unavailable_offerings.mark_unavailable("bx2-2x8", "us-south-1", "spot")
+        it = provider.get("bx2-2x8")
+        bad = [o for o in it.offerings
+               if o.zone == "us-south-1" and o.capacity_type == "spot"]
+        assert bad and not bad[0].available
+        ok = [o for o in it.offerings
+              if o.zone == "us-south-2" and o.capacity_type == "spot"]
+        assert ok[0].available
+
+    def test_allocatable_subtracts_overhead(self, provider):
+        it = provider.get("bx2-2x8")
+        assert it.cpu_milli == 2000
+        assert it.allocatable_cpu_milli == 1800
+        assert it.allocatable_memory_mib == 8 * 1024 - 2548
+
+
+class TestFiltering:
+    def test_filter_by_requirements(self, provider):
+        types = provider.list()
+        out = filter_instance_types(types, InstanceRequirements(
+            architecture="amd64", min_cpu=8, min_memory_gib=32))
+        assert out
+        assert all(t.cpu_milli >= 8000 and t.memory_mib >= 32 * 1024 for t in out)
+
+    def test_price_ceiling(self, provider):
+        types = provider.list()
+        out = filter_instance_types(types, InstanceRequirements(max_hourly_price=0.2))
+        assert out
+        for t in out:
+            assert t.cheapest_offering().price <= 0.2
+
+    def test_ranked_by_cost_efficiency(self, provider):
+        out = filter_instance_types(provider.list(), InstanceRequirements(min_cpu=2))
+        scores = [instance_type_score(t, t.cheapest_offering().price) for t in out]
+        assert scores == sorted(scores)
+
+
+class TestPricing:
+    def test_batched_fetch(self, cloud):
+        p = PricingProvider(cloud)
+        try:
+            price = p.get_price("bx2-2x8")
+            assert price > 0
+            # whole catalog fetched once, then cached
+            calls = cloud.recorder.call_count("get_pricing")
+            assert calls == len(cloud.profiles)
+            p.get_price("bx2-4x16")
+            assert cloud.recorder.call_count("get_pricing") == calls
+        finally:
+            p.close()
+
+    def test_static_provider(self):
+        p = StaticPricingProvider({"a": 1.5})
+        assert p.get_price("a") == 1.5
+        assert p.get_price("b") == 0.0
+
+
+class TestUnavailableOfferings:
+    def test_ttl_expiry(self):
+        clock = FakeClock()
+        u = UnavailableOfferings(clock=clock)
+        u.mark_unavailable("t", "z", "spot", ttl=10)
+        assert u.is_unavailable("t", "z", "spot")
+        clock.t = 11
+        assert not u.is_unavailable("t", "z", "spot")
+
+    def test_generation_bumps(self):
+        u = UnavailableOfferings()
+        g = u.generation
+        u.mark_unavailable("t", "z", "spot")
+        assert u.generation > g
+
+
+class TestCatalogArrays:
+    def test_build_shapes(self, provider):
+        arrays = CatalogArrays.build(provider.list())
+        assert arrays.num_types == 20
+        assert arrays.num_offerings == 20 * 3 * 2
+        assert arrays.type_alloc.shape == (20, 4)
+        assert arrays.offering_alloc().shape == (arrays.num_offerings, 4)
+        assert arrays.off_price.dtype == np.float32
+
+    def test_offering_labels(self, provider):
+        arrays = CatalogArrays.build(provider.list())
+        o = arrays.find_offering("bx2-2x8", "us-south-2", "spot")
+        labels = arrays.offering_label_values(o)
+        assert labels["node.kubernetes.io/instance-type"] == "bx2-2x8"
+        assert labels["topology.kubernetes.io/zone"] == "us-south-2"
+        assert labels["karpenter.sh/capacity-type"] == "spot"
+
+    def test_availability_refresh(self, provider):
+        arrays = CatalogArrays.build(provider.list())
+        u = UnavailableOfferings()
+        assert arrays.refresh_availability(u) is False or arrays.off_avail.all()
+        u.mark_unavailable("bx2-2x8", "us-south-1", "spot")
+        assert arrays.refresh_availability(u) is True
+        o = arrays.find_offering("bx2-2x8", "us-south-1", "spot")
+        assert not arrays.off_avail[o]
+        # no-op when generation unchanged
+        assert arrays.refresh_availability(u) is False

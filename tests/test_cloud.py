@@ -1,0 +1,220 @@
+"""Tests for the fake cloud, error taxonomy, retry, subnet scoring, images.
+
+Reference test-strategy parity (SURVEY.md §4.2): stateful fakes with call
+recording and error injection gate all provisioning logic.
+"""
+
+import pytest
+
+from karpenter_tpu.apis.nodeclass import (
+    ImageSelector, PlacementStrategy, SubnetSelectionCriteria,
+)
+from karpenter_tpu.cloud.errors import (
+    CloudError, is_capacity, is_not_found, is_rate_limit, is_retryable, parse_error,
+)
+from karpenter_tpu.cloud.fake import FakeCloud, generate_profiles, profile_price
+from karpenter_tpu.cloud.image import ImageResolver, parse_image_name
+from karpenter_tpu.cloud.retry import RetryConfig, retry_with_backoff
+from karpenter_tpu.cloud.subnet import SubnetProvider, subnet_score
+
+
+class TestErrors:
+    def test_status_classification(self):
+        assert is_not_found(CloudError("x", 404))
+        assert is_rate_limit(CloudError("x", 429))
+        assert is_retryable(CloudError("x", 503))
+        assert not is_retryable(CloudError("x", 400))
+
+    def test_parse_string_errors(self):
+        assert parse_error(RuntimeError("instance not found")).code == "not_found"
+        assert parse_error(RuntimeError("rate limit exceeded")).retryable
+        assert is_capacity(parse_error(RuntimeError("insufficient capacity")))
+        assert not parse_error(RuntimeError("quota exceeded for vCPU")).retryable
+
+
+class TestRetry:
+    def test_retries_until_success(self):
+        attempts = []
+
+        def flaky():
+            attempts.append(1)
+            if len(attempts) < 3:
+                raise CloudError("unavailable", 503)
+            return "ok"
+
+        sleeps = []
+        assert retry_with_backoff(flaky, RetryConfig(initial=1, cap=15, steps=10),
+                                  sleep=sleeps.append) == "ok"
+        assert sleeps == [1, 2]
+
+    def test_backoff_caps(self):
+        sleeps = []
+
+        def always_fail():
+            raise CloudError("unavailable", 503)
+
+        with pytest.raises(CloudError):
+            retry_with_backoff(always_fail, RetryConfig(initial=1, cap=15, steps=6),
+                               sleep=sleeps.append)
+        assert sleeps == [1, 2, 4, 8, 15]
+
+    def test_non_retryable_raises_immediately(self):
+        attempts = []
+
+        def bad_request():
+            attempts.append(1)
+            raise CloudError("bad", 400)
+
+        with pytest.raises(CloudError):
+            retry_with_backoff(bad_request, sleep=lambda s: None)
+        assert len(attempts) == 1
+
+    def test_honors_retry_after(self):
+        sleeps = []
+        attempts = []
+
+        def limited():
+            attempts.append(1)
+            if len(attempts) < 2:
+                raise CloudError("429", 429, retry_after=7.5)
+            return "ok"
+
+        assert retry_with_backoff(limited, sleep=sleeps.append) == "ok"
+        assert sleeps == [7.5]
+
+
+class TestFakeCloud:
+    def test_create_get_delete_instance(self):
+        cloud = FakeCloud()
+        inst = cloud.create_instance("n1", "bx2-2x8", "us-south-1", "subnet-11", "img-1")
+        assert inst.id.startswith("inst-")
+        assert cloud.get_instance(inst.id).profile == "bx2-2x8"
+        assert cloud.subnets["subnet-11"].available_ips == 255
+        cloud.delete_instance(inst.id)
+        assert cloud.subnets["subnet-11"].available_ips == 256
+        with pytest.raises(CloudError):
+            cloud.get_instance(inst.id)
+
+    def test_create_validates_inputs(self):
+        cloud = FakeCloud()
+        with pytest.raises(CloudError):
+            cloud.create_instance("n", "nope", "us-south-1", "subnet-11", "img-1")
+        with pytest.raises(CloudError, match="not us-south-2"):
+            cloud.create_instance("n", "bx2-2x8", "us-south-2", "subnet-11", "img-1")
+
+    def test_error_injection(self):
+        cloud = FakeCloud()
+        cloud.recorder.inject_error("create_instance", CloudError("boom", 503))
+        with pytest.raises(CloudError, match="boom"):
+            cloud.create_instance("n", "bx2-2x8", "us-south-1", "subnet-11", "img-1")
+        # one-shot: next call succeeds
+        cloud.create_instance("n", "bx2-2x8", "us-south-1", "subnet-11", "img-1")
+        assert cloud.recorder.call_count("create_instance") == 2
+
+    def test_capacity_limits(self):
+        cloud = FakeCloud()
+        cloud.capacity_limits[("bx2-2x8", "us-south-1")] = 1
+        cloud.create_instance("a", "bx2-2x8", "us-south-1", "subnet-11", "img-1")
+        with pytest.raises(CloudError) as ei:
+            cloud.create_instance("b", "bx2-2x8", "us-south-1", "subnet-11", "img-1")
+        assert is_capacity(ei.value)
+        # other zone unaffected
+        cloud.create_instance("c", "bx2-2x8", "us-south-2", "subnet-21", "img-1")
+
+    def test_spot_preemption_simulation(self):
+        cloud = FakeCloud()
+        inst = cloud.create_instance("s", "bx2-2x8", "us-south-1", "subnet-11",
+                                     "img-1", capacity_type="spot")
+        cloud.preempt_spot_instance(inst.id)
+        spots = cloud.list_spot_instances()
+        assert spots[0].status == "stopped"
+        assert spots[0].status_reason == "stopped_by_preemption"
+
+    def test_generate_profiles_deterministic(self):
+        a = generate_profiles(500)
+        b = generate_profiles(500)
+        assert len(a) == 500
+        assert [p.name for p in a] == [p.name for p in b]
+        assert len({p.name for p in a}) == 500
+        assert all(profile_price(p) > 0 for p in a)
+
+
+class TestSubnets:
+    def test_score_prefers_free_subnets(self):
+        from karpenter_tpu.cloud.fake import FakeSubnet
+        empty = FakeSubnet(id="a", zone="z", total_ips=256, available_ips=256)
+        half = FakeSubnet(id="b", zone="z", total_ips=256, available_ips=128)
+        assert subnet_score(empty) > subnet_score(half)
+
+    def test_balanced_one_per_zone(self):
+        cloud = FakeCloud(subnets_per_zone=2)
+        prov = SubnetProvider(cloud)
+        sel = prov.select_subnets(PlacementStrategy(zone_balance="Balanced"))
+        assert len(sel) == 3
+        assert len({s.zone for s in sel}) == 3
+
+    def test_availability_first_selects_all(self):
+        cloud = FakeCloud(subnets_per_zone=2)
+        sel = SubnetProvider(cloud).select_subnets(
+            PlacementStrategy(zone_balance="AvailabilityFirst"))
+        assert len(sel) == 6
+
+    def test_cost_optimized_two_zones(self):
+        cloud = FakeCloud(subnets_per_zone=2)
+        sel = SubnetProvider(cloud).select_subnets(
+            PlacementStrategy(zone_balance="CostOptimized"))
+        assert len(sel) == 2
+        assert len({s.zone for s in sel}) == 2
+
+    def test_min_ips_filter(self):
+        cloud = FakeCloud(subnets_per_zone=1)
+        cloud.subnets["subnet-11"].available_ips = 3
+        sel = SubnetProvider(cloud).select_subnets(PlacementStrategy(
+            zone_balance="AvailabilityFirst",
+            subnet_selection=SubnetSelectionCriteria(minimum_available_ips=10)))
+        assert all(s.id != "subnet-11" for s in sel)
+
+    def test_cluster_awareness_bonus(self):
+        cloud = FakeCloud(subnets_per_zone=2)
+        # subnet-12 hosts 3 cluster nodes -> should outrank subnet-11
+        prov = SubnetProvider(cloud, cluster_subnets_fn=lambda: {"subnet-12": 3})
+        sel = prov.select_subnets(PlacementStrategy(zone_balance="Balanced"))
+        zone1 = [s for s in sel if s.zone == "us-south-1"]
+        assert zone1[0].id == "subnet-12"
+
+    def test_no_eligible_raises(self):
+        cloud = FakeCloud()
+        for s in cloud.subnets.values():
+            s.state = "pending"
+        with pytest.raises(ValueError, match="no eligible"):
+            SubnetProvider(cloud).select_subnets(PlacementStrategy())
+
+
+class TestImageResolver:
+    def test_parse_name(self):
+        p = parse_image_name("ubuntu-24-04-amd64")
+        assert p["os"] == "ubuntu" and p["major"] == "24" and p["arch"] == "amd64"
+        assert parse_image_name("weird") is None
+
+    def test_resolve_by_id_and_name(self):
+        cloud = FakeCloud()
+        r = ImageResolver(cloud)
+        assert r.resolve(image="img-1") == "img-1"
+        assert r.resolve(image="ubuntu-22-04-amd64") == "img-2"
+
+    def test_selector_picks_latest(self):
+        cloud = FakeCloud()
+        r = ImageResolver(cloud)
+        img = r.resolve(selector=ImageSelector(os="ubuntu", architecture="amd64"))
+        assert cloud.images[img].name == "ubuntu-24-04-amd64"
+
+    def test_selector_arch_filter(self):
+        cloud = FakeCloud()
+        img = ImageResolver(cloud).resolve(
+            selector=ImageSelector(os="ubuntu", architecture="arm64"))
+        assert cloud.images[img].name == "ubuntu-22-04-arm64"
+
+    def test_selector_no_match(self):
+        cloud = FakeCloud()
+        with pytest.raises(CloudError):
+            ImageResolver(cloud).resolve(selector=ImageSelector(os="windows"))
