@@ -21,6 +21,7 @@ generation and keeps them resident between solves (SURVEY.md §7.4
 
 from __future__ import annotations
 
+import itertools
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Sequence, Tuple
 
@@ -35,6 +36,8 @@ from karpenter_tpu.apis.requirements import (
 from karpenter_tpu.catalog.instancetype import InstanceType
 
 CAPACITY_TYPES = (CAPACITY_TYPE_ON_DEMAND, CAPACITY_TYPE_SPOT)
+
+_uid_counter = itertools.count(1)
 
 
 @dataclass
@@ -60,7 +63,8 @@ class CatalogArrays:
     sizes: List[str]
     # provenance
     generation: int = 0
-    availability_generation: int = -1
+    availability_generation: object = None
+    uid: int = -1                   # unique per build() — device-cache key
     _offering_index: Dict[Tuple[str, str, str], int] = field(default_factory=dict)
 
     # -- construction ------------------------------------------------------
@@ -110,7 +114,7 @@ class CatalogArrays:
             off_price=np.asarray(off_price, dtype=np.float32),
             off_avail=np.asarray(off_avail, dtype=bool),
             zones=zones, archs=archs, families=families, sizes=sizes,
-            generation=generation,
+            generation=generation, uid=next(_uid_counter),
             _offering_index=offering_index,
         )
 
@@ -127,6 +131,18 @@ class CatalogArrays:
     def offering_alloc(self) -> np.ndarray:
         """int32 [O, R] allocatable capacity per offering."""
         return self.type_alloc[self.off_type]
+
+    def offering_rank_price(self) -> np.ndarray:
+        """float32 [O] price used for *ranking only*: real price when known,
+        else a size-proportional pseudo-price (cpu cores + mem GiB), mirroring
+        the reference's fallback ranking for unpriced types
+        (instancetype.go:88-110).  Plan cost accounting still uses
+        ``off_price`` (0 for unknown), matching the reference's Offering
+        semantics."""
+        alloc = self.offering_alloc().astype(np.float32)
+        pseudo = alloc[:, 0] / 1000.0 + alloc[:, 1] / 1024.0
+        return np.where(self.off_price > 0, self.off_price,
+                        pseudo).astype(np.float32)
 
     def offering_label_values(self, o: int) -> Dict[str, str]:
         """Node label values an offering would produce — the host-side

@@ -109,26 +109,26 @@ def compute_overhead(kubelet: Optional[KubeletConfig]) -> Tuple[int, int]:
     Defaults: kubeReserved 100m/1Gi + systemReserved 100m/1Gi +
     evictionHard memory 500Mi.
     """
-    kube_cpu, kube_mem = 100, 1024
-    sys_cpu, sys_mem = 100, 1024
-    evict_mem = 500  # 500Mi
-    if kubelet:
-        kube = dict(kubelet.kube_reserved)
-        system = dict(kubelet.system_reserved)
-        evict = dict(kubelet.eviction_hard)
+    def parse_or(d, key, parser, default):
+        # Per-field fallback (the reference keeps the default for each
+        # malformed quantity individually, instancetype.go:801-843).
+        if key not in d:
+            return default
         try:
-            if "cpu" in kube:
-                kube_cpu = parse_cpu_milli(kube["cpu"])
-            if "memory" in kube:
-                kube_mem = parse_memory_mib(kube["memory"])
-            if "cpu" in system:
-                sys_cpu = parse_cpu_milli(system["cpu"])
-            if "memory" in system:
-                sys_mem = parse_memory_mib(system["memory"])
-            if "memory.available" in evict:
-                evict_mem = parse_memory_mib(evict["memory.available"])
+            return parser(d[key])
         except ValueError as e:
-            log.warning("invalid kubelet reservation, using defaults", error=str(e))
+            log.warning("invalid kubelet reservation, using default",
+                        key=key, value=d[key], error=str(e))
+            return default
+
+    kube = dict(kubelet.kube_reserved) if kubelet else {}
+    system = dict(kubelet.system_reserved) if kubelet else {}
+    evict = dict(kubelet.eviction_hard) if kubelet else {}
+    kube_cpu = parse_or(kube, "cpu", parse_cpu_milli, 100)
+    kube_mem = parse_or(kube, "memory", parse_memory_mib, 1024)
+    sys_cpu = parse_or(system, "cpu", parse_cpu_milli, 100)
+    sys_mem = parse_or(system, "memory", parse_memory_mib, 1024)
+    evict_mem = parse_or(evict, "memory.available", parse_memory_mib, 500)
     return kube_cpu + sys_cpu, kube_mem + sys_mem + evict_mem
 
 
@@ -188,6 +188,7 @@ class InstanceTypeProvider:
                                **({"clock": clock} if clock else {}))
         self._zone_cache = TTLCache(default_ttl=3600.0,
                                     **({"clock": clock} if clock else {}))
+        self._avail_cache: dict = {}
 
     @property
     def unavailable_offerings(self):
@@ -198,13 +199,20 @@ class InstanceTypeProvider:
             "zones", lambda: retry_with_backoff(self._client.list_zones))
 
     def list(self, nodeclass: Optional[NodeClass] = None) -> List[InstanceType]:
-        """Full catalog with offerings; availability is applied fresh on every
-        call (the blackout set changes faster than the catalog)."""
+        """Full catalog with offerings; availability is re-applied whenever
+        the blackout set changes (cheap equality check on its generation, so
+        steady-state list() calls return the cached objects)."""
         kubelet = nodeclass.spec.kubelet if nodeclass else None
+        key = ("catalog", self._kubelet_key(kubelet))
         base: List[InstanceType] = self._cache.get_or_set(
-            ("catalog", self._kubelet_key(kubelet)),
-            lambda: self._build(kubelet))
-        return [self._with_fresh_availability(it) for it in base]
+            key, lambda: self._build(kubelet))
+        gen = self._unavailable.generation
+        cached = self._avail_cache.get(key)
+        if cached is not None and cached[0] == gen and cached[1] is base:
+            return cached[2]
+        applied = [self._with_fresh_availability(it) for it in base]
+        self._avail_cache[key] = (gen, base, applied)
+        return applied
 
     def get(self, name: str, nodeclass: Optional[NodeClass] = None) -> Optional[InstanceType]:
         for it in self.list(nodeclass):

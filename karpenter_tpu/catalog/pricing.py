@@ -80,16 +80,28 @@ class PricingProvider:
             prices = {}
             for name, fut in futures.items():
                 try:
-                    prices[name] = fut.result(timeout=30)
-                except Exception as e:  # price miss is non-fatal
+                    value = fut.result(timeout=30)
+                    if value is not None:
+                        prices[name] = value
+                except Exception as e:  # batch-level failure is non-fatal too
                     log.warning("pricing fetch failed", type=name, error=str(e))
             with self._lock:
                 self._prices.update(prices)
                 self._fetched_at = self._clock()
             log.info("pricing refreshed", entries=len(prices))
 
-    def _fetch_batch(self, names: Sequence[str]) -> List[float]:
-        return [self._client.get_pricing(n) for n in names]
+    def _fetch_batch(self, names: Sequence[str]) -> List[Optional[float]]:
+        # Per-item isolation: one failing entry must not poison the whole
+        # window (the batcher propagates a handler exception to every
+        # caller in the batch).
+        out: List[Optional[float]] = []
+        for n in names:
+            try:
+                out.append(self._client.get_pricing(n))
+            except Exception as e:  # noqa: BLE001 — miss is non-fatal
+                log.warning("pricing fetch failed", type=n, error=str(e))
+                out.append(None)
+        return out
 
 
 class StaticPricingProvider:

@@ -24,13 +24,11 @@ class UnavailableOfferings:
 
     def __init__(self, clock: Callable[[], float] = time.monotonic):
         self._cache = TTLCache(default_ttl=self.DEFAULT_TTL, clock=clock)
-        self._generation = 0
 
     def mark_unavailable(self, instance_type: str, zone: str, capacity_type: str,
                          ttl: float = None, reason: str = "") -> None:
         self._cache.set(offering_key(instance_type, zone, capacity_type),
                         reason or "unavailable", ttl)
-        self._generation += 1
 
     def is_unavailable(self, instance_type: str, zone: str, capacity_type: str) -> bool:
         return self._cache.contains(offering_key(instance_type, zone, capacity_type))
@@ -44,16 +42,13 @@ class UnavailableOfferings:
     def cleanup(self) -> int:
         """Called by the hourly catalog-refresh singleton
         (controllers/providers/instancetype/instancetype.go:58)."""
-        purged = self._cache.cleanup()
-        if purged:
-            self._generation += 1
-        return purged
+        return self._cache.cleanup()
 
     @property
-    def generation(self) -> int:
-        """Bumped on every write *and* on TTL expiry — lets the catalog
-        arrays know when the availability mask must be re-derived.  Reading
-        the generation purges expired entries first so expiry is observable
-        without waiting for the hourly cleanup sweep."""
-        self.cleanup()
-        return self._generation
+    def generation(self) -> frozenset:
+        """The set of currently-live blackout keys.  Consumers (catalog
+        arrays, availability-cached lists) compare generations for equality;
+        any write *or* TTL expiry — including lazy expiry inside the cache —
+        changes the value, so stale masks can never survive an expired
+        blackout."""
+        return frozenset(self._cache.keys())

@@ -1,0 +1,13 @@
+from karpenter_tpu.solver.types import (
+    SolveRequest, Plan, PlannedNode, SolverOptions,
+)
+from karpenter_tpu.solver.encode import EncodedProblem, encode
+from karpenter_tpu.solver.greedy import GreedySolver
+from karpenter_tpu.solver.jax_backend import JaxSolver
+from karpenter_tpu.solver.validate import validate_plan
+
+__all__ = [
+    "SolveRequest", "Plan", "PlannedNode", "SolverOptions",
+    "EncodedProblem", "encode",
+    "GreedySolver", "JaxSolver", "validate_plan",
+]

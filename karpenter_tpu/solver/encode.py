@@ -1,0 +1,250 @@
+"""Host-side problem encoding: pods -> dense group tensors + masks.
+
+This is the bridge between the relational scheduling world (requirements,
+taints, spread, affinity — SURVEY.md §7.4 "constraint fidelity in tensor
+form") and the dense solve.  Strategy: *hard masks + host-side group
+splitting*, so the device solve only ever sees
+
+- ``group_req``   int32 [G, R]   resource vector per pod of the group
+- ``group_count`` int32 [G]      pods in the group
+- ``group_cap``   int32 [G]      max pods of the group per node
+                                 (1 for hostname anti-affinity)
+- ``compat``      bool  [G, O]   group x offering feasibility
+
+Relational constraints are lowered as:
+- **node selectors / required node affinity** -> per-label allowed-value
+  masks over the catalog vocabularies, intersected into ``compat``;
+- **nodepool taints** -> pods that do not tolerate them are rejected
+  before grouping (unschedulable for this pool);
+- **topology spread over zones (DoNotSchedule)** -> the group is split
+  into per-zone pinned subgroups with counts as even as possible
+  (skew <= 1 <= maxSkew by construction);
+- **zone affinity (co-schedule)** -> group marked single-zone: compat is
+  restricted per-zone into Z candidate subproblems and the solver keeps
+  zone-pure placement by splitting into one pinned subgroup per candidate
+  zone... v1 pins to the zone with the most total compatible capacity;
+- **hostname anti-affinity (self)** -> per-node cap 1.
+
+Grouping identical pods is the long-axis compression (SURVEY.md §5.7): 10k
+replicas collapse into a handful of group rows; the device scan is over
+groups, not pods.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from karpenter_tpu.apis.nodeclaim import NodePool
+from karpenter_tpu.apis.pod import NUM_RESOURCES, PodSpec, tolerates_all
+from karpenter_tpu.apis.requirements import (
+    CAPACITY_TYPE_ON_DEMAND, CAPACITY_TYPE_SPOT,
+    LABEL_ARCH, LABEL_CAPACITY_TYPE, LABEL_HOSTNAME, LABEL_INSTANCE_FAMILY,
+    LABEL_INSTANCE_SIZE, LABEL_INSTANCE_TYPE, LABEL_ZONE, Requirements,
+)
+from karpenter_tpu.catalog.arrays import CAPACITY_TYPES, CatalogArrays
+
+BIG_CAP = 1 << 30  # "no per-node cap"
+
+
+@dataclass
+class PodGroup:
+    representative: PodSpec
+    pod_names: List[str]
+    count: int
+    requirements: Requirements
+    cap_per_node: int = BIG_CAP
+    pinned_zone: Optional[str] = None
+    spread_origin: Optional[Tuple] = None   # signature of the pre-split group
+
+
+@dataclass
+class EncodedProblem:
+    groups: List[PodGroup]
+    group_req: np.ndarray       # int32 [G, R]
+    group_count: np.ndarray     # int32 [G]
+    group_cap: np.ndarray       # int32 [G]
+    compat: np.ndarray          # bool [G, O]
+    catalog: CatalogArrays
+    rejected: List[str] = field(default_factory=list)  # pods unschedulable pre-solve
+    # group order is descending dominant-resource size; both backends
+    # consume the same order, so plans are comparable.
+
+    @property
+    def num_groups(self) -> int:
+        return len(self.groups)
+
+    @property
+    def num_pods(self) -> int:
+        return int(self.group_count.sum()) + len(self.rejected)
+
+
+def _dominant_size(req: Sequence[int], mean_alloc: np.ndarray) -> float:
+    """FFD sort key: dominant resource share vs mean node capacity."""
+    shares = [r / a if a > 0 else 0.0 for r, a in zip(req, mean_alloc)]
+    return max(shares)
+
+
+def _split_counts(total: int, ways: int) -> List[int]:
+    """Split ``total`` into ``ways`` parts as evenly as possible."""
+    base, rem = divmod(total, ways)
+    return [base + (1 if i < rem else 0) for i in range(ways)]
+
+
+def _allowed_mask(reqs: Requirements, key: str, vocab: List[str]) -> np.ndarray:
+    """bool [len(vocab)] — which vocabulary values every requirement on
+    ``key`` admits."""
+    allowed = set(reqs.allowed_values(key, vocab))
+    return np.array([v in allowed for v in vocab], dtype=bool)
+
+
+def _has_zone_affinity(pod: PodSpec) -> bool:
+    return any(not t.anti and t.topology_key == LABEL_ZONE for t in pod.affinity)
+
+
+def _has_hostname_anti_affinity(pod: PodSpec) -> bool:
+    """Self anti-affinity: the term's selector matches the pod's own labels."""
+    own = pod.labels_dict
+    for t in pod.affinity:
+        if t.anti and t.topology_key == LABEL_HOSTNAME:
+            if all(own.get(k) == v for k, v in t.label_selector):
+                return True
+    return False
+
+
+def _zone_spread_constraints(pod: PodSpec):
+    return [c for c in pod.topology_spread
+            if c.topology_key == LABEL_ZONE and c.when_unsatisfiable == "DoNotSchedule"]
+
+
+def encode(pods: Sequence[PodSpec], catalog: CatalogArrays,
+           nodepool: Optional[NodePool] = None) -> EncodedProblem:
+    """Group, split, and lower the scheduling problem to dense tensors."""
+    nodepool = nodepool or NodePool(name="default")
+    pool_labels = dict(nodepool.labels)
+
+    # 1. Reject pods that cannot run in this pool at all (taints).
+    rejected: List[str] = []
+    eligible: List[PodSpec] = []
+    for pod in pods:
+        if nodepool.taints and not tolerates_all(pod.tolerations, nodepool.taints):
+            rejected.append(pod.name)
+        else:
+            eligible.append(pod)
+
+    # 2. Group by constraint signature.
+    by_sig: Dict[Tuple, List[PodSpec]] = {}
+    for pod in eligible:
+        by_sig.setdefault(pod.constraint_signature(), []).append(pod)
+
+    # 3. Per-group requirement lowering + splitting.
+    known_keys = {LABEL_INSTANCE_TYPE, LABEL_ARCH, LABEL_INSTANCE_FAMILY,
+                  LABEL_INSTANCE_SIZE, LABEL_ZONE, LABEL_CAPACITY_TYPE}
+    groups: List[PodGroup] = []
+    for sig, members in by_sig.items():
+        rep = members[0]
+        reqs = rep.scheduling_requirements().merged(nodepool.requirements)
+        # requirements on keys the catalog can't express must be satisfied
+        # by static nodepool labels, else the group is unschedulable here
+        unsat = [r for r in reqs
+                 if r.key not in known_keys and not r.matches(pool_labels)]
+        if unsat:
+            rejected.extend(p.name for p in members)
+            continue
+        cap = 1 if _has_hostname_anti_affinity(rep) else BIG_CAP
+
+        zone_allowed = _allowed_mask(reqs, LABEL_ZONE, catalog.zones)
+        spread = _zone_spread_constraints(rep)
+        if spread and zone_allowed.sum() > 1:
+            # split into per-zone pinned subgroups, evenly (skew <= 1)
+            zones = [z for z, ok in zip(catalog.zones, zone_allowed) if ok]
+            counts = _split_counts(len(members), len(zones))
+            offset = 0
+            for zone, cnt in zip(zones, counts):
+                if cnt == 0:
+                    continue
+                sub = members[offset:offset + cnt]
+                offset += cnt
+                sub_reqs = Requirements(list(reqs.items))
+                groups.append(PodGroup(
+                    representative=rep, pod_names=[p.name for p in sub],
+                    count=cnt, requirements=sub_reqs, cap_per_node=cap,
+                    pinned_zone=zone, spread_origin=sig))
+        elif _has_zone_affinity(rep) and zone_allowed.sum() > 1:
+            # co-schedule in one zone: pin to the zone with the most
+            # compatible offering capacity (v1 heuristic; validator checks
+            # zone purity)
+            zones = [z for z, ok in zip(catalog.zones, zone_allowed) if ok]
+            best = _best_zone_for(rep, reqs, zones, catalog)
+            groups.append(PodGroup(
+                representative=rep, pod_names=[p.name for p in members],
+                count=len(members), requirements=reqs, cap_per_node=cap,
+                pinned_zone=best))
+        else:
+            groups.append(PodGroup(
+                representative=rep, pod_names=[p.name for p in members],
+                count=len(members), requirements=reqs, cap_per_node=cap))
+
+    # 4. FFD order: descending dominant size (deterministic tie-break on
+    # first pod name).
+    mean_alloc = catalog.type_alloc.mean(axis=0) if catalog.num_types else \
+        np.ones(NUM_RESOURCES)
+    groups.sort(key=lambda g: (-_dominant_size(g.representative.requests.as_tuple(),
+                                               mean_alloc),
+                               g.pod_names[0]))
+
+    # 5. Dense tensors.
+    G, O = len(groups), catalog.num_offerings
+    group_req = np.zeros((G, NUM_RESOURCES), dtype=np.int32)
+    group_count = np.zeros(G, dtype=np.int32)
+    group_cap = np.zeros(G, dtype=np.int32)
+    compat = np.zeros((G, O), dtype=bool)
+    off_alloc = catalog.offering_alloc()          # [O, R]
+
+    for gi, g in enumerate(groups):
+        req = g.representative.requests.as_tuple()
+        group_req[gi] = req
+        group_count[gi] = g.count
+        group_cap[gi] = min(g.cap_per_node, np.iinfo(np.int32).max)
+        mask = np.ones(O, dtype=bool)
+        mask &= _allowed_mask(g.requirements, LABEL_INSTANCE_TYPE,
+                              catalog.type_names)[catalog.off_type]
+        mask &= _allowed_mask(g.requirements, LABEL_ARCH,
+                              catalog.archs)[catalog.type_arch[catalog.off_type]]
+        mask &= _allowed_mask(g.requirements, LABEL_INSTANCE_FAMILY,
+                              catalog.families)[catalog.type_family[catalog.off_type]]
+        mask &= _allowed_mask(g.requirements, LABEL_INSTANCE_SIZE,
+                              catalog.sizes)[catalog.type_size[catalog.off_type]]
+        mask &= _allowed_mask(g.requirements, LABEL_CAPACITY_TYPE,
+                              list(CAPACITY_TYPES))[catalog.off_cap]
+        zone_mask = _allowed_mask(g.requirements, LABEL_ZONE, catalog.zones)
+        if g.pinned_zone is not None:
+            pin = np.array([z == g.pinned_zone for z in catalog.zones])
+            zone_mask &= pin
+        mask &= zone_mask[catalog.off_zone]
+        mask &= catalog.off_avail
+        # resource fit on an *empty* node — a group can never use an
+        # offering whose allocatable is below one pod's request
+        mask &= (off_alloc >= group_req[gi][None, :]).all(axis=1)
+        compat[gi] = mask
+
+    return EncodedProblem(
+        groups=groups, group_req=group_req, group_count=group_count,
+        group_cap=group_cap, compat=compat, catalog=catalog, rejected=rejected)
+
+
+def _best_zone_for(pod: PodSpec, reqs: Requirements, zones: List[str],
+                   catalog: CatalogArrays) -> str:
+    """Zone with the most offering capacity compatible with the pod."""
+    req = np.asarray(pod.requests.as_tuple(), dtype=np.int64)
+    off_alloc = catalog.offering_alloc().astype(np.int64)
+    fits = (off_alloc >= req[None, :]).all(axis=1) & catalog.off_avail
+    best, best_cap = zones[0], -1
+    for z in zones:
+        zi = catalog.zones.index(z)
+        cap = int((fits & (catalog.off_zone == zi)).sum())
+        if cap > best_cap:
+            best, best_cap = z, cap
+    return best

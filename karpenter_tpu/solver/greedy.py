@@ -1,0 +1,125 @@
+"""Host greedy solver: the correctness/cost oracle and the CPU baseline.
+
+Semantics replicate the reference's provisioning path (the greedy
+first-fit-decreasing over instance types that karpenter-core's
+Scheduler.Solve performs per reconcile, consuming the compatibility filter
+of cloudprovider.go:321-352 and the cost ranking of instancetype.go:88-110):
+
+- pods are processed in the shared FFD order produced by ``encode`` (groups
+  descending by dominant resource share);
+- each pod first-fits onto an already-open node (oldest first) whose
+  offering is compatible and has residual capacity;
+- otherwise a new node is opened with the offering minimizing
+  price / pods-that-fit (cost-per-pod on an empty node), ties broken by
+  offering index.
+
+Because pods within a group are identical, the implementation fills nodes a
+group at a time (place min(fit, cap, remaining) pods on each open node in
+age order, then open new nodes batch-filled to capacity) — bitwise
+identical to per-pod first-fit, but O(G x N) instead of O(P x N).
+
+This is also the "Go FFD loop" stand-in for BASELINE.md's >=20x comparison
+(same algorithm on host; a C++ twin lives in native/).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from karpenter_tpu.solver.encode import EncodedProblem, encode
+from karpenter_tpu.solver.types import Plan, PlannedNode, SolveRequest, SolverOptions
+from karpenter_tpu.utils import metrics
+
+
+class GreedySolver:
+    def __init__(self, options: Optional[SolverOptions] = None):
+        self.options = options or SolverOptions(backend="greedy")
+
+    def solve(self, request: SolveRequest) -> Plan:
+        t0 = time.perf_counter()
+        problem = encode(request.pods, request.catalog, request.nodepool)
+        plan = self.solve_encoded(problem)
+        plan.solve_seconds = time.perf_counter() - t0
+        metrics.SOLVE_DURATION.labels("greedy").observe(plan.solve_seconds)
+        metrics.SOLVE_PODS.labels("greedy").observe(len(request.pods))
+        metrics.SOLVE_COST.labels("greedy").set(plan.total_cost_per_hour)
+        return plan
+
+    def solve_encoded(self, problem: EncodedProblem) -> Plan:
+        catalog = problem.catalog
+        off_alloc = catalog.offering_alloc().astype(np.int64)   # [O, R]
+        off_price = catalog.off_price.astype(np.float64)
+        off_rank = catalog.offering_rank_price().astype(np.float64)
+        max_nodes = self.options.max_nodes
+
+        node_offering: List[int] = []
+        node_resid: List[np.ndarray] = []
+        node_pods: List[List[str]] = []
+
+        unplaced: List[str] = list(problem.rejected)
+
+        for gi, group in enumerate(problem.groups):
+            req = problem.group_req[gi].astype(np.int64)
+            cap = int(problem.group_cap[gi])
+            compat = problem.compat[gi]
+            remaining = list(group.pod_names)
+
+            # fill open nodes in age order (first-fit)
+            for ni in range(len(node_offering)):
+                if not remaining:
+                    break
+                if not compat[node_offering[ni]]:
+                    continue
+                resid = node_resid[ni]
+                if req.max() > 0:
+                    fit = int(np.min(np.where(req > 0, resid // np.maximum(req, 1),
+                                              np.int64(1 << 40))))
+                else:
+                    fit = 1 << 40
+                take = min(fit, cap, len(remaining))
+                if take <= 0:
+                    continue
+                node_resid[ni] = resid - req * take
+                node_pods[ni].extend(remaining[:take])
+                del remaining[:take]
+
+            if not remaining:
+                continue
+
+            # open new nodes with the cheapest-per-pod offering
+            fit_empty = np.where(
+                compat,
+                np.min(np.where(req[None, :] > 0,
+                                off_alloc // np.maximum(req[None, :], 1),
+                                np.int64(1 << 40)), axis=1),
+                0)
+            fit_empty = np.minimum(fit_empty, cap)
+            with np.errstate(divide="ignore", invalid="ignore"):
+                cost_per_pod = np.where(fit_empty > 0, off_rank / fit_empty, np.inf)
+            best_off = int(np.argmin(cost_per_pod))
+            best_fit = int(fit_empty[best_off])
+            if best_fit <= 0:
+                unplaced.extend(remaining)
+                continue
+            while remaining and len(node_offering) < max_nodes:
+                take = min(best_fit, len(remaining))
+                node_offering.append(best_off)
+                node_resid.append(off_alloc[best_off] - req * take)
+                node_pods.append(remaining[:take])
+                del remaining[:take]
+            unplaced.extend(remaining)
+
+        nodes = []
+        total = 0.0
+        for ni, off in enumerate(node_offering):
+            itype, zone, captype = catalog.describe_offering(off)
+            price = float(off_price[off])
+            total += price
+            nodes.append(PlannedNode(instance_type=itype, zone=zone,
+                                     capacity_type=captype, price=price,
+                                     pod_names=node_pods[ni], offering_index=off))
+        return Plan(nodes=nodes, unplaced_pods=unplaced,
+                    total_cost_per_hour=total, backend="greedy")

@@ -1,0 +1,290 @@
+"""TPU solver backend: the placement core as one jitted program.
+
+Design (SURVEY.md §1 "TPU-build mapping", §7.1):
+
+- The FFD loop that karpenter-core runs per-pod in Go becomes a
+  ``lax.scan`` over *pod groups* (identical pods collapse at encode time,
+  SURVEY.md §5.7), each step vectorized over the node axis [N] and the
+  offering axis [O].  Integer arithmetic throughout — capacities and
+  requests are int32 (milliCPU / MiB / gpu / pod-slots), so fit counts are
+  exact floor divisions on the VPU.
+- Filling open nodes is first-fit in node-age order via an exclusive
+  cumulative sum of per-node fit counts (take = clip(count - cumfit, 0,
+  fit)) — no sequential inner loop, no sort.
+- Opening new nodes writes a whole arithmetic ramp of batch-filled nodes
+  in one masked update (no scatter).
+- A **right-sizing refinement** then re-picks, per open node, the cheapest
+  offering that (a) fits the node's final load and (b) is compatible with
+  every group placed on it.  Group-compatibility intersection is computed
+  as one [N,G] x [G,O] matmul on the MXU — this is the pass that beats
+  plain greedy cost (the "LP-relaxed cost minimization" role of the north
+  star, kept strictly feasibility-preserving per SURVEY.md §7.4).
+
+Static shapes: (G, O, N) are padded to buckets (types.py) so XLA compiles
+once per bucket combination; the catalog tensors stay device-resident
+between solves keyed by catalog/availability generation (§7.4
+"host<->device boundary").
+"""
+
+from __future__ import annotations
+
+import functools
+import time
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from karpenter_tpu.solver.encode import EncodedProblem, encode
+from karpenter_tpu.solver.types import (
+    GROUP_BUCKETS, NODE_BUCKETS, OFFERING_BUCKETS,
+    Plan, PlannedNode, SolveRequest, SolverOptions, bucket,
+)
+from karpenter_tpu.utils import metrics
+from karpenter_tpu.utils.logging import get_logger
+
+log = get_logger("solver.jax")
+
+_BIG = jnp.int32(1 << 30)
+
+
+# ---------------------------------------------------------------------------
+# The jitted kernel. Everything below lax-land is shape-static.
+# ---------------------------------------------------------------------------
+
+def _fit_counts(resid, req):
+    """[N,R] // [R] -> [N] pods that fit; dims with req==0 are unconstrained."""
+    per_dim = jnp.where(req[None, :] > 0,
+                        resid // jnp.maximum(req[None, :], 1),
+                        _BIG)
+    return jnp.min(per_dim, axis=1)
+
+
+def _ffd_step(off_alloc, off_rank, state, inputs):
+    node_off, node_resid, ptr = state
+    req, count, cap, compat_g = inputs
+
+    N = node_off.shape[0]
+    is_open = node_off >= 0
+    # group-vs-open-node compatibility via the node's offering
+    node_compat = jnp.where(is_open, compat_g[jnp.clip(node_off, 0, None)], False)
+
+    # ---- fill open nodes, first-fit in age order --------------------------
+    fit = _fit_counts(node_resid, req)
+    fit = jnp.where(node_compat, fit, 0)
+    fit = jnp.minimum(fit, cap)
+    cumfit = jnp.cumsum(fit) - fit                      # exclusive
+    take = jnp.clip(count - cumfit, 0, fit)
+    placed = jnp.sum(take)
+    node_resid = node_resid - take[:, None] * req[None, :]
+    rem = count - placed
+
+    # ---- open new nodes with the cheapest-per-pod offering ----------------
+    fit_empty = _fit_counts(off_alloc, req)
+    fit_empty = jnp.where(compat_g, fit_empty, 0)
+    fit_empty = jnp.minimum(fit_empty, cap)
+    cpp = jnp.where(fit_empty > 0, off_rank / fit_empty.astype(jnp.float32),
+                    jnp.inf)
+    best = jnp.argmin(cpp).astype(jnp.int32)
+    bf = fit_empty[best]
+
+    n_new = jnp.where(bf > 0, -(-rem // jnp.maximum(bf, 1)), 0)
+    n_new = jnp.minimum(n_new, N - ptr)
+    idx = jnp.arange(N, dtype=jnp.int32)
+    new_pos = idx - ptr
+    is_new = (new_pos >= 0) & (new_pos < n_new)
+    pods_new = jnp.where(is_new, jnp.clip(rem - new_pos * bf, 0, bf), 0)
+    # ceil(rem/bf) could include a slot receiving 0 pods only when rem==0;
+    # n_new==0 then, so every opened node holds >=1 pod.
+    node_off = jnp.where(is_new & (pods_new > 0), best, node_off)
+    opened = is_new & (pods_new > 0)
+    node_resid = jnp.where(opened[:, None],
+                           off_alloc[best][None, :] - pods_new[:, None] * req[None, :],
+                           node_resid)
+    ptr = ptr + jnp.sum(opened.astype(jnp.int32))
+    placed_new = jnp.sum(pods_new)
+    unplaced_g = rem - placed_new
+    assign_g = take + pods_new
+    return (node_off, node_resid, ptr), (assign_g, unplaced_g)
+
+
+def _right_size(node_off, node_resid, assign, compat, off_alloc, off_rank):
+    """Per-node cheapest compatible offering that fits the final load.
+
+    Feasibility-preserving by construction: the load already fits and every
+    group on the node admits the new offering (zone pins and availability
+    are part of ``compat``)."""
+    N = node_off.shape[0]
+    is_open = node_off >= 0
+    safe_off = jnp.clip(node_off, 0, None)
+    load = off_alloc[safe_off] - node_resid                  # [N, R]
+    # group-presence [G,N] -> incompat counts [N,O] on the MXU
+    present = (assign > 0).astype(jnp.float32)               # [G, N]
+    incompat = (~compat).astype(jnp.float32)                 # [G, O]
+    incompat_count = jnp.einsum("gn,go->no", present, incompat,
+                                preferred_element_type=jnp.float32)
+    all_compat = incompat_count < 0.5                        # [N, O]
+    fits = jnp.all(off_alloc[None, :, :] >= load[:, None, :], axis=2)  # [N, O]
+    candidate = all_compat & fits & is_open[:, None]
+    cand_price = jnp.where(candidate, off_rank[None, :], jnp.inf)
+    best = jnp.argmin(cand_price, axis=1).astype(jnp.int32)  # [N]
+    best_price = jnp.min(cand_price, axis=1)
+    cur_price = off_rank[safe_off]
+    improve = is_open & (best_price < cur_price - 1e-9)
+    new_off = jnp.where(improve, best, node_off)
+    new_resid = jnp.where(improve[:, None], off_alloc[jnp.clip(new_off, 0, None)] - load,
+                          node_resid)
+    return new_off, new_resid
+
+
+@functools.partial(jax.jit, static_argnames=("num_nodes", "right_size"))
+def solve_kernel(group_req, group_count, group_cap, compat,
+                 off_alloc, off_price, off_rank, *, num_nodes: int,
+                 right_size: bool = True):
+    """The full placement solve.
+
+    Args (device, padded):
+      group_req   int32 [G, R]; group_count int32 [G]; group_cap int32 [G]
+      compat      bool  [G, O]
+      off_alloc   int32 [O, R]; off_price float32 [O] (real $/h, cost
+                  accounting); off_rank float32 [O] (ranking price with
+                  size-based fallback for unpriced offerings)
+    Returns:
+      node_off  int32 [N] (-1 = unused slot)
+      assign    int32 [G, N] pods of group g on node n
+      unplaced  int32 [G]
+      cost      float32 scalar ($/h of open nodes)
+    """
+    G = group_req.shape[0]
+    N = num_nodes
+    R = group_req.shape[1]
+    node_off0 = jnp.full((N,), -1, dtype=jnp.int32)
+    node_resid0 = jnp.zeros((N, R), dtype=jnp.int32)
+    step = functools.partial(_ffd_step, off_alloc, off_rank)
+    (node_off, node_resid, ptr), (assign, unplaced) = lax.scan(
+        step, (node_off0, node_resid0, jnp.int32(0)),
+        (group_req, group_count, group_cap, compat))
+    if right_size:
+        node_off, node_resid = _right_size(node_off, node_resid, assign,
+                                           compat, off_alloc, off_rank)
+    is_open = node_off >= 0
+    cost = jnp.sum(jnp.where(is_open, off_price[jnp.clip(node_off, 0, None)], 0.0))
+    return node_off, assign, unplaced, cost
+
+
+# ---------------------------------------------------------------------------
+# Host wrapper
+# ---------------------------------------------------------------------------
+
+class JaxSolver:
+    """Pads, uploads, solves, decodes.  Catalog tensors are kept
+    device-resident keyed by (catalog generation, availability generation)."""
+
+    def __init__(self, options: Optional[SolverOptions] = None):
+        self.options = options or SolverOptions(backend="jax")
+        self._device_catalog: Dict[Tuple, Tuple] = {}
+
+    # -- public ------------------------------------------------------------
+
+    def solve(self, request: SolveRequest) -> Plan:
+        t0 = time.perf_counter()
+        problem = encode(request.pods, request.catalog, request.nodepool)
+        plan = self.solve_encoded(problem)
+        plan.solve_seconds = time.perf_counter() - t0
+        metrics.SOLVE_DURATION.labels("jax").observe(plan.solve_seconds)
+        metrics.SOLVE_PODS.labels("jax").observe(len(request.pods))
+        metrics.SOLVE_COST.labels("jax").set(plan.total_cost_per_hour)
+        return plan
+
+    def solve_encoded(self, problem: EncodedProblem) -> Plan:
+        catalog = problem.catalog
+        G = problem.num_groups
+        O = catalog.num_offerings
+        if G == 0:
+            return Plan(nodes=[], unplaced_pods=list(problem.rejected),
+                        backend="jax")
+
+        total_pods = int(problem.group_count.sum())
+        G_pad = bucket(G, GROUP_BUCKETS) if self.options.bucket_groups else G
+        O_pad = bucket(O, OFFERING_BUCKETS) if self.options.bucket_groups else O
+        N = min(self.options.max_nodes,
+                bucket(max(total_pods, 1), NODE_BUCKETS))
+
+        group_req = _pad2(problem.group_req, G_pad)
+        group_count = _pad1(problem.group_count, G_pad)
+        group_cap = _pad1(problem.group_cap, G_pad)
+        compat = _pad2(problem.compat, G_pad, O_pad)
+        off_alloc, off_price, off_rank = self._device_offerings(catalog, O_pad)
+
+        node_off, assign, unplaced, cost = solve_kernel(
+            jnp.asarray(group_req), jnp.asarray(group_count),
+            jnp.asarray(group_cap), jnp.asarray(compat),
+            off_alloc, off_price, off_rank,
+            num_nodes=N, right_size=self.options.right_size)
+        return self._decode(problem, np.asarray(node_off), np.asarray(assign),
+                            np.asarray(unplaced), float(cost))
+
+    # -- internals ---------------------------------------------------------
+
+    def _device_offerings(self, catalog, O_pad: int):
+        key = (catalog.uid, catalog.generation, catalog.availability_generation,
+               O_pad)
+        cached = self._device_catalog.get(key)
+        if cached is None:
+            off_alloc = _pad2(catalog.offering_alloc().astype(np.int32), O_pad)
+            off_price = _pad1(catalog.off_price.astype(np.float32), O_pad)
+            off_rank = _pad1(catalog.offering_rank_price(), O_pad)
+            cached = (jax.device_put(off_alloc), jax.device_put(off_price),
+                      jax.device_put(off_rank))
+            self._device_catalog = {key: cached}   # keep only current generation
+        return cached
+
+    def _decode(self, problem: EncodedProblem, node_off, assign, unplaced,
+                cost: float) -> Plan:
+        catalog = problem.catalog
+        groups = problem.groups
+        cursors = [0] * len(groups)
+        nodes: List[PlannedNode] = []
+        open_idx = np.nonzero(node_off >= 0)[0]
+        for n in open_idx:
+            off = int(node_off[n])
+            itype, zone, captype = catalog.describe_offering(off)
+            pod_names: List[str] = []
+            for gi in range(len(groups)):
+                k = int(assign[gi, n])
+                if k > 0:
+                    c = cursors[gi]
+                    pod_names.extend(groups[gi].pod_names[c:c + k])
+                    cursors[gi] = c + k
+            nodes.append(PlannedNode(
+                instance_type=itype, zone=zone, capacity_type=captype,
+                price=float(catalog.off_price[off]) if off < catalog.num_offerings
+                else 0.0,
+                pod_names=pod_names, offering_index=off))
+        unplaced_names: List[str] = list(problem.rejected)
+        for gi, g in enumerate(groups):
+            miss = int(unplaced[gi])
+            if miss > 0:
+                unplaced_names.extend(g.pod_names[len(g.pod_names) - miss:])
+        return Plan(nodes=nodes, unplaced_pods=unplaced_names,
+                    total_cost_per_hour=float(cost), backend="jax")
+
+
+def _pad1(a: np.ndarray, n: int) -> np.ndarray:
+    if a.shape[0] == n:
+        return a
+    out = np.zeros((n,) + a.shape[1:], dtype=a.dtype)
+    out[:a.shape[0]] = a
+    return out
+
+
+def _pad2(a: np.ndarray, n0: int, n1: Optional[int] = None) -> np.ndarray:
+    n1 = a.shape[1] if n1 is None else n1
+    if a.shape == (n0, n1):
+        return a
+    out = np.zeros((n0, n1), dtype=a.dtype)
+    out[:a.shape[0], :a.shape[1]] = a
+    return out

@@ -1,0 +1,97 @@
+"""Solver interface types: request, plan, options.
+
+The solver is the TPU-build replacement for karpenter-core's
+``Scheduler.Solve`` (the per-reconcile greedy bin-packer — BASELINE.json
+north star).  It is a *pure function*: (pods, catalog, nodepool) -> Plan.
+Stateless, deterministic, seedable; all durable state lives outside
+(SURVEY.md §5.4).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from karpenter_tpu.apis.nodeclaim import NodePool
+from karpenter_tpu.apis.pod import PodSpec
+from karpenter_tpu.catalog.arrays import CatalogArrays
+
+
+@dataclass
+class SolverOptions:
+    """Gated solver config (SURVEY.md §5.6: backend selection mirrors the
+    circuit-breaker-style env gating so the default path is untouched)."""
+
+    backend: str = "jax"            # "greedy" (host oracle) | "jax" (TPU)
+    max_nodes: int = 4096           # static bound on nodes per solve
+    right_size: bool = True         # post-pass: re-pick cheapest fitting offering
+    bucket_groups: bool = True      # pad G/O/N to pow2 buckets (avoid recompiles)
+
+
+@dataclass
+class SolveRequest:
+    pods: List[PodSpec]
+    catalog: CatalogArrays
+    nodepool: Optional[NodePool] = None
+
+
+@dataclass
+class PlannedNode:
+    """One node the plan wants created."""
+
+    instance_type: str
+    zone: str
+    capacity_type: str
+    price: float
+    pod_names: List[str] = field(default_factory=list)
+    offering_index: int = -1
+
+    @property
+    def pod_count(self) -> int:
+        return len(self.pod_names)
+
+
+@dataclass
+class Plan:
+    """Placement result: nodes to create + pod assignment + leftovers."""
+
+    nodes: List[PlannedNode] = field(default_factory=list)
+    unplaced_pods: List[str] = field(default_factory=list)
+    total_cost_per_hour: float = 0.0
+    backend: str = ""
+    solve_seconds: float = 0.0
+
+    @property
+    def placed_count(self) -> int:
+        return sum(n.pod_count for n in self.nodes)
+
+    def summary(self) -> Dict[str, object]:
+        return {
+            "nodes": len(self.nodes),
+            "placed": self.placed_count,
+            "unplaced": len(self.unplaced_pods),
+            "cost_per_hour": round(self.total_cost_per_hour, 4),
+            "backend": self.backend,
+            "solve_seconds": round(self.solve_seconds, 6),
+        }
+
+
+def bucket(n: int, buckets: Sequence[int]) -> int:
+    """Smallest bucket >= n (static shapes for XLA; SURVEY.md §7.4
+    'bucketed padding to avoid recompiles')."""
+    for b in buckets:
+        if n <= b:
+            return b
+    return buckets[-1] if n <= buckets[-1] else _next_pow2(n)
+
+
+def _next_pow2(n: int) -> int:
+    p = 1
+    while p < n:
+        p *= 2
+    return p
+
+
+GROUP_BUCKETS = (32, 64, 128, 256, 512, 1024, 2048)
+OFFERING_BUCKETS = (128, 256, 512, 1024, 2048, 4096)
+NODE_BUCKETS = (64, 256, 1024, 4096, 16384)

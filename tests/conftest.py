@@ -3,12 +3,19 @@
 Mirrors the reference's test strategy (SURVEY.md §4.9): unit layers fake both
 the cloud and the cluster; multi-chip behavior is validated on a virtual CPU
 mesh via --xla_force_host_platform_device_count, never on real hardware.
+
+Two layers of CPU forcing are required in this environment:
+- env vars (for subprocesses and for jax's own defaults);
+- ``jax.config.update("jax_platforms", "cpu")`` — the ambient axon
+  sitecustomize registers the real-TPU tunnel backend at interpreter start
+  and overrides jax_platforms to "axon,cpu"; if the tunnel is down, the
+  first backend initialization hangs for minutes.  Resetting the config
+  before any backend init keeps unit tests hermetic and fast.
 """
 
 import os
 
-# Must be set before jax is imported anywhere.
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
@@ -16,11 +23,13 @@ if "xla_force_host_platform_device_count" not in flags:
     ).strip()
 os.environ.setdefault("JAX_ENABLE_X64", "0")
 
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
 import pytest  # noqa: E402
 
 
 @pytest.fixture(scope="session")
 def devices():
-    import jax
-
     return jax.devices()

@@ -149,11 +149,18 @@ class TestUnavailableOfferings:
         clock.t = 11
         assert not u.is_unavailable("t", "z", "spot")
 
-    def test_generation_bumps(self):
-        u = UnavailableOfferings()
-        g = u.generation
-        u.mark_unavailable("t", "z", "spot")
-        assert u.generation > g
+    def test_generation_changes_on_write_and_expiry(self):
+        clock = FakeClock()
+        u = UnavailableOfferings(clock=clock)
+        g0 = u.generation
+        u.mark_unavailable("t", "z", "spot", ttl=10)
+        g1 = u.generation
+        assert g1 != g0
+        # lazy TTL expiry must also change the generation (stale masks
+        # would otherwise outlive the blackout)
+        clock.t = 11
+        assert u.generation != g1
+        assert u.generation == g0
 
 
 class TestCatalogArrays:

@@ -1,0 +1,258 @@
+"""Solver tests: encoding, greedy oracle, jax backend parity, constraints.
+
+Strategy per SURVEY.md §4.9: pure-function solver over fake catalog +
+synthetic seeded pod tensors; the independent validator is the oracle.
+"""
+
+import numpy as np
+import pytest
+
+from karpenter_tpu.apis.nodeclaim import NodePool
+from karpenter_tpu.apis.pod import (
+    PodAffinityTerm, PodSpec, ResourceRequests, Taint, Toleration,
+    TopologySpreadConstraint, make_pods,
+)
+from karpenter_tpu.apis.requirements import (
+    LABEL_ARCH, LABEL_CAPACITY_TYPE, LABEL_ZONE, Operator, Requirement, Requirements,
+)
+from karpenter_tpu.catalog import (
+    CatalogArrays, InstanceTypeProvider, PricingProvider, UnavailableOfferings,
+)
+from karpenter_tpu.cloud.fake import FakeCloud, generate_profiles
+from karpenter_tpu.solver import (
+    GreedySolver, JaxSolver, Plan, SolveRequest, SolverOptions, encode, validate_plan,
+)
+
+
+@pytest.fixture(scope="module")
+def catalog():
+    cloud = FakeCloud()
+    pricing = PricingProvider(cloud)
+    itp = InstanceTypeProvider(cloud, pricing)
+    arrays = CatalogArrays.build(itp.list())
+    pricing.close()
+    return arrays
+
+
+def pods_simple(n, cpu=500, mem=1024, **kw):
+    return make_pods(n, requests=ResourceRequests(cpu, mem, 0, 1), **kw)
+
+
+def seeded_mixed_pods(n, seed=0):
+    """Deterministic mixed workload: several size classes + constraints."""
+    rng = np.random.RandomState(seed)
+    sizes = [(250, 512), (500, 1024), (1000, 4096), (2000, 8192), (4000, 16384)]
+    pods = []
+    for i in range(n):
+        cpu, mem = sizes[rng.randint(len(sizes))]
+        kw = {}
+        r = rng.rand()
+        if r < 0.2:
+            kw["node_selector"] = ((LABEL_ZONE, f"us-south-{rng.randint(3) + 1}"),)
+        elif r < 0.3:
+            kw["required_requirements"] = (
+                Requirement(LABEL_CAPACITY_TYPE, Operator.IN, ("on-demand",)),)
+        pods.append(PodSpec(f"pod-{i}", requests=ResourceRequests(cpu, mem, 0, 1),
+                            **kw))
+    return pods
+
+
+class TestEncode:
+    def test_identical_pods_one_group(self, catalog):
+        prob = encode(pods_simple(100), catalog)
+        assert prob.num_groups == 1
+        assert prob.group_count[0] == 100
+        assert prob.compat[0].sum() == catalog.num_offerings  # everything fits
+
+    def test_zone_selector_masks_offerings(self, catalog):
+        pods = pods_simple(10, node_selector=((LABEL_ZONE, "us-south-1"),))
+        prob = encode(pods, catalog)
+        zi = catalog.zones.index("us-south-1")
+        assert prob.compat[0][catalog.off_zone != zi].sum() == 0
+        assert prob.compat[0][catalog.off_zone == zi].all()
+
+    def test_spread_splits_groups(self, catalog):
+        pods = make_pods(10, requests=ResourceRequests(500, 1024, 0, 1),
+                         topology_spread=(TopologySpreadConstraint(max_skew=1),))
+        prob = encode(pods, catalog)
+        assert prob.num_groups == 3
+        assert sorted(prob.group_count.tolist()) == [3, 3, 4]
+        zones = {g.pinned_zone for g in prob.groups}
+        assert zones == set(catalog.zones)
+
+    def test_intolerant_pods_rejected(self, catalog):
+        pool = NodePool(name="tainted", taints=(Taint("dedicated", "x", "NoSchedule"),))
+        tolerant = make_pods(3, name_prefix="tol",
+                             requests=ResourceRequests(500, 1024, 0, 1),
+                             tolerations=(Toleration("dedicated", "Equal", "x"),))
+        intolerant = pods_simple(2, name_prefix="int")
+        prob = encode(tolerant + intolerant, catalog, pool)
+        assert sorted(prob.rejected) == ["int-0", "int-1"]
+        assert prob.group_count.sum() == 3
+
+    def test_unknown_label_requirement_rejected_unless_pool_provides(self, catalog):
+        pods = pods_simple(2, node_selector=(("custom/label", "gold"),))
+        prob = encode(pods, catalog)
+        assert len(prob.rejected) == 2
+        pool = NodePool(name="gold", labels={"custom/label": "gold"})
+        prob2 = encode(pods, catalog, pool)
+        assert prob2.rejected == []
+
+    def test_huge_pod_incompatible_everywhere(self, catalog):
+        pods = pods_simple(1, cpu=1_000_000, mem=1)
+        prob = encode(pods, catalog)
+        assert prob.compat[0].sum() == 0
+
+
+class TestGreedy:
+    def test_places_all_and_feasible(self, catalog):
+        pods = pods_simple(100)
+        plan = GreedySolver().solve(SolveRequest(pods, catalog))
+        assert validate_plan(plan, pods, catalog) == []
+        assert plan.unplaced_pods == []
+        assert plan.placed_count == 100
+        assert plan.total_cost_per_hour > 0
+
+    def test_prefers_cheap_spot(self, catalog):
+        pods = pods_simple(10)
+        plan = GreedySolver().solve(SolveRequest(pods, catalog))
+        assert all(n.capacity_type == "spot" for n in plan.nodes)
+
+    def test_on_demand_requirement_respected(self, catalog):
+        pods = pods_simple(10, required_requirements=(
+            Requirement(LABEL_CAPACITY_TYPE, Operator.IN, ("on-demand",)),))
+        plan = GreedySolver().solve(SolveRequest(pods, catalog))
+        assert validate_plan(plan, pods, catalog) == []
+        assert all(n.capacity_type == "on-demand" for n in plan.nodes)
+
+    def test_bin_packs_onto_fewer_nodes(self, catalog):
+        # 20 pods of 500m/1Gi pack far denser than one node per pod
+        plan = GreedySolver().solve(SolveRequest(pods_simple(20), catalog))
+        assert 1 <= len(plan.nodes) < 20
+
+    def test_unschedulable_reported(self, catalog):
+        pods = pods_simple(2, cpu=10_000_000)
+        plan = GreedySolver().solve(SolveRequest(pods, catalog))
+        assert sorted(plan.unplaced_pods) == ["pod-0", "pod-1"]
+        assert plan.nodes == []
+
+
+class TestJaxBackend:
+    def test_feasible_and_complete(self, catalog):
+        pods = pods_simple(100)
+        plan = JaxSolver().solve(SolveRequest(pods, catalog))
+        assert validate_plan(plan, pods, catalog) == []
+        assert plan.unplaced_pods == []
+        assert plan.placed_count == 100
+
+    @pytest.mark.parametrize("seed", [0, 1, 2, 3])
+    def test_parity_with_oracle_mixed(self, catalog, seed):
+        pods = seeded_mixed_pods(300, seed=seed)
+        greedy = GreedySolver().solve(SolveRequest(pods, catalog))
+        jaxp = JaxSolver().solve(SolveRequest(pods, catalog))
+        assert validate_plan(greedy, pods, catalog) == []
+        assert validate_plan(jaxp, pods, catalog) == []
+        assert len(jaxp.unplaced_pods) == len(greedy.unplaced_pods) == 0
+        # right-sizing means jax must match or beat greedy cost
+        assert jaxp.total_cost_per_hour <= greedy.total_cost_per_hour + 1e-6
+
+    def test_without_rightsizing_cost_equals_oracle(self, catalog):
+        pods = seeded_mixed_pods(200, seed=7)
+        greedy = GreedySolver().solve(SolveRequest(pods, catalog))
+        jaxp = JaxSolver(SolverOptions(backend="jax", right_size=False)).solve(
+            SolveRequest(pods, catalog))
+        assert jaxp.total_cost_per_hour == pytest.approx(
+            greedy.total_cost_per_hour, rel=1e-6)
+        assert len(jaxp.nodes) == len(greedy.nodes)
+
+    def test_spread_constraint_satisfied(self, catalog):
+        pods = make_pods(30, requests=ResourceRequests(500, 1024, 0, 1),
+                         topology_spread=(TopologySpreadConstraint(max_skew=1),))
+        plan = JaxSolver().solve(SolveRequest(pods, catalog))
+        assert validate_plan(plan, pods, catalog) == []
+        zones = {}
+        for n in plan.nodes:
+            zones[n.zone] = zones.get(n.zone, 0) + n.pod_count
+        assert max(zones.values()) - min(zones.values()) <= 1
+
+    def test_anti_affinity_one_per_node(self, catalog):
+        pods = make_pods(5, requests=ResourceRequests(100, 128, 0, 1),
+                         labels=(("app", "solo"),),
+                         affinity=(PodAffinityTerm(label_selector=(("app", "solo"),),
+                                                   anti=True),))
+        plan = JaxSolver().solve(SolveRequest(pods, catalog))
+        assert validate_plan(plan, pods, catalog) == []
+        assert len(plan.nodes) == 5
+        assert all(n.pod_count == 1 for n in plan.nodes)
+
+    def test_zone_affinity_coschedules(self, catalog):
+        pods = make_pods(8, requests=ResourceRequests(500, 1024, 0, 1),
+                         labels=(("app", "web"),),
+                         affinity=(PodAffinityTerm(label_selector=(("app", "web"),),
+                                                   topology_key=LABEL_ZONE),))
+        plan = JaxSolver().solve(SolveRequest(pods, catalog))
+        assert validate_plan(plan, pods, catalog) == []
+        assert len({n.zone for n in plan.nodes}) == 1
+
+    def test_availability_mask_respected(self, catalog):
+        unavail = UnavailableOfferings()
+        # black out ALL spot offerings -> plan must use on-demand
+        for t in catalog.type_names:
+            for z in catalog.zones:
+                unavail.mark_unavailable(t, z, "spot")
+        catalog.refresh_availability(unavail)
+        try:
+            pods = pods_simple(10)
+            plan = JaxSolver().solve(SolveRequest(pods, catalog))
+            assert validate_plan(plan, pods, catalog) == []
+            assert all(n.capacity_type == "on-demand" for n in plan.nodes)
+        finally:
+            # restore for other tests (module-scoped fixture)
+            catalog.off_avail[:] = True
+            catalog.availability_generation = -1
+
+    def test_deterministic(self, catalog):
+        pods = seeded_mixed_pods(100, seed=5)
+        a = JaxSolver().solve(SolveRequest(pods, catalog))
+        b = JaxSolver().solve(SolveRequest(pods, catalog))
+        assert [(n.instance_type, n.zone, sorted(n.pod_names)) for n in a.nodes] == \
+               [(n.instance_type, n.zone, sorted(n.pod_names)) for n in b.nodes]
+
+    def test_max_nodes_bound(self, catalog):
+        opts = SolverOptions(backend="jax", max_nodes=2)
+        pods = make_pods(5, requests=ResourceRequests(100, 128, 0, 1),
+                         labels=(("app", "solo"),),
+                         affinity=(PodAffinityTerm(label_selector=(("app", "solo"),),
+                                                   anti=True),))
+        plan = JaxSolver(opts).solve(SolveRequest(pods, catalog))
+        assert len(plan.nodes) == 2
+        assert len(plan.unplaced_pods) == 3
+        assert validate_plan(plan, pods, catalog) == []
+
+    def test_gpu_pods_need_gpu_types(self):
+        cloud = FakeCloud(profiles=generate_profiles(
+            30, families=("bx2", "gx3")))
+        pricing = PricingProvider(cloud)
+        itp = InstanceTypeProvider(cloud, pricing)
+        cat = CatalogArrays.build(itp.list())
+        pricing.close()
+        pods = make_pods(4, requests=ResourceRequests(1000, 4096, 1, 1))
+        plan = JaxSolver().solve(SolveRequest(pods, cat))
+        assert validate_plan(plan, pods, cat) == []
+        assert plan.unplaced_pods == []
+        assert all(n.instance_type.startswith("gx3") for n in plan.nodes)
+
+
+class TestScale:
+    def test_1k_pods_100_types(self):
+        cloud = FakeCloud(profiles=generate_profiles(100))
+        pricing = PricingProvider(cloud)
+        itp = InstanceTypeProvider(cloud, pricing)
+        cat = CatalogArrays.build(itp.list())
+        pricing.close()
+        pods = seeded_mixed_pods(1000, seed=11)
+        greedy = GreedySolver().solve(SolveRequest(pods, cat))
+        jaxp = JaxSolver().solve(SolveRequest(pods, cat))
+        assert validate_plan(jaxp, pods, cat) == []
+        assert jaxp.unplaced_pods == []
+        assert jaxp.total_cost_per_hour <= greedy.total_cost_per_hour + 1e-6

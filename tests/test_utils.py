@@ -169,7 +169,7 @@ class TestMetrics:
         assert metrics.ERRORS.get("solver", "timeout") == 3.0
 
     def test_histogram(self):
-        h = metrics.SOLVE_DURATION
+        h = metrics.Histogram("test_histogram_iso", "test-only", ("backend",))
         h.labels("jax").observe(0.004)
         h.labels("jax").observe(0.2)
         assert h.count("jax") == 2
