@@ -139,6 +139,13 @@ class PodAffinityTerm:
     anti: bool = False
 
 
+def pod_key(pod: "PodSpec") -> str:
+    """Canonical pod identity: 'namespace/name'.  Every plan, nomination,
+    and validator structure keys pods this way — bare names collide across
+    namespaces."""
+    return f"{pod.namespace}/{pod.name}"
+
+
 @dataclass(frozen=True)
 class PodSpec:
     """A pending pod as seen by the provisioner."""

@@ -1,0 +1,15 @@
+from karpenter_tpu.core.cluster import ClusterState
+from karpenter_tpu.core.circuitbreaker import (
+    CircuitBreaker, CircuitBreakerConfig, CircuitBreakerManager, CircuitBreakerOpenError,
+)
+from karpenter_tpu.core.actuator import Actuator
+from karpenter_tpu.core.window import SolveWindow, WindowOptions
+from karpenter_tpu.core.provisioner import Provisioner, ProvisionerOptions
+
+__all__ = [
+    "ClusterState",
+    "CircuitBreaker", "CircuitBreakerConfig", "CircuitBreakerManager",
+    "CircuitBreakerOpenError",
+    "Actuator", "SolveWindow", "WindowOptions",
+    "Provisioner", "ProvisionerOptions",
+]

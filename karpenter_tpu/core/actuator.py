@@ -1,0 +1,262 @@
+"""Actuator: executes a placement Plan against the cloud.
+
+The Create side mirrors ``pkg/providers/vpc/instance/provider.go:184-903``
+and ``pkg/cloudprovider/cloudprovider.go:249-501``:
+
+- Ready-condition gate on the NodeClass (cloudprovider.go:282-301);
+- circuit-breaker gate per (nodeclass, region) with the deferred
+  success/failure record balancing concurrency counters (:356-383);
+- zone/subnet resolution: plan zone -> status-selected subnets, else best
+  free-IP subnet in zone (vpc/instance/provider.go:243-329);
+- image from status cache else resolver (:403-475);
+- bootstrap user-data generation (:587-597);
+- error taxonomy on create: capacity/quota errors feed the
+  UnavailableOfferings blackout (the scheduler stops picking dead
+  offerings); partial-failure cleanup is the fake cloud's create-side
+  atomicity (ref cleans VNI/volume orphans :1192-1312);
+- NodeClaim construction with labels from the offering + provider id
+  (cloudprovider.go:420-494).
+
+Delete verifies the instance is gone and raises NodeClaimNotFoundError so
+the lifecycle releases the finalizer (:993-1061 contract).
+"""
+
+from __future__ import annotations
+
+import time
+import uuid
+from typing import Dict, List, Optional, Tuple
+
+from karpenter_tpu.apis.nodeclaim import NodeClaim, parse_provider_id, provider_id
+from karpenter_tpu.apis.nodeclass import (
+    ANNOTATION_IMAGE, ANNOTATION_NODECLASS_HASH, ANNOTATION_NODECLASS_HASH_VERSION,
+    ANNOTATION_SECURITY_GROUPS, ANNOTATION_SUBNET, NODECLASS_HASH_VERSION, NodeClass,
+)
+from karpenter_tpu.apis.requirements import (
+    LABEL_CAPACITY_TYPE, LABEL_NODEPOOL, LABEL_REGION, LABEL_ZONE,
+)
+from karpenter_tpu.catalog.arrays import CatalogArrays
+from karpenter_tpu.catalog.unavailable import UnavailableOfferings
+from karpenter_tpu.cloud.errors import (
+    CloudError, NodeClaimNotFoundError, is_capacity, is_not_found, is_quota,
+    parse_error,
+)
+from karpenter_tpu.cloud.image import ImageResolver
+from karpenter_tpu.cloud.subnet import SubnetProvider
+from karpenter_tpu.core.bootstrap import BootstrapOptions, BootstrapProvider, ClusterConfig
+from karpenter_tpu.core.circuitbreaker import CircuitBreakerManager
+from karpenter_tpu.core.cluster import ClusterState
+from karpenter_tpu.solver.types import Plan, PlannedNode
+from karpenter_tpu.utils import metrics
+from karpenter_tpu.utils.logging import get_logger
+
+log = get_logger("core.actuator")
+
+KARPENTER_TAGS = {"karpenter.sh/managed": "true"}
+
+
+class Actuator:
+    def __init__(self, cloud, cluster: ClusterState,
+                 subnet_provider: Optional[SubnetProvider] = None,
+                 image_resolver: Optional[ImageResolver] = None,
+                 bootstrap: Optional[BootstrapProvider] = None,
+                 breaker: Optional[CircuitBreakerManager] = None,
+                 unavailable: Optional[UnavailableOfferings] = None,
+                 cluster_config: Optional[ClusterConfig] = None):
+        self.cloud = cloud
+        self.cluster = cluster
+        self.subnets = subnet_provider or SubnetProvider(
+            cloud, cluster_subnets_fn=cluster.node_count_by_subnet)
+        self.images = image_resolver or ImageResolver(cloud)
+        self.bootstrap = bootstrap or BootstrapProvider()
+        self.breaker = breaker or CircuitBreakerManager()
+        self.unavailable = unavailable or UnavailableOfferings()
+        self.cluster_config = cluster_config or ClusterConfig()
+
+    # -- create ------------------------------------------------------------
+
+    def create_node(self, planned: PlannedNode, nodeclass: NodeClass,
+                    catalog: CatalogArrays, nodepool_name: str = "default") -> NodeClaim:
+        """Launch one instance for a planned node; returns the launched
+        NodeClaim (registered into cluster state)."""
+        if not nodeclass.status.is_ready():
+            self.cluster.record_event("NodeClass", nodeclass.name, "Warning",
+                                      "NotReady", "nodeclass not ready for provisioning")
+            raise CloudError(f"nodeclass {nodeclass.name} is not ready",
+                             status_code=409, retryable=False)
+        region = nodeclass.spec.region
+        self.breaker.can_provision(nodeclass.name, region)
+        t0 = time.perf_counter()
+        try:
+            claim = self._do_create(planned, nodeclass, catalog, nodepool_name)
+        except Exception as e:
+            err = parse_error(e, operation="create_instance")
+            self.breaker.record_failure(nodeclass.name, region, str(err))
+            self._record_create_failure(planned, nodeclass, err, catalog)
+            metrics.PROVISIONING_DURATION.labels(
+                planned.instance_type, planned.zone, "error").observe(
+                time.perf_counter() - t0)
+            raise
+        self.breaker.record_success(nodeclass.name, region)
+        metrics.PROVISIONING_DURATION.labels(
+            planned.instance_type, planned.zone, "success").observe(
+            time.perf_counter() - t0)
+        metrics.INSTANCE_LIFECYCLE.labels("created", planned.instance_type,
+                                          planned.zone).inc()
+        metrics.COST_PER_HOUR.labels(planned.instance_type, planned.zone,
+                                     planned.capacity_type).set(planned.price)
+        return claim
+
+    def _do_create(self, planned: PlannedNode, nodeclass: NodeClass,
+                   catalog: CatalogArrays, nodepool_name: str) -> NodeClaim:
+        subnet_id = self._resolve_subnet(planned.zone, nodeclass)
+        image_id = self._resolve_image(nodeclass)
+        sgs = tuple(nodeclass.status.resolved_security_groups) or \
+            tuple(nodeclass.spec.security_groups)
+        node_name = f"karpenter-{nodeclass.name}-{uuid.uuid4().hex[:8]}"
+        labels = dict(catalog.offering_label_values(planned.offering_index)) \
+            if planned.offering_index >= 0 else {}
+        labels[LABEL_REGION] = nodeclass.spec.region
+        labels[LABEL_NODEPOOL] = nodepool_name
+        user_data = self.bootstrap.user_data(nodeclass, BootstrapOptions(
+            cluster=self.cluster_config, node_name=node_name,
+            instance_type=planned.instance_type,
+            architecture=labels.get("kubernetes.io/arch", "amd64"),
+            region=nodeclass.spec.region, zone=planned.zone, labels=labels))
+
+        inst = self.cloud.create_instance(
+            name=node_name, profile=planned.instance_type, zone=planned.zone,
+            subnet_id=subnet_id, image_id=image_id,
+            capacity_type=planned.capacity_type,
+            security_group_ids=sgs or (),
+            user_data=user_data,
+            tags={**KARPENTER_TAGS,
+                  "karpenter.sh/nodepool": nodepool_name,
+                  "karpenter-tpu.sh/nodeclass": nodeclass.name})
+
+        claim = NodeClaim(
+            name=node_name,
+            nodeclass_name=nodeclass.name,
+            nodepool_name=nodepool_name,
+            instance_type=planned.instance_type,
+            zone=planned.zone,
+            capacity_type=planned.capacity_type,
+            provider_id=provider_id(nodeclass.spec.region, inst.id),
+            labels={**labels, LABEL_ZONE: planned.zone,
+                    LABEL_CAPACITY_TYPE: planned.capacity_type},
+            annotations={
+                ANNOTATION_NODECLASS_HASH: nodeclass.spec_hash(),
+                ANNOTATION_NODECLASS_HASH_VERSION: NODECLASS_HASH_VERSION,
+                ANNOTATION_SUBNET: subnet_id,
+                ANNOTATION_IMAGE: image_id,
+                ANNOTATION_SECURITY_GROUPS: ",".join(sorted(
+                    inst.security_group_ids)),
+            },
+            subnet_id=subnet_id, image_id=image_id,
+            security_group_ids=tuple(inst.security_group_ids),
+            hourly_price=planned.price,
+            launched=True,
+            finalizers=["karpenter-tpu.sh/termination"])
+        self.cluster.add_nodeclaim(claim)
+        self.cluster.record_event("NodeClaim", claim.name, "Normal", "Launched",
+                                  f"{planned.instance_type}/{planned.zone}/"
+                                  f"{planned.capacity_type} -> {inst.id}")
+        return claim
+
+    def _resolve_subnet(self, zone: str, nodeclass: NodeClass) -> str:
+        """4-way resolution (vpc/instance/provider.go:243-329): explicit
+        spec.subnet -> status.selected_subnets filtered by zone -> best
+        free-IP subnet in zone."""
+        if nodeclass.spec.subnet:
+            return nodeclass.spec.subnet
+        if nodeclass.status.selected_subnets:
+            for sid in nodeclass.status.selected_subnets:
+                try:
+                    if self.subnets.get_subnet(sid).zone == zone:
+                        return sid
+                except CloudError:
+                    continue
+        best = self.subnets.best_subnet_in_zone(zone)
+        if best is None:
+            raise CloudError(f"no subnet available in zone {zone}", 409,
+                             retryable=False)
+        return best.id
+
+    def _resolve_image(self, nodeclass: NodeClass) -> str:
+        if nodeclass.status.resolved_image_id:
+            return nodeclass.status.resolved_image_id
+        return self.images.resolve(nodeclass.spec.image,
+                                   nodeclass.spec.image_selector)
+
+    def _record_create_failure(self, planned: PlannedNode, nodeclass: NodeClass,
+                               err: CloudError,
+                               catalog: Optional[CatalogArrays] = None) -> None:
+        metrics.ERRORS.labels("actuator", err.code or "unknown").inc()
+        # subnet state may have shifted under the 5-min cache (IP counts
+        # move with every create); refresh so retries see reality
+        self.subnets.invalidate()
+        self.cluster.record_event(
+            "NodeClass", nodeclass.name, "Warning", "CreateFailed",
+            f"{planned.instance_type}/{planned.zone}: {err.message}")
+        # capacity/quota failures blackout offerings so the next solve
+        # avoids them (ref UnavailableOfferings feedback)
+        if is_capacity(err):
+            # capacity exhaustion is zonal
+            self.unavailable.mark_unavailable(
+                planned.instance_type, planned.zone, planned.capacity_type,
+                reason=err.code)
+        elif is_quota(err):
+            # quota is regional: blackout the type in every zone briefly so
+            # the solver doesn't burn breaker budget walking the zone list
+            zones = catalog.zones if catalog is not None else [planned.zone]
+            for z in zones:
+                self.unavailable.mark_unavailable(
+                    planned.instance_type, z, planned.capacity_type,
+                    ttl=300.0, reason=err.code)
+
+    # -- plan execution ----------------------------------------------------
+
+    def execute_plan(self, plan: Plan, nodeclass: NodeClass,
+                     catalog: CatalogArrays,
+                     nodepool_name: str = "default"
+                     ) -> Tuple[List[Optional[NodeClaim]], List[str]]:
+        """Create every planned node; returns (claims, errors) with claims
+        POSITIONALLY aligned to plan.nodes (None = that create failed).  A
+        failed node leaves its pods pending for the next solve window (the
+        reference's per-NodeClaim create failures behave the same)."""
+        claims: List[Optional[NodeClaim]] = []
+        errors: List[str] = []
+        for planned in plan.nodes:
+            try:
+                claims.append(self.create_node(planned, nodeclass, catalog,
+                                               nodepool_name))
+            except Exception as e:  # noqa: BLE001
+                claims.append(None)
+                errors.append(f"{planned.instance_type}/{planned.zone}: {e}")
+        return claims, errors
+
+    # -- delete ------------------------------------------------------------
+
+    def delete_node(self, claim: NodeClaim) -> None:
+        """Delete the backing instance; raises NodeClaimNotFoundError once
+        verifiably gone (finalizer-release contract,
+        vpc/instance/provider.go:1041-1046)."""
+        parsed = parse_provider_id(claim.provider_id)
+        if parsed is None:
+            raise NodeClaimNotFoundError(claim.name)
+        _, instance_id = parsed
+        try:
+            self.cloud.delete_instance(instance_id)
+        except CloudError as e:
+            if not is_not_found(e):
+                raise
+        # verify gone
+        try:
+            self.cloud.get_instance(instance_id)
+        except CloudError as e:
+            if is_not_found(e):
+                metrics.INSTANCE_LIFECYCLE.labels("deleted", claim.instance_type,
+                                                  claim.zone).inc()
+                raise NodeClaimNotFoundError(claim.name)
+            raise
+        raise CloudError(f"instance {instance_id} still exists after delete", 500)

@@ -1,0 +1,171 @@
+"""Node bootstrap: join tokens + cloud-init user-data generation.
+
+Parity with ``pkg/providers/vpc/bootstrap/`` (provider.go:73 entry,
+cloudinit.go:1030 template) and the token helpers
+(common/types/token.go:31-113): a bootstrap token with 24h TTL created (or
+reused) per cluster, cluster CA/endpoint/DNS/CNI discovery, and a
+cloud-init script that TLS-bootstraps the kubelet with the right labels
+and the unregistered startup taint.
+
+The cluster-discovery inputs come from :class:`ClusterConfig` instead of
+kubeadm configmaps — the standalone framework owns that state directly.
+"""
+
+from __future__ import annotations
+
+import secrets
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+from karpenter_tpu.apis.nodeclass import NodeClass
+from karpenter_tpu.apis.pod import Taint
+
+TAINT_UNREGISTERED = Taint(key="karpenter.sh/unregistered", value="",
+                           effect="NoExecute")
+
+
+@dataclass
+class ClusterConfig:
+    """Discovered cluster facts (ref detects via kubeadm/cluster-info
+    configmaps + node inspection, common/types/cluster.go:36-216)."""
+
+    api_endpoint: str = "https://10.0.0.1:6443"
+    kubernetes_version: str = "1.32.0"
+    cluster_ca: str = "LS0tLS1CRUdJTi=="       # base64 CA bundle
+    cluster_dns: str = "172.21.0.10"
+    service_cidr: str = "172.21.0.0/16"
+    cluster_cidr: str = "172.17.0.0/18"
+    cni_plugin: str = "calico"
+    cni_version: str = "3.27"
+    container_runtime: str = "containerd"
+
+
+@dataclass
+class BootstrapToken:
+    token_id: str
+    token_secret: str
+    expires_at: float
+
+    @property
+    def token(self) -> str:
+        return f"{self.token_id}.{self.token_secret}"
+
+
+class TokenStore:
+    """Create/reuse 24h bootstrap tokens (token.go:31-113: find unexpired,
+    else mint; stored as kube-system Secrets in the reference)."""
+
+    TTL = 24 * 3600.0
+
+    def __init__(self, clock=time.time):
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._tokens: List[BootstrapToken] = []
+
+    def find_or_create(self) -> BootstrapToken:
+        now = self._clock()
+        with self._lock:
+            for t in self._tokens:
+                # reuse only with >6h of life left (ref refreshes near expiry)
+                if t.expires_at - now > 6 * 3600:
+                    return t
+            token = BootstrapToken(
+                token_id=secrets.token_hex(3),
+                token_secret=secrets.token_hex(8),
+                expires_at=now + self.TTL)
+            self._tokens.append(token)
+            return token
+
+    def cleanup_expired(self) -> int:
+        now = self._clock()
+        with self._lock:
+            before = len(self._tokens)
+            self._tokens = [t for t in self._tokens if t.expires_at > now]
+            return before - len(self._tokens)
+
+    def live_tokens(self) -> List[BootstrapToken]:
+        now = self._clock()
+        with self._lock:
+            return [t for t in self._tokens if t.expires_at > now]
+
+
+@dataclass
+class BootstrapOptions:
+    """Per-node bootstrap inputs (ref common/types/bootstrap.go:53-122)."""
+
+    cluster: ClusterConfig
+    node_name: str
+    instance_type: str
+    architecture: str = "amd64"
+    region: str = ""
+    zone: str = ""
+    labels: Dict[str, str] = field(default_factory=dict)
+    taints: Tuple[Taint, ...] = ()
+    kubelet_extra_args: Dict[str, str] = field(default_factory=dict)
+
+
+class BootstrapProvider:
+    """Generates cloud-init user-data (ref GetUserDataWithInstanceIDAndType,
+    bootstrap/provider.go:73; template cloudinit.go:1030)."""
+
+    def __init__(self, tokens: Optional[TokenStore] = None):
+        self.tokens = tokens or TokenStore()
+
+    def user_data(self, nodeclass: NodeClass, opts: BootstrapOptions) -> str:
+        """Resolution order (ref provider.go:200-247 + custom user-data
+        handling): explicit spec.user_data wins; otherwise the generated
+        cloud-init; spec.user_data_append is appended either way."""
+        if nodeclass.spec.user_data:
+            script = nodeclass.spec.user_data
+        else:
+            script = self._generate(opts)
+        if nodeclass.spec.user_data_append:
+            script += "\n# --- user-data append ---\n"
+            script += nodeclass.spec.user_data_append
+        return script
+
+    def _generate(self, o: BootstrapOptions) -> str:
+        token = self.tokens.find_or_create()
+        labels = dict(o.labels)
+        taints = list(o.taints) + [TAINT_UNREGISTERED]
+        taint_args = ",".join(
+            f"{t.key}={t.value}:{t.effect}" for t in taints)
+        label_args = ",".join(f"{k}={v}" for k, v in sorted(labels.items()))
+        extra = " ".join(f"--{k}={v}" for k, v in sorted(o.kubelet_extra_args.items()))
+        c = o.cluster
+        return f"""#cloud-config
+# karpenter-tpu node bootstrap ({o.node_name})
+write_files:
+  - path: /etc/kubernetes/bootstrap-kubeconfig
+    permissions: '0600'
+    content: |
+      apiVersion: v1
+      kind: Config
+      clusters:
+      - cluster:
+          certificate-authority-data: {c.cluster_ca}
+          server: {c.api_endpoint}
+        name: default
+      contexts:
+      - context: {{cluster: default, user: kubelet-bootstrap}}
+        name: default
+      current-context: default
+      users:
+      - name: kubelet-bootstrap
+        user:
+          token: {token.token}
+  - path: /etc/systemd/system/kubelet.service.d/20-karpenter.conf
+    content: |
+      [Service]
+      Environment="KUBELET_EXTRA_ARGS=--node-labels={label_args} \\
+        --register-with-taints={taint_args} \\
+        --cluster-dns={c.cluster_dns} {extra}"
+runcmd:
+  - hostnamectl set-hostname {o.node_name}
+  - install-container-runtime {c.container_runtime}
+  - install-kubelet {c.kubernetes_version} --arch {o.architecture}
+  - install-cni {c.cni_plugin} {c.cni_version} --cluster-cidr {c.cluster_cidr}
+  - systemctl enable --now kubelet
+"""

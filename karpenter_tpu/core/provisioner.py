@@ -1,0 +1,181 @@
+"""Provisioner: the pod-watch -> window -> solve -> actuate loop.
+
+This replaces the karpenter-core provisioning controller + Scheduler.Solve
+(SURVEY.md §3.2's hot path) with the batched TPU solve:
+
+  pending pods --watch--> SolveWindow --fire--> Solver.solve
+       -> Plan -> Actuator.execute_plan -> NodeClaims -> pods nominated
+
+Per-NodePool flow mirrors GetInstanceTypes' per-pool filtered catalog
+(cloudprovider.go:553): each pool solves against the catalog filtered by
+its NodeClass's selected instance types; failed creates leave pods pending
+for the next window (retry loop).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from karpenter_tpu.apis.nodeclaim import NodePool
+from karpenter_tpu.apis.nodeclass import NodeClass
+from karpenter_tpu.apis.pod import PodSpec, pod_key
+from karpenter_tpu.catalog.arrays import CatalogArrays
+from karpenter_tpu.catalog.instancetype import InstanceTypeProvider, filter_instance_types
+from karpenter_tpu.core.actuator import Actuator
+from karpenter_tpu.core.cluster import ClusterState, PendingPod
+from karpenter_tpu.core.window import SolveWindow, WindowOptions
+from karpenter_tpu.solver.greedy import GreedySolver
+from karpenter_tpu.solver.jax_backend import JaxSolver
+from karpenter_tpu.solver.types import Plan, SolveRequest, SolverOptions
+from karpenter_tpu.utils import metrics
+from karpenter_tpu.utils.logging import get_logger
+
+log = get_logger("core.provisioner")
+
+
+@dataclass
+class ProvisionerOptions:
+    solver: SolverOptions = field(default_factory=SolverOptions)
+    window: WindowOptions = field(default_factory=WindowOptions)
+    default_nodepool: str = "default"
+
+
+def make_solver(options: SolverOptions):
+    """Backend gate (SURVEY.md §5.6: solver backend selected like the
+    circuit-breaker config so the default path stays untouched)."""
+    if options.backend == "greedy":
+        return GreedySolver(options)
+    return JaxSolver(options)
+
+
+class Provisioner:
+    def __init__(self, cluster: ClusterState, catalog_provider: InstanceTypeProvider,
+                 actuator: Actuator, options: Optional[ProvisionerOptions] = None):
+        self.cluster = cluster
+        self.catalog_provider = catalog_provider
+        self.actuator = actuator
+        self.options = options or ProvisionerOptions()
+        self.solver = make_solver(self.options.solver)
+        self._catalog_cache: Dict[Tuple, CatalogArrays] = {}
+        self._lock = threading.Lock()
+        self._window: Optional[SolveWindow] = None
+        self._unsubscribe = None
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self) -> None:
+        """Begin watch-driven provisioning: pod ADDED events feed the
+        window; each fired window runs one solve + actuation."""
+        self._window = SolveWindow(self._on_window, self.options.window)
+
+        def on_pod_event(event_type: str, pending: PendingPod):
+            if event_type == "ADDED" and not pending.bound_node:
+                self._window.add(pending.spec)
+
+        self._unsubscribe = self.cluster.watch("pods", on_pod_event)
+
+    def stop(self) -> None:
+        if self._unsubscribe:
+            self._unsubscribe()
+            self._unsubscribe = None
+        if self._window:
+            self._window.close()
+            self._window = None
+
+    # -- synchronous entry (tests, repair loops, consolidation) ------------
+
+    def provision_once(self) -> List[Plan]:
+        """Solve + actuate all currently-pending unnominated pods, grouped
+        by NodePool.  Returns the executed plans."""
+        pending = [p for p in self.cluster.pending_pods() if not p.nominated_node]
+        if not pending:
+            return []
+        plans, _ = self._provision([p.spec for p in pending])
+        return plans
+
+    # -- internals ---------------------------------------------------------
+
+    def _on_window(self, pods: Sequence[PodSpec]) -> Sequence[object]:
+        # per-pod outcome = the claim the pod was ACTUALLY nominated onto
+        # (pods on failed creates resolve to None and stay pending)
+        _, nominated = self._provision(list(pods))
+        return [nominated.get(pod_key(p)) for p in pods]
+
+    def _provision(self, pods: List[PodSpec]) -> Tuple[List[Plan], Dict[str, str]]:
+        plans: List[Plan] = []
+        nominated: Dict[str, str] = {}   # pod key -> claim name
+        for pool in self._pools():
+            pool_pods = pods  # encode() rejects pods incompatible with the pool
+            nodeclass = self.cluster.get_nodeclass(pool.nodeclass_name) or \
+                self.cluster.get_nodeclass("default")
+            if nodeclass is None:
+                log.warning("no nodeclass for pool", pool=pool.name)
+                continue
+            catalog = self._catalog_for(nodeclass)
+            if catalog is None:
+                continue
+            plan = self.solver.solve(SolveRequest(pool_pods, catalog, pool))
+            if not plan.nodes:
+                continue
+            claims, errors = self.actuator.execute_plan(
+                plan, nodeclass, catalog, pool.name)
+            # nominate pods onto successfully-created claims (positional)
+            for node, claim in zip(plan.nodes, claims):
+                if claim is None:
+                    continue  # create failed -> pods stay pending for retry
+                for pn in node.pod_names:
+                    self._nominate(pn, claim.name)
+                    nominated[pn] = claim.name
+            if errors:
+                log.warning("plan partially executed", pool=pool.name,
+                            errors=errors[:3])
+            plans.append(plan)
+            # pods nominated onto real claims are consumed; leftovers roll
+            # into the next pool (or stay pending for the next window)
+            pods = [p for p in pods if pod_key(p) not in nominated]
+            if not pods:
+                break
+        return plans, nominated
+
+    def _nominate(self, key: str, node_name: str) -> None:
+        pending = self.cluster.get("pods", key)
+        if pending is not None:
+            pending.nominated_node = node_name
+
+    def _pools(self) -> List[NodePool]:
+        pools = self.cluster.list("nodepools")
+        if not pools:
+            pools = [NodePool(name=self.options.default_nodepool,
+                              nodeclass_name="default")]
+        return sorted(pools, key=lambda p: -p.weight)
+
+    MAX_CATALOG_CACHE = 16
+
+    def _catalog_for(self, nodeclass: NodeClass) -> Optional[CatalogArrays]:
+        """Per-NodeClass filtered catalog arrays.  Cached per (nodeclass
+        spec, selected types) so multi-pool setups keep one entry each;
+        blackout changes only re-derive the availability mask in place
+        (cheap), never rebuild the arrays — device tensors re-upload only
+        when the mask actually changed (keyed by availability generation in
+        JaxSolver)."""
+        types = self.catalog_provider.list(nodeclass)
+        if nodeclass.status.selected_instance_types:
+            allowed = set(nodeclass.status.selected_instance_types)
+            types = [t for t in types if t.name in allowed]
+        if not types:
+            return None
+        key = (nodeclass.name, nodeclass.spec_hash(),
+               tuple(sorted(t.name for t in types)))
+        with self._lock:
+            cached = self._catalog_cache.get(key)
+            if cached is None:
+                cached = CatalogArrays.build(types)
+                if len(self._catalog_cache) >= self.MAX_CATALOG_CACHE:
+                    oldest = next(iter(self._catalog_cache))
+                    del self._catalog_cache[oldest]
+                self._catalog_cache[key] = cached
+        cached.refresh_availability(self.catalog_provider.unavailable_offerings)
+        return cached

@@ -38,7 +38,7 @@ from typing import Dict, List, Optional, Sequence, Tuple
 import numpy as np
 
 from karpenter_tpu.apis.nodeclaim import NodePool
-from karpenter_tpu.apis.pod import NUM_RESOURCES, PodSpec, tolerates_all
+from karpenter_tpu.apis.pod import NUM_RESOURCES, PodSpec, pod_key, tolerates_all
 from karpenter_tpu.apis.requirements import (
     CAPACITY_TYPE_ON_DEMAND, CAPACITY_TYPE_SPOT,
     LABEL_ARCH, LABEL_CAPACITY_TYPE, LABEL_HOSTNAME, LABEL_INSTANCE_FAMILY,
@@ -52,7 +52,7 @@ BIG_CAP = 1 << 30  # "no per-node cap"
 @dataclass
 class PodGroup:
     representative: PodSpec
-    pod_names: List[str]
+    pod_names: List[str]           # canonical 'namespace/name' keys
     count: int
     requirements: Requirements
     cap_per_node: int = BIG_CAP
@@ -119,6 +119,41 @@ def _zone_spread_constraints(pod: PodSpec):
             if c.topology_key == LABEL_ZONE and c.when_unsatisfiable == "DoNotSchedule"]
 
 
+def _nozone_compat(reqs: Requirements, req_vec, catalog: CatalogArrays) -> np.ndarray:
+    """bool [O]: offering feasibility for a group ignoring the zone axis —
+    type/arch/family/size/capacity-type masks, availability, and empty-node
+    resource fit."""
+    mask = np.ones(catalog.num_offerings, dtype=bool)
+    mask &= _allowed_mask(reqs, LABEL_INSTANCE_TYPE,
+                          catalog.type_names)[catalog.off_type]
+    mask &= _allowed_mask(reqs, LABEL_ARCH,
+                          catalog.archs)[catalog.type_arch[catalog.off_type]]
+    mask &= _allowed_mask(reqs, LABEL_INSTANCE_FAMILY,
+                          catalog.families)[catalog.type_family[catalog.off_type]]
+    mask &= _allowed_mask(reqs, LABEL_INSTANCE_SIZE,
+                          catalog.sizes)[catalog.type_size[catalog.off_type]]
+    mask &= _allowed_mask(reqs, LABEL_CAPACITY_TYPE,
+                          list(CAPACITY_TYPES))[catalog.off_cap]
+    mask &= catalog.off_avail
+    mask &= (catalog.offering_alloc() >=
+             np.asarray(req_vec, dtype=np.int64)[None, :]).all(axis=1)
+    return mask
+
+
+def viable_zones(reqs: Requirements, req_vec, catalog: CatalogArrays) -> List[str]:
+    """Zones (within the requirement-allowed set) where the group has at
+    least one available, resource-fitting offering.  Spread subgroups are
+    only pinned to viable zones — pinning to a dead zone would strand pods
+    AND violate the skew the split was meant to guarantee."""
+    zone_allowed = _allowed_mask(reqs, LABEL_ZONE, catalog.zones)
+    nozone = _nozone_compat(reqs, req_vec, catalog)
+    out = []
+    for zi, z in enumerate(catalog.zones):
+        if zone_allowed[zi] and (nozone & (catalog.off_zone == zi)).any():
+            out.append(z)
+    return out
+
+
 def encode(pods: Sequence[PodSpec], catalog: CatalogArrays,
            nodepool: Optional[NodePool] = None) -> EncodedProblem:
     """Group, split, and lower the scheduling problem to dense tensors."""
@@ -130,7 +165,7 @@ def encode(pods: Sequence[PodSpec], catalog: CatalogArrays,
     eligible: List[PodSpec] = []
     for pod in pods:
         if nodepool.taints and not tolerates_all(pod.tolerations, nodepool.taints):
-            rejected.append(pod.name)
+            rejected.append(pod_key(pod))
         else:
             eligible.append(pod)
 
@@ -151,15 +186,18 @@ def encode(pods: Sequence[PodSpec], catalog: CatalogArrays,
         unsat = [r for r in reqs
                  if r.key not in known_keys and not r.matches(pool_labels)]
         if unsat:
-            rejected.extend(p.name for p in members)
+            rejected.extend(pod_key(p) for p in members)
             continue
         cap = 1 if _has_hostname_anti_affinity(rep) else BIG_CAP
 
         zone_allowed = _allowed_mask(reqs, LABEL_ZONE, catalog.zones)
+        req_vec = rep.requests.as_tuple()
         spread = _zone_spread_constraints(rep)
-        if spread and zone_allowed.sum() > 1:
-            # split into per-zone pinned subgroups, evenly (skew <= 1)
-            zones = [z for z, ok in zip(catalog.zones, zone_allowed) if ok]
+        live_zones = viable_zones(reqs, req_vec, catalog)
+        if spread and len(live_zones) > 1:
+            # split into per-zone pinned subgroups, evenly (skew <= 1),
+            # over zones that can actually host the group
+            zones = live_zones
             counts = _split_counts(len(members), len(zones))
             offset = 0
             for zone, cnt in zip(zones, counts):
@@ -169,22 +207,21 @@ def encode(pods: Sequence[PodSpec], catalog: CatalogArrays,
                 offset += cnt
                 sub_reqs = Requirements(list(reqs.items))
                 groups.append(PodGroup(
-                    representative=rep, pod_names=[p.name for p in sub],
+                    representative=rep, pod_names=[pod_key(p) for p in sub],
                     count=cnt, requirements=sub_reqs, cap_per_node=cap,
                     pinned_zone=zone, spread_origin=sig))
-        elif _has_zone_affinity(rep) and zone_allowed.sum() > 1:
+        elif _has_zone_affinity(rep) and len(live_zones) > 1:
             # co-schedule in one zone: pin to the zone with the most
             # compatible offering capacity (v1 heuristic; validator checks
             # zone purity)
-            zones = [z for z, ok in zip(catalog.zones, zone_allowed) if ok]
-            best = _best_zone_for(rep, reqs, zones, catalog)
+            best = _best_zone_for(rep, reqs, live_zones, catalog)
             groups.append(PodGroup(
-                representative=rep, pod_names=[p.name for p in members],
+                representative=rep, pod_names=[pod_key(p) for p in members],
                 count=len(members), requirements=reqs, cap_per_node=cap,
                 pinned_zone=best))
         else:
             groups.append(PodGroup(
-                representative=rep, pod_names=[p.name for p in members],
+                representative=rep, pod_names=[pod_key(p) for p in members],
                 count=len(members), requirements=reqs, cap_per_node=cap))
 
     # 4. FFD order: descending dominant size (deterministic tie-break on

@@ -22,11 +22,12 @@ from collections import defaultdict
 from typing import Dict, List, Optional, Sequence
 
 from karpenter_tpu.apis.nodeclaim import NodePool
-from karpenter_tpu.apis.pod import PodSpec, tolerates_all
+from karpenter_tpu.apis.pod import PodSpec, pod_key, tolerates_all
 from karpenter_tpu.apis.requirements import LABEL_ZONE
 from karpenter_tpu.catalog.arrays import CatalogArrays
 from karpenter_tpu.solver.encode import (
     _has_hostname_anti_affinity, _has_zone_affinity, _zone_spread_constraints,
+    viable_zones,
 )
 from karpenter_tpu.solver.types import Plan
 
@@ -36,7 +37,7 @@ def validate_plan(plan: Plan, pods: Sequence[PodSpec], catalog: CatalogArrays,
     """Returns a list of violations (empty = feasible)."""
     nodepool = nodepool or NodePool(name="default")
     errors: List[str] = []
-    by_name: Dict[str, PodSpec] = {p.name: p for p in pods}
+    by_name: Dict[str, PodSpec] = {pod_key(p): p for p in pods}
 
     # 1. assignment is a partition
     seen: Dict[str, str] = {}
@@ -109,7 +110,7 @@ def validate_plan(plan: Plan, pods: Sequence[PodSpec], catalog: CatalogArrays,
         groups[p.constraint_signature()].append(p)
     for sig, members in groups.items():
         rep = members[0]
-        placed_zones = [pod_zone[p.name] for p in members if p.name in pod_zone]
+        placed_zones = [pod_zone[pod_key(p)] for p in members if pod_key(p) in pod_zone]
         if not placed_zones:
             continue
         if _has_zone_affinity(rep) and len(set(placed_zones)) > 1:
@@ -119,9 +120,12 @@ def validate_plan(plan: Plan, pods: Sequence[PodSpec], catalog: CatalogArrays,
             counts = defaultdict(int)
             for z in placed_zones:
                 counts[z] += 1
-            # skew over zones the group's requirements allow
+            # skew measured over zones the group can actually use (allowed
+            # by requirements AND having a viable offering) — same spread
+            # semantics the encoder guarantees
             reqs = rep.scheduling_requirements().merged(nodepool.requirements)
-            allowed = reqs.allowed_values(LABEL_ZONE, catalog.zones) or catalog.zones
+            allowed = viable_zones(reqs, rep.requests.as_tuple(), catalog) \
+                or catalog.zones
             values = [counts.get(z, 0) for z in allowed]
             skew = max(values) - min(values)
             if skew > c.max_skew:

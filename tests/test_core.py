@@ -1,0 +1,354 @@
+"""Core loop tests: circuit breaker, cluster store, actuator, provisioner.
+
+Includes the minimum end-to-end slice (SURVEY.md §7.3 / BASELINE config #1):
+100 pending pods x 20 profiles on the fake cloud -> all pods nominated,
+instances created, provisioning metrics observed.
+"""
+
+import pytest
+
+from karpenter_tpu.apis.nodeclaim import NodeClaim, NodePool
+from karpenter_tpu.apis.nodeclass import NodeClass, NodeClassSpec
+from karpenter_tpu.apis.pod import PodSpec, ResourceRequests, make_pods
+from karpenter_tpu.catalog import InstanceTypeProvider, PricingProvider, UnavailableOfferings
+from karpenter_tpu.cloud.errors import CloudError, NodeClaimNotFoundError
+from karpenter_tpu.cloud.fake import FakeCloud
+from karpenter_tpu.core import (
+    Actuator, CircuitBreaker, CircuitBreakerConfig, CircuitBreakerManager,
+    CircuitBreakerOpenError, ClusterState, Provisioner, ProvisionerOptions,
+)
+from karpenter_tpu.core.cluster import ConflictError
+from karpenter_tpu.core.provisioner import make_solver
+from karpenter_tpu.core.bootstrap import BootstrapProvider, BootstrapOptions, ClusterConfig, TokenStore
+from karpenter_tpu.solver.types import SolverOptions
+from karpenter_tpu.core.window import WindowOptions
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+
+# ---------------------------------------------------------------------------
+# Circuit breaker (parity: circuitbreaker_test.go state transitions)
+# ---------------------------------------------------------------------------
+
+class TestCircuitBreaker:
+    def make(self, **kw):
+        clock = FakeClock()
+        cfg = CircuitBreakerConfig(**{**dict(rate_limit_per_minute=100,
+                                             max_concurrent_instances=100), **kw})
+        return CircuitBreaker(cfg, clock), clock
+
+    def test_opens_after_threshold(self):
+        cb, clock = self.make(failure_threshold=3)
+        for _ in range(3):
+            cb.can_provision()
+            cb.record_failure("boom")
+        assert cb.state == "OPEN"
+        with pytest.raises(CircuitBreakerOpenError):
+            cb.can_provision()
+
+    def test_half_open_after_recovery_and_closes_on_success(self):
+        cb, clock = self.make(failure_threshold=1, recovery_timeout=900)
+        cb.can_provision()
+        cb.record_failure()
+        assert cb.state == "OPEN"
+        clock.t = 901
+        cb.can_provision()            # transitions to HALF_OPEN, consumes probe
+        assert cb.state == "HALF_OPEN"
+        cb.record_success()
+        assert cb.state == "CLOSED"
+
+    def test_half_open_failure_reopens(self):
+        cb, clock = self.make(failure_threshold=1, recovery_timeout=900)
+        cb.can_provision(); cb.record_failure()
+        clock.t = 901
+        cb.can_provision(); cb.record_failure()
+        assert cb.state == "OPEN"
+
+    def test_half_open_probe_budget(self):
+        cb, clock = self.make(failure_threshold=1, recovery_timeout=900,
+                              half_open_max_requests=2)
+        cb.can_provision(); cb.record_failure()
+        clock.t = 901
+        cb.can_provision()
+        cb.can_provision()
+        with pytest.raises(CircuitBreakerOpenError, match="probe budget"):
+            cb.can_provision()
+
+    def test_rate_limit_per_minute(self):
+        cb, clock = self.make(rate_limit_per_minute=2)
+        cb.can_provision(); cb.record_success()
+        cb.can_provision(); cb.record_success()
+        with pytest.raises(CircuitBreakerOpenError, match="rate limit"):
+            cb.can_provision()
+        clock.t = 61
+        cb.can_provision()            # minute window reset
+
+    def test_max_concurrent(self):
+        cb, clock = self.make(max_concurrent_instances=2)
+        cb.can_provision()
+        cb.can_provision()
+        with pytest.raises(CircuitBreakerOpenError, match="concurrent"):
+            cb.can_provision()
+        cb.record_success()
+        cb.can_provision()
+
+    def test_failure_window_expires_old_failures(self):
+        cb, clock = self.make(failure_threshold=3, failure_window=300)
+        cb.can_provision(); cb.record_failure()
+        cb.can_provision(); cb.record_failure()
+        clock.t = 301                 # first two age out
+        cb.can_provision(); cb.record_failure()
+        assert cb.state == "CLOSED"
+
+    def test_disabled_always_allows(self):
+        cb, _ = self.make(enabled=False, rate_limit_per_minute=0)
+        for _ in range(10):
+            cb.can_provision()
+
+    def test_manager_keys_and_cleanup(self):
+        clock = FakeClock()
+        mgr = CircuitBreakerManager(CircuitBreakerConfig(), clock)
+        mgr.can_provision("nc-a", "us-south")
+        mgr.record_success("nc-a", "us-south")
+        mgr.can_provision("nc-b", "eu-de")
+        mgr.record_success("nc-b", "eu-de")
+        assert len(mgr.states()) == 2
+        clock.t = 3601
+        assert mgr.cleanup() == 2
+
+    def test_config_from_env(self):
+        cfg = CircuitBreakerConfig.from_env(
+            {"CIRCUIT_BREAKER_FAILURE_THRESHOLD": "7",
+             "CIRCUIT_BREAKER_ENABLED": "false"})
+        assert cfg.failure_threshold == 7
+        assert not cfg.enabled
+
+
+# ---------------------------------------------------------------------------
+# Cluster state
+# ---------------------------------------------------------------------------
+
+class TestClusterState:
+    def test_add_get_conflict(self):
+        cs = ClusterState()
+        nc = NodeClass(name="default")
+        cs.add_nodeclass(nc)
+        assert cs.get_nodeclass("default") is nc
+        with pytest.raises(ConflictError):
+            cs.add_nodeclass(NodeClass(name="default"))
+
+    def test_optimistic_concurrency(self):
+        cs = ClusterState()
+        nc = cs.add_nodeclass(NodeClass(name="default"))
+        rv = nc.resource_version
+        cs.update("nodeclasses", "default", nc, expect_rv=rv)
+        with pytest.raises(ConflictError):
+            cs.update("nodeclasses", "default", nc, expect_rv=rv)  # stale now
+
+    def test_watch_events(self):
+        cs = ClusterState()
+        seen = []
+        unsub = cs.watch("nodeclaims", lambda t, o: seen.append((t, o.name)))
+        claim = cs.add_nodeclaim(NodeClaim(name="c1"))
+        cs.update("nodeclaims", "c1", claim)
+        cs.delete("nodeclaims", "c1")
+        assert seen == [("ADDED", "c1"), ("MODIFIED", "c1"), ("DELETED", "c1")]
+        unsub()
+        cs.add_nodeclaim(NodeClaim(name="c2"))
+        assert len(seen) == 3
+
+    def test_pending_pods_and_binding(self):
+        cs = ClusterState()
+        cs.add_pod(PodSpec("a"))
+        cs.add_pod(PodSpec("b"))
+        assert len(cs.pending_pods()) == 2
+        cs.bind_pod("default/a", "node-1")
+        assert [p.spec.name for p in cs.pending_pods()] == ["b"]
+
+
+# ---------------------------------------------------------------------------
+# Bootstrap
+# ---------------------------------------------------------------------------
+
+class TestBootstrap:
+    def test_token_reuse_and_expiry(self):
+        clock = FakeClock()
+        ts = TokenStore(clock=clock)
+        t1 = ts.find_or_create()
+        t2 = ts.find_or_create()
+        assert t1.token == t2.token
+        clock.t = 19 * 3600           # <6h left -> new token
+        t3 = ts.find_or_create()
+        assert t3.token != t1.token
+        clock.t = 25 * 3600
+        assert ts.cleanup_expired() == 1
+
+    def test_userdata_generation(self):
+        bp = BootstrapProvider()
+        nc = NodeClass(name="default", spec=NodeClassSpec(region="us-south"))
+        ud = bp.user_data(nc, BootstrapOptions(
+            cluster=ClusterConfig(), node_name="n1", instance_type="bx2-4x16",
+            labels={"x": "y"}))
+        assert "#cloud-config" in ud
+        assert "karpenter.sh/unregistered=:NoExecute" in ud
+        assert "x=y" in ud
+
+    def test_custom_userdata_wins_append_appends(self):
+        bp = BootstrapProvider()
+        nc = NodeClass(name="default", spec=NodeClassSpec(
+            user_data="#!/bin/sh\necho custom", user_data_append="echo extra"))
+        ud = bp.user_data(nc, BootstrapOptions(cluster=ClusterConfig(),
+                                               node_name="n", instance_type="t"))
+        assert ud.startswith("#!/bin/sh")
+        assert "echo extra" in ud
+
+
+# ---------------------------------------------------------------------------
+# Actuator + end-to-end slice
+# ---------------------------------------------------------------------------
+
+def ready_nodeclass(name="default", **kw) -> NodeClass:
+    nc = NodeClass(name=name, spec=NodeClassSpec(
+        region="us-south", instance_profile="", image="img-1", vpc="vpc-1", **kw))
+    nc.spec.instance_requirements = None
+    nc.spec.instance_profile = "bx2-4x16"
+    nc.status.resolved_image_id = "img-1"
+    nc.status.set_condition("Ready", "True", "Validated")
+    return nc
+
+
+@pytest.fixture
+def rig():
+    """Full provisioning rig on the fake cloud."""
+    cloud = FakeCloud()
+    pricing = PricingProvider(cloud)
+    unavail = UnavailableOfferings()
+    itp = InstanceTypeProvider(cloud, pricing, unavail)
+    cluster = ClusterState()
+    cluster.add_nodeclass(ready_nodeclass())
+    actuator = Actuator(cloud, cluster, unavailable=unavail)
+    prov = Provisioner(cluster, itp, actuator,
+                       ProvisionerOptions(solver=SolverOptions(backend="jax")))
+    yield cloud, cluster, prov, actuator, itp
+    pricing.close()
+
+
+class TestActuator:
+    def test_create_and_delete_node(self, rig):
+        cloud, cluster, prov, actuator, itp = rig
+        from karpenter_tpu.catalog import CatalogArrays
+        from karpenter_tpu.solver.types import PlannedNode
+        cat = CatalogArrays.build(itp.list())
+        nc = cluster.get_nodeclass("default")
+        o = cat.find_offering("bx2-4x16", "us-south-1", "on-demand")
+        claim = actuator.create_node(PlannedNode(
+            instance_type="bx2-4x16", zone="us-south-1",
+            capacity_type="on-demand", price=0.19, pod_names=["p1"],
+            offering_index=o), nc, cat)
+        assert claim.provider_id.startswith("tpu:///us-south/")
+        assert cloud.instance_count() == 1
+        inst = cloud.list_instances()[0]
+        assert inst.tags["karpenter.sh/managed"] == "true"
+        assert "#cloud-config" in inst.user_data
+        with pytest.raises(NodeClaimNotFoundError):
+            actuator.delete_node(claim)
+        assert cloud.instance_count() == 0
+
+    def test_not_ready_nodeclass_blocks(self, rig):
+        cloud, cluster, prov, actuator, itp = rig
+        from karpenter_tpu.catalog import CatalogArrays
+        from karpenter_tpu.solver.types import PlannedNode
+        cat = CatalogArrays.build(itp.list())
+        nc = ready_nodeclass("unready")
+        nc.status.set_condition("Ready", "False", "ValidationFailed")
+        with pytest.raises(CloudError, match="not ready"):
+            actuator.create_node(PlannedNode("bx2-4x16", "us-south-1",
+                                             "on-demand", 0.19, ["p"], 0), nc, cat)
+
+    def test_capacity_error_blacks_out_offering(self, rig):
+        cloud, cluster, prov, actuator, itp = rig
+        from karpenter_tpu.catalog import CatalogArrays
+        from karpenter_tpu.solver.types import PlannedNode
+        cat = CatalogArrays.build(itp.list())
+        nc = cluster.get_nodeclass("default")
+        cloud.capacity_limits[("bx2-4x16", "us-south-1")] = 0
+        with pytest.raises(CloudError):
+            actuator.create_node(PlannedNode(
+                "bx2-4x16", "us-south-1", "spot", 0.1, ["p"],
+                cat.find_offering("bx2-4x16", "us-south-1", "spot")), nc, cat)
+        assert actuator.unavailable.is_unavailable("bx2-4x16", "us-south-1", "spot")
+
+    def test_delete_unknown_provider_id(self, rig):
+        cloud, cluster, prov, actuator, itp = rig
+        with pytest.raises(NodeClaimNotFoundError):
+            actuator.delete_node(NodeClaim(name="ghost", provider_id="bogus"))
+
+
+class TestEndToEndSlice:
+    """BASELINE config #1: 100 pending pods x 20 profiles, fake cloud."""
+
+    def test_100_pods_provisioned(self, rig):
+        cloud, cluster, prov, actuator, itp = rig
+        for pod in make_pods(100, name_prefix="nginx",
+                             requests=ResourceRequests(500, 512, 0, 1)):
+            cluster.add_pod(pod)
+        plans = prov.provision_once()
+        assert plans, "no plan produced"
+        assert sum(p.placed_count for p in plans) == 100
+        assert cloud.instance_count() == len(plans[0].nodes)
+        # every pod nominated onto a claim
+        assert all(p.nominated_node for p in cluster.pending_pods())
+        # claims registered with annotations
+        claims = cluster.nodeclaims()
+        assert len(claims) == cloud.instance_count()
+        assert all(c.annotations["karpenter-tpu.sh/subnet-id"] for c in claims)
+
+    def test_window_coalesces_concurrent_arrivals(self, rig):
+        cloud, cluster, prov, actuator, itp = rig
+        prov.options.window = WindowOptions(idle_seconds=0.1, max_seconds=2.0)
+        prov.start()
+        try:
+            for pod in make_pods(30, requests=ResourceRequests(500, 512, 0, 1)):
+                cluster.add_pod(pod)
+            import time
+            deadline = time.time() + 15
+            while time.time() < deadline:
+                if all(p.nominated_node for p in cluster.pending_pods()):
+                    break
+                time.sleep(0.1)
+            assert all(p.nominated_node for p in cluster.pending_pods())
+            assert cloud.instance_count() >= 1
+        finally:
+            prov.stop()
+
+    def test_failed_create_leaves_pods_pending(self, rig):
+        cloud, cluster, prov, actuator, itp = rig
+        cloud.recorder.set_persistent_error(
+            "create_instance", CloudError("no capacity", 503,
+                                          code="insufficient_capacity",
+                                          retryable=False))
+        for pod in make_pods(5, requests=ResourceRequests(500, 512, 0, 1)):
+            cluster.add_pod(pod)
+        plans = prov.provision_once()
+        assert cloud.instance_count() == 0
+        assert all(not p.nominated_node for p in cluster.pending_pods())
+        # retry after clearing the failure succeeds
+        cloud.recorder.set_persistent_error("create_instance", None)
+        prov.provision_once()
+        assert all(p.nominated_node for p in cluster.pending_pods())
+
+    def test_greedy_backend_gate(self, rig):
+        cloud, cluster, prov, actuator, itp = rig
+        prov2 = Provisioner(cluster, itp, actuator, ProvisionerOptions(
+            solver=SolverOptions(backend="greedy")))
+        for pod in make_pods(10, requests=ResourceRequests(500, 512, 0, 1)):
+            cluster.add_pod(pod)
+        plans = prov2.provision_once()
+        assert plans[0].backend == "greedy"
+        assert all(p.nominated_node for p in cluster.pending_pods())

@@ -87,7 +87,7 @@ class TestEncode:
                              tolerations=(Toleration("dedicated", "Equal", "x"),))
         intolerant = pods_simple(2, name_prefix="int")
         prob = encode(tolerant + intolerant, catalog, pool)
-        assert sorted(prob.rejected) == ["int-0", "int-1"]
+        assert sorted(prob.rejected) == ["default/int-0", "default/int-1"]
         assert prob.group_count.sum() == 3
 
     def test_unknown_label_requirement_rejected_unless_pool_provides(self, catalog):
@@ -133,7 +133,7 @@ class TestGreedy:
     def test_unschedulable_reported(self, catalog):
         pods = pods_simple(2, cpu=10_000_000)
         plan = GreedySolver().solve(SolveRequest(pods, catalog))
-        assert sorted(plan.unplaced_pods) == ["pod-0", "pod-1"]
+        assert sorted(plan.unplaced_pods) == ["default/pod-0", "default/pod-1"]
         assert plan.nodes == []
 
 
