@@ -1,0 +1,126 @@
+"""Benchmark: the BASELINE.json headline — 10k pods x 500 types placement.
+
+Measures the end-to-end solve (host encode + device FFD scan + right-sizing
++ result fetch) on the flagship config and compares against the host FFD
+baseline (the "Go greedy loop" stand-in: same semantics, host execution).
+
+Prints ONE JSON line:
+  {"metric": "p50_solve_ms_10kpods_500types", "value": <p50 ms>,
+   "unit": "ms", "vs_baseline": <host_ffd_p50 / jax_p50>}
+
+Run on real TPU by the driver; ``--quick`` shrinks the config for local CPU
+sanity checks.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+import numpy as np
+
+
+def build_workload(num_pods: int, num_types: int, seed: int = 42):
+    from karpenter_tpu.apis.pod import (
+        PodSpec, ResourceRequests, Toleration, TopologySpreadConstraint,
+    )
+    from karpenter_tpu.apis.requirements import (
+        LABEL_CAPACITY_TYPE, LABEL_ZONE, Operator, Requirement,
+    )
+    from karpenter_tpu.catalog import CatalogArrays, InstanceTypeProvider, PricingProvider
+    from karpenter_tpu.cloud.fake import FakeCloud, generate_profiles
+
+    cloud = FakeCloud(profiles=generate_profiles(num_types))
+    pricing = PricingProvider(cloud)
+    itp = InstanceTypeProvider(cloud, pricing)
+    catalog = CatalogArrays.build(itp.list())
+    pricing.close()
+
+    rng = np.random.RandomState(seed)
+    sizes = [(250, 512), (500, 1024), (1000, 4096), (2000, 8192),
+             (4000, 16384), (8000, 32768)]
+    pods = []
+    for i in range(num_pods):
+        cpu, mem = sizes[rng.randint(len(sizes))]
+        kw = {}
+        r = rng.rand()
+        if r < 0.25:           # topology spread (config #3 constraint mix)
+            kw["topology_spread"] = (TopologySpreadConstraint(max_skew=1),)
+        elif r < 0.40:         # zone pin
+            kw["node_selector"] = ((LABEL_ZONE, f"us-south-{rng.randint(3) + 1}"),)
+        elif r < 0.50:         # on-demand only
+            kw["required_requirements"] = (
+                Requirement(LABEL_CAPACITY_TYPE, Operator.IN, ("on-demand",)),)
+        elif r < 0.55:         # tolerates a dedicated taint
+            kw["tolerations"] = (Toleration("dedicated", "Exists"),)
+        pods.append(PodSpec(f"p{i}", requests=ResourceRequests(cpu, mem, 0, 1),
+                            **kw))
+    return pods, catalog
+
+
+def run(num_pods: int, num_types: int, iters: int) -> dict:
+    from karpenter_tpu.solver import GreedySolver, JaxSolver, SolveRequest, validate_plan
+
+    pods, catalog = build_workload(num_pods, num_types)
+    request = SolveRequest(pods, catalog)
+
+    jax_solver = JaxSolver()
+    greedy = GreedySolver()
+
+    # warmup (compile) + correctness gate
+    plan = jax_solver.solve(request)
+    errs = validate_plan(plan, pods, catalog)
+    if errs:
+        print(json.dumps({"metric": "INVALID_PLAN", "value": 0, "unit": "",
+                          "vs_baseline": 0, "errors": errs[:3]}))
+        sys.exit(1)
+    gplan = greedy.solve(request)
+
+    def p50(f, n):
+        xs = []
+        for _ in range(n):
+            t0 = time.perf_counter()
+            f()
+            xs.append(time.perf_counter() - t0)
+        return float(np.percentile(xs, 50))
+
+    jax_p50 = p50(lambda: jax_solver.solve(request), iters)
+    greedy_p50 = p50(lambda: greedy.solve(request), max(3, iters // 4))
+
+    # cost sanity: the TPU plan must not cost more than the baseline's
+    cost_ratio = plan.total_cost_per_hour / max(gplan.total_cost_per_hour, 1e-9)
+    vs_baseline = greedy_p50 / jax_p50 if cost_ratio <= 1.0 + 1e-6 else 0.0
+    pods_label = f"{num_pods // 1000}k" if num_pods >= 1000 else str(num_pods)
+    return {
+        "metric": f"p50_solve_ms_{pods_label}pods_{num_types}types",
+        "value": round(jax_p50 * 1000, 3),
+        "unit": "ms",
+        "vs_baseline": round(vs_baseline, 2),
+    }
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--quick", action="store_true",
+                    help="small config for CPU sanity")
+    ap.add_argument("--pods", type=int, default=None)
+    ap.add_argument("--types", type=int, default=None)
+    ap.add_argument("--iters", type=int, default=None)
+    args = ap.parse_args()
+
+    if args.quick:
+        pods, types, iters = 1000, 100, 5
+    else:
+        pods, types, iters = 10000, 500, 20
+    pods = args.pods or pods
+    types = args.types or types
+    iters = args.iters or iters
+
+    result = run(pods, types, iters)
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    main()

@@ -172,7 +172,18 @@ class PodSpec:
 
     def constraint_signature(self) -> Tuple:
         """Pods with identical signatures are interchangeable for placement —
-        the host-side grouping key for the solver (solver/encode.py)."""
+        the host-side grouping key for the solver (solver/encode.py).
+        Memoized: the provisioner re-encodes the same PodSpec instances every
+        solve window, and signature construction dominates encode time at
+        10k pods."""
+        cached = getattr(self, "_sig_cache", None)
+        if cached is not None:
+            return cached
+        sig = self._constraint_signature()
+        object.__setattr__(self, "_sig_cache", sig)
+        return sig
+
+    def _constraint_signature(self) -> Tuple:
         return (
             self.requests.as_tuple(),
             tuple(sorted(self.labels)),

@@ -1,0 +1,274 @@
+"""Fleet-scale solves: SPMD over the device mesh.
+
+Two composable parallel dimensions (SURVEY.md §5.7, BASELINE config #5):
+
+- **fleet axis** (data parallel): C independent cluster problems stacked on
+  a leading axis, shard_map'd over ``fleet`` — each device solves its
+  clusters with the plain ``solve_core``.  Embarrassingly parallel; no
+  collectives (quota coupling is modeled as per-shard caps first, per
+  SURVEY.md §7.4).
+
+- **offer axis** (model parallel): ONE cluster's offering catalog sharded
+  across ``offer`` devices.  Node state (which offering each node runs,
+  residual capacity) is replicated; each FFD step computes its local
+  shard's fit/cost-per-pod, then the winner is combined with
+  ``lax.pmin`` and the winner's capacity row is broadcast with
+  ``lax.psum`` — the collectives ride ICI, never the host.  Useful when
+  the catalog axis outgrows one chip's VMEM-friendly tile or when
+  offering-mask construction dominates.
+
+Both entry points take numpy inputs padded by the caller (same bucketing
+as JaxSolver) and return stacked numpy results bit-identical to running
+``solve_kernel`` per cluster (tests assert this).
+"""
+
+from __future__ import annotations
+
+import functools
+from dataclasses import dataclass
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+import inspect
+
+try:
+    from jax import shard_map as _shard_map
+except ImportError:  # older jax
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+# jax >=0.8 renamed check_rep -> check_vma; disable either way (outputs are
+# replicated over the offer axis by construction via psum/pmin).
+_CHECK_KW = ("check_vma" if "check_vma" in inspect.signature(_shard_map).parameters
+             else "check_rep")
+
+
+def shard_map(f, *, mesh, in_specs, out_specs, check_rep=False):
+    return _shard_map(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                      **{_CHECK_KW: check_rep})
+
+from karpenter_tpu.parallel.mesh import FLEET_AXIS, OFFER_AXIS
+from karpenter_tpu.solver.jax_backend import _fit_counts, _right_size, solve_core
+
+_BIG_I32 = jnp.int32(2 ** 31 - 1)
+
+
+@dataclass
+class FleetProblem:
+    """Stacked multi-cluster problem: leading axis = cluster."""
+
+    group_req: np.ndarray      # [C, G, R] int32
+    group_count: np.ndarray    # [C, G] int32
+    group_cap: np.ndarray      # [C, G] int32
+    compat: np.ndarray         # [C, G, O] bool
+    off_alloc: np.ndarray      # [C, O, R] int32
+    off_price: np.ndarray      # [C, O] float32
+    off_rank: np.ndarray       # [C, O] float32
+
+    @property
+    def num_clusters(self) -> int:
+        return self.group_req.shape[0]
+
+
+# ---------------------------------------------------------------------------
+# Fleet axis: clusters data-parallel
+# ---------------------------------------------------------------------------
+
+def fleet_solve(problem: FleetProblem, mesh: Mesh, *, num_nodes: int,
+                right_size: bool = True):
+    """Solve C cluster problems across the mesh's fleet axis.
+
+    C must be divisible by the fleet-axis size.  Returns stacked
+    (node_off [C,N], assign [C,G,N], unplaced [C,G], cost [C]).
+    """
+    vsolve = jax.vmap(functools.partial(
+        solve_core, num_nodes=num_nodes, right_size=right_size))
+
+    spec = P(FLEET_AXIS)
+    f = shard_map(vsolve, mesh=mesh,
+                  in_specs=(spec,) * 7, out_specs=(spec,) * 4,
+                  check_rep=False)
+    out = jax.jit(f)(problem.group_req, problem.group_count, problem.group_cap,
+                     problem.compat, problem.off_alloc, problem.off_price,
+                     problem.off_rank)
+    return tuple(np.asarray(o) for o in out)
+
+
+# ---------------------------------------------------------------------------
+# Offer axis: catalog model-parallel with pmin/psum collectives
+# ---------------------------------------------------------------------------
+
+def _gather_global(values_local, global_idx, my_base, axis_name):
+    """Fetch values at global offering indices from a sharded [O_l, ...]
+    array: each shard contributes its in-range entries, psum combines."""
+    O_l = values_local.shape[0]
+    pos = jnp.clip(global_idx - my_base, 0, O_l - 1)
+    in_range = (global_idx >= my_base) & (global_idx < my_base + O_l)
+    local = jnp.where(
+        in_range.reshape(in_range.shape + (1,) * (values_local.ndim - 1)),
+        values_local[pos], 0)
+    return lax.psum(local, axis_name)
+
+
+def _ffd_step_sharded(axis_name, off_alloc_l, off_rank_l, state, inputs):
+    """One FFD step with the offering axis sharded across ``axis_name``.
+
+    Node state is replicated; the cheapest-per-pod offering is chosen with
+    a two-stage pmin (min cost, then min global index among ties) and the
+    winner's allocatable row is psum-broadcast."""
+    node_off, node_resid, ptr = state
+    req, count, cap, compat_l = inputs
+
+    N = node_off.shape[0]
+    O_l = off_rank_l.shape[0]
+    my_base = lax.axis_index(axis_name).astype(jnp.int32) * O_l
+    is_open = node_off >= 0
+
+    # group-vs-open-node compatibility: gather compat at global node_off
+    compat_i32 = compat_l.astype(jnp.int32)
+    node_compat = _gather_global(compat_i32, node_off, my_base, axis_name) > 0
+    node_compat = node_compat & is_open
+
+    fit = _fit_counts(node_resid, req)
+    fit = jnp.where(node_compat, fit, 0)
+    fit = jnp.minimum(fit, cap)
+    cumfit = jnp.cumsum(fit) - fit
+    take = jnp.clip(count - cumfit, 0, fit)
+    placed = jnp.sum(take)
+    node_resid = node_resid - take[:, None] * req[None, :]
+    rem = count - placed
+
+    # local cheapest-per-pod, then global combine
+    fit_empty = _fit_counts(off_alloc_l, req)
+    fit_empty = jnp.where(compat_l, fit_empty, 0)
+    fit_empty = jnp.minimum(fit_empty, cap)
+    cpp = jnp.where(fit_empty > 0, off_rank_l / fit_empty.astype(jnp.float32),
+                    jnp.inf)
+    local_arg = jnp.argmin(cpp).astype(jnp.int32)
+    local_min = cpp[local_arg]
+    global_min = lax.pmin(local_min, axis_name)
+    # tie-break: lowest global index among shards achieving the min
+    cand = jnp.where(local_min == global_min, my_base + local_arg, _BIG_I32)
+    best = lax.pmin(cand, axis_name)
+    have_best = jnp.isfinite(global_min)
+    # winner's fit + alloc row, broadcast
+    mine = (best >= my_base) & (best < my_base + O_l)
+    bf = lax.psum(jnp.where(mine, fit_empty[jnp.clip(best - my_base, 0, O_l - 1)], 0),
+                  axis_name)
+    bf = jnp.where(have_best, bf, 0)
+    best_alloc = lax.psum(
+        jnp.where(mine, off_alloc_l[jnp.clip(best - my_base, 0, O_l - 1)],
+                  jnp.zeros_like(off_alloc_l[0])), axis_name)
+
+    n_new = jnp.where(bf > 0, -(-rem // jnp.maximum(bf, 1)), 0)
+    n_new = jnp.minimum(n_new, N - ptr)
+    idx = jnp.arange(N, dtype=jnp.int32)
+    new_pos = idx - ptr
+    is_new = (new_pos >= 0) & (new_pos < n_new)
+    pods_new = jnp.where(is_new, jnp.clip(rem - new_pos * bf, 0, bf), 0)
+    opened = is_new & (pods_new > 0)
+    node_off = jnp.where(opened, best, node_off)
+    node_resid = jnp.where(opened[:, None],
+                           best_alloc[None, :] - pods_new[:, None] * req[None, :],
+                           node_resid)
+    ptr = ptr + jnp.sum(opened.astype(jnp.int32))
+    unplaced_g = rem - jnp.sum(pods_new)
+    assign_g = take + pods_new
+    return (node_off, node_resid, ptr), (assign_g, unplaced_g)
+
+
+def _right_size_sharded(axis_name, node_off, node_resid, assign,
+                        compat_l, off_alloc_l, off_rank_l):
+    """Sharded right-sizing: each shard proposes its best local candidate
+    per node; pmin picks the global winner."""
+    O_l = off_rank_l.shape[0]
+    my_base = lax.axis_index(axis_name).astype(jnp.int32) * O_l
+    is_open = node_off >= 0
+    alloc_at = _gather_global(off_alloc_l, node_off, my_base, axis_name)
+    load = alloc_at - node_resid
+
+    present = (assign > 0).astype(jnp.float32)
+    incompat = (~compat_l).astype(jnp.float32)
+    incompat_count = jnp.einsum("gn,go->no", present, incompat,
+                                preferred_element_type=jnp.float32)
+    all_compat = incompat_count < 0.5
+    fits = jnp.all(off_alloc_l[None, :, :] >= load[:, None, :], axis=2)
+    candidate = all_compat & fits & is_open[:, None]
+    cand_price = jnp.where(candidate, off_rank_l[None, :], jnp.inf)
+    local_arg = jnp.argmin(cand_price, axis=1).astype(jnp.int32)
+    local_min = jnp.take_along_axis(cand_price, local_arg[:, None], axis=1)[:, 0]
+    global_min = lax.pmin(local_min, axis_name)
+    cand_idx = jnp.where(local_min == global_min, my_base + local_arg, _BIG_I32)
+    best = lax.pmin(cand_idx, axis_name)
+
+    cur_rank_local = jnp.where(
+        (node_off >= my_base) & (node_off < my_base + O_l),
+        off_rank_l[jnp.clip(node_off - my_base, 0, O_l - 1)], 0.0)
+    cur_rank = lax.psum(cur_rank_local, axis_name)
+    improve = is_open & jnp.isfinite(global_min) & (global_min < cur_rank - 1e-9)
+    new_off = jnp.where(improve, best, node_off)
+    new_alloc = _gather_global(off_alloc_l, new_off, my_base, axis_name)
+    new_resid = jnp.where(improve[:, None], new_alloc - load, node_resid)
+    return new_off, new_resid
+
+
+def sharded_solve_core(axis_name, group_req, group_count, group_cap, compat_l,
+                       off_alloc_l, off_price_l, off_rank_l, *, num_nodes: int,
+                       right_size: bool = True):
+    """Offerings-sharded solve body (runs inside shard_map)."""
+    N = num_nodes
+    R = group_req.shape[1]
+    O_l = off_rank_l.shape[0]
+    node_off0 = jnp.full((N,), -1, dtype=jnp.int32)
+    node_resid0 = jnp.zeros((N, R), dtype=jnp.int32)
+    step = functools.partial(_ffd_step_sharded, axis_name, off_alloc_l, off_rank_l)
+    (node_off, node_resid, ptr), (assign, unplaced) = lax.scan(
+        step, (node_off0, node_resid0, jnp.int32(0)),
+        (group_req, group_count, group_cap, compat_l))
+    if right_size:
+        node_off, node_resid = _right_size_sharded(
+            axis_name, node_off, node_resid, assign, compat_l, off_alloc_l,
+            off_rank_l)
+    my_base = lax.axis_index(axis_name).astype(jnp.int32) * O_l
+    is_open = node_off >= 0
+    price_local = jnp.where(
+        is_open & (node_off >= my_base) & (node_off < my_base + O_l),
+        off_price_l[jnp.clip(node_off - my_base, 0, O_l - 1)], 0.0)
+    cost = lax.psum(jnp.sum(price_local), axis_name)
+    return node_off, assign, unplaced, cost
+
+
+def fleet_solve_sharded_offerings(problem: FleetProblem, mesh: Mesh, *,
+                                  num_nodes: int, right_size: bool = True):
+    """2D solve: clusters over FLEET_AXIS, offerings over OFFER_AXIS.
+
+    C % fleet == 0 and O % offer == 0 required.  Results are bit-identical
+    to the unsharded kernel (tie-breaks preserved by the index-pmin)."""
+    n_offer = mesh.shape[OFFER_AXIS]
+    O = problem.off_rank.shape[1]
+    if O % n_offer:
+        raise ValueError(f"offerings {O} not divisible by offer axis {n_offer}")
+
+    vsolve = jax.vmap(functools.partial(
+        sharded_solve_core, OFFER_AXIS, num_nodes=num_nodes,
+        right_size=right_size))
+
+    in_specs = (
+        P(FLEET_AXIS), P(FLEET_AXIS), P(FLEET_AXIS),
+        P(FLEET_AXIS, None, OFFER_AXIS),     # compat [C, G, O]
+        P(FLEET_AXIS, OFFER_AXIS, None),     # off_alloc [C, O, R]
+        P(FLEET_AXIS, OFFER_AXIS),           # off_price [C, O]
+        P(FLEET_AXIS, OFFER_AXIS),           # off_rank [C, O]
+    )
+    out_specs = (P(FLEET_AXIS), P(FLEET_AXIS), P(FLEET_AXIS), P(FLEET_AXIS))
+    f = shard_map(vsolve, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                  check_rep=False)
+    out = jax.jit(f)(problem.group_req, problem.group_count, problem.group_cap,
+                     problem.compat, problem.off_alloc, problem.off_price,
+                     problem.off_rank)
+    return tuple(np.asarray(o) for o in out)

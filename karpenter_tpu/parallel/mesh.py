@@ -1,0 +1,37 @@
+"""Device-mesh helpers.
+
+SURVEY.md §5.7/§2.8: the fleet axis ("clusters") is this project's
+data-parallel dimension; the offerings axis is the model-parallel one
+(catalog sharded across devices, combined with psum/pmin collectives over
+ICI).  Meshes are plain ``jax.sharding.Mesh`` so everything composes with
+pjit/shard_map.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import numpy as np
+
+import jax
+from jax.sharding import Mesh
+
+FLEET_AXIS = "fleet"
+OFFER_AXIS = "offer"
+
+
+def fleet_mesh(n_devices: Optional[int] = None) -> Mesh:
+    """1D mesh over clusters (the v5e-8 fleet config of BASELINE.json #5)."""
+    devices = jax.devices()[:n_devices] if n_devices else jax.devices()
+    return Mesh(np.array(devices), (FLEET_AXIS,))
+
+
+def solver_mesh(fleet: int, offer: int, devices: Optional[Sequence] = None) -> Mesh:
+    """2D mesh: fleet (cluster data-parallel) x offer (catalog
+    model-parallel)."""
+    devices = list(devices) if devices is not None else jax.devices()
+    if fleet * offer > len(devices):
+        raise ValueError(f"mesh {fleet}x{offer} needs {fleet * offer} devices, "
+                         f"have {len(devices)}")
+    arr = np.array(devices[:fleet * offer]).reshape(fleet, offer)
+    return Mesh(arr, (FLEET_AXIS, OFFER_AXIS))

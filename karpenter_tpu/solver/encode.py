@@ -58,6 +58,7 @@ class PodGroup:
     cap_per_node: int = BIG_CAP
     pinned_zone: Optional[str] = None
     spread_origin: Optional[Tuple] = None   # signature of the pre-split group
+    nozone_mask: Optional[np.ndarray] = None  # bool [O], computed once in encode
 
 
 @dataclass
@@ -93,11 +94,21 @@ def _split_counts(total: int, ways: int) -> List[int]:
     return [base + (1 if i < rem else 0) for i in range(ways)]
 
 
-def _allowed_mask(reqs: Requirements, key: str, vocab: List[str]) -> np.ndarray:
+def _allowed_mask(reqs: Requirements, key: str, vocab: List[str],
+                  cache: Optional[Dict] = None) -> np.ndarray:
     """bool [len(vocab)] — which vocabulary values every requirement on
-    ``key`` admits."""
+    ``key`` admits.  With ``cache``, masks are shared across groups whose
+    requirements on ``key`` are identical (the common case: none)."""
+    key_reqs = tuple(sorted(r.signature for r in reqs.get(key)))
+    if cache is not None:
+        hit = cache.get((key, key_reqs))
+        if hit is not None:
+            return hit
     allowed = set(reqs.allowed_values(key, vocab))
-    return np.array([v in allowed for v in vocab], dtype=bool)
+    mask = np.array([v in allowed for v in vocab], dtype=bool)
+    if cache is not None:
+        cache[(key, key_reqs)] = mask
+    return mask
 
 
 def _has_zone_affinity(pod: PodSpec) -> bool:
@@ -119,34 +130,37 @@ def _zone_spread_constraints(pod: PodSpec):
             if c.topology_key == LABEL_ZONE and c.when_unsatisfiable == "DoNotSchedule"]
 
 
-def _nozone_compat(reqs: Requirements, req_vec, catalog: CatalogArrays) -> np.ndarray:
+def _nozone_compat(reqs: Requirements, req_vec, catalog: CatalogArrays,
+                   cache: Optional[Dict] = None) -> np.ndarray:
     """bool [O]: offering feasibility for a group ignoring the zone axis —
     type/arch/family/size/capacity-type masks, availability, and empty-node
     resource fit."""
-    mask = np.ones(catalog.num_offerings, dtype=bool)
-    mask &= _allowed_mask(reqs, LABEL_INSTANCE_TYPE,
-                          catalog.type_names)[catalog.off_type]
+    mask = _allowed_mask(reqs, LABEL_INSTANCE_TYPE,
+                         catalog.type_names, cache)[catalog.off_type]
     mask &= _allowed_mask(reqs, LABEL_ARCH,
-                          catalog.archs)[catalog.type_arch[catalog.off_type]]
+                          catalog.archs, cache)[catalog.type_arch[catalog.off_type]]
     mask &= _allowed_mask(reqs, LABEL_INSTANCE_FAMILY,
-                          catalog.families)[catalog.type_family[catalog.off_type]]
+                          catalog.families, cache)[catalog.type_family[catalog.off_type]]
     mask &= _allowed_mask(reqs, LABEL_INSTANCE_SIZE,
-                          catalog.sizes)[catalog.type_size[catalog.off_type]]
+                          catalog.sizes, cache)[catalog.type_size[catalog.off_type]]
     mask &= _allowed_mask(reqs, LABEL_CAPACITY_TYPE,
-                          list(CAPACITY_TYPES))[catalog.off_cap]
+                          list(CAPACITY_TYPES), cache)[catalog.off_cap]
     mask &= catalog.off_avail
     mask &= (catalog.offering_alloc() >=
              np.asarray(req_vec, dtype=np.int64)[None, :]).all(axis=1)
     return mask
 
 
-def viable_zones(reqs: Requirements, req_vec, catalog: CatalogArrays) -> List[str]:
+def viable_zones(reqs: Requirements, req_vec, catalog: CatalogArrays,
+                 nozone: Optional[np.ndarray] = None,
+                 cache: Optional[Dict] = None) -> List[str]:
     """Zones (within the requirement-allowed set) where the group has at
     least one available, resource-fitting offering.  Spread subgroups are
     only pinned to viable zones — pinning to a dead zone would strand pods
     AND violate the skew the split was meant to guarantee."""
-    zone_allowed = _allowed_mask(reqs, LABEL_ZONE, catalog.zones)
-    nozone = _nozone_compat(reqs, req_vec, catalog)
+    zone_allowed = _allowed_mask(reqs, LABEL_ZONE, catalog.zones, cache)
+    if nozone is None:
+        nozone = _nozone_compat(reqs, req_vec, catalog, cache)
     out = []
     for zi, z in enumerate(catalog.zones):
         if zone_allowed[zi] and (nozone & (catalog.off_zone == zi)).any():
@@ -174,9 +188,12 @@ def encode(pods: Sequence[PodSpec], catalog: CatalogArrays,
     for pod in eligible:
         by_sig.setdefault(pod.constraint_signature(), []).append(pod)
 
-    # 3. Per-group requirement lowering + splitting.
+    # 3. Per-group requirement lowering + splitting.  The zone-independent
+    # offering mask is computed ONCE per signature group (shared by split
+    # subgroups) and label masks are cached across groups.
     known_keys = {LABEL_INSTANCE_TYPE, LABEL_ARCH, LABEL_INSTANCE_FAMILY,
                   LABEL_INSTANCE_SIZE, LABEL_ZONE, LABEL_CAPACITY_TYPE}
+    mask_cache: Dict = {}
     groups: List[PodGroup] = []
     for sig, members in by_sig.items():
         rep = members[0]
@@ -190,10 +207,11 @@ def encode(pods: Sequence[PodSpec], catalog: CatalogArrays,
             continue
         cap = 1 if _has_hostname_anti_affinity(rep) else BIG_CAP
 
-        zone_allowed = _allowed_mask(reqs, LABEL_ZONE, catalog.zones)
         req_vec = rep.requests.as_tuple()
+        nozone = _nozone_compat(reqs, req_vec, catalog, mask_cache)
         spread = _zone_spread_constraints(rep)
-        live_zones = viable_zones(reqs, req_vec, catalog)
+        live_zones = viable_zones(reqs, req_vec, catalog, nozone=nozone,
+                                  cache=mask_cache)
         if spread and len(live_zones) > 1:
             # split into per-zone pinned subgroups, evenly (skew <= 1),
             # over zones that can actually host the group
@@ -209,7 +227,7 @@ def encode(pods: Sequence[PodSpec], catalog: CatalogArrays,
                 groups.append(PodGroup(
                     representative=rep, pod_names=[pod_key(p) for p in sub],
                     count=cnt, requirements=sub_reqs, cap_per_node=cap,
-                    pinned_zone=zone, spread_origin=sig))
+                    pinned_zone=zone, spread_origin=sig, nozone_mask=nozone))
         elif _has_zone_affinity(rep) and len(live_zones) > 1:
             # co-schedule in one zone: pin to the zone with the most
             # compatible offering capacity (v1 heuristic; validator checks
@@ -218,11 +236,12 @@ def encode(pods: Sequence[PodSpec], catalog: CatalogArrays,
             groups.append(PodGroup(
                 representative=rep, pod_names=[pod_key(p) for p in members],
                 count=len(members), requirements=reqs, cap_per_node=cap,
-                pinned_zone=best))
+                pinned_zone=best, nozone_mask=nozone))
         else:
             groups.append(PodGroup(
                 representative=rep, pod_names=[pod_key(p) for p in members],
-                count=len(members), requirements=reqs, cap_per_node=cap))
+                count=len(members), requirements=reqs, cap_per_node=cap,
+                nozone_mask=nozone))
 
     # 4. FFD order: descending dominant size (deterministic tie-break on
     # first pod name).
@@ -238,33 +257,20 @@ def encode(pods: Sequence[PodSpec], catalog: CatalogArrays,
     group_count = np.zeros(G, dtype=np.int32)
     group_cap = np.zeros(G, dtype=np.int32)
     compat = np.zeros((G, O), dtype=bool)
-    off_alloc = catalog.offering_alloc()          # [O, R]
 
     for gi, g in enumerate(groups):
         req = g.representative.requests.as_tuple()
         group_req[gi] = req
         group_count[gi] = g.count
         group_cap[gi] = min(g.cap_per_node, np.iinfo(np.int32).max)
-        mask = np.ones(O, dtype=bool)
-        mask &= _allowed_mask(g.requirements, LABEL_INSTANCE_TYPE,
-                              catalog.type_names)[catalog.off_type]
-        mask &= _allowed_mask(g.requirements, LABEL_ARCH,
-                              catalog.archs)[catalog.type_arch[catalog.off_type]]
-        mask &= _allowed_mask(g.requirements, LABEL_INSTANCE_FAMILY,
-                              catalog.families)[catalog.type_family[catalog.off_type]]
-        mask &= _allowed_mask(g.requirements, LABEL_INSTANCE_SIZE,
-                              catalog.sizes)[catalog.type_size[catalog.off_type]]
-        mask &= _allowed_mask(g.requirements, LABEL_CAPACITY_TYPE,
-                              list(CAPACITY_TYPES))[catalog.off_cap]
-        zone_mask = _allowed_mask(g.requirements, LABEL_ZONE, catalog.zones)
+        # nozone_mask already folds label masks, availability, and
+        # empty-node resource fit; only the zone axis remains
+        mask = g.nozone_mask.copy()
+        zone_mask = _allowed_mask(g.requirements, LABEL_ZONE, catalog.zones,
+                                  mask_cache).copy()
         if g.pinned_zone is not None:
-            pin = np.array([z == g.pinned_zone for z in catalog.zones])
-            zone_mask &= pin
+            zone_mask &= np.array([z == g.pinned_zone for z in catalog.zones])
         mask &= zone_mask[catalog.off_zone]
-        mask &= catalog.off_avail
-        # resource fit on an *empty* node — a group can never use an
-        # offering whose allocatable is below one pod's request
-        mask &= (off_alloc >= group_req[gi][None, :]).all(axis=1)
         compat[gi] = mask
 
     return EncodedProblem(

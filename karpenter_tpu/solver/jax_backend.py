@@ -140,6 +140,27 @@ def _right_size(node_off, node_resid, assign, compat, off_alloc, off_rank):
     return new_off, new_resid
 
 
+def solve_core(group_req, group_count, group_cap, compat,
+               off_alloc, off_price, off_rank, *, num_nodes: int,
+               right_size: bool = True):
+    """Un-jitted solve body — vmap/shard_map it for fleet-scale solves
+    (parallel/fleet.py); ``solve_kernel`` is the single-problem jit."""
+    N = num_nodes
+    R = group_req.shape[1]
+    node_off0 = jnp.full((N,), -1, dtype=jnp.int32)
+    node_resid0 = jnp.zeros((N, R), dtype=jnp.int32)
+    step = functools.partial(_ffd_step, off_alloc, off_rank)
+    (node_off, node_resid, ptr), (assign, unplaced) = lax.scan(
+        step, (node_off0, node_resid0, jnp.int32(0)),
+        (group_req, group_count, group_cap, compat))
+    if right_size:
+        node_off, node_resid = _right_size(node_off, node_resid, assign,
+                                           compat, off_alloc, off_rank)
+    is_open = node_off >= 0
+    cost = jnp.sum(jnp.where(is_open, off_price[jnp.clip(node_off, 0, None)], 0.0))
+    return node_off, assign, unplaced, cost
+
+
 @functools.partial(jax.jit, static_argnames=("num_nodes", "right_size"))
 def solve_kernel(group_req, group_count, group_cap, compat,
                  off_alloc, off_price, off_rank, *, num_nodes: int,
@@ -158,21 +179,9 @@ def solve_kernel(group_req, group_count, group_cap, compat,
       unplaced  int32 [G]
       cost      float32 scalar ($/h of open nodes)
     """
-    G = group_req.shape[0]
-    N = num_nodes
-    R = group_req.shape[1]
-    node_off0 = jnp.full((N,), -1, dtype=jnp.int32)
-    node_resid0 = jnp.zeros((N, R), dtype=jnp.int32)
-    step = functools.partial(_ffd_step, off_alloc, off_rank)
-    (node_off, node_resid, ptr), (assign, unplaced) = lax.scan(
-        step, (node_off0, node_resid0, jnp.int32(0)),
-        (group_req, group_count, group_cap, compat))
-    if right_size:
-        node_off, node_resid = _right_size(node_off, node_resid, assign,
-                                           compat, off_alloc, off_rank)
-    is_open = node_off >= 0
-    cost = jnp.sum(jnp.where(is_open, off_price[jnp.clip(node_off, 0, None)], 0.0))
-    return node_off, assign, unplaced, cost
+    return solve_core(group_req, group_count, group_cap, compat,
+                      off_alloc, off_price, off_rank,
+                      num_nodes=num_nodes, right_size=right_size)
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,111 @@
+"""Fleet/mesh tests on the 8-device virtual CPU mesh.
+
+Asserts the sharded paths are BIT-IDENTICAL to the single-device kernel —
+the collectives (pmin winner selection, psum broadcast) must not change
+tie-breaks (SURVEY.md §4.9 multi-chip strategy).
+"""
+
+import numpy as np
+import pytest
+
+import jax
+
+from karpenter_tpu.apis.pod import PodSpec, ResourceRequests
+from karpenter_tpu.catalog import CatalogArrays, InstanceTypeProvider, PricingProvider
+from karpenter_tpu.cloud.fake import FakeCloud, generate_profiles
+from karpenter_tpu.parallel import (
+    FleetProblem, fleet_mesh, fleet_solve, fleet_solve_sharded_offerings,
+    solver_mesh,
+)
+from karpenter_tpu.solver import encode
+from karpenter_tpu.solver.jax_backend import solve_kernel, _pad1, _pad2
+
+
+def build_problem(seed: int, n_pods: int, catalog: CatalogArrays,
+                  G_pad=32, O_pad=None):
+    rng = np.random.RandomState(seed)
+    sizes = [(250, 512), (500, 1024), (1000, 4096), (2000, 8192)]
+    pods = []
+    for i in range(n_pods):
+        cpu, mem = sizes[rng.randint(len(sizes))]
+        pods.append(PodSpec(f"s{seed}-p{i}", requests=ResourceRequests(cpu, mem, 0, 1)))
+    prob = encode(pods, catalog)
+    O = catalog.num_offerings if O_pad is None else O_pad
+    return (
+        _pad2(prob.group_req, G_pad),
+        _pad1(prob.group_count, G_pad),
+        _pad1(prob.group_cap, G_pad),
+        _pad2(prob.compat, G_pad, O),
+        _pad2(catalog.offering_alloc().astype(np.int32), O),
+        _pad1(catalog.off_price.astype(np.float32), O),
+        _pad1(catalog.offering_rank_price(), O),
+    )
+
+
+@pytest.fixture(scope="module")
+def catalog():
+    cloud = FakeCloud(profiles=generate_profiles(24))
+    pricing = PricingProvider(cloud)
+    itp = InstanceTypeProvider(cloud, pricing)
+    arrays = CatalogArrays.build(itp.list())
+    pricing.close()
+    return arrays
+
+
+@pytest.fixture(scope="module")
+def fleet_problem(catalog):
+    per = [build_problem(seed, 60, catalog) for seed in range(8)]
+    stacked = [np.stack([p[i] for p in per]) for i in range(7)]
+    return FleetProblem(*stacked), per
+
+
+N_NODES = 64
+
+
+class TestFleetSolve:
+    def test_eight_devices_available(self):
+        assert len(jax.devices()) == 8
+
+    def test_fleet_matches_per_cluster(self, fleet_problem):
+        problem, per = fleet_problem
+        mesh = fleet_mesh(8)
+        node_off, assign, unplaced, cost = fleet_solve(
+            problem, mesh, num_nodes=N_NODES)
+        for c, args in enumerate(per):
+            ref = solve_kernel(*[np.asarray(a) for a in args], num_nodes=N_NODES)
+            np.testing.assert_array_equal(node_off[c], np.asarray(ref[0]))
+            np.testing.assert_array_equal(assign[c], np.asarray(ref[1]))
+            np.testing.assert_array_equal(unplaced[c], np.asarray(ref[2]))
+            assert cost[c] == pytest.approx(float(ref[3]), rel=1e-6)
+
+    def test_fleet_multiple_clusters_per_device(self, catalog):
+        per = [build_problem(s, 40, catalog) for s in range(8)]
+        stacked = FleetProblem(*[np.stack([p[i] for p in per]) for i in range(7)])
+        mesh = fleet_mesh(4)   # 2 clusters per device
+        node_off, _, unplaced, cost = fleet_solve(stacked, mesh, num_nodes=N_NODES)
+        assert node_off.shape == (8, N_NODES)
+        assert (unplaced == 0).all()
+
+
+class TestShardedOfferings:
+    @pytest.mark.parametrize("offer_shards", [2, 4])
+    def test_sharded_matches_unsharded(self, catalog, offer_shards):
+        O = catalog.num_offerings            # 24 types x 3 zones x 2 = 144
+        per = [build_problem(s, 50, catalog) for s in range(4)]
+        stacked = FleetProblem(*[np.stack([p[i] for p in per]) for i in range(7)])
+        fleet = 4 if 4 * offer_shards <= 8 else 2
+        mesh = solver_mesh(fleet=fleet, offer=offer_shards)
+        node_off, assign, unplaced, cost = fleet_solve_sharded_offerings(
+            stacked, mesh, num_nodes=N_NODES)
+        for c, args in enumerate(per):
+            ref = solve_kernel(*[np.asarray(a) for a in args], num_nodes=N_NODES)
+            np.testing.assert_array_equal(node_off[c], np.asarray(ref[0]))
+            np.testing.assert_array_equal(unplaced[c], np.asarray(ref[2]))
+            assert cost[c] == pytest.approx(float(ref[3]), rel=1e-6)
+
+    def test_indivisible_offerings_rejected(self, catalog):
+        per = [build_problem(0, 10, catalog)]
+        stacked = FleetProblem(*[np.stack([p[i] for p in per]) for i in range(7)])
+        mesh = solver_mesh(fleet=1, offer=5)
+        with pytest.raises(ValueError, match="not divisible"):
+            fleet_solve_sharded_offerings(stacked, mesh, num_nodes=N_NODES)
