@@ -170,10 +170,14 @@ class CatalogArrays:
     def refresh_availability(self, unavailable) -> bool:
         """Re-derive the availability column from the blackout set; returns
         True when the mask changed (caller re-uploads to device)."""
-        if unavailable.generation == self.availability_generation:
+        # capture the generation ONCE and derive the mask from that same
+        # frozenset — reading keys and generation separately lets a TTL
+        # expire in between, recording a generation the mask doesn't match
+        gen = unavailable.generation
+        if gen == self.availability_generation:
             return False
         mask = np.ones(self.num_offerings, dtype=bool)
-        for key in unavailable.unavailable_keys():
+        for key in gen:
             parts = key.split(":")
             if len(parts) != 3:
                 continue
@@ -182,5 +186,5 @@ class CatalogArrays:
                 mask[idx] = False
         changed = not np.array_equal(mask, self.off_avail)
         self.off_avail = mask
-        self.availability_generation = unavailable.generation
+        self.availability_generation = gen
         return changed

@@ -1,0 +1,255 @@
+"""Fault-ring controllers: interruption, spot preemption, orphan cleanup,
+catalog/pricing refreshers.
+
+Each is an availability-mask writer (SURVEY.md §7.1 "faults"): their output
+feeds ``UnavailableOfferings`` so the next solve window stops picking dead
+offerings — the TPU-build shape of the reference's failure-detection loop
+(SURVEY.md §5.3).
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from typing import Optional
+
+from karpenter_tpu.apis.nodeclaim import parse_provider_id
+from karpenter_tpu.catalog.instancetype import InstanceTypeProvider
+from karpenter_tpu.catalog.unavailable import UnavailableOfferings
+from karpenter_tpu.cloud.errors import CloudError, is_not_found
+from karpenter_tpu.controllers.runtime import PollController, Result
+from karpenter_tpu.core.actuator import KARPENTER_TAGS
+from karpenter_tpu.core.cluster import ClusterState
+from karpenter_tpu.utils import metrics
+from karpenter_tpu.utils.logging import get_logger
+
+log = get_logger("controllers.faults")
+
+ANNOTATION_INTERRUPTED = "karpenter-tpu.sh/interrupted"
+
+# Node-condition heuristics -> interruption classes (ref interruption/
+# controller.go:220-255).
+_CAPACITY_CONDITIONS = ("OutOfCapacity", "InstanceRetired", "Preempted")
+_HEALTH_CONDITIONS = ("KernelDeadlock", "ReadonlyFilesystem",
+                      "FrequentKubeletRestart")
+_NETWORK_CONDITIONS = ("NetworkUnavailable",)
+
+
+class InterruptionController(PollController):
+    """1-min scan of nodes for interruption signals (ref interruption/
+    controller.go:151): condition heuristics with never-ready suppression
+    (:259), then annotate + event + delete the claim so the replacement
+    cycle runs; capacity reasons black out the offering."""
+
+    name = "interruption"
+    interval = 60.0
+    never_ready_grace = 600.0   # suppress signals on nodes still booting
+
+    def __init__(self, cluster: ClusterState,
+                 unavailable: UnavailableOfferings):
+        self.cluster = cluster
+        self.unavailable = unavailable
+
+    def reconcile(self) -> Result:
+        now = time.time()
+        for node in self.cluster.nodes():
+            if node.deleted or ANNOTATION_INTERRUPTED in node.annotations:
+                continue
+            claim = self._claim_for(node)
+            if claim is None or claim.deleted:
+                continue
+            # never-ready suppression: a node that hasn't become Ready yet
+            # is booting, not interrupted (interruption/controller.go:259)
+            if not claim.initialized and now - node.created_at < self.never_ready_grace:
+                continue
+            reason = self._interruption_reason(node)
+            if not reason:
+                continue
+            self._handle(node, claim, reason)
+        return Result()
+
+    def _claim_for(self, node):
+        for claim in self.cluster.nodeclaims():
+            if claim.provider_id == node.provider_id:
+                return claim
+        return None
+
+    def _interruption_reason(self, node) -> str:
+        for cond in _CAPACITY_CONDITIONS:
+            if node.conditions.get(cond) == "True":
+                return f"capacity:{cond}"
+        for cond in _NETWORK_CONDITIONS:
+            if node.conditions.get(cond) == "True":
+                return f"network:{cond}"
+        for cond in _HEALTH_CONDITIONS:
+            if node.conditions.get(cond) == "True":
+                return f"health:{cond}"
+        return ""
+
+    def _handle(self, node, claim, reason: str) -> None:
+        node.annotations[ANNOTATION_INTERRUPTED] = reason
+        self.cluster.update("nodes", node.name, node)
+        self.cluster.record_event("Node", node.name, "Warning", "Interrupted",
+                                  reason)
+        metrics.INSTANCE_LIFECYCLE.labels("interrupted", claim.instance_type,
+                                          claim.zone).inc()
+        # capacity interruptions mean the offering is bad right now
+        if reason.startswith("capacity:"):
+            self.unavailable.mark_unavailable(
+                claim.instance_type, claim.zone, claim.capacity_type,
+                reason=reason)
+        claim.deleted = True   # hand to the termination controller
+        self.cluster.update("nodeclaims", claim.name, claim)
+        log.info("interrupted node; replacing", node=node.name, reason=reason)
+
+
+class SpotPreemptionController(PollController):
+    """1-min spot scan (ref spot/preemption/controller.go:39-110): stopped
+    instances with status_reason stopped_by_preemption -> offering blackout
+    for 1h (key type:zone:spot, :97) + delete instance + finalize claim."""
+
+    name = "spot.preemption"
+    interval = 60.0
+    blackout_ttl = 3600.0
+
+    def __init__(self, cluster: ClusterState, cloud,
+                 unavailable: UnavailableOfferings):
+        self.cluster = cluster
+        self.cloud = cloud
+        self.unavailable = unavailable
+
+    def reconcile(self) -> Result:
+        try:
+            spot = self.cloud.list_spot_instances()
+        except CloudError as e:
+            log.warning("spot list failed", error=str(e))
+            return Result()
+        preempted = [i for i in spot if i.status == "stopped" and
+                     i.status_reason == "stopped_by_preemption"]
+        for inst in preempted:
+            self.unavailable.mark_unavailable(
+                inst.profile, inst.zone, "spot",
+                ttl=self.blackout_ttl, reason="preempted")
+            metrics.INSTANCE_LIFECYCLE.labels("preempted", inst.profile,
+                                              inst.zone).inc()
+            try:
+                self.cloud.delete_instance(inst.id)
+            except CloudError as e:
+                if not is_not_found(e):
+                    log.warning("preempted delete failed", instance=inst.id,
+                                error=str(e))
+            claim = self._claim_for_instance(inst.id)
+            if claim is not None and not claim.deleted:
+                claim.deleted = True
+                self.cluster.update("nodeclaims", claim.name, claim)
+                self.cluster.record_event(
+                    "NodeClaim", claim.name, "Warning", "SpotPreempted",
+                    f"{inst.profile}/{inst.zone} preempted; offering "
+                    f"blacked out {self.blackout_ttl:.0f}s")
+        return Result()
+
+    def _claim_for_instance(self, instance_id: str):
+        for claim in self.cluster.nodeclaims():
+            parsed = parse_provider_id(claim.provider_id)
+            if parsed and parsed[1] == instance_id:
+                return claim
+        return None
+
+
+class OrphanCleanupController(PollController):
+    """Env-gated two-way orphan sweep (ref orphancleanup/controller.go:117,
+    gate KARPENTER_ENABLE_ORPHAN_CLEANUP at controllers.go:238): nodes
+    without instances and Karpenter-tagged instances without nodes
+    (tag check :350-437)."""
+
+    name = "node.orphancleanup"
+    interval = 300.0
+    min_instance_age = 600.0   # don't reap instances whose node is booting
+
+    def __init__(self, cluster: ClusterState, cloud, enabled: Optional[bool] = None):
+        self.cluster = cluster
+        self.cloud = cloud
+        self.enabled = (os.environ.get("KARPENTER_ENABLE_ORPHAN_CLEANUP", "")
+                        .lower() in ("1", "true", "yes")) if enabled is None \
+            else enabled
+
+    def reconcile(self) -> Result:
+        if not self.enabled:
+            return Result()
+        now = time.time()
+        # precompute both reference sets once: the sweep must stay
+        # O(instances + nodes + claims), not O(instances x (nodes + claims))
+        node_ids = set()
+        for n in self.cluster.nodes():
+            parsed = parse_provider_id(n.provider_id)
+            if parsed:
+                node_ids.add(parsed[1])
+        claim_ids = set()
+        for c in self.cluster.nodeclaims():
+            parsed = parse_provider_id(c.provider_id)
+            if parsed:
+                claim_ids.add(parsed[1])
+        # instances without nodes (tag-checked — never touch unmanaged)
+        for inst in self.cloud.list_instances():
+            if not all(inst.tags.get(k) == v for k, v in KARPENTER_TAGS.items()):
+                continue
+            if now - inst.created_at < self.min_instance_age:
+                continue
+            if inst.id not in node_ids and inst.id not in claim_ids:
+                try:
+                    self.cloud.delete_instance(inst.id)
+                    log.info("orphan cleanup: deleted instance", instance=inst.id)
+                except CloudError as e:
+                    if not is_not_found(e):
+                        log.warning("orphan instance delete failed",
+                                    instance=inst.id, error=str(e))
+        # nodes without instances
+        for node in self.cluster.nodes():
+            parsed = parse_provider_id(node.provider_id)
+            if parsed is None:
+                continue
+            try:
+                self.cloud.get_instance(parsed[1])
+            except CloudError as e:
+                if is_not_found(e):
+                    self.cluster.delete("nodes", node.name)
+                    log.info("orphan cleanup: deleted node", node=node.name)
+        return Result()
+
+
+class InstanceTypeRefreshController(PollController):
+    """Hourly catalog refresh + expired-blackout cleanup (ref controllers/
+    providers/instancetype/instancetype.go:73)."""
+
+    name = "providers.instancetype"
+    interval = 3600.0
+
+    def __init__(self, instance_types: InstanceTypeProvider,
+                 unavailable: UnavailableOfferings):
+        self.instance_types = instance_types
+        self.unavailable = unavailable
+
+    def reconcile(self) -> Result:
+        self.instance_types.refresh()
+        removed = self.unavailable.cleanup()
+        if removed:
+            log.info("offering blackouts expired", count=removed)
+        return Result()
+
+
+class PricingRefreshController(PollController):
+    """12h pricing refresh (ref controllers/providers/pricing/
+    controller.go:73; NoOp fallback :38-50 — a provider without refresh()
+    is skipped)."""
+
+    name = "providers.pricing"
+    interval = 12 * 3600.0
+
+    def __init__(self, pricing_provider):
+        self.pricing = pricing_provider
+
+    def reconcile(self) -> Result:
+        refresh = getattr(self.pricing, "refresh", None)
+        if callable(refresh):
+            refresh()
+        return Result()

@@ -99,14 +99,17 @@ class CircuitBreaker:
                     self._transition(HALF_OPEN, now)
                 else:
                     raise CircuitBreakerOpenError(self._key, "recovery timeout pending")
-            if self.state == HALF_OPEN:
-                if self._half_open_requests >= self.config.half_open_max_requests:
-                    raise CircuitBreakerOpenError(self._key, "half-open probe budget spent")
-                self._half_open_requests += 1
+            # rate/concurrency checks BEFORE consuming half-open budget: a
+            # rejection here never reaches record_*, so a probe consumed now
+            # would be burned with no provision attempted
             if self._minute_count >= self.config.rate_limit_per_minute:
                 raise CircuitBreakerOpenError(self._key, "provision rate limit reached")
             if self._concurrent >= self.config.max_concurrent_instances:
                 raise CircuitBreakerOpenError(self._key, "max concurrent provisions")
+            if self.state == HALF_OPEN:
+                if self._half_open_requests >= self.config.half_open_max_requests:
+                    raise CircuitBreakerOpenError(self._key, "half-open probe budget spent")
+                self._half_open_requests += 1
             self._minute_count += 1
             self._concurrent += 1
 

@@ -1,0 +1,100 @@
+"""CloudProvider facade: the karpenter-core-facing interface (L3).
+
+Capability parity with ``pkg/cloudprovider/cloudprovider.go:64`` —
+Create / Delete / Get / List / GetInstanceTypes / IsDrifted / Name /
+RepairPolicies / GetSupportedNodeClasses — re-centered on the solver: Create
+takes a PlannedNode from the solve instead of re-running a greedy pick, but
+keeps the reference's gates (Ready condition :282-301, compatible-type
+filter :321-352, circuit breaker :356-373) which live in the Actuator.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+from karpenter_tpu.apis.nodeclaim import NodeClaim, parse_provider_id
+from karpenter_tpu.apis.nodeclass import NodeClass
+from karpenter_tpu.catalog.arrays import CatalogArrays
+from karpenter_tpu.catalog.instancetype import InstanceType, InstanceTypeProvider
+from karpenter_tpu.cloud.errors import CloudError, NodeClaimNotFoundError, is_not_found
+from karpenter_tpu.core.actuator import Actuator
+from karpenter_tpu.core.cluster import ClusterState
+from karpenter_tpu.core.drift import RepairPolicy, is_drifted, repair_policies
+from karpenter_tpu.solver.types import PlannedNode
+from karpenter_tpu.utils.logging import get_logger
+
+log = get_logger("core.cloudprovider")
+
+PROVIDER_NAME = "karpenter-tpu"
+
+
+class CloudProvider:
+    def __init__(self, cluster: ClusterState, actuator: Actuator,
+                 instance_types: InstanceTypeProvider):
+        self.cluster = cluster
+        self.actuator = actuator
+        self.instance_types = instance_types
+
+    # -- identity ----------------------------------------------------------
+
+    def name(self) -> str:
+        return PROVIDER_NAME
+
+    def get_supported_node_classes(self) -> List[str]:
+        return ["NodeClass"]
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def create(self, planned: PlannedNode, nodeclass: NodeClass,
+               catalog: CatalogArrays, nodepool_name: str = "default") -> NodeClaim:
+        """(cloudprovider.go:249-501 — gates live in Actuator.create_node)"""
+        return self.actuator.create_node(planned, nodeclass, catalog, nodepool_name)
+
+    def delete(self, claim: NodeClaim) -> None:
+        """Raises NodeClaimNotFoundError once the instance is verifiably
+        gone — the finalizer-release contract (cloudprovider.go:503)."""
+        self.actuator.delete_node(claim)
+
+    def get(self, provider_id: str) -> Optional[NodeClaim]:
+        """Resolve a providerID back to a live NodeClaim
+        (cloudprovider.go:106): verify the instance exists, then find the
+        claim tracking it."""
+        parsed = parse_provider_id(provider_id)
+        if parsed is None:
+            return None
+        _, instance_id = parsed
+        try:
+            self.actuator.cloud.get_instance(instance_id)
+        except CloudError as e:
+            if is_not_found(e):
+                raise NodeClaimNotFoundError(provider_id)
+            raise
+        for claim in self.cluster.nodeclaims():
+            if claim.provider_id == provider_id:
+                return claim
+        return None
+
+    def list(self) -> List[NodeClaim]:
+        """All NodeClaims with live provider IDs (cloudprovider.go:172 lists
+        nodes with ibm:// providerIDs; claims are this framework's ledger)."""
+        return [c for c in self.cluster.nodeclaims()
+                if c.provider_id and not c.deleted]
+
+    def get_instance_types(self, nodeclass: Optional[NodeClass] = None
+                           ) -> List[InstanceType]:
+        """Per-NodeClass filtered catalog (cloudprovider.go:553)."""
+        types = self.instance_types.list(nodeclass)
+        if nodeclass is not None and nodeclass.status.selected_instance_types:
+            allowed = set(nodeclass.status.selected_instance_types)
+            types = [t for t in types if t.name in allowed]
+        return types
+
+    # -- drift / repair ----------------------------------------------------
+
+    def is_drifted(self, claim: NodeClaim) -> str:
+        """Six-check drift chain; "" = not drifted (cloudprovider.go:585)."""
+        nodeclass = self.cluster.get_nodeclass(claim.nodeclass_name)
+        return is_drifted(claim, nodeclass)
+
+    def repair_policies(self) -> List[RepairPolicy]:
+        return repair_policies()

@@ -41,6 +41,9 @@ class ProvisionerOptions:
     solver: SolverOptions = field(default_factory=SolverOptions)
     window: WindowOptions = field(default_factory=WindowOptions)
     default_nodepool: str = "default"
+    # retry loop: pods whose create failed (or whose node died) re-enter a
+    # window after sitting unnominated this long
+    retry_interval: float = 15.0
 
 
 def make_solver(options: SolverOptions):
@@ -68,22 +71,72 @@ class Provisioner:
 
     def start(self) -> None:
         """Begin watch-driven provisioning: pod ADDED events feed the
-        window; each fired window runs one solve + actuation."""
+        window; each fired window runs one solve + actuation.  Two repair
+        feeds keep pods from stranding: a claim-deletion watch un-nominates
+        that claim's pods immediately, and a retry ticker re-windows any pod
+        still unnominated after retry_interval (failed creates, unplaceable
+        pods waiting out an offering blackout)."""
         self._window = SolveWindow(self._on_window, self.options.window)
 
         def on_pod_event(event_type: str, pending: PendingPod):
             if event_type == "ADDED" and not pending.bound_node:
                 self._window.add(pending.spec)
 
+        def on_claim_event(event_type: str, claim):
+            deleted = event_type == "DELETED" or getattr(claim, "deleted", False)
+            if deleted and getattr(claim, "name", ""):
+                self._renominate_orphans(claim.name)
+
         self._unsubscribe = self.cluster.watch("pods", on_pod_event)
+        self._unsub_claims = self.cluster.watch("nodeclaims", on_claim_event)
+        self._stop_retry = threading.Event()
+        self._retry_thread = threading.Thread(
+            target=self._retry_loop, name="provisioner-retry", daemon=True)
+        self._retry_thread.start()
 
     def stop(self) -> None:
         if self._unsubscribe:
             self._unsubscribe()
             self._unsubscribe = None
+        if getattr(self, "_unsub_claims", None):
+            self._unsub_claims()
+            self._unsub_claims = None
+        if getattr(self, "_stop_retry", None):
+            self._stop_retry.set()
+            self._retry_thread.join(timeout=5.0)
         if self._window:
             self._window.close()
             self._window = None
+
+    # -- repair feeds ------------------------------------------------------
+
+    def _renominate_orphans(self, claim_name: str) -> None:
+        """A claim died: its nominated (not yet bound) pods go back in the
+        queue for the next window (the replacement cycle of SURVEY.md §5.3)."""
+        for pending in self.cluster.list("pods"):
+            if pending.nominated_node == claim_name and not pending.bound_node:
+                pending.nominated_node = ""
+                if self._window is not None:
+                    self._window.add(pending.spec)
+
+    def _retry_loop(self) -> None:
+        while not self._stop_retry.wait(self.options.retry_interval):
+            self.requeue_pending()
+
+    def requeue_pending(self) -> int:
+        """Re-window every pod that has sat unnominated past the retry
+        interval (create failures and blacked-out offerings resolve with
+        time; the reference's per-reconcile retry has the same effect)."""
+        if self._window is None:
+            return 0
+        cutoff = time.time() - self.options.retry_interval
+        n = 0
+        for pending in self.cluster.pending_pods():
+            if not pending.nominated_node and pending.enqueued_at <= cutoff:
+                pending.enqueued_at = time.time()   # rate-limit re-adds
+                self._window.add(pending.spec)
+                n += 1
+        return n
 
     # -- synchronous entry (tests, repair loops, consolidation) ------------
 
@@ -99,9 +152,23 @@ class Provisioner:
     # -- internals ---------------------------------------------------------
 
     def _on_window(self, pods: Sequence[PodSpec]) -> Sequence[object]:
+        # The retry feeds can enqueue a pod more than once, and a pod added
+        # to the window may have been nominated/bound since: solve only the
+        # still-pending unnominated set, deduped by key.
+        seen = set()
+        to_solve: List[PodSpec] = []
+        for p in pods:
+            key = pod_key(p)
+            if key in seen:
+                continue
+            seen.add(key)
+            pending = self.cluster.get("pods", key)
+            if pending is None or pending.bound_node or pending.nominated_node:
+                continue
+            to_solve.append(p)
         # per-pod outcome = the claim the pod was ACTUALLY nominated onto
         # (pods on failed creates resolve to None and stay pending)
-        _, nominated = self._provision(list(pods))
+        _, nominated = self._provision(to_solve)
         return [nominated.get(pod_key(p)) for p in pods]
 
     def _provision(self, pods: List[PodSpec]) -> Tuple[List[Plan], Dict[str, str]]:

@@ -234,6 +234,9 @@ BATCH_SIZE = Histogram(
     "karpenter_tpu_batcher_batch_size",
     "Items per fired batch", ("batcher",),
     buckets=(1, 2, 5, 10, 20, 50, 100, 200, 500, 1000, 10000))
+RECONCILE_DURATION = Histogram(
+    "karpenter_tpu_reconcile_duration_seconds",
+    "Controller reconcile latency", ("controller",))
 
 # Solver-specific families (new in the TPU build).
 SOLVE_DURATION = Histogram(

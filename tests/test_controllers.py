@@ -1,0 +1,561 @@
+"""Controller-plane tests: runtime, nodeclass controllers, nodeclaim
+lifecycle, fault ring, drift + CloudProvider facade.
+
+Mirrors the reference's controller test strategy (SURVEY.md §4.4): fake
+cluster store + fake cloud, reconcilers driven deterministically via
+ControllerManager.sync().
+"""
+
+import time
+
+import pytest
+
+from karpenter_tpu.apis.nodeclaim import NodeClaim, provider_id
+from karpenter_tpu.apis.nodeclass import (
+    ANNOTATION_IMAGE, ANNOTATION_NODECLASS_HASH, ANNOTATION_NODECLASS_HASH_VERSION,
+    ANNOTATION_SECURITY_GROUPS, ANNOTATION_SUBNET, NODECLASS_HASH_VERSION,
+    ImageSelector, InstanceRequirements, NodeClass, NodeClassSpec, PlacementStrategy,
+)
+from karpenter_tpu.apis.pod import Taint
+from karpenter_tpu.catalog import (
+    CatalogArrays, InstanceTypeProvider, PricingProvider, UnavailableOfferings,
+)
+from karpenter_tpu.cloud.errors import NodeClaimNotFoundError
+from karpenter_tpu.cloud.fake import FakeCloud
+from karpenter_tpu.cloud.image import ImageResolver
+from karpenter_tpu.cloud.subnet import SubnetProvider
+from karpenter_tpu.controllers import ControllerManager, PollController, Result, WatchController
+from karpenter_tpu.controllers.faults import (
+    InstanceTypeRefreshController, InterruptionController, OrphanCleanupController,
+    PricingRefreshController, SpotPreemptionController,
+)
+from karpenter_tpu.controllers.nodeclaim import (
+    GarbageCollectionController, NodeClaimTerminationController,
+    RegistrationController, StartupTaintController, TaggingController,
+)
+from karpenter_tpu.controllers.nodeclass import (
+    AutoplacementController, NodeClassHashController, NodeClassStatusController,
+    NodeClassTerminationController, TERMINATION_FINALIZER,
+)
+from karpenter_tpu.core import Actuator, ClusterState
+from karpenter_tpu.core.bootstrap import TAINT_UNREGISTERED
+from karpenter_tpu.core.cloudprovider import CloudProvider
+from karpenter_tpu.core.drift import (
+    DRIFT_HASH, DRIFT_HASH_VERSION, DRIFT_IMAGE, DRIFT_NODECLASS_DELETED,
+    DRIFT_SECURITY_GROUPS, DRIFT_SUBNET, is_drifted, repair_policies,
+)
+from karpenter_tpu.core.kubelet import FakeKubelet
+from karpenter_tpu.solver.types import PlannedNode
+
+
+def ready_nodeclass(name="default", **kw) -> NodeClass:
+    nc = NodeClass(name=name, spec=NodeClassSpec(
+        region="us-south", image="img-1", vpc="vpc-1", **kw))
+    if not nc.spec.instance_requirements:
+        nc.spec.instance_profile = nc.spec.instance_profile or "bx2-4x16"
+    nc.status.resolved_image_id = "img-1"
+    nc.status.set_condition("Ready", "True", "Validated")
+    return nc
+
+
+@pytest.fixture
+def rig():
+    cloud = FakeCloud()
+    pricing = PricingProvider(cloud)
+    unavail = UnavailableOfferings()
+    itp = InstanceTypeProvider(cloud, pricing, unavail)
+    cluster = ClusterState()
+    actuator = Actuator(cloud, cluster, unavailable=unavail)
+    yield cloud, cluster, actuator, itp, unavail
+    pricing.close()
+
+
+def launch_claim(cloud, cluster, actuator, itp, name="default"):
+    cluster.add_nodeclass(ready_nodeclass(name))
+    cat = CatalogArrays.build(itp.list())
+    o = cat.find_offering("bx2-4x16", "us-south-1", "on-demand")
+    return actuator.create_node(
+        PlannedNode("bx2-4x16", "us-south-1", "on-demand", price=0.2,
+                    offering_index=o, pod_names=("default/p0",)),
+        cluster.get_nodeclass(name), cat)
+
+
+# ---------------------------------------------------------------------------
+# Drift (ref cloudprovider.go:585-642 six checks)
+# ---------------------------------------------------------------------------
+
+class TestDrift:
+    def claim_for(self, nc: NodeClass) -> NodeClaim:
+        return NodeClaim(
+            name="c1", nodeclass_name=nc.name,
+            annotations={
+                ANNOTATION_NODECLASS_HASH: nc.spec_hash(),
+                ANNOTATION_NODECLASS_HASH_VERSION: NODECLASS_HASH_VERSION,
+                ANNOTATION_SUBNET: "subnet-1",
+                ANNOTATION_IMAGE: "img-1",
+                ANNOTATION_SECURITY_GROUPS: "sg-1,sg-2",
+            })
+
+    def base(self):
+        nc = ready_nodeclass()
+        nc.status.selected_subnets = ["subnet-1", "subnet-2"]
+        nc.status.resolved_security_groups = ["sg-2", "sg-1"]
+        return nc
+
+    def test_not_drifted(self):
+        nc = self.base()
+        assert is_drifted(self.claim_for(nc), nc) == ""
+
+    def test_nodeclass_deleted(self):
+        nc = self.base()
+        claim = self.claim_for(nc)
+        assert is_drifted(claim, None) == DRIFT_NODECLASS_DELETED
+        nc.deleted = True
+        assert is_drifted(claim, nc) == DRIFT_NODECLASS_DELETED
+
+    def test_hash_version(self):
+        nc = self.base()
+        claim = self.claim_for(nc)
+        claim.annotations[ANNOTATION_NODECLASS_HASH_VERSION] = "v0"
+        assert is_drifted(claim, nc) == DRIFT_HASH_VERSION
+
+    def test_spec_hash(self):
+        nc = self.base()
+        claim = self.claim_for(nc)
+        nc.spec.zone = "us-south-2"   # spec change -> hash moves
+        assert is_drifted(claim, nc) == DRIFT_HASH
+
+    def test_image(self):
+        nc = self.base()
+        claim = self.claim_for(nc)
+        nc.status.resolved_image_id = "img-9"
+        assert is_drifted(claim, nc) == DRIFT_IMAGE
+
+    def test_subnet(self):
+        nc = self.base()
+        claim = self.claim_for(nc)
+        nc.status.selected_subnets = ["subnet-7"]
+        assert is_drifted(claim, nc) == DRIFT_SUBNET
+
+    def test_explicit_subnet(self):
+        nc = self.base()
+        nc.spec.subnet = "subnet-9"
+        claim = self.claim_for(nc)
+        claim.annotations[ANNOTATION_NODECLASS_HASH] = nc.spec_hash()
+        assert is_drifted(claim, nc) == DRIFT_SUBNET
+
+    def test_security_groups_order_insensitive(self):
+        nc = self.base()
+        claim = self.claim_for(nc)
+        assert is_drifted(claim, nc) == ""          # {sg-1,sg-2} == {sg-2,sg-1}
+        nc.status.resolved_security_groups = ["sg-1", "sg-3"]
+        assert is_drifted(claim, nc) == DRIFT_SECURITY_GROUPS
+
+    def test_repair_policies_table(self):
+        pols = repair_policies()
+        assert {(p.condition_type, p.condition_status) for p in pols} == {
+            ("Ready", "False"), ("Ready", "Unknown"), ("MemoryPressure", "True"),
+            ("DiskPressure", "True"), ("PIDPressure", "True")}
+        assert all(p.toleration_seconds >= 300 for p in pols)
+
+
+# ---------------------------------------------------------------------------
+# CloudProvider facade
+# ---------------------------------------------------------------------------
+
+class TestCloudProviderFacade:
+    def test_get_list_delete(self, rig):
+        cloud, cluster, actuator, itp, unavail = rig
+        cp = CloudProvider(cluster, actuator, itp)
+        claim = launch_claim(cloud, cluster, actuator, itp)
+        assert cp.name() == "karpenter-tpu"
+        assert [c.name for c in cp.list()] == [claim.name]
+        assert cp.get(claim.provider_id).name == claim.name
+        with pytest.raises(NodeClaimNotFoundError):
+            cp.delete(claim)
+        with pytest.raises(NodeClaimNotFoundError):
+            cp.get(claim.provider_id)
+
+    def test_get_instance_types_filtered(self, rig):
+        cloud, cluster, actuator, itp, unavail = rig
+        cp = CloudProvider(cluster, actuator, itp)
+        nc = ready_nodeclass("sel")
+        nc.status.selected_instance_types = ["bx2-4x16", "cx2-2x4"]
+        names = {t.name for t in cp.get_instance_types(nc)}
+        assert names <= {"bx2-4x16", "cx2-2x4"} and "bx2-4x16" in names
+
+    def test_is_drifted_via_store(self, rig):
+        cloud, cluster, actuator, itp, unavail = rig
+        cp = CloudProvider(cluster, actuator, itp)
+        claim = launch_claim(cloud, cluster, actuator, itp)
+        assert cp.is_drifted(claim) == ""
+        nc = cluster.get_nodeclass("default")
+        nc.spec.zone = "us-south-3"
+        assert cp.is_drifted(claim) == DRIFT_HASH
+
+
+# ---------------------------------------------------------------------------
+# Runtime
+# ---------------------------------------------------------------------------
+
+class TestRuntime:
+    def test_sync_reconciles_existing_and_cascades(self, rig):
+        cloud, cluster, actuator, itp, unavail = rig
+        seen = []
+
+        class C(WatchController):
+            name = "t"
+            watch_kinds = ("nodeclasses",)
+
+            def reconcile(self, key):
+                seen.append(key)
+                return Result()
+
+        cluster.add_nodeclass(ready_nodeclass("a"))
+        mgr = ControllerManager(cluster)
+        mgr.register(C())
+        mgr.sync(rounds=1)
+        assert seen == ["a"]
+
+    def test_poller_adaptive_requeue(self, rig):
+        cloud, cluster, actuator, itp, unavail = rig
+        calls = []
+
+        class P(PollController):
+            name = "p"
+            interval = 100.0
+
+            def reconcile(self):
+                calls.append(1)
+                return Result(requeue_after=0.01)
+
+        mgr = ControllerManager(cluster)
+        mgr.register(P())
+        mgr.sync(rounds=2)
+        assert len(calls) == 2
+
+    def test_live_watch_triggers_reconcile(self, rig):
+        cloud, cluster, actuator, itp, unavail = rig
+        import threading
+        done = threading.Event()
+
+        class C(WatchController):
+            name = "live"
+            watch_kinds = ("nodeclasses",)
+
+            def reconcile(self, key):
+                done.set()
+                return Result()
+
+        mgr = ControllerManager(cluster)
+        mgr.register(C())
+        mgr.start()
+        try:
+            cluster.add_nodeclass(ready_nodeclass("live-nc"))
+            assert done.wait(5.0), "watch event did not reach reconcile"
+        finally:
+            mgr.stop()
+
+    def test_reconcile_error_does_not_kill_manager(self, rig):
+        cloud, cluster, actuator, itp, unavail = rig
+
+        class Bad(WatchController):
+            name = "bad"
+            watch_kinds = ("nodeclasses",)
+
+            def reconcile(self, key):
+                raise RuntimeError("boom")
+
+        cluster.add_nodeclass(ready_nodeclass("x"))
+        mgr = ControllerManager(cluster)
+        mgr.register(Bad())
+        mgr.sync(rounds=1)   # must not raise
+
+
+# ---------------------------------------------------------------------------
+# NodeClass controllers
+# ---------------------------------------------------------------------------
+
+class TestNodeClassControllers:
+    def test_hash_controller_stamps_annotations(self, rig):
+        cloud, cluster, actuator, itp, unavail = rig
+        nc = cluster.add_nodeclass(ready_nodeclass())
+        ctrl = NodeClassHashController(cluster)
+        ctrl.reconcile("default")
+        nc = cluster.get_nodeclass("default")
+        assert nc.annotations[ANNOTATION_NODECLASS_HASH] == nc.spec_hash()
+        assert nc.annotations[ANNOTATION_NODECLASS_HASH_VERSION] == NODECLASS_HASH_VERSION
+
+    def test_status_validates_and_resolves(self, rig):
+        cloud, cluster, actuator, itp, unavail = rig
+        nc = NodeClass(name="nc1", spec=NodeClassSpec(
+            region="us-south", instance_profile="bx2-4x16",
+            image_selector=ImageSelector(os="ubuntu", major_version="22")))
+        cluster.add_nodeclass(nc)
+        ctrl = NodeClassStatusController(cluster, cloud)
+        ctrl.reconcile("nc1")
+        nc = cluster.get_nodeclass("nc1")
+        assert nc.status.is_ready(), nc.status.validation_error
+        assert nc.status.resolved_image_id
+        assert nc.status.resolved_security_groups  # default SG resolved
+
+    def test_status_rejects_bad_profile(self, rig):
+        cloud, cluster, actuator, itp, unavail = rig
+        nc = NodeClass(name="bad", spec=NodeClassSpec(
+            region="us-south", instance_profile="nope-99x99", image="img-1"))
+        cluster.add_nodeclass(nc)
+        NodeClassStatusController(cluster, cloud).reconcile("bad")
+        nc = cluster.get_nodeclass("bad")
+        assert not nc.status.is_ready()
+        assert "not found" in nc.status.validation_error
+
+    def test_status_rejects_zone_subnet_mismatch(self, rig):
+        cloud, cluster, actuator, itp, unavail = rig
+        subnets = cloud.list_subnets()
+        wrong = next(s for s in subnets if s.zone != "us-south-1")
+        nc = NodeClass(name="zs", spec=NodeClassSpec(
+            region="us-south", zone="us-south-1", subnet=wrong.id,
+            instance_profile="bx2-4x16", image="img-1"))
+        cluster.add_nodeclass(nc)
+        NodeClassStatusController(cluster, cloud).reconcile("zs")
+        assert not cluster.get_nodeclass("zs").status.is_ready()
+
+    def test_autoplacement_selects_types_and_subnets(self, rig):
+        cloud, cluster, actuator, itp, unavail = rig
+        nc = NodeClass(name="auto", spec=NodeClassSpec(
+            region="us-south", image="img-1",
+            instance_requirements=InstanceRequirements(min_cpu=4, min_memory_gib=8),
+            placement_strategy=PlacementStrategy(zone_balance="Balanced")))
+        cluster.add_nodeclass(nc)
+        ctrl = AutoplacementController(cluster, itp, SubnetProvider(cloud))
+        ctrl.reconcile("auto")
+        nc = cluster.get_nodeclass("auto")
+        assert nc.status.selected_instance_types
+        assert all("bx2" in n or "cx2" in n or "mx2" in n or "gx3" in n
+                   for n in nc.status.selected_instance_types)
+        assert nc.status.selected_subnets
+        # Balanced -> one subnet per zone
+        zones = {cloud.get_subnet(s).zone for s in nc.status.selected_subnets}
+        assert len(zones) == len(nc.status.selected_subnets)
+
+    def test_termination_blocks_until_claims_gone(self, rig):
+        cloud, cluster, actuator, itp, unavail = rig
+        claim = launch_claim(cloud, cluster, actuator, itp)
+        ctrl = NodeClassTerminationController(cluster)
+        ctrl.reconcile("default")   # adds finalizer
+        nc = cluster.get_nodeclass("default")
+        assert TERMINATION_FINALIZER in nc.finalizers
+        nc.deleted = True
+        res = ctrl.reconcile("default")
+        assert res.requeue_after > 0          # blocked by the live claim
+        assert cluster.get_nodeclass("default") is not None
+        cluster.delete("nodeclaims", claim.name)
+        ctrl.reconcile("default")
+        assert cluster.get_nodeclass("default") is None
+
+
+# ---------------------------------------------------------------------------
+# NodeClaim lifecycle controllers
+# ---------------------------------------------------------------------------
+
+class TestNodeClaimControllers:
+    def test_registration_and_initialization(self, rig):
+        cloud, cluster, actuator, itp, unavail = rig
+        claim = launch_claim(cloud, cluster, actuator, itp)
+        claim.taints = (Taint("dedicated", "gpu", "NoSchedule"),)
+        kubelet = FakeKubelet(cluster)
+        node = kubelet.join(claim)
+        assert any(t.key == TAINT_UNREGISTERED.key for t in node.taints)
+        ctrl = RegistrationController(cluster)
+        ctrl.reconcile(claim.name)
+        claim = cluster.get_nodeclaim(claim.name)
+        node = cluster.get_node(node.name)
+        assert claim.registered and claim.node_name == node.name
+        assert not claim.initialized                   # node not Ready yet
+        assert not any(t.key == TAINT_UNREGISTERED.key for t in node.taints)
+        assert node.labels["karpenter.sh/capacity-type"] == "on-demand"
+        assert any(t.key == "dedicated" for t in node.taints)
+        kubelet.mark_ready(node.name)
+        ctrl.reconcile(claim.name)
+        claim = cluster.get_nodeclaim(claim.name)
+        assert claim.initialized
+        assert cluster.get_node(node.name).labels["karpenter.sh/initialized"] == "true"
+
+    def test_startup_taint_removed_when_ready(self, rig):
+        cloud, cluster, actuator, itp, unavail = rig
+        claim = launch_claim(cloud, cluster, actuator, itp)
+        claim.startup_taints = (Taint("example.com/startup", "", "NoSchedule"),)
+        kubelet = FakeKubelet(cluster)
+        node = kubelet.join(claim)
+        reg = RegistrationController(cluster)
+        reg.reconcile(claim.name)
+        st = StartupTaintController(cluster)
+        st.reconcile(claim.name)               # node not ready -> no-op
+        assert any(t.key == "example.com/startup"
+                   for t in cluster.get_node(node.name).taints)
+        kubelet.mark_ready(node.name)
+        # CNI taint holds removal
+        n = cluster.get_node(node.name)
+        n.taints.append(Taint("node.cilium.io/agent-not-ready", "", "NoExecute"))
+        cluster.update("nodes", n.name, n)
+        res = st.reconcile(claim.name)
+        assert res.requeue_after > 0
+        n = cluster.get_node(node.name)
+        n.taints = [t for t in n.taints if not t.key.startswith("node.cilium.io")]
+        cluster.update("nodes", n.name, n)
+        st.reconcile(claim.name)
+        assert not any(t.key == "example.com/startup"
+                       for t in cluster.get_node(node.name).taints)
+
+    def test_termination_finalizes_claim(self, rig):
+        cloud, cluster, actuator, itp, unavail = rig
+        claim = launch_claim(cloud, cluster, actuator, itp)
+        FakeKubelet(cluster).join(claim)
+        RegistrationController(cluster).reconcile(claim.name)
+        claim = cluster.get_nodeclaim(claim.name)
+        claim.deleted = True
+        ctrl = NodeClaimTerminationController(cluster, actuator)
+        ctrl.reconcile(claim.name)
+        assert cluster.get_nodeclaim(claim.name) is None
+        assert cluster.get_node(claim.node_name) is None
+        assert cloud.instance_count() == 0
+
+    def test_gc_orphan_instance_and_dead_claim(self, rig):
+        cloud, cluster, actuator, itp, unavail = rig
+        claim = launch_claim(cloud, cluster, actuator, itp)
+        # orphan: karpenter-tagged instance nobody tracks
+        orphan = cloud.create_instance(
+            name="orphan", profile="bx2-4x16", zone="us-south-1",
+            subnet_id=cloud.list_subnets()[0].id, image_id="img-1",
+            tags={"karpenter.sh/managed": "true"})
+        # unmanaged instance must never be touched
+        unmanaged = cloud.create_instance(
+            name="pet", profile="bx2-4x16", zone="us-south-1",
+            subnet_id=cloud.list_subnets()[0].id, image_id="img-1")
+        gc = GarbageCollectionController(cluster, cloud)
+        res = gc.reconcile()
+        # newborn grace: within min_instance_age the orphan survives (the
+        # actuator creates the instance before registering the claim)
+        assert orphan.id in {i.id for i in cloud.list_instances()}
+        cloud.instances[orphan.id].created_at = time.time() - 10000
+        res = gc.reconcile()
+        assert res.requeue_after == gc.fast_interval      # dirty sweep
+        ids = {i.id for i in cloud.list_instances()}
+        assert orphan.id not in ids and unmanaged.id in ids
+        # dead claim: instance vanishes under a live claim
+        cloud.delete_instance(claim.provider_id.rsplit("/", 1)[1])
+        gc.reconcile()
+        assert cluster.get_nodeclaim(claim.name).deleted
+
+    def test_gc_registration_timeout(self, rig):
+        cloud, cluster, actuator, itp, unavail = rig
+        claim = launch_claim(cloud, cluster, actuator, itp)
+        claim.created_at = time.time() - 1000
+        gc = GarbageCollectionController(cluster, cloud)
+        gc.reconcile()
+        assert cluster.get_nodeclaim(claim.name).deleted
+
+    def test_tagging_restores_tags(self, rig):
+        cloud, cluster, actuator, itp, unavail = rig
+        claim = launch_claim(cloud, cluster, actuator, itp)
+        iid = claim.provider_id.rsplit("/", 1)[1]
+        cloud.update_tags(iid, {})
+        TaggingController(cluster, cloud).reconcile()
+        assert cloud.get_instance(iid).tags["karpenter.sh/managed"] == "true"
+
+
+# ---------------------------------------------------------------------------
+# Fault ring
+# ---------------------------------------------------------------------------
+
+class TestFaultControllers:
+    def test_interruption_replaces_and_blacks_out(self, rig):
+        cloud, cluster, actuator, itp, unavail = rig
+        claim = launch_claim(cloud, cluster, actuator, itp)
+        kubelet = FakeKubelet(cluster)
+        node = kubelet.join(claim, ready=True)
+        RegistrationController(cluster).reconcile(claim.name)
+        kubelet.mark_condition(node.name, "OutOfCapacity", "True")
+        InterruptionController(cluster, unavail).reconcile()
+        assert cluster.get_nodeclaim(claim.name).deleted
+        assert unavail.is_unavailable("bx2-4x16", "us-south-1", "on-demand")
+
+    def test_interruption_never_ready_suppression(self, rig):
+        cloud, cluster, actuator, itp, unavail = rig
+        claim = launch_claim(cloud, cluster, actuator, itp)
+        kubelet = FakeKubelet(cluster)
+        node = kubelet.join(claim)           # never became ready/initialized
+        kubelet.mark_condition(node.name, "NetworkUnavailable", "True")
+        InterruptionController(cluster, unavail).reconcile()
+        assert not cluster.get_nodeclaim(claim.name).deleted
+
+    def test_spot_preemption_blackout_and_replace(self, rig):
+        cloud, cluster, actuator, itp, unavail = rig
+        cluster.add_nodeclass(ready_nodeclass())
+        cat = CatalogArrays.build(itp.list())
+        o = cat.find_offering("bx2-4x16", "us-south-1", "spot")
+        claim = actuator.create_node(
+            PlannedNode("bx2-4x16", "us-south-1", "spot", price=0.1,
+                        offering_index=o), cluster.get_nodeclass("default"), cat)
+        iid = claim.provider_id.rsplit("/", 1)[1]
+        cloud.preempt_spot_instance(iid)
+        SpotPreemptionController(cluster, cloud, unavail).reconcile()
+        assert unavail.is_unavailable("bx2-4x16", "us-south-1", "spot")
+        assert cluster.get_nodeclaim(claim.name).deleted
+        assert cloud.instance_count() == 0
+
+    def test_orphan_cleanup_gated_and_two_way(self, rig):
+        cloud, cluster, actuator, itp, unavail = rig
+        inst = cloud.create_instance(
+            name="orphan", profile="bx2-4x16", zone="us-south-1",
+            subnet_id=cloud.list_subnets()[0].id, image_id="img-1",
+            tags={"karpenter.sh/managed": "true"})
+        # age the instance past the boot grace
+        cloud.instances[inst.id].created_at = time.time() - 10000
+        off = OrphanCleanupController(cluster, cloud, enabled=False)
+        off.reconcile()
+        assert cloud.instance_count() == 1    # gate off -> untouched
+        on = OrphanCleanupController(cluster, cloud, enabled=True)
+        on.reconcile()
+        assert cloud.instance_count() == 0
+        # node whose instance is gone
+        from karpenter_tpu.apis.nodeclaim import Node
+        cluster.add_node(Node(name="ghost",
+                              provider_id=provider_id("us-south", "inst-xyz")))
+        on.reconcile()
+        assert cluster.get_node("ghost") is None
+
+    def test_refreshers(self, rig):
+        cloud, cluster, actuator, itp, unavail = rig
+        unavail.mark_unavailable("bx2-4x16", "us-south-1", "spot", ttl=-1.0)
+        InstanceTypeRefreshController(itp, unavail).reconcile()
+        assert not unavail.is_unavailable("bx2-4x16", "us-south-1", "spot")
+        PricingRefreshController(object()).reconcile()   # NoOp fallback
+
+
+# ---------------------------------------------------------------------------
+# Full-plane integration: launch -> join -> register -> interrupt -> replace
+# ---------------------------------------------------------------------------
+
+def test_controller_plane_end_to_end(rig):
+    cloud, cluster, actuator, itp, unavail = rig
+    claim = launch_claim(cloud, cluster, actuator, itp)
+    mgr = ControllerManager(cluster)
+    mgr.register(NodeClassHashController(cluster))
+    mgr.register(NodeClassStatusController(cluster, cloud))
+    mgr.register(RegistrationController(cluster))
+    mgr.register(StartupTaintController(cluster))
+    mgr.register(NodeClaimTerminationController(cluster, actuator))
+    mgr.register(GarbageCollectionController(cluster, cloud))
+    mgr.register(InterruptionController(cluster, unavail))
+    kubelet = FakeKubelet(cluster)
+    node = kubelet.join(claim, ready=True)
+    mgr.sync()
+    claim = cluster.get_nodeclaim(claim.name)
+    assert claim.registered and claim.initialized
+    # interruption -> deleted claim -> termination finalizes -> GC clean
+    kubelet.mark_condition(node.name, "OutOfCapacity", "True")
+    mgr.sync()
+    assert cluster.get_nodeclaim(claim.name) is None
+    assert cloud.instance_count() == 0
+    assert unavail.is_unavailable("bx2-4x16", "us-south-1", "on-demand")

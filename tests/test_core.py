@@ -343,6 +343,72 @@ class TestEndToEndSlice:
         prov.provision_once()
         assert all(p.nominated_node for p in cluster.pending_pods())
 
+    def test_retry_loop_recovers_failed_creates_live(self, rig):
+        """Watch-driven mode: pods stranded by a create failure re-enter a
+        window via the retry ticker once the fault clears."""
+        cloud, cluster, prov, actuator, itp = rig
+        prov.options.window = WindowOptions(idle_seconds=0.05, max_seconds=1.0)
+        prov.options.retry_interval = 0.3
+        # permissive breaker: this test exercises the retry plumbing, and
+        # fast retries would otherwise trip the provision rate limit
+        actuator.breaker = CircuitBreakerManager(CircuitBreakerConfig(
+            failure_threshold=10000, rate_limit_per_minute=100000,
+            max_concurrent_instances=100000))
+        cloud.recorder.set_persistent_error(
+            "create_instance", CloudError("no capacity", 503,
+                                          code="insufficient_capacity",
+                                          retryable=False))
+        prov.start()
+        import time
+        try:
+            for pod in make_pods(5, requests=ResourceRequests(500, 512, 0, 1)):
+                cluster.add_pod(pod)
+            time.sleep(1.0)
+            assert cloud.instance_count() == 0
+            cloud.recorder.set_persistent_error("create_instance", None)
+            deadline = time.time() + 20
+            while time.time() < deadline:
+                if all(p.nominated_node for p in cluster.pending_pods()):
+                    break
+                time.sleep(0.1)
+            assert all(p.nominated_node for p in cluster.pending_pods()), \
+                "retry loop did not recover stranded pods"
+        finally:
+            prov.stop()
+
+    def test_claim_deletion_renominates_pods_live(self, rig):
+        """A claim dying (interruption/preemption) un-nominates its pods and
+        the next window replaces the capacity."""
+        cloud, cluster, prov, actuator, itp = rig
+        prov.options.window = WindowOptions(idle_seconds=0.05, max_seconds=1.0)
+        prov.start()
+        import time
+        try:
+            for pod in make_pods(4, requests=ResourceRequests(500, 512, 0, 1)):
+                cluster.add_pod(pod)
+            deadline = time.time() + 15
+            while time.time() < deadline:
+                if all(p.nominated_node for p in cluster.pending_pods()):
+                    break
+                time.sleep(0.1)
+            claims = cluster.nodeclaims()
+            assert claims
+            # kill the claim: delete via the store (watch fires)
+            victim = claims[0]
+            cluster.delete("nodeclaims", victim.name)
+            deadline = time.time() + 15
+            while time.time() < deadline:
+                pods = cluster.pending_pods()
+                if all(p.nominated_node and p.nominated_node != victim.name
+                       for p in pods):
+                    break
+                time.sleep(0.1)
+            assert all(p.nominated_node and p.nominated_node != victim.name
+                       for p in cluster.pending_pods()), \
+                "pods on the dead claim were not re-nominated"
+        finally:
+            prov.stop()
+
     def test_greedy_backend_gate(self, rig):
         cloud, cluster, prov, actuator, itp = rig
         prov2 = Provisioner(cluster, itp, actuator, ProvisionerOptions(
