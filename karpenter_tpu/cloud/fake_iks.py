@@ -1,0 +1,219 @@
+"""Stateful in-memory fake IKS (managed-cluster) API.
+
+Parity with the reference's IKS double (``pkg/fake/iksapi.go:29-485``):
+worker pools with per-zone sizing, **atomic** increment/decrement resize
+(the race-free resize idiom of ``pkg/cloudprovider/ibm/iks.go:406``),
+worker -> VPC instance mapping, call recording and error injection via the
+shared :class:`~karpenter_tpu.cloud.fake.CallRecorder`.
+
+Workers materialize real instances in the attached :class:`FakeCloud`, so
+the node-join continuation and GC sweeps behave identically across VPC and
+IKS actuation modes.
+"""
+
+from __future__ import annotations
+
+import itertools
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from karpenter_tpu.cloud.errors import CloudError, not_found
+from karpenter_tpu.cloud.fake import CallRecorder, FakeCloud
+
+
+@dataclass
+class FakeWorkerPool:
+    id: str
+    name: str
+    flavor: str                  # instance profile name
+    zones: List[str]
+    size_per_zone: int
+    state: str = "normal"        # normal | resizing | deleting
+    labels: Dict[str, str] = field(default_factory=dict)
+    dynamic: bool = False        # created by karpenter (eligible for cleanup)
+    created_at: float = field(default_factory=time.time)
+
+
+@dataclass
+class FakeWorker:
+    id: str
+    pool_id: str
+    zone: str
+    instance_id: str             # backing FakeCloud instance
+    state: str = "provisioning"  # provisioning | deployed | deleting
+
+
+class FakeIKS:
+    """One fake IKS cluster backed by a FakeCloud for instances."""
+
+    def __init__(self, cluster_id: str, cloud: FakeCloud,
+                 kube_version: str = "1.32.3"):
+        self.cluster_id = cluster_id
+        self.cloud = cloud
+        self.kube_version = kube_version
+        self.recorder = CallRecorder()
+        self.pools: Dict[str, FakeWorkerPool] = {}
+        self.workers: Dict[str, FakeWorker] = {}
+        self._lock = threading.RLock()
+        self._pool_seq = itertools.count(1)
+        self._worker_seq = itertools.count(1)
+
+    # -- pool CRUD (ref iks.go:317-469, 559-633) ---------------------------
+
+    def list_pools(self) -> List[FakeWorkerPool]:
+        self.recorder.record("list_pools")
+        self.recorder.maybe_raise("list_pools")
+        with self._lock:
+            return list(self.pools.values())
+
+    def get_pool(self, pool_id: str) -> FakeWorkerPool:
+        self.recorder.record("get_pool", pool_id)
+        self.recorder.maybe_raise("get_pool")
+        with self._lock:
+            pool = self.pools.get(pool_id)
+            if pool is None:
+                raise not_found("worker_pool", pool_id)
+            return pool
+
+    def get_pool_by_name(self, name: str) -> Optional[FakeWorkerPool]:
+        with self._lock:
+            for pool in self.pools.values():
+                if pool.name == name:
+                    return pool
+        return None
+
+    def create_pool(self, name: str, flavor: str, zones: List[str],
+                    size_per_zone: int = 0, labels: Optional[Dict[str, str]] = None,
+                    dynamic: bool = False) -> FakeWorkerPool:
+        self.recorder.record("create_pool", name, flavor)
+        self.recorder.maybe_raise("create_pool")
+        with self._lock:
+            if self.get_pool_by_name(name) is not None:
+                raise CloudError(f"worker pool {name!r} already exists", 409,
+                                 code="already_exists", retryable=False)
+            pool = FakeWorkerPool(
+                id=f"pool-{next(self._pool_seq)}", name=name, flavor=flavor,
+                zones=list(zones), size_per_zone=size_per_zone,
+                labels=dict(labels or {}), dynamic=dynamic)
+            self.pools[pool.id] = pool
+            for zone in pool.zones:
+                for _ in range(size_per_zone):
+                    self._add_worker(pool, zone)
+            return pool
+
+    def delete_pool(self, pool_id: str) -> None:
+        self.recorder.record("delete_pool", pool_id)
+        self.recorder.maybe_raise("delete_pool")
+        with self._lock:
+            pool = self.pools.get(pool_id)
+            if pool is None:
+                raise not_found("worker_pool", pool_id)
+            for worker in [w for w in self.workers.values()
+                           if w.pool_id == pool_id]:
+                self._remove_worker(worker)
+            del self.pools[pool_id]
+
+    def add_pool_zone(self, pool_id: str, zone: str) -> None:
+        """Idempotently extend a pool's zone list (locked — callers must
+        not check-then-append on the pool object directly)."""
+        self.recorder.record("add_pool_zone", pool_id, zone)
+        self.recorder.maybe_raise("add_pool_zone")
+        with self._lock:
+            pool = self.pools.get(pool_id)
+            if pool is None:
+                raise not_found("worker_pool", pool_id)
+            if zone not in pool.zones:
+                pool.zones.append(zone)
+
+    # -- atomic resize (ref iks.go:406 atomic increment) -------------------
+
+    def increment_pool(self, pool_id: str, zone: str) -> FakeWorker:
+        """Atomically grow the pool by one worker in ``zone`` and return the
+        new worker — callers never read-modify-write a size field, so
+        concurrent increments can't lose updates."""
+        self.recorder.record("increment_pool", pool_id, zone)
+        self.recorder.maybe_raise("increment_pool")
+        with self._lock:
+            pool = self.pools.get(pool_id)
+            if pool is None:
+                raise not_found("worker_pool", pool_id)
+            if zone not in pool.zones:
+                raise CloudError(f"pool {pool.name} has no zone {zone}", 400,
+                                 code="bad_request", retryable=False)
+            worker = self._add_worker(pool, zone)
+            pool.size_per_zone = max(
+                len([w for w in self.workers.values()
+                     if w.pool_id == pool_id and w.zone == z])
+                for z in pool.zones)
+            return worker
+
+    def decrement_pool(self, pool_id: str, worker_id: str) -> None:
+        """Atomically remove one specific worker."""
+        self.recorder.record("decrement_pool", pool_id, worker_id)
+        self.recorder.maybe_raise("decrement_pool")
+        with self._lock:
+            worker = self.workers.get(worker_id)
+            if worker is None or worker.pool_id != pool_id:
+                raise not_found("worker", worker_id)
+            self._remove_worker(worker)
+            pool = self.pools.get(pool_id)
+            if pool is not None and pool.zones:
+                pool.size_per_zone = max(
+                    len([w for w in self.workers.values()
+                         if w.pool_id == pool_id and w.zone == z])
+                    for z in pool.zones)
+
+    # -- workers (ref iks.go:161-232) --------------------------------------
+
+    def list_workers(self, pool_id: Optional[str] = None) -> List[FakeWorker]:
+        self.recorder.record("list_workers")
+        self.recorder.maybe_raise("list_workers")
+        with self._lock:
+            return [w for w in self.workers.values()
+                    if pool_id is None or w.pool_id == pool_id]
+
+    def get_worker(self, worker_id: str) -> FakeWorker:
+        self.recorder.record("get_worker", worker_id)
+        self.recorder.maybe_raise("get_worker")
+        with self._lock:
+            worker = self.workers.get(worker_id)
+            if worker is None:
+                raise not_found("worker", worker_id)
+            return worker
+
+    def worker_instance_id(self, worker_id: str) -> str:
+        """Worker -> VPC instance mapping (ref iks.go:195)."""
+        return self.get_worker(worker_id).instance_id
+
+    def deploy_worker(self, worker_id: str) -> None:
+        """Test hook: worker finishes provisioning."""
+        with self._lock:
+            if worker_id in self.workers:
+                self.workers[worker_id].state = "deployed"
+
+    # -- internals ---------------------------------------------------------
+
+    def _add_worker(self, pool: FakeWorkerPool, zone: str) -> FakeWorker:
+        subnet = next((s for s in self.cloud.list_subnets() if s.zone == zone),
+                      None)
+        images = self.cloud.list_images()   # IKS-managed worker image
+        inst = self.cloud.create_instance(
+            name=f"iks-{pool.name}-{next(self._worker_seq)}",
+            profile=pool.flavor, zone=zone,
+            subnet_id=subnet.id if subnet else "",
+            image_id=images[0].id if images else "",
+            tags={"iks.io/cluster": self.cluster_id,
+                  "iks.io/pool": pool.id})
+        worker = FakeWorker(id=f"worker-{inst.id}", pool_id=pool.id,
+                            zone=zone, instance_id=inst.id)
+        self.workers[worker.id] = worker
+        return worker
+
+    def _remove_worker(self, worker: FakeWorker) -> None:
+        try:
+            self.cloud.delete_instance(worker.instance_id)
+        except CloudError:
+            pass
+        self.workers.pop(worker.id, None)

@@ -1,0 +1,182 @@
+"""Load balancer provider: node registration in LB pools.
+
+Capability parity with ``pkg/providers/loadbalancer/provider.go``:
+``register_instance`` adds the node IP to each configured target pool
+(:69) and waits for the member to report healthy (:246);
+``deregister_instance`` removes it; health-check config validation mirrors
+:277 and the patch builder ``healthcheck.go:44-145``.  The fake LB state
+lives here too (the reference talks to VPC LB REST; tests use pkg/fake).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+from karpenter_tpu.apis.nodeclass import HealthCheck, LoadBalancerIntegration, LoadBalancerTarget
+from karpenter_tpu.cloud.errors import CloudError, not_found
+from karpenter_tpu.utils import metrics
+from karpenter_tpu.utils.logging import get_logger
+
+log = get_logger("cloud.loadbalancer")
+
+
+@dataclass
+class PoolMember:
+    id: str
+    address: str
+    port: int
+    weight: int = 50
+    health: str = "unknown"      # unknown | ok | faulted
+    created_at: float = field(default_factory=time.time)
+
+
+@dataclass
+class FakePool:
+    id: str
+    lb_id: str
+    name: str
+    members: Dict[str, PoolMember] = field(default_factory=dict)
+    health_check: Optional[HealthCheck] = None
+
+
+class FakeLoadBalancers:
+    """In-memory LB API double (pool/member CRUD, ref vpc.go:516-669)."""
+
+    def __init__(self, healthy_after: float = 0.0):
+        self._lock = threading.RLock()
+        self.pools: Dict[Tuple[str, str], FakePool] = {}   # (lb, pool name)
+        self._seq = 0
+        self.healthy_after = healthy_after   # member health settle delay
+
+    def ensure_pool(self, lb_id: str, pool_name: str) -> FakePool:
+        with self._lock:
+            key = (lb_id, pool_name)
+            if key not in self.pools:
+                self._seq += 1
+                self.pools[key] = FakePool(id=f"lbpool-{self._seq}",
+                                           lb_id=lb_id, name=pool_name)
+            return self.pools[key]
+
+    def get_pool(self, lb_id: str, pool_name: str) -> FakePool:
+        with self._lock:
+            pool = self.pools.get((lb_id, pool_name))
+            if pool is None:
+                raise not_found("lb_pool", f"{lb_id}/{pool_name}")
+            return pool
+
+    def add_member(self, lb_id: str, pool_name: str, address: str, port: int,
+                   weight: int) -> PoolMember:
+        with self._lock:
+            pool = self.get_pool(lb_id, pool_name)
+            for m in pool.members.values():
+                if m.address == address and m.port == port:
+                    return m   # idempotent
+            self._seq += 1
+            member = PoolMember(id=f"member-{self._seq}", address=address,
+                                port=port, weight=weight)
+            pool.members[member.id] = member
+            return member
+
+    def remove_member(self, lb_id: str, pool_name: str, address: str) -> int:
+        with self._lock:
+            pool = self.get_pool(lb_id, pool_name)
+            gone = [mid for mid, m in pool.members.items()
+                    if m.address == address]
+            for mid in gone:
+                del pool.members[mid]
+            return len(gone)
+
+    def member_health(self, member: PoolMember) -> str:
+        if member.health != "unknown":
+            return member.health
+        if time.time() - member.created_at >= self.healthy_after:
+            member.health = "ok"
+        return member.health
+
+    def set_health_check(self, lb_id: str, pool_name: str,
+                         hc: HealthCheck) -> None:
+        with self._lock:
+            self.get_pool(lb_id, pool_name).health_check = hc
+
+
+def validate_integration(integration: LoadBalancerIntegration) -> List[str]:
+    """(ref provider.go:277 config validation)"""
+    errs: List[str] = []
+    if not integration.enabled:
+        return errs
+    if not integration.target_groups:
+        errs.append("loadBalancerIntegration.enabled requires targetGroups")
+    for i, tg in enumerate(integration.target_groups):
+        prefix = f"targetGroups[{i}]"
+        if not tg.load_balancer_id:
+            errs.append(f"{prefix}.loadBalancerID is required")
+        if not tg.pool_name:
+            errs.append(f"{prefix}.poolName is required")
+        if not (1 <= tg.port <= 65535):
+            errs.append(f"{prefix}.port {tg.port} out of range")
+        if not (0 <= tg.weight <= 100):
+            errs.append(f"{prefix}.weight {tg.weight} out of range")
+        hc = tg.health_check
+        if hc is not None:
+            if hc.protocol not in ("tcp", "http", "https"):
+                errs.append(f"{prefix}.healthCheck.protocol invalid")
+            if hc.port and not (1 <= hc.port <= 65535):
+                errs.append(f"{prefix}.healthCheck.port out of range")
+            if hc.interval < 2 or hc.timeout < 1 or hc.timeout >= hc.interval:
+                errs.append(f"{prefix}.healthCheck timing invalid "
+                            "(timeout must be < interval, interval >= 2)")
+    return errs
+
+
+class LoadBalancerProvider:
+    def __init__(self, lbs: Optional[FakeLoadBalancers] = None):
+        self.lbs = lbs or FakeLoadBalancers()
+
+    def register_instance(self, integration: LoadBalancerIntegration,
+                          address: str, wait_healthy: bool = False,
+                          timeout: float = 5.0) -> List[str]:
+        """Adds the address to every target pool; returns member ids
+        (ref RegisterInstance provider.go:69, wait-healthy :246)."""
+        errs = validate_integration(integration)
+        if errs:
+            raise CloudError("invalid loadBalancerIntegration: " +
+                             "; ".join(errs), 400, retryable=False)
+        member_ids: List[str] = []
+        for tg in integration.target_groups:
+            pool = self.lbs.ensure_pool(tg.load_balancer_id, tg.pool_name)
+            if tg.health_check is not None and \
+                    pool.health_check != tg.health_check:
+                self.lbs.set_health_check(tg.load_balancer_id, tg.pool_name,
+                                          tg.health_check)
+            member = self.lbs.add_member(tg.load_balancer_id, tg.pool_name,
+                                         address, tg.port, tg.weight)
+            member_ids.append(member.id)
+            metrics.API_REQUESTS.labels("lb", "add_member", "ok").inc()
+            if wait_healthy:
+                self._wait_healthy(member, timeout)
+        return member_ids
+
+    def deregister_instance(self, integration: LoadBalancerIntegration,
+                            address: str) -> int:
+        removed = 0
+        for tg in integration.target_groups:
+            try:
+                removed += self.lbs.remove_member(tg.load_balancer_id,
+                                                  tg.pool_name, address)
+                metrics.API_REQUESTS.labels("lb", "remove_member", "ok").inc()
+            except CloudError as e:
+                log.warning("deregister failed", lb=tg.load_balancer_id,
+                            pool=tg.pool_name, error=str(e))
+        return removed
+
+    def _wait_healthy(self, member: PoolMember, timeout: float) -> None:
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            if self.lbs.member_health(member) == "ok":
+                return
+            time.sleep(0.05)
+        raise CloudError(f"member {member.id} not healthy after {timeout}s",
+                         408, code="timeout", retryable=True)

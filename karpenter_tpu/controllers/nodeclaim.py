@@ -152,16 +152,24 @@ class NodeClaimTerminationController(WatchController):
     name = "nodeclaim.termination"
     watch_kinds = ("nodeclaims",)
 
-    def __init__(self, cluster: ClusterState, actuator: Actuator):
+    def __init__(self, cluster: ClusterState, actuator: Actuator, factory=None):
         self.cluster = cluster
         self.actuator = actuator
+        # optional ProviderFactory: deletes route to the actuator that
+        # created the claim (IKS pool decrement vs VPC instance delete)
+        self.factory = factory
+
+    def _actuator_for(self, claim):
+        if self.factory is not None:
+            return self.factory.get_actuator_for_claim(claim)
+        return self.actuator
 
     def reconcile(self, key: str) -> Result:
         claim = self.cluster.get_nodeclaim(key)
         if claim is None or not claim.deleted:
             return Result()
         try:
-            self.actuator.delete_node(claim)
+            self._actuator_for(claim).delete_node(claim)
         except NodeClaimNotFoundError:
             pass   # instance verifiably gone -> release finalizer
         except CloudError as e:

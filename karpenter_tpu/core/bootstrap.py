@@ -169,3 +169,28 @@ runcmd:
   - install-cni {c.cni_plugin} {c.cni_version} --cluster-cidr {c.cluster_cidr}
   - systemctl enable --now kubelet
 """
+
+
+class IKSBootstrapProvider:
+    """iks-api bootstrap mode: workers register through the managed-cluster
+    API instead of cloud-init (ref AddWorkerToIKSCluster,
+    pkg/providers/iks/bootstrap/iks_api.go:53; cluster-config retrieval via
+    GetClusterConfig).  The IKS control plane owns kubelet config, so there
+    is no user-data to generate — registration is an API call that flips
+    the worker to deployed."""
+
+    def __init__(self, iks):
+        self.iks = iks
+
+    def cluster_config(self) -> ClusterConfig:
+        """Cluster connection details from the IKS API (ref iks.go:248
+        kubeconfig retrieval)."""
+        return ClusterConfig(
+            kubernetes_version=self.iks.kube_version,
+            api_endpoint=f"https://{self.iks.cluster_id}.iks.example.com:30090")
+
+    def register_worker(self, worker_id: str) -> None:
+        """(ref iks_api.go:53) — the managed plane provisions kubelet;
+        completion surfaces as worker state=deployed."""
+        self.iks.get_worker(worker_id)       # not-found propagates
+        self.iks.deploy_worker(worker_id)

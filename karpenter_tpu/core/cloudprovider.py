@@ -30,9 +30,11 @@ PROVIDER_NAME = "karpenter-tpu"
 
 class CloudProvider:
     def __init__(self, cluster: ClusterState, actuator: Actuator,
-                 instance_types: InstanceTypeProvider):
+                 instance_types: InstanceTypeProvider, factory=None):
         self.cluster = cluster
         self.actuator = actuator
+        # optional ProviderFactory for per-NodeClass VPC/IKS routing
+        self.factory = factory
         self.instance_types = instance_types
 
     # -- identity ----------------------------------------------------------
@@ -48,12 +50,16 @@ class CloudProvider:
     def create(self, planned: PlannedNode, nodeclass: NodeClass,
                catalog: CatalogArrays, nodepool_name: str = "default") -> NodeClaim:
         """(cloudprovider.go:249-501 — gates live in Actuator.create_node)"""
-        return self.actuator.create_node(planned, nodeclass, catalog, nodepool_name)
+        actuator = self.factory.get_actuator(nodeclass) \
+            if self.factory is not None else self.actuator
+        return actuator.create_node(planned, nodeclass, catalog, nodepool_name)
 
     def delete(self, claim: NodeClaim) -> None:
         """Raises NodeClaimNotFoundError once the instance is verifiably
         gone — the finalizer-release contract (cloudprovider.go:503)."""
-        self.actuator.delete_node(claim)
+        actuator = self.factory.get_actuator_for_claim(claim) \
+            if self.factory is not None else self.actuator
+        actuator.delete_node(claim)
 
     def get(self, provider_id: str) -> Optional[NodeClaim]:
         """Resolve a providerID back to a live NodeClaim

@@ -79,7 +79,7 @@ class ClusterState:
         self._rv = 0
         self._collections: Dict[str, Dict[str, Any]] = {
             "pods": {}, "nodes": {}, "nodeclaims": {}, "nodeclasses": {},
-            "nodepools": {},
+            "nodepools": {}, "lbregistrations": {},
         }
         self._watchers: Dict[str, List[Callable[[str, Any], None]]] = defaultdict(list)
         self.events: List[Event] = []

@@ -56,10 +56,14 @@ def make_solver(options: SolverOptions):
 
 class Provisioner:
     def __init__(self, cluster: ClusterState, catalog_provider: InstanceTypeProvider,
-                 actuator: Actuator, options: Optional[ProvisionerOptions] = None):
+                 actuator: Actuator, options: Optional[ProvisionerOptions] = None,
+                 factory=None):
         self.cluster = cluster
         self.catalog_provider = catalog_provider
         self.actuator = actuator
+        # optional ProviderFactory: per-NodeClass VPC/IKS actuation selection
+        # (ref factory.go:70); without one, the VPC actuator serves all
+        self.factory = factory
         self.options = options or ProvisionerOptions()
         self.solver = make_solver(self.options.solver)
         self._catalog_cache: Dict[Tuple, CatalogArrays] = {}
@@ -187,7 +191,9 @@ class Provisioner:
             plan = self.solver.solve(SolveRequest(pool_pods, catalog, pool))
             if not plan.nodes:
                 continue
-            claims, errors = self.actuator.execute_plan(
+            actuator = self.factory.get_actuator(nodeclass) \
+                if self.factory is not None else self.actuator
+            claims, errors = actuator.execute_plan(
                 plan, nodeclass, catalog, pool.name)
             # nominate pods onto successfully-created claims (positional)
             for node, claim in zip(plan.nodes, claims):

@@ -1,0 +1,354 @@
+"""IKS worker-pool actuation, provider factory, pool cleanup, and load
+balancer integration tests (SURVEY.md §2.4 iks/workerpool, loadbalancer;
+§2.5 iks/poolcleanup, nodeclaim/loadbalancer)."""
+
+import time
+
+import pytest
+
+from karpenter_tpu.apis.nodeclass import (
+    DynamicPoolConfig, HealthCheck, LoadBalancerIntegration, LoadBalancerTarget,
+    NodeClass, NodeClassSpec,
+)
+from karpenter_tpu.catalog import CatalogArrays, InstanceTypeProvider, PricingProvider
+from karpenter_tpu.cloud.errors import CloudError, NodeClaimNotFoundError
+from karpenter_tpu.cloud.fake import FakeCloud
+from karpenter_tpu.cloud.fake_iks import FakeIKS
+from karpenter_tpu.cloud.loadbalancer import (
+    FakeLoadBalancers, LoadBalancerProvider, validate_integration,
+)
+from karpenter_tpu.controllers.iks import PoolCleanupController
+from karpenter_tpu.controllers.loadbalancer import LoadBalancerController
+from karpenter_tpu.controllers.nodeclaim import RegistrationController
+from karpenter_tpu.core import Actuator, ClusterState
+from karpenter_tpu.core.bootstrap import IKSBootstrapProvider
+from karpenter_tpu.core.factory import MODE_IKS, MODE_VPC, ProviderFactory, determine_mode
+from karpenter_tpu.core.kubelet import FakeKubelet
+from karpenter_tpu.core.workerpool import WorkerPoolActuator, sanitize_pool_name
+from karpenter_tpu.solver.types import PlannedNode
+
+
+def iks_nodeclass(name="iks", dynamic=True, **kw) -> NodeClass:
+    nc = NodeClass(name=name, spec=NodeClassSpec(
+        region="us-south", image="img-1", instance_profile="bx2-4x16",
+        bootstrap_mode="iks-api", iks_cluster_id="cls-1",
+        iks_dynamic_pools=DynamicPoolConfig(
+            enabled=dynamic, pool_name_prefix="kp",
+            empty_pool_ttl_seconds=1) if dynamic else None, **kw))
+    nc.status.resolved_image_id = "img-1"
+    nc.status.set_condition("Ready", "True", "Validated")
+    return nc
+
+
+@pytest.fixture
+def iks_rig():
+    cloud = FakeCloud()
+    iks = FakeIKS("cls-1", cloud)
+    pricing = PricingProvider(cloud)
+    itp = InstanceTypeProvider(cloud, pricing)
+    cluster = ClusterState()
+    from karpenter_tpu.core import CircuitBreakerConfig, CircuitBreakerManager
+    actuator = WorkerPoolActuator(iks, cluster, breaker=CircuitBreakerManager(
+        CircuitBreakerConfig(rate_limit_per_minute=1000,
+                             max_concurrent_instances=1000)))
+    catalog = CatalogArrays.build(itp.list())
+    yield cloud, iks, cluster, actuator, catalog
+    pricing.close()
+
+
+def planned(catalog, profile="bx2-4x16", zone="us-south-1", cap="on-demand"):
+    o = catalog.find_offering(profile, zone, cap)
+    return PlannedNode(profile, zone, cap, price=0.2, offering_index=o)
+
+
+class TestPoolNaming:
+    def test_sanitize(self):
+        assert sanitize_pool_name("kp-bx2-4x16") == "kp-bx2-4x16"
+        assert sanitize_pool_name("KP_bx2.4x16!") == "kp-bx2-4x16"
+        assert sanitize_pool_name("9starts-with-digit") == "kp-9starts-with-digit"
+        assert len(sanitize_pool_name("x" * 100)) <= 31
+
+
+class TestWorkerPoolActuator:
+    def test_dynamic_pool_create_and_increment(self, iks_rig):
+        cloud, iks, cluster, actuator, catalog = iks_rig
+        nc = cluster.add_nodeclass(iks_nodeclass())
+        claim = actuator.create_node(planned(catalog), nc, catalog)
+        pools = iks.list_pools()
+        assert len(pools) == 1 and pools[0].dynamic
+        assert pools[0].flavor == "bx2-4x16"
+        assert len(iks.list_workers(pools[0].id)) == 1
+        assert cloud.instance_count() == 1
+        assert claim.provider_id.startswith("tpu:///us-south/")
+        # second create in the same zone reuses the pool
+        actuator.create_node(planned(catalog), nc, catalog)
+        assert len(iks.list_pools()) == 1
+        assert len(iks.list_workers(pools[0].id)) == 2
+        # a new zone joins the existing dynamic pool
+        actuator.create_node(planned(catalog, zone="us-south-2"), nc, catalog)
+        assert sorted(iks.list_pools()[0].zones) == ["us-south-1", "us-south-2"]
+
+    def test_static_pool_match_and_gating(self, iks_rig):
+        cloud, iks, cluster, actuator, catalog = iks_rig
+        # pre-existing admin pool
+        pool = iks.create_pool("ops-pool", "cx2-2x4", ["us-south-1"], 0)
+        nc = cluster.add_nodeclass(iks_nodeclass("static", dynamic=False))
+        claim = actuator.create_node(planned(catalog, "cx2-2x4"), nc, catalog)
+        assert claim.annotations["karpenter-tpu.sh/iks-pool-id"] == pool.id
+        # no pool + dynamic disabled -> hard error
+        with pytest.raises(CloudError, match="dynamic pools disabled"):
+            actuator.create_node(planned(catalog, "mx2-2x16"), nc, catalog)
+
+    def test_explicit_pool_pin(self, iks_rig):
+        cloud, iks, cluster, actuator, catalog = iks_rig
+        pool = iks.create_pool("pinned", "bx2-4x16", ["us-south-1"], 0)
+        nc = iks_nodeclass("pinned")
+        nc.spec.iks_worker_pool_id = pool.id
+        cluster.add_nodeclass(nc)
+        claim = actuator.create_node(planned(catalog), nc, catalog)
+        assert claim.annotations["karpenter-tpu.sh/iks-pool-id"] == pool.id
+
+    def test_delete_decrements_and_finalizes(self, iks_rig):
+        cloud, iks, cluster, actuator, catalog = iks_rig
+        nc = cluster.add_nodeclass(iks_nodeclass())
+        claim = actuator.create_node(planned(catalog), nc, catalog)
+        assert cloud.instance_count() == 1
+        with pytest.raises(NodeClaimNotFoundError):
+            actuator.delete_node(claim)
+        assert cloud.instance_count() == 0
+        assert not iks.list_workers()
+
+    def test_atomic_increment_is_race_free(self, iks_rig):
+        """Concurrent increments never lose a worker (ref iks.go:406)."""
+        import threading
+        cloud, iks, cluster, actuator, catalog = iks_rig
+        pool = iks.create_pool("racy", "bx2-4x16", ["us-south-1"], 0)
+        n, errs = 16, []
+
+        def inc():
+            try:
+                iks.increment_pool(pool.id, "us-south-1")
+            except Exception as e:  # noqa: BLE001
+                errs.append(e)
+
+        threads = [threading.Thread(target=inc) for _ in range(n)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert not errs
+        assert len(iks.list_workers(pool.id)) == n
+        assert iks.get_pool(pool.id).size_per_zone == n
+
+    def test_pool_name_collision_disambiguates_flavor(self, iks_rig):
+        """Truncation collisions must never provision the wrong flavor."""
+        cloud, iks, cluster, actuator, catalog = iks_rig
+        nc = iks_nodeclass("long")
+        nc.spec.iks_dynamic_pools = DynamicPoolConfig(
+            enabled=True, pool_name_prefix="a-very-long-pool-prefix-name",
+            empty_pool_ttl_seconds=600)
+        cluster.add_nodeclass(nc)
+        actuator.create_node(planned(catalog, "bx2-4x16"), nc, catalog)
+        actuator.create_node(planned(catalog, "bx2-8x32"), nc, catalog)
+        pools = iks.list_pools()
+        assert len(pools) == 2                      # collision split
+        assert {p.flavor for p in pools} == {"bx2-4x16", "bx2-8x32"}
+        for p in pools:
+            workers = iks.list_workers(p.id)
+            assert all(cloud.get_instance(w.instance_id).profile == p.flavor
+                       for w in workers)
+
+    def test_iks_bootstrap_provider(self, iks_rig):
+        cloud, iks, cluster, actuator, catalog = iks_rig
+        nc = cluster.add_nodeclass(iks_nodeclass())
+        claim = actuator.create_node(planned(catalog), nc, catalog)
+        bp = IKSBootstrapProvider(iks)
+        cfg = bp.cluster_config()
+        assert "cls-1" in cfg.api_endpoint
+        worker_id = claim.annotations["karpenter-tpu.sh/iks-worker-id"]
+        bp.register_worker(worker_id)
+        assert iks.get_worker(worker_id).state == "deployed"
+
+
+class TestProviderFactory:
+    def test_mode_selection(self):
+        assert determine_mode(iks_nodeclass(), env={}) == MODE_IKS
+        vpc_nc = NodeClass(name="v", spec=NodeClassSpec(
+            region="us-south", instance_profile="bx2-4x16", image="img-1"))
+        assert determine_mode(vpc_nc, env={}) == MODE_VPC
+        assert determine_mode(vpc_nc, env={"IKS_CLUSTER_ID": "c"}) == MODE_IKS
+        nc2 = NodeClass(name="c", spec=NodeClassSpec(
+            region="us-south", instance_profile="bx2-4x16", image="img-1",
+            iks_cluster_id="cls-9"))
+        assert determine_mode(nc2, env={}) == MODE_IKS
+
+    def test_factory_routes_actuators(self, iks_rig):
+        cloud, iks, cluster, wp_actuator, catalog = iks_rig
+        vpc_actuator = Actuator(cloud, cluster)
+        factory = ProviderFactory(vpc_actuator, wp_actuator, env={})
+        assert factory.get_actuator(iks_nodeclass()) is wp_actuator
+        vpc_nc = NodeClass(name="v", spec=NodeClassSpec(
+            region="us-south", instance_profile="bx2-4x16", image="img-1"))
+        assert factory.get_actuator(vpc_nc) is vpc_actuator
+        # missing IKS wiring falls back to VPC
+        factory2 = ProviderFactory(vpc_actuator, None, env={})
+        assert factory2.get_actuator(iks_nodeclass()) is vpc_actuator
+
+
+class TestPoolCleanup:
+    def test_empty_dynamic_pool_reaped_after_ttl(self, iks_rig):
+        cloud, iks, cluster, actuator, catalog = iks_rig
+        nc = cluster.add_nodeclass(iks_nodeclass())   # ttl=1s
+        claim = actuator.create_node(planned(catalog), nc, catalog)
+        ctrl = PoolCleanupController(cluster, iks)
+        ctrl.reconcile()
+        assert len(iks.list_pools()) == 1     # has a worker -> kept
+        with pytest.raises(NodeClaimNotFoundError):
+            actuator.delete_node(claim)
+        ctrl.reconcile()                      # starts the empty clock
+        assert len(iks.list_pools()) == 1     # within TTL
+        time.sleep(1.1)
+        ctrl.reconcile()
+        assert len(iks.list_pools()) == 0
+
+    def test_static_and_retain_pools_kept(self, iks_rig):
+        cloud, iks, cluster, actuator, catalog = iks_rig
+        iks.create_pool("admin", "bx2-4x16", ["us-south-1"], 0)   # static
+        nc = iks_nodeclass("retain")
+        nc.spec.iks_dynamic_pools = DynamicPoolConfig(
+            enabled=True, pool_name_prefix="kp", empty_pool_ttl_seconds=0,
+            cleanup_policy="Retain")
+        cluster.add_nodeclass(nc)
+        # different flavor so the static pool can't satisfy the create
+        claim = actuator.create_node(planned(catalog, "cx2-2x4"), nc, catalog)
+        with pytest.raises(NodeClaimNotFoundError):
+            actuator.delete_node(claim)
+        ctrl = PoolCleanupController(cluster, iks)
+        ctrl.reconcile()
+        time.sleep(0.05)
+        ctrl.reconcile()
+        assert len(iks.list_pools()) == 2     # both survive
+
+
+# ---------------------------------------------------------------------------
+# Load balancer
+# ---------------------------------------------------------------------------
+
+def lb_integration(**kw) -> LoadBalancerIntegration:
+    return LoadBalancerIntegration(
+        enabled=True,
+        target_groups=(LoadBalancerTarget(
+            load_balancer_id="lb-1", pool_name="web", port=443,
+            health_check=HealthCheck(protocol="tcp", port=443)),),
+        **kw)
+
+
+class TestLoadBalancer:
+    def test_validation(self):
+        assert validate_integration(LoadBalancerIntegration()) == []
+        bad = LoadBalancerIntegration(enabled=True, target_groups=(
+            LoadBalancerTarget(load_balancer_id="", pool_name="", port=0,
+                               weight=200),))
+        errs = validate_integration(bad)
+        assert len(errs) == 4
+        bad_hc = LoadBalancerIntegration(enabled=True, target_groups=(
+            LoadBalancerTarget(load_balancer_id="lb", pool_name="p", port=80,
+                               health_check=HealthCheck(protocol="udp",
+                                                        interval=1, timeout=5)),))
+        assert any("protocol" in e for e in validate_integration(bad_hc))
+        assert any("timing" in e for e in validate_integration(bad_hc))
+
+    def test_register_wait_healthy_and_deregister(self):
+        lbs = FakeLoadBalancers()
+        provider = LoadBalancerProvider(lbs)
+        integ = lb_integration()
+        ids = provider.register_instance(integ, "10.0.0.5", wait_healthy=True)
+        assert len(ids) == 1
+        pool = lbs.get_pool("lb-1", "web")
+        assert len(pool.members) == 1
+        assert pool.health_check.port == 443
+        # idempotent re-register
+        provider.register_instance(integ, "10.0.0.5")
+        assert len(pool.members) == 1
+        assert provider.deregister_instance(integ, "10.0.0.5") == 1
+        assert len(pool.members) == 0
+
+    def test_controller_registers_on_node_join(self):
+        cloud = FakeCloud()
+        pricing = PricingProvider(cloud)
+        itp = InstanceTypeProvider(cloud, pricing)
+        cluster = ClusterState()
+        actuator = Actuator(cloud, cluster)
+        nc = NodeClass(name="lbnc", spec=NodeClassSpec(
+            region="us-south", instance_profile="bx2-4x16", image="img-1",
+            load_balancer_integration=lb_integration()))
+        nc.status.resolved_image_id = "img-1"
+        nc.status.set_condition("Ready", "True", "Validated")
+        cluster.add_nodeclass(nc)
+        catalog = CatalogArrays.build(itp.list())
+        claim = actuator.create_node(planned(catalog), nc, catalog,
+                                     nodepool_name="default")
+        lbs = FakeLoadBalancers()
+        ctrl = LoadBalancerController(cluster, LoadBalancerProvider(lbs))
+        ctrl.reconcile(claim.name)
+        assert (  # not registered: node hasn't joined
+            "lb-1", "web") not in lbs.pools or not lbs.pools[("lb-1", "web")].members
+        kubelet = FakeKubelet(cluster)
+        node = kubelet.join(claim, ready=True)
+        RegistrationController(cluster).reconcile(claim.name)
+        ctrl.reconcile(claim.name)
+        pool = lbs.get_pool("lb-1", "web")
+        assert len(pool.members) == 1
+        assert list(pool.members.values())[0].address == node.addresses[0]
+        # claim deletion deregisters (auto_deregister default true)
+        cluster.delete("nodeclaims", claim.name)
+        ctrl.reconcile(claim.name)
+        assert len(pool.members) == 0
+        pricing.close()
+
+    def test_membership_sweep_removes_stale(self):
+        """Restart safety: recorded memberships for dead claims are swept,
+        but operator-added members in the same pool are never touched."""
+        from karpenter_tpu.apis.nodeclaim import NodeClaim
+        from karpenter_tpu.controllers.loadbalancer import (
+            LBMembershipSweeper, LBRegistration,
+        )
+        cluster = ClusterState()
+        lbs = FakeLoadBalancers()
+        provider = LoadBalancerProvider(lbs)
+        integ = lb_integration()
+        # operator-added backend karpenter knows nothing about
+        provider.register_instance(integ, "192.168.1.5")
+        # recorded registration for a dead claim
+        provider.register_instance(integ, "10.0.0.77")
+        cluster.add("lbregistrations", "dead-claim", LBRegistration(
+            name="dead-claim", address="10.0.0.77",
+            targets=tuple(integ.target_groups)))
+        # recorded registration for a live claim
+        provider.register_instance(integ, "10.0.0.88")
+        cluster.add_nodeclaim(NodeClaim(name="live-claim"))
+        cluster.add("lbregistrations", "live-claim", LBRegistration(
+            name="live-claim", address="10.0.0.88",
+            targets=tuple(integ.target_groups)))
+        LBMembershipSweeper(cluster, provider).reconcile()
+        addrs = {m.address for m in lbs.get_pool("lb-1", "web").members.values()}
+        assert addrs == {"192.168.1.5", "10.0.0.88"}
+        assert cluster.get("lbregistrations", "dead-claim") is None
+
+    def test_termination_routes_iks_claims_through_pool(self, iks_rig):
+        """Factory delete routing: an IKS-created claim must be torn down by
+        pool decrement, not a raw VPC instance delete."""
+        from karpenter_tpu.controllers.nodeclaim import NodeClaimTerminationController
+        cloud, iks, cluster, wp_actuator, catalog = iks_rig
+        vpc_actuator = Actuator(cloud, cluster)
+        factory = ProviderFactory(vpc_actuator, wp_actuator, env={})
+        nc = cluster.add_nodeclass(iks_nodeclass())
+        claim = wp_actuator.create_node(planned(catalog), nc, catalog)
+        pool_id = claim.annotations["karpenter-tpu.sh/iks-pool-id"]
+        claim.deleted = True
+        ctrl = NodeClaimTerminationController(cluster, vpc_actuator,
+                                              factory=factory)
+        ctrl.reconcile(claim.name)
+        assert cluster.get_nodeclaim(claim.name) is None
+        assert iks.list_workers(pool_id) == []     # pool bookkeeping clean
+        assert cloud.instance_count() == 0
