@@ -16,7 +16,7 @@ from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Tuple
 
 from karpenter_tpu.apis.nodeclass import HealthCheck, LoadBalancerIntegration, LoadBalancerTarget
-from karpenter_tpu.cloud.errors import CloudError, not_found
+from karpenter_tpu.cloud.errors import CloudError, is_not_found, not_found
 from karpenter_tpu.utils import metrics
 from karpenter_tpu.utils.logging import get_logger
 
@@ -161,16 +161,27 @@ class LoadBalancerProvider:
 
     def deregister_instance(self, integration: LoadBalancerIntegration,
                             address: str) -> int:
-        removed = 0
-        for tg in integration.target_groups:
+        removed, _ = self.remove_targets(integration.target_groups, address)
+        return removed
+
+    def remove_targets(self, targets, address: str) -> Tuple[int, int]:
+        """Remove ``address`` from each target pool; returns
+        (members_removed, failures).  A non-zero failure count means the
+        caller must retry — the member may still be serving traffic."""
+        removed = failures = 0
+        for tg in targets:
             try:
                 removed += self.lbs.remove_member(tg.load_balancer_id,
                                                   tg.pool_name, address)
                 metrics.API_REQUESTS.labels("lb", "remove_member", "ok").inc()
             except CloudError as e:
+                if is_not_found(e):
+                    continue   # pool gone = nothing left to remove
+                failures += 1
+                metrics.API_REQUESTS.labels("lb", "remove_member", "error").inc()
                 log.warning("deregister failed", lb=tg.load_balancer_id,
                             pool=tg.pool_name, error=str(e))
-        return removed
+        return removed, failures
 
     def _wait_healthy(self, member: PoolMember, timeout: float) -> None:
         deadline = time.time() + timeout

@@ -33,10 +33,17 @@ class PoolCleanupController(PollController):
         self._empty_since: Dict[str, float] = {}
 
     def _policy_for(self, pool) -> tuple:
-        """(ttl, policy) from the NodeClass that owns this dynamic pool's
-        prefix, else controller defaults.  Pool names were created through
-        sanitize_pool_name, so the prefix must be compared sanitized too."""
-        from karpenter_tpu.core.workerpool import sanitize_pool_name
+        """(ttl, policy) from the NodeClass that owns this dynamic pool —
+        resolved by the ownership label stamped at creation (immune to name
+        sanitization/collision-disambiguation), with a sanitized-prefix
+        match as fallback for pools from before the label existed."""
+        from karpenter_tpu.core.workerpool import LABEL_OWNER_NODECLASS, sanitize_pool_name
+        owner = pool.labels.get(LABEL_OWNER_NODECLASS, "")
+        if owner:
+            nc = self.cluster.get("nodeclasses", owner)
+            if nc is not None and nc.spec.iks_dynamic_pools is not None:
+                dyn = nc.spec.iks_dynamic_pools
+                return float(dyn.empty_pool_ttl_seconds), dyn.cleanup_policy
         for nc in self.cluster.list("nodeclasses"):
             dyn = nc.spec.iks_dynamic_pools
             if dyn is not None and dyn.enabled and \

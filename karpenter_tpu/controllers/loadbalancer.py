@@ -98,25 +98,18 @@ class LoadBalancerController(WatchController):
         if record is None:
             return Result()
         if record.auto_deregister:
-            removed = _remove_membership(self.provider, record)
+            removed, failures = self.provider.remove_targets(record.targets,
+                                                             record.address)
+            if failures:
+                # keep the record: a leaked member keeps routing traffic to
+                # a dead backend, and the sweeper can only retry what is
+                # still recorded
+                return Result(requeue_after=10.0)
             if removed:
                 self.cluster.record_event("NodeClaim", key, "Normal",
                                           "LBDeregistered", record.address)
         self.cluster.delete("lbregistrations", key)
         return Result()
-
-
-def _remove_membership(provider: LoadBalancerProvider,
-                       record: LBRegistration) -> int:
-    removed = 0
-    for tg in record.targets:
-        try:
-            removed += provider.lbs.remove_member(
-                tg.load_balancer_id, tg.pool_name, record.address)
-        except CloudError as e:
-            log.warning("LB member removal failed", pool=tg.pool_name,
-                        address=record.address, error=str(e))
-    return removed
 
 
 class LBMembershipSweeper(PollController):
@@ -140,7 +133,10 @@ class LBMembershipSweeper(PollController):
             if claim is not None and not claim.deleted:
                 continue
             if record.auto_deregister:
-                removed = _remove_membership(self.provider, record)
+                removed, failures = self.provider.remove_targets(
+                    record.targets, record.address)
+                if failures:
+                    continue   # keep the record; retry next sweep
                 if removed:
                     log.info("LB sweep removed stale membership",
                              claim=record.name, address=record.address)

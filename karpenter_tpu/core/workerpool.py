@@ -40,6 +40,7 @@ log = get_logger("core.workerpool")
 
 ANNOTATION_POOL_ID = "karpenter-tpu.sh/iks-pool-id"
 ANNOTATION_WORKER_ID = "karpenter-tpu.sh/iks-worker-id"
+LABEL_OWNER_NODECLASS = "karpenter-tpu.sh/nodeclass"
 
 _POOL_NAME_MAX = 31
 _POOL_NAME_RE = re.compile(r"[^a-z0-9-]+")
@@ -159,7 +160,11 @@ class WorkerPoolActuator:
             return existing
         return self.iks.create_pool(
             name=name, flavor=planned.instance_type, zones=[planned.zone],
-            size_per_zone=0, labels={"karpenter.sh/managed": "true"},
+            size_per_zone=0,
+            # ownership label: the cleanup controller resolves TTL/policy by
+            # owner, immune to name sanitization/disambiguation
+            labels={"karpenter.sh/managed": "true",
+                    LABEL_OWNER_NODECLASS: nodeclass.name},
             dynamic=True)
 
     # -- delete ------------------------------------------------------------

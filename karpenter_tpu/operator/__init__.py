@@ -1,0 +1,12 @@
+"""Operator layer: process wiring, layered options, credential store.
+
+The L0/L1 equivalent of the reference (``cmd/controller/main.go`` +
+``pkg/operator``): validates credentials, builds the shared providers and
+blackout cache, registers every controller, and runs the provisioning loop.
+"""
+
+from karpenter_tpu.operator.credentials import (  # noqa: F401
+    Credentials, CredentialStore, EnvCredentialProvider, StaticCredentialProvider,
+)
+from karpenter_tpu.operator.options import Options  # noqa: F401
+from karpenter_tpu.operator.operator import Operator  # noqa: F401

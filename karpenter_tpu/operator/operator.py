@@ -1,0 +1,173 @@
+"""Operator: full process wiring (the main() + pkg/operator equivalent).
+
+Mirrors the reference's startup chain (SURVEY.md §3.1): validate
+credentials (operator.go:80-97, process-fatal on failure) -> build the
+cloud client + shared ``UnavailableOfferings`` (operator.go:62-63) ->
+provider factory -> CloudProvider facade -> register the controller fleet
+(controllers.go:117-259, with the same env gates) -> start the manager and
+the provisioning loop.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+from karpenter_tpu.catalog.instancetype import InstanceTypeProvider
+from karpenter_tpu.catalog.pricing import PricingProvider
+from karpenter_tpu.catalog.unavailable import UnavailableOfferings
+from karpenter_tpu.cloud.fake import FakeCloud
+from karpenter_tpu.cloud.loadbalancer import LoadBalancerProvider
+from karpenter_tpu.controllers import ControllerManager
+from karpenter_tpu.controllers.faults import (
+    InstanceTypeRefreshController, InterruptionController, OrphanCleanupController,
+    PricingRefreshController, SpotPreemptionController,
+)
+from karpenter_tpu.controllers.iks import PoolCleanupController
+from karpenter_tpu.controllers.loadbalancer import (
+    LBMembershipSweeper, LoadBalancerController,
+)
+from karpenter_tpu.controllers.nodeclaim import (
+    GarbageCollectionController, NodeClaimTerminationController,
+    RegistrationController, StartupTaintController, TaggingController,
+)
+from karpenter_tpu.controllers.nodeclass import (
+    AutoplacementController, NodeClassHashController, NodeClassStatusController,
+    NodeClassTerminationController,
+)
+from karpenter_tpu.core.actuator import Actuator
+from karpenter_tpu.core.circuitbreaker import CircuitBreakerManager
+from karpenter_tpu.core.cloudprovider import CloudProvider
+from karpenter_tpu.core.cluster import ClusterState
+from karpenter_tpu.core.factory import ProviderFactory
+from karpenter_tpu.core.provisioner import Provisioner, ProvisionerOptions
+from karpenter_tpu.core.workerpool import WorkerPoolActuator
+from karpenter_tpu.operator.credentials import (
+    CredentialStore, EnvCredentialProvider, StaticCredentialProvider,
+)
+from karpenter_tpu.operator.options import Options
+from karpenter_tpu.utils.logging import get_logger
+
+log = get_logger("operator")
+
+
+class Operator:
+    """Builds and runs the whole control plane.
+
+    ``cloud``/``iks``/``lbs`` default to fakes (the simulation environment);
+    a real deployment injects live clients with the same surface.
+    """
+
+    def __init__(self, options: Optional[Options] = None, cloud=None,
+                 iks=None, lbs=None, credential_provider=None,
+                 cluster: Optional[ClusterState] = None):
+        self.options = options or Options.from_env()
+        errs = self.options.validate()
+        if errs:
+            raise ValueError("invalid options: " + "; ".join(errs))
+
+        # credential validation is boot-fatal (operator.go:80-97);
+        # programmatic options.api_key outranks the environment
+        if credential_provider is None and self.options.api_key:
+            credential_provider = StaticCredentialProvider(
+                self.options.api_key, self.options.region)
+        self.credentials = CredentialStore(
+            credential_provider or EnvCredentialProvider())
+        self.credentials.get()
+
+        self.cloud = cloud if cloud is not None else \
+            FakeCloud(region=self.options.region)
+        self.iks = iks
+        self.cluster = cluster or ClusterState()
+        self.unavailable = UnavailableOfferings()
+        self.pricing = PricingProvider(self.cloud)
+        self.instance_types = InstanceTypeProvider(
+            self.cloud, self.pricing, self.unavailable,
+            spot_discount_percent=self.options.spot_discount_percent)
+        self.breaker = CircuitBreakerManager(self.options.circuit_breaker)
+
+        self.actuator = Actuator(self.cloud, self.cluster,
+                                 breaker=self.breaker,
+                                 unavailable=self.unavailable)
+        iks_actuator = WorkerPoolActuator(
+            self.iks, self.cluster, breaker=self.breaker,
+            unavailable=self.unavailable) if self.iks is not None else None
+        # options.iks_cluster_id forces IKS mode (factory.go:128) — feed the
+        # factory an env view derived from options, not ambient os.environ
+        factory_env = {"IKS_CLUSTER_ID": self.options.iks_cluster_id} \
+            if self.options.iks_cluster_id else {}
+        self.factory = ProviderFactory(self.actuator, iks_actuator,
+                                       env=factory_env)
+        self.cloudprovider = CloudProvider(self.cluster, self.actuator,
+                                           self.instance_types,
+                                           factory=self.factory)
+        self.provisioner = Provisioner(
+            self.cluster, self.instance_types, self.actuator,
+            ProvisionerOptions(solver=self.options.solver,
+                               window=self.options.window),
+            factory=self.factory)
+        self.lb_provider = LoadBalancerProvider(lbs) if lbs is not None else None
+
+        self.manager = ControllerManager(self.cluster)
+        for ctrl in self._build_controllers():
+            self.manager.register(ctrl)
+        self._started = False
+
+    def _build_controllers(self) -> List:
+        """The reference's registration list (controllers.go:117-259) with
+        the same feature gates."""
+        ctrls = [
+            NodeClassHashController(self.cluster),
+            NodeClassStatusController(self.cluster, self.cloud,
+                                      subnet_provider=self.actuator.subnets,
+                                      image_resolver=self.actuator.images),
+            AutoplacementController(self.cluster, self.instance_types,
+                                    self.actuator.subnets),
+            NodeClassTerminationController(self.cluster),
+            RegistrationController(self.cluster),
+            StartupTaintController(self.cluster),
+            NodeClaimTerminationController(self.cluster, self.actuator,
+                                           factory=self.factory),
+            GarbageCollectionController(self.cluster, self.cloud),
+            TaggingController(self.cluster, self.cloud),
+            SpotPreemptionController(self.cluster, self.cloud,
+                                     self.unavailable),
+            InstanceTypeRefreshController(self.instance_types,
+                                          self.unavailable),
+            PricingRefreshController(self.pricing),
+        ]
+        if self.options.interruption_enabled:
+            ctrls.append(InterruptionController(self.cluster, self.unavailable))
+        # env-gated (controllers.go:238)
+        ctrls.append(OrphanCleanupController(
+            self.cluster, self.cloud,
+            enabled=self.options.orphan_cleanup_enabled))
+        if self.iks is not None:
+            ctrls.append(PoolCleanupController(self.cluster, self.iks))
+        if self.lb_provider is not None:
+            ctrls.append(LoadBalancerController(self.cluster, self.lb_provider))
+            ctrls.append(LBMembershipSweeper(self.cluster, self.lb_provider))
+        return ctrls
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self) -> None:
+        """Resync existing objects, then go live (watch threads + pollers +
+        the provisioning window)."""
+        if self._started:
+            return
+        self.manager.sync(rounds=1)    # restart = resume (SURVEY.md §5.4)
+        self.manager.start()
+        self.provisioner.start()
+        self._started = True
+        log.info("operator started",
+                 controllers=len(self.manager.controllers()),
+                 backend=self.options.solver.backend)
+
+    def stop(self) -> None:
+        if not self._started:
+            return
+        self.provisioner.stop()
+        self.manager.stop()
+        self.pricing.close()
+        self._started = False
+        log.info("operator stopped")

@@ -1,0 +1,105 @@
+"""Layered options/flag system.
+
+Capability parity with ``pkg/operator/options/options.go``: env + flag
+config with validation (:250) — interruption toggle, region/zone/resource
+group, ``SpotDiscountPercent`` (spot price = % of on-demand, default 60,
+:76), the full ``CIRCUIT_BREAKER_*`` env family (:154-221 — parsed by
+CircuitBreakerConfig.from_env), plus this build's solver block (backend,
+window) gated the same way so the default path stays untouched
+(SURVEY.md §5.6).
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass, field
+from typing import List, Mapping, Optional
+
+from karpenter_tpu.core.circuitbreaker import CircuitBreakerConfig
+from karpenter_tpu.core.window import WindowOptions
+from karpenter_tpu.solver.types import SolverOptions
+
+
+def _getf(env: Mapping[str, str], key: str, default: float) -> float:
+    try:
+        return float(env.get(key, default))
+    except (TypeError, ValueError):
+        return default
+
+
+def _geti(env: Mapping[str, str], key: str, default: int) -> int:
+    try:
+        return int(env.get(key, default))
+    except (TypeError, ValueError):
+        return default
+
+
+def _getb(env: Mapping[str, str], key: str, default: bool) -> bool:
+    raw = env.get(key)
+    if raw is None:
+        return default
+    return raw.lower() in ("1", "true", "yes", "on")
+
+
+@dataclass
+class Options:
+    # identity / placement (ref options.go:41-77)
+    region: str = ""
+    zone: str = ""
+    resource_group: str = ""
+    api_key: str = ""                 # cloud API credential (validated at boot)
+    iks_cluster_id: str = ""          # forces IKS mode when set (factory.go:128)
+
+    # behavior toggles
+    interruption_enabled: bool = True
+    orphan_cleanup_enabled: bool = False   # KARPENTER_ENABLE_ORPHAN_CLEANUP
+    spot_discount_percent: int = 60        # spot = % of on-demand (options.go:76)
+
+    # sub-configs
+    circuit_breaker: CircuitBreakerConfig = field(
+        default_factory=CircuitBreakerConfig)
+    solver: SolverOptions = field(default_factory=SolverOptions)
+    window: WindowOptions = field(default_factory=WindowOptions)
+
+    @classmethod
+    def from_env(cls, env: Optional[Mapping[str, str]] = None) -> "Options":
+        env = os.environ if env is None else env
+        solver = SolverOptions(
+            backend=env.get("KARPENTER_SOLVER_BACKEND", "jax"))
+        window = WindowOptions(
+            idle_seconds=_getf(env, "KARPENTER_WINDOW_IDLE_SECONDS", 1.0),
+            max_seconds=_getf(env, "KARPENTER_WINDOW_MAX_SECONDS", 10.0),
+            max_pods=_geti(env, "KARPENTER_WINDOW_MAX_PODS", 10000))
+        return cls(
+            region=env.get("TPU_CLOUD_REGION", env.get("IBMCLOUD_REGION", "")),
+            zone=env.get("TPU_CLOUD_ZONE", ""),
+            resource_group=env.get("TPU_CLOUD_RESOURCE_GROUP", ""),
+            api_key=env.get("TPU_CLOUD_API_KEY",
+                            env.get("IBMCLOUD_API_KEY", "")),
+            iks_cluster_id=env.get("IKS_CLUSTER_ID", ""),
+            interruption_enabled=_getb(env, "KARPENTER_ENABLE_INTERRUPTION",
+                                       True),
+            orphan_cleanup_enabled=_getb(env, "KARPENTER_ENABLE_ORPHAN_CLEANUP",
+                                         False),
+            spot_discount_percent=_geti(env, "KARPENTER_SPOT_DISCOUNT_PERCENT",
+                                        60),
+            circuit_breaker=CircuitBreakerConfig.from_env(env),
+            solver=solver, window=window)
+
+    def validate(self) -> List[str]:
+        """(ref options.go:250)"""
+        errs: List[str] = []
+        if not self.region:
+            errs.append("region is required (TPU_CLOUD_REGION)")
+        if self.zone and self.region and not self.zone.startswith(self.region):
+            errs.append(f"zone {self.zone!r} not in region {self.region!r}")
+        if not (0 <= self.spot_discount_percent <= 100):
+            errs.append("spot_discount_percent must be in [0, 100]")
+        if self.solver.backend not in ("greedy", "jax"):
+            errs.append(f"solver backend invalid: {self.solver.backend!r}")
+        if self.window.idle_seconds <= 0 or \
+                self.window.max_seconds < self.window.idle_seconds:
+            errs.append("window timing invalid (idle > 0, max >= idle)")
+        if self.window.max_pods < 1:
+            errs.append("window max_pods must be >= 1")
+        return errs

@@ -335,6 +335,62 @@ class TestLoadBalancer:
         assert addrs == {"192.168.1.5", "10.0.0.88"}
         assert cluster.get("lbregistrations", "dead-claim") is None
 
+    def test_failed_removal_keeps_record_for_retry(self):
+        """A transient LB error must not drop the durable record — the
+        member would leak forever (sweeper only retries recorded addresses)."""
+        from karpenter_tpu.apis.nodeclaim import NodeClaim
+        from karpenter_tpu.controllers.loadbalancer import (
+            LBMembershipSweeper, LBRegistration, LoadBalancerController,
+        )
+        cluster = ClusterState()
+        lbs = FakeLoadBalancers()
+        provider = LoadBalancerProvider(lbs)
+        integ = lb_integration()
+        provider.register_instance(integ, "10.0.0.50")
+        cluster.add("lbregistrations", "c1", LBRegistration(
+            name="c1", address="10.0.0.50", targets=tuple(integ.target_groups)))
+
+        # make removal fail transiently
+        real_remove = lbs.remove_member
+        fail = {"on": True}
+
+        def flaky(lb_id, pool_name, address):
+            if fail["on"]:
+                raise CloudError("lb api down", 503, retryable=True)
+            return real_remove(lb_id, pool_name, address)
+
+        lbs.remove_member = flaky
+        ctrl = LoadBalancerController(cluster, provider)
+        res = ctrl._deregister("c1")
+        assert res.requeue_after > 0
+        assert cluster.get("lbregistrations", "c1") is not None
+        LBMembershipSweeper(cluster, provider).reconcile()
+        assert cluster.get("lbregistrations", "c1") is not None
+        fail["on"] = False
+        ctrl._deregister("c1")
+        assert cluster.get("lbregistrations", "c1") is None
+        assert not lbs.get_pool("lb-1", "web").members
+
+    def test_disambiguated_pool_honors_owner_policy(self, iks_rig):
+        """Collision-renamed pools still resolve TTL/policy via the
+        ownership label."""
+        cloud, iks, cluster, actuator, catalog = iks_rig
+        nc = iks_nodeclass("own")
+        nc.spec.iks_dynamic_pools = DynamicPoolConfig(
+            enabled=True, pool_name_prefix="a-very-long-pool-prefix-name",
+            empty_pool_ttl_seconds=0, cleanup_policy="Retain")
+        cluster.add_nodeclass(nc)
+        c1 = actuator.create_node(planned(catalog, "bx2-4x16"), nc, catalog)
+        c2 = actuator.create_node(planned(catalog, "bx2-8x32"), nc, catalog)
+        for c in (c1, c2):
+            with pytest.raises(NodeClaimNotFoundError):
+                actuator.delete_node(c)
+        ctrl = PoolCleanupController(cluster, iks)
+        ctrl.reconcile()
+        time.sleep(0.05)
+        ctrl.reconcile()
+        assert len(iks.list_pools()) == 2   # Retain respected for BOTH names
+
     def test_termination_routes_iks_claims_through_pool(self, iks_rig):
         """Factory delete routing: an IKS-created claim must be torn down by
         pool decrement, not a raw VPC instance delete."""

@@ -1,0 +1,203 @@
+"""Operator wiring, layered options, and credential-store tests
+(SURVEY.md §2.1, §2.6 credentials, §5.6 config layering)."""
+
+import pytest
+
+from karpenter_tpu.apis.nodeclass import (
+    InstanceRequirements, NodeClass, NodeClassSpec, PlacementStrategy,
+)
+from karpenter_tpu.apis.pod import ResourceRequests, make_pods
+from karpenter_tpu.cloud.errors import CloudError
+from karpenter_tpu.cloud.fake_iks import FakeIKS
+from karpenter_tpu.core.kubelet import FakeKubelet
+from karpenter_tpu.operator import (
+    CredentialStore, EnvCredentialProvider, Operator, Options,
+    StaticCredentialProvider,
+)
+
+
+BASE_ENV = {"TPU_CLOUD_REGION": "us-south", "TPU_CLOUD_API_KEY": "k3y"}
+
+
+class TestOptions:
+    def test_from_env_layering(self):
+        env = {**BASE_ENV,
+               "KARPENTER_SPOT_DISCOUNT_PERCENT": "40",
+               "KARPENTER_ENABLE_ORPHAN_CLEANUP": "true",
+               "KARPENTER_ENABLE_INTERRUPTION": "false",
+               "KARPENTER_SOLVER_BACKEND": "greedy",
+               "KARPENTER_WINDOW_IDLE_SECONDS": "0.5",
+               "CIRCUIT_BREAKER_FAILURE_THRESHOLD": "7",
+               "IKS_CLUSTER_ID": "cls-42"}
+        opts = Options.from_env(env)
+        assert opts.region == "us-south"
+        assert opts.spot_discount_percent == 40
+        assert opts.orphan_cleanup_enabled and not opts.interruption_enabled
+        assert opts.solver.backend == "greedy"
+        assert opts.window.idle_seconds == 0.5
+        assert opts.circuit_breaker.failure_threshold == 7
+        assert opts.iks_cluster_id == "cls-42"
+        assert opts.validate() == []
+
+    def test_validation_catches_bad_config(self):
+        opts = Options.from_env({})
+        errs = opts.validate()
+        assert any("region" in e for e in errs)
+        opts2 = Options.from_env({**BASE_ENV, "TPU_CLOUD_ZONE": "eu-de-1"})
+        assert any("zone" in e for e in opts2.validate())
+        opts3 = Options.from_env(
+            {**BASE_ENV, "KARPENTER_SPOT_DISCOUNT_PERCENT": "150"})
+        assert any("spot_discount" in e for e in opts3.validate())
+        opts4 = Options.from_env(
+            {**BASE_ENV, "KARPENTER_SOLVER_BACKEND": "cuda"})
+        assert any("backend" in e for e in opts4.validate())
+
+    def test_bad_numeric_env_falls_back(self):
+        opts = Options.from_env(
+            {**BASE_ENV, "KARPENTER_SPOT_DISCOUNT_PERCENT": "lots"})
+        assert opts.spot_discount_percent == 60
+
+
+class TestCredentials:
+    def test_env_provider_and_encryption_roundtrip(self):
+        store = CredentialStore(EnvCredentialProvider(BASE_ENV))
+        creds = store.get()
+        assert creds.api_key == "k3y" and creds.region == "us-south"
+        # plaintext never sits in the store's attributes
+        import pickle
+        for name, value in vars(store).items():
+            if isinstance(value, (bytes, str)) and name != "_region":
+                assert b"k3y" not in (value if isinstance(value, bytes)
+                                      else value.encode())
+
+    def test_missing_key_is_fatal(self):
+        store = CredentialStore(EnvCredentialProvider(
+            {"TPU_CLOUD_REGION": "us-south"}))
+        with pytest.raises(CloudError, match="API key"):
+            store.get()
+
+    def test_ttl_refresh_and_invalidate(self):
+        calls = []
+
+        def provider():
+            calls.append(1)
+            from karpenter_tpu.operator.credentials import Credentials
+            return Credentials(api_key=f"k{len(calls)}", region="us-south")
+
+        clock = {"t": 0.0}
+        store = CredentialStore(provider, ttl=100.0, clock=lambda: clock["t"])
+        assert store.get().api_key == "k1"
+        assert store.get().api_key == "k1"     # cached
+        clock["t"] = 101.0
+        assert store.get().api_key == "k2"     # TTL refresh
+        store.invalidate()
+        assert store.get().api_key == "k3"     # forced
+
+    def test_static_base64_provider(self):
+        import base64
+        p = StaticCredentialProvider(
+            base64.b64encode(b"secret").decode(), "us-south",
+            base64_encoded=True)
+        assert p().api_key == "secret"
+
+
+class TestOperator:
+    def test_boot_fails_without_credentials(self):
+        with pytest.raises(CloudError):
+            Operator(Options.from_env(BASE_ENV),
+                     credential_provider=EnvCredentialProvider({}))
+
+    def test_boot_fails_on_invalid_options(self):
+        with pytest.raises(ValueError, match="invalid options"):
+            Operator(Options.from_env({"TPU_CLOUD_API_KEY": "k"}),
+                     credential_provider=EnvCredentialProvider(BASE_ENV))
+
+    def test_controller_fleet_and_gates(self):
+        op = Operator(Options.from_env(BASE_ENV),
+                      credential_provider=EnvCredentialProvider(BASE_ENV))
+        names = op.manager.controllers()
+        assert "nodeclass.status" in names and "interruption" in names
+        assert "iks.poolcleanup" not in names          # no IKS wired
+        assert "nodeclaim.loadbalancer" not in names   # no LB wired
+        op2 = Operator(
+            Options.from_env({**BASE_ENV,
+                              "KARPENTER_ENABLE_INTERRUPTION": "false"}),
+            credential_provider=EnvCredentialProvider(BASE_ENV))
+        assert "interruption" not in op2.manager.controllers()
+        op3 = Operator(Options.from_env(BASE_ENV),
+                       credential_provider=EnvCredentialProvider(BASE_ENV))
+        iks = FakeIKS("cls-1", op3.cloud)
+        op4 = Operator(Options.from_env(BASE_ENV), iks=iks,
+                       credential_provider=EnvCredentialProvider(BASE_ENV))
+        assert "iks.poolcleanup" in op4.manager.controllers()
+
+    def test_options_iks_cluster_id_forces_mode(self):
+        """options.iks_cluster_id must drive the factory without relying on
+        ambient os.environ (factory.go:128 parity)."""
+        from karpenter_tpu.core.workerpool import WorkerPoolActuator
+        env = {**BASE_ENV, "IKS_CLUSTER_ID": "cls-42"}
+        op = Operator(Options.from_env(env),
+                      credential_provider=EnvCredentialProvider(BASE_ENV))
+        iks = FakeIKS("cls-42", op.cloud)
+        op2 = Operator(Options.from_env(env), iks=iks,
+                       credential_provider=EnvCredentialProvider(BASE_ENV))
+        plain_nc = NodeClass(name="plain", spec=NodeClassSpec(
+            region="us-south", instance_profile="bx2-4x16", image="img-1"))
+        assert isinstance(op2.factory.get_actuator(plain_nc), WorkerPoolActuator)
+        op.pricing.close(); op2.pricing.close()
+
+    def test_options_api_key_feeds_credentials(self):
+        op = Operator(Options(region="us-south", api_key="prog-key"))
+        assert op.credentials.get().api_key == "prog-key"
+        op.pricing.close()
+
+    def test_spot_discount_flows_to_catalog(self):
+        env = {**BASE_ENV, "KARPENTER_SPOT_DISCOUNT_PERCENT": "30"}
+        op = Operator(Options.from_env(env),
+                      credential_provider=EnvCredentialProvider(env))
+        types = op.instance_types.list()
+        it = next(t for t in types if any(
+            o.capacity_type == "spot" for o in t.offerings))
+        od = next(o.price for o in it.offerings
+                  if o.capacity_type == "on-demand")
+        spot = next(o.price for o in it.offerings if o.capacity_type == "spot")
+        assert spot == pytest.approx(od * 0.30)
+        op.pricing.close()
+
+    def test_operator_end_to_end_live(self):
+        """Boot -> NodeClass Ready via controllers -> pods -> nodes -> all
+        initialized; the full wired loop."""
+        import time
+        env = {**BASE_ENV,
+               "KARPENTER_WINDOW_IDLE_SECONDS": "0.05",
+               "KARPENTER_WINDOW_MAX_SECONDS": "1.0",
+               "CIRCUIT_BREAKER_RATE_LIMIT_PER_MINUTE": "1000",
+               "CIRCUIT_BREAKER_MAX_CONCURRENT_INSTANCES": "1000"}
+        op = Operator(Options.from_env(env),
+                      credential_provider=EnvCredentialProvider(env))
+        nc = NodeClass(name="default", spec=NodeClassSpec(
+            region="us-south", image="img-1", vpc="vpc-1",
+            instance_requirements=InstanceRequirements(min_cpu=2),
+            placement_strategy=PlacementStrategy()))
+        op.cluster.add_nodeclass(nc)
+        op.start()
+        kubelet = FakeKubelet(op.cluster, op.cloud)
+        try:
+            for pod in make_pods(50, requests=ResourceRequests(500, 1024, 0, 1)):
+                op.cluster.add_pod(pod)
+            deadline = time.time() + 30
+            while time.time() < deadline:
+                kubelet.join_pending(ready=True)
+                pending = [p for p in op.cluster.pending_pods()
+                           if not p.nominated_node]
+                claims = op.cluster.nodeclaims()
+                if not pending and claims and \
+                        all(c.initialized for c in claims):
+                    break
+                time.sleep(0.1)
+            assert op.cluster.get_nodeclass("default").status.is_ready()
+            assert all(p.nominated_node for p in op.cluster.pending_pods())
+            claims = op.cluster.nodeclaims()
+            assert claims and all(c.initialized for c in claims)
+        finally:
+            op.stop()
