@@ -41,7 +41,8 @@ class PoolCleanupController(PollController):
         owner = pool.labels.get(LABEL_OWNER_NODECLASS, "")
         if owner:
             nc = self.cluster.get("nodeclasses", owner)
-            if nc is not None and nc.spec.iks_dynamic_pools is not None:
+            if nc is not None and nc.spec.iks_dynamic_pools is not None \
+                    and nc.spec.iks_dynamic_pools.enabled:
                 dyn = nc.spec.iks_dynamic_pools
                 return float(dyn.empty_pool_ttl_seconds), dyn.cleanup_policy
         for nc in self.cluster.list("nodeclasses"):

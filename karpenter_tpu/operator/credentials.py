@@ -39,6 +39,16 @@ class Credentials:
                              retryable=False)
 
 
+def resolve_api_key(env: Mapping[str, str]) -> str:
+    """Single source of truth for the API-key env fallback chain — shared
+    with Options.from_env so boot validation and options never diverge."""
+    return env.get("TPU_CLOUD_API_KEY", env.get("IBMCLOUD_API_KEY", ""))
+
+
+def resolve_region(env: Mapping[str, str]) -> str:
+    return env.get("TPU_CLOUD_REGION", env.get("IBMCLOUD_REGION", ""))
+
+
 class EnvCredentialProvider:
     """(ref credentials.go:283 env provider)"""
 
@@ -48,10 +58,8 @@ class EnvCredentialProvider:
     def __call__(self) -> Credentials:
         env = os.environ if self.env is None else self.env
         return Credentials(
-            api_key=env.get("TPU_CLOUD_API_KEY",
-                            env.get("IBMCLOUD_API_KEY", "")),
-            region=env.get("TPU_CLOUD_REGION",
-                           env.get("IBMCLOUD_REGION", "")),
+            api_key=resolve_api_key(env),
+            region=resolve_region(env),
             iks_api_key=env.get("TPU_CLOUD_IKS_API_KEY", ""))
 
 
@@ -97,7 +105,15 @@ class CredentialStore:
         with self._lock:
             if self._blob is None or \
                     self._clock() - self._fetched_at >= self._ttl:
-                self._refresh_locked()
+                try:
+                    self._refresh_locked()
+                except Exception:
+                    # transient provider failure at TTL expiry: serve the
+                    # still-valid cached credentials (the pricing-provider
+                    # stale-on-error posture); only fail with no cache
+                    if self._blob is None:
+                        raise
+                    log.warning("credential refresh failed; serving cached")
             return self._decrypt_locked()
 
     def invalidate(self) -> None:

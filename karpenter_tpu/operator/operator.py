@@ -164,10 +164,12 @@ class Operator:
                  backend=self.options.solver.backend)
 
     def stop(self) -> None:
+        # pricing spawns its batcher thread in __init__, so it must be
+        # closed even for a constructed-but-never-started operator
+        self.pricing.close()
         if not self._started:
             return
         self.provisioner.stop()
         self.manager.stop()
-        self.pricing.close()
         self._started = False
         log.info("operator stopped")

@@ -70,12 +70,14 @@ class Options:
             idle_seconds=_getf(env, "KARPENTER_WINDOW_IDLE_SECONDS", 1.0),
             max_seconds=_getf(env, "KARPENTER_WINDOW_MAX_SECONDS", 10.0),
             max_pods=_geti(env, "KARPENTER_WINDOW_MAX_PODS", 10000))
+        from karpenter_tpu.operator.credentials import (
+            resolve_api_key, resolve_region,
+        )
         return cls(
-            region=env.get("TPU_CLOUD_REGION", env.get("IBMCLOUD_REGION", "")),
+            region=resolve_region(env),
             zone=env.get("TPU_CLOUD_ZONE", ""),
             resource_group=env.get("TPU_CLOUD_RESOURCE_GROUP", ""),
-            api_key=env.get("TPU_CLOUD_API_KEY",
-                            env.get("IBMCLOUD_API_KEY", "")),
+            api_key=resolve_api_key(env),
             iks_cluster_id=env.get("IKS_CLUSTER_ID", ""),
             interruption_enabled=_getb(env, "KARPENTER_ENABLE_INTERRUPTION",
                                        True),

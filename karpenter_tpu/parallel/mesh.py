@@ -20,9 +20,12 @@ FLEET_AXIS = "fleet"
 OFFER_AXIS = "offer"
 
 
-def fleet_mesh(n_devices: Optional[int] = None) -> Mesh:
+def fleet_mesh(n_devices: Optional[int] = None,
+               devices: Optional[Sequence] = None) -> Mesh:
     """1D mesh over clusters (the v5e-8 fleet config of BASELINE.json #5)."""
-    devices = jax.devices()[:n_devices] if n_devices else jax.devices()
+    if devices is None:
+        devices = jax.devices()
+    devices = list(devices)[:n_devices] if n_devices else list(devices)
     return Mesh(np.array(devices), (FLEET_AXIS,))
 
 

@@ -260,7 +260,9 @@ def encode(pods: Sequence[PodSpec], catalog: CatalogArrays,
 
     for gi, g in enumerate(groups):
         req = g.representative.requests.as_tuple()
-        group_req[gi] = req
+        # every pod occupies >=1 pod slot: keeps per-node assignment counts
+        # bounded by the offering's pod-slot allocatable (int16 packing)
+        group_req[gi] = (req[0], req[1], req[2], max(req[3], 1))
         group_count[gi] = g.count
         group_cap[gi] = min(g.cap_per_node, np.iinfo(np.int32).max)
         # nozone_mask already folds label masks, availability, and

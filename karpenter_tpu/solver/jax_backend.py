@@ -38,6 +38,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from karpenter_tpu.solver.encode import BIG_CAP as BIG_CAP_I32
 from karpenter_tpu.solver.encode import EncodedProblem, encode
 from karpenter_tpu.solver.types import (
     GROUP_BUCKETS, NODE_BUCKETS, OFFERING_BUCKETS,
@@ -161,10 +162,11 @@ def solve_core(group_req, group_count, group_cap, compat,
     return node_off, assign, unplaced, cost
 
 
-@functools.partial(jax.jit, static_argnames=("num_nodes", "right_size"))
+@functools.partial(jax.jit,
+                   static_argnames=("num_nodes", "right_size", "assign_dtype"))
 def solve_kernel(group_req, group_count, group_cap, compat,
                  off_alloc, off_price, off_rank, *, num_nodes: int,
-                 right_size: bool = True):
+                 right_size: bool = True, assign_dtype: str = "int32"):
     """The full placement solve.
 
     Args (device, padded):
@@ -175,13 +177,17 @@ def solve_kernel(group_req, group_count, group_cap, compat,
                   size-based fallback for unpriced offerings)
     Returns:
       node_off  int32 [N] (-1 = unused slot)
-      assign    int32 [G, N] pods of group g on node n
+      assign    [G, N] pods of group g on node n, in ``assign_dtype``
+                (int16 when every offering's pod-slot capacity fits — the
+                dominant device->host transfer, halved for the tunnel)
       unplaced  int32 [G]
       cost      float32 scalar ($/h of open nodes)
     """
-    return solve_core(group_req, group_count, group_cap, compat,
-                      off_alloc, off_price, off_rank,
-                      num_nodes=num_nodes, right_size=right_size)
+    node_off, assign, unplaced, cost = solve_core(
+        group_req, group_count, group_cap, compat,
+        off_alloc, off_price, off_rank,
+        num_nodes=num_nodes, right_size=right_size)
+    return node_off, assign.astype(assign_dtype), unplaced, cost
 
 
 # ---------------------------------------------------------------------------
@@ -219,8 +225,10 @@ class JaxSolver:
         total_pods = int(problem.group_count.sum())
         G_pad = bucket(G, GROUP_BUCKETS) if self.options.bucket_groups else G
         O_pad = bucket(O, OFFERING_BUCKETS) if self.options.bucket_groups else O
-        N = min(self.options.max_nodes,
-                bucket(max(total_pods, 1), NODE_BUCKETS))
+        N_cap = min(self.options.max_nodes,
+                    bucket(max(total_pods, 1), NODE_BUCKETS))
+        N = self._estimate_nodes(problem, N_cap) if self.options.adaptive_nodes \
+            else N_cap
 
         group_req = _pad2(problem.group_req, G_pad)
         group_count = _pad1(problem.group_count, G_pad)
@@ -228,13 +236,54 @@ class JaxSolver:
         compat = _pad2(problem.compat, G_pad, O_pad)
         off_alloc, off_price, off_rank = self._device_offerings(catalog, O_pad)
 
-        node_off, assign, unplaced, cost = solve_kernel(
-            jnp.asarray(group_req), jnp.asarray(group_count),
-            jnp.asarray(group_cap), jnp.asarray(compat),
-            off_alloc, off_price, off_rank,
-            num_nodes=N, right_size=self.options.right_size)
-        return self._decode(problem, np.asarray(node_off), np.asarray(assign),
-                            np.asarray(unplaced), float(cost))
+        # Pack the assignment matrix (the dominant D2H transfer) into int16
+        # when per-node pod counts provably fit: every group requests >=1
+        # pod slot, so assign[g,n] <= the offering's pod-slot allocatable.
+        max_slots = int(catalog.offering_alloc()[:, 3].max()) if O else 1
+        assign_dtype = "int16" if max_slots < (1 << 15) else "int32"
+
+        while True:
+            out = solve_kernel(
+                jnp.asarray(group_req), jnp.asarray(group_count),
+                jnp.asarray(group_cap), jnp.asarray(compat),
+                off_alloc, off_price, off_rank,
+                num_nodes=N, right_size=self.options.right_size,
+                assign_dtype=assign_dtype)
+            # one pipelined fetch round: start all D2H copies, then read
+            for o in out:
+                o.copy_to_host_async()
+            node_off = np.asarray(out[0])
+            assign = np.asarray(out[1])
+            unplaced = np.asarray(out[2])
+            cost = float(out[3])
+            # escalate only when the node budget itself was the binding
+            # constraint (all slots open + pods left over)
+            if (int(unplaced.sum()) > 0 and int((node_off >= 0).sum()) >= N
+                    and N < N_cap):
+                N = min(N_cap, bucket(N * 4, NODE_BUCKETS))
+                continue
+            break
+        return self._decode(problem, node_off, assign.astype(np.int32),
+                            unplaced, cost)
+
+    @staticmethod
+    def _estimate_nodes(problem: EncodedProblem, n_cap: int) -> int:
+        """Static node-axis size: 2x the bin-packing lower bound (total
+        demand / best single-node capacity) plus headroom; FFD never exceeds
+        ~1.7x LB, and an in-kernel overflow triggers escalation anyway."""
+        catalog = problem.catalog
+        if catalog.num_offerings == 0:
+            return min(64, n_cap)
+        tot = (problem.group_req.astype(np.int64)
+               * problem.group_count[:, None]).sum(axis=0)          # [R]
+        best = catalog.offering_alloc().max(axis=0).astype(np.int64)  # [R]
+        lb = int(np.max(np.ceil(tot / np.maximum(best, 1))))
+        # per-node-capped groups (anti-affinity) need >= count/cap nodes
+        capped = problem.group_cap < BIG_CAP_I32
+        if capped.any():
+            lb = max(lb, int(np.max(np.ceil(
+                problem.group_count[capped] / problem.group_cap[capped]))))
+        return min(n_cap, bucket(max(2 * lb + 32, 64), NODE_BUCKETS))
 
     # -- internals ---------------------------------------------------------
 

@@ -26,6 +26,8 @@ class SolverOptions:
     max_nodes: int = 4096           # static bound on nodes per solve
     right_size: bool = True         # post-pass: re-pick cheapest fitting offering
     bucket_groups: bool = True      # pad G/O/N to pow2 buckets (avoid recompiles)
+    adaptive_nodes: bool = True     # size the node axis from the demand lower
+                                    # bound; escalate on in-kernel overflow
 
 
 @dataclass
@@ -94,4 +96,4 @@ def _next_pow2(n: int) -> int:
 
 GROUP_BUCKETS = (32, 64, 128, 256, 512, 1024, 2048)
 OFFERING_BUCKETS = (128, 256, 512, 1024, 2048, 4096)
-NODE_BUCKETS = (64, 256, 1024, 4096, 16384)
+NODE_BUCKETS = (64, 256, 1024, 2048, 4096, 8192, 16384)
