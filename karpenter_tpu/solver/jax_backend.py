@@ -112,8 +112,9 @@ def _ffd_step(off_alloc, off_rank, state, inputs):
     return (node_off, node_resid, ptr), (assign_g, unplaced_g)
 
 
-def _right_size(node_off, node_resid, assign, compat, off_alloc, off_rank):
-    """Per-node cheapest compatible offering that fits the final load.
+def _right_size(node_off, load, assign, compat, off_alloc, off_rank):
+    """Per-node cheapest compatible offering that fits the final load
+    (``load`` [N,R] = resources actually consumed on each node).
 
     Feasibility-preserving by construction: the load already fits and every
     group on the node admits the new offering (zone pins and availability
@@ -121,7 +122,6 @@ def _right_size(node_off, node_resid, assign, compat, off_alloc, off_rank):
     N = node_off.shape[0]
     is_open = node_off >= 0
     safe_off = jnp.clip(node_off, 0, None)
-    load = off_alloc[safe_off] - node_resid                  # [N, R]
     # group-presence [G,N] -> incompat counts [N,O] on the MXU
     present = (assign > 0).astype(jnp.float32)               # [G, N]
     incompat = (~compat).astype(jnp.float32)                 # [G, O]
@@ -135,10 +135,7 @@ def _right_size(node_off, node_resid, assign, compat, off_alloc, off_rank):
     best_price = jnp.min(cand_price, axis=1)
     cur_price = off_rank[safe_off]
     improve = is_open & (best_price < cur_price - 1e-9)
-    new_off = jnp.where(improve, best, node_off)
-    new_resid = jnp.where(improve[:, None], off_alloc[jnp.clip(new_off, 0, None)] - load,
-                          node_resid)
-    return new_off, new_resid
+    return jnp.where(improve, best, node_off)
 
 
 def solve_core(group_req, group_count, group_cap, compat,
@@ -155,8 +152,9 @@ def solve_core(group_req, group_count, group_cap, compat,
         step, (node_off0, node_resid0, jnp.int32(0)),
         (group_req, group_count, group_cap, compat))
     if right_size:
-        node_off, node_resid = _right_size(node_off, node_resid, assign,
-                                           compat, off_alloc, off_rank)
+        load = off_alloc[jnp.clip(node_off, 0, None)] - node_resid
+        node_off = _right_size(node_off, load, assign,
+                               compat, off_alloc, off_rank)
     is_open = node_off >= 0
     cost = jnp.sum(jnp.where(is_open, off_price[jnp.clip(node_off, 0, None)], 0.0))
     return node_off, assign, unplaced, cost
@@ -187,6 +185,37 @@ def solve_kernel(group_req, group_count, group_cap, compat,
         group_req, group_count, group_cap, compat,
         off_alloc, off_price, off_rank,
         num_nodes=num_nodes, right_size=right_size)
+    return node_off, assign.astype(assign_dtype), unplaced, cost
+
+
+@functools.partial(jax.jit, static_argnames=("G", "O", "N", "right_size",
+                                             "assign_dtype", "interpret"))
+def solve_kernel_pallas(meta, compat_i8, alloc8, rank_row, off_price, *,
+                        G: int, O: int, N: int, right_size: bool = True,
+                        assign_dtype: str = "int32",
+                        interpret: bool = False):
+    """Pallas-backed solve with the same output contract as solve_kernel.
+    The FFD scan runs as ONE Mosaic kernel (solver/pallas_kernel.py); the
+    right-sizing matmul pass and cost stay in XLA (MXU-friendly already)."""
+    from karpenter_tpu.solver.pallas_kernel import ffd_scan_pallas
+
+    # compat crosses the host->device boundary as int8 (4x smaller on the
+    # wire); the kernel wants the int32 tiling, cast on device
+    node_off, assign, unplaced = ffd_scan_pallas(
+        meta, compat_i8.astype(jnp.int32), alloc8, rank_row, G=G, O=O, N=N,
+        interpret=interpret)
+    if right_size:
+        compat = compat_i8 > 0
+        off_alloc = alloc8[:4].T                              # [O, R]
+        group_req = meta[:, :4]
+        # exact integer load: assign^T @ group_req on the MXU
+        load = jnp.einsum("gn,gr->nr", assign, group_req,
+                          preferred_element_type=jnp.int32)   # [N, R]
+        node_off = _right_size(node_off, load, assign, compat,
+                               off_alloc, rank_row[0])
+    is_open = node_off >= 0
+    cost = jnp.sum(jnp.where(is_open, off_price[jnp.clip(node_off, 0, None)],
+                             0.0))
     return node_off, assign.astype(assign_dtype), unplaced, cost
 
 
@@ -234,7 +263,6 @@ class JaxSolver:
         group_count = _pad1(problem.group_count, G_pad)
         group_cap = _pad1(problem.group_cap, G_pad)
         compat = _pad2(problem.compat, G_pad, O_pad)
-        off_alloc, off_price, off_rank = self._device_offerings(catalog, O_pad)
 
         # Pack the assignment matrix (the dominant D2H transfer) into int16
         # when per-node pod counts provably fit: every group requests >=1
@@ -243,12 +271,29 @@ class JaxSolver:
         assign_dtype = "int16" if max_slots < (1 << 15) else "int32"
 
         while True:
-            out = solve_kernel(
-                jnp.asarray(group_req), jnp.asarray(group_count),
-                jnp.asarray(group_cap), jnp.asarray(compat),
-                off_alloc, off_price, off_rank,
-                num_nodes=N, right_size=self.options.right_size,
-                assign_dtype=assign_dtype)
+            use_pallas = self._use_pallas(G_pad, O_pad, max(N, 128))
+            if use_pallas:
+                from karpenter_tpu.solver.pallas_kernel import pack_problem
+                N = max(N, 128)
+                meta, compat_i8 = pack_problem(group_req, group_count,
+                                               group_cap, compat)
+                alloc8, rank_row, price_dev = self._device_offerings_pallas(
+                    catalog, O_pad)
+                out = solve_kernel_pallas(
+                    jnp.asarray(meta), jnp.asarray(compat_i8),
+                    alloc8, rank_row, price_dev,
+                    G=G_pad, O=O_pad, N=N,
+                    right_size=self.options.right_size,
+                    assign_dtype=assign_dtype)
+            else:
+                off_alloc, off_price, off_rank = self._device_offerings(
+                    catalog, O_pad)
+                out = solve_kernel(
+                    jnp.asarray(group_req), jnp.asarray(group_count),
+                    jnp.asarray(group_cap), jnp.asarray(compat),
+                    off_alloc, off_price, off_rank,
+                    num_nodes=N, right_size=self.options.right_size,
+                    assign_dtype=assign_dtype)
             # one pipelined fetch round: start all D2H copies, then read
             for o in out:
                 o.copy_to_host_async()
@@ -287,17 +332,58 @@ class JaxSolver:
 
     # -- internals ---------------------------------------------------------
 
+    def _use_pallas(self, G_pad: int, O_pad: int, N: int) -> bool:
+        """Mosaic path: on by default on TPU backends, off on cpu/gpu
+        (no Mosaic), overridable via SolverOptions.use_pallas."""
+        from karpenter_tpu.solver.pallas_kernel import pallas_path_viable
+
+        mode = self.options.use_pallas
+        if mode == "off":
+            return False
+        if not pallas_path_viable(G_pad, O_pad, N):
+            return False
+        if mode == "on":
+            return True
+        return jax.default_backend() not in ("cpu", "gpu")
+
+    def _prune_device_catalog(self, catalog) -> None:
+        """Drop device tensors of stale catalog generations; both layouts
+        of the current generation stay resident."""
+        gen = (catalog.uid, catalog.generation,
+               catalog.availability_generation)
+        self._device_catalog = {
+            k: v for k, v in self._device_catalog.items()
+            if (k[1:4] if k[0] == "pallas" else k[:3]) == gen}
+
+    def _device_offerings_pallas(self, catalog, O_pad: int):
+        from karpenter_tpu.solver.pallas_kernel import pack_catalog
+
+        key = ("pallas", catalog.uid, catalog.generation,
+               catalog.availability_generation, O_pad)
+        cached = self._device_catalog.get(key)
+        if cached is None:
+            self._prune_device_catalog(catalog)
+            alloc8, rank_row = pack_catalog(
+                _pad2(catalog.offering_alloc().astype(np.int32), O_pad),
+                _pad1(catalog.offering_rank_price(), O_pad))
+            price = _pad1(catalog.off_price.astype(np.float32), O_pad)
+            cached = (jax.device_put(alloc8), jax.device_put(rank_row),
+                      jax.device_put(price))
+            self._device_catalog[key] = cached
+        return cached
+
     def _device_offerings(self, catalog, O_pad: int):
         key = (catalog.uid, catalog.generation, catalog.availability_generation,
                O_pad)
         cached = self._device_catalog.get(key)
         if cached is None:
+            self._prune_device_catalog(catalog)
             off_alloc = _pad2(catalog.offering_alloc().astype(np.int32), O_pad)
             off_price = _pad1(catalog.off_price.astype(np.float32), O_pad)
             off_rank = _pad1(catalog.offering_rank_price(), O_pad)
             cached = (jax.device_put(off_alloc), jax.device_put(off_price),
                       jax.device_put(off_rank))
-            self._device_catalog = {key: cached}   # keep only current generation
+            self._device_catalog[key] = cached
         return cached
 
     def _decode(self, problem: EncodedProblem, node_off, assign, unplaced,

@@ -1,0 +1,228 @@
+"""Pallas TPU kernel for the FFD placement scan.
+
+The lax.scan path (jax_backend.solve_core) emits ~25 small HLO ops per pod
+group; at G=64 groups the per-op dispatch overhead dominates the solve.
+This kernel runs the WHOLE scan as one Mosaic program with every tensor
+resident in VMEM — one launch, zero inter-op overhead.  Semantics are
+bit-identical to ``_ffd_step`` (same FFD order, same cheapest-per-pod
+offering choice, same first-fit node filling), asserted by the parity
+tests in tests/test_pallas.py.
+
+Layout (driven by TPU tiling rules — dynamic indexing is only legal on
+the sublane axis, so every per-node and per-offering tensor is laid out
+*wide*, with nodes/offerings on the lane axis):
+
+  group_meta  int32 [G, 8]   SMEM  (req_cpu, req_mem, req_gpu, req_pods,
+                                    count, cap, 0, 0) — scalar reads
+  compat      int32 [G, O]   VMEM  group x offering feasibility
+                                   (int32, not int8: dynamic sublane reads
+                                   need the (8,128) int32 tiling — int8
+                                   tiles are 32-sublane aligned)
+  off_alloc   int32 [8, O]   VMEM  rows 0..3 = per-resource allocatable
+  off_rank    f32   [1, O]   VMEM  ranking price
+  node state:
+    node_off  int32 [1, N]   (output; -1 = unused slot)
+    resid     int32 [8, N]   (scratch; rows 0..3 live)
+    gcompat   int32 [G, N]  (scratch; gcompat[g,n] = compat[g, off(n)],
+                              maintained incrementally as nodes open —
+                              this replaces the per-step gather
+                              ``compat_g[node_off]`` which TPU can't do)
+  outputs:
+    assign    int32 [G, N]; unplaced int32 [G, 128] (host reads col 0)
+
+Columns are extracted from wide tensors with masked lane-reductions
+(e.g. ``alloc_r = max(where(lane == best, off_alloc[r], 0))``) instead of
+dynamic lane slices, which Mosaic only allows at multiples of 128.
+
+Reference anchor: this is the TPU-native replacement for karpenter-core's
+``Scheduler.Solve`` greedy loop (SURVEY.md §3.2 hot path; the compatibility
+filter of cloudprovider.go:321-352 is pre-lowered into ``compat`` by
+solver/encode.py).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_BIG = 1 << 30  # plain int: jnp constants at module scope become captured consts
+
+# VMEM ceiling for the pallas path (bytes, conservative vs the ~16MB/core
+# budget — leaves room for Mosaic temporaries and double buffers).
+_VMEM_BUDGET = 10 * 1024 * 1024
+
+
+def pallas_path_viable(G: int, O: int, N: int) -> bool:
+    """Whether (padded) problem shapes fit the single-block kernel."""
+    if N % 128 != 0 or O % 128 != 0:
+        return False
+    vmem = (
+        G * O * 4        # compat int32
+        + G * N * 4      # gcompat int32
+        + 8 * N * 4      # resid
+        + 8 * O * 4      # off_alloc
+        + O * 4          # off_rank
+        + G * N * 4      # assign
+        + N * 4 * 6      # node_off + wide temporaries
+    )
+    return vmem <= _VMEM_BUDGET
+
+
+def _cumsum_lanes_excl(x):
+    """Exclusive cumsum along the lane axis of [1, N] via log-step rolls
+    (jnp.cumsum has no Mosaic lowering)."""
+    n = x.shape[1]
+    lane = jax.lax.broadcasted_iota(jnp.int32, x.shape, 1)
+    k = 1
+    while k < n:
+        x = x + jnp.where(lane >= k, pltpu.roll(x, k, 1), 0)
+        k *= 2
+    # inclusive -> exclusive: shift right by one lane
+    return jnp.where(lane >= 1, pltpu.roll(x, 1, 1), 0)
+
+
+def _rows_from_scalars(vals, rows, width):
+    """[rows, width] vector whose row r broadcasts scalar vals[r] (vals
+    shorter than rows pads with 0) — builds per-sublane divisors from SMEM
+    scalars without any gather."""
+    sub = jax.lax.broadcasted_iota(jnp.int32, (rows, width), 0)
+    out = jnp.zeros((rows, width), jnp.int32)
+    for r, v in enumerate(vals):
+        out = jnp.where(sub == r, v, out)
+    return out
+
+
+def _lane_pick(row, lane_idx, target):
+    """Scalar row[0, target] via masked reduction (dynamic lane slicing is
+    illegal off 128-boundaries)."""
+    return jnp.max(jnp.where(lane_idx == target, row, jnp.zeros_like(row)))
+
+
+def _ffd_kernel(meta_ref, compat_ref, alloc_ref, rank_ref,
+                node_off_ref, assign_ref, unplaced_ref,
+                resid_ref, gcompat_ref, *, G: int, O: int, N: int):
+    R = 4
+    laneN = jax.lax.broadcasted_iota(jnp.int32, (1, N), 1)
+    laneO = jax.lax.broadcasted_iota(jnp.int32, (1, O), 1)
+
+    # init state
+    node_off_ref[:] = jnp.full((1, N), -1, jnp.int32)
+    resid_ref[:] = jnp.zeros((8, N), jnp.int32)
+    gcompat_ref[:] = jnp.zeros((G, N), jnp.int32)
+
+    alloc = alloc_ref[:]                                   # [8, O]
+
+    def body(g, ptr):
+        req = [meta_ref[g, r] for r in range(R)]
+        count = meta_ref[g, 4]
+        cap = meta_ref[g, 5]
+        div = _rows_from_scalars(req, 8, 1)                # [8,1] divisors
+
+        # ---- fill open nodes, first-fit in age (lane) order ----
+        q = resid_ref[:] // jnp.maximum(div, 1)            # [8, N]
+        fit = jnp.min(jnp.where(div > 0, q, _BIG), axis=0,
+                      keepdims=True)                       # [1, N]
+        open_ok = gcompat_ref[pl.ds(g, 1), :] > 0          # [1, N]
+        fit = jnp.where(open_ok & (node_off_ref[:] >= 0), fit, 0)
+        fit = jnp.minimum(fit, cap)
+        cumfit = _cumsum_lanes_excl(fit)
+        take = jnp.clip(count - cumfit, 0, fit)            # [1, N]
+        placed = jnp.sum(take)
+        resid_ref[:] = resid_ref[:] - take * div           # bcast [8,N]
+        rem = count - placed
+
+        # ---- open new nodes with the cheapest-per-pod offering ----
+        qe = alloc // jnp.maximum(div, 1)                  # [8, O]
+        fit_e = jnp.min(jnp.where(div > 0, qe, _BIG), axis=0,
+                        keepdims=True)                     # [1, O]
+        ok = compat_ref[pl.ds(g, 1), :] > 0                # [1, O]
+        fit_e = jnp.minimum(jnp.where(ok, fit_e, 0), cap)
+        cpp = jnp.where(fit_e > 0,
+                        rank_ref[:] / fit_e.astype(jnp.float32),
+                        jnp.float32(jnp.inf))              # [1, O]
+        m = jnp.min(cpp)
+        best = jnp.min(jnp.where(cpp == m, laneO, _BIG))   # first argmin
+        bf = _lane_pick(fit_e, laneO, best)
+
+        n_new = jnp.where(bf > 0, -(-rem // jnp.maximum(bf, 1)), 0)
+        n_new = jnp.minimum(n_new, N - ptr)
+        new_pos = laneN - ptr
+        is_new = (new_pos >= 0) & (new_pos < n_new)
+        pods_new = jnp.where(is_new, jnp.clip(rem - new_pos * bf, 0, bf), 0)
+        opened = is_new & (pods_new > 0)                   # [1, N]
+
+        node_off_ref[:] = jnp.where(opened, best, node_off_ref[:])
+        a_vals = [_lane_pick(alloc[r:r + 1, :], laneO, best) for r in range(R)]
+        a_vec = _rows_from_scalars(a_vals, 8, 1)           # [8,1]
+        resid_ref[:] = jnp.where(opened, a_vec - pods_new * div, resid_ref[:])
+
+        # gcompat for newly-opened nodes = compat[:, best] column,
+        # extracted per the same masked-reduction trick, all groups at once
+        hit = (jax.lax.broadcasted_iota(jnp.int32, (G, O), 1) == best) \
+            & (compat_ref[:] > 0)
+        col = jnp.max(hit.astype(jnp.int32), axis=1, keepdims=True)  # [G,1]
+        gcompat_ref[:] = jnp.where(opened, col, gcompat_ref[:])
+
+        assign_ref[pl.ds(g, 1), :] = take + pods_new
+        unplaced_ref[pl.ds(g, 1), :] = jnp.full(
+            (1, 128), rem - jnp.sum(pods_new), jnp.int32)
+        return ptr + jnp.sum(opened.astype(jnp.int32))
+
+    jax.lax.fori_loop(0, G, body, jnp.int32(0))
+
+
+@functools.partial(jax.jit, static_argnames=("G", "O", "N", "interpret"))
+def ffd_scan_pallas(group_meta, compat_i8, off_alloc8, off_rank,
+                    *, G: int, O: int, N: int, interpret: bool = False):
+    """One-launch FFD scan.  Returns (node_off [N], assign [G,N],
+    unplaced [G]) — same contract as the lax.scan path."""
+    kernel = functools.partial(_ffd_kernel, G=G, O=O, N=N)
+    node_off, assign, unplaced = pl.pallas_call(
+        kernel,
+        out_shape=(
+            jax.ShapeDtypeStruct((1, N), jnp.int32),
+            jax.ShapeDtypeStruct((G, N), jnp.int32),
+            jax.ShapeDtypeStruct((G, 128), jnp.int32),
+        ),
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            pl.BlockSpec(memory_space=pltpu.VMEM),
+            pl.BlockSpec(memory_space=pltpu.VMEM),
+            pl.BlockSpec(memory_space=pltpu.VMEM),
+        ],
+        out_specs=(
+            pl.BlockSpec(memory_space=pltpu.VMEM),
+            pl.BlockSpec(memory_space=pltpu.VMEM),
+            pl.BlockSpec(memory_space=pltpu.VMEM),
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((8, N), jnp.int32),    # resid
+            pltpu.VMEM((G, N), jnp.int32),    # gcompat
+        ],
+        interpret=interpret,
+    )(group_meta, compat_i8, off_alloc8, off_rank)
+    return node_off[0], assign, unplaced[:, 0]
+
+
+def pack_problem(group_req, group_count, group_cap, compat):
+    """Host-side packing of the per-window problem into kernel layout."""
+    G = compat.shape[0]
+    meta = np.zeros((G, 8), dtype=np.int32)
+    meta[:, :4] = group_req
+    meta[:, 4] = group_count
+    meta[:, 5] = np.minimum(group_cap, np.iinfo(np.int32).max)
+    return meta, np.asarray(compat, dtype=np.int8)
+
+
+def pack_catalog(off_alloc, off_rank):
+    """Host-side packing of the (device-resident, cached) catalog tensors."""
+    O = off_alloc.shape[0]
+    alloc8 = np.zeros((8, O), dtype=np.int32)
+    alloc8[:4] = np.asarray(off_alloc, dtype=np.int32).T
+    return alloc8, np.asarray(off_rank, dtype=np.float32)[None, :]

@@ -28,6 +28,8 @@ class SolverOptions:
     bucket_groups: bool = True      # pad G/O/N to pow2 buckets (avoid recompiles)
     adaptive_nodes: bool = True     # size the node axis from the demand lower
                                     # bound; escalate on in-kernel overflow
+    use_pallas: str = "auto"        # "auto" (TPU only) | "on" | "off" —
+                                    # single-launch Mosaic FFD kernel
 
 
 @dataclass

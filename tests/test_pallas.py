@@ -1,0 +1,119 @@
+"""Parity tests for the Mosaic FFD kernel (interpret mode on CPU).
+
+The pallas path must be *bit-identical* to the lax.scan path (which is
+itself parity-tested against the host greedy oracle in test_solver.py):
+same node openings, same assignment matrix, same unplaced counts.
+"""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from karpenter_tpu.apis.pod import PodSpec, ResourceRequests, Toleration
+from karpenter_tpu.apis.requirements import (
+    LABEL_CAPACITY_TYPE, LABEL_ZONE, Operator, Requirement,
+)
+from karpenter_tpu.catalog import CatalogArrays, InstanceTypeProvider, PricingProvider
+from karpenter_tpu.cloud.fake import FakeCloud, generate_profiles
+from karpenter_tpu.solver import encode
+from karpenter_tpu.solver.jax_backend import (
+    _pad1, _pad2, solve_kernel, solve_kernel_pallas,
+)
+from karpenter_tpu.solver.pallas_kernel import (
+    pack_catalog, pack_problem, pallas_path_viable,
+)
+from karpenter_tpu.solver.types import (
+    GROUP_BUCKETS, OFFERING_BUCKETS, bucket,
+)
+
+
+def _problem(num_pods=200, num_types=12, seed=3):
+    cloud = FakeCloud(profiles=generate_profiles(num_types))
+    pricing = PricingProvider(cloud)
+    catalog = CatalogArrays.build(InstanceTypeProvider(cloud, pricing).list())
+    pricing.close()
+    rng = np.random.RandomState(seed)
+    sizes = [(250, 512), (1000, 4096), (4000, 16384)]
+    pods = []
+    for i in range(num_pods):
+        cpu, mem = sizes[rng.randint(len(sizes))]
+        kw = {}
+        r = rng.rand()
+        if r < 0.2:
+            kw["node_selector"] = ((LABEL_ZONE, f"us-south-{rng.randint(3)+1}"),)
+        elif r < 0.3:
+            kw["required_requirements"] = (
+                Requirement(LABEL_CAPACITY_TYPE, Operator.IN, ("on-demand",)),)
+        pods.append(PodSpec(f"p{i}", requests=ResourceRequests(cpu, mem, 0, 1),
+                            **kw))
+    return encode(pods, catalog), catalog
+
+
+def _padded(prob, catalog):
+    G = bucket(prob.num_groups, GROUP_BUCKETS)
+    O = bucket(catalog.num_offerings, OFFERING_BUCKETS)
+    return (G, O,
+            _pad2(prob.group_req, G), _pad1(prob.group_count, G),
+            _pad1(prob.group_cap, G), _pad2(prob.compat, G, O))
+
+
+@pytest.mark.parametrize("right_size", [False, True])
+def test_pallas_matches_scan(right_size):
+    prob, catalog = _problem()
+    G, O, group_req, group_count, group_cap, compat = _padded(prob, catalog)
+    N = 256
+
+    off_alloc = _pad2(catalog.offering_alloc().astype(np.int32), O)
+    off_price = _pad1(catalog.off_price.astype(np.float32), O)
+    off_rank = _pad1(catalog.offering_rank_price(), O)
+
+    ref = solve_kernel(
+        jnp.asarray(group_req), jnp.asarray(group_count),
+        jnp.asarray(group_cap), jnp.asarray(compat),
+        jnp.asarray(off_alloc), jnp.asarray(off_price),
+        jnp.asarray(off_rank), num_nodes=N, right_size=right_size)
+
+    meta, compat_i = pack_problem(group_req, group_count, group_cap, compat)
+    alloc8, rank_row = pack_catalog(off_alloc, off_rank)
+    out = solve_kernel_pallas(
+        jnp.asarray(meta), jnp.asarray(compat_i), jnp.asarray(alloc8),
+        jnp.asarray(rank_row), jnp.asarray(off_price),
+        G=G, O=O, N=N, right_size=right_size, interpret=True)
+
+    np.testing.assert_array_equal(np.asarray(out[0]), np.asarray(ref[0]))
+    np.testing.assert_array_equal(np.asarray(out[1]), np.asarray(ref[1]))
+    np.testing.assert_array_equal(np.asarray(out[2]), np.asarray(ref[2]))
+    assert abs(float(out[3]) - float(ref[3])) < 1e-3
+
+
+def test_pallas_unplaceable_group_matches_scan():
+    """A group with no compatible offering must report unplaced identically."""
+    prob, catalog = _problem(num_pods=40, num_types=4)
+    G, O, group_req, group_count, group_cap, compat = _padded(prob, catalog)
+    compat = compat.copy()
+    compat[0, :] = False          # kill the first (largest) group
+    N = 128
+
+    off_alloc = _pad2(catalog.offering_alloc().astype(np.int32), O)
+    off_price = _pad1(catalog.off_price.astype(np.float32), O)
+    off_rank = _pad1(catalog.offering_rank_price(), O)
+    ref = solve_kernel(
+        jnp.asarray(group_req), jnp.asarray(group_count),
+        jnp.asarray(group_cap), jnp.asarray(compat),
+        jnp.asarray(off_alloc), jnp.asarray(off_price),
+        jnp.asarray(off_rank), num_nodes=N)
+    meta, compat_i = pack_problem(group_req, group_count, group_cap, compat)
+    alloc8, rank_row = pack_catalog(off_alloc, off_rank)
+    out = solve_kernel_pallas(
+        jnp.asarray(meta), jnp.asarray(compat_i), jnp.asarray(alloc8),
+        jnp.asarray(rank_row), jnp.asarray(off_price),
+        G=G, O=O, N=N, interpret=True)
+    np.testing.assert_array_equal(np.asarray(out[2]), np.asarray(ref[2]))
+    assert int(np.asarray(out[2])[0]) == int(prob.group_count[0])
+
+
+def test_viability_gate():
+    assert pallas_path_viable(64, 4096, 1024)
+    assert not pallas_path_viable(64, 4096, 1000)      # N % 128
+    assert not pallas_path_viable(2048, 4096, 16384)   # VMEM blowout
