@@ -142,8 +142,16 @@ class PodAffinityTerm:
 def pod_key(pod: "PodSpec") -> str:
     """Canonical pod identity: 'namespace/name'.  Every plan, nomination,
     and validator structure keys pods this way — bare names collide across
-    namespaces."""
-    return f"{pod.namespace}/{pod.name}"
+    namespaces.  Memoized on the (frozen) pod: the provisioner calls this
+    for every pod on every solve window."""
+    cached = getattr(pod, "_key_cache", None)
+    if cached is None:
+        cached = f"{pod.namespace}/{pod.name}"
+        object.__setattr__(pod, "_key_cache", cached)
+    return cached
+
+
+_SIG_IDS: Dict[Tuple, int] = {}  # signature tuple -> interned id
 
 
 @dataclass(frozen=True)
@@ -182,6 +190,17 @@ class PodSpec:
         sig = self._constraint_signature()
         object.__setattr__(self, "_sig_cache", sig)
         return sig
+
+    def signature_id(self) -> int:
+        """Process-wide interned integer for the constraint signature —
+        grouping 10k pods by int avoids re-hashing nested tuples on every
+        encode."""
+        cached = getattr(self, "_sig_id", None)
+        if cached is None:
+            cached = _SIG_IDS.setdefault(self.constraint_signature(),
+                                         len(_SIG_IDS))
+            object.__setattr__(self, "_sig_id", cached)
+        return cached
 
     def _constraint_signature(self) -> Tuple:
         return (

@@ -129,8 +129,14 @@ class CatalogArrays:
         return int(self.off_type.shape[0])
 
     def offering_alloc(self) -> np.ndarray:
-        """int32 [O, R] allocatable capacity per offering."""
-        return self.type_alloc[self.off_type]
+        """int32 [O, R] allocatable capacity per offering.  Memoized:
+        type_alloc/off_type are immutable after build(), and encode calls
+        this once per pod-signature group."""
+        cached = getattr(self, "_alloc_cache", None)
+        if cached is None:
+            cached = self.type_alloc[self.off_type]
+            self._alloc_cache = cached
+        return cached
 
     def offering_rank_price(self) -> np.ndarray:
         """float32 [O] price used for *ranking only*: real price when known,

@@ -168,10 +168,19 @@ def viable_zones(reqs: Requirements, req_vec, catalog: CatalogArrays,
     return out
 
 
+_DEFAULT_POOL = NodePool(name="default")
+# cross-encode memo of per-signature lowering (requirements, nozone mask,
+# viable zones) — valid only for the default pool, keyed by catalog
+# generation so availability changes invalidate it.  The provisioner
+# re-encodes the same pending set every window; this skips the per-group
+# mask construction entirely on repeats.
+_SIG_LOWER_CACHE: Dict[Tuple, Tuple] = {}
+
+
 def encode(pods: Sequence[PodSpec], catalog: CatalogArrays,
            nodepool: Optional[NodePool] = None) -> EncodedProblem:
     """Group, split, and lower the scheduling problem to dense tensors."""
-    nodepool = nodepool or NodePool(name="default")
+    nodepool = nodepool or _DEFAULT_POOL
     pool_labels = dict(nodepool.labels)
 
     # 1. Reject pods that cannot run in this pool at all (taints).
@@ -183,10 +192,11 @@ def encode(pods: Sequence[PodSpec], catalog: CatalogArrays,
         else:
             eligible.append(pod)
 
-    # 2. Group by constraint signature.
-    by_sig: Dict[Tuple, List[PodSpec]] = {}
+    # 2. Group by constraint signature (interned int ids: no tuple
+    # re-hashing at 10k pods).
+    by_sig: Dict[int, List[PodSpec]] = {}
     for pod in eligible:
-        by_sig.setdefault(pod.constraint_signature(), []).append(pod)
+        by_sig.setdefault(pod.signature_id(), []).append(pod)
 
     # 3. Per-group requirement lowering + splitting.  The zone-independent
     # offering mask is computed ONCE per signature group (shared by split
@@ -195,23 +205,41 @@ def encode(pods: Sequence[PodSpec], catalog: CatalogArrays,
                   LABEL_INSTANCE_SIZE, LABEL_ZONE, LABEL_CAPACITY_TYPE}
     mask_cache: Dict = {}
     groups: List[PodGroup] = []
+    cache_ok = nodepool is _DEFAULT_POOL
+    gen_key = (catalog.uid, catalog.generation, catalog.availability_generation)
+    if cache_ok and _SIG_LOWER_CACHE and \
+            next(iter(_SIG_LOWER_CACHE))[1:] != gen_key:
+        _SIG_LOWER_CACHE.clear()   # catalog moved on; drop stale masks
     for sig, members in by_sig.items():
         rep = members[0]
-        reqs = rep.scheduling_requirements().merged(nodepool.requirements)
-        # requirements on keys the catalog can't express must be satisfied
-        # by static nodepool labels, else the group is unschedulable here
-        unsat = [r for r in reqs
-                 if r.key not in known_keys and not r.matches(pool_labels)]
-        if unsat:
-            rejected.extend(pod_key(p) for p in members)
-            continue
-        cap = 1 if _has_hostname_anti_affinity(rep) else BIG_CAP
-
-        req_vec = rep.requests.as_tuple()
-        nozone = _nozone_compat(reqs, req_vec, catalog, mask_cache)
+        hit = _SIG_LOWER_CACHE.get((sig,) + gen_key) if cache_ok else None
+        if hit is not None:
+            reqs, unsat_flag, cap, nozone, live_zones = hit
+            if unsat_flag:
+                rejected.extend(pod_key(p) for p in members)
+                continue
+        else:
+            reqs = rep.scheduling_requirements().merged(nodepool.requirements)
+            # requirements on keys the catalog can't express must be
+            # satisfied by static nodepool labels, else the group is
+            # unschedulable here
+            unsat = [r for r in reqs
+                     if r.key not in known_keys and not r.matches(pool_labels)]
+            cap = 1 if _has_hostname_anti_affinity(rep) else BIG_CAP
+            req_vec = rep.requests.as_tuple()
+            if unsat:
+                if cache_ok:
+                    _SIG_LOWER_CACHE[(sig,) + gen_key] = (reqs, True, cap,
+                                                          None, None)
+                rejected.extend(pod_key(p) for p in members)
+                continue
+            nozone = _nozone_compat(reqs, req_vec, catalog, mask_cache)
+            live_zones = viable_zones(reqs, req_vec, catalog, nozone=nozone,
+                                      cache=mask_cache)
+            if cache_ok:
+                _SIG_LOWER_CACHE[(sig,) + gen_key] = (reqs, False, cap,
+                                                      nozone, live_zones)
         spread = _zone_spread_constraints(rep)
-        live_zones = viable_zones(reqs, req_vec, catalog, nozone=nozone,
-                                  cache=mask_cache)
         if spread and len(live_zones) > 1:
             # split into per-zone pinned subgroups, evenly (skew <= 1),
             # over zones that can actually host the group
