@@ -102,6 +102,13 @@ def run(num_pods: int, num_types: int, iters: int) -> dict:
 
 
 def main():
+    import os
+    if os.environ.get("JAX_PLATFORMS"):
+        # honor an explicit platform choice over the ambient axon
+        # sitecustomize (which pins jax_platforms to the real-TPU tunnel
+        # and hangs at backend init when the tunnel is down)
+        import jax
+        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
     ap = argparse.ArgumentParser()
     ap.add_argument("--quick", action="store_true",
                     help="small config for CPU sanity")

@@ -1,0 +1,92 @@
+"""ctypes binding for the native per-pod FFD twin (native/ffd.cpp).
+
+The library is built on demand with ``make -C native`` (g++; no pybind11
+in this environment — plain ``extern "C"`` + ctypes).  Absence of a
+toolchain degrades gracefully: ``load()`` returns None and callers fall
+back to the pure-python grouped greedy.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Optional
+
+import numpy as np
+
+from karpenter_tpu.utils.logging import get_logger
+
+log = get_logger("native")
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_NATIVE_DIR = os.path.join(_REPO_ROOT, "native")
+_LIB_PATH = os.path.join(_NATIVE_DIR, "build", "libffd.so")
+
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_load_failed = False
+
+_I32P = np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS")
+_U8P = np.ctypeslib.ndpointer(np.uint8, flags="C_CONTIGUOUS")
+_F32P = np.ctypeslib.ndpointer(np.float32, flags="C_CONTIGUOUS")
+
+
+def load() -> Optional[ctypes.CDLL]:
+    """Load (building if needed) the native library; None if unavailable."""
+    global _lib, _load_failed
+    with _lock:
+        if _lib is not None or _load_failed:
+            return _lib
+        if not os.path.exists(_LIB_PATH):
+            try:
+                subprocess.run(["make", "-C", _NATIVE_DIR], check=True,
+                               capture_output=True, timeout=120)
+            except Exception as e:  # no toolchain / build failure
+                log.warning("native build failed; using python greedy",
+                            error=str(e))
+                _load_failed = True
+                return None
+        try:
+            lib = ctypes.CDLL(_LIB_PATH)
+            lib.ffd_solve.restype = ctypes.c_int
+            lib.ffd_solve.argtypes = [
+                ctypes.c_int, ctypes.c_int, ctypes.c_int,
+                _I32P, _I32P, _I32P, _U8P, _I32P, _F32P,
+                _I32P, _I32P, _I32P,
+            ]
+            _lib = lib
+        except OSError as e:
+            log.warning("native load failed; using python greedy",
+                        error=str(e))
+            _load_failed = True
+        return _lib
+
+
+def ffd_solve(group_req: np.ndarray, group_count: np.ndarray,
+              group_cap: np.ndarray, compat: np.ndarray,
+              off_alloc: np.ndarray, off_rank: np.ndarray,
+              max_nodes: int):
+    """Run the per-pod FFD.  Returns (node_off, assign, unplaced, open)
+    or None when the native library is unavailable; ``open`` is -1 on node
+    overflow (caller escalates max_nodes)."""
+    lib = load()
+    if lib is None:
+        return None
+    G, O = compat.shape
+    N = int(max_nodes)
+    node_off = np.full(N, -1, dtype=np.int32)
+    assign = np.zeros((G, N), dtype=np.int32)
+    unplaced = np.zeros(G, dtype=np.int32)
+    n_open = lib.ffd_solve(
+        G, O, N,
+        np.ascontiguousarray(group_req, dtype=np.int32),
+        np.ascontiguousarray(group_count, dtype=np.int32),
+        np.ascontiguousarray(np.minimum(group_cap, np.iinfo(np.int32).max),
+                             dtype=np.int32),
+        np.ascontiguousarray(compat, dtype=np.uint8),
+        np.ascontiguousarray(off_alloc, dtype=np.int32),
+        np.ascontiguousarray(off_rank, dtype=np.float32),
+        node_off, assign, unplaced)
+    return node_off, assign, unplaced, n_open

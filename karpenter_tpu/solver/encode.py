@@ -308,6 +308,42 @@ def encode(pods: Sequence[PodSpec], catalog: CatalogArrays,
         group_cap=group_cap, compat=compat, catalog=catalog, rejected=rejected)
 
 
+def decode_plan(problem: EncodedProblem, node_off: np.ndarray,
+                assign: np.ndarray, unplaced: np.ndarray, cost: float,
+                backend: str):
+    """Shared dense-result -> Plan decoding (jax, pallas, and native
+    backends all emit the same (node_off, assign, unplaced) contract)."""
+    from karpenter_tpu.solver.types import Plan, PlannedNode
+
+    catalog = problem.catalog
+    groups = problem.groups
+    cursors = [0] * len(groups)
+    nodes: List = []
+    open_idx = np.nonzero(node_off >= 0)[0]
+    for n in open_idx:
+        off = int(node_off[n])
+        itype, zone, captype = catalog.describe_offering(off)
+        pod_names: List[str] = []
+        for gi in range(len(groups)):
+            k = int(assign[gi, n]) if gi < assign.shape[0] else 0
+            if k > 0:
+                c = cursors[gi]
+                pod_names.extend(groups[gi].pod_names[c:c + k])
+                cursors[gi] = c + k
+        nodes.append(PlannedNode(
+            instance_type=itype, zone=zone, capacity_type=captype,
+            price=float(catalog.off_price[off])
+            if off < catalog.num_offerings else 0.0,
+            pod_names=pod_names, offering_index=off))
+    unplaced_names: List[str] = list(problem.rejected)
+    for gi, g in enumerate(groups):
+        miss = int(unplaced[gi]) if gi < len(unplaced) else 0
+        if miss > 0:
+            unplaced_names.extend(g.pod_names[len(g.pod_names) - miss:])
+    return Plan(nodes=nodes, unplaced_pods=unplaced_names,
+                total_cost_per_hour=float(cost), backend=backend)
+
+
 def _best_zone_for(pod: PodSpec, reqs: Requirements, zones: List[str],
                    catalog: CatalogArrays) -> str:
     """Zone with the most offering capacity compatible with the pod."""

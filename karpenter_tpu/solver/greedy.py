@@ -49,6 +49,35 @@ class GreedySolver:
         return plan
 
     def solve_encoded(self, problem: EncodedProblem) -> Plan:
+        if self.options.use_native != "off":
+            plan = self._solve_native(problem)
+            if plan is not None:
+                return plan
+        return self._solve_python(problem)
+
+    def _solve_native(self, problem: EncodedProblem) -> Optional[Plan]:
+        """Per-pod FFD in C++ (native/ffd.cpp) — same plan as the python
+        path, at Go-loop speeds; None when the library is unavailable."""
+        from karpenter_tpu.solver.encode import decode_plan
+        from karpenter_tpu import native
+
+        if problem.num_groups == 0:
+            return Plan(nodes=[], unplaced_pods=list(problem.rejected),
+                        backend="greedy-native")
+        catalog = problem.catalog
+        out = native.ffd_solve(
+            problem.group_req, problem.group_count, problem.group_cap,
+            problem.compat, catalog.offering_alloc().astype(np.int32),
+            catalog.offering_rank_price(), self.options.max_nodes)
+        if out is None:
+            return None
+        node_off, assign, unplaced, n_open = out
+        open_mask = node_off >= 0
+        cost = float(catalog.off_price[node_off[open_mask]].sum())
+        return decode_plan(problem, node_off, assign, unplaced, cost,
+                           "greedy-native")
+
+    def _solve_python(self, problem: EncodedProblem) -> Plan:
         catalog = problem.catalog
         off_alloc = catalog.offering_alloc().astype(np.int64)   # [O, R]
         off_price = catalog.off_price.astype(np.float64)

@@ -388,33 +388,9 @@ class JaxSolver:
 
     def _decode(self, problem: EncodedProblem, node_off, assign, unplaced,
                 cost: float) -> Plan:
-        catalog = problem.catalog
-        groups = problem.groups
-        cursors = [0] * len(groups)
-        nodes: List[PlannedNode] = []
-        open_idx = np.nonzero(node_off >= 0)[0]
-        for n in open_idx:
-            off = int(node_off[n])
-            itype, zone, captype = catalog.describe_offering(off)
-            pod_names: List[str] = []
-            for gi in range(len(groups)):
-                k = int(assign[gi, n])
-                if k > 0:
-                    c = cursors[gi]
-                    pod_names.extend(groups[gi].pod_names[c:c + k])
-                    cursors[gi] = c + k
-            nodes.append(PlannedNode(
-                instance_type=itype, zone=zone, capacity_type=captype,
-                price=float(catalog.off_price[off]) if off < catalog.num_offerings
-                else 0.0,
-                pod_names=pod_names, offering_index=off))
-        unplaced_names: List[str] = list(problem.rejected)
-        for gi, g in enumerate(groups):
-            miss = int(unplaced[gi])
-            if miss > 0:
-                unplaced_names.extend(g.pod_names[len(g.pod_names) - miss:])
-        return Plan(nodes=nodes, unplaced_pods=unplaced_names,
-                    total_cost_per_hour=float(cost), backend="jax")
+        from karpenter_tpu.solver.encode import decode_plan
+
+        return decode_plan(problem, node_off, assign, unplaced, cost, "jax")
 
 
 def _pad1(a: np.ndarray, n: int) -> np.ndarray:

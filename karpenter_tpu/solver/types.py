@@ -30,6 +30,8 @@ class SolverOptions:
                                     # bound; escalate on in-kernel overflow
     use_pallas: str = "auto"        # "auto" (TPU only) | "on" | "off" —
                                     # single-launch Mosaic FFD kernel
+    use_native: str = "auto"        # greedy backend: C++ per-pod FFD twin
+                                    # (native/ffd.cpp); "off" = pure python
 
 
 @dataclass

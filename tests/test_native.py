@@ -1,0 +1,83 @@
+"""Parity tests: C++ per-pod FFD (native/ffd.cpp) vs the python greedy.
+
+The native twin is the reference-semantics Go-loop stand-in; its plans
+must be identical to the grouped python implementation (which is itself
+the oracle for the jax/pallas backends)."""
+
+import numpy as np
+import pytest
+
+from karpenter_tpu import native
+from karpenter_tpu.apis.pod import PodSpec, ResourceRequests, make_pods
+from karpenter_tpu.apis.requirements import (
+    LABEL_CAPACITY_TYPE, LABEL_ZONE, Operator, Requirement,
+)
+from karpenter_tpu.catalog import CatalogArrays, InstanceTypeProvider, PricingProvider
+from karpenter_tpu.cloud.fake import FakeCloud, generate_profiles
+from karpenter_tpu.solver import GreedySolver, SolveRequest
+from karpenter_tpu.solver.types import SolverOptions
+
+needs_native = pytest.mark.skipif(native.load() is None,
+                                  reason="native toolchain unavailable")
+
+
+def _catalog(num_types=10):
+    cloud = FakeCloud(profiles=generate_profiles(num_types))
+    pricing = PricingProvider(cloud)
+    catalog = CatalogArrays.build(InstanceTypeProvider(cloud, pricing).list())
+    pricing.close()
+    return catalog
+
+
+def _plans_equal(a, b):
+    return ([(n.instance_type, n.zone, n.capacity_type, n.pod_names)
+             for n in a.nodes] ==
+            [(n.instance_type, n.zone, n.capacity_type, n.pod_names)
+             for n in b.nodes]) and \
+        sorted(a.unplaced_pods) == sorted(b.unplaced_pods)
+
+
+@needs_native
+def test_native_matches_python_mixed_workload():
+    catalog = _catalog()
+    rng = np.random.RandomState(11)
+    sizes = [(250, 512), (1000, 4096), (4000, 16384)]
+    pods = []
+    for i in range(600):
+        cpu, mem = sizes[rng.randint(3)]
+        kw = {}
+        r = rng.rand()
+        if r < 0.2:
+            kw["node_selector"] = ((LABEL_ZONE, f"us-south-{rng.randint(3)+1}"),)
+        elif r < 0.3:
+            kw["required_requirements"] = (
+                Requirement(LABEL_CAPACITY_TYPE, Operator.IN, ("on-demand",)),)
+        pods.append(PodSpec(f"p{i}", requests=ResourceRequests(cpu, mem, 0, 1),
+                            **kw))
+    req = SolveRequest(pods, catalog)
+    p_native = GreedySolver(SolverOptions(use_native="auto")).solve(req)
+    p_python = GreedySolver(SolverOptions(use_native="off")).solve(req)
+    assert p_native.backend == "greedy-native"
+    assert _plans_equal(p_native, p_python)
+    # f32 accumulation (native) vs f64 (python): sub-cent drift only
+    assert abs(p_native.total_cost_per_hour - p_python.total_cost_per_hour) < 1e-4
+
+
+@needs_native
+def test_native_unplaceable_pods():
+    catalog = _catalog(num_types=3)
+    pods = make_pods(5, requests=ResourceRequests(10_000_000, 1, 0, 1))
+    req = SolveRequest(pods, catalog)
+    p = GreedySolver(SolverOptions(use_native="auto")).solve(req)
+    assert len(p.unplaced_pods) == 5 and not p.nodes
+
+
+@needs_native
+def test_native_node_overflow_degrades_like_python():
+    catalog = _catalog(num_types=4)
+    pods = make_pods(200, requests=ResourceRequests(1000, 2048, 0, 1))
+    req = SolveRequest(pods, catalog)
+    a = GreedySolver(SolverOptions(use_native="auto", max_nodes=2)).solve(req)
+    b = GreedySolver(SolverOptions(use_native="off", max_nodes=2)).solve(req)
+    assert _plans_equal(a, b)
+    assert a.unplaced_pods
