@@ -10,6 +10,7 @@ from __future__ import annotations
 
 import math
 import re
+import threading
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Tuple
 
@@ -152,6 +153,7 @@ def pod_key(pod: "PodSpec") -> str:
 
 
 _SIG_IDS: Dict[Tuple, int] = {}  # signature tuple -> interned id
+_SIG_IDS_LOCK = threading.Lock()
 
 
 @dataclass(frozen=True)
@@ -194,11 +196,13 @@ class PodSpec:
     def signature_id(self) -> int:
         """Process-wide interned integer for the constraint signature —
         grouping 10k pods by int avoids re-hashing nested tuples on every
-        encode."""
+        encode.  Interning is locked: a racing setdefault(sig, len(map))
+        could hand the same id to two different signatures."""
         cached = getattr(self, "_sig_id", None)
         if cached is None:
-            cached = _SIG_IDS.setdefault(self.constraint_signature(),
-                                         len(_SIG_IDS))
+            sig = self.constraint_signature()
+            with _SIG_IDS_LOCK:
+                cached = _SIG_IDS.setdefault(sig, len(_SIG_IDS))
             object.__setattr__(self, "_sig_id", cached)
         return cached
 

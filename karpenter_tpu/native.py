@@ -39,13 +39,15 @@ def load() -> Optional[ctypes.CDLL]:
     with _lock:
         if _lib is not None or _load_failed:
             return _lib
-        if not os.path.exists(_LIB_PATH):
-            try:
-                subprocess.run(["make", "-C", _NATIVE_DIR], check=True,
-                               capture_output=True, timeout=120)
-            except Exception as e:  # no toolchain / build failure
-                log.warning("native build failed; using python greedy",
-                            error=str(e))
+        # always run make — a no-op when up to date, and it rebuilds a
+        # stale .so after ffd.cpp edits (the binary is not in VCS)
+        try:
+            subprocess.run(["make", "-C", _NATIVE_DIR], check=True,
+                           capture_output=True, timeout=120)
+        except Exception as e:  # no toolchain / build failure
+            log.warning("native build failed; using python greedy",
+                        error=str(e))
+            if not os.path.exists(_LIB_PATH):
                 _load_failed = True
                 return None
         try:

@@ -308,6 +308,28 @@ def encode(pods: Sequence[PodSpec], catalog: CatalogArrays,
         group_cap=group_cap, compat=compat, catalog=catalog, rejected=rejected)
 
 
+def estimate_nodes(problem: EncodedProblem, n_cap: int,
+                   buckets: Sequence[int]) -> int:
+    """Static node-axis size: 2x the bin-packing lower bound (total demand
+    / best single-node capacity) plus headroom; FFD never exceeds ~1.7x LB,
+    and solver backends escalate on overflow anyway."""
+    from karpenter_tpu.solver.types import bucket
+
+    catalog = problem.catalog
+    if catalog.num_offerings == 0:
+        return min(64, n_cap)
+    tot = (problem.group_req.astype(np.int64)
+           * problem.group_count[:, None]).sum(axis=0)            # [R]
+    best = catalog.offering_alloc().max(axis=0).astype(np.int64)  # [R]
+    lb = int(np.max(np.ceil(tot / np.maximum(best, 1))))
+    # per-node-capped groups (anti-affinity) need >= count/cap nodes
+    capped = problem.group_cap < BIG_CAP
+    if capped.any():
+        lb = max(lb, int(np.max(np.ceil(
+            problem.group_count[capped] / problem.group_cap[capped]))))
+    return min(n_cap, bucket(max(2 * lb + 32, 64), buckets))
+
+
 def decode_plan(problem: EncodedProblem, node_off: np.ndarray,
                 assign: np.ndarray, unplaced: np.ndarray, cost: float,
                 backend: str):

@@ -65,13 +65,21 @@ class GreedySolver:
             return Plan(nodes=[], unplaced_pods=list(problem.rejected),
                         backend="greedy-native")
         catalog = problem.catalog
-        out = native.ffd_solve(
-            problem.group_req, problem.group_count, problem.group_cap,
-            problem.compat, catalog.offering_alloc().astype(np.int32),
-            catalog.offering_rank_price(), self.options.max_nodes)
-        if out is None:
-            return None
-        node_off, assign, unplaced, n_open = out
+        from karpenter_tpu.solver.encode import estimate_nodes
+        from karpenter_tpu.solver.types import NODE_BUCKETS
+        N = estimate_nodes(problem, self.options.max_nodes, NODE_BUCKETS)
+        while True:
+            out = native.ffd_solve(
+                problem.group_req, problem.group_count, problem.group_cap,
+                problem.compat, catalog.offering_alloc().astype(np.int32),
+                catalog.offering_rank_price(), N)
+            if out is None:
+                return None
+            node_off, assign, unplaced, n_open = out
+            if n_open < 0 and N < self.options.max_nodes:
+                N = min(self.options.max_nodes, N * 4)   # overflow: escalate
+                continue
+            break
         open_mask = node_off >= 0
         cost = float(catalog.off_price[node_off[open_mask]].sum())
         return decode_plan(problem, node_off, assign, unplaced, cost,

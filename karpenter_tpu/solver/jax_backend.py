@@ -271,7 +271,10 @@ class JaxSolver:
         assign_dtype = "int16" if max_slots < (1 << 15) else "int32"
 
         while True:
-            use_pallas = self._use_pallas(G_pad, O_pad, max(N, 128))
+            # pallas needs a 128-multiple node axis; never exceed the
+            # configured cap to get one — fall back to the scan path instead
+            use_pallas = (max(N, 128) <= N_cap
+                          and self._use_pallas(G_pad, O_pad, max(N, 128)))
             if use_pallas:
                 from karpenter_tpu.solver.pallas_kernel import pack_problem
                 N = max(N, 128)
@@ -313,22 +316,9 @@ class JaxSolver:
 
     @staticmethod
     def _estimate_nodes(problem: EncodedProblem, n_cap: int) -> int:
-        """Static node-axis size: 2x the bin-packing lower bound (total
-        demand / best single-node capacity) plus headroom; FFD never exceeds
-        ~1.7x LB, and an in-kernel overflow triggers escalation anyway."""
-        catalog = problem.catalog
-        if catalog.num_offerings == 0:
-            return min(64, n_cap)
-        tot = (problem.group_req.astype(np.int64)
-               * problem.group_count[:, None]).sum(axis=0)          # [R]
-        best = catalog.offering_alloc().max(axis=0).astype(np.int64)  # [R]
-        lb = int(np.max(np.ceil(tot / np.maximum(best, 1))))
-        # per-node-capped groups (anti-affinity) need >= count/cap nodes
-        capped = problem.group_cap < BIG_CAP_I32
-        if capped.any():
-            lb = max(lb, int(np.max(np.ceil(
-                problem.group_count[capped] / problem.group_cap[capped]))))
-        return min(n_cap, bucket(max(2 * lb + 32, 64), NODE_BUCKETS))
+        from karpenter_tpu.solver.encode import estimate_nodes
+
+        return estimate_nodes(problem, n_cap, NODE_BUCKETS)
 
     # -- internals ---------------------------------------------------------
 
