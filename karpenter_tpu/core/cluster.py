@@ -77,10 +77,10 @@ class ClusterState:
     def __init__(self):
         self._lock = threading.RLock()
         self._rv = 0
-        self._collections: Dict[str, Dict[str, Any]] = {
-            "pods": {}, "nodes": {}, "nodeclaims": {}, "nodeclasses": {},
-            "nodepools": {}, "lbregistrations": {},
-        }
+        self._collections: Dict[str, Dict[str, Any]] = defaultdict(dict)
+        for kind in ("pods", "nodes", "nodeclaims", "nodeclasses",
+                     "nodepools", "lbregistrations", "rbac"):
+            self._collections[kind] = {}
         self._watchers: Dict[str, List[Callable[[str, Any], None]]] = defaultdict(list)
         self.events: List[Event] = []
 

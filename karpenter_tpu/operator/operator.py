@@ -18,6 +18,7 @@ from karpenter_tpu.catalog.unavailable import UnavailableOfferings
 from karpenter_tpu.cloud.fake import FakeCloud
 from karpenter_tpu.cloud.loadbalancer import LoadBalancerProvider
 from karpenter_tpu.controllers import ControllerManager
+from karpenter_tpu.controllers.bootstrap import BootstrapTokenController
 from karpenter_tpu.controllers.faults import (
     InstanceTypeRefreshController, InterruptionController, OrphanCleanupController,
     PricingRefreshController, SpotPreemptionController,
@@ -137,6 +138,10 @@ class Operator:
         ]
         if self.options.interruption_enabled:
             ctrls.append(InterruptionController(self.cluster, self.unavailable))
+        # bootstrap-token lifecycle (ref RegisterBootstrapController,
+        # controllers.go:267 + bootstrap/token_controller.go)
+        ctrls.append(BootstrapTokenController(
+            self.cluster, self.actuator.bootstrap.tokens))
         # env-gated (controllers.go:238)
         ctrls.append(OrphanCleanupController(
             self.cluster, self.cloud,

@@ -559,3 +559,54 @@ def test_controller_plane_end_to_end(rig):
     assert cluster.get_nodeclaim(claim.name) is None
     assert cloud.instance_count() == 0
     assert unavail.is_unavailable("bx2-4x16", "us-south-1", "on-demand")
+
+
+class TestBootstrapTokenController:
+    def test_rbac_and_token_lifecycle(self):
+        from karpenter_tpu.controllers.bootstrap import (
+            REQUIRED_BINDINGS, BootstrapTokenController,
+        )
+        from karpenter_tpu.core.bootstrap import TokenStore
+        from karpenter_tpu.core.cluster import ClusterState
+
+        now = [1000.0]
+        tokens = TokenStore(clock=lambda: now[0])
+        cluster = ClusterState()
+        ctrl = BootstrapTokenController(cluster, tokens)
+
+        # first pass: RBAC ensured + a token pre-minted
+        ctrl.reconcile()
+        assert not ctrl.missing_bindings()
+        assert len(cluster.list("rbac")) == len(REQUIRED_BINDINGS)
+        assert len(tokens.live_tokens()) == 1
+        first = tokens.live_tokens()[0]
+
+        # within its useful life nothing new is minted, RBAC is idempotent
+        now[0] += 3600
+        ctrl.reconcile()
+        assert len(tokens.live_tokens()) == 1
+        assert len(cluster.list("rbac")) == len(REQUIRED_BINDINGS)
+
+        # close to expiry (< 6h left): a fresh token is pre-minted so the
+        # hot provisioning path never mints inline (token.go:85 contract)
+        now[0] = first.expires_at - 3600
+        ctrl.reconcile()
+        live = tokens.live_tokens()
+        assert len(live) == 2 and any(t is not first for t in live)
+
+        # past expiry: the dead token is swept
+        now[0] = first.expires_at + 1
+        ctrl.reconcile()
+        assert first not in tokens.live_tokens()
+        assert all(t.expires_at > now[0] for t in tokens.live_tokens())
+
+    def test_registered_in_operator_fleet(self):
+        from karpenter_tpu.controllers.bootstrap import BootstrapTokenController
+        from karpenter_tpu.operator.operator import Operator
+        from karpenter_tpu.operator.options import Options
+
+        op = Operator(Options(region="us-south", api_key="k"))
+        try:
+            assert BootstrapTokenController.name in op.manager.controllers()
+        finally:
+            op.stop()
