@@ -1,0 +1,246 @@
+"""Disruption controller: drift replacement + consolidation.
+
+In the reference these live in karpenter-core (the drift and disruption
+controllers call ``CloudProvider.IsDrifted`` — SURVEY.md §3.4 — and run
+empty/underutilized consolidation against the cluster state).  The
+standalone framework owns both:
+
+- **Drift replacement**: claims whose NodeClass has moved under them
+  (hash/hash-version/image/subnet/security-group drift, core/drift.py)
+  are replaced — pods are unbound back to pending, the claim is deleted
+  (the termination controller finalizes the instance), and the
+  provisioning window re-places the pods against the *current* spec.
+- **Empty consolidation**: nodes with no bound pods past the pool's
+  ``consolidate_after_seconds`` are removed (policy gate:
+  WhenEmpty / WhenEmptyOrUnderutilized).
+- **Underutilized consolidation**: karpenter's single-node move — if every
+  pod on a node provably fits in the residual capacity of other live
+  nodes, bind them there directly (this framework owns the scheduler
+  role, so the rebind is ours to do, not a kube-scheduler's) and delete
+  the node.  Savings-first order: cheapest-to-remove nodes go first.
+
+The full cost-optimal *repack* (BASELINE config #4) reuses the solver:
+``propose_repack`` returns the fresh-solve plan and its cost delta vs the
+live fleet; the poll loop only *executes* the safe single-node moves, so
+actuation stays idempotent while the repack remains observable (and is
+what bench_fleet exercises on TPU).
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from karpenter_tpu.apis.nodeclaim import NodeClaim, NodePool
+from karpenter_tpu.apis.pod import NUM_RESOURCES
+from karpenter_tpu.controllers.runtime import PollController, Result
+from karpenter_tpu.core.cluster import ClusterState
+from karpenter_tpu.core.cloudprovider import CloudProvider
+from karpenter_tpu.utils.logging import get_logger
+
+log = get_logger("controllers.disruption")
+
+
+@dataclass
+class RepackProposal:
+    """Observable outcome of a fresh fleet solve vs the live fleet."""
+
+    current_cost: float
+    proposed_cost: float
+    plan: object = None            # solver Plan
+    savings: float = 0.0
+
+
+class DisruptionController(PollController):
+    """Singleton poller (10s — the repack cadence of BASELINE config #4)."""
+
+    name = "disruption"
+    interval = 10.0
+
+    def __init__(self, cluster: ClusterState, cloudprovider: CloudProvider,
+                 provisioner=None, clock=time.time):
+        self.cluster = cluster
+        self.cloudprovider = cloudprovider
+        self.provisioner = provisioner
+        self.clock = clock
+
+    # -- reconcile ---------------------------------------------------------
+
+    def reconcile(self) -> Result:
+        drifted = self._replace_drifted()
+        emptied = self._consolidate_empty()
+        moved = self._consolidate_underutilized()
+        if drifted or emptied or moved:
+            log.info("disruption pass", drifted=drifted, empty=emptied,
+                     consolidated=moved)
+        return Result()
+
+    # -- drift (SURVEY.md §3.4) -------------------------------------------
+
+    def _replace_drifted(self) -> int:
+        n = 0
+        for claim in self.cluster.nodeclaims():
+            if claim.deleted or not claim.registered:
+                continue
+            reason = self.cloudprovider.is_drifted(claim)
+            if not reason:
+                continue
+            # (is_drifted already counted the detection metric)
+            log.info("drifted claim replaced", claim=claim.name,
+                     reason=reason)
+            self._evict_and_delete(claim)
+            n += 1
+        return n
+
+    # -- consolidation -----------------------------------------------------
+
+    def _pool_for(self, claim: NodeClaim) -> NodePool:
+        pool = self.cluster.get("nodepools", claim.nodepool_name)
+        return pool if pool is not None else NodePool(name="default")
+
+    def _consolidate_empty(self) -> int:
+        now = self.clock()
+        n = 0
+        for claim in self.cluster.nodeclaims():
+            if claim.deleted or not claim.initialized or not claim.node_name:
+                continue
+            pool = self._pool_for(claim)
+            if pool.consolidation_policy not in (
+                    "WhenEmpty", "WhenEmptyOrUnderutilized"):
+                continue
+            if self._bound_pods(claim.node_name):
+                continue
+            if now - claim.created_at < pool.consolidate_after_seconds:
+                continue
+            log.info("empty node consolidated", claim=claim.name)
+            self._evict_and_delete(claim)
+            n += 1
+        return n
+
+    def _consolidate_underutilized(self) -> int:
+        """Single-node move: cheapest removable node whose pods all fit in
+        the other nodes' residuals; pods are rebound directly."""
+        claims = [c for c in self.cluster.nodeclaims()
+                  if not c.deleted and c.initialized and c.node_name
+                  and self._pool_for(c).consolidation_policy
+                  == "WhenEmptyOrUnderutilized"]
+        if len(claims) < 2:
+            return 0
+        resid = {c.name: self._residual(c) for c in claims}
+        moved = 0
+        # cheapest first: removing a low-price node frees least value, but
+        # is likeliest to fit elsewhere; karpenter sorts by disruption cost
+        for claim in sorted(claims, key=lambda c: c.hourly_price):
+            pods = self._bound_pods(claim.node_name)
+            if not pods:
+                continue
+            placement = self._fit_elsewhere(claim, pods, claims, resid)
+            if placement is None:
+                continue
+            for pod, target in placement:
+                self.cluster.bind_pod(pod, target.node_name)
+                resid[target.name] = resid[target.name] - \
+                    self._pod_req(pod)
+            log.info("underutilized node consolidated", claim=claim.name,
+                     pods_moved=len(placement))
+            self._delete_claim(claim)
+            claims.remove(claim)
+            moved += 1
+        return moved
+
+    # -- repack (observable; BASELINE config #4) --------------------------
+
+    def propose_repack(self) -> Optional[RepackProposal]:
+        """Fresh solve of the entire workload vs the live fleet cost."""
+        if self.provisioner is None:
+            return None
+        from karpenter_tpu.solver.types import SolveRequest
+
+        claims = [c for c in self.cluster.nodeclaims() if not c.deleted]
+        if not claims:
+            return None
+        current = sum(c.hourly_price for c in claims)
+        nodeclass = self.cluster.get_nodeclass("default")
+        if nodeclass is None:
+            return None
+        catalog = self.provisioner._catalog_for(nodeclass)
+        if catalog is None:
+            return None
+        pods = [p.spec for p in self.cluster.list("pods")]
+        if not pods:
+            return None
+        plan = self.provisioner.solver.solve(SolveRequest(pods, catalog))
+        return RepackProposal(
+            current_cost=current, proposed_cost=plan.total_cost_per_hour,
+            plan=plan, savings=current - plan.total_cost_per_hour)
+
+    # -- helpers -----------------------------------------------------------
+
+    def _bound_pods(self, node_name: str) -> List[str]:
+        from karpenter_tpu.apis.pod import pod_key
+
+        return [pod_key(p.spec) for p in self.cluster.list("pods")
+                if p.bound_node == node_name
+                or p.nominated_node == node_name]
+
+    def _pod_req(self, pod_key_str: str) -> np.ndarray:
+        pending = self.cluster.get("pods", pod_key_str)
+        if pending is None:
+            return np.zeros(NUM_RESOURCES, dtype=np.int64)
+        req = pending.spec.requests.as_tuple()
+        return np.array((req[0], req[1], req[2], max(req[3], 1)),
+                        dtype=np.int64)
+
+    def _alloc(self, claim: NodeClaim) -> np.ndarray:
+        it = self.cloudprovider.instance_types.get(claim.instance_type)
+        if it is None:
+            return np.zeros(NUM_RESOURCES, dtype=np.int64)
+        return np.array((it.allocatable_cpu_milli, it.allocatable_memory_mib,
+                         it.gpu, it.pods), dtype=np.int64)
+
+    def _residual(self, claim: NodeClaim) -> np.ndarray:
+        resid = self._alloc(claim)
+        for pk in self._bound_pods(claim.node_name):
+            resid = resid - self._pod_req(pk)
+        return resid
+
+    def _fit_elsewhere(self, victim: NodeClaim, pods: List[str],
+                       claims: List[NodeClaim],
+                       resid: Dict[str, np.ndarray]
+                       ) -> Optional[List[Tuple[str, NodeClaim]]]:
+        """First-fit each pod into other nodes' residuals (on a working
+        copy); None if any pod does not fit."""
+        work = {k: v.copy() for k, v in resid.items()}
+        placement: List[Tuple[str, NodeClaim]] = []
+        others = [c for c in claims if c.name != victim.name]
+        for pk in pods:
+            req = self._pod_req(pk)
+            target = None
+            for c in others:
+                if (work[c.name] >= req).all():
+                    target = c
+                    break
+            if target is None:
+                return None
+            work[target.name] = work[target.name] - req
+            placement.append((pk, target))
+        return placement
+
+    def _evict_and_delete(self, claim: NodeClaim) -> None:
+        """Unbind the node's pods back to pending, then delete the claim
+        (the termination controller finalizes the instance; the window
+        re-places the pods)."""
+        for pk in self._bound_pods(claim.node_name):
+            pending = self.cluster.get("pods", pk)
+            if pending is not None:
+                pending.bound_node = ""
+                pending.nominated_node = ""
+                pending.enqueued_at = 0.0   # immediate re-window
+        self._delete_claim(claim)
+
+    def _delete_claim(self, claim: NodeClaim) -> None:
+        claim.deleted = True
+        self.cluster.update("nodeclaims", claim.name, claim)

@@ -130,14 +130,22 @@ class Actuator:
             capacity_type=planned.capacity_type,
             security_group_ids=sgs or (),
             user_data=user_data,
+            volumes=self._build_volumes(node_name, nodeclass),
             tags={**KARPENTER_TAGS,
                   "karpenter.sh/nodepool": nodepool_name,
                   "karpenter-tpu.sh/nodeclass": nodeclass.name})
 
+        # the claim inherits the pool's taints/startup taints (karpenter
+        # core semantics: NodeClaim carries them, registration syncs them
+        # onto the node — registration/controller.go:238-391)
+        pool = self.cluster.get("nodepools", nodepool_name)
         claim = NodeClaim(
             name=node_name,
             nodeclass_name=nodeclass.name,
             nodepool_name=nodepool_name,
+            taints=tuple(pool.taints) if pool is not None else (),
+            startup_taints=tuple(pool.startup_taints)
+            if pool is not None else (),
             instance_type=planned.instance_type,
             zone=planned.zone,
             capacity_type=planned.capacity_type,
@@ -162,6 +170,20 @@ class Actuator:
                                   f"{planned.instance_type}/{planned.zone}/"
                                   f"{planned.capacity_type} -> {inst.id}")
         return claim
+
+    def _build_volumes(self, node_name: str, nodeclass: NodeClass):
+        """spec.blockDeviceMappings -> boot/data volumes; default 100GB
+        general-purpose when unset (ref buildVolumeAttachments
+        vpc/instance/provider.go:1316-1494, default :477-481)."""
+        from karpenter_tpu.cloud.fake import FakeVolume
+
+        vols = []
+        for i, bdm in enumerate(nodeclass.spec.block_device_mappings):
+            v = bdm.volume
+            vols.append(FakeVolume(
+                id=f"vol-{node_name}-{i}",
+                capacity_gb=v.capacity_gb, profile=v.profile))
+        return tuple(vols)   # empty -> cloud applies the 100GB default
 
     def _resolve_subnet(self, zone: str, nodeclass: NodeClass) -> str:
         """4-way resolution (vpc/instance/provider.go:243-329): explicit

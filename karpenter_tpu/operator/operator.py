@@ -19,6 +19,7 @@ from karpenter_tpu.cloud.fake import FakeCloud
 from karpenter_tpu.cloud.loadbalancer import LoadBalancerProvider
 from karpenter_tpu.controllers import ControllerManager
 from karpenter_tpu.controllers.bootstrap import BootstrapTokenController
+from karpenter_tpu.controllers.disruption import DisruptionController
 from karpenter_tpu.controllers.faults import (
     InstanceTypeRefreshController, InterruptionController, OrphanCleanupController,
     PricingRefreshController, SpotPreemptionController,
@@ -142,6 +143,10 @@ class Operator:
         # controllers.go:267 + bootstrap/token_controller.go)
         ctrls.append(BootstrapTokenController(
             self.cluster, self.actuator.bootstrap.tokens))
+        # drift replacement + consolidation (karpenter-core's disruption
+        # plane, owned here since the framework is standalone — §3.4)
+        ctrls.append(DisruptionController(
+            self.cluster, self.cloudprovider, provisioner=self.provisioner))
         # env-gated (controllers.go:238)
         ctrls.append(OrphanCleanupController(
             self.cluster, self.cloud,
