@@ -101,6 +101,84 @@ def run(num_pods: int, num_types: int, iters: int) -> dict:
     }
 
 
+def run_fleet(num_clusters: int, num_pods: int, num_types: int,
+              iters: int) -> dict:
+    """BASELINE config #5: C cluster problems solved jointly on the chip
+    (vmapped over the fleet axis) vs the native C++ FFD looping over
+    clusters on the host — the fleet-throughput story.  Amortizes one
+    dispatch+fetch round over the whole fleet."""
+    import jax
+    import jax.numpy as jnp
+
+    from karpenter_tpu.parallel import FleetProblem, fleet_mesh, fleet_solve
+    from karpenter_tpu.solver import GreedySolver
+    from karpenter_tpu.solver.encode import encode
+    from karpenter_tpu.solver.jax_backend import _pad1, _pad2
+    from karpenter_tpu.solver.types import (
+        GROUP_BUCKETS, OFFERING_BUCKETS, SolverOptions, bucket,
+    )
+
+    per = []
+    probs = []
+    for c in range(num_clusters):
+        pods, catalog = build_workload(num_pods, num_types, seed=100 + c)
+        prob = encode(pods, catalog)
+        G = bucket(prob.num_groups, GROUP_BUCKETS)
+        O = bucket(catalog.num_offerings, OFFERING_BUCKETS)
+        per.append((
+            _pad2(prob.group_req, G), _pad1(prob.group_count, G),
+            _pad1(prob.group_cap, G), _pad2(prob.compat, G, O),
+            _pad2(catalog.offering_alloc().astype(np.int32), O),
+            _pad1(catalog.off_price.astype(np.float32), O),
+            _pad1(catalog.offering_rank_price(), O)))
+        probs.append(prob)
+    stacked = FleetProblem(*[np.stack([p[i] for p in per]) for i in range(7)])
+    N = bucket(max(num_pods // 8, 64),
+               (64, 256, 1024, 2048, 4096))
+
+    mesh = fleet_mesh(1)   # one real chip: fleet axis vmapped on-device
+    dev = [jnp.asarray(getattr(stacked, f)) for f in
+           ("group_req", "group_count", "group_cap", "compat",
+            "off_alloc", "off_price", "off_rank")]
+    devprob = FleetProblem(*dev)
+
+    def device_solve():
+        out = fleet_solve(devprob, mesh, num_nodes=N)
+        jax.block_until_ready(out)
+        return out
+
+    out = device_solve()   # warmup/compile
+    assert (np.asarray(out[2]) == 0).all(), "fleet solve left pods unplaced"
+
+    def p50(f, n):
+        xs = []
+        for _ in range(n):
+            t0 = time.perf_counter()
+            f()
+            xs.append(time.perf_counter() - t0)
+        return float(np.percentile(xs, 50))
+
+    jax_p50 = p50(device_solve, iters)
+
+    # symmetric scope: both sides consume pre-encoded problems (the
+    # provisioner keeps encodings warm across windows either way)
+    greedy = GreedySolver(SolverOptions(use_native="auto"))
+
+    def host_solve():
+        for prob in probs:
+            greedy.solve_encoded(prob)
+
+    host_p50 = p50(host_solve, max(2, iters // 4))
+    total_pods = num_clusters * num_pods
+    return {
+        "metric": f"fleet_pods_per_sec_{num_clusters}x{num_pods // 1000}k"
+                  f"pods_{num_types}types",
+        "value": round(total_pods / jax_p50, 1),
+        "unit": "pods/s",
+        "vs_baseline": round(host_p50 / jax_p50, 2),
+    }
+
+
 def main():
     import os
     if os.environ.get("JAX_PLATFORMS"):
@@ -112,6 +190,9 @@ def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--quick", action="store_true",
                     help="small config for CPU sanity")
+    ap.add_argument("--fleet", type=int, default=0, metavar="C",
+                    help="fleet mode: C clusters solved jointly "
+                         "(BASELINE config #5)")
     ap.add_argument("--pods", type=int, default=None)
     ap.add_argument("--types", type=int, default=None)
     ap.add_argument("--iters", type=int, default=None)
@@ -125,7 +206,10 @@ def main():
     types = args.types or types
     iters = args.iters or iters
 
-    result = run(pods, types, iters)
+    if args.fleet:
+        result = run_fleet(args.fleet, pods, types, max(3, iters // 4))
+    else:
+        result = run(pods, types, iters)
     print(json.dumps(result))
 
 

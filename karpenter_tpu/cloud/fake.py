@@ -418,6 +418,15 @@ class FakeCloud:
 
     # -- introspection -----------------------------------------------------
 
+    def quota_status(self) -> Tuple[int, int]:
+        """(live instances, quota limit) — the reference introspects VPC
+        quotas per resource (vpc/instance/provider.go:905-991); the fake
+        exposes the single instance quota it enforces."""
+        with self._lock:
+            live = sum(1 for i in self.instances.values()
+                       if i.status != "deleting")
+            return live, self.instance_quota
+
     def instance_count(self) -> int:
         with self._lock:
             return len(self.instances)

@@ -103,6 +103,15 @@ class Actuator:
             time.perf_counter() - t0)
         metrics.INSTANCE_LIFECYCLE.labels("created", planned.instance_type,
                                           planned.zone).inc()
+        # quota introspection (ref vpc/instance/provider.go:905-991 + the
+        # quota_utilization family, metrics.go:45)
+        try:
+            used, limit = self.cloud.quota_status()
+            if limit > 0:
+                metrics.QUOTA_UTILIZATION.labels(
+                    "instances", nodeclass.spec.region).set(used / limit)
+        except Exception:   # quota introspection must never fail a create
+            pass
         metrics.COST_PER_HOUR.labels(planned.instance_type, planned.zone,
                                      planned.capacity_type).set(planned.price)
         return claim
