@@ -51,6 +51,10 @@ def make_solver(options: SolverOptions):
     circuit-breaker config so the default path stays untouched)."""
     if options.backend == "greedy":
         return GreedySolver(options)
+    if options.backend == "remote":
+        from karpenter_tpu.service import RemoteSolver
+
+        return RemoteSolver(options.address or "127.0.0.1:50051", options)
     return JaxSolver(options)
 
 

@@ -65,7 +65,8 @@ class Options:
     def from_env(cls, env: Optional[Mapping[str, str]] = None) -> "Options":
         env = os.environ if env is None else env
         solver = SolverOptions(
-            backend=env.get("KARPENTER_SOLVER_BACKEND", "jax"))
+            backend=env.get("KARPENTER_SOLVER_BACKEND", "jax"),
+            address=env.get("KARPENTER_SOLVER_ADDRESS", ""))
         window = WindowOptions(
             idle_seconds=_getf(env, "KARPENTER_WINDOW_IDLE_SECONDS", 1.0),
             max_seconds=_getf(env, "KARPENTER_WINDOW_MAX_SECONDS", 10.0),
@@ -97,8 +98,11 @@ class Options:
             errs.append(f"zone {self.zone!r} not in region {self.region!r}")
         if not (0 <= self.spot_discount_percent <= 100):
             errs.append("spot_discount_percent must be in [0, 100]")
-        if self.solver.backend not in ("greedy", "jax"):
+        if self.solver.backend not in ("greedy", "jax", "remote"):
             errs.append(f"solver backend invalid: {self.solver.backend!r}")
+        if self.solver.backend == "remote" and not self.solver.address:
+            errs.append("solver backend 'remote' requires "
+                        "KARPENTER_SOLVER_ADDRESS")
         if self.window.idle_seconds <= 0 or \
                 self.window.max_seconds < self.window.idle_seconds:
             errs.append("window timing invalid (idle > 0, max >= idle)")

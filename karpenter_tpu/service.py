@@ -1,0 +1,240 @@
+"""Solver gRPC sidecar: host controllers <-> TPU solver over gRPC.
+
+SURVEY.md §5.8's TPU-native communication plane: the controller plane is
+a host process; the solver runs pinned to the TPU VM and serves `Solve`
+over gRPC (localhost sidecar or DCN across hosts).  The whole solve
+window crosses the wire as ONE message, and catalog tensors are uploaded
+once per generation and stay device-resident between solves (§7.4
+"host<->device boundary": batch the window into one transfer, keep the
+catalog resident).
+
+No protobuf codegen: messages are numpy ``.npz`` archives over
+raw-bytes gRPC methods (grpcio supports arbitrary serializers), so the
+wire format is self-describing and the dependency surface stays at
+grpcio + numpy.
+
+Methods (service ``karpenter.tpu.Solver``):
+
+- ``UploadCatalog``  npz{alloc,price,rank} + id/generation header ->
+  "ok" (tensors go device-resident under that key)
+- ``Solve``          npz{group_req,group_count,group_cap,compat} +
+  catalog key + options -> npz{node_off,assign,unplaced,cost}
+
+The client (:class:`RemoteSolver`) implements the same
+``solve_encoded(problem) -> Plan`` surface as the local backends, so
+``KARPENTER_SOLVER_BACKEND=remote`` + ``KARPENTER_SOLVER_ADDRESS`` drops
+in without touching the provisioner.
+"""
+
+from __future__ import annotations
+
+import io
+import threading
+import time
+from concurrent import futures
+from typing import Dict, Optional, Tuple
+
+import numpy as np
+
+from karpenter_tpu.solver.encode import EncodedProblem, decode_plan, encode
+from karpenter_tpu.solver.types import (
+    GROUP_BUCKETS, NODE_BUCKETS, OFFERING_BUCKETS, Plan, SolveRequest,
+    SolverOptions, bucket,
+)
+from karpenter_tpu.utils import metrics
+from karpenter_tpu.utils.logging import get_logger
+
+log = get_logger("service")
+
+_SERVICE = "karpenter.tpu.Solver"
+
+
+def _pack(**arrays) -> bytes:
+    buf = io.BytesIO()
+    np.savez_compressed(buf, **arrays)
+    return buf.getvalue()
+
+
+def _unpack(data: bytes) -> Dict[str, np.ndarray]:
+    return dict(np.load(io.BytesIO(data), allow_pickle=False))
+
+
+def _identity(b: bytes) -> bytes:
+    return b
+
+
+# ---------------------------------------------------------------------------
+# Server
+# ---------------------------------------------------------------------------
+
+class SolverServer:
+    """The TPU-pinned half.  Wraps a JaxSolver kernel path with a
+    catalog-upload cache keyed by (catalog_id, generation)."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0,
+                 options: Optional[SolverOptions] = None):
+        import grpc
+
+        self.options = options or SolverOptions(backend="jax")
+        self._catalogs: Dict[Tuple[str, int], Dict[str, np.ndarray]] = {}
+        self._lock = threading.Lock()
+
+        handler = grpc.method_handlers_generic_handler(_SERVICE, {
+            "Solve": grpc.unary_unary_rpc_method_handler(
+                self._solve, request_deserializer=_identity,
+                response_serializer=_identity),
+            "UploadCatalog": grpc.unary_unary_rpc_method_handler(
+                self._upload, request_deserializer=_identity,
+                response_serializer=_identity),
+        })
+        self._server = grpc.server(
+            futures.ThreadPoolExecutor(max_workers=4))
+        self._server.add_generic_rpc_handlers((handler,))
+        self.port = self._server.add_insecure_port(f"{host}:{port}")
+
+    def start(self) -> "SolverServer":
+        self._server.start()
+        log.info("solver sidecar listening", port=self.port)
+        return self
+
+    def stop(self, grace: float = 0.5) -> None:
+        self._server.stop(grace)
+
+    # -- handlers ----------------------------------------------------------
+
+    def _upload(self, request: bytes, context) -> bytes:
+        arrays = _unpack(request)
+        key = (str(arrays["catalog_id"]), int(arrays["generation"]))
+        with self._lock:
+            # keep only the latest generation per catalog id
+            self._catalogs = {k: v for k, v in self._catalogs.items()
+                              if k[0] != key[0]}
+            self._catalogs[key] = {
+                "off_alloc": arrays["off_alloc"].astype(np.int32),
+                "off_price": arrays["off_price"].astype(np.float32),
+                "off_rank": arrays["off_rank"].astype(np.float32),
+            }
+        return b"ok"
+
+    def _solve(self, request: bytes, context) -> bytes:
+        import jax.numpy as jnp
+
+        from karpenter_tpu.solver.jax_backend import solve_kernel
+
+        t0 = time.perf_counter()
+        arrays = _unpack(request)
+        key = (str(arrays["catalog_id"]), int(arrays["generation"]))
+        with self._lock:
+            cat = self._catalogs.get(key)
+        if cat is None:
+            return _pack(error=np.array("unknown catalog; re-upload"))
+
+        group_req = arrays["group_req"]
+        G, O = arrays["compat"].shape
+        N = int(arrays["num_nodes"])
+        out = solve_kernel(
+            jnp.asarray(group_req), jnp.asarray(arrays["group_count"]),
+            jnp.asarray(arrays["group_cap"]), jnp.asarray(arrays["compat"]),
+            jnp.asarray(cat["off_alloc"]), jnp.asarray(cat["off_price"]),
+            jnp.asarray(cat["off_rank"]),
+            num_nodes=N, right_size=bool(arrays["right_size"]))
+        node_off, assign, unplaced, cost = [np.asarray(o) for o in out]
+        metrics.SOLVE_DURATION.labels("sidecar").observe(
+            time.perf_counter() - t0)
+        return _pack(node_off=node_off, assign=assign, unplaced=unplaced,
+                     cost=np.float32(cost))
+
+
+# ---------------------------------------------------------------------------
+# Client
+# ---------------------------------------------------------------------------
+
+class RemoteSolver:
+    """Drop-in solver backend speaking to a :class:`SolverServer`."""
+
+    def __init__(self, address: str,
+                 options: Optional[SolverOptions] = None):
+        import grpc
+
+        self.options = options or SolverOptions(backend="remote")
+        self._channel = grpc.insecure_channel(address)
+        self._solve = self._channel.unary_unary(
+            f"/{_SERVICE}/Solve", request_serializer=_identity,
+            response_deserializer=_identity)
+        self._upload = self._channel.unary_unary(
+            f"/{_SERVICE}/UploadCatalog", request_serializer=_identity,
+            response_deserializer=_identity)
+        self._uploaded: Dict[str, int] = {}
+
+    def close(self) -> None:
+        self._channel.close()
+
+    # -- Solver surface ----------------------------------------------------
+
+    def solve(self, request: SolveRequest) -> Plan:
+        t0 = time.perf_counter()
+        problem = encode(request.pods, request.catalog, request.nodepool)
+        plan = self.solve_encoded(problem)
+        plan.solve_seconds = time.perf_counter() - t0
+        metrics.SOLVE_DURATION.labels("remote").observe(plan.solve_seconds)
+        return plan
+
+    def solve_encoded(self, problem: EncodedProblem) -> Plan:
+        from karpenter_tpu.solver.encode import estimate_nodes
+        from karpenter_tpu.solver.jax_backend import _pad1, _pad2
+
+        catalog = problem.catalog
+        if problem.num_groups == 0:
+            return Plan(nodes=[], unplaced_pods=list(problem.rejected),
+                        backend="remote")
+        G = bucket(problem.num_groups, GROUP_BUCKETS)
+        O = bucket(catalog.num_offerings, OFFERING_BUCKETS)
+        self._ensure_catalog(catalog, O)
+
+        total = int(problem.group_count.sum())
+        N_cap = min(self.options.max_nodes, bucket(max(total, 1),
+                                                   NODE_BUCKETS))
+        N = estimate_nodes(problem, N_cap, NODE_BUCKETS) \
+            if self.options.adaptive_nodes else N_cap
+        cat_id, gen = self._catalog_key(catalog)
+        while True:
+            resp = _unpack(self._solve(_pack(
+                catalog_id=np.array(cat_id), generation=np.int64(gen),
+                group_req=_pad2(problem.group_req, G),
+                group_count=_pad1(problem.group_count, G),
+                group_cap=_pad1(problem.group_cap, G),
+                compat=_pad2(problem.compat, G, O),
+                num_nodes=np.int64(N),
+                right_size=np.bool_(self.options.right_size))))
+            if "error" in resp:
+                raise RuntimeError(str(resp["error"]))
+            node_off = resp["node_off"]
+            unplaced = resp["unplaced"]
+            if (int(unplaced.sum()) > 0
+                    and int((node_off >= 0).sum()) >= N and N < N_cap):
+                N = min(N_cap, bucket(N * 4, NODE_BUCKETS))
+                continue
+            break
+        return decode_plan(problem, node_off,
+                           resp["assign"].astype(np.int32), unplaced,
+                           float(resp["cost"]), "remote")
+
+    # -- internals ---------------------------------------------------------
+
+    @staticmethod
+    def _catalog_key(catalog) -> Tuple[str, int]:
+        return (f"{catalog.uid}", hash(
+            (catalog.generation, catalog.availability_generation)) & 0x7fffffff)
+
+    def _ensure_catalog(self, catalog, O_pad: int) -> None:
+        cat_id, gen = self._catalog_key(catalog)
+        if self._uploaded.get(cat_id) == gen:
+            return
+        from karpenter_tpu.solver.jax_backend import _pad1, _pad2
+
+        self._upload(_pack(
+            catalog_id=np.array(cat_id), generation=np.int64(gen),
+            off_alloc=_pad2(catalog.offering_alloc().astype(np.int32), O_pad),
+            off_price=_pad1(catalog.off_price.astype(np.float32), O_pad),
+            off_rank=_pad1(catalog.offering_rank_price(), O_pad)))
+        self._uploaded[cat_id] = gen

@@ -32,6 +32,8 @@ class SolverOptions:
                                     # single-launch Mosaic FFD kernel
     use_native: str = "auto"        # greedy backend: C++ per-pod FFD twin
                                     # (native/ffd.cpp); "off" = pure python
+    address: str = ""               # backend "remote": solver sidecar
+                                    # gRPC address (host:port)
 
 
 @dataclass

@@ -1,0 +1,89 @@
+"""Solver gRPC sidecar tests: upload-once catalog, solve round trip,
+parity with the in-process backend, escalation, and the provisioner's
+backend gate (SURVEY.md §5.8 communication plane)."""
+
+import numpy as np
+import pytest
+
+from karpenter_tpu.catalog import CatalogArrays, InstanceTypeProvider, PricingProvider
+from karpenter_tpu.cloud.fake import FakeCloud, generate_profiles
+from karpenter_tpu.apis.pod import PodSpec, ResourceRequests, make_pods
+from karpenter_tpu.service import RemoteSolver, SolverServer
+from karpenter_tpu.solver import JaxSolver, SolveRequest
+from karpenter_tpu.solver.types import SolverOptions
+
+
+@pytest.fixture(scope="module")
+def server():
+    s = SolverServer(port=0).start()
+    yield s
+    s.stop()
+
+
+def _catalog(num_types=10):
+    cloud = FakeCloud(profiles=generate_profiles(num_types))
+    pricing = PricingProvider(cloud)
+    catalog = CatalogArrays.build(InstanceTypeProvider(cloud, pricing).list())
+    pricing.close()
+    return catalog
+
+
+def test_remote_matches_local(server):
+    catalog = _catalog()
+    rng = np.random.RandomState(5)
+    sizes = [(500, 1024), (2000, 8192)]
+    pods = [PodSpec(f"p{i}", requests=ResourceRequests(*sizes[rng.randint(2)],
+                                                       0, 1))
+            for i in range(300)]
+    req = SolveRequest(pods, catalog)
+
+    client = RemoteSolver(f"127.0.0.1:{server.port}")
+    try:
+        remote = client.solve(req)
+        local = JaxSolver().solve(req)
+        assert remote.backend == "remote"
+        assert [(n.instance_type, n.zone, n.pod_names) for n in remote.nodes] \
+            == [(n.instance_type, n.zone, n.pod_names) for n in local.nodes]
+        assert abs(remote.total_cost_per_hour
+                   - local.total_cost_per_hour) < 1e-3
+
+        # second solve: catalog upload is skipped (client-side memo)
+        uploaded = dict(client._uploaded)
+        client.solve(req)
+        assert client._uploaded == uploaded
+    finally:
+        client.close()
+
+
+def test_remote_unknown_catalog_errors(server):
+    catalog = _catalog(4)
+    client = RemoteSolver(f"127.0.0.1:{server.port}")
+    try:
+        client._uploaded[f"{catalog.uid}"] = \
+            RemoteSolver._catalog_key(catalog)[1]   # pretend uploaded
+        with pytest.raises(RuntimeError, match="unknown catalog"):
+            client.solve(SolveRequest(
+                make_pods(3, requests=ResourceRequests(500, 1024, 0, 1)),
+                catalog))
+    finally:
+        client.close()
+
+
+def test_provisioner_gate_builds_remote(server):
+    from karpenter_tpu.core.provisioner import make_solver
+
+    solver = make_solver(SolverOptions(
+        backend="remote", address=f"127.0.0.1:{server.port}"))
+    assert isinstance(solver, RemoteSolver)
+    solver.close()
+
+
+def test_options_validate_remote_address():
+    from karpenter_tpu.operator.options import Options
+
+    env = {"TPU_CLOUD_REGION": "us-south", "TPU_CLOUD_API_KEY": "k",
+           "KARPENTER_SOLVER_BACKEND": "remote"}
+    assert any("KARPENTER_SOLVER_ADDRESS" in e
+               for e in Options.from_env(env).validate())
+    env["KARPENTER_SOLVER_ADDRESS"] = "10.0.0.9:50051"
+    assert Options.from_env(env).validate() == []
