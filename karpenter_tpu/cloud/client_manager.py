@@ -1,0 +1,60 @@
+"""Cached cloud-client manager.
+
+Parity with ``pkg/utils/vpcclient`` (manager.go:52-148): client
+construction is expensive (auth handshake), so a TTL-cached instance is
+shared, with explicit invalidation on auth failures and an error-logging
+helper that classifies through the shared taxonomy.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Generic, Optional, TypeVar
+
+from karpenter_tpu.cloud.errors import CloudError, is_auth, parse_error
+from karpenter_tpu.utils.logging import get_logger
+
+log = get_logger("cloud.client_manager")
+
+C = TypeVar("C")
+
+
+class ClientManager(Generic[C]):
+    """TTL-cached client with invalidate-on-auth-failure."""
+
+    def __init__(self, build: Callable[[], C], ttl: float = 1800.0,
+                 clock: Callable[[], float] = time.monotonic):
+        self._build = build
+        self._ttl = ttl
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._client: Optional[C] = None
+        self._built_at = -float("inf")
+
+    def get(self) -> C:
+        with self._lock:
+            if self._client is None or \
+                    self._clock() - self._built_at >= self._ttl:
+                self._client = self._build()
+                self._built_at = self._clock()
+            return self._client
+
+    def invalidate(self) -> None:
+        with self._lock:
+            self._client = None
+            self._built_at = -float("inf")
+
+    def call(self, op: Callable[[C], object], operation: str = "call"):
+        """Run ``op(client)``; on auth errors the cached client is dropped
+        so the next call re-authenticates (manager.go invalidation +
+        HandleVPCError logging semantics)."""
+        try:
+            return op(self.get())
+        except Exception as e:
+            err = parse_error(e, operation)
+            if is_auth(err):
+                log.warning("auth failure; invalidating cached client",
+                            operation=operation, error=str(err))
+                self.invalidate()
+            raise

@@ -114,6 +114,10 @@ def is_quota(err: Exception) -> bool:
     return isinstance(err, CloudError) and err.code == CODE_QUOTA_EXCEEDED
 
 
+def is_auth(err: Exception) -> bool:
+    return isinstance(err, CloudError) and err.status_code in (401, 403)
+
+
 class NodeClaimNotFoundError(Exception):
     """Signals the core lifecycle to release the finalizer — the instance is
     verifiably gone (ref contract at vpc/instance/provider.go:1041-1046)."""

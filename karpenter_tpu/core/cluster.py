@@ -174,6 +174,16 @@ class ClusterState:
     # -- typed conveniences ------------------------------------------------
 
     def add_nodeclass(self, nc: NodeClass) -> NodeClass:
+        """Admission-validates the spec (the webhook analogue — ref
+        ibmnodeclass_webhook.go + the CEL rules of
+        ibmnodeclass_types.go:481-488); deep cloud checks stay with the
+        status controller."""
+        errs = nc.validate()
+        if errs:
+            from karpenter_tpu.apis.nodeclass import ValidationError
+
+            raise ValidationError(
+                f"nodeclass {nc.name} rejected at admission: {errs[:3]}")
         return self.add("nodeclasses", nc.name, nc)
 
     def get_nodeclass(self, name: str) -> Optional[NodeClass]:

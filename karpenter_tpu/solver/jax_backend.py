@@ -52,6 +52,27 @@ log = get_logger("solver.jax")
 _BIG = jnp.int32(1 << 30)
 
 
+def _maybe_trace(name: str):
+    """JAX-profiler trace span around the solve, gated by
+    KARPENTER_TPU_PROFILE_DIR (SURVEY.md §5.1: xprof traces on top of the
+    reference's duration-histogram observability).  The first call with
+    the env set starts a trace session into that directory."""
+    import contextlib
+    import os
+
+    trace_dir = os.environ.get("KARPENTER_TPU_PROFILE_DIR", "")
+    if not trace_dir:
+        return contextlib.nullcontext()
+    global _TRACE_STARTED
+    if not _TRACE_STARTED:
+        jax.profiler.start_trace(trace_dir)
+        _TRACE_STARTED = True
+    return jax.profiler.TraceAnnotation(name)
+
+
+_TRACE_STARTED = False
+
+
 # ---------------------------------------------------------------------------
 # The jitted kernel. Everything below lax-land is shape-static.
 # ---------------------------------------------------------------------------
@@ -235,8 +256,9 @@ class JaxSolver:
 
     def solve(self, request: SolveRequest) -> Plan:
         t0 = time.perf_counter()
-        problem = encode(request.pods, request.catalog, request.nodepool)
-        plan = self.solve_encoded(problem)
+        with _maybe_trace("karpenter_tpu.solve"):
+            problem = encode(request.pods, request.catalog, request.nodepool)
+            plan = self.solve_encoded(problem)
         plan.solve_seconds = time.perf_counter() - t0
         metrics.SOLVE_DURATION.labels("jax").observe(plan.solve_seconds)
         metrics.SOLVE_PODS.labels("jax").observe(len(request.pods))

@@ -218,3 +218,128 @@ class TestImageResolver:
         cloud = FakeCloud()
         with pytest.raises(CloudError):
             ImageResolver(cloud).resolve(selector=ImageSelector(os="windows"))
+
+
+class TestHTTPClientLayer:
+    """pkg/httpclient + iam.go + utils/vpcclient parity."""
+
+    def _response(self, payload=b'{"ok": true}', status=200):
+        import io
+
+        class R(io.BytesIO):
+            def __init__(self, data, status):
+                super().__init__(data)
+                self.status = status
+
+            def __enter__(self):
+                return self
+
+            def __exit__(self, *a):
+                return False
+        return R(payload, status)
+
+    def test_token_refresh_before_expiry(self):
+        from karpenter_tpu.cloud.http import TokenSource
+
+        now = [0.0]
+        calls = []
+
+        def fetch():
+            calls.append(now[0])
+            return {"access_token": f"t{len(calls)}", "expires_in": 600}
+
+        ts = TokenSource(fetch, clock=lambda: now[0])
+        assert ts.token() == "t1"
+        now[0] = 200.0          # 400s left > margin: cached
+        assert ts.token() == "t1"
+        now[0] = 350.0          # <300s left: refreshed
+        assert ts.token() == "t2"
+        ts.invalidate()
+        assert ts.token() == "t3"
+
+    def test_request_auth_header_and_json(self):
+        from karpenter_tpu.cloud.http import HTTPClient, TokenSource
+
+        seen = {}
+
+        def opener(req, timeout):
+            seen["auth"] = req.get_header("Authorization")
+            seen["url"] = req.full_url
+            seen["method"] = req.get_method()
+            return self._response(b'{"id": "i-1"}')
+
+        c = HTTPClient("https://api.example.com/v1", "vpc",
+                       TokenSource(lambda: {"access_token": "tok",
+                                            "expires_in": 3600}),
+                       opener=opener)
+        out = c.post("/instances", {"name": "n"}, operation="create_instance")
+        assert out == {"id": "i-1"}
+        assert seen["auth"] == "Bearer tok"
+        assert seen["method"] == "POST"
+        assert seen["url"].endswith("/v1/instances")
+
+    def test_http_error_becomes_typed_and_honors_retry_after(self):
+        import email.message
+        import urllib.error
+
+        from karpenter_tpu.cloud.errors import CloudError, is_rate_limit
+        from karpenter_tpu.cloud.http import HTTPClient
+
+        headers = email.message.Message()
+        headers["Retry-After"] = "7"
+        attempts = []
+
+        def opener(req, timeout):
+            attempts.append(1)
+            if len(attempts) == 1:
+                raise urllib.error.HTTPError(
+                    req.full_url, 429, "Too Many Requests", headers,
+                    io.BytesIO(b'{"errors": [{"message": "slow down", '
+                               b'"code": "rate_limited"}]}'))
+            return self._response(b'{"ok": 1}')
+
+        import io
+        waits = []
+        c = HTTPClient("https://api.example.com", "vpc", opener=opener,
+                       sleep=waits.append)
+        out = c.get("/x", operation="list")
+        assert out == {"ok": 1} and len(attempts) == 2
+        assert waits and waits[0] == 7.0   # Retry-After honored
+
+    def test_auth_failure_invalidates_token_and_client(self):
+        import urllib.error
+
+        from karpenter_tpu.cloud.client_manager import ClientManager
+        from karpenter_tpu.cloud.errors import CloudError
+
+        builds = []
+
+        def build():
+            builds.append(1)
+            return object()
+
+        mgr = ClientManager(build, ttl=3600)
+        c1 = mgr.get()
+        assert mgr.get() is c1 and len(builds) == 1
+
+        def op(client):
+            raise CloudError("expired token", 401)
+
+        try:
+            mgr.call(op, operation="list")
+        except CloudError:
+            pass
+        assert mgr.get() is not c1 and len(builds) == 2
+
+    def test_client_manager_ttl(self):
+        from karpenter_tpu.cloud.client_manager import ClientManager
+
+        now = [0.0]
+        builds = []
+        mgr = ClientManager(lambda: builds.append(1) or len(builds),
+                            ttl=100, clock=lambda: now[0])
+        assert mgr.get() == 1
+        now[0] = 50
+        assert mgr.get() == 1
+        now[0] = 150
+        assert mgr.get() == 2

@@ -135,17 +135,29 @@ class TestCircuitBreaker:
 # ---------------------------------------------------------------------------
 
 class TestClusterState:
+    @staticmethod
+    def _valid_nc(name="default"):
+        return NodeClass(name=name, spec=NodeClassSpec(
+            region="us-south", image="img-1", vpc="vpc-1",
+            instance_profile="bx2-4x16"))
+
     def test_add_get_conflict(self):
         cs = ClusterState()
-        nc = NodeClass(name="default")
+        nc = self._valid_nc()
         cs.add_nodeclass(nc)
         assert cs.get_nodeclass("default") is nc
         with pytest.raises(ConflictError):
-            cs.add_nodeclass(NodeClass(name="default"))
+            cs.add_nodeclass(self._valid_nc())
+
+    def test_admission_rejects_invalid_spec(self):
+        from karpenter_tpu.apis.nodeclass import ValidationError
+
+        with pytest.raises(ValidationError, match="rejected at admission"):
+            ClusterState().add_nodeclass(NodeClass(name="bad"))
 
     def test_optimistic_concurrency(self):
         cs = ClusterState()
-        nc = cs.add_nodeclass(NodeClass(name="default"))
+        nc = cs.add_nodeclass(self._valid_nc())
         rv = nc.resource_version
         cs.update("nodeclasses", "default", nc, expect_rv=rv)
         with pytest.raises(ConflictError):
