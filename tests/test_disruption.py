@@ -1,0 +1,139 @@
+"""Disruption controller unit tests: drift sweep, empty + underutilized
+consolidation mechanics, repack proposal (SURVEY.md §3.4 + §7.2 step 7)."""
+
+import pytest
+
+from karpenter_tpu.apis.nodeclaim import NodeClaim, NodePool
+from karpenter_tpu.apis.nodeclass import NodeClass, NodeClassSpec
+from karpenter_tpu.apis.pod import PodSpec, ResourceRequests
+from karpenter_tpu.catalog import InstanceTypeProvider, PricingProvider
+from karpenter_tpu.cloud.fake import FakeCloud
+from karpenter_tpu.controllers.disruption import DisruptionController
+from karpenter_tpu.core.cloudprovider import CloudProvider
+from karpenter_tpu.core.cluster import ClusterState
+
+
+class FakeClock:
+    def __init__(self, t=1000.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+
+@pytest.fixture
+def rig():
+    cloud = FakeCloud()
+    pricing = PricingProvider(cloud)
+    itp = InstanceTypeProvider(cloud, pricing)
+    cluster = ClusterState()
+    cluster.add_nodeclass(NodeClass(name="default", spec=NodeClassSpec(
+        region="us-south", image="img-1", vpc="vpc-1",
+        instance_profile="bx2-4x16")))
+    cp = CloudProvider(cluster, actuator=None, instance_types=itp)
+    clock = FakeClock()
+    ctrl = DisruptionController(cluster, cp, clock=clock)
+    yield cluster, ctrl, clock, itp
+    pricing.close()
+
+
+def _claim(cluster, name, itype="bx2-4x16", price=0.2, pool="default",
+           age=1000.0, node=None):
+    c = NodeClaim(name=name, nodeclass_name="default", nodepool_name=pool,
+                  instance_type=itype, zone="us-south-1",
+                  node_name=node or f"node-{name}", hourly_price=price,
+                  launched=True, registered=True, initialized=True)
+    c.created_at = age
+    cluster.add_nodeclaim(c)
+    return c
+
+
+def _pod(cluster, name, node, cpu=500, mem=1024):
+    cluster.add_pod(PodSpec(name, requests=ResourceRequests(cpu, mem, 0, 1)))
+    cluster.bind_pod(f"default/{name}", node)
+
+
+class TestEmptyConsolidation:
+    def test_policy_and_age_gates(self, rig):
+        cluster, ctrl, clock, _ = rig
+        cluster.add_nodepool(NodePool(name="never", nodeclass_name="default",
+                                      consolidation_policy="Never"))
+        young = _claim(cluster, "young", age=clock.t - 5)
+        old = _claim(cluster, "old", age=clock.t - 3600)
+        gated = _claim(cluster, "gated", pool="never", age=clock.t - 3600)
+        assert ctrl._consolidate_empty() == 1
+        assert old.deleted and not young.deleted and not gated.deleted
+
+
+class TestUnderutilizedConsolidation:
+    def test_pods_move_to_residuals_and_node_removed(self, rig):
+        cluster, ctrl, clock, itp = rig
+        # two big nodes lightly loaded + one cheap node whose pods fit
+        a = _claim(cluster, "a", itype="bx2-16x64", price=0.8,
+                   age=clock.t - 3600)
+        b = _claim(cluster, "b", itype="bx2-16x64", price=0.8,
+                   age=clock.t - 3600)
+        victim = _claim(cluster, "v", itype="bx2-2x8", price=0.1,
+                        age=clock.t - 3600)
+        _pod(cluster, "pa", a.node_name, cpu=2000, mem=4096)
+        _pod(cluster, "pb", b.node_name, cpu=2000, mem=4096)
+        _pod(cluster, "pv1", victim.node_name, cpu=500, mem=1024)
+        _pod(cluster, "pv2", victim.node_name, cpu=500, mem=1024)
+
+        moved = ctrl._consolidate_underutilized()
+        assert moved >= 1
+        assert victim.deleted
+        for key in ("default/pv1", "default/pv2"):
+            p = cluster.get("pods", key)
+            assert p.bound_node in (a.node_name, b.node_name)
+
+    def test_no_move_when_nothing_fits(self, rig):
+        cluster, ctrl, clock, _ = rig
+        a = _claim(cluster, "a", itype="bx2-2x8", price=0.1,
+                   age=clock.t - 3600)
+        b = _claim(cluster, "b", itype="bx2-2x8", price=0.1,
+                   age=clock.t - 3600)
+        # both nearly full: 2 vCPU (2000m) allocatable minus overheads
+        _pod(cluster, "pa", a.node_name, cpu=1200, mem=2048)
+        _pod(cluster, "pb", b.node_name, cpu=1200, mem=2048)
+        assert ctrl._consolidate_underutilized() == 0
+        assert not a.deleted and not b.deleted
+
+
+class TestDriftSweep:
+    def test_drifted_claim_evicted_and_deleted(self, rig):
+        cluster, ctrl, clock, _ = rig
+        claim = _claim(cluster, "d", age=clock.t - 100)
+        from karpenter_tpu.apis.nodeclass import (
+            ANNOTATION_NODECLASS_HASH, NODECLASS_HASH_VERSION,
+        )
+        nc = cluster.get_nodeclass("default")
+        claim.annotations = {
+            ANNOTATION_NODECLASS_HASH: "stale-hash",
+            "karpenter-tpu.sh/nodeclass-hash-version": NODECLASS_HASH_VERSION,
+        }
+        _pod(cluster, "pd", claim.node_name)
+        assert ctrl._replace_drifted() == 1
+        assert claim.deleted
+        p = cluster.get("pods", "default/pd")
+        assert not p.bound_node and not p.nominated_node
+
+
+class TestRepackProposal:
+    def test_savings_reported(self, rig):
+        cluster, ctrl, clock, itp = rig
+        from karpenter_tpu.core.provisioner import Provisioner
+
+        prov = Provisioner(cluster, itp, actuator=None)
+        ctrl.provisioner = prov
+        # fleet of overpriced nodes hosting small pods
+        for i in range(3):
+            c = _claim(cluster, f"r{i}", itype="bx2-16x64", price=0.8,
+                       age=clock.t - 3600)
+            _pod(cluster, f"pr{i}", c.node_name, cpu=500, mem=1024)
+        proposal = ctrl.propose_repack()
+        assert proposal is not None
+        assert proposal.current_cost == pytest.approx(2.4)
+        assert proposal.proposed_cost < proposal.current_cost
+        assert proposal.savings == pytest.approx(
+            proposal.current_cost - proposal.proposed_cost)
