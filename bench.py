@@ -136,16 +136,29 @@ def run_fleet(num_clusters: int, num_pods: int, num_types: int,
     N = bucket(max(num_pods // 8, 64),
                (64, 256, 1024, 2048, 4096))
 
-    mesh = fleet_mesh(1)   # one real chip: fleet axis vmapped on-device
-    dev = [jnp.asarray(getattr(stacked, f)) for f in
-           ("group_req", "group_count", "group_cap", "compat",
-            "off_alloc", "off_price", "off_rank")]
-    devprob = FleetProblem(*dev)
+    from karpenter_tpu.solver.pallas_kernel import pallas_path_viable
 
-    def device_solve():
-        out = fleet_solve(devprob, mesh, num_nodes=N)
-        jax.block_until_ready(out)
-        return out
+    use_pallas = (jax.default_backend() not in ("cpu", "gpu")
+                  and pallas_path_viable(stacked.compat.shape[1],
+                                         stacked.compat.shape[2],
+                                         max(N, 128)))
+    if use_pallas:
+        from karpenter_tpu.parallel import fleet_solve_pallas
+
+        def device_solve():
+            # per-cluster Mosaic dispatches + one pipelined fetch round
+            return fleet_solve_pallas(stacked, num_nodes=N)
+    else:
+        mesh = fleet_mesh(1)   # fleet axis vmapped on-device
+        dev = [jnp.asarray(getattr(stacked, f)) for f in
+               ("group_req", "group_count", "group_cap", "compat",
+                "off_alloc", "off_price", "off_rank")]
+        devprob = FleetProblem(*dev)
+
+        def device_solve():
+            out = fleet_solve(devprob, mesh, num_nodes=N)
+            jax.block_until_ready(out)
+            return out
 
     out = device_solve()   # warmup/compile
     assert (np.asarray(out[2]) == 0).all(), "fleet solve left pods unplaced"

@@ -79,6 +79,41 @@ class FleetProblem:
 # Fleet axis: clusters data-parallel
 # ---------------------------------------------------------------------------
 
+def fleet_solve_pallas(problem: FleetProblem, *, num_nodes: int,
+                       right_size: bool = True, interpret: bool = False):
+    """Single-chip fleet solve through the Mosaic kernel: one dispatch per
+    cluster (identical padded shapes -> one compilation), results fetched
+    in one pipelined D2H round.  This is the fast path for BASELINE
+    config #5 on one chip; the shard_map variants scale it across a mesh.
+    """
+    import numpy as np
+
+    from karpenter_tpu.solver.jax_backend import solve_kernel_pallas
+    from karpenter_tpu.solver.pallas_kernel import pack_catalog, pack_problem
+
+    C, G, O = problem.compat.shape
+    outs = []
+    for c in range(C):
+        meta, compat = pack_problem(
+            problem.group_req[c], problem.group_count[c],
+            problem.group_cap[c], problem.compat[c])
+        alloc8, rank_row = pack_catalog(problem.off_alloc[c],
+                                        problem.off_rank[c])
+        outs.append(solve_kernel_pallas(
+            jnp.asarray(meta), jnp.asarray(compat), jnp.asarray(alloc8),
+            jnp.asarray(rank_row), jnp.asarray(problem.off_price[c]),
+            G=G, O=O, N=max(num_nodes, 128), right_size=right_size,
+            assign_dtype="int16", interpret=interpret))
+    for out in outs:                  # one pipelined fetch round
+        for o in out:
+            o.copy_to_host_async()
+    node_off = np.stack([np.asarray(o[0]) for o in outs])
+    assign = np.stack([np.asarray(o[1]).astype(np.int32) for o in outs])
+    unplaced = np.stack([np.asarray(o[2]) for o in outs])
+    cost = np.array([float(o[3]) for o in outs], dtype=np.float32)
+    return node_off, assign, unplaced, cost
+
+
 def fleet_solve(problem: FleetProblem, mesh: Mesh, *, num_nodes: int,
                 right_size: bool = True):
     """Solve C cluster problems across the mesh's fleet axis.

@@ -117,3 +117,32 @@ def test_viability_gate():
     assert pallas_path_viable(64, 4096, 1024)
     assert not pallas_path_viable(64, 4096, 1000)      # N % 128
     assert not pallas_path_viable(2048, 4096, 16384)   # VMEM blowout
+
+
+def test_fleet_pallas_matches_fleet_scan():
+    """fleet_solve_pallas (per-cluster Mosaic dispatches) must match the
+    shard_map scan path cluster-for-cluster."""
+    import jax
+
+    from karpenter_tpu.parallel import (
+        FleetProblem, fleet_mesh, fleet_solve, fleet_solve_pallas,
+    )
+
+    per = []
+    for seed in range(2):
+        prob, catalog = _problem(num_pods=80, num_types=6, seed=seed)
+        G, O, group_req, group_count, group_cap, compat = _padded(prob, catalog)
+        per.append((group_req, group_count, group_cap, compat,
+                    _pad2(catalog.offering_alloc().astype(np.int32), O),
+                    _pad1(catalog.off_price.astype(np.float32), O),
+                    _pad1(catalog.offering_rank_price(), O)))
+    stacked = FleetProblem(*[np.stack([p[i] for p in per]) for i in range(7)])
+    N = 128
+
+    ref = fleet_solve(stacked, fleet_mesh(2, devices=jax.devices("cpu")),
+                      num_nodes=N)
+    out = fleet_solve_pallas(stacked, num_nodes=N, interpret=True)
+    np.testing.assert_array_equal(out[0], ref[0])
+    np.testing.assert_array_equal(out[1], ref[1])
+    np.testing.assert_array_equal(out[2], ref[2])
+    np.testing.assert_allclose(out[3], ref[3], atol=1e-3)
