@@ -112,6 +112,7 @@ class Operator:
         self.manager = ControllerManager(self.cluster)
         for ctrl in self._build_controllers():
             self.manager.register(ctrl)
+        self.metrics_server = None
         self._started = False
 
     def _build_controllers(self) -> List:
@@ -168,6 +169,12 @@ class Operator:
         self.manager.sync(rounds=1)    # restart = resume (SURVEY.md §5.4)
         self.manager.start()
         self.provisioner.start()
+        if self.options.metrics_port and self.metrics_server is None:
+            from karpenter_tpu.operator.server import MetricsServer
+
+            self.metrics_server = MetricsServer(
+                port=self.options.metrics_port,
+                ready_check=lambda: self._started).start()
         self._started = True
         log.info("operator started",
                  controllers=len(self.manager.controllers()),
@@ -181,5 +188,8 @@ class Operator:
             return
         self.provisioner.stop()
         self.manager.stop()
+        if self.metrics_server is not None:
+            self.metrics_server.stop()
+            self.metrics_server = None
         self._started = False
         log.info("operator stopped")

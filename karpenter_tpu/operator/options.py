@@ -54,6 +54,7 @@ class Options:
     interruption_enabled: bool = True
     orphan_cleanup_enabled: bool = False   # KARPENTER_ENABLE_ORPHAN_CLEANUP
     spot_discount_percent: int = 60        # spot = % of on-demand (options.go:76)
+    metrics_port: int = 0                  # 0 = metrics server disabled
 
     # sub-configs
     circuit_breaker: CircuitBreakerConfig = field(
@@ -82,6 +83,7 @@ class Options:
             iks_cluster_id=env.get("IKS_CLUSTER_ID", ""),
             interruption_enabled=_getb(env, "KARPENTER_ENABLE_INTERRUPTION",
                                        True),
+            metrics_port=_geti(env, "KARPENTER_METRICS_PORT", 0),
             orphan_cleanup_enabled=_getb(env, "KARPENTER_ENABLE_ORPHAN_CLEANUP",
                                          False),
             spot_discount_percent=_geti(env, "KARPENTER_SPOT_DISCOUNT_PERCENT",

@@ -1,0 +1,69 @@
+"""Metrics + health HTTP server.
+
+The observability endpoint the deploy manifests scrape (§5.5 parity with
+the reference's metrics service + probes): ``/metrics`` serves the
+Prometheus text exposition from utils/metrics, ``/healthz`` liveness,
+``/readyz`` readiness (operator started and controller manager live).
+stdlib http.server on a daemon thread — no extra dependencies.
+"""
+
+from __future__ import annotations
+
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Callable, Optional
+
+from karpenter_tpu.utils import metrics
+from karpenter_tpu.utils.logging import get_logger
+
+log = get_logger("operator.server")
+
+
+class MetricsServer:
+    def __init__(self, host: str = "0.0.0.0", port: int = 8080,
+                 ready_check: Optional[Callable[[], bool]] = None):
+        self._ready = ready_check or (lambda: True)
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def do_GET(self):  # noqa: N802 (stdlib API)
+                if self.path == "/metrics":
+                    body = metrics.render().encode()
+                    self._reply(200, body,
+                                "text/plain; version=0.0.4; charset=utf-8")
+                elif self.path == "/healthz":
+                    self._reply(200, b"ok", "text/plain")
+                elif self.path == "/readyz":
+                    if outer._ready():
+                        self._reply(200, b"ready", "text/plain")
+                    else:
+                        self._reply(503, b"not ready", "text/plain")
+                else:
+                    self._reply(404, b"not found", "text/plain")
+
+            def _reply(self, status: int, body: bytes, ctype: str):
+                self.send_response(status)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, fmt, *args):  # quiet the stdlib logger
+                pass
+
+        self._server = ThreadingHTTPServer((host, port), Handler)
+        self.port = self._server.server_address[1]
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> "MetricsServer":
+        self._thread = threading.Thread(target=self._server.serve_forever,
+                                        name="metrics-server", daemon=True)
+        self._thread.start()
+        log.info("metrics server listening", port=self.port)
+        return self
+
+    def stop(self) -> None:
+        self._server.shutdown()
+        self._server.server_close()
+        if self._thread is not None:
+            self._thread.join(timeout=2)

@@ -201,3 +201,37 @@ class TestOperator:
             assert claims and all(c.initialized for c in claims)
         finally:
             op.stop()
+
+
+class TestMetricsServer:
+    def test_metrics_health_ready_endpoints(self):
+        import urllib.request
+
+        from karpenter_tpu.operator.server import MetricsServer
+
+        ready = [False]
+        srv = MetricsServer(host="127.0.0.1", port=0,
+                            ready_check=lambda: ready[0]).start()
+        try:
+            base = f"http://127.0.0.1:{srv.port}"
+            body = urllib.request.urlopen(f"{base}/metrics").read().decode()
+            assert "karpenter_tpu_" in body
+            assert urllib.request.urlopen(f"{base}/healthz").status == 200
+            try:
+                urllib.request.urlopen(f"{base}/readyz")
+                assert False, "expected 503"
+            except urllib.error.HTTPError as e:
+                assert e.code == 503
+            ready[0] = True
+            assert urllib.request.urlopen(f"{base}/readyz").status == 200
+        finally:
+            srv.stop()
+
+    def test_operator_gates_metrics_server(self):
+        op = Operator(Options.from_env({**BASE_ENV,
+                                        "KARPENTER_METRICS_PORT": "0"}))
+        try:
+            op.start()
+            assert op.metrics_server is None   # port 0 = disabled
+        finally:
+            op.stop()
