@@ -101,6 +101,8 @@ class DisruptionController(PollController):
         pool = self.cluster.get("nodepools", claim.nodepool_name)
         return pool if pool is not None else NodePool(name="default")
 
+    EMPTY_SINCE_ANNOTATION = "karpenter-tpu.sh/empty-since"
+
     def _consolidate_empty(self) -> int:
         now = self.clock()
         n = 0
@@ -112,8 +114,20 @@ class DisruptionController(PollController):
                     "WhenEmpty", "WhenEmptyOrUnderutilized"):
                 continue
             if self._bound_pods(claim.node_name):
+                # node busy again: reset the emptiness clock so a later
+                # drain restarts the consolidateAfter damping window
+                if claim.annotations.pop(self.EMPTY_SINCE_ANNOTATION, None):
+                    self.cluster.update("nodeclaims", claim.name, claim)
                 continue
-            if now - claim.created_at < pool.consolidate_after_seconds:
+            # consolidateAfter measures from when the node *became* empty
+            # (karpenter semantics), not from node creation — a long-lived
+            # node must still wait out the window after its last pod exits
+            empty_since = claim.annotations.get(self.EMPTY_SINCE_ANNOTATION)
+            if empty_since is None:
+                claim.annotations[self.EMPTY_SINCE_ANNOTATION] = repr(now)
+                self.cluster.update("nodeclaims", claim.name, claim)
+                empty_since = repr(now)
+            if now - float(empty_since) < pool.consolidate_after_seconds:
                 continue
             log.info("empty node consolidated", claim=claim.name)
             self._evict_and_delete(claim)
@@ -207,25 +221,100 @@ class DisruptionController(PollController):
             resid = resid - self._pod_req(pk)
         return resid
 
+    def _target_labels(self, claim: NodeClaim) -> Dict[str, str]:
+        """Effective scheduling labels of the node backing ``claim``: claim
+        labels + pool static labels + well-known placement labels (mirrors
+        what the actuator/registration stamp on the real node)."""
+        from karpenter_tpu.apis.requirements import (
+            LABEL_CAPACITY_TYPE, LABEL_HOSTNAME, LABEL_INSTANCE_TYPE,
+            LABEL_NODEPOOL, LABEL_ZONE)
+
+        labels = dict(self._pool_for(claim).labels)
+        labels.update(claim.labels)
+        labels.setdefault(LABEL_INSTANCE_TYPE, claim.instance_type)
+        labels.setdefault(LABEL_ZONE, claim.zone)
+        labels.setdefault(LABEL_CAPACITY_TYPE, claim.capacity_type)
+        labels.setdefault(LABEL_NODEPOOL, claim.nodepool_name)
+        labels.setdefault(LABEL_HOSTNAME, claim.node_name)
+        return labels
+
+    def _pod_compatible(self, spec, victim: NodeClaim, target: NodeClaim,
+                        target_labels: Dict[str, str],
+                        planned_on_target: List) -> bool:
+        """Full compatibility of a pod move onto ``target`` — the same
+        constraints the solver's compat mask enforces at placement time
+        (node selectors / required affinity, taints, zone co-location,
+        hostname anti-affinity cap).  Reference karpenter simulates full
+        scheduling before consolidating; moves that only check resources
+        can silently break zone pins and taint gates."""
+        from karpenter_tpu.apis.pod import tolerates_all
+        from karpenter_tpu.solver.encode import (
+            _has_hostname_anti_affinity, _has_zone_affinity,
+            _zone_spread_constraints)
+
+        if not spec.scheduling_requirements().matches(target_labels):
+            return False
+        pool = self._pool_for(target)
+        if not tolerates_all(spec.tolerations, target.taints) or \
+                not tolerates_all(spec.tolerations, pool.taints):
+            return False
+        # zone co-schedule affinity and DoNotSchedule zone spread: keep the
+        # pod in its current zone so group purity / skew is preserved
+        if (_has_zone_affinity(spec) or _zone_spread_constraints(spec)) \
+                and target.zone != victim.zone:
+            return False
+        # hostname anti-affinity (self): at most one matching pod per node
+        if _has_hostname_anti_affinity(spec):
+            own = spec.labels_dict
+            for other in self._pods_on(target, planned_on_target):
+                if other is not None and all(
+                        other.labels_dict.get(k) == v
+                        for k, v in own.items()) and own:
+                    return False
+        return True
+
+    def _pods_on(self, claim: NodeClaim, planned: List):
+        """PodSpecs currently bound to ``claim``'s node plus any planned
+        moves onto it within this consolidation pass."""
+        out = []
+        for pk in self._bound_pods(claim.node_name):
+            pending = self.cluster.get("pods", pk)
+            if pending is not None:
+                out.append(pending.spec)
+        out.extend(planned)
+        return out
+
     def _fit_elsewhere(self, victim: NodeClaim, pods: List[str],
                        claims: List[NodeClaim],
                        resid: Dict[str, np.ndarray]
                        ) -> Optional[List[Tuple[str, NodeClaim]]]:
         """First-fit each pod into other nodes' residuals (on a working
-        copy); None if any pod does not fit."""
+        copy), honoring the pod's full scheduling constraints against each
+        candidate target; None if any pod does not fit."""
         work = {k: v.copy() for k, v in resid.items()}
         placement: List[Tuple[str, NodeClaim]] = []
+        planned: Dict[str, List] = {}
         others = [c for c in claims if c.name != victim.name]
+        labels = {c.name: self._target_labels(c) for c in others}
         for pk in pods:
             req = self._pod_req(pk)
+            pending = self.cluster.get("pods", pk)
+            spec = pending.spec if pending is not None else None
             target = None
             for c in others:
-                if (work[c.name] >= req).all():
-                    target = c
-                    break
+                if not (work[c.name] >= req).all():
+                    continue
+                if spec is not None and not self._pod_compatible(
+                        spec, victim, c, labels[c.name],
+                        planned.get(c.name, [])):
+                    continue
+                target = c
+                break
             if target is None:
                 return None
             work[target.name] = work[target.name] - req
+            if spec is not None:
+                planned.setdefault(target.name, []).append(spec)
             placement.append((pk, target))
         return placement
 

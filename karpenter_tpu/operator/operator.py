@@ -182,12 +182,20 @@ class Operator:
 
     def stop(self) -> None:
         # pricing spawns its batcher thread in __init__, so it must be
-        # closed even for a constructed-but-never-started operator
-        self.pricing.close()
+        # closed even for a constructed-but-never-started operator — but
+        # for a *started* one it must close only after the controllers
+        # stop, or the still-running pricing/instance-type refresh pollers
+        # can hit "batcher closed" mid-shutdown
         if not self._started:
+            self.pricing.close()
             return
-        self.provisioner.stop()
-        self.manager.stop()
+        try:
+            self.provisioner.stop()
+            self.manager.stop()
+        finally:
+            # even if a controller stop raises, the batcher thread must
+            # not outlive the operator
+            self.pricing.close()
         if self.metrics_server is not None:
             self.metrics_server.stop()
             self.metrics_server = None

@@ -197,6 +197,7 @@ class RemoteSolver:
         N = estimate_nodes(problem, N_cap, NODE_BUCKETS) \
             if self.options.adaptive_nodes else N_cap
         cat_id, gen = self._catalog_key(catalog)
+        reuploaded = False
         while True:
             resp = _unpack(self._solve(_pack(
                 catalog_id=np.array(cat_id), generation=np.int64(gen),
@@ -207,7 +208,16 @@ class RemoteSolver:
                 num_nodes=np.int64(N),
                 right_size=np.bool_(self.options.right_size))))
             if "error" in resp:
-                raise RuntimeError(str(resp["error"]))
+                err = str(resp["error"])
+                # a restarted sidecar loses its catalog cache; our memo
+                # would otherwise make every solve for this generation
+                # fail permanently — drop it, re-upload, retry once
+                if "unknown catalog" in err and not reuploaded:
+                    self._uploaded.pop(cat_id, None)
+                    self._ensure_catalog(catalog, O)
+                    reuploaded = True
+                    continue
+                raise RuntimeError(err)
             node_off = resp["node_off"]
             unplaced = resp["unplaced"]
             if (int(unplaced.sum()) > 0
@@ -238,3 +248,40 @@ class RemoteSolver:
             off_price=_pad1(catalog.off_price.astype(np.float32), O_pad),
             off_rank=_pad1(catalog.offering_rank_price(), O_pad)))
         self._uploaded[cat_id] = gen
+
+
+# ---------------------------------------------------------------------------
+# Module entry: `python -m karpenter_tpu.service --port 50061` runs the
+# TPU-pinned sidecar standalone (the deployment manifest's solver container).
+# ---------------------------------------------------------------------------
+
+def main(argv=None) -> None:
+    import argparse
+    import os
+    import signal
+
+    parser = argparse.ArgumentParser(description="karpenter-tpu solver sidecar")
+    # localhost-only by default: the service is unauthenticated insecure
+    # gRPC, meant to be reached from the controller container in the same
+    # pod — never from the cluster network
+    parser.add_argument("--host", default="127.0.0.1")
+    parser.add_argument("--port", type=int, default=50061)
+    args = parser.parse_args(argv)
+
+    # an ambient sitecustomize may pin jax_platforms; an explicit
+    # JAX_PLATFORMS env must win (same contract as bench.py)
+    if os.environ.get("JAX_PLATFORMS"):
+        import jax
+
+        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+
+    server = SolverServer(host=args.host, port=args.port).start()
+    done = threading.Event()
+    for sig in (signal.SIGINT, signal.SIGTERM):
+        signal.signal(sig, lambda *_: done.set())
+    done.wait()
+    server.stop()
+
+
+if __name__ == "__main__":
+    main()

@@ -49,7 +49,10 @@ from karpenter_tpu.utils.logging import get_logger
 
 log = get_logger("solver.jax")
 
-_BIG = jnp.int32(1 << 30)
+# plain int: weak-typed in jnp.where, and a module-level jnp constant
+# would initialize the JAX backend at import time (hanging process start
+# whenever the TPU tunnel is slow — the solver must stay import-safe)
+_BIG = 1 << 30
 
 
 def _maybe_trace(name: str):

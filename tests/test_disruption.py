@@ -54,15 +54,44 @@ def _pod(cluster, name, node, cpu=500, mem=1024):
 
 
 class TestEmptyConsolidation:
-    def test_policy_and_age_gates(self, rig):
+    def test_policy_and_emptiness_gates(self, rig):
+        """consolidateAfter measures from when the node was *observed*
+        empty, not from creation: the first pass only stamps, deletion
+        happens once the emptiness window has elapsed — and Never-policy
+        pools are exempt throughout."""
         cluster, ctrl, clock, _ = rig
         cluster.add_nodepool(NodePool(name="never", nodeclass_name="default",
                                       consolidation_policy="Never"))
         young = _claim(cluster, "young", age=clock.t - 5)
         old = _claim(cluster, "old", age=clock.t - 3600)
         gated = _claim(cluster, "gated", pool="never", age=clock.t - 3600)
+        # pass 1: nothing deleted — even the hour-old node only now became
+        # observably empty (the old created_at gate deleted it instantly)
+        assert ctrl._consolidate_empty() == 0
+        assert ctrl.EMPTY_SINCE_ANNOTATION in old.annotations
+        assert ctrl.EMPTY_SINCE_ANNOTATION not in gated.annotations
+        # pass 2 after the window: both empty nodes go, the gated one stays
+        clock.t += 31
+        assert ctrl._consolidate_empty() == 2
+        assert old.deleted and young.deleted and not gated.deleted
+
+    def test_emptiness_clock_resets_when_pod_returns(self, rig):
+        cluster, ctrl, clock, _ = rig
+        claim = _claim(cluster, "a", age=clock.t - 3600)
+        assert ctrl._consolidate_empty() == 0          # stamped
+        clock.t += 20
+        _pod(cluster, "p", claim.node_name)            # node busy again
+        assert ctrl._consolidate_empty() == 0
+        assert ctrl.EMPTY_SINCE_ANNOTATION not in claim.annotations
+        # drain again: the 30s damping window restarts from scratch
+        cluster.delete("pods", "default/p")
+        clock.t += 15
+        assert ctrl._consolidate_empty() == 0          # re-stamped at +35
+        clock.t += 20                                  # only 20s empty
+        assert ctrl._consolidate_empty() == 0
+        clock.t += 15                                  # 35s empty
         assert ctrl._consolidate_empty() == 1
-        assert old.deleted and not young.deleted and not gated.deleted
+        assert claim.deleted
 
 
 class TestUnderutilizedConsolidation:
@@ -98,6 +127,66 @@ class TestUnderutilizedConsolidation:
         _pod(cluster, "pb", b.node_name, cpu=1200, mem=2048)
         assert ctrl._consolidate_underutilized() == 0
         assert not a.deleted and not b.deleted
+
+    def test_move_respects_node_selector(self, rig):
+        """A pod zone-pinned by nodeSelector must not be rebound onto a
+        resource-fitting node in another zone (the solver's compat mask
+        enforces this at placement; the move path must too)."""
+        from karpenter_tpu.apis.requirements import LABEL_ZONE
+
+        cluster, ctrl, clock, _ = rig
+        big = _claim(cluster, "big", itype="bx2-16x64", price=0.8,
+                     age=clock.t - 3600)
+        big.zone = "us-south-2"
+        victim = _claim(cluster, "v", itype="bx2-2x8", price=0.1,
+                        age=clock.t - 3600)   # zone us-south-1
+        cluster.add_pod(PodSpec(
+            "pinned", requests=ResourceRequests(500, 1024, 0, 1),
+            node_selector=((LABEL_ZONE, "us-south-1"),)))
+        cluster.bind_pod("default/pinned", victim.node_name)
+        assert ctrl._consolidate_underutilized() == 0
+        assert not victim.deleted
+        assert cluster.get("pods", "default/pinned").bound_node \
+            == victim.node_name
+
+    def test_move_respects_taints(self, rig):
+        """Pods without a toleration for the target's taints stay put."""
+        from karpenter_tpu.apis.pod import Taint
+
+        cluster, ctrl, clock, _ = rig
+        tainted = _claim(cluster, "t", itype="bx2-16x64", price=0.8,
+                         age=clock.t - 3600)
+        tainted.taints = (Taint(key="dedicated", value="gpu",
+                                effect="NoSchedule"),)
+        victim = _claim(cluster, "v", itype="bx2-2x8", price=0.1,
+                        age=clock.t - 3600)
+        _pod(cluster, "plain", victim.node_name)
+        assert ctrl._consolidate_underutilized() == 0
+        assert not victim.deleted
+
+    def test_move_respects_hostname_anti_affinity(self, rig):
+        """Self hostname anti-affinity: the move must not co-locate two
+        replicas on the same target node even when resources fit."""
+        from karpenter_tpu.apis.pod import PodAffinityTerm
+
+        cluster, ctrl, clock, _ = rig
+        target = _claim(cluster, "big", itype="bx2-16x64", price=0.8,
+                        age=clock.t - 3600)
+        victim = _claim(cluster, "v", itype="bx2-4x16", price=0.2,
+                        age=clock.t - 3600)
+        anti = PodAffinityTerm(label_selector=(("app", "web"),),
+                               topology_key="kubernetes.io/hostname",
+                               anti=True)
+        for name, node in (("web-1", target.node_name),
+                           ("web-2", victim.node_name)):
+            cluster.add_pod(PodSpec(
+                name, requests=ResourceRequests(500, 1024, 0, 1),
+                labels=(("app", "web"),), affinity=(anti,)))
+            cluster.bind_pod(f"default/{name}", node)
+        assert ctrl._consolidate_underutilized() == 0
+        assert not victim.deleted
+        assert cluster.get("pods", "default/web-2").bound_node \
+            == victim.node_name
 
 
 class TestDriftSweep:

@@ -90,9 +90,15 @@ def test_basic_workflow_provision_and_deprovision():
         from karpenter_tpu.controllers.disruption import DisruptionController
         ctrl = next(c for c in op.manager._poll
                     if isinstance(c, DisruptionController))
-        # consolidate_after defaults to 30s; use a direct pass with aged claims
+        # consolidate_after defaults to 30s, measured from observed
+        # emptiness: the first pass stamps empty-since, then we age the
+        # stamps and the second pass deletes
+        ctrl.reconcile()
+        assert not any(c.deleted for c in op.cluster.nodeclaims())
         for c in op.cluster.nodeclaims():
-            c.created_at -= 3600
+            ann = c.annotations.get(ctrl.EMPTY_SINCE_ANNOTATION)
+            assert ann is not None
+            c.annotations[ctrl.EMPTY_SINCE_ANNOTATION] = repr(float(ann) - 3600)
         ctrl.reconcile()
         assert all(c.deleted for c in op.cluster.nodeclaims())
     finally:

@@ -55,16 +55,37 @@ def test_remote_matches_local(server):
         client.close()
 
 
-def test_remote_unknown_catalog_errors(server):
+def test_remote_unknown_catalog_raises_when_reupload_fails(server):
+    """If the catalog is STILL unknown after the one re-upload retry
+    (e.g. upload path broken), the error must surface — not loop."""
     catalog = _catalog(4)
     client = RemoteSolver(f"127.0.0.1:{server.port}")
     try:
         client._uploaded[f"{catalog.uid}"] = \
-            RemoteSolver._catalog_key(catalog)[1]   # pretend uploaded
+            RemoteSolver._catalog_key(catalog)[1]   # stale memo
+        client._ensure_catalog = lambda *a, **k: None   # re-upload no-ops
         with pytest.raises(RuntimeError, match="unknown catalog"):
             client.solve(SolveRequest(
                 make_pods(3, requests=ResourceRequests(500, 1024, 0, 1)),
                 catalog))
+    finally:
+        client.close()
+
+
+def test_remote_recovers_from_sidecar_catalog_loss(server):
+    """A restarted sidecar loses its catalog cache; the client must drop
+    its upload memo, re-upload, and retry the solve instead of failing
+    every subsequent window for this catalog generation."""
+    catalog = _catalog(4)
+    client = RemoteSolver(f"127.0.0.1:{server.port}")
+    try:
+        client._uploaded[f"{catalog.uid}"] = \
+            RemoteSolver._catalog_key(catalog)[1]   # memo says uploaded...
+        # ...but the server has never seen it (simulates sidecar restart)
+        plan = client.solve(SolveRequest(
+            make_pods(3, requests=ResourceRequests(500, 1024, 0, 1)),
+            catalog))
+        assert not plan.unplaced_pods and plan.nodes
     finally:
         client.close()
 
