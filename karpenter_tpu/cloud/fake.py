@@ -15,13 +15,20 @@ from __future__ import annotations
 
 import itertools
 import threading
-import time
 from collections import defaultdict
-from dataclasses import dataclass, field
 from typing import Callable, Dict, List, Optional, Tuple
 
 from karpenter_tpu.cloud.errors import CloudError, not_found
 from karpenter_tpu.cloud.profile import InstanceProfile
+from karpenter_tpu.cloud.resources import VNI, Image, Instance, Subnet, Volume
+
+# Historical names — the DTOs moved to cloud/resources.py so the HTTP
+# clients share them; existing imports keep working.
+FakeInstance = Instance
+FakeSubnet = Subnet
+FakeImage = Image
+FakeVNI = VNI
+FakeVolume = Volume
 
 
 def _snap(obj):
@@ -37,61 +44,6 @@ def _snap(obj):
             v = list(v)
         kw[f.name] = v
     return type(obj)(**kw)
-
-
-@dataclass
-class FakeInstance:
-    id: str
-    name: str
-    profile: str
-    zone: str
-    subnet_id: str
-    image_id: str
-    capacity_type: str = "on-demand"   # availability policy analogue
-    status: str = "running"            # pending|running|stopped|deleting
-    status_reason: str = ""
-    tags: Dict[str, str] = field(default_factory=dict)
-    security_group_ids: Tuple[str, ...] = ()
-    vni_id: str = ""
-    volume_ids: Tuple[str, ...] = ()
-    user_data: str = ""
-    created_at: float = field(default_factory=time.time)
-    ip_address: str = ""
-
-
-@dataclass
-class FakeSubnet:
-    id: str
-    zone: str
-    total_ips: int = 256
-    available_ips: int = 256
-    state: str = "available"
-    tags: Dict[str, str] = field(default_factory=dict)
-    vpc_id: str = "vpc-1"
-
-
-@dataclass
-class FakeImage:
-    id: str
-    name: str                          # e.g. "ubuntu-24-04-amd64"
-    os: str = "ubuntu"
-    architecture: str = "amd64"
-    status: str = "available"
-    visibility: str = "public"
-    created_at: float = 0.0
-
-
-@dataclass
-class FakeVNI:
-    id: str
-    subnet_id: str
-
-
-@dataclass
-class FakeVolume:
-    id: str
-    capacity_gb: int
-    profile: str
 
 
 class CallRecorder:

@@ -15,34 +15,16 @@ from __future__ import annotations
 
 import itertools
 import threading
-import time
-from dataclasses import dataclass, field
 from typing import Dict, List, Optional
 
 from karpenter_tpu.cloud.errors import CloudError, not_found
 from karpenter_tpu.cloud.fake import CallRecorder, FakeCloud
+from karpenter_tpu.cloud.resources import Worker, WorkerPool
 
-
-@dataclass
-class FakeWorkerPool:
-    id: str
-    name: str
-    flavor: str                  # instance profile name
-    zones: List[str]
-    size_per_zone: int
-    state: str = "normal"        # normal | resizing | deleting
-    labels: Dict[str, str] = field(default_factory=dict)
-    dynamic: bool = False        # created by karpenter (eligible for cleanup)
-    created_at: float = field(default_factory=time.time)
-
-
-@dataclass
-class FakeWorker:
-    id: str
-    pool_id: str
-    zone: str
-    instance_id: str             # backing FakeCloud instance
-    state: str = "provisioning"  # provisioning | deployed | deleting
+# Historical names — DTOs live in cloud/resources.py, shared with the
+# HTTP-backed IKS client.
+FakeWorkerPool = WorkerPool
+FakeWorker = Worker
 
 
 class FakeIKS:
@@ -186,6 +168,30 @@ class FakeIKS:
     def worker_instance_id(self, worker_id: str) -> str:
         """Worker -> VPC instance mapping (ref iks.go:195)."""
         return self.get_worker(worker_id).instance_id
+
+    def register_worker(self, instance_id: str, pool_id: str = "") -> FakeWorker:
+        """IKS-API bootstrap: register an EXISTING VPC instance as a
+        cluster worker (ref AddWorkerToIKSCluster, iks_api.go:53) — the
+        control plane joins the node, no cloud-init token dance."""
+        self.recorder.record("register_worker", instance_id, pool_id)
+        self.recorder.maybe_raise("register_worker")
+        inst = self.cloud.get_instance(instance_id)
+        with self._lock:
+            if pool_id and pool_id not in self.pools:
+                raise not_found("worker_pool", pool_id)
+            worker = FakeWorker(id=f"worker-{inst.id}", pool_id=pool_id,
+                                zone=inst.zone, instance_id=inst.id)
+            self.workers[worker.id] = worker
+            return worker
+
+    def get_cluster_config(self) -> Dict:
+        """Cluster config for bootstrap decisions (ref iks.go:248)."""
+        self.recorder.record("get_cluster_config")
+        self.recorder.maybe_raise("get_cluster_config")
+        return {"cluster_id": self.cluster_id,
+                "kube_version": self.kube_version,
+                "api_endpoint": f"https://{self.cluster_id}.cluster.local:6443",
+                "ca_bundle": "fake-ca"}
 
     def deploy_worker(self, worker_id: str) -> None:
         """Test hook: worker finishes provisioning."""

@@ -76,8 +76,26 @@ class Operator:
             credential_provider or EnvCredentialProvider())
         self.credentials.get()
 
+        # cloud selection (VERDICT round 1 item 3: env selects fake vs
+        # real): an explicit injected client wins; TPU_CLOUD_ENDPOINT
+        # builds the HTTP-backed clients; default is the in-memory fake
+        # (simulation environment)
+        if cloud is None and self.options.cloud_endpoint:
+            from karpenter_tpu.cloud.vpc import VPCCloudClient
+
+            creds = self.credentials.get()
+            cloud = VPCCloudClient(self.options.cloud_endpoint,
+                                   creds.api_key,
+                                   region=self.options.region)
         self.cloud = cloud if cloud is not None else \
             FakeCloud(region=self.options.region)
+        if iks is None and self.options.cloud_endpoint \
+                and self.options.iks_cluster_id:
+            from karpenter_tpu.cloud.iks import IKSClient
+
+            iks = IKSClient(self.options.cloud_endpoint,
+                            self.options.iks_cluster_id,
+                            api_key=self.credentials.get().api_key)
         self.iks = iks
         self.cluster = cluster or ClusterState()
         self.unavailable = UnavailableOfferings()
@@ -190,14 +208,18 @@ class Operator:
             self.pricing.close()
             return
         try:
-            self.provisioner.stop()
-            self.manager.stop()
+            try:
+                self.provisioner.stop()
+            finally:
+                # manager must stop even if the provisioner raised —
+                # otherwise its refresh pollers outlive the close below
+                self.manager.stop()
         finally:
-            # even if a controller stop raises, the batcher thread must
-            # not outlive the operator
+            # even if a controller stop raises, the batcher thread and the
+            # metrics server must not outlive the operator
             self.pricing.close()
-        if self.metrics_server is not None:
-            self.metrics_server.stop()
-            self.metrics_server = None
+            if self.metrics_server is not None:
+                self.metrics_server.stop()
+                self.metrics_server = None
         self._started = False
         log.info("operator stopped")

@@ -48,6 +48,8 @@ class Options:
     zone: str = ""
     resource_group: str = ""
     api_key: str = ""                 # cloud API credential (validated at boot)
+    cloud_endpoint: str = ""          # cloud REST endpoint; set -> real
+                                      # HTTP clients instead of the fakes
     iks_cluster_id: str = ""          # forces IKS mode when set (factory.go:128)
 
     # behavior toggles
@@ -80,6 +82,7 @@ class Options:
             zone=env.get("TPU_CLOUD_ZONE", ""),
             resource_group=env.get("TPU_CLOUD_RESOURCE_GROUP", ""),
             api_key=resolve_api_key(env),
+            cloud_endpoint=env.get("TPU_CLOUD_ENDPOINT", ""),
             iks_cluster_id=env.get("IKS_CLUSTER_ID", ""),
             interruption_enabled=_getb(env, "KARPENTER_ENABLE_INTERRUPTION",
                                        True),

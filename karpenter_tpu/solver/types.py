@@ -32,6 +32,10 @@ class SolverOptions:
                                     # single-launch Mosaic FFD kernel
     use_native: str = "auto"        # greedy backend: C++ per-pod FFD twin
                                     # (native/ffd.cpp); "off" = pure python
+    compact_assign: str = "auto"    # COO-compact the [G,N] assign matrix on
+                                    # device before the D2H fetch ("auto" =
+                                    # TPU only — the dominant transfer
+                                    # shrinks from G*N entries to <=pods)
     address: str = ""               # backend "remote": solver sidecar
                                     # gRPC address (host:port)
 
@@ -103,3 +107,6 @@ def _next_pow2(n: int) -> int:
 GROUP_BUCKETS = (32, 64, 128, 256, 512, 1024, 2048)
 OFFERING_BUCKETS = (128, 256, 512, 1024, 2048, 4096)
 NODE_BUCKETS = (64, 256, 1024, 2048, 4096, 8192, 16384)
+# COO capacity buckets for the compacted assign fetch: nnz <= placed pods
+# (every entry carries >=1 pod), so sizing by total pods is always safe
+COO_BUCKETS = (256, 1024, 4096, 16384, 65536)

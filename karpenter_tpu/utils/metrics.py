@@ -249,6 +249,14 @@ SOLVE_PODS = Histogram(
 SOLVE_COST = Gauge(
     "karpenter_tpu_solve_plan_cost_per_hour",
     "Hourly cost of the last plan", ("backend",))
+SOLVE_PATH = Counter(
+    "karpenter_tpu_solve_path_total",
+    "Device solves by kernel path (pallas vs lax.scan fallback) — makes "
+    "silent pallas-viability fallbacks observable", ("path",))
+SOLVE_D2H_BYTES = Histogram(
+    "karpenter_tpu_solve_d2h_bytes",
+    "Device->host result bytes per solve", ("backend",),
+    buckets=(1 << 10, 1 << 13, 1 << 16, 1 << 18, 1 << 20, 1 << 22, 1 << 24))
 
 # Autoplacement families (autoplacement/metrics.go:81).
 AUTOPLACEMENT_SELECTIONS = Counter(

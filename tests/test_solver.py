@@ -156,6 +156,40 @@ class TestJaxBackend:
         # right-sizing means jax must match or beat greedy cost
         assert jaxp.total_cost_per_hour <= greedy.total_cost_per_hour + 1e-6
 
+    @pytest.mark.parametrize("seed", [0, 5])
+    def test_compact_assign_bit_identical_to_dense(self, catalog, seed):
+        """The COO-compacted result fetch (the D2H payload shrink for slow
+        links) must reproduce the dense decode exactly — same nodes, same
+        pod-name allocation, same cost."""
+        pods = seeded_mixed_pods(300, seed=seed)
+        dense = JaxSolver(SolverOptions(compact_assign="off")).solve(
+            SolveRequest(pods, catalog))
+        compact = JaxSolver(SolverOptions(compact_assign="on")).solve(
+            SolveRequest(pods, catalog))
+        assert [(n.instance_type, n.zone, n.capacity_type, n.pod_names)
+                for n in compact.nodes] == \
+            [(n.instance_type, n.zone, n.capacity_type, n.pod_names)
+             for n in dense.nodes]
+        assert compact.unplaced_pods == dense.unplaced_pods
+        assert compact.total_cost_per_hour == pytest.approx(
+            dense.total_cost_per_hour, rel=1e-6)
+        assert validate_plan(compact, pods, catalog) == []
+
+    def test_compact_assign_expand_roundtrip(self):
+        """expand_coo_assign inverts the device-side compaction for any
+        count matrix whose nnz fits the COO capacity."""
+        import numpy as np
+        import jax.numpy as jnp
+
+        from karpenter_tpu.solver.jax_backend import (
+            _compact_assign, expand_coo_assign)
+
+        rng = np.random.RandomState(3)
+        dense = rng.randint(0, 4, size=(17, 33)).astype(np.int16)
+        idx, cnt = _compact_assign(jnp.asarray(dense), 1024)
+        out = expand_coo_assign(np.asarray(idx), np.asarray(cnt), 17, 33)
+        assert (out == dense).all()
+
     def test_without_rightsizing_cost_equals_oracle(self, catalog):
         pods = seeded_mixed_pods(200, seed=7)
         greedy = GreedySolver().solve(SolveRequest(pods, catalog))
