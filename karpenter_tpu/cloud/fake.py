@@ -232,13 +232,49 @@ class FakeCloud:
         self.recorder.maybe_raise("get_default_security_group")
         return self.default_security_group
 
+    # -- network interfaces / volumes (staged allocation) ------------------
+
+    def create_vni(self, subnet_id: str) -> FakeVNI:
+        """Standalone VNI allocation — the first stage of the reference's
+        staged create (vpc/instance/provider.go:333-401); a later instance
+        create attaches it, a failed create must clean it up."""
+        self.recorder.record("create_vni", subnet_id)
+        self.recorder.maybe_raise("create_vni")
+        with self._lock:
+            subnet = self.subnets.get(subnet_id)
+            if subnet is None:
+                raise not_found("subnet", subnet_id)
+            if subnet.available_ips <= 0:
+                raise CloudError(f"subnet {subnet_id} has no available IPs",
+                                 409, retryable=False)
+            vni = FakeVNI(id=f"vni-{next(self._seq)}", subnet_id=subnet_id)
+            self.vnis[vni.id] = vni
+            return vni
+
+    def create_volume(self, capacity_gb: int = 100,
+                      profile: str = "general-purpose",
+                      volume_id: str = "") -> FakeVolume:
+        """Standalone volume allocation (second stage of staged create)."""
+        self.recorder.record("create_volume", volume_id or capacity_gb)
+        self.recorder.maybe_raise("create_volume")
+        with self._lock:
+            vol = FakeVolume(id=volume_id or f"vol-{next(self._seq)}",
+                             capacity_gb=capacity_gb, profile=profile)
+            self.volumes[vol.id] = vol
+            return vol
+
     # -- instance lifecycle ------------------------------------------------
 
     def create_instance(self, name: str, profile: str, zone: str, subnet_id: str,
                         image_id: str, capacity_type: str = "on-demand",
                         security_group_ids: Tuple[str, ...] = (),
                         user_data: str = "", tags: Optional[Dict[str, str]] = None,
-                        volumes: Tuple[FakeVolume, ...] = ()) -> FakeInstance:
+                        volumes: Tuple[FakeVolume, ...] = (),
+                        vni_id: str = "",
+                        volume_ids: Tuple[str, ...] = ()) -> FakeInstance:
+        """Create an instance.  With ``vni_id``/``volume_ids`` it ATTACHES
+        pre-allocated resources (staged create); otherwise it allocates
+        them implicitly (legacy one-shot path)."""
         self.recorder.record("create_instance", name, profile, zone, capacity_type)
         self.recorder.maybe_raise("create_instance")
         with self._lock:
@@ -257,6 +293,11 @@ class FakeCloud:
                                  retryable=False)
             if image_id not in self.images:
                 raise not_found("image", image_id)
+            if vni_id and vni_id not in self.vnis:
+                raise not_found("vni", vni_id)
+            for vid in volume_ids:
+                if vid not in self.volumes:
+                    raise not_found("volume", vid)
             live = sum(1 for i in self.instances.values()
                        if i.status not in ("deleting",))
             if live >= self.instance_quota:
@@ -272,18 +313,26 @@ class FakeCloud:
                         f"insufficient capacity for {profile} in {zone}", 503,
                         code="insufficient_capacity", retryable=False)
             n = next(self._seq)
-            vni = FakeVNI(id=f"vni-{n}", subnet_id=subnet_id)
-            self.vnis[vni.id] = vni
-            vols = tuple(volumes) or (FakeVolume(id=f"vol-{n}", capacity_gb=100,
-                                                 profile="general-purpose"),)
-            for v in vols:
-                self.volumes[v.id] = v
+            if vni_id:
+                vni = self.vnis[vni_id]
+            else:
+                vni = FakeVNI(id=f"vni-{n}", subnet_id=subnet_id)
+                self.vnis[vni.id] = vni
+            if volume_ids:
+                vol_ids = tuple(volume_ids)
+            else:
+                vols = tuple(volumes) or (FakeVolume(id=f"vol-{n}",
+                                                     capacity_gb=100,
+                                                     profile="general-purpose"),)
+                for v in vols:
+                    self.volumes[v.id] = v
+                vol_ids = tuple(v.id for v in vols)
             inst = FakeInstance(
                 id=f"inst-{n:06d}", name=name, profile=profile, zone=zone,
                 subnet_id=subnet_id, image_id=image_id,
                 capacity_type=capacity_type,
                 security_group_ids=tuple(security_group_ids) or (self.default_security_group,),
-                vni_id=vni.id, volume_ids=tuple(v.id for v in vols),
+                vni_id=vni.id, volume_ids=vol_ids,
                 user_data=user_data, tags=dict(tags or {}),
                 ip_address=f"10.0.{len(self.instances) // 250}.{len(self.instances) % 250 + 4}")
             self.instances[inst.id] = inst

@@ -118,6 +118,16 @@ class StubCloudServer:
             return {"images": [image_to_json(m) for m in cloud.list_images()]}
         if path == "/v1/vpcs/default/security_group":
             return {"id": cloud.get_default_security_group()}
+        if path == "/v1/virtual_network_interfaces" and method == "POST":
+            vni = cloud.create_vni(body.get("subnet_id", ""))
+            return {"id": vni.id, "subnet_id": vni.subnet_id}
+        if path == "/v1/volumes" and method == "POST":
+            vol = cloud.create_volume(
+                capacity_gb=int(body.get("capacity_gb", 100)),
+                profile=body.get("profile", "general-purpose"),
+                volume_id=body.get("volume_id", ""))
+            return {"id": vol.id, "capacity_gb": vol.capacity_gb,
+                    "profile": vol.profile}
         if path == "/v1/instances" and method == "POST":
             vols = tuple(Volume(id=v.get("id", ""),
                                 capacity_gb=int(v.get("capacity_gb", 100)),
@@ -131,7 +141,9 @@ class StubCloudServer:
                 capacity_type=body.get("capacity_type", "on-demand"),
                 security_group_ids=tuple(body.get("security_group_ids") or ()),
                 user_data=body.get("user_data", ""),
-                tags=body.get("tags") or {}, volumes=vols)
+                tags=body.get("tags") or {}, volumes=vols,
+                vni_id=body.get("vni_id", ""),
+                volume_ids=tuple(body.get("volume_ids") or ()))
             return instance_to_json(inst)
         if path == "/v1/instances" and method == "GET":
             if query.get("availability") == ["spot"]:

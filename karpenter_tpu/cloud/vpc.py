@@ -179,6 +179,23 @@ class VPCCloudClient:
         return self.http.get("/v1/vpcs/default/security_group",
                              "get_default_sg")["id"]
 
+    # -- staged allocation (ref vpc.go:448-478 VNIs, :416-446 volumes) -----
+
+    def create_vni(self, subnet_id: str) -> VNI:
+        data = self.http.post("/v1/virtual_network_interfaces",
+                              {"subnet_id": subnet_id}, "create_vni")
+        return VNI(id=data["id"], subnet_id=data.get("subnet_id", subnet_id))
+
+    def create_volume(self, capacity_gb: int = 100,
+                      profile: str = "general-purpose",
+                      volume_id: str = "") -> Volume:
+        data = self.http.post("/v1/volumes",
+                              {"capacity_gb": capacity_gb, "profile": profile,
+                               "volume_id": volume_id}, "create_volume")
+        return Volume(id=data["id"],
+                      capacity_gb=int(data.get("capacity_gb", capacity_gb)),
+                      profile=data.get("profile", profile))
+
     # -- instance lifecycle (ref vpc.go:125-232) ---------------------------
 
     def create_instance(self, name: str, profile: str, zone: str,
@@ -187,7 +204,9 @@ class VPCCloudClient:
                         security_group_ids: Tuple[str, ...] = (),
                         user_data: str = "",
                         tags: Optional[Dict[str, str]] = None,
-                        volumes: Tuple[Volume, ...] = ()) -> Instance:
+                        volumes: Tuple[Volume, ...] = (),
+                        vni_id: str = "",
+                        volume_ids: Tuple[str, ...] = ()) -> Instance:
         body = {
             "name": name, "profile": profile, "zone": zone,
             "subnet_id": subnet_id, "image_id": image_id,
@@ -196,6 +215,7 @@ class VPCCloudClient:
             "user_data": user_data, "tags": dict(tags or {}),
             "volumes": [{"id": v.id, "capacity_gb": v.capacity_gb,
                          "profile": v.profile} for v in volumes],
+            "vni_id": vni_id, "volume_ids": list(volume_ids),
         }
         return instance_from_json(
             self.http.post("/v1/instances", body, "create_instance"))

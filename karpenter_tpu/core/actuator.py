@@ -133,16 +133,8 @@ class Actuator:
             architecture=labels.get("kubernetes.io/arch", "amd64"),
             region=nodeclass.spec.region, zone=planned.zone, labels=labels))
 
-        inst = self.cloud.create_instance(
-            name=node_name, profile=planned.instance_type, zone=planned.zone,
-            subnet_id=subnet_id, image_id=image_id,
-            capacity_type=planned.capacity_type,
-            security_group_ids=sgs or (),
-            user_data=user_data,
-            volumes=self._build_volumes(node_name, nodeclass),
-            tags={**KARPENTER_TAGS,
-                  "karpenter.sh/nodepool": nodepool_name,
-                  "karpenter-tpu.sh/nodeclass": nodeclass.name})
+        inst = self._staged_create(planned, nodeclass, node_name, subnet_id,
+                                   image_id, sgs, user_data, nodepool_name)
 
         # the claim inherits the pool's taints/startup taints (karpenter
         # core semantics: NodeClaim carries them, registration syncs them
@@ -180,19 +172,55 @@ class Actuator:
                                   f"{planned.capacity_type} -> {inst.id}")
         return claim
 
-    def _build_volumes(self, node_name: str, nodeclass: NodeClass):
-        """spec.blockDeviceMappings -> boot/data volumes; default 100GB
-        general-purpose when unset (ref buildVolumeAttachments
-        vpc/instance/provider.go:1316-1494, default :477-481)."""
-        from karpenter_tpu.cloud.fake import FakeVolume
+    def _staged_create(self, planned: PlannedNode, nodeclass: NodeClass,
+                       node_name: str, subnet_id: str, image_id: str,
+                       sgs, user_data: str, nodepool_name: str):
+        """Staged allocation with partial-failure cleanup (ref
+        vpc/instance/provider.go:333-401 VNI prototype, :477-481 volumes,
+        :720-797 create with orphan cleanup :1192-1312): allocate VNI ->
+        volumes -> instance; any stage failing deletes what the earlier
+        stages allocated, so a failed create leaks nothing."""
+        vni_id = ""
+        created_volume_ids: List[str] = []
+        try:
+            vni_id = self.cloud.create_vni(subnet_id).id
+            for i, bdm in enumerate(nodeclass.spec.block_device_mappings):
+                v = bdm.volume
+                created_volume_ids.append(self.cloud.create_volume(
+                    capacity_gb=v.capacity_gb, profile=v.profile,
+                    volume_id=f"vol-{node_name}-{i}").id)
+            return self.cloud.create_instance(
+                name=node_name, profile=planned.instance_type,
+                zone=planned.zone, subnet_id=subnet_id, image_id=image_id,
+                capacity_type=planned.capacity_type,
+                security_group_ids=sgs or (),
+                user_data=user_data,
+                vni_id=vni_id, volume_ids=tuple(created_volume_ids),
+                tags={**KARPENTER_TAGS,
+                      "karpenter.sh/nodepool": nodepool_name,
+                      "karpenter-tpu.sh/nodeclass": nodeclass.name})
+        except Exception:
+            self._cleanup_partial_create(vni_id, created_volume_ids)
+            raise
 
-        vols = []
-        for i, bdm in enumerate(nodeclass.spec.block_device_mappings):
-            v = bdm.volume
-            vols.append(FakeVolume(
-                id=f"vol-{node_name}-{i}",
-                capacity_gb=v.capacity_gb, profile=v.profile))
-        return tuple(vols)   # empty -> cloud applies the 100GB default
+    def _cleanup_partial_create(self, vni_id: str,
+                                volume_ids: List[str]) -> None:
+        """Best-effort orphan deletion — cleanup failure must not mask the
+        create error (the GC sweep is the eventual-consistency backstop)."""
+        for vid in volume_ids:
+            try:
+                self.cloud.delete_volume(vid)
+            except Exception as e:  # noqa: BLE001
+                log.warning("orphan volume cleanup failed", volume=vid,
+                            error=str(e))
+                metrics.ERRORS.labels("actuator", "orphan_cleanup").inc()
+        if vni_id:
+            try:
+                self.cloud.delete_vni(vni_id)
+            except Exception as e:  # noqa: BLE001
+                log.warning("orphan vni cleanup failed", vni=vni_id,
+                            error=str(e))
+                metrics.ERRORS.labels("actuator", "orphan_cleanup").inc()
 
     def _resolve_subnet(self, zone: str, nodeclass: NodeClass) -> str:
         """4-way resolution (vpc/instance/provider.go:243-329): explicit

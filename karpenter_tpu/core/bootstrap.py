@@ -16,7 +16,7 @@ from __future__ import annotations
 import secrets
 import threading
 import time
-from dataclasses import dataclass, field
+from dataclasses import dataclass, field, replace
 from typing import Dict, List, Optional, Tuple
 
 from karpenter_tpu.apis.nodeclass import NodeClass
@@ -108,10 +108,12 @@ class BootstrapOptions:
 
 class BootstrapProvider:
     """Generates cloud-init user-data (ref GetUserDataWithInstanceIDAndType,
-    bootstrap/provider.go:73; template cloudinit.go:1030)."""
+    bootstrap/provider.go:73; template cloudinit.go:29-1030 — full
+    production document built by core/cloudinit.py)."""
 
-    def __init__(self, tokens: Optional[TokenStore] = None):
+    def __init__(self, tokens: Optional[TokenStore] = None, env=None):
         self.tokens = tokens or TokenStore()
+        self.env = env          # BootstrapEnv (mirrors/proxies) or None
 
     def user_data(self, nodeclass: NodeClass, opts: BootstrapOptions) -> str:
         """Resolution order (ref provider.go:200-247 + custom user-data
@@ -120,55 +122,29 @@ class BootstrapProvider:
         if nodeclass.spec.user_data:
             script = nodeclass.spec.user_data
         else:
-            script = self._generate(opts)
+            script = self._generate(nodeclass, opts)
         if nodeclass.spec.user_data_append:
             script += "\n# --- user-data append ---\n"
             script += nodeclass.spec.user_data_append
         return script
 
-    def _generate(self, o: BootstrapOptions) -> str:
+    def _generate(self, nodeclass: NodeClass, o: BootstrapOptions) -> str:
+        from karpenter_tpu.core.cloudinit import generate_cloud_init
+
         token = self.tokens.find_or_create()
-        labels = dict(o.labels)
-        taints = list(o.taints) + [TAINT_UNREGISTERED]
-        taint_args = ",".join(
-            f"{t.key}={t.value}:{t.effect}" for t in taints)
-        label_args = ",".join(f"{k}={v}" for k, v in sorted(labels.items()))
-        extra = " ".join(f"--{k}={v}" for k, v in sorted(o.kubelet_extra_args.items()))
-        c = o.cluster
-        return f"""#cloud-config
-# karpenter-tpu node bootstrap ({o.node_name})
-write_files:
-  - path: /etc/kubernetes/bootstrap-kubeconfig
-    permissions: '0600'
-    content: |
-      apiVersion: v1
-      kind: Config
-      clusters:
-      - cluster:
-          certificate-authority-data: {c.cluster_ca}
-          server: {c.api_endpoint}
-        name: default
-      contexts:
-      - context: {{cluster: default, user: kubelet-bootstrap}}
-        name: default
-      current-context: default
-      users:
-      - name: kubelet-bootstrap
-        user:
-          token: {token.token}
-  - path: /etc/systemd/system/kubelet.service.d/20-karpenter.conf
-    content: |
-      [Service]
-      Environment="KUBELET_EXTRA_ARGS=--node-labels={label_args} \\
-        --register-with-taints={taint_args} \\
-        --cluster-dns={c.cluster_dns} {extra}"
-runcmd:
-  - hostnamectl set-hostname {o.node_name}
-  - install-container-runtime {c.container_runtime}
-  - install-kubelet {c.kubernetes_version} --arch {o.architecture}
-  - install-cni {c.cni_plugin} {c.cni_version} --cluster-cidr {c.cluster_cidr}
-  - systemctl enable --now kubelet
-"""
+        cluster = o.cluster
+        # spec.api_server_endpoint overrides discovery (ref NodeClass
+        # override vs kubeadm/cluster-info configmap chain, token.go:115-188)
+        if nodeclass.spec.api_server_endpoint:
+            cluster = replace(cluster,
+                              api_endpoint=nodeclass.spec.api_server_endpoint)
+        return generate_cloud_init(
+            cluster, node_name=o.node_name, token=token.token,
+            architecture=o.architecture, labels=dict(o.labels),
+            taints=list(o.taints) + [TAINT_UNREGISTERED],
+            kubelet=nodeclass.spec.kubelet,
+            kubelet_extra_args=dict(o.kubelet_extra_args),
+            env=self.env)
 
 
 class IKSBootstrapProvider:

@@ -302,6 +302,113 @@ class TestActuator:
             actuator.delete_node(NodeClaim(name="ghost", provider_id="bogus"))
 
 
+class TestPartialFailureCleanup:
+    """Staged create cleans its own orphans (ref
+    vpc/instance/provider.go:1192-1312): inject a failure at every stage
+    and assert zero leaked VNIs/volumes (VERDICT round 1 item 4)."""
+
+    def _planned(self, cat):
+        from karpenter_tpu.solver.types import PlannedNode
+
+        return PlannedNode(
+            instance_type="bx2-4x16", zone="us-south-1",
+            capacity_type="on-demand", price=0.19, pod_names=["p"],
+            offering_index=cat.find_offering("bx2-4x16", "us-south-1",
+                                             "on-demand"))
+
+    def _nodeclass_with_volumes(self, cluster):
+        from karpenter_tpu.apis.nodeclass import (
+            BlockDeviceMapping, VolumeSpec,
+        )
+
+        nc = cluster.get_nodeclass("default")
+        nc.spec.block_device_mappings = (
+            BlockDeviceMapping(volume=VolumeSpec(capacity_gb=200)),
+            BlockDeviceMapping(volume=VolumeSpec(capacity_gb=50)),
+        )
+        return nc
+
+    def test_vni_create_fails_nothing_leaked(self, rig):
+        cloud, cluster, prov, actuator, itp = rig
+        from karpenter_tpu.catalog import CatalogArrays
+        cat = CatalogArrays.build(itp.list())
+        nc = self._nodeclass_with_volumes(cluster)
+        cloud.recorder.inject_error("create_vni", CloudError("boom", 500))
+        with pytest.raises(CloudError):
+            actuator.create_node(self._planned(cat), nc, cat)
+        assert not cloud.vnis and not cloud.volumes
+        assert cloud.instance_count() == 0
+
+    def test_volume_create_fails_vni_cleaned(self, rig):
+        cloud, cluster, prov, actuator, itp = rig
+        from karpenter_tpu.catalog import CatalogArrays
+        cat = CatalogArrays.build(itp.list())
+        nc = self._nodeclass_with_volumes(cluster)
+        # fail the SECOND volume: the first volume + the VNI must both be
+        # deleted by the cleanup pass
+        calls = []
+        orig = cloud.create_volume
+        def flaky(*a, **k):
+            calls.append(1)
+            if len(calls) == 2:
+                raise CloudError("volume quota", 403, code="quota_exceeded",
+                                 retryable=False)
+            return orig(*a, **k)
+        cloud.create_volume = flaky
+        try:
+            with pytest.raises(CloudError):
+                actuator.create_node(self._planned(cat), nc, cat)
+        finally:
+            cloud.create_volume = orig
+        assert not cloud.vnis and not cloud.volumes
+        assert cloud.instance_count() == 0
+
+    def test_instance_create_fails_vni_and_volumes_cleaned(self, rig):
+        cloud, cluster, prov, actuator, itp = rig
+        from karpenter_tpu.catalog import CatalogArrays
+        cat = CatalogArrays.build(itp.list())
+        nc = self._nodeclass_with_volumes(cluster)
+        cloud.recorder.inject_error(
+            "create_instance",
+            CloudError("insufficient capacity", 503,
+                       code="insufficient_capacity", retryable=False))
+        with pytest.raises(CloudError):
+            actuator.create_node(self._planned(cat), nc, cat)
+        assert not cloud.vnis and not cloud.volumes
+        assert cloud.instance_count() == 0
+
+    def test_cleanup_failure_does_not_mask_create_error(self, rig):
+        cloud, cluster, prov, actuator, itp = rig
+        from karpenter_tpu.catalog import CatalogArrays
+        cat = CatalogArrays.build(itp.list())
+        nc = self._nodeclass_with_volumes(cluster)
+        cloud.recorder.inject_error("create_instance",
+                                    CloudError("capacity", 503,
+                                               code="insufficient_capacity",
+                                               retryable=False))
+        cloud.recorder.inject_error("delete_vni", CloudError("hiccup", 500))
+        with pytest.raises(CloudError, match="capacity"):
+            actuator.create_node(self._planned(cat), nc, cat)
+        # volumes cleaned; the VNI leak is logged for the GC backstop
+        assert not cloud.volumes
+
+    def test_successful_create_attaches_staged_resources(self, rig):
+        cloud, cluster, prov, actuator, itp = rig
+        from karpenter_tpu.catalog import CatalogArrays
+        cat = CatalogArrays.build(itp.list())
+        nc = self._nodeclass_with_volumes(cluster)
+        claim = actuator.create_node(self._planned(cat), nc, cat)
+        inst = cloud.list_instances()[0]
+        assert inst.vni_id in cloud.vnis
+        assert len(inst.volume_ids) == 2
+        assert {cloud.volumes[v].capacity_gb for v in inst.volume_ids} \
+            == {200, 50}
+        with pytest.raises(NodeClaimNotFoundError):
+            actuator.delete_node(claim)
+        # instance delete reclaims its attached staged resources
+        assert not cloud.vnis and not cloud.volumes
+
+
 class TestEndToEndSlice:
     """BASELINE config #1: 100 pending pods x 20 profiles, fake cloud."""
 
