@@ -299,6 +299,9 @@ class JaxSolver:
         # D2H payload (VERDICT round 1: the bench must be able to separate
         # "solver slow" from "link slow")
         self.last_stats: Dict[str, object] = {}
+        # per-shape pallas breaker: one pathological (G,O,N) bucket must
+        # not disable the fast path for buckets that compile fine
+        self._pallas_failed_shapes: set = set()
 
     # -- public ------------------------------------------------------------
 
@@ -344,24 +347,44 @@ class JaxSolver:
         while True:
             # pallas needs a 128-multiple node axis; never exceed the
             # configured cap to get one — fall back to the scan path instead
-            use_pallas = (max(N, 128) <= N_cap
-                          and self._use_pallas(G_pad, O_pad, max(N, 128)))
+            Np = max(N, 128)
+            use_pallas = (Np <= N_cap and self._use_pallas(G_pad, O_pad, Np)
+                          and (G_pad, O_pad, Np)
+                          not in self._pallas_failed_shapes)
             t_disp = time.perf_counter()
+            leaves = None
             if use_pallas:
-                from karpenter_tpu.solver.pallas_kernel import pack_problem
-                N = max(N, 128)
-                meta, compat_i8 = pack_problem(group_req, group_count,
-                                               group_cap, compat)
-                alloc8, rank_row, price_dev = self._device_offerings_pallas(
-                    catalog, O_pad)
-                out = solve_kernel_pallas(
-                    jnp.asarray(meta), jnp.asarray(compat_i8),
-                    alloc8, rank_row, price_dev,
-                    G=G_pad, O=O_pad, N=N,
-                    right_size=self.options.right_size,
-                    assign_dtype=assign_dtype,
-                    compact=min(K, G_pad * N) if K else 0)
-            else:
+                # dispatch AND sync inside the try: TPU execution is
+                # async, so Mosaic runtime faults only surface at
+                # block_until_ready — a fallback that guards dispatch
+                # alone would miss them
+                try:
+                    from karpenter_tpu.solver.pallas_kernel import pack_problem
+                    meta, compat_i8 = pack_problem(group_req, group_count,
+                                                   group_cap, compat)
+                    alloc8, rank_row, price_dev = \
+                        self._device_offerings_pallas(catalog, O_pad)
+                    out = solve_kernel_pallas(
+                        jnp.asarray(meta), jnp.asarray(compat_i8),
+                        alloc8, rank_row, price_dev,
+                        G=G_pad, O=O_pad, N=Np,
+                        right_size=self.options.right_size,
+                        assign_dtype=assign_dtype,
+                        compact=min(K, G_pad * Np) if K else 0)
+                    leaves = self._leaves(out, K)
+                    jax.block_until_ready(leaves)
+                    N = Np
+                except Exception as e:  # noqa: BLE001
+                    # a Mosaic failure must never break a solve window —
+                    # fall back to the scan path for this shape bucket
+                    # and make the switch observable
+                    log.warning("pallas path failed; scan fallback engaged",
+                                error=str(e)[:300], G=G_pad, O=O_pad, N=Np)
+                    metrics.ERRORS.labels("solver", "pallas_fallback").inc()
+                    self._pallas_failed_shapes.add((G_pad, O_pad, Np))
+                    use_pallas = False
+                    leaves = None
+            if leaves is None:
                 off_alloc, off_price, off_rank = self._device_offerings(
                     catalog, O_pad)
                 out = solve_kernel(
@@ -371,10 +394,9 @@ class JaxSolver:
                     num_nodes=N, right_size=self.options.right_size,
                     assign_dtype=assign_dtype,
                     compact=min(K, G_pad * N) if K else 0)
+                leaves = self._leaves(out, K)
+                jax.block_until_ready(leaves)
             node_off_dev, assign_dev, unplaced_dev, cost_dev = out
-            leaves = [node_off_dev, unplaced_dev, cost_dev] + \
-                (list(assign_dev) if K else [assign_dev])
-            jax.block_until_ready(leaves)
             t_done = time.perf_counter()
             # one pipelined fetch round: start all D2H copies, then read
             for o in leaves:
@@ -407,6 +429,14 @@ class JaxSolver:
             break
         return self._decode(problem, node_off, assign.astype(np.int32),
                             unplaced, cost)
+
+    @staticmethod
+    def _leaves(out, K: int) -> list:
+        """Flatten a kernel result into its device arrays (COO results
+        carry the assign as an (idx, cnt) pair)."""
+        node_off, assign, unplaced, cost = out
+        return [node_off, unplaced, cost] + \
+            (list(assign) if K else [assign])
 
     def _compact_k(self, total_pods: int, G_pad: int) -> int:
         """COO capacity for the compacted assign fetch; 0 = dense fetch.

@@ -114,9 +114,61 @@ def test_pallas_unplaceable_group_matches_scan():
 
 
 def test_viability_gate():
+    from karpenter_tpu.solver.pallas_kernel import choose_group_block
+
     assert pallas_path_viable(64, 4096, 1024)
-    assert not pallas_path_viable(64, 4096, 1000)      # N % 128
-    assert not pallas_path_viable(2048, 4096, 16384)   # VMEM blowout
+    assert not pallas_path_viable(64, 4096, 1000)       # N % 128
+    # the configs VERDICT round 1 flagged as silently falling back now
+    # tile onto the grid instead of failing the viability gate
+    assert pallas_path_viable(512, 1024, 4096)
+    gb = choose_group_block(512, 1024, 4096)
+    assert gb is not None and gb < 512                  # tiled, not whole
+    # node state alone (resid + wide temporaries scale with N regardless
+    # of block size) can still blow the budget
+    assert not pallas_path_viable(2048, 4096, 262144)
+
+
+def test_tiled_grid_matches_scan(monkeypatch):
+    """Force a multi-block grid (Gb < G) with a tiny VMEM budget and
+    assert bit-identical results — cross-block node state (node_off,
+    resid, ptr) and the block-entry gcompat rebuild must be exact."""
+    import karpenter_tpu.solver.pallas_kernel as pk
+
+    # one group per pod (distinct cpu requests) -> G well above the
+    # minimum block size, so the budget clamp forces a real multi-block grid
+    cloud = FakeCloud(profiles=generate_profiles(10))
+    pricing = PricingProvider(cloud)
+    catalog = CatalogArrays.build(InstanceTypeProvider(cloud, pricing).list())
+    pricing.close()
+    pods = [PodSpec(f"p{i}", requests=ResourceRequests(100 + i, 256, 0, 1))
+            for i in range(120)]
+    prob = encode(pods, catalog)
+    G, O, group_req, group_count, group_cap, compat = _padded(prob, catalog)
+    assert G >= 128, G
+    N = 256
+    # budget small enough that Gb < G, large enough that Gb >= 32 fits
+    monkeypatch.setattr(pk, "_VMEM_BUDGET", pk._block_vmem(32, O, N) + 1)
+    gb = pk.choose_group_block(G, O, N)
+    assert gb is not None and gb < G, (G, gb)
+
+    off_alloc = _pad2(catalog.offering_alloc().astype(np.int32), O)
+    off_price = _pad1(catalog.off_price.astype(np.float32), O)
+    off_rank = _pad1(catalog.offering_rank_price(), O)
+    ref = solve_kernel(
+        jnp.asarray(group_req), jnp.asarray(group_count),
+        jnp.asarray(group_cap), jnp.asarray(compat),
+        jnp.asarray(off_alloc), jnp.asarray(off_price),
+        jnp.asarray(off_rank), num_nodes=N)
+    meta, compat_i = pack_problem(group_req, group_count, group_cap, compat)
+    alloc8, rank_row = pack_catalog(off_alloc, off_rank)
+    out = solve_kernel_pallas(
+        jnp.asarray(meta), jnp.asarray(compat_i), jnp.asarray(alloc8),
+        jnp.asarray(rank_row), jnp.asarray(off_price),
+        G=G, O=O, N=N, interpret=True)
+    np.testing.assert_array_equal(np.asarray(out[0]), np.asarray(ref[0]))
+    np.testing.assert_array_equal(np.asarray(out[1]), np.asarray(ref[1]))
+    np.testing.assert_array_equal(np.asarray(out[2]), np.asarray(ref[2]))
+    assert abs(float(out[3]) - float(ref[3])) < 1e-3
 
 
 def test_fleet_pallas_matches_fleet_scan():
