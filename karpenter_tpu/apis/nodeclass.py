@@ -11,9 +11,14 @@ from __future__ import annotations
 
 import hashlib
 import json
+import re
 import time
 from dataclasses import dataclass, field, asdict
 from typing import Dict, List, Optional, Tuple
+
+# cloud resource id shape: alphanumerics plus - _ . (loose enough for
+# every provider id style, strict enough to catch whitespace/injection)
+_ID_RE = re.compile(r"^[A-Za-z0-9][A-Za-z0-9._-]*$")
 
 
 class ValidationError(ValueError):
@@ -249,12 +254,48 @@ class NodeClass:
             errs.append(f"spec.zone {s.zone!r} not in region {s.region!r}")
         if s.subnet and not s.subnet.startswith("subnet-") and not s.subnet.startswith("0"):
             errs.append(f"spec.subnet {s.subnet!r} is not a subnet id")
-        if s.placement_strategy and s.placement_strategy.zone_balance not in (
-                "Balanced", "AvailabilityFirst", "CostOptimized"):
-            errs.append("spec.placementStrategy.zoneBalance invalid")
+        # format checks (ref status/controller.go:222 format validation)
+        for sg in s.security_groups:
+            if not sg or not _ID_RE.match(sg):
+                errs.append(f"spec.securityGroups entry {sg!r} is not a "
+                            "security group id")
+        for key in s.ssh_keys:
+            if not key or not _ID_RE.match(key):
+                errs.append(f"spec.sshKeys entry {key!r} is not a key id")
+        if s.vpc and not _ID_RE.match(s.vpc):
+            errs.append(f"spec.vpc {s.vpc!r} is not a VPC id")
+        if s.instance_requirements is not None:
+            r = s.instance_requirements
+            if r.architecture and r.architecture not in ("amd64", "arm64",
+                                                         "s390x"):
+                errs.append("spec.instanceRequirements.architecture invalid")
+            if r.min_cpu < 0 or r.min_memory_gib < 0 or r.max_hourly_price < 0:
+                errs.append("spec.instanceRequirements values must be >= 0")
+        if s.placement_strategy:
+            p = s.placement_strategy
+            if p.zone_balance not in ("Balanced", "AvailabilityFirst",
+                                      "CostOptimized"):
+                errs.append("spec.placementStrategy.zoneBalance invalid")
+            if p.subnet_selection.minimum_available_ips < 0:
+                errs.append("spec.placementStrategy.subnetSelection."
+                            "minimumAvailableIPs must be >= 0")
+        if s.kubelet is not None:
+            if s.kubelet.max_pods < 0 or s.kubelet.max_pods > 1000:
+                errs.append("spec.kubelet.maxPods must be in [0, 1000]")
         root_vols = [b for b in s.block_device_mappings if b.root_volume]
         if len(root_vols) > 1:
             errs.append("at most one blockDeviceMapping may be rootVolume")
+        for b in s.block_device_mappings:
+            if b.volume.capacity_gb < 10 or b.volume.capacity_gb > 16000:
+                errs.append(f"blockDeviceMapping volume capacity "
+                            f"{b.volume.capacity_gb}GB out of range [10, 16000]")
+        if s.load_balancer_integration and s.load_balancer_integration.enabled:
+            for tg in s.load_balancer_integration.target_groups:
+                if not tg.load_balancer_id:
+                    errs.append("loadBalancerIntegration targetGroups entries "
+                                "require loadBalancerID")
+                if not (0 < tg.port < 65536):
+                    errs.append(f"loadBalancer target port {tg.port} invalid")
         return errs
 
 
@@ -265,3 +306,180 @@ ANNOTATION_SUBNET = "karpenter-tpu.sh/subnet-id"
 ANNOTATION_SECURITY_GROUPS = "karpenter-tpu.sh/security-groups"
 ANNOTATION_IMAGE = "karpenter-tpu.sh/image-id"
 NODECLASS_HASH_VERSION = "v1"
+
+
+# --- JSON (CRD-shaped) parsing ---------------------------------------------
+# Admission requests arrive as the CRD's camelCase JSON (the shape
+# deploy/crds/tpunodeclass.yaml declares); this is the webhook-side
+# deserializer (ref ibmnodeclass_webhook.go decodes the same way via
+# apimachinery).
+
+def _pairs(d: Optional[Dict]) -> Tuple[Tuple[str, str], ...]:
+    return tuple(sorted((str(k), str(v)) for k, v in (d or {}).items()))
+
+
+def _obj(d, allowed: Tuple[str, ...], ctx: str) -> Optional[Dict]:
+    """Validate a nested object: must be a dict (or None) and use only
+    known keys — a misspelled nested field (minCpu for minCPU) silently
+    defaulting would admit specs the controller then ignores."""
+    if d is None:
+        return None
+    if not isinstance(d, dict):
+        raise ValidationError(f"spec.{ctx} must be an object, "
+                              f"got {type(d).__name__}")
+    unknown = set(d) - set(allowed)
+    if unknown:
+        raise ValidationError(
+            f"unknown fields in spec.{ctx}: {sorted(unknown)}")
+    return d
+
+
+def nodeclass_from_dict(doc: Dict) -> "NodeClass":
+    """Parse a CRD-shaped dict (metadata + camelCase spec) into a
+    NodeClass.  Unknown fields — top-level OR nested — raise
+    ValidationError: an admission webhook that silently drops fields
+    would accept specs the controller then ignores."""
+    meta = doc.get("metadata") or {}
+    spec = dict(doc.get("spec") or {})
+    if not isinstance(meta, dict):
+        raise ValidationError("metadata must be an object")
+
+    def take(key, default=None):
+        return spec.pop(key, default)
+
+    ir = _obj(take("instanceRequirements"),
+              ("architecture", "minCPU", "minMemoryGiB", "minMemory",
+               "maxHourlyPrice", "gpu"), "instanceRequirements")
+    sel = _obj(take("imageSelector"),
+               ("os", "majorVersion", "minorVersion", "architecture",
+                "variant"), "imageSelector")
+    ps = _obj(take("placementStrategy"),
+              ("zoneBalance", "subnetSelection"), "placementStrategy")
+    if ps is not None:
+        _obj(ps.get("subnetSelection"),
+             ("minimumAvailableIPs", "requiredTags"),
+             "placementStrategy.subnetSelection")
+    dyn = _obj(take("iksDynamicPools"),
+               ("enabled", "poolNamePrefix", "emptyPoolTTLSeconds",
+                "cleanupPolicy"), "iksDynamicPools")
+    lbi = _obj(take("loadBalancerIntegration"),
+               ("enabled", "targetGroups", "autoDeregister",
+                "registrationTimeout"), "loadBalancerIntegration")
+    if lbi is not None:
+        for i, tg in enumerate(lbi.get("targetGroups") or ()):
+            _obj(tg, ("loadBalancerID", "poolName", "port", "weight",
+                      "healthCheck"), f"loadBalancerIntegration."
+                                      f"targetGroups[{i}]")
+            _obj(tg.get("healthCheck"),
+                 ("protocol", "port", "interval", "timeout", "retries"),
+                 f"loadBalancerIntegration.targetGroups[{i}].healthCheck")
+    bdms = take("blockDeviceMappings") or []
+    for i, b in enumerate(bdms):
+        _obj(b, ("deviceName", "rootVolume", "volume"),
+             f"blockDeviceMappings[{i}]")
+        _obj(b.get("volume"),
+             ("capacityGB", "profile", "iops", "bandwidth",
+              "encryptionKey", "deleteOnTermination"),
+             f"blockDeviceMappings[{i}].volume")
+    kubelet = _obj(take("kubelet"),
+                   ("maxPods", "systemReserved", "kubeReserved",
+                    "evictionHard", "clusterDNS"), "kubelet")
+
+    parsed = NodeClassSpec(
+        region=take("region", ""),
+        zone=take("zone", ""),
+        instance_profile=take("instanceProfile", ""),
+        instance_requirements=InstanceRequirements(
+            architecture=ir.get("architecture", ""),
+            min_cpu=int(ir.get("minCPU", 0)),
+            min_memory_gib=int(ir.get("minMemoryGiB", ir.get("minMemory", 0))),
+            max_hourly_price=float(ir.get("maxHourlyPrice", 0.0)),
+            gpu=bool(ir.get("gpu", False))) if ir is not None else None,
+        image=take("image", ""),
+        image_selector=ImageSelector(
+            os=sel.get("os", "ubuntu"),
+            major_version=str(sel.get("majorVersion", "")),
+            minor_version=str(sel.get("minorVersion", "")),
+            architecture=sel.get("architecture", "amd64"),
+            variant=sel.get("variant", "")) if sel is not None else None,
+        vpc=take("vpc", ""),
+        subnet=take("subnet", ""),
+        security_groups=tuple(take("securityGroups") or ()),
+        ssh_keys=tuple(take("sshKeys") or ()),
+        resource_group=take("resourceGroup", ""),
+        placement_target=take("placementTarget", ""),
+        tags=_pairs(take("tags")),
+        placement_strategy=PlacementStrategy(
+            zone_balance=ps.get("zoneBalance", "Balanced"),
+            subnet_selection=SubnetSelectionCriteria(
+                minimum_available_ips=int(
+                    (ps.get("subnetSelection") or {})
+                    .get("minimumAvailableIPs", 0)),
+                required_tags=_pairs(
+                    (ps.get("subnetSelection") or {}).get("requiredTags"))))
+        if ps is not None else None,
+        user_data=take("userData", ""),
+        user_data_append=take("userDataAppend", ""),
+        bootstrap_mode=take("bootstrapMode", "auto"),
+        iks_cluster_id=take("iksClusterID", ""),
+        iks_worker_pool_id=take("iksWorkerPoolID", ""),
+        iks_dynamic_pools=DynamicPoolConfig(
+            enabled=bool(dyn.get("enabled", False)),
+            pool_name_prefix=dyn.get("poolNamePrefix", "karpenter"),
+            empty_pool_ttl_seconds=int(dyn.get("emptyPoolTTLSeconds", 600)),
+            cleanup_policy=dyn.get("cleanupPolicy", "Delete"))
+        if dyn is not None else None,
+        load_balancer_integration=LoadBalancerIntegration(
+            enabled=bool(lbi.get("enabled", False)),
+            target_groups=tuple(
+                LoadBalancerTarget(
+                    load_balancer_id=tg.get("loadBalancerID", ""),
+                    pool_name=tg.get("poolName", ""),
+                    port=int(tg.get("port", 0)),
+                    weight=int(tg.get("weight", 50)),
+                    health_check=HealthCheck(
+                        protocol=tg["healthCheck"].get("protocol", "tcp"),
+                        port=int(tg["healthCheck"].get("port", 0)),
+                        interval=int(tg["healthCheck"].get("interval", 5)),
+                        timeout=int(tg["healthCheck"].get("timeout", 2)),
+                        retries=int(tg["healthCheck"].get("retries", 2)))
+                    if tg.get("healthCheck") else None)
+                for tg in (lbi.get("targetGroups") or ())),
+            auto_deregister=bool(lbi.get("autoDeregister", True)),
+            registration_timeout=int(lbi.get("registrationTimeout", 300)))
+        if lbi is not None else None,
+        block_device_mappings=tuple(
+            BlockDeviceMapping(
+                device_name=b.get("deviceName", ""),
+                root_volume=bool(b.get("rootVolume", False)),
+                volume=VolumeSpec(
+                    capacity_gb=int((b.get("volume") or {})
+                                    .get("capacityGB", 100)),
+                    profile=(b.get("volume") or {})
+                    .get("profile", "general-purpose"),
+                    iops=int((b.get("volume") or {}).get("iops", 0)),
+                    bandwidth=int((b.get("volume") or {})
+                                  .get("bandwidth", 0)),
+                    encryption_key=(b.get("volume") or {})
+                    .get("encryptionKey", ""),
+                    delete_on_termination=bool(
+                        (b.get("volume") or {})
+                        .get("deleteOnTermination", True))))
+            for b in bdms),
+        kubelet=KubeletConfig(
+            max_pods=int(kubelet.get("maxPods", 0)),
+            system_reserved=_pairs(kubelet.get("systemReserved")),
+            kube_reserved=_pairs(kubelet.get("kubeReserved")),
+            eviction_hard=_pairs(kubelet.get("evictionHard")),
+            cluster_dns=tuple(kubelet.get("clusterDNS") or ()))
+        if kubelet is not None else None,
+        api_server_endpoint=take("apiServerEndpoint", ""),
+    )
+    if spec:
+        raise ValidationError(f"unknown spec fields: {sorted(spec)}")
+    name = meta.get("name") or doc.get("name") or ""
+    if not name:
+        raise ValidationError("metadata.name is required")
+    return NodeClass(name=name, spec=parsed,
+                     annotations=dict(meta.get("annotations") or {}),
+                     labels=dict(meta.get("labels") or {}))

@@ -166,6 +166,8 @@ class FakeCloud:
         self.volumes: Dict[str, FakeVolume] = {}
         self.security_groups: Dict[str, str] = {"sg-default": "default"}
         self.default_security_group = "sg-default"
+        self.vpcs: Dict[str, str] = {"vpc-1": region}   # id -> region
+        self.ssh_keys: Dict[str, str] = {"key-1": "rsa"}  # id -> type
         self.instance_quota = instance_quota
         self.capacity_limits: Dict[Tuple[str, str], int] = {}  # (profile, zone) -> max
         for zi, zone in enumerate(self.zone_names):
@@ -231,6 +233,29 @@ class FakeCloud:
         self.recorder.record("get_default_security_group")
         self.recorder.maybe_raise("get_default_security_group")
         return self.default_security_group
+
+    def list_security_groups(self) -> List[str]:
+        """SG ids in the VPC (ref vpc.go:268-414 SG surface; consumed by
+        the status controller's existence checks)."""
+        self.recorder.record("list_security_groups")
+        self.recorder.maybe_raise("list_security_groups")
+        with self._lock:
+            return list(self.security_groups)
+
+    def list_vpcs(self) -> List[str]:
+        """VPC ids visible in this region (ref status/controller.go:471
+        VPC-in-region validation)."""
+        self.recorder.record("list_vpcs")
+        self.recorder.maybe_raise("list_vpcs")
+        with self._lock:
+            return [v for v, r in self.vpcs.items() if r == self.region]
+
+    def list_ssh_keys(self) -> List[str]:
+        """SSH key ids (ref status/controller.go:796 key validation)."""
+        self.recorder.record("list_ssh_keys")
+        self.recorder.maybe_raise("list_ssh_keys")
+        with self._lock:
+            return list(self.ssh_keys)
 
     # -- network interfaces / volumes (staged allocation) ------------------
 

@@ -118,6 +118,12 @@ class StubCloudServer:
             return {"images": [image_to_json(m) for m in cloud.list_images()]}
         if path == "/v1/vpcs/default/security_group":
             return {"id": cloud.get_default_security_group()}
+        if path == "/v1/security_groups":
+            return {"security_groups": cloud.list_security_groups()}
+        if path == "/v1/vpcs":
+            return {"vpcs": cloud.list_vpcs()}
+        if path == "/v1/keys":
+            return {"keys": cloud.list_ssh_keys()}
         if path == "/v1/virtual_network_interfaces" and method == "POST":
             vni = cloud.create_vni(body.get("subnet_id", ""))
             return {"id": vni.id, "subnet_id": vni.subnet_id}

@@ -179,6 +179,18 @@ class VPCCloudClient:
         return self.http.get("/v1/vpcs/default/security_group",
                              "get_default_sg")["id"]
 
+    def list_security_groups(self) -> List[str]:
+        return list(self.http.get("/v1/security_groups",
+                                  "list_security_groups")
+                    .get("security_groups", []))
+
+    def list_vpcs(self) -> List[str]:
+        return list(self.http.get("/v1/vpcs", "list_vpcs").get("vpcs", []))
+
+    def list_ssh_keys(self) -> List[str]:
+        return list(self.http.get("/v1/keys", "list_ssh_keys")
+                    .get("keys", []))
+
     # -- staged allocation (ref vpc.go:448-478 VNIs, :416-446 volumes) -----
 
     def create_vni(self, subnet_id: str) -> VNI:

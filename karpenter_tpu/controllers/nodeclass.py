@@ -126,10 +126,41 @@ class NodeClassStatusController(WatchController):
             if nc.spec.instance_profile not in profiles:
                 errs.append(f"instance profile {nc.spec.instance_profile} "
                             "not found")
+        errs += self._validate_vpc_resources(nc)
         try:
             self.images.resolve(nc.spec.image, nc.spec.image_selector)
         except CloudError as e:
             errs.append(f"image resolution failed: {e.message}")
+        return errs
+
+    def _validate_vpc_resources(self, nc: NodeClass) -> list:
+        """VPC-in-region, security-group, and SSH-key existence (ref
+        status/controller.go:471 VPC, :735 SGs, :796 keys).  Cloud hiccups
+        during these lookups do NOT fail validation — a transient list
+        error must not flip a Ready NodeClass to NotReady.  Capability is
+        probed explicitly (getattr) so a client lacking the listing
+        surface skips the check, while a genuine bug inside a list
+        implementation still surfaces."""
+        errs = []
+        checks = [
+            ("list_vpcs", [nc.spec.vpc] if nc.spec.vpc else [],
+             lambda ident: f"VPC {ident} not found in region"),
+            ("list_security_groups", list(nc.spec.security_groups),
+             lambda ident: f"security group {ident} not found"),
+            ("list_ssh_keys", list(nc.spec.ssh_keys),
+             lambda ident: f"SSH key {ident} not found"),
+        ]
+        for method, idents, msg in checks:
+            if not idents:
+                continue
+            fn = getattr(self.cloud, method, None)
+            if fn is None:
+                continue
+            try:
+                known = set(fn())
+            except CloudError:
+                continue
+            errs.extend(msg(i) for i in idents if i not in known)
         return errs
 
     def _resolve_status(self, nc: NodeClass) -> None:

@@ -1,14 +1,23 @@
-"""Metrics + health HTTP server.
+"""Metrics + health + admission HTTP server.
 
 The observability endpoint the deploy manifests scrape (§5.5 parity with
 the reference's metrics service + probes): ``/metrics`` serves the
 Prometheus text exposition from utils/metrics, ``/healthz`` liveness,
 ``/readyz`` readiness (operator started and controller manager live).
+
+``POST /validate-nodeclass`` serves the SAME spec validation the
+in-process admission path enforces, for out-of-process writers (ref
+``ibmnodeclass_webhook.go`` — the reference registers a validation
+webhook for exactly this).  Accepts either a Kubernetes AdmissionReview
+envelope (returns the AdmissionReview response shape) or a bare
+CRD-shaped NodeClass document (returns ``{"allowed", "errors"}``).
+
 stdlib http.server on a daemon thread — no extra dependencies.
 """
 
 from __future__ import annotations
 
+import json
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Callable, Optional
@@ -17,6 +26,51 @@ from karpenter_tpu.utils import metrics
 from karpenter_tpu.utils.logging import get_logger
 
 log = get_logger("operator.server")
+
+
+def validate_nodeclass_document(doc: dict) -> list:
+    """Shared webhook-side validation: parse the CRD-shaped dict and run
+    the same ``validate()`` the in-process admission uses.  Returns the
+    violation list (parse failures are violations too)."""
+    from karpenter_tpu.apis.nodeclass import ValidationError, nodeclass_from_dict
+
+    if not isinstance(doc, dict):
+        return [f"NodeClass document must be a JSON object, "
+                f"got {type(doc).__name__}"]
+    try:
+        nc = nodeclass_from_dict(doc)
+    except ValidationError as e:
+        return [str(e)]
+    except (TypeError, ValueError, KeyError, AttributeError) as e:
+        # AttributeError covers non-dict nested fields ({"spec":
+        # {"kubelet": "big"}}) — a malformed document is a denial, not a
+        # dropped connection
+        return [f"malformed NodeClass document: {e}"]
+    return nc.validate()
+
+
+def _admission_response(body) -> dict:
+    """Handle both AdmissionReview and bare-object requests."""
+    if not isinstance(body, dict):
+        return {"allowed": False,
+                "errors": [f"request body must be a JSON object, "
+                           f"got {type(body).__name__}"]}
+    if body.get("kind") == "AdmissionReview":
+        request = body.get("request") or {}
+        errs = validate_nodeclass_document(request.get("object") or {})
+        return {
+            "apiVersion": body.get("apiVersion",
+                                   "admission.k8s.io/v1"),
+            "kind": "AdmissionReview",
+            "response": {
+                "uid": request.get("uid", ""),
+                "allowed": not errs,
+                **({"status": {"code": 422,
+                               "message": "; ".join(errs)}} if errs else {}),
+            },
+        }
+    errs = validate_nodeclass_document(body)
+    return {"allowed": not errs, "errors": errs}
 
 
 class MetricsServer:
@@ -40,6 +94,26 @@ class MetricsServer:
                         self._reply(503, b"not ready", "text/plain")
                 else:
                     self._reply(404, b"not found", "text/plain")
+
+            def do_POST(self):  # noqa: N802 (stdlib API)
+                if self.path != "/validate-nodeclass":
+                    self._reply(404, b"not found", "text/plain")
+                    return
+                length = int(self.headers.get("Content-Length") or 0)
+                try:
+                    body = json.loads(self.rfile.read(length)) if length \
+                        else {}
+                except json.JSONDecodeError:
+                    self._reply(400, b'{"error": "invalid JSON"}',
+                                "application/json")
+                    return
+                try:
+                    out = json.dumps(_admission_response(body)).encode()
+                except Exception as e:  # noqa: BLE001 — never drop the socket
+                    out = json.dumps({"allowed": False,
+                                      "errors": [f"webhook error: {e}"]}
+                                     ).encode()
+                self._reply(200, out, "application/json")
 
             def _reply(self, status: int, body: bytes, ctype: str):
                 self.send_response(status)

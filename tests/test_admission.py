@@ -1,0 +1,226 @@
+"""Validation-depth + admission webhook tests (VERDICT round 1 item 7):
+format checks, cloud-resource existence checks in the status controller,
+CRD-shaped JSON parsing, and the HTTP admission endpoint — the same
+validation enforced in-process and over the wire (ref
+ibmnodeclass_webhook.go + status/controller.go:471-845)."""
+
+import json
+import urllib.request
+
+import pytest
+
+from karpenter_tpu.apis.nodeclass import (
+    BlockDeviceMapping, InstanceRequirements, KubeletConfig,
+    LoadBalancerIntegration, LoadBalancerTarget, NodeClass, NodeClassSpec,
+    PlacementStrategy, SubnetSelectionCriteria, ValidationError, VolumeSpec,
+    nodeclass_from_dict,
+)
+from karpenter_tpu.cloud.fake import FakeCloud
+from karpenter_tpu.controllers.nodeclass import NodeClassStatusController
+from karpenter_tpu.core.cluster import ClusterState
+
+
+def _valid_spec(**kw):
+    base = dict(region="us-south", image="img-1", vpc="vpc-1",
+                instance_profile="bx2-4x16")
+    base.update(kw)
+    return NodeClassSpec(**base)
+
+
+class TestFormatValidation:
+    def test_valid_baseline(self):
+        assert NodeClass(name="a", spec=_valid_spec()).validate() == []
+
+    @pytest.mark.parametrize("field,value,match", [
+        ("security_groups", ("sg ok",), "security group id"),
+        ("security_groups", ("",), "security group id"),
+        ("ssh_keys", ("bad key!",), "key id"),
+        ("vpc", "vpc one", "VPC id"),
+    ])
+    def test_id_formats(self, field, value, match):
+        errs = NodeClass(name="a", spec=_valid_spec(**{field: value})).validate()
+        assert any(match in e for e in errs), errs
+
+    def test_instance_requirements_ranges(self):
+        spec = _valid_spec(instance_profile="", instance_requirements=
+                           InstanceRequirements(architecture="mips",
+                                                min_cpu=-1))
+        errs = NodeClass(name="a", spec=spec).validate()
+        assert any("architecture" in e for e in errs)
+        assert any(">= 0" in e for e in errs)
+
+    def test_placement_strategy_ranges(self):
+        spec = _valid_spec(placement_strategy=PlacementStrategy(
+            zone_balance="Wat",
+            subnet_selection=SubnetSelectionCriteria(
+                minimum_available_ips=-5)))
+        errs = NodeClass(name="a", spec=spec).validate()
+        assert any("zoneBalance" in e for e in errs)
+        assert any("minimumAvailableIPs" in e for e in errs)
+
+    def test_kubelet_and_volume_ranges(self):
+        spec = _valid_spec(
+            kubelet=KubeletConfig(max_pods=5000),
+            block_device_mappings=(BlockDeviceMapping(
+                volume=VolumeSpec(capacity_gb=5)),))
+        errs = NodeClass(name="a", spec=spec).validate()
+        assert any("maxPods" in e for e in errs)
+        assert any("capacity" in e for e in errs)
+
+    def test_lb_target_validation(self):
+        spec = _valid_spec(load_balancer_integration=LoadBalancerIntegration(
+            enabled=True,
+            target_groups=(LoadBalancerTarget(port=0),)))
+        errs = NodeClass(name="a", spec=spec).validate()
+        assert any("loadBalancerID" in e for e in errs)
+        assert any("port" in e for e in errs)
+
+
+class TestStatusControllerCloudChecks:
+    def _rig(self):
+        cloud = FakeCloud()
+        cluster = ClusterState()
+        ctrl = NodeClassStatusController(cluster, cloud)
+        return cloud, cluster, ctrl
+
+    def _run(self, cluster, ctrl, nc):
+        cluster.add_nodeclass(nc)
+        ctrl.reconcile(nc.name)
+        return cluster.get_nodeclass(nc.name)
+
+    def test_vpc_in_region_checked(self):
+        cloud, cluster, ctrl = self._rig()
+        nc = self._run(cluster, ctrl, NodeClass(
+            name="a", spec=_valid_spec(vpc="vpc-elsewhere")))
+        assert not nc.status.is_ready()
+        assert "VPC vpc-elsewhere not found" in nc.status.validation_error
+
+    def test_security_groups_checked(self):
+        cloud, cluster, ctrl = self._rig()
+        cloud.security_groups["sg-app"] = "app"
+        nc = self._run(cluster, ctrl, NodeClass(name="a", spec=_valid_spec(
+            security_groups=("sg-app", "sg-ghost"))))
+        assert "security group sg-ghost not found" in nc.status.validation_error
+
+    def test_ssh_keys_checked(self):
+        cloud, cluster, ctrl = self._rig()
+        nc = self._run(cluster, ctrl, NodeClass(name="a", spec=_valid_spec(
+            ssh_keys=("key-1", "key-ghost"))))
+        assert "SSH key key-ghost not found" in nc.status.validation_error
+
+    def test_transient_cloud_error_does_not_unready(self):
+        from karpenter_tpu.cloud.errors import CloudError
+
+        cloud, cluster, ctrl = self._rig()
+        cloud.recorder.set_persistent_error(
+            "list_vpcs", CloudError("api down", 503))
+        nc = self._run(cluster, ctrl, NodeClass(
+            name="a", spec=_valid_spec(vpc="vpc-1")))
+        assert nc.status.is_ready()      # lookup hiccup is not a violation
+
+    def test_all_valid_becomes_ready(self):
+        cloud, cluster, ctrl = self._rig()
+        nc = self._run(cluster, ctrl, NodeClass(name="a", spec=_valid_spec(
+            security_groups=("sg-default",), ssh_keys=("key-1",))))
+        assert nc.status.is_ready()
+        assert list(nc.status.resolved_security_groups) == ["sg-default"]
+
+
+class TestJSONParsing:
+    def test_full_document_roundtrip(self):
+        nc = nodeclass_from_dict({
+            "metadata": {"name": "web", "labels": {"team": "a"}},
+            "spec": {
+                "region": "us-south", "zone": "us-south-1",
+                "image": "img-1", "vpc": "vpc-1",
+                "instanceRequirements": {"minCPU": 4, "minMemoryGiB": 16,
+                                         "maxHourlyPrice": 1.5},
+                "securityGroups": ["sg-default"],
+                "sshKeys": ["key-1"],
+                "placementStrategy": {
+                    "zoneBalance": "CostOptimized",
+                    "subnetSelection": {"minimumAvailableIPs": 8,
+                                        "requiredTags": {"env": "prod"}}},
+                "blockDeviceMappings": [
+                    {"rootVolume": True,
+                     "volume": {"capacityGB": 200, "profile": "10iops-tier"}}],
+                "kubelet": {"maxPods": 110,
+                            "systemReserved": {"cpu": "100m"}},
+                "bootstrapMode": "cloud-init",
+            }})
+        assert nc.name == "web"
+        assert nc.spec.instance_requirements.min_cpu == 4
+        assert nc.spec.placement_strategy.zone_balance == "CostOptimized"
+        assert nc.spec.placement_strategy.subnet_selection.required_tags \
+            == (("env", "prod"),)
+        assert nc.spec.block_device_mappings[0].volume.capacity_gb == 200
+        assert nc.spec.kubelet.max_pods == 110
+        assert nc.validate() == []
+
+    def test_unknown_fields_rejected(self):
+        with pytest.raises(ValidationError, match="unknown spec fields"):
+            nodeclass_from_dict({"metadata": {"name": "x"},
+                                 "spec": {"region": "us-south",
+                                          "florb": True}})
+
+    def test_missing_name_rejected(self):
+        with pytest.raises(ValidationError, match="metadata.name"):
+            nodeclass_from_dict({"spec": {"region": "us-south"}})
+
+
+class TestAdmissionEndpoint:
+    @pytest.fixture()
+    def server(self):
+        from karpenter_tpu.operator.server import MetricsServer
+
+        srv = MetricsServer(host="127.0.0.1", port=0).start()
+        yield srv
+        srv.stop()
+
+    def _post(self, server, doc):
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{server.port}/validate-nodeclass",
+            data=json.dumps(doc).encode(),
+            headers={"Content-Type": "application/json"}, method="POST")
+        with urllib.request.urlopen(req, timeout=5) as resp:
+            return json.loads(resp.read())
+
+    def _doc(self, **spec):
+        base = {"region": "us-south", "image": "img-1",
+                "instanceProfile": "bx2-4x16", "vpc": "vpc-1"}
+        base.update(spec)
+        return {"metadata": {"name": "x"}, "spec": base}
+
+    def test_valid_allowed(self, server):
+        out = self._post(server, self._doc())
+        assert out == {"allowed": True, "errors": []}
+
+    def test_invalid_denied_with_reasons(self, server):
+        out = self._post(server, self._doc(bootstrapMode="iks-api"))
+        assert out["allowed"] is False
+        assert any("iksClusterID" in e for e in out["errors"])
+
+    def test_admission_review_envelope(self, server):
+        review = {
+            "apiVersion": "admission.k8s.io/v1",
+            "kind": "AdmissionReview",
+            "request": {"uid": "u-123",
+                        "object": self._doc(zone="eu-de-1")}}
+        out = self._post(server, review)
+        assert out["kind"] == "AdmissionReview"
+        assert out["response"]["uid"] == "u-123"
+        assert out["response"]["allowed"] is False
+        assert "not in region" in out["response"]["status"]["message"]
+
+    def test_admission_review_allows_valid(self, server):
+        review = {"kind": "AdmissionReview",
+                  "request": {"uid": "u-1", "object": self._doc()}}
+        out = self._post(server, review)
+        assert out["response"] == {"uid": "u-1", "allowed": True}
+
+    def test_malformed_document_denied(self, server):
+        out = self._post(server, {"metadata": {"name": "x"},
+                                  "spec": {"region": "us-south",
+                                           "unknownThing": 1}})
+        assert out["allowed"] is False
+        assert any("unknown spec fields" in e for e in out["errors"])
