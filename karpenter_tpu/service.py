@@ -172,9 +172,12 @@ class RemoteSolver:
     # -- Solver surface ----------------------------------------------------
 
     def solve(self, request: SolveRequest) -> Plan:
+        from karpenter_tpu.solver.zonesplit import solve_with_zone_candidates
+
         t0 = time.perf_counter()
-        problem = encode(request.pods, request.catalog, request.nodepool)
-        plan = self.solve_encoded(problem)
+        # handles the zone_candidates gate internally (each candidate is
+        # an extra sidecar round trip, capped by zone_candidate_solves)
+        plan = solve_with_zone_candidates(self, request)
         plan.solve_seconds = time.perf_counter() - t0
         metrics.SOLVE_DURATION.labels("remote").observe(plan.solve_seconds)
         return plan

@@ -178,9 +178,17 @@ _SIG_LOWER_CACHE: Dict[Tuple, Tuple] = {}
 
 
 def encode(pods: Sequence[PodSpec], catalog: CatalogArrays,
-           nodepool: Optional[NodePool] = None) -> EncodedProblem:
-    """Group, split, and lower the scheduling problem to dense tensors."""
+           nodepool: Optional[NodePool] = None,
+           zone_overrides: Optional[Dict[int, str]] = None) -> EncodedProblem:
+    """Group, split, and lower the scheduling problem to dense tensors.
+
+    ``zone_overrides`` maps a signature id -> forced pinned zone for its
+    zone-affinity group — the mechanism behind the multi-zone candidate
+    split (solver/zonesplit.py): candidates re-encode with each viable
+    zone and the cost-minimizing solve wins (replaces the v1
+    most-capacity heuristic when enabled)."""
     nodepool = nodepool or _DEFAULT_POOL
+    zone_overrides = zone_overrides or {}
     pool_labels = dict(nodepool.labels)
 
     # 1. Reject pods that cannot run in this pool at all (taints).
@@ -257,10 +265,13 @@ def encode(pods: Sequence[PodSpec], catalog: CatalogArrays,
                     count=cnt, requirements=sub_reqs, cap_per_node=cap,
                     pinned_zone=zone, spread_origin=sig, nozone_mask=nozone))
         elif _has_zone_affinity(rep) and len(live_zones) > 1:
-            # co-schedule in one zone: pin to the zone with the most
-            # compatible offering capacity (v1 heuristic; validator checks
-            # zone purity)
-            best = _best_zone_for(rep, reqs, live_zones, catalog)
+            # co-schedule in one zone: an explicit candidate override wins
+            # (zonesplit refinement); default pin is the zone with the
+            # most compatible offering capacity (v1 heuristic; validator
+            # checks zone purity either way)
+            override = zone_overrides.get(sig)
+            best = override if override in live_zones else \
+                _best_zone_for(rep, reqs, live_zones, catalog)
             groups.append(PodGroup(
                 representative=rep, pod_names=[pod_key(p) for p in members],
                 count=len(members), requirements=reqs, cap_per_node=cap,

@@ -39,9 +39,11 @@ class GreedySolver:
         self.options = options or SolverOptions(backend="greedy")
 
     def solve(self, request: SolveRequest) -> Plan:
+        from karpenter_tpu.solver.zonesplit import solve_with_zone_candidates
+
         t0 = time.perf_counter()
-        problem = encode(request.pods, request.catalog, request.nodepool)
-        plan = self.solve_encoded(problem)
+        # handles the zone_candidates gate internally
+        plan = solve_with_zone_candidates(self, request)
         plan.solve_seconds = time.perf_counter() - t0
         metrics.SOLVE_DURATION.labels("greedy").observe(plan.solve_seconds)
         metrics.SOLVE_PODS.labels("greedy").observe(len(request.pods))

@@ -306,10 +306,13 @@ class JaxSolver:
     # -- public ------------------------------------------------------------
 
     def solve(self, request: SolveRequest) -> Plan:
+        from karpenter_tpu.solver.zonesplit import solve_with_zone_candidates
+
         t0 = time.perf_counter()
         with _maybe_trace("karpenter_tpu.solve"):
-            problem = encode(request.pods, request.catalog, request.nodepool)
-            plan = self.solve_encoded(problem)
+            # handles the zone_candidates gate internally (single solve
+            # when off or no affinity groups)
+            plan = solve_with_zone_candidates(self, request)
         plan.solve_seconds = time.perf_counter() - t0
         metrics.SOLVE_DURATION.labels("jax").observe(plan.solve_seconds)
         metrics.SOLVE_PODS.labels("jax").observe(len(request.pods))

@@ -36,6 +36,13 @@ class SolverOptions:
                                     # device before the D2H fetch ("auto" =
                                     # TPU only — the dominant transfer
                                     # shrinks from G*N entries to <=pods)
+    zone_candidates: str = "on"     # zone-affinity groups: solve per-zone
+                                    # candidates and keep the cheapest
+                                    # (solver/zonesplit.py); "off" = v1
+                                    # most-capacity pin only
+    zone_candidate_solves: int = 8  # extra-solve budget for the candidate
+                                    # refinement (remote backend: each is
+                                    # one more sidecar round trip)
     address: str = ""               # backend "remote": solver sidecar
                                     # gRPC address (host:port)
 
