@@ -58,8 +58,17 @@ def load() -> Optional[ctypes.CDLL]:
                 _I32P, _I32P, _I32P, _U8P, _I32P, _F32P,
                 _I32P, _I32P, _I32P,
             ]
+            lib.ffd_solve_gid.restype = ctypes.c_int
+            lib.ffd_solve_gid.argtypes = [
+                ctypes.c_int, ctypes.c_int, ctypes.c_int,
+                _I32P, _I32P, _I32P, _U8P, _I32P, _F32P,
+                _I32P, _I32P,
+                _I32P, _I32P, _I32P,
+            ]
             _lib = lib
-        except OSError as e:
+        except (OSError, AttributeError) as e:
+            # AttributeError: a stale .so missing a newer symbol (e.g.
+            # ffd_solve_gid) must degrade to python greedy, not crash
             log.warning("native load failed; using python greedy",
                         error=str(e))
             _load_failed = True
@@ -69,10 +78,15 @@ def load() -> Optional[ctypes.CDLL]:
 def ffd_solve(group_req: np.ndarray, group_count: np.ndarray,
               group_cap: np.ndarray, compat: np.ndarray,
               off_alloc: np.ndarray, off_rank: np.ndarray,
-              max_nodes: int):
+              max_nodes: int, gid: np.ndarray = None):
     """Run the per-pod FFD.  Returns (node_off, assign, unplaced, open)
     or None when the native library is unavailable; ``open`` is -1 on node
-    overflow (caller escalates max_nodes)."""
+    overflow (caller escalates max_nodes).
+
+    ``gid``: per-row original-group ids for per-pod expansions — the
+    per-node cap is then accounted across all rows sharing a gid (a
+    per-pod row holds one pod, so its own assign count can never reach a
+    cap; see native/ffd.cpp ffd_solve_gid)."""
     lib = load()
     if lib is None:
         return None
@@ -81,7 +95,7 @@ def ffd_solve(group_req: np.ndarray, group_count: np.ndarray,
     node_off = np.full(N, -1, dtype=np.int32)
     assign = np.zeros((G, N), dtype=np.int32)
     unplaced = np.zeros(G, dtype=np.int32)
-    n_open = lib.ffd_solve(
+    args = [
         G, O, N,
         np.ascontiguousarray(group_req, dtype=np.int32),
         np.ascontiguousarray(group_count, dtype=np.int32),
@@ -90,5 +104,13 @@ def ffd_solve(group_req: np.ndarray, group_count: np.ndarray,
         np.ascontiguousarray(compat, dtype=np.uint8),
         np.ascontiguousarray(off_alloc, dtype=np.int32),
         np.ascontiguousarray(off_rank, dtype=np.float32),
-        node_off, assign, unplaced)
+    ]
+    if gid is None:
+        n_open = lib.ffd_solve(*args, node_off, assign, unplaced)
+    else:
+        gid = np.ascontiguousarray(gid, dtype=np.int32)
+        n_gids = int(gid.max()) + 1 if gid.size else 1
+        gid_count = np.zeros((n_gids, N), dtype=np.int32)
+        n_open = lib.ffd_solve_gid(*args, gid, gid_count,
+                                   node_off, assign, unplaced)
     return node_off, assign, unplaced, n_open

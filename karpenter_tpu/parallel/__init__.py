@@ -1,8 +1,9 @@
 from karpenter_tpu.parallel.mesh import fleet_mesh, solver_mesh
 from karpenter_tpu.parallel.fleet import (
-    FleetProblem, fleet_solve, fleet_solve_pallas,
+    FleetProblem, fleet_device_catalog, fleet_solve, fleet_solve_pallas,
     fleet_solve_sharded_offerings,
 )
 
-__all__ = ["fleet_mesh", "solver_mesh", "FleetProblem", "fleet_solve",
-           "fleet_solve_pallas", "fleet_solve_sharded_offerings"]
+__all__ = ["fleet_mesh", "solver_mesh", "FleetProblem",
+           "fleet_device_catalog", "fleet_solve", "fleet_solve_pallas",
+           "fleet_solve_sharded_offerings"]

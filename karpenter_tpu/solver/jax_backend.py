@@ -192,6 +192,152 @@ def expand_coo_assign(idx: np.ndarray, cnt: np.ndarray,
     return assign
 
 
+# ---------------------------------------------------------------------------
+# Packed single-buffer I/O (VERDICT round 2 item 1: the tunnel round trips
+# dominate the solve wall — 5 separate D2H leaves cost ~14 ms each through
+# the axon link.  Packing every per-solve input into ONE int32 buffer and
+# every output into ONE int32 buffer collapses the transfer count to one
+# H2D + one D2H regardless of problem shape.)
+#
+# Input layout  (int32, length G*8 + G*O/32):
+#   [0, G*8)      meta rows [G, 8]: req_cpu, req_mem, req_gpu, req_pods,
+#                 count, cap, 0, 0
+#   [G*8, end)    compat BITS, 32 per word (little-endian bit order),
+#                 row-major [G, O] — the [G,O] mask is by far the largest
+#                 per-window input; bit-packing shrinks it 8x vs bytes
+# Output layout (int32, length N + G + 1 + (2K | G*N)):
+#   [0, N)        node_off        (-1 = unused slot)
+#   [N, N+G)      unplaced per group
+#   [N+G]         cost            (float32 bit pattern)
+#   rest          COO idx[K] + cnt[K] when compact=K, else dense assign [G*N]
+# ---------------------------------------------------------------------------
+
+def pack_input(group_req, group_count, group_cap, compat) -> np.ndarray:
+    """Host-side: pack the per-window problem into the single H2D buffer.
+    ``compat`` may be bool or int8; O must be a multiple of 32 (guaranteed
+    by the offering padding in solve_encoded)."""
+    G, O = compat.shape
+    buf = np.empty(G * 8 + G * (O // 32), dtype=np.int32)
+    meta = buf[:G * 8].reshape(G, 8)
+    meta[:] = 0
+    meta[:, :4] = group_req
+    meta[:, 4] = group_count
+    meta[:, 5] = np.minimum(group_cap, np.iinfo(np.int32).max)
+    bits = np.packbits(np.ascontiguousarray(compat, dtype=np.uint8)
+                       .reshape(G, O // 32, 32),
+                       axis=-1, bitorder="little")          # [G, O/32, 4] u8
+    buf[G * 8:] = bits.reshape(-1).view(np.int32)
+    return buf
+
+
+def _unpack_problem(packed, G: int, O: int):
+    """Device-side inverse of :func:`pack_input` -> (meta [G,8] int32,
+    compat [G,O] int32 0/1).  Bit extraction via shifts (little-endian bit
+    and byte order, matching numpy packbits + .view on every supported
+    platform)."""
+    meta = packed[:G * 8].reshape(G, 8)
+    cw = packed[G * 8:].reshape(G, O // 32)
+    b = jnp.stack([(cw >> k) & 1 for k in range(32)], axis=-1)
+    return meta, b.reshape(G, O)
+
+
+def _pack_result(node_off, assign, unplaced, cost, K: int,
+                 dense16: bool = False):
+    """Device-side: flatten the solve result into the single D2H buffer.
+    ``dense16`` halves the dense-assign tail by packing two int16 counts
+    per word (valid when every offering's pod-slot capacity < 2^15, the
+    same bound the multi-leaf path used for its int16 assign_dtype)."""
+    cost_i = lax.bitcast_convert_type(cost.astype(jnp.float32)[None],
+                                      jnp.int32)
+    if K > 0:
+        idx, cnt = _compact_assign(assign.astype(jnp.int32), K)
+        tail = [idx, cnt]
+    elif dense16:
+        pairs = assign.astype(jnp.int32).reshape(-1, 2)
+        tail = [(pairs[:, 0] & 0xFFFF) | (pairs[:, 1] << 16)]
+    else:
+        tail = [assign.astype(jnp.int32).reshape(-1)]
+    return jnp.concatenate([node_off, unplaced.astype(jnp.int32), cost_i]
+                           + tail)
+
+
+def unpack_result(out: np.ndarray, G: int, N: int, K: int,
+                  dense16: bool = False):
+    """Host-side inverse of :func:`_pack_result` -> (node_off [N],
+    assign [G,N] int32, unplaced [G], cost float)."""
+    node_off = out[:N]
+    unplaced = out[N:N + G]
+    cost = float(out[N + G:N + G + 1].view(np.float32)[0])
+    rest = out[N + G + 1:]
+    if K > 0:
+        assign = expand_coo_assign(rest[:K], rest[K:2 * K], G, N)
+    elif dense16:
+        assign = np.empty(G * N, dtype=np.int32)
+        assign[0::2] = rest & 0xFFFF
+        assign[1::2] = (rest >> 16) & 0xFFFF
+        assign = assign.reshape(G, N)
+    else:
+        assign = rest.reshape(G, N)
+    return node_off, assign, unplaced, cost
+
+
+def _pallas_core(meta, compat_i, alloc8, rank_row, off_price, *, G: int,
+                 O: int, N: int, right_size: bool, interpret: bool):
+    """Shared body of the Mosaic-backed solve: FFD scan as one pallas
+    kernel, right-sizing + cost in XLA (MXU-friendly already).  Both the
+    multi-leaf and the packed entry points trace through here so the
+    feasibility-critical right-sizing logic exists exactly once."""
+    from karpenter_tpu.solver.pallas_kernel import ffd_scan_pallas
+
+    node_off, assign, unplaced = ffd_scan_pallas(
+        meta, compat_i, alloc8, rank_row, G=G, O=O, N=N, interpret=interpret)
+    if right_size:
+        compat = compat_i > 0
+        off_alloc = alloc8[:4].T                              # [O, R]
+        group_req = meta[:, :4]
+        # exact integer load: assign^T @ group_req on the MXU
+        load = jnp.einsum("gn,gr->nr", assign, group_req,
+                          preferred_element_type=jnp.int32)   # [N, R]
+        node_off = _right_size(node_off, load, assign, compat,
+                               off_alloc, rank_row[0])
+    is_open = node_off >= 0
+    cost = jnp.sum(jnp.where(is_open, off_price[jnp.clip(node_off, 0, None)],
+                             0.0))
+    return node_off, assign, unplaced, cost
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("G", "O", "N", "right_size", "compact",
+                                    "dense16"))
+def solve_packed(packed, off_alloc, off_price, off_rank, *, G: int, O: int,
+                 N: int, right_size: bool = True, compact: int = 0,
+                 dense16: bool = False):
+    """Packed-I/O solve through the lax.scan path: ONE device input (the
+    per-window problem buffer; catalog tensors are device-resident and
+    cached), ONE device output."""
+    meta, compat_i = _unpack_problem(packed, G, O)
+    node_off, assign, unplaced, cost = solve_core(
+        meta[:, :4], meta[:, 4], meta[:, 5], compat_i > 0,
+        off_alloc, off_price, off_rank, num_nodes=N, right_size=right_size)
+    return _pack_result(node_off, assign, unplaced, cost, compact, dense16)
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("G", "O", "N", "right_size", "interpret",
+                                    "compact", "dense16"))
+def solve_packed_pallas(packed, alloc8, rank_row, off_price, *, G: int,
+                        O: int, N: int, right_size: bool = True,
+                        interpret: bool = False, compact: int = 0,
+                        dense16: bool = False):
+    """Packed-I/O solve through the Mosaic kernel — same buffer contract
+    as :func:`solve_packed`."""
+    meta, compat_i = _unpack_problem(packed, G, O)
+    node_off, assign, unplaced, cost = _pallas_core(
+        meta, compat_i, alloc8, rank_row, off_price,
+        G=G, O=O, N=N, right_size=right_size, interpret=interpret)
+    return _pack_result(node_off, assign, unplaced, cost, compact, dense16)
+
+
 def solve_core(group_req, group_count, group_cap, compat,
                off_alloc, off_price, off_rank, *, num_nodes: int,
                right_size: bool = True):
@@ -257,27 +403,12 @@ def solve_kernel_pallas(meta, compat_i8, alloc8, rank_row, off_price, *,
                         assign_dtype: str = "int32",
                         interpret: bool = False, compact: int = 0):
     """Pallas-backed solve with the same output contract as solve_kernel.
-    The FFD scan runs as ONE Mosaic kernel (solver/pallas_kernel.py); the
-    right-sizing matmul pass and cost stay in XLA (MXU-friendly already)."""
-    from karpenter_tpu.solver.pallas_kernel import ffd_scan_pallas
-
-    # compat crosses the host->device boundary as int8 (4x smaller on the
-    # wire); the kernel wants the int32 tiling, cast on device
-    node_off, assign, unplaced = ffd_scan_pallas(
-        meta, compat_i8.astype(jnp.int32), alloc8, rank_row, G=G, O=O, N=N,
-        interpret=interpret)
-    if right_size:
-        compat = compat_i8 > 0
-        off_alloc = alloc8[:4].T                              # [O, R]
-        group_req = meta[:, :4]
-        # exact integer load: assign^T @ group_req on the MXU
-        load = jnp.einsum("gn,gr->nr", assign, group_req,
-                          preferred_element_type=jnp.int32)   # [N, R]
-        node_off = _right_size(node_off, load, assign, compat,
-                               off_alloc, rank_row[0])
-    is_open = node_off >= 0
-    cost = jnp.sum(jnp.where(is_open, off_price[jnp.clip(node_off, 0, None)],
-                             0.0))
+    Traces through :func:`_pallas_core` (shared with the packed entry
+    point).  compat crosses the host->device boundary as int8 (4x smaller
+    on the wire); the kernel wants the int32 tiling, cast on device."""
+    node_off, assign, unplaced, cost = _pallas_core(
+        meta, compat_i8.astype(jnp.int32), alloc8, rank_row, off_price,
+        G=G, O=O, N=N, right_size=right_size, interpret=interpret)
     assign = assign.astype(assign_dtype)
     if compact > 0:
         assign = _compact_assign(assign, compact)
@@ -288,6 +419,28 @@ def solve_kernel_pallas(meta, compat_i8, alloc8, rank_row, off_price, *,
 # Host wrapper
 # ---------------------------------------------------------------------------
 
+class _Prepared:
+    """Shapes + the packed H2D buffer for one solve.  Mutable: ``N``
+    escalates on in-kernel node overflow, and each dispatch re-clamps
+    ``K`` (and records ``dense16``) to the shapes it actually ran with so
+    ``unpack_result`` always parses the buffer the kernel produced."""
+
+    __slots__ = ("catalog", "G_pad", "O_pad", "N", "N_cap", "K0", "K",
+                 "dense16", "packed")
+
+    def __init__(self, *, catalog, G_pad, O_pad, N, N_cap, K0, packed,
+                 dense16=False):
+        self.catalog = catalog
+        self.G_pad = G_pad
+        self.O_pad = O_pad
+        self.N = N
+        self.N_cap = N_cap
+        self.K0 = K0
+        self.K = min(K0, G_pad * N)
+        self.dense16 = dense16
+        self.packed = packed
+
+
 class JaxSolver:
     """Pads, uploads, solves, decodes.  Catalog tensors are kept
     device-resident keyed by (catalog generation, availability generation)."""
@@ -295,9 +448,10 @@ class JaxSolver:
     def __init__(self, options: Optional[SolverOptions] = None):
         self.options = options or SolverOptions(backend="jax")
         self._device_catalog: Dict[Tuple, Tuple] = {}
-        # per-solve observability: kernel path, device vs fetch split,
-        # D2H payload (VERDICT round 1: the bench must be able to separate
-        # "solver slow" from "link slow")
+        # per-solve observability: kernel path, dispatch vs exec+fetch
+        # split, payload bytes.  Pure chip time is NOT separable on the
+        # solve path (a sync before the fetch would cost a tunnel round
+        # trip) — compute_handle measures it out-of-band.
         self.last_stats: Dict[str, object] = {}
         # per-shape pallas breaker: one pathological (G,O,N) bucket must
         # not disable the fast path for buckets that compile fine
@@ -320,126 +474,169 @@ class JaxSolver:
         return plan
 
     def solve_encoded(self, problem: EncodedProblem) -> Plan:
-        catalog = problem.catalog
-        G = problem.num_groups
-        O = catalog.num_offerings
-        if G == 0:
+        if problem.num_groups == 0:
             return Plan(nodes=[], unplaced_pods=list(problem.rejected),
                         backend="jax")
-
-        total_pods = int(problem.group_count.sum())
-        G_pad = bucket(G, GROUP_BUCKETS) if self.options.bucket_groups else G
-        O_pad = bucket(O, OFFERING_BUCKETS) if self.options.bucket_groups else O
-        N_cap = min(self.options.max_nodes,
-                    bucket(max(total_pods, 1), NODE_BUCKETS))
-        N = self._estimate_nodes(problem, N_cap) if self.options.adaptive_nodes \
-            else N_cap
-
-        group_req = _pad2(problem.group_req, G_pad)
-        group_count = _pad1(problem.group_count, G_pad)
-        group_cap = _pad1(problem.group_cap, G_pad)
-        compat = _pad2(problem.compat, G_pad, O_pad)
-
-        # Pack the assignment matrix (the dominant D2H transfer) into int16
-        # when per-node pod counts provably fit: every group requests >=1
-        # pod slot, so assign[g,n] <= the offering's pod-slot allocatable.
-        max_slots = int(catalog.offering_alloc()[:, 3].max()) if O else 1
-        assign_dtype = "int16" if max_slots < (1 << 15) else "int32"
-        K = self._compact_k(total_pods, G_pad)
+        prep = self._prepare(problem)
 
         while True:
-            # pallas needs a 128-multiple node axis; never exceed the
-            # configured cap to get one — fall back to the scan path instead
-            Np = max(N, 128)
-            use_pallas = (Np <= N_cap and self._use_pallas(G_pad, O_pad, Np)
-                          and (G_pad, O_pad, Np)
-                          not in self._pallas_failed_shapes)
             t_disp = time.perf_counter()
-            leaves = None
-            if use_pallas:
-                # dispatch AND sync inside the try: TPU execution is
-                # async, so Mosaic runtime faults only surface at
-                # block_until_ready — a fallback that guards dispatch
-                # alone would miss them
-                try:
-                    from karpenter_tpu.solver.pallas_kernel import pack_problem
-                    meta, compat_i8 = pack_problem(group_req, group_count,
-                                                   group_cap, compat)
-                    alloc8, rank_row, price_dev = \
-                        self._device_offerings_pallas(catalog, O_pad)
-                    out = solve_kernel_pallas(
-                        jnp.asarray(meta), jnp.asarray(compat_i8),
-                        alloc8, rank_row, price_dev,
-                        G=G_pad, O=O_pad, N=Np,
-                        right_size=self.options.right_size,
-                        assign_dtype=assign_dtype,
-                        compact=min(K, G_pad * Np) if K else 0)
-                    leaves = self._leaves(out, K)
-                    jax.block_until_ready(leaves)
-                    N = Np
-                except Exception as e:  # noqa: BLE001
-                    # a Mosaic failure must never break a solve window —
-                    # fall back to the scan path for this shape bucket
-                    # and make the switch observable
-                    log.warning("pallas path failed; scan fallback engaged",
-                                error=str(e)[:300], G=G_pad, O=O_pad, N=Np)
-                    metrics.ERRORS.labels("solver", "pallas_fallback").inc()
-                    self._pallas_failed_shapes.add((G_pad, O_pad, Np))
-                    use_pallas = False
-                    leaves = None
-            if leaves is None:
-                off_alloc, off_price, off_rank = self._device_offerings(
-                    catalog, O_pad)
-                out = solve_kernel(
-                    jnp.asarray(group_req), jnp.asarray(group_count),
-                    jnp.asarray(group_cap), jnp.asarray(compat),
-                    off_alloc, off_price, off_rank,
-                    num_nodes=N, right_size=self.options.right_size,
-                    assign_dtype=assign_dtype,
-                    compact=min(K, G_pad * N) if K else 0)
-                leaves = self._leaves(out, K)
-                jax.block_until_ready(leaves)
-            node_off_dev, assign_dev, unplaced_dev, cost_dev = out
-            t_done = time.perf_counter()
-            # one pipelined fetch round: start all D2H copies, then read
-            for o in leaves:
-                o.copy_to_host_async()
-            node_off = np.asarray(node_off_dev)
-            if K:
-                assign = expand_coo_assign(np.asarray(assign_dev[0]),
-                                           np.asarray(assign_dev[1]),
-                                           G_pad, N)
-            else:
-                assign = np.asarray(assign_dev)
-            unplaced = np.asarray(unplaced_dev)
-            cost = float(cost_dev)
+            out_dev, path = self._dispatch(prep, prep.packed)
+            t_issued = time.perf_counter()
+            # ONE synchronous D2H: np.asarray blocks through compute and
+            # fetch in a single round trip (no separate block_until_ready
+            # sync — that would be a second RTT on the timing path).  TPU
+            # execution is async, so Mosaic runtime faults surface HERE,
+            # not at dispatch — the pallas fallback hooks the fetch.
+            try:
+                out_np = np.asarray(out_dev)
+            except Exception as e:  # noqa: BLE001
+                if path != "pallas":
+                    raise
+                # a Mosaic failure must never break a solve window — fall
+                # back to the scan path for this shape bucket and make the
+                # switch observable
+                log.warning("pallas path failed; scan fallback engaged",
+                            error=str(e)[:300], G=prep.G_pad, O=prep.O_pad,
+                            N=prep.N)
+                metrics.ERRORS.labels("solver", "pallas_fallback").inc()
+                self._pallas_failed_shapes.add(
+                    (prep.G_pad, prep.O_pad, prep.N))
+                out_dev, path = self._dispatch(prep, prep.packed)
+                out_np = np.asarray(out_dev)
             t_fetch = time.perf_counter()
-            path = "pallas" if use_pallas else "scan"
+            node_off, assign, unplaced, cost = unpack_result(
+                out_np, prep.G_pad, prep.N, prep.K, prep.dense16)
             metrics.SOLVE_PATH.labels(path).inc()
-            d2h = int(sum(int(np.dtype(o.dtype).itemsize) * int(np.prod(o.shape))
-                          for o in leaves))
+            d2h = int(out_np.nbytes)
             metrics.SOLVE_D2H_BYTES.labels("jax").observe(d2h)
+            # exec_fetch_s spans async device EXECUTION + D2H together (a
+            # separate sync before the fetch would cost one more tunnel
+            # round trip); pure chip time is measured out-of-band by
+            # compute_handle, not here
             self.last_stats = {
-                "path": path, "device_s": t_done - t_disp,
-                "fetch_s": t_fetch - t_done, "d2h_bytes": d2h,
-                "compact": bool(K), "G": G_pad, "O": O_pad, "N": N}
+                "path": path, "wall_s": t_fetch - t_disp,
+                "dispatch_s": t_issued - t_disp,
+                "exec_fetch_s": t_fetch - t_issued, "d2h_bytes": d2h,
+                "h2d_bytes": int(prep.packed.nbytes),
+                "compact": bool(prep.K), "G": prep.G_pad, "O": prep.O_pad,
+                "N": prep.N}
             # escalate only when the node budget itself was the binding
             # constraint (all slots open + pods left over)
-            if (int(unplaced.sum()) > 0 and int((node_off >= 0).sum()) >= N
-                    and N < N_cap):
-                N = min(N_cap, bucket(N * 4, NODE_BUCKETS))
+            if (int(unplaced.sum()) > 0
+                    and int((node_off >= 0).sum()) >= prep.N
+                    and prep.N < prep.N_cap):
+                prep.N = min(prep.N_cap, bucket(prep.N * 4, NODE_BUCKETS))
                 continue
             break
         return self._decode(problem, node_off, assign.astype(np.int32),
                             unplaced, cost)
 
-    @staticmethod
-    def _leaves(out, K: int) -> list:
-        """Flatten a kernel result into its device arrays (COO results
-        carry the assign as an (idx, cnt) pair)."""
-        node_off, assign, unplaced, cost = out
-        return [node_off, unplaced, cost] + \
-            (list(assign) if K else [assign])
+    def compute_handle(self, problem: EncodedProblem):
+        """Pure on-chip benchmark handle: returns a zero-arg callable that
+        re-runs the packed solve on DEVICE-RESIDENT inputs and blocks until
+        the on-device result is ready — no H2D, no D2H.  This is the
+        "<50 ms on one v5e chip" measurement (VERDICT round 2 item 2: the
+        wall number alone cannot separate chip time from tunnel time)."""
+        prep = self._prepare(problem)
+        dev_in = jax.device_put(prep.packed)
+        jax.block_until_ready(dev_in)
+
+        def run(k: int = 1):
+            # k back-to-back dispatches, ONE block: through a high-RTT
+            # link, per-solve device time = slope of t(k) over k (the
+            # single fixed sync round trip cancels out)
+            outs = [self._dispatch(prep, dev_in)[0] for _ in range(k)]
+            outs[-1].block_until_ready()
+            return outs[-1]
+
+        run()   # warm the executable for this shape
+        return run
+
+    def _prepare(self, problem: EncodedProblem) -> "_Prepared":
+        """Pad, choose shapes, and pack the single H2D buffer."""
+        catalog = problem.catalog
+        G = problem.num_groups
+        O = catalog.num_offerings
+        total_pods = int(problem.group_count.sum())
+        G_pad = bucket(G, GROUP_BUCKETS) if self.options.bucket_groups else G
+        O_pad = bucket(O, OFFERING_BUCKETS) if self.options.bucket_groups \
+            else -32 * (-O // 32)   # packed compat needs a 32-multiple O
+        N_cap = min(self.options.max_nodes,
+                    bucket(max(total_pods, 1), NODE_BUCKETS))
+        N = self._estimate_nodes(problem, N_cap) \
+            if self.options.adaptive_nodes else N_cap
+        packed = pack_input(_pad2(problem.group_req, G_pad),
+                            _pad1(problem.group_count, G_pad),
+                            _pad1(problem.group_cap, G_pad),
+                            _pad2(problem.compat, G_pad, O_pad))
+        # K0 is the pod-count COO bound (nnz <= placed pods); the dispatch
+        # clamps it against the ACTUAL node axis of each attempt (pallas
+        # rounds N up to 128, escalation grows it 4x) — a one-shot clamp
+        # against the initial estimate could silently drop entries when
+        # K0 > G*N_init and N later grows (_compact_assign scatters with
+        # mode="drop")
+        K0 = self._compact_k(total_pods, G_pad)
+        # dense fetch (compact off): pack two int16 counts per word when
+        # every offering's pod-slot capacity provably bounds assign cells
+        # below 2^15 (same bound the old int16 assign_dtype used)
+        max_slots = int(catalog.offering_alloc()[:, 3].max()) if O else 1
+        # G_pad*N evenness: the int16 pair-packing reshapes to (-1, 2);
+        # N is even for every bucket but an unbucketed odd G with odd
+        # max_nodes could produce an odd product
+        return _Prepared(catalog=catalog, G_pad=G_pad, O_pad=O_pad,
+                         N=N, N_cap=N_cap, K0=K0, packed=packed,
+                         dense16=(K0 == 0 and max_slots < (1 << 15)
+                                  and (G_pad * N) % 2 == 0))
+
+    def _dispatch(self, prep: "_Prepared", arr):
+        """Issue the packed solve (pallas with scan fallback).  ``arr`` is
+        the packed input — host numpy (implicit single H2D) or an already
+        device-resident buffer.  Returns (device output, path name)."""
+        catalog, G_pad, O_pad = prep.catalog, prep.G_pad, prep.O_pad
+        N = prep.N
+        # re-check the dense16 evenness invariant against the N actually
+        # dispatched — escalation can land on an odd N_cap after _prepare
+        # validated only the initial estimate (reshape(-1, 2) would fail)
+        # (scan dispatches with N; pallas with max(N, 128), which is even
+        # whenever it differs from N — so checking N covers both)
+        if prep.dense16 and (G_pad * N) % 2:
+            prep.dense16 = False
+        # pallas needs a 128-multiple node axis; never exceed the
+        # configured cap to get one — fall back to the scan path instead
+        Np = max(N, 128)
+        use_pallas = (Np <= prep.N_cap and self._use_pallas(G_pad, O_pad, Np)
+                      and (G_pad, O_pad, Np)
+                      not in self._pallas_failed_shapes)
+        if use_pallas:
+            # Mosaic COMPILE failures surface here; runtime faults are
+            # async and surface at the caller's fetch/block, which owns
+            # the scan fallback for both cases
+            try:
+                alloc8, rank_row, price_dev = \
+                    self._device_offerings_pallas(catalog, O_pad)
+                prep.K = min(prep.K0, G_pad * Np)   # re-clamp to actual N
+                out = solve_packed_pallas(
+                    arr, alloc8, rank_row, price_dev,
+                    G=G_pad, O=O_pad, N=Np,
+                    right_size=self.options.right_size,
+                    compact=prep.K, dense16=prep.dense16)
+                prep.N = Np
+                return out, "pallas"
+            except Exception as e:  # noqa: BLE001
+                log.warning("pallas dispatch failed; scan fallback engaged",
+                            error=str(e)[:300], G=G_pad, O=O_pad, N=Np)
+                metrics.ERRORS.labels("solver", "pallas_fallback").inc()
+                self._pallas_failed_shapes.add((G_pad, O_pad, Np))
+        off_alloc, off_price, off_rank = self._device_offerings(
+            catalog, O_pad)
+        prep.K = min(prep.K0, G_pad * N)   # re-clamp to actual N
+        out = solve_packed(
+            arr, off_alloc, off_price, off_rank,
+            G=G_pad, O=O_pad, N=N,
+            right_size=self.options.right_size,
+            compact=prep.K, dense16=prep.dense16)
+        return out, "scan"
 
     def _compact_k(self, total_pods: int, G_pad: int) -> int:
         """COO capacity for the compacted assign fetch; 0 = dense fetch.

@@ -34,16 +34,26 @@ extern "C" {
 
 // Returns the number of open nodes, or -1 if max_nodes was exhausted with
 // placeable pods remaining (caller escalates, mirroring the JAX path).
-int ffd_solve(int G, int O, int N,
-              const int32_t* group_req,    // [G,R]
-              const int32_t* group_count,  // [G]
-              const int32_t* group_cap,    // [G]
-              const uint8_t* compat,       // [G,O]
-              const int32_t* off_alloc,    // [O,R]
-              const float* off_rank,       // [O]
-              int32_t* node_off,           // out [N]  (-1 = unused)
-              int32_t* assign,             // out [G,N] (zeroed by caller)
-              int32_t* unplaced) {         // out [G]
+//
+// ``gid`` (optional, may be null): per-row ORIGINAL group id for per-pod
+// expansions (solver/greedy.py expand_per_pod).  With one row per pod the
+// per-node cap (hostname anti-affinity etc.) cannot be enforced through
+// the row's own assign count — each row holds a single pod — so the cap
+// accounting runs against ``gid_count`` ([n_gids, N], zeroed by caller)
+// keyed by the original group.  Null gid keeps the grouped behavior
+// (cap counted on the row itself).
+int ffd_solve_gid(int G, int O, int N,
+                  const int32_t* group_req,    // [G,R]
+                  const int32_t* group_count,  // [G]
+                  const int32_t* group_cap,    // [G]
+                  const uint8_t* compat,       // [G,O]
+                  const int32_t* off_alloc,    // [O,R]
+                  const float* off_rank,       // [O]
+                  const int32_t* gid,          // [G] or null
+                  int32_t* gid_count,          // [n_gids,N] or null
+                  int32_t* node_off,           // out [N]  (-1 = unused)
+                  int32_t* assign,             // out [G,N] (zeroed by caller)
+                  int32_t* unplaced) {         // out [G]
   std::vector<int32_t> resid(static_cast<size_t>(N) * R, 0);
   int open = 0;
   bool overflow = false;
@@ -52,6 +62,8 @@ int ffd_solve(int G, int O, int N,
     const int32_t* req = group_req + static_cast<size_t>(g) * R;
     const int32_t cap = group_cap[g];
     const uint8_t* cg = compat + static_cast<size_t>(g) * O;
+    int32_t* capcnt = gid ? gid_count + static_cast<size_t>(gid[g]) * N
+                          : assign + static_cast<size_t>(g) * N;
     unplaced[g] = 0;
 
     // cheapest-per-pod offering on an empty node for this group: the
@@ -85,11 +97,12 @@ int ffd_solve(int G, int O, int N,
       bool placed = false;
       for (int n = 0; n < open; ++n) {
         if (!cg[node_off[n]]) continue;
-        if (assign[static_cast<size_t>(g) * N + n] >= cap) continue;
+        if (capcnt[n] >= cap) continue;
         int32_t* rn = resid.data() + static_cast<size_t>(n) * R;
         if (!fits(rn, req)) continue;
         for (int r = 0; r < R; ++r) rn[r] -= req[r];
         assign[static_cast<size_t>(g) * N + n] += 1;
+        if (gid) capcnt[n] += 1;
         placed = true;
         break;
       }
@@ -110,9 +123,21 @@ int ffd_solve(int G, int O, int N,
       int32_t* rn = resid.data() + static_cast<size_t>(n) * R;
       for (int r = 0; r < R; ++r) rn[r] = alloc[r] - req[r];
       assign[static_cast<size_t>(g) * N + n] = 1;
+      if (gid) capcnt[n] += 1;
     }
   }
   return overflow ? -1 : open;
+}
+
+// Grouped entry point (original ABI): cap accounting on the row itself.
+int ffd_solve(int G, int O, int N,
+              const int32_t* group_req, const int32_t* group_count,
+              const int32_t* group_cap, const uint8_t* compat,
+              const int32_t* off_alloc, const float* off_rank,
+              int32_t* node_off, int32_t* assign, int32_t* unplaced) {
+  return ffd_solve_gid(G, O, N, group_req, group_count, group_cap, compat,
+                       off_alloc, off_rank, nullptr, nullptr,
+                       node_off, assign, unplaced);
 }
 
 }  // extern "C"

@@ -81,3 +81,58 @@ def test_native_node_overflow_degrades_like_python():
     b = GreedySolver(SolverOptions(use_native="off", max_nodes=2)).solve(req)
     assert _plans_equal(a, b)
     assert a.unplaced_pods
+
+
+@needs_native
+class TestPerPodExpansion:
+    """The faithful per-pod baseline (VERDICT round 2 item 3): signature
+    compression undone, one row per pod, caps accounted per ORIGINAL group
+    via the gid side table."""
+
+    def test_per_pod_plan_matches_grouped(self):
+        from karpenter_tpu.solver.encode import encode
+        from karpenter_tpu.solver.greedy import solve_per_pod_native
+
+        catalog = _catalog(20)
+        rng = np.random.RandomState(4)
+        sizes = [(250, 512), (500, 1024), (2000, 8192)]
+        pods = []
+        for i in range(400):
+            cpu, mem = sizes[rng.randint(len(sizes))]
+            pods.append(PodSpec(f"p{i}",
+                                requests=ResourceRequests(cpu, mem, 0, 1)))
+        prob = encode(pods, catalog)
+        out = solve_per_pod_native(prob)
+        assert out is not None and out[3] >= 0
+        gplan = GreedySolver(SolverOptions(use_native="off")) \
+            .solve_encoded(prob)
+        # grouped batch-fill is documented bit-identical to per-pod
+        # first-fit: same node count, same offerings, same cost
+        node_off, _, unplaced, n_open = out
+        assert n_open == len(gplan.nodes)
+        assert int(unplaced.sum()) == len(gplan.unplaced_pods) - \
+            len(prob.rejected)
+        open_off = np.sort(node_off[node_off >= 0])
+        assert open_off.tolist() == sorted(
+            n.offering_index for n in gplan.nodes)
+
+    def test_per_pod_respects_anti_affinity_cap(self):
+        """cap_per_node=1 (hostname anti-affinity): the per-pod expansion
+        must open one node per pod, not stack the group on one node."""
+        from karpenter_tpu.apis.pod import PodAffinityTerm
+        from karpenter_tpu.solver.encode import encode
+        from karpenter_tpu.solver.greedy import solve_per_pod_native
+
+        catalog = _catalog(10)
+        pods = make_pods(6, requests=ResourceRequests(100, 128, 0, 1),
+                         labels=(("app", "db"),),
+                         affinity=(PodAffinityTerm(
+                             topology_key="kubernetes.io/hostname",
+                             label_selector=(("app", "db"),), anti=True),))
+        prob = encode(pods, catalog)
+        assert (prob.group_cap == 1).any()
+        out = solve_per_pod_native(prob)
+        node_off, assign, unplaced, n_open = out
+        assert int(unplaced.sum()) == 0
+        assert n_open == 6             # one node per pod, cap enforced
+        assert (assign.sum(axis=0)[:n_open] == 1).all()

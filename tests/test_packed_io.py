@@ -1,0 +1,191 @@
+"""Packed single-buffer solve I/O (VERDICT round 2 item 1).
+
+The solve crosses the host<->device boundary exactly twice — one packed
+int32 input buffer, one packed int32 output buffer — because each
+transfer through the TPU tunnel costs a full round trip regardless of
+size.  These tests pin the byte-level pack/unpack contract and assert the
+packed kernels are bit-identical to the multi-leaf kernels they replace.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from karpenter_tpu.apis.pod import PodSpec, ResourceRequests
+from karpenter_tpu.catalog import CatalogArrays, InstanceTypeProvider, PricingProvider
+from karpenter_tpu.cloud.fake import FakeCloud
+from karpenter_tpu.solver import GreedySolver, JaxSolver, SolveRequest, encode, validate_plan
+from karpenter_tpu.solver.jax_backend import (
+    _pad1, _pad2, _unpack_problem, pack_input, solve_kernel, solve_packed,
+    solve_packed_pallas, unpack_result,
+)
+from karpenter_tpu.solver.types import (
+    GROUP_BUCKETS, OFFERING_BUCKETS, SolverOptions, bucket,
+)
+
+
+@pytest.fixture(scope="module")
+def catalog():
+    cloud = FakeCloud()
+    pricing = PricingProvider(cloud)
+    itp = InstanceTypeProvider(cloud, pricing)
+    arrays = CatalogArrays.build(itp.list())
+    pricing.close()
+    return arrays
+
+
+def _padded_problem(catalog, n_pods=200, seed=3):
+    rng = np.random.RandomState(seed)
+    sizes = [(250, 512), (500, 1024), (2000, 8192), (4000, 16384)]
+    pods = []
+    for i in range(n_pods):
+        cpu, mem = sizes[rng.randint(len(sizes))]
+        pods.append(PodSpec(f"p{i}", requests=ResourceRequests(cpu, mem, 0, 1)))
+    prob = encode(pods, catalog)
+    G = bucket(prob.num_groups, GROUP_BUCKETS)
+    O = bucket(catalog.num_offerings, OFFERING_BUCKETS)
+    return (prob,
+            _pad2(prob.group_req, G), _pad1(prob.group_count, G),
+            _pad1(prob.group_cap, G), _pad2(prob.compat, G, O), G, O)
+
+
+class TestPackUnpack:
+    def test_roundtrip_bytes(self, catalog):
+        _, req, cnt, cap, compat, G, O = _padded_problem(catalog)
+        packed = pack_input(req, cnt, cap, compat)
+        assert packed.dtype == np.int32
+        assert packed.shape == (G * 8 + G * O // 32,)
+        meta, compat_i = jax.jit(_unpack_problem, static_argnums=(1, 2))(
+            packed, G, O)
+        np.testing.assert_array_equal(np.asarray(meta)[:, :4], req)
+        np.testing.assert_array_equal(np.asarray(meta)[:, 4], cnt)
+        np.testing.assert_array_equal(np.asarray(meta)[:, 5],
+                                      np.minimum(cap, np.iinfo(np.int32).max))
+        np.testing.assert_array_equal(np.asarray(compat_i),
+                                      compat.astype(np.int32))
+
+    def test_result_roundtrip_dense_and_coo(self):
+        G, N, K = 8, 16, 32
+        rng = np.random.RandomState(0)
+        node_off = rng.randint(-1, 5, N).astype(np.int32)
+        unplaced = rng.randint(0, 3, G).astype(np.int32)
+        # sparse assign tied to open nodes so COO nnz fits K
+        assign = np.zeros((G, N), np.int32)
+        assign[1, 3] = 7
+        assign[4, 0] = 2
+        cost = 12.375
+        from karpenter_tpu.solver.jax_backend import _pack_result
+
+        for k in (0, K):
+            out = np.asarray(jax.jit(
+                lambda a, b, c, d: _pack_result(a, b, c, d, k))(
+                    jnp.asarray(node_off), jnp.asarray(assign),
+                    jnp.asarray(unplaced), jnp.float32(cost)))
+            no, asg, unp, c = unpack_result(out, G, N, k)
+            np.testing.assert_array_equal(no, node_off)
+            np.testing.assert_array_equal(asg, assign)
+            np.testing.assert_array_equal(unp, unplaced)
+            assert c == pytest.approx(cost)
+
+
+class TestPackedKernelParity:
+    def test_packed_scan_matches_multi_leaf_kernel(self, catalog):
+        _, req, cnt, cap, compat, G, O = _padded_problem(catalog)
+        N = 256
+        off_alloc = _pad2(catalog.offering_alloc().astype(np.int32), O)
+        off_price = _pad1(catalog.off_price.astype(np.float32), O)
+        off_rank = _pad1(catalog.offering_rank_price(), O)
+        ref = solve_kernel(req, cnt, cap, compat, off_alloc, off_price,
+                           off_rank, num_nodes=N)
+        packed = pack_input(req, cnt, cap, compat)
+        out = np.asarray(solve_packed(packed, off_alloc, off_price, off_rank,
+                                      G=G, O=O, N=N))
+        no, asg, unp, cost = unpack_result(out, G, N, 0)
+        np.testing.assert_array_equal(no, np.asarray(ref[0]))
+        np.testing.assert_array_equal(asg, np.asarray(ref[1]))
+        np.testing.assert_array_equal(unp, np.asarray(ref[2]))
+        assert cost == pytest.approx(float(ref[3]), rel=1e-6)
+
+    def test_packed_coo_matches_dense(self, catalog):
+        _, req, cnt, cap, compat, G, O = _padded_problem(catalog, seed=7)
+        N = 256
+        off_alloc = _pad2(catalog.offering_alloc().astype(np.int32), O)
+        off_price = _pad1(catalog.off_price.astype(np.float32), O)
+        off_rank = _pad1(catalog.offering_rank_price(), O)
+        packed = pack_input(req, cnt, cap, compat)
+        dense = unpack_result(
+            np.asarray(solve_packed(packed, off_alloc, off_price, off_rank,
+                                    G=G, O=O, N=N)), G, N, 0)
+        K = 1024
+        coo = unpack_result(
+            np.asarray(solve_packed(packed, off_alloc, off_price, off_rank,
+                                    G=G, O=O, N=N, compact=K)), G, N, K)
+        np.testing.assert_array_equal(dense[0], coo[0])
+        np.testing.assert_array_equal(dense[1], coo[1])
+        np.testing.assert_array_equal(dense[2], coo[2])
+
+    def test_packed_pallas_interpret_matches_scan(self, catalog):
+        _, req, cnt, cap, compat, G, O = _padded_problem(catalog, seed=11)
+        N = 128
+        from karpenter_tpu.solver.pallas_kernel import pack_catalog
+
+        off_alloc = _pad2(catalog.offering_alloc().astype(np.int32), O)
+        off_price = _pad1(catalog.off_price.astype(np.float32), O)
+        off_rank = _pad1(catalog.offering_rank_price(), O)
+        alloc8, rank_row = pack_catalog(off_alloc, off_rank)
+        packed = pack_input(req, cnt, cap, compat)
+        ref = unpack_result(
+            np.asarray(solve_packed(packed, off_alloc, off_price, off_rank,
+                                    G=G, O=O, N=N)), G, N, 0)
+        out = unpack_result(
+            np.asarray(solve_packed_pallas(
+                packed, jnp.asarray(alloc8), jnp.asarray(rank_row),
+                jnp.asarray(off_price), G=G, O=O, N=N, interpret=True)),
+            G, N, 0)
+        np.testing.assert_array_equal(ref[0], out[0])
+        np.testing.assert_array_equal(ref[1], out[1])
+        np.testing.assert_array_equal(ref[2], out[2])
+        assert out[3] == pytest.approx(ref[3], rel=1e-6)
+
+
+class TestSolverIntegration:
+    def test_solve_encoded_single_h2d_single_d2h(self, catalog):
+        """The end-to-end solve reports exactly one packed transfer each
+        way (the invariant the round-3 latency work rests on)."""
+        pods = [PodSpec(f"p{i}", requests=ResourceRequests(500, 1024, 0, 1))
+                for i in range(300)]
+        solver = JaxSolver()
+        plan = solver.solve(SolveRequest(pods, catalog))
+        assert validate_plan(plan, pods, catalog) == []
+        st = solver.last_stats
+        assert st["h2d_bytes"] > 0 and st["d2h_bytes"] > 0
+        # output buffer = N + G + 1 + tail, a single int32 vector
+        assert st["d2h_bytes"] % 4 == 0
+
+    def test_compute_handle_stable_and_fetchless(self, catalog):
+        pods = [PodSpec(f"p{i}", requests=ResourceRequests(500, 1024, 0, 1))
+                for i in range(100)]
+        solver = JaxSolver()
+        prob = encode(pods, catalog)
+        run = solver.compute_handle(prob)
+        a = np.asarray(run(1))
+        b = np.asarray(run(3))
+        np.testing.assert_array_equal(a, b)
+
+    def test_packed_plan_matches_greedy_oracle(self, catalog):
+        rng = np.random.RandomState(5)
+        sizes = [(250, 512), (500, 1024), (2000, 8192)]
+        pods = []
+        for i in range(500):
+            cpu, mem = sizes[rng.randint(len(sizes))]
+            pods.append(PodSpec(f"p{i}",
+                                requests=ResourceRequests(cpu, mem, 0, 1)))
+        req = SolveRequest(pods, catalog)
+        jplan = JaxSolver().solve(req)
+        gplan = GreedySolver(SolverOptions(use_native="off")).solve(req)
+        assert validate_plan(jplan, pods, catalog) == []
+        # right-sizing may only IMPROVE on greedy cost, never regress it
+        assert jplan.total_cost_per_hour <= gplan.total_cost_per_hour + 1e-6
+        assert sorted(jplan.unplaced_pods) == sorted(gplan.unplaced_pods)
