@@ -159,15 +159,23 @@ class TestWorkerPoolActuator:
                        for w in workers)
 
     def test_iks_bootstrap_provider(self, iks_rig):
+        """iks-api bootstrap (ref iks_api.go:53): a VPC instance is
+        REGISTERED into the cluster through the client's real surface;
+        the managed plane (simulated by the fake's deploy hook) flips it
+        to deployed."""
         cloud, iks, cluster, actuator, catalog = iks_rig
-        nc = cluster.add_nodeclass(iks_nodeclass())
-        claim = actuator.create_node(planned(catalog), nc, catalog)
         bp = IKSBootstrapProvider(iks)
         cfg = bp.cluster_config()
         assert "cls-1" in cfg.api_endpoint
-        worker_id = claim.annotations["karpenter-tpu.sh/iks-worker-id"]
-        bp.register_worker(worker_id)
-        assert iks.get_worker(worker_id).state == "deployed"
+        assert cfg.kubernetes_version == iks.kube_version
+        subnet = cloud.list_subnets()[0]
+        inst = cloud.create_instance(name="byo-node", profile="bx2-4x16",
+                                     zone=subnet.zone, subnet_id=subnet.id,
+                                     image_id=cloud.list_images()[0].id)
+        worker = bp.register_instance(inst.id)
+        assert bp.worker_state(worker.id) == "provisioning"
+        iks.deploy_worker(worker.id)         # managed plane finishes
+        assert bp.worker_state(worker.id) == "deployed"
 
 
 class TestProviderFactory:
