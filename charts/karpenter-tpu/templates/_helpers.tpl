@@ -1,0 +1,20 @@
+{{/* Common labels (ref charts/templates/_helpers.tpl) */}}
+{{- define "karpenter-tpu.labels" -}}
+app.kubernetes.io/name: {{ .Chart.Name }}
+app.kubernetes.io/instance: {{ .Release.Name }}
+app.kubernetes.io/version: {{ .Chart.AppVersion | quote }}
+app.kubernetes.io/managed-by: {{ .Release.Service }}
+{{- end }}
+
+{{- define "karpenter-tpu.selectorLabels" -}}
+app.kubernetes.io/name: {{ .Chart.Name }}
+app.kubernetes.io/instance: {{ .Release.Name }}
+{{- end }}
+
+{{- define "karpenter-tpu.serviceAccountName" -}}
+{{- if .Values.serviceAccount.create }}{{ .Values.serviceAccount.name }}{{- else }}{{ .Values.serviceAccount.name | default "default" }}{{- end }}
+{{- end }}
+
+{{- define "karpenter-tpu.credentialsSecretName" -}}
+{{- if .Values.credentials.existingSecret }}{{ .Values.credentials.existingSecret }}{{- else }}{{ .Release.Name }}-credentials{{- end }}
+{{- end }}

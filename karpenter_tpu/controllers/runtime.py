@@ -123,8 +123,13 @@ class _Queue:
 
 
 class ControllerManager:
-    def __init__(self, cluster: ClusterState):
+    def __init__(self, cluster: ClusterState, leader=None):
         self.cluster = cluster
+        # leader gate (core/leaderelection.py): non-leader replicas keep
+        # watching (queues accumulate, caches stay warm) but do not
+        # reconcile — controller-runtime's leader-election semantics.
+        # Queued keys drain on failover; pollers just skip their tick.
+        self.leader = leader if leader is not None else (lambda: True)
         self._watch: List[WatchController] = []
         self._poll: List[PollController] = []
         self._queues: Dict[str, _Queue] = {}
@@ -189,6 +194,11 @@ class ControllerManager:
             key = queue.get()
             if key is None:
                 continue
+            if not self.leader():
+                # keep the key queued for the leader-to-be (small delay
+                # so a follower doesn't spin on one hot key)
+                queue.add(key, after=1.0)
+                continue
             result = self._reconcile_one(ctrl, key)
             if result.requeue_after > 0:
                 queue.add(key, after=result.requeue_after)
@@ -196,6 +206,9 @@ class ControllerManager:
     def _poll_loop(self, poller: PollController) -> None:
         wait = 0.0   # first cycle immediately
         while not self._stop.wait(wait):
+            if not self.leader():
+                wait = min(poller.interval, 1.0)
+                continue
             result = self._run_poller(poller)
             wait = result.requeue_after or poller.interval
 
@@ -231,6 +244,8 @@ class ControllerManager:
         """Reconcile every existing object through every watch controller
         and run every poller once, repeated ``rounds`` times so cascades
         (status -> autoplacement -> ...) settle.  No threads."""
+        if not self.leader():
+            return   # a follower's resync would actuate (GC deletes etc.)
         for _ in range(rounds):
             for ctrl in self._watch:
                 keys: List[str] = []

@@ -61,7 +61,7 @@ def make_solver(options: SolverOptions):
 class Provisioner:
     def __init__(self, cluster: ClusterState, catalog_provider: InstanceTypeProvider,
                  actuator: Actuator, options: Optional[ProvisionerOptions] = None,
-                 factory=None):
+                 factory=None, leader=None):
         self.cluster = cluster
         self.catalog_provider = catalog_provider
         self.actuator = actuator
@@ -70,6 +70,11 @@ class Provisioner:
         self.factory = factory
         self.options = options or ProvisionerOptions()
         self.solver = make_solver(self.options.solver)
+        # actuation gate (core/leaderelection.py): a non-leader replica
+        # keeps its watches and window warm but never solves/creates —
+        # pods stay pending for the leader (ref controller-runtime leases,
+        # controllers.go:37-41)
+        self.leader = leader if leader is not None else (lambda: True)
         self._catalog_cache: Dict[Tuple, CatalogArrays] = {}
         self._lock = threading.Lock()
         self._window: Optional[SolveWindow] = None
@@ -160,6 +165,11 @@ class Provisioner:
     # -- internals ---------------------------------------------------------
 
     def _on_window(self, pods: Sequence[PodSpec]) -> Sequence[object]:
+        if not self.leader():
+            # follower replica: never solve/actuate.  The pods stay
+            # pending and unnominated; the retry ticker re-windows them
+            # after failover, so nothing strands.
+            return [None for _ in pods]
         # The retry feeds can enqueue a pod more than once, and a pod added
         # to the window may have been nominated/bound since: solve only the
         # still-pending unnominated set, deduped by key.

@@ -120,17 +120,31 @@ class Operator:
         self.cloudprovider = CloudProvider(self.cluster, self.actuator,
                                            self.instance_types,
                                            factory=self.factory)
+        # leader election: actuation gate shared by the provisioner and
+        # every controller (ref controller-runtime leases,
+        # controllers.go:37-41); single-replica default = always leader
+        if self.options.leader_election_enabled:
+            from karpenter_tpu.core.leaderelection import LeaderElector
+
+            self.elector = LeaderElector(
+                self.cluster, identity=self.options.leader_identity)
+        else:
+            from karpenter_tpu.core.leaderelection import AlwaysLeader
+
+            self.elector = AlwaysLeader()
         self.provisioner = Provisioner(
             self.cluster, self.instance_types, self.actuator,
             ProvisionerOptions(solver=self.options.solver,
                                window=self.options.window),
-            factory=self.factory)
+            factory=self.factory, leader=self.elector.is_leader)
         self.lb_provider = LoadBalancerProvider(lbs) if lbs is not None else None
 
-        self.manager = ControllerManager(self.cluster)
+        self.manager = ControllerManager(self.cluster,
+                                         leader=self.elector.is_leader)
         for ctrl in self._build_controllers():
             self.manager.register(ctrl)
         self.metrics_server = None
+        self.webhook_server = None
         self._started = False
 
     def _build_controllers(self) -> List:
@@ -184,6 +198,7 @@ class Operator:
         the provisioning window)."""
         if self._started:
             return
+        self.elector.start()
         self.manager.sync(rounds=1)    # restart = resume (SURVEY.md §5.4)
         self.manager.start()
         self.provisioner.start()
@@ -193,6 +208,17 @@ class Operator:
             self.metrics_server = MetricsServer(
                 port=self.options.metrics_port,
                 ready_check=lambda: self._started).start()
+        if self.options.webhook_port and self.webhook_server is None:
+            # dedicated TLS admission listener: the API server refuses
+            # plaintext webhooks, so /validate-nodeclass must be served
+            # with the cert the ValidatingWebhookConfiguration trusts
+            from karpenter_tpu.operator.server import MetricsServer
+
+            self.webhook_server = MetricsServer(
+                port=self.options.webhook_port,
+                ready_check=lambda: self._started,
+                tls_cert=self.options.webhook_tls_cert,
+                tls_key=self.options.webhook_tls_key).start()
         self._started = True
         log.info("operator started",
                  controllers=len(self.manager.controllers()),
@@ -221,5 +247,9 @@ class Operator:
             if self.metrics_server is not None:
                 self.metrics_server.stop()
                 self.metrics_server = None
+            if self.webhook_server is not None:
+                self.webhook_server.stop()
+                self.webhook_server = None
+            self.elector.stop()        # release-on-cancel: hand off now
         self._started = False
         log.info("operator stopped")

@@ -57,6 +57,11 @@ class Options:
     orphan_cleanup_enabled: bool = False   # KARPENTER_ENABLE_ORPHAN_CLEANUP
     spot_discount_percent: int = 60        # spot = % of on-demand (options.go:76)
     metrics_port: int = 0                  # 0 = metrics server disabled
+    webhook_port: int = 0                  # 0 = TLS admission listener off
+    webhook_tls_cert: str = ""             # serving cert path (webhook)
+    webhook_tls_key: str = ""              # serving key path (webhook)
+    leader_election_enabled: bool = False  # lease-based single-active gate
+    leader_identity: str = ""              # defaults to a random identity
 
     # sub-configs
     circuit_breaker: CircuitBreakerConfig = field(
@@ -87,6 +92,12 @@ class Options:
             interruption_enabled=_getb(env, "KARPENTER_ENABLE_INTERRUPTION",
                                        True),
             metrics_port=_geti(env, "KARPENTER_METRICS_PORT", 0),
+            webhook_port=_geti(env, "KARPENTER_WEBHOOK_PORT", 0),
+            webhook_tls_cert=env.get("KARPENTER_WEBHOOK_TLS_CERT", ""),
+            webhook_tls_key=env.get("KARPENTER_WEBHOOK_TLS_KEY", ""),
+            leader_election_enabled=_getb(
+                env, "KARPENTER_LEADER_ELECTION", False),
+            leader_identity=env.get("POD_NAME", ""),
             orphan_cleanup_enabled=_getb(env, "KARPENTER_ENABLE_ORPHAN_CLEANUP",
                                          False),
             spot_discount_percent=_geti(env, "KARPENTER_SPOT_DISCOUNT_PERCENT",
@@ -105,6 +116,13 @@ class Options:
             errs.append("spot_discount_percent must be in [0, 100]")
         if self.solver.backend not in ("greedy", "jax", "remote"):
             errs.append(f"solver backend invalid: {self.solver.backend!r}")
+        if self.webhook_port and not (self.webhook_tls_cert
+                                      and self.webhook_tls_key):
+            # a plaintext admission listener is worse than none: the API
+            # server refuses it and failurePolicy=Fail then rejects every
+            # NodeClass write with no hint at the cause
+            errs.append("webhook_port requires KARPENTER_WEBHOOK_TLS_CERT "
+                        "and KARPENTER_WEBHOOK_TLS_KEY")
         if self.solver.backend == "remote" and not self.solver.address:
             errs.append("solver backend 'remote' requires "
                         "KARPENTER_SOLVER_ADDRESS")

@@ -74,8 +74,17 @@ def _admission_response(body) -> dict:
 
 
 class MetricsServer:
+    """Serves /metrics, /healthz, /readyz, and /validate-nodeclass.
+
+    With ``tls_cert``/``tls_key`` the listener speaks HTTPS — the webhook
+    deployment runs a SECOND instance of this server on the webhook port
+    with the serving certificate the ValidatingWebhookConfiguration's
+    caBundle trusts (ref chart wiring around ibmnodeclass_webhook.go; the
+    API server refuses to call plaintext webhooks)."""
+
     def __init__(self, host: str = "0.0.0.0", port: int = 8080,
-                 ready_check: Optional[Callable[[], bool]] = None):
+                 ready_check: Optional[Callable[[], bool]] = None,
+                 tls_cert: str = "", tls_key: str = ""):
         self._ready = ready_check or (lambda: True)
         outer = self
 
@@ -125,7 +134,32 @@ class MetricsServer:
             def log_message(self, fmt, *args):  # quiet the stdlib logger
                 pass
 
-        self._server = ThreadingHTTPServer((host, port), Handler)
+        self.tls = bool(tls_cert and tls_key)
+        if self.tls:
+            import ssl
+
+            ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+            ctx.load_cert_chain(certfile=tls_cert, keyfile=tls_key)
+
+            class TLSServer(ThreadingHTTPServer):
+                """TLS wrapped PER CONNECTION in the handler thread, with
+                a socket timeout — wrapping the listener would run the
+                handshake inside accept() on the serve_forever thread,
+                letting one stalled client (port scan, plain-HTTP probe)
+                block every subsequent admission call."""
+
+                def finish_request(self, request, client_address):
+                    request.settimeout(10.0)
+                    try:
+                        request = ctx.wrap_socket(request, server_side=True)
+                    except Exception:  # noqa: BLE001 — bad handshake, drop
+                        self.shutdown_request(request)
+                        return
+                    super().finish_request(request, client_address)
+
+            self._server = TLSServer((host, port), Handler)
+        else:
+            self._server = ThreadingHTTPServer((host, port), Handler)
         self.port = self._server.server_address[1]
         self._thread: Optional[threading.Thread] = None
 

@@ -257,6 +257,9 @@ SOLVE_D2H_BYTES = Histogram(
     "karpenter_tpu_solve_d2h_bytes",
     "Device->host result bytes per solve", ("backend",),
     buckets=(1 << 10, 1 << 13, 1 << 16, 1 << 18, 1 << 20, 1 << 22, 1 << 24))
+LEADER = Gauge(
+    "karpenter_tpu_leader",
+    "1 when this replica holds the named leader-election lease", ("lease",))
 
 # Autoplacement families (autoplacement/metrics.go:81).
 AUTOPLACEMENT_SELECTIONS = Counter(

@@ -1,0 +1,176 @@
+"""Helm chart consistency tests (VERDICT round 2 item 7).
+
+No helm binary exists in this environment, so instead of `helm template`
+these tests pin the properties that rot silently: every `.Values.*`
+reference in the templates resolves against values.yaml, every env var
+the chart injects is one the operator actually reads, the packaged CRD
+matches the canonical copy, and the chart metadata parses.
+"""
+
+import os
+import re
+
+import pytest
+import yaml
+
+CHART = os.path.join(os.path.dirname(__file__), "..", "charts", "karpenter-tpu")
+TEMPLATES = os.path.join(CHART, "templates")
+REPO = os.path.join(os.path.dirname(__file__), "..")
+
+
+def _values():
+    with open(os.path.join(CHART, "values.yaml")) as f:
+        return yaml.safe_load(f)
+
+
+def _template_sources():
+    out = {}
+    for name in sorted(os.listdir(TEMPLATES)):
+        with open(os.path.join(TEMPLATES, name)) as f:
+            out[name] = f.read()
+    return out
+
+
+def _lookup(values, dotted):
+    node = values
+    for part in dotted.split("."):
+        if not isinstance(node, dict) or part not in node:
+            return False
+        node = node[part]
+    return True
+
+
+class TestChartStructure:
+    def test_chart_yaml_parses(self):
+        with open(os.path.join(CHART, "Chart.yaml")) as f:
+            chart = yaml.safe_load(f)
+        assert chart["apiVersion"] == "v2"
+        assert chart["name"] == "karpenter-tpu"
+        assert chart["version"]
+
+    def test_values_yaml_parses_with_expected_surface(self):
+        v = _values()
+        for key in ("image", "replicas", "solver", "window", "circuitBreaker",
+                    "credentials", "metrics", "webhook", "serviceMonitor",
+                    "prometheusRule", "podDisruptionBudget", "dashboard"):
+            assert key in v, f"values.yaml missing {key}"
+
+    def test_every_values_reference_resolves(self):
+        """A template referencing a value that values.yaml doesn't define
+        renders as <no value> — the classic silent chart rot."""
+        v = _values()
+        pat = re.compile(r"\.Values\.([A-Za-z0-9_.]+)")
+        missing = []
+        for name, src in _template_sources().items():
+            for ref in pat.findall(src):
+                if not _lookup(v, ref):
+                    missing.append(f"{name}: .Values.{ref}")
+        assert missing == [], missing
+
+    def test_crd_matches_canonical_copy(self):
+        with open(os.path.join(CHART, "crds", "tpunodeclass.yaml")) as f:
+            packaged = f.read()
+        with open(os.path.join(REPO, "deploy", "crds",
+                               "tpunodeclass.yaml")) as f:
+            canonical = f.read()
+        assert packaged == canonical
+
+    def test_dashboard_matches_canonical_copy(self):
+        with open(os.path.join(CHART, "dashboards",
+                               "karpenter-tpu.json")) as f:
+            packaged = f.read()
+        with open(os.path.join(REPO, "deploy", "dashboards",
+                               "karpenter-tpu.json")) as f:
+            canonical = f.read()
+        assert packaged == canonical
+
+    def test_expected_templates_present(self):
+        names = set(_template_sources())
+        for required in ("deployment.yaml", "configmap.yaml",
+                         "configmap-circuitbreaker.yaml", "clusterrole.yaml",
+                         "serviceaccount.yaml", "secret.yaml", "service.yaml",
+                         "servicemonitor.yaml", "poddisruptionbudget.yaml",
+                         "prometheusrule.yaml", "webhook.yaml",
+                         "grafana-dashboard.yaml", "_helpers.tpl"):
+            assert required in names, f"missing template {required}"
+
+
+class TestChartOperatorConsistency:
+    def test_injected_env_vars_are_read_by_the_operator(self):
+        """Every env key the chart's configmaps inject must be consumed by
+        the option/credential layer — otherwise a chart knob is a no-op."""
+        sources = ""
+        for mod in ("operator/options.py", "operator/credentials.py",
+                    "core/circuitbreaker.py"):
+            path = os.path.join(REPO, "karpenter_tpu", mod)
+            if os.path.exists(path):
+                with open(path) as f:
+                    sources += f.read()
+        env_pat = re.compile(
+            r"^\s{2}((?:KARPENTER|CIRCUIT_BREAKER|TPU_CLOUD)[A-Z_]*):",
+            re.MULTILINE)
+        tmpl = _template_sources()
+        injected = set(env_pat.findall(tmpl["configmap.yaml"])) | \
+            set(env_pat.findall(tmpl["configmap-circuitbreaker.yaml"]))
+        assert injected, "no env keys found in chart configmaps"
+        unknown = sorted(k for k in injected if k not in sources)
+        assert unknown == [], f"chart injects env vars nothing reads: {unknown}"
+
+    def test_webhook_points_at_served_path(self):
+        """The registration path must match the handler route."""
+        tmpl = _template_sources()["webhook.yaml"]
+        assert "path: /validate-nodeclass" in tmpl
+        with open(os.path.join(REPO, "karpenter_tpu", "operator",
+                               "server.py")) as f:
+            server = f.read()
+        assert '"/validate-nodeclass"' in server
+
+    def test_webhook_tls_env_matches_options(self):
+        tmpl = _template_sources()["configmap.yaml"]
+        for key in ("KARPENTER_WEBHOOK_PORT", "KARPENTER_WEBHOOK_TLS_CERT",
+                    "KARPENTER_WEBHOOK_TLS_KEY"):
+            assert key in tmpl
+
+
+class TestWebhookTLSServing:
+    def test_tls_listener_serves_admission(self, tmp_path):
+        """The dedicated webhook listener speaks HTTPS with the provided
+        cert and serves the same /validate-nodeclass admission."""
+        import json
+        import ssl
+        import subprocess
+        import urllib.request
+
+        cert = tmp_path / "tls.crt"
+        key = tmp_path / "tls.key"
+        proc = subprocess.run(
+            ["openssl", "req", "-x509", "-newkey", "rsa:2048", "-nodes",
+             "-keyout", str(key), "-out", str(cert), "-days", "1",
+             "-subj", "/CN=localhost"],
+            capture_output=True)
+        if proc.returncode != 0:
+            pytest.skip("openssl unavailable for self-signed cert")
+
+        from karpenter_tpu.operator.server import MetricsServer
+
+        srv = MetricsServer(host="127.0.0.1", port=0,
+                            tls_cert=str(cert), tls_key=str(key)).start()
+        try:
+            assert srv.tls
+            ctx = ssl.create_default_context(cafile=str(cert))
+            ctx.check_hostname = False
+            body = json.dumps({"kind": "AdmissionReview",
+                               "apiVersion": "admission.k8s.io/v1",
+                               "request": {"uid": "u1", "object": {
+                                   "metadata": {"name": "x"},
+                                   "spec": {}}}}).encode()
+            req = urllib.request.Request(
+                f"https://127.0.0.1:{srv.port}/validate-nodeclass",
+                data=body, headers={"Content-Type": "application/json"})
+            with urllib.request.urlopen(req, context=ctx, timeout=5) as resp:
+                out = json.loads(resp.read())
+            assert out["kind"] == "AdmissionReview"
+            assert out["response"]["uid"] == "u1"
+            assert out["response"]["allowed"] is False   # empty spec invalid
+        finally:
+            srv.stop()
