@@ -102,6 +102,7 @@ class HealthCheck:
     interval: int = 5
     timeout: int = 2
     retries: int = 2
+    path: str = ""          # required for http/https (healthcheck.go:161)
 
 
 @dataclass(frozen=True)
@@ -371,7 +372,8 @@ def nodeclass_from_dict(doc: Dict) -> "NodeClass":
                       "healthCheck"), f"loadBalancerIntegration."
                                       f"targetGroups[{i}]")
             _obj(tg.get("healthCheck"),
-                 ("protocol", "port", "interval", "timeout", "retries"),
+                 ("protocol", "port", "path", "interval", "timeout",
+                  "retries"),
                  f"loadBalancerIntegration.targetGroups[{i}].healthCheck")
     bdms = take("blockDeviceMappings") or []
     for i, b in enumerate(bdms):
@@ -442,7 +444,8 @@ def nodeclass_from_dict(doc: Dict) -> "NodeClass":
                         port=int(tg["healthCheck"].get("port", 0)),
                         interval=int(tg["healthCheck"].get("interval", 5)),
                         timeout=int(tg["healthCheck"].get("timeout", 2)),
-                        retries=int(tg["healthCheck"].get("retries", 2)))
+                        retries=int(tg["healthCheck"].get("retries", 2)),
+                        path=tg["healthCheck"].get("path", ""))
                     if tg.get("healthCheck") else None)
                 for tg in (lbi.get("targetGroups") or ())),
             auto_deregister=bool(lbi.get("autoDeregister", True)),

@@ -20,6 +20,7 @@ import time
 from dataclasses import dataclass
 from typing import Callable, Dict, List, Optional, Tuple
 
+from karpenter_tpu.utils import metrics
 from karpenter_tpu.utils.logging import get_logger
 
 log = get_logger("core.circuitbreaker")
@@ -151,6 +152,10 @@ class CircuitBreaker:
                      frm=self.state, to=state)
             self.state = state
             self._last_state_change = now
+            # 0=CLOSED 1=OPEN 2=HALF_OPEN — the PrometheusRule alert
+            # (chart prometheusrule.yaml) fires on >= 1
+            metrics.CB_STATE.labels(self._key[0], self._key[1]).set(
+                {CLOSED: 0.0, OPEN: 1.0, HALF_OPEN: 2.0}[state])
 
     def _reset_minute(self, now: float) -> None:
         if now - self._minute_start >= 60.0:

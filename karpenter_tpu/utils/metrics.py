@@ -260,6 +260,10 @@ SOLVE_D2H_BYTES = Histogram(
 LEADER = Gauge(
     "karpenter_tpu_leader",
     "1 when this replica holds the named leader-election lease", ("lease",))
+CB_STATE = Gauge(
+    "karpenter_tpu_circuit_breaker_state",
+    "Circuit breaker state per (nodeclass, region): 0=closed 1=open "
+    "2=half-open", ("nodeclass", "region"))
 
 # Autoplacement families (autoplacement/metrics.go:81).
 AUTOPLACEMENT_SELECTIONS = Counter(
