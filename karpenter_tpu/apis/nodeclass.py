@@ -373,7 +373,8 @@ def nodeclass_from_dict(doc: Dict) -> "NodeClass":
                                       f"targetGroups[{i}]")
             _obj(tg.get("healthCheck"),
                  ("protocol", "port", "path", "interval", "timeout",
-                  "retries"),
+                  "retries", "intervalSeconds", "timeoutSeconds",
+                  "maxRetries"),
                  f"loadBalancerIntegration.targetGroups[{i}].healthCheck")
     bdms = take("blockDeviceMappings") or []
     for i, b in enumerate(bdms):
@@ -439,12 +440,21 @@ def nodeclass_from_dict(doc: Dict) -> "NodeClass":
                     pool_name=tg.get("poolName", ""),
                     port=int(tg.get("port", 0)),
                     weight=int(tg.get("weight", 50)),
+                    # the CRD names the timings intervalSeconds/
+                    # timeoutSeconds/maxRetries; the short forms are kept
+                    # for programmatic callers
                     health_check=HealthCheck(
                         protocol=tg["healthCheck"].get("protocol", "tcp"),
                         port=int(tg["healthCheck"].get("port", 0)),
-                        interval=int(tg["healthCheck"].get("interval", 5)),
-                        timeout=int(tg["healthCheck"].get("timeout", 2)),
-                        retries=int(tg["healthCheck"].get("retries", 2)),
+                        interval=int(tg["healthCheck"].get(
+                            "intervalSeconds",
+                            tg["healthCheck"].get("interval", 5))),
+                        timeout=int(tg["healthCheck"].get(
+                            "timeoutSeconds",
+                            tg["healthCheck"].get("timeout", 2))),
+                        retries=int(tg["healthCheck"].get(
+                            "maxRetries",
+                            tg["healthCheck"].get("retries", 2))),
                         path=tg["healthCheck"].get("path", ""))
                     if tg.get("healthCheck") else None)
                 for tg in (lbi.get("targetGroups") or ())),

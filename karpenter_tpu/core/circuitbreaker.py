@@ -81,6 +81,9 @@ class CircuitBreaker:
         self._minute_count = 0
         self._minute_start = clock()
         self.last_used = clock()
+        # export the 0=CLOSED baseline immediately: dashboards must be
+        # able to tell "closed" from "no breaker exists"
+        metrics.CB_STATE.labels(key[0], key[1]).set(0.0)
 
     # -- public ------------------------------------------------------------
 
@@ -202,6 +205,9 @@ class CircuitBreakerManager:
                     if now - cb.last_used > self.IDLE_TTL and cb.state == CLOSED]
             for k in dead:
                 del self._breakers[k]
+                # drop the gauge series too — churned nodeclasses must
+                # not accumulate stale label sets forever
+                metrics.CB_STATE.remove(k[0], k[1])
             return len(dead)
 
     def states(self) -> Dict[Tuple[str, str], str]:

@@ -77,6 +77,13 @@ class Provisioner:
         self.leader = leader if leader is not None else (lambda: True)
         self._catalog_cache: Dict[Tuple, CatalogArrays] = {}
         self._lock = threading.Lock()
+        # serializes solve+actuate: the window batcher runs handlers on an
+        # executor POOL, so back-to-back windows can overlap — two
+        # concurrent solves would both see a pod unnominated and
+        # double-provision it (karpenter-core runs one scheduling loop at
+        # a time for the same reason).  The pending-set recheck in
+        # _on_window happens under this lock.
+        self._solve_lock = threading.Lock()
         self._window: Optional[SolveWindow] = None
         self._unsubscribe = None
 
@@ -155,12 +162,16 @@ class Provisioner:
 
     def provision_once(self) -> List[Plan]:
         """Solve + actuate all currently-pending unnominated pods, grouped
-        by NodePool.  Returns the executed plans."""
-        pending = [p for p in self.cluster.pending_pods() if not p.nominated_node]
-        if not pending:
-            return []
-        plans, _ = self._provision([p.spec for p in pending])
-        return plans
+        by NodePool.  Returns the executed plans.  Shares the solve lock
+        with the window path so repair/consolidation loops can't
+        double-provision against an in-flight window."""
+        with self._solve_lock:
+            pending = [p for p in self.cluster.pending_pods()
+                       if not p.nominated_node]
+            if not pending:
+                return []
+            plans, _ = self._provision([p.spec for p in pending])
+            return plans
 
     # -- internals ---------------------------------------------------------
 
@@ -170,24 +181,29 @@ class Provisioner:
             # pending and unnominated; the retry ticker re-windows them
             # after failover, so nothing strands.
             return [None for _ in pods]
-        # The retry feeds can enqueue a pod more than once, and a pod added
-        # to the window may have been nominated/bound since: solve only the
-        # still-pending unnominated set, deduped by key.
-        seen = set()
-        to_solve: List[PodSpec] = []
-        for p in pods:
-            key = pod_key(p)
-            if key in seen:
-                continue
-            seen.add(key)
-            pending = self.cluster.get("pods", key)
-            if pending is None or pending.bound_node or pending.nominated_node:
-                continue
-            to_solve.append(p)
-        # per-pod outcome = the claim the pod was ACTUALLY nominated onto
-        # (pods on failed creates resolve to None and stay pending)
-        _, nominated = self._provision(to_solve)
-        return [nominated.get(pod_key(p)) for p in pods]
+        with self._solve_lock:
+            # The retry feeds can enqueue a pod more than once, and a pod
+            # added to the window may have been nominated/bound since:
+            # solve only the still-pending unnominated set, deduped by
+            # key.  The recheck MUST be inside the solve lock — an
+            # overlapping window's nomination only becomes visible once
+            # its solve completes.
+            seen = set()
+            to_solve: List[PodSpec] = []
+            for p in pods:
+                key = pod_key(p)
+                if key in seen:
+                    continue
+                seen.add(key)
+                pending = self.cluster.get("pods", key)
+                if pending is None or pending.bound_node \
+                        or pending.nominated_node:
+                    continue
+                to_solve.append(p)
+            # per-pod outcome = the claim the pod was ACTUALLY nominated
+            # onto (pods on failed creates resolve to None, stay pending)
+            _, nominated = self._provision(to_solve)
+            return [nominated.get(pod_key(p)) for p in pods]
 
     def _provision(self, pods: List[PodSpec]) -> Tuple[List[Plan], Dict[str, str]]:
         plans: List[Plan] = []

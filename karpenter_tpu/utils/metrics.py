@@ -87,6 +87,12 @@ class _Metric:
         with self._lock:
             return self._values.get(labels, 0.0)
 
+    def remove(self, *label_values: str) -> None:
+        """Drop one label series (gauges tracking per-object state must
+        not leak series after the object is deleted)."""
+        with self._lock:
+            self._values.pop(tuple(str(v) for v in label_values), None)
+
     def samples(self):
         with self._lock:
             return dict(self._values)

@@ -224,3 +224,36 @@ class TestAdmissionEndpoint:
                                            "unknownThing": 1}})
         assert out["allowed"] is False
         assert any("unknown spec fields" in e for e in out["errors"])
+
+
+class TestHealthCheckFieldNames:
+    def test_crd_named_timing_fields_accepted(self):
+        """The CRD names the HC timings intervalSeconds/timeoutSeconds/
+        maxRetries — admission and the parser must accept exactly what the
+        structural schema admits (and the short programmatic forms)."""
+        from karpenter_tpu.apis.nodeclass import nodeclass_from_dict
+        from karpenter_tpu.operator.server import validate_nodeclass_document
+
+        spec = {"region": "us-south", "instanceProfile": "bx2-4x16",
+                "image": "img-1",
+                "loadBalancerIntegration": {
+                    "enabled": True,
+                    "targetGroups": [{"loadBalancerID": "lb-1",
+                                      "poolName": "web", "port": 443,
+                                      "healthCheck": {
+                                          "protocol": "http",
+                                          "path": "/hz",
+                                          "intervalSeconds": 30,
+                                          "timeoutSeconds": 5,
+                                          "maxRetries": 3}}]}}
+        doc = {"metadata": {"name": "hc"}, "spec": spec}
+        assert validate_nodeclass_document(doc) == []
+        hc = nodeclass_from_dict(doc).spec.load_balancer_integration \
+            .target_groups[0].health_check
+        assert (hc.interval, hc.timeout, hc.retries) == (30, 5, 3)
+        # short forms still parse (programmatic callers)
+        spec["loadBalancerIntegration"]["targetGroups"][0]["healthCheck"] = {
+            "protocol": "tcp", "interval": 20, "timeout": 4, "retries": 2}
+        hc = nodeclass_from_dict(doc).spec.load_balancer_integration \
+            .target_groups[0].health_check
+        assert (hc.interval, hc.timeout, hc.retries) == (20, 4, 2)
