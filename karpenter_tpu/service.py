@@ -67,21 +67,52 @@ def _identity(b: bytes) -> bytes:
 # Server
 # ---------------------------------------------------------------------------
 
+class _UploadedCatalog:
+    """Catalog-like shim over uploaded tensors — satisfies JaxSolver's
+    device-catalog cache surface, so the sidecar's catalogs stay
+    DEVICE-resident between solves (previously the server re-transferred
+    host copies into jnp on every Solve)."""
+
+    def __init__(self, cat_id: str, generation: int, off_alloc, off_price,
+                 off_rank):
+        self.uid = cat_id
+        self.generation = generation
+        self.availability_generation = 0
+        self.num_offerings = off_alloc.shape[0]
+        self.off_price = off_price
+        self._alloc = off_alloc
+        self._rank = off_rank
+
+    def offering_alloc(self):
+        return self._alloc
+
+    def offering_rank_price(self):
+        return self._rank
+
+
 class SolverServer:
-    """The TPU-pinned half.  Wraps a JaxSolver kernel path with a
-    catalog-upload cache keyed by (catalog_id, generation)."""
+    """The TPU-pinned half.  Solves run through JaxSolver's packed
+    single-buffer path (pallas with scan fallback, server-side node
+    escalation); catalog tensors go device-resident at upload and stay
+    there between solves, keyed by (catalog_id, generation)."""
 
     def __init__(self, host: str = "127.0.0.1", port: int = 0,
                  options: Optional[SolverOptions] = None):
         import grpc
 
+        from karpenter_tpu.solver.jax_backend import JaxSolver
+
         self.options = options or SolverOptions(backend="jax")
-        self._catalogs: Dict[Tuple[str, int], Dict[str, np.ndarray]] = {}
+        self._jax = JaxSolver(self.options)
+        self._catalogs: Dict[Tuple[str, int], _UploadedCatalog] = {}
         self._lock = threading.Lock()
 
         handler = grpc.method_handlers_generic_handler(_SERVICE, {
             "Solve": grpc.unary_unary_rpc_method_handler(
                 self._solve, request_deserializer=_identity,
+                response_serializer=_identity),
+            "SolveBatch": grpc.unary_unary_rpc_method_handler(
+                self._solve_batch, request_deserializer=_identity,
                 response_serializer=_identity),
             "UploadCatalog": grpc.unary_unary_rpc_method_handler(
                 self._upload, request_deserializer=_identity,
@@ -105,44 +136,97 @@ class SolverServer:
     def _upload(self, request: bytes, context) -> bytes:
         arrays = _unpack(request)
         key = (str(arrays["catalog_id"]), int(arrays["generation"]))
+        cat = _UploadedCatalog(
+            key[0], key[1],
+            arrays["off_alloc"].astype(np.int32),
+            arrays["off_price"].astype(np.float32),
+            arrays["off_rank"].astype(np.float32))
         with self._lock:
             # keep only the latest generation per catalog id
             self._catalogs = {k: v for k, v in self._catalogs.items()
                               if k[0] != key[0]}
-            self._catalogs[key] = {
-                "off_alloc": arrays["off_alloc"].astype(np.int32),
-                "off_price": arrays["off_price"].astype(np.float32),
-                "off_rank": arrays["off_rank"].astype(np.float32),
-            }
+            self._catalogs[key] = cat
+        # warm the device residency immediately, both kernel layouts
+        # (pallas is the default dispatch path on TPU backends)
+        self._jax._device_offerings(cat, cat.num_offerings)
+        try:
+            self._jax._device_offerings_pallas(cat, cat.num_offerings)
+        except Exception:  # noqa: BLE001 — no Mosaic on cpu/gpu backends
+            pass
         return b"ok"
 
-    def _solve(self, request: bytes, context) -> bytes:
-        import jax.numpy as jnp
+    def _catalog_for(self, arrays):
+        key = (str(arrays["catalog_id"]), int(arrays["generation"]))
+        with self._lock:
+            return self._catalogs.get(key)
 
-        from karpenter_tpu.solver.jax_backend import solve_kernel
+    def _solve(self, request: bytes, context) -> bytes:
+        t0 = time.perf_counter()
+        arrays = _unpack(request)
+        cat = self._catalog_for(arrays)
+        if cat is None:
+            return _pack(error=np.array("unknown catalog; re-upload"))
+        N = int(arrays["num_nodes"])
+        prep = self._jax.prepare_arrays(
+            cat, arrays["group_req"], arrays["group_count"],
+            arrays["group_cap"], arrays["compat"],
+            num_nodes=N, n_cap=int(arrays.get("n_cap", N)),
+            right_size=bool(arrays["right_size"]))
+        node_off, assign, unplaced, cost = self._jax._solve_prepared(prep)
+        metrics.SOLVE_DURATION.labels("sidecar").observe(
+            time.perf_counter() - t0)
+        return _pack(node_off=node_off, assign=assign.astype(np.int32),
+                     unplaced=unplaced, cost=np.float32(cost))
+
+    def _solve_batch(self, request: bytes, context) -> bytes:
+        """Zone-candidate batch: C problems sharing req/count/cap and the
+        catalog, differing per-candidate in compat — one device dispatch
+        (solve_packed_batch) for the whole set."""
+        from karpenter_tpu.solver.jax_backend import (
+            clamp_output_opts, needs_node_escalation, pack_input,
+            solve_packed_batch, unpack_result,
+        )
+        from karpenter_tpu.solver.types import NODE_BUCKETS
 
         t0 = time.perf_counter()
         arrays = _unpack(request)
-        key = (str(arrays["catalog_id"]), int(arrays["generation"]))
-        with self._lock:
-            cat = self._catalogs.get(key)
+        cat = self._catalog_for(arrays)
         if cat is None:
             return _pack(error=np.array("unknown catalog; re-upload"))
-
-        group_req = arrays["group_req"]
-        G, O = arrays["compat"].shape
+        compat = arrays["compat"]                      # [C, G, O]
+        C, G, O = compat.shape
+        # pad the batch axis (repeat row 0) so shrinking candidate sets
+        # across refinement rounds reuse one compiled executable
+        C_pad = bucket(C, (2, 4, 8, 16, 32))
+        packed_rows = [pack_input(arrays["group_req"],
+                                  arrays["group_count"],
+                                  arrays["group_cap"], compat[c])
+                       for c in range(C)]
+        rows = np.stack(packed_rows + [packed_rows[0]] * (C_pad - C))
+        off_alloc, off_price, off_rank = self._jax._device_offerings(cat, O)
         N = int(arrays["num_nodes"])
-        out = solve_kernel(
-            jnp.asarray(group_req), jnp.asarray(arrays["group_count"]),
-            jnp.asarray(arrays["group_cap"]), jnp.asarray(arrays["compat"]),
-            jnp.asarray(cat["off_alloc"]), jnp.asarray(cat["off_price"]),
-            jnp.asarray(cat["off_rank"]),
-            num_nodes=N, right_size=bool(arrays["right_size"]))
-        node_off, assign, unplaced, cost = [np.asarray(o) for o in out]
-        metrics.SOLVE_DURATION.labels("sidecar").observe(
+        n_cap = int(arrays.get("n_cap", N))
+        total = int(arrays["group_count"].sum())
+        K0 = self._jax._compact_k(total, G)
+        while True:
+            K, dense16 = clamp_output_opts(K0, False, G, N)
+            out_np = np.asarray(solve_packed_batch(
+                rows, off_alloc, off_price, off_rank, G=G, O=O, N=N,
+                right_size=bool(arrays["right_size"]), compact=K))
+            parsed = [unpack_result(out_np[c], G, N, K) for c in range(C)]
+            if any(needs_node_escalation(no, u, N, n_cap)
+                   for no, _, u, _ in parsed):
+                N = min(n_cap, bucket(N * 4, NODE_BUCKETS))
+                continue
+            break
+        metrics.SOLVE_DURATION.labels("sidecar-batch").observe(
             time.perf_counter() - t0)
-        return _pack(node_off=node_off, assign=assign, unplaced=unplaced,
-                     cost=np.float32(cost))
+        return _pack(
+            node_off=np.stack([p[0] for p in parsed]),
+            assign=np.stack([p[1] for p in parsed]).astype(np.int32),
+            unplaced=np.stack([p[2] for p in parsed]),
+            cost=np.array([p[3] for p in parsed], dtype=np.float32),
+            num_nodes=np.int64(N))
 
 
 # ---------------------------------------------------------------------------
@@ -160,6 +244,9 @@ class RemoteSolver:
         self._channel = grpc.insecure_channel(address)
         self._solve = self._channel.unary_unary(
             f"/{_SERVICE}/Solve", request_serializer=_identity,
+            response_deserializer=_identity)
+        self._solve_batch = self._channel.unary_unary(
+            f"/{_SERVICE}/SolveBatch", request_serializer=_identity,
             response_deserializer=_identity)
         self._upload = self._channel.unary_unary(
             f"/{_SERVICE}/UploadCatalog", request_serializer=_identity,
@@ -202,6 +289,9 @@ class RemoteSolver:
         cat_id, gen = self._catalog_key(catalog)
         reuploaded = False
         while True:
+            # node escalation happens SERVER-side within one RPC (the
+            # sidecar's _solve_prepared climbs to n_cap); this loop exists
+            # only for the restarted-sidecar catalog re-upload
             resp = _unpack(self._solve(_pack(
                 catalog_id=np.array(cat_id), generation=np.int64(gen),
                 group_req=_pad2(problem.group_req, G),
@@ -209,7 +299,8 @@ class RemoteSolver:
                 group_cap=_pad1(problem.group_cap, G),
                 compat=_pad2(problem.compat, G, O),
                 num_nodes=np.int64(N),
-                right_size=np.bool_(self.options.right_size))))
+                right_size=np.bool_(self.options.right_size),
+                n_cap=np.int64(N_cap))))
             if "error" in resp:
                 err = str(resp["error"])
                 # a restarted sidecar loses its catalog cache; our memo
@@ -221,16 +312,72 @@ class RemoteSolver:
                     reuploaded = True
                     continue
                 raise RuntimeError(err)
-            node_off = resp["node_off"]
-            unplaced = resp["unplaced"]
-            if (int(unplaced.sum()) > 0
-                    and int((node_off >= 0).sum()) >= N and N < N_cap):
-                N = min(N_cap, bucket(N * 4, NODE_BUCKETS))
-                continue
             break
-        return decode_plan(problem, node_off,
-                           resp["assign"].astype(np.int32), unplaced,
-                           float(resp["cost"]), "remote")
+        return decode_plan(problem, resp["node_off"],
+                           resp["assign"].astype(np.int32),
+                           resp["unplaced"], float(resp["cost"]), "remote")
+
+    def solve_encoded_batch(self, problems) -> "list[Plan]":
+        """Zone-candidate batch over ONE sidecar round trip (zonesplit
+        discovers this via getattr — without it each candidate would be
+        its own RPC).  Problems must share the catalog and group arrays,
+        differing only in compat (what _with_zone produces)."""
+        from karpenter_tpu.solver.encode import estimate_nodes
+        from karpenter_tpu.solver.jax_backend import _pad1, _pad2
+
+        if not problems:
+            return []
+        base = problems[0]
+        catalog = base.catalog
+        if any(p.catalog is not catalog
+               or p.num_groups != base.num_groups for p in problems[1:]):
+            return [self.solve_encoded(p) for p in problems]
+        G = bucket(base.num_groups, GROUP_BUCKETS)
+        O = bucket(catalog.num_offerings, OFFERING_BUCKETS)
+        self._ensure_catalog(catalog, O)
+        total = int(base.group_count.sum())
+        N_cap = min(self.options.max_nodes, bucket(max(total, 1),
+                                                   NODE_BUCKETS))
+        N = estimate_nodes(base, N_cap, NODE_BUCKETS) \
+            if self.options.adaptive_nodes else N_cap
+        cat_id, gen = self._catalog_key(catalog)
+        compat = np.stack([_pad2(p.compat, G, O) for p in problems])
+        reuploaded = False
+        while True:
+            import grpc
+
+            try:
+                raw = self._solve_batch(_pack(
+                catalog_id=np.array(cat_id), generation=np.int64(gen),
+                group_req=_pad2(base.group_req, G),
+                group_count=_pad1(base.group_count, G),
+                group_cap=_pad1(base.group_cap, G),
+                    compat=compat,
+                    num_nodes=np.int64(N), n_cap=np.int64(N_cap),
+                    right_size=np.bool_(self.options.right_size)))
+            except grpc.RpcError as e:
+                if e.code() == grpc.StatusCode.UNIMPLEMENTED:
+                    # rolling upgrade: the sidecar predates SolveBatch —
+                    # degrade to per-candidate Solve RPCs
+                    log.warning("sidecar lacks SolveBatch; sequential "
+                                "candidate solves engaged")
+                    return [self.solve_encoded(p) for p in problems]
+                raise
+            resp = _unpack(raw)
+            if "error" in resp:
+                err = str(resp["error"])
+                if "unknown catalog" in err and not reuploaded:
+                    self._uploaded.pop(cat_id, None)
+                    self._ensure_catalog(catalog, O)
+                    reuploaded = True
+                    continue
+                raise RuntimeError(err)
+            break
+        return [decode_plan(p, resp["node_off"][c],
+                            resp["assign"][c].astype(np.int32),
+                            resp["unplaced"][c], float(resp["cost"][c]),
+                            "remote")
+                for c, p in enumerate(problems)]
 
     # -- internals ---------------------------------------------------------
 

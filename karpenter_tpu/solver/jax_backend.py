@@ -465,10 +465,10 @@ class _Prepared:
     ``unpack_result`` always parses the buffer the kernel produced."""
 
     __slots__ = ("catalog", "G_pad", "O_pad", "N", "N_cap", "K0", "K",
-                 "dense16_ok", "dense16", "packed")
+                 "dense16_ok", "dense16", "packed", "right_size")
 
     def __init__(self, *, catalog, G_pad, O_pad, N, N_cap, K0, packed,
-                 dense16_ok=False):
+                 dense16_ok=False, right_size=None):
         self.catalog = catalog
         self.G_pad = G_pad
         self.O_pad = O_pad
@@ -478,6 +478,9 @@ class _Prepared:
         self.dense16_ok = dense16_ok
         self.K, self.dense16 = clamp_output_opts(K0, dense16_ok, G_pad, N)
         self.packed = packed
+        # None = use the solver's SolverOptions; the sidecar overrides
+        # per request (the wire flag must win over the server's defaults)
+        self.right_size = right_size
 
 
 class JaxSolver:
@@ -517,7 +520,15 @@ class JaxSolver:
             return Plan(nodes=[], unplaced_pods=list(problem.rejected),
                         backend="jax")
         prep = self._prepare(problem)
+        node_off, assign, unplaced, cost = self._solve_prepared(prep)
+        return self._decode(problem, node_off, assign.astype(np.int32),
+                            unplaced, cost)
 
+    def _solve_prepared(self, prep: "_Prepared"):
+        """Dispatch/fetch/escalate loop on an already-packed problem —
+        shared by solve_encoded and the gRPC sidecar (service.py), which
+        receives pre-padded arrays over the wire and has no
+        EncodedProblem to decode against."""
         while True:
             t_disp = time.perf_counter()
             out_dev, path = self._dispatch(prep, prep.packed)
@@ -563,9 +574,26 @@ class JaxSolver:
             if needs_node_escalation(node_off, unplaced, prep.N, prep.N_cap):
                 prep.N = min(prep.N_cap, bucket(prep.N * 4, NODE_BUCKETS))
                 continue
-            break
-        return self._decode(problem, node_off, assign.astype(np.int32),
-                            unplaced, cost)
+            return node_off, assign, unplaced, cost
+
+    def prepare_arrays(self, catalog, group_req, group_count, group_cap,
+                       compat, num_nodes: int, n_cap: int,
+                       right_size=None) -> "_Prepared":
+        """Build a _Prepared from ALREADY-PADDED arrays (the sidecar's
+        wire format) against any catalog-like object exposing
+        uid/generation/availability_generation/num_offerings/
+        offering_alloc()/off_price/offering_rank_price()."""
+        G_pad, O_pad = compat.shape
+        total_pods = int(group_count.sum())
+        packed = pack_input(group_req, group_count, group_cap, compat)
+        max_slots = int(catalog.offering_alloc()[:, 3].max()) \
+            if catalog.num_offerings else 1
+        return _Prepared(catalog=catalog, G_pad=G_pad, O_pad=O_pad,
+                         N=num_nodes, N_cap=n_cap,
+                         K0=self._compact_k(total_pods, G_pad),
+                         packed=packed,
+                         dense16_ok=max_slots < (1 << 15),
+                         right_size=right_size)
 
     def solve_encoded_batch(self, problems: List[EncodedProblem]
                             ) -> List[Plan]:
@@ -705,10 +733,12 @@ class JaxSolver:
                 # shapes the _prepare-time values don't hold for
                 prep.K, prep.dense16 = clamp_output_opts(
                     prep.K0, prep.dense16_ok, G_pad, Np)
+                rs = self.options.right_size if prep.right_size is None \
+                    else prep.right_size
                 out = solve_packed_pallas(
                     arr, alloc8, rank_row, price_dev,
                     G=G_pad, O=O_pad, N=Np,
-                    right_size=self.options.right_size,
+                    right_size=rs,
                     compact=prep.K, dense16=prep.dense16)
                 prep.N = Np
                 return out, "pallas"
@@ -721,10 +751,12 @@ class JaxSolver:
             catalog, O_pad)
         prep.K, prep.dense16 = clamp_output_opts(
             prep.K0, prep.dense16_ok, G_pad, N)
+        rs = self.options.right_size if prep.right_size is None \
+            else prep.right_size
         out = solve_packed(
             arr, off_alloc, off_price, off_rank,
             G=G_pad, O=O_pad, N=N,
-            right_size=self.options.right_size,
+            right_size=rs,
             compact=prep.K, dense16=prep.dense16)
         return out, "scan"
 
@@ -763,14 +795,25 @@ class JaxSolver:
             return True
         return jax.default_backend() not in ("cpu", "gpu")
 
+    MAX_DEVICE_CATALOGS = 16   # entries (uid x layout x O_pad), LRU-ish
+
     def _prune_device_catalog(self, catalog) -> None:
-        """Drop device tensors of stale catalog generations; both layouts
-        of the current generation stay resident."""
+        """Drop device tensors of STALE GENERATIONS of this catalog uid;
+        other uids stay resident (multiple NodeClasses / sidecar tenants
+        alternate solves — evicting them per miss would re-transfer
+        catalog tensors on essentially every solve).  Total residency is
+        bounded by evicting oldest-inserted entries past the cap."""
         gen = (catalog.uid, catalog.generation,
                catalog.availability_generation)
+
+        def live(k):
+            head = k[1:4] if k[0] == "pallas" else k[:3]
+            return head[0] != gen[0] or head == gen
+
         self._device_catalog = {
-            k: v for k, v in self._device_catalog.items()
-            if (k[1:4] if k[0] == "pallas" else k[:3]) == gen}
+            k: v for k, v in self._device_catalog.items() if live(k)}
+        while len(self._device_catalog) >= self.MAX_DEVICE_CATALOGS:
+            self._device_catalog.pop(next(iter(self._device_catalog)))
 
     def _device_offerings_pallas(self, catalog, O_pad: int):
         from karpenter_tpu.solver.pallas_kernel import pack_catalog

@@ -108,3 +108,59 @@ def test_options_validate_remote_address():
                for e in Options.from_env(env).validate())
     env["KARPENTER_SOLVER_ADDRESS"] = "10.0.0.9:50051"
     assert Options.from_env(env).validate() == []
+
+
+def test_remote_batch_matches_sequential(server):
+    """SolveBatch: zone candidates share one RPC and one device dispatch;
+    plans must equal per-candidate Solve calls."""
+    from karpenter_tpu.solver.encode import encode
+    from karpenter_tpu.solver.zonesplit import _with_zone, affinity_candidates
+
+    from tests.test_zonesplit import _affinity_pods, _skewed_catalog
+
+    cat = _skewed_catalog()
+    prob = encode(_affinity_pods(), cat)
+    cands = affinity_candidates(prob)
+    gi, _, zones = cands[0]
+    probs = [_with_zone(prob, gi, z) for z in zones]
+    remote = RemoteSolver(f"127.0.0.1:{server.port}")
+    try:
+        batched = remote.solve_encoded_batch(probs)
+        singles = [remote.solve_encoded(p) for p in probs]
+        for b, s in zip(batched, singles):
+            assert b.total_cost_per_hour == pytest.approx(
+                s.total_cost_per_hour, rel=1e-6)
+            assert sorted(b.unplaced_pods) == sorted(s.unplaced_pods)
+    finally:
+        remote.close()
+
+
+def test_remote_zone_candidates_use_one_batch_rpc(server):
+    """The refinement through the remote backend must ride SolveBatch
+    (one RPC per round), not Z sequential Solve RPCs."""
+    from karpenter_tpu.solver import SolveRequest as SR
+
+    from tests.test_zonesplit import _affinity_pods, _skewed_catalog
+
+    cat = _skewed_catalog()
+    remote = RemoteSolver(f"127.0.0.1:{server.port}")
+    calls = {"batch": 0, "single": 0}
+    orig_batch, orig_single = remote.solve_encoded_batch, remote.solve_encoded
+
+    def count_batch(probs):
+        calls["batch"] += 1
+        return orig_batch(probs)
+
+    def count_single(prob):
+        calls["single"] += 1
+        return orig_single(prob)
+
+    remote.solve_encoded_batch = count_batch
+    remote.solve_encoded = count_single
+    try:
+        plan = remote.solve(SR(_affinity_pods(), cat))
+        assert {n.zone for n in plan.nodes} == {"us-south-2"}
+        assert calls["single"] == 1      # the base solve
+        assert calls["batch"] == 1       # all candidates in one RPC
+    finally:
+        remote.close()
