@@ -442,6 +442,17 @@ class FakeCloud:
             inst.status = "stopped"
             inst.status_reason = reason
 
+    def degrade_instance(self, instance_id: str,
+                         state: str = "degraded") -> None:
+        """Test hook: the metadata-service health signal (ref
+        interruption/controller.go:304-325) — instance still runs but its
+        health_state reads degraded/faulted."""
+        with self._lock:
+            inst = self.instances.get(instance_id)
+            if inst is None:
+                raise not_found("instance", instance_id)
+            inst.health_state = state
+
     # -- introspection -----------------------------------------------------
 
     def quota_status(self) -> Tuple[int, int]:

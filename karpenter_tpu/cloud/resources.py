@@ -26,6 +26,7 @@ class Instance:
     capacity_type: str = "on-demand"   # availability policy analogue
     status: str = "running"            # pending|running|stopped|deleting
     status_reason: str = ""
+    health_state: str = "ok"           # ok|degraded|faulted (metadata svc)
     tags: Dict[str, str] = field(default_factory=dict)
     security_group_ids: Tuple[str, ...] = ()
     vni_id: str = ""

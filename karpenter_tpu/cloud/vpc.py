@@ -49,7 +49,8 @@ def instance_to_json(i: Instance) -> Dict:
         "id": i.id, "name": i.name, "profile": i.profile, "zone": i.zone,
         "subnet_id": i.subnet_id, "image_id": i.image_id,
         "capacity_type": i.capacity_type, "status": i.status,
-        "status_reason": i.status_reason, "tags": dict(i.tags),
+        "status_reason": i.status_reason,
+        "health_state": i.health_state, "tags": dict(i.tags),
         "security_group_ids": list(i.security_group_ids),
         "vni_id": i.vni_id, "volume_ids": list(i.volume_ids),
         "user_data": i.user_data, "created_at": i.created_at,
@@ -65,6 +66,7 @@ def instance_from_json(d: Dict) -> Instance:
         capacity_type=d.get("capacity_type", "on-demand"),
         status=d.get("status", "running"),
         status_reason=d.get("status_reason", ""),
+        health_state=d.get("health_state", "ok"),
         tags=dict(d.get("tags") or {}),
         security_group_ids=tuple(d.get("security_group_ids") or ()),
         vni_id=d.get("vni_id", ""),

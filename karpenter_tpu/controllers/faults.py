@@ -38,20 +38,28 @@ _NETWORK_CONDITIONS = ("NetworkUnavailable",)
 class InterruptionController(PollController):
     """1-min scan of nodes for interruption signals (ref interruption/
     controller.go:151): condition heuristics with never-ready suppression
-    (:259), then annotate + event + delete the claim so the replacement
-    cycle runs; capacity reasons black out the offering."""
+    (:259) PLUS the instance metadata-health signal (:304-325 — the
+    reference queries the metadata service for health_state
+    degraded/faulted; here the cloud API exposes the same field), then
+    annotate + event + delete the claim so the replacement cycle runs;
+    capacity reasons black out the offering."""
 
     name = "interruption"
     interval = 60.0
     never_ready_grace = 600.0   # suppress signals on nodes still booting
 
     def __init__(self, cluster: ClusterState,
-                 unavailable: UnavailableOfferings):
+                 unavailable: UnavailableOfferings, cloud=None):
         self.cluster = cluster
         self.unavailable = unavailable
+        # optional: enables the metadata-health probe (condition
+        # heuristics alone otherwise, as when metadata is unreachable —
+        # the reference treats that as expected, controller.go:310)
+        self.cloud = cloud
 
     def reconcile(self) -> Result:
         now = time.time()
+        health = self._instance_health()
         for node in self.cluster.nodes():
             if node.deleted or ANNOTATION_INTERRUPTED in node.annotations:
                 continue
@@ -62,11 +70,26 @@ class InterruptionController(PollController):
             # is booting, not interrupted (interruption/controller.go:259)
             if not claim.initialized and now - node.created_at < self.never_ready_grace:
                 continue
-            reason = self._interruption_reason(node)
+            reason = self._interruption_reason(node, health)
             if not reason:
                 continue
             self._handle(node, claim, reason)
         return Result()
+
+    def _instance_health(self) -> dict:
+        """instance id -> degraded|faulted, from one list call per sweep
+        (the per-node metadata probe of the reference, lifted to the
+        API so the control plane can see it).  Unreachable cloud ->
+        heuristics only, never a failed sweep."""
+        if self.cloud is None:
+            return {}
+        try:
+            return {i.id: i.health_state for i in self.cloud.list_instances()
+                    if getattr(i, "health_state", "ok")
+                    in ("degraded", "faulted")}
+        except CloudError as e:
+            log.warning("metadata health probe failed", error=str(e))
+            return {}
 
     def _claim_for(self, node):
         for claim in self.cluster.nodeclaims():
@@ -74,7 +97,7 @@ class InterruptionController(PollController):
                 return claim
         return None
 
-    def _interruption_reason(self, node) -> str:
+    def _interruption_reason(self, node, health: dict) -> str:
         for cond in _CAPACITY_CONDITIONS:
             if node.conditions.get(cond) == "True":
                 return f"capacity:{cond}"
@@ -84,6 +107,10 @@ class InterruptionController(PollController):
         for cond in _HEALTH_CONDITIONS:
             if node.conditions.get(cond) == "True":
                 return f"health:{cond}"
+        parsed = parse_provider_id(node.provider_id)
+        if parsed and parsed[1] in health:
+            # metadata-service health signal (controller.go:316-322)
+            return f"health:metadata:{health[parsed[1]]}"
         return ""
 
     def _handle(self, node, claim, reason: str) -> None:

@@ -171,7 +171,9 @@ class Operator:
             PricingRefreshController(self.pricing),
         ]
         if self.options.interruption_enabled:
-            ctrls.append(InterruptionController(self.cluster, self.unavailable))
+            ctrls.append(InterruptionController(self.cluster,
+                                                self.unavailable,
+                                                cloud=self.cloud))
         # bootstrap-token lifecycle (ref RegisterBootstrapController,
         # controllers.go:267 + bootstrap/token_controller.go)
         ctrls.append(BootstrapTokenController(

@@ -106,6 +106,10 @@ class SolverServer:
         self._jax = JaxSolver(self.options)
         self._catalogs: Dict[Tuple[str, int], _UploadedCatalog] = {}
         self._lock = threading.Lock()
+        # JaxSolver's device-catalog dict / failed-shape set / last_stats
+        # are not thread-safe, and the device serializes solves anyway —
+        # all _jax use from the 4 gRPC worker threads goes through this
+        self._solver_lock = threading.Lock()
 
         handler = grpc.method_handlers_generic_handler(_SERVICE, {
             "Solve": grpc.unary_unary_rpc_method_handler(
@@ -148,11 +152,12 @@ class SolverServer:
             self._catalogs[key] = cat
         # warm the device residency immediately, both kernel layouts
         # (pallas is the default dispatch path on TPU backends)
-        self._jax._device_offerings(cat, cat.num_offerings)
-        try:
-            self._jax._device_offerings_pallas(cat, cat.num_offerings)
-        except Exception:  # noqa: BLE001 — no Mosaic on cpu/gpu backends
-            pass
+        with self._solver_lock:
+            self._jax._device_offerings(cat, cat.num_offerings)
+            try:
+                self._jax._device_offerings_pallas(cat, cat.num_offerings)
+            except Exception:  # noqa: BLE001 — no Mosaic on cpu/gpu
+                pass
         return b"ok"
 
     def _catalog_for(self, arrays):
@@ -167,12 +172,14 @@ class SolverServer:
         if cat is None:
             return _pack(error=np.array("unknown catalog; re-upload"))
         N = int(arrays["num_nodes"])
-        prep = self._jax.prepare_arrays(
-            cat, arrays["group_req"], arrays["group_count"],
-            arrays["group_cap"], arrays["compat"],
-            num_nodes=N, n_cap=int(arrays.get("n_cap", N)),
-            right_size=bool(arrays["right_size"]))
-        node_off, assign, unplaced, cost = self._jax._solve_prepared(prep)
+        with self._solver_lock:
+            prep = self._jax.prepare_arrays(
+                cat, arrays["group_req"], arrays["group_count"],
+                arrays["group_cap"], arrays["compat"],
+                num_nodes=N, n_cap=int(arrays.get("n_cap", N)),
+                right_size=bool(arrays["right_size"]))
+            node_off, assign, unplaced, cost = \
+                self._jax._solve_prepared(prep)
         metrics.SOLVE_DURATION.labels("sidecar").observe(
             time.perf_counter() - t0)
         return _pack(node_off=node_off, assign=assign.astype(np.int32),
@@ -203,22 +210,25 @@ class SolverServer:
                                   arrays["group_cap"], compat[c])
                        for c in range(C)]
         rows = np.stack(packed_rows + [packed_rows[0]] * (C_pad - C))
-        off_alloc, off_price, off_rank = self._jax._device_offerings(cat, O)
         N = int(arrays["num_nodes"])
         n_cap = int(arrays.get("n_cap", N))
         total = int(arrays["group_count"].sum())
-        K0 = self._jax._compact_k(total, G)
-        while True:
-            K, dense16 = clamp_output_opts(K0, False, G, N)
-            out_np = np.asarray(solve_packed_batch(
-                rows, off_alloc, off_price, off_rank, G=G, O=O, N=N,
-                right_size=bool(arrays["right_size"]), compact=K))
-            parsed = [unpack_result(out_np[c], G, N, K) for c in range(C)]
-            if any(needs_node_escalation(no, u, N, n_cap)
-                   for no, _, u, _ in parsed):
-                N = min(n_cap, bucket(N * 4, NODE_BUCKETS))
-                continue
-            break
+        with self._solver_lock:
+            off_alloc, off_price, off_rank = \
+                self._jax._device_offerings(cat, O)
+            K0 = self._jax._compact_k(total, G)
+            while True:
+                K, dense16 = clamp_output_opts(K0, False, G, N)
+                out_np = np.asarray(solve_packed_batch(
+                    rows, off_alloc, off_price, off_rank, G=G, O=O, N=N,
+                    right_size=bool(arrays["right_size"]), compact=K))
+                parsed = [unpack_result(out_np[c], G, N, K)
+                          for c in range(C)]
+                if any(needs_node_escalation(no, u, N, n_cap)
+                       for no, _, u, _ in parsed):
+                    N = min(n_cap, bucket(N * 4, NODE_BUCKETS))
+                    continue
+                break
         metrics.SOLVE_DURATION.labels("sidecar-batch").observe(
             time.perf_counter() - t0)
         return _pack(
@@ -312,6 +322,17 @@ class RemoteSolver:
                     reuploaded = True
                     continue
                 raise RuntimeError(err)
+            # version skew: an OLD sidecar ignores n_cap and returns at
+            # the requested N without escalating — detect (node budget
+            # binding at the server's actual N) and climb client-side;
+            # a new sidecar already escalated to n_cap, so this no-ops
+            node_off = resp["node_off"]
+            server_n = int(node_off.shape[0])
+            if (int(resp["unplaced"].sum()) > 0
+                    and int((node_off >= 0).sum()) >= server_n
+                    and server_n < N_cap and N < N_cap):
+                N = min(N_cap, bucket(max(N, server_n) * 4, NODE_BUCKETS))
+                continue
             break
         return decode_plan(problem, resp["node_off"],
                            resp["assign"].astype(np.int32),
@@ -330,7 +351,14 @@ class RemoteSolver:
         base = problems[0]
         catalog = base.catalog
         if any(p.catalog is not catalog
-               or p.num_groups != base.num_groups for p in problems[1:]):
+               or p.num_groups != base.num_groups
+               or not (np.array_equal(p.group_req, base.group_req)
+                       and np.array_equal(p.group_count, base.group_count)
+                       and np.array_equal(p.group_cap, base.group_cap))
+               for p in problems[1:]):
+            # the wire format sends ONE copy of req/count/cap for every
+            # candidate — problems differing beyond compat must take the
+            # per-problem path or base's arrays would silently apply
             return [self.solve_encoded(p) for p in problems]
         G = bucket(base.num_groups, GROUP_BUCKETS)
         O = bucket(catalog.num_offerings, OFFERING_BUCKETS)

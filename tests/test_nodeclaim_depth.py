@@ -580,3 +580,71 @@ class TestWindowRetryRaces:
             assert prov.requeue_pending() == 0      # enqueued_at bumped
         finally:
             prov._window.close()
+
+
+class TestInterruptionMetadataHealth:
+    """The metadata-service health signal (ref interruption/
+    controller.go:304-325): a degraded/faulted instance interrupts its
+    node even with clean node conditions."""
+
+    def _healthy_node(self, rig, cloud_for_ctrl):
+        cloud, cluster, actuator, itp, unavail = rig
+        claim = launch_claim(cloud, cluster, actuator, itp)
+        FakeKubelet(cluster).join(claim, ready=True)
+        RegistrationController(cluster).reconcile(claim.name)
+        ctrl = InterruptionController(cluster, unavail,
+                                      cloud=cloud_for_ctrl)
+        return cloud, cluster, cluster.get_nodeclaim(claim.name), ctrl
+
+    def test_degraded_instance_interrupts_clean_node(self, rig):
+        cloud, cluster, claim, ctrl = self._healthy_node(rig, rig[0])
+        inst_id = claim.provider_id.rsplit("/", 1)[1]
+        ctrl.reconcile()
+        assert not cluster.get_nodeclaim(claim.name).deleted   # healthy
+        cloud.degrade_instance(inst_id, "degraded")
+        ctrl.reconcile()
+        claim = cluster.get_nodeclaim(claim.name)
+        assert claim.deleted
+        node = cluster.get_node(claim.node_name)
+        assert node.annotations["karpenter-tpu.sh/interrupted"] == \
+            "health:metadata:degraded"
+
+    def test_health_probe_disabled_without_cloud(self, rig):
+        cloud, cluster, claim, ctrl = self._healthy_node(rig, None)
+        cloud.degrade_instance(claim.provider_id.rsplit("/", 1)[1],
+                               "faulted")
+        ctrl.reconcile()
+        assert not cluster.get_nodeclaim(claim.name).deleted
+
+    def test_probe_failure_degrades_to_heuristics(self, rig):
+        from karpenter_tpu.cloud.errors import CloudError
+
+        cloud, cluster, claim, ctrl = self._healthy_node(rig, rig[0])
+        cloud.recorder.inject_error(
+            "list_instances", CloudError("api down", 503))
+        try:
+            ctrl.reconcile()        # no crash; heuristics-only sweep
+        finally:
+            cloud.recorder.reset()
+        assert not cluster.get_nodeclaim(claim.name).deleted
+
+    def test_health_state_round_trips_the_wire(self):
+        """The HTTP client must surface health_state so a remote control
+        plane sees what the fake exposes."""
+        from karpenter_tpu.cloud.fake import FakeCloud, generate_profiles
+        from karpenter_tpu.cloud.stub import StubCloudServer
+        from karpenter_tpu.cloud.vpc import VPCCloudClient
+
+        fake = FakeCloud(profiles=generate_profiles(4))
+        server = StubCloudServer(cloud=fake, api_key="k").start()
+        try:
+            client = VPCCloudClient(server.endpoint, "k",
+                                    sleep=lambda s: None)
+            inst = fake.create_instance(
+                name="hs", profile="bx2-2x8", zone="us-south-1",
+                subnet_id="subnet-11", image_id="img-1")
+            fake.degrade_instance(inst.id, "faulted")
+            got = client.get_instance(inst.id)
+            assert got.health_state == "faulted"
+        finally:
+            server.stop()
