@@ -87,7 +87,9 @@ class InterruptionController(PollController):
             return {i.id: i.health_state for i in self.cloud.list_instances()
                     if getattr(i, "health_state", "ok")
                     in ("degraded", "faulted")}
-        except CloudError as e:
+        except Exception as e:  # noqa: BLE001 — e.g. a raw socket timeout
+            # from the HTTP client; the condition heuristics need no
+            # cloud access and must still run this sweep
             log.warning("metadata health probe failed", error=str(e))
             return {}
 

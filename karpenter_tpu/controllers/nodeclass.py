@@ -189,10 +189,17 @@ class AutoplacementController(WatchController):
         self.cluster = cluster
         self.instance_types = instance_types
         self.subnets = subnet_provider
+        # names already warned about an empty selection — a fresh
+        # NodeClass starts with selected==[] so the change-check alone
+        # would never emit the first warning, and without the memo every
+        # revalidation would re-emit it
+        self._warned_empty: set = set()
 
     def reconcile(self, key: str) -> Result:
         nc = self.cluster.get_nodeclass(key)
         if nc is None or nc.deleted:
+            # a recreated NodeClass with the same name must warn afresh
+            self._warned_empty.discard(key)
             return Result()
         rv = nc.resource_version
         changed = False
@@ -216,13 +223,16 @@ class AutoplacementController(WatchController):
             time.perf_counter() - t0)
         metrics.AUTOPLACEMENT_SELECTIONS.labels(
             "instance_types", "ok" if selected else "empty").inc()
-        if selected == nc.status.selected_instance_types:
-            return False
-        nc.status.selected_instance_types = selected
-        if not selected:
+        if not selected and nc.name not in self._warned_empty:
+            self._warned_empty.add(nc.name)
             self.cluster.record_event(
                 "NodeClass", nc.name, "Warning", "NoMatchingInstanceTypes",
                 "instanceRequirements matched no instance types")
+        elif selected:
+            self._warned_empty.discard(nc.name)
+        if selected == nc.status.selected_instance_types:
+            return False
+        nc.status.selected_instance_types = selected
         return True
 
     def _select_subnets(self, nc: NodeClass) -> bool:

@@ -1,0 +1,247 @@
+"""NodeClass controller behavioral depth.
+
+The reference's status-controller suite alone is 2.7k lines
+(status/controller_test.go); this module covers the edge cases beyond
+the happy-path validation test: per-check failure modes, transient-error
+tolerance, the self-feeding-watch guard, recovery transitions,
+autoplacement conflicts, and hash/termination lifecycles.
+"""
+
+import pytest
+
+from karpenter_tpu.apis.nodeclass import (
+    ANNOTATION_NODECLASS_HASH, ANNOTATION_NODECLASS_HASH_VERSION,
+    ImageSelector, InstanceRequirements, NodeClass, NodeClassSpec,
+    PlacementStrategy,
+)
+from karpenter_tpu.catalog import (
+    CatalogArrays, InstanceTypeProvider, PricingProvider, UnavailableOfferings,
+)
+from karpenter_tpu.cloud.errors import CloudError
+from karpenter_tpu.cloud.fake import FakeCloud
+from karpenter_tpu.cloud.subnet import SubnetProvider
+from karpenter_tpu.controllers.nodeclass import (
+    AutoplacementController, NodeClassHashController, NodeClassStatusController,
+    NodeClassTerminationController, TERMINATION_FINALIZER,
+)
+from karpenter_tpu.core import ClusterState
+
+
+@pytest.fixture
+def rig():
+    cloud = FakeCloud()
+    pricing = PricingProvider(cloud)
+    itp = InstanceTypeProvider(cloud, pricing)
+    cluster = ClusterState()
+    status = NodeClassStatusController(cluster, cloud)
+    yield cloud, cluster, itp, status
+    pricing.close()
+
+
+def spec(**kw) -> NodeClassSpec:
+    base = dict(region="us-south", instance_profile="bx2-4x16", image="img-1")
+    base.update(kw)
+    return NodeClassSpec(**base)
+
+
+class TestStatusValidationDepth:
+    def test_each_cloud_check_produces_its_error(self, rig):
+        cloud, cluster, itp, status = rig
+        cases = [
+            (spec(zone="us-south-9"), "zone us-south-9 not found"),
+            (spec(subnet="subnet-404"), "subnet subnet-404 not found"),
+            (spec(zone="us-south-1", subnet="subnet-21"),
+             "is in zone us-south-2, not us-south-1"),
+            (spec(instance_profile="mx99-giant"),
+             "instance profile mx99-giant not found"),
+            (spec(vpc="vpc-ghost"), "VPC vpc-ghost not found"),
+            (spec(security_groups=("sg-ghost",)),
+             "security group sg-ghost not found"),
+            (spec(ssh_keys=("key-ghost",)), "SSH key key-ghost not found"),
+            (spec(image="img-ghost"), "image resolution failed"),
+        ]
+        for i, (sp, want) in enumerate(cases):
+            nc = cluster.add_nodeclass(NodeClass(name=f"bad{i}", spec=sp))
+            status.reconcile(nc.name)
+            nc = cluster.get_nodeclass(nc.name)
+            assert not nc.status.is_ready(), f"case {i} should fail"
+            assert want in nc.status.validation_error, \
+                f"case {i}: {nc.status.validation_error!r}"
+
+    def test_transient_listing_error_does_not_flip_ready(self, rig):
+        """A cloud hiccup during SG/VPC/key listing must not mark a Ready
+        NodeClass NotReady (status/controller.go behavior: transient
+        lookups are skipped, not failed)."""
+        cloud, cluster, itp, status = rig
+        nc = cluster.add_nodeclass(NodeClass(
+            name="flaky", spec=spec(security_groups=("sg-default",),
+                        vpc="vpc-1")))
+        status.reconcile(nc.name)
+        assert cluster.get_nodeclass("flaky").status.is_ready()
+        cloud.recorder.inject_error(
+            "list_security_groups", CloudError("api down", 503))
+        try:
+            status.reconcile(nc.name)
+        finally:
+            cloud.recorder.reset()
+        assert cluster.get_nodeclass("flaky").status.is_ready()
+
+    def test_noop_reconcile_does_not_republish(self, rig):
+        """Publishing an unchanged status would re-trigger the watch —
+        a self-feeding hot loop.  Repeated reconciles must leave the
+        resourceVersion alone."""
+        cloud, cluster, itp, status = rig
+        nc = cluster.add_nodeclass(NodeClass(name="stable", spec=spec()))
+        status.reconcile(nc.name)
+        rv = cluster.get_nodeclass("stable").resource_version
+        for _ in range(3):
+            status.reconcile(nc.name)
+        assert cluster.get_nodeclass("stable").resource_version == rv
+
+    def test_recovery_transitions_back_to_ready(self, rig):
+        cloud, cluster, itp, status = rig
+        nc = cluster.add_nodeclass(NodeClass(
+            name="heal", spec=spec(instance_profile="nope-1x1")))
+        status.reconcile(nc.name)
+        assert not cluster.get_nodeclass("heal").status.is_ready()
+        nc = cluster.get_nodeclass("heal")
+        nc.spec.instance_profile = "bx2-4x16"
+        status.reconcile(nc.name)
+        healed = cluster.get_nodeclass("heal")
+        assert healed.status.is_ready()
+        assert healed.status.validation_error == ""
+
+    def test_default_sg_resolved_only_when_unspecified(self, rig):
+        cloud, cluster, itp, status = rig
+        a = cluster.add_nodeclass(NodeClass(name="defsg", spec=spec()))
+        cloud.security_groups.update({"sg-a": "a", "sg-b": "b"})
+        b = cluster.add_nodeclass(NodeClass(
+            name="expsg", spec=spec(security_groups=("sg-a", "sg-b"))))
+        status.reconcile("defsg")
+        status.reconcile("expsg")
+        assert cluster.get_nodeclass("defsg").status \
+            .resolved_security_groups == ["sg-default"]
+        assert cluster.get_nodeclass("expsg").status \
+            .resolved_security_groups == ["sg-a", "sg-b"]
+
+    def test_image_selector_resolves_latest(self, rig):
+        cloud, cluster, itp, status = rig
+        nc = cluster.add_nodeclass(NodeClass(name="sel", spec=spec(
+            image="", image_selector=ImageSelector(os="ubuntu",
+                                                   architecture="amd64"))))
+        status.reconcile("sel")
+        nc = cluster.get_nodeclass("sel")
+        assert nc.status.is_ready()
+        assert nc.status.resolved_image_id
+
+    def test_revalidation_requeues_at_24h(self, rig):
+        cloud, cluster, itp, status = rig
+        nc = cluster.add_nodeclass(NodeClass(name="rq", spec=spec()))
+        result = status.reconcile("rq")
+        assert result.requeue_after == status.revalidate_after == 24 * 3600.0
+
+
+class TestAutoplacementDepth:
+    def _ctrl(self, rig):
+        cloud, cluster, itp, _ = rig
+        return cluster, AutoplacementController(
+            cluster, itp, SubnetProvider(cloud))
+
+    def test_requirements_select_and_stay_idempotent(self, rig):
+        cluster, ctrl = self._ctrl(rig)
+        nc = cluster.add_nodeclass(NodeClass(name="auto", spec=spec(
+            instance_profile="",
+            instance_requirements=InstanceRequirements(min_cpu=4,
+                                                       min_memory_gib=16))))
+        ctrl.reconcile("auto")
+        nc = cluster.get_nodeclass("auto")
+        selected = nc.status.selected_instance_types
+        assert selected and all("2x8" not in t for t in selected)
+        rv = nc.resource_version
+        ctrl.reconcile("auto")        # unchanged selection: no publish
+        assert cluster.get_nodeclass("auto").resource_version == rv
+
+    def test_empty_selection_emits_warning_event(self, rig):
+        cluster, ctrl = self._ctrl(rig)
+        nc = cluster.add_nodeclass(NodeClass(name="none", spec=spec(
+            instance_profile="",
+            instance_requirements=InstanceRequirements(min_cpu=4096))))
+        ctrl.reconcile("none")
+        assert cluster.get_nodeclass("none").status \
+            .selected_instance_types == []
+        events = cluster.events_for("NodeClass", "none")
+        assert any(e.reason == "NoMatchingInstanceTypes" for e in events)
+
+    def test_conflicting_write_requeues(self, rig):
+        """Optimistic-lock conflict (autoplacement/controller.go:248):
+        another writer bumps the rv between read and patch — the
+        controller requeues instead of clobbering."""
+        cluster, ctrl = self._ctrl(rig)
+        nc = cluster.add_nodeclass(NodeClass(name="race", spec=spec(
+            instance_profile="",
+            instance_requirements=InstanceRequirements(min_cpu=2))))
+        orig_update = cluster.update
+
+        def racing_update(kind, key, obj, expect_rv=None):
+            # simulate a concurrent writer landing first
+            fresh = cluster.get(kind, key)
+            orig_update(kind, key, fresh)           # bumps rv
+            return orig_update(kind, key, obj, expect_rv=expect_rv)
+
+        cluster.update = racing_update
+        try:
+            result = ctrl.reconcile("race")
+        finally:
+            cluster.update = orig_update
+        assert result.requeue_after == 0.5
+        # retry succeeds and lands the selection
+        ctrl.reconcile("race")
+        assert cluster.get_nodeclass("race").status.selected_instance_types
+
+    def test_placement_strategy_fills_subnets_unless_pinned(self, rig):
+        cluster, ctrl = self._ctrl(rig)
+        nc = cluster.add_nodeclass(NodeClass(name="strat", spec=spec(
+            placement_strategy=PlacementStrategy(zone_balance="Balanced"))))
+        ctrl.reconcile("strat")
+        selected = cluster.get_nodeclass("strat").status.selected_subnets
+        assert selected
+        pinned = cluster.add_nodeclass(NodeClass(name="pin", spec=spec(
+            subnet="subnet-11",
+            placement_strategy=PlacementStrategy(zone_balance="Balanced"))))
+        ctrl.reconcile("pin")
+        assert cluster.get_nodeclass("pin").status.selected_subnets == []
+
+
+class TestHashAndTermination:
+    def test_hash_restamps_only_on_spec_change(self, rig):
+        cloud, cluster, itp, _ = rig
+        ctrl = NodeClassHashController(cluster)
+        nc = cluster.add_nodeclass(NodeClass(name="h", spec=spec()))
+        ctrl.reconcile("h")
+        nc = cluster.get_nodeclass("h")
+        h1 = nc.annotations[ANNOTATION_NODECLASS_HASH]
+        assert nc.annotations[ANNOTATION_NODECLASS_HASH_VERSION]
+        rv = nc.resource_version
+        ctrl.reconcile("h")
+        nc = cluster.get_nodeclass("h")
+        assert nc.resource_version == rv          # unchanged: no publish
+        nc.spec.zone = "us-south-2"
+        ctrl.reconcile("h")
+        assert cluster.get_nodeclass("h") \
+            .annotations[ANNOTATION_NODECLASS_HASH] != h1
+
+    def test_termination_blocks_on_referencing_claims(self, rig):
+        from karpenter_tpu.apis.nodeclaim import NodeClaim
+
+        cloud, cluster, itp, _ = rig
+        ctrl = NodeClassTerminationController(cluster)
+        nc = cluster.add_nodeclass(NodeClass(
+            name="doomed", spec=spec(),
+            finalizers=[TERMINATION_FINALIZER]))
+        cluster.add_nodeclaim(NodeClaim(name="c1", nodeclass_name="doomed"))
+        nc.deleted = True
+        ctrl.reconcile("doomed")
+        assert cluster.get_nodeclass("doomed") is not None   # blocked
+        cluster.delete("nodeclaims", "c1")
+        ctrl.reconcile("doomed")
+        assert cluster.get_nodeclass("doomed") is None       # finalized
