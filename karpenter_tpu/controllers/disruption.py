@@ -52,6 +52,22 @@ class RepackProposal:
     proposed_cost: float
     plan: object = None            # solver Plan
     savings: float = 0.0
+    nodeclass: object = None       # resolved once; apply reuses it
+    catalog: object = None
+    pool: object = None
+
+
+@dataclass
+class _PendingRepack:
+    """Phase-2 state: new fleet created, waiting for it to become Ready
+    before any pod moves or old capacity drains."""
+
+    new_claims: list
+    old_claim_names: list
+    pod_map: dict                  # pod key -> new claim name
+    deadline: float
+    current_cost: float
+    proposed_cost: float
 
 
 class DisruptionController(PollController):
@@ -61,21 +77,41 @@ class DisruptionController(PollController):
     interval = 10.0
 
     def __init__(self, cluster: ClusterState, cloudprovider: CloudProvider,
-                 provisioner=None, clock=time.time):
+                 provisioner=None, clock=time.time,
+                 repack_enabled: bool = False,
+                 repack_min_savings_fraction: float = 0.15,
+                 repack_cooldown: float = 600.0):
         self.cluster = cluster
         self.cloudprovider = cloudprovider
         self.provisioner = provisioner
         self.clock = clock
+        # cost-optimal repack (BASELINE config #4 actuated): OFF by
+        # default — blue/green churn is a policy decision, gated like the
+        # reference's consolidation policies.  Hysteresis: a minimum
+        # savings fraction plus a cooldown between applications.
+        self.repack_enabled = repack_enabled
+        self.repack_min_savings_fraction = repack_min_savings_fraction
+        self.repack_cooldown = repack_cooldown
+        self.repack_ready_timeout = 900.0   # new-fleet Ready deadline
+        self._last_repack = 0.0             # stamped on EVERY attempt —
+        # a converged fleet must not pay a full fresh solve per 10s poll
+        self._pending_repack: Optional[_PendingRepack] = None
 
     # -- reconcile ---------------------------------------------------------
 
     def reconcile(self) -> Result:
         drifted = self._replace_drifted()
-        emptied = self._consolidate_empty()
-        moved = self._consolidate_underutilized()
-        if drifted or emptied or moved:
+        # consolidation pauses while a repack transition is in flight:
+        # the new fleet is intentionally empty until cutover, so empty
+        # consolidation would reap it (and underutilized moves would use
+        # unproven nodes as targets / drain old capacity early)
+        transitioning = self._pending_repack is not None
+        emptied = 0 if transitioning else self._consolidate_empty()
+        moved = 0 if transitioning else self._consolidate_underutilized()
+        repacked = self._repack_if_profitable() if self.repack_enabled else 0
+        if drifted or emptied or moved or repacked:
             log.info("disruption pass", drifted=drifted, empty=emptied,
-                     consolidated=moved)
+                     consolidated=moved, repacked=repacked)
         return Result()
 
     # -- drift (SURVEY.md §3.4) -------------------------------------------
@@ -168,16 +204,25 @@ class DisruptionController(PollController):
     # -- repack (observable; BASELINE config #4) --------------------------
 
     def propose_repack(self) -> Optional[RepackProposal]:
-        """Fresh solve of the entire workload vs the live fleet cost."""
+        """Fresh solve of the entire workload vs the live fleet cost.
+        Single-pool scope: with multiple NodePools (or pool taints the
+        solve can't reproduce without pool context) the repack proposal
+        declines rather than produce a fleet stripped of pool policy."""
         if self.provisioner is None:
             return None
         from karpenter_tpu.solver.types import SolveRequest
 
+        pools = self.cluster.list("nodepools")
+        if len(pools) > 1:
+            return None
+        pool = pools[0] if pools else None
         claims = [c for c in self.cluster.nodeclaims() if not c.deleted]
         if not claims:
             return None
         current = sum(c.hourly_price for c in claims)
-        nodeclass = self.cluster.get_nodeclass("default")
+        nodeclass = self.cluster.get_nodeclass(
+            pool.nodeclass_name if pool and pool.nodeclass_name
+            else "default") or self.cluster.get_nodeclass("default")
         if nodeclass is None:
             return None
         catalog = self.provisioner._catalog_for(nodeclass)
@@ -186,16 +231,144 @@ class DisruptionController(PollController):
         pods = [p.spec for p in self.cluster.list("pods")]
         if not pods:
             return None
-        plan = self.provisioner.solver.solve(SolveRequest(pods, catalog))
+        plan = self.provisioner.solver.solve(
+            SolveRequest(pods, catalog, pool))
         return RepackProposal(
             current_cost=current, proposed_cost=plan.total_cost_per_hour,
-            plan=plan, savings=current - plan.total_cost_per_hour)
+            plan=plan, savings=current - plan.total_cost_per_hour,
+            nodeclass=nodeclass, catalog=catalog, pool=pool)
+
+    def _repack_if_profitable(self) -> int:
+        """Two-phase blue/green repack, serialized behind the
+        provisioner's solve lock (a concurrent solve window and a repack
+        solving the same pods would double-provision).
+
+        Phase 1: fresh solve; when it places everything and saves at
+        least the threshold, CREATE the new fleet — and stop.  No pod
+        moves, no old capacity drained: the plan is unproven until its
+        nodes are Ready.  Phase 2 (subsequent polls): once every new
+        claim is initialized, renominate the pods onto their planned
+        nodes and drain the old fleet; if the new fleet misses the Ready
+        deadline, roll IT back and keep the old fleet serving."""
+        if self.provisioner is None:
+            return 0
+        with self.provisioner._solve_lock:
+            if self._pending_repack is not None:
+                return self._advance_pending_repack()
+            now = self.clock()
+            if now - self._last_repack < self.repack_cooldown:
+                return 0
+            self._last_repack = now   # stamp EVERY attempt (poll damping)
+            proposal = self.propose_repack()
+            if proposal is None or proposal.current_cost <= 0:
+                return 0
+            if proposal.plan.unplaced_pods:
+                return 0   # the fresh solve can't host the full workload
+            if proposal.savings < \
+                    self.repack_min_savings_fraction * proposal.current_cost:
+                return 0
+            old_names = [c.name for c in self.cluster.nodeclaims()
+                         if not c.deleted]
+            actuator = self.provisioner.factory.get_actuator(
+                proposal.nodeclass) if self.provisioner.factory is not None \
+                else self.provisioner.actuator
+            # repack creates its fleet in one burst and cannot make
+            # incremental progress on partial creates — defer when the
+            # plan exceeds the breaker's per-minute budget instead of
+            # churning create/rollback every cooldown
+            breaker = getattr(actuator, "breaker", None)
+            if breaker is not None and getattr(breaker, "config", None) \
+                    is not None and breaker.config.enabled and \
+                    len(proposal.plan.nodes) > \
+                    breaker.config.rate_limit_per_minute:
+                log.warning(
+                    "repack deferred: plan exceeds the circuit breaker's "
+                    "provision rate budget",
+                    plan_nodes=len(proposal.plan.nodes),
+                    rate_limit=breaker.config.rate_limit_per_minute)
+                return 0
+            pool_name = proposal.pool.name if proposal.pool is not None \
+                else "default"
+            new_claims, errors = actuator.execute_plan(
+                proposal.plan, proposal.nodeclass, proposal.catalog,
+                nodepool_name=pool_name)
+            if errors or any(c is None for c in new_claims):
+                # roll back: the old fleet keeps serving
+                for c in new_claims:
+                    if c is not None:
+                        self._delete_claim(c)
+                log.warning("repack aborted on partial create",
+                            errors=errors[:3])
+                return 0
+            pod_map = {pk: claim.name
+                       for node, claim in zip(proposal.plan.nodes, new_claims)
+                       for pk in node.pod_names}
+            self._pending_repack = _PendingRepack(
+                new_claims=new_claims, old_claim_names=old_names,
+                pod_map=pod_map, deadline=now + self.repack_ready_timeout,
+                current_cost=proposal.current_cost,
+                proposed_cost=proposal.proposed_cost)
+            log.info("repack phase 1: new fleet created, awaiting Ready",
+                     new_nodes=len(new_claims), old_nodes=len(old_names))
+            return 0   # nothing moved yet
+
+    def _advance_pending_repack(self) -> int:
+        pending = self._pending_repack
+        fresh = [self.cluster.get_nodeclaim(c.name)
+                 for c in pending.new_claims]
+        if any(c is None or c.deleted for c in fresh):
+            # GC/interruption took a new node out before cutover: abandon
+            self._rollback_pending("new fleet lost a node before Ready")
+            return 0
+        if not all(c.initialized for c in fresh):
+            if self.clock() > pending.deadline:
+                self._rollback_pending("new fleet missed the Ready deadline")
+            return 0
+        # cutover: every new node proved Ready — move pods, drain old
+        for pk, claim_name in pending.pod_map.items():
+            p = self.cluster.get("pods", pk)
+            if p is not None:
+                p.bound_node = ""
+                p.nominated_node = claim_name
+        drained = 0
+        for name in pending.old_claim_names:
+            old = self.cluster.get_nodeclaim(name)
+            if old is not None and not old.deleted:
+                # pods in pod_map were just renominated (bound_node
+                # cleared), so eviction only re-pends stragglers that
+                # landed on the old node after the phase-1 snapshot
+                self._evict_and_delete(old)
+                drained += 1
+        self.cluster.record_event(
+            "NodeClaim", "fleet", "Normal", "Repacked",
+            f"${pending.current_cost:.2f}/h -> "
+            f"${pending.proposed_cost:.2f}/h "
+            f"({drained} -> {len(pending.new_claims)} nodes)")
+        log.info("repack phase 2: cutover complete", drained=drained,
+                 new_nodes=len(pending.new_claims))
+        self._pending_repack = None
+        self._last_repack = self.clock()
+        return 1
+
+    def _rollback_pending(self, why: str) -> None:
+        for c in self._pending_repack.new_claims:
+            live = self.cluster.get_nodeclaim(c.name)
+            if live is not None and not live.deleted:
+                # eviction, not bare delete: anything that bound onto a
+                # new node during the wait must re-pend, not strand
+                self._evict_and_delete(live)
+        log.warning("repack rolled back", reason=why)
+        self._pending_repack = None
 
     # -- helpers -----------------------------------------------------------
 
     def _bound_pods(self, node_name: str) -> List[str]:
         from karpenter_tpu.apis.pod import pod_key
 
+        if not node_name:
+            # a never-joined claim has node_name "" — matching it against
+            # pods would claim every un-nominated pod in the cluster
+            return []
         return [pod_key(p.spec) for p in self.cluster.list("pods")
                 if p.bound_node == node_name
                 or p.nominated_node == node_name]

@@ -182,6 +182,9 @@ class NodeClaimTerminationController(WatchController):
         if CLAIM_FINALIZER in claim.finalizers:
             claim.finalizers.remove(CLAIM_FINALIZER)
         if claim.node_name:
+            # node-lifecycle eviction: pods bound to the dying node
+            # re-pend (the retry ticker re-windows them)
+            self.cluster.evict_node_pods(claim.node_name)
             self.cluster.delete("nodes", claim.node_name)
         self.cluster.delete("nodeclaims", key)
         self.cluster.record_event("NodeClaim", key, "Normal", "Terminated", "")
@@ -299,6 +302,7 @@ class GarbageCollectionController(PollController):
                 self.cloud.get_instance(parsed[1])
             except CloudError as e:
                 if is_not_found(e):
+                    self.cluster.evict_node_pods(node.name)
                     self.cluster.delete("nodes", node.name)
                     log.info("GC: deleted orphan node", node=node.name)
                     n += 1

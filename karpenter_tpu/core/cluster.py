@@ -198,6 +198,25 @@ class ClusterState:
     def pending_pods(self) -> List[PendingPod]:
         return self.list("pods", lambda p: not p.bound_node)
 
+    def evict_node_pods(self, node_name: str) -> int:
+        """Re-pend every pod bound/nominated to ``node_name``'s claim —
+        the node-lifecycle eviction that follows a Node deletion in a
+        real cluster (without it, pods bound to a dead node would strand
+        forever in the sim)."""
+        if not node_name:
+            return 0
+        n = 0
+        with self._lock:
+            pods = list(self._collections["pods"].values())
+        for pending in pods:
+            if pending.bound_node == node_name or \
+                    pending.nominated_node == node_name:
+                pending.bound_node = ""
+                pending.nominated_node = ""
+                pending.enqueued_at = 0.0   # immediate re-window
+                n += 1
+        return n
+
     def bind_pod(self, pod_key: str, node_name: str) -> None:
         with self._lock:
             p = self._collections["pods"].get(pod_key)

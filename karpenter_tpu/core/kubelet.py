@@ -39,14 +39,39 @@ class FakeKubelet:
         return self.cluster.add_node(node)
 
     def join_pending(self, ready: bool = False) -> List[Node]:
-        """Join every launched-but-nodeless claim (bulk test driver)."""
+        """Join every launched-but-nodeless claim (bulk test driver), then
+        bind nominated pods onto ready nodes — the kube-scheduler's half
+        of the continuation."""
         have = {n.provider_id for n in self.cluster.nodes()}
         joined = []
         for claim in self.cluster.nodeclaims():
             if claim.launched and not claim.deleted and \
                     claim.provider_id not in have:
                 joined.append(self.join(claim, ready=ready))
+        self.bind_nominated()
         return joined
+
+    def bind_nominated(self) -> int:
+        """Bind each nominated-but-unbound pod once its claim's node is
+        Ready (the kube-scheduler bind the provisioner's nomination
+        anticipates).  Pods nominated onto claims whose node joined
+        EARLIER — e.g. a repack cutover onto an already-Ready fleet —
+        bind here too, not just at join time."""
+        n = 0
+        for pending in self.cluster.list("pods"):
+            if pending.bound_node or not pending.nominated_node:
+                continue
+            claim = self.cluster.get_nodeclaim(pending.nominated_node)
+            if claim is None or claim.deleted:
+                continue
+            node = self.cluster.get_node(claim.node_name or claim.name)
+            if node is None or not node.ready or node.deleted:
+                continue
+            from karpenter_tpu.apis.pod import pod_key
+
+            self.cluster.bind_pod(pod_key(pending.spec), node.name)
+            n += 1
+        return n
 
     def mark_ready(self, node_name: str, ready: bool = True) -> Optional[Node]:
         node = self.cluster.get_node(node_name)

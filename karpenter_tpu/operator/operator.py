@@ -181,7 +181,10 @@ class Operator:
         # drift replacement + consolidation (karpenter-core's disruption
         # plane, owned here since the framework is standalone — §3.4)
         ctrls.append(DisruptionController(
-            self.cluster, self.cloudprovider, provisioner=self.provisioner))
+            self.cluster, self.cloudprovider, provisioner=self.provisioner,
+            repack_enabled=self.options.repack_enabled,
+            repack_min_savings_fraction=(
+                self.options.repack_min_savings_percent / 100.0)))
         # env-gated (controllers.go:238)
         ctrls.append(OrphanCleanupController(
             self.cluster, self.cloud,

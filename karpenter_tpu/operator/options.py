@@ -55,6 +55,8 @@ class Options:
     # behavior toggles
     interruption_enabled: bool = True
     orphan_cleanup_enabled: bool = False   # KARPENTER_ENABLE_ORPHAN_CLEANUP
+    repack_enabled: bool = False           # KARPENTER_ENABLE_REPACK
+    repack_min_savings_percent: int = 15   # apply repack only above this
     spot_discount_percent: int = 60        # spot = % of on-demand (options.go:76)
     metrics_port: int = 0                  # 0 = metrics server disabled
     webhook_port: int = 0                  # 0 = TLS admission listener off
@@ -100,6 +102,9 @@ class Options:
             leader_identity=env.get("POD_NAME", ""),
             orphan_cleanup_enabled=_getb(env, "KARPENTER_ENABLE_ORPHAN_CLEANUP",
                                          False),
+            repack_enabled=_getb(env, "KARPENTER_ENABLE_REPACK", False),
+            repack_min_savings_percent=_geti(
+                env, "KARPENTER_REPACK_MIN_SAVINGS_PERCENT", 15),
             spot_discount_percent=_geti(env, "KARPENTER_SPOT_DISCOUNT_PERCENT",
                                         60),
             circuit_breaker=CircuitBreakerConfig.from_env(env),

@@ -199,10 +199,11 @@ def _ffd_step_sharded(axis_name, off_alloc_l, off_rank_l, state, inputs):
     node_resid = node_resid - take[:, None] * req[None, :]
     rem = count - placed
 
-    # local cheapest-per-pod, then global combine
+    # local cheapest-per-pod, then global combine (fit capped by the pods
+    # remaining, matching _ffd_step — parity with the unsharded kernel)
     fit_empty = _fit_counts(off_alloc_l, req)
     fit_empty = jnp.where(compat_l, fit_empty, 0)
-    fit_empty = jnp.minimum(fit_empty, cap)
+    fit_empty = jnp.minimum(jnp.minimum(fit_empty, cap), rem)
     cpp = jnp.where(fit_empty > 0, off_rank_l / fit_empty.astype(jnp.float32),
                     jnp.inf)
     local_arg = jnp.argmin(cpp).astype(jnp.int32)

@@ -186,14 +186,18 @@ class GreedySolver:
             if not remaining:
                 continue
 
-            # open new nodes with the cheapest-per-pod offering
+            # open new nodes with the cheapest-per-pod offering; fit is
+            # capped by the pods actually remaining so cost-per-pod is
+            # judged on the pods a node will really hold (karpenter sizes
+            # claims to their pod batch — a huge node must not "win" for
+            # a tiny tail)
             fit_empty = np.where(
                 compat,
                 np.min(np.where(req[None, :] > 0,
                                 off_alloc // np.maximum(req[None, :], 1),
                                 np.int64(1 << 40)), axis=1),
                 0)
-            fit_empty = np.minimum(fit_empty, cap)
+            fit_empty = np.minimum(fit_empty, min(cap, len(remaining)))
             with np.errstate(divide="ignore", invalid="ignore"):
                 cost_per_pod = np.where(fit_empty > 0, off_rank / fit_empty, np.inf)
             best_off = int(np.argmin(cost_per_pod))

@@ -111,6 +111,10 @@ def _ffd_step(off_alloc, off_rank, state, inputs):
     fit_empty = _fit_counts(off_alloc, req)
     fit_empty = jnp.where(compat_g, fit_empty, 0)
     fit_empty = jnp.minimum(fit_empty, cap)
+    # cap by the pods actually remaining: cost-per-pod must be judged on
+    # the pods a node will really hold, or a huge node "wins" for a tiny
+    # tail (karpenter sizes claims to their pod batch)
+    fit_empty = jnp.minimum(fit_empty, rem)
     cpp = jnp.where(fit_empty > 0, off_rank / fit_empty.astype(jnp.float32),
                     jnp.inf)
     best = jnp.argmin(cpp).astype(jnp.int32)

@@ -206,7 +206,9 @@ def _ffd_kernel(meta_ref, compat_ref, alloc_ref, rank_ref,
         fit_e = jnp.min(jnp.where(div > 0, qe, _BIG), axis=0,
                         keepdims=True)                     # [1, O]
         ok = compat_ref[pl.ds(g, 1), :] > 0                # [1, O]
-        fit_e = jnp.minimum(jnp.where(ok, fit_e, 0), cap)
+        # cap by remaining pods too (cost-per-pod judged on the pods a
+        # node will really hold — matches _ffd_step)
+        fit_e = jnp.minimum(jnp.minimum(jnp.where(ok, fit_e, 0), cap), rem)
         cpp = jnp.where(fit_e > 0,
                         rank_ref[:] / fit_e.astype(jnp.float32),
                         jnp.float32(jnp.inf))              # [1, O]

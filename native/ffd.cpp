@@ -58,6 +58,25 @@ int ffd_solve_gid(int G, int O, int N,
   int open = 0;
   bool overflow = false;
 
+  // Per-ORIGINAL-group state for per-pod expansions: the cheapest-per-pod
+  // offering is chosen once per group at its FIRST node open, with fit
+  // capped by the group's pods remaining at that moment — bit-identical
+  // to the grouped backends' batch-fill (which caps fit_empty by `rem`).
+  // A per-pod row (count=1) must consult its gid's remaining, not its
+  // own, or every tail pod would open a 1-pod node.
+  int n_gids = 0;
+  if (gid) {
+    for (int g = 0; g < G; ++g)
+      if (gid[g] + 1 > n_gids) n_gids = gid[g] + 1;
+  }
+  std::vector<int32_t> gid_left(n_gids, 0);
+  std::vector<int> gid_best(n_gids, -1);
+  std::vector<int32_t> gid_bestfit(n_gids, 0);
+  std::vector<char> gid_ready(n_gids, 0);
+  if (gid) {
+    for (int g = 0; g < G; ++g) gid_left[gid[g]] += group_count[g];
+  }
+
   for (int g = 0; g < G; ++g) {
     const int32_t* req = group_req + static_cast<size_t>(g) * R;
     const int32_t cap = group_cap[g];
@@ -66,30 +85,16 @@ int ffd_solve_gid(int G, int O, int N,
                           : assign + static_cast<size_t>(g) * N;
     unplaced[g] = 0;
 
-    // cheapest-per-pod offering on an empty node for this group: the
-    // choice is group-invariant, hoisted out of the per-pod loop (the
-    // reference recomputes it per pod with identical result)
+    // per-group (per-GID when expanded) best-offering memo — see the
+    // gid-state comment above the group loop
     int best = -1;
     int32_t best_fit = 0;
-    float best_cpp = std::numeric_limits<float>::infinity();
-    for (int o = 0; o < O; ++o) {
-      if (!cg[o]) continue;
-      const int32_t* alloc = off_alloc + static_cast<size_t>(o) * R;
-      int32_t f = std::numeric_limits<int32_t>::max();
-      for (int r = 0; r < R; ++r)
-        if (req[r] > 0) {
-          int32_t q = alloc[r] / req[r];
-          if (q < f) f = q;
-        }
-      if (f == std::numeric_limits<int32_t>::max()) f = 1 << 30;
-      if (f > cap) f = cap;
-      if (f <= 0) continue;
-      float cpp = off_rank[o] / static_cast<float>(f);
-      if (cpp < best_cpp) {
-        best_cpp = cpp;
-        best = o;
-        best_fit = f;
-      }
+    bool best_ready = false;
+    const int slot = gid ? gid[g] : -1;
+    if (slot >= 0 && gid_ready[slot]) {
+      best = gid_best[slot];
+      best_fit = gid_bestfit[slot];
+      best_ready = true;
     }
 
     for (int32_t p = 0; p < group_count[g]; ++p) {
@@ -102,12 +107,46 @@ int ffd_solve_gid(int G, int O, int N,
         if (!fits(rn, req)) continue;
         for (int r = 0; r < R; ++r) rn[r] -= req[r];
         assign[static_cast<size_t>(g) * N + n] += 1;
-        if (gid) capcnt[n] += 1;
+        if (gid) {
+          capcnt[n] += 1;
+          gid_left[slot] -= 1;
+        }
         placed = true;
         break;
       }
       if (placed) continue;
 
+      if (!best_ready) {
+        best_ready = true;
+        const int32_t remaining =
+            slot >= 0 ? gid_left[slot] : group_count[g] - p;
+        float best_cpp = std::numeric_limits<float>::infinity();
+        for (int o = 0; o < O; ++o) {
+          if (!cg[o]) continue;
+          const int32_t* alloc = off_alloc + static_cast<size_t>(o) * R;
+          int32_t f = std::numeric_limits<int32_t>::max();
+          for (int r = 0; r < R; ++r)
+            if (req[r] > 0) {
+              int32_t q = alloc[r] / req[r];
+              if (q < f) f = q;
+            }
+          if (f == std::numeric_limits<int32_t>::max()) f = 1 << 30;
+          if (f > cap) f = cap;
+          if (f > remaining) f = remaining;
+          if (f <= 0) continue;
+          float cpp = off_rank[o] / static_cast<float>(f);
+          if (cpp < best_cpp) {
+            best_cpp = cpp;
+            best = o;
+            best_fit = f;
+          }
+        }
+        if (slot >= 0) {
+          gid_best[slot] = best;
+          gid_bestfit[slot] = best_fit;
+          gid_ready[slot] = 1;
+        }
+      }
       if (best < 0 || best_fit <= 0) {  // no offering can ever host it
         unplaced[g] = group_count[g] - p;
         break;
@@ -123,7 +162,10 @@ int ffd_solve_gid(int G, int O, int N,
       int32_t* rn = resid.data() + static_cast<size_t>(n) * R;
       for (int r = 0; r < R; ++r) rn[r] = alloc[r] - req[r];
       assign[static_cast<size_t>(g) * N + n] = 1;
-      if (gid) capcnt[n] += 1;
+      if (gid) {
+        capcnt[n] += 1;
+        gid_left[slot] -= 1;
+      }
     }
   }
   return overflow ? -1 : open;

@@ -448,6 +448,12 @@ class TestEndToEndSlice:
 
     def test_failed_create_leaves_pods_pending(self, rig):
         cloud, cluster, prov, actuator, itp = rig
+        # permissive breaker: the test exercises failure plumbing; the
+        # right-sized plans open several nodes and would trip the strict
+        # default 2/min rate limit
+        actuator.breaker = CircuitBreakerManager(CircuitBreakerConfig(
+            failure_threshold=10000, rate_limit_per_minute=100000,
+            max_concurrent_instances=100000))
         cloud.recorder.set_persistent_error(
             "create_instance", CloudError("no capacity", 503,
                                           code="insufficient_capacity",
@@ -500,6 +506,9 @@ class TestEndToEndSlice:
         the next window replaces the capacity."""
         cloud, cluster, prov, actuator, itp = rig
         prov.options.window = WindowOptions(idle_seconds=0.05, max_seconds=1.0)
+        actuator.breaker = CircuitBreakerManager(CircuitBreakerConfig(
+            failure_threshold=10000, rate_limit_per_minute=100000,
+            max_concurrent_instances=100000))
         prov.start()
         import time
         try:

@@ -226,3 +226,148 @@ class TestRepackProposal:
         assert proposal.proposed_cost < proposal.current_cost
         assert proposal.savings == pytest.approx(
             proposal.current_cost - proposal.proposed_cost)
+
+
+class TestRepackApply:
+    """BASELINE config #4 ACTUATED: the fresh-solve proposal is applied
+    blue/green — new nodes created, pods renominated, old fleet drained —
+    behind the savings-threshold and cooldown gates."""
+
+    def _rig_with_actuator(self, rig):
+        from karpenter_tpu.core import Actuator
+        from karpenter_tpu.core.provisioner import Provisioner
+
+        cluster, ctrl, clock, itp = rig
+        cloud = itp._client
+        nc = cluster.get_nodeclass("default")
+        nc.status.resolved_image_id = "img-1"
+        nc.status.set_condition("Ready", "True", "Validated")
+        actuator = Actuator(cloud, cluster)
+        ctrl.provisioner = Provisioner(cluster, itp, actuator)
+        ctrl.repack_enabled = True
+        ctrl.repack_cooldown = 0.0
+        return cluster, ctrl, clock
+
+    def test_profitable_repack_two_phase_cutover(self, rig):
+        from karpenter_tpu.core.kubelet import FakeKubelet
+
+        cluster, ctrl, clock = self._rig_with_actuator(rig)
+        # 3 big expensive nodes, each hosting one tiny pod -> the fresh
+        # solve packs all pods onto one small node
+        for i in range(3):
+            c = _claim(cluster, f"fat{i}", itype="bx2-16x64", price=0.8,
+                       age=clock.t - 3600)
+            _pod(cluster, f"p{i}", c.node_name, cpu=250, mem=512)
+        old = {c.name for c in cluster.nodeclaims()}
+        # phase 1: new fleet created, NOTHING moved or drained yet
+        assert ctrl._repack_if_profitable() == 0
+        assert ctrl._pending_repack is not None
+        for name in old:
+            assert not cluster.get_nodeclaim(name).deleted
+        for i in range(3):
+            assert cluster.get("pods", f"default/p{i}").bound_node
+        new_names = {c.name for c in ctrl._pending_repack.new_claims}
+        # new fleet not Ready -> still held
+        assert ctrl._repack_if_profitable() == 0
+        assert not any(cluster.get_nodeclaim(n).deleted for n in old)
+        # kubelet joins the new fleet; registration marks it initialized
+        from karpenter_tpu.controllers.nodeclaim import RegistrationController
+
+        kubelet = FakeKubelet(cluster)
+        kubelet.join_pending(ready=True)
+        reg = RegistrationController(cluster)
+        for n in new_names:
+            reg.reconcile(n)
+        # phase 2: cutover
+        assert ctrl._repack_if_profitable() == 1
+        live = [c for c in cluster.nodeclaims() if not c.deleted]
+        assert {c.name for c in live} == new_names
+        assert sum(c.hourly_price for c in live) < 2.4 * 0.85
+        for i in range(3):
+            p = cluster.get("pods", f"default/p{i}")
+            assert p.nominated_node in new_names
+            assert not p.bound_node
+        for name in old:
+            assert cluster.get_nodeclaim(name).deleted
+        ev = [e for e in cluster.events_for("NodeClaim", "fleet")
+              if e.reason == "Repacked"]
+        assert len(ev) == 1
+
+    def test_new_fleet_never_ready_rolls_back(self, rig):
+        cluster, ctrl, clock = self._rig_with_actuator(rig)
+        ctrl.repack_ready_timeout = 100.0
+        for i in range(2):
+            c = _claim(cluster, f"nb{i}", itype="bx2-16x64", price=0.8,
+                       age=clock.t - 3600)
+            _pod(cluster, f"np{i}", c.node_name, cpu=250, mem=512)
+        old = {c.name for c in cluster.nodeclaims()}
+        assert ctrl._repack_if_profitable() == 0
+        assert ctrl._pending_repack is not None
+        new_names = {c.name for c in ctrl._pending_repack.new_claims}
+        clock.t += 101      # the new fleet never registers
+        assert ctrl._repack_if_profitable() == 0
+        assert ctrl._pending_repack is None
+        # new fleet rolled back, old fleet untouched, pods still bound
+        for n in new_names:
+            assert cluster.get_nodeclaim(n).deleted
+        for name in old:
+            assert not cluster.get_nodeclaim(name).deleted
+        for i in range(2):
+            assert cluster.get("pods", f"default/np{i}").bound_node
+
+    def test_unprofitable_or_gated_repack_noops(self, rig):
+        cluster, ctrl, clock = self._rig_with_actuator(rig)
+        c = _claim(cluster, "ok0", itype="bx2-4x16", price=0.2,
+                   age=clock.t - 3600)
+        _pod(cluster, "q0", c.node_name, cpu=3000, mem=12288)
+        # savings exist (spot repricing) but stay under a high threshold:
+        # the gate must hold
+        ctrl.repack_min_savings_fraction = 0.9
+        assert ctrl._repack_if_profitable() == 0
+        assert not cluster.get_nodeclaim("ok0").deleted
+
+    def test_cooldown_damps_repeated_solves(self, rig):
+        cluster, ctrl, clock = self._rig_with_actuator(rig)
+        ctrl.repack_cooldown = 600.0
+        c = _claim(cluster, "w0", itype="bx2-4x16", price=0.2,
+                   age=clock.t - 3600)
+        _pod(cluster, "cp0", c.node_name, cpu=3000, mem=12288)
+        ctrl.repack_min_savings_fraction = 0.9   # proposal always declines
+        solves = []
+        orig = ctrl.propose_repack
+
+        def counting():
+            solves.append(1)
+            return orig()
+
+        ctrl.propose_repack = counting
+        assert ctrl._repack_if_profitable() == 0
+        # every ATTEMPT stamps the cooldown — a converged fleet must not
+        # pay a full fresh solve per 10s poll
+        assert ctrl._repack_if_profitable() == 0
+        assert ctrl._repack_if_profitable() == 0
+        assert len(solves) == 1
+        clock.t += 601
+        assert ctrl._repack_if_profitable() == 0
+        assert len(solves) == 2
+
+    def test_partial_create_rolls_back(self, rig):
+        from karpenter_tpu.cloud.errors import CloudError
+
+        cluster, ctrl, clock = self._rig_with_actuator(rig)
+        for i in range(2):
+            c = _claim(cluster, f"rb{i}", itype="bx2-16x64", price=0.8,
+                       age=clock.t - 3600)
+            _pod(cluster, f"rp{i}", c.node_name, cpu=250, mem=512)
+        cloud = ctrl.provisioner.actuator.cloud
+        cloud.recorder.inject_error(
+            "create_instance", CloudError("zone capacity", 503,
+                                          code="insufficient_capacity"))
+        try:
+            assert ctrl._repack_if_profitable() == 0
+        finally:
+            cloud.recorder.reset()
+        # old fleet untouched, pods still bound
+        for i in range(2):
+            assert not cluster.get_nodeclaim(f"rb{i}").deleted
+            assert cluster.get("pods", f"default/rp{i}").bound_node

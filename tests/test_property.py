@@ -118,8 +118,10 @@ def test_backends_agree_and_plans_hold_invariants(seed):
     assert sorted(jx.unplaced_pods) == sorted(gpy.unplaced_pods), \
         f"seed {seed}: jax and greedy disagree on unplaced pods"
 
-    # right-sizing refines cost, never regresses it
-    assert jx.total_cost_per_hour <= gpy.total_cost_per_hour + 1e-6, \
+    # right-sizing refines cost, never regresses it (relative epsilon:
+    # the device accumulates cost in float32, the host in float64)
+    assert jx.total_cost_per_hour <= gpy.total_cost_per_hour * (1 + 1e-5) \
+        + 1e-6, \
         f"seed {seed}: jax cost {jx.total_cost_per_hour} > " \
         f"greedy {gpy.total_cost_per_hour}"
 
@@ -134,4 +136,5 @@ def test_larger_workloads_with_batched_candidates(seed):
     gpy = GreedySolver(SolverOptions(use_native="off")).solve(req)
     assert validate_plan(jx, pods, catalog) == []
     assert sorted(jx.unplaced_pods) == sorted(gpy.unplaced_pods)
-    assert jx.total_cost_per_hour <= gpy.total_cost_per_hour + 1e-6
+    assert jx.total_cost_per_hour <= gpy.total_cost_per_hour * (1 + 1e-5) \
+        + 1e-6
