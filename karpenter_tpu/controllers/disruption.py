@@ -220,10 +220,12 @@ class DisruptionController(PollController):
         if not claims:
             return None
         current = sum(c.hourly_price for c in claims)
-        nodeclass = self.cluster.get_nodeclass(
-            pool.nodeclass_name if pool and pool.nodeclass_name
-            else "default") or self.cluster.get_nodeclass("default")
+        wanted = pool.nodeclass_name if pool and pool.nodeclass_name \
+            else "default"
+        nodeclass = self.cluster.get_nodeclass(wanted)
         if nodeclass is None:
+            # decline rather than silently rebuilding the fleet from a
+            # DIFFERENT nodeclass (drift would immediately fight it)
             return None
         catalog = self.provisioner._catalog_for(nodeclass)
         if catalog is None:
@@ -269,9 +271,7 @@ class DisruptionController(PollController):
                 return 0
             old_names = [c.name for c in self.cluster.nodeclaims()
                          if not c.deleted]
-            actuator = self.provisioner.factory.get_actuator(
-                proposal.nodeclass) if self.provisioner.factory is not None \
-                else self.provisioner.actuator
+            actuator = self.provisioner.actuator_for(proposal.nodeclass)
             # repack creates its fleet in one burst and cannot make
             # incremental progress on partial creates — defer when the
             # plan exceeds the breaker's per-minute budget instead of
@@ -289,28 +289,46 @@ class DisruptionController(PollController):
                 return 0
             pool_name = proposal.pool.name if proposal.pool is not None \
                 else "default"
-            new_claims, errors = actuator.execute_plan(
-                proposal.plan, proposal.nodeclass, proposal.catalog,
-                nodepool_name=pool_name)
-            if errors or any(c is None for c in new_claims):
-                # roll back: the old fleet keeps serving
-                for c in new_claims:
-                    if c is not None:
-                        self._delete_claim(c)
-                log.warning("repack aborted on partial create",
-                            errors=errors[:3])
-                return 0
+        # the create burst runs OUTSIDE the solve lock — per-node cloud
+        # calls must not stall unrelated solve windows; the old_names
+        # snapshot was taken under the lock, so claims a concurrent
+        # window creates are never drained at cutover
+        new_claims, errors = actuator.execute_plan(
+            proposal.plan, proposal.nodeclass, proposal.catalog,
+            nodepool_name=pool_name)
+        if errors or any(c is None for c in new_claims):
+            # roll back: the old fleet keeps serving.  Stamp the cooldown
+            # so the failure backs off instead of retrying next poll.
+            for c in new_claims:
+                if c is not None:
+                    self._delete_claim(c)
+            self._last_repack = self.clock()
+            log.warning("repack aborted on partial create",
+                        errors=errors[:3])
+            return 0
+        with self.provisioner._solve_lock:
             pod_map = {pk: claim.name
                        for node, claim in zip(proposal.plan.nodes, new_claims)
                        for pk in node.pod_names}
+            # pods that are still PENDING (unnominated, unbound) nominate
+            # onto the new fleet immediately — exactly what a provisioner
+            # window would do — so no concurrent window double-provisions
+            # them during the Ready wait.  Pods bound to old nodes move
+            # only at cutover.
+            for pk, claim_name in pod_map.items():
+                p = self.cluster.get("pods", pk)
+                if p is not None and not p.bound_node \
+                        and not p.nominated_node:
+                    p.nominated_node = claim_name
             self._pending_repack = _PendingRepack(
                 new_claims=new_claims, old_claim_names=old_names,
-                pod_map=pod_map, deadline=now + self.repack_ready_timeout,
+                pod_map=pod_map,
+                deadline=self.clock() + self.repack_ready_timeout,
                 current_cost=proposal.current_cost,
                 proposed_cost=proposal.proposed_cost)
-            log.info("repack phase 1: new fleet created, awaiting Ready",
-                     new_nodes=len(new_claims), old_nodes=len(old_names))
-            return 0   # nothing moved yet
+        log.info("repack phase 1: new fleet created, awaiting Ready",
+                 new_nodes=len(new_claims), old_nodes=len(old_names))
+        return 0   # nothing moved yet
 
     def _advance_pending_repack(self) -> int:
         pending = self._pending_repack
@@ -359,6 +377,8 @@ class DisruptionController(PollController):
                 self._evict_and_delete(live)
         log.warning("repack rolled back", reason=why)
         self._pending_repack = None
+        # a failed transition backs off a full cooldown before retrying
+        self._last_repack = self.clock()
 
     # -- helpers -----------------------------------------------------------
 

@@ -221,8 +221,7 @@ class Provisioner:
             plan = self.solver.solve(SolveRequest(pool_pods, catalog, pool))
             if not plan.nodes:
                 continue
-            actuator = self.factory.get_actuator(nodeclass) \
-                if self.factory is not None else self.actuator
+            actuator = self.actuator_for(nodeclass)
             claims, errors = actuator.execute_plan(
                 plan, nodeclass, catalog, pool.name)
             # nominate pods onto successfully-created claims (positional)
@@ -242,6 +241,13 @@ class Provisioner:
             if not pods:
                 break
         return plans, nominated
+
+    def actuator_for(self, nodeclass: NodeClass):
+        """Per-NodeClass actuation routing (ref factory.go:70) — the ONE
+        place selection logic lives; repack and the window path share it."""
+        if self.factory is not None:
+            return self.factory.get_actuator(nodeclass)
+        return self.actuator
 
     def _nominate(self, key: str, node_name: str) -> None:
         pending = self.cluster.get("pods", key)

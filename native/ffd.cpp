@@ -58,21 +58,22 @@ int ffd_solve_gid(int G, int O, int N,
   int open = 0;
   bool overflow = false;
 
-  // Per-ORIGINAL-group state for per-pod expansions: the cheapest-per-pod
-  // offering is chosen once per group at its FIRST node open, with fit
-  // capped by the group's pods remaining at that moment — bit-identical
-  // to the grouped backends' batch-fill (which caps fit_empty by `rem`).
-  // A per-pod row (count=1) must consult its gid's remaining, not its
-  // own, or every tail pod would open a 1-pod node.
+  // Per-ORIGINAL-group state for per-pod expansions.  The grouped
+  // backends choose the new-node offering once per group with fit capped
+  // by the pods remaining at the first open; a per-pod row (count=1)
+  // must use its GID's remaining at the gid's first open — frozen there
+  // — or every tail pod would open a 1-pod node.  The offering scan
+  // itself is deliberately REPEATED per row (it is a pure function of
+  // the frozen remaining, so plans stay bit-identical to the grouped
+  // batch-fill): this loop is the reference-cost baseline, and
+  // karpenter-core pays instance-type work per pod, not per group.
   int n_gids = 0;
   if (gid) {
     for (int g = 0; g < G; ++g)
       if (gid[g] + 1 > n_gids) n_gids = gid[g] + 1;
   }
   std::vector<int32_t> gid_left(n_gids, 0);
-  std::vector<int> gid_best(n_gids, -1);
-  std::vector<int32_t> gid_bestfit(n_gids, 0);
-  std::vector<char> gid_ready(n_gids, 0);
+  std::vector<int32_t> gid_frozen_rem(n_gids, -1);   // -1 = not frozen yet
   if (gid) {
     for (int g = 0; g < G; ++g) gid_left[gid[g]] += group_count[g];
   }
@@ -85,17 +86,12 @@ int ffd_solve_gid(int G, int O, int N,
                           : assign + static_cast<size_t>(g) * N;
     unplaced[g] = 0;
 
-    // per-group (per-GID when expanded) best-offering memo — see the
+    // best-offering choice at the first node open of this row — see the
     // gid-state comment above the group loop
     int best = -1;
     int32_t best_fit = 0;
     bool best_ready = false;
     const int slot = gid ? gid[g] : -1;
-    if (slot >= 0 && gid_ready[slot]) {
-      best = gid_best[slot];
-      best_fit = gid_bestfit[slot];
-      best_ready = true;
-    }
 
     for (int32_t p = 0; p < group_count[g]; ++p) {
       // first-fit over open nodes in age order — the per-pod hot loop
@@ -118,8 +114,13 @@ int ffd_solve_gid(int G, int O, int N,
 
       if (!best_ready) {
         best_ready = true;
-        const int32_t remaining =
-            slot >= 0 ? gid_left[slot] : group_count[g] - p;
+        int32_t remaining;
+        if (slot >= 0) {
+          if (gid_frozen_rem[slot] < 0) gid_frozen_rem[slot] = gid_left[slot];
+          remaining = gid_frozen_rem[slot];
+        } else {
+          remaining = group_count[g] - p;
+        }
         float best_cpp = std::numeric_limits<float>::infinity();
         for (int o = 0; o < O; ++o) {
           if (!cg[o]) continue;
@@ -140,11 +141,6 @@ int ffd_solve_gid(int G, int O, int N,
             best = o;
             best_fit = f;
           }
-        }
-        if (slot >= 0) {
-          gid_best[slot] = best;
-          gid_bestfit[slot] = best_fit;
-          gid_ready[slot] = 1;
         }
       }
       if (best < 0 || best_fit <= 0) {  // no offering can ever host it
