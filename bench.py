@@ -22,13 +22,7 @@ import time
 import numpy as np
 
 
-def build_workload(num_pods: int, num_types: int, seed: int = 42):
-    from karpenter_tpu.apis.pod import (
-        PodSpec, ResourceRequests, Toleration, TopologySpreadConstraint,
-    )
-    from karpenter_tpu.apis.requirements import (
-        LABEL_CAPACITY_TYPE, LABEL_ZONE, Operator, Requirement,
-    )
+def build_catalog(num_types: int):
     from karpenter_tpu.catalog import CatalogArrays, InstanceTypeProvider, PricingProvider
     from karpenter_tpu.cloud.fake import FakeCloud, generate_profiles
 
@@ -37,6 +31,18 @@ def build_workload(num_pods: int, num_types: int, seed: int = 42):
     itp = InstanceTypeProvider(cloud, pricing)
     catalog = CatalogArrays.build(itp.list())
     pricing.close()
+    return catalog
+
+
+def build_workload(num_pods: int, num_types: int, seed: int = 42):
+    from karpenter_tpu.apis.pod import (
+        PodSpec, ResourceRequests, Toleration, TopologySpreadConstraint,
+    )
+    from karpenter_tpu.apis.requirements import (
+        LABEL_CAPACITY_TYPE, LABEL_ZONE, Operator, Requirement,
+    )
+
+    catalog = build_catalog(num_types)
 
     rng = np.random.RandomState(seed)
     sizes = [(250, 512), (500, 1024), (1000, 4096), (2000, 8192),
@@ -62,6 +68,87 @@ def build_workload(num_pods: int, num_types: int, seed: int = 42):
 
 def p50(xs):
     return float(np.percentile(xs, 50))
+
+
+def build_hetero_workload(num_pods: int, num_types: int, seed: int = 7):
+    """Heterogeneous variant: near-unique request shapes, so signature
+    compression yields THOUSANDS of groups instead of ~50.  This is the
+    regime that actually stresses the solve (G x N x O work) — config #3's
+    size-class mix collapses to a handful of groups, which any host loop
+    handles in milliseconds."""
+    from karpenter_tpu.apis.pod import PodSpec, ResourceRequests
+
+    catalog = build_catalog(num_types)
+    rng = np.random.RandomState(seed)
+    pods = []
+    for i in range(num_pods):
+        cpu = int(rng.randint(100, 8000))
+        mem = int(rng.randint(256, 32768))
+        pods.append(PodSpec(f"h{i}",
+                            requests=ResourceRequests(cpu, mem, 0, 1)))
+    return pods, catalog
+
+
+def run_hetero(num_pods: int, num_types: int, iters: int) -> dict:
+    """Extra keyed metrics for the heterogeneous regime: same contract as
+    the headline solve, at G in the thousands."""
+    from karpenter_tpu.solver import (
+        GreedySolver, JaxSolver, SolveRequest, encode, validate_plan,
+    )
+    from karpenter_tpu.solver.greedy import expand_per_pod, solve_per_pod_native
+
+    pods, catalog = build_hetero_workload(num_pods, num_types)
+    request = SolveRequest(pods, catalog)
+    problem = encode(pods, catalog)
+
+    jax_solver = JaxSolver()
+    plan = jax_solver.solve(request)       # warmup/compile
+    errs = validate_plan(plan, pods, catalog)
+    if errs:
+        return {"hetero_error": f"INVALID_PLAN: {errs[:2]}"}
+    walls = []
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        jax_solver.solve(request)
+        walls.append(time.perf_counter() - t0)
+
+    greedy = GreedySolver()
+    gplan = greedy.solve(request)
+    gtimes = []
+    for _ in range(max(3, iters // 2)):
+        t0 = time.perf_counter()
+        greedy.solve(request)
+        gtimes.append(time.perf_counter() - t0)
+
+    expanded = expand_per_pod(problem)
+    naive_p50 = 0.0
+    if solve_per_pod_native(problem, expanded=expanded) is not None:
+        ntimes = []
+        for _ in range(max(3, iters // 2)):
+            t0 = time.perf_counter()
+            solve_per_pod_native(problem, expanded=expanded)
+            ntimes.append(time.perf_counter() - t0)
+        naive_p50 = p50(ntimes)
+
+    cost_ratio = plan.total_cost_per_hour / max(gplan.total_cost_per_hour,
+                                                1e-9)
+    jp = p50(walls)
+    if not naive_p50:
+        vs, gate = 0.0, "no-native-baseline"
+    elif cost_ratio > 1.0 + 1e-6:
+        vs, gate = 0.0, "cost-exceeds-baseline"
+    else:
+        vs, gate = naive_p50 / jp, "ok"
+    return {
+        "hetero_groups": problem.num_groups,
+        "hetero_wall_ms": round(jp * 1000, 3),
+        "hetero_compute_path": jax_solver.last_stats.get("path", ""),
+        "hetero_host_p50_ms": round(p50(gtimes) * 1000, 3),
+        "hetero_naive_host_p50_ms": round(naive_p50 * 1000, 3),
+        "hetero_vs_baseline": round(vs, 2),
+        "hetero_baseline_gate": gate,
+        "hetero_cost_ratio": round(cost_ratio, 4),
+    }
 
 
 def run(num_pods: int, num_types: int, iters: int, platform: str) -> dict:
@@ -397,6 +484,13 @@ def main():
             result.update(run_fleet(fleet, pods, types, max(3, iters // 4)))
         except Exception as e:  # noqa: BLE001 — never lose the main result
             result["fleet_error"] = str(e)[:200]
+    try:
+        # heterogeneous regime: thousands of signature groups (the shape
+        # that actually stresses the solve; the headline mix collapses to
+        # ~50 groups that any host loop clears in milliseconds)
+        result.update(run_hetero(pods, types, max(3, iters // 4)))
+    except Exception as e:  # noqa: BLE001
+        result["hetero_error"] = str(e)[:200]
     print(json.dumps(result))
 
 
