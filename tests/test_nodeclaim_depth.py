@@ -648,3 +648,46 @@ class TestInterruptionMetadataHealth:
             assert got.health_state == "faulted"
         finally:
             server.stop()
+
+
+class TestNodeEviction:
+    """Node deletion must re-pend its pods (the node-lifecycle eviction a
+    real API server performs) — termination and orphan GC both."""
+
+    def test_termination_evicts_bound_pods(self, rig):
+        cloud, cluster, actuator, itp, _ = rig
+        claim = launch_claim(cloud, cluster, actuator, itp)
+        node = FakeKubelet(cluster).join(claim, ready=True)
+        RegistrationController(cluster).reconcile(claim.name)
+        pod = PodSpec("w0", requests=ResourceRequests(500, 1024, 0, 1))
+        cluster.add_pod(pod)
+        cluster.bind_pod("default/w0", node.name)
+        claim = cluster.get_nodeclaim(claim.name)
+        claim.deleted = True
+        cluster.update("nodeclaims", claim.name, claim)
+        NodeClaimTerminationController(cluster, actuator).reconcile(claim.name)
+        p = cluster.get("pods", "default/w0")
+        assert not p.bound_node and not p.nominated_node
+        assert p.enqueued_at == 0.0        # immediate re-window
+
+    def test_orphan_gc_evicts_bound_pods(self, rig):
+        cloud, cluster, actuator, itp, _ = rig
+        cluster.add_node(Node(name="ghost", ready=True,
+                              provider_id=provider_id("us-south",
+                                                      "inst-gone")))
+        pod = PodSpec("g0", requests=ResourceRequests(500, 1024, 0, 1))
+        cluster.add_pod(pod)
+        cluster.bind_pod("default/g0", "ghost")
+        GarbageCollectionController(cluster, cloud).reconcile()
+        assert cluster.get_node("ghost") is None
+        p = cluster.get("pods", "default/g0")
+        assert not p.bound_node
+
+    def test_evict_empty_node_name_is_noop(self, rig):
+        """The guard against claiming every un-nominated pod via the
+        empty node name (a never-joined claim has node_name '')."""
+        cloud, cluster, actuator, itp, _ = rig
+        pod = PodSpec("keep", requests=ResourceRequests(500, 1024, 0, 1))
+        pending = cluster.add_pod(pod)
+        pending.nominated_node = ""
+        assert cluster.evict_node_pods("") == 0
