@@ -345,29 +345,51 @@ def decode_plan(problem: EncodedProblem, node_off: np.ndarray,
                 assign: np.ndarray, unplaced: np.ndarray, cost: float,
                 backend: str):
     """Shared dense-result -> Plan decoding (jax, pallas, and native
-    backends all emit the same (node_off, assign, unplaced) contract)."""
+    backends all emit the same (node_off, assign, unplaced) contract).
+
+    Vectorized over the assign nonzeros: the naive per-node x per-group
+    cursor walk is O(nodes x groups) Python — 20M iterations at the
+    heterogeneous 10k-group regime (measured 12.4 s, dominating the
+    solve wall).  The cursor semantics (each group's pod_names consumed
+    in node-ascending order) reproduce exactly: entry offsets are
+    per-group exclusive cumsums over the node-ascending entry order."""
     from karpenter_tpu.solver.types import Plan, PlannedNode
 
     catalog = problem.catalog
     groups = problem.groups
-    cursors = [0] * len(groups)
     nodes: List = []
     open_idx = np.nonzero(node_off >= 0)[0]
+    G = len(groups)
+    # nonzero entries of the live [G, N] block, gi-major (np.nonzero is
+    # row-major) -> per-group exclusive cumsum = each entry's start offset
+    # into its group's pod_names, because within one group entries are
+    # already node-ascending
+    gis, ns = np.nonzero((assign[:G] > 0) & (node_off >= 0)[None, :])
+    cnts = assign[gis, ns].astype(np.int64)
+    csum = np.cumsum(cnts) - cnts                     # exclusive, global
+    if gis.size:
+        first = np.zeros(gis.size, dtype=bool)
+        first[0] = True
+        first[1:] = gis[1:] != gis[:-1]
+        group_base = np.repeat(csum[first], np.diff(
+            np.concatenate([np.nonzero(first)[0], [gis.size]])))
+        starts = csum - group_base                    # offset within group
+    else:
+        starts = csum
+    # gi-major iteration fills each per-node list in ascending gi — the
+    # same order the cursor walk produced (dict keys make node order moot)
+    per_node: Dict[int, List[str]] = {}
+    for gi, n, s, k in zip(gis, ns, starts, cnts):
+        per_node.setdefault(int(n), []).extend(
+            groups[gi].pod_names[s:s + k])
     for n in open_idx:
         off = int(node_off[n])
         itype, zone, captype = catalog.describe_offering(off)
-        pod_names: List[str] = []
-        for gi in range(len(groups)):
-            k = int(assign[gi, n]) if gi < assign.shape[0] else 0
-            if k > 0:
-                c = cursors[gi]
-                pod_names.extend(groups[gi].pod_names[c:c + k])
-                cursors[gi] = c + k
         nodes.append(PlannedNode(
             instance_type=itype, zone=zone, capacity_type=captype,
             price=float(catalog.off_price[off])
             if off < catalog.num_offerings else 0.0,
-            pod_names=pod_names, offering_index=off))
+            pod_names=per_node.get(int(n), []), offering_index=off))
     unplaced_names: List[str] = list(problem.rejected)
     for gi, g in enumerate(groups):
         miss = int(unplaced[gi]) if gi < len(unplaced) else 0
