@@ -107,13 +107,20 @@ def fleet_solve_pallas(problem: FleetProblem, *, num_nodes: int,
     :func:`fleet_device_catalog`) keeps the catalog upload out of the
     per-window path; ``compact`` = per-cluster COO capacity (0 = dense)."""
     from karpenter_tpu.solver.jax_backend import (
-        pack_input, solve_packed_pallas, unpack_result,
+        _pad2, dedup_rows, pack_input, solve_packed_pallas, unpack_result,
     )
+    from karpenter_tpu.solver.types import LABELROW_BUCKETS, bucket
 
     C, G, O = problem.compat.shape
     N = max(num_nodes, 128)
+    # factored compat upload: per-cluster deduped label rows with one
+    # common U bucket (same-length buffers -> one compiled executable)
+    factored = [dedup_rows(problem.compat[c]) for c in range(C)]
+    U_pad = bucket(max(max(r.shape[0] for _, r in factored), 1),
+                   LABELROW_BUCKETS)
     ins = np.stack([pack_input(problem.group_req[c], problem.group_count[c],
-                               problem.group_cap[c], problem.compat[c])
+                               problem.group_cap[c], factored[c][0],
+                               _pad2(factored[c][1], U_pad, O))
                     for c in range(C)])
     big = jnp.asarray(ins)                              # ONE H2D
     if device_catalog is None:
@@ -122,7 +129,7 @@ def fleet_solve_pallas(problem: FleetProblem, *, num_nodes: int,
     K = min(compact, G * N)
     outs = [solve_packed_pallas(
         big[c], alloc8_all[c], rank_all[c], price_all[c],
-        G=G, O=O, N=N, right_size=right_size, interpret=interpret,
+        G=G, O=O, U=U_pad, N=N, right_size=right_size, interpret=interpret,
         compact=K) for c in range(C)]
     out_np = np.asarray(jnp.stack(outs))                # ONE D2H
     node_off = np.empty((C, N), np.int32)

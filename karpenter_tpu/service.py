@@ -190,10 +190,10 @@ class SolverServer:
         catalog, differing per-candidate in compat — one device dispatch
         (solve_packed_batch) for the whole set."""
         from karpenter_tpu.solver.jax_backend import (
-            clamp_output_opts, needs_node_escalation, pack_input,
-            solve_packed_batch, unpack_result,
+            _pad2, clamp_output_opts, dedup_rows, needs_node_escalation,
+            pack_input, solve_packed_batch, unpack_result,
         )
-        from karpenter_tpu.solver.types import NODE_BUCKETS
+        from karpenter_tpu.solver.types import LABELROW_BUCKETS, NODE_BUCKETS
 
         t0 = time.perf_counter()
         arrays = _unpack(request)
@@ -205,10 +205,42 @@ class SolverServer:
         # pad the batch axis (repeat row 0) so shrinking candidate sets
         # across refinement rounds reuse one compiled executable
         C_pad = bucket(C, (2, 4, 8, 16, 32))
+        # factor each candidate's compat into label rows.  Candidates are
+        # the base problem with one (or few) re-pinned rows, so the base
+        # is deduped ONCE and each candidate only patches its rows that
+        # actually differ — no per-candidate full dedup on the RPC path.
+        factored = [dedup_rows(compat[0])]
+        for c in range(1, C):
+            diff = np.nonzero((compat[c] != compat[0]).any(axis=1))[0]
+            if diff.size > max(8, G // 4):
+                factored.append(dedup_rows(compat[c]))   # unusually different
+                continue
+            idx0, rows0 = factored[0]
+            idx_c = idx0.copy()
+            extra = []
+            for gdx in diff:
+                row = compat[c][gdx]
+                hits = np.nonzero((rows0 == row[None, :]).all(axis=1))[0]
+                if hits.size:
+                    idx_c[gdx] = int(hits[0])
+                    continue
+                for j, er in enumerate(extra):
+                    if (er == row).all():
+                        idx_c[gdx] = rows0.shape[0] + j
+                        break
+                else:
+                    extra.append(row)
+                    idx_c[gdx] = rows0.shape[0] + len(extra) - 1
+            rows_c = (np.concatenate([rows0, np.stack(extra)])
+                      if extra else rows0)
+            factored.append((idx_c, rows_c))
+        U_pad = bucket(max(max(r.shape[0] for _, r in factored), 1),
+                       LABELROW_BUCKETS)
         packed_rows = [pack_input(arrays["group_req"],
                                   arrays["group_count"],
-                                  arrays["group_cap"], compat[c])
-                       for c in range(C)]
+                                  arrays["group_cap"], idx,
+                                  _pad2(rws, U_pad, O))
+                       for idx, rws in factored]
         rows = np.stack(packed_rows + [packed_rows[0]] * (C_pad - C))
         N = int(arrays["num_nodes"])
         n_cap = int(arrays.get("n_cap", N))
@@ -220,7 +252,8 @@ class SolverServer:
             while True:
                 K, dense16 = clamp_output_opts(K0, False, G, N)
                 out_np = np.asarray(solve_packed_batch(
-                    rows, off_alloc, off_price, off_rank, G=G, O=O, N=N,
+                    rows, off_alloc, off_price, off_rank, G=G, O=O,
+                    U=U_pad, N=N,
                     right_size=bool(arrays["right_size"]), compact=K))
                 parsed = [unpack_result(out_np[c], G, N, K)
                           for c in range(C)]

@@ -59,6 +59,9 @@ class PodGroup:
     pinned_zone: Optional[str] = None
     spread_origin: Optional[Tuple] = None   # signature of the pre-split group
     nozone_mask: Optional[np.ndarray] = None  # bool [O], computed once in encode
+    label_mask: Optional[np.ndarray] = None   # bool [O], nozone WITHOUT the
+                                              # resource-fit term (device
+                                              # recomputes fit from group_req)
 
 
 @dataclass
@@ -70,6 +73,14 @@ class EncodedProblem:
     compat: np.ndarray          # bool [G, O]
     catalog: CatalogArrays
     rejected: List[str] = field(default_factory=list)  # pods unschedulable pre-solve
+    # compat factored for the device path: compat[g] ==
+    # label_rows[label_idx[g]] & fit(group_req[g]) — the label rows dedupe
+    # to a handful of distinct masks (1 when pods carry no constraints),
+    # so the solver ships U small rows + a [G] index instead of the full
+    # [G, O] mask, and the chip recomputes the resource-fit term from
+    # group_req x the resident catalog (H2D shrinks ~30x at large G).
+    label_rows: Optional[np.ndarray] = None   # bool [U, O]
+    label_idx: Optional[np.ndarray] = None    # int32 [G]
     # group order is descending dominant-resource size; both backends
     # consume the same order, so plans are comparable.
 
@@ -130,11 +141,29 @@ def _zone_spread_constraints(pod: PodSpec):
             if c.topology_key == LABEL_ZONE and c.when_unsatisfiable == "DoNotSchedule"]
 
 
-def _nozone_compat(reqs: Requirements, req_vec, catalog: CatalogArrays,
-                   cache: Optional[Dict] = None) -> np.ndarray:
-    """bool [O]: offering feasibility for a group ignoring the zone axis —
-    type/arch/family/size/capacity-type masks, availability, and empty-node
-    resource fit."""
+_LABEL_KEYS = (LABEL_INSTANCE_TYPE, LABEL_ARCH, LABEL_INSTANCE_FAMILY,
+               LABEL_INSTANCE_SIZE, LABEL_CAPACITY_TYPE)
+
+
+def _label_compat(reqs: Requirements, catalog: CatalogArrays,
+                  cache: Optional[Dict] = None) -> np.ndarray:
+    """bool [O]: the LABEL part of offering feasibility (zone-independent):
+    type/arch/family/size/capacity-type masks and availability — no
+    resource-fit term (the device recomputes fit from group_req, so only
+    these rows cross the host->device boundary).
+
+    The COMBINED row is memoized by its five requirement signatures, so
+    signatures with identical label constraints (the common case: none)
+    share one array object — the label-row dedup in encode() keys on
+    identity, making U the number of truly distinct constraint sets, not
+    the number of request-size groups."""
+    if cache is not None:
+        combined_key = ("__label_row__",) + tuple(
+            tuple(sorted(r.signature for r in reqs.get(k)))
+            for k in _LABEL_KEYS)
+        hit = cache.get(combined_key)
+        if hit is not None:
+            return hit
     mask = _allowed_mask(reqs, LABEL_INSTANCE_TYPE,
                          catalog.type_names, cache)[catalog.off_type]
     mask &= _allowed_mask(reqs, LABEL_ARCH,
@@ -146,9 +175,22 @@ def _nozone_compat(reqs: Requirements, req_vec, catalog: CatalogArrays,
     mask &= _allowed_mask(reqs, LABEL_CAPACITY_TYPE,
                           list(CAPACITY_TYPES), cache)[catalog.off_cap]
     mask &= catalog.off_avail
-    mask &= (catalog.offering_alloc() >=
-             np.asarray(req_vec, dtype=np.int64)[None, :]).all(axis=1)
+    if cache is not None:
+        cache[combined_key] = mask
     return mask
+
+
+def _fit_mask(req_vec, catalog: CatalogArrays) -> np.ndarray:
+    """bool [O]: empty-node resource fit (alloc >= req, every dimension)."""
+    return (catalog.offering_alloc() >=
+            np.asarray(req_vec, dtype=np.int64)[None, :]).all(axis=1)
+
+
+def _nozone_compat(reqs: Requirements, req_vec, catalog: CatalogArrays,
+                   cache: Optional[Dict] = None) -> np.ndarray:
+    """bool [O]: offering feasibility for a group ignoring the zone axis —
+    label masks, availability, and empty-node resource fit."""
+    return _label_compat(reqs, catalog, cache) & _fit_mask(req_vec, catalog)
 
 
 def viable_zones(reqs: Requirements, req_vec, catalog: CatalogArrays,
@@ -222,7 +264,7 @@ def encode(pods: Sequence[PodSpec], catalog: CatalogArrays,
         rep = members[0]
         hit = _SIG_LOWER_CACHE.get((sig,) + gen_key) if cache_ok else None
         if hit is not None:
-            reqs, unsat_flag, cap, nozone, live_zones = hit
+            reqs, unsat_flag, cap, label, nozone, live_zones = hit
             if unsat_flag:
                 rejected.extend(pod_key(p) for p in members)
                 continue
@@ -238,15 +280,17 @@ def encode(pods: Sequence[PodSpec], catalog: CatalogArrays,
             if unsat:
                 if cache_ok:
                     _SIG_LOWER_CACHE[(sig,) + gen_key] = (reqs, True, cap,
-                                                          None, None)
+                                                          None, None, None)
                 rejected.extend(pod_key(p) for p in members)
                 continue
-            nozone = _nozone_compat(reqs, req_vec, catalog, mask_cache)
+            label = _label_compat(reqs, catalog, mask_cache)
+            nozone = label & _fit_mask(req_vec, catalog)
             live_zones = viable_zones(reqs, req_vec, catalog, nozone=nozone,
                                       cache=mask_cache)
             if cache_ok:
                 _SIG_LOWER_CACHE[(sig,) + gen_key] = (reqs, False, cap,
-                                                      nozone, live_zones)
+                                                      label, nozone,
+                                                      live_zones)
         spread = _zone_spread_constraints(rep)
         if spread and len(live_zones) > 1:
             # split into per-zone pinned subgroups, evenly (skew <= 1),
@@ -263,7 +307,8 @@ def encode(pods: Sequence[PodSpec], catalog: CatalogArrays,
                 groups.append(PodGroup(
                     representative=rep, pod_names=[pod_key(p) for p in sub],
                     count=cnt, requirements=sub_reqs, cap_per_node=cap,
-                    pinned_zone=zone, spread_origin=sig, nozone_mask=nozone))
+                    pinned_zone=zone, spread_origin=sig, nozone_mask=nozone,
+                    label_mask=label))
         elif _has_zone_affinity(rep) and len(live_zones) > 1:
             # co-schedule in one zone: an explicit candidate override wins
             # (zonesplit refinement); default pin is the zone with the
@@ -275,12 +320,12 @@ def encode(pods: Sequence[PodSpec], catalog: CatalogArrays,
             groups.append(PodGroup(
                 representative=rep, pod_names=[pod_key(p) for p in members],
                 count=len(members), requirements=reqs, cap_per_node=cap,
-                pinned_zone=best, nozone_mask=nozone))
+                pinned_zone=best, nozone_mask=nozone, label_mask=label))
         else:
             groups.append(PodGroup(
                 representative=rep, pod_names=[pod_key(p) for p in members],
                 count=len(members), requirements=reqs, cap_per_node=cap,
-                nozone_mask=nozone))
+                nozone_mask=nozone, label_mask=label))
 
     # 4. FFD order: descending dominant size (deterministic tie-break on
     # first pod name).
@@ -290,12 +335,17 @@ def encode(pods: Sequence[PodSpec], catalog: CatalogArrays,
                                                mean_alloc),
                                g.pod_names[0]))
 
-    # 5. Dense tensors.
+    # 5. Dense tensors.  Label rows (compat without the per-group resource
+    # fit) are deduped as they are built: most groups share a handful of
+    # distinct (label-mask, zone-requirement, pin) combinations, and only
+    # the unique rows cross to the device (EncodedProblem docstring).
     G, O = len(groups), catalog.num_offerings
     group_req = np.zeros((G, NUM_RESOURCES), dtype=np.int32)
     group_count = np.zeros(G, dtype=np.int32)
     group_cap = np.zeros(G, dtype=np.int32)
-    compat = np.zeros((G, O), dtype=bool)
+    label_idx = np.zeros(G, dtype=np.int32)
+    row_keys: Dict[Tuple, int] = {}
+    rows: List[np.ndarray] = []
 
     for gi, g in enumerate(groups):
         req = g.representative.requests.as_tuple()
@@ -304,19 +354,36 @@ def encode(pods: Sequence[PodSpec], catalog: CatalogArrays,
         group_req[gi] = (req[0], req[1], req[2], max(req[3], 1))
         group_count[gi] = g.count
         group_cap[gi] = min(g.cap_per_node, np.iinfo(np.int32).max)
-        # nozone_mask already folds label masks, availability, and
-        # empty-node resource fit; only the zone axis remains
-        mask = g.nozone_mask.copy()
-        zone_mask = _allowed_mask(g.requirements, LABEL_ZONE, catalog.zones,
-                                  mask_cache).copy()
-        if g.pinned_zone is not None:
-            zone_mask &= np.array([z == g.pinned_zone for z in catalog.zones])
-        mask &= zone_mask[catalog.off_zone]
-        compat[gi] = mask
+        zone_sig = tuple(sorted(r.signature
+                                for r in g.requirements.get(LABEL_ZONE)))
+        key = (id(g.label_mask), zone_sig, g.pinned_zone)
+        ui = row_keys.get(key)
+        if ui is None:
+            zone_mask = _allowed_mask(g.requirements, LABEL_ZONE,
+                                      catalog.zones, mask_cache).copy()
+            if g.pinned_zone is not None:
+                zone_mask &= np.array([z == g.pinned_zone
+                                       for z in catalog.zones])
+            ui = len(rows)
+            rows.append(g.label_mask & zone_mask[catalog.off_zone])
+            row_keys[key] = ui
+        label_idx[gi] = ui
 
+    label_rows = (np.stack(rows) if rows
+                  else np.zeros((0, O), dtype=bool))
+    # host compat = label row & resource fit, the exact factoring the
+    # device reproduces (fit uses the ADJUSTED req the solve sees) — one
+    # vectorized broadcast, not a per-group _fit_mask call
+    if G:
+        fit_all = (catalog.offering_alloc()[None, :, :]
+                   >= group_req.astype(np.int64)[:, None, :]).all(axis=2)
+        compat = label_rows[label_idx] & fit_all
+    else:
+        compat = np.zeros((G, O), dtype=bool)
     return EncodedProblem(
         groups=groups, group_req=group_req, group_count=group_count,
-        group_cap=group_cap, compat=compat, catalog=catalog, rejected=rejected)
+        group_cap=group_cap, compat=compat, catalog=catalog,
+        rejected=rejected, label_rows=label_rows, label_idx=label_idx)
 
 
 def estimate_nodes(problem: EncodedProblem, n_cap: int,

@@ -117,3 +117,6 @@ NODE_BUCKETS = (64, 256, 1024, 2048, 4096, 8192, 16384)
 # COO capacity buckets for the compacted assign fetch: nnz <= placed pods
 # (every entry carries >=1 pod), so sizing by total pods is always safe
 COO_BUCKETS = (256, 1024, 4096, 16384, 65536)
+# label-row buckets for the factored compat upload (U distinct masks;
+# typically single digits — 1 when pods carry no constraints)
+LABELROW_BUCKETS = (4, 16, 64, 256, 1024, 4096)

@@ -25,7 +25,8 @@ import numpy as np
 
 from karpenter_tpu.apis.requirements import LABEL_ZONE
 from karpenter_tpu.solver.encode import (
-    EncodedProblem, _allowed_mask, _has_zone_affinity, encode, viable_zones,
+    EncodedProblem, _allowed_mask, _fit_mask, _has_zone_affinity, encode,
+    viable_zones,
 )
 from karpenter_tpu.solver.types import Plan, SolveRequest
 from karpenter_tpu.utils.logging import get_logger
@@ -62,12 +63,34 @@ def _with_zone(problem: EncodedProblem, gi: int, zone: str
     g = problem.groups[gi]
     zone_mask = _allowed_mask(g.requirements, LABEL_ZONE, catalog.zones).copy()
     zone_mask &= np.array([z == zone for z in catalog.zones])
-    row = g.nozone_mask & zone_mask[catalog.off_zone]
+    row_label = (g.label_mask if g.label_mask is not None
+                 else g.nozone_mask) & zone_mask[catalog.off_zone]
     compat = problem.compat.copy()
-    compat[gi] = row
+    # same label_row & fit(adjusted req) factoring as encode(), so host
+    # compat and the device's recomputed compat stay bit-identical
+    compat[gi] = row_label & _fit_mask(problem.group_req[gi], catalog)
     groups = list(problem.groups)
     groups[gi] = dataclasses.replace(g, pinned_zone=zone)
-    return dataclasses.replace(problem, groups=groups, compat=compat)
+    # keep the device-path factoring in sync.  Reuse an identical existing
+    # row if one exists; else overwrite the group's old slot when no other
+    # group shares it; else append — chained refinements must not grow U
+    # monotonically (a LABELROW_BUCKETS boundary crossing would force an
+    # XLA recompile mid-refinement).
+    label_rows, label_idx = problem.label_rows, problem.label_idx
+    if label_rows is not None and g.label_mask is not None:
+        label_idx = problem.label_idx.copy()
+        hits = np.nonzero((label_rows == row_label[None, :]).all(axis=1))[0]
+        old = label_idx[gi]
+        if hits.size:
+            label_idx[gi] = int(hits[0])
+        elif int((label_idx == old).sum()) == 1:
+            label_rows = label_rows.copy()
+            label_rows[old] = row_label
+        else:
+            label_rows = np.concatenate([label_rows, row_label[None, :]])
+            label_idx[gi] = label_rows.shape[0] - 1
+    return dataclasses.replace(problem, groups=groups, compat=compat,
+                               label_rows=label_rows, label_idx=label_idx)
 
 
 def _wins(candidate: Plan, incumbent: Plan) -> bool:

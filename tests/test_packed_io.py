@@ -18,12 +18,19 @@ from karpenter_tpu.catalog import CatalogArrays, InstanceTypeProvider, PricingPr
 from karpenter_tpu.cloud.fake import FakeCloud
 from karpenter_tpu.solver import GreedySolver, JaxSolver, SolveRequest, encode, validate_plan
 from karpenter_tpu.solver.jax_backend import (
-    _pad1, _pad2, _unpack_problem, pack_input, solve_kernel, solve_packed,
-    solve_packed_pallas, unpack_result,
+    _pad1, _pad2, _unpack_problem, dedup_rows, pack_input, solve_kernel,
+    solve_packed, solve_packed_pallas, unpack_result,
 )
 from karpenter_tpu.solver.types import (
-    GROUP_BUCKETS, OFFERING_BUCKETS, SolverOptions, bucket,
+    GROUP_BUCKETS, LABELROW_BUCKETS, OFFERING_BUCKETS, SolverOptions, bucket,
 )
+
+
+def _factored(compat, O):
+    """dedup + pad label rows for the v2 packed-input format."""
+    idx, rows = dedup_rows(compat)
+    U = bucket(max(rows.shape[0], 1), LABELROW_BUCKETS)
+    return idx, _pad2(rows, U, O), U
 
 
 @pytest.fixture(scope="module")
@@ -54,17 +61,36 @@ def _padded_problem(catalog, n_pods=200, seed=3):
 class TestPackUnpack:
     def test_roundtrip_bytes(self, catalog):
         _, req, cnt, cap, compat, G, O = _padded_problem(catalog)
-        packed = pack_input(req, cnt, cap, compat)
+        idx, rows, U = _factored(compat, O)
+        packed = pack_input(req, cnt, cap, idx, rows)
         assert packed.dtype == np.int32
-        assert packed.shape == (G * 8 + G * O // 32,)
-        meta, compat_i = jax.jit(_unpack_problem, static_argnums=(1, 2))(
-            packed, G, O)
+        assert packed.shape == (G * 8 + U * O // 32,)
+        off_alloc = _pad2(catalog.offering_alloc().astype(np.int32), O)
+        meta, compat_i = jax.jit(_unpack_problem,
+                                 static_argnums=(2, 3, 4))(
+            packed, off_alloc, G, O, U)
         np.testing.assert_array_equal(np.asarray(meta)[:, :4], req)
         np.testing.assert_array_equal(np.asarray(meta)[:, 4], cnt)
         np.testing.assert_array_equal(np.asarray(meta)[:, 5],
                                       np.minimum(cap, np.iinfo(np.int32).max))
+        # device-rebuilt compat == host compat (rows & recomputed fit; the
+        # encoder's rows already fold fit, so the AND is idempotent)
         np.testing.assert_array_equal(np.asarray(compat_i),
                                       compat.astype(np.int32))
+
+    def test_label_rows_dedupe_collapses_u(self, catalog):
+        """Unconstrained same-label pods share ONE label row regardless of
+        how many request-size groups they split into."""
+        prob, req, cnt, cap, compat, G, O = _padded_problem(catalog)
+        assert prob.label_rows is not None
+        # the workload has no constraints -> every group shares one row
+        assert prob.label_rows.shape[0] == 1
+        assert (prob.label_idx == 0).all()
+        # factored device compat must equal the dense host compat
+        fit = (catalog.offering_alloc()[None, :, :]
+               >= prob.group_req[:, None, :]).all(axis=2)
+        rebuilt = prob.label_rows[prob.label_idx] & fit
+        np.testing.assert_array_equal(rebuilt, prob.compat)
 
     def test_result_roundtrip_dense_and_coo(self):
         G, N, K = 8, 16, 32
@@ -99,9 +125,10 @@ class TestPackedKernelParity:
         off_rank = _pad1(catalog.offering_rank_price(), O)
         ref = solve_kernel(req, cnt, cap, compat, off_alloc, off_price,
                            off_rank, num_nodes=N)
-        packed = pack_input(req, cnt, cap, compat)
+        idx, rows, U = _factored(compat, O)
+        packed = pack_input(req, cnt, cap, idx, rows)
         out = np.asarray(solve_packed(packed, off_alloc, off_price, off_rank,
-                                      G=G, O=O, N=N))
+                                      G=G, O=O, U=U, N=N))
         no, asg, unp, cost = unpack_result(out, G, N, 0)
         np.testing.assert_array_equal(no, np.asarray(ref[0]))
         np.testing.assert_array_equal(asg, np.asarray(ref[1]))
@@ -114,14 +141,15 @@ class TestPackedKernelParity:
         off_alloc = _pad2(catalog.offering_alloc().astype(np.int32), O)
         off_price = _pad1(catalog.off_price.astype(np.float32), O)
         off_rank = _pad1(catalog.offering_rank_price(), O)
-        packed = pack_input(req, cnt, cap, compat)
+        idx, rows, U = _factored(compat, O)
+        packed = pack_input(req, cnt, cap, idx, rows)
         dense = unpack_result(
             np.asarray(solve_packed(packed, off_alloc, off_price, off_rank,
-                                    G=G, O=O, N=N)), G, N, 0)
+                                    G=G, O=O, U=U, N=N)), G, N, 0)
         K = 1024
         coo = unpack_result(
             np.asarray(solve_packed(packed, off_alloc, off_price, off_rank,
-                                    G=G, O=O, N=N, compact=K)), G, N, K)
+                                    G=G, O=O, U=U, N=N, compact=K)), G, N, K)
         np.testing.assert_array_equal(dense[0], coo[0])
         np.testing.assert_array_equal(dense[1], coo[1])
         np.testing.assert_array_equal(dense[2], coo[2])
@@ -135,14 +163,15 @@ class TestPackedKernelParity:
         off_price = _pad1(catalog.off_price.astype(np.float32), O)
         off_rank = _pad1(catalog.offering_rank_price(), O)
         alloc8, rank_row = pack_catalog(off_alloc, off_rank)
-        packed = pack_input(req, cnt, cap, compat)
+        idx, rows, U = _factored(compat, O)
+        packed = pack_input(req, cnt, cap, idx, rows)
         ref = unpack_result(
             np.asarray(solve_packed(packed, off_alloc, off_price, off_rank,
-                                    G=G, O=O, N=N)), G, N, 0)
+                                    G=G, O=O, U=U, N=N)), G, N, 0)
         out = unpack_result(
             np.asarray(solve_packed_pallas(
                 packed, jnp.asarray(alloc8), jnp.asarray(rank_row),
-                jnp.asarray(off_price), G=G, O=O, N=N, interpret=True)),
+                jnp.asarray(off_price), G=G, O=O, U=U, N=N, interpret=True)),
             G, N, 0)
         np.testing.assert_array_equal(ref[0], out[0])
         np.testing.assert_array_equal(ref[1], out[1])
