@@ -229,9 +229,11 @@ def dedup_rows(compat) -> Tuple[np.ndarray, np.ndarray]:
     its recomputed fit on top, which is idempotent."""
     G = compat.shape[0]
     compat = np.ascontiguousarray(compat, dtype=bool)
-    if G == 0:
-        return (np.zeros(0, dtype=np.int32),
-                np.zeros((0, compat.shape[1]), dtype=bool))
+    if G == 0 or compat.shape[1] == 0:
+        # O == 0: the np.void row view cannot be built for zero-width
+        # rows (advisor round 3) — every row is trivially identical
+        return (np.zeros(G, dtype=np.int32),
+                np.zeros((min(G, 1), compat.shape[1]), dtype=bool))
     # vectorized row dedup: each row viewed as one opaque byte blob, one
     # np.unique sort (no per-row Python loop on the dispatch path)
     blobs = compat.view(np.dtype((np.void, compat.shape[1]))).reshape(G)

@@ -77,7 +77,14 @@ def _with_zone(problem: EncodedProblem, gi: int, zone: str
     # monotonically (a LABELROW_BUCKETS boundary crossing would force an
     # XLA recompile mid-refinement).
     label_rows, label_idx = problem.label_rows, problem.label_idx
-    if label_rows is not None and g.label_mask is not None:
+    if label_rows is not None and g.label_mask is None:
+        # no factored label mask to patch: drop the factoring so _prepare
+        # falls back to dedup_rows(compat), which reflects the patched
+        # row — keeping stale rows would rebuild compat WITHOUT the pin
+        # on device (advisor round 3, zonesplit.py:80)
+        label_rows = None
+        label_idx = None
+    elif label_rows is not None:
         label_idx = problem.label_idx.copy()
         hits = np.nonzero((label_rows == row_label[None, :]).all(axis=1))[0]
         old = label_idx[gi]
@@ -89,8 +96,8 @@ def _with_zone(problem: EncodedProblem, gi: int, zone: str
         else:
             label_rows = np.concatenate([label_rows, row_label[None, :]])
             label_idx[gi] = label_rows.shape[0] - 1
-    return dataclasses.replace(problem, groups=groups, compat=compat,
-                               label_rows=label_rows, label_idx=label_idx)
+    return problem.replace(groups=groups, compat=compat,
+                           label_rows=label_rows, label_idx=label_idx)
 
 
 def _wins(candidate: Plan, incumbent: Plan) -> bool:

@@ -1,0 +1,73 @@
+"""Probe 3: steady-state per-solve wall of a copy_to_host_async pipeline,
+and the hetero solve's compute/encode/fetch breakdown."""
+from __future__ import annotations
+
+import json
+import sys
+import time
+from collections import deque
+
+import numpy as np
+
+import jax
+
+sys.path.insert(0, "/root/repo")
+
+
+def p50(xs):
+    return float(np.percentile(xs, 50))
+
+
+def main():
+    out = {}
+    g = jax.jit(lambda a, s: a * 2 + s)
+    big = jax.device_put(np.zeros((32768,), np.int32))
+    jax.block_until_ready(g(big, 0))
+
+    # depth-d pipeline: dispatch+async-copy i, fetch i-d
+    for depth in (2, 4, 8):
+        q = deque()
+        times = []
+        for i in range(24 + depth):
+            t0 = time.perf_counter()
+            o = g(big, i)
+            o.copy_to_host_async()
+            q.append(o)
+            if len(q) > depth:
+                np.asarray(q.popleft())
+            if i >= depth:
+                times.append(time.perf_counter() - t0)
+        out[f"async_pipeline_depth{depth}_per_ms"] = round(p50(times) * 1000, 3)
+
+    # hetero-shaped breakdown
+    from bench import build_hetero_workload
+    from karpenter_tpu.solver import JaxSolver, SolveRequest, encode
+
+    pods, catalog = build_hetero_workload(10000, 500)
+    t0 = time.perf_counter()
+    problem = encode(pods, catalog)
+    out["hetero_encode_cold_ms"] = round((time.perf_counter() - t0) * 1000, 1)
+    t0 = time.perf_counter()
+    problem = encode(pods, catalog)
+    out["hetero_encode_warm_ms"] = round((time.perf_counter() - t0) * 1000, 1)
+    out["hetero_G"] = problem.num_groups
+
+    solver = JaxSolver()
+    t0 = time.perf_counter()
+    plan = solver.solve_encoded(problem)
+    out["hetero_first_solve_ms"] = round((time.perf_counter() - t0) * 1000, 1)
+    t0 = time.perf_counter()
+    plan = solver.solve_encoded(problem)
+    out["hetero_warm_solve_ms"] = round((time.perf_counter() - t0) * 1000, 1)
+    out["hetero_stats"] = {k: (round(v, 4) if isinstance(v, float) else v)
+                           for k, v in solver.last_stats.items()}
+    # pure chip time for the hetero shape
+    run_h = solver.compute_handle(problem)
+    t1 = time.perf_counter(); run_h(1); a = time.perf_counter() - t1
+    t1 = time.perf_counter(); run_h(3); b = time.perf_counter() - t1
+    out["hetero_compute_ms"] = round((b - a) / 2 * 1000, 1)
+    print(json.dumps(out, indent=1))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,107 @@
+"""Probe the axon tunnel's fixed round-trip latency and bandwidth.
+
+Methodology (recorded for the bench's rtt_floor_ms field): a D2H fetch of
+a 4-byte device array that is already computed measures the pure
+host->device->host round trip with no compute and no meaningful payload.
+Sweeping payload sizes separates the fixed latency from bandwidth.
+"""
+from __future__ import annotations
+
+import json
+import time
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+
+def p50(xs):
+    return float(np.percentile(xs, 50))
+
+
+def main():
+    dev = jax.devices()[0]
+    print(f"# device: {dev}", flush=True)
+
+    out = {}
+
+    # 1. pure D2H round trip, 4-byte payload, result already resident
+    x = jax.device_put(np.zeros((1,), np.int32))
+    jax.block_until_ready(x)
+    times = []
+    for _ in range(15):
+        t0 = time.perf_counter()
+        np.asarray(x)
+        times.append(time.perf_counter() - t0)
+    out["d2h_tiny_p50_ms"] = round(p50(times) * 1000, 3)
+    out["d2h_tiny_min_ms"] = round(min(times) * 1000, 3)
+
+    # 2. H2D tiny
+    buf = np.zeros((1,), np.int32)
+    times = []
+    for _ in range(15):
+        t0 = time.perf_counter()
+        y = jax.device_put(buf)
+        jax.block_until_ready(y)
+        times.append(time.perf_counter() - t0)
+    out["h2d_tiny_p50_ms"] = round(p50(times) * 1000, 3)
+
+    # 3. dispatch of a trivial jitted fn (no fetch)
+    f = jax.jit(lambda a: a + 1)
+    r = f(x)
+    jax.block_until_ready(r)
+    times = []
+    for _ in range(15):
+        t0 = time.perf_counter()
+        r = f(x)
+        times.append(time.perf_counter() - t0)   # async: dispatch only
+    out["dispatch_async_p50_ms"] = round(p50(times) * 1000, 3)
+
+    # 4. dispatch + block (full round trip through execution)
+    times = []
+    for _ in range(15):
+        t0 = time.perf_counter()
+        jax.block_until_ready(f(x))
+        times.append(time.perf_counter() - t0)
+    out["exec_block_tiny_p50_ms"] = round(p50(times) * 1000, 3)
+
+    # 5. dispatch + np.asarray fetch (what the solver does)
+    times = []
+    for _ in range(15):
+        t0 = time.perf_counter()
+        np.asarray(f(x))
+        times.append(time.perf_counter() - t0)
+    out["exec_fetch_tiny_p50_ms"] = round(p50(times) * 1000, 3)
+
+    # 6. payload sweep on D2H to split latency vs bandwidth
+    for nbytes in (1 << 12, 1 << 16, 1 << 20, 1 << 23):
+        z = jax.device_put(np.zeros((nbytes // 4,), np.int32))
+        jax.block_until_ready(z)
+        times = []
+        for _ in range(7):
+            t0 = time.perf_counter()
+            np.asarray(z)
+            times.append(time.perf_counter() - t0)
+        out[f"d2h_{nbytes}B_p50_ms"] = round(p50(times) * 1000, 3)
+
+    # 7. pipelining probe: k dispatch+fetch pairs issued back-to-back,
+    # fetched in order — does overlap hide the RTT?
+    g = jax.jit(lambda a: a * 2 + 1)
+    big = jax.device_put(np.zeros((32768,), np.int32))  # ~131KB like a solve
+    jax.block_until_ready(g(big))
+    for k in (1, 4, 8):
+        times = []
+        for _ in range(7):
+            t0 = time.perf_counter()
+            outs = [g(big) for _ in range(k)]
+            for o in outs:
+                np.asarray(o)
+            times.append((time.perf_counter() - t0) / k)
+        out[f"pipelined_depth{k}_per_solve_ms"] = round(p50(times) * 1000, 3)
+
+    print(json.dumps(out, indent=1))
+
+
+if __name__ == "__main__":
+    main()

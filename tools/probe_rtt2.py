@@ -1,0 +1,110 @@
+"""Probe 2: can the ~72ms per-await tunnel cost be batched or overlapped?
+
+Questions:
+ 1. block_until_ready on a LIST of k fresh outputs — one 72ms sync or k?
+ 2. concurrent np.asarray from k threads — overlap or serialize?
+ 3. one jitted fn returning k outputs (tuple) — one await for all?
+ 4. copy_to_host_async + local sleep + asarray — does async copy land
+    without a blocking RPC?
+ 5. does await cost depend on payload size?
+"""
+from __future__ import annotations
+
+import concurrent.futures as cf
+import json
+import time
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+
+def p50(xs):
+    return float(np.percentile(xs, 50))
+
+
+def main():
+    out = {}
+    g = jax.jit(lambda a, s: a * 2 + s)
+    big = jax.device_put(np.zeros((32768,), np.int32))
+    jax.block_until_ready(g(big, 1))
+
+    # 1. one block_until_ready over a list of k fresh outputs
+    for k in (4, 8):
+        times = []
+        for _ in range(5):
+            t0 = time.perf_counter()
+            outs = [g(big, i) for i in range(k)]
+            jax.block_until_ready(outs)
+            times.append((time.perf_counter() - t0) / k)
+        out[f"block_list_depth{k}_per_ms"] = round(p50(times) * 1000, 3)
+
+    # 1b. block list then fetch all (fetch should be free after await)
+    times = []
+    for _ in range(5):
+        t0 = time.perf_counter()
+        outs = [g(big, i) for i in range(8)]
+        jax.block_until_ready(outs)
+        for o in outs:
+            np.asarray(o)
+        times.append((time.perf_counter() - t0) / 8)
+    out["block_list_then_fetch8_per_ms"] = round(p50(times) * 1000, 3)
+
+    # 2. concurrent asarray from threads
+    pool = cf.ThreadPoolExecutor(8)
+    for k in (4, 8):
+        times = []
+        for _ in range(5):
+            t0 = time.perf_counter()
+            outs = [g(big, i) for i in range(k)]
+            list(pool.map(np.asarray, outs))
+            times.append((time.perf_counter() - t0) / k)
+        out[f"threaded_fetch_depth{k}_per_ms"] = round(p50(times) * 1000, 3)
+
+    # 3. one jit returning a tuple of k arrays
+    h = jax.jit(lambda a: tuple(a + i for i in range(8)))
+    jax.block_until_ready(h(big))
+    times = []
+    for _ in range(5):
+        t0 = time.perf_counter()
+        outs = h(big)
+        for o in outs:
+            np.asarray(o)
+        times.append(time.perf_counter() - t0)
+    out["multi_output_jit_fetch8_total_ms"] = round(p50(times) * 1000, 3)
+
+    # 4. copy_to_host_async then local wait then fetch
+    times = []
+    for _ in range(5):
+        t0 = time.perf_counter()
+        o = g(big, 3)
+        try:
+            o.copy_to_host_async()
+        except Exception as e:  # noqa: BLE001
+            out["copy_to_host_async_error"] = str(e)[:80]
+            break
+        time.sleep(0.15)   # give the tunnel 2x RTT of idle time
+        t1 = time.perf_counter()
+        np.asarray(o)
+        times.append(time.perf_counter() - t1)
+    if times:
+        out["fetch_after_async_copy_ms"] = round(p50(times) * 1000, 3)
+
+    # 5. await cost vs payload
+    for nbytes in (4, 1 << 20, 1 << 23):
+        big2 = jax.device_put(np.zeros((max(nbytes // 4, 1),), np.int32))
+        f2 = jax.jit(lambda a: a + 1)
+        jax.block_until_ready(f2(big2))
+        times = []
+        for _ in range(5):
+            t0 = time.perf_counter()
+            np.asarray(f2(big2))
+            times.append(time.perf_counter() - t0)
+        out[f"await_{nbytes}B_ms"] = round(p50(times) * 1000, 3)
+
+    print(json.dumps(out, indent=1))
+
+
+if __name__ == "__main__":
+    main()
