@@ -565,6 +565,22 @@ def decode_plan(problem: EncodedProblem, node_off: np.ndarray,
     solve wall).  The cursor semantics (each group's pod_names consumed
     in node-ascending order) reproduce exactly: entry offsets are
     per-group exclusive cumsums over the node-ascending entry order."""
+    G = len(problem.groups)
+    gis, ns = np.nonzero((assign[:G] > 0) & (node_off >= 0)[None, :])
+    cnts = assign[gis, ns].astype(np.int64)
+    return decode_plan_entries(problem, node_off, gis, ns, cnts, unplaced,
+                               cost, backend)
+
+
+def decode_plan_entries(problem: EncodedProblem, node_off: np.ndarray,
+                        gis: np.ndarray, ns: np.ndarray, cnts: np.ndarray,
+                        unplaced: np.ndarray, cost: float, backend: str):
+    """COO form of :func:`decode_plan`: assignment entries (group gi,
+    node n, pod count) in any order.  The flat solver and the pipelined
+    solve path decode straight from device COO without densifying the
+    [G, N] matrix (a 256 MB allocation per solve at the heterogeneous
+    10k-group shape); the classic sync path (`unpack_result`) still
+    densifies for its dense-contract consumers (sidecar wire format)."""
     from karpenter_tpu.solver.types import Plan, PlannedNode
 
     catalog = problem.catalog
@@ -572,12 +588,16 @@ def decode_plan(problem: EncodedProblem, node_off: np.ndarray,
     nodes: List = []
     open_idx = np.nonzero(node_off >= 0)[0]
     G = len(groups)
-    # nonzero entries of the live [G, N] block, gi-major (np.nonzero is
-    # row-major) -> per-group exclusive cumsum = each entry's start offset
-    # into its group's pod_names, because within one group entries are
-    # already node-ascending
-    gis, ns = np.nonzero((assign[:G] > 0) & (node_off >= 0)[None, :])
-    cnts = assign[gis, ns].astype(np.int64)
+    keep = (gis < G) & (node_off[ns] >= 0) & (cnts > 0)
+    if not keep.all():
+        gis, ns, cnts = gis[keep], ns[keep], cnts[keep]
+    # per-group exclusive cumsum = each entry's start offset into its
+    # group's pod_names; entries must be gi-major with node-ascending
+    # order within a group for the offsets to reproduce the reference's
+    # cursor walk — lexsort makes that true for any input order
+    reorder = np.lexsort((ns, gis))
+    gis, ns = gis[reorder], ns[reorder]
+    cnts = cnts[reorder].astype(np.int64)
     csum = np.cumsum(cnts) - cnts                     # exclusive, global
     if gis.size:
         first = np.zeros(gis.size, dtype=bool)

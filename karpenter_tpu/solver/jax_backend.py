@@ -563,6 +563,15 @@ class JaxSolver:
         if problem.num_groups == 0:
             return Plan(nodes=[], unplaced_pods=list(problem.rejected),
                         backend="jax")
+        from karpenter_tpu.solver.flat import flat_viable, solve_flat
+
+        if flat_viable(problem, self.options):
+            # heterogeneous regime (G in the thousands): the parallel
+            # deal/repair kernel replaces the G-sequential scan
+            # (solver/flat.py); None = unsuitable after all -> scan path
+            plan = solve_flat(self, problem)
+            if plan is not None:
+                return plan
         prep = self._prepare(problem)
         node_off, assign, unplaced, cost = self._solve_prepared(prep)
         return self._decode(problem, node_off, assign.astype(np.int32),

@@ -43,6 +43,13 @@ class SolverOptions:
     zone_candidate_solves: int = 8  # extra-solve budget for the candidate
                                     # refinement (remote backend: each is
                                     # one more sidecar round trip)
+    flat_solver: str = "auto"       # heterogeneous-regime parallel solve
+                                    # (solver/flat.py): "auto" engages at
+                                    # >= flat_min_groups; "on" forces the
+                                    # regime gate off G; "off" disables
+    flat_min_groups: int = 2048     # G threshold for the flat path (below
+                                    # it the G-sequential scan/pallas
+                                    # kernels are faster AND FFD-exact)
     address: str = ""               # backend "remote": solver sidecar
                                     # gRPC address (host:port)
 

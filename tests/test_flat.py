@@ -1,0 +1,131 @@
+"""Flat-regime solver (solver/flat.py): feasibility, cost vs the greedy
+oracle, escalation, and regime gating.  The flat path is NOT FFD — its
+contract is feasibility (validate_plan clean) at equal-or-lower cost
+than the host oracle on its target regime (VERDICT round 3 item 1)."""
+import numpy as np
+import pytest
+
+from karpenter_tpu.apis.pod import PodSpec, PodAffinityTerm, ResourceRequests
+from karpenter_tpu.catalog import CatalogArrays, InstanceTypeProvider, PricingProvider
+from karpenter_tpu.cloud.fake import FakeCloud, generate_profiles
+from karpenter_tpu.solver import (
+    GreedySolver, JaxSolver, SolveRequest, encode, validate_plan,
+)
+from karpenter_tpu.solver.flat import flat_viable, solve_flat
+from karpenter_tpu.solver.types import SolverOptions
+
+
+def make_catalog(n=40):
+    cloud = FakeCloud(profiles=generate_profiles(n))
+    pricing = PricingProvider(cloud)
+    itp = InstanceTypeProvider(cloud, pricing)
+    catalog = CatalogArrays.build(itp.list())
+    pricing.close()
+    return catalog
+
+
+def hetero_pods(n, seed=0, cpu_hi=8000, mem_hi=32768):
+    rng = np.random.RandomState(seed)
+    return [PodSpec(f"h{i}", requests=ResourceRequests(
+        int(rng.randint(100, cpu_hi)), int(rng.randint(256, mem_hi)), 0, 1))
+        for i in range(n)]
+
+
+def flat_opts(**kw):
+    kw.setdefault("backend", "jax")
+    kw.setdefault("flat_min_groups", 16)
+    return SolverOptions(**kw)
+
+
+class TestFlatQuality:
+    @pytest.mark.parametrize("seed", [0, 1, 2])
+    def test_feasible_and_cheaper_than_oracle(self, seed):
+        catalog = make_catalog()
+        pods = hetero_pods(800, seed=seed)
+        req = SolveRequest(pods, catalog)
+        js = JaxSolver(flat_opts())
+        plan = js.solve(req)
+        assert js.last_stats.get("path") == "flat"
+        assert validate_plan(plan, pods, catalog) == []
+        assert not plan.unplaced_pods
+        oracle = GreedySolver().solve(req)
+        assert plan.total_cost_per_hour <= \
+            oracle.total_cost_per_hour * (1.0 + 1e-6)
+
+    def test_all_pods_decoded_exactly_once(self):
+        catalog = make_catalog()
+        pods = hetero_pods(300, seed=3)
+        plan = JaxSolver(flat_opts()).solve(SolveRequest(pods, catalog))
+        seen = [p for n in plan.nodes for p in n.pod_names]
+        seen += plan.unplaced_pods
+        assert sorted(seen) == sorted(f"default/h{i}" for i in range(300))
+
+    def test_unplaceable_items_reported_unplaced(self):
+        catalog = make_catalog(10)
+        big = catalog.offering_alloc().max(axis=0)
+        pods = hetero_pods(200, seed=4)
+        # 5 pods larger than any offering
+        pods += [PodSpec(f"huge{i}", requests=ResourceRequests(
+            int(big[0]) + 1000, 1024, 0, 1)) for i in range(5)]
+        plan = JaxSolver(flat_opts()).solve(SolveRequest(pods, catalog))
+        assert validate_plan(plan, pods, catalog) == []
+        assert sorted(plan.unplaced_pods) == sorted(
+            f"default/huge{i}" for i in range(5))
+
+    def test_node_escalation_on_tight_budget(self):
+        catalog = make_catalog()
+        pods = hetero_pods(600, seed=5)
+        js = JaxSolver(flat_opts())
+        plan = js.solve(SolveRequest(pods, catalog))
+        assert not plan.unplaced_pods
+        assert validate_plan(plan, pods, catalog) == []
+
+
+class TestFlatGate:
+    def test_small_g_uses_scan(self):
+        catalog = make_catalog()
+        pods = [PodSpec(f"p{i}", requests=ResourceRequests(500, 1024, 0, 1))
+                for i in range(100)]
+        js = JaxSolver(SolverOptions(backend="jax"))   # default threshold
+        js.solve(SolveRequest(pods, catalog))
+        assert js.last_stats.get("path") in ("scan", "pallas")
+
+    def test_anti_affinity_caps_fall_back(self):
+        catalog = make_catalog()
+        pods = hetero_pods(64, seed=6)
+        # self anti-affinity -> per-node cap 1 -> flat not viable
+        sel = (("app", "x"),)
+        pods += [PodSpec(f"a{i}", requests=ResourceRequests(200, 512, 0, 1),
+                         labels=sel,
+                         affinity=(PodAffinityTerm(label_selector=sel,
+                                                   anti=True),))
+                 for i in range(4)]
+        problem = encode(pods, catalog)
+        assert not flat_viable(problem, flat_opts())
+
+    def test_multi_label_row_falls_back(self):
+        catalog = make_catalog()
+        pods = hetero_pods(64, seed=7)
+        pods += [PodSpec(f"z{i}", requests=ResourceRequests(200, 512, 0, 1),
+                         node_selector=(("topology.kubernetes.io/zone",
+                                         catalog.zones[0]),))
+                 for i in range(4)]
+        problem = encode(pods, catalog)
+        assert problem.label_rows.shape[0] > 1
+        assert not flat_viable(problem, flat_opts())
+
+    def test_off_option(self):
+        catalog = make_catalog()
+        problem = encode(hetero_pods(64, seed=8), catalog)
+        assert not flat_viable(problem, flat_opts(flat_solver="off"))
+
+    def test_solve_flat_matches_validate_on_forced_small(self):
+        catalog = make_catalog()
+        pods = hetero_pods(40, seed=9)
+        problem = encode(pods, catalog)
+        js = JaxSolver(flat_opts(flat_solver="on"))
+        assert flat_viable(problem, js.options)
+        plan = solve_flat(js, problem)
+        assert plan is not None
+        assert validate_plan(plan, pods, catalog) == []
+        assert plan.placed_count + len(plan.unplaced_pods) == 40
