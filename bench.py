@@ -89,13 +89,59 @@ def build_hetero_workload(num_pods: int, num_types: int, seed: int = 7):
     return pods, catalog
 
 
+def measure_rtt_floor() -> float:
+    """Fixed cost (ms) of ONE blocking await of a fresh device result —
+    the wall-clock floor any single-shot solve pays through the TPU
+    tunnel, independent of payload (methodology: tools/probe_rtt.py;
+    d2h of an ALREADY-awaited array is ~4 us, so this is sync latency,
+    not bandwidth)."""
+    import jax
+
+    f = jax.jit(lambda a: a + 1)
+    x = jax.device_put(np.zeros((1,), np.int32))
+    jax.block_until_ready(f(x))
+    times = []
+    for _ in range(7):
+        t0 = time.perf_counter()
+        np.asarray(f(x))
+        times.append(time.perf_counter() - t0)
+    return p50(times) * 1000
+
+
+def run_pipelined(jax_solver, problem, iters: int, depth: int = 16):
+    """Amortized per-solve wall of a depth-``depth`` async pipeline over
+    a stream of solve windows (the provisioner's shape: consecutive
+    windows every 10 s; VERDICT round 3 item 2 names pipelining as the
+    sanctioned way to hide the tunnel RTT).  Returns (amortized_ms,
+    p50_ms, depth).  Each result() is a FULL solve: fetch + COO decode
+    to a Plan."""
+    import itertools
+
+    depth = max(1, min(depth, iters - 1))
+    times = []
+    t_all = last = time.perf_counter()
+    stream = jax_solver.solve_stream(itertools.repeat(problem, iters),
+                                     depth=depth)
+    for _plan in stream:
+        now = time.perf_counter()
+        times.append(now - last)
+        last = now
+    amort = (time.perf_counter() - t_all) / iters
+    steady = times[depth:] if len(times) > depth else times
+    return amort * 1000, p50(steady) * 1000 if steady else amort * 1000, depth
+
+
 def run_hetero(num_pods: int, num_types: int, iters: int) -> dict:
-    """Extra keyed metrics for the heterogeneous regime: same contract as
-    the headline solve, at G in the thousands."""
+    """Heterogeneous regime (G in the thousands — the hot loop the TPU
+    build exists to beat, SURVEY §5.7).  Baselines are placement-FAIR:
+    the greedy oracle gets an uncapped node budget so its cost covers
+    every pod (a capped oracle silently omits unplaced pods' cost,
+    flattering itself)."""
     from karpenter_tpu.solver import (
         GreedySolver, JaxSolver, SolveRequest, encode, validate_plan,
     )
     from karpenter_tpu.solver.greedy import expand_per_pod, solve_per_pod_native
+    from karpenter_tpu.solver.types import SolverOptions
 
     pods, catalog = build_hetero_workload(num_pods, num_types)
     request = SolveRequest(pods, catalog)
@@ -111,8 +157,10 @@ def run_hetero(num_pods: int, num_types: int, iters: int) -> dict:
         t0 = time.perf_counter()
         jax_solver.solve(request)
         walls.append(time.perf_counter() - t0)
+    pipe_ms, _, pipe_depth = run_pipelined(jax_solver, problem,
+                                           max(iters * 2, 12))
 
-    greedy = GreedySolver()
+    greedy = GreedySolver(SolverOptions(backend="greedy", max_nodes=32768))
     gplan = greedy.solve(request)
     gtimes = []
     for _ in range(max(3, iters // 2)):
@@ -130,22 +178,33 @@ def run_hetero(num_pods: int, num_types: int, iters: int) -> dict:
             ntimes.append(time.perf_counter() - t0)
         naive_p50 = p50(ntimes)
 
+    # cost fairness: compare only at equal-or-better placement
     cost_ratio = plan.total_cost_per_hour / max(gplan.total_cost_per_hour,
                                                 1e-9)
+    placed_ok = plan.placed_count >= gplan.placed_count
     jp = p50(walls)
     if not naive_p50:
         vs, gate = 0.0, "no-native-baseline"
+    elif not placed_ok:
+        vs, gate = 0.0, "places-fewer-than-baseline"
     elif cost_ratio > 1.0 + 1e-6:
         vs, gate = 0.0, "cost-exceeds-baseline"
+    elif naive_p50 / jp < 1.0:
+        vs, gate = naive_p50 / jp, "below-baseline"
     else:
         vs, gate = naive_p50 / jp, "ok"
     return {
         "hetero_groups": problem.num_groups,
         "hetero_wall_ms": round(jp * 1000, 3),
+        "hetero_pipelined_ms": round(pipe_ms, 3),
+        "hetero_pipeline_depth": pipe_depth,
         "hetero_compute_path": jax_solver.last_stats.get("path", ""),
+        "hetero_placed": plan.placed_count,
         "hetero_host_p50_ms": round(p50(gtimes) * 1000, 3),
         "hetero_naive_host_p50_ms": round(naive_p50 * 1000, 3),
         "hetero_vs_baseline": round(vs, 2),
+        "hetero_vs_baseline_pipelined": round(
+            naive_p50 * 1000 / pipe_ms, 2) if naive_p50 else 0.0,
         "hetero_baseline_gate": gate,
         "hetero_cost_ratio": round(cost_ratio, 4),
     }
@@ -232,23 +291,47 @@ def run(num_pods: int, num_types: int, iters: int, platform: str) -> dict:
             ntimes.append(time.perf_counter() - t0)
         naive_p50 = p50(ntimes)
 
+    # pipelined window stream (the deployment-shaped number: the tunnel
+    # await amortizes across consecutive windows; single-shot wall pays
+    # the measured rtt_floor once per solve, which no architecture can
+    # route around through this link)
+    pipe_ms, pipe_p50_ms, pipe_depth = run_pipelined(
+        jax_solver, problem, max(iters * 2, 24))
+    rtt_floor = measure_rtt_floor()
+
     # cost sanity: the TPU plan must not cost more than the baseline's.
     # vs_baseline=0 is ambiguous on its own — the gate field says whether
     # it means a missing native baseline or a cost regression
     cost_ratio = plan.total_cost_per_hour / max(gplan.total_cost_per_hour, 1e-9)
+    vs_pipe = naive_p50 * 1000 / pipe_ms if naive_p50 else 0.0
     if not naive_p50:
         vs_baseline, gate = 0.0, "no-native-baseline"
     elif cost_ratio > 1.0 + 1e-6:
         vs_baseline, gate = 0.0, "cost-exceeds-baseline"
+    elif vs_pipe < 1.0:
+        # the gate must FAIL when the TPU path loses to the host even in
+        # its best (pipelined) regime (VERDICT round 3 item 3: r3 printed
+        # "ok" at vs_baseline 0.29)
+        vs_baseline, gate = vs_pipe, "below-baseline"
     else:
-        vs_baseline, gate = naive_p50 / jax_p50, "ok"
+        vs_baseline, gate = vs_pipe, "ok"
     pods_label = f"{num_pods // 1000}k" if num_pods >= 1000 else str(num_pods)
     return {
         "metric": f"p50_solve_ms_{pods_label}pods_{num_types}types",
-        "value": round(jax_p50 * 1000, 3),
+        # headline value: amortized per-solve wall of the pipelined
+        # window stream (includes encode/pack/decode; full Plans out).
+        # Single-shot wall and the measured per-await tunnel floor are
+        # alongside — single-shot can never beat rtt_floor_ms here.
+        "value": round(pipe_ms, 3),
         "unit": "ms",
-        # headline comparison: faithful per-pod reference loop / TPU wall
+        "value_definition": f"amortized per-solve wall, depth-{pipe_depth}"
+                            " async pipeline (full encode+solve+decode)",
         "vs_baseline": round(vs_baseline, 2),
+        "single_shot_p50_ms": round(jax_p50 * 1000, 3),
+        "vs_baseline_single_shot": round(
+            naive_p50 / jax_p50, 2) if naive_p50 else 0.0,
+        "pipelined_p50_ms": round(pipe_p50_ms, 3),
+        "rtt_floor_ms": round(rtt_floor, 3),
         "wall_ms": round(jax_p50 * 1000, 3),
         # pure chip time per solve (device-resident inputs, no transfers)
         "compute_ms": round(compute_s * 1000, 3),
@@ -491,6 +574,24 @@ def main():
         result.update(run_hetero(pods, types, max(3, iters // 4)))
     except Exception as e:  # noqa: BLE001
         result["hetero_error"] = str(e)[:200]
+
+    # BASELINE.md targets, asserted explicitly: a regression to target
+    # must be visible here without reading the raw numbers (VERDICT
+    # round 3 item 3).  Sections that did not run report null, never a
+    # phantom false.
+    result["target_met"] = {
+        "headline_under_50ms": result.get("value", 1e9) < 50.0,
+        "speedup_20x": result.get("vs_baseline", 0.0) >= 20.0,
+        "cost_parity": 0.0 < result.get("cost_ratio", 0.0) <= 1.0 + 1e-6,
+        "hetero_beats_host":
+            (result["hetero_vs_baseline"] >= 1.0
+             and 0.0 < result.get("hetero_cost_ratio", 9.9) <= 1.0 + 1e-6)
+            if "hetero_vs_baseline" in result else None,
+        "fleet_beats_grouped_host":
+            (0.0 < result["fleet_wall_ms"]
+             < result.get("fleet_grouped_host_ms", 0.0))
+            if "fleet_wall_ms" in result else None,
+    }
     print(json.dumps(result))
 
 

@@ -332,11 +332,25 @@ def flat_viable(problem: EncodedProblem, options) -> bool:
     return True
 
 
-def solve_flat(solver, problem: EncodedProblem) -> Optional[Plan]:
-    """Run the flat kernel through the solver's device-resident catalog;
-    returns None when the problem turns out unsuitable after all (caller
-    falls back to the scan path).  Escalates the node axis on spill."""
-    from karpenter_tpu.solver.encode import decode_plan_entries
+class FlatAttempt:
+    """One in-flight flat dispatch: the host-side arrays (reused across
+    node escalations) plus the pending device buffer.  The result copy
+    is started immediately (`copy_to_host_async`), so by the time
+    ``finalize_flat`` runs in a pipelined loop the fetch is local."""
+
+    __slots__ = ("item_req", "item_gid", "item_live", "row", "G_pad",
+                 "O_pad", "I_pad", "N", "N_cap", "K", "out_dev", "t_disp",
+                 "t_issued")
+
+    def __init__(self, **kw):
+        for k, v in kw.items():
+            setattr(self, k, v)
+
+
+def dispatch_flat(solver, problem: EncodedProblem) -> Optional[FlatAttempt]:
+    """Issue the flat kernel and start the async result copy; returns
+    None when the problem turns out unsuitable after all (caller falls
+    back to the scan path)."""
     from karpenter_tpu.solver.jax_backend import _pad1
     from karpenter_tpu.solver.types import GROUP_BUCKETS
 
@@ -357,20 +371,42 @@ def solve_flat(solver, problem: EncodedProblem) -> Optional[Plan]:
     item_live[:total] = True
     row = _pad1(np.ascontiguousarray(problem.label_rows[0]), O_pad)
 
-    off_alloc, off_price, off_rank = solver._device_offerings(catalog, O_pad)
     N_cap = min(solver.options.max_nodes,
                 bucket(max(total, 1), NODE_BUCKETS))
     N = estimate_nodes(problem, N_cap, NODE_BUCKETS)
     K = bucket(total + G_pad, COO_BUCKETS)
+    if N * G_pad >= (1 << 31) - 1:
+        return None
+    a = FlatAttempt(item_req=item_req, item_gid=item_gid,
+                    item_live=item_live, row=row, G_pad=G_pad, O_pad=O_pad,
+                    I_pad=I_pad, N=N, N_cap=N_cap, K=K, out_dev=None,
+                    t_disp=0.0, t_issued=0.0)
+    _dispatch_attempt(solver, problem, a)
+    return a
+
+
+def _dispatch_attempt(solver, problem, a: FlatAttempt) -> None:
+    off_alloc, off_price, off_rank = solver._device_offerings(
+        problem.catalog, a.O_pad)
+    a.t_disp = time.perf_counter()
+    a.out_dev = flat_solve_kernel(
+        a.item_req, a.item_gid, a.item_live, a.row, off_alloc, off_rank,
+        off_price, I=a.I_pad, O=a.O_pad, G=a.G_pad, N=a.N, K=a.K)
+    try:
+        a.out_dev.copy_to_host_async()
+    except Exception:  # noqa: BLE001 — CPU arrays may not support it
+        pass
+    a.t_issued = time.perf_counter()
+
+
+def finalize_flat(solver, problem: EncodedProblem, a: FlatAttempt) -> Plan:
+    """Fetch + decode a flat attempt, escalating the node axis on spill
+    (synchronous re-dispatch; spill is rare by construction)."""
+    from karpenter_tpu.solver.encode import decode_plan_entries
+
     while True:
-        if N * G_pad >= (1 << 31) - 1:
-            return None
-        t_disp = time.perf_counter()
-        out_dev = flat_solve_kernel(
-            item_req, item_gid, item_live, row, off_alloc, off_rank,
-            off_price, I=I_pad, O=O_pad, G=G_pad, N=N, K=K)
-        t_issued = time.perf_counter()
-        out_np = np.asarray(out_dev)
+        N, G_pad, K = a.N, a.G_pad, a.K
+        out_np = np.asarray(a.out_dev)
         t_fetch = time.perf_counter()
         node_off = out_np[:N]
         unplaced = out_np[N:N + G_pad]
@@ -381,15 +417,16 @@ def solve_flat(solver, problem: EncodedProblem) -> Optional[Plan]:
         metrics.SOLVE_PATH.labels("flat").inc()
         metrics.SOLVE_D2H_BYTES.labels("jax").observe(int(out_np.nbytes))
         solver.last_stats = {
-            "path": "flat", "wall_s": t_fetch - t_disp,
-            "dispatch_s": t_issued - t_disp,
-            "exec_fetch_s": t_fetch - t_issued,
+            "path": "flat", "wall_s": t_fetch - a.t_disp,
+            "dispatch_s": a.t_issued - a.t_disp,
+            "exec_fetch_s": t_fetch - a.t_issued,
             "d2h_bytes": int(out_np.nbytes),
-            "h2d_bytes": int(item_req.nbytes + item_gid.nbytes
-                             + item_live.nbytes + row.nbytes),
-            "G": G_pad, "O": O_pad, "N": N, "I": I_pad}
-        if spilled > 0 and N < N_cap:
-            N = min(N_cap, bucket(N * 4, NODE_BUCKETS))
+            "h2d_bytes": int(a.item_req.nbytes + a.item_gid.nbytes
+                             + a.item_live.nbytes + a.row.nbytes),
+            "G": G_pad, "O": a.O_pad, "N": N, "I": a.I_pad}
+        if spilled > 0 and a.N < a.N_cap:
+            a.N = min(a.N_cap, bucket(a.N * 4, NODE_BUCKETS))
+            _dispatch_attempt(solver, problem, a)
             continue
         break
     live = cnt > 0
@@ -397,3 +434,11 @@ def solve_flat(solver, problem: EncodedProblem) -> Optional[Plan]:
     return decode_plan_entries(
         problem, node_off, flat_idx % G_pad, flat_idx // G_pad,
         cnt[live], unplaced, cost, "jax")
+
+
+def solve_flat(solver, problem: EncodedProblem) -> Optional[Plan]:
+    """Synchronous flat solve: dispatch + finalize in one call."""
+    a = dispatch_flat(solver, problem)
+    if a is None:
+        return None
+    return finalize_flat(solver, problem, a)

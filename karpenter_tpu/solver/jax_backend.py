@@ -560,22 +560,56 @@ class JaxSolver:
         return plan
 
     def solve_encoded(self, problem: EncodedProblem) -> Plan:
-        if problem.num_groups == 0:
-            return Plan(nodes=[], unplaced_pods=list(problem.rejected),
-                        backend="jax")
-        from karpenter_tpu.solver.flat import flat_viable, solve_flat
+        # one routing + fetch/escalation/decode state machine for sync
+        # AND async: the sync path is the async path awaited immediately
+        # (_solve_prepared remains only for the sidecar's dense-tuple
+        # wire contract)
+        return self.solve_encoded_async(problem).result()
 
+    def solve_encoded_async(self, problem: EncodedProblem) -> "PendingSolve":
+        """Pipelined entry point: dispatch the solve and start the async
+        result copy, returning immediately.  ``PendingSolve.result()``
+        fetches + decodes.  Through the TPU tunnel one blocking await
+        costs ~70 ms regardless of payload (tools/probe_rtt.py), but
+        dispatches are ~1 ms and `copy_to_host_async` lands results in
+        the background — so a depth-k window pipeline pays the round
+        trip once per PIPELINE, not once per solve (VERDICT round 3
+        item 2: hide the tunnel RTT)."""
+        from karpenter_tpu.solver.flat import dispatch_flat, flat_viable
+
+        if problem.num_groups == 0:
+            return PendingSolve(self, problem, done=Plan(
+                nodes=[], unplaced_pods=list(problem.rejected),
+                backend="jax"))
         if flat_viable(problem, self.options):
-            # heterogeneous regime (G in the thousands): the parallel
-            # deal/repair kernel replaces the G-sequential scan
-            # (solver/flat.py); None = unsuitable after all -> scan path
-            plan = solve_flat(self, problem)
-            if plan is not None:
-                return plan
+            attempt = dispatch_flat(self, problem)
+            if attempt is not None:
+                return PendingSolve(self, problem, flat=attempt)
         prep = self._prepare(problem)
-        node_off, assign, unplaced, cost = self._solve_prepared(prep)
-        return self._decode(problem, node_off, assign.astype(np.int32),
-                            unplaced, cost)
+        t0 = time.perf_counter()
+        dev, path = self._dispatch(prep, prep.packed)
+        try:
+            dev.copy_to_host_async()
+        except Exception:  # noqa: BLE001 — cpu arrays may not support it
+            pass
+        return PendingSolve(self, problem, prep=prep, dev=dev, path=path,
+                            t_disp=t0, t_issued=time.perf_counter())
+
+    def solve_stream(self, problems, depth: int = 2):
+        """Solve an iterable of EncodedProblems through a depth-``depth``
+        dispatch/fetch pipeline; yields Plans in order.  Steady-state
+        per-solve wall approaches host work + chip time — the ~70 ms
+        tunnel await amortizes across the window stream (the repack
+        loop's shape: consecutive 10 s windows)."""
+        from collections import deque
+
+        q: "deque[PendingSolve]" = deque()
+        for p in problems:
+            q.append(self.solve_encoded_async(p))
+            if len(q) > depth:
+                yield q.popleft().result()
+        while q:
+            yield q.popleft().result()
 
     def _solve_prepared(self, prep: "_Prepared"):
         """Dispatch/fetch/escalate loop on an already-packed problem —
@@ -923,6 +957,99 @@ class JaxSolver:
         from karpenter_tpu.solver.encode import decode_plan
 
         return decode_plan(problem, node_off, assign, unplaced, cost, "jax")
+
+
+class PendingSolve:
+    """One in-flight solve (packed scan/pallas or flat).  ``result()``
+    blocks on the async copy (free once landed), handles pallas runtime
+    fallback and node escalation with synchronous re-dispatches (both
+    rare), and decodes straight from device COO — no [G, N]
+    densification on the pipelined path."""
+
+    __slots__ = ("_solver", "_problem", "_prep", "_dev", "_path", "_flat",
+                 "_t_disp", "_t_issued", "_done")
+
+    def __init__(self, solver, problem, prep=None, dev=None, path="",
+                 flat=None, t_disp=0.0, t_issued=0.0, done=None):
+        self._solver = solver
+        self._problem = problem
+        self._prep = prep
+        self._dev = dev
+        self._path = path
+        self._flat = flat
+        self._t_disp = t_disp
+        self._t_issued = t_issued
+        self._done = done
+
+    def result(self) -> Plan:
+        if self._done is not None:
+            return self._done
+        if self._flat is not None:
+            from karpenter_tpu.solver.flat import finalize_flat
+
+            self._done = finalize_flat(self._solver, self._problem,
+                                       self._flat)
+            return self._done
+        from karpenter_tpu.solver.encode import (
+            decode_plan, decode_plan_entries,
+        )
+
+        solver, prep = self._solver, self._prep
+        dev, path = self._dev, self._path
+        t_disp, t_issued = self._t_disp, self._t_issued
+        while True:
+            try:
+                out_np = np.asarray(dev)
+            except Exception as e:  # noqa: BLE001 — Mosaic runtime fault
+                if path != "pallas":
+                    raise
+                log.warning("pallas path failed; scan fallback engaged",
+                            error=str(e)[:300], G=prep.G_pad, O=prep.O_pad,
+                            N=prep.N)
+                metrics.ERRORS.labels("solver", "pallas_fallback").inc()
+                solver._pallas_failed_shapes.add(
+                    (prep.G_pad, prep.O_pad, prep.N))
+                dev, path = solver._dispatch(prep, prep.packed)
+                continue
+            t_fetch = time.perf_counter()
+            G, N, K = prep.G_pad, prep.N, prep.K
+            node_off = out_np[:N]
+            unplaced = out_np[N:N + G]
+            cost = float(out_np[N + G:N + G + 1].view(np.float32)[0])
+            metrics.SOLVE_PATH.labels(path).inc()
+            metrics.SOLVE_D2H_BYTES.labels("jax").observe(int(out_np.nbytes))
+            solver.last_stats = {
+                "path": path, "wall_s": t_fetch - t_disp,
+                "dispatch_s": t_issued - t_disp,
+                "exec_fetch_s": t_fetch - t_issued,
+                "d2h_bytes": int(out_np.nbytes),
+                "h2d_bytes": int(prep.packed.nbytes),
+                "compact": bool(K), "G": G, "O": prep.O_pad, "N": N}
+            if needs_node_escalation(node_off, unplaced, N, prep.N_cap):
+                prep.N = min(prep.N_cap, bucket(prep.N * 4, NODE_BUCKETS))
+                t_disp = time.perf_counter()
+                dev, path = solver._dispatch(prep, prep.packed)
+                try:
+                    dev.copy_to_host_async()
+                except Exception:  # noqa: BLE001
+                    pass
+                t_issued = time.perf_counter()
+                continue
+            if K > 0:
+                idx = out_np[N + G + 1:N + G + 1 + K]
+                cnt = out_np[N + G + 1 + K:N + G + 1 + 2 * K]
+                live = cnt > 0
+                flat_idx = idx[live]
+                self._done = decode_plan_entries(
+                    self._problem, node_off, flat_idx % G, flat_idx // G,
+                    cnt[live], unplaced, cost, "jax")
+            else:
+                _, assign, _, _ = unpack_result(out_np, G, N, K,
+                                                prep.dense16)
+                self._done = decode_plan(self._problem, node_off,
+                                         assign.astype(np.int32), unplaced,
+                                         cost, "jax")
+            return self._done
 
 
 def _pad1(a: np.ndarray, n: int) -> np.ndarray:

@@ -1,0 +1,72 @@
+"""Pipelined solve API: async dispatch/fetch parity with the sync path
+(VERDICT round 3 item 2 — the RTT-hiding window pipeline)."""
+import numpy as np
+
+from karpenter_tpu.apis.pod import PodSpec, ResourceRequests
+from karpenter_tpu.catalog import CatalogArrays, InstanceTypeProvider, PricingProvider
+from karpenter_tpu.cloud.fake import FakeCloud, generate_profiles
+from karpenter_tpu.solver import JaxSolver, encode, validate_plan
+from karpenter_tpu.solver.types import SolverOptions
+
+
+def make_catalog(n=30):
+    cloud = FakeCloud(profiles=generate_profiles(n))
+    pricing = PricingProvider(cloud)
+    itp = InstanceTypeProvider(cloud, pricing)
+    catalog = CatalogArrays.build(itp.list())
+    pricing.close()
+    return catalog
+
+
+def mixed_pods(n, seed=0):
+    rng = np.random.RandomState(seed)
+    sizes = [(250, 512), (1000, 4096), (4000, 16384)]
+    return [PodSpec(f"p{i}", requests=ResourceRequests(*sizes[rng.randint(3)],
+                                                       0, 1))
+            for i in range(n)]
+
+
+class TestAsyncSolve:
+    def test_async_matches_sync(self):
+        catalog = make_catalog()
+        pods = mixed_pods(500)
+        problem = encode(pods, catalog)
+        js = JaxSolver()
+        sync = js.solve_encoded(problem)
+        pend = js.solve_encoded_async(problem)
+        plan = pend.result()
+        assert plan.total_cost_per_hour == sync.total_cost_per_hour
+        assert sorted(p for n in plan.nodes for p in n.pod_names) == \
+            sorted(p for n in sync.nodes for p in n.pod_names)
+        assert validate_plan(plan, pods, catalog) == []
+        # result() is idempotent
+        assert pend.result() is plan
+
+    def test_async_routes_flat_regime(self):
+        catalog = make_catalog()
+        rng = np.random.RandomState(1)
+        pods = [PodSpec(f"h{i}", requests=ResourceRequests(
+            int(rng.randint(100, 4000)), int(rng.randint(256, 8192)), 0, 1))
+            for i in range(300)]
+        problem = encode(pods, catalog)
+        js = JaxSolver(SolverOptions(backend="jax", flat_min_groups=16))
+        plan = js.solve_encoded_async(problem).result()
+        assert js.last_stats.get("path") == "flat"
+        assert validate_plan(plan, pods, catalog) == []
+
+    def test_empty_problem(self):
+        catalog = make_catalog()
+        problem = encode([], catalog)
+        plan = JaxSolver().solve_encoded_async(problem).result()
+        assert plan.nodes == [] and plan.unplaced_pods == []
+
+    def test_solve_stream_order_and_parity(self):
+        catalog = make_catalog()
+        js = JaxSolver()
+        problems = [encode(mixed_pods(120, seed=s), catalog)
+                    for s in range(5)]
+        sync_costs = [js.solve_encoded(p).total_cost_per_hour
+                      for p in problems]
+        stream_costs = [pl.total_cost_per_hour
+                        for pl in js.solve_stream(problems, depth=2)]
+        assert stream_costs == sync_costs
