@@ -1,9 +1,11 @@
 from karpenter_tpu.parallel.mesh import fleet_mesh, solver_mesh
 from karpenter_tpu.parallel.fleet import (
-    FleetProblem, fleet_device_catalog, fleet_solve, fleet_solve_pallas,
-    fleet_solve_sharded_offerings,
+    CooCapacity, FleetProblem, fleet_device_catalog, fleet_pack_inputs,
+    fleet_parse_outputs, fleet_solve, fleet_solve_pallas,
+    fleet_solve_pallas_sharded, fleet_solve_sharded_offerings,
 )
 
-__all__ = ["fleet_mesh", "solver_mesh", "FleetProblem",
-           "fleet_device_catalog", "fleet_solve", "fleet_solve_pallas",
-           "fleet_solve_sharded_offerings"]
+__all__ = ["fleet_mesh", "solver_mesh", "CooCapacity", "FleetProblem",
+           "fleet_device_catalog", "fleet_pack_inputs",
+           "fleet_parse_outputs", "fleet_solve", "fleet_solve_pallas",
+           "fleet_solve_pallas_sharded", "fleet_solve_sharded_offerings"]

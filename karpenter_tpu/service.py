@@ -190,8 +190,9 @@ class SolverServer:
         catalog, differing per-candidate in compat — one device dispatch
         (solve_packed_batch) for the whole set."""
         from karpenter_tpu.solver.jax_backend import (
-            _pad2, clamp_output_opts, dedup_rows, needs_node_escalation,
-            pack_input, solve_packed_batch, unpack_result,
+            _pad2, clamp_output_opts, coo_buffer_full, dedup_rows, grow_coo,
+            needs_node_escalation, pack_input, solve_packed_batch,
+            unpack_result,
         )
         from karpenter_tpu.solver.types import LABELROW_BUCKETS, NODE_BUCKETS
 
@@ -248,13 +249,17 @@ class SolverServer:
         with self._solver_lock:
             off_alloc, off_price, off_rank = \
                 self._jax._device_offerings(cat, O)
-            K0 = self._jax._compact_k(total, G)
+            K0, K_cap = self._jax._compact_k(total, G)
             while True:
                 K, dense16 = clamp_output_opts(K0, False, G, N)
                 out_np = np.asarray(solve_packed_batch(
                     rows, off_alloc, off_price, off_rank, G=G, O=O,
                     U=U_pad, N=N,
                     right_size=bool(arrays["right_size"]), compact=K))
+                if any(coo_buffer_full(out_np[c], G, N, K)
+                       for c in range(C)) and K0 < K_cap:
+                    K0 = grow_coo(K0, K_cap)
+                    continue
                 parsed = [unpack_result(out_np[c], G, N, K)
                           for c in range(C)]
                 if any(needs_node_escalation(no, u, N, n_cap)

@@ -374,7 +374,9 @@ def dispatch_flat(solver, problem: EncodedProblem) -> Optional[FlatAttempt]:
     N_cap = min(solver.options.max_nodes,
                 bucket(max(total, 1), NODE_BUCKETS))
     N = estimate_nodes(problem, N_cap, NODE_BUCKETS)
-    K = bucket(total + G_pad, COO_BUCKETS)
+    # exact bound: every placed item contributes at most one COO entry
+    # (merges only shrink), so bucket(total) can never overflow
+    K = bucket(total, COO_BUCKETS)
     if N * G_pad >= (1 << 31) - 1:
         return None
     a = FlatAttempt(item_req=item_req, item_gid=item_gid,

@@ -309,6 +309,25 @@ def clamp_output_opts(K0: int, dense16_ok: bool, G: int, N: int):
     return K, (dense16_ok and K == 0 and (G * N) % 2 == 0)
 
 
+def coo_buffer_full(out_np: np.ndarray, G: int, N: int, K: int) -> bool:
+    """Sound overflow detector for the compacted assign fetch:
+    ``_compact_assign`` scatters with mode="drop", and a dropped entry
+    implies every one of the K slots is occupied — so 'all cnt slots
+    live' catches any overflow (with at worst one spurious retry when
+    nnz == K exactly).  Lets dispatches start with a ~4x smaller COO
+    bucket: D2H payload is latency through the tunnel."""
+    if K <= 0:
+        return False
+    cnt = out_np[N + G + 1 + K:N + G + 1 + 2 * K]
+    return bool((cnt > 0).all())
+
+
+def grow_coo(K0: int, K_cap: int) -> int:
+    from karpenter_tpu.solver.types import COO_BUCKETS
+
+    return min(bucket(K0 * 4, COO_BUCKETS), K_cap)
+
+
 def needs_node_escalation(node_off, unplaced, N: int, N_cap: int) -> bool:
     """Escalate only when the node budget itself was the binding
     constraint: all slots open AND pods left over."""
@@ -336,6 +355,25 @@ def unpack_result(out: np.ndarray, G: int, N: int, K: int,
     return node_off, assign, unplaced, cost
 
 
+def finish_pallas_solve(meta, compat_i, node_off, assign, alloc8, rank_row,
+                        off_price, right_size: bool):
+    """Post-kernel tail shared by EVERY Mosaic entry point (single-chip
+    packed, multi-leaf, and the fleet grid): right-sizing on the exact
+    integer load (assign^T @ group_req on the MXU) + open-node cost.
+    Kept in exactly one place — the feasibility-critical logic must not
+    fork between the single and fleet paths."""
+    if right_size:
+        off_alloc = alloc8[:4].T                              # [O, R]
+        load = jnp.einsum("gn,gr->nr", assign, meta[:, :4],
+                          preferred_element_type=jnp.int32)   # [N, R]
+        node_off = _right_size(node_off, load, assign, compat_i > 0,
+                               off_alloc, rank_row[0])
+    is_open = node_off >= 0
+    cost = jnp.sum(jnp.where(is_open, off_price[jnp.clip(node_off, 0, None)],
+                             0.0))
+    return node_off, cost
+
+
 def _pallas_core(meta, compat_i, alloc8, rank_row, off_price, *, G: int,
                  O: int, N: int, right_size: bool, interpret: bool):
     """Shared body of the Mosaic-backed solve: FFD scan as one pallas
@@ -346,18 +384,9 @@ def _pallas_core(meta, compat_i, alloc8, rank_row, off_price, *, G: int,
 
     node_off, assign, unplaced = ffd_scan_pallas(
         meta, compat_i, alloc8, rank_row, G=G, O=O, N=N, interpret=interpret)
-    if right_size:
-        compat = compat_i > 0
-        off_alloc = alloc8[:4].T                              # [O, R]
-        group_req = meta[:, :4]
-        # exact integer load: assign^T @ group_req on the MXU
-        load = jnp.einsum("gn,gr->nr", assign, group_req,
-                          preferred_element_type=jnp.int32)   # [N, R]
-        node_off = _right_size(node_off, load, assign, compat,
-                               off_alloc, rank_row[0])
-    is_open = node_off >= 0
-    cost = jnp.sum(jnp.where(is_open, off_price[jnp.clip(node_off, 0, None)],
-                             0.0))
+    node_off, cost = finish_pallas_solve(meta, compat_i, node_off, assign,
+                                         alloc8, rank_row, off_price,
+                                         right_size)
     return node_off, assign, unplaced, cost
 
 
@@ -508,10 +537,11 @@ class _Prepared:
     ``unpack_result`` always parses the buffer the kernel produced."""
 
     __slots__ = ("catalog", "G_pad", "O_pad", "U_pad", "N", "N_cap", "K0",
-                 "K", "dense16_ok", "dense16", "packed", "right_size")
+                 "K_cap", "K", "dense16_ok", "dense16", "packed",
+                 "right_size")
 
     def __init__(self, *, catalog, G_pad, O_pad, U_pad, N, N_cap, K0, packed,
-                 dense16_ok=False, right_size=None):
+                 K_cap=None, dense16_ok=False, right_size=None):
         self.catalog = catalog
         self.G_pad = G_pad
         self.O_pad = O_pad
@@ -519,6 +549,7 @@ class _Prepared:
         self.N = N
         self.N_cap = N_cap
         self.K0 = K0
+        self.K_cap = K0 if K_cap is None else K_cap
         self.dense16_ok = dense16_ok
         self.K, self.dense16 = clamp_output_opts(K0, dense16_ok, G_pad, N)
         self.packed = packed
@@ -542,6 +573,11 @@ class JaxSolver:
         # per-shape pallas breaker: one pathological (G,O,N) bucket must
         # not disable the fast path for buckets that compile fine
         self._pallas_failed_shapes: set = set()
+        # per-G-bucket floor for the COO fetch capacity: growth from an
+        # overflow retry persists, so later windows of an nnz-heavy
+        # workload start at the grown size instead of re-paying the
+        # double dispatch every solve
+        self._coo_floor: Dict[int, int] = {}
 
     # -- public ------------------------------------------------------------
 
@@ -642,6 +678,11 @@ class JaxSolver:
                 out_dev, path = self._dispatch(prep, prep.packed)
                 out_np = np.asarray(out_dev)
             t_fetch = time.perf_counter()
+            if coo_buffer_full(out_np, prep.G_pad, prep.N, prep.K) \
+                    and prep.K0 < prep.K_cap:
+                prep.K0 = grow_coo(prep.K0, prep.K_cap)
+                self._note_coo_growth(prep.G_pad, prep.K0)
+                continue
             node_off, assign, unplaced, cost = unpack_result(
                 out_np, prep.G_pad, prep.N, prep.K, prep.dense16)
             metrics.SOLVE_PATH.labels(path).inc()
@@ -680,10 +721,10 @@ class JaxSolver:
                             _pad2(rows, U_pad, O_pad))
         max_slots = int(catalog.offering_alloc()[:, 3].max()) \
             if catalog.num_offerings else 1
+        K0, K_cap = self._compact_k(total_pods, G_pad)
         return _Prepared(catalog=catalog, G_pad=G_pad, O_pad=O_pad,
                          U_pad=U_pad, N=num_nodes, N_cap=n_cap,
-                         K0=self._compact_k(total_pods, G_pad),
-                         packed=packed,
+                         K0=K0, K_cap=K_cap, packed=packed,
                          dense16_ok=max_slots < (1 << 15),
                          right_size=right_size)
 
@@ -709,6 +750,7 @@ class JaxSolver:
         N = max(p.N for p in preps)
         N_cap = max(p.N_cap for p in preps)
         K0 = max(p.K0 for p in preps)
+        K_cap = max(p.K_cap for p in preps)
         if any(p.G_pad != G_pad for p in preps):
             # mixed group buckets (shouldn't happen for candidate sets —
             # same groups, different masks); keep it correct regardless
@@ -735,6 +777,11 @@ class JaxSolver:
             t_issued = time.perf_counter()
             out_np = np.asarray(out_dev)
             t_fetch = time.perf_counter()
+            if any(coo_buffer_full(out_np[c], G_pad, N, K)
+                   for c in range(C)) and K0 < K_cap:
+                K0 = grow_coo(K0, K_cap)
+                self._note_coo_growth(G_pad, K0)
+                continue
             parsed = [unpack_result(out_np[c], G_pad, N, K, dense16)
                       for c in range(C)]
             if any(needs_node_escalation(no, u, N, N_cap)
@@ -806,14 +853,14 @@ class JaxSolver:
         # against the initial estimate could silently drop entries when
         # K0 > G*N_init and N later grows (_compact_assign scatters with
         # mode="drop")
-        K0 = self._compact_k(total_pods, G_pad)
+        K0, K_cap = self._compact_k(total_pods, G_pad)
         # dense fetch (compact off): pack two int16 counts per word when
         # every offering's pod-slot capacity provably bounds assign cells
         # below 2^15 (same bound the old int16 assign_dtype used)
         max_slots = int(catalog.offering_alloc()[:, 3].max()) if O else 1
         return _Prepared(catalog=catalog, G_pad=G_pad, O_pad=O_pad,
-                         U_pad=U_pad, N=N, N_cap=N_cap, K0=K0, packed=packed,
-                         dense16_ok=max_slots < (1 << 15))
+                         U_pad=U_pad, N=N, N_cap=N_cap, K0=K0, K_cap=K_cap,
+                         packed=packed, dense16_ok=max_slots < (1 << 15))
 
     def _dispatch(self, prep: "_Prepared", arr):
         """Issue the packed solve (pallas with scan fallback).  ``arr`` is
@@ -866,18 +913,28 @@ class JaxSolver:
             compact=prep.K, dense16=prep.dense16)
         return out, "scan"
 
-    def _compact_k(self, total_pods: int, G_pad: int) -> int:
-        """COO capacity for the compacted assign fetch; 0 = dense fetch.
-        nnz <= placed pods, but also >= one entry per open node — the pod
-        count dominates, so bucket on it (+G_pad slack for padding rows)."""
+    def _compact_k(self, total_pods: int, G_pad: int) -> Tuple[int, int]:
+        """(initial, cap) COO capacity for the compacted assign fetch;
+        (0, 0) = dense fetch.  nnz <= placed pods bounds the CAP, but
+        real solves land far below it (nnz ~ open nodes x groups-per-
+        node), and D2H size is latency through the tunnel — so start a
+        bucket ~4x smaller and let the full-buffer check escalate (a
+        dropped entry implies every slot used, so 'all K slots live'
+        is a sound overflow detector)."""
         from karpenter_tpu.solver.types import COO_BUCKETS
 
         mode = self.options.compact_assign
         if mode == "off":
-            return 0
+            return 0, 0
         if mode != "on" and jax.default_backend() in ("cpu", "gpu"):
-            return 0
-        return bucket(total_pods + G_pad, COO_BUCKETS)
+            return 0, 0
+        cap = bucket(total_pods + G_pad, COO_BUCKETS)
+        first = max(bucket(max(total_pods // 4, 256) + G_pad, COO_BUCKETS),
+                    self._coo_floor.get(G_pad, 0))
+        return min(first, cap), cap
+
+    def _note_coo_growth(self, G_pad: int, K0: int) -> None:
+        self._coo_floor[G_pad] = max(self._coo_floor.get(G_pad, 0), K0)
 
     @staticmethod
     def _estimate_nodes(problem: EncodedProblem, n_cap: int) -> int:
@@ -1013,6 +1070,17 @@ class PendingSolve:
                 continue
             t_fetch = time.perf_counter()
             G, N, K = prep.G_pad, prep.N, prep.K
+            if coo_buffer_full(out_np, G, N, K) and prep.K0 < prep.K_cap:
+                prep.K0 = grow_coo(prep.K0, prep.K_cap)
+                solver._note_coo_growth(G, prep.K0)
+                t_disp = time.perf_counter()
+                dev, path = solver._dispatch(prep, prep.packed)
+                try:
+                    dev.copy_to_host_async()
+                except Exception:  # noqa: BLE001
+                    pass
+                t_issued = time.perf_counter()
+                continue
             node_off = out_np[:N]
             unplaced = out_np[N:N + G]
             cost = float(out_np[N + G:N + G + 1].view(np.float32)[0])

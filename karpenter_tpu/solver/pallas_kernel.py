@@ -146,11 +146,16 @@ def _lane_pick(row, lane_idx, target):
 def _ffd_kernel(meta_ref, compat_ref, alloc_ref, rank_ref,
                 node_off_ref, assign_ref, unplaced_ref,
                 resid_ref, gcompat_ref, ptr_ref,
-                *, Gb: int, O: int, N: int):
+                *, Gb: int, O: int, N: int, block_axis: int = 0):
     """One grid step: process ``Gb`` groups.  Node state (node_off, resid,
     ptr) persists in scratch/output across the sequential grid; gcompat
-    covers only this block's rows and is rebuilt from node_off at entry."""
-    b = pl.program_id(0)
+    covers only this block's rows and is rebuilt from node_off at entry.
+
+    ``block_axis`` is the grid axis carrying the group-block index: 0 for
+    the single-problem grid (G//Gb,), 1 for the fleet grid (C, G//Gb) —
+    the fleet axis is major, so state resets at block 0 of each cluster
+    and the same body solves C clusters in ONE Mosaic launch."""
+    b = pl.program_id(block_axis)
     R = 4
     laneN = jax.lax.broadcasted_iota(jnp.int32, (1, N), 1)
     laneO = jax.lax.broadcasted_iota(jnp.int32, (1, O), 1)
@@ -293,6 +298,61 @@ def ffd_scan_pallas(group_meta, compat_i8, off_alloc8, off_rank,
         interpret=interpret,
     )(group_meta, compat_i8, off_alloc8, off_rank)
     return node_off[0], assign, unplaced[:, 0]
+
+
+@functools.partial(jax.jit, static_argnames=("C", "G", "O", "N", "interpret"))
+def ffd_scan_pallas_fleet(group_meta, compat_i, off_alloc8, off_rank,
+                          *, C: int, G: int, O: int, N: int,
+                          interpret: bool = False):
+    """Fleet variant: C stacked cluster problems solved in ONE Mosaic
+    launch over a (C, G//Gb) grid — the fleet axis rides the grid, so
+    per-cluster dispatch overhead (the round-3 fleet bottleneck: C
+    sequential launches) disappears.  Node state resets at each
+    cluster's first block (same kernel body; ``block_axis=1``).
+
+    Inputs carry a leading cluster axis: group_meta [C,G,8],
+    compat_i [C,G,O] int32, off_alloc8 [C,8,O], off_rank [C,1,O].
+    Returns (node_off [C,N], assign [C,G,N], unplaced [C,G])."""
+    Gb = choose_group_block(G, O, N)
+    if Gb is None:
+        raise ValueError(
+            f"fleet problem does not fit the pallas VMEM tiling "
+            f"(G={G}, O={O}, N={N})")
+    kernel = functools.partial(_ffd_kernel, Gb=Gb, O=O, N=N, block_axis=1)
+    node_off, assign, unplaced = pl.pallas_call(
+        kernel,
+        grid=(C, G // Gb),
+        out_shape=(
+            jax.ShapeDtypeStruct((C, 1, N), jnp.int32),
+            jax.ShapeDtypeStruct((C, G, N), jnp.int32),
+            jax.ShapeDtypeStruct((C, G, 128), jnp.int32),
+        ),
+        in_specs=[
+            pl.BlockSpec((None, Gb, 8), lambda c, b: (c, b, 0),
+                         memory_space=pltpu.SMEM),
+            pl.BlockSpec((None, Gb, O), lambda c, b: (c, b, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((None, 8, O), lambda c, b: (c, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((None, 1, O), lambda c, b: (c, 0, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=(
+            pl.BlockSpec((None, 1, N), lambda c, b: (c, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((None, Gb, N), lambda c, b: (c, b, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((None, Gb, 128), lambda c, b: (c, b, 0),
+                         memory_space=pltpu.VMEM),
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((8, N), jnp.int32),
+            pltpu.VMEM((Gb, N), jnp.int32),
+            pltpu.SMEM((1,), jnp.int32),
+        ],
+        interpret=interpret,
+    )(group_meta, compat_i, off_alloc8, off_rank)
+    return node_off[:, 0], assign, unplaced[:, :, 0]
 
 
 def pack_problem(group_req, group_count, group_cap, compat):

@@ -1,0 +1,148 @@
+"""Start-small COO fetch capacity: the full-buffer overflow detector and
+its escalation must reproduce the dense result exactly (the optimization
+trades D2H payload for a rare retry; a detector regression would drop
+placements silently)."""
+import numpy as np
+
+from karpenter_tpu.apis.pod import PodSpec, ResourceRequests
+from karpenter_tpu.catalog import CatalogArrays, InstanceTypeProvider, PricingProvider
+from karpenter_tpu.cloud.fake import FakeCloud, generate_profiles
+from karpenter_tpu.solver import JaxSolver, encode
+from karpenter_tpu.solver.jax_backend import (
+    clamp_output_opts, coo_buffer_full, grow_coo,
+)
+from karpenter_tpu.solver.types import SolverOptions
+
+
+def make_catalog(n=12):
+    cloud = FakeCloud(profiles=generate_profiles(n))
+    pricing = PricingProvider(cloud)
+    itp = InstanceTypeProvider(cloud, pricing)
+    catalog = CatalogArrays.build(itp.list())
+    pricing.close()
+    return catalog
+
+
+def unique_pods(n, seed=0):
+    """n near-unique pods -> n groups of count 1 -> nnz == placed pods."""
+    rng = np.random.RandomState(seed)
+    return [PodSpec(f"u{i}", requests=ResourceRequests(
+        int(rng.randint(100, 2000)), int(rng.randint(256, 4096)), 0, 1))
+        for i in range(n)]
+
+
+class TestDetector:
+    def test_full_and_not_full(self):
+        G, N, K = 4, 8, 4
+        buf = np.zeros(N + G + 1 + 2 * K, np.int32)
+        assert not coo_buffer_full(buf, G, N, K)          # all cnt zero
+        buf[N + G + 1 + K:] = 1                           # every slot live
+        assert coo_buffer_full(buf, G, N, K)
+        buf[N + G + 1 + K] = 0                            # one free slot
+        assert not coo_buffer_full(buf, G, N, K)
+        assert not coo_buffer_full(buf, G, N, 0)          # dense mode
+
+    def test_grow_is_bounded(self):
+        assert grow_coo(256, 1024) == 1024
+        assert grow_coo(256, 65536) == 1024
+        assert grow_coo(65536, 65536) == 65536
+
+
+class TestEscalation:
+    def _forced_small(self, monkeypatch, first=256, cap=4096):
+        def fake_compact_k(self, total_pods, G_pad):
+            return first, cap
+
+        monkeypatch.setattr(JaxSolver, "_compact_k", fake_compact_k)
+
+    def test_escalated_solve_matches_dense(self, monkeypatch):
+        catalog = make_catalog()
+        # 600 unique groups of 1 pod -> nnz = 600 > forced K=256
+        problem = encode(unique_pods(600), catalog)
+        dense = JaxSolver(SolverOptions(
+            backend="jax", compact_assign="off", flat_solver="off")
+        ).solve_encoded(problem)
+        self._forced_small(monkeypatch)
+        js = JaxSolver(SolverOptions(backend="jax", compact_assign="on",
+                                     flat_solver="off"))
+        plan = js.solve_encoded(problem)
+        assert plan.total_cost_per_hour == dense.total_cost_per_hour
+        assert sorted((n.instance_type, tuple(sorted(n.pod_names)))
+                      for n in plan.nodes) == \
+            sorted((n.instance_type, tuple(sorted(n.pod_names)))
+                   for n in dense.nodes)
+        # growth persisted: the next solve starts at the grown floor
+        G_pad = js._prepare(problem).G_pad
+        assert js._coo_floor.get(G_pad, 0) >= 600
+
+    def test_sync_prepared_path_escalates(self, monkeypatch):
+        catalog = make_catalog()
+        problem = encode(unique_pods(500, seed=1), catalog)
+        dense = JaxSolver(SolverOptions(
+            backend="jax", compact_assign="off", flat_solver="off")
+        ).solve_encoded(problem)
+        self._forced_small(monkeypatch)
+        js = JaxSolver(SolverOptions(backend="jax", compact_assign="on",
+                                     flat_solver="off"))
+        prep = js._prepare(problem)
+        assert prep.K < 500   # genuinely undersized at dispatch
+        node_off, assign, unplaced, cost = js._solve_prepared(prep)
+        open_cost = float(
+            catalog.off_price[node_off[node_off >= 0]].sum())
+        assert abs(open_cost - dense.total_cost_per_hour) < 1e-4
+        assert int(assign.sum()) == 500
+
+
+class TestFleetEscalation:
+    def _fleet(self, C=2, pods=220):
+        from karpenter_tpu.parallel import FleetProblem
+        from karpenter_tpu.solver.jax_backend import _pad1, _pad2
+        from karpenter_tpu.solver.types import (
+            GROUP_BUCKETS, OFFERING_BUCKETS, bucket,
+        )
+
+        per = []
+        for c in range(C):
+            catalog = make_catalog()
+            prob = encode(unique_pods(pods, seed=10 + c), catalog)
+            G = bucket(prob.num_groups, GROUP_BUCKETS)
+            O = bucket(catalog.num_offerings, OFFERING_BUCKETS)
+            per.append((
+                _pad2(prob.group_req, G), _pad1(prob.group_count, G),
+                _pad1(prob.group_cap, G), _pad2(prob.compat, G, O),
+                _pad2(catalog.offering_alloc().astype(np.int32), O),
+                _pad1(catalog.off_price.astype(np.float32), O),
+                _pad1(catalog.offering_rank_price(), O)))
+        return FleetProblem(*[np.stack([p[i] for p in per])
+                              for i in range(7)])
+
+    def test_fleet_small_coo_matches_dense(self):
+        from karpenter_tpu.parallel import CooCapacity, fleet_solve_pallas
+
+        stacked = self._fleet()
+        dense = fleet_solve_pallas(stacked, num_nodes=128, interpret=True)
+        coo = CooCapacity(64, 4096)
+        small = fleet_solve_pallas(stacked, num_nodes=128, interpret=True,
+                                   coo_state=coo)
+        for a, b in zip(small, dense):
+            np.testing.assert_array_equal(a, b)
+        assert coo.k > 64   # escalated and persisted
+
+    def test_sharded_fleet_small_coo_matches_dense(self):
+        import jax
+        import pytest
+
+        if len(jax.devices()) < 2:
+            pytest.skip("needs the multi-device CPU mesh")
+        from karpenter_tpu.parallel import (
+            fleet_mesh, fleet_solve_pallas, fleet_solve_pallas_sharded,
+        )
+
+        stacked = self._fleet(C=2)
+        mesh = fleet_mesh(2)
+        dense = fleet_solve_pallas(stacked, num_nodes=128, interpret=True)
+        small = fleet_solve_pallas_sharded(
+            stacked, mesh, num_nodes=128, interpret=True, compact=64,
+            compact_cap=4096)
+        for a, b in zip(small, dense):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
