@@ -21,17 +21,12 @@ from karpenter_tpu.solver import encode
 from karpenter_tpu.solver.jax_backend import solve_kernel, _pad1, _pad2
 
 
-def build_problem(seed: int, n_pods: int, catalog: CatalogArrays,
-                  G_pad=32, O_pad=None):
-    rng = np.random.RandomState(seed)
-    sizes = [(250, 512), (500, 1024), (1000, 4096), (2000, 8192)]
-    pods = []
-    for i in range(n_pods):
-        cpu, mem = sizes[rng.randint(len(sizes))]
-        pods.append(PodSpec(f"s{seed}-p{i}", requests=ResourceRequests(cpu, mem, 0, 1)))
+def lower_padded(pods, catalog: CatalogArrays, G_pad: int, O_pad=None):
+    """encode + pad to the 7-field FleetProblem cluster layout — the one
+    copy of the lowering block every problem builder shares."""
     prob = encode(pods, catalog)
     O = catalog.num_offerings if O_pad is None else O_pad
-    return (
+    return prob, (
         _pad2(prob.group_req, G_pad),
         _pad1(prob.group_count, G_pad),
         _pad1(prob.group_cap, G_pad),
@@ -40,6 +35,17 @@ def build_problem(seed: int, n_pods: int, catalog: CatalogArrays,
         _pad1(catalog.off_price.astype(np.float32), O),
         _pad1(catalog.offering_rank_price(), O),
     )
+
+
+def build_problem(seed: int, n_pods: int, catalog: CatalogArrays,
+                  G_pad=32, O_pad=None):
+    rng = np.random.RandomState(seed)
+    sizes = [(250, 512), (500, 1024), (1000, 4096), (2000, 8192)]
+    pods = []
+    for i in range(n_pods):
+        cpu, mem = sizes[rng.randint(len(sizes))]
+        pods.append(PodSpec(f"s{seed}-p{i}", requests=ResourceRequests(cpu, mem, 0, 1)))
+    return lower_padded(pods, catalog, G_pad, O_pad)[1]
 
 
 @pytest.fixture(scope="module")
@@ -85,6 +91,106 @@ class TestFleetSolve:
         node_off, _, unplaced, cost = fleet_solve(stacked, mesh, num_nodes=N_NODES)
         assert node_off.shape == (8, N_NODES)
         assert (unplaced == 0).all()
+
+
+def build_hetero_problem(seed: int, n_pods: int, catalog: CatalogArrays,
+                         G_pad: int, O_pad: int):
+    """Near-unique request shapes -> G in the hundreds: the regime where
+    padding and tie-break bugs actually bite (VERDICT round 3 item 5 —
+    the r3 parity shapes were 60 pods x 24 types)."""
+    rng = np.random.RandomState(seed)
+    pods = [PodSpec(f"s{seed}-h{i}", requests=ResourceRequests(
+        int(rng.randint(100, 4000)), int(rng.randint(256, 16384)), 0, 1))
+        for i in range(n_pods)]
+    prob, args = lower_padded(pods, catalog, G_pad, O_pad)
+    assert prob.num_groups >= 512, prob.num_groups
+    return args
+
+
+@pytest.fixture(scope="module")
+def big_catalog():
+    # 85 types x 3 zones x 2 capacity types = 510 offerings -> O_pad 512
+    cloud = FakeCloud(profiles=generate_profiles(85))
+    pricing = PricingProvider(cloud)
+    itp = InstanceTypeProvider(cloud, pricing)
+    arrays = CatalogArrays.build(itp.list())
+    pricing.close()
+    return arrays
+
+
+class TestLargeShapeParity:
+    """Sharded-vs-unsharded bit-identical parity at G>=512 / O=512 on
+    all 8 devices, including the node-escalation procedure."""
+
+    G_PAD, O_PAD, PODS = 1024, 512, 600
+
+    @pytest.fixture(scope="class")
+    def big_fleet(self, big_catalog):
+        per = [build_hetero_problem(s, self.PODS, big_catalog,
+                                    self.G_PAD, self.O_PAD)
+               for s in range(8)]
+        return FleetProblem(*[np.stack([p[i] for p in per])
+                              for i in range(7)]), per
+
+    def test_fleet_parity_at_scale(self, big_fleet):
+        problem, per = big_fleet
+        mesh = fleet_mesh(8)
+        node_off, assign, unplaced, cost = fleet_solve(
+            problem, mesh, num_nodes=128)
+        for c, args in enumerate(per):
+            ref = solve_kernel(*[np.asarray(a) for a in args],
+                               num_nodes=128)
+            np.testing.assert_array_equal(node_off[c], np.asarray(ref[0]),
+                                          err_msg=f"cluster {c}")
+            np.testing.assert_array_equal(assign[c], np.asarray(ref[1]),
+                                          err_msg=f"cluster {c}")
+            np.testing.assert_array_equal(unplaced[c], np.asarray(ref[2]),
+                                          err_msg=f"cluster {c}")
+            assert cost[c] == pytest.approx(float(ref[3]), rel=1e-6)
+
+    def test_fleet_parity_through_escalation(self, big_fleet):
+        """Run the escalation PROCEDURE (solve small, detect overflow,
+        re-solve at 4x) on the sharded path and assert each stage is
+        bit-identical to the unsharded kernel under the same pressure."""
+        from karpenter_tpu.solver.jax_backend import needs_node_escalation
+
+        problem, per = big_fleet
+        mesh = fleet_mesh(8)
+        N = 16   # far below demand: every cluster overflows
+        node_off, assign, unplaced, cost = fleet_solve(
+            problem, mesh, num_nodes=N)
+        assert (unplaced.sum(axis=1) > 0).all()
+        for c, args in enumerate(per):
+            ref = solve_kernel(*[np.asarray(a) for a in args], num_nodes=N)
+            np.testing.assert_array_equal(node_off[c], np.asarray(ref[0]))
+            np.testing.assert_array_equal(assign[c], np.asarray(ref[1]))
+            np.testing.assert_array_equal(unplaced[c], np.asarray(ref[2]))
+            assert cost[c] == pytest.approx(float(ref[3]), rel=1e-6)
+            assert needs_node_escalation(node_off[c], unplaced[c], N, 256)
+        # escalated stage
+        node_off2, assign2, unplaced2, cost2 = fleet_solve(
+            problem, mesh, num_nodes=N * 4)
+        for c, args in enumerate(per):
+            ref = solve_kernel(*[np.asarray(a) for a in args],
+                               num_nodes=N * 4)
+            np.testing.assert_array_equal(node_off2[c], np.asarray(ref[0]))
+            np.testing.assert_array_equal(assign2[c], np.asarray(ref[1]))
+            np.testing.assert_array_equal(unplaced2[c], np.asarray(ref[2]))
+            assert cost2[c] == pytest.approx(float(ref[3]), rel=1e-6)
+
+    def test_sharded_offerings_parity_at_scale(self, big_fleet):
+        problem, per = big_fleet
+        mesh = solver_mesh(fleet=4, offer=2)
+        node_off, assign, unplaced, cost = fleet_solve_sharded_offerings(
+            problem, mesh, num_nodes=128)
+        for c, args in enumerate(per):
+            ref = solve_kernel(*[np.asarray(a) for a in args],
+                               num_nodes=128)
+            np.testing.assert_array_equal(node_off[c], np.asarray(ref[0]),
+                                          err_msg=f"cluster {c}")
+            np.testing.assert_array_equal(unplaced[c], np.asarray(ref[2]),
+                                          err_msg=f"cluster {c}")
+            assert cost[c] == pytest.approx(float(ref[3]), rel=1e-6)
 
 
 class TestShardedOfferings:
