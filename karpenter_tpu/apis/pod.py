@@ -122,6 +122,21 @@ def tolerates_all(tolerations: Tuple[Toleration, ...], taints: Tuple[Taint, ...]
     return True
 
 
+def tolerates_soft(tolerations: Tuple[Toleration, ...],
+                   taints: Tuple[Taint, ...]) -> bool:
+    """PreferNoSchedule counterpart of :func:`tolerates_all`: True when
+    every SOFT taint is tolerated.  Used for pool-preference ordering
+    (the provisioner tries soft-tainted pools last for intolerant pods),
+    never for feasibility — kube semantics: 'prefer not to schedule,
+    but allow'."""
+    for t in taints:
+        if t.effect != "PreferNoSchedule":
+            continue
+        if not any(tol.tolerates(t) for tol in tolerations):
+            return False
+    return True
+
+
 @dataclass(frozen=True)
 class TopologySpreadConstraint:
     max_skew: int = 1
@@ -165,6 +180,10 @@ class PodSpec:
     requests: ResourceRequests = field(default_factory=ResourceRequests)
     node_selector: Tuple[Tuple[str, str], ...] = ()
     required_requirements: Tuple = ()      # tuple of Requirement (nodeAffinity required)
+    # preferredDuringSchedulingIgnoredDuringExecution: (weight 1-100,
+    # Requirement) terms — soft preferences lowered to cost penalties in
+    # offering choice, never to hard masks (SURVEY §7.4)
+    preferred_requirements: Tuple = ()     # tuple of (int, Requirement)
     tolerations: Tuple[Toleration, ...] = ()
     topology_spread: Tuple[TopologySpreadConstraint, ...] = ()
     affinity: Tuple[PodAffinityTerm, ...] = ()
@@ -212,6 +231,8 @@ class PodSpec:
             tuple(sorted(self.labels)),
             tuple(sorted(self.node_selector)),
             tuple(sorted(r.signature for r in self.required_requirements)),
+            tuple(sorted((w, r.signature)
+                         for w, r in self.preferred_requirements)),
             tuple(sorted((t.key, t.operator, t.value, t.effect) for t in self.tolerations)),
             tuple(sorted((c.max_skew, c.topology_key, c.when_unsatisfiable, c.label_selector)
                          for c in self.topology_spread)),

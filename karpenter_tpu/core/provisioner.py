@@ -206,40 +206,69 @@ class Provisioner:
             return [nominated.get(pod_key(p)) for p in pods]
 
     def _provision(self, pods: List[PodSpec]) -> Tuple[List[Plan], Dict[str, str]]:
+        """Two soft-taint passes over the pool ladder (kube's
+        PreferNoSchedule semantics: 'prefer not to schedule, but
+        allow'): pass 0 offers each pool only the pods that tolerate its
+        SOFT taints; pass 1 re-offers whatever remains with the
+        preference waived — a pod lands on a soft-tainted pool only when
+        no untainted pool could host it.  Hard NoSchedule/NoExecute
+        rejection is unchanged (encode(); SURVEY §7.4 soft terms)."""
+        from karpenter_tpu.apis.pod import tolerates_soft
+
         plans: List[Plan] = []
         nominated: Dict[str, str] = {}   # pod key -> claim name
-        for pool in self._pools():
-            pool_pods = pods  # encode() rejects pods incompatible with the pool
-            nodeclass = self.cluster.get_nodeclass(pool.nodeclass_name) or \
-                self.cluster.get_nodeclass("default")
-            if nodeclass is None:
-                log.warning("no nodeclass for pool", pool=pool.name)
-                continue
-            catalog = self._catalog_for(nodeclass)
-            if catalog is None:
-                continue
-            plan = self.solver.solve(SolveRequest(pool_pods, catalog, pool))
-            if not plan.nodes:
-                continue
-            actuator = self.actuator_for(nodeclass)
-            claims, errors = actuator.execute_plan(
-                plan, nodeclass, catalog, pool.name)
-            # nominate pods onto successfully-created claims (positional)
-            for node, claim in zip(plan.nodes, claims):
-                if claim is None:
-                    continue  # create failed -> pods stay pending for retry
-                for pn in node.pod_names:
-                    self._nominate(pn, claim.name)
-                    nominated[pn] = claim.name
-            if errors:
-                log.warning("plan partially executed", pool=pool.name,
-                            errors=errors[:3])
-            plans.append(plan)
-            # pods nominated onto real claims are consumed; leftovers roll
-            # into the next pool (or stay pending for the next window)
-            pods = [p for p in pods if pod_key(p) not in nominated]
-            if not pods:
-                break
+        # pods a soft-tainted pool was denied in pass 0: ONLY these are
+        # re-offered in pass 1 — re-running the whole ladder would
+        # double every solve and re-issue failed creates within one
+        # window for clusters with no soft taints at all
+        soft_excluded: set = set()
+        for soft_pass in (0, 1):
+            if soft_pass == 1:
+                pods = [p for p in pods if pod_key(p) in soft_excluded]
+            for pool in self._pools():
+                if soft_pass == 0 and pool.taints:
+                    pool_pods = []
+                    for p in pods:
+                        if tolerates_soft(p.tolerations, pool.taints):
+                            pool_pods.append(p)
+                        else:
+                            soft_excluded.add(pod_key(p))
+                else:
+                    # encode() rejects pods incompatible with the pool
+                    pool_pods = pods
+                if not pool_pods:
+                    continue
+                nodeclass = self.cluster.get_nodeclass(pool.nodeclass_name) \
+                    or self.cluster.get_nodeclass("default")
+                if nodeclass is None:
+                    log.warning("no nodeclass for pool", pool=pool.name)
+                    continue
+                catalog = self._catalog_for(nodeclass)
+                if catalog is None:
+                    continue
+                plan = self.solver.solve(
+                    SolveRequest(pool_pods, catalog, pool))
+                if not plan.nodes:
+                    continue
+                actuator = self.actuator_for(nodeclass)
+                claims, errors = actuator.execute_plan(
+                    plan, nodeclass, catalog, pool.name)
+                # nominate pods onto successfully-created claims
+                for node, claim in zip(plan.nodes, claims):
+                    if claim is None:
+                        continue  # create failed -> pods stay pending
+                    for pn in node.pod_names:
+                        self._nominate(pn, claim.name)
+                        nominated[pn] = claim.name
+                if errors:
+                    log.warning("plan partially executed", pool=pool.name,
+                                errors=errors[:3])
+                plans.append(plan)
+                # nominated pods are consumed; leftovers roll into the
+                # next pool (or the soft-waived second pass)
+                pods = [p for p in pods if pod_key(p) not in nominated]
+                if not pods:
+                    return plans, nominated
         return plans, nominated
 
     def actuator_for(self, nodeclass: NodeClass):

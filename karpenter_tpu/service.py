@@ -172,12 +172,18 @@ class SolverServer:
         if cat is None:
             return _pack(error=np.array("unknown catalog; re-upload"))
         N = int(arrays["num_nodes"])
+        pref_rows = arrays.get("pref_rows")
+        pref_idx = arrays.get("pref_idx")
+        pref_lambda = (int(arrays["pref_lambda_bp"]) / 10000.0
+                       if "pref_lambda_bp" in arrays else None)
         with self._solver_lock:
             prep = self._jax.prepare_arrays(
                 cat, arrays["group_req"], arrays["group_count"],
                 arrays["group_cap"], arrays["compat"],
                 num_nodes=N, n_cap=int(arrays.get("n_cap", N)),
-                right_size=bool(arrays["right_size"]))
+                right_size=bool(arrays["right_size"]),
+                pref_rows=pref_rows, pref_idx=pref_idx,
+                pref_lambda=pref_lambda)
             node_off, assign, unplaced, cost = \
                 self._jax._solve_prepared(prep)
         metrics.SOLVE_DURATION.labels("sidecar").observe(
@@ -335,6 +341,18 @@ class RemoteSolver:
         N = estimate_nodes(problem, N_cap, NODE_BUCKETS) \
             if self.options.adaptive_nodes else N_cap
         cat_id, gen = self._catalog_key(catalog)
+        # soft preferences ride two extra (small) wire arrays; an old
+        # sidecar ignores unknown npz keys, degrading to plain ranking
+        pref_kw = {}
+        if problem.pref_rows is not None and problem.pref_idx is not None:
+            pidx = np.full(G, -1, np.int32)
+            pidx[:problem.pref_idx.shape[0]] = problem.pref_idx
+            pref_kw = dict(
+                pref_rows=_pad2(problem.pref_rows.astype(np.float32),
+                                problem.pref_rows.shape[0], O),
+                pref_idx=pidx,
+                pref_lambda_bp=np.int64(
+                    int(self.options.preference_lambda * 10000)))
         reuploaded = False
         while True:
             # node escalation happens SERVER-side within one RPC (the
@@ -348,7 +366,7 @@ class RemoteSolver:
                 compat=_pad2(problem.compat, G, O),
                 num_nodes=np.int64(N),
                 right_size=np.bool_(self.options.right_size),
-                n_cap=np.int64(N_cap))))
+                n_cap=np.int64(N_cap), **pref_kw)))
             if "error" in resp:
                 err = str(resp["error"])
                 # a restarted sidecar loses its catalog cache; our memo
@@ -388,6 +406,10 @@ class RemoteSolver:
             return []
         base = problems[0]
         catalog = base.catalog
+        if base.pref_rows is not None:
+            # the batch wire has no preference leaves; the per-problem
+            # Solve RPC carries them, so candidates take that path
+            return [self.solve_encoded(p) for p in problems]
         if any(p.catalog is not catalog
                or p.num_groups != base.num_groups
                or not (np.array_equal(p.group_req, base.group_req)

@@ -81,7 +81,7 @@ class EncodedProblem:
 
     __slots__ = ("groups", "group_req", "group_count", "group_cap",
                  "catalog", "rejected", "label_rows", "label_idx",
-                 "_compat")
+                 "pref_rows", "pref_idx", "_compat")
 
     def __init__(self, groups: List[PodGroup], group_req: np.ndarray,
                  group_count: np.ndarray, group_cap: np.ndarray,
@@ -89,7 +89,9 @@ class EncodedProblem:
                  catalog: Optional[CatalogArrays] = None,
                  rejected: Optional[List[str]] = None,
                  label_rows: Optional[np.ndarray] = None,
-                 label_idx: Optional[np.ndarray] = None):
+                 label_idx: Optional[np.ndarray] = None,
+                 pref_rows: Optional[np.ndarray] = None,
+                 pref_idx: Optional[np.ndarray] = None):
         self.groups = groups
         self.group_req = group_req
         self.group_count = group_count
@@ -98,7 +100,18 @@ class EncodedProblem:
         self.rejected = rejected if rejected is not None else []
         self.label_rows = label_rows
         self.label_idx = label_idx
+        # soft preferences, factored like label rows: pref_rows float32
+        # [P, O] (weighted miss fraction, 0 = fully preferred) + pref_idx
+        # int32 [G] (-1 = no preferences).  None when NO group carries
+        # preferences — the common case, and the gate for the
+        # pallas/flat fast paths (the scan path owns penalty ranking).
+        self.pref_rows = pref_rows
+        self.pref_idx = pref_idx
         self._compat = compat
+
+    @property
+    def has_preferences(self) -> bool:
+        return self.pref_rows is not None
 
     @property
     def compat(self) -> np.ndarray:
@@ -123,7 +136,8 @@ class EncodedProblem:
                       group_count=self.group_count, group_cap=self.group_cap,
                       compat=self._compat, catalog=self.catalog,
                       rejected=self.rejected, label_rows=self.label_rows,
-                      label_idx=self.label_idx)
+                      label_idx=self.label_idx, pref_rows=self.pref_rows,
+                      pref_idx=self.pref_idx)
         fields.update(kw)
         return EncodedProblem(**fields)
 
@@ -215,6 +229,79 @@ def _label_compat(reqs: Requirements, catalog: CatalogArrays,
     if cache is not None:
         cache[combined_key] = mask
     return mask
+
+
+# weight of one ScheduleAnyway zone-spread term in the soft-preference
+# blend (kube's scoring plugins weigh spread comparably to the strongest
+# preferred-affinity term, which caps at 100)
+SOFT_SPREAD_WEIGHT = 100
+
+
+def _req_offering_mask(r, catalog: CatalogArrays,
+                       cache: Optional[Dict] = None) -> Optional[np.ndarray]:
+    """bool [O]: offerings satisfying ONE requirement, for preference
+    scoring.  Keys the catalog cannot express return None (constant over
+    offerings — irrelevant to ranking within a solve)."""
+    one = Requirements([r])
+    if r.key == LABEL_INSTANCE_TYPE:
+        return _allowed_mask(one, r.key, catalog.type_names,
+                             cache)[catalog.off_type]
+    if r.key == LABEL_ARCH:
+        return _allowed_mask(one, r.key, catalog.archs,
+                             cache)[catalog.type_arch[catalog.off_type]]
+    if r.key == LABEL_INSTANCE_FAMILY:
+        return _allowed_mask(one, r.key, catalog.families,
+                             cache)[catalog.type_family[catalog.off_type]]
+    if r.key == LABEL_INSTANCE_SIZE:
+        return _allowed_mask(one, r.key, catalog.sizes,
+                             cache)[catalog.type_size[catalog.off_type]]
+    if r.key == LABEL_CAPACITY_TYPE:
+        return _allowed_mask(one, r.key, list(CAPACITY_TYPES),
+                             cache)[catalog.off_cap]
+    if r.key == LABEL_ZONE:
+        return _allowed_mask(one, r.key, catalog.zones,
+                             cache)[catalog.off_zone]
+    return None
+
+
+def _lower_preferred(preferred, catalog: CatalogArrays,
+                     cache: Optional[Dict] = None):
+    """(terms, total_weight) where terms = [(weight, satisfied_mask)] —
+    the per-signature half of the preference penalty; the per-subgroup
+    soft-spread term joins in :func:`_pref_miss_row`."""
+    terms = []
+    total = 0
+    for w, r in preferred:
+        sat = _req_offering_mask(r, catalog, cache)
+        if sat is None:
+            continue
+        terms.append((int(w), sat))
+        total += int(w)
+    return terms, total
+
+
+def _pref_miss_row(terms, total_w: int, soft_zone: Optional[str],
+                   catalog: CatalogArrays) -> Optional[np.ndarray]:
+    """float32 [O] in [0,1]: weighted fraction of UNSATISFIED preference
+    terms per offering (0 = fully preferred).  None when the group has
+    no scoreable preferences."""
+    tw = total_w + (SOFT_SPREAD_WEIGHT if soft_zone is not None else 0)
+    if tw == 0:
+        return None
+    miss = np.zeros(catalog.num_offerings, np.float32)
+    for w, sat in terms:
+        miss += w * (~sat)
+    if soft_zone is not None:
+        zi = catalog.zones.index(soft_zone) if soft_zone in catalog.zones \
+            else -1
+        miss += SOFT_SPREAD_WEIGHT * (catalog.off_zone != zi)
+    return miss / tw
+
+
+def _soft_zone_spread(pod: PodSpec):
+    return [c for c in pod.topology_spread
+            if c.topology_key == LABEL_ZONE
+            and c.when_unsatisfiable == "ScheduleAnyway"]
 
 
 def _fit_mask(req_vec, catalog: CatalogArrays) -> np.ndarray:
@@ -376,9 +463,24 @@ def _encode_impl(pods: Sequence[PodSpec], catalog: CatalogArrays,
     g_count: List[int] = []                # assembled vectorized below
     g_cap: List[int] = []
     g_label: List[int] = []
+    g_pref: List[int] = []                 # index into pref row set; -1 = none
     g_name: List[str] = []
     row_keys: Dict[Tuple, int] = {}
     rows: List[np.ndarray] = []
+    pref_row_keys: Dict[bytes, int] = {}
+    pref_rows_l: List[np.ndarray] = []
+
+    def pref_for(terms, total_w, soft_zone) -> int:
+        row = _pref_miss_row(terms, total_w, soft_zone, catalog)
+        if row is None:
+            return -1
+        key = row.tobytes()
+        pi = pref_row_keys.get(key)
+        if pi is None:
+            pi = len(pref_rows_l)
+            pref_rows_l.append(row)
+            pref_row_keys[key] = pi
+        return pi
     cache_ok = nodepool is _DEFAULT_POOL
     gen_key = (catalog.uid, catalog.generation, catalog.availability_generation)
     if cache_ok and _SIG_LOWER_CACHE and \
@@ -413,7 +515,8 @@ def _encode_impl(pods: Sequence[PodSpec], catalog: CatalogArrays,
         rep = members[0]
         hit = _SIG_LOWER_CACHE.get((sig,) + gen_key) if cache_ok else None
         if hit is not None:
-            reqs, unsat_flag, cap, label, nozone, live_zones, zone_sig = hit
+            (reqs, unsat_flag, cap, label, nozone, live_zones, zone_sig,
+             pref) = hit
             if unsat_flag:
                 rejected.extend(pod_key(p) for p in members)
                 continue
@@ -430,7 +533,7 @@ def _encode_impl(pods: Sequence[PodSpec], catalog: CatalogArrays,
                 if cache_ok:
                     _SIG_LOWER_CACHE[(sig,) + gen_key] = (reqs, True, cap,
                                                           None, None, None,
-                                                          None)
+                                                          None, None)
                 rejected.extend(pod_key(p) for p in members)
                 continue
             label = _label_compat(reqs, catalog, mask_cache)
@@ -439,20 +542,27 @@ def _encode_impl(pods: Sequence[PodSpec], catalog: CatalogArrays,
                                       cache=mask_cache)
             zone_sig = tuple(sorted(r.signature
                                     for r in reqs.get(LABEL_ZONE)))
+            pref = _lower_preferred(rep.preferred_requirements, catalog,
+                                    mask_cache) \
+                if rep.preferred_requirements else ([], 0)
             if cache_ok:
                 _SIG_LOWER_CACHE[(sig,) + gen_key] = (reqs, False, cap,
                                                       label, nozone,
-                                                      live_zones, zone_sig)
+                                                      live_zones, zone_sig,
+                                                      pref)
         req = rep.requests.as_tuple()
         # every pod occupies >=1 pod slot: keeps per-node assignment
         # counts bounded by the offering's pod-slot allocatable
         req_row = (req[0], req[1], req[2], max(req[3], 1))
         cap_i32 = min(cap, np.iinfo(np.int32).max)
-        spread = _zone_spread_constraints(rep)
-        if spread and len(live_zones) > 1:
-            # split into per-zone pinned subgroups, evenly (skew <= 1),
-            # over zones that can actually host the group
-            zones = live_zones
+        pref_terms, pref_w = pref
+
+        def split_subgroups(zones, pinned: bool):
+            """Per-zone even split (skew <= 1) shared by the HARD spread
+            (DoNotSchedule: subgroups zone-PINNED into compat) and the
+            SOFT spread (ScheduleAnyway: subgroups zone-PREFERRED via a
+            penalty term — capacity shortfall degrades spread instead of
+            stranding pods; SURVEY §7.4 soft terms become cost)."""
             counts = _split_counts(len(members), len(zones))
             offset = 0
             for zone, cnt in zip(zones, counts):
@@ -460,17 +570,27 @@ def _encode_impl(pods: Sequence[PodSpec], catalog: CatalogArrays,
                     continue
                 sub = members[offset:offset + cnt]
                 offset += cnt
-                sub_reqs = Requirements(list(reqs.items))
                 groups.append(PodGroup(
                     representative=rep, pod_names=[pod_key(p) for p in sub],
-                    count=cnt, requirements=sub_reqs, cap_per_node=cap,
-                    pinned_zone=zone, spread_origin=sig, nozone_mask=nozone,
+                    count=cnt,
+                    requirements=Requirements(list(reqs.items)) if pinned
+                    else reqs,
+                    cap_per_node=cap,
+                    pinned_zone=zone if pinned else None,
+                    spread_origin=sig, nozone_mask=nozone,
                     label_mask=label))
                 g_req.append(req_row)
                 g_count.append(cnt)
                 g_cap.append(cap_i32)
-                g_label.append(row_for(label, zone_sig, zone, reqs))
+                g_label.append(row_for(label, zone_sig,
+                                       zone if pinned else None, reqs))
+                g_pref.append(pref_for(pref_terms, pref_w,
+                                       None if pinned else zone))
                 g_name.append(groups[-1].pod_names[0])
+
+        spread = _zone_spread_constraints(rep)
+        if spread and len(live_zones) > 1:
+            split_subgroups(live_zones, pinned=True)
         elif _has_zone_affinity(rep) and len(live_zones) > 1:
             # co-schedule in one zone: an explicit candidate override wins
             # (zonesplit refinement); default pin is the zone with the
@@ -487,7 +607,13 @@ def _encode_impl(pods: Sequence[PodSpec], catalog: CatalogArrays,
             g_count.append(len(members))
             g_cap.append(cap_i32)
             g_label.append(row_for(label, zone_sig, best, reqs))
+            g_pref.append(pref_for(pref_terms, pref_w, None))
             g_name.append(groups[-1].pod_names[0])
+        elif _soft_zone_spread(rep) and len(live_zones) > 1:
+            # soft spread ranks BELOW hard spread and below zone
+            # co-scheduling affinity (a hard term must never be diluted
+            # into a preference by the presence of a soft one)
+            split_subgroups(live_zones, pinned=False)
         else:
             groups.append(PodGroup(
                 representative=rep, pod_names=[pod_key(p) for p in members],
@@ -497,6 +623,7 @@ def _encode_impl(pods: Sequence[PodSpec], catalog: CatalogArrays,
             g_count.append(len(members))
             g_cap.append(cap_i32)
             g_label.append(row_for(label, zone_sig, None, reqs))
+            g_pref.append(pref_for(pref_terms, pref_w, None))
             g_name.append(groups[-1].pod_names[0])
 
     # 4. FFD order: descending dominant size (deterministic tie-break on
@@ -508,6 +635,7 @@ def _encode_impl(pods: Sequence[PodSpec], catalog: CatalogArrays,
     group_count = np.asarray(g_count, dtype=np.int32)
     group_cap = np.asarray(g_cap, dtype=np.int32)
     label_idx = np.asarray(g_label, dtype=np.int32)
+    pref_idx = np.asarray(g_pref, dtype=np.int32)
     if G:
         shares = np.where(mean_alloc[None, :] > 0,
                           group_req.astype(np.float64)
@@ -519,16 +647,20 @@ def _encode_impl(pods: Sequence[PodSpec], catalog: CatalogArrays,
         group_count = group_count[order]
         group_cap = group_cap[order]
         label_idx = label_idx[order]
+        pref_idx = pref_idx[order]
 
     label_rows = (np.stack(rows) if rows
                   else np.zeros((0, O), dtype=bool))
+    has_pref = bool(pref_rows_l)
     # compat (label row & per-group resource fit) stays LAZY — the
     # device rebuilds it from this exact factoring, and host consumers
     # force it on demand (EncodedProblem.compat)
     return EncodedProblem(
         groups=groups, group_req=group_req, group_count=group_count,
         group_cap=group_cap, compat=None, catalog=catalog,
-        rejected=rejected, label_rows=label_rows, label_idx=label_idx)
+        rejected=rejected, label_rows=label_rows, label_idx=label_idx,
+        pref_rows=np.stack(pref_rows_l) if has_pref else None,
+        pref_idx=pref_idx if has_pref else None)
 
 
 def estimate_nodes(problem: EncodedProblem, n_cap: int,

@@ -318,6 +318,9 @@ def flat_viable(problem: EncodedProblem, options) -> bool:
     if problem.label_rows is None or problem.label_idx is None \
             or problem.label_rows.shape[0] != 1:
         return False
+    if problem.pref_rows is not None:
+        # soft preferences need penalty ranking — the scan path owns it
+        return False
     if not (problem.group_cap >= np.minimum(
             problem.group_count, BIG_CAP)).all():
         return False   # per-node caps (anti-affinity) need the scan path

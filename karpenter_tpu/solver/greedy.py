@@ -109,7 +109,10 @@ class GreedySolver:
         return plan
 
     def solve_encoded(self, problem: EncodedProblem) -> Plan:
-        if self.options.use_native != "off":
+        if self.options.use_native != "off" \
+                and problem.pref_rows is None:
+            # the C++ twin has no preference-penalty ranking; soft
+            # constraints route to the python oracle
             plan = self._solve_native(problem)
             if plan is not None:
                 return plan
@@ -162,6 +165,15 @@ class GreedySolver:
             req = problem.group_req[gi].astype(np.int64)
             cap = int(problem.group_cap[gi])
             compat = problem.compat[gi]
+            # soft preferences: penalty-ranked pricing for the new-node
+            # choice (same rank_g = rank * (1 + lambda * miss) blend the
+            # device scan applies); real cost accounting untouched
+            rank_g = off_rank
+            if problem.pref_rows is not None \
+                    and int(problem.pref_idx[gi]) >= 0:
+                miss = problem.pref_rows[int(problem.pref_idx[gi])]
+                lam = getattr(self.options, "preference_lambda", 0.15)
+                rank_g = off_rank * (1.0 + lam * miss.astype(np.float64))
             remaining = list(group.pod_names)
 
             # fill open nodes in age order (first-fit)
@@ -199,7 +211,7 @@ class GreedySolver:
                 0)
             fit_empty = np.minimum(fit_empty, min(cap, len(remaining)))
             with np.errstate(divide="ignore", invalid="ignore"):
-                cost_per_pod = np.where(fit_empty > 0, off_rank / fit_empty, np.inf)
+                cost_per_pod = np.where(fit_empty > 0, rank_g / fit_empty, np.inf)
             best_off = int(np.argmin(cost_per_pod))
             best_fit = int(fit_empty[best_off])
             if best_fit <= 0:

@@ -140,13 +140,20 @@ def _ffd_step(off_alloc, off_rank, state, inputs):
     return (node_off, node_resid, ptr), (assign_g, unplaced_g)
 
 
-def _right_size(node_off, load, assign, compat, off_alloc, off_rank):
+def _right_size(node_off, load, assign, compat, off_alloc, off_rank,
+                miss_g=None, pref_lambda: float = 0.0):
     """Per-node cheapest compatible offering that fits the final load
     (``load`` [N,R] = resources actually consumed on each node).
 
     Feasibility-preserving by construction: the load already fits and every
     group on the node admits the new offering (zone pins and availability
-    are part of ``compat``)."""
+    are part of ``compat``).
+
+    With soft preferences (``miss_g`` float32 [G,O], weighted unsatisfied
+    fraction per group), ranking uses the presence-averaged node penalty:
+    rank_eff[n,o] = rank[o] * (1 + lambda * mean_g-on-n miss_g[o]) — the
+    cost-term form of preferred affinity / ScheduleAnyway (SURVEY §7.4;
+    hard-mask semantics untouched)."""
     N = node_off.shape[0]
     is_open = node_off >= 0
     safe_off = jnp.clip(node_off, 0, None)
@@ -158,10 +165,20 @@ def _right_size(node_off, load, assign, compat, off_alloc, off_rank):
     all_compat = incompat_count < 0.5                        # [N, O]
     fits = jnp.all(off_alloc[None, :, :] >= load[:, None, :], axis=2)  # [N, O]
     candidate = all_compat & fits & is_open[:, None]
-    cand_price = jnp.where(candidate, off_rank[None, :], jnp.inf)
+    if miss_g is not None:
+        cnt_node = jnp.maximum(jnp.sum(present, axis=0), 1.0)      # [N]
+        miss_node = jnp.einsum("gn,go->no", present, miss_g,
+                               preferred_element_type=jnp.float32) \
+            / cnt_node[:, None]                                     # [N, O]
+        rank_eff = off_rank[None, :] * (1.0 + pref_lambda * miss_node)
+    else:
+        rank_eff = jnp.broadcast_to(off_rank[None, :],
+                                    (N, off_rank.shape[0]))
+    cand_price = jnp.where(candidate, rank_eff, jnp.inf)
     best = jnp.argmin(cand_price, axis=1).astype(jnp.int32)  # [N]
     best_price = jnp.min(cand_price, axis=1)
-    cur_price = off_rank[safe_off]
+    cur_price = jnp.take_along_axis(rank_eff, safe_off[:, None],
+                                    axis=1)[:, 0]
     improve = is_open & (best_price < cur_price - 1e-9)
     return jnp.where(improve, best, node_off)
 
@@ -407,6 +424,27 @@ def solve_packed(packed, off_alloc, off_price, off_rank, *, G: int, O: int,
 
 
 @functools.partial(jax.jit,
+                   static_argnames=("G", "O", "U", "N", "P", "right_size",
+                                    "compact", "dense16", "lam_bp"))
+def solve_packed_pref(packed, pref_rows, pref_idx, off_alloc, off_price,
+                      off_rank, *, G: int, O: int, U: int, N: int, P: int,
+                      right_size: bool = True, compact: int = 0,
+                      dense16: bool = False, lam_bp: int = 1500):
+    """Packed solve with soft-preference penalty ranking (scan path; the
+    pallas/flat fast paths gate off when preferences are present).  Two
+    extra small leaves carry the factored preference rows; ``lam_bp`` is
+    the penalty weight in basis points (SolverOptions.preference_lambda
+    x 10000, static — a handful of distinct values per process)."""
+    meta, compat_i = _unpack_problem(packed, off_alloc, G, O, U)
+    node_off, assign, unplaced, cost = solve_core(
+        meta[:, :4], meta[:, 4], meta[:, 5], compat_i > 0,
+        off_alloc, off_price, off_rank, num_nodes=N,
+        right_size=right_size, pref_rows=pref_rows, pref_idx=pref_idx,
+        pref_lambda=lam_bp / 10000.0)
+    return _pack_result(node_off, assign, unplaced, cost, compact, dense16)
+
+
+@functools.partial(jax.jit,
                    static_argnames=("G", "O", "U", "N", "right_size",
                                     "compact", "dense16"))
 def solve_packed_batch(packed_rows, off_alloc, off_price, off_rank, *,
@@ -451,21 +489,44 @@ def solve_packed_pallas(packed, alloc8, rank_row, off_price, *, G: int,
 
 def solve_core(group_req, group_count, group_cap, compat,
                off_alloc, off_price, off_rank, *, num_nodes: int,
-               right_size: bool = True):
+               right_size: bool = True, pref_rows=None, pref_idx=None,
+               pref_lambda: float = 0.15):
     """Un-jitted solve body — vmap/shard_map it for fleet-scale solves
-    (parallel/fleet.py); ``solve_kernel`` is the single-problem jit."""
+    (parallel/fleet.py); ``solve_kernel`` is the single-problem jit.
+
+    Soft preferences (``pref_rows`` [P,O] miss fractions + ``pref_idx``
+    [G], -1 = none) scale the RANKING price per group:
+    rank_g = rank * (1 + lambda * miss) — preferred offerings win
+    cost-comparable choices, real cost accounting (off_price) is
+    untouched.  The scan path owns preferences; pallas/flat gate off."""
     N = num_nodes
     R = group_req.shape[1]
     node_off0 = jnp.full((N,), -1, dtype=jnp.int32)
     node_resid0 = jnp.zeros((N, R), dtype=jnp.int32)
-    step = functools.partial(_ffd_step, off_alloc, off_rank)
+    miss_g = None
+    if pref_rows is not None and pref_idx is not None:
+        P = pref_rows.shape[0]
+        miss_g = jnp.where((pref_idx >= 0)[:, None],
+                           pref_rows[jnp.clip(pref_idx, 0, P - 1)],
+                           0.0)                                   # [G, O]
+
+        def step(state, inputs):
+            req, count, cap, compat_g, miss_row = inputs
+            rank_g = off_rank * (1.0 + pref_lambda * miss_row)
+            return _ffd_step(off_alloc, rank_g, state,
+                             (req, count, cap, compat_g))
+
+        xs = (group_req, group_count, group_cap, compat, miss_g)
+    else:
+        step = functools.partial(_ffd_step, off_alloc, off_rank)
+        xs = (group_req, group_count, group_cap, compat)
     (node_off, node_resid, ptr), (assign, unplaced) = lax.scan(
-        step, (node_off0, node_resid0, jnp.int32(0)),
-        (group_req, group_count, group_cap, compat))
+        step, (node_off0, node_resid0, jnp.int32(0)), xs)
     if right_size:
         load = off_alloc[jnp.clip(node_off, 0, None)] - node_resid
         node_off = _right_size(node_off, load, assign,
-                               compat, off_alloc, off_rank)
+                               compat, off_alloc, off_rank,
+                               miss_g=miss_g, pref_lambda=pref_lambda)
     is_open = node_off >= 0
     cost = jnp.sum(jnp.where(is_open, off_price[jnp.clip(node_off, 0, None)], 0.0))
     return node_off, assign, unplaced, cost
@@ -538,10 +599,11 @@ class _Prepared:
 
     __slots__ = ("catalog", "G_pad", "O_pad", "U_pad", "N", "N_cap", "K0",
                  "K_cap", "K", "dense16_ok", "dense16", "packed",
-                 "right_size")
+                 "right_size", "pref_rows", "pref_idx", "pref_lambda")
 
     def __init__(self, *, catalog, G_pad, O_pad, U_pad, N, N_cap, K0, packed,
-                 K_cap=None, dense16_ok=False, right_size=None):
+                 K_cap=None, dense16_ok=False, right_size=None,
+                 pref_rows=None, pref_idx=None, pref_lambda=None):
         self.catalog = catalog
         self.G_pad = G_pad
         self.O_pad = O_pad
@@ -556,6 +618,13 @@ class _Prepared:
         # None = use the solver's SolverOptions; the sidecar overrides
         # per request (the wire flag must win over the server's defaults)
         self.right_size = right_size
+        # soft-preference leaves (padded); None = no preferences — the
+        # gate for the pallas fast path (scan owns penalty ranking).
+        # pref_lambda None = the solver's SolverOptions value (the
+        # sidecar wire flag must win over server defaults)
+        self.pref_rows = pref_rows
+        self.pref_idx = pref_idx
+        self.pref_lambda = pref_lambda
 
 
 class JaxSolver:
@@ -706,7 +775,8 @@ class JaxSolver:
 
     def prepare_arrays(self, catalog, group_req, group_count, group_cap,
                        compat, num_nodes: int, n_cap: int,
-                       right_size=None) -> "_Prepared":
+                       right_size=None, pref_rows=None, pref_idx=None,
+                       pref_lambda=None) -> "_Prepared":
         """Build a _Prepared from ALREADY-PADDED arrays (the sidecar's
         wire format) against any catalog-like object exposing
         uid/generation/availability_generation/num_offerings/
@@ -722,11 +792,21 @@ class JaxSolver:
         max_slots = int(catalog.offering_alloc()[:, 3].max()) \
             if catalog.num_offerings else 1
         K0, K_cap = self._compact_k(total_pods, G_pad)
+        if pref_rows is not None:
+            P_pad = bucket(pref_rows.shape[0], (4, 16, 64, 256))
+            pref_rows = _pad2(np.asarray(pref_rows, np.float32),
+                              P_pad, O_pad)
+            idx = np.full(G_pad, -1, np.int32)   # padding groups: no pref
+            if pref_idx is not None:
+                src = np.asarray(pref_idx, np.int32)
+                idx[:src.shape[0]] = src
+            pref_idx = idx
         return _Prepared(catalog=catalog, G_pad=G_pad, O_pad=O_pad,
                          U_pad=U_pad, N=num_nodes, N_cap=n_cap,
                          K0=K0, K_cap=K_cap, packed=packed,
                          dense16_ok=max_slots < (1 << 15),
-                         right_size=right_size)
+                         right_size=right_size, pref_rows=pref_rows,
+                         pref_idx=pref_idx, pref_lambda=pref_lambda)
 
     def solve_encoded_batch(self, problems: List[EncodedProblem]
                             ) -> List[Plan]:
@@ -737,7 +817,8 @@ class JaxSolver:
         if not problems:
             return []
         catalog = problems[0].catalog
-        if any(p.catalog is not catalog for p in problems[1:]):
+        if any(p.catalog is not catalog for p in problems[1:]) \
+                or any(p.pref_rows is not None for p in problems):
             return [self.solve_encoded(p) for p in problems]
         # one common label-row bucket across candidates (their U differs
         # by at most one appended row) so the stacked buffers share length
@@ -858,9 +939,17 @@ class JaxSolver:
         # every offering's pod-slot capacity provably bounds assign cells
         # below 2^15 (same bound the old int16 assign_dtype used)
         max_slots = int(catalog.offering_alloc()[:, 3].max()) if O else 1
+        pref_rows = pref_idx = None
+        if problem.pref_rows is not None and problem.pref_idx is not None:
+            P_pad = bucket(problem.pref_rows.shape[0], (4, 16, 64, 256))
+            pref_rows = _pad2(problem.pref_rows.astype(np.float32),
+                              P_pad, O_pad)
+            pref_idx = np.full(G_pad, -1, np.int32)
+            pref_idx[:problem.pref_idx.shape[0]] = problem.pref_idx
         return _Prepared(catalog=catalog, G_pad=G_pad, O_pad=O_pad,
                          U_pad=U_pad, N=N, N_cap=N_cap, K0=K0, K_cap=K_cap,
-                         packed=packed, dense16_ok=max_slots < (1 << 15))
+                         packed=packed, dense16_ok=max_slots < (1 << 15),
+                         pref_rows=pref_rows, pref_idx=pref_idx)
 
     def _dispatch(self, prep: "_Prepared", arr):
         """Issue the packed solve (pallas with scan fallback).  ``arr`` is
@@ -868,6 +957,26 @@ class JaxSolver:
         device-resident buffer.  Returns (device output, path name)."""
         catalog, G_pad, O_pad = prep.catalog, prep.G_pad, prep.O_pad
         N = prep.N
+        if prep.pref_rows is not None:
+            # soft preferences: penalty-ranked scan path (pallas carries
+            # no per-group rank rows; preferences are rare enough that
+            # the fast path stays clean)
+            off_alloc, off_price, off_rank = self._device_offerings(
+                catalog, O_pad)
+            prep.K, prep.dense16 = clamp_output_opts(
+                prep.K0, prep.dense16_ok, G_pad, N)
+            rs = self.options.right_size if prep.right_size is None \
+                else prep.right_size
+            lam = self.options.preference_lambda \
+                if prep.pref_lambda is None else prep.pref_lambda
+            out = solve_packed_pref(
+                arr, prep.pref_rows, prep.pref_idx,
+                off_alloc, off_price, off_rank,
+                G=G_pad, O=O_pad, U=prep.U_pad, N=N,
+                P=prep.pref_rows.shape[0], right_size=rs,
+                compact=prep.K, dense16=prep.dense16,
+                lam_bp=int(lam * 10000))
+            return out, "scan-pref"
         # pallas needs a 128-multiple node axis; never exceed the
         # configured cap to get one — fall back to the scan path instead
         Np = max(N, 128)

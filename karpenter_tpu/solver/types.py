@@ -50,6 +50,10 @@ class SolverOptions:
     flat_min_groups: int = 2048     # G threshold for the flat path (below
                                     # it the G-sequential scan/pallas
                                     # kernels are faster AND FFD-exact)
+    preference_lambda: float = 0.15  # soft-preference penalty weight: a
+                                    # fully non-preferred offering ranks
+                                    # as (1+lambda)x its price; real cost
+                                    # accounting is never touched
     address: str = ""               # backend "remote": solver sidecar
                                     # gRPC address (host:port)
 

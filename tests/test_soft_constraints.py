@@ -1,0 +1,239 @@
+"""Soft scheduling preferences as cost terms (VERDICT round 3 item 7):
+preferred node affinity, ScheduleAnyway zone spread, and PreferNoSchedule
+pool taints.  Hard-mask semantics must be untouched; preferences steer
+RANKING only (real cost accounting unchanged)."""
+import numpy as np
+
+from karpenter_tpu.apis.pod import (
+    PodSpec, ResourceRequests, Taint, Toleration,
+    TopologySpreadConstraint,
+)
+from karpenter_tpu.apis.requirements import (
+    LABEL_CAPACITY_TYPE, LABEL_ZONE, Operator, Requirement,
+)
+from karpenter_tpu.catalog import CatalogArrays, InstanceTypeProvider, PricingProvider
+from karpenter_tpu.cloud.fake import FakeCloud, generate_profiles
+from karpenter_tpu.solver import (
+    GreedySolver, JaxSolver, SolveRequest, encode, validate_plan,
+)
+from karpenter_tpu.solver.types import SolverOptions
+
+
+def make_catalog(n=12):
+    cloud = FakeCloud(profiles=generate_profiles(n))
+    pricing = PricingProvider(cloud)
+    itp = InstanceTypeProvider(cloud, pricing)
+    catalog = CatalogArrays.build(itp.list())
+    pricing.close()
+    return catalog
+
+
+def pods_pref_zone(n, zone, weight=100):
+    return [PodSpec(
+        f"p{i}", requests=ResourceRequests(500, 1024, 0, 1),
+        preferred_requirements=((weight, Requirement(
+            LABEL_ZONE, Operator.IN, (zone,))),))
+        for i in range(n)]
+
+
+class TestPreferredAffinity:
+    def test_zone_preference_honored_at_equal_cost(self):
+        # zones are price-identical in the fake catalog: the preferred
+        # zone must win every node
+        catalog = make_catalog()
+        zone = catalog.zones[1]
+        pods = pods_pref_zone(40, zone)
+        for solver in (JaxSolver(), GreedySolver()):
+            plan = solver.solve(SolveRequest(pods, catalog))
+            assert validate_plan(plan, pods, catalog) == []
+            assert plan.nodes and all(n.zone == zone for n in plan.nodes), \
+                solver.__class__.__name__
+
+    def test_preference_never_blocks_placement(self):
+        # preference names a zone that doesn't exist: pods still place
+        catalog = make_catalog()
+        pods = pods_pref_zone(10, "mars-east-1")
+        plan = JaxSolver().solve(SolveRequest(pods, catalog))
+        assert not plan.unplaced_pods
+        assert validate_plan(plan, pods, catalog) == []
+
+    def test_scan_matches_penalty_oracle(self):
+        # right_size off: the scan path and the python oracle share the
+        # penalty blend exactly -> identical node multiset + cost
+        catalog = make_catalog()
+        zone = catalog.zones[2]
+        pods = pods_pref_zone(60, zone)
+        problem = encode(pods, catalog)
+        jp = JaxSolver(SolverOptions(backend="jax", right_size=False)
+                       ).solve_encoded(problem)
+        gp = GreedySolver(SolverOptions(backend="greedy", right_size=False)
+                          ).solve_encoded(problem)
+        assert sorted((n.instance_type, n.zone, n.capacity_type,
+                       len(n.pod_names)) for n in jp.nodes) == \
+            sorted((n.instance_type, n.zone, n.capacity_type,
+                    len(n.pod_names)) for n in gp.nodes)
+        assert abs(jp.total_cost_per_hour - gp.total_cost_per_hour) < 1e-4
+
+    def test_strong_price_signal_beats_weak_preference(self):
+        # preferring on-demand at lambda=0.15 must NOT override spot's
+        # much larger discount — preferences are tie-breakers, not masks
+        catalog = make_catalog()
+        pods = [PodSpec(
+            f"p{i}", requests=ResourceRequests(500, 1024, 0, 1),
+            preferred_requirements=((50, Requirement(
+                LABEL_CAPACITY_TYPE, Operator.IN, ("on-demand",))),))
+            for i in range(20)]
+        plan = JaxSolver().solve(SolveRequest(pods, catalog))
+        assert plan.nodes and all(n.capacity_type == "spot"
+                                  for n in plan.nodes)
+
+
+class TestHardTermsUntouched:
+    def test_zone_affinity_beats_soft_spread(self):
+        # a hard co-scheduling zone-affinity term combined with a SOFT
+        # spread must stay co-scheduled: the soft term can never dilute
+        # a hard one into a preference (review round 4 finding)
+        from karpenter_tpu.apis.pod import PodAffinityTerm
+
+        catalog = make_catalog()
+        sel = (("app", "db"),)
+        pods = [PodSpec(
+            f"a{i}", requests=ResourceRequests(500, 1024, 0, 1),
+            labels=sel,
+            affinity=(PodAffinityTerm(label_selector=sel,
+                                      topology_key=LABEL_ZONE),),
+            topology_spread=(TopologySpreadConstraint(
+                max_skew=1, when_unsatisfiable="ScheduleAnyway"),))
+            for i in range(20)]
+        plan = JaxSolver().solve(SolveRequest(pods, catalog))
+        assert not plan.unplaced_pods
+        assert validate_plan(plan, pods, catalog) == []
+        assert len({n.zone for n in plan.nodes}) == 1
+
+    def test_hard_spread_beats_soft_spread(self):
+        catalog = make_catalog()
+        pods = [PodSpec(
+            f"b{i}", requests=ResourceRequests(500, 1024, 0, 1),
+            topology_spread=(
+                TopologySpreadConstraint(max_skew=1),
+                TopologySpreadConstraint(
+                    max_skew=1, when_unsatisfiable="ScheduleAnyway")))
+            for i in range(30)]
+        plan = JaxSolver().solve(SolveRequest(pods, catalog))
+        assert validate_plan(plan, pods, catalog) == []  # hard skew holds
+
+
+class TestRemotePreferences:
+    def test_sidecar_honors_preference_penalty(self):
+        from karpenter_tpu.service import RemoteSolver, SolverServer
+
+        server = SolverServer(port=0).start()
+        client = RemoteSolver(f"127.0.0.1:{server.port}")
+        try:
+            catalog = make_catalog()
+            zone = catalog.zones[1]
+            pods = pods_pref_zone(30, zone)
+            plan = client.solve(SolveRequest(pods, catalog))
+            assert plan.nodes and all(n.zone == zone for n in plan.nodes)
+        finally:
+            client.close()
+            server.stop()
+
+
+class TestScheduleAnywaySpread:
+    def test_spreads_across_zones_at_equal_cost(self):
+        catalog = make_catalog()
+        pods = [PodSpec(
+            f"s{i}", requests=ResourceRequests(500, 1024, 0, 1),
+            topology_spread=(TopologySpreadConstraint(
+                max_skew=1, when_unsatisfiable="ScheduleAnyway"),))
+            for i in range(30)]
+        plan = JaxSolver().solve(SolveRequest(pods, catalog))
+        assert not plan.unplaced_pods
+        assert validate_plan(plan, pods, catalog) == []
+        zones = {n.zone for n in plan.nodes}
+        assert len(zones) >= 2, f"no spread: {zones}"
+
+    def test_soft_spread_is_not_a_mask(self):
+        # zone-restrict the pods to ONE zone via hard selector; the soft
+        # spread must not strand them (DoNotSchedule couldn't either
+        # here, but the soft path must not pin subgroups hard)
+        catalog = make_catalog()
+        zone = catalog.zones[0]
+        pods = [PodSpec(
+            f"s{i}", requests=ResourceRequests(500, 1024, 0, 1),
+            node_selector=((LABEL_ZONE, zone),),
+            topology_spread=(TopologySpreadConstraint(
+                max_skew=1, when_unsatisfiable="ScheduleAnyway"),))
+            for i in range(20)]
+        plan = JaxSolver().solve(SolveRequest(pods, catalog))
+        assert not plan.unplaced_pods
+        assert all(n.zone == zone for n in plan.nodes)
+
+
+class TestPreferNoScheduleTaints:
+    def _rig(self):
+        from tests.test_core import ready_nodeclass
+        from karpenter_tpu.apis.nodeclaim import NodePool
+        from karpenter_tpu.catalog.unavailable import UnavailableOfferings
+        from karpenter_tpu.cloud.fake import FakeCloud
+        from karpenter_tpu.catalog import InstanceTypeProvider, PricingProvider
+        from karpenter_tpu.core.actuator import Actuator
+        from karpenter_tpu.core.cluster import ClusterState
+        from karpenter_tpu.core.provisioner import (
+            Provisioner, ProvisionerOptions,
+        )
+
+        cloud = FakeCloud()
+        pricing = PricingProvider(cloud)
+        unavail = UnavailableOfferings()
+        itp = InstanceTypeProvider(cloud, pricing, unavail)
+        cluster = ClusterState()
+        cluster.add_nodeclass(ready_nodeclass())
+        cluster.add_nodepool(NodePool(
+            name="gpu-pool", nodeclass_name="default", weight=100,
+            taints=(Taint("dedicated", "gpu", "PreferNoSchedule"),)))
+        cluster.add_nodepool(NodePool(
+            name="general", nodeclass_name="default", weight=10))
+        actuator = Actuator(cloud, cluster, unavailable=unavail)
+        prov = Provisioner(cluster, itp, actuator, ProvisionerOptions(
+            solver=SolverOptions(backend="greedy")))
+        return prov, cluster, pricing
+
+    def test_intolerant_pod_avoids_soft_tainted_pool(self):
+        prov, cluster, pricing = self._rig()
+        try:
+            pods = [PodSpec("plain", requests=ResourceRequests(500, 1024))]
+            plans, nominated = prov._provision(pods)
+            assert "default/plain" in nominated
+            claim = cluster.get("nodeclaims", nominated["default/plain"])
+            assert claim.nodepool_name == "general"
+        finally:
+            pricing.close()
+
+    def test_tolerant_pod_lands_on_preferred_heavy_pool(self):
+        prov, cluster, pricing = self._rig()
+        try:
+            pods = [PodSpec(
+                "gpuish", requests=ResourceRequests(500, 1024),
+                tolerations=(Toleration("dedicated", "Equal", "gpu",
+                                        "PreferNoSchedule"),))]
+            plans, nominated = prov._provision(pods)
+            claim = cluster.get("nodeclaims", nominated["default/gpuish"])
+            # tolerant pod follows pool weight (gpu-pool = 100)
+            assert claim.nodepool_name == "gpu-pool"
+        finally:
+            pricing.close()
+
+    def test_soft_taint_alone_never_blocks(self):
+        # only the soft-tainted pool exists: the pod schedules anyway
+        prov, cluster, pricing = self._rig()
+        try:
+            cluster.delete("nodepools", "general")
+            pods = [PodSpec("plain2", requests=ResourceRequests(500, 1024))]
+            plans, nominated = prov._provision(pods)
+            assert "default/plain2" in nominated
+            claim = cluster.get("nodeclaims", nominated["default/plain2"])
+            assert claim.nodepool_name == "gpu-pool"
+        finally:
+            pricing.close()
