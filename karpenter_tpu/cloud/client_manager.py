@@ -23,10 +23,13 @@ C = TypeVar("C")
 class ClientManager(Generic[C]):
     """TTL-cached client with invalidate-on-auth-failure."""
 
-    def __init__(self, build: Callable[[], C], ttl: float = 1800.0,
+    def __init__(self, build: Callable[[], C], ttl: Optional[float] = None,
                  clock: Callable[[], float] = time.monotonic):
+        from karpenter_tpu.constants import DEFAULT_CLIENT_CACHE_TTL_SECONDS
+
         self._build = build
-        self._ttl = ttl
+        self._ttl = float(DEFAULT_CLIENT_CACHE_TTL_SECONDS) if ttl is None \
+            else ttl
         self._clock = clock
         self._lock = threading.Lock()
         self._client: Optional[C] = None

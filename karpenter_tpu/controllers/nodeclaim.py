@@ -25,7 +25,7 @@ from karpenter_tpu.utils.logging import get_logger
 log = get_logger("controllers.nodeclaim")
 
 LABEL_INITIALIZED = "karpenter.sh/initialized"
-CLAIM_FINALIZER = "karpenter-tpu.sh/termination"
+from karpenter_tpu.constants import CLAIM_FINALIZER
 
 # Taint-key prefixes that mean "CNI/cloud init not finished" — startup
 # taints are held until these clear (ref startuptaint/controller.go:322-433).

@@ -27,6 +27,7 @@ import time
 import uuid
 from typing import Dict, List, Optional, Tuple
 
+from karpenter_tpu.constants import CLAIM_FINALIZER
 from karpenter_tpu.apis.nodeclaim import NodeClaim, parse_provider_id, provider_id
 from karpenter_tpu.apis.nodeclass import (
     ANNOTATION_IMAGE, ANNOTATION_NODECLASS_HASH, ANNOTATION_NODECLASS_HASH_VERSION,
@@ -165,7 +166,7 @@ class Actuator:
             security_group_ids=tuple(inst.security_group_ids),
             hourly_price=planned.price,
             launched=True,
-            finalizers=["karpenter-tpu.sh/termination"])
+            finalizers=[CLAIM_FINALIZER])
         self.cluster.add_nodeclaim(claim)
         self.cluster.record_event("NodeClaim", claim.name, "Normal", "Launched",
                                   f"{planned.instance_type}/{planned.zone}/"

@@ -19,6 +19,7 @@ import re
 import time
 from typing import List, Optional, Tuple
 
+from karpenter_tpu.constants import CLAIM_FINALIZER
 from karpenter_tpu.apis.nodeclaim import NodeClaim, parse_provider_id, provider_id
 from karpenter_tpu.apis.nodeclass import NodeClass
 from karpenter_tpu.apis.requirements import (
@@ -120,7 +121,7 @@ class WorkerPoolActuator:
                          ANNOTATION_WORKER_ID: worker.id},
             hourly_price=planned.price,
             launched=True,
-            finalizers=["karpenter-tpu.sh/termination"])
+            finalizers=[CLAIM_FINALIZER])
         self.cluster.add_nodeclaim(claim)
         self.cluster.record_event(
             "NodeClaim", claim.name, "Normal", "WorkerAdded",

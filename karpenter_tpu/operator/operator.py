@@ -225,7 +225,10 @@ class Operator:
                 tls_cert=self.options.webhook_tls_cert,
                 tls_key=self.options.webhook_tls_key).start()
         self._started = True
+        from karpenter_tpu.version import get_version
+
         log.info("operator started",
+                 version=get_version(),
                  controllers=len(self.manager.controllers()),
                  backend=self.options.solver.backend)
 

@@ -95,7 +95,11 @@ class MetricsServer:
                     self._reply(200, body,
                                 "text/plain; version=0.0.4; charset=utf-8")
                 elif self.path == "/healthz":
-                    self._reply(200, b"ok", "text/plain")
+                    from karpenter_tpu.version import get_version
+
+                    self._reply(200, b'{"status":"ok","version":"'
+                                + get_version().encode() + b'"}',
+                                "application/json")
                 elif self.path == "/readyz":
                     if outer._ready():
                         self._reply(200, b"ready", "text/plain")

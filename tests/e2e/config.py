@@ -1,0 +1,90 @@
+"""E2E NodeClass/workload configuration (reference: test/e2e/config.go +
+test/e2e/configs/*.json — named configs loadable per scenario, with env
+placeholders resolved at load time)."""
+
+from __future__ import annotations
+
+import json
+import os
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Dict, List, Optional
+
+CONFIG_DIR = Path(__file__).parent / "configs"
+
+
+@dataclass
+class NodeClassConfig:
+    """One TPUNodeClass variant under test."""
+
+    name: str
+    region: str = ""
+    zones: List[str] = field(default_factory=list)
+    instance_profile: str = ""
+    instance_requirements: Optional[Dict] = None
+    image: str = ""
+    vpc: str = ""
+    subnet: str = ""
+    security_groups: List[str] = field(default_factory=list)
+    placement_strategy: Optional[Dict] = None
+
+    def to_manifest(self) -> Dict:
+        spec: Dict = {
+            "region": self.region or os.environ.get("TPU_CLOUD_REGION", ""),
+            "image": self.image or os.environ.get("TEST_IMAGE_ID", ""),
+            "vpc": self.vpc or os.environ.get("TEST_VPC_ID", ""),
+            "subnet": self.subnet or os.environ.get("TEST_SUBNET_ID", ""),
+            "securityGroups": self.security_groups
+            or [os.environ.get("TEST_SECURITY_GROUP_ID", "")],
+        }
+        if self.zones:
+            spec["zones"] = self.zones
+        if self.instance_profile:
+            spec["instanceProfile"] = self.instance_profile
+        if self.instance_requirements:
+            spec["instanceRequirements"] = self.instance_requirements
+        if self.placement_strategy:
+            spec["placementStrategy"] = self.placement_strategy
+        return {
+            "apiVersion": "karpenter-tpu.sh/v1alpha1",
+            "kind": "TPUNodeClass",
+            "metadata": {"name": self.name},
+            "spec": spec,
+        }
+
+
+def load_config(name: str) -> NodeClassConfig:
+    """Load a named config from configs/<name>.json with ${ENV}
+    placeholder resolution."""
+    raw = (CONFIG_DIR / f"{name}.json").read_text()
+    raw = os.path.expandvars(raw)
+    data = json.loads(raw)
+    return NodeClassConfig(**data)
+
+
+def make_workload(name: str, replicas: int, cpu: str = "500m",
+                  memory: str = "512Mi",
+                  node_selector: Optional[Dict[str, str]] = None) -> Dict:
+    """A minimal pending-pod deployment that forces provisioning."""
+    sel = {"app": name}
+    return {
+        "apiVersion": "apps/v1",
+        "kind": "Deployment",
+        "metadata": {"name": name},
+        "spec": {
+            "replicas": replicas,
+            "selector": {"matchLabels": sel},
+            "template": {
+                "metadata": {"labels": sel},
+                "spec": {
+                    "nodeSelector": node_selector or {},
+                    "containers": [{
+                        "name": "pause",
+                        "image": "registry.k8s.io/pause:3.9",
+                        "resources": {"requests": {
+                            "cpu": cpu, "memory": memory}},
+                    }],
+                },
+            },
+        },
+    }

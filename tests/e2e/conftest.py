@@ -1,0 +1,47 @@
+"""Collection gate: the live-cluster e2e tier only runs when explicitly
+requested (reference suite.go:99-102 — `RUN_E2E_TESTS=true` or skip), so
+`pytest tests/` stays a pure fake-cloud run everywhere.
+
+The modifyitems hook receives the WHOLE session's item list even from a
+subdirectory conftest — every marker is scoped to items under this
+directory, or a plain `pytest tests/` would silently skip the entire
+unit suite."""
+import os
+from pathlib import Path
+
+import pytest
+
+_E2E_DIR = Path(__file__).parent.resolve()
+
+
+def _is_e2e(item) -> bool:
+    try:
+        return _E2E_DIR in Path(str(item.fspath)).resolve().parents
+    except Exception:  # noqa: BLE001 — non-file items are not ours
+        return False
+
+
+def pytest_collection_modifyitems(config, items):
+    e2e_items = [i for i in items if _is_e2e(i)]
+    if os.environ.get("RUN_E2E_TESTS") != "true":
+        gate = pytest.mark.skip(
+            reason="live-cluster e2e gated off — set RUN_E2E_TESTS=true "
+                   "plus the env vars listed in tests/e2e/suite.py")
+        for item in e2e_items:
+            item.add_marker(gate)
+    if os.environ.get("RUN_E2E_BENCHMARKS") != "true":
+        bench_gate = pytest.mark.skip(
+            reason="e2e benchmarks gated off — RUN_E2E_BENCHMARKS=true "
+                   "(make e2e-benchmark)")
+        for item in e2e_items:
+            if "benchmark" in item.nodeid:
+                item.add_marker(bench_gate)
+
+
+@pytest.fixture(scope="session")
+def suite():
+    from tests.e2e.suite import E2ESuite
+
+    s = E2ESuite.setup()
+    yield s
+    s.teardown()

@@ -1,0 +1,186 @@
+"""Live-cluster e2e suite bootstrap.
+
+Mirrors the reference's env-bootstrapped suite
+(`/root/reference/test/e2e/suite.go:97-145`): gate on RUN_E2E_TESTS,
+fail fast on missing required environment, build a Kubernetes client
+from KUBECONFIG, and sweep test leftovers BEFORE each run so a crashed
+previous run can't poison this one.
+
+The cloud/cluster cannot exist in CI or the dev sandbox — everything
+here degrades to a clean skip — but the harness itself (config,
+waiting, verification, cleanup) is real and is what `make e2e` runs
+against a live TPU cluster.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional
+
+import pytest
+
+# env the suite requires before touching a real cluster (reference
+# suite.go:105-116's requiredEnvVars, TPU-cloud shaped)
+REQUIRED_ENV = (
+    "TPU_CLOUD_API_KEY",
+    "TPU_CLOUD_REGION",
+    "TEST_VPC_ID",
+    "TEST_SUBNET_ID",
+    "TEST_IMAGE_ID",
+    "TEST_ZONE",
+    "TEST_SECURITY_GROUP_ID",
+    "KUBERNETES_API_SERVER_ENDPOINT",
+)
+
+# every object the suite creates carries this label; cleanup sweeps by it
+E2E_LABEL = "karpenter-tpu.sh/e2e"
+DEFAULT_TIMEOUT = 900       # one cold provision + CNI init
+POLL_INTERVAL = 5.0
+
+
+@dataclass
+class E2ESuite:
+    """One live-cluster test session: kube client + config + cleanup."""
+
+    kube: object
+    custom: object              # CustomObjectsApi for the CRDs
+    region: str
+    zone: str
+    namespace: str = "karpenter-tpu-e2e"
+    created: List[Dict] = field(default_factory=list)
+
+    # -- bootstrap ---------------------------------------------------------
+
+    @classmethod
+    def setup(cls) -> "E2ESuite":
+        if os.environ.get("RUN_E2E_TESTS") != "true":
+            pytest.skip("RUN_E2E_TESTS != true")
+        missing = [v for v in REQUIRED_ENV if not os.environ.get(v)]
+        if missing:
+            pytest.fail(f"required e2e env vars not set: {missing}")
+        try:
+            from kubernetes import client, config
+        except ImportError:
+            pytest.fail("the live e2e tier needs the `kubernetes` package "
+                        "(pip install kubernetes)")
+        try:
+            config.load_kube_config(os.environ.get("KUBECONFIG"))
+        except Exception:  # noqa: BLE001 — in-cluster fallback
+            config.load_incluster_config()
+        suite = cls(kube=client.CoreV1Api(),
+                    custom=client.CustomObjectsApi(),
+                    region=os.environ["TPU_CLOUD_REGION"],
+                    zone=os.environ["TEST_ZONE"])
+        suite.cleanup_leftovers()   # pre-test sweep (suite.go:147-152)
+        return suite
+
+    # -- waiting / verification helpers -----------------------------------
+
+    def wait_for(self, what: str, predicate: Callable[[], bool],
+                 timeout: float = DEFAULT_TIMEOUT) -> None:
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if predicate():
+                return
+            time.sleep(POLL_INTERVAL)
+        pytest.fail(f"timed out after {timeout}s waiting for {what}")
+
+    def nodes_with_label(self, key: str,
+                         value: Optional[str] = None) -> List:
+        sel = key if value is None else f"{key}={value}"
+        return self.kube.list_node(label_selector=sel).items
+
+    def wait_for_nodes(self, count: int, label: str = E2E_LABEL,
+                       timeout: float = DEFAULT_TIMEOUT) -> List:
+        self.wait_for(
+            f"{count} ready nodes with {label}",
+            lambda: len([n for n in self.nodes_with_label(label)
+                         if _node_ready(n)]) >= count,
+            timeout)
+        return self.nodes_with_label(label)
+
+    def wait_for_pods_scheduled(self, namespace: str, selector: str,
+                                count: int,
+                                timeout: float = DEFAULT_TIMEOUT) -> None:
+        def scheduled() -> bool:
+            pods = self.kube.list_namespaced_pod(
+                namespace, label_selector=selector).items
+            return sum(1 for p in pods if p.spec.node_name) >= count
+
+        self.wait_for(f"{count} scheduled pods ({selector})", scheduled,
+                      timeout)
+
+    # -- object creation (tracked for cleanup) -----------------------------
+
+    def create_nodeclass(self, body: Dict) -> Dict:
+        body.setdefault("metadata", {}).setdefault("labels", {})[
+            E2E_LABEL] = "true"
+        out = self.custom.create_cluster_custom_object(
+            "karpenter-tpu.sh", "v1alpha1", "tpunodeclasses", body)
+        self.created.append({"kind": "tpunodeclasses",
+                             "name": body["metadata"]["name"]})
+        return out
+
+    def create_deployment(self, namespace: str, body: Dict) -> None:
+        from kubernetes import client
+
+        body.setdefault("metadata", {}).setdefault("labels", {})[
+            E2E_LABEL] = "true"
+        client.AppsV1Api().create_namespaced_deployment(namespace, body)
+        self.created.append({"kind": "deployment", "namespace": namespace,
+                             "name": body["metadata"]["name"]})
+
+    # -- cleanup -----------------------------------------------------------
+
+    def cleanup_leftovers(self) -> None:
+        """Delete anything a previous (possibly crashed) run left behind,
+        THEN wait for its nodes to drain — scale-down is part of what the
+        suite certifies (reference cleanup.go)."""
+        from kubernetes import client
+
+        apps = client.AppsV1Api()
+        for ns in (self.namespace, "default"):
+            try:
+                for d in apps.list_namespaced_deployment(
+                        ns, label_selector=E2E_LABEL).items:
+                    apps.delete_namespaced_deployment(d.metadata.name, ns)
+            except Exception:  # noqa: BLE001 — namespace may not exist yet
+                pass
+        try:
+            for nc in self.custom.list_cluster_custom_object(
+                    "karpenter-tpu.sh", "v1alpha1", "tpunodeclasses"
+            ).get("items", []):
+                if nc["metadata"].get("labels", {}).get(E2E_LABEL):
+                    self.custom.delete_cluster_custom_object(
+                        "karpenter-tpu.sh", "v1alpha1", "tpunodeclasses",
+                        nc["metadata"]["name"])
+        except Exception:  # noqa: BLE001
+            pass
+
+    def teardown(self) -> None:
+        from kubernetes import client
+
+        apps = client.AppsV1Api()
+        for obj in reversed(self.created):
+            try:
+                if obj["kind"] == "deployment":
+                    apps.delete_namespaced_deployment(obj["name"],
+                                                      obj["namespace"])
+                else:
+                    self.custom.delete_cluster_custom_object(
+                        "karpenter-tpu.sh", "v1alpha1", obj["kind"],
+                        obj["name"])
+            except Exception:  # noqa: BLE001 — already gone is fine
+                pass
+        # nodes must drain back to zero: deprovisioning is part of the
+        # certified surface, not an afterthought
+        self.wait_for("e2e nodes to drain",
+                      lambda: not self.nodes_with_label(E2E_LABEL),
+                      timeout=600)
+
+
+def _node_ready(node) -> bool:
+    return any(c.type == "Ready" and c.status == "True"
+               for c in (node.status.conditions or []))

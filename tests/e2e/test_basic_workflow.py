@@ -1,0 +1,30 @@
+"""Basic provision/deprovision workflow against a live cluster
+(reference: test/e2e/basic_workflow_test.go).  Gated by RUN_E2E_TESTS."""
+import pytest
+
+from tests.e2e.config import load_config, make_workload
+from tests.e2e.suite import E2E_LABEL
+
+
+def test_basic_provision_and_deprovision(suite):
+    nc = load_config("default")
+    suite.create_nodeclass(nc.to_manifest())
+
+    # pending pods force a provision
+    suite.create_deployment("default", make_workload("e2e-basic", 5))
+    suite.wait_for_pods_scheduled("default", "app=e2e-basic", 5)
+    nodes = suite.nodes_with_label(E2E_LABEL)
+    assert nodes, "pods scheduled but no e2e-labeled node appeared"
+
+    # deprovision: the teardown fixture asserts nodes drain to zero
+
+
+def test_nodeclass_validation_rejects_bad_spec(suite):
+    bad = load_config("default")
+    bad.name = "e2e-bad-vpc"
+    bad.vpc = "vpc-does-not-exist"
+    manifest = bad.to_manifest()
+    # the validating webhook (operator/server.py /validate-nodeclass)
+    # must reject an unresolvable VPC reference
+    with pytest.raises(Exception):
+        suite.create_nodeclass(manifest)

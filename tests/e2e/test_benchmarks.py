@@ -1,0 +1,42 @@
+"""E2E latency benchmarks against a live cluster (reference:
+test/e2e/benchmarks_test.go:29-100 behind `make e2e-benchmark`):
+instance-creation, NodeClass-validation, and pod-scheduling latency,
+logged per run — the reference publishes no numbers either; the harness
+records them."""
+import time
+
+from tests.e2e.config import load_config, make_workload
+from tests.e2e.suite import E2E_LABEL
+
+
+def test_benchmark_instance_creation_latency(suite):
+    nc = load_config("default")
+    nc.name = "e2e-bench-create"
+    suite.create_nodeclass(nc.to_manifest())
+    t0 = time.monotonic()
+    suite.create_deployment("default", make_workload("e2e-bench", 1))
+    suite.wait_for_nodes(1)
+    created = time.monotonic() - t0
+    suite.wait_for_pods_scheduled("default", "app=e2e-bench", 1)
+    scheduled = time.monotonic() - t0
+    print(f"BENCH instance_creation_s={created:.1f} "
+          f"pod_scheduling_s={scheduled:.1f}")
+    assert created < 900   # the 30-min suite envelope implies << this
+
+
+def test_benchmark_nodeclass_validation_latency(suite):
+    nc = load_config("default")
+    nc.name = "e2e-bench-validate"
+    t0 = time.monotonic()
+    suite.create_nodeclass(nc.to_manifest())
+
+    def ready() -> bool:
+        obj = suite.custom.get_cluster_custom_object(
+            "karpenter-tpu.sh", "v1alpha1", "tpunodeclasses",
+            "e2e-bench-validate")
+        conds = obj.get("status", {}).get("conditions", [])
+        return any(c.get("type") == "Ready" and c.get("status") == "True"
+                   for c in conds)
+
+    suite.wait_for("NodeClass Ready", ready, timeout=120)
+    print(f"BENCH nodeclass_validation_s={time.monotonic() - t0:.1f}")

@@ -1,0 +1,31 @@
+"""Drift detection/remediation against a live cluster (reference:
+test/e2e/drift_test.go): mutate the NodeClass, expect the drift
+controller to replace the node."""
+from tests.e2e.config import load_config, make_workload
+from tests.e2e.suite import E2E_LABEL
+
+
+def test_nodeclass_change_drifts_and_replaces(suite):
+    nc = load_config("default")
+    nc.name = "e2e-drift"
+    suite.create_nodeclass(nc.to_manifest())
+    suite.create_deployment("default", make_workload("e2e-drift", 3))
+    suite.wait_for_pods_scheduled("default", "app=e2e-drift", 3)
+    before = {n.metadata.name for n in suite.nodes_with_label(E2E_LABEL)}
+
+    # mutate a hash-relevant field -> spec-hash drift (6-way drift in
+    # core/drift.py; the annotation pair mirrors the reference's
+    # hash + hash-version contract)
+    patched = nc
+    patched.instance_profile = "bx2-8x32"
+    suite.custom.patch_cluster_custom_object(
+        "karpenter-tpu.sh", "v1alpha1", "tpunodeclasses", "e2e-drift",
+        patched.to_manifest())
+
+    def replaced() -> bool:
+        now = {n.metadata.name for n in suite.nodes_with_label(E2E_LABEL)}
+        return bool(now) and not (now & before)
+
+    suite.wait_for("drifted nodes to be replaced", replaced, timeout=1200)
+    # workload survived the blue/green replace
+    suite.wait_for_pods_scheduled("default", "app=e2e-drift", 3)

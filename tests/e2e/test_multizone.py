@@ -1,0 +1,23 @@
+"""Multi-zone spread against a live cluster (reference:
+test/e2e/multizone_test.go)."""
+from tests.e2e.config import load_config, make_workload
+from tests.e2e.suite import E2E_LABEL
+
+
+def test_zone_spread_places_across_zones(suite):
+    nc = load_config("multizone")
+    suite.create_nodeclass(nc.to_manifest())
+
+    wl = make_workload("e2e-spread", 9)
+    wl["spec"]["template"]["spec"]["topologySpreadConstraints"] = [{
+        "maxSkew": 1,
+        "topologyKey": "topology.kubernetes.io/zone",
+        "whenUnsatisfiable": "DoNotSchedule",
+        "labelSelector": {"matchLabels": {"app": "e2e-spread"}},
+    }]
+    suite.create_deployment("default", wl)
+    suite.wait_for_pods_scheduled("default", "app=e2e-spread", 9)
+
+    zones = {n.metadata.labels.get("topology.kubernetes.io/zone")
+             for n in suite.nodes_with_label(E2E_LABEL)}
+    assert len(zones) >= 2, f"spread produced a single zone: {zones}"

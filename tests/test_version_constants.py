@@ -1,0 +1,30 @@
+"""Version/constants plumbing (reference pkg/version + pkg/constants)."""
+from karpenter_tpu import constants
+from karpenter_tpu.version import VERSION, get_version
+
+
+def test_version_default_and_override(monkeypatch):
+    assert get_version() == VERSION
+    assert isinstance(VERSION, str) and VERSION
+
+
+def test_constants_match_the_values_actually_stamped():
+    from karpenter_tpu.apis.requirements import LABEL_NODEPOOL
+    from karpenter_tpu.controllers import nodeclaim
+    from karpenter_tpu.core.actuator import KARPENTER_TAGS
+
+    assert constants.GROUP == "karpenter-tpu.sh"
+    # the index must agree with the owning modules — two same-named
+    # constants with different values is a label-selector landmine
+    assert constants.LABEL_NODEPOOL is LABEL_NODEPOOL
+    assert nodeclaim.CLAIM_FINALIZER == constants.CLAIM_FINALIZER
+    assert constants.CLAIM_FINALIZER == "karpenter-tpu.sh/termination"
+    assert constants.LABEL_MANAGED in KARPENTER_TAGS
+    assert constants.DEFAULT_CLIENT_CACHE_TTL_SECONDS == 1800
+
+
+def test_client_manager_uses_default_ttl():
+    from karpenter_tpu.cloud.client_manager import ClientManager
+
+    cm = ClientManager(build=lambda: object())
+    assert cm._ttl == float(constants.DEFAULT_CLIENT_CACHE_TTL_SECONDS)
