@@ -30,6 +30,12 @@ lint: ## Ruff lint (config: ruff.toml); no-op with a hint if ruff is absent
 		echo "ruff not installed (CI installs it; pip install ruff locally)"; \
 	fi
 
+.PHONY: test-stress
+test-stress: ## Adversarial-interleaving concurrency tier, repeated (the -race analogue)
+	for i in 1 2 3 4 5; do \
+		$(TEST_ENV) $(PY) -m pytest tests/test_stress_concurrency.py -q || exit 1; \
+	done
+
 .PHONY: bench
 bench: ## Full benchmark (one JSON line; runs on the ambient JAX backend)
 	$(PY) bench.py

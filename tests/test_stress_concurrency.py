@@ -1,0 +1,186 @@
+"""Adversarial-interleaving stress tier (docs/design/race-detection.md:
+the Python analogue of the reference's blanket `go test -race` run).
+
+``sys.setswitchinterval(1e-5)`` forces thread switches every bytecode
+burst so check-then-act windows fail reliably; every test asserts an
+exact INVARIANT (counts, uniqueness), never just "no exception".
+"""
+import sys
+import threading
+
+import pytest
+
+from karpenter_tpu.apis.pod import PodSpec, ResourceRequests
+
+
+@pytest.fixture(autouse=True)
+def adversarial_scheduler():
+    old = sys.getswitchinterval()
+    sys.setswitchinterval(1e-5)
+    yield
+    sys.setswitchinterval(old)
+
+
+def hammer(fn, n_threads=8, reps=200):
+    errs = []
+
+    def run():
+        try:
+            for _ in range(reps):
+                fn()
+        except Exception as e:  # noqa: BLE001
+            errs.append(e)
+
+    ts = [threading.Thread(target=run) for _ in range(n_threads)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    assert not errs, errs[:3]
+
+
+class TestClusterStateStress:
+    def test_concurrent_add_delete_list_counts(self):
+        from karpenter_tpu.core.cluster import ClusterState
+
+        cluster = ClusterState()
+        counter = {"n": 0}
+        lock = threading.Lock()
+
+        def one():
+            with lock:
+                counter["n"] += 1
+                i = counter["n"]
+            cluster.add_pod(PodSpec(f"p{i}",
+                                    requests=ResourceRequests(100, 128)))
+            assert cluster.get("pods", f"default/p{i}") is not None
+            if i % 3 == 0:
+                cluster.delete("pods", f"default/p{i}")
+
+        hammer(one, n_threads=8, reps=150)
+        total = 8 * 150
+        expect = total - total // 3
+        assert len(cluster.list("pods")) == expect
+
+    def test_event_recording_no_lost_updates(self):
+        from karpenter_tpu.core.cluster import ClusterState
+
+        cluster = ClusterState()
+        cluster.add_pod(PodSpec("p0", requests=ResourceRequests(100, 128)))
+
+        def one():
+            cluster.record_event("Pod", "default/p0", "Normal", "Tested",
+                                 "stress")
+
+        hammer(one, n_threads=8, reps=100)
+        # exact count: any lost update is a failure (800 is far below
+        # the recorder's 10k ring cap, so none may be evicted)
+        events = cluster.events_for("Pod", "default/p0")
+        assert len(events) == 8 * 100
+        assert all(e.reason == "Tested" for e in events)
+
+
+class TestCircuitBreakerStress:
+    def test_concurrent_failures_trip_exactly_once_per_key(self):
+        from karpenter_tpu.core.circuitbreaker import (
+            CircuitBreakerConfig, CircuitBreakerManager,
+        )
+
+        reg = CircuitBreakerManager(CircuitBreakerConfig(
+            failure_threshold=3, rate_limit_per_minute=10 ** 9,
+            max_concurrent_instances=10 ** 9))
+
+        def one():
+            reg.record_failure("nc", "region", "boom")
+
+        hammer(one, n_threads=8, reps=50)
+        assert reg.states().get(("nc", "region")) == "OPEN"
+
+    def test_concurrent_mixed_keys_stay_isolated(self):
+        from karpenter_tpu.core.circuitbreaker import (
+            CircuitBreakerConfig, CircuitBreakerManager,
+        )
+
+        # a LOW reachable threshold: only nc0 is driven past it; any
+        # cross-key contamination of failure counts trips nc1..nc3 and
+        # fails the isolation assertion below
+        reg = CircuitBreakerManager(CircuitBreakerConfig(
+            failure_threshold=3, rate_limit_per_minute=10 ** 9,
+            max_concurrent_instances=10 ** 9))
+        idx = {"n": 0}
+        lock = threading.Lock()
+
+        def one():
+            with lock:
+                idx["n"] += 1
+                k = idx["n"] % 4
+            if k == 0:
+                reg.record_failure("nc0", "r", "x")
+            else:
+                # success-only traffic: any failure appearing on these
+                # keys could only come from cross-key contamination
+                reg.record_success(f"nc{k}", "r")
+
+        hammer(one)
+        states = reg.states()
+        assert states[("nc0", "r")] == "OPEN"
+        for k in (1, 2, 3):
+            assert states[(f"nc{k}", "r")] == "CLOSED", states
+
+
+class TestUnavailableOfferingsStress:
+    def test_blackout_and_generation_consistency(self):
+        from karpenter_tpu.catalog.unavailable import UnavailableOfferings
+
+        un = UnavailableOfferings()
+        tid = threading.local()
+        counter = {"n": 0}
+        lock = threading.Lock()
+
+        def one():
+            if not hasattr(tid, "me"):
+                with lock:
+                    counter["n"] += 1
+                    tid.me = counter["n"]
+            # TWO writes per iteration, then a snapshot: the captured
+            # generation (not the live cache) must contain BOTH — a torn
+            # snapshot that sees one write without the other fails here
+            a = f"it{tid.me}-a"
+            b = f"it{tid.me}-b"
+            un.mark_unavailable(a, "z1", "spot", ttl=60)
+            un.mark_unavailable(b, "z1", "spot", ttl=60)
+            gen = un.generation
+            keys = {str(k) for k in gen}
+            assert any(a in k for k in keys), (a, keys)
+            assert any(b in k for k in keys), (b, keys)
+
+        hammer(one, n_threads=8, reps=100)
+        # every thread's final pair is still live
+        final = {str(k) for k in un.generation}
+        for t in range(1, counter["n"] + 1):
+            assert any(f"it{t}-a" in k for k in final)
+            assert any(f"it{t}-b" in k for k in final)
+
+
+class TestSignatureInterningStress:
+    def test_signature_ids_unique_under_contention(self):
+        # the interning map hands out ids under a lock; racing
+        # setdefaults must never assign one id to two distinct
+        # signatures, nor two ids to one signature.  Every thread builds
+        # FRESH PodSpec objects (per-pod memo cold) for the same 64
+        # signature contents.
+        results = []
+        res_lock = threading.Lock()
+
+        def one():
+            pods = [PodSpec(f"s{i}",
+                            requests=ResourceRequests(100 + i, 128))
+                    for i in range(64)]
+            ids = tuple(p.signature_id() for p in pods)
+            with res_lock:
+                results.append(ids)
+
+        hammer(one, n_threads=8, reps=20)
+        distinct = set(results)
+        assert len(distinct) == 1            # same id per signature, always
+        assert len(set(results[0])) == 64    # and all 64 ids distinct
