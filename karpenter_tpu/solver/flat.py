@@ -37,10 +37,11 @@ oracle on heterogeneous mixes (right-sizing reclaims the partially-
 filled-node waste FFD pays for) — asserted by tests/test_flat.py
 against the greedy oracle.
 
-Scope gates (checked host-side in ``flat_viable``): one distinct label
-row, no per-node caps (hostname anti-affinity), and shapes fitting
-int32 key arithmetic.  Anything else falls back to the scan/pallas
-paths unchanged.
+Scope gates (checked host-side in ``flat_viable``): at most 32 distinct
+label rows (a bin's row-set packs into one-hot columns for the
+right-size intersection), no per-node caps (hostname anti-affinity), no
+soft preferences, and shapes fitting int32 key arithmetic.  Anything
+else falls back to the scan/pallas paths unchanged.
 """
 
 from __future__ import annotations
@@ -87,40 +88,55 @@ def _segmented_prefix(req2, bin2, I: int):
     return excl - base[seg_id]
 
 
-def _flat_body(item_req, item_gid, item_live, row, off_alloc, off_rank,
-               off_price, *, I: int, O: int, G: int, N: int, K: int,
-               beta_bp: int, max_rounds: int):
+def _flat_body(item_req, item_gid, item_live, rows, item_row, off_alloc,
+               off_rank, off_price, *, I: int, O: int, G: int, N: int,
+               K: int, U: int, beta_bp: int, max_rounds: int):
     R = item_req.shape[1]
     reqf = item_req.astype(jnp.float32)
     allocf = jnp.maximum(off_alloc.astype(jnp.float32), 1.0)
     Cmax = jnp.maximum(jnp.max(off_alloc, axis=0).astype(jnp.float32), 1.0)
 
-    # exact per-item placeability against the label row
+    # exact per-item placeability: resource fit AND the item's label row
+    # (``rows`` [U, O] bool, ``item_row`` [I] int32 — U <= 32 so a bin's
+    # row-set fits a [N, U] one-hot matrix for right-sizing)
     fits = jnp.all(off_alloc[None, :, :] >= item_req[:, None, :], axis=2)
-    okoff = fits & row[None, :]
+    rc = jnp.clip(item_row, 0, U - 1)       # guarded row index, hoisted
+    row_i = rows[rc]                                             # [I, O]
+    okoff = fits & row_i
     fit_any = jnp.any(okoff, axis=1) & item_live
 
-    # Per-item bin class.  Primary: ONE global offering chosen by fluid
-    # economics — cheapest rank x bins-needed among offerings covering
-    # the componentwise-max placeable request.  Large shared bins keep
-    # utilization high (the fill pass + right-sizing reclaim the rest);
-    # per-pod exact-fit bins (the oracle's literal rule) fragment a
-    # heterogeneous window into ~1 pod per node and cost ~25% more on
-    # ladder-rounding waste.  Items the global offering cannot hold fall
-    # back to their own cheapest-fitting offering, so no covering
+    # Per-item bin class.  Primary: ONE covering offering PER LABEL ROW
+    # chosen by fluid economics — cheapest rank x bins-needed among the
+    # row's offerings covering the row's componentwise-max placeable
+    # request.  Large shared bins keep utilization high (the fill pass +
+    # right-sizing reclaim the rest); per-pod exact-fit bins (the
+    # oracle's literal rule) fragment a heterogeneous window into ~1 pod
+    # per node and cost ~25% more on ladder-rounding waste.  The choice
+    # is per ROW, not global: a zone-pinned subset must get its own
+    # zone-local big bin, not fall back to snug bins because the global
+    # offering lives elsewhere.  Items their row's offering cannot hold
+    # fall back to their own cheapest-fitting offering, so no covering
     # precondition exists (reference economics anchor:
     # cloudprovider.go:321-352 + instancetype.go:88-110).
     price_fit = jnp.where(okoff, off_rank[None, :], jnp.inf)
     exact_cls = jnp.argmin(price_fit, axis=1).astype(jnp.int32)      # [I]
-    g_max = jnp.max(jnp.where(fit_any[:, None], item_req, 0), axis=0)
-    T = jnp.sum(jnp.where(fit_any[:, None], reqf, 0.0), axis=0)
-    covers = row & jnp.all(off_alloc >= g_max[None, :], axis=1)      # [O]
-    fluid = jnp.max(T[None, :] / allocf, axis=1)                     # [O]
-    score = jnp.where(covers, off_rank * jnp.maximum(fluid, 1.0), jnp.inf)
-    ostar = jnp.argmin(score).astype(jnp.int32)
-    has_cover = jnp.any(covers)
-    fits_star = jnp.take(okoff, ostar, axis=1)                       # [I]
-    cls = jnp.where(has_cover & fits_star, ostar, exact_cls)
+    seg_row = jnp.where(fit_any, item_row, U)
+    T_u = jax.ops.segment_sum(jnp.where(fit_any[:, None], reqf, 0.0),
+                              seg_row, num_segments=U + 1)[:U]       # [U,R]
+    max_u = jax.ops.segment_max(jnp.where(fit_any[:, None], item_req, 0),
+                                seg_row, num_segments=U + 1)[:U]     # [U,R]
+    covers_u = rows & jnp.all(off_alloc[None, :, :] >= max_u[:, None, :],
+                              axis=2)                                # [U,O]
+    fluid_u = jnp.max(T_u[:, None, :] / allocf[None, :, :], axis=2)  # [U,O]
+    score_u = jnp.where(covers_u,
+                        off_rank[None, :] * jnp.maximum(fluid_u, 1.0),
+                        jnp.inf)
+    ostar_u = jnp.argmin(score_u, axis=1).astype(jnp.int32)          # [U]
+    has_cover_u = jnp.any(covers_u, axis=1)                          # [U]
+    star_i = ostar_u[rc]                                             # [I]
+    fits_star = jnp.take_along_axis(okoff, star_i[:, None],
+                                    axis=1)[:, 0]
+    cls = jnp.where(has_cover_u[rc] & fits_star, star_i, exact_cls)
     Ci = off_alloc[cls]                                              # [I,R]
 
     # static order: class-major, dominant share (vs own class capacity)
@@ -135,15 +151,20 @@ def _flat_body(item_req, item_gid, item_live, row, off_alloc, off_rank,
     scls = cls[order]
     active0 = fit_any[order]
     sCap = off_alloc[scls]                                           # [I,R]
+    sok = okoff[order]                                               # [I,O]
+    # one-hot row membership in sorted space: bins accumulate the SET of
+    # item row-classes they host (for right-size row intersection)
+    soh = (jax.lax.broadcasted_iota(jnp.int32, (I, U), 1)
+           == item_row[order][:, None]).astype(jnp.int32)            # [I,U]
 
     beta = beta_bp / 10000.0
 
     def cond(st):
-        t, bins_used, _, active, _, _, _ = st
+        t, bins_used, _, active, _, _, _, _ = st
         return (t < max_rounds) & jnp.any(active) & (bins_used < N)
 
     def body(st):
-        t, bins_used, bin_of, active, load, obin, npods = st
+        t, bins_used, bin_of, active, load, obin, npods, hrow = st
         open_b = npods > 0
         n_open = jnp.sum(open_b.astype(jnp.int32))
 
@@ -162,6 +183,12 @@ def _flat_body(item_req, item_gid, item_live, row, off_alloc, off_rank,
         j = jnp.mod(k, 2 * na)
         local = jnp.where(j < na, j, 2 * na - 1 - j)
         binf = jnp.where(active & (n_open > 0), blist[local], N)
+        # label feasibility vs the target bin's CURRENT offering: an
+        # item may only ride a bin whose offering its row allows (with
+        # one label row this is vacuous; with many it is load-bearing)
+        tgt_off = obin[jnp.clip(binf, 0, N - 1)]
+        ok_t = jnp.take_along_axis(sok, tgt_off[:, None], axis=1)[:, 0]
+        binf = jnp.where(ok_t, binf, N)
         ord2 = jnp.argsort(binf)
         req2 = jnp.where(active[:, None], sreq, 0)[ord2]
         bin2 = binf[ord2]
@@ -175,6 +202,9 @@ def _flat_body(item_req, item_gid, item_live, row, off_alloc, off_rank,
             num_segments=N + 1)[:N]
         npods = npods + jax.ops.segment_sum(
             keepf.astype(jnp.int32), segf, num_segments=N + 1)[:N]
+        hrow = jnp.maximum(hrow, jax.ops.segment_max(
+            jnp.where(keepf[:, None], soh, 0), segf,
+            num_segments=N + 1)[:N])
         bin_of = jnp.where(keepf & active, binf, bin_of)
         active = active & ~keepf
 
@@ -216,16 +246,19 @@ def _flat_body(item_req, item_gid, item_live, row, off_alloc, off_rank,
             num_segments=N + 1)[:N]
         npods = npods + jax.ops.segment_sum(
             keepo.astype(jnp.int32), sego, num_segments=N + 1)[:N]
+        hrow = jnp.maximum(hrow, jax.ops.segment_max(
+            jnp.where(keepo[:, None], soh, 0), sego,
+            num_segments=N + 1)[:N])
         obin = obin.at[sego].set(scls, mode="drop")
         bin_of = jnp.where(keepo & active, bino, bin_of)
         active = active & ~keepo
         return (t + 1, jnp.minimum(bins_used + jnp.sum(n_new), 1 << 29),
-                bin_of, active, load, obin, npods)
+                bin_of, active, load, obin, npods, hrow)
 
     st0 = (jnp.int32(0), jnp.int32(0), jnp.full((I,), N, jnp.int32),
            active0, jnp.zeros((N, R), jnp.int32), jnp.zeros((N,), jnp.int32),
-           jnp.zeros((N,), jnp.int32))
-    (_, bins_used, bin_of, active, load, obin, npods) = \
+           jnp.zeros((N,), jnp.int32), jnp.zeros((N, U), jnp.int32))
+    (_, bins_used, bin_of, active, load, obin, npods, hrow) = \
         lax.while_loop(cond, body, st0)
 
     # leftover actives (normally none): one bin of the item's own class
@@ -239,15 +272,22 @@ def _flat_body(item_req, item_gid, item_live, row, off_alloc, off_rank,
                                       segs, num_segments=N + 1)[:N]
     npods = npods + jax.ops.segment_sum(ok.astype(jnp.int32), segs,
                                         num_segments=N + 1)[:N]
+    hrow = jnp.maximum(hrow, jax.ops.segment_max(
+        jnp.where(ok[:, None], soh, 0), segs, num_segments=N + 1)[:N])
     obin = obin.at[segs].set(scls, mode="drop")
     spilled = jnp.sum((active & ~ok).astype(jnp.int32))
 
     placed_s = bin_of < N
     open_b = npods > 0
 
-    # right-size: cheapest offering fitting the final load (class row
-    # shared by every item, so label feasibility is row membership)
-    cand = row[None, :] & jnp.all(
+    # right-size: cheapest offering fitting the final load AND allowed
+    # by EVERY row class present on the bin — the row-set intersection
+    # rides one [N,U] x [U,O] matmul (viol > 0 => some class forbids o);
+    # each bin's current offering was feasibility-checked per item at
+    # placement, so a candidate always exists
+    viol = jnp.dot(hrow.astype(jnp.float32),
+                   (~rows).astype(jnp.float32))                      # [N,O]
+    cand = (viol < 0.5) & jnp.all(
         off_alloc[None, :, :] >= load[:, None, :], axis=2)           # [N,O]
     cand_price = jnp.where(cand, off_rank[None, :], jnp.inf)
     node_off = jnp.where(open_b,
@@ -279,19 +319,20 @@ def _flat_body(item_req, item_gid, item_live, row, off_alloc, off_rank,
     return node_off, unplaced_g, cost, idx_arr, cnt_arr, spilled
 
 
-@functools.partial(jax.jit, static_argnames=("I", "O", "G", "N", "K",
+@functools.partial(jax.jit, static_argnames=("I", "O", "G", "N", "K", "U",
                                              "beta_bp", "max_rounds"))
-def flat_solve_kernel(item_req, item_gid, item_live, row, off_alloc,
-                      off_rank, off_price, *, I: int, O: int, G: int,
-                      N: int, K: int, beta_bp: int = 300,
+def flat_solve_kernel(item_req, item_gid, item_live, rows, item_row,
+                      off_alloc, off_rank, off_price, *, I: int, O: int,
+                      G: int, N: int, K: int, U: int, beta_bp: int = 300,
                       max_rounds: int = _MAX_ROUNDS):
     """One-buffer-out flat solve.  Output layout (int32, length
     N + G + 1 + 2K + 1): node_off [N] | unplaced [G] | cost (f32 bits) |
     COO idx [K] | COO cnt [K] | spilled (placeable-but-no-room count —
     the node-escalation signal)."""
     node_off, unplaced_g, cost, idx_arr, cnt_arr, spilled = _flat_body(
-        item_req, item_gid, item_live, row, off_alloc, off_rank, off_price,
-        I=I, O=O, G=G, N=N, K=K, beta_bp=beta_bp, max_rounds=max_rounds)
+        item_req, item_gid, item_live, rows, item_row, off_alloc, off_rank,
+        off_price, I=I, O=O, G=G, N=N, K=K, U=U, beta_bp=beta_bp,
+        max_rounds=max_rounds)
     cost_i = lax.bitcast_convert_type(cost.astype(jnp.float32)[None],
                                       jnp.int32)
     return jnp.concatenate([node_off, unplaced_g, cost_i, idx_arr, cnt_arr,
@@ -316,7 +357,10 @@ def flat_viable(problem: EncodedProblem, options) -> bool:
     if mode != "on" and G < getattr(options, "flat_min_groups", 2048):
         return False
     if problem.label_rows is None or problem.label_idx is None \
-            or problem.label_rows.shape[0] != 1:
+            or not (1 <= problem.label_rows.shape[0] <= 32):
+        # the right-size row intersection packs a bin's row-set into 32
+        # one-hot columns; windows with more distinct constraint rows
+        # take the scan path (they compress well anyway)
         return False
     if problem.pref_rows is not None:
         # soft preferences need penalty ranking — the scan path owns it
@@ -341,9 +385,9 @@ class FlatAttempt:
     is started immediately (`copy_to_host_async`), so by the time
     ``finalize_flat`` runs in a pipelined loop the fetch is local."""
 
-    __slots__ = ("item_req", "item_gid", "item_live", "row", "G_pad",
-                 "O_pad", "I_pad", "N", "N_cap", "K", "out_dev", "t_disp",
-                 "t_issued")
+    __slots__ = ("item_req", "item_gid", "item_live", "rows", "item_row",
+                 "G_pad", "O_pad", "I_pad", "U_pad", "N", "N_cap", "K",
+                 "out_dev", "t_disp", "t_issued")
 
     def __init__(self, **kw):
         for k, v in kw.items():
@@ -354,7 +398,6 @@ def dispatch_flat(solver, problem: EncodedProblem) -> Optional[FlatAttempt]:
     """Issue the flat kernel and start the async result copy; returns
     None when the problem turns out unsuitable after all (caller falls
     back to the scan path)."""
-    from karpenter_tpu.solver.jax_backend import _pad1
     from karpenter_tpu.solver.types import GROUP_BUCKETS
 
     catalog = problem.catalog
@@ -372,7 +415,12 @@ def dispatch_flat(solver, problem: EncodedProblem) -> Optional[FlatAttempt]:
     item_gid[:total] = order
     item_live = np.zeros(I_pad, bool)
     item_live[:total] = True
-    row = _pad1(np.ascontiguousarray(problem.label_rows[0]), O_pad)
+    U = problem.label_rows.shape[0]
+    U_pad = bucket(U, (4, 8, 16, 32))
+    rows = np.zeros((U_pad, O_pad), bool)
+    rows[:U, :O] = problem.label_rows
+    item_row = np.zeros(I_pad, np.int32)
+    item_row[:total] = problem.label_idx[order]
 
     N_cap = min(solver.options.max_nodes,
                 bucket(max(total, 1), NODE_BUCKETS))
@@ -383,8 +431,9 @@ def dispatch_flat(solver, problem: EncodedProblem) -> Optional[FlatAttempt]:
     if N * G_pad >= (1 << 31) - 1:
         return None
     a = FlatAttempt(item_req=item_req, item_gid=item_gid,
-                    item_live=item_live, row=row, G_pad=G_pad, O_pad=O_pad,
-                    I_pad=I_pad, N=N, N_cap=N_cap, K=K, out_dev=None,
+                    item_live=item_live, rows=rows, item_row=item_row,
+                    G_pad=G_pad, O_pad=O_pad, I_pad=I_pad, U_pad=U_pad,
+                    N=N, N_cap=N_cap, K=K, out_dev=None,
                     t_disp=0.0, t_issued=0.0)
     _dispatch_attempt(solver, problem, a)
     return a
@@ -395,8 +444,9 @@ def _dispatch_attempt(solver, problem, a: FlatAttempt) -> None:
         problem.catalog, a.O_pad)
     a.t_disp = time.perf_counter()
     a.out_dev = flat_solve_kernel(
-        a.item_req, a.item_gid, a.item_live, a.row, off_alloc, off_rank,
-        off_price, I=a.I_pad, O=a.O_pad, G=a.G_pad, N=a.N, K=a.K)
+        a.item_req, a.item_gid, a.item_live, a.rows, a.item_row, off_alloc,
+        off_rank, off_price, I=a.I_pad, O=a.O_pad, G=a.G_pad, N=a.N,
+        K=a.K, U=a.U_pad)
     try:
         a.out_dev.copy_to_host_async()
     except Exception:  # noqa: BLE001 — CPU arrays may not support it
@@ -427,7 +477,8 @@ def finalize_flat(solver, problem: EncodedProblem, a: FlatAttempt) -> Plan:
             "exec_fetch_s": t_fetch - a.t_issued,
             "d2h_bytes": int(out_np.nbytes),
             "h2d_bytes": int(a.item_req.nbytes + a.item_gid.nbytes
-                             + a.item_live.nbytes + a.row.nbytes),
+                             + a.item_live.nbytes + a.rows.nbytes
+                             + a.item_row.nbytes),
             "G": G_pad, "O": a.O_pad, "N": N, "I": a.I_pad}
         if spilled > 0 and a.N < a.N_cap:
             a.N = min(a.N_cap, bucket(a.N * 4, NODE_BUCKETS))

@@ -72,6 +72,44 @@ class TestFlatQuality:
         assert sorted(plan.unplaced_pods) == sorted(
             f"default/huge{i}" for i in range(5))
 
+    @pytest.mark.parametrize("seed", [0, 1])
+    def test_multi_row_constrained_hetero(self, seed):
+        """Mixed constraint rows on the flat path (round-4 U<=32
+        generalization): zone-pinned and capacity-type-limited subsets
+        ride the same bins only where their rows allow — every hard
+        constraint must hold in the decoded plan."""
+        from karpenter_tpu.apis.requirements import (
+            LABEL_CAPACITY_TYPE, LABEL_ZONE, Operator, Requirement,
+        )
+
+        catalog = make_catalog()
+        rng = np.random.RandomState(seed)
+        pods = []
+        for i in range(700):
+            kw = {}
+            r = rng.rand()
+            if r < 0.2:
+                kw["node_selector"] = ((LABEL_ZONE,
+                                        catalog.zones[rng.randint(3)]),)
+            elif r < 0.3:
+                kw["required_requirements"] = (Requirement(
+                    LABEL_CAPACITY_TYPE, Operator.IN, ("on-demand",)),)
+            pods.append(PodSpec(
+                f"m{i}", requests=ResourceRequests(
+                    int(rng.randint(100, 4000)),
+                    int(rng.randint(256, 8192)), 0, 1), **kw))
+        problem = encode(pods, catalog)
+        assert problem.label_rows.shape[0] > 1
+        js = JaxSolver(flat_opts())
+        assert flat_viable(problem, js.options)
+        plan = js.solve_encoded(problem)
+        assert js.last_stats.get("path") == "flat"
+        assert validate_plan(plan, pods, catalog) == []
+        assert not plan.unplaced_pods
+        oracle = GreedySolver().solve_encoded(problem)
+        assert plan.total_cost_per_hour <= \
+            oracle.total_cost_per_hour * (1.0 + 1e-6)
+
     def test_node_escalation_on_tight_budget(self):
         catalog = make_catalog()
         pods = hetero_pods(600, seed=5)
@@ -103,16 +141,14 @@ class TestFlatGate:
         problem = encode(pods, catalog)
         assert not flat_viable(problem, flat_opts())
 
-    def test_multi_label_row_falls_back(self):
+    def test_many_label_rows_fall_back(self):
+        # > 32 distinct rows exceeds the row-set matrix; scan owns it
         catalog = make_catalog()
-        pods = hetero_pods(64, seed=7)
-        pods += [PodSpec(f"z{i}", requests=ResourceRequests(200, 512, 0, 1),
-                         node_selector=(("topology.kubernetes.io/zone",
-                                         catalog.zones[0]),))
-                 for i in range(4)]
-        problem = encode(pods, catalog)
-        assert problem.label_rows.shape[0] > 1
-        assert not flat_viable(problem, flat_opts())
+        problem = encode(hetero_pods(64, seed=7), catalog)
+        fat = problem.replace(
+            label_rows=np.ones((33, catalog.num_offerings), dtype=bool),
+            label_idx=np.zeros(problem.num_groups, dtype=np.int32))
+        assert not flat_viable(fat, flat_opts())
 
     def test_off_option(self):
         catalog = make_catalog()
