@@ -11,15 +11,18 @@ that regime, built from TPU-friendly primitives only (sorts, cumsums,
 segment reductions — no sequential dependence on G).  It deliberately
 reproduces the ORACLE'S ECONOMICS in parallel form:
 
-1. **Per-item class**: each item's class is the cheapest offering that
-   fits it alone — exactly the oracle's new-node choice for one pod
-   (greedy.py cost_per_pod at remaining=1; the reference's cheapest-fit
-   scan, cloudprovider.go:321-352 + instancetype.go:88-110).  A class
-   bin packs against that offering's allocatable, so every class item
-   fits a class bin by construction (no covering-offering precondition).
+1. **Per-item class**: each label row gets ONE covering offering by
+   fluid economics (cheapest rank x bins-needed over the row's
+   componentwise-max request); items the row offering cannot hold fall
+   back to their own cheapest-fitting offering — the oracle's new-node
+   choice for one pod (greedy.py cost_per_pod at remaining=1; the
+   reference's cheapest-fit scan, cloudprovider.go:321-352 +
+   instancetype.go:88-110).  A class bin packs against its offering's
+   allocatable, so every class item fits a class bin by construction.
 2. **Fill pass (per round)**: remaining items are dealt snake-order
-   over OPEN bins ranked by slack, each bin keeping the largest-first
-   prefix that fits its residual — the parallel form of the oracle's
+   over OPEN bins ranked by slack — gated on the item's row allowing
+   the bin's offering — each bin keeping the largest-first prefix that
+   fits its residual: the parallel form of the oracle's
    fill-open-nodes-before-opening rule, and the step that keeps
    utilization at FFD levels.
 3. **Open pass (per round)**: per class, ``ceil(fluid x (1+beta))``
@@ -28,9 +31,10 @@ reproduces the ORACLE'S ECONOMICS in parallel form:
    check guarantees feasibility, overflow respills into the next round.
    A bounded ``while_loop`` runs both passes on device.
 4. **Right-sizing**: every open bin is re-priced to the cheapest
-   offering that fits its final load (same feasibility argument as
-   jax_backend._right_size: one shared label row, the load dominates
-   every item on the bin).
+   offering that fits its final load AND is allowed by every row class
+   present on the bin (one [N,U] x [U,O] matmul); the bin's current
+   offering was row-checked per item at placement, so a candidate
+   always exists.
 
 Cost quality: fill + class economics + right-sizing tracks the host FFD
 oracle on heterogeneous mixes (right-sizing reclaims the partially-
