@@ -70,13 +70,19 @@ def p50(xs):
     return float(np.percentile(xs, 50))
 
 
-def build_hetero_workload(num_pods: int, num_types: int, seed: int = 7):
+def build_hetero_workload(num_pods: int, num_types: int, seed: int = 7,
+                          constrained_frac: float = 0.0):
     """Heterogeneous variant: near-unique request shapes, so signature
     compression yields THOUSANDS of groups instead of ~50.  This is the
     regime that actually stresses the solve (G x N x O work) — config #3's
     size-class mix collapses to a handful of groups, which any host loop
-    handles in milliseconds."""
+    handles in milliseconds.  ``constrained_frac`` adds hard zone pins /
+    capacity-type limits to that fraction of pods (multiple label rows:
+    the flat path's U<=32 generalization)."""
     from karpenter_tpu.apis.pod import PodSpec, ResourceRequests
+    from karpenter_tpu.apis.requirements import (
+        LABEL_CAPACITY_TYPE, LABEL_ZONE, Operator, Requirement,
+    )
 
     catalog = build_catalog(num_types)
     rng = np.random.RandomState(seed)
@@ -84,8 +90,17 @@ def build_hetero_workload(num_pods: int, num_types: int, seed: int = 7):
     for i in range(num_pods):
         cpu = int(rng.randint(100, 8000))
         mem = int(rng.randint(256, 32768))
+        kw = {}
+        r = rng.rand()
+        if r < constrained_frac * 0.7:
+            kw["node_selector"] = ((LABEL_ZONE,
+                                    f"us-south-{rng.randint(3) + 1}"),)
+        elif r < constrained_frac:
+            kw["required_requirements"] = (Requirement(
+                LABEL_CAPACITY_TYPE, Operator.IN, ("on-demand",)),)
         pods.append(PodSpec(f"h{i}",
-                            requests=ResourceRequests(cpu, mem, 0, 1)))
+                            requests=ResourceRequests(cpu, mem, 0, 1),
+                            **kw))
     return pods, catalog
 
 
@@ -193,7 +208,7 @@ def run_hetero(num_pods: int, num_types: int, iters: int) -> dict:
         vs, gate = naive_p50 / jp, "below-baseline"
     else:
         vs, gate = naive_p50 / jp, "ok"
-    return {
+    out = {
         "hetero_groups": problem.num_groups,
         "hetero_wall_ms": round(jp * 1000, 3),
         "hetero_pipelined_ms": round(pipe_ms, 3),
@@ -207,6 +222,63 @@ def run_hetero(num_pods: int, num_types: int, iters: int) -> dict:
             naive_p50 * 1000 / pipe_ms, 2) if naive_p50 else 0.0,
         "hetero_baseline_gate": gate,
         "hetero_cost_ratio": round(cost_ratio, 4),
+    }
+    out.update(run_hetero_constrained(num_pods, num_types,
+                                      max(2, iters // 2)))
+    return out
+
+
+def run_hetero_constrained(num_pods: int, num_types: int,
+                           iters: int) -> dict:
+    """Constrained heterogeneous sub-config: 30% of the near-unique pods
+    carry hard zone pins / capacity-type limits (multiple label rows) —
+    the regime the flat path's round-4 U<=32 generalization exists for;
+    without it these windows fell back to the G-sequential scan."""
+    from karpenter_tpu.solver import (
+        GreedySolver, JaxSolver, SolveRequest, encode, validate_plan,
+    )
+    from karpenter_tpu.solver.greedy import expand_per_pod, solve_per_pod_native
+    from karpenter_tpu.solver.types import SolverOptions
+
+    pods, catalog = build_hetero_workload(num_pods, num_types, seed=11,
+                                          constrained_frac=0.3)
+    request = SolveRequest(pods, catalog)
+    problem = encode(pods, catalog)
+    js = JaxSolver()
+    plan = js.solve(request)
+    errs = validate_plan(plan, pods, catalog)
+    if errs:
+        return {"hetero_constrained_error": f"INVALID_PLAN: {errs[:2]}"}
+    walls = []
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        js.solve(request)
+        walls.append(time.perf_counter() - t0)
+
+    expanded = expand_per_pod(problem)
+    naive_p50 = 0.0
+    if solve_per_pod_native(problem, expanded=expanded) is not None:
+        ntimes = []
+        for _ in range(iters):
+            t0 = time.perf_counter()
+            solve_per_pod_native(problem, expanded=expanded)
+            ntimes.append(time.perf_counter() - t0)
+        naive_p50 = p50(ntimes)
+    gplan = GreedySolver(SolverOptions(backend="greedy",
+                                       max_nodes=32768)).solve(request)
+    jp = p50(walls)
+    cost_ratio = plan.total_cost_per_hour / max(gplan.total_cost_per_hour,
+                                                1e-9)
+    return {
+        "hetero_constrained_rows": int(problem.label_rows.shape[0]),
+        "hetero_constrained_wall_ms": round(jp * 1000, 3),
+        "hetero_constrained_path": js.last_stats.get("path", ""),
+        "hetero_constrained_vs_baseline": round(
+            naive_p50 / jp, 2) if naive_p50 else 0.0,
+        "hetero_constrained_naive_host_ms": round(naive_p50 * 1000, 3),
+        "hetero_constrained_cost_ratio": round(cost_ratio, 4),
+        "hetero_constrained_placed_delta":
+            plan.placed_count - gplan.placed_count,
     }
 
 
