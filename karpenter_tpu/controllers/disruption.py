@@ -230,6 +230,16 @@ class DisruptionController(PollController):
         catalog = self.provisioner._catalog_for(nodeclass)
         if catalog is None:
             return None
+        if pool is not None and (pool.cpu_limit_milli
+                                 or pool.memory_limit_mib):
+            # blue/green repack doubles the pool's footprint during the
+            # overlap, which a resource-limited pool cannot absorb;
+            # rather than transiently violate spec.limits (or apply a
+            # trimmed fleet that strands pods mid-replacement), repack
+            # defers to the consolidation paths for capped pools
+            log.info("repack skipped: pool has resource limits",
+                     pool=pool.name)
+            return None
         pods = [p.spec for p in self.cluster.list("pods")]
         if not pods:
             return None

@@ -84,6 +84,9 @@ class Provisioner:
         # a time for the same reason).  The pending-set recheck in
         # _on_window happens under this lock.
         self._solve_lock = threading.Lock()
+        # provider-wide type->(cpu,mem) fallback for pool-limit
+        # accounting (claims whose type left the filtered catalog)
+        self._all_type_alloc: Optional[Dict[str, Tuple[int, int]]] = None
         self._window: Optional[SolveWindow] = None
         self._unsubscribe = None
 
@@ -217,6 +220,11 @@ class Provisioner:
 
         plans: List[Plan] = []
         nominated: Dict[str, str] = {}   # pod key -> claim name
+        # pods trimmed by a pool resource limit this window: the Warning
+        # event is emitted only for those STILL unnominated at window
+        # end (another pool may place them — an event then would be a
+        # false alarm)
+        limit_dropped: Dict[str, str] = {}  # pod key -> pool name
         # pods a soft-tainted pool was denied in pass 0: ONLY these are
         # re-offered in pass 1 — re-running the whole ladder would
         # double every solve and re-issue failed creates within one
@@ -246,8 +254,19 @@ class Provisioner:
                 catalog = self._catalog_for(nodeclass)
                 if catalog is None:
                     continue
+                usage = self._pool_usage(pool, catalog) \
+                    if (pool.cpu_limit_milli or pool.memory_limit_mib) \
+                    else (0, 0)
+                solve_catalog = self._catalog_within_limits(pool, catalog,
+                                                            usage)
+                if solve_catalog is None:
+                    continue   # pool budget exhausted: pods stay pending
                 plan = self.solver.solve(
-                    SolveRequest(pool_pods, catalog, pool))
+                    SolveRequest(pool_pods, solve_catalog, pool))
+                plan, dropped = self._apply_pool_limits(pool, plan,
+                                                        catalog, usage)
+                for pn in dropped:
+                    limit_dropped.setdefault(pn, pool.name)
                 if not plan.nodes:
                     continue
                 actuator = self.actuator_for(nodeclass)
@@ -268,8 +287,135 @@ class Provisioner:
                 # next pool (or the soft-waived second pass)
                 pods = [p for p in pods if pod_key(p) not in nominated]
                 if not pods:
-                    return plans, nominated
+                    break
+            if not pods:
+                break
+        for pn, pool_name in limit_dropped.items():
+            if pn not in nominated:
+                self.cluster.record_event(
+                    "Pod", pn, "Warning", "NodePoolLimitReached",
+                    f"pool {pool_name} resource limit blocks provisioning")
         return plans, nominated
+
+    def _type_alloc_for(self, name: str, catalog):
+        """(cpu_milli, mem_mib) of an instance type: the pool's filtered
+        catalog first, then the PROVIDER-WIDE type table — a claim whose
+        type was later filtered out of the NodeClass selection must still
+        count against the pool limit, or the budget silently resets."""
+        try:
+            ti = catalog.type_names.index(name)
+            return int(catalog.type_alloc[ti, 0]), int(catalog.type_alloc[ti, 1])
+        except ValueError:
+            pass
+        fallback = self._all_type_alloc
+        if fallback is None or name not in fallback:
+            fallback = {}
+            try:
+                for it in self.catalog_provider.list():
+                    fallback[it.name] = (int(it.allocatable_cpu_milli),
+                                         int(it.allocatable_memory_mib))
+            except Exception:  # noqa: BLE001 — provider outage: see below
+                pass
+            self._all_type_alloc = fallback
+        if name in fallback:
+            return fallback[name]
+        log.warning("unknown instance type for pool-limit accounting; "
+                    "counting zero", instance_type=name)
+        return 0, 0
+
+    def _pool_usage(self, pool: NodePool, catalog):
+        """(cpu_milli, mem_mib) currently provisioned by this pool's live
+        claims."""
+        used_cpu = used_mem = 0
+        for claim in self.cluster.list("nodeclaims"):
+            if claim.nodepool_name != pool.name or claim.deleted:
+                continue
+            cpu, mem = self._type_alloc_for(claim.instance_type, catalog)
+            used_cpu += cpu
+            used_mem += mem
+        return used_cpu, used_mem
+
+    def _catalog_within_limits(self, pool: NodePool, catalog, usage):
+        """Steer the SOLVE under the pool's remaining resource budget
+        (karpenter-core passes remaining capacity into scheduling): a
+        shallow catalog view masks out offerings larger than what's left,
+        so the solver picks right-sized nodes instead of producing a plan
+        the limit trim must discard wholesale.  None = budget exhausted.
+        The view gets a DERIVED uid (it must not evict the base
+        catalog's device tensors — JaxSolver prunes stale generations per
+        uid) and an availability generation keyed by the MASK content,
+        which is stable across windows while the binding offering set is
+        unchanged."""
+        if not pool.cpu_limit_milli and not pool.memory_limit_mib:
+            return catalog
+        used_cpu, used_mem = usage
+        rem_cpu = (pool.cpu_limit_milli - used_cpu) \
+            if pool.cpu_limit_milli else None
+        rem_mem = (pool.memory_limit_mib - used_mem) \
+            if pool.memory_limit_mib else None
+        if (rem_cpu is not None and rem_cpu <= 0) or \
+                (rem_mem is not None and rem_mem <= 0):
+            return None
+        import copy
+        import hashlib
+
+        alloc = catalog.offering_alloc()
+        avail = catalog.off_avail.copy()
+        if rem_cpu is not None:
+            avail &= alloc[:, 0] <= rem_cpu
+        if rem_mem is not None:
+            avail &= alloc[:, 1] <= rem_mem
+        if avail.sum() == catalog.off_avail.sum():
+            return catalog   # budget doesn't bind any offering: no view
+        view = copy.copy(catalog)
+        view.off_avail = avail
+        view.uid = f"{catalog.uid}-limit-{pool.name}"
+        view.availability_generation = (
+            "pool-limit", hashlib.sha1(avail.tobytes()).hexdigest()[:12],
+            catalog.availability_generation)
+        return view
+
+    def _apply_pool_limits(self, pool: NodePool, plan: Plan, catalog,
+                           usage) -> Tuple[Plan, List[str]]:
+        """Enforce NodePool resource limits (karpenter-core semantics the
+        reference inherits upstream: capacity is never provisioned past
+        `spec.limits`; the overflow's pods stay pending).  Plan nodes are
+        kept in solver order until existing pool usage + kept nodes
+        would exceed the cpu/memory limit; dropped nodes' pods join
+        unplaced and retry next window (the limit may have freed up).
+        Returns (trimmed plan, dropped pod keys)."""
+        if not pool.cpu_limit_milli and not pool.memory_limit_mib:
+            return plan, []
+        used_cpu, used_mem = usage
+        keep = []
+        dropped: List[str] = []
+        for node in plan.nodes:
+            alloc = catalog.offering_alloc()[node.offering_index] \
+                if 0 <= node.offering_index < catalog.num_offerings \
+                else None
+            if alloc is None:
+                keep.append(node)
+                continue
+            over_cpu = pool.cpu_limit_milli and \
+                used_cpu + int(alloc[0]) > pool.cpu_limit_milli
+            over_mem = pool.memory_limit_mib and \
+                used_mem + int(alloc[1]) > pool.memory_limit_mib
+            if over_cpu or over_mem:
+                dropped.extend(node.pod_names)
+                continue
+            used_cpu += int(alloc[0])
+            used_mem += int(alloc[1])
+            keep.append(node)
+        if not dropped:
+            return plan, []
+        log.warning("nodepool limit reached; trimming plan",
+                    pool=pool.name, dropped_nodes=len(plan.nodes) - len(keep),
+                    pending_pods=len(dropped))
+        return Plan(nodes=keep,
+                    unplaced_pods=list(plan.unplaced_pods) + dropped,
+                    total_cost_per_hour=sum(n.price for n in keep),
+                    backend=plan.backend,
+                    solve_seconds=plan.solve_seconds), dropped
 
     def actuator_for(self, nodeclass: NodeClass):
         """Per-NodeClass actuation routing (ref factory.go:70) — the ONE

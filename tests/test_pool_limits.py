@@ -1,0 +1,86 @@
+"""NodePool resource limits (karpenter-core `spec.limits` semantics the
+reference inherits upstream): capacity is never provisioned past the
+pool's cpu/memory budget; overflow pods stay pending and retry."""
+import pytest
+
+from karpenter_tpu.apis.nodeclaim import NodePool
+from karpenter_tpu.apis.pod import PodSpec, ResourceRequests
+from karpenter_tpu.catalog import InstanceTypeProvider, PricingProvider
+from karpenter_tpu.catalog.unavailable import UnavailableOfferings
+from karpenter_tpu.cloud.fake import FakeCloud
+from karpenter_tpu.core.actuator import Actuator
+from karpenter_tpu.core.cluster import ClusterState
+from karpenter_tpu.core.provisioner import Provisioner, ProvisionerOptions
+from karpenter_tpu.solver.types import SolverOptions
+from tests.test_core import ready_nodeclass
+
+
+@pytest.fixture
+def rig():
+    cloud = FakeCloud()
+    pricing = PricingProvider(cloud)
+    unavail = UnavailableOfferings()
+    itp = InstanceTypeProvider(cloud, pricing, unavail)
+    cluster = ClusterState()
+    cluster.add_nodeclass(ready_nodeclass())
+    actuator = Actuator(cloud, cluster, unavailable=unavail)
+    prov = Provisioner(cluster, itp, actuator, ProvisionerOptions(
+        solver=SolverOptions(backend="greedy")))
+    yield cluster, prov
+    pricing.close()
+
+
+def pods_of(n):
+    return [PodSpec(f"p{i}", requests=ResourceRequests(1000, 2048))
+            for i in range(n)]
+
+
+class TestPoolLimits:
+    def test_cpu_limit_blocks_overflow(self, rig):
+        cluster, prov = rig
+        # 40 x 1-core pods but a 8000m pool budget: only ~8 cores of
+        # nodes may exist; the rest stay pending
+        cluster.add_nodepool(NodePool(name="capped",
+                                      nodeclass_name="default",
+                                      cpu_limit_milli=8000))
+        plans, nominated = prov._provision(pods_of(40))
+        catalog = prov._catalog_for(cluster.get_nodeclass("default"))
+        type_idx = {n: i for i, n in enumerate(catalog.type_names)}
+        total_cpu = sum(
+            int(catalog.type_alloc[type_idx[c.instance_type], 0])
+            for c in cluster.list("nodeclaims"))
+        assert 0 < total_cpu <= 8000
+        assert len(nominated) < 40          # overflow stayed pending
+        # every pending pod got the limit event
+        dropped = [f"default/p{i}" for i in range(40)
+                   if f"default/p{i}" not in nominated]
+        assert dropped
+        ev = cluster.events_for("Pod", dropped[0])
+        assert any(e.reason == "NodePoolLimitReached" for e in ev)
+
+    def test_existing_usage_counts_against_limit(self, rig):
+        cluster, prov = rig
+        cluster.add_nodepool(NodePool(name="capped",
+                                      nodeclass_name="default",
+                                      cpu_limit_milli=8000))
+        prov._provision(pods_of(6))
+        before = len(cluster.list("nodeclaims"))
+        assert before > 0
+        # pool is near its budget: a second window must respect what the
+        # first already consumed
+        prov._provision([PodSpec(f"q{i}",
+                                 requests=ResourceRequests(1000, 2048))
+                         for i in range(40)])
+        catalog = prov._catalog_for(cluster.get_nodeclass("default"))
+        type_idx = {n: i for i, n in enumerate(catalog.type_names)}
+        total_cpu = sum(
+            int(catalog.type_alloc[type_idx[c.instance_type], 0])
+            for c in cluster.list("nodeclaims"))
+        assert total_cpu <= 8000
+
+    def test_unlimited_pool_unchanged(self, rig):
+        cluster, prov = rig
+        cluster.add_nodepool(NodePool(name="open",
+                                      nodeclass_name="default"))
+        plans, nominated = prov._provision(pods_of(20))
+        assert len(nominated) == 20
