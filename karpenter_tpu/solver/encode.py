@@ -341,6 +341,43 @@ _DEFAULT_POOL = NodePool(name="default")
 # re-encodes the same pending set every window; this skips the per-group
 # mask construction entirely on repeats.
 _SIG_LOWER_CACHE: Dict[Tuple, Tuple] = {}
+# cap on distinct catalog generations kept in the sig-lowering cache: a
+# flat namespace cleared on any generation change gives ZERO reuse when
+# catalogs alternate in one process (multi-NodeClass pools; pool-limit
+# views) — instead stale generations are evicted only past this bound
+_SIG_CACHE_MAX_GENS = 8
+_SIG_CACHE_GENS: List[Tuple] = []   # insertion-ordered live generations
+
+
+def clear_sig_cache() -> None:
+    """Test/bench hook: drop every cached signature lowering."""
+    _SIG_LOWER_CACHE.clear()
+    _SIG_CACHE_GENS.clear()
+
+
+def _sig_cache_admit(gen_key: Tuple) -> None:
+    """Track ``gen_key`` as live (LRU).  A NEW generation of a uid
+    evicts that uid's older generations immediately — generations are
+    monotonic per catalog, so their entries can never be hit again and
+    would otherwise pile up 8x in the single-catalog steady state.  The
+    cap then only bounds DISTINCT catalogs (the alternation case the
+    per-generation structure exists for)."""
+    if gen_key in _SIG_CACHE_GENS:
+        if _SIG_CACHE_GENS[-1] != gen_key:        # LRU refresh
+            _SIG_CACHE_GENS.remove(gen_key)
+            _SIG_CACHE_GENS.append(gen_key)
+        return
+    uid = gen_key[0]
+    dead = [g for g in _SIG_CACHE_GENS if g[0] == uid]
+    _SIG_CACHE_GENS.append(gen_key)
+    while len(_SIG_CACHE_GENS) > _SIG_CACHE_MAX_GENS:
+        dead.append(_SIG_CACHE_GENS[0])
+        del _SIG_CACHE_GENS[0]
+    for g in dead:
+        if g in _SIG_CACHE_GENS:
+            _SIG_CACHE_GENS.remove(g)
+        for k in [k for k in _SIG_LOWER_CACHE if k[1:] == g]:
+            del _SIG_LOWER_CACHE[k]
 
 # whole-encode memo: the provisioner's repack loop re-encodes an
 # unchanged pending set every window (10 s period), and the pipelined
@@ -483,9 +520,8 @@ def _encode_impl(pods: Sequence[PodSpec], catalog: CatalogArrays,
         return pi
     cache_ok = nodepool is _DEFAULT_POOL
     gen_key = (catalog.uid, catalog.generation, catalog.availability_generation)
-    if cache_ok and _SIG_LOWER_CACHE and \
-            next(iter(_SIG_LOWER_CACHE))[1:] != gen_key:
-        _SIG_LOWER_CACHE.clear()   # catalog moved on; drop stale masks
+    if cache_ok:
+        _sig_cache_admit(gen_key)
 
     def row_for(label, zone_sig, pinned_zone, requirements) -> int:
         # the label-row dedup key is CONTENT-keyed on the label mask

@@ -67,6 +67,46 @@ class TestEncodeMemo:
                                             labels={"env": "prod"}))
         assert p3 is not p1
 
+    def test_alternating_catalogs_keep_sig_cache_warm(self):
+        # multi-NodeClass pools (and pool-limit views) alternate
+        # catalogs within one process; the per-generation sig cache must
+        # serve BOTH instead of clearing on every switch — asserted on
+        # CACHE STATE, not wall time (a timing assertion cannot
+        # distinguish thrash at these sizes)
+        from karpenter_tpu.solver.encode import (
+            _SIG_LOWER_CACHE, clear_sig_cache,
+        )
+
+        cat_a, cat_b = make_catalog(), make_catalog()
+        pods = [PodSpec(f"p{i}",
+                        requests=ResourceRequests(100 + i, 1024, 0, 1))
+                for i in range(40)]          # 40 distinct signatures
+        clear_sig_cache()
+        encode(pods, cat_a)
+        encode(pods, cat_b)
+        gens = {k[1:] for k in _SIG_LOWER_CACHE}
+        gen_a = (cat_a.uid, cat_a.generation, cat_a.availability_generation)
+        gen_b = (cat_b.uid, cat_b.generation, cat_b.availability_generation)
+        assert gen_a in gens and gen_b in gens   # neither evicted the other
+        assert sum(1 for k in _SIG_LOWER_CACHE if k[1:] == gen_a) >= 40
+
+    def test_new_generation_evicts_same_catalog_immediately(self):
+        from karpenter_tpu.solver.encode import (
+            _SIG_LOWER_CACHE, clear_sig_cache,
+        )
+
+        catalog = make_catalog()
+        pods = pods_of(30)
+        clear_sig_cache()
+        encode(pods, catalog)
+        old_gen = (catalog.uid, catalog.generation,
+                   catalog.availability_generation)
+        catalog.availability_generation = "bumped"
+        encode(pods, catalog)
+        # monotonic generations of one catalog never recur: the old
+        # sub-cache must be gone at once, not after 8 more generations
+        assert not any(k[1:] == old_gen for k in _SIG_LOWER_CACHE)
+
     def test_memo_bounded(self):
         catalog = make_catalog()
         _ENCODE_MEMO.clear()
