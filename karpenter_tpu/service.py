@@ -177,6 +177,11 @@ class SolverServer:
         pref_lambda = (int(arrays["pref_lambda_bp"]) / 10000.0
                        if "pref_lambda_bp" in arrays else None)
         with self._solver_lock:
+            out = self._solve_flat_maybe(cat, arrays, pref_rows is not None)
+            if out is not None:
+                metrics.SOLVE_DURATION.labels("sidecar").observe(
+                    time.perf_counter() - t0)
+                return out
             prep = self._jax.prepare_arrays(
                 cat, arrays["group_req"], arrays["group_count"],
                 arrays["group_cap"], arrays["compat"],
@@ -190,6 +195,67 @@ class SolverServer:
             time.perf_counter() - t0)
         return _pack(node_off=node_off, assign=assign.astype(np.int32),
                      unplaced=unplaced, cost=np.float32(cost))
+
+    def _solve_flat_maybe(self, cat, arrays, has_pref: bool):
+        """Route heterogeneous wire solves to the flat path (round 3's
+        G-sequential regression would otherwise survive on the REMOTE
+        backend only).  Returns packed wire bytes, or None for the
+        classic path.  With a COO-capable client (``coo_ok`` flag) the
+        assignment ships as (idx, cnt) — the dense [G, N] wire matrix is
+        hundreds of MB at the 10k-group shape."""
+        from karpenter_tpu.solver.flat import (
+            dispatch_flat, finalize_flat_arrays, flat_viable,
+        )
+        from karpenter_tpu.solver.jax_backend import (
+            dedup_rows, expand_coo_assign,
+        )
+
+        if has_pref:
+            return None
+        opts = self._jax.options
+        # cheap row-independent gates FIRST — the O(G x O) factoring
+        # below must not run on solves the flat path then rejects.
+        # The wire right_size flag must win over server defaults (the
+        # flat kernel's bin re-pricing IS a right-size pass), and the
+        # G threshold uses the REAL group count, not the wire padding —
+        # remote and local backends must route identically.
+        if opts.flat_solver == "off" or not bool(arrays["right_size"]):
+            return None
+        real_g = int((arrays["group_count"] > 0).sum())
+        if opts.flat_solver != "on" and real_g < opts.flat_min_groups:
+            return None
+        compat = arrays["compat"]
+        if "label_rows" in arrays and "label_idx" in arrays:
+            # fit-FREE factoring from the client's encoder: the flat
+            # path's row classes must not fragment on per-group fit
+            # patterns (dedup_rows rows contain fit, which at
+            # heterogeneous scale makes U explode past the 32-row gate)
+            rows = arrays["label_rows"].astype(bool)
+            label_idx = arrays["label_idx"]
+        else:
+            label_idx, rows = dedup_rows(compat)
+        shim = _WireProblem(
+            catalog=cat, group_req=arrays["group_req"],
+            group_count=arrays["group_count"],
+            group_cap=arrays["group_cap"],
+            label_rows=rows, label_idx=label_idx)
+        if not flat_viable(shim, self._jax.options):
+            return None
+        attempt = dispatch_flat(self._jax, shim)
+        if attempt is None:
+            return None
+        node_off, unplaced, cost, idx, cnt = finalize_flat_arrays(
+            self._jax, shim, attempt)
+        G = compat.shape[0]
+        if bool(arrays.get("coo_ok", False)):
+            return _pack(node_off=node_off, unplaced=unplaced[:G],
+                         cost=np.float32(cost), assign_coo_idx=idx,
+                         assign_coo_cnt=cnt,
+                         coo_g=np.int64(attempt.G_pad))
+        assign = expand_coo_assign(idx, cnt, attempt.G_pad,
+                                   node_off.shape[0])[:G]
+        return _pack(node_off=node_off, assign=assign.astype(np.int32),
+                     unplaced=unplaced[:G], cost=np.float32(cost))
 
     def _solve_batch(self, request: bytes, context) -> bytes:
         """Zone-candidate batch: C problems sharing req/count/cap and the
@@ -287,6 +353,31 @@ class SolverServer:
 # Client
 # ---------------------------------------------------------------------------
 
+class _WireProblem:
+    """EncodedProblem-shaped view over wire arrays, carrying exactly the
+    fields the flat path consumes (flat_viable / dispatch_flat /
+    estimate_nodes).  Decoding stays client-side — the server never sees
+    pod names."""
+
+    __slots__ = ("catalog", "group_req", "group_count", "group_cap",
+                 "label_rows", "label_idx", "pref_rows", "pref_idx")
+
+    def __init__(self, *, catalog, group_req, group_count, group_cap,
+                 label_rows, label_idx):
+        self.catalog = catalog
+        self.group_req = group_req
+        self.group_count = group_count
+        self.group_cap = group_cap
+        self.label_rows = label_rows
+        self.label_idx = label_idx
+        self.pref_rows = None
+        self.pref_idx = None
+
+    @property
+    def num_groups(self) -> int:
+        return int(self.group_req.shape[0])
+
+
 class RemoteSolver:
     """Drop-in solver backend speaking to a :class:`SolverServer`."""
 
@@ -341,6 +432,17 @@ class RemoteSolver:
         N = estimate_nodes(problem, N_cap, NODE_BUCKETS) \
             if self.options.adaptive_nodes else N_cap
         cat_id, gen = self._catalog_key(catalog)
+        # the fit-free label factoring rides the wire so the server's
+        # flat route classes by CONSTRAINT row, not fit pattern (an old
+        # sidecar ignores the extra keys)
+        extra_kw = {}
+        if problem.label_rows is not None and problem.label_idx is not None:
+            U = problem.label_rows.shape[0]
+            lidx = np.zeros(G, np.int32)
+            lidx[:problem.label_idx.shape[0]] = problem.label_idx
+            extra_kw = dict(
+                label_rows=_pad2(problem.label_rows, U, O),
+                label_idx=lidx)
         # soft preferences ride two extra (small) wire arrays; an old
         # sidecar ignores unknown npz keys, degrading to plain ranking
         pref_kw = {}
@@ -366,7 +468,8 @@ class RemoteSolver:
                 compat=_pad2(problem.compat, G, O),
                 num_nodes=np.int64(N),
                 right_size=np.bool_(self.options.right_size),
-                n_cap=np.int64(N_cap), **pref_kw)))
+                n_cap=np.int64(N_cap), coo_ok=np.bool_(True),
+                **extra_kw, **pref_kw)))
             if "error" in resp:
                 err = str(resp["error"])
                 # a restarted sidecar loses its catalog cache; our memo
@@ -390,6 +493,18 @@ class RemoteSolver:
                 N = min(N_cap, bucket(max(N, server_n) * 4, NODE_BUCKETS))
                 continue
             break
+        if "assign_coo_idx" in resp:
+            # flat-path COO wire: decode straight from entries — the
+            # dense [G, N] matrix never exists on either side
+            from karpenter_tpu.solver.encode import decode_plan_entries
+
+            Gp = int(resp["coo_g"])
+            cnt = resp["assign_coo_cnt"]
+            live = cnt > 0
+            fi = resp["assign_coo_idx"][live]
+            return decode_plan_entries(
+                problem, resp["node_off"], fi % Gp, fi // Gp, cnt[live],
+                resp["unplaced"], float(resp["cost"]), "remote")
         return decode_plan(problem, resp["node_off"],
                            resp["assign"].astype(np.int32),
                            resp["unplaced"], float(resp["cost"]), "remote")

@@ -368,14 +368,16 @@ def _sig_cache_admit(gen_key: Tuple) -> None:
             _SIG_CACHE_GENS.append(gen_key)
         return
     uid = gen_key[0]
+    # drain this uid's dead generations FIRST: they must not count
+    # toward the cap, or a generation bump at exactly MAX_GENS live
+    # catalogs would evict a LIVE distinct catalog instead
     dead = [g for g in _SIG_CACHE_GENS if g[0] == uid]
+    for g in dead:
+        _SIG_CACHE_GENS.remove(g)
     _SIG_CACHE_GENS.append(gen_key)
     while len(_SIG_CACHE_GENS) > _SIG_CACHE_MAX_GENS:
-        dead.append(_SIG_CACHE_GENS[0])
-        del _SIG_CACHE_GENS[0]
+        dead.append(_SIG_CACHE_GENS.pop(0))
     for g in dead:
-        if g in _SIG_CACHE_GENS:
-            _SIG_CACHE_GENS.remove(g)
         for k in [k for k in _SIG_LOWER_CACHE if k[1:] == g]:
             del _SIG_LOWER_CACHE[k]
 
@@ -713,8 +715,9 @@ def estimate_nodes(problem: EncodedProblem, n_cap: int,
            * problem.group_count[:, None]).sum(axis=0)            # [R]
     best = catalog.offering_alloc().max(axis=0).astype(np.int64)  # [R]
     lb = int(np.max(np.ceil(tot / np.maximum(best, 1))))
-    # per-node-capped groups (anti-affinity) need >= count/cap nodes
-    capped = problem.group_cap < BIG_CAP
+    # per-node-capped groups (anti-affinity) need >= count/cap nodes;
+    # cap == 0 rows are padding (count 0), not a real constraint
+    capped = (problem.group_cap < BIG_CAP) & (problem.group_cap > 0)
     if capped.any():
         lb = max(lb, int(np.max(np.ceil(
             problem.group_count[capped] / problem.group_cap[capped]))))

@@ -422,7 +422,8 @@ def dispatch_flat(solver, problem: EncodedProblem) -> Optional[FlatAttempt]:
     U = problem.label_rows.shape[0]
     U_pad = bucket(U, (4, 8, 16, 32))
     rows = np.zeros((U_pad, O_pad), bool)
-    rows[:U, :O] = problem.label_rows
+    src_w = min(problem.label_rows.shape[1], O_pad)
+    rows[:U, :src_w] = problem.label_rows[:, :src_w]
     item_row = np.zeros(I_pad, np.int32)
     item_row[:total] = problem.label_idx[order]
 
@@ -458,11 +459,12 @@ def _dispatch_attempt(solver, problem, a: FlatAttempt) -> None:
     a.t_issued = time.perf_counter()
 
 
-def finalize_flat(solver, problem: EncodedProblem, a: FlatAttempt) -> Plan:
-    """Fetch + decode a flat attempt, escalating the node axis on spill
-    (synchronous re-dispatch; spill is rare by construction)."""
-    from karpenter_tpu.solver.encode import decode_plan_entries
-
+def finalize_flat_arrays(solver, problem, a: FlatAttempt):
+    """Fetch a flat attempt, escalating the node axis on spill
+    (synchronous re-dispatch; spill is rare by construction).  Returns
+    raw result arrays (node_off [N], unplaced [G_pad], cost, COO idx,
+    COO cnt) — the sidecar's wire layer consumes these directly;
+    :func:`finalize_flat` decodes them to a Plan."""
     while True:
         N, G_pad, K = a.N, a.G_pad, a.K
         out_np = np.asarray(a.out_dev)
@@ -488,11 +490,18 @@ def finalize_flat(solver, problem: EncodedProblem, a: FlatAttempt) -> Plan:
             a.N = min(a.N_cap, bucket(a.N * 4, NODE_BUCKETS))
             _dispatch_attempt(solver, problem, a)
             continue
-        break
+        return node_off, unplaced, cost, idx, cnt
+
+
+def finalize_flat(solver, problem: EncodedProblem, a: FlatAttempt) -> Plan:
+    from karpenter_tpu.solver.encode import decode_plan_entries
+
+    node_off, unplaced, cost, idx, cnt = finalize_flat_arrays(
+        solver, problem, a)
     live = cnt > 0
     flat_idx = idx[live]
     return decode_plan_entries(
-        problem, node_off, flat_idx % G_pad, flat_idx // G_pad,
+        problem, node_off, flat_idx % a.G_pad, flat_idx // a.G_pad,
         cnt[live], unplaced, cost, "jax")
 
 

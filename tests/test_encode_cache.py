@@ -107,6 +107,23 @@ class TestEncodeMemo:
         # sub-cache must be gone at once, not after 8 more generations
         assert not any(k[1:] == old_gen for k in _SIG_LOWER_CACHE)
 
+    def test_generation_bump_never_evicts_live_distinct_catalog(self):
+        from karpenter_tpu.solver.encode import (
+            _SIG_CACHE_GENS, _SIG_CACHE_MAX_GENS, _sig_cache_admit,
+            clear_sig_cache,
+        )
+
+        clear_sig_cache()
+        for u in range(_SIG_CACHE_MAX_GENS):
+            _sig_cache_admit((f"uid{u}", 1, "g1"))
+        # bumping the LAST catalog's generation at exactly MAX live
+        # catalogs must evict only its own dead generation
+        _sig_cache_admit((f"uid{_SIG_CACHE_MAX_GENS - 1}", 2, "g2"))
+        assert ("uid0", 1, "g1") in _SIG_CACHE_GENS
+        assert (f"uid{_SIG_CACHE_MAX_GENS - 1}", 1, "g1") \
+            not in _SIG_CACHE_GENS
+        clear_sig_cache()
+
     def test_memo_bounded(self):
         catalog = make_catalog()
         _ENCODE_MEMO.clear()

@@ -1,0 +1,92 @@
+"""Sidecar flat-path routing + COO wire format: heterogeneous windows
+through the REMOTE backend must ride the parallel flat solver (round 3's
+G-sequential regression would otherwise survive on this path only), and
+the assignment ships as COO entries instead of a dense [G, N] matrix."""
+import numpy as np
+import pytest
+
+from karpenter_tpu.apis.pod import PodSpec, ResourceRequests
+from karpenter_tpu.catalog import CatalogArrays, InstanceTypeProvider, PricingProvider
+from karpenter_tpu.cloud.fake import FakeCloud, generate_profiles
+from karpenter_tpu.service import RemoteSolver, SolverServer, _pack, _unpack
+from karpenter_tpu.solver import JaxSolver, SolveRequest, validate_plan
+from karpenter_tpu.solver.types import SolverOptions
+
+
+@pytest.fixture(scope="module")
+def server():
+    # low flat threshold so the CPU-sized test window routes flat
+    s = SolverServer(port=0, options=SolverOptions(
+        backend="jax", flat_min_groups=64)).start()
+    yield s
+    s.stop()
+
+
+def _catalog(num_types=12):
+    cloud = FakeCloud(profiles=generate_profiles(num_types))
+    pricing = PricingProvider(cloud)
+    catalog = CatalogArrays.build(InstanceTypeProvider(cloud, pricing).list())
+    pricing.close()
+    return catalog
+
+
+def hetero_pods(n, seed=0):
+    rng = np.random.RandomState(seed)
+    return [PodSpec(f"h{i}", requests=ResourceRequests(
+        int(rng.randint(100, 3000)), int(rng.randint(256, 8192)), 0, 1))
+        for i in range(n)]
+
+
+def test_remote_hetero_rides_flat_with_coo_wire(server):
+    catalog = _catalog()
+    pods = hetero_pods(400)
+    req = SolveRequest(pods, catalog)
+    client = RemoteSolver(f"127.0.0.1:{server.port}")
+    try:
+        remote = client.solve(req)
+        assert remote.backend == "remote"
+        assert validate_plan(remote, pods, catalog) == []
+        assert not remote.unplaced_pods
+        # parity with the local flat path: identical plan economics
+        local = JaxSolver(SolverOptions(backend="jax",
+                                        flat_min_groups=64)).solve(req)
+        assert abs(remote.total_cost_per_hour
+                   - local.total_cost_per_hour) < 1e-3
+        assert sorted(n.instance_type for n in remote.nodes) == \
+            sorted(n.instance_type for n in local.nodes)
+    finally:
+        client.close()
+
+
+def test_dense_fallback_for_clients_without_coo(server):
+    """An old client never sends coo_ok; the server's flat route must
+    still answer with the classic dense assign contract."""
+    from karpenter_tpu.solver.encode import encode
+    from karpenter_tpu.solver.jax_backend import _pad1, _pad2
+    from karpenter_tpu.solver.types import (
+        GROUP_BUCKETS, OFFERING_BUCKETS, bucket,
+    )
+
+    catalog = _catalog()
+    pods = hetero_pods(300, seed=2)
+    problem = encode(pods, catalog)
+    client = RemoteSolver(f"127.0.0.1:{server.port}")
+    try:
+        G = bucket(problem.num_groups, GROUP_BUCKETS)
+        O = bucket(catalog.num_offerings, OFFERING_BUCKETS)
+        client._ensure_catalog(catalog, O)
+        cat_id, gen = client._catalog_key(catalog)
+        resp = _unpack(client._solve(_pack(
+            catalog_id=np.array(cat_id), generation=np.int64(gen),
+            group_req=_pad2(problem.group_req, G),
+            group_count=_pad1(problem.group_count, G),
+            group_cap=_pad1(problem.group_cap, G),
+            compat=_pad2(problem.compat, G, O),
+            num_nodes=np.int64(256),
+            right_size=np.bool_(True))))     # no coo_ok flag
+        assert "assign" in resp and "assign_coo_idx" not in resp
+        assert resp["assign"].shape[0] == G
+        placed = int(resp["assign"].sum())
+        assert placed == len(pods)
+    finally:
+        client.close()
