@@ -12,7 +12,7 @@ import threading
 import time
 from typing import Callable, Generic, Optional, TypeVar
 
-from karpenter_tpu.cloud.errors import CloudError, is_auth, parse_error
+from karpenter_tpu.cloud.errors import is_auth, parse_error
 from karpenter_tpu.utils.logging import get_logger
 
 log = get_logger("cloud.client_manager")

@@ -16,7 +16,7 @@ from __future__ import annotations
 import itertools
 import threading
 from collections import defaultdict
-from typing import Callable, Dict, List, Optional, Tuple
+from typing import Dict, List, Optional, Tuple
 
 from karpenter_tpu.cloud.errors import CloudError, not_found
 from karpenter_tpu.cloud.profile import InstanceProfile

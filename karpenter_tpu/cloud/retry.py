@@ -13,7 +13,7 @@ import time
 from dataclasses import dataclass
 from typing import Callable, TypeVar
 
-from karpenter_tpu.cloud.errors import CloudError, is_rate_limit, is_retryable, parse_error
+from karpenter_tpu.cloud.errors import is_rate_limit, is_retryable, parse_error
 from karpenter_tpu.utils.logging import get_logger
 
 log = get_logger("cloud.retry")

@@ -10,7 +10,7 @@ halves — SURVEY.md §0).
 from __future__ import annotations
 
 import time
-from typing import List, Optional
+from typing import Optional
 
 from karpenter_tpu.apis.nodeclaim import Node, NodeClaim, parse_provider_id
 from karpenter_tpu.apis.pod import Taint

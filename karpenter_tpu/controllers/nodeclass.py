@@ -8,7 +8,7 @@ termination}`` (SURVEY.md §2.5).
 from __future__ import annotations
 
 import time
-from typing import Callable, Optional
+from typing import Optional
 
 from karpenter_tpu.apis.nodeclass import (
     ANNOTATION_NODECLASS_HASH, ANNOTATION_NODECLASS_HASH_VERSION,

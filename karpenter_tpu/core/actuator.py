@@ -25,7 +25,7 @@ from __future__ import annotations
 
 import time
 import uuid
-from typing import Dict, List, Optional, Tuple
+from typing import List, Optional, Tuple
 
 from karpenter_tpu.constants import CLAIM_FINALIZER
 from karpenter_tpu.apis.nodeclaim import NodeClaim, parse_provider_id, provider_id

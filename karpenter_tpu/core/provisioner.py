@@ -30,7 +30,6 @@ from karpenter_tpu.core.window import SolveWindow, WindowOptions
 from karpenter_tpu.solver.greedy import GreedySolver
 from karpenter_tpu.solver.jax_backend import JaxSolver
 from karpenter_tpu.solver.types import Plan, SolveRequest, SolverOptions
-from karpenter_tpu.utils import metrics
 from karpenter_tpu.utils.logging import get_logger
 
 log = get_logger("core.provisioner")

@@ -9,7 +9,6 @@ invocation per window, mirroring karpenter-core's provisioner batching.
 
 from __future__ import annotations
 
-import threading
 import time
 from dataclasses import dataclass
 from typing import Callable, List, Optional, Sequence

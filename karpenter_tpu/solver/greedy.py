@@ -25,7 +25,7 @@ This is also the "Go FFD loop" stand-in for BASELINE.md's >=20x comparison
 from __future__ import annotations
 
 import time
-from typing import Dict, List, Optional
+from typing import List, Optional
 
 import numpy as np
 

@@ -23,7 +23,6 @@ from typing import Dict, List, Optional, Sequence
 
 from karpenter_tpu.apis.nodeclaim import NodePool
 from karpenter_tpu.apis.pod import PodSpec, pod_key, tolerates_all
-from karpenter_tpu.apis.requirements import LABEL_ZONE
 from karpenter_tpu.catalog.arrays import CatalogArrays
 from karpenter_tpu.solver.encode import (
     _has_hostname_anti_affinity, _has_zone_affinity, _zone_spread_constraints,

@@ -6,7 +6,6 @@ records them."""
 import time
 
 from tests.e2e.config import load_config, make_workload
-from tests.e2e.suite import E2E_LABEL
 
 
 def test_benchmark_instance_creation_latency(suite):

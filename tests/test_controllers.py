@@ -22,7 +22,6 @@ from karpenter_tpu.catalog import (
 )
 from karpenter_tpu.cloud.errors import NodeClaimNotFoundError
 from karpenter_tpu.cloud.fake import FakeCloud
-from karpenter_tpu.cloud.image import ImageResolver
 from karpenter_tpu.cloud.subnet import SubnetProvider
 from karpenter_tpu.controllers import ControllerManager, PollController, Result, WatchController
 from karpenter_tpu.controllers.faults import (

@@ -18,7 +18,6 @@ from karpenter_tpu.core import (
     CircuitBreakerOpenError, ClusterState, Provisioner, ProvisionerOptions,
 )
 from karpenter_tpu.core.cluster import ConflictError
-from karpenter_tpu.core.provisioner import make_solver
 from karpenter_tpu.core.bootstrap import BootstrapProvider, BootstrapOptions, ClusterConfig, TokenStore
 from karpenter_tpu.solver.types import SolverOptions
 from karpenter_tpu.core.window import WindowOptions

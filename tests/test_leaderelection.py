@@ -4,7 +4,6 @@ actuation gate, and the two-operator failover done-criterion."""
 import threading
 import time
 
-import pytest
 
 from karpenter_tpu.core.cluster import ClusterState
 from karpenter_tpu.core.leaderelection import (

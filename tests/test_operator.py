@@ -64,7 +64,6 @@ class TestCredentials:
         creds = store.get()
         assert creds.api_key == "k3y" and creds.region == "us-south"
         # plaintext never sits in the store's attributes
-        import pickle
         for name, value in vars(store).items():
             if isinstance(value, (bytes, str)) and name != "_region":
                 assert b"k3y" not in (value if isinstance(value, bytes)
