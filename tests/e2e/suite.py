@@ -132,6 +132,36 @@ class E2ESuite:
         self.created.append({"kind": "deployment", "namespace": namespace,
                              "name": body["metadata"]["name"]})
 
+    # -- diagnostics (reference test/e2e/diagnostics.go) -------------------
+
+    def dump_diagnostics(self, namespace: str, selector: str) -> str:
+        """Collect the failure context a human would ask for: matching
+        pods with phase/conditions/events, e2e-labeled nodes with
+        conditions, and recent controller log tail.  Returned (and
+        printed) so pytest failure output carries it."""
+        lines: List[str] = []
+        try:
+            for p in self.kube.list_namespaced_pod(
+                    namespace, label_selector=selector).items:
+                lines.append(f"pod {p.metadata.name}: phase="
+                             f"{p.status.phase} node={p.spec.node_name}")
+                for c in (p.status.conditions or []):
+                    if c.status != "True":
+                        lines.append(f"  cond {c.type}={c.status}: "
+                                     f"{c.reason} {c.message}")
+            for n in self.nodes_with_label(E2E_LABEL):
+                ready = _node_ready(n)
+                lines.append(f"node {n.metadata.name}: ready={ready} "
+                             f"labels={n.metadata.labels}")
+            evs = self.kube.list_namespaced_event(namespace).items[-20:]
+            for e in evs:
+                lines.append(f"event {e.reason}: {e.message}")
+        except Exception as e:  # noqa: BLE001 — diagnostics never mask
+            lines.append(f"diagnostics collection failed: {e}")
+        text = "\n".join(lines)
+        print(f"=== e2e diagnostics ({selector}) ===\n{text}")
+        return text
+
     # -- cleanup -----------------------------------------------------------
 
     def cleanup_leftovers(self) -> None:

@@ -1,0 +1,36 @@
+"""Block-device-mapping provisioning against a live cluster (reference:
+test/e2e/block_device_test.go): a NodeClass with a custom root volume +
+additional data volume must produce nodes whose instances carry both."""
+from tests.e2e.config import load_config, make_workload
+from tests.e2e.suite import E2E_LABEL
+
+
+def test_block_device_mappings_applied(suite):
+    nc = load_config("default")
+    nc.name = "e2e-blockdev"
+    manifest = nc.to_manifest()
+    manifest["spec"]["blockDeviceMappings"] = [
+        {
+            "rootVolume": True,
+            "volumeSpec": {
+                "capacityGiB": 50,
+                "profile": "general-purpose",
+                "tags": ["test:root-volume", "environment:e2e-test"],
+            },
+        },
+        {
+            "deviceName": "/dev/vdb",
+            "volumeSpec": {"capacityGiB": 100, "profile": "10iops-tier"},
+        },
+    ]
+    suite.create_nodeclass(manifest)
+    suite.create_deployment("default", make_workload("e2e-blockdev", 1))
+    nodes = suite.wait_for_nodes(1)
+    # the claim's provider id resolves the instance; both volumes must be
+    # attached (verified through the node's volume annotations the
+    # registration controller stamps)
+    node = nodes[0]
+    anns = node.metadata.annotations or {}
+    vols = anns.get("karpenter-tpu.sh/volume-attachments", "")
+    assert "/dev/vdb" in vols or len(vols.split(",")) >= 2, \
+        f"expected 2 volume attachments, annotations: {anns}"
