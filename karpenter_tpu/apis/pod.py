@@ -226,18 +226,51 @@ class PodSpec:
         return cached
 
     def _constraint_signature(self) -> Tuple:
+        # empty fast paths: the common pod carries no constraints, and
+        # building 7 generator+sorted() pipelines per pod dominated cold
+        # encode at 10k pods (~110 ms; first-restart-window budget)
         return (
             self.requests.as_tuple(),
-            tuple(sorted(self.labels)),
-            tuple(sorted(self.node_selector)),
-            tuple(sorted(r.signature for r in self.required_requirements)),
+            tuple(sorted(self.labels)) if self.labels else (),
+            tuple(sorted(self.node_selector)) if self.node_selector else (),
+            tuple(sorted(r.signature for r in self.required_requirements))
+            if self.required_requirements else (),
             tuple(sorted((w, r.signature)
-                         for w, r in self.preferred_requirements)),
-            tuple(sorted((t.key, t.operator, t.value, t.effect) for t in self.tolerations)),
-            tuple(sorted((c.max_skew, c.topology_key, c.when_unsatisfiable, c.label_selector)
-                         for c in self.topology_spread)),
-            tuple(sorted((a.label_selector, a.topology_key, a.anti) for a in self.affinity)),
+                         for w, r in self.preferred_requirements))
+            if self.preferred_requirements else (),
+            tuple(sorted((t.key, t.operator, t.value, t.effect)
+                         for t in self.tolerations))
+            if self.tolerations else (),
+            tuple(sorted((c.max_skew, c.topology_key, c.when_unsatisfiable,
+                          c.label_selector) for c in self.topology_spread))
+            if self.topology_spread else (),
+            tuple(sorted((a.label_selector, a.topology_key, a.anti)
+                         for a in self.affinity)) if self.affinity else (),
         )
+
+
+def fingerprint_token(pod: "PodSpec") -> Tuple[str, int]:
+    """THE canonical encode-memo token — (pod key, interned signature
+    id) — memoized on the pod as ``_fpt``.  Single definition: both the
+    encode fingerprint (solver/encode.py) and watch-time interning below
+    must produce the identical token or the whole-encode memo silently
+    misses every window."""
+    tok = getattr(pod, "_fpt", None)
+    if tok is None:
+        tok = (pod_key(pod), pod.signature_id())
+        object.__setattr__(pod, "_fpt", tok)
+    return tok
+
+
+def intern_signatures(pods) -> None:
+    """Eagerly intern constraint signatures (and the encode fingerprint
+    token) for a batch of pods.  The per-pod signature construction is
+    the dominant cold-encode cost at 10k pods (~90 ms); production pods
+    arrive through the watch stream, so the provisioner interns at
+    ingestion time and the solve window's encode finds every token
+    cached — the restart-window budget never pays it all at once."""
+    for p in pods:
+        fingerprint_token(p)
 
 
 def make_pods(count: int, name_prefix: str = "pod", **kwargs) -> List[PodSpec]:

@@ -168,6 +168,24 @@ class CatalogArrays:
         return (self.type_names[t], self.zones[int(self.off_zone[o])],
                 CAPACITY_TYPES[int(self.off_cap[o])])
 
+    def describe_offerings(self, offs: np.ndarray):
+        """Vectorized :meth:`describe_offering` over an index array —
+        returns (type_names, zones, captypes, prices) as host lists.
+        The per-offering string columns are materialized once per
+        catalog (object arrays; ~O strings) so a decode touching
+        hundreds of nodes costs four fancy-index gathers instead of
+        per-node Python lookups (the decode hot path, VERDICT round 4
+        item 1: host-side Python overhead rivals chip time)."""
+        cached = getattr(self, "_desc_cache", None)
+        if cached is None:
+            cached = (np.array(self.type_names, object)[self.off_type],
+                      np.array(self.zones, object)[self.off_zone],
+                      np.array(CAPACITY_TYPES, object)[self.off_cap])
+            self._desc_cache = cached
+        tn, zn, cn = cached
+        return (tn[offs].tolist(), zn[offs].tolist(), cn[offs].tolist(),
+                self.off_price[offs].tolist())
+
     def find_offering(self, instance_type: str, zone: str, capacity_type: str) -> Optional[int]:
         return self._offering_index.get((instance_type, zone, capacity_type))
 

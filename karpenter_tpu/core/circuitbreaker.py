@@ -179,6 +179,13 @@ class CircuitBreakerManager:
         self._lock = threading.Lock()
         self._breakers: Dict[Tuple[str, str], CircuitBreaker] = {}
 
+    @property
+    def config(self) -> CircuitBreakerConfig:
+        """Public view of the shared config — budget-aware callers (the
+        repack burst guard, disruption.py) size their plans against it;
+        a private-only attribute silently disabled that guard."""
+        return self._config
+
     def get(self, nodeclass: str, region: str) -> CircuitBreaker:
         key = (nodeclass, region)
         with self._lock:

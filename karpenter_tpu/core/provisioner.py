@@ -21,7 +21,7 @@ from typing import Dict, List, Optional, Sequence, Tuple
 
 from karpenter_tpu.apis.nodeclaim import NodePool
 from karpenter_tpu.apis.nodeclass import NodeClass
-from karpenter_tpu.apis.pod import PodSpec, pod_key
+from karpenter_tpu.apis.pod import PodSpec, intern_signatures, pod_key
 from karpenter_tpu.catalog.arrays import CatalogArrays
 from karpenter_tpu.catalog.instancetype import InstanceTypeProvider, filter_instance_types
 from karpenter_tpu.core.actuator import Actuator
@@ -102,6 +102,11 @@ class Provisioner:
 
         def on_pod_event(event_type: str, pending: PendingPod):
             if event_type == "ADDED" and not pending.bound_node:
+                # intern at ingestion (watch-stream time), so the solve
+                # window's encode finds every signature token cached —
+                # a restart never pays 10k signature constructions
+                # inside one window (apis/pod.py intern_signatures)
+                intern_signatures((pending.spec,))
                 self._window.add(pending.spec)
 
         def on_claim_event(event_type: str, claim):

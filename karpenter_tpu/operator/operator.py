@@ -145,6 +145,8 @@ class Operator:
             self.manager.register(ctrl)
         self.metrics_server = None
         self.webhook_server = None
+        self._warmup_thread = None
+        self._warmup_stop = None
         self._started = False
 
     def _build_controllers(self) -> List:
@@ -198,11 +200,74 @@ class Operator:
 
     # -- lifecycle ---------------------------------------------------------
 
+    def _start_solver_warmup(self) -> None:
+        """Cold-start tier (SURVEY.md §7.4 'ragged shapes &
+        recompilation'): enable the persistent XLA compile cache and
+        eagerly compile the common bucket ladder in a daemon thread, so
+        the first provisioning window after a restart pays neither XLA
+        compilation nor the catalog upload.  No-op for non-jax backends;
+        never boot-fatal."""
+        if self.options.solver.backend != "jax":
+            return
+        try:
+            from karpenter_tpu.solver.warmup import (
+                enable_persistent_compile_cache,
+            )
+
+            enable_persistent_compile_cache(
+                self.options.compile_cache_dir or None)
+        except Exception as e:  # noqa: BLE001
+            log.warning("compile cache setup failed", error=str(e)[:200])
+        if not self.options.solver_warmup:
+            return
+        import threading
+
+        self._warmup_stop = threading.Event()
+
+        def _warm():
+            try:
+                import time as _time
+
+                from karpenter_tpu.catalog.arrays import CatalogArrays
+                from karpenter_tpu.solver.warmup import warmup_solver
+
+                # prefer the PROVISIONER'S catalog instance: the device
+                # cache keys on catalog uid, so warming a privately built
+                # catalog would leave a dead upload the first window
+                # cannot hit.  NodeClasses arrive via watches — wait
+                # briefly for one, then fall back to a provider-wide
+                # catalog (the XLA compile warmup is uid-independent
+                # either way).
+                catalog = None
+                deadline = _time.time() + 10.0
+                while catalog is None and _time.time() < deadline:
+                    for nc in self.cluster.list("nodeclasses"):
+                        catalog = self.provisioner._catalog_for(nc)
+                        if catalog is not None:
+                            break
+                    if catalog is None and self._warmup_stop.wait(0.5):
+                        return          # shutting down: skip warmup
+                if self._warmup_stop.is_set():
+                    return
+                if catalog is None:
+                    catalog = CatalogArrays.build(self.instance_types.list())
+                warmup_solver(self.provisioner.solver, catalog)
+            except Exception as e:  # noqa: BLE001 — warmup is best-effort
+                log.warning("solver warmup failed", error=str(e)[:200])
+
+        # daemon (a hung tunnel must not block exit) but joined in
+        # stop(): a live compile thread killed at interpreter teardown
+        # aborts the process from inside XLA
+        self._warmup_thread = threading.Thread(
+            target=_warm, name="solver-warmup", daemon=True)
+        self._warmup_thread.start()
+
     def start(self) -> None:
         """Resync existing objects, then go live (watch threads + pollers +
         the provisioning window)."""
         if self._started:
             return
+        self._start_solver_warmup()
         self.elector.start()
         self.manager.sync(rounds=1)    # restart = resume (SURVEY.md §5.4)
         self.manager.start()
@@ -251,6 +316,10 @@ class Operator:
         finally:
             # even if a controller stop raises, the batcher thread and the
             # metrics server must not outlive the operator
+            if self._warmup_thread is not None:
+                self._warmup_stop.set()   # interrupt the NodeClass poll
+                self._warmup_thread.join(timeout=60.0)
+                self._warmup_thread = None
             self.pricing.close()
             if self.metrics_server is not None:
                 self.metrics_server.stop()

@@ -64,6 +64,10 @@ class Options:
     webhook_tls_key: str = ""              # serving key path (webhook)
     leader_election_enabled: bool = False  # lease-based single-active gate
     leader_identity: str = ""              # defaults to a random identity
+    # cold-start tier (first solve after restart must not pay XLA compile
+    # or catalog upload): persistent compile cache dir + boot warmup
+    compile_cache_dir: str = ""            # KARPENTER_TPU_COMPILE_CACHE
+    solver_warmup: bool = True             # KARPENTER_TPU_WARMUP
 
     # sub-configs
     circuit_breaker: CircuitBreakerConfig = field(
@@ -107,6 +111,8 @@ class Options:
                 env, "KARPENTER_REPACK_MIN_SAVINGS_PERCENT", 15),
             spot_discount_percent=_geti(env, "KARPENTER_SPOT_DISCOUNT_PERCENT",
                                         60),
+            compile_cache_dir=env.get("KARPENTER_TPU_COMPILE_CACHE", ""),
+            solver_warmup=_getb(env, "KARPENTER_TPU_WARMUP", True),
             circuit_breaker=CircuitBreakerConfig.from_env(env),
             solver=solver, window=window)
 

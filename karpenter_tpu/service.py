@@ -38,8 +38,8 @@ import numpy as np
 
 from karpenter_tpu.solver.encode import EncodedProblem, decode_plan, encode
 from karpenter_tpu.solver.types import (
-    GROUP_BUCKETS, NODE_BUCKETS, OFFERING_BUCKETS, Plan, SolveRequest,
-    SolverOptions, bucket,
+    BATCH_BUCKETS, GROUP_BUCKETS, NODE_BUCKETS, OFFERING_BUCKETS, Plan,
+    SolveRequest, SolverOptions, bucket,
 )
 from karpenter_tpu.utils import metrics
 from karpenter_tpu.utils.logging import get_logger
@@ -277,7 +277,7 @@ class SolverServer:
         C, G, O = compat.shape
         # pad the batch axis (repeat row 0) so shrinking candidate sets
         # across refinement rounds reuse one compiled executable
-        C_pad = bucket(C, (2, 4, 8, 16, 32))
+        C_pad = bucket(C, BATCH_BUCKETS)
         # factor each candidate's compat into label rows.  Candidates are
         # the base problem with one (or few) re-pinned rows, so the base
         # is deduped ONCE and each candidate only patches its rows that
@@ -323,7 +323,7 @@ class SolverServer:
                 self._jax._device_offerings(cat, O)
             K0, K_cap = self._jax._compact_k(total, G)
             while True:
-                K, dense16 = clamp_output_opts(K0, False, G, N)
+                K, dense16, _coo16 = clamp_output_opts(K0, False, G, N)
                 out_np = np.asarray(solve_packed_batch(
                     rows, off_alloc, off_price, off_rank, G=G, O=O,
                     U=U_pad, N=N,

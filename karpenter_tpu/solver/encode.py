@@ -39,7 +39,10 @@ from typing import Dict, List, Optional, Sequence, Tuple
 import numpy as np
 
 from karpenter_tpu.apis.nodeclaim import NodePool
-from karpenter_tpu.apis.pod import NUM_RESOURCES, PodSpec, pod_key, tolerates_all
+from karpenter_tpu.apis.pod import (
+    NUM_RESOURCES, PodSpec, fingerprint_token as _fp_token, pod_key,
+    tolerates_all,
+)
 from karpenter_tpu.apis.requirements import (
     CAPACITY_TYPE_ON_DEMAND, CAPACITY_TYPE_SPOT,
     LABEL_ARCH, LABEL_CAPACITY_TYPE, LABEL_HOSTNAME, LABEL_INSTANCE_FAMILY,
@@ -81,7 +84,8 @@ class EncodedProblem:
 
     __slots__ = ("groups", "group_req", "group_count", "group_cap",
                  "catalog", "rejected", "label_rows", "label_idx",
-                 "pref_rows", "pref_idx", "_compat")
+                 "pref_rows", "pref_idx", "_compat", "_names_idx",
+                 "_prep_cache")
 
     def __init__(self, groups: List[PodGroup], group_req: np.ndarray,
                  group_count: np.ndarray, group_cap: np.ndarray,
@@ -108,6 +112,8 @@ class EncodedProblem:
         self.pref_rows = pref_rows
         self.pref_idx = pref_idx
         self._compat = compat
+        self._names_idx = None      # (names_arr object [P], gstart int64 [G+1])
+        self._prep_cache = None     # jax_backend packed-template cache
 
     @property
     def has_preferences(self) -> bool:
@@ -395,14 +401,6 @@ _ENCODE_MEMO_MAX = 8
 
 
 _FPT_GETTER = attrgetter("_fpt")
-
-
-def _fp_token(pod: PodSpec) -> Tuple[str, int]:
-    tok = getattr(pod, "_fpt", None)
-    if tok is None:
-        tok = (pod_key(pod), pod.signature_id())
-        object.__setattr__(pod, "_fpt", tok)
-    return tok
 
 
 def _pods_fingerprint(pods: Sequence[PodSpec]) -> Tuple:
@@ -743,6 +741,27 @@ def decode_plan(problem: EncodedProblem, node_off: np.ndarray,
                                cost, backend)
 
 
+def _names_index(problem: EncodedProblem):
+    """(names_arr object [P], gstart int64 [G+1]): every group's
+    pod_names concatenated group-major, with per-group start offsets —
+    built once per problem so decode gathers pod names with numpy fancy
+    indexing instead of per-entry Python list slicing (the decode loop
+    was the largest host cost of a pipelined window: 2.4 ms of the 4 ms
+    amortized wall at the headline shape, VERDICT round 4 item 1)."""
+    cached = problem._names_idx
+    if cached is None:
+        sizes = np.fromiter((len(g.pod_names) for g in problem.groups),
+                            np.int64, len(problem.groups))
+        gstart = np.zeros(len(problem.groups) + 1, np.int64)
+        np.cumsum(sizes, out=gstart[1:])
+        names_arr = np.empty(int(gstart[-1]), object)
+        for gi, g in enumerate(problem.groups):
+            names_arr[gstart[gi]:gstart[gi + 1]] = g.pod_names
+        cached = (names_arr, gstart)
+        problem._names_idx = cached
+    return cached
+
+
 def decode_plan_entries(problem: EncodedProblem, node_off: np.ndarray,
                         gis: np.ndarray, ns: np.ndarray, cnts: np.ndarray,
                         unplaced: np.ndarray, cost: float, backend: str):
@@ -751,53 +770,97 @@ def decode_plan_entries(problem: EncodedProblem, node_off: np.ndarray,
     solve path decode straight from device COO without densifying the
     [G, N] matrix (a 256 MB allocation per solve at the heterogeneous
     10k-group shape); the classic sync path (`unpack_result`) still
-    densifies for its dense-contract consumers (sidecar wire format)."""
+    densifies for its dense-contract consumers (sidecar wire format).
+
+    Fully vectorized: pod names are gathered through the per-problem
+    names index (one object-array fancy index), split per node by a
+    stable node sort that preserves the gi-major cursor order the
+    reference's walk produced."""
     from karpenter_tpu.solver.types import Plan, PlannedNode
 
     catalog = problem.catalog
     groups = problem.groups
-    nodes: List = []
     open_idx = np.nonzero(node_off >= 0)[0]
     G = len(groups)
     keep = (gis < G) & (node_off[ns] >= 0) & (cnts > 0)
     if not keep.all():
         gis, ns, cnts = gis[keep], ns[keep], cnts[keep]
-    # per-group exclusive cumsum = each entry's start offset into its
-    # group's pod_names; entries must be gi-major with node-ascending
-    # order within a group for the offsets to reproduce the reference's
-    # cursor walk — lexsort makes that true for any input order
-    reorder = np.lexsort((ns, gis))
-    gis, ns = gis[reorder], ns[reorder]
-    cnts = cnts[reorder].astype(np.int64)
-    csum = np.cumsum(cnts) - cnts                     # exclusive, global
-    if gis.size:
-        first = np.zeros(gis.size, dtype=bool)
-        first[0] = True
-        first[1:] = gis[1:] != gis[:-1]
-        group_base = np.repeat(csum[first], np.diff(
-            np.concatenate([np.nonzero(first)[0], [gis.size]])))
-        starts = csum - group_base                    # offset within group
-    else:
-        starts = csum
-    # gi-major iteration fills each per-node list in ascending gi — the
-    # same order the cursor walk produced (dict keys make node order moot)
     per_node: Dict[int, List[str]] = {}
-    for gi, n, s, k in zip(gis, ns, starts, cnts):
-        per_node.setdefault(int(n), []).extend(
-            groups[gi].pod_names[s:s + k])
-    for n in open_idx:
-        off = int(node_off[n])
-        itype, zone, captype = catalog.describe_offering(off)
-        nodes.append(PlannedNode(
-            instance_type=itype, zone=zone, capacity_type=captype,
-            price=float(catalog.off_price[off])
-            if off < catalog.num_offerings else 0.0,
-            pod_names=per_node.get(int(n), []), offering_index=off))
+    if gis.size:
+        # per-group exclusive cumsum = each entry's start offset into its
+        # group's pod_names; entries must be gi-major with node-ascending
+        # order within a group for the offsets to reproduce the
+        # reference's cursor walk — lexsort makes that true for any order
+        reorder = np.lexsort((ns, gis))
+        g_s = gis[reorder]
+        cnt_s = cnts[reorder].astype(np.int64)
+        csum_s = np.cumsum(cnt_s) - cnt_s             # exclusive, global
+        first = np.zeros(g_s.size, dtype=bool)
+        first[0] = True
+        first[1:] = g_s[1:] != g_s[:-1]
+        group_base = np.repeat(csum_s[first], np.diff(
+            np.concatenate([np.nonzero(first)[0], [g_s.size]])))
+        starts_s = csum_s - group_base                # offset within group
+        names_arr, gstart = _names_index(problem)
+        src_start_s = gstart[g_s] + starts_s          # into names_arr
+        key = ns.astype(np.int64) * G + gis           # input entry order
+        if key.size < 2 or (np.diff(key) > 0).all():
+            # fast path — the device COO is emitted n-major already
+            # (idx = n*G + g ascending): invert the ENTRY permutation
+            # (nnz-sized) instead of re-sorting at POD granularity, and
+            # node boundaries fall out of the ns runs.  Within a node,
+            # entries are gi-ascending either way, so pod order matches
+            # the general path exactly.
+            src_start = np.empty_like(src_start_s)
+            src_start[reorder] = src_start_s
+            cnt64 = cnts.astype(np.int64)
+            ecs = np.cumsum(cnt64) - cnt64
+            total = int(ecs[-1] + cnt64[-1])
+            flat_src = np.repeat(src_start - ecs, cnt64) \
+                + np.arange(total, dtype=np.int64)
+            names_sorted = names_arr[flat_src]
+            efirst = np.zeros(ns.size, dtype=bool)
+            efirst[0] = True
+            efirst[1:] = ns[1:] != ns[:-1]
+            fidx = np.nonzero(efirst)[0]
+            uniq = ns[fidx]
+            bounds = np.append(ecs[fidx], total)
+        else:
+            total = int(csum_s[-1] + cnt_s[-1])
+            # entry e covers names_arr[src_start_s[e]:...+cnt_s[e]]
+            flat_src = np.repeat(src_start_s - csum_s, cnt_s) \
+                + np.arange(total, dtype=np.int64)
+            pod_node = np.repeat(ns[reorder], cnt_s)
+            order2 = np.argsort(pod_node, kind="stable")  # keeps gi order
+            names_sorted = names_arr[flat_src[order2]]
+            node_sorted = pod_node[order2]
+            uniq, firsts = np.unique(node_sorted, return_index=True)
+            bounds = np.append(firsts, total)
+        # ONE object-array -> list conversion, then C-speed list slices
+        # per node (240 per-node .tolist() calls cost ~3x more)
+        all_names = names_sorted.tolist()
+        bl = bounds.tolist()
+        per_node = {n: all_names[bl[i]:bl[i + 1]]
+                    for i, n in enumerate(uniq.tolist())}
+    offs = node_off[open_idx]
+    num_off = catalog.num_offerings
+    in_range = offs < num_off
+    itypes, zones, captypes, prices = catalog.describe_offerings(
+        np.minimum(offs, max(num_off - 1, 0)))
+    get = per_node.get
+    in_range_l = in_range.tolist()
+    offs_l = offs.tolist()
+    nodes: List = [
+        PlannedNode(it, z, ct, pr if ok else 0.0, get(n, []), off)
+        for n, off, it, z, ct, pr, ok in zip(
+            open_idx.tolist(), offs_l, itypes, zones, captypes, prices,
+            in_range_l)]
     unplaced_names: List[str] = list(problem.rejected)
-    for gi, g in enumerate(groups):
-        miss = int(unplaced[gi]) if gi < len(unplaced) else 0
-        if miss > 0:
-            unplaced_names.extend(g.pod_names[len(g.pod_names) - miss:])
+    miss = np.asarray(unplaced[:G])
+    for gi in np.nonzero(miss > 0)[0].tolist():
+        g = groups[gi]
+        m = int(miss[gi])
+        unplaced_names.extend(g.pod_names[len(g.pod_names) - m:])
     return Plan(nodes=nodes, unplaced_pods=unplaced_names,
                 total_cost_per_hour=float(cost), backend=backend)
 

@@ -391,18 +391,38 @@ class FlatAttempt:
 
     __slots__ = ("item_req", "item_gid", "item_live", "rows", "item_row",
                  "G_pad", "O_pad", "I_pad", "U_pad", "N", "N_cap", "K",
-                 "out_dev", "t_disp", "t_issued")
+                 "out_dev", "fut", "t_disp", "t_issued", "tmpl")
 
     def __init__(self, **kw):
+        self.tmpl = None
+        self.fut = None
         for k, v in kw.items():
             setattr(self, k, v)
 
 
-def dispatch_flat(solver, problem: EncodedProblem) -> Optional[FlatAttempt]:
-    """Issue the flat kernel and start the async result copy; returns
-    None when the problem turns out unsuitable after all (caller falls
-    back to the scan path)."""
+_FLAT_UNSUITABLE = "unsuitable"
+
+
+def _flat_template(solver, problem: EncodedProblem):
+    """Host-side flat arrays for a problem, built once and cached on the
+    problem (EncodedProblems are immutable by convention; the hetero
+    window stream re-expanded ~10k item rows every window).  Returns a
+    template FlatAttempt (never dispatched itself) or None."""
     from karpenter_tpu.solver.types import GROUP_BUCKETS
+
+    cache = getattr(problem, "_prep_cache", None)
+    if cache is None:
+        try:
+            cache = problem._prep_cache = {}
+        except AttributeError:
+            cache = None   # wire shims (_WireProblem) carry no cache slot
+    key = ("flat", solver.options.max_nodes)
+    if cache is not None:
+        tmpl = cache.get(key)
+        if tmpl is _FLAT_UNSUITABLE:
+            return None
+        if tmpl is not None:
+            return tmpl
 
     catalog = problem.catalog
     G = problem.num_groups
@@ -434,12 +454,33 @@ def dispatch_flat(solver, problem: EncodedProblem) -> Optional[FlatAttempt]:
     # (merges only shrink), so bucket(total) can never overflow
     K = bucket(total, COO_BUCKETS)
     if N * G_pad >= (1 << 31) - 1:
+        if cache is not None:
+            cache[key] = _FLAT_UNSUITABLE
         return None
-    a = FlatAttempt(item_req=item_req, item_gid=item_gid,
-                    item_live=item_live, rows=rows, item_row=item_row,
-                    G_pad=G_pad, O_pad=O_pad, I_pad=I_pad, U_pad=U_pad,
-                    N=N, N_cap=N_cap, K=K, out_dev=None,
+    tmpl = FlatAttempt(item_req=item_req, item_gid=item_gid,
+                       item_live=item_live, rows=rows, item_row=item_row,
+                       G_pad=G_pad, O_pad=O_pad, I_pad=I_pad, U_pad=U_pad,
+                       N=N, N_cap=N_cap, K=K, out_dev=None,
+                       t_disp=0.0, t_issued=0.0)
+    if cache is not None:
+        cache[key] = tmpl
+    return tmpl
+
+
+def dispatch_flat(solver, problem: EncodedProblem) -> Optional[FlatAttempt]:
+    """Issue the flat kernel and start the async result copy; returns
+    None when the problem turns out unsuitable after all (caller falls
+    back to the scan path)."""
+    tmpl = _flat_template(solver, problem)
+    if tmpl is None:
+        return None
+    a = FlatAttempt(item_req=tmpl.item_req, item_gid=tmpl.item_gid,
+                    item_live=tmpl.item_live, rows=tmpl.rows,
+                    item_row=tmpl.item_row, G_pad=tmpl.G_pad,
+                    O_pad=tmpl.O_pad, I_pad=tmpl.I_pad, U_pad=tmpl.U_pad,
+                    N=tmpl.N, N_cap=tmpl.N_cap, K=tmpl.K, out_dev=None,
                     t_disp=0.0, t_issued=0.0)
+    a.tmpl = tmpl
     _dispatch_attempt(solver, problem, a)
     return a
 
@@ -456,6 +497,9 @@ def _dispatch_attempt(solver, problem, a: FlatAttempt) -> None:
         a.out_dev.copy_to_host_async()
     except Exception:  # noqa: BLE001 — CPU arrays may not support it
         pass
+    from karpenter_tpu.solver.jax_backend import _prefetch
+
+    a.fut = _prefetch(a.out_dev)
     a.t_issued = time.perf_counter()
 
 
@@ -465,9 +509,11 @@ def finalize_flat_arrays(solver, problem, a: FlatAttempt):
     raw result arrays (node_off [N], unplaced [G_pad], cost, COO idx,
     COO cnt) — the sidecar's wire layer consumes these directly;
     :func:`finalize_flat` decodes them to a Plan."""
+    from karpenter_tpu.solver.jax_backend import _await_dev
+
     while True:
         N, G_pad, K = a.N, a.G_pad, a.K
-        out_np = np.asarray(a.out_dev)
+        out_np = _await_dev(a.out_dev, a.fut)
         t_fetch = time.perf_counter()
         node_off = out_np[:N]
         unplaced = out_np[N:N + G_pad]
@@ -488,6 +534,8 @@ def finalize_flat_arrays(solver, problem, a: FlatAttempt):
             "G": G_pad, "O": a.O_pad, "N": N, "I": a.I_pad}
         if spilled > 0 and a.N < a.N_cap:
             a.N = min(a.N_cap, bucket(a.N * 4, NODE_BUCKETS))
+            if a.tmpl is not None:      # later windows start escalated
+                a.tmpl.N = max(a.tmpl.N, a.N)
             _dispatch_attempt(solver, problem, a)
             continue
         return node_off, unplaced, cost, idx, cnt

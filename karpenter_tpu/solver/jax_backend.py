@@ -41,8 +41,8 @@ from jax import lax
 from karpenter_tpu.solver.encode import BIG_CAP as BIG_CAP_I32
 from karpenter_tpu.solver.encode import EncodedProblem, encode
 from karpenter_tpu.solver.types import (
-    GROUP_BUCKETS, LABELROW_BUCKETS, NODE_BUCKETS, OFFERING_BUCKETS,
-    Plan, PlannedNode, SolveRequest, SolverOptions, bucket,
+    BATCH_BUCKETS, GROUP_BUCKETS, LABELROW_BUCKETS, NODE_BUCKETS,
+    OFFERING_BUCKETS, Plan, PlannedNode, SolveRequest, SolverOptions, bucket,
 )
 from karpenter_tpu.utils import metrics
 from karpenter_tpu.utils.logging import get_logger
@@ -53,6 +53,72 @@ log = get_logger("solver.jax")
 # would initialize the JAX backend at import time (hanging process start
 # whenever the TPU tunnel is slow — the solver must stay import-safe)
 _BIG = 1 << 30
+
+# Background fetch pool: through the TPU tunnel, async result copies only
+# LAND while some thread is blocked in a device await (measured: every
+# third pipelined batch paid a full ~65 ms round trip; the two popped
+# during that block were free).  Prefetching np.asarray on a daemon
+# thread overlaps that round trip with host-side decode, so the pipeline
+# pays it with wall-clock hidden.  Two workers: one blocking drain plus
+# one spare so consecutive units overlap.  Hand-rolled daemon threads,
+# NOT concurrent.futures.ThreadPoolExecutor: its atexit hook joins
+# worker threads at interpreter shutdown, so a fetch hung on a dead
+# tunnel would block process exit forever (a hung tunnel must never
+# block exit — same rule as the operator's warmup thread).
+class _DaemonFetchPool:
+    def __init__(self, workers: int = 2):
+        import queue
+        import threading
+
+        self._q: "queue.SimpleQueue" = queue.SimpleQueue()
+        for i in range(workers):
+            threading.Thread(target=self._run, daemon=True,
+                             name=f"ktpu-fetch-{i}").start()
+
+    def _run(self):
+        while True:
+            fut, dev = self._q.get()
+            if not fut.set_running_or_notify_cancel():
+                continue
+            try:
+                fut.set_result(np.asarray(dev))
+            except BaseException as e:  # noqa: BLE001 — delivered via result()
+                fut.set_exception(e)
+
+    def submit(self, dev):
+        from concurrent.futures import Future
+
+        fut = Future()
+        self._q.put((fut, dev))
+        return fut
+
+
+_FETCH_POOL = None
+
+
+def _fetch_pool():
+    global _FETCH_POOL
+    if _FETCH_POOL is None:
+        _FETCH_POOL = _DaemonFetchPool()
+    return _FETCH_POOL
+
+
+def _prefetch(dev):
+    """Future resolving to np.asarray(dev) on the fetch pool; the inline
+    fallback (None) keeps behavior identical if submission fails."""
+    try:
+        return _fetch_pool().submit(dev)
+    except Exception:  # noqa: BLE001 — interpreter shutdown etc.
+        return None
+
+
+def _await_dev(dev, fut):
+    """Resolve a prefetched device buffer: the future's result if one was
+    started (exceptions — e.g. Mosaic runtime faults — re-raise here,
+    same as the inline path), else a direct blocking fetch."""
+    if fut is not None:
+        return fut.result()
+    return np.asarray(dev)
 
 
 def _maybe_trace(name: str):
@@ -298,16 +364,23 @@ def _unpack_problem(packed, off_alloc, G: int, O: int, U: int):
 
 
 def _pack_result(node_off, assign, unplaced, cost, K: int,
-                 dense16: bool = False):
+                 dense16: bool = False, coo16: bool = False):
     """Device-side: flatten the solve result into the single D2H buffer.
     ``dense16`` halves the dense-assign tail by packing two int16 counts
     per word (valid when every offering's pod-slot capacity < 2^15, the
-    same bound the multi-leaf path used for its int16 assign_dtype)."""
+    same bound the multi-leaf path used for its int16 assign_dtype);
+    ``coo16`` halves the COO tail by packing (idx << 16 | cnt) into one
+    word per entry (valid when G*N <= 2^15 so idx fits 15 bits, and the
+    same pod-count bound — D2H bytes are wall-clock through the tunnel,
+    ~0.5 ms per 16 KB measured)."""
     cost_i = lax.bitcast_convert_type(cost.astype(jnp.float32)[None],
                                       jnp.int32)
     if K > 0:
         idx, cnt = _compact_assign(assign.astype(jnp.int32), K)
-        tail = [idx, cnt]
+        if coo16:
+            tail = [(idx << 16) | cnt]
+        else:
+            tail = [idx, cnt]
     elif dense16:
         pairs = assign.astype(jnp.int32).reshape(-1, 2)
         tail = [(pairs[:, 0] & 0xFFFF) | (pairs[:, 1] << 16)]
@@ -318,15 +391,19 @@ def _pack_result(node_off, assign, unplaced, cost, K: int,
 
 
 def clamp_output_opts(K0: int, dense16_ok: bool, G: int, N: int):
-    """The (K, dense16) pair valid for a dispatch at node axis ``N`` —
-    the SINGLE source of the two packer/parser invariants: K never
-    exceeds the G*N cell count (_compact_assign drops on overflow), and
-    int16 pair-packing needs an even G*N (reshape(-1, 2))."""
+    """The (K, dense16, coo16) triple valid for a dispatch at node axis
+    ``N`` — the SINGLE source of the packer/parser invariants: K never
+    exceeds the G*N cell count (_compact_assign drops on overflow),
+    int16 pair-packing needs an even G*N (reshape(-1, 2)), and COO word
+    packing needs every flat index n*G+g below 2^15 plus the <2^15
+    pod-count bound dense16_ok already certifies."""
     K = min(K0, G * N)
-    return K, (dense16_ok and K == 0 and (G * N) % 2 == 0)
+    return (K, (dense16_ok and K == 0 and (G * N) % 2 == 0),
+            (dense16_ok and K > 0 and G * N <= (1 << 15)))
 
 
-def coo_buffer_full(out_np: np.ndarray, G: int, N: int, K: int) -> bool:
+def coo_buffer_full(out_np: np.ndarray, G: int, N: int, K: int,
+                    coo16: bool = False) -> bool:
     """Sound overflow detector for the compacted assign fetch:
     ``_compact_assign`` scatters with mode="drop", and a dropped entry
     implies every one of the K slots is occupied — so 'all cnt slots
@@ -335,7 +412,10 @@ def coo_buffer_full(out_np: np.ndarray, G: int, N: int, K: int) -> bool:
     bucket: D2H payload is latency through the tunnel."""
     if K <= 0:
         return False
-    cnt = out_np[N + G + 1 + K:N + G + 1 + 2 * K]
+    if coo16:
+        cnt = out_np[N + G + 1:N + G + 1 + K] & 0xFFFF
+    else:
+        cnt = out_np[N + G + 1 + K:N + G + 1 + 2 * K]
     return bool((cnt > 0).all())
 
 
@@ -352,8 +432,19 @@ def needs_node_escalation(node_off, unplaced, N: int, N_cap: int) -> bool:
             and int((node_off >= 0).sum()) >= N)
 
 
+def unpack_coo_tail(out: np.ndarray, G: int, N: int, K: int,
+                    coo16: bool = False):
+    """(idx [K], cnt [K]) views/arrays of the COO tail of a packed
+    result buffer, in either wire layout."""
+    rest = out[N + G + 1:]
+    if coo16:
+        word = rest[:K]
+        return word >> 16, word & 0xFFFF
+    return rest[:K], rest[K:2 * K]
+
+
 def unpack_result(out: np.ndarray, G: int, N: int, K: int,
-                  dense16: bool = False):
+                  dense16: bool = False, coo16: bool = False):
     """Host-side inverse of :func:`_pack_result` -> (node_off [N],
     assign [G,N] int32, unplaced [G], cost float)."""
     node_off = out[:N]
@@ -361,7 +452,8 @@ def unpack_result(out: np.ndarray, G: int, N: int, K: int,
     cost = float(out[N + G:N + G + 1].view(np.float32)[0])
     rest = out[N + G + 1:]
     if K > 0:
-        assign = expand_coo_assign(rest[:K], rest[K:2 * K], G, N)
+        idx, cnt = unpack_coo_tail(out, G, N, K, coo16)
+        assign = expand_coo_assign(idx, cnt, G, N)
     elif dense16:
         assign = np.empty(G * N, dtype=np.int32)
         assign[0::2] = rest & 0xFFFF
@@ -409,10 +501,10 @@ def _pallas_core(meta, compat_i, alloc8, rank_row, off_price, *, G: int,
 
 @functools.partial(jax.jit,
                    static_argnames=("G", "O", "U", "N", "right_size",
-                                    "compact", "dense16"))
+                                    "compact", "dense16", "coo16"))
 def solve_packed(packed, off_alloc, off_price, off_rank, *, G: int, O: int,
                  U: int, N: int, right_size: bool = True, compact: int = 0,
-                 dense16: bool = False):
+                 dense16: bool = False, coo16: bool = False):
     """Packed-I/O solve through the lax.scan path: ONE device input (the
     per-window problem buffer; catalog tensors are device-resident and
     cached), ONE device output."""
@@ -420,16 +512,19 @@ def solve_packed(packed, off_alloc, off_price, off_rank, *, G: int, O: int,
     node_off, assign, unplaced, cost = solve_core(
         meta[:, :4], meta[:, 4], meta[:, 5], compat_i > 0,
         off_alloc, off_price, off_rank, num_nodes=N, right_size=right_size)
-    return _pack_result(node_off, assign, unplaced, cost, compact, dense16)
+    return _pack_result(node_off, assign, unplaced, cost, compact, dense16,
+                        coo16)
 
 
 @functools.partial(jax.jit,
                    static_argnames=("G", "O", "U", "N", "P", "right_size",
-                                    "compact", "dense16", "lam_bp"))
+                                    "compact", "dense16", "coo16",
+                                    "lam_bp"))
 def solve_packed_pref(packed, pref_rows, pref_idx, off_alloc, off_price,
                       off_rank, *, G: int, O: int, U: int, N: int, P: int,
                       right_size: bool = True, compact: int = 0,
-                      dense16: bool = False, lam_bp: int = 1500):
+                      dense16: bool = False, coo16: bool = False,
+                      lam_bp: int = 1500):
     """Packed solve with soft-preference penalty ranking (scan path; the
     pallas/flat fast paths gate off when preferences are present).  Two
     extra small leaves carry the factored preference rows; ``lam_bp`` is
@@ -441,16 +536,17 @@ def solve_packed_pref(packed, pref_rows, pref_idx, off_alloc, off_price,
         off_alloc, off_price, off_rank, num_nodes=N,
         right_size=right_size, pref_rows=pref_rows, pref_idx=pref_idx,
         pref_lambda=lam_bp / 10000.0)
-    return _pack_result(node_off, assign, unplaced, cost, compact, dense16)
+    return _pack_result(node_off, assign, unplaced, cost, compact, dense16,
+                        coo16)
 
 
 @functools.partial(jax.jit,
                    static_argnames=("G", "O", "U", "N", "right_size",
-                                    "compact", "dense16"))
+                                    "compact", "dense16", "coo16"))
 def solve_packed_batch(packed_rows, off_alloc, off_price, off_rank, *,
                        G: int, O: int, U: int, N: int,
-                       right_size: bool = True,
-                       compact: int = 0, dense16: bool = False):
+                       right_size: bool = True, compact: int = 0,
+                       dense16: bool = False, coo16: bool = False):
     """[C, Li] same-catalog packed problems -> [C, Lo] packed results in
     ONE dispatch (vmapped scan solve).  This is the zone-candidate
     refinement kernel: the C candidates differ in a single compat row
@@ -463,18 +559,19 @@ def solve_packed_batch(packed_rows, off_alloc, off_price, off_rank, *,
             off_alloc, off_price, off_rank, num_nodes=N,
             right_size=right_size)
         return _pack_result(node_off, assign, unplaced, cost, compact,
-                            dense16)
+                            dense16, coo16)
 
     return jax.vmap(one)(packed_rows)
 
 
 @functools.partial(jax.jit,
                    static_argnames=("G", "O", "U", "N", "right_size",
-                                    "interpret", "compact", "dense16"))
+                                    "interpret", "compact", "dense16",
+                                    "coo16"))
 def solve_packed_pallas(packed, alloc8, rank_row, off_price, *, G: int,
                         O: int, U: int, N: int, right_size: bool = True,
                         interpret: bool = False, compact: int = 0,
-                        dense16: bool = False):
+                        dense16: bool = False, coo16: bool = False):
     """Packed-I/O solve through the Mosaic kernel — same buffer contract
     as :func:`solve_packed`.  The [O,R] catalog view the compat rebuild
     needs is derived on device from the kernel's resident alloc8 layout
@@ -484,7 +581,43 @@ def solve_packed_pallas(packed, alloc8, rank_row, off_price, *, G: int,
     node_off, assign, unplaced, cost = _pallas_core(
         meta, compat_i, alloc8, rank_row, off_price,
         G=G, O=O, N=N, right_size=right_size, interpret=interpret)
-    return _pack_result(node_off, assign, unplaced, cost, compact, dense16)
+    return _pack_result(node_off, assign, unplaced, cost, compact, dense16,
+                        coo16)
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("C", "G", "O", "U", "N", "right_size",
+                                    "compact", "dense16", "coo16"))
+def solve_packed_pallas_batch(packed_rows, alloc8, rank_row, off_price, *,
+                              C: int, G: int, O: int, U: int, N: int,
+                              right_size: bool = True, compact: int = 0,
+                              dense16: bool = False, coo16: bool = False):
+    """[C, Li] same-catalog packed problems -> [C, Lo] packed results in
+    ONE Mosaic launch: the window-batching kernel behind the pipelined
+    stream (VERDICT round 4 item 1: per-launch tunnel overhead ~1.5-2 ms
+    dominates a single window's amortized wall — batching C consecutive
+    windows divides it by C).  Rides the fleet grid
+    (pallas_kernel.ffd_scan_pallas_fleet) with the single resident
+    catalog broadcast across the cluster axis; unpack / right-size /
+    result packing are vmapped XLA."""
+    from karpenter_tpu.solver.pallas_kernel import ffd_scan_pallas_fleet
+
+    off_alloc = alloc8[:4].T                                    # [O, R]
+    metas, compats = jax.vmap(
+        lambda p: _unpack_problem(p, off_alloc, G, O, U))(packed_rows)
+    alloc8_all = jnp.broadcast_to(alloc8[None], (C,) + alloc8.shape)
+    rank_all = jnp.broadcast_to(rank_row[None], (C,) + rank_row.shape)
+    node_off, assign, unplaced = ffd_scan_pallas_fleet(
+        metas, compats, alloc8_all, rank_all, C=C, G=G, O=O, N=N)
+
+    def finish_one(meta, compat_i, node_off_c, assign_c, unplaced_c):
+        node_off_c, cost = finish_pallas_solve(
+            meta, compat_i, node_off_c, assign_c, alloc8, rank_row,
+            off_price, right_size)
+        return _pack_result(node_off_c, assign_c, unplaced_c, cost,
+                            compact, dense16, coo16)
+
+    return jax.vmap(finish_one)(metas, compats, node_off, assign, unplaced)
 
 
 def solve_core(group_req, group_count, group_cap, compat,
@@ -595,11 +728,18 @@ class _Prepared:
     """Shapes + the packed H2D buffer for one solve.  Mutable: ``N``
     escalates on in-kernel node overflow, and each dispatch re-clamps
     ``K`` (and records ``dense16``) to the shapes it actually ran with so
-    ``unpack_result`` always parses the buffer the kernel produced."""
+    ``unpack_result`` always parses the buffer the kernel produced.
+
+    Instances built by ``_prepare`` are cached as per-problem TEMPLATES
+    (packing an unchanged window cost ~0.4 ms of the ~4 ms pipelined
+    wall); every dispatch works on a :meth:`clone` so in-flight solves
+    never see another dispatch's shape mutations.  Escalations write
+    back to the template (``tmpl``) so later windows start escalated."""
 
     __slots__ = ("catalog", "G_pad", "O_pad", "U_pad", "N", "N_cap", "K0",
-                 "K_cap", "K", "dense16_ok", "dense16", "packed",
-                 "right_size", "pref_rows", "pref_idx", "pref_lambda")
+                 "K_cap", "K", "dense16_ok", "dense16", "coo16", "packed",
+                 "right_size", "pref_rows", "pref_idx", "pref_lambda",
+                 "tmpl")
 
     def __init__(self, *, catalog, G_pad, O_pad, U_pad, N, N_cap, K0, packed,
                  K_cap=None, dense16_ok=False, right_size=None,
@@ -613,7 +753,8 @@ class _Prepared:
         self.K0 = K0
         self.K_cap = K0 if K_cap is None else K_cap
         self.dense16_ok = dense16_ok
-        self.K, self.dense16 = clamp_output_opts(K0, dense16_ok, G_pad, N)
+        self.K, self.dense16, self.coo16 = clamp_output_opts(
+            K0, dense16_ok, G_pad, N)
         self.packed = packed
         # None = use the solver's SolverOptions; the sidecar overrides
         # per request (the wire flag must win over the server's defaults)
@@ -625,6 +766,24 @@ class _Prepared:
         self.pref_rows = pref_rows
         self.pref_idx = pref_idx
         self.pref_lambda = pref_lambda
+        self.tmpl = None
+
+    def clone(self) -> "_Prepared":
+        c = _Prepared.__new__(_Prepared)
+        for s in _Prepared.__slots__:
+            setattr(c, s, getattr(self, s))
+        c.tmpl = self if self.tmpl is None else self.tmpl
+        return c
+
+    def grow_K0(self, k_new: int) -> None:
+        self.K0 = min(k_new, self.K_cap)
+        if self.tmpl is not None:
+            self.tmpl.K0 = max(self.tmpl.K0, self.K0)
+
+    def escalate_N(self, n_new: int) -> None:
+        self.N = min(n_new, self.N_cap)
+        if self.tmpl is not None:
+            self.tmpl.N = max(self.tmpl.N, self.N)
 
 
 class JaxSolver:
@@ -697,24 +856,102 @@ class JaxSolver:
             dev.copy_to_host_async()
         except Exception:  # noqa: BLE001 — cpu arrays may not support it
             pass
+        fut = _prefetch(dev)
         return PendingSolve(self, problem, prep=prep, dev=dev, path=path,
-                            t_disp=t0, t_issued=time.perf_counter())
+                            fut=fut, t_disp=t0,
+                            t_issued=time.perf_counter())
 
-    def solve_stream(self, problems, depth: int = 2):
+    def solve_stream(self, problems, depth: int = 2, batch: object = "auto"):
         """Solve an iterable of EncodedProblems through a depth-``depth``
         dispatch/fetch pipeline; yields Plans in order.  Steady-state
         per-solve wall approaches host work + chip time — the ~70 ms
         tunnel await amortizes across the window stream (the repack
-        loop's shape: consecutive 10 s windows)."""
+        loop's shape: consecutive 10 s windows).
+
+        With ``batch`` > 1 (default on TPU backends), consecutive
+        same-catalog windows that share padded shapes additionally ride
+        ONE Mosaic launch (solve_packed_pallas_batch), dividing the
+        per-launch tunnel overhead (~1.5-2 ms measured) by the batch
+        width; flat-regime / preference / shape-mismatched windows break
+        the batch and go through the single-window path unchanged.
+
+        Batching is capped at ``depth // 2`` so the pipeline contract
+        survives: accumulating a batch delays the FIRST yield by the
+        batch width, and a batch wider than the remaining depth budget
+        would be awaited synchronously with nothing else in flight.  At
+        the default depth=2 this disables batching entirely (exact
+        pre-batching behavior); throughput callers opt in with a deep
+        pipeline (bench: depth=192, batch=32)."""
         from collections import deque
 
-        q: "deque[PendingSolve]" = deque()
+        if batch == "auto":
+            batch = 16 if jax.default_backend() not in ("cpu", "gpu") else 1
+        batch = min(batch if isinstance(batch, int) else 1,
+                    max(1, depth // 2))
+        q: "deque" = deque()    # (unit, n_windows)
+        inflight = 0
+
+        def drain_to(limit):
+            nonlocal inflight
+            while q and inflight > limit:
+                unit, n = q.popleft()
+                inflight -= n
+                if n == 1:
+                    yield unit.result()
+                else:
+                    yield from unit.results()
+
+        if batch <= 1:
+            for p in problems:
+                q.append((self.solve_encoded_async(p), 1))
+                inflight += 1
+                yield from drain_to(depth)
+            yield from drain_to(0)
+            return
+
+        from karpenter_tpu.solver.flat import flat_viable
+
+        buf: list = []          # [(problem, prep)] awaiting one batch
+
+        def flush():
+            nonlocal inflight
+            if not buf:
+                return
+            if len(buf) == 1:
+                unit, n = (self.solve_encoded_async(buf[0][0]), 1)
+            else:
+                unit, n = (self._dispatch_window_batch(list(buf)), len(buf))
+            buf.clear()
+            q.append((unit, n))
+            inflight += n
+
         for p in problems:
-            q.append(self.solve_encoded_async(p))
-            if len(q) > depth:
-                yield q.popleft().result()
-        while q:
-            yield q.popleft().result()
+            prep = None
+            batchable = (p.num_groups > 0 and p.pref_rows is None
+                         and not flat_viable(p, self.options))
+            if batchable:
+                prep = self._prepare(p)
+            if not batchable:
+                flush()
+                q.append((self.solve_encoded_async(p), 1))
+                inflight += 1
+            else:
+                if buf and (buf[0][0].catalog is not p.catalog
+                            or (buf[0][1].G_pad, buf[0][1].O_pad,
+                                buf[0][1].U_pad)
+                            != (prep.G_pad, prep.O_pad, prep.U_pad)):
+                    flush()
+                buf.append((p, prep))
+                if len(buf) >= batch:
+                    flush()
+            yield from drain_to(depth)
+        flush()
+        yield from drain_to(0)
+
+    def _dispatch_window_batch(self, items) -> "BatchPendingSolve":
+        """Stack C prepared same-shape windows into one [C, Li] buffer
+        and launch them as a single Mosaic fleet-grid program."""
+        return BatchPendingSolve(self, items)
 
     def _solve_prepared(self, prep: "_Prepared"):
         """Dispatch/fetch/escalate loop on an already-packed problem —
@@ -747,13 +984,14 @@ class JaxSolver:
                 out_dev, path = self._dispatch(prep, prep.packed)
                 out_np = np.asarray(out_dev)
             t_fetch = time.perf_counter()
-            if coo_buffer_full(out_np, prep.G_pad, prep.N, prep.K) \
-                    and prep.K0 < prep.K_cap:
-                prep.K0 = grow_coo(prep.K0, prep.K_cap)
+            if coo_buffer_full(out_np, prep.G_pad, prep.N, prep.K,
+                               prep.coo16) and prep.K0 < prep.K_cap:
+                prep.grow_K0(grow_coo(prep.K0, prep.K_cap))
                 self._note_coo_growth(prep.G_pad, prep.K0)
                 continue
             node_off, assign, unplaced, cost = unpack_result(
-                out_np, prep.G_pad, prep.N, prep.K, prep.dense16)
+                out_np, prep.G_pad, prep.N, prep.K, prep.dense16,
+                prep.coo16)
             metrics.SOLVE_PATH.labels(path).inc()
             d2h = int(out_np.nbytes)
             metrics.SOLVE_D2H_BYTES.labels("jax").observe(d2h)
@@ -769,7 +1007,7 @@ class JaxSolver:
                 "compact": bool(prep.K), "G": prep.G_pad, "O": prep.O_pad,
                 "N": prep.N}
             if needs_node_escalation(node_off, unplaced, prep.N, prep.N_cap):
-                prep.N = min(prep.N_cap, bucket(prep.N * 4, NODE_BUCKETS))
+                prep.escalate_N(bucket(prep.N * 4, NODE_BUCKETS))
                 continue
             return node_off, assign, unplaced, cost
 
@@ -840,7 +1078,7 @@ class JaxSolver:
         # pad the batch axis to a small bucket (rows repeat row 0) so
         # shrinking candidate sets across refinement rounds reuse one
         # compiled executable instead of retracing per distinct C
-        C_pad = bucket(C, (2, 4, 8, 16, 32))
+        C_pad = bucket(C, BATCH_BUCKETS)
         rows = np.stack([p.packed for p in preps]
                         + [preps[0].packed] * (C_pad - C))
         off_alloc, off_price, off_rank = self._device_offerings(
@@ -848,22 +1086,22 @@ class JaxSolver:
         dense16_ok = all(p.dense16_ok for p in preps)
         t_disp = time.perf_counter()
         while True:
-            K, dense16 = clamp_output_opts(K0, dense16_ok, G_pad, N)
+            K, dense16, coo16 = clamp_output_opts(K0, dense16_ok, G_pad, N)
             t_issue = time.perf_counter()
             out_dev = solve_packed_batch(
                 rows, off_alloc, off_price, off_rank,
                 G=G_pad, O=O_pad, U=U_pad, N=N,
                 right_size=self.options.right_size,
-                compact=K, dense16=dense16)
+                compact=K, dense16=dense16, coo16=coo16)
             t_issued = time.perf_counter()
             out_np = np.asarray(out_dev)
             t_fetch = time.perf_counter()
-            if any(coo_buffer_full(out_np[c], G_pad, N, K)
+            if any(coo_buffer_full(out_np[c], G_pad, N, K, coo16)
                    for c in range(C)) and K0 < K_cap:
                 K0 = grow_coo(K0, K_cap)
                 self._note_coo_growth(G_pad, K0)
                 continue
-            parsed = [unpack_result(out_np[c], G_pad, N, K, dense16)
+            parsed = [unpack_result(out_np[c], G_pad, N, K, dense16, coo16)
                       for c in range(C)]
             if any(needs_node_escalation(no, u, N, N_cap)
                    for no, _, u, _ in parsed):
@@ -886,27 +1124,81 @@ class JaxSolver:
         re-runs the packed solve on DEVICE-RESIDENT inputs and blocks until
         the on-device result is ready — no H2D, no D2H.  This is the
         "<50 ms on one v5e chip" measurement (VERDICT round 2 item 2: the
-        wall number alone cannot separate chip time from tunnel time)."""
+        wall number alone cannot separate chip time from tunnel time).
+
+        The inner loop calls the resolved jit executable DIRECTLY — the
+        routing/clamping Python in ``_dispatch`` costs ~0.5 ms per call,
+        which at k=9 dispatches would inflate the measured chip slope by
+        ~70% (round-4 ``compute_ms`` 1.21 was really ~0.7 chip)."""
         prep = self._prepare(problem)
         dev_in = jax.device_put(prep.packed)
         jax.block_until_ready(dev_in)
+        out, path = self._dispatch(prep, dev_in)    # resolve path + warm
+        out.block_until_ready()
+        rs = self.options.right_size if prep.right_size is None \
+            else prep.right_size
+        if path == "scan-pref":
+            # preference solves keep the (rare) routed dispatch — the
+            # slope is still exact, just with the Python overhead noted
+            def fn():
+                return self._dispatch(prep, dev_in)[0]
+        elif path == "pallas":
+            alloc8, rank_row, price = self._device_offerings_pallas(
+                prep.catalog, prep.O_pad)
+            fn = functools.partial(
+                solve_packed_pallas, dev_in, alloc8, rank_row, price,
+                G=prep.G_pad, O=prep.O_pad, U=prep.U_pad, N=prep.N,
+                right_size=rs, compact=prep.K, dense16=prep.dense16,
+                coo16=prep.coo16)
+        else:
+            off_alloc, off_price, off_rank = self._device_offerings(
+                prep.catalog, prep.O_pad)
+            fn = functools.partial(
+                solve_packed, dev_in, off_alloc, off_price, off_rank,
+                G=prep.G_pad, O=prep.O_pad, U=prep.U_pad, N=prep.N,
+                right_size=rs, compact=prep.K, dense16=prep.dense16,
+                coo16=prep.coo16)
 
         def run(k: int = 1):
             # k back-to-back dispatches, ONE block: through a high-RTT
             # link, per-solve device time = slope of t(k) over k (the
             # single fixed sync round trip cancels out)
-            outs = [self._dispatch(prep, dev_in)[0] for _ in range(k)]
+            outs = [fn() for _ in range(k)]
             outs[-1].block_until_ready()
             return outs[-1]
 
-        run()   # warm the executable for this shape
+        run()
         return run
 
     def _prepare(self, problem: EncodedProblem,
                  u_pad: Optional[int] = None) -> "_Prepared":
-        """Pad, choose shapes, and pack the single H2D buffer.  ``u_pad``
-        overrides the label-row bucket (the batch path needs one common U
-        across candidates whose row counts differ by one)."""
+        """Pad, choose shapes, and pack the single H2D buffer; the result
+        is a CLONE of a per-problem cached template (EncodedProblems are
+        immutable by convention, so the packed buffer of an unchanged
+        window never needs rebuilding — the provisioner re-solves the
+        same pending set every tick).  ``u_pad`` overrides the label-row
+        bucket (the batch path needs one common U across candidates
+        whose row counts differ by one)."""
+        opts = self.options
+        key = (u_pad, opts.bucket_groups, opts.max_nodes,
+               opts.adaptive_nodes, opts.compact_assign)
+        cache = problem._prep_cache
+        if cache is None:
+            cache = problem._prep_cache = {}
+        tmpl = cache.get(key)
+        if tmpl is not None:
+            c = tmpl.clone()
+            # cross-problem COO floor learned since the template was built
+            floor = self._coo_floor.get(c.G_pad, 0)
+            if floor > c.K0:
+                c.K0 = min(floor, c.K_cap)
+            return c
+        tmpl = self._prepare_impl(problem, u_pad)
+        cache[key] = tmpl
+        return tmpl.clone()
+
+    def _prepare_impl(self, problem: EncodedProblem,
+                      u_pad: Optional[int] = None) -> "_Prepared":
         catalog = problem.catalog
         G = problem.num_groups
         O = catalog.num_offerings
@@ -963,7 +1255,7 @@ class JaxSolver:
             # the fast path stays clean)
             off_alloc, off_price, off_rank = self._device_offerings(
                 catalog, O_pad)
-            prep.K, prep.dense16 = clamp_output_opts(
+            prep.K, prep.dense16, prep.coo16 = clamp_output_opts(
                 prep.K0, prep.dense16_ok, G_pad, N)
             rs = self.options.right_size if prep.right_size is None \
                 else prep.right_size
@@ -974,7 +1266,7 @@ class JaxSolver:
                 off_alloc, off_price, off_rank,
                 G=G_pad, O=O_pad, U=prep.U_pad, N=N,
                 P=prep.pref_rows.shape[0], right_size=rs,
-                compact=prep.K, dense16=prep.dense16,
+                compact=prep.K, dense16=prep.dense16, coo16=prep.coo16,
                 lam_bp=int(lam * 10000))
             return out, "scan-pref"
         # pallas needs a 128-multiple node axis; never exceed the
@@ -993,7 +1285,7 @@ class JaxSolver:
                 # (K, dense16) must match the node axis ACTUALLY
                 # dispatched — escalation and the 128-rounding land on
                 # shapes the _prepare-time values don't hold for
-                prep.K, prep.dense16 = clamp_output_opts(
+                prep.K, prep.dense16, prep.coo16 = clamp_output_opts(
                     prep.K0, prep.dense16_ok, G_pad, Np)
                 rs = self.options.right_size if prep.right_size is None \
                     else prep.right_size
@@ -1001,7 +1293,8 @@ class JaxSolver:
                     arr, alloc8, rank_row, price_dev,
                     G=G_pad, O=O_pad, U=prep.U_pad, N=Np,
                     right_size=rs,
-                    compact=prep.K, dense16=prep.dense16)
+                    compact=prep.K, dense16=prep.dense16,
+                    coo16=prep.coo16)
                 prep.N = Np
                 return out, "pallas"
             except Exception as e:  # noqa: BLE001
@@ -1011,7 +1304,7 @@ class JaxSolver:
                 self._pallas_failed_shapes.add((G_pad, O_pad, Np))
         off_alloc, off_price, off_rank = self._device_offerings(
             catalog, O_pad)
-        prep.K, prep.dense16 = clamp_output_opts(
+        prep.K, prep.dense16, prep.coo16 = clamp_output_opts(
             prep.K0, prep.dense16_ok, G_pad, N)
         rs = self.options.right_size if prep.right_size is None \
             else prep.right_size
@@ -1019,7 +1312,7 @@ class JaxSolver:
             arr, off_alloc, off_price, off_rank,
             G=G_pad, O=O_pad, U=prep.U_pad, N=N,
             right_size=rs,
-            compact=prep.K, dense16=prep.dense16)
+            compact=prep.K, dense16=prep.dense16, coo16=prep.coo16)
         return out, "scan"
 
     def _compact_k(self, total_pods: int, G_pad: int) -> Tuple[int, int]:
@@ -1038,7 +1331,10 @@ class JaxSolver:
         if mode != "on" and jax.default_backend() in ("cpu", "gpu"):
             return 0, 0
         cap = bucket(total_pods + G_pad, COO_BUCKETS)
-        first = max(bucket(max(total_pods // 4, 256) + G_pad, COO_BUCKETS),
+        # total/8 start (real solves land near nnz ~ open nodes x
+        # groups-per-node, far below the pod bound); the persistent
+        # per-G floor absorbs the rare workload where this under-shoots
+        first = max(bucket(max(total_pods // 8, 256) + G_pad, COO_BUCKETS),
                     self._coo_floor.get(G_pad, 0))
         return min(first, cap), cap
 
@@ -1133,16 +1429,17 @@ class PendingSolve:
     densification on the pipelined path."""
 
     __slots__ = ("_solver", "_problem", "_prep", "_dev", "_path", "_flat",
-                 "_t_disp", "_t_issued", "_done")
+                 "_fut", "_t_disp", "_t_issued", "_done")
 
     def __init__(self, solver, problem, prep=None, dev=None, path="",
-                 flat=None, t_disp=0.0, t_issued=0.0, done=None):
+                 flat=None, fut=None, t_disp=0.0, t_issued=0.0, done=None):
         self._solver = solver
         self._problem = problem
         self._prep = prep
         self._dev = dev
         self._path = path
         self._flat = flat
+        self._fut = fut
         self._t_disp = t_disp
         self._t_issued = t_issued
         self._done = done
@@ -1162,10 +1459,11 @@ class PendingSolve:
 
         solver, prep = self._solver, self._prep
         dev, path = self._dev, self._path
+        fut = self._fut
         t_disp, t_issued = self._t_disp, self._t_issued
         while True:
             try:
-                out_np = np.asarray(dev)
+                out_np = _await_dev(dev, fut)
             except Exception as e:  # noqa: BLE001 — Mosaic runtime fault
                 if path != "pallas":
                     raise
@@ -1176,11 +1474,13 @@ class PendingSolve:
                 solver._pallas_failed_shapes.add(
                     (prep.G_pad, prep.O_pad, prep.N))
                 dev, path = solver._dispatch(prep, prep.packed)
+                fut = _prefetch(dev)
                 continue
             t_fetch = time.perf_counter()
             G, N, K = prep.G_pad, prep.N, prep.K
-            if coo_buffer_full(out_np, G, N, K) and prep.K0 < prep.K_cap:
-                prep.K0 = grow_coo(prep.K0, prep.K_cap)
+            if coo_buffer_full(out_np, G, N, K, prep.coo16) \
+                    and prep.K0 < prep.K_cap:
+                prep.grow_K0(grow_coo(prep.K0, prep.K_cap))
                 solver._note_coo_growth(G, prep.K0)
                 t_disp = time.perf_counter()
                 dev, path = solver._dispatch(prep, prep.packed)
@@ -1188,6 +1488,7 @@ class PendingSolve:
                     dev.copy_to_host_async()
                 except Exception:  # noqa: BLE001
                     pass
+                fut = _prefetch(dev)
                 t_issued = time.perf_counter()
                 continue
             node_off = out_np[:N]
@@ -1203,18 +1504,18 @@ class PendingSolve:
                 "h2d_bytes": int(prep.packed.nbytes),
                 "compact": bool(K), "G": G, "O": prep.O_pad, "N": N}
             if needs_node_escalation(node_off, unplaced, N, prep.N_cap):
-                prep.N = min(prep.N_cap, bucket(prep.N * 4, NODE_BUCKETS))
+                prep.escalate_N(bucket(prep.N * 4, NODE_BUCKETS))
                 t_disp = time.perf_counter()
                 dev, path = solver._dispatch(prep, prep.packed)
                 try:
                     dev.copy_to_host_async()
                 except Exception:  # noqa: BLE001
                     pass
+                fut = _prefetch(dev)
                 t_issued = time.perf_counter()
                 continue
             if K > 0:
-                idx = out_np[N + G + 1:N + G + 1 + K]
-                cnt = out_np[N + G + 1 + K:N + G + 1 + 2 * K]
+                idx, cnt = unpack_coo_tail(out_np, G, N, K, prep.coo16)
                 live = cnt > 0
                 flat_idx = idx[live]
                 self._done = decode_plan_entries(
@@ -1222,11 +1523,156 @@ class PendingSolve:
                     cnt[live], unplaced, cost, "jax")
             else:
                 _, assign, _, _ = unpack_result(out_np, G, N, K,
-                                                prep.dense16)
+                                                prep.dense16, prep.coo16)
                 self._done = decode_plan(self._problem, node_off,
                                          assign.astype(np.int32), unplaced,
                                          cost, "jax")
             return self._done
+
+
+class BatchPendingSolve:
+    """C in-flight same-shape windows in one Mosaic launch (the
+    window-batching arm of ``solve_stream``).  ``results()`` blocks on
+    the single async copy, handles COO growth / node escalation with a
+    whole-batch re-dispatch (both rare and shared-shape by
+    construction), and decodes each row straight from device COO.  A
+    Mosaic runtime fault falls back to per-window scan solves."""
+
+    __slots__ = ("_solver", "_problems", "_preps", "_C", "_C_pad", "_rows",
+                 "_N", "_N_run", "_N_cap", "_K0", "_K_cap", "_dense16_ok",
+                 "_K", "_dense16", "_coo16", "_dev", "_fut", "_path",
+                 "_t_disp", "_t_issued", "_done")
+
+    def __init__(self, solver: "JaxSolver", items):
+        self._solver = solver
+        self._problems = [p for p, _ in items]
+        self._preps = [pr for _, pr in items]
+        p0 = self._preps[0]
+        self._C = len(items)
+        self._C_pad = bucket(self._C, BATCH_BUCKETS)
+        self._rows = np.stack([pr.packed for pr in self._preps]
+                              + [p0.packed] * (self._C_pad - self._C))
+        self._N = max(pr.N for pr in self._preps)
+        self._N_cap = max(pr.N_cap for pr in self._preps)
+        self._K0 = max(pr.K0 for pr in self._preps)
+        self._K_cap = max(pr.K_cap for pr in self._preps)
+        self._dense16_ok = all(pr.dense16_ok for pr in self._preps)
+        self._done = None
+        self._dispatch()
+
+    def _dispatch(self):
+        solver, p0 = self._solver, self._preps[0]
+        G, O = p0.G_pad, p0.O_pad
+        self._t_disp = time.perf_counter()
+        Np = max(self._N, 128)        # pallas needs a 128-multiple axis
+        use_pallas = Np <= self._N_cap \
+            and solver._use_pallas(G, O, Np) \
+            and (G, O, Np) not in solver._pallas_failed_shapes
+        self._N_run = Np if use_pallas else self._N
+        self._K, self._dense16, self._coo16 = clamp_output_opts(
+            self._K0, self._dense16_ok, G, self._N_run)
+        if use_pallas:
+            alloc8, rank_row, price = solver._device_offerings_pallas(
+                p0.catalog, O)
+            self._dev = solve_packed_pallas_batch(
+                self._rows, alloc8, rank_row, price,
+                C=self._C_pad, G=G, O=O, U=p0.U_pad, N=self._N_run,
+                right_size=solver.options.right_size,
+                compact=self._K, dense16=self._dense16, coo16=self._coo16)
+            self._path = "pallas-batch"
+        else:
+            off_alloc, off_price, off_rank = solver._device_offerings(
+                p0.catalog, O)
+            self._dev = solve_packed_batch(
+                self._rows, off_alloc, off_price, off_rank,
+                G=G, O=O, U=p0.U_pad, N=self._N_run,
+                right_size=solver.options.right_size,
+                compact=self._K, dense16=self._dense16, coo16=self._coo16)
+            self._path = "scan-batch"
+        try:
+            self._dev.copy_to_host_async()
+        except Exception:  # noqa: BLE001 — cpu arrays
+            pass
+        self._fut = _prefetch(self._dev)
+        self._t_issued = time.perf_counter()
+
+    def results(self) -> List[Plan]:
+        if self._done is not None:
+            return self._done
+        from karpenter_tpu.solver.encode import (
+            decode_plan, decode_plan_entries,
+        )
+
+        solver, p0 = self._solver, self._preps[0]
+        G, O = p0.G_pad, p0.O_pad
+        while True:
+            try:
+                out_np = _await_dev(self._dev, self._fut)
+            except Exception as e:  # noqa: BLE001 — Mosaic runtime fault
+                if self._path != "pallas-batch":
+                    raise
+                log.warning("pallas batch failed; scan-batch fallback",
+                            error=str(e)[:300], G=G, O=O, N=self._N_run,
+                            C=self._C)
+                metrics.ERRORS.labels("solver", "pallas_fallback").inc()
+                solver._pallas_failed_shapes.add((G, O, self._N_run))
+                self._dispatch()
+                continue
+            t_fetch = time.perf_counter()
+            N, K = self._N_run, self._K
+            if self._K0 < self._K_cap and any(
+                    coo_buffer_full(out_np[c], G, N, K, self._coo16)
+                    for c in range(self._C)):
+                self._K0 = grow_coo(self._K0, self._K_cap)
+                for pr in self._preps:
+                    pr.grow_K0(self._K0)
+                solver._note_coo_growth(G, self._K0)
+                self._dispatch()
+                continue
+            parsed = []
+            for c in range(self._C):
+                row = out_np[c]
+                node_off = row[:N]
+                unplaced = row[N:N + G]
+                cost = float(row[N + G:N + G + 1].view(np.float32)[0])
+                parsed.append((row, node_off, unplaced, cost))
+            if any(needs_node_escalation(no, u, N, self._N_cap)
+                   for _, no, u, _ in parsed):
+                self._N = min(self._N_cap, bucket(N * 4, NODE_BUCKETS))
+                for pr in self._preps:
+                    pr.escalate_N(self._N)
+                self._dispatch()
+                continue
+            metrics.SOLVE_PATH.labels(self._path).inc()
+            metrics.SOLVE_D2H_BYTES.labels("jax").observe(int(out_np.nbytes))
+            solver.last_stats = {
+                "path": self._path, "batch": self._C,
+                "batch_pad": self._C_pad,
+                "wall_s": t_fetch - self._t_disp,
+                "dispatch_s": self._t_issued - self._t_disp,
+                "exec_fetch_s": t_fetch - self._t_issued,
+                "d2h_bytes": int(out_np.nbytes),
+                "h2d_bytes": int(self._rows.nbytes),
+                "compact": bool(K), "G": G, "O": O, "N": N}
+            plans = []
+            for problem, (row, node_off, unplaced, cost) in zip(
+                    self._problems, parsed):
+                if K > 0:
+                    idx, cnt = unpack_coo_tail(row, G, N, K, self._coo16)
+                    live = cnt > 0
+                    fi = idx[live]
+                    plans.append(decode_plan_entries(
+                        problem, node_off, fi % G, fi // G, cnt[live],
+                        unplaced, cost, "jax"))
+                else:
+                    _, assign, _, _ = unpack_result(row, G, N, K,
+                                                    self._dense16,
+                                                    self._coo16)
+                    plans.append(decode_plan(problem, node_off,
+                                             assign.astype(np.int32),
+                                             unplaced, cost, "jax"))
+            self._done = plans
+            return plans
 
 
 def _pad1(a: np.ndarray, n: int) -> np.ndarray:

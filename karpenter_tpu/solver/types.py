@@ -65,7 +65,7 @@ class SolveRequest:
     nodepool: Optional[NodePool] = None
 
 
-@dataclass
+@dataclass(slots=True)
 class PlannedNode:
     """One node the plan wants created."""
 
@@ -123,11 +123,23 @@ def _next_pow2(n: int) -> int:
 
 
 GROUP_BUCKETS = (32, 64, 128, 256, 512, 1024, 2048)
-OFFERING_BUCKETS = (128, 256, 512, 1024, 2048, 4096)
-NODE_BUCKETS = (64, 256, 1024, 2048, 4096, 8192, 16384)
+# 3072 = 24 x 128: a real rung between 2k and 4k — the 500-type x 3-zone
+# catalog lands at 3000 offerings, and every per-step [G, O] / [8, O]
+# kernel tensor scales with O_pad (25% chip time at the headline shape)
+OFFERING_BUCKETS = (128, 256, 512, 1024, 2048, 3072, 4096)
+# finer low rungs (128-multiples, the pallas node-axis granularity): the
+# headline solve opens ~240 nodes — N=1024 ran the kernel 2x too wide
+NODE_BUCKETS = (64, 128, 256, 384, 512, 1024, 2048, 4096, 8192, 16384)
 # COO capacity buckets for the compacted assign fetch: nnz <= placed pods
-# (every entry carries >=1 pod), so sizing by total pods is always safe
-COO_BUCKETS = (256, 1024, 4096, 16384, 65536)
+# (every entry carries >=1 pod), so sizing by total pods is always safe.
+# Finer rungs matter through the TPU tunnel: D2H payload is wall-clock
+# (~0.5 ms per 16 KB measured), and the old 1k->4k jump cost ~24 KB of
+# dead zeros per window at the headline nnz (~1.2k entries)
+COO_BUCKETS = (256, 512, 1024, 2048, 4096, 8192, 16384, 32768, 65536)
 # label-row buckets for the factored compat upload (U distinct masks;
 # typically single digits — 1 when pods carry no constraints)
 LABELROW_BUCKETS = (4, 16, 64, 256, 1024, 4096)
+# batch-axis pad rungs shared by every stacked-solve surface (zone
+# candidates, window batching, the sidecar's SolveBatch): shrinking
+# batches must land on the same compiled executable across paths
+BATCH_BUCKETS = (2, 4, 8, 16, 32)

@@ -371,3 +371,40 @@ class TestRepackApply:
         for i in range(2):
             assert not cluster.get_nodeclaim(f"rb{i}").deleted
             assert cluster.get("pods", f"default/rp{i}").bound_node
+
+
+class TestRepackBreakerGuard:
+    def test_oversized_plan_defers_instead_of_partial_create(self, rig):
+        """The burst guard must see the breaker's REAL config (a private
+        -only attribute silently disabled it — the repack then churned
+        create/abort against the rate limit every cooldown)."""
+        from karpenter_tpu.core import Actuator
+        from karpenter_tpu.core.circuitbreaker import (
+            CircuitBreakerConfig, CircuitBreakerManager,
+        )
+        from karpenter_tpu.core.provisioner import Provisioner
+
+        cluster, ctrl, clock, itp = rig
+        cloud = itp._client
+        nc = cluster.get_nodeclass("default")
+        nc.status.resolved_image_id = "img-1"
+        nc.status.set_condition("Ready", "True", "Validated")
+        breaker = CircuitBreakerManager(CircuitBreakerConfig(
+            rate_limit_per_minute=2))
+        actuator = Actuator(cloud, cluster, breaker=breaker)
+        ctrl.provisioner = Provisioner(cluster, itp, actuator)
+        ctrl.repack_enabled = True
+        ctrl.repack_cooldown = 0.0
+        # a fleet whose repack plan needs more creates than the budget:
+        # many pods that cannot share nodes (each fills a small node)
+        for i in range(8):
+            c = _claim(cluster, f"fat{i}", itype="bx2-16x64", price=0.9,
+                       age=clock.t - 3600)
+            # one pod > half of the biggest node (128 cpu): the fresh
+            # plan needs 8 nodes, far over the 2/min budget
+            _pod(cluster, f"p{i}", c.node_name, cpu=70000, mem=3000)
+        before = {c.name for c in cluster.nodeclaims()}
+        assert ctrl._repack_if_profitable() == 0
+        # deferred: no partial fleet created, nothing rolled back/deleted
+        assert {c.name for c in cluster.nodeclaims()} == before
+        assert ctrl._pending_repack is None

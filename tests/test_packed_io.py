@@ -218,3 +218,48 @@ class TestSolverIntegration:
         # right-sizing may only IMPROVE on greedy cost, never regress it
         assert jplan.total_cost_per_hour <= gplan.total_cost_per_hour + 1e-6
         assert sorted(jplan.unplaced_pods) == sorted(gplan.unplaced_pods)
+
+
+class TestCoo16:
+    """Single-word COO wire format ((idx << 16) | cnt): exact round trip
+    and parity with the two-array layout (the D2H payload is wall-clock
+    through the TPU tunnel — coo16 halves the dominant tail)."""
+
+    def test_coo16_round_trip_parity(self):
+        import jax
+
+        from karpenter_tpu.solver.jax_backend import (
+            _pack_result, clamp_output_opts, unpack_result,
+        )
+
+        G, N = 6, 16
+        rng = np.random.RandomState(3)
+        assign = rng.randint(0, 5, size=(G, N)).astype(np.int32)
+        node_off = rng.randint(-1, 4, size=N).astype(np.int32)
+        unplaced = rng.randint(0, 3, size=G).astype(np.int32)
+        K, dense16, coo16 = clamp_output_opts(64, True, G, N)
+        assert coo16 and not dense16
+        out16 = np.asarray(jax.jit(
+            lambda a, b, c, d: _pack_result(a, b, c, d, K, coo16=True))(
+                node_off, assign, unplaced, np.float32(7.5)))
+        out32 = np.asarray(jax.jit(
+            lambda a, b, c, d: _pack_result(a, b, c, d, K))(
+                node_off, assign, unplaced, np.float32(7.5)))
+        assert out16.shape[0] == N + G + 1 + K
+        assert out32.shape[0] == N + G + 1 + 2 * K
+        a16 = unpack_result(out16, G, N, K, coo16=True)
+        a32 = unpack_result(out32, G, N, K)
+        for x, y in zip(a16, a32):
+            np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+
+    def test_coo16_gate_bounds(self):
+        from karpenter_tpu.solver.jax_backend import clamp_output_opts
+
+        # G*N beyond 2^15 must fall back to the two-array layout
+        _, _, coo16 = clamp_output_opts(64, True, 64, 1024)
+        assert not coo16
+        # within 2^15 but pod counts unbounded -> no packing either
+        _, _, coo16 = clamp_output_opts(64, False, 64, 512)
+        assert not coo16
+        _, _, coo16 = clamp_output_opts(64, True, 64, 512)
+        assert coo16

@@ -70,3 +70,63 @@ class TestAsyncSolve:
         stream_costs = [pl.total_cost_per_hour
                         for pl in js.solve_stream(problems, depth=2)]
         assert stream_costs == sync_costs
+
+
+class TestBatchedStream:
+    """Window batching (solve_stream batch>1): C consecutive same-shape
+    windows ride one dispatch (scan-batch on CPU; the Mosaic fleet grid
+    on TPU) with bit-identical plans to the per-window path."""
+
+    def test_batched_stream_parity(self):
+        catalog = make_catalog()
+        js = JaxSolver()
+        problems = [encode(mixed_pods(120, seed=s), catalog)
+                    for s in range(7)]
+        sync = [js.solve_encoded(p) for p in problems]
+        plans = list(js.solve_stream(problems, depth=8, batch=4))
+        assert js.last_stats.get("path", "").endswith("-batch")
+        assert [p.total_cost_per_hour for p in plans] == \
+            [p.total_cost_per_hour for p in sync]
+        for got, want, prob in zip(plans, sync, problems):
+            assert sorted(p for n in got.nodes for p in n.pod_names) == \
+                sorted(p for n in want.nodes for p in n.pod_names)
+
+    def test_batched_stream_mixed_catalogs_split(self):
+        cat_a, cat_b = make_catalog(), make_catalog(20)
+        js = JaxSolver()
+        problems = [encode(mixed_pods(60, seed=s), cat_a) for s in range(3)] \
+            + [encode(mixed_pods(60, seed=s), cat_b) for s in range(3)]
+        sync_costs = [js.solve_encoded(p).total_cost_per_hour
+                      for p in problems]
+        got = [pl.total_cost_per_hour
+               for pl in js.solve_stream(problems, depth=8, batch=4)]
+        assert got == sync_costs
+
+    def test_batched_stream_repeated_problem_uses_prep_cache(self):
+        catalog = make_catalog()
+        js = JaxSolver()
+        problem = encode(mixed_pods(200, seed=3), catalog)
+        plans = list(js.solve_stream([problem] * 9, depth=8, batch=4))
+        want = js.solve_encoded(problem)
+        assert all(p.total_cost_per_hour == want.total_cost_per_hour
+                   for p in plans)
+        # the packed template was built once and cloned per window
+        assert problem._prep_cache is not None
+        assert len(problem._prep_cache) == 1
+
+    def test_stream_empty_and_flat_windows_break_batch(self):
+        catalog = make_catalog()
+        rng = np.random.RandomState(5)
+        hetero = [PodSpec(f"h{i}", requests=ResourceRequests(
+            int(rng.randint(100, 4000)), int(rng.randint(256, 8192)), 0, 1))
+            for i in range(300)]
+        js = JaxSolver(SolverOptions(backend="jax", flat_min_groups=16))
+        problems = [encode(mixed_pods(80, seed=1), catalog),
+                    encode([], catalog),
+                    encode(hetero, catalog),
+                    encode(mixed_pods(80, seed=2), catalog)]
+        sync_costs = [js.solve_encoded(p).total_cost_per_hour
+                      for p in problems]
+        got = [pl.total_cost_per_hour
+               for pl in js.solve_stream(problems, depth=8, batch=4)]
+        assert got == sync_costs

@@ -1,0 +1,78 @@
+"""Cold-start tier: persistent compile cache config + bucket warmup
+(operator restart must not pay XLA compilation in its first window)."""
+import os
+
+import numpy as np
+import pytest
+
+from karpenter_tpu.catalog import CatalogArrays, InstanceTypeProvider, PricingProvider
+from karpenter_tpu.cloud.fake import FakeCloud, generate_profiles
+from karpenter_tpu.solver import JaxSolver
+from karpenter_tpu.solver.warmup import (
+    enable_persistent_compile_cache, warmup_solver,
+)
+
+
+def make_catalog(n=20):
+    cloud = FakeCloud(profiles=generate_profiles(n))
+    pricing = PricingProvider(cloud)
+    itp = InstanceTypeProvider(cloud, pricing)
+    catalog = CatalogArrays.build(itp.list())
+    pricing.close()
+    return catalog
+
+
+class TestCompileCache:
+    def test_disabled_without_config(self, monkeypatch):
+        monkeypatch.delenv("KARPENTER_TPU_COMPILE_CACHE", raising=False)
+        assert enable_persistent_compile_cache() is None
+
+    def test_enables_and_creates_dir(self, tmp_path):
+        import jax
+
+        d = str(tmp_path / "jit-cache")
+        assert enable_persistent_compile_cache(d) == d
+        assert os.isdir(d) if hasattr(os, "isdir") else os.path.isdir(d)
+        assert jax.config.jax_compilation_cache_dir == d
+
+
+class TestWarmup:
+    def test_warmup_compiles_ladder(self):
+        catalog = make_catalog()
+        solver = JaxSolver()
+        warmed = warmup_solver(solver, catalog,
+                               shapes=((32, 4, 64, 500),),
+                               batch_widths=(2,), force=True)
+        assert warmed >= 1
+        # catalog tensors are resident after warmup
+        assert solver._device_catalog
+
+    def test_warmup_never_raises_on_bad_shape(self):
+        catalog = make_catalog()
+        solver = JaxSolver()
+        # absurd shape must be swallowed, not fatal (boot path)
+        warmup_solver(solver, catalog, shapes=((32, 4, -5, 100),),
+                      force=True)
+
+    def test_operator_boot_runs_warmup(self):
+        from karpenter_tpu.apis.nodeclass import NodeClass, NodeClassSpec
+        from karpenter_tpu.operator.operator import Operator
+        from karpenter_tpu.operator.options import Options
+
+        op = Operator(Options(api_key="k", region="us-south",
+                              solver_warmup=True))
+        # a ready NodeClass so warmup warms the PROVISIONER'S catalog
+        # (the instance production solves hit), not a private rebuild
+        nc = NodeClass(name="default", spec=NodeClassSpec(
+            region="us-south", image="img-1", vpc="vpc-1",
+            instance_profile="bx2-4x16"))
+        op.cluster.add_nodeclass(nc)
+        try:
+            op.start()
+            import time
+            deadline = time.time() + 30
+            while time.time() < deadline and not op.provisioner.solver._device_catalog:
+                time.sleep(0.1)
+            assert op.provisioner.solver._device_catalog
+        finally:
+            op.stop()

@@ -1,0 +1,141 @@
+"""Profile the pipelined solve window: where do the host-side
+milliseconds go?  Splits one steady-state window of the headline
+config into prepare (pack), dispatch (jit call), fetch (np.asarray of a
+landed async copy) and decode (COO -> Plan), plus the flat_viable check.
+
+Usage: python tools/profile_window.py [--pods 10000] [--types 500]
+       [--iters 40] [--hetero]
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def p50(xs):
+    return float(np.percentile(xs, 50)) * 1000
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--pods", type=int, default=10000)
+    ap.add_argument("--types", type=int, default=500)
+    ap.add_argument("--iters", type=int, default=40)
+    ap.add_argument("--hetero", action="store_true")
+    args = ap.parse_args()
+
+    import bench
+    bench.resolve_platform()
+    import jax
+
+    from karpenter_tpu.solver import JaxSolver, SolveRequest, encode
+    from karpenter_tpu.solver.flat import flat_viable
+
+    if args.hetero:
+        pods, catalog = bench.build_hetero_workload(args.pods, args.types)
+    else:
+        pods, catalog = bench.build_workload(args.pods, args.types)
+    problem = encode(pods, catalog)
+    solver = JaxSolver()
+    request = SolveRequest(pods, catalog)
+    plan = solver.solve(request)          # warm compile
+    print(f"backend={jax.default_backend()} path={solver.last_stats.get('path')} "
+          f"G={problem.num_groups} nodes={len(plan.nodes)} "
+          f"placed={plan.placed_count}")
+
+    # -- component timings over iters windows -----------------------------
+    t_flat, t_prep, t_disp, t_fetch, t_decode = [], [], [], [], []
+    for _ in range(args.iters):
+        t0 = time.perf_counter()
+        fv = flat_viable(problem, solver.options)
+        t1 = time.perf_counter()
+        prep = solver._prepare(problem)
+        t2 = time.perf_counter()
+        dev, path = solver._dispatch(prep, prep.packed)
+        try:
+            dev.copy_to_host_async()
+        except Exception:
+            pass
+        t3 = time.perf_counter()
+        out_np = np.asarray(dev)           # NOTE: blocking (includes chip)
+        t4 = time.perf_counter()
+        G, N, K = prep.G_pad, prep.N, prep.K
+        node_off = out_np[:N]
+        unplaced = out_np[N:N + G]
+        cost = float(out_np[N + G:N + G + 1].view(np.float32)[0])
+        if K > 0:
+            from karpenter_tpu.solver.encode import decode_plan_entries
+            from karpenter_tpu.solver.jax_backend import unpack_coo_tail
+            idx, cnt = unpack_coo_tail(out_np, G, N, K, prep.coo16)
+            live = cnt > 0
+            fi = idx[live]
+            decode_plan_entries(problem, node_off, fi % G, fi // G,
+                                cnt[live], unplaced, cost, "jax")
+        t5 = time.perf_counter()
+        t_flat.append(t1 - t0)
+        t_prep.append(t2 - t1)
+        t_disp.append(t3 - t2)
+        t_fetch.append(t4 - t3)
+        t_decode.append(t5 - t4)
+    print(f"flat_viable {p50(t_flat):8.3f} ms")
+    print(f"prepare     {p50(t_prep):8.3f} ms")
+    print(f"dispatch    {p50(t_disp):8.3f} ms  (path={path})")
+    print(f"fetch(blk)  {p50(t_fetch):8.3f} ms  (incl chip+rtt)")
+    print(f"decode      {p50(t_decode):8.3f} ms")
+
+    # -- pipelined amortized, as the bench measures it ---------------------
+    import itertools
+    amort, pp50, depth = bench.run_pipelined(solver, problem,
+                                             max(args.iters * 2, 48))
+    print(f"pipelined amortized {amort:8.3f} ms  p50 {pp50:8.3f} (depth {depth})")
+
+    # finer: the BATCHED stream's anatomy — submit (prep+stack+dispatch),
+    # await (asarray of the landed copy), decode per batch of 16
+    import itertools
+
+    from karpenter_tpu.solver.encode import decode_plan_entries  # noqa: F401
+
+    n_batches = max(args.iters // 2, 8)
+    t_submit, t_await, t_decode = [], [], []
+    pend = []
+    t0 = time.perf_counter()
+    for i in range(n_batches):
+        ta = time.perf_counter()
+        unit = solver._dispatch_window_batch([(problem, solver._prepare(problem))
+                                              for _ in range(16)])
+        tb = time.perf_counter()
+        t_submit.append(tb - ta)
+        pend.append(unit)
+        if len(pend) > 2:
+            u = pend.pop(0)
+            tc = time.perf_counter()
+            out_np = np.asarray(u._dev)
+            td = time.perf_counter()
+            u.results()
+            te = time.perf_counter()
+            t_await.append(td - tc)
+            t_decode.append(te - td)
+    while pend:
+        u = pend.pop(0)
+        tc = time.perf_counter()
+        np.asarray(u._dev)
+        td = time.perf_counter()
+        u.results()
+        te = time.perf_counter()
+        t_await.append(td - tc)
+        t_decode.append(te - td)
+    total = time.perf_counter() - t0
+    print(f"batched stream: amortized {total / (n_batches * 16) * 1000:8.3f}"
+          f" ms/window | per batch of 16: submit p50 {p50(t_submit):8.3f}"
+          f"  await p50 {p50(t_await):8.3f}  decode p50 {p50(t_decode):8.3f}")
+
+
+if __name__ == "__main__":
+    main()
