@@ -71,14 +71,17 @@ def p50(xs):
 
 
 def build_hetero_workload(num_pods: int, num_types: int, seed: int = 7,
-                          constrained_frac: float = 0.0):
+                          constrained_frac: float = 0.0,
+                          pref_frac: float = 0.0):
     """Heterogeneous variant: near-unique request shapes, so signature
     compression yields THOUSANDS of groups instead of ~50.  This is the
     regime that actually stresses the solve (G x N x O work) — config #3's
     size-class mix collapses to a handful of groups, which any host loop
     handles in milliseconds.  ``constrained_frac`` adds hard zone pins /
     capacity-type limits to that fraction of pods (multiple label rows:
-    the flat path's U<=32 generalization)."""
+    the flat path's multi-class generalization); ``pref_frac`` adds
+    SOFT capacity-type preferences (preferred affinity as penalty
+    ranking — the round-5 flat-path widening)."""
     from karpenter_tpu.apis.pod import PodSpec, ResourceRequests
     from karpenter_tpu.apis.requirements import (
         LABEL_CAPACITY_TYPE, LABEL_ZONE, Operator, Requirement,
@@ -98,6 +101,9 @@ def build_hetero_workload(num_pods: int, num_types: int, seed: int = 7,
         elif r < constrained_frac:
             kw["required_requirements"] = (Requirement(
                 LABEL_CAPACITY_TYPE, Operator.IN, ("on-demand",)),)
+        elif r < constrained_frac + pref_frac:
+            kw["preferred_requirements"] = ((100, Requirement(
+                LABEL_CAPACITY_TYPE, Operator.IN, ("spot",))),)
         pods.append(PodSpec(f"h{i}",
                             requests=ResourceRequests(cpu, mem, 0, 1),
                             **kw))
@@ -251,9 +257,11 @@ def run_hetero(num_pods: int, num_types: int, iters: int) -> dict:
 def run_hetero_constrained(num_pods: int, num_types: int,
                            iters: int) -> dict:
     """Constrained heterogeneous sub-config: 30% of the near-unique pods
-    carry hard zone pins / capacity-type limits (multiple label rows) —
-    the regime the flat path's round-4 U<=32 generalization exists for;
-    without it these windows fell back to the G-sequential scan."""
+    carry hard zone pins / capacity-type limits (multiple label rows)
+    and 15% carry SOFT capacity-type preferences — the regime the flat
+    path's class generalization exists for (round 5 lifted the
+    no-preferences gate: without it these windows fell back to the
+    G-sequential scan that loses ~9x in this same bench)."""
     from karpenter_tpu.solver import (
         GreedySolver, JaxSolver, SolveRequest, encode, validate_plan,
     )
@@ -261,7 +269,8 @@ def run_hetero_constrained(num_pods: int, num_types: int,
     from karpenter_tpu.solver.types import SolverOptions
 
     pods, catalog = build_hetero_workload(num_pods, num_types, seed=11,
-                                          constrained_frac=0.3)
+                                          constrained_frac=0.3,
+                                          pref_frac=0.15)
     request = SolveRequest(pods, catalog)
     problem = encode(pods, catalog)
     js = JaxSolver()
@@ -291,6 +300,7 @@ def run_hetero_constrained(num_pods: int, num_types: int,
                                                 1e-9)
     return {
         "hetero_constrained_rows": int(problem.label_rows.shape[0]),
+        "hetero_constrained_has_prefs": problem.pref_rows is not None,
         "hetero_constrained_wall_ms": round(jp * 1000, 3),
         "hetero_constrained_path": js.last_stats.get("path", ""),
         "hetero_constrained_vs_baseline": round(

@@ -177,7 +177,8 @@ class SolverServer:
         pref_lambda = (int(arrays["pref_lambda_bp"]) / 10000.0
                        if "pref_lambda_bp" in arrays else None)
         with self._solver_lock:
-            out = self._solve_flat_maybe(cat, arrays, pref_rows is not None)
+            out = self._solve_flat_maybe(cat, arrays, pref_rows, pref_idx,
+                                         pref_lambda)
             if out is not None:
                 metrics.SOLVE_DURATION.labels("sidecar").observe(
                     time.perf_counter() - t0)
@@ -196,13 +197,16 @@ class SolverServer:
         return _pack(node_off=node_off, assign=assign.astype(np.int32),
                      unplaced=unplaced, cost=np.float32(cost))
 
-    def _solve_flat_maybe(self, cat, arrays, has_pref: bool):
+    def _solve_flat_maybe(self, cat, arrays, pref_rows=None,
+                          pref_idx=None, pref_lambda=None):
         """Route heterogeneous wire solves to the flat path (round 3's
         G-sequential regression would otherwise survive on the REMOTE
         backend only).  Returns packed wire bytes, or None for the
         classic path.  With a COO-capable client (``coo_ok`` flag) the
         assignment ships as (idx, cnt) — the dense [G, N] wire matrix is
-        hundreds of MB at the 10k-group shape."""
+        hundreds of MB at the 10k-group shape.  Soft preferences ride
+        the flat path too (per-class penalty ranking; flat_viable gates
+        on the class count), so remote and local route identically."""
         from karpenter_tpu.solver.flat import (
             dispatch_flat, finalize_flat_arrays, flat_viable,
         )
@@ -210,8 +214,6 @@ class SolverServer:
             dedup_rows, expand_coo_assign,
         )
 
-        if has_pref:
-            return None
         opts = self._jax.options
         # cheap row-independent gates FIRST — the O(G x O) factoring
         # below must not run on solves the flat path then rejects.
@@ -238,10 +240,11 @@ class SolverServer:
             catalog=cat, group_req=arrays["group_req"],
             group_count=arrays["group_count"],
             group_cap=arrays["group_cap"],
-            label_rows=rows, label_idx=label_idx)
+            label_rows=rows, label_idx=label_idx,
+            pref_rows=pref_rows, pref_idx=pref_idx)
         if not flat_viable(shim, self._jax.options):
             return None
-        attempt = dispatch_flat(self._jax, shim)
+        attempt = dispatch_flat(self._jax, shim, pref_lambda=pref_lambda)
         if attempt is None:
             return None
         node_off, unplaced, cost, idx, cnt = finalize_flat_arrays(
@@ -363,15 +366,15 @@ class _WireProblem:
                  "label_rows", "label_idx", "pref_rows", "pref_idx")
 
     def __init__(self, *, catalog, group_req, group_count, group_cap,
-                 label_rows, label_idx):
+                 label_rows, label_idx, pref_rows=None, pref_idx=None):
         self.catalog = catalog
         self.group_req = group_req
         self.group_count = group_count
         self.group_cap = group_cap
         self.label_rows = label_rows
         self.label_idx = label_idx
-        self.pref_rows = None
-        self.pref_idx = None
+        self.pref_rows = pref_rows
+        self.pref_idx = pref_idx
 
     @property
     def num_groups(self) -> int:

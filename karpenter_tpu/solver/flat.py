@@ -41,11 +41,12 @@ oracle on heterogeneous mixes (right-sizing reclaims the partially-
 filled-node waste FFD pays for) — asserted by tests/test_flat.py
 against the greedy oracle.
 
-Scope gates (checked host-side in ``flat_viable``): at most 32 distinct
-label rows (a bin's row-set packs into one-hot columns for the
-right-size intersection), no per-node caps (hostname anti-affinity), no
-soft preferences, and shapes fitting int32 key arithmetic.  Anything
-else falls back to the scan/pallas paths unchanged.
+Scope gates (checked host-side in ``flat_viable``): at most MAX_CLASSES
+(128) distinct constraint CLASSES — label rows, or (label row, pref
+row) pairs when soft preferences are present, which ride the flat path
+as per-class penalty ranking — no per-node caps (hostname
+anti-affinity), and shapes fitting int32 key arithmetic.  Anything else
+falls back to the scan/pallas paths unchanged.
 """
 
 from __future__ import annotations
@@ -71,6 +72,11 @@ log = get_logger("solver.flat")
 
 ITEM_BUCKETS = (1024, 2048, 4096, 8192, 16384, 32768)
 _MAX_ROUNDS = 12
+# distinct (label row, pref row) classes a window may carry on the flat
+# path: each bin's class-set is a [N, U] one-hot block for the
+# right-size intersection/penalty matmuls (round-4 cap was 32 rows)
+MAX_CLASSES = 128
+CLASS_BUCKETS = (4, 8, 16, 32, 64, 128)
 
 
 # ---------------------------------------------------------------------------
@@ -93,16 +99,23 @@ def _segmented_prefix(req2, bin2, I: int):
 
 
 def _flat_body(item_req, item_gid, item_live, rows, item_row, off_alloc,
-               off_rank, off_price, *, I: int, O: int, G: int, N: int,
-               K: int, U: int, beta_bp: int, max_rounds: int):
+               off_rank, miss_rows, off_price, *, I: int, O: int, G: int,
+               N: int, K: int, U: int, beta_bp: int, lam_bp: int,
+               max_rounds: int):
     R = item_req.shape[1]
     reqf = item_req.astype(jnp.float32)
     allocf = jnp.maximum(off_alloc.astype(jnp.float32), 1.0)
     Cmax = jnp.maximum(jnp.max(off_alloc, axis=0).astype(jnp.float32), 1.0)
+    # per-class penalty ranking (soft preferences as cost terms, the
+    # flat-path form of solve_core's rank_g): rank_rows[u, o] =
+    # off_rank[o] * (1 + lambda * miss_rows[u, o]) — classes without
+    # preferences carry miss 0, so rank_rows reduces to off_rank
+    rank_rows = off_rank[None, :] * (1.0 + (lam_bp / 10000.0) * miss_rows)
 
     # exact per-item placeability: resource fit AND the item's label row
-    # (``rows`` [U, O] bool, ``item_row`` [I] int32 — U <= 32 so a bin's
-    # row-set fits a [N, U] one-hot matrix for right-sizing)
+    # (``rows`` [U, O] bool, ``item_row`` [I] int32 — U <= MAX_CLASSES
+    # so a bin's class-set fits a [N, U] one-hot matrix for the
+    # right-size intersection/penalty matmuls)
     fits = jnp.all(off_alloc[None, :, :] >= item_req[:, None, :], axis=2)
     rc = jnp.clip(item_row, 0, U - 1)       # guarded row index, hoisted
     row_i = rows[rc]                                             # [I, O]
@@ -122,7 +135,7 @@ def _flat_body(item_req, item_gid, item_live, rows, item_row, off_alloc,
     # fall back to their own cheapest-fitting offering, so no covering
     # precondition exists (reference economics anchor:
     # cloudprovider.go:321-352 + instancetype.go:88-110).
-    price_fit = jnp.where(okoff, off_rank[None, :], jnp.inf)
+    price_fit = jnp.where(okoff, rank_rows[rc], jnp.inf)            # [I,O]
     exact_cls = jnp.argmin(price_fit, axis=1).astype(jnp.int32)      # [I]
     seg_row = jnp.where(fit_any, item_row, U)
     T_u = jax.ops.segment_sum(jnp.where(fit_any[:, None], reqf, 0.0),
@@ -133,7 +146,7 @@ def _flat_body(item_req, item_gid, item_live, rows, item_row, off_alloc,
                               axis=2)                                # [U,O]
     fluid_u = jnp.max(T_u[:, None, :] / allocf[None, :, :], axis=2)  # [U,O]
     score_u = jnp.where(covers_u,
-                        off_rank[None, :] * jnp.maximum(fluid_u, 1.0),
+                        rank_rows * jnp.maximum(fluid_u, 1.0),
                         jnp.inf)
     ostar_u = jnp.argmin(score_u, axis=1).astype(jnp.int32)          # [U]
     has_cover_u = jnp.any(covers_u, axis=1)                          # [U]
@@ -289,11 +302,17 @@ def _flat_body(item_req, item_gid, item_live, rows, item_row, off_alloc,
     # rides one [N,U] x [U,O] matmul (viol > 0 => some class forbids o);
     # each bin's current offering was feasibility-checked per item at
     # placement, so a candidate always exists
-    viol = jnp.dot(hrow.astype(jnp.float32),
-                   (~rows).astype(jnp.float32))                      # [N,O]
+    hrow_f = hrow.astype(jnp.float32)
+    viol = jnp.dot(hrow_f, (~rows).astype(jnp.float32))              # [N,O]
     cand = (viol < 0.5) & jnp.all(
         off_alloc[None, :, :] >= load[:, None, :], axis=2)           # [N,O]
-    cand_price = jnp.where(cand, off_rank[None, :], jnp.inf)
+    # presence-averaged penalty rank over the classes hosted on each
+    # bin — rank_rows[u] = off_rank*(1+lam*miss_u), so the mean over
+    # present classes IS off_rank*(1+lam*mean miss), mirroring
+    # _right_size's presence-averaged node penalty
+    cnt_u = jnp.maximum(jnp.sum(hrow_f, axis=1, keepdims=True), 1.0)
+    rank_eff = jnp.dot(hrow_f, rank_rows) / cnt_u                    # [N,O]
+    cand_price = jnp.where(cand, rank_eff, jnp.inf)
     node_off = jnp.where(open_b,
                          jnp.argmin(cand_price, axis=1).astype(jnp.int32),
                          -1)
@@ -324,10 +343,12 @@ def _flat_body(item_req, item_gid, item_live, rows, item_row, off_alloc,
 
 
 @functools.partial(jax.jit, static_argnames=("I", "O", "G", "N", "K", "U",
-                                             "beta_bp", "max_rounds"))
+                                             "beta_bp", "lam_bp",
+                                             "max_rounds"))
 def flat_solve_kernel(item_req, item_gid, item_live, rows, item_row,
-                      off_alloc, off_rank, off_price, *, I: int, O: int,
-                      G: int, N: int, K: int, U: int, beta_bp: int = 300,
+                      off_alloc, off_rank, miss_rows, off_price, *, I: int,
+                      O: int, G: int, N: int, K: int, U: int,
+                      beta_bp: int = 300, lam_bp: int = 1500,
                       max_rounds: int = _MAX_ROUNDS):
     """One-buffer-out flat solve.  Output layout (int32, length
     N + G + 1 + 2K + 1): node_off [N] | unplaced [G] | cost (f32 bits) |
@@ -335,8 +356,8 @@ def flat_solve_kernel(item_req, item_gid, item_live, rows, item_row,
     the node-escalation signal)."""
     node_off, unplaced_g, cost, idx_arr, cnt_arr, spilled = _flat_body(
         item_req, item_gid, item_live, rows, item_row, off_alloc, off_rank,
-        off_price, I=I, O=O, G=G, N=N, K=K, U=U, beta_bp=beta_bp,
-        max_rounds=max_rounds)
+        miss_rows, off_price, I=I, O=O, G=G, N=N, K=K, U=U,
+        beta_bp=beta_bp, lam_bp=lam_bp, max_rounds=max_rounds)
     cost_i = lax.bitcast_convert_type(cost.astype(jnp.float32)[None],
                                       jnp.int32)
     return jnp.concatenate([node_off, unplaced_g, cost_i, idx_arr, cnt_arr,
@@ -361,14 +382,21 @@ def flat_viable(problem: EncodedProblem, options) -> bool:
     if mode != "on" and G < getattr(options, "flat_min_groups", 2048):
         return False
     if problem.label_rows is None or problem.label_idx is None \
-            or not (1 <= problem.label_rows.shape[0] <= 32):
-        # the right-size row intersection packs a bin's row-set into 32
-        # one-hot columns; windows with more distinct constraint rows
-        # take the scan path (they compress well anyway)
+            or not (1 <= problem.label_rows.shape[0] <= MAX_CLASSES):
+        # a bin's class-set rides [N, U] one-hot columns for the
+        # right-size intersection; windows with more distinct constraint
+        # rows take the scan path (they compress well anyway)
         return False
     if problem.pref_rows is not None:
-        # soft preferences need penalty ranking — the scan path owns it
-        return False
+        # soft preferences ride per-class penalty ranking: classes are
+        # distinct (label row, pref row) PAIRS, and the class count must
+        # still fit the one-hot budget
+        if problem.pref_idx is None:
+            return False
+        pairs = (problem.label_idx.astype(np.int64) << 32) \
+            | (problem.pref_idx.astype(np.int64) & 0xFFFFFFFF)
+        if np.unique(pairs).size > MAX_CLASSES:
+            return False
     if not (problem.group_cap >= np.minimum(
             problem.group_count, BIG_CAP)).all():
         return False   # per-node caps (anti-affinity) need the scan path
@@ -390,12 +418,14 @@ class FlatAttempt:
     ``finalize_flat`` runs in a pipelined loop the fetch is local."""
 
     __slots__ = ("item_req", "item_gid", "item_live", "rows", "item_row",
-                 "G_pad", "O_pad", "I_pad", "U_pad", "N", "N_cap", "K",
-                 "out_dev", "fut", "t_disp", "t_issued", "tmpl")
+                 "miss_rows", "G_pad", "O_pad", "I_pad", "U_pad", "N",
+                 "N_cap", "K", "lam_bp", "out_dev", "fut", "t_disp",
+                 "t_issued", "tmpl")
 
     def __init__(self, **kw):
         self.tmpl = None
         self.fut = None
+        self.lam_bp = None      # None = solver options' preference_lambda
         for k, v in kw.items():
             setattr(self, k, v)
 
@@ -439,13 +469,33 @@ def _flat_template(solver, problem: EncodedProblem):
     item_gid[:total] = order
     item_live = np.zeros(I_pad, bool)
     item_live[:total] = True
-    U = problem.label_rows.shape[0]
-    U_pad = bucket(U, (4, 8, 16, 32))
+    # classes: distinct label rows, or distinct (label, pref) pairs when
+    # soft preferences are present — each class carries its own penalty
+    # ranking row (off_rank x (1 + lambda x miss))
+    if problem.pref_rows is not None and problem.pref_idx is not None:
+        pairs = (problem.label_idx.astype(np.int64) << 32) \
+            | (problem.pref_idx.astype(np.int64) & 0xFFFFFFFF)
+        uniq, class_of_group = np.unique(pairs, return_inverse=True)
+        U = uniq.size
+        cls_label = (uniq >> 32).astype(np.int32)
+        cls_pref = (uniq & 0xFFFFFFFF).astype(np.int64).astype(np.int32)
+    else:
+        U = problem.label_rows.shape[0]
+        class_of_group = problem.label_idx
+        cls_label = np.arange(U, dtype=np.int32)
+        cls_pref = np.full(U, -1, np.int32)
+    U_pad = bucket(U, CLASS_BUCKETS)
     rows = np.zeros((U_pad, O_pad), bool)
     src_w = min(problem.label_rows.shape[1], O_pad)
-    rows[:U, :src_w] = problem.label_rows[:, :src_w]
+    rows[:U, :src_w] = problem.label_rows[cls_label, :src_w]
+    miss_rows = np.zeros((U_pad, O_pad), np.float32)
+    if problem.pref_rows is not None:
+        has = cls_pref >= 0
+        pw = min(problem.pref_rows.shape[1], O_pad)
+        miss_rows[np.nonzero(has)[0], :pw] = \
+            problem.pref_rows[cls_pref[has], :pw]
     item_row = np.zeros(I_pad, np.int32)
-    item_row[:total] = problem.label_idx[order]
+    item_row[:total] = np.asarray(class_of_group, np.int32)[order]
 
     N_cap = min(solver.options.max_nodes,
                 bucket(max(total, 1), NODE_BUCKETS))
@@ -459,27 +509,31 @@ def _flat_template(solver, problem: EncodedProblem):
         return None
     tmpl = FlatAttempt(item_req=item_req, item_gid=item_gid,
                        item_live=item_live, rows=rows, item_row=item_row,
-                       G_pad=G_pad, O_pad=O_pad, I_pad=I_pad, U_pad=U_pad,
-                       N=N, N_cap=N_cap, K=K, out_dev=None,
-                       t_disp=0.0, t_issued=0.0)
+                       miss_rows=miss_rows, G_pad=G_pad, O_pad=O_pad,
+                       I_pad=I_pad, U_pad=U_pad, N=N, N_cap=N_cap, K=K,
+                       out_dev=None, t_disp=0.0, t_issued=0.0)
     if cache is not None:
         cache[key] = tmpl
     return tmpl
 
 
-def dispatch_flat(solver, problem: EncodedProblem) -> Optional[FlatAttempt]:
+def dispatch_flat(solver, problem: EncodedProblem,
+                  pref_lambda: Optional[float] = None
+                  ) -> Optional[FlatAttempt]:
     """Issue the flat kernel and start the async result copy; returns
     None when the problem turns out unsuitable after all (caller falls
-    back to the scan path)."""
+    back to the scan path).  ``pref_lambda`` overrides the solver
+    options' penalty weight (the sidecar's wire flag must win over
+    server defaults, same as the scan path)."""
     tmpl = _flat_template(solver, problem)
     if tmpl is None:
         return None
     a = FlatAttempt(item_req=tmpl.item_req, item_gid=tmpl.item_gid,
                     item_live=tmpl.item_live, rows=tmpl.rows,
-                    item_row=tmpl.item_row, G_pad=tmpl.G_pad,
-                    O_pad=tmpl.O_pad, I_pad=tmpl.I_pad, U_pad=tmpl.U_pad,
-                    N=tmpl.N, N_cap=tmpl.N_cap, K=tmpl.K, out_dev=None,
-                    t_disp=0.0, t_issued=0.0)
+                    item_row=tmpl.item_row, miss_rows=tmpl.miss_rows,
+                    G_pad=tmpl.G_pad, O_pad=tmpl.O_pad, I_pad=tmpl.I_pad,
+                    U_pad=tmpl.U_pad, N=tmpl.N, N_cap=tmpl.N_cap, K=tmpl.K,
+                    out_dev=None, t_disp=0.0, t_issued=0.0)
     a.tmpl = tmpl
     _dispatch_attempt(solver, problem, a)
     return a
@@ -488,11 +542,13 @@ def dispatch_flat(solver, problem: EncodedProblem) -> Optional[FlatAttempt]:
 def _dispatch_attempt(solver, problem, a: FlatAttempt) -> None:
     off_alloc, off_price, off_rank = solver._device_offerings(
         problem.catalog, a.O_pad)
+    lam_bp = a.lam_bp if a.lam_bp is not None else \
+        int(getattr(solver.options, "preference_lambda", 0.15) * 10000)
     a.t_disp = time.perf_counter()
     a.out_dev = flat_solve_kernel(
         a.item_req, a.item_gid, a.item_live, a.rows, a.item_row, off_alloc,
-        off_rank, off_price, I=a.I_pad, O=a.O_pad, G=a.G_pad, N=a.N,
-        K=a.K, U=a.U_pad)
+        off_rank, a.miss_rows, off_price, I=a.I_pad, O=a.O_pad, G=a.G_pad,
+        N=a.N, K=a.K, U=a.U_pad, lam_bp=lam_bp)
     try:
         a.out_dev.copy_to_host_async()
     except Exception:  # noqa: BLE001 — CPU arrays may not support it

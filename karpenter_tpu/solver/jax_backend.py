@@ -529,7 +529,9 @@ def solve_packed_pref(packed, pref_rows, pref_idx, off_alloc, off_price,
     pallas/flat fast paths gate off when preferences are present).  Two
     extra small leaves carry the factored preference rows; ``lam_bp`` is
     the penalty weight in basis points (SolverOptions.preference_lambda
-    x 10000, static — a handful of distinct values per process)."""
+    x 10000, static — a handful of distinct values per process).  The
+    pallas fast path gates off on preferences; the FLAT path carries
+    them (per-class penalty ranking, solver/flat.py)."""
     meta, compat_i = _unpack_problem(packed, off_alloc, G, O, U)
     node_off, assign, unplaced, cost = solve_core(
         meta[:, :4], meta[:, 4], meta[:, 5], compat_i > 0,
@@ -631,7 +633,8 @@ def solve_core(group_req, group_count, group_cap, compat,
     [G], -1 = none) scale the RANKING price per group:
     rank_g = rank * (1 + lambda * miss) — preferred offerings win
     cost-comparable choices, real cost accounting (off_price) is
-    untouched.  The scan path owns preferences; pallas/flat gate off."""
+    untouched.  The pallas path gates off on preferences; the flat path
+    carries them as per-class penalty ranking (solver/flat.py)."""
     N = num_nodes
     R = group_req.shape[1]
     node_off0 = jnp.full((N,), -1, dtype=jnp.int32)

@@ -142,13 +142,22 @@ class TestFlatGate:
         assert not flat_viable(problem, flat_opts())
 
     def test_many_label_rows_fall_back(self):
-        # > 32 distinct rows exceeds the row-set matrix; scan owns it
+        # > MAX_CLASSES distinct rows exceeds the class one-hot block;
+        # scan owns those windows (cap raised 32 -> 128 in round 5)
+        from karpenter_tpu.solver.flat import MAX_CLASSES
+
         catalog = make_catalog()
         problem = encode(hetero_pods(64, seed=7), catalog)
         fat = problem.replace(
-            label_rows=np.ones((33, catalog.num_offerings), dtype=bool),
+            label_rows=np.ones((MAX_CLASSES + 1, catalog.num_offerings),
+                               dtype=bool),
             label_idx=np.zeros(problem.num_groups, dtype=np.int32))
         assert not flat_viable(fat, flat_opts())
+        ok = problem.replace(
+            label_rows=np.ones((MAX_CLASSES, catalog.num_offerings),
+                               dtype=bool),
+            label_idx=np.zeros(problem.num_groups, dtype=np.int32))
+        assert flat_viable(ok, flat_opts())
 
     def test_off_option(self):
         catalog = make_catalog()
@@ -165,3 +174,78 @@ class TestFlatGate:
         assert plan is not None
         assert validate_plan(plan, pods, catalog) == []
         assert plan.placed_count + len(plan.unplaced_pods) == 40
+
+
+class TestFlatPreferences:
+    """Round-5 widening: soft preferences ride the flat path as
+    per-class penalty ranking (classes = distinct (label, pref) pairs),
+    instead of falling back to the G-sequential scan."""
+
+    def _pref_pods(self, n, seed=3):
+        from karpenter_tpu.apis.requirements import (
+            LABEL_CAPACITY_TYPE, Operator, Requirement,
+        )
+
+        rng = np.random.RandomState(seed)
+        pods = []
+        for i in range(n):
+            kw = {}
+            if rng.rand() < 0.4:
+                kw["preferred_requirements"] = ((100, Requirement(
+                    LABEL_CAPACITY_TYPE, Operator.IN, ("spot",))),)
+            pods.append(PodSpec(
+                f"fp{i}", requests=ResourceRequests(
+                    int(rng.randint(100, 4000)),
+                    int(rng.randint(256, 8192)), 0, 1), **kw))
+        return pods
+
+    def test_preferences_stay_on_flat_path(self):
+        catalog = make_catalog()
+        pods = self._pref_pods(300)
+        problem = encode(pods, catalog)
+        assert problem.pref_rows is not None
+        js = JaxSolver(flat_opts(flat_solver="on"))
+        assert flat_viable(problem, js.options)
+        plan = js.solve_encoded(problem)
+        assert js.last_stats["path"] == "flat"
+        assert validate_plan(plan, pods, catalog) == []
+
+    def test_pref_flat_cost_tracks_oracle(self):
+        from karpenter_tpu.solver import GreedySolver, SolveRequest
+        from karpenter_tpu.solver.types import SolverOptions
+
+        catalog = make_catalog()
+        pods = self._pref_pods(400, seed=5)
+        problem = encode(pods, catalog)
+        js = JaxSolver(flat_opts(flat_solver="on"))
+        plan = js.solve_encoded(problem)
+        assert js.last_stats["path"] == "flat"
+        oracle = GreedySolver(SolverOptions(
+            backend="greedy", max_nodes=32768)).solve(
+                SolveRequest(pods, catalog))
+        assert plan.placed_count >= oracle.placed_count
+        # penalty ranking is a heuristic; real cost must stay within a
+        # small band of the oracle's (flat usually WINS via right-sizing)
+        assert plan.total_cost_per_hour <= \
+            oracle.total_cost_per_hour * 1.05
+
+    def test_preference_actually_steers_offering_choice(self):
+        """With a crushing preference weight, pods that prefer spot land
+        on spot offerings when a cost-comparable spot offering exists."""
+        from karpenter_tpu.apis.requirements import (
+            LABEL_CAPACITY_TYPE, Operator, Requirement,
+        )
+
+        catalog = make_catalog()
+        pods = [PodSpec(f"sp{i}", requests=ResourceRequests(500, 1024, 0, 1),
+                        preferred_requirements=((100, Requirement(
+                            LABEL_CAPACITY_TYPE, Operator.IN, ("spot",))),))
+                for i in range(64)]
+        problem = encode(pods, catalog)
+        js = JaxSolver(flat_opts(flat_solver="on"))
+        js.options.preference_lambda = 5.0
+        plan = js.solve_encoded(problem)
+        assert js.last_stats["path"] == "flat"
+        spot = sum(n.pod_count for n in plan.nodes
+                   if n.capacity_type == "spot")
+        assert spot == 64, f"only {spot}/64 pods on preferred spot"

@@ -90,3 +90,37 @@ def test_dense_fallback_for_clients_without_coo(server):
         assert placed == len(pods)
     finally:
         client.close()
+
+
+def test_remote_hetero_with_preferences_rides_flat(server):
+    """Round-5 widening on the WIRE: preference-carrying heterogeneous
+    windows must ride the flat path remotely too (remote and local
+    route identically), with the penalty actually steering choices."""
+    from karpenter_tpu.apis.requirements import (
+        LABEL_CAPACITY_TYPE, Operator, Requirement,
+    )
+
+    catalog = _catalog()
+    rng = np.random.RandomState(4)
+    pods = []
+    for i in range(400):
+        kw = {}
+        if rng.rand() < 0.3:
+            kw["preferred_requirements"] = ((100, Requirement(
+                LABEL_CAPACITY_TYPE, Operator.IN, ("spot",))),)
+        pods.append(PodSpec(f"hp{i}", requests=ResourceRequests(
+            int(rng.randint(100, 3000)), int(rng.randint(256, 8192)),
+            0, 1), **kw))
+    req = SolveRequest(pods, catalog)
+    client = RemoteSolver(f"127.0.0.1:{server.port}")
+    try:
+        remote = client.solve(req)
+        assert remote.backend == "remote"
+        assert validate_plan(remote, pods, catalog) == []
+        assert not remote.unplaced_pods
+        local = JaxSolver(SolverOptions(backend="jax",
+                                        flat_min_groups=64)).solve(req)
+        assert abs(remote.total_cost_per_hour
+                   - local.total_cost_per_hour) < 1e-3
+    finally:
+        client.close()
