@@ -67,3 +67,29 @@ docs: ## Serve the mkdocs site locally (requires mkdocs)
 	else \
 		echo "mkdocs not installed (pip install mkdocs mkdocs-material)"; \
 	fi
+
+IMAGE_REPO ?= karpenter-tpu
+IMAGE_TAG ?= $(shell git rev-parse --short HEAD 2>/dev/null || echo dev)
+
+.PHONY: image
+image: image-controller image-solver ## Build both container images
+
+.PHONY: image-controller
+image-controller: ## Build the controller image (docker/Dockerfile.controller)
+	docker build -f docker/Dockerfile.controller \
+		-t $(IMAGE_REPO)/controller:$(IMAGE_TAG) .
+
+.PHONY: image-solver
+image-solver: ## Build the TPU solver sidecar image (docker/Dockerfile.solver)
+	docker build -f docker/Dockerfile.solver \
+		-t $(IMAGE_REPO)/solver:$(IMAGE_TAG) .
+
+.PHONY: helm-lint
+helm-lint: ## Lint + render the chart (no cluster required)
+	helm lint charts/karpenter-tpu
+	helm template karpenter-tpu charts/karpenter-tpu \
+		--set region=us-south >/dev/null
+
+.PHONY: docs-build
+docs-build: ## Build the docs site (strict: broken nav/links fail)
+	$(PY) -m mkdocs build --strict
