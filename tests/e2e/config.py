@@ -64,9 +64,24 @@ def load_config(name: str) -> NodeClassConfig:
 
 def make_workload(name: str, replicas: int, cpu: str = "500m",
                   memory: str = "512Mi",
-                  node_selector: Optional[Dict[str, str]] = None) -> Dict:
+                  node_selector: Optional[Dict[str, str]] = None,
+                  tolerations: Optional[List[Dict]] = None,
+                  topology_spread: Optional[List[Dict]] = None) -> Dict:
     """A minimal pending-pod deployment that forces provisioning."""
     sel = {"app": name}
+    pod_spec: Dict = {
+        "nodeSelector": node_selector or {},
+        "containers": [{
+            "name": "pause",
+            "image": "registry.k8s.io/pause:3.9",
+            "resources": {"requests": {
+                "cpu": cpu, "memory": memory}},
+        }],
+    }
+    if tolerations:
+        pod_spec["tolerations"] = tolerations
+    if topology_spread:
+        pod_spec["topologySpreadConstraints"] = topology_spread
     return {
         "apiVersion": "apps/v1",
         "kind": "Deployment",
@@ -76,15 +91,30 @@ def make_workload(name: str, replicas: int, cpu: str = "500m",
             "selector": {"matchLabels": sel},
             "template": {
                 "metadata": {"labels": sel},
-                "spec": {
-                    "nodeSelector": node_selector or {},
-                    "containers": [{
-                        "name": "pause",
-                        "image": "registry.k8s.io/pause:3.9",
-                        "resources": {"requests": {
-                            "cpu": cpu, "memory": memory}},
-                    }],
-                },
+                "spec": pod_spec,
             },
         },
+    }
+
+
+def make_nodepool(name: str, nodeclass: str,
+                  taints: Optional[List[Dict]] = None,
+                  startup_taints: Optional[List[Dict]] = None,
+                  requirements: Optional[List[Dict]] = None,
+                  limits: Optional[Dict[str, str]] = None) -> Dict:
+    """A TPUNodePool manifest (deploy/crds/tpunodepool.yaml)."""
+    spec: Dict = {"nodeClassRef": {"name": nodeclass}}
+    if taints:
+        spec["taints"] = taints
+    if startup_taints:
+        spec["startupTaints"] = startup_taints
+    if requirements:
+        spec["requirements"] = requirements
+    if limits:
+        spec["limits"] = limits
+    return {
+        "apiVersion": "karpenter-tpu.sh/v1alpha1",
+        "kind": "TPUNodePool",
+        "metadata": {"name": name},
+        "spec": spec,
     }

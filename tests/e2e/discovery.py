@@ -1,0 +1,77 @@
+"""Instance discovery + per-node verification helpers (reference:
+test/e2e/instance_discovery.go): resolve what the cloud actually
+offers, and what the provisioned nodes actually are, from the live
+cluster's vantage point — scenarios assert against DISCOVERED reality,
+not hard-coded profile names."""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+LABEL_INSTANCE_TYPE = "node.kubernetes.io/instance-type"
+LABEL_ZONE = "topology.kubernetes.io/zone"
+LABEL_CAPACITY_TYPE = "karpenter.sh/capacity-type"
+
+
+def node_instance_type(node) -> Optional[str]:
+    return (node.metadata.labels or {}).get(LABEL_INSTANCE_TYPE)
+
+
+def node_zone(node) -> Optional[str]:
+    return (node.metadata.labels or {}).get(LABEL_ZONE)
+
+
+def nodes_by_zone(nodes) -> Dict[str, List]:
+    out: Dict[str, List] = {}
+    for n in nodes:
+        out.setdefault(node_zone(n) or "", []).append(n)
+    return out
+
+
+def parse_profile(name: str) -> Optional[Dict[str, int]]:
+    """'bx2-4x16' -> {'cpu': 4, 'memory_gib': 16} (IBM profile grammar);
+    None for names outside it."""
+    try:
+        _family, size = name.split("-", 1)
+        cpu, mem = size.split("x", 1)
+        return {"cpu": int(cpu), "memory_gib": int(mem)}
+    except (ValueError, AttributeError):
+        return None
+
+
+def discovered_profiles(suite) -> List[str]:
+    """Instance profiles selected/validated by the cluster's NodeClasses
+    (status.selectedInstanceTypes — the operator's discovery output),
+    falling back to profiles seen on live nodes."""
+    found: List[str] = []
+    try:
+        for nc in suite.custom.list_cluster_custom_object(
+                "karpenter-tpu.sh", "v1alpha1", "tpunodeclasses"
+        ).get("items", []):
+            found.extend(nc.get("status", {})
+                         .get("selectedInstanceTypes", []))
+    except Exception:  # noqa: BLE001 — fall through to node labels
+        pass
+    for n in suite.kube.list_node().items:
+        t = node_instance_type(n)
+        if t:
+            found.append(t)
+    # stable de-dup
+    seen, out = set(), []
+    for t in found:
+        if t not in seen:
+            seen.add(t)
+            out.append(t)
+    return out
+
+
+def assert_node_matches_requirements(node, *, min_cpu: int = 0,
+                                     min_memory_gib: int = 0) -> None:
+    t = node_instance_type(node)
+    assert t, f"node {node.metadata.name} has no instance-type label"
+    parsed = parse_profile(t)
+    assert parsed, f"unparseable instance profile {t!r}"
+    assert parsed["cpu"] >= min_cpu, \
+        f"{t}: cpu {parsed['cpu']} < required {min_cpu}"
+    assert parsed["memory_gib"] >= min_memory_gib, \
+        f"{t}: memory {parsed['memory_gib']} < required {min_memory_gib}"

@@ -178,16 +178,17 @@ class E2ESuite:
                     apps.delete_namespaced_deployment(d.metadata.name, ns)
             except Exception:  # noqa: BLE001 — namespace may not exist yet
                 pass
-        try:
-            for nc in self.custom.list_cluster_custom_object(
-                    "karpenter-tpu.sh", "v1alpha1", "tpunodeclasses"
-            ).get("items", []):
-                if nc["metadata"].get("labels", {}).get(E2E_LABEL):
-                    self.custom.delete_cluster_custom_object(
-                        "karpenter-tpu.sh", "v1alpha1", "tpunodeclasses",
-                        nc["metadata"]["name"])
-        except Exception:  # noqa: BLE001
-            pass
+        for plural in ("tpunodepools", "tpunodeclasses"):
+            try:
+                for obj in self.custom.list_cluster_custom_object(
+                        "karpenter-tpu.sh", "v1alpha1", plural
+                ).get("items", []):
+                    if obj["metadata"].get("labels", {}).get(E2E_LABEL):
+                        self.custom.delete_cluster_custom_object(
+                            "karpenter-tpu.sh", "v1alpha1", plural,
+                            obj["metadata"]["name"])
+            except Exception:  # noqa: BLE001
+                pass
 
     def teardown(self) -> None:
         from kubernetes import client

@@ -1,0 +1,69 @@
+"""Image resolution against a live cloud (reference:
+test/e2e/image_selector_test.go): image by explicit ID, image by
+selector, and the NotReady surface for unresolvable images.  Gated by
+RUN_E2E_TESTS."""
+import os
+
+from tests.e2e.config import load_config, make_workload
+
+
+def _nodeclass_status(suite, name):
+    obj = suite.custom.get_cluster_custom_object(
+        "karpenter-tpu.sh", "v1alpha1", "tpunodeclasses", name)
+    return obj.get("status", {})
+
+
+def _is_ready(status) -> bool:
+    return any(c.get("type") == "Ready" and c.get("status") == "True"
+               for c in status.get("conditions", []))
+
+
+def test_explicit_image_id_resolves(suite):
+    nc = load_config("default")
+    nc.name = "e2e-img-id"
+    suite.create_nodeclass(nc.to_manifest())
+    suite.wait_for(
+        "nodeclass ready with resolved image",
+        lambda: _is_ready(_nodeclass_status(suite, "e2e-img-id")),
+        timeout=120)
+    st = _nodeclass_status(suite, "e2e-img-id")
+    assert st.get("resolvedImageID") == os.environ["TEST_IMAGE_ID"]
+
+
+def test_image_selector_resolves_by_name(suite):
+    name = os.environ.get("TEST_IMAGE_NAME")
+    if not name:
+        import pytest
+
+        pytest.skip("TEST_IMAGE_NAME not set")
+    nc = load_config("default")
+    nc.name = "e2e-img-sel"
+    manifest = nc.to_manifest()
+    del manifest["spec"]["image"]
+    manifest["spec"]["imageSelector"] = {"name": name}
+    suite.create_nodeclass(manifest)
+    suite.wait_for(
+        "selector-resolved image",
+        lambda: bool(_nodeclass_status(suite, "e2e-img-sel")
+                     .get("resolvedImageID")),
+        timeout=120)
+    # and it actually provisions
+    suite.create_deployment("default", make_workload("e2e-img-sel", 1))
+    suite.wait_for_pods_scheduled("default", "app=e2e-img-sel", 1)
+
+
+def test_unresolvable_image_surfaces_not_ready(suite):
+    nc = load_config("default")
+    nc.name = "e2e-img-bad"
+    manifest = nc.to_manifest()
+    del manifest["spec"]["image"]
+    manifest["spec"]["imageSelector"] = {"name": "no-such-image-xyzzy"}
+    suite.create_nodeclass(manifest)
+
+    def not_ready_with_reason() -> bool:
+        st = _nodeclass_status(suite, "e2e-img-bad")
+        return any(c.get("type") == "Ready" and c.get("status") == "False"
+                   for c in st.get("conditions", []))
+
+    suite.wait_for("NotReady condition", not_ready_with_reason,
+                   timeout=120)

@@ -1,0 +1,46 @@
+"""Instance-profile selection against a live cloud (reference:
+test/e2e/instance_profiles_test.go): an explicit profile is honored
+verbatim; instanceRequirements auto-select a compliant, cost-ranked
+profile from the DISCOVERED offering set.  Gated by RUN_E2E_TESTS."""
+from tests.e2e.config import load_config, make_workload
+from tests.e2e.discovery import (
+    assert_node_matches_requirements, discovered_profiles,
+    node_instance_type,
+)
+from tests.e2e.suite import E2E_LABEL
+
+
+def test_explicit_profile_is_honored(suite):
+    profiles = discovered_profiles(suite)
+    assert profiles, "no instance profiles discoverable"
+    target = profiles[0]
+    nc = load_config("default")
+    nc.name = "e2e-prof-explicit"
+    nc.instance_profile = target
+    suite.create_nodeclass(nc.to_manifest())
+    suite.create_deployment("default", make_workload("e2e-prof-exp", 2))
+    suite.wait_for_pods_scheduled("default", "app=e2e-prof-exp", 2)
+    for n in suite.nodes_with_label(E2E_LABEL):
+        assert node_instance_type(n) == target, \
+            f"{n.metadata.name}: {node_instance_type(n)} != {target}"
+
+
+def test_requirements_autoselect_compliant_profile(suite):
+    nc = load_config("default")
+    nc.name = "e2e-prof-auto"
+    nc.instance_profile = ""
+    nc.instance_requirements = {"minCPU": 4, "minMemoryGiB": 16}
+    suite.create_nodeclass(nc.to_manifest())
+
+    def selected() -> bool:
+        obj = suite.custom.get_cluster_custom_object(
+            "karpenter-tpu.sh", "v1alpha1", "tpunodeclasses",
+            "e2e-prof-auto")
+        return bool(obj.get("status", {}).get("selectedInstanceTypes"))
+
+    suite.wait_for("auto-selected instance types", selected, timeout=120)
+    suite.create_deployment("default", make_workload(
+        "e2e-prof-auto", 1, cpu="3", memory="12Gi"))
+    suite.wait_for_pods_scheduled("default", "app=e2e-prof-auto", 1)
+    for n in suite.nodes_with_label(E2E_LABEL):
+        assert_node_matches_requirements(n, min_cpu=4, min_memory_gib=16)

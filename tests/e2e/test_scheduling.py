@@ -1,0 +1,54 @@
+"""Scheduling behaviors against a live cluster (reference:
+test/e2e/scheduling_test.go): zone selectors, topology spread, and
+right-sized instance selection for resource-heavy pods.  Gated by
+RUN_E2E_TESTS."""
+import os
+
+from tests.e2e.config import load_config, make_workload
+from tests.e2e.discovery import (
+    LABEL_ZONE, assert_node_matches_requirements, node_zone, nodes_by_zone,
+)
+from tests.e2e.suite import E2E_LABEL
+
+
+def test_zone_selector_pins_provisioned_nodes(suite):
+    nc = load_config("default")
+    nc.name = "e2e-sched-zone"
+    suite.create_nodeclass(nc.to_manifest())
+    zone = os.environ["TEST_ZONE"]
+    suite.create_deployment("default", make_workload(
+        "e2e-sched-zone", 3, node_selector={LABEL_ZONE: zone}))
+    suite.wait_for_pods_scheduled("default", "app=e2e-sched-zone", 3)
+    for n in suite.nodes_with_label(E2E_LABEL):
+        assert node_zone(n) == zone, \
+            f"node {n.metadata.name} in {node_zone(n)}, wanted {zone}"
+
+
+def test_topology_spread_lands_across_zones(suite):
+    nc = load_config("multizone")
+    nc.name = "e2e-sched-spread"
+    suite.create_nodeclass(nc.to_manifest())
+    spread = [{
+        "maxSkew": 1,
+        "topologyKey": LABEL_ZONE,
+        "whenUnsatisfiable": "DoNotSchedule",
+        "labelSelector": {"matchLabels": {"app": "e2e-sched-spread"}},
+    }]
+    suite.create_deployment("default", make_workload(
+        "e2e-sched-spread", 6, topology_spread=spread))
+    suite.wait_for_pods_scheduled("default", "app=e2e-sched-spread", 6)
+    zones = nodes_by_zone(suite.nodes_with_label(E2E_LABEL))
+    assert len(zones) >= 2, f"spread landed in one zone: {list(zones)}"
+
+
+def test_heavy_pod_gets_right_sized_instance(suite):
+    nc = load_config("default")
+    nc.name = "e2e-sched-heavy"
+    suite.create_nodeclass(nc.to_manifest())
+    suite.create_deployment("default", make_workload(
+        "e2e-sched-heavy", 1, cpu="7", memory="28Gi"))
+    suite.wait_for_pods_scheduled("default", "app=e2e-sched-heavy", 1)
+    pods = suite.kube.list_namespaced_pod(
+        "default", label_selector="app=e2e-sched-heavy").items
+    node = suite.kube.read_node(pods[0].spec.node_name)
+    assert_node_matches_requirements(node, min_cpu=8, min_memory_gib=28)

@@ -1,0 +1,69 @@
+"""Taint semantics against a live cluster (reference:
+test/e2e/e2e_taints_test.go): pool taints keep intolerant pods off,
+tolerating pods on; startup taints lift once the node initializes.
+Gated by RUN_E2E_TESTS."""
+import time
+
+from tests.e2e.config import load_config, make_nodepool, make_workload
+from tests.e2e.suite import E2E_LABEL
+
+
+def _create_nodepool(suite, body):
+    body.setdefault("metadata", {}).setdefault("labels", {})[
+        E2E_LABEL] = "true"
+    suite.custom.create_cluster_custom_object(
+        "karpenter-tpu.sh", "v1alpha1", "tpunodepools", body)
+    suite.created.append({"kind": "tpunodepools",
+                          "name": body["metadata"]["name"]})
+
+
+def test_dedicated_taint_requires_toleration(suite):
+    nc = load_config("default")
+    nc.name = "e2e-taint-nc"
+    suite.create_nodeclass(nc.to_manifest())
+    _create_nodepool(suite, make_nodepool(
+        "e2e-taint-pool", "e2e-taint-nc",
+        taints=[{"key": "dedicated", "value": "e2e",
+                 "effect": "NoSchedule"}]))
+
+    # intolerant workload: must stay Pending against this pool
+    suite.create_deployment("default", make_workload("e2e-taint-no", 2))
+    # tolerating workload: schedules onto the tainted nodes
+    suite.create_deployment("default", make_workload(
+        "e2e-taint-yes", 2,
+        tolerations=[{"key": "dedicated", "operator": "Equal",
+                      "value": "e2e", "effect": "NoSchedule"}]))
+    suite.wait_for_pods_scheduled("default", "app=e2e-taint-yes", 2)
+
+    time.sleep(30)   # give the scheduler every chance to misplace
+    pods = suite.kube.list_namespaced_pod(
+        "default", label_selector="app=e2e-taint-no").items
+    tainted = {n.metadata.name for n in suite.nodes_with_label(E2E_LABEL)
+               if any(t.key == "dedicated"
+                      for t in (n.spec.taints or []))}
+    for p in pods:
+        assert p.spec.node_name not in tainted, \
+            f"intolerant pod {p.metadata.name} on tainted node"
+
+
+def test_startup_taints_lift_after_initialization(suite):
+    nc = load_config("default")
+    nc.name = "e2e-sttaint-nc"
+    suite.create_nodeclass(nc.to_manifest())
+    _create_nodepool(suite, make_nodepool(
+        "e2e-sttaint-pool", "e2e-sttaint-nc",
+        startup_taints=[{"key": "karpenter-tpu.sh/initializing",
+                         "effect": "NoSchedule"}]))
+    suite.create_deployment("default", make_workload(
+        "e2e-sttaint", 1,
+        tolerations=[{"key": "karpenter-tpu.sh/initializing",
+                      "operator": "Exists"}]))
+    nodes = suite.wait_for_nodes(1)
+
+    def lifted() -> bool:
+        fresh = suite.kube.read_node(nodes[0].metadata.name)
+        return not any(t.key == "karpenter-tpu.sh/initializing"
+                       for t in (fresh.spec.taints or []))
+
+    # the startup-taint controller removes it once the node initializes
+    suite.wait_for("startup taint removal", lifted, timeout=600)
