@@ -61,6 +61,7 @@ def enable_persistent_compile_cache(path: Optional[str] = None) -> Optional[str]
 # the single-window executable AND the 16-wide window-batch executable.
 DEFAULT_WARMUP_SHAPES: Tuple[Tuple[int, int, int, int], ...] = (
     (64, 4, 512, 10000),
+    (64, 16, 512, 10000),
     (64, 4, 128, 1000),
 )
 
@@ -78,6 +79,12 @@ def warmup_solver(solver, catalog, *,
     compilation is process-wide."""
     import jax
 
+    # the dispatch path imports these lazily; first touch costs ~1.3 s
+    # of module loading (jax.experimental.pallas) — exactly the kind of
+    # first-window cost warmup exists to hoist to boot
+    import karpenter_tpu.solver.flat  # noqa: F401
+    import karpenter_tpu.solver.pallas_kernel  # noqa: F401
+    import karpenter_tpu.solver.zonesplit  # noqa: F401
     from karpenter_tpu.solver.jax_backend import (
         clamp_output_opts, pack_input, solve_packed, solve_packed_pallas,
         solve_packed_pallas_batch,
