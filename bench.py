@@ -825,9 +825,11 @@ intern_signatures(pods)   # the watch path does this at pod ingestion
 from karpenter_tpu.solver import JaxSolver, SolveRequest
 solver = JaxSolver()
 # the operator-restart model: boot warmup runs BEFORE the first window
-# arrives (operator.py _start_solver_warmup), so the first window pays
-# neither tracing nor XLA compilation — warmup itself is what the
-# persistent cache accelerates across restarts
+# arrives (operator.py _start_solver_warmup), so for shapes the warmup
+# ladder covers (the headline's G_pad=64 bucket is in
+# DEFAULT_WARMUP_SHAPES) the first window pays neither tracing nor XLA
+# compilation — warmup itself is what the persistent cache accelerates
+# across restarts
 t0 = time.perf_counter()
 warmup_solver(solver, catalog, force=True)
 warm_s = time.perf_counter() - t0

@@ -363,6 +363,16 @@ def _unpack_problem(packed, off_alloc, G: int, O: int, U: int):
     return meta, rows_g * fit.astype(jnp.int32)
 
 
+def pack16_pairs(a):
+    """int32 [2n] (values in [-2^15, 2^15)) -> int32 [n] of int16 pairs.
+    Host side inverts with ``.view(np.int16)`` (little-endian: the low
+    half is the even element).  THE one definition of the pair-packing
+    contract — the dense16 wire, the flat slim wire, and any future
+    int16 packing must share it."""
+    pairs = a.reshape(-1, 2)
+    return (pairs[:, 0] & 0xFFFF) | (pairs[:, 1] << 16)
+
+
 def _pack_result(node_off, assign, unplaced, cost, K: int,
                  dense16: bool = False, coo16: bool = False):
     """Device-side: flatten the solve result into the single D2H buffer.
@@ -382,8 +392,7 @@ def _pack_result(node_off, assign, unplaced, cost, K: int,
         else:
             tail = [idx, cnt]
     elif dense16:
-        pairs = assign.astype(jnp.int32).reshape(-1, 2)
-        tail = [(pairs[:, 0] & 0xFFFF) | (pairs[:, 1] << 16)]
+        tail = [pack16_pairs(assign.astype(jnp.int32))]
     else:
         tail = [assign.astype(jnp.int32).reshape(-1)]
     return jnp.concatenate([node_off, unplaced.astype(jnp.int32), cost_i]

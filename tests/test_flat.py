@@ -249,3 +249,65 @@ class TestFlatPreferences:
         spot = sum(n.pod_count for n in plan.nodes
                    if n.capacity_type == "spot")
         assert spot == 64, f"only {spot}/64 pods on preferred spot"
+
+
+class TestSlimWire:
+    """int16 pair-packed flat output (round 5): bit-identical plans to
+    the classic int32 layout, at ~60% of the D2H bytes."""
+
+    def test_slim_parity_with_classic_layout(self):
+        from karpenter_tpu.solver.flat import _flat_template, dispatch_flat, finalize_flat
+
+        catalog = make_catalog()
+        pods = hetero_pods(500, seed=12)
+        problem = encode(pods, catalog)
+        js = JaxSolver(flat_opts(flat_solver="on"))
+        tmpl = _flat_template(js, problem)
+        assert tmpl.slim            # gate holds at this shape
+        a1 = dispatch_flat(js, problem)
+        slim_plan = finalize_flat(js, problem, a1)
+        slim_bytes = js.last_stats["d2h_bytes"]
+        # force the classic layout through the same template
+        tmpl.slim = False
+        a2 = dispatch_flat(js, problem)
+        classic_plan = finalize_flat(js, problem, a2)
+        classic_bytes = js.last_stats["d2h_bytes"]
+        tmpl.slim = True
+        assert slim_plan.total_cost_per_hour == \
+            classic_plan.total_cost_per_hour
+        assert sorted(p for n in slim_plan.nodes for p in n.pod_names) == \
+            sorted(p for n in classic_plan.nodes for p in n.pod_names)
+        assert slim_bytes < classic_bytes * 0.7
+        assert validate_plan(slim_plan, pods, catalog) == []
+
+    def test_slim_gate_rejects_wide_counts(self):
+        import numpy as np
+
+        from karpenter_tpu.solver.flat import _flat_template
+
+        catalog = make_catalog()
+        # one group with >= 2^15 pods of one shape: counts overflow int16
+        pods = [PodSpec(f"w{i}", requests=ResourceRequests(100, 256, 0, 1))
+                for i in range(8)]
+        problem = encode(pods, catalog)
+        fat = problem.replace(group_count=np.array(
+            [1 << 15] + [1] * (problem.num_groups - 1), dtype=np.int32))
+        js = JaxSolver(flat_opts(flat_solver="on"))
+        tmpl = _flat_template(js, fat)
+        assert tmpl is not None and not tmpl.slim
+
+
+def test_slim_gate_rejects_odd_node_cap():
+    """An odd binding max_nodes must disable the slim wire (pair packing
+    reshapes [N] into (-1, 2)) instead of crashing the solve."""
+    from karpenter_tpu.solver.flat import _flat_template
+
+    catalog = make_catalog()
+    pods = hetero_pods(300, seed=15)
+    problem = encode(pods, catalog)
+    js = JaxSolver(flat_opts(flat_solver="on", max_nodes=225))
+    tmpl = _flat_template(js, problem)
+    assert tmpl is not None and not tmpl.slim
+    plan = js.solve_encoded(problem)
+    assert js.last_stats["path"] == "flat"
+    assert validate_plan(plan, pods, catalog) == []
