@@ -70,6 +70,23 @@ def p50(xs):
     return float(np.percentile(xs, 50))
 
 
+def dispatch_slope_s(handle, k_lo: int = 1, k_hi: int = 7,
+                     reps: int = 5) -> float:
+    """Per-dispatch device time via the k-dispatch slope: p50 wall of k
+    back-to-back dispatches + ONE block, for two k values — the fixed
+    link round trip cancels in the difference.  THE one slope
+    methodology for every chip-boundary figure in this bench."""
+    def timed(k):
+        xs = []
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            handle(k)
+            xs.append(time.perf_counter() - t0)
+        return p50(xs)
+
+    return max((timed(k_hi) - timed(k_lo)) / (k_hi - k_lo), 0.0)
+
+
 def build_hetero_workload(num_pods: int, num_types: int, seed: int = 7,
                           constrained_frac: float = 0.0,
                           pref_frac: float = 0.0):
@@ -201,6 +218,16 @@ def run_hetero(num_pods: int, num_types: int, iters: int) -> dict:
     pipe_ms, _, pipe_depth = run_pipelined(jax_solver, problem,
                                            max(iters * 8, 36))
 
+    # pure on-chip flat compute (k-dispatch slope on device-resident
+    # inputs): the chip-boundary figure for the heterogeneous regime
+    hetero_compute = 0.0
+    if jax_solver.last_stats.get("path") == "flat":
+        from karpenter_tpu.solver.flat import flat_compute_handle
+
+        handle = flat_compute_handle(jax_solver, problem)
+        if handle is not None:
+            hetero_compute = dispatch_slope_s(handle)
+
     greedy = GreedySolver(SolverOptions(backend="greedy", max_nodes=32768))
     gplan = greedy.solve(request)
     gtimes = []
@@ -240,6 +267,10 @@ def run_hetero(num_pods: int, num_types: int, iters: int) -> dict:
         "hetero_pipelined_ms": round(pipe_ms, 3),
         "hetero_pipeline_depth": pipe_depth,
         "hetero_compute_path": jax_solver.last_stats.get("path", ""),
+        "hetero_compute_ms": round(hetero_compute * 1000, 3),
+        "hetero_vs_baseline_compute": round(
+            naive_p50 / hetero_compute, 2) if naive_p50 and hetero_compute
+        else 0.0,
         "hetero_placed": plan.placed_count,
         "hetero_host_p50_ms": round(p50(gtimes) * 1000, 3),
         "hetero_naive_host_p50_ms": round(naive_p50 * 1000, 3),
@@ -357,17 +388,7 @@ def run(num_pods: int, num_types: int, iters: int, platform: str) -> dict:
     # dispatches on device-resident inputs, one sync — the slope over k
     # cancels the fixed tunnel round trip, leaving per-solve chip time
     run_h = jax_solver.compute_handle(problem)
-    k_lo, k_hi = 1, 9
-
-    def timed(k, n=5):
-        xs = []
-        for _ in range(n):
-            t0 = time.perf_counter()
-            run_h(k)
-            xs.append(time.perf_counter() - t0)
-        return p50(xs)
-
-    compute_s = max((timed(k_hi) - timed(k_lo)) / (k_hi - k_lo), 0.0)
+    compute_s = dispatch_slope_s(run_h, 1, 9)
 
     # host baseline #1: grouped FFD (shares the encode's signature
     # compression; kept for transparency — it is NOT the reference loop)
@@ -613,16 +634,7 @@ def run_fleet(num_clusters: int, num_pods: int, num_types: int,
             outs[-1].block_until_ready()
 
         run_k(1)
-
-        def timed(k, n=5):
-            xs = []
-            for _ in range(n):
-                t0 = time.perf_counter()
-                run_k(k)
-                xs.append(time.perf_counter() - t0)
-            return float(np.percentile(xs, 50))
-
-        fleet_compute = max((timed(7) - timed(1)) / 6, 0.0)
+        fleet_compute = dispatch_slope_s(run_k)
 
     # faithful per-pod reference loop, cluster after cluster (the host
     # has no fleet amortization to exploit — karpenter-core runs one
@@ -939,8 +951,15 @@ def resolve_platform(probe_timeout: float = 150.0) -> str:
                     if lines:
                         return lines[-1]
             except subprocess.TimeoutExpired:
+                # graceful first: a SIGKILLed tunnel client can leave the
+                # device link wedged for minutes (measured), poisoning
+                # the RETRY this timeout exists to enable
                 try:
-                    os.killpg(proc.pid, signal.SIGKILL)
+                    os.killpg(proc.pid, signal.SIGTERM)
+                    try:
+                        proc.wait(timeout=10)
+                    except subprocess.TimeoutExpired:
+                        os.killpg(proc.pid, signal.SIGKILL)
                 except (ProcessLookupError, PermissionError):
                     pass
         print(f"# backend probe attempt {attempt} failed; "

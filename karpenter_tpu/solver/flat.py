@@ -556,6 +556,8 @@ def dispatch_flat(solver, problem: EncodedProblem,
                     slim=tmpl.slim, out_dev=None, t_disp=0.0,
                     t_issued=0.0)
     a.tmpl = tmpl
+    if pref_lambda is not None:
+        a.lam_bp = int(pref_lambda * 10000)
     _dispatch_attempt(solver, problem, a)
     return a
 
@@ -637,6 +639,41 @@ def finalize_flat(solver, problem: EncodedProblem, a: FlatAttempt) -> Plan:
     return decode_plan_entries(
         problem, node_off, flat_idx % a.G_pad, flat_idx // a.G_pad,
         cnt[live], unplaced, cost, "jax")
+
+
+def flat_compute_handle(solver, problem: EncodedProblem):
+    """Pure on-chip benchmark handle for the flat kernel: a zero-arg
+    callable re-running the solve on DEVICE-RESIDENT inputs (no H2D, no
+    D2H) — the heterogeneous regime's chip-boundary measurement, the
+    flat-path mirror of JaxSolver.compute_handle (k-dispatch slope
+    cancels the fixed link round trip).  None when flat is unsuitable."""
+    import jax
+
+    if not flat_viable(problem, solver.options):
+        return None
+    tmpl = _flat_template(solver, problem)
+    if tmpl is None:
+        return None
+    off_alloc, off_price, off_rank = solver._device_offerings(
+        problem.catalog, tmpl.O_pad)
+    dev = [jax.device_put(x) for x in
+           (tmpl.item_req, tmpl.item_gid, tmpl.item_live, tmpl.rows,
+            tmpl.item_row, tmpl.miss_rows)]
+    jax.block_until_ready(dev)
+    lam_bp = int(getattr(solver.options, "preference_lambda", 0.15) * 10000)
+    fn = functools.partial(
+        flat_solve_kernel, dev[0], dev[1], dev[2], dev[3], dev[4],
+        off_alloc, off_rank, dev[5], off_price, I=tmpl.I_pad,
+        O=tmpl.O_pad, G=tmpl.G_pad, N=tmpl.N, K=tmpl.K, U=tmpl.U_pad,
+        lam_bp=lam_bp, slim=tmpl.slim)
+
+    def run(k: int = 1):
+        outs = [fn() for _ in range(k)]
+        outs[-1].block_until_ready()
+        return outs[-1]
+
+    run()
+    return run
 
 
 def solve_flat(solver, problem: EncodedProblem) -> Optional[Plan]:

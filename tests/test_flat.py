@@ -311,3 +311,47 @@ def test_slim_gate_rejects_odd_node_cap():
     plan = js.solve_encoded(problem)
     assert js.last_stats["path"] == "flat"
     assert validate_plan(plan, pods, catalog) == []
+
+
+def test_flat_compute_handle_runs_on_device_inputs():
+    """The chip-boundary handle (k-dispatch slope source) must re-run
+    the flat solve on device-resident inputs and return the packed
+    buffer each time."""
+    import numpy as np
+
+    from karpenter_tpu.solver.flat import flat_compute_handle
+
+    catalog = make_catalog()
+    pods = hetero_pods(200, seed=21)
+    problem = encode(pods, catalog)
+    js = JaxSolver(flat_opts(flat_solver="on"))
+    handle = flat_compute_handle(js, problem)
+    assert handle is not None
+    out1 = np.asarray(handle(1))
+    out3 = np.asarray(handle(3))
+    np.testing.assert_array_equal(out1, out3)   # deterministic re-runs
+
+
+def test_dispatch_flat_applies_wire_pref_lambda():
+    """The sidecar's per-request lambda must reach the kernel (it was
+    silently dropped once — the plan then ranked with server defaults)."""
+    from karpenter_tpu.solver.flat import dispatch_flat
+
+    catalog = make_catalog()
+    pods = hetero_pods(120, seed=30)
+    problem = encode(pods, catalog)
+    js = JaxSolver(flat_opts(flat_solver="on"))
+    a = dispatch_flat(js, problem, pref_lambda=0.5)
+    assert a is not None and a.lam_bp == 5000
+    a2 = dispatch_flat(js, problem)
+    assert a2 is not None and a2.lam_bp is None
+
+
+def test_flat_compute_handle_rejects_unviable():
+    from karpenter_tpu.solver.flat import flat_compute_handle
+
+    catalog = make_catalog()
+    problem = encode(hetero_pods(64, seed=31), catalog)
+    bare = problem.replace(label_rows=None, label_idx=None)
+    js = JaxSolver(flat_opts(flat_solver="on"))
+    assert flat_compute_handle(js, bare) is None
