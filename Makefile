@@ -16,19 +16,31 @@ help: ## Show this help
 test: unit ## Alias for unit
 
 .PHONY: ci
-ci: unit lint ## All CI checks (tests + linting)
+ci: unit lint graftlint ## All CI checks (tests + linting + graftlint)
 
 .PHONY: unit
 unit: ## Full unit/integration suite on the virtual CPU mesh
 	$(TEST_ENV) $(PY) -m pytest tests/ -x -q --ignore=tests/e2e
 
 .PHONY: lint
-lint: ## Ruff lint (config: ruff.toml); no-op with a hint if ruff is absent
+lint: ## Ruff lint (config: ruff.toml); under CI=true a missing ruff FAILS
 	@if $(PY) -m ruff --version >/dev/null 2>&1; then \
-		$(PY) -m ruff check karpenter_tpu tests bench.py __graft_entry__.py; \
+		$(PY) -m ruff check karpenter_tpu tests tools bench.py __graft_entry__.py; \
+	elif [ "$$CI" = "true" ]; then \
+		echo "FATAL: CI=true but ruff is not installed — the lint gate" \
+		     "must never silently no-op in the workflow"; \
+		exit 1; \
 	else \
 		echo "ruff not installed (CI installs it; pip install ruff locally)"; \
 	fi
+
+.PHONY: graftlint
+graftlint: ## JAX/TPU purity + concurrency static analysis (tools/graftlint)
+	$(PY) -m tools.graftlint
+
+.PHONY: graftlint-baseline
+graftlint-baseline: ## Re-accept current graftlint findings into the debt ledger
+	$(PY) -m tools.graftlint --update-baseline
 
 .PHONY: test-stress
 test-stress: ## Adversarial-interleaving concurrency tier, repeated (the -race analogue)

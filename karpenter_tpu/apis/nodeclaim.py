@@ -10,7 +10,6 @@ from __future__ import annotations
 
 import time
 from dataclasses import dataclass, field
-from typing import Dict, List, Optional, Tuple
 
 from karpenter_tpu.apis.pod import Taint
 from karpenter_tpu.apis.requirements import Requirements
@@ -26,10 +25,10 @@ class NodeClaim:
     capacity_type: str = "on-demand"
     provider_id: str = ""            # "tpu:///<region>/<instance-id>" once launched
     node_name: str = ""
-    labels: Dict[str, str] = field(default_factory=dict)
-    annotations: Dict[str, str] = field(default_factory=dict)
-    taints: Tuple[Taint, ...] = ()
-    startup_taints: Tuple[Taint, ...] = ()
+    labels: dict[str, str] = field(default_factory=dict)
+    annotations: dict[str, str] = field(default_factory=dict)
+    taints: tuple[Taint, ...] = ()
+    startup_taints: tuple[Taint, ...] = ()
     requirements: Requirements = field(default_factory=Requirements)
     # lifecycle
     created_at: float = field(default_factory=time.time)
@@ -37,13 +36,13 @@ class NodeClaim:
     initialized: bool = False
     launched: bool = False
     deleted: bool = False
-    finalizers: List[str] = field(default_factory=list)
+    finalizers: list[str] = field(default_factory=list)
     resource_version: int = 0
     uid: str = ""
     # resolved placement (written by the actuator from the solve plan)
     subnet_id: str = ""
     image_id: str = ""
-    security_group_ids: Tuple[str, ...] = ()
+    security_group_ids: tuple[str, ...] = ()
     hourly_price: float = 0.0
 
 
@@ -53,12 +52,12 @@ class Node:
 
     name: str
     provider_id: str = ""
-    labels: Dict[str, str] = field(default_factory=dict)
-    annotations: Dict[str, str] = field(default_factory=dict)
-    taints: List[Taint] = field(default_factory=list)
+    labels: dict[str, str] = field(default_factory=dict)
+    annotations: dict[str, str] = field(default_factory=dict)
+    taints: list[Taint] = field(default_factory=list)
     ready: bool = False
-    conditions: Dict[str, str] = field(default_factory=dict)  # type -> status
-    addresses: List[str] = field(default_factory=list)
+    conditions: dict[str, str] = field(default_factory=dict)  # type -> status
+    addresses: list[str] = field(default_factory=list)
     created_at: float = field(default_factory=time.time)
     deleted: bool = False
     resource_version: int = 0
@@ -74,9 +73,9 @@ class NodePool:
     name: str
     nodeclass_name: str = ""
     requirements: Requirements = field(default_factory=Requirements)
-    taints: Tuple[Taint, ...] = ()
-    startup_taints: Tuple[Taint, ...] = ()
-    labels: Dict[str, str] = field(default_factory=dict)
+    taints: tuple[Taint, ...] = ()
+    startup_taints: tuple[Taint, ...] = ()
+    labels: dict[str, str] = field(default_factory=dict)
     weight: int = 10
     cpu_limit_milli: int = 0         # 0 = unlimited
     memory_limit_mib: int = 0
@@ -90,7 +89,7 @@ def provider_id(region: str, instance_id: str) -> str:
     return f"tpu:///{region}/{instance_id}"
 
 
-def parse_provider_id(pid: str) -> Optional[Tuple[str, str]]:
+def parse_provider_id(pid: str) -> tuple[str, str] | None:
     """-> (region, instance_id) or None (ref extractInstanceIDFromProviderID,
     vpc/instance/provider.go:1176)."""
     if not pid or not pid.startswith("tpu:///"):

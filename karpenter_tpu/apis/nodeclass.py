@@ -14,7 +14,6 @@ import json
 import re
 import time
 from dataclasses import dataclass, field, asdict
-from typing import Dict, List, Optional, Tuple
 
 # cloud resource id shape: alphanumerics plus - _ . (loose enough for
 # every provider id style, strict enough to catch whitespace/injection)
@@ -42,7 +41,7 @@ class InstanceRequirements:
 @dataclass(frozen=True)
 class SubnetSelectionCriteria:
     minimum_available_ips: int = 0
-    required_tags: Tuple[Tuple[str, str], ...] = ()
+    required_tags: tuple[tuple[str, str], ...] = ()
 
 
 @dataclass(frozen=True)
@@ -89,10 +88,10 @@ class KubeletConfig:
     """Subset mirrored from ibmnodeclass_types.go:318-387."""
 
     max_pods: int = 0               # 0 = provider heuristic
-    system_reserved: Tuple[Tuple[str, str], ...] = ()
-    kube_reserved: Tuple[Tuple[str, str], ...] = ()
-    eviction_hard: Tuple[Tuple[str, str], ...] = ()
-    cluster_dns: Tuple[str, ...] = ()
+    system_reserved: tuple[tuple[str, str], ...] = ()
+    kube_reserved: tuple[tuple[str, str], ...] = ()
+    eviction_hard: tuple[tuple[str, str], ...] = ()
+    cluster_dns: tuple[str, ...] = ()
 
 
 @dataclass(frozen=True)
@@ -111,7 +110,7 @@ class LoadBalancerTarget:
     pool_name: str = ""
     port: int = 0
     weight: int = 50
-    health_check: Optional[HealthCheck] = None
+    health_check: HealthCheck | None = None
 
 
 @dataclass(frozen=True)
@@ -119,7 +118,7 @@ class LoadBalancerIntegration:
     """(ibmnodeclass_types.go:146-244)"""
 
     enabled: bool = False
-    target_groups: Tuple[LoadBalancerTarget, ...] = ()
+    target_groups: tuple[LoadBalancerTarget, ...] = ()
     auto_deregister: bool = True
     registration_timeout: int = 300
 
@@ -141,26 +140,26 @@ class NodeClassSpec:
     region: str = ""
     zone: str = ""
     instance_profile: str = ""
-    instance_requirements: Optional[InstanceRequirements] = None
+    instance_requirements: InstanceRequirements | None = None
     image: str = ""
-    image_selector: Optional[ImageSelector] = None
+    image_selector: ImageSelector | None = None
     vpc: str = ""
     subnet: str = ""
-    security_groups: Tuple[str, ...] = ()
-    ssh_keys: Tuple[str, ...] = ()
+    security_groups: tuple[str, ...] = ()
+    ssh_keys: tuple[str, ...] = ()
     resource_group: str = ""
     placement_target: str = ""
-    tags: Tuple[Tuple[str, str], ...] = ()
-    placement_strategy: Optional[PlacementStrategy] = None
+    tags: tuple[tuple[str, str], ...] = ()
+    placement_strategy: PlacementStrategy | None = None
     user_data: str = ""
     user_data_append: str = ""
     bootstrap_mode: str = "auto"    # auto | cloud-init | iks-api
     iks_cluster_id: str = ""
     iks_worker_pool_id: str = ""
-    iks_dynamic_pools: Optional[DynamicPoolConfig] = None
-    load_balancer_integration: Optional[LoadBalancerIntegration] = None
-    block_device_mappings: Tuple[BlockDeviceMapping, ...] = ()
-    kubelet: Optional[KubeletConfig] = None
+    iks_dynamic_pools: DynamicPoolConfig | None = None
+    load_balancer_integration: LoadBalancerIntegration | None = None
+    block_device_mappings: tuple[BlockDeviceMapping, ...] = ()
+    kubelet: KubeletConfig | None = None
     api_server_endpoint: str = ""
 
 
@@ -179,14 +178,14 @@ class NodeClassStatus:
 
     last_validation_time: float = 0.0
     validation_error: str = ""
-    selected_instance_types: List[str] = field(default_factory=list)
-    selected_subnets: List[str] = field(default_factory=list)
-    resolved_security_groups: List[str] = field(default_factory=list)
+    selected_instance_types: list[str] = field(default_factory=list)
+    selected_subnets: list[str] = field(default_factory=list)
+    resolved_security_groups: list[str] = field(default_factory=list)
     resolved_image_id: str = ""
-    conditions: List[Condition] = field(default_factory=list)
+    conditions: list[Condition] = field(default_factory=list)
 
     def set_condition(self, type_: str, status: str, reason: str = "",
-                      message: str = "", now: Optional[float] = None) -> None:
+                      message: str = "", now: float | None = None) -> None:
         now = time.time() if now is None else now
         for i, c in enumerate(self.conditions):
             if c.type == type_:
@@ -198,7 +197,7 @@ class NodeClassStatus:
                 return
         self.conditions.append(Condition(type_, status, reason, message, now))
 
-    def condition(self, type_: str) -> Optional[Condition]:
+    def condition(self, type_: str) -> Condition | None:
         for c in self.conditions:
             if c.type == type_:
                 return c
@@ -214,9 +213,9 @@ class NodeClass:
     name: str
     spec: NodeClassSpec = field(default_factory=NodeClassSpec)
     status: NodeClassStatus = field(default_factory=NodeClassStatus)
-    annotations: Dict[str, str] = field(default_factory=dict)
-    labels: Dict[str, str] = field(default_factory=dict)
-    finalizers: List[str] = field(default_factory=list)
+    annotations: dict[str, str] = field(default_factory=dict)
+    labels: dict[str, str] = field(default_factory=dict)
+    finalizers: list[str] = field(default_factory=list)
     deleted: bool = False            # deletionTimestamp analogue
     resource_version: int = 0
     uid: str = ""
@@ -234,10 +233,10 @@ class NodeClass:
 
     # -- CEL-equivalent cross-field validation (ibmnodeclass_types.go:481-488)
 
-    def validate(self) -> List[str]:
+    def validate(self) -> list[str]:
         """Returns a list of violations (empty = valid)."""
         s = self.spec
-        errs: List[str] = []
+        errs: list[str] = []
         if not s.region:
             errs.append("spec.region is required")
         if bool(s.instance_profile) == bool(s.instance_requirements):
@@ -315,11 +314,11 @@ NODECLASS_HASH_VERSION = "v1"
 # deserializer (ref ibmnodeclass_webhook.go decodes the same way via
 # apimachinery).
 
-def _pairs(d: Optional[Dict]) -> Tuple[Tuple[str, str], ...]:
+def _pairs(d: dict | None) -> tuple[tuple[str, str], ...]:
     return tuple(sorted((str(k), str(v)) for k, v in (d or {}).items()))
 
 
-def _obj(d, allowed: Tuple[str, ...], ctx: str) -> Optional[Dict]:
+def _obj(d, allowed: tuple[str, ...], ctx: str) -> dict | None:
     """Validate a nested object: must be a dict (or None) and use only
     known keys — a misspelled nested field (minCpu for minCPU) silently
     defaulting would admit specs the controller then ignores."""
@@ -335,7 +334,7 @@ def _obj(d, allowed: Tuple[str, ...], ctx: str) -> Optional[Dict]:
     return d
 
 
-def nodeclass_from_dict(doc: Dict) -> "NodeClass":
+def nodeclass_from_dict(doc: dict) -> "NodeClass":
     """Parse a CRD-shaped dict (metadata + camelCase spec) into a
     NodeClass.  Unknown fields — top-level OR nested — raise
     ValidationError: an admission webhook that silently drops fields

@@ -12,7 +12,6 @@ import math
 import re
 import threading
 from dataclasses import dataclass, field
-from typing import Dict, List, Optional, Tuple
 
 from karpenter_tpu.apis.requirements import Requirements
 
@@ -71,7 +70,7 @@ class ResourceRequests:
     pods: int = 1
 
     @classmethod
-    def parse(cls, requests: Dict[str, object]) -> "ResourceRequests":
+    def parse(cls, requests: dict[str, object]) -> "ResourceRequests":
         return cls(
             cpu_milli=parse_cpu_milli(requests.get("cpu", 0)),
             memory_mib=parse_memory_mib(requests.get("memory", 0)),
@@ -79,7 +78,7 @@ class ResourceRequests:
             pods=1,
         )
 
-    def as_tuple(self) -> Tuple[int, int, int, int]:
+    def as_tuple(self) -> tuple[int, int, int, int]:
         return (self.cpu_milli, self.memory_mib, self.gpu, self.pods)
 
     def __add__(self, other: "ResourceRequests") -> "ResourceRequests":
@@ -111,7 +110,7 @@ class Toleration:
         return self.key == taint.key and self.value == taint.value
 
 
-def tolerates_all(tolerations: Tuple[Toleration, ...], taints: Tuple[Taint, ...]) -> bool:
+def tolerates_all(tolerations: tuple[Toleration, ...], taints: tuple[Taint, ...]) -> bool:
     """A pod can schedule onto a node iff every NoSchedule/NoExecute taint is
     tolerated (PreferNoSchedule is soft and ignored for feasibility)."""
     for t in taints:
@@ -122,8 +121,8 @@ def tolerates_all(tolerations: Tuple[Toleration, ...], taints: Tuple[Taint, ...]
     return True
 
 
-def tolerates_soft(tolerations: Tuple[Toleration, ...],
-                   taints: Tuple[Taint, ...]) -> bool:
+def tolerates_soft(tolerations: tuple[Toleration, ...],
+                   taints: tuple[Taint, ...]) -> bool:
     """PreferNoSchedule counterpart of :func:`tolerates_all`: True when
     every SOFT taint is tolerated.  Used for pool-preference ordering
     (the provisioner tries soft-tainted pools last for intolerant pods),
@@ -142,7 +141,7 @@ class TopologySpreadConstraint:
     max_skew: int = 1
     topology_key: str = "topology.kubernetes.io/zone"
     when_unsatisfiable: str = "DoNotSchedule"  # or ScheduleAnyway
-    label_selector: Tuple[Tuple[str, str], ...] = ()
+    label_selector: tuple[tuple[str, str], ...] = ()
 
 
 @dataclass(frozen=True)
@@ -150,7 +149,7 @@ class PodAffinityTerm:
     """Simplified (anti-)affinity: match pods by label selector within a
     topology domain."""
 
-    label_selector: Tuple[Tuple[str, str], ...] = ()
+    label_selector: tuple[tuple[str, str], ...] = ()
     topology_key: str = "kubernetes.io/hostname"
     anti: bool = False
 
@@ -167,7 +166,7 @@ def pod_key(pod: "PodSpec") -> str:
     return cached
 
 
-_SIG_IDS: Dict[Tuple, int] = {}  # signature tuple -> interned id
+_SIG_IDS: dict[tuple, int] = {}  # signature tuple -> interned id
 _SIG_IDS_LOCK = threading.Lock()
 
 
@@ -178,16 +177,16 @@ class PodSpec:
     name: str
     namespace: str = "default"
     requests: ResourceRequests = field(default_factory=ResourceRequests)
-    node_selector: Tuple[Tuple[str, str], ...] = ()
-    required_requirements: Tuple = ()      # tuple of Requirement (nodeAffinity required)
+    node_selector: tuple[tuple[str, str], ...] = ()
+    required_requirements: tuple = ()      # tuple of Requirement (nodeAffinity required)
     # preferredDuringSchedulingIgnoredDuringExecution: (weight 1-100,
     # Requirement) terms — soft preferences lowered to cost penalties in
     # offering choice, never to hard masks (SURVEY §7.4)
-    preferred_requirements: Tuple = ()     # tuple of (int, Requirement)
-    tolerations: Tuple[Toleration, ...] = ()
-    topology_spread: Tuple[TopologySpreadConstraint, ...] = ()
-    affinity: Tuple[PodAffinityTerm, ...] = ()
-    labels: Tuple[Tuple[str, str], ...] = ()
+    preferred_requirements: tuple = ()     # tuple of (int, Requirement)
+    tolerations: tuple[Toleration, ...] = ()
+    topology_spread: tuple[TopologySpreadConstraint, ...] = ()
+    affinity: tuple[PodAffinityTerm, ...] = ()
+    labels: tuple[tuple[str, str], ...] = ()
 
     def scheduling_requirements(self) -> Requirements:
         reqs = Requirements.from_selector(dict(self.node_selector))
@@ -196,10 +195,10 @@ class PodSpec:
         return reqs
 
     @property
-    def labels_dict(self) -> Dict[str, str]:
+    def labels_dict(self) -> dict[str, str]:
         return dict(self.labels)
 
-    def constraint_signature(self) -> Tuple:
+    def constraint_signature(self) -> tuple:
         """Pods with identical signatures are interchangeable for placement —
         the host-side grouping key for the solver (solver/encode.py).
         Memoized: the provisioner re-encodes the same PodSpec instances every
@@ -225,7 +224,7 @@ class PodSpec:
             object.__setattr__(self, "_sig_id", cached)
         return cached
 
-    def _constraint_signature(self) -> Tuple:
+    def _constraint_signature(self) -> tuple:
         # empty fast paths: the common pod carries no constraints, and
         # building 7 generator+sorted() pipelines per pod dominated cold
         # encode at 10k pods (~110 ms; first-restart-window budget)
@@ -249,7 +248,7 @@ class PodSpec:
         )
 
 
-def fingerprint_token(pod: "PodSpec") -> Tuple[str, int]:
+def fingerprint_token(pod: "PodSpec") -> tuple[str, int]:
     """THE canonical encode-memo token — (pod key, interned signature
     id) — memoized on the pod as ``_fpt``.  Single definition: both the
     encode fingerprint (solver/encode.py) and watch-time interning below
@@ -273,6 +272,6 @@ def intern_signatures(pods) -> None:
         fingerprint_token(p)
 
 
-def make_pods(count: int, name_prefix: str = "pod", **kwargs) -> List[PodSpec]:
+def make_pods(count: int, name_prefix: str = "pod", **kwargs) -> list[PodSpec]:
     """Convenience fan-out for tests/benchmarks."""
     return [PodSpec(name=f"{name_prefix}-{i}", **kwargs) for i in range(count)]

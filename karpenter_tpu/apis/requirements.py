@@ -14,7 +14,7 @@ from __future__ import annotations
 
 import enum
 from dataclasses import dataclass, field
-from typing import Dict, Iterable, List, Optional, Tuple
+from collections.abc import Iterable
 
 
 class Operator(str, enum.Enum):
@@ -46,9 +46,9 @@ CAPACITY_TYPE_SPOT = "spot"
 class Requirement:
     key: str
     operator: Operator
-    values: Tuple[str, ...] = ()
+    values: tuple[str, ...] = ()
 
-    def matches(self, labels: Dict[str, str]) -> bool:
+    def matches(self, labels: dict[str, str]) -> bool:
         """Does a node with these labels satisfy the requirement?"""
         present = self.key in labels
         value = labels.get(self.key)
@@ -70,18 +70,18 @@ class Requirement:
             return left > right if op == Operator.GT else left < right
         raise ValueError(f"unknown operator {op}")
 
-    def allows_value(self, value: Optional[str]) -> bool:
+    def allows_value(self, value: str | None) -> bool:
         """Does the requirement allow a specific value for its key
         (value None = label absent)?"""
         labels = {} if value is None else {self.key: value}
         return self.matches(labels)
 
     @property
-    def signature(self) -> Tuple:
+    def signature(self) -> tuple:
         return (self.key, self.operator.value, tuple(sorted(self.values)))
 
 
-def _num(v: Optional[str]):
+def _num(v: str | None):
     try:
         return float(v)  # type: ignore[arg-type]
     except (TypeError, ValueError):
@@ -93,10 +93,10 @@ class Requirements:
     """A conjunction of requirements, deduped per key (AND across keys,
     operator semantics within a key)."""
 
-    items: List[Requirement] = field(default_factory=list)
+    items: list[Requirement] = field(default_factory=list)
 
     @classmethod
-    def from_selector(cls, selector: Dict[str, str]) -> "Requirements":
+    def from_selector(cls, selector: dict[str, str]) -> "Requirements":
         return cls([Requirement(k, Operator.IN, (v,)) for k, v in sorted(selector.items())])
 
     def add(self, req: Requirement) -> "Requirements":
@@ -106,10 +106,10 @@ class Requirements:
     def merged(self, other: "Requirements") -> "Requirements":
         return Requirements(self.items + other.items)
 
-    def matches(self, labels: Dict[str, str]) -> bool:
+    def matches(self, labels: dict[str, str]) -> bool:
         return all(r.matches(labels) for r in self.items)
 
-    def allowed_values(self, key: str, candidates: Iterable[str]) -> List[str]:
+    def allowed_values(self, key: str, candidates: Iterable[str]) -> list[str]:
         """Filter candidate values for ``key`` to those every requirement on
         that key admits."""
         reqs = [r for r in self.items if r.key == key]
@@ -118,11 +118,11 @@ class Requirements:
     def has_key(self, key: str) -> bool:
         return any(r.key == key for r in self.items)
 
-    def get(self, key: str) -> List[Requirement]:
+    def get(self, key: str) -> list[Requirement]:
         return [r for r in self.items if r.key == key]
 
     @property
-    def signature(self) -> Tuple:
+    def signature(self) -> tuple:
         return tuple(sorted(r.signature for r in self.items))
 
     def __iter__(self):

@@ -23,7 +23,7 @@ from __future__ import annotations
 
 import itertools
 from dataclasses import dataclass, field
-from typing import Dict, List, Optional, Sequence, Tuple
+from collections.abc import Sequence
 
 import numpy as np
 
@@ -45,7 +45,7 @@ class CatalogArrays:
     """Structure-of-arrays catalog over the offering axis."""
 
     # per-type
-    type_names: List[str]
+    type_names: list[str]
     type_alloc: np.ndarray          # int32 [T, R] allocatable (cpu_m, mem_mib, gpu, pods)
     type_arch: np.ndarray           # int32 [T] -> arch vocab index
     type_family: np.ndarray         # int32 [T] -> family vocab index
@@ -57,15 +57,15 @@ class CatalogArrays:
     off_price: np.ndarray           # float32 [O] $/h (0 = unknown)
     off_avail: np.ndarray           # bool [O]
     # vocabularies
-    zones: List[str]
-    archs: List[str]
-    families: List[str]
-    sizes: List[str]
+    zones: list[str]
+    archs: list[str]
+    families: list[str]
+    sizes: list[str]
     # provenance
     generation: int = 0
     availability_generation: object = None
     uid: int = -1                   # unique per build() — device-cache key
-    _offering_index: Dict[Tuple[str, str, str], int] = field(default_factory=dict)
+    _offering_index: dict[tuple[str, str, str], int] = field(default_factory=dict)
 
     # -- construction ------------------------------------------------------
 
@@ -88,7 +88,7 @@ class CatalogArrays:
         type_family = np.zeros(T, dtype=np.int32)
         type_size = np.zeros(T, dtype=np.int32)
         off_type, off_zone, off_cap, off_price, off_avail = [], [], [], [], []
-        offering_index: Dict[Tuple[str, str, str], int] = {}
+        offering_index: dict[tuple[str, str, str], int] = {}
 
         for t, it in enumerate(instance_types):
             type_alloc[t] = (it.allocatable_cpu_milli, it.allocatable_memory_mib,
@@ -150,7 +150,7 @@ class CatalogArrays:
         return np.where(self.off_price > 0, self.off_price,
                         pseudo).astype(np.float32)
 
-    def offering_label_values(self, o: int) -> Dict[str, str]:
+    def offering_label_values(self, o: int) -> dict[str, str]:
         """Node label values an offering would produce — the host-side
         bridge for lowering Requirements into masks."""
         t = int(self.off_type[o])
@@ -163,7 +163,7 @@ class CatalogArrays:
             LABEL_CAPACITY_TYPE: CAPACITY_TYPES[int(self.off_cap[o])],
         }
 
-    def describe_offering(self, o: int) -> Tuple[str, str, str]:
+    def describe_offering(self, o: int) -> tuple[str, str, str]:
         t = int(self.off_type[o])
         return (self.type_names[t], self.zones[int(self.off_zone[o])],
                 CAPACITY_TYPES[int(self.off_cap[o])])
@@ -186,7 +186,7 @@ class CatalogArrays:
         return (tn[offs].tolist(), zn[offs].tolist(), cn[offs].tolist(),
                 self.off_price[offs].tolist())
 
-    def find_offering(self, instance_type: str, zone: str, capacity_type: str) -> Optional[int]:
+    def find_offering(self, instance_type: str, zone: str, capacity_type: str) -> int | None:
         return self._offering_index.get((instance_type, zone, capacity_type))
 
     # -- availability refresh ---------------------------------------------

@@ -17,7 +17,7 @@ from __future__ import annotations
 
 import re
 from dataclasses import dataclass, field
-from typing import Dict, List, Optional, Sequence, Tuple
+from collections.abc import Sequence
 
 from karpenter_tpu.apis.nodeclass import InstanceRequirements, KubeletConfig, NodeClass
 from karpenter_tpu.apis.pod import parse_cpu_milli, parse_memory_mib
@@ -77,7 +77,7 @@ class InstanceType:
     architecture: str
     family: str
     size: str
-    offerings: List[Offering] = field(default_factory=list)
+    offerings: list[Offering] = field(default_factory=list)
     # overhead (reserved out of capacity before pods fit)
     overhead_cpu_milli: int = 0
     overhead_memory_mib: int = 0
@@ -90,7 +90,7 @@ class InstanceType:
     def allocatable_memory_mib(self) -> int:
         return max(0, self.memory_mib - self.overhead_memory_mib)
 
-    def label_values(self) -> Dict[str, str]:
+    def label_values(self) -> dict[str, str]:
         return {
             LABEL_INSTANCE_TYPE: self.name,
             LABEL_ARCH: self.architecture,
@@ -98,12 +98,12 @@ class InstanceType:
             LABEL_INSTANCE_SIZE: self.size,
         }
 
-    def cheapest_offering(self) -> Optional[Offering]:
+    def cheapest_offering(self) -> Offering | None:
         avail = [o for o in self.offerings if o.available and o.price > 0]
         return min(avail, key=lambda o: o.price) if avail else None
 
 
-def compute_overhead(kubelet: Optional[KubeletConfig]) -> Tuple[int, int]:
+def compute_overhead(kubelet: KubeletConfig | None) -> tuple[int, int]:
     """-> (cpu_milli, memory_mib) reserved (instancetype.go:792-858).
 
     Defaults: kubeReserved 100m/1Gi + systemReserved 100m/1Gi +
@@ -142,7 +142,7 @@ def instance_type_score(it: InstanceType, price: float) -> float:
 
 
 def filter_instance_types(types: Sequence[InstanceType],
-                          reqs: InstanceRequirements) -> List[InstanceType]:
+                          reqs: InstanceRequirements) -> list[InstanceType]:
     """Auto-selection filter (instancetype.go:259-356): architecture, minCPU,
     minMemory, maxHourlyPrice (vs cheapest available offering), gpu."""
     out = []
@@ -194,17 +194,17 @@ class InstanceTypeProvider:
     def unavailable_offerings(self):
         return self._unavailable
 
-    def zones(self) -> List[str]:
+    def zones(self) -> list[str]:
         return self._zone_cache.get_or_set(
             "zones", lambda: retry_with_backoff(self._client.list_zones))
 
-    def list(self, nodeclass: Optional[NodeClass] = None) -> List[InstanceType]:
+    def list(self, nodeclass: NodeClass | None = None) -> list[InstanceType]:
         """Full catalog with offerings; availability is re-applied whenever
         the blackout set changes (cheap equality check on its generation, so
         steady-state list() calls return the cached objects)."""
         kubelet = nodeclass.spec.kubelet if nodeclass else None
         key = ("catalog", self._kubelet_key(kubelet))
-        base: List[InstanceType] = self._cache.get_or_set(
+        base: list[InstanceType] = self._cache.get_or_set(
             key, lambda: self._build(kubelet))
         gen = self._unavailable.generation
         cached = self._avail_cache.get(key)
@@ -214,7 +214,7 @@ class InstanceTypeProvider:
         self._avail_cache[key] = (gen, base, applied)
         return applied
 
-    def get(self, name: str, nodeclass: Optional[NodeClass] = None) -> Optional[InstanceType]:
+    def get(self, name: str, nodeclass: NodeClass | None = None) -> InstanceType | None:
         for it in self.list(nodeclass):
             if it.name == name:
                 return it
@@ -229,13 +229,13 @@ class InstanceTypeProvider:
     # -- internals ---------------------------------------------------------
 
     @staticmethod
-    def _kubelet_key(kubelet: Optional[KubeletConfig]):
+    def _kubelet_key(kubelet: KubeletConfig | None):
         return kubelet if kubelet is None else (
             kubelet.max_pods, kubelet.system_reserved, kubelet.kube_reserved,
             kubelet.eviction_hard)
 
-    def _build(self, kubelet: Optional[KubeletConfig]) -> List[InstanceType]:
-        profiles: List[InstanceProfile] = retry_with_backoff(
+    def _build(self, kubelet: KubeletConfig | None) -> list[InstanceType]:
+        profiles: list[InstanceProfile] = retry_with_backoff(
             self._client.list_instance_profiles)
         zones = self.zones()
         if not zones:

@@ -11,7 +11,7 @@ from __future__ import annotations
 
 import threading
 import time
-from typing import Callable, Dict, List, Optional, Sequence
+from collections.abc import Callable, Sequence
 
 from karpenter_tpu.utils.batcher import Batcher, BatcherOptions
 from karpenter_tpu.utils.logging import get_logger
@@ -23,12 +23,12 @@ class PricingProvider:
     TTL = 12 * 3600.0  # 12h (ibm_provider.go:34)
 
     def __init__(self, client, clock: Callable[[], float] = time.monotonic,
-                 batcher_options: Optional[BatcherOptions] = None):
+                 batcher_options: BatcherOptions | None = None):
         self._client = client
         self._clock = clock
         self._lock = threading.Lock()
         self._refresh_lock = threading.Lock()
-        self._prices: Dict[str, float] = {}
+        self._prices: dict[str, float] = {}
         self._fetched_at: float = -1e18
         self._batcher: Batcher = Batcher(
             self._fetch_batch,
@@ -44,7 +44,7 @@ class PricingProvider:
         with self._lock:
             return self._prices.get(instance_type, 0.0)
 
-    def get_prices(self, zone: str = "") -> Dict[str, float]:
+    def get_prices(self, zone: str = "") -> dict[str, float]:
         self._ensure_fresh()
         with self._lock:
             return dict(self._prices)
@@ -74,7 +74,10 @@ class PricingProvider:
                 if not force and self._clock() - self._fetched_at < self.TTL \
                         and self._prices:
                     return
-            names = [p.name for p in self._client.list_instance_profiles()]
+            # deliberate: _refresh_lock serializes REFRESHERS across this
+            # RPC (double-checked refresh); readers only take _lock and
+            # never stall behind it
+            names = [p.name for p in self._client.list_instance_profiles()]  # graftlint: disable=GL101
             # dedupe (getpricing.go dedups by catalog entry id)
             futures = {n: self._batcher.add(n) for n in dict.fromkeys(names)}
             prices = {}
@@ -90,11 +93,11 @@ class PricingProvider:
                 self._fetched_at = self._clock()
             log.info("pricing refreshed", entries=len(prices))
 
-    def _fetch_batch(self, names: Sequence[str]) -> List[Optional[float]]:
+    def _fetch_batch(self, names: Sequence[str]) -> list[float | None]:
         # Per-item isolation: one failing entry must not poison the whole
         # window (the batcher propagates a handler exception to every
         # caller in the batch).
-        out: List[Optional[float]] = []
+        out: list[float | None] = []
         for n in names:
             try:
                 out.append(self._client.get_pricing(n))
@@ -108,13 +111,13 @@ class StaticPricingProvider:
     """NoOp/static fallback (ref pricing controller fallback,
     controllers/providers/pricing/controller.go:38-50)."""
 
-    def __init__(self, prices: Optional[Dict[str, float]] = None):
+    def __init__(self, prices: dict[str, float] | None = None):
         self._prices = dict(prices or {})
 
     def get_price(self, instance_type: str, zone: str = "") -> float:
         return self._prices.get(instance_type, 0.0)
 
-    def get_prices(self, zone: str = "") -> Dict[str, float]:
+    def get_prices(self, zone: str = "") -> dict[str, float]:
         return dict(self._prices)
 
     def refresh(self) -> None:

@@ -10,7 +10,7 @@ device-resident catalog tensors.
 from __future__ import annotations
 
 import time
-from typing import Callable, List, Tuple
+from collections.abc import Callable
 
 from karpenter_tpu.utils.cache import TTLCache
 
@@ -36,7 +36,7 @@ class UnavailableOfferings:
     def is_unavailable_key(self, key: str) -> bool:
         return self._cache.contains(key)
 
-    def unavailable_keys(self) -> List[str]:
+    def unavailable_keys(self) -> list[str]:
         return list(self._cache.keys())
 
     def cleanup(self) -> int:

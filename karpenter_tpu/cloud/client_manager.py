@@ -10,7 +10,8 @@ from __future__ import annotations
 
 import threading
 import time
-from typing import Callable, Generic, Optional, TypeVar
+from collections.abc import Callable
+from typing import Generic, TypeVar
 
 from karpenter_tpu.cloud.errors import is_auth, parse_error
 from karpenter_tpu.utils.logging import get_logger
@@ -23,7 +24,7 @@ C = TypeVar("C")
 class ClientManager(Generic[C]):
     """TTL-cached client with invalidate-on-auth-failure."""
 
-    def __init__(self, build: Callable[[], C], ttl: Optional[float] = None,
+    def __init__(self, build: Callable[[], C], ttl: float | None = None,
                  clock: Callable[[], float] = time.monotonic):
         from karpenter_tpu.constants import DEFAULT_CLIENT_CACHE_TTL_SECONDS
 
@@ -32,7 +33,7 @@ class ClientManager(Generic[C]):
             else ttl
         self._clock = clock
         self._lock = threading.Lock()
-        self._client: Optional[C] = None
+        self._client: C | None = None
         self._built_at = -float("inf")
 
     def get(self) -> C:

@@ -8,7 +8,6 @@ branches on (:298-331).
 
 from __future__ import annotations
 
-from typing import Optional
 
 # Error codes (the cloud-API-level taxonomy).
 CODE_NOT_FOUND = "not_found"
@@ -30,7 +29,7 @@ class CloudError(Exception):
     """Typed cloud API error (ref IBMError, errors.go:54)."""
 
     def __init__(self, message: str, status_code: int = 0, code: str = "",
-                 retryable: Optional[bool] = None, retry_after: float = 0.0,
+                 retryable: bool | None = None, retry_after: float = 0.0,
                  operation: str = ""):
         super().__init__(message)
         self.message = message

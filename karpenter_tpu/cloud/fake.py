@@ -16,7 +16,6 @@ from __future__ import annotations
 import itertools
 import threading
 from collections import defaultdict
-from typing import Dict, List, Optional, Tuple
 
 from karpenter_tpu.cloud.errors import CloudError, not_found
 from karpenter_tpu.cloud.profile import InstanceProfile
@@ -52,9 +51,9 @@ class CallRecorder:
 
     def __init__(self):
         self._lock = threading.Lock()
-        self.calls: Dict[str, List[tuple]] = defaultdict(list)
-        self._next_errors: Dict[str, List[Exception]] = defaultdict(list)
-        self._persistent_errors: Dict[str, Exception] = {}
+        self.calls: dict[str, list[tuple]] = defaultdict(list)
+        self._next_errors: dict[str, list[Exception]] = defaultdict(list)
+        self._persistent_errors: dict[str, Exception] = {}
 
     def record(self, method: str, *args) -> None:
         with self._lock:
@@ -68,7 +67,7 @@ class CallRecorder:
         with self._lock:
             self._next_errors[method].extend([err] * times)
 
-    def set_persistent_error(self, method: str, err: Optional[Exception]) -> None:
+    def set_persistent_error(self, method: str, err: Exception | None) -> None:
         with self._lock:
             if err is None:
                 self._persistent_errors.pop(method, None)
@@ -100,10 +99,10 @@ _FAMILIES = {
 _CPU_LADDER = (2, 4, 8, 16, 24, 32, 48, 64, 96, 128)
 
 
-def generate_profiles(count: int = 20, families: Tuple[str, ...] = ("bx2", "cx2", "mx2"),
-                      arch: str = "amd64") -> List[InstanceProfile]:
+def generate_profiles(count: int = 20, families: tuple[str, ...] = ("bx2", "cx2", "mx2"),
+                      arch: str = "amd64") -> list[InstanceProfile]:
     """Deterministic IBM-shaped profile ladder of ``count`` types."""
-    out: List[InstanceProfile] = []
+    out: list[InstanceProfile] = []
     for family, cpu in itertools.product(families, _CPU_LADDER):
         if len(out) >= count:
             break
@@ -148,8 +147,8 @@ class FakeCloud:
     Thread-safe; every mutator records its call and honors injected errors.
     """
 
-    def __init__(self, region: str = "us-south", zones: Optional[List[str]] = None,
-                 profiles: Optional[List[InstanceProfile]] = None,
+    def __init__(self, region: str = "us-south", zones: list[str] | None = None,
+                 profiles: list[InstanceProfile] | None = None,
                  subnets_per_zone: int = 2, subnet_capacity: int = 256,
                  instance_quota: int = 100000):
         self.region = region
@@ -158,18 +157,18 @@ class FakeCloud:
         self.recorder = CallRecorder()
         self._lock = threading.RLock()
         self._seq = itertools.count(1)
-        self.profiles: List[InstanceProfile] = profiles or generate_profiles(20)
-        self.instances: Dict[str, FakeInstance] = {}
-        self.subnets: Dict[str, FakeSubnet] = {}
-        self.images: Dict[str, FakeImage] = {}
-        self.vnis: Dict[str, FakeVNI] = {}
-        self.volumes: Dict[str, FakeVolume] = {}
-        self.security_groups: Dict[str, str] = {"sg-default": "default"}
+        self.profiles: list[InstanceProfile] = profiles or generate_profiles(20)
+        self.instances: dict[str, FakeInstance] = {}
+        self.subnets: dict[str, FakeSubnet] = {}
+        self.images: dict[str, FakeImage] = {}
+        self.vnis: dict[str, FakeVNI] = {}
+        self.volumes: dict[str, FakeVolume] = {}
+        self.security_groups: dict[str, str] = {"sg-default": "default"}
         self.default_security_group = "sg-default"
-        self.vpcs: Dict[str, str] = {"vpc-1": region}   # id -> region
-        self.ssh_keys: Dict[str, str] = {"key-1": "rsa"}  # id -> type
+        self.vpcs: dict[str, str] = {"vpc-1": region}   # id -> region
+        self.ssh_keys: dict[str, str] = {"key-1": "rsa"}  # id -> type
         self.instance_quota = instance_quota
-        self.capacity_limits: Dict[Tuple[str, str], int] = {}  # (profile, zone) -> max
+        self.capacity_limits: dict[tuple[str, str], int] = {}  # (profile, zone) -> max
         for zi, zone in enumerate(self.zone_names):
             for si in range(subnets_per_zone):
                 sid = f"subnet-{zi + 1}{si + 1}"
@@ -188,12 +187,12 @@ class FakeCloud:
 
     # -- catalog side ------------------------------------------------------
 
-    def list_zones(self) -> List[str]:
+    def list_zones(self) -> list[str]:
         self.recorder.record("list_zones")
         self.recorder.maybe_raise("list_zones")
         return list(self.zone_names)
 
-    def list_instance_profiles(self) -> List[InstanceProfile]:
+    def list_instance_profiles(self) -> list[InstanceProfile]:
         self.recorder.record("list_instance_profiles")
         self.recorder.maybe_raise("list_instance_profiles")
         return list(self.profiles)
@@ -208,7 +207,7 @@ class FakeCloud:
 
     # -- subnets / images / SGs -------------------------------------------
 
-    def list_subnets(self) -> List[FakeSubnet]:
+    def list_subnets(self) -> list[FakeSubnet]:
         self.recorder.record("list_subnets")
         self.recorder.maybe_raise("list_subnets")
         with self._lock:
@@ -223,7 +222,7 @@ class FakeCloud:
                 raise not_found("subnet", subnet_id)
             return _snap(s)
 
-    def list_images(self) -> List[FakeImage]:
+    def list_images(self) -> list[FakeImage]:
         self.recorder.record("list_images")
         self.recorder.maybe_raise("list_images")
         with self._lock:
@@ -234,7 +233,7 @@ class FakeCloud:
         self.recorder.maybe_raise("get_default_security_group")
         return self.default_security_group
 
-    def list_security_groups(self) -> List[str]:
+    def list_security_groups(self) -> list[str]:
         """SG ids in the VPC (ref vpc.go:268-414 SG surface; consumed by
         the status controller's existence checks)."""
         self.recorder.record("list_security_groups")
@@ -242,7 +241,7 @@ class FakeCloud:
         with self._lock:
             return list(self.security_groups)
 
-    def list_vpcs(self) -> List[str]:
+    def list_vpcs(self) -> list[str]:
         """VPC ids visible in this region (ref status/controller.go:471
         VPC-in-region validation)."""
         self.recorder.record("list_vpcs")
@@ -250,7 +249,7 @@ class FakeCloud:
         with self._lock:
             return [v for v, r in self.vpcs.items() if r == self.region]
 
-    def list_ssh_keys(self) -> List[str]:
+    def list_ssh_keys(self) -> list[str]:
         """SSH key ids (ref status/controller.go:796 key validation)."""
         self.recorder.record("list_ssh_keys")
         self.recorder.maybe_raise("list_ssh_keys")
@@ -292,11 +291,11 @@ class FakeCloud:
 
     def create_instance(self, name: str, profile: str, zone: str, subnet_id: str,
                         image_id: str, capacity_type: str = "on-demand",
-                        security_group_ids: Tuple[str, ...] = (),
-                        user_data: str = "", tags: Optional[Dict[str, str]] = None,
-                        volumes: Tuple[FakeVolume, ...] = (),
+                        security_group_ids: tuple[str, ...] = (),
+                        user_data: str = "", tags: dict[str, str] | None = None,
+                        volumes: tuple[FakeVolume, ...] = (),
                         vni_id: str = "",
-                        volume_ids: Tuple[str, ...] = ()) -> FakeInstance:
+                        volume_ids: tuple[str, ...] = ()) -> FakeInstance:
         """Create an instance.  With ``vni_id``/``volume_ids`` it ATTACHES
         pre-allocated resources (staged create); otherwise it allocates
         them implicitly (legacy one-shot path)."""
@@ -373,7 +372,7 @@ class FakeCloud:
                 raise not_found("instance", instance_id)
             return _snap(inst)
 
-    def list_instances(self) -> List[FakeInstance]:
+    def list_instances(self) -> list[FakeInstance]:
         self.recorder.record("list_instances")
         self.recorder.maybe_raise("list_instances")
         with self._lock:
@@ -393,7 +392,7 @@ class FakeCloud:
             if subnet is not None:
                 subnet.available_ips = min(subnet.total_ips, subnet.available_ips + 1)
 
-    def update_tags(self, instance_id: str, tags: Dict[str, str]) -> None:
+    def update_tags(self, instance_id: str, tags: dict[str, str]) -> None:
         self.recorder.record("update_tags", instance_id)
         self.recorder.maybe_raise("update_tags")
         with self._lock:
@@ -416,7 +415,7 @@ class FakeCloud:
 
     # -- spot / fault simulation ------------------------------------------
 
-    def list_spot_instances(self) -> List[FakeInstance]:
+    def list_spot_instances(self) -> list[FakeInstance]:
         self.recorder.record("list_spot_instances")
         self.recorder.maybe_raise("list_spot_instances")
         with self._lock:
@@ -455,7 +454,7 @@ class FakeCloud:
 
     # -- introspection -----------------------------------------------------
 
-    def quota_status(self) -> Tuple[int, int]:
+    def quota_status(self) -> tuple[int, int]:
         """(live instances, quota limit) — the reference introspects VPC
         quotas per resource (vpc/instance/provider.go:905-991); the fake
         exposes the single instance quota it enforces."""

@@ -15,7 +15,6 @@ from __future__ import annotations
 
 import itertools
 import threading
-from typing import Dict, List, Optional
 
 from karpenter_tpu.cloud.errors import CloudError, not_found
 from karpenter_tpu.cloud.fake import CallRecorder, FakeCloud
@@ -36,15 +35,15 @@ class FakeIKS:
         self.cloud = cloud
         self.kube_version = kube_version
         self.recorder = CallRecorder()
-        self.pools: Dict[str, FakeWorkerPool] = {}
-        self.workers: Dict[str, FakeWorker] = {}
+        self.pools: dict[str, FakeWorkerPool] = {}
+        self.workers: dict[str, FakeWorker] = {}
         self._lock = threading.RLock()
         self._pool_seq = itertools.count(1)
         self._worker_seq = itertools.count(1)
 
     # -- pool CRUD (ref iks.go:317-469, 559-633) ---------------------------
 
-    def list_pools(self) -> List[FakeWorkerPool]:
+    def list_pools(self) -> list[FakeWorkerPool]:
         self.recorder.record("list_pools")
         self.recorder.maybe_raise("list_pools")
         with self._lock:
@@ -59,15 +58,15 @@ class FakeIKS:
                 raise not_found("worker_pool", pool_id)
             return pool
 
-    def get_pool_by_name(self, name: str) -> Optional[FakeWorkerPool]:
+    def get_pool_by_name(self, name: str) -> FakeWorkerPool | None:
         with self._lock:
             for pool in self.pools.values():
                 if pool.name == name:
                     return pool
         return None
 
-    def create_pool(self, name: str, flavor: str, zones: List[str],
-                    size_per_zone: int = 0, labels: Optional[Dict[str, str]] = None,
+    def create_pool(self, name: str, flavor: str, zones: list[str],
+                    size_per_zone: int = 0, labels: dict[str, str] | None = None,
                     dynamic: bool = False) -> FakeWorkerPool:
         self.recorder.record("create_pool", name, flavor)
         self.recorder.maybe_raise("create_pool")
@@ -82,7 +81,7 @@ class FakeIKS:
             self.pools[pool.id] = pool
             for zone in pool.zones:
                 for _ in range(size_per_zone):
-                    self._add_worker(pool, zone)
+                    self._add_worker_locked(pool, zone)
             return pool
 
     def delete_pool(self, pool_id: str) -> None:
@@ -94,7 +93,7 @@ class FakeIKS:
                 raise not_found("worker_pool", pool_id)
             for worker in [w for w in self.workers.values()
                            if w.pool_id == pool_id]:
-                self._remove_worker(worker)
+                self._remove_worker_locked(worker)
             del self.pools[pool_id]
 
     def add_pool_zone(self, pool_id: str, zone: str) -> None:
@@ -124,7 +123,7 @@ class FakeIKS:
             if zone not in pool.zones:
                 raise CloudError(f"pool {pool.name} has no zone {zone}", 400,
                                  code="bad_request", retryable=False)
-            worker = self._add_worker(pool, zone)
+            worker = self._add_worker_locked(pool, zone)
             pool.size_per_zone = max(
                 len([w for w in self.workers.values()
                      if w.pool_id == pool_id and w.zone == z])
@@ -139,7 +138,7 @@ class FakeIKS:
             worker = self.workers.get(worker_id)
             if worker is None or worker.pool_id != pool_id:
                 raise not_found("worker", worker_id)
-            self._remove_worker(worker)
+            self._remove_worker_locked(worker)
             pool = self.pools.get(pool_id)
             if pool is not None and pool.zones:
                 pool.size_per_zone = max(
@@ -149,7 +148,7 @@ class FakeIKS:
 
     # -- workers (ref iks.go:161-232) --------------------------------------
 
-    def list_workers(self, pool_id: Optional[str] = None) -> List[FakeWorker]:
+    def list_workers(self, pool_id: str | None = None) -> list[FakeWorker]:
         self.recorder.record("list_workers")
         self.recorder.maybe_raise("list_workers")
         with self._lock:
@@ -184,7 +183,7 @@ class FakeIKS:
             self.workers[worker.id] = worker
             return worker
 
-    def get_cluster_config(self) -> Dict:
+    def get_cluster_config(self) -> dict:
         """Cluster config for bootstrap decisions (ref iks.go:248)."""
         self.recorder.record("get_cluster_config")
         self.recorder.maybe_raise("get_cluster_config")
@@ -201,7 +200,8 @@ class FakeIKS:
 
     # -- internals ---------------------------------------------------------
 
-    def _add_worker(self, pool: FakeWorkerPool, zone: str) -> FakeWorker:
+    def _add_worker_locked(self, pool: FakeWorkerPool, zone: str) -> FakeWorker:
+        # caller holds self._lock (RLock; the _locked contract)
         subnet = next((s for s in self.cloud.list_subnets() if s.zone == zone),
                       None)
         images = self.cloud.list_images()   # IKS-managed worker image
@@ -217,7 +217,8 @@ class FakeIKS:
         self.workers[worker.id] = worker
         return worker
 
-    def _remove_worker(self, worker: FakeWorker) -> None:
+    def _remove_worker_locked(self, worker: FakeWorker) -> None:
+        # caller holds self._lock (RLock; the _locked contract)
         try:
             self.cloud.delete_instance(worker.instance_id)
         except CloudError:

@@ -24,7 +24,7 @@ import threading
 import time
 import urllib.error
 import urllib.request
-from typing import Callable, Dict, Optional
+from collections.abc import Callable
 
 from karpenter_tpu.cloud.errors import CloudError, parse_error
 from karpenter_tpu.cloud.retry import retry_with_backoff
@@ -40,7 +40,7 @@ class TokenSource:
 
     REFRESH_MARGIN = 300.0
 
-    def __init__(self, fetch: Callable[[], Dict],
+    def __init__(self, fetch: Callable[[], dict],
                  clock: Callable[[], float] = time.monotonic):
         """``fetch() -> {"access_token": str, "expires_in": seconds}``"""
         self._fetch = fetch
@@ -67,9 +67,9 @@ class HTTPClient:
     """Thin JSON REST client with typed errors and retry."""
 
     def __init__(self, base_url: str, service: str,
-                 token_source: Optional[TokenSource] = None,
+                 token_source: TokenSource | None = None,
                  timeout: float = 30.0,
-                 opener: Optional[Callable] = None,
+                 opener: Callable | None = None,
                  sleep: Callable[[float], None] = time.sleep):
         self.base_url = base_url.rstrip("/")
         self.service = service
@@ -81,17 +81,17 @@ class HTTPClient:
 
     # -- verbs -------------------------------------------------------------
 
-    def get(self, path: str, operation: str = "get") -> Dict:
+    def get(self, path: str, operation: str = "get") -> dict:
         return self.request("GET", path, operation=operation)
 
-    def post(self, path: str, body: Dict, operation: str = "post") -> Dict:
+    def post(self, path: str, body: dict, operation: str = "post") -> dict:
         return self.request("POST", path, body=body, operation=operation)
 
-    def delete(self, path: str, operation: str = "delete") -> Dict:
+    def delete(self, path: str, operation: str = "delete") -> dict:
         return self.request("DELETE", path, operation=operation)
 
-    def request(self, method: str, path: str, body: Optional[Dict] = None,
-                operation: str = "request") -> Dict:
+    def request(self, method: str, path: str, body: dict | None = None,
+                operation: str = "request") -> dict:
         def attempt():
             return self._do(method, path, body, operation)
 
@@ -100,8 +100,8 @@ class HTTPClient:
 
     # -- internals ---------------------------------------------------------
 
-    def _do(self, method: str, path: str, body: Optional[Dict],
-            operation: str) -> Dict:
+    def _do(self, method: str, path: str, body: dict | None,
+            operation: str) -> dict:
         url = f"{self.base_url}{path}"
         data = json.dumps(body).encode() if body is not None else None
         req = urllib.request.Request(url, data=data, method=method)

@@ -33,20 +33,19 @@ Wire protocol (v2-flavored; the stub server in ``cloud/stub.py`` speaks it):
 
 from __future__ import annotations
 
-from typing import Dict, List, Optional
 
 from karpenter_tpu.cloud.http import HTTPClient, TokenSource
 from karpenter_tpu.cloud.resources import Worker, WorkerPool
 
 
-def pool_to_json(p: WorkerPool) -> Dict:
+def pool_to_json(p: WorkerPool) -> dict:
     return {"id": p.id, "name": p.name, "flavor": p.flavor,
             "zones": list(p.zones), "size_per_zone": p.size_per_zone,
             "state": p.state, "labels": dict(p.labels),
             "dynamic": p.dynamic, "created_at": p.created_at}
 
 
-def pool_from_json(d: Dict) -> WorkerPool:
+def pool_from_json(d: dict) -> WorkerPool:
     return WorkerPool(
         id=d["id"], name=d.get("name", ""), flavor=d.get("flavor", ""),
         zones=list(d.get("zones") or []),
@@ -56,12 +55,12 @@ def pool_from_json(d: Dict) -> WorkerPool:
         created_at=float(d.get("created_at", 0.0)))
 
 
-def worker_to_json(w: Worker) -> Dict:
+def worker_to_json(w: Worker) -> dict:
     return {"id": w.id, "pool_id": w.pool_id, "zone": w.zone,
             "instance_id": w.instance_id, "state": w.state}
 
 
-def worker_from_json(d: Dict) -> Worker:
+def worker_from_json(d: dict) -> Worker:
     return Worker(id=d["id"], pool_id=d.get("pool_id", ""),
                   zone=d.get("zone", ""),
                   instance_id=d.get("instance_id", ""),
@@ -72,7 +71,7 @@ class IKSClient:
     """Provider-facing IKS client speaking the REST protocol above."""
 
     def __init__(self, endpoint: str, cluster_id: str, api_key: str = "",
-                 token_source: Optional[TokenSource] = None,
+                 token_source: TokenSource | None = None,
                  timeout: float = 30.0, opener=None, sleep=None):
         self.cluster_id = cluster_id
         kw = {}
@@ -91,7 +90,7 @@ class IKSClient:
 
     # -- pool CRUD (ref iks.go:317-469, 559-633) ---------------------------
 
-    def list_pools(self) -> List[WorkerPool]:
+    def list_pools(self) -> list[WorkerPool]:
         data = self.http.get(f"{self._base}/workerpools", "list_pools")
         return [pool_from_json(p) for p in data.get("workerpools", [])]
 
@@ -99,15 +98,15 @@ class IKSClient:
         return pool_from_json(self.http.get(
             f"{self._base}/workerpools/{pool_id}", "get_pool"))
 
-    def get_pool_by_name(self, name: str) -> Optional[WorkerPool]:
+    def get_pool_by_name(self, name: str) -> WorkerPool | None:
         for pool in self.list_pools():
             if pool.name == name:
                 return pool
         return None
 
-    def create_pool(self, name: str, flavor: str, zones: List[str],
+    def create_pool(self, name: str, flavor: str, zones: list[str],
                     size_per_zone: int = 0,
-                    labels: Optional[Dict[str, str]] = None,
+                    labels: dict[str, str] | None = None,
                     dynamic: bool = False) -> WorkerPool:
         body = {"name": name, "flavor": flavor, "zones": list(zones),
                 "size_per_zone": size_per_zone, "labels": dict(labels or {}),
@@ -137,7 +136,7 @@ class IKSClient:
 
     # -- workers (ref iks.go:161-232) --------------------------------------
 
-    def list_workers(self, pool_id: Optional[str] = None) -> List[Worker]:
+    def list_workers(self, pool_id: str | None = None) -> list[Worker]:
         path = f"{self._base}/workers"
         if pool_id:
             path += f"?pool={pool_id}"
@@ -165,7 +164,7 @@ class IKSClient:
         return worker_from_json(self.http.post(
             f"{self._base}/workers", body, "register_worker"))
 
-    def get_cluster_config(self) -> Dict:
+    def get_cluster_config(self) -> dict:
         """Cluster config for bootstrap decisions (ref iks.go:248 cluster
         kubeconfig retrieval): API endpoint, CA bundle, kube version."""
         return self.http.get(f"{self._base}/config", "get_cluster_config")

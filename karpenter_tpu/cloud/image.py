@@ -9,7 +9,6 @@ lookup (:49-126) and semantic selection — parse ``os-major-minor-arch
 from __future__ import annotations
 
 import re
-from typing import List, Optional
 
 from karpenter_tpu.apis.nodeclass import ImageSelector
 from karpenter_tpu.cloud.errors import not_found
@@ -31,7 +30,7 @@ class ImageResolver:
     def __init__(self, client):
         self._client = client
 
-    def resolve(self, image: str = "", selector: Optional[ImageSelector] = None) -> str:
+    def resolve(self, image: str = "", selector: ImageSelector | None = None) -> str:
         """-> image id."""
         if image:
             return self._resolve_direct(image)
@@ -50,7 +49,7 @@ class ImageResolver:
         raise not_found("image", image)
 
     def _resolve_selector(self, sel: ImageSelector) -> str:
-        candidates: List[FakeImage] = []
+        candidates: list[FakeImage] = []
         for img in self._client.list_images():
             if img.status != "available":
                 continue

@@ -26,7 +26,6 @@ from __future__ import annotations
 import threading
 import time
 from dataclasses import dataclass, field
-from typing import Dict, List, Optional, Tuple
 
 from karpenter_tpu.apis.nodeclass import (
     HealthCheck, LoadBalancerIntegration, LoadBalancerTarget,
@@ -74,9 +73,9 @@ class FakePool:
     id: str
     lb_id: str
     name: str
-    members: Dict[str, PoolMember] = field(default_factory=dict)
+    members: dict[str, PoolMember] = field(default_factory=dict)
     protocol: str = _HC_DEFAULT_PROTOCOL
-    health_monitor: Optional[PoolHealthMonitor] = None   # None = pool defaults
+    health_monitor: PoolHealthMonitor | None = None   # None = pool defaults
 
 
 class FakeLoadBalancers:
@@ -89,7 +88,7 @@ class FakeLoadBalancers:
 
     def __init__(self, healthy_after: float = 0.0):
         self._lock = threading.RLock()
-        self.pools: Dict[Tuple[str, str], FakePool] = {}   # (lb, pool name)
+        self.pools: dict[tuple[str, str], FakePool] = {}   # (lb, pool name)
         self.known_lbs: set = set()
         self._seq = 0
         self.healthy_after = healthy_after   # member settle delay
@@ -124,7 +123,7 @@ class FakeLoadBalancers:
                 raise not_found("lb_pool", f"{lb_id}/{pool_name}")
             return pool
 
-    def update_pool(self, lb_id: str, pool_name: str, patch: Dict) -> FakePool:
+    def update_pool(self, lb_id: str, pool_name: str, patch: dict) -> FakePool:
         """Apply a health-check patch map (ref UpdateLoadBalancerPool)."""
         with self._lock:
             pool = self.get_pool(lb_id, pool_name)
@@ -170,7 +169,7 @@ class FakeLoadBalancers:
             return member
 
     def find_member_by_instance(self, lb_id: str, pool_name: str,
-                                instance_id: str) -> Optional[PoolMember]:
+                                instance_id: str) -> PoolMember | None:
         """(ref findMemberByInstanceID, provider.go:225)"""
         with self._lock:
             pool = self.get_pool(lb_id, pool_name)
@@ -221,12 +220,12 @@ class FakeLoadBalancers:
 # ---------------------------------------------------------------------------
 
 def build_health_check_patch(desired: HealthCheck, pool: FakePool
-                             ) -> Tuple[bool, Dict]:
+                             ) -> tuple[bool, dict]:
     """Diff desired HC config against the pool's applied state; returns
     (needs_update, patch map).  Mirrors buildHealthCheckPatch
     (healthcheck.go:77-145): defaults tcp/30s/5s/2 retries; url_path only
     for http(s) with a path; untouched fields stay out of the patch."""
-    patch: Dict = {}
+    patch: dict = {}
     protocol = desired.protocol or _HC_DEFAULT_PROTOCOL
     interval = desired.interval or _HC_DEFAULT_INTERVAL
     timeout = desired.timeout or _HC_DEFAULT_TIMEOUT
@@ -242,7 +241,7 @@ def build_health_check_patch(desired: HealthCheck, pool: FakePool
         or (protocol in ("http", "https") and desired.path
             and hm.url_path != desired.path))
     if needs_monitor:
-        monitor: Dict = {"delay": interval, "max_retries": retries,
+        monitor: dict = {"delay": interval, "max_retries": retries,
                          "timeout": timeout, "type": protocol}
         if protocol in ("http", "https") and desired.path:
             monitor["url_path"] = desired.path
@@ -250,11 +249,11 @@ def build_health_check_patch(desired: HealthCheck, pool: FakePool
     return bool(patch), patch
 
 
-def validate_health_check(hc: Optional[HealthCheck]) -> List[str]:
+def validate_health_check(hc: HealthCheck | None) -> list[str]:
     """(ref ValidateHealthCheck, healthcheck.go:150-189)"""
     if hc is None:
         return []
-    errs: List[str] = []
+    errs: list[str] = []
     if hc.protocol not in ("", "tcp", "http", "https"):
         errs.append(f"invalid health check protocol: {hc.protocol}")
     if hc.protocol in ("http", "https") and not hc.path:
@@ -275,9 +274,9 @@ def validate_health_check(hc: Optional[HealthCheck]) -> List[str]:
     return errs
 
 
-def validate_integration(integration: LoadBalancerIntegration) -> List[str]:
+def validate_integration(integration: LoadBalancerIntegration) -> list[str]:
     """Static spec validation (ref provider.go:277 + per-target HC rules)."""
-    errs: List[str] = []
+    errs: list[str] = []
     if not integration.enabled:
         return errs
     if not integration.target_groups:
@@ -298,7 +297,7 @@ def validate_integration(integration: LoadBalancerIntegration) -> List[str]:
 
 
 class LoadBalancerProvider:
-    def __init__(self, lbs: Optional[FakeLoadBalancers] = None,
+    def __init__(self, lbs: FakeLoadBalancers | None = None,
                  poll_interval: float = 0.05):
         self.lbs = lbs or FakeLoadBalancers()
         # the reference polls every 10s (provider.go:252); tests shrink it
@@ -309,7 +308,7 @@ class LoadBalancerProvider:
     def register_instance(self, integration: LoadBalancerIntegration,
                           address: str, instance_id: str = "",
                           wait_healthy: bool = False,
-                          timeout: float = 5.0) -> List[str]:
+                          timeout: float = 5.0) -> list[str]:
         """Adds the node to every target pool; returns member ids.  HC
         config is reconciled per pool through the diff-driven patch
         builder BEFORE the member lands, so a newly-registered node is
@@ -318,7 +317,7 @@ class LoadBalancerProvider:
         if errs:
             raise CloudError("invalid loadBalancerIntegration: " +
                              "; ".join(errs), 400, retryable=False)
-        member_ids: List[str] = []
+        member_ids: list[str] = []
         for tg in integration.target_groups:
             self.lbs.ensure_pool(tg.load_balancer_id, tg.pool_name)
             if tg.health_check is not None:
@@ -363,7 +362,7 @@ class LoadBalancerProvider:
         return removed
 
     def remove_targets(self, targets, address: str,
-                       instance_id: str = "") -> Tuple[int, int]:
+                       instance_id: str = "") -> tuple[int, int]:
         """Remove the node from each target pool; returns
         (members_removed, failures).  Lookup by ``instance_id`` when
         given (members already gone are skipped silently,
@@ -425,7 +424,7 @@ class LoadBalancerProvider:
 
     def validate_configuration(self,
                                integration: LoadBalancerIntegration
-                               ) -> List[str]:
+                               ) -> list[str]:
         """Spec rules plus existence checks: LB reachable, pool present."""
         errs = validate_integration(integration)
         if errs or not integration.enabled:

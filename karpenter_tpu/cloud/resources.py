@@ -12,7 +12,6 @@ from __future__ import annotations
 
 import time
 from dataclasses import dataclass, field
-from typing import Dict, List, Tuple
 
 
 @dataclass
@@ -27,10 +26,10 @@ class Instance:
     status: str = "running"            # pending|running|stopped|deleting
     status_reason: str = ""
     health_state: str = "ok"           # ok|degraded|faulted (metadata svc)
-    tags: Dict[str, str] = field(default_factory=dict)
-    security_group_ids: Tuple[str, ...] = ()
+    tags: dict[str, str] = field(default_factory=dict)
+    security_group_ids: tuple[str, ...] = ()
     vni_id: str = ""
-    volume_ids: Tuple[str, ...] = ()
+    volume_ids: tuple[str, ...] = ()
     user_data: str = ""
     created_at: float = field(default_factory=time.time)
     ip_address: str = ""
@@ -43,7 +42,7 @@ class Subnet:
     total_ips: int = 256
     available_ips: int = 256
     state: str = "available"
-    tags: Dict[str, str] = field(default_factory=dict)
+    tags: dict[str, str] = field(default_factory=dict)
     vpc_id: str = "vpc-1"
 
 
@@ -76,10 +75,10 @@ class WorkerPool:
     id: str
     name: str
     flavor: str                  # instance profile name
-    zones: List[str]
+    zones: list[str]
     size_per_zone: int
     state: str = "normal"        # normal | resizing | deleting
-    labels: Dict[str, str] = field(default_factory=dict)
+    labels: dict[str, str] = field(default_factory=dict)
     dynamic: bool = False        # created by karpenter (eligible for cleanup)
     created_at: float = field(default_factory=time.time)
 

@@ -11,7 +11,8 @@ from __future__ import annotations
 
 import time
 from dataclasses import dataclass
-from typing import Callable, TypeVar
+from collections.abc import Callable
+from typing import TypeVar
 
 from karpenter_tpu.cloud.errors import is_rate_limit, is_retryable, parse_error
 from karpenter_tpu.utils.logging import get_logger
@@ -39,7 +40,9 @@ def retry_with_backoff(fn: Callable[[], T], config: RetryConfig = None,
     ``steps`` attempts.
     """
     cfg = config or RetryConfig()
-    delay = cfg.initial
+    # the cap bounds EVERY wait, including the first (a misconfigured
+    # initial > cap must not produce one over-cap sleep)
+    delay = min(cfg.initial, cfg.cap)
     last: Exception = RuntimeError("retry_with_backoff: no attempts")
     for attempt in range(cfg.steps):
         try:

@@ -24,7 +24,6 @@ import json
 import secrets
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
-from typing import Dict, Optional
 from urllib.parse import parse_qs, urlparse
 
 from karpenter_tpu.cloud.errors import CloudError
@@ -40,20 +39,20 @@ from karpenter_tpu.cloud.vpc import (
 class StubCloudServer:
     """HTTP facade over a FakeCloud (+ optional FakeIKS)."""
 
-    def __init__(self, cloud: Optional[FakeCloud] = None,
-                 iks: Optional[FakeIKS] = None,
+    def __init__(self, cloud: FakeCloud | None = None,
+                 iks: FakeIKS | None = None,
                  api_key: str = "test-key", host: str = "127.0.0.1",
                  port: int = 0, token_ttl: float = 3600.0):
         self.cloud = cloud or FakeCloud()
         self.iks = iks
         self.api_key = api_key
         self.token_ttl = token_ttl
-        self._tokens: Dict[str, bool] = {}
+        self._tokens: dict[str, bool] = {}
         self._lock = threading.Lock()
         handler = _make_handler(self)
         self._server = ThreadingHTTPServer((host, port), handler)
         self.port = self._server.server_address[1]
-        self._thread: Optional[threading.Thread] = None
+        self._thread: threading.Thread | None = None
 
     @property
     def endpoint(self) -> str:
@@ -73,7 +72,7 @@ class StubCloudServer:
 
     # -- auth --------------------------------------------------------------
 
-    def issue_token(self, apikey: str) -> Dict:
+    def issue_token(self, apikey: str) -> dict:
         if apikey != self.api_key:
             raise CloudError("invalid api key", 401, retryable=False)
         token = secrets.token_hex(16)
@@ -95,7 +94,7 @@ class StubCloudServer:
 
     # -- routing -----------------------------------------------------------
 
-    def handle(self, method: str, path: str, query: Dict, body: Dict) -> Dict:
+    def handle(self, method: str, path: str, query: dict, body: dict) -> dict:
         """Dispatch a request to the backing fakes.  Returns the JSON
         response dict; raises CloudError for API-level failures."""
         parts = [p for p in path.split("/") if p]
@@ -187,8 +186,8 @@ class StubCloudServer:
         raise CloudError(f"no route for {method} {path}", 404,
                          retryable=False)
 
-    def _handle_iks(self, method: str, cluster_id: str, rest, query: Dict,
-                    body: Dict) -> Dict:
+    def _handle_iks(self, method: str, cluster_id: str, rest, query: dict,
+                    body: dict) -> dict:
         iks = self.iks
         if iks is None or cluster_id != iks.cluster_id:
             raise CloudError(f"cluster {cluster_id!r} not found", 404,
@@ -233,7 +232,7 @@ class StubCloudServer:
         raise CloudError(f"no IKS route for {method} /{'/'.join(rest)}", 404,
                          retryable=False)
 
-    def _register_worker(self, body: Dict):
+    def _register_worker(self, body: dict):
         """AddWorkerToIKSCluster analogue: attach an existing VPC instance
         to the cluster as a worker (ref iks_api.go:53)."""
         return self.iks.register_worker(body.get("instance_id", ""),
@@ -246,7 +245,7 @@ def _make_handler(stub: StubCloudServer):
         def log_message(self, *args):
             pass
 
-        def _read_body(self) -> Dict:
+        def _read_body(self) -> dict:
             length = int(self.headers.get("Content-Length") or 0)
             if not length:
                 return {}
@@ -255,8 +254,8 @@ def _make_handler(stub: StubCloudServer):
             except json.JSONDecodeError:
                 return {}
 
-        def _send(self, status: int, payload: Dict,
-                  headers: Optional[Dict] = None) -> None:
+        def _send(self, status: int, payload: dict,
+                  headers: dict | None = None) -> None:
             data = json.dumps(payload).encode()
             self.send_response(status)
             self.send_header("Content-Type", "application/json")

@@ -13,7 +13,7 @@ Parity with ``pkg/providers/vpc/subnet/provider.go``:
 from __future__ import annotations
 
 from dataclasses import dataclass
-from typing import Callable, Dict, List, Optional
+from collections.abc import Callable
 
 from karpenter_tpu.apis.nodeclass import PlacementStrategy, SubnetSelectionCriteria
 from karpenter_tpu.cloud.fake import FakeSubnet
@@ -33,7 +33,7 @@ def subnet_score(subnet: FakeSubnet) -> float:
 
 
 def apply_cluster_awareness(subnet: FakeSubnet, base: float,
-                            cluster_subnets: Dict[str, int]) -> float:
+                            cluster_subnets: dict[str, int]) -> float:
     """(subnet/provider.go:327-344)"""
     if not cluster_subnets:
         return base
@@ -46,7 +46,7 @@ def apply_cluster_awareness(subnet: FakeSubnet, base: float,
 class SubnetProvider:
     CACHE_TTL = 300.0  # 5 min (:73-80)
 
-    def __init__(self, client, cluster_subnets_fn: Optional[Callable[[], Dict[str, int]]] = None,
+    def __init__(self, client, cluster_subnets_fn: Callable[[], dict[str, int]] | None = None,
                  clock=None):
         """``cluster_subnets_fn`` returns {subnet_id: node_count} for nodes
         already in the cluster (ref walks providerID -> GetInstance,
@@ -56,7 +56,7 @@ class SubnetProvider:
         self._cache = TTLCache(default_ttl=self.CACHE_TTL,
                                **({"clock": clock} if clock else {}))
 
-    def list_subnets(self) -> List[FakeSubnet]:
+    def list_subnets(self) -> list[FakeSubnet]:
         return self._cache.get_or_set("subnets", self._client.list_subnets)
 
     def get_subnet(self, subnet_id: str) -> FakeSubnet:
@@ -65,7 +65,7 @@ class SubnetProvider:
     def invalidate(self) -> None:
         self._cache.delete("subnets")
 
-    def select_subnets(self, strategy: Optional[PlacementStrategy]) -> List[FakeSubnet]:
+    def select_subnets(self, strategy: PlacementStrategy | None) -> list[FakeSubnet]:
         """Filter -> score -> zone-distribute (:114-217)."""
         strategy = strategy or PlacementStrategy()
         criteria = strategy.subnet_selection or SubnetSelectionCriteria()
@@ -89,7 +89,7 @@ class SubnetProvider:
             key=lambda s: apply_cluster_awareness(s, subnet_score(s), cluster_subnets),
             reverse=True)
 
-        selected: List[FakeSubnet] = []
+        selected: list[FakeSubnet] = []
         seen_zones = set()
         if strategy.zone_balance == "Balanced":
             for s in scored:
@@ -111,7 +111,7 @@ class SubnetProvider:
             raise ValueError("no subnets selected after applying placement strategy")
         return selected
 
-    def best_subnet_in_zone(self, zone: str) -> Optional[FakeSubnet]:
+    def best_subnet_in_zone(self, zone: str) -> FakeSubnet | None:
         """Most-free-IPs subnet in a zone (ref create-path fallback,
         vpc/instance/provider.go:243-329)."""
         candidates = [s for s in self.list_subnets()

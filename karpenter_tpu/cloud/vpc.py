@@ -37,14 +37,13 @@ parsed into the shared taxonomy by the HTTP layer (429 honors
 
 from __future__ import annotations
 
-from typing import Dict, List, Optional, Tuple
 
 from karpenter_tpu.cloud.http import HTTPClient, TokenSource
 from karpenter_tpu.cloud.profile import InstanceProfile
 from karpenter_tpu.cloud.resources import VNI, Image, Instance, Subnet, Volume
 
 
-def instance_to_json(i: Instance) -> Dict:
+def instance_to_json(i: Instance) -> dict:
     return {
         "id": i.id, "name": i.name, "profile": i.profile, "zone": i.zone,
         "subnet_id": i.subnet_id, "image_id": i.image_id,
@@ -58,7 +57,7 @@ def instance_to_json(i: Instance) -> Dict:
     }
 
 
-def instance_from_json(d: Dict) -> Instance:
+def instance_from_json(d: dict) -> Instance:
     return Instance(
         id=d["id"], name=d.get("name", ""), profile=d.get("profile", ""),
         zone=d.get("zone", ""), subnet_id=d.get("subnet_id", ""),
@@ -76,13 +75,13 @@ def instance_from_json(d: Dict) -> Instance:
         ip_address=d.get("ip_address", ""))
 
 
-def subnet_to_json(s: Subnet) -> Dict:
+def subnet_to_json(s: Subnet) -> dict:
     return {"id": s.id, "zone": s.zone, "total_ips": s.total_ips,
             "available_ips": s.available_ips, "state": s.state,
             "tags": dict(s.tags), "vpc_id": s.vpc_id}
 
 
-def subnet_from_json(d: Dict) -> Subnet:
+def subnet_from_json(d: dict) -> Subnet:
     return Subnet(id=d["id"], zone=d.get("zone", ""),
                   total_ips=int(d.get("total_ips", 256)),
                   available_ips=int(d.get("available_ips", 256)),
@@ -91,13 +90,13 @@ def subnet_from_json(d: Dict) -> Subnet:
                   vpc_id=d.get("vpc_id", "vpc-1"))
 
 
-def image_to_json(m: Image) -> Dict:
+def image_to_json(m: Image) -> dict:
     return {"id": m.id, "name": m.name, "os": m.os,
             "architecture": m.architecture, "status": m.status,
             "visibility": m.visibility, "created_at": m.created_at}
 
 
-def image_from_json(d: Dict) -> Image:
+def image_from_json(d: dict) -> Image:
     return Image(id=d["id"], name=d.get("name", ""), os=d.get("os", ""),
                  architecture=d.get("architecture", "amd64"),
                  status=d.get("status", "available"),
@@ -105,14 +104,14 @@ def image_from_json(d: Dict) -> Image:
                  created_at=float(d.get("created_at", 0.0)))
 
 
-def profile_to_json(p: InstanceProfile) -> Dict:
+def profile_to_json(p: InstanceProfile) -> dict:
     return {"name": p.name, "cpu": p.cpu, "memory_gib": p.memory_gib,
             "architecture": p.architecture, "gpu": p.gpu,
             "gpu_model": p.gpu_model, "supports_spot": p.supports_spot,
             "bandwidth_gbps": p.bandwidth_gbps}
 
 
-def profile_from_json(d: Dict) -> InstanceProfile:
+def profile_from_json(d: dict) -> InstanceProfile:
     return InstanceProfile(
         name=d["name"], cpu=int(d.get("cpu", 0)),
         memory_gib=int(d.get("memory_gib", 0)),
@@ -146,16 +145,16 @@ class VPCCloudClient:
         self.http = HTTPClient(endpoint, "vpc", token_source=self.tokens,
                                timeout=timeout, **kw)
 
-    def _fetch_token(self) -> Dict:
+    def _fetch_token(self) -> dict:
         return self._iam.post("/identity/token", {"apikey": self._api_key},
                               operation="token")
 
     # -- catalog side (ref catalog.go:84-114, vpc.go:489-514) --------------
 
-    def list_zones(self) -> List[str]:
+    def list_zones(self) -> list[str]:
         return list(self.http.get("/v1/zones", "list_zones").get("zones", []))
 
-    def list_instance_profiles(self) -> List[InstanceProfile]:
+    def list_instance_profiles(self) -> list[InstanceProfile]:
         data = self.http.get("/v1/instance/profiles", "list_profiles")
         return [profile_from_json(p) for p in data.get("profiles", [])]
 
@@ -165,7 +164,7 @@ class VPCCloudClient:
 
     # -- subnets / images / SGs (ref vpc.go:234-414) -----------------------
 
-    def list_subnets(self) -> List[Subnet]:
+    def list_subnets(self) -> list[Subnet]:
         data = self.http.get("/v1/subnets", "list_subnets")
         return [subnet_from_json(s) for s in data.get("subnets", [])]
 
@@ -173,7 +172,7 @@ class VPCCloudClient:
         return subnet_from_json(
             self.http.get(f"/v1/subnets/{subnet_id}", "get_subnet"))
 
-    def list_images(self) -> List[Image]:
+    def list_images(self) -> list[Image]:
         data = self.http.get("/v1/images", "list_images")
         return [image_from_json(m) for m in data.get("images", [])]
 
@@ -181,15 +180,15 @@ class VPCCloudClient:
         return self.http.get("/v1/vpcs/default/security_group",
                              "get_default_sg")["id"]
 
-    def list_security_groups(self) -> List[str]:
+    def list_security_groups(self) -> list[str]:
         return list(self.http.get("/v1/security_groups",
                                   "list_security_groups")
                     .get("security_groups", []))
 
-    def list_vpcs(self) -> List[str]:
+    def list_vpcs(self) -> list[str]:
         return list(self.http.get("/v1/vpcs", "list_vpcs").get("vpcs", []))
 
-    def list_ssh_keys(self) -> List[str]:
+    def list_ssh_keys(self) -> list[str]:
         return list(self.http.get("/v1/keys", "list_ssh_keys")
                     .get("keys", []))
 
@@ -215,12 +214,12 @@ class VPCCloudClient:
     def create_instance(self, name: str, profile: str, zone: str,
                         subnet_id: str, image_id: str,
                         capacity_type: str = "on-demand",
-                        security_group_ids: Tuple[str, ...] = (),
+                        security_group_ids: tuple[str, ...] = (),
                         user_data: str = "",
-                        tags: Optional[Dict[str, str]] = None,
-                        volumes: Tuple[Volume, ...] = (),
+                        tags: dict[str, str] | None = None,
+                        volumes: tuple[Volume, ...] = (),
                         vni_id: str = "",
-                        volume_ids: Tuple[str, ...] = ()) -> Instance:
+                        volume_ids: tuple[str, ...] = ()) -> Instance:
         body = {
             "name": name, "profile": profile, "zone": zone,
             "subnet_id": subnet_id, "image_id": image_id,
@@ -238,14 +237,14 @@ class VPCCloudClient:
         return instance_from_json(
             self.http.get(f"/v1/instances/{instance_id}", "get_instance"))
 
-    def list_instances(self) -> List[Instance]:
+    def list_instances(self) -> list[Instance]:
         data = self.http.get("/v1/instances", "list_instances")
         return [instance_from_json(i) for i in data.get("instances", [])]
 
     def delete_instance(self, instance_id: str) -> None:
         self.http.delete(f"/v1/instances/{instance_id}", "delete_instance")
 
-    def update_tags(self, instance_id: str, tags: Dict[str, str]) -> None:
+    def update_tags(self, instance_id: str, tags: dict[str, str]) -> None:
         self.http.post(f"/v1/instances/{instance_id}/tags", {"tags": tags},
                        "update_tags")
 
@@ -258,14 +257,14 @@ class VPCCloudClient:
 
     # -- spot (ref vpc.go:191) ---------------------------------------------
 
-    def list_spot_instances(self) -> List[Instance]:
+    def list_spot_instances(self) -> list[Instance]:
         data = self.http.get("/v1/instances?availability=spot",
                              "list_spot_instances")
         return [instance_from_json(i) for i in data.get("instances", [])]
 
     # -- introspection (ref vpc/instance/provider.go:905-991) --------------
 
-    def quota_status(self) -> Tuple[int, int]:
+    def quota_status(self) -> tuple[int, int]:
         data = self.http.get("/v1/quota", "quota_status")
         return int(data["live"]), int(data["limit"])
 

@@ -18,7 +18,6 @@ layer and tests can assert on.
 from __future__ import annotations
 
 from dataclasses import dataclass, field
-from typing import Dict, List
 
 from karpenter_tpu.controllers.runtime import PollController, Result
 from karpenter_tpu.core.bootstrap import TokenStore
@@ -46,7 +45,7 @@ class RBACBinding:
     name: str
     subject_group: str
     role: str
-    labels: Dict[str, str] = field(default_factory=dict)
+    labels: dict[str, str] = field(default_factory=dict)
 
 
 class BootstrapTokenController(PollController):
@@ -86,6 +85,6 @@ class BootstrapTokenController(PollController):
                     labels={"app.kubernetes.io/managed-by": "karpenter-tpu"}))
                 log.info("rbac binding ensured", name=name, role=role)
 
-    def missing_bindings(self) -> List[str]:
+    def missing_bindings(self) -> list[str]:
         return [n for n, _, _ in REQUIRED_BINDINGS
                 if self.cluster.get("rbac", n) is None]

@@ -30,7 +30,6 @@ from __future__ import annotations
 
 import time
 from dataclasses import dataclass
-from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
@@ -95,7 +94,7 @@ class DisruptionController(PollController):
         self.repack_ready_timeout = 900.0   # new-fleet Ready deadline
         self._last_repack = 0.0             # stamped on EVERY attempt —
         # a converged fleet must not pay a full fresh solve per 10s poll
-        self._pending_repack: Optional[_PendingRepack] = None
+        self._pending_repack: _PendingRepack | None = None
 
     # -- reconcile ---------------------------------------------------------
 
@@ -203,7 +202,7 @@ class DisruptionController(PollController):
 
     # -- repack (observable; BASELINE config #4) --------------------------
 
-    def propose_repack(self) -> Optional[RepackProposal]:
+    def propose_repack(self) -> RepackProposal | None:
         """Fresh solve of the entire workload vs the live fleet cost.
         Single-pool scope: with multiple NodePools (or pool taints the
         solve can't reproduce without pool context) the repack proposal
@@ -266,7 +265,7 @@ class DisruptionController(PollController):
             return 0
         with self.provisioner._solve_lock:
             if self._pending_repack is not None:
-                return self._advance_pending_repack()
+                return self._advance_pending_repack_locked()
             now = self.clock()
             if now - self._last_repack < self.repack_cooldown:
                 return 0
@@ -312,7 +311,10 @@ class DisruptionController(PollController):
             for c in new_claims:
                 if c is not None:
                     self._delete_claim(c)
-            self._last_repack = self.clock()
+            # single-writer: only this controller's keyed reconcile
+            # thread touches the cooldown stamp, and the create burst
+            # deliberately runs outside the solve lock (see above)
+            self._last_repack = self.clock()  # graftlint: disable=GL103
             log.warning("repack aborted on partial create",
                         errors=errors[:3])
             return 0
@@ -340,17 +342,17 @@ class DisruptionController(PollController):
                  new_nodes=len(new_claims), old_nodes=len(old_names))
         return 0   # nothing moved yet
 
-    def _advance_pending_repack(self) -> int:
+    def _advance_pending_repack_locked(self) -> int:
         pending = self._pending_repack
         fresh = [self.cluster.get_nodeclaim(c.name)
                  for c in pending.new_claims]
         if any(c is None or c.deleted for c in fresh):
             # GC/interruption took a new node out before cutover: abandon
-            self._rollback_pending("new fleet lost a node before Ready")
+            self._rollback_pending_locked("new fleet lost a node before Ready")
             return 0
         if not all(c.initialized for c in fresh):
             if self.clock() > pending.deadline:
-                self._rollback_pending("new fleet missed the Ready deadline")
+                self._rollback_pending_locked("new fleet missed the Ready deadline")
             return 0
         # cutover: every new node proved Ready — move pods, drain old
         for pk, claim_name in pending.pod_map.items():
@@ -378,7 +380,7 @@ class DisruptionController(PollController):
         self._last_repack = self.clock()
         return 1
 
-    def _rollback_pending(self, why: str) -> None:
+    def _rollback_pending_locked(self, why: str) -> None:
         for c in self._pending_repack.new_claims:
             live = self.cluster.get_nodeclaim(c.name)
             if live is not None and not live.deleted:
@@ -392,7 +394,7 @@ class DisruptionController(PollController):
 
     # -- helpers -----------------------------------------------------------
 
-    def _bound_pods(self, node_name: str) -> List[str]:
+    def _bound_pods(self, node_name: str) -> list[str]:
         from karpenter_tpu.apis.pod import pod_key
 
         if not node_name:
@@ -424,7 +426,7 @@ class DisruptionController(PollController):
             resid = resid - self._pod_req(pk)
         return resid
 
-    def _target_labels(self, claim: NodeClaim) -> Dict[str, str]:
+    def _target_labels(self, claim: NodeClaim) -> dict[str, str]:
         """Effective scheduling labels of the node backing ``claim``: claim
         labels + pool static labels + well-known placement labels (mirrors
         what the actuator/registration stamp on the real node)."""
@@ -442,8 +444,8 @@ class DisruptionController(PollController):
         return labels
 
     def _pod_compatible(self, spec, victim: NodeClaim, target: NodeClaim,
-                        target_labels: Dict[str, str],
-                        planned_on_target: List) -> bool:
+                        target_labels: dict[str, str],
+                        planned_on_target: list) -> bool:
         """Full compatibility of a pod move onto ``target`` — the same
         constraints the solver's compat mask enforces at placement time
         (node selectors / required affinity, taints, zone co-location,
@@ -476,7 +478,7 @@ class DisruptionController(PollController):
                     return False
         return True
 
-    def _pods_on(self, claim: NodeClaim, planned: List):
+    def _pods_on(self, claim: NodeClaim, planned: list):
         """PodSpecs currently bound to ``claim``'s node plus any planned
         moves onto it within this consolidation pass."""
         out = []
@@ -487,16 +489,16 @@ class DisruptionController(PollController):
         out.extend(planned)
         return out
 
-    def _fit_elsewhere(self, victim: NodeClaim, pods: List[str],
-                       claims: List[NodeClaim],
-                       resid: Dict[str, np.ndarray]
-                       ) -> Optional[List[Tuple[str, NodeClaim]]]:
+    def _fit_elsewhere(self, victim: NodeClaim, pods: list[str],
+                       claims: list[NodeClaim],
+                       resid: dict[str, np.ndarray]
+                       ) -> list[tuple[str, NodeClaim]] | None:
         """First-fit each pod into other nodes' residuals (on a working
         copy), honoring the pod's full scheduling constraints against each
         candidate target; None if any pod does not fit."""
         work = {k: v.copy() for k, v in resid.items()}
-        placement: List[Tuple[str, NodeClaim]] = []
-        planned: Dict[str, List] = {}
+        placement: list[tuple[str, NodeClaim]] = []
+        planned: dict[str, list] = {}
         others = [c for c in claims if c.name != victim.name]
         labels = {c.name: self._target_labels(c) for c in others}
         for pk in pods:

@@ -11,7 +11,6 @@ from __future__ import annotations
 
 import os
 import time
-from typing import Optional
 
 from karpenter_tpu.apis.nodeclaim import parse_provider_id
 from karpenter_tpu.catalog.instancetype import InstanceTypeProvider
@@ -195,7 +194,7 @@ class OrphanCleanupController(PollController):
     interval = 300.0
     min_instance_age = 600.0   # don't reap instances whose node is booting
 
-    def __init__(self, cluster: ClusterState, cloud, enabled: Optional[bool] = None):
+    def __init__(self, cluster: ClusterState, cloud, enabled: bool | None = None):
         self.cluster = cluster
         self.cloud = cloud
         self.enabled = (os.environ.get("KARPENTER_ENABLE_ORPHAN_CLEANUP", "")

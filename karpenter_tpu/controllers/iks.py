@@ -9,7 +9,6 @@ held zero workers past ``emptyPoolTTL``, honoring the cleanup policy
 from __future__ import annotations
 
 import time
-from typing import Dict
 
 from karpenter_tpu.cloud.errors import CloudError
 from karpenter_tpu.cloud.fake_iks import FakeIKS
@@ -30,7 +29,7 @@ class PoolCleanupController(PollController):
         self.iks = iks
         self.empty_pool_ttl = empty_pool_ttl
         self.cleanup_policy = cleanup_policy
-        self._empty_since: Dict[str, float] = {}
+        self._empty_since: dict[str, float] = {}
 
     def _policy_for(self, pool) -> tuple:
         """(ttl, policy) from the NodeClass that owns this dynamic pool —

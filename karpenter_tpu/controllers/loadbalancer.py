@@ -14,7 +14,6 @@ karpenter itself registered — never operator-added backends sharing a pool.
 from __future__ import annotations
 
 from dataclasses import dataclass, field
-from typing import Optional, Tuple
 
 from karpenter_tpu.apis.nodeclass import LoadBalancerTarget
 from karpenter_tpu.cloud.errors import CloudError
@@ -34,7 +33,7 @@ class LBRegistration:
 
     name: str                                  # claim name
     address: str
-    targets: Tuple[LoadBalancerTarget, ...]
+    targets: tuple[LoadBalancerTarget, ...]
     auto_deregister: bool = True
     resource_version: int = 0
 
@@ -47,7 +46,7 @@ class LoadBalancerController(WatchController):
         self.cluster = cluster
         self.provider = provider
 
-    def map_event(self, kind: str, event_type: str, obj) -> Optional[str]:
+    def map_event(self, kind: str, event_type: str, obj) -> str | None:
         if kind == "nodes":
             for claim in self.cluster.nodeclaims():
                 if claim.provider_id == obj.provider_id:

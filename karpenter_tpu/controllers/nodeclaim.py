@@ -10,7 +10,6 @@ halves — SURVEY.md §0).
 from __future__ import annotations
 
 import time
-from typing import Optional
 
 from karpenter_tpu.apis.nodeclaim import Node, NodeClaim, parse_provider_id
 from karpenter_tpu.apis.pod import Taint
@@ -35,7 +34,7 @@ CNI_NOT_READY_PREFIXES = (
 )
 
 
-def _claim_for_node(cluster: ClusterState, node: Node) -> Optional[NodeClaim]:
+def _claim_for_node(cluster: ClusterState, node: Node) -> NodeClaim | None:
     for claim in cluster.nodeclaims():
         if claim.provider_id and claim.provider_id == node.provider_id:
             return claim
@@ -54,7 +53,7 @@ class RegistrationController(WatchController):
     def __init__(self, cluster: ClusterState):
         self.cluster = cluster
 
-    def map_event(self, kind: str, event_type: str, obj) -> Optional[str]:
+    def map_event(self, kind: str, event_type: str, obj) -> str | None:
         if kind == "nodes":
             claim = _claim_for_node(self.cluster, obj)
             return claim.name if claim else None
@@ -85,7 +84,7 @@ class RegistrationController(WatchController):
             self.cluster.update("nodes", node.name, node)
         return Result()
 
-    def _find_node(self, claim: NodeClaim) -> Optional[Node]:
+    def _find_node(self, claim: NodeClaim) -> Node | None:
         for node in self.cluster.nodes():
             if node.provider_id == claim.provider_id and not node.deleted:
                 return node
@@ -117,7 +116,7 @@ class StartupTaintController(WatchController):
     def __init__(self, cluster: ClusterState):
         self.cluster = cluster
 
-    def map_event(self, kind: str, event_type: str, obj) -> Optional[str]:
+    def map_event(self, kind: str, event_type: str, obj) -> str | None:
         if kind == "nodes":
             claim = _claim_for_node(self.cluster, obj)
             return claim.name if claim else None

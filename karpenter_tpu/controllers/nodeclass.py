@@ -8,7 +8,6 @@ termination}`` (SURVEY.md §2.5).
 from __future__ import annotations
 
 import time
-from typing import Optional
 
 from karpenter_tpu.apis.nodeclass import (
     ANNOTATION_NODECLASS_HASH, ANNOTATION_NODECLASS_HASH_VERSION,
@@ -64,8 +63,8 @@ class NodeClassStatusController(WatchController):
     revalidate_after = 24 * 3600.0
 
     def __init__(self, cluster: ClusterState, cloud,
-                 subnet_provider: Optional[SubnetProvider] = None,
-                 image_resolver: Optional[ImageResolver] = None):
+                 subnet_provider: SubnetProvider | None = None,
+                 image_resolver: ImageResolver | None = None):
         self.cluster = cluster
         self.cloud = cloud
         self.subnets = subnet_provider or SubnetProvider(cloud)
@@ -259,7 +258,7 @@ class NodeClassTerminationController(WatchController):
     def __init__(self, cluster: ClusterState):
         self.cluster = cluster
 
-    def map_event(self, kind: str, event_type: str, obj) -> Optional[str]:
+    def map_event(self, kind: str, event_type: str, obj) -> str | None:
         if kind == "nodeclaims":
             # a claim going away may unblock its nodeclass's deletion
             return getattr(obj, "nodeclass_name", None) or None

@@ -19,7 +19,7 @@ from __future__ import annotations
 import threading
 import time
 from dataclasses import dataclass
-from typing import Callable, Dict, List, Optional, Sequence
+from collections.abc import Callable, Sequence
 
 from karpenter_tpu.core.cluster import ClusterState
 from karpenter_tpu.utils import metrics
@@ -47,7 +47,7 @@ class WatchController:
     name = "watch"
     watch_kinds: Sequence[str] = ()
 
-    def map_event(self, kind: str, event_type: str, obj) -> Optional[str]:
+    def map_event(self, kind: str, event_type: str, obj) -> str | None:
         return getattr(obj, "name", None)
 
     def reconcile(self, key: str) -> Result:  # pragma: no cover - abstract
@@ -71,9 +71,9 @@ class _Queue:
     def __init__(self):
         self._lock = threading.Lock()
         self._cv = threading.Condition(self._lock)
-        self._pending: List[str] = []
+        self._pending: list[str] = []
         self._in_queue: set = set()
-        self._delayed: Dict[str, float] = {}   # key -> not-before monotonic
+        self._delayed: dict[str, float] = {}   # key -> not-before monotonic
         self._closed = False
 
     def add(self, key: str, after: float = 0.0) -> None:
@@ -88,7 +88,8 @@ class _Queue:
                 self._in_queue.add(key)
             self._cv.notify()
 
-    def _promote_due(self, now: float) -> None:
+    def _promote_due_locked(self, now: float) -> None:
+        # caller holds self._cv (the _locked contract, docs/development.md)
         due = [k for k, t in self._delayed.items() if t <= now]
         for k in due:
             del self._delayed[k]
@@ -96,22 +97,22 @@ class _Queue:
                 self._pending.append(k)
                 self._in_queue.add(k)
 
-    def get(self, timeout: float = 0.2) -> Optional[str]:
+    def get(self, timeout: float = 0.2) -> str | None:
         with self._cv:
-            self._promote_due(time.monotonic())
+            self._promote_due_locked(time.monotonic())
             if not self._pending and not self._closed:
                 self._cv.wait(timeout)
-                self._promote_due(time.monotonic())
+                self._promote_due_locked(time.monotonic())
             if not self._pending:
                 return None
             key = self._pending.pop(0)
             self._in_queue.discard(key)
             return key
 
-    def drain(self) -> List[str]:
+    def drain(self) -> list[str]:
         """Take everything currently due (test/sync path)."""
         with self._cv:
-            self._promote_due(time.monotonic())
+            self._promote_due_locked(time.monotonic())
             keys, self._pending = self._pending, []
             self._in_queue.clear()
             return keys
@@ -130,11 +131,11 @@ class ControllerManager:
         # reconcile — controller-runtime's leader-election semantics.
         # Queued keys drain on failover; pollers just skip their tick.
         self.leader = leader if leader is not None else (lambda: True)
-        self._watch: List[WatchController] = []
-        self._poll: List[PollController] = []
-        self._queues: Dict[str, _Queue] = {}
-        self._unsubs: List[Callable[[], None]] = []
-        self._threads: List[threading.Thread] = []
+        self._watch: list[WatchController] = []
+        self._poll: list[PollController] = []
+        self._queues: dict[str, _Queue] = {}
+        self._unsubs: list[Callable[[], None]] = []
+        self._threads: list[threading.Thread] = []
         self._stop = threading.Event()
 
     # -- registration ------------------------------------------------------
@@ -148,7 +149,7 @@ class ControllerManager:
         else:
             raise TypeError(f"not a controller: {controller!r}")
 
-    def controllers(self) -> List[str]:
+    def controllers(self) -> list[str]:
         return [c.name for c in self._watch] + [c.name for c in self._poll]
 
     # -- live operation ----------------------------------------------------
@@ -248,7 +249,7 @@ class ControllerManager:
             return   # a follower's resync would actuate (GC deletes etc.)
         for _ in range(rounds):
             for ctrl in self._watch:
-                keys: List[str] = []
+                keys: list[str] = []
                 for kind in ctrl.watch_kinds:
                     for obj in self.cluster.list(kind):
                         key = ctrl.map_event(kind, "SYNC", obj)

@@ -25,7 +25,6 @@ from __future__ import annotations
 
 import time
 import uuid
-from typing import List, Optional, Tuple
 
 from karpenter_tpu.constants import CLAIM_FINALIZER
 from karpenter_tpu.apis.nodeclaim import NodeClaim, parse_provider_id, provider_id
@@ -58,12 +57,12 @@ KARPENTER_TAGS = {"karpenter.sh/managed": "true"}
 
 class Actuator:
     def __init__(self, cloud, cluster: ClusterState,
-                 subnet_provider: Optional[SubnetProvider] = None,
-                 image_resolver: Optional[ImageResolver] = None,
-                 bootstrap: Optional[BootstrapProvider] = None,
-                 breaker: Optional[CircuitBreakerManager] = None,
-                 unavailable: Optional[UnavailableOfferings] = None,
-                 cluster_config: Optional[ClusterConfig] = None):
+                 subnet_provider: SubnetProvider | None = None,
+                 image_resolver: ImageResolver | None = None,
+                 bootstrap: BootstrapProvider | None = None,
+                 breaker: CircuitBreakerManager | None = None,
+                 unavailable: UnavailableOfferings | None = None,
+                 cluster_config: ClusterConfig | None = None):
         self.cloud = cloud
         self.cluster = cluster
         self.subnets = subnet_provider or SubnetProvider(
@@ -182,7 +181,7 @@ class Actuator:
         volumes -> instance; any stage failing deletes what the earlier
         stages allocated, so a failed create leaks nothing."""
         vni_id = ""
-        created_volume_ids: List[str] = []
+        created_volume_ids: list[str] = []
         try:
             vni_id = self.cloud.create_vni(subnet_id).id
             for i, bdm in enumerate(nodeclass.spec.block_device_mappings):
@@ -205,7 +204,7 @@ class Actuator:
             raise
 
     def _cleanup_partial_create(self, vni_id: str,
-                                volume_ids: List[str]) -> None:
+                                volume_ids: list[str]) -> None:
         """Best-effort orphan deletion — cleanup failure must not mask the
         create error (the GC sweep is the eventual-consistency backstop)."""
         for vid in volume_ids:
@@ -250,7 +249,7 @@ class Actuator:
 
     def _record_create_failure(self, planned: PlannedNode, nodeclass: NodeClass,
                                err: CloudError,
-                               catalog: Optional[CatalogArrays] = None) -> None:
+                               catalog: CatalogArrays | None = None) -> None:
         metrics.ERRORS.labels("actuator", err.code or "unknown").inc()
         # subnet state may have shifted under the 5-min cache (IP counts
         # move with every create); refresh so retries see reality
@@ -279,13 +278,13 @@ class Actuator:
     def execute_plan(self, plan: Plan, nodeclass: NodeClass,
                      catalog: CatalogArrays,
                      nodepool_name: str = "default"
-                     ) -> Tuple[List[Optional[NodeClaim]], List[str]]:
+                     ) -> tuple[list[NodeClaim | None], list[str]]:
         """Create every planned node; returns (claims, errors) with claims
         POSITIONALLY aligned to plan.nodes (None = that create failed).  A
         failed node leaves its pods pending for the next solve window (the
         reference's per-NodeClaim create failures behave the same)."""
-        claims: List[Optional[NodeClaim]] = []
-        errors: List[str] = []
+        claims: list[NodeClaim | None] = []
+        errors: list[str] = []
         for planned in plan.nodes:
             try:
                 claims.append(self.create_node(planned, nodeclass, catalog,

@@ -17,7 +17,6 @@ import secrets
 import threading
 import time
 from dataclasses import dataclass, field, replace
-from typing import Dict, List, Optional, Tuple
 
 from karpenter_tpu.apis.nodeclass import NodeClass
 from karpenter_tpu.apis.pod import Taint
@@ -62,7 +61,7 @@ class TokenStore:
     def __init__(self, clock=time.time):
         self._clock = clock
         self._lock = threading.Lock()
-        self._tokens: List[BootstrapToken] = []
+        self._tokens: list[BootstrapToken] = []
 
     def find_or_create(self) -> BootstrapToken:
         now = self._clock()
@@ -85,7 +84,7 @@ class TokenStore:
             self._tokens = [t for t in self._tokens if t.expires_at > now]
             return before - len(self._tokens)
 
-    def live_tokens(self) -> List[BootstrapToken]:
+    def live_tokens(self) -> list[BootstrapToken]:
         now = self._clock()
         with self._lock:
             return [t for t in self._tokens if t.expires_at > now]
@@ -101,9 +100,9 @@ class BootstrapOptions:
     architecture: str = "amd64"
     region: str = ""
     zone: str = ""
-    labels: Dict[str, str] = field(default_factory=dict)
-    taints: Tuple[Taint, ...] = ()
-    kubelet_extra_args: Dict[str, str] = field(default_factory=dict)
+    labels: dict[str, str] = field(default_factory=dict)
+    taints: tuple[Taint, ...] = ()
+    kubelet_extra_args: dict[str, str] = field(default_factory=dict)
 
 
 class BootstrapProvider:
@@ -111,7 +110,7 @@ class BootstrapProvider:
     bootstrap/provider.go:73; template cloudinit.go:29-1030 — full
     production document built by core/cloudinit.py)."""
 
-    def __init__(self, tokens: Optional[TokenStore] = None, env=None):
+    def __init__(self, tokens: TokenStore | None = None, env=None):
         self.tokens = tokens or TokenStore()
         self.env = env          # BootstrapEnv (mirrors/proxies) or None
 

@@ -18,7 +18,7 @@ import os
 import threading
 import time
 from dataclasses import dataclass
-from typing import Callable, Dict, List, Optional, Tuple
+from collections.abc import Callable
 
 from karpenter_tpu.utils import metrics
 from karpenter_tpu.utils.logging import get_logger
@@ -29,7 +29,7 @@ CLOSED, OPEN, HALF_OPEN = "CLOSED", "OPEN", "HALF_OPEN"
 
 
 class CircuitBreakerOpenError(Exception):
-    def __init__(self, key: Tuple[str, str], reason: str):
+    def __init__(self, key: tuple[str, str], reason: str):
         super().__init__(f"circuit breaker open for {key[0]}/{key[1]}: {reason}")
         self.key = key
         self.reason = reason
@@ -66,15 +66,15 @@ class CircuitBreakerConfig:
 
 
 class CircuitBreaker:
-    def __init__(self, config: Optional[CircuitBreakerConfig] = None,
+    def __init__(self, config: CircuitBreakerConfig | None = None,
                  clock: Callable[[], float] = time.monotonic,
-                 key: Tuple[str, str] = ("default", "default")):
+                 key: tuple[str, str] = ("default", "default")):
         self.config = config or CircuitBreakerConfig()
         self._clock = clock
         self._key = key
         self._lock = threading.Lock()
         self.state = CLOSED
-        self._failures: List[float] = []
+        self._failures: list[float] = []
         self._last_state_change = clock()
         self._half_open_requests = 0
         self._concurrent = 0
@@ -97,7 +97,7 @@ class CircuitBreaker:
         with self._lock:
             now = self._clock()
             self.last_used = now
-            self._reset_minute(now)
+            self._reset_minute_locked(now)
             if self.state == OPEN:
                 if now - self._last_state_change >= self.config.recovery_timeout:
                     self._transition(HALF_OPEN, now)
@@ -160,7 +160,8 @@ class CircuitBreaker:
             metrics.CB_STATE.labels(self._key[0], self._key[1]).set(
                 {CLOSED: 0.0, OPEN: 1.0, HALF_OPEN: 2.0}[state])
 
-    def _reset_minute(self, now: float) -> None:
+    def _reset_minute_locked(self, now: float) -> None:
+        # caller holds self._lock (the _locked contract)
         if now - self._minute_start >= 60.0:
             self._minute_start = now
             self._minute_count = 0
@@ -172,12 +173,12 @@ class CircuitBreakerManager:
 
     IDLE_TTL = 3600.0
 
-    def __init__(self, config: Optional[CircuitBreakerConfig] = None,
+    def __init__(self, config: CircuitBreakerConfig | None = None,
                  clock: Callable[[], float] = time.monotonic):
         self._config = config or CircuitBreakerConfig()
         self._clock = clock
         self._lock = threading.Lock()
-        self._breakers: Dict[Tuple[str, str], CircuitBreaker] = {}
+        self._breakers: dict[tuple[str, str], CircuitBreaker] = {}
 
     @property
     def config(self) -> CircuitBreakerConfig:
@@ -217,6 +218,6 @@ class CircuitBreakerManager:
                 metrics.CB_STATE.remove(k[0], k[1])
             return len(dead)
 
-    def states(self) -> Dict[Tuple[str, str], str]:
+    def states(self) -> dict[tuple[str, str], str]:
         with self._lock:
             return {k: cb.state for k, cb in self._breakers.items()}

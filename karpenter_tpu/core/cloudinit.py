@@ -23,7 +23,6 @@ Layout of the generated document:
 from __future__ import annotations
 
 from dataclasses import dataclass, field
-from typing import Dict, List, Optional, Tuple
 
 # Download endpoints are parameterized so air-gapped mirrors can override
 # them through BootstrapEnv (the env-injection contract).
@@ -58,9 +57,9 @@ class BootstrapEnv:
     http_proxy: str = ""
     https_proxy: str = ""
     no_proxy: str = ""
-    extra: Tuple[Tuple[str, str], ...] = ()
+    extra: tuple[tuple[str, str], ...] = ()
 
-    def as_pairs(self) -> List[Tuple[str, str]]:
+    def as_pairs(self) -> list[tuple[str, str]]:
         pairs = [
             ("KARPENTER_K8S_DOWNLOAD", self.k8s_download),
             ("KARPENTER_CONTAINERD_DOWNLOAD", self.containerd_download),
@@ -193,9 +192,9 @@ users:
 """
 
 
-def kubelet_unit(node_name: str, labels: Dict[str, str], taints,
-                 extra_args: Dict[str, str],
-                 env_pairs: List[Tuple[str, str]]) -> str:
+def kubelet_unit(node_name: str, labels: dict[str, str], taints,
+                 extra_args: dict[str, str],
+                 env_pairs: list[tuple[str, str]]) -> str:
     """kubelet systemd service with registration args (labels + taints)
     and injected environment."""
     label_args = ",".join(f"{k}={v}" for k, v in sorted(labels.items()))
@@ -228,7 +227,7 @@ WantedBy=multi-user.target
 
 
 def install_script(cluster, architecture: str,
-                   env_pairs: List[Tuple[str, str]]) -> str:
+                   env_pairs: list[tuple[str, str]]) -> str:
     """Binary installation helper: containerd + runc + CNI plugin
     binaries + kubelet, all architecture-conditional (the ref template
     branches on arch the same way), idempotent, fail-fast."""
@@ -288,7 +287,7 @@ echo "{cluster.cluster_ca}" | base64 -d > /etc/kubernetes/pki/ca.crt
 """
 
 
-def cni_install_commands(cluster) -> List[str]:
+def cni_install_commands(cluster) -> list[str]:
     """Per-plugin CNI installation branch (ref template's CNI section:
     plugin + version selection).  The node-side step differs per plugin:
     calico/flannel need the conf dir primed for the DaemonSet to adopt;
@@ -341,10 +340,10 @@ def modules_config() -> str:
 
 def generate_cloud_init(cluster, node_name: str, token: str,
                         architecture: str = "amd64",
-                        labels: Optional[Dict[str, str]] = None,
+                        labels: dict[str, str] | None = None,
                         taints=(), kubelet=None,
-                        kubelet_extra_args: Optional[Dict[str, str]] = None,
-                        env: Optional[BootstrapEnv] = None,
+                        kubelet_extra_args: dict[str, str] | None = None,
+                        env: BootstrapEnv | None = None,
                         max_pods: int = 0) -> str:
     """Assemble the full #cloud-config document."""
     env = env or BootstrapEnv()

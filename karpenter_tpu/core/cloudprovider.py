@@ -10,7 +10,6 @@ filter :321-352, circuit breaker :356-373) which live in the Actuator.
 
 from __future__ import annotations
 
-from typing import List, Optional
 
 from karpenter_tpu.apis.nodeclaim import NodeClaim, parse_provider_id
 from karpenter_tpu.apis.nodeclass import NodeClass
@@ -42,7 +41,7 @@ class CloudProvider:
     def name(self) -> str:
         return PROVIDER_NAME
 
-    def get_supported_node_classes(self) -> List[str]:
+    def get_supported_node_classes(self) -> list[str]:
         return ["NodeClass"]
 
     # -- lifecycle ---------------------------------------------------------
@@ -61,7 +60,7 @@ class CloudProvider:
             if self.factory is not None else self.actuator
         actuator.delete_node(claim)
 
-    def get(self, provider_id: str) -> Optional[NodeClaim]:
+    def get(self, provider_id: str) -> NodeClaim | None:
         """Resolve a providerID back to a live NodeClaim
         (cloudprovider.go:106): verify the instance exists, then find the
         claim tracking it."""
@@ -80,14 +79,14 @@ class CloudProvider:
                 return claim
         return None
 
-    def list(self) -> List[NodeClaim]:
+    def list(self) -> list[NodeClaim]:
         """All NodeClaims with live provider IDs (cloudprovider.go:172 lists
         nodes with ibm:// providerIDs; claims are this framework's ledger)."""
         return [c for c in self.cluster.nodeclaims()
                 if c.provider_id and not c.deleted]
 
-    def get_instance_types(self, nodeclass: Optional[NodeClass] = None
-                           ) -> List[InstanceType]:
+    def get_instance_types(self, nodeclass: NodeClass | None = None
+                           ) -> list[InstanceType]:
         """Per-NodeClass filtered catalog (cloudprovider.go:553)."""
         types = self.instance_types.list(nodeclass)
         if nodeclass is not None and nodeclass.status.selected_instance_types:
@@ -102,5 +101,5 @@ class CloudProvider:
         nodeclass = self.cluster.get_nodeclass(claim.nodeclass_name)
         return is_drifted(claim, nodeclass)
 
-    def repair_policies(self) -> List[RepairPolicy]:
+    def repair_policies(self) -> list[RepairPolicy]:
         return repair_policies()

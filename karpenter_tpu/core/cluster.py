@@ -24,7 +24,8 @@ import threading
 import time
 from collections import defaultdict
 from dataclasses import dataclass, field
-from typing import Any, Callable, Dict, List, Optional, Tuple
+from collections.abc import Callable
+from typing import Any
 
 from karpenter_tpu.apis.nodeclaim import Node, NodeClaim, NodePool
 from karpenter_tpu.apis.nodeclass import NodeClass
@@ -66,7 +67,7 @@ class _Collection:
     def __init__(self, store: "ClusterState", kind: str):
         self._store = store
         self._kind = kind
-        self._items: Dict[str, Any] = {}
+        self._items: dict[str, Any] = {}
 
     def __len__(self):
         with self._store._lock:
@@ -77,12 +78,12 @@ class ClusterState:
     def __init__(self):
         self._lock = threading.RLock()
         self._rv = 0
-        self._collections: Dict[str, Dict[str, Any]] = defaultdict(dict)
+        self._collections: dict[str, dict[str, Any]] = defaultdict(dict)
         for kind in ("pods", "nodes", "nodeclaims", "nodeclasses",
                      "nodepools", "lbregistrations", "rbac"):
             self._collections[kind] = {}
-        self._watchers: Dict[str, List[Callable[[str, Any], None]]] = defaultdict(list)
-        self.events: List[Event] = []
+        self._watchers: dict[str, list[Callable[[str, Any], None]]] = defaultdict(list)
+        self.events: list[Event] = []
 
     # -- generic store -----------------------------------------------------
 
@@ -102,17 +103,17 @@ class ClusterState:
         self._notify(watchers, ADDED, obj)
         return obj
 
-    def get(self, kind: str, name: str) -> Optional[Any]:
+    def get(self, kind: str, name: str) -> Any | None:
         with self._lock:
             return self._collections[kind].get(name)
 
-    def list(self, kind: str, predicate: Optional[Callable[[Any], bool]] = None) -> List[Any]:
+    def list(self, kind: str, predicate: Callable[[Any], bool] | None = None) -> list[Any]:
         with self._lock:
             items = list(self._collections[kind].values())
         return [i for i in items if predicate(i)] if predicate else items
 
     def update(self, kind: str, name: str, obj: Any,
-               expect_rv: Optional[int] = None) -> Any:
+               expect_rv: int | None = None) -> Any:
         with self._lock:
             coll = self._collections[kind]
             current = coll.get(name)
@@ -130,7 +131,7 @@ class ClusterState:
         self._notify(watchers, MODIFIED, obj)
         return obj
 
-    def delete(self, kind: str, name: str) -> Optional[Any]:
+    def delete(self, kind: str, name: str) -> Any | None:
         with self._lock:
             obj = self._collections[kind].pop(name, None)
             watchers = list(self._watchers[kind]) if obj is not None else []
@@ -167,7 +168,7 @@ class ClusterState:
             if len(self.events) > 10000:
                 self.events = self.events[-5000:]
 
-    def events_for(self, kind: str, name: str) -> List[Event]:
+    def events_for(self, kind: str, name: str) -> list[Event]:
         with self._lock:
             return [e for e in self.events if e.kind == kind and e.name == name]
 
@@ -186,7 +187,7 @@ class ClusterState:
                 f"nodeclass {nc.name} rejected at admission: {errs[:3]}")
         return self.add("nodeclasses", nc.name, nc)
 
-    def get_nodeclass(self, name: str) -> Optional[NodeClass]:
+    def get_nodeclass(self, name: str) -> NodeClass | None:
         return self.get("nodeclasses", name)
 
     def add_nodepool(self, np_: NodePool) -> NodePool:
@@ -195,7 +196,7 @@ class ClusterState:
     def add_pod(self, pod: PodSpec) -> PendingPod:
         return self.add("pods", f"{pod.namespace}/{pod.name}", PendingPod(spec=pod))
 
-    def pending_pods(self) -> List[PendingPod]:
+    def pending_pods(self) -> list[PendingPod]:
         return self.list("pods", lambda p: not p.bound_node)
 
     def evict_node_pods(self, node_name: str) -> int:
@@ -226,26 +227,26 @@ class ClusterState:
     def add_nodeclaim(self, claim: NodeClaim) -> NodeClaim:
         return self.add("nodeclaims", claim.name, claim)
 
-    def get_nodeclaim(self, name: str) -> Optional[NodeClaim]:
+    def get_nodeclaim(self, name: str) -> NodeClaim | None:
         return self.get("nodeclaims", name)
 
-    def nodeclaims(self, predicate=None) -> List[NodeClaim]:
+    def nodeclaims(self, predicate=None) -> list[NodeClaim]:
         return self.list("nodeclaims", predicate)
 
     def add_node(self, node: Node) -> Node:
         return self.add("nodes", node.name, node)
 
-    def get_node(self, name: str) -> Optional[Node]:
+    def get_node(self, name: str) -> Node | None:
         return self.get("nodes", name)
 
-    def nodes(self, predicate=None) -> List[Node]:
+    def nodes(self, predicate=None) -> list[Node]:
         return self.list("nodes", predicate)
 
-    def node_count_by_subnet(self) -> Dict[str, int]:
+    def node_count_by_subnet(self) -> dict[str, int]:
         """{subnet_id: node count} for subnet cluster-awareness scoring
         (ref walks providerID -> GetInstance, subnet/provider.go:247-310;
         here claims carry their subnet)."""
-        counts: Dict[str, int] = defaultdict(int)
+        counts: dict[str, int] = defaultdict(int)
         for claim in self.nodeclaims():
             if claim.subnet_id and not claim.deleted:
                 counts[claim.subnet_id] += 1

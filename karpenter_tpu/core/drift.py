@@ -14,7 +14,6 @@ from __future__ import annotations
 
 import time
 from dataclasses import dataclass
-from typing import List, Optional
 
 from karpenter_tpu.apis.nodeclaim import NodeClaim
 from karpenter_tpu.apis.nodeclass import (
@@ -35,7 +34,7 @@ DRIFT_SUBNET = "SubnetDrifted"
 DRIFT_SECURITY_GROUPS = "SecurityGroupsDrifted"
 
 
-def is_drifted(claim: NodeClaim, nodeclass: Optional[NodeClass]) -> str:
+def is_drifted(claim: NodeClaim, nodeclass: NodeClass | None) -> str:
     """Returns a drift reason or "" (the reference's IsDrifted contract).
 
     Checks run in the reference's order; the first hit wins.
@@ -48,7 +47,7 @@ def is_drifted(claim: NodeClaim, nodeclass: Optional[NodeClass]) -> str:
     return reason
 
 
-def _detect(claim: NodeClaim, nodeclass: Optional[NodeClass]) -> str:
+def _detect(claim: NodeClaim, nodeclass: NodeClass | None) -> str:
     # 1. nodeclass gone (cloudprovider.go:644)
     if nodeclass is None or nodeclass.deleted:
         return DRIFT_NODECLASS_DELETED
@@ -102,7 +101,7 @@ class RepairPolicy:
     toleration_seconds: float
 
 
-def repair_policies() -> List[RepairPolicy]:
+def repair_policies() -> list[RepairPolicy]:
     """The reference's table: Ready=False/Unknown 5 min; pressure conditions
     10 min (cloudprovider.go:775-804)."""
     return [

@@ -9,7 +9,6 @@ IKS; spec.iksClusterID -> IKS; ``IKS_CLUSTER_ID`` env -> IKS; default VPC.
 from __future__ import annotations
 
 import os
-from typing import Optional
 
 from karpenter_tpu.apis.nodeclass import NodeClass
 from karpenter_tpu.core.actuator import Actuator
@@ -35,7 +34,7 @@ def determine_mode(nodeclass: NodeClass, env=os.environ) -> str:
 
 class ProviderFactory:
     def __init__(self, vpc_actuator: Actuator,
-                 iks_actuator: Optional[WorkerPoolActuator] = None,
+                 iks_actuator: WorkerPoolActuator | None = None,
                  env=os.environ):
         self.vpc = vpc_actuator
         self.iks = iks_actuator

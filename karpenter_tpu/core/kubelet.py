@@ -11,7 +11,6 @@ and the simulated control loop drive that continuation through this class:
 from __future__ import annotations
 
 import time
-from typing import List, Optional
 
 from karpenter_tpu.apis.nodeclaim import Node, NodeClaim
 from karpenter_tpu.core.bootstrap import TAINT_UNREGISTERED
@@ -38,7 +37,7 @@ class FakeKubelet:
             addresses=[f"10.0.0.{abs(hash(claim.name)) % 250 + 1}"])
         return self.cluster.add_node(node)
 
-    def join_pending(self, ready: bool = False) -> List[Node]:
+    def join_pending(self, ready: bool = False) -> list[Node]:
         """Join every launched-but-nodeless claim (bulk test driver), then
         bind nominated pods onto ready nodes — the kube-scheduler's half
         of the continuation."""
@@ -73,7 +72,7 @@ class FakeKubelet:
             n += 1
         return n
 
-    def mark_ready(self, node_name: str, ready: bool = True) -> Optional[Node]:
+    def mark_ready(self, node_name: str, ready: bool = True) -> Node | None:
         node = self.cluster.get_node(node_name)
         if node is None:
             return None
@@ -82,7 +81,7 @@ class FakeKubelet:
         return self.cluster.update("nodes", node_name, node)
 
     def mark_condition(self, node_name: str, condition: str, status: str,
-                       since: Optional[float] = None) -> Optional[Node]:
+                       since: float | None = None) -> Node | None:
         node = self.cluster.get_node(node_name)
         if node is None:
             return None

@@ -22,7 +22,7 @@ import threading
 import time
 import uuid
 from dataclasses import dataclass
-from typing import Callable, Optional
+from collections.abc import Callable
 
 from karpenter_tpu.core.cluster import ClusterState, ConflictError
 from karpenter_tpu.utils import metrics
@@ -60,8 +60,8 @@ class LeaderElector:
                  lease_duration: float = 15.0,
                  renew_interval: float = 5.0,
                  retry_interval: float = 2.0,
-                 on_started_leading: Optional[Callable[[], None]] = None,
-                 on_stopped_leading: Optional[Callable[[], None]] = None,
+                 on_started_leading: Callable[[], None] | None = None,
+                 on_stopped_leading: Callable[[], None] | None = None,
                  clock=time.time):
         # clock is WALL time by default: renew_time in the lease record is
         # compared across replicas, and monotonic clocks have per-host
@@ -80,7 +80,7 @@ class LeaderElector:
         self._leading = False
         self._transition_lock = threading.Lock()
         self._stop = threading.Event()
-        self._thread: Optional[threading.Thread] = None
+        self._thread: threading.Thread | None = None
 
     # -- public --------------------------------------------------------------
 

@@ -17,7 +17,7 @@ from __future__ import annotations
 import threading
 import time
 from dataclasses import dataclass, field
-from typing import Dict, List, Optional, Sequence, Tuple
+from collections.abc import Sequence
 
 from karpenter_tpu.apis.nodeclaim import NodePool
 from karpenter_tpu.apis.nodeclass import NodeClass
@@ -59,7 +59,7 @@ def make_solver(options: SolverOptions):
 
 class Provisioner:
     def __init__(self, cluster: ClusterState, catalog_provider: InstanceTypeProvider,
-                 actuator: Actuator, options: Optional[ProvisionerOptions] = None,
+                 actuator: Actuator, options: ProvisionerOptions | None = None,
                  factory=None, leader=None):
         self.cluster = cluster
         self.catalog_provider = catalog_provider
@@ -74,7 +74,7 @@ class Provisioner:
         # pods stay pending for the leader (ref controller-runtime leases,
         # controllers.go:37-41)
         self.leader = leader if leader is not None else (lambda: True)
-        self._catalog_cache: Dict[Tuple, CatalogArrays] = {}
+        self._catalog_cache: dict[tuple, CatalogArrays] = {}
         self._lock = threading.Lock()
         # serializes solve+actuate: the window batcher runs handlers on an
         # executor POOL, so back-to-back windows can overlap — two
@@ -85,8 +85,8 @@ class Provisioner:
         self._solve_lock = threading.Lock()
         # provider-wide type->(cpu,mem) fallback for pool-limit
         # accounting (claims whose type left the filtered catalog)
-        self._all_type_alloc: Optional[Dict[str, Tuple[int, int]]] = None
-        self._window: Optional[SolveWindow] = None
+        self._all_type_alloc: dict[str, tuple[int, int]] | None = None
+        self._window: SolveWindow | None = None
         self._unsubscribe = None
 
     # -- lifecycle ---------------------------------------------------------
@@ -167,7 +167,7 @@ class Provisioner:
 
     # -- synchronous entry (tests, repair loops, consolidation) ------------
 
-    def provision_once(self) -> List[Plan]:
+    def provision_once(self) -> list[Plan]:
         """Solve + actuate all currently-pending unnominated pods, grouped
         by NodePool.  Returns the executed plans.  Shares the solve lock
         with the window path so repair/consolidation loops can't
@@ -196,7 +196,7 @@ class Provisioner:
             # overlapping window's nomination only becomes visible once
             # its solve completes.
             seen = set()
-            to_solve: List[PodSpec] = []
+            to_solve: list[PodSpec] = []
             for p in pods:
                 key = pod_key(p)
                 if key in seen:
@@ -212,7 +212,7 @@ class Provisioner:
             _, nominated = self._provision(to_solve)
             return [nominated.get(pod_key(p)) for p in pods]
 
-    def _provision(self, pods: List[PodSpec]) -> Tuple[List[Plan], Dict[str, str]]:
+    def _provision(self, pods: list[PodSpec]) -> tuple[list[Plan], dict[str, str]]:
         """Two soft-taint passes over the pool ladder (kube's
         PreferNoSchedule semantics: 'prefer not to schedule, but
         allow'): pass 0 offers each pool only the pods that tolerate its
@@ -222,13 +222,13 @@ class Provisioner:
         rejection is unchanged (encode(); SURVEY §7.4 soft terms)."""
         from karpenter_tpu.apis.pod import tolerates_soft
 
-        plans: List[Plan] = []
-        nominated: Dict[str, str] = {}   # pod key -> claim name
+        plans: list[Plan] = []
+        nominated: dict[str, str] = {}   # pod key -> claim name
         # pods trimmed by a pool resource limit this window: the Warning
         # event is emitted only for those STILL unnominated at window
         # end (another pool may place them — an event then would be a
         # false alarm)
-        limit_dropped: Dict[str, str] = {}  # pod key -> pool name
+        limit_dropped: dict[str, str] = {}  # pod key -> pool name
         # pods a soft-tainted pool was denied in pass 0: ONLY these are
         # re-offered in pass 1 — re-running the whole ladder would
         # double every solve and re-issue failed creates within one
@@ -380,7 +380,7 @@ class Provisioner:
         return view
 
     def _apply_pool_limits(self, pool: NodePool, plan: Plan, catalog,
-                           usage) -> Tuple[Plan, List[str]]:
+                           usage) -> tuple[Plan, list[str]]:
         """Enforce NodePool resource limits (karpenter-core semantics the
         reference inherits upstream: capacity is never provisioned past
         `spec.limits`; the overflow's pods stay pending).  Plan nodes are
@@ -392,7 +392,7 @@ class Provisioner:
             return plan, []
         used_cpu, used_mem = usage
         keep = []
-        dropped: List[str] = []
+        dropped: list[str] = []
         for node in plan.nodes:
             alloc = catalog.offering_alloc()[node.offering_index] \
                 if 0 <= node.offering_index < catalog.num_offerings \
@@ -433,7 +433,7 @@ class Provisioner:
         if pending is not None:
             pending.nominated_node = node_name
 
-    def _pools(self) -> List[NodePool]:
+    def _pools(self) -> list[NodePool]:
         pools = self.cluster.list("nodepools")
         if not pools:
             pools = [NodePool(name=self.options.default_nodepool,
@@ -442,7 +442,7 @@ class Provisioner:
 
     MAX_CATALOG_CACHE = 16
 
-    def _catalog_for(self, nodeclass: NodeClass) -> Optional[CatalogArrays]:
+    def _catalog_for(self, nodeclass: NodeClass) -> CatalogArrays | None:
         """Per-NodeClass filtered catalog arrays.  Cached per (nodeclass
         spec, selected types) so multi-pool setups keep one entry each;
         blackout changes only re-derive the availability mask in place

@@ -11,7 +11,7 @@ from __future__ import annotations
 
 import time
 from dataclasses import dataclass
-from typing import Callable, List, Optional, Sequence
+from collections.abc import Callable, Sequence
 
 from karpenter_tpu.apis.pod import PodSpec
 from karpenter_tpu.utils.batcher import Batcher, BatcherOptions
@@ -37,14 +37,14 @@ class SolveWindow:
     reports (e.g. node name or None)."""
 
     def __init__(self, on_window: Callable[[Sequence[PodSpec]], Sequence[object]],
-                 options: Optional[WindowOptions] = None):
+                 options: WindowOptions | None = None):
         self.options = options or WindowOptions()
         self._batcher: Batcher = Batcher(on_window, self.options.to_batcher())
 
     def add(self, pod: PodSpec):
         return self._batcher.add(pod)
 
-    def add_all(self, pods: Sequence[PodSpec]) -> List:
+    def add_all(self, pods: Sequence[PodSpec]) -> list:
         return [self._batcher.add(p) for p in pods]
 
     def close(self) -> None:

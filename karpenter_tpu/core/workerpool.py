@@ -17,7 +17,6 @@ from __future__ import annotations
 import hashlib
 import re
 import time
-from typing import List, Optional, Tuple
 
 from karpenter_tpu.constants import CLAIM_FINALIZER
 from karpenter_tpu.apis.nodeclaim import NodeClaim, parse_provider_id, provider_id
@@ -58,8 +57,8 @@ def sanitize_pool_name(raw: str) -> str:
 
 class WorkerPoolActuator:
     def __init__(self, iks: FakeIKS, cluster: ClusterState,
-                 breaker: Optional[CircuitBreakerManager] = None,
-                 unavailable: Optional[UnavailableOfferings] = None):
+                 breaker: CircuitBreakerManager | None = None,
+                 unavailable: UnavailableOfferings | None = None):
         self.iks = iks
         self.cluster = cluster
         self.breaker = breaker or CircuitBreakerManager()
@@ -196,9 +195,9 @@ class WorkerPoolActuator:
 
     def execute_plan(self, plan: Plan, nodeclass: NodeClass,
                      catalog: CatalogArrays, nodepool_name: str = "default"
-                     ) -> Tuple[List[Optional[NodeClaim]], List[str]]:
-        claims: List[Optional[NodeClaim]] = []
-        errors: List[str] = []
+                     ) -> tuple[list[NodeClaim | None], list[str]]:
+        claims: list[NodeClaim | None] = []
+        errors: list[str] = []
         for planned in plan.nodes:
             try:
                 claims.append(self.create_node(planned, nodeclass, catalog,

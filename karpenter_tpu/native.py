@@ -12,7 +12,6 @@ import ctypes
 import os
 import subprocess
 import threading
-from typing import Optional
 
 import numpy as np
 
@@ -25,7 +24,7 @@ _NATIVE_DIR = os.path.join(_REPO_ROOT, "native")
 _LIB_PATH = os.path.join(_NATIVE_DIR, "build", "libffd.so")
 
 _lock = threading.Lock()
-_lib: Optional[ctypes.CDLL] = None
+_lib: ctypes.CDLL | None = None
 _load_failed = False
 
 _I32P = np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS")
@@ -33,7 +32,7 @@ _U8P = np.ctypeslib.ndpointer(np.uint8, flags="C_CONTIGUOUS")
 _F32P = np.ctypeslib.ndpointer(np.float32, flags="C_CONTIGUOUS")
 
 
-def load() -> Optional[ctypes.CDLL]:
+def load() -> ctypes.CDLL | None:
     """Load (building if needed) the native library; None if unavailable."""
     global _lib, _load_failed
     with _lock:

@@ -14,14 +14,77 @@ import os
 import threading
 import time
 from dataclasses import dataclass
-from typing import Callable, Mapping, Optional
-
-from cryptography.hazmat.primitives.ciphers.aead import AESGCM
+from collections.abc import Callable, Mapping
 
 from karpenter_tpu.cloud.errors import CloudError
 from karpenter_tpu.utils.logging import get_logger
 
 log = get_logger("operator.credentials")
+
+try:
+    from cryptography.hazmat.primitives.ciphers.aead import AESGCM
+except ImportError:                          # pragma: no cover - env-gated
+    AESGCM = None
+
+
+class _FallbackAEAD:
+    """In-memory scramble used only when ``cryptography`` is absent
+    (dev/test containers; production images install it and get real
+    AES-GCM).  HMAC-SHA256 counter-mode keystream + HMAC tag keeps the
+    store's at-rest posture — no plaintext in attributes, tampering
+    detected — under an ephemeral per-process key.  The key lives in the
+    same process memory as the blob either way, so both ciphers are
+    defense-in-depth against accidental dumps, not a confidentiality
+    boundary."""
+
+    def __init__(self, key: bytes):
+        self._key = key
+
+    @staticmethod
+    def generate_key(bit_length: int = 256) -> bytes:
+        return os.urandom(bit_length // 8)
+
+    def _keystream(self, nonce: bytes, n: int) -> bytes:
+        import hashlib
+        import hmac as _hmac
+
+        out = b""
+        counter = 0
+        while len(out) < n:
+            out += _hmac.new(self._key, nonce + counter.to_bytes(8, "big"),
+                             hashlib.sha256).digest()
+            counter += 1
+        return out[:n]
+
+    def _tag(self, nonce: bytes, ct: bytes) -> bytes:
+        import hashlib
+        import hmac as _hmac
+
+        return _hmac.new(self._key, b"tag" + nonce + ct,
+                         hashlib.sha256).digest()
+
+    def encrypt(self, nonce: bytes, data: bytes, aad) -> bytes:
+        ct = bytes(a ^ b for a, b in
+                   zip(data, self._keystream(nonce, len(data))))
+        return ct + self._tag(nonce, ct)
+
+    def decrypt(self, nonce: bytes, data: bytes, aad) -> bytes:
+        import hmac as _hmac
+
+        ct, tag = data[:-32], data[-32:]
+        if not _hmac.compare_digest(tag, self._tag(nonce, ct)):
+            raise ValueError("credential blob authentication failed")
+        return bytes(a ^ b for a, b in
+                     zip(ct, self._keystream(nonce, len(ct))))
+
+
+def _aead_factory():
+    if AESGCM is not None:
+        return AESGCM
+    log.warning("cryptography not installed; credential store using "
+                "stdlib HMAC-CTR fallback (install cryptography for "
+                "AES-GCM)")
+    return _FallbackAEAD
 
 
 @dataclass(frozen=True)
@@ -52,7 +115,7 @@ def resolve_region(env: Mapping[str, str]) -> str:
 class EnvCredentialProvider:
     """(ref credentials.go:283 env provider)"""
 
-    def __init__(self, env: Optional[Mapping[str, str]] = None):
+    def __init__(self, env: Mapping[str, str] | None = None):
         self.env = env
 
     def __call__(self) -> Credentials:
@@ -92,10 +155,11 @@ class CredentialStore:
         self._provider = provider
         self._ttl = ttl
         self._clock = clock
-        self._key = AESGCM.generate_key(bit_length=256)
-        self._gcm = AESGCM(self._key)
+        aead = _aead_factory()
+        self._key = aead.generate_key(bit_length=256)
+        self._gcm = aead(self._key)
         self._lock = threading.Lock()
-        self._blob: Optional[bytes] = None       # nonce || ciphertext
+        self._blob: bytes | None = None       # nonce || ciphertext
         self._fetched_at = -float("inf")
         self._region = ""                        # non-secret, kept plain
 

@@ -10,7 +10,6 @@ the provisioning loop.
 
 from __future__ import annotations
 
-from typing import List, Optional
 
 from karpenter_tpu.catalog.instancetype import InstanceTypeProvider
 from karpenter_tpu.catalog.pricing import PricingProvider
@@ -59,9 +58,9 @@ class Operator:
     a real deployment injects live clients with the same surface.
     """
 
-    def __init__(self, options: Optional[Options] = None, cloud=None,
+    def __init__(self, options: Options | None = None, cloud=None,
                  iks=None, lbs=None, credential_provider=None,
-                 cluster: Optional[ClusterState] = None):
+                 cluster: ClusterState | None = None):
         self.options = options or Options.from_env()
         errs = self.options.validate()
         if errs:
@@ -149,7 +148,7 @@ class Operator:
         self._warmup_stop = None
         self._started = False
 
-    def _build_controllers(self) -> List:
+    def _build_controllers(self) -> list:
         """The reference's registration list (controllers.go:117-259) with
         the same feature gates."""
         ctrls = [

@@ -13,7 +13,7 @@ from __future__ import annotations
 
 import os
 from dataclasses import dataclass, field
-from typing import List, Mapping, Optional
+from collections.abc import Mapping
 
 from karpenter_tpu.core.circuitbreaker import CircuitBreakerConfig
 from karpenter_tpu.core.window import WindowOptions
@@ -76,7 +76,7 @@ class Options:
     window: WindowOptions = field(default_factory=WindowOptions)
 
     @classmethod
-    def from_env(cls, env: Optional[Mapping[str, str]] = None) -> "Options":
+    def from_env(cls, env: Mapping[str, str] | None = None) -> "Options":
         env = os.environ if env is None else env
         solver = SolverOptions(
             backend=env.get("KARPENTER_SOLVER_BACKEND", "jax"),
@@ -116,9 +116,9 @@ class Options:
             circuit_breaker=CircuitBreakerConfig.from_env(env),
             solver=solver, window=window)
 
-    def validate(self) -> List[str]:
+    def validate(self) -> list[str]:
         """(ref options.go:250)"""
-        errs: List[str] = []
+        errs: list[str] = []
         if not self.region:
             errs.append("region is required (TPU_CLOUD_REGION)")
         if self.zone and self.region and not self.zone.startswith(self.region):

@@ -20,7 +20,7 @@ from __future__ import annotations
 import json
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
-from typing import Callable, Optional
+from collections.abc import Callable
 
 from karpenter_tpu.utils import metrics
 from karpenter_tpu.utils.logging import get_logger
@@ -83,7 +83,7 @@ class MetricsServer:
     API server refuses to call plaintext webhooks)."""
 
     def __init__(self, host: str = "0.0.0.0", port: int = 8080,
-                 ready_check: Optional[Callable[[], bool]] = None,
+                 ready_check: Callable[[], bool] | None = None,
                  tls_cert: str = "", tls_key: str = ""):
         self._ready = ready_check or (lambda: True)
         outer = self
@@ -165,7 +165,7 @@ class MetricsServer:
         else:
             self._server = ThreadingHTTPServer((host, port), Handler)
         self.port = self._server.server_address[1]
-        self._thread: Optional[threading.Thread] = None
+        self._thread: threading.Thread | None = None
 
     def start(self) -> "MetricsServer":
         self._thread = threading.Thread(target=self._server.serve_forever,

@@ -26,7 +26,6 @@ from __future__ import annotations
 
 import functools
 from dataclasses import dataclass
-from typing import Optional, Tuple
 
 import numpy as np
 
@@ -181,8 +180,8 @@ class CooCapacity:
 def fleet_solve_pallas(problem: FleetProblem, *, num_nodes: int,
                        right_size: bool = True, interpret: bool = False,
                        device_catalog=None, compact: int = 0,
-                       compact_cap: Optional[int] = None,
-                       coo_state: Optional[CooCapacity] = None,
+                       compact_cap: int | None = None,
+                       coo_state: CooCapacity | None = None,
                        packed_inputs=None, async_only: bool = False):
     """Single-dispatch fleet solve through the Mosaic fleet grid.
     ``device_catalog`` (from :func:`fleet_device_catalog`) keeps the
@@ -236,10 +235,30 @@ def fleet_solve_pallas(problem: FleetProblem, *, num_nodes: int,
     return finalize if async_only else finalize()
 
 
+@functools.lru_cache(maxsize=64)
+def _fleet_pallas_sharded_jit(mesh: Mesh, C_local: int, G: int, O: int,
+                              U: int, N: int, right_size: bool,
+                              interpret: bool, compact: int):
+    """Cached jit of the sharded pallas fleet grid: shard_map + jit were
+    previously rebuilt per solve call, so every window paid a fresh
+    trace + XLA compile (GL003).  Keyed on the mesh and every static
+    shape/option; COO escalation (`compact` growth) lands on its own
+    cache line."""
+    def inner(big_l, alloc8_l, rank_l, price_l):
+        return fleet_packed_pallas(
+            big_l, alloc8_l, rank_l, price_l,
+            C=C_local, G=G, O=O, U=U, N=N, right_size=right_size,
+            interpret=interpret, compact=compact)
+
+    spec = P(FLEET_AXIS)
+    return jax.jit(shard_map(inner, mesh=mesh, in_specs=(spec,) * 4,
+                             out_specs=spec, check_rep=False))
+
+
 def fleet_solve_pallas_sharded(problem: FleetProblem, mesh: Mesh, *,
                                num_nodes: int, right_size: bool = True,
                                interpret: bool = False, compact: int = 0,
-                               compact_cap: Optional[int] = None):
+                               compact_cap: int | None = None):
     """Fleet axis sharded over the mesh, each shard running the Mosaic
     fleet grid on its local clusters — the pallas fast path under
     shard_map (round 3 gap: only solve_core had a sharded variant).
@@ -258,18 +277,11 @@ def fleet_solve_pallas_sharded(problem: FleetProblem, mesh: Mesh, *,
     K = min(compact, G * N)
     K_cap = min(compact_cap if compact_cap is not None else compact, G * N)
 
-    spec = P(FLEET_AXIS)
     while True:
-        def inner(big_l, alloc8_l, rank_l, price_l, _K=K):
-            return fleet_packed_pallas(
-                big_l, alloc8_l, rank_l, price_l,
-                C=C // n, G=G, O=O, U=U_pad, N=N, right_size=right_size,
-                interpret=interpret, compact=_K)
-
-        f = shard_map(inner, mesh=mesh, in_specs=(spec,) * 4,
-                      out_specs=spec, check_rep=False)
-        out_np = np.asarray(jax.jit(f)(jnp.asarray(ins), alloc8_all,
-                                       rank_all, price_all))
+        f = _fleet_pallas_sharded_jit(mesh, C // n, G, O, U_pad, N,
+                                      right_size, interpret, K)
+        out_np = np.asarray(f(jnp.asarray(ins), alloc8_all,
+                              rank_all, price_all))
         if K > 0 and K < K_cap and any(
                 coo_buffer_full(out_np[c], G, N, K) for c in range(C)):
             K = grow_coo(K, K_cap)
@@ -284,17 +296,23 @@ def fleet_solve(problem: FleetProblem, mesh: Mesh, *, num_nodes: int,
     C must be divisible by the fleet-axis size.  Returns stacked
     (node_off [C,N], assign [C,G,N], unplaced [C,G], cost [C]).
     """
+    f = _fleet_solve_jit(mesh, num_nodes, right_size)
+    out = f(problem.group_req, problem.group_count, problem.group_cap,
+            problem.compat, problem.off_alloc, problem.off_price,
+            problem.off_rank)
+    return tuple(np.asarray(o) for o in out)
+
+
+@functools.lru_cache(maxsize=64)
+def _fleet_solve_jit(mesh: Mesh, num_nodes: int, right_size: bool):
+    """Cached jit of the fleet-axis vmapped solve (per-call shard_map +
+    jit rebuild recompiled every invocation — GL003)."""
     vsolve = jax.vmap(functools.partial(
         solve_core, num_nodes=num_nodes, right_size=right_size))
-
     spec = P(FLEET_AXIS)
-    f = shard_map(vsolve, mesh=mesh,
-                  in_specs=(spec,) * 7, out_specs=(spec,) * 4,
-                  check_rep=False)
-    out = jax.jit(f)(problem.group_req, problem.group_count, problem.group_cap,
-                     problem.compat, problem.off_alloc, problem.off_price,
-                     problem.off_rank)
-    return tuple(np.asarray(o) for o in out)
+    return jax.jit(shard_map(vsolve, mesh=mesh,
+                             in_specs=(spec,) * 7, out_specs=(spec,) * 4,
+                             check_rep=False))
 
 
 # ---------------------------------------------------------------------------
@@ -453,6 +471,18 @@ def fleet_solve_sharded_offerings(problem: FleetProblem, mesh: Mesh, *,
     if O % n_offer:
         raise ValueError(f"offerings {O} not divisible by offer axis {n_offer}")
 
+    f = _fleet_sharded_offerings_jit(mesh, num_nodes, right_size)
+    out = f(problem.group_req, problem.group_count, problem.group_cap,
+            problem.compat, problem.off_alloc, problem.off_price,
+            problem.off_rank)
+    return tuple(np.asarray(o) for o in out)
+
+
+@functools.lru_cache(maxsize=64)
+def _fleet_sharded_offerings_jit(mesh: Mesh, num_nodes: int,
+                                 right_size: bool):
+    """Cached jit of the 2D (fleet x offer) sharded solve (per-call
+    shard_map + jit rebuild recompiled every invocation — GL003)."""
     vsolve = jax.vmap(functools.partial(
         sharded_solve_core, OFFER_AXIS, num_nodes=num_nodes,
         right_size=right_size))
@@ -465,9 +495,5 @@ def fleet_solve_sharded_offerings(problem: FleetProblem, mesh: Mesh, *,
         P(FLEET_AXIS, OFFER_AXIS),           # off_rank [C, O]
     )
     out_specs = (P(FLEET_AXIS), P(FLEET_AXIS), P(FLEET_AXIS), P(FLEET_AXIS))
-    f = shard_map(vsolve, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
-                  check_rep=False)
-    out = jax.jit(f)(problem.group_req, problem.group_count, problem.group_cap,
-                     problem.compat, problem.off_alloc, problem.off_price,
-                     problem.off_rank)
-    return tuple(np.asarray(o) for o in out)
+    return jax.jit(shard_map(vsolve, mesh=mesh, in_specs=in_specs,
+                             out_specs=out_specs, check_rep=False))

@@ -9,7 +9,7 @@ pjit/shard_map.
 
 from __future__ import annotations
 
-from typing import Optional, Sequence
+from collections.abc import Sequence
 
 import numpy as np
 
@@ -20,8 +20,8 @@ FLEET_AXIS = "fleet"
 OFFER_AXIS = "offer"
 
 
-def fleet_mesh(n_devices: Optional[int] = None,
-               devices: Optional[Sequence] = None) -> Mesh:
+def fleet_mesh(n_devices: int | None = None,
+               devices: Sequence | None = None) -> Mesh:
     """1D mesh over clusters (the v5e-8 fleet config of BASELINE.json #5)."""
     if devices is None:
         devices = jax.devices()
@@ -29,7 +29,7 @@ def fleet_mesh(n_devices: Optional[int] = None,
     return Mesh(np.array(devices), (FLEET_AXIS,))
 
 
-def solver_mesh(fleet: int, offer: int, devices: Optional[Sequence] = None) -> Mesh:
+def solver_mesh(fleet: int, offer: int, devices: Sequence | None = None) -> Mesh:
     """2D mesh: fleet (cluster data-parallel) x offer (catalog
     model-parallel)."""
     devices = list(devices) if devices is not None else jax.devices()

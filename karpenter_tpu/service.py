@@ -32,7 +32,6 @@ import io
 import threading
 import time
 from concurrent import futures
-from typing import Dict, Optional, Tuple
 
 import numpy as np
 
@@ -55,7 +54,7 @@ def _pack(**arrays) -> bytes:
     return buf.getvalue()
 
 
-def _unpack(data: bytes) -> Dict[str, np.ndarray]:
+def _unpack(data: bytes) -> dict[str, np.ndarray]:
     return dict(np.load(io.BytesIO(data), allow_pickle=False))
 
 
@@ -97,14 +96,14 @@ class SolverServer:
     there between solves, keyed by (catalog_id, generation)."""
 
     def __init__(self, host: str = "127.0.0.1", port: int = 0,
-                 options: Optional[SolverOptions] = None):
+                 options: SolverOptions | None = None):
         import grpc
 
         from karpenter_tpu.solver.jax_backend import JaxSolver
 
         self.options = options or SolverOptions(backend="jax")
         self._jax = JaxSolver(self.options)
-        self._catalogs: Dict[Tuple[str, int], _UploadedCatalog] = {}
+        self._catalogs: dict[tuple[str, int], _UploadedCatalog] = {}
         self._lock = threading.Lock()
         # JaxSolver's device-catalog dict / failed-shape set / last_stats
         # are not thread-safe, and the device serializes solves anyway —
@@ -385,7 +384,7 @@ class RemoteSolver:
     """Drop-in solver backend speaking to a :class:`SolverServer`."""
 
     def __init__(self, address: str,
-                 options: Optional[SolverOptions] = None):
+                 options: SolverOptions | None = None):
         import grpc
 
         self.options = options or SolverOptions(backend="remote")
@@ -399,7 +398,7 @@ class RemoteSolver:
         self._upload = self._channel.unary_unary(
             f"/{_SERVICE}/UploadCatalog", request_serializer=_identity,
             response_deserializer=_identity)
-        self._uploaded: Dict[str, int] = {}
+        self._uploaded: dict[str, int] = {}
 
     def close(self) -> None:
         self._channel.close()
@@ -588,7 +587,7 @@ class RemoteSolver:
     # -- internals ---------------------------------------------------------
 
     @staticmethod
-    def _catalog_key(catalog) -> Tuple[str, int]:
+    def _catalog_key(catalog) -> tuple[str, int]:
         return (f"{catalog.uid}", hash(
             (catalog.generation, catalog.availability_generation)) & 0x7fffffff)
 

@@ -34,7 +34,7 @@ from __future__ import annotations
 
 from dataclasses import dataclass, field
 from operator import attrgetter
-from typing import Dict, List, Optional, Sequence, Tuple
+from collections.abc import Sequence
 
 import numpy as np
 
@@ -56,14 +56,14 @@ BIG_CAP = 1 << 30  # "no per-node cap"
 @dataclass
 class PodGroup:
     representative: PodSpec
-    pod_names: List[str]           # canonical 'namespace/name' keys
+    pod_names: list[str]           # canonical 'namespace/name' keys
     count: int
     requirements: Requirements
     cap_per_node: int = BIG_CAP
-    pinned_zone: Optional[str] = None
-    spread_origin: Optional[Tuple] = None   # signature of the pre-split group
-    nozone_mask: Optional[np.ndarray] = None  # bool [O], computed once in encode
-    label_mask: Optional[np.ndarray] = None   # bool [O], nozone WITHOUT the
+    pinned_zone: str | None = None
+    spread_origin: tuple | None = None   # signature of the pre-split group
+    nozone_mask: np.ndarray | None = None  # bool [O], computed once in encode
+    label_mask: np.ndarray | None = None   # bool [O], nozone WITHOUT the
                                               # resource-fit term (device
                                               # recomputes fit from group_req)
 
@@ -87,15 +87,15 @@ class EncodedProblem:
                  "pref_rows", "pref_idx", "_compat", "_names_idx",
                  "_prep_cache")
 
-    def __init__(self, groups: List[PodGroup], group_req: np.ndarray,
+    def __init__(self, groups: list[PodGroup], group_req: np.ndarray,
                  group_count: np.ndarray, group_cap: np.ndarray,
-                 compat: Optional[np.ndarray] = None,
-                 catalog: Optional[CatalogArrays] = None,
-                 rejected: Optional[List[str]] = None,
-                 label_rows: Optional[np.ndarray] = None,
-                 label_idx: Optional[np.ndarray] = None,
-                 pref_rows: Optional[np.ndarray] = None,
-                 pref_idx: Optional[np.ndarray] = None):
+                 compat: np.ndarray | None = None,
+                 catalog: CatalogArrays | None = None,
+                 rejected: list[str] | None = None,
+                 label_rows: np.ndarray | None = None,
+                 label_idx: np.ndarray | None = None,
+                 pref_rows: np.ndarray | None = None,
+                 pref_idx: np.ndarray | None = None):
         self.groups = groups
         self.group_req = group_req
         self.group_count = group_count
@@ -156,14 +156,14 @@ class EncodedProblem:
         return int(self.group_count.sum()) + len(self.rejected)
 
 
-def _split_counts(total: int, ways: int) -> List[int]:
+def _split_counts(total: int, ways: int) -> list[int]:
     """Split ``total`` into ``ways`` parts as evenly as possible."""
     base, rem = divmod(total, ways)
     return [base + (1 if i < rem else 0) for i in range(ways)]
 
 
-def _allowed_mask(reqs: Requirements, key: str, vocab: List[str],
-                  cache: Optional[Dict] = None) -> np.ndarray:
+def _allowed_mask(reqs: Requirements, key: str, vocab: list[str],
+                  cache: dict | None = None) -> np.ndarray:
     """bool [len(vocab)] — which vocabulary values every requirement on
     ``key`` admits.  With ``cache``, masks are shared across groups whose
     requirements on ``key`` are identical (the common case: none)."""
@@ -203,7 +203,7 @@ _LABEL_KEYS = (LABEL_INSTANCE_TYPE, LABEL_ARCH, LABEL_INSTANCE_FAMILY,
 
 
 def _label_compat(reqs: Requirements, catalog: CatalogArrays,
-                  cache: Optional[Dict] = None) -> np.ndarray:
+                  cache: dict | None = None) -> np.ndarray:
     """bool [O]: the LABEL part of offering feasibility (zone-independent):
     type/arch/family/size/capacity-type masks and availability — no
     resource-fit term (the device recomputes fit from group_req, so only
@@ -244,7 +244,7 @@ SOFT_SPREAD_WEIGHT = 100
 
 
 def _req_offering_mask(r, catalog: CatalogArrays,
-                       cache: Optional[Dict] = None) -> Optional[np.ndarray]:
+                       cache: dict | None = None) -> np.ndarray | None:
     """bool [O]: offerings satisfying ONE requirement, for preference
     scoring.  Keys the catalog cannot express return None (constant over
     offerings — irrelevant to ranking within a solve)."""
@@ -271,7 +271,7 @@ def _req_offering_mask(r, catalog: CatalogArrays,
 
 
 def _lower_preferred(preferred, catalog: CatalogArrays,
-                     cache: Optional[Dict] = None):
+                     cache: dict | None = None):
     """(terms, total_weight) where terms = [(weight, satisfied_mask)] —
     the per-signature half of the preference penalty; the per-subgroup
     soft-spread term joins in :func:`_pref_miss_row`."""
@@ -286,8 +286,8 @@ def _lower_preferred(preferred, catalog: CatalogArrays,
     return terms, total
 
 
-def _pref_miss_row(terms, total_w: int, soft_zone: Optional[str],
-                   catalog: CatalogArrays) -> Optional[np.ndarray]:
+def _pref_miss_row(terms, total_w: int, soft_zone: str | None,
+                   catalog: CatalogArrays) -> np.ndarray | None:
     """float32 [O] in [0,1]: weighted fraction of UNSATISFIED preference
     terms per offering (0 = fully preferred).  None when the group has
     no scoreable preferences."""
@@ -317,15 +317,15 @@ def _fit_mask(req_vec, catalog: CatalogArrays) -> np.ndarray:
 
 
 def _nozone_compat(reqs: Requirements, req_vec, catalog: CatalogArrays,
-                   cache: Optional[Dict] = None) -> np.ndarray:
+                   cache: dict | None = None) -> np.ndarray:
     """bool [O]: offering feasibility for a group ignoring the zone axis —
     label masks, availability, and empty-node resource fit."""
     return _label_compat(reqs, catalog, cache) & _fit_mask(req_vec, catalog)
 
 
 def viable_zones(reqs: Requirements, req_vec, catalog: CatalogArrays,
-                 nozone: Optional[np.ndarray] = None,
-                 cache: Optional[Dict] = None) -> List[str]:
+                 nozone: np.ndarray | None = None,
+                 cache: dict | None = None) -> list[str]:
     """Zones (within the requirement-allowed set) where the group has at
     least one available, resource-fitting offering.  Spread subgroups are
     only pinned to viable zones — pinning to a dead zone would strand pods
@@ -346,13 +346,13 @@ _DEFAULT_POOL = NodePool(name="default")
 # generation so availability changes invalidate it.  The provisioner
 # re-encodes the same pending set every window; this skips the per-group
 # mask construction entirely on repeats.
-_SIG_LOWER_CACHE: Dict[Tuple, Tuple] = {}
+_SIG_LOWER_CACHE: dict[tuple, tuple] = {}
 # cap on distinct catalog generations kept in the sig-lowering cache: a
 # flat namespace cleared on any generation change gives ZERO reuse when
 # catalogs alternate in one process (multi-NodeClass pools; pool-limit
 # views) — instead stale generations are evicted only past this bound
 _SIG_CACHE_MAX_GENS = 8
-_SIG_CACHE_GENS: List[Tuple] = []   # insertion-ordered live generations
+_SIG_CACHE_GENS: list[tuple] = []   # insertion-ordered live generations
 
 
 def clear_sig_cache() -> None:
@@ -361,7 +361,7 @@ def clear_sig_cache() -> None:
     _SIG_CACHE_GENS.clear()
 
 
-def _sig_cache_admit(gen_key: Tuple) -> None:
+def _sig_cache_admit(gen_key: tuple) -> None:
     """Track ``gen_key`` as live (LRU).  A NEW generation of a uid
     evicts that uid's older generations immediately — generations are
     monotonic per catalog, so their entries can never be hit again and
@@ -396,14 +396,14 @@ def _sig_cache_admit(gen_key: Tuple) -> None:
 # stores (token tuple, problem) so hits are equality-verified.  Entries
 # are immutable by convention (no caller mutates an EncodedProblem —
 # zonesplit derives via .replace()).
-_ENCODE_MEMO: Dict[Tuple, Tuple[Tuple, EncodedProblem]] = {}
+_ENCODE_MEMO: dict[tuple, tuple[tuple, EncodedProblem]] = {}
 _ENCODE_MEMO_MAX = 8
 
 
 _FPT_GETTER = attrgetter("_fpt")
 
 
-def _pods_fingerprint(pods: Sequence[PodSpec]) -> Tuple:
+def _pods_fingerprint(pods: Sequence[PodSpec]) -> tuple:
     """Order-sensitive identity of a solve window: pod key + interned
     constraint-signature id per pod, memoized as one `_fpt` attribute on
     the frozen PodSpec so the steady-state cost is a single C-level
@@ -418,7 +418,7 @@ def _pods_fingerprint(pods: Sequence[PodSpec]) -> Tuple:
         return tuple(_fp_token(p) for p in pods)
 
 
-def _pool_signature(pool: NodePool) -> Tuple:
+def _pool_signature(pool: NodePool) -> tuple:
     """Content identity of a NodePool for the encode memo: every field
     that influences lowering (taint rejection, requirement merging,
     static-label satisfaction).  The production provisioner passes a
@@ -430,8 +430,8 @@ def _pool_signature(pool: NodePool) -> Tuple:
 
 
 def encode(pods: Sequence[PodSpec], catalog: CatalogArrays,
-           nodepool: Optional[NodePool] = None,
-           zone_overrides: Optional[Dict[int, str]] = None) -> EncodedProblem:
+           nodepool: NodePool | None = None,
+           zone_overrides: dict[int, str] | None = None) -> EncodedProblem:
     """Group, split, and lower the scheduling problem to dense tensors.
 
     ``zone_overrides`` maps a signature id -> forced pinned zone for its
@@ -467,12 +467,12 @@ def encode(pods: Sequence[PodSpec], catalog: CatalogArrays,
 
 def _encode_impl(pods: Sequence[PodSpec], catalog: CatalogArrays,
                  nodepool: NodePool,
-                 zone_overrides: Dict[int, str]) -> EncodedProblem:
+                 zone_overrides: dict[int, str]) -> EncodedProblem:
     pool_labels = dict(nodepool.labels)
 
     # 1. Reject pods that cannot run in this pool at all (taints).
-    rejected: List[str] = []
-    eligible: List[PodSpec] = []
+    rejected: list[str] = []
+    eligible: list[PodSpec] = []
     for pod in pods:
         if nodepool.taints and not tolerates_all(pod.tolerations, nodepool.taints):
             rejected.append(pod_key(pod))
@@ -481,7 +481,7 @@ def _encode_impl(pods: Sequence[PodSpec], catalog: CatalogArrays,
 
     # 2. Group by constraint signature (interned int ids: no tuple
     # re-hashing at 10k pods).
-    by_sig: Dict[int, List[PodSpec]] = {}
+    by_sig: dict[int, list[PodSpec]] = {}
     for pod in eligible:
         by_sig.setdefault(pod.signature_id(), []).append(pod)
 
@@ -494,18 +494,18 @@ def _encode_impl(pods: Sequence[PodSpec], catalog: CatalogArrays,
     # hundreds of ms instead of seconds.
     known_keys = {LABEL_INSTANCE_TYPE, LABEL_ARCH, LABEL_INSTANCE_FAMILY,
                   LABEL_INSTANCE_SIZE, LABEL_ZONE, LABEL_CAPACITY_TYPE}
-    mask_cache: Dict = {}
-    groups: List[PodGroup] = []
-    g_req: List[Tuple[int, ...]] = []      # per-group scalar columns,
-    g_count: List[int] = []                # assembled vectorized below
-    g_cap: List[int] = []
-    g_label: List[int] = []
-    g_pref: List[int] = []                 # index into pref row set; -1 = none
-    g_name: List[str] = []
-    row_keys: Dict[Tuple, int] = {}
-    rows: List[np.ndarray] = []
-    pref_row_keys: Dict[bytes, int] = {}
-    pref_rows_l: List[np.ndarray] = []
+    mask_cache: dict = {}
+    groups: list[PodGroup] = []
+    g_req: list[tuple[int, ...]] = []      # per-group scalar columns,
+    g_count: list[int] = []                # assembled vectorized below
+    g_cap: list[int] = []
+    g_label: list[int] = []
+    g_pref: list[int] = []                 # index into pref row set; -1 = none
+    g_name: list[str] = []
+    row_keys: dict[tuple, int] = {}
+    rows: list[np.ndarray] = []
+    pref_row_keys: dict[bytes, int] = {}
+    pref_rows_l: list[np.ndarray] = []
 
     def pref_for(terms, total_w, soft_zone) -> int:
         row = _pref_miss_row(terms, total_w, soft_zone, catalog)
@@ -785,7 +785,7 @@ def decode_plan_entries(problem: EncodedProblem, node_off: np.ndarray,
     keep = (gis < G) & (node_off[ns] >= 0) & (cnts > 0)
     if not keep.all():
         gis, ns, cnts = gis[keep], ns[keep], cnts[keep]
-    per_node: Dict[int, List[str]] = {}
+    per_node: dict[int, list[str]] = {}
     if gis.size:
         # per-group exclusive cumsum = each entry's start offset into its
         # group's pod_names; entries must be gi-major with node-ascending
@@ -850,12 +850,12 @@ def decode_plan_entries(problem: EncodedProblem, node_off: np.ndarray,
     get = per_node.get
     in_range_l = in_range.tolist()
     offs_l = offs.tolist()
-    nodes: List = [
+    nodes: list = [
         PlannedNode(it, z, ct, pr if ok else 0.0, get(n, []), off)
         for n, off, it, z, ct, pr, ok in zip(
             open_idx.tolist(), offs_l, itypes, zones, captypes, prices,
             in_range_l)]
-    unplaced_names: List[str] = list(problem.rejected)
+    unplaced_names: list[str] = list(problem.rejected)
     miss = np.asarray(unplaced[:G])
     for gi in np.nonzero(miss > 0)[0].tolist():
         g = groups[gi]
@@ -865,7 +865,7 @@ def decode_plan_entries(problem: EncodedProblem, node_off: np.ndarray,
                 total_cost_per_hour=float(cost), backend=backend)
 
 
-def _best_zone_for(pod: PodSpec, reqs: Requirements, zones: List[str],
+def _best_zone_for(pod: PodSpec, reqs: Requirements, zones: list[str],
                    catalog: CatalogArrays) -> str:
     """Zone with the most offering capacity compatible with the pod."""
     req = np.asarray(pod.requests.as_tuple(), dtype=np.int64)

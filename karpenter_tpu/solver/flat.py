@@ -53,7 +53,6 @@ from __future__ import annotations
 
 import functools
 import time
-from typing import Optional, Tuple
 
 import numpy as np
 
@@ -538,8 +537,8 @@ def _flat_template(solver, problem: EncodedProblem):
 
 
 def dispatch_flat(solver, problem: EncodedProblem,
-                  pref_lambda: Optional[float] = None
-                  ) -> Optional[FlatAttempt]:
+                  pref_lambda: float | None = None
+                  ) -> FlatAttempt | None:
     """Issue the flat kernel and start the async result copy; returns
     None when the problem turns out unsuitable after all (caller falls
     back to the scan path).  ``pref_lambda`` overrides the solver
@@ -676,7 +675,7 @@ def flat_compute_handle(solver, problem: EncodedProblem):
     return run
 
 
-def solve_flat(solver, problem: EncodedProblem) -> Optional[Plan]:
+def solve_flat(solver, problem: EncodedProblem) -> Plan | None:
     """Synchronous flat solve: dispatch + finalize in one call."""
     a = dispatch_flat(solver, problem)
     if a is None:

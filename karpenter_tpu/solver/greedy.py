@@ -25,7 +25,6 @@ This is also the "Go FFD loop" stand-in for BASELINE.md's >=20x comparison
 from __future__ import annotations
 
 import time
-from typing import List, Optional
 
 import numpy as np
 
@@ -93,7 +92,7 @@ def solve_per_pod_native(problem: EncodedProblem, expanded=None,
 
 
 class GreedySolver:
-    def __init__(self, options: Optional[SolverOptions] = None):
+    def __init__(self, options: SolverOptions | None = None):
         self.options = options or SolverOptions(backend="greedy")
 
     def solve(self, request: SolveRequest) -> Plan:
@@ -118,7 +117,7 @@ class GreedySolver:
                 return plan
         return self._solve_python(problem)
 
-    def _solve_native(self, problem: EncodedProblem) -> Optional[Plan]:
+    def _solve_native(self, problem: EncodedProblem) -> Plan | None:
         """Per-pod FFD in C++ (native/ffd.cpp) — same plan as the python
         path, at Go-loop speeds; None when the library is unavailable."""
         from karpenter_tpu.solver.encode import decode_plan
@@ -155,11 +154,11 @@ class GreedySolver:
         off_rank = catalog.offering_rank_price().astype(np.float64)
         max_nodes = self.options.max_nodes
 
-        node_offering: List[int] = []
-        node_resid: List[np.ndarray] = []
-        node_pods: List[List[str]] = []
+        node_offering: list[int] = []
+        node_resid: list[np.ndarray] = []
+        node_pods: list[list[str]] = []
 
-        unplaced: List[str] = list(problem.rejected)
+        unplaced: list[str] = list(problem.rejected)
 
         for gi, group in enumerate(problem.groups):
             req = problem.group_req[gi].astype(np.int64)

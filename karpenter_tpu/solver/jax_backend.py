@@ -30,7 +30,6 @@ from __future__ import annotations
 
 import functools
 import time
-from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
@@ -304,7 +303,7 @@ def expand_coo_assign(idx: np.ndarray, cnt: np.ndarray,
 #   rest          COO idx[K] + cnt[K] when compact=K, else dense assign [G*N]
 # ---------------------------------------------------------------------------
 
-def dedup_rows(compat) -> Tuple[np.ndarray, np.ndarray]:
+def dedup_rows(compat) -> tuple[np.ndarray, np.ndarray]:
     """Factor a raw [G, O] mask into (label_idx [G] int32, rows [U, O]
     bool) with U distinct rows — the fallback when the encoder's own
     factoring is unavailable (sidecar wire arrays, stacked fleet
@@ -802,14 +801,14 @@ class JaxSolver:
     """Pads, uploads, solves, decodes.  Catalog tensors are kept
     device-resident keyed by (catalog generation, availability generation)."""
 
-    def __init__(self, options: Optional[SolverOptions] = None):
+    def __init__(self, options: SolverOptions | None = None):
         self.options = options or SolverOptions(backend="jax")
-        self._device_catalog: Dict[Tuple, Tuple] = {}
+        self._device_catalog: dict[tuple, tuple] = {}
         # per-solve observability: kernel path, dispatch vs exec+fetch
         # split, payload bytes.  Pure chip time is NOT separable on the
         # solve path (a sync before the fetch would cost a tunnel round
         # trip) — compute_handle measures it out-of-band.
-        self.last_stats: Dict[str, object] = {}
+        self.last_stats: dict[str, object] = {}
         # per-shape pallas breaker: one pathological (G,O,N) bucket must
         # not disable the fast path for buckets that compile fine
         self._pallas_failed_shapes: set = set()
@@ -817,7 +816,7 @@ class JaxSolver:
         # overflow retry persists, so later windows of an nnz-heavy
         # workload start at the grown size instead of re-paying the
         # double dispatch every solve
-        self._coo_floor: Dict[int, int] = {}
+        self._coo_floor: dict[int, int] = {}
 
     # -- public ------------------------------------------------------------
 
@@ -1058,8 +1057,8 @@ class JaxSolver:
                          right_size=right_size, pref_rows=pref_rows,
                          pref_idx=pref_idx, pref_lambda=pref_lambda)
 
-    def solve_encoded_batch(self, problems: List[EncodedProblem]
-                            ) -> List[Plan]:
+    def solve_encoded_batch(self, problems: list[EncodedProblem]
+                            ) -> list[Plan]:
         """Solve C problems sharing one catalog in ONE dispatch and ONE
         fetch (zonesplit's candidate evaluation: each problem is the base
         with one compat row re-pinned).  Falls back to per-problem solves
@@ -1183,7 +1182,7 @@ class JaxSolver:
         return run
 
     def _prepare(self, problem: EncodedProblem,
-                 u_pad: Optional[int] = None) -> "_Prepared":
+                 u_pad: int | None = None) -> "_Prepared":
         """Pad, choose shapes, and pack the single H2D buffer; the result
         is a CLONE of a per-problem cached template (EncodedProblems are
         immutable by convention, so the packed buffer of an unchanged
@@ -1210,7 +1209,7 @@ class JaxSolver:
         return tmpl.clone()
 
     def _prepare_impl(self, problem: EncodedProblem,
-                      u_pad: Optional[int] = None) -> "_Prepared":
+                      u_pad: int | None = None) -> "_Prepared":
         catalog = problem.catalog
         G = problem.num_groups
         O = catalog.num_offerings
@@ -1327,7 +1326,7 @@ class JaxSolver:
             compact=prep.K, dense16=prep.dense16, coo16=prep.coo16)
         return out, "scan"
 
-    def _compact_k(self, total_pods: int, G_pad: int) -> Tuple[int, int]:
+    def _compact_k(self, total_pods: int, G_pad: int) -> tuple[int, int]:
         """(initial, cap) COO capacity for the compacted assign fetch;
         (0, 0) = dense fetch.  nnz <= placed pods bounds the CAP, but
         real solves land far below it (nnz ~ open nodes x groups-per-
@@ -1608,7 +1607,7 @@ class BatchPendingSolve:
         self._fut = _prefetch(self._dev)
         self._t_issued = time.perf_counter()
 
-    def results(self) -> List[Plan]:
+    def results(self) -> list[Plan]:
         if self._done is not None:
             return self._done
         from karpenter_tpu.solver.encode import (
@@ -1695,7 +1694,7 @@ def _pad1(a: np.ndarray, n: int) -> np.ndarray:
     return out
 
 
-def _pad2(a: np.ndarray, n0: int, n1: Optional[int] = None) -> np.ndarray:
+def _pad2(a: np.ndarray, n0: int, n1: int | None = None) -> np.ndarray:
     n1 = a.shape[1] if n1 is None else n1
     if a.shape == (n0, n1):
         return a

@@ -10,7 +10,7 @@ Stateless, deterministic, seedable; all durable state lives outside
 from __future__ import annotations
 
 from dataclasses import dataclass, field
-from typing import Dict, List, Optional, Sequence, Tuple
+from collections.abc import Sequence
 
 from karpenter_tpu.apis.nodeclaim import NodePool
 from karpenter_tpu.apis.pod import PodSpec
@@ -60,9 +60,9 @@ class SolverOptions:
 
 @dataclass
 class SolveRequest:
-    pods: List[PodSpec]
+    pods: list[PodSpec]
     catalog: CatalogArrays
-    nodepool: Optional[NodePool] = None
+    nodepool: NodePool | None = None
 
 
 @dataclass(slots=True)
@@ -73,7 +73,7 @@ class PlannedNode:
     zone: str
     capacity_type: str
     price: float
-    pod_names: List[str] = field(default_factory=list)
+    pod_names: list[str] = field(default_factory=list)
     offering_index: int = -1
 
     @property
@@ -85,8 +85,8 @@ class PlannedNode:
 class Plan:
     """Placement result: nodes to create + pod assignment + leftovers."""
 
-    nodes: List[PlannedNode] = field(default_factory=list)
-    unplaced_pods: List[str] = field(default_factory=list)
+    nodes: list[PlannedNode] = field(default_factory=list)
+    unplaced_pods: list[str] = field(default_factory=list)
     total_cost_per_hour: float = 0.0
     backend: str = ""
     solve_seconds: float = 0.0
@@ -95,7 +95,7 @@ class Plan:
     def placed_count(self) -> int:
         return sum(n.pod_count for n in self.nodes)
 
-    def summary(self) -> Dict[str, object]:
+    def summary(self) -> dict[str, object]:
         return {
             "nodes": len(self.nodes),
             "placed": self.placed_count,

@@ -19,7 +19,7 @@ with either solver backend:
 from __future__ import annotations
 
 from collections import defaultdict
-from typing import Dict, List, Optional, Sequence
+from collections.abc import Sequence
 
 from karpenter_tpu.apis.nodeclaim import NodePool
 from karpenter_tpu.apis.pod import PodSpec, pod_key, tolerates_all
@@ -32,14 +32,14 @@ from karpenter_tpu.solver.types import Plan
 
 
 def validate_plan(plan: Plan, pods: Sequence[PodSpec], catalog: CatalogArrays,
-                  nodepool: Optional[NodePool] = None) -> List[str]:
+                  nodepool: NodePool | None = None) -> list[str]:
     """Returns a list of violations (empty = feasible)."""
     nodepool = nodepool or NodePool(name="default")
-    errors: List[str] = []
-    by_name: Dict[str, PodSpec] = {pod_key(p): p for p in pods}
+    errors: list[str] = []
+    by_name: dict[str, PodSpec] = {pod_key(p): p for p in pods}
 
     # 1. assignment is a partition
-    seen: Dict[str, str] = {}
+    seen: dict[str, str] = {}
     for ni, node in enumerate(plan.nodes):
         for pn in node.pod_names:
             if pn in seen:
@@ -90,7 +90,7 @@ def validate_plan(plan: Plan, pods: Sequence[PodSpec], catalog: CatalogArrays,
 
     # 3. anti-affinity: <=1 self-anti pod of the same signature per node
     for ni, node in enumerate(plan.nodes):
-        sig_count: Dict[tuple, int] = defaultdict(int)
+        sig_count: dict[tuple, int] = defaultdict(int)
         for pn in node.pod_names:
             pod = by_name.get(pn)
             if pod is not None and _has_hostname_anti_affinity(pod):
@@ -100,11 +100,11 @@ def validate_plan(plan: Plan, pods: Sequence[PodSpec], catalog: CatalogArrays,
                 errors.append(f"node{ni}: {c} anti-affinity pods of one group")
 
     # 4. zone affinity + topology spread, per original signature group
-    pod_zone: Dict[str, str] = {}
+    pod_zone: dict[str, str] = {}
     for node in plan.nodes:
         for pn in node.pod_names:
             pod_zone[pn] = node.zone
-    groups: Dict[tuple, List[PodSpec]] = defaultdict(list)
+    groups: dict[tuple, list[PodSpec]] = defaultdict(list)
     for p in pods:
         groups[p.constraint_signature()].append(p)
     for sig, members in groups.items():

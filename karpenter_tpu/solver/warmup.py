@@ -23,7 +23,7 @@ shapes & recompilation").
 from __future__ import annotations
 
 import os
-from typing import Optional, Sequence, Tuple
+from collections.abc import Sequence
 
 import numpy as np
 
@@ -32,7 +32,7 @@ from karpenter_tpu.utils.logging import get_logger
 log = get_logger("solver.warmup")
 
 
-def enable_persistent_compile_cache(path: Optional[str] = None) -> Optional[str]:
+def enable_persistent_compile_cache(path: str | None = None) -> str | None:
     """Point JAX's persistent compilation cache at ``path`` (or
     ``$KARPENTER_TPU_COMPILE_CACHE``).  Returns the directory in use, or
     None when disabled.  Thresholds are zeroed so even small executables
@@ -59,7 +59,7 @@ def enable_persistent_compile_cache(path: Optional[str] = None) -> Optional[str]
 # (G_pad, U_pad, N, expected_pods) combos covering the common ladder:
 # small windows (G<=64) at the two usual node buckets.  Each entry warms
 # the single-window executable AND the 16-wide window-batch executable.
-DEFAULT_WARMUP_SHAPES: Tuple[Tuple[int, int, int, int], ...] = (
+DEFAULT_WARMUP_SHAPES: tuple[tuple[int, int, int, int], ...] = (
     (64, 4, 512, 10000),
     (64, 16, 512, 10000),
     (64, 4, 128, 1000),
@@ -67,7 +67,7 @@ DEFAULT_WARMUP_SHAPES: Tuple[Tuple[int, int, int, int], ...] = (
 
 
 def warmup_solver(solver, catalog, *,
-                  shapes: Sequence[Tuple[int, int, int, int]] = None,
+                  shapes: Sequence[tuple[int, int, int, int]] = None,
                   batch_widths: Sequence[int] = (16, 32),
                   force: bool = False) -> int:
     """Compile the packed solve executables for ``catalog``'s offering

@@ -19,7 +19,6 @@ on-device, the whole refinement is a handful of kernel launches.
 from __future__ import annotations
 
 import dataclasses
-from typing import List, Optional, Tuple
 
 import numpy as np
 
@@ -35,7 +34,7 @@ log = get_logger("solver.zonesplit")
 
 
 def affinity_candidates(problem: EncodedProblem
-                        ) -> List[Tuple[int, str, List[str]]]:
+                        ) -> list[tuple[int, str, list[str]]]:
     """(group index, current pinned zone, viable zones) per zone-affinity
     group with a real choice (>1 viable zone)."""
     out = []
@@ -146,7 +145,7 @@ def solve_with_zone_candidates(backend, request: SolveRequest) -> Plan:
     # ride the same batched dispatch for free
     seen: set = set()
     while open_groups and (budget > 0 or seen):
-        cand_keys: List[Tuple[int, str]] = []
+        cand_keys: list[tuple[int, str]] = []
         for gi, (current, zones) in open_groups.items():
             cand_keys.extend((gi, z) for z in zones if z != current)
         fresh = [k for k in cand_keys if k not in seen]
@@ -160,7 +159,7 @@ def solve_with_zone_candidates(backend, request: SolveRequest) -> Plan:
             plans = batch_solve(probs)
         else:
             plans = [backend.solve_encoded(p) for p in probs]
-        best_i: Optional[int] = None
+        best_i: int | None = None
         for i, p in enumerate(plans):
             if _wins(p, plans[best_i] if best_i is not None else plan):
                 best_i = i

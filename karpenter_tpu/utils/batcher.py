@@ -19,7 +19,8 @@ import threading
 import time
 from concurrent.futures import Future, ThreadPoolExecutor
 from dataclasses import dataclass, field
-from typing import Callable, Dict, Generic, Hashable, List, Optional, Sequence, TypeVar
+from collections.abc import Callable, Hashable, Sequence
+from typing import Generic, TypeVar
 
 from karpenter_tpu.utils import metrics
 
@@ -64,16 +65,16 @@ class Batcher(Generic[T, U]):
     def __init__(
         self,
         handler: Callable[[Sequence[T]], Sequence[U]],
-        options: Optional[BatcherOptions] = None,
+        options: BatcherOptions | None = None,
         hasher: Callable[[T], Hashable] = one_bucket_hasher,
     ):
         self._handler = handler
         self._opts = options or BatcherOptions()
         self._hasher = hasher
-        self._lock = threading.Condition()
-        self._buckets: Dict[Hashable, List[_Pending[T, U]]] = {}
-        self._bucket_born: Dict[Hashable, float] = {}
-        self._bucket_last: Dict[Hashable, float] = {}
+        self._cv = threading.Condition()
+        self._buckets: dict[Hashable, list[_Pending[T, U]]] = {}
+        self._bucket_born: dict[Hashable, float] = {}
+        self._bucket_last: dict[Hashable, float] = {}
         self._pool = ThreadPoolExecutor(max_workers=self._opts.max_workers,
                                         thread_name_prefix=f"{self._opts.name}-exec")
         self._closed = False
@@ -84,7 +85,7 @@ class Batcher(Generic[T, U]):
     # -- public ------------------------------------------------------------
 
     def add(self, item: T) -> "Future[U]":
-        with self._lock:
+        with self._cv:
             if self._closed:
                 raise RuntimeError("batcher closed")
             bucket = self._hasher(item)
@@ -95,16 +96,16 @@ class Batcher(Generic[T, U]):
             self._bucket_last[bucket] = now
             p = _Pending(item)
             pendings.append(p)
-            self._lock.notify()
+            self._cv.notify()
             return p.future
 
-    def add_and_wait(self, item: T, timeout: Optional[float] = None) -> U:
+    def add_and_wait(self, item: T, timeout: float | None = None) -> U:
         return self.add(item).result(timeout=timeout)
 
     def close(self) -> None:
-        with self._lock:
+        with self._cv:
             self._closed = True
-            self._lock.notify()
+            self._cv.notify()
         self._loop.join(timeout=5)
         self._flush_all()
         self._pool.shutdown(wait=True)
@@ -114,11 +115,11 @@ class Batcher(Generic[T, U]):
     def _run(self) -> None:
         opts = self._opts
         while True:
-            with self._lock:
+            with self._cv:
                 if self._closed:
                     return
                 now = time.monotonic()
-                fire: List[Hashable] = []
+                fire: list[Hashable] = []
                 deadline = None
                 for bucket, pendings in self._buckets.items():
                     if not pendings:
@@ -137,14 +138,14 @@ class Batcher(Generic[T, U]):
                     self._bucket_last.pop(bucket, None)
                     batches.append((batch, now - born))
                 if not batches:
-                    self._lock.wait(timeout=None if deadline is None else max(0.0, deadline - now))
+                    self._cv.wait(timeout=None if deadline is None else max(0.0, deadline - now))
                     continue
             for batch, age in batches:
                 metrics.BATCH_WINDOW_SECONDS.labels(self._opts.name).observe(age)
                 metrics.BATCH_SIZE.labels(self._opts.name).observe(len(batch))
                 self._pool.submit(self._exec, batch)
 
-    def _exec(self, batch: List[_Pending[T, U]]) -> None:
+    def _exec(self, batch: list[_Pending[T, U]]) -> None:
         try:
             results = self._handler([p.item for p in batch])
             if results is None or len(results) != len(batch):
@@ -159,7 +160,7 @@ class Batcher(Generic[T, U]):
                     p.future.set_exception(e)
 
     def _flush_all(self) -> None:
-        with self._lock:
+        with self._cv:
             remaining = [p for ps in self._buckets.values() for p in ps]
             self._buckets.clear()
         for p in remaining:

@@ -10,7 +10,8 @@ from __future__ import annotations
 
 import threading
 import time
-from typing import Any, Callable, Dict, Optional, Tuple
+from collections.abc import Callable
+from typing import Any
 
 _SENTINEL = object()
 
@@ -29,10 +30,10 @@ class TTLCache:
         self._default_ttl = default_ttl
         self._clock = clock
         self._lock = threading.RLock()
-        self._data: Dict[Any, Tuple[Any, float]] = {}  # key -> (value, expires_at)
-        self._inflight: Dict[Any, threading.Lock] = {}
+        self._data: dict[Any, tuple[Any, float]] = {}  # key -> (value, expires_at)
+        self._inflight: dict[Any, threading.Lock] = {}
 
-    def set(self, key: Any, value: Any, ttl: Optional[float] = None) -> None:
+    def set(self, key: Any, value: Any, ttl: float | None = None) -> None:
         expires = self._clock() + (self._default_ttl if ttl is None else ttl)
         with self._lock:
             self._data[key] = (value, expires)
@@ -57,7 +58,7 @@ class TTLCache:
             self._data.pop(key, None)
             self._inflight.pop(key, None)
 
-    def get_or_set(self, key: Any, fn: Callable[[], Any], ttl: Optional[float] = None) -> Any:
+    def get_or_set(self, key: Any, fn: Callable[[], Any], ttl: float | None = None) -> Any:
         """Return cached value, computing ``fn()`` at most once per miss.
 
         Concurrent callers missing on the same key block on a per-key lock;

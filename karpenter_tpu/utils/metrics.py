@@ -9,19 +9,19 @@ text exposition format so the numbers are scrapeable without client libs.
 from __future__ import annotations
 
 import threading
-from typing import Dict, List, Sequence, Tuple
+from collections.abc import Sequence
 
 _DEFAULT_BUCKETS = (0.001, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0,
                     2.5, 5.0, 10.0, 30.0, 60.0, 120.0, 300.0, 600.0)
 
 _registry_lock = threading.Lock()
-_registry: List["_Metric"] = []
+_registry: list["_Metric"] = []
 
 
 class _Child:
     __slots__ = ("_metric", "_labels")
 
-    def __init__(self, metric: "_Metric", labels: Tuple[str, ...]):
+    def __init__(self, metric: "_Metric", labels: tuple[str, ...]):
         self._metric = metric
         self._labels = labels
 
@@ -49,7 +49,7 @@ class _Metric:
         self.help = help_
         self.label_names = tuple(label_names)
         self._lock = threading.Lock()
-        self._values: Dict[Tuple[str, ...], float] = {}
+        self._values: dict[tuple[str, ...], float] = {}
         with _registry_lock:
             _registry.append(self)
 
@@ -101,7 +101,7 @@ class _Metric:
         with self._lock:
             self._values.clear()
 
-    def _render(self) -> List[str]:
+    def _render(self) -> list[str]:
         lines = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} {self.kind}"]
         for labels, value in sorted(self.samples().items()):
             lines.append(f"{self.name}{_fmt_labels(self.label_names, labels)} {value}")
@@ -133,9 +133,9 @@ class Histogram(_Metric):
     def __init__(self, name, help_, label_names=(), buckets: Sequence[float] = _DEFAULT_BUCKETS):
         super().__init__(name, help_, label_names)
         self.buckets = tuple(sorted(buckets))
-        self._counts: Dict[Tuple[str, ...], List[int]] = {}
-        self._sums: Dict[Tuple[str, ...], float] = {}
-        self._totals: Dict[Tuple[str, ...], int] = {}
+        self._counts: dict[tuple[str, ...], list[int]] = {}
+        self._sums: dict[tuple[str, ...], float] = {}
+        self._totals: dict[tuple[str, ...], int] = {}
 
     def _observe(self, labels, value):
         with self._lock:
@@ -164,7 +164,7 @@ class Histogram(_Metric):
             self._sums.clear()
             self._totals.clear()
 
-    def _render(self) -> List[str]:
+    def _render(self) -> list[str]:
         lines = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} histogram"]
         with self._lock:
             items = [(lv, list(c), self._sums.get(lv, 0.0), self._totals.get(lv, 0))
@@ -173,10 +173,12 @@ class Histogram(_Metric):
             cum = 0
             for b, c in zip(self.buckets, counts):
                 cum += c
+                le = f'le="{b}"'
                 lines.append(f"{self.name}_bucket"
-                             f"{_fmt_labels(self.label_names, labels, f'le=\"{b}\"')} {cum}")
+                             f"{_fmt_labels(self.label_names, labels, le)} {cum}")
+            le_inf = 'le="+Inf"'
             lines.append(f"{self.name}_bucket"
-                         f"{_fmt_labels(self.label_names, labels, 'le=\"+Inf\"')} {total}")
+                         f"{_fmt_labels(self.label_names, labels, le_inf)} {total}")
             lines.append(f"{self.name}_sum{_fmt_labels(self.label_names, labels)} {s}")
             lines.append(f"{self.name}_count{_fmt_labels(self.label_names, labels)} {total}")
         return lines
@@ -186,7 +188,7 @@ def render() -> str:
     """Prometheus text exposition of every registered metric."""
     with _registry_lock:
         metrics_ = list(_registry)
-    out: List[str] = []
+    out: list[str] = []
     for m in metrics_:
         out.extend(m._render())
     return "\n".join(out) + "\n"

@@ -8,7 +8,6 @@ import json
 import os
 from dataclasses import dataclass, field
 from pathlib import Path
-from typing import Dict, List, Optional
 
 CONFIG_DIR = Path(__file__).parent / "configs"
 
@@ -19,17 +18,17 @@ class NodeClassConfig:
 
     name: str
     region: str = ""
-    zones: List[str] = field(default_factory=list)
+    zones: list[str] = field(default_factory=list)
     instance_profile: str = ""
-    instance_requirements: Optional[Dict] = None
+    instance_requirements: dict | None = None
     image: str = ""
     vpc: str = ""
     subnet: str = ""
-    security_groups: List[str] = field(default_factory=list)
-    placement_strategy: Optional[Dict] = None
+    security_groups: list[str] = field(default_factory=list)
+    placement_strategy: dict | None = None
 
-    def to_manifest(self) -> Dict:
-        spec: Dict = {
+    def to_manifest(self) -> dict:
+        spec: dict = {
             "region": self.region or os.environ.get("TPU_CLOUD_REGION", ""),
             "image": self.image or os.environ.get("TEST_IMAGE_ID", ""),
             "vpc": self.vpc or os.environ.get("TEST_VPC_ID", ""),
@@ -64,12 +63,12 @@ def load_config(name: str) -> NodeClassConfig:
 
 def make_workload(name: str, replicas: int, cpu: str = "500m",
                   memory: str = "512Mi",
-                  node_selector: Optional[Dict[str, str]] = None,
-                  tolerations: Optional[List[Dict]] = None,
-                  topology_spread: Optional[List[Dict]] = None) -> Dict:
+                  node_selector: dict[str, str] | None = None,
+                  tolerations: list[dict] | None = None,
+                  topology_spread: list[dict] | None = None) -> dict:
     """A minimal pending-pod deployment that forces provisioning."""
     sel = {"app": name}
-    pod_spec: Dict = {
+    pod_spec: dict = {
         "nodeSelector": node_selector or {},
         "containers": [{
             "name": "pause",
@@ -98,12 +97,12 @@ def make_workload(name: str, replicas: int, cpu: str = "500m",
 
 
 def make_nodepool(name: str, nodeclass: str,
-                  taints: Optional[List[Dict]] = None,
-                  startup_taints: Optional[List[Dict]] = None,
-                  requirements: Optional[List[Dict]] = None,
-                  limits: Optional[Dict[str, str]] = None) -> Dict:
+                  taints: list[dict] | None = None,
+                  startup_taints: list[dict] | None = None,
+                  requirements: list[dict] | None = None,
+                  limits: dict[str, str] | None = None) -> dict:
     """A TPUNodePool manifest (deploy/crds/tpunodepool.yaml)."""
-    spec: Dict = {"nodeClassRef": {"name": nodeclass}}
+    spec: dict = {"nodeClassRef": {"name": nodeclass}}
     if taints:
         spec["taints"] = taints
     if startup_taints:

@@ -6,29 +6,28 @@ not hard-coded profile names."""
 
 from __future__ import annotations
 
-from typing import Dict, List, Optional
 
 LABEL_INSTANCE_TYPE = "node.kubernetes.io/instance-type"
 LABEL_ZONE = "topology.kubernetes.io/zone"
 LABEL_CAPACITY_TYPE = "karpenter.sh/capacity-type"
 
 
-def node_instance_type(node) -> Optional[str]:
+def node_instance_type(node) -> str | None:
     return (node.metadata.labels or {}).get(LABEL_INSTANCE_TYPE)
 
 
-def node_zone(node) -> Optional[str]:
+def node_zone(node) -> str | None:
     return (node.metadata.labels or {}).get(LABEL_ZONE)
 
 
-def nodes_by_zone(nodes) -> Dict[str, List]:
-    out: Dict[str, List] = {}
+def nodes_by_zone(nodes) -> dict[str, list]:
+    out: dict[str, list] = {}
     for n in nodes:
         out.setdefault(node_zone(n) or "", []).append(n)
     return out
 
 
-def parse_profile(name: str) -> Optional[Dict[str, int]]:
+def parse_profile(name: str) -> dict[str, int] | None:
     """'bx2-4x16' -> {'cpu': 4, 'memory_gib': 16} (IBM profile grammar);
     None for names outside it."""
     try:
@@ -39,11 +38,11 @@ def parse_profile(name: str) -> Optional[Dict[str, int]]:
         return None
 
 
-def discovered_profiles(suite) -> List[str]:
+def discovered_profiles(suite) -> list[str]:
     """Instance profiles selected/validated by the cluster's NodeClasses
     (status.selectedInstanceTypes — the operator's discovery output),
     falling back to profiles seen on live nodes."""
-    found: List[str] = []
+    found: list[str] = []
     try:
         for nc in suite.custom.list_cluster_custom_object(
                 "karpenter-tpu.sh", "v1alpha1", "tpunodeclasses"

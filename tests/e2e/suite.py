@@ -17,7 +17,7 @@ from __future__ import annotations
 import os
 import time
 from dataclasses import dataclass, field
-from typing import Callable, Dict, List, Optional
+from collections.abc import Callable
 
 import pytest
 
@@ -49,7 +49,7 @@ class E2ESuite:
     region: str
     zone: str
     namespace: str = "karpenter-tpu-e2e"
-    created: List[Dict] = field(default_factory=list)
+    created: list[dict] = field(default_factory=list)
 
     # -- bootstrap ---------------------------------------------------------
 
@@ -88,12 +88,12 @@ class E2ESuite:
         pytest.fail(f"timed out after {timeout}s waiting for {what}")
 
     def nodes_with_label(self, key: str,
-                         value: Optional[str] = None) -> List:
+                         value: str | None = None) -> list:
         sel = key if value is None else f"{key}={value}"
         return self.kube.list_node(label_selector=sel).items
 
     def wait_for_nodes(self, count: int, label: str = E2E_LABEL,
-                       timeout: float = DEFAULT_TIMEOUT) -> List:
+                       timeout: float = DEFAULT_TIMEOUT) -> list:
         self.wait_for(
             f"{count} ready nodes with {label}",
             lambda: len([n for n in self.nodes_with_label(label)
@@ -114,7 +114,7 @@ class E2ESuite:
 
     # -- object creation (tracked for cleanup) -----------------------------
 
-    def create_nodeclass(self, body: Dict) -> Dict:
+    def create_nodeclass(self, body: dict) -> dict:
         body.setdefault("metadata", {}).setdefault("labels", {})[
             E2E_LABEL] = "true"
         out = self.custom.create_cluster_custom_object(
@@ -123,7 +123,7 @@ class E2ESuite:
                              "name": body["metadata"]["name"]})
         return out
 
-    def create_deployment(self, namespace: str, body: Dict) -> None:
+    def create_deployment(self, namespace: str, body: dict) -> None:
         from kubernetes import client
 
         body.setdefault("metadata", {}).setdefault("labels", {})[
@@ -139,7 +139,7 @@ class E2ESuite:
         pods with phase/conditions/events, e2e-labeled nodes with
         conditions, and recent controller log tail.  Returned (and
         printed) so pytest failure output carries it."""
-        lines: List[str] = []
+        lines: list[str] = []
         try:
             for p in self.kube.list_namespaced_pod(
                     namespace, label_selector=selector).items:

@@ -69,6 +69,74 @@ class TestRetry:
             retry_with_backoff(bad_request, sleep=lambda s: None)
         assert len(attempts) == 1
 
+    def test_backoff_cap_holds_for_remaining_attempts(self):
+        # pinned (graftlint Family B reads this file): once the
+        # geometric ramp hits the cap it STAYS there — no reset, no
+        # overshoot
+        sleeps = []
+
+        def always_fail():
+            raise CloudError("unavailable", 503)
+
+        with pytest.raises(CloudError):
+            retry_with_backoff(always_fail,
+                               RetryConfig(initial=1, factor=2, cap=15,
+                                           steps=9),
+                               sleep=sleeps.append)
+        assert sleeps == [1, 2, 4, 8, 15, 15, 15, 15]
+        assert max(sleeps) == 15
+
+    def test_backoff_cap_bounds_first_wait(self):
+        # misconfigured initial > cap: the cap clamps the FIRST sleep too
+        sleeps = []
+
+        def always_fail():
+            raise CloudError("unavailable", 503)
+
+        with pytest.raises(CloudError):
+            retry_with_backoff(always_fail,
+                               RetryConfig(initial=40, factor=2, cap=15,
+                                           steps=3),
+                               sleep=sleeps.append)
+        assert sleeps == [15, 15]
+
+    def test_retry_after_overrides_wait_but_not_the_ramp(self):
+        # a 429 Retry-After substitutes that one wait; the geometric
+        # delay still advances underneath (server hint is per-attempt,
+        # not a backoff reset)
+        sleeps = []
+        attempts = []
+
+        def limited():
+            attempts.append(1)
+            if len(attempts) == 1:
+                raise CloudError("429", 429, retry_after=7.5)
+            if len(attempts) < 4:
+                raise CloudError("unavailable", 503)
+            return "ok"
+
+        assert retry_with_backoff(
+            limited, RetryConfig(initial=1, factor=2, cap=15, steps=10),
+            sleep=sleeps.append) == "ok"
+        assert sleeps == [7.5, 2, 4]
+
+    def test_retry_after_exceeding_cap_is_honored(self):
+        # the server-directed wait is authoritative even above the cap
+        # (parity: ratelimit_retry.go honors Retry-After verbatim)
+        sleeps = []
+        attempts = []
+
+        def limited():
+            attempts.append(1)
+            if len(attempts) < 2:
+                raise CloudError("429", 429, retry_after=120.0)
+            return "ok"
+
+        assert retry_with_backoff(
+            limited, RetryConfig(initial=1, cap=15, steps=5),
+            sleep=sleeps.append) == "ok"
+        assert sleeps == [120.0]
+
     def test_honors_retry_after(self):
         sleeps = []
         attempts = []

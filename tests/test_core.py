@@ -79,6 +79,58 @@ class TestCircuitBreaker:
         with pytest.raises(CircuitBreakerOpenError, match="probe budget"):
             cb.can_provision()
 
+    def test_half_open_close_resets_failure_history(self):
+        # pinned (graftlint Family B reads this file): a successful
+        # half-open probe closes the breaker AND clears the failure
+        # window — re-opening takes a full fresh threshold, not
+        # threshold-minus-stale-failures
+        cb, clock = self.make(failure_threshold=3, recovery_timeout=900,
+                              failure_window=10_000)
+        for _ in range(3):
+            cb.can_provision()
+            cb.record_failure("boom")
+        assert cb.state == "OPEN"
+        clock.t = 901
+        cb.can_provision()
+        cb.record_success()
+        assert cb.state == "CLOSED"
+        # two new failures are below threshold: still CLOSED
+        for _ in range(2):
+            cb.can_provision()
+            cb.record_failure("again")
+        assert cb.state == "CLOSED"
+        cb.can_provision()
+        cb.record_failure("third")
+        assert cb.state == "OPEN"
+
+    def test_half_open_close_restores_probe_budget(self):
+        # budget is per half-open episode: close resets it, so the next
+        # OPEN -> HALF_OPEN cycle gets the full budget again
+        cb, clock = self.make(failure_threshold=1, recovery_timeout=900,
+                              half_open_max_requests=2)
+        cb.can_provision(); cb.record_failure()
+        clock.t = 901
+        cb.can_provision()
+        cb.record_success()           # closes, probe budget wiped
+        assert cb.state == "CLOSED"
+        cb.can_provision(); cb.record_failure()      # re-open
+        clock.t = 1901
+        cb.can_provision()            # probe 1 of the NEW episode
+        cb.can_provision()            # probe 2 — full budget available
+        with pytest.raises(CircuitBreakerOpenError, match="probe budget"):
+            cb.can_provision()
+
+    def test_recovery_boundary_is_inclusive(self):
+        # at exactly recovery_timeout the breaker half-opens (>=)
+        cb, clock = self.make(failure_threshold=1, recovery_timeout=900)
+        cb.can_provision(); cb.record_failure()
+        clock.t = 899.999
+        with pytest.raises(CircuitBreakerOpenError):
+            cb.can_provision()
+        clock.t = 900.0
+        cb.can_provision()
+        assert cb.state == "HALF_OPEN"
+
     def test_rate_limit_per_minute(self):
         cb, clock = self.make(rate_limit_per_minute=2)
         cb.can_provision(); cb.record_success()

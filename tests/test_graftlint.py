@@ -1,0 +1,545 @@
+"""graftlint rule + engine tests.
+
+One minimal good/bad fixture pair per rule (the acceptance contract for
+every GLxxx ID: the bad snippet yields exactly that rule, the good twin
+yields nothing), plus engine mechanics — per-line suppressions, the
+baseline ledger (new vs baselined vs stale), fingerprint stability under
+line moves, and the syntax-error hard-fail.
+
+Fixtures run through ``lint_source`` with a path inside each family's
+scope (the path decides which rules apply, exactly like the CLI).
+"""
+
+import json
+import textwrap
+from pathlib import Path
+
+from tools.graftlint.engine import Baseline, SourceModule, default_engine, lint_source
+from tools.graftlint.rules import all_rules
+
+SOLVER_PATH = "karpenter_tpu/solver/_snippet.py"
+CTRL_PATH = "karpenter_tpu/controllers/_snippet.py"
+CLOUD_PATH = "karpenter_tpu/cloud/_snippet.py"
+
+
+def rules_of(src: str, path: str) -> list:
+    return sorted({f.rule for f in lint_source(textwrap.dedent(src), path)})
+
+
+def assert_flags(src: str, rule: str, path: str = SOLVER_PATH) -> None:
+    found = rules_of(src, path)
+    assert rule in found, f"expected {rule}, got {found}"
+
+
+def assert_clean(src: str, rule: str, path: str = SOLVER_PATH) -> None:
+    found = rules_of(src, path)
+    assert rule not in found, f"unexpected {rule} in {found}"
+
+
+# -- registry ---------------------------------------------------------------
+
+def test_registry_ids_stable_and_unique():
+    rules = [cls() for cls in all_rules()]
+    ids = [r.id for r in rules]
+    assert len(ids) == len(set(ids))
+    assert len(ids) >= 8
+    fams = {r.id: r.family for r in rules}
+    for rid, fam in fams.items():
+        assert (fam == "A") == rid.startswith("GL0"), (rid, fam)
+        assert rid.startswith("GL"), rid
+    # both families present (the two checker families of the suite)
+    assert {"A", "B"} <= set(fams.values())
+
+
+def test_every_rule_has_description_and_scope():
+    for cls in all_rules():
+        r = cls()
+        assert r.name and r.description and r.scope
+
+
+# -- Family A fixtures ------------------------------------------------------
+
+def test_gl001_host_sync_bad():
+    assert_flags(
+        """
+        import jax, numpy as np
+
+        @jax.jit
+        def solve(x):
+            host = np.asarray(x)
+            return host.sum()
+        """, "GL001")
+
+
+def test_gl001_host_sync_float_cast_bad():
+    assert_flags(
+        """
+        import jax
+
+        @jax.jit
+        def solve(x):
+            return float(x.sum())
+        """, "GL001")
+
+
+def test_gl001_host_sync_good():
+    assert_clean(
+        """
+        import jax
+        import jax.numpy as jnp
+
+        @jax.jit
+        def solve(x):
+            return jnp.asarray(x).sum()
+
+        def fetch(dev):
+            # host sync OUTSIDE the traced body is the normal fetch path
+            return float(dev)
+        """, "GL001")
+
+
+def test_gl002_tracer_bool_bad():
+    assert_flags(
+        """
+        import jax
+
+        @jax.jit
+        def solve(x):
+            if x > 0:
+                return x
+            return -x
+        """, "GL002")
+
+
+def test_gl002_static_arg_and_none_gate_good():
+    assert_clean(
+        """
+        import functools
+        import jax
+
+        @functools.partial(jax.jit, static_argnames=("dense",))
+        def solve(x, pref=None, *, dense: bool = False):
+            if pref is not None:      # trace-time-static optional gate
+                x = x + pref
+            if dense:                 # static arg: shape-static branch
+                return x
+            return -x
+        """, "GL002")
+
+
+def test_gl003_recompile_bad():
+    assert_flags(
+        """
+        import jax
+
+        def solve_window(f, x):
+            return jax.jit(f)(x)
+        """, "GL003")
+
+
+def test_gl003_cached_builder_good():
+    assert_clean(
+        """
+        import functools
+        import jax
+
+        @functools.lru_cache(maxsize=8)
+        def _solver_jit(n):
+            return jax.jit(lambda x: x * n)
+
+        class Backend:
+            def __init__(self):
+                self._f = jax.jit(lambda x: x + 1)
+
+        def solve_window(x, n):
+            return _solver_jit(n)(x)
+        """, "GL003")
+
+
+def test_gl004_tracer_leak_bad():
+    assert_flags(
+        """
+        import jax
+
+        class Backend:
+            @jax.jit
+            def solve(self, x):
+                self.last = x          # leaks the tracer onto the instance
+                return x + 1
+        """, "GL004")
+
+
+def test_gl004_mutating_nonlocal_list_bad():
+    assert_flags(
+        """
+        import jax
+
+        TRACE_LOG = []
+
+        @jax.jit
+        def solve(x):
+            TRACE_LOG.append(x)
+            return x + 1
+        """, "GL004")
+
+
+def test_gl004_local_state_good():
+    assert_clean(
+        """
+        import jax
+
+        @jax.jit
+        def solve(x):
+            acc = []
+            acc.append(x + 1)
+            out = {}
+            out["y"] = acc[0]
+            return out["y"]
+        """, "GL004")
+
+
+def test_gl005_dtype_drift_bad():
+    assert_flags(
+        """
+        import jax, numpy as np
+
+        @jax.jit
+        def solve(x):
+            pad = np.zeros((8,))
+            return x + pad
+        """, "GL005")
+
+
+def test_gl005_explicit_dtype_good():
+    assert_clean(
+        """
+        import jax, numpy as np
+
+        @jax.jit
+        def solve(x):
+            pad = np.zeros((8,), dtype=np.int32)
+            return x + pad
+        """, "GL005")
+
+
+def test_gl006_missing_donation_bad():
+    assert_flags(
+        """
+        import functools
+        import jax
+
+        @functools.partial(jax.jit, static_argnames=("n",))
+        def solve_packed(packed, *, n: int):
+            return packed[:n]
+        """, "GL006")
+
+
+def test_gl006_donated_good():
+    assert_clean(
+        """
+        import functools
+        import jax
+
+        @functools.partial(jax.jit, donate_argnums=(0,),
+                           static_argnames=("n",))
+        def solve_packed(packed, *, n: int):
+            return packed[:n]
+
+        @jax.jit
+        def helper_kernel(x):
+            # not a solve_* entry point: donation is the entry contract
+            return x + 1
+        """, "GL006")
+
+
+# -- Family B fixtures ------------------------------------------------------
+
+def test_gl101_lock_across_rpc_bad():
+    assert_flags(
+        """
+        class Pricing:
+            def refresh(self):
+                with self._lock:
+                    rows = self._client.list_instance_profiles()
+                    self._prices = dict(rows)
+        """, "GL101", CLOUD_PATH)
+
+
+def test_gl101_sleep_under_lock_bad():
+    assert_flags(
+        """
+        import time
+
+        class Poller:
+            def poll(self):
+                with self._lock:
+                    time.sleep(0.5)
+        """, "GL101", CLOUD_PATH)
+
+
+def test_gl101_copy_then_call_good():
+    assert_clean(
+        """
+        class Pricing:
+            def refresh(self):
+                with self._lock:
+                    names = list(self._names)
+                rows = self._client.fetch(names)   # RPC outside the lock
+                with self._lock:
+                    self._prices.update(rows)
+        """, "GL101", CLOUD_PATH)
+
+
+def test_gl101_condition_wait_good():
+    assert_clean(
+        """
+        class Queue:
+            def get(self):
+                with self._cv:
+                    self._cv.wait(0.2)
+                    return self._items.pop()
+        """, "GL101", CTRL_PATH)
+
+
+def test_gl102_sleep_in_controller_bad():
+    assert_flags(
+        """
+        import time
+
+        class Controller:
+            def reconcile(self, key):
+                time.sleep(1.0)
+        """, "GL102", CTRL_PATH)
+
+
+def test_gl102_stop_event_wait_good():
+    assert_clean(
+        """
+        class Controller:
+            def reconcile(self, key):
+                self._stop.wait(1.0)
+        """, "GL102", CTRL_PATH)
+
+
+def test_gl102_scoped_to_controllers_only():
+    # cloud/ poll helpers use the injectable-sleep pattern; GL102 must
+    # not fire outside controllers/ + core/
+    assert_clean(
+        """
+        import time
+
+        def poll(fn):
+            time.sleep(0.1)
+        """, "GL102", CLOUD_PATH)
+
+
+def test_gl103_mixed_lock_discipline_bad():
+    assert_flags(
+        """
+        class State:
+            def tracked(self, x):
+                with self._lock:
+                    self._items.append(x)
+
+            def untracked(self, x):
+                self._items.append(x)
+        """, "GL103", CTRL_PATH)
+
+
+def test_gl103_locked_suffix_contract_good():
+    assert_clean(
+        """
+        class State:
+            def tracked(self, x):
+                with self._lock:
+                    self._add_locked(x)
+
+            def _add_locked(self, x):
+                self._items.append(x)
+        """, "GL103", CTRL_PATH)
+
+
+def test_gl103_init_exempt_good():
+    assert_clean(
+        """
+        class State:
+            def __init__(self):
+                self._items = []
+
+            def tracked(self, x):
+                with self._lock:
+                    self._items.append(x)
+        """, "GL103", CTRL_PATH)
+
+
+def test_gl104_non_daemon_thread_bad():
+    assert_flags(
+        """
+        import threading
+
+        def start(fn):
+            t = threading.Thread(target=fn)
+            t.start()
+            return t
+        """, "GL104", CTRL_PATH)
+
+
+def test_gl104_daemon_thread_good():
+    assert_clean(
+        """
+        import threading
+
+        def start(fn):
+            t = threading.Thread(target=fn, daemon=True)
+            t.start()
+            return t
+        """, "GL104", CTRL_PATH)
+
+
+# -- suppressions -----------------------------------------------------------
+
+def test_per_line_suppression():
+    src = textwrap.dedent(
+        """
+        import time
+
+        class Controller:
+            def reconcile(self, key):
+                time.sleep(1.0)  # graftlint: disable=GL102
+        """)
+    assert not lint_source(src, CTRL_PATH)
+
+
+def test_suppression_is_rule_specific():
+    src = textwrap.dedent(
+        """
+        import time
+
+        class Controller:
+            def reconcile(self, key):
+                time.sleep(1.0)  # graftlint: disable=GL999
+        """)
+    assert [f.rule for f in lint_source(src, CTRL_PATH)] == ["GL102"]
+
+
+def test_bare_disable_suppresses_all():
+    src = textwrap.dedent(
+        """
+        import time
+
+        class Controller:
+            def reconcile(self, key):
+                time.sleep(1.0)  # graftlint: disable
+        """)
+    assert not lint_source(src, CTRL_PATH)
+
+
+# -- scoping ----------------------------------------------------------------
+
+def test_family_a_rules_do_not_run_on_controllers():
+    # a jit kernel pasted into controller code is out of Family A's scope
+    src = """
+        import jax, numpy as np
+
+        @jax.jit
+        def solve(x):
+            return float(np.asarray(x).sum())
+        """
+    found = rules_of(src, CTRL_PATH)
+    assert not [r for r in found if r.startswith("GL0")]
+
+
+# -- engine mechanics -------------------------------------------------------
+
+BAD_CTRL = textwrap.dedent(
+    """
+    import time
+
+    class Controller:
+        def reconcile(self, key):
+            time.sleep(1.0)
+    """)
+
+
+def _findings_with_lines(src: str, path: str):
+    module = SourceModule(path, src)
+    engine = default_engine()
+    return [(f, module.line_text(f.line))
+            for f in engine.lint_module(module)]
+
+
+def test_baseline_split_new_vs_known(tmp_path: Path):
+    found = _findings_with_lines(BAD_CTRL, CTRL_PATH)
+    assert found
+    base = Baseline.from_findings(found)
+    new, stale = base.split(found)
+    assert not new and not stale
+
+    # an empty baseline reports everything as new
+    new, stale = Baseline().split(found)
+    assert len(new) == len(found) and not stale
+
+
+def test_baseline_fingerprint_survives_line_moves():
+    found = _findings_with_lines(BAD_CTRL, CTRL_PATH)
+    base = Baseline.from_findings(found)
+    moved = "# a new comment line on top\n" + BAD_CTRL
+    new, stale = base.split(_findings_with_lines(moved, CTRL_PATH))
+    assert not new and not stale
+
+
+def test_baseline_reports_stale_entries_after_fix(tmp_path: Path):
+    found = _findings_with_lines(BAD_CTRL, CTRL_PATH)
+    base = Baseline.from_findings(found)
+    fixed = BAD_CTRL.replace("time.sleep(1.0)", "self._stop.wait(1.0)")
+    new, stale = base.split(_findings_with_lines(fixed, CTRL_PATH))
+    assert not new
+    assert len(stale) == len(found)
+
+
+def test_baseline_roundtrip(tmp_path: Path):
+    found = _findings_with_lines(BAD_CTRL, CTRL_PATH)
+    base = Baseline.from_findings(found)
+    p = tmp_path / "baseline.json"
+    base.save(p)
+    loaded = Baseline.load(p)
+    assert loaded.entries == base.entries
+    assert json.loads(p.read_text())["version"] == 1
+
+
+def test_committed_baseline_matches_repo():
+    """The committed ledger stays exact: no new findings AND no stale
+    entries (debt only ever shrinks, and shrinking must be committed)."""
+    repo = Path(__file__).resolve().parent.parent
+    base_path = repo / "tools" / "graftlint" / "baseline.json"
+    from tools.graftlint.__main__ import DEFAULT_TARGETS, _collect
+    targets = _collect(repo, list(DEFAULT_TARGETS))
+    engine = default_engine()
+    found, errors = engine.lint_files(repo, targets)
+    assert not errors, errors
+    new, stale = Baseline.load(base_path).split(found)
+    assert not new, [f.render() for f in new]
+    assert not stale, stale
+
+
+def test_syntax_error_is_hard_failure(tmp_path: Path):
+    bad = tmp_path / "karpenter_tpu"
+    bad.mkdir()
+    f = bad / "broken.py"
+    f.write_text("def oops(:\n")
+    engine = default_engine()
+    found, errors = engine.lint_files(tmp_path, [f])
+    assert not found
+    assert errors and "syntax error" in errors[0]
+
+
+def test_cli_exit_codes(tmp_path: Path):
+    from tools.graftlint.__main__ import main
+
+    report = tmp_path / "report.json"
+    rc = main(["--report", str(report)])
+    assert rc == 0
+    data = json.loads(report.read_text())
+    assert data["files_checked"] > 0
+    assert not data["new"]
+    assert data["rules"] and "GL001" in data["rules"]

@@ -1,0 +1,30 @@
+"""graftlint: JAX/TPU-aware static analysis for karpenter-tpu.
+
+Two checker families over the AST (docs/development.md "Static analysis
+gates"):
+
+- **Family A — JAX/TPU purity** (``rules/jax_purity.py``), run over the
+  solver hot path (``karpenter_tpu/solver/``, ``karpenter_tpu/parallel/``,
+  ``karpenter_tpu/native.py``, ``bench.py``): host syncs inside jitted
+  bodies, per-call recompilation, tracer leaks, dtype drift, missing
+  buffer donation.  These are the bug classes that silently destroy the
+  <50 ms batched-solve budget and that generic linters cannot see.
+- **Family B — concurrency** (``rules/concurrency.py``), the ``-race``
+  analogue for the controller plane (``karpenter_tpu/controllers/``,
+  ``karpenter_tpu/core/``, ``karpenter_tpu/cloud/``,
+  ``karpenter_tpu/operator/``): locks held across blocking cloud RPCs,
+  shared state mutated outside a class's own lock discipline,
+  ``time.sleep`` in reconcile threads, non-daemon helper threads.
+
+Enforcement model: ``# graftlint: disable=GLxxx`` per-line suppressions
+for justified exceptions, plus a committed baseline
+(``tools/graftlint/baseline.json``) that keeps existing debt visible
+while hard-failing any NEW violation.  ``make graftlint`` (folded into
+``make ci``) is the gate.
+"""
+
+from tools.graftlint.engine import (  # noqa: F401
+    Finding, LintEngine, Rule, lint_paths, lint_source,
+)
+
+__all__ = ["Finding", "LintEngine", "Rule", "lint_paths", "lint_source"]

@@ -1,0 +1,138 @@
+"""graftlint CLI.
+
+    python -m tools.graftlint                     # lint default scopes
+    python -m tools.graftlint path1.py dir/       # explicit targets
+    python -m tools.graftlint --update-baseline   # re-accept current debt
+    python -m tools.graftlint --list-rules
+    python -m tools.graftlint --report out.json   # CI artifact
+
+Exit codes: 0 clean (or all findings baselined), 1 new violations or
+unparsable files, 2 usage error.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from pathlib import Path
+
+from tools.graftlint.engine import Baseline, default_engine
+
+REPO_ROOT = Path(__file__).resolve().parent.parent.parent
+DEFAULT_BASELINE = Path(__file__).resolve().parent / "baseline.json"
+
+# default lint surface = union of both families' scopes
+DEFAULT_TARGETS = (
+    "karpenter_tpu/solver",
+    "karpenter_tpu/parallel",
+    "karpenter_tpu/native.py",
+    "bench.py",
+    "karpenter_tpu/controllers",
+    "karpenter_tpu/core",
+    "karpenter_tpu/cloud",
+    "karpenter_tpu/operator",
+    "karpenter_tpu/catalog",
+    "karpenter_tpu/utils",
+    "karpenter_tpu/service.py",
+    "karpenter_tpu/__main__.py",
+)
+
+
+def _collect(root: Path, targets: list[str]) -> list[Path]:
+    out: list[Path] = []
+    for t in targets:
+        p = (root / t) if not Path(t).is_absolute() else Path(t)
+        if not p.resolve().is_relative_to(root):
+            # findings/baseline entries key on root-relative paths, so an
+            # out-of-tree target can never be linted consistently
+            print(f"graftlint: target outside the repo root: {t}",
+                  file=sys.stderr)
+            raise SystemExit(2)
+        if p.is_dir():
+            out.extend(sorted(p.rglob("*.py")))
+        elif p.suffix == ".py" and p.exists():
+            out.append(p)
+        else:
+            print(f"graftlint: no such target: {t}", file=sys.stderr)
+            raise SystemExit(2)
+    return out
+
+
+def main(argv: list[str] = None) -> int:
+    ap = argparse.ArgumentParser(prog="graftlint")
+    ap.add_argument("targets", nargs="*", help="files/dirs (default: "
+                    "solver+parallel+bench hot path and controller plane)")
+    ap.add_argument("--baseline", default=str(DEFAULT_BASELINE),
+                    help="baseline JSON (committed debt ledger)")
+    ap.add_argument("--no-baseline", action="store_true",
+                    help="report every finding, ignore the ledger")
+    ap.add_argument("--update-baseline", action="store_true",
+                    help="rewrite the ledger to the current findings")
+    ap.add_argument("--report", metavar="PATH",
+                    help="write a JSON report (CI artifact)")
+    ap.add_argument("--list-rules", action="store_true")
+    args = ap.parse_args(argv)
+
+    engine = default_engine()
+    if args.list_rules:
+        for rule in engine.rules:
+            fam = "JAX/TPU purity" if rule.family == "A" else "concurrency"
+            print(f"{rule.id}  [{fam}]  {rule.name}")
+            print(f"       {rule.description}\n")
+        return 0
+
+    files = _collect(REPO_ROOT, list(args.targets) or list(DEFAULT_TARGETS))
+    found, errors = engine.lint_files(REPO_ROOT, files)
+
+    if args.update_baseline:
+        Baseline.from_findings(found).save(Path(args.baseline))
+        print(f"graftlint: baseline updated — {len(found)} finding(s) "
+              f"accepted into {args.baseline}")
+        for e in errors:
+            print(f"graftlint: {e}", file=sys.stderr)
+        return 1 if errors else 0
+
+    if args.no_baseline:
+        new, stale = [f for f, _ in found], []
+    else:
+        baseline = Baseline.load(Path(args.baseline))
+        new, stale = baseline.split(found)
+
+    report = {
+        "files_checked": len(files),
+        "rules": [r.id for r in engine.rules],
+        "total_findings": len(found),
+        "baselined": len(found) - len(new),
+        "new": [
+            {"path": f.path, "line": f.line, "col": f.col,
+             "rule": f.rule, "message": f.message}
+            for f in new
+        ],
+        "stale_baseline_entries": [
+            {"path": p, "rule": r, "text": t} for p, r, t in stale
+        ],
+        "parse_errors": errors,
+    }
+    if args.report:
+        Path(args.report).write_text(json.dumps(report, indent=2) + "\n")
+
+    for e in errors:
+        print(f"graftlint: {e}")
+    for f in new:
+        print(f.render())
+    if stale:
+        print(f"graftlint: {len(stale)} stale baseline entr"
+              f"{'y' if len(stale) == 1 else 'ies'} (violations fixed — "
+              f"run --update-baseline to shrink the ledger):")
+        for p, r, t in stale:
+            print(f"  {p}: {r}: {t[:70]}")
+    ok = not new and not errors
+    print(f"graftlint: {len(files)} files, {len(found)} finding(s), "
+          f"{len(new)} new, {len(found) - len(new)} baselined"
+          f"{' — FAIL' if not ok else ' — ok'}")
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,207 @@
+"""graftlint engine: file loading, rule registry, suppressions, baseline.
+
+Stdlib-only by design — the gate must run in any environment that can
+run the test suite (the container has no ruff; graftlint must never be
+able to silently no-op the same way, see Makefile `lint` vs `graftlint`).
+"""
+
+from __future__ import annotations
+
+import ast
+import fnmatch
+import json
+import re
+from dataclasses import dataclass, field
+from pathlib import Path
+from collections.abc import Iterable, Iterator, Sequence
+
+# `# graftlint: disable=GL001,GL102` suppresses those rules on that line;
+# `# graftlint: disable` suppresses every rule on that line.
+_SUPPRESS_RE = re.compile(
+    r"#\s*graftlint:\s*disable(?:=(?P<rules>[A-Z0-9,\s]+))?")
+
+_ALL = "*"
+
+
+@dataclass(frozen=True)
+class Finding:
+    path: str          # repo-relative posix path
+    line: int          # 1-indexed
+    col: int           # 0-indexed
+    rule: str          # stable ID, e.g. "GL001"
+    message: str
+
+    def fingerprint(self, line_text: str) -> tuple[str, str, str]:
+        """Line-number-independent identity used by the baseline: a
+        violation that merely moves (code added above it) stays matched;
+        editing the offending line itself surfaces it for re-review."""
+        return (self.path, self.rule, line_text.strip())
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}:{self.col + 1}: {self.rule} {self.message}"
+
+
+class SourceModule:
+    """One parsed file: AST + per-line suppression sets."""
+
+    def __init__(self, path: str, text: str):
+        self.path = path
+        self.text = text
+        self.lines = text.splitlines()
+        self.tree = ast.parse(text, filename=path)
+        self._suppressions: dict[int, set] = {}
+        for lineno, line in enumerate(self.lines, start=1):
+            m = _SUPPRESS_RE.search(line)
+            if m:
+                rules = m.group("rules")
+                if rules is None:
+                    self._suppressions[lineno] = {_ALL}
+                else:
+                    self._suppressions[lineno] = {
+                        r.strip() for r in rules.split(",") if r.strip()}
+
+    def suppressed(self, lineno: int, rule: str) -> bool:
+        s = self._suppressions.get(lineno)
+        return bool(s) and (_ALL in s or rule in s)
+
+    def line_text(self, lineno: int) -> str:
+        if 1 <= lineno <= len(self.lines):
+            return self.lines[lineno - 1]
+        return ""
+
+
+class Rule:
+    """Base checker.  Subclasses set the class attributes and implement
+    ``check``; registration is just listing the class in
+    ``rules.all_rules`` (plugin table, docs/development.md)."""
+
+    id: str = ""
+    name: str = ""
+    family: str = ""        # "A" (JAX/TPU purity) or "B" (concurrency)
+    description: str = ""
+    # repo-relative glob patterns this rule applies to
+    scope: Sequence[str] = ()
+
+    def applies_to(self, path: str) -> bool:
+        return any(fnmatch.fnmatch(path, pat) for pat in self.scope)
+
+    def check(self, module: SourceModule) -> Iterator[Finding]:
+        raise NotImplementedError
+
+    def finding(self, module: SourceModule, node: ast.AST,
+                message: str) -> Finding:
+        return Finding(path=module.path, line=node.lineno,
+                       col=getattr(node, "col_offset", 0),
+                       rule=self.id, message=message)
+
+
+@dataclass
+class Baseline:
+    """Committed debt ledger: multiset of finding fingerprints.  New
+    violations (fingerprints not in the ledger) hard-fail; entries whose
+    violation disappeared are reported as stale so the ledger only ever
+    shrinks."""
+
+    entries: dict[tuple[str, str, str], int] = field(default_factory=dict)
+
+    @classmethod
+    def load(cls, path: Path) -> "Baseline":
+        if not path.exists():
+            return cls()
+        data = json.loads(path.read_text())
+        entries: dict[tuple[str, str, str], int] = {}
+        for e in data.get("entries", []):
+            key = (e["path"], e["rule"], e["text"])
+            entries[key] = entries.get(key, 0) + int(e.get("count", 1))
+        return cls(entries)
+
+    @classmethod
+    def from_findings(cls, found: Sequence[tuple[Finding, str]]) -> "Baseline":
+        entries: dict[tuple[str, str, str], int] = {}
+        for f, line_text in found:
+            key = f.fingerprint(line_text)
+            entries[key] = entries.get(key, 0) + 1
+        return cls(entries)
+
+    def save(self, path: Path) -> None:
+        rows = [{"path": p, "rule": r, "text": t, "count": c}
+                for (p, r, t), c in sorted(self.entries.items())]
+        path.write_text(json.dumps({"version": 1, "entries": rows},
+                                   indent=2, sort_keys=True) + "\n")
+
+    def split(self, found: Sequence[tuple[Finding, str]]
+              ) -> tuple[list[Finding], list[tuple[str, str, str]]]:
+        """-> (new findings not covered by the ledger, stale entries)."""
+        budget = dict(self.entries)
+        new: list[Finding] = []
+        for f, line_text in found:
+            key = f.fingerprint(line_text)
+            if budget.get(key, 0) > 0:
+                budget[key] -= 1
+            else:
+                new.append(f)
+        stale = [k for k, c in budget.items() if c > 0]
+        return new, stale
+
+
+class LintEngine:
+    def __init__(self, rules: Sequence[Rule]):
+        self.rules = list(rules)
+        ids = [r.id for r in self.rules]
+        assert len(ids) == len(set(ids)), f"duplicate rule ids: {ids}"
+
+    def lint_module(self, module: SourceModule,
+                    only_rules: set | None = None) -> list[Finding]:
+        out: list[Finding] = []
+        for rule in self.rules:
+            if only_rules is not None and rule.id not in only_rules:
+                continue
+            if only_rules is None and not rule.applies_to(module.path):
+                continue
+            for f in rule.check(module):
+                if not module.suppressed(f.line, f.rule):
+                    out.append(f)
+        out.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+        return out
+
+    def lint_text(self, text: str, path: str,
+                  only_rules: set | None = None) -> list[Finding]:
+        return self.lint_module(SourceModule(path, text), only_rules)
+
+    def lint_files(self, root: Path, paths: Iterable[Path]
+                   ) -> tuple[list[tuple[Finding, str]], list[str]]:
+        """-> ([(finding, offending line text)], [unparsable-file errors])."""
+        found: list[tuple[Finding, str]] = []
+        errors: list[str] = []
+        for p in sorted(set(paths)):
+            rel = p.relative_to(root).as_posix()
+            try:
+                module = SourceModule(rel, p.read_text())
+            except SyntaxError as e:
+                # a file the gate cannot parse is itself a hard failure:
+                # py3.10 is the runtime floor (the seed shipped a
+                # py3.12-only f-string that broke every import)
+                errors.append(f"{rel}:{e.lineno}: syntax error: {e.msg}")
+                continue
+            for f in self.lint_module(module):
+                found.append((f, module.line_text(f.line)))
+        return found, errors
+
+
+def default_engine() -> LintEngine:
+    from tools.graftlint.rules import all_rules
+
+    return LintEngine([cls() for cls in all_rules()])
+
+
+def lint_source(text: str, path: str = "karpenter_tpu/solver/_snippet.py",
+                only_rules: set | None = None) -> list[Finding]:
+    """Test/fixture entry point: lint a source string as if it lived at
+    ``path`` (the path decides which rules' scopes apply unless
+    ``only_rules`` pins the rule set explicitly)."""
+    return default_engine().lint_text(text, path, only_rules)
+
+
+def lint_paths(root: Path, paths: Iterable[Path]
+               ) -> tuple[list[tuple[Finding, str]], list[str]]:
+    return default_engine().lint_files(root, paths)

@@ -1,0 +1,29 @@
+"""Rule registry: the plugin table.
+
+Adding a rule = write a ``Rule`` subclass in one of the family modules
+(or a new module) and list it here.  IDs are stable and never reused:
+GL0xx = Family A (JAX/TPU purity), GL1xx = Family B (concurrency).
+"""
+
+from __future__ import annotations
+
+
+from tools.graftlint.engine import Rule
+from tools.graftlint.rules import concurrency, jax_purity
+
+
+def all_rules() -> list[type[Rule]]:
+    return [
+        # Family A — JAX/TPU purity
+        jax_purity.HostSyncInKernel,          # GL001
+        jax_purity.TracerBoolCoercion,        # GL002
+        jax_purity.RecompileHazard,           # GL003
+        jax_purity.TracerLeak,                # GL004
+        jax_purity.DtypeDrift,                # GL005
+        jax_purity.MissingDonation,           # GL006
+        # Family B — concurrency (the -race analogue)
+        concurrency.LockAcrossBlockingCall,   # GL101
+        concurrency.SleepInController,        # GL102
+        concurrency.UnlockedSharedMutation,   # GL103
+        concurrency.NonDaemonThread,          # GL104
+    ]

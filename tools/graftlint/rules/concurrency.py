@@ -1,0 +1,327 @@
+"""Family B — concurrency rules (GL101-GL104), the `-race` analogue.
+
+The controller plane is 23 controllers sharing ClusterState, cloud
+clients, and the work-queue runtime.  Go gets `-race`; Python gets
+these: a lock held across a cloud RPC serializes every reconciler on one
+slow API call, state mutated outside a class's own lock discipline is a
+data race, `time.sleep` in a controller thread blocks its whole keyed
+queue, and a non-daemon helper thread can hang process exit on a dead
+TPU tunnel (the repo-wide daemon-thread rule, solver/jax_backend.py).
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from collections.abc import Iterator
+
+from tools.graftlint.engine import Finding, Rule, SourceModule
+from tools.graftlint.rules.jaxctx import attr_chain, func_terminal_name
+
+FAMILY_B_SCOPE = (
+    "karpenter_tpu/controllers/*",
+    "karpenter_tpu/controllers/**/*",
+    "karpenter_tpu/core/*",
+    "karpenter_tpu/core/**/*",
+    "karpenter_tpu/cloud/*",
+    "karpenter_tpu/cloud/**/*",
+    "karpenter_tpu/operator/*",
+    "karpenter_tpu/operator/**/*",
+    "karpenter_tpu/catalog/*",
+    "karpenter_tpu/utils/*",
+    "karpenter_tpu/service.py",
+    "karpenter_tpu/__main__.py",
+)
+
+# terminal attribute/name that denotes a mutex-ish context manager
+_LOCK_NAME_RE = re.compile(r"(^|_)(lock|mutex|sem|semaphore)$", re.I)
+_CV_NAME_RE = re.compile(r"(^|_)(cv|cond|condition)$", re.I)
+
+# attribute segments that mark a cloud/API client object
+_CLIENT_SEGMENTS = {"client", "clients", "lbs", "vpc", "iks", "http",
+                    "session", "api", "cloud"}
+# blocking call terminal names (network/process/thread waits)
+_BLOCKING_TERMINALS = {"sleep", "urlopen", "getaddrinfo", "connect",
+                       "recv", "send", "sendall", "run", "check_output",
+                       "check_call", "communicate"}
+_BLOCKING_FUNCS = {"retry_with_backoff"}
+_BLOCKING_ROOTS = {"requests", "subprocess", "socket", "urllib"}
+
+
+def _lockish(expr: ast.AST) -> str | None:
+    """'lock' / 'cv' when the with-item looks like acquiring a mutex;
+    handles `self._lock`, `lock`, `obj._cv`, and `x.acquire()`-style."""
+    chain = attr_chain(expr)
+    if not chain:
+        return None
+    name = chain[-1]
+    if _LOCK_NAME_RE.search(name):
+        return "lock"
+    if _CV_NAME_RE.search(name):
+        return "cv"
+    return None
+
+
+class _FamilyBRule(Rule):
+    family = "B"
+    scope = FAMILY_B_SCOPE
+
+
+class LockAcrossBlockingCall(_FamilyBRule):
+    id = "GL101"
+    name = "lock-across-blocking-call"
+    description = (
+        "Blocking call (cloud RPC, HTTP, sleep, retry loop, future/thread "
+        "wait) made while holding a lock. Every other thread contending "
+        "for that lock stalls behind one slow API round trip — the "
+        "controller-plane deadlock/latency bug Go's race detector plus "
+        "review catches in the reference. Copy what you need under the "
+        "lock, call outside it."
+    )
+
+    def check(self, module: SourceModule) -> Iterator[Finding]:
+        for node in ast.walk(module.tree):
+            if not isinstance(node, (ast.With, ast.AsyncWith)):
+                continue
+            kinds = [_lockish(item.context_expr) for item in node.items]
+            if not any(kinds):
+                continue
+            is_cv = "cv" in kinds
+            for call in self._walk_calls(node):
+                msg = self._blocking_message(call, is_cv)
+                if msg:
+                    yield self.finding(module, call, msg)
+
+    def _walk_calls(self, with_node: ast.AST) -> Iterator[ast.Call]:
+        for stmt in with_node.body:
+            for n in ast.walk(stmt):
+                if isinstance(n, ast.Call):
+                    yield n
+
+    def _blocking_message(self, call: ast.Call,
+                          under_cv: bool) -> str | None:
+        chain = attr_chain(call.func)
+        if not chain:
+            return None
+        terminal = chain[-1]
+        dotted = ".".join(chain)
+        if terminal in ("wait", "wait_for"):
+            # cv.wait releases the lock — that is the correct pattern;
+            # but Event.wait / Thread.join under a lock holds it
+            if under_cv and len(chain) >= 2 \
+                    and _CV_NAME_RE.search(chain[-2]):
+                return None
+            return (f"`{dotted}(...)` waits while holding a lock — only "
+                    f"a Condition belonging to this lock may wait here")
+        if terminal == "sleep":
+            return f"`{dotted}(...)` sleeps while holding a lock"
+        if isinstance(call.func, ast.Name) and \
+                call.func.id in _BLOCKING_FUNCS:
+            return (f"`{call.func.id}(...)` (backoff retry loop: sleeps "
+                    f"between attempts) called while holding a lock")
+        if terminal in _BLOCKING_TERMINALS and len(chain) >= 2:
+            return f"blocking `{dotted}(...)` while holding a lock"
+        if chain[0] in _BLOCKING_ROOTS:
+            return f"blocking `{dotted}(...)` while holding a lock"
+        if terminal == "result" and not call.args and not call.keywords:
+            return (f"`{dotted}()` blocks on a future while holding a "
+                    f"lock")
+        if terminal == "join" and len(chain) >= 2 and not (
+                call.args and isinstance(call.args[0], ast.Constant)
+                and isinstance(call.args[0].value, str)):
+            # str.join(...) takes an iterable of strings; thread/process
+            # join takes a timeout — heuristically skip joins over string
+            # literals and flag attribute joins on thread-ish names
+            if any(s in chain[-2].lower()
+                   for s in ("thread", "proc", "worker", "pool")):
+                return f"`{dotted}(...)` joins a thread while holding a lock"
+            return None
+        # cloud-client RPC: any call whose attribute chain crosses a
+        # client-ish segment (self.lbs.get_member, self._client.request)
+        if len(chain) >= 2 and any(seg.lstrip("_") in _CLIENT_SEGMENTS
+                                   for seg in chain[:-1]):
+            return (f"cloud RPC `{dotted}(...)` while holding a lock — "
+                    f"one slow API call stalls every contending thread")
+        return None
+
+
+class SleepInController(_FamilyBRule):
+    id = "GL102"
+    name = "sleep-in-controller"
+    description = (
+        "time.sleep in controller/core code: a reconcile worker that "
+        "sleeps blocks its whole keyed work queue (and cannot be "
+        "interrupted on shutdown). Use the stop event "
+        "(`self._stop.wait(t)`), Result(requeue_after=t), or the "
+        "injectable-sleep pattern (cloud/retry.py) so tests and shutdown "
+        "stay deterministic."
+    )
+
+    # narrower than the family scope: cloud/ poll helpers use the
+    # injectable-sleep pattern instead, and __main__'s simulate loop is a
+    # CLI, not a controller thread
+    scope = (
+        "karpenter_tpu/controllers/*",
+        "karpenter_tpu/controllers/**/*",
+        "karpenter_tpu/core/*",
+        "karpenter_tpu/core/**/*",
+    )
+
+    def check(self, module: SourceModule) -> Iterator[Finding]:
+        for node in ast.walk(module.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            chain = attr_chain(node.func)
+            if chain[-2:] == ["time", "sleep"] or chain == ["sleep"]:
+                yield self.finding(
+                    module, node,
+                    "time.sleep in controller-plane code — blocks the "
+                    "worker thread uninterruptibly; use the stop event's "
+                    "wait(), requeue_after, or an injected sleep")
+
+
+class UnlockedSharedMutation(_FamilyBRule):
+    id = "GL103"
+    name = "unlocked-shared-mutation"
+    description = (
+        "Attribute that this class mutates under its own lock in some "
+        "methods is also mutated outside any lock in others. Either every "
+        "mutation takes the lock or none needs to — mixed discipline is a "
+        "data race (lost updates under the free-threaded controller "
+        "plane). Initialize in __init__, then keep every later mutation "
+        "under the lock."
+    )
+
+    _MUTATORS = {"append", "extend", "insert", "add", "update",
+                 "setdefault", "pop", "popitem", "remove", "clear",
+                 "discard"}
+
+    def check(self, module: SourceModule) -> Iterator[Finding]:
+        for cls in ast.walk(module.tree):
+            if not isinstance(cls, ast.ClassDef):
+                continue
+            yield from self._check_class(module, cls)
+
+    def _check_class(self, module: SourceModule,
+                     cls: ast.ClassDef) -> Iterator[Finding]:
+        methods = [n for n in cls.body
+                   if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))]
+        if not methods:
+            return
+        # guarded = self-attrs mutated under `with self.<lock>` anywhere
+        guarded: set[str] = set()
+        for m in methods:
+            for node in ast.walk(m):
+                if isinstance(node, (ast.With, ast.AsyncWith)) and any(
+                        _lockish(i.context_expr) and self._is_self_lock(
+                            i.context_expr) for i in node.items):
+                    for stmt in node.body:
+                        for n in ast.walk(stmt):
+                            guarded |= set(self._mutated_self_attrs(n))
+        if not guarded:
+            return
+        for m in methods:
+            if m.name == "__init__":
+                continue    # construction happens-before sharing
+            if m.name.endswith("_locked"):
+                # the `_locked` suffix is the documented contract for
+                # helpers that require the caller to hold the lock
+                # (credentials._refresh_locked idiom)
+                continue
+            for node, attrs in self._unlocked_mutations(m):
+                hot = sorted(set(attrs) & guarded)
+                if hot:
+                    yield self.finding(
+                        module, node,
+                        f"`self.{hot[0]}` is lock-guarded elsewhere in "
+                        f"`{cls.name}` but mutated here outside the lock")
+
+    @staticmethod
+    def _is_self_lock(expr: ast.AST) -> bool:
+        chain = attr_chain(expr)
+        return len(chain) >= 2 and chain[0] in ("self", "cls")
+
+    def _mutated_self_attrs(self, node: ast.AST) -> list[str]:
+        out: list[str] = []
+        if isinstance(node, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+            targets = node.targets if isinstance(node, ast.Assign) \
+                else [node.target]
+            for t in targets:
+                base = t
+                if isinstance(base, ast.Subscript):
+                    base = base.value
+                if isinstance(base, ast.Attribute) and \
+                        isinstance(base.value, ast.Name) and \
+                        base.value.id == "self":
+                    out.append(base.attr)
+        elif isinstance(node, ast.Call) and \
+                isinstance(node.func, ast.Attribute) and \
+                node.func.attr in self._MUTATORS:
+            base = node.func.value
+            if isinstance(base, ast.Attribute) and \
+                    isinstance(base.value, ast.Name) and \
+                    base.value.id == "self":
+                out.append(base.attr)
+        return out
+
+    def _unlocked_mutations(self, method: ast.AST
+                            ) -> Iterator[tuple[ast.AST, list[str]]]:
+        """(node, mutated self-attrs) for mutations NOT under a with-lock."""
+        locked_spans: list[tuple[int, int]] = []
+        for node in ast.walk(method):
+            if isinstance(node, (ast.With, ast.AsyncWith)) and any(
+                    _lockish(i.context_expr) for i in node.items):
+                locked_spans.append(
+                    (node.lineno, getattr(node, "end_lineno", node.lineno)))
+        for node in ast.walk(method):
+            attrs = self._mutated_self_attrs(node)
+            if not attrs:
+                continue
+            line = node.lineno
+            if any(lo <= line <= hi for lo, hi in locked_spans):
+                continue
+            yield node, attrs
+
+
+class NonDaemonThread(_FamilyBRule):
+    id = "GL104"
+    name = "non-daemon-thread"
+    description = (
+        "threading.Thread(...) without daemon=True in the controller "
+        "plane. The repo-wide rule (solver/jax_backend.py fetch pool): a "
+        "helper thread hung on a dead TPU tunnel or cloud API must never "
+        "block process exit — pass daemon=True at construction."
+    )
+
+    def check(self, module: SourceModule) -> Iterator[Finding]:
+        daemon_assigned = self._daemon_assign_lines(module)
+        for node in ast.walk(module.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            chain = attr_chain(node.func)
+            if chain[-1:] != ["Thread"]:
+                continue
+            if len(chain) >= 2 and chain[-2] not in ("threading",):
+                continue
+            has_daemon = any(k.arg == "daemon" for k in node.keywords)
+            if has_daemon:
+                continue
+            # `t.daemon = True` within a few lines counts (old idiom)
+            if any(node.lineno <= ln <= node.lineno + 4
+                   for ln in daemon_assigned):
+                continue
+            yield self.finding(
+                module, node,
+                "threading.Thread without daemon=True — a hung helper "
+                "thread must never block process exit (repo daemon-"
+                "thread rule)")
+
+    @staticmethod
+    def _daemon_assign_lines(module: SourceModule) -> list[int]:
+        out: list[int] = []
+        for node in ast.walk(module.tree):
+            if isinstance(node, ast.Assign):
+                for t in node.targets:
+                    if isinstance(t, ast.Attribute) and t.attr == "daemon":
+                        out.append(node.lineno)
+        return out

@@ -1,0 +1,368 @@
+"""Family A — JAX/TPU purity rules (GL001-GL006).
+
+These guard the <50 ms batched-solve budget: a host sync inside a jitted
+body serializes the pipeline on a ~70 ms tunnel round trip, a per-call
+re-jit pays full XLA compilation on the hot path, a leaked tracer
+poisons later traces, dtype drift silently upcasts VPU integer math, and
+a missing donation doubles the H2D footprint of multi-MB solve buffers.
+"""
+
+from __future__ import annotations
+
+import ast
+from collections.abc import Iterator
+
+from tools.graftlint.engine import Finding, Rule, SourceModule
+from tools.graftlint.rules import jaxctx
+
+FAMILY_A_SCOPE = (
+    "karpenter_tpu/solver/*",
+    "karpenter_tpu/solver/**/*",
+    "karpenter_tpu/parallel/*",
+    "karpenter_tpu/parallel/**/*",
+    "karpenter_tpu/native.py",
+    "bench.py",
+)
+
+_NUMPY_ALIASES = {"np", "numpy", "onp"}
+_HOST_SYNC_METHODS = {"item", "tolist", "block_until_ready"}
+_HOST_SYNC_NP_FUNCS = {"asarray", "array", "copyto", "savez", "save"}
+_CAST_BUILTINS = {"float", "int", "bool", "complex"}
+# numpy constructors whose default dtype is float64/int64 — inside a
+# kernel these bake wide constants into the trace
+_NP_DEFAULT_DTYPE_CTORS = {
+    "zeros", "ones", "full", "empty", "arange", "linspace", "eye",
+    "identity",
+}
+
+
+class _FamilyARule(Rule):
+    family = "A"
+    scope = FAMILY_A_SCOPE
+
+
+class HostSyncInKernel(_FamilyARule):
+    id = "GL001"
+    name = "host-sync-in-kernel"
+    description = (
+        "Host synchronization inside a traced (jit/scan/pallas) body: "
+        "np.asarray/np.array, jax.device_get, .item()/.tolist()/"
+        ".block_until_ready(), or float()/int()/bool() on a traced value. "
+        "Each one forces a device round trip (or a trace-time error) in "
+        "code that must stay compiled and on-device."
+    )
+
+    def check(self, module: SourceModule) -> Iterator[Finding]:
+        analysis = jaxctx.analyze(module)
+        for info in analysis.kernel_items():
+            for node in analysis.body_nodes(info.fn):
+                if not isinstance(node, ast.Call):
+                    continue
+                msg = self._host_sync_message(node, analysis, info)
+                if msg:
+                    yield self.finding(module, node, msg)
+
+    def _host_sync_message(self, node: ast.Call,
+                           analysis: jaxctx.JaxModuleAnalysis,
+                           info: jaxctx.KernelInfo) -> str | None:
+        func = node.func
+        chain = jaxctx.attr_chain(func)
+        if len(chain) >= 2 and chain[0] in _NUMPY_ALIASES \
+                and chain[-1] in _HOST_SYNC_NP_FUNCS:
+            return (f"numpy host call `{'.'.join(chain)}` inside a traced "
+                    f"body — forces a device->host transfer; use jnp")
+        if chain[-2:] == ["jax", "device_get"] or \
+                (len(chain) == 2 and chain == ["jax", "device_get"]):
+            return "jax.device_get inside a traced body blocks on the device"
+        if isinstance(func, ast.Attribute) \
+                and func.attr in _HOST_SYNC_METHODS \
+                and analysis.expr_tainted(func.value, info):
+            return (f".{func.attr}() on a traced value — host sync inside "
+                    f"a compiled body")
+        if isinstance(func, ast.Name) and func.id in _CAST_BUILTINS \
+                and len(node.args) == 1 \
+                and analysis.expr_tainted(node.args[0], info):
+            return (f"{func.id}() on a traced value inside a compiled body "
+                    f"— forces a host sync (or a ConcretizationTypeError)")
+        return None
+
+
+class TracerBoolCoercion(_FamilyARule):
+    id = "GL002"
+    name = "tracer-bool-coercion"
+    description = (
+        "Python control flow (`if`/`while`/`assert`/`and`/`or`) on a "
+        "traced value inside a jitted body. Branching must go through "
+        "lax.cond/jnp.where; a traced truth value either re-traces per "
+        "branch or raises at trace time."
+    )
+
+    def check(self, module: SourceModule) -> Iterator[Finding]:
+        analysis = jaxctx.analyze(module)
+        for info in analysis.kernel_items():
+            for node in analysis.body_nodes(info.fn):
+                test: ast.expr | None = None
+                kind = ""
+                if isinstance(node, (ast.If, ast.While)):
+                    test, kind = node.test, type(node).__name__.lower()
+                elif isinstance(node, ast.Assert):
+                    test, kind = node.test, "assert"
+                elif isinstance(node, ast.IfExp):
+                    test, kind = node.test, "conditional expression"
+                if test is None or self._is_staticness_check(test):
+                    continue
+                if analysis.expr_tainted(test, info):
+                    yield self.finding(
+                        module, node,
+                        f"`{kind}` on a traced value inside a compiled "
+                        f"body — use lax.cond/jnp.where (or mark the "
+                        f"argument static)")
+
+    @classmethod
+    def _is_staticness_check(cls, test: ast.expr) -> bool:
+        """`x is None` / `x is not None` (and and/or/not combinations of
+        them) are trace-time-static gates on optional args — standard and
+        safe."""
+        if isinstance(test, ast.Compare):
+            return all(isinstance(op, (ast.Is, ast.IsNot))
+                       for op in test.ops)
+        if isinstance(test, ast.BoolOp):
+            return all(cls._is_staticness_check(v) for v in test.values)
+        if isinstance(test, ast.UnaryOp) and isinstance(test.op, ast.Not):
+            return cls._is_staticness_check(test.operand)
+        return False
+
+
+class RecompileHazard(_FamilyARule):
+    id = "GL003"
+    name = "recompile-hazard"
+    description = (
+        "jax.jit / pallas_call constructed inside a function body: every "
+        "call builds a fresh compiled callable, so nothing is ever cached "
+        "and the hot path pays XLA compilation per invocation. Hoist to "
+        "module level, cache on self in __init__, or wrap the builder in "
+        "functools.lru_cache."
+    )
+
+    _BUILDER_NAMES = {"pallas_call"}
+
+    def check(self, module: SourceModule) -> Iterator[Finding]:
+        analysis = jaxctx.analyze(module)
+        for node in ast.walk(module.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            is_builder = jaxctx.is_jit_expr(node.func) or \
+                jaxctx.func_terminal_name(node.func) in self._BUILDER_NAMES
+            if not is_builder:
+                continue
+            # `jax.jit(f)(args)`: the inner jax.jit(f) Call is the build
+            # site; don't double-flag the outer invocation
+            if isinstance(node.func, ast.Call) and \
+                    jaxctx.is_jit_expr(node.func.func):
+                continue
+            encl = analysis._enclosing_function(node)
+            if encl is None:
+                continue                      # module level: compiled once
+            if encl.name == "__init__" or self._is_cached(encl) \
+                    or self._stored_on_self(node, analysis):
+                continue
+            # a jitted/traced enclosing body means this IS the kernel
+            # construction point inside a trace — still a per-trace build,
+            # but pallas_call inside a jitted wrapper is the documented
+            # pattern (the wrapper itself caches); only flag un-jitted
+            # enclosing functions
+            if encl in analysis.kernels:
+                continue
+            yield self.finding(
+                module, node,
+                f"compiled-callable construction inside `{encl.name}()` — "
+                f"a fresh jit/pallas_call per invocation recompiles every "
+                f"call; hoist to module level or cache it")
+
+    @staticmethod
+    def _is_cached(fn: ast.AST) -> bool:
+        for dec in getattr(fn, "decorator_list", []):
+            name = jaxctx.func_terminal_name(dec) or \
+                jaxctx.func_terminal_name(getattr(dec, "func", dec))
+            if name in {"lru_cache", "cache", "cached_property"}:
+                return True
+        return False
+
+    def _stored_on_self(self, node: ast.Call,
+                        analysis: jaxctx.JaxModuleAnalysis) -> bool:
+        """`self.fn = jax.jit(...)` caches per instance — accept it."""
+        parent = analysis.parents.get(node)
+        if isinstance(parent, ast.Assign):
+            return any(isinstance(t, ast.Attribute) for t in parent.targets)
+        return False
+
+
+class TracerLeak(_FamilyARule):
+    id = "GL004"
+    name = "tracer-leak"
+    description = (
+        "State written from inside a traced body: assignment to "
+        "self/globals/closure state, or mutation (.append/.update/...) of "
+        "a name not local to the kernel. The write happens once at trace "
+        "time, not per call — and if the value is a tracer it escapes the "
+        "trace and poisons later operations (JAX's UnexpectedTracerError)."
+    )
+
+    _MUTATORS = {"append", "extend", "insert", "add", "update",
+                 "setdefault", "pop", "popitem", "remove", "clear",
+                 "discard"}
+
+    def check(self, module: SourceModule) -> Iterator[Finding]:
+        analysis = jaxctx.analyze(module)
+        for info in analysis.kernel_items():
+            local_names = self._local_names(info)
+            for node in analysis.body_nodes(info.fn):
+                yield from self._check_node(module, analysis, info, node,
+                                            local_names)
+
+    def _local_names(self, info: jaxctx.KernelInfo) -> set[str]:
+        names: set[str] = set(jaxctx.all_params(info.fn))
+        for node in ast.walk(info.fn):
+            if isinstance(node, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
+                targets = node.targets if isinstance(node, ast.Assign) \
+                    else [node.target]
+                for t in targets:
+                    for n in ast.walk(t):
+                        if isinstance(n, ast.Name):
+                            names.add(n.id)
+            elif isinstance(node, (ast.For, ast.AsyncFor)):
+                for n in ast.walk(node.target):
+                    if isinstance(n, ast.Name):
+                        names.add(n.id)
+            elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                names.add(node.name)
+            elif isinstance(node, ast.withitem) and node.optional_vars:
+                for n in ast.walk(node.optional_vars):
+                    if isinstance(n, ast.Name):
+                        names.add(n.id)
+            elif isinstance(node, ast.comprehension):
+                for n in ast.walk(node.target):
+                    if isinstance(n, ast.Name):
+                        names.add(n.id)
+        return names
+
+    def _check_node(self, module: SourceModule,
+                    analysis: jaxctx.JaxModuleAnalysis,
+                    info: jaxctx.KernelInfo, node: ast.AST,
+                    local_names: set[str]) -> Iterator[Finding]:
+        if isinstance(node, (ast.Global, ast.Nonlocal)):
+            yield self.finding(
+                module, node,
+                f"`{type(node).__name__.lower()}` declaration inside a "
+                f"traced body — writes escape the trace (run once at "
+                f"trace time, never per call)")
+        elif isinstance(node, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+            targets = node.targets if isinstance(node, ast.Assign) \
+                else [node.target]
+            for t in targets:
+                if isinstance(t, ast.Attribute):
+                    base = t.value
+                    if isinstance(base, ast.Name) and \
+                            base.id in ("self", "cls"):
+                        yield self.finding(
+                            module, t,
+                            f"traced body stores to `{base.id}.{t.attr}` "
+                            f"— instance state written at trace time "
+                            f"leaks tracers and skews re-traces")
+                elif isinstance(t, ast.Subscript):
+                    base = t.value
+                    if isinstance(base, ast.Name) and \
+                            base.id not in local_names:
+                        yield self.finding(
+                            module, t,
+                            f"traced body writes into non-local "
+                            f"`{base.id}[...]` — mutation escapes the "
+                            f"trace")
+        elif isinstance(node, ast.Call) and \
+                isinstance(node.func, ast.Attribute) and \
+                node.func.attr in self._MUTATORS:
+            base = node.func.value
+            if isinstance(base, ast.Name) and base.id not in local_names:
+                yield self.finding(
+                    module, node,
+                    f"traced body mutates non-local `{base.id}"
+                    f".{node.func.attr}(...)` — runs once at trace time "
+                    f"and leaks any traced argument")
+
+
+class DtypeDrift(_FamilyARule):
+    id = "GL005"
+    name = "dtype-drift"
+    description = (
+        "float64 (or default-dtype numpy constructors) inside TPU kernel "
+        "code: np.zeros(n)/np.arange(n) default to float64/int64 and bake "
+        "wide constants into the trace; explicit float64 upcasts VPU "
+        "integer math. Kernels are int32/float32 throughout — pass dtype= "
+        "explicitly."
+    )
+
+    def check(self, module: SourceModule) -> Iterator[Finding]:
+        analysis = jaxctx.analyze(module)
+        for info in analysis.kernel_items():
+            for node in analysis.body_nodes(info.fn):
+                if isinstance(node, ast.Attribute) and \
+                        node.attr == "float64":
+                    chain = jaxctx.attr_chain(node)
+                    yield self.finding(
+                        module, node,
+                        f"`{'.'.join(chain)}` inside a kernel — solver "
+                        f"kernels are int32/float32; float64 upcasts the "
+                        f"whole expression")
+                elif isinstance(node, ast.Constant) and \
+                        node.value == "float64":
+                    yield self.finding(
+                        module, node,
+                        "\"float64\" dtype string inside a kernel — "
+                        "solver kernels are int32/float32")
+                elif isinstance(node, ast.Call):
+                    chain = jaxctx.attr_chain(node.func)
+                    if len(chain) >= 2 and chain[0] in _NUMPY_ALIASES \
+                            and chain[-1] in _NP_DEFAULT_DTYPE_CTORS \
+                            and not any(k.arg == "dtype"
+                                        for k in node.keywords):
+                        yield self.finding(
+                            module, node,
+                            f"`{'.'.join(chain)}` without dtype= inside a "
+                            f"kernel — numpy defaults to float64/int64 "
+                            f"and bakes a wide constant into the trace")
+
+
+class MissingDonation(_FamilyARule):
+    id = "GL006"
+    name = "missing-donation"
+    description = (
+        "jit-wrapped solve entry point without donate_argnums/"
+        "donate_argnames: the per-solve input buffer (multi-MB at the 10k-"
+        "pod regime) is kept alive across the call, doubling device-memory "
+        "footprint and blocking XLA's input/output aliasing. Donate the "
+        "transient problem buffer (never the resident catalog tensors)."
+    )
+
+    # jit entry points considered "solve entry points": the public
+    # dispatch surface of the solver (name-based contract, see
+    # docs/development.md)
+    _ENTRY_PREFIXES = ("solve_", "solve")
+
+    def check(self, module: SourceModule) -> Iterator[Finding]:
+        analysis = jaxctx.analyze(module)
+        for dec in analysis.jit_decorations:
+            name = dec.fn.name
+            if not name.startswith(self._ENTRY_PREFIXES):
+                continue
+            nonstatic = [p for p in jaxctx.positional_params(dec.fn)
+                         if p not in dec.static_params
+                         and p not in ("self", "cls")]
+            if not nonstatic:
+                continue
+            if not dec.donates:
+                yield self.finding(
+                    module, dec.fn,
+                    f"jitted solve entry `{name}` takes array buffers "
+                    f"({', '.join(nonstatic[:3])}...) but declares no "
+                    f"donate_argnums — the transient input buffer stays "
+                    f"alive across the call")

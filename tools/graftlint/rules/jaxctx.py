@@ -1,0 +1,429 @@
+"""Shared JAX context analysis for Family A rules.
+
+Answers one question for the purity checkers: *which function bodies are
+traced* (jit/scan/pallas/vmap kernels plus everything they call inside
+the module), and *which names inside them are tracers* (a light
+intra/inter-procedural taint over function params and assignments).
+
+Precision notes:
+- ``static_argnums`` / ``static_argnames`` params are NOT tainted — a
+  Python ``if`` on a static arg is shape-static control flow, which is
+  exactly how this codebase selects output layouts (dense16/coo16).
+- ``x.shape`` / ``x.ndim`` / ``x.dtype`` / ``len(x)`` are Python values
+  at trace time — subtrees under them are untainted.
+- Calls from a kernel body to module-level functions (or ``self.``
+  methods of the same class) propagate: the callee becomes a kernel and
+  its params inherit taint from the actual arguments at each call site.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+from collections.abc import Iterator, Sequence
+
+from tools.graftlint.engine import SourceModule
+
+# f in jax.jit(f) / decorator position
+_JIT_NAMES = {"jit"}
+# call names whose function-valued args are traced
+_COMBINATORS = {
+    "scan", "pallas_call", "vmap", "pmap", "grad", "value_and_grad",
+    "checkpoint", "remat", "shard_map", "while_loop", "fori_loop", "cond",
+    "switch", "associated_scan", "associative_scan", "map", "custom_vjp",
+    "custom_jvp",
+}
+# lax.map/jax ``map`` only counts with an attribute base (never builtin map)
+_ATTR_ONLY_COMBINATORS = {"map"}
+
+_SHAPE_ATTRS = {"shape", "ndim", "dtype", "size"}
+_UNTAINTED_CALLS = {"len", "isinstance", "range", "type"}
+
+
+def func_terminal_name(func: ast.AST) -> str | None:
+    if isinstance(func, ast.Name):
+        return func.id
+    if isinstance(func, ast.Attribute):
+        return func.attr
+    return None
+
+
+def attr_chain(node: ast.AST) -> list[str]:
+    """x.y.z -> ["x", "y", "z"]; non-name bases contribute nothing."""
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+    return list(reversed(parts))
+
+
+def is_jit_expr(node: ast.AST) -> bool:
+    """jax.jit / jit / functools.partial(jax.jit, ...) / jax.jit(...)"""
+    if isinstance(node, (ast.Name, ast.Attribute)):
+        return func_terminal_name(node) in _JIT_NAMES
+    if isinstance(node, ast.Call):
+        name = func_terminal_name(node.func)
+        if name in _JIT_NAMES:
+            return True
+        if name == "partial" and node.args \
+                and is_jit_expr(node.args[0]):
+            return True
+    return False
+
+
+def jit_call_kwargs(node: ast.AST) -> dict[str, ast.expr]:
+    """keyword args of the jit(...) / partial(jax.jit, ...) expression."""
+    if isinstance(node, ast.Call):
+        return {k.arg: k.value for k in node.keywords if k.arg}
+    return {}
+
+
+def _const_str_seq(node: ast.expr | None) -> list[str]:
+    if node is None:
+        return []
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return [node.value]
+    if isinstance(node, (ast.Tuple, ast.List, ast.Set)):
+        return [e.value for e in node.elts
+                if isinstance(e, ast.Constant) and isinstance(e.value, str)]
+    return []
+
+
+def _const_int_seq(node: ast.expr | None) -> list[int]:
+    if node is None:
+        return []
+    if isinstance(node, ast.Constant) and isinstance(node.value, int):
+        return [node.value]
+    if isinstance(node, (ast.Tuple, ast.List, ast.Set)):
+        return [e.value for e in node.elts
+                if isinstance(e, ast.Constant) and isinstance(e.value, int)]
+    return []
+
+
+def positional_params(fn: ast.AST) -> list[str]:
+    a = fn.args
+    return [p.arg for p in (a.posonlyargs + a.args)]
+
+
+def all_params(fn: ast.AST) -> list[str]:
+    a = fn.args
+    names = [p.arg for p in (a.posonlyargs + a.args + a.kwonlyargs)]
+    if a.vararg:
+        names.append(a.vararg.arg)
+    if a.kwarg:
+        names.append(a.kwarg.arg)
+    return names
+
+
+@dataclass
+class JitDecoration:
+    """A module/class-level def wrapped in jit (decorator form)."""
+
+    fn: ast.AST                     # FunctionDef | AsyncFunctionDef
+    decorator: ast.expr
+    static_params: set[str]
+    kwargs: dict[str, ast.expr] = field(default_factory=dict)
+
+    @property
+    def donates(self) -> bool:
+        return "donate_argnums" in self.kwargs \
+            or "donate_argnames" in self.kwargs
+
+
+def jit_decoration(fn: ast.AST) -> JitDecoration | None:
+    for dec in getattr(fn, "decorator_list", []):
+        if not is_jit_expr(dec):
+            continue
+        kwargs = jit_call_kwargs(dec)
+        static = set(_const_str_seq(kwargs.get("static_argnames")))
+        pos = positional_params(fn)
+        for i in _const_int_seq(kwargs.get("static_argnums")):
+            if 0 <= i < len(pos):
+                static.add(pos[i])
+        # keyword-only params listed in static_argnames already covered
+        return JitDecoration(fn=fn, decorator=dec, static_params=static,
+                             kwargs=kwargs)
+    return None
+
+
+@dataclass
+class KernelInfo:
+    fn: ast.AST
+    reason: str                     # "jit" | "combinator" | "called" | "nested"
+    tainted: set[str] = field(default_factory=set)
+    static_params: set[str] = field(default_factory=set)
+
+
+class _ParentVisitor(ast.NodeVisitor):
+    def __init__(self):
+        self.parents: dict[ast.AST, ast.AST] = {}
+
+    def generic_visit(self, node):
+        for child in ast.iter_child_nodes(node):
+            self.parents[child] = node
+        super().generic_visit(node)
+
+
+class JaxModuleAnalysis:
+    """Kernel discovery + taint for one module."""
+
+    def __init__(self, module: SourceModule):
+        self.module = module
+        tree = module.tree
+        pv = _ParentVisitor()
+        pv.visit(tree)
+        self.parents = pv.parents
+
+        self.defs: list[ast.AST] = [
+            n for n in ast.walk(tree)
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))]
+        # resolution tables: module-level name -> def, (class, name) -> def
+        self.module_funcs: dict[str, ast.AST] = {}
+        self.methods: dict[tuple[ast.AST, str], ast.AST] = {}
+        for fn in self.defs:
+            parent = self.parents.get(fn)
+            if isinstance(parent, ast.Module):
+                self.module_funcs[fn.name] = fn
+            elif isinstance(parent, ast.ClassDef):
+                self.methods[(parent, fn.name)] = fn
+
+        self.jit_decorations: list[JitDecoration] = []
+        self.kernels: dict[ast.AST, KernelInfo] = {}
+        self._discover()
+        self._propagate()
+
+    # -- discovery ---------------------------------------------------------
+
+    def _discover(self) -> None:
+        for fn in self.defs:
+            dec = jit_decoration(fn)
+            if dec is not None:
+                self.jit_decorations.append(dec)
+                tainted = {p for p in all_params(fn)
+                           if p not in dec.static_params} - {"self", "cls"}
+                self._add_kernel(fn, "jit", tainted, dec.static_params)
+        # functions passed to combinators / jit(f) call-form, anywhere
+        for node in ast.walk(self.module.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = func_terminal_name(node.func)
+            is_comb = name in _COMBINATORS and (
+                name not in _ATTR_ONLY_COMBINATORS
+                or isinstance(node.func, ast.Attribute))
+            if is_comb:
+                for arg in list(node.args) + [k.value for k in node.keywords]:
+                    fn = self._resolve_callable(arg, node)
+                    if fn is not None:
+                        tainted = set(all_params(fn)) - {"self", "cls"}
+                        self._add_kernel(fn, "combinator", tainted, set())
+            elif is_jit_expr(node.func) or (
+                    name == "partial" and node.args
+                    and is_jit_expr(node.args[0])):
+                for arg in node.args:
+                    fn = self._resolve_callable(arg, node)
+                    if fn is not None:
+                        kwargs = jit_call_kwargs(node)
+                        static = set(
+                            _const_str_seq(kwargs.get("static_argnames")))
+                        pos = positional_params(fn)
+                        for i in _const_int_seq(kwargs.get("static_argnums")):
+                            if 0 <= i < len(pos):
+                                static.add(pos[i])
+                        tainted = {p for p in all_params(fn)
+                                   if p not in static} - {"self", "cls"}
+                        self._add_kernel(fn, "jit", tainted, static)
+
+    def _resolve_callable(self, arg: ast.AST,
+                          at: ast.AST) -> ast.AST | None:
+        if isinstance(arg, ast.Name):
+            # prefer a local def visible from the call site
+            fn = self._enclosing_local_def(arg.id, at)
+            if fn is not None:
+                return fn
+            return self.module_funcs.get(arg.id)
+        if isinstance(arg, ast.Attribute) and \
+                isinstance(arg.value, ast.Name) and \
+                arg.value.id in ("self", "cls"):
+            cls = self._enclosing_class(at)
+            if cls is not None:
+                return self.methods.get((cls, arg.attr))
+        return None
+
+    def _enclosing_local_def(self, name: str,
+                             at: ast.AST) -> ast.AST | None:
+        scope = self._enclosing_function(at)
+        while scope is not None:
+            for n in ast.walk(scope):
+                if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                        and n.name == name and n is not scope:
+                    return n
+            scope = self._enclosing_function(self.parents.get(scope))
+        return None
+
+    def _enclosing_function(self, node: ast.AST | None) -> ast.AST | None:
+        while node is not None:
+            node = self.parents.get(node)
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                return node
+        return None
+
+    def _enclosing_class(self, node: ast.AST | None) -> ast.AST | None:
+        while node is not None:
+            node = self.parents.get(node)
+            if isinstance(node, ast.ClassDef):
+                return node
+        return None
+
+    def _add_kernel(self, fn: ast.AST, reason: str, tainted: set[str],
+                    static: set[str]) -> bool:
+        info = self.kernels.get(fn)
+        if info is None:
+            self.kernels[fn] = KernelInfo(fn=fn, reason=reason,
+                                          tainted=set(tainted),
+                                          static_params=set(static))
+            return True
+        before = len(info.tainted)
+        info.tainted |= tainted
+        return len(info.tainted) != before
+
+    # -- propagation -------------------------------------------------------
+
+    def _propagate(self) -> None:
+        for _ in range(10):
+            changed = False
+            for fn, info in list(self.kernels.items()):
+                changed |= self._settle_local_taint(info)
+                changed |= self._mark_nested(fn, info)
+                changed |= self._propagate_calls(fn, info)
+            if not changed:
+                break
+
+    def _settle_local_taint(self, info: KernelInfo) -> bool:
+        """Names assigned from tainted expressions become tainted
+        (2-pass fixpoint inside _propagate's outer loop)."""
+        changed = False
+        for node in self.body_nodes(info.fn):
+            targets: list[ast.expr] = []
+            value: ast.expr | None = None
+            if isinstance(node, ast.Assign):
+                targets, value = node.targets, node.value
+            elif isinstance(node, ast.AugAssign):
+                targets, value = [node.target], node.value
+            elif isinstance(node, ast.AnnAssign) and node.value is not None:
+                targets, value = [node.target], node.value
+            elif isinstance(node, (ast.For, ast.AsyncFor)):
+                targets, value = [node.target], node.iter
+            elif isinstance(node, ast.withitem) and \
+                    node.optional_vars is not None:
+                targets, value = [node.optional_vars], node.context_expr
+            if value is None or not self.expr_tainted(value, info):
+                continue
+            for t in targets:
+                for n in ast.walk(t):
+                    if isinstance(n, ast.Name) and \
+                            n.id not in info.tainted:
+                        info.tainted.add(n.id)
+                        changed = True
+        return changed
+
+    def _mark_nested(self, fn: ast.AST, info: KernelInfo) -> bool:
+        changed = False
+        for node in self.body_nodes(fn):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                tainted = set(all_params(node)) - {"self", "cls"}
+                # closure names tainted in the enclosing kernel stay
+                # tainted inside the nested def
+                tainted |= info.tainted
+                changed |= self._add_kernel(node, "nested", tainted, set())
+            if isinstance(node, ast.Lambda):
+                pass  # lambdas share the enclosing kernel's taint via scope
+        return changed
+
+    def _propagate_calls(self, fn: ast.AST, info: KernelInfo) -> bool:
+        changed = False
+        for node in self.body_nodes(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            callee = self._resolve_callable(node.func, node)
+            if callee is None or callee is fn:
+                continue
+            pos = positional_params(callee)
+            if pos and pos[0] in ("self", "cls"):
+                pos = pos[1:]
+            tainted: set[str] = set()
+            for i, arg in enumerate(node.args):
+                if isinstance(arg, ast.Starred):
+                    continue
+                if i < len(pos) and self.expr_tainted(arg, info):
+                    tainted.add(pos[i])
+            callee_params = set(all_params(callee))
+            for kw in node.keywords:
+                if kw.arg and kw.arg in callee_params \
+                        and self.expr_tainted(kw.value, info):
+                    tainted.add(kw.arg)
+            changed |= self._add_kernel(callee, "called", tainted, set())
+        return changed
+
+    # -- queries -----------------------------------------------------------
+
+    def body_nodes(self, fn: ast.AST,
+                   include_nested: bool = False) -> Iterator[ast.AST]:
+        """Walk a kernel's own body; nested defs are their own kernels so
+        their subtrees are skipped unless asked for."""
+        stack: list[ast.AST] = []
+        for stmt in fn.body:
+            stack.append(stmt)
+        while stack:
+            node = stack.pop()
+            yield node
+            if not include_nested and isinstance(
+                    node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            stack.extend(ast.iter_child_nodes(node))
+
+    def expr_tainted(self, node: ast.AST, info: KernelInfo) -> bool:
+        if isinstance(node, ast.Name):
+            return node.id in info.tainted
+        if isinstance(node, ast.Attribute):
+            if node.attr in _SHAPE_ATTRS:
+                return False
+            return self.expr_tainted(node.value, info)
+        if isinstance(node, ast.Call):
+            name = func_terminal_name(node.func)
+            if isinstance(node.func, ast.Name) and \
+                    name in _UNTAINTED_CALLS:
+                return False
+            if any(self.expr_tainted(a, info) for a in node.args):
+                return True
+            if any(self.expr_tainted(k.value, info)
+                   for k in node.keywords):
+                return True
+            # method call on a tainted object (x.sum(), x.astype(...))
+            if isinstance(node.func, ast.Attribute):
+                return self.expr_tainted(node.func.value, info)
+            return False
+        if isinstance(node, (ast.Constant, ast.Lambda)):
+            return False
+        return any(self.expr_tainted(child, info)
+                   for child in ast.iter_child_nodes(node))
+
+    def kernel_items(self) -> Sequence[KernelInfo]:
+        return list(self.kernels.values())
+
+
+_CACHE: dict[int, tuple[SourceModule, JaxModuleAnalysis]] = {}
+
+
+def analyze(module: SourceModule) -> JaxModuleAnalysis:
+    """Per-module analysis cache (every Family A rule shares one pass)."""
+    cached = _CACHE.get(id(module))
+    if cached is not None and cached[0] is module:
+        return cached[1]
+    result = JaxModuleAnalysis(module)
+    _CACHE[id(module)] = (module, result)
+    if len(_CACHE) > 64:
+        _CACHE.clear()
+        _CACHE[id(module)] = (module, result)
+    return result
