@@ -19,8 +19,8 @@ test: unit ## Alias for unit
 ci: unit lint graftlint ## All CI checks (tests + linting + graftlint)
 
 .PHONY: unit
-unit: ## Full unit/integration suite on the virtual CPU mesh
-	$(TEST_ENV) $(PY) -m pytest tests/ -x -q --ignore=tests/e2e
+unit: ## Full unit/integration suite on the virtual CPU mesh (slow soaks live in `make chaos`)
+	$(TEST_ENV) $(PY) -m pytest tests/ -x -q --ignore=tests/e2e -m "not slow"
 
 .PHONY: lint
 lint: ## Ruff lint (config: ruff.toml); under CI=true a missing ruff FAILS
@@ -41,6 +41,16 @@ graftlint: ## JAX/TPU purity + concurrency static analysis (tools/graftlint)
 .PHONY: graftlint-baseline
 graftlint-baseline: ## Re-accept current graftlint findings into the debt ledger
 	$(PY) -m tools.graftlint --update-baseline
+
+.PHONY: chaos
+chaos: ## Seeded chaos matrix (profiles x seeds, deterministic; docs/design/chaos.md)
+	$(TEST_ENV) $(PY) -m karpenter_tpu.chaos --seeds 4 --rounds 10 \
+		--trace-dir .chaos-traces
+
+.PHONY: chaos-replay
+chaos-replay: ## Replay one failing scenario: make chaos-replay PROFILE=spot-storm SEED=3
+	$(TEST_ENV) $(PY) -m karpenter_tpu.chaos \
+		--profile $(PROFILE) --seed $(SEED) --rounds 10
 
 .PHONY: test-stress
 test-stress: ## Adversarial-interleaving concurrency tier, repeated (the -race analogue)

@@ -32,6 +32,10 @@ class NodeClaim:
     requirements: Requirements = field(default_factory=Requirements)
     # lifecycle
     created_at: float = field(default_factory=time.time)
+    # stamped once by the registration controller; anchors the
+    # never-ready grace window (a node object recreated by re-adoption
+    # must NOT reset it — interruption suppression keys on the claim)
+    registered_at: float = 0.0
     registered: bool = False
     initialized: bool = False
     launched: bool = False

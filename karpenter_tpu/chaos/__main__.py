@@ -1,0 +1,67 @@
+"""Chaos harness CLI (`make chaos`).
+
+    python -m karpenter_tpu.chaos                         # full matrix
+    python -m karpenter_tpu.chaos --seeds 4 --rounds 10
+    python -m karpenter_tpu.chaos --profile spot-storm --seed 3   # replay
+    python -m karpenter_tpu.chaos --list-profiles
+
+Exit codes: 0 all invariants held and every trace was reproducible,
+1 any invariant violation or determinism failure, 2 usage error.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+# the harness never needs an accelerator; force CPU before jax can
+# initialize a backend through any transitive import
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+from karpenter_tpu.chaos.profile import FIXTURE_PROFILES, PROFILES  # noqa: E402
+from karpenter_tpu.chaos.runner import run_matrix, run_scenario  # noqa: E402
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(prog="karpenter_tpu.chaos")
+    ap.add_argument("--profile", action="append", default=None,
+                    help="profile name (repeatable; default: full matrix)")
+    ap.add_argument("--seed", type=int, default=None,
+                    help="single seed (replay mode)")
+    ap.add_argument("--seeds", type=int, default=4,
+                    help="run seeds 1..N (default 4)")
+    ap.add_argument("--rounds", type=int, default=10)
+    ap.add_argument("--no-verify-determinism", action="store_true",
+                    help="skip the double-run trace-digest comparison")
+    ap.add_argument("--trace-dir", default=".chaos-traces",
+                    help="where failing scenarios dump their event trace")
+    ap.add_argument("--list-profiles", action="store_true")
+    args = ap.parse_args(argv)
+
+    if args.list_profiles:
+        for name, p in {**PROFILES, **FIXTURE_PROFILES}.items():
+            tag = " [fixture]" if p.fixture else ""
+            print(f"{name:<18}{tag} {p.description}")
+        return 0
+
+    seeds = (args.seed,) if args.seed is not None \
+        else tuple(range(1, args.seeds + 1))
+    if args.profile and args.seed is not None and len(args.profile) == 1:
+        # replay mode: one scenario, full report
+        res = run_scenario(args.profile[0], args.seed, rounds=args.rounds)
+        if res.violations:
+            print(res.render_failure())
+            return 1
+        print(f"ok   {res.profile} seed={res.seed} "
+              f"events={len(res.trace)} digest={res.digest[:12]}")
+        return 0
+    _, failures = run_matrix(
+        args.profile, seeds, rounds=args.rounds,
+        verify_determinism=not args.no_verify_determinism,
+        trace_dir=args.trace_dir)
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

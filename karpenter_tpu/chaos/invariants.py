@@ -1,0 +1,156 @@
+"""Invariant catalog: what must stay true no matter what chaos does.
+
+Checked between ``ControllerManager.sync()`` rounds against GROUND TRUTH
+(the unwrapped fake cloud + cluster state), never through the chaos
+proxy — an invariant checker that can be lied to proves nothing.
+
+Round invariants (hold continuously, modulo a convergence grace sized in
+scenario rounds):
+
+- ``no-stale-orphan``: no Karpenter-tagged instance older than
+  ``orphan_grace`` without a claim or node tracking it (leaked creates
+  must be reaped by GC / orphan cleanup);
+- ``no-stuck-claim``: no live claim still uninitialized past
+  ``stuck_claim_grace`` (registration or GC replacement must act);
+- ``solver-plan-valid``: every plan that reached actuation passed the
+  independent ``solver/validate.py`` oracle.
+
+Final invariants (eventual, checked after the quiesce phase):
+
+- ``blackouts-expire``: every UnavailableOfferings entry expired once
+  its TTL elapsed on the virtual clock;
+- ``pods-resolve``: every pending pod is bound, or provably unplaceable
+  (its requests fit no offering in the catalog).
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass
+
+from karpenter_tpu.apis.nodeclaim import parse_provider_id
+from karpenter_tpu.chaos.trace import EventTrace
+from karpenter_tpu.core.actuator import KARPENTER_TAGS
+
+
+@dataclass(frozen=True)
+class Violation:
+    invariant: str
+    detail: str
+
+    def render(self) -> str:
+        return f"[{self.invariant}] {self.detail}"
+
+
+class InvariantChecker:
+    def __init__(self, cluster, cloud, unavailable, *,
+                 orphan_grace: float, stuck_claim_grace: float,
+                 solver_violations: list[str] | None = None,
+                 trace: EventTrace | None = None):
+        self.cluster = cluster
+        self.cloud = cloud              # ground truth: the UNWRAPPED fake
+        self.unavailable = unavailable
+        self.orphan_grace = orphan_grace
+        self.stuck_claim_grace = stuck_claim_grace
+        # shared with the harness's ValidatingSolver; drained per check
+        self.solver_violations = solver_violations \
+            if solver_violations is not None else []
+        self.trace = trace
+
+    # -- round invariants ----------------------------------------------------
+
+    def check_round(self) -> list[Violation]:
+        out: list[Violation] = []
+        out.extend(self._no_stale_orphans())
+        out.extend(self._no_stuck_claims())
+        out.extend(self._solver_plans_valid())
+        if self.trace is not None:
+            self.trace.add("invariants", phase="round", violations=len(out),
+                           kinds=sorted({v.invariant for v in out}))
+        return out
+
+    def _tracked_instance_ids(self) -> set:
+        ids = set()
+        for claim in self.cluster.nodeclaims():
+            parsed = parse_provider_id(claim.provider_id)
+            if parsed:
+                ids.add(parsed[1])
+        for node in self.cluster.nodes():
+            parsed = parse_provider_id(node.provider_id)
+            if parsed:
+                ids.add(parsed[1])
+        return ids
+
+    def _no_stale_orphans(self) -> list[Violation]:
+        now = time.time()
+        tracked = self._tracked_instance_ids()
+        out = []
+        for inst in self.cloud.list_instances():
+            if not all(inst.tags.get(k) == v for k, v in KARPENTER_TAGS.items()):
+                continue   # unmanaged: never ours to track (or reap)
+            age = now - inst.created_at
+            if inst.id not in tracked and age > self.orphan_grace:
+                out.append(Violation(
+                    "no-stale-orphan",
+                    f"tagged instance {inst.id} ({inst.profile}/{inst.zone}) "
+                    f"untracked for {age:.0f}s > {self.orphan_grace:.0f}s"))
+        return out
+
+    def _no_stuck_claims(self) -> list[Violation]:
+        now = time.time()
+        out = []
+        for claim in self.cluster.nodeclaims():
+            if claim.deleted or not claim.launched or claim.initialized:
+                continue
+            age = now - claim.created_at
+            if age > self.stuck_claim_grace:
+                out.append(Violation(
+                    "no-stuck-claim",
+                    f"claim {claim.name} uninitialized for {age:.0f}s "
+                    f"> {self.stuck_claim_grace:.0f}s"))
+        return out
+
+    def _solver_plans_valid(self) -> list[Violation]:
+        out = [Violation("solver-plan-valid", v)
+               for v in self.solver_violations]
+        self.solver_violations.clear()
+        return out
+
+    # -- final (eventual) invariants -----------------------------------------
+
+    def check_final(self, catalog=None) -> list[Violation]:
+        out: list[Violation] = []
+        stale = self.unavailable.unavailable_keys()
+        if stale:
+            out.append(Violation(
+                "blackouts-expire",
+                f"{len(stale)} offering blackouts survived the quiesce "
+                f"window: {sorted(stale)[:3]}"))
+        out.extend(self._pods_resolve(catalog))
+        if self.trace is not None:
+            self.trace.add("invariants", phase="final", violations=len(out),
+                           kinds=sorted({v.invariant for v in out}))
+        return out
+
+    def _pods_resolve(self, catalog) -> list[Violation]:
+        out = []
+        for pending in self.cluster.pending_pods():
+            if pending.bound_node:
+                continue
+            if catalog is not None and not self._placeable(pending.spec, catalog):
+                continue   # explicitly unplaceable: fits no offering
+            out.append(Violation(
+                "pods-resolve",
+                f"pod {pending.spec.namespace}/{pending.spec.name} still "
+                f"unbound after quiesce (nominated="
+                f"{pending.nominated_node or '-'})"))
+        return out
+
+    @staticmethod
+    def _placeable(pod, catalog) -> bool:
+        req = pod.requests.as_tuple()
+        alloc = catalog.offering_alloc()
+        for o in range(catalog.num_offerings):
+            if all(int(alloc[o, i]) >= req[i] for i in range(len(req))):
+                return True
+        return False

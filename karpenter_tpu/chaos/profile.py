@@ -1,0 +1,150 @@
+"""Declarative chaos profiles: what to break, how hard, how often.
+
+A :class:`ChaosProfile` is pure data — every stochastic decision it
+parameterizes is drawn from the harness's seeded ``random.Random``
+stream, so a profile + seed fully determines the fault schedule.  Error
+kinds name the ``cloud/errors.py`` taxonomy (429 with Retry-After, 5xx,
+timeouts, not-found ...); storm knobs drive the fake cloud's
+spot-preemption / health-degradation / capacity hooks so
+``controllers/faults.py`` sees exactly the signals production would.
+
+The registry ships the scenario matrix ``make chaos`` runs plus fixture
+profiles (``fixture=True``) used by tests to prove the harness *fails*
+when an invariant is really broken.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+# weighted draw over the cloud error taxonomy (kind -> weight); kinds are
+# materialized into CloudErrors by chaos/cloud.py
+DEFAULT_ERROR_KINDS: tuple[tuple[str, float], ...] = (
+    ("rate_limited", 3.0),       # 429 + Retry-After
+    ("internal", 2.0),           # 500
+    ("unavailable", 2.0),        # 503
+    ("timeout", 2.0),            # 408
+    ("conflict", 0.5),           # 409, non-retryable
+    ("not_found", 0.5),          # 404 — the cloud lying; must self-heal
+)
+
+
+@dataclass(frozen=True)
+class ChaosProfile:
+    """One named fault-injection configuration (see docs/design/chaos.md)."""
+
+    name: str
+    description: str = ""
+    # per-call error injection: method name (or "*" for any wrapped
+    # method) -> probability of raising an injected CloudError
+    error_rates: dict[str, float] = field(default_factory=dict)
+    error_kinds: tuple[tuple[str, float], ...] = DEFAULT_ERROR_KINDS
+    # injected latency in VIRTUAL seconds: method (or "*") -> (lo, hi)
+    latency: dict[str, tuple[float, float]] = field(default_factory=dict)
+    # list_* calls return a random subset with this probability
+    partial_list_rate: float = 0.0
+    # create_instance succeeds server-side but the response is "lost":
+    # a tagged instance leaks with no claim (the orphan-cleanup path)
+    create_leak_rate: float = 0.0
+    # per-tick storms (one tick per scenario round)
+    preempt_storm_rate: float = 0.0      # P(spot preemption storm this tick)
+    preempt_storm_frac: float = 0.5      # P(each spot instance is hit)
+    degrade_rate: float = 0.0            # P(one instance health degrades)
+    capacity_blackout_rate: float = 0.0  # P(a (type, zone) loses capacity)
+    capacity_blackout_rounds: int = 3    # ticks a blackout lasts
+    # solver-layer failure injection (exercises the greedy degraded mode)
+    solver_failure_rate: float = 0.0
+    # workload shaping
+    pod_waves: int = 4                   # rounds that add a pod wave
+    pods_per_wave: tuple[int, int] = (8, 32)
+    # harness controllers skipped by name (fixture profiles use this to
+    # deliberately break an invariant)
+    disable_controllers: tuple[str, ...] = ()
+    fixture: bool = False                # excluded from the default matrix
+
+    def rate_for(self, method: str) -> float:
+        return self.error_rates.get(method, self.error_rates.get("*", 0.0))
+
+    def latency_for(self, method: str) -> tuple[float, float] | None:
+        return self.latency.get(method, self.latency.get("*"))
+
+
+def _profiles(*profiles: ChaosProfile) -> dict[str, ChaosProfile]:
+    return {p.name: p for p in profiles}
+
+
+# The scenario matrix (`make chaos` runs every non-fixture profile).
+PROFILES: dict[str, ChaosProfile] = _profiles(
+    ChaosProfile(
+        name="calm",
+        description="no faults — the control run proving the harness "
+                    "itself holds every invariant"),
+    ChaosProfile(
+        name="flaky-api",
+        description="background 5xx/timeout noise + jittered latency on "
+                    "every cloud call",
+        error_rates={"*": 0.08, "create_instance": 0.15},
+        latency={"*": (0.05, 2.0)}),
+    ChaosProfile(
+        name="rate-limited",
+        description="429 storms with Retry-After — exercises the "
+                    "honor-Retry-After + decorrelated-jitter retry stack",
+        error_rates={"*": 0.20},
+        error_kinds=(("rate_limited", 8.0), ("unavailable", 1.0)),
+        latency={"*": (0.01, 0.5)}),
+    ChaosProfile(
+        name="partial-lists",
+        description="list responses silently truncated + timeouts — "
+                    "controllers must never actuate destructively off an "
+                    "incomplete list",
+        partial_list_rate=0.30,
+        error_rates={"*": 0.05},
+        error_kinds=(("timeout", 3.0), ("unavailable", 1.0))),
+    ChaosProfile(
+        name="leaky-creates",
+        description="mid-create failures leak tagged instances with no "
+                    "claim — the orphan-cleanup/GC path must reap them",
+        create_leak_rate=0.35,
+        error_rates={"create_instance": 0.10}),
+    ChaosProfile(
+        name="spot-storm",
+        description="spot preemption storms + metadata health "
+                    "degradation — interruption/preemption controllers "
+                    "must black out offerings and replace capacity",
+        preempt_storm_rate=0.50, preempt_storm_frac=0.60,
+        degrade_rate=0.30),
+    ChaosProfile(
+        name="capacity-crunch",
+        description="rolling (type, zone) capacity blackouts — create "
+                    "failures must feed UnavailableOfferings and the "
+                    "solver must route around them",
+        capacity_blackout_rate=0.45, capacity_blackout_rounds=3,
+        error_rates={"create_instance": 0.05}),
+    ChaosProfile(
+        name="solver-degraded",
+        description="solver backend failures mid-provision — the "
+                    "degraded greedy fallback must complete the cycle",
+        solver_failure_rate=0.40,
+        error_rates={"*": 0.04}),
+)
+
+# Fixture profiles: deliberately broken worlds the test suite uses to
+# prove a real violation FAILS the run (with a replay command).
+FIXTURE_PROFILES: dict[str, ChaosProfile] = _profiles(
+    ChaosProfile(
+        name="broken-fixture",
+        description="leaky creates with GC + orphan cleanup disabled — "
+                    "the no-stale-orphan invariant MUST fire",
+        create_leak_rate=0.50,
+        disable_controllers=("nodeclaim.garbagecollection",
+                             "node.orphancleanup"),
+        fixture=True),
+)
+
+
+def get_profile(name: str) -> ChaosProfile:
+    p = PROFILES.get(name) or FIXTURE_PROFILES.get(name)
+    if p is None:
+        known = sorted(PROFILES) + sorted(FIXTURE_PROFILES)
+        raise KeyError(f"unknown chaos profile {name!r}; known: {known}")
+    return p

@@ -1,0 +1,315 @@
+"""Scenario soak runner: seeded chaos through the deterministic sync path.
+
+One scenario = one :class:`ChaosHarness`: a full provisioning stack
+(fake cloud behind :class:`ChaosCloud`, actuator, greedy solver behind
+the production degraded-mode wrapper, fault-ring + lifecycle
+controllers) driven strictly single-threaded — ``provision_once()`` +
+``ControllerManager.sync()`` on a :class:`VirtualClock`, never
+``start()``.  Rounds alternate workload waves, chaos ticks, a
+provision/join/sync pump, and invariant checks; then a quiesce phase
+lifts all faults and advances virtual time past every TTL so the
+*eventual* invariants (blackouts expire, pods resolve) become checkable.
+
+Determinism is enforced, not assumed: ``run_matrix`` executes every
+(profile, seed) cell twice and compares trace digests.  Any failure
+prints the exact replay command.
+"""
+
+from __future__ import annotations
+
+import random
+from dataclasses import dataclass
+from pathlib import Path
+
+from karpenter_tpu.apis.nodeclass import (
+    InstanceRequirements, NodeClass, NodeClassSpec, PlacementStrategy,
+)
+from karpenter_tpu.apis.pod import ResourceRequests, make_pods
+from karpenter_tpu.catalog.instancetype import InstanceTypeProvider
+from karpenter_tpu.catalog.pricing import PricingProvider
+from karpenter_tpu.catalog.unavailable import UnavailableOfferings
+from karpenter_tpu.chaos.clock import VirtualClock
+from karpenter_tpu.chaos.cloud import ChaosCloud
+from karpenter_tpu.chaos.invariants import InvariantChecker, Violation
+from karpenter_tpu.chaos.profile import PROFILES, ChaosProfile, get_profile
+from karpenter_tpu.chaos.solver import UnstableSolver, ValidatingSolver
+from karpenter_tpu.chaos.trace import EventTrace
+from karpenter_tpu.cloud.fake import FakeCloud
+from karpenter_tpu.controllers.faults import (
+    InterruptionController, OrphanCleanupController, SpotPreemptionController,
+)
+from karpenter_tpu.controllers.nodeclaim import (
+    GarbageCollectionController, NodeClaimTerminationController,
+    RegistrationController, StartupTaintController, TaggingController,
+)
+from karpenter_tpu.controllers.runtime import ControllerManager
+from karpenter_tpu.core.actuator import Actuator
+from karpenter_tpu.core.circuitbreaker import CircuitBreakerConfig, CircuitBreakerManager
+from karpenter_tpu.core.cluster import ClusterState
+from karpenter_tpu.core.kubelet import FakeKubelet
+from karpenter_tpu.core.provisioner import Provisioner, ProvisionerOptions
+from karpenter_tpu.solver.degraded import ResilientSolver
+from karpenter_tpu.solver.greedy import GreedySolver
+from karpenter_tpu.solver.types import SolverOptions
+
+# pod size menu (cpu_milli, memory_mib) — drawn per wave by the seeded
+# world stream
+_POD_SIZES = ((250, 512), (500, 1024), (1000, 2048), (2000, 4096))
+
+REPLAY_FMT = ("python -m karpenter_tpu.chaos --profile {profile} "
+              "--seed {seed} --rounds {rounds}")
+
+
+@dataclass
+class ScenarioResult:
+    profile: str
+    seed: int
+    rounds: int
+    violations: list[Violation]
+    trace: EventTrace
+    digest: str
+
+    @property
+    def ok(self) -> bool:
+        return not self.violations
+
+    @property
+    def replay(self) -> str:
+        return REPLAY_FMT.format(profile=self.profile, seed=self.seed,
+                                 rounds=self.rounds)
+
+    def render_failure(self) -> str:
+        lines = [f"CHAOS FAILURE scenario={self.profile} seed={self.seed} "
+                 f"({len(self.violations)} violations)"]
+        lines += [f"  {v.render()}" for v in self.violations[:10]]
+        if len(self.violations) > 10:
+            lines.append(f"  ... +{len(self.violations) - 10} more")
+        lines.append(f"replay: {self.replay}")
+        return "\n".join(lines)
+
+
+class ChaosHarness:
+    """One scenario's stack + round loop (see module docstring)."""
+
+    def __init__(self, profile: ChaosProfile, seed: int, *,
+                 rounds: int = 10, step: float = 60.0,
+                 quiesce_rounds: int = 4, quiesce_step: float = 1200.0):
+        self.profile = profile
+        self.seed = seed
+        self.rounds = rounds
+        self.step = step
+        self.quiesce_rounds = quiesce_rounds
+        self.quiesce_step = quiesce_step
+        # independent streams so cloud faults, workload shaping, and
+        # solver faults cannot perturb each other's schedules
+        self.rng_world = random.Random(f"{profile.name}:{seed}:world")
+
+    # -- stack construction --------------------------------------------------
+
+    def build(self) -> None:
+        profile, seed = self.profile, self.seed
+        self.clock = VirtualClock()
+        self.trace = EventTrace()
+        self.fake = FakeCloud(region="us-south")
+        self.chaos_cloud = ChaosCloud(
+            self.fake, profile,
+            random.Random(f"{profile.name}:{seed}:cloud"),
+            clock=self.clock, trace=self.trace)
+        self.unavailable = UnavailableOfferings(clock=self.clock.monotonic)
+        self.pricing = PricingProvider(self.fake)
+        # catalog/pricing read the RAW fake: the chaos seam is the
+        # provisioning/controller surface; a huge catalog TTL keeps the
+        # pricing batcher thread out of the traced window entirely
+        self.catalog_provider = InstanceTypeProvider(
+            self.fake, self.pricing, self.unavailable,
+            catalog_ttl=1e9, clock=self.clock.monotonic)
+        self.cluster = ClusterState()
+        nc = NodeClass(name="default", spec=NodeClassSpec(
+            region="us-south", image="img-1", vpc="vpc-1",
+            instance_requirements=InstanceRequirements(min_cpu=2),
+            placement_strategy=PlacementStrategy()))
+        nc.status.resolved_image_id = "img-1"
+        nc.status.set_condition("Ready", "True", "ChaosHarness")
+        self.cluster.add_nodeclass(nc)
+        self.nodeclass = nc
+        breaker = CircuitBreakerManager(CircuitBreakerConfig(
+            failure_threshold=10**6, rate_limit_per_minute=10**6,
+            max_concurrent_instances=10**6))
+        self.actuator = Actuator(self.chaos_cloud, self.cluster,
+                                 breaker=breaker,
+                                 unavailable=self.unavailable)
+        opts = SolverOptions(backend="greedy")
+        self.unstable = UnstableSolver(
+            GreedySolver(opts),
+            random.Random(f"{profile.name}:{seed}:solver"),
+            profile.solver_failure_rate, trace=self.trace)
+        # the PRODUCTION degraded-mode wrapper sits under the harness's
+        # independent validation oracle
+        self.solver = ValidatingSolver(ResilientSolver(self.unstable, opts),
+                                       trace=self.trace)
+        self.provisioner = Provisioner(
+            self.cluster, self.catalog_provider, self.actuator,
+            ProvisionerOptions(solver=opts))
+        self.provisioner.solver = self.solver
+        self.kubelet = FakeKubelet(self.cluster, self.fake)
+        self.manager = ControllerManager(self.cluster)
+        for ctrl in self._controllers():
+            if ctrl.name in profile.disable_controllers:
+                self.trace.add("config", disabled_controller=ctrl.name)
+                continue
+            self.manager.register(ctrl)
+        gc_grace = GarbageCollectionController.min_instance_age
+        reg_timeout = GarbageCollectionController.registration_timeout
+        self.checker = InvariantChecker(
+            self.cluster, self.fake, self.unavailable,
+            orphan_grace=gc_grace + 3 * self.step + 30.0,
+            stuck_claim_grace=(reg_timeout
+                               + 2 * max(self.step, self.quiesce_step) + 60.0),
+            solver_violations=self.solver.violations, trace=self.trace)
+        # warm the catalog before chaos arms (pricing resolution happens
+        # here, outside the deterministic traced window)
+        self.catalog_provider.list(nc)
+
+    def _controllers(self) -> list:
+        return [
+            RegistrationController(self.cluster),
+            StartupTaintController(self.cluster),
+            NodeClaimTerminationController(self.cluster, self.actuator),
+            GarbageCollectionController(self.cluster, self.chaos_cloud),
+            TaggingController(self.cluster, self.chaos_cloud),
+            SpotPreemptionController(self.cluster, self.chaos_cloud,
+                                     self.unavailable),
+            InterruptionController(self.cluster, self.unavailable,
+                                   cloud=self.chaos_cloud),
+            OrphanCleanupController(self.cluster, self.chaos_cloud,
+                                    enabled=True),
+        ]
+
+    # -- round loop ----------------------------------------------------------
+
+    def run(self) -> list[Violation]:
+        self.build()
+        violations: list[Violation] = []
+        try:
+            with self.clock.installed():
+                self._t0 = self.clock.time()
+                self.chaos_cloud.arm()
+                for r in range(self.rounds):
+                    self.trace.add("round", n=r, t=self._vt())
+                    self.chaos_cloud.tick()
+                    self._inject_pods(r)
+                    self._pump()
+                    violations.extend(self.checker.check_round())
+                    self.clock.advance(self.step)
+                # quiesce: lift every fault, expire every TTL, let the
+                # recovery mechanisms finish the job
+                self.chaos_cloud.disarm()
+                self.unstable.failure_rate = 0.0
+                for q in range(self.quiesce_rounds):
+                    self.clock.advance(self.quiesce_step)
+                    self.trace.add("round", n=self.rounds + q, t=self._vt(),
+                                   quiesce=True)
+                    self._pump()
+                    violations.extend(self.checker.check_round())
+                catalog = self.provisioner._catalog_for(self.nodeclass)
+                violations.extend(self.checker.check_final(catalog))
+        finally:
+            self.pricing.close()
+        # a persistent violation repeats every round; report each once
+        seen: set = set()
+        unique = [v for v in violations
+                  if v not in seen and not seen.add(v)]
+        return unique
+
+    def _vt(self) -> float:
+        return round(self.clock.time() - self._t0, 3)
+
+    def _inject_pods(self, round_no: int) -> None:
+        if round_no >= self.profile.pod_waves:
+            return
+        lo, hi = self.profile.pods_per_wave
+        n = self.rng_world.randint(lo, hi)
+        cpu, mem = _POD_SIZES[self.rng_world.randrange(len(_POD_SIZES))]
+        for pod in make_pods(n, name_prefix=f"wave{round_no}",
+                             requests=ResourceRequests(cpu, mem, 0, 1)):
+            self.cluster.add_pod(pod)
+        self.trace.add("workload", wave=round_no, pods=n, cpu=cpu, mem=mem)
+
+    def _pump(self) -> None:
+        """One provisioning + continuation + reconcile beat."""
+        self.provisioner.provision_once()
+        self.kubelet.join_pending(ready=True)
+        self.manager.sync(rounds=2)
+        self.kubelet.bind_nominated()
+        self.unavailable.cleanup()
+        pods = self.cluster.list("pods")
+        self.trace.add(
+            "pump",
+            pods=len(pods),
+            bound=sum(1 for p in pods if p.bound_node),
+            claims=sum(1 for c in self.cluster.nodeclaims() if not c.deleted),
+            instances=self.fake.instance_count(),
+            blackouts=len(self.unavailable.unavailable_keys()))
+
+
+def run_scenario(profile: ChaosProfile | str, seed: int, *,
+                 rounds: int = 10, **kwargs) -> ScenarioResult:
+    prof = get_profile(profile) if isinstance(profile, str) else profile
+    harness = ChaosHarness(prof, seed, rounds=rounds, **kwargs)
+    violations = harness.run()
+    return ScenarioResult(profile=prof.name, seed=seed, rounds=rounds,
+                          violations=violations, trace=harness.trace,
+                          digest=harness.trace.digest())
+
+
+def run_matrix(profile_names: list[str] | None = None,
+               seeds: tuple[int, ...] = (1, 2, 3, 4), *,
+               rounds: int = 10, verify_determinism: bool = True,
+               trace_dir: str | None = None,
+               echo=print) -> tuple[list[ScenarioResult], list[str]]:
+    """Run profiles x seeds; returns (results, failure messages).
+
+    Each cell runs TWICE when ``verify_determinism`` — identical trace
+    digests are the acceptance bar for "same seed => same run".  On any
+    failure the trace is dumped under ``trace_dir`` (the CI artifact)
+    and the replay command printed.
+    """
+    names = profile_names if profile_names is not None else list(PROFILES)
+    results: list[ScenarioResult] = []
+    failures: list[str] = []
+    for name in names:
+        for seed in seeds:
+            res = run_scenario(name, seed, rounds=rounds)
+            results.append(res)
+            problems = []
+            res2 = None
+            if verify_determinism:
+                res2 = run_scenario(name, seed, rounds=rounds)
+                if res2.digest != res.digest:
+                    problems.append(
+                        f"NONDETERMINISTIC scenario={name} seed={seed}: "
+                        f"trace digests differ across identical runs "
+                        f"({res.digest[:12]} != {res2.digest[:12]})\n"
+                        f"replay: {res.replay}")
+            if res.violations:
+                problems.append(res.render_failure())
+            if problems:
+                failures.extend(problems)
+                for p in problems:
+                    echo(p)
+                if trace_dir:
+                    path = Path(trace_dir) / f"{name}-seed{seed}.jsonl"
+                    res.trace.dump(path)
+                    echo(f"trace: {path}")
+                    if res2 is not None and res2.digest != res.digest:
+                        # both runs: diagnosing nondeterminism needs the
+                        # divergent trace, not just the first
+                        path2 = Path(trace_dir) / f"{name}-seed{seed}-run2.jsonl"
+                        res2.trace.dump(path2)
+                        echo(f"trace: {path2}")
+            else:
+                echo(f"ok   {name:<16} seed={seed} events={len(res.trace):<4} "
+                     f"digest={res.digest[:12]}")
+    echo(f"chaos matrix: {len(results)} scenarios, "
+         f"{len(failures)} failures")
+    return results, failures

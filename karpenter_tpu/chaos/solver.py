@@ -1,0 +1,65 @@
+"""Solver-layer chaos: fault injection + independent plan validation.
+
+:class:`UnstableSolver` makes the solver backend fail on a seeded
+schedule, which is how scenarios exercise the production degraded mode
+(``solver/degraded.py`` falling back to greedy).  :class:`ValidatingSolver`
+is the harness's outermost wrapper: every plan that reaches actuation is
+re-checked by ``solver/validate.py`` — the no-shared-code-path oracle —
+and any violation is recorded for the invariant checker.
+"""
+
+from __future__ import annotations
+
+import random
+
+from karpenter_tpu.chaos.trace import EventTrace
+from karpenter_tpu.solver.types import Plan, SolveRequest
+from karpenter_tpu.solver.validate import validate_plan
+
+
+class SolverChaosError(RuntimeError):
+    """The injected backend failure (distinct from real solver bugs)."""
+
+
+class UnstableSolver:
+    """Raises instead of solving with probability ``failure_rate``."""
+
+    def __init__(self, inner, rng: random.Random, failure_rate: float,
+                 trace: EventTrace | None = None):
+        self.inner = inner
+        self.rng = rng
+        self.failure_rate = failure_rate
+        self.trace = trace
+        self.options = getattr(inner, "options", None)
+
+    def solve(self, request: SolveRequest) -> Plan:
+        if self.failure_rate > 0 and self.rng.random() < self.failure_rate:
+            if self.trace is not None:
+                self.trace.add("fault", method="solver.solve",
+                               error="backend_failure")
+            raise SolverChaosError("injected solver backend failure")
+        return self.inner.solve(request)
+
+
+class ValidatingSolver:
+    """Runs the independent feasibility oracle on every plan; violations
+    accumulate in ``violations`` (drained by the invariant checker)."""
+
+    def __init__(self, inner, trace: EventTrace | None = None):
+        self.inner = inner
+        self.trace = trace
+        self.options = getattr(inner, "options", None)
+        self.violations: list[str] = []
+
+    def solve(self, request: SolveRequest) -> Plan:
+        plan = self.inner.solve(request)
+        errors = validate_plan(plan, request.pods, request.catalog,
+                               request.nodepool)
+        if self.trace is not None:
+            self.trace.add("solve", backend=plan.backend,
+                           nodes=len(plan.nodes), placed=plan.placed_count,
+                           unplaced=len(plan.unplaced_pods),
+                           cost=round(plan.total_cost_per_hour, 4),
+                           invalid=len(errors))
+        self.violations.extend(errors)
+        return plan

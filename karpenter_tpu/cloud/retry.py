@@ -1,14 +1,25 @@
-"""Retry stack: exponential backoff + 429 Retry-After honoring.
+"""Retry stack: exponential backoff + decorrelated jitter + 429
+Retry-After honoring.
 
 Parity with the reference's two retry layers:
 - exponential backoff 1s -> 15s cap, 10 steps for catalog listing
   (instancetype.go:440-446);
 - generic rate-limit retry that honors Retry-After
   (ratelimit_retry.go:39).
+
+On top of parity: **decorrelated jitter** (the AWS architecture-blog
+schedule: ``wait = min(cap, uniform(initial, prev_wait * 3))``).  A pure
+exponential schedule synchronizes retry storms — every controller that
+failed in the same cloud brownout retries in the same instant, forever.
+Jitter decorrelates the fleet while keeping the same bounds
+(``min(initial, cap) <= wait <= cap``).  Pass a seeded ``random.Random``
+as ``rng`` for a deterministic schedule (tests, the chaos harness);
+``jitter=False`` pins the exact geometric ramp.
 """
 
 from __future__ import annotations
 
+import random
 import time
 from dataclasses import dataclass
 from collections.abc import Callable
@@ -29,20 +40,34 @@ class RetryConfig:
     cap: float = 15.0
     steps: int = 10
     honor_retry_after: bool = True
+    # decorrelated jitter on every backoff wait; False pins the pure
+    # geometric ramp (pinned-schedule tests, lockstep simulations)
+    jitter: bool = True
 
 
 def retry_with_backoff(fn: Callable[[], T], config: RetryConfig = None,
-                       sleep: Callable[[float], None] = time.sleep,
-                       operation: str = "") -> T:
+                       sleep: Callable[[float], None] | None = None,
+                       operation: str = "",
+                       rng: random.Random | None = None) -> T:
     """Call ``fn`` with exponential backoff on retryable errors.
 
     Non-retryable errors raise immediately; the last error raises after
-    ``steps`` attempts.
+    ``steps`` attempts.  With ``config.jitter`` each wait is drawn
+    decorrelated from the previous one (bounded by ``initial``/``cap``);
+    a server Retry-After always overrides the drawn wait verbatim.
     """
     cfg = config or RetryConfig()
+    if sleep is None:
+        # resolved at call time, NOT bound as a default at import — the
+        # chaos VirtualClock patches time.sleep so injected Retry-After
+        # waits cost scenario time, and an import-time default would
+        # capture the real sleep before the patch
+        sleep = time.sleep
+    draw = (rng or random).uniform if cfg.jitter else None
     # the cap bounds EVERY wait, including the first (a misconfigured
     # initial > cap must not produce one over-cap sleep)
-    delay = min(cfg.initial, cfg.cap)
+    floor = min(cfg.initial, cfg.cap)
+    delay = floor
     last: Exception = RuntimeError("retry_with_backoff: no attempts")
     for attempt in range(cfg.steps):
         try:
@@ -59,5 +84,11 @@ def retry_with_backoff(fn: Callable[[], T], config: RetryConfig = None,
                 log.debug("retrying after error", operation=operation,
                           attempt=attempt + 1, wait=wait, error=str(e))
                 sleep(wait)
-                delay = min(delay * cfg.factor, cfg.cap)
+                if draw is not None:
+                    # decorrelated: next draw ranges off the PREVIOUS
+                    # drawn delay (not the server hint), clamped to
+                    # [floor, cap]
+                    delay = min(cfg.cap, max(floor, draw(floor, delay * 3)))
+                else:
+                    delay = min(delay * cfg.factor, cfg.cap)
     raise last

@@ -66,9 +66,19 @@ class InterruptionController(PollController):
             if claim is None or claim.deleted:
                 continue
             # never-ready suppression: a node that hasn't become Ready yet
-            # is booting, not interrupted (interruption/controller.go:259)
-            if not claim.initialized and now - node.created_at < self.never_ready_grace:
-                continue
+            # is booting, not interrupted (interruption/controller.go:259).
+            # Anchored on the CLAIM's registration stamp, never
+            # node.created_at alone: a node object recreated by
+            # re-adoption would reset the grace window and suppress real
+            # interruptions indefinitely.  Before registration stamps it
+            # (slow launch, poll ordering) fall back to the LATER of
+            # claim/node creation so a freshly joined node still gets
+            # its boot grace.
+            if not claim.initialized:
+                anchor = claim.registered_at \
+                    or max(claim.created_at, node.created_at)
+                if now - anchor < self.never_ready_grace:
+                    continue
             reason = self._interruption_reason(node, health)
             if not reason:
                 continue

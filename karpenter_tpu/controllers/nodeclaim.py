@@ -70,6 +70,7 @@ class RegistrationController(WatchController):
         if not claim.registered:
             self._sync_metadata(claim, node)
             claim.registered = True
+            claim.registered_at = time.time()
             claim.node_name = node.name
             self.cluster.update("nodeclaims", key, claim)
             self.cluster.record_event("NodeClaim", claim.name, "Normal",

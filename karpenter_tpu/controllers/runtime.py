@@ -124,6 +124,14 @@ class _Queue:
 
 
 class ControllerManager:
+    # crash backoff: a reconcile key whose handler keeps throwing backs
+    # off 5s -> 10s -> ... -> 5min instead of hot-looping every 5s
+    # forever (a poisoned object would otherwise burn a worker + error
+    # log line every 5s for its whole life); any successful reconcile
+    # of the key resets the schedule
+    crash_backoff_initial = 5.0
+    crash_backoff_cap = 300.0
+
     def __init__(self, cluster: ClusterState, leader=None):
         self.cluster = cluster
         # leader gate (core/leaderelection.py): non-leader replicas keep
@@ -137,6 +145,8 @@ class ControllerManager:
         self._unsubs: list[Callable[[], None]] = []
         self._threads: list[threading.Thread] = []
         self._stop = threading.Event()
+        self._crash_lock = threading.Lock()
+        self._crash_counts: dict[tuple[str, str], int] = {}
 
     # -- registration ------------------------------------------------------
 
@@ -182,6 +192,8 @@ class ControllerManager:
             t.join(timeout)
         self._threads.clear()
         self._queues = {c.name: _Queue() for c in self._watch}
+        with self._crash_lock:
+            self._crash_counts.clear()
 
     def _make_handler(self, ctrl: WatchController, kind: str, queue: _Queue):
         def handler(event_type: str, obj):
@@ -215,13 +227,25 @@ class ControllerManager:
 
     def _reconcile_one(self, ctrl: WatchController, key: str) -> Result:
         t0 = time.perf_counter()
+        ck = (ctrl.name, key)
         try:
             result = ctrl.reconcile(key) or Result()
         except Exception as e:  # noqa: BLE001 — controllers must not die
+            with self._crash_lock:
+                crashes = self._crash_counts.get(ck, 0) + 1
+                self._crash_counts[ck] = crashes
+            # exponent clamp: 2**(crashes-1) overflows float conversion
+            # after ~1024 consecutive crashes of one key — the cap is
+            # reached long before, so bound the exponent, not the product
+            delay = min(self.crash_backoff_cap,
+                        self.crash_backoff_initial * (2 ** min(crashes - 1, 30)))
             log.error("reconcile failed", controller=ctrl.name, key=key,
-                      error=str(e))
+                      error=str(e), crashes=crashes, requeue_after=delay)
             metrics.ERRORS.labels(f"controller.{ctrl.name}", "reconcile").inc()
-            result = Result(requeue_after=5.0)
+            result = Result(requeue_after=delay)
+        else:
+            with self._crash_lock:
+                self._crash_counts.pop(ck, None)
         metrics.RECONCILE_DURATION.labels(ctrl.name).observe(
             time.perf_counter() - t0)
         return result

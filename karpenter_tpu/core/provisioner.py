@@ -47,14 +47,23 @@ class ProvisionerOptions:
 
 def make_solver(options: SolverOptions):
     """Backend gate (SURVEY.md §5.6: solver backend selected like the
-    circuit-breaker config so the default path stays untouched)."""
+    circuit-breaker config so the default path stays untouched).
+
+    Non-greedy backends come wrapped in ``ResilientSolver``: a backend
+    failure or structurally invalid plan degrades that solve to the
+    greedy host oracle (ERRORS breadcrumb) instead of failing the
+    provision cycle (docs/design/chaos.md)."""
     if options.backend == "greedy":
         return GreedySolver(options)
+    from karpenter_tpu.solver.degraded import ResilientSolver
+
     if options.backend == "remote":
         from karpenter_tpu.service import RemoteSolver
 
-        return RemoteSolver(options.address or "127.0.0.1:50051", options)
-    return JaxSolver(options)
+        return ResilientSolver(
+            RemoteSolver(options.address or "127.0.0.1:50051", options),
+            options)
+    return ResilientSolver(JaxSolver(options), options)
 
 
 class Provisioner:

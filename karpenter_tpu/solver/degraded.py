@@ -1,0 +1,114 @@
+"""Solver degraded mode: greedy fallback instead of a failed cycle.
+
+The batched backend (jax / remote sidecar) can fail in ways the host
+oracle cannot: a dead TPU tunnel, a Mosaic runtime fault, non-finite
+output from a miscompiled kernel.  None of those may fail a provision
+cycle — pods would sit pending until a human notices.  ``ResilientSolver``
+wraps any backend: an exception OR a structurally invalid plan
+(non-finite cost, bad offering index, pod accounting that doesn't
+partition the request) degrades that one solve to ``solver/greedy.py``
+with an ``ERRORS`` metric breadcrumb (component="solver",
+kind="degraded_backend_failure" / "degraded_invalid_plan") and a
+``degraded:`` backend tag on the plan, so dashboards see every
+degradation while provisioning keeps working.
+
+The structural check is deliberately cheap (O(pods)) — full feasibility
+stays with ``solver/validate.py`` (tests and the chaos harness run it
+on every plan); this gate only has to catch output a broken backend
+could emit.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+
+from karpenter_tpu.apis.pod import pod_key
+from karpenter_tpu.solver.types import Plan, SolveRequest, SolverOptions
+from karpenter_tpu.utils import metrics
+from karpenter_tpu.utils.logging import get_logger
+
+log = get_logger("solver.degraded")
+
+
+def plan_defects(plan: Plan, request: SolveRequest) -> list[str]:
+    """Structural sanity of a plan against its request (cheap, O(pods))."""
+    if plan is None:
+        return ["backend returned no plan"]
+    defects: list[str] = []
+    if not math.isfinite(plan.total_cost_per_hour) \
+            or plan.total_cost_per_hour < 0:
+        defects.append(f"non-finite/negative total cost "
+                       f"{plan.total_cost_per_hour!r}")
+    catalog = request.catalog
+    seen: set[str] = set()
+    dupes = 0
+    for ni, node in enumerate(plan.nodes):
+        if not math.isfinite(node.price) or node.price < 0:
+            defects.append(f"node{ni}: non-finite/negative price "
+                           f"{node.price!r}")
+        if not (0 <= node.offering_index < catalog.num_offerings):
+            defects.append(f"node{ni}: offering index "
+                           f"{node.offering_index} out of range")
+        for pn in node.pod_names:
+            if pn in seen:
+                dupes += 1
+            seen.add(pn)
+    for pn in plan.unplaced_pods:
+        if pn in seen:
+            dupes += 1
+        seen.add(pn)
+    if dupes:
+        defects.append(f"{dupes} pods assigned more than once")
+    want = {pod_key(p) for p in request.pods}
+    if seen != want:
+        defects.append(f"pod accounting mismatch: {len(seen - want)} unknown, "
+                       f"{len(want - seen)} missing")
+    return defects
+
+
+class ResilientSolver:
+    """Wraps a primary backend; degrades single solves to greedy.
+
+    Transparent to introspection: unknown attributes (warmup hooks,
+    device caches) delegate to the primary, so operator warmup and the
+    disruption plane keep working against the wrapped solver.
+    """
+
+    def __init__(self, primary, options: SolverOptions | None = None):
+        self.primary = primary
+        self.options = options or getattr(primary, "options", None) \
+            or SolverOptions()
+        self._fallback = None
+
+    @property
+    def fallback(self):
+        if self._fallback is None:
+            from karpenter_tpu.solver.greedy import GreedySolver
+
+            self._fallback = GreedySolver(
+                dataclasses.replace(self.options, backend="greedy"))
+        return self._fallback
+
+    def __getattr__(self, name: str):
+        return getattr(self.primary, name)
+
+    def solve(self, request: SolveRequest) -> Plan:
+        try:
+            plan = self.primary.solve(request)
+        except Exception as e:  # noqa: BLE001 — degrade, never fail the cycle
+            log.error("solver backend failed; degrading to greedy",
+                      backend=self.options.backend, error=str(e)[:200])
+            return self._degrade(request, "backend_failure")
+        defects = plan_defects(plan, request)
+        if defects:
+            log.error("solver produced invalid plan; degrading to greedy",
+                      backend=plan.backend, defects=defects[:3])
+            return self._degrade(request, "invalid_plan")
+        return plan
+
+    def _degrade(self, request: SolveRequest, reason: str) -> Plan:
+        metrics.ERRORS.labels("solver", f"degraded_{reason}").inc()
+        plan = self.fallback.solve(request)
+        plan.backend = f"degraded:{plan.backend}"
+        return plan

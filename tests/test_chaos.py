@@ -1,0 +1,590 @@
+"""Chaos harness tests: seeded fault injection, invariants, determinism.
+
+Three layers, mirroring the package:
+
+- units — VirtualClock patching, EventTrace digests, ChaosProfile
+  registry, ChaosCloud's injection mechanics against the raw fake;
+- the production hardening chaos exercises — ``solver/degraded.py``'s
+  greedy fallback, including a LIVE provision cycle completing through
+  it with the degradation recorded in metrics;
+- scenario round-trips — same (profile, seed) twice => identical trace
+  digest, and the deliberately broken fixture profile FAILS with the
+  exact replay command (the harness must be falsifiable to prove
+  anything).
+
+The full matrix lives behind ``make chaos`` / the slow marker so tier-1
+stays fast.
+"""
+
+import random
+import time
+
+import pytest
+
+from karpenter_tpu.apis.nodeclaim import NodeClaim, Node, provider_id
+from karpenter_tpu.apis.nodeclass import (
+    InstanceRequirements, NodeClass, NodeClassSpec, PlacementStrategy,
+)
+from karpenter_tpu.apis.pod import ResourceRequests, make_pods
+from karpenter_tpu.catalog import (
+    CatalogArrays, InstanceTypeProvider, PricingProvider, UnavailableOfferings,
+)
+from karpenter_tpu.chaos import (
+    ChaosCloud, ChaosProfile, EventTrace, InvariantChecker, PROFILES,
+    VirtualClock, get_profile, run_scenario,
+)
+from karpenter_tpu.chaos.cloud import make_error
+from karpenter_tpu.chaos.profile import FIXTURE_PROFILES
+from karpenter_tpu.chaos.runner import run_matrix
+from karpenter_tpu.chaos.solver import UnstableSolver, ValidatingSolver
+from karpenter_tpu.cloud.errors import CloudError
+from karpenter_tpu.cloud.fake import FakeCloud
+from karpenter_tpu.core.actuator import KARPENTER_TAGS, Actuator
+from karpenter_tpu.core.cluster import ClusterState
+from karpenter_tpu.core.provisioner import Provisioner, ProvisionerOptions, make_solver
+from karpenter_tpu.solver.degraded import ResilientSolver, plan_defects
+from karpenter_tpu.solver.greedy import GreedySolver
+from karpenter_tpu.solver.types import Plan, PlannedNode, SolveRequest, SolverOptions
+from karpenter_tpu.utils import metrics
+
+
+def ready_nodeclass(name="default") -> NodeClass:
+    nc = NodeClass(name=name, spec=NodeClassSpec(
+        region="us-south", image="img-1", vpc="vpc-1",
+        instance_requirements=InstanceRequirements(min_cpu=2),
+        placement_strategy=PlacementStrategy()))
+    nc.status.resolved_image_id = "img-1"
+    nc.status.set_condition("Ready", "True", "Test")
+    return nc
+
+
+# ---------------------------------------------------------------------------
+# VirtualClock
+# ---------------------------------------------------------------------------
+
+class TestVirtualClock:
+    def test_advance_moves_both_readouts(self):
+        clock = VirtualClock(start=1000.0)
+        t0, m0 = clock.time(), clock.monotonic()
+        clock.advance(60.0)
+        assert clock.time() == t0 + 60.0
+        assert clock.monotonic() == m0 + 60.0
+
+    def test_rewind_raises(self):
+        with pytest.raises(ValueError):
+            VirtualClock().advance(-1.0)
+
+    def test_sleep_costs_virtual_time_only(self):
+        clock = VirtualClock()
+        t0 = clock.time()
+        wall0 = time.perf_counter()
+        clock.sleep(3600.0)
+        assert clock.time() == t0 + 3600.0
+        assert time.perf_counter() - wall0 < 5.0
+
+    def test_installed_patches_and_restores(self):
+        real_time, real_mono, real_sleep = time.time, time.monotonic, time.sleep
+        clock = VirtualClock(start=5000.0)
+        with clock.installed():
+            assert time.time() == 5000.0
+            time.sleep(120.0)            # virtual: advances, doesn't block
+            assert time.time() == 5120.0
+            assert time.monotonic() == clock.monotonic()
+        assert time.time is real_time
+        assert time.monotonic is real_mono
+        assert time.sleep is real_sleep
+
+    def test_installed_restores_on_error(self):
+        real_time = time.time
+        with pytest.raises(RuntimeError):
+            with VirtualClock().installed():
+                raise RuntimeError("boom")
+        assert time.time is real_time
+
+
+# ---------------------------------------------------------------------------
+# EventTrace
+# ---------------------------------------------------------------------------
+
+class TestEventTrace:
+    def test_digest_deterministic_and_order_sensitive(self):
+        a, b = EventTrace(), EventTrace()
+        for t in (a, b):
+            t.add("fault", method="m", error="timeout")
+            t.add("round", n=0)
+        assert a.digest() == b.digest()
+        c = EventTrace()
+        c.add("round", n=0)
+        c.add("fault", method="m", error="timeout")
+        assert c.digest() != a.digest()
+
+    def test_of_kind_and_len(self):
+        t = EventTrace()
+        t.add("fault", method="m")
+        t.add("round", n=0)
+        assert len(t) == 2
+        assert t.of_kind("fault") == [{"kind": "fault", "method": "m"}]
+
+    def test_dump_jsonl(self, tmp_path):
+        t = EventTrace()
+        t.add("round", n=0)
+        p = t.dump(tmp_path / "nested" / "trace.jsonl")
+        assert p.read_text() == '{"kind": "round", "n": 0}\n'
+
+
+# ---------------------------------------------------------------------------
+# Profiles
+# ---------------------------------------------------------------------------
+
+class TestProfiles:
+    def test_matrix_has_at_least_five_profiles(self):
+        # the acceptance bar: >= 5 scenario profiles in the default matrix
+        assert len(PROFILES) >= 5
+        assert not any(p.fixture for p in PROFILES.values())
+        assert all(p.fixture for p in FIXTURE_PROFILES.values())
+
+    def test_get_profile_resolves_fixtures_and_rejects_unknown(self):
+        assert get_profile("calm").name == "calm"
+        assert get_profile("broken-fixture").fixture
+        with pytest.raises(KeyError):
+            get_profile("no-such-profile")
+
+    def test_wildcard_rates(self):
+        p = ChaosProfile(name="t", error_rates={"*": 0.1, "get_instance": 0.5},
+                         latency={"*": (0.0, 1.0)})
+        assert p.rate_for("get_instance") == 0.5
+        assert p.rate_for("list_instances") == 0.1
+        assert p.latency_for("anything") == (0.0, 1.0)
+
+
+# ---------------------------------------------------------------------------
+# ChaosCloud
+# ---------------------------------------------------------------------------
+
+def make_error_profile(**kw) -> ChaosProfile:
+    return ChaosProfile(name="t", **kw)
+
+
+class TestChaosCloud:
+    def test_unarmed_is_a_clean_passthrough(self):
+        fake = FakeCloud()
+        chaos = ChaosCloud(fake, make_error_profile(error_rates={"*": 1.0}))
+        assert chaos.list_zones() == fake.list_zones()   # would raise if armed
+
+    def test_injection_rate_one_always_raises_typed_error(self):
+        chaos = ChaosCloud(
+            FakeCloud(),
+            make_error_profile(error_rates={"*": 1.0},
+                               error_kinds=(("rate_limited", 1.0),)),
+            random.Random(7))
+        chaos.arm()
+        with pytest.raises(CloudError) as ei:
+            chaos.list_instances()
+        assert ei.value.status_code == 429
+        assert ei.value.retry_after > 0
+        assert chaos.trace.of_kind("fault")[0]["error"] == "rate_limited"
+
+    def test_same_seed_same_fault_schedule(self):
+        def schedule(seed):
+            chaos = ChaosCloud(FakeCloud(),
+                               make_error_profile(error_rates={"*": 0.5}),
+                               random.Random(seed))
+            chaos.arm()
+            out = []
+            for _ in range(30):
+                try:
+                    chaos.list_zones()
+                    out.append("ok")
+                except CloudError as e:
+                    out.append(e.status_code)
+            return out
+
+        assert schedule(3) == schedule(3)
+        assert schedule(3) != schedule(4)
+
+    def test_partial_list_is_a_strict_ordered_subset(self):
+        fake = FakeCloud()
+        sub = fake.list_subnets()[0].id
+        for i in range(6):
+            fake.create_instance(name=f"i{i}", profile="bx2-4x16",
+                                 zone="us-south-1", subnet_id=sub,
+                                 image_id="img-1")
+        chaos = ChaosCloud(fake, make_error_profile(partial_list_rate=1.0),
+                           random.Random(1))
+        chaos.arm()
+        full_ids = [i.id for i in fake.list_instances()]
+        got_ids = [i.id for i in chaos.list_instances()]
+        assert 1 <= len(got_ids) < len(full_ids)
+        assert got_ids == [i for i in full_ids if i in set(got_ids)]  # order kept
+
+    def test_leaked_create_exists_server_side_but_call_fails(self):
+        fake = FakeCloud()
+        chaos = ChaosCloud(fake, make_error_profile(create_leak_rate=1.0),
+                           random.Random(1))
+        chaos.arm()
+        with pytest.raises(CloudError) as ei:
+            chaos.create_instance(name="leak", profile="bx2-4x16",
+                                  zone="us-south-1",
+                                  subnet_id=fake.list_subnets()[0].id,
+                                  image_id="img-1",
+                                  tags=dict(KARPENTER_TAGS))
+        assert ei.value.status_code == 500
+        assert fake.instance_count() == 1    # the orphan the GC must reap
+        assert chaos.trace.of_kind("fault")[0]["error"] == "leaked_create"
+
+    def test_injected_latency_costs_virtual_time(self):
+        clock = VirtualClock()
+        t0 = clock.time()
+        chaos = ChaosCloud(FakeCloud(),
+                           make_error_profile(latency={"*": (1.0, 2.0)}),
+                           random.Random(1), clock=clock)
+        chaos.arm()
+        chaos.list_zones()
+        assert 1.0 <= clock.time() - t0 <= 2.0
+
+    def test_preemption_storm_flips_status_reason(self):
+        fake = FakeCloud()
+        inst = fake.create_instance(
+            name="spot0", profile="bx2-4x16", zone="us-south-1",
+            subnet_id=fake.list_subnets()[0].id, image_id="img-1",
+            capacity_type="spot")
+        chaos = ChaosCloud(
+            fake, make_error_profile(preempt_storm_rate=1.0,
+                                     preempt_storm_frac=1.0),
+            random.Random(1))
+        chaos.arm()
+        chaos.tick()
+        hit = fake.get_instance(inst.id)
+        assert hit.status == "stopped"
+        assert hit.status_reason == "stopped_by_preemption"
+        assert chaos.trace.of_kind("storm")[0]["storm"] == "spot_preemption"
+
+    def test_capacity_blackout_ages_out_and_restores(self):
+        fake = FakeCloud()
+        chaos = ChaosCloud(
+            fake, make_error_profile(capacity_blackout_rate=1.0,
+                                     capacity_blackout_rounds=2),
+            random.Random(1))
+        chaos.arm()
+        chaos.tick()
+        assert 0 in fake.capacity_limits.values()
+        # stop spawning new blackouts; aging still runs every tick and
+        # must lift the standing one after its rounds elapse
+        chaos.profile = make_error_profile(capacity_blackout_rate=0.0)
+        chaos.tick()
+        chaos.tick()
+        assert 0 not in fake.capacity_limits.values()
+        storms = [e["storm"] for e in chaos.trace.of_kind("storm")]
+        assert "capacity_restored" in storms
+
+    def test_disarm_lifts_standing_blackouts(self):
+        fake = FakeCloud()
+        chaos = ChaosCloud(
+            fake, make_error_profile(capacity_blackout_rate=1.0,
+                                     capacity_blackout_rounds=99),
+            random.Random(1))
+        chaos.arm()
+        chaos.tick()
+        assert 0 in fake.capacity_limits.values()
+        chaos.disarm()
+        assert 0 not in fake.capacity_limits.values()
+        assert chaos.list_zones()    # and injection is off
+
+    def test_make_error_covers_taxonomy(self):
+        rng = random.Random(0)
+        statuses = {kind: make_error(kind, "m", rng).status_code
+                    for kind in ("rate_limited", "internal", "unavailable",
+                                 "timeout", "conflict", "not_found")}
+        assert statuses == {"rate_limited": 429, "internal": 500,
+                            "unavailable": 503, "timeout": 408,
+                            "conflict": 409, "not_found": 404}
+        with pytest.raises(ValueError):
+            make_error("alien", "m", rng)
+
+
+# ---------------------------------------------------------------------------
+# Invariant checker units
+# ---------------------------------------------------------------------------
+
+@pytest.fixture
+def inv_rig():
+    cloud = FakeCloud()
+    pricing = PricingProvider(cloud)
+    unavail = UnavailableOfferings()
+    itp = InstanceTypeProvider(cloud, pricing, unavail)
+    cluster = ClusterState()
+    checker = InvariantChecker(cluster, cloud, unavail,
+                               orphan_grace=300.0, stuck_claim_grace=900.0)
+    yield cloud, cluster, unavail, itp, checker
+    pricing.close()
+
+
+class TestInvariants:
+    def _orphan(self, cloud, tags, age):
+        inst = cloud.create_instance(
+            name="x", profile="bx2-4x16", zone="us-south-1",
+            subnet_id=cloud.list_subnets()[0].id, image_id="img-1", tags=tags)
+        cloud.instances[inst.id].created_at = time.time() - age
+        return inst
+
+    def test_stale_tagged_orphan_flagged(self, inv_rig):
+        cloud, cluster, unavail, itp, checker = inv_rig
+        self._orphan(cloud, dict(KARPENTER_TAGS), age=1000)
+        kinds = {v.invariant for v in checker.check_round()}
+        assert kinds == {"no-stale-orphan"}
+
+    def test_unmanaged_and_young_instances_exempt(self, inv_rig):
+        cloud, cluster, unavail, itp, checker = inv_rig
+        self._orphan(cloud, {"owner": "someone-else"}, age=10**6)
+        self._orphan(cloud, dict(KARPENTER_TAGS), age=10.0)   # within grace
+        assert checker.check_round() == []
+
+    def test_tracked_instance_is_not_an_orphan(self, inv_rig):
+        cloud, cluster, unavail, itp, checker = inv_rig
+        inst = self._orphan(cloud, dict(KARPENTER_TAGS), age=1000)
+        cluster.add_nodeclaim(NodeClaim(
+            name="c0", provider_id=provider_id("us-south", inst.id)))
+        assert checker.check_round() == []
+
+    def test_stuck_claim_flagged_after_grace(self, inv_rig):
+        cloud, cluster, unavail, itp, checker = inv_rig
+        claim = NodeClaim(name="stuck", launched=True)
+        claim.created_at = time.time() - 1000
+        cluster.add_nodeclaim(claim)
+        kinds = {v.invariant for v in checker.check_round()}
+        assert kinds == {"no-stuck-claim"}
+        claim.initialized = True
+        assert checker.check_round() == []
+
+    def test_solver_violations_drained_once(self, inv_rig):
+        cloud, cluster, unavail, itp, checker = inv_rig
+        checker.solver_violations.append("pod double-placed")
+        assert [v.invariant for v in checker.check_round()] \
+            == ["solver-plan-valid"]
+        assert checker.check_round() == []
+
+    def test_unexpired_blackout_fails_final(self, inv_rig):
+        cloud, cluster, unavail, itp, checker = inv_rig
+        unavail.mark_unavailable("bx2-4x16", "us-south-1", "spot", ttl=10**9)
+        kinds = {v.invariant for v in checker.check_final()}
+        assert kinds == {"blackouts-expire"}
+
+    def test_pods_resolve_unplaceable_exempt(self, inv_rig):
+        cloud, cluster, unavail, itp, checker = inv_rig
+        catalog = CatalogArrays.build(itp.list())
+        placeable, = make_pods(1, name_prefix="small",
+                               requests=ResourceRequests(500, 512, 0, 1))
+        impossible, = make_pods(1, name_prefix="huge",
+                                requests=ResourceRequests(10**9, 10**9, 0, 1))
+        cluster.add_pod(placeable)
+        cluster.add_pod(impossible)
+        out = checker.check_final(catalog)
+        details = [v.detail for v in out if v.invariant == "pods-resolve"]
+        assert len(details) == 1 and "small" in details[0]
+
+
+# ---------------------------------------------------------------------------
+# Solver degraded mode (the production hardening chaos exercises)
+# ---------------------------------------------------------------------------
+
+class FailingSolver:
+    def __init__(self, options=None):
+        self.options = options or SolverOptions(backend="greedy")
+
+    def solve(self, request):
+        raise RuntimeError("injected backend failure")
+
+
+class StaticPlanSolver:
+    def __init__(self, plan):
+        self.plan = plan
+        self.options = SolverOptions(backend="greedy")
+
+    def solve(self, request):
+        return self.plan
+
+
+def solve_request(itp, n_pods=3) -> SolveRequest:
+    catalog = CatalogArrays.build(itp.list())
+    pods = make_pods(n_pods, requests=ResourceRequests(500, 1024, 0, 1))
+    return SolveRequest(pods=pods, catalog=catalog)
+
+
+@pytest.fixture
+def catalog_rig():
+    cloud = FakeCloud()
+    pricing = PricingProvider(cloud)
+    itp = InstanceTypeProvider(cloud, pricing, UnavailableOfferings())
+    yield cloud, itp
+    pricing.close()
+
+
+class TestPlanDefects:
+    def test_valid_plan_has_no_defects(self, catalog_rig):
+        cloud, itp = catalog_rig
+        req = solve_request(itp)
+        plan = GreedySolver(SolverOptions(backend="greedy")).solve(req)
+        assert plan_defects(plan, req) == []
+
+    def test_defect_catalog(self, catalog_rig):
+        cloud, itp = catalog_rig
+        req = solve_request(itp, n_pods=2)
+        names = [f"default/{p.name}" for p in req.pods]
+        bad = Plan(nodes=[PlannedNode("bx2-4x16", "us-south-1", "on-demand",
+                                      price=float("nan"), pod_names=[names[0]],
+                                      offering_index=10**6)],
+                   unplaced_pods=[names[0]],          # duplicated + missing [1]
+                   total_cost_per_hour=float("inf"))
+        defects = " / ".join(plan_defects(bad, req))
+        assert "non-finite" in defects
+        assert "out of range" in defects
+        assert "more than once" in defects
+        assert "missing" in defects
+        assert plan_defects(None, req) == ["backend returned no plan"]
+
+
+class TestResilientSolver:
+    def test_backend_failure_degrades_to_greedy_with_metric(self, catalog_rig):
+        cloud, itp = catalog_rig
+        req = solve_request(itp)
+        before = metrics.ERRORS.get("solver", "degraded_backend_failure")
+        solver = ResilientSolver(FailingSolver())
+        plan = solver.solve(req)
+        assert plan.backend.startswith("degraded:greedy")
+        assert plan.placed_count == len(req.pods)
+        assert metrics.ERRORS.get("solver", "degraded_backend_failure") \
+            == before + 1
+
+    def test_invalid_plan_degrades_with_metric(self, catalog_rig):
+        cloud, itp = catalog_rig
+        req = solve_request(itp)
+        before = metrics.ERRORS.get("solver", "degraded_invalid_plan")
+        garbage = Plan(total_cost_per_hour=float("nan"))
+        plan = ResilientSolver(StaticPlanSolver(garbage)).solve(req)
+        assert plan.backend.startswith("degraded:greedy")
+        assert metrics.ERRORS.get("solver", "degraded_invalid_plan") \
+            == before + 1
+
+    def test_healthy_backend_passes_through_untouched(self, catalog_rig):
+        cloud, itp = catalog_rig
+        req = solve_request(itp)
+        plan = ResilientSolver(GreedySolver(SolverOptions())).solve(req)
+        assert not plan.backend.startswith("degraded:")
+
+    def test_unknown_attrs_delegate_to_primary(self):
+        primary = GreedySolver(SolverOptions())
+        primary.custom_marker = "x"
+        assert ResilientSolver(primary).custom_marker == "x"
+
+    def test_make_solver_wraps_non_greedy_backends(self):
+        assert isinstance(make_solver(SolverOptions(backend="greedy")),
+                          GreedySolver)
+        wrapped = make_solver(SolverOptions(backend="jax"))
+        assert isinstance(wrapped, ResilientSolver)
+
+    def test_live_provision_cycle_completes_via_fallback(self):
+        """The acceptance scenario: backend dies mid-provision, pods still
+        get capacity, the degradation is visible in metrics."""
+        cloud = FakeCloud()
+        pricing = PricingProvider(cloud)
+        try:
+            unavail = UnavailableOfferings()
+            itp = InstanceTypeProvider(cloud, pricing, unavail)
+            cluster = ClusterState()
+            cluster.add_nodeclass(ready_nodeclass())
+            actuator = Actuator(cloud, cluster, unavailable=unavail)
+            prov = Provisioner(cluster, itp, actuator, ProvisionerOptions(
+                solver=SolverOptions(backend="greedy")))
+            prov.solver = ResilientSolver(FailingSolver())
+            for pod in make_pods(4, requests=ResourceRequests(500, 1024, 0, 1)):
+                cluster.add_pod(pod)
+            before = metrics.ERRORS.get("solver", "degraded_backend_failure")
+            plans = prov.provision_once()
+            assert plans and plans[0].backend.startswith("degraded:greedy")
+            assert cloud.instance_count() > 0
+            assert all(p.nominated_node for p in cluster.pending_pods())
+            assert metrics.ERRORS.get("solver", "degraded_backend_failure") \
+                == before + 1
+        finally:
+            pricing.close()
+
+
+class TestChaosSolverWrappers:
+    def test_unstable_solver_deterministic_schedule(self, catalog_rig):
+        cloud, itp = catalog_rig
+        req = solve_request(itp)
+
+        def schedule(seed):
+            s = UnstableSolver(GreedySolver(SolverOptions()),
+                               random.Random(seed), failure_rate=0.5)
+            out = []
+            for _ in range(12):
+                try:
+                    s.solve(req)
+                    out.append("ok")
+                except Exception:
+                    out.append("fail")
+            return out
+
+        assert schedule(5) == schedule(5)
+        assert "fail" in schedule(5) and "ok" in schedule(5)
+
+    def test_validating_solver_accumulates_violations(self, catalog_rig):
+        cloud, itp = catalog_rig
+        req = solve_request(itp, n_pods=2)
+        garbage = Plan(nodes=[], unplaced_pods=[], total_cost_per_hour=0.0)
+        v = ValidatingSolver(StaticPlanSolver(garbage))
+        v.solve(req)
+        assert v.violations   # both pods unaccounted for
+
+
+# ---------------------------------------------------------------------------
+# Scenario round-trips (determinism + falsifiability)
+# ---------------------------------------------------------------------------
+
+class TestScenarios:
+    def test_same_seed_identical_trace_digest(self):
+        a = run_scenario("flaky-api", 1, rounds=4)
+        b = run_scenario("flaky-api", 1, rounds=4)
+        assert a.digest == b.digest
+        assert a.ok and b.ok
+
+    def test_different_seeds_diverge(self):
+        a = run_scenario("flaky-api", 1, rounds=4)
+        b = run_scenario("flaky-api", 2, rounds=4)
+        assert a.digest != b.digest
+
+    def test_calm_profile_holds_every_invariant(self):
+        res = run_scenario("calm", 1, rounds=4)
+        assert res.ok, res.render_failure()
+        assert res.trace.of_kind("invariants")
+
+    def test_broken_fixture_fails_with_replay_command(self):
+        """Falsifiability: a world with GC + orphan cleanup disabled MUST
+        trip no-stale-orphan, and the failure names the exact replay."""
+        res = run_scenario("broken-fixture", 1, rounds=5)
+        assert not res.ok
+        assert {v.invariant for v in res.violations} == {"no-stale-orphan"}
+        assert res.replay == ("python -m karpenter_tpu.chaos "
+                              "--profile broken-fixture --seed 1 --rounds 5")
+        rendered = res.render_failure()
+        assert "replay: " + res.replay in rendered
+        assert "no-stale-orphan" in rendered
+
+    def test_run_matrix_reports_fixture_failure(self, tmp_path):
+        lines = []
+        results, failures = run_matrix(
+            ["broken-fixture"], seeds=(1,), rounds=5,
+            verify_determinism=False, trace_dir=str(tmp_path),
+            echo=lines.append)
+        assert failures and not results[0].ok
+        assert (tmp_path / "broken-fixture-seed1.jsonl").exists()
+        assert any("replay:" in ln for ln in lines)
+
+    @pytest.mark.slow
+    def test_small_matrix_with_determinism_verification(self):
+        _, failures = run_matrix(
+            ["rate-limited", "leaky-creates", "solver-degraded"],
+            seeds=(1, 2), rounds=6, verify_determinism=True,
+            echo=lambda *_: None)
+        assert failures == []

@@ -43,7 +43,8 @@ class TestRetry:
             return "ok"
 
         sleeps = []
-        assert retry_with_backoff(flaky, RetryConfig(initial=1, cap=15, steps=10),
+        assert retry_with_backoff(flaky, RetryConfig(initial=1, cap=15, steps=10,
+                                              jitter=False),
                                   sleep=sleeps.append) == "ok"
         assert sleeps == [1, 2]
 
@@ -54,7 +55,8 @@ class TestRetry:
             raise CloudError("unavailable", 503)
 
         with pytest.raises(CloudError):
-            retry_with_backoff(always_fail, RetryConfig(initial=1, cap=15, steps=6),
+            retry_with_backoff(always_fail, RetryConfig(initial=1, cap=15, steps=6,
+                                                       jitter=False),
                                sleep=sleeps.append)
         assert sleeps == [1, 2, 4, 8, 15]
 
@@ -81,7 +83,7 @@ class TestRetry:
         with pytest.raises(CloudError):
             retry_with_backoff(always_fail,
                                RetryConfig(initial=1, factor=2, cap=15,
-                                           steps=9),
+                                           steps=9, jitter=False),
                                sleep=sleeps.append)
         assert sleeps == [1, 2, 4, 8, 15, 15, 15, 15]
         assert max(sleeps) == 15
@@ -96,7 +98,7 @@ class TestRetry:
         with pytest.raises(CloudError):
             retry_with_backoff(always_fail,
                                RetryConfig(initial=40, factor=2, cap=15,
-                                           steps=3),
+                                           steps=3, jitter=False),
                                sleep=sleeps.append)
         assert sleeps == [15, 15]
 
@@ -116,7 +118,8 @@ class TestRetry:
             return "ok"
 
         assert retry_with_backoff(
-            limited, RetryConfig(initial=1, factor=2, cap=15, steps=10),
+            limited, RetryConfig(initial=1, factor=2, cap=15, steps=10,
+                                 jitter=False),
             sleep=sleeps.append) == "ok"
         assert sleeps == [7.5, 2, 4]
 
@@ -148,6 +151,65 @@ class TestRetry:
             return "ok"
 
         assert retry_with_backoff(limited, sleep=sleeps.append) == "ok"
+        assert sleeps == [7.5]
+
+
+class TestRetryJitter:
+    """Decorrelated jitter (chaos PR satellite): bounded, deterministic
+    under a seeded Random, and never overriding a server Retry-After."""
+
+    @staticmethod
+    def _always_fail():
+        raise CloudError("unavailable", 503)
+
+    def _schedule(self, seed, steps=8, initial=1.0, cap=15.0):
+        import random
+        sleeps = []
+        with pytest.raises(CloudError):
+            retry_with_backoff(self._always_fail,
+                               RetryConfig(initial=initial, cap=cap,
+                                           steps=steps),
+                               sleep=sleeps.append,
+                               rng=random.Random(seed))
+        return sleeps
+
+    def test_jitter_bounds(self):
+        # pinned contract: min(initial, cap) <= every wait <= cap, first
+        # wait exactly initial (nothing to decorrelate from yet)
+        for seed in range(5):
+            sleeps = self._schedule(seed)
+            assert len(sleeps) == 7
+            assert sleeps[0] == 1.0
+            assert all(1.0 <= s <= 15.0 for s in sleeps), sleeps
+
+    def test_jitter_deterministic_with_seeded_rng(self):
+        assert self._schedule(42) == self._schedule(42)
+        # and actually jittered: two seeds diverge somewhere
+        assert self._schedule(1) != self._schedule(2)
+
+    def test_jitter_disabled_is_pure_exponential(self):
+        sleeps = []
+        with pytest.raises(CloudError):
+            retry_with_backoff(self._always_fail,
+                               RetryConfig(initial=1, cap=15, steps=6,
+                                           jitter=False),
+                               sleep=sleeps.append)
+        assert sleeps == [1, 2, 4, 8, 15]
+
+    def test_retry_after_still_authoritative_under_jitter(self):
+        import random
+        attempts = []
+
+        def limited():
+            attempts.append(1)
+            if len(attempts) < 2:
+                raise CloudError("429", 429, retry_after=7.5)
+            return "ok"
+
+        sleeps = []
+        assert retry_with_backoff(limited, RetryConfig(),
+                                  sleep=sleeps.append,
+                                  rng=random.Random(0)) == "ok"
         assert sleeps == [7.5]
 
 

@@ -270,6 +270,71 @@ class TestRuntime:
         mgr.register(Bad())
         mgr.sync(rounds=1)   # must not raise
 
+    def test_crash_backoff_schedule_pinned(self, rig):
+        """A poisoned key must NOT hot-loop every 5 s forever: the requeue
+        schedule doubles per consecutive crash, capped at 5 min."""
+        cloud, cluster, actuator, itp, unavail = rig
+
+        class Poisoned(WatchController):
+            name = "poisoned"
+            watch_kinds = ("nodeclasses",)
+
+            def reconcile(self, key):
+                raise RuntimeError("boom")
+
+        mgr = ControllerManager(cluster)
+        ctrl = Poisoned()
+        mgr.register(ctrl)
+        delays = [mgr._reconcile_one(ctrl, "k").requeue_after
+                  for _ in range(9)]
+        assert delays == [5.0, 10.0, 20.0, 40.0, 80.0, 160.0,
+                          300.0, 300.0, 300.0]
+
+    def test_crash_backoff_resets_on_success_and_is_per_key(self, rig):
+        cloud, cluster, actuator, itp, unavail = rig
+
+        class Flaky(WatchController):
+            name = "flaky"
+            watch_kinds = ("nodeclasses",)
+            poisoned = True
+
+            def reconcile(self, key):
+                if self.poisoned:
+                    raise RuntimeError("boom")
+                return Result()
+
+        mgr = ControllerManager(cluster)
+        ctrl = Flaky()
+        mgr.register(ctrl)
+        assert mgr._reconcile_one(ctrl, "a").requeue_after == 5.0
+        assert mgr._reconcile_one(ctrl, "a").requeue_after == 10.0
+        # an unrelated key starts its own schedule at the floor
+        assert mgr._reconcile_one(ctrl, "b").requeue_after == 5.0
+        # one success wipes key "a"'s history...
+        ctrl.poisoned = False
+        assert mgr._reconcile_one(ctrl, "a").requeue_after == 0.0
+        # ...so its next crash is back at the floor, not 20 s
+        ctrl.poisoned = True
+        assert mgr._reconcile_one(ctrl, "a").requeue_after == 5.0
+
+    def test_crash_backoff_cleared_on_stop(self, rig):
+        cloud, cluster, actuator, itp, unavail = rig
+
+        class Bad(WatchController):
+            name = "bad"
+            watch_kinds = ("nodeclasses",)
+
+            def reconcile(self, key):
+                raise RuntimeError("boom")
+
+        mgr = ControllerManager(cluster)
+        ctrl = Bad()
+        mgr.register(ctrl)
+        mgr._reconcile_one(ctrl, "k")
+        mgr._reconcile_one(ctrl, "k")
+        mgr.stop()   # restart semantics: history does not survive
+        assert mgr._reconcile_one(ctrl, "k").requeue_after == 5.0
+
 
 # ---------------------------------------------------------------------------
 # NodeClass controllers
@@ -488,6 +553,69 @@ class TestFaultControllers:
         InterruptionController(cluster, unavail).reconcile()
         assert not cluster.get_nodeclaim(claim.name).deleted
 
+    def test_interruption_grace_anchored_on_claim_not_node(self, rig):
+        """Re-adoption recreates the NODE object with a fresh created_at;
+        the grace window must key on the claim's registration stamp or a
+        flapping node suppresses real interruptions indefinitely."""
+        cloud, cluster, actuator, itp, unavail = rig
+        claim = launch_claim(cloud, cluster, actuator, itp)
+        kubelet = FakeKubelet(cluster)
+        node = kubelet.join(claim)           # never initialized
+        kubelet.mark_condition(node.name, "NetworkUnavailable", "True")
+        ctrl = InterruptionController(cluster, unavail)
+        # registered long ago; node object recreated just now
+        claim.registered_at = time.time() - ctrl.never_ready_grace - 1
+        node.created_at = time.time()
+        ctrl.reconcile()
+        assert cluster.get_nodeclaim(claim.name).deleted
+
+    def test_interruption_never_ready_grace_boundary(self, rig):
+        cloud, cluster, actuator, itp, unavail = rig
+        claim = launch_claim(cloud, cluster, actuator, itp)
+        kubelet = FakeKubelet(cluster)
+        node = kubelet.join(claim)
+        kubelet.mark_condition(node.name, "NetworkUnavailable", "True")
+        ctrl = InterruptionController(cluster, unavail)
+        # just inside the grace: still booting, signal suppressed
+        claim.registered_at = time.time() - (ctrl.never_ready_grace - 30)
+        ctrl.reconcile()
+        assert not cluster.get_nodeclaim(claim.name).deleted
+        # just past it: the suppression must lift
+        claim.registered_at = time.time() - (ctrl.never_ready_grace + 30)
+        ctrl.reconcile()
+        assert cluster.get_nodeclaim(claim.name).deleted
+
+    def test_interruption_unregistered_claim_falls_back_to_created_at(self, rig):
+        cloud, cluster, actuator, itp, unavail = rig
+        claim = launch_claim(cloud, cluster, actuator, itp)
+        kubelet = FakeKubelet(cluster)
+        node = kubelet.join(claim)
+        kubelet.mark_condition(node.name, "NetworkUnavailable", "True")
+        ctrl = InterruptionController(cluster, unavail)
+        assert claim.registered_at == 0.0    # registration never ran
+        claim.created_at = time.time() - ctrl.never_ready_grace - 1
+        # the unregistered fallback is the LATER of claim/node creation:
+        # a node that only just joined keeps its boot grace even though
+        # the claim's launch dragged past the window...
+        ctrl.reconcile()
+        assert not cluster.get_nodeclaim(claim.name).deleted
+        # ...and once the node itself has been up past the grace with
+        # registration still absent, the suppression lifts
+        node.created_at = time.time() - ctrl.never_ready_grace - 1
+        cluster.update("nodes", node.name, node)
+        ctrl.reconcile()
+        assert cluster.get_nodeclaim(claim.name).deleted
+
+    def test_registration_stamps_registered_at(self, rig):
+        cloud, cluster, actuator, itp, unavail = rig
+        claim = launch_claim(cloud, cluster, actuator, itp)
+        FakeKubelet(cluster).join(claim, ready=True)
+        before = time.time()
+        RegistrationController(cluster).reconcile(claim.name)
+        claim = cluster.get_nodeclaim(claim.name)
+        assert claim.registered
+        assert claim.registered_at >= before
+
     def test_spot_preemption_blackout_and_replace(self, rig):
         cloud, cluster, actuator, itp, unavail = rig
         cluster.add_nodeclass(ready_nodeclass())
@@ -523,6 +651,51 @@ class TestFaultControllers:
                               provider_id=provider_id("us-south", "inst-xyz")))
         on.reconcile()
         assert cluster.get_node("ghost") is None
+
+    def test_orphan_cleanup_never_touches_unmanaged_instances(self, rig):
+        cloud, cluster, actuator, itp, unavail = rig
+        inst = cloud.create_instance(
+            name="bare-metal-pet", profile="bx2-4x16", zone="us-south-1",
+            subnet_id=cloud.list_subnets()[0].id, image_id="img-1",
+            tags={"owner": "someone-else"})
+        cloud.instances[inst.id].created_at = time.time() - 10**6
+        OrphanCleanupController(cluster, cloud, enabled=True).reconcile()
+        assert cloud.get_instance(inst.id)   # untagged: never ours to reap
+
+    def test_orphan_cleanup_respects_min_instance_age(self, rig):
+        cloud, cluster, actuator, itp, unavail = rig
+        ctrl = OrphanCleanupController(cluster, cloud, enabled=True)
+        tags = {"karpenter.sh/managed": "true"}
+        sub = cloud.list_subnets()[0].id
+        young = cloud.create_instance(name="booting", profile="bx2-4x16",
+                                      zone="us-south-1", subnet_id=sub,
+                                      image_id="img-1", tags=tags)
+        cloud.instances[young.id].created_at = \
+            time.time() - (ctrl.min_instance_age - 60)
+        old = cloud.create_instance(name="leaked", profile="bx2-4x16",
+                                    zone="us-south-1", subnet_id=sub,
+                                    image_id="img-1", tags=tags)
+        cloud.instances[old.id].created_at = \
+            time.time() - (ctrl.min_instance_age + 60)
+        ctrl.reconcile()
+        ids = {i.id for i in cloud.list_instances()}
+        assert young.id in ids and old.id not in ids
+
+    def test_orphan_cleanup_transient_get_error_keeps_node(self, rig):
+        """A 503 on get_instance is the cloud having a bad minute, not
+        proof the instance is gone — the node must survive the sweep."""
+        cloud, cluster, actuator, itp, unavail = rig
+        claim = launch_claim(cloud, cluster, actuator, itp)
+        node = FakeKubelet(cluster).join(claim, ready=True)
+        from karpenter_tpu.cloud.errors import CloudError
+        cloud.recorder.inject_error(
+            "get_instance", CloudError("brownout", 503), times=1)
+        ctrl = OrphanCleanupController(cluster, cloud, enabled=True)
+        ctrl.reconcile()
+        assert cluster.get_node(node.name) is not None
+        # error drained: the next clean sweep still keeps the live node
+        ctrl.reconcile()
+        assert cluster.get_node(node.name) is not None
 
     def test_refreshers(self, rig):
         cloud, cluster, actuator, itp, unavail = rig

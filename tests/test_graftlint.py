@@ -396,6 +396,89 @@ def test_gl104_daemon_thread_good():
         """, "GL104", CTRL_PATH)
 
 
+def test_gl105_silent_swallow_bad():
+    assert_flags(
+        """
+        def probe(cloud):
+            try:
+                return cloud.list_instances()
+            except Exception:
+                return []
+        """, "GL105", CTRL_PATH)
+
+
+def test_gl105_bare_except_bad():
+    assert_flags(
+        """
+        def probe(cloud):
+            try:
+                return cloud.list_instances()
+            except:  # noqa: E722
+                return []
+        """, "GL105", CLOUD_PATH)
+
+
+def test_gl105_logged_good():
+    assert_clean(
+        """
+        def probe(cloud):
+            try:
+                return cloud.list_instances()
+            except Exception as e:
+                log.warning("probe failed", error=str(e))
+                return []
+        """, "GL105", CTRL_PATH)
+
+
+def test_gl105_metrics_good():
+    assert_clean(
+        """
+        def probe(cloud):
+            try:
+                return cloud.list_instances()
+            except Exception:
+                metrics.ERRORS.labels("cloud", "probe").inc()
+                return []
+        """, "GL105", CLOUD_PATH)
+
+
+def test_gl105_reraise_good():
+    assert_clean(
+        """
+        def probe(cloud):
+            try:
+                return cloud.list_instances()
+            except Exception as e:
+                err = parse_error(e, "probe")
+                raise err
+        """, "GL105", CLOUD_PATH)
+
+
+def test_gl105_narrow_except_good():
+    # catching a typed error is a classification decision, not a swallow
+    assert_clean(
+        """
+        def probe(cloud):
+            try:
+                return cloud.list_instances()
+            except CloudError:
+                return []
+        """, "GL105", CLOUD_PATH)
+
+
+def test_gl105_out_of_scope_good():
+    # solver code is Family A territory; the swallow rule targets the
+    # fault-handling plane only
+    assert_clean(
+        """
+        def probe(cloud):
+            try:
+                return cloud.list_instances()
+            except Exception:
+                return []
+        """, "GL105", SOLVER_PATH)
+
+
 # -- suppressions -----------------------------------------------------------
 
 def test_per_line_suppression():

@@ -439,7 +439,13 @@ class TestInterruptionWindowBoundaries:
         node.created_at = time.time() - age
         node.conditions[condition] = "True"
         cluster.update("nodes", node.name, node)
-        return cluster, unavail, cluster.get_nodeclaim(claim.name), node
+        # the never-ready grace anchors on the CLAIM's registration
+        # stamp (node.created_at resets on re-adoption); age the claim
+        claim = cluster.get_nodeclaim(claim.name)
+        claim.created_at = time.time() - age
+        claim.registered_at = time.time() - age
+        cluster.update("nodeclaims", claim.name, claim)
+        return cluster, unavail, claim, node
 
     def test_never_ready_inside_grace_suppressed(self, rig):
         cluster, unavail, claim, node = self._node_with_condition(

@@ -92,11 +92,14 @@ def test_remote_recovers_from_sidecar_catalog_loss(server):
 
 def test_provisioner_gate_builds_remote(server):
     from karpenter_tpu.core.provisioner import make_solver
+    from karpenter_tpu.solver.degraded import ResilientSolver
 
     solver = make_solver(SolverOptions(
         backend="remote", address=f"127.0.0.1:{server.port}"))
-    assert isinstance(solver, RemoteSolver)
-    solver.close()
+    # wrapped in the degraded-mode gate; the remote client underneath
+    assert isinstance(solver, ResilientSolver)
+    assert isinstance(solver.primary, RemoteSolver)
+    solver.close()   # delegates through the wrapper
 
 
 def test_options_validate_remote_address():

@@ -26,4 +26,5 @@ def all_rules() -> list[type[Rule]]:
         concurrency.SleepInController,        # GL102
         concurrency.UnlockedSharedMutation,   # GL103
         concurrency.NonDaemonThread,          # GL104
+        concurrency.SilentExceptionSwallow,   # GL105
     ]

@@ -283,6 +283,78 @@ class UnlockedSharedMutation(_FamilyBRule):
             yield node, attrs
 
 
+class SilentExceptionSwallow(_FamilyBRule):
+    id = "GL105"
+    name = "silent-exception-swallow"
+    description = (
+        "`except Exception` (or bare except) in controller/cloud code "
+        "whose handler neither logs, increments metrics.ERRORS, nor "
+        "re-raises. A fault swallowed silently is invisible to operators "
+        "and to the chaos harness's invariants — the exact failure class "
+        "the fault-ring exists to surface. Log it, count it in "
+        "metrics.ERRORS, or re-raise."
+    )
+
+    # narrower than the family scope: the swallow rule is about the
+    # fault-handling plane (controllers + cloud clients), where every
+    # exception is an availability signal something downstream needs
+    scope = (
+        "karpenter_tpu/controllers/*",
+        "karpenter_tpu/controllers/**/*",
+        "karpenter_tpu/cloud/*",
+        "karpenter_tpu/cloud/**/*",
+    )
+
+    _LOG_METHODS = {"debug", "info", "warning", "warn", "error",
+                    "exception", "critical"}
+
+    def check(self, module: SourceModule) -> Iterator[Finding]:
+        for node in ast.walk(module.tree):
+            if not isinstance(node, ast.Try):
+                continue
+            for handler in node.handlers:
+                if not self._broad(handler.type):
+                    continue
+                if self._observed(handler):
+                    continue
+                caught = "except" if handler.type is None else \
+                    f"except {ast.unparse(handler.type)}"
+                yield self.finding(
+                    module, handler,
+                    f"`{caught}` swallows the error without logging, "
+                    f"metrics.ERRORS, or re-raising — faults in the "
+                    f"controller/cloud plane must stay observable")
+
+    @staticmethod
+    def _broad(type_expr: ast.AST | None) -> bool:
+        if type_expr is None:
+            return True   # bare except
+        exprs = type_expr.elts if isinstance(type_expr, ast.Tuple) \
+            else [type_expr]
+        return any(attr_chain(e)[-1:] in (["Exception"], ["BaseException"])
+                   for e in exprs)
+
+    def _observed(self, handler: ast.ExceptHandler) -> bool:
+        for n in ast.walk(handler):
+            if isinstance(n, ast.Raise):
+                return True
+            if not isinstance(n, ast.Call):
+                continue
+            chain = attr_chain(n.func)
+            if not chain:
+                continue
+            # log.warning(...), self.logger.error(...), logging.exception(...)
+            if chain[-1] in self._LOG_METHODS and any(
+                    "log" in seg.lower() for seg in chain[:-1]):
+                return True
+            # metrics.ERRORS.labels(...).inc() — the inner labels() call
+            # carries the full metrics.ERRORS chain; other counters
+            # (REQUESTS, latency) do NOT record the fault and don't count
+            if "ERRORS" in chain:
+                return True
+        return False
+
+
 class NonDaemonThread(_FamilyBRule):
     id = "GL104"
     name = "non-daemon-thread"
