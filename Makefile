@@ -47,6 +47,10 @@ chaos: ## Seeded chaos matrix (profiles x seeds, deterministic; docs/design/chao
 	$(TEST_ENV) $(PY) -m karpenter_tpu.chaos --seeds 4 --rounds 10 \
 		--trace-dir .chaos-traces
 
+.PHONY: smoke
+smoke: ## Debug-surface smoke: real operator, curl-equivalent checks on /metrics /statusz /debug/traces
+	JAX_PLATFORMS=cpu $(PY) tools/smoke_debug_surface.py
+
 .PHONY: chaos-replay
 chaos-replay: ## Replay one failing scenario: make chaos-replay PROFILE=spot-storm SEED=3
 	$(TEST_ENV) $(PY) -m karpenter_tpu.chaos \

@@ -35,6 +35,7 @@ from karpenter_tpu.chaos.profile import PROFILES, ChaosProfile, get_profile
 from karpenter_tpu.chaos.solver import UnstableSolver, ValidatingSolver
 from karpenter_tpu.chaos.trace import EventTrace
 from karpenter_tpu.cloud.fake import FakeCloud
+from karpenter_tpu import obs
 from karpenter_tpu.controllers.faults import (
     InterruptionController, OrphanCleanupController, SpotPreemptionController,
 )
@@ -68,6 +69,9 @@ class ScenarioResult:
     violations: list[Violation]
     trace: EventTrace
     digest: str
+    # flight-recorder span dump (JSON-safe dicts): the causal record
+    # behind any violation — dumped next to the event trace on failure
+    spans: list = None
 
     @property
     def ok(self) -> bool:
@@ -191,7 +195,16 @@ class ChaosHarness:
         self.build()
         violations: list[Violation] = []
         try:
-            with self.clock.installed():
+            # scenario-scoped tracer: fresh deterministic span ids per
+            # run, and the recorder anchor is taken INSIDE the installed
+            # virtual clock so span offsets ride scenario time (spans
+            # deliberately stay OUT of the EventTrace digest — the span
+            # layer is evidence, the event trace is the determinism
+            # contract)
+            with self.clock.installed(), \
+                    obs.use(obs.Tracer(obs.FlightRecorder(
+                        capacity=256, error_capacity=64))) as tracer:
+                self.recorder = tracer.recorder
                 self._t0 = self.clock.time()
                 self.chaos_cloud.arm()
                 for r in range(self.rounds):
@@ -233,6 +246,9 @@ class ChaosHarness:
         for pod in make_pods(n, name_prefix=f"wave{round_no}",
                              requests=ResourceRequests(cpu, mem, 0, 1)):
             self.cluster.add_pod(pod)
+        # the pod-event end of the causal chain (chaos drives
+        # provision_once directly, so there is no watch feed to stamp it)
+        obs.instant("pod.event", wave=round_no, pods=n, cpu=cpu, mem=mem)
         self.trace.add("workload", wave=round_no, pods=n, cpu=cpu, mem=mem)
 
     def _pump(self) -> None:
@@ -254,12 +270,15 @@ class ChaosHarness:
 
 def run_scenario(profile: ChaosProfile | str, seed: int, *,
                  rounds: int = 10, **kwargs) -> ScenarioResult:
+    from karpenter_tpu.obs.export import recorder_to_dicts
+
     prof = get_profile(profile) if isinstance(profile, str) else profile
     harness = ChaosHarness(prof, seed, rounds=rounds, **kwargs)
     violations = harness.run()
     return ScenarioResult(profile=prof.name, seed=seed, rounds=rounds,
                           violations=violations, trace=harness.trace,
-                          digest=harness.trace.digest())
+                          digest=harness.trace.digest(),
+                          spans=recorder_to_dicts(harness.recorder))
 
 
 def run_matrix(profile_names: list[str] | None = None,
@@ -301,6 +320,17 @@ def run_matrix(profile_names: list[str] | None = None,
                     path = Path(trace_dir) / f"{name}-seed{seed}.jsonl"
                     res.trace.dump(path)
                     echo(f"trace: {path}")
+                    # the implicated flight-recorder traces land next to
+                    # the fault trace: the causal chain (pod event ->
+                    # provision -> solve -> actuation -> RPC attempts)
+                    # behind the violation, Perfetto-convertible via
+                    # `python -m karpenter_tpu.obs export --input ...`
+                    from karpenter_tpu.obs.export import dump_jsonl
+
+                    span_path = Path(trace_dir) / \
+                        f"{name}-seed{seed}-spans.jsonl"
+                    dump_jsonl(res.spans or [], span_path)
+                    echo(f"spans: {span_path}")
                     if res2 is not None and res2.digest != res.digest:
                         # both runs: diagnosing nondeterminism needs the
                         # divergent trace, not just the first

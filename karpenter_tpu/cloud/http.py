@@ -28,6 +28,7 @@ from collections.abc import Callable
 
 from karpenter_tpu.cloud.errors import CloudError, parse_error
 from karpenter_tpu.cloud.retry import retry_with_backoff
+from karpenter_tpu import obs
 from karpenter_tpu.utils import metrics
 from karpenter_tpu.utils.logging import get_logger
 
@@ -110,24 +111,31 @@ class HTTPClient:
             req.add_header("Content-Type", "application/json")
         if self.tokens is not None:
             req.add_header("Authorization", f"Bearer {self.tokens.token()}")
-        try:
-            with self._open(req, timeout=self.timeout) as resp:
-                status = getattr(resp, "status", 200)
+        # one span per wire attempt — retries are SEPARATE spans, so a
+        # dumped trace shows each round trip with its own status
+        with obs.span(f"rpc.{self.service}.{operation}", method=method,
+                      path=path) as sp:
+            try:
+                with self._open(req, timeout=self.timeout) as resp:
+                    status = getattr(resp, "status", 200)
+                    sp.set("status", status)
+                    metrics.API_REQUESTS.labels(self.service, operation,
+                                                str(status)).inc()
+                    payload = resp.read()
+                    return json.loads(payload) if payload else {}
+            except urllib.error.HTTPError as e:
+                sp.set("status", e.code)
                 metrics.API_REQUESTS.labels(self.service, operation,
-                                            str(status)).inc()
-                payload = resp.read()
-                return json.loads(payload) if payload else {}
-        except urllib.error.HTTPError as e:
-            metrics.API_REQUESTS.labels(self.service, operation,
-                                        str(e.code)).inc()
-            if e.code in (401, 403) and self.tokens is not None:
-                self.tokens.invalidate()   # force re-auth on next attempt
-            raise self._typed_error(e, operation)
-        except urllib.error.URLError as e:
-            metrics.API_REQUESTS.labels(self.service, operation,
-                                        "network").inc()
-            raise CloudError(f"{operation}: {e.reason}", status_code=0,
-                             code="network", retryable=True)
+                                            str(e.code)).inc()
+                if e.code in (401, 403) and self.tokens is not None:
+                    self.tokens.invalidate()  # force re-auth on next attempt
+                raise self._typed_error(e, operation)
+            except urllib.error.URLError as e:
+                sp.set("status", "network")
+                metrics.API_REQUESTS.labels(self.service, operation,
+                                            "network").inc()
+                raise CloudError(f"{operation}: {e.reason}", status_code=0,
+                                 code="network", retryable=True)
 
     @staticmethod
     def _typed_error(e: "urllib.error.HTTPError", operation: str) -> CloudError:

@@ -26,6 +26,7 @@ from collections.abc import Callable
 from typing import TypeVar
 
 from karpenter_tpu.cloud.errors import is_rate_limit, is_retryable, parse_error
+from karpenter_tpu import obs
 from karpenter_tpu.utils.logging import get_logger
 
 log = get_logger("cloud.retry")
@@ -71,7 +72,12 @@ def retry_with_backoff(fn: Callable[[], T], config: RetryConfig = None,
     last: Exception = RuntimeError("retry_with_backoff: no attempts")
     for attempt in range(cfg.steps):
         try:
-            return fn()
+            # one span per attempt: retried RPCs show up in a dumped
+            # trace as N sibling spans with the backoff decisions as
+            # events on the enclosing span
+            with obs.span("rpc.attempt", operation=operation or "call",
+                          attempt=attempt + 1):
+                return fn()
         except Exception as e:  # noqa: BLE001 — classified below
             err = parse_error(e, operation)
             if not is_retryable(err):
@@ -83,6 +89,10 @@ def retry_with_backoff(fn: Callable[[], T], config: RetryConfig = None,
             if attempt < cfg.steps - 1:
                 log.debug("retrying after error", operation=operation,
                           attempt=attempt + 1, wait=wait, error=str(e))
+                obs.event("backoff", operation=operation,
+                          attempt=attempt + 1, wait=round(wait, 4),
+                          retry_after=err.retry_after > 0,
+                          error=str(e)[:120])
                 sleep(wait)
                 if draw is not None:
                     # decorrelated: next draw ranges off the PREVIOUS

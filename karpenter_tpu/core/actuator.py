@@ -47,6 +47,7 @@ from karpenter_tpu.core.bootstrap import BootstrapOptions, BootstrapProvider, Cl
 from karpenter_tpu.core.circuitbreaker import CircuitBreakerManager
 from karpenter_tpu.core.cluster import ClusterState
 from karpenter_tpu.solver.types import Plan, PlannedNode
+from karpenter_tpu import obs
 from karpenter_tpu.utils import metrics
 from karpenter_tpu.utils.logging import get_logger
 
@@ -79,6 +80,18 @@ class Actuator:
                     catalog: CatalogArrays, nodepool_name: str = "default") -> NodeClaim:
         """Launch one instance for a planned node; returns the launched
         NodeClaim (registered into cluster state)."""
+        with obs.span("actuate.create",
+                      instance_type=planned.instance_type, zone=planned.zone,
+                      capacity_type=planned.capacity_type,
+                      nodeclass=nodeclass.name) as sp:
+            claim = self._create_node_span(planned, nodeclass, catalog,
+                                           nodepool_name, sp)
+            sp.set("claim", claim.name)
+            return claim
+
+    def _create_node_span(self, planned: PlannedNode, nodeclass: NodeClass,
+                          catalog: CatalogArrays, nodepool_name: str,
+                          sp) -> NodeClaim:
         if not nodeclass.status.is_ready():
             self.cluster.record_event("NodeClass", nodeclass.name, "Warning",
                                       "NotReady", "nodeclass not ready for provisioning")
@@ -86,6 +99,8 @@ class Actuator:
                              status_code=409, retryable=False)
         region = nodeclass.spec.region
         self.breaker.can_provision(nodeclass.name, region)
+        # breaker state AFTER the gate passed (half-open probes show up)
+        sp.set("cb_state", self.breaker.get(nodeclass.name, region).state)
         t0 = time.perf_counter()
         try:
             claim = self._do_create(planned, nodeclass, catalog, nodepool_name)
@@ -183,22 +198,29 @@ class Actuator:
         vni_id = ""
         created_volume_ids: list[str] = []
         try:
-            vni_id = self.cloud.create_vni(subnet_id).id
+            with obs.span("rpc.create_vni", subnet=subnet_id):
+                vni_id = self.cloud.create_vni(subnet_id).id
             for i, bdm in enumerate(nodeclass.spec.block_device_mappings):
                 v = bdm.volume
-                created_volume_ids.append(self.cloud.create_volume(
-                    capacity_gb=v.capacity_gb, profile=v.profile,
-                    volume_id=f"vol-{node_name}-{i}").id)
-            return self.cloud.create_instance(
-                name=node_name, profile=planned.instance_type,
-                zone=planned.zone, subnet_id=subnet_id, image_id=image_id,
-                capacity_type=planned.capacity_type,
-                security_group_ids=sgs or (),
-                user_data=user_data,
-                vni_id=vni_id, volume_ids=tuple(created_volume_ids),
-                tags={**KARPENTER_TAGS,
-                      "karpenter.sh/nodepool": nodepool_name,
-                      "karpenter-tpu.sh/nodeclass": nodeclass.name})
+                with obs.span("rpc.create_volume", index=i):
+                    created_volume_ids.append(self.cloud.create_volume(
+                        capacity_gb=v.capacity_gb, profile=v.profile,
+                        volume_id=f"vol-{node_name}-{i}").id)
+            with obs.span("rpc.create_instance",
+                          instance_type=planned.instance_type,
+                          zone=planned.zone,
+                          capacity_type=planned.capacity_type):
+                return self.cloud.create_instance(
+                    name=node_name, profile=planned.instance_type,
+                    zone=planned.zone, subnet_id=subnet_id,
+                    image_id=image_id,
+                    capacity_type=planned.capacity_type,
+                    security_group_ids=sgs or (),
+                    user_data=user_data,
+                    vni_id=vni_id, volume_ids=tuple(created_volume_ids),
+                    tags={**KARPENTER_TAGS,
+                          "karpenter.sh/nodepool": nodepool_name,
+                          "karpenter-tpu.sh/nodeclass": nodeclass.name})
         except Exception:
             self._cleanup_partial_create(vni_id, created_volume_ids)
             raise
@@ -283,16 +305,21 @@ class Actuator:
         POSITIONALLY aligned to plan.nodes (None = that create failed).  A
         failed node leaves its pods pending for the next solve window (the
         reference's per-NodeClaim create failures behave the same)."""
-        claims: list[NodeClaim | None] = []
-        errors: list[str] = []
-        for planned in plan.nodes:
-            try:
-                claims.append(self.create_node(planned, nodeclass, catalog,
-                                               nodepool_name))
-            except Exception as e:  # noqa: BLE001
-                claims.append(None)
-                errors.append(f"{planned.instance_type}/{planned.zone}: {e}")
-        return claims, errors
+        with obs.span("actuate.plan", nodes=len(plan.nodes),
+                      nodepool=nodepool_name, backend=plan.backend) as sp:
+            claims: list[NodeClaim | None] = []
+            errors: list[str] = []
+            for planned in plan.nodes:
+                try:
+                    claims.append(self.create_node(planned, nodeclass,
+                                                   catalog, nodepool_name))
+                except Exception as e:  # noqa: BLE001
+                    claims.append(None)
+                    errors.append(f"{planned.instance_type}/"
+                                  f"{planned.zone}: {e}")
+            if errors:
+                sp.fail(f"{len(errors)} of {len(plan.nodes)} creates failed")
+            return claims, errors
 
     # -- delete ------------------------------------------------------------
 
@@ -304,18 +331,49 @@ class Actuator:
         if parsed is None:
             raise NodeClaimNotFoundError(claim.name)
         _, instance_id = parsed
-        try:
-            self.cloud.delete_instance(instance_id)
-        except CloudError as e:
-            if not is_not_found(e):
-                raise
+        # expected not-found outcomes are caught INSIDE the spans: a
+        # routine successful delete must not mint error traces, or the
+        # flight recorder's error ring (reserved for real failures)
+        # drowns in the success path
+        with obs.span("rpc.delete_instance", instance=instance_id) as sp:
+            try:
+                self.cloud.delete_instance(instance_id)
+            except CloudError as e:
+                if not is_not_found(e):
+                    raise
+                sp.set("already_gone", True)
         # verify gone
-        try:
-            self.cloud.get_instance(instance_id)
-        except CloudError as e:
-            if is_not_found(e):
-                metrics.INSTANCE_LIFECYCLE.labels("deleted", claim.instance_type,
-                                                  claim.zone).inc()
-                raise NodeClaimNotFoundError(claim.name)
-            raise
+        gone = False
+        with obs.span("rpc.get_instance", instance=instance_id,
+                      verify="post-delete") as sp:
+            try:
+                self.cloud.get_instance(instance_id)
+            except CloudError as e:
+                if not is_not_found(e):
+                    raise
+                gone = True
+                sp.set("gone", True)
+        if gone:
+            metrics.INSTANCE_LIFECYCLE.labels("deleted", claim.instance_type,
+                                              claim.zone).inc()
+            self._drop_cost_series(claim)
+            raise NodeClaimNotFoundError(claim.name)
         raise CloudError(f"instance {instance_id} still exists after delete", 500)
+
+    def _drop_cost_series(self, claim: NodeClaim) -> None:
+        """Series hygiene: the COST_PER_HOUR gauge is keyed by
+        (instance_type, zone, capacity_type) — drop the label set once the
+        LAST claim with that shape is verifiably gone, or churned
+        offerings accumulate stale series forever.  A deleted-marked
+        sibling still counts as live: the tombstone is set BEFORE the
+        cloud delete (which can fail and requeue for minutes), and a
+        claim leaves cluster state only once its instance is verifiably
+        gone — until then the shape is still billing."""
+        for other in self.cluster.nodeclaims():
+            if other.name != claim.name \
+                    and other.instance_type == claim.instance_type \
+                    and other.zone == claim.zone \
+                    and other.capacity_type == claim.capacity_type:
+                return
+        metrics.COST_PER_HOUR.remove(claim.instance_type, claim.zone,
+                                     claim.capacity_type)

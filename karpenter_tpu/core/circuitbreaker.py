@@ -20,6 +20,7 @@ import time
 from dataclasses import dataclass
 from collections.abc import Callable
 
+from karpenter_tpu import obs
 from karpenter_tpu.utils import metrics
 from karpenter_tpu.utils.logging import get_logger
 
@@ -153,6 +154,11 @@ class CircuitBreaker:
         if state != self.state:
             log.info("circuit breaker transition", key=self._key,
                      frm=self.state, to=state)
+            # pure in-memory marker (instant span / span event) — safe
+            # under self._lock, and it puts breaker flips on the same
+            # timeline as the RPC spans they gate
+            obs.instant("cb.transition", nodeclass=self._key[0],
+                        region=self._key[1], frm=self.state, to=state)
             self.state = state
             self._last_state_change = now
             # 0=CLOSED 1=OPEN 2=HALF_OPEN — the PrometheusRule alert

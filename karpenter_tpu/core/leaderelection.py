@@ -115,6 +115,9 @@ class LeaderElector:
         if self._leading:
             self._release()
             self._set_leading(False)
+        # series hygiene: a stopped elector's lease gauge must not linger
+        # as a stale 0 row (replicas churn; the scrape joins on lease)
+        metrics.LEADER.remove(self.lease_name)
 
     def try_acquire_or_renew(self) -> bool:
         """One CAS round.  Returns whether this identity holds the lease
@@ -183,6 +186,10 @@ class LeaderElector:
                 return
             self._leading = leading
         metrics.LEADER.labels(self.lease_name).set(1.0 if leading else 0.0)
+        from karpenter_tpu import obs
+
+        obs.instant("leader.transition", lease=self.lease_name,
+                    leading=leading)
         if leading:
             log.info("became leader", lease=self.lease_name,
                      identity=self.identity)

@@ -30,6 +30,7 @@ from karpenter_tpu.core.window import SolveWindow, WindowOptions
 from karpenter_tpu.solver.greedy import GreedySolver
 from karpenter_tpu.solver.jax_backend import JaxSolver
 from karpenter_tpu.solver.types import Plan, SolveRequest, SolverOptions
+from karpenter_tpu import obs
 from karpenter_tpu.utils.logging import get_logger
 
 log = get_logger("core.provisioner")
@@ -116,6 +117,7 @@ class Provisioner:
                 # a restart never pays 10k signature constructions
                 # inside one window (apis/pod.py intern_signatures)
                 intern_signatures((pending.spec,))
+                obs.instant("pod.event", pod=pod_key(pending.spec))
                 self._window.add(pending.spec)
 
         def on_claim_event(event_type: str, claim):
@@ -222,6 +224,16 @@ class Provisioner:
             return [nominated.get(pod_key(p)) for p in pods]
 
     def _provision(self, pods: list[PodSpec]) -> tuple[list[Plan], dict[str, str]]:
+        """Span-wrapped provisioning cycle: the root of the causal chain
+        when invoked synchronously (provision_once, chaos, repair loops);
+        under a fired window it nests beneath the batch.window span."""
+        with obs.span("provision.cycle", pods=len(pods)) as sp:
+            plans, nominated = self._provision_pools(pods)
+            sp.set("plans", len(plans))
+            sp.set("nominated", len(nominated))
+            return plans, nominated
+
+    def _provision_pools(self, pods: list[PodSpec]) -> tuple[list[Plan], dict[str, str]]:
         """Two soft-taint passes over the pool ladder (kube's
         PreferNoSchedule semantics: 'prefer not to schedule, but
         allow'): pass 0 offers each pool only the pods that tolerate its

@@ -197,6 +197,24 @@ class Operator:
             ctrls.append(LBMembershipSweeper(self.cluster, self.lb_provider))
         return ctrls
 
+    # -- introspection -----------------------------------------------------
+
+    def statusz(self) -> dict:
+        """Operator-level /statusz extras: backend + leadership + breaker
+        state + the last solve's stats — the 'why is this cycle slow'
+        one-pager next to /debug/traces' full causal record."""
+        solver = self.provisioner.solver
+        last = dict(getattr(solver, "last_stats", None) or {})
+        return {
+            "backend": self.options.solver.backend,
+            "started": self._started,
+            "leader": bool(self.elector.is_leader()),
+            "controllers": len(self.manager.controllers()),
+            "circuit_breakers": {f"{k[0]}/{k[1]}": v
+                                 for k, v in self.breaker.states().items()},
+            "last_solve": last,
+        }
+
     # -- lifecycle ---------------------------------------------------------
 
     def _start_solver_warmup(self) -> None:
@@ -266,6 +284,11 @@ class Operator:
         the provisioning window)."""
         if self._started:
             return
+        # build identity rendered before the first scrape can arrive
+        # (dashboards join series against karpenter_tpu_build_info)
+        from karpenter_tpu.utils.metrics import record_build_info
+
+        record_build_info(backend=self.options.solver.backend)
         self._start_solver_warmup()
         self.elector.start()
         self.manager.sync(rounds=1)    # restart = resume (SURVEY.md §5.4)
@@ -276,7 +299,8 @@ class Operator:
 
             self.metrics_server = MetricsServer(
                 port=self.options.metrics_port,
-                ready_check=lambda: self._started).start()
+                ready_check=lambda: self._started,
+                statusz=self.statusz).start()
         if self.options.webhook_port and self.webhook_server is None:
             # dedicated TLS admission listener: the API server refuses
             # plaintext webhooks, so /validate-nodeclass must be served

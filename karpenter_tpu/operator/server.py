@@ -12,6 +12,15 @@ webhook for exactly this).  Accepts either a Kubernetes AdmissionReview
 envelope (returns the AdmissionReview response shape) or a bare
 CRD-shaped NodeClass document (returns ``{"allowed", "errors"}``).
 
+Debug surface (docs/design/observability.md):
+
+- ``GET /debug/traces[?status=error&min_ms=10&limit=20]`` — recent
+  traces from the process flight recorder (karpenter_tpu.obs), newest
+  first, errors never evicted by successes;
+- ``GET /statusz`` — uptime, build identity, last solve breakdown,
+  leader / circuit-breaker state (the operator wires its own extras in
+  via the ``statusz`` callback).
+
 stdlib http.server on a daemon thread — no extra dependencies.
 """
 
@@ -19,7 +28,9 @@ from __future__ import annotations
 
 import json
 import threading
+import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from urllib.parse import parse_qs, urlparse
 from collections.abc import Callable
 
 from karpenter_tpu.utils import metrics
@@ -84,8 +95,13 @@ class MetricsServer:
 
     def __init__(self, host: str = "0.0.0.0", port: int = 8080,
                  ready_check: Callable[[], bool] | None = None,
-                 tls_cert: str = "", tls_key: str = ""):
+                 tls_cert: str = "", tls_key: str = "",
+                 statusz: Callable[[], dict] | None = None):
         self._ready = ready_check or (lambda: True)
+        # operator-supplied /statusz extras (backend, leader, breakers,
+        # last solve); the server owns uptime + version
+        self._statusz_extra = statusz
+        self._started_at = time.time()
         outer = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -94,6 +110,11 @@ class MetricsServer:
                     body = metrics.render().encode()
                     self._reply(200, body,
                                 "text/plain; version=0.0.4; charset=utf-8")
+                elif self.path.split("?", 1)[0] == "/debug/traces":
+                    self._json_endpoint(
+                        lambda: outer._debug_traces(self.path))
+                elif self.path.split("?", 1)[0] == "/statusz":
+                    self._json_endpoint(outer._statusz)
                 elif self.path == "/healthz":
                     from karpenter_tpu.version import get_version
 
@@ -127,6 +148,18 @@ class MetricsServer:
                                       "errors": [f"webhook error: {e}"]}
                                      ).encode()
                 self._reply(200, out, "application/json")
+
+            def _json_endpoint(self, fn) -> None:
+                """Debug-surface contract: 200 + JSON payload, or 500 +
+                ``{"error"}`` — never an exception through the stdlib
+                handler (which would drop the socket)."""
+                try:
+                    body = json.dumps(fn(), default=str).encode()
+                    self._reply(200, body, "application/json")
+                except Exception as e:  # noqa: BLE001 — debug surface
+                    self._reply(500, json.dumps(
+                        {"error": str(e)[:200]}).encode(),
+                        "application/json")
 
             def _reply(self, status: int, body: bytes, ctype: str):
                 self.send_response(status)
@@ -166,6 +199,41 @@ class MetricsServer:
             self._server = ThreadingHTTPServer((host, port), Handler)
         self.port = self._server.server_address[1]
         self._thread: threading.Thread | None = None
+
+    # -- debug endpoints ----------------------------------------------------
+
+    def _debug_traces(self, path: str) -> dict:
+        from karpenter_tpu import obs
+        from karpenter_tpu.obs.export import debug_traces
+
+        q = parse_qs(urlparse(path).query)
+
+        def one(key, default, cast):
+            try:
+                return cast(q[key][0]) if key in q and q[key] else default
+            except (TypeError, ValueError):
+                return default
+
+        return debug_traces(
+            obs.get_recorder(),
+            status=one("status", None, str),
+            min_duration_ms=one("min_ms", 0.0, float),
+            limit=one("limit", 50, int))
+
+    def _statusz(self) -> dict:
+        from karpenter_tpu import obs
+        from karpenter_tpu.version import get_version
+
+        out = {
+            "uptime_s": round(time.time() - self._started_at, 3),
+            "version": get_version(),
+            "ready": bool(self._ready()),
+            "recorder": obs.get_recorder().stats(),
+            "last_solve_phases_ms": obs.last_solve_breakdown(),
+        }
+        if self._statusz_extra is not None:
+            out.update(self._statusz_extra())
+        return out
 
     def start(self) -> "MetricsServer":
         self._thread = threading.Thread(target=self._server.serve_forever,

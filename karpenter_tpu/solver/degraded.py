@@ -25,6 +25,7 @@ import math
 
 from karpenter_tpu.apis.pod import pod_key
 from karpenter_tpu.solver.types import Plan, SolveRequest, SolverOptions
+from karpenter_tpu import obs
 from karpenter_tpu.utils import metrics
 from karpenter_tpu.utils.logging import get_logger
 
@@ -109,6 +110,11 @@ class ResilientSolver:
 
     def _degrade(self, request: SolveRequest, reason: str) -> Plan:
         metrics.ERRORS.labels("solver", f"degraded_{reason}").inc()
-        plan = self.fallback.solve(request)
+        # the degradation is a first-class node in the causal chain: the
+        # fallback's own "solve" span nests under it, so a dumped trace
+        # shows WHICH solve ran degraded and why
+        with obs.span("solve.degraded", reason=reason,
+                      backend=self.options.backend):
+            plan = self.fallback.solve(request)
         plan.backend = f"degraded:{plan.backend}"
         return plan

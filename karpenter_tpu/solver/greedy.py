@@ -30,6 +30,7 @@ import numpy as np
 
 from karpenter_tpu.solver.encode import EncodedProblem, encode
 from karpenter_tpu.solver.types import Plan, PlannedNode, SolveRequest, SolverOptions
+from karpenter_tpu import obs
 from karpenter_tpu.utils import metrics
 
 
@@ -99,8 +100,12 @@ class GreedySolver:
         from karpenter_tpu.solver.zonesplit import solve_with_zone_candidates
 
         t0 = time.perf_counter()
-        # handles the zone_candidates gate internally
-        plan = solve_with_zone_candidates(self, request)
+        with obs.span("solve", backend="greedy",
+                      pods=len(request.pods)) as sp:
+            # handles the zone_candidates gate internally
+            plan = solve_with_zone_candidates(self, request)
+            sp.set("nodes", len(plan.nodes))
+            sp.set("unplaced", len(plan.unplaced_pods))
         plan.solve_seconds = time.perf_counter() - t0
         metrics.SOLVE_DURATION.labels("greedy").observe(plan.solve_seconds)
         metrics.SOLVE_PODS.labels("greedy").observe(len(request.pods))

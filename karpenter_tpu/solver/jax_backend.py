@@ -43,10 +43,22 @@ from karpenter_tpu.solver.types import (
     BATCH_BUCKETS, GROUP_BUCKETS, LABELROW_BUCKETS, NODE_BUCKETS,
     OFFERING_BUCKETS, Plan, PlannedNode, SolveRequest, SolverOptions, bucket,
 )
+from karpenter_tpu import obs
 from karpenter_tpu.utils import metrics
 from karpenter_tpu.utils.logging import get_logger
 
 log = get_logger("solver.jax")
+
+
+def _phase(name: str, t0: float, t1: float, parent=None, **attrs) -> None:
+    """ONE measurement feeds BOTH observability layers: a retroactive
+    span (flight recorder) and the solve_phase histogram — the span dump
+    and the scraped metric can never disagree about a phase's duration.
+    Cost on the hot path: one allocation + one preallocated ring-slot
+    write + one histogram observe (timestamps are taken by the caller
+    with two ``obs.now()`` reads, no context-manager machinery)."""
+    obs.record("solve." + name, t0, t1, parent=parent, **attrs)
+    metrics.SOLVE_PHASE.labels(name).observe(t1 - t0)
 
 # plain int: weak-typed in jnp.where, and a module-level jnp constant
 # would initialize the JAX backend at import time (hanging process start
@@ -824,10 +836,14 @@ class JaxSolver:
         from karpenter_tpu.solver.zonesplit import solve_with_zone_candidates
 
         t0 = time.perf_counter()
-        with _maybe_trace("karpenter_tpu.solve"):
+        with _maybe_trace("karpenter_tpu.solve"), \
+                obs.span("solve", backend="jax",
+                         pods=len(request.pods)) as sp:
             # handles the zone_candidates gate internally (single solve
             # when off or no affinity groups)
             plan = solve_with_zone_candidates(self, request)
+            sp.set("nodes", len(plan.nodes))
+            sp.set("path", self.last_stats.get("path", ""))
         plan.solve_seconds = time.perf_counter() - t0
         metrics.SOLVE_DURATION.labels("jax").observe(plan.solve_seconds)
         metrics.SOLVE_PODS.labels("jax").observe(len(request.pods))
@@ -860,17 +876,21 @@ class JaxSolver:
             attempt = dispatch_flat(self, problem)
             if attempt is not None:
                 return PendingSolve(self, problem, flat=attempt)
+        par = obs.current_span()
+        t_enc = obs.now()
         prep = self._prepare(problem)
-        t0 = time.perf_counter()
+        _phase("encode", t_enc, obs.now(), parent=par)
+        t0 = obs.now()
         dev, path = self._dispatch(prep, prep.packed)
         try:
             dev.copy_to_host_async()
         except Exception:  # noqa: BLE001 — cpu arrays may not support it
             pass
         fut = _prefetch(dev)
+        t_iss = obs.now()
+        _phase("h2d", t0, t_iss, parent=par, path=path)
         return PendingSolve(self, problem, prep=prep, dev=dev, path=path,
-                            fut=fut, t_disp=t0,
-                            t_issued=time.perf_counter())
+                            fut=fut, t_disp=t0, t_issued=t_iss, span=par)
 
     def solve_stream(self, problems, depth: int = 2, batch: object = "auto"):
         """Solve an iterable of EncodedProblems through a depth-``depth``
@@ -969,10 +989,12 @@ class JaxSolver:
         shared by solve_encoded and the gRPC sidecar (service.py), which
         receives pre-padded arrays over the wire and has no
         EncodedProblem to decode against."""
+        par = obs.current_span()
         while True:
-            t_disp = time.perf_counter()
+            t_disp = obs.now()
             out_dev, path = self._dispatch(prep, prep.packed)
-            t_issued = time.perf_counter()
+            t_issued = obs.now()
+            _phase("h2d", t_disp, t_issued, parent=par, path=path)
             # ONE synchronous D2H: np.asarray blocks through compute and
             # fetch in a single round trip (no separate block_until_ready
             # sync — that would be a second RTT on the timing path).  TPU
@@ -994,15 +1016,19 @@ class JaxSolver:
                     (prep.G_pad, prep.O_pad, prep.N))
                 out_dev, path = self._dispatch(prep, prep.packed)
                 out_np = np.asarray(out_dev)
-            t_fetch = time.perf_counter()
+            t_fetch = obs.now()
+            _phase("compute", t_issued, t_fetch, parent=par, path=path)
             if coo_buffer_full(out_np, prep.G_pad, prep.N, prep.K,
                                prep.coo16) and prep.K0 < prep.K_cap:
                 prep.grow_K0(grow_coo(prep.K0, prep.K_cap))
                 self._note_coo_growth(prep.G_pad, prep.K0)
                 continue
+            t_dec = obs.now()
             node_off, assign, unplaced, cost = unpack_result(
                 out_np, prep.G_pad, prep.N, prep.K, prep.dense16,
                 prep.coo16)
+            _phase("d2h", t_dec, obs.now(), parent=par,
+                   bytes=int(out_np.nbytes))
             metrics.SOLVE_PATH.labels(path).inc()
             d2h = int(out_np.nbytes)
             metrics.SOLVE_D2H_BYTES.labels("jax").observe(d2h)
@@ -1440,10 +1466,11 @@ class PendingSolve:
     densification on the pipelined path."""
 
     __slots__ = ("_solver", "_problem", "_prep", "_dev", "_path", "_flat",
-                 "_fut", "_t_disp", "_t_issued", "_done")
+                 "_fut", "_t_disp", "_t_issued", "_done", "_span")
 
     def __init__(self, solver, problem, prep=None, dev=None, path="",
-                 flat=None, fut=None, t_disp=0.0, t_issued=0.0, done=None):
+                 flat=None, fut=None, t_disp=0.0, t_issued=0.0, done=None,
+                 span=None):
         self._solver = solver
         self._problem = problem
         self._prep = prep
@@ -1454,6 +1481,10 @@ class PendingSolve:
         self._t_disp = t_disp
         self._t_issued = t_issued
         self._done = done
+        # parent span captured at DISPATCH time: result() may run on a
+        # different control flow (pipelined drains), so the ambient
+        # context there would mis-parent the compute/d2h phase spans
+        self._span = span
 
     def result(self) -> Plan:
         if self._done is not None:
@@ -1487,20 +1518,24 @@ class PendingSolve:
                 dev, path = solver._dispatch(prep, prep.packed)
                 fut = _prefetch(dev)
                 continue
-            t_fetch = time.perf_counter()
+            t_fetch = obs.now()
+            _phase("compute", t_issued, t_fetch, parent=self._span,
+                   path=path)
             G, N, K = prep.G_pad, prep.N, prep.K
             if coo_buffer_full(out_np, G, N, K, prep.coo16) \
                     and prep.K0 < prep.K_cap:
                 prep.grow_K0(grow_coo(prep.K0, prep.K_cap))
                 solver._note_coo_growth(G, prep.K0)
-                t_disp = time.perf_counter()
+                t_disp = obs.now()
                 dev, path = solver._dispatch(prep, prep.packed)
                 try:
                     dev.copy_to_host_async()
                 except Exception:  # noqa: BLE001
                     pass
                 fut = _prefetch(dev)
-                t_issued = time.perf_counter()
+                t_issued = obs.now()
+                _phase("h2d", t_disp, t_issued, parent=self._span,
+                       path=path, retry="coo_growth")
                 continue
             node_off = out_np[:N]
             unplaced = out_np[N:N + G]
@@ -1516,15 +1551,18 @@ class PendingSolve:
                 "compact": bool(K), "G": G, "O": prep.O_pad, "N": N}
             if needs_node_escalation(node_off, unplaced, N, prep.N_cap):
                 prep.escalate_N(bucket(prep.N * 4, NODE_BUCKETS))
-                t_disp = time.perf_counter()
+                t_disp = obs.now()
                 dev, path = solver._dispatch(prep, prep.packed)
                 try:
                     dev.copy_to_host_async()
                 except Exception:  # noqa: BLE001
                     pass
                 fut = _prefetch(dev)
-                t_issued = time.perf_counter()
+                t_issued = obs.now()
+                _phase("h2d", t_disp, t_issued, parent=self._span,
+                       path=path, retry="node_escalation")
                 continue
+            t_dec = obs.now()
             if K > 0:
                 idx, cnt = unpack_coo_tail(out_np, G, N, K, prep.coo16)
                 live = cnt > 0
@@ -1538,6 +1576,8 @@ class PendingSolve:
                 self._done = decode_plan(self._problem, node_off,
                                          assign.astype(np.int32), unplaced,
                                          cost, "jax")
+            _phase("d2h", t_dec, obs.now(), parent=self._span,
+                   bytes=int(out_np.nbytes))
             return self._done
 
 
@@ -1552,10 +1592,11 @@ class BatchPendingSolve:
     __slots__ = ("_solver", "_problems", "_preps", "_C", "_C_pad", "_rows",
                  "_N", "_N_run", "_N_cap", "_K0", "_K_cap", "_dense16_ok",
                  "_K", "_dense16", "_coo16", "_dev", "_fut", "_path",
-                 "_t_disp", "_t_issued", "_done")
+                 "_t_disp", "_t_issued", "_done", "_span")
 
     def __init__(self, solver: "JaxSolver", items):
         self._solver = solver
+        self._span = obs.current_span()
         self._problems = [p for p, _ in items]
         self._preps = [pr for _, pr in items]
         p0 = self._preps[0]
@@ -1574,7 +1615,7 @@ class BatchPendingSolve:
     def _dispatch(self):
         solver, p0 = self._solver, self._preps[0]
         G, O = p0.G_pad, p0.O_pad
-        self._t_disp = time.perf_counter()
+        self._t_disp = obs.now()
         Np = max(self._N, 128)        # pallas needs a 128-multiple axis
         use_pallas = Np <= self._N_cap \
             and solver._use_pallas(G, O, Np) \
@@ -1605,7 +1646,9 @@ class BatchPendingSolve:
         except Exception:  # noqa: BLE001 — cpu arrays
             pass
         self._fut = _prefetch(self._dev)
-        self._t_issued = time.perf_counter()
+        self._t_issued = obs.now()
+        _phase("h2d", self._t_disp, self._t_issued, parent=self._span,
+               path=self._path, batch=self._C)
 
     def results(self) -> list[Plan]:
         if self._done is not None:
@@ -1629,7 +1672,9 @@ class BatchPendingSolve:
                 solver._pallas_failed_shapes.add((G, O, self._N_run))
                 self._dispatch()
                 continue
-            t_fetch = time.perf_counter()
+            t_fetch = obs.now()
+            _phase("compute", self._t_issued, t_fetch, parent=self._span,
+                   path=self._path, batch=self._C)
             N, K = self._N_run, self._K
             if self._K0 < self._K_cap and any(
                     coo_buffer_full(out_np[c], G, N, K, self._coo16)
@@ -1665,6 +1710,7 @@ class BatchPendingSolve:
                 "d2h_bytes": int(out_np.nbytes),
                 "h2d_bytes": int(self._rows.nbytes),
                 "compact": bool(K), "G": G, "O": O, "N": N}
+            t_dec = obs.now()
             plans = []
             for problem, (row, node_off, unplaced, cost) in zip(
                     self._problems, parsed):
@@ -1682,6 +1728,8 @@ class BatchPendingSolve:
                     plans.append(decode_plan(problem, node_off,
                                              assign.astype(np.int32),
                                              unplaced, cost, "jax"))
+            _phase("d2h", t_dec, obs.now(), parent=self._span,
+                   bytes=int(out_np.nbytes), batch=self._C)
             self._done = plans
             return plans
 

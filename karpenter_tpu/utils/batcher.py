@@ -22,10 +22,22 @@ from dataclasses import dataclass, field
 from collections.abc import Callable, Hashable, Sequence
 from typing import Generic, TypeVar
 
+from karpenter_tpu import obs
 from karpenter_tpu.utils import metrics
 
 T = TypeVar("T")  # request item type
 U = TypeVar("U")  # per-item result type
+
+# per-item intake spans recorded under a fired window's root span; capped
+# so a 10k-pod window costs 32 span slots, not 10k (the rest summarized
+# in the root span's attributes)
+_INTAKE_SPAN_CAP = 32
+
+
+def _item_label(item) -> str:
+    name = getattr(item, "name", "")
+    return name if isinstance(name, str) and name \
+        else type(item).__name__
 
 
 @dataclass
@@ -52,6 +64,9 @@ def default_hasher(item) -> Hashable:
 class _Pending(Generic[T, U]):
     item: T
     future: "Future[U]" = field(default_factory=Future)
+    # enqueue stamp on the obs clock: the fired window's root span is
+    # backdated to the oldest item so the trace shows queueing time
+    enqueued_at: float = field(default_factory=obs.now)
 
 
 class Batcher(Generic[T, U]):
@@ -146,18 +161,33 @@ class Batcher(Generic[T, U]):
                 self._pool.submit(self._exec, batch)
 
     def _exec(self, batch: list[_Pending[T, U]]) -> None:
-        try:
-            results = self._handler([p.item for p in batch])
-            if results is None or len(results) != len(batch):
-                raise ValueError(
-                    f"batch handler returned {0 if results is None else len(results)} "
-                    f"results for {len(batch)} items")
-            for p, r in zip(batch, results):
-                p.future.set_result(r)
-        except Exception as e:  # propagate to every caller
-            for p in batch:
-                if not p.future.done():
-                    p.future.set_exception(e)
+        # ONE trace per fired window, rooted at the oldest enqueue: the
+        # handler (solve -> actuate -> cloud RPC) runs inside this span's
+        # context, so the whole provisioning chain nests under it
+        t_fire = obs.now()
+        with obs.span(f"batch.window:{self._opts.name}",
+                      start=min(p.enqueued_at for p in batch),
+                      batcher=self._opts.name, items=len(batch)) as sp:
+            for p in batch[:_INTAKE_SPAN_CAP]:
+                obs.record("pod.intake", p.enqueued_at, t_fire, parent=sp,
+                           item=_item_label(p.item))
+            if len(batch) > _INTAKE_SPAN_CAP:
+                sp.set("intake_spans_truncated",
+                       len(batch) - _INTAKE_SPAN_CAP)
+            try:
+                results = self._handler([p.item for p in batch])
+                if results is None or len(results) != len(batch):
+                    raise ValueError(
+                        f"batch handler returned "
+                        f"{0 if results is None else len(results)} "
+                        f"results for {len(batch)} items")
+                for p, r in zip(batch, results):
+                    p.future.set_result(r)
+            except Exception as e:  # propagate to every caller
+                sp.fail(e)
+                for p in batch:
+                    if not p.future.done():
+                        p.future.set_exception(e)
 
     def _flush_all(self) -> None:
         with self._cv:

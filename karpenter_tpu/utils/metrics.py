@@ -265,6 +265,15 @@ SOLVE_D2H_BYTES = Histogram(
     "karpenter_tpu_solve_d2h_bytes",
     "Device->host result bytes per solve", ("backend",),
     buckets=(1 << 10, 1 << 13, 1 << 16, 1 << 18, 1 << 20, 1 << 22, 1 << 24))
+SOLVE_PHASE = Histogram(
+    "karpenter_tpu_solve_phase_seconds",
+    "Per-phase solve latency: encode (host encode+pack), h2d (H2D upload "
+    "+ kernel dispatch), compute (device execute + D2H await — not "
+    "separable through the async fetch without an extra round trip), "
+    "d2h (host-side result unpack/decode).  Fed from the SAME "
+    "measurements as the obs span layer so the two agree.", ("phase",),
+    buckets=(0.00005, 0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005,
+             0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0))
 LEADER = Gauge(
     "karpenter_tpu_leader",
     "1 when this replica holds the named leader-election lease", ("lease",))
@@ -272,6 +281,36 @@ CB_STATE = Gauge(
     "karpenter_tpu_circuit_breaker_state",
     "Circuit breaker state per (nodeclass, region): 0=closed 1=open "
     "2=half-open", ("nodeclass", "region"))
+
+BUILD_INFO = Gauge(
+    "karpenter_tpu_build_info",
+    "Always 1; the labels carry build identity (version, solver backend, "
+    "jax platform) — join other series against it in dashboards",
+    ("version", "backend", "platform"))
+
+
+def record_build_info(backend: str = "", platform: str = "") -> None:
+    """Render the build_info series (operator startup; idempotent — the
+    series is keyed by its labels, and stale label sets are dropped so a
+    backend change never leaves two '1' rows)."""
+    import sys
+
+    from karpenter_tpu.version import get_version
+
+    if not platform:
+        jax_mod = sys.modules.get("jax")
+        if jax_mod is not None:
+            try:
+                platform = jax_mod.default_backend()
+            except Exception:  # noqa: BLE001 — identity must never fail boot
+                platform = "unknown"
+        else:
+            import os
+
+            platform = os.environ.get("JAX_PLATFORMS", "") or "uninitialized"
+    BUILD_INFO.reset()
+    BUILD_INFO.labels(get_version(), backend or "unknown", platform).set(1.0)
+
 
 # Autoplacement families (autoplacement/metrics.go:81).
 AUTOPLACEMENT_SELECTIONS = Counter(

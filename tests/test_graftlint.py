@@ -479,6 +479,76 @@ def test_gl105_out_of_scope_good():
         """, "GL105", SOLVER_PATH)
 
 
+def test_gl106_unclosed_span_bad():
+    assert_flags(
+        """
+        from karpenter_tpu import obs
+
+        def provision(pods):
+            sp = obs.span("provision.cycle", pods=len(pods))
+            do_work(pods)        # an exception here leaks the open span
+        """, "GL106", CTRL_PATH)
+
+
+def test_gl106_unclosed_tracer_span_bad():
+    assert_flags(
+        """
+        def solve(tracer, request):
+            span = tracer.span("solve")
+            return run(request)
+        """, "GL106", SOLVER_PATH)
+
+
+def test_gl106_with_block_good():
+    assert_clean(
+        """
+        from karpenter_tpu import obs
+
+        def provision(pods):
+            with obs.span("provision.cycle", pods=len(pods)) as sp:
+                do_work(pods)
+                sp.set("done", True)
+        """, "GL106", CTRL_PATH)
+
+
+def test_gl106_factory_return_and_record_good():
+    assert_clean(
+        """
+        from karpenter_tpu import obs
+
+        def make_span(name):
+            # handing the context manager to the caller is the factory
+            # pattern obs.span itself uses
+            return obs.span(name)
+
+        def phases(t0, t1):
+            # record() takes explicit start/end: nothing stays open
+            obs.record("solve.h2d", t0, t1)
+        """, "GL106", SOLVER_PATH)
+
+
+def test_gl106_regex_match_span_not_flagged():
+    assert_clean(
+        """
+        import re
+
+        def extent(text):
+            m = re.search(r"x+", text)
+            return m.span() if m else (0, 0)
+        """, "GL106", CTRL_PATH)
+
+
+def test_gl106_enter_context_good():
+    assert_clean(
+        """
+        import contextlib
+        from karpenter_tpu import obs
+
+        def run(stack: contextlib.ExitStack):
+            stack.enter_context(obs.span("outer"))
+        """, "GL106", CTRL_PATH)
+
+
 # -- suppressions -----------------------------------------------------------
 
 def test_per_line_suppression():

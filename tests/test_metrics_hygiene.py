@@ -1,0 +1,199 @@
+"""Metrics exposition hygiene: render() round-trip parsing (including
+the new solve_phase family and build_info), and label-series lifecycle —
+every per-object gauge (CB_STATE, COST_PER_HOUR, LEADER) drops its
+series when the object goes away, so churn never accumulates stale
+label sets.
+"""
+
+from __future__ import annotations
+
+import re
+
+import pytest
+
+from karpenter_tpu.utils import metrics
+
+# Prometheus text exposition grammar (the subset render() emits)
+_SAMPLE_RE = re.compile(
+    r"^(?P<name>[a-zA-Z_:][a-zA-Z0-9_:]*)"
+    r"(?P<labels>\{[^}]*\})?"
+    r" (?P<value>[0-9eE+.\-]+|NaN|[+-]Inf)$")
+_LABEL_RE = re.compile(r'([a-zA-Z_][a-zA-Z0-9_]*)="((?:[^"\\]|\\.)*)"')
+
+
+def parse_exposition(text: str) -> dict:
+    """Parse Prometheus text format -> {family: {"type", "help",
+    "samples": {(name, labels_tuple): value}}}.  Raises on any line that
+    doesn't parse — the round-trip contract."""
+    families: dict = {}
+    current = None
+    for line in text.splitlines():
+        if not line.strip():
+            continue
+        if line.startswith("# HELP "):
+            _, _, rest = line.partition("# HELP ")
+            name, _, help_ = rest.partition(" ")
+            current = families.setdefault(
+                name, {"help": help_, "type": "", "samples": {}})
+        elif line.startswith("# TYPE "):
+            _, _, rest = line.partition("# TYPE ")
+            name, _, kind = rest.partition(" ")
+            families.setdefault(
+                name, {"help": "", "type": "", "samples": {}})["type"] = \
+                kind.strip()
+        else:
+            m = _SAMPLE_RE.match(line)
+            assert m, f"unparseable exposition line: {line!r}"
+            labels = tuple(sorted(_LABEL_RE.findall(m.group("labels") or "")))
+            fam = m.group("name")
+            base = fam
+            for suffix in ("_bucket", "_sum", "_count"):
+                if fam.endswith(suffix) and fam[:-len(suffix)] in families:
+                    base = fam[:-len(suffix)]
+            assert base in families, f"sample before HELP/TYPE: {line!r}"
+            value = float(m.group("value"))
+            families[base]["samples"][(fam, labels)] = value
+    return families
+
+
+class TestRoundTrip:
+    def test_render_parses_completely(self):
+        # make sure the families under test carry samples
+        metrics.SOLVE_PHASE.labels("encode").observe(0.001)
+        metrics.SOLVE_PHASE.labels("compute").observe(0.02)
+        metrics.record_build_info(backend="jax")
+        families = parse_exposition(metrics.render())
+        assert "karpenter_tpu_solve_phase_seconds" in families
+        assert "karpenter_tpu_build_info" in families
+        assert "karpenter_tpu_errors_total" in families
+
+    def test_solve_phase_family_shape(self):
+        metrics.SOLVE_PHASE.reset()
+        metrics.SOLVE_PHASE.labels("h2d").observe(0.004)
+        metrics.SOLVE_PHASE.labels("h2d").observe(0.009)
+        fam = parse_exposition(metrics.render())[
+            "karpenter_tpu_solve_phase_seconds"]
+        assert fam["type"] == "histogram"
+        samples = fam["samples"]
+        count = samples[("karpenter_tpu_solve_phase_seconds_count",
+                         (("phase", "h2d"),))]
+        total = samples[("karpenter_tpu_solve_phase_seconds_sum",
+                         (("phase", "h2d"),))]
+        assert count == 2 and total == pytest.approx(0.013)
+        # buckets are cumulative and end at the count
+        buckets = sorted(
+            ((ls, v) for (n, ls), v in samples.items()
+             if n.endswith("_bucket") and ("phase", "h2d") in ls),
+            key=lambda kv: float(dict(kv[0])["le"])
+            if dict(kv[0])["le"] != "+Inf" else float("inf"))
+        values = [v for _ls, v in buckets]
+        assert values == sorted(values) and values[-1] == count
+
+    def test_build_info_single_row_after_backend_change(self):
+        metrics.record_build_info(backend="jax", platform="cpu")
+        metrics.record_build_info(backend="greedy", platform="cpu")
+        samples = metrics.BUILD_INFO.samples()
+        assert len(samples) == 1
+        (labels,) = samples
+        assert "greedy" in labels
+
+
+class TestSeriesHygiene:
+    def test_cb_state_series_removed_on_cleanup(self):
+        from karpenter_tpu.core.circuitbreaker import (
+            CircuitBreakerConfig, CircuitBreakerManager,
+        )
+
+        clock = [0.0]
+        mgr = CircuitBreakerManager(CircuitBreakerConfig(),
+                                    clock=lambda: clock[0])
+        mgr.get("hyg-nc", "hyg-region")
+        assert ("hyg-nc", "hyg-region") in metrics.CB_STATE.samples()
+        clock[0] += mgr.IDLE_TTL + 1
+        assert mgr.cleanup() == 1
+        assert ("hyg-nc", "hyg-region") not in metrics.CB_STATE.samples()
+
+    def test_leader_series_removed_on_elector_stop(self):
+        from karpenter_tpu.core.cluster import ClusterState
+        from karpenter_tpu.core.leaderelection import LeaderElector
+
+        elector = LeaderElector(ClusterState(), identity="hyg-1",
+                                lease_name="hyg-lease")
+        assert elector.try_acquire_or_renew()
+        assert ("hyg-lease",) in metrics.LEADER.samples()
+        elector.stop()
+        assert ("hyg-lease",) not in metrics.LEADER.samples(), \
+            "LEADER series leaked after elector stop"
+
+    def test_cost_series_removed_with_last_claim(self):
+        from karpenter_tpu.catalog import (
+            InstanceTypeProvider, PricingProvider,
+        )
+        from karpenter_tpu.catalog.arrays import CatalogArrays
+        from karpenter_tpu.cloud.fake import FakeCloud
+        from karpenter_tpu.core.actuator import Actuator
+        from karpenter_tpu.core.cluster import ClusterState
+        from karpenter_tpu.solver.types import PlannedNode
+
+        from tests.test_core import ready_nodeclass
+
+        cloud = FakeCloud()
+        pricing = PricingProvider(cloud)
+        try:
+            catalog = CatalogArrays.build(
+                InstanceTypeProvider(cloud, pricing).list())
+        finally:
+            pricing.close()
+        cluster = ClusterState()
+        nc = ready_nodeclass()
+        cluster.add_nodeclass(nc)
+        actuator = Actuator(cloud, cluster)
+        planned = PlannedNode(
+            instance_type="bx2-4x16", zone="us-south-1",
+            capacity_type="on-demand", price=0.2,
+            offering_index=0, pod_names=())
+        claim = actuator.create_node(planned, nc, catalog)
+        key = ("bx2-4x16", "us-south-1", "on-demand")
+        assert key in metrics.COST_PER_HOUR.samples()
+        from karpenter_tpu.cloud.errors import NodeClaimNotFoundError
+
+        with pytest.raises(NodeClaimNotFoundError):
+            actuator.delete_node(claim)
+        assert key not in metrics.COST_PER_HOUR.samples(), \
+            "COST_PER_HOUR series leaked after the last claim was deleted"
+
+    def test_cost_series_kept_while_sibling_claim_lives(self):
+        from karpenter_tpu.catalog import (
+            InstanceTypeProvider, PricingProvider,
+        )
+        from karpenter_tpu.catalog.arrays import CatalogArrays
+        from karpenter_tpu.cloud.errors import NodeClaimNotFoundError
+        from karpenter_tpu.cloud.fake import FakeCloud
+        from karpenter_tpu.core.actuator import Actuator
+        from karpenter_tpu.core.cluster import ClusterState
+        from karpenter_tpu.solver.types import PlannedNode
+
+        from tests.test_core import ready_nodeclass
+
+        cloud = FakeCloud()
+        pricing = PricingProvider(cloud)
+        try:
+            catalog = CatalogArrays.build(
+                InstanceTypeProvider(cloud, pricing).list())
+        finally:
+            pricing.close()
+        cluster = ClusterState()
+        nc = ready_nodeclass()
+        cluster.add_nodeclass(nc)
+        actuator = Actuator(cloud, cluster)
+        planned = PlannedNode(
+            instance_type="bx2-4x16", zone="us-south-1",
+            capacity_type="on-demand", price=0.2,
+            offering_index=0, pod_names=())
+        c1 = actuator.create_node(planned, nc, catalog)
+        actuator.create_node(planned, nc, catalog)
+        key = ("bx2-4x16", "us-south-1", "on-demand")
+        with pytest.raises(NodeClaimNotFoundError):
+            actuator.delete_node(c1)
+        assert key in metrics.COST_PER_HOUR.samples(), \
+            "series dropped while a live claim still has that shape"

@@ -32,6 +32,7 @@ DEFAULT_TARGETS = (
     "karpenter_tpu/core",
     "karpenter_tpu/cloud",
     "karpenter_tpu/operator",
+    "karpenter_tpu/obs",
     "karpenter_tpu/catalog",
     "karpenter_tpu/utils",
     "karpenter_tpu/service.py",

@@ -9,7 +9,7 @@ from __future__ import annotations
 
 
 from tools.graftlint.engine import Rule
-from tools.graftlint.rules import concurrency, jax_purity
+from tools.graftlint.rules import concurrency, jax_purity, observability
 
 
 def all_rules() -> list[type[Rule]]:
@@ -27,4 +27,5 @@ def all_rules() -> list[type[Rule]]:
         concurrency.UnlockedSharedMutation,   # GL103
         concurrency.NonDaemonThread,          # GL104
         concurrency.SilentExceptionSwallow,   # GL105
+        observability.UnclosedSpan,           # GL106
     ]

@@ -27,6 +27,7 @@ FAMILY_B_SCOPE = (
     "karpenter_tpu/cloud/**/*",
     "karpenter_tpu/operator/*",
     "karpenter_tpu/operator/**/*",
+    "karpenter_tpu/obs/*",
     "karpenter_tpu/catalog/*",
     "karpenter_tpu/utils/*",
     "karpenter_tpu/service.py",

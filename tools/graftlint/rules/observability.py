@@ -1,0 +1,92 @@
+"""Family B addition — observability hygiene (GL106).
+
+A span opened but not closed through a ``with`` block leaks on the
+exception path: the trace never finalizes (its slot sits in the
+recorder's open-trace table until evicted) and every child span that
+follows mis-parents.  The ``karpenter_tpu.obs`` contract is therefore
+context-manager-or-bust: ``with obs.span(...)`` / ``with
+tracer.span(...)``, or the retroactive ``obs.record(start, end)`` which
+never holds an open span at all.
+"""
+
+from __future__ import annotations
+
+import ast
+from collections.abc import Iterator
+
+from tools.graftlint.engine import Finding, Rule, SourceModule
+from tools.graftlint.rules.jaxctx import attr_chain
+
+# receivers whose ``.span(...)`` is a tracer span (re.Match.span() and
+# other unrelated ``.span()`` methods must not trip the rule)
+_TRACER_RECEIVERS = {"obs", "tracer", "tracing", "_tracer"}
+_ALWAYS_SPAN_TERMINALS = {"start_span", "start_timer"}
+
+
+class UnclosedSpan(Rule):
+    id = "GL106"
+    name = "span-not-context-managed"
+    description = (
+        "obs.span()/tracer.span() (or a start_span/start_timer call) used "
+        "outside a `with` block. An exception between open and close "
+        "leaks the span: the trace never finalizes and later spans "
+        "mis-parent. Use `with obs.span(...) as sp:` — or obs.record() "
+        "with explicit start/end timestamps, which never holds an open "
+        "span. Returning/yielding the span (a factory handing the "
+        "context manager to its caller) is exempt."
+    )
+    family = "B"
+    scope = ("karpenter_tpu/*", "karpenter_tpu/**/*", "bench.py")
+
+    def check(self, module: SourceModule) -> Iterator[Finding]:
+        allowed = self._allowed_call_ids(module.tree)
+        for node in ast.walk(module.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            if id(node) in allowed or not self._is_span_open(node):
+                continue
+            yield self.finding(
+                module, node,
+                "span opened without a `with` block — the exception path "
+                "leaks an open span (trace never finalizes); use "
+                "`with ...span(...):` or obs.record(start, end)")
+
+    @staticmethod
+    def _is_span_open(call: ast.Call) -> bool:
+        chain = attr_chain(call.func)
+        if not chain:
+            return False
+        terminal = chain[-1]
+        if terminal in _ALWAYS_SPAN_TERMINALS:
+            return True
+        if terminal != "span":
+            return False
+        if len(chain) == 1:
+            return True           # bare `span(...)` (from ... import span)
+        return chain[-2].lstrip("_") in {r.lstrip("_")
+                                         for r in _TRACER_RECEIVERS}
+
+    @staticmethod
+    def _allowed_call_ids(tree: ast.AST) -> set:
+        """Call nodes that legitimately hold/forward the context manager:
+        with-items, return/yield values (factory functions), and
+        ExitStack.enter_context arguments."""
+        allowed: set = set()
+        for node in ast.walk(tree):
+            if isinstance(node, (ast.With, ast.AsyncWith)):
+                for item in node.items:
+                    if isinstance(item.context_expr, ast.Call):
+                        allowed.add(id(item.context_expr))
+            elif isinstance(node, ast.Return) and \
+                    isinstance(node.value, ast.Call):
+                allowed.add(id(node.value))
+            elif isinstance(node, (ast.Yield, ast.YieldFrom)) and \
+                    isinstance(node.value, ast.Call):
+                allowed.add(id(node.value))
+            elif isinstance(node, ast.Call):
+                chain = attr_chain(node.func)
+                if chain[-1:] == ["enter_context"]:
+                    for arg in node.args:
+                        if isinstance(arg, ast.Call):
+                            allowed.add(id(arg))
+        return allowed

@@ -1,0 +1,139 @@
+"""CI smoke for the HTTP debug surface (docs/design/observability.md).
+
+Starts a REAL operator (fake cloud, greedy solver) with the metrics
+server enabled, drives one provisioning wave so the flight recorder has
+traces, then hits ``/metrics``, ``/statusz``, and ``/debug/traces``
+over actual HTTP and fails on:
+
+- any non-200 status,
+- ``/metrics`` missing the Prometheus content type
+  (``text/plain; version=0.0.4; charset=utf-8``), the ``build_info``
+  identity gauge, or the ``solve_phase`` family,
+- ``/statusz`` or ``/debug/traces`` payloads that don't parse as JSON
+  or are missing their contract keys.
+
+Run locally: ``JAX_PLATFORMS=cpu python tools/smoke_debug_surface.py``.
+Exit codes: 0 ok, 1 any check failed.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+import urllib.request
+
+# runnable as `python tools/smoke_debug_surface.py` from the repo root
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.setdefault("TPU_CLOUD_REGION", "us-south")
+os.environ.setdefault("TPU_CLOUD_API_KEY", "simulated")
+os.environ.setdefault("KARPENTER_SOLVER_BACKEND", "greedy")
+os.environ.setdefault("KARPENTER_METRICS_PORT", "0")  # ephemeral bind
+os.environ.setdefault("KARPENTER_WINDOW_IDLE_SECONDS", "0.1")
+os.environ.setdefault("KARPENTER_WINDOW_MAX_SECONDS", "1.0")
+
+
+def _get(port: int, path: str) -> tuple[int, str, bytes]:
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}{path}", timeout=10) as resp:
+        return (resp.status, resp.headers.get("Content-Type", ""),
+                resp.read())
+
+
+def main() -> int:
+    from karpenter_tpu.apis.nodeclass import (
+        InstanceRequirements, NodeClass, NodeClassSpec, PlacementStrategy,
+    )
+    from karpenter_tpu.apis.pod import ResourceRequests, make_pods
+    from karpenter_tpu.operator import Operator, Options
+    from karpenter_tpu.operator.server import MetricsServer
+
+    failures: list[str] = []
+
+    def check(cond: bool, what: str) -> None:
+        print(f"  {'ok  ' if cond else 'FAIL'} {what}")
+        if not cond:
+            failures.append(what)
+
+    op = Operator(Options.from_env())
+    nc = NodeClass(name="default", spec=NodeClassSpec(
+        region=op.options.region, image="img-1", vpc="vpc-1",
+        instance_requirements=InstanceRequirements(min_cpu=2),
+        placement_strategy=PlacementStrategy()))
+    op.cluster.add_nodeclass(nc)
+    try:
+        op.start()
+        # Options.from_env() port 0 leaves the server off; bind our own
+        # ephemeral one exactly the way the operator would
+        if op.metrics_server is None:
+            op.metrics_server = MetricsServer(
+                port=0, ready_check=lambda: True,
+                statusz=op.statusz).start()
+        port = op.metrics_server.port
+        print(f"operator up, metrics server on :{port}")
+
+        for pod in make_pods(10, name_prefix="smoke",
+                             requests=ResourceRequests(500, 1024, 0, 1)):
+            op.cluster.add_pod(pod)
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            if all(p.nominated_node for p in op.cluster.pending_pods()):
+                break
+            time.sleep(0.1)
+        check(all(p.nominated_node for p in op.cluster.pending_pods()),
+              "provisioning wave resolved (traces recorded)")
+
+        print("GET /metrics")
+        status, ctype, body = _get(port, "/metrics")
+        check(status == 200, f"/metrics status 200 (got {status})")
+        check(ctype == "text/plain; version=0.0.4; charset=utf-8",
+              f"/metrics content type (got {ctype!r})")
+        text = body.decode()
+        check("karpenter_tpu_build_info{" in text,
+              "build_info identity gauge rendered")
+        check("karpenter_tpu_solve_phase_seconds" in text
+              or "greedy" == op.options.solver.backend,
+              "solve_phase family present (jax backend only)")
+
+        print("GET /statusz")
+        status, ctype, body = _get(port, "/statusz")
+        check(status == 200, f"/statusz status 200 (got {status})")
+        try:
+            doc = json.loads(body)
+        except ValueError as e:
+            doc = {}
+            check(False, f"/statusz parses as JSON ({e})")
+        for key in ("uptime_s", "version", "backend", "leader",
+                    "recorder", "circuit_breakers"):
+            check(key in doc, f"/statusz has {key!r}")
+
+        print("GET /debug/traces")
+        status, ctype, body = _get(
+            port, "/debug/traces?limit=10&min_ms=0")
+        check(status == 200, f"/debug/traces status 200 (got {status})")
+        try:
+            doc = json.loads(body)
+        except ValueError as e:
+            doc = {}
+            check(False, f"/debug/traces parses as JSON ({e})")
+        check(bool(doc.get("traces")), "/debug/traces has traces")
+        check("recorder" in doc, "/debug/traces has recorder stats")
+        roots = {t["root"] for t in doc.get("traces", ())}
+        check(any(r.startswith("batch.window") or r == "provision.cycle"
+                  for r in roots),
+              f"a provisioning trace is retained (roots={sorted(roots)})")
+    finally:
+        op.stop()
+
+    if failures:
+        print(f"debug-surface smoke: {len(failures)} check(s) FAILED")
+        return 1
+    print("debug-surface smoke: all checks passed")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
