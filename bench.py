@@ -815,11 +815,19 @@ def run_repack(num_claims: int = 2000, num_types: int = 200,
         cost1 = sum(c.hourly_price for c in cluster.nodeclaims()
                     if not c.deleted)
         live = [c for c in cluster.nodeclaims() if not c.deleted]
-        tick_p50 = p50(tick_walls) * 1000
-        tick_max = max(tick_walls) * 1000
+        # the FIRST tick executes the actual blue/green transition
+        # (phase-1 create burst) on a cold path — reporting it inside
+        # the steady-state max conflated one-off transition cost with
+        # the recurring tick budget (BENCH_r05: max 531 ms vs p50 47 ms).
+        # Cold is reported on its own; p50/max cover warm ticks only.
+        tick_cold = tick_walls[0] * 1000
+        warm_walls = tick_walls[1:] if len(tick_walls) > 1 else tick_walls
+        tick_p50 = p50(warm_walls) * 1000
+        tick_max = max(warm_walls) * 1000
         return {
             "repack_claims": num_claims,
             "repack_pods": pod_i,
+            "repack_tick_cold_ms": round(tick_cold, 3),
             "repack_tick_p50_ms": round(tick_p50, 3),
             "repack_tick_max_ms": round(tick_max, 3),
             "repack_headroom_x": round(10000.0 / max(tick_max, 1e-9), 1),
@@ -829,6 +837,136 @@ def run_repack(num_claims: int = 2000, num_types: int = 200,
         }
     finally:
         pricing.close()
+
+
+def run_preempt(num_pending: int = 10000, num_types: int = 500,
+                num_claims: int = 2000, iters: int = 10,
+                seed: int = 31) -> dict:
+    """Overload scenario (ISSUE 4 acceptance): pending demand ~2x what
+    the cluster can host, mixed priorities, every node already full —
+    placement can only happen by evicting lower-priority pods.  Measures
+    the batched preemption plan (cold = first call incl. jit trace; warm
+    = steady state) against two baselines:
+
+    - the greedy HOST loop (``preempt/greedy.py``) on the same inputs —
+      plans are parity-identical by construction, so this is a pure
+      speed comparison of the vectorized grid vs python loops;
+    - the PRIORITY-BLIND path (what the system did before the preempt
+      plane: FIFO slack-fill, no evictions) at the same eviction
+      budget — quality compared as priority-weighted placed demand.
+    """
+    from karpenter_tpu.apis.nodeclaim import NodeClaim
+    from karpenter_tpu.apis.pod import PodSpec, ResourceRequests
+    from karpenter_tpu.core.cluster import ClusterState
+    from karpenter_tpu.preempt import (
+        GreedyPreemptionPlanner, PlannerOptions, PreemptionPlanner,
+        encode_victims, group_node_compat,
+    )
+    from karpenter_tpu.solver.encode import encode
+    from karpenter_tpu.solver.validate import validate_preemption_plan
+
+    catalog = build_catalog(num_types)
+    rng = np.random.RandomState(seed)
+    alloc = catalog.type_alloc
+    # hostable types only (>= 2 cpus): pending size classes below must
+    # fit a single node
+    hostable = [t for t in range(catalog.num_types)
+                if alloc[t, 0] >= 2000 and alloc[t, 1] >= 4096]
+    zones = catalog.zones
+
+    cluster = ClusterState()
+    for i in range(num_claims):
+        t = hostable[rng.randint(len(hostable))]
+        claim = NodeClaim(
+            name=f"pc{i}", nodeclass_name="default",
+            instance_type=catalog.type_names[t],
+            zone=zones[rng.randint(len(zones))],
+            node_name=f"node-pc{i}", launched=True)
+        cluster.add_nodeclaim(claim)
+        # fill ~96% of the node with 3 victims, priorities skewed low —
+        # freed capacity exists, but (on most nodes) only via eviction
+        for j in range(3):
+            cpu = int(alloc[t, 0] * 0.32)
+            mem = int(alloc[t, 1] * 0.32)
+            prio = int(rng.choice([0, 0, 0, 100]))
+            name = f"v{i}-{j}"
+            cluster.add_pod(PodSpec(
+                name, requests=ResourceRequests(cpu, mem, 0, 1),
+                priority=prio))
+            cluster.bind_pod(f"default/{name}", claim.node_name)
+
+    sizes = [(500, 1024), (1000, 2048), (2000, 4096)]
+    prios = [0, 0, 100, 100, 100, 1000]
+    pending = []
+    for k in range(num_pending):
+        cpu, mem = sizes[rng.randint(len(sizes))]
+        pending.append(PodSpec(
+            f"p{k}", requests=ResourceRequests(cpu, mem, 0, 1),
+            priority=prios[rng.randint(len(prios))]))
+
+    budget = num_claims * 3          # same cap for every compared path
+    opts = PlannerOptions(max_evictions=budget)
+    prob = encode(pending, catalog)
+    t0 = time.perf_counter()
+    victims = encode_victims(cluster, catalog)
+    encode_victims_ms = (time.perf_counter() - t0) * 1000
+    compat = group_node_compat(prob, victims)
+
+    planner = PreemptionPlanner(opts)
+    t0 = time.perf_counter()
+    plan = planner.plan(prob, victims, compat)
+    cold_ms = (time.perf_counter() - t0) * 1000
+    walls = []
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        planner.plan(prob, victims, compat)
+        walls.append(time.perf_counter() - t0)
+    warm_p50 = p50(walls) * 1000
+    warm_max = max(walls) * 1000
+
+    t0 = time.perf_counter()
+    gplan = GreedyPreemptionPlanner(opts).plan(prob, victims, compat)
+    greedy_host_ms = (time.perf_counter() - t0) * 1000
+    parity = (plan.placements == gplan.placements
+              and [(e.claim_name, e.pod_key) for e in plan.evictions]
+              == [(e.claim_name, e.pod_key) for e in gplan.evictions])
+
+    # priority-blind baseline: the pre-preemption system at the SAME
+    # eviction budget — it cannot rank victims (no priority signal), so
+    # the budget goes unspent and placement is FIFO slack-fill.  Quality
+    # is scored with the TRUE priorities either way.
+    blind_pods = [PodSpec(p.name, requests=p.requests) for p in pending]
+    blind_plan = GreedyPreemptionPlanner(opts).plan(
+        encode(blind_pods, catalog), victims)
+    prio_of = {f"default/{p.name}": p.priority for p in pending}
+
+    def weighted(placements):
+        return sum(prio_of[pn] + 1 for pn in placements)
+
+    w_plan, w_blind = weighted(plan.placements), weighted(
+        blind_plan.placements)
+    errors = validate_preemption_plan(plan, pending, cluster, catalog)
+    return {
+        "preempt_pending": num_pending,
+        "preempt_claims": victims.num_nodes,
+        "preempt_candidates": plan.candidate_count,
+        "preempt_encode_victims_ms": round(encode_victims_ms, 3),
+        "preempt_plan_cold_ms": round(cold_ms, 3),
+        "preempt_plan_warm_p50_ms": round(warm_p50, 3),
+        "preempt_plan_warm_max_ms": round(warm_max, 3),
+        "preempt_greedy_host_ms": round(greedy_host_ms, 3),
+        "preempt_vs_greedy_host": round(
+            greedy_host_ms / max(warm_p50, 1e-9), 2),
+        "preempt_evictions": plan.eviction_count,
+        "preempt_placed": plan.placed_count,
+        "preempt_unplaced": len(plan.unplaced),
+        "preempt_parity_with_host": parity,
+        "preempt_weighted_placed": w_plan,
+        "preempt_blind_weighted_placed": w_blind,
+        "preempt_weighted_gain_x": round(w_plan / max(w_blind, 1), 2),
+        "preempt_plan_valid": not errors,
+        "preempt_validate_errors": errors[:2],
+    }
 
 
 _COLD_SCRIPT = r'''
@@ -1053,6 +1191,16 @@ def main():
             ticks=4 if args.quick else 8))
     except Exception as e:  # noqa: BLE001
         result["repack_error"] = str(e)[:200]
+    try:
+        # ISSUE 4 overload scenario: priority-aware preemption planning
+        # at headline scale (pending demand ~2x feasible capacity)
+        result.update(run_preempt(
+            num_pending=1000 if args.quick else 10000,
+            num_types=100 if args.quick else 500,
+            num_claims=200 if args.quick else 2000,
+            iters=4 if args.quick else 10))
+    except Exception as e:  # noqa: BLE001
+        result["preempt_error"] = str(e)[:200]
 
 
     # BASELINE.md targets, asserted explicitly: a regression to target
@@ -1086,6 +1234,20 @@ def main():
         "first_solve_overhead_under_50ms":
             (result["first_solve_overhead_ms"] < 50.0)
             if "first_solve_overhead_ms" in result else None,
+        # ISSUE 4 acceptance: the batched preemption plan clears 50 ms
+        # warm at 10k x 500 x 2k scale, its plan is bit-identical to the
+        # greedy host oracle, and it places strictly more
+        # priority-weighted demand than the priority-blind path at the
+        # same eviction budget
+        "preempt_plan_under_50ms_warm":
+            (result["preempt_plan_warm_p50_ms"] < 50.0
+             and result.get("preempt_plan_valid") is True
+             and result.get("preempt_parity_with_host") is True)
+            if "preempt_plan_warm_p50_ms" in result else None,
+        "preempt_beats_blind_weighted":
+            (result["preempt_weighted_placed"]
+             > result.get("preempt_blind_weighted_placed", 0))
+            if "preempt_weighted_placed" in result else None,
         # the un-pipelined repack-tick comparison at the chip boundary:
         # one fleet solve's device time vs the grouped host loop (the
         # tunnel wall floor, rtt_floor_ms ~ 68 ms, exceeds the host's

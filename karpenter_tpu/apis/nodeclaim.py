@@ -85,6 +85,11 @@ class NodePool:
     memory_limit_mib: int = 0
     consolidation_policy: str = "WhenEmptyOrUnderutilized"
     consolidate_after_seconds: float = 30.0
+    # priority-preemption disruption budget: max pod evictions the
+    # PreemptionController may execute against this pool's nodes per
+    # reconcile round (karpenter's spec.disruption.budgets analogue).
+    # 0 disables preemption for the pool; -1 = unbounded.
+    preemption_budget: int = 16
     resource_version: int = 0
 
 

@@ -43,6 +43,30 @@ def parse_cpu_milli(q) -> int:
     raise ValueError(f"bad cpu suffix {q!r}")
 
 
+# k8s PriorityClass bounds: the API type is int32, and user-defined
+# classes are capped at 1e9 (values above are reserved for
+# system-critical classes) — out-of-range ints CLAMP (a cluster admin's
+# oversized class must not reject the pod), non-ints REJECT (a typo'd
+# priorityClassName resolution bug must not silently become priority 0).
+PRIORITY_MIN = -(2 ** 31)
+PRIORITY_MAX = 10 ** 9
+
+
+def parse_priority(q) -> int:
+    """Strict priorityClassName-style value: int (or None) -> clamped int.
+
+    None -> 0 (no priority class).  bools, floats, and strings are
+    rejected — priority feeds straight into the solver's int32
+    ``group_prio`` tensor and the preemption planner's no-inversion
+    guarantee, so a lenient parse here would let a malformed manifest
+    silently outrank (or be outranked by) every correct pod."""
+    if q is None:
+        return 0
+    if isinstance(q, bool) or not isinstance(q, int):
+        raise ValueError(f"bad priority {q!r}: must be an int")
+    return max(PRIORITY_MIN, min(PRIORITY_MAX, q))
+
+
 def parse_memory_mib(q) -> int:
     """'4Gi' -> 4096; '512Mi' -> 512; bytes int -> MiB.
 
@@ -187,6 +211,14 @@ class PodSpec:
     topology_spread: tuple[TopologySpreadConstraint, ...] = ()
     affinity: tuple[PodAffinityTerm, ...] = ()
     labels: tuple[tuple[str, str], ...] = ()
+    # priorityClassName-style int (parse_priority semantics) — the
+    # preemption plane's ordering key.  Validated at construction: every
+    # PodSpec in the system carries an in-bounds int, so the solver's
+    # group_prio tensor and the no-inversion checks never re-validate.
+    priority: int = 0
+
+    def __post_init__(self):
+        object.__setattr__(self, "priority", parse_priority(self.priority))
 
     def scheduling_requirements(self) -> Requirements:
         reqs = Requirements.from_selector(dict(self.node_selector))
@@ -230,6 +262,9 @@ class PodSpec:
         # encode at 10k pods (~110 ms; first-restart-window budget)
         return (
             self.requests.as_tuple(),
+            # priority splits groups: pods of different priorities are NOT
+            # interchangeable once the preemption plane ranks them
+            self.priority,
             tuple(sorted(self.labels)) if self.labels else (),
             tuple(sorted(self.node_selector)) if self.node_selector else (),
             tuple(sorted(r.signature for r in self.required_requirements))

@@ -21,6 +21,16 @@ Final invariants (eventual, checked after the quiesce phase):
   its TTL elapsed on the virtual clock;
 - ``pods-resolve``: every pending pod is bound, or provably unplaceable
   (its requests fit no offering in the catalog).
+
+Preemption-plane invariants (armed when the harness runs a
+PreemptionController):
+
+- ``no-priority-inversion`` (round): no executed eviction's victim had
+  priority >= its beneficiary's — checked against the controller's
+  ground-truth eviction log, drained per round;
+- ``preempted-pods-resolve`` (final): every pod the preemption plane
+  ever evicted is bound again after quiesce (or provably unplaceable) —
+  eviction may delay a low-priority pod, never strand it.
 """
 
 from __future__ import annotations
@@ -46,7 +56,7 @@ class InvariantChecker:
     def __init__(self, cluster, cloud, unavailable, *,
                  orphan_grace: float, stuck_claim_grace: float,
                  solver_violations: list[str] | None = None,
-                 trace: EventTrace | None = None):
+                 trace: EventTrace | None = None, preemption=None):
         self.cluster = cluster
         self.cloud = cloud              # ground truth: the UNWRAPPED fake
         self.unavailable = unavailable
@@ -56,6 +66,9 @@ class InvariantChecker:
         self.solver_violations = solver_violations \
             if solver_violations is not None else []
         self.trace = trace
+        # the harness's PreemptionController (or None): its eviction_log
+        # / preempted_keys are the preemption invariants' ground truth
+        self.preemption = preemption
 
     # -- round invariants ----------------------------------------------------
 
@@ -64,6 +77,7 @@ class InvariantChecker:
         out.extend(self._no_stale_orphans())
         out.extend(self._no_stuck_claims())
         out.extend(self._solver_plans_valid())
+        out.extend(self._no_priority_inversion())
         if self.trace is not None:
             self.trace.add("invariants", phase="round", violations=len(out),
                            kinds=sorted({v.invariant for v in out}))
@@ -116,6 +130,23 @@ class InvariantChecker:
         self.solver_violations.clear()
         return out
 
+    def _no_priority_inversion(self) -> list[Violation]:
+        """Every executed eviction must have served a STRICTLY higher
+        priority beneficiary — drained from the controller's log so a
+        violation names the exact victim."""
+        if self.preemption is None:
+            return []
+        out = []
+        for rec in self.preemption.eviction_log:
+            if rec.victim_priority >= rec.beneficiary_priority:
+                out.append(Violation(
+                    "no-priority-inversion",
+                    f"pod {rec.pod_key} (priority {rec.victim_priority}) "
+                    f"evicted from {rec.claim_name} for priority "
+                    f"{rec.beneficiary_priority} pod {rec.beneficiary}"))
+        self.preemption.eviction_log.clear()
+        return out
+
     # -- final (eventual) invariants -----------------------------------------
 
     def check_final(self, catalog=None) -> list[Violation]:
@@ -127,9 +158,31 @@ class InvariantChecker:
                 f"{len(stale)} offering blackouts survived the quiesce "
                 f"window: {sorted(stale)[:3]}"))
         out.extend(self._pods_resolve(catalog))
+        out.extend(self._preempted_pods_resolve(catalog))
         if self.trace is not None:
             self.trace.add("invariants", phase="final", violations=len(out),
                            kinds=sorted({v.invariant for v in out}))
+        return out
+
+    def _preempted_pods_resolve(self, catalog) -> list[Violation]:
+        """A preemption may DELAY a low-priority pod; it must never
+        strand one.  After quiesce every ever-evicted pod is bound again
+        (anywhere) or provably unplaceable."""
+        if self.preemption is None:
+            return []
+        out = []
+        for key in sorted(self.preemption.preempted_keys):
+            pending = self.cluster.get("pods", key)
+            if pending is None or pending.bound_node:
+                continue
+            if catalog is not None and \
+                    not self._placeable(pending.spec, catalog):
+                continue
+            out.append(Violation(
+                "preempted-pods-resolve",
+                f"pod {key} evicted by preemption and still unbound "
+                f"after quiesce (nominated="
+                f"{pending.nominated_node or '-'})"))
         return out
 
     def _pods_resolve(self, catalog) -> list[Violation]:

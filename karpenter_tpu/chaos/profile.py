@@ -57,6 +57,15 @@ class ChaosProfile:
     # workload shaping
     pod_waves: int = 4                   # rounds that add a pod wave
     pods_per_wave: tuple[int, int] = (8, 32)
+    # mixed-priority backlog: when non-empty, each wave draws its pods'
+    # priority from this menu (seeded world stream) — the preemption
+    # plane's workload shape (overload profile)
+    pod_priorities: tuple[int, ...] = ()
+    # global live-instance cap imposed on the fake cloud for the chaos
+    # window (0 = unlimited); lifts at quiesce.  Demand past the cap is
+    # genuine overload: creates fail with quota_exceeded and pending
+    # pods can only move via preemption onto existing capacity.
+    instance_quota: int = 0
     # harness controllers skipped by name (fixture profiles use this to
     # deliberately break an invariant)
     disable_controllers: tuple[str, ...] = ()
@@ -126,6 +135,20 @@ PROFILES: dict[str, ChaosProfile] = _profiles(
                     "degraded greedy fallback must complete the cycle",
         solver_failure_rate=0.40,
         error_rates={"*": 0.04}),
+    ChaosProfile(
+        name="overload",
+        description="instance quota far below demand + capacity "
+                    "blackouts + spot storms under a mixed-priority "
+                    "backlog — the preemption plane must move "
+                    "high-priority pods onto existing capacity with "
+                    "zero priority inversion, and every preempted pod "
+                    "must re-resolve once the quota lifts",
+        instance_quota=10,
+        pod_priorities=(0, 0, 0, 100, 100, 1000),
+        pod_waves=6, pods_per_wave=(10, 30),
+        capacity_blackout_rate=0.40, capacity_blackout_rounds=3,
+        preempt_storm_rate=0.30, preempt_storm_frac=0.40,
+        error_rates={"create_instance": 0.10}),
 )
 
 # Fixture profiles: deliberately broken worlds the test suite uses to

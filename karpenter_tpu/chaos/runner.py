@@ -43,6 +43,7 @@ from karpenter_tpu.controllers.nodeclaim import (
     GarbageCollectionController, NodeClaimTerminationController,
     RegistrationController, StartupTaintController, TaggingController,
 )
+from karpenter_tpu.controllers.preemption import PreemptionController
 from karpenter_tpu.controllers.runtime import ControllerManager
 from karpenter_tpu.core.actuator import Actuator
 from karpenter_tpu.core.circuitbreaker import CircuitBreakerConfig, CircuitBreakerManager
@@ -155,6 +156,16 @@ class ChaosHarness:
             self.cluster, self.catalog_provider, self.actuator,
             ProvisionerOptions(solver=opts))
         self.provisioner.solver = self.solver
+        # genuine overload: a live-instance quota far below demand makes
+        # creates fail until quiesce lifts it — pending pods can only
+        # move via the preemption plane meanwhile
+        self._default_quota = self.fake.instance_quota
+        if profile.instance_quota:
+            self.fake.instance_quota = profile.instance_quota
+        # min_pending_age=0: the pump provisions before every sync, so a
+        # still-unnominated pod HAS had its create chance this round
+        self.preemption = PreemptionController(
+            self.cluster, self.provisioner, min_pending_age=0.0)
         self.kubelet = FakeKubelet(self.cluster, self.fake)
         self.manager = ControllerManager(self.cluster)
         for ctrl in self._controllers():
@@ -169,7 +180,9 @@ class ChaosHarness:
             orphan_grace=gc_grace + 3 * self.step + 30.0,
             stuck_claim_grace=(reg_timeout
                                + 2 * max(self.step, self.quiesce_step) + 60.0),
-            solver_violations=self.solver.violations, trace=self.trace)
+            solver_violations=self.solver.violations, trace=self.trace,
+            preemption=self.preemption
+            if "preemption" not in profile.disable_controllers else None)
         # warm the catalog before chaos arms (pricing resolution happens
         # here, outside the deterministic traced window)
         self.catalog_provider.list(nc)
@@ -187,6 +200,7 @@ class ChaosHarness:
                                    cloud=self.chaos_cloud),
             OrphanCleanupController(self.cluster, self.chaos_cloud,
                                     enabled=True),
+            self.preemption,
         ]
 
     # -- round loop ----------------------------------------------------------
@@ -218,6 +232,7 @@ class ChaosHarness:
                 # recovery mechanisms finish the job
                 self.chaos_cloud.disarm()
                 self.unstable.failure_rate = 0.0
+                self.fake.instance_quota = self._default_quota
                 for q in range(self.quiesce_rounds):
                     self.clock.advance(self.quiesce_step)
                     self.trace.add("round", n=self.rounds + q, t=self._vt(),
@@ -243,13 +258,18 @@ class ChaosHarness:
         lo, hi = self.profile.pods_per_wave
         n = self.rng_world.randint(lo, hi)
         cpu, mem = _POD_SIZES[self.rng_world.randrange(len(_POD_SIZES))]
+        menu = self.profile.pod_priorities
+        prio = menu[self.rng_world.randrange(len(menu))] if menu else 0
         for pod in make_pods(n, name_prefix=f"wave{round_no}",
-                             requests=ResourceRequests(cpu, mem, 0, 1)):
+                             requests=ResourceRequests(cpu, mem, 0, 1),
+                             priority=prio):
             self.cluster.add_pod(pod)
         # the pod-event end of the causal chain (chaos drives
         # provision_once directly, so there is no watch feed to stamp it)
-        obs.instant("pod.event", wave=round_no, pods=n, cpu=cpu, mem=mem)
-        self.trace.add("workload", wave=round_no, pods=n, cpu=cpu, mem=mem)
+        obs.instant("pod.event", wave=round_no, pods=n, cpu=cpu, mem=mem,
+                    priority=prio)
+        self.trace.add("workload", wave=round_no, pods=n, cpu=cpu, mem=mem,
+                       priority=prio)
 
     def _pump(self) -> None:
         """One provisioning + continuation + reconcile beat."""
@@ -265,7 +285,8 @@ class ChaosHarness:
             bound=sum(1 for p in pods if p.bound_node),
             claims=sum(1 for c in self.cluster.nodeclaims() if not c.deleted),
             instances=self.fake.instance_count(),
-            blackouts=len(self.unavailable.unavailable_keys()))
+            blackouts=len(self.unavailable.unavailable_keys()),
+            preempted=len(self.preemption.preempted_keys))
 
 
 def run_scenario(profile: ChaosProfile | str, seed: int, *,

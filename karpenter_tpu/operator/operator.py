@@ -186,6 +186,16 @@ class Operator:
             repack_enabled=self.options.repack_enabled,
             repack_min_savings_fraction=(
                 self.options.repack_min_savings_percent / 100.0)))
+        # priority-aware preemption: stranded high-priority pods take
+        # capacity from lower-priority pods on existing nodes when no
+        # offering is creatable (docs/design/preemption.md)
+        if self.options.preemption_enabled:
+            from karpenter_tpu.controllers.preemption import (
+                PreemptionController,
+            )
+
+            ctrls.append(PreemptionController(
+                self.cluster, self.provisioner))
         # env-gated (controllers.go:238)
         ctrls.append(OrphanCleanupController(
             self.cluster, self.cloud,

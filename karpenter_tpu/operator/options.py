@@ -54,6 +54,10 @@ class Options:
 
     # behavior toggles
     interruption_enabled: bool = True
+    # a pod-EVICTING plane ships opt-in, like repack and orphan cleanup:
+    # upgrading clusters whose priorities were decorative must not start
+    # losing low-priority pods without an operator decision
+    preemption_enabled: bool = False       # KARPENTER_ENABLE_PREEMPTION
     orphan_cleanup_enabled: bool = False   # KARPENTER_ENABLE_ORPHAN_CLEANUP
     repack_enabled: bool = False           # KARPENTER_ENABLE_REPACK
     repack_min_savings_percent: int = 15   # apply repack only above this
@@ -97,6 +101,8 @@ class Options:
             iks_cluster_id=env.get("IKS_CLUSTER_ID", ""),
             interruption_enabled=_getb(env, "KARPENTER_ENABLE_INTERRUPTION",
                                        True),
+            preemption_enabled=_getb(env, "KARPENTER_ENABLE_PREEMPTION",
+                                     False),
             metrics_port=_geti(env, "KARPENTER_METRICS_PORT", 0),
             webhook_port=_geti(env, "KARPENTER_WEBHOOK_PORT", 0),
             webhook_tls_cert=env.get("KARPENTER_WEBHOOK_TLS_CERT", ""),

@@ -1,0 +1,22 @@
+"""karpenter_tpu.preempt — priority-aware preemption planning.
+
+When the placement solve leaves high-priority groups unplaced (capacity
+blackouts, quota exhaustion, spot storms), this subsystem computes a
+minimal-cost eviction set over currently-placed lower-priority pods
+whose freed capacity hosts the pending high-priority groups — one
+batched candidate grid per round, with a pure-python greedy parity path
+and a ResilientSolver-style degraded fallback.  Execution (budgets,
+re-pending evicted pods, events/metrics) lives in
+``controllers/preemption.py``; invariants in ``solver/validate.py`` and
+the chaos ``overload`` profile.  See docs/design/preemption.md.
+"""
+
+from karpenter_tpu.preempt.encode import (  # noqa: F401
+    VictimSet, encode_victims, group_node_compat,
+)
+from karpenter_tpu.preempt.degraded import ResilientPlanner  # noqa: F401
+from karpenter_tpu.preempt.greedy import GreedyPreemptionPlanner  # noqa: F401
+from karpenter_tpu.preempt.planner import PreemptionPlanner  # noqa: F401
+from karpenter_tpu.preempt.types import (  # noqa: F401
+    Eviction, PlannerOptions, PreemptionPlan,
+)

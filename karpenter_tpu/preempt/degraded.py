@@ -1,0 +1,101 @@
+"""Preemption degraded mode: greedy fallback instead of a failed plan.
+
+Mirrors ``solver/degraded.py``: the batched planner can fail in ways the
+host loop cannot (a broken device kernel, a shape bug in the grid
+padding).  None of those may stall the preemption plane while
+high-priority pods sit pending — ``ResilientPlanner`` degrades that one
+plan to ``preempt/greedy.py`` with an ``ERRORS`` breadcrumb
+(component="preempt") and a ``degraded:`` backend tag.
+
+The structural gate is deliberately cheap (O(evictions + placements));
+full feasibility stays with ``validate_preemption_plan``
+(solver/validate.py), which tests and the chaos harness run on every
+executed plan.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from karpenter_tpu.preempt.encode import VictimSet
+from karpenter_tpu.preempt.greedy import GreedyPreemptionPlanner
+from karpenter_tpu.preempt.planner import PreemptionPlanner
+from karpenter_tpu.preempt.types import PlannerOptions, PreemptionPlan
+from karpenter_tpu.solver.encode import EncodedProblem
+from karpenter_tpu import obs
+from karpenter_tpu.utils import metrics
+from karpenter_tpu.utils.logging import get_logger
+
+log = get_logger("preempt.degraded")
+
+
+def plan_defects(plan: PreemptionPlan, problem: EncodedProblem,
+                 victims: VictimSet) -> list[str]:
+    """Structural sanity of a preemption plan (cheap; the full oracle is
+    validate_preemption_plan)."""
+    if plan is None:
+        return ["planner returned no plan"]
+    defects: list[str] = []
+    known_claims = set(victims.claim_names)
+    evicted: set[str] = set()
+    for ev in plan.evictions:
+        if ev.claim_name not in known_claims:
+            defects.append(f"eviction on unknown claim {ev.claim_name}")
+        if ev.pod_key in evicted:
+            defects.append(f"pod {ev.pod_key} evicted twice")
+        evicted.add(ev.pod_key)
+        # the invariant the whole subsystem exists to uphold: an
+        # inverted eviction must never even reach the execution gate
+        if ev.victim_priority >= ev.beneficiary_priority:
+            defects.append(
+                f"priority inversion: victim {ev.pod_key} "
+                f"(prio {ev.victim_priority}) evicted for prio "
+                f"{ev.beneficiary_priority}")
+    pending = {pn for g in problem.groups for pn in g.pod_names}
+    for pn, claim in plan.placements.items():
+        if pn not in pending:
+            defects.append(f"placement of unknown pending pod {pn}")
+        if claim not in known_claims:
+            defects.append(f"placement onto unknown claim {claim}")
+        if pn in evicted:
+            defects.append(f"pod {pn} both placed and evicted")
+    return defects
+
+
+class ResilientPlanner:
+    """Wraps the batched planner; degrades single plans to greedy."""
+
+    def __init__(self, primary: PreemptionPlanner | None = None,
+                 options: PlannerOptions | None = None):
+        self.options = options or getattr(primary, "options", None) \
+            or PlannerOptions()
+        self.primary = primary or PreemptionPlanner(self.options)
+        self._fallback = None
+
+    @property
+    def fallback(self) -> GreedyPreemptionPlanner:
+        if self._fallback is None:
+            self._fallback = GreedyPreemptionPlanner(self.options)
+        return self._fallback
+
+    def plan(self, problem: EncodedProblem, victims: VictimSet,
+             compat: np.ndarray | None = None) -> PreemptionPlan:
+        try:
+            plan = self.primary.plan(problem, victims, compat)
+        except Exception as e:  # noqa: BLE001 — degrade, never fail the cycle
+            log.error("preemption planner failed; degrading to greedy",
+                      error=str(e)[:200])
+            return self._degrade(problem, victims, compat, "backend_failure")
+        defects = plan_defects(plan, problem, victims)
+        if defects:
+            log.error("preemption planner produced invalid plan; degrading",
+                      defects=defects[:3])
+            return self._degrade(problem, victims, compat, "invalid_plan")
+        return plan
+
+    def _degrade(self, problem, victims, compat, reason: str) -> PreemptionPlan:
+        metrics.ERRORS.labels("preempt", f"degraded_{reason}").inc()
+        with obs.span("preempt.plan.degraded", reason=reason):
+            plan = self.fallback.plan(problem, victims, compat)
+        plan.backend = f"degraded:{plan.backend}"
+        return plan

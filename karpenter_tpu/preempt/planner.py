@@ -1,0 +1,216 @@
+"""Batched preemption planner: one vectorized candidate grid per round.
+
+The canonical algorithm (shared bit-for-bit with ``preempt/greedy.py``,
+the pure-python parity path — differential tests assert identical
+plans):
+
+Groups are visited in the encoded problem's order (priority DESC, then
+dominant size — ``solver/encode.py``).  For each group, rounds repeat
+until its pods are placed or nothing helps:
+
+1. For every victim node, feasibility of "evict the k cheapest victims"
+   is evaluated for ALL k at once against the freed-capacity prefix
+   tensors (``cap = resid + freed_prefix[k] - consumed``) — one batched
+   [Nn, K] grid, the device-friendly shape (CvxCluster-style relaxation
+   of the eviction/placement trade-off into dense feasibility);
+2. each node's candidate is its CHEAPEST feasible k (smallest eviction
+   prefix that fits >= 1 pod); victims above or equal to the group's
+   priority are never eligible (k is capped below the node's first such
+   victim — the no-priority-inversion guarantee is structural);
+3. candidates commit in ascending (eviction weight, -fit, node) order
+   until the group is placed or the disruption budget runs out.  Weights
+   are dense priority ranks (int, overflow-proof), so evicting two
+   prio-0 pods is cheaper than one prio-100 pod.
+
+Evicting k=0 victims is a valid candidate: free capacity on existing
+nodes is used before anything is evicted (the planner doubles as a
+slack-filler for pods the solve could not place because no offering was
+*creatable*).
+
+The grid step optionally runs as a jitted device kernel (int32,
+bucket-padded shapes so recompiles stay bounded); arithmetic is
+integer-exact on both paths, so the backend choice never changes the
+plan.
+"""
+
+from __future__ import annotations
+
+import time
+from functools import lru_cache
+
+import numpy as np
+
+from karpenter_tpu.preempt.encode import VictimSet, group_node_compat
+from karpenter_tpu.preempt.types import Eviction, PlannerOptions, PreemptionPlan
+from karpenter_tpu.solver.encode import EncodedProblem
+from karpenter_tpu.solver.types import bucket
+
+_FIT_BIG = np.int64(1) << 40
+# bucket rungs for the device grid (recompile bound): nodes x prefix-k
+_NODE_PAD = (64, 128, 256, 512, 1024, 2048, 4096, 8192, 16384)
+_K_PAD = (2, 4, 8, 16, 32, 64, 128, 256)
+# below this grid size the jit dispatch overhead beats the kernel win
+_DEVICE_MIN_CELLS = 4096
+_I32_MAX = int(np.iinfo(np.int32).max)
+
+
+@lru_cache(maxsize=1)
+def _device_fit_grid():
+    """Jitted [Nn, K] fit-grid kernel, or None when jax is unusable."""
+    try:
+        import jax
+        import jax.numpy as jnp
+
+        @jax.jit
+        def fit_grid(resid0, freed_prefix, consumed, req):
+            cap = resid0[:, None, :] + freed_prefix - consumed[:, None, :]
+            per = jnp.where(req[None, None, :] > 0,
+                            cap // jnp.maximum(req, 1)[None, None, :],
+                            jnp.int32(_I32_MAX))
+            return jnp.clip(jnp.min(per, axis=2), 0, None)
+
+        # force one trace so an unusable backend fails HERE, not mid-plan
+        fit_grid(np.zeros((1, 1, 4), np.int32), np.zeros((1, 2, 4), np.int32),
+                 np.zeros((1, 4), np.int32), np.ones(4, np.int32))
+        return fit_grid
+    except Exception:  # noqa: BLE001 — device is an optimization, not a dep
+        return None
+
+
+class PreemptionPlanner:
+    """Pure function over (encoded pending problem, victim set)."""
+
+    def __init__(self, options: PlannerOptions | None = None):
+        self.options = options or PlannerOptions()
+
+    # -- grid step (the only backend-switched code) -----------------------
+
+    def _fit_grid(self, resid0, freed_prefix, consumed, req):
+        Nn, K, _R = freed_prefix.shape
+        use = self.options.use_device
+        if use != "off" and (use == "on" or Nn * K >= _DEVICE_MIN_CELLS):
+            dev = _device_fit_grid()
+            # int32 contract: overflow would silently diverge from the
+            # host path, so any out-of-range tensor routes to numpy
+            if dev is not None and all(
+                    np.abs(a).max(initial=0) < _I32_MAX
+                    for a in (resid0, freed_prefix, consumed, req)):
+                Np = bucket(Nn, _NODE_PAD)
+                Kp = bucket(K, _K_PAD)
+                r0 = np.zeros((Np, resid0.shape[1]), np.int32)
+                r0[:Nn] = resid0
+                fp = np.zeros((Np, Kp, freed_prefix.shape[2]), np.int32)
+                fp[:Nn, :K] = freed_prefix
+                co = np.zeros((Np, consumed.shape[1]), np.int32)
+                co[:Nn] = consumed
+                out = np.asarray(dev(r0, fp, co, req.astype(np.int32)))
+                return out[:Nn, :K].astype(np.int64)
+        cap = resid0[:, None, :] + freed_prefix - consumed[:, None, :]
+        per = np.where(req[None, None, :] > 0,
+                       cap // np.maximum(req, 1)[None, None, :], _FIT_BIG)
+        return np.clip(per.min(axis=2), 0, None)
+
+    # -- the plan ----------------------------------------------------------
+
+    def plan(self, problem: EncodedProblem, victims: VictimSet,
+             compat: np.ndarray | None = None) -> PreemptionPlan:
+        t0 = time.perf_counter()
+        out = PreemptionPlan(backend="vector",
+                             candidate_count=victims.num_victims)
+        G, Nn = problem.num_groups, victims.num_nodes
+        if G == 0 or Nn == 0:
+            out.unplaced = [pn for g in problem.groups for pn in g.pod_names]
+            out.plan_seconds = time.perf_counter() - t0
+            return out
+        if compat is None:
+            compat = group_node_compat(problem, victims)
+
+        # dense priority-rank weights (overflow-proof: raw priorities
+        # span int32, ranks span the count of distinct values)
+        real = victims.vict_prio[victims.vict_prio != np.iinfo(np.int32).max]
+        ranks = np.unique(real)
+        w = np.where(victims.vict_prio == np.iinfo(np.int32).max, 0,
+                     np.searchsorted(ranks, victims.vict_prio) + 1)
+        wsum = np.zeros((Nn, victims.vict_prio.shape[1] + 1), dtype=np.int64)
+        np.cumsum(w, axis=1, out=wsum[:, 1:])
+
+        freed_prefix = victims.freed_prefix              # [Nn, K, R]
+        K = freed_prefix.shape[1]
+        resid0 = victims.resid
+        consumed = np.zeros_like(resid0)
+        kstart = np.zeros(Nn, dtype=np.int64)
+        budget = self.options.max_evictions if self.options.max_evictions >= 0 \
+            else (1 << 60)
+        krange = np.arange(K, dtype=np.int64)
+        n_index = np.arange(Nn)
+
+        for gi, group in enumerate(problem.groups):
+            c = int(problem.group_count[gi])
+            node_ok = compat[gi]
+            if c == 0 or not node_ok.any():
+                out.unplaced.extend(group.pod_names)
+                continue
+            p = int(problem.group_prio[gi])
+            req = problem.group_req[gi].astype(np.int64)
+            cap_per = int(problem.group_cap[gi])
+            # victims eligible for THIS group: the sorted prefix strictly
+            # below its priority (pads sit at int32 max, never counted)
+            klim = (victims.vict_prio < p).sum(axis=1).astype(np.int64)
+            placed_on = np.zeros(Nn, dtype=np.int64)
+            cursor = 0
+            while c > 0:
+                fit = self._fit_grid(resid0, freed_prefix, consumed, req)
+                # k == kstart evicts NOBODY, so it stays legal even when
+                # earlier (higher-priority) groups already advanced the
+                # node past this group's eligible prefix (klim < kstart)
+                # — slack left after their placements is fair game
+                feas = ((krange[None, :] >= kstart[:, None])
+                        & (krange[None, :] <= np.maximum(klim,
+                                                         kstart)[:, None])
+                        & (krange[None, :] - kstart[:, None] <= budget)
+                        & node_ok[:, None]
+                        & (fit >= 1)
+                        & (placed_on < cap_per)[:, None])
+                has = feas.any(axis=1)
+                if not has.any():
+                    break
+                kbest = np.argmax(feas, axis=1)          # first feasible k
+                fitb = fit[n_index, kbest]
+                cost = wsum[n_index, kbest] - wsum[n_index, kstart]
+                cand = n_index[has]
+                order = cand[np.lexsort((
+                    -fitb[cand], cost[cand]))]           # stable: n asc last
+                progressed = False
+                for n in order.tolist():
+                    if c <= 0:
+                        break
+                    k = int(kbest[n])
+                    extra = k - int(kstart[n])
+                    if extra > budget:
+                        continue
+                    take = min(int(fitb[n]), c, cap_per - int(placed_on[n]))
+                    if take <= 0:
+                        continue
+                    for j in range(int(kstart[n]), k):
+                        out.evictions.append(Eviction(
+                            claim_name=victims.claim_names[n],
+                            pod_key=victims.vict_keys[n][j],
+                            victim_priority=int(victims.vict_prio[n, j]),
+                            beneficiary_priority=p,
+                            beneficiary=group.pod_names[0]))
+                    out.eviction_weight += int(wsum[n, k] - wsum[n, kstart[n]])
+                    budget -= extra
+                    kstart[n] = k
+                    consumed[n] += req * take
+                    for pn in group.pod_names[cursor:cursor + take]:
+                        out.placements[pn] = victims.claim_names[n]
+                    cursor += take
+                    placed_on[n] += take
+                    c -= take
+                    progressed = True
+                if not progressed:
+                    break
+            if c:
+                out.unplaced.extend(group.pod_names[cursor:])
+        out.plan_seconds = time.perf_counter() - t0
+        return out

@@ -83,9 +83,9 @@ class EncodedProblem:
     consume the same order, so plans are comparable."""
 
     __slots__ = ("groups", "group_req", "group_count", "group_cap",
-                 "catalog", "rejected", "label_rows", "label_idx",
-                 "pref_rows", "pref_idx", "_compat", "_names_idx",
-                 "_prep_cache")
+                 "group_prio", "catalog", "rejected", "label_rows",
+                 "label_idx", "pref_rows", "pref_idx", "_compat",
+                 "_names_idx", "_prep_cache")
 
     def __init__(self, groups: list[PodGroup], group_req: np.ndarray,
                  group_count: np.ndarray, group_cap: np.ndarray,
@@ -95,11 +95,16 @@ class EncodedProblem:
                  label_rows: np.ndarray | None = None,
                  label_idx: np.ndarray | None = None,
                  pref_rows: np.ndarray | None = None,
-                 pref_idx: np.ndarray | None = None):
+                 pref_idx: np.ndarray | None = None,
+                 group_prio: np.ndarray | None = None):
         self.groups = groups
         self.group_req = group_req
         self.group_count = group_count
         self.group_cap = group_cap
+        # int32 [G] per-group pod priority (parse_priority-validated) —
+        # the preemption plane's ranking tensor; zeros when absent
+        self.group_prio = group_prio if group_prio is not None \
+            else np.zeros(len(groups), dtype=np.int32)
         self.catalog = catalog
         self.rejected = rejected if rejected is not None else []
         self.label_rows = label_rows
@@ -143,7 +148,7 @@ class EncodedProblem:
                       compat=self._compat, catalog=self.catalog,
                       rejected=self.rejected, label_rows=self.label_rows,
                       label_idx=self.label_idx, pref_rows=self.pref_rows,
-                      pref_idx=self.pref_idx)
+                      pref_idx=self.pref_idx, group_prio=self.group_prio)
         fields.update(kw)
         return EncodedProblem(**fields)
 
@@ -501,6 +506,7 @@ def _encode_impl(pods: Sequence[PodSpec], catalog: CatalogArrays,
     g_cap: list[int] = []
     g_label: list[int] = []
     g_pref: list[int] = []                 # index into pref row set; -1 = none
+    g_prio: list[int] = []
     g_name: list[str] = []
     row_keys: dict[tuple, int] = {}
     rows: list[np.ndarray] = []
@@ -622,6 +628,7 @@ def _encode_impl(pods: Sequence[PodSpec], catalog: CatalogArrays,
                                        zone if pinned else None, reqs))
                 g_pref.append(pref_for(pref_terms, pref_w,
                                        None if pinned else zone))
+                g_prio.append(rep.priority)
                 g_name.append(groups[-1].pod_names[0])
 
         spread = _zone_spread_constraints(rep)
@@ -644,6 +651,7 @@ def _encode_impl(pods: Sequence[PodSpec], catalog: CatalogArrays,
             g_cap.append(cap_i32)
             g_label.append(row_for(label, zone_sig, best, reqs))
             g_pref.append(pref_for(pref_terms, pref_w, None))
+            g_prio.append(rep.priority)
             g_name.append(groups[-1].pod_names[0])
         elif _soft_zone_spread(rep) and len(live_zones) > 1:
             # soft spread ranks BELOW hard spread and below zone
@@ -660,10 +668,15 @@ def _encode_impl(pods: Sequence[PodSpec], catalog: CatalogArrays,
             g_cap.append(cap_i32)
             g_label.append(row_for(label, zone_sig, None, reqs))
             g_pref.append(pref_for(pref_terms, pref_w, None))
+            g_prio.append(rep.priority)
             g_name.append(groups[-1].pod_names[0])
 
-    # 4. FFD order: descending dominant size (deterministic tie-break on
-    # first pod name) — one vectorized lexsort over per-group arrays.
+    # 4. FFD order: descending PRIORITY first (preemption semantics:
+    # under scarcity, every backend spends capacity on high-priority
+    # groups before lower ones — placement becomes priority-aware with
+    # no extra device work), then descending dominant size, deterministic
+    # tie-break on first pod name — one vectorized lexsort over per-group
+    # arrays.  All-default-priority windows sort exactly as before.
     G, O = len(groups), catalog.num_offerings
     mean_alloc = catalog.type_alloc.mean(axis=0) if catalog.num_types else \
         np.ones(NUM_RESOURCES)
@@ -672,18 +685,21 @@ def _encode_impl(pods: Sequence[PodSpec], catalog: CatalogArrays,
     group_cap = np.asarray(g_cap, dtype=np.int32)
     label_idx = np.asarray(g_label, dtype=np.int32)
     pref_idx = np.asarray(g_pref, dtype=np.int32)
+    group_prio = np.asarray(g_prio, dtype=np.int32)
     if G:
         shares = np.where(mean_alloc[None, :] > 0,
                           group_req.astype(np.float64)
                           / np.maximum(mean_alloc, 1e-12)[None, :],
                           0.0).max(axis=1)
-        order = np.lexsort((np.asarray(g_name), -shares))
+        order = np.lexsort((np.asarray(g_name), -shares,
+                            -group_prio.astype(np.int64)))
         groups = [groups[i] for i in order]
         group_req = np.ascontiguousarray(group_req[order])
         group_count = group_count[order]
         group_cap = group_cap[order]
         label_idx = label_idx[order]
         pref_idx = pref_idx[order]
+        group_prio = np.ascontiguousarray(group_prio[order])
 
     label_rows = (np.stack(rows) if rows
                   else np.zeros((0, O), dtype=bool))
@@ -696,7 +712,7 @@ def _encode_impl(pods: Sequence[PodSpec], catalog: CatalogArrays,
         group_cap=group_cap, compat=None, catalog=catalog,
         rejected=rejected, label_rows=label_rows, label_idx=label_idx,
         pref_rows=np.stack(pref_rows_l) if has_pref else None,
-        pref_idx=pref_idx if has_pref else None)
+        pref_idx=pref_idx if has_pref else None, group_prio=group_prio)
 
 
 def estimate_nodes(problem: EncodedProblem, n_cap: int,

@@ -137,3 +137,141 @@ def validate_plan(plan: Plan, pods: Sequence[PodSpec], catalog: CatalogArrays,
         errors.append(f"cost mismatch: nodes sum {expected} != "
                       f"plan {plan.total_cost_per_hour}")
     return errors
+
+
+def validate_preemption_plan(plan, pending_pods: Sequence[PodSpec], cluster,
+                             catalog: CatalogArrays,
+                             nodepool: NodePool | None = None,
+                             occupancy: dict | None = None) -> list[str]:
+    """Independent feasibility oracle for a PreemptionPlan — no shared
+    code path with either planner backend.  Checks against ground truth
+    (cluster claims + bound pods + catalog):
+
+    - every eviction names a live claim and a pod actually occupying it;
+      no pod is evicted twice, or both evicted and placed;
+    - **no priority inversion**: every victim's priority is strictly
+      below the lowest priority among the pods the plan places on that
+      claim (and below the recorded beneficiary priority);
+    - per-claim capacity: surviving occupants + placements fit the
+      claim's offering allocatable;
+    - placed pods come from the pending request, each placed once, their
+      scheduling requirements are satisfied by the target's offering
+      labels (availability deliberately NOT required — the node exists)
+      and they tolerate the claim's and pool's taints.
+    """
+    from karpenter_tpu.preempt.encode import claim_pods, occupancy_index
+
+    nodepool = nodepool or NodePool(name="default")
+    errors: list[str] = []
+    by_name: dict[str, PodSpec] = {pod_key(p): p for p in pending_pods}
+    claims = {c.name: c for c in cluster.nodeclaims()
+              if not c.deleted and c.launched}
+    if occupancy is None:
+        occupancy = occupancy_index(cluster)
+
+    evicted: dict[str, str] = {}           # pod key -> claim
+    for ev in plan.evictions:
+        claim = claims.get(ev.claim_name)
+        if claim is None:
+            errors.append(f"eviction {ev.pod_key}: unknown/dead claim "
+                          f"{ev.claim_name}")
+            continue
+        occupants = {pod_key(p.spec): p
+                     for p in claim_pods(cluster, claim, index=occupancy)}
+        if ev.pod_key not in occupants:
+            errors.append(f"eviction {ev.pod_key}: pod not on claim "
+                          f"{ev.claim_name}")
+        elif occupants[ev.pod_key].spec.priority != ev.victim_priority:
+            errors.append(f"eviction {ev.pod_key}: recorded priority "
+                          f"{ev.victim_priority} != actual "
+                          f"{occupants[ev.pod_key].spec.priority}")
+        if ev.pod_key in evicted:
+            errors.append(f"pod {ev.pod_key} evicted twice")
+        evicted[ev.pod_key] = ev.claim_name
+        if ev.victim_priority >= ev.beneficiary_priority:
+            errors.append(
+                f"priority inversion: victim {ev.pod_key} (prio "
+                f"{ev.victim_priority}) evicted for beneficiary prio "
+                f"{ev.beneficiary_priority}")
+
+    placed_by_claim: dict[str, list[str]] = {}
+    seen: set[str] = set()
+    for pn, claim_name in plan.placements.items():
+        if pn in seen:
+            errors.append(f"pod {pn} placed twice")
+        seen.add(pn)
+        if pn in evicted:
+            errors.append(f"pod {pn} both placed and evicted")
+        if pn not in by_name:
+            errors.append(f"placed pod {pn} not in the pending request")
+        if claim_name not in claims:
+            errors.append(f"pod {pn} placed on unknown claim {claim_name}")
+            continue
+        placed_by_claim.setdefault(claim_name, []).append(pn)
+
+    for claim_name, placed in placed_by_claim.items():
+        claim = claims[claim_name]
+        o = catalog.find_offering(claim.instance_type, claim.zone,
+                                  claim.capacity_type)
+        if o is None:
+            errors.append(f"claim {claim_name}: offering "
+                          f"{claim.instance_type}/{claim.zone} not in catalog")
+            continue
+        labels = dict(nodepool.labels)
+        labels.update(catalog.offering_label_values(o))
+        alloc = catalog.offering_alloc()[o]
+        used = [0, 0, 0, 0]
+        # surviving occupants keep their footprint
+        for p in claim_pods(cluster, claim, index=occupancy):
+            key = pod_key(p.spec)
+            if evicted.get(key) == claim_name:
+                continue
+            for i, v in enumerate(p.spec.requests.as_tuple()):
+                used[i] += v if i != 3 else max(v, 1)
+        max_placed_prio = None
+        for pn in placed:
+            pod = by_name.get(pn)
+            if pod is None:
+                continue
+            for i, v in enumerate(pod.requests.as_tuple()):
+                used[i] += v if i != 3 else max(v, 1)
+            max_placed_prio = pod.priority if max_placed_prio is None \
+                else max(max_placed_prio, pod.priority)
+            reqs = pod.scheduling_requirements().merged(nodepool.requirements)
+            if not reqs.matches(labels):
+                errors.append(f"claim {claim_name}: pod {pn} requirements "
+                              f"unsatisfied by labels")
+            if claim.taints and not tolerates_all(pod.tolerations,
+                                                  claim.taints):
+                errors.append(f"claim {claim_name}: pod {pn} does not "
+                              f"tolerate claim taints")
+            if nodepool.taints and not tolerates_all(pod.tolerations,
+                                                     nodepool.taints):
+                errors.append(f"claim {claim_name}: pod {pn} does not "
+                              f"tolerate pool taints")
+        if any(u > a for u, a in zip(used, alloc)):
+            errors.append(f"claim {claim_name} ({claim.instance_type}): "
+                          f"capacity exceeded used={used} "
+                          f"alloc={list(alloc)}")
+        # independent inversion check: recompute from the placements,
+        # not the plan's own beneficiary stamps.  Every victim must have
+        # yielded to SOME strictly-higher-priority pod placed on the
+        # claim (the max, not the min: lower-priority pods may ride
+        # along into leftover slack without evicting anyone).
+        if max_placed_prio is not None:
+            for ev in plan.evictions:
+                if ev.claim_name == claim_name \
+                        and ev.victim_priority >= max_placed_prio:
+                    errors.append(
+                        f"claim {claim_name}: victim {ev.pod_key} (prio "
+                        f"{ev.victim_priority}) >= placed max prio "
+                        f"{max_placed_prio}")
+
+    # evictions that freed capacity nothing uses are waste, not a
+    # feasibility violation — but an eviction on a claim with NO
+    # placements at all serves nobody and is flagged
+    for ev in plan.evictions:
+        if ev.claim_name in claims and ev.claim_name not in placed_by_claim:
+            errors.append(f"eviction {ev.pod_key} on claim {ev.claim_name} "
+                          f"serves no placement")
+    return errors

@@ -274,6 +274,20 @@ SOLVE_PHASE = Histogram(
     "measurements as the obs span layer so the two agree.", ("phase",),
     buckets=(0.00005, 0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005,
              0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0))
+# Preemption plane (karpenter_tpu/preempt + controllers/preemption.py).
+PREEMPTIONS = Counter(
+    "karpenter_tpu_preemptions_total",
+    "Pod evictions executed by the preemption plane, by reason "
+    "(priority = a higher-priority pending pod took the capacity)",
+    ("reason",))
+PREEMPTION_CANDIDATES = Histogram(
+    "karpenter_tpu_preemption_candidates",
+    "Victim pods considered per preemption plan",
+    (), buckets=(1, 10, 50, 100, 500, 1000, 5000, 10000, 100000))
+PREEMPTION_PLAN_DURATION = Histogram(
+    "karpenter_tpu_preemption_plan_seconds",
+    "Preemption plan latency (encode victims + batched solve)",
+    ("backend",))
 LEADER = Gauge(
     "karpenter_tpu_leader",
     "1 when this replica holds the named leader-election lease", ("lease",))

@@ -11,7 +11,8 @@ from karpenter_tpu.apis import (
     PodSpec, Toleration, Taint,
 )
 from karpenter_tpu.apis.pod import (
-    ResourceRequests, parse_cpu_milli, parse_memory_mib, tolerates_all,
+    PRIORITY_MAX, PRIORITY_MIN, ResourceRequests, parse_cpu_milli,
+    parse_memory_mib, parse_priority, tolerates_all,
 )
 from karpenter_tpu.apis.requirements import Operator, Requirement, Requirements
 
@@ -31,6 +32,27 @@ class TestQuantities:
         r = ResourceRequests.parse({"cpu": "500m", "memory": "1Gi",
                                     "nvidia.com/gpu": 2})
         assert r.as_tuple() == (500, 1024, 2, 1)
+
+    # priorityClassName-style values: None -> 0, ints clamp to the k8s
+    # bounds (int32 floor, 1e9 user-class ceiling), everything else is
+    # a hard reject — the preemption plane's no-inversion guarantee
+    # keys on these ints, so a lenient parse is an inversion vector.
+    @pytest.mark.parametrize("q,want", [
+        (None, 0), (0, 0), (100, 100), (-7, -7),
+        (PRIORITY_MAX, PRIORITY_MAX),
+        (PRIORITY_MAX + 1, PRIORITY_MAX),          # clamp above ceiling
+        (2 ** 31, PRIORITY_MAX),
+        (PRIORITY_MIN, PRIORITY_MIN),
+        (PRIORITY_MIN - 1, PRIORITY_MIN),          # clamp below int32
+        (-(2 ** 63), PRIORITY_MIN)])
+    def test_priority_valid(self, q, want):
+        assert parse_priority(q) == want
+
+    @pytest.mark.parametrize("q", [
+        "100", "high", 1.5, 0.0, True, False, [], {}, (0,), b"0"])
+    def test_priority_rejects_non_int(self, q):
+        with pytest.raises(ValueError):
+            parse_priority(q)
 
 
 class TestRequirements:

@@ -559,6 +559,20 @@ class TestScenarios:
         assert res.ok, res.render_failure()
         assert res.trace.of_kind("invariants")
 
+    def test_overload_profile_preempts_and_holds_invariants(self):
+        """The preemption plane's acceptance scenario: an instance quota
+        far below demand forces evictions of low-priority pods, with
+        zero priority inversions and every preempted pod re-resolving
+        after the quota lifts at quiesce."""
+        res = run_scenario("overload", 2, rounds=10)
+        assert res.ok, res.render_failure()
+        pump = res.trace.of_kind("pump")
+        assert max(r.get("preempted", 0) for r in pump) > 0, \
+            "overload never exercised the preemption plane"
+        # determinism: same cell twice => identical digest
+        again = run_scenario("overload", 2, rounds=10)
+        assert res.digest == again.digest
+
     def test_broken_fixture_fails_with_replay_command(self):
         """Falsifiability: a world with GC + orphan cleanup disabled MUST
         trip no-stale-orphan, and the failure names the exact replay."""

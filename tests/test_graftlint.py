@@ -18,6 +18,7 @@ from tools.graftlint.engine import Baseline, SourceModule, default_engine, lint_
 from tools.graftlint.rules import all_rules
 
 SOLVER_PATH = "karpenter_tpu/solver/_snippet.py"
+PREEMPT_PATH = "karpenter_tpu/preempt/_snippet.py"
 CTRL_PATH = "karpenter_tpu/controllers/_snippet.py"
 CLOUD_PATH = "karpenter_tpu/cloud/_snippet.py"
 
@@ -125,6 +126,41 @@ def test_gl002_static_arg_and_none_gate_good():
                 return x
             return -x
         """, "GL002")
+
+
+def test_gl002_preempt_scope_eviction_scoring_bad():
+    """The purity family covers karpenter_tpu/preempt/: a tracer-bool in
+    an eviction-scoring kernel (early-exit on a traced feasibility
+    count) must fire GL002 there, same as in solver/."""
+    assert_flags(
+        """
+        import jax
+        import jax.numpy as jnp
+
+        @jax.jit
+        def score_evictions(resid, freed_prefix, req):
+            cap = resid[:, None, :] + freed_prefix
+            fit = jnp.min(cap // jnp.maximum(req, 1), axis=2)
+            if fit.sum() == 0:        # traced bool: trace-time error
+                return jnp.zeros_like(fit)
+            return jnp.clip(fit, 0, None)
+        """, "GL002", path=PREEMPT_PATH)
+
+
+def test_gl002_preempt_scope_eviction_scoring_good():
+    assert_clean(
+        """
+        import jax
+        import jax.numpy as jnp
+
+        @jax.jit
+        def score_evictions(resid, freed_prefix, req):
+            cap = resid[:, None, :] + freed_prefix
+            fit = jnp.min(cap // jnp.maximum(req, 1), axis=2)
+            # branchless: the empty case falls out of the where
+            return jnp.where(fit.sum() == 0, jnp.zeros_like(fit),
+                             jnp.clip(fit, 0, None))
+        """, "GL002", path=PREEMPT_PATH)
 
 
 def test_gl003_recompile_bad():

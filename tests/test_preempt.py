@@ -1,0 +1,717 @@
+"""Preemption-plane tests: victim encoding, planner semantics, parity,
+degraded fallback, the independent validator, and the controller.
+
+Strategy mirrors the solver suite (SURVEY.md §4.9): pure functions over
+a fake catalog + hand-built cluster state, with the greedy host path as
+the differential oracle for the batched planner and
+``validate_preemption_plan`` as the independent feasibility oracle for
+both.
+"""
+
+import numpy as np
+import pytest
+
+from karpenter_tpu.apis.nodeclaim import NodeClaim, NodePool
+from karpenter_tpu.apis.nodeclass import (
+    InstanceRequirements, NodeClass, NodeClassSpec, PlacementStrategy,
+)
+from karpenter_tpu.apis.pod import (
+    PodSpec, ResourceRequests, Taint, Toleration, make_pods, pod_key,
+)
+from karpenter_tpu.apis.requirements import LABEL_ZONE
+from karpenter_tpu.catalog import (
+    CatalogArrays, InstanceTypeProvider, PricingProvider, UnavailableOfferings,
+)
+from karpenter_tpu.cloud.fake import FakeCloud
+from karpenter_tpu.controllers.preemption import PreemptionController
+from karpenter_tpu.core.actuator import Actuator
+from karpenter_tpu.core.cluster import ClusterState
+from karpenter_tpu.core.provisioner import Provisioner, ProvisionerOptions
+from karpenter_tpu.preempt import (
+    Eviction, GreedyPreemptionPlanner, PlannerOptions, PreemptionPlan,
+    PreemptionPlanner, ResilientPlanner, VictimSet, encode_victims,
+    group_node_compat,
+)
+from karpenter_tpu.preempt.degraded import plan_defects
+from karpenter_tpu.preempt.encode import PRIO_PAD, claim_pods, occupancy_index
+from karpenter_tpu.solver.encode import encode
+from karpenter_tpu.solver.types import SolverOptions
+from karpenter_tpu.solver.validate import validate_preemption_plan
+from karpenter_tpu.utils import metrics
+
+
+@pytest.fixture(scope="module")
+def catalog():
+    cloud = FakeCloud()
+    pricing = PricingProvider(cloud)
+    itp = InstanceTypeProvider(cloud, pricing)
+    arrays = CatalogArrays.build(itp.list())
+    pricing.close()
+    return arrays
+
+
+# bx2-2x8: alloc = (1800 cpu-milli, 5644 MiB, 0 accel, 30 pods)
+SMALL = "bx2-2x8"
+
+
+def req(cpu, mem=1024):
+    return ResourceRequests(cpu, mem, 0, 1)
+
+
+def add_claim(cluster, name, itype=SMALL, zone="us-south-1",
+              cap="on-demand", pool="", taints=(), launched=True):
+    claim = NodeClaim(
+        name=name, nodeclass_name="default", nodepool_name=pool,
+        instance_type=itype, zone=zone, capacity_type=cap,
+        taints=tuple(taints), launched=launched, node_name=f"node-{name}")
+    cluster.add_nodeclaim(claim)
+    return claim
+
+
+def bind(cluster, spec, claim):
+    cluster.add_pod(spec)
+    cluster.bind_pod(pod_key(spec), claim.node_name)
+
+
+def pend(cluster, spec):
+    p = cluster.add_pod(spec)
+    p.enqueued_at = 0.0        # already past any pending-age gate
+    return p
+
+
+# ---------------------------------------------------------------------------
+# Priority threading through solver/encode.py
+# ---------------------------------------------------------------------------
+
+class TestEncodePriority:
+    def test_priority_splits_groups_and_orders_first(self, catalog):
+        pods = (make_pods(4, "lo", requests=req(500), priority=0)
+                + make_pods(3, "hi", requests=req(500), priority=100))
+        prob = encode(pods, catalog)
+        assert prob.num_groups == 2
+        # priority DESC before size: the prio-100 group leads
+        assert prob.group_prio.tolist() == [100, 0]
+        assert prob.group_count.tolist() == [3, 4]
+        assert prob.group_prio.dtype == np.int32
+
+    def test_priority_outranks_size_in_ffd_order(self, catalog):
+        pods = (make_pods(2, "big-lo", requests=req(2000, 8192), priority=0)
+                + make_pods(2, "small-hi", requests=req(250, 512),
+                            priority=7))
+        prob = encode(pods, catalog)
+        assert prob.group_prio.tolist() == [7, 0]
+
+    def test_default_priority_all_zero(self, catalog):
+        prob = encode(make_pods(5, requests=req(500)), catalog)
+        assert prob.group_prio.tolist() == [0]
+
+
+# ---------------------------------------------------------------------------
+# Victim encoding
+# ---------------------------------------------------------------------------
+
+class TestEncodeVictims:
+    def test_residuals_order_and_prefix(self, catalog):
+        cluster = ClusterState()
+        c = add_claim(cluster, "c1")
+        bind(cluster, PodSpec("a", requests=req(400), priority=10), c)
+        bind(cluster, PodSpec("b", requests=req(200), priority=0), c)
+        bind(cluster, PodSpec("d", requests=req(300), priority=0), c)
+        v = encode_victims(cluster, catalog)
+        assert v.claim_names == ["c1"]
+        assert v.num_victims == 3
+        # priority asc, then size DESC within a priority
+        assert v.vict_prio[0].tolist() == [0, 0, 10]
+        assert v.vict_keys[0] == ["default/d", "default/b", "default/a"]
+        alloc = catalog.offering_alloc()[v.node_off[0]]
+        assert v.resid[0].tolist() == [
+            alloc[0] - 900, alloc[1] - 3 * 1024, 0, alloc[3] - 3]
+        # freed prefix: cumulative (cpu column)
+        assert v.freed_prefix[0, :, 0].tolist() == [0, 300, 500, 900]
+        assert v.freed_prefix[0, :, 3].tolist() == [0, 1, 2, 3]
+
+    def test_skips_dead_unlaunched_and_unknown_offering(self, catalog):
+        cluster = ClusterState()
+        add_claim(cluster, "dead").deleted = True
+        add_claim(cluster, "pending", launched=False)
+        add_claim(cluster, "ghost", itype="no-such-type")
+        add_claim(cluster, "live")
+        v = encode_victims(cluster, catalog)
+        assert v.claim_names == ["live"]
+
+    def test_padding_never_counts_as_victim(self, catalog):
+        cluster = ClusterState()
+        c1 = add_claim(cluster, "c1")
+        bind(cluster, PodSpec("a", requests=req(200), priority=0), c1)
+        add_claim(cluster, "c2")   # empty: pure padding row
+        v = encode_victims(cluster, catalog)
+        assert v.vict_count.tolist() == [1, 0]
+        assert (v.vict_prio[1] == PRIO_PAD).all()
+        # "victims below priority p" is zero on the padded row
+        assert (v.vict_prio[1] < 10 ** 9).sum() == 0
+
+    def test_nominated_pods_hold_capacity(self, catalog):
+        cluster = ClusterState()
+        c = add_claim(cluster, "c1")
+        p = cluster.add_pod(PodSpec("nom", requests=req(600)))
+        p.nominated_node = "c1"     # nominated onto the CLAIM name
+        v = encode_victims(cluster, catalog)
+        assert v.num_victims == 1
+        assert v.vict_keys[0] == ["default/nom"]
+
+    def test_occupancy_index_matches_per_claim_scan(self, catalog):
+        cluster = ClusterState()
+        c1 = add_claim(cluster, "c1")
+        c2 = add_claim(cluster, "c2")
+        bind(cluster, PodSpec("a", requests=req(100)), c1)
+        bind(cluster, PodSpec("b", requests=req(100)), c2)
+        idx = occupancy_index(cluster)
+        for c in (c1, c2):
+            with_idx = [pod_key(p.spec) for p in
+                        claim_pods(cluster, c, index=idx)]
+            without = [pod_key(p.spec) for p in claim_pods(cluster, c)]
+            assert with_idx == without
+
+    def test_compat_zone_and_taints(self, catalog):
+        cluster = ClusterState()
+        add_claim(cluster, "z1", zone="us-south-1")
+        add_claim(cluster, "z2", zone="us-south-2")
+        add_claim(cluster, "tainted", zone="us-south-1",
+                  taints=(Taint("dedicated", "db", "NoSchedule"),))
+        v = encode_victims(cluster, catalog)
+        prob = encode(
+            [PodSpec("p", requests=req(500), priority=5,
+                     node_selector=((LABEL_ZONE, "us-south-1"),))], catalog)
+        compat = group_node_compat(prob, v)
+        assert compat[0].tolist() == [True, False, False]
+        # a toleration re-opens the tainted node
+        prob2 = encode(
+            [PodSpec("p", requests=req(500), priority=5,
+                     tolerations=(Toleration(key="dedicated",
+                                             value="db"),))], catalog)
+        compat2 = group_node_compat(prob2, v)
+        assert compat2[0].tolist() == [True, True, True]
+
+
+# ---------------------------------------------------------------------------
+# Planner semantics (both backends — the canonical algorithm is shared)
+# ---------------------------------------------------------------------------
+
+PLANNERS = [PreemptionPlanner, GreedyPreemptionPlanner]
+
+
+@pytest.mark.parametrize("planner_cls", PLANNERS)
+class TestPlannerSemantics:
+    def test_slack_fill_no_evictions(self, catalog, planner_cls):
+        """Free capacity on existing nodes is used before anything is
+        evicted (k=0 candidates)."""
+        cluster = ClusterState()
+        c = add_claim(cluster, "c1")
+        bind(cluster, PodSpec("lo", requests=req(400), priority=0), c)
+        prob = encode(make_pods(2, "hi", requests=req(500), priority=100),
+                      catalog)
+        plan = planner_cls().plan(prob, encode_victims(cluster, catalog))
+        assert plan.evictions == []
+        assert set(plan.placements) == {"default/hi-0", "default/hi-1"}
+        assert plan.unplaced == []
+
+    def test_evicts_cheapest_lower_priority_only(self, catalog, planner_cls):
+        """A full node: the prio-0 victim goes, the prio-50 one stays."""
+        cluster = ClusterState()
+        c = add_claim(cluster, "c1")
+        bind(cluster, PodSpec("lo", requests=req(800, 2048), priority=0), c)
+        bind(cluster, PodSpec("mid", requests=req(800, 2048), priority=50), c)
+        prob = encode([PodSpec("hi", requests=req(900, 2048), priority=100)],
+                      catalog)
+        plan = planner_cls().plan(prob, encode_victims(cluster, catalog))
+        assert [e.pod_key for e in plan.evictions] == ["default/lo"]
+        assert plan.evictions[0].victim_priority == 0
+        assert plan.evictions[0].beneficiary_priority == 100
+        assert plan.placements == {"default/hi": "c1"}
+
+    def test_no_inversion_equal_priority_never_evicted(self, catalog,
+                                                       planner_cls):
+        cluster = ClusterState()
+        c = add_claim(cluster, "c1")
+        bind(cluster, PodSpec("lo", requests=req(1000, 4096), priority=5), c)
+        bind(cluster, PodSpec("lo2", requests=req(700, 1024), priority=5), c)
+        prob = encode([PodSpec("same", requests=req(900, 2048), priority=5)],
+                      catalog)
+        plan = planner_cls().plan(prob, encode_victims(cluster, catalog))
+        assert plan.evictions == []
+        assert plan.placements == {}
+        assert plan.unplaced == ["default/same"]
+
+    def test_budget_caps_evictions(self, catalog, planner_cls):
+        """Two nodes each need one eviction; budget 1 allows only one."""
+        cluster = ClusterState()
+        for i in range(2):
+            c = add_claim(cluster, f"c{i}")
+            bind(cluster, PodSpec(f"lo{i}", requests=req(1700, 4096),
+                                  priority=0), c)
+        prob = encode(make_pods(2, "hi", requests=req(1000, 2048),
+                                priority=100), catalog)
+        plan = planner_cls(PlannerOptions(max_evictions=1)).plan(
+            prob, encode_victims(cluster, catalog))
+        assert plan.eviction_count == 1
+        assert plan.placed_count == 1
+        assert len(plan.unplaced) == 1
+
+    def test_prefers_fewer_rank_weighted_evictions(self, catalog,
+                                                   planner_cls):
+        """One prio-0 eviction on c-cheap beats two on c-dear."""
+        cluster = ClusterState()
+        dear = add_claim(cluster, "c-dear")
+        for i in range(2):
+            bind(cluster, PodSpec(f"d{i}", requests=req(850, 2048),
+                                  priority=0), dear)
+        cheap = add_claim(cluster, "c-cheap")
+        bind(cluster, PodSpec("ch", requests=req(1700, 4096), priority=0),
+             cheap)
+        prob = encode([PodSpec("hi", requests=req(1500, 3072), priority=9)],
+                      catalog)
+        plan = planner_cls().plan(prob, encode_victims(cluster, catalog))
+        assert [e.pod_key for e in plan.evictions] == ["default/ch"]
+        assert plan.placements == {"default/hi": "c-cheap"}
+
+    def test_high_priority_group_served_first_under_scarcity(
+            self, catalog, planner_cls):
+        """Capacity for one pod only: the prio-1000 group gets it."""
+        cluster = ClusterState()
+        c = add_claim(cluster, "c1")
+        bind(cluster, PodSpec("lo", requests=req(1500, 2048), priority=0), c)
+        pods = [PodSpec("mid", requests=req(1000, 2048), priority=10),
+                PodSpec("vip", requests=req(1000, 2048), priority=1000)]
+        prob = encode(pods, catalog)
+        plan = planner_cls().plan(prob, encode_victims(cluster, catalog))
+        assert plan.placements == {"default/vip": "c1"}
+        assert plan.unplaced == ["default/mid"]
+        assert [e.beneficiary_priority for e in plan.evictions] == [1000]
+
+    def test_low_priority_slack_fill_after_high_priority_evictions(
+            self, catalog, planner_cls):
+        """Once a high-priority group evicts a node past a lower group's
+        eligible prefix (klim < kstart), the lower group must still get
+        the node's REMAINING slack — k == kstart evicts nobody."""
+        cluster = ClusterState()
+        c = add_claim(cluster, "c1")
+        for i in range(2):
+            bind(cluster, PodSpec(f"v{i}", requests=req(700, 2048),
+                                  priority=100), c)
+        pods = [PodSpec("vip", requests=req(1400, 4096), priority=1000),
+                PodSpec("small", requests=req(200, 512), priority=50)]
+        prob = encode(pods, catalog)
+        plan = planner_cls().plan(prob, encode_victims(cluster, catalog))
+        # vip evicted both prio-100 victims; small rides leftover slack
+        assert {e.pod_key for e in plan.evictions} \
+            == {"default/v0", "default/v1"}
+        assert plan.placements == {"default/vip": "c1",
+                                   "default/small": "c1"}
+        assert plan.unplaced == []
+        errs = validate_preemption_plan(plan, pods, cluster, catalog)
+        assert errs == []
+
+    def test_empty_inputs(self, catalog, planner_cls):
+        cluster = ClusterState()
+        prob = encode([PodSpec("p", requests=req(100), priority=3)], catalog)
+        plan = planner_cls().plan(prob, encode_victims(cluster, catalog))
+        assert plan.empty and plan.unplaced == ["default/p"]
+
+
+# ---------------------------------------------------------------------------
+# Differential parity: batched grid == greedy host loop, bit for bit
+# ---------------------------------------------------------------------------
+
+def _random_world(catalog, seed):
+    rng = np.random.RandomState(seed)
+    cluster = ClusterState()
+    types = ["bx2-2x8", "bx2-4x16", "bx2-8x32"]
+    zones = ["us-south-1", "us-south-2", "us-south-3"]
+    for i in range(rng.randint(2, 8)):
+        c = add_claim(cluster, f"c{i}",
+                      itype=types[rng.randint(len(types))],
+                      zone=zones[rng.randint(len(zones))])
+        for j in range(rng.randint(0, 5)):
+            bind(cluster, PodSpec(
+                f"v{i}-{j}", priority=int(rng.choice([0, 0, 5, 50])),
+                requests=req(int(rng.choice([200, 400, 800])),
+                             int(rng.choice([512, 1024, 2048])))), c)
+    pending = []
+    for k in range(rng.randint(1, 12)):
+        kw = {}
+        if rng.rand() < 0.25:
+            kw["node_selector"] = ((LABEL_ZONE,
+                                    zones[rng.randint(len(zones))]),)
+        pending.append(PodSpec(
+            f"p{k}", priority=int(rng.choice([10, 100, 1000])),
+            requests=req(int(rng.choice([250, 500, 900])),
+                         int(rng.choice([512, 1024, 4096]))), **kw))
+    return cluster, pending
+
+
+@pytest.mark.parametrize("seed", range(12))
+def test_vector_greedy_parity(catalog, seed):
+    cluster, pending = _random_world(catalog, seed)
+    prob = encode(pending, catalog)
+    victims = encode_victims(cluster, catalog)
+    budget = [-1, 1, 3][seed % 3]
+    a = PreemptionPlanner(PlannerOptions(max_evictions=budget,
+                                         use_device="off")).plan(
+        prob, victims)
+    b = GreedyPreemptionPlanner(PlannerOptions(max_evictions=budget)).plan(
+        prob, victims)
+    assert [(e.claim_name, e.pod_key) for e in a.evictions] \
+        == [(e.claim_name, e.pod_key) for e in b.evictions]
+    assert a.placements == b.placements
+    assert a.eviction_weight == b.eviction_weight
+    assert sorted(a.unplaced) == sorted(b.unplaced)
+    # both plans pass the independent oracle
+    for plan in (a, b):
+        errs = [e for e in validate_preemption_plan(
+            plan, pending, cluster, catalog)
+            if "serves no placement" not in e]
+        assert errs == [], (plan.backend, errs)
+
+
+def test_device_grid_matches_numpy_grid(catalog):
+    """use_device=on vs off on the same inputs — the jitted kernel is
+    integer-exact against the numpy path (skips if no jax backend)."""
+    from karpenter_tpu.preempt.planner import _device_fit_grid
+    if _device_fit_grid() is None:
+        pytest.skip("no usable jax backend")
+    cluster, pending = _random_world(catalog, 99)
+    prob = encode(pending, catalog)
+    victims = encode_victims(cluster, catalog)
+    on = PreemptionPlanner(PlannerOptions(use_device="on")).plan(
+        prob, victims)
+    off = PreemptionPlanner(PlannerOptions(use_device="off")).plan(
+        prob, victims)
+    assert [(e.claim_name, e.pod_key) for e in on.evictions] \
+        == [(e.claim_name, e.pod_key) for e in off.evictions]
+    assert on.placements == off.placements
+
+
+# ---------------------------------------------------------------------------
+# Degraded fallback
+# ---------------------------------------------------------------------------
+
+class _Boom:
+    options = None
+
+    def plan(self, *a, **kw):
+        raise RuntimeError("device fell over")
+
+
+class _Inverted:
+    """Primary that returns a plan violating no-inversion."""
+
+    options = None
+
+    def plan(self, problem, victims, compat=None):
+        p = PreemptionPlan(backend="vector")
+        p.evictions.append(Eviction(
+            claim_name=victims.claim_names[0], pod_key="default/x",
+            victim_priority=100, beneficiary_priority=5))
+        return p
+
+
+class TestDegraded:
+    def _world(self, catalog):
+        cluster = ClusterState()
+        c = add_claim(cluster, "c1")
+        bind(cluster, PodSpec("lo", requests=req(1500, 4096), priority=0), c)
+        prob = encode([PodSpec("hi", requests=req(1000, 2048), priority=10)],
+                      catalog)
+        return prob, encode_victims(cluster, catalog)
+
+    def test_backend_failure_degrades_to_greedy(self, catalog):
+        prob, victims = self._world(catalog)
+        before = metrics.ERRORS.get("preempt", "degraded_backend_failure")
+        plan = ResilientPlanner(primary=_Boom()).plan(prob, victims)
+        assert plan.backend == "degraded:greedy"
+        assert plan.placements == {"default/hi": "c1"}
+        assert metrics.ERRORS.get("preempt", "degraded_backend_failure") \
+            == before + 1
+
+    def test_invalid_plan_degrades(self, catalog):
+        prob, victims = self._world(catalog)
+        before = metrics.ERRORS.get("preempt", "degraded_invalid_plan")
+        plan = ResilientPlanner(primary=_Inverted()).plan(prob, victims)
+        assert plan.backend == "degraded:greedy"
+        assert metrics.ERRORS.get("preempt", "degraded_invalid_plan") \
+            == before + 1
+
+    def test_healthy_plan_passes_through(self, catalog):
+        prob, victims = self._world(catalog)
+        plan = ResilientPlanner().plan(prob, victims)
+        assert plan.backend == "vector"
+
+    def test_plan_defects_catalog(self, catalog):
+        prob, victims = self._world(catalog)
+        p = PreemptionPlan()
+        p.evictions = [
+            Eviction("ghost-claim", "default/a", 0, 10),
+            Eviction("c1", "default/b", 0, 10),
+            Eviction("c1", "default/b", 0, 10),          # double evict
+            Eviction("c1", "default/c", 50, 10),         # inversion
+        ]
+        p.placements = {"default/nope": "c1",            # unknown pending
+                        "default/b": "c1"}               # placed + evicted
+        text = " ".join(plan_defects(p, prob, victims))
+        for frag in ("unknown claim", "evicted twice", "priority inversion",
+                     "unknown pending", "both placed and evicted"):
+            assert frag in text, frag
+
+
+# ---------------------------------------------------------------------------
+# Independent oracle: validate_preemption_plan
+# ---------------------------------------------------------------------------
+
+class TestValidatePreemptionPlan:
+    def _world(self, catalog):
+        cluster = ClusterState()
+        c = add_claim(cluster, "c1")
+        bind(cluster, PodSpec("lo", requests=req(1200, 4096), priority=0), c)
+        bind(cluster, PodSpec("mid", requests=req(500, 1024), priority=50), c)
+        pending = [PodSpec("hi", requests=req(1000, 2048), priority=100)]
+        prob = encode(pending, catalog)
+        victims = encode_victims(cluster, catalog)
+        return cluster, pending, prob, victims
+
+    def test_planner_output_validates_clean(self, catalog):
+        cluster, pending, prob, victims = self._world(catalog)
+        plan = PreemptionPlanner().plan(prob, victims)
+        assert plan.placements
+        assert validate_preemption_plan(plan, pending, cluster,
+                                        catalog) == []
+
+    def test_inversion_flagged(self, catalog):
+        """Recompute-from-placements catches a victim whose eviction
+        served nobody higher: the stamp claims beneficiary 100, but the
+        only pod actually placed on the claim is prio 20."""
+        cluster, pending, prob, victims = self._world(catalog)
+        plan = PreemptionPlan()
+        plan.evictions.append(Eviction("c1", "default/mid", 50, 100))
+        weak = PodSpec("weak", requests=req(100), priority=20)
+        plan.placements["default/weak"] = "c1"
+        errs = " ".join(validate_preemption_plan(
+            plan, [weak], cluster, catalog))
+        assert "prio 50" in errs and "placed max prio 20" in errs
+
+    def test_slack_rider_beside_served_eviction_is_valid(self, catalog):
+        """A lower-priority pod riding leftover slack on a claim whose
+        evictions served a HIGHER-priority placement is legitimate —
+        the max-based recompute must not reject it."""
+        cluster, pending, prob, victims = self._world(catalog)
+        plan = PreemptionPlanner().plan(prob, victims)
+        assert [e.pod_key for e in plan.evictions] == ["default/lo"]
+        rider = PodSpec("rider", requests=req(100, 256), priority=20)
+        plan.placements["default/rider"] = "c1"
+        assert validate_preemption_plan(
+            plan, pending + [rider], cluster, catalog) == []
+
+    def test_eviction_of_absent_pod_flagged(self, catalog):
+        cluster, pending, prob, victims = self._world(catalog)
+        plan = PreemptionPlanner().plan(prob, victims)
+        plan.evictions.append(Eviction("c1", "default/ghost", 0, 100))
+        errs = " ".join(validate_preemption_plan(
+            plan, pending, cluster, catalog))
+        assert "pod not on claim" in errs
+
+    def test_capacity_overflow_flagged(self, catalog):
+        cluster, pending, prob, victims = self._world(catalog)
+        plan = PreemptionPlan()
+        # no evictions, yet three 1000-milli pods onto the nearly-full c1
+        pending3 = make_pods(3, "hog", requests=req(1000, 1024),
+                             priority=100)
+        for p in pending3:
+            plan.placements[pod_key(p)] = "c1"
+        errs = " ".join(validate_preemption_plan(
+            plan, pending3, cluster, catalog))
+        assert "capacity exceeded" in errs
+
+    def test_pointless_eviction_flagged(self, catalog):
+        cluster, pending, prob, victims = self._world(catalog)
+        plan = PreemptionPlan()
+        plan.evictions.append(Eviction("c1", "default/lo", 0, 100))
+        errs = " ".join(validate_preemption_plan(
+            plan, pending, cluster, catalog))
+        assert "serves no placement" in errs
+
+    def test_unknown_claim_flagged(self, catalog):
+        cluster, pending, prob, victims = self._world(catalog)
+        plan = PreemptionPlan()
+        plan.placements["default/hi"] = "nowhere"
+        errs = " ".join(validate_preemption_plan(
+            plan, pending, cluster, catalog))
+        assert "unknown claim" in errs
+
+
+# ---------------------------------------------------------------------------
+# PreemptionController: execution, budgets, events
+# ---------------------------------------------------------------------------
+
+def ready_nodeclass(name="default") -> NodeClass:
+    nc = NodeClass(name=name, spec=NodeClassSpec(
+        region="us-south", image="img-1", vpc="vpc-1",
+        instance_requirements=InstanceRequirements(min_cpu=2),
+        placement_strategy=PlacementStrategy()))
+    nc.status.resolved_image_id = "img-1"
+    nc.status.set_condition("Ready", "True", "Test")
+    return nc
+
+
+@pytest.fixture()
+def rig():
+    cloud = FakeCloud()
+    pricing = PricingProvider(cloud)
+    unavail = UnavailableOfferings()
+    itp = InstanceTypeProvider(cloud, pricing, unavail)
+    cluster = ClusterState()
+    cluster.add_nodeclass(ready_nodeclass())
+    actuator = Actuator(cloud, cluster, unavailable=unavail)
+    prov = Provisioner(cluster, itp, actuator, ProvisionerOptions(
+        solver=SolverOptions(backend="greedy")))
+    yield cluster, prov
+    pricing.close()
+
+
+class TestPreemptionController:
+    def test_executes_plan_and_repends_victims(self, rig):
+        cluster, prov = rig
+        c = add_claim(cluster, "c1")
+        lo = PodSpec("lo", requests=req(1500, 4096), priority=0)
+        bind(cluster, lo, c)
+        hi = PodSpec("hi", requests=req(1000, 2048), priority=100)
+        pend(cluster, hi)
+        before = metrics.PREEMPTIONS.get("priority")
+        ctrl = PreemptionController(cluster, prov, min_pending_age=0.0)
+        ctrl.reconcile()
+        victim = cluster.get("pods", "default/lo")
+        assert victim.bound_node == "" and victim.nominated_node == ""
+        assert victim.enqueued_at == 0.0
+        beneficiary = cluster.get("pods", "default/hi")
+        assert beneficiary.nominated_node == "c1"
+        assert metrics.PREEMPTIONS.get("priority") == before + 1
+        assert [r.pod_key for r in ctrl.eviction_log] == ["default/lo"]
+        assert ctrl.preempted_keys == {"default/lo"}
+        reasons = [e.reason for e in cluster.events_for("Pod", "default/lo")]
+        assert "Preempted" in reasons
+        reasons_hi = [e.reason
+                      for e in cluster.events_for("Pod", "default/hi")]
+        assert "PreemptionPlaced" in reasons_hi
+
+    def test_budget_zero_disables_pool(self, rig):
+        cluster, prov = rig
+        cluster.add_nodepool(NodePool(name="default",
+                                      nodeclass_name="default",
+                                      preemption_budget=0))
+        c = add_claim(cluster, "c1", pool="default")
+        bind(cluster, PodSpec("lo", requests=req(1500, 4096), priority=0), c)
+        pend(cluster, PodSpec("hi", requests=req(1000, 2048), priority=100))
+        ctrl = PreemptionController(cluster, prov, min_pending_age=0.0)
+        ctrl.reconcile()
+        assert cluster.get("pods", "default/lo").bound_node
+        assert not cluster.get("pods", "default/hi").nominated_node
+        assert not ctrl.eviction_log
+
+    def test_budget_limits_evictions_per_round(self, rig):
+        cluster, prov = rig
+        cluster.add_nodepool(NodePool(name="default",
+                                      nodeclass_name="default",
+                                      preemption_budget=1))
+        for i in range(2):
+            c = add_claim(cluster, f"c{i}", pool="default")
+            bind(cluster, PodSpec(f"lo{i}", requests=req(1500, 4096),
+                                  priority=0), c)
+        for p in make_pods(2, "hi", requests=req(1000, 2048), priority=100):
+            pend(cluster, p)
+        ctrl = PreemptionController(cluster, prov, min_pending_age=0.0)
+        ctrl.reconcile()
+        assert len(ctrl.eviction_log) == 1
+
+    def test_no_stranded_pods_is_a_noop(self, rig):
+        cluster, prov = rig
+        c = add_claim(cluster, "c1")
+        bind(cluster, PodSpec("lo", requests=req(500), priority=0), c)
+        ctrl = PreemptionController(cluster, prov, min_pending_age=0.0)
+        ctrl.reconcile()
+        assert not ctrl.eviction_log
+
+    def test_pending_age_gate_survives_enqueued_restamps(self, rig):
+        """Age comes from the controller's OWN first-seen stamps: the
+        provisioner's retry ticker restamps enqueued_at every interval,
+        so keying on it could starve the plane forever."""
+        cluster, prov = rig
+        c = add_claim(cluster, "c1")
+        bind(cluster, PodSpec("lo", requests=req(1500, 4096), priority=0), c)
+        p = cluster.add_pod(PodSpec("hi", requests=req(1000, 2048),
+                                    priority=100))
+        clock = {"t": 1000.0}
+        ctrl = PreemptionController(cluster, prov,
+                                    clock=lambda: clock["t"],
+                                    min_pending_age=5.0)
+        ctrl.reconcile()              # stamps first-seen, too young
+        assert list(ctrl.eviction_log) == []
+        clock["t"] += 4.0
+        p.enqueued_at = clock["t"]    # retry ticker restamp mid-wait
+        ctrl.reconcile()
+        assert list(ctrl.eviction_log) == []
+        clock["t"] += 2.0             # 6 s since FIRST seen: past gate
+        p.enqueued_at = clock["t"]    # restamp again; must not matter
+        ctrl.reconcile()
+        assert [r.pod_key for r in ctrl.eviction_log] == ["default/lo"]
+
+    def test_customized_default_nodepool_still_preempts(self):
+        """Pool resolution comes from the provisioner: a customized
+        options.default_nodepool must not dead-end the plane."""
+        cloud = FakeCloud()
+        pricing = PricingProvider(cloud)
+        try:
+            unavail = UnavailableOfferings()
+            itp = InstanceTypeProvider(cloud, pricing, unavail)
+            cluster = ClusterState()
+            cluster.add_nodeclass(ready_nodeclass())
+            actuator = Actuator(cloud, cluster, unavailable=unavail)
+            prov = Provisioner(cluster, itp, actuator, ProvisionerOptions(
+                solver=SolverOptions(backend="greedy"),
+                default_nodepool="custom"))
+            c = add_claim(cluster, "c1", pool="custom")
+            bind(cluster, PodSpec("lo", requests=req(1500, 4096),
+                                  priority=0), c)
+            pend(cluster, PodSpec("hi", requests=req(1000, 2048),
+                                  priority=100))
+            ctrl = PreemptionController(cluster, prov, min_pending_age=0.0)
+            ctrl.reconcile()
+            assert [r.pod_key for r in ctrl.eviction_log] == ["default/lo"]
+        finally:
+            pricing.close()
+
+    def test_never_evicts_for_equal_priority(self, rig):
+        cluster, prov = rig
+        c = add_claim(cluster, "c1")
+        bind(cluster, PodSpec("lo", requests=req(1500, 4096), priority=7), c)
+        pend(cluster, PodSpec("same", requests=req(1000, 2048), priority=7))
+        ctrl = PreemptionController(cluster, prov, min_pending_age=0.0)
+        ctrl.reconcile()
+        assert not ctrl.eviction_log
+        assert cluster.get("pods", "default/lo").bound_node
+
+
+# ---------------------------------------------------------------------------
+# Priority parsing (strictness the whole plane leans on)
+# ---------------------------------------------------------------------------
+
+class TestPodSpecPriorityValidation:
+    def test_constructor_validates(self):
+        assert PodSpec("p", priority=None).priority == 0
+        assert PodSpec("p", priority=10 ** 9 + 5).priority == 10 ** 9
+        with pytest.raises(ValueError):
+            PodSpec("p", priority="100")
+
+    def test_priority_in_constraint_signature(self):
+        a = PodSpec("a", requests=req(500), priority=0)
+        b = PodSpec("b", requests=req(500), priority=1)
+        c = PodSpec("c", requests=req(500), priority=1)
+        assert a.constraint_signature() != b.constraint_signature()
+        assert b.constraint_signature() == c.constraint_signature()

@@ -26,6 +26,7 @@ DEFAULT_BASELINE = Path(__file__).resolve().parent / "baseline.json"
 DEFAULT_TARGETS = (
     "karpenter_tpu/solver",
     "karpenter_tpu/parallel",
+    "karpenter_tpu/preempt",
     "karpenter_tpu/native.py",
     "bench.py",
     "karpenter_tpu/controllers",

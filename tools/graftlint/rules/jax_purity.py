@@ -20,6 +20,8 @@ FAMILY_A_SCOPE = (
     "karpenter_tpu/solver/**/*",
     "karpenter_tpu/parallel/*",
     "karpenter_tpu/parallel/**/*",
+    "karpenter_tpu/preempt/*",
+    "karpenter_tpu/preempt/**/*",
     "karpenter_tpu/native.py",
     "bench.py",
 )

@@ -2,15 +2,18 @@
 
 Starts a REAL operator (fake cloud, greedy solver) with the metrics
 server enabled, drives one provisioning wave so the flight recorder has
-traces, then hits ``/metrics``, ``/statusz``, and ``/debug/traces``
-over actual HTTP and fails on:
+traces plus one demo preemption cycle (a low-priority pod yields its
+node to a stranded high-priority pod), then hits ``/metrics``,
+``/statusz``, and ``/debug/traces`` over actual HTTP and fails on:
 
 - any non-200 status,
 - ``/metrics`` missing the Prometheus content type
   (``text/plain; version=0.0.4; charset=utf-8``), the ``build_info``
-  identity gauge, or the ``solve_phase`` family,
+  identity gauge, the ``solve_phase`` family, or the
+  ``karpenter_tpu_preemption*`` families the demo cycle must emit,
 - ``/statusz`` or ``/debug/traces`` payloads that don't parse as JSON
-  or are missing their contract keys.
+  or are missing their contract keys (including the retained
+  ``preempt.plan`` trace).
 
 Run locally: ``JAX_PLATFORMS=cpu python tools/smoke_debug_surface.py``.
 Exit codes: 0 ok, 1 any check failed.
@@ -86,6 +89,44 @@ def main() -> int:
         check(all(p.nominated_node for p in op.cluster.pending_pods()),
               "provisioning wave resolved (traces recorded)")
 
+        # demo preemption cycle: a full node whose low-priority pod must
+        # yield to a stranded high-priority pod — sized so NO wave claim
+        # can host the beneficiary (7000m only fits the prey node even
+        # with every wave victim evicted), and the cloud quota clamped
+        # so the live operator's async solve window cannot race us by
+        # CREATING capacity for it.  Exercises preempt.plan/
+        # preempt.evict spans and the karpenter_tpu_preemption* families
+        # asserted below.
+        print("demo preemption cycle")
+        from karpenter_tpu.apis.nodeclaim import NodeClaim
+        from karpenter_tpu.apis.pod import PodSpec
+        from karpenter_tpu.controllers.preemption import PreemptionController
+
+        saved_quota = op.cloud.instance_quota
+        op.cloud.instance_quota = op.cloud.instance_count()
+        prey = NodeClaim(
+            name="smoke-prey", nodeclass_name="default",
+            instance_type="bx2-8x32", zone="us-south-1",
+            node_name="node-smoke-prey", launched=True)
+        op.cluster.add_nodeclaim(prey)
+        op.cluster.add_pod(PodSpec(
+            "smoke-lo", requests=ResourceRequests(7000, 16384, 0, 1),
+            priority=0))
+        op.cluster.bind_pod("default/smoke-lo", "node-smoke-prey")
+        hi = op.cluster.add_pod(PodSpec(
+            "smoke-hi", requests=ResourceRequests(7000, 16384, 0, 1),
+            priority=100))
+        hi.enqueued_at = 0.0
+        pc = PreemptionController(op.cluster, op.provisioner,
+                                  min_pending_age=0.0)
+        pc.reconcile()
+        op.cloud.instance_quota = saved_quota
+        check([r.pod_key for r in pc.eviction_log] == ["default/smoke-lo"],
+              "demo preemption evicted the low-priority pod")
+        check(op.cluster.get("pods", "default/smoke-hi").nominated_node
+              == "smoke-prey",
+              "beneficiary nominated onto the freed node")
+
         print("GET /metrics")
         status, ctype, body = _get(port, "/metrics")
         check(status == 200, f"/metrics status 200 (got {status})")
@@ -97,6 +138,12 @@ def main() -> int:
         check("karpenter_tpu_solve_phase_seconds" in text
               or "greedy" == op.options.solver.backend,
               "solve_phase family present (jax backend only)")
+        check('karpenter_tpu_preemptions_total{reason="priority"} 1'
+              in text, "preemptions_total counted the demo eviction")
+        check("karpenter_tpu_preemption_candidates" in text,
+              "preemption candidate histogram rendered")
+        check("karpenter_tpu_preemption_plan_seconds" in text,
+              "preemption plan-latency histogram rendered")
 
         print("GET /statusz")
         status, ctype, body = _get(port, "/statusz")
@@ -125,6 +172,9 @@ def main() -> int:
         check(any(r.startswith("batch.window") or r == "provision.cycle"
                   for r in roots),
               f"a provisioning trace is retained (roots={sorted(roots)})")
+        check("preempt.plan" in roots,
+              f"the demo preemption trace is retained "
+              f"(roots={sorted(roots)})")
     finally:
         op.stop()
 
