@@ -13,6 +13,7 @@ import re
 import threading
 from dataclasses import dataclass, field
 
+from karpenter_tpu.apis.podgroup import PodGroup
 from karpenter_tpu.apis.requirements import Requirements
 
 # Resource axis order used by every dense tensor in the system.
@@ -216,9 +217,16 @@ class PodSpec:
     # PodSpec in the system carries an in-bounds int, so the solver's
     # group_prio tensor and the no-inversion checks never re-validate.
     priority: int = 0
+    # gang membership (apis/podgroup.py): members of one PodGroup place
+    # all-or-nothing, optionally on a contiguous torus slice.  None =
+    # ordinary per-pod scheduling.  Strictly a PodGroup or None — a
+    # malformed gang spec must fail at construction, not place per-pod.
+    gang: PodGroup | None = None
 
     def __post_init__(self):
         object.__setattr__(self, "priority", parse_priority(self.priority))
+        if self.gang is not None and not isinstance(self.gang, PodGroup):
+            raise ValueError(f"bad gang {self.gang!r}: must be a PodGroup")
 
     def scheduling_requirements(self) -> Requirements:
         reqs = Requirements.from_selector(dict(self.node_selector))
@@ -265,6 +273,9 @@ class PodSpec:
             # priority splits groups: pods of different priorities are NOT
             # interchangeable once the preemption plane ranks them
             self.priority,
+            # gang splits groups the same way: members place atomically,
+            # so a member and a lookalike singleton must never share a row
+            self.gang.signature() if self.gang is not None else None,
             tuple(sorted(self.labels)) if self.labels else (),
             tuple(sorted(self.node_selector)) if self.node_selector else (),
             tuple(sorted(r.signature for r in self.required_requirements))

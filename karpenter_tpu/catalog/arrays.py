@@ -61,6 +61,10 @@ class CatalogArrays:
     archs: list[str]
     families: list[str]
     sizes: list[str]
+    # per-type accelerator torus dims (gang slice placement;
+    # gang/topology.py lowers these to placement bitmask tables).  Host
+    # list, not a device tensor: only the gang encoder consumes it.
+    type_torus: list[tuple[int, ...]] = field(default_factory=list)
     # provenance
     generation: int = 0
     availability_generation: object = None
@@ -114,6 +118,7 @@ class CatalogArrays:
             off_price=np.asarray(off_price, dtype=np.float32),
             off_avail=np.asarray(off_avail, dtype=bool),
             zones=zones, archs=archs, families=families, sizes=sizes,
+            type_torus=[it.torus_dims for it in instance_types],
             generation=generation, uid=next(_uid_counter),
             _offering_index=offering_index,
         )

@@ -15,6 +15,7 @@ Capability parity with ``pkg/providers/common/instancetype/instancetype.go``:
 
 from __future__ import annotations
 
+import math
 import re
 from dataclasses import dataclass, field
 from collections.abc import Sequence
@@ -57,6 +58,34 @@ def pods_capacity(cpu: int) -> int:
     return 110
 
 
+def default_torus(chips: int) -> tuple[int, ...]:
+    """Deterministic torus dims for a type exposing ``chips``
+    accelerators, following real TPU slice geometry: perfect-square
+    power-of-two counts are 2-D meshes (4 -> (2, 2), 16 -> (4, 4),
+    64 -> (8, 8) — the v5e shapes), other powers of two factor into
+    <= 3 near-cubic axes largest-first (8 -> (2, 2, 2), 32 -> (4, 4, 2)),
+    and non-power-of-two counts fall back to a 1-D ring.  The gang
+    plane's topology layer (gang/topology.py) enumerates contiguous
+    sub-slices against these dims; a type with no accelerators has no
+    torus and can never host a slice-shaped gang."""
+    if chips <= 0:
+        return ()
+    if chips & (chips - 1):          # not a power of two: 1-D ring
+        return (chips,)
+    root = math.isqrt(chips)
+    if root * root == chips and root >= 2:
+        return (root, root)
+    dims = [1, 1, 1]
+    i = 0
+    n = chips
+    while n > 1:
+        dims[i % 3] *= 2
+        n //= 2
+        i += 1
+    dims = sorted((d for d in dims if d > 1), reverse=True)
+    return tuple(dims) if dims else (1,)
+
+
 @dataclass(frozen=True)
 class Offering:
     zone: str
@@ -81,6 +110,13 @@ class InstanceType:
     # overhead (reserved out of capacity before pods fit)
     overhead_cpu_milli: int = 0
     overhead_memory_mib: int = 0
+    # accelerator torus dims (gang slice placement); None = derive from
+    # the accelerator count via default_torus(), () = no torus
+    torus: tuple[int, ...] | None = None
+
+    @property
+    def torus_dims(self) -> tuple[int, ...]:
+        return self.torus if self.torus is not None else default_torus(self.gpu)
 
     @property
     def allocatable_cpu_milli(self) -> int:
@@ -282,4 +318,4 @@ class InstanceTypeProvider:
             gpu=it.gpu, pods=it.pods, architecture=it.architecture,
             family=it.family, size=it.size, offerings=offerings,
             overhead_cpu_milli=it.overhead_cpu_milli,
-            overhead_memory_mib=it.overhead_memory_mib)
+            overhead_memory_mib=it.overhead_memory_mib, torus=it.torus)

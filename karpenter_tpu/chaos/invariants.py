@@ -31,6 +31,19 @@ PreemptionController):
 - ``preempted-pods-resolve`` (final): every pod the preemption plane
   ever evicted is bound again after quiesce (or provably unplaceable) —
   eviction may delay a low-priority pod, never strand it.
+
+Gang-plane invariants (armed when the harness runs a
+GangAdmissionController):
+
+- ``no-partial-gang-placed`` (round): every executed gang placement
+  carried the gang's FULL pending membership (>= min_member, no
+  duplicates) — checked against the controller's ground-truth placement
+  log, drained per round;
+- ``gangs-resolve-or-release`` (final): after quiesce no pod still
+  carries a gang and sits unbound — every gang either placed whole, or
+  was deadline-released to per-pod scheduling (whose members the
+  ordinary pods-resolve invariant then covers), or is provably
+  unplaceable (no offering fits a member / no torus hosts the slice).
 """
 
 from __future__ import annotations
@@ -56,7 +69,8 @@ class InvariantChecker:
     def __init__(self, cluster, cloud, unavailable, *,
                  orphan_grace: float, stuck_claim_grace: float,
                  solver_violations: list[str] | None = None,
-                 trace: EventTrace | None = None, preemption=None):
+                 trace: EventTrace | None = None, preemption=None,
+                 gang=None):
         self.cluster = cluster
         self.cloud = cloud              # ground truth: the UNWRAPPED fake
         self.unavailable = unavailable
@@ -69,6 +83,9 @@ class InvariantChecker:
         # the harness's PreemptionController (or None): its eviction_log
         # / preempted_keys are the preemption invariants' ground truth
         self.preemption = preemption
+        # the harness's GangAdmissionController (or None): its
+        # placement_log / released set back the gang invariants
+        self.gang = gang
 
     # -- round invariants ----------------------------------------------------
 
@@ -78,6 +95,7 @@ class InvariantChecker:
         out.extend(self._no_stuck_claims())
         out.extend(self._solver_plans_valid())
         out.extend(self._no_priority_inversion())
+        out.extend(self._no_partial_gang_placed())
         if self.trace is not None:
             self.trace.add("invariants", phase="round", violations=len(out),
                            kinds=sorted({v.invariant for v in out}))
@@ -147,6 +165,30 @@ class InvariantChecker:
         self.preemption.eviction_log.clear()
         return out
 
+    def _no_partial_gang_placed(self) -> list[Violation]:
+        """Every executed gang placement must have carried the gang's
+        full pending membership, at or above min_member — drained from
+        the controller's log so a violation names the exact gang."""
+        if self.gang is None:
+            return []
+        out = []
+        for rec in self.gang.placement_log:
+            members = set(rec.members)
+            if len(members) != len(rec.members):
+                out.append(Violation(
+                    "no-partial-gang-placed",
+                    f"gang {rec.gang} placement on {rec.claim_name} "
+                    f"repeats members"))
+            if len(members) < rec.total_members \
+                    or len(members) < rec.min_member:
+                out.append(Violation(
+                    "no-partial-gang-placed",
+                    f"gang {rec.gang} placed {len(members)}/"
+                    f"{rec.total_members} members (min_member "
+                    f"{rec.min_member}) on {rec.claim_name}"))
+        self.gang.placement_log.clear()
+        return out
+
     # -- final (eventual) invariants -----------------------------------------
 
     def check_final(self, catalog=None) -> list[Violation]:
@@ -159,6 +201,7 @@ class InvariantChecker:
                 f"window: {sorted(stale)[:3]}"))
         out.extend(self._pods_resolve(catalog))
         out.extend(self._preempted_pods_resolve(catalog))
+        out.extend(self._gangs_resolve_or_release(catalog))
         if self.trace is not None:
             self.trace.add("invariants", phase="final", violations=len(out),
                            kinds=sorted({v.invariant for v in out}))
@@ -184,6 +227,45 @@ class InvariantChecker:
                 f"after quiesce (nominated="
                 f"{pending.nominated_node or '-'})"))
         return out
+
+    def _gangs_resolve_or_release(self, catalog) -> list[Violation]:
+        """A gang may be delayed, placed, or deadline-released — never
+        parked forever.  After quiesce, any unbound pod still carrying a
+        gang is a violation unless the gang is provably unplaceable
+        (the deadline release strips the gang field, so released
+        members are ordinary pods covered by pods-resolve)."""
+        if self.gang is None:
+            return []
+        by_gang: dict[str, list] = {}
+        for pending in self.cluster.pending_pods():
+            if pending.spec.gang is not None and not pending.bound_node:
+                by_gang.setdefault(pending.spec.gang.name,
+                                   []).append(pending)
+        out = []
+        for name, members in by_gang.items():
+            if catalog is not None \
+                    and not self._gang_placeable(members, catalog):
+                continue
+            for pending in members:
+                spec = pending.spec
+                out.append(Violation(
+                    "gangs-resolve-or-release",
+                    f"pod {spec.namespace}/{spec.name} of gang {name} "
+                    f"still unbound and unreleased after quiesce "
+                    f"(nominated={pending.nominated_node or '-'})"))
+        return out
+
+    @staticmethod
+    def _gang_placeable(members, catalog) -> bool:
+        """Can this gang conceivably place WHOLE: the real gang encoder
+        answers exactly — some offering must be label-compatible, host
+        the slice shape's torus, AND fit the gang's TOTAL member demand
+        on one empty node (a per-member under-approximation here would
+        flag correct systems for gangs that genuinely cannot place)."""
+        from karpenter_tpu.gang.encode import encode_gangs
+
+        problem = encode_gangs([p.spec for p in members], catalog)
+        return bool(problem.num_gangs and problem.compat.any())
 
     def _pods_resolve(self, catalog) -> list[Violation]:
         out = []

@@ -61,6 +61,17 @@ class ChaosProfile:
     # priority from this menu (seeded world stream) — the preemption
     # plane's workload shape (overload profile)
     pod_priorities: tuple[int, ...] = ()
+    # gang workload shaping (gang profile): probability a wave arrives
+    # as a PodGroup, the member-count menu, and the slice-shape menu
+    # ("" = gang without topology demand).  gang_stagger_rate makes some
+    # gang waves arrive split across two rounds (exercises parking);
+    # gang_starve_rate drops the second half entirely (exercises the
+    # deadline release + degraded per-pod fallback).
+    gang_wave_rate: float = 0.0
+    gang_sizes: tuple[int, ...] = (4, 8)
+    gang_slice_shapes: tuple[str, ...] = ("",)
+    gang_stagger_rate: float = 0.0
+    gang_starve_rate: float = 0.0
     # global live-instance cap imposed on the fake cloud for the chaos
     # window (0 = unlimited); lifts at quiesce.  Demand past the cap is
     # genuine overload: creates fail with quota_exceeded and pending
@@ -148,6 +159,20 @@ PROFILES: dict[str, ChaosProfile] = _profiles(
         pod_waves=6, pods_per_wave=(10, 30),
         capacity_blackout_rate=0.40, capacity_blackout_rounds=3,
         preempt_storm_rate=0.30, preempt_storm_frac=0.40,
+        error_rates={"create_instance": 0.10}),
+    ChaosProfile(
+        name="gang",
+        description="mixed gang/singleton backlog (staggered and starved "
+                    "gangs included) + capacity blackouts + spot storms — "
+                    "gangs must place atomically (no partial gang ever "
+                    "nominated) and every gang must resolve or be "
+                    "deadline-released to per-pod scheduling",
+        gang_wave_rate=0.6, gang_sizes=(4, 6, 8),
+        gang_slice_shapes=("", "2x2", "2x2x2"),
+        gang_stagger_rate=0.35, gang_starve_rate=0.25,
+        pod_waves=6, pods_per_wave=(4, 12),
+        capacity_blackout_rate=0.35, capacity_blackout_rounds=3,
+        preempt_storm_rate=0.25, preempt_storm_frac=0.40,
         error_rates={"create_instance": 0.10}),
 )
 

@@ -25,6 +25,7 @@ from karpenter_tpu.apis.nodeclass import (
     InstanceRequirements, NodeClass, NodeClassSpec, PlacementStrategy,
 )
 from karpenter_tpu.apis.pod import ResourceRequests, make_pods
+from karpenter_tpu.apis.podgroup import PodGroup
 from karpenter_tpu.catalog.instancetype import InstanceTypeProvider
 from karpenter_tpu.catalog.pricing import PricingProvider
 from karpenter_tpu.catalog.unavailable import UnavailableOfferings
@@ -43,6 +44,7 @@ from karpenter_tpu.controllers.nodeclaim import (
     GarbageCollectionController, NodeClaimTerminationController,
     RegistrationController, StartupTaintController, TaggingController,
 )
+from karpenter_tpu.controllers.gang import GangAdmissionController
 from karpenter_tpu.controllers.preemption import PreemptionController
 from karpenter_tpu.controllers.runtime import ControllerManager
 from karpenter_tpu.core.actuator import Actuator
@@ -115,7 +117,19 @@ class ChaosHarness:
         profile, seed = self.profile, self.seed
         self.clock = VirtualClock()
         self.trace = EventTrace()
-        self.fake = FakeCloud(region="us-south")
+        # gang profiles need accelerator types (torus dims for slice
+        # placement); other profiles keep the default catalog so their
+        # schedules are untouched
+        gang_profiles = None
+        if profile.gang_wave_rate:
+            from karpenter_tpu.cloud.fake import generate_profiles
+
+            # gx3 first: the ladder is truncated at 24 types, and the
+            # accelerator family must reach the big-chip rungs (a 2x2x2
+            # slice needs an 8-chip torus, i.e. a 64-cpu gx3)
+            gang_profiles = generate_profiles(
+                24, families=("gx3", "bx2", "cx2"))
+        self.fake = FakeCloud(region="us-south", profiles=gang_profiles)
         self.chaos_cloud = ChaosCloud(
             self.fake, profile,
             random.Random(f"{profile.name}:{seed}:cloud"),
@@ -166,6 +180,12 @@ class ChaosHarness:
         # still-unnominated pod HAS had its create chance this round
         self.preemption = PreemptionController(
             self.cluster, self.provisioner, min_pending_age=0.0)
+        # gang plane on the virtual clock; registers the provisioner's
+        # admission gate (parks sub-min_member + slice gangs)
+        self.gang = GangAdmissionController(
+            self.cluster, self.provisioner, clock=self.clock.time)
+        self._gang_backlog: list[tuple[int, list]] = []   # (round, pods)
+        self._gang_seq = 0
         self.kubelet = FakeKubelet(self.cluster, self.fake)
         self.manager = ControllerManager(self.cluster)
         for ctrl in self._controllers():
@@ -182,7 +202,9 @@ class ChaosHarness:
                                + 2 * max(self.step, self.quiesce_step) + 60.0),
             solver_violations=self.solver.violations, trace=self.trace,
             preemption=self.preemption
-            if "preemption" not in profile.disable_controllers else None)
+            if "preemption" not in profile.disable_controllers else None,
+            gang=self.gang
+            if "gang" not in profile.disable_controllers else None)
         # warm the catalog before chaos arms (pricing resolution happens
         # here, outside the deterministic traced window)
         self.catalog_provider.list(nc)
@@ -201,6 +223,7 @@ class ChaosHarness:
             OrphanCleanupController(self.cluster, self.chaos_cloud,
                                     enabled=True),
             self.preemption,
+            self.gang,
         ]
 
     # -- round loop ----------------------------------------------------------
@@ -253,6 +276,15 @@ class ChaosHarness:
         return round(self.clock.time() - self._t0, 3)
 
     def _inject_pods(self, round_no: int) -> None:
+        # staggered gang remainders land first (their arrival round came)
+        due = [(r, pods) for r, pods in self._gang_backlog if r <= round_no]
+        self._gang_backlog = [(r, pods) for r, pods in self._gang_backlog
+                              if r > round_no]
+        for _, pods in due:
+            for pod in pods:
+                self.cluster.add_pod(pod)
+            self.trace.add("workload", shape="gang-remainder",
+                           gang=pods[0].gang.name, pods=len(pods))
         if round_no >= self.profile.pod_waves:
             return
         lo, hi = self.profile.pods_per_wave
@@ -260,6 +292,10 @@ class ChaosHarness:
         cpu, mem = _POD_SIZES[self.rng_world.randrange(len(_POD_SIZES))]
         menu = self.profile.pod_priorities
         prio = menu[self.rng_world.randrange(len(menu))] if menu else 0
+        if self.profile.gang_wave_rate \
+                and self.rng_world.random() < self.profile.gang_wave_rate:
+            self._inject_gang(round_no, prio)
+            return
         for pod in make_pods(n, name_prefix=f"wave{round_no}",
                              requests=ResourceRequests(cpu, mem, 0, 1),
                              priority=prio):
@@ -270,6 +306,43 @@ class ChaosHarness:
                     priority=prio)
         self.trace.add("workload", wave=round_no, pods=n, cpu=cpu, mem=mem,
                        priority=prio)
+
+    def _inject_gang(self, round_no: int, prio: int) -> None:
+        """One gang wave: full, staggered over two rounds, or starved
+        (the remainder never arrives — the deadline-release path)."""
+        p = self.profile
+        size = p.gang_sizes[self.rng_world.randrange(len(p.gang_sizes))]
+        shape = p.gang_slice_shapes[
+            self.rng_world.randrange(len(p.gang_slice_shapes))]
+        self._gang_seq += 1
+        name = f"gang-{self._gang_seq}"
+        # deadline sized in scenario rounds: long enough for a staggered
+        # remainder (next round) to beat it, short enough that a starved
+        # gang releases well inside the chaos window
+        gang = PodGroup(name=name, min_member=size,
+                        slice_shape=shape or None,
+                        deadline_seconds=2.5 * self.step)
+        # members sized small so a full gang fits one accelerator node
+        pods = make_pods(size, name_prefix=name,
+                         requests=ResourceRequests(250, 512, 0, 1),
+                         priority=prio, gang=gang)
+        arrive_now = pods
+        mode = "full"
+        if self.rng_world.random() < p.gang_stagger_rate:
+            half = max(1, size // 2)
+            arrive_now = pods[:half]
+            if self.rng_world.random() < p.gang_starve_rate:
+                mode = "starved"       # remainder never arrives
+            else:
+                mode = "staggered"
+                self._gang_backlog.append((round_no + 1, pods[half:]))
+        for pod in arrive_now:
+            self.cluster.add_pod(pod)
+        obs.instant("pod.event", wave=round_no, gang=name,
+                    pods=len(arrive_now), mode=mode)
+        self.trace.add("workload", wave=round_no, shape="gang", gang=name,
+                       members=size, arrived=len(arrive_now),
+                       slice=shape, mode=mode)
 
     def _pump(self) -> None:
         """One provisioning + continuation + reconcile beat."""
@@ -286,7 +359,9 @@ class ChaosHarness:
             claims=sum(1 for c in self.cluster.nodeclaims() if not c.deleted),
             instances=self.fake.instance_count(),
             blackouts=len(self.unavailable.unavailable_keys()),
-            preempted=len(self.preemption.preempted_keys))
+            preempted=len(self.preemption.preempted_keys),
+            gangs_admitted=len(self.gang.admitted),
+            gangs_released=len(self.gang.released))
 
 
 def run_scenario(profile: ChaosProfile | str, seed: int, *,

@@ -1,0 +1,284 @@
+"""Gang admission controller: parks, admits, places, and releases gangs.
+
+The planning half lives in ``karpenter_tpu/gang`` (pure functions); this
+controller owns the messy parts:
+
+- **parking**: it registers the provisioner's admission gate, so a
+  gang's members never enter a solve window until the gang is admitted
+  (``min_member`` members pending).  Slice-shaped gangs are NEVER
+  released to the ordinary solver — their contiguous-sub-slice contract
+  is invisible to it — and are placed here via the topology-aware
+  planner instead;
+- **first-seen stamps**: gang age is tracked by controller-owned
+  stamps, not ``enqueued_at`` — the provisioner's retry ticker restamps
+  that field every interval, which would make a parked gang look
+  forever-young and never hit its deadline (the same lesson the
+  preemption controller learned);
+- **admission**: once ``min_member`` members are pending the gang is
+  admitted (``gang.admit`` span, event, metrics); non-slice gangs are
+  re-windowed immediately and the gang-aware solver places them
+  atomically;
+- **slice placement**: admitted slice gangs are planned per NodePool
+  under the solve lock (``gang.place`` span), validated by the
+  independent ``validate_gang_plan`` oracle — an invalid plan is
+  dropped with an ERRORS breadcrumb, never actuated — and executed
+  through the same actuator path the provisioner uses.  A failed create
+  nominates NOBODY (one node per gang), so atomicity survives partial
+  actuation;
+- **deadline release**: a gang still unplaced past its
+  ``deadline_seconds`` is released with a degraded per-pod fallback —
+  members lose their gang field and re-enter the queue as ordinary
+  pods — plus an ``ERRORS{gang, deadline_release}`` breadcrumb and a
+  Warning event (a parked-forever gang is a deadlocked job; per-pod
+  capacity at least lets the operator see it running partially);
+- **evidence**: ``gang.admit``/``gang.place`` spans,
+  ``karpenter_tpu_gang_*`` metric families, and a ``placement_log`` the
+  chaos invariants drain (no-partial-gang-placed,
+  gangs-resolve-or-release).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from collections import deque
+from dataclasses import dataclass
+
+from karpenter_tpu.apis.pod import PodSpec, pod_key
+from karpenter_tpu.controllers.runtime import PollController, Result
+from karpenter_tpu.core.cluster import ClusterState
+from karpenter_tpu.gang.degraded import ResilientGangPlanner
+from karpenter_tpu.gang.encode import encode_gangs
+from karpenter_tpu.gang.types import GangOptions
+from karpenter_tpu.solver.validate import validate_gang_plan
+from karpenter_tpu import obs
+from karpenter_tpu.utils import metrics
+from karpenter_tpu.utils.logging import get_logger
+
+log = get_logger("controllers.gang")
+
+
+@dataclass(frozen=True)
+class GangPlacementRecord:
+    """One executed gang placement — the chaos invariants' ground truth."""
+
+    gang: str
+    claim_name: str
+    members: tuple[str, ...]
+    total_members: int
+    min_member: int
+    backend: str
+
+
+class GangAdmissionController(PollController):
+    """Singleton poller: admit, place, or release pending gangs."""
+
+    name = "gang"
+    interval = 5.0
+
+    def __init__(self, cluster: ClusterState, provisioner,
+                 options: GangOptions | None = None, clock=time.time):
+        self.cluster = cluster
+        self.provisioner = provisioner
+        self.options = options or GangOptions()
+        self.planner = ResilientGangPlanner(options=self.options)
+        self.clock = clock
+        # controller-owned first-seen stamps (see module docstring)
+        self._first_seen: dict[str, float] = {}
+        self.admitted: set[str] = set()
+        # gangs released to per-pod scheduling by the deadline fallback
+        # — insertion-ordered and FIFO-bounded like placement_log: the
+        # release strips members' gang fields, so nothing ever prunes
+        # entries by reference and an unbounded set would leak one name
+        # per released gang for the process lifetime
+        self.released: dict[str, None] = {}
+        self._released_max = 4096
+        # executed-placement evidence, drained per chaos round and
+        # bounded for the operator path where nothing drains it
+        self.placement_log: deque[GangPlacementRecord] = deque(maxlen=4096)
+        if provisioner is not None:
+            provisioner.admission = self.admit
+
+    # -- the provision-queue gate -----------------------------------------
+
+    def admit(self, spec: PodSpec) -> bool:
+        """May this pod enter an ordinary solve window?  Non-gang pods
+        always; slice gangs never (the topology planner owns them);
+        other gangs once admitted."""
+        gang = spec.gang
+        if gang is None:
+            return True
+        if gang.slice_shape:
+            return False
+        return gang.name in self.admitted
+
+    # -- reconcile ---------------------------------------------------------
+
+    def reconcile(self) -> Result:
+        if self.provisioner is None:
+            return Result()
+        now = self.clock()
+        groups: dict[str, list] = {}
+        for p in self.cluster.pending_pods():
+            if p.spec.gang is not None and not p.bound_node:
+                groups.setdefault(p.spec.gang.name, []).append(p)
+        # prune state for gangs that fully resolved (bound or deleted)
+        for name in list(self._first_seen):
+            if name not in groups:
+                self._first_seen.pop(name, None)
+                self.admitted.discard(name)
+        parked = 0
+        to_place: list[tuple[str, list]] = []
+        for name, members in groups.items():
+            spec = members[0].spec.gang
+            first = self._first_seen.setdefault(name, now)
+            complete = len(members) >= spec.min_member
+            if complete and name not in self.admitted:
+                self.admitted.add(name)
+                metrics.GANG_ADMISSIONS.labels("admitted").inc()
+                metrics.GANG_MEMBERS.observe(len(members))
+                with obs.span("gang.admit", gang=name,
+                              members=len(members),
+                              min_member=spec.min_member,
+                              slice=str(spec.slice_shape or "")):
+                    self.cluster.record_event(
+                        "PodGroup", name, "Normal", "GangAdmitted",
+                        f"{len(members)} members pending "
+                        f"(min_member {spec.min_member})")
+            if name in self.admitted:
+                waiting = [p for p in members if not p.nominated_node]
+                if waiting and now - first >= spec.deadline_seconds:
+                    # admitted but still (even partially) unplaced by
+                    # the deadline: the capacity never fully
+                    # materialized — e.g. one of a spanning gang's
+                    # creates failed, stranding a sub-min_member
+                    # remainder the atomic solver can never place
+                    # alone.  Degrade to per-pod rather than park the
+                    # job forever (nominated members keep their
+                    # nominations; only the gang field is stripped).
+                    self._release(name, members, spec)
+                elif spec.slice_shape:
+                    if waiting:
+                        to_place.append((name, members))
+                else:
+                    # immediate re-window: the admission gate now passes
+                    # these pods; waiting out the retry interval would
+                    # add a whole tick of latency to every admission
+                    for p in waiting:
+                        p.enqueued_at = 0.0
+            elif now - first >= spec.deadline_seconds:
+                self._release(name, members, spec)
+            else:
+                parked += 1
+        metrics.GANG_PARKED.set(parked)
+        if to_place:
+            self._place_slice_gangs(to_place)
+        return Result()
+
+    # -- deadline fallback -------------------------------------------------
+
+    def _release(self, name: str, members: list, spec) -> None:
+        """Degraded per-pod fallback: strip the gang field so members
+        re-enter the queue as ordinary pods."""
+        for p in members:
+            p.spec = dataclasses.replace(p.spec, gang=None)
+            p.enqueued_at = 0.0
+        while len(self.released) >= self._released_max:
+            self.released.pop(next(iter(self.released)))
+        self.released[name] = None
+        self.admitted.discard(name)
+        self._first_seen.pop(name, None)
+        metrics.GANG_ADMISSIONS.labels("released_degraded").inc()
+        metrics.ERRORS.labels("gang", "deadline_release").inc()
+        obs.instant("gang.release", gang=name, members=len(members),
+                    min_member=spec.min_member)
+        self.cluster.record_event(
+            "PodGroup", name, "Warning", "GangReleased",
+            f"deadline {spec.deadline_seconds:.0f}s expired with "
+            f"{len(members)}/{spec.min_member} members; released to "
+            f"per-pod scheduling (degraded)")
+        log.warning("gang released on deadline", gang=name,
+                    members=len(members), min_member=spec.min_member)
+
+    # -- slice placement ---------------------------------------------------
+
+    def _place_slice_gangs(self, gangs: list[tuple[str, list]]) -> None:
+        placed: set[str] = set()
+        for pool in self.provisioner._pools():
+            remaining = [(n, m) for n, m in gangs if n not in placed]
+            if not remaining:
+                break
+            placed.update(self._place_pool(pool, remaining))
+
+    def _place_pool(self, pool, gangs: list[tuple[str, list]]) -> set[str]:
+        nodeclass = self.cluster.get_nodeclass(pool.nodeclass_name) \
+            or self.cluster.get_nodeclass("default")
+        if nodeclass is None:
+            return set()
+        catalog = self.provisioner._catalog_for(nodeclass)
+        if catalog is None:
+            return set()
+        # plan + actuate under the solve lock: a concurrent window
+        # nominating one of these pods would race capacity accounting
+        with self.provisioner._solve_lock:
+            pods = [p.spec for _, members in gangs for p in members
+                    if not p.nominated_node and not p.bound_node]
+            if not pods:
+                return set()
+            t0 = time.perf_counter()
+            with obs.span("gang.place", pool=pool.name,
+                          gangs=len(gangs), pods=len(pods)) as sp:
+                problem = encode_gangs(pods, catalog, pool)
+                plan = self.planner.plan(problem)
+                sp.set("backend", plan.backend)
+                sp.set("nodes", len(plan.nodes))
+                sp.set("gangs_placed", len(plan.placed_gangs))
+                metrics.GANG_PLAN_DURATION.labels(plan.backend).observe(
+                    time.perf_counter() - t0)
+                if plan.empty:
+                    return set()
+                # independent oracle gate: never actuate an invalid plan
+                errors = validate_gang_plan(plan, pods, catalog, pool)
+                if errors:
+                    metrics.ERRORS.labels("gang", "invalid_plan").inc()
+                    sp.set("invalid", len(errors))
+                    log.error("gang plan failed validation; dropped",
+                              pool=pool.name, errors=errors[:3])
+                    return set()
+                return self._execute(plan, pool, nodeclass, catalog,
+                                     problem)
+
+    def _execute(self, plan, pool, nodeclass, catalog, problem) -> set[str]:
+        sizes = {g.name: len(g.pod_names) for g in problem.gangs}
+        mins = {g.name: g.min_member for g in problem.gangs}
+        actuator = self.provisioner.actuator_for(nodeclass)
+        claims, errors = actuator.execute_plan(plan.to_plan(), nodeclass,
+                                               catalog, pool.name)
+        if errors:
+            log.warning("gang plan partially executed", pool=pool.name,
+                        errors=errors[:3])
+        placed: set[str] = set()
+        for node, claim in zip(plan.nodes, claims):
+            if claim is None:
+                continue   # create failed: the gang stays pending whole
+            for a in node.assignments:
+                for pn in a.pod_names:
+                    self.provisioner._nominate(pn, claim.name)
+                # total_members = the gang's pending membership when
+                # planned; the invariant checker compares it against the
+                # members the record actually carried (an assignment row
+                # holds ALL of them by construction — the checker proves
+                # it, never assumes it)
+                self.placement_log.append(GangPlacementRecord(
+                    gang=a.gang, claim_name=claim.name,
+                    members=a.pod_names,
+                    total_members=sizes.get(a.gang, len(a.pod_names)),
+                    min_member=mins.get(a.gang, 0),
+                    backend=plan.backend))
+                metrics.GANG_PLACEMENTS.labels(plan.backend).inc()
+                placed.add(a.gang)
+                self.cluster.record_event(
+                    "PodGroup", a.gang, "Normal", "GangPlaced",
+                    f"{len(a.pod_names)} members on {claim.name} "
+                    f"({node.instance_type}/{node.zone})")
+        return placed

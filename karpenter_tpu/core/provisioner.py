@@ -96,6 +96,12 @@ class Provisioner:
         # provider-wide type->(cpu,mem) fallback for pool-limit
         # accounting (claims whose type left the filtered catalog)
         self._all_type_alloc: dict[str, tuple[int, int]] | None = None
+        # optional admission gate (callable(PodSpec) -> bool): the gang
+        # plane registers one to PARK sub-min_member gangs (and all
+        # slice-shaped gangs, which its topology planner owns) out of
+        # every solve window — held pods stay pending and re-enter via
+        # the retry ticker once admitted (controllers/gang.py)
+        self.admission = None
         self._window: SolveWindow | None = None
         self._unsubscribe = None
 
@@ -227,6 +233,10 @@ class Provisioner:
         """Span-wrapped provisioning cycle: the root of the causal chain
         when invoked synchronously (provision_once, chaos, repair loops);
         under a fired window it nests beneath the batch.window span."""
+        if self.admission is not None:
+            pods = [p for p in pods if self.admission(p)]
+            if not pods:
+                return [], {}
         with obs.span("provision.cycle", pods=len(pods)) as sp:
             plans, nominated = self._provision_pools(pods)
             sp.set("plans", len(plans))

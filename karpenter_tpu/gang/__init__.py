@@ -1,0 +1,32 @@
+"""Gang scheduling + TPU-topology-aware slice placement.
+
+The plane that turns the per-pod packer into an accelerator scheduler
+(docs/design/gang.md): :mod:`apis/podgroup` declares the gang contract,
+:mod:`gang/topology` lowers per-type torus dims into placement bitmask
+tables, :mod:`gang/encode` builds dense gang tensors from pending pods,
+:mod:`gang/planner` places gangs atomically (vectorized grid, optional
+jitted device kernel), :mod:`gang/greedy` is the bit-identical host
+parity oracle, and :mod:`gang/degraded` degrades a failed batched plan
+to greedy instead of stranding the gang.  Execution lives in
+``controllers/gang.py`` behind ``KARPENTER_ENABLE_GANG``.
+"""
+
+from karpenter_tpu.apis.podgroup import PodGroup, parse_slice_shape
+from karpenter_tpu.gang.degraded import ResilientGangPlanner, gang_plan_defects
+from karpenter_tpu.gang.encode import GangInfo, GangProblem, encode_gangs
+from karpenter_tpu.gang.greedy import GreedyGangPlanner
+from karpenter_tpu.gang.planner import GangPlanner
+from karpenter_tpu.gang.topology import (
+    SliceTable, enumerate_placements, slice_table,
+)
+from karpenter_tpu.gang.types import (
+    GangAssignment, GangNode, GangOptions, GangPlan,
+)
+
+__all__ = [
+    "GangAssignment", "GangInfo", "GangNode", "GangOptions", "GangPlan",
+    "GangPlanner", "GangProblem", "GreedyGangPlanner", "PodGroup",
+    "ResilientGangPlanner", "SliceTable", "encode_gangs",
+    "enumerate_placements", "gang_plan_defects", "parse_slice_shape",
+    "slice_table",
+]

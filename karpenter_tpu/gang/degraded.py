@@ -1,0 +1,97 @@
+"""Gang degraded mode: greedy fallback instead of a failed plan.
+
+Mirrors ``solver/degraded.py`` and ``preempt/degraded.py``: the batched
+planner can fail in ways the host loop cannot (a broken device kernel, a
+shape bug in the grid padding).  None of those may stall the gang plane
+while whole jobs sit parked — ``ResilientGangPlanner`` degrades that one
+plan to ``gang/greedy.py`` with an ``ERRORS`` breadcrumb
+(component="gang") and a ``degraded:`` backend tag.
+
+The structural gate is deliberately cheap (O(members + nodes)); full
+feasibility stays with ``validate_gang_plan`` (solver/validate.py),
+which tests and the execution controller run on every plan.
+"""
+
+from __future__ import annotations
+
+from karpenter_tpu.gang.encode import GangProblem
+from karpenter_tpu.gang.greedy import GreedyGangPlanner
+from karpenter_tpu.gang.planner import GangPlanner
+from karpenter_tpu.gang.types import GangOptions, GangPlan
+from karpenter_tpu import obs
+from karpenter_tpu.utils import metrics
+from karpenter_tpu.utils.logging import get_logger
+
+log = get_logger("gang.degraded")
+
+
+def gang_plan_defects(plan: GangPlan, problem: GangProblem) -> list[str]:
+    """Structural sanity of a gang plan (cheap; the full oracle is
+    validate_gang_plan)."""
+    if plan is None:
+        return ["planner returned no plan"]
+    defects: list[str] = []
+    members = {g.name: set(g.pod_names) for g in problem.gangs}
+    placed: dict[str, set[str]] = {}
+    seen: set[str] = set()
+    for node in plan.nodes:
+        if not (0 <= node.offering_index < problem.catalog.num_offerings):
+            defects.append(f"node offering index {node.offering_index} "
+                           f"out of range")
+        for a in node.assignments:
+            for pn in a.pod_names:
+                if pn in seen:
+                    defects.append(f"pod {pn} placed twice")
+                seen.add(pn)
+            placed.setdefault(a.gang, set()).update(a.pod_names)
+    for name, pods in placed.items():
+        want = members.get(name)
+        if want is None:
+            defects.append(f"placement of unknown gang {name}")
+        elif pods != want:
+            # the invariant the whole subsystem exists to uphold: a
+            # partial gang must never even reach the execution gate
+            defects.append(f"partial gang {name}: {len(pods)}/{len(want)} "
+                           f"members placed")
+    for pn in plan.unplaced:
+        if pn in seen:
+            defects.append(f"pod {pn} both placed and unplaced")
+    return defects
+
+
+class ResilientGangPlanner:
+    """Wraps the batched planner; degrades single plans to greedy."""
+
+    def __init__(self, primary: GangPlanner | None = None,
+                 options: GangOptions | None = None):
+        self.options = options or getattr(primary, "options", None) \
+            or GangOptions()
+        self.primary = primary or GangPlanner(self.options)
+        self._fallback = None
+
+    @property
+    def fallback(self) -> GreedyGangPlanner:
+        if self._fallback is None:
+            self._fallback = GreedyGangPlanner(self.options)
+        return self._fallback
+
+    def plan(self, problem: GangProblem) -> GangPlan:
+        try:
+            plan = self.primary.plan(problem)
+        except Exception as e:  # noqa: BLE001 — degrade, never fail the cycle
+            log.error("gang planner failed; degrading to greedy",
+                      error=str(e)[:200])
+            return self._degrade(problem, "backend_failure")
+        defects = gang_plan_defects(plan, problem)
+        if defects:
+            log.error("gang planner produced invalid plan; degrading",
+                      defects=defects[:3])
+            return self._degrade(problem, "invalid_plan")
+        return plan
+
+    def _degrade(self, problem: GangProblem, reason: str) -> GangPlan:
+        metrics.ERRORS.labels("gang", f"degraded_{reason}").inc()
+        with obs.span("gang.plan.degraded", reason=reason):
+            plan = self.fallback.plan(problem)
+        plan.backend = f"degraded:{plan.backend}"
+        return plan

@@ -1,0 +1,167 @@
+"""Slice topology: per-type torus dims -> placement bitmask tables.
+
+The catalog carries each instance type's accelerator torus dims
+(``CatalogArrays.type_torus``, derived from the accelerator count or set
+explicitly on the ``InstanceType``).  This module lowers a gang's
+``slice_shape`` against those tori ONCE into dense bitmask tensors:
+
+- every *placement* of shape ``s`` in torus ``t`` (axis-aligned
+  contiguous sub-block, every distinct axis permutation of ``s`` that
+  fits, no wraparound) becomes one uint64 chip bitmask;
+- per catalog + shape, the placements of every offering stack into a
+  padded ``masks uint64 [O, Pmax]`` + ``valid bool [O, Pmax]`` table
+  (:class:`SliceTable`), cached per catalog generation.
+
+"Does offering ``o`` still fit shape ``s`` under occupancy ``occ``" is
+then one batched AND over the ``[offerings, placements]`` grid —
+``(masks & occ[:, None]) == 0`` — with no host loops on the hot path;
+the planner's device kernel runs the identical integer arithmetic on
+chip (gang/planner.py).
+
+Tori are capped at 64 chips so one mask word covers any placement
+(``apis/podgroup.MAX_SLICE_CHIPS`` rejects larger shapes at admission);
+a type whose torus exceeds the cap simply exposes no placements.
+"""
+
+from __future__ import annotations
+
+import itertools
+import math
+from dataclasses import dataclass
+
+import numpy as np
+
+from karpenter_tpu.catalog.arrays import CatalogArrays
+
+MAX_TORUS_CHIPS = 64
+
+# (torus dims, shape) -> tuple of placement masks; both keys are tiny
+# tuples, and distinct (torus, shape) pairs number in the dozens — the
+# enumeration is pure combinatorics, valid forever
+_PLACEMENT_CACHE: dict[tuple, tuple[int, ...]] = {}
+# (catalog uid, generation, shape) -> SliceTable
+_TABLE_CACHE: dict[tuple, "SliceTable"] = {}
+_TABLE_CACHE_MAX = 32
+
+
+@dataclass(frozen=True)
+class SliceTable:
+    """Padded per-offering placement bitmasks for ONE slice shape."""
+
+    shape: tuple[int, ...]
+    masks: np.ndarray        # uint64 [O, Pmax]; 0 where invalid
+    valid: np.ndarray        # bool   [O, Pmax]
+    count: np.ndarray        # int32  [O] valid placements per offering
+
+    @property
+    def pmax(self) -> int:
+        return int(self.masks.shape[1])
+
+    def free_grid(self, occupancy: np.ndarray) -> np.ndarray:
+        """bool [O, Pmax]: placement p of offering o is valid AND chip-
+        disjoint from ``occupancy`` (uint64 [O]) — THE batched fit test."""
+        return self.valid & ((self.masks & occupancy[:, None]) == 0)
+
+    def fits(self, occupancy: np.ndarray) -> np.ndarray:
+        """bool [O]: some placement is still free under ``occupancy``."""
+        return self.free_grid(occupancy).any(axis=1)
+
+
+def _chip_index(dims: tuple[int, ...]) -> np.ndarray:
+    """Row-major chip numbering of the torus grid."""
+    return np.arange(math.prod(dims)).reshape(dims)
+
+
+def enumerate_placements(torus: tuple[int, ...],
+                         shape: tuple[int, ...]) -> tuple[int, ...]:
+    """Every contiguous axis-aligned placement of ``shape`` in ``torus``
+    as chip bitmasks, deduplicated, ascending — the deterministic order
+    every planner path and the validator share.
+
+    Distinct axis permutations of ``shape`` count as distinct
+    orientations (a 2x4 job fits a 4x2 window); wraparound placements
+    are excluded (a production slice must be physically contiguous).
+    """
+    if not torus or math.prod(torus) > MAX_TORUS_CHIPS:
+        return ()
+    key = (torus, shape)
+    hit = _PLACEMENT_CACHE.get(key)
+    if hit is not None:
+        return hit
+    idx = _chip_index(torus)
+    masks: set[int] = set()
+    for perm in sorted(set(itertools.permutations(shape))):
+        if len(perm) > len(torus):
+            continue
+        # right-align the shape onto the torus axes, leading axes size 1
+        full = (1,) * (len(torus) - len(perm)) + perm
+        if any(s > t for s, t in zip(full, torus)):
+            continue
+        origins = [range(t - s + 1) for s, t in zip(full, torus)]
+        for origin in itertools.product(*origins):
+            block = idx[tuple(slice(o, o + s)
+                              for o, s in zip(origin, full))]
+            mask = 0
+            for c in block.ravel().tolist():
+                mask |= 1 << c
+            masks.add(mask)
+    out = tuple(sorted(masks))
+    _PLACEMENT_CACHE[key] = out
+    return out
+
+
+def type_placements(catalog: CatalogArrays, t: int,
+                    shape: tuple[int, ...]) -> tuple[int, ...]:
+    """Placement masks of ``shape`` on type ``t``'s torus (possibly ())."""
+    tori = catalog.type_torus
+    torus = tori[t] if t < len(tori) else ()
+    return enumerate_placements(tuple(torus), shape)
+
+
+def slice_table(catalog: CatalogArrays,
+                shape: tuple[int, ...]) -> SliceTable:
+    """The ``[offerings, placements]`` bitmask table for ``shape``,
+    memoized per catalog generation (offerings of one type share that
+    type's placements; the table is availability-independent — blackouts
+    gate *creates*, not geometry)."""
+    key = (catalog.uid, catalog.generation, shape)
+    hit = _TABLE_CACHE.get(key)
+    if hit is not None:
+        return hit
+    per_type = [type_placements(catalog, t, shape)
+                for t in range(catalog.num_types)]
+    pmax = max((len(p) for p in per_type), default=0)
+    O = catalog.num_offerings
+    masks = np.zeros((O, max(pmax, 1)), dtype=np.uint64)
+    valid = np.zeros((O, max(pmax, 1)), dtype=bool)
+    for o in range(O):
+        plc = per_type[int(catalog.off_type[o])]
+        if plc:
+            masks[o, :len(plc)] = np.array(plc, dtype=np.uint64)
+            valid[o, :len(plc)] = True
+    table = SliceTable(shape=shape, masks=masks, valid=valid,
+                       count=valid.sum(axis=1).astype(np.int32))
+    while len(_TABLE_CACHE) >= _TABLE_CACHE_MAX:
+        _TABLE_CACHE.pop(next(iter(_TABLE_CACHE)))
+    _TABLE_CACHE[key] = table
+    return table
+
+
+def clear_topology_cache() -> None:
+    """Test hook: drop every cached placement table."""
+    _PLACEMENT_CACHE.clear()
+    _TABLE_CACHE.clear()
+
+
+def mask_chips(mask: int) -> int:
+    """Chip count of a placement bitmask (host-side popcount)."""
+    return int(mask).bit_count()
+
+
+def split_mask_words(masks: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
+    """uint64 masks -> (lo, hi) int32 word pairs for the device kernel
+    (TPU jit runs 32-bit; bitwise AND is word-local, so the disjointness
+    test decomposes exactly)."""
+    lo = (masks & np.uint64(0xFFFFFFFF)).astype(np.uint32).view(np.int32)
+    hi = (masks >> np.uint64(32)).astype(np.uint32).view(np.int32)
+    return lo, hi

@@ -196,6 +196,17 @@ class Operator:
 
             ctrls.append(PreemptionController(
                 self.cluster, self.provisioner))
+        # gang admission + TPU-slice placement: whole-job atomic
+        # scheduling, parked behind min_member (docs/design/gang.md).
+        # Opt-in: the controller registers the provisioner's admission
+        # gate, changing how gang-labeled pods are queued.
+        if self.options.gang_enabled:
+            from karpenter_tpu.controllers.gang import (
+                GangAdmissionController,
+            )
+
+            ctrls.append(GangAdmissionController(
+                self.cluster, self.provisioner))
         # env-gated (controllers.go:238)
         ctrls.append(OrphanCleanupController(
             self.cluster, self.cloud,

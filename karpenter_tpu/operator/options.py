@@ -58,6 +58,11 @@ class Options:
     # upgrading clusters whose priorities were decorative must not start
     # losing low-priority pods without an operator decision
     preemption_enabled: bool = False       # KARPENTER_ENABLE_PREEMPTION
+    # the gang plane ships opt-in like preemption/repack: it HOLDS pods
+    # out of the provision queue, and an upgrading cluster whose gang
+    # labels were decorative must not start parking workloads without an
+    # operator decision
+    gang_enabled: bool = False             # KARPENTER_ENABLE_GANG
     orphan_cleanup_enabled: bool = False   # KARPENTER_ENABLE_ORPHAN_CLEANUP
     repack_enabled: bool = False           # KARPENTER_ENABLE_REPACK
     repack_min_savings_percent: int = 15   # apply repack only above this
@@ -103,6 +108,7 @@ class Options:
                                        True),
             preemption_enabled=_getb(env, "KARPENTER_ENABLE_PREEMPTION",
                                      False),
+            gang_enabled=_getb(env, "KARPENTER_ENABLE_GANG", False),
             metrics_port=_geti(env, "KARPENTER_METRICS_PORT", 0),
             webhook_port=_geti(env, "KARPENTER_WEBHOOK_PORT", 0),
             webhook_tls_cert=env.get("KARPENTER_WEBHOOK_TLS_CERT", ""),

@@ -65,6 +65,21 @@ def plan_defects(plan: Plan, request: SolveRequest) -> list[str]:
     if seen != want:
         defects.append(f"pod accounting mismatch: {len(seen - want)} unknown, "
                        f"{len(want - seen)} missing")
+    # no-partial-gang (cheap form): a plan carrying a strict subset of a
+    # gang's members degrades to the gang-aware greedy oracle instead of
+    # half-creating a job's capacity (docs/design/gang.md)
+    placed_names = {pn for node in plan.nodes for pn in node.pod_names}
+    tally: dict[str, list[int]] = {}
+    for p in request.pods:
+        if p.gang is not None:
+            row = tally.setdefault(p.gang.name, [0, 0])
+            row[1] += 1
+            if pod_key(p) in placed_names:
+                row[0] += 1
+    for name, (placed, total) in tally.items():
+        if 0 < placed < total:
+            defects.append(f"partial gang {name}: {placed}/{total} "
+                           f"members placed")
     return defects
 
 

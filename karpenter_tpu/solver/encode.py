@@ -83,7 +83,8 @@ class EncodedProblem:
     consume the same order, so plans are comparable."""
 
     __slots__ = ("groups", "group_req", "group_count", "group_cap",
-                 "group_prio", "catalog", "rejected", "label_rows",
+                 "group_prio", "group_gang", "group_min", "gang_names",
+                 "catalog", "rejected", "label_rows",
                  "label_idx", "pref_rows", "pref_idx", "_compat",
                  "_names_idx", "_prep_cache")
 
@@ -96,7 +97,10 @@ class EncodedProblem:
                  label_idx: np.ndarray | None = None,
                  pref_rows: np.ndarray | None = None,
                  pref_idx: np.ndarray | None = None,
-                 group_prio: np.ndarray | None = None):
+                 group_prio: np.ndarray | None = None,
+                 group_gang: np.ndarray | None = None,
+                 group_min: np.ndarray | None = None,
+                 gang_names: list[str] | None = None):
         self.groups = groups
         self.group_req = group_req
         self.group_count = group_count
@@ -105,6 +109,17 @@ class EncodedProblem:
         # the preemption plane's ranking tensor; zeros when absent
         self.group_prio = group_prio if group_prio is not None \
             else np.zeros(len(groups), dtype=np.int32)
+        # gang plane (apis/podgroup.py): int32 [G] gang id (-1 = no
+        # gang; ids index gang_names) + int32 [G] min_member.  Groups of
+        # one gang place all-or-nothing — enforced in the decode choke
+        # point every dense backend shares (decode_plan_entries), the
+        # greedy host oracle's transactional pass, and the independent
+        # validator's no-partial-gang check (docs/design/gang.md).
+        self.group_gang = group_gang if group_gang is not None \
+            else np.full(len(groups), -1, dtype=np.int32)
+        self.group_min = group_min if group_min is not None \
+            else np.zeros(len(groups), dtype=np.int32)
+        self.gang_names = gang_names if gang_names is not None else []
         self.catalog = catalog
         self.rejected = rejected if rejected is not None else []
         self.label_rows = label_rows
@@ -123,6 +138,10 @@ class EncodedProblem:
     @property
     def has_preferences(self) -> bool:
         return self.pref_rows is not None
+
+    @property
+    def has_gangs(self) -> bool:
+        return len(self.gang_names) > 0
 
     @property
     def compat(self) -> np.ndarray:
@@ -148,7 +167,9 @@ class EncodedProblem:
                       compat=self._compat, catalog=self.catalog,
                       rejected=self.rejected, label_rows=self.label_rows,
                       label_idx=self.label_idx, pref_rows=self.pref_rows,
-                      pref_idx=self.pref_idx, group_prio=self.group_prio)
+                      pref_idx=self.pref_idx, group_prio=self.group_prio,
+                      group_gang=self.group_gang, group_min=self.group_min,
+                      gang_names=self.gang_names)
         fields.update(kw)
         return EncodedProblem(**fields)
 
@@ -507,7 +528,10 @@ def _encode_impl(pods: Sequence[PodSpec], catalog: CatalogArrays,
     g_label: list[int] = []
     g_pref: list[int] = []                 # index into pref row set; -1 = none
     g_prio: list[int] = []
+    g_gang: list[int] = []                 # gang id; -1 = no gang
+    g_min: list[int] = []                  # gang min_member; 0 = no gang
     g_name: list[str] = []
+    gang_ids: dict[str, int] = {}          # gang name -> interned id
     row_keys: dict[tuple, int] = {}
     rows: list[np.ndarray] = []
     pref_row_keys: dict[bytes, int] = {}
@@ -598,6 +622,11 @@ def _encode_impl(pods: Sequence[PodSpec], catalog: CatalogArrays,
         req_row = (req[0], req[1], req[2], max(req[3], 1))
         cap_i32 = min(cap, np.iinfo(np.int32).max)
         pref_terms, pref_w = pref
+        if rep.gang is not None:
+            gang_id = gang_ids.setdefault(rep.gang.name, len(gang_ids))
+            gang_min = rep.gang.min_member
+        else:
+            gang_id, gang_min = -1, 0
 
         def split_subgroups(zones, pinned: bool):
             """Per-zone even split (skew <= 1) shared by the HARD spread
@@ -629,10 +658,29 @@ def _encode_impl(pods: Sequence[PodSpec], catalog: CatalogArrays,
                 g_pref.append(pref_for(pref_terms, pref_w,
                                        None if pinned else zone))
                 g_prio.append(rep.priority)
+                g_gang.append(gang_id)
+                g_min.append(gang_min)
                 g_name.append(groups[-1].pod_names[0])
 
         spread = _zone_spread_constraints(rep)
-        if spread and len(live_zones) > 1:
+        if rep.gang is not None:
+            # gang members place all-or-nothing: never spread-split or
+            # zone-candidate-split a gang — co-placement is the contract
+            # (zone requirements still apply through the label row)
+            groups.append(PodGroup(
+                representative=rep, pod_names=[pod_key(p) for p in members],
+                count=len(members), requirements=reqs, cap_per_node=cap,
+                nozone_mask=nozone, label_mask=label))
+            g_req.append(req_row)
+            g_count.append(len(members))
+            g_cap.append(cap_i32)
+            g_label.append(row_for(label, zone_sig, None, reqs))
+            g_pref.append(pref_for(pref_terms, pref_w, None))
+            g_prio.append(rep.priority)
+            g_gang.append(gang_id)
+            g_min.append(gang_min)
+            g_name.append(groups[-1].pod_names[0])
+        elif spread and len(live_zones) > 1:
             split_subgroups(live_zones, pinned=True)
         elif _has_zone_affinity(rep) and len(live_zones) > 1:
             # co-schedule in one zone: an explicit candidate override wins
@@ -652,6 +700,8 @@ def _encode_impl(pods: Sequence[PodSpec], catalog: CatalogArrays,
             g_label.append(row_for(label, zone_sig, best, reqs))
             g_pref.append(pref_for(pref_terms, pref_w, None))
             g_prio.append(rep.priority)
+            g_gang.append(gang_id)
+            g_min.append(gang_min)
             g_name.append(groups[-1].pod_names[0])
         elif _soft_zone_spread(rep) and len(live_zones) > 1:
             # soft spread ranks BELOW hard spread and below zone
@@ -669,6 +719,8 @@ def _encode_impl(pods: Sequence[PodSpec], catalog: CatalogArrays,
             g_label.append(row_for(label, zone_sig, None, reqs))
             g_pref.append(pref_for(pref_terms, pref_w, None))
             g_prio.append(rep.priority)
+            g_gang.append(gang_id)
+            g_min.append(gang_min)
             g_name.append(groups[-1].pod_names[0])
 
     # 4. FFD order: descending PRIORITY first (preemption semantics:
@@ -686,6 +738,8 @@ def _encode_impl(pods: Sequence[PodSpec], catalog: CatalogArrays,
     label_idx = np.asarray(g_label, dtype=np.int32)
     pref_idx = np.asarray(g_pref, dtype=np.int32)
     group_prio = np.asarray(g_prio, dtype=np.int32)
+    group_gang = np.asarray(g_gang, dtype=np.int32)
+    group_min = np.asarray(g_min, dtype=np.int32)
     if G:
         shares = np.where(mean_alloc[None, :] > 0,
                           group_req.astype(np.float64)
@@ -700,6 +754,8 @@ def _encode_impl(pods: Sequence[PodSpec], catalog: CatalogArrays,
         label_idx = label_idx[order]
         pref_idx = pref_idx[order]
         group_prio = np.ascontiguousarray(group_prio[order])
+        group_gang = np.ascontiguousarray(group_gang[order])
+        group_min = np.ascontiguousarray(group_min[order])
 
     label_rows = (np.stack(rows) if rows
                   else np.zeros((0, O), dtype=bool))
@@ -712,7 +768,9 @@ def _encode_impl(pods: Sequence[PodSpec], catalog: CatalogArrays,
         group_cap=group_cap, compat=None, catalog=catalog,
         rejected=rejected, label_rows=label_rows, label_idx=label_idx,
         pref_rows=np.stack(pref_rows_l) if has_pref else None,
-        pref_idx=pref_idx if has_pref else None, group_prio=group_prio)
+        pref_idx=pref_idx if has_pref else None, group_prio=group_prio,
+        group_gang=group_gang, group_min=group_min,
+        gang_names=list(gang_ids))
 
 
 def estimate_nodes(problem: EncodedProblem, n_cap: int,
@@ -778,6 +836,51 @@ def _names_index(problem: EncodedProblem):
     return cached
 
 
+def _enforce_gangs(problem: EncodedProblem, node_off: np.ndarray,
+                   gis: np.ndarray, ns: np.ndarray, cnts: np.ndarray,
+                   cost: float):
+    """Vectorized all-or-nothing gang enforcement over COO entries.
+
+    A gang is *partial* when its placed member count is positive but
+    below its total pending membership (or its membership never reached
+    ``min_member``).  Partial gangs' entries are dropped — their counts
+    return to the caller as ``(group indices, counts)`` for the
+    per-group unplaced tally — and any node left with NO entries is
+    closed (``node_off`` -1) with its price subtracted from ``cost``:
+    a node opened solely for a half-placed gang must not be created.
+
+    Returns ``(node_off, gis, ns, cnts, dropped_or_None, cost)``.
+    """
+    G = len(problem.groups)
+    gg = problem.group_gang
+    gmask = gg[:G] >= 0
+    if not gmask.any():
+        return node_off, gis, ns, cnts, None, cost
+    ngang = len(problem.gang_names)
+    gang_of = gg[:G][gmask].astype(np.int64)
+    total = np.zeros(ngang, np.int64)
+    np.add.at(total, gang_of, problem.group_count[:G][gmask].astype(np.int64))
+    minm = np.zeros(ngang, np.int64)
+    np.maximum.at(minm, gang_of, problem.group_min[:G][gmask].astype(np.int64))
+    entry_gang = gg[gis]
+    e = entry_gang >= 0
+    placed = np.zeros(ngang, np.int64)
+    np.add.at(placed, entry_gang[e], cnts[e].astype(np.int64))
+    bad = (placed > 0) & ((placed < total) | (total < minm))
+    if not bad.any():
+        return node_off, gis, ns, cnts, None, cost
+    drop = e & bad[np.clip(entry_gang, 0, None)]
+    dropped = (gis[drop], cnts[drop].astype(np.int64))
+    dead = np.setdiff1d(np.unique(ns), np.unique(ns[~drop]),
+                        assume_unique=True)
+    if dead.size:
+        node_off = np.array(node_off, copy=True)
+        cost = float(cost) - float(
+            problem.catalog.off_price[node_off[dead]].sum())
+        node_off[dead] = -1
+    return node_off, gis[~drop], ns[~drop], cnts[~drop], dropped, cost
+
+
 def decode_plan_entries(problem: EncodedProblem, node_off: np.ndarray,
                         gis: np.ndarray, ns: np.ndarray, cnts: np.ndarray,
                         unplaced: np.ndarray, cost: float, backend: str):
@@ -796,11 +899,28 @@ def decode_plan_entries(problem: EncodedProblem, node_off: np.ndarray,
 
     catalog = problem.catalog
     groups = problem.groups
-    open_idx = np.nonzero(node_off >= 0)[0]
     G = len(groups)
     keep = (gis < G) & (node_off[ns] >= 0) & (cnts > 0)
     if not keep.all():
         gis, ns, cnts = gis[keep], ns[keep], cnts[keep]
+    if problem.has_gangs and gis.size:
+        # no-partial-gang choke point: every dense backend decodes
+        # through here, so a plan carrying a strict subset of a gang's
+        # members (or a sub-min_member gang) is structurally impossible
+        # downstream of this line — the dropped members return to
+        # unplaced and nodes emptied by the drop are closed (their cost
+        # leaves the plan).  The greedy host oracle enforces the same
+        # invariant transactionally; solver/validate.py re-checks it
+        # independently (the three-layer pattern, docs/design/gang.md).
+        node_off, gis, ns, cnts, cnts_dropped, cost = _enforce_gangs(
+            problem, node_off, gis, ns, cnts, cost)
+        if cnts_dropped is not None:
+            up = np.zeros(G, dtype=np.int64)
+            m = min(G, len(unplaced))
+            up[:m] = np.asarray(unplaced[:m], dtype=np.int64)
+            np.add.at(up, cnts_dropped[0], cnts_dropped[1])
+            unplaced = up
+    open_idx = np.nonzero(node_off >= 0)[0]
     per_node: dict[int, list[str]] = {}
     if gis.size:
         # per-group exclusive cumsum = each entry's start offset into its

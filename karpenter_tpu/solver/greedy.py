@@ -114,9 +114,12 @@ class GreedySolver:
 
     def solve_encoded(self, problem: EncodedProblem) -> Plan:
         if self.options.use_native != "off" \
-                and problem.pref_rows is None:
-            # the C++ twin has no preference-penalty ranking; soft
-            # constraints route to the python oracle
+                and problem.pref_rows is None \
+                and not problem.has_gangs:
+            # the C++ twin has no preference-penalty ranking and no
+            # gang transaction; those windows route to the python
+            # oracle (a native partial gang would only be stripped by
+            # the decode choke point, wasting the opened nodes)
             plan = self._solve_native(problem)
             if plan is not None:
                 return plan
@@ -165,10 +168,41 @@ class GreedySolver:
 
         unplaced: list[str] = list(problem.rejected)
 
+        # gang transaction state (docs/design/gang.md): a gang group
+        # places all-or-nothing — its placements are rolled back when
+        # the group cannot fully place, and a multi-group gang that
+        # fails in ANY group is stripped whole in the post-pass below
+        gang_ids = problem.group_gang
+        gang_total: dict[int, int] = {}
+        gang_minm: dict[int, int] = {}
+        if problem.has_gangs:
+            for i in range(problem.num_groups):
+                gid = int(gang_ids[i])
+                if gid >= 0:
+                    gang_total[gid] = gang_total.get(gid, 0) \
+                        + int(problem.group_count[i])
+                    gang_minm[gid] = max(gang_minm.get(gid, 0),
+                                         int(problem.group_min[i]))
+        failed_gangs: set[int] = set()
+
         for gi, group in enumerate(problem.groups):
             req = problem.group_req[gi].astype(np.int64)
             cap = int(problem.group_cap[gi])
             compat = problem.compat[gi]
+            gid = int(gang_ids[gi]) if problem.has_gangs else -1
+            saved = None
+            if gid >= 0:
+                if gid in failed_gangs \
+                        or gang_total[gid] < gang_minm[gid]:
+                    failed_gangs.add(gid)
+                    unplaced.extend(group.pod_names)
+                    continue
+                # shallow snapshots suffice: the placement loop REPLACES
+                # node_resid entries (never mutates in place) and only
+                # ever extends node_pods, so rollback = restore lists +
+                # truncate pod tails
+                saved = (list(node_offering), list(node_resid),
+                         [len(p) for p in node_pods])
             # soft preferences: penalty-ranked pricing for the new-node
             # choice (same rank_g = rank * (1 + lambda * miss) blend the
             # device scan applies); real cost accounting untouched
@@ -199,35 +233,73 @@ class GreedySolver:
                 node_pods[ni].extend(remaining[:take])
                 del remaining[:take]
 
-            if not remaining:
-                continue
-
-            # open new nodes with the cheapest-per-pod offering; fit is
-            # capped by the pods actually remaining so cost-per-pod is
-            # judged on the pods a node will really hold (karpenter sizes
-            # claims to their pod batch — a huge node must not "win" for
-            # a tiny tail)
-            fit_empty = np.where(
-                compat,
-                np.min(np.where(req[None, :] > 0,
-                                off_alloc // np.maximum(req[None, :], 1),
-                                np.int64(1 << 40)), axis=1),
-                0)
-            fit_empty = np.minimum(fit_empty, min(cap, len(remaining)))
-            with np.errstate(divide="ignore", invalid="ignore"):
-                cost_per_pod = np.where(fit_empty > 0, rank_g / fit_empty, np.inf)
-            best_off = int(np.argmin(cost_per_pod))
-            best_fit = int(fit_empty[best_off])
-            if best_fit <= 0:
+            if remaining:
+                # open new nodes with the cheapest-per-pod offering; fit
+                # is capped by the pods actually remaining so
+                # cost-per-pod is judged on the pods a node will really
+                # hold (karpenter sizes claims to their pod batch — a
+                # huge node must not "win" for a tiny tail)
+                fit_empty = np.where(
+                    compat,
+                    np.min(np.where(req[None, :] > 0,
+                                    off_alloc // np.maximum(req[None, :], 1),
+                                    np.int64(1 << 40)), axis=1),
+                    0)
+                fit_empty = np.minimum(fit_empty, min(cap, len(remaining)))
+                with np.errstate(divide="ignore", invalid="ignore"):
+                    cost_per_pod = np.where(fit_empty > 0,
+                                            rank_g / fit_empty, np.inf)
+                best_off = int(np.argmin(cost_per_pod))
+                best_fit = int(fit_empty[best_off])
+                if best_fit > 0:
+                    while remaining and len(node_offering) < max_nodes:
+                        take = min(best_fit, len(remaining))
+                        node_offering.append(best_off)
+                        node_resid.append(off_alloc[best_off] - req * take)
+                        node_pods.append(remaining[:take])
+                        del remaining[:take]
+            if gid >= 0 and remaining:
+                # gang group could not fully place: roll the whole group
+                # back — a partial gang must never survive the oracle
+                node_offering[:] = saved[0]
+                node_resid[:] = saved[1]
+                del node_pods[len(saved[0]):]
+                for i, n0 in enumerate(saved[2]):
+                    del node_pods[i][n0:]
+                failed_gangs.add(gid)
+                unplaced.extend(group.pod_names)
+            else:
                 unplaced.extend(remaining)
-                continue
-            while remaining and len(node_offering) < max_nodes:
-                take = min(best_fit, len(remaining))
-                node_offering.append(best_off)
-                node_resid.append(off_alloc[best_off] - req * take)
-                node_pods.append(remaining[:take])
-                del remaining[:take]
-            unplaced.extend(remaining)
+
+        if failed_gangs:
+            # a gang spanning several signature groups (heterogeneous
+            # members) fails WHOLE: strip any sibling groups' placements
+            # and close nodes the strip emptied
+            doomed: dict[str, np.ndarray] = {}
+            for i in range(problem.num_groups):
+                if int(gang_ids[i]) in failed_gangs:
+                    r = problem.group_req[i].astype(np.int64)
+                    for pn in problem.groups[i].pod_names:
+                        doomed[pn] = r
+            stripped = False
+            for ni in range(len(node_offering)):
+                if not any(pn in doomed for pn in node_pods[ni]):
+                    continue
+                kept = []
+                for pn in node_pods[ni]:
+                    if pn in doomed:
+                        node_resid[ni] = node_resid[ni] + doomed[pn]
+                        unplaced.append(pn)
+                        stripped = True
+                    else:
+                        kept.append(pn)
+                node_pods[ni] = kept
+            if stripped:
+                keep_idx = [ni for ni in range(len(node_offering))
+                            if node_pods[ni]]
+                node_offering = [node_offering[i] for i in keep_idx]
+                node_resid = [node_resid[i] for i in keep_idx]
+                node_pods = [node_pods[i] for i in keep_idx]
 
         nodes = []
         total = 0.0

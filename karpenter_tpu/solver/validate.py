@@ -109,6 +109,12 @@ def validate_plan(plan: Plan, pods: Sequence[PodSpec], catalog: CatalogArrays,
         groups[p.constraint_signature()].append(p)
     for sig, members in groups.items():
         rep = members[0]
+        if rep.gang is not None:
+            # gang co-placement supersedes spread: the encoder never
+            # zone-splits a gang (all-or-nothing on shared capacity is
+            # the contract), so skew over a gang's members is not a
+            # defect — gang atomicity is checked in section 5 instead
+            continue
         placed_zones = [pod_zone[pod_key(p)] for p in members if pod_key(p) in pod_zone]
         if not placed_zones:
             continue
@@ -131,7 +137,146 @@ def validate_plan(plan: Plan, pods: Sequence[PodSpec], catalog: CatalogArrays,
                 errors.append(f"group {rep.name}: zone skew {skew} > "
                               f"maxSkew {c.max_skew} ({dict(counts)})")
 
-    # 5. cost accounting
+    # 5. gang atomicity (no-partial-gang): every PodGroup's members are
+    # placed whole or not at all, and never below min_member — the
+    # independent third layer behind the decode choke point and the
+    # greedy transaction (docs/design/gang.md)
+    gangs: dict[str, list[PodSpec]] = defaultdict(list)
+    for p in pods:
+        if p.gang is not None:
+            gangs[p.gang.name].append(p)
+    placed_names = {pn for node in plan.nodes for pn in node.pod_names}
+    for name, members in gangs.items():
+        placed = sum(1 for p in members if pod_key(p) in placed_names)
+        if 0 < placed < len(members):
+            errors.append(f"gang {name}: partial placement "
+                          f"{placed}/{len(members)} members")
+        elif placed and placed < members[0].gang.min_member:
+            errors.append(f"gang {name}: placed {placed} members below "
+                          f"min_member {members[0].gang.min_member}")
+
+    # 6. cost accounting
+    expected = sum(n.price for n in plan.nodes)
+    if abs(expected - plan.total_cost_per_hour) > 1e-3 * max(1.0, expected):
+        errors.append(f"cost mismatch: nodes sum {expected} != "
+                      f"plan {plan.total_cost_per_hour}")
+    return errors
+
+
+def validate_gang_plan(plan, pods: Sequence[PodSpec], catalog: CatalogArrays,
+                       nodepool: NodePool | None = None) -> list[str]:
+    """Independent feasibility oracle for a :class:`gang.types.GangPlan`
+    — no shared code path with either planner backend.  Checks against
+    the raw gang pods + catalog:
+
+    - every gang is placed WHOLE on exactly one node (atomicity), with
+      at least ``min_member`` members present, or fully unplaced;
+    - slice geometry: each assignment's placement bitmask has exactly
+      ``chips`` bits, lies within the node type's torus, and is one of
+      the enumerated contiguous placements; assignments sharing a node
+      are pairwise chip-disjoint;
+    - per-node capacity: total member demand fits the offering's
+      allocatable; offering is available and label-compatible with the
+      members' scheduling requirements; pool taints tolerated;
+    - cost accounting matches the node prices.
+    """
+    import math
+
+    from karpenter_tpu.gang.topology import mask_chips, type_placements
+
+    nodepool = nodepool or NodePool(name="default")
+    errors: list[str] = []
+    by_name: dict[str, PodSpec] = {pod_key(p): p for p in pods}
+    members_of: dict[str, set[str]] = defaultdict(set)
+    spec_of: dict[str, object] = {}
+    for p in pods:
+        if p.gang is not None:
+            members_of[p.gang.name].add(pod_key(p))
+            spec_of[p.gang.name] = p.gang
+
+    placed_of: dict[str, set[str]] = defaultdict(set)
+    node_of: dict[str, set[int]] = defaultdict(set)
+    seen: set[str] = set()
+    for ni, node in enumerate(plan.nodes):
+        o = node.offering_index
+        if o < 0 or o >= catalog.num_offerings:
+            errors.append(f"node{ni}: bad offering index {o}")
+            continue
+        if not catalog.off_avail[o]:
+            errors.append(f"node{ni}: offering {node.instance_type}/"
+                          f"{node.zone}/{node.capacity_type} is blacked out")
+        if (node.instance_type, node.zone, node.capacity_type) != \
+                catalog.describe_offering(o):
+            errors.append(f"node{ni}: offering index mismatch")
+        t = int(catalog.off_type[o])
+        labels = dict(nodepool.labels)
+        labels.update(catalog.offering_label_values(o))
+        alloc = catalog.offering_alloc()[o]
+        used = [0, 0, 0, 0]
+        occupied = 0
+        for a in node.assignments:
+            spec = spec_of.get(a.gang)
+            if spec is None:
+                errors.append(f"node{ni}: assignment for unknown gang "
+                              f"{a.gang}")
+                continue
+            placed_of[a.gang].update(a.pod_names)
+            node_of[a.gang].add(ni)
+            if spec.slice_shape:
+                want = math.prod(spec.slice_shape)
+                if mask_chips(a.placement_mask) != want:
+                    errors.append(f"node{ni}: gang {a.gang} mask has "
+                                  f"{mask_chips(a.placement_mask)} chips, "
+                                  f"shape needs {want}")
+                if a.placement_mask not in type_placements(
+                        catalog, t, spec.slice_shape):
+                    errors.append(f"node{ni}: gang {a.gang} mask is not a "
+                                  f"contiguous {spec.slice_shape} placement "
+                                  f"on {node.instance_type}'s torus")
+                if a.placement_mask & occupied:
+                    errors.append(f"node{ni}: gang {a.gang} slice overlaps "
+                                  f"another gang's chips")
+                occupied |= a.placement_mask
+            for pn in a.pod_names:
+                if pn in seen:
+                    errors.append(f"pod {pn} assigned twice")
+                seen.add(pn)
+                pod = by_name.get(pn)
+                if pod is None:
+                    errors.append(f"pod {pn} not in request")
+                    continue
+                for i, v in enumerate(pod.requests.as_tuple()):
+                    used[i] += v if i != 3 else max(v, 1)
+                reqs = pod.scheduling_requirements().merged(
+                    nodepool.requirements)
+                if not reqs.matches(labels):
+                    errors.append(f"node{ni}: pod {pn} requirements "
+                                  f"unsatisfied by labels")
+                if nodepool.taints and not tolerates_all(pod.tolerations,
+                                                         nodepool.taints):
+                    errors.append(f"node{ni}: pod {pn} does not tolerate "
+                                  f"pool taints")
+        if any(u > a_ for u, a_ in zip(used, alloc)):
+            errors.append(f"node{ni} ({node.instance_type}): capacity "
+                          f"exceeded used={used} alloc={list(alloc)}")
+
+    for name, members in members_of.items():
+        placed = placed_of.get(name, set())
+        if not placed:
+            continue
+        if placed != members:
+            errors.append(f"gang {name}: partial placement "
+                          f"{len(placed)}/{len(members)} members")
+        if len(node_of[name]) > 1:
+            errors.append(f"gang {name}: members split across "
+                          f"{len(node_of[name])} nodes")
+        if len(placed) < spec_of[name].min_member:
+            errors.append(f"gang {name}: placed below min_member "
+                          f"{spec_of[name].min_member}")
+    for pn in plan.unplaced:
+        if pn in seen:
+            errors.append(f"pod {pn} both placed and unplaced")
+
     expected = sum(n.price for n in plan.nodes)
     if abs(expected - plan.total_cost_per_hour) > 1e-3 * max(1.0, expected):
         errors.append(f"cost mismatch: nodes sum {expected} != "

@@ -288,6 +288,29 @@ PREEMPTION_PLAN_DURATION = Histogram(
     "karpenter_tpu_preemption_plan_seconds",
     "Preemption plan latency (encode victims + batched solve)",
     ("backend",))
+# Gang plane (karpenter_tpu/gang + controllers/gang.py).
+GANG_ADMISSIONS = Counter(
+    "karpenter_tpu_gang_admissions_total",
+    "Gang admission outcomes: admitted (min_member reached), "
+    "released_degraded (deadline expired sub-min_member; members fell "
+    "back to per-pod scheduling)",
+    ("outcome",))
+GANG_PLACEMENTS = Counter(
+    "karpenter_tpu_gang_placements_total",
+    "Gangs placed atomically by the gang plane, by backend",
+    ("backend",))
+GANG_PARKED = Gauge(
+    "karpenter_tpu_gang_parked",
+    "Gangs currently parked out of the provision queue awaiting "
+    "min_member", ())
+GANG_MEMBERS = Histogram(
+    "karpenter_tpu_gang_members",
+    "Members per admitted gang",
+    (), buckets=(1, 2, 4, 8, 16, 32, 64, 128, 256, 1024))
+GANG_PLAN_DURATION = Histogram(
+    "karpenter_tpu_gang_plan_seconds",
+    "Gang placement plan latency (encode + batched slice grid)",
+    ("backend",))
 LEADER = Gauge(
     "karpenter_tpu_leader",
     "1 when this replica holds the named leader-election lease", ("lease",))

@@ -573,6 +573,22 @@ class TestScenarios:
         again = run_scenario("overload", 2, rounds=10)
         assert res.digest == again.digest
 
+    def test_gang_profile_places_gangs_and_holds_invariants(self):
+        """The gang plane's acceptance scenario: a mixed gang/singleton
+        backlog under blackouts and spot storms, with zero partial gang
+        placements and every gang resolving or deadline-releasing."""
+        res = run_scenario("gang", 1, rounds=10)
+        assert res.ok, res.render_failure()
+        pump = res.trace.of_kind("pump")
+        assert max(r.get("gangs_admitted", 0) for r in pump) > 0, \
+            "gang profile never admitted a gang"
+        waves = [e for e in res.trace.of_kind("workload")
+                 if e.get("shape") == "gang"]
+        assert waves, "gang profile never injected a gang wave"
+        # determinism: same cell twice => identical digest
+        again = run_scenario("gang", 1, rounds=10)
+        assert res.digest == again.digest
+
     def test_broken_fixture_fails_with_replay_command(self):
         """Falsifiability: a world with GC + orphan cleanup disabled MUST
         trip no-stale-orphan, and the failure names the exact replay."""

@@ -355,3 +355,39 @@ def test_flat_compute_handle_rejects_unviable():
     bare = problem.replace(label_rows=None, label_idx=None)
     js = JaxSolver(flat_opts(flat_solver="on"))
     assert flat_compute_handle(js, bare) is None
+
+
+class TestFlatEmptyEligibleZones:
+    """Satellite (ISSUE 5): on the flat path too, a group whose zone
+    requirement matches nothing must degrade to explicit unplaced
+    accounting — not an empty-but-'valid' plan."""
+
+    def test_dead_zone_group_unplaced_on_flat_path(self):
+        from karpenter_tpu.apis.requirements import LABEL_ZONE
+
+        catalog = make_catalog()
+        pods = hetero_pods(64, seed=5)
+        dead = [PodSpec(f"dz{i}",
+                        requests=ResourceRequests(500, 1024, 0, 1),
+                        node_selector=((LABEL_ZONE, "mars-north-1"),))
+                for i in range(5)]
+        js = JaxSolver(flat_opts(flat_solver="on"))
+        plan = js.solve(SolveRequest(pods + dead, catalog))
+        assert js.last_stats.get("path") == "flat"
+        assert validate_plan(plan, pods + dead, catalog) == []
+        assert sorted(plan.unplaced_pods) == \
+            sorted(f"default/dz{i}" for i in range(5))
+
+    def test_all_dead_window_yields_empty_plan_with_full_unplaced(self):
+        from karpenter_tpu.apis.requirements import LABEL_ZONE
+
+        catalog = make_catalog()
+        dead = [PodSpec(f"dz{i}",
+                        requests=ResourceRequests(500, 1024, 0, 1),
+                        node_selector=((LABEL_ZONE, "mars-north-1"),))
+                for i in range(8)]
+        js = JaxSolver(flat_opts(flat_solver="on"))
+        plan = js.solve(SolveRequest(dead, catalog))
+        assert not plan.nodes
+        assert len(plan.unplaced_pods) == 8
+        assert validate_plan(plan, dead, catalog) == []

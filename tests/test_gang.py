@@ -1,0 +1,739 @@
+"""Gang-plane tests: PodGroup API, torus topology, atomic planner
+semantics, device/host parity, degraded fallback, the independent
+validators, the three-layer solver enforcement, the admission
+controller, and the chaos invariants.
+
+Strategy mirrors the preemption suite (tests/test_preempt.py): pure
+functions over a fake catalog + hand-built cluster state, with the
+greedy host path as the differential oracle for the batched planner and
+``validate_gang_plan`` as the independent feasibility oracle for both.
+"""
+
+import numpy as np
+import pytest
+
+from karpenter_tpu.apis.nodeclaim import NodePool
+from karpenter_tpu.apis.pod import (
+    PodSpec, ResourceRequests, Taint, TopologySpreadConstraint, make_pods,
+    pod_key,
+)
+from karpenter_tpu.apis.podgroup import PodGroup, parse_slice_shape
+from karpenter_tpu.catalog import (
+    CatalogArrays, InstanceTypeProvider, PricingProvider,
+)
+from karpenter_tpu.catalog.instancetype import InstanceType, default_torus
+from karpenter_tpu.cloud.fake import FakeCloud, generate_profiles
+from karpenter_tpu.controllers.gang import GangAdmissionController
+from karpenter_tpu.core.cluster import ClusterState
+from karpenter_tpu.gang import (
+    GangOptions, GangPlanner, GreedyGangPlanner, ResilientGangPlanner,
+    encode_gangs, gang_plan_defects,
+)
+from karpenter_tpu.gang.topology import (
+    clear_topology_cache, enumerate_placements, mask_chips, slice_table,
+)
+from karpenter_tpu.gang.types import GangAssignment
+from karpenter_tpu.solver.encode import encode
+from karpenter_tpu.solver.greedy import GreedySolver
+from karpenter_tpu.solver.types import SolveRequest, SolverOptions
+from karpenter_tpu.solver.validate import validate_gang_plan, validate_plan
+from karpenter_tpu.utils import metrics
+
+
+@pytest.fixture(scope="module")
+def catalog():
+    """Accelerator-heavy catalog: gx3 types carry tori up to (4, 4)."""
+    cloud = FakeCloud(profiles=generate_profiles(
+        30, families=("gx3", "bx2", "cx2")))
+    pricing = PricingProvider(cloud)
+    itp = InstanceTypeProvider(cloud, pricing)
+    arrays = CatalogArrays.build(itp.list())
+    pricing.close()
+    return arrays
+
+
+def gang_pods(name, n, *, min_member=None, shape=None, cpu=250, mem=512,
+              priority=0, deadline=120.0):
+    gang = PodGroup(name=name, min_member=min_member or n,
+                    slice_shape=shape, deadline_seconds=deadline)
+    return make_pods(n, name_prefix=name,
+                     requests=ResourceRequests(cpu, mem, 0, 1),
+                     priority=priority, gang=gang)
+
+
+# -- PodGroup API -----------------------------------------------------------
+
+class TestPodGroupAPI:
+    def test_parse_slice_shape_table(self):
+        assert parse_slice_shape("4x4") == (4, 4)
+        assert parse_slice_shape("2X2x2") == (2, 2, 2)
+        assert parse_slice_shape("8") == (8,)
+        assert parse_slice_shape((2, 4)) == (2, 4)
+        assert parse_slice_shape([2, 2]) == (2, 2)
+        assert parse_slice_shape(None) is None
+        assert parse_slice_shape("") is None
+
+    @pytest.mark.parametrize("bad", [
+        "4x", "x4", "4x4x4x4", "0x2", "2x-1", "a", "4.5", 4, 4.0,
+        (0, 2), (2, True), ("2", "2"), "9x9",        # 81 chips > 64
+    ])
+    def test_parse_slice_shape_rejects(self, bad):
+        with pytest.raises(ValueError):
+            parse_slice_shape(bad)
+
+    def test_podgroup_validation(self):
+        g = PodGroup("j", min_member=4, slice_shape="2x2")
+        assert g.chips == 4 and g.deadline_seconds == 120.0
+        assert g.signature() == ("j", 4, (2, 2))
+        with pytest.raises(ValueError):
+            PodGroup("", min_member=1)
+        with pytest.raises(ValueError):
+            PodGroup("j", min_member=0)
+        with pytest.raises(ValueError):
+            PodGroup("j", min_member=True)
+        with pytest.raises(ValueError):
+            PodGroup("j", deadline_seconds=0)
+        with pytest.raises(ValueError):
+            PodGroup("j", deadline_seconds=float("nan"))
+
+    def test_podspec_gang_strict(self):
+        with pytest.raises(ValueError):
+            PodSpec("p", gang={"name": "j"})
+        p = PodSpec("p", gang=PodGroup("j", min_member=2))
+        assert p.gang.name == "j"
+
+    def test_gang_splits_constraint_signature(self):
+        """A gang member and a lookalike singleton are never
+        interchangeable — and two different gangs never share a row."""
+        a = PodSpec("a", gang=PodGroup("g1", min_member=2))
+        b = PodSpec("b", gang=PodGroup("g2", min_member=2))
+        c = PodSpec("c")
+        assert a.constraint_signature() != b.constraint_signature()
+        assert a.constraint_signature() != c.constraint_signature()
+        assert a.signature_id() != c.signature_id()
+
+
+# -- torus topology ---------------------------------------------------------
+
+class TestTopology:
+    def test_default_torus_geometry(self):
+        assert default_torus(0) == ()
+        assert default_torus(2) == (2,)
+        assert default_torus(4) == (2, 2)
+        assert default_torus(8) == (2, 2, 2)
+        assert default_torus(16) == (4, 4)       # v5e mesh, hosts 4x4
+        assert default_torus(64) == (8, 8)
+        assert default_torus(12) == (12,)        # non-pow2: 1-D ring
+
+    def test_instancetype_override_and_catalog_column(self):
+        it = InstanceType(name="tpu-v4-16", cpu_milli=96000,
+                          memory_mib=131072, gpu=16, pods=110,
+                          architecture="amd64", family="tpu", size="16",
+                          torus=(4, 2, 2))
+        assert it.torus_dims == (4, 2, 2)
+        cat = CatalogArrays.build([it])
+        assert cat.type_torus == [(4, 2, 2)]
+
+    def test_enumerate_placements_counts_and_masks(self):
+        # 3x3 origins for a 2x2 window in a 4x4 mesh
+        pl = enumerate_placements((4, 4), (2, 2))
+        assert len(pl) == 9
+        assert all(mask_chips(m) == 4 for m in pl)
+        assert pl == tuple(sorted(pl))
+        # both orientations of a 2x4 window: 3 + 3
+        assert len(enumerate_placements((4, 4), (2, 4))) == 6
+        # the whole torus is one placement
+        assert len(enumerate_placements((2, 2, 2), (2, 2, 2))) == 1
+        # doesn't fit / no torus / too-big torus
+        assert enumerate_placements((2, 2), (4, 4)) == ()
+        assert enumerate_placements((), (2, 2)) == ()
+        assert enumerate_placements((8, 8, 8), (2, 2)) == ()
+        # 3-D shape can't land in a 2-D torus
+        assert enumerate_placements((4, 4), (2, 2, 2)) == ()
+
+    def test_slice_table_and_free_grid(self, catalog):
+        tab = slice_table(catalog, (2, 2))
+        assert tab.masks.shape[0] == catalog.num_offerings
+        with_placements = tab.count > 0
+        assert with_placements.any()
+        occ = np.zeros(catalog.num_offerings, dtype=np.uint64)
+        assert (tab.fits(occ) == with_placements).all()
+        # fully occupy every torus: nothing fits
+        full = np.full(catalog.num_offerings, np.uint64(0xFFFFFFFFFFFFFFFF))
+        assert not tab.fits(full).any()
+        # memoized per catalog generation
+        assert slice_table(catalog, (2, 2)) is tab
+
+
+# -- encoding ---------------------------------------------------------------
+
+class TestEncodeGangs:
+    def test_orders_priority_then_chips(self, catalog):
+        pods = (gang_pods("small", 2, shape="2x2")
+                + gang_pods("big", 2, shape="4x4")
+                + gang_pods("vip", 2, shape="2x2", priority=100))
+        prob = encode_gangs(pods, catalog)
+        assert [g.name for g in prob.gangs] == ["vip", "big", "small"]
+        assert prob.gang_prio.tolist() == [100, 0, 0]
+
+    def test_taints_reject_whole_gang(self, catalog):
+        pool = NodePool(name="t", taints=(Taint("dedicated", "x"),))
+        pods = gang_pods("g", 3)
+        prob = encode_gangs(pods, catalog, pool)
+        assert prob.num_gangs == 0
+        assert len(prob.rejected) == 3
+
+    def test_unhostable_shape_has_no_compat(self, catalog):
+        # no type's torus hosts an 8x8 slice in this catalog
+        prob = encode_gangs(gang_pods("huge", 2, shape="8x8"), catalog)
+        assert prob.num_gangs == 1
+        assert not prob.compat.any()
+
+
+# -- planner semantics ------------------------------------------------------
+
+def fingerprint(plan):
+    return (plan.placements,
+            [(n.offering_index,
+              [(a.gang, a.placement_mask, a.pod_names)
+               for a in n.assignments]) for n in plan.nodes])
+
+
+class TestPlanner:
+    def test_two_small_slices_share_one_torus_node(self, catalog):
+        """Two 2x2 gangs pack onto ONE (4, 4) torus when that node is
+        already open and cheaper than opening another."""
+        pods = gang_pods("a", 4, shape="2x2") + gang_pods("b", 4, shape="2x2")
+        prob = encode_gangs(pods, catalog)
+        plan = GangPlanner(GangOptions(use_device="off")).plan(prob)
+        assert len(plan.placed_gangs) == 2
+        assert validate_gang_plan(plan, pods, catalog) == []
+        if len(plan.nodes) == 1:
+            masks = [a.placement_mask for a in plan.nodes[0].assignments]
+            assert masks[0] & masks[1] == 0
+
+    def test_sub_min_member_gang_never_places(self, catalog):
+        pods = gang_pods("half", 2, min_member=4)
+        prob = encode_gangs(pods, catalog)
+        plan = GangPlanner().plan(prob)
+        assert plan.placed_count == 0
+        assert plan.unplaced_gangs == ["half"]
+
+    def test_impossible_gang_unplaced_whole(self, catalog):
+        pods = gang_pods("huge", 4, shape="8x8")
+        plan = GangPlanner().plan(encode_gangs(pods, catalog))
+        assert plan.placed_count == 0
+        assert len(plan.unplaced) == 4
+
+    def test_capacity_forces_second_node(self, catalog):
+        """Two 2x2 gangs whose combined cpu demand exceeds any single
+        torus node must land on two nodes, chips notwithstanding."""
+        alloc = catalog.offering_alloc()
+        tab = slice_table(catalog, (2, 2))
+        max_cpu = int(alloc[tab.count > 0, 0].max())
+        per_member = max_cpu // 4
+        pods = (gang_pods("a", 4, shape="2x2", cpu=per_member)
+                + gang_pods("b", 4, shape="2x2", cpu=per_member))
+        prob = encode_gangs(pods, catalog)
+        plan = GangPlanner().plan(prob)
+        assert len(plan.placed_gangs) == 2
+        assert len(plan.nodes) == 2
+        assert validate_gang_plan(plan, pods, catalog) == []
+
+
+class TestParity:
+    @pytest.mark.parametrize("seed", range(8))
+    def test_vector_equals_greedy(self, catalog, seed):
+        rng = np.random.RandomState(seed)
+        shapes = ["2x2", "2x2x2", "4x4", "2x2", None]
+        pods = []
+        for g in range(int(rng.randint(3, 10))):
+            size = int(rng.randint(2, 9))
+            pods += gang_pods(
+                f"s{seed}g{g}", size,
+                shape=shapes[int(rng.randint(len(shapes)))],
+                cpu=int(rng.randint(100, 2000)),
+                mem=int(rng.randint(256, 4096)),
+                priority=int(rng.choice([0, 0, 100])))
+        prob = encode_gangs(pods, catalog)
+        v = GangPlanner(GangOptions(use_device="off")).plan(prob)
+        g = GreedyGangPlanner().plan(prob)
+        assert fingerprint(v) == fingerprint(g)
+        assert v.unplaced_gangs == g.unplaced_gangs
+        assert abs(v.total_cost_per_hour - g.total_cost_per_hour) < 1e-6
+        assert validate_gang_plan(v, pods, catalog) == []
+
+    def test_device_kernel_parity(self, catalog):
+        pods = []
+        for g in range(6):
+            pods += gang_pods(f"d{g}", 4, shape="2x2" if g % 2 else "2x2x2")
+        prob = encode_gangs(pods, catalog)
+        on = GangPlanner(GangOptions(use_device="on")).plan(prob)
+        off = GangPlanner(GangOptions(use_device="off")).plan(prob)
+        assert fingerprint(on) == fingerprint(off)
+
+
+# -- degraded mode ----------------------------------------------------------
+
+class TestDegraded:
+    def test_backend_failure_degrades_to_greedy(self, catalog):
+        class Boom:
+            options = GangOptions()
+
+            def plan(self, problem):
+                raise RuntimeError("device on fire")
+
+        before = metrics.ERRORS.get("gang", "degraded_backend_failure")
+        rp = ResilientGangPlanner(primary=Boom())
+        pods = gang_pods("g", 4, shape="2x2")
+        plan = rp.plan(encode_gangs(pods, catalog))
+        assert plan.backend == "degraded:greedy"
+        assert len(plan.placed_gangs) == 1
+        assert metrics.ERRORS.get("gang", "degraded_backend_failure") \
+            == before + 1
+
+    def test_invalid_plan_degrades(self, catalog):
+        class Partial(GangPlanner):
+            def plan(self, problem):
+                p = super().plan(problem)
+                # corrupt: drop one member from the assignment row
+                n = p.nodes[0]
+                a = n.assignments[0]
+                n.assignments[0] = GangAssignment(
+                    gang=a.gang, placement_mask=a.placement_mask,
+                    pod_names=a.pod_names[1:])
+                return p
+
+        before = metrics.ERRORS.get("gang", "degraded_invalid_plan")
+        rp = ResilientGangPlanner(primary=Partial())
+        pods = gang_pods("g", 4, shape="2x2")
+        plan = rp.plan(encode_gangs(pods, catalog))
+        assert plan.backend == "degraded:greedy"
+        assert metrics.ERRORS.get("gang", "degraded_invalid_plan") \
+            == before + 1
+
+    def test_defect_catalog(self, catalog):
+        pods = gang_pods("g", 4, shape="2x2")
+        prob = encode_gangs(pods, catalog)
+        plan = GangPlanner().plan(prob)
+        assert gang_plan_defects(plan, prob) == []
+        # partial gang
+        import copy
+
+        broken = copy.deepcopy(plan)
+        a = broken.nodes[0].assignments[0]
+        broken.nodes[0].assignments[0] = GangAssignment(
+            gang=a.gang, placement_mask=a.placement_mask,
+            pod_names=a.pod_names[:2])
+        assert any("partial gang" in d
+                   for d in gang_plan_defects(broken, prob))
+        # unknown gang
+        broken2 = copy.deepcopy(plan)
+        broken2.nodes[0].assignments.append(GangAssignment(
+            gang="ghost", placement_mask=0, pod_names=("default/x",)))
+        assert any("unknown gang" in d
+                   for d in gang_plan_defects(broken2, prob))
+
+
+# -- independent validator --------------------------------------------------
+
+class TestValidateGangPlan:
+    def _plan(self, catalog, pods):
+        return GangPlanner().plan(encode_gangs(pods, catalog))
+
+    def test_overlapping_slices_flagged(self, catalog):
+        pods = gang_pods("a", 4, shape="2x2") + gang_pods("b", 4, shape="2x2")
+        plan = self._plan(catalog, pods)
+        two = [(ni, ai) for ni, n in enumerate(plan.nodes)
+               for ai, a in enumerate(n.assignments)]
+        # force b onto a's exact chips (same node or not, same mask)
+        (n0, a0), (n1, a1) = two[0], two[-1]
+        first = plan.nodes[n0].assignments[a0]
+        second = plan.nodes[n1].assignments[a1]
+        plan.nodes[n0].assignments[a1 if n0 == n1 else a0] = GangAssignment(
+            gang=second.gang if n0 == n1 else first.gang,
+            placement_mask=first.placement_mask,
+            pod_names=(second if n0 == n1 else first).pod_names)
+        if n0 == n1:
+            errs = validate_gang_plan(plan, pods, catalog)
+            assert any("overlaps" in e for e in errs)
+
+    def test_wrong_chip_count_and_bad_mask_flagged(self, catalog):
+        pods = gang_pods("a", 4, shape="2x2")
+        plan = self._plan(catalog, pods)
+        a = plan.nodes[0].assignments[0]
+        plan.nodes[0].assignments[0] = GangAssignment(
+            gang=a.gang, placement_mask=0b111, pod_names=a.pod_names)
+        errs = validate_gang_plan(plan, pods, catalog)
+        assert any("chips" in e for e in errs)
+
+    def test_split_gang_flagged(self, catalog):
+        pods = gang_pods("a", 4, shape="2x2")
+        plan = self._plan(catalog, pods)
+        node = plan.nodes[0]
+        a = node.assignments[0]
+        half1 = GangAssignment(a.gang, a.placement_mask, a.pod_names[:2])
+        half2 = GangAssignment(a.gang, a.placement_mask, a.pod_names[2:])
+        node.assignments[0] = half1
+        from karpenter_tpu.gang.types import GangNode
+
+        plan.nodes.append(GangNode(
+            instance_type=node.instance_type, zone=node.zone,
+            capacity_type=node.capacity_type, price=node.price,
+            offering_index=node.offering_index, assignments=[half2]))
+        plan.total_cost_per_hour += node.price
+        errs = validate_gang_plan(plan, pods, catalog)
+        assert any("split across" in e for e in errs)
+
+    def test_capacity_and_cost_flagged(self, catalog):
+        pods = gang_pods("a", 4, shape="2x2", cpu=250)
+        plan = self._plan(catalog, pods)
+        plan.total_cost_per_hour *= 3
+        errs = validate_gang_plan(plan, pods, catalog)
+        assert any("cost mismatch" in e for e in errs)
+
+
+# -- solver three-layer enforcement ----------------------------------------
+
+class TestSolverIntegration:
+    def test_encode_carries_gang_tensors(self, catalog):
+        pods = gang_pods("g", 3) + make_pods(
+            2, "s", requests=ResourceRequests(250, 512, 0, 1))
+        prob = encode(pods, catalog)
+        assert prob.has_gangs
+        assert prob.gang_names == ["g"]
+        gang_rows = prob.group_gang >= 0
+        assert prob.group_count[gang_rows].sum() == 3
+        assert (prob.group_min[gang_rows] == 3).all()
+
+    def test_gang_never_spread_split(self, catalog):
+        spread = (TopologySpreadConstraint(max_skew=1),)
+        pods = make_pods(6, "g",
+                         requests=ResourceRequests(250, 512, 0, 1),
+                         topology_spread=spread,
+                         gang=PodGroup("g", min_member=6))
+        prob = encode(pods, catalog)
+        gang_rows = int((prob.group_gang >= 0).sum())
+        assert gang_rows == 1          # spread would have split per zone
+
+    def test_greedy_transactional_rollback(self, catalog):
+        """A gang with one impossible member must not leave siblings
+        placed — and must not leak nodes opened for them."""
+        pods = gang_pods("g", 5, cpu=500)
+        pods.append(PodSpec("g-big",
+                            requests=ResourceRequests(10**7, 512, 0, 1),
+                            gang=pods[0].gang))
+        plan = GreedySolver(SolverOptions(backend="greedy")).solve(
+            SolveRequest(pods, catalog))
+        assert plan.placed_count == 0
+        assert not plan.nodes
+        assert len(plan.unplaced_pods) == 6
+        assert validate_plan(plan, pods, catalog) == []
+
+    def test_jax_decode_choke_strips_partial(self, catalog):
+        from karpenter_tpu.solver.jax_backend import JaxSolver
+
+        pods = gang_pods("g", 5, cpu=500)
+        pods.append(PodSpec("g-big",
+                            requests=ResourceRequests(10**7, 512, 0, 1),
+                            gang=pods[0].gang))
+        pods += make_pods(3, "ok",
+                          requests=ResourceRequests(250, 512, 0, 1))
+        plan = JaxSolver().solve(SolveRequest(pods, catalog))
+        placed = {pn for n in plan.nodes for pn in n.pod_names}
+        assert not any(pn.startswith("default/g") for pn in placed)
+        assert {f"default/ok-{i}" for i in range(3)} <= placed
+        assert validate_plan(plan, pods, catalog) == []
+
+    def test_validate_plan_flags_partial_gang(self, catalog):
+        """The validator is genuinely independent: feed it a hand-built
+        partial-gang plan and it must object."""
+        pods = gang_pods("g", 4, cpu=250)
+        plan = GreedySolver(SolverOptions(backend="greedy")).solve(
+            SolveRequest(pods, catalog))
+        assert plan.placed_count == 4
+        node = plan.nodes[0]
+        dropped = node.pod_names.pop()
+        plan.unplaced_pods.append(dropped)
+        errs = validate_plan(plan, pods, catalog)
+        assert any("partial placement" in e for e in errs)
+
+
+# -- admission controller ---------------------------------------------------
+
+class _Clock:
+    def __init__(self, t=1000.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+
+def _rig(catalog_families=("gx3", "bx2", "cx2")):
+    from karpenter_tpu.core.actuator import Actuator
+    from karpenter_tpu.core.circuitbreaker import (
+        CircuitBreakerConfig, CircuitBreakerManager,
+    )
+    from karpenter_tpu.core.provisioner import Provisioner
+    from karpenter_tpu.apis.nodeclass import (
+        InstanceRequirements, NodeClass, NodeClassSpec, PlacementStrategy,
+    )
+
+    cloud = FakeCloud(profiles=generate_profiles(
+        24, families=catalog_families))
+    pricing = PricingProvider(cloud)
+    itp = InstanceTypeProvider(cloud, pricing)
+    cluster = ClusterState()
+    nc = NodeClass(name="default", spec=NodeClassSpec(
+        region="us-south", image="img-1", vpc="vpc-1",
+        instance_requirements=InstanceRequirements(min_cpu=2),
+        placement_strategy=PlacementStrategy()))
+    nc.status.resolved_image_id = "img-1"
+    nc.status.set_condition("Ready", "True", "Test")
+    cluster.add_nodeclass(nc)
+    breaker = CircuitBreakerManager(CircuitBreakerConfig(
+        rate_limit_per_minute=10**6, max_concurrent_instances=10**6))
+    actuator = Actuator(cloud, cluster, breaker=breaker)
+    prov = Provisioner(cluster, itp, actuator)
+    clock = _Clock()
+    ctrl = GangAdmissionController(cluster, prov, clock=clock)
+    return cluster, prov, ctrl, clock, pricing
+
+
+class TestGangController:
+    def test_parks_then_admits_then_places_slice_gang(self):
+        cluster, prov, ctrl, clock, pricing = _rig()
+        try:
+            half = gang_pods("j", 2, min_member=4, shape="2x2")
+            for p in half:
+                cluster.add_pod(p)
+            # admission gate holds slice gangs out of ordinary windows
+            assert not ctrl.admit(half[0])
+            ctrl.reconcile()
+            assert "j" not in ctrl.admitted
+            assert metrics.GANG_PARKED.get() == 1.0
+            assert prov.provision_once() == []      # parked: no solve
+            # remainder arrives -> admit + place atomically
+            rest = make_pods(2, "j-rest",
+                             requests=ResourceRequests(250, 512, 0, 1),
+                             gang=half[0].gang)
+            for p in rest:
+                cluster.add_pod(p)
+            ctrl.reconcile()
+            assert "j" in ctrl.admitted
+            members = half + rest
+            claims = {cluster.get("pods", pod_key(p)).nominated_node
+                      for p in members}
+            assert len(claims) == 1 and "" not in claims
+            assert [r.gang for r in ctrl.placement_log] == ["j"]
+            rec = ctrl.placement_log[0]
+            assert len(rec.members) == rec.total_members == 4
+        finally:
+            pricing.close()
+
+    def test_non_slice_gang_released_to_solver_on_admit(self):
+        cluster, prov, ctrl, clock, pricing = _rig()
+        try:
+            pods = gang_pods("plain", 3)
+            for p in pods:
+                cluster.add_pod(p)
+            assert not ctrl.admit(pods[0])          # not admitted yet
+            ctrl.reconcile()
+            assert ctrl.admit(pods[0])
+            prov.provision_once()
+            claims = {cluster.get("pods", pod_key(p)).nominated_node
+                      for p in pods}
+            assert "" not in claims                 # all nominated
+        finally:
+            pricing.close()
+
+    def test_deadline_release_strips_gang(self):
+        cluster, prov, ctrl, clock, pricing = _rig()
+        try:
+            before = metrics.ERRORS.get("gang", "deadline_release")
+            half = gang_pods("starved", 2, min_member=4, deadline=30.0)
+            for p in half:
+                cluster.add_pod(p)
+            ctrl.reconcile()                        # parked, stamped
+            clock.t += 31.0
+            ctrl.reconcile()                        # deadline: release
+            assert "starved" in ctrl.released
+            for p in half:
+                pending = cluster.get("pods", pod_key(p))
+                assert pending.spec.gang is None    # degraded per-pod
+            assert metrics.ERRORS.get("gang", "deadline_release") \
+                == before + 1
+            # released members now pass any admission gate and place
+            prov.provision_once()
+            assert all(cluster.get("pods", pod_key(p)).nominated_node
+                       for p in half)
+        finally:
+            pricing.close()
+
+    def test_admitted_but_unplaceable_gang_releases_on_deadline(self):
+        # no accelerator types: the slice gang admits but can never place
+        cluster, prov, ctrl, clock, pricing = _rig(
+            catalog_families=("bx2", "cx2"))
+        try:
+            pods = gang_pods("doomed", 4, shape="2x2", deadline=30.0)
+            for p in pods:
+                cluster.add_pod(p)
+            ctrl.reconcile()
+            assert "doomed" in ctrl.admitted
+            assert all(not cluster.get("pods", pod_key(p)).nominated_node
+                       for p in pods)
+            clock.t += 31.0
+            ctrl.reconcile()
+            assert "doomed" in ctrl.released
+        finally:
+            pricing.close()
+
+
+# -- chaos invariants -------------------------------------------------------
+
+class TestGangInvariants:
+    def test_no_partial_gang_placed_fires_on_bad_record(self):
+        from karpenter_tpu.chaos.invariants import InvariantChecker
+        from karpenter_tpu.controllers.gang import GangPlacementRecord
+
+        cluster, prov, ctrl, clock, pricing = _rig()
+        try:
+            checker = InvariantChecker(
+                cluster, FakeCloud(), None, orphan_grace=1e9,
+                stuck_claim_grace=1e9, gang=ctrl)
+            ctrl.placement_log.append(GangPlacementRecord(
+                gang="bad", claim_name="c1",
+                members=("default/a", "default/b"),
+                total_members=4, min_member=4, backend="vector"))
+            out = checker._no_partial_gang_placed()
+            assert len(out) == 1
+            assert "2/4" in out[0].detail
+            assert not ctrl.placement_log          # drained
+            assert checker._no_partial_gang_placed() == []
+        finally:
+            pricing.close()
+
+    def test_gangs_resolve_or_release_fires_for_parked_forever(self):
+        from karpenter_tpu.chaos.invariants import InvariantChecker
+
+        cluster, prov, ctrl, clock, pricing = _rig()
+        try:
+            checker = InvariantChecker(
+                cluster, FakeCloud(), None, orphan_grace=1e9,
+                stuck_claim_grace=1e9, gang=ctrl)
+            catalog = prov._catalog_for(cluster.get_nodeclass("default"))
+            for p in gang_pods("stuck", 2, min_member=8):
+                cluster.add_pod(p)
+            out = checker._gangs_resolve_or_release(catalog)
+            assert len(out) == 2
+            assert all(v.invariant == "gangs-resolve-or-release"
+                       for v in out)
+            # unplaceable gangs are excused
+            for p in gang_pods("nohost", 2, shape="8x8"):
+                cluster.add_pod(p)
+            out2 = checker._gangs_resolve_or_release(catalog)
+            assert len(out2) == 2                  # still only 'stuck'
+        finally:
+            pricing.close()
+
+
+class TestReviewHardening:
+    """Regression pins for the PR-5 review findings."""
+
+    def test_gang_with_hard_spread_validates_clean(self, catalog):
+        """Gang co-placement supersedes topology spread: a gang carrying
+        a hard spread constraint must not be split by the encoder AND
+        must not be flagged by the validator's skew check."""
+        spread = (TopologySpreadConstraint(max_skew=1),)
+        pods = make_pods(6, "gs",
+                         requests=ResourceRequests(250, 512, 0, 1),
+                         topology_spread=spread,
+                         gang=PodGroup("gs", min_member=6))
+        plan = GreedySolver(SolverOptions(backend="greedy")).solve(
+            SolveRequest(pods, catalog))
+        assert plan.placed_count == 6
+        assert validate_plan(plan, pods, catalog) == []
+
+    def test_partially_nominated_gang_releases_on_deadline(self):
+        """A spanning gang whose creates half-failed (some members
+        nominated, a sub-min_member remainder pending) must still hit
+        the deadline release — the remainder can never place alone."""
+        cluster, prov, ctrl, clock, pricing = _rig()
+        try:
+            pods = gang_pods("span", 4, deadline=30.0)
+            for p in pods:
+                cluster.add_pod(p)
+            ctrl.reconcile()
+            assert "span" in ctrl.admitted
+            # simulate a half-failed actuation: two members nominated
+            for p in pods[:2]:
+                cluster.get("pods", pod_key(p)).nominated_node = "c-x"
+            clock.t += 31.0
+            ctrl.reconcile()
+            assert "span" in ctrl.released
+            for p in pods[2:]:
+                assert cluster.get("pods", pod_key(p)).spec.gang is None
+            # nominated members keep their nominations
+            assert cluster.get("pods", pod_key(pods[0])).nominated_node \
+                == "c-x"
+        finally:
+            pricing.close()
+
+    def test_gang_placeable_is_whole_gang_exact(self, catalog):
+        """gangs-resolve-or-release excuses a gang whose members fit
+        individually but whose TOTAL demand fits no single node."""
+        from karpenter_tpu.chaos.invariants import InvariantChecker
+
+        cluster, prov, ctrl, clock, pricing = _rig()
+        try:
+            checker = InvariantChecker(
+                cluster, FakeCloud(), None, orphan_grace=1e9,
+                stuck_claim_grace=1e9, gang=ctrl)
+            cat = prov._catalog_for(cluster.get_nodeclass("default"))
+            max_cpu = int(cat.offering_alloc()[:, 0].max())
+            # 8 members of ~max/4 cpu: each fits alone, total fits nowhere
+            for p in gang_pods("toobig", 8, cpu=max_cpu // 4):
+                cluster.add_pod(p)
+            assert checker._gangs_resolve_or_release(cat) == []
+        finally:
+            pricing.close()
+
+    def test_forced_device_without_kernel_raises(self, catalog,
+                                                 monkeypatch):
+        """use_device='on' with no usable kernel must fail loudly (and
+        degrade via ResilientGangPlanner), never silently compare host
+        against host."""
+        import karpenter_tpu.gang.planner as planner_mod
+
+        monkeypatch.setattr(planner_mod, "_device_free_grid", lambda: None)
+        # two gangs: the grid step only runs once a node is already open
+        pods = gang_pods("g", 4, shape="2x2") \
+            + gang_pods("h", 4, shape="2x2")
+        prob = encode_gangs(pods, catalog)
+        with pytest.raises(RuntimeError, match="forced on"):
+            GangPlanner(GangOptions(use_device="on")).plan(prob)
+        plan = ResilientGangPlanner(
+            primary=GangPlanner(GangOptions(use_device="on"))).plan(prob)
+        assert plan.backend == "degraded:greedy"
+        assert len(plan.placed_gangs) == 2
+
+    def test_released_set_is_bounded(self):
+        cluster, prov, ctrl, clock, pricing = _rig()
+        try:
+            ctrl._released_max = 2
+            for i in range(3):
+                pods = gang_pods(f"r{i}", 1, min_member=4, deadline=10.0)
+                for p in pods:
+                    cluster.add_pod(p)
+            ctrl.reconcile()
+            clock.t += 11.0
+            ctrl.reconcile()
+            assert len(ctrl.released) == 2
+            assert "r0" not in ctrl.released        # oldest evicted
+        finally:
+            pricing.close()
+
+
+def test_clear_topology_cache_is_idempotent():
+    clear_topology_cache()
+    clear_topology_cache()

@@ -19,6 +19,7 @@ from tools.graftlint.rules import all_rules
 
 SOLVER_PATH = "karpenter_tpu/solver/_snippet.py"
 PREEMPT_PATH = "karpenter_tpu/preempt/_snippet.py"
+GANG_PATH = "karpenter_tpu/gang/_snippet.py"
 CTRL_PATH = "karpenter_tpu/controllers/_snippet.py"
 CLOUD_PATH = "karpenter_tpu/cloud/_snippet.py"
 
@@ -161,6 +162,66 @@ def test_gl002_preempt_scope_eviction_scoring_good():
             return jnp.where(fit.sum() == 0, jnp.zeros_like(fit),
                              jnp.clip(fit, 0, None))
         """, "GL002", path=PREEMPT_PATH)
+
+
+def test_gl002_gang_scope_slice_mask_kernel_bad():
+    """The purity family covers karpenter_tpu/gang/: a tracer-bool in a
+    slice-mask kernel (early-exit on a traced free-placement count)
+    must fire GL002 there, same as in solver/ and preempt/."""
+    assert_flags(
+        """
+        import jax
+        import jax.numpy as jnp
+
+        @jax.jit
+        def free_grid(occ, masks, valid):
+            free = valid & ((masks & occ[:, None]) == 0)
+            if free.sum() == 0:       # traced bool: trace-time error
+                return jnp.zeros(occ.shape[0], bool)
+            return free.any(axis=1)
+        """, "GL002", path=GANG_PATH)
+
+
+def test_gl002_gang_scope_slice_mask_kernel_good():
+    assert_clean(
+        """
+        import jax
+        import jax.numpy as jnp
+
+        @jax.jit
+        def free_grid(occ, masks, valid):
+            free = valid & ((masks & occ[:, None]) == 0)
+            # branchless: an all-occupied grid just yields all-False
+            return free.any(axis=1)
+        """, "GL002", path=GANG_PATH)
+
+
+def test_gl003_gang_scope_per_plan_jit_bad():
+    """A slice-fit kernel rebuilt per plan call (jax.jit inside the
+    planner's hot path) is the recompile hazard GL003 exists for."""
+    assert_flags(
+        """
+        import jax
+
+        def plan_gang(occ, masks):
+            fit = jax.jit(lambda o, m: ((m & o[:, None]) == 0).any(1))
+            return fit(occ, masks)
+        """, "GL003", path=GANG_PATH)
+
+
+def test_gl003_gang_scope_cached_kernel_good():
+    assert_clean(
+        """
+        import functools
+        import jax
+
+        @functools.lru_cache(maxsize=1)
+        def _free_grid_kernel():
+            return jax.jit(lambda o, m: ((m & o[:, None]) == 0).any(1))
+
+        def plan_gang(occ, masks):
+            return _free_grid_kernel()(occ, masks)
+        """, "GL003", path=GANG_PATH)
 
 
 def test_gl003_recompile_bad():

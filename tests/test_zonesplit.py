@@ -211,3 +211,71 @@ class TestBatchedCandidates:
                     for n in b.nodes] == \
                 [(n.instance_type, n.zone, sorted(n.pod_names))
                  for n in s.nodes]
+
+
+class TestEmptyEligibleZones:
+    """A group whose requirements exclude EVERY zone (satellite, ISSUE 5):
+    the empty eligible offering set must degrade to "all pods unplaced",
+    never to an empty-but-'valid' plan that silently drops the pods from
+    accounting."""
+
+    def _catalog(self):
+        cloud = FakeCloud()
+        pricing = PricingProvider(cloud)
+        cat = CatalogArrays.build(InstanceTypeProvider(cloud, pricing).list())
+        pricing.close()
+        return cat
+
+    def _dead_zone_pods(self, n=4):
+        return [PodSpec(f"dz{i}", requests=ResourceRequests(500, 1024, 0, 1),
+                        node_selector=((LABEL_ZONE, "mars-north-1"),))
+                for i in range(n)]
+
+    @pytest.mark.parametrize("backend", ["greedy", "jax"])
+    def test_every_pod_lands_in_unplaced(self, backend):
+        cat = self._catalog()
+        pods = self._dead_zone_pods()
+        solver = GreedySolver(SolverOptions(backend="greedy")) \
+            if backend == "greedy" else JaxSolver()
+        plan = solver.solve(SolveRequest(pods, cat))
+        assert not plan.nodes
+        # the contract: pods are ACCOUNTED as unplaced, not dropped
+        assert sorted(plan.unplaced_pods) == \
+            sorted(f"default/dz{i}" for i in range(4))
+        assert validate_plan(plan, pods, cat) == []
+
+    def test_zone_affinity_with_no_viable_zone_degrades_cleanly(self):
+        """Zone-affinity (co-schedule) group whose requirement excludes
+        every zone: viable_zones is empty, so the candidate refinement
+        has nothing to refine — the solve must neither crash nor emit a
+        phantom placement."""
+        cat = self._catalog()
+        term = PodAffinityTerm(label_selector=(("app", "db"),),
+                               topology_key=LABEL_ZONE, anti=False)
+        pods = [PodSpec(f"aff{i}", requests=ResourceRequests(500, 1024, 0, 1),
+                        node_selector=((LABEL_ZONE, "mars-north-1"),),
+                        affinity=(term,), labels=(("app", "db"),))
+                for i in range(3)]
+        problem = encode(pods, cat)
+        assert affinity_candidates(problem) == []
+        for solver in (GreedySolver(SolverOptions(backend="greedy")),
+                       JaxSolver()):
+            plan = solver.solve(SolveRequest(pods, cat))
+            assert not plan.nodes
+            assert len(plan.unplaced_pods) == 3
+            assert validate_plan(plan, pods, cat) == []
+
+    def test_mixed_window_places_only_the_eligible(self):
+        """Dead-zone pods ride a window with placeable pods: the
+        eligible half places, the dead half is reported unplaced."""
+        cat = self._catalog()
+        pods = self._dead_zone_pods(3) + [
+            PodSpec(f"ok{i}", requests=ResourceRequests(250, 512, 0, 1))
+            for i in range(3)]
+        plan = GreedySolver(SolverOptions(backend="greedy")).solve(
+            SolveRequest(pods, cat))
+        placed = {pn for n in plan.nodes for pn in n.pod_names}
+        assert placed == {f"default/ok{i}" for i in range(3)}
+        assert sorted(plan.unplaced_pods) == \
+            sorted(f"default/dz{i}" for i in range(3))
+        assert validate_plan(plan, pods, cat) == []

@@ -27,6 +27,7 @@ DEFAULT_TARGETS = (
     "karpenter_tpu/solver",
     "karpenter_tpu/parallel",
     "karpenter_tpu/preempt",
+    "karpenter_tpu/gang",
     "karpenter_tpu/native.py",
     "bench.py",
     "karpenter_tpu/controllers",

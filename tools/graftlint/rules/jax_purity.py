@@ -22,6 +22,8 @@ FAMILY_A_SCOPE = (
     "karpenter_tpu/parallel/**/*",
     "karpenter_tpu/preempt/*",
     "karpenter_tpu/preempt/**/*",
+    "karpenter_tpu/gang/*",
+    "karpenter_tpu/gang/**/*",
     "karpenter_tpu/native.py",
     "bench.py",
 )

@@ -37,6 +37,11 @@ os.environ.setdefault("KARPENTER_SOLVER_BACKEND", "greedy")
 os.environ.setdefault("KARPENTER_METRICS_PORT", "0")  # ephemeral bind
 os.environ.setdefault("KARPENTER_WINDOW_IDLE_SECONDS", "0.1")
 os.environ.setdefault("KARPENTER_WINDOW_MAX_SECONDS", "1.0")
+# the provisioning wave + demo cycles create more nodes inside one
+# minute than the production breaker's 2/min budget — the smoke tests
+# the debug surface, not provisioning backpressure
+os.environ.setdefault("CIRCUIT_BREAKER_RATE_LIMIT_PER_MINUTE", "1000")
+os.environ.setdefault("CIRCUIT_BREAKER_MAX_CONCURRENT_INSTANCES", "1000")
 
 
 def _get(port: int, path: str) -> tuple[int, str, bytes]:
@@ -61,7 +66,14 @@ def main() -> int:
         if not cond:
             failures.append(what)
 
-    op = Operator(Options.from_env())
+    # accelerator-bearing fake cloud: the gang demo below places a
+    # slice-shaped gang, which needs types with torus dims (gx3)
+    from karpenter_tpu.cloud.fake import FakeCloud, generate_profiles
+
+    op = Operator(Options.from_env(),
+                  cloud=FakeCloud(region=os.environ["TPU_CLOUD_REGION"],
+                                  profiles=generate_profiles(
+                                      24, families=("gx3", "bx2", "cx2"))))
     nc = NodeClass(name="default", spec=NodeClassSpec(
         region=op.options.region, image="img-1", vpc="vpc-1",
         instance_requirements=InstanceRequirements(min_cpu=2),
@@ -127,6 +139,30 @@ def main() -> int:
               == "smoke-prey",
               "beneficiary nominated onto the freed node")
 
+        # demo gang cycle: a full slice-shaped gang is admitted and
+        # placed atomically on one torus node — exercises gang.admit/
+        # gang.place spans and the karpenter_tpu_gang_* families
+        # asserted below
+        print("demo gang cycle")
+        from karpenter_tpu.apis.podgroup import PodGroup
+        from karpenter_tpu.controllers.gang import GangAdmissionController
+
+        gc_ctrl = GangAdmissionController(op.cluster, op.provisioner)
+        gang = PodGroup(name="smoke-gang", min_member=4, slice_shape="2x2")
+        for pod in make_pods(4, name_prefix="smoke-gang",
+                             requests=ResourceRequests(250, 512, 0, 1),
+                             gang=gang):
+            op.cluster.add_pod(pod)
+        gc_ctrl.reconcile()
+        gang_pods = [op.cluster.get("pods", f"default/smoke-gang-{i}")
+                     for i in range(4)]
+        claims = {p.nominated_node for p in gang_pods}
+        check(len(claims) == 1 and "" not in claims,
+              f"gang placed atomically on one node (claims={claims})")
+        check([r.gang for r in gc_ctrl.placement_log] == ["smoke-gang"]
+              and len(gc_ctrl.placement_log[0].members) == 4,
+              "gang placement log carries the full membership")
+
         print("GET /metrics")
         status, ctype, body = _get(port, "/metrics")
         check(status == 200, f"/metrics status 200 (got {status})")
@@ -144,6 +180,16 @@ def main() -> int:
               "preemption candidate histogram rendered")
         check("karpenter_tpu_preemption_plan_seconds" in text,
               "preemption plan-latency histogram rendered")
+        check('karpenter_tpu_gang_admissions_total{outcome="admitted"} 1'
+              in text, "gang_admissions_total counted the demo admission")
+        check('karpenter_tpu_gang_placements_total{' in text,
+              "gang_placements_total counted the demo placement")
+        check("karpenter_tpu_gang_plan_seconds" in text,
+              "gang plan-latency histogram rendered")
+        check("karpenter_tpu_gang_parked" in text,
+              "gang parked gauge rendered")
+        check("karpenter_tpu_gang_members" in text,
+              "gang members histogram rendered")
 
         print("GET /statusz")
         status, ctype, body = _get(port, "/statusz")
@@ -174,6 +220,9 @@ def main() -> int:
               f"a provisioning trace is retained (roots={sorted(roots)})")
         check("preempt.plan" in roots,
               f"the demo preemption trace is retained "
+              f"(roots={sorted(roots)})")
+        check("gang.place" in roots,
+              f"the demo gang placement trace is retained "
               f"(roots={sorted(roots)})")
     finally:
         op.stop()
