@@ -47,8 +47,16 @@ chaos: ## Seeded chaos matrix (profiles x seeds, deterministic; docs/design/chao
 	$(TEST_ENV) $(PY) -m karpenter_tpu.chaos --seeds 4 --rounds 10 \
 		--trace-dir .chaos-traces
 
+.PHONY: soak
+soak: ## Simulated production day (composed chaos profiles) with SLO gates; report in .soak-report/
+	$(TEST_ENV) $(PY) -m karpenter_tpu.chaos --soak --report-dir .soak-report
+
+.PHONY: soak-short
+soak-short: ## CI-sized soak (same composition, fewer rounds)
+	$(TEST_ENV) $(PY) -m karpenter_tpu.chaos --soak --short --report-dir .soak-report
+
 .PHONY: smoke
-smoke: ## Debug-surface smoke: real operator, curl-equivalent checks on /metrics /statusz /debug/traces
+smoke: ## Debug-surface smoke: real operator, curl-equivalent checks on /metrics /statusz /debug/traces /debug/slo
 	JAX_PLATFORMS=cpu $(PY) tools/smoke_debug_surface.py
 
 .PHONY: chaos-replay

@@ -1,12 +1,14 @@
-"""Chaos harness CLI (`make chaos`).
+"""Chaos harness CLI (`make chaos`, `make soak`).
 
     python -m karpenter_tpu.chaos                         # full matrix
     python -m karpenter_tpu.chaos --seeds 4 --rounds 10
     python -m karpenter_tpu.chaos --profile spot-storm --seed 3   # replay
+    python -m karpenter_tpu.chaos --soak [--short]        # production day
     python -m karpenter_tpu.chaos --list-profiles
 
-Exit codes: 0 all invariants held and every trace was reproducible,
-1 any invariant violation or determinism failure, 2 usage error.
+Exit codes: 0 all invariants held and every trace was reproducible (for
+--soak: every SLO met, gate proven, no invariant violation), 1 any
+invariant violation / determinism failure / burned SLO, 2 usage error.
 """
 
 from __future__ import annotations
@@ -37,7 +39,24 @@ def main(argv: list[str] | None = None) -> int:
     ap.add_argument("--trace-dir", default=".chaos-traces",
                     help="where failing scenarios dump their event trace")
     ap.add_argument("--list-profiles", action="store_true")
+    ap.add_argument("--soak", action="store_true",
+                    help="run the composed production-day soak with SLO "
+                         "gates (docs/design/observability.md)")
+    ap.add_argument("--short", action="store_true",
+                    help="with --soak: the CI-sized short day")
+    ap.add_argument("--report-dir", default=".soak-report",
+                    help="with --soak: burn report + span bundle output")
     args = ap.parse_args(argv)
+
+    if args.soak:
+        from karpenter_tpu.chaos.soak import (
+            PRODUCTION_DAY, SHORT_DAY, run_soak,
+        )
+
+        res = run_soak(SHORT_DAY if args.short else PRODUCTION_DAY,
+                       seed=args.seed if args.seed is not None else 1,
+                       report_dir=args.report_dir)
+        return 0 if res.ok else 1
 
     if args.list_profiles:
         for name, p in {**PROFILES, **FIXTURE_PROFILES}.items():

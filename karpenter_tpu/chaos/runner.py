@@ -100,13 +100,18 @@ class ChaosHarness:
 
     def __init__(self, profile: ChaosProfile, seed: int, *,
                  rounds: int = 10, step: float = 60.0,
-                 quiesce_rounds: int = 4, quiesce_step: float = 1200.0):
+                 quiesce_rounds: int = 4, quiesce_step: float = 1200.0,
+                 clock: VirtualClock | None = None):
         self.profile = profile
         self.seed = seed
         self.rounds = rounds
         self.step = step
         self.quiesce_rounds = quiesce_rounds
         self.quiesce_step = quiesce_step
+        # injected clock (the soak measures each segment's virtual span
+        # to concatenate segments onto one day timeline); default is a
+        # fresh clock per run, same as always
+        self._inject_clock = clock
         # independent streams so cloud faults, workload shaping, and
         # solver faults cannot perturb each other's schedules
         self.rng_world = random.Random(f"{profile.name}:{seed}:world")
@@ -115,7 +120,7 @@ class ChaosHarness:
 
     def build(self) -> None:
         profile, seed = self.profile, self.seed
-        self.clock = VirtualClock()
+        self.clock = self._inject_clock or VirtualClock()
         self.trace = EventTrace()
         # gang profiles need accelerator types (torus dims for slice
         # placement); other profiles keep the default catalog so their

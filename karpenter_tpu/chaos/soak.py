@@ -1,0 +1,217 @@
+"""`make soak`: a simulated production day with SLO gates.
+
+Composes EXISTING chaos profiles into one day-shaped sequence on the
+VirtualClock — diurnal load ramps (pods_per_wave scaled per segment),
+a midday overload peak, an afternoon spot storm, evening gang waves —
+with ONE placement ledger accounting every pod across all segments.
+At the end the ledger is evaluated against the declarative SLO specs
+(obs/slo.py); a burned SLO fails the run with a burn-rate report that
+names the violating pods and the span bundle holding each one's causal
+chain.
+
+The gate is proven honest on EVERY run: a deliberately-unmeetable
+fixture SLO (threshold 0) is evaluated alongside the real ones and the
+soak fails unless that fixture actually burns — an SLO harness that
+cannot fail is decoration, not a gate.
+
+Latency thresholds are VIRTUAL seconds: scenario rounds advance the
+clock 60 s per beat and quiesce beats 1200 s, so a pod stranded behind
+the overload quota until recovery legitimately shows a multi-virtual-
+hour placement.  The recorder-overhead gate deliberately uses
+``perf_counter`` (unpatched) so it stays a real-microseconds bound.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from dataclasses import dataclass
+from pathlib import Path
+
+from karpenter_tpu import obs
+from karpenter_tpu.chaos.clock import VirtualClock
+from karpenter_tpu.chaos.profile import get_profile
+from karpenter_tpu.chaos.runner import ChaosHarness
+from karpenter_tpu.obs.export import dump_jsonl, recorder_to_dicts
+from karpenter_tpu.obs.ledger import PlacementLedger
+from karpenter_tpu.obs.slo import (
+    BROKEN_FIXTURE_SLO, DEFAULT_SOAK_SLOS, Measurement, SLOReport, SLOSpec,
+    evaluate_slos, ledger_measurements, slo_summary,
+)
+
+
+@dataclass(frozen=True)
+class SoakSegment:
+    """One stretch of the production day: an existing chaos profile run
+    for ``rounds`` beats with its wave size scaled by ``load``."""
+
+    profile: str
+    rounds: int
+    load: float = 1.0
+
+
+# The production day (full soak): calm overnight state, morning ramp of
+# API flake, midday overload peak (quota + mixed priorities), afternoon
+# spot storm, evening gang waves, load tapering off.
+PRODUCTION_DAY: tuple[SoakSegment, ...] = (
+    SoakSegment("calm", 3, 0.5),
+    SoakSegment("flaky-api", 4, 0.8),
+    SoakSegment("overload", 6, 1.5),
+    SoakSegment("spot-storm", 6, 1.2),
+    SoakSegment("gang", 6, 1.0),
+    SoakSegment("calm", 3, 0.4),
+)
+
+# CI-sized short profile (the `slow`-marked job): same composition,
+# fewer beats.  The overload peak runs 5 rounds at 2x load against the
+# 10-instance quota — enough beats for the seeded fault schedule to
+# strand pods across rounds and trigger the preemption plane (verified:
+# ~8 preemptions, placements up to ~21 virtual minutes), so the CI
+# day's latency gates see real nonzero samples.  A soak whose every pod
+# places within its arrival beat measures p99 = 0 and can never burn;
+# tests/test_slo.py pins the non-vacuousness.
+SHORT_DAY: tuple[SoakSegment, ...] = (
+    SoakSegment("calm", 2, 0.5),
+    SoakSegment("overload", 5, 2.0),
+    SoakSegment("spot-storm", 3, 1.0),
+    SoakSegment("gang", 3, 1.0),
+)
+
+# Extends the default specs with the day-end drain gate: every pod the
+# day produced must have resolved (virtual hours of quiesce are part of
+# the day — a pod still open at the end is stranded, not merely slow).
+SOAK_SLOS: tuple[SLOSpec, ...] = DEFAULT_SOAK_SLOS + (
+    SLOSpec(name="day-end-drain", objective="unresolved_pods",
+            threshold=0.0,
+            description="no pod is still unresolved when the production "
+                        "day ends (stranding, not latency)"),
+)
+
+
+@dataclass
+class SoakResult:
+    segments: list[dict]
+    report: SLOReport
+    gate_proven: bool              # the broken fixture SLO really burned
+    summary: dict
+    ledger_stats: dict
+    chaos_violations: int
+    report_path: str = ""
+
+    @property
+    def ok(self) -> bool:
+        return self.chaos_violations == 0 and self.report.ok \
+            and self.gate_proven
+
+
+def _scaled(profile, load: float):
+    lo, hi = profile.pods_per_wave
+    return dataclasses.replace(
+        profile, pods_per_wave=(max(1, round(lo * load)),
+                                max(1, round(hi * load))))
+
+
+def run_soak(segments: tuple[SoakSegment, ...] = PRODUCTION_DAY, *,
+             seed: int = 1, slos: tuple[SLOSpec, ...] = SOAK_SLOS,
+             report_dir: str = ".soak-report",
+             echo=print) -> SoakResult:
+    """Run the composed production day and gate it on the SLOs.  Every
+    segment's flight-recorder spans are dumped as a bundle next to the
+    burn report, and each violator row names its bundle."""
+    out_dir = Path(report_dir)
+    out_dir.mkdir(parents=True, exist_ok=True)
+    ledger = PlacementLedger(capacity=2048, error_capacity=512,
+                             sample_capacity=16384, max_open=65536)
+    seg_results: list[dict] = []
+    bundles: dict[str, str] = {}
+    chaos_violations = 0
+    rec_dropped = rec_total = 0
+    # cumulative day clock: each segment runs on its own VirtualClock
+    # (all anchored near the same real monotonic base), so segment
+    # samples are rebased onto one concatenated day timeline — the burn
+    # windows evaluate against coherent, monotonic day-seconds
+    day_t = 0.0
+    with obs.use_ledger(ledger):
+        for i, seg in enumerate(segments):
+            name = f"{i:02d}-{seg.profile}"
+            ledger.set_context(name)
+            profile = _scaled(get_profile(seg.profile), seg.load)
+            clock = VirtualClock()
+            mono0 = clock.monotonic()
+            since = ledger.sample_count
+            harness = ChaosHarness(profile, seed, rounds=seg.rounds,
+                                   clock=clock)
+            violations = harness.run()
+            ledger.rebase_recent(since, day_t - mono0)
+            day_t += clock.monotonic() - mono0
+            chaos_violations += len(violations)
+            rstats = harness.recorder.stats()
+            rec_dropped += rstats["dropped_spans"]
+            rec_total += rstats["traces_total"] + rstats["instants_total"]
+            bundle = out_dir / f"{name}-spans.jsonl"
+            dump_jsonl(recorder_to_dicts(harness.recorder), bundle)
+            bundles[name] = str(bundle)
+            stats = ledger.stats()
+            seg_results.append({
+                "segment": name, "rounds": seg.rounds, "load": seg.load,
+                "chaos_violations": [v.render() for v in violations],
+                "resolved_so_far": stats["resolved_total"],
+                "open_records": stats["open_records"],
+                "bundle": bundles[name],
+            })
+            echo(f"segment {name:<16} rounds={seg.rounds} "
+                 f"load={seg.load:.1f} violations={len(violations)} "
+                 f"resolved={stats['resolved_total']} "
+                 f"open={stats['open_records']} "
+                 f"day_t={day_t:.0f}s")
+
+    measurements = ledger_measurements(
+        ledger,
+        extra={
+            "recorder_dropped_fraction": Measurement(
+                value=rec_dropped / max(1, rec_total)),
+            "unresolved_pods": Measurement(
+                value=float(ledger.stats()["open_records"]),
+                violators=[rec.to_dict()
+                           for rec in ledger.open_records(8)]),
+        })
+    report = evaluate_slos(list(slos), measurements, at=day_t)
+    # attach each violator's span bundle (its segment's dump)
+    for r in report.results:
+        for v in r.violators:
+            ctx = v.get("context", "")
+            if ctx in bundles:
+                v["bundle"] = bundles[ctx]
+    # prove the gate can fail: the fixture SLO is unmeetable by
+    # construction, so it MUST burn — if it doesn't (e.g. the ledger
+    # resolved nothing and every latency reads 0.0), the gate is inert
+    # and the soak fails loudly instead of green-washing
+    proof = evaluate_slos([BROKEN_FIXTURE_SLO], measurements, at=day_t)
+    gate_proven = not proof.ok
+
+    result = SoakResult(
+        segments=seg_results, report=report, gate_proven=gate_proven,
+        summary=slo_summary(ledger), ledger_stats=ledger.stats(),
+        chaos_violations=chaos_violations)
+    report_path = out_dir / "slo_report.json"
+    report_path.write_text(json.dumps({
+        "ok": result.ok,
+        "gate_proven": gate_proven,
+        "chaos_violations": chaos_violations,
+        "report": report.to_dict(),
+        "summary": result.summary,
+        "ledger": result.ledger_stats,
+        "segments": seg_results,
+    }, indent=2, default=str))
+    result.report_path = str(report_path)
+
+    echo(report.render())
+    if not gate_proven:
+        echo("GATE NOT PROVEN: the deliberately-broken fixture SLO did "
+             "not burn — the soak resolved nothing measurable")
+    if chaos_violations:
+        echo(f"chaos invariants: {chaos_violations} violation(s) — see "
+             f"segment entries in {report_path}")
+    echo(f"soak report: {report_path}")
+    echo(f"SOAK {'PASS' if result.ok else 'FAIL'}")
+    return result

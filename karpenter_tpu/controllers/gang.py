@@ -137,6 +137,9 @@ class GangAdmissionController(PollController):
                 self.admitted.add(name)
                 metrics.GANG_ADMISSIONS.labels("admitted").inc()
                 metrics.GANG_MEMBERS.observe(len(members))
+                for p in members:
+                    obs.get_ledger().transition(pod_key(p.spec),
+                                                "gang.admit")
                 with obs.span("gang.admit", gang=name,
                               members=len(members),
                               min_member=spec.min_member,
@@ -170,6 +173,11 @@ class GangAdmissionController(PollController):
                 self._release(name, members, spec)
             else:
                 parked += 1
+                # deduped transition: the 5s reconcile loop stamps
+                # "gang.park" once per park episode, not once per tick
+                for p in members:
+                    obs.get_ledger().transition(pod_key(p.spec),
+                                                "gang.park")
         metrics.GANG_PARKED.set(parked)
         if to_place:
             self._place_slice_gangs(to_place)
@@ -183,6 +191,9 @@ class GangAdmissionController(PollController):
         for p in members:
             p.spec = dataclasses.replace(p.spec, gang=None)
             p.enqueued_at = 0.0
+            # flags the record: a later nomination resolves as
+            # outcome "placed_degraded", feeding the degraded-rate SLO
+            obs.get_ledger().transition(pod_key(p.spec), "gang.release")
         while len(self.released) >= self._released_max:
             self.released.pop(next(iter(self.released)))
         self.released[name] = None

@@ -12,12 +12,13 @@ from __future__ import annotations
 import time
 
 from karpenter_tpu.apis.nodeclaim import Node, NodeClaim, parse_provider_id
-from karpenter_tpu.apis.pod import Taint
+from karpenter_tpu.apis.pod import Taint, pod_key
 from karpenter_tpu.cloud.errors import CloudError, NodeClaimNotFoundError, is_not_found
 from karpenter_tpu.controllers.runtime import PollController, Result, WatchController
 from karpenter_tpu.core.actuator import KARPENTER_TAGS, Actuator
 from karpenter_tpu.core.bootstrap import TAINT_UNREGISTERED
 from karpenter_tpu.core.cluster import ClusterState
+from karpenter_tpu import obs
 from karpenter_tpu.utils import metrics
 from karpenter_tpu.utils.logging import get_logger
 
@@ -75,6 +76,13 @@ class RegistrationController(WatchController):
             self.cluster.update("nodeclaims", key, claim)
             self.cluster.record_event("NodeClaim", claim.name, "Normal",
                                       "Registered", f"node {node.name}")
+            # SLO ledger: every pod nominated onto this claim now has a
+            # full first-seen -> registered latency (the true end-to-end
+            # leg including cloud create + boot + join)
+            ledger = obs.get_ledger()
+            for pending in self.cluster.pending_pods():
+                if pending.nominated_node == claim.name:
+                    ledger.registered(pod_key(pending.spec))
             changed = True
         if claim.registered and not claim.initialized and node.ready:
             claim.initialized = True

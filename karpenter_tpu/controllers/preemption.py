@@ -210,6 +210,9 @@ class PreemptionController(PollController):
                 pending.bound_node = ""
                 pending.nominated_node = ""
                 pending.enqueued_at = 0.0   # immediate re-window
+                # SLO ledger: the victim's placement clock restarts —
+                # its re-placement resolves as outcome "replaced"
+                obs.get_ledger().reopen(ev.pod_key, "preempted")
                 executed += 1
             metrics.PREEMPTIONS.labels("priority").inc()
             self.cluster.record_event(
@@ -230,6 +233,7 @@ class PreemptionController(PollController):
                     or pending.nominated_node:
                 continue
             pending.nominated_node = claim_name
+            obs.get_ledger().resolve(pn, "placed")
             placed += 1
             self.cluster.record_event(
                 "Pod", pn, "Normal", "PreemptionPlaced",

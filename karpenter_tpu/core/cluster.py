@@ -30,6 +30,7 @@ from typing import Any
 from karpenter_tpu.apis.nodeclaim import Node, NodeClaim, NodePool
 from karpenter_tpu.apis.nodeclass import NodeClass
 from karpenter_tpu.apis.pod import PodSpec
+from karpenter_tpu import obs
 from karpenter_tpu.utils.logging import get_logger
 
 log = get_logger("core.cluster")
@@ -194,7 +195,12 @@ class ClusterState:
         return self.add("nodepools", np_.name, np_)
 
     def add_pod(self, pod: PodSpec) -> PendingPod:
-        return self.add("pods", f"{pod.namespace}/{pod.name}", PendingPod(spec=pod))
+        key = f"{pod.namespace}/{pod.name}"
+        # the pod's placement clock starts HERE — this is the API-server
+        # intake every path (operator watch, chaos harness, tests) shares,
+        # so the SLO ledger's first-seen stamp cannot miss an entry point
+        obs.get_ledger().first_seen(key)
+        return self.add("pods", key, PendingPod(spec=pod))
 
     def pending_pods(self) -> list[PendingPod]:
         return self.list("pods", lambda p: not p.bound_node)
@@ -223,6 +229,7 @@ class ClusterState:
             p = self._collections["pods"].get(pod_key)
             if p is not None:
                 p.bound_node = node_name
+        obs.get_ledger().stamp(pod_key, "bound", dedupe=True)
 
     def add_nodeclaim(self, claim: NodeClaim) -> NodeClaim:
         return self.add("nodeclaims", claim.name, claim)

@@ -238,6 +238,10 @@ class Provisioner:
             if not pods:
                 return [], {}
         with obs.span("provision.cycle", pods=len(pods)) as sp:
+            # SLO ledger: this cycle consumed these pods against the
+            # cluster state as of NOW — solve_start stamps them and
+            # refreshes the pending-staleness gauge (obs/ledger.py)
+            obs.get_ledger().solve_start([pod_key(p) for p in pods])
             plans, nominated = self._provision_pools(pods)
             sp.set("plans", len(plans))
             sp.set("nominated", len(nominated))
@@ -302,6 +306,10 @@ class Provisioner:
                                                         catalog, usage)
                 for pn in dropped:
                     limit_dropped.setdefault(pn, pool.name)
+                # plan decoded: the snapshot this solve consumed is now
+                # this stale (solver-staleness SLO source)
+                obs.get_ledger().plan_decoded(
+                    [pn for node in plan.nodes for pn in node.pod_names])
                 if not plan.nodes:
                     continue
                 actuator = self.actuator_for(nodeclass)
@@ -463,6 +471,11 @@ class Provisioner:
         pending = self.cluster.get("pods", key)
         if pending is not None:
             pending.nominated_node = node_name
+            # terminal ledger edge: placement decision latency observed
+            # into karpenter_tpu_pod_placement_seconds{outcome}; the
+            # ambient span (fired window / gang.place) supplies the
+            # trace id /debug/slo links tail pods through
+            obs.get_ledger().resolve(key, "placed")
 
     def _pools(self) -> list[NodePool]:
         pools = self.cluster.list("nodepools")

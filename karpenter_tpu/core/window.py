@@ -24,10 +24,16 @@ class WindowOptions:
     max_pods: int = 10000           # solve immediately at this many
 
     def to_batcher(self) -> BatcherOptions:
+        from karpenter_tpu.apis.pod import pod_key
+
+        # ledger_key: every pod added to the solve window gets its
+        # window_enqueue stamp, and each fired window links its trace id
+        # into the placement ledger (obs/ledger.py)
         return BatcherOptions(idle_timeout=self.idle_seconds,
                               max_timeout=self.max_seconds,
                               max_items=self.max_pods,
-                              name="solve-window")
+                              name="solve-window",
+                              ledger_key=pod_key)
 
 
 class SolveWindow:

@@ -25,8 +25,14 @@ from contextlib import contextmanager
 from karpenter_tpu.obs.trace import (  # noqa: F401 (public API re-exports)
     FlightRecorder, Span, Tracer, current_span, now,
 )
+# AFTER trace: importing the ledger pulls in utils.metrics, whose
+# package __init__ imports the batcher, which imports this package —
+# the batcher's class body reads ``obs.now``, so the trace re-exports
+# must already be bound when that re-entrant import observes us.
+from karpenter_tpu.obs.ledger import PlacementLedger  # noqa: F401,E402
 
 _tracer = Tracer()
+_ledger = PlacementLedger()
 
 
 def get_tracer() -> Tracer:
@@ -35,6 +41,10 @@ def get_tracer() -> Tracer:
 
 def get_recorder() -> FlightRecorder:
     return _tracer.recorder
+
+
+def get_ledger() -> PlacementLedger:
+    return _ledger
 
 
 def span(name: str, **kwargs) -> Span:
@@ -74,6 +84,20 @@ def use(tracer: Tracer):
         yield tracer
     finally:
         _tracer = prev
+
+
+@contextmanager
+def use_ledger(ledger: PlacementLedger):
+    """Route ledger stamps through ``ledger`` for the block — the soak
+    harness installs a fresh one so a production-day run's accounting
+    never mixes with ambient process state (mirrors :func:`use`)."""
+    global _ledger
+    prev = _ledger
+    _ledger = ledger
+    try:
+        yield ledger
+    finally:
+        _ledger = prev
 
 
 def phase_durations(prefix: str = "solve.") -> dict[str, list[float]]:

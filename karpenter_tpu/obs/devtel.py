@@ -1,0 +1,162 @@
+"""Device telemetry: recompiles, transfer bytes, donation misses.
+
+BENCH_r05 shows the solve path is >98% transfer/dispatch overhead
+(exec_fetch ~70 ms vs ~1.2 ms compute; encode_cold ~105-117 ms), but
+those numbers were inferred from bench tails — nothing measured them
+continuously on the LIVE solve path.  This module is the direct
+instrumentation the device-resident-state refactor (ROADMAP item 1)
+optimizes against:
+
+- **Recompile events** — every dispatch carries a static-shape
+  signature (kernel path + padded G/O/U/N + output layout); a signature
+  this process has never dispatched implies an XLA trace+compile (the
+  jit cache is keyed by exactly these static args).  Counted per kernel
+  and per constraint-signature bucket in
+  ``karpenter_tpu_jit_recompiles_total{kernel,bucket}``.
+- **Executable-cache hit ratio** — hits/(hits+misses) over the same
+  signatures (``karpenter_tpu_executable_cache_events_total{event}``),
+  surfaced on ``/statusz`` and ``/debug/slo``.
+- **H2D / D2H bytes** — packed-problem uploads, catalog tensor
+  re-uploads, and fetched result buffers
+  (``karpenter_tpu_device_transfer_bytes_total{direction}`` plus the
+  per-window ``karpenter_tpu_solve_h2d_bytes`` histogram).
+- **Donation misses** — dispatches whose input was a fresh host array
+  instead of a donated device-resident buffer
+  (``karpenter_tpu_donation_misses_total{site}``): the per-window
+  re-upload debt ROADMAP-1 eliminates.
+
+All accounting happens at DISPATCH level on the host — never inside a
+jit-traced function, where a metric call would silently become a
+trace-time no-op (graftlint GL107 enforces this over solver/, parallel/,
+preempt/ and gang/).
+"""
+
+from __future__ import annotations
+
+import threading
+
+from karpenter_tpu.utils import metrics
+
+# distinct static-shape signatures are bounded by the bucket ladders;
+# this cap is a leak backstop, far above any real combination count
+MAX_SIGNATURES = 4096
+
+
+class DeviceTelemetry:
+    """Thread-safe counters for the live solve path's device traffic."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        # insertion-ordered so the cap evicts FIFO — a plain set would
+        # stop admitting at the cap and then count every post-cap
+        # signature as a fresh recompile on EVERY dispatch, permanently
+        # inflating the exact counter ROADMAP-1 gates its before/after on
+        self._signatures: dict[tuple, None] = {}
+        self.dispatches = 0
+        self.recompiles = 0
+        self.cache_hits = 0
+        self.h2d_bytes = 0
+        self.d2h_bytes = 0
+        self.catalog_uploads = 0
+        self.catalog_upload_bytes = 0
+        self.donation_misses = 0
+        self.donation_miss_bytes = 0
+
+    # -- accounting ----------------------------------------------------------
+
+    def note_dispatch(self, kernel: str, signature: tuple, *,
+                      h2d_bytes: int = 0, donated: bool = True,
+                      backend: str = "jax") -> bool:
+        """One kernel dispatch.  Returns True when the signature was new
+        (an executable-cache miss => recompile event)."""
+        sig = (kernel, signature)
+        with self._lock:
+            new = sig not in self._signatures
+            if new:
+                while len(self._signatures) >= MAX_SIGNATURES:
+                    self._signatures.pop(next(iter(self._signatures)))
+                self._signatures[sig] = None
+            self.dispatches += 1
+            if new:
+                self.recompiles += 1
+            else:
+                self.cache_hits += 1
+            if h2d_bytes:
+                self.h2d_bytes += h2d_bytes
+            if not donated:
+                self.donation_misses += 1
+                self.donation_miss_bytes += h2d_bytes
+        bucket = self._bucket(signature)
+        if new:
+            metrics.JIT_RECOMPILES.labels(kernel, bucket).inc()
+        metrics.EXEC_CACHE.labels("miss" if new else "hit").inc()
+        if h2d_bytes:
+            metrics.TRANSFER_BYTES.labels("h2d").inc(h2d_bytes)
+            metrics.SOLVE_H2D_BYTES.labels(backend).observe(h2d_bytes)
+        if not donated:
+            metrics.DONATION_MISSES.labels(kernel).inc()
+        return new
+
+    def note_catalog_upload(self, nbytes: int) -> None:
+        """Catalog tensors re-uploaded (device-catalog cache miss)."""
+        with self._lock:
+            self.catalog_uploads += 1
+            self.catalog_upload_bytes += nbytes
+            self.h2d_bytes += nbytes
+        metrics.TRANSFER_BYTES.labels("h2d").inc(nbytes)
+
+    def note_d2h(self, nbytes: int) -> None:
+        with self._lock:
+            self.d2h_bytes += nbytes
+        metrics.TRANSFER_BYTES.labels("d2h").inc(nbytes)
+
+    # -- readout -------------------------------------------------------------
+
+    @staticmethod
+    def _bucket(signature: tuple) -> str:
+        """Constraint-signature bucket label: the padded problem shape
+        (the jit cache key's dominant axis), kept low-cardinality."""
+        dims = [str(v) for v in signature
+                if isinstance(v, int) and not isinstance(v, bool)][:3]
+        return "x".join(dims) if dims else "scalar"
+
+    def hit_ratio(self) -> float:
+        with self._lock:
+            total = self.cache_hits + self.recompiles
+            return self.cache_hits / total if total else 1.0
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            total = self.cache_hits + self.recompiles
+            return {
+                "dispatches": self.dispatches,
+                "recompiles": self.recompiles,
+                "executable_cache_hits": self.cache_hits,
+                "executable_cache_hit_ratio":
+                    round(self.cache_hits / total, 4) if total else 1.0,
+                "h2d_bytes": self.h2d_bytes,
+                "d2h_bytes": self.d2h_bytes,
+                "catalog_uploads": self.catalog_uploads,
+                "catalog_upload_bytes": self.catalog_upload_bytes,
+                "donation_misses": self.donation_misses,
+                "donation_miss_bytes": self.donation_miss_bytes,
+            }
+
+    def reset(self) -> None:
+        """Bench section isolation (signatures survive — the process's
+        compiled executables don't evaporate between sections)."""
+        with self._lock:
+            self.dispatches = self.recompiles = self.cache_hits = 0
+            self.h2d_bytes = self.d2h_bytes = 0
+            self.catalog_uploads = self.catalog_upload_bytes = 0
+            self.donation_misses = self.donation_miss_bytes = 0
+
+
+# process-wide singleton: dispatch sites are module functions/methods
+# spread across solver/ and parallel/, and the refactor's before/after
+# comparison needs ONE ledger of device traffic
+DEVTEL = DeviceTelemetry()
+
+
+def get_devtel() -> DeviceTelemetry:
+    return DEVTEL

@@ -157,6 +157,18 @@ class FlightRecorder:
 
     # -- ingestion ----------------------------------------------------------
 
+    def _drop_locked(self) -> None:
+        """Bounded-memory drop accounting: the process counter AND the
+        scrapeable karpenter_tpu_recorder_dropped_spans_total series (a
+        recorder silently shedding evidence is itself an SLO signal).
+        Lazy import: utils.metrics' package __init__ imports the
+        batcher, which imports obs — a module-top import here would
+        re-enter this module half-built."""
+        self.dropped_spans += 1
+        from karpenter_tpu.utils import metrics
+
+        metrics.RECORDER_DROPPED.inc()
+
     def add(self, span: Span) -> None:
         """A span completed.  Root completion finalizes the trace into a
         ring slot; non-root spans accumulate under their open trace."""
@@ -173,15 +185,15 @@ class FlightRecorder:
                     if len(done[3]) < self.MAX_SPANS_PER_TRACE:
                         done[3].append(span)
                     else:
-                        self.dropped_spans += 1
+                        self._drop_locked()
                     return
                 if len(self._open) >= self.MAX_OPEN_TRACES:
                     # a leaked (never-closed) root must not grow memory
                     self._open.pop(next(iter(self._open)))
-                    self.dropped_spans += 1
+                    self._drop_locked()
                 spans = self._open[span.trace_id] = []
             if len(spans) >= self.MAX_SPANS_PER_TRACE:
-                self.dropped_spans += 1
+                self._drop_locked()
             else:
                 spans.append(span)
             if span.parent_id == 0:
@@ -230,9 +242,9 @@ class FlightRecorder:
 
     def stats(self) -> dict:
         with self._lock:
+            retained = sum(1 for t in self._ring if t is not None)
             return {
-                "traces_retained": sum(1 for t in self._ring
-                                       if t is not None),
+                "traces_retained": retained,
                 "traces_total": self._n_ring,
                 "error_traces_retained": sum(1 for t in self._err_ring
                                              if t is not None),
@@ -240,6 +252,10 @@ class FlightRecorder:
                 "instants_total": self._n_instants,
                 "open_traces": len(self._open),
                 "dropped_spans": self.dropped_spans,
+                "capacity": self.capacity,
+                "error_capacity": self.error_capacity,
+                "ring_occupancy": round(retained / self.capacity, 4)
+                if self.capacity else 0.0,
             }
 
 

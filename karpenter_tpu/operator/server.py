@@ -17,9 +17,12 @@ Debug surface (docs/design/observability.md):
 - ``GET /debug/traces[?status=error&min_ms=10&limit=20]`` — recent
   traces from the process flight recorder (karpenter_tpu.obs), newest
   first, errors never evicted by successes;
+- ``GET /debug/slo`` — live SLO evaluation over the placement ledger
+  (worst-case pods with trace ids, burn state, device telemetry);
 - ``GET /statusz`` — uptime, build identity, last solve breakdown,
-  leader / circuit-breaker state (the operator wires its own extras in
-  via the ``statusz`` callback).
+  ledger + recorder + device-telemetry snapshots, leader /
+  circuit-breaker state (the operator wires its own extras in via the
+  ``statusz`` callback).
 
 stdlib http.server on a daemon thread — no extra dependencies.
 """
@@ -113,6 +116,8 @@ class MetricsServer:
                 elif self.path.split("?", 1)[0] == "/debug/traces":
                     self._json_endpoint(
                         lambda: outer._debug_traces(self.path))
+                elif self.path.split("?", 1)[0] == "/debug/slo":
+                    self._json_endpoint(outer._debug_slo)
                 elif self.path.split("?", 1)[0] == "/statusz":
                     self._json_endpoint(outer._statusz)
                 elif self.path == "/healthz":
@@ -220,16 +225,32 @@ class MetricsServer:
             min_duration_ms=one("min_ms", 0.0, float),
             limit=one("limit", 50, int))
 
+    def _debug_slo(self) -> dict:
+        """Live SLO evaluation over the placement ledger: burn state per
+        default SLO, the worst-case pod table (trace ids link into
+        /debug/traces), and the device-telemetry snapshot
+        (docs/design/observability.md)."""
+        from karpenter_tpu import obs
+        from karpenter_tpu.obs.slo import debug_slo_payload
+
+        return debug_slo_payload(obs.get_ledger(),
+                                 recorder=obs.get_recorder())
+
     def _statusz(self) -> dict:
         from karpenter_tpu import obs
+        from karpenter_tpu.obs.devtel import get_devtel
         from karpenter_tpu.version import get_version
 
+        ledger = obs.get_ledger()
         out = {
             "uptime_s": round(time.time() - self._started_at, 3),
             "version": get_version(),
             "ready": bool(self._ready()),
             "recorder": obs.get_recorder().stats(),
             "last_solve_phases_ms": obs.last_solve_breakdown(),
+            "ledger": ledger.stats(),
+            "pending_staleness_s": round(ledger.pending_staleness(), 6),
+            "device_telemetry": get_devtel().snapshot(),
         }
         if self._statusz_extra is not None:
             out.update(self._statusz_extra())

@@ -51,6 +51,7 @@ def shard_map(f, *, mesh, in_specs, out_specs, check_rep=False):
     return _shard_map(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
                       **{_CHECK_KW: check_rep})
 
+from karpenter_tpu.obs.devtel import get_devtel
 from karpenter_tpu.parallel.mesh import FLEET_AXIS, OFFER_AXIS
 from karpenter_tpu.solver.jax_backend import _fit_counts, _right_size, solve_core
 
@@ -207,6 +208,14 @@ def fleet_solve_pallas(problem: FleetProblem, *, num_nodes: int,
             min(compact_cap if compact_cap is not None else compact, G * N))
 
     def dispatch(K):
+        # device telemetry at DISPATCH level (never inside the traced
+        # kernel — GL107): a host-numpy input is an H2D upload and a
+        # donation miss; a new (C,G,O,U,N,K) signature is a recompile
+        host_input = isinstance(ins, np.ndarray)
+        get_devtel().note_dispatch(
+            "fleet-pallas", (C, G, O, U_pad, N, K, right_size),
+            h2d_bytes=int(ins.nbytes) if host_input else 0,
+            donated=not host_input)
         out_dev = fleet_packed_pallas(
             ins, alloc8_all, rank_all, price_all,
             C=C, G=G, O=O, U=U_pad, N=N, right_size=right_size,
@@ -224,6 +233,7 @@ def fleet_solve_pallas(problem: FleetProblem, *, num_nodes: int,
         K, dev = K0, out_dev
         while True:
             out_np = np.asarray(dev)
+            get_devtel().note_d2h(int(out_np.nbytes))
             if K > 0 and K < coo_state.cap and any(
                     coo_buffer_full(out_np[c], G, N, K) for c in range(C)):
                 K = grow_coo(K, coo_state.cap)
@@ -280,8 +290,12 @@ def fleet_solve_pallas_sharded(problem: FleetProblem, mesh: Mesh, *,
     while True:
         f = _fleet_pallas_sharded_jit(mesh, C // n, G, O, U_pad, N,
                                       right_size, interpret, K)
+        get_devtel().note_dispatch(
+            "fleet-pallas-sharded", (n, C, G, O, U_pad, N, K, right_size),
+            h2d_bytes=int(ins.nbytes), donated=False)
         out_np = np.asarray(f(jnp.asarray(ins), alloc8_all,
                               rank_all, price_all))
+        get_devtel().note_d2h(int(out_np.nbytes))
         if K > 0 and K < K_cap and any(
                 coo_buffer_full(out_np[c], G, N, K) for c in range(C)):
             K = grow_coo(K, K_cap)
@@ -297,10 +311,19 @@ def fleet_solve(problem: FleetProblem, mesh: Mesh, *, num_nodes: int,
     (node_off [C,N], assign [C,G,N], unplaced [C,G], cost [C]).
     """
     f = _fleet_solve_jit(mesh, num_nodes, right_size)
+    h2d = sum(int(a.nbytes) for a in (
+        problem.group_req, problem.group_count, problem.group_cap,
+        problem.compat, problem.off_alloc, problem.off_price,
+        problem.off_rank) if isinstance(a, np.ndarray))
+    get_devtel().note_dispatch(
+        "fleet-scan", problem.compat.shape + (num_nodes, right_size),
+        h2d_bytes=h2d, donated=h2d == 0)
     out = f(problem.group_req, problem.group_count, problem.group_cap,
             problem.compat, problem.off_alloc, problem.off_price,
             problem.off_rank)
-    return tuple(np.asarray(o) for o in out)
+    res = tuple(np.asarray(o) for o in out)
+    get_devtel().note_d2h(sum(int(o.nbytes) for o in res))
+    return res
 
 
 @functools.lru_cache(maxsize=64)

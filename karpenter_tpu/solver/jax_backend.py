@@ -44,6 +44,7 @@ from karpenter_tpu.solver.types import (
     OFFERING_BUCKETS, Plan, PlannedNode, SolveRequest, SolverOptions, bucket,
 )
 from karpenter_tpu import obs
+from karpenter_tpu.obs.devtel import get_devtel
 from karpenter_tpu.utils import metrics
 from karpenter_tpu.utils.logging import get_logger
 
@@ -1032,6 +1033,7 @@ class JaxSolver:
             metrics.SOLVE_PATH.labels(path).inc()
             d2h = int(out_np.nbytes)
             metrics.SOLVE_D2H_BYTES.labels("jax").observe(d2h)
+            get_devtel().note_d2h(d2h)
             # exec_fetch_s spans async device EXECUTION + D2H together (a
             # separate sync before the fetch would cost one more tunnel
             # round trip); pure chip time is measured out-of-band by
@@ -1147,6 +1149,12 @@ class JaxSolver:
             break
         metrics.SOLVE_PATH.labels("scan-batch").inc()
         metrics.SOLVE_D2H_BYTES.labels("jax").observe(int(out_np.nbytes))
+        get_devtel().note_d2h(int(out_np.nbytes))
+        get_devtel().note_dispatch(
+            "scan-batch",
+            (G_pad, O_pad, U_pad, N, C_pad, K, dense16, coo16,
+             self.options.right_size),
+            h2d_bytes=int(rows.nbytes), donated=False)
         self.last_stats = {
             "path": "scan-batch", "batch": C, "batch_pad": C_pad,
             "wall_s": t_fetch - t_disp, "dispatch_s": t_issued - t_issue,
@@ -1280,6 +1288,24 @@ class JaxSolver:
                          packed=packed, dense16_ok=max_slots < (1 << 15),
                          pref_rows=pref_rows, pref_idx=pref_idx)
 
+    @staticmethod
+    def _note_dispatch(path: str, prep: "_Prepared", arr, N: int,
+                       extra: tuple = ()) -> None:
+        """Device telemetry for one dispatch (obs/devtel.py): the static
+        signature below mirrors the jit cache key (static_argnames of
+        the solve_packed* kernels), so a new signature IS a recompile;
+        a host-numpy input is an H2D upload AND a donation miss (the
+        packed buffer is rebuilt per window instead of living donated
+        on device — ROADMAP-1's target).  Host-side only — never called
+        from inside a traced function (graftlint GL107)."""
+        host_input = isinstance(arr, np.ndarray)
+        get_devtel().note_dispatch(
+            path,
+            (prep.G_pad, prep.O_pad, prep.U_pad, N, prep.K,
+             prep.dense16, prep.coo16) + tuple(extra),
+            h2d_bytes=int(arr.nbytes) if host_input else 0,
+            donated=not host_input)
+
     def _dispatch(self, prep: "_Prepared", arr):
         """Issue the packed solve (pallas with scan fallback).  ``arr`` is
         the packed input — host numpy (implicit single H2D) or an already
@@ -1298,6 +1324,8 @@ class JaxSolver:
                 else prep.right_size
             lam = self.options.preference_lambda \
                 if prep.pref_lambda is None else prep.pref_lambda
+            self._note_dispatch("scan-pref", prep, arr, N,
+                                (prep.pref_rows.shape[0], rs))
             out = solve_packed_pref(
                 arr, prep.pref_rows, prep.pref_idx,
                 off_alloc, off_price, off_rank,
@@ -1326,6 +1354,7 @@ class JaxSolver:
                     prep.K0, prep.dense16_ok, G_pad, Np)
                 rs = self.options.right_size if prep.right_size is None \
                     else prep.right_size
+                self._note_dispatch("pallas", prep, arr, Np, (rs,))
                 out = solve_packed_pallas(
                     arr, alloc8, rank_row, price_dev,
                     G=G_pad, O=O_pad, U=prep.U_pad, N=Np,
@@ -1345,6 +1374,7 @@ class JaxSolver:
             prep.K0, prep.dense16_ok, G_pad, N)
         rs = self.options.right_size if prep.right_size is None \
             else prep.right_size
+        self._note_dispatch("scan", prep, arr, N, (rs,))
         out = solve_packed(
             arr, off_alloc, off_price, off_rank,
             G=G_pad, O=O_pad, U=prep.U_pad, N=N,
@@ -1435,6 +1465,8 @@ class JaxSolver:
             cached = (jax.device_put(alloc8), jax.device_put(rank_row),
                       jax.device_put(price))
             self._device_catalog[key] = cached
+            get_devtel().note_catalog_upload(
+                int(alloc8.nbytes + rank_row.nbytes + price.nbytes))
         return cached
 
     def _device_offerings(self, catalog, O_pad: int):
@@ -1449,6 +1481,8 @@ class JaxSolver:
             cached = (jax.device_put(off_alloc), jax.device_put(off_price),
                       jax.device_put(off_rank))
             self._device_catalog[key] = cached
+            get_devtel().note_catalog_upload(
+                int(off_alloc.nbytes + off_price.nbytes + off_rank.nbytes))
         return cached
 
     def _decode(self, problem: EncodedProblem, node_off, assign, unplaced,
@@ -1542,6 +1576,7 @@ class PendingSolve:
             cost = float(out_np[N + G:N + G + 1].view(np.float32)[0])
             metrics.SOLVE_PATH.labels(path).inc()
             metrics.SOLVE_D2H_BYTES.labels("jax").observe(int(out_np.nbytes))
+            get_devtel().note_d2h(int(out_np.nbytes))
             solver.last_stats = {
                 "path": path, "wall_s": t_fetch - t_disp,
                 "dispatch_s": t_issued - t_disp,
@@ -1641,6 +1676,11 @@ class BatchPendingSolve:
                 right_size=solver.options.right_size,
                 compact=self._K, dense16=self._dense16, coo16=self._coo16)
             self._path = "scan-batch"
+        get_devtel().note_dispatch(
+            self._path,
+            (G, O, p0.U_pad, self._N_run, self._C_pad, self._K,
+             self._dense16, self._coo16, solver.options.right_size),
+            h2d_bytes=int(self._rows.nbytes), donated=False)
         try:
             self._dev.copy_to_host_async()
         except Exception:  # noqa: BLE001 — cpu arrays
@@ -1701,6 +1741,7 @@ class BatchPendingSolve:
                 continue
             metrics.SOLVE_PATH.labels(self._path).inc()
             metrics.SOLVE_D2H_BYTES.labels("jax").observe(int(out_np.nbytes))
+            get_devtel().note_d2h(int(out_np.nbytes))
             solver.last_stats = {
                 "path": self._path, "batch": self._C,
                 "batch_pad": self._C_pad,

@@ -50,6 +50,11 @@ class BatcherOptions:
     max_items: int = 200          # fire immediately at this many items
     max_workers: int = 8          # executor pool bound (ref caps at 100)
     name: str = "batcher"
+    # item -> placement-ledger key (None = this batcher carries items
+    # the SLO ledger doesn't track).  The solve window sets pod_key so
+    # enqueue is stamped per pod and each fired window links its trace
+    # id to the pods it carried (obs/ledger.py).
+    ledger_key: Callable | None = None
 
 
 def one_bucket_hasher(item) -> Hashable:
@@ -111,6 +116,9 @@ class Batcher(Generic[T, U]):
             self._bucket_last[bucket] = now
             p = _Pending(item)
             pendings.append(p)
+            if self._opts.ledger_key is not None:
+                obs.get_ledger().stamp(self._opts.ledger_key(item),
+                                       "window_enqueue", t=p.enqueued_at)
             self._cv.notify()
             return p.future
 
@@ -168,6 +176,12 @@ class Batcher(Generic[T, U]):
         with obs.span(f"batch.window:{self._opts.name}",
                       start=min(p.enqueued_at for p in batch),
                       batcher=self._opts.name, items=len(batch)) as sp:
+            if self._opts.ledger_key is not None:
+                # the fired window's trace id becomes each pod's bundle
+                # link: /debug/slo tail entries point at THIS trace
+                obs.get_ledger().link_trace(
+                    [self._opts.ledger_key(p.item) for p in batch],
+                    sp.trace_id)
             for p in batch[:_INTAKE_SPAN_CAP]:
                 obs.record("pod.intake", p.enqueued_at, t_fire, parent=sp,
                            item=_item_label(p.item))

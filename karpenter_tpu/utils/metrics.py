@@ -265,6 +265,22 @@ SOLVE_D2H_BYTES = Histogram(
     "karpenter_tpu_solve_d2h_bytes",
     "Device->host result bytes per solve", ("backend",),
     buckets=(1 << 10, 1 << 13, 1 << 16, 1 << 18, 1 << 20, 1 << 22, 1 << 24))
+# Solve phases live in a bimodal ms-scale regime (BENCH_r05: sub-ms
+# compute vs exec_fetch ~70 ms and encode_cold ~105-117 ms).  The old
+# ladder jumped 0.05 -> 0.1 -> 0.25, flattening the entire 50-250 ms
+# band — where the DOMINANT costs live — into two buckets, so p99 was a
+# bucket edge, not a measurement.  Dense coverage over 10-250 ms;
+# boundaries are pinned by tests/test_slo.py::TestBucketTuning.
+SOLVE_PHASE_BUCKETS = (
+    0.00005, 0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005,
+    0.01, 0.02, 0.035, 0.05, 0.065, 0.08, 0.1, 0.13, 0.17,
+    0.25, 0.5, 1.0, 2.5)
+# Pod-to-placement spans batching windows (seconds) through retry loops
+# (minutes): sub-second decision latency still resolves, and the tail
+# reaches the chaos soak's virtual-hours regime without saturating +Inf.
+POD_PLACEMENT_BUCKETS = (
+    0.005, 0.025, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0,
+    60.0, 120.0, 300.0, 600.0, 1800.0, 3600.0)
 SOLVE_PHASE = Histogram(
     "karpenter_tpu_solve_phase_seconds",
     "Per-phase solve latency: encode (host encode+pack), h2d (H2D upload "
@@ -272,8 +288,7 @@ SOLVE_PHASE = Histogram(
     "separable through the async fetch without an extra round trip), "
     "d2h (host-side result unpack/decode).  Fed from the SAME "
     "measurements as the obs span layer so the two agree.", ("phase",),
-    buckets=(0.00005, 0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005,
-             0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0))
+    buckets=SOLVE_PHASE_BUCKETS)
 # Preemption plane (karpenter_tpu/preempt + controllers/preemption.py).
 PREEMPTIONS = Counter(
     "karpenter_tpu_preemptions_total",
@@ -311,6 +326,60 @@ GANG_PLAN_DURATION = Histogram(
     "karpenter_tpu_gang_plan_seconds",
     "Gang placement plan latency (encode + batched slice grid)",
     ("backend",))
+# SLO ledger plane (karpenter_tpu/obs/ledger.py + obs/slo.py).
+POD_PLACEMENT = Histogram(
+    "karpenter_tpu_pod_placement_seconds",
+    "End-to-end pod lifecycle latency by outcome: placed (first-seen -> "
+    "nominated), placed_degraded (same, after a gang deadline release), "
+    "replaced (re-placement after a preemption eviction), registered "
+    "(first-seen -> the nominated claim's node registered).  Tail "
+    "observations carry their trace id in the ledger so /debug/slo "
+    "links worst-case pods to retained flight-recorder bundles.",
+    ("outcome",), buckets=POD_PLACEMENT_BUCKETS)
+PENDING_STALENESS = Gauge(
+    "karpenter_tpu_pending_staleness_seconds",
+    "Staleness by kind: oldest_pod (age of the oldest unresolved pod in "
+    "the placement ledger), solve_snapshot (age of the cluster-state "
+    "snapshot the last solve consumed when its plan was decoded)",
+    ("kind",))
+RECORDER_DROPPED = Counter(
+    "karpenter_tpu_recorder_dropped_spans_total",
+    "Spans the flight recorder dropped to stay bounded (open-trace cap, "
+    "span-per-trace cap, late arrivals past the cap)", ())
+LEDGER_DROPPED = Counter(
+    "karpenter_tpu_ledger_dropped_records_total",
+    "Pod lifecycle records the placement ledger dropped to stay bounded "
+    "(open-record cap; errors are retained in a separate ring and never "
+    "evicted by successes)", ())
+
+# Device telemetry (karpenter_tpu/obs/devtel.py): direct instrumentation
+# for the device-resident-state refactor (ROADMAP item 1).
+JIT_RECOMPILES = Counter(
+    "karpenter_tpu_jit_recompiles_total",
+    "Executable-cache misses per kernel and constraint-signature bucket: "
+    "a dispatch whose static-shape signature (path, G, O, U, N, output "
+    "layout) was never seen by this process implies an XLA trace+compile",
+    ("kernel", "bucket"))
+EXEC_CACHE = Counter(
+    "karpenter_tpu_executable_cache_events_total",
+    "Solve dispatches by executable-cache outcome (hit = signature "
+    "already compiled this process); hit/(hit+miss) is the cache ratio "
+    "surfaced on /statusz and /debug/slo", ("event",))
+TRANSFER_BYTES = Counter(
+    "karpenter_tpu_device_transfer_bytes_total",
+    "Host<->device payload bytes moved by the live solve path, by "
+    "direction (h2d includes packed problem uploads and catalog tensor "
+    "re-uploads; d2h is fetched result buffers)", ("direction",))
+SOLVE_H2D_BYTES = Histogram(
+    "karpenter_tpu_solve_h2d_bytes",
+    "Host->device packed-problem bytes per solve window", ("backend",),
+    buckets=(1 << 10, 1 << 13, 1 << 16, 1 << 18, 1 << 20, 1 << 22, 1 << 24))
+DONATION_MISSES = Counter(
+    "karpenter_tpu_donation_misses_total",
+    "Dispatches whose input buffer was a fresh host array (re-uploaded, "
+    "not donated device-resident state) — the transfer-overhead debt the "
+    "device-resident refactor pays down, counted per call site", ("site",))
+
 LEADER = Gauge(
     "karpenter_tpu_leader",
     "1 when this replica holds the named leader-election lease", ("lease",))

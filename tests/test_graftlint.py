@@ -646,6 +646,83 @@ def test_gl106_enter_context_good():
         """, "GL106", CTRL_PATH)
 
 
+def test_gl107_metric_in_jitted_kernel_bad():
+    assert_flags(
+        """
+        import functools
+        import jax
+        from karpenter_tpu.utils import metrics
+
+        @functools.partial(jax.jit, static_argnames=("G",))
+        def solve_packed(packed, *, G):
+            out = packed * 2
+            # trace-time no-op: never re-executes after compile
+            metrics.SOLVE_PHASE.labels("compute").observe(0.001)
+            return out
+        """, "GL107", SOLVER_PATH)
+
+
+def test_gl107_span_in_scanned_step_bad():
+    assert_flags(
+        """
+        from jax import lax
+        from karpenter_tpu import obs
+
+        def solve(state0, inputs):
+            def step(state, x):
+                obs.record("solve.step", 0.0, 0.001)
+                return state + x, x
+            return lax.scan(step, state0, inputs)
+        """, "GL107", PREEMPT_PATH)
+
+
+def test_gl107_metric_constant_in_kernel_bad():
+    assert_flags(
+        """
+        import jax
+        from karpenter_tpu.utils.metrics import SOLVE_PATH
+
+        @jax.jit
+        def kernel(x):
+            SOLVE_PATH.labels("pallas").inc()
+            return x * 2
+        """, "GL107", GANG_PATH)
+
+
+def test_gl107_dispatch_level_telemetry_good():
+    assert_clean(
+        """
+        import functools
+        import jax
+        from karpenter_tpu.obs.devtel import get_devtel
+
+        @functools.partial(jax.jit, static_argnames=("G",))
+        def solve_packed(packed, *, G):
+            return packed * 2
+
+        def dispatch(prep, arr):
+            # host-side accounting around the traced call is the contract
+            get_devtel().note_dispatch("scan", (prep.G,),
+                                       h2d_bytes=int(arr.nbytes),
+                                       donated=False)
+            return solve_packed(arr, G=prep.G)
+        """, "GL107", SOLVER_PATH)
+
+
+def test_gl107_jnp_at_set_not_flagged():
+    assert_clean(
+        """
+        import jax
+        import jax.numpy as jnp
+
+        @jax.jit
+        def kernel(assign, idx, val):
+            # x.at[i].set(v) terminates in .set — must never trip GL107
+            out = assign.at[idx].set(val)
+            return out.max(), out.astype(jnp.int32)
+        """, "GL107", SOLVER_PATH)
+
+
 # -- suppressions -----------------------------------------------------------
 
 def test_per_line_suppression():

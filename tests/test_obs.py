@@ -245,6 +245,69 @@ class TestExport:
         doc2 = ox.debug_traces(tracer.recorder, min_duration_ms=1e9)
         assert doc2["traces"] == []
 
+    def test_instants_round_trip_both_formats(self, tracer, tmp_path):
+        """Loose instants (no enclosing span) must survive JSONL AND
+        Chrome export with their attributes — PR 3 only pinned the
+        solve-chain spans."""
+        obs.instant("pod.event", pod="ns/a", wave=3)
+        obs.instant("cb.transition", nodeclass="default", to="open")
+        obs.instant("gang.release", gang="g1", members=2)
+        dicts = ox.recorder_to_dicts(tracer.recorder)
+        inst = {d["name"]: d for d in dicts if d.get("instant")}
+        assert set(inst) == {"pod.event", "cb.transition", "gang.release"}
+        assert inst["pod.event"]["attrs"] == {"pod": "ns/a", "wave": 3}
+        loaded = ox.load_jsonl(ox.dump_jsonl(dicts,
+                                             tmp_path / "i.jsonl"))
+        chrome = ox.dicts_to_chrome(loaded)
+        i_events = {e["name"]: e for e in chrome["traceEvents"]
+                    if e["ph"] == "i"}
+        assert {"pod.event", "cb.transition", "gang.release"} \
+            <= set(i_events)
+        assert i_events["pod.event"]["args"]["pod"] == "ns/a"
+        assert i_events["gang.release"]["args"]["members"] == 2
+
+    def test_preempt_and_gang_span_families_round_trip(self, tracer,
+                                                       tmp_path):
+        """The preempt.* / gang.* span families (PRs 4-5) through both
+        export formats: names, attrs, and parent linkage intact."""
+        with obs.span("preempt.plan", pool="default", pending=3) as plan:
+            plan.set("backend", "vector")
+            with obs.span("preempt.evict", pod="ns/lo", claim="c1",
+                          victim_priority=0, beneficiary_priority=100):
+                pass
+        obs.instant("preempt.executed", pool="default", evictions=1)
+        with obs.span("gang.admit", gang="g1", members=4,
+                      min_member=4):
+            pass
+        with obs.span("gang.place", pool="default", gangs=1) as gp:
+            gp.set("backend", "vector")
+        dicts = ox.recorder_to_dicts(tracer.recorder)
+        by_name = {}
+        for d in dicts:
+            by_name.setdefault(d["name"], d)
+        assert {"preempt.plan", "preempt.evict", "preempt.executed",
+                "gang.admit", "gang.place"} <= set(by_name)
+        evict, plan_d = by_name["preempt.evict"], by_name["preempt.plan"]
+        assert evict["parent_id"] == plan_d["span_id"]
+        assert evict["trace_id"] == plan_d["trace_id"]
+        assert evict["attrs"]["beneficiary_priority"] == 100
+        assert plan_d["attrs"]["backend"] == "vector"
+        assert by_name["gang.admit"]["attrs"]["min_member"] == 4
+        # JSONL round trip preserves everything
+        loaded = ox.load_jsonl(ox.dump_jsonl(dicts, tmp_path / "p.jsonl"))
+        assert loaded == json.loads(json.dumps(dicts, default=str))
+        # chrome: plan/evict/admit/place are complete (X) events,
+        # preempt.executed is an instant
+        chrome = ox.dicts_to_chrome(loaded)
+        ph = {e["name"]: e["ph"] for e in chrome["traceEvents"]
+              if e["name"] != "process_name"}
+        assert ph["preempt.plan"] == "X" and ph["gang.place"] == "X"
+        assert ph["preempt.executed"] == "i"
+        # evict shares its parent's tid row (same trace lane)
+        lanes = {e["name"]: e.get("tid")
+                 for e in chrome["traceEvents"] if "tid" in e}
+        assert lanes["preempt.evict"] == lanes["preempt.plan"]
+
     def test_cli_export_chrome(self, tmp_path, capsys):
         from karpenter_tpu.obs.__main__ import main
 

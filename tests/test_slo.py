@@ -1,0 +1,451 @@
+"""SLO ledger + device telemetry + soak-gate tests.
+
+Covers the second observability layer (docs/design/observability.md):
+placement-ledger lifecycle semantics and bounds, the retuned histogram
+buckets (pinned), device-telemetry accounting through a real JaxSolver
+solve, declarative SLO evaluation (including the proof that a broken
+spec FAILS), the end-to-end park->admit->place stamp ordering for a
+gang pod, and the short production-day soak (slow tier).
+"""
+
+from __future__ import annotations
+
+import time
+
+import pytest
+
+from karpenter_tpu import obs
+from karpenter_tpu.obs.devtel import DeviceTelemetry
+from karpenter_tpu.obs.ledger import PlacementLedger
+from karpenter_tpu.obs.slo import (
+    BROKEN_FIXTURE_SLO, DEFAULT_SOAK_SLOS, Measurement, SLOSpec,
+    debug_slo_payload, evaluate_slos, ledger_measurements, quantile,
+    slo_summary,
+)
+from karpenter_tpu.utils import metrics
+
+
+@pytest.fixture
+def ledger():
+    led = PlacementLedger(capacity=8, error_capacity=4, max_open=16)
+    with obs.use_ledger(led):
+        yield led
+
+
+# ---------------------------------------------------------------------------
+# ledger lifecycle semantics
+# ---------------------------------------------------------------------------
+
+class TestLedger:
+    def test_stamp_ordering_through_resolution(self, ledger):
+        ledger.first_seen("ns/p", t=10.0)
+        ledger.stamp("ns/p", "window_enqueue", t=10.5)
+        ledger.solve_start(["ns/p"], t=11.0)
+        ledger.plan_decoded(["ns/p"], t=11.2)
+        ledger.resolve("ns/p", "placed", t=12.0, trace_id=7)
+        rec = ledger.get("ns/p")
+        assert rec.stamp_names() == ["first_seen", "window_enqueue",
+                                     "solve_start", "plan_decode",
+                                     "nominated"]
+        assert rec.outcome == "placed"
+        assert rec.duration_s == pytest.approx(2.0)
+        assert rec.trace_id == 7
+        assert metrics.POD_PLACEMENT.count("placed") >= 1
+
+    def test_first_seen_idempotent_while_open(self, ledger):
+        ledger.first_seen("ns/p", t=1.0)
+        ledger.first_seen("ns/p", t=5.0)   # must not restart the clock
+        assert ledger.get("ns/p").first_seen == 1.0
+
+    def test_registered_observes_second_outcome(self, ledger):
+        ledger.first_seen("ns/p", t=1.0)
+        ledger.resolve("ns/p", "placed", t=2.0)
+        before = metrics.POD_PLACEMENT.count("registered")
+        ledger.registered("ns/p", t=6.0)
+        assert metrics.POD_PLACEMENT.count("registered") == before + 1
+        assert ledger.get("ns/p").stamp_names()[-1] == "registered"
+
+    def test_gang_release_flag_degrades_outcome(self, ledger):
+        ledger.first_seen("ns/g", t=1.0)
+        ledger.transition("ns/g", "gang.park", t=1.5)
+        ledger.transition("ns/g", "gang.park", t=2.0)   # deduped
+        ledger.transition("ns/g", "gang.release", t=3.0)
+        ledger.resolve("ns/g", "placed", t=4.0)
+        rec = ledger.get("ns/g")
+        assert rec.outcome == "placed_degraded"
+        assert rec.stamp_names().count("gang.park") == 1
+
+    def test_preemption_reopen_restarts_clock(self, ledger):
+        ledger.first_seen("ns/v", t=1.0)
+        ledger.resolve("ns/v", "placed", t=2.0)
+        ledger.reopen("ns/v", "preempted", t=50.0)
+        ledger.resolve("ns/v", "placed", t=53.0)
+        rec = ledger.get("ns/v")
+        assert rec.outcome == "replaced"
+        assert rec.duration_s == pytest.approx(3.0)   # not 52.0
+
+    def test_staleness_high_water_and_snapshot(self, ledger):
+        ledger.first_seen("ns/old", t=0.0)
+        ledger.first_seen("ns/new", t=90.0)
+        ledger.solve_start(["ns/new"], t=100.0)
+        assert ledger.staleness_high_water == pytest.approx(100.0)
+        ledger.plan_decoded(["ns/new"], t=103.5)
+        assert ledger.snapshot_staleness() == pytest.approx(3.5)
+        assert metrics.PENDING_STALENESS.get("solve_snapshot") \
+            == pytest.approx(3.5)
+
+    def test_worst_table_carries_trace_ids(self, ledger):
+        for i in range(6):
+            key = f"ns/p{i}"
+            ledger.first_seen(key, t=0.0)
+            ledger.resolve(key, "placed", t=float(i), trace_id=100 + i)
+        worst = ledger.worst(3)
+        assert [w["pod"] for w in worst] == ["ns/p5", "ns/p4", "ns/p3"]
+        assert worst[0]["trace_id"] == 105
+
+    def test_open_records_bounded_with_drop_count(self, ledger):
+        for i in range(40):                    # max_open=16
+            ledger.first_seen(f"ns/p{i}")
+        stats = ledger.stats()
+        assert stats["open_records"] == 16
+        assert stats["dropped_records"] == 24
+
+    def test_error_ring_never_evicted_by_successes(self, ledger):
+        ledger.first_seen("ns/bad", t=0.0)
+        ledger.transition("ns/bad", "gang.release", t=0.5)
+        ledger.resolve("ns/bad", "placed", t=1.0)   # -> placed_degraded
+        for i in range(32):                    # capacity=8 success ring
+            key = f"ns/ok{i}"
+            ledger.first_seen(key, t=0.0)
+            ledger.resolve(key, "placed", t=0.1)
+        stats = ledger.stats()
+        assert stats["error_retained"] == 1
+        rec = ledger.get("ns/bad")
+        assert rec is not None and rec.outcome == "placed_degraded"
+
+    def test_stamps_bounded_per_record(self, ledger):
+        ledger.first_seen("ns/p")
+        for i in range(100):
+            ledger.stamp("ns/p", f"edge{i}")
+        assert len(ledger.get("ns/p").stamps) <= \
+            ledger.get("ns/p").MAX_STAMPS
+
+
+class TestLedgerOverhead:
+    N = 3000
+
+    def test_stamp_overhead_matches_span_bound(self):
+        """The ledger stamp must stay at the same ~µs bound the span
+        layer pins (tests/test_obs.py::TestOverhead)."""
+        led = PlacementLedger(capacity=16)
+        led.first_seen("ns/hot")
+        t0 = time.perf_counter()
+        for _ in range(self.N):
+            led.stamp("ns/hot", "window_enqueue")
+        per = (time.perf_counter() - t0) / self.N
+        assert per < 50e-6, f"ledger stamp costs {per * 1e6:.1f} us"
+
+    def test_resolve_overhead(self):
+        led = PlacementLedger(capacity=64, sample_capacity=self.N + 1)
+        for i in range(self.N):
+            led.first_seen(f"ns/p{i}")
+        t0 = time.perf_counter()
+        for i in range(self.N):
+            led.resolve(f"ns/p{i}", "placed")
+        per = (time.perf_counter() - t0) / self.N
+        assert per < 100e-6, f"ledger resolve costs {per * 1e6:.1f} us"
+
+
+# ---------------------------------------------------------------------------
+# histogram bucket tuning (satellite: pin the boundaries)
+# ---------------------------------------------------------------------------
+
+class TestBucketTuning:
+    def test_solve_phase_buckets_pinned(self):
+        """BENCH shows exec_fetch ~70 ms and encode_cold ~105-117 ms vs
+        sub-ms compute: the ladder must resolve the 50-250 ms band with
+        more than two buckets, while keeping the sub-ms rungs."""
+        assert metrics.SOLVE_PHASE.buckets == (
+            0.00005, 0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005,
+            0.01, 0.02, 0.035, 0.05, 0.065, 0.08, 0.1, 0.13, 0.17,
+            0.25, 0.5, 1.0, 2.5)
+        band = [b for b in metrics.SOLVE_PHASE.buckets
+                if 0.05 <= b <= 0.25]
+        assert len(band) >= 6, "50-250ms band flattened again"
+        # the two BENCH_r05 regimes land in DISTINCT buckets
+        def bucket_of(v):
+            return next(b for b in metrics.SOLVE_PHASE.buckets if v <= b)
+        assert bucket_of(0.070) != bucket_of(0.110)
+        assert bucket_of(0.0012) < 0.005
+
+    def test_pod_placement_buckets_pinned(self):
+        assert metrics.POD_PLACEMENT.buckets == (
+            0.005, 0.025, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0,
+            60.0, 120.0, 300.0, 600.0, 1800.0, 3600.0)
+
+
+# ---------------------------------------------------------------------------
+# device telemetry
+# ---------------------------------------------------------------------------
+
+class TestDeviceTelemetry:
+    def test_recompile_vs_cache_hit_accounting(self):
+        dt = DeviceTelemetry()
+        assert dt.note_dispatch("scan", (64, 32, 8, 128),
+                                h2d_bytes=1024, donated=False) is True
+        assert dt.note_dispatch("scan", (64, 32, 8, 128),
+                                h2d_bytes=1024, donated=False) is False
+        assert dt.note_dispatch("scan", (128, 32, 8, 128)) is True
+        snap = dt.snapshot()
+        assert snap["recompiles"] == 2
+        assert snap["executable_cache_hits"] == 1
+        assert snap["executable_cache_hit_ratio"] == pytest.approx(
+            1 / 3, abs=1e-3)
+        assert snap["h2d_bytes"] == 2048
+        assert snap["donation_misses"] == 2
+
+    def test_transfer_and_catalog_accounting(self):
+        dt = DeviceTelemetry()
+        dt.note_catalog_upload(4096)
+        dt.note_d2h(512)
+        snap = dt.snapshot()
+        assert snap["catalog_uploads"] == 1
+        assert snap["h2d_bytes"] == 4096
+        assert snap["d2h_bytes"] == 512
+
+    def test_bucket_label_low_cardinality(self):
+        dt = DeviceTelemetry()
+        assert dt._bucket((64, 32, 8, 128, 0, True, False)) == "64x32x8"
+        assert dt._bucket((True, False)) == "scalar"
+
+    def test_live_jax_solve_populates_devtel(self):
+        """The LIVE solve path (not bench) must account recompiles,
+        transfer bytes, and donation misses — the acceptance contract
+        for the ROADMAP-1 instrumentation."""
+        from karpenter_tpu.obs.devtel import get_devtel
+        from karpenter_tpu.solver import JaxSolver, SolveRequest
+        from karpenter_tpu.apis.pod import ResourceRequests, make_pods
+        from karpenter_tpu.catalog.arrays import CatalogArrays
+        from karpenter_tpu.catalog.instancetype import InstanceTypeProvider
+        from karpenter_tpu.catalog.pricing import PricingProvider
+        from karpenter_tpu.cloud.fake import FakeCloud
+
+        cloud = FakeCloud()
+        pricing = PricingProvider(cloud)
+        try:
+            catalog = CatalogArrays.build(
+                InstanceTypeProvider(cloud, pricing).list())
+        finally:
+            pricing.close()
+        pods = make_pods(6, name_prefix="dt",
+                         requests=ResourceRequests(500, 1024, 0, 1))
+        dt = get_devtel()
+        before = dt.snapshot()
+        solver = JaxSolver()
+        solver.solve(SolveRequest(pods, catalog))
+        solver.solve(SolveRequest(pods, catalog))
+        after = dt.snapshot()
+        assert after["dispatches"] > before["dispatches"]
+        assert after["h2d_bytes"] > before["h2d_bytes"]
+        assert after["d2h_bytes"] > before["d2h_bytes"]
+        assert after["donation_misses"] > before["donation_misses"]
+        # the second identical solve rides the executable cache
+        assert after["executable_cache_hits"] \
+            > before["executable_cache_hits"]
+
+
+# ---------------------------------------------------------------------------
+# SLO evaluation
+# ---------------------------------------------------------------------------
+
+class TestSLOEvaluation:
+    def test_quantile_nearest_rank(self):
+        xs = [float(i) for i in range(1, 101)]
+        assert quantile(xs, 0.50) == 50.0
+        assert quantile(xs, 0.99) == 99.0
+        assert quantile([], 0.99) == 0.0
+
+    def test_pass_and_burn(self):
+        specs = [SLOSpec(name="lat", objective="p99", threshold=1.0),
+                 SLOSpec(name="drain", objective="open", threshold=0.0)]
+        report = evaluate_slos(specs, {
+            "p99": Measurement(value=0.5),
+            "open": Measurement(value=3.0,
+                                violators=[{"pod": "ns/x",
+                                            "trace_id": 4}])}, at=100.0)
+        assert not report.ok
+        assert [r.spec.name for r in report.burned] == ["drain"]
+        burned = report.burned[0]
+        assert burned.violators[0]["pod"] == "ns/x"
+        assert "ns/x" in report.render()
+
+    def test_missing_objective_burns_loudly(self):
+        report = evaluate_slos(
+            [SLOSpec(name="ghost", objective="nobody_measures_this",
+                     threshold=1.0)], {}, at=0.0)
+        assert not report.ok
+        assert "not measured" in report.results[0].violators[0]["pod"]
+
+    def test_burn_rate_windowed(self):
+        spec = SLOSpec(name="lat", objective="p99", threshold=1.0,
+                       burn_window_s=10.0)
+        samples = [(t, 2.0 if t >= 95 else 0.1)
+                   for t in range(80, 100)]          # last 5 violate
+        report = evaluate_slos([spec], {
+            "p99": Measurement(value=0.5, samples=samples)}, at=100.0)
+        r = report.results[0]
+        assert r.ok                                  # headline value ok
+        assert r.burn_rate == pytest.approx(5 / 10)  # window burns half
+
+    def test_broken_fixture_spec_fails_a_real_run(self, ledger):
+        """The acceptance proof: a deliberately-broken SLO spec turns a
+        perfectly healthy run into a failure — the gate can fail."""
+        ledger.first_seen("ns/p", t=0.0)
+        ledger.resolve("ns/p", "placed", t=0.01)
+        measurements = ledger_measurements(ledger,
+                                           measure_overhead=False)
+        healthy = evaluate_slos(
+            [s for s in DEFAULT_SOAK_SLOS
+             if s.objective in measurements], measurements, at=1.0)
+        assert healthy.ok
+        broken = evaluate_slos([BROKEN_FIXTURE_SLO], measurements,
+                               at=1.0)
+        assert not broken.ok
+        assert broken.results[0].violators, \
+            "a burned SLO must name its violating pods"
+
+    def test_summary_and_debug_payload_shapes(self, ledger):
+        ledger.first_seen("ns/p", t=0.0)
+        ledger.solve_start(["ns/p"], t=1.0)
+        ledger.resolve("ns/p", "placed", t=2.0, trace_id=9)
+        summary = slo_summary(ledger)
+        assert summary["pod_placement_p99_s"] == pytest.approx(2.0)
+        assert summary["resolved"] == 1
+        assert isinstance(summary["slos"], dict) and summary["slos"]
+        payload = debug_slo_payload(ledger,
+                                    recorder=obs.get_recorder())
+        assert {"report", "worst_pods", "ledger",
+                "device_telemetry"} <= set(payload)
+        assert payload["worst_pods"][0]["trace_id"] == 9
+        assert len(payload["report"]["results"]) \
+            == len(DEFAULT_SOAK_SLOS)
+
+
+# ---------------------------------------------------------------------------
+# end-to-end: gang pod park -> admit -> place stamp ordering
+# ---------------------------------------------------------------------------
+
+class TestGangLedgerEndToEnd:
+    def _rig(self):
+        from karpenter_tpu.apis.nodeclass import (
+            InstanceRequirements, NodeClass, NodeClassSpec,
+            PlacementStrategy,
+        )
+        from karpenter_tpu.catalog.instancetype import InstanceTypeProvider
+        from karpenter_tpu.catalog.pricing import PricingProvider
+        from karpenter_tpu.cloud.fake import FakeCloud, generate_profiles
+        from karpenter_tpu.controllers.gang import GangAdmissionController
+        from karpenter_tpu.core.actuator import Actuator
+        from karpenter_tpu.core.circuitbreaker import (
+            CircuitBreakerConfig, CircuitBreakerManager,
+        )
+        from karpenter_tpu.core.cluster import ClusterState
+        from karpenter_tpu.core.provisioner import Provisioner
+
+        cloud = FakeCloud(profiles=generate_profiles(
+            24, families=("gx3", "bx2", "cx2")))
+        pricing = PricingProvider(cloud)
+        itp = InstanceTypeProvider(cloud, pricing)
+        cluster = ClusterState()
+        nc = NodeClass(name="default", spec=NodeClassSpec(
+            region="us-south", image="img-1", vpc="vpc-1",
+            instance_requirements=InstanceRequirements(min_cpu=2),
+            placement_strategy=PlacementStrategy()))
+        nc.status.resolved_image_id = "img-1"
+        nc.status.set_condition("Ready", "True", "Test")
+        cluster.add_nodeclass(nc)
+        breaker = CircuitBreakerManager(CircuitBreakerConfig(
+            rate_limit_per_minute=10**6, max_concurrent_instances=10**6))
+        actuator = Actuator(cloud, cluster, breaker=breaker)
+        prov = Provisioner(cluster, itp, actuator)
+        ctrl = GangAdmissionController(cluster, prov)
+        return cluster, prov, ctrl, pricing
+
+    def test_park_admit_place_stamp_ordering(self, ledger):
+        from karpenter_tpu.apis.pod import (
+            ResourceRequests, make_pods, pod_key,
+        )
+        from karpenter_tpu.apis.podgroup import PodGroup
+
+        cluster, prov, ctrl, pricing = self._rig()
+        try:
+            gang = PodGroup(name="slo-gang", min_member=4,
+                            slice_shape="2x2")
+            half = make_pods(2, "slo-gang",
+                             requests=ResourceRequests(250, 512, 0, 1),
+                             gang=gang)
+            for p in half:
+                cluster.add_pod(p)
+            ctrl.reconcile()                 # sub-min_member: parked
+            key = pod_key(half[0])
+            assert ledger.get(key).stamp_names() == ["first_seen",
+                                                     "gang.park"]
+            rest = make_pods(2, "slo-gang-rest",
+                             requests=ResourceRequests(250, 512, 0, 1),
+                             gang=gang)
+            for p in rest:
+                cluster.add_pod(p)
+            ctrl.reconcile()                 # admit + place atomically
+            rec = ledger.get(key)
+            assert rec.outcome == "placed"
+            names = rec.stamp_names()
+            assert names.index("gang.park") < names.index("gang.admit") \
+                < names.index("nominated")
+            assert rec.trace_id, \
+                "placement must link the gang.place trace"
+            # every member shares the ordering contract
+            for p in half + rest:
+                r = ledger.get(pod_key(p))
+                assert r is not None and r.outcome == "placed"
+        finally:
+            pricing.close()
+
+
+# ---------------------------------------------------------------------------
+# the short production day (slow tier: `make soak-short` shape)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.slow
+class TestSoak:
+    def test_short_day_passes_and_gate_proven(self, tmp_path):
+        from karpenter_tpu.chaos.soak import SHORT_DAY, run_soak
+
+        res = run_soak(SHORT_DAY, seed=1, report_dir=str(tmp_path),
+                       echo=lambda *_: None)
+        assert res.chaos_violations == 0
+        assert res.report.ok, res.report.render()
+        assert res.gate_proven
+        assert (tmp_path / "slo_report.json").exists()
+        assert res.summary["resolved"] > 0
+        # the CI day must NOT be vacuous: the overload peak strands pods
+        # across beats, so the latency gates see real nonzero samples —
+        # a soak whose p99 reads 0.0 can never burn and gates nothing
+        assert res.summary["pod_placement_p99_s"] > 0
+        assert res.summary["pending_staleness_s"] > 0
+        assert res.ledger_stats["transitions"], \
+            "the day must exercise at least one lifecycle transition"
+
+    def test_broken_slo_fails_the_day(self, tmp_path):
+        from karpenter_tpu.chaos.soak import SHORT_DAY, SOAK_SLOS, run_soak
+        from karpenter_tpu.obs.slo import SLOSpec
+
+        impossible = SOAK_SLOS + (SLOSpec(
+            name="impossible", objective="pod_placement_p99_s",
+            threshold=-1.0),)
+        res = run_soak(SHORT_DAY[:2], seed=1, slos=impossible,
+                       report_dir=str(tmp_path), echo=lambda *_: None)
+        assert not res.ok
+        assert "impossible" in [r.spec.name for r in res.report.burned]
+        burned = [r for r in res.report.burned
+                  if r.spec.name == "impossible"][0]
+        assert burned.violators, "burn report must name violating pods"

@@ -28,4 +28,5 @@ def all_rules() -> list[type[Rule]]:
         concurrency.NonDaemonThread,          # GL104
         concurrency.SilentExceptionSwallow,   # GL105
         observability.UnclosedSpan,           # GL106
+        observability.TelemetryInKernel,      # GL107
     ]

@@ -1,12 +1,19 @@
-"""Family B addition — observability hygiene (GL106).
+"""Family B additions — observability hygiene (GL106, GL107).
 
-A span opened but not closed through a ``with`` block leaks on the
-exception path: the trace never finalizes (its slot sits in the
+GL106: a span opened but not closed through a ``with`` block leaks on
+the exception path: the trace never finalizes (its slot sits in the
 recorder's open-trace table until evicted) and every child span that
 follows mis-parents.  The ``karpenter_tpu.obs`` contract is therefore
 context-manager-or-bust: ``with obs.span(...)`` / ``with
 tracer.span(...)``, or the retroactive ``obs.record(start, end)`` which
 never holds an open span at all.
+
+GL107: a metric / ledger / span call inside a jit-traced function runs
+ONCE at trace time and never again — the compiled executable replays
+the numerics, not the Python.  The counter silently stops counting the
+moment the cache warms, which is worse than no metric: dashboards show
+a frozen value that looks alive.  All telemetry must live at dispatch
+level on the host (obs/devtel.py's contract).
 """
 
 from __future__ import annotations
@@ -15,7 +22,7 @@ import ast
 from collections.abc import Iterator
 
 from tools.graftlint.engine import Finding, Rule, SourceModule
-from tools.graftlint.rules.jaxctx import attr_chain
+from tools.graftlint.rules.jaxctx import analyze, attr_chain
 
 # receivers whose ``.span(...)`` is a tracer span (re.Match.span() and
 # other unrelated ``.span()`` methods must not trip the rule)
@@ -90,3 +97,56 @@ class UnclosedSpan(Rule):
                         if isinstance(arg, ast.Call):
                             allowed.add(id(arg))
         return allowed
+
+
+# telemetry receivers: module-level helper namespaces and the
+# metric-constant idiom (SOLVE_PHASE.labels(...).observe(...))
+_TELEMETRY_MODULES = {"metrics", "obs", "devtel", "ledger"}
+_TELEMETRY_FUNCS = {"_phase", "get_devtel", "get_ledger"}
+_METRIC_TERMINALS = {"labels", "observe", "inc", "dec"}
+
+
+class TelemetryInKernel(Rule):
+    id = "GL107"
+    name = "telemetry-in-traced-function"
+    description = (
+        "metric / ledger / span call inside a jit-traced function "
+        "(jit/scan/pallas/vmap kernel or a function they call). Traced "
+        "Python runs ONCE at compile time — the compiled executable "
+        "never re-executes the call, so the counter/span silently "
+        "freezes after the first (per-shape) invocation. Move the "
+        "telemetry to the host-side dispatch wrapper (see "
+        "karpenter_tpu/obs/devtel.py)."
+    )
+    family = "B"
+    scope = ("karpenter_tpu/solver/*", "karpenter_tpu/parallel/*",
+             "karpenter_tpu/preempt/*", "karpenter_tpu/gang/*")
+
+    def check(self, module: SourceModule) -> Iterator[Finding]:
+        analysis = analyze(module)
+        for info in analysis.kernel_items():
+            for node in analysis.body_nodes(info.fn):
+                if isinstance(node, ast.Call) and \
+                        self._is_telemetry(node):
+                    yield self.finding(
+                        module, node,
+                        "telemetry call inside a traced function — it "
+                        "runs once at trace time, then the compiled "
+                        "executable silently skips it; hoist to the "
+                        "dispatch wrapper")
+
+    @staticmethod
+    def _is_telemetry(call: ast.Call) -> bool:
+        chain = attr_chain(call.func)
+        if not chain:
+            return False
+        root, terminal = chain[0].lstrip("_"), chain[-1]
+        if root in _TELEMETRY_MODULES and len(chain) > 1:
+            return True                 # metrics.X..., obs.record(...)
+        if terminal in _TELEMETRY_FUNCS or chain[0] in _TELEMETRY_FUNCS:
+            return True                 # _phase(...), get_devtel()
+        # METRIC_CONSTANT.labels(...) / .observe(...) / .inc() — require
+        # an ALL-CAPS receiver so jnp's x.at[i].set / arr.max() etc.
+        # never trip the rule
+        return len(chain) >= 2 and chain[0].isupper() \
+            and terminal in _METRIC_TERMINALS

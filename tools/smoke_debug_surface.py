@@ -163,6 +163,35 @@ def main() -> int:
               and len(gc_ctrl.placement_log[0].members) == 4,
               "gang placement log carries the full membership")
 
+        # demo device-telemetry cycle: a REAL JaxSolver solve (cpu
+        # backend) so recompile count, H2D/D2H bytes, donation misses
+        # and the executable-cache hit ratio are populated by the live
+        # solve path — the second identical solve must be a cache hit
+        print("demo device-telemetry cycle (jax backend)")
+        from karpenter_tpu.obs.devtel import get_devtel
+        from karpenter_tpu.solver.jax_backend import JaxSolver
+        from karpenter_tpu.solver.types import SolveRequest, SolverOptions
+
+        catalog = op.provisioner._catalog_for(nc)
+        devtel_pods = make_pods(8, name_prefix="devtel",
+                                requests=ResourceRequests(500, 1024, 0, 1))
+        jax_solver = JaxSolver(SolverOptions(backend="jax"))
+        plan = jax_solver.solve(SolveRequest(devtel_pods, catalog))
+        jax_solver.solve(SolveRequest(devtel_pods, catalog))
+        snap = get_devtel().snapshot()
+        check(bool(plan.nodes), "devtel demo solve produced a plan")
+        check(snap["recompiles"] >= 1,
+              f"recompile events counted ({snap['recompiles']})")
+        check(snap["executable_cache_hits"] >= 1,
+              "second identical solve hit the executable cache")
+        check(snap["h2d_bytes"] > 0 and snap["d2h_bytes"] > 0,
+              f"H2D/D2H bytes accounted (h2d={snap['h2d_bytes']} "
+              f"d2h={snap['d2h_bytes']})")
+        check(snap["donation_misses"] >= 1,
+              "host-input dispatches counted as donation misses")
+        check(0.0 <= snap["executable_cache_hit_ratio"] <= 1.0,
+              "executable-cache hit ratio well-formed")
+
         print("GET /metrics")
         status, ctype, body = _get(port, "/metrics")
         check(status == 200, f"/metrics status 200 (got {status})")
@@ -190,6 +219,49 @@ def main() -> int:
               "gang parked gauge rendered")
         check("karpenter_tpu_gang_members" in text,
               "gang members histogram rendered")
+        # SLO ledger + device telemetry families (obs/ledger.py,
+        # obs/devtel.py) — placement observed by the wave nominations,
+        # devtel populated by the jax demo solve above
+        check('karpenter_tpu_pod_placement_seconds_bucket{outcome="placed"'
+              in text, "pod placement histogram observed the wave")
+        check("karpenter_tpu_pending_staleness_seconds" in text,
+              "pending staleness gauge rendered")
+        check("karpenter_tpu_recorder_dropped_spans_total" in text,
+              "recorder dropped-spans counter rendered")
+        check('karpenter_tpu_jit_recompiles_total{kernel=' in text,
+              "jit recompile counter carries live samples")
+        check('karpenter_tpu_device_transfer_bytes_total{direction="h2d"}'
+              in text and
+              'karpenter_tpu_device_transfer_bytes_total{direction="d2h"}'
+              in text, "transfer byte counters carry both directions")
+        check('karpenter_tpu_executable_cache_events_total{event="hit"}'
+              in text, "executable-cache hit events counted")
+        check("karpenter_tpu_donation_misses_total{" in text,
+              "donation miss counter carries live samples")
+
+        print("GET /debug/slo")
+        status, ctype, body = _get(port, "/debug/slo")
+        check(status == 200, f"/debug/slo status 200 (got {status})")
+        check(ctype == "application/json",
+              f"/debug/slo content type (got {ctype!r})")
+        try:
+            doc = json.loads(body)
+        except ValueError as e:
+            doc = {}
+            check(False, f"/debug/slo parses as JSON ({e})")
+        for key in ("report", "worst_pods", "ledger", "device_telemetry",
+                    "pending_staleness_s"):
+            check(key in doc, f"/debug/slo has {key!r}")
+        results = (doc.get("report") or {}).get("results", [])
+        check(len(results) >= 4,
+              f"/debug/slo evaluates >=4 SLOs (got {len(results)})")
+        check(any(w.get("trace_id") for w in doc.get("worst_pods", ())),
+              "worst-case pods carry trace ids linking to /debug/traces")
+        dt = doc.get("device_telemetry") or {}
+        check(dt.get("recompiles", 0) >= 1
+              and dt.get("h2d_bytes", 0) > 0
+              and "executable_cache_hit_ratio" in dt,
+              "/debug/slo device telemetry reflects the live solve path")
 
         print("GET /statusz")
         status, ctype, body = _get(port, "/statusz")
@@ -200,7 +272,8 @@ def main() -> int:
             doc = {}
             check(False, f"/statusz parses as JSON ({e})")
         for key in ("uptime_s", "version", "backend", "leader",
-                    "recorder", "circuit_breakers"):
+                    "recorder", "circuit_breakers", "ledger",
+                    "device_telemetry", "pending_staleness_s"):
             check(key in doc, f"/statusz has {key!r}")
 
         print("GET /debug/traces")
