@@ -59,6 +59,10 @@ soak-short: ## CI-sized soak (same composition, fewer rounds)
 smoke: ## Debug-surface smoke: real operator, curl-equivalent checks on /metrics /statusz /debug/traces /debug/slo
 	JAX_PLATFORMS=cpu $(PY) tools/smoke_debug_surface.py
 
+.PHONY: warm-restart-check
+warm-restart-check: ## AOT executable cache gate: a warm restart must recompile nothing and boot faster than cold (resident/aot.py)
+	JAX_PLATFORMS=cpu $(PY) tools/warm_restart_check.py
+
 .PHONY: chaos-replay
 chaos-replay: ## Replay one failing scenario: make chaos-replay PROFILE=spot-storm SEED=3
 	$(TEST_ENV) $(PY) -m karpenter_tpu.chaos \

@@ -1176,6 +1176,89 @@ print(json.dumps({"first_ms": round(first, 3), "steady_ms": round(steady, 3),
 '''
 
 
+def run_resident(num_pods: int, num_types: int, windows: int = 10) -> dict:
+    """ISSUE 8 / ROADMAP-1: the delta-encoded incremental solve vs the
+    full re-encode path over a churned window stream — per-window
+    H2D/D2H bytes (sourced from devtel, the same counters /statusz
+    scrapes), incremental vs full-encode solve latency, executable-cache
+    hit ratio, and the bit-identity parity gate.  Window 0 (cold:
+    rebuild + compiles) is excluded from the warm aggregates."""
+    import random as _random
+
+    from karpenter_tpu.apis.pod import PodSpec, ResourceRequests
+    from karpenter_tpu.obs.devtel import get_devtel
+    from karpenter_tpu.resident.delta import pack_window
+    from karpenter_tpu.solver import JaxSolver, SolveRequest, encode
+    from karpenter_tpu.solver.types import SolverOptions
+
+    pods, catalog = build_workload(num_pods, num_types, seed=77)
+    rng = _random.Random("bench-resident")
+    seqs, cur = [], list(pods)
+    for w in range(windows):
+        if w:
+            for _ in range(rng.randrange(1, 6)):
+                cur.pop(rng.randrange(len(cur)))
+            cur.extend(PodSpec(f"rw{w}n{i}",
+                               requests=ResourceRequests(500, 1024, 0, 1))
+                       for i in range(rng.randrange(1, 6)))
+        seqs.append(list(cur))
+
+    devtel = get_devtel()
+    on = JaxSolver(SolverOptions(backend="jax", resident="on"))
+    off = JaxSolver(SolverOptions(backend="jax", resident="off"))
+
+    def key(plan):
+        return ([(n.instance_type, n.zone, n.capacity_type,
+                  tuple(n.pod_names)) for n in plan.nodes],
+                tuple(plan.unplaced_pods),
+                round(plan.total_cost_per_hour, 9))
+
+    parity = True
+    on_ms, off_ms, h2d_w, d2h_w = [], [], [], []
+    full_packed_bytes = 0
+    for w, pods_w in enumerate(seqs):
+        req = SolveRequest(pods_w, catalog)
+        full_packed_bytes = int(pack_window(
+            encode(pods_w, catalog))[0].nbytes)
+        # alternate solve order so the shared encode memo biases neither
+        legs = (off, on) if w % 2 == 0 else (on, off)
+        walls = {}
+        for solver in legs:
+            if solver is on:
+                before = devtel.snapshot()
+            t0 = time.perf_counter()
+            plan = solver.solve(req)
+            walls[id(solver)] = time.perf_counter() - t0
+            if solver is on:
+                after = devtel.snapshot()
+                p_on = plan
+            else:
+                p_off = plan
+        parity = parity and key(p_on) == key(p_off)
+        if w:   # warm windows only
+            on_ms.append(walls[id(on)] * 1000)
+            off_ms.append(walls[id(off)] * 1000)
+            h2d_w.append(after["h2d_bytes"] - before["h2d_bytes"])
+            d2h_w.append(after["d2h_bytes"] - before["d2h_bytes"])
+    stats = on.resident.stats()
+    res = devtel.snapshot()["resident"]
+    return {"resident": {
+        "windows": windows,
+        "parity": parity,
+        "incremental_solve_p50_ms": round(p50(on_ms), 3),
+        "full_encode_solve_p50_ms": round(p50(off_ms), 3),
+        "warm_h2d_p50_bytes": int(p50(h2d_w)),
+        "warm_h2d_max_bytes": int(max(h2d_w)),
+        "warm_d2h_p50_bytes": int(p50(d2h_w)),
+        "full_packed_bytes": full_packed_bytes,
+        "delta_windows": res["deltas"],
+        "hit_windows": res["hits"],
+        "rebuilds": stats["rebuilds"],
+        "last_rebuild_reason": stats["last_rebuild_reason"],
+        "executable_cache_hit_ratio": round(devtel.hit_ratio(), 4),
+    }}
+
+
 def run_cold_start(timeout_s: float = 560.0,
                    platform: str = "") -> dict:
     """BASELINE cold-start probe (VERDICT round 4 weak #4): the first
@@ -1373,6 +1456,15 @@ def main():
             iters=4 if args.quick else 10))
     except Exception as e:  # noqa: BLE001
         result["gang_error"] = str(e)[:200]
+    try:
+        # ISSUE 8: device-resident state — incremental vs full-encode
+        # solve latency, per-window delta traffic, parity gate
+        result.update(run_resident(
+            num_pods=600 if args.quick else 2000,
+            num_types=60 if args.quick else 200,
+            windows=6 if args.quick else 12))
+    except Exception as e:  # noqa: BLE001
+        result["resident_error"] = str(e)[:200]
 
 
     # BASELINE.md targets, asserted explicitly: a regression to target
@@ -1442,6 +1534,14 @@ def main():
              < result.get("fleet_grouped_host_ms", 0.0)
              and 0.0 < result.get("fleet_cost_ratio", 9.9) <= 1.0 + 1e-6)
             if "fleet_wall_ms" in result else None,
+        # ISSUE 8 acceptance: resident incremental solves bit-identical
+        # to full re-encode, with warm-window H2D bounded by the delta
+        # (strictly below a full packed-buffer re-upload)
+        "resident_parity_and_delta_bounded":
+            (result["resident"]["parity"] is True
+             and 0 <= result["resident"]["warm_h2d_max_bytes"]
+             < result["resident"]["full_packed_bytes"])
+            if "resident" in result else None,
     }
     print(json.dumps(result))
 

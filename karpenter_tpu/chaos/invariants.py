@@ -70,7 +70,7 @@ class InvariantChecker:
                  orphan_grace: float, stuck_claim_grace: float,
                  solver_violations: list[str] | None = None,
                  trace: EventTrace | None = None, preemption=None,
-                 gang=None):
+                 gang=None, resident=None):
         self.cluster = cluster
         self.cloud = cloud              # ground truth: the UNWRAPPED fake
         self.unavailable = unavailable
@@ -86,6 +86,11 @@ class InvariantChecker:
         # the harness's GangAdmissionController (or None): its
         # placement_log / released set back the gang invariants
         self.gang = gang
+        # resident-state probe (or None): exposes .store (the harness's
+        # ResidentStore), .window_pods() and .catalog() — the inputs the
+        # harness tracked, re-listed from ClusterState at check time so
+        # the rebuild below is ground truth, not an echo of the store
+        self.resident = resident
 
     # -- round invariants ----------------------------------------------------
 
@@ -96,6 +101,7 @@ class InvariantChecker:
         out.extend(self._solver_plans_valid())
         out.extend(self._no_priority_inversion())
         out.extend(self._no_partial_gang_placed())
+        out.extend(self._resident_state_fresh())
         if self.trace is not None:
             self.trace.add("invariants", phase="round", violations=len(out),
                            kinds=sorted({v.invariant for v in out}))
@@ -187,6 +193,60 @@ class InvariantChecker:
                     f"{rec.total_members} members (min_member "
                     f"{rec.min_member}) on {rec.claim_name}"))
         self.gang.placement_log.clear()
+        return out
+
+    def _resident_state_fresh(self) -> list[Violation]:
+        """The resident store's mirror AND its device-resident tensors
+        must equal a from-scratch rebuild of the tracked window from
+        ClusterState — stale device state (a missed invalidation, a
+        mis-applied delta) is exactly the failure mode the
+        generation-tracked store exists to prevent
+        (docs/design/resident.md 'parity contract')."""
+        probe = self.resident
+        if probe is None:
+            return []
+        snap = probe.store.snapshot_state()
+        if snap is None:
+            return []      # no window tracked yet
+        catalog = probe.catalog()
+        if catalog is None:
+            return []
+        import numpy as np
+
+        from karpenter_tpu.resident.delta import pack_window
+        from karpenter_tpu.solver.encode import encode
+
+        problem = encode(probe.window_pods(), catalog)
+        fresh, shape = pack_window(problem)
+        fresh = fresh.reshape(-1)
+        out: list[Violation] = []
+        if snap["key"] != (catalog.uid,) + shape:
+            return [Violation(
+                "resident-state-fresh",
+                f"tracked state keyed {snap['key']} but the current "
+                f"window lowers to {(catalog.uid,) + shape}")]
+        gen = (catalog.generation, catalog.availability_generation)
+        if snap["generation"] != gen:
+            out.append(Violation(
+                "resident-state-fresh",
+                f"resident generation {snap['generation']} != catalog "
+                f"generation {gen} (missed invalidation)"))
+        if snap["mirror"].shape != fresh.shape \
+                or not np.array_equal(snap["mirror"], fresh):
+            diff = int(np.count_nonzero(snap["mirror"] != fresh)) \
+                if snap["mirror"].shape == fresh.shape else -1
+            out.append(Violation(
+                "resident-state-fresh",
+                f"host mirror diverged from a fresh ClusterState "
+                f"rebuild ({diff} words differ)"))
+        dev = np.asarray(snap["device"]).reshape(-1)
+        if dev.shape != fresh.shape or not np.array_equal(dev, fresh):
+            diff = int(np.count_nonzero(dev != fresh)) \
+                if dev.shape == fresh.shape else -1
+            out.append(Violation(
+                "resident-state-fresh",
+                f"device-resident tensors diverged from a fresh "
+                f"ClusterState rebuild ({diff} words differ)"))
         return out
 
     # -- final (eventual) invariants -----------------------------------------

@@ -65,6 +65,18 @@ REPLAY_FMT = ("python -m karpenter_tpu.chaos --profile {profile} "
 
 
 @dataclass
+class ResidentProbe:
+    """What the resident-state invariant needs: the harness's store plus
+    callables re-listing the tracked window's inputs from ClusterState
+    at CHECK time (the rebuild must be ground truth, not a cached echo
+    of what the store saw)."""
+
+    store: object
+    window_pods: object       # () -> list[PodSpec]
+    catalog: object           # () -> CatalogArrays | None
+
+
+@dataclass
 class ScenarioResult:
     profile: str
     seed: int
@@ -191,6 +203,14 @@ class ChaosHarness:
             self.cluster, self.provisioner, clock=self.clock.time)
         self._gang_backlog: list[tuple[int, list]] = []   # (round, pods)
         self._gang_seq = 0
+        # resident-state store tracked through every pump beat: the
+        # chaos matrix exercises the store's delta/invalidation machinery
+        # (blackouts bump availability generations, churn drives deltas)
+        # under the resident-state-fresh invariant — rebuilt from
+        # ClusterState and compared word-for-word between sync rounds
+        from karpenter_tpu.resident.store import ResidentStore
+
+        self.resident = ResidentStore()
         self.kubelet = FakeKubelet(self.cluster, self.fake)
         self.manager = ControllerManager(self.cluster)
         for ctrl in self._controllers():
@@ -209,7 +229,12 @@ class ChaosHarness:
             preemption=self.preemption
             if "preemption" not in profile.disable_controllers else None,
             gang=self.gang
-            if "gang" not in profile.disable_controllers else None)
+            if "gang" not in profile.disable_controllers else None,
+            resident=ResidentProbe(
+                store=self.resident,
+                window_pods=self._resident_window,
+                catalog=lambda: self.provisioner._catalog_for(
+                    self.nodeclass)))
         # warm the catalog before chaos arms (pricing resolution happens
         # here, outside the deterministic traced window)
         self.catalog_provider.list(nc)
@@ -349,6 +374,13 @@ class ChaosHarness:
                        members=size, arrived=len(arrive_now),
                        slice=shape, mode=mode)
 
+    def _resident_window(self) -> list:
+        """The window the resident store tracks: pending unnominated
+        pods, in collection order (the same selection provision_once
+        solves)."""
+        return [p.spec for p in self.cluster.pending_pods()
+                if not p.nominated_node]
+
     def _pump(self) -> None:
         """One provisioning + continuation + reconcile beat."""
         self.provisioner.provision_once()
@@ -356,6 +388,12 @@ class ChaosHarness:
         self.manager.sync(rounds=2)
         self.kubelet.bind_nominated()
         self.unavailable.cleanup()
+        # track the post-beat window through the resident store (delta
+        # against the previous beat's device-resident state); the round
+        # invariant then rebuilds it from ClusterState and compares
+        catalog = self.provisioner._catalog_for(self.nodeclass)
+        if catalog is not None:
+            self.resident.track_window(self._resident_window(), catalog)
         pods = self.cluster.list("pods")
         self.trace.add(
             "pump",

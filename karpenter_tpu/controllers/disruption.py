@@ -79,11 +79,19 @@ class DisruptionController(PollController):
                  provisioner=None, clock=time.time,
                  repack_enabled: bool = False,
                  repack_min_savings_fraction: float = 0.15,
-                 repack_cooldown: float = 600.0):
+                 repack_cooldown: float = 600.0,
+                 resident_occupancy: bool = False):
         self.cluster = cluster
         self.cloudprovider = cloudprovider
         self.provisioner = provisioner
         self.clock = clock
+        # KARPENTER_ENABLE_RESIDENT: the consolidation passes read node
+        # occupancy through ONE shared per-tick snapshot
+        # (resident/store.OccupancySnapshot) instead of one full pod
+        # scan per claim — results pinned bit-identical to the rescan
+        # path (tests/test_resident.py)
+        self.resident_occupancy = resident_occupancy
+        self._occ = None
         # cost-optimal repack (BASELINE config #4 actuated): OFF by
         # default — blue/green churn is a policy decision, gated like the
         # reference's consolidation policies.  Hysteresis: a minimum
@@ -105,8 +113,18 @@ class DisruptionController(PollController):
         # consolidation would reap it (and underutilized moves would use
         # unproven nodes as targets / drain old capacity early)
         transitioning = self._pending_repack is not None
-        emptied = 0 if transitioning else self._consolidate_empty()
-        moved = 0 if transitioning else self._consolidate_underutilized()
+        # the occupancy snapshot is built AFTER drift replacement (which
+        # unbinds pods) and torn down before repack (which renominates
+        # pending pods the snapshot does not track)
+        if self.resident_occupancy and not transitioning:
+            from karpenter_tpu.resident.store import OccupancySnapshot
+
+            self._occ = OccupancySnapshot(self.cluster)
+        try:
+            emptied = 0 if transitioning else self._consolidate_empty()
+            moved = 0 if transitioning else self._consolidate_underutilized()
+        finally:
+            self._occ = None
         repacked = self._repack_if_profitable() if self.repack_enabled else 0
         if drifted or emptied or moved or repacked:
             log.info("disruption pass", drifted=drifted, empty=emptied,
@@ -191,6 +209,11 @@ class DisruptionController(PollController):
                 continue
             for pod, target in placement:
                 self.cluster.bind_pod(pod, target.node_name)
+                if self._occ is not None:
+                    p = self.cluster.get("pods", pod)
+                    self._occ.rebind(
+                        pod, target.node_name,
+                        p.nominated_node if p is not None else "")
                 resid[target.name] = resid[target.name] - \
                     self._pod_req(pod)
             log.info("underutilized node consolidated", claim=claim.name,
@@ -401,6 +424,11 @@ class DisruptionController(PollController):
             # a never-joined claim has node_name "" — matching it against
             # pods would claim every un-nominated pod in the cluster
             return []
+        if self._occ is not None:
+            # one shared snapshot per tick (KARPENTER_ENABLE_RESIDENT):
+            # same pods, same order as the rescan below — in-pass moves
+            # and evictions keep it current via rebind()/unbind()
+            return self._occ.pods_on(node_name)
         return [pod_key(p.spec) for p in self.cluster.list("pods")
                 if p.bound_node == node_name
                 or p.nominated_node == node_name]
@@ -533,6 +561,8 @@ class DisruptionController(PollController):
                 pending.bound_node = ""
                 pending.nominated_node = ""
                 pending.enqueued_at = 0.0   # immediate re-window
+            if self._occ is not None:
+                self._occ.unbind(pk)
         self._delete_claim(claim)
 
     def _delete_claim(self, claim: NodeClaim) -> None:

@@ -131,8 +131,19 @@ class Provisioner:
             if deleted and getattr(claim, "name", ""):
                 self._renominate_orphans(claim.name)
 
+        def on_pool_event(event_type: str, pool):
+            # generation-tracked invalidation (docs/design/resident.md):
+            # a NodePool edit changes how windows lower (taints,
+            # requirement merging, labels) — the resident store must
+            # rebuild from ground truth rather than trust device state
+            # encoded under the old pool spec
+            store = getattr(self.solver, "resident", None)
+            if store is not None:
+                store.invalidate("nodepool_edit")
+
         self._unsubscribe = self.cluster.watch("pods", on_pod_event)
         self._unsub_claims = self.cluster.watch("nodeclaims", on_claim_event)
+        self._unsub_pools = self.cluster.watch("nodepools", on_pool_event)
         self._stop_retry = threading.Event()
         self._retry_thread = threading.Thread(
             target=self._retry_loop, name="provisioner-retry", daemon=True)
@@ -145,6 +156,9 @@ class Provisioner:
         if getattr(self, "_unsub_claims", None):
             self._unsub_claims()
             self._unsub_claims = None
+        if getattr(self, "_unsub_pools", None):
+            self._unsub_pools()
+            self._unsub_pools = None
         if getattr(self, "_stop_retry", None):
             self._stop_retry.set()
             self._retry_thread.join(timeout=5.0)

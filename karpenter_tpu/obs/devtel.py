@@ -61,6 +61,23 @@ class DeviceTelemetry:
         self.catalog_upload_bytes = 0
         self.donation_misses = 0
         self.donation_miss_bytes = 0
+        # resident-state accounting (karpenter_tpu/resident/): windows by
+        # mode, delta traffic, last rebuild reason — the /statusz and
+        # /debug/slo surface for the store's health
+        self.resident_windows = 0
+        self.resident_hits = 0
+        self.resident_deltas = 0
+        self.resident_rebuilds = 0
+        self.resident_invalidations = 0
+        self.resident_delta_bytes = 0
+        self.resident_delta_words_last = 0
+        self.resident_bytes = 0
+        self.resident_last_rebuild_reason = ""
+        self.resident_generation = ""
+        # optional hook: called OUTSIDE the lock with (kernel, signature)
+        # for every signature this process first dispatches — the AOT
+        # executable cache (resident/aot.py) records its manifest here
+        self.signature_sink = None
 
     # -- accounting ----------------------------------------------------------
 
@@ -89,6 +106,12 @@ class DeviceTelemetry:
         bucket = self._bucket(signature)
         if new:
             metrics.JIT_RECOMPILES.labels(kernel, bucket).inc()
+            sink = self.signature_sink
+            if sink is not None:
+                try:
+                    sink(kernel, signature)
+                except Exception:  # noqa: BLE001 — telemetry must never fail a solve
+                    pass
         metrics.EXEC_CACHE.labels("miss" if new else "hit").inc()
         if h2d_bytes:
             metrics.TRANSFER_BYTES.labels("h2d").inc(h2d_bytes)
@@ -109,6 +132,41 @@ class DeviceTelemetry:
         with self._lock:
             self.d2h_bytes += nbytes
         metrics.TRANSFER_BYTES.labels("d2h").inc(nbytes)
+
+    def note_resident_window(self, mode: str, *, h2d_bytes: int = 0,
+                             words: int = 0, reason: str = "",
+                             resident_bytes: int = 0,
+                             generation=None) -> None:
+        """One window through the resident store: ``mode`` is hit (no
+        change, zero-delta dispatch), delta (compact update tensors), or
+        rebuild (full re-upload; ``reason`` says why)."""
+        with self._lock:
+            self.resident_windows += 1
+            if mode == "hit":
+                self.resident_hits += 1
+            elif mode == "delta":
+                self.resident_deltas += 1
+            else:
+                self.resident_rebuilds += 1
+                self.resident_last_rebuild_reason = reason
+            self.resident_delta_bytes += h2d_bytes
+            self.resident_delta_words_last = words
+            self.resident_bytes = resident_bytes
+            if generation is not None:
+                self.resident_generation = str(generation)
+        metrics.RESIDENT_WINDOWS.labels(mode).inc()
+        if mode == "rebuild":
+            metrics.RESIDENT_REBUILDS.labels(reason or "unknown").inc()
+        metrics.RESIDENT_DELTA_BYTES.observe(h2d_bytes)
+
+    def note_resident_invalidation(self, reason: str) -> None:
+        """An explicit store invalidation.  Deliberately NOT counted as
+        a rebuild: the reason rides to the next window's actual rebuild
+        (note_resident_window), so one logical rebuild is counted once —
+        under its cause, not a generic "cold"."""
+        with self._lock:
+            self.resident_invalidations += 1
+            self.resident_last_rebuild_reason = reason
 
     # -- readout -------------------------------------------------------------
 
@@ -140,6 +198,18 @@ class DeviceTelemetry:
                 "catalog_upload_bytes": self.catalog_upload_bytes,
                 "donation_misses": self.donation_misses,
                 "donation_miss_bytes": self.donation_miss_bytes,
+                "resident": {
+                    "windows": self.resident_windows,
+                    "hits": self.resident_hits,
+                    "deltas": self.resident_deltas,
+                    "rebuilds": self.resident_rebuilds,
+                    "invalidations": self.resident_invalidations,
+                    "delta_h2d_bytes": self.resident_delta_bytes,
+                    "last_delta_words": self.resident_delta_words_last,
+                    "resident_bytes": self.resident_bytes,
+                    "last_rebuild_reason": self.resident_last_rebuild_reason,
+                    "generation": self.resident_generation,
+                },
             }
 
     def reset(self) -> None:
@@ -150,6 +220,10 @@ class DeviceTelemetry:
             self.h2d_bytes = self.d2h_bytes = 0
             self.catalog_uploads = self.catalog_upload_bytes = 0
             self.donation_misses = self.donation_miss_bytes = 0
+            self.resident_windows = self.resident_hits = 0
+            self.resident_deltas = self.resident_rebuilds = 0
+            self.resident_invalidations = self.resident_delta_bytes = 0
+            self.resident_delta_words_last = self.resident_bytes = 0
 
 
 # process-wide singleton: dispatch sites are module functions/methods

@@ -131,6 +131,10 @@ class Operator:
             from karpenter_tpu.core.leaderelection import AlwaysLeader
 
             self.elector = AlwaysLeader()
+        # the resident flag resolves into the solver options BEFORE the
+        # provisioner builds its solver (make_solver reads them once)
+        if self.options.resident_enabled:
+            self.options.solver.resident = "on"
         self.provisioner = Provisioner(
             self.cluster, self.instance_types, self.actuator,
             ProvisionerOptions(solver=self.options.solver,
@@ -185,7 +189,8 @@ class Operator:
             self.cluster, self.cloudprovider, provisioner=self.provisioner,
             repack_enabled=self.options.repack_enabled,
             repack_min_savings_fraction=(
-                self.options.repack_min_savings_percent / 100.0)))
+                self.options.repack_min_savings_percent / 100.0),
+            resident_occupancy=self.options.resident_enabled))
         # priority-aware preemption: stranded high-priority pods take
         # capacity from lower-priority pods on existing nodes when no
         # offering is creatable (docs/design/preemption.md)
@@ -226,7 +231,7 @@ class Operator:
         one-pager next to /debug/traces' full causal record."""
         solver = self.provisioner.solver
         last = dict(getattr(solver, "last_stats", None) or {})
-        return {
+        out = {
             "backend": self.options.solver.backend,
             "started": self._started,
             "leader": bool(self.elector.is_leader()),
@@ -235,6 +240,13 @@ class Operator:
                                  for k, v in self.breaker.states().items()},
             "last_solve": last,
         }
+        # resident-store health (generation, resident bytes, last rebuild
+        # reason, delta sizes) — ResilientSolver delegates the attribute
+        # to its primary; None for greedy/remote backends or flag off
+        store = getattr(solver, "resident", None)
+        if store is not None:
+            out["resident"] = store.stats()
+        return out
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -247,13 +259,26 @@ class Operator:
         never boot-fatal."""
         if self.options.solver.backend != "jax":
             return
+        self.aot = None
         try:
-            from karpenter_tpu.solver.warmup import (
-                enable_persistent_compile_cache,
-            )
+            import os
 
-            enable_persistent_compile_cache(
-                self.options.compile_cache_dir or None)
+            cache_dir = self.options.compile_cache_dir \
+                or os.environ.get("KARPENTER_TPU_COMPILE_CACHE", "")
+            if cache_dir:
+                # the AOT executable cache (resident/aot.py) wraps the
+                # persistent compile cache: it also records every NEW
+                # dispatch signature into a manifest, so the warmup
+                # below can replay exactly what production compiled
+                from karpenter_tpu.resident.aot import AOTExecutableCache
+
+                self.aot = AOTExecutableCache(cache_dir).enable()
+            else:
+                from karpenter_tpu.solver.warmup import (
+                    enable_persistent_compile_cache,
+                )
+
+                enable_persistent_compile_cache(None)
         except Exception as e:  # noqa: BLE001
             log.warning("compile cache setup failed", error=str(e)[:200])
         if not self.options.solver_warmup:
@@ -290,6 +315,11 @@ class Operator:
                 if catalog is None:
                     catalog = CatalogArrays.build(self.instance_types.list())
                 warmup_solver(self.provisioner.solver, catalog)
+                if self.aot is not None:
+                    # warm-restart tier: replay the signatures a prior
+                    # process dispatched, each served from the disk
+                    # cache instead of a cold XLA compile
+                    self.aot.prewarm(self.provisioner.solver, catalog)
             except Exception as e:  # noqa: BLE001 — warmup is best-effort
                 log.warning("solver warmup failed", error=str(e)[:200])
 

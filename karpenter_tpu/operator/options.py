@@ -65,6 +65,11 @@ class Options:
     gang_enabled: bool = False             # KARPENTER_ENABLE_GANG
     orphan_cleanup_enabled: bool = False   # KARPENTER_ENABLE_ORPHAN_CLEANUP
     repack_enabled: bool = False           # KARPENTER_ENABLE_REPACK
+    # device-resident cluster state + delta-encoded incremental solves
+    # (karpenter_tpu/resident/, docs/design/resident.md): opt-in like
+    # preemption/gang/repack — it changes what lives on device between
+    # windows and how the repack plane snapshots occupancy
+    resident_enabled: bool = False         # KARPENTER_ENABLE_RESIDENT
     repack_min_savings_percent: int = 15   # apply repack only above this
     spot_discount_percent: int = 60        # spot = % of on-demand (options.go:76)
     metrics_port: int = 0                  # 0 = metrics server disabled
@@ -119,6 +124,7 @@ class Options:
             orphan_cleanup_enabled=_getb(env, "KARPENTER_ENABLE_ORPHAN_CLEANUP",
                                          False),
             repack_enabled=_getb(env, "KARPENTER_ENABLE_REPACK", False),
+            resident_enabled=_getb(env, "KARPENTER_ENABLE_RESIDENT", False),
             repack_min_savings_percent=_geti(
                 env, "KARPENTER_REPACK_MIN_SAVINGS_PERCENT", 15),
             spot_discount_percent=_geti(env, "KARPENTER_SPOT_DISCOUNT_PERCENT",

@@ -183,7 +183,8 @@ def fleet_solve_pallas(problem: FleetProblem, *, num_nodes: int,
                        device_catalog=None, compact: int = 0,
                        compact_cap: int | None = None,
                        coo_state: CooCapacity | None = None,
-                       packed_inputs=None, async_only: bool = False):
+                       packed_inputs=None, async_only: bool = False,
+                       resident_buf=None):
     """Single-dispatch fleet solve through the Mosaic fleet grid.
     ``device_catalog`` (from :func:`fleet_device_catalog`) keeps the
     catalog upload out of the per-window path; ``packed_inputs`` (from
@@ -193,7 +194,11 @@ def fleet_solve_pallas(problem: FleetProblem, *, num_nodes: int,
     may start below the nnz bound ``compact_cap`` — D2H payload is
     latency through the tunnel — and the finalizer re-dispatches at 4x
     on the sound full-buffer overflow signal (jax_backend.coo_buffer_
-    full)."""
+    full).  ``resident_buf`` (a resident.store.ResidentBuffer) keeps the
+    stacked input DEVICE-RESIDENT across windows: an unchanged window
+    reuses the buffer outright and a churned one moves only the padded
+    word delta through the donated update kernel — the fleet-path arm
+    of ROADMAP-1 (per-window H2D bounded by the delta, not C x Li)."""
     from karpenter_tpu.solver.jax_backend import coo_buffer_full, grow_coo
 
     C, G, O = problem.compat.shape
@@ -206,18 +211,25 @@ def fleet_solve_pallas(problem: FleetProblem, *, num_nodes: int,
         coo_state = CooCapacity(
             min(compact, G * N),
             min(compact_cap if compact_cap is not None else compact, G * N))
+    dispatch_ins = ins
+    if resident_buf is not None:
+        # the buffer accounts its own telemetry (delta vs rebuild bytes,
+        # donated update dispatch); the solve dispatch below then sees a
+        # device-resident input (no H2D, no donation miss).  Safe to
+        # reuse across retries: fleet_packed_pallas does not donate it.
+        dispatch_ins, _ = resident_buf.update(ins, kernel="fleet-resident")
 
     def dispatch(K):
         # device telemetry at DISPATCH level (never inside the traced
         # kernel — GL107): a host-numpy input is an H2D upload and a
         # donation miss; a new (C,G,O,U,N,K) signature is a recompile
-        host_input = isinstance(ins, np.ndarray)
+        host_input = isinstance(dispatch_ins, np.ndarray)
         get_devtel().note_dispatch(
             "fleet-pallas", (C, G, O, U_pad, N, K, right_size),
             h2d_bytes=int(ins.nbytes) if host_input else 0,
             donated=not host_input)
         out_dev = fleet_packed_pallas(
-            ins, alloc8_all, rank_all, price_all,
+            dispatch_ins, alloc8_all, rank_all, price_all,
             C=C, G=G, O=O, U=U_pad, N=N, right_size=right_size,
             interpret=interpret, compact=K)
         try:

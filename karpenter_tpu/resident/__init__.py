@@ -1,0 +1,64 @@
+"""Device-resident cluster state: delta-encoded incremental solves.
+
+ROADMAP item 1 (the substrate items 2-5 build on): BENCH_r05 shows the
+solver is >98% transfer/dispatch overhead on the single-shot path —
+compute ~1.2 ms against an exec_fetch of ~70 ms with an rtt_floor of
+~68 ms, plus encode_cold of ~105-117 ms and ~19 ms of first-solve
+overhead.  The scheduler loop has exactly the shape CvxCluster
+(PAPERS.md) amortizes 100-1000x: each window differs from the last by a
+handful of pod arrivals/departures and claim transitions, yet the
+pre-resident path re-encoded and re-uploaded the whole world every
+window.
+
+This package keeps the per-window problem state RESIDENT on device as
+donated buffers and moves only what changed:
+
+- :mod:`karpenter_tpu.resident.delta` — the delta encoder: lowers a
+  window to compact ``(word index, word value)`` update tensors against
+  the previous window's device-resident packed buffer (pod arrivals,
+  departures and occupancy changes all manifest as changed meta rows /
+  label-row words of the packed layout).  Full re-encode remains the
+  cold/recovery path and the parity oracle: a resident incremental
+  solve must be bit-identical to a from-scratch encode.
+- :mod:`karpenter_tpu.resident.kernels` — the donated device kernels:
+  ``update_resident`` (apply a delta in place, old buffer donated) and
+  ``solve_resident`` (fused delta-apply + packed solve in ONE dispatch,
+  returning the new resident state alongside the result buffer).
+- :mod:`karpenter_tpu.resident.store` — generation-tracked state:
+  ``ResidentStore`` (the solver-side store JaxSolver dispatches
+  through), ``ResidentBuffer`` (the generic buffer parallel/fleet
+  rides), and ``OccupancySnapshot`` (the one-per-tick occupancy view
+  the disruption/repack plane shares instead of per-claim pod scans).
+  Catalog updates, NodePool edits and degraded-mode fallbacks force a
+  clean rebuild — never a silent solve against stale device state.
+- :mod:`karpenter_tpu.resident.aot` — the AOT executable cache: the
+  static-shape signatures devtel tracks are persisted in a manifest
+  next to JAX's on-disk compilation cache, so a restarted process
+  pre-compiles exactly the executables production dispatched before
+  (cuts encode_cold / first-solve overhead; tools/warm_restart_check.py
+  is the CI gate).
+
+Opt-in via ``KARPENTER_ENABLE_RESIDENT`` (the preempt/gang convention)
+or ``SolverOptions.resident="on"``.  Design: docs/design/resident.md.
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def resident_enabled(options=None, env=None) -> bool:
+    """The one gate every wiring point shares: SolverOptions.resident
+    "on"/"off" wins; "auto" defers to KARPENTER_ENABLE_RESIDENT."""
+    mode = getattr(options, "resident", "auto") if options is not None \
+        else "auto"
+    if mode == "on":
+        return True
+    if mode == "off":
+        return False
+    raw = (os.environ if env is None else env).get(
+        "KARPENTER_ENABLE_RESIDENT", "")
+    return raw.lower() in ("1", "true", "yes", "on")
+
+
+__all__ = ["resident_enabled"]

@@ -1,0 +1,223 @@
+"""AOT executable cache: persist compiled-shape knowledge across restarts.
+
+Two layers (docs/design/resident.md "AOT cache keying"):
+
+1. **XLA's on-disk compilation cache** (solver/warmup.py
+   ``enable_persistent_compile_cache``): a restart recompiles nothing it
+   compiled before — but only once something ASKS for each executable.
+2. **The signature manifest** (this module): devtel already tracks every
+   dispatch's static-shape signature (the jit cache key — kernel path +
+   bucket-padded G/O/U/N + output layout).  The cache records each NEW
+   signature into ``aot_manifest.json`` next to the disk cache, and
+   :meth:`AOTExecutableCache.prewarm` replays the manifest through the
+   REAL jit entry points at boot — so a restarted process pre-compiles
+   exactly the executables production dispatched before, each served
+   from the disk cache instead of a cold XLA compile.  That is what
+   cuts ``encode_cold`` / first-solve overhead to a disk read
+   (tools/warm_restart_check.py is the CI gate on ``warmup_restart_s``).
+
+The manifest is advisory: unknown kernels, stale shapes (an O_pad
+smaller than the current catalog) and failed replays are skipped, never
+fatal — cold compilation always remains the fallback.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+
+import numpy as np
+
+from karpenter_tpu.utils.logging import get_logger
+
+log = get_logger("resident.aot")
+
+MANIFEST_NAME = "aot_manifest.json"
+MAX_ENTRIES = 512
+
+# kernels the prewarm replayer knows how to reconstruct dummy inputs
+# for; others are recorded anyway (future replayers) but skipped
+_PALLAS_KERNELS = {"pallas", "pallas-batch"}
+_SUPPORTED = {"scan", "scan-batch", "resident"} | _PALLAS_KERNELS
+
+
+class AOTExecutableCache:
+    """Signature manifest + persistent compile cache in one directory."""
+
+    def __init__(self, directory: str):
+        self.dir = directory
+        self.path = os.path.join(directory, MANIFEST_NAME)
+        self._entries: dict[tuple, None] = {}
+        self._enabled = False
+        self._load()
+
+    # -- persistence -------------------------------------------------------
+
+    def _load(self) -> None:
+        try:
+            with open(self.path, encoding="utf-8") as f:
+                doc = json.load(f)
+            for e in doc.get("entries", []):
+                sig = tuple(e["signature"])
+                self._entries[(e["kernel"], sig)] = None
+        except FileNotFoundError:
+            pass
+        except Exception as e:  # noqa: BLE001 — a corrupt manifest is a cold start
+            log.warning("aot manifest unreadable; starting cold",
+                        error=str(e)[:200])
+            self._entries = {}
+
+    def _flush(self) -> None:
+        os.makedirs(self.dir, exist_ok=True)
+        doc = {"version": 1,
+               "entries": [{"kernel": k, "signature": list(sig)}
+                           for (k, sig) in self._entries]}
+        tmp = self.path + ".tmp"
+        with open(tmp, "w", encoding="utf-8") as f:
+            json.dump(doc, f, indent=1, sort_keys=True)
+        os.replace(tmp, self.path)
+
+    # -- recording ---------------------------------------------------------
+
+    def enable(self) -> "AOTExecutableCache":
+        """Point JAX's persistent compile cache at the directory and
+        start recording new dispatch signatures from devtel."""
+        from karpenter_tpu.obs.devtel import get_devtel
+        from karpenter_tpu.solver.warmup import enable_persistent_compile_cache
+
+        enable_persistent_compile_cache(self.dir)
+        get_devtel().signature_sink = self.record
+        self._enabled = True
+        return self
+
+    def record(self, kernel: str, signature: tuple) -> None:
+        """One new static-shape signature (devtel sink).  Only flat
+        int/bool signatures round-trip through JSON; anything else is
+        left to the disk cache alone."""
+        if not all(isinstance(v, (int, bool)) for v in signature):
+            return
+        key = (kernel, tuple(signature))
+        if key in self._entries:
+            return
+        # FIFO eviction at the cap: a long-lived cache dir whose
+        # workload shapes drift must keep recording what production
+        # dispatches NOW, not freeze on the first 512 shapes ever seen
+        while len(self._entries) >= MAX_ENTRIES:
+            self._entries.pop(next(iter(self._entries)))
+        self._entries[key] = None
+        try:
+            self._flush()
+        except OSError as e:
+            log.warning("aot manifest write failed", error=str(e)[:200])
+
+    def entries(self) -> list[tuple]:
+        return list(self._entries)
+
+    # -- replay ------------------------------------------------------------
+
+    def prewarm(self, solver, catalog, *, block: bool = True) -> dict:
+        """Replay every manifest signature through the real jit entry
+        points (zero-filled problems: the solve is trivial, the compile
+        — served from the disk cache — is the point).  Returns
+        ``{"warmed", "skipped", "seconds"}``."""
+        import jax
+
+        t0 = time.perf_counter()
+        on_tpu = jax.default_backend() not in ("cpu", "gpu")
+        warmed = skipped = 0
+        pending = []
+        for kernel, sig in list(self._entries):
+            if kernel not in _SUPPORTED or \
+                    (kernel in _PALLAS_KERNELS and not on_tpu):
+                skipped += 1
+                continue
+            try:
+                dev = self._replay_one(solver, catalog, kernel, sig)
+            except Exception as e:  # noqa: BLE001 — prewarm is best-effort
+                log.warning("aot prewarm entry failed", kernel=kernel,
+                            signature=sig, error=str(e)[:200])
+                skipped += 1
+                continue
+            if dev is None:
+                skipped += 1
+            else:
+                pending.append(dev)
+                warmed += 1
+        if block:
+            for dev in pending:
+                try:
+                    jax.block_until_ready(dev)
+                except Exception:  # noqa: BLE001
+                    pass
+        out = {"warmed": warmed, "skipped": skipped,
+               "seconds": round(time.perf_counter() - t0, 3)}
+        log.info("aot prewarm done", **out)
+        return out
+
+    def _replay_one(self, solver, catalog, kernel: str, sig: tuple):
+        from karpenter_tpu.solver.jax_backend import pack_input
+
+        if kernel == "resident":
+            G, O, U, N, D, K, d16, c16, rs = sig
+        elif kernel in ("scan-batch", "pallas-batch"):
+            G, O, U, N, C, K, d16, c16, rs = sig
+        else:
+            G, O, U, N, K, d16, c16, rs = sig
+        if O % 32 or O < catalog.num_offerings:
+            return None   # stale shape: this catalog no longer fits it
+        packed = pack_input(np.zeros((G, 4), np.int32),
+                            np.zeros(G, np.int32), np.zeros(G, np.int32),
+                            np.zeros(G, np.int32), np.zeros((U, O), bool))
+        if kernel == "scan":
+            from karpenter_tpu.solver.jax_backend import solve_packed
+
+            off_alloc, off_price, off_rank = solver._device_offerings(
+                catalog, O)
+            return solve_packed(packed, off_alloc, off_price, off_rank,
+                                G=G, O=O, U=U, N=N, right_size=rs,
+                                compact=K, dense16=d16, coo16=c16)
+        if kernel == "scan-batch":
+            from karpenter_tpu.solver.jax_backend import solve_packed_batch
+
+            off_alloc, off_price, off_rank = solver._device_offerings(
+                catalog, O)
+            return solve_packed_batch(
+                np.stack([packed] * C), off_alloc, off_price, off_rank,
+                G=G, O=O, U=U, N=N, right_size=rs, compact=K,
+                dense16=d16, coo16=c16)
+        if kernel == "resident":
+            import jax
+
+            from karpenter_tpu.resident.kernels import solve_resident
+
+            off_alloc, off_price, off_rank = solver._device_offerings(
+                catalog, O)
+            didx = np.full(D, packed.size, np.int32)
+            dval = np.zeros(D, np.int32)
+            _, out = solve_resident(
+                jax.device_put(packed), didx, dval,
+                off_alloc, off_price, off_rank,
+                G=G, O=O, U=U, N=N, right_size=rs, compact=K,
+                dense16=d16, coo16=c16)
+            return out
+        if kernel == "pallas":
+            from karpenter_tpu.solver.jax_backend import solve_packed_pallas
+
+            alloc8, rank_row, price = solver._device_offerings_pallas(
+                catalog, O)
+            return solve_packed_pallas(packed, alloc8, rank_row, price,
+                                       G=G, O=O, U=U, N=N, right_size=rs,
+                                       compact=K, dense16=d16, coo16=c16)
+        if kernel == "pallas-batch":
+            from karpenter_tpu.solver.jax_backend import (
+                solve_packed_pallas_batch,
+            )
+
+            alloc8, rank_row, price = solver._device_offerings_pallas(
+                catalog, O)
+            return solve_packed_pallas_batch(
+                np.stack([packed] * C), alloc8, rank_row, price,
+                C=C, G=G, O=O, U=U, N=N, right_size=rs, compact=K,
+                dense16=d16, coo16=c16)
+        return None

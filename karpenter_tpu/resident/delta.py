@@ -1,0 +1,108 @@
+"""Delta encoder: window -> compact update tensors vs the resident state.
+
+The packed solve buffer (jax_backend pack_input: [G,8] meta rows + the
+factored label-row bitset) is a CONTENT-ADDRESSED lowering of a window:
+pod arrivals and departures change a handful of meta rows (count /
+request columns of their groups), claim transitions change nothing (the
+solve input is the pending set), and constraint changes flip label-row
+words.  So the minimal correct delta between two windows is exactly the
+set of int32 words that differ — computed here as one vectorized
+``np.nonzero`` over the mirror, then padded to a small bucket ladder so
+the donated update kernel compiles once per rung, not per window.
+
+Parity contract: applying ``(idx, val)`` on device must reproduce the
+full host packed buffer bit-for-bit (the chaos invariant and the
+differential tests rebuild from ClusterState and compare) — which makes
+the incremental solve bit-identical to a from-scratch encode by
+construction: the solve kernel's input IS the full buffer.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import numpy as np
+
+# delta-size rungs: the (didx, dval) pair is padded up to one of these
+# so XLA compiles the update/solve executable once per rung.  Padding
+# entries carry an out-of-range index and are dropped on device
+# (.at[].set(mode="drop")).
+DELTA_BUCKETS = (64, 256, 1024, 4096, 16384, 65536)
+
+# a delta larger than this fraction of the buffer loses to a plain
+# re-upload (diff + scatter overhead for most of the buffer's words);
+# the window rebuilds instead
+REBUILD_FRACTION = 0.5
+
+
+@dataclass(frozen=True)
+class WindowDelta:
+    """What one window cost against the resident state."""
+
+    mode: str              # "hit" (no change) | "delta" | "rebuild"
+    words: int             # changed int32 words (0 for hit; buffer size
+                           # for rebuild)
+    h2d_bytes: int         # bytes this window actually moved host->device
+    reason: str = ""       # rebuild reason ("" unless mode == "rebuild")
+    arrivals: int = 0      # semantic churn, when the caller tracked pod
+    departures: int = 0    # keys across windows (telemetry only)
+
+
+def diff_words(mirror: np.ndarray, packed: np.ndarray) -> np.ndarray:
+    """int64 indices of words differing between the resident mirror and
+    the new window's packed buffer (both flat int32, same length)."""
+    return np.nonzero(mirror != packed)[0]
+
+
+def pad_delta(idx: np.ndarray, val: np.ndarray, drop_index: int,
+              buckets=DELTA_BUCKETS) -> tuple[np.ndarray, np.ndarray]:
+    """Pad ``(idx, val)`` up to the smallest bucket: padding rows carry
+    ``drop_index`` (one past the buffer end) so the device-side
+    ``.at[].set(mode="drop")`` ignores them."""
+    from karpenter_tpu.solver.types import bucket
+
+    d_pad = bucket(max(int(idx.size), 1), buckets)
+    didx = np.full(d_pad, drop_index, dtype=np.int32)
+    dval = np.zeros(d_pad, dtype=np.int32)
+    didx[:idx.size] = idx
+    dval[:idx.size] = val
+    return didx, dval
+
+
+def pod_churn(prev_keys: frozenset, pods) -> tuple[int, int, frozenset]:
+    """(arrivals, departures, current key set) between two windows —
+    the semantic delta size reported alongside the word-level one."""
+    from karpenter_tpu.apis.pod import pod_key
+
+    cur = frozenset(pod_key(p) for p in pods)
+    return (len(cur - prev_keys), len(prev_keys - cur), cur)
+
+
+def pack_window(problem) -> tuple[np.ndarray, tuple[int, int, int]]:
+    """Lower an EncodedProblem to its bucket-padded packed buffer +
+    shape key — the SAME padding and packing ``JaxSolver._prepare``
+    applies (shared code path: pack_input / dedup_rows / the bucket
+    ladders), so a host-side tracker (chaos harness, invariant rebuild)
+    and the solver agree on the buffer layout word for word."""
+    from karpenter_tpu.solver.jax_backend import (
+        _pad1, _pad2, dedup_rows, pack_input,
+    )
+    from karpenter_tpu.solver.types import (
+        GROUP_BUCKETS, LABELROW_BUCKETS, OFFERING_BUCKETS, bucket,
+    )
+
+    G = problem.num_groups
+    O = problem.catalog.num_offerings
+    G_pad = bucket(G, GROUP_BUCKETS)
+    O_pad = bucket(O, OFFERING_BUCKETS)
+    if problem.label_rows is not None and problem.label_idx is not None:
+        rows, label_idx = problem.label_rows, problem.label_idx
+    else:
+        label_idx, rows = dedup_rows(problem.compat)
+    U_pad = bucket(max(rows.shape[0], 1), LABELROW_BUCKETS)
+    packed = pack_input(_pad2(problem.group_req, G_pad),
+                        _pad1(problem.group_count, G_pad),
+                        _pad1(problem.group_cap, G_pad),
+                        _pad1(label_idx, G_pad),
+                        _pad2(rows, U_pad, O_pad))
+    return packed, (G_pad, O_pad, U_pad)

@@ -1,0 +1,65 @@
+"""Donated device kernels for the resident state store.
+
+Two entry points, both with the old state buffer DONATED (graftlint
+GL006: the transient state input must alias the output, never double
+the device footprint):
+
+- :func:`update_resident` — apply one delta in place; the standalone
+  form non-solver consumers ride (the fleet path's input buffer, the
+  chaos harness's tracked window state).
+- :func:`solve_resident` — delta-apply FUSED with the packed solve in
+  ONE dispatch: the per-window H2D collapses to the (idx, val) pair and
+  the new resident state rides back as an aliased output next to the
+  packed result buffer.  Traces the same ``_unpack_problem`` +
+  ``solve_core`` body as ``solve_packed``, so a resident incremental
+  solve on a bit-identical buffer is bit-identical to the from-scratch
+  path (the parity contract docs/design/resident.md pins).
+
+The catalog tensors (off_alloc / off_price / off_rank) are the
+device-RESIDENT cache JaxSolver keys by generation — they are never
+donated (GL006's explicit carve-out).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+
+from karpenter_tpu.solver.jax_backend import (
+    _pack_result, _unpack_problem, solve_core,
+)
+
+
+@functools.partial(jax.jit, donate_argnames=("state",))
+def update_resident(state, didx, dval):
+    """Scatter a padded word delta into the resident buffer: padding
+    entries carry an out-of-range index and drop.  The old buffer is
+    donated — the update aliases in place on device."""
+    flat = state.reshape(-1).at[didx].set(dval, mode="drop")
+    return flat.reshape(state.shape)
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("G", "O", "U", "N", "right_size",
+                                    "compact", "dense16", "coo16"),
+                   donate_argnames=("state",))
+def solve_resident(state, didx, dval, off_alloc, off_price, off_rank, *,
+                   G: int, O: int, U: int, N: int,
+                   right_size: bool = True, compact: int = 0,
+                   dense16: bool = False, coo16: bool = False):
+    """Delta-apply + packed solve in one dispatch.
+
+    Args: ``state`` int32 [L] resident packed buffer (donated);
+    ``didx``/``dval`` int32 [D] padded word delta; catalog tensors as in
+    ``solve_packed``.  Returns ``(new_state, packed_result)`` — the new
+    state stays on device for the next window's delta.
+    """
+    state = state.at[didx].set(dval, mode="drop")
+    meta, compat_i = _unpack_problem(state, off_alloc, G, O, U)
+    node_off, assign, unplaced, cost = solve_core(
+        meta[:, :4], meta[:, 4], meta[:, 5], compat_i > 0,
+        off_alloc, off_price, off_rank, num_nodes=N,
+        right_size=right_size)
+    return state, _pack_result(node_off, assign, unplaced, cost, compact,
+                               dense16, coo16)

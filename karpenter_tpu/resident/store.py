@@ -1,0 +1,451 @@
+"""Generation-tracked device-resident state: the store itself.
+
+Three consumers share this module (docs/design/resident.md):
+
+- :class:`ResidentStore` — the solver-side store.  ``JaxSolver``
+  dispatches warm windows through :meth:`dispatch_solve` (fused
+  delta-apply + solve, ONE device input of delta size); host-side
+  trackers (the chaos harness, the greedy parity leg of the
+  differential tests) ride :meth:`track_window`, which maintains the
+  same mirror + device buffer through the standalone update kernel.
+- :class:`ResidentBuffer` — one generic donated buffer + host mirror;
+  the fleet path keeps its stacked [C, Li] input resident with it.
+- :class:`OccupancySnapshot` — the one-per-tick occupancy view the
+  disruption/repack plane reads instead of re-scanning every pod per
+  claim (O(claims x pods) host rebuilds were the repack tick's tail).
+
+Invalidation rules (the "generation-tracked" part): a state's device
+tensors are only ever consulted when its recorded generation equals the
+catalog's ``(generation, availability_generation)`` AND the window's
+padded shape matches — anything else is a clean rebuild with the reason
+recorded.  Degraded-mode fallbacks call :meth:`ResidentStore.invalidate`
+so the next window never solves against device state a failed dispatch
+may have poisoned.  The full re-encode path remains both the recovery
+path and the parity oracle: between chaos sync rounds the
+``resident-state-fresh`` invariant rebuilds the packed buffer from
+ClusterState and compares it word-for-word against the mirror AND the
+fetched device tensors.
+"""
+
+from __future__ import annotations
+
+import threading
+
+import numpy as np
+
+from karpenter_tpu.resident.delta import (
+    DELTA_BUCKETS, REBUILD_FRACTION, WindowDelta, diff_words, pack_window,
+    pad_delta, pod_churn,
+)
+from karpenter_tpu import obs
+from karpenter_tpu.obs.devtel import get_devtel
+from karpenter_tpu.utils.logging import get_logger
+
+log = get_logger("resident.store")
+
+
+def plan_update(buf, flat: np.ndarray, generation: tuple | None):
+    """THE one cold/generation/shape/oversized-delta decision ladder,
+    shared by every resident consumer (``ResidentBuffer.update`` and the
+    solver's fused dispatch must never drift apart on invalidation
+    semantics).  ``buf`` is anything exposing mirror/dev/generation/
+    pending_reason.  Returns ``(reason, idx)``: a non-empty reason means
+    rebuild (a pending invalidation reason wins over the generic
+    "cold"); otherwise ``idx`` holds the changed-word indices (possibly
+    empty = hit)."""
+    if buf.dev is None or buf.mirror is None:
+        return buf.pending_reason or "cold", None
+    if buf.generation != generation:
+        return "generation", None
+    if buf.mirror.shape != flat.shape:
+        return "shape", None
+    idx = diff_words(buf.mirror, flat)
+    if idx.size > max(64, flat.size * REBUILD_FRACTION):
+        return "delta_too_large", None
+    return "", idx
+
+
+class ResidentBuffer:
+    """One device-resident int32 buffer + its host mirror.
+
+    ``update(host)`` returns the device buffer to dispatch: a no-change
+    window reuses it outright (zero H2D), a small diff rides the donated
+    ``update_resident`` kernel as a padded (idx, val) pair, and a shape/
+    generation change or an oversized diff rebuilds from host.  The
+    mirror always equals the device content — that equality IS the
+    parity contract the invariants check.
+    """
+
+    __slots__ = ("name", "mirror", "dev", "generation", "stats",
+                 "pending_reason")
+
+    def __init__(self, name: str = "buffer"):
+        self.name = name
+        self.mirror: np.ndarray | None = None
+        self.dev = None
+        self.generation: tuple | None = None
+        self.stats = {"hit": 0, "delta": 0, "rebuild": 0}
+        # an explicit invalidation's reason, reported by the NEXT
+        # rebuild instead of the generic "cold" (one logical rebuild =
+        # one counted rebuild, carrying the cause)
+        self.pending_reason = ""
+
+    def invalidate(self, reason: str = "") -> None:
+        self.mirror = None
+        self.dev = None
+        self.generation = None
+        self.pending_reason = reason
+
+    def update(self, host: np.ndarray, generation: tuple | None = None,
+               kernel: str = "resident-update"):
+        """-> (device buffer, WindowDelta).  ``host`` must be int32."""
+        import jax
+
+        from karpenter_tpu.resident.kernels import update_resident
+
+        flat = host.reshape(-1)
+        reason, idx = plan_update(self, flat, generation)
+        if not reason:
+            if idx.size == 0:
+                self.stats["hit"] += 1
+                delta = WindowDelta(mode="hit", words=0, h2d_bytes=0)
+                self._note(kernel, host, delta, generation)
+                return self.dev, delta
+            didx, dval = pad_delta(idx, flat[idx], flat.size,
+                                   DELTA_BUCKETS)
+            self.dev = update_resident(self.dev, didx, dval)
+            self.mirror[idx] = flat[idx]
+            self.stats["delta"] += 1
+            delta = WindowDelta(
+                mode="delta", words=int(idx.size),
+                h2d_bytes=int(didx.nbytes + dval.nbytes))
+            self._note(kernel, host, delta, generation)
+            return self.dev, delta
+        self.dev = jax.device_put(host)
+        self.mirror = flat.copy()
+        self.generation = generation
+        self.pending_reason = ""
+        self.stats["rebuild"] += 1
+        delta = WindowDelta(mode="rebuild", words=int(flat.size),
+                            h2d_bytes=int(host.nbytes), reason=reason)
+        self._note(kernel, host, delta, generation)
+        return self.dev, delta
+
+    def _note(self, kernel: str, host: np.ndarray, delta: WindowDelta,
+              generation) -> None:
+        get_devtel().note_resident_window(
+            delta.mode, h2d_bytes=delta.h2d_bytes, words=delta.words,
+            reason=delta.reason, resident_bytes=int(host.nbytes),
+            generation=generation)
+        if delta.mode != "hit":
+            get_devtel().note_dispatch(
+                kernel, (host.size, delta.mode == "rebuild"),
+                h2d_bytes=delta.h2d_bytes,
+                donated=delta.mode == "delta")
+
+
+class _SolveState:
+    """Per-(catalog uid, padded shape) resident solve state: the buffer
+    plus the tracked window's pod-key set (semantic churn telemetry)."""
+
+    __slots__ = ("buf", "pod_keys")
+
+    def __init__(self):
+        self.buf = ResidentBuffer(name="solve")
+        self.pod_keys: frozenset = frozenset()
+
+
+class ResidentStore:
+    """The solver-side store: keyed resident solve states + counters."""
+
+    MAX_STATES = 8   # distinct (catalog uid, shape) combos kept resident
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._states: dict[tuple, _SolveState] = {}
+        self.windows = 0
+        self.rebuilds = 0
+        self.invalidations = 0
+        self.last_delta: WindowDelta | None = None
+        self.last_rebuild_reason = ""
+        self.last_key: tuple | None = None
+
+    # -- state bookkeeping -------------------------------------------------
+
+    def _state_for(self, key: tuple) -> _SolveState:
+        with self._lock:
+            st = self._states.get(key)
+            if st is None:
+                while len(self._states) >= self.MAX_STATES:
+                    self._states.pop(next(iter(self._states)))
+                st = self._states[key] = _SolveState()
+            return st
+
+    def invalidate(self, reason: str = "invalidated") -> None:
+        """Drop EVERY resident state (degraded-mode fallback, NodePool
+        edit, operator request): the next window of each key rebuilds
+        from host instead of trusting possibly-stale device tensors.
+        The reason rides to that rebuild (ONE logical rebuild, counted
+        once, carrying its cause) rather than being double-counted
+        here."""
+        with self._lock:
+            for st in self._states.values():
+                st.buf.invalidate(reason)
+            occ = getattr(self, "_occ_buf", None)
+            if occ is not None:
+                occ.invalidate(reason)
+            self.invalidations += 1
+        get_devtel().note_resident_invalidation(reason)
+
+    def _account(self, key: tuple, delta: WindowDelta) -> None:
+        with self._lock:
+            self.windows += 1
+            if delta.mode == "rebuild":
+                self.rebuilds += 1
+                self.last_rebuild_reason = delta.reason
+            self.last_delta = delta
+            self.last_key = key
+
+    # -- the solver dispatch path ------------------------------------------
+
+    def dispatch_solve(self, prep, packed: np.ndarray, catalog_tensors,
+                       right_size: bool):
+        """Fused delta-apply + solve for one prepared window; returns
+        the device result buffer (same wire layout as ``solve_packed``).
+        The caller (JaxSolver._dispatch) owns routing, escalation and
+        fallback — an exception here must invalidate + fall back there.
+        """
+        from karpenter_tpu.resident.kernels import solve_resident
+
+        catalog = prep.catalog
+        key = (catalog.uid, prep.G_pad, prep.O_pad, prep.U_pad)
+        gen = (catalog.generation, catalog.availability_generation)
+        buf = self._state_for(key).buf
+        flat = packed.reshape(-1)
+        t0 = obs.now()
+        reason, idx = plan_update(buf, flat, gen)
+        if reason:
+            import jax
+
+            buf.dev = jax.device_put(flat)
+            buf.mirror = flat.copy()
+            buf.generation = gen
+            buf.pending_reason = ""
+            buf.stats["rebuild"] += 1
+            didx, dval = pad_delta(np.empty(0, np.int64),
+                                   np.empty(0, np.int32), flat.size)
+            delta = WindowDelta(mode="rebuild", words=int(flat.size),
+                                h2d_bytes=int(flat.nbytes), reason=reason)
+        else:
+            didx, dval = pad_delta(idx, flat[idx], flat.size)
+            if idx.size:
+                buf.mirror[idx] = flat[idx]
+                buf.stats["delta"] += 1
+                delta = WindowDelta(
+                    mode="delta", words=int(idx.size),
+                    h2d_bytes=int(didx.nbytes + dval.nbytes))
+            else:
+                # unchanged window: the delta pair still rides along
+                # (smallest bucket, all padding) so the dispatch shape
+                # stays uniform, but it IS a resident hit
+                buf.stats["hit"] += 1
+                delta = WindowDelta(mode="hit", words=0,
+                                    h2d_bytes=int(didx.nbytes + dval.nbytes))
+        off_alloc, off_price, off_rank = catalog_tensors
+        sig = (prep.G_pad, prep.O_pad, prep.U_pad, prep.N, didx.size,
+               prep.K, prep.dense16, prep.coo16, right_size)
+        get_devtel().note_dispatch(
+            "resident", sig, h2d_bytes=delta.h2d_bytes,
+            donated=delta.mode != "rebuild")
+        get_devtel().note_resident_window(
+            delta.mode, h2d_bytes=delta.h2d_bytes, words=delta.words,
+            reason=delta.reason, resident_bytes=int(flat.nbytes),
+            generation=(catalog.uid,) + gen)
+        buf.dev, out = solve_resident(
+            buf.dev, didx, dval, off_alloc, off_price, off_rank,
+            G=prep.G_pad, O=prep.O_pad, U=prep.U_pad, N=prep.N,
+            right_size=right_size, compact=prep.K, dense16=prep.dense16,
+            coo16=prep.coo16)
+        self._account(key, delta)
+        obs.record("resident.window", t0, obs.now(), mode=delta.mode,
+                   words=delta.words, h2d_bytes=delta.h2d_bytes,
+                   reason=delta.reason)
+        return out
+
+    # -- host-side window tracking (chaos harness, parity legs) ------------
+
+    def track_window(self, pods, catalog, nodepool=None) -> WindowDelta:
+        """Maintain the resident state for a window WITHOUT solving on
+        it: encode (memoized), pack, and apply the delta through the
+        standalone donated update kernel.  Non-jax backends (the chaos
+        harness runs greedy) exercise the exact store machinery the
+        solver path relies on — and the invariant checks it against a
+        fresh ClusterState rebuild between sync rounds."""
+        from karpenter_tpu.solver.encode import encode
+
+        problem = encode(pods, catalog, nodepool)
+        packed, (G_pad, O_pad, U_pad) = pack_window(problem)
+        key = (catalog.uid, G_pad, O_pad, U_pad)
+        gen = (catalog.generation, catalog.availability_generation)
+        st = self._state_for(key)
+        arrivals, departures, cur = pod_churn(st.pod_keys, pods)
+        st.pod_keys = cur
+        _, delta = st.buf.update(packed, gen)
+        delta = WindowDelta(mode=delta.mode, words=delta.words,
+                            h2d_bytes=delta.h2d_bytes, reason=delta.reason,
+                            arrivals=arrivals, departures=departures)
+        self._account(key, delta)
+        return delta
+
+    # -- device-resident claim occupancy -----------------------------------
+
+    def occupancy_tensors(self, cluster, catalog):
+        """Device-resident claim/occupancy tensors: one int32 row
+        ``[offering, pod_count, resid_cpu, resid_mem, resid_gpu,
+        resid_pods]`` per live launched claim (cluster insertion order,
+        padded to a node bucket), maintained through the same donated
+        delta path as the solve state.  Claim churn (register/delete)
+        and pod binds change a handful of rows per tick — this is the
+        residual-capacity substrate the repack-on-TPU item (ROADMAP 2)
+        solves against without a per-tick host rebuild + full upload.
+
+        Returns ``(claim_names, device [Nn_pad, 6] int32, WindowDelta)``.
+        """
+        from karpenter_tpu.apis.pod import NUM_RESOURCES
+        from karpenter_tpu.preempt.encode import (
+            _pod_req_vec, claim_pods, occupancy_index,
+        )
+        from karpenter_tpu.solver.types import NODE_BUCKETS, bucket
+
+        idx = occupancy_index(cluster)
+        alloc = catalog.offering_alloc().astype(np.int64)
+        names: list[str] = []
+        rows: list[tuple] = []
+        for c in cluster.nodeclaims():
+            if c.deleted or not c.launched:
+                continue
+            off = catalog.find_offering(c.instance_type, c.zone,
+                                        c.capacity_type)
+            if off is None:
+                continue
+            resid = alloc[off].copy()
+            count = 0
+            for p in claim_pods(cluster, c, index=idx):
+                resid -= _pod_req_vec(p.spec)
+                count += 1
+            names.append(c.name)
+            rows.append((off, count) + tuple(int(v) for v in resid))
+        width = 2 + NUM_RESOURCES
+        n_pad = bucket(max(len(rows), 1), NODE_BUCKETS)
+        arr = np.zeros((n_pad, width), dtype=np.int32)
+        if rows:
+            arr[:len(rows)] = np.asarray(rows, dtype=np.int64).clip(
+                np.iinfo(np.int32).min, np.iinfo(np.int32).max)
+        with self._lock:
+            buf = getattr(self, "_occ_buf", None)
+            if buf is None:
+                buf = self._occ_buf = ResidentBuffer(name="occupancy")
+        gen = (catalog.uid, catalog.generation,
+               catalog.availability_generation)
+        dev, delta = buf.update(arr, generation=gen,
+                                kernel="resident-occupancy")
+        return names, dev, delta
+
+    def snapshot_state(self, catalog=None) -> dict | None:
+        """The most recent state's (mirror, device fetch, generation) for
+        invariant checks / debug — None before any window."""
+        with self._lock:
+            key = self.last_key
+            st = self._states.get(key) if key is not None else None
+        if st is None or st.buf.mirror is None or st.buf.dev is None:
+            return None
+        return {"key": key, "generation": st.buf.generation,
+                "mirror": st.buf.mirror, "device": np.asarray(st.buf.dev)}
+
+    def stats(self) -> dict:
+        with self._lock:
+            last = self.last_delta
+            return {
+                "states": len(self._states),
+                "windows": self.windows,
+                "rebuilds": self.rebuilds,
+                "invalidations": self.invalidations,
+                "last_mode": last.mode if last else "",
+                "last_delta_words": last.words if last else 0,
+                "last_delta_h2d_bytes": last.h2d_bytes if last else 0,
+                "last_rebuild_reason": self.last_rebuild_reason,
+            }
+
+
+def resident_store_of(solver):
+    """The ResidentStore behind any solver-shaped object (ResilientSolver
+    delegates unknown attributes to its primary), or None."""
+    return getattr(solver, "resident", None)
+
+
+class OccupancySnapshot:
+    """One shared occupancy view per disruption tick.
+
+    Reproduces ``DisruptionController._bound_pods`` EXACTLY — pods whose
+    ``bound_node`` or ``nominated_node`` equals the queried name, in pod
+    collection order — from ONE pass over the pod collection instead of
+    one full scan per claim (the O(claims x pods) host rebuild the
+    resident store removes from the repack tick).  In-pass mutations
+    (consolidation rebinds, evictions) go through :meth:`rebind` /
+    :meth:`unbind`, which preserve each pod's original collection order
+    so results stay bit-identical to the per-call rescan path (pinned
+    by tests/test_resident.py).
+    """
+
+    def __init__(self, cluster):
+        from karpenter_tpu.apis.pod import pod_key
+
+        self._order: dict[str, int] = {}
+        self._by_name: dict[str, dict[str, None]] = {}
+        self._homes: dict[str, tuple[str, ...]] = {}
+        for i, p in enumerate(cluster.list("pods")):
+            key = pod_key(p.spec)
+            self._order[key] = i
+            names = []
+            if p.bound_node:
+                names.append(p.bound_node)
+            if p.nominated_node and p.nominated_node != p.bound_node:
+                names.append(p.nominated_node)
+            for n in names:
+                self._by_name.setdefault(n, {})[key] = None
+            self._homes[key] = tuple(names)
+
+    def pods_on(self, name: str) -> list[str]:
+        if not name:
+            return []
+        keys = self._by_name.get(name)
+        if not keys:
+            return []
+        return sorted(keys, key=self._order.__getitem__)
+
+    def _drop(self, key: str) -> None:
+        for n in self._homes.get(key, ()):
+            bucket = self._by_name.get(n)
+            if bucket is not None:
+                bucket.pop(key, None)
+        self._homes[key] = ()
+
+    def rebind(self, key: str, bound_node: str,
+               nominated_node: str = "") -> None:
+        """A consolidation move changed ``key``'s binding: re-home it
+        under its CURRENT (bound, nominated) pair — the same pair the
+        per-call rescan would see — at its original collection order."""
+        self._drop(key)
+        names = []
+        if bound_node:
+            names.append(bound_node)
+        if nominated_node and nominated_node != bound_node:
+            names.append(nominated_node)
+        for n in names:
+            self._by_name.setdefault(n, {})[key] = None
+        self._homes[key] = tuple(names)
+        self._order.setdefault(key, len(self._order))
+
+    def unbind(self, key: str) -> None:
+        """An eviction returned ``key`` to pending (no node)."""
+        self._drop(key)

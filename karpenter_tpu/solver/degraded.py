@@ -125,6 +125,16 @@ class ResilientSolver:
 
     def _degrade(self, request: SolveRequest, reason: str) -> Plan:
         metrics.ERRORS.labels("solver", f"degraded_{reason}").inc()
+        # a degraded solve may have left poisoned buffers behind (Mosaic
+        # runtime fault mid-pipeline, a donated state consumed by a
+        # failed dispatch): the resident store must rebuild from ground
+        # truth next window, never solve against stale device state
+        store = getattr(self.primary, "resident", None)
+        if store is not None:
+            try:
+                store.invalidate(f"degraded_{reason}")
+            except Exception:  # noqa: BLE001 — degradation must not fail
+                pass
         # the degradation is a first-class node in the causal chain: the
         # fallback's own "solve" span nests under it, so a dumped trace
         # shows WHICH solve ran degraded and why

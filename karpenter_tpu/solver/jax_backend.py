@@ -30,12 +30,24 @@ from __future__ import annotations
 
 import functools
 import time
+import warnings
 
 import numpy as np
 
 import jax
 import jax.numpy as jnp
 from jax import lax
+
+# The packed entry points donate their transient problem buffer (GL006).
+# A solve's output buffer has a different length than its input, so XLA
+# cannot ALIAS the donated memory and warns per executable — but the
+# donation still releases the input during execution (the footprint
+# halving the rule exists for); the aliasing miss is expected and benign
+# for shape-changing solves.  Only the resident path
+# (resident/kernels.solve_resident) achieves true aliasing by returning
+# the state buffer as an output.
+warnings.filterwarnings(
+    "ignore", message="Some donated buffers were not usable")
 
 from karpenter_tpu.solver.encode import BIG_CAP as BIG_CAP_I32
 from karpenter_tpu.solver.encode import EncodedProblem, encode
@@ -522,13 +534,19 @@ def _pallas_core(meta, compat_i, alloc8, rank_row, off_price, *, G: int,
 
 @functools.partial(jax.jit,
                    static_argnames=("G", "O", "U", "N", "right_size",
-                                    "compact", "dense16", "coo16"))
+                                    "compact", "dense16", "coo16"),
+                   donate_argnames=("packed",))
 def solve_packed(packed, off_alloc, off_price, off_rank, *, G: int, O: int,
                  U: int, N: int, right_size: bool = True, compact: int = 0,
                  dense16: bool = False, coo16: bool = False):
     """Packed-I/O solve through the lax.scan path: ONE device input (the
     per-window problem buffer; catalog tensors are device-resident and
-    cached), ONE device output."""
+    cached), ONE device output.  The transient problem buffer is DONATED
+    (GL006): dispatches upload a fresh host buffer per window, so the
+    device copy may alias into the result instead of living alongside it
+    — only the resident path (resident/kernels.solve_resident) keeps a
+    problem buffer alive across calls, and it round-trips the donated
+    state as an output."""
     meta, compat_i = _unpack_problem(packed, off_alloc, G, O, U)
     node_off, assign, unplaced, cost = solve_core(
         meta[:, :4], meta[:, 4], meta[:, 5], compat_i > 0,
@@ -540,7 +558,8 @@ def solve_packed(packed, off_alloc, off_price, off_rank, *, G: int, O: int,
 @functools.partial(jax.jit,
                    static_argnames=("G", "O", "U", "N", "P", "right_size",
                                     "compact", "dense16", "coo16",
-                                    "lam_bp"))
+                                    "lam_bp"),
+                   donate_argnames=("packed",))
 def solve_packed_pref(packed, pref_rows, pref_idx, off_alloc, off_price,
                       off_rank, *, G: int, O: int, U: int, N: int, P: int,
                       right_size: bool = True, compact: int = 0,
@@ -565,7 +584,8 @@ def solve_packed_pref(packed, pref_rows, pref_idx, off_alloc, off_price,
 
 @functools.partial(jax.jit,
                    static_argnames=("G", "O", "U", "N", "right_size",
-                                    "compact", "dense16", "coo16"))
+                                    "compact", "dense16", "coo16"),
+                   donate_argnames=("packed_rows",))
 def solve_packed_batch(packed_rows, off_alloc, off_price, off_rank, *,
                        G: int, O: int, U: int, N: int,
                        right_size: bool = True, compact: int = 0,
@@ -590,7 +610,8 @@ def solve_packed_batch(packed_rows, off_alloc, off_price, off_rank, *,
 @functools.partial(jax.jit,
                    static_argnames=("G", "O", "U", "N", "right_size",
                                     "interpret", "compact", "dense16",
-                                    "coo16"))
+                                    "coo16"),
+                   donate_argnames=("packed",))
 def solve_packed_pallas(packed, alloc8, rank_row, off_price, *, G: int,
                         O: int, U: int, N: int, right_size: bool = True,
                         interpret: bool = False, compact: int = 0,
@@ -610,7 +631,8 @@ def solve_packed_pallas(packed, alloc8, rank_row, off_price, *, G: int,
 
 @functools.partial(jax.jit,
                    static_argnames=("C", "G", "O", "U", "N", "right_size",
-                                    "compact", "dense16", "coo16"))
+                                    "compact", "dense16", "coo16"),
+                   donate_argnames=("packed_rows",))
 def solve_packed_pallas_batch(packed_rows, alloc8, rank_row, off_price, *,
                               C: int, G: int, O: int, U: int, N: int,
                               right_size: bool = True, compact: int = 0,
@@ -641,6 +663,39 @@ def solve_packed_pallas_batch(packed_rows, alloc8, rank_row, off_price, *,
                             compact, dense16, coo16)
 
     return jax.vmap(finish_one)(metas, compats, node_off, assign, unplaced)
+
+
+# Non-donated probe twins of the packed entry points, used ONLY by
+# compute_handle's k-dispatch slope measurement: the timed loop
+# re-dispatches ONE device-resident input buffer, which the production
+# entries would consume on the first call now that they donate their
+# transient problem buffer (GL006).  Probes trace the identical bodies,
+# so the measured chip slope is the production executable's.
+@functools.partial(jax.jit,
+                   static_argnames=("G", "O", "U", "N", "right_size",
+                                    "compact", "dense16", "coo16"))
+def _probe_packed(packed, off_alloc, off_price, off_rank, *, G: int,
+                  O: int, U: int, N: int, right_size: bool = True,
+                  compact: int = 0, dense16: bool = False,
+                  coo16: bool = False):
+    return solve_packed.__wrapped__(
+        packed, off_alloc, off_price, off_rank, G=G, O=O, U=U, N=N,
+        right_size=right_size, compact=compact, dense16=dense16,
+        coo16=coo16)
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("G", "O", "U", "N", "right_size",
+                                    "interpret", "compact", "dense16",
+                                    "coo16"))
+def _probe_packed_pallas(packed, alloc8, rank_row, off_price, *, G: int,
+                         O: int, U: int, N: int, right_size: bool = True,
+                         interpret: bool = False, compact: int = 0,
+                         dense16: bool = False, coo16: bool = False):
+    return solve_packed_pallas.__wrapped__(
+        packed, alloc8, rank_row, off_price, G=G, O=O, U=U, N=N,
+        right_size=right_size, interpret=interpret, compact=compact,
+        dense16=dense16, coo16=coo16)
 
 
 def solve_core(group_req, group_count, group_cap, compat,
@@ -691,7 +746,9 @@ def solve_core(group_req, group_count, group_cap, compat,
 
 @functools.partial(jax.jit,
                    static_argnames=("num_nodes", "right_size", "assign_dtype",
-                                    "compact"))
+                                    "compact"),
+                   donate_argnames=("group_req", "group_count", "group_cap",
+                                    "compat"))
 def solve_kernel(group_req, group_count, group_cap, compat,
                  off_alloc, off_price, off_rank, *, num_nodes: int,
                  right_size: bool = True, assign_dtype: str = "int32",
@@ -726,7 +783,8 @@ def solve_kernel(group_req, group_count, group_cap, compat,
 
 @functools.partial(jax.jit, static_argnames=("G", "O", "N", "right_size",
                                              "assign_dtype", "interpret",
-                                             "compact"))
+                                             "compact"),
+                   donate_argnames=("meta", "compat_i8"))
 def solve_kernel_pallas(meta, compat_i8, alloc8, rank_row, off_price, *,
                         G: int, O: int, N: int, right_size: bool = True,
                         assign_dtype: str = "int32",
@@ -830,6 +888,17 @@ class JaxSolver:
         # workload start at the grown size instead of re-paying the
         # double dispatch every solve
         self._coo_floor: dict[int, int] = {}
+        # device-resident problem state (karpenter_tpu/resident/): warm
+        # windows dispatch a fused delta-apply + solve instead of
+        # re-uploading the whole packed buffer.  Opt-in via
+        # KARPENTER_ENABLE_RESIDENT / SolverOptions.resident.
+        self.resident = None
+        from karpenter_tpu.resident import resident_enabled
+
+        if resident_enabled(self.options):
+            from karpenter_tpu.resident.store import ResidentStore
+
+            self.resident = ResidentStore()
 
     # -- public ------------------------------------------------------------
 
@@ -1178,7 +1247,13 @@ class JaxSolver:
         prep = self._prepare(problem)
         dev_in = jax.device_put(prep.packed)
         jax.block_until_ready(dev_in)
-        out, path = self._dispatch(prep, dev_in)    # resolve path + warm
+        # route resolution + warmup dispatches the HOST buffer (the
+        # production entries donate their packed input, so dev_in must
+        # never pass through them); the timed loop below re-dispatches
+        # dev_in through the non-donated probe twins.  The resident path
+        # is bypassed: its fused kernel mutates store state per call,
+        # which would skew a pure-slope measurement.
+        out, path = self._dispatch(prep, prep.packed, allow_resident=False)
         out.block_until_ready()
         rs = self.options.right_size if prep.right_size is None \
             else prep.right_size
@@ -1186,12 +1261,13 @@ class JaxSolver:
             # preference solves keep the (rare) routed dispatch — the
             # slope is still exact, just with the Python overhead noted
             def fn():
-                return self._dispatch(prep, dev_in)[0]
+                return self._dispatch(prep, prep.packed,
+                                      allow_resident=False)[0]
         elif path == "pallas":
             alloc8, rank_row, price = self._device_offerings_pallas(
                 prep.catalog, prep.O_pad)
             fn = functools.partial(
-                solve_packed_pallas, dev_in, alloc8, rank_row, price,
+                _probe_packed_pallas, dev_in, alloc8, rank_row, price,
                 G=prep.G_pad, O=prep.O_pad, U=prep.U_pad, N=prep.N,
                 right_size=rs, compact=prep.K, dense16=prep.dense16,
                 coo16=prep.coo16)
@@ -1199,7 +1275,7 @@ class JaxSolver:
             off_alloc, off_price, off_rank = self._device_offerings(
                 prep.catalog, prep.O_pad)
             fn = functools.partial(
-                solve_packed, dev_in, off_alloc, off_price, off_rank,
+                _probe_packed, dev_in, off_alloc, off_price, off_rank,
                 G=prep.G_pad, O=prep.O_pad, U=prep.U_pad, N=prep.N,
                 right_size=rs, compact=prep.K, dense16=prep.dense16,
                 coo16=prep.coo16)
@@ -1306,12 +1382,23 @@ class JaxSolver:
             h2d_bytes=int(arr.nbytes) if host_input else 0,
             donated=not host_input)
 
-    def _dispatch(self, prep: "_Prepared", arr):
+    def _dispatch(self, prep: "_Prepared", arr, allow_resident: bool = True):
         """Issue the packed solve (pallas with scan fallback).  ``arr`` is
         the packed input — host numpy (implicit single H2D) or an already
-        device-resident buffer.  Returns (device output, path name)."""
+        device-resident buffer.  Returns (device output, path name).
+
+        With the resident store engaged, host-packed preference-free
+        windows route through the fused delta-apply + solve kernel
+        instead (scan semantics; escalation retries re-enter here with
+        an empty delta).  ``allow_resident=False`` is the probe/bench
+        bypass (compute_handle)."""
         catalog, G_pad, O_pad = prep.catalog, prep.G_pad, prep.O_pad
         N = prep.N
+        if allow_resident and self.resident is not None \
+                and prep.pref_rows is None and isinstance(arr, np.ndarray):
+            out = self._dispatch_resident(prep, arr)
+            if out is not None:
+                return out, "resident"
         if prep.pref_rows is not None:
             # soft preferences: penalty-ranked scan path (pallas carries
             # no per-group rank rows; preferences are rare enough that
@@ -1381,6 +1468,30 @@ class JaxSolver:
             right_size=rs,
             compact=prep.K, dense16=prep.dense16, coo16=prep.coo16)
         return out, "scan"
+
+    def _dispatch_resident(self, prep: "_Prepared", packed: np.ndarray):
+        """One window through the resident store: the packed buffer is
+        diffed against the device-resident mirror and only the compact
+        (idx, val) delta crosses the host->device boundary (full
+        re-upload on cold/generation/shape rebuilds).  Returns the
+        device result buffer — same wire layout as the scan path — or
+        None after invalidating the store, so the caller falls back to
+        the classic host path (a resident failure must never fail a
+        solve window)."""
+        prep.K, prep.dense16, prep.coo16 = clamp_output_opts(
+            prep.K0, prep.dense16_ok, prep.G_pad, prep.N)
+        rs = self.options.right_size if prep.right_size is None \
+            else prep.right_size
+        try:
+            tensors = self._device_offerings(prep.catalog, prep.O_pad)
+            return self.resident.dispatch_solve(prep, packed, tensors, rs)
+        except Exception as e:  # noqa: BLE001 — degrade to the host path
+            log.warning("resident dispatch failed; host path fallback",
+                        error=str(e)[:300], G=prep.G_pad, O=prep.O_pad,
+                        N=prep.N)
+            metrics.ERRORS.labels("solver", "resident_fallback").inc()
+            self.resident.invalidate("dispatch_error")
+            return None
 
     def _compact_k(self, total_pods: int, G_pad: int) -> tuple[int, int]:
         """(initial, cap) COO capacity for the compacted assign fetch;

@@ -54,6 +54,12 @@ class SolverOptions:
                                     # fully non-preferred offering ranks
                                     # as (1+lambda)x its price; real cost
                                     # accounting is never touched
+    resident: str = "auto"          # device-resident cluster state with
+                                    # delta-encoded incremental solves
+                                    # (karpenter_tpu/resident/): "auto"
+                                    # defers to KARPENTER_ENABLE_RESIDENT
+                                    # (opt-in, the preempt/gang
+                                    # convention); "on"/"off" force it
     address: str = ""               # backend "remote": solver sidecar
                                     # gRPC address (host:port)
 

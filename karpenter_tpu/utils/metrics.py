@@ -380,6 +380,25 @@ DONATION_MISSES = Counter(
     "not donated device-resident state) — the transfer-overhead debt the "
     "device-resident refactor pays down, counted per call site", ("site",))
 
+# Resident state store (karpenter_tpu/resident/): per-window outcome of
+# the delta-encoded incremental solve path (docs/design/resident.md)
+RESIDENT_WINDOWS = Counter(
+    "karpenter_tpu_resident_windows_total",
+    "Solve windows through the resident state store by outcome: hit "
+    "(unchanged window, zero-delta dispatch), delta (compact update "
+    "tensors), rebuild (full re-upload)", ("mode",))
+RESIDENT_REBUILDS = Counter(
+    "karpenter_tpu_resident_rebuilds_total",
+    "Resident-state rebuilds by reason (cold, generation = catalog/"
+    "availability bump, shape = padded-bucket change, delta_too_large, "
+    "degraded_* = degraded-mode invalidation, nodepool_edit)", ("reason",))
+RESIDENT_DELTA_BYTES = Histogram(
+    "karpenter_tpu_resident_delta_bytes",
+    "Host->device bytes one resident window actually moved (the padded "
+    "delta pair on warm windows; the full packed buffer on rebuilds)",
+    (), buckets=(256, 1 << 10, 1 << 12, 1 << 14, 1 << 16, 1 << 18,
+                 1 << 20, 1 << 22))
+
 LEADER = Gauge(
     "karpenter_tpu_leader",
     "1 when this replica holds the named leader-election lease", ("lease",))

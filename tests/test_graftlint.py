@@ -349,6 +349,35 @@ def test_gl006_donated_good():
         """, "GL006")
 
 
+RESIDENT_PATH = "karpenter_tpu/resident/_snippet.py"
+
+
+def test_gl006_non_donated_update_kernel_bad():
+    # a resident-state update kernel that keeps the OLD state buffer
+    # alive doubles the store's device footprint — the exact debt the
+    # donation contract exists to prevent
+    assert_flags(
+        """
+        import jax
+
+        @jax.jit
+        def update_resident(state, didx, dval):
+            return state.at[didx].set(dval, mode="drop")
+        """, "GL006", RESIDENT_PATH)
+
+
+def test_gl006_donated_update_kernel_good():
+    assert_clean(
+        """
+        import functools
+        import jax
+
+        @functools.partial(jax.jit, donate_argnames=("state",))
+        def update_resident(state, didx, dval):
+            return state.at[didx].set(dval, mode="drop")
+        """, "GL006", RESIDENT_PATH)
+
+
 # -- Family B fixtures ------------------------------------------------------
 
 def test_gl101_lock_across_rpc_bad():

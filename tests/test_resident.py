@@ -1,0 +1,670 @@
+"""Device-resident state store tests (karpenter_tpu/resident/).
+
+The load-bearing contract is PARITY: a resident incremental solve must
+be bit-identical to a from-scratch encode on every backend — pinned
+here as a differential test over seeded churn sequences (jax resident
+vs jax full-encode; greedy with window tracking vs greedy fresh), plus
+the delta-encoder edge cases, generation-tracked invalidation, the
+donated update kernel, the AOT manifest round-trip, the fleet resident
+buffer, and the repack occupancy-snapshot parity pin
+(docs/design/resident.md).
+"""
+
+from __future__ import annotations
+
+import random
+
+import numpy as np
+import pytest
+
+from karpenter_tpu.apis.nodeclaim import NodeClaim, NodePool
+from karpenter_tpu.apis.nodeclass import NodeClass, NodeClassSpec
+from karpenter_tpu.apis.pod import PodSpec, ResourceRequests
+from karpenter_tpu.catalog import InstanceTypeProvider, PricingProvider
+from karpenter_tpu.catalog.arrays import CatalogArrays
+from karpenter_tpu.cloud.fake import FakeCloud
+from karpenter_tpu.core.cluster import ClusterState
+from karpenter_tpu.obs.devtel import get_devtel
+from karpenter_tpu.resident.delta import pack_window
+from karpenter_tpu.resident.store import (
+    OccupancySnapshot, ResidentBuffer, ResidentStore,
+)
+from karpenter_tpu.solver.jax_backend import JaxSolver
+from karpenter_tpu.solver.greedy import GreedySolver
+from karpenter_tpu.solver.types import SolveRequest, SolverOptions
+
+
+@pytest.fixture(scope="module")
+def catalog():
+    cloud = FakeCloud(region="us-south")
+    pricing = PricingProvider(cloud)
+    cat = CatalogArrays.build(InstanceTypeProvider(cloud, pricing).list())
+    pricing.close()
+    return cat
+
+
+_SIZES = ((250, 512), (500, 1024), (1000, 2048), (2000, 4096))
+
+
+def _pods(rng: random.Random, n: int, prefix: str) -> list[PodSpec]:
+    out = []
+    for i in range(n):
+        cpu, mem = _SIZES[rng.randrange(len(_SIZES))]
+        out.append(PodSpec(f"{prefix}-{i}",
+                           requests=ResourceRequests(cpu, mem, 0, 1),
+                           priority=rng.choice((0, 0, 0, 100))))
+    return out
+
+
+def churn_windows(seed: int, windows: int = 5) -> list[list[PodSpec]]:
+    """A seeded churn sequence: each window differs from the last by a
+    handful of arrivals/departures (the scheduler-loop shape the delta
+    encoder amortizes)."""
+    rng = random.Random(f"resident-churn-{seed}")
+    cur = _pods(rng, 30 + rng.randrange(10), f"s{seed}base")
+    seq = [list(cur)]
+    for w in range(1, windows):
+        drop = rng.randrange(0, 4)
+        for _ in range(min(drop, max(len(cur) - 5, 0))):
+            cur.pop(rng.randrange(len(cur)))
+        cur.extend(_pods(rng, rng.randrange(0, 5), f"s{seed}w{w}"))
+        seq.append(list(cur))
+    return seq
+
+
+def plan_key(plan):
+    """Bit-identity of a Plan for differential comparison."""
+    return (
+        [(n.instance_type, n.zone, n.capacity_type, n.offering_index,
+          round(n.price, 9), tuple(n.pod_names)) for n in plan.nodes],
+        tuple(plan.unplaced_pods),
+        round(plan.total_cost_per_hour, 9),
+    )
+
+
+# ---------------------------------------------------------------------------
+# Differential parity: the acceptance bar
+# ---------------------------------------------------------------------------
+
+class TestDifferentialParity:
+    @pytest.mark.parametrize("seed", range(8))
+    def test_jax_resident_bit_identical_to_full_encode(self, catalog, seed):
+        """Every window of a churn sequence: the resident incremental
+        solve's plan equals the from-scratch full-encode solve's plan
+        bit for bit — and the sequence actually exercised the delta
+        path (not rebuilds all the way down)."""
+        on = JaxSolver(SolverOptions(backend="jax", resident="on"))
+        off = JaxSolver(SolverOptions(backend="jax", resident="off"))
+        for pods in churn_windows(seed):
+            p_on = on.solve(SolveRequest(pods, catalog))
+            p_off = off.solve(SolveRequest(pods, catalog))
+            assert plan_key(p_on) == plan_key(p_off)
+        stats = on.resident.stats()
+        assert stats["windows"] >= 5
+        # warm windows ride deltas: only the cold window (and bucket
+        # crossings, rare at this size) rebuild
+        assert stats["rebuilds"] < stats["windows"]
+
+    @pytest.mark.parametrize("seed", range(8))
+    def test_greedy_tracked_window_matches_fresh_encode(self, catalog,
+                                                        seed):
+        """The greedy leg: plans are backend-identical with the store
+        tracking every window, and after each window the store's mirror
+        AND device tensors equal a fresh from-scratch pack."""
+        tracked = GreedySolver(SolverOptions(backend="greedy"))
+        fresh = GreedySolver(SolverOptions(backend="greedy"))
+        store = ResidentStore()
+        for pods in churn_windows(seed):
+            p_tracked = tracked.solve(SolveRequest(pods, catalog))
+            store.track_window(pods, catalog)
+            p_fresh = fresh.solve(SolveRequest(pods, catalog))
+            assert plan_key(p_tracked) == plan_key(p_fresh)
+            from karpenter_tpu.solver.encode import encode
+
+            want, shape = pack_window(encode(pods, catalog))
+            snap = store.snapshot_state()
+            assert snap["key"] == (catalog.uid,) + shape
+            assert np.array_equal(snap["mirror"], want.reshape(-1))
+            assert np.array_equal(snap["device"].reshape(-1),
+                                  want.reshape(-1))
+
+    def test_pipelined_stream_parity(self, catalog):
+        """solve_stream windows through the resident path decode to the
+        same plans as the non-resident stream (depth > 1: deltas ride
+        the async pipeline)."""
+        from karpenter_tpu.solver.encode import encode
+
+        seq = churn_windows(99, windows=6)
+        problems = [encode(pods, catalog) for pods in seq]
+        on = JaxSolver(SolverOptions(backend="jax", resident="on"))
+        off = JaxSolver(SolverOptions(backend="jax", resident="off"))
+        got = [plan_key(p) for p in on.solve_stream(iter(problems),
+                                                    depth=4, batch=1)]
+        want = [plan_key(p) for p in off.solve_stream(iter(problems),
+                                                      depth=4, batch=1)]
+        assert got == want
+        assert on.resident.stats()["windows"] >= 6
+
+
+# ---------------------------------------------------------------------------
+# Delta-encoder edge cases
+# ---------------------------------------------------------------------------
+
+class TestDeltaEdgeCases:
+    def test_empty_delta_noop_window(self, catalog):
+        store = ResidentStore()
+        pods = _pods(random.Random(1), 20, "noop")
+        first = store.track_window(pods, catalog)
+        again = store.track_window(pods, catalog)
+        assert first.mode == "rebuild" and first.reason == "cold"
+        assert again.mode == "hit" and again.words == 0 \
+            and again.h2d_bytes == 0
+
+    def test_pod_arriving_and_departing_within_one_window(self, catalog):
+        """A pod that arrives AND departs between two tracked windows
+        leaves no trace: the delta is empty (net-zero churn), and the
+        state still equals a fresh rebuild."""
+        store = ResidentStore()
+        base = _pods(random.Random(2), 24, "blip")
+        store.track_window(base, catalog)
+        # transient pod came and went before the next window fired
+        delta = store.track_window(list(base), catalog)
+        assert delta.mode == "hit"
+        assert (delta.arrivals, delta.departures) == (0, 0)
+        # and a pod that lives exactly one window: in, then out
+        transient = base + _pods(random.Random(3), 1, "transient")
+        mid = store.track_window(transient, catalog)
+        out = store.track_window(base, catalog)
+        assert mid.mode == "delta" and mid.arrivals == 1
+        assert out.mode == "delta" and out.departures == 1
+        from karpenter_tpu.solver.encode import encode
+
+        want, _ = pack_window(encode(base, catalog))
+        assert np.array_equal(store.snapshot_state()["mirror"],
+                              want.reshape(-1))
+
+    def test_claim_register_delete_race(self, catalog):
+        """A claim registering consumes its pods out of the window; the
+        claim dying returns them — the store must track both directions
+        as small deltas and stay fresh throughout (the register/delete
+        race of a flapping node)."""
+        store = ResidentStore()
+        rng = random.Random(4)
+        base = _pods(rng, 25, "race")
+        store.track_window(base, catalog)
+        # claim registered: its 6 pods leave the pending window
+        nominated = base[6:]
+        d1 = store.track_window(nominated, catalog)
+        # claim deleted before Ready: the pods are back next window
+        d2 = store.track_window(base, catalog)
+        assert d1.mode == "delta" and d1.departures == 6
+        assert d2.mode == "delta" and d2.arrivals == 6
+        from karpenter_tpu.solver.encode import encode
+
+        want, _ = pack_window(encode(base, catalog))
+        snap = store.snapshot_state()
+        assert np.array_equal(snap["mirror"], want.reshape(-1))
+        assert np.array_equal(snap["device"].reshape(-1),
+                              want.reshape(-1))
+
+    def test_catalog_generation_bump_forces_rebuild(self, catalog):
+        """A catalog/availability generation bump mid-stream must REBUILD
+        the resident state, never delta against tensors encoded under
+        the old generation."""
+        import copy
+
+        cat = copy.copy(catalog)
+        cat.uid = "genbump"
+        cat.availability_generation = 0
+        store = ResidentStore()
+        pods = _pods(random.Random(5), 22, "gen")
+        store.track_window(pods, cat)
+        cat.availability_generation = 1
+        delta = store.track_window(pods, cat)
+        assert delta.mode == "rebuild" and delta.reason == "generation"
+        # solver leg: same catalog bump through the dispatch path
+        on = JaxSolver(SolverOptions(backend="jax", resident="on"))
+        off = JaxSolver(SolverOptions(backend="jax", resident="off"))
+        cat.availability_generation = 2
+        assert plan_key(on.solve(SolveRequest(pods, cat))) == \
+            plan_key(off.solve(SolveRequest(pods, cat)))
+        cat.availability_generation = 3
+        assert plan_key(on.solve(SolveRequest(pods, cat))) == \
+            plan_key(off.solve(SolveRequest(pods, cat)))
+        assert on.resident.stats()["rebuilds"] >= 2
+
+    def test_donation_buffer_reuse_after_degraded_rebuild(self, catalog):
+        """A degraded-mode fallback invalidates the store (the donated
+        device buffer may have been consumed by the failed dispatch);
+        the next window rebuilds cleanly and parity holds."""
+        from karpenter_tpu.solver.degraded import ResilientSolver
+
+        primary = JaxSolver(SolverOptions(backend="jax", resident="on"))
+        solver = ResilientSolver(primary)
+        pods = _pods(random.Random(6), 20, "degraded")
+        ref = JaxSolver(SolverOptions(backend="jax", resident="off"))
+        assert plan_key(solver.solve(SolveRequest(pods, catalog))) == \
+            plan_key(ref.solve(SolveRequest(pods, catalog)))
+        # one backend failure -> degraded greedy plan + store invalidated
+        real_solve = primary.solve
+        calls = {"n": 0}
+
+        def boom(request):
+            calls["n"] += 1
+            raise RuntimeError("injected tunnel fault")
+
+        primary.solve = boom
+        degraded = solver.solve(SolveRequest(pods, catalog))
+        assert degraded.backend.startswith("degraded:")
+        assert primary.resident.stats()["invalidations"] == 1
+        primary.solve = real_solve
+        # recovery: rebuild from host, never touch the old (possibly
+        # donated-and-deleted) device buffer — and parity still holds
+        from karpenter_tpu.utils import metrics
+
+        rebuilds_before = metrics.RESIDENT_REBUILDS.get(
+            "degraded_backend_failure")
+        after = solver.solve(SolveRequest(pods, catalog))
+        assert plan_key(after) == plan_key(
+            ref.solve(SolveRequest(pods, catalog)))
+        stats = primary.resident.stats()
+        assert stats["last_mode"] == "rebuild"
+        # the invalidation's reason rides to the rebuild (counted ONCE,
+        # under its cause — not a generic "cold" plus a phantom rebuild
+        # at invalidation time)
+        assert stats["last_rebuild_reason"] == "degraded_backend_failure"
+        assert metrics.RESIDENT_REBUILDS.get(
+            "degraded_backend_failure") == rebuilds_before + 1
+
+
+# ---------------------------------------------------------------------------
+# H2D bounded by the delta, not the problem size
+# ---------------------------------------------------------------------------
+
+class TestWarmWindowTraffic:
+    def test_warm_h2d_bounded_by_delta_size(self, catalog):
+        """Steady-state warm windows move delta-sized payloads, not the
+        full packed buffer — visible in devtel's h2d accounting and the
+        solve_h2d_bytes histogram the acceptance criteria name."""
+        from karpenter_tpu.resident.delta import DELTA_BUCKETS
+        from karpenter_tpu.utils import metrics
+
+        devtel = get_devtel()
+        solver = JaxSolver(SolverOptions(backend="jax", resident="on"))
+        seq = churn_windows(7, windows=6)
+        solver.solve(SolveRequest(seq[0], catalog))   # cold: full upload
+        full_bytes = None
+        for pods in seq[1:]:
+            from karpenter_tpu.solver.encode import encode
+
+            packed, _ = pack_window(encode(pods, catalog))
+            full_bytes = int(packed.nbytes)
+            before = devtel.snapshot()
+            h2d_hist_before = metrics.SOLVE_H2D_BYTES.sum("jax")
+            solver.solve(SolveRequest(pods, catalog))
+            after = devtel.snapshot()
+            window_h2d = after["h2d_bytes"] - before["h2d_bytes"]
+            hist_delta = metrics.SOLVE_H2D_BYTES.sum("jax") \
+                - h2d_hist_before
+            assert after["resident"]["windows"] > \
+                before["resident"]["windows"]
+            # the padded delta pair bounds the window's H2D: at this
+            # churn (<5 changed groups -> <64 words) the smallest two
+            # rungs cover it, strictly below a full re-upload
+            bound = 2 * DELTA_BUCKETS[1] * 4
+            assert 0 <= window_h2d <= bound
+            assert window_h2d < full_bytes
+            assert hist_delta <= bound
+
+
+# ---------------------------------------------------------------------------
+# Store invalidation wiring
+# ---------------------------------------------------------------------------
+
+class TestInvalidationWiring:
+    def test_nodepool_edit_invalidates_through_provisioner(self, catalog):
+        from karpenter_tpu.catalog.unavailable import UnavailableOfferings
+        from karpenter_tpu.core.provisioner import (
+            Provisioner, ProvisionerOptions,
+        )
+
+        cloud = FakeCloud(region="us-south")
+        pricing = PricingProvider(cloud)
+        itp = InstanceTypeProvider(cloud, pricing,
+                                   UnavailableOfferings())
+        cluster = ClusterState()
+        cluster.add_nodeclass(NodeClass(name="default", spec=NodeClassSpec(
+            region="us-south", image="img-1", vpc="vpc-1",
+            instance_profile="bx2-4x16")))
+        prov = Provisioner(
+            cluster, itp, actuator=None,
+            options=ProvisionerOptions(
+                solver=SolverOptions(backend="jax", resident="on")))
+        store = getattr(prov.solver, "resident", None)
+        assert store is not None   # ResilientSolver delegates to primary
+        try:
+            prov.start()
+            # seed a resident state, THEN edit the pool: the watch must
+            # invalidate, and the next window's rebuild must carry the
+            # pool-edit reason instead of a generic "cold"
+            cat = prov._catalog_for(cluster.get_nodeclass("default"))
+            pods = _pods(random.Random(11), 10, "pooledit")
+            prov.solver.solve(SolveRequest(pods, cat))
+            cluster.add_nodepool(NodePool(name="edited",
+                                          nodeclass_name="default"))
+            assert store.invalidations >= 1
+            prov.solver.solve(SolveRequest(pods, cat))
+            assert store.stats()["last_rebuild_reason"] == "nodepool_edit"
+        finally:
+            prov.stop()
+            pricing.close()
+
+
+# ---------------------------------------------------------------------------
+# Donated update kernel
+# ---------------------------------------------------------------------------
+
+class TestUpdateKernel:
+    def test_update_donates_and_drops_padding(self):
+        import jax
+
+        from karpenter_tpu.resident.kernels import update_resident
+
+        state = jax.device_put(np.arange(16, dtype=np.int32))
+        didx = np.array([3, 7, 16, 16], dtype=np.int32)   # 16 = padding
+        dval = np.array([100, 200, 999, 999], dtype=np.int32)
+        out = np.asarray(update_resident(state, didx, dval))
+        want = np.arange(16, dtype=np.int32)
+        want[3], want[7] = 100, 200
+        assert np.array_equal(out, want)
+        # the old buffer was donated: consumed on CPU/TPU alike
+        assert state.is_deleted()
+
+    def test_resident_buffer_roundtrip_modes(self):
+        buf = ResidentBuffer(name="t")
+        a = np.arange(32, dtype=np.int32)
+        dev, d0 = buf.update(a, generation=(1,))
+        assert d0.mode == "rebuild" and d0.reason == "cold"
+        dev, d1 = buf.update(a, generation=(1,))
+        assert d1.mode == "hit"
+        b = a.copy()
+        b[5] = -1
+        dev, d2 = buf.update(b, generation=(1,))
+        assert d2.mode == "delta" and d2.words == 1
+        assert np.array_equal(np.asarray(dev), b)
+        dev, d3 = buf.update(b, generation=(2,))
+        assert d3.mode == "rebuild" and d3.reason == "generation"
+
+
+# ---------------------------------------------------------------------------
+# AOT executable cache
+# ---------------------------------------------------------------------------
+
+class TestAOTCache:
+    def test_manifest_records_and_prewarms(self, catalog, tmp_path):
+        from karpenter_tpu.resident.aot import AOTExecutableCache
+
+        devtel = get_devtel()
+        cache = AOTExecutableCache(str(tmp_path))
+        # earlier tests already dispatched these shapes process-wide;
+        # the sink only sees NEW signatures, so start it from zero
+        devtel._signatures.clear()
+        devtel.signature_sink = cache.record
+        try:
+            solver = JaxSolver(SolverOptions(backend="jax",
+                                             resident="on"))
+            pods = _pods(random.Random(8), 18, "aot")
+            solver.solve(SolveRequest(pods, catalog))
+        finally:
+            devtel.signature_sink = None
+        kernels = {k for k, _ in cache.entries()}
+        assert "resident" in kernels
+        # a "restarted" process: fresh cache object loads the manifest
+        # and replays it through the real entry points
+        reloaded = AOTExecutableCache(str(tmp_path))
+        assert set(reloaded.entries()) == set(cache.entries())
+        solver2 = JaxSolver(SolverOptions(backend="jax", resident="off"))
+        out = reloaded.prewarm(solver2, catalog)
+        assert out["warmed"] >= 1
+
+    def test_corrupt_manifest_is_cold_start(self, tmp_path):
+        from karpenter_tpu.resident.aot import AOTExecutableCache
+
+        (tmp_path / "aot_manifest.json").write_text("{not json")
+        cache = AOTExecutableCache(str(tmp_path))
+        assert cache.entries() == []
+
+
+# ---------------------------------------------------------------------------
+# Fleet resident buffer
+# ---------------------------------------------------------------------------
+
+class TestFleetResident:
+    def test_fleet_resident_buffer_matches_and_hits(self):
+        from karpenter_tpu.cloud.fake import generate_profiles
+        from karpenter_tpu.parallel.fleet import (
+            FleetProblem, fleet_solve_pallas,
+        )
+        from karpenter_tpu.solver.encode import encode
+        from karpenter_tpu.solver.jax_backend import _pad1, _pad2
+        from karpenter_tpu.solver.types import (
+            GROUP_BUCKETS, OFFERING_BUCKETS, bucket,
+        )
+
+        per = []
+        for c in range(2):
+            cloud = FakeCloud(profiles=generate_profiles(6))
+            pricing = PricingProvider(cloud)
+            cat = CatalogArrays.build(
+                InstanceTypeProvider(cloud, pricing).list())
+            pricing.close()
+            pods = _pods(random.Random(50 + c), 40, f"fleet{c}")
+            prob = encode(pods, cat)
+            G = bucket(prob.num_groups, GROUP_BUCKETS)
+            O = bucket(cat.num_offerings, OFFERING_BUCKETS)
+            per.append((
+                _pad2(prob.group_req, G), _pad1(prob.group_count, G),
+                _pad1(prob.group_cap, G), _pad2(prob.compat, G, O),
+                _pad2(cat.offering_alloc().astype(np.int32), O),
+                _pad1(cat.off_price.astype(np.float32), O),
+                _pad1(cat.offering_rank_price(), O)))
+        stacked = FleetProblem(*[np.stack([p[i] for p in per])
+                                 for i in range(7)])
+        buf = ResidentBuffer(name="fleet")
+        want = fleet_solve_pallas(stacked, num_nodes=128, interpret=True)
+        got = fleet_solve_pallas(stacked, num_nodes=128, interpret=True,
+                                 resident_buf=buf)
+        for w, g in zip(want, got):
+            assert np.array_equal(np.asarray(w), np.asarray(g))
+        assert buf.stats["rebuild"] == 1
+        again = fleet_solve_pallas(stacked, num_nodes=128, interpret=True,
+                                   resident_buf=buf)
+        for w, g in zip(want, again):
+            assert np.array_equal(np.asarray(w), np.asarray(g))
+        assert buf.stats["hit"] == 1
+
+
+# ---------------------------------------------------------------------------
+# Occupancy snapshot: the repack satellite's parity pin
+# ---------------------------------------------------------------------------
+
+def _consolidation_rig(resident_occupancy: bool):
+    from karpenter_tpu.core.cloudprovider import CloudProvider
+    from karpenter_tpu.controllers.disruption import DisruptionController
+
+    cloud = FakeCloud()
+    pricing = PricingProvider(cloud)
+    itp = InstanceTypeProvider(cloud, pricing)
+    cluster = ClusterState()
+    cluster.add_nodeclass(NodeClass(name="default", spec=NodeClassSpec(
+        region="us-south", image="img-1", vpc="vpc-1",
+        instance_profile="bx2-4x16")))
+    cluster.add_nodepool(NodePool(
+        name="default", nodeclass_name="default",
+        consolidation_policy="WhenEmptyOrUnderutilized",
+        consolidate_after_seconds=30))
+    cp = CloudProvider(cluster, actuator=None, instance_types=itp)
+
+    class Clock:
+        t = 1000.0
+
+        def __call__(self):
+            return self.t
+
+    clock = Clock()
+    ctrl = DisruptionController(cluster, cp, clock=clock,
+                                resident_occupancy=resident_occupancy)
+    # a mix: one nearly-empty cheap node whose pods fit elsewhere, one
+    # loaded node, one empty node, anti-affinity pods in the mix
+    for name, itype, price, age in (
+            ("big", "bx2-8x32", 0.5, 400.0), ("cheap", "bx2-4x16", 0.1, 400.0),
+            ("empty", "bx2-4x16", 0.1, 400.0)):
+        c = NodeClaim(name=name, nodeclass_name="default",
+                      nodepool_name="default", instance_type=itype,
+                      zone="us-south-1", node_name=f"node-{name}",
+                      hourly_price=price, launched=True, registered=True,
+                      initialized=True)
+        c.created_at = clock.t - age
+        cluster.add_nodeclaim(c)
+
+    def bind(name, node, cpu=500, mem=1024, labels=(), affinity=()):
+        spec = PodSpec(name, requests=ResourceRequests(cpu, mem, 0, 1),
+                       labels=tuple(labels), affinity=tuple(affinity))
+        cluster.add_pod(spec)
+        cluster.bind_pod(f"default/{name}", node)
+
+    bind("a1", "node-big", 1000, 2048)
+    bind("a2", "node-big", 1000, 2048)
+    bind("c1", "node-cheap", 500, 1024)
+    bind("c2", "node-cheap", 250, 512)
+    pricing.close()
+    return cluster, ctrl, clock
+
+
+class TestOccupancySnapshotParity:
+    def test_repack_tick_results_unchanged_vs_host_rebuild(self):
+        """The pinned satellite test: a consolidation tick through the
+        shared per-tick snapshot produces EXACTLY the same cluster
+        mutations as the per-claim host-rescan path."""
+        outcomes = []
+        for flag in (False, True):
+            cluster, ctrl, clock = _consolidation_rig(flag)
+            for _ in range(3):
+                ctrl.reconcile()
+                clock.t += 31.0
+            outcomes.append((
+                {c.name: c.deleted for c in cluster.nodeclaims()},
+                {k: (p.bound_node, p.nominated_node)
+                 for k, p in ((k, cluster.get("pods", k)) for k in (
+                     "default/a1", "default/a2", "default/c1",
+                     "default/c2")) if p is not None},
+            ))
+        assert outcomes[0] == outcomes[1]
+
+    def test_occupancy_tensors_resident_and_delta_encoded(self):
+        """The claim/occupancy tensors ride the same donated delta path:
+        device rows equal a host rebuild from ground truth, claim churn
+        is a small delta, and a catalog bump rebuilds."""
+        cluster, _, _ = _consolidation_rig(False)
+        store = ResidentStore()
+        # arrays built from the rig's cloud so find_offering resolves
+        # the claims' instance types
+        cloud = FakeCloud()
+        pricing = PricingProvider(cloud)
+        cat = CatalogArrays.build(InstanceTypeProvider(cloud,
+                                                       pricing).list())
+        pricing.close()
+        names, dev, d0 = store.occupancy_tensors(cluster, cat)
+        assert d0.mode == "rebuild" and set(names) == {"big", "cheap",
+                                                       "empty"}
+        host = np.asarray(dev)
+        # ground truth: preempt/encode's victim tensors agree on resid
+        from karpenter_tpu.preempt.encode import encode_victims
+
+        vs = encode_victims(cluster, cat)
+        for i, name in enumerate(names):
+            j = vs.claim_names.index(name)
+            assert host[i, 0] == vs.node_off[j]
+            assert np.array_equal(host[i, 2:].astype(np.int64),
+                                  vs.resid[j])
+        # claim churn: one claim dies -> one row delta, not a rebuild
+        dead = cluster.get_nodeclaim("empty")
+        dead.deleted = True
+        cluster.update("nodeclaims", "empty", dead)
+        names2, dev2, d1 = store.occupancy_tensors(cluster, cat)
+        assert "empty" not in names2 and d1.mode == "delta"
+        # catalog generation bump -> clean rebuild
+        cat.availability_generation = ("bumped",)
+        _, _, d2 = store.occupancy_tensors(cluster, cat)
+        assert d2.mode == "rebuild" and d2.reason == "generation"
+
+    def test_snapshot_matches_rescan_under_mutation(self):
+        """Claim register/delete races: the snapshot stays equal to a
+        fresh rescan through rebinds and evictions (the in-pass
+        mutations the consolidation loop performs)."""
+        from karpenter_tpu.apis.pod import pod_key
+
+        cluster, ctrl, _ = _consolidation_rig(True)
+
+        def rescan(node):
+            return [pod_key(p.spec) for p in cluster.list("pods")
+                    if p.bound_node == node or p.nominated_node == node]
+
+        snap = OccupancySnapshot(cluster)
+        for node in ("node-big", "node-cheap", "node-empty", "nope"):
+            assert snap.pods_on(node) == rescan(node)
+        # a move: c1 rebinds onto node-big
+        cluster.bind_pod("default/c1", "node-big")
+        p = cluster.get("pods", "default/c1")
+        snap.rebind("default/c1", "node-big", p.nominated_node)
+        for node in ("node-big", "node-cheap"):
+            assert snap.pods_on(node) == rescan(node)
+        # an eviction: a1 unbinds entirely
+        p = cluster.get("pods", "default/a1")
+        p.bound_node = ""
+        p.nominated_node = ""
+        snap.unbind("default/a1")
+        for node in ("node-big", "node-cheap"):
+            assert snap.pods_on(node) == rescan(node)
+
+
+# ---------------------------------------------------------------------------
+# The chaos invariant actually fires on a broken store
+# ---------------------------------------------------------------------------
+
+class TestInvariantFires:
+    def _checker(self, store, pods, catalog):
+        from karpenter_tpu.chaos.invariants import InvariantChecker
+        from karpenter_tpu.chaos.runner import ResidentProbe
+
+        return InvariantChecker(
+            None, None, None, orphan_grace=0.0, stuck_claim_grace=0.0,
+            resident=ResidentProbe(store=store,
+                                   window_pods=lambda: pods,
+                                   catalog=lambda: catalog))
+
+    def test_clean_store_passes_and_corrupt_store_fails(self, catalog):
+        pods = _pods(random.Random(9), 16, "inv")
+        store = ResidentStore()
+        store.track_window(pods, catalog)
+        checker = self._checker(store, pods, catalog)
+        assert checker._resident_state_fresh() == []
+        # corrupt one mirror word: a mis-applied delta must be CAUGHT
+        snap_key = store.last_key
+        store._states[snap_key].buf.mirror[0] ^= 1
+        bad = checker._resident_state_fresh()
+        assert bad and any("mirror diverged" in v.detail for v in bad)
+
+    def test_stale_generation_fails(self, catalog):
+        import copy
+
+        cat = copy.copy(catalog)
+        cat.uid = "invgen"
+        cat.availability_generation = 0
+        pods = _pods(random.Random(10), 16, "invg")
+        store = ResidentStore()
+        store.track_window(pods, cat)
+        cat.availability_generation = 1   # catalog moved; store did not
+        checker = self._checker(store, pods, cat)
+        bad = checker._resident_state_fresh()
+        assert bad and any("generation" in v.detail for v in bad)

@@ -24,6 +24,8 @@ FAMILY_A_SCOPE = (
     "karpenter_tpu/preempt/**/*",
     "karpenter_tpu/gang/*",
     "karpenter_tpu/gang/**/*",
+    "karpenter_tpu/resident/*",
+    "karpenter_tpu/resident/**/*",
     "karpenter_tpu/native.py",
     "bench.py",
 )
@@ -340,17 +342,21 @@ class MissingDonation(_FamilyARule):
     id = "GL006"
     name = "missing-donation"
     description = (
-        "jit-wrapped solve entry point without donate_argnums/"
-        "donate_argnames: the per-solve input buffer (multi-MB at the 10k-"
-        "pod regime) is kept alive across the call, doubling device-memory "
-        "footprint and blocking XLA's input/output aliasing. Donate the "
-        "transient problem buffer (never the resident catalog tensors)."
+        "jit-wrapped solve entry point (or resident-state update kernel) "
+        "without donate_argnums/donate_argnames: the per-solve input "
+        "buffer (multi-MB at the 10k-pod regime) is kept alive across "
+        "the call, doubling device-memory footprint and blocking XLA's "
+        "input/output aliasing. Donate the transient problem buffer and "
+        "the old resident-state buffer (never the resident catalog "
+        "tensors)."
     )
 
     # jit entry points considered "solve entry points": the public
-    # dispatch surface of the solver (name-based contract, see
-    # docs/development.md)
-    _ENTRY_PREFIXES = ("solve_", "solve")
+    # dispatch surface of the solver plus the resident-state update
+    # kernels (name-based contract, see docs/development.md) — a
+    # non-donated state update would keep BOTH generations of the
+    # resident buffer alive on device
+    _ENTRY_PREFIXES = ("solve_", "solve", "update_", "apply_")
 
     def check(self, module: SourceModule) -> Iterator[Finding]:
         analysis = jaxctx.analyze(module)

@@ -120,7 +120,8 @@ class TelemetryInKernel(Rule):
     )
     family = "B"
     scope = ("karpenter_tpu/solver/*", "karpenter_tpu/parallel/*",
-             "karpenter_tpu/preempt/*", "karpenter_tpu/gang/*")
+             "karpenter_tpu/preempt/*", "karpenter_tpu/gang/*",
+             "karpenter_tpu/resident/*")
 
     def check(self, module: SourceModule) -> Iterator[Finding]:
         analysis = analyze(module)

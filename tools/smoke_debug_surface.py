@@ -192,6 +192,27 @@ def main() -> int:
         check(0.0 <= snap["executable_cache_hit_ratio"] <= 1.0,
               "executable-cache hit ratio well-formed")
 
+        # demo resident cycle: two churned windows through a resident-
+        # enabled JaxSolver — window 1 rebuilds (cold), window 2 rides
+        # the delta path; the store state must then surface on /metrics,
+        # /statusz and /debug/slo (docs/design/resident.md)
+        print("demo resident cycle (delta-encoded incremental solve)")
+        res_solver = JaxSolver(SolverOptions(backend="jax",
+                                             resident="on"))
+        res_pods = make_pods(6, name_prefix="res",
+                             requests=ResourceRequests(500, 1024, 0, 1))
+        res_solver.solve(SolveRequest(res_pods, catalog))
+        churned = res_pods + make_pods(
+            2, name_prefix="res-arrival",
+            requests=ResourceRequests(250, 512, 0, 1))
+        res_solver.solve(SolveRequest(churned, catalog))
+        rstats = res_solver.resident.stats()
+        check(rstats["windows"] == 2 and rstats["rebuilds"] == 1,
+              f"resident demo: cold rebuild + one warm window ({rstats})")
+        check(rstats["last_mode"] == "delta"
+              and 0 < rstats["last_delta_words"] < 64,
+              f"warm window rode the delta path ({rstats})")
+
         print("GET /metrics")
         status, ctype, body = _get(port, "/metrics")
         check(status == 200, f"/metrics status 200 (got {status})")
@@ -238,6 +259,14 @@ def main() -> int:
               in text, "executable-cache hit events counted")
         check("karpenter_tpu_donation_misses_total{" in text,
               "donation miss counter carries live samples")
+        check('karpenter_tpu_resident_windows_total{mode="rebuild"} 1'
+              in text and
+              'karpenter_tpu_resident_windows_total{mode="delta"} 1'
+              in text, "resident window counter saw the demo cycle")
+        check('karpenter_tpu_resident_rebuilds_total{reason="cold"}'
+              in text, "resident rebuild reason counted")
+        check("karpenter_tpu_resident_delta_bytes" in text,
+              "resident delta-bytes histogram rendered")
 
         print("GET /debug/slo")
         status, ctype, body = _get(port, "/debug/slo")
@@ -262,6 +291,12 @@ def main() -> int:
               and dt.get("h2d_bytes", 0) > 0
               and "executable_cache_hit_ratio" in dt,
               "/debug/slo device telemetry reflects the live solve path")
+        res = dt.get("resident") or {}
+        check(res.get("windows", 0) >= 2 and res.get("deltas", 0) >= 1
+              and res.get("resident_bytes", 0) > 0
+              and res.get("last_rebuild_reason") == "cold"
+              and res.get("generation"),
+              f"/debug/slo exposes resident-store state ({res})")
 
         print("GET /statusz")
         status, ctype, body = _get(port, "/statusz")
@@ -275,6 +310,11 @@ def main() -> int:
                     "recorder", "circuit_breakers", "ledger",
                     "device_telemetry", "pending_staleness_s"):
             check(key in doc, f"/statusz has {key!r}")
+        sres = (doc.get("device_telemetry") or {}).get("resident") or {}
+        check(sres.get("windows", 0) >= 2
+              and "last_delta_words" in sres
+              and "last_rebuild_reason" in sres,
+              f"/statusz exposes resident-store state ({sres})")
 
         print("GET /debug/traces")
         status, ctype, body = _get(

@@ -1,0 +1,130 @@
+"""CI gate: the AOT executable cache must cut warm-restart time.
+
+Runs the same boot sequence twice in FRESH processes sharing one cache
+directory (resident/aot.py: JAX's persistent compile cache + the
+signature manifest):
+
+1. **cold** — empty cache: real solves compile their executables from
+   scratch and record their static-shape signatures into the manifest;
+2. **warm** — a "restarted operator": the manifest is replayed through
+   the real jit entry points, every compile served from the disk cache.
+
+Fails when the warm restart recompiled anything (new XLA cache entries
+appeared — the manifest/disk-cache keying broke) or when
+``warmup_restart_s`` did not drop vs the cold run.
+
+Run locally: ``JAX_PLATFORMS=cpu python tools/warm_restart_check.py``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+
+def _child(cache_dir: str) -> int:
+    import random
+
+    from karpenter_tpu.apis.pod import PodSpec, ResourceRequests
+    from karpenter_tpu.catalog import InstanceTypeProvider, PricingProvider
+    from karpenter_tpu.catalog.arrays import CatalogArrays
+    from karpenter_tpu.cloud.fake import FakeCloud
+    from karpenter_tpu.resident.aot import AOTExecutableCache
+    from karpenter_tpu.solver.jax_backend import JaxSolver
+    from karpenter_tpu.solver.types import SolveRequest, SolverOptions
+
+    cloud = FakeCloud(region="us-south")
+    pricing = PricingProvider(cloud)
+    catalog = CatalogArrays.build(InstanceTypeProvider(cloud, pricing).list())
+    pricing.close()
+    cache = AOTExecutableCache(cache_dir)
+    warm = bool(cache.entries())
+    cache.enable()
+    solver = JaxSolver(SolverOptions(backend="jax", resident="on"))
+    t0 = time.perf_counter()
+    if warm:
+        out = cache.prewarm(solver, catalog)
+        detail = out
+    else:
+        # the representative boot workload: two window scales through
+        # BOTH solve paths (resident fused kernel + classic scan),
+        # recording each executable's signature into the manifest
+        classic = JaxSolver(SolverOptions(backend="jax", resident="off"))
+        rng = random.Random("warm-restart")
+        sizes = ((250, 512), (500, 1024), (1000, 2048), (2000, 4096))
+        for n in (40, 900):
+            pods = [PodSpec(f"c{n}p{i}",
+                            requests=ResourceRequests(*sizes[rng.randrange(4)],
+                                                      0, 1))
+                    for i in range(n)]
+            solver.solve(SolveRequest(pods, catalog))
+            classic.solve(SolveRequest(pods, catalog))
+        detail = {"entries": len(cache.entries())}
+    elapsed = time.perf_counter() - t0
+    print(json.dumps({"mode": "warm" if warm else "cold",
+                      "warmup_restart_s": round(elapsed, 3),
+                      "detail": detail}))
+    return 0
+
+
+def main() -> int:
+    if len(sys.argv) >= 3 and sys.argv[1] == "--child":
+        return _child(sys.argv[2])
+
+    with tempfile.TemporaryDirectory(prefix="ktpu-aot-") as d:
+        def run():
+            proc = subprocess.run(
+                [sys.executable, os.path.abspath(__file__), "--child", d],
+                capture_output=True, text=True, timeout=600,
+                env={**os.environ, "JAX_PLATFORMS": "cpu"})
+            if proc.returncode != 0:
+                print(proc.stdout)
+                print(proc.stderr)
+                raise RuntimeError(f"child failed rc={proc.returncode}")
+            return json.loads(proc.stdout.strip().splitlines()[-1])
+
+        def xla_entries():
+            return {f for f in os.listdir(d) if f.endswith("-cache")}
+
+        cold = run()
+        cold_files = xla_entries()
+        warm = run()
+        new_files = xla_entries() - cold_files
+        print(f"cold boot:  {cold['warmup_restart_s']:.3f}s "
+              f"({len(cold_files)} executables compiled, "
+              f"{cold['detail'].get('entries', '?')} manifest entries)")
+        print(f"warm boot:  {warm['warmup_restart_s']:.3f}s "
+              f"(prewarm: {warm['detail']})")
+        failures = []
+        if warm.get("mode") != "warm":
+            failures.append("second run did not find the AOT manifest")
+        if new_files:
+            failures.append(
+                f"warm restart recompiled {len(new_files)} executables "
+                f"(cache keying broke): {sorted(new_files)[:3]}")
+        if not cold_files:
+            failures.append("cold run wrote no XLA cache entries")
+        if warm["warmup_restart_s"] >= cold["warmup_restart_s"]:
+            failures.append(
+                f"AOT cache did not cut warmup_restart_s "
+                f"({warm['warmup_restart_s']:.3f}s warm vs "
+                f"{cold['warmup_restart_s']:.3f}s cold)")
+        for f in failures:
+            print(f"FAIL {f}")
+        if not failures:
+            cut = 1 - warm["warmup_restart_s"] / cold["warmup_restart_s"]
+            print(f"warm-restart check ok: AOT cache cut "
+                  f"warmup_restart_s by {cut:.0%}")
+        return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
