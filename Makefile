@@ -82,6 +82,10 @@ bench: ## Full benchmark (one JSON line; runs on the ambient JAX backend)
 bench-quick: ## Small-config CPU benchmark sanity
 	JAX_PLATFORMS=cpu $(PY) bench.py --quick
 
+.PHONY: bench-compare
+bench-compare: ## Diff the newest BENCH_r*.json against the previous round, flag >20% regressions (informational)
+	$(PY) tools/bench_compare.py
+
 .PHONY: e2e
 e2e: ## E2E tests against a real cluster (env-gated; see tests/e2e/suite.py)
 	@if [ -z "$$RUN_E2E_TESTS" ]; then \

@@ -561,6 +561,17 @@ def run(num_pods: int, num_types: int, iters: int, platform: str) -> dict:
     }
 
 
+def fleet_pipelined_value(pipe_s: float, pipe_skip: str):
+    """The ONE place the fleet_pipelined_ms JSON value is produced: a
+    measured ms float, or an explicit "skipped: <reason>" string — NEVER
+    null (BENCH_r05's null was ambiguous between "not run" and "broken
+    pipeline"; trajectory tooling and the target gate both type-switch
+    on this value, pinned in tests/test_bench_compare.py)."""
+    if pipe_s:
+        return round(pipe_s * 1000, 3)
+    return pipe_skip or "skipped: pipelined stream not run"
+
+
 def run_fleet(num_clusters: int, num_pods: int, num_types: int,
               iters: int) -> dict:
     """BASELINE config #5: C cluster problems solved jointly on the chip
@@ -749,9 +760,7 @@ def run_fleet(num_clusters: int, num_pods: int, num_types: int,
         # Never null: a skipped run says WHY (cpu fallback, non-viable
         # pallas shape) so a missing number reads as "not run", not
         # "broken pipeline"
-        "fleet_pipelined_ms": round(pipe_s * 1000, 3) if pipe_s
-                              else (pipe_skip or
-                                    "skipped: pipelined stream not run"),
+        "fleet_pipelined_ms": fleet_pipelined_value(pipe_s, pipe_skip),
         "fleet_vs_baseline": round(vs_naive, 2),
         "fleet_vs_baseline_pipelined": round(naive_p50 / pipe_s, 2)
                                        if pipe_s and naive_p50 and cost_ok
@@ -1259,6 +1268,84 @@ def run_resident(num_pods: int, num_types: int, windows: int = 10) -> dict:
     }}
 
 
+def run_explain(num_pods: int = 1200, num_types: int = 60,
+                iters: int = 6) -> dict:
+    """ISSUE 9: warm-path overhead and parity of the explain plane
+    (karpenter_tpu/explain).  A scarcity workload guarantees unplaced
+    pods of several reason classes (insufficient-*, requirements via an
+    impossible selector, capacity via a clamped node budget under mixed
+    priorities); the gate asserts zero ADDITIONAL dispatches per solve
+    (the reason words ride the existing one), explain D2H bytes < 5% of
+    solve D2H, and device words bit-identical to the host oracle."""
+    from karpenter_tpu.apis.pod import PodSpec, ResourceRequests
+    from karpenter_tpu.apis.requirements import LABEL_INSTANCE_TYPE
+    from karpenter_tpu.obs.devtel import get_devtel
+    from karpenter_tpu.solver import (
+        GreedySolver, JaxSolver, SolveRequest, encode,
+    )
+    from karpenter_tpu.solver.types import SolverOptions
+
+    catalog = build_catalog(num_types)
+    rng = np.random.RandomState(9)
+    pods = []
+    for i in range(num_pods):
+        hi = i % 2 == 0
+        pods.append(PodSpec(
+            f"ex{i}", requests=ResourceRequests(
+                int(2000 + 500 * rng.randint(4)), 8192, 0, 1),
+            priority=100 if hi else 0))
+    pods.append(PodSpec("ex-huge", requests=ResourceRequests(
+        50_000_000, 900_000_000, 0, 1)))
+    pods.append(PodSpec("ex-nolabel", requests=ResourceRequests(
+        500, 1024, 0, 1),
+        node_selector=((LABEL_INSTANCE_TYPE, "no-such-type"),)))
+    # a clamped node budget strands the low-priority tail: the capacity
+    # its compat admits is consumed by the high-priority half
+    opts = SolverOptions(backend="jax", max_nodes=64, adaptive_nodes=False)
+    solver = JaxSolver(opts)
+    req = SolveRequest(pods, catalog)
+    plan = solver.solve(req)          # warmup / compile
+    devtel = get_devtel()
+    before = devtel.snapshot()
+    walls = []
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        plan = solver.solve(req)
+        walls.append(time.perf_counter() - t0)
+    after = devtel.snapshot()
+    solves_dispatches = after["dispatches"] - before["dispatches"]
+    d2h = after["d2h_bytes"] - before["d2h_bytes"]
+    explain_d2h = after["explain_d2h_bytes"] - before["explain_d2h_bytes"]
+    gplan = GreedySolver(SolverOptions(
+        backend="greedy", use_native="off", max_nodes=64,
+        adaptive_nodes=False)).solve(req)
+    parity = plan.unplaced_words == gplan.unplaced_words \
+        and plan.unplaced_reasons == gplan.unplaced_reasons
+    hist: dict[str, int] = {}
+    for r in plan.unplaced_reasons.values():
+        hist[r] = hist.get(r, 0) + 1
+    # direct oracle cross-check on the encoded problem (belt/braces on
+    # top of the plan-level dict comparison)
+    from karpenter_tpu.explain.validate import check_plan_reasons
+
+    problem = encode(pods, catalog)
+    violations = check_plan_reasons(problem, plan)
+    return {"explain": {
+        "unplaced": len(plan.unplaced_pods),
+        "reasons": dict(sorted(hist.items())),
+        "parity": bool(parity),
+        "consistency_violations": len(violations),
+        # the reason words ride the solve's own dispatch: any value
+        # above one dispatch per solve means explain grew the launch
+        # count (COO-growth/escalation retries would too, but the warm
+        # loop re-solves an unchanged window)
+        "extra_dispatches": max(0, solves_dispatches - iters),
+        "d2h_fraction": round(explain_d2h / d2h, 5) if d2h else 0.0,
+        "explain_d2h_bytes_per_solve": explain_d2h // max(iters, 1),
+        "solve_warm_p50_ms": round(p50(walls) * 1000, 3),
+    }}
+
+
 def run_cold_start(timeout_s: float = 560.0,
                    platform: str = "") -> dict:
     """BASELINE cold-start probe (VERDICT round 4 weak #4): the first
@@ -1420,6 +1507,12 @@ def main():
             result.update(run_fleet(fleet, pods, types, max(3, iters // 4)))
         except Exception as e:  # noqa: BLE001 — never lose the main result
             result["fleet_error"] = str(e)[:200]
+            # the skip-string contract holds on EVERY path: a fleet
+            # section that died mid-run must not leave a null behind
+            result.setdefault("fleet_pipelined_ms",
+                              fleet_pipelined_value(0.0,
+                                                    "skipped: fleet "
+                                                    "section errored"))
     try:
         # heterogeneous regime: thousands of signature groups (the shape
         # that actually stresses the solve; the headline mix collapses to
@@ -1467,11 +1560,28 @@ def main():
         result["resident_error"] = str(e)[:200]
 
 
+    try:
+        # ISSUE 9: explain-plane overhead + parity (reason words ride
+        # the existing dispatch; device vs host-oracle bit-identity)
+        result.update(run_explain(
+            num_pods=400 if args.quick else 1200,
+            num_types=30 if args.quick else 60,
+            iters=3 if args.quick else 6))
+    except Exception as e:  # noqa: BLE001
+        result["explain_error"] = str(e)[:200]
+
+    result["target_met"] = compute_target_met(result)
+    print(json.dumps(result))
+
+
+def compute_target_met(result: dict) -> dict:
     # BASELINE.md targets, asserted explicitly: a regression to target
     # must be visible here without reading the raw numbers (VERDICT
     # round 3 item 3).  Sections that did not run report null, never a
-    # phantom false.
-    result["target_met"] = {
+    # phantom false — and every INPUT this function reads must be
+    # non-null when its section ran (skip paths emit "skipped: <reason>"
+    # strings; pinned in tests/test_bench_compare.py).
+    return {
         "headline_under_50ms": result.get("value", 1e9) < 50.0,
         "speedup_20x": result.get("vs_baseline", 0.0) >= 20.0,
         "speedup_20x_on_chip": result.get("vs_baseline_compute",
@@ -1542,8 +1652,18 @@ def main():
              and 0 <= result["resident"]["warm_h2d_max_bytes"]
              < result["resident"]["full_packed_bytes"])
             if "resident" in result else None,
+        # ISSUE 9 acceptance: explain reason words ride the existing
+        # dispatch (zero extra launches), cost <5% of solve D2H, and
+        # the device words are bit-identical to the host oracle with
+        # zero ground-truth consistency violations
+        "explain_overhead_bounded":
+            (result["explain"]["parity"] is True
+             and result["explain"]["extra_dispatches"] == 0
+             and result["explain"]["consistency_violations"] == 0
+             and result["explain"]["unplaced"] > 0
+             and 0.0 <= result["explain"]["d2h_fraction"] < 0.05)
+            if "explain" in result else None,
     }
-    print(json.dumps(result))
 
 
 if __name__ == "__main__":

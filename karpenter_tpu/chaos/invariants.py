@@ -70,7 +70,8 @@ class InvariantChecker:
                  orphan_grace: float, stuck_claim_grace: float,
                  solver_violations: list[str] | None = None,
                  trace: EventTrace | None = None, preemption=None,
-                 gang=None, resident=None):
+                 gang=None, resident=None,
+                 explain_violations: list[str] | None = None):
         self.cluster = cluster
         self.cloud = cloud              # ground truth: the UNWRAPPED fake
         self.unavailable = unavailable
@@ -79,6 +80,12 @@ class InvariantChecker:
         # shared with the harness's ValidatingSolver; drained per check
         self.solver_violations = solver_violations \
             if solver_violations is not None else []
+        # explain-consistency contradictions (karpenter_tpu/explain):
+        # every unplaced pod's reason is re-derived from the request and
+        # checked against ground truth — a pod blamed on availability
+        # while a feasible offering sits open is a violation
+        self.explain_violations = explain_violations \
+            if explain_violations is not None else []
         self.trace = trace
         # the harness's PreemptionController (or None): its eviction_log
         # / preempted_keys are the preemption invariants' ground truth
@@ -99,6 +106,7 @@ class InvariantChecker:
         out.extend(self._no_stale_orphans())
         out.extend(self._no_stuck_claims())
         out.extend(self._solver_plans_valid())
+        out.extend(self._explain_consistent())
         out.extend(self._no_priority_inversion())
         out.extend(self._no_partial_gang_placed())
         out.extend(self._resident_state_fresh())
@@ -152,6 +160,12 @@ class InvariantChecker:
         out = [Violation("solver-plan-valid", v)
                for v in self.solver_violations]
         self.solver_violations.clear()
+        return out
+
+    def _explain_consistent(self) -> list[Violation]:
+        out = [Violation("explain-consistent", v)
+               for v in self.explain_violations]
+        self.explain_violations.clear()
         return out
 
     def _no_priority_inversion(self) -> list[Violation]:

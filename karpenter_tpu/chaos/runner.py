@@ -226,6 +226,7 @@ class ChaosHarness:
             stuck_claim_grace=(reg_timeout
                                + 2 * max(self.step, self.quiesce_step) + 60.0),
             solver_violations=self.solver.violations, trace=self.trace,
+            explain_violations=self.solver.explain_violations,
             preemption=self.preemption
             if "preemption" not in profile.disable_controllers else None,
             gang=self.gang

@@ -43,13 +43,18 @@ class UnstableSolver:
 
 class ValidatingSolver:
     """Runs the independent feasibility oracle on every plan; violations
-    accumulate in ``violations`` (drained by the invariant checker)."""
+    accumulate in ``violations`` (drained by the invariant checker).
+    Every plan's attached unplaced reasons are additionally re-derived
+    from the request via the explain consistency oracle
+    (karpenter_tpu/explain/validate.py); contradictions accumulate in
+    ``explain_violations`` for the ``explain-consistent`` invariant."""
 
     def __init__(self, inner, trace: EventTrace | None = None):
         self.inner = inner
         self.trace = trace
         self.options = getattr(inner, "options", None)
         self.violations: list[str] = []
+        self.explain_violations: list[str] = []
 
     def solve(self, request: SolveRequest) -> Plan:
         plan = self.inner.solve(request)
@@ -62,4 +67,16 @@ class ValidatingSolver:
                            cost=round(plan.total_cost_per_hour, 4),
                            invalid=len(errors))
         self.violations.extend(errors)
+        if plan.unplaced_pods:
+            from karpenter_tpu.explain.validate import check_plan_reasons
+            from karpenter_tpu.solver.encode import encode
+
+            try:
+                problem = encode(request.pods, request.catalog,
+                                 request.nodepool)
+                self.explain_violations.extend(
+                    check_plan_reasons(problem, plan))
+            except Exception as e:  # noqa: BLE001 — the check must not fail a solve
+                self.explain_violations.append(
+                    f"explain consistency check errored: {e!r}")
         return plan

@@ -46,6 +46,7 @@ from dataclasses import dataclass
 
 from karpenter_tpu.apis.pod import PodSpec, pod_key
 from karpenter_tpu.controllers.runtime import PollController, Result
+from karpenter_tpu.explain import get_registry
 from karpenter_tpu.core.cluster import ClusterState
 from karpenter_tpu.gang.degraded import ResilientGangPlanner
 from karpenter_tpu.gang.encode import encode_gangs
@@ -140,6 +141,10 @@ class GangAdmissionController(PollController):
                 for p in members:
                     obs.get_ledger().transition(pod_key(p.spec),
                                                 "gang.admit")
+                    # the park verdict lifted: members now compete as
+                    # ordinary solve-window pods
+                    get_registry().clear_bits(pod_key(p.spec),
+                                              "gang_parked")
                 with obs.span("gang.admit", gang=name,
                               members=len(members),
                               min_member=spec.min_member,
@@ -176,9 +181,23 @@ class GangAdmissionController(PollController):
                 # deduped transition: the 5s reconcile loop stamps
                 # "gang.park" once per park episode, not once per tick
                 for p in members:
-                    obs.get_ledger().transition(pod_key(p.spec),
-                                                "gang.park")
+                    key = pod_key(p.spec)
+                    obs.get_ledger().transition(key, "gang.park")
+                    # explain verdict for the parked members: the
+                    # registry stamp dedupes, so the 5s loop emits the
+                    # Warning event once per park episode
+                    if get_registry().stamp(
+                            key, "gang_parked",
+                            detail=f"gang {name}: {len(members)}/"
+                                   f"{spec.min_member} members pending"):
+                        self.cluster.record_event(
+                            "Pod", key, "Warning", "Unplaced",
+                            f"cannot place: gang_parked (gang {name} "
+                            f"awaiting min_member {spec.min_member})")
         metrics.GANG_PARKED.set(parked)
+        # unconditional: the tick that unparks the LAST gang must zero
+        # the gang_parked gauge count, not leave it lingering
+        get_registry().update_unplaced_gauge()
         if to_place:
             self._place_slice_gangs(to_place)
         return Result()
@@ -194,6 +213,9 @@ class GangAdmissionController(PollController):
             # flags the record: a later nomination resolves as
             # outcome "placed_degraded", feeding the degraded-rate SLO
             obs.get_ledger().transition(pod_key(p.spec), "gang.release")
+            # released members are ordinary pods: gang verdicts lift
+            get_registry().clear_bits(pod_key(p.spec), "gang_parked",
+                                      "gang_geometry")
         while len(self.released) >= self._released_max:
             self.released.pop(next(iter(self.released)))
         self.released[name] = None
@@ -246,6 +268,26 @@ class GangAdmissionController(PollController):
                 sp.set("gangs_placed", len(plan.placed_gangs))
                 metrics.GANG_PLAN_DURATION.labels(plan.backend).observe(
                     time.perf_counter() - t0)
+                # explain: a gang whose compat row is EMPTY has no
+                # offering whose torus hosts its slice (or fits its
+                # total demand) — the gang_geometry verdict.  A NON-empty
+                # row clears the bit: a catalog that recovered (new
+                # torus-bearing offering) must not keep blaming geometry
+                # for a gang now merely waiting on capacity.
+                for idx, g in enumerate(problem.gangs):
+                    if not problem.compat[idx].any():
+                        for pn in g.pod_names:
+                            if get_registry().stamp(
+                                    pn, "gang_geometry",
+                                    detail=f"gang {g.name}: no offering "
+                                           f"hosts the slice"):
+                                self.cluster.record_event(
+                                    "Pod", pn, "Warning", "Unplaced",
+                                    f"cannot place: gang_geometry "
+                                    f"(gang {g.name})")
+                    else:
+                        for pn in g.pod_names:
+                            get_registry().clear_bits(pn, "gang_geometry")
                 if plan.empty:
                     return set()
                 # independent oracle gate: never actuate an invalid plan

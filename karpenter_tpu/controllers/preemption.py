@@ -111,17 +111,53 @@ class PreemptionController(PollController):
         if not stranded:
             return Result()
         executed = 0
+        budget_blocked = False
+        attempted = False
         for pool in self._pools():
             if pool.preemption_budget == 0:
+                budget_blocked = True
                 continue
             # placements from an earlier pool consume their pods
             stranded = [p for p in stranded if not p.nominated_node]
             if not stranded:
                 break
+            attempted = True
             executed += self._preempt_pool(pool, stranded)
         if executed:
             log.info("preemption pass", evictions=executed)
+        # explain verdict for pods the plane could not help.  A plan
+        # that RAN and left them stranded is the most specific truth —
+        # no strictly-lower-priority victim worth evicting
+        # (priority_starved) — regardless of some OTHER pool being
+        # budget-gated; only when no budgeted pool attempted at all is
+        # the budget the blocker.
+        still = [p for p in stranded if not p.nominated_node]
+        if still and (budget_blocked or attempted):
+            self._stamp_unhelped(
+                still, "priority_starved" if attempted
+                else "preemption_budget")
         return Result()
+
+    def _stamp_unhelped(self, stranded: list, reason: str) -> None:
+        """Layer the preemption plane's verdict onto the explain
+        registry (karpenter_tpu/explain).  Pods whose standing reason is
+        STATIC (nothing could ever host them) are skipped — preemption
+        was never going to help, and blaming it would contradict the
+        consistency oracle."""
+        from karpenter_tpu.explain import get_registry
+        from karpenter_tpu.explain.validate import STATIC_REASONS
+
+        registry = get_registry()
+        for p in stranded:
+            key = pod_key(p.spec)
+            entry = registry.get(key)
+            if entry is not None and entry.reason in STATIC_REASONS:
+                continue
+            if registry.stamp(key, reason, detail="preemption plane"):
+                self.cluster.record_event(
+                    "Pod", key, "Warning", "Unplaced",
+                    f"cannot place: {reason}")
+        registry.update_unplaced_gauge()
 
     def _pools(self) -> list[NodePool]:
         # the provisioner's resolution, not a reimplementation: it knows
@@ -234,6 +270,9 @@ class PreemptionController(PollController):
                 continue
             pending.nominated_node = claim_name
             obs.get_ledger().resolve(pn, "placed")
+            from karpenter_tpu.explain import get_registry
+
+            get_registry().resolve(pn)
             placed += 1
             self.cluster.record_event(
                 "Pod", pn, "Normal", "PreemptionPlaced",

@@ -273,6 +273,11 @@ class Provisioner:
 
         plans: list[Plan] = []
         nominated: dict[str, str] = {}   # pod key -> claim name
+        # explain verdicts collected across the pool ladder: the LAST
+        # pool's verdict for a pod stands (later pools had the later
+        # word on it); only pods still unnominated at window end are
+        # recorded (karpenter_tpu/explain)
+        window_reasons: dict[str, tuple[str, int, dict | None]] = {}
         # pods trimmed by a pool resource limit this window: the Warning
         # event is emitted only for those STILL unnominated at window
         # end (another pool may place them — an event then would be a
@@ -313,13 +318,24 @@ class Provisioner:
                 solve_catalog = self._catalog_within_limits(pool, catalog,
                                                             usage)
                 if solve_catalog is None:
-                    continue   # pool budget exhausted: pods stay pending
+                    # pool budget exhausted: pods stay pending — but they
+                    # must still carry a verdict (another pool's real
+                    # solve verdict wins via setdefault; otherwise the
+                    # limit_dropped fallback records capacity_exhausted
+                    # + the NodePoolLimitReached event at window end)
+                    for p in pool_pods:
+                        limit_dropped.setdefault(pod_key(p), pool.name)
+                    continue
                 plan = self.solver.solve(
                     SolveRequest(pool_pods, solve_catalog, pool))
                 plan, dropped = self._apply_pool_limits(pool, plan,
                                                         catalog, usage)
                 for pn in dropped:
                     limit_dropped.setdefault(pn, pool.name)
+                for pn, reason in plan.unplaced_reasons.items():
+                    window_reasons[pn] = (
+                        reason, plan.unplaced_words.get(pn, 0),
+                        plan.unplaced_nearest.get(pn))
                 # plan decoded: the snapshot this solve consumed is now
                 # this stale (solver-staleness SLO source)
                 obs.get_ledger().plan_decoded(
@@ -352,7 +368,45 @@ class Provisioner:
                 self.cluster.record_event(
                     "Pod", pn, "Warning", "NodePoolLimitReached",
                     f"pool {pool_name} resource limit blocks provisioning")
+        self._record_unplaced(window_reasons, nominated, limit_dropped)
         return plans, nominated
+
+    def _record_unplaced(self, window_reasons: dict, nominated: dict,
+                         limit_dropped: dict) -> None:
+        """Window-end explain accounting (karpenter_tpu/explain): every
+        pod that stayed unnominated gets its verdict recorded in the
+        bounded registry (the /debug/explain surface), an
+        ``unplaced:<reason>`` ledger stamp feeding
+        ``pod_placement_seconds{outcome="unplaced"}``, and — only when
+        the canonical reason CHANGED — a Warning event carrying the
+        reason and the window's trace id.  The
+        ``karpenter_tpu_unplaced_pods{reason}`` gauge refreshes over the
+        full allowlist so counts never linger."""
+        from karpenter_tpu.explain import get_registry, word_for
+
+        registry = get_registry()
+        ledger = obs.get_ledger()
+        cur = obs.current_span()
+        trace_id = cur.trace_id if cur is not None else 0
+        for pn, pool_name in limit_dropped.items():
+            if pn not in nominated and pn not in window_reasons:
+                window_reasons[pn] = (
+                    "capacity_exhausted", word_for("capacity_exhausted"),
+                    None)
+        for pn, (reason, word, near) in window_reasons.items():
+            if pn in nominated:
+                continue
+            changed = registry.note(pn, word, reason, nearest=near,
+                                    trace_id=trace_id, merge=False)
+            ledger.unplaced(pn, reason)
+            if changed:
+                self.cluster.record_event(
+                    "Pod", pn, "Warning", "Unplaced",
+                    f"cannot place: {reason} (trace={trace_id})")
+        # unconditional: a window that placed its last previously-stuck
+        # pod must ZERO that reason's gauge ("counts never linger"), not
+        # just windows that produced fresh verdicts
+        registry.update_unplaced_gauge()
 
     def _type_alloc_for(self, name: str, catalog):
         """(cpu_milli, mem_mib) of an instance type: the pool's filtered
@@ -490,6 +544,11 @@ class Provisioner:
             # ambient span (fired window / gang.place) supplies the
             # trace id /debug/slo links tail pods through
             obs.get_ledger().resolve(key, "placed")
+            # the pod placed: drop its explain row so /debug/explain
+            # only ever describes pods that are still unplaced
+            from karpenter_tpu.explain import get_registry
+
+            get_registry().resolve(key)
 
     def _pools(self) -> list[NodePool]:
         pools = self.cluster.list("nodepools")

@@ -61,6 +61,11 @@ class DeviceTelemetry:
         self.catalog_upload_bytes = 0
         self.donation_misses = 0
         self.donation_miss_bytes = 0
+        # explain reason-word share of the fetched result buffers: the
+        # [G] int32 words karpenter_tpu/explain appends ride the D2H the
+        # solve already pays; this counter makes the overhead auditable
+        # (bench gates it < 5% of solve D2H)
+        self.explain_d2h_bytes = 0
         # resident-state accounting (karpenter_tpu/resident/): windows by
         # mode, delta traffic, last rebuild reason — the /statusz and
         # /debug/slo surface for the store's health
@@ -133,6 +138,13 @@ class DeviceTelemetry:
             self.d2h_bytes += nbytes
         metrics.TRANSFER_BYTES.labels("d2h").inc(nbytes)
 
+    def note_explain_d2h(self, nbytes: int) -> None:
+        """The explain reason-word slice of a fetched result buffer
+        (already counted in note_d2h's total — this is the attribution,
+        not an extra transfer)."""
+        with self._lock:
+            self.explain_d2h_bytes += nbytes
+
     def note_resident_window(self, mode: str, *, h2d_bytes: int = 0,
                              words: int = 0, reason: str = "",
                              resident_bytes: int = 0,
@@ -198,6 +210,7 @@ class DeviceTelemetry:
                 "catalog_upload_bytes": self.catalog_upload_bytes,
                 "donation_misses": self.donation_misses,
                 "donation_miss_bytes": self.donation_miss_bytes,
+                "explain_d2h_bytes": self.explain_d2h_bytes,
                 "resident": {
                     "windows": self.resident_windows,
                     "hits": self.resident_hits,
@@ -220,6 +233,7 @@ class DeviceTelemetry:
             self.h2d_bytes = self.d2h_bytes = 0
             self.catalog_uploads = self.catalog_upload_bytes = 0
             self.donation_misses = self.donation_miss_bytes = 0
+            self.explain_d2h_bytes = 0
             self.resident_windows = self.resident_hits = 0
             self.resident_deltas = self.resident_rebuilds = 0
             self.resident_invalidations = self.resident_delta_bytes = 0

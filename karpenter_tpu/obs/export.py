@@ -114,20 +114,27 @@ def to_chrome(recorder: FlightRecorder) -> dict:
 
 
 def debug_traces(recorder: FlightRecorder, *, status: str | None = None,
-                 min_duration_ms: float = 0.0, limit: int = 50) -> dict:
+                 min_duration_ms: float = 0.0, limit: int = 50,
+                 trace_id: int | None = None) -> dict:
     """The ``/debug/traces`` payload: newest-first trace summaries with
-    their spans, filterable by status and minimum root duration."""
+    their spans, filterable by status and minimum root duration.
+    ``trace_id`` is an exact lookup — the direct fetch for the trace ids
+    the ledger's worst-K table and ``/debug/slo`` print (other filters
+    are ignored for a pinpoint fetch)."""
     anchor = recorder.anchor_monotonic
     wall0 = recorder.anchor_wall
     traces = []
-    for trace_id, tstatus, root, spans in recorder.traces():
-        if status and tstatus != status:
+    for tid, tstatus, root, spans in recorder.traces():
+        if trace_id is not None:
+            if tid != trace_id:
+                continue
+        elif status and tstatus != status:
             continue
         dur_ms = root.duration_s * 1000.0
-        if dur_ms < min_duration_ms:
+        if trace_id is None and dur_ms < min_duration_ms:
             continue
         traces.append({
-            "trace_id": trace_id,
+            "trace_id": tid,
             "root": root.name,
             "status": tstatus,
             "start_unix": round(wall0 + (root.start - anchor), 6),

@@ -251,6 +251,32 @@ class PlacementLedger:
             if name == "gang.release":
                 rec.flag("released_degraded")
 
+    def unplaced(self, key: str, reason: str,
+                 t: float | None = None) -> None:
+        """A solve window left this pod unplaced for ``reason``
+        (karpenter_tpu/explain canonical taxonomy).  Non-terminal — the
+        record stays open for the retry loop — but each NEW verdict
+        observes the pod's age-so-far into
+        ``pod_placement_seconds{outcome="unplaced"}`` and stamps
+        ``unplaced:<reason>`` (deduped, so a retry loop re-deciding the
+        same reason every 15 s neither spams the histogram nor burns the
+        record's stamp budget)."""
+        t = now() if t is None else t
+        name = f"unplaced:{reason}"
+        with self._lock:
+            rec = self._open.get(key)
+            if rec is None:
+                return
+            before = len(rec.stamps)
+            rec.add_stamp(name, t, dedupe=True)
+            changed = len(rec.stamps) != before
+            if changed:
+                self.transition_counts[name] = \
+                    self.transition_counts.get(name, 0) + 1
+            age = max(0.0, t - rec.first_seen)
+        if changed:
+            metrics.POD_PLACEMENT.labels("unplaced").observe(age)
+
     def reopen(self, key: str, reason: str, t: float | None = None) -> None:
         """A resolved pod re-entered the queue (preemption eviction):
         restart its placement clock — the re-placement is a fresh

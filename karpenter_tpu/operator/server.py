@@ -14,11 +14,15 @@ CRD-shaped NodeClass document (returns ``{"allowed", "errors"}``).
 
 Debug surface (docs/design/observability.md):
 
-- ``GET /debug/traces[?status=error&min_ms=10&limit=20]`` — recent
-  traces from the process flight recorder (karpenter_tpu.obs), newest
-  first, errors never evicted by successes;
+- ``GET /debug/traces[?status=error&min_ms=10&limit=20&trace_id=N]`` —
+  recent traces from the process flight recorder (karpenter_tpu.obs),
+  newest first, errors never evicted by successes; ``trace_id=`` is the
+  exact-lookup fetch for ids printed by /debug/slo's worst-pod table;
 - ``GET /debug/slo`` — live SLO evaluation over the placement ledger
   (worst-case pods with trace ids, burn state, device telemetry);
+- ``GET /debug/explain[?pod=ns/name&limit=N]`` — per-pod placement
+  explainability (karpenter_tpu/explain): canonical unplaced reason,
+  elimination bitmask, nearest-miss offering, reason summary;
 - ``GET /statusz`` — uptime, build identity, last solve breakdown,
   ledger + recorder + device-telemetry snapshots, leader /
   circuit-breaker state (the operator wires its own extras in via the
@@ -118,6 +122,9 @@ class MetricsServer:
                         lambda: outer._debug_traces(self.path))
                 elif self.path.split("?", 1)[0] == "/debug/slo":
                     self._json_endpoint(outer._debug_slo)
+                elif self.path.split("?", 1)[0] == "/debug/explain":
+                    self._json_endpoint(
+                        lambda: outer._debug_explain(self.path))
                 elif self.path.split("?", 1)[0] == "/statusz":
                     self._json_endpoint(outer._statusz)
                 elif self.path == "/healthz":
@@ -223,7 +230,33 @@ class MetricsServer:
             obs.get_recorder(),
             status=one("status", None, str),
             min_duration_ms=one("min_ms", 0.0, float),
-            limit=one("limit", 50, int))
+            limit=one("limit", 50, int),
+            trace_id=one("trace_id", None, int))
+
+    def _debug_explain(self, path: str) -> dict:
+        """Per-pod placement explainability (karpenter_tpu/explain):
+        canonical reason, raw elimination bits, the nearest-miss
+        offering ("would fit if +2 CPU"), and the trace id of the window
+        that decided — plus a reason-count summary.  ``?pod=ns/name``
+        narrows to one pod; ``?limit=`` bounds the table."""
+        from karpenter_tpu.explain import get_registry
+
+        q = parse_qs(urlparse(path).query)
+        registry = get_registry()
+        pod = q["pod"][0] if q.get("pod") else ""
+        if pod:
+            entry = registry.get(pod)
+            return {"pods": [entry.to_dict()] if entry else [],
+                    "summary": registry.summary()}
+        try:
+            limit = int(q["limit"][0]) if q.get("limit") else 100
+        except (TypeError, ValueError):
+            limit = 100
+        return {
+            "pods": [e.to_dict() for e in registry.entries(limit)],
+            "summary": registry.summary(),
+            "stamped_total": registry.stamped_total,
+        }
 
     def _debug_slo(self) -> dict:
         """Live SLO evaluation over the placement ledger: burn state per
@@ -241,6 +274,8 @@ class MetricsServer:
         from karpenter_tpu.obs.devtel import get_devtel
         from karpenter_tpu.version import get_version
 
+        from karpenter_tpu.explain import get_registry
+
         ledger = obs.get_ledger()
         out = {
             "uptime_s": round(time.time() - self._started_at, 3),
@@ -251,6 +286,7 @@ class MetricsServer:
             "ledger": ledger.stats(),
             "pending_staleness_s": round(ledger.pending_staleness(), 6),
             "device_telemetry": get_devtel().snapshot(),
+            "unplaced_reasons": get_registry().summary(),
         }
         if self._statusz_extra is not None:
             out.update(self._statusz_extra())

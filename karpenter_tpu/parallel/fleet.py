@@ -115,7 +115,10 @@ def fleet_packed_pallas(big, alloc8_all, rank_all, price_all, *, C: int,
     from karpenter_tpu.solver.pallas_kernel import ffd_scan_pallas_fleet
 
     off_alloc_all = alloc8_all[:, :4].transpose(0, 2, 1)      # [C,O,R]
-    metas, compats = jax.vmap(
+    # the fleet wire keeps the bare _pack_result layout (no explain
+    # suffix): its parser is fleet_parse_outputs, not unpack_result, and
+    # repack consumers re-derive reasons host-side when they need them
+    metas, compats, _rows = jax.vmap(
         lambda p, a: _unpack_problem(p, a, G, O, U))(big, off_alloc_all)
     node_off, assign, unplaced = ffd_scan_pallas_fleet(
         metas, compats, alloc8_all, rank_all, C=C, G=G, O=O, N=N,

@@ -104,5 +104,6 @@ def pack_window(problem) -> tuple[np.ndarray, tuple[int, int, int]]:
                         _pad1(problem.group_count, G_pad),
                         _pad1(problem.group_cap, G_pad),
                         _pad1(label_idx, G_pad),
-                        _pad2(rows, U_pad, O_pad))
+                        _pad2(rows, U_pad, O_pad),
+                        group_prio=_pad1(problem.group_prio, G_pad))
     return packed, (G_pad, O_pad, U_pad)

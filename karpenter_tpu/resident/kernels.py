@@ -27,7 +27,7 @@ import functools
 import jax
 
 from karpenter_tpu.solver.jax_backend import (
-    _pack_result, _unpack_problem, solve_core,
+    _pack_result_explained, _unpack_problem, solve_core,
 )
 
 
@@ -56,10 +56,11 @@ def solve_resident(state, didx, dval, off_alloc, off_price, off_rank, *,
     state stays on device for the next window's delta.
     """
     state = state.at[didx].set(dval, mode="drop")
-    meta, compat_i = _unpack_problem(state, off_alloc, G, O, U)
+    meta, compat_i, rows_g = _unpack_problem(state, off_alloc, G, O, U)
     node_off, assign, unplaced, cost = solve_core(
         meta[:, :4], meta[:, 4], meta[:, 5], compat_i > 0,
         off_alloc, off_price, off_rank, num_nodes=N,
         right_size=right_size)
-    return state, _pack_result(node_off, assign, unplaced, cost, compact,
-                               dense16, coo16)
+    return state, _pack_result_explained(meta, rows_g, compat_i, node_off,
+                                         assign, unplaced, cost, off_alloc,
+                                         compact, dense16, coo16)

@@ -84,7 +84,7 @@ class EncodedProblem:
 
     __slots__ = ("groups", "group_req", "group_count", "group_cap",
                  "group_prio", "group_gang", "group_min", "gang_names",
-                 "catalog", "rejected", "label_rows",
+                 "catalog", "rejected", "rejected_reasons", "label_rows",
                  "label_idx", "pref_rows", "pref_idx", "_compat",
                  "_names_idx", "_prep_cache")
 
@@ -100,7 +100,8 @@ class EncodedProblem:
                  group_prio: np.ndarray | None = None,
                  group_gang: np.ndarray | None = None,
                  group_min: np.ndarray | None = None,
-                 gang_names: list[str] | None = None):
+                 gang_names: list[str] | None = None,
+                 rejected_reasons: dict[str, str] | None = None):
         self.groups = groups
         self.group_req = group_req
         self.group_count = group_count
@@ -122,6 +123,12 @@ class EncodedProblem:
         self.gang_names = gang_names if gang_names is not None else []
         self.catalog = catalog
         self.rejected = rejected if rejected is not None else []
+        # pod key -> canonical explain reason for encoder-time rejects
+        # ("taints" = pool taints not tolerated, "requirements" =
+        # statically-unsatisfiable requirement keys); consumed by the
+        # explain decode fold (karpenter_tpu/explain/decode.py)
+        self.rejected_reasons = rejected_reasons \
+            if rejected_reasons is not None else {}
         self.label_rows = label_rows
         self.label_idx = label_idx
         # soft preferences, factored like label rows: pref_rows float32
@@ -169,7 +176,8 @@ class EncodedProblem:
                       label_idx=self.label_idx, pref_rows=self.pref_rows,
                       pref_idx=self.pref_idx, group_prio=self.group_prio,
                       group_gang=self.group_gang, group_min=self.group_min,
-                      gang_names=self.gang_names)
+                      gang_names=self.gang_names,
+                      rejected_reasons=self.rejected_reasons)
         fields.update(kw)
         return EncodedProblem(**fields)
 
@@ -228,6 +236,34 @@ _LABEL_KEYS = (LABEL_INSTANCE_TYPE, LABEL_ARCH, LABEL_INSTANCE_FAMILY,
                LABEL_INSTANCE_SIZE, LABEL_CAPACITY_TYPE)
 
 
+def _label_compat_noavail(reqs: Requirements, catalog: CatalogArrays,
+                          cache: dict | None = None) -> np.ndarray:
+    """bool [O]: the five label masks WITHOUT the availability term —
+    the factor the explain refinement splits on (a pod whose labels
+    match offerings that are all unavailable is "availability", not
+    "requirements"; karpenter_tpu/explain/decode.py)."""
+    if cache is not None:
+        key = ("__label_row_noavail__",) + tuple(
+            tuple(sorted(r.signature for r in reqs.get(k)))
+            for k in _LABEL_KEYS)
+        hit = cache.get(key)
+        if hit is not None:
+            return hit
+    mask = _allowed_mask(reqs, LABEL_INSTANCE_TYPE,
+                         catalog.type_names, cache)[catalog.off_type]
+    mask &= _allowed_mask(reqs, LABEL_ARCH,
+                          catalog.archs, cache)[catalog.type_arch[catalog.off_type]]
+    mask &= _allowed_mask(reqs, LABEL_INSTANCE_FAMILY,
+                          catalog.families, cache)[catalog.type_family[catalog.off_type]]
+    mask &= _allowed_mask(reqs, LABEL_INSTANCE_SIZE,
+                          catalog.sizes, cache)[catalog.type_size[catalog.off_type]]
+    mask &= _allowed_mask(reqs, LABEL_CAPACITY_TYPE,
+                          list(CAPACITY_TYPES), cache)[catalog.off_cap]
+    if cache is not None:
+        cache[key] = mask
+    return mask
+
+
 def _label_compat(reqs: Requirements, catalog: CatalogArrays,
                   cache: dict | None = None) -> np.ndarray:
     """bool [O]: the LABEL part of offering feasibility (zone-independent):
@@ -247,17 +283,7 @@ def _label_compat(reqs: Requirements, catalog: CatalogArrays,
         hit = cache.get(combined_key)
         if hit is not None:
             return hit
-    mask = _allowed_mask(reqs, LABEL_INSTANCE_TYPE,
-                         catalog.type_names, cache)[catalog.off_type]
-    mask &= _allowed_mask(reqs, LABEL_ARCH,
-                          catalog.archs, cache)[catalog.type_arch[catalog.off_type]]
-    mask &= _allowed_mask(reqs, LABEL_INSTANCE_FAMILY,
-                          catalog.families, cache)[catalog.type_family[catalog.off_type]]
-    mask &= _allowed_mask(reqs, LABEL_INSTANCE_SIZE,
-                          catalog.sizes, cache)[catalog.type_size[catalog.off_type]]
-    mask &= _allowed_mask(reqs, LABEL_CAPACITY_TYPE,
-                          list(CAPACITY_TYPES), cache)[catalog.off_cap]
-    mask &= catalog.off_avail
+    mask = _label_compat_noavail(reqs, catalog, cache) & catalog.off_avail
     if cache is not None:
         cache[combined_key] = mask
     return mask
@@ -498,10 +524,13 @@ def _encode_impl(pods: Sequence[PodSpec], catalog: CatalogArrays,
 
     # 1. Reject pods that cannot run in this pool at all (taints).
     rejected: list[str] = []
+    rej_reasons: dict[str, str] = {}   # explain taxonomy, decode fold
     eligible: list[PodSpec] = []
     for pod in pods:
         if nodepool.taints and not tolerates_all(pod.tolerations, nodepool.taints):
-            rejected.append(pod_key(pod))
+            key = pod_key(pod)
+            rejected.append(key)
+            rej_reasons[key] = "taints"
         else:
             eligible.append(pod)
 
@@ -584,7 +613,10 @@ def _encode_impl(pods: Sequence[PodSpec], catalog: CatalogArrays,
             (reqs, unsat_flag, cap, label, nozone, live_zones, zone_sig,
              pref) = hit
             if unsat_flag:
-                rejected.extend(pod_key(p) for p in members)
+                for p in members:
+                    key = pod_key(p)
+                    rejected.append(key)
+                    rej_reasons[key] = "requirements"
                 continue
         else:
             reqs = rep.scheduling_requirements().merged(nodepool.requirements)
@@ -600,7 +632,10 @@ def _encode_impl(pods: Sequence[PodSpec], catalog: CatalogArrays,
                     _SIG_LOWER_CACHE[(sig,) + gen_key] = (reqs, True, cap,
                                                           None, None, None,
                                                           None, None)
-                rejected.extend(pod_key(p) for p in members)
+                for p in members:
+                    key = pod_key(p)
+                    rejected.append(key)
+                    rej_reasons[key] = "requirements"
                 continue
             label = _label_compat(reqs, catalog, mask_cache)
             nozone = label & _fit_mask(req_vec, catalog)
@@ -770,7 +805,7 @@ def _encode_impl(pods: Sequence[PodSpec], catalog: CatalogArrays,
         pref_rows=np.stack(pref_rows_l) if has_pref else None,
         pref_idx=pref_idx if has_pref else None, group_prio=group_prio,
         group_gang=group_gang, group_min=group_min,
-        gang_names=list(gang_ids))
+        gang_names=list(gang_ids), rejected_reasons=rej_reasons)
 
 
 def estimate_nodes(problem: EncodedProblem, n_cap: int,
@@ -798,7 +833,7 @@ def estimate_nodes(problem: EncodedProblem, n_cap: int,
 
 def decode_plan(problem: EncodedProblem, node_off: np.ndarray,
                 assign: np.ndarray, unplaced: np.ndarray, cost: float,
-                backend: str):
+                backend: str, reason_words: np.ndarray | None = None):
     """Shared dense-result -> Plan decoding (jax, pallas, and native
     backends all emit the same (node_off, assign, unplaced) contract).
 
@@ -812,7 +847,7 @@ def decode_plan(problem: EncodedProblem, node_off: np.ndarray,
     gis, ns = np.nonzero((assign[:G] > 0) & (node_off >= 0)[None, :])
     cnts = assign[gis, ns].astype(np.int64)
     return decode_plan_entries(problem, node_off, gis, ns, cnts, unplaced,
-                               cost, backend)
+                               cost, backend, reason_words=reason_words)
 
 
 def _names_index(problem: EncodedProblem):
@@ -883,7 +918,8 @@ def _enforce_gangs(problem: EncodedProblem, node_off: np.ndarray,
 
 def decode_plan_entries(problem: EncodedProblem, node_off: np.ndarray,
                         gis: np.ndarray, ns: np.ndarray, cnts: np.ndarray,
-                        unplaced: np.ndarray, cost: float, backend: str):
+                        unplaced: np.ndarray, cost: float, backend: str,
+                        reason_words: np.ndarray | None = None):
     """COO form of :func:`decode_plan`: assignment entries (group gi,
     node n, pod count) in any order.  The flat solver and the pipelined
     solve path decode straight from device COO without densifying the
@@ -997,8 +1033,16 @@ def decode_plan_entries(problem: EncodedProblem, node_off: np.ndarray,
         g = groups[gi]
         m = int(miss[gi])
         unplaced_names.extend(g.pod_names[len(g.pod_names) - m:])
-    return Plan(nodes=nodes, unplaced_pods=unplaced_names,
+    plan = Plan(nodes=nodes, unplaced_pods=unplaced_names,
                 total_cost_per_hour=float(cost), backend=backend)
+    if unplaced_names:
+        # fold the device reason words (or the host oracle, when the
+        # path carries none) into per-pod canonical reasons — the
+        # explain fold is a no-op for fully-placed windows
+        from karpenter_tpu.explain.decode import attach
+
+        attach(problem, plan, reason_words, miss=miss)
+    return plan
 
 
 def _best_zone_for(pod: PodSpec, reqs: Requirements, zones: list[str],

@@ -132,8 +132,13 @@ class GreedySolver:
         from karpenter_tpu import native
 
         if problem.num_groups == 0:
-            return Plan(nodes=[], unplaced_pods=list(problem.rejected),
+            plan = Plan(nodes=[], unplaced_pods=list(problem.rejected),
                         backend="greedy-native")
+            if plan.unplaced_pods:
+                from karpenter_tpu.explain.decode import attach
+
+                attach(problem, plan)
+            return plan
         catalog = problem.catalog
         from karpenter_tpu.solver.encode import estimate_nodes
         from karpenter_tpu.solver.types import NODE_BUCKETS
@@ -310,5 +315,12 @@ class GreedySolver:
             nodes.append(PlannedNode(instance_type=itype, zone=zone,
                                      capacity_type=captype, price=price,
                                      pod_names=node_pods[ni], offering_index=off))
-        return Plan(nodes=nodes, unplaced_pods=unplaced,
+        plan = Plan(nodes=nodes, unplaced_pods=unplaced,
                     total_cost_per_hour=total, backend="greedy")
+        if unplaced:
+            # host-oracle explain fold: same words the device reduction
+            # emits for this window (karpenter_tpu/explain/greedy.py)
+            from karpenter_tpu.explain.decode import attach
+
+            attach(problem, plan)
+        return plan

@@ -312,7 +312,7 @@ def expand_coo_assign(idx: np.ndarray, cnt: np.ndarray,
 #
 # Input layout v2 (int32, length G*8 + U*O/32):
 #   [0, G*8)      meta rows [G, 8]: req_cpu, req_mem, req_gpu, req_pods,
-#                 count, cap, label_row_idx, 0
+#                 count, cap, label_row_idx, priority
 #   [G*8, end)    LABEL-ROW bits [U, O/32] (little-endian bit order) —
 #                 compat WITHOUT the per-group resource-fit term.  The
 #                 rows dedupe to a handful of distinct masks (U=1 when
@@ -321,11 +321,17 @@ def expand_coo_assign(idx: np.ndarray, cnt: np.ndarray,
 #                 the RESIDENT catalog — at the heterogeneous 10k-group
 #                 regime this shrinks H2D from 8.4 MB ([G,O] bits) to the
 #                 ~0.5 MB meta block.
-# Output layout (int32, length N + G + 1 + (2K | G*N)):
+# Output layout (int32, length N + G + 1 + (K | 2K | G*N/2 | G*N) + G):
 #   [0, N)        node_off        (-1 = unused slot)
 #   [N, N+G)      unplaced per group
 #   [N+G]         cost            (float32 bit pattern)
-#   rest          COO idx[K] + cnt[K] when compact=K, else dense assign [G*N]
+#   tail          COO idx[K] + cnt[K] when compact=K, else dense assign [G*N]
+#   [end-G, end)  explain reason words [G] (karpenter_tpu/explain): the
+#                 per-group elimination bitmask, computed by masked
+#                 reductions INSIDE the same dispatch — zero extra
+#                 dispatches, zero extra H2D, G extra int32 words on the
+#                 one D2H the solve already pays (<1% of the result
+#                 buffer at every bucketed shape)
 # ---------------------------------------------------------------------------
 
 def dedup_rows(compat) -> tuple[np.ndarray, np.ndarray]:
@@ -350,10 +356,13 @@ def dedup_rows(compat) -> tuple[np.ndarray, np.ndarray]:
 
 
 def pack_input(group_req, group_count, group_cap, label_idx,
-               label_rows) -> np.ndarray:
+               label_rows, group_prio=None) -> np.ndarray:
     """Host-side: pack the per-window problem into the single H2D buffer.
     ``label_rows`` may be bool or int8; O must be a multiple of 32
-    (guaranteed by the offering padding in solve_encoded)."""
+    (guaranteed by the offering padding in solve_encoded).  ``group_prio``
+    rides the spare meta column so the on-device explain reduction can
+    attribute consumed capacity to higher-priority groups (zeros when the
+    caller has no priorities — the sidecar wire)."""
     G = group_req.shape[0]
     U, O = label_rows.shape
     buf = np.empty(G * 8 + U * (O // 32), dtype=np.int32)
@@ -363,6 +372,8 @@ def pack_input(group_req, group_count, group_cap, label_idx,
     meta[:, 4] = group_count
     meta[:, 5] = np.minimum(group_cap, np.iinfo(np.int32).max)
     meta[:, 6] = label_idx
+    if group_prio is not None:
+        meta[:, 7] = group_prio
     bits = np.packbits(np.ascontiguousarray(label_rows, dtype=np.uint8)
                        .reshape(U, O // 32, 32),
                        axis=-1, bitorder="little")          # [U, O/32, 4] u8
@@ -372,11 +383,14 @@ def pack_input(group_req, group_count, group_cap, label_idx,
 
 def _unpack_problem(packed, off_alloc, G: int, O: int, U: int):
     """Device-side inverse of :func:`pack_input` -> (meta [G,8] int32,
-    compat [G,O] int32 0/1).  compat is REBUILT on device: gather each
-    group's label row, AND the resource-fit term recomputed from the
-    group's request vector against the resident catalog ``off_alloc``
-    [O,R].  Bit extraction via shifts (little-endian bit and byte order,
-    matching numpy packbits + .view on every supported platform)."""
+    compat [G,O] int32 0/1, label rows_g [G,O] int32 0/1).  compat is
+    REBUILT on device: gather each group's label row, AND the
+    resource-fit term recomputed from the group's request vector against
+    the resident catalog ``off_alloc`` [O,R].  The fit-free label row is
+    returned alongside — the explain reduction needs it to separate
+    "labels match nothing" from "labels match, nothing fits".  Bit
+    extraction via shifts (little-endian bit and byte order, matching
+    numpy packbits + .view on every supported platform)."""
     meta = packed[:G * 8].reshape(G, 8)
     cw = packed[G * 8:].reshape(U, O // 32)
     b = jnp.stack([(cw >> k) & 1 for k in range(32)], axis=-1)
@@ -384,7 +398,81 @@ def _unpack_problem(packed, off_alloc, G: int, O: int, U: int):
     rows_g = jnp.take(rows, jnp.clip(meta[:, 6], 0, U - 1), axis=0)
     fit = jnp.all(off_alloc[None, :, :] >= meta[:, None, :4],
                   axis=2)                                    # [G, O]
-    return meta, rows_g * fit.astype(jnp.int32)
+    return meta, rows_g * fit.astype(jnp.int32), rows_g
+
+
+def _explain_words(meta, rows_g, compat_i, unplaced, off_alloc):
+    """Per-group explain reason words (int32 [G]) — the device half of
+    karpenter_tpu/explain, computed from tensors ALREADY on device for
+    the solve it rides (masked reductions; no extra dispatch, no extra
+    H2D).  MUST stay bit-identical to the host oracle
+    ``explain.greedy.reason_words`` — change one side, change both
+    (docs/design/explain.md "parity contract").
+
+    Bits computed here: per-resource insufficiency (via the nearest-miss
+    argmin over the clipped deficit), the generic static bit (label row
+    empty; the host decode refines it), capacity_exhausted, and
+    capacity_higher_prio (compat overlap with a PLACED strictly-higher-
+    priority group, the [G,G] presence test on the MXU)."""
+    from karpenter_tpu.explain import (
+        BIT, DEFICIT_CLIP, DEFICIT_MASKED, RESOURCE_BITS,
+    )
+
+    req = meta[:, :4]
+    count = meta[:, 4]
+    prio = meta[:, 7]
+    lbl = rows_g > 0
+    compat = compat_i > 0
+    has_label = jnp.any(lbl, axis=1)
+    has_fit = jnp.any(compat, axis=1)
+    per_dim = jnp.minimum(
+        jnp.maximum(req[:, None, :] - off_alloc[None, :, :], 0),
+        DEFICIT_CLIP)
+    deficit = jnp.sum(per_dim, axis=2)                       # [G, O] int32
+    masked = jnp.where(lbl, deficit, DEFICIT_MASKED)
+    nearest = jnp.argmin(masked, axis=1)
+    near_alloc = off_alloc[nearest]                          # [G, R]
+    insufficient = has_label & ~has_fit
+    bits = jnp.zeros(req.shape[0], dtype=jnp.int32)
+    for r, bit_name in enumerate(RESOURCE_BITS):
+        hit = insufficient & (req[:, r] > near_alloc[:, r])
+        bits = bits | jnp.where(hit, jnp.int32(1 << BIT[bit_name]), 0)
+    bits = bits | jnp.where(~has_label,
+                            jnp.int32(1 << BIT["requirements"]), 0)
+    bits = bits | jnp.where(has_fit,
+                            jnp.int32(1 << BIT["capacity_exhausted"]), 0)
+    # consumed-by-higher-priority, in O(G*O): per offering, the max
+    # priority among PLACED groups compatible with it; a group whose
+    # compat admits any offering where that max exceeds its own priority
+    # lost capacity to higher-priority demand.  Equivalent to the
+    # pairwise [G,G] overlap test (exists placed h with compat overlap
+    # and prio[h] > prio[g]  <=>  exists o in compat[g] with
+    # max_placed_prio[o] > prio[g]) without the G^2 intermediate that
+    # would dominate the solve at the 10k-group regime.
+    placed = (count - unplaced) > 0
+    int_min = jnp.iinfo(jnp.int32).min
+    max_placed_prio = jnp.max(
+        jnp.where(compat & placed[:, None], prio[:, None], int_min),
+        axis=0)                                              # [O]
+    cap_hp = jnp.any(compat & (max_placed_prio[None, :] > prio[:, None]),
+                     axis=1) & has_fit
+    bits = bits | jnp.where(cap_hp,
+                            jnp.int32(1 << BIT["capacity_higher_prio"]), 0)
+    live_un = (count > 0) & (unplaced > 0)
+    return jnp.where(live_un, bits, 0).astype(jnp.int32)
+
+
+def _pack_result_explained(meta, rows_g, compat_i, node_off, assign,
+                           unplaced, cost, off_alloc, compact, dense16,
+                           coo16):
+    """Packed result + the appended [G] explain reason words — the ONE
+    finisher every packed entry point (scan, pref, batch, pallas,
+    resident) traces through, so the output wire layout cannot fork."""
+    out = _pack_result(node_off, assign, unplaced, cost, compact, dense16,
+                       coo16)
+    words = _explain_words(meta, rows_g, compat_i,
+                           unplaced.astype(jnp.int32), off_alloc)
+    return jnp.concatenate([out, words])
 
 
 def pack16_pairs(a):
@@ -476,10 +564,35 @@ def unpack_coo_tail(out: np.ndarray, G: int, N: int, K: int,
     return rest[:K], rest[K:2 * K]
 
 
+def result_tail_len(G: int, N: int, K: int, dense16: bool = False,
+                    coo16: bool = False) -> int:
+    """Words in the assignment tail of a packed result buffer — the ONE
+    offset arithmetic the explain-word reader and the parsers share."""
+    if K > 0:
+        return K if coo16 else 2 * K
+    if dense16:
+        return (G * N) // 2
+    return G * N
+
+
+def unpack_reason_words(out: np.ndarray, G: int, N: int, K: int,
+                        dense16: bool = False,
+                        coo16: bool = False) -> np.ndarray | None:
+    """The appended [G] explain reason words of a packed result buffer
+    (karpenter_tpu/explain), or None for a legacy buffer without them
+    (the bare ``_pack_result`` layout direct kernel callers produce)."""
+    off = N + G + 1 + result_tail_len(G, N, K, dense16, coo16)
+    if out.shape[0] < off + G:
+        return None
+    return out[off:off + G]
+
+
 def unpack_result(out: np.ndarray, G: int, N: int, K: int,
                   dense16: bool = False, coo16: bool = False):
     """Host-side inverse of :func:`_pack_result` -> (node_off [N],
-    assign [G,N] int32, unplaced [G], cost float)."""
+    assign [G,N] int32, unplaced [G], cost float).  Tolerates the
+    explain-word suffix (the dense tails slice to their exact length
+    instead of consuming the remainder)."""
     node_off = out[:N]
     unplaced = out[N:N + G]
     cost = float(out[N + G:N + G + 1].view(np.float32)[0])
@@ -488,12 +601,13 @@ def unpack_result(out: np.ndarray, G: int, N: int, K: int,
         idx, cnt = unpack_coo_tail(out, G, N, K, coo16)
         assign = expand_coo_assign(idx, cnt, G, N)
     elif dense16:
+        half = rest[:(G * N) // 2]
         assign = np.empty(G * N, dtype=np.int32)
-        assign[0::2] = rest & 0xFFFF
-        assign[1::2] = (rest >> 16) & 0xFFFF
+        assign[0::2] = half & 0xFFFF
+        assign[1::2] = (half >> 16) & 0xFFFF
         assign = assign.reshape(G, N)
     else:
-        assign = rest.reshape(G, N)
+        assign = rest[:G * N].reshape(G, N)
     return node_off, assign, unplaced, cost
 
 
@@ -547,12 +661,13 @@ def solve_packed(packed, off_alloc, off_price, off_rank, *, G: int, O: int,
     — only the resident path (resident/kernels.solve_resident) keeps a
     problem buffer alive across calls, and it round-trips the donated
     state as an output."""
-    meta, compat_i = _unpack_problem(packed, off_alloc, G, O, U)
+    meta, compat_i, rows_g = _unpack_problem(packed, off_alloc, G, O, U)
     node_off, assign, unplaced, cost = solve_core(
         meta[:, :4], meta[:, 4], meta[:, 5], compat_i > 0,
         off_alloc, off_price, off_rank, num_nodes=N, right_size=right_size)
-    return _pack_result(node_off, assign, unplaced, cost, compact, dense16,
-                        coo16)
+    return _pack_result_explained(meta, rows_g, compat_i, node_off, assign,
+                                  unplaced, cost, off_alloc, compact,
+                                  dense16, coo16)
 
 
 @functools.partial(jax.jit,
@@ -572,14 +687,15 @@ def solve_packed_pref(packed, pref_rows, pref_idx, off_alloc, off_price,
     x 10000, static — a handful of distinct values per process).  The
     pallas fast path gates off on preferences; the FLAT path carries
     them (per-class penalty ranking, solver/flat.py)."""
-    meta, compat_i = _unpack_problem(packed, off_alloc, G, O, U)
+    meta, compat_i, rows_g = _unpack_problem(packed, off_alloc, G, O, U)
     node_off, assign, unplaced, cost = solve_core(
         meta[:, :4], meta[:, 4], meta[:, 5], compat_i > 0,
         off_alloc, off_price, off_rank, num_nodes=N,
         right_size=right_size, pref_rows=pref_rows, pref_idx=pref_idx,
         pref_lambda=lam_bp / 10000.0)
-    return _pack_result(node_off, assign, unplaced, cost, compact, dense16,
-                        coo16)
+    return _pack_result_explained(meta, rows_g, compat_i, node_off, assign,
+                                  unplaced, cost, off_alloc, compact,
+                                  dense16, coo16)
 
 
 @functools.partial(jax.jit,
@@ -596,13 +712,14 @@ def solve_packed_batch(packed_rows, off_alloc, off_price, off_rank, *,
     each, so batching them amortizes the dispatch+fetch round trips that
     dominated the sequential refinement (VERDICT round 2 item 4)."""
     def one(p):
-        meta, compat_i = _unpack_problem(p, off_alloc, G, O, U)
+        meta, compat_i, rows_g = _unpack_problem(p, off_alloc, G, O, U)
         node_off, assign, unplaced, cost = solve_core(
             meta[:, :4], meta[:, 4], meta[:, 5], compat_i > 0,
             off_alloc, off_price, off_rank, num_nodes=N,
             right_size=right_size)
-        return _pack_result(node_off, assign, unplaced, cost, compact,
-                            dense16, coo16)
+        return _pack_result_explained(meta, rows_g, compat_i, node_off,
+                                      assign, unplaced, cost, off_alloc,
+                                      compact, dense16, coo16)
 
     return jax.vmap(one)(packed_rows)
 
@@ -621,12 +738,13 @@ def solve_packed_pallas(packed, alloc8, rank_row, off_price, *, G: int,
     needs is derived on device from the kernel's resident alloc8 layout
     (rows 0..3 = per-resource allocatable) — no extra H2D."""
     off_alloc = alloc8[:4].T                                  # [O, R]
-    meta, compat_i = _unpack_problem(packed, off_alloc, G, O, U)
+    meta, compat_i, rows_g = _unpack_problem(packed, off_alloc, G, O, U)
     node_off, assign, unplaced, cost = _pallas_core(
         meta, compat_i, alloc8, rank_row, off_price,
         G=G, O=O, N=N, right_size=right_size, interpret=interpret)
-    return _pack_result(node_off, assign, unplaced, cost, compact, dense16,
-                        coo16)
+    return _pack_result_explained(meta, rows_g, compat_i, node_off, assign,
+                                  unplaced, cost, off_alloc, compact,
+                                  dense16, coo16)
 
 
 @functools.partial(jax.jit,
@@ -648,21 +766,24 @@ def solve_packed_pallas_batch(packed_rows, alloc8, rank_row, off_price, *,
     from karpenter_tpu.solver.pallas_kernel import ffd_scan_pallas_fleet
 
     off_alloc = alloc8[:4].T                                    # [O, R]
-    metas, compats = jax.vmap(
+    metas, compats, rows = jax.vmap(
         lambda p: _unpack_problem(p, off_alloc, G, O, U))(packed_rows)
     alloc8_all = jnp.broadcast_to(alloc8[None], (C,) + alloc8.shape)
     rank_all = jnp.broadcast_to(rank_row[None], (C,) + rank_row.shape)
     node_off, assign, unplaced = ffd_scan_pallas_fleet(
         metas, compats, alloc8_all, rank_all, C=C, G=G, O=O, N=N)
 
-    def finish_one(meta, compat_i, node_off_c, assign_c, unplaced_c):
+    def finish_one(meta, compat_i, rows_g, node_off_c, assign_c,
+                   unplaced_c):
         node_off_c, cost = finish_pallas_solve(
             meta, compat_i, node_off_c, assign_c, alloc8, rank_row,
             off_price, right_size)
-        return _pack_result(node_off_c, assign_c, unplaced_c, cost,
-                            compact, dense16, coo16)
+        return _pack_result_explained(meta, rows_g, compat_i, node_off_c,
+                                      assign_c, unplaced_c, cost,
+                                      off_alloc, compact, dense16, coo16)
 
-    return jax.vmap(finish_one)(metas, compats, node_off, assign, unplaced)
+    return jax.vmap(finish_one)(metas, compats, rows, node_off, assign,
+                                unplaced)
 
 
 # Non-donated probe twins of the packed entry points, used ONLY by
@@ -939,9 +1060,15 @@ class JaxSolver:
         from karpenter_tpu.solver.flat import dispatch_flat, flat_viable
 
         if problem.num_groups == 0:
-            return PendingSolve(self, problem, done=Plan(
-                nodes=[], unplaced_pods=list(problem.rejected),
-                backend="jax"))
+            done = Plan(nodes=[], unplaced_pods=list(problem.rejected),
+                        backend="jax")
+            if done.unplaced_pods:
+                # all-rejected window (e.g. every pod taint-rejected):
+                # the encoder-time reasons still need folding
+                from karpenter_tpu.explain.decode import attach
+
+                attach(problem, done)
+            return PendingSolve(self, problem, done=done)
         if flat_viable(problem, self.options):
             attempt = dispatch_flat(self, problem)
             if attempt is not None:
@@ -1103,6 +1230,7 @@ class JaxSolver:
             d2h = int(out_np.nbytes)
             metrics.SOLVE_D2H_BYTES.labels("jax").observe(d2h)
             get_devtel().note_d2h(d2h)
+            get_devtel().note_explain_d2h(prep.G_pad * 4)
             # exec_fetch_s spans async device EXECUTION + D2H together (a
             # separate sync before the fetch would cost one more tunnel
             # round trip); pure chip time is measured out-of-band by
@@ -1219,6 +1347,7 @@ class JaxSolver:
         metrics.SOLVE_PATH.labels("scan-batch").inc()
         metrics.SOLVE_D2H_BYTES.labels("jax").observe(int(out_np.nbytes))
         get_devtel().note_d2h(int(out_np.nbytes))
+        get_devtel().note_explain_d2h(C * G_pad * 4)
         get_devtel().note_dispatch(
             "scan-batch",
             (G_pad, O_pad, U_pad, N, C_pad, K, dense16, coo16,
@@ -1230,8 +1359,11 @@ class JaxSolver:
             "exec_fetch_s": t_fetch - t_issued,
             "d2h_bytes": int(out_np.nbytes),
             "h2d_bytes": int(rows.nbytes), "G": G_pad, "O": O_pad, "N": N}
-        return [self._decode(p, no, asg.astype(np.int32), u, c)
-                for p, (no, asg, u, c) in zip(problems, parsed)]
+        return [self._decode(p, no, asg.astype(np.int32), u, c,
+                             unpack_reason_words(out_np[ci], G_pad, N, K,
+                                                 dense16, coo16))
+                for ci, (p, (no, asg, u, c))
+                in enumerate(zip(problems, parsed))]
 
     def compute_handle(self, problem: EncodedProblem):
         """Pure on-chip benchmark handle: returns a zero-arg callable that
@@ -1340,7 +1472,8 @@ class JaxSolver:
                             _pad1(problem.group_count, G_pad),
                             _pad1(problem.group_cap, G_pad),
                             _pad1(label_idx, G_pad),
-                            _pad2(rows, U_pad, O_pad))
+                            _pad2(rows, U_pad, O_pad),
+                            group_prio=_pad1(problem.group_prio, G_pad))
         # K0 is the pod-count COO bound (nnz <= placed pods); the dispatch
         # clamps it against the ACTUAL node axis of each attempt (pallas
         # rounds N up to 128, escalation grows it 4x) — a one-shot clamp
@@ -1597,10 +1730,11 @@ class JaxSolver:
         return cached
 
     def _decode(self, problem: EncodedProblem, node_off, assign, unplaced,
-                cost: float) -> Plan:
+                cost: float, reason_words=None) -> Plan:
         from karpenter_tpu.solver.encode import decode_plan
 
-        return decode_plan(problem, node_off, assign, unplaced, cost, "jax")
+        return decode_plan(problem, node_off, assign, unplaced, cost, "jax",
+                           reason_words=reason_words)
 
 
 class PendingSolve:
@@ -1688,6 +1822,7 @@ class PendingSolve:
             metrics.SOLVE_PATH.labels(path).inc()
             metrics.SOLVE_D2H_BYTES.labels("jax").observe(int(out_np.nbytes))
             get_devtel().note_d2h(int(out_np.nbytes))
+            get_devtel().note_explain_d2h(G * 4)
             solver.last_stats = {
                 "path": path, "wall_s": t_fetch - t_disp,
                 "dispatch_s": t_issued - t_disp,
@@ -1709,19 +1844,21 @@ class PendingSolve:
                        path=path, retry="node_escalation")
                 continue
             t_dec = obs.now()
+            words = unpack_reason_words(out_np, G, N, K, prep.dense16,
+                                        prep.coo16)
             if K > 0:
                 idx, cnt = unpack_coo_tail(out_np, G, N, K, prep.coo16)
                 live = cnt > 0
                 flat_idx = idx[live]
                 self._done = decode_plan_entries(
                     self._problem, node_off, flat_idx % G, flat_idx // G,
-                    cnt[live], unplaced, cost, "jax")
+                    cnt[live], unplaced, cost, "jax", reason_words=words)
             else:
                 _, assign, _, _ = unpack_result(out_np, G, N, K,
                                                 prep.dense16, prep.coo16)
                 self._done = decode_plan(self._problem, node_off,
                                          assign.astype(np.int32), unplaced,
-                                         cost, "jax")
+                                         cost, "jax", reason_words=words)
             _phase("d2h", t_dec, obs.now(), parent=self._span,
                    bytes=int(out_np.nbytes))
             return self._done
@@ -1853,6 +1990,7 @@ class BatchPendingSolve:
             metrics.SOLVE_PATH.labels(self._path).inc()
             metrics.SOLVE_D2H_BYTES.labels("jax").observe(int(out_np.nbytes))
             get_devtel().note_d2h(int(out_np.nbytes))
+            get_devtel().note_explain_d2h(self._C * G * 4)
             solver.last_stats = {
                 "path": self._path, "batch": self._C,
                 "batch_pad": self._C_pad,
@@ -1866,20 +2004,23 @@ class BatchPendingSolve:
             plans = []
             for problem, (row, node_off, unplaced, cost) in zip(
                     self._problems, parsed):
+                words = unpack_reason_words(row, G, N, K, self._dense16,
+                                            self._coo16)
                 if K > 0:
                     idx, cnt = unpack_coo_tail(row, G, N, K, self._coo16)
                     live = cnt > 0
                     fi = idx[live]
                     plans.append(decode_plan_entries(
                         problem, node_off, fi % G, fi // G, cnt[live],
-                        unplaced, cost, "jax"))
+                        unplaced, cost, "jax", reason_words=words))
                 else:
                     _, assign, _, _ = unpack_result(row, G, N, K,
                                                     self._dense16,
                                                     self._coo16)
                     plans.append(decode_plan(problem, node_off,
                                              assign.astype(np.int32),
-                                             unplaced, cost, "jax"))
+                                             unplaced, cost, "jax",
+                                             reason_words=words))
             _phase("d2h", t_dec, obs.now(), parent=self._span,
                    bytes=int(out_np.nbytes), batch=self._C)
             self._done = plans

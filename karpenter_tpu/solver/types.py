@@ -96,6 +96,12 @@ class Plan:
     total_cost_per_hour: float = 0.0
     backend: str = ""
     solve_seconds: float = 0.0
+    # explainability (karpenter_tpu/explain): per-unplaced-pod canonical
+    # reason, raw elimination bitmask, and the nearest-miss offering for
+    # statically-eliminated pods ("would fit if +2 CPU")
+    unplaced_reasons: dict[str, str] = field(default_factory=dict)
+    unplaced_words: dict[str, int] = field(default_factory=dict)
+    unplaced_nearest: dict[str, dict] = field(default_factory=dict)
 
     @property
     def placed_count(self) -> int:

@@ -352,6 +352,37 @@ LEDGER_DROPPED = Counter(
     "(open-record cap; errors are retained in a separate ring and never "
     "evicted by successes)", ())
 
+# Explainability plane (karpenter_tpu/explain): why unplaced pods are
+# unplaced.  UNPLACED_REASONS is the label ALLOWLIST — the reason-label
+# cardinality bound, and one of the three reason enumerations graftlint
+# GL108 keeps drift-free (the others: explain.REASON_BITS and
+# explain.LADDER).  Keep it a pure tuple literal: GL108 reads it from
+# the AST.
+UNPLACED_REASONS = (
+    "insufficient_cpu",
+    "insufficient_mem",
+    "insufficient_accel",
+    "insufficient_pods",
+    "requirements",
+    "taints",
+    "zone_affinity",
+    "zone_blackout",
+    "availability",
+    "preemption_budget",
+    "gang_geometry",
+    "gang_parked",
+    "priority_starved",
+    "capacity_higher_prio",
+    "capacity_exhausted",
+)
+UNPLACED_PODS = Gauge(
+    "karpenter_tpu_unplaced_pods",
+    "Pods currently unplaced by canonical explain reason "
+    "(karpenter_tpu/explain: most-specific-wins fold of the per-group "
+    "elimination bitmask the solve computes on device).  Label "
+    "cardinality is bounded by the UNPLACED_REASONS allowlist; every "
+    "reason renders (0 when empty) so counts never linger.", ("reason",))
+
 # Device telemetry (karpenter_tpu/obs/devtel.py): direct instrumentation
 # for the device-resident-state refactor (ROADMAP item 1).
 JIT_RECOMPILES = Counter(

@@ -1,0 +1,147 @@
+"""Bench trajectory tooling (tools/bench_compare.py) + the bench JSON
+type contracts its comparisons and the target gate depend on.
+
+Pins two ISSUE-9 satellites:
+
+- ``make bench-compare`` reads the BENCH_r*.json trajectory, skips
+  rounds whose ``parsed`` is null, compares the last two parsed rounds,
+  and flags >threshold regressions with the right directionality;
+- bench's skip paths NEVER emit null — ``fleet_pipelined_ms`` is a
+  number or a "skipped: <reason>" string on every path, and
+  ``compute_target_met`` type-switches safely over every input shape a
+  real round can produce (numbers, skip strings, absent sections).
+"""
+
+import json
+
+import pytest
+
+import bench
+from tools.bench_compare import compare, load_rounds, render_table
+
+
+def _wrap(parsed):
+    return {"cmd": "python bench.py", "n": 1, "parsed": parsed, "rc": 0,
+            "tail": ""}
+
+
+@pytest.fixture
+def rounds_dir(tmp_path):
+    (tmp_path / "BENCH_r01.json").write_text(json.dumps(_wrap(None)))
+    (tmp_path / "BENCH_r02.json").write_text(json.dumps(_wrap({
+        "value": 10.0, "repack_tick_max_ms": 500.0,
+        "fleet_pods_per_sec": 1000.0,
+        "fleet_pipelined_ms": "skipped: pallas fleet path not viable "
+                              "on backend 'cpu'",
+        "resident": {"incremental_solve_p50_ms": 4.0},
+    })))
+    (tmp_path / "BENCH_r03.json").write_text(json.dumps(_wrap({
+        "value": 13.0,                      # +30% ms -> regression
+        "repack_tick_max_ms": 400.0,        # improved
+        "fleet_pods_per_sec": 700.0,        # -30% throughput -> regression
+        "fleet_pipelined_ms": 26.5,         # prev was a skip string
+        "resident": {"incremental_solve_p50_ms": 4.2},  # +5% -> ok
+    })))
+    return tmp_path
+
+
+class TestLoadRounds:
+    def test_null_parsed_rounds_skipped(self, rounds_dir):
+        rounds = load_rounds(rounds_dir)
+        assert [n for n, _, doc in rounds if doc] == [2, 3]
+        assert [n for n, _, doc in rounds if not doc] == [1]
+
+    def test_bare_result_file_tolerated(self, tmp_path):
+        (tmp_path / "BENCH_r07.json").write_text(json.dumps(
+            {"value": 5.0, "target_met": {}}))
+        rounds = load_rounds(tmp_path)
+        assert rounds[0][2]["value"] == 5.0
+
+    def test_unreadable_file_is_a_dead_round(self, tmp_path):
+        (tmp_path / "BENCH_r01.json").write_text("{not json")
+        rounds = load_rounds(tmp_path)
+        assert rounds[0][2] is None
+
+
+class TestCompare:
+    def test_directional_regressions(self, rounds_dir):
+        rounds = [r for r in load_rounds(rounds_dir) if r[2]]
+        rows = compare(rounds[-2][2], rounds[-1][2], 0.20)
+        by = {r["metric"]: r for r in rows}
+        assert by["value"]["regression"] is True           # ms up 30%
+        assert by["repack_tick_max_ms"]["regression"] is False
+        assert by["fleet_pods_per_sec"]["regression"] is True
+        assert by["resident.incremental_solve_p50_ms"]["regression"] \
+            is False
+        # a skip STRING on one side is "did not run", never a number
+        assert by["fleet_pipelined_ms"]["delta_pct"] is None
+        assert by["fleet_pipelined_ms"]["regression"] is False
+
+    def test_render_table_readable(self, rounds_dir):
+        rounds = [r for r in load_rounds(rounds_dir) if r[2]]
+        rows = compare(rounds[-2][2], rounds[-1][2], 0.20)
+        table = render_table(rows, rounds[-2][1], rounds[-1][1])
+        assert "REGRESSION" in table and "value" in table
+        assert "BENCH_r02.json -> BENCH_r03.json" in table
+
+    def test_main_informational_exit(self, rounds_dir):
+        from tools.bench_compare import main
+
+        assert main(["--dir", str(rounds_dir)]) == 0
+        assert main(["--dir", str(rounds_dir), "--strict"]) == 1
+
+    def test_fewer_than_two_rounds(self, tmp_path):
+        from tools.bench_compare import main
+
+        assert main(["--dir", str(tmp_path)]) == 0
+
+
+class TestBenchSkipContract:
+    def test_fleet_pipelined_value_never_null(self):
+        assert bench.fleet_pipelined_value(0.0265, "") == 26.5
+        v = bench.fleet_pipelined_value(0.0, "skipped: no pallas")
+        assert v == "skipped: no pallas"
+        v = bench.fleet_pipelined_value(0.0, "")
+        assert isinstance(v, str) and v.startswith("skipped:")
+
+    def test_target_met_inputs_never_null(self):
+        """Every value the gate emits is True/False/None; no input shape
+        a real round produces (skip strings, absent sections, zeroes)
+        may raise or leak a null COMPARISON into a gate that claims to
+        have run."""
+        shapes = [
+            {},                                           # everything absent
+            {"value": 3.2, "vs_baseline": 21.0,
+             "cost_ratio": 0.98,
+             "fleet_wall_ms": 50.0, "fleet_grouped_host_ms": 100.0,
+             "fleet_pipelined_ms": "skipped: pallas fleet path not "
+                                   "viable on backend 'cpu'"},
+            {"value": 3.2, "fleet_wall_ms": 50.0,
+             "fleet_grouped_host_ms": 100.0,
+             "fleet_pipelined_ms": 26.5},
+            {"explain": {"parity": True, "extra_dispatches": 0,
+                         "consistency_violations": 0, "unplaced": 3,
+                         "d2h_fraction": 0.004}},
+            {"resident": {"parity": True, "warm_h2d_max_bytes": 512,
+                          "full_packed_bytes": 4096}},
+        ]
+        for result in shapes:
+            gates = bench.compute_target_met(result)
+            assert isinstance(gates, dict) and gates
+            for name, value in gates.items():
+                assert value in (True, False, None), (name, value)
+
+    def test_target_met_gates_fire(self):
+        gates = bench.compute_target_met({
+            "explain": {"parity": True, "extra_dispatches": 0,
+                        "consistency_violations": 0, "unplaced": 5,
+                        "d2h_fraction": 0.003}})
+        assert gates["explain_overhead_bounded"] is True
+        gates = bench.compute_target_met({
+            "explain": {"parity": False, "extra_dispatches": 0,
+                        "consistency_violations": 0, "unplaced": 5,
+                        "d2h_fraction": 0.003}})
+        assert gates["explain_overhead_bounded"] is False
+        # absent section -> None ("did not run"), not a phantom False
+        assert bench.compute_target_met({})["explain_overhead_bounded"] \
+            is None

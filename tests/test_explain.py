@@ -1,0 +1,585 @@
+"""Placement explainability (karpenter_tpu/explain, ISSUE 9).
+
+Covers the whole plane:
+
+- taxonomy invariants (bit table / ladder / metrics allowlist agree,
+  most-specific-wins fold order);
+- the DEVICE reason words vs the host oracle — bit-identical across
+  seeded differential sequences on both the packed scan path and the
+  greedy backend (the parity contract, same discipline as preempt/gang);
+- the decode-side static refinement (requirements / availability /
+  zone_affinity / zone_blackout) and nearest-miss payload;
+- the consistency oracle (a reason contradicting ground truth is
+  flagged);
+- end-to-end wiring: provisioner registry/ledger/gauge/event flow,
+  reason-tagged ledger outcomes, metrics-render cardinality bound, and
+  export round-trips of the explain.fold span (JSONL + Chrome, parent
+  linkage).
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+from karpenter_tpu import obs
+from karpenter_tpu.apis.pod import PodSpec, ResourceRequests
+from karpenter_tpu.apis.requirements import (
+    LABEL_INSTANCE_TYPE, LABEL_ZONE,
+)
+from karpenter_tpu.catalog import (
+    CatalogArrays, InstanceTypeProvider, PricingProvider,
+)
+from karpenter_tpu.cloud.fake import FakeCloud
+from karpenter_tpu.explain import (
+    BIT, CANONICAL_REASONS, DEVICE_BITS, LADDER, REASON_BITS,
+    ExplainRegistry, fold_reason, get_registry, word_for, word_names,
+)
+from karpenter_tpu.explain.greedy import nearest_miss, reason_words
+from karpenter_tpu.explain.validate import (
+    DYNAMIC_REASONS, STATIC_REASONS, check_plan_reasons,
+)
+from karpenter_tpu.solver import (
+    GreedySolver, JaxSolver, SolveRequest, encode,
+)
+from karpenter_tpu.solver.types import SolverOptions
+from karpenter_tpu.utils import metrics
+
+
+@pytest.fixture(scope="module")
+def catalog():
+    cloud = FakeCloud()
+    pricing = PricingProvider(cloud)
+    itp = InstanceTypeProvider(cloud, pricing)
+    arrays = CatalogArrays.build(itp.list())
+    pricing.close()
+    return arrays
+
+
+@pytest.fixture(autouse=True)
+def _clean_registry():
+    get_registry().clear()
+    yield
+    get_registry().clear()
+
+
+class TestTaxonomy:
+    def test_three_enumerations_agree(self):
+        names = {n for n, _ in REASON_BITS}
+        assert names == set(LADDER)
+        assert names == set(metrics.UNPLACED_REASONS)
+        assert names == set(CANONICAL_REASONS)
+
+    def test_bits_unique_and_dense(self):
+        idxs = [i for _, i in REASON_BITS]
+        assert idxs == sorted(set(idxs))
+        assert max(idxs) < 31          # int32 words, sign bit never used
+
+    def test_device_bits_subset(self):
+        assert DEVICE_BITS <= {n for n, _ in REASON_BITS}
+        assert STATIC_REASONS | DYNAMIC_REASONS <= set(CANONICAL_REASONS)
+
+    def test_fold_most_specific_wins(self):
+        w = word_for("insufficient_mem", "capacity_exhausted")
+        assert fold_reason(w) == "insufficient_mem"
+        w = word_for("gang_parked", "requirements", "capacity_exhausted")
+        assert fold_reason(w) == "gang_parked"
+        assert fold_reason(0) == "capacity_exhausted"
+
+    def test_word_names_round_trip(self):
+        w = word_for("taints", "zone_blackout")
+        assert word_names(w) == ["taints", "zone_blackout"]
+
+
+def _scarce_pods(rng, n, *, hi_frac=0.5):
+    pods = []
+    for i in range(n):
+        hi = rng.rand() < hi_frac
+        cpu, mem = [(2000, 8192), (4000, 16384)][rng.randint(2)]
+        pods.append(PodSpec(f"s{i}",
+                            requests=ResourceRequests(cpu, mem, 0, 1),
+                            priority=100 if hi else 0))
+    return pods
+
+
+class TestDeviceHostParity:
+    """The acceptance bar: device words bit-identical to the host
+    oracle across >=8 seeded differential sequences on both backends."""
+
+    SEEDS = range(8)
+
+    def _workload(self, catalog, seed):
+        rng = np.random.RandomState(seed)
+        pods = _scarce_pods(rng, 120)
+        pods.append(PodSpec(f"huge{seed}", requests=ResourceRequests(
+            40_000_000, 800_000_000, 0, 1)))
+        pods.append(PodSpec(f"nolabel{seed}",
+                            requests=ResourceRequests(500, 1024, 0, 1),
+                            node_selector=((LABEL_INSTANCE_TYPE,
+                                            "absent-type"),)))
+        return pods
+
+    @pytest.mark.parametrize("seed", SEEDS)
+    def test_jax_plan_matches_greedy_plan(self, catalog, seed):
+        pods = self._workload(catalog, seed)
+        # clamped node budget: the low-priority tail must starve, so
+        # capacity words (incl. capacity_higher_prio) are exercised
+        jopt = SolverOptions(backend="jax", max_nodes=64,
+                             adaptive_nodes=False)
+        gopt = SolverOptions(backend="greedy", use_native="off",
+                             max_nodes=64, adaptive_nodes=False)
+        req = SolveRequest(pods, catalog)
+        jp = JaxSolver(jopt).solve(req)
+        gp = GreedySolver(gopt).solve(req)
+        assert jp.unplaced_pods and set(jp.unplaced_pods) \
+            == set(gp.unplaced_pods)
+        assert jp.unplaced_words == gp.unplaced_words      # bit-identical
+        assert jp.unplaced_reasons == gp.unplaced_reasons
+
+    @pytest.mark.parametrize("seed", SEEDS)
+    def test_device_words_match_oracle_raw(self, catalog, seed):
+        """Below the plan layer: the packed kernel's appended words
+        equal the oracle run on the same per-group unplaced counts."""
+        from karpenter_tpu.solver.jax_backend import (
+            _pad1, _pad2, dedup_rows, pack_input, solve_packed,
+            unpack_reason_words, unpack_result,
+        )
+        from karpenter_tpu.solver.types import (
+            GROUP_BUCKETS, LABELROW_BUCKETS, OFFERING_BUCKETS, bucket,
+        )
+
+        pods = self._workload(catalog, seed)
+        problem = encode(pods, catalog)
+        G = bucket(problem.num_groups, GROUP_BUCKETS)
+        O = bucket(catalog.num_offerings, OFFERING_BUCKETS)
+        # mirror _prepare's factoring choice exactly: the encoder's
+        # fit-free label rows when present (dedup_rows folds fit in,
+        # which collapses insufficiency into the generic static bit)
+        if problem.label_rows is not None:
+            rows, label_idx = problem.label_rows, problem.label_idx
+        else:
+            label_idx, rows = dedup_rows(problem.compat)
+        U = bucket(max(rows.shape[0], 1), LABELROW_BUCKETS)
+        packed = pack_input(_pad2(problem.group_req, G),
+                            _pad1(problem.group_count, G),
+                            _pad1(problem.group_cap, G),
+                            _pad1(label_idx, G), _pad2(rows, U, O),
+                            group_prio=_pad1(problem.group_prio, G))
+        off_alloc = _pad2(catalog.offering_alloc().astype(np.int32), O)
+        off_price = _pad1(catalog.off_price.astype(np.float32), O)
+        off_rank = _pad1(catalog.offering_rank_price(), O)
+        N = 64
+        out = np.asarray(solve_packed(packed, off_alloc, off_price,
+                                      off_rank, G=G, O=O, U=U, N=N))
+        _, _, unplaced, _ = unpack_result(out, G, N, 0)
+        dev_words = unpack_reason_words(out, G, N, 0)
+        assert dev_words is not None
+        oracle = reason_words(problem, unplaced)
+        np.testing.assert_array_equal(
+            dev_words[:problem.num_groups], oracle)
+        # padding groups never carry evidence
+        assert (dev_words[problem.num_groups:] == 0).all()
+
+
+class TestStaticRefinement:
+    def test_zone_affinity_refined(self, catalog):
+        # a zone selector naming a zone with no offerings
+        pod = PodSpec("zoned", requests=ResourceRequests(500, 1024, 0, 1),
+                      node_selector=((LABEL_ZONE, "us-south-99"),))
+        plan = GreedySolver(SolverOptions(use_native="off")).solve(
+            SolveRequest([pod], catalog))
+        assert plan.unplaced_reasons == {"default/zoned": "zone_affinity"}
+
+    def test_zone_blackout_refined(self, catalog):
+        import copy
+
+        view = copy.copy(catalog)
+        view.off_avail = catalog.off_avail.copy()
+        # black out EVERY offering in zone us-south-1
+        view.off_avail[np.asarray(catalog.off_zone) ==
+                       catalog.zones.index("us-south-1")] = False
+        view.uid = f"{catalog.uid}-blackout-test"
+        view.availability_generation = ("test-blackout",)
+        pod = PodSpec("dark", requests=ResourceRequests(500, 1024, 0, 1),
+                      node_selector=((LABEL_ZONE, "us-south-1"),))
+        plan = GreedySolver(SolverOptions(use_native="off")).solve(
+            SolveRequest([pod], view))
+        assert plan.unplaced_reasons == {"default/dark": "zone_blackout"}
+
+    def test_availability_refined(self, catalog):
+        import copy
+
+        view = copy.copy(catalog)
+        view.off_avail = np.zeros_like(catalog.off_avail)
+        view.uid = f"{catalog.uid}-allout-test"
+        view.availability_generation = ("test-allout",)
+        pod = PodSpec("quota", requests=ResourceRequests(500, 1024, 0, 1))
+        plan = GreedySolver(SolverOptions(use_native="off")).solve(
+            SolveRequest([pod], view))
+        assert plan.unplaced_reasons == {"default/quota": "availability"}
+
+    def test_requirements_refined(self, catalog):
+        pod = PodSpec("never", requests=ResourceRequests(500, 1024, 0, 1),
+                      node_selector=((LABEL_INSTANCE_TYPE, "no-such"),))
+        plan = GreedySolver(SolverOptions(use_native="off")).solve(
+            SolveRequest([pod], catalog))
+        assert plan.unplaced_reasons == {"default/never": "requirements"}
+
+    def test_taint_reject_reason(self, catalog):
+        from karpenter_tpu.apis.nodeclaim import NodePool
+        from karpenter_tpu.apis.pod import Taint
+
+        pool = NodePool(name="tainted",
+                        taints=(Taint("dedicated", "gpu", "NoSchedule"),))
+        pod = PodSpec("plain", requests=ResourceRequests(500, 1024, 0, 1))
+        plan = GreedySolver(SolverOptions(use_native="off")).solve(
+            SolveRequest([pod], catalog, pool))
+        assert plan.unplaced_reasons == {"default/plain": "taints"}
+
+    def test_nearest_miss_payload(self, catalog):
+        pod = PodSpec("big", requests=ResourceRequests(
+            9_000_000, 512, 0, 1))
+        problem = encode([pod], catalog)
+        near = nearest_miss(problem, 0)
+        assert near is not None
+        assert near["instance_type"]
+        assert near["deficits"].get("cpu_milli", 0) > 0
+        assert "memory_mib" not in near["deficits"]   # mem fits
+
+    def test_insufficiency_bits_name_failing_dims(self, catalog):
+        pod = PodSpec("wide", requests=ResourceRequests(
+            9_000_000, 900_000_000, 0, 1))
+        plan = GreedySolver(SolverOptions(use_native="off")).solve(
+            SolveRequest([pod], catalog))
+        word = plan.unplaced_words["default/wide"]
+        names = set(word_names(word))
+        assert {"insufficient_cpu", "insufficient_mem"} <= names
+        # the canonical fold picks ONE (ladder: mem outranks cpu)
+        assert plan.unplaced_reasons["default/wide"] == "insufficient_mem"
+
+
+class TestConsistencyOracle:
+    def test_clean_plan_passes(self, catalog):
+        pods = [PodSpec("ok", requests=ResourceRequests(500, 1024, 0, 1)),
+                PodSpec("huge", requests=ResourceRequests(
+                    40_000_000, 800_000_000, 0, 1))]
+        problem = encode(pods, catalog)
+        plan = GreedySolver(SolverOptions(use_native="off")).solve(
+            SolveRequest(pods, catalog))
+        assert check_plan_reasons(problem, plan) == []
+
+    def test_static_lie_flagged(self, catalog):
+        """A placeable pod blamed on a static reason is the classic
+        lie: 'requirements' while a feasible offering sits open."""
+        pods = [PodSpec("fine", requests=ResourceRequests(500, 1024, 0, 1))]
+        problem = encode(pods, catalog)
+        plan = GreedySolver(SolverOptions(use_native="off")).solve(
+            SolveRequest(pods, catalog))
+        # forge an unplaced verdict with a static reason
+        plan.unplaced_pods = ["default/fine"]
+        plan.unplaced_reasons = {"default/fine": "requirements"}
+        out = check_plan_reasons(problem, plan)
+        assert len(out) == 1 and "static" in out[0]
+
+    def test_dynamic_lie_flagged(self, catalog):
+        pods = [PodSpec("huge", requests=ResourceRequests(
+            40_000_000, 800_000_000, 0, 1))]
+        problem = encode(pods, catalog)
+        plan = GreedySolver(SolverOptions(use_native="off")).solve(
+            SolveRequest(pods, catalog))
+        plan.unplaced_reasons = {"default/huge": "capacity_exhausted"}
+        out = check_plan_reasons(problem, plan)
+        assert len(out) == 1 and "dynamic" in out[0]
+
+    def test_missing_reason_flagged(self, catalog):
+        pods = [PodSpec("huge", requests=ResourceRequests(
+            40_000_000, 800_000_000, 0, 1))]
+        problem = encode(pods, catalog)
+        plan = GreedySolver(SolverOptions(use_native="off")).solve(
+            SolveRequest(pods, catalog))
+        plan.unplaced_reasons = {}
+        out = check_plan_reasons(problem, plan)
+        assert len(out) == 1 and "no reason" in out[0]
+
+    def test_unknown_reason_flagged(self, catalog):
+        pods = [PodSpec("huge", requests=ResourceRequests(
+            40_000_000, 800_000_000, 0, 1))]
+        problem = encode(pods, catalog)
+        plan = GreedySolver(SolverOptions(use_native="off")).solve(
+            SolveRequest(pods, catalog))
+        plan.unplaced_reasons = {"default/huge": "cosmic_rays"}
+        out = check_plan_reasons(problem, plan)
+        assert len(out) == 1 and "allowlist" in out[0]
+
+
+class TestRegistry:
+    def test_note_merge_and_fold(self):
+        reg = ExplainRegistry(capacity=4)
+        changed = reg.note("a", word_for("capacity_exhausted"),
+                           "capacity_exhausted")
+        assert changed
+        # controller stamp layers on top; gang outranks capacity
+        assert reg.stamp("a", "gang_parked")
+        e = reg.get("a")
+        assert e.reason == "gang_parked"
+        assert set(word_names(e.word)) == {"capacity_exhausted",
+                                           "gang_parked"}
+        # same verdict again: no change signal
+        assert not reg.stamp("a", "gang_parked")
+
+    def test_bounded_fifo(self):
+        reg = ExplainRegistry(capacity=3)
+        for i in range(5):
+            reg.note(f"p{i}", 1, "requirements")
+        assert reg.get("p0") is None and reg.get("p4") is not None
+        assert len(reg.entries()) == 3
+
+    def test_resolve_prunes(self):
+        reg = ExplainRegistry()
+        reg.note("a", 1, "requirements")
+        reg.resolve("a")
+        assert reg.get("a") is None and reg.summary() == {}
+
+    def test_gauge_full_allowlist(self):
+        reg = ExplainRegistry()
+        reg.note("a", word_for("gang_parked"), "gang_parked")
+        reg.update_unplaced_gauge()
+        samples = metrics.UNPLACED_PODS.samples()
+        # EVERY canonical reason renders; absent ones render 0
+        assert {k[0] for k in samples} == set(metrics.UNPLACED_REASONS)
+        assert samples[("gang_parked",)] == 1.0
+        reg.resolve("a")
+        reg.update_unplaced_gauge()
+        assert metrics.UNPLACED_PODS.samples()[("gang_parked",)] == 0.0
+
+
+class TestEndToEndWindow:
+    """Provisioner wiring: an unplaceable pod flows into the registry,
+    the ledger's unplaced outcome, the gauge, and a Warning event."""
+
+    def _rig(self):
+        from karpenter_tpu.core.actuator import Actuator
+        from karpenter_tpu.core.cluster import ClusterState
+        from karpenter_tpu.core.provisioner import (
+            Provisioner, ProvisionerOptions,
+        )
+        from karpenter_tpu.apis.nodeclass import (
+            InstanceRequirements, NodeClass, NodeClassSpec,
+            PlacementStrategy,
+        )
+        from karpenter_tpu.catalog.instancetype import InstanceTypeProvider
+        from karpenter_tpu.catalog.pricing import PricingProvider
+
+        cloud = FakeCloud()
+        cluster = ClusterState()
+        pricing = PricingProvider(cloud)
+        itp = InstanceTypeProvider(cloud, pricing)
+        cluster.add_nodeclass(NodeClass(name="default", spec=NodeClassSpec(
+            region="us-south", image="img-1", vpc="vpc-1",
+            instance_requirements=InstanceRequirements(),
+            placement_strategy=PlacementStrategy())))
+        actuator = Actuator(cloud, cluster)
+        prov = Provisioner(cluster, itp, actuator,
+                           ProvisionerOptions(
+                               solver=SolverOptions(backend="greedy",
+                                                    use_native="off")))
+        return cluster, prov, pricing
+
+    def test_window_records_unplaced(self):
+        cluster, prov, pricing = self._rig()
+        try:
+            cluster.add_pod(PodSpec("ok", requests=ResourceRequests(
+                500, 1024, 0, 1)))
+            cluster.add_pod(PodSpec("stuck", requests=ResourceRequests(
+                40_000_000, 800_000_000, 0, 1)))
+            prov.provision_once()
+            entry = get_registry().get("default/stuck")
+            assert entry is not None
+            assert entry.reason.startswith("insufficient_")
+            assert entry.nearest is not None
+            # placed pod never enters the registry
+            assert get_registry().get("default/ok") is None
+            # ledger stamped the unplaced outcome with the reason
+            rec = obs.get_ledger().get("default/stuck")
+            assert rec is not None
+            assert any(n.startswith("unplaced:insufficient_")
+                       for n in rec.stamp_names())
+            # Warning event carries the reason
+            events = [e for e in cluster.events_for("Pod", "default/stuck")
+                      if e.reason == "Unplaced"]
+            assert events and "insufficient_" in events[0].message
+            # gauge refreshed over the allowlist
+            samples = metrics.UNPLACED_PODS.samples()
+            assert sum(samples.values()) >= 1.0
+            # a SECOND window with the same verdict: event deduped
+            prov.provision_once()
+            events2 = [e for e in cluster.events_for("Pod",
+                                                     "default/stuck")
+                       if e.reason == "Unplaced"]
+            assert len(events2) == len(events)
+        finally:
+            pricing.close()
+
+    def test_pool_budget_exhausted_gets_verdict(self):
+        """A pool whose cpu/mem budget is fully consumed skips the solve
+        entirely — its pods must STILL carry a verdict (the 'unplaced
+        with no why' gap the subsystem exists to close)."""
+        from karpenter_tpu.apis.nodeclaim import NodeClaim, NodePool
+
+        cluster, prov, pricing = self._rig()
+        try:
+            cluster.add_nodepool(NodePool(name="tight",
+                                          nodeclass_name="default",
+                                          cpu_limit_milli=1))
+            cluster.add_nodeclaim(NodeClaim(
+                name="eats-budget", nodepool_name="tight",
+                instance_type="bx2-4x16", zone="us-south-1",
+                launched=True))
+            cluster.add_pod(PodSpec("budgeted",
+                                    requests=ResourceRequests(
+                                        500, 1024, 0, 1)))
+            prov.provision_once()
+            pending = cluster.get("pods", "default/budgeted")
+            if not pending.nominated_node:   # budget really blocked it
+                entry = get_registry().get("default/budgeted")
+                assert entry is not None
+                assert entry.reason == "capacity_exhausted"
+        finally:
+            pricing.close()
+
+    def test_gauge_zeroes_when_pod_places(self):
+        """'Counts never linger': the window that places the previously
+        stuck pod must zero its reason's gauge."""
+        cluster, prov, pricing = self._rig()
+        try:
+            cluster.add_pod(PodSpec("flappy", requests=ResourceRequests(
+                40_000_000, 800_000_000, 0, 1)))
+            prov.provision_once()
+            reason = get_registry().get("default/flappy").reason
+            assert metrics.UNPLACED_PODS.get(reason) == 1.0
+            # the pod resolves (bound out-of-band): next window must
+            # refresh the gauge to zero even though it produced no
+            # fresh verdicts
+            cluster.bind_pod("default/flappy", "node-external")
+            get_registry().resolve("default/flappy")
+            cluster.add_pod(PodSpec("easy", requests=ResourceRequests(
+                500, 1024, 0, 1)))
+            prov.provision_once()
+            assert metrics.UNPLACED_PODS.get(reason) == 0.0
+        finally:
+            pricing.close()
+
+    def test_pod_placement_unplaced_outcome_observed(self):
+        before = metrics.POD_PLACEMENT.count("unplaced")
+        cluster, prov, pricing = self._rig()
+        try:
+            cluster.add_pod(PodSpec("stuck2", requests=ResourceRequests(
+                40_000_000, 800_000_000, 0, 1)))
+            prov.provision_once()
+            assert metrics.POD_PLACEMENT.count("unplaced") == before + 1
+        finally:
+            pricing.close()
+
+
+class TestMetricsRender:
+    def test_unplaced_family_renders_with_bounded_cardinality(self):
+        get_registry().note("x", word_for("zone_blackout"),
+                            "zone_blackout")
+        get_registry().update_unplaced_gauge()
+        text = metrics.render()
+        lines = [ln for ln in text.splitlines()
+                 if ln.startswith("karpenter_tpu_unplaced_pods{")]
+        assert len(lines) == len(metrics.UNPLACED_REASONS)
+        rendered = {ln.split('reason="')[1].split('"')[0] for ln in lines}
+        assert rendered == set(metrics.UNPLACED_REASONS)
+        assert 'karpenter_tpu_unplaced_pods{reason="zone_blackout"} 1' \
+            in text
+
+
+class TestExportRoundTrip:
+    def test_explain_fold_span_round_trips(self, catalog, tmp_path):
+        from karpenter_tpu.obs.export import (
+            dicts_to_chrome, dump_jsonl, load_jsonl, recorder_to_dicts,
+        )
+
+        obs.reset_recorder(capacity=64)
+        pods = [PodSpec("huge", requests=ResourceRequests(
+            40_000_000, 800_000_000, 0, 1))]
+        with obs.span("provision.cycle", pods=1) as root:
+            GreedySolver(SolverOptions(use_native="off")).solve(
+                SolveRequest(pods, catalog))
+            trace_id = root.trace_id
+        dicts = recorder_to_dicts(obs.get_recorder())
+        folds = [d for d in dicts if d["name"] == "explain.fold"]
+        assert folds, f"no explain.fold span in {[d['name'] for d in dicts]}"
+        fold = folds[0]
+        assert fold["trace_id"] == trace_id          # parent linkage
+        assert fold["attrs"]["unplaced"] == 1
+        # JSONL round trip
+        p = dump_jsonl(dicts, tmp_path / "spans.jsonl")
+        assert any(d["name"] == "explain.fold" for d in load_jsonl(p))
+        # Chrome export carries the fold as a complete event
+        chrome = dicts_to_chrome(dicts)
+        names = {e["name"] for e in chrome["traceEvents"]}
+        assert "explain.fold" in names
+
+    def test_ledger_reason_outcome_in_record_dict(self, catalog):
+        ledger = obs.get_ledger()
+        ledger.first_seen("default/tagged")
+        ledger.unplaced("default/tagged", "zone_blackout")
+        rec = ledger.get("default/tagged")
+        d = rec.to_dict()
+        assert any(n == "unplaced:zone_blackout"
+                   for n, _ in d["stamps"])
+        assert json.loads(json.dumps(d))  # JSON-safe
+        ledger.resolve("default/tagged", "placed")
+
+
+class TestTraceIdLookup:
+    def test_debug_traces_exact_lookup(self):
+        from karpenter_tpu.obs.export import debug_traces
+
+        obs.reset_recorder(capacity=32)
+        with obs.span("provision.cycle") as a:
+            tid_a = a.trace_id
+        with obs.span("provision.cycle") as b:
+            tid_b = b.trace_id
+        out = debug_traces(obs.get_recorder(), trace_id=tid_a)
+        assert [t["trace_id"] for t in out["traces"]] == [tid_a]
+        out = debug_traces(obs.get_recorder(), trace_id=tid_b,
+                           min_duration_ms=1e9)   # filters ignored
+        assert [t["trace_id"] for t in out["traces"]] == [tid_b]
+        out = debug_traces(obs.get_recorder(), trace_id=999999)
+        assert out["traces"] == []
+
+
+class TestChaosExplainHook:
+    def test_validating_solver_accumulates_contradictions(self, catalog):
+        from karpenter_tpu.chaos.solver import ValidatingSolver
+
+        class LyingSolver:
+            options = SolverOptions(backend="greedy", use_native="off")
+
+            def solve(self, request):
+                plan = GreedySolver(self.options).solve(request)
+                for pn in plan.unplaced_pods:
+                    plan.unplaced_reasons[pn] = "capacity_exhausted"
+                return plan
+
+        vs = ValidatingSolver(LyingSolver())
+        pods = [PodSpec("huge", requests=ResourceRequests(
+            40_000_000, 800_000_000, 0, 1))]
+        vs.solve(SolveRequest(pods, catalog))
+        assert vs.explain_violations
+        assert "dynamic" in vs.explain_violations[0]
+
+    def test_honest_solver_clean(self, catalog):
+        from karpenter_tpu.chaos.solver import ValidatingSolver
+
+        vs = ValidatingSolver(GreedySolver(SolverOptions(
+            use_native="off")))
+        pods = [PodSpec("huge", requests=ResourceRequests(
+            40_000_000, 800_000_000, 0, 1)),
+                PodSpec("ok", requests=ResourceRequests(500, 1024, 0, 1))]
+        vs.solve(SolveRequest(pods, catalog))
+        assert vs.explain_violations == []

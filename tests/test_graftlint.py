@@ -899,3 +899,77 @@ def test_cli_exit_codes(tmp_path: Path):
     assert data["files_checked"] > 0
     assert not data["new"]
     assert data["rules"] and "GL001" in data["rules"]
+
+
+# -- GL108: reason-enum drift (karpenter_tpu/explain) -----------------------
+
+EXPLAIN_PATH = "karpenter_tpu/explain/__init__.py"
+
+_GOOD_EXPLAIN = """
+REASON_BITS = (
+    ("requirements", 0),
+    ("taints", 1),
+)
+LADDER = (
+    "taints",
+    "requirements",
+)
+"""
+
+_GOOD_METRICS = """
+UNPLACED_REASONS = (
+    "requirements",
+    "taints",
+)
+"""
+
+
+def test_gl108_internal_drift_bad():
+    assert_flags(
+        """
+        REASON_BITS = (
+            ("requirements", 0),
+            ("taints", 1),
+        )
+        LADDER = (
+            "requirements",
+        )
+        """, "GL108", EXPLAIN_PATH)
+
+
+def test_gl108_missing_tuples_bad():
+    assert_flags("REASONS = 1\n", "GL108", EXPLAIN_PATH)
+
+
+def test_gl108_computed_tuple_bad():
+    # a computed value defeats the AST check and must be flagged, not
+    # silently accepted
+    assert_flags(
+        """
+        REASON_BITS = tuple(("requirements", i) for i in range(1))
+        LADDER = ("requirements",)
+        """, "GL108", EXPLAIN_PATH)
+
+
+def test_gl108_cross_file_fixture_pair():
+    from tools.graftlint.rules.observability import reason_sets_from_sources
+
+    assert reason_sets_from_sources(_GOOD_EXPLAIN, _GOOD_METRICS) == []
+    drifted = _GOOD_METRICS.replace('"taints",', '"quota",')
+    problems = reason_sets_from_sources(_GOOD_EXPLAIN, drifted)
+    assert problems and "UNPLACED_REASONS drift" in problems[0]
+
+
+def test_gl108_real_repo_consistent():
+    root = Path(__file__).resolve().parents[1]
+    from tools.graftlint.rules.observability import reason_sets_from_sources
+
+    assert reason_sets_from_sources(
+        (root / "karpenter_tpu/explain/__init__.py").read_text(),
+        (root / "karpenter_tpu/utils/metrics.py").read_text()) == []
+
+
+def test_gl108_metrics_without_allowlist_clean():
+    # metrics fixtures without the explain plane are out of scope
+    assert_clean("SOLVE_PATH = 1\n", "GL108",
+                 "karpenter_tpu/utils/metrics.py")

@@ -66,8 +66,8 @@ class TestPackUnpack:
         assert packed.dtype == np.int32
         assert packed.shape == (G * 8 + U * O // 32,)
         off_alloc = _pad2(catalog.offering_alloc().astype(np.int32), O)
-        meta, compat_i = jax.jit(_unpack_problem,
-                                 static_argnums=(2, 3, 4))(
+        meta, compat_i, rows_g = jax.jit(_unpack_problem,
+                                         static_argnums=(2, 3, 4))(
             packed, off_alloc, G, O, U)
         np.testing.assert_array_equal(np.asarray(meta)[:, :4], req)
         np.testing.assert_array_equal(np.asarray(meta)[:, 4], cnt)

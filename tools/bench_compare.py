@@ -1,0 +1,171 @@
+"""Bench trajectory diff: the newest BENCH_r*.json vs its predecessor.
+
+The repo accumulates one ``BENCH_rNN.json`` per round but nothing read
+the trajectory automatically — a 2x regression on a headline metric
+only surfaced if a human happened to diff the JSON.  This tool compares
+the two most recent rounds WITH PARSED RESULTS on a curated metric
+table (headline solve, repack, fleet, preempt, gang, resident, explain)
+and flags any metric that moved more than ``--threshold`` (default 20%)
+in its bad direction.
+
+Informational by default (exit 0 — CI runs it as a non-blocking step so
+a noisy TPU round can't block merges); ``--strict`` exits 1 on
+regressions.  Run via ``make bench-compare``.
+
+Bench round files are ``{"cmd", "n", "parsed", "rc", "tail"}`` wrappers
+(the driver's capture shape); ``parsed`` may be null when a round died
+— those rounds are skipped with a note, never compared.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import re
+import sys
+from pathlib import Path
+
+# (dotted key, direction) — direction "lower" = lower is better (ms,
+# bytes), "higher" = higher is better (throughput, speedups, ratios
+# where bigger means faster)
+METRICS: tuple[tuple[str, str], ...] = (
+    ("value", "lower"),                         # headline pipelined ms
+    ("single_shot_p50_ms", "lower"),
+    ("compute_ms", "lower"),
+    ("encode_cold_ms", "lower"),
+    ("encode_warm_ms", "lower"),
+    ("vs_baseline", "higher"),
+    ("vs_baseline_compute", "higher"),
+    ("hetero_pipelined_ms", "lower"),
+    ("hetero_vs_baseline", "higher"),
+    ("repack_tick_p50_ms", "lower"),
+    ("repack_tick_max_ms", "lower"),
+    ("fleet_pods_per_sec", "higher"),
+    ("fleet_pipelined_ms", "lower"),
+    ("fleet_compute_ms", "lower"),
+    ("preempt_plan_warm_p50_ms", "lower"),
+    ("gang_plan_warm_p50_ms", "lower"),
+    ("resident.incremental_solve_p50_ms", "lower"),
+    ("resident.warm_h2d_max_bytes", "lower"),
+    ("explain.solve_warm_p50_ms", "lower"),
+    ("explain.d2h_fraction", "lower"),
+)
+
+_ROUND_RE = re.compile(r"BENCH_r(\d+)\.json$")
+
+
+def _get(doc: dict, dotted: str):
+    cur = doc
+    for part in dotted.split("."):
+        if not isinstance(cur, dict) or part not in cur:
+            return None
+        cur = cur[part]
+    # skip-string values ("skipped: ...") and other non-numerics are
+    # "did not run", not zero
+    return cur if isinstance(cur, (int, float)) \
+        and not isinstance(cur, bool) else None
+
+
+def load_rounds(root: Path) -> list[tuple[int, str, dict | None]]:
+    """(round number, filename, parsed result or None), ascending."""
+    out = []
+    for p in sorted(root.glob("BENCH_r*.json")):
+        m = _ROUND_RE.search(p.name)
+        if not m:
+            continue
+        try:
+            doc = json.loads(p.read_text())
+        except (OSError, ValueError):
+            out.append((int(m.group(1)), p.name, None))
+            continue
+        parsed = doc.get("parsed") if isinstance(doc, dict) else None
+        # tolerate bare result files (no driver wrapper)
+        if parsed is None and isinstance(doc, dict) and "target_met" in doc:
+            parsed = doc
+        out.append((int(m.group(1)), p.name,
+                    parsed if isinstance(parsed, dict) else None))
+    out.sort(key=lambda r: r[0])
+    return out
+
+
+def compare(prev: dict, cur: dict, threshold: float) -> list[dict]:
+    """Per-metric comparison rows; ``regression`` True when the metric
+    moved more than ``threshold`` (fraction) in its bad direction."""
+    rows = []
+    for key, direction in METRICS:
+        a, b = _get(prev, key), _get(cur, key)
+        if a is None or b is None:
+            rows.append({"metric": key, "prev": a, "cur": b,
+                         "delta_pct": None, "regression": False,
+                         "note": "not in both rounds"})
+            continue
+        if a == 0:
+            rows.append({"metric": key, "prev": a, "cur": b,
+                         "delta_pct": None, "regression": False,
+                         "note": "prev is zero"})
+            continue
+        delta = (b - a) / abs(a)
+        bad = delta > threshold if direction == "lower" \
+            else delta < -threshold
+        rows.append({"metric": key, "prev": a, "cur": b,
+                     "delta_pct": round(delta * 100, 1),
+                     "regression": bad, "note": ""})
+    return rows
+
+
+def render_table(rows: list[dict], prev_name: str, cur_name: str,
+                 threshold: float = 0.20) -> str:
+    lines = [f"bench-compare: {prev_name} -> {cur_name}",
+             f"{'metric':<38} {'prev':>14} {'cur':>14} {'delta':>9}  flag"]
+    for r in rows:
+        if r["delta_pct"] is None:
+            if r["prev"] is None and r["cur"] is None:
+                continue   # metric absent from both: noise
+            delta, flag = "-", r["note"]
+        else:
+            delta = f"{r['delta_pct']:+.1f}%"
+            flag = f"REGRESSION >{threshold:.0%}" if r["regression"] else ""
+        fmt = (lambda v: "-" if v is None
+               else (f"{v:.3f}" if isinstance(v, float) else str(v)))
+        lines.append(f"{r['metric']:<38} {fmt(r['prev']):>14} "
+                     f"{fmt(r['cur']):>14} {delta:>9}  {flag}")
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--dir", default=".",
+                    help="directory holding BENCH_r*.json (default: cwd)")
+    ap.add_argument("--threshold", type=float, default=0.20,
+                    help="regression flag threshold as a fraction "
+                         "(default 0.20 = 20%%)")
+    ap.add_argument("--strict", action="store_true",
+                    help="exit 1 when any metric regressed (default: "
+                         "informational, always exit 0)")
+    args = ap.parse_args(argv)
+    rounds = load_rounds(Path(args.dir))
+    usable = [(n, name, doc) for n, name, doc in rounds if doc]
+    skipped = [(n, name) for n, name, doc in rounds if not doc]
+    for n, name in skipped:
+        print(f"# {name}: no parsed result (round died) — skipped")
+    if len(usable) < 2:
+        print("bench-compare: fewer than two parsed rounds — nothing to "
+              "compare")
+        return 0
+    (_, prev_name, prev), (_, cur_name, cur) = usable[-2], usable[-1]
+    rows = compare(prev, cur, args.threshold)
+    print(render_table(rows, prev_name, cur_name, args.threshold))
+    regressions = [r for r in rows if r["regression"]]
+    if regressions:
+        print(f"\n{len(regressions)} metric(s) regressed more than "
+              f"{args.threshold:.0%} — see flags above")
+        if args.strict:
+            return 1
+    else:
+        print("\nno >threshold regressions between the last two parsed "
+              "rounds")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -28,6 +28,8 @@ DEFAULT_TARGETS = (
     "karpenter_tpu/parallel",
     "karpenter_tpu/preempt",
     "karpenter_tpu/gang",
+    "karpenter_tpu/resident",
+    "karpenter_tpu/explain",
     "karpenter_tpu/native.py",
     "bench.py",
     "karpenter_tpu/controllers",

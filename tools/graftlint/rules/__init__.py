@@ -29,4 +29,5 @@ def all_rules() -> list[type[Rule]]:
         concurrency.SilentExceptionSwallow,   # GL105
         observability.UnclosedSpan,           # GL106
         observability.TelemetryInKernel,      # GL107
+        observability.ReasonEnumDrift,        # GL108
     ]

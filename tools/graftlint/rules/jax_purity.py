@@ -26,6 +26,8 @@ FAMILY_A_SCOPE = (
     "karpenter_tpu/gang/**/*",
     "karpenter_tpu/resident/*",
     "karpenter_tpu/resident/**/*",
+    "karpenter_tpu/explain/*",
+    "karpenter_tpu/explain/**/*",
     "karpenter_tpu/native.py",
     "bench.py",
 )

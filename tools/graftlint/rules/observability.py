@@ -1,4 +1,4 @@
-"""Family B additions — observability hygiene (GL106, GL107).
+"""Family B additions — observability hygiene (GL106, GL107, GL108).
 
 GL106: a span opened but not closed through a ``with`` block leaks on
 the exception path: the trace never finalizes (its slot sits in the
@@ -14,6 +14,15 @@ the numerics, not the Python.  The counter silently stops counting the
 moment the cache warms, which is worse than no metric: dashboards show
 a frozen value that looks alive.  All telemetry must live at dispatch
 level on the host (obs/devtel.py's contract).
+
+GL108: the explain reason taxonomy lives in THREE places that must
+enumerate identical name sets — the device bit table
+(``explain.REASON_BITS``), the host fold ladder (``explain.LADDER``),
+and the metrics label allowlist (``metrics.UNPLACED_REASONS``).  A
+reason added to one but not the others silently produces words the fold
+can never name, or metric labels the cardinality bound never admits.
+AST-checked: the tuples are read as literals, never imported (an import
+would mask exactly the drift the rule exists to catch).
 """
 
 from __future__ import annotations
@@ -121,7 +130,7 @@ class TelemetryInKernel(Rule):
     family = "B"
     scope = ("karpenter_tpu/solver/*", "karpenter_tpu/parallel/*",
              "karpenter_tpu/preempt/*", "karpenter_tpu/gang/*",
-             "karpenter_tpu/resident/*")
+             "karpenter_tpu/resident/*", "karpenter_tpu/explain/*")
 
     def check(self, module: SourceModule) -> Iterator[Finding]:
         analysis = analyze(module)
@@ -151,3 +160,140 @@ class TelemetryInKernel(Rule):
         # never trip the rule
         return len(chain) >= 2 and chain[0].isupper() \
             and terminal in _METRIC_TERMINALS
+
+
+# ---------------------------------------------------------------------------
+# GL108 — reason-enum drift (karpenter_tpu/explain)
+# ---------------------------------------------------------------------------
+
+def _assign_node(tree: ast.AST, name: str) -> ast.Assign | None:
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                if isinstance(t, ast.Name) and t.id == name:
+                    return node
+    return None
+
+
+def _tuple_reason_names(tree: ast.AST, name: str) -> list[str] | None:
+    """Reason names from a module-level tuple literal: either plain
+    strings (LADDER, UNPLACED_REASONS) or ("name", bit) pairs
+    (REASON_BITS).  None when the assignment is absent or not a pure
+    literal the AST can read."""
+    node = _assign_node(tree, name)
+    if node is None or not isinstance(node.value, (ast.Tuple, ast.List)):
+        return None
+    out: list[str] = []
+    for elt in node.value.elts:
+        if isinstance(elt, ast.Constant) and isinstance(elt.value, str):
+            out.append(elt.value)
+        elif isinstance(elt, (ast.Tuple, ast.List)) and elt.elts \
+                and isinstance(elt.elts[0], ast.Constant) \
+                and isinstance(elt.elts[0].value, str):
+            out.append(elt.elts[0].value)
+        else:
+            return None
+    return out
+
+
+def reason_sets_from_sources(explain_src: str,
+                             metrics_src: str) -> list[str]:
+    """Pure cross-file form of the GL108 check (fixture-testable):
+    drift messages between REASON_BITS / LADDER in ``explain_src`` and
+    UNPLACED_REASONS in ``metrics_src`` (empty list = consistent)."""
+    problems: list[str] = []
+    etree = ast.parse(explain_src)
+    mtree = ast.parse(metrics_src)
+    bits = _tuple_reason_names(etree, "REASON_BITS")
+    ladder = _tuple_reason_names(etree, "LADDER")
+    allow = _tuple_reason_names(mtree, "UNPLACED_REASONS")
+    if bits is None:
+        problems.append("REASON_BITS missing or not a literal tuple")
+    if ladder is None:
+        problems.append("LADDER missing or not a literal tuple")
+    if allow is None:
+        problems.append("UNPLACED_REASONS missing or not a literal tuple")
+    if bits is not None and ladder is not None \
+            and set(bits) != set(ladder):
+        problems.append(
+            f"REASON_BITS vs LADDER drift: "
+            f"{sorted(set(bits) ^ set(ladder))}")
+    if bits is not None and allow is not None \
+            and set(bits) != set(allow):
+        problems.append(
+            f"REASON_BITS vs metrics UNPLACED_REASONS drift: "
+            f"{sorted(set(bits) ^ set(allow))}")
+    return problems
+
+
+class ReasonEnumDrift(Rule):
+    id = "GL108"
+    name = "reason-enum-drift"
+    description = (
+        "The explain reason taxonomy is enumerated in three places that "
+        "must agree: explain.REASON_BITS (device bit table), "
+        "explain.LADDER (most-specific-wins fold), and "
+        "metrics.UNPLACED_REASONS (label allowlist / cardinality "
+        "bound). A name present in one but not the others produces "
+        "unfoldable words or unadmitted metric labels. The tuples are "
+        "read from the AST as pure literals."
+    )
+    family = "B"
+    scope = ("karpenter_tpu/explain/__init__.py",
+             "karpenter_tpu/utils/metrics.py")
+
+    _EXPLAIN = "karpenter_tpu/explain/__init__.py"
+    _METRICS = "karpenter_tpu/utils/metrics.py"
+
+    @staticmethod
+    def _repo_path(rel: str):
+        """Sibling-file lookup anchored on the REPO ROOT derived from
+        this module's location (tools/graftlint/rules/ -> root), never
+        the process cwd — graftlint invoked from any directory must
+        still see the cross-file drift."""
+        import pathlib
+
+        return pathlib.Path(__file__).resolve().parents[3] / rel
+
+    def check(self, module: SourceModule) -> Iterator[Finding]:
+        if module.path.endswith("explain/__init__.py"):
+            bits = _tuple_reason_names(module.tree, "REASON_BITS")
+            ladder = _tuple_reason_names(module.tree, "LADDER")
+            anchor = _assign_node(module.tree, "LADDER") \
+                or _assign_node(module.tree, "REASON_BITS") or module.tree
+            if bits is None or ladder is None:
+                yield self.finding(
+                    module, anchor if isinstance(anchor, ast.AST)
+                    and hasattr(anchor, "lineno") else module.tree.body[0],
+                    "REASON_BITS / LADDER must be module-level literal "
+                    "tuples (the AST check cannot read computed values)")
+                return
+            if set(bits) != set(ladder):
+                yield self.finding(
+                    module, anchor,
+                    f"REASON_BITS vs LADDER drift: "
+                    f"{sorted(set(bits) ^ set(ladder))}")
+            other = self._repo_path(self._METRICS)
+            if other.exists():
+                allow = _tuple_reason_names(ast.parse(other.read_text()),
+                                            "UNPLACED_REASONS")
+                if allow is not None and set(allow) != set(bits):
+                    yield self.finding(
+                        module, anchor,
+                        f"REASON_BITS vs metrics UNPLACED_REASONS drift: "
+                        f"{sorted(set(bits) ^ set(allow))}")
+        else:   # utils/metrics.py
+            allow = _tuple_reason_names(module.tree, "UNPLACED_REASONS")
+            if allow is None:
+                return   # fixtures / metrics without the explain plane
+            anchor = _assign_node(module.tree, "UNPLACED_REASONS")
+            other = self._repo_path(self._EXPLAIN)
+            if not other.exists():
+                return
+            bits = _tuple_reason_names(ast.parse(other.read_text()),
+                                       "REASON_BITS")
+            if bits is not None and set(bits) != set(allow):
+                yield self.finding(
+                    module, anchor,
+                    f"UNPLACED_REASONS vs explain REASON_BITS drift: "
+                    f"{sorted(set(bits) ^ set(allow))}")

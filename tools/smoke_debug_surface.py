@@ -101,6 +101,29 @@ def main() -> int:
         check(all(p.nominated_node for p in op.cluster.pending_pods()),
               "provisioning wave resolved (traces recorded)")
 
+        # demo explain cycle: a pod no offering can host — the next
+        # window must attach an insufficient-* reason (device/oracle
+        # fold), stamp the ledger, refresh the unplaced gauge, and
+        # surface the verdict on /debug/explain
+        print("demo explain cycle (unplaceable pod)")
+        from karpenter_tpu.apis.pod import PodSpec
+        from karpenter_tpu.explain import get_registry
+
+        op.cluster.add_pod(PodSpec(
+            "smoke-stuck",
+            requests=ResourceRequests(50_000_000, 900_000_000, 0, 1)))
+        deadline = time.time() + 20
+        entry = None
+        while time.time() < deadline:
+            entry = get_registry().get("default/smoke-stuck")
+            if entry is not None:
+                break
+            time.sleep(0.1)
+        check(entry is not None
+              and entry.reason.startswith("insufficient_"),
+              f"unplaceable pod carries an insufficient-* reason "
+              f"({entry.reason if entry else 'no entry'})")
+
         # demo preemption cycle: a full node whose low-priority pod must
         # yield to a stranded high-priority pod — sized so NO wave claim
         # can host the beneficiary (7000m only fits the prey node even
@@ -249,6 +272,11 @@ def main() -> int:
               "pending staleness gauge rendered")
         check("karpenter_tpu_recorder_dropped_spans_total" in text,
               "recorder dropped-spans counter rendered")
+        check('karpenter_tpu_unplaced_pods{reason="insufficient_' in text,
+              "unplaced_pods gauge counted the demo unplaceable pod")
+        check('karpenter_tpu_pod_placement_seconds_bucket{'
+              'outcome="unplaced"' in text,
+              "placement histogram observed the unplaced outcome")
         check('karpenter_tpu_jit_recompiles_total{kernel=' in text,
               "jit recompile counter carries live samples")
         check('karpenter_tpu_device_transfer_bytes_total{direction="h2d"}'
@@ -298,6 +326,33 @@ def main() -> int:
               and res.get("generation"),
               f"/debug/slo exposes resident-store state ({res})")
 
+        print("GET /debug/explain")
+        status, ctype, body = _get(port, "/debug/explain")
+        check(status == 200, f"/debug/explain status 200 (got {status})")
+        check(ctype == "application/json",
+              f"/debug/explain content type (got {ctype!r})")
+        try:
+            doc = json.loads(body)
+        except ValueError as e:
+            doc = {}
+            check(False, f"/debug/explain parses as JSON ({e})")
+        stuck = [p for p in doc.get("pods", ())
+                 if p.get("pod") == "default/smoke-stuck"]
+        check(bool(stuck), "/debug/explain lists the unplaceable pod")
+        if stuck:
+            check(stuck[0].get("reason", "").startswith("insufficient_"),
+                  f"reason is insufficient-* ({stuck[0].get('reason')})")
+            near = stuck[0].get("nearest_miss") or {}
+            check(bool(near.get("instance_type"))
+                  and bool(near.get("deficits")),
+                  f"nearest-miss offering with deficits attached ({near})")
+        check(any(doc.get("summary", {}).values()),
+              "/debug/explain reason summary is non-empty")
+        status, _, body = _get(port,
+                               "/debug/explain?pod=default/smoke-stuck")
+        check(status == 200 and json.loads(body).get("pods"),
+              "/debug/explain?pod= pinpoint lookup returns the entry")
+
         print("GET /statusz")
         status, ctype, body = _get(port, "/statusz")
         check(status == 200, f"/statusz status 200 (got {status})")
@@ -308,8 +363,11 @@ def main() -> int:
             check(False, f"/statusz parses as JSON ({e})")
         for key in ("uptime_s", "version", "backend", "leader",
                     "recorder", "circuit_breakers", "ledger",
-                    "device_telemetry", "pending_staleness_s"):
+                    "device_telemetry", "pending_staleness_s",
+                    "unplaced_reasons"):
             check(key in doc, f"/statusz has {key!r}")
+        check(any(doc.get("unplaced_reasons", {}).values()),
+              "/statusz unplaced-reason summary carries the demo pod")
         sres = (doc.get("device_telemetry") or {}).get("resident") or {}
         check(sres.get("windows", 0) >= 2
               and "last_delta_words" in sres
@@ -337,6 +395,24 @@ def main() -> int:
         check("gang.place" in roots,
               f"the demo gang placement trace is retained "
               f"(roots={sorted(roots)})")
+
+        # trace-id round trip: /debug/slo's worst-pod table prints trace
+        # ids — the exact-lookup filter must fetch that one bundle
+        print("GET /debug/traces?trace_id= (round trip from /debug/slo)")
+        status, _, body = _get(port, "/debug/slo")
+        worst = (json.loads(body) or {}).get("worst_pods", [])
+        tids = [w["trace_id"] for w in worst if w.get("trace_id")]
+        check(bool(tids), "/debug/slo worst pods carry trace ids")
+        if tids:
+            status, _, body = _get(port,
+                                   f"/debug/traces?trace_id={tids[0]}")
+            doc = json.loads(body)
+            got = doc.get("traces", [])
+            check(status == 200 and len(got) == 1
+                  and got[0]["trace_id"] == tids[0]
+                  and got[0].get("spans"),
+                  f"trace_id={tids[0]} exact lookup returns that one "
+                  f"non-empty bundle (got {len(got)})")
     finally:
         op.stop()
 
