@@ -415,19 +415,77 @@ def run(num_pods: int, num_types: int, iters: int, platform: str) -> dict:
     # (docs/design/observability.md); reset so only the measured
     # single-shot loop contributes
     from karpenter_tpu import obs
+    from karpenter_tpu.obs.prof import get_profiler
 
     obs.reset_recorder(capacity=max(iters * 4, 64))
+    # steady-state profiler accounting rides the measured loop at the
+    # PRODUCTION sampling interval — the overhead fraction below is the
+    # <1% acceptance gate, not a forced-sampling artifact
+    prof = get_profiler()
+    prof.reset()
     walls = []
     for _ in range(iters):
         t0 = time.perf_counter()
         jax_solver.solve(request)
         walls.append(time.perf_counter() - t0)
     jax_p50 = p50(walls)
+    steady_prof = prof.snapshot()
     phase_durs = obs.phase_durations()
 
     def phase_p50_ms(name: str) -> float:
         xs = phase_durs.get("solve." + name)
         return round(p50(xs) * 1000, 3) if xs else 0.0
+
+    # sampled device-time decomposition (obs/prof.py): force the
+    # profiler's synchronization bracket onto a handful of warm solves
+    # so exec_fetch finally splits into dispatch / device-execute /
+    # fetch per kernel — ROADMAP-2's repack work measures against this
+    from karpenter_tpu.obs.prof import DEFAULT_INTERVAL as PROF_INTERVAL
+
+    prev_interval = prof.interval
+    prof.reset()
+    prof.interval = 1
+    try:
+        for _ in range(5):
+            jax_solver.solve(request)
+    finally:
+        prof.interval = prev_interval
+    forced_prof = prof.snapshot()
+    prof.reset()      # forced-pass stats must not pollute later sections
+    active_kernel = jax_solver.last_stats.get("path", "")
+    split = forced_prof["kernels"].get(active_kernel) or next(
+        iter(forced_prof["kernels"].values()), {})
+    # production-cadence overhead estimate from the PRECISELY measured
+    # forced samples: one bracket costs (execute + fetch) of extra
+    # serialization (the conservative pipelined bound the profiler
+    # itself accounts), paid every PROF_INTERVAL dispatches — never
+    # vacuous, since the forced pass always samples
+    bracket_ms = (split.get("dispatch_ms", 0.0)
+                  + split.get("execute_ms", 0.0)
+                  + split.get("fetch_ms", 0.0))
+    est_overhead = ((split.get("execute_ms", 0.0)
+                     + split.get("fetch_ms", 0.0))
+                    / bracket_ms / PROF_INTERVAL) if bracket_ms else 0.0
+    device_time = {
+        "kernels": forced_prof["kernels"],
+        # the headline solve path's split — the decomposition of the
+        # exec_fetch_ms wall the host spans cannot separate
+        "exec_fetch_decomposed": {
+            "kernel": active_kernel,
+            "dispatch_ms": split.get("dispatch_ms", 0.0),
+            "execute_ms": split.get("execute_ms", 0.0),
+            "fetch_ms": split.get("fetch_ms", 0.0),
+        },
+        # overhead at the production cadence (<1% gate, mirrored live
+        # on /statusz): the estimate from forced samples plus the
+        # directly measured value when the steady loop sampled
+        "profiler_overhead_fraction": round(est_overhead, 6),
+        "measured_overhead_fraction": steady_prof["overhead_fraction"],
+        "profiler_interval": PROF_INTERVAL,
+        "steady_interval": steady_prof["interval"],
+        "steady_samples": steady_prof["samples"],
+        "steady_dispatches": steady_prof["dispatches_seen"],
+    }
 
     # pure on-chip compute (VERDICT round 2 item 2): k back-to-back
     # dispatches on device-resident inputs, one sync — the slope over k
@@ -557,6 +615,10 @@ def run(num_pods: int, num_types: int, iters: int, platform: str) -> dict:
         # device telemetry accumulated by THIS process's live solve path
         # (obs/devtel.py): recompiles, transfer bytes, cache hit ratio
         "device_telemetry": _devtel_snapshot(),
+        # sampled device-time attribution (obs/prof.py): per-kernel
+        # dispatch/execute/fetch split + the profiler's own steady-state
+        # overhead fraction (docs/design/profiling.md)
+        "device_time": device_time,
         "platform": platform,
     }
 
@@ -1663,6 +1725,24 @@ def compute_target_met(result: dict) -> dict:
              and result["explain"]["unplaced"] > 0
              and 0.0 <= result["explain"]["d2h_fraction"] < 0.05)
             if "explain" in result else None,
+        # ISSUE 10 acceptance: the sampled profiler decomposes
+        # exec_fetch into dispatch / device-execute / fetch for the
+        # headline solve kernel, at <1% steady-state self-overhead at
+        # the production cadence — the forced-sample estimate is never
+        # vacuous, and when the steady loop actually sampled, the
+        # directly measured value (the one /statusz surfaces) must
+        # clear the gate too
+        "device_time_decomposed_under_1pct_overhead":
+            (result["device_time"]["exec_fetch_decomposed"]["execute_ms"]
+             > 0.0
+             and result["device_time"]["exec_fetch_decomposed"]
+             ["dispatch_ms"] > 0.0
+             and 0.0 <= result["device_time"]["profiler_overhead_fraction"]
+             < 0.01
+             and (result["device_time"]["steady_samples"] == 0
+                  or result["device_time"]["measured_overhead_fraction"]
+                  < 0.01))
+            if "device_time" in result else None,
     }
 
 

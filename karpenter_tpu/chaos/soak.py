@@ -97,6 +97,9 @@ class SoakResult:
     ledger_stats: dict
     chaos_violations: int
     report_path: str = ""
+    # triage bundle auto-written on a burned day (obs/watchdog.py);
+    # empty when the day passed (or the write failed)
+    triage_bundle: str = ""
 
     @property
     def ok(self) -> bool:
@@ -114,6 +117,7 @@ def _scaled(profile, load: float):
 def run_soak(segments: tuple[SoakSegment, ...] = PRODUCTION_DAY, *,
              seed: int = 1, slos: tuple[SLOSpec, ...] = SOAK_SLOS,
              report_dir: str = ".soak-report",
+             triage_dir: str = ".triage",
              echo=print) -> SoakResult:
     """Run the composed production day and gate it on the SLOs.  Every
     segment's flight-recorder spans are dumped as a bundle next to the
@@ -131,39 +135,52 @@ def run_soak(segments: tuple[SoakSegment, ...] = PRODUCTION_DAY, *,
     # samples are rebased onto one concatenated day timeline — the burn
     # windows evaluate against coherent, monotonic day-seconds
     day_t = 0.0
-    with obs.use_ledger(ledger):
-        for i, seg in enumerate(segments):
-            name = f"{i:02d}-{seg.profile}"
-            ledger.set_context(name)
-            profile = _scaled(get_profile(seg.profile), seg.load)
-            clock = VirtualClock()
-            mono0 = clock.monotonic()
-            since = ledger.sample_count
-            harness = ChaosHarness(profile, seed, rounds=seg.rounds,
-                                   clock=clock)
-            violations = harness.run()
-            ledger.rebase_recent(since, day_t - mono0)
-            day_t += clock.monotonic() - mono0
-            chaos_violations += len(violations)
-            rstats = harness.recorder.stats()
-            rec_dropped += rstats["dropped_spans"]
-            rec_total += rstats["traces_total"] + rstats["instants_total"]
-            bundle = out_dir / f"{name}-spans.jsonl"
-            dump_jsonl(recorder_to_dicts(harness.recorder), bundle)
-            bundles[name] = str(bundle)
-            stats = ledger.stats()
-            seg_results.append({
-                "segment": name, "rounds": seg.rounds, "load": seg.load,
-                "chaos_violations": [v.render() for v in violations],
-                "resolved_so_far": stats["resolved_total"],
-                "open_records": stats["open_records"],
-                "bundle": bundles[name],
-            })
-            echo(f"segment {name:<16} rounds={seg.rounds} "
-                 f"load={seg.load:.1f} violations={len(violations)} "
-                 f"resolved={stats['resolved_total']} "
-                 f"open={stats['open_records']} "
-                 f"day_t={day_t:.0f}s")
+    # route the process watchdog's breach bundles into THIS soak's
+    # triage dir for the duration — a slow-kernel breach mid-day must
+    # land next to the slo_burn bundle, not in the ambient cwd
+    from karpenter_tpu.obs.watchdog import get_watchdog
+
+    wd = get_watchdog()
+    prev_triage = wd.triage_dir
+    wd.triage_dir = triage_dir
+    try:
+        with obs.use_ledger(ledger):
+            for i, seg in enumerate(segments):
+                name = f"{i:02d}-{seg.profile}"
+                ledger.set_context(name)
+                profile = _scaled(get_profile(seg.profile), seg.load)
+                clock = VirtualClock()
+                mono0 = clock.monotonic()
+                since = ledger.sample_count
+                harness = ChaosHarness(profile, seed, rounds=seg.rounds,
+                                       clock=clock)
+                violations = harness.run()
+                ledger.rebase_recent(since, day_t - mono0)
+                day_t += clock.monotonic() - mono0
+                chaos_violations += len(violations)
+                rstats = harness.recorder.stats()
+                rec_dropped += rstats["dropped_spans"]
+                rec_total += rstats["traces_total"] \
+                    + rstats["instants_total"]
+                bundle = out_dir / f"{name}-spans.jsonl"
+                dump_jsonl(recorder_to_dicts(harness.recorder), bundle)
+                bundles[name] = str(bundle)
+                stats = ledger.stats()
+                seg_results.append({
+                    "segment": name, "rounds": seg.rounds,
+                    "load": seg.load,
+                    "chaos_violations": [v.render() for v in violations],
+                    "resolved_so_far": stats["resolved_total"],
+                    "open_records": stats["open_records"],
+                    "bundle": bundles[name],
+                })
+                echo(f"segment {name:<16} rounds={seg.rounds} "
+                     f"load={seg.load:.1f} violations={len(violations)} "
+                     f"resolved={stats['resolved_total']} "
+                     f"open={stats['open_records']} "
+                     f"day_t={day_t:.0f}s")
+    finally:
+        wd.triage_dir = prev_triage
 
     measurements = ledger_measurements(
         ledger,
@@ -204,6 +221,26 @@ def run_soak(segments: tuple[SoakSegment, ...] = PRODUCTION_DAY, *,
         "segments": seg_results,
     }, indent=2, default=str))
     result.report_path = str(report_path)
+
+    # a burned day auto-writes a triage bundle next to the burn report:
+    # the span bundles name WHAT happened, the triage manifest packages
+    # the worst-K pods / devtel / profiler state an operator needs for
+    # WHY — and CI uploads .triage/ as an artifact alongside the report
+    if not result.ok:
+        from karpenter_tpu.obs.watchdog import write_triage_bundle
+
+        try:
+            result.triage_bundle = write_triage_bundle(
+                "slo_burn",
+                {"burned": [r.spec.name for r in report.burned],
+                 "gate_proven": gate_proven,
+                 "chaos_violations": chaos_violations,
+                 "report_path": str(report_path)},
+                triage_dir=triage_dir, ledger=ledger)
+            echo(f"triage bundle: {result.triage_bundle}")
+        except Exception as e:  # noqa: BLE001 — a failed bundle must not
+            # mask the burn verdict the soak exists to deliver
+            echo(f"triage bundle write failed: {e}")
 
     echo(report.render())
     if not gate_proven:

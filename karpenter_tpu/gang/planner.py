@@ -113,8 +113,12 @@ class GangPlanner:
                 re_ = np.zeros((Np, resid.shape[1]), np.int32)
                 re_[:Nn] = resid.astype(np.int32)
                 lo = np.zeros(Np, bool); lo[:Nn] = label_ok      # noqa: E702
-                fits, first = dev(ol, oh, ml, mh, va, re_,
-                                  need.astype(np.int32), lo)
+                from karpenter_tpu.obs.prof import get_profiler
+
+                with get_profiler().sampled("gang-grid") as probe:
+                    fits, first = dev(ol, oh, ml, mh, va, re_,
+                                      need.astype(np.int32), lo)
+                    probe.dispatched((fits, first))
                 return (np.asarray(fits)[:Nn],
                         np.asarray(first)[:Nn].astype(np.int64))
         free = valid & ((masks & occ[:, None]) == 0)

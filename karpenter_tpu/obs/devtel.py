@@ -83,6 +83,10 @@ class DeviceTelemetry:
         # for every signature this process first dispatches — the AOT
         # executable cache (resident/aot.py) records its manifest here
         self.signature_sink = None
+        # optional hook: called OUTSIDE the lock with (kernel,) per
+        # recompile event — the anomaly watchdog's burst detector
+        # (obs/watchdog.py), installed by obs/prof.get_profiler()
+        self.recompile_sink = None
 
     # -- accounting ----------------------------------------------------------
 
@@ -115,6 +119,12 @@ class DeviceTelemetry:
             if sink is not None:
                 try:
                     sink(kernel, signature)
+                except Exception:  # noqa: BLE001 — telemetry must never fail a solve
+                    pass
+            rsink = self.recompile_sink
+            if rsink is not None:
+                try:
+                    rsink(kernel)
                 except Exception:  # noqa: BLE001 — telemetry must never fail a solve
                     pass
         metrics.EXEC_CACHE.labels("miss" if new else "hit").inc()

@@ -274,8 +274,10 @@ class PlacementLedger:
                 self.transition_counts[name] = \
                     self.transition_counts.get(name, 0) + 1
             age = max(0.0, t - rec.first_seen)
+            tid = rec.trace_id
         if changed:
-            metrics.POD_PLACEMENT.labels("unplaced").observe(age)
+            metrics.POD_PLACEMENT.labels("unplaced").observe(
+                age, exemplar={"trace_id": str(tid)} if tid else None)
 
     def reopen(self, key: str, reason: str, t: float | None = None) -> None:
         """A resolved pod re-entered the queue (preemption eviction):
@@ -325,7 +327,12 @@ class PlacementLedger:
             rec.duration_s = max(0.0, t - rec.first_seen)
             rec.context = self._context
             self._retain_locked(rec)
-        metrics.POD_PLACEMENT.labels(outcome).observe(rec.duration_s)
+        # OpenMetrics exemplar: a slow placement bucket links straight
+        # to the deciding window's span bundle via /debug/traces
+        metrics.POD_PLACEMENT.labels(outcome).observe(
+            rec.duration_s,
+            exemplar={"trace_id": str(rec.trace_id)} if rec.trace_id
+            else None)
 
     def registered(self, key: str, t: float | None = None) -> None:
         """The claim a pod was nominated onto registered its node: the
@@ -338,7 +345,9 @@ class PlacementLedger:
                 return
             rec.add_stamp("registered", t, dedupe=True)
             elapsed = max(0.0, t - rec.first_seen)
-        metrics.POD_PLACEMENT.labels("registered").observe(elapsed)
+            tid = rec.trace_id
+        metrics.POD_PLACEMENT.labels("registered").observe(
+            elapsed, exemplar={"trace_id": str(tid)} if tid else None)
 
     # -- retention -----------------------------------------------------------
 

@@ -23,6 +23,10 @@ Debug surface (docs/design/observability.md):
 - ``GET /debug/explain[?pod=ns/name&limit=N]`` — per-pod placement
   explainability (karpenter_tpu/explain): canonical unplaced reason,
   elimination bitmask, nearest-miss offering, reason summary;
+- ``GET /debug/profile[?duration_s=N]`` — on-demand device-time
+  capture (karpenter_tpu/obs/prof.py): single-flight, duration-capped,
+  returns per-dispatch dispatch/execute/fetch decomposition plus a
+  Perfetto-loadable Chrome trace;
 - ``GET /statusz`` — uptime, build identity, last solve breakdown,
   ledger + recorder + device-telemetry snapshots, leader /
   circuit-breaker state (the operator wires its own extras in via the
@@ -114,9 +118,31 @@ class MetricsServer:
         class Handler(BaseHTTPRequestHandler):
             def do_GET(self):  # noqa: N802 (stdlib API)
                 if self.path == "/metrics":
-                    body = metrics.render().encode()
-                    self._reply(200, body,
-                                "text/plain; version=0.0.4; charset=utf-8")
+                    # content negotiation: an OpenMetrics scraper gets
+                    # the exemplar-bearing exposition (trace_id
+                    # exemplars on solve_phase / pod_placement /
+                    # device_time buckets link into /debug/traces);
+                    # the plain text render is unchanged
+                    if "application/openmetrics-text" in \
+                            (self.headers.get("Accept") or ""):
+                        self._reply(
+                            200, metrics.render_openmetrics().encode(),
+                            "application/openmetrics-text; version=1.0.0; "
+                            "charset=utf-8")
+                    else:
+                        self._reply(
+                            200, metrics.render().encode(),
+                            "text/plain; version=0.0.4; charset=utf-8")
+                elif self.path.split("?", 1)[0] == "/debug/profile":
+                    # single-flight + duration-capped: distinct status
+                    # codes (429 busy), so it can't ride _json_endpoint
+                    try:
+                        code, payload = outer._debug_profile(self.path)
+                    except Exception as e:  # noqa: BLE001 — debug surface
+                        code, payload = 500, {"error": str(e)[:200]}
+                    self._reply(code,
+                                json.dumps(payload, default=str).encode(),
+                                "application/json")
                 elif self.path.split("?", 1)[0] == "/debug/traces":
                     self._json_endpoint(
                         lambda: outer._debug_traces(self.path))
@@ -258,6 +284,37 @@ class MetricsServer:
             "stamped_total": registry.stamped_total,
         }
 
+    def _debug_profile(self, path: str) -> tuple[int, dict]:
+        """On-demand device-time capture (docs/design/profiling.md):
+        force-samples every dispatch for ``?duration_s=`` (clamped to
+        the profiler's cap), then returns the per-dispatch
+        dispatch/execute/fetch decomposition, a per-kernel summary, and
+        a Perfetto-loadable Chrome trace built through the existing
+        export path.  Single-flight: a second concurrent capture gets
+        429, never a second window."""
+        from karpenter_tpu.obs.export import dicts_to_chrome
+        from karpenter_tpu.obs.prof import (
+            aggregate_samples, clamp_capture_duration, get_profiler,
+            samples_to_span_dicts,
+        )
+
+        q = parse_qs(urlparse(path).query)
+        raw = q["duration_s"][0] if q.get("duration_s") else 1.0
+        duration_s = clamp_capture_duration(raw)
+        prof = get_profiler()
+        samples = prof.capture(duration_s)
+        if samples is None:
+            return 429, {"error": "a profile capture is already in "
+                                  "flight (single-flight)"}
+        return 200, {
+            "duration_s": duration_s,
+            "sample_count": len(samples),
+            "samples": samples[:256],
+            "device_time": aggregate_samples(samples),
+            "profiler": prof.snapshot(),
+            "chrome": dicts_to_chrome(samples_to_span_dicts(samples)),
+        }
+
     def _debug_slo(self) -> dict:
         """Live SLO evaluation over the placement ledger: burn state per
         default SLO, the worst-case pod table (trace ids link into
@@ -272,6 +329,8 @@ class MetricsServer:
     def _statusz(self) -> dict:
         from karpenter_tpu import obs
         from karpenter_tpu.obs.devtel import get_devtel
+        from karpenter_tpu.obs.prof import get_profiler
+        from karpenter_tpu.obs.watchdog import get_watchdog
         from karpenter_tpu.version import get_version
 
         from karpenter_tpu.explain import get_registry
@@ -287,6 +346,11 @@ class MetricsServer:
             "pending_staleness_s": round(ledger.pending_staleness(), 6),
             "device_telemetry": get_devtel().snapshot(),
             "unplaced_reasons": get_registry().summary(),
+            # device-profiling plane (docs/design/profiling.md): the
+            # per-kernel dispatch/execute/fetch split, the profiler's
+            # own overhead fraction (<1% gate), and watchdog state
+            "profiler": get_profiler().snapshot(),
+            "watchdog": get_watchdog().snapshot(),
         }
         if self._statusz_extra is not None:
             out.update(self._statusz_extra())

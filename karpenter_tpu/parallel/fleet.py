@@ -52,6 +52,7 @@ def shard_map(f, *, mesh, in_specs, out_specs, check_rep=False):
                       **{_CHECK_KW: check_rep})
 
 from karpenter_tpu.obs.devtel import get_devtel
+from karpenter_tpu.obs.prof import get_profiler
 from karpenter_tpu.parallel.mesh import FLEET_AXIS, OFFER_AXIS
 from karpenter_tpu.solver.jax_backend import _fit_counts, _right_size, solve_core
 
@@ -231,10 +232,12 @@ def fleet_solve_pallas(problem: FleetProblem, *, num_nodes: int,
             "fleet-pallas", (C, G, O, U_pad, N, K, right_size),
             h2d_bytes=int(ins.nbytes) if host_input else 0,
             donated=not host_input)
-        out_dev = fleet_packed_pallas(
-            dispatch_ins, alloc8_all, rank_all, price_all,
-            C=C, G=G, O=O, U=U_pad, N=N, right_size=right_size,
-            interpret=interpret, compact=K)
+        with get_profiler().sampled("fleet-pallas") as probe:
+            out_dev = fleet_packed_pallas(
+                dispatch_ins, alloc8_all, rank_all, price_all,
+                C=C, G=G, O=O, U=U_pad, N=N, right_size=right_size,
+                interpret=interpret, compact=K)
+            probe.dispatched(out_dev)
         try:
             out_dev.copy_to_host_async()
         except Exception:  # noqa: BLE001 — cpu arrays
@@ -308,8 +311,10 @@ def fleet_solve_pallas_sharded(problem: FleetProblem, mesh: Mesh, *,
         get_devtel().note_dispatch(
             "fleet-pallas-sharded", (n, C, G, O, U_pad, N, K, right_size),
             h2d_bytes=int(ins.nbytes), donated=False)
-        out_np = np.asarray(f(jnp.asarray(ins), alloc8_all,
-                              rank_all, price_all))
+        with get_profiler().sampled("fleet-pallas-sharded") as probe:
+            out_dev = f(jnp.asarray(ins), alloc8_all, rank_all, price_all)
+            probe.dispatched(out_dev)
+        out_np = np.asarray(out_dev)
         get_devtel().note_d2h(int(out_np.nbytes))
         if K > 0 and K < K_cap and any(
                 coo_buffer_full(out_np[c], G, N, K) for c in range(C)):
@@ -333,9 +338,11 @@ def fleet_solve(problem: FleetProblem, mesh: Mesh, *, num_nodes: int,
     get_devtel().note_dispatch(
         "fleet-scan", problem.compat.shape + (num_nodes, right_size),
         h2d_bytes=h2d, donated=h2d == 0)
-    out = f(problem.group_req, problem.group_count, problem.group_cap,
-            problem.compat, problem.off_alloc, problem.off_price,
-            problem.off_rank)
+    with get_profiler().sampled("fleet-scan") as probe:
+        out = f(problem.group_req, problem.group_count, problem.group_cap,
+                problem.compat, problem.off_alloc, problem.off_price,
+                problem.off_rank)
+        probe.dispatched(out)
     res = tuple(np.asarray(o) for o in out)
     get_devtel().note_d2h(sum(int(o.nbytes) for o in res))
     return res

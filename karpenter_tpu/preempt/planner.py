@@ -103,7 +103,12 @@ class PreemptionPlanner:
                 fp[:Nn, :K] = freed_prefix
                 co = np.zeros((Np, consumed.shape[1]), np.int32)
                 co[:Nn] = consumed
-                out = np.asarray(dev(r0, fp, co, req.astype(np.int32)))
+                from karpenter_tpu.obs.prof import get_profiler
+
+                with get_profiler().sampled("preempt-grid") as probe:
+                    out_dev = dev(r0, fp, co, req.astype(np.int32))
+                    probe.dispatched(out_dev)
+                out = np.asarray(out_dev)
                 return out[:Nn, :K].astype(np.int64)
         cap = resid0[:, None, :] + freed_prefix - consumed[:, None, :]
         per = np.where(req[None, None, :] > 0,

@@ -57,6 +57,7 @@ from karpenter_tpu.solver.types import (
 )
 from karpenter_tpu import obs
 from karpenter_tpu.obs.devtel import get_devtel
+from karpenter_tpu.obs.prof import get_profiler
 from karpenter_tpu.utils import metrics
 from karpenter_tpu.utils.logging import get_logger
 
@@ -69,9 +70,14 @@ def _phase(name: str, t0: float, t1: float, parent=None, **attrs) -> None:
     and the scraped metric can never disagree about a phase's duration.
     Cost on the hot path: one allocation + one preallocated ring-slot
     write + one histogram observe (timestamps are taken by the caller
-    with two ``obs.now()`` reads, no context-manager machinery)."""
-    obs.record("solve." + name, t0, t1, parent=parent, **attrs)
-    metrics.SOLVE_PHASE.labels(name).observe(t1 - t0)
+    with two ``obs.now()`` reads, no context-manager machinery).  The
+    histogram observation carries the span's trace id as an OpenMetrics
+    exemplar: a slow bucket on a dashboard links straight to its span
+    bundle via /debug/traces?trace_id= (content-negotiated — the plain
+    text render never shows exemplars)."""
+    sp = obs.record("solve." + name, t0, t1, parent=parent, **attrs)
+    metrics.SOLVE_PHASE.labels(name).observe(
+        t1 - t0, exemplar={"trace_id": str(sp.trace_id)})
 
 # plain int: weak-typed in jnp.where, and a module-level jnp constant
 # would initialize the JAX backend at import time (hanging process start
@@ -1324,11 +1330,13 @@ class JaxSolver:
         while True:
             K, dense16, coo16 = clamp_output_opts(K0, dense16_ok, G_pad, N)
             t_issue = time.perf_counter()
-            out_dev = solve_packed_batch(
-                rows, off_alloc, off_price, off_rank,
-                G=G_pad, O=O_pad, U=U_pad, N=N,
-                right_size=self.options.right_size,
-                compact=K, dense16=dense16, coo16=coo16)
+            with get_profiler().sampled("scan-batch") as probe:
+                out_dev = solve_packed_batch(
+                    rows, off_alloc, off_price, off_rank,
+                    G=G_pad, O=O_pad, U=U_pad, N=N,
+                    right_size=self.options.right_size,
+                    compact=K, dense16=dense16, coo16=coo16)
+                probe.dispatched(out_dev)
             t_issued = time.perf_counter()
             out_np = np.asarray(out_dev)
             t_fetch = time.perf_counter()
@@ -1546,13 +1554,15 @@ class JaxSolver:
                 if prep.pref_lambda is None else prep.pref_lambda
             self._note_dispatch("scan-pref", prep, arr, N,
                                 (prep.pref_rows.shape[0], rs))
-            out = solve_packed_pref(
-                arr, prep.pref_rows, prep.pref_idx,
-                off_alloc, off_price, off_rank,
-                G=G_pad, O=O_pad, U=prep.U_pad, N=N,
-                P=prep.pref_rows.shape[0], right_size=rs,
-                compact=prep.K, dense16=prep.dense16, coo16=prep.coo16,
-                lam_bp=int(lam * 10000))
+            with get_profiler().sampled("scan-pref") as probe:
+                out = solve_packed_pref(
+                    arr, prep.pref_rows, prep.pref_idx,
+                    off_alloc, off_price, off_rank,
+                    G=G_pad, O=O_pad, U=prep.U_pad, N=N,
+                    P=prep.pref_rows.shape[0], right_size=rs,
+                    compact=prep.K, dense16=prep.dense16, coo16=prep.coo16,
+                    lam_bp=int(lam * 10000))
+                probe.dispatched(out)
             return out, "scan-pref"
         # pallas needs a 128-multiple node axis; never exceed the
         # configured cap to get one — fall back to the scan path instead
@@ -1575,12 +1585,14 @@ class JaxSolver:
                 rs = self.options.right_size if prep.right_size is None \
                     else prep.right_size
                 self._note_dispatch("pallas", prep, arr, Np, (rs,))
-                out = solve_packed_pallas(
-                    arr, alloc8, rank_row, price_dev,
-                    G=G_pad, O=O_pad, U=prep.U_pad, N=Np,
-                    right_size=rs,
-                    compact=prep.K, dense16=prep.dense16,
-                    coo16=prep.coo16)
+                with get_profiler().sampled("pallas") as probe:
+                    out = solve_packed_pallas(
+                        arr, alloc8, rank_row, price_dev,
+                        G=G_pad, O=O_pad, U=prep.U_pad, N=Np,
+                        right_size=rs,
+                        compact=prep.K, dense16=prep.dense16,
+                        coo16=prep.coo16)
+                    probe.dispatched(out)
                 prep.N = Np
                 return out, "pallas"
             except Exception as e:  # noqa: BLE001
@@ -1595,11 +1607,13 @@ class JaxSolver:
         rs = self.options.right_size if prep.right_size is None \
             else prep.right_size
         self._note_dispatch("scan", prep, arr, N, (rs,))
-        out = solve_packed(
-            arr, off_alloc, off_price, off_rank,
-            G=G_pad, O=O_pad, U=prep.U_pad, N=N,
-            right_size=rs,
-            compact=prep.K, dense16=prep.dense16, coo16=prep.coo16)
+        with get_profiler().sampled("scan") as probe:
+            out = solve_packed(
+                arr, off_alloc, off_price, off_rank,
+                G=G_pad, O=O_pad, U=prep.U_pad, N=N,
+                right_size=rs,
+                compact=prep.K, dense16=prep.dense16, coo16=prep.coo16)
+            probe.dispatched(out)
         return out, "scan"
 
     def _dispatch_resident(self, prep: "_Prepared", packed: np.ndarray):
@@ -1909,20 +1923,26 @@ class BatchPendingSolve:
         if use_pallas:
             alloc8, rank_row, price = solver._device_offerings_pallas(
                 p0.catalog, O)
-            self._dev = solve_packed_pallas_batch(
-                self._rows, alloc8, rank_row, price,
-                C=self._C_pad, G=G, O=O, U=p0.U_pad, N=self._N_run,
-                right_size=solver.options.right_size,
-                compact=self._K, dense16=self._dense16, coo16=self._coo16)
+            with get_profiler().sampled("pallas-batch") as probe:
+                self._dev = solve_packed_pallas_batch(
+                    self._rows, alloc8, rank_row, price,
+                    C=self._C_pad, G=G, O=O, U=p0.U_pad, N=self._N_run,
+                    right_size=solver.options.right_size,
+                    compact=self._K, dense16=self._dense16,
+                    coo16=self._coo16)
+                probe.dispatched(self._dev)
             self._path = "pallas-batch"
         else:
             off_alloc, off_price, off_rank = solver._device_offerings(
                 p0.catalog, O)
-            self._dev = solve_packed_batch(
-                self._rows, off_alloc, off_price, off_rank,
-                G=G, O=O, U=p0.U_pad, N=self._N_run,
-                right_size=solver.options.right_size,
-                compact=self._K, dense16=self._dense16, coo16=self._coo16)
+            with get_profiler().sampled("scan-batch") as probe:
+                self._dev = solve_packed_batch(
+                    self._rows, off_alloc, off_price, off_rank,
+                    G=G, O=O, U=p0.U_pad, N=self._N_run,
+                    right_size=solver.options.right_size,
+                    compact=self._K, dense16=self._dense16,
+                    coo16=self._coo16)
+                probe.dispatched(self._dev)
             self._path = "scan-batch"
         get_devtel().note_dispatch(
             self._path,

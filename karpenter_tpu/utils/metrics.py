@@ -9,6 +9,7 @@ text exposition format so the numbers are scrapeable without client libs.
 from __future__ import annotations
 
 import threading
+import time
 from collections.abc import Sequence
 
 _DEFAULT_BUCKETS = (0.001, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0,
@@ -34,8 +35,8 @@ class _Child:
     def set(self, value: float) -> None:
         self._metric._set(self._labels, value)
 
-    def observe(self, value: float) -> None:
-        self._metric._observe(self._labels, value)
+    def observe(self, value: float, exemplar: dict | None = None) -> None:
+        self._metric._observe(self._labels, value, exemplar)
 
     def get(self) -> float:
         return self._metric._get(self._labels)
@@ -66,8 +67,8 @@ class _Metric:
     def set(self, value: float) -> None:
         self.labels().set(value)
 
-    def observe(self, value: float) -> None:
-        self.labels().observe(value)
+    def observe(self, value: float, exemplar: dict | None = None) -> None:
+        self.labels().observe(value, exemplar)
 
     def get(self, *label_values: str) -> float:
         return self._get(tuple(str(v) for v in label_values))
@@ -80,7 +81,7 @@ class _Metric:
         with self._lock:
             self._values[labels] = value
 
-    def _observe(self, labels, value):
+    def _observe(self, labels, value, exemplar=None):
         raise TypeError(f"{self.kind} does not support observe()")
 
     def _get(self, labels):
@@ -107,6 +108,11 @@ class _Metric:
             lines.append(f"{self.name}{_fmt_labels(self.label_names, labels)} {value}")
         return lines
 
+    def _render_om(self) -> list[str]:
+        """OpenMetrics-flavored lines (exemplar-bearing families
+        override); identical to the plain text render by default."""
+        return self._render()
+
 
 def _escape(v: str) -> str:
     return v.replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
@@ -122,6 +128,21 @@ def _fmt_labels(names, values, extra: str = "") -> str:
 class Counter(_Metric):
     kind = "counter"
 
+    def _render_om(self) -> list[str]:
+        """OpenMetrics counter shape: the FAMILY is named without the
+        ``_total`` suffix and samples carry it back — a strict
+        OpenMetrics parser (Prometheus with exemplar scraping on)
+        rejects a TYPE line whose family name ends in _total, failing
+        the whole scrape."""
+        family = self.name[:-len("_total")] \
+            if self.name.endswith("_total") else self.name
+        lines = [f"# HELP {family} {self.help}",
+                 f"# TYPE {family} counter"]
+        for labels, value in sorted(self.samples().items()):
+            lines.append(f"{family}_total"
+                         f"{_fmt_labels(self.label_names, labels)} {value}")
+        return lines
+
 
 class Gauge(_Metric):
     kind = "gauge"
@@ -136,8 +157,13 @@ class Histogram(_Metric):
         self._counts: dict[tuple[str, ...], list[int]] = {}
         self._sums: dict[tuple[str, ...], float] = {}
         self._totals: dict[tuple[str, ...], int] = {}
+        # OpenMetrics exemplars: LAST exemplar per (labelset, bucket) —
+        # cardinality is bounded by len(buckets)+1 per labelset BY
+        # CONSTRUCTION (tests/test_prof.py pins it); the plain text
+        # render never shows them (content negotiation only)
+        self._exemplars: dict[tuple, tuple[dict, float, float]] = {}
 
-    def _observe(self, labels, value):
+    def _observe(self, labels, value, exemplar=None):
         with self._lock:
             counts = self._counts.setdefault(labels, [0] * len(self.buckets))
             idx = next((j for j, b in enumerate(self.buckets) if value <= b), None)
@@ -145,6 +171,11 @@ class Histogram(_Metric):
                 counts[idx] += 1
             self._sums[labels] = self._sums.get(labels, 0.0) + value
             self._totals[labels] = self._totals.get(labels, 0) + 1
+            if exemplar:
+                self._exemplars[(labels,
+                                 len(self.buckets) if idx is None
+                                 else idx)] = \
+                    (dict(exemplar), float(value), time.time())
 
     def _get(self, labels):
         with self._lock:
@@ -163,25 +194,42 @@ class Histogram(_Metric):
             self._counts.clear()
             self._sums.clear()
             self._totals.clear()
+            self._exemplars.clear()
 
-    def _render(self) -> list[str]:
+    def _render(self, exemplars: bool = False) -> list[str]:
         lines = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} histogram"]
         with self._lock:
             items = [(lv, list(c), self._sums.get(lv, 0.0), self._totals.get(lv, 0))
                      for lv, c in self._counts.items()]
+            ex = dict(self._exemplars) if exemplars else {}
         for labels, counts, s, total in sorted(items):
             cum = 0
-            for b, c in zip(self.buckets, counts):
+            for j, (b, c) in enumerate(zip(self.buckets, counts)):
                 cum += c
                 le = f'le="{b}"'
                 lines.append(f"{self.name}_bucket"
-                             f"{_fmt_labels(self.label_names, labels, le)} {cum}")
+                             f"{_fmt_labels(self.label_names, labels, le)} {cum}"
+                             + _fmt_exemplar(ex.get((labels, j))))
             le_inf = 'le="+Inf"'
             lines.append(f"{self.name}_bucket"
-                         f"{_fmt_labels(self.label_names, labels, le_inf)} {total}")
+                         f"{_fmt_labels(self.label_names, labels, le_inf)} {total}"
+                         + _fmt_exemplar(ex.get((labels, len(self.buckets)))))
             lines.append(f"{self.name}_sum{_fmt_labels(self.label_names, labels)} {s}")
             lines.append(f"{self.name}_count{_fmt_labels(self.label_names, labels)} {total}")
         return lines
+
+    def _render_om(self) -> list[str]:
+        return self._render(exemplars=True)
+
+
+def _fmt_exemplar(ex: tuple[dict, float, float] | None) -> str:
+    """OpenMetrics exemplar suffix: `` # {trace_id="7"} value ts`` —
+    empty when no exemplar is attached to the bucket."""
+    if ex is None:
+        return ""
+    lbls, value, ts = ex
+    lset = ",".join(f'{k}="{_escape(str(v))}"' for k, v in lbls.items())
+    return f" # {{{lset}}} {value} {round(ts, 3)}"
 
 
 def render() -> str:
@@ -191,6 +239,23 @@ def render() -> str:
     out: list[str] = []
     for m in metrics_:
         out.extend(m._render())
+    return "\n".join(out) + "\n"
+
+
+def render_openmetrics() -> str:
+    """Exemplar-bearing OpenMetrics-flavored exposition: the SAME
+    families and sample lines as :func:`render`, plus histogram bucket
+    exemplars (`` # {trace_id="..."} value ts``) and the ``# EOF``
+    terminator.  Served by the metrics server under content negotiation
+    (``Accept: application/openmetrics-text``); the plain text render
+    is byte-for-byte unchanged — exemplars never leak into it
+    (tests/test_prof.py pins both)."""
+    with _registry_lock:
+        metrics_ = list(_registry)
+    out: list[str] = []
+    for m in metrics_:
+        out.extend(m._render_om())
+    out.append("# EOF")
     return "\n".join(out) + "\n"
 
 
@@ -429,6 +494,43 @@ RESIDENT_DELTA_BYTES = Histogram(
     "delta pair on warm windows; the full packed buffer on rebuilds)",
     (), buckets=(256, 1 << 10, 1 << 12, 1 << 14, 1 << 16, 1 << 18,
                  1 << 20, 1 << 22))
+
+# Device profiling plane (karpenter_tpu/obs/prof.py + obs/watchdog.py):
+# sampled device-time attribution + anomaly-triggered triage bundles
+# (docs/design/profiling.md).
+DEVICE_TIME = Histogram(
+    "karpenter_tpu_device_time_seconds",
+    "Sampled decomposition of the async dispatch->result wall per "
+    "kernel: dispatch (host launch until the call returns), execute "
+    "(block_until_ready after launch — true device execution), fetch "
+    "(device->host copy of the result).  Fed by the profiler's "
+    "synchronization brackets (every Nth dispatch per kernel), which "
+    "the host-side solve_phase histograms structurally cannot "
+    "decompose under async dispatch.", ("kernel", "phase"),
+    buckets=SOLVE_PHASE_BUCKETS)
+PROF_SAMPLES = Counter(
+    "karpenter_tpu_prof_samples_total",
+    "Sampled (synchronized) dispatches per kernel — the denominator "
+    "context for device_time_seconds", ("kernel",))
+PROF_OVERHEAD = Gauge(
+    "karpenter_tpu_prof_overhead_fraction",
+    "Profiler self-overhead: the sampled brackets' extra fetch wall "
+    "over the estimated total dispatch wall (steady-state gate <1%, "
+    "asserted in tests and surfaced on /statusz)", ())
+WATCHDOG_BREACHES = Counter(
+    "karpenter_tpu_watchdog_breaches_total",
+    "Anomaly-watchdog breaches by kernel and phase (phase 'recompile' "
+    "= a jit-recompile burst inside the rolling window; others = a "
+    "sampled duration far outside its EWMA baseline)",
+    ("kernel", "phase"))
+TRIAGE_BUNDLES = Counter(
+    "karpenter_tpu_triage_bundles_total",
+    "Triage bundles written to the .triage/ directory by trigger "
+    "(slow_kernel, recompile_burst, slo_burn)", ("trigger",))
+WATCHDOG_SUPPRESSED = Counter(
+    "karpenter_tpu_watchdog_suppressed_total",
+    "Breaches whose triage bundle was suppressed by the rate limit, "
+    "by trigger", ("trigger",))
 
 LEADER = Gauge(
     "karpenter_tpu_leader",

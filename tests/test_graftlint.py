@@ -973,3 +973,108 @@ def test_gl108_metrics_without_allowlist_clean():
     # metrics fixtures without the explain plane are out of scope
     assert_clean("SOLVE_PATH = 1\n", "GL108",
                  "karpenter_tpu/utils/metrics.py")
+
+
+# -- GL109: blocking-sync-in-hot-path (karpenter_tpu/obs/prof.py) ------------
+
+RESIDENT_PATH = "karpenter_tpu/resident/_snippet.py"
+PARALLEL_PATH = "karpenter_tpu/parallel/_snippet.py"
+
+
+def test_gl109_block_until_ready_on_hot_path_bad():
+    assert_flags(
+        """
+        import numpy as np
+
+        def dispatch(prep, arr):
+            out = solve_packed(arr)
+            out.block_until_ready()
+            return np.asarray(out)
+        """, "GL109", SOLVER_PATH)
+
+
+def test_gl109_jax_block_and_device_get_bad():
+    for call in ("jax.block_until_ready(out)", "jax.device_get(out)"):
+        assert_flags(
+            f"""
+            import jax
+
+            def fetch(out):
+                {call}
+                return out
+            """, "GL109", PARALLEL_PATH)
+
+
+def test_gl109_item_on_hot_path_bad():
+    assert_flags(
+        """
+        def decode(out_dev):
+            return out_dev[0].item()
+        """, "GL109", PREEMPT_PATH)
+
+
+def test_gl109_sampled_scope_good():
+    # the profiler's synchronization bracket is the sanctioned scope:
+    # a blocking sync inside `with ...sampled(...)` is the whole point
+    assert_clean(
+        """
+        import jax
+        from karpenter_tpu.obs.prof import get_profiler
+
+        def dispatch(arr):
+            with get_profiler().sampled("scan") as probe:
+                out = solve_packed(arr)
+                jax.block_until_ready(out)
+                probe.dispatched(out)
+            return out
+        """, "GL109", SOLVER_PATH)
+
+
+def test_gl109_warmup_and_probe_harnesses_good():
+    # measurement/warmup functions exist to synchronize — exempt by
+    # name, including defs nested inside them (compute_handle's `run`)
+    assert_clean(
+        """
+        import jax
+
+        def warmup_solver(pending):
+            for dev in pending:
+                dev.block_until_ready()
+
+        def prewarm(entries):
+            jax.block_until_ready(entries)
+
+        def compute_handle(prep, dev_in):
+            jax.block_until_ready(dev_in)
+
+            def run(k=1):
+                outs = [f() for _ in range(k)]
+                outs[-1].block_until_ready()
+                return outs[-1]
+
+            return run
+        """, "GL109", RESIDENT_PATH)
+
+
+def test_gl109_np_asarray_fetch_not_flagged():
+    # np.asarray at the decode boundary is the sanctioned fetch (GL001
+    # owns the inside-a-kernel case); dict .items() is not .item()
+    assert_clean(
+        """
+        import numpy as np
+
+        def fetch(out_dev, stats):
+            out = np.asarray(out_dev)
+            for k, v in stats.items():
+                pass
+            return out
+        """, "GL109", SOLVER_PATH)
+
+
+def test_gl109_out_of_scope_paths_clean():
+    # the rule guards the solver hot path, not controllers/ or obs/
+    assert_clean(
+        """
+        def reconcile(out):
+            out.block_until_ready()
+        """, "GL109", CTRL_PATH)

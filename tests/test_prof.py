@@ -1,0 +1,582 @@
+"""Device profiling + anomaly watchdog (karpenter_tpu/obs/prof.py,
+obs/watchdog.py, docs/design/profiling.md).
+
+Covers the ISSUE-10 acceptance surface:
+
+- sampling cadence (every Nth dispatch per kernel) and the inactive
+  fast path's overhead bound;
+- probe measurement on the CPU backend, including device/host timer
+  agreement (both brackets read the same clock, so the three phases
+  must tile the bracketed wall) and fault-swallowing (a Mosaic runtime
+  fault must surface at the caller's fetch, never out of the probe);
+- steady-state profiler self-overhead < 1% of solve wall, measured on
+  the REAL JaxSolver path;
+- watchdog baseline/trigger/rate-limit determinism under the
+  VirtualClock, exactly-once bundle emission on an injected
+  slow-kernel scenario, recompile-burst detection, and triage bundle
+  size/FIFO caps + completeness;
+- /debug/profile single-flight + duration cap on a live MetricsServer;
+- OpenMetrics exemplars: plain render unchanged, exemplar cardinality
+  bounded per (labelset, bucket), solve_phase buckets carrying
+  trace_id exemplars from the live solve path;
+- chaos determinism: profiler sampling must not perturb the seeded
+  event-trace digest.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+from pathlib import Path
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from karpenter_tpu.chaos.clock import VirtualClock
+from karpenter_tpu.obs.prof import (
+    MAX_CAPTURE_S, MIN_CAPTURE_S, DeviceProfiler, aggregate_samples,
+    clamp_capture_duration, get_profiler, samples_to_span_dicts,
+)
+from karpenter_tpu.obs.watchdog import (
+    Baseline, Watchdog, write_triage_bundle,
+)
+from karpenter_tpu.utils import metrics
+
+
+@pytest.fixture()
+def tiny_kernel():
+    return jax.jit(lambda x: x * 2 + 1)
+
+
+def _fake_catalog():
+    from karpenter_tpu.catalog import (
+        CatalogArrays, InstanceTypeProvider, PricingProvider,
+    )
+    from karpenter_tpu.cloud.fake import FakeCloud
+
+    cloud = FakeCloud()
+    pricing = PricingProvider(cloud)
+    itp = InstanceTypeProvider(cloud, pricing)
+    arrays = CatalogArrays.build(itp.list())
+    pricing.close()
+    return arrays
+
+
+def _dispatch_n(prof: DeviceProfiler, fn, n: int, kernel: str = "toy"):
+    x = jnp.ones(512, jnp.int32)
+    actives = 0
+    for _ in range(n):
+        with prof.sampled(kernel) as probe:
+            out = fn(x)
+            probe.dispatched(out)
+        actives += 1 if probe else 0
+    return actives
+
+
+class TestSamplingCadence:
+    def test_every_nth_dispatch_per_kernel(self, tiny_kernel):
+        prof = DeviceProfiler(interval=4)
+        assert _dispatch_n(prof, tiny_kernel, 16) == 4
+        # per-kernel counters: a second kernel starts its own cadence
+        assert _dispatch_n(prof, tiny_kernel, 8, kernel="other") == 2
+
+    def test_first_dispatch_is_sampled(self, tiny_kernel):
+        # smoke/bench get a split without spinning the cadence
+        prof = DeviceProfiler(interval=64)
+        assert _dispatch_n(prof, tiny_kernel, 1) == 1
+        assert prof.samples == 1
+
+    def test_interval_zero_disables(self, tiny_kernel):
+        prof = DeviceProfiler(interval=0)
+        assert _dispatch_n(prof, tiny_kernel, 8) == 0
+        assert prof.samples == 0
+
+    def test_inactive_fast_path_is_cheap(self):
+        prof = DeviceProfiler(interval=1_000_000)
+        prof.sampled("warm")          # burn the first-sample slot
+        t0 = time.perf_counter()
+        n = 5000
+        for _ in range(n):
+            with prof.sampled("warm") as probe:
+                probe.dispatched(None)
+        per = (time.perf_counter() - t0) / n
+        # generous envelope (same style as the obs hot-path bounds):
+        # one lock + dict increment + one __slots__ object
+        assert per < 50e-6, f"inactive probe cost {per * 1e6:.1f}us"
+
+
+class TestProbeMeasurement:
+    def test_phases_measured_and_metered(self, tiny_kernel):
+        prof = DeviceProfiler(interval=1)
+        before = metrics.PROF_SAMPLES.get("toy")
+        x = jnp.ones(512, jnp.int32)
+        with prof.sampled("toy") as probe:
+            out = tiny_kernel(x)
+            probe.dispatched(out)
+        assert probe.dispatch_s > 0.0
+        assert probe.execute_s >= 0.0 and probe.fetch_s >= 0.0
+        assert prof.samples == 1
+        assert metrics.PROF_SAMPLES.get("toy") == before + 1
+        snap = prof.snapshot()
+        assert snap["kernels"]["toy"]["samples"] == 1
+        assert snap["kernels"]["toy"]["dispatch_ms"] > 0.0
+
+    def test_device_host_timer_agreement_on_cpu(self, tiny_kernel):
+        """On the CPU backend both 'device' brackets and the host wall
+        read perf_counter, so the three phases must tile the bracketed
+        wall: sum(phases) <= wall, with only bookkeeping slack."""
+        prof = DeviceProfiler(interval=1)
+        x = jnp.ones((256, 256), jnp.float32)
+        f = jax.jit(lambda a: a @ a)
+        f(x).block_until_ready()        # compile outside the bracket
+        t0 = time.perf_counter()
+        with prof.sampled("agree") as probe:
+            out = f(x)
+            probe.dispatched(out)
+        wall = time.perf_counter() - t0
+        total = probe.dispatch_s + probe.execute_s + probe.fetch_s
+        assert 0.0 < total <= wall
+        assert wall - total < 0.05, \
+            f"phases {total:.6f}s leave {wall - total:.6f}s unaccounted"
+
+    def test_probe_swallows_fetch_faults(self):
+        """An async runtime fault must surface at the CALLER's fetch
+        (where the pallas->scan fallback lives) — the probe discards
+        its sample instead of raising."""
+        prof = DeviceProfiler(interval=1)
+
+        class Exploding:
+            def block_until_ready(self):
+                raise RuntimeError("mosaic fault")
+
+        with prof.sampled("faulty") as probe:
+            probe.dispatched(Exploding())
+        assert not probe.active
+        assert prof.samples == 0
+
+    def test_overhead_fraction_under_1pct_on_real_solver(self):
+        """The acceptance gate: steady-state profiler overhead < 1% of
+        solve wall on the REAL JaxSolver dispatch path."""
+        from karpenter_tpu.apis.pod import ResourceRequests, make_pods
+        from karpenter_tpu.solver.jax_backend import JaxSolver
+        from karpenter_tpu.solver.types import SolveRequest, SolverOptions
+
+        catalog = _fake_catalog()
+        pods = make_pods(16, name_prefix="prof",
+                         requests=ResourceRequests(500, 1024, 0, 1))
+        from karpenter_tpu.obs.prof import DEFAULT_INTERVAL
+
+        solver = JaxSolver(SolverOptions(backend="jax"))
+        solver.solve(SolveRequest(pods, catalog))   # compile outside
+        prof = get_profiler()
+        prof.reset()
+        prev = prof.interval
+        prof.interval = DEFAULT_INTERVAL    # the production cadence —
+        # overhead is the bracket's (execute + fetch) serialization
+        # bound paid every Nth dispatch, so the gate is a statement
+        # about the steady state, not a forced-sampling run
+        try:
+            for _ in range(2 * DEFAULT_INTERVAL + 2):
+                solver.solve(SolveRequest(pods, catalog))
+        finally:
+            prof.interval = prev
+        assert prof.samples >= 2
+        frac = prof.overhead_fraction()
+        assert 0.0 <= frac < 0.01, f"profiler overhead {frac:.4f}"
+        # the same value /statusz surfaces
+        assert prof.snapshot()["overhead_fraction"] == round(frac, 6)
+
+    def test_capture_forced_samples_excluded_from_overhead(
+            self, tiny_kernel):
+        """A /debug/profile window samples 1:1 by design — its forced
+        samples must never inflate the cumulative steady-state
+        overhead gauge (it would sit above the <1% gate forever)."""
+        prof = DeviceProfiler(interval=0)
+        res: dict = {}
+        t = threading.Thread(
+            target=lambda: res.update(s=prof.capture(0.4)))
+        t.start()
+        time.sleep(0.1)
+        _dispatch_n(prof, tiny_kernel, 4)
+        t.join()
+        assert len(res["s"]) == 4           # capture saw the dispatches
+        assert prof.samples == 0            # steady accounting untouched
+        assert prof.overhead_s == 0.0
+        assert prof.overhead_fraction() == 0.0
+
+    def test_fetch_false_skips_device_get(self, tiny_kernel):
+        """Resident-buffer updates stay on device in steady state —
+        their probe must not measure (or pay) a full-state D2H."""
+        prof = DeviceProfiler(interval=1)
+        x = jnp.ones(512, jnp.int32)
+        with prof.sampled("resident-update") as probe:
+            out = tiny_kernel(x)
+            probe.dispatched(out, fetch=False)
+        assert probe.fetch_s == 0.0
+        assert probe.execute_s >= 0.0
+        assert prof.samples == 1
+
+    def test_reset_keeps_cadence_but_clears_stats(self, tiny_kernel):
+        prof = DeviceProfiler(interval=4)
+        _dispatch_n(prof, tiny_kernel, 6)
+        prof.reset()
+        assert prof.samples == 0 and prof.dispatches_seen == 0
+        # cadence position survives: dispatches 6,7 are not multiples
+        # of 4, so nothing samples until dispatch 8
+        assert _dispatch_n(prof, tiny_kernel, 1) == 0
+        assert _dispatch_n(prof, tiny_kernel, 2) == 1
+
+
+class TestWatchdog:
+    def _warm(self, wd: Watchdog, n: int = 10, value: float = 0.010):
+        for _ in range(n):
+            wd.observe("scan", "execute", value)
+
+    def test_no_breach_during_warmup(self, tmp_path):
+        wd = Watchdog(triage_dir=str(tmp_path), warmup=5)
+        for _ in range(4):
+            assert not wd.observe("scan", "execute", 5.0)
+        assert wd.breaches == 0
+
+    def test_slow_kernel_fires_exactly_once_rate_limited(self, tmp_path):
+        """The acceptance scenario: an injected slow kernel breaches,
+        produces ONE complete triage bundle, and every further breach
+        inside the rate-limit window is suppressed — deterministic
+        under the VirtualClock."""
+        wd = Watchdog(triage_dir=str(tmp_path), rate_limit_s=600.0)
+        with VirtualClock().installed():
+            self._warm(wd)
+            assert wd.observe("scan", "execute", 0.250)
+            for _ in range(5):
+                wd.observe("scan", "execute", 0.250)
+            assert wd.bundles == 1
+            assert wd.breaches == 6
+            assert wd.suppressed == 5
+            bundles = [p for p in tmp_path.iterdir() if p.is_dir()]
+            assert len(bundles) == 1
+            # past the rate-limit window (virtual time!) it re-arms
+            time.sleep(601)
+            assert wd.observe("scan", "execute", 0.250)
+            assert wd.bundles == 2
+
+    def test_breach_does_not_poison_baseline(self, tmp_path):
+        wd = Watchdog(triage_dir=str(tmp_path), rate_limit_s=1e9)
+        with VirtualClock().installed():
+            self._warm(wd)
+            for _ in range(20):
+                wd.observe("scan", "execute", 0.250)
+            # the baseline still reflects the warmup regime, so the
+            # anomaly keeps breaching instead of becoming the new normal
+            assert wd.breaches == 20
+
+    def test_sub_floor_wobble_never_breaches(self, tmp_path):
+        wd = Watchdog(triage_dir=str(tmp_path))
+        for _ in range(10):
+            wd.observe("fast", "execute", 0.00001)
+        assert not wd.observe("fast", "execute", 0.0009)  # < MIN_ABS_S
+        assert wd.breaches == 0
+
+    def test_bundle_completeness(self, tmp_path):
+        wd = Watchdog(triage_dir=str(tmp_path), rate_limit_s=0.0)
+        self._warm(wd)
+        wd.observe("scan", "execute", 0.250)
+        bdir = Path(wd.last_bundle_path)
+        assert bdir.is_dir()
+        manifest = json.loads((bdir / "bundle.json").read_text())
+        for key in ("trigger", "detail", "worst_pods", "ledger",
+                    "device_telemetry", "profiler", "watchdog",
+                    "span_count"):
+            assert key in manifest, f"bundle missing {key!r}"
+        assert manifest["trigger"] == "slow_kernel"
+        assert manifest["detail"]["kernel"] == "scan"
+        assert manifest["detail"]["value_s"] == 0.25
+        assert (bdir / "spans.jsonl").exists()
+
+    def test_bundle_fifo_cap(self, tmp_path):
+        wd = Watchdog(triage_dir=str(tmp_path), rate_limit_s=0.0,
+                      max_bundles=3)
+        with VirtualClock().installed():
+            self._warm(wd)
+            for _ in range(7):
+                wd.observe("scan", "execute", 0.250)
+                time.sleep(1)
+        dirs = sorted(p.name for p in tmp_path.iterdir() if p.is_dir())
+        assert len(dirs) == 3
+        # FIFO: the survivors are the NEWEST bundles (names carry the
+        # monotonic sequence)
+        assert wd.last_bundle_path.endswith(dirs[-1])
+
+    def test_recompile_burst_grace_ignores_cold_start(self, tmp_path):
+        """A fresh process compiling its kernel set must never page:
+        bursts inside the cold-start grace are recorded, not breached."""
+        with VirtualClock().installed():
+            wd = Watchdog(triage_dir=str(tmp_path))
+            for _ in range(wd.RECOMPILE_BURST * 2):
+                assert not wd.note_recompile("scan")
+            assert wd.breaches == 0
+            # past the grace the detector arms (window cleared by time)
+            time.sleep(wd.RECOMPILE_GRACE_S + wd.RECOMPILE_WINDOW_S)
+            for _ in range(wd.RECOMPILE_BURST - 1):
+                assert not wd.note_recompile("scan")
+            assert wd.note_recompile("scan")
+
+    def test_recompile_burst_breaches_and_rearms(self, tmp_path):
+        wd = Watchdog(triage_dir=str(tmp_path), rate_limit_s=0.0,
+                      recompile_grace_s=0.0)
+        with VirtualClock().installed():
+            for i in range(wd.RECOMPILE_BURST - 1):
+                assert not wd.note_recompile("scan")
+            assert wd.note_recompile("scan")
+            assert wd.bundles == 1
+            # the window cleared on trigger: the next event alone
+            # cannot re-fire
+            assert not wd.note_recompile("scan")
+            # events outside the rolling window fall off
+            time.sleep(wd.RECOMPILE_WINDOW_S + 1)
+            for i in range(wd.RECOMPILE_BURST - 1):
+                assert not wd.note_recompile("scan")
+
+    def test_devtel_recompile_sink_reaches_watchdog(self):
+        """get_profiler() installs the devtel hook; a new dispatch
+        signature must tick the singleton watchdog's burst window."""
+        from karpenter_tpu.obs.devtel import get_devtel
+        from karpenter_tpu.obs.watchdog import get_watchdog
+
+        get_profiler()      # ensures the hook is installed
+        wd = get_watchdog()
+        before = len(wd._recompiles)
+        get_devtel().note_dispatch(
+            "prof-test-kernel", ("unique-sig", time.perf_counter()))
+        assert len(wd._recompiles) >= before + 1
+
+    def test_triage_bundle_direct_writer(self, tmp_path):
+        p = write_triage_bundle("slo_burn", {"burned": ["p99"]},
+                                triage_dir=str(tmp_path))
+        manifest = json.loads((Path(p) / "bundle.json").read_text())
+        assert manifest["trigger"] == "slo_burn"
+        assert manifest["detail"] == {"burned": ["p99"]}
+
+
+class TestCapture:
+    def test_clamp(self):
+        assert clamp_capture_duration(99.0) == MAX_CAPTURE_S
+        assert clamp_capture_duration(0.0001) == MIN_CAPTURE_S
+        assert clamp_capture_duration("nonsense") == 1.0
+        assert clamp_capture_duration(0.5) == 0.5
+
+    def test_capture_is_single_flight_and_collects(self, tiny_kernel):
+        prof = DeviceProfiler(interval=0)    # steady sampling off:
+        # only the capture window may force samples
+        res: dict = {}
+        t = threading.Thread(
+            target=lambda: res.update(samples=prof.capture(0.5)))
+        t.start()
+        time.sleep(0.1)
+        assert prof.capture(0.1) is None     # second flight refused
+        _dispatch_n(prof, tiny_kernel, 3)
+        t.join()
+        assert res["samples"] is not None and len(res["samples"]) == 3
+        s = res["samples"][0]
+        assert s["kernel"] == "toy" and "execute_s" in s
+        # after the flight clears, a fresh capture is admitted
+        assert prof.capture(MIN_CAPTURE_S) == []
+
+    def test_samples_to_chrome_export_path(self):
+        samples = [{"kernel": "scan", "t_us": 10.0, "dispatch_s": 0.001,
+                    "execute_s": 0.002, "fetch_s": 0.0005}]
+        dicts = samples_to_span_dicts(samples)
+        assert [d["name"] for d in dicts] == [
+            "device.dispatch", "device.execute", "device.fetch"]
+        assert dicts[1]["start_us"] == 10.0 + 1000.0
+        from karpenter_tpu.obs.export import dicts_to_chrome
+
+        chrome = dicts_to_chrome(dicts)
+        names = {e["name"] for e in chrome["traceEvents"]}
+        assert "device.execute" in names
+        agg = aggregate_samples(samples)
+        assert agg["scan"]["execute_ms"] == 2.0
+
+
+class TestDebugProfileEndpoint:
+    @pytest.fixture()
+    def server(self):
+        from karpenter_tpu.operator.server import MetricsServer
+
+        srv = MetricsServer(host="127.0.0.1", port=0).start()
+        yield srv
+        srv.stop()
+
+    @staticmethod
+    def _get(port, path, timeout=15.0):
+        try:
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}{path}",
+                    timeout=timeout) as resp:
+                return resp.status, json.loads(resp.read())
+        except urllib.error.HTTPError as e:
+            return e.code, json.loads(e.read())
+
+    def test_capture_endpoint_payload(self, server, tiny_kernel):
+        prof = get_profiler()
+        res: dict = {}
+        t = threading.Thread(target=lambda: res.update(
+            r=self._get(server.port, "/debug/profile?duration_s=0.4")))
+        t.start()
+        time.sleep(0.1)
+        _dispatch_n(prof, tiny_kernel, 2, kernel="endpoint-toy")
+        t.join()
+        code, doc = res["r"]
+        assert code == 200
+        assert doc["duration_s"] == 0.4
+        assert doc["sample_count"] >= 2
+        assert "endpoint-toy" in doc["device_time"]
+        assert doc["chrome"]["traceEvents"]
+
+    def test_single_flight_429(self, server):
+        res: dict = {}
+        t = threading.Thread(target=lambda: res.update(
+            a=self._get(server.port, "/debug/profile?duration_s=1.0")))
+        t.start()
+        time.sleep(0.2)
+        code, doc = self._get(server.port,
+                              "/debug/profile?duration_s=0.2")
+        t.join()
+        assert res["a"][0] == 200
+        assert code == 429
+        assert "single-flight" in doc["error"]
+
+    def test_duration_capped(self, server):
+        # an absurd duration clamps to the cap instead of holding the
+        # handler (we only check the clamped value is reported — the
+        # clamp math itself is pinned in TestCapture)
+        code, doc = self._get(server.port,
+                              "/debug/profile?duration_s=0.05")
+        assert code == 200
+        assert doc["duration_s"] == MIN_CAPTURE_S
+
+
+class TestExemplars:
+    def test_plain_render_never_shows_exemplars(self):
+        h = metrics.Histogram("test_exemplar_plain_seconds", "t",
+                              ("k",), buckets=(0.1, 1.0))
+        h.labels("a").observe(0.05, exemplar={"trace_id": "7"})
+        text = "\n".join(h._render())
+        assert "trace_id" not in text
+        assert " # {" not in text
+
+    def test_openmetrics_exemplars_and_eof(self):
+        h = metrics.Histogram("test_exemplar_om_seconds", "t",
+                              ("k",), buckets=(0.1, 1.0))
+        h.labels("a").observe(0.05, exemplar={"trace_id": "7"})
+        h.labels("a").observe(99.0, exemplar={"trace_id": "9"})  # +Inf
+        om = "\n".join(h._render_om())
+        assert '# {trace_id="7"} 0.05' in om
+        assert '# {trace_id="9"} 99.0' in om
+        full = metrics.render_openmetrics()
+        assert full.rstrip().endswith("# EOF")
+
+    def test_exemplar_cardinality_bounded_per_bucket(self):
+        """The render round-trip cardinality pin: N distinct trace ids
+        into one bucket keep exactly ONE exemplar (last-write-wins) —
+        exemplars can never grow a family's exposition beyond
+        buckets+1 extra annotations per labelset."""
+        h = metrics.Histogram("test_exemplar_cardinality_seconds", "t",
+                              ("k",), buckets=(0.1, 1.0))
+        for i in range(100):
+            h.labels("a").observe(0.05, exemplar={"trace_id": str(i)})
+        om = "\n".join(h._render_om())
+        assert om.count(" # {") == 1
+        assert '# {trace_id="99"}' in om
+        assert len(h._exemplars) == 1
+
+    def test_counters_reject_observe_unchanged(self):
+        c = metrics.Counter("test_exemplar_counter_total", "t")
+        with pytest.raises(TypeError):
+            c.observe(1.0)
+
+    def test_solve_phase_carries_trace_id_exemplar_from_live_path(self):
+        """The satellite's end-to-end wire: a live JaxSolver solve must
+        attach the window trace id to its solve_phase buckets, so a
+        slow bucket links to /debug/traces?trace_id=."""
+        from karpenter_tpu.apis.pod import ResourceRequests, make_pods
+        from karpenter_tpu.solver.jax_backend import JaxSolver
+        from karpenter_tpu.solver.types import SolveRequest, SolverOptions
+
+        metrics.SOLVE_PHASE.reset()
+        catalog = _fake_catalog()
+        pods = make_pods(4, name_prefix="exemplar",
+                         requests=ResourceRequests(250, 512, 0, 1))
+        JaxSolver(SolverOptions(backend="jax")).solve(
+            SolveRequest(pods, catalog))
+        om = "\n".join(metrics.SOLVE_PHASE._render_om())
+        plain = "\n".join(metrics.SOLVE_PHASE._render())
+        assert '# {trace_id="' in om
+        assert "# {" not in plain
+
+    def test_pod_placement_exemplar_from_ledger(self):
+        from karpenter_tpu.obs.ledger import PlacementLedger
+
+        metrics.POD_PLACEMENT.reset()
+        led = PlacementLedger(capacity=8)
+        led.first_seen("ns/exemplar-pod")
+        led.resolve("ns/exemplar-pod", "placed", trace_id=4242)
+        om = "\n".join(metrics.POD_PLACEMENT._render_om())
+        assert '# {trace_id="4242"}' in om
+
+
+class TestChaosDeterminism:
+    def test_profiler_sampling_stays_out_of_digests(self):
+        """Pinned: the seeded chaos event-trace digest must be
+        identical with sampling forced on vs fully off — profiler
+        samples are real-time measurements and must never leak into
+        the deterministic replay record."""
+        from karpenter_tpu.chaos.runner import run_scenario
+
+        prof = get_profiler()
+        prev = prof.interval
+        try:
+            prof.interval = 1
+            res_on = run_scenario("calm", seed=3, rounds=3)
+            prof.interval = 0
+            res_off = run_scenario("calm", seed=3, rounds=3)
+        finally:
+            prof.interval = prev
+        assert res_on.digest == res_off.digest
+        assert not res_on.violations and not res_off.violations
+
+
+class TestWatchdogMetrics:
+    def test_breach_and_suppression_counters(self, tmp_path):
+        b0 = metrics.WATCHDOG_BREACHES.get("metered", "execute")
+        s0 = metrics.WATCHDOG_SUPPRESSED.get("slow_kernel")
+        t0 = metrics.TRIAGE_BUNDLES.get("slow_kernel")
+        wd = Watchdog(triage_dir=str(tmp_path), rate_limit_s=1e9)
+        for _ in range(10):
+            wd.observe("metered", "execute", 0.010)
+        wd.observe("metered", "execute", 0.250)
+        wd.observe("metered", "execute", 0.250)
+        assert metrics.WATCHDOG_BREACHES.get("metered", "execute") \
+            == b0 + 2
+        assert metrics.TRIAGE_BUNDLES.get("slow_kernel") == t0 + 1
+        assert metrics.WATCHDOG_SUPPRESSED.get("slow_kernel") == s0 + 1
+
+
+class TestBaselineMath:
+    def test_ewma_converges(self):
+        b = Baseline()
+        for _ in range(50):
+            b.update(0.010)
+        assert abs(b.mean - 0.010) < 1e-9
+        assert b.dev < 1e-9
+
+    def test_dev_tracks_spread(self):
+        b = Baseline()
+        for i in range(100):
+            b.update(0.010 if i % 2 else 0.020)
+        assert 0.003 < b.dev < 0.008

@@ -11,6 +11,7 @@ gang pod, and the short production-day soak (slow tier).
 from __future__ import annotations
 
 import time
+from pathlib import Path
 
 import pytest
 
@@ -435,17 +436,45 @@ class TestSoak:
         assert res.ledger_stats["transitions"], \
             "the day must exercise at least one lifecycle transition"
 
-    def test_broken_slo_fails_the_day(self, tmp_path):
+    def test_broken_slo_fails_the_day_and_writes_triage_bundle(
+            self, tmp_path):
+        import json
+
         from karpenter_tpu.chaos.soak import SHORT_DAY, SOAK_SLOS, run_soak
         from karpenter_tpu.obs.slo import SLOSpec
 
         impossible = SOAK_SLOS + (SLOSpec(
             name="impossible", objective="pod_placement_p99_s",
             threshold=-1.0),)
+        triage = tmp_path / "triage"
         res = run_soak(SHORT_DAY[:2], seed=1, slos=impossible,
-                       report_dir=str(tmp_path), echo=lambda *_: None)
+                       report_dir=str(tmp_path / "report"),
+                       triage_dir=str(triage), echo=lambda *_: None)
         assert not res.ok
         assert "impossible" in [r.spec.name for r in res.report.burned]
         burned = [r for r in res.report.burned
                   if r.spec.name == "impossible"][0]
         assert burned.violators, "burn report must name violating pods"
+        # the burn auto-writes a triage bundle (obs/watchdog.py) — the
+        # artifact CI uploads next to the soak report
+        assert res.triage_bundle and res.triage_bundle.endswith(
+            "-slo_burn")
+        manifest = json.loads(
+            (Path(res.triage_bundle) / "bundle.json").read_text())
+        assert manifest["trigger"] == "slo_burn"
+        assert "impossible" in manifest["detail"]["burned"]
+        assert (Path(res.triage_bundle) / "spans.jsonl").exists()
+
+    def test_passing_day_writes_no_slo_burn_bundle(self, tmp_path):
+        from karpenter_tpu.chaos.soak import SHORT_DAY, run_soak
+
+        triage = tmp_path / "triage"
+        res = run_soak(SHORT_DAY[:1], seed=1,
+                       report_dir=str(tmp_path / "report"),
+                       triage_dir=str(triage), echo=lambda *_: None)
+        assert res.triage_bundle == ""
+        # no slo_burn bundle on a passing day; an incidental watchdog
+        # breach (CPU jitter on a CI runner) may write a slow_kernel
+        # bundle, but run_soak routes it into THIS soak's triage dir
+        if triage.exists():
+            assert not list(triage.glob("*-slo_burn"))

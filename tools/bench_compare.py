@@ -49,6 +49,13 @@ METRICS: tuple[tuple[str, str], ...] = (
     ("resident.warm_h2d_max_bytes", "lower"),
     ("explain.solve_warm_p50_ms", "lower"),
     ("explain.d2h_fraction", "lower"),
+    # sampled device-time attribution (obs/prof.py): the headline
+    # kernel's true device-execute and fetch shares of exec_fetch, and
+    # the profiler's own steady-state overhead (<1% acceptance gate)
+    ("device_time.exec_fetch_decomposed.dispatch_ms", "lower"),
+    ("device_time.exec_fetch_decomposed.execute_ms", "lower"),
+    ("device_time.exec_fetch_decomposed.fetch_ms", "lower"),
+    ("device_time.profiler_overhead_fraction", "lower"),
 )
 
 _ROUND_RE = re.compile(r"BENCH_r(\d+)\.json$")

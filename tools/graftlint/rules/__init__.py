@@ -30,4 +30,5 @@ def all_rules() -> list[type[Rule]]:
         observability.UnclosedSpan,           # GL106
         observability.TelemetryInKernel,      # GL107
         observability.ReasonEnumDrift,        # GL108
+        observability.BlockingSyncInHotPath,  # GL109
     ]

@@ -1,4 +1,4 @@
-"""Family B additions — observability hygiene (GL106, GL107, GL108).
+"""Family B additions — observability hygiene (GL106-GL109).
 
 GL106: a span opened but not closed through a ``with`` block leaks on
 the exception path: the trace never finalizes (its slot sits in the
@@ -14,6 +14,18 @@ the numerics, not the Python.  The counter silently stops counting the
 moment the cache warms, which is worse than no metric: dashboards show
 a frozen value that looks alive.  All telemetry must live at dispatch
 level on the host (obs/devtel.py's contract).
+
+GL109: a blocking device sync (``block_until_ready`` /
+``jax.device_get`` / ``.item()``) on the solver hot path serializes the
+async pipeline on a full tunnel round trip (~65-70 ms measured) — the
+exact cost the pipelined stream exists to amortize.  The ONLY
+sanctioned blocking syncs are (a) the profiler's sampling brackets
+(``with ...sampled(...):`` scopes, obs/prof.py — every Nth dispatch
+pays one sync to decompose device time) and (b) measurement/warmup
+harnesses whose entire point is the sync (``compute_handle``,
+``warmup``/``prewarm`` functions, ``_probe*`` twins).  ``np.asarray``
+at the decode/fetch boundary is the sanctioned result fetch and is not
+flagged (GL001 already forbids it INSIDE traced bodies).
 
 GL108: the explain reason taxonomy lives in THREE places that must
 enumerate identical name sets — the device bit table
@@ -110,8 +122,9 @@ class UnclosedSpan(Rule):
 
 # telemetry receivers: module-level helper namespaces and the
 # metric-constant idiom (SOLVE_PHASE.labels(...).observe(...))
-_TELEMETRY_MODULES = {"metrics", "obs", "devtel", "ledger"}
-_TELEMETRY_FUNCS = {"_phase", "get_devtel", "get_ledger"}
+_TELEMETRY_MODULES = {"metrics", "obs", "devtel", "ledger", "prof"}
+_TELEMETRY_FUNCS = {"_phase", "get_devtel", "get_ledger", "get_profiler",
+                    "get_watchdog"}
 _METRIC_TERMINALS = {"labels", "observe", "inc", "dec"}
 
 
@@ -297,3 +310,87 @@ class ReasonEnumDrift(Rule):
                     module, anchor,
                     f"UNPLACED_REASONS vs explain REASON_BITS drift: "
                     f"{sorted(set(bits) ^ set(allow))}")
+
+
+# ---------------------------------------------------------------------------
+# GL109 — blocking-sync-in-hot-path (karpenter_tpu/obs/prof.py contract)
+# ---------------------------------------------------------------------------
+
+# function-name markers for sanctioned measurement/warmup harnesses:
+# their entire purpose is the synchronization (compute_handle's
+# k-dispatch slope, warmup/prewarm compile draining, the _probe twins)
+_GL109_EXEMPT_NAME_PARTS = ("warm", "compute_handle", "probe")
+
+
+class BlockingSyncInHotPath(Rule):
+    id = "GL109"
+    name = "blocking-sync-in-hot-path"
+    description = (
+        "block_until_ready / jax.device_get / .item() on the solver hot "
+        "path outside a sanctioned scope. A blocking sync serializes the "
+        "async pipeline on a full device round trip (~65-70 ms through "
+        "the TPU tunnel). Sampled device-time measurement belongs inside "
+        "a `with get_profiler().sampled(...)` bracket (obs/prof.py); "
+        "warmup/prewarm/compute_handle/_probe harnesses are exempt by "
+        "name; np.asarray at the decode boundary is the sanctioned fetch."
+    )
+    family = "B"
+    scope = ("karpenter_tpu/solver/*", "karpenter_tpu/parallel/*",
+             "karpenter_tpu/preempt/*", "karpenter_tpu/gang/*",
+             "karpenter_tpu/resident/*")
+
+    def check(self, module: SourceModule) -> Iterator[Finding]:
+        exempt = self._exempt_ranges(module.tree)
+        for node in ast.walk(module.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            what = self._blocking_sync(node)
+            if what and not any(a <= node.lineno <= b for a, b in exempt):
+                yield self.finding(
+                    module, node,
+                    f"blocking device sync `{what}` on the hot path — "
+                    f"serializes the async pipeline on a device round "
+                    f"trip; sample it inside `with ...sampled(...):` "
+                    f"(obs/prof.py) or move it to a warmup/probe harness")
+
+    @staticmethod
+    def _blocking_sync(call: ast.Call) -> str | None:
+        chain = attr_chain(call.func)
+        if not chain:
+            return None
+        terminal = chain[-1]
+        if terminal == "block_until_ready":
+            # covers both x.block_until_ready() and
+            # jax.block_until_ready(x)
+            return ".".join(chain[-2:]) if len(chain) > 1 else terminal
+        if terminal == "device_get" and len(chain) >= 2:
+            return ".".join(chain[-2:])
+        if terminal == "item" and isinstance(call.func, ast.Attribute) \
+                and not call.args and not call.keywords:
+            return ".item()"
+        return None
+
+    @classmethod
+    def _exempt_ranges(cls, tree: ast.AST) -> list[tuple[int, int]]:
+        """(start, end) line ranges of sanctioned scopes: `with` blocks
+        whose context expression is a ``...sampled(...)`` call (the
+        profiler bracket — nested calls inside ride the exemption), and
+        whole functions whose name marks a measurement/warmup harness
+        (nested defs like compute_handle's `run` are covered by the
+        parent's range)."""
+        out: list[tuple[int, int]] = []
+        for node in ast.walk(tree):
+            if isinstance(node, (ast.With, ast.AsyncWith)):
+                for item in node.items:
+                    if isinstance(item.context_expr, ast.Call):
+                        chain = attr_chain(item.context_expr.func)
+                        if chain[-1:] == ["sampled"]:
+                            out.append((node.lineno, node.end_lineno
+                                        or node.lineno))
+                            break
+            elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                name = node.name.lower()
+                if any(part in name for part in _GL109_EXEMPT_NAME_PARTS):
+                    out.append((node.lineno, node.end_lineno
+                                or node.lineno))
+        return out

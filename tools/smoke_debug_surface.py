@@ -24,7 +24,9 @@ from __future__ import annotations
 import json
 import os
 import sys
+import threading
 import time
+import urllib.error
 import urllib.request
 
 # runnable as `python tools/smoke_debug_surface.py` from the repo root
@@ -44,11 +46,17 @@ os.environ.setdefault("CIRCUIT_BREAKER_RATE_LIMIT_PER_MINUTE", "1000")
 os.environ.setdefault("CIRCUIT_BREAKER_MAX_CONCURRENT_INSTANCES", "1000")
 
 
-def _get(port: int, path: str) -> tuple[int, str, bytes]:
-    with urllib.request.urlopen(
-            f"http://127.0.0.1:{port}{path}", timeout=10) as resp:
-        return (resp.status, resp.headers.get("Content-Type", ""),
-                resp.read())
+def _get(port: int, path: str,
+         headers: dict | None = None,
+         timeout: float = 15) -> tuple[int, str, bytes]:
+    req = urllib.request.Request(f"http://127.0.0.1:{port}{path}",
+                                 headers=headers or {})
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            return (resp.status, resp.headers.get("Content-Type", ""),
+                    resp.read())
+    except urllib.error.HTTPError as e:
+        return (e.code, e.headers.get("Content-Type", ""), e.read())
 
 
 def main() -> int:
@@ -236,6 +244,32 @@ def main() -> int:
               and 0 < rstats["last_delta_words"] < 64,
               f"warm window rode the delta path ({rstats})")
 
+        # demo device-profiling cycle: force the sampling bracket onto
+        # one live solve so device_time carries a real dispatch/execute/
+        # fetch split, then check the profiler's self-metering
+        # (docs/design/profiling.md)
+        print("demo device-profiling cycle (forced sampling bracket)")
+        from karpenter_tpu.obs.prof import get_profiler
+
+        prof = get_profiler()
+        prev_interval = prof.interval
+        prof.interval = 1
+        try:
+            jax_solver.solve(SolveRequest(devtel_pods, catalog))
+        finally:
+            prof.interval = prev_interval
+        psnap = prof.snapshot()
+        check(psnap["samples"] >= 1 and psnap["kernels"],
+              f"profiler sampled the live solve "
+              f"(samples={psnap['samples']})")
+        split = next(iter(psnap["kernels"].values()))
+        check(split["dispatch_ms"] >= 0 and "execute_ms" in split
+              and "fetch_ms" in split,
+              f"sampled dispatch decomposed ({split})")
+        check(0.0 <= psnap["overhead_fraction"] <= 1.0,
+              f"profiler self-overhead metered "
+              f"({psnap['overhead_fraction']})")
+
         print("GET /metrics")
         status, ctype, body = _get(port, "/metrics")
         check(status == 200, f"/metrics status 200 (got {status})")
@@ -295,6 +329,70 @@ def main() -> int:
               in text, "resident rebuild reason counted")
         check("karpenter_tpu_resident_delta_bytes" in text,
               "resident delta-bytes histogram rendered")
+        # device-profiling families (obs/prof.py + obs/watchdog.py)
+        check('karpenter_tpu_device_time_seconds_bucket{kernel=' in text,
+              "device_time histogram carries live sampled splits")
+        check('karpenter_tpu_prof_samples_total{kernel=' in text,
+              "profiler sample counter carries live samples")
+        check("karpenter_tpu_prof_overhead_fraction" in text,
+              "profiler overhead gauge rendered")
+        check("# TYPE karpenter_tpu_watchdog_breaches_total counter"
+              in text, "watchdog breach counter family rendered")
+        check("# TYPE karpenter_tpu_triage_bundles_total counter"
+              in text, "triage bundle counter family rendered")
+        check(" # {" not in text,
+              "plain text render carries NO exemplars")
+
+        print("GET /metrics (Accept: application/openmetrics-text)")
+        status, ctype, body = _get(
+            port, "/metrics",
+            headers={"Accept": "application/openmetrics-text"})
+        check(status == 200 and ctype.startswith(
+            "application/openmetrics-text"),
+            f"openmetrics negotiation ({status}, {ctype!r})")
+        om = body.decode()
+        check(om.rstrip().endswith("# EOF"),
+              "openmetrics exposition ends with # EOF")
+        check('# {trace_id="' in om,
+              "histogram buckets carry trace_id exemplars "
+              "(solve_phase/pod_placement -> /debug/traces)")
+
+        # on-demand capture: /debug/profile is single-flight and
+        # duration-capped; a solve dispatched DURING the window lands
+        # in the capture
+        print("GET /debug/profile (capture + single-flight)")
+        results: dict = {}
+
+        def _capture(tag, duration):
+            results[tag] = _get(port,
+                                f"/debug/profile?duration_s={duration}")
+
+        t1 = threading.Thread(target=_capture, args=("a", 1.0))
+        t1.start()
+        time.sleep(0.2)
+        t2 = threading.Thread(target=_capture, args=("b", 0.2))
+        t2.start()
+        time.sleep(0.1)
+        jax_solver.solve(SolveRequest(devtel_pods, catalog))
+        t1.join()
+        t2.join()
+        statuses = sorted(r[0] for r in results.values())
+        check(statuses == [200, 429],
+              f"concurrent captures: one 200, one 429 ({statuses})")
+        ok_body = next(r[2] for r in results.values() if r[0] == 200)
+        try:
+            pdoc = json.loads(ok_body)
+        except ValueError as e:
+            pdoc = {}
+            check(False, f"/debug/profile parses as JSON ({e})")
+        for key in ("duration_s", "sample_count", "device_time",
+                    "profiler", "chrome"):
+            check(key in pdoc, f"/debug/profile has {key!r}")
+        check(pdoc.get("sample_count", 0) >= 1,
+              f"capture saw the live dispatch "
+              f"(samples={pdoc.get('sample_count')})")
+        check(bool((pdoc.get("chrome") or {}).get("traceEvents")),
+              "capture renders Perfetto-loadable trace events")
 
         print("GET /debug/slo")
         status, ctype, body = _get(port, "/debug/slo")
@@ -373,6 +471,16 @@ def main() -> int:
               and "last_delta_words" in sres
               and "last_rebuild_reason" in sres,
               f"/statusz exposes resident-store state ({sres})")
+        sprof = doc.get("profiler") or {}
+        check(sprof.get("samples", 0) >= 1
+              and "overhead_fraction" in sprof
+              and sprof.get("kernels"),
+              f"/statusz surfaces the profiler split + overhead "
+              f"({ {k: sprof.get(k) for k in ('samples', 'overhead_fraction')} })")
+        swd = doc.get("watchdog") or {}
+        check("breaches" in swd and "bundles" in swd
+              and "rate_limit_s" in swd,
+              f"/statusz surfaces watchdog state ({swd})")
 
         print("GET /debug/traces")
         status, ctype, body = _get(
