@@ -70,7 +70,7 @@ class InvariantChecker:
                  orphan_grace: float, stuck_claim_grace: float,
                  solver_violations: list[str] | None = None,
                  trace: EventTrace | None = None, preemption=None,
-                 gang=None, resident=None,
+                 gang=None, resident=None, repack=None,
                  explain_violations: list[str] | None = None):
         self.cluster = cluster
         self.cloud = cloud              # ground truth: the UNWRAPPED fake
@@ -98,6 +98,11 @@ class InvariantChecker:
         # harness tracked, re-listed from ClusterState at check time so
         # the rebuild below is ground truth, not an echo of the store
         self.resident = resident
+        # repack probe (or None): .controller is the harness's
+        # DisruptionController (repack_log / repack_violations are the
+        # executed-migration-plan ground truth, drained per round),
+        # .catalog() re-derives target capacity and torus geometry
+        self.repack = repack
 
     # -- round invariants ----------------------------------------------------
 
@@ -110,6 +115,7 @@ class InvariantChecker:
         out.extend(self._no_priority_inversion())
         out.extend(self._no_partial_gang_placed())
         out.extend(self._resident_state_fresh())
+        out.extend(self._repack_plans_valid())
         if self.trace is not None:
             self.trace.add("invariants", phase="round", violations=len(out),
                            kinds=sorted({v.invariant for v in out}))
@@ -261,6 +267,131 @@ class InvariantChecker:
                 "resident-state-fresh",
                 f"device-resident tensors diverged from a fresh "
                 f"ClusterState rebuild ({diff} words differ)"))
+        return out
+
+    def _repack_plans_valid(self) -> list[Violation]:
+        """Every EXECUTED migration plan re-derives as valid from ground
+        truth: choke-point validator errors surface (an invalid plan was
+        produced, even though it was never actuated), no pod was
+        dropped (drained claims are deleted and nothing is still homed
+        on them; every migrated pod still exists), per-target capacity
+        re-derived from catalog allocatable is respected, and each
+        claimed slice reopening re-enumerates from the type's torus
+        geometry — fits the vacated occupancy, not the occupied one.
+        Drained per round, like the preemption/gang logs."""
+        if self.repack is None:
+            return []
+        ctrl = self.repack.controller
+        out = [Violation("repack-plan-valid", v)
+               for v in ctrl.repack_violations]
+        ctrl.repack_violations.clear()
+        catalog = self.repack.catalog()
+        for rec in ctrl.repack_log:
+            out.extend(self._check_repack_record(rec, catalog))
+        ctrl.repack_log.clear()
+        return out
+
+    def _check_repack_record(self, rec, catalog) -> list[Violation]:
+        from karpenter_tpu.gang.topology import enumerate_placements
+
+        out: list[Violation] = []
+        drained = set(rec.drained)
+        drained_nodes = set()
+        for name in rec.drained:
+            claim = self.cluster.get_nodeclaim(name)
+            if claim is None:
+                continue
+            if not claim.deleted:
+                out.append(Violation(
+                    "repack-plan-valid",
+                    f"drained claim {name} still live after actuation"))
+            if claim.node_name:
+                drained_nodes.add(claim.node_name)
+        seen: set[str] = set()
+        targets: dict[str, int] = {}
+        for pod, src, dst in rec.migrations:
+            if pod in seen:
+                out.append(Violation(
+                    "repack-plan-valid", f"pod {pod} migrated twice in "
+                    f"one plan"))
+            seen.add(pod)
+            if src == dst:
+                out.append(Violation(
+                    "repack-plan-valid",
+                    f"pod {pod} migrated onto its own node {src}"))
+            if dst in drained:
+                out.append(Violation(
+                    "repack-plan-valid",
+                    f"pod {pod} migrated onto drained claim {dst}"))
+            if self.cluster.get("pods", pod) is None:
+                out.append(Violation(
+                    "repack-plan-valid",
+                    f"migrated pod {pod} vanished (dropped)"))
+            targets[dst] = targets.get(dst, 0) + 1
+        # no pod dropped: nothing still homed on a drained claim/node
+        for p in self.cluster.list("pods"):
+            homes = {p.bound_node, p.nominated_node}
+            if homes & (drained | drained_nodes):
+                from karpenter_tpu.apis.pod import pod_key
+
+                out.append(Violation(
+                    "repack-plan-valid",
+                    f"pod {pod_key(p.spec)} still homed on a drained "
+                    f"claim ({p.bound_node or p.nominated_node})"))
+        # capacity respected: re-derive each migration target's residual
+        # from catalog allocatable minus its CURRENT occupants
+        if catalog is not None:
+            from karpenter_tpu.preempt.encode import (
+                _pod_req_vec, claim_pods, occupancy_index,
+            )
+
+            idx = occupancy_index(self.cluster)
+            for name in sorted(targets):
+                claim = self.cluster.get_nodeclaim(name)
+                if claim is None or claim.deleted:
+                    continue
+                o = catalog.find_offering(claim.instance_type, claim.zone,
+                                          claim.capacity_type)
+                if o is None:
+                    continue
+                resid = catalog.offering_alloc()[o].astype("int64").copy()
+                for p in claim_pods(self.cluster, claim, index=idx):
+                    resid -= _pod_req_vec(p.spec)
+                if (resid < 0).any():
+                    out.append(Violation(
+                        "repack-plan-valid",
+                        f"migration target {name} over capacity after "
+                        f"actuation (residual {resid.tolist()})"))
+        # claimed slices actually reopened: re-enumerate the geometry
+        # from the type's torus dims (independent of every planner path)
+        for claim_name, offering, shape, pre, post in rec.reopened:
+            if claim_name in drained:
+                out.append(Violation(
+                    "repack-plan-valid",
+                    f"slice {shape} claimed reopened on DRAINED claim "
+                    f"{claim_name}"))
+                continue
+            if catalog is None or offering >= catalog.num_offerings:
+                continue
+            t = int(catalog.off_type[offering])
+            torus = tuple(catalog.type_torus[t]) \
+                if t < len(catalog.type_torus) else ()
+            fits_pre = fits_post = False
+            for mask in enumerate_placements(torus, tuple(shape)):
+                if (mask & pre) == 0:
+                    fits_pre = True
+                if (mask & post) == 0:
+                    fits_post = True
+            if fits_pre:
+                out.append(Violation(
+                    "repack-plan-valid",
+                    f"slice {shape} on {claim_name} already fit before "
+                    f"the defrag move (nothing reopened)"))
+            if not fits_post:
+                out.append(Violation(
+                    "repack-plan-valid",
+                    f"slice {shape} on {claim_name} does not fit the "
+                    f"vacated torus (claimed reopening is false)"))
         return out
 
     # -- final (eventual) invariants -----------------------------------------

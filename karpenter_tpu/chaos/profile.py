@@ -61,6 +61,18 @@ class ChaosProfile:
     # priority from this menu (seeded world stream) — the preemption
     # plane's workload shape (overload profile)
     pod_priorities: tuple[int, ...] = ()
+    # accelerator-consuming singleton waves: when non-empty, each
+    # singleton wave draws a per-pod gpu (chip) request from this menu —
+    # the fragmentation profile's scatter workload (chips fill low-first,
+    # so partial fills strand contiguous slices)
+    pod_gpu: tuple[int, ...] = ()
+    # node selector stamped on gpu singleton waves (fragmentation pins
+    # them to one big-torus type so the scatter lands on exactly the
+    # tori the parked gangs need)
+    pod_node_selector: dict[str, str] = field(default_factory=dict)
+    # run the production DisruptionController with migration-first
+    # repack enabled (repack plane + repack-plan-valid invariant armed)
+    repack: bool = False
     # gang workload shaping (gang profile): probability a wave arrives
     # as a PodGroup, the member-count menu, and the slice-shape menu
     # ("" = gang without topology demand).  gang_stagger_rate makes some
@@ -174,6 +186,32 @@ PROFILES: dict[str, ChaosProfile] = _profiles(
         capacity_blackout_rate=0.35, capacity_blackout_rounds=3,
         preempt_storm_rate=0.25, preempt_storm_frac=0.40,
         error_rates={"create_instance": 0.10}),
+    ChaosProfile(
+        name="fragmentation",
+        description="scattered accelerator singletons + parked slice "
+                    "gangs with the migration-first repack plane live — "
+                    "torus defragmentation must reopen contiguous slices "
+                    "(no gang starves to deadline release while aggregate "
+                    "chips exist) and every executed migration plan must "
+                    "re-validate against ground truth",
+        repack=True,
+        pod_gpu=(1,),
+        # pin the scatter to the 8-chip-torus rung: a 2x2x2 gang needs
+        # the WHOLE torus, so any singleton chip on a node strands it
+        pod_node_selector={"node.kubernetes.io/instance-type":
+                           "gx3-64x512"},
+        gang_wave_rate=0.45, gang_sizes=(4,),
+        gang_slice_shapes=("2x2x2",),
+        gang_stagger_rate=0.0, gang_starve_rate=0.0,
+        pod_waves=6, pods_per_wave=(3, 4),
+        # a live-instance cap keeps the gang from simply creating a
+        # fresh torus: it must wait for defrag to reopen one (lifts at
+        # quiesce, like the overload profile); the preemption plane's
+        # slack-filler is off so singleton waves stay SCATTERED across
+        # partially-filled tori instead of backfilling tight
+        instance_quota=4,
+        disable_controllers=("preemption",),
+        error_rates={"create_instance": 0.05}),
 )
 
 # Fixture profiles: deliberately broken worlds the test suite uses to

@@ -77,6 +77,17 @@ class ResidentProbe:
 
 
 @dataclass
+class RepackProbe:
+    """What the repack-plan-valid invariant needs: the harness's
+    DisruptionController (its ``repack_log`` / ``repack_violations`` are
+    the executed-plan ground truth, drained per round) plus a catalog
+    getter for re-deriving target capacity and torus geometry."""
+
+    controller: object
+    catalog: object           # () -> CatalogArrays | None
+
+
+@dataclass
 class ScenarioResult:
     profile: str
     seed: int
@@ -138,7 +149,7 @@ class ChaosHarness:
         # placement); other profiles keep the default catalog so their
         # schedules are untouched
         gang_profiles = None
-        if profile.gang_wave_rate:
+        if profile.gang_wave_rate or profile.pod_gpu:
             from karpenter_tpu.cloud.fake import generate_profiles
 
             # gx3 first: the ladder is truncated at 24 types, and the
@@ -211,6 +222,36 @@ class ChaosHarness:
         from karpenter_tpu.resident.store import ResidentStore
 
         self.resident = ResidentStore()
+        # migration-first repack plane (fragmentation profile): the
+        # PRODUCTION DisruptionController, defrag scoring live, every
+        # executed plan logged for the repack-plan-valid invariant
+        self.disruption = None
+        if profile.repack:
+            from karpenter_tpu.apis.nodeclaim import NodePool
+            from karpenter_tpu.controllers.disruption import (
+                DisruptionController,
+            )
+            from karpenter_tpu.core.cloudprovider import CloudProvider
+
+            # single-node consolidation OFF for this profile: it would
+            # greedily merge the singleton scatter every round, racing
+            # the batched repack plane this profile exists to exercise
+            self.cluster.add_nodepool(NodePool(
+                name="default", nodeclass_name="default",
+                consolidation_policy="Never"))
+
+            self.disruption = DisruptionController(
+                self.cluster,
+                CloudProvider(self.cluster, actuator=self.actuator,
+                              instance_types=self.catalog_provider),
+                provisioner=self.provisioner, clock=self.clock.time,
+                repack_enabled=True, repack_cooldown=0.0,
+                resident_occupancy=True,
+                # migration-only: the blue/green rebuild's rollback
+                # re-pends pods, which would race the round clock at the
+                # final pump (and its create bursts fight the quota the
+                # profile imposes on purpose)
+                repack_rebuild=False)
         self.kubelet = FakeKubelet(self.cluster, self.fake)
         self.manager = ControllerManager(self.cluster)
         for ctrl in self._controllers():
@@ -235,7 +276,12 @@ class ChaosHarness:
                 store=self.resident,
                 window_pods=self._resident_window,
                 catalog=lambda: self.provisioner._catalog_for(
-                    self.nodeclass)))
+                    self.nodeclass)),
+            repack=RepackProbe(
+                controller=self.disruption,
+                catalog=lambda: self.provisioner._catalog_for(
+                    self.nodeclass))
+            if self.disruption is not None else None)
         # warm the catalog before chaos arms (pricing resolution happens
         # here, outside the deterministic traced window)
         self.catalog_provider.list(nc)
@@ -255,7 +301,7 @@ class ChaosHarness:
                                     enabled=True),
             self.preemption,
             self.gang,
-        ]
+        ] + ([self.disruption] if self.disruption is not None else [])
 
     # -- round loop ----------------------------------------------------------
 
@@ -327,16 +373,23 @@ class ChaosHarness:
                 and self.rng_world.random() < self.profile.gang_wave_rate:
             self._inject_gang(round_no, prio)
             return
+        # accelerator-consuming singletons (fragmentation profile): each
+        # wave pod draws a chip count from the menu — chips fill
+        # low-first, so partial fills fragment the tori the parked gangs
+        # need (exactly the scatter the repack defrag term must undo)
+        gmenu = self.profile.pod_gpu
+        gpu = gmenu[self.rng_world.randrange(len(gmenu))] if gmenu else 0
+        selector = dict(self.profile.pod_node_selector) if gpu else {}
         for pod in make_pods(n, name_prefix=f"wave{round_no}",
-                             requests=ResourceRequests(cpu, mem, 0, 1),
-                             priority=prio):
+                             requests=ResourceRequests(cpu, mem, gpu, 1),
+                             priority=prio, node_selector=selector):
             self.cluster.add_pod(pod)
         # the pod-event end of the causal chain (chaos drives
         # provision_once directly, so there is no watch feed to stamp it)
         obs.instant("pod.event", wave=round_no, pods=n, cpu=cpu, mem=mem,
                     priority=prio)
         self.trace.add("workload", wave=round_no, pods=n, cpu=cpu, mem=mem,
-                       priority=prio)
+                       gpu=gpu, priority=prio)
 
     def _inject_gang(self, round_no: int, prio: int) -> None:
         """One gang wave: full, staggered over two rounds, or starved

@@ -38,9 +38,28 @@ from karpenter_tpu.apis.pod import NUM_RESOURCES
 from karpenter_tpu.controllers.runtime import PollController, Result
 from karpenter_tpu.core.cluster import ClusterState
 from karpenter_tpu.core.cloudprovider import CloudProvider
+from karpenter_tpu import obs
+from karpenter_tpu.utils import metrics
 from karpenter_tpu.utils.logging import get_logger
 
 log = get_logger("controllers.disruption")
+
+
+@dataclass(frozen=True)
+class RepackRecord:
+    """Ground-truth evidence of one EXECUTED migration plan — the chaos
+    harness's ``repack-plan-valid`` invariant re-derives plan validity
+    (no pod dropped, capacity respected, claimed slices actually
+    reopened) from these records + the live cluster, the same drained-
+    log discipline the preemption/gang invariants use."""
+
+    migrations: tuple = ()       # (pod_key, src_claim, dst_claim) triples
+    drained: tuple = ()          # claim names deleted by the plan
+    # (claim_name, offering, shape, pre_mask, post_mask) per reopened
+    # slice — geometry evidence the invariant re-enumerates from scratch
+    reopened: tuple = ()
+    backend: str = ""
+    savings_fraction: float = 0.0
 
 
 @dataclass
@@ -80,7 +99,10 @@ class DisruptionController(PollController):
                  repack_enabled: bool = False,
                  repack_min_savings_fraction: float = 0.15,
                  repack_cooldown: float = 600.0,
-                 resident_occupancy: bool = False):
+                 resident_occupancy: bool = False,
+                 repack_migrate: bool = True,
+                 repack_rebuild: bool = True,
+                 repack_options=None):
         self.cluster = cluster
         self.cloudprovider = cloudprovider
         self.provisioner = provisioner
@@ -103,6 +125,27 @@ class DisruptionController(PollController):
         self._last_repack = 0.0             # stamped on EVERY attempt —
         # a converged fleet must not pay a full fresh solve per 10s poll
         self._pending_repack: _PendingRepack | None = None
+        # migration-first repack (karpenter_tpu/repack): one batched
+        # LP-relaxed plan over EXISTING capacity — drains + defrag moves
+        # actuated directly (no create burst, no blue/green wait) when
+        # the plan clears the same savings-fraction hysteresis, or
+        # reopens a parked gang slice.  The blue/green fresh-solve
+        # transition below remains the fallback for savings only a
+        # re-typed fleet can reach.
+        self.repack_migrate = repack_migrate
+        # the blue/green fresh-solve rebuild (phase-1 create burst +
+        # Ready-gated cutover).  Off = migration-only repack: no create
+        # bursts, no transition state — what the chaos harness runs,
+        # where a rollback's re-pended pods would race the round clock.
+        self.repack_rebuild = repack_rebuild
+        self._repacker = None
+        self._repack_options = repack_options
+        # ground truth for the chaos repack-plan-valid invariant:
+        # executed plans (drained per check) + choke-point validator
+        # errors (an invalid plan is never actuated, but the harness
+        # must still see that it was produced)
+        self.repack_log: list[RepackRecord] = []
+        self.repack_violations: list[str] = []
 
     # -- reconcile ---------------------------------------------------------
 
@@ -114,8 +157,10 @@ class DisruptionController(PollController):
         # unproven nodes as targets / drain old capacity early)
         transitioning = self._pending_repack is not None
         # the occupancy snapshot is built AFTER drift replacement (which
-        # unbinds pods) and torn down before repack (which renominates
-        # pending pods the snapshot does not track)
+        # unbinds pods) and stays live through the migration repack (its
+        # moves ride rebind(), same as consolidation); it is torn down
+        # before the blue/green fallback can renominate pending pods the
+        # snapshot does not track (nothing reads it after that point)
         if self.resident_occupancy and not transitioning:
             from karpenter_tpu.resident.store import OccupancySnapshot
 
@@ -123,9 +168,10 @@ class DisruptionController(PollController):
         try:
             emptied = 0 if transitioning else self._consolidate_empty()
             moved = 0 if transitioning else self._consolidate_underutilized()
+            repacked = self._repack_if_profitable() \
+                if self.repack_enabled else 0
         finally:
             self._occ = None
-        repacked = self._repack_if_profitable() if self.repack_enabled else 0
         if drifted or emptied or moved or repacked:
             log.info("disruption pass", drifted=drifted, empty=emptied,
                      consolidated=moved, repacked=repacked)
@@ -166,7 +212,7 @@ class DisruptionController(PollController):
             if pool.consolidation_policy not in (
                     "WhenEmpty", "WhenEmptyOrUnderutilized"):
                 continue
-            if self._bound_pods(claim.node_name):
+            if self._claim_pods(claim):
                 # node busy again: reset the emptiness clock so a later
                 # drain restarts the consolidateAfter damping window
                 if claim.annotations.pop(self.EMPTY_SINCE_ANNOTATION, None):
@@ -201,19 +247,33 @@ class DisruptionController(PollController):
         # cheapest first: removing a low-price node frees least value, but
         # is likeliest to fit elsewhere; karpenter sorts by disruption cost
         for claim in sorted(claims, key=lambda c: c.hourly_price):
-            pods = self._bound_pods(claim.node_name)
+            pods = self._claim_pods(claim)
             if not pods:
+                continue
+            if any((p := self.cluster.get("pods", pk)) is not None
+                   and (not p.bound_node or p.spec.gang is not None)
+                   for pk in pods):
+                # in-flight nominations: the node is about to RECEIVE
+                # pods — rebinding an unbound nomination here would
+                # bypass the kubelet bind.  Gang members are immovable
+                # outright: a single-node move scatters an atomically
+                # co-located gang (and voids its slice geometry) — the
+                # same movability rule the repack plane enforces.
                 continue
             placement = self._fit_elsewhere(claim, pods, claims, resid)
             if placement is None:
                 continue
             for pod, target in placement:
+                p = self.cluster.get("pods", pod)
+                if p is not None:
+                    # clear the stale nomination: leaving it pointing at
+                    # the OLD claim lets that claim's finalizer
+                    # (evict_node_pods matches nominated too) rip the
+                    # pod off its new home later
+                    p.nominated_node = ""
                 self.cluster.bind_pod(pod, target.node_name)
                 if self._occ is not None:
-                    p = self.cluster.get("pods", pod)
-                    self._occ.rebind(
-                        pod, target.node_name,
-                        p.nominated_node if p is not None else "")
+                    self._occ.rebind(pod, target.node_name, "")
                 resid[target.name] = resid[target.name] - \
                     self._pod_req(pod)
             log.info("underutilized node consolidated", claim=claim.name,
@@ -293,6 +353,12 @@ class DisruptionController(PollController):
             if now - self._last_repack < self.repack_cooldown:
                 return 0
             self._last_repack = now   # stamp EVERY attempt (poll damping)
+            if self.repack_migrate:
+                migrated = self._repack_migrate_locked()
+                if migrated:
+                    return migrated
+            if not self.repack_rebuild:
+                return 0
             proposal = self.propose_repack()
             if proposal is None or proposal.current_cost <= 0:
                 return 0
@@ -365,6 +431,124 @@ class DisruptionController(PollController):
                  new_nodes=len(new_claims), old_nodes=len(old_names))
         return 0   # nothing moved yet
 
+    def _repack_migrate_locked(self) -> int:
+        """Migration-first repack: plan one batched LP-relaxed
+        consolidation + defrag pass over EXISTING capacity (the
+        karpenter_tpu/repack plane, fed from the resident occupancy
+        substrate), validate it with the independent
+        ``validate_repack_plan`` oracle, then actuate — pods rebound
+        directly (this framework owns the scheduler role), emptied
+        nodes drained.  Same single-pool scope and savings-fraction
+        hysteresis as the blue/green path; a plan that reopens a parked
+        gang slice actuates regardless of savings (a starving gang
+        outranks cost hysteresis)."""
+        if self.provisioner is None:
+            return 0
+        pools = self.cluster.list("nodepools")
+        if len(pools) > 1:
+            return 0
+        pool = pools[0] if pools else None
+        wanted = pool.nodeclass_name if pool and pool.nodeclass_name \
+            else "default"
+        nodeclass = self.cluster.get_nodeclass(wanted)
+        if nodeclass is None:
+            return 0
+        catalog = self.provisioner._catalog_for(nodeclass)
+        if catalog is None:
+            return 0
+        from karpenter_tpu.repack import (
+            ResilientRepacker, RepackOptions, encode_repack,
+        )
+        from karpenter_tpu.resident.store import resident_store_of
+        from karpenter_tpu.solver.validate import validate_repack_plan
+
+        if self._repacker is None:
+            self._repacker = ResilientRepacker(
+                options=self._repack_options or RepackOptions())
+        store = resident_store_of(self.provisioner.solver) \
+            if self.resident_occupancy else None
+        t0 = time.perf_counter()
+        with obs.span("repack.plan", pool=pool.name if pool else "") as sp:
+            problem = encode_repack(self.cluster, catalog, pool,
+                                    snapshot=self._occ, store=store)
+            plan = self._repacker.plan(problem)
+            sp.set("backend", plan.backend)
+            sp.set("nodes", problem.num_nodes)
+            sp.set("migrations", plan.migration_count)
+            sp.set("drained", len(plan.drained))
+            sp.set("slices_reopened", plan.slices_reopened)
+        metrics.REPACK_PLAN_DURATION.labels(plan.backend).observe(
+            time.perf_counter() - t0)
+        if plan.empty:
+            return 0
+        profitable = plan.current_cost > 0 and plan.savings >= \
+            self.repack_min_savings_fraction * plan.current_cost
+        if not profitable and plan.slices_reopened == 0:
+            return 0
+        # independent oracle gate: never actuate an invalid plan (same
+        # choke-point discipline as preempt/gang execution)
+        errors = validate_repack_plan(plan, self.cluster, catalog, pool)
+        if errors:
+            metrics.ERRORS.labels("repack", "invalid_plan").inc()
+            self.repack_violations.extend(errors[:10])
+            log.error("repack migration plan failed validation; dropped",
+                      errors=errors[:3])
+            return 0
+        return self._actuate_repack_plan(plan)
+
+    def _actuate_repack_plan(self, plan) -> int:
+        from karpenter_tpu.repack.types import KIND_DRAIN
+
+        claims = {c.name: c for c in self.cluster.nodeclaims()
+                  if not c.deleted}
+        moved = 0
+        for m in plan.migrations:
+            dst = claims.get(m.dst_claim)
+            if dst is None:
+                continue
+            p = self.cluster.get("pods", m.pod_key)
+            if p is not None:
+                # re-home fully: a nomination left dangling on the
+                # source claim would keep counting against its chips
+                p.nominated_node = ""
+            self.cluster.bind_pod(m.pod_key, dst.node_name)
+            if self._occ is not None:
+                self._occ.rebind(m.pod_key, dst.node_name, "")
+            metrics.REPACK_MIGRATIONS.labels(
+                "consolidate" if m.kind == KIND_DRAIN else "defrag").inc()
+            moved += 1
+        drained = 0
+        for name in plan.drained:
+            claim = self.cluster.get_nodeclaim(name)
+            if claim is not None and not claim.deleted:
+                # occupants were all migrated above; eviction only
+                # re-pends stragglers that raced onto the node
+                self._evict_and_delete(claim)
+                drained += 1
+        if plan.slices_reopened:
+            metrics.REPACK_SLICES_REOPENED.inc(plan.slices_reopened)
+        metrics.REPACK_SAVINGS_FRACTION.set(plan.savings_fraction)
+        self.repack_log.append(RepackRecord(
+            migrations=tuple((m.pod_key, m.src_claim, m.dst_claim)
+                             for m in plan.migrations),
+            drained=tuple(plan.drained),
+            reopened=tuple((r.claim_name, r.offering, tuple(r.shape),
+                            r.pre_mask, r.post_mask)
+                           for r in plan.reopened),
+            backend=plan.backend,
+            savings_fraction=plan.savings_fraction))
+        self.cluster.record_event(
+            "NodeClaim", "fleet", "Normal", "RepackMigrated",
+            f"${plan.current_cost:.2f}/h -> ${plan.proposed_cost:.2f}/h "
+            f"({moved} pods moved, {drained} nodes drained, "
+            f"{plan.slices_reopened} slices reopened)")
+        log.info("repack migration plan actuated", migrations=moved,
+                 drained=drained, slices_reopened=plan.slices_reopened,
+                 backend=plan.backend,
+                 savings_fraction=round(plan.savings_fraction, 4))
+        defrag_sources = {r.claim_name for r in plan.reopened}
+        return drained + len(defrag_sources)
+
     def _advance_pending_repack_locked(self) -> int:
         pending = self._pending_repack
         fresh = [self.cluster.get_nodeclaim(c.name)
@@ -417,6 +601,18 @@ class DisruptionController(PollController):
 
     # -- helpers -----------------------------------------------------------
 
+    def _claim_pods(self, claim: NodeClaim) -> list[str]:
+        """Pods homed on ``claim`` under EITHER name — bound/nominated to
+        its node, or nominated onto the claim itself (the provisioner
+        nominates by CLAIM name; a node-name-only scan would call a node
+        with in-flight nominations 'empty' and strand them on delete).
+        Same two-name union as ``preempt.encode.claim_pods``."""
+        seen: dict[str, None] = {}
+        for name in (claim.node_name, claim.name):
+            for pk in self._bound_pods(name):
+                seen.setdefault(pk, None)
+        return list(seen)
+
     def _bound_pods(self, node_name: str) -> list[str]:
         from karpenter_tpu.apis.pod import pod_key
 
@@ -450,7 +646,7 @@ class DisruptionController(PollController):
 
     def _residual(self, claim: NodeClaim) -> np.ndarray:
         resid = self._alloc(claim)
-        for pk in self._bound_pods(claim.node_name):
+        for pk in self._claim_pods(claim):
             resid = resid - self._pod_req(pk)
         return resid
 
@@ -510,7 +706,7 @@ class DisruptionController(PollController):
         """PodSpecs currently bound to ``claim``'s node plus any planned
         moves onto it within this consolidation pass."""
         out = []
-        for pk in self._bound_pods(claim.node_name):
+        for pk in self._claim_pods(claim):
             pending = self.cluster.get("pods", pk)
             if pending is not None:
                 out.append(pending.spec)
@@ -555,7 +751,7 @@ class DisruptionController(PollController):
         """Unbind the node's pods back to pending, then delete the claim
         (the termination controller finalizes the instance; the window
         re-places the pods)."""
-        for pk in self._bound_pods(claim.node_name):
+        for pk in self._claim_pods(claim):
             pending = self.cluster.get("pods", pk)
             if pending is not None:
                 pending.bound_node = ""

@@ -254,10 +254,18 @@ class GangAdmissionController(PollController):
         # plan + actuate under the solve lock: a concurrent window
         # nominating one of these pods would race capacity accounting
         with self.provisioner._solve_lock:
+            # live-capacity pre-pass: a gang whose slice is already open
+            # on an existing accelerator node nominates there directly —
+            # free capacity beats a create, and it is the payoff path of
+            # the repack plane's slice defragmentation (a parked gang
+            # lands on the torus the defrag migrations just vacated,
+            # docs/design/repack.md)
+            placed_live = self._place_on_live(pool, catalog, gangs)
+            gangs = [(n, m) for n, m in gangs if n not in placed_live]
             pods = [p.spec for _, members in gangs for p in members
                     if not p.nominated_node and not p.bound_node]
             if not pods:
-                return set()
+                return placed_live
             t0 = time.perf_counter()
             with obs.span("gang.place", pool=pool.name,
                           gangs=len(gangs), pods=len(pods)) as sp:
@@ -289,7 +297,7 @@ class GangAdmissionController(PollController):
                         for pn in g.pod_names:
                             get_registry().clear_bits(pn, "gang_geometry")
                 if plan.empty:
-                    return set()
+                    return placed_live
                 # independent oracle gate: never actuate an invalid plan
                 errors = validate_gang_plan(plan, pods, catalog, pool)
                 if errors:
@@ -297,9 +305,138 @@ class GangAdmissionController(PollController):
                     sp.set("invalid", len(errors))
                     log.error("gang plan failed validation; dropped",
                               pool=pool.name, errors=errors[:3])
-                    return set()
-                return self._execute(plan, pool, nodeclass, catalog,
-                                     problem)
+                    return placed_live
+                return placed_live | self._execute(plan, pool, nodeclass,
+                                                   catalog, problem)
+
+    def _place_on_live(self, pool, catalog, gangs) -> set[str]:
+        """Nominate gangs onto EXISTING initialized nodes of THIS pool
+        whose residual capacity covers the gang's total demand and whose
+        torus has a free contiguous placement for its slice shape (chip
+        occupancy re-derived via the canonical chip model
+        repack/encode.py defines).  Oldest claim first, lowest placement
+        mask first — deterministic, and by construction atomic (all
+        waiting members nominate onto ONE claim or none).
+
+        Eligibility mirrors the repack plane's: initialized, node-backed
+        claims only (a launched-but-unready node is unproven capacity —
+        a gang parked on a claim the registration-timeout GC later reaps
+        would burn its deadline for nothing), and only claims of the
+        pool being placed (another pool's labels/taints were never
+        matched).  Only torus-bearing types are scanned — slice gangs
+        can land nowhere else, and a full-fleet occupancy rebuild per
+        reconcile would re-add exactly the host loop the repack plane
+        removed."""
+        import numpy as np
+
+        from karpenter_tpu.apis.pod import tolerates_all
+        from karpenter_tpu.gang.topology import enumerate_placements
+        from karpenter_tpu.preempt.encode import (
+            _pod_req_vec, claim_pods, occupancy_index,
+        )
+        from karpenter_tpu.repack.encode import PodRef, chip_layout
+
+        placed: set[str] = set()
+        claims = [c for c in self.cluster.nodeclaims()
+                  if not c.deleted and c.launched and c.initialized
+                  and c.node_name and c.nodepool_name == pool.name]
+        if not claims:
+            return placed
+        idx = None
+        alloc = catalog.offering_alloc().astype(np.int64)
+        states = []
+        for c in claims:
+            off = catalog.find_offering(c.instance_type, c.zone,
+                                        c.capacity_type)
+            if off is None:
+                continue
+            t = int(catalog.off_type[off])
+            torus = tuple(catalog.type_torus[t]) \
+                if t < len(catalog.type_torus) else ()
+            if not torus:
+                continue   # no torus: no slice gang can ever land here
+            if idx is None:
+                idx = occupancy_index(self.cluster)
+            resid = alloc[off].copy()
+            refs: list[PodRef] = []
+            gang_shapes: list[tuple[str, tuple]] = []
+            seen: set[str] = set()
+            for p in claim_pods(self.cluster, c, index=idx):
+                spec = p.spec
+                resid -= _pod_req_vec(spec)
+                ref = PodRef(key=pod_key(spec), req=None, sig=0,
+                             gpu=int(spec.requests.gpu), movable=False,
+                             single=False)
+                if spec.gang is not None and spec.gang.slice_shape:
+                    if spec.gang.name not in seen:
+                        seen.add(spec.gang.name)
+                        gang_shapes.append(
+                            (spec.gang.name, tuple(spec.gang.slice_shape)))
+                    ref.chip_mask = -1
+                refs.append(ref)
+            occ, _sing = chip_layout(refs, gang_shapes, torus)
+            states.append({"claim": c, "off": off, "torus": torus,
+                           "resid": resid, "occ": occ})
+        if not states:
+            return placed
+        for name, members in gangs:
+            waiting = [p for p in members
+                       if not p.nominated_node and not p.bound_node]
+            if not waiting:
+                continue
+            spec = waiting[0].spec.gang
+            shape = tuple(spec.slice_shape) if spec.slice_shape else ()
+            need = np.zeros(alloc.shape[1], np.int64)
+            for p in waiting:
+                need += _pod_req_vec(p.spec)
+            reqs = waiting[0].spec.scheduling_requirements().merged(
+                pool.requirements)
+            for st in states:
+                c = st["claim"]
+                if not (st["resid"] >= need).all():
+                    continue
+                labels = dict(pool.labels)
+                labels.update(catalog.offering_label_values(st["off"]))
+                if not reqs.matches(labels):
+                    continue
+                if (c.taints and any(
+                        not tolerates_all(p.spec.tolerations, c.taints)
+                        for p in waiting)) or (pool.taints and any(
+                        not tolerates_all(p.spec.tolerations, pool.taints)
+                        for p in waiting)):
+                    continue
+                mask = 0
+                if shape:
+                    for m in enumerate_placements(st["torus"], shape):
+                        if (m & st["occ"]) == 0:
+                            mask = m
+                            break
+                    if not mask:
+                        continue
+                with obs.span("gang.place.live", gang=name, claim=c.name,
+                              members=len(waiting)):
+                    for p in waiting:
+                        self.provisioner._nominate(pod_key(p.spec), c.name)
+                    self.placement_log.append(GangPlacementRecord(
+                        gang=name, claim_name=c.name,
+                        members=tuple(pod_key(p.spec) for p in waiting),
+                        total_members=len(waiting),
+                        min_member=spec.min_member, backend="live"))
+                    metrics.GANG_PLACEMENTS.labels("live").inc()
+                    self.cluster.record_event(
+                        "PodGroup", name, "Normal", "GangPlaced",
+                        f"{len(waiting)} members onto live node "
+                        f"{c.name}" + (f" (slice "
+                                       f"{'x'.join(map(str, shape))})"
+                                       if shape else ""))
+                st["resid"] = st["resid"] - need
+                st["occ"] |= mask
+                placed.add(name)
+                log.info("gang placed on live capacity", gang=name,
+                         claim=c.name, members=len(waiting),
+                         slice=str(spec.slice_shape or ""))
+                break
+        return placed
 
     def _execute(self, plan, pool, nodeclass, catalog, problem) -> set[str]:
         sizes = {g.name: len(g.pod_names) for g in problem.gangs}

@@ -1,0 +1,38 @@
+"""Fleet-wide consolidation as a batched LP-relaxed solve.
+
+The repack plane (docs/design/repack.md) follows the established
+encode / batched-planner / greedy-parity / degraded layout the
+preemption (``preempt/``) and gang (``gang/``) planes share:
+
+- :mod:`karpenter_tpu.repack.encode` — live fleet -> dense migration
+  tensors, consumed straight off the resident occupancy substrate
+  (``ResidentStore.occupancy_tensors``) when available;
+- :mod:`karpenter_tpu.repack.planner` — one batched scoring grid per
+  round (jitted device kernel or numpy, integer-exact both ways) + the
+  deterministic integral rounding;
+- :mod:`karpenter_tpu.repack.greedy` — the scalar host-loop oracle the
+  batched path is differentially tested against, and the degraded-mode
+  fallback;
+- :mod:`karpenter_tpu.repack.degraded` — ``ResilientRepacker`` + the
+  cheap structural gate;
+- ``validate_repack_plan`` (solver/validate.py) — the independent
+  feasibility oracle the disruption controller runs before actuation.
+"""
+
+from karpenter_tpu.repack.encode import (
+    RepackProblem, encode_repack, parked_gang_shapes,
+)
+from karpenter_tpu.repack.degraded import ResilientRepacker, repack_plan_defects
+from karpenter_tpu.repack.greedy import GreedyRepacker
+from karpenter_tpu.repack.planner import RepackPlanner
+from karpenter_tpu.repack.types import (
+    KIND_DEFRAG, KIND_DRAIN, Migration, ReopenedSlice, RepackOptions,
+    RepackPlan,
+)
+
+__all__ = [
+    "KIND_DEFRAG", "KIND_DRAIN", "GreedyRepacker", "Migration",
+    "ReopenedSlice", "RepackOptions", "RepackPlan", "RepackPlanner",
+    "RepackProblem", "ResilientRepacker", "encode_repack",
+    "parked_gang_shapes", "repack_plan_defects",
+]

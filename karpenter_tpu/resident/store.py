@@ -359,6 +359,19 @@ class ResidentStore:
                                 kernel="resident-occupancy")
         return names, dev, delta
 
+    def occupancy_rows(self) -> np.ndarray | None:
+        """Host mirror of the occupancy rows as ``[Nn_pad, 6] int32``
+        (None before any :meth:`occupancy_tensors` call) — the repack
+        encoder's host-side view of the device-resident rows; by the
+        parity contract it equals the device tensor word-for-word."""
+        from karpenter_tpu.apis.pod import NUM_RESOURCES
+
+        with self._lock:
+            buf = getattr(self, "_occ_buf", None)
+        if buf is None or buf.mirror is None:
+            return None
+        return buf.mirror.reshape(-1, 2 + NUM_RESOURCES)
+
     def snapshot_state(self, catalog=None) -> dict | None:
         """The most recent state's (mirror, device fetch, generation) for
         invariant checks / debug — None before any window."""

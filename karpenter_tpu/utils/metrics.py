@@ -391,6 +391,27 @@ GANG_PLAN_DURATION = Histogram(
     "karpenter_tpu_gang_plan_seconds",
     "Gang placement plan latency (encode + batched slice grid)",
     ("backend",))
+# Repack plane (karpenter_tpu/repack + controllers/disruption.py).
+REPACK_PLAN_DURATION = Histogram(
+    "karpenter_tpu_repack_plan_seconds",
+    "Fleet repack plan latency (encode from the resident occupancy "
+    "substrate + batched LP-relaxed scoring grid + integral rounding), "
+    "by planner backend (device / vector / greedy / degraded:*)",
+    ("backend",))
+REPACK_MIGRATIONS = Counter(
+    "karpenter_tpu_repack_migrations_total",
+    "Pod migrations executed by the repack plane, by kind (consolidate "
+    "= source node fully drained and deleted; defrag = chip-consuming "
+    "singletons vacated so a parked gang slice reopens)",
+    ("kind",))
+REPACK_SLICES_REOPENED = Counter(
+    "karpenter_tpu_repack_slices_reopened_total",
+    "Parked gang slice shapes newly fitting an accelerator node after a "
+    "defrag migration vacated its singleton chips", ())
+REPACK_SAVINGS_FRACTION = Gauge(
+    "karpenter_tpu_repack_savings_fraction",
+    "Savings fraction of the most recent actuated repack migration plan "
+    "(drained node cost / fleet cost at plan time)", ())
 # SLO ledger plane (karpenter_tpu/obs/ledger.py + obs/slo.py).
 POD_PLACEMENT = Histogram(
     "karpenter_tpu_pod_placement_seconds",

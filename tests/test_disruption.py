@@ -246,6 +246,10 @@ class TestRepackApply:
         ctrl.provisioner = Provisioner(cluster, itp, actuator)
         ctrl.repack_enabled = True
         ctrl.repack_cooldown = 0.0
+        # these tests pin the blue/green TRANSITION semantics — the
+        # fallback the migration-first planner defers to; the migration
+        # path has its own suite (tests/test_repack.py)
+        ctrl.repack_migrate = False
         return cluster, ctrl, clock
 
     def test_profitable_repack_two_phase_cutover(self, rig):

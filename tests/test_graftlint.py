@@ -22,6 +22,7 @@ PREEMPT_PATH = "karpenter_tpu/preempt/_snippet.py"
 GANG_PATH = "karpenter_tpu/gang/_snippet.py"
 CTRL_PATH = "karpenter_tpu/controllers/_snippet.py"
 CLOUD_PATH = "karpenter_tpu/cloud/_snippet.py"
+REPACK_PATH = "karpenter_tpu/repack/_snippet.py"
 
 
 def rules_of(src: str, path: str) -> list:
@@ -194,6 +195,71 @@ def test_gl002_gang_scope_slice_mask_kernel_good():
             # branchless: an all-occupied grid just yields all-False
             return free.any(axis=1)
         """, "GL002", path=GANG_PATH)
+
+
+def test_gl002_repack_scope_migration_scoring_bad():
+    """The purity family covers karpenter_tpu/repack/: a tracer-bool in
+    a migration-scoring kernel (early-exit on a traced candidate count)
+    must fire GL002 there, same as in solver/, preempt/ and gang/."""
+    assert_flags(
+        """
+        import jax
+        import jax.numpy as jnp
+
+        @jax.jit
+        def score_migrations(rows, alloc, price):
+            resid = rows[:, 2:]
+            demand = alloc[rows[:, 0]] - resid
+            feas = (demand <= jnp.maximum(resid, 0).sum(0)).all(1)
+            if feas.sum() == 0:       # traced bool: trace-time error
+                return jnp.zeros_like(price)
+            return jnp.where(feas, price, 0)
+        """, "GL002", path=REPACK_PATH)
+
+
+def test_gl002_repack_scope_migration_scoring_good():
+    assert_clean(
+        """
+        import jax
+        import jax.numpy as jnp
+
+        @jax.jit
+        def score_migrations(rows, alloc, price):
+            resid = rows[:, 2:]
+            demand = alloc[rows[:, 0]] - resid
+            feas = (demand <= jnp.maximum(resid, 0).sum(0)).all(1)
+            # branchless: an infeasible fleet just scores all-zero
+            return jnp.where(feas, price, 0)
+        """, "GL002", path=REPACK_PATH)
+
+
+def test_gl003_repack_scope_per_plan_jit_bad():
+    """A migration-scoring kernel rebuilt per plan call (jax.jit inside
+    the planner's hot path) is the recompile hazard GL003 exists for."""
+    assert_flags(
+        """
+        import jax
+
+        def plan_repack(rows, price):
+            score = jax.jit(lambda r, p: p * (r[:, 1] > 0))
+            return score(rows, price)
+        """, "GL003", path=REPACK_PATH)
+
+
+def test_gl003_repack_scope_cached_kernel_good():
+    assert_clean(
+        """
+        from functools import lru_cache
+
+        import jax
+
+        @lru_cache(maxsize=1)
+        def _kernel():
+            return jax.jit(lambda r, p: p * (r[:, 1] > 0))
+
+        def plan_repack(rows, price):
+            return _kernel()(rows, price)
+        """, "GL003", path=REPACK_PATH)
 
 
 def test_gl003_gang_scope_per_plan_jit_bad():

@@ -40,6 +40,8 @@ METRICS: tuple[tuple[str, str], ...] = (
     ("hetero_vs_baseline", "higher"),
     ("repack_tick_p50_ms", "lower"),
     ("repack_tick_max_ms", "lower"),
+    ("repack_plan_p50_ms", "lower"),
+    ("repack_plan_max_ms", "lower"),
     ("fleet_pods_per_sec", "higher"),
     ("fleet_pipelined_ms", "lower"),
     ("fleet_compute_ms", "lower"),

@@ -28,6 +28,8 @@ FAMILY_A_SCOPE = (
     "karpenter_tpu/resident/**/*",
     "karpenter_tpu/explain/*",
     "karpenter_tpu/explain/**/*",
+    "karpenter_tpu/repack/*",
+    "karpenter_tpu/repack/**/*",
     "karpenter_tpu/native.py",
     "bench.py",
 )

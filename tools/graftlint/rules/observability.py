@@ -143,7 +143,8 @@ class TelemetryInKernel(Rule):
     family = "B"
     scope = ("karpenter_tpu/solver/*", "karpenter_tpu/parallel/*",
              "karpenter_tpu/preempt/*", "karpenter_tpu/gang/*",
-             "karpenter_tpu/resident/*", "karpenter_tpu/explain/*")
+             "karpenter_tpu/resident/*", "karpenter_tpu/explain/*",
+             "karpenter_tpu/repack/*")
 
     def check(self, module: SourceModule) -> Iterator[Finding]:
         analysis = analyze(module)
@@ -337,7 +338,7 @@ class BlockingSyncInHotPath(Rule):
     family = "B"
     scope = ("karpenter_tpu/solver/*", "karpenter_tpu/parallel/*",
              "karpenter_tpu/preempt/*", "karpenter_tpu/gang/*",
-             "karpenter_tpu/resident/*")
+             "karpenter_tpu/resident/*", "karpenter_tpu/repack/*")
 
     def check(self, module: SourceModule) -> Iterator[Finding]:
         exempt = self._exempt_ranges(module.tree)

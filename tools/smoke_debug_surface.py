@@ -194,6 +194,44 @@ def main() -> int:
               and len(gc_ctrl.placement_log[0].members) == 4,
               "gang placement log carries the full membership")
 
+        # demo repack cycle: three oversized nodes each hosting one tiny
+        # bound pod — the migration-first repack plane drains two onto
+        # the third (no creates; validated by the independent oracle
+        # before actuation) — exercises the repack.plan span and the
+        # karpenter_tpu_repack_* families asserted below
+        print("demo repack cycle (migration-first consolidation)")
+        from karpenter_tpu.controllers.disruption import DisruptionController
+
+        for i in range(3):
+            rc = NodeClaim(
+                name=f"smoke-fat{i}", nodeclass_name="default",
+                instance_type="bx2-16x64", zone="us-south-1",
+                node_name=f"node-smoke-fat{i}", hourly_price=0.8,
+                launched=True, registered=True, initialized=True)
+            op.cluster.add_nodeclaim(rc)
+            op.cluster.add_pod(PodSpec(
+                f"smoke-fatp{i}",
+                requests=ResourceRequests(250, 512, 0, 1)))
+            op.cluster.bind_pod(f"default/smoke-fatp{i}",
+                                f"node-smoke-fat{i}")
+        dc = DisruptionController(
+            op.cluster, None, provisioner=op.provisioner,
+            repack_enabled=True, repack_cooldown=0.0,
+            repack_rebuild=False,
+            # the earlier demos left pricey gang/prey nodes in the fleet;
+            # the smoke tests the debug surface, not the hysteresis (the
+            # threshold gate is pinned by tests/test_repack.py)
+            repack_min_savings_fraction=0.05)
+        repacked = dc._repack_if_profitable()
+        check(repacked >= 1 and len(dc.repack_log) == 1,
+              f"demo repack drained nodes via validated migrations "
+              f"(sources={repacked}, "
+              f"violations={dc.repack_violations[:2]})")
+        check(any((lambda c: c is None or c.deleted)(
+                  op.cluster.get_nodeclaim(f"smoke-fat{i}"))
+                  for i in range(3)),
+              "demo repack deleted at least one drained claim")
+
         # demo device-telemetry cycle: a REAL JaxSolver solve (cpu
         # backend) so recompile count, H2D/D2H bytes, donation misses
         # and the executable-cache hit ratio are populated by the live
@@ -297,6 +335,18 @@ def main() -> int:
               "gang parked gauge rendered")
         check("karpenter_tpu_gang_members" in text,
               "gang members histogram rendered")
+        # repack plane families (karpenter_tpu/repack +
+        # controllers/disruption.py) — populated by the demo repack cycle
+        check("karpenter_tpu_repack_plan_seconds" in text,
+              "repack plan-latency histogram rendered")
+        check('karpenter_tpu_repack_migrations_total{kind="consolidate"}'
+              in text,
+              "repack migration counter counted the demo drains")
+        check("karpenter_tpu_repack_savings_fraction" in text,
+              "repack savings-fraction gauge rendered")
+        check("# TYPE karpenter_tpu_repack_slices_reopened_total counter"
+              in text, "repack slices-reopened counter family rendered")
+
         # SLO ledger + device telemetry families (obs/ledger.py,
         # obs/devtel.py) — placement observed by the wave nominations,
         # devtel populated by the jax demo solve above
@@ -484,7 +534,7 @@ def main() -> int:
 
         print("GET /debug/traces")
         status, ctype, body = _get(
-            port, "/debug/traces?limit=10&min_ms=0")
+            port, "/debug/traces?limit=25&min_ms=0")
         check(status == 200, f"/debug/traces status 200 (got {status})")
         try:
             doc = json.loads(body)
