@@ -43,8 +43,10 @@ graftlint-baseline: ## Re-accept current graftlint findings into the debt ledger
 	$(PY) -m tools.graftlint --update-baseline
 
 .PHONY: chaos
-chaos: ## Seeded chaos matrix (profiles x seeds, deterministic; docs/design/chaos.md)
+chaos: ## Seeded chaos matrix (profiles x seeds + crashpoint matrix, deterministic; docs/design/chaos.md)
 	$(TEST_ENV) $(PY) -m karpenter_tpu.chaos --seeds 4 --rounds 10 \
+		--trace-dir .chaos-traces
+	$(TEST_ENV) $(PY) -m karpenter_tpu.chaos --crash --seeds 3 \
 		--trace-dir .chaos-traces
 
 .PHONY: soak
@@ -61,6 +63,15 @@ smoke: ## Debug-surface smoke: real operator, curl-equivalent checks on /metrics
 
 .PHONY: warm-restart-check
 warm-restart-check: ## AOT executable cache gate: a warm restart must recompile nothing and boot faster than cold (resident/aot.py)
+	JAX_PLATFORMS=cpu $(PY) tools/warm_restart_check.py
+
+.PHONY: crash-matrix
+crash-matrix: ## Crashpoint x seed matrix: kill/restart the operator at seeded crashpoints, journal-recovered (docs/design/recovery.md)
+	$(TEST_ENV) $(PY) -m karpenter_tpu.chaos --crash --seeds 3 \
+		--trace-dir .chaos-traces
+
+.PHONY: recovery-check
+recovery-check: ## Full recovery-time gate: journal replay (zero duplicate creates) + AOT prewarm + resident rebuild (tools/warm_restart_check.py)
 	JAX_PLATFORMS=cpu $(PY) tools/warm_restart_check.py
 
 .PHONY: chaos-replay

@@ -4,6 +4,9 @@
     python -m karpenter_tpu.chaos --seeds 4 --rounds 10
     python -m karpenter_tpu.chaos --profile spot-storm --seed 3   # replay
     python -m karpenter_tpu.chaos --soak [--short]        # production day
+    python -m karpenter_tpu.chaos --crash                 # crashpoint matrix
+    python -m karpenter_tpu.chaos --crash --crashpoint actuate.mid_create \
+        --seed 2                                          # crash replay
     python -m karpenter_tpu.chaos --list-profiles
 
 Exit codes: 0 all invariants held and every trace was reproducible (for
@@ -33,7 +36,9 @@ def main(argv: list[str] | None = None) -> int:
                     help="single seed (replay mode)")
     ap.add_argument("--seeds", type=int, default=4,
                     help="run seeds 1..N (default 4)")
-    ap.add_argument("--rounds", type=int, default=10)
+    ap.add_argument("--rounds", type=int, default=None,
+                    help="rounds per scenario (default: 10, or 8 with "
+                         "--crash)")
     ap.add_argument("--no-verify-determinism", action="store_true",
                     help="skip the double-run trace-digest comparison")
     ap.add_argument("--trace-dir", default=".chaos-traces",
@@ -46,7 +51,42 @@ def main(argv: list[str] | None = None) -> int:
                     help="with --soak: the CI-sized short day")
     ap.add_argument("--report-dir", default=".soak-report",
                     help="with --soak: burn report + span bundle output")
+    ap.add_argument("--crash", action="store_true",
+                    help="run the crashpoint x seed matrix (operator "
+                         "kill/restart chaos; docs/design/recovery.md)")
+    ap.add_argument("--crashpoint", action="append", default=None,
+                    help="with --crash: crashpoint name (repeatable; "
+                         "default: full catalog)")
     args = ap.parse_args(argv)
+
+    # an explicit --rounds must never be silently coerced: the crash
+    # path's different default is resolved only when the flag is absent
+    # (a replay with --rounds N MUST run exactly N, or the digest the
+    # user is chasing never reproduces)
+    if args.crash:
+        from karpenter_tpu.chaos.crash import (
+            run_crash_matrix, run_crash_scenario,
+        )
+
+        rounds = args.rounds if args.rounds is not None else 8
+        if args.crashpoint and args.seed is not None \
+                and len(args.crashpoint) == 1:
+            res = run_crash_scenario(args.crashpoint[0], args.seed,
+                                     rounds=rounds)
+            if res.violations:
+                print(res.render_failure())
+                return 1
+            print(f"ok   {res.crashpoint} seed={res.seed} "
+                  f"crashes={res.crashes} events={len(res.trace)} "
+                  f"digest={res.digest[:12]}")
+            return 0
+        seeds = (args.seed,) if args.seed is not None \
+            else tuple(range(1, args.seeds + 1))
+        _, failures = run_crash_matrix(
+            args.crashpoint, seeds, rounds=rounds,
+            verify_determinism=not args.no_verify_determinism,
+            trace_dir=args.trace_dir)
+        return 1 if failures else 0
 
     if args.soak:
         from karpenter_tpu.chaos.soak import (
@@ -64,11 +104,12 @@ def main(argv: list[str] | None = None) -> int:
             print(f"{name:<18}{tag} {p.description}")
         return 0
 
+    rounds = args.rounds if args.rounds is not None else 10
     seeds = (args.seed,) if args.seed is not None \
         else tuple(range(1, args.seeds + 1))
     if args.profile and args.seed is not None and len(args.profile) == 1:
         # replay mode: one scenario, full report
-        res = run_scenario(args.profile[0], args.seed, rounds=args.rounds)
+        res = run_scenario(args.profile[0], args.seed, rounds=rounds)
         if res.violations:
             print(res.render_failure())
             return 1
@@ -76,7 +117,7 @@ def main(argv: list[str] | None = None) -> int:
               f"events={len(res.trace)} digest={res.digest[:12]}")
         return 0
     _, failures = run_matrix(
-        args.profile, seeds, rounds=args.rounds,
+        args.profile, seeds, rounds=rounds,
         verify_determinism=not args.no_verify_determinism,
         trace_dir=args.trace_dir)
     return 1 if failures else 0

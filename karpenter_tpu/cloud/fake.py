@@ -169,6 +169,12 @@ class FakeCloud:
         self.ssh_keys: dict[str, str] = {"key-1": "rsa"}  # id -> type
         self.instance_quota = instance_quota
         self.capacity_limits: dict[tuple[str, str], int] = {}  # (profile, zone) -> max
+        # idempotency-key ledger (docs/design/recovery.md): a create
+        # replayed with the same key returns the EXISTING resource —
+        # the server-side contract the crash-recovery journal's
+        # deterministic keys rely on (real clouds expect client tokens
+        # the same way, e.g. IBM VPC's transaction ids)
+        self.idempotency: dict[str, str] = {}
         for zi, zone in enumerate(self.zone_names):
             for si in range(subnets_per_zone):
                 sid = f"subnet-{zi + 1}{si + 1}"
@@ -258,13 +264,32 @@ class FakeCloud:
 
     # -- network interfaces / volumes (staged allocation) ------------------
 
-    def create_vni(self, subnet_id: str) -> FakeVNI:
+    def _idem_hit(self, key: str, collection: dict):
+        """Existing resource for a replayed idempotency key, or None.
+        Caller holds the lock.  A stale entry (resource since deleted)
+        falls through to a fresh create."""
+        if not key:
+            return None
+        rid = self.idempotency.get(key)
+        return collection.get(rid) if rid else None
+
+    def find_by_idempotency(self, key: str) -> str | None:
+        """Resource id previously created under ``key`` (recovery's
+        fence path uses this to learn a leaked id)."""
+        with self._lock:
+            return self.idempotency.get(key)
+
+    def create_vni(self, subnet_id: str,
+                   idempotency_key: str = "") -> FakeVNI:
         """Standalone VNI allocation — the first stage of the reference's
         staged create (vpc/instance/provider.go:333-401); a later instance
         create attaches it, a failed create must clean it up."""
         self.recorder.record("create_vni", subnet_id)
         self.recorder.maybe_raise("create_vni")
         with self._lock:
+            hit = self._idem_hit(idempotency_key, self.vnis)
+            if hit is not None:
+                return hit
             subnet = self.subnets.get(subnet_id)
             if subnet is None:
                 raise not_found("subnet", subnet_id)
@@ -273,18 +298,26 @@ class FakeCloud:
                                  409, retryable=False)
             vni = FakeVNI(id=f"vni-{next(self._seq)}", subnet_id=subnet_id)
             self.vnis[vni.id] = vni
+            if idempotency_key:
+                self.idempotency[idempotency_key] = vni.id
             return vni
 
     def create_volume(self, capacity_gb: int = 100,
                       profile: str = "general-purpose",
-                      volume_id: str = "") -> FakeVolume:
+                      volume_id: str = "",
+                      idempotency_key: str = "") -> FakeVolume:
         """Standalone volume allocation (second stage of staged create)."""
         self.recorder.record("create_volume", volume_id or capacity_gb)
         self.recorder.maybe_raise("create_volume")
         with self._lock:
+            hit = self._idem_hit(idempotency_key, self.volumes)
+            if hit is not None:
+                return hit
             vol = FakeVolume(id=volume_id or f"vol-{next(self._seq)}",
                              capacity_gb=capacity_gb, profile=profile)
             self.volumes[vol.id] = vol
+            if idempotency_key:
+                self.idempotency[idempotency_key] = vol.id
             return vol
 
     # -- instance lifecycle ------------------------------------------------
@@ -295,13 +328,19 @@ class FakeCloud:
                         user_data: str = "", tags: dict[str, str] | None = None,
                         volumes: tuple[FakeVolume, ...] = (),
                         vni_id: str = "",
-                        volume_ids: tuple[str, ...] = ()) -> FakeInstance:
+                        volume_ids: tuple[str, ...] = (),
+                        idempotency_key: str = "") -> FakeInstance:
         """Create an instance.  With ``vni_id``/``volume_ids`` it ATTACHES
         pre-allocated resources (staged create); otherwise it allocates
-        them implicitly (legacy one-shot path)."""
+        them implicitly (legacy one-shot path).  A replayed
+        ``idempotency_key`` returns the existing instance — quota and
+        validation are skipped, the work already happened."""
         self.recorder.record("create_instance", name, profile, zone, capacity_type)
         self.recorder.maybe_raise("create_instance")
         with self._lock:
+            hit = self._idem_hit(idempotency_key, self.instances)
+            if hit is not None:
+                return _snap(hit)
             if not any(p.name == profile for p in self.profiles):
                 raise CloudError(f"profile {profile!r} not found", 404)
             if zone not in self.zone_names:
@@ -361,6 +400,8 @@ class FakeCloud:
                 ip_address=f"10.0.{len(self.instances) // 250}.{len(self.instances) % 250 + 4}")
             self.instances[inst.id] = inst
             subnet.available_ips -= 1
+            if idempotency_key:
+                self.idempotency[idempotency_key] = inst.id
             return _snap(inst)
 
     def get_instance(self, instance_id: str) -> FakeInstance:

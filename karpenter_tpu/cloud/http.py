@@ -71,33 +71,46 @@ class HTTPClient:
                  token_source: TokenSource | None = None,
                  timeout: float = 30.0,
                  opener: Callable | None = None,
-                 sleep: Callable[[float], None] = time.sleep):
+                 sleep: Callable[[float], None] = time.sleep,
+                 budget: float | None = None):
         self.base_url = base_url.rstrip("/")
         self.service = service
         self.tokens = token_source
         self.timeout = timeout
+        # overall wall-clock budget per request (retries + Retry-After
+        # sleeps included; cloud/retry.py deadline propagation) — a
+        # controller-owned client caps every call below its requeue
+        # interval so the retry loop can never outlive the reconcile
+        self.budget = budget
         # injectable transport/sleep for tests
         self._open = opener or urllib.request.urlopen
         self._sleep = sleep
 
     # -- verbs -------------------------------------------------------------
 
-    def get(self, path: str, operation: str = "get") -> dict:
-        return self.request("GET", path, operation=operation)
+    def get(self, path: str, operation: str = "get",
+            budget: float | None = None) -> dict:
+        return self.request("GET", path, operation=operation, budget=budget)
 
-    def post(self, path: str, body: dict, operation: str = "post") -> dict:
-        return self.request("POST", path, body=body, operation=operation)
+    def post(self, path: str, body: dict, operation: str = "post",
+             budget: float | None = None) -> dict:
+        return self.request("POST", path, body=body, operation=operation,
+                            budget=budget)
 
-    def delete(self, path: str, operation: str = "delete") -> dict:
-        return self.request("DELETE", path, operation=operation)
+    def delete(self, path: str, operation: str = "delete",
+               budget: float | None = None) -> dict:
+        return self.request("DELETE", path, operation=operation,
+                            budget=budget)
 
     def request(self, method: str, path: str, body: dict | None = None,
-                operation: str = "request") -> dict:
+                operation: str = "request",
+                budget: float | None = None) -> dict:
         def attempt():
             return self._do(method, path, body, operation)
 
-        return retry_with_backoff(attempt, operation=operation,
-                                  sleep=self._sleep)
+        return retry_with_backoff(
+            attempt, operation=operation, sleep=self._sleep,
+            budget=budget if budget is not None else self.budget)
 
     # -- internals ---------------------------------------------------------
 

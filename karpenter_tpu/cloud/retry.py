@@ -49,13 +49,22 @@ class RetryConfig:
 def retry_with_backoff(fn: Callable[[], T], config: RetryConfig = None,
                        sleep: Callable[[float], None] | None = None,
                        operation: str = "",
-                       rng: random.Random | None = None) -> T:
+                       rng: random.Random | None = None,
+                       budget: float | None = None) -> T:
     """Call ``fn`` with exponential backoff on retryable errors.
 
     Non-retryable errors raise immediately; the last error raises after
     ``steps`` attempts.  With ``config.jitter`` each wait is drawn
     decorrelated from the previous one (bounded by ``initial``/``cap``);
     a server Retry-After always overrides the drawn wait verbatim.
+
+    ``budget`` is an overall wall-clock deadline in seconds that retries
+    AND Retry-After sleeps must respect: a wait that would end at or
+    past ``now + budget`` is never started — the last error raises
+    instead (sleeping a clamped remainder would waste the whole wait,
+    and retrying before a server-mandated Retry-After elapses would
+    violate it).  A retry loop must never outlive its caller's requeue
+    interval; the controller re-enters on its own schedule.
     """
     cfg = config or RetryConfig()
     if sleep is None:
@@ -64,6 +73,8 @@ def retry_with_backoff(fn: Callable[[], T], config: RetryConfig = None,
         # waits cost scenario time, and an import-time default would
         # capture the real sleep before the patch
         sleep = time.sleep
+    # deadline resolved at call time for the same VirtualClock reason
+    deadline = (time.monotonic() + budget) if budget is not None else None
     draw = (rng or random).uniform if cfg.jitter else None
     # the cap bounds EVERY wait, including the first (a misconfigured
     # initial > cap must not produce one over-cap sleep)
@@ -87,6 +98,17 @@ def retry_with_backoff(fn: Callable[[], T], config: RetryConfig = None,
             if cfg.honor_retry_after and is_rate_limit(err) and err.retry_after > 0:
                 wait = err.retry_after
             if attempt < cfg.steps - 1:
+                if deadline is not None:
+                    remaining = deadline - time.monotonic()
+                    # boundary clamp: wait == remaining is already too
+                    # late (the post-sleep attempt would start at the
+                    # deadline), so >= stops the loop here
+                    if wait >= remaining:
+                        obs.event("backoff.budget_exhausted",
+                                  operation=operation, attempt=attempt + 1,
+                                  wait=round(wait, 4),
+                                  remaining=round(max(remaining, 0.0), 4))
+                        raise last
                 log.debug("retrying after error", operation=operation,
                           attempt=attempt + 1, wait=wait, error=str(e))
                 obs.event("backoff", operation=operation,

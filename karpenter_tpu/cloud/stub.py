@@ -124,13 +124,16 @@ class StubCloudServer:
         if path == "/v1/keys":
             return {"keys": cloud.list_ssh_keys()}
         if path == "/v1/virtual_network_interfaces" and method == "POST":
-            vni = cloud.create_vni(body.get("subnet_id", ""))
+            vni = cloud.create_vni(
+                body.get("subnet_id", ""),
+                idempotency_key=body.get("idempotency_key", ""))
             return {"id": vni.id, "subnet_id": vni.subnet_id}
         if path == "/v1/volumes" and method == "POST":
             vol = cloud.create_volume(
                 capacity_gb=int(body.get("capacity_gb", 100)),
                 profile=body.get("profile", "general-purpose"),
-                volume_id=body.get("volume_id", ""))
+                volume_id=body.get("volume_id", ""),
+                idempotency_key=body.get("idempotency_key", ""))
             return {"id": vol.id, "capacity_gb": vol.capacity_gb,
                     "profile": vol.profile}
         if path == "/v1/instances" and method == "POST":
@@ -148,7 +151,8 @@ class StubCloudServer:
                 user_data=body.get("user_data", ""),
                 tags=body.get("tags") or {}, volumes=vols,
                 vni_id=body.get("vni_id", ""),
-                volume_ids=tuple(body.get("volume_ids") or ()))
+                volume_ids=tuple(body.get("volume_ids") or ()),
+                idempotency_key=body.get("idempotency_key", ""))
             return instance_to_json(inst)
         if path == "/v1/instances" and method == "GET":
             if query.get("availability") == ["spot"]:

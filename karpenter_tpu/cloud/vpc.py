@@ -194,17 +194,22 @@ class VPCCloudClient:
 
     # -- staged allocation (ref vpc.go:448-478 VNIs, :416-446 volumes) -----
 
-    def create_vni(self, subnet_id: str) -> VNI:
+    def create_vni(self, subnet_id: str, idempotency_key: str = "") -> VNI:
         data = self.http.post("/v1/virtual_network_interfaces",
-                              {"subnet_id": subnet_id}, "create_vni")
+                              {"subnet_id": subnet_id,
+                               "idempotency_key": idempotency_key},
+                              "create_vni")
         return VNI(id=data["id"], subnet_id=data.get("subnet_id", subnet_id))
 
     def create_volume(self, capacity_gb: int = 100,
                       profile: str = "general-purpose",
-                      volume_id: str = "") -> Volume:
+                      volume_id: str = "",
+                      idempotency_key: str = "") -> Volume:
         data = self.http.post("/v1/volumes",
                               {"capacity_gb": capacity_gb, "profile": profile,
-                               "volume_id": volume_id}, "create_volume")
+                               "volume_id": volume_id,
+                               "idempotency_key": idempotency_key},
+                              "create_volume")
         return Volume(id=data["id"],
                       capacity_gb=int(data.get("capacity_gb", capacity_gb)),
                       profile=data.get("profile", profile))
@@ -219,7 +224,8 @@ class VPCCloudClient:
                         tags: dict[str, str] | None = None,
                         volumes: tuple[Volume, ...] = (),
                         vni_id: str = "",
-                        volume_ids: tuple[str, ...] = ()) -> Instance:
+                        volume_ids: tuple[str, ...] = (),
+                        idempotency_key: str = "") -> Instance:
         body = {
             "name": name, "profile": profile, "zone": zone,
             "subnet_id": subnet_id, "image_id": image_id,
@@ -229,6 +235,7 @@ class VPCCloudClient:
             "volumes": [{"id": v.id, "capacity_gb": v.capacity_gb,
                          "profile": v.profile} for v in volumes],
             "vni_id": vni_id, "volume_ids": list(volume_ids),
+            "idempotency_key": idempotency_key,
         }
         return instance_from_json(
             self.http.post("/v1/instances", body, "create_instance"))

@@ -102,11 +102,17 @@ class DisruptionController(PollController):
                  resident_occupancy: bool = False,
                  repack_migrate: bool = True,
                  repack_rebuild: bool = True,
-                 repack_options=None):
+                 repack_options=None, journal=None):
+        from karpenter_tpu.recovery.journal import NULL_JOURNAL
+
         self.cluster = cluster
         self.cloudprovider = cloudprovider
         self.provisioner = provisioner
         self.clock = clock
+        # write-ahead journal: an executed migration plan records an
+        # intent before the first rebind, so a crash mid-plan re-pends
+        # the interrupted pods on restart (docs/design/recovery.md)
+        self.journal = journal if journal is not None else NULL_JOURNAL
         # KARPENTER_ENABLE_RESIDENT: the consolidation passes read node
         # occupancy through ONE shared per-tick snapshot
         # (resident/store.OccupancySnapshot) instead of one full pod
@@ -502,29 +508,35 @@ class DisruptionController(PollController):
         claims = {c.name: c for c in self.cluster.nodeclaims()
                   if not c.deleted}
         moved = 0
-        for m in plan.migrations:
-            dst = claims.get(m.dst_claim)
-            if dst is None:
-                continue
-            p = self.cluster.get("pods", m.pod_key)
-            if p is not None:
-                # re-home fully: a nomination left dangling on the
-                # source claim would keep counting against its chips
-                p.nominated_node = ""
-            self.cluster.bind_pod(m.pod_key, dst.node_name)
-            if self._occ is not None:
-                self._occ.rebind(m.pod_key, dst.node_name, "")
-            metrics.REPACK_MIGRATIONS.labels(
-                "consolidate" if m.kind == KIND_DRAIN else "defrag").inc()
-            moved += 1
-        drained = 0
-        for name in plan.drained:
-            claim = self.cluster.get_nodeclaim(name)
-            if claim is not None and not claim.deleted:
-                # occupants were all migrated above; eviction only
-                # re-pends stragglers that raced onto the node
-                self._evict_and_delete(claim)
-                drained += 1
+        with self.journal.intent(
+                "repack_migration",
+                migrations=[(m.pod_key, m.src_claim, m.dst_claim)
+                            for m in plan.migrations],
+                drained=list(plan.drained)) as intent:
+            for m in plan.migrations:
+                dst = claims.get(m.dst_claim)
+                if dst is None:
+                    continue
+                p = self.cluster.get("pods", m.pod_key)
+                if p is not None:
+                    # re-home fully: a nomination left dangling on the
+                    # source claim would keep counting against its chips
+                    p.nominated_node = ""
+                self.cluster.bind_pod(m.pod_key, dst.node_name)
+                if self._occ is not None:
+                    self._occ.rebind(m.pod_key, dst.node_name, "")
+                intent.note(f"moved:{m.pod_key}", dst=m.dst_claim)
+                metrics.REPACK_MIGRATIONS.labels(
+                    "consolidate" if m.kind == KIND_DRAIN else "defrag").inc()
+                moved += 1
+            drained = 0
+            for name in plan.drained:
+                claim = self.cluster.get_nodeclaim(name)
+                if claim is not None and not claim.deleted:
+                    # occupants were all migrated above; eviction only
+                    # re-pends stragglers that raced onto the node
+                    self._evict_and_delete(claim)
+                    drained += 1
         if plan.slices_reopened:
             metrics.REPACK_SLICES_REOPENED.inc(plan.slices_reopened)
         metrics.REPACK_SAVINGS_FRACTION.set(plan.savings_fraction)

@@ -151,10 +151,13 @@ class SpotPreemptionController(PollController):
     blackout_ttl = 3600.0
 
     def __init__(self, cluster: ClusterState, cloud,
-                 unavailable: UnavailableOfferings):
+                 unavailable: UnavailableOfferings, journal=None):
+        from karpenter_tpu.recovery.journal import NULL_JOURNAL
+
         self.cluster = cluster
         self.cloud = cloud
         self.unavailable = unavailable
+        self.journal = journal if journal is not None else NULL_JOURNAL
 
     def reconcile(self) -> Result:
         try:
@@ -171,7 +174,9 @@ class SpotPreemptionController(PollController):
             metrics.INSTANCE_LIFECYCLE.labels("preempted", inst.profile,
                                               inst.zone).inc()
             try:
-                self.cloud.delete_instance(inst.id)
+                with self.journal.intent("orphan_delete", instance=inst.id,
+                                         reason="spot_preempted"):
+                    self.cloud.delete_instance(inst.id)
             except CloudError as e:
                 if not is_not_found(e):
                     log.warning("preempted delete failed", instance=inst.id,
@@ -204,9 +209,13 @@ class OrphanCleanupController(PollController):
     interval = 300.0
     min_instance_age = 600.0   # don't reap instances whose node is booting
 
-    def __init__(self, cluster: ClusterState, cloud, enabled: bool | None = None):
+    def __init__(self, cluster: ClusterState, cloud, enabled: bool | None = None,
+                 journal=None):
+        from karpenter_tpu.recovery.journal import NULL_JOURNAL
+
         self.cluster = cluster
         self.cloud = cloud
+        self.journal = journal if journal is not None else NULL_JOURNAL
         self.enabled = (os.environ.get("KARPENTER_ENABLE_ORPHAN_CLEANUP", "")
                         .lower() in ("1", "true", "yes")) if enabled is None \
             else enabled
@@ -235,7 +244,10 @@ class OrphanCleanupController(PollController):
                 continue
             if inst.id not in node_ids and inst.id not in claim_ids:
                 try:
-                    self.cloud.delete_instance(inst.id)
+                    with self.journal.intent("orphan_delete",
+                                             instance=inst.id,
+                                             reason="orphan_sweep"):
+                        self.cloud.delete_instance(inst.id)
                     log.info("orphan cleanup: deleted instance", instance=inst.id)
                 except CloudError as e:
                     if not is_not_found(e):

@@ -51,6 +51,7 @@ from karpenter_tpu.core.cluster import ClusterState
 from karpenter_tpu.gang.degraded import ResilientGangPlanner
 from karpenter_tpu.gang.encode import encode_gangs
 from karpenter_tpu.gang.types import GangOptions
+from karpenter_tpu.recovery.journal import NULL_JOURNAL
 from karpenter_tpu.solver.validate import validate_gang_plan
 from karpenter_tpu import obs
 from karpenter_tpu.utils import metrics
@@ -78,9 +79,14 @@ class GangAdmissionController(PollController):
     interval = 5.0
 
     def __init__(self, cluster: ClusterState, provisioner,
-                 options: GangOptions | None = None, clock=time.time):
+                 options: GangOptions | None = None, clock=time.time,
+                 journal=None):
         self.cluster = cluster
         self.provisioner = provisioner
+        # write-ahead journal: gang placements are intents (all-or-
+        # nothing on replay), admissions durable state — a restarted
+        # operator must not reset parked gangs' deadline clocks
+        self.journal = journal if journal is not None else NULL_JOURNAL
         self.options = options or GangOptions()
         self.planner = ResilientGangPlanner(options=self.options)
         self.clock = clock
@@ -99,6 +105,18 @@ class GangAdmissionController(PollController):
         self.placement_log: deque[GangPlacementRecord] = deque(maxlen=4096)
         if provisioner is not None:
             provisioner.admission = self.admit
+
+    def seed_recovered(self, admitted: dict[str, float],
+                       parked: dict[str, float] | None = None) -> None:
+        """Adopt the reconciler's rebuilt gang state: admitted names
+        re-enter the admission set, and BOTH admitted and still-parked
+        gangs keep the crashed process's first-seen stamps — deadlines
+        keep burning across the restart instead of resetting."""
+        for name, first in (parked or {}).items():
+            self._first_seen.setdefault(name, float(first))
+        for name, first in admitted.items():
+            self.admitted.add(name)
+            self._first_seen.setdefault(name, float(first))
 
     # -- the provision-queue gate -----------------------------------------
 
@@ -127,15 +145,28 @@ class GangAdmissionController(PollController):
         for name in list(self._first_seen):
             if name not in groups:
                 self._first_seen.pop(name, None)
-                self.admitted.discard(name)
+                self.journal.state(f"gang/first_seen/{name}", None)
+                if name in self.admitted:
+                    self.admitted.discard(name)
+                    self.journal.state(f"gang/admitted/{name}", None)
         parked = 0
         to_place: list[tuple[str, list]] = []
         for name, members in groups.items():
             spec = members[0].spec.gang
+            if name not in self._first_seen:
+                # durable first-seen stamp from the FIRST park
+                # observation: a parked gang's deadline clock must keep
+                # burning across operator restarts, not reset to zero
+                # every time the process rolls
+                self.journal.state(f"gang/first_seen/{name}", now)
             first = self._first_seen.setdefault(name, now)
             complete = len(members) >= spec.min_member
             if complete and name not in self.admitted:
                 self.admitted.add(name)
+                # durable admission + first-seen stamp: a restart must
+                # neither re-park an admitted gang nor reset its
+                # deadline clock (docs/design/recovery.md)
+                self.journal.state(f"gang/admitted/{name}", first)
                 metrics.GANG_ADMISSIONS.labels("admitted").inc()
                 metrics.GANG_MEMBERS.observe(len(members))
                 for p in members:
@@ -220,7 +251,9 @@ class GangAdmissionController(PollController):
             self.released.pop(next(iter(self.released)))
         self.released[name] = None
         self.admitted.discard(name)
+        self.journal.state(f"gang/admitted/{name}", None)
         self._first_seen.pop(name, None)
+        self.journal.state(f"gang/first_seen/{name}", None)
         metrics.GANG_ADMISSIONS.labels("released_degraded").inc()
         metrics.ERRORS.labels("gang", "deadline_release").inc()
         obs.instant("gang.release", gang=name, members=len(members),
@@ -414,7 +447,10 @@ class GangAdmissionController(PollController):
                     if not mask:
                         continue
                 with obs.span("gang.place.live", gang=name, claim=c.name,
-                              members=len(waiting)):
+                              members=len(waiting)), \
+                        self.journal.intent(
+                            "gang_placement", gang=name, claim=c.name,
+                            pods=[pod_key(p.spec) for p in waiting]):
                     for p in waiting:
                         self.provisioner._nominate(pod_key(p.spec), c.name)
                     self.placement_log.append(GangPlacementRecord(
@@ -452,8 +488,14 @@ class GangAdmissionController(PollController):
             if claim is None:
                 continue   # create failed: the gang stays pending whole
             for a in node.assignments:
-                for pn in a.pod_names:
-                    self.provisioner._nominate(pn, claim.name)
+                # one intent per (gang, claim): replay is all-or-nothing
+                # — a live claim gets the whole membership re-nominated,
+                # a dead one releases every member back to pending
+                with self.journal.intent("gang_placement", gang=a.gang,
+                                         claim=claim.name,
+                                         pods=list(a.pod_names)):
+                    for pn in a.pod_names:
+                        self.provisioner._nominate(pn, claim.name)
                 # total_members = the gang's pending membership when
                 # planned; the invariant checker compares it against the
                 # members the record actually carried (an assignment row

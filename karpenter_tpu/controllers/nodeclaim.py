@@ -212,9 +212,12 @@ class GarbageCollectionController(PollController):
     registration_timeout = 900.0   # 15 min (ref registration TTL)
     min_instance_age = 120.0       # create_instance -> add_nodeclaim race grace
 
-    def __init__(self, cluster: ClusterState, cloud):
+    def __init__(self, cluster: ClusterState, cloud, journal=None):
+        from karpenter_tpu.recovery.journal import NULL_JOURNAL
+
         self.cluster = cluster
         self.cloud = cloud
+        self.journal = journal if journal is not None else NULL_JOURNAL
 
     def reconcile(self) -> Result:
         dirty = 0
@@ -247,7 +250,9 @@ class GarbageCollectionController(PollController):
             if now - inst.created_at < self.min_instance_age:
                 continue
             try:
-                self.cloud.delete_instance(inst.id)
+                with self.journal.intent("orphan_delete", instance=inst.id,
+                                         reason="gc_sweep"):
+                    self.cloud.delete_instance(inst.id)
                 n += 1
                 metrics.INSTANCE_LIFECYCLE.labels(
                     "gc_orphan_instance", inst.profile, inst.zone).inc()

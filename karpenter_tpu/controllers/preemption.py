@@ -37,6 +37,8 @@ from karpenter_tpu.core.cluster import ClusterState
 from karpenter_tpu.preempt.degraded import ResilientPlanner
 from karpenter_tpu.preempt.encode import encode_victims, occupancy_index
 from karpenter_tpu.preempt.types import PlannerOptions
+from karpenter_tpu.recovery import crashpoints
+from karpenter_tpu.recovery.journal import NULL_JOURNAL
 from karpenter_tpu.solver.encode import encode
 from karpenter_tpu.solver.validate import validate_preemption_plan
 from karpenter_tpu import obs
@@ -65,9 +67,13 @@ class PreemptionController(PollController):
 
     def __init__(self, cluster: ClusterState, provisioner,
                  options: PlannerOptions | None = None, clock=time.time,
-                 min_pending_age: float = 5.0):
+                 min_pending_age: float = 5.0, journal=None):
         self.cluster = cluster
         self.provisioner = provisioner
+        # write-ahead journal: evictions record an intent before the
+        # first victim moves, and every victim a durable preempted/
+        # state row — a restart rebuilds preempted_keys from it
+        self.journal = journal if journal is not None else NULL_JOURNAL
         self.options = options or PlannerOptions()
         self.planner = ResilientPlanner(options=self.options)
         self.clock = clock
@@ -91,6 +97,12 @@ class PreemptionController(PollController):
         self.eviction_log: deque[PreemptionRecord] = deque(maxlen=4096)
         self.preempted_keys: set[str] = set()
 
+    def seed_recovered(self, preempted_keys) -> None:
+        """Adopt the restart reconciler's rebuilt ``preempted_keys`` —
+        the preempted-pods-resolve contract survives the crash only if
+        the new process keeps watching the old process's victims."""
+        self.preempted_keys.update(preempted_keys)
+
     # -- reconcile ---------------------------------------------------------
 
     def reconcile(self) -> Result:
@@ -101,6 +113,7 @@ class PreemptionController(PollController):
             p = self.cluster.get("pods", key)
             if p is None or p.bound_node:
                 self.preempted_keys.discard(key)   # resolved (or gone)
+                self.journal.state(f"preempted/{key}", None)
         pending = {pod_key(p.spec): p for p in self.cluster.pending_pods()
                    if not p.nominated_node}
         self._first_pending = {k: self._first_pending.get(k, now)
@@ -233,35 +246,44 @@ class PreemptionController(PollController):
 
     def _execute(self, plan, pool: NodePool) -> int:
         """Evict victims, then nominate beneficiaries (that order: a bind
-        racing the eviction must see the capacity already released)."""
+        racing the eviction must see the capacity already released).
+        The whole eviction batch runs under one write-ahead intent: a
+        crash mid-batch leaves the intent open, and the restart
+        reconciler re-pends exactly the victims the notes say moved."""
         executed = 0
-        for ev in plan.evictions:
-            pending = self.cluster.get("pods", ev.pod_key)
-            if pending is None:
-                continue
-            with obs.span("preempt.evict", pod=ev.pod_key,
-                          claim=ev.claim_name,
-                          victim_priority=ev.victim_priority,
-                          beneficiary_priority=ev.beneficiary_priority):
-                pending.bound_node = ""
-                pending.nominated_node = ""
-                pending.enqueued_at = 0.0   # immediate re-window
-                # SLO ledger: the victim's placement clock restarts —
-                # its re-placement resolves as outcome "replaced"
-                obs.get_ledger().reopen(ev.pod_key, "preempted")
-                executed += 1
-            metrics.PREEMPTIONS.labels("priority").inc()
-            self.cluster.record_event(
-                "Pod", ev.pod_key, "Warning", "Preempted",
-                f"evicted from {ev.claim_name} (priority "
-                f"{ev.victim_priority}) for a priority "
-                f"{ev.beneficiary_priority} pod")
-            rec = PreemptionRecord(
-                pod_key=ev.pod_key, victim_priority=ev.victim_priority,
-                beneficiary_priority=ev.beneficiary_priority,
-                beneficiary=ev.beneficiary, claim_name=ev.claim_name)
-            self.eviction_log.append(rec)
-            self.preempted_keys.add(ev.pod_key)
+        with self.journal.intent(
+                "eviction", pool=pool.name,
+                pods=[ev.pod_key for ev in plan.evictions]) as intent:
+            for ev in plan.evictions:
+                pending = self.cluster.get("pods", ev.pod_key)
+                if pending is None:
+                    continue
+                crashpoints.hit("preempt.mid_evict")
+                with obs.span("preempt.evict", pod=ev.pod_key,
+                              claim=ev.claim_name,
+                              victim_priority=ev.victim_priority,
+                              beneficiary_priority=ev.beneficiary_priority):
+                    pending.bound_node = ""
+                    pending.nominated_node = ""
+                    pending.enqueued_at = 0.0   # immediate re-window
+                    # SLO ledger: the victim's placement clock restarts —
+                    # its re-placement resolves as outcome "replaced"
+                    obs.get_ledger().reopen(ev.pod_key, "preempted")
+                    executed += 1
+                intent.note(f"evicted:{ev.pod_key}", pod=ev.pod_key)
+                self.journal.state(f"preempted/{ev.pod_key}", 1)
+                metrics.PREEMPTIONS.labels("priority").inc()
+                self.cluster.record_event(
+                    "Pod", ev.pod_key, "Warning", "Preempted",
+                    f"evicted from {ev.claim_name} (priority "
+                    f"{ev.victim_priority}) for a priority "
+                    f"{ev.beneficiary_priority} pod")
+                rec = PreemptionRecord(
+                    pod_key=ev.pod_key, victim_priority=ev.victim_priority,
+                    beneficiary_priority=ev.beneficiary_priority,
+                    beneficiary=ev.beneficiary, claim_name=ev.claim_name)
+                self.eviction_log.append(rec)
+                self.preempted_keys.add(ev.pod_key)
         placed = 0
         for pn, claim_name in plan.placements.items():
             pending = self.cluster.get("pods", pn)
@@ -269,6 +291,7 @@ class PreemptionController(PollController):
                     or pending.nominated_node:
                 continue
             pending.nominated_node = claim_name
+            self.journal.state(f"nom/{pn}", claim_name)
             obs.get_ledger().resolve(pn, "placed")
             from karpenter_tpu.explain import get_registry
 

@@ -46,6 +46,8 @@ from karpenter_tpu.cloud.subnet import SubnetProvider
 from karpenter_tpu.core.bootstrap import BootstrapOptions, BootstrapProvider, ClusterConfig
 from karpenter_tpu.core.circuitbreaker import CircuitBreakerManager
 from karpenter_tpu.core.cluster import ClusterState
+from karpenter_tpu.recovery import crashpoints
+from karpenter_tpu.recovery.journal import NULL_JOURNAL
 from karpenter_tpu.solver.types import Plan, PlannedNode
 from karpenter_tpu import obs
 from karpenter_tpu.utils import metrics
@@ -63,9 +65,14 @@ class Actuator:
                  bootstrap: BootstrapProvider | None = None,
                  breaker: CircuitBreakerManager | None = None,
                  unavailable: UnavailableOfferings | None = None,
-                 cluster_config: ClusterConfig | None = None):
+                 cluster_config: ClusterConfig | None = None,
+                 journal=None):
         self.cloud = cloud
         self.cluster = cluster
+        # write-ahead intent journal (karpenter_tpu/recovery): every
+        # staged create / delete records a durable intent before its
+        # first RPC; NULL_JOURNAL (the default) no-ops the whole plane
+        self.journal = journal if journal is not None else NULL_JOURNAL
         self.subnets = subnet_provider or SubnetProvider(
             cloud, cluster_subnets_fn=cluster.node_count_by_subnet)
         self.images = image_resolver or ImageResolver(cloud)
@@ -148,9 +155,44 @@ class Actuator:
             architecture=labels.get("kubernetes.io/arch", "amd64"),
             region=nodeclass.spec.region, zone=planned.zone, labels=labels))
 
-        inst = self._staged_create(planned, nodeclass, node_name, subnet_id,
-                                   image_id, sgs, user_data, nodepool_name)
+        # write-ahead intent: durable BEFORE the first RPC, carrying
+        # everything the restart reconciler needs to finish the create
+        # (replay with idempotency keys + nominate) or fence its
+        # half-built leftovers (docs/design/recovery.md)
+        with self.journal.intent(
+                "node_create", node=node_name, nodeclass=nodeclass.name,
+                nodepool=nodepool_name, region=nodeclass.spec.region,
+                type=planned.instance_type, zone=planned.zone,
+                capacity_type=planned.capacity_type, subnet=subnet_id,
+                image=image_id, price=planned.price,
+                sgs=list(sgs or ()),
+                # the rendered bootstrap config: a replayed create whose
+                # instance RPC never ran must boot a node that can still
+                # join the cluster (an empty user_data node never
+                # registers and is GC'd — dead spend)
+                user_data=user_data,
+                volumes=[{"capacity_gb": b.volume.capacity_gb,
+                          "profile": b.volume.profile}
+                         for b in nodeclass.spec.block_device_mappings],
+                pods=list(planned.pod_names)) as intent:
+            crashpoints.hit("actuate.pre_rpc")
+            inst = self._staged_create(planned, nodeclass, node_name,
+                                       subnet_id, image_id, sgs, user_data,
+                                       nodepool_name, intent)
+            claim = self._register_claim(planned, nodeclass, nodepool_name,
+                                         node_name, subnet_id, image_id,
+                                         labels, inst)
+            intent.note("claim", name=claim.name)
+            # the pods this node was created FOR: survives the intent's
+            # completion so a crash after this point (post-create,
+            # pre-nominate) still recovers the nomination
+            self.journal.state(f"claimpods/{claim.name}",
+                               list(planned.pod_names))
+        return claim
 
+    def _register_claim(self, planned: PlannedNode, nodeclass: NodeClass,
+                        nodepool_name: str, node_name: str, subnet_id: str,
+                        image_id: str, labels: dict, inst) -> NodeClaim:
         # the claim inherits the pool's taints/startup taints (karpenter
         # core semantics: NodeClaim carries them, registration syncs them
         # onto the node — registration/controller.go:238-391)
@@ -189,28 +231,46 @@ class Actuator:
 
     def _staged_create(self, planned: PlannedNode, nodeclass: NodeClass,
                        node_name: str, subnet_id: str, image_id: str,
-                       sgs, user_data: str, nodepool_name: str):
+                       sgs, user_data: str, nodepool_name: str, intent):
         """Staged allocation with partial-failure cleanup (ref
         vpc/instance/provider.go:333-401 VNI prototype, :477-481 volumes,
         :720-797 create with orphan cleanup :1192-1312): allocate VNI ->
         volumes -> instance; any stage failing deletes what the earlier
-        stages allocated, so a failed create leaks nothing."""
+        stages allocated, so a failed create leaks nothing.
+
+        Every RPC carries an idempotency key derived from the write-ahead
+        intent id and notes its result id back into the journal, so a
+        crash at ANY point replays as lookups, never duplicates
+        (docs/design/recovery.md)."""
         vni_id = ""
         created_volume_ids: list[str] = []
         try:
             with obs.span("rpc.create_vni", subnet=subnet_id):
-                vni_id = self.cloud.create_vni(subnet_id).id
+                vni_id = self.cloud.create_vni(
+                    subnet_id, idempotency_key=intent.idem_key("vni")).id
+            intent.note("vni", id=vni_id)
+            crashpoints.hit("actuate.mid_create")
             for i, bdm in enumerate(nodeclass.spec.block_device_mappings):
                 v = bdm.volume
                 with obs.span("rpc.create_volume", index=i):
                     created_volume_ids.append(self.cloud.create_volume(
                         capacity_gb=v.capacity_gb, profile=v.profile,
-                        volume_id=f"vol-{node_name}-{i}").id)
+                        volume_id=f"vol-{node_name}-{i}",
+                        idempotency_key=intent.idem_key(f"vol{i}")).id)
+                intent.note(f"vol{i}", id=created_volume_ids[-1])
+            tags = {**KARPENTER_TAGS,
+                    "karpenter.sh/nodepool": nodepool_name,
+                    "karpenter-tpu.sh/nodeclass": nodeclass.name}
+            if intent.id:
+                # ground-truth marker for the no-double-create chaos
+                # invariant (detection, not the recovery mechanism —
+                # replay dedupe rides the idempotency key)
+                tags["karpenter.sh/intent-id"] = intent.id
             with obs.span("rpc.create_instance",
                           instance_type=planned.instance_type,
                           zone=planned.zone,
                           capacity_type=planned.capacity_type):
-                return self.cloud.create_instance(
+                inst = self.cloud.create_instance(
                     name=node_name, profile=planned.instance_type,
                     zone=planned.zone, subnet_id=subnet_id,
                     image_id=image_id,
@@ -218,20 +278,28 @@ class Actuator:
                     security_group_ids=sgs or (),
                     user_data=user_data,
                     vni_id=vni_id, volume_ids=tuple(created_volume_ids),
-                    tags={**KARPENTER_TAGS,
-                          "karpenter.sh/nodepool": nodepool_name,
-                          "karpenter-tpu.sh/nodeclass": nodeclass.name})
+                    tags=tags,
+                    idempotency_key=intent.idem_key("inst"))
+            # the response-lost window: the instance exists server-side
+            # but its id is not yet durable — exactly the leaked-create
+            # failure mode the idempotent replay exists for
+            crashpoints.hit("actuate.post_create")
+            intent.note("instance", id=inst.id)
+            return inst
         except Exception:
-            self._cleanup_partial_create(vni_id, created_volume_ids)
+            self._cleanup_partial_create(vni_id, created_volume_ids, intent)
             raise
 
     def _cleanup_partial_create(self, vni_id: str,
-                                volume_ids: list[str]) -> None:
+                                volume_ids: list[str], intent) -> None:
         """Best-effort orphan deletion — cleanup failure must not mask the
-        create error (the GC sweep is the eventual-consistency backstop)."""
+        create error (the GC sweep is the eventual-consistency backstop).
+        The intent notes what was cleaned so a crash DURING cleanup still
+        replays the remainder."""
         for vid in volume_ids:
             try:
                 self.cloud.delete_volume(vid)
+                intent.note(f"cleaned:{vid}", id=vid)
             except Exception as e:  # noqa: BLE001
                 log.warning("orphan volume cleanup failed", volume=vid,
                             error=str(e))
@@ -239,6 +307,7 @@ class Actuator:
         if vni_id:
             try:
                 self.cloud.delete_vni(vni_id)
+                intent.note(f"cleaned:{vni_id}", id=vni_id)
             except Exception as e:  # noqa: BLE001
                 log.warning("orphan vni cleanup failed", vni=vni_id,
                             error=str(e))
@@ -331,34 +400,46 @@ class Actuator:
         if parsed is None:
             raise NodeClaimNotFoundError(claim.name)
         _, instance_id = parsed
-        # expected not-found outcomes are caught INSIDE the spans: a
-        # routine successful delete must not mint error traces, or the
-        # flight recorder's error ring (reserved for real failures)
-        # drowns in the success path
-        with obs.span("rpc.delete_instance", instance=instance_id) as sp:
-            try:
-                self.cloud.delete_instance(instance_id)
-            except CloudError as e:
-                if not is_not_found(e):
-                    raise
-                sp.set("already_gone", True)
-        # verify gone
-        gone = False
-        with obs.span("rpc.get_instance", instance=instance_id,
-                      verify="post-delete") as sp:
-            try:
-                self.cloud.get_instance(instance_id)
-            except CloudError as e:
-                if not is_not_found(e):
-                    raise
-                gone = True
-                sp.set("gone", True)
-        if gone:
-            metrics.INSTANCE_LIFECYCLE.labels("deleted", claim.instance_type,
-                                              claim.zone).inc()
-            self._drop_cost_series(claim)
-            raise NodeClaimNotFoundError(claim.name)
-        raise CloudError(f"instance {instance_id} still exists after delete", 500)
+        # journaled delete: a crash between the delete RPC and the
+        # verify re-drives the (idempotent) delete on restart.  The
+        # success contract RAISES NodeClaimNotFoundError, so that
+        # exception closes the intent as ok.
+        with self.journal.intent("claim_delete", claim=claim.name,
+                                 instance=instance_id,
+                                 ok=(NodeClaimNotFoundError,)):
+            # expected not-found outcomes are caught INSIDE the spans: a
+            # routine successful delete must not mint error traces, or the
+            # flight recorder's error ring (reserved for real failures)
+            # drowns in the success path
+            with obs.span("rpc.delete_instance", instance=instance_id) as sp:
+                try:
+                    self.cloud.delete_instance(instance_id)
+                except CloudError as e:
+                    if not is_not_found(e):
+                        raise
+                    sp.set("already_gone", True)
+            # verify gone
+            gone = False
+            with obs.span("rpc.get_instance", instance=instance_id,
+                          verify="post-delete") as sp:
+                try:
+                    self.cloud.get_instance(instance_id)
+                except CloudError as e:
+                    if not is_not_found(e):
+                        raise
+                    gone = True
+                    sp.set("gone", True)
+            if gone:
+                metrics.INSTANCE_LIFECYCLE.labels("deleted",
+                                                  claim.instance_type,
+                                                  claim.zone).inc()
+                self._drop_cost_series(claim)
+                # the node is gone for good: its created-for record
+                # must not re-nominate pods onto it after a restart
+                self.journal.state(f"claimpods/{claim.name}", None)
+                raise NodeClaimNotFoundError(claim.name)
+            raise CloudError(
+                f"instance {instance_id} still exists after delete", 500)
 
     def _drop_cost_series(self, claim: NodeClaim) -> None:
         """Series hygiene: the COST_PER_HOUR gauge is keyed by

@@ -27,6 +27,8 @@ from karpenter_tpu.catalog.instancetype import InstanceTypeProvider, filter_inst
 from karpenter_tpu.core.actuator import Actuator
 from karpenter_tpu.core.cluster import ClusterState, PendingPod
 from karpenter_tpu.core.window import SolveWindow, WindowOptions
+from karpenter_tpu.recovery import crashpoints
+from karpenter_tpu.recovery.journal import NULL_JOURNAL
 from karpenter_tpu.solver.greedy import GreedySolver
 from karpenter_tpu.solver.jax_backend import JaxSolver
 from karpenter_tpu.solver.types import Plan, SolveRequest, SolverOptions
@@ -70,10 +72,13 @@ def make_solver(options: SolverOptions):
 class Provisioner:
     def __init__(self, cluster: ClusterState, catalog_provider: InstanceTypeProvider,
                  actuator: Actuator, options: ProvisionerOptions | None = None,
-                 factory=None, leader=None):
+                 factory=None, leader=None, journal=None):
         self.cluster = cluster
         self.catalog_provider = catalog_provider
         self.actuator = actuator
+        # write-ahead journal (karpenter_tpu/recovery): nominations are
+        # recorded as newest-wins state so a restart rebuilds them
+        self.journal = journal if journal is not None else NULL_JOURNAL
         # optional ProviderFactory: per-NodeClass VPC/IKS actuation selection
         # (ref factory.go:70); without one, the VPC actuator serves all
         self.factory = factory
@@ -345,6 +350,10 @@ class Provisioner:
                 actuator = self.actuator_for(nodeclass)
                 claims, errors = actuator.execute_plan(
                     plan, nodeclass, catalog, pool.name)
+                # the stranded-capacity window: claims registered, pods
+                # not yet nominated — covered by the actuator's
+                # claimpods state records (docs/design/recovery.md)
+                crashpoints.hit("provision.pre_nominate")
                 # nominate pods onto successfully-created claims
                 for node, claim in zip(plan.nodes, claims):
                     if claim is None:
@@ -539,6 +548,8 @@ class Provisioner:
         pending = self.cluster.get("pods", key)
         if pending is not None:
             pending.nominated_node = node_name
+            # durable nomination record: newest wins, rebuilt on restart
+            self.journal.state(f"nom/{key}", node_name)
             # terminal ledger edge: placement decision latency observed
             # into karpenter_tpu_pod_placement_seconds{outcome}; the
             # ambient span (fired window / gang.place) supplies the

@@ -46,6 +46,7 @@ from karpenter_tpu.operator.credentials import (
     CredentialStore, EnvCredentialProvider, StaticCredentialProvider,
 )
 from karpenter_tpu.operator.options import Options
+from karpenter_tpu import obs
 from karpenter_tpu.utils.logging import get_logger
 
 log = get_logger("operator")
@@ -104,9 +105,28 @@ class Operator:
             spot_discount_percent=self.options.spot_discount_percent)
         self.breaker = CircuitBreakerManager(self.options.circuit_breaker)
 
+        # crash-recovery plane (docs/design/recovery.md): with a journal
+        # dir configured, every mutating actuation writes a durable
+        # intent ahead of its first RPC, and start() replays open
+        # intents before the controllers resume; unset -> null journal
+        if self.options.journal_dir:
+            import os as _os
+
+            from karpenter_tpu.recovery.journal import IntentJournal
+
+            self.journal = IntentJournal(
+                _os.path.join(self.options.journal_dir, "intents.jsonl"),
+                owner=self.options.leader_identity or "operator")
+        else:
+            from karpenter_tpu.recovery.journal import NULL_JOURNAL
+
+            self.journal = NULL_JOURNAL
+        self._recovery_report = None
+
         self.actuator = Actuator(self.cloud, self.cluster,
                                  breaker=self.breaker,
-                                 unavailable=self.unavailable)
+                                 unavailable=self.unavailable,
+                                 journal=self.journal)
         iks_actuator = WorkerPoolActuator(
             self.iks, self.cluster, breaker=self.breaker,
             unavailable=self.unavailable) if self.iks is not None else None
@@ -139,7 +159,8 @@ class Operator:
             self.cluster, self.instance_types, self.actuator,
             ProvisionerOptions(solver=self.options.solver,
                                window=self.options.window),
-            factory=self.factory, leader=self.elector.is_leader)
+            factory=self.factory, leader=self.elector.is_leader,
+            journal=self.journal)
         self.lb_provider = LoadBalancerProvider(lbs) if lbs is not None else None
 
         self.manager = ControllerManager(self.cluster,
@@ -150,7 +171,12 @@ class Operator:
         self.webhook_server = None
         self._warmup_thread = None
         self._warmup_stop = None
+        self._warmup_started = False
         self._started = False
+        import threading as _threading
+
+        self._recovered = False
+        self._recover_lock = _threading.Lock()
 
     def _build_controllers(self) -> list:
         """The reference's registration list (controllers.go:117-259) with
@@ -167,10 +193,12 @@ class Operator:
             StartupTaintController(self.cluster),
             NodeClaimTerminationController(self.cluster, self.actuator,
                                            factory=self.factory),
-            GarbageCollectionController(self.cluster, self.cloud),
+            GarbageCollectionController(self.cluster, self.cloud,
+                                        journal=self.journal),
             TaggingController(self.cluster, self.cloud),
             SpotPreemptionController(self.cluster, self.cloud,
-                                     self.unavailable),
+                                     self.unavailable,
+                                     journal=self.journal),
             InstanceTypeRefreshController(self.instance_types,
                                           self.unavailable),
             PricingRefreshController(self.pricing),
@@ -190,7 +218,8 @@ class Operator:
             repack_enabled=self.options.repack_enabled,
             repack_min_savings_fraction=(
                 self.options.repack_min_savings_percent / 100.0),
-            resident_occupancy=self.options.resident_enabled))
+            resident_occupancy=self.options.resident_enabled,
+            journal=self.journal))
         # priority-aware preemption: stranded high-priority pods take
         # capacity from lower-priority pods on existing nodes when no
         # offering is creatable (docs/design/preemption.md)
@@ -200,7 +229,7 @@ class Operator:
             )
 
             ctrls.append(PreemptionController(
-                self.cluster, self.provisioner))
+                self.cluster, self.provisioner, journal=self.journal))
         # gang admission + TPU-slice placement: whole-job atomic
         # scheduling, parked behind min_member (docs/design/gang.md).
         # Opt-in: the controller registers the provisioner's admission
@@ -211,11 +240,12 @@ class Operator:
             )
 
             ctrls.append(GangAdmissionController(
-                self.cluster, self.provisioner))
+                self.cluster, self.provisioner, journal=self.journal))
         # env-gated (controllers.go:238)
         ctrls.append(OrphanCleanupController(
             self.cluster, self.cloud,
-            enabled=self.options.orphan_cleanup_enabled))
+            enabled=self.options.orphan_cleanup_enabled,
+            journal=self.journal))
         if self.iks is not None:
             ctrls.append(PoolCleanupController(self.cluster, self.iks))
         if self.lb_provider is not None:
@@ -246,6 +276,12 @@ class Operator:
         store = getattr(solver, "resident", None)
         if store is not None:
             out["resident"] = store.stats()
+        # crash-recovery block: journal health + what the last restart
+        # recovery replayed/fenced (docs/design/recovery.md)
+        recovery = {"journal": self.journal.stats()}
+        if self._recovery_report is not None:
+            recovery["last_recovery"] = self._recovery_report.to_dict()
+        out["recovery"] = recovery
         return out
 
     # -- lifecycle ---------------------------------------------------------
@@ -257,6 +293,11 @@ class Operator:
         the first provisioning window after a restart pays neither XLA
         compilation nor the catalog upload.  No-op for non-jax backends;
         never boot-fatal."""
+        # idempotent: a follower prewarms at start(), and its deferred
+        # recover() on later leadership must not spawn a second warmup
+        if self._warmup_started:
+            return
+        self._warmup_started = True
         if self.options.solver.backend != "jax":
             return
         self.aot = None
@@ -330,6 +371,46 @@ class Operator:
             target=_warm, name="solver-warmup", daemon=True)
         self._warmup_thread.start()
 
+    def recover(self) -> None:
+        """ONE restart path (docs/design/recovery.md): replay the
+        write-ahead journal's open intents against cloud + cluster
+        ground truth (fence or finish each), rebuild volatile controller
+        state (preempted_keys, gang admissions, nominations) from the
+        journal's state records, then hand off to the AOT prewarm +
+        resident rebuild tier (_start_solver_warmup), which pre-compiles
+        exactly what the crashed process dispatched.
+
+        Runs at most once per process, and the journal replay half —
+        which ISSUES cloud RPCs (fence deletes, finish creates) — only
+        ever runs while this replica is the leader: a restarted
+        follower fencing intents against resources the live leader just
+        adopted would be exactly the split-brain actuation the election
+        gate exists to prevent (same rule as the manager's
+        follower-skips-resync)."""
+        do_replay = False
+        with self._recover_lock:
+            # a follower's call falls through to the warmup tail WITHOUT
+            # consuming the once-flag — its replay is still owed if (and
+            # when) it becomes leader
+            if not self._recovered and self.elector.is_leader():
+                self._recovered = True
+                do_replay = True
+        if do_replay and self.journal.stats().get("enabled"):
+            from karpenter_tpu.recovery.reconciler import Reconciler
+
+            self._recovery_report = Reconciler(
+                self.journal, self.cloud, self.cluster).recover()
+            for ctrl in self.manager.controllers():
+                seed = getattr(ctrl, "seed_recovered", None)
+                if seed is None:
+                    continue
+                if ctrl.name == "preemption":
+                    seed(self._recovery_report.preempted_keys)
+                elif ctrl.name == "gang":
+                    seed(self._recovery_report.gang_admitted,
+                         self._recovery_report.gang_parked)
+        self._start_solver_warmup()
+
     def start(self) -> None:
         """Resync existing objects, then go live (watch threads + pollers +
         the provisioning window)."""
@@ -340,8 +421,23 @@ class Operator:
         from karpenter_tpu.utils.metrics import record_build_info
 
         record_build_info(backend=self.options.solver.backend)
-        self._start_solver_warmup()
+        # journal replay is leadership-gated: a follower defers its
+        # recovery until (if ever) it becomes leader; prewarm still
+        # runs either way via the deferred recover()'s warmup tail
+        prior_cb = getattr(self.elector, "on_started_leading", None)
+
+        def _recover_on_lead():
+            self.recover()
+            if prior_cb is not None:
+                prior_cb()
+
+        if hasattr(self.elector, "on_started_leading"):
+            self.elector.on_started_leading = _recover_on_lead
         self.elector.start()
+        if self.elector.is_leader():
+            self.recover()
+        else:
+            self._start_solver_warmup()   # follower-safe prewarm only
         self.manager.sync(rounds=1)    # restart = resume (SURVEY.md §5.4)
         self.manager.start()
         self.provisioner.start()
@@ -371,6 +467,63 @@ class Operator:
                  controllers=len(self.manager.controllers()),
                  backend=self.options.solver.backend)
 
+    def install_signal_handlers(self) -> None:
+        """SIGTERM -> graceful drain (Kubernetes pod termination sends
+        exactly this before the SIGKILL deadline).  Main-thread only —
+        Python delivers signals nowhere else."""
+        import signal
+
+        def _on_sigterm(signum, frame):
+            log.info("SIGTERM received; draining")
+            self.drain()
+
+        signal.signal(signal.SIGTERM, _on_sigterm)
+
+    def drain(self, timeout: float = 30.0) -> None:
+        """Graceful shutdown (docs/design/recovery.md): stop accepting
+        solve windows, let in-flight actuation finish (or stay
+        journaled — a crash past the deadline replays it), flush the
+        journal and dump the recorder rings next to it, then stop.  A
+        drained process leaves ZERO open intents for its successor."""
+        if not self._started:
+            self.stop()
+            return
+        with obs.span("operator.drain") as sp:
+            # 1. stop intake: the window closes (pending adds resolve),
+            #    controllers + pollers stop — no NEW actuation starts
+            self.provisioner.stop()
+            self.manager.stop()
+            # 2. wait out in-flight actuation: the solve lock serializes
+            #    solve+actuate, so holding it proves the plane is idle
+            acquired = self.provisioner._solve_lock.acquire(timeout=timeout)
+            if acquired:
+                self.provisioner._solve_lock.release()
+            sp.set("actuation_drained", acquired)
+            # 3. flush the durable evidence: journal to disk, recorder
+            #    rings to a drain bundle next to it (a post-mortem can
+            #    read the final causal chains without a live /debug)
+            self.journal.flush()
+            if self.options.journal_dir:
+                try:
+                    import os as _os
+
+                    from karpenter_tpu.obs.export import (
+                        dump_jsonl, recorder_to_dicts,
+                    )
+
+                    dump_jsonl(recorder_to_dicts(obs.get_recorder()),
+                               _os.path.join(self.options.journal_dir,
+                                             "drain-spans.jsonl"))
+                except Exception as e:  # noqa: BLE001 — drain must finish
+                    log.warning("drain span dump failed",
+                                error=str(e)[:200])
+            sp.set("open_intents",
+                   self.journal.stats().get("open_intents", 0))
+        self.stop()
+        self.journal.close()
+        log.info("operator drained",
+                 open_intents=self.journal.stats().get("open_intents", 0))
+
     def stop(self) -> None:
         # pricing spawns its batcher thread in __init__, so it must be
         # closed even for a constructed-but-never-started operator — but
@@ -379,6 +532,7 @@ class Operator:
         # can hit "batcher closed" mid-shutdown
         if not self._started:
             self.pricing.close()
+            self.journal.close()
             return
         try:
             try:

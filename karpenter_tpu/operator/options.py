@@ -82,6 +82,11 @@ class Options:
     # or catalog upload): persistent compile cache dir + boot warmup
     compile_cache_dir: str = ""            # KARPENTER_TPU_COMPILE_CACHE
     solver_warmup: bool = True             # KARPENTER_TPU_WARMUP
+    # crash-recovery plane (karpenter_tpu/recovery): directory for the
+    # write-ahead intent journal; set -> every mutating actuation is
+    # journaled and operator start replays open intents
+    # (docs/design/recovery.md)
+    journal_dir: str = ""                  # KARPENTER_JOURNAL_DIR
 
     # sub-configs
     circuit_breaker: CircuitBreakerConfig = field(
@@ -131,6 +136,7 @@ class Options:
                                         60),
             compile_cache_dir=env.get("KARPENTER_TPU_COMPILE_CACHE", ""),
             solver_warmup=_getb(env, "KARPENTER_TPU_WARMUP", True),
+            journal_dir=env.get("KARPENTER_JOURNAL_DIR", ""),
             circuit_breaker=CircuitBreakerConfig.from_env(env),
             solver=solver, window=window)
 

@@ -553,6 +553,36 @@ WATCHDOG_SUPPRESSED = Counter(
     "Breaches whose triage bundle was suppressed by the rate limit, "
     "by trigger", ("trigger",))
 
+# Crash-recovery plane: write-ahead intent journal + restart reconciler
+# (karpenter_tpu/recovery, docs/design/recovery.md).
+JOURNAL_RECORDS = Counter(
+    "karpenter_tpu_journal_records_total",
+    "Write-ahead journal records appended, by record type (intent = "
+    "durable pre-RPC intent, note = staged-RPC progress, done = "
+    "completion, state = newest-wins control-plane state)", ("rec",))
+JOURNAL_OPEN_INTENTS = Gauge(
+    "karpenter_tpu_journal_open_intents",
+    "Intents currently open (written ahead of an actuation whose "
+    "completion record has not landed); nonzero across a restart means "
+    "the reconciler has replay work", ())
+JOURNAL_COMPACTIONS = Counter(
+    "karpenter_tpu_journal_compactions_total",
+    "Journal compaction rewrites (bounded-file guarantee)", ())
+JOURNAL_BYTES = Gauge(
+    "karpenter_tpu_journal_bytes",
+    "On-disk journal size after the last flush/compaction", ())
+RECOVERY_DURATION = Histogram(
+    "karpenter_tpu_recovery_seconds",
+    "Restart recovery latency by phase: replay (journal read), fence "
+    "(open-intent resolution + state rebuild against ground truth)",
+    ("phase",))
+RECOVERY_INTENTS = Counter(
+    "karpenter_tpu_recovery_intents_total",
+    "Open intents resolved on restart, by kind and outcome (finished = "
+    "completed against ground truth, fenced = leftovers deleted / state "
+    "released, error = the recovery action itself failed and was left "
+    "to the orphan/GC backstops)", ("kind", "outcome"))
+
 LEADER = Gauge(
     "karpenter_tpu_leader",
     "1 when this replica holds the named leader-election lease", ("lease",))

@@ -1144,3 +1144,84 @@ def test_gl109_out_of_scope_paths_clean():
         def reconcile(out):
             out.block_until_ready()
         """, "GL109", CTRL_PATH)
+
+
+# -- GL110: unjournaled-mutation (karpenter_tpu/recovery) --------------------
+
+CORE_PATH = "karpenter_tpu/core/_snippet.py"
+
+
+def test_gl110_bare_create_bad():
+    assert_flags(
+        """
+        class A:
+            def provision(self):
+                return self.cloud.create_instance(name="n", profile="p",
+                                                  zone="z", subnet_id="s",
+                                                  image_id="i")
+        """, "GL110", CORE_PATH)
+
+
+def test_gl110_bare_delete_bad():
+    assert_flags(
+        """
+        class C:
+            def sweep(self):
+                for inst in self.cloud.list_instances():
+                    self.cloud.delete_instance(inst.id)
+        """, "GL110", CTRL_PATH)
+
+
+def test_gl110_with_intent_good():
+    assert_clean(
+        """
+        class A:
+            def provision(self):
+                with self.journal.intent("node_create", node="n") as intent:
+                    return self.cloud.create_instance(
+                        name="n", profile="p", zone="z", subnet_id="s",
+                        image_id="i",
+                        idempotency_key=intent.idem_key("inst"))
+        """, "GL110", CORE_PATH)
+
+
+def test_gl110_intent_param_helper_good():
+    # the staged-create helper idiom: the caller opened the intent and
+    # passed the handle down — the helper's RPCs are covered
+    assert_clean(
+        """
+        class A:
+            def _staged(self, subnet_id, intent):
+                vni = self.cloud.create_vni(
+                    subnet_id, idempotency_key=intent.idem_key("vni"))
+                intent.note("vni", id=vni.id)
+                return vni
+        """, "GL110", CORE_PATH)
+
+
+def test_gl110_nonmutating_calls_clean():
+    assert_clean(
+        """
+        class C:
+            def reconcile(self):
+                self.cloud.list_instances()
+                self.cloud.get_instance("i-1")
+                self.cloud.update_tags("i-1", {})
+        """, "GL110", CTRL_PATH)
+
+
+def test_gl110_out_of_scope_paths_clean():
+    # recovery/ itself replays and fences intents by construction; the
+    # cloud clients ARE the mutation surface — neither is in scope
+    assert_clean(
+        """
+        class R:
+            def fence(self):
+                self.cloud.delete_instance("i-1")
+        """, "GL110", "karpenter_tpu/recovery/_snippet.py")
+    assert_clean(
+        """
+        class C:
+            def delete_instance(self, instance_id):
+                return self.http.delete_instance(instance_id)
+        """, "GL110", CLOUD_PATH)

@@ -1,0 +1,941 @@
+"""Crash-recovery plane tests (karpenter_tpu/recovery +
+docs/design/recovery.md).
+
+Covers the journal's write-ahead/torn-line/compaction contracts, the
+cloud idempotency-key ledger, the reconciler's fence-vs-finish decision
+table against ground truth, the actuator/controller journaling wiring,
+the crashpoint chaos dimension (including the deliberately-broken
+idempotency fixture that MUST fail no-double-create), retry deadline
+propagation, the operator's graceful drain, and leader-failover journal
+fencing.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+import pytest
+
+from karpenter_tpu.apis.nodeclaim import NodeClaim, provider_id
+from karpenter_tpu.apis.nodeclass import (
+    InstanceRequirements, NodeClass, NodeClassSpec, PlacementStrategy,
+)
+from karpenter_tpu.apis.pod import PodSpec, ResourceRequests, make_pods
+from karpenter_tpu.catalog.arrays import CatalogArrays
+from karpenter_tpu.catalog.instancetype import InstanceTypeProvider
+from karpenter_tpu.catalog.pricing import PricingProvider
+from karpenter_tpu.cloud.errors import CloudError
+from karpenter_tpu.cloud.fake import FakeCloud
+from karpenter_tpu.constants import CLAIM_FINALIZER
+from karpenter_tpu.core.actuator import Actuator
+from karpenter_tpu.core.cluster import ClusterState
+from karpenter_tpu.recovery import crashpoints
+from karpenter_tpu.recovery.crashpoints import (
+    CRASHPOINTS, CrashInjector, SimulatedCrash,
+)
+from karpenter_tpu.recovery.journal import (
+    NULL_JOURNAL, IntentJournal, NullJournal, read_journal,
+)
+from karpenter_tpu.recovery.reconciler import Reconciler
+from karpenter_tpu.solver.types import PlannedNode
+
+
+def ready_nodeclass(cluster: ClusterState) -> NodeClass:
+    nc = NodeClass(name="default", spec=NodeClassSpec(
+        region="us-south", image="img-1", vpc="vpc-1",
+        instance_requirements=InstanceRequirements(min_cpu=2),
+        placement_strategy=PlacementStrategy()))
+    nc.status.resolved_image_id = "img-1"
+    nc.status.set_condition("Ready", "True", "Test")
+    cluster.add_nodeclass(nc)
+    return nc
+
+
+def build_catalog(cloud: FakeCloud) -> CatalogArrays:
+    pricing = PricingProvider(cloud)
+    catalog = CatalogArrays.build(
+        InstanceTypeProvider(cloud, pricing).list())
+    pricing.close()
+    return catalog
+
+
+def planned(catalog: CatalogArrays, pods=("default/p1",)) -> PlannedNode:
+    return PlannedNode(instance_type=catalog.type_names[0],
+                       zone="us-south-1", capacity_type="on-demand",
+                       price=1.0, pod_names=list(pods))
+
+
+# -- journal ----------------------------------------------------------------
+
+class TestJournal:
+    def test_write_ahead_ordering(self, tmp_path):
+        """The intent record is on disk BEFORE the block body runs."""
+        path = str(tmp_path / "j.jsonl")
+        j = IntentJournal(path, owner="t")
+        with j.intent("node_create", node="n1") as intent:
+            on_disk, _, _, _ = read_journal(path)
+            assert [i.id for i in on_disk] == [intent.id]
+            assert not on_disk[0].outcome
+        on_disk, _, _, _ = read_journal(path)
+        assert on_disk[0].outcome == "ok"
+        j.close()
+
+    def test_crash_leaves_intent_open(self, tmp_path):
+        path = str(tmp_path / "j.jsonl")
+        j = IntentJournal(path, owner="t")
+        with pytest.raises(SimulatedCrash):
+            with j.intent("node_create", node="n1") as intent:
+                intent.note("vni", id="vni-9")
+                raise SimulatedCrash("actuate.mid_create", 1)
+        j.close()
+        j2 = IntentJournal(path, owner="t")
+        opens = j2.open_intents()
+        assert len(opens) == 1
+        assert opens[0].notes["vni"] == {"id": "vni-9"}
+        # seq continues past the crashed intent: ids never collide
+        with j2.intent("eviction", pods=[]) as i2:
+            assert int(i2.id.rsplit("-", 1)[-1]) > \
+                int(opens[0].id.rsplit("-", 1)[-1])
+        j2.close()
+
+    def test_clean_failure_closes_intent(self, tmp_path):
+        j = IntentJournal(str(tmp_path / "j.jsonl"), owner="t")
+        with pytest.raises(CloudError):
+            with j.intent("node_create", node="n1"):
+                raise CloudError("quota", 403)
+        assert j.open_intents() == []
+        j.close()
+
+    def test_ok_exceptions_close_as_success(self, tmp_path):
+        from karpenter_tpu.cloud.errors import NodeClaimNotFoundError
+
+        path = str(tmp_path / "j.jsonl")
+        j = IntentJournal(path, owner="t")
+        with pytest.raises(NodeClaimNotFoundError):
+            with j.intent("claim_delete", claim="c1",
+                          ok=(NodeClaimNotFoundError,)):
+                raise NodeClaimNotFoundError("c1")
+        intents, _, _, _ = read_journal(path)
+        assert intents[0].outcome == "ok"
+        j.close()
+
+    def test_state_newest_wins_and_tombstones(self, tmp_path):
+        path = str(tmp_path / "j.jsonl")
+        j = IntentJournal(path, owner="t")
+        j.state("nom/a", "c1")
+        j.state("nom/a", "c2")
+        j.state("nom/b", "c3")
+        j.state("nom/b", None)
+        assert j.state_map() == {"nom/a": "c2"}
+        j.close()
+        _, state, _, _ = read_journal(path)
+        assert state == {"nom/a": "c2"}
+
+    def test_torn_final_line_tolerated(self, tmp_path):
+        path = str(tmp_path / "j.jsonl")
+        j = IntentJournal(path, owner="t")
+        with j.intent("node_create", node="n1"):
+            pass
+        j.close()
+        with open(path, "a", encoding="utf-8") as fh:
+            fh.write('{"rec":"intent","id":"t-00')   # torn write
+        intents, _, _, _ = read_journal(path)
+        assert len(intents) == 1
+        # and a reopened journal keeps appending past the tear
+        j2 = IntentJournal(path, owner="t")
+        j2.state("k", 1)
+        assert j2.state_map() == {"k": 1}
+        j2.close()
+
+    def test_compaction_bounds_the_file(self, tmp_path):
+        path = str(tmp_path / "j.jsonl")
+        j = IntentJournal(path, owner="t", max_records=80)
+        for i in range(200):
+            with j.intent("eviction", pods=[f"p{i}"]):
+                pass
+        assert j.stats()["records"] <= 160   # rewritten under the cap
+        assert j.stats()["compactions"] >= 1
+        # an open intent survives every compaction with its notes
+        with pytest.raises(SimulatedCrash):
+            with j.intent("node_create", node="keep") as intent:
+                intent.note("vni", id="v1")
+                raise SimulatedCrash("journal.append", 1)
+        j.compact()
+        j.close()
+        intents, _, _, _ = read_journal(path)
+        open_ = [i for i in intents if not i.outcome]
+        assert len(open_) == 1 and open_[0].notes["vni"] == {"id": "v1"}
+
+    def test_seq_survives_compaction(self, tmp_path):
+        """Intent ids must NEVER be reused across compactions: a reused
+        id reuses its idempotency keys, and a new create would silently
+        return a stale cloud resource (review finding)."""
+        path = str(tmp_path / "j.jsonl")
+        j = IntentJournal(path, owner="t")
+        with j.intent("node_create", node="n1") as i1:
+            pass
+        j.compact()       # drops the completed intent from the file
+        j.close()
+        j2 = IntentJournal(path, owner="t")
+        with j2.intent("node_create", node="n2") as i2:
+            assert int(i2.id.rsplit("-", 1)[-1]) > \
+                int(i1.id.rsplit("-", 1)[-1])
+            assert i2.idem_key("inst") != i1.idem_key("inst")
+        j2.close()
+
+    def test_null_journal_surface(self):
+        assert isinstance(NULL_JOURNAL, NullJournal)
+        with NULL_JOURNAL.intent("node_create", node="x") as intent:
+            assert intent.idem_key("vni") == ""
+            intent.note("vni", id="v")
+        NULL_JOURNAL.state("k", 1)
+        assert NULL_JOURNAL.state_map() == {}
+        assert NULL_JOURNAL.stats() == {"enabled": False}
+
+    def test_idempotency_switch_off_mints_no_keys(self, tmp_path):
+        j = IntentJournal(str(tmp_path / "j.jsonl"), owner="t",
+                          idempotency=False)
+        with j.intent("node_create", node="n1") as intent:
+            assert intent.idem_key("inst") == ""
+        j.close()
+
+    def test_virtual_clock_stamps(self, tmp_path):
+        from karpenter_tpu.chaos.clock import VirtualClock
+
+        path = str(tmp_path / "j.jsonl")
+        clock = VirtualClock(start=1000.0)
+        with clock.installed():
+            j = IntentJournal(path, owner="t")
+            with j.intent("eviction", pods=[]):
+                pass
+            clock.advance(60.0)
+            j.state("k", 1)
+            j.close()
+        recs = [json.loads(line)
+                for line in open(path, encoding="utf-8")]
+        assert recs[0]["t"] == 1000.0
+        assert recs[-1]["t"] == 1060.0
+
+
+# -- cloud idempotency ------------------------------------------------------
+
+class TestCloudIdempotency:
+    def test_replayed_creates_are_lookups(self):
+        cloud = FakeCloud()
+        vni1 = cloud.create_vni("subnet-11", idempotency_key="k/vni")
+        vni2 = cloud.create_vni("subnet-11", idempotency_key="k/vni")
+        assert vni1.id == vni2.id
+        vol1 = cloud.create_volume(volume_id="vol-x-0",
+                                   idempotency_key="k/vol0")
+        vol2 = cloud.create_volume(volume_id="vol-x-0",
+                                   idempotency_key="k/vol0")
+        assert vol1.id == vol2.id
+        kw = dict(name="n", profile=cloud.profiles[0].name,
+                  zone="us-south-1", subnet_id="subnet-11",
+                  image_id="img-1")
+        i1 = cloud.create_instance(**kw, idempotency_key="k/inst")
+        i2 = cloud.create_instance(**kw, idempotency_key="k/inst")
+        assert i1.id == i2.id
+        assert cloud.instance_count() == 1
+        assert cloud.find_by_idempotency("k/inst") == i1.id
+        # no key -> no dedupe (the pre-journal behavior is unchanged)
+        i3 = cloud.create_instance(**kw)
+        assert i3.id != i1.id
+
+    def test_replay_skips_quota(self):
+        cloud = FakeCloud(instance_quota=1)
+        kw = dict(name="n", profile=cloud.profiles[0].name,
+                  zone="us-south-1", subnet_id="subnet-11",
+                  image_id="img-1")
+        i1 = cloud.create_instance(**kw, idempotency_key="k/inst")
+        # quota is full, but the REPLAY returns the existing instance
+        i2 = cloud.create_instance(**kw, idempotency_key="k/inst")
+        assert i2.id == i1.id
+        with pytest.raises(CloudError):
+            cloud.create_instance(**kw, idempotency_key="other")
+
+    def test_stub_threads_idempotency_key(self):
+        from karpenter_tpu.cloud.stub import StubCloudServer
+        from karpenter_tpu.cloud.vpc import VPCCloudClient
+
+        server = StubCloudServer().start()
+        try:
+            client = VPCCloudClient(server.endpoint, "test-key")
+            v1 = client.create_vni("subnet-11", idempotency_key="w/vni")
+            v2 = client.create_vni("subnet-11", idempotency_key="w/vni")
+            assert v1.id == v2.id
+            kw = dict(name="n", profile=server.cloud.profiles[0].name,
+                      zone="us-south-1", subnet_id="subnet-11",
+                      image_id="img-1")
+            i1 = client.create_instance(**kw, idempotency_key="w/inst")
+            i2 = client.create_instance(**kw, idempotency_key="w/inst")
+            assert i1.id == i2.id
+            assert server.cloud.instance_count() == 1
+        finally:
+            server.stop()
+
+
+# -- actuator journaling ----------------------------------------------------
+
+class TestActuatorJournaling:
+    def _rig(self, tmp_path, quota=100000):
+        cloud = FakeCloud(instance_quota=quota)
+        cluster = ClusterState()
+        nc = ready_nodeclass(cluster)
+        catalog = build_catalog(cloud)
+        journal = IntentJournal(str(tmp_path / "j.jsonl"), owner="t")
+        actuator = Actuator(cloud, cluster, journal=journal)
+        return cloud, cluster, nc, catalog, journal, actuator
+
+    def test_successful_create_closes_intent(self, tmp_path):
+        cloud, cluster, nc, catalog, journal, actuator = self._rig(tmp_path)
+        claim = actuator.create_node(planned(catalog), nc, catalog)
+        assert journal.open_intents() == []
+        intents, state, _, _ = read_journal(journal.path)
+        create = [i for i in intents if i.kind == "node_create"][0]
+        assert create.outcome == "ok"
+        assert create.notes["instance"]["id"]
+        assert state[f"claimpods/{claim.name}"] == ["default/p1"]
+        # the instance carries the intent-id ground-truth tag
+        inst = cloud.list_instances()[0]
+        assert inst.tags["karpenter.sh/intent-id"] == create.id
+        journal.close()
+
+    def test_failed_create_closes_failed_and_cleans(self, tmp_path):
+        cloud, cluster, nc, catalog, journal, actuator = \
+            self._rig(tmp_path, quota=0)
+        with pytest.raises(CloudError):
+            actuator.create_node(planned(catalog), nc, catalog)
+        assert journal.open_intents() == []
+        intents, _, _, _ = read_journal(journal.path)
+        create = [i for i in intents if i.kind == "node_create"][0]
+        assert create.outcome == "failed"
+        assert not cloud.vnis and not cloud.volumes   # compensation ran
+        journal.close()
+
+    def test_crash_mid_create_leaves_open_intent(self, tmp_path):
+        cloud, cluster, nc, catalog, journal, actuator = self._rig(tmp_path)
+        injector = CrashInjector("actuate.mid_create", seed=1,
+                                 first_hit_range=(1, 1), max_crashes=1)
+        with crashpoints.installed(injector), pytest.raises(SimulatedCrash):
+            actuator.create_node(planned(catalog), nc, catalog)
+        opens = journal.open_intents()
+        assert len(opens) == 1 and opens[0].kind == "node_create"
+        assert "vni" in opens[0].notes          # stage progress survived
+        assert len(cloud.vnis) == 1             # the leak recovery fences
+        journal.close()
+
+    def test_delete_node_journaled(self, tmp_path):
+        from karpenter_tpu.cloud.errors import NodeClaimNotFoundError
+
+        cloud, cluster, nc, catalog, journal, actuator = self._rig(tmp_path)
+        claim = actuator.create_node(planned(catalog), nc, catalog)
+        with pytest.raises(NodeClaimNotFoundError):
+            actuator.delete_node(claim)
+        intents, state, _, _ = read_journal(journal.path)
+        dele = [i for i in intents if i.kind == "claim_delete"][0]
+        assert dele.outcome == "ok"       # success RAISES NotFound
+        assert f"claimpods/{claim.name}" not in state   # tombstoned
+        journal.close()
+
+
+# -- reconciler decision table ----------------------------------------------
+
+class TestReconciler:
+    def _crash_create(self, tmp_path, crashpoint, pods=("default/p1",),
+                      add_pods=True, idempotency=True):
+        cloud = FakeCloud()
+        cluster = ClusterState()
+        nc = ready_nodeclass(cluster)
+        catalog = build_catalog(cloud)
+        if add_pods:
+            for key in pods:
+                cluster.add_pod(PodSpec(
+                    key.split("/", 1)[1],
+                    requests=ResourceRequests(500, 1024, 0, 1)))
+        journal = IntentJournal(str(tmp_path / "j.jsonl"), owner="t",
+                                idempotency=idempotency)
+        actuator = Actuator(cloud, cluster, journal=journal)
+        injector = CrashInjector(crashpoint, seed=1,
+                                 first_hit_range=(1, 1), max_crashes=1)
+        with crashpoints.installed(injector), pytest.raises(SimulatedCrash):
+            actuator.create_node(planned(catalog, pods), nc, catalog)
+        journal.close()
+        journal2 = IntentJournal(str(tmp_path / "j.jsonl"), owner="t",
+                                 idempotency=idempotency)
+        return cloud, cluster, journal2
+
+    @pytest.mark.parametrize("crashpoint", ["actuate.pre_rpc",
+                                            "actuate.mid_create",
+                                            "actuate.post_create"])
+    def test_finish_replays_without_duplicates(self, tmp_path, crashpoint):
+        """Pods still waiting -> the create replays via idempotency keys
+        and the pods nominate; NEVER a duplicate resource."""
+        cloud, cluster, journal = self._crash_create(tmp_path, crashpoint)
+        report = Reconciler(journal, cloud, cluster).recover()
+        assert report.replayed == 1 and report.finished == 1
+        assert cloud.instance_count() == 1
+        claims = [c for c in cluster.nodeclaims() if not c.deleted]
+        assert len(claims) == 1
+        p = cluster.get("pods", "default/p1")
+        assert p.nominated_node == claims[0].name
+        # every vni/volume attached to the single instance
+        inst = cloud.list_instances()[0]
+        assert set(cloud.vnis) == {inst.vni_id}
+        # the replayed instance boots with the journaled bootstrap
+        # config — an empty-user_data node could never join the cluster
+        assert inst.user_data, "replayed create lost user_data"
+        assert journal.open_intents() == []
+        journal.close()
+
+    def test_fence_deletes_partial_leftovers(self, tmp_path):
+        """Nobody waiting -> the half-built vni is deleted, not finished."""
+        cloud, cluster, journal = self._crash_create(
+            tmp_path, "actuate.mid_create", add_pods=False)
+        assert len(cloud.vnis) == 1          # the crash leaked it
+        report = Reconciler(journal, cloud, cluster).recover()
+        assert report.fenced == 1
+        assert cloud.instance_count() == 0
+        assert not cloud.vnis and not cloud.volumes
+        journal.close()
+
+    def test_post_create_fence_deletes_instance(self, tmp_path):
+        cloud, cluster, journal = self._crash_create(
+            tmp_path, "actuate.post_create", add_pods=False)
+        assert cloud.instance_count() == 1
+        report = Reconciler(journal, cloud, cluster).recover()
+        assert report.fenced == 1
+        assert cloud.instance_count() == 0
+        assert not cloud.vnis and not cloud.volumes
+        journal.close()
+
+    def test_committed_create_closes_and_renominates(self, tmp_path):
+        """Crash on the DONE write (journal.append): claim registered,
+        intent open — recovery closes it and restores the nomination."""
+        cloud = FakeCloud()
+        cluster = ClusterState()
+        nc = ready_nodeclass(cluster)
+        catalog = build_catalog(cloud)
+        cluster.add_pod(PodSpec("p1",
+                                requests=ResourceRequests(500, 1024, 0, 1)))
+        journal = IntentJournal(str(tmp_path / "j.jsonl"), owner="t")
+        actuator = Actuator(cloud, cluster, journal=journal)
+        # crash exactly on the intent's completion append: hits are
+        # 1=intent 2=note(vni) 3=note(vol... none) -> count appends for
+        # this create: intent, vni note, instance note, claim note,
+        # claimpods state, done.  Target the 6th append.
+        injector = CrashInjector("journal.append", seed=1,
+                                 first_hit_range=(6, 6), max_crashes=1)
+        with crashpoints.installed(injector), pytest.raises(SimulatedCrash):
+            actuator.create_node(planned(catalog), nc, catalog)
+        assert len([c for c in cluster.nodeclaims()]) == 1
+        journal.close()
+        journal2 = IntentJournal(str(tmp_path / "j.jsonl"), owner="t")
+        report = Reconciler(journal2, cloud, cluster).recover()
+        assert report.replayed == 1 and report.finished == 1
+        assert cloud.instance_count() == 1
+        p = cluster.get("pods", "default/p1")
+        assert p.nominated_node == cluster.nodeclaims()[0].name
+        journal2.close()
+
+    def test_broken_idempotency_duplicates(self, tmp_path):
+        """The deliberately-broken fixture: keys off -> the replayed
+        create genuinely duplicates (what no-double-create catches)."""
+        cloud, cluster, journal = self._crash_create(
+            tmp_path, "actuate.post_create", idempotency=False)
+        Reconciler(journal, cloud, cluster).recover()
+        assert cloud.instance_count() == 2     # the duplicate
+        journal.close()
+
+    def test_eviction_replay_repends_noted_victims(self, tmp_path):
+        cloud = FakeCloud()
+        cluster = ClusterState()
+        cluster.add_pod(PodSpec("v1", requests=ResourceRequests(100, 100)))
+        cluster.add_pod(PodSpec("v2", requests=ResourceRequests(100, 100)))
+        cluster.get("pods", "default/v1").bound_node = ""
+        cluster.get("pods", "default/v1").nominated_node = "old"
+        journal = IntentJournal(str(tmp_path / "j.jsonl"), owner="t")
+        with pytest.raises(SimulatedCrash):
+            with journal.intent("eviction",
+                                pods=["default/v1", "default/v2"]) as i:
+                i.note("evicted:default/v1", pod="default/v1")
+                raise SimulatedCrash("preempt.mid_evict", 1)
+        journal.close()
+        journal2 = IntentJournal(str(tmp_path / "j.jsonl"), owner="t")
+        report = Reconciler(journal2, cloud, cluster).recover()
+        assert report.fenced == 1
+        assert "default/v1" in report.preempted_keys
+        assert "default/v2" not in report.preempted_keys  # never moved
+        v1 = cluster.get("pods", "default/v1")
+        assert v1.nominated_node == "" and v1.enqueued_at == 0.0
+        journal2.close()
+
+    def test_gang_replay_all_or_nothing(self, tmp_path):
+        cloud = FakeCloud()
+        cluster = ClusterState()
+        for n in ("g1", "g2"):
+            cluster.add_pod(PodSpec(n, requests=ResourceRequests(100, 100)))
+        cluster.add_nodeclaim(NodeClaim(name="claim-live", launched=True))
+        journal = IntentJournal(str(tmp_path / "j.jsonl"), owner="t")
+        with pytest.raises(SimulatedCrash):
+            with journal.intent("gang_placement", gang="g",
+                                claim="claim-live",
+                                pods=["default/g1", "default/g2"]):
+                cluster.get("pods", "default/g1").nominated_node = \
+                    "claim-live"
+                raise SimulatedCrash("journal.append", 1)
+        journal.close()
+        journal2 = IntentJournal(str(tmp_path / "j.jsonl"), owner="t")
+        report = Reconciler(journal2, cloud, cluster).recover()
+        assert report.finished == 1
+        assert cluster.get("pods", "default/g2").nominated_node == \
+            "claim-live"
+        journal2.close()
+
+    def test_gang_replay_dead_claim_releases_members(self, tmp_path):
+        cloud = FakeCloud()
+        cluster = ClusterState()
+        cluster.add_pod(PodSpec("g1", requests=ResourceRequests(100, 100)))
+        cluster.get("pods", "default/g1").nominated_node = "claim-gone"
+        journal = IntentJournal(str(tmp_path / "j.jsonl"), owner="t")
+        with pytest.raises(SimulatedCrash):
+            with journal.intent("gang_placement", gang="g",
+                                claim="claim-gone", pods=["default/g1"]):
+                raise SimulatedCrash("journal.append", 1)
+        journal.close()
+        journal2 = IntentJournal(str(tmp_path / "j.jsonl"), owner="t")
+        report = Reconciler(journal2, cloud, cluster).recover()
+        assert report.fenced == 1
+        assert cluster.get("pods", "default/g1").nominated_node == ""
+        journal2.close()
+
+    def test_state_rebuild_against_ground_truth(self, tmp_path):
+        cloud = FakeCloud()
+        cluster = ClusterState()
+        cluster.add_nodeclaim(NodeClaim(name="c1", launched=True))
+        for n in ("a", "b", "c"):
+            cluster.add_pod(PodSpec(n, requests=ResourceRequests(100, 100)))
+        cluster.bind_pod("default/b", "c1")   # resolved: must tombstone
+        journal = IntentJournal(str(tmp_path / "j.jsonl"), owner="t")
+        journal.state("nom/default/a", "c1")
+        journal.state("nom/default/b", "c1")
+        journal.state("nom/default/gone", "c1")
+        journal.state("claimpods/c1", ["default/c"])
+        journal.state("preempted/default/a", 1)
+        journal.state("preempted/default/b", 1)
+        journal.state("gang/admitted/gg", 123.5)
+        journal.close()
+        journal2 = IntentJournal(str(tmp_path / "j.jsonl"), owner="t")
+        report = Reconciler(journal2, cloud, cluster).recover()
+        assert cluster.get("pods", "default/a").nominated_node == "c1"
+        assert cluster.get("pods", "default/c").nominated_node == "c1"
+        assert report.preempted_keys == {"default/a"}
+        assert report.gang_admitted == {"gg": 123.5}
+        # resolved/gone entries tombstoned out of the surviving map
+        state = journal2.state_map()
+        assert "nom/default/b" not in state
+        assert "nom/default/gone" not in state
+        assert "preempted/default/b" not in state
+        journal2.close()
+
+    def test_parked_gang_deadline_survives_restart(self, tmp_path):
+        """A parked (not-yet-admitted) gang's first-seen stamp is
+        journaled from the FIRST park observation, so its deadline
+        clock keeps burning across restarts (review finding)."""
+        from karpenter_tpu.controllers.gang import GangAdmissionController
+
+        from karpenter_tpu.apis.podgroup import PodGroup
+
+        class FakeProvisioner:
+            admission = None
+
+            def _pools(self):
+                return []
+
+        cloud = FakeCloud()
+        cluster = ClusterState()
+        clock = {"t": 500.0}
+        gang = PodGroup(name="gg", min_member=4, deadline_seconds=100.0)
+        for pod in make_pods(2, name_prefix="gg",
+                             requests=ResourceRequests(100, 100, 0, 1),
+                             gang=gang):
+            cluster.add_pod(pod)
+        journal = IntentJournal(str(tmp_path / "j.jsonl"), owner="t")
+        ctrl = GangAdmissionController(cluster, FakeProvisioner(),
+                                       journal=journal,
+                                       clock=lambda: clock["t"])
+        ctrl.reconcile()                   # parks the sub-min gang
+        assert ctrl._first_seen == {"gg": 500.0}
+        journal.close()
+        # restart
+        journal2 = IntentJournal(str(tmp_path / "j.jsonl"), owner="t")
+        report = Reconciler(journal2, cloud, cluster).recover()
+        assert report.gang_parked == {"gg": 500.0}
+        ctrl2 = GangAdmissionController(cluster, FakeProvisioner(),
+                                        journal=journal2,
+                                        clock=lambda: clock["t"])
+        ctrl2.seed_recovered(report.gang_admitted, report.gang_parked)
+        # the restarted controller does NOT restamp: the deadline still
+        # anchors on the original park time
+        clock["t"] = 590.0
+        ctrl2.reconcile()
+        assert ctrl2._first_seen["gg"] == 500.0
+        journal2.close()
+
+    def test_claim_delete_replay_redrives(self, tmp_path):
+        cloud = FakeCloud()
+        cluster = ClusterState()
+        inst = cloud.create_instance(
+            name="n", profile=cloud.profiles[0].name, zone="us-south-1",
+            subnet_id="subnet-11", image_id="img-1")
+        cluster.add_nodeclaim(NodeClaim(
+            name="c1", provider_id=provider_id("us-south", inst.id),
+            launched=True, finalizers=[CLAIM_FINALIZER]))
+        journal = IntentJournal(str(tmp_path / "j.jsonl"), owner="t")
+        with pytest.raises(SimulatedCrash):
+            with journal.intent("claim_delete", claim="c1",
+                                instance=inst.id):
+                raise SimulatedCrash("journal.append", 1)
+        journal.close()
+        journal2 = IntentJournal(str(tmp_path / "j.jsonl"), owner="t")
+        report = Reconciler(journal2, cloud, cluster).recover()
+        assert report.finished == 1
+        assert cloud.instance_count() == 0
+        journal2.close()
+
+
+# -- crashpoint chaos dimension ---------------------------------------------
+
+class TestCrashChaos:
+    def test_two_cells_green_and_deterministic(self):
+        from karpenter_tpu.chaos.crash import run_crash_scenario
+
+        for cp in ("actuate.post_create", "preempt.mid_evict"):
+            res = run_crash_scenario(cp, 1, rounds=6)
+            assert res.violations == [], res.render_failure()
+            assert res.crashes >= 1, f"{cp}: no crash fired (vacuous)"
+            res2 = run_crash_scenario(cp, 1, rounds=6)
+            assert res.digest == res2.digest
+
+    def test_broken_fixture_fails_no_double_create(self):
+        from karpenter_tpu.chaos.crash import run_crash_scenario
+
+        res = run_crash_scenario("actuate.post_create", 1, rounds=6,
+                                 idempotency=False)
+        kinds = {v.invariant for v in res.violations}
+        assert "no-double-create" in kinds, \
+            "broken idempotency did NOT trip no-double-create — " \
+            "the invariant is vacuous"
+
+    @pytest.mark.slow
+    def test_full_matrix(self):
+        from karpenter_tpu.chaos.crash import run_crash_matrix
+
+        _, failures = run_crash_matrix(seeds=(1, 2, 3))
+        assert failures == []
+
+    def test_crashpoint_catalog_stable(self):
+        assert set(CRASHPOINTS) == {
+            "actuate.pre_rpc", "actuate.mid_create", "actuate.post_create",
+            "provision.pre_nominate", "preempt.mid_evict", "journal.append"}
+        with pytest.raises(ValueError):
+            CrashInjector("not.a.point", 1)
+
+
+# -- retry deadline propagation ---------------------------------------------
+
+class TestRetryDeadline:
+    def _flaky(self, fails: int, retry_after: float = 0.0):
+        calls = {"n": 0}
+
+        def fn():
+            calls["n"] += 1
+            if calls["n"] <= fails:
+                raise CloudError("throttled", 429,
+                                 retry_after=retry_after)
+            return "ok"
+        return fn, calls
+
+    def test_budget_stops_oversized_retry_after(self):
+        from karpenter_tpu.cloud.retry import RetryConfig, retry_with_backoff
+
+        sleeps: list[float] = []
+        fn, calls = self._flaky(fails=10, retry_after=30.0)
+        with pytest.raises(CloudError):
+            retry_with_backoff(fn, RetryConfig(jitter=False),
+                               sleep=sleeps.append, budget=2.0)
+        # the 30s Retry-After would blow the 2s budget: never slept
+        assert sleeps == []
+        assert calls["n"] == 1
+
+    def test_budget_allows_waits_inside_it(self):
+        from karpenter_tpu.cloud.retry import RetryConfig, retry_with_backoff
+
+        sleeps: list[float] = []
+        fn, calls = self._flaky(fails=2)
+        out = retry_with_backoff(
+            fn, RetryConfig(initial=0.0, jitter=False),
+            sleep=sleeps.append, budget=60.0)
+        assert out == "ok" and calls["n"] == 3
+        assert len(sleeps) == 2
+
+    def test_boundary_clamp(self):
+        """wait == remaining is already too late: the loop stops."""
+        from karpenter_tpu.chaos.clock import VirtualClock
+        from karpenter_tpu.cloud.retry import RetryConfig, retry_with_backoff
+
+        clock = VirtualClock(start=0.0)
+        with clock.installed():
+            fn, calls = self._flaky(fails=10, retry_after=5.0)
+            with pytest.raises(CloudError):
+                retry_with_backoff(fn, RetryConfig(jitter=False),
+                                   budget=5.0)
+            # exactly one attempt: the 5s Retry-After equals the 5s
+            # remaining budget, so the sleep never starts
+            assert calls["n"] == 1
+
+    def test_no_budget_is_unchanged(self):
+        from karpenter_tpu.cloud.retry import RetryConfig, retry_with_backoff
+
+        sleeps: list[float] = []
+        fn, calls = self._flaky(fails=3)
+        out = retry_with_backoff(fn, RetryConfig(jitter=False),
+                                 sleep=sleeps.append)
+        assert out == "ok" and len(sleeps) == 3
+
+    def test_http_client_budget_threads_through(self):
+        from karpenter_tpu.cloud.http import HTTPClient
+
+        class FlakyOpener:
+            def __init__(self):
+                self.calls = 0
+
+            def __call__(self, req, timeout=0):
+                self.calls += 1
+                import urllib.error
+
+                raise urllib.error.HTTPError(
+                    req.full_url, 429, "throttled",
+                    {"Retry-After": "30"}, None)
+
+        opener = FlakyOpener()
+        sleeps: list[float] = []
+        client = HTTPClient("http://x", "vpc", opener=opener,
+                            sleep=sleeps.append, budget=2.0)
+        with pytest.raises(CloudError):
+            client.get("/v1/zones", "list_zones")
+        assert opener.calls == 1 and sleeps == []
+
+
+# -- operator drain + restart ------------------------------------------------
+
+class TestOperatorDrain:
+    def _operator(self, tmp_path, cloud=None, cluster=None):
+        from karpenter_tpu.operator import Operator, Options
+        from karpenter_tpu.core.window import WindowOptions
+        from karpenter_tpu.solver.types import SolverOptions
+
+        opts = Options(region="us-south", api_key="sim",
+                       journal_dir=str(tmp_path),
+                       solver=SolverOptions(backend="greedy"),
+                       window=WindowOptions(idle_seconds=0.05,
+                                            max_seconds=0.5),
+                       solver_warmup=False)
+        return Operator(opts, cloud=cloud or FakeCloud(region="us-south"),
+                        cluster=cluster)
+
+    def test_drain_then_restart_replays_zero_intents(self, tmp_path):
+        import time as _time
+
+        op = self._operator(tmp_path)
+        ready_nodeclass(op.cluster)
+        op.start()
+        try:
+            for pod in make_pods(4, name_prefix="drain",
+                                 requests=ResourceRequests(500, 1024, 0, 1)):
+                op.cluster.add_pod(pod)
+            deadline = _time.time() + 20
+            while _time.time() < deadline and any(
+                    not p.nominated_node for p in op.cluster.pending_pods()):
+                _time.sleep(0.05)
+            assert all(p.nominated_node
+                       for p in op.cluster.pending_pods())
+        finally:
+            op.drain()
+        # the drained journal holds zero open intents on disk
+        intents, _, _, _ = read_journal(
+            os.path.join(str(tmp_path), "intents.jsonl"))
+        assert all(i.outcome for i in intents)
+        # the drain bundle landed next to the journal
+        assert (tmp_path / "drain-spans.jsonl").exists()
+        # restart: recovery replays NOTHING
+        op2 = self._operator(tmp_path)
+        op2.recover()
+        try:
+            assert op2._recovery_report.replayed == 0
+            assert op2.statusz()["recovery"]["last_recovery"][
+                "replayed"] == 0
+        finally:
+            op2.stop()
+
+    def test_crashed_operator_restart_replays(self, tmp_path):
+        """The drain counterpart: a NOT-drained operator with an open
+        intent replays it on the next start()."""
+        op = self._operator(tmp_path)
+        nc = op.cluster.get_nodeclass("default") or \
+            ready_nodeclass(op.cluster)
+        catalog = build_catalog(op.cloud)
+        op.cluster.add_pod(PodSpec(
+            "crashpod", requests=ResourceRequests(500, 1024, 0, 1)))
+        injector = CrashInjector("actuate.post_create", seed=1,
+                                 first_hit_range=(1, 1), max_crashes=1)
+        with crashpoints.installed(injector), pytest.raises(SimulatedCrash):
+            op.actuator.create_node(
+                planned(catalog, ("default/crashpod",)), nc, catalog)
+        op.journal.close()
+        op.pricing.close()
+        # restart = resume: the durable backends (cloud ground truth,
+        # API-server state) survive; only operator memory is fresh
+        op2 = self._operator(tmp_path, cloud=op.cloud,
+                             cluster=op.cluster)
+        op2.recover()
+        try:
+            assert op2._recovery_report.replayed == 1
+            assert op2._recovery_report.finished == 1
+            assert op2.cloud.instance_count() == 1
+            p = op2.cluster.get("pods", "default/crashpod")
+            assert p.nominated_node      # the lost nomination recovered
+        finally:
+            op2.stop()
+
+
+class TestRecoveryLeadershipGate:
+    def test_follower_defers_replay_until_leadership(self, tmp_path):
+        """Journal replay ISSUES cloud RPCs, so a restarted follower
+        must not recover while another replica leads (review finding) —
+        and must still replay once it wins the lease."""
+        from karpenter_tpu.core.window import WindowOptions
+        from karpenter_tpu.operator import Operator, Options
+        from karpenter_tpu.solver.types import SolverOptions
+
+        # an open intent from the "previous generation"
+        journal = IntentJournal(str(tmp_path / "intents.jsonl"),
+                                owner="old")
+        with pytest.raises(SimulatedCrash):
+            with journal.intent("eviction", pods=[]):
+                raise SimulatedCrash("journal.append", 1)
+        journal.close()
+        opts = Options(region="us-south", api_key="sim",
+                       journal_dir=str(tmp_path),
+                       solver=SolverOptions(backend="greedy"),
+                       window=WindowOptions(idle_seconds=0.05,
+                                            max_seconds=0.5),
+                       solver_warmup=False)
+        op = Operator(opts, cloud=FakeCloud(region="us-south"))
+
+        class FlippableElector:
+            identity = "b"
+            leading = False
+
+            def is_leader(self):
+                return self.leading
+
+            def start(self):
+                return self
+
+            def stop(self):
+                pass
+
+        op.elector = FlippableElector()
+        try:
+            op.recover()       # follower: replay deferred, not consumed
+            assert op._recovery_report is None
+            assert len(op.journal.open_intents()) == 1
+            op.elector.leading = True
+            op.recover()       # leader now: the owed replay runs
+            assert op._recovery_report is not None
+            assert op._recovery_report.replayed == 1
+            assert op.journal.open_intents() == []
+        finally:
+            op.stop()
+
+
+# -- leader failover + journal fencing ---------------------------------------
+
+class TestLeaderFailoverFencing:
+    def test_flapping_never_dual_leader_and_winner_fences(self, tmp_path):
+        from karpenter_tpu.core.leaderelection import LeaderElector
+
+        store = ClusterState()
+        clock = {"t": 1000.0}
+        a = LeaderElector(store, identity="a", lease_duration=15.0,
+                          clock=lambda: clock["t"])
+        b = LeaderElector(store, identity="b", lease_duration=15.0,
+                          clock=lambda: clock["t"])
+
+        def never_both():
+            assert not (a.is_leader() and b.is_leader()), \
+                "split brain: both electors actuate"
+
+        assert a.try_acquire_or_renew() is True
+        assert b.try_acquire_or_renew() is False
+        never_both()
+        # the holder journals an intent, then stalls (no renewals)
+        journal_a = IntentJournal(str(tmp_path / "intents.jsonl"),
+                                  owner="a")
+        with pytest.raises(SimulatedCrash):
+            with journal_a.intent("node_create", node="nA",
+                                  subnet="subnet-11", volumes=[]):
+                raise SimulatedCrash("actuate.pre_rpc", 1)
+        journal_a.close()
+        # rapid flapping: renew races under an advancing clock
+        for step in (5.0, 5.0, 6.0, 16.0, 2.0, 14.0, 1.0, 20.0):
+            clock["t"] += step
+            expired = (clock["t"] - a._last_renew) >= a.lease_duration
+            never_both()
+            if expired:
+                # the fence demotes a BEFORE b takes over
+                assert a.is_leader() is False
+                assert b.try_acquire_or_renew() is True
+                never_both()
+                break
+            assert a.try_acquire_or_renew() is True
+            never_both()
+        assert b.is_leader() is True and a.is_leader() is False
+        # journal ownership transfers with the lease: the winner opens
+        # the SAME journal file and fences the loser's open intents
+        cloud = FakeCloud()
+        cluster = ClusterState()
+        journal_b = IntentJournal(str(tmp_path / "intents.jsonl"),
+                                  owner="b")
+        assert len(journal_b.open_intents()) == 1
+        report = Reconciler(journal_b, cloud, cluster).recover()
+        assert report.fenced == 1
+        assert journal_b.open_intents() == []
+        # and b's new intents never collide with a's ids
+        with journal_b.intent("node_create", node="nB") as intent:
+            assert intent.id.startswith("b-")
+        journal_b.close()
+
+    def test_release_then_reacquire_flapping(self, tmp_path):
+        """Rapid acquire/release cycles: at every observable instant at
+        most one elector holds the actuation gate."""
+        from karpenter_tpu.core.leaderelection import LeaderElector
+
+        store = ClusterState()
+        clock = {"t": 0.0}
+        a = LeaderElector(store, identity="a", clock=lambda: clock["t"])
+        b = LeaderElector(store, identity="b", clock=lambda: clock["t"])
+        for _ in range(6):
+            assert a.try_acquire_or_renew() is True
+            assert b.try_acquire_or_renew() is False
+            assert not (a.is_leader() and b.is_leader())
+            a._release()
+            a._set_leading(False)
+            assert b.try_acquire_or_renew() is True
+            assert not (a.is_leader() and b.is_leader())
+            assert a.try_acquire_or_renew() is False
+            b._release()
+            b._set_leading(False)
+            clock["t"] += 1.0

@@ -31,4 +31,5 @@ def all_rules() -> list[type[Rule]]:
         observability.TelemetryInKernel,      # GL107
         observability.ReasonEnumDrift,        # GL108
         observability.BlockingSyncInHotPath,  # GL109
+        concurrency.UnjournaledMutation,      # GL110
     ]

@@ -30,6 +30,7 @@ FAMILY_B_SCOPE = (
     "karpenter_tpu/obs/*",
     "karpenter_tpu/catalog/*",
     "karpenter_tpu/utils/*",
+    "karpenter_tpu/recovery/*",
     "karpenter_tpu/service.py",
     "karpenter_tpu/__main__.py",
 )
@@ -354,6 +355,79 @@ class SilentExceptionSwallow(_FamilyBRule):
             if "ERRORS" in chain:
                 return True
         return False
+
+
+class UnjournaledMutation(_FamilyBRule):
+    id = "GL110"
+    name = "unjournaled-mutation"
+    description = (
+        "Mutating cloud-client call (create_instance / create_vni / "
+        "create_volume / delete_instance / delete_vni / delete_volume) "
+        "outside a write-ahead journal intent context. A crash between "
+        "the RPC and its in-memory bookkeeping leaks the resource (or "
+        "strands the delete) with no record for the restart reconciler "
+        "to fence or finish — the exact failure class the intent "
+        "journal exists for (docs/design/recovery.md). Wrap the call in "
+        "`with journal.intent(...)` or run it inside a helper that "
+        "takes the open `intent` handle."
+    )
+
+    # the actuation plane: where a lost RPC result is a leaked resource.
+    # recovery/ itself is exempt — the reconciler's replay/fence calls
+    # operate ON intents by construction.
+    scope = (
+        "karpenter_tpu/controllers/*",
+        "karpenter_tpu/controllers/**/*",
+        "karpenter_tpu/core/*",
+        "karpenter_tpu/core/**/*",
+    )
+
+    _MUTATORS = {"create_instance", "create_vni", "create_volume",
+                 "delete_instance", "delete_vni", "delete_volume"}
+
+    def check(self, module: SourceModule) -> Iterator[Finding]:
+        sanctioned = self._sanctioned_spans(module)
+        for node in ast.walk(module.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            chain = attr_chain(node.func)
+            if len(chain) < 2 or chain[-1] not in self._MUTATORS:
+                continue
+            line = node.lineno
+            if any(lo <= line <= hi for lo, hi in sanctioned):
+                continue
+            yield self.finding(
+                module, node,
+                f"mutating cloud call `{'.'.join(chain)}(...)` outside a "
+                f"journal intent context — a crash here leaks state the "
+                f"restart reconciler cannot see")
+
+    @staticmethod
+    def _sanctioned_spans(module: SourceModule) -> list[tuple[int, int]]:
+        """Line spans where a mutating call is journal-covered: inside
+        `with <x>.intent(...)` blocks, or inside functions that RECEIVE
+        the open intent handle (an `intent`/`_intent` parameter — the
+        staged-create helper / partial-cleanup idiom)."""
+        spans: list[tuple[int, int]] = []
+        for node in ast.walk(module.tree):
+            if isinstance(node, (ast.With, ast.AsyncWith)):
+                for item in node.items:
+                    expr = item.context_expr
+                    if isinstance(expr, ast.Call):
+                        chain = attr_chain(expr.func)
+                        if chain[-1:] == ["intent"]:
+                            spans.append((node.lineno,
+                                          getattr(node, "end_lineno",
+                                                  node.lineno)))
+                            break
+            elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                args = node.args
+                names = [a.arg for a in (args.posonlyargs + args.args
+                                         + args.kwonlyargs)]
+                if any(n in ("intent", "_intent") for n in names):
+                    spans.append((node.lineno,
+                                  getattr(node, "end_lineno", node.lineno)))
+        return spans
 
 
 class NonDaemonThread(_FamilyBRule):

@@ -44,6 +44,13 @@ os.environ.setdefault("KARPENTER_WINDOW_MAX_SECONDS", "1.0")
 # the debug surface, not provisioning backpressure
 os.environ.setdefault("CIRCUIT_BREAKER_RATE_LIMIT_PER_MINUTE", "1000")
 os.environ.setdefault("CIRCUIT_BREAKER_MAX_CONCURRENT_INSTANCES", "1000")
+# crash-recovery plane live for the smoke: journal every actuation into
+# a temp dir so /statusz's recovery block and the journal metric
+# families are real, not vacuous (docs/design/recovery.md)
+import tempfile  # noqa: E402
+
+_journal_dir = tempfile.mkdtemp(prefix="ktpu-smoke-journal-")
+os.environ.setdefault("KARPENTER_JOURNAL_DIR", _journal_dir)
 
 
 def _get(port: int, path: str,
@@ -390,6 +397,21 @@ def main() -> int:
               in text, "watchdog breach counter family rendered")
         check("# TYPE karpenter_tpu_triage_bundles_total counter"
               in text, "triage bundle counter family rendered")
+        # crash-recovery plane families (karpenter_tpu/recovery +
+        # docs/design/recovery.md) — live: the journal recorded every
+        # create/nominate of the waves above
+        check('karpenter_tpu_journal_records_total{rec="intent"}' in text,
+              "journal intent records counted the demo actuations")
+        check('karpenter_tpu_journal_records_total{rec="done"}' in text,
+              "journal completion records counted")
+        check('karpenter_tpu_journal_records_total{rec="state"}' in text,
+              "journal state records counted the nominations")
+        check("karpenter_tpu_journal_open_intents 0" in text,
+              "journal open-intents gauge drained to zero")
+        check("# TYPE karpenter_tpu_recovery_seconds histogram" in text,
+              "recovery phase histogram family rendered")
+        check("# TYPE karpenter_tpu_recovery_intents_total counter"
+              in text, "recovery intent-outcome counter family rendered")
         check(" # {" not in text,
               "plain text render carries NO exemplars")
 
@@ -531,6 +553,18 @@ def main() -> int:
         check("breaches" in swd and "bundles" in swd
               and "rate_limit_s" in swd,
               f"/statusz surfaces watchdog state ({swd})")
+        # crash-recovery block (docs/design/recovery.md): live journal
+        # stats + what the boot recovery replayed
+        srec = doc.get("recovery") or {}
+        sj = srec.get("journal") or {}
+        check(sj.get("enabled") is True and sj.get("records", 0) >= 1
+              and sj.get("open_intents", -1) == 0,
+              f"/statusz recovery block carries live journal stats ({sj})")
+        slast = srec.get("last_recovery") or {}
+        check("replayed" in slast and "fenced" in slast
+              and "duration_s" in slast,
+              f"/statusz recovery block carries the boot recovery "
+              f"report ({slast})")
 
         print("GET /debug/traces")
         status, ctype, body = _get(

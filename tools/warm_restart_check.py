@@ -1,4 +1,4 @@
-"""CI gate: the AOT executable cache must cut warm-restart time.
+"""CI gate: the full recovery path must be fast AND correct.
 
 Runs the same boot sequence twice in FRESH processes sharing one cache
 directory (resident/aot.py: JAX's persistent compile cache + the
@@ -6,14 +6,24 @@ signature manifest):
 
 1. **cold** — empty cache: real solves compile their executables from
    scratch and record their static-shape signatures into the manifest;
-2. **warm** — a "restarted operator": the manifest is replayed through
-   the real jit entry points, every compile served from the disk cache.
+2. **warm** — a "restarted operator": ONE recovery sequence
+   (docs/design/recovery.md) under one measured gate —
+   (a) **journal replay**: a crashed mid-create actuation (simulated
+   via the recovery crashpoint injector) is replayed through the
+   write-ahead journal's idempotency keys — the gate fails on ANY
+   duplicate create or an intent left open;
+   (b) **AOT prewarm**: the manifest replays through the real jit entry
+   points, every compile served from the disk cache;
+   (c) **resident rebuild**: a ResidentStore cold rebuild of a
+   production-shaped window.
 
 Fails when the warm restart recompiled anything (new XLA cache entries
-appeared — the manifest/disk-cache keying broke) or when
-``warmup_restart_s`` did not drop vs the cold run.
+appeared — the manifest/disk-cache keying broke), when
+``warmup_restart_s`` did not drop vs the cold run, or when the journal
+replay duplicated/leaked anything.
 
-Run locally: ``JAX_PLATFORMS=cpu python tools/warm_restart_check.py``.
+Run locally: ``JAX_PLATFORMS=cpu python tools/warm_restart_check.py``
+(``make recovery-check``).
 """
 
 from __future__ import annotations
@@ -28,6 +38,88 @@ import time
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+
+def _replay_crashed_create(work_dir: str) -> dict:
+    """The journal-replay leg of the recovery gate: drive a REAL staged
+    create into a simulated crash after ``create_instance`` returned
+    (the response-lost window), then recover through the reconciler and
+    prove the replayed create deduplicated via its idempotency key."""
+    import glob
+
+    from karpenter_tpu.apis.nodeclaim import NodePool
+    from karpenter_tpu.apis.nodeclass import (
+        InstanceRequirements, NodeClass, NodeClassSpec, PlacementStrategy,
+    )
+    from karpenter_tpu.cloud.fake import FakeCloud
+    from karpenter_tpu.core.actuator import Actuator
+    from karpenter_tpu.core.cluster import ClusterState
+    from karpenter_tpu.recovery import crashpoints
+    from karpenter_tpu.recovery.crashpoints import (
+        CrashInjector, SimulatedCrash,
+    )
+    from karpenter_tpu.recovery.journal import IntentJournal
+    from karpenter_tpu.recovery.reconciler import Reconciler
+    from karpenter_tpu.solver.types import PlannedNode
+
+    path = os.path.join(work_dir, "recovery-check-journal.jsonl")
+    for stale in glob.glob(path + "*"):
+        os.remove(stale)
+    cloud = FakeCloud(region="us-south")
+    cluster = ClusterState()
+    nc = NodeClass(name="default", spec=NodeClassSpec(
+        region="us-south", image="img-1", vpc="vpc-1",
+        instance_requirements=InstanceRequirements(min_cpu=2),
+        placement_strategy=PlacementStrategy()))
+    nc.status.resolved_image_id = "img-1"
+    nc.status.set_condition("Ready", "True", "RecoveryCheck")
+    cluster.add_nodeclass(nc)
+    cluster.add_nodepool(NodePool(name="default",
+                                  nodeclass_name="default"))
+    from karpenter_tpu.apis.pod import PodSpec, ResourceRequests
+
+    cluster.add_pod(PodSpec("rc-pod",
+                            requests=ResourceRequests(500, 1024, 0, 1)))
+    from karpenter_tpu.catalog import InstanceTypeProvider, PricingProvider
+    from karpenter_tpu.catalog.arrays import CatalogArrays
+
+    pricing = PricingProvider(cloud)
+    catalog = CatalogArrays.build(InstanceTypeProvider(cloud,
+                                                       pricing).list())
+    pricing.close()
+    planned = PlannedNode(instance_type=catalog.type_names[0],
+                          zone="us-south-1", capacity_type="on-demand",
+                          price=1.0, pod_names=["default/rc-pod"],
+                          offering_index=-1)
+    journal = IntentJournal(path, owner="rc")
+    actuator = Actuator(cloud, cluster, journal=journal)
+    injector = CrashInjector("actuate.post_create", seed=1,
+                             first_hit_range=(1, 1), max_crashes=1)
+    crashed = False
+    with crashpoints.installed(injector):
+        try:
+            actuator.create_node(planned, nc, catalog)
+        except SimulatedCrash:
+            crashed = True
+    journal.close()
+    # "restart": fresh journal handle + reconciler against ground truth
+    journal2 = IntentJournal(path, owner="rc")
+    report = Reconciler(journal2, cloud, cluster).recover()
+    by_intent: dict[str, int] = {}
+    for inst in cloud.list_instances():
+        iid = inst.tags.get("karpenter.sh/intent-id", "")
+        if iid:
+            by_intent[iid] = by_intent.get(iid, 0) + 1
+    open_after = len(journal2.open_intents())
+    journal2.close()
+    return {
+        "crashed": crashed,
+        "replayed": report.replayed,
+        "finished": report.finished,
+        "duplicate_creates": sum(1 for n in by_intent.values() if n > 1),
+        "instances": cloud.instance_count(),
+        "open_intents_after": open_after,
+    }
 
 
 def _child(cache_dir: str) -> int:
@@ -51,8 +143,22 @@ def _child(cache_dir: str) -> int:
     solver = JaxSolver(SolverOptions(backend="jax", resident="on"))
     t0 = time.perf_counter()
     if warm:
+        # the full restart sequence under ONE measured gate: journal
+        # replay -> AOT prewarm -> resident rebuild
+        recovery = _replay_crashed_create(cache_dir)
         out = cache.prewarm(solver, catalog)
-        detail = out
+        from karpenter_tpu.resident.store import ResidentStore
+
+        store = ResidentStore()
+        rng = random.Random("recovery-rebuild")
+        sizes = ((250, 512), (500, 1024), (1000, 2048), (2000, 4096))
+        window = [PodSpec(f"rb{i}",
+                          requests=ResourceRequests(*sizes[rng.randrange(4)],
+                                                    0, 1))
+                  for i in range(400)]
+        store.track_window(window, catalog)
+        detail = {"prewarm": out, "recovery": recovery,
+                  "resident": store.stats().get("windows", "ok")}
     else:
         # the representative boot workload: two window scales through
         # BOTH solve paths (resident fused kernel + classic scan),
@@ -102,10 +208,27 @@ def main() -> int:
               f"({len(cold_files)} executables compiled, "
               f"{cold['detail'].get('entries', '?')} manifest entries)")
         print(f"warm boot:  {warm['warmup_restart_s']:.3f}s "
-              f"(prewarm: {warm['detail']})")
+              f"(recovery: {warm['detail']})")
         failures = []
         if warm.get("mode") != "warm":
             failures.append("second run did not find the AOT manifest")
+        recovery = (warm.get("detail") or {}).get("recovery") or {}
+        if not recovery.get("crashed"):
+            failures.append("recovery leg never simulated its crash "
+                            "(the gate proved nothing)")
+        if recovery.get("duplicate_creates", 1) != 0:
+            failures.append(
+                f"journal replay DUPLICATED creates "
+                f"({recovery.get('duplicate_creates')} intents own >1 "
+                f"instance — idempotency-key dedupe broke)")
+        if recovery.get("instances") != 1:
+            failures.append(
+                f"recovery left {recovery.get('instances')} instances "
+                f"for one crashed create (expected exactly 1)")
+        if recovery.get("open_intents_after", 1) != 0:
+            failures.append(
+                f"journal did not converge after recovery "
+                f"({recovery.get('open_intents_after')} intents open)")
         if new_files:
             failures.append(
                 f"warm restart recompiled {len(new_files)} executables "
