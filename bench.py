@@ -1624,6 +1624,185 @@ def run_explain(num_pods: int = 1200, num_types: int = 60,
     }}
 
 
+def run_stochastic(num_pods: int = 10000, num_types: int = 500,
+                   iters: int = 6, parity_seeds: int = 8) -> dict:
+    """ISSUE 13: chance-constrained stochastic packing
+    (karpenter_tpu/stochastic).  10k high-variance pods x ``num_types``
+    packed under a per-node violation-probability bound: the gate
+    asserts density uplift vs deterministic request packing (mean
+    demand placed per dollar of capacity), a Monte-Carlo measured
+    violation rate at or under epsilon, warm quantile-check overhead
+    <5% of the deterministic solve p50, zero extra dispatches (the
+    check rides the existing solve), and 8-seed device/oracle
+    bit-parity."""
+    from karpenter_tpu.apis.nodeclaim import NodePool
+    from karpenter_tpu.apis.pod import (
+        PodSpec, ResourceRequests, UsageDistribution,
+    )
+    from karpenter_tpu.obs.devtel import get_devtel
+    from karpenter_tpu.solver import JaxSolver, encode
+    from karpenter_tpu.solver.types import SolverOptions
+    from karpenter_tpu.stochastic import z_bp_for
+    from karpenter_tpu.stochastic.greedy import solve_stochastic_host
+    from karpenter_tpu.stochastic.validate import (
+        measured_violation_rate, violation_bound,
+    )
+
+    eps = 0.05
+    catalog = build_catalog(num_types)
+    # a bounded usage-profile menu: distributions must GROUP (the
+    # signature folds usage), or 10k pods become 10k groups and the
+    # bench measures encode, not the quantile check
+    sizes = ((1000, 2048), (2000, 4096), (4000, 8192), (8000, 16384))
+    fracs = (0.4, 0.5, 0.6)
+    cvs = (0.15, 0.25, 0.35)
+    rng = np.random.RandomState(13)
+    pods, det_pods, mean_pods = [], [], []
+    for i in range(num_pods):
+        cpu, mem = sizes[rng.randint(len(sizes))]
+        frac = fracs[rng.randint(len(fracs))]
+        cv = cvs[rng.randint(len(cvs))]
+        mcpu, mmem = int(cpu * frac), int(mem * frac)
+        usage = UsageDistribution(
+            mean=ResourceRequests(mcpu, mmem, 0, 1),
+            var=(int((cv * mcpu) ** 2), int((cv * mmem) ** 2), 0, 0))
+        pods.append(PodSpec(f"sto{i}",
+                            requests=ResourceRequests(cpu, mem, 0, 1),
+                            usage=usage))
+        det_pods.append(PodSpec(f"det{i}",
+                                requests=ResourceRequests(cpu, mem, 0, 1)))
+        # the quantile-check overhead baseline: the SAME mean demand
+        # packed deterministically (no variance machinery) — comparing
+        # against request packing would conflate the check's cost with
+        # the workload shift overcommit itself causes (more pods per
+        # node, more decode)
+        mean_pods.append(PodSpec(
+            f"mean{i}", requests=ResourceRequests(mcpu, mmem, 0, 1)))
+    pool = NodePool(name="default", overcommit=eps)
+    solver = JaxSolver(SolverOptions(backend="jax"))
+    problem = encode(pods, catalog, pool)
+    det_problem = encode(det_pods, catalog)
+    mean_problem = encode(mean_pods, catalog)
+
+    plan = solver.solve_encoded(problem)           # warmup / compile
+    det_plan = solver.solve_encoded(det_problem)
+    devtel = get_devtel()
+    before = devtel.snapshot()
+    walls = []
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        plan = solver.solve_encoded(problem)
+        walls.append(time.perf_counter() - t0)
+    after = devtel.snapshot()
+    sto_dispatches = after["dispatches"] - before["dispatches"]
+    det_walls = []
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        det_plan = solver.solve_encoded(det_problem)
+        det_walls.append(time.perf_counter() - t0)
+    solver.solve_encoded(mean_problem)          # warmup (own shapes)
+    mean_walls = []
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        solver.solve_encoded(mean_problem)
+        mean_walls.append(time.perf_counter() - t0)
+
+    # density: mean demand placed per dollar-hour of created capacity
+    # (node counts alone mislead — right-sizing changes node SIZES)
+    def density(p, mean_demand):
+        cost = max(p.total_cost_per_hour, 1e-9)
+        return mean_demand * (p.placed_count / max(len(pods), 1)) / cost
+
+    total_mean_cpu = float(sum(p.usage.mean.cpu_milli for p in pods))
+    sto_density = density(plan, total_mean_cpu)
+    det_density = density(det_plan, total_mean_cpu)
+
+    # measured violation rate: seeded draws per planned node
+    by_name = {f"{p.namespace}/{p.name}": p for p in pods}
+    nodes = []
+    for node in plan.nodes:
+        specs = [by_name[pn] for pn in node.pod_names if pn in by_name]
+        if specs and 0 <= node.offering_index < catalog.num_offerings:
+            nodes.append((specs,
+                          catalog.offering_alloc()[node.offering_index]))
+    rate, samples = measured_violation_rate(nodes, trials=64, seed=13)
+
+    # device/oracle parity across seeds: small per-seed windows, raw
+    # tensor comparison against the numpy twin
+    parity_ok = True
+    for seed in range(parity_seeds):
+        prng = np.random.RandomState(100 + seed)
+        ppods = []
+        for i in range(300):
+            cpu, mem = sizes[prng.randint(len(sizes))]
+            frac = fracs[prng.randint(len(fracs))]
+            cv = cvs[prng.randint(len(cvs))]
+            mcpu, mmem = int(cpu * frac), int(mem * frac)
+            ppods.append(PodSpec(
+                f"sp{seed}x{i}",
+                requests=ResourceRequests(cpu, mem, 0, 1),
+                usage=UsageDistribution(
+                    mean=ResourceRequests(mcpu, mmem, 0, 1),
+                    var=(int((cv * mcpu) ** 2), int((cv * mmem) ** 2),
+                         0, 0))))
+        pprob = encode(ppods, catalog, pool)
+        prep = solver._prepare(pprob)
+        from karpenter_tpu.solver.jax_backend import (
+            unpack_reason_words, unpack_result,
+        )
+        from karpenter_tpu.stochastic.kernel import (
+            build_fit_grids, solve_packed_stochastic,
+        )
+
+        off_alloc, off_price, off_rank = solver._device_offerings(
+            catalog, prep.O_pad)
+        kd, kc = build_fit_grids(prep.sto, off_alloc, G=prep.G_pad,
+                                 z_bp=prep.z_bp)
+        out = np.asarray(solve_packed_stochastic(
+            prep.packed.copy(), prep.sto.copy(), kd, kc, off_alloc,
+            off_price, off_rank, G=prep.G_pad, O=prep.O_pad,
+            U=prep.U_pad, N=prep.N, z_bp=prep.z_bp, right_size=True))
+        node_off, assign, unplaced, _cost = unpack_result(
+            out, prep.G_pad, prep.N, 0)
+        words = unpack_reason_words(out, prep.G_pad, prep.N, 0)
+        G = pprob.num_groups
+        h_off, h_assign, h_unp, _hc, h_words = solve_stochastic_host(
+            pprob, prep.N, prep.z_bp, right_size=True)
+        if not (np.array_equal(node_off, h_off)
+                and np.array_equal(assign[:G], h_assign)
+                and np.array_equal(unplaced[:G], h_unp)
+                and np.array_equal(words[:G], h_words)):
+            parity_ok = False
+
+    det_p50 = p50(det_walls)
+    mean_p50 = p50(mean_walls)
+    return {"stochastic": {
+        "epsilon": eps,
+        "z_bp": z_bp_for(eps),
+        "groups": problem.num_groups,
+        "placed": plan.placed_count,
+        "nodes": len(plan.nodes),
+        "det_nodes": len(det_plan.nodes),
+        "cost_per_hour": round(plan.total_cost_per_hour, 4),
+        "det_cost_per_hour": round(det_plan.total_cost_per_hour, 4),
+        # >1.0 = stochastic packing serves more mean demand per dollar
+        "density_uplift": round(sto_density / max(det_density, 1e-12), 4),
+        "violation_rate": round(rate, 5),
+        "violation_samples": samples,
+        "violation_bound": round(violation_bound(eps, samples), 5),
+        "solve_warm_p50_ms": round(p50(walls) * 1000, 3),
+        "det_solve_warm_p50_ms": round(det_p50 * 1000, 3),
+        "mean_solve_warm_p50_ms": round(mean_p50 * 1000, 3),
+        # the quantile check must ride the existing solve: <5% on top
+        # of the MEAN-equivalent deterministic warm p50 (the
+        # workload-matched baseline), zero extra dispatches
+        "overhead_fraction": round(
+            (p50(walls) - mean_p50) / max(mean_p50, 1e-9), 4),
+        "extra_dispatches": max(0, sto_dispatches - iters),
+        "parity_seeds_ok": bool(parity_ok),
+    }}
+
+
 def run_cold_start(timeout_s: float = 560.0,
                    platform: str = "") -> dict:
     """BASELINE cold-start probe (VERDICT round 4 weak #4): the first
@@ -1849,6 +2028,18 @@ def main():
     except Exception as e:  # noqa: BLE001
         result["explain_error"] = str(e)[:200]
 
+    try:
+        # ISSUE 13: chance-constrained stochastic packing — density
+        # uplift vs deterministic requests, measured violation rate vs
+        # epsilon, warm quantile-check overhead, device/oracle parity
+        result.update(run_stochastic(
+            num_pods=1000 if args.quick else 10000,
+            num_types=50 if args.quick else 500,
+            iters=3 if args.quick else 6,
+            parity_seeds=4 if args.quick else 8))
+    except Exception as e:  # noqa: BLE001
+        result["stochastic_error"] = str(e)[:200]
+
     result["target_met"] = compute_target_met(result)
     print(json.dumps(result))
 
@@ -1967,6 +2158,21 @@ def compute_target_met(result: dict) -> dict:
         # vacuous, and when the steady loop actually sampled, the
         # directly measured value (the one /statusz surfaces) must
         # clear the gate too
+        # ISSUE 13 acceptance: stochastic packing places measurably
+        # more mean demand per dollar than deterministic requests while
+        # the Monte-Carlo measured violation rate stays at or under
+        # epsilon (+sampling slack), the quantile check rides the
+        # existing dispatch (zero extra launches, <5% warm overhead),
+        # and the device kernel is bit-identical to the numpy oracle
+        # across the seed sweep
+        "stochastic_density_under_bound":
+            (result["stochastic"]["density_uplift"] > 1.0
+             and result["stochastic"]["violation_rate"]
+             <= result["stochastic"]["violation_bound"]
+             and result["stochastic"]["extra_dispatches"] == 0
+             and result["stochastic"]["overhead_fraction"] < 0.05
+             and result["stochastic"]["parity_seeds_ok"] is True)
+            if "stochastic" in result else None,
         "device_time_decomposed_under_1pct_overhead":
             (result["device_time"]["exec_fetch_decomposed"]["execute_ms"]
              > 0.0

@@ -68,6 +68,29 @@ class Node:
     uid: str = ""
 
 
+# epsilon ceiling: an overcommit bound at or above 0.5 means z(eps) <= 0
+# — "pack with no variance buffer at all", which is never what a
+# violation-probability bound is for; larger values clamp here
+OVERCOMMIT_MAX = 0.45
+
+
+def parse_overcommit(q) -> float:
+    """Strict overcommit epsilon: number (or None) -> clamped float.
+
+    None/0 -> 0.0 (stochastic plane off).  bools, strings, and
+    non-finite floats are rejected — epsilon gates the solver's
+    chance-constraint feasibility term, so a lenient parse would let a
+    malformed pool spec silently drop the violation bound."""
+    if q is None:
+        return 0.0
+    if isinstance(q, bool) or not isinstance(q, (int, float)):
+        raise ValueError(f"bad overcommit {q!r}: must be a number")
+    q = float(q)
+    if q != q or q in (float("inf"), float("-inf")):
+        raise ValueError(f"bad overcommit {q!r}: must be finite")
+    return max(0.0, min(OVERCOMMIT_MAX, q))
+
+
 @dataclass
 class NodePool:
     """Provisioning pool: requirements + nodeclass ref + disruption policy
@@ -90,7 +113,19 @@ class NodePool:
     # reconcile round (karpenter's spec.disruption.budgets analogue).
     # 0 disables preemption for the pool; -1 = unbounded.
     preemption_budget: int = 16
+    # chance-constrained overcommit (karpenter_tpu/stochastic): the
+    # per-node violation-probability bound epsilon.  0 disables the
+    # stochastic plane for this pool (every solve stays deterministic —
+    # strict superset); with epsilon > 0, pods carrying a usage
+    # distribution pack by mean + z(epsilon)*sqrt(sum variance) instead
+    # of by request.  Validated at construction: non-numbers REJECT
+    # (a typo'd manifest must not silently disable the violation
+    # bound), out-of-range values CLAMP into [0, OVERCOMMIT_MAX].
+    overcommit: float = 0.0
     resource_version: int = 0
+
+    def __post_init__(self):
+        self.overcommit = parse_overcommit(self.overcommit)
 
 
 def provider_id(region: str, instance_id: str) -> str:

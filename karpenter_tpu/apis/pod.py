@@ -113,6 +113,69 @@ class ResourceRequests:
                                 self.pods + other.pods)
 
 
+# usage scalars feed straight into int32 device tensors
+# (stochastic/encode.stack_usage) — a value past this bound would crash
+# the encode or silently wrap to a NEGATIVE variance, voiding the
+# violation bound, so it hard-rejects here instead
+USAGE_MAX = 2 ** 31 - 1
+
+
+def _usage_int(v, what: str) -> int:
+    """parse_priority-style strictness for one usage scalar: ints only
+    (bools and floats REJECT — a float mean would silently break the
+    solver's exact integer mean arithmetic; NaN/inf literals arrive as
+    floats and reject on the same branch), non-negative, int32-bounded
+    (the dense tensors the solver consumes are int32)."""
+    if isinstance(v, bool) or not isinstance(v, int):
+        raise ValueError(f"bad usage {what} {v!r}: must be a "
+                         f"non-negative int")
+    if not 0 <= v <= USAGE_MAX:
+        raise ValueError(f"bad usage {what} {v!r}: must be in "
+                         f"[0, {USAGE_MAX}] (int32 tensor bound)")
+    return v
+
+
+@dataclass(frozen=True)
+class UsageDistribution:
+    """Per-resource usage distribution for chance-constrained packing
+    (karpenter_tpu/stochastic): ``mean`` in the SAME integer units as
+    :class:`ResourceRequests` (milliCPU, MiB, accel, pod slots) and
+    ``var`` in those units SQUARED, both per pod.  A pod without a
+    distribution behaves exactly as ``usage=(requests, 0)`` — the
+    stochastic plane is a strict superset of deterministic packing.
+
+    Validation is hard-reject at construction (the parse_priority
+    convention): negative variance, variance on an axis whose mean is
+    zero ("variance without mean"), and non-int values (bools, floats —
+    which is also how NaN/inf are rejected) never enter the system, so
+    the solver's quantile check can assume finite non-negative integers
+    and never re-validates.
+    """
+
+    mean: ResourceRequests = field(default_factory=ResourceRequests)
+    var: tuple[int, int, int, int] = (0, 0, 0, 0)
+
+    def __post_init__(self):
+        if not isinstance(self.mean, ResourceRequests):
+            raise ValueError(f"bad usage mean {self.mean!r}: must be a "
+                             f"ResourceRequests")
+        mean = tuple(_usage_int(v, "mean") for v in self.mean.as_tuple())
+        if not isinstance(self.var, (tuple, list)) \
+                or len(self.var) != NUM_RESOURCES:
+            raise ValueError(f"bad usage variance {self.var!r}: must be "
+                             f"a {NUM_RESOURCES}-tuple")
+        var = tuple(_usage_int(v, "variance") for v in self.var)
+        for m, v, axis in zip(mean, var, RESOURCE_AXES):
+            if v > 0 and m == 0:
+                raise ValueError(
+                    f"bad usage: variance {v} on {axis} with zero mean "
+                    f"(variance without mean)")
+        object.__setattr__(self, "var", var)
+
+    def signature(self) -> tuple:
+        return (self.mean.as_tuple(), self.var)
+
+
 @dataclass(frozen=True)
 class Taint:
     key: str
@@ -222,11 +285,21 @@ class PodSpec:
     # ordinary per-pod scheduling.  Strictly a PodGroup or None — a
     # malformed gang spec must fail at construction, not place per-pod.
     gang: PodGroup | None = None
+    # usage distribution (karpenter_tpu/stochastic): mean/variance per
+    # resource for chance-constrained packing under a NodePool
+    # overcommit bound.  None = deterministic (mean=requests, var=0).
+    # Strictly a UsageDistribution or None — its own __post_init__
+    # hard-rejects malformed distributions.
+    usage: UsageDistribution | None = None
 
     def __post_init__(self):
         object.__setattr__(self, "priority", parse_priority(self.priority))
         if self.gang is not None and not isinstance(self.gang, PodGroup):
             raise ValueError(f"bad gang {self.gang!r}: must be a PodGroup")
+        if self.usage is not None \
+                and not isinstance(self.usage, UsageDistribution):
+            raise ValueError(f"bad usage {self.usage!r}: must be a "
+                             f"UsageDistribution")
 
     def scheduling_requirements(self) -> Requirements:
         reqs = Requirements.from_selector(dict(self.node_selector))
@@ -276,6 +349,10 @@ class PodSpec:
             # gang splits groups the same way: members place atomically,
             # so a member and a lookalike singleton must never share a row
             self.gang.signature() if self.gang is not None else None,
+            # usage splits groups too: pods with different distributions
+            # consume different chance-constrained capacity, so they are
+            # NOT interchangeable under an overcommit bound
+            self.usage.signature() if self.usage is not None else None,
             tuple(sorted(self.labels)) if self.labels else (),
             tuple(sorted(self.node_selector)) if self.node_selector else (),
             tuple(sorted(r.signature for r in self.required_requirements))

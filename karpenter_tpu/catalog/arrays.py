@@ -65,6 +65,14 @@ class CatalogArrays:
     # gang/topology.py lowers these to placement bitmask tables).  Host
     # list, not a device tensor: only the gang encoder consumes it.
     type_torus: list[tuple[int, ...]] = field(default_factory=list)
+    # spot-risk ranking column (karpenter_tpu/stochastic/risk.py):
+    # float32 [O] expected-eviction penalty per offering (0 = no
+    # observed risk / on-demand).  Enters RANKING only — real cost
+    # accounting (off_price) never moves.  risk_generation keys the
+    # solver's device-resident rank tensors, so a re-priced model
+    # re-uploads instead of serving stale ranks.
+    off_risk: np.ndarray | None = None
+    risk_generation: int = 0
     # provenance
     generation: int = 0
     availability_generation: object = None
@@ -152,8 +160,15 @@ class CatalogArrays:
         semantics."""
         alloc = self.offering_alloc().astype(np.float32)
         pseudo = alloc[:, 0] / 1000.0 + alloc[:, 1] / 1024.0
-        return np.where(self.off_price > 0, self.off_price,
+        rank = np.where(self.off_price > 0, self.off_price,
                         pseudo).astype(np.float32)
+        if self.off_risk is not None:
+            # expected eviction cost (stochastic/risk.py): an offering
+            # observed interrupted r of the time ranks as if its price
+            # carried the replacement churn — ranking only, cost
+            # accounting untouched
+            rank = (rank * (1.0 + self.off_risk)).astype(np.float32)
+        return rank
 
     def offering_label_values(self, o: int) -> dict[str, str]:
         """Node label values an offering would produce — the host-side

@@ -71,7 +71,8 @@ class InvariantChecker:
                  solver_violations: list[str] | None = None,
                  trace: EventTrace | None = None, preemption=None,
                  gang=None, resident=None, repack=None,
-                 explain_violations: list[str] | None = None):
+                 explain_violations: list[str] | None = None,
+                 stochastic=None):
         self.cluster = cluster
         self.cloud = cloud              # ground truth: the UNWRAPPED fake
         self.unavailable = unavailable
@@ -103,6 +104,11 @@ class InvariantChecker:
         # executed-migration-plan ground truth, drained per round),
         # .catalog() re-derives target capacity and torus geometry
         self.repack = repack
+        # stochastic probe (or None): the oversubscription profile's
+        # epsilon bound + catalog/model getters — backs the
+        # violation-rate-under-bound and risk-model-consistent
+        # invariants (karpenter_tpu/stochastic)
+        self.stochastic = stochastic
 
     # -- round invariants ----------------------------------------------------
 
@@ -116,6 +122,7 @@ class InvariantChecker:
         out.extend(self._no_partial_gang_placed())
         out.extend(self._resident_state_fresh())
         out.extend(self._repack_plans_valid())
+        out.extend(self._risk_model_consistent())
         if self.trace is not None:
             self.trace.add("invariants", phase="round", violations=len(out),
                            kinds=sorted({v.invariant for v in out}))
@@ -394,6 +401,50 @@ class InvariantChecker:
                     f"vacated torus (claimed reopening is false)"))
         return out
 
+    def _risk_model_consistent(self) -> list[Violation]:
+        """The rates the solver PRICES must equal the rates the ledger
+        OBSERVED — exactly, not within tolerance: both sides are
+        integer-count ratios over the same history, so any difference
+        is a stale model or a pricing bug, never noise.  Checked two
+        ways: the harness's model vs a fresh ledger rebuild, and the
+        catalog's off_risk column vs the column that fresh model
+        implies."""
+        probe = self.stochastic
+        if probe is None:
+            return []
+        model = probe.model()
+        if model is None:
+            return []      # no pump has priced yet
+        import numpy as np
+
+        from karpenter_tpu import obs
+        from karpenter_tpu.stochastic.risk import SpotRiskModel
+
+        fresh = SpotRiskModel.from_ledger(obs.get_ledger())
+        out: list[Violation] = []
+        if fresh.counts() != model.counts():
+            out.append(Violation(
+                "risk-model-consistent",
+                f"priced model counts {model.counts()} != ledger-observed "
+                f"counts {fresh.counts()}"))
+        catalog = probe.catalog()
+        if catalog is not None:
+            want = fresh.risk_column(catalog)
+            got = getattr(catalog, "off_risk", None)
+            if got is None:
+                if want.any():
+                    out.append(Violation(
+                        "risk-model-consistent",
+                        "ledger holds interruption history but the "
+                        "catalog prices no spot risk"))
+            elif not np.array_equal(got, want):
+                diff = int(np.count_nonzero(got != want))
+                out.append(Violation(
+                    "risk-model-consistent",
+                    f"catalog off_risk diverges from the ledger-derived "
+                    f"column ({diff} offerings differ)"))
+        return out
+
     # -- final (eventual) invariants -----------------------------------------
 
     def check_final(self, catalog=None) -> list[Violation]:
@@ -407,10 +458,55 @@ class InvariantChecker:
         out.extend(self._pods_resolve(catalog))
         out.extend(self._preempted_pods_resolve(catalog))
         out.extend(self._gangs_resolve_or_release(catalog))
+        out.extend(self._violation_rate_under_bound())
         if self.trace is not None:
             self.trace.add("invariants", phase="final", violations=len(out),
                            kinds=sorted({v.invariant for v in out}))
         return out
+
+    def _violation_rate_under_bound(self) -> list[Violation]:
+        """At quiesce, the EMPIRICAL node-overload frequency of the
+        oversubscribed fleet — seeded usage draws from every bound
+        pod's own distribution — must stay at or under the pool's
+        epsilon (plus finite-sample slack).  This is the promise the
+        chance constraint makes; measuring it against ground-truth
+        placements (never the solver's arithmetic) is the whole point
+        of the invariant."""
+        probe = self.stochastic
+        if probe is None:
+            return []
+        catalog = probe.catalog()
+        if catalog is None:
+            return []
+        from karpenter_tpu.preempt.encode import claim_pods, occupancy_index
+        from karpenter_tpu.stochastic.validate import (
+            measured_violation_rate, violation_bound,
+        )
+
+        idx = occupancy_index(self.cluster)
+        nodes = []
+        for claim in self.cluster.nodeclaims():
+            if claim.deleted or not claim.registered:
+                continue
+            o = catalog.find_offering(claim.instance_type, claim.zone,
+                                      claim.capacity_type)
+            if o is None:
+                continue
+            pods = [p.spec for p in claim_pods(self.cluster, claim,
+                                               index=idx)]
+            if pods:
+                nodes.append((pods, catalog.offering_alloc()[o]))
+        rate, samples = measured_violation_rate(nodes, trials=256,
+                                                seed=probe.seed)
+        bound = violation_bound(probe.eps, samples)
+        if rate > bound:
+            return [Violation(
+                "violation-rate-under-bound",
+                f"measured node-overload rate {rate:.4f} over "
+                f"{samples} samples exceeds epsilon {probe.eps:g} "
+                f"(+sampling slack = {bound:.4f}) across "
+                f"{len(nodes)} occupied nodes")]
+        return []
 
     def _preempted_pods_resolve(self, catalog) -> list[Violation]:
         """A preemption may DELAY a low-priority pod; it must never

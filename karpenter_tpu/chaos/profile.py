@@ -84,6 +84,17 @@ class ChaosProfile:
     gang_slice_shapes: tuple[str, ...] = ("",)
     gang_stagger_rate: float = 0.0
     gang_starve_rate: float = 0.0
+    # oversubscription workload (karpenter_tpu/stochastic): with
+    # mean_frac > 0, every wave pod carries a usage distribution —
+    # mean = frac * request per resource, std = cv * mean with cv drawn
+    # from the menu by the seeded world stream.  overcommit_eps > 0
+    # arms the "default" NodePool's violation-probability bound (the
+    # solver packs by mean + z(eps)*sqrt(var)), the spot-risk pricing
+    # loop, and the violation-rate-under-bound / risk-model-consistent
+    # invariants.
+    pod_usage_mean_frac: float = 0.0
+    pod_usage_cv: tuple[float, ...] = ()
+    overcommit_eps: float = 0.0
     # global live-instance cap imposed on the fake cloud for the chaos
     # window (0 = unlimited); lifts at quiesce.  Demand past the cap is
     # genuine overload: creates fail with quota_exceeded and pending
@@ -186,6 +197,25 @@ PROFILES: dict[str, ChaosProfile] = _profiles(
         capacity_blackout_rate=0.35, capacity_blackout_rounds=3,
         preempt_storm_rate=0.25, preempt_storm_frac=0.40,
         error_rates={"create_instance": 0.10}),
+    ChaosProfile(
+        name="oversubscribe",
+        description="high-variance usage distributions packed under a "
+                    "chance-constraint overcommit bound + spot storms — "
+                    "the measured node-overload frequency must stay at "
+                    "or under epsilon, and the spot risk the solver "
+                    "prices must match the ledger's observed "
+                    "interruption history exactly",
+        pod_usage_mean_frac=0.5, pod_usage_cv=(0.1, 0.2, 0.3),
+        overcommit_eps=0.05,
+        pod_waves=6, pods_per_wave=(10, 30),
+        preempt_storm_rate=0.45, preempt_storm_frac=0.5,
+        degrade_rate=0.20,
+        error_rates={"create_instance": 0.08},
+        # the preemption plane accounts node residuals by REQUEST;
+        # against a deliberately-overcommitted fleet its slack filler
+        # would fight the stochastic packer every round — the
+        # oversubscription class owns density here
+        disable_controllers=("preemption",)),
     ChaosProfile(
         name="fragmentation",
         description="scattered accelerator singletons + parked slice "

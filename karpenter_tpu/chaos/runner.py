@@ -77,6 +77,19 @@ class ResidentProbe:
 
 
 @dataclass
+class StochasticProbe:
+    """What the oversubscription invariants need: the profile's epsilon
+    bound, a catalog getter (allocatable + offering lookup at CHECK
+    time), the risk model the harness actually priced with, and the
+    seed for the deterministic usage draws."""
+
+    eps: float
+    catalog: object           # () -> CatalogArrays | None
+    model: object             # () -> SpotRiskModel | None
+    seed: int = 0
+
+
+@dataclass
 class RepackProbe:
     """What the repack-plan-valid invariant needs: the harness's
     DisruptionController (its ``repack_log`` / ``repack_violations`` are
@@ -204,6 +217,26 @@ class ChaosHarness:
         self._default_quota = self.fake.instance_quota
         if profile.instance_quota:
             self.fake.instance_quota = profile.instance_quota
+        # spot-risk state is PROCESS-GLOBAL (the ledger history feeds
+        # the model the provisioner prices from): every seeded run must
+        # start from an empty history and an empty model, or
+        # determinism-verify reruns would learn run 1's rates and pack
+        # differently — reset for EVERY profile, since any spot storm
+        # now feeds the learning loop
+        from karpenter_tpu.stochastic.risk import refresh_from_ledger
+
+        obs.get_ledger().reset_interruption_history()
+        refresh_from_ledger(obs.get_ledger())
+        # oversubscription (karpenter_tpu/stochastic): arm the default
+        # pool's violation-probability bound — every solve window now
+        # lowers usage distributions and packs chance-constrained
+        self.risk_model = None
+        if profile.overcommit_eps:
+            from karpenter_tpu.apis.nodeclaim import NodePool
+
+            self.cluster.add_nodepool(NodePool(
+                name="default", nodeclass_name="default",
+                overcommit=profile.overcommit_eps))
         # min_pending_age=0: the pump provisions before every sync, so a
         # still-unnominated pod HAS had its create chance this round
         self.preemption = PreemptionController(
@@ -281,7 +314,14 @@ class ChaosHarness:
                 controller=self.disruption,
                 catalog=lambda: self.provisioner._catalog_for(
                     self.nodeclass))
-            if self.disruption is not None else None)
+            if self.disruption is not None else None,
+            stochastic=StochasticProbe(
+                eps=profile.overcommit_eps,
+                catalog=lambda: self.provisioner._catalog_for(
+                    self.nodeclass),
+                model=lambda: self.risk_model,
+                seed=seed)
+            if profile.overcommit_eps else None)
         # warm the catalog before chaos arms (pricing resolution happens
         # here, outside the deterministic traced window)
         self.catalog_provider.list(nc)
@@ -380,9 +420,24 @@ class ChaosHarness:
         gmenu = self.profile.pod_gpu
         gpu = gmenu[self.rng_world.randrange(len(gmenu))] if gmenu else 0
         selector = dict(self.profile.pod_node_selector) if gpu else {}
+        # oversubscription waves: mean = frac * request, std = cv * mean
+        # with cv from the menu — drawn from the seeded world stream so
+        # the usage shape is part of the deterministic schedule
+        usage = None
+        if self.profile.pod_usage_mean_frac:
+            from karpenter_tpu.apis.pod import UsageDistribution
+
+            frac = self.profile.pod_usage_mean_frac
+            menu_cv = self.profile.pod_usage_cv or (0.2,)
+            cv = menu_cv[self.rng_world.randrange(len(menu_cv))]
+            mcpu, mmem = int(cpu * frac), int(mem * frac)
+            usage = UsageDistribution(
+                mean=ResourceRequests(mcpu, mmem, 0, 1),
+                var=(int((cv * mcpu) ** 2), int((cv * mmem) ** 2), 0, 0))
         for pod in make_pods(n, name_prefix=f"wave{round_no}",
                              requests=ResourceRequests(cpu, mem, gpu, 1),
-                             priority=prio, node_selector=selector):
+                             priority=prio, node_selector=selector,
+                             usage=usage):
             self.cluster.add_pod(pod)
         # the pod-event end of the causal chain (chaos drives
         # provision_once directly, so there is no watch feed to stamp it)
@@ -448,6 +503,17 @@ class ChaosHarness:
         catalog = self.provisioner._catalog_for(self.nodeclass)
         if catalog is not None:
             self.resident.track_window(self._resident_window(), catalog)
+        # spot-risk learning loop (stochastic/risk.py): re-derive the
+        # model from the ledger's labeled lifecycle history and price
+        # expected eviction cost into offering ranking — checked
+        # against the same ledger by the risk-model-consistent
+        # invariant every round
+        if self.profile.overcommit_eps:
+            from karpenter_tpu.stochastic.risk import refresh_from_ledger
+
+            self.risk_model = refresh_from_ledger(obs.get_ledger())
+            if catalog is not None:
+                self.risk_model.price_catalog(catalog)
         pods = self.cluster.list("pods")
         self.trace.add(
             "pump",

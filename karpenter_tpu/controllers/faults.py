@@ -19,6 +19,7 @@ from karpenter_tpu.cloud.errors import CloudError, is_not_found
 from karpenter_tpu.controllers.runtime import PollController, Result
 from karpenter_tpu.core.actuator import KARPENTER_TAGS
 from karpenter_tpu.core.cluster import ClusterState
+from karpenter_tpu import obs
 from karpenter_tpu.utils import metrics
 from karpenter_tpu.utils.logging import get_logger
 
@@ -158,6 +159,12 @@ class SpotPreemptionController(PollController):
         self.cloud = cloud
         self.unavailable = unavailable
         self.journal = journal if journal is not None else NULL_JOURNAL
+        # instance ids already counted as interruptions: a stopped
+        # instance whose delete keeps failing stays listed for many
+        # polls — one real preemption must count ONCE in the risk
+        # history, not once per reconcile (pruned against the live
+        # list, so the set stays bounded)
+        self._counted_interruptions: set[str] = set()
 
     def reconcile(self) -> Result:
         try:
@@ -167,7 +174,21 @@ class SpotPreemptionController(PollController):
             return Result()
         preempted = [i for i in spot if i.status == "stopped" and
                      i.status_reason == "stopped_by_preemption"]
+        # labeled lifecycle history for the spot risk model
+        # (karpenter_tpu/stochastic/risk.py): every live spot instance
+        # this round is one exposure, every NEW preemption one
+        # interruption — stamped from ground-truth cloud state, so
+        # chaos spot storms generate exactly the histories production
+        # would
+        ledger = obs.get_ledger()
+        for inst in spot:
+            if inst.status == "running":
+                ledger.node_seen(inst.profile, inst.zone)
+        self._counted_interruptions &= {i.id for i in preempted}
         for inst in preempted:
+            if inst.id not in self._counted_interruptions:
+                self._counted_interruptions.add(inst.id)
+                ledger.interruption(inst.profile, inst.zone)
             self.unavailable.mark_unavailable(
                 inst.profile, inst.zone, "spot",
                 ttl=self.blackout_ttl, reason="preempted")
@@ -189,6 +210,15 @@ class SpotPreemptionController(PollController):
                     "NodeClaim", claim.name, "Warning", "SpotPreempted",
                     f"{inst.profile}/{inst.zone} preempted; offering "
                     f"blacked out {self.blackout_ttl:.0f}s")
+        if spot:
+            # production learning loop (stochastic/risk.py): re-derive
+            # the process risk model from the history this round just
+            # extended and persist it through the journal's keyed state
+            # records — the provisioner prices every catalog it
+            # resolves from this model
+            from karpenter_tpu.stochastic.risk import refresh_from_ledger
+
+            refresh_from_ledger(ledger).save(self.journal)
         return Result()
 
     def _claim_for_instance(self, instance_id: str):

@@ -594,4 +594,16 @@ class Provisioner:
                     del self._catalog_cache[oldest]
                 self._catalog_cache[key] = cached
         cached.refresh_availability(self.catalog_provider.unavailable_offerings)
+        # spot-risk pricing (karpenter_tpu/stochastic/risk.py): price
+        # learned interruption rates into offering RANKING on every
+        # catalog this provisioner resolves.  The model is refreshed by
+        # SpotPreemptionController from the ledger history; with no
+        # observations price_catalog is a cheap no-op (off_risk stays
+        # unset, generation untouched) — and it only bumps the risk
+        # generation when the column actually changed.
+        from karpenter_tpu.stochastic.risk import get_risk_model
+
+        model = get_risk_model()
+        if model.counts():
+            model.price_catalog(cached)
         return cached

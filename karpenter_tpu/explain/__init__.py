@@ -64,17 +64,22 @@ REASON_BITS = (
     ("priority_starved", 12),       # preemption found no lower-prio victim
     ("capacity_higher_prio", 13),   # capacity consumed by higher priority
     ("capacity_exhausted", 14),     # feasible offerings exist, all consumed
+    ("overcommit_risk", 15),        # the chance-constraint variance buffer
+                                    # (karpenter_tpu/stochastic) blocked
+                                    # density the mean alone would allow
 )
 
 BIT = {name: idx for name, idx in REASON_BITS}
 CANONICAL_REASONS = tuple(name for name, _ in REASON_BITS)
 
-# bits the DEVICE reduction computes (solver/jax_backend._explain_words);
-# everything else is host-refined or controller-stamped
+# bits the DEVICE reduction computes (solver/jax_backend._explain_words;
+# overcommit_risk by the stochastic kernel's reduction,
+# stochastic/kernel._risk_words); everything else is host-refined or
+# controller-stamped
 DEVICE_BITS = frozenset((
     "insufficient_cpu", "insufficient_mem", "insufficient_accel",
     "insufficient_pods", "requirements", "capacity_higher_prio",
-    "capacity_exhausted"))
+    "capacity_exhausted", "overcommit_risk"))
 
 # plane-level bits stamped by controllers (gang/preempt) rather than the
 # solve: a fresh window verdict (registry.note merge=False) REPLACES the
@@ -104,6 +109,9 @@ LADDER = (
     "insufficient_pods",
     "insufficient_mem",
     "insufficient_cpu",
+    # the variance buffer is more specific than the capacity catch-alls:
+    # "your p99 usage blocked this" beats "everything was consumed"
+    "overcommit_risk",
     "capacity_higher_prio",
     "capacity_exhausted",
 )

@@ -122,6 +122,20 @@ def reason_words(problem, unplaced: np.ndarray,
     bits |= cap_hp.astype(np.int64) << BIT["capacity_higher_prio"]
 
     words[:] = np.where(un & live, bits, 0).astype(np.int32)
+    if getattr(problem, "group_var", None) is not None:
+        # stochastic windows: the overcommit_risk bit, via the same
+        # fixed-iteration grid search the device kernel runs
+        # (stochastic/kernel._risk_words — the parity contract)
+        from karpenter_tpu.stochastic import z_bp_for
+        from karpenter_tpu.stochastic.greedy import risk_words_np
+
+        words |= risk_words_np(
+            problem.group_mean.astype(np.int32),
+            problem.group_var.astype(np.int32),
+            problem.group_count.astype(np.int64),
+            np.asarray(unplaced[:G], dtype=np.int64), compat,
+            catalog.offering_alloc().astype(np.int32),
+            z_bp_for(problem.overcommit_eps))
     return words
 
 

@@ -39,7 +39,10 @@ STATIC_REASONS = frozenset({
 # reasons asserting the pod COULD place, but something dynamic stopped it
 DYNAMIC_REASONS = frozenset({
     "capacity_exhausted", "capacity_higher_prio", "priority_starved",
-    "preemption_budget", "gang_parked", "gang_geometry"})
+    "preemption_budget", "gang_parked", "gang_geometry",
+    # the variance buffer blocks DENSITY, not static fit: the pod is
+    # placeable alone on an empty node, so the reason is dynamic
+    "overcommit_risk"})
 
 
 def _statically_placeable_all(problem) -> np.ndarray:

@@ -137,6 +137,13 @@ class PlacementLedger:
         self._snapshot_staleness = 0.0
         self.staleness_high_water = 0.0
         self._context = ""
+        # labeled spot lifecycle history (karpenter_tpu/stochastic/
+        # risk.py learns per-(type, zone) interruption rates from it):
+        # exposures = live-spot-instance scan rounds, interruptions =
+        # observed spot preemptions, both stamped by the production
+        # SpotPreemptionController from ground-truth cloud state
+        self._spot_interrupted: dict[tuple[str, str], int] = {}
+        self._spot_exposure: dict[tuple[str, str], int] = {}
 
     # -- context -------------------------------------------------------------
 
@@ -348,6 +355,41 @@ class PlacementLedger:
             tid = rec.trace_id
         metrics.POD_PLACEMENT.labels("registered").observe(
             elapsed, exemplar={"trace_id": str(tid)} if tid else None)
+
+    # -- spot lifecycle history (stochastic/risk.py) -------------------------
+
+    def node_seen(self, itype: str, zone: str, n: int = 1) -> None:
+        """One spot-exposure observation per live spot instance per scan
+        round — the denominator of the learned interruption rate."""
+        with self._lock:
+            key = (itype, zone)
+            self._spot_exposure[key] = self._spot_exposure.get(key, 0) + n
+
+    def interruption(self, itype: str, zone: str, n: int = 1) -> None:
+        """One observed spot preemption — the numerator.  Counted per
+        instance (not per pod) so the rate is a per-node survival
+        statistic, comparable across pod densities."""
+        with self._lock:
+            key = (itype, zone)
+            self._spot_interrupted[key] = \
+                self._spot_interrupted.get(key, 0) + n
+        metrics.SPOT_INTERRUPTIONS.labels(itype, zone).inc(n)
+
+    def interruption_history(self) -> dict:
+        """{"interrupted": {(type, zone): n}, "exposure": ...} — the
+        risk model's exact learning surface (copies; callers never see
+        live dicts)."""
+        with self._lock:
+            return {"interrupted": dict(self._spot_interrupted),
+                    "exposure": dict(self._spot_exposure)}
+
+    def reset_interruption_history(self) -> None:
+        """Chaos-harness hook: each seeded scenario starts from an empty
+        history, so determinism-verify reruns in one process observe
+        identical rates (the ledger is process-global)."""
+        with self._lock:
+            self._spot_interrupted.clear()
+            self._spot_exposure.clear()
 
     # -- retention -----------------------------------------------------------
 

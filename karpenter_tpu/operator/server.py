@@ -27,6 +27,10 @@ Debug surface (docs/design/observability.md):
   capture (karpenter_tpu/obs/prof.py): single-flight, duration-capped,
   returns per-dispatch dispatch/execute/fetch decomposition plus a
   Perfetto-loadable Chrome trace;
+- ``GET /debug/risk`` — spot-interruption risk model
+  (karpenter_tpu/stochastic/risk.py): per-(type, zone) learned rates
+  the solver prices into offering ranking, plus the ledger's raw
+  labeled interruption/exposure history;
 - ``GET /statusz`` — uptime, build identity, last solve breakdown,
   ledger + recorder + device-telemetry snapshots, leader /
   circuit-breaker state (the operator wires its own extras in via the
@@ -151,6 +155,8 @@ class MetricsServer:
                 elif self.path.split("?", 1)[0] == "/debug/explain":
                     self._json_endpoint(
                         lambda: outer._debug_explain(self.path))
+                elif self.path.split("?", 1)[0] == "/debug/risk":
+                    self._json_endpoint(outer._debug_risk)
                 elif self.path.split("?", 1)[0] == "/statusz":
                     self._json_endpoint(outer._statusz)
                 elif self.path == "/healthz":
@@ -284,6 +290,27 @@ class MetricsServer:
             "stamped_total": registry.stamped_total,
         }
 
+    def _debug_risk(self) -> dict:
+        """Spot-risk model surface (karpenter_tpu/stochastic/risk.py):
+        the per-(type, zone) interruption rates the solver prices,
+        refreshed from the ledger's labeled lifecycle history at read
+        time, plus the raw history itself — so an operator can see
+        both what was observed and what is being priced."""
+        from karpenter_tpu import obs
+        from karpenter_tpu.stochastic.risk import refresh_from_ledger
+
+        model = refresh_from_ledger(obs.get_ledger())
+        hist = obs.get_ledger().interruption_history()
+        return {
+            "model": model.snapshot(),
+            "history": {
+                "interrupted": {f"{t}/{z}": n for (t, z), n
+                                in sorted(hist["interrupted"].items())},
+                "exposure": {f"{t}/{z}": n for (t, z), n
+                             in sorted(hist["exposure"].items())},
+            },
+        }
+
     def _debug_profile(self, path: str) -> tuple[int, dict]:
         """On-demand device-time capture (docs/design/profiling.md):
         force-samples every dispatch for ``?duration_s=`` (clamped to
@@ -334,6 +361,7 @@ class MetricsServer:
         from karpenter_tpu.version import get_version
 
         from karpenter_tpu.explain import get_registry
+        from karpenter_tpu.stochastic.risk import get_risk_model
 
         ledger = obs.get_ledger()
         out = {
@@ -351,6 +379,10 @@ class MetricsServer:
             # own overhead fraction (<1% gate), and watchdog state
             "profiler": get_profiler().snapshot(),
             "watchdog": get_watchdog().snapshot(),
+            # spot-risk block (stochastic/risk.py): what the solver
+            # currently prices per (type, zone) — /debug/risk has the
+            # full history
+            "risk": get_risk_model().snapshot(),
         }
         if self._statusz_extra is not None:
             out.update(self._statusz_extra())

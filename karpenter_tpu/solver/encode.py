@@ -49,6 +49,7 @@ from karpenter_tpu.apis.requirements import (
     LABEL_INSTANCE_SIZE, LABEL_INSTANCE_TYPE, LABEL_ZONE, Requirements,
 )
 from karpenter_tpu.catalog.arrays import CAPACITY_TYPES, CatalogArrays
+from karpenter_tpu.stochastic.encode import usage_rows
 
 BIG_CAP = 1 << 30  # "no per-node cap"
 
@@ -85,7 +86,8 @@ class EncodedProblem:
     __slots__ = ("groups", "group_req", "group_count", "group_cap",
                  "group_prio", "group_gang", "group_min", "gang_names",
                  "catalog", "rejected", "rejected_reasons", "label_rows",
-                 "label_idx", "pref_rows", "pref_idx", "_compat",
+                 "label_idx", "pref_rows", "pref_idx", "group_mean",
+                 "group_var", "overcommit_eps", "_compat",
                  "_names_idx", "_prep_cache")
 
     def __init__(self, groups: list[PodGroup], group_req: np.ndarray,
@@ -101,7 +103,10 @@ class EncodedProblem:
                  group_gang: np.ndarray | None = None,
                  group_min: np.ndarray | None = None,
                  gang_names: list[str] | None = None,
-                 rejected_reasons: dict[str, str] | None = None):
+                 rejected_reasons: dict[str, str] | None = None,
+                 group_mean: np.ndarray | None = None,
+                 group_var: np.ndarray | None = None,
+                 overcommit_eps: float = 0.0):
         self.groups = groups
         self.group_req = group_req
         self.group_count = group_count
@@ -138,6 +143,14 @@ class EncodedProblem:
         # pallas/flat fast paths (the scan path owns penalty ranking).
         self.pref_rows = pref_rows
         self.pref_idx = pref_idx
+        # stochastic plane (karpenter_tpu/stochastic): int32 [G, R]
+        # usage mean/variance per pod of the group, attached ONLY when
+        # the nodepool overcommits (NodePool.overcommit > 0) — None is
+        # the strict-superset gate every deterministic path checks.
+        # overcommit_eps is the pool's violation-probability bound.
+        self.group_mean = group_mean
+        self.group_var = group_var
+        self.overcommit_eps = overcommit_eps
         self._compat = compat
         self._names_idx = None      # (names_arr object [P], gstart int64 [G+1])
         self._prep_cache = None     # jax_backend packed-template cache
@@ -177,7 +190,9 @@ class EncodedProblem:
                       pref_idx=self.pref_idx, group_prio=self.group_prio,
                       group_gang=self.group_gang, group_min=self.group_min,
                       gang_names=self.gang_names,
-                      rejected_reasons=self.rejected_reasons)
+                      rejected_reasons=self.rejected_reasons,
+                      group_mean=self.group_mean, group_var=self.group_var,
+                      overcommit_eps=self.overcommit_eps)
         fields.update(kw)
         return EncodedProblem(**fields)
 
@@ -478,7 +493,10 @@ def _pool_signature(pool: NodePool) -> tuple:
     return (pool.name, pool.nodeclass_name,
             tuple(sorted(r.signature for r in pool.requirements)),
             pool.taints, pool.startup_taints,
-            tuple(sorted(pool.labels.items())), pool.resource_version)
+            tuple(sorted(pool.labels.items())), pool.resource_version,
+            # overcommit epsilon changes which tensors the encoder
+            # attaches (stochastic plane) — part of lowering identity
+            getattr(pool, "overcommit", 0.0))
 
 
 def encode(pods: Sequence[PodSpec], catalog: CatalogArrays,
@@ -560,6 +578,12 @@ def _encode_impl(pods: Sequence[PodSpec], catalog: CatalogArrays,
     g_gang: list[int] = []                 # gang id; -1 = no gang
     g_min: list[int] = []                  # gang min_member; 0 = no gang
     g_name: list[str] = []
+    # stochastic columns (karpenter_tpu/stochastic): collected only when
+    # the pool overcommits — the deterministic encode allocates nothing
+    overcommit_eps = float(getattr(nodepool, "overcommit", 0.0) or 0.0)
+    stochastic = overcommit_eps > 0.0
+    g_mean: list[tuple[int, ...]] = []
+    g_var: list[tuple[int, ...]] = []
     gang_ids: dict[str, int] = {}          # gang name -> interned id
     row_keys: dict[tuple, int] = {}
     rows: list[np.ndarray] = []
@@ -655,6 +679,10 @@ def _encode_impl(pods: Sequence[PodSpec], catalog: CatalogArrays,
         # every pod occupies >=1 pod slot: keeps per-node assignment
         # counts bounded by the offering's pod-slot allocatable
         req_row = (req[0], req[1], req[2], max(req[3], 1))
+        if stochastic:
+            mean_row, var_row = usage_rows(rep)
+        else:
+            mean_row = var_row = ()   # never appended
         cap_i32 = min(cap, np.iinfo(np.int32).max)
         pref_terms, pref_w = pref
         if rep.gang is not None:
@@ -696,6 +724,9 @@ def _encode_impl(pods: Sequence[PodSpec], catalog: CatalogArrays,
                 g_gang.append(gang_id)
                 g_min.append(gang_min)
                 g_name.append(groups[-1].pod_names[0])
+                if stochastic:
+                    g_mean.append(mean_row)
+                    g_var.append(var_row)
 
         spread = _zone_spread_constraints(rep)
         if rep.gang is not None:
@@ -715,6 +746,9 @@ def _encode_impl(pods: Sequence[PodSpec], catalog: CatalogArrays,
             g_gang.append(gang_id)
             g_min.append(gang_min)
             g_name.append(groups[-1].pod_names[0])
+            if stochastic:
+                g_mean.append(mean_row)
+                g_var.append(var_row)
         elif spread and len(live_zones) > 1:
             split_subgroups(live_zones, pinned=True)
         elif _has_zone_affinity(rep) and len(live_zones) > 1:
@@ -738,6 +772,9 @@ def _encode_impl(pods: Sequence[PodSpec], catalog: CatalogArrays,
             g_gang.append(gang_id)
             g_min.append(gang_min)
             g_name.append(groups[-1].pod_names[0])
+            if stochastic:
+                g_mean.append(mean_row)
+                g_var.append(var_row)
         elif _soft_zone_spread(rep) and len(live_zones) > 1:
             # soft spread ranks BELOW hard spread and below zone
             # co-scheduling affinity (a hard term must never be diluted
@@ -757,6 +794,9 @@ def _encode_impl(pods: Sequence[PodSpec], catalog: CatalogArrays,
             g_gang.append(gang_id)
             g_min.append(gang_min)
             g_name.append(groups[-1].pod_names[0])
+            if stochastic:
+                g_mean.append(mean_row)
+                g_var.append(var_row)
 
     # 4. FFD order: descending PRIORITY first (preemption semantics:
     # under scarcity, every backend spends capacity on high-priority
@@ -775,6 +815,11 @@ def _encode_impl(pods: Sequence[PodSpec], catalog: CatalogArrays,
     group_prio = np.asarray(g_prio, dtype=np.int32)
     group_gang = np.asarray(g_gang, dtype=np.int32)
     group_min = np.asarray(g_min, dtype=np.int32)
+    group_mean = group_var = None
+    if stochastic:
+        from karpenter_tpu.stochastic.encode import stack_usage
+
+        group_mean, group_var = stack_usage(g_mean, g_var)
     if G:
         shares = np.where(mean_alloc[None, :] > 0,
                           group_req.astype(np.float64)
@@ -791,6 +836,9 @@ def _encode_impl(pods: Sequence[PodSpec], catalog: CatalogArrays,
         group_prio = np.ascontiguousarray(group_prio[order])
         group_gang = np.ascontiguousarray(group_gang[order])
         group_min = np.ascontiguousarray(group_min[order])
+        if stochastic:
+            group_mean = np.ascontiguousarray(group_mean[order])
+            group_var = np.ascontiguousarray(group_var[order])
 
     label_rows = (np.stack(rows) if rows
                   else np.zeros((0, O), dtype=bool))
@@ -805,7 +853,9 @@ def _encode_impl(pods: Sequence[PodSpec], catalog: CatalogArrays,
         pref_rows=np.stack(pref_rows_l) if has_pref else None,
         pref_idx=pref_idx if has_pref else None, group_prio=group_prio,
         group_gang=group_gang, group_min=group_min,
-        gang_names=list(gang_ids), rejected_reasons=rej_reasons)
+        gang_names=list(gang_ids), rejected_reasons=rej_reasons,
+        group_mean=group_mean, group_var=group_var,
+        overcommit_eps=overcommit_eps if stochastic else 0.0)
 
 
 def estimate_nodes(problem: EncodedProblem, n_cap: int,

@@ -92,6 +92,37 @@ def solve_per_pod_native(problem: EncodedProblem, expanded=None,
         return out
 
 
+# shared zero-variance row for deterministic nodes (node_vars entries
+# are REPLACED, never mutated, so one shared array is safe)
+_NO_VAR = np.zeros(4, dtype=np.float64)
+
+
+def _chance_cap(hi: int, resid: np.ndarray, var_sum: np.ndarray,
+                mean: np.ndarray, var: np.ndarray, zsq) -> int:
+    """Largest k <= hi passing the per-dimension quantile check for ONE
+    node (karpenter_tpu/stochastic semantics; the device twin is
+    stochastic/kernel._chance_fit)."""
+    from karpenter_tpu.stochastic import CHANCE_FIT_MAX
+    from karpenter_tpu.stochastic.greedy import chance_fit_np
+
+    hi_a = np.asarray([min(int(hi), CHANCE_FIT_MAX)], dtype=np.int64)
+    k = chance_fit_np(resid[None, :], var_sum[None, :].astype(np.float32),
+                      mean, var.astype(np.float32), zsq, hi_a)
+    return int(k[0])
+
+
+def _chance_cap_empty(fit_empty: np.ndarray, off_alloc: np.ndarray,
+                      mean: np.ndarray, var: np.ndarray, zsq) -> np.ndarray:
+    """Chance-corrected empty-node fit over the offering axis."""
+    from karpenter_tpu.stochastic import CHANCE_FIT_MAX
+    from karpenter_tpu.stochastic.greedy import chance_fit_np
+
+    hi = np.minimum(fit_empty, CHANCE_FIT_MAX).astype(np.int64)
+    return chance_fit_np(off_alloc,
+                         np.zeros_like(off_alloc, dtype=np.float32),
+                         mean, var.astype(np.float32), zsq, hi)
+
+
 class GreedySolver:
     def __init__(self, options: SolverOptions | None = None):
         self.options = options or SolverOptions(backend="greedy")
@@ -115,6 +146,7 @@ class GreedySolver:
     def solve_encoded(self, problem: EncodedProblem) -> Plan:
         if self.options.use_native != "off" \
                 and problem.pref_rows is None \
+                and problem.group_var is None \
                 and not problem.has_gangs:
             # the C++ twin has no preference-penalty ranking and no
             # gang transaction; those windows route to the python
@@ -167,8 +199,21 @@ class GreedySolver:
         off_rank = catalog.offering_rank_price().astype(np.float64)
         max_nodes = self.options.max_nodes
 
+        # chance-constrained packing (karpenter_tpu/stochastic): when
+        # the encoder attached usage tensors, capacity is consumed by
+        # MEAN and every fit routes through the quantile check with the
+        # node's accumulated variance — the host twin of the device
+        # scan's semantics (no right-size pass here, same as ever)
+        stochastic = problem.group_var is not None
+        zsq = np.float32(0.0)
+        if stochastic:
+            from karpenter_tpu.stochastic import z_bp_for, zsq_value
+
+            zsq = np.float32(zsq_value(z_bp_for(problem.overcommit_eps)))
+
         node_offering: list[int] = []
         node_resid: list[np.ndarray] = []
+        node_vars: list[np.ndarray] = []    # accumulated variance [R]
         node_pods: list[list[str]] = []
 
         unplaced: list[str] = list(problem.rejected)
@@ -192,6 +237,9 @@ class GreedySolver:
 
         for gi, group in enumerate(problem.groups):
             req = problem.group_req[gi].astype(np.int64)
+            if stochastic:
+                req = problem.group_mean[gi].astype(np.int64)
+                gvar = problem.group_var[gi].astype(np.float64)
             cap = int(problem.group_cap[gi])
             compat = problem.compat[gi]
             gid = int(gang_ids[gi]) if problem.has_gangs else -1
@@ -203,11 +251,11 @@ class GreedySolver:
                     unplaced.extend(group.pod_names)
                     continue
                 # shallow snapshots suffice: the placement loop REPLACES
-                # node_resid entries (never mutates in place) and only
-                # ever extends node_pods, so rollback = restore lists +
-                # truncate pod tails
+                # node_resid / node_vars entries (never mutates in
+                # place) and only ever extends node_pods, so rollback =
+                # restore lists + truncate pod tails
                 saved = (list(node_offering), list(node_resid),
-                         [len(p) for p in node_pods])
+                         [len(p) for p in node_pods], list(node_vars))
             # soft preferences: penalty-ranked pricing for the new-node
             # choice (same rank_g = rank * (1 + lambda * miss) blend the
             # device scan applies); real cost accounting untouched
@@ -231,10 +279,15 @@ class GreedySolver:
                                               np.int64(1 << 40))))
                 else:
                     fit = 1 << 40
+                if stochastic:
+                    fit = _chance_cap(fit, resid, node_vars[ni], req,
+                                      gvar, zsq)
                 take = min(fit, cap, len(remaining))
                 if take <= 0:
                     continue
                 node_resid[ni] = resid - req * take
+                if stochastic:
+                    node_vars[ni] = node_vars[ni] + gvar * take
                 node_pods[ni].extend(remaining[:take])
                 del remaining[:take]
 
@@ -250,6 +303,9 @@ class GreedySolver:
                                     off_alloc // np.maximum(req[None, :], 1),
                                     np.int64(1 << 40)), axis=1),
                     0)
+                if stochastic:
+                    fit_empty = _chance_cap_empty(fit_empty, off_alloc,
+                                                  req, gvar, zsq)
                 fit_empty = np.minimum(fit_empty, min(cap, len(remaining)))
                 with np.errstate(divide="ignore", invalid="ignore"):
                     cost_per_pod = np.where(fit_empty > 0,
@@ -261,6 +317,8 @@ class GreedySolver:
                         take = min(best_fit, len(remaining))
                         node_offering.append(best_off)
                         node_resid.append(off_alloc[best_off] - req * take)
+                        node_vars.append(gvar * take if stochastic
+                                         else _NO_VAR)
                         node_pods.append(remaining[:take])
                         del remaining[:take]
             if gid >= 0 and remaining:
@@ -268,6 +326,7 @@ class GreedySolver:
                 # back — a partial gang must never survive the oracle
                 node_offering[:] = saved[0]
                 node_resid[:] = saved[1]
+                node_vars[:] = saved[3]
                 del node_pods[len(saved[0]):]
                 for i, n0 in enumerate(saved[2]):
                     del node_pods[i][n0:]
@@ -283,7 +342,12 @@ class GreedySolver:
             doomed: dict[str, np.ndarray] = {}
             for i in range(problem.num_groups):
                 if int(gang_ids[i]) in failed_gangs:
-                    r = problem.group_req[i].astype(np.int64)
+                    # stochastic windows packed by mean, so the strip
+                    # returns MEAN capacity (variance is deliberately
+                    # not restored — keeping the stripped pods' buffer
+                    # only tightens the node, never violates it)
+                    r = (problem.group_mean[i] if stochastic
+                         else problem.group_req[i]).astype(np.int64)
                     for pn in problem.groups[i].pod_names:
                         doomed[pn] = r
             stripped = False

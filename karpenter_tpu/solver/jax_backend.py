@@ -948,11 +948,12 @@ class _Prepared:
     __slots__ = ("catalog", "G_pad", "O_pad", "U_pad", "N", "N_cap", "K0",
                  "K_cap", "K", "dense16_ok", "dense16", "coo16", "packed",
                  "right_size", "pref_rows", "pref_idx", "pref_lambda",
-                 "tmpl")
+                 "sto", "z_bp", "sto_grid", "tmpl")
 
     def __init__(self, *, catalog, G_pad, O_pad, U_pad, N, N_cap, K0, packed,
                  K_cap=None, dense16_ok=False, right_size=None,
-                 pref_rows=None, pref_idx=None, pref_lambda=None):
+                 pref_rows=None, pref_idx=None, pref_lambda=None,
+                 sto=None, z_bp=0):
         self.catalog = catalog
         self.G_pad = G_pad
         self.O_pad = O_pad
@@ -975,6 +976,16 @@ class _Prepared:
         self.pref_rows = pref_rows
         self.pref_idx = pref_idx
         self.pref_lambda = pref_lambda
+        # stochastic plane (karpenter_tpu/stochastic): the packed
+        # mean/var suffix leaf + the static z(eps) basis points.  sto
+        # None = deterministic dispatch (the strict-superset gate);
+        # the degraded fallback disarms it in place.
+        self.sto = sto
+        self.z_bp = z_bp
+        # device-resident (kd, kc) fit grids, built lazily at first
+        # stochastic dispatch and cached on the template — warm solves
+        # pass them as inputs instead of recomputing the [G, O, R] grid
+        self.sto_grid = None
         self.tmpl = None
 
     def clone(self) -> "_Prepared":
@@ -1075,7 +1086,7 @@ class JaxSolver:
 
                 attach(problem, done)
             return PendingSolve(self, problem, done=done)
-        if flat_viable(problem, self.options):
+        if problem.group_var is None and flat_viable(problem, self.options):
             attempt = dispatch_flat(self, problem)
             if attempt is not None:
                 return PendingSolve(self, problem, flat=attempt)
@@ -1162,6 +1173,7 @@ class JaxSolver:
         for p in problems:
             prep = None
             batchable = (p.num_groups > 0 and p.pref_rows is None
+                         and p.group_var is None
                          and not flat_viable(p, self.options))
             if batchable:
                 prep = self._prepare(p)
@@ -1206,19 +1218,31 @@ class JaxSolver:
             try:
                 out_np = np.asarray(out_dev)
             except Exception as e:  # noqa: BLE001
-                if path != "pallas":
+                if path == "stochastic":
+                    # async stochastic-kernel fault (TPU execution is
+                    # lazy): disarm the route and re-dispatch the SAME
+                    # base buffer deterministically
+                    from karpenter_tpu.stochastic.degraded import (
+                        note_degraded,
+                    )
+
+                    note_degraded(prep, e)
+                    out_dev, path = self._dispatch(prep, prep.packed)
+                    out_np = np.asarray(out_dev)
+                elif path != "pallas":
                     raise
-                # a Mosaic failure must never break a solve window — fall
-                # back to the scan path for this shape bucket and make the
-                # switch observable
-                log.warning("pallas path failed; scan fallback engaged",
-                            error=str(e)[:300], G=prep.G_pad, O=prep.O_pad,
-                            N=prep.N)
-                metrics.ERRORS.labels("solver", "pallas_fallback").inc()
-                self._pallas_failed_shapes.add(
-                    (prep.G_pad, prep.O_pad, prep.N))
-                out_dev, path = self._dispatch(prep, prep.packed)
-                out_np = np.asarray(out_dev)
+                else:
+                    # a Mosaic failure must never break a solve window —
+                    # fall back to the scan path for this shape bucket
+                    # and make the switch observable
+                    log.warning("pallas path failed; scan fallback engaged",
+                                error=str(e)[:300], G=prep.G_pad,
+                                O=prep.O_pad, N=prep.N)
+                    metrics.ERRORS.labels("solver", "pallas_fallback").inc()
+                    self._pallas_failed_shapes.add(
+                        (prep.G_pad, prep.O_pad, prep.N))
+                    out_dev, path = self._dispatch(prep, prep.packed)
+                    out_np = np.asarray(out_dev)
             t_fetch = obs.now()
             _phase("compute", t_issued, t_fetch, parent=par, path=path)
             if coo_buffer_full(out_np, prep.G_pad, prep.N, prep.K,
@@ -1298,7 +1322,8 @@ class JaxSolver:
             return []
         catalog = problems[0].catalog
         if any(p.catalog is not catalog for p in problems[1:]) \
-                or any(p.pref_rows is not None for p in problems):
+                or any(p.pref_rows is not None for p in problems) \
+                or any(p.group_var is not None for p in problems):
             return [self.solve_encoded(p) for p in problems]
         # one common label-row bucket across candidates (their U differs
         # by at most one appended row) so the stacked buffers share length
@@ -1500,10 +1525,23 @@ class JaxSolver:
                               P_pad, O_pad)
             pref_idx = np.full(G_pad, -1, np.int32)
             pref_idx[:problem.pref_idx.shape[0]] = problem.pref_idx
+        sto, z_bp = None, 0
+        if problem.group_var is not None:
+            # stochastic suffix (karpenter_tpu/stochastic): the BASE
+            # packed buffer is unchanged — the deterministic degraded
+            # fallback re-dispatches it as-is — and the mean/var rows
+            # ride one extra small donated leaf
+            from karpenter_tpu.stochastic import z_bp_for
+            from karpenter_tpu.stochastic.encode import pack_stochastic
+
+            sto = pack_stochastic(problem.group_mean, problem.group_var,
+                                  G_pad)
+            z_bp = z_bp_for(problem.overcommit_eps)
         return _Prepared(catalog=catalog, G_pad=G_pad, O_pad=O_pad,
                          U_pad=U_pad, N=N, N_cap=N_cap, K0=K0, K_cap=K_cap,
                          packed=packed, dense16_ok=max_slots < (1 << 15),
-                         pref_rows=pref_rows, pref_idx=pref_idx)
+                         pref_rows=pref_rows, pref_idx=pref_idx,
+                         sto=sto, z_bp=z_bp)
 
     @staticmethod
     def _note_dispatch(path: str, prep: "_Prepared", arr, N: int,
@@ -1535,8 +1573,17 @@ class JaxSolver:
         bypass (compute_handle)."""
         catalog, G_pad, O_pad = prep.catalog, prep.G_pad, prep.O_pad
         N = prep.N
+        if prep.sto is not None:
+            # chance-constrained windows own their route (the pallas /
+            # flat / resident fast paths carry no quantile check); a
+            # kernel failure here degrades to the deterministic scan on
+            # the SAME base buffer (stochastic/degraded.py)
+            out = self._dispatch_stochastic(prep, arr)
+            if out is not None:
+                return out, "stochastic"
         if allow_resident and self.resident is not None \
-                and prep.pref_rows is None and isinstance(arr, np.ndarray):
+                and prep.pref_rows is None and prep.sto is None \
+                and isinstance(arr, np.ndarray):
             out = self._dispatch_resident(prep, arr)
             if out is not None:
                 return out, "resident"
@@ -1615,6 +1662,52 @@ class JaxSolver:
                 compact=prep.K, dense16=prep.dense16, coo16=prep.coo16)
             probe.dispatched(out)
         return out, "scan"
+
+    def _dispatch_stochastic(self, prep: "_Prepared", arr):
+        """One chance-constrained window (stochastic/kernel.py): the
+        standard packed buffer plus the donated mean/var suffix leaf,
+        z(eps) static in basis points.  Returns the device result
+        buffer — same wire layout as the scan path — or None after
+        disarming the stochastic route (stochastic/degraded.py), so the
+        caller falls through to the deterministic dispatch: a broken
+        quantile kernel must never fail a solve window."""
+        from karpenter_tpu.stochastic.degraded import note_degraded
+        from karpenter_tpu.stochastic.kernel import (
+            build_fit_grids, solve_packed_stochastic,
+        )
+
+        catalog, G_pad, O_pad = prep.catalog, prep.G_pad, prep.O_pad
+        N = prep.N
+        prep.K, prep.dense16, prep.coo16 = clamp_output_opts(
+            prep.K0, prep.dense16_ok, G_pad, N)
+        rs = self.options.right_size if prep.right_size is None \
+            else prep.right_size
+        try:
+            off_alloc, off_price, off_rank = self._device_offerings(
+                catalog, O_pad)
+            if prep.sto_grid is None:
+                # per-problem constants (mean, var, catalog, epsilon):
+                # built once, device-resident on the template — every
+                # warm re-solve of this window ships them as inputs
+                prep.sto_grid = build_fit_grids(prep.sto, off_alloc,
+                                                G=G_pad, z_bp=prep.z_bp)
+                if prep.tmpl is not None:
+                    prep.tmpl.sto_grid = prep.sto_grid
+            kd, kc = prep.sto_grid
+            self._note_dispatch("stochastic", prep, arr, N, (prep.z_bp, rs))
+            with get_profiler().sampled("stochastic") as probe:
+                out = solve_packed_stochastic(
+                    arr, prep.sto, kd, kc, off_alloc, off_price, off_rank,
+                    G=G_pad, O=O_pad, U=prep.U_pad, N=N, z_bp=prep.z_bp,
+                    right_size=rs, compact=prep.K, dense16=prep.dense16,
+                    coo16=prep.coo16)
+                probe.dispatched(out)
+            metrics.OVERCOMMIT_SOLVES.labels("stochastic").inc()
+            metrics.OVERCOMMIT_Z.set(prep.z_bp / 10000.0)
+            return out
+        except Exception as e:  # noqa: BLE001 — degrade, never fail
+            note_degraded(prep, e)
+            return None
 
     def _dispatch_resident(self, prep: "_Prepared", packed: np.ndarray):
         """One window through the resident store: the packed buffer is
@@ -1712,7 +1805,8 @@ class JaxSolver:
         from karpenter_tpu.solver.pallas_kernel import pack_catalog
 
         key = ("pallas", catalog.uid, catalog.generation,
-               catalog.availability_generation, O_pad)
+               catalog.availability_generation, O_pad,
+               getattr(catalog, "risk_generation", 0))
         cached = self._device_catalog.get(key)
         if cached is None:
             self._prune_device_catalog(catalog)
@@ -1729,7 +1823,7 @@ class JaxSolver:
 
     def _device_offerings(self, catalog, O_pad: int):
         key = (catalog.uid, catalog.generation, catalog.availability_generation,
-               O_pad)
+               O_pad, getattr(catalog, "risk_generation", 0))
         cached = self._device_catalog.get(key)
         if cached is None:
             self._prune_device_catalog(catalog)
@@ -1800,6 +1894,18 @@ class PendingSolve:
             try:
                 out_np = _await_dev(dev, fut)
             except Exception as e:  # noqa: BLE001 — Mosaic runtime fault
+                if path == "stochastic":
+                    # async stochastic-kernel fault: disarm the route
+                    # and re-dispatch deterministically (the base
+                    # packed buffer is unchanged by construction)
+                    from karpenter_tpu.stochastic.degraded import (
+                        note_degraded,
+                    )
+
+                    note_degraded(prep, e)
+                    dev, path = solver._dispatch(prep, prep.packed)
+                    fut = _prefetch(dev)
+                    continue
                 if path != "pallas":
                     raise
                 log.warning("pallas path failed; scan fallback engaged",

@@ -72,10 +72,12 @@ def validate_plan(plan: Plan, pods: Sequence[PodSpec], catalog: CatalogArrays,
                 catalog.describe_offering(o):
             errors.append(f"node{ni}: offering index mismatch")
         used = [0, 0, 0, 0]
+        node_pods: list[PodSpec] = []
         for pn in node.pod_names:
             pod = by_name.get(pn)
             if pod is None:
                 continue
+            node_pods.append(pod)
             for i, v in enumerate(pod.requests.as_tuple()):
                 used[i] += v
             reqs = pod.scheduling_requirements().merged(nodepool.requirements)
@@ -84,7 +86,22 @@ def validate_plan(plan: Plan, pods: Sequence[PodSpec], catalog: CatalogArrays,
                               f"by labels {labels}")
             if nodepool.taints and not tolerates_all(pod.tolerations, nodepool.taints):
                 errors.append(f"node{ni}: pod {pn} does not tolerate pool taints")
-        if any(u > a for u, a in zip(used, alloc)):
+        overcommit = float(getattr(nodepool, "overcommit", 0.0) or 0.0)
+        if overcommit > 0.0:
+            # chance-constrained pool (karpenter_tpu/stochastic): the
+            # per-node capacity rule is the quantile bound on the pods'
+            # usage distributions — sum(mean) + z(eps)*sqrt(sum var)
+            # per dimension — re-derived from the raw pods with an
+            # independent float64 implementation (never the kernel's
+            # float32 arithmetic)
+            from karpenter_tpu.stochastic.validate import (
+                node_chance_violations,
+            )
+
+            errors.extend(node_chance_violations(
+                node_pods, alloc, overcommit,
+                label=f"node{ni} ({node.instance_type})"))
+        elif any(u > a for u, a in zip(used, alloc)):
             errors.append(f"node{ni} ({node.instance_type}): capacity exceeded "
                           f"used={used} alloc={list(alloc)}")
 

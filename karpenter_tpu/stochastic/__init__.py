@@ -1,0 +1,118 @@
+"""Chance-constrained stochastic packing — the fifth solver plane.
+
+Per "Solving the Batch Stochastic Bin Packing Problem in Cloud: A
+Chance-constrained Optimization Approach" (PAPERS.md), pod usage is a
+DISTRIBUTION, not a scalar: each pod carries a per-resource
+(mean, variance) pair (``apis/pod.UsageDistribution``) and each NodePool
+a violation-probability bound epsilon (``NodePool.overcommit``).  A node
+is chance-feasible when, per resource dimension,
+
+    sum(mean) + z(eps) * sqrt(sum(variance)) <= capacity
+
+— the Gaussian deterministic equivalent, evaluated as ONE vectorized
+quantile check inside the existing solve dispatch (stochastic/kernel.py
+rides the packed-buffer suffix trick the explain plane established:
+the per-group mean/variance tensors travel as a small extra leaf, the
+result buffer layout is unchanged).  Pooled variance is the density
+win: sqrt(sum var) grows like sqrt(n) while budgeting each pod its own
+z*sqrt(var) grows like n, so nodes legally hold 10-30% more mean demand
+at the same violation bound.
+
+Plane layout (the established encode/kernel/greedy-parity/degraded/
+validate pattern of preempt/, gang/, and repack/):
+
+- ``stochastic/encode.py``  — groups -> mean/var tensors + the packed
+  suffix; ``solver/encode.py`` attaches them when the pool overcommits;
+- ``stochastic/kernel.py``  — the chance-constrained FFD scan (jitted,
+  donated per GL006, prof-sampled) sharing the packed result wire;
+- ``stochastic/greedy.py``  — the bit-identical numpy parity oracle
+  (same fixed-point binary search, same float32 op order);
+- ``stochastic/degraded.py``— deterministic-requests fallback when the
+  stochastic kernel fails (ResilientSolver convention);
+- ``stochastic/validate.py``— independent chance-constraint validator +
+  the measured-violation-rate probe the chaos invariant consumes;
+- ``stochastic/risk.py``    — per-(type, zone) spot-interruption rate
+  learned from the ledger's labeled lifecycle records, priced into
+  offering ranking, persisted via recovery-journal state records.
+
+Every numeric constant the device kernel and the host oracle share
+lives HERE — change one side, change both is prevented by having only
+one side to change.
+"""
+
+from __future__ import annotations
+
+import math
+
+import numpy as np
+
+# Binary-search bounds of the vectorized quantile check: the fit count
+# is clamped to CHANCE_FIT_MAX and resolved in CHANCE_ITERS fixed
+# iterations, so the device scan and the numpy oracle run the
+# IDENTICAL op sequence — the parity contract is structural, not
+# numerical luck.  2047 pods per node per group is far above any real
+# offering's pod-slot allocatable, and every iteration is a full
+# [*, R] tensor pass — the cap is the direct knob on quantile-check
+# cost (12 = ceil(log2(2047 + 2)) iterations resolve the range
+# exactly).
+CHANCE_FIT_MAX = 2047
+CHANCE_ITERS = 12
+
+# epsilon floor: z(eps) explodes as eps -> 0; bounds below this clamp
+# (a 1e-9 bound would demand ~6 sigma of buffer and pack worse than
+# deterministic requests for any realistic variance)
+EPS_MIN = 1e-6
+
+
+def z_value(eps: float) -> float:
+    """One-sided standard-normal quantile z with P(X > z) = eps — the
+    chance-constraint multiplier.  Acklam-free: derived from the exact
+    inverse error function via ``sqrt(2) * erfinv(1 - 2*eps)`` computed
+    with a deterministic rational approximation (Giles 2010 single-
+    precision-grade polynomial evaluated in float64), accurate to ~1e-7
+    over the clamped epsilon range — far below the basis-point
+    quantization the kernel consumes."""
+    eps = min(max(float(eps), EPS_MIN), 0.5)
+    # inverse normal CDF at q = 1 - eps via the Beasley-Springer-Moro
+    # style central/tail split (deterministic, stdlib-only)
+    q = 1.0 - eps
+    if q == 0.5:
+        return 0.0
+    # tail form: z = t - poly(t)/poly(t), t = sqrt(-2 ln(eps))
+    t = math.sqrt(-2.0 * math.log(eps))
+    z = t - ((2.515517 + 0.802853 * t + 0.010328 * t * t)
+             / (1.0 + 1.432788 * t + 0.189269 * t * t
+                + 0.001308 * t * t * t))
+    # one Newton step against the exact normal tail tightens the
+    # classic Hastings approximation from ~4.5e-4 to <1e-7 absolute
+    pdf = math.exp(-0.5 * z * z) / math.sqrt(2.0 * math.pi)
+    cdf_tail = 0.5 * math.erfc(z / math.sqrt(2.0))
+    if pdf > 0:
+        z -= (eps - cdf_tail) / pdf
+    return z
+
+
+def z_bp_for(eps: float) -> int:
+    """z(eps) quantized to basis points (z * 10000, int) — the STATIC
+    kernel argument, so a handful of distinct epsilons per process
+    means a handful of compiled executables, never a recompile per
+    float wiggle."""
+    return int(round(z_value(eps) * 10000.0))
+
+
+def zsq_value(z_bp: int) -> float:
+    """The squared z constant both the device kernel and the numpy
+    oracle consume, materialized ONCE on the host in float32 so the
+    two sides compare against bit-identical values: the quantile check
+    is ``zsq * sum(var) <= (cap - sum(mean))^2`` — square-compare form,
+    no sqrt on the hot path."""
+    zf = np.float32(np.float32(z_bp) * np.float32(1e-4))
+    return float(np.float32(zf * zf))
+
+
+def stochastic_enabled(problem) -> bool:
+    """Does this encoded problem carry the stochastic plane?  True when
+    the encoder attached mean/variance tensors (pool overcommit > 0).
+    The strict-superset gate: every dispatch path checks this before
+    routing to the chance-constrained kernel."""
+    return getattr(problem, "group_var", None) is not None

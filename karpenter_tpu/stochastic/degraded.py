@@ -1,0 +1,50 @@
+"""Degraded mode: deterministic-requests fallback.
+
+The chance-constrained kernel can fail the same ways any device kernel
+can (dead tunnel, Mosaic/XLA fault, a poisoned donated buffer).  None
+of those may fail a solve window — the ``ResilientSolver`` convention:
+the dispatch strips the stochastic suffix and re-runs the IDENTICAL
+packed buffer through the deterministic scan (packing by request, zero
+overcommit), with an ``ERRORS`` breadcrumb and the
+``karpenter_tpu_overcommit_solves_total{mode="degraded"}`` counter so
+dashboards see every degradation.  Semantics of the fallback are the
+strict-superset guarantee in reverse: requests upper-bound usage, so a
+deterministic plan is always chance-feasible at ANY epsilon.
+"""
+
+from __future__ import annotations
+
+from karpenter_tpu.utils import metrics
+from karpenter_tpu.utils.logging import get_logger
+
+log = get_logger("stochastic.degraded")
+
+
+def strip_stochastic(prep) -> None:
+    """Disarm the stochastic route on a prepared dispatch IN PLACE: the
+    next ``_dispatch`` of this prep (and of its cached template — a
+    broken kernel must not re-break every later window of the same
+    shape) runs the deterministic scan on the unchanged base buffer."""
+    prep.sto = None
+    tmpl = getattr(prep, "tmpl", None)
+    if tmpl is not None:
+        tmpl.sto = None
+
+
+def note_degraded(prep, error: Exception) -> None:
+    """One degradation breadcrumb: log + metric, then strip."""
+    log.warning("stochastic kernel failed; deterministic-requests "
+                "fallback engaged", error=str(error)[:300],
+                G=prep.G_pad, O=prep.O_pad, N=prep.N)
+    metrics.ERRORS.labels("solver", "stochastic_fallback").inc()
+    metrics.OVERCOMMIT_SOLVES.labels("degraded").inc()
+    strip_stochastic(prep)
+
+
+def deterministic_problem(problem):
+    """Problem-level fallback (host paths): the same window with the
+    stochastic tensors dropped — packing reverts to requests."""
+    if getattr(problem, "group_var", None) is None:
+        return problem
+    return problem.replace(group_mean=None, group_var=None,
+                           overcommit_eps=0.0)

@@ -1,0 +1,291 @@
+"""Device kernel: the chance-constrained FFD scan.
+
+Same shape as ``solver/jax_backend.solve_packed`` — one donated packed
+problem buffer in, one packed result buffer (node_off / unplaced / cost
+/ assign tail / explain words) out — plus the small donated stochastic
+suffix leaf (``stochastic/encode.pack_stochastic``).  The ONLY semantic
+change vs the deterministic scan is the fit count: capacity is consumed
+by MEAN, and every fit is resolved through the vectorized quantile
+check
+
+    zsq * (node_var + k * var) <= (resid_mean - k * mean)^2   per dim
+
+via a fixed ``CHANCE_ITERS``-step integer binary search (monotone
+predicate; ``feas(0)`` is a loop invariant of the packing, so the
+search is exact).  The square-compare form keeps sqrt off the hot path
+and — with the shared float32 ``zsq`` constant and the identical op
+order — makes the numpy oracle (stochastic/greedy.py) bit-identical:
+every float op is a single IEEE-rounded elementwise mul/add/compare,
+never a reassociable reduction.
+
+Deterministic degenerate case: var == 0 collapses the predicate to
+``0 <= diff^2`` — the chance fit EQUALS the integer mean fit, so a
+window of request-mean/zero-variance pods packs exactly as the
+deterministic scan would (the strict-superset contract, asserted in
+tests/test_stochastic.py).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from karpenter_tpu.stochastic import CHANCE_FIT_MAX, CHANCE_ITERS, zsq_value
+
+_BIG = 1 << 30
+
+
+def _fit_counts(resid, req):
+    """[X,R] // [R] -> [X]; dims with req==0 are unconstrained (mirror
+    of jax_backend._fit_counts, local so the kernel module has no
+    import-time dependency on the 2k-line backend)."""
+    per_dim = jnp.where(req[None, :] > 0,
+                        resid // jnp.maximum(req[None, :], 1), _BIG)
+    return jnp.min(per_dim, axis=1)
+
+
+def _chance_fit(resid, var_sum, mean, var_f, zsq, hi):
+    """Max k per row of ``resid`` [X,R] with accumulated variance
+    ``var_sum`` [X,R] such that every dimension passes the quantile
+    check — ``hi`` [X] is the integer mean-fit bound (so k*mean never
+    overflows int32 and feas(hi') only tightens).  Fixed-iteration
+    binary search: identical trip count on device and oracle."""
+    lo = jnp.zeros_like(hi)
+    for _ in range(CHANCE_ITERS):
+        mid = (lo + hi + 1) // 2
+        diff = resid - mid[:, None] * mean[None, :]            # int32 >= 0
+        diff_f = diff.astype(jnp.float32)
+        lhs = zsq * (var_sum + mid[:, None].astype(jnp.float32)
+                     * var_f[None, :])
+        feas = jnp.all(lhs <= diff_f * diff_f, axis=1)
+        lo = jnp.where(feas, mid, lo)
+        hi = jnp.where(feas, hi, mid - 1)
+    return lo
+
+
+def _chance_fit_grid(alloc, mean, var_f, zsq, kd):
+    """Empty-node chance fit over the [G, O] grid: max k of group g on
+    an EMPTY offering o.  With zero accumulated variance the constraint
+    SEPARATES per dimension, so the boundary has a closed form in
+    sqrt-space — per dim, ``k*m + z*sqrt(k*v) <= A`` gives
+    ``sqrt(k) <= 2A / (sqrt(z^2 v + 4mA) + sqrt(z^2 v))`` (the
+    cancellation-free arrangement) — refined by a 4-point monotone
+    correction window under the EXACT predicate, so float rounding in
+    the closed form can never change the result.  ~3x fewer tensor
+    passes than the fixed binary search the fill path uses (this grid
+    is the quantile check's dominant cost at real offering counts)."""
+    A = alloc[None, :, :].astype(jnp.float32)              # [1, O, R]
+    m = mean[:, None, :].astype(jnp.float32)               # [G, 1, R]
+    bv = zsq * var_f[:, None, :]                           # z^2 v
+    den = jnp.sqrt(bv + 4.0 * m * A) + jnp.sqrt(bv)
+    s = jnp.where(den > 0, (2.0 * A) / den, 0.0)
+    k_dim = jnp.where(mean[:, None, :] > 0, jnp.floor(s * s),
+                      jnp.float32(CHANCE_FIT_MAX))
+    k_hat = jnp.clip(jnp.min(k_dim, axis=2).astype(jnp.int32), 0, kd)
+    k = jnp.maximum(k_hat - 2, 0)
+    k0 = k
+    for j in range(1, 5):
+        mid = k0 + j
+        diff = alloc[None, :, :] - mid[:, :, None] * mean[:, None, :]
+        diff_f = diff.astype(jnp.float32)
+        lhs = zsq * (mid[:, :, None].astype(jnp.float32)
+                     * var_f[:, None, :])
+        feas = (mid <= kd) & jnp.all(lhs <= diff_f * diff_f, axis=2)
+        k = k + feas.astype(jnp.int32)
+    return k
+
+
+def _ffd_step_stochastic(off_alloc, off_rank, zsq, state, inputs):
+    """One group through the chance-constrained scan.  Mirrors
+    jax_backend._ffd_step line for line; the mean replaces the request
+    in every capacity term, the open-node fill routes through the
+    quantile check against the node's accumulated variance, and the
+    empty-node fit arrives PRECOMPUTED (``kc_g``, one vectorized grid
+    search before the scan — per-step it would re-search the whole
+    offering axis per group, the dominant quantile-check cost)."""
+    node_off, node_resid, node_var, ptr = state
+    mean, var, count, cap, compat_g, kc_g = inputs
+    var_f = var.astype(jnp.float32)
+
+    N = node_off.shape[0]
+    is_open = node_off >= 0
+    node_compat = jnp.where(is_open,
+                            compat_g[jnp.clip(node_off, 0, None)], False)
+
+    # ---- fill open nodes, first-fit in age order -------------------------
+    hi = jnp.minimum(_fit_counts(node_resid, mean), CHANCE_FIT_MAX)
+    fit = _chance_fit(node_resid, node_var, mean, var_f, zsq, hi)
+    fit = jnp.where(node_compat, fit, 0)
+    fit = jnp.minimum(fit, cap)
+    cumfit = jnp.cumsum(fit) - fit
+    take = jnp.clip(count - cumfit, 0, fit)
+    placed = jnp.sum(take)
+    node_resid = node_resid - take[:, None] * mean[None, :]
+    node_var = node_var + take[:, None].astype(jnp.float32) * var_f[None, :]
+    rem = count - placed
+
+    # ---- open new nodes with the cheapest-per-pod offering ---------------
+    fit_empty = jnp.where(compat_g, kc_g, 0)
+    fit_empty = jnp.minimum(fit_empty, cap)
+    fit_empty = jnp.minimum(fit_empty, rem)
+    cpp = jnp.where(fit_empty > 0, off_rank / fit_empty.astype(jnp.float32),
+                    jnp.inf)
+    best = jnp.argmin(cpp).astype(jnp.int32)
+    bf = fit_empty[best]
+
+    n_new = jnp.where(bf > 0, -(-rem // jnp.maximum(bf, 1)), 0)
+    n_new = jnp.minimum(n_new, N - ptr)
+    idx = jnp.arange(N, dtype=jnp.int32)
+    new_pos = idx - ptr
+    is_new = (new_pos >= 0) & (new_pos < n_new)
+    pods_new = jnp.where(is_new, jnp.clip(rem - new_pos * bf, 0, bf), 0)
+    opened = is_new & (pods_new > 0)
+    node_off = jnp.where(opened, best, node_off)
+    node_resid = jnp.where(
+        opened[:, None],
+        off_alloc[best][None, :] - pods_new[:, None] * mean[None, :],
+        node_resid)
+    node_var = jnp.where(
+        opened[:, None],
+        pods_new[:, None].astype(jnp.float32) * var_f[None, :],
+        node_var)
+    ptr = ptr + jnp.sum(opened.astype(jnp.int32))
+    placed_new = jnp.sum(pods_new)
+    unplaced_g = rem - placed_new
+    assign_g = take + pods_new
+    return (node_off, node_resid, node_var, ptr), (assign_g, unplaced_g)
+
+
+def _right_size_stochastic(node_off, load_mean, load_var, assign, compat,
+                           off_alloc, off_rank, zsq):
+    """Per-node cheapest compatible offering whose capacity passes the
+    quantile check for the node's FINAL (mean, variance) load.  Same
+    structure as jax_backend._right_size; the fit test gains the
+    variance term (elementwise square-compare — no float reductions,
+    so the oracle mirrors bit-exactly)."""
+    N = node_off.shape[0]
+    is_open = node_off >= 0
+    safe_off = jnp.clip(node_off, 0, None)
+    present = (assign > 0).astype(jnp.float32)               # [G, N]
+    incompat = (~compat).astype(jnp.float32)                 # [G, O]
+    incompat_count = jnp.einsum("gn,go->no", present, incompat,
+                                preferred_element_type=jnp.float32)
+    all_compat = incompat_count < 0.5                        # [N, O]
+    diff = off_alloc[None, :, :] - load_mean[:, None, :]     # [N, O, R]
+    diff_f = diff.astype(jnp.float32)
+    chance_ok = jnp.all((diff >= 0)
+                        & (zsq * load_var[:, None, :] <= diff_f * diff_f),
+                        axis=2)                              # [N, O]
+    candidate = all_compat & chance_ok & is_open[:, None]
+    rank_eff = jnp.broadcast_to(off_rank[None, :], (N, off_rank.shape[0]))
+    cand_price = jnp.where(candidate, rank_eff, jnp.inf)
+    best = jnp.argmin(cand_price, axis=1).astype(jnp.int32)
+    best_price = jnp.min(cand_price, axis=1)
+    cur_price = jnp.take_along_axis(rank_eff, safe_off[:, None],
+                                    axis=1)[:, 0]
+    improve = is_open & (best_price < cur_price - 1e-9)
+    return jnp.where(improve, best, node_off)
+
+
+def _empty_fit_grids(mean, var, off_alloc, zsq):
+    """(kd [G, O], kc [G, O]): the deterministic mean fit and the
+    chance-constrained fit of each group on each EMPTY offering.  Pure
+    per-problem constants (mean, var, catalog, epsilon) — computed ONCE
+    per problem by :func:`build_fit_grids` and kept device-resident in
+    the prepared-dispatch template (the device-catalog pattern), so the
+    warm solve loop re-dispatches them as inputs instead of recomputing
+    the [G, O, R] grid every window."""
+    var_f = var.astype(jnp.float32)
+    per_dim = jnp.where(mean[:, None, :] > 0,
+                        off_alloc[None, :, :]
+                        // jnp.maximum(mean[:, None, :], 1), _BIG)
+    kd = jnp.minimum(jnp.min(per_dim, axis=2), CHANCE_FIT_MAX)   # [G, O]
+    kc = _chance_fit_grid(off_alloc, mean, var_f, zsq, kd)
+    return kd, kc
+
+
+@functools.partial(jax.jit, static_argnames=("G", "z_bp"))
+def build_fit_grids(sto, off_alloc, *, G: int, z_bp: int):
+    """Device-side grid build from the packed stochastic suffix — one
+    call per (problem, catalog) at first dispatch; the returned device
+    arrays ride every later solve of the window as plain inputs."""
+    from karpenter_tpu.apis.pod import NUM_RESOURCES
+
+    half = G * NUM_RESOURCES
+    mean = sto[:half].reshape(G, NUM_RESOURCES)
+    var = sto[half:2 * half].reshape(G, NUM_RESOURCES)
+    return _empty_fit_grids(mean, var, off_alloc,
+                            jnp.float32(zsq_value(z_bp)))
+
+
+def _risk_words(var, count, unplaced, compat, kd, kc):
+    """int32 [G] with ONLY the overcommit_risk bit: set for a live
+    unplaced group carrying variance whose chance fit on some compatible
+    offering is STRICTLY below its deterministic mean fit — the
+    variance buffer, not the mean, is what blocked density there.
+    Mirrored in explain/greedy.reason_words (the parity contract)."""
+    from karpenter_tpu.explain import BIT
+
+    has_var = jnp.any(var > 0, axis=1)
+    hit = jnp.any(compat & (kc < kd), axis=1) & has_var \
+        & (count > 0) & (unplaced > 0)
+    return jnp.where(hit, jnp.int32(1 << BIT["overcommit_risk"]),
+                     0).astype(jnp.int32)
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("G", "O", "U", "N", "z_bp",
+                                    "right_size", "compact", "dense16",
+                                    "coo16"),
+                   donate_argnames=("packed", "sto"))
+def solve_packed_stochastic(packed, sto, kd, kc, off_alloc, off_price,
+                            off_rank, *,
+                            G: int, O: int, U: int, N: int, z_bp: int,
+                            right_size: bool = True, compact: int = 0,
+                            dense16: bool = False, coo16: bool = False):
+    """Packed-I/O chance-constrained solve.  Buffer contract identical
+    to ``solve_packed`` (the deterministic fallback re-dispatches the
+    same ``packed`` buffer), plus the donated stochastic suffix ``sto``
+    (mean/var rows, stochastic/encode.py) and the per-problem
+    device-resident fit grids ``kd``/``kc`` (:func:`build_fit_grids` —
+    NOT donated, they ride every warm solve of the window).  ``z_bp``
+    is z(eps) in basis points — static, so epsilon changes recompile
+    per distinct bound, never per solve."""
+    from karpenter_tpu.apis.pod import NUM_RESOURCES
+    from karpenter_tpu.solver.jax_backend import (
+        _explain_words, _pack_result, _unpack_problem,
+    )
+
+    zsq = jnp.float32(zsq_value(z_bp))
+    meta, compat_i, rows_g = _unpack_problem(packed, off_alloc, G, O, U)
+    half = G * NUM_RESOURCES
+    mean = sto[:half].reshape(G, NUM_RESOURCES)
+    var = sto[half:2 * half].reshape(G, NUM_RESOURCES)
+    compat = compat_i > 0
+    count, cap = meta[:, 4], meta[:, 5]
+
+    node_off0 = jnp.full((N,), -1, dtype=jnp.int32)
+    node_resid0 = jnp.zeros((N, NUM_RESOURCES), dtype=jnp.int32)
+    node_var0 = jnp.zeros((N, NUM_RESOURCES), dtype=jnp.float32)
+    step = functools.partial(_ffd_step_stochastic, off_alloc, off_rank, zsq)
+    (node_off, node_resid, node_var, _ptr), (assign, unplaced) = lax.scan(
+        step, (node_off0, node_resid0, node_var0, jnp.int32(0)),
+        (mean, var, count, cap, compat, kc))
+    if right_size:
+        load_mean = off_alloc[jnp.clip(node_off, 0, None)] - node_resid
+        node_off = _right_size_stochastic(node_off, load_mean, node_var,
+                                          assign, compat, off_alloc,
+                                          off_rank, zsq)
+    is_open = node_off >= 0
+    cost = jnp.sum(jnp.where(is_open,
+                             off_price[jnp.clip(node_off, 0, None)], 0.0))
+    out = _pack_result(node_off, assign, unplaced, cost, compact, dense16,
+                       coo16)
+    words = _explain_words(meta, rows_g, compat_i,
+                           unplaced.astype(jnp.int32), off_alloc)
+    words = words | _risk_words(var, count, unplaced.astype(jnp.int32),
+                                compat, kd, kc)
+    return jnp.concatenate([out, words])

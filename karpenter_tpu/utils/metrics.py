@@ -460,6 +460,7 @@ UNPLACED_REASONS = (
     "priority_starved",
     "capacity_higher_prio",
     "capacity_exhausted",
+    "overcommit_risk",
 )
 UNPLACED_PODS = Gauge(
     "karpenter_tpu_unplaced_pods",
@@ -515,6 +516,32 @@ RESIDENT_DELTA_BYTES = Histogram(
     "delta pair on warm windows; the full packed buffer on rebuilds)",
     (), buckets=(256, 1 << 10, 1 << 12, 1 << 14, 1 << 16, 1 << 18,
                  1 << 20, 1 << 22))
+
+# Stochastic packing plane (karpenter_tpu/stochastic/): chance-
+# constrained oversubscription + spot-risk-aware placement
+# (docs/design/stochastic.md).
+OVERCOMMIT_SOLVES = Counter(
+    "karpenter_tpu_overcommit_solves_total",
+    "Chance-constrained solve dispatches by mode: stochastic (the "
+    "quantile-check kernel ran), degraded (the kernel failed and the "
+    "window fell back to deterministic requests)", ("mode",))
+OVERCOMMIT_Z = Gauge(
+    "karpenter_tpu_overcommit_z_score",
+    "z(epsilon) multiplier of the most recent stochastic dispatch — the "
+    "variance-buffer strength the violation-probability bound implies "
+    "(0 when the plane never dispatched)", ())
+SPOT_INTERRUPTIONS = Counter(
+    "karpenter_tpu_spot_risk_interruptions_total",
+    "Observed spot interruptions per (instance_type, zone) — the "
+    "ledger-derived history the spot risk model learns from "
+    "(karpenter_tpu/stochastic/risk.py); cardinality bounded by the "
+    "catalog (types x zones)", ("instance_type", "zone"))
+SPOT_RISK_RATE = Gauge(
+    "karpenter_tpu_spot_risk_rate",
+    "Learned spot-interruption rate per (instance_type, zone): observed "
+    "interruptions / exposures in [0, 1]; priced into offering RANKING "
+    "as rank * (1 + lambda * rate) — real cost accounting never moves",
+    ("instance_type", "zone"))
 
 # Device profiling plane (karpenter_tpu/obs/prof.py + obs/watchdog.py):
 # sampled device-time attribution + anomaly-triggered triage bundles

@@ -23,6 +23,7 @@ GANG_PATH = "karpenter_tpu/gang/_snippet.py"
 CTRL_PATH = "karpenter_tpu/controllers/_snippet.py"
 CLOUD_PATH = "karpenter_tpu/cloud/_snippet.py"
 REPACK_PATH = "karpenter_tpu/repack/_snippet.py"
+STOCHASTIC_PATH = "karpenter_tpu/stochastic/_snippet.py"
 
 
 def rules_of(src: str, path: str) -> list:
@@ -231,6 +232,53 @@ def test_gl002_repack_scope_migration_scoring_good():
             # branchless: an infeasible fleet just scores all-zero
             return jnp.where(feas, price, 0)
         """, "GL002", path=REPACK_PATH)
+
+
+def test_gl002_stochastic_scope_quantile_kernel_bad():
+    """The purity family covers karpenter_tpu/stochastic/: a
+    tracer-bool in a broken quantile-check kernel (early-exit on a
+    traced feasibility count) must fire GL002 there, same as in the
+    other solver planes."""
+    assert_flags(
+        """
+        import jax
+        import jax.numpy as jnp
+
+        @jax.jit
+        def chance_fit(resid, var_sum, mean, var, zsq, hi):
+            lo = jnp.zeros_like(hi)
+            for _ in range(12):
+                mid = (lo + hi + 1) // 2
+                diff = resid - mid[:, None] * mean[None, :]
+                lhs = zsq * (var_sum + mid[:, None] * var[None, :])
+                feas = jnp.all(lhs <= diff * diff, axis=1)
+                if feas.sum() == 0:   # traced bool: trace-time error
+                    return lo
+                lo = jnp.where(feas, mid, lo)
+                hi = jnp.where(feas, hi, mid - 1)
+            return lo
+        """, "GL002", path=STOCHASTIC_PATH)
+
+
+def test_gl002_stochastic_scope_quantile_kernel_good():
+    assert_clean(
+        """
+        import jax
+        import jax.numpy as jnp
+
+        @jax.jit
+        def chance_fit(resid, var_sum, mean, var, zsq, hi):
+            lo = jnp.zeros_like(hi)
+            for _ in range(12):
+                mid = (lo + hi + 1) // 2
+                diff = resid - mid[:, None] * mean[None, :]
+                lhs = zsq * (var_sum + mid[:, None] * var[None, :])
+                feas = jnp.all(lhs <= diff * diff, axis=1)
+                # branchless: an all-infeasible window converges to lo
+                lo = jnp.where(feas, mid, lo)
+                hi = jnp.where(feas, hi, mid - 1)
+            return lo
+        """, "GL002", path=STOCHASTIC_PATH)
 
 
 def test_gl003_repack_scope_per_plan_jit_bad():

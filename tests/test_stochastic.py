@@ -1,0 +1,561 @@
+"""Chance-constrained stochastic packing (karpenter_tpu/stochastic,
+ISSUE 13).
+
+Covers the whole plane:
+
+- UsageDistribution / NodePool.overcommit strict validation
+  (table-driven, the parse_priority convention);
+- encode lowering: tensors attach only under an overcommit bound
+  (strict superset), usage splits signature groups, rows ride the FFD
+  sort;
+- z(eps) quantile sanity and the basis-point quantization;
+- DEVICE kernel vs numpy oracle — node_off / assign / unplaced /
+  explain words bit-identical across seeded windows (the parity
+  contract, same discipline as preempt/gang/explain);
+- zero-variance degeneracy: the chance-constrained solve of
+  request-mean/zero-var pods equals the deterministic solve exactly;
+- the independent chance-constraint validator (accepts kernel plans,
+  rejects fabricated over-packed ones) + the Monte-Carlo violation
+  probe;
+- overcommit_risk explain bit: device/oracle agreement, ladder fold,
+  consistency-oracle classification, nearest-miss p99-variance payload;
+- degraded fallback: a broken stochastic kernel degrades the window to
+  deterministic requests, never fails it;
+- the spot-risk model: exact ledger-count reproduction, the empty-
+  ledger zero prior (no NaN, no div0), journal persistence round-trip,
+  ranking-only pricing;
+- the oversubscribe chaos profile end to end (seeded, deterministic).
+"""
+
+import numpy as np
+import pytest
+
+from karpenter_tpu.apis.nodeclaim import NodePool, parse_overcommit
+from karpenter_tpu.apis.pod import (
+    PodSpec, ResourceRequests, UsageDistribution,
+)
+from karpenter_tpu.catalog import (
+    CatalogArrays, InstanceTypeProvider, PricingProvider,
+)
+from karpenter_tpu.cloud.fake import FakeCloud
+from karpenter_tpu.solver import GreedySolver, JaxSolver, encode
+from karpenter_tpu.solver.types import SolverOptions
+from karpenter_tpu.solver.validate import validate_plan
+from karpenter_tpu.stochastic import (
+    CHANCE_FIT_MAX, stochastic_enabled, z_bp_for, z_value, zsq_value,
+)
+from karpenter_tpu.stochastic.greedy import solve_stochastic_host
+from karpenter_tpu.stochastic.risk import (
+    RISK_LAMBDA, SpotRiskModel, refresh_from_ledger,
+)
+from karpenter_tpu.stochastic.validate import (
+    measured_violation_rate, node_chance_violations, violation_bound,
+)
+
+
+@pytest.fixture(scope="module")
+def catalog():
+    cloud = FakeCloud()
+    pricing = PricingProvider(cloud)
+    itp = InstanceTypeProvider(cloud, pricing)
+    arrays = CatalogArrays.build(itp.list())
+    pricing.close()
+    return arrays
+
+
+def _usage(mcpu, mmem, cv):
+    return UsageDistribution(
+        mean=ResourceRequests(mcpu, mmem, 0, 1),
+        var=(int((cv * mcpu) ** 2), int((cv * mmem) ** 2), 0, 0))
+
+
+def _pods(n, seed=0, prefix="sp"):
+    rng = np.random.RandomState(seed)
+    sizes = ((500, 1024), (1000, 2048), (2000, 4096), (4000, 8192))
+    out = []
+    for i in range(n):
+        cpu, mem = sizes[rng.randint(len(sizes))]
+        frac = (0.4, 0.5, 0.6)[rng.randint(3)]
+        cv = (0.1, 0.2, 0.3)[rng.randint(3)]
+        out.append(PodSpec(
+            f"{prefix}{i}", requests=ResourceRequests(cpu, mem, 0, 1),
+            usage=_usage(int(cpu * frac), int(mem * frac), cv)))
+    return out
+
+
+POOL = NodePool(name="default", overcommit=0.05)
+
+
+# -- validation (satellite: parse_priority-style strictness) ---------------
+
+@pytest.mark.parametrize("kwargs", [
+    # negative variance
+    dict(mean=ResourceRequests(100, 100, 0, 1), var=(-1, 0, 0, 0)),
+    # variance without mean
+    dict(mean=ResourceRequests(0, 100, 0, 1), var=(25, 0, 0, 0)),
+    # float variance (also the NaN/inf rejection branch)
+    dict(mean=ResourceRequests(100, 100, 0, 1), var=(1.5, 0, 0, 0)),
+    dict(mean=ResourceRequests(100, 100, 0, 1),
+         var=(float("nan"), 0, 0, 0)),
+    dict(mean=ResourceRequests(100, 100, 0, 1),
+         var=(float("inf"), 0, 0, 0)),
+    # bool variance
+    dict(mean=ResourceRequests(100, 100, 0, 1), var=(True, 0, 0, 0)),
+    # wrong arity
+    dict(mean=ResourceRequests(100, 100, 0, 1), var=(1, 2, 3)),
+    # non-ResourceRequests mean
+    dict(mean=(100, 100, 0, 1), var=(0, 0, 0, 0)),
+])
+def test_usage_validation_rejects(kwargs):
+    with pytest.raises(ValueError):
+        UsageDistribution(**kwargs)
+
+
+@pytest.mark.parametrize("kwargs", [
+    dict(),
+    dict(mean=ResourceRequests(100, 200, 0, 1)),
+    dict(mean=ResourceRequests(100, 200, 0, 1), var=(25, 100, 0, 0)),
+    dict(mean=ResourceRequests(100, 0, 0, 1), var=(25, 0, 0, 0)),
+])
+def test_usage_validation_accepts(kwargs):
+    UsageDistribution(**kwargs)
+
+
+def test_podspec_rejects_non_usage():
+    with pytest.raises(ValueError):
+        PodSpec("p", usage={"mean": 1})
+
+
+@pytest.mark.parametrize("bad", ["0.1", True, float("nan"), float("inf")])
+def test_parse_overcommit_rejects(bad):
+    with pytest.raises(ValueError):
+        parse_overcommit(bad)
+
+
+def test_parse_overcommit_clamps_and_defaults():
+    assert parse_overcommit(None) == 0.0
+    assert parse_overcommit(0) == 0.0
+    assert parse_overcommit(0.05) == 0.05
+    assert parse_overcommit(0.9) == pytest.approx(0.45)
+    assert parse_overcommit(-0.3) == 0.0
+    assert NodePool(name="n", overcommit=2).overcommit == \
+        pytest.approx(0.45)
+
+
+# -- z table ----------------------------------------------------------------
+
+def test_z_value_known_points():
+    assert z_value(0.5) == pytest.approx(0.0, abs=1e-6)
+    assert z_value(0.05) == pytest.approx(1.6449, abs=2e-3)
+    assert z_value(0.01) == pytest.approx(2.3263, abs=2e-3)
+    assert z_value(0.001) == pytest.approx(3.0902, abs=3e-3)
+
+
+def test_z_monotone_and_quantized():
+    zs = [z_value(e) for e in (0.2, 0.1, 0.05, 0.02, 0.01)]
+    assert zs == sorted(zs)
+    assert z_bp_for(0.05) == round(z_value(0.05) * 10000)
+    assert zsq_value(z_bp_for(0.05)) == pytest.approx(
+        z_value(0.05) ** 2, rel=1e-3)
+
+
+# -- encode lowering --------------------------------------------------------
+
+def test_encode_strict_superset(catalog):
+    pods = _pods(20)
+    det = encode(pods, catalog)
+    assert det.group_var is None and det.group_mean is None
+    assert det.overcommit_eps == 0.0
+    assert not stochastic_enabled(det)
+    sto = encode(pods, catalog, POOL)
+    assert stochastic_enabled(sto)
+    assert sto.group_mean.shape == (sto.num_groups, 4)
+    assert sto.group_var.shape == (sto.num_groups, 4)
+    assert sto.overcommit_eps == 0.05
+    # rows aligned with the FFD sort: every group's mean matches its
+    # representative's usage
+    for gi, g in enumerate(sto.groups):
+        rep = g.representative
+        want = rep.usage.mean.as_tuple()
+        assert tuple(sto.group_mean[gi][:3]) == want[:3]
+        assert tuple(sto.group_var[gi]) == rep.usage.var
+
+
+def test_usage_splits_signature_groups(catalog):
+    a = PodSpec("a", requests=ResourceRequests(1000, 2048, 0, 1),
+                usage=_usage(500, 1024, 0.1))
+    b = PodSpec("b", requests=ResourceRequests(1000, 2048, 0, 1),
+                usage=_usage(500, 1024, 0.3))
+    c = PodSpec("c", requests=ResourceRequests(1000, 2048, 0, 1))
+    assert a.constraint_signature() != b.constraint_signature()
+    assert a.constraint_signature() != c.constraint_signature()
+    problem = encode([a, b, c], catalog, POOL)
+    assert problem.num_groups == 3
+
+
+def test_pool_signature_includes_overcommit(catalog):
+    pods = _pods(4, seed=7, prefix="memo")
+    p1 = encode(pods, catalog, NodePool(name="default"))
+    p2 = encode(pods, catalog, NodePool(name="default", overcommit=0.05))
+    assert p1.group_var is None and p2.group_var is not None
+
+
+# -- device/oracle parity ---------------------------------------------------
+
+def _device_run(solver, problem):
+    from karpenter_tpu.solver.jax_backend import (
+        unpack_reason_words, unpack_result,
+    )
+    from karpenter_tpu.stochastic.kernel import (
+        build_fit_grids, solve_packed_stochastic,
+    )
+
+    prep = solver._prepare(problem)
+    off_alloc, off_price, off_rank = solver._device_offerings(
+        problem.catalog, prep.O_pad)
+    kd, kc = build_fit_grids(prep.sto, off_alloc, G=prep.G_pad,
+                             z_bp=prep.z_bp)
+    out = np.asarray(solve_packed_stochastic(
+        prep.packed.copy(), prep.sto.copy(), kd, kc, off_alloc,
+        off_price, off_rank, G=prep.G_pad, O=prep.O_pad, U=prep.U_pad,
+        N=prep.N, z_bp=prep.z_bp, right_size=True))
+    node_off, assign, unplaced, cost = unpack_result(
+        out, prep.G_pad, prep.N, 0)
+    words = unpack_reason_words(out, prep.G_pad, prep.N, 0)
+    return prep, node_off, assign, unplaced, cost, words
+
+
+@pytest.mark.parametrize("seed", range(4))
+def test_kernel_oracle_parity(catalog, seed):
+    solver = JaxSolver(SolverOptions(backend="jax"))
+    problem = encode(_pods(120, seed=seed, prefix=f"par{seed}"),
+                     catalog, POOL)
+    prep, node_off, assign, unplaced, cost, words = _device_run(
+        solver, problem)
+    G = problem.num_groups
+    h_off, h_assign, h_unp, h_cost, h_words = solve_stochastic_host(
+        problem, prep.N, prep.z_bp, right_size=True)
+    assert np.array_equal(node_off, h_off)
+    assert np.array_equal(assign[:G], h_assign)
+    assert np.array_equal(unplaced[:G], h_unp)
+    assert np.array_equal(words[:G], h_words)
+    assert cost == pytest.approx(h_cost, rel=1e-5)
+
+
+def test_zero_variance_equals_deterministic(catalog):
+    """Strict-superset degeneracy: mean=request, var=0 under an
+    overcommit bound packs EXACTLY as the deterministic scan."""
+    base = [PodSpec(f"zv{i}",
+                    requests=ResourceRequests(1000 + 500 * (i % 3),
+                                              2048, 0, 1))
+            for i in range(40)]
+    solver = JaxSolver(SolverOptions(backend="jax"))
+    det_plan = solver.solve_encoded(encode(base, catalog))
+    sto = [PodSpec(f"zv{i}",
+                   requests=ResourceRequests(1000 + 500 * (i % 3),
+                                             2048, 0, 1),
+                   usage=UsageDistribution(
+                       mean=ResourceRequests(1000 + 500 * (i % 3),
+                                             2048, 0, 1)))
+           for i in range(40)]
+    sto_plan = solver.solve_encoded(encode(sto, catalog, POOL))
+    assert solver.last_stats["path"] == "stochastic"
+    assert [(n.instance_type, n.zone, sorted(n.pod_names))
+            for n in sto_plan.nodes] == \
+        [(n.instance_type, n.zone, sorted(n.pod_names))
+         for n in det_plan.nodes]
+    assert sto_plan.total_cost_per_hour == pytest.approx(
+        det_plan.total_cost_per_hour)
+
+
+def test_solve_routes_and_validates(catalog):
+    pods = _pods(200, seed=3, prefix="route")
+    solver = JaxSolver(SolverOptions(backend="jax"))
+    plan = solver.solve_encoded(encode(pods, catalog, POOL))
+    assert solver.last_stats["path"] == "stochastic"
+    assert plan.placed_count + len(plan.unplaced_pods) == len(pods)
+    assert validate_plan(plan, pods, catalog, POOL) == []
+
+
+def test_greedy_chance_packing_validates(catalog):
+    pods = _pods(150, seed=5, prefix="greedy")
+    solver = GreedySolver(SolverOptions(backend="greedy",
+                                        use_native="off"))
+    plan = solver.solve_encoded(encode(pods, catalog, POOL))
+    assert plan.placed_count == len(pods)
+    assert validate_plan(plan, pods, catalog, POOL) == []
+    # the greedy overcommit actually oversubscribes: some node's
+    # REQUEST sum exceeds its allocatable (the density win is real)
+    by_name = {f"{p.namespace}/{p.name}": p for p in pods}
+    oversubscribed = False
+    for node in plan.nodes:
+        alloc = catalog.offering_alloc()[node.offering_index]
+        used = np.zeros(4, dtype=np.int64)
+        for pn in node.pod_names:
+            used += np.asarray(by_name[pn].requests.as_tuple())
+        if (used > alloc).any():
+            oversubscribed = True
+    assert oversubscribed
+
+
+# -- independent validator + violation probe --------------------------------
+
+def test_validator_rejects_overpacked_node(catalog):
+    """A fabricated node whose pooled p-quantile demand exceeds
+    capacity must be flagged by the independent rule."""
+    alloc = np.array([10000, 20000, 0, 100])
+    big = [PodSpec(f"v{i}", requests=ResourceRequests(3000, 4096, 0, 1),
+                   usage=_usage(2400, 4000, 0.3)) for i in range(5)]
+    errs = node_chance_violations(big, alloc, 0.05)
+    assert errs and "chance constraint violated" in errs[0]
+    ok = [PodSpec(f"o{i}", requests=ResourceRequests(3000, 4096, 0, 1),
+                  usage=_usage(1000, 2000, 0.1)) for i in range(5)]
+    assert node_chance_violations(ok, alloc, 0.05) == []
+
+
+def test_measured_violation_rate_respects_bound():
+    alloc = np.array([100000, 200000, 0, 100], dtype=np.int64)
+    pods = [PodSpec(f"m{i}", requests=ResourceRequests(2000, 4096, 0, 1),
+                    usage=_usage(1000, 2048, 0.2)) for i in range(40)]
+    # chance-feasible load at eps=0.05
+    assert node_chance_violations(pods, alloc, 0.05) == []
+    rate, samples = measured_violation_rate([(pods, alloc)], trials=200,
+                                            seed=1)
+    assert samples == 400                  # 2 variance-carrying dims
+    assert rate <= violation_bound(0.05, samples)
+    # deterministic per seed
+    rate2, _ = measured_violation_rate([(pods, alloc)], trials=200,
+                                       seed=1)
+    assert rate == rate2
+
+
+def test_measured_violation_rate_catches_overload():
+    alloc = np.array([20000, 2000000, 0, 100], dtype=np.int64)
+    pods = [PodSpec(f"x{i}", requests=ResourceRequests(2000, 4096, 0, 1),
+                    usage=_usage(1900, 100, 0.3)) for i in range(11)]
+    rate, samples = measured_violation_rate([(pods, alloc)], trials=200,
+                                            seed=1)
+    assert rate > violation_bound(0.05, samples)
+
+
+# -- explain: overcommit_risk ----------------------------------------------
+
+def test_overcommit_risk_bit_and_fold(catalog):
+    from karpenter_tpu.explain import BIT, LADDER, fold_reason, word_for
+
+    assert "overcommit_risk" in LADDER
+    w = word_for("overcommit_risk", "capacity_exhausted")
+    assert fold_reason(w) == "overcommit_risk"
+    assert BIT["overcommit_risk"] == 15
+
+
+def test_overcommit_risk_end_to_end(catalog):
+    """A variance-heavy workload on a clamped node budget: unplaced
+    pods fold to overcommit_risk (device + oracle agree through the
+    plan path) with the p99-variance nearest-miss payload."""
+    pods = [PodSpec(f"r{i}", requests=ResourceRequests(4000, 8192, 0, 1),
+                    usage=_usage(3000, 6000, 0.5)) for i in range(400)]
+    opts = SolverOptions(backend="jax", max_nodes=4, adaptive_nodes=False)
+    solver = JaxSolver(opts)
+    plan = solver.solve_encoded(encode(pods, catalog, POOL))
+    assert plan.unplaced_pods
+    reasons = set(plan.unplaced_reasons.values())
+    assert "overcommit_risk" in reasons
+    risky = next(pn for pn, r in plan.unplaced_reasons.items()
+                 if r == "overcommit_risk")
+    near = plan.unplaced_nearest.get(risky)
+    assert near and "overcommit" in near
+    oc = near["overcommit"]
+    assert oc["epsilon"] == 0.05
+    assert oc["buffer"] and oc["p99_fit_variance"]
+    # greedy oracle path folds identically
+    gplan = GreedySolver(SolverOptions(
+        backend="greedy", use_native="off", max_nodes=4,
+        adaptive_nodes=False)).solve_encoded(encode(pods, catalog, POOL))
+    assert plan.unplaced_reasons == gplan.unplaced_reasons
+    # consistency oracle: overcommit_risk is a DYNAMIC reason
+    from karpenter_tpu.explain.validate import (
+        DYNAMIC_REASONS, check_plan_reasons,
+    )
+
+    assert "overcommit_risk" in DYNAMIC_REASONS
+    assert check_plan_reasons(encode(pods, catalog, POOL), plan) == []
+
+
+# -- degraded fallback ------------------------------------------------------
+
+def test_degraded_falls_back_to_deterministic(catalog, monkeypatch):
+    import karpenter_tpu.stochastic.kernel as kernel_mod
+
+    def boom(*a, **k):
+        raise RuntimeError("injected stochastic kernel fault")
+
+    monkeypatch.setattr(kernel_mod, "solve_packed_stochastic", boom)
+    pods = _pods(30, seed=9, prefix="deg")
+    solver = JaxSolver(SolverOptions(backend="jax"))
+    plan = solver.solve_encoded(encode(pods, catalog, POOL))
+    # degraded to the deterministic scan: every pod still resolves and
+    # the plan is request-feasible (stricter than the chance rule)
+    assert solver.last_stats["path"] in ("scan", "pallas", "resident")
+    assert plan.placed_count == len(pods)
+    assert validate_plan(plan, pods, catalog) == []
+
+
+# -- spot-risk model --------------------------------------------------------
+
+def _fresh_ledger():
+    from karpenter_tpu import obs
+
+    ledger = obs.get_ledger()
+    ledger.reset_interruption_history()
+    return ledger
+
+
+def test_risk_model_reproduces_ledger_counts_exactly():
+    ledger = _fresh_ledger()
+    for _ in range(8):
+        ledger.node_seen("gx3-16x128", "us-south-1")
+    for _ in range(2):
+        ledger.interruption("gx3-16x128", "us-south-1")
+    ledger.node_seen("bx2-4x16", "us-south-2", n=5)
+    model = SpotRiskModel.from_ledger(ledger)
+    assert model.counts() == {("bx2-4x16", "us-south-2"): (0, 5),
+                              ("gx3-16x128", "us-south-1"): (2, 8)}
+    assert model.rate("gx3-16x128", "us-south-1") == 0.25
+    assert model.rate("bx2-4x16", "us-south-2") == 0.0
+    ledger.reset_interruption_history()
+
+
+def test_risk_model_empty_ledger_zero_prior():
+    model = SpotRiskModel.from_ledger(_fresh_ledger())
+    r = model.rate("anything", "anywhere")
+    assert r == 0.0 and r == r            # exactly zero, never NaN
+    assert model.counts() == {}
+    # interruptions with no exposure price as fully risky, not safe
+    model.observe("t", "z", interrupted=3)
+    assert model.rate("t", "z") == 1.0
+
+
+def test_risk_model_journal_round_trip(tmp_path):
+    from karpenter_tpu.recovery.journal import IntentJournal
+
+    model = SpotRiskModel()
+    model.observe("gx3-16x128", "us-south-1", interrupted=3, exposure=12)
+    model.observe("bx2-4x16", "us-south-3", exposure=7)
+    journal = IntentJournal(str(tmp_path / "j.jsonl"), fsync=False)
+    model.save(journal)
+    journal.close()
+    reloaded = SpotRiskModel.load(
+        IntentJournal(str(tmp_path / "j.jsonl"), fsync=False))
+    assert reloaded.counts() == model.counts()
+    assert reloaded.rate("gx3-16x128", "us-south-1") == 0.25
+
+
+def test_risk_pricing_ranks_risky_spot_down(catalog):
+    model = SpotRiskModel()
+    itype, zone, cap = catalog.describe_offering(0)
+    spot_offs = [o for o in range(catalog.num_offerings)
+                 if catalog.describe_offering(o)[2] == "spot"]
+    assert spot_offs
+    o = spot_offs[0]
+    itype, zone, _ = catalog.describe_offering(o)
+    base_rank = catalog.offering_rank_price().copy()
+    model.observe(itype, zone, interrupted=1, exposure=2)   # rate 0.5
+    gen0 = catalog.risk_generation
+    model.price_catalog(catalog)
+    assert catalog.risk_generation == gen0 + 1
+    ranked = catalog.offering_rank_price()
+    assert ranked[o] == pytest.approx(
+        base_rank[o] * (1 + RISK_LAMBDA * 0.5), rel=1e-5)
+    # real cost accounting untouched
+    assert np.array_equal(catalog.off_price, catalog.off_price)
+    # idempotent re-price: unchanged rates do not bump the generation
+    model.price_catalog(catalog)
+    assert catalog.risk_generation == gen0 + 1
+    # clean up the module-scoped catalog for other tests
+    catalog.off_risk = None
+    catalog.risk_generation = gen0 + 2
+
+
+def test_refresh_from_ledger_sets_metric():
+    from karpenter_tpu.utils import metrics
+
+    ledger = _fresh_ledger()
+    ledger.node_seen("gx3-16x128", "us-south-1", n=4)
+    ledger.interruption("gx3-16x128", "us-south-1")
+    model = refresh_from_ledger(ledger)
+    assert model.rate("gx3-16x128", "us-south-1") == 0.25
+    assert "karpenter_tpu_spot_risk_rate" in metrics.render()
+    # reset BOTH the history and the process-global model: the
+    # provisioner prices every catalog from the global model, so a
+    # leftover rate would leak into unrelated tests' plans
+    ledger.reset_interruption_history()
+    refresh_from_ledger(ledger)
+
+
+def test_provisioner_prices_from_global_model(catalog):
+    """The production wiring: a model refreshed from ledger history
+    prices every catalog the provisioner resolves (risk enters offering
+    ranking), and an empty model leaves catalogs untouched."""
+    ledger = _fresh_ledger()
+    spot_offs = [o for o in range(catalog.num_offerings)
+                 if catalog.describe_offering(o)[2] == "spot"]
+    itype, zone, _ = catalog.describe_offering(spot_offs[0])
+    ledger.node_seen(itype, zone, n=2)
+    ledger.interruption(itype, zone)
+    refresh_from_ledger(ledger)
+    from karpenter_tpu.stochastic.risk import get_risk_model
+
+    base = catalog.off_risk
+    get_risk_model().price_catalog(catalog)
+    assert catalog.off_risk is not None and catalog.off_risk[
+        spot_offs[0]] == pytest.approx(RISK_LAMBDA * 0.5)
+    # cleanup: empty history + model, un-price the shared catalog
+    ledger.reset_interruption_history()
+    refresh_from_ledger(ledger)
+    catalog.off_risk = base
+    catalog.risk_generation += 1
+
+
+# -- chance math edges ------------------------------------------------------
+
+def test_chance_fit_clamp_and_empty():
+    from karpenter_tpu.stochastic.greedy import chance_fit_np
+
+    zsq = np.float32(zsq_value(z_bp_for(0.05)))
+    resid = np.array([[1000, 1000, 0, 50]], dtype=np.int64)
+    mean = np.array([1, 1, 0, 1], dtype=np.int64)
+    var = np.zeros(4, dtype=np.float32)
+    hi = np.array([CHANCE_FIT_MAX], dtype=np.int64)
+    k = chance_fit_np(resid, np.zeros((1, 4), np.float32), mean, var,
+                      zsq, hi)
+    # zero variance: the chance fit equals the clamped bound
+    assert int(k[0]) == CHANCE_FIT_MAX
+    # nonzero variance strictly reduces the fit (variance only on the
+    # dims that have capacity — a var>0 dim with zero residual is
+    # rightly infeasible for any k >= 1)
+    var2 = np.array([400.0, 400.0, 0.0, 0.0], dtype=np.float32)
+    k2 = chance_fit_np(resid, np.zeros((1, 4), np.float32), mean, var2,
+                       zsq, np.array([1000], dtype=np.int64))
+    assert 0 < int(k2[0]) < 1000
+
+
+# -- oversubscribe chaos profile -------------------------------------------
+
+@pytest.mark.slow
+def test_oversubscribe_scenario_clean_and_deterministic():
+    from karpenter_tpu.chaos.runner import run_scenario
+
+    res1 = run_scenario("oversubscribe", seed=2, rounds=3)
+    assert res1.ok, res1.render_failure()
+    res2 = run_scenario("oversubscribe", seed=2, rounds=3)
+    assert res1.digest == res2.digest
+
+
+def test_oversubscribe_profile_registered():
+    from karpenter_tpu.chaos.profile import PROFILES
+
+    p = PROFILES["oversubscribe"]
+    assert p.overcommit_eps > 0 and p.pod_usage_mean_frac > 0
+    assert p.preempt_storm_rate > 0          # spot storms included
+    assert not p.fixture                     # runs in the matrix

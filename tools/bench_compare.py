@@ -51,6 +51,13 @@ METRICS: tuple[tuple[str, str], ...] = (
     ("resident.warm_h2d_max_bytes", "lower"),
     ("explain.solve_warm_p50_ms", "lower"),
     ("explain.d2h_fraction", "lower"),
+    # stochastic packing (karpenter_tpu/stochastic): chance-constrained
+    # density vs deterministic requests, quantile-check overhead, and
+    # the measured violation rate against the epsilon bound
+    ("stochastic.solve_warm_p50_ms", "lower"),
+    ("stochastic.density_uplift", "higher"),
+    ("stochastic.overhead_fraction", "lower"),
+    ("stochastic.violation_rate", "lower"),
     # sampled device-time attribution (obs/prof.py): the headline
     # kernel's true device-execute and fetch shares of exec_fetch, and
     # the profiler's own steady-state overhead (<1% acceptance gate)

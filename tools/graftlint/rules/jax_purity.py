@@ -30,6 +30,8 @@ FAMILY_A_SCOPE = (
     "karpenter_tpu/explain/**/*",
     "karpenter_tpu/repack/*",
     "karpenter_tpu/repack/**/*",
+    "karpenter_tpu/stochastic/*",
+    "karpenter_tpu/stochastic/**/*",
     "karpenter_tpu/native.py",
     "bench.py",
 )

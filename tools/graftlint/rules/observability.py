@@ -144,7 +144,7 @@ class TelemetryInKernel(Rule):
     scope = ("karpenter_tpu/solver/*", "karpenter_tpu/parallel/*",
              "karpenter_tpu/preempt/*", "karpenter_tpu/gang/*",
              "karpenter_tpu/resident/*", "karpenter_tpu/explain/*",
-             "karpenter_tpu/repack/*")
+             "karpenter_tpu/repack/*", "karpenter_tpu/stochastic/*")
 
     def check(self, module: SourceModule) -> Iterator[Finding]:
         analysis = analyze(module)
@@ -338,7 +338,8 @@ class BlockingSyncInHotPath(Rule):
     family = "B"
     scope = ("karpenter_tpu/solver/*", "karpenter_tpu/parallel/*",
              "karpenter_tpu/preempt/*", "karpenter_tpu/gang/*",
-             "karpenter_tpu/resident/*", "karpenter_tpu/repack/*")
+             "karpenter_tpu/resident/*", "karpenter_tpu/repack/*",
+             "karpenter_tpu/stochastic/*")
 
     def check(self, module: SourceModule) -> Iterator[Finding]:
         exempt = self._exempt_ranges(module.tree)

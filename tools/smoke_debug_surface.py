@@ -315,6 +315,37 @@ def main() -> int:
               f"profiler self-overhead metered "
               f"({psnap['overhead_fraction']})")
 
+        # demo stochastic cycle (karpenter_tpu/stochastic): one
+        # chance-constrained solve (usage distributions + pool
+        # overcommit) and one ledger-learned spot-risk refresh — the
+        # karpenter_tpu_overcommit_* / spot_risk_* families and the
+        # /debug/risk surface below must then be live, not vacuous
+        print("demo stochastic cycle (chance-constrained overcommit)")
+        from karpenter_tpu.apis.nodeclaim import NodePool
+        from karpenter_tpu.apis.pod import UsageDistribution
+        from karpenter_tpu import obs as _obs
+        from karpenter_tpu.stochastic.risk import refresh_from_ledger
+
+        sto_pods = make_pods(
+            8, name_prefix="sto",
+            requests=ResourceRequests(2000, 4096, 0, 1),
+            usage=UsageDistribution(
+                mean=ResourceRequests(1000, 2048, 0, 1),
+                var=(200 ** 2, 400 ** 2, 0, 0)))
+        sto_plan = jax_solver.solve(SolveRequest(
+            sto_pods, catalog, NodePool(name="default", overcommit=0.05)))
+        check(bool(sto_plan.nodes) and not sto_plan.unplaced_pods,
+              "stochastic demo solve placed every pod")
+        check(jax_solver.last_stats.get("path") == "stochastic",
+              f"stochastic demo rode the chance-constrained kernel "
+              f"(path={jax_solver.last_stats.get('path')!r})")
+        # labeled spot lifecycle history -> learned rates (risk.py)
+        _obs.get_ledger().node_seen("bx2-4x16", "us-south-1", n=10)
+        _obs.get_ledger().interruption("bx2-4x16", "us-south-1")
+        risk_model = refresh_from_ledger(_obs.get_ledger())
+        check(risk_model.rate("bx2-4x16", "us-south-1") == 0.1,
+              "risk model reproduces the ledger's counts (1/10)")
+
         print("GET /metrics")
         status, ctype, body = _get(port, "/metrics")
         check(status == 200, f"/metrics status 200 (got {status})")
@@ -397,6 +428,16 @@ def main() -> int:
               in text, "watchdog breach counter family rendered")
         check("# TYPE karpenter_tpu_triage_bundles_total counter"
               in text, "triage bundle counter family rendered")
+        # stochastic plane families (karpenter_tpu/stochastic +
+        # docs/design/stochastic.md) — live from the demo cycle above
+        check('karpenter_tpu_overcommit_solves_total{mode="stochastic"}'
+              in text, "overcommit solve counter saw the demo dispatch")
+        check("karpenter_tpu_overcommit_z_score" in text,
+              "overcommit z-score gauge rendered")
+        check('karpenter_tpu_spot_risk_rate{instance_type="bx2-4x16"'
+              in text, "spot risk rate gauge carries the learned pair")
+        check('karpenter_tpu_spot_risk_interruptions_total{' in text,
+              "spot interruption counter carries the ledger history")
         # crash-recovery plane families (karpenter_tpu/recovery +
         # docs/design/recovery.md) — live: the journal recorded every
         # create/nominate of the waves above
@@ -523,6 +564,24 @@ def main() -> int:
         check(status == 200 and json.loads(body).get("pods"),
               "/debug/explain?pod= pinpoint lookup returns the entry")
 
+        print("GET /debug/risk")
+        status, ctype, body = _get(port, "/debug/risk")
+        check(status == 200, f"/debug/risk status 200 (got {status})")
+        try:
+            rdoc = json.loads(body)
+        except ValueError as e:
+            rdoc = {}
+            check(False, f"/debug/risk parses as JSON ({e})")
+        check("model" in rdoc and "history" in rdoc,
+              "/debug/risk has model + history blocks")
+        rpairs = (rdoc.get("model") or {}).get("pairs") or []
+        check(any(p.get("instance_type") == "bx2-4x16"
+                  and p.get("rate") == 0.1 for p in rpairs),
+              f"/debug/risk prices the learned pair ({rpairs[:2]})")
+        check((rdoc.get("history") or {}).get("interrupted", {})
+              .get("bx2-4x16/us-south-1") == 1,
+              "/debug/risk history reproduces the ledger counts")
+
         print("GET /statusz")
         status, ctype, body = _get(port, "/statusz")
         check(status == 200, f"/statusz status 200 (got {status})")
@@ -553,6 +612,9 @@ def main() -> int:
         check("breaches" in swd and "bundles" in swd
               and "rate_limit_s" in swd,
               f"/statusz surfaces watchdog state ({swd})")
+        srisk = doc.get("risk") or {}
+        check("pairs" in srisk and "risk_lambda" in srisk,
+              f"/statusz surfaces the spot-risk block ({srisk.keys()})")
         # crash-recovery block (docs/design/recovery.md): live journal
         # stats + what the boot recovery replayed
         srec = doc.get("recovery") or {}
