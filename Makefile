@@ -57,6 +57,10 @@ soak: ## Simulated production day (composed chaos profiles) with SLO gates; repo
 soak-short: ## CI-sized soak (same composition, fewer rounds)
 	$(TEST_ENV) $(PY) -m karpenter_tpu.chaos --soak --short --report-dir .soak-report
 
+.PHONY: soak-sharded-short
+soak-sharded-short: ## CI-sized soak with the sharded solve plane armed (2-shard virtual mesh on CPU, same SLO gates)
+	$(TEST_ENV) $(PY) -m karpenter_tpu.chaos --soak --short --sharded 2 --report-dir .soak-report
+
 .PHONY: smoke
 smoke: ## Debug-surface smoke: real operator, curl-equivalent checks on /metrics /statusz /debug/traces /debug/slo
 	JAX_PLATFORMS=cpu $(PY) tools/smoke_debug_surface.py

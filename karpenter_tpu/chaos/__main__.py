@@ -49,6 +49,11 @@ def main(argv: list[str] | None = None) -> int:
                          "gates (docs/design/observability.md)")
     ap.add_argument("--short", action="store_true",
                     help="with --soak: the CI-sized short day")
+    ap.add_argument("--sharded", type=int, default=0, metavar="S",
+                    help="with --soak: arm the sharded continuous-solve "
+                         "plane with S shards across every segment (the "
+                         "`make soak-sharded-short` gate: same SLOs, "
+                         "2-shard virtual mesh on CPU)")
     ap.add_argument("--report-dir", default=".soak-report",
                     help="with --soak: burn report + span bundle output")
     ap.add_argument("--crash", action="store_true",
@@ -95,7 +100,8 @@ def main(argv: list[str] | None = None) -> int:
 
         res = run_soak(SHORT_DAY if args.short else PRODUCTION_DAY,
                        seed=args.seed if args.seed is not None else 1,
-                       report_dir=args.report_dir)
+                       report_dir=args.report_dir,
+                       shard_count=args.sharded)
         return 0 if res.ok else 1
 
     if args.list_profiles:

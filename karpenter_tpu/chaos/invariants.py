@@ -72,7 +72,7 @@ class InvariantChecker:
                  trace: EventTrace | None = None, preemption=None,
                  gang=None, resident=None, repack=None,
                  explain_violations: list[str] | None = None,
-                 stochastic=None):
+                 stochastic=None, sharded=None):
         self.cluster = cluster
         self.cloud = cloud              # ground truth: the UNWRAPPED fake
         self.unavailable = unavailable
@@ -109,6 +109,10 @@ class InvariantChecker:
         # violation-rate-under-bound and risk-model-consistent
         # invariants (karpenter_tpu/stochastic)
         self.stochastic = stochastic
+        # sharded probe (or None): the shard-skew profile's shadow
+        # service + window/catalog getters — backs the shards-converge
+        # invariant (karpenter_tpu/sharded)
+        self.sharded = sharded
 
     # -- round invariants ----------------------------------------------------
 
@@ -123,6 +127,7 @@ class InvariantChecker:
         out.extend(self._resident_state_fresh())
         out.extend(self._repack_plans_valid())
         out.extend(self._risk_model_consistent())
+        out.extend(self._shards_converge())
         if self.trace is not None:
             self.trace.add("invariants", phase="round", violations=len(out),
                            kinds=sorted({v.invariant for v in out}))
@@ -399,6 +404,56 @@ class InvariantChecker:
                     "repack-plan-valid",
                     f"slice {shape} on {claim_name} does not fit the "
                     f"vacated torus (claimed reopening is false)"))
+        return out
+
+    def _shards_converge(self) -> list[Violation]:
+        """Sharded-plane ground truth (karpenter_tpu/sharded):
+
+        - the routed partition is a disjoint cover (every pending pod
+          on exactly one shard, no split signature group);
+        - the per-shard device-resident tensors AND the host mirror
+          equal a from-scratch rebuild of the window from ClusterState,
+          word for word (the stacked generalization of
+          resident-state-fresh);
+        - the last rebalance decision re-derives exactly from its
+          pressure matrix via the independent numpy oracle, and its
+          migrations actually landed on the receiver;
+        - skew provably drains: a collective that keeps asking for
+          migrations while the donor owns splittable groups and nothing
+          moves for 3 consecutive rounds is stuck, not converging.
+        """
+        probe = self.sharded
+        if probe is None:
+            return []
+        catalog = probe.catalog()
+        if catalog is None:
+            return []
+        from karpenter_tpu.sharded.validate import (
+            partition_violations, rebalance_violations, state_violations,
+        )
+
+        svc = probe.service
+        pods = probe.window_pods()
+        out = [Violation("shards-converge", v)
+               for v in partition_violations(svc, pods)]
+        out.extend(Violation("shards-converge", v)
+                   for v in state_violations(svc, pods, catalog))
+        out.extend(Violation("shards-converge", v)
+                   for v in rebalance_violations(svc, svc.last_decision))
+        dec = svc.last_decision
+        if dec is not None and dec.amount > 0 \
+                and dec.donor != dec.receiver and not dec.moved_keys \
+                and int(dec.pressure[dec.donor, 1]) > 1:
+            probe.stuck_rounds += 1
+            if probe.stuck_rounds >= 3:
+                out.append(Violation(
+                    "shards-converge",
+                    f"rebalance stuck: shard {dec.donor} holds skew "
+                    f"{dec.skew} across {probe.stuck_rounds} rounds "
+                    f"with {int(dec.pressure[dec.donor, 1])} splittable "
+                    f"groups and zero migrations applied"))
+        else:
+            probe.stuck_rounds = 0
         return out
 
     def _risk_model_consistent(self) -> list[Violation]:

@@ -95,6 +95,15 @@ class ChaosProfile:
     pod_usage_mean_frac: float = 0.0
     pod_usage_cv: tuple[float, ...] = ()
     overcommit_eps: float = 0.0
+    # sharded continuous-solve plane (karpenter_tpu/sharded): with
+    # shard_count > 0 the harness shadow-runs a ShardedSolveService
+    # through every pump (admit pending -> stacked shard_map solve ->
+    # rebalance collective) under the shards-converge invariant.
+    # shard_hot_rate makes that fraction of singleton waves carry a
+    # request signature CRAFTED to hash onto shard 0 (hash-hot keys) so
+    # load concentrates and only the rebalance collective can drain it.
+    shard_count: int = 0
+    shard_hot_rate: float = 0.0
     # global live-instance cap imposed on the fake cloud for the chaos
     # window (0 = unlimited); lifts at quiesce.  Demand past the cap is
     # genuine overload: creates fail with quota_exceeded and pending
@@ -216,6 +225,19 @@ PROFILES: dict[str, ChaosProfile] = _profiles(
         # would fight the stochastic packer every round — the
         # oversubscription class owns density here
         disable_controllers=("preemption",)),
+    ChaosProfile(
+        name="shard-skew",
+        description="hash-hot pod keys concentrating load on one shard "
+                    "of the sharded continuous-solve service, under "
+                    "spot storms — the per-shard device-resident "
+                    "tensors must stay word-identical to a "
+                    "ClusterState rebuild and the rebalance collective "
+                    "must provably drain the skew (shards-converge "
+                    "invariant)",
+        shard_count=2, shard_hot_rate=0.75,
+        pod_waves=6, pods_per_wave=(10, 24),
+        preempt_storm_rate=0.35, preempt_storm_frac=0.45,
+        error_rates={"create_instance": 0.08}),
     ChaosProfile(
         name="fragmentation",
         description="scattered accelerator singletons + parked slice "

@@ -90,6 +90,21 @@ class StochasticProbe:
 
 
 @dataclass
+class ShardedProbe:
+    """What the shards-converge invariant needs: the harness's sharded
+    service plus callables re-listing the tracked window and catalog
+    from ClusterState at CHECK time (the rebuild is ground truth, not
+    an echo of what the service saw).  ``stuck_rounds`` accumulates the
+    consecutive rounds the rebalance collective asked for migrations it
+    then failed to apply — skew that provably never drains."""
+
+    service: object
+    window_pods: object       # () -> list[PodSpec]
+    catalog: object           # () -> CatalogArrays | None
+    stuck_rounds: int = 0
+
+
+@dataclass
 class RepackProbe:
     """What the repack-plan-valid invariant needs: the harness's
     DisruptionController (its ``repack_log`` / ``repack_violations`` are
@@ -255,6 +270,16 @@ class ChaosHarness:
         from karpenter_tpu.resident.store import ResidentStore
 
         self.resident = ResidentStore()
+        # sharded continuous-solve plane (karpenter_tpu/sharded): a
+        # shadow service tracked through every pump — admit the pending
+        # window, one stacked shard_map solve, one rebalance collective
+        # tick — under the shards-converge invariant (state rebuilt from
+        # ClusterState word-for-word, skew provably drained)
+        self.sharded = None
+        if profile.shard_count:
+            from karpenter_tpu.sharded import ShardedSolveService
+
+            self.sharded = ShardedSolveService(profile.shard_count)
         # migration-first repack plane (fragmentation profile): the
         # PRODUCTION DisruptionController, defrag scoring live, every
         # executed plan logged for the repack-plan-valid invariant
@@ -315,6 +340,12 @@ class ChaosHarness:
                 catalog=lambda: self.provisioner._catalog_for(
                     self.nodeclass))
             if self.disruption is not None else None,
+            sharded=ShardedProbe(
+                service=self.sharded,
+                window_pods=self._resident_window,
+                catalog=lambda: self.provisioner._catalog_for(
+                    self.nodeclass))
+            if self.sharded is not None else None,
             stochastic=StochasticProbe(
                 eps=profile.overcommit_eps,
                 catalog=lambda: self.provisioner._catalog_for(
@@ -413,6 +444,13 @@ class ChaosHarness:
                 and self.rng_world.random() < self.profile.gang_wave_rate:
             self._inject_gang(round_no, prio)
             return
+        # hash-hot waves (shard-skew profile): craft the wave's request
+        # signature so it HASHES onto shard 0 — load concentrates on one
+        # shard and only the rebalance collective's ownership migrations
+        # can drain it (the skew the shards-converge invariant watches)
+        if self.profile.shard_hot_rate \
+                and self.rng_world.random() < self.profile.shard_hot_rate:
+            cpu, mem = self._hot_requests(cpu, mem)
         # accelerator-consuming singletons (fragmentation profile): each
         # wave pod draws a chip count from the menu — chips fill
         # low-first, so partial fills fragment the tori the parked gangs
@@ -483,6 +521,36 @@ class ChaosHarness:
                        members=size, arrived=len(arrive_now),
                        slice=shape, mode=mode)
 
+    def _hot_requests(self, cpu: int, mem: int) -> tuple[int, int]:
+        """Smallest cpu bump whose request signature hashes to shard 0
+        (deterministic: blake2 content hashing, seeded-stream-free)."""
+        from karpenter_tpu.sharded.router import craft_hot_requests
+
+        return craft_hot_requests(self.profile.shard_count, 0,
+                                  cpu=cpu, mem=mem, count=1)[0]
+
+    def _pump_sharded(self, catalog) -> None:
+        """One shadow beat of the sharded service: admit the pending
+        window, drop resolved pods, one stacked solve, one rebalance
+        collective tick — every number it produces rides the event
+        trace so the determinism digest covers the plane."""
+        from karpenter_tpu.apis.pod import pod_key
+
+        pending = self._resident_window()
+        self.sharded.sync_backlog(pod_key(p) for p in pending)
+        self.sharded.admit(pending)
+        plan = self.sharded.solve_window(catalog)
+        decision = self.sharded.rebalance()
+        self.trace.add("sharded",
+                       shard_pods=list(plan.shard_pods),
+                       nodes=sum(len(p.nodes) for p in plan.plans),
+                       unplaced=sum(len(p.unplaced_pods)
+                                    for p in plan.plans),
+                       skew=decision.skew, donor=decision.donor,
+                       receiver=decision.receiver,
+                       moved=len(decision.moved_keys),
+                       migrations=self.sharded.migrations)
+
     def _resident_window(self) -> list:
         """The window the resident store tracks: pending unnominated
         pods, in collection order (the same selection provision_once
@@ -503,6 +571,8 @@ class ChaosHarness:
         catalog = self.provisioner._catalog_for(self.nodeclass)
         if catalog is not None:
             self.resident.track_window(self._resident_window(), catalog)
+        if self.sharded is not None and catalog is not None:
+            self._pump_sharded(catalog)
         # spot-risk learning loop (stochastic/risk.py): re-derive the
         # model from the ledger's labeled lifecycle history and price
         # expected eviction cost into offering ranking — checked

@@ -107,17 +107,24 @@ class SoakResult:
             and self.gate_proven
 
 
-def _scaled(profile, load: float):
+def _scaled(profile, load: float, shard_count: int = 0):
     lo, hi = profile.pods_per_wave
-    return dataclasses.replace(
-        profile, pods_per_wave=(max(1, round(lo * load)),
-                                max(1, round(hi * load))))
+    kwargs = {"pods_per_wave": (max(1, round(lo * load)),
+                                max(1, round(hi * load)))}
+    if shard_count:
+        # `make soak-sharded-short`: the WHOLE day runs with the
+        # sharded continuous-solve plane armed (shadow service + the
+        # shards-converge invariant every pump) — the SLO gates are
+        # unchanged; a shard-state divergence surfaces as a chaos
+        # violation, which fails the soak like any other
+        kwargs["shard_count"] = shard_count
+    return dataclasses.replace(profile, **kwargs)
 
 
 def run_soak(segments: tuple[SoakSegment, ...] = PRODUCTION_DAY, *,
              seed: int = 1, slos: tuple[SLOSpec, ...] = SOAK_SLOS,
              report_dir: str = ".soak-report",
-             triage_dir: str = ".triage",
+             triage_dir: str = ".triage", shard_count: int = 0,
              echo=print) -> SoakResult:
     """Run the composed production day and gate it on the SLOs.  Every
     segment's flight-recorder spans are dumped as a bundle next to the
@@ -148,7 +155,8 @@ def run_soak(segments: tuple[SoakSegment, ...] = PRODUCTION_DAY, *,
             for i, seg in enumerate(segments):
                 name = f"{i:02d}-{seg.profile}"
                 ledger.set_context(name)
-                profile = _scaled(get_profile(seg.profile), seg.load)
+                profile = _scaled(get_profile(seg.profile), seg.load,
+                                  shard_count)
                 clock = VirtualClock()
                 mono0 = clock.monotonic()
                 since = ledger.sample_count

@@ -66,6 +66,17 @@ def make_solver(options: SolverOptions):
         return ResilientSolver(
             RemoteSolver(options.address or "127.0.0.1:50051", options),
             options)
+    from karpenter_tpu.sharded import sharded_shards
+
+    shards = sharded_shards(options)
+    if shards > 1:
+        # sharded continuous-solve service (karpenter_tpu/sharded/):
+        # streaming admission router + stacked per-shard resident solves
+        # over the shard mesh.  Two degradation layers: the plane's own
+        # host fallback, then the solver-level greedy degrade.
+        from karpenter_tpu.sharded import ShardedSolver
+
+        return ResilientSolver(ShardedSolver(shards, options), options)
     return ResilientSolver(JaxSolver(options), options)
 
 

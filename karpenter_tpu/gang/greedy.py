@@ -16,6 +16,7 @@ from __future__ import annotations
 import time
 
 from karpenter_tpu.gang.encode import GangProblem
+from karpenter_tpu.gang.topology import best_placement, rank_assignment
 from karpenter_tpu.gang.types import GangAssignment, GangNode, GangOptions, GangPlan
 
 
@@ -46,9 +47,11 @@ class GreedyGangPlanner:
             out.placed_gangs.append(gang.name)
             for pn in gang.pod_names:
                 out.placements[pn] = n
+            chips, hop = rank_assignment(catalog, node_off[n], mask)
             assignments.setdefault(n, []).append(GangAssignment(
                 gang=gang.name, placement_mask=mask,
-                pod_names=tuple(gang.pod_names)))
+                pod_names=tuple(gang.pod_names),
+                rank_chips=chips, max_hop=hop))
 
         for gi, gang in enumerate(problem.gangs):
             size = int(problem.gang_size[gi])
@@ -71,11 +74,17 @@ class GreedyGangPlanner:
                 if table is None:
                     mask = 0
                 else:
+                    # rank-aware pick: lowest (hop, index) free placement
+                    # — the same scoring term the batched grid applies
                     row = table.masks[o]
+                    hops = table.hops[o]
+                    best_score = None
                     for p in range(int(table.count[o])):
                         if (int(row[p]) & node_occ[n]) == 0:
-                            mask = int(row[p])
-                            break
+                            score = (int(hops[p]), p)
+                            if best_score is None or score < best_score:
+                                best_score = score
+                                mask = int(row[p])
                 if mask < 0:
                     continue
                 node_occ[n] |= mask
@@ -94,8 +103,9 @@ class GreedyGangPlanner:
                     if best_rank is None or r < best_rank:
                         best, best_rank = o, r
                 if best >= 0:
-                    mask = int(table.masks[best, 0]) if table is not None \
-                        else 0
+                    mask = int(table.masks[best, best_placement(table,
+                                                                best)]) \
+                        if table is not None else 0
                     node_off.append(best)
                     node_occ.append(mask)
                     node_resid.append([int(off_alloc[best, d]) - need[d]

@@ -35,7 +35,9 @@ from functools import lru_cache
 import numpy as np
 
 from karpenter_tpu.gang.encode import GangProblem
-from karpenter_tpu.gang.topology import split_mask_words
+from karpenter_tpu.gang.topology import (
+    best_placement, rank_assignment, split_mask_words,
+)
 from karpenter_tpu.gang.types import GangAssignment, GangNode, GangOptions, GangPlan
 from karpenter_tpu.solver.types import bucket
 
@@ -55,7 +57,7 @@ def _device_free_grid():
 
         @jax.jit
         def free_grid(occ_lo, occ_hi, m_lo, m_hi, valid, resid, need,
-                      label_ok):
+                      label_ok, hops):
             # chip-disjointness decomposes exactly over the two 32-bit
             # mask words: (mask & occ) == 0  <=>  both words AND to zero
             disjoint = ((m_lo & occ_lo[:, None])
@@ -63,14 +65,22 @@ def _device_free_grid():
             free = valid & disjoint                          # [Nn, P]
             cap_ok = (resid >= need[None, :]).all(axis=1)    # [Nn]
             fits = label_ok & cap_ok & free.any(axis=1)
-            first = jnp.where(fits, jnp.argmax(free, axis=1), -1)
+            # rank-aware scoring term: among free placements take the
+            # one minimizing (rank-assignment max hop, index) — one
+            # more column over the same grid, same dispatch
+            P = valid.shape[1]
+            idx = jnp.arange(P, dtype=jnp.int32)[None, :]
+            score = jnp.where(free, hops * jnp.int32(P + 1) + idx,
+                              jnp.int32(2 ** 30))
+            first = jnp.where(fits, jnp.argmin(score, axis=1), -1)
             return fits, first.astype(jnp.int32)
 
         # force one trace so an unusable backend fails HERE, not mid-plan
         free_grid(np.zeros(1, np.int32), np.zeros(1, np.int32),
                   np.zeros((1, 2), np.int32), np.zeros((1, 2), np.int32),
                   np.ones((1, 2), bool), np.zeros((1, 4), np.int32),
-                  np.zeros(4, np.int32), np.ones(1, bool))
+                  np.zeros(4, np.int32), np.ones(1, bool),
+                  np.zeros((1, 2), np.int32))
         return free_grid
     except Exception:  # noqa: BLE001 — device is an optimization, not a dep
         return None
@@ -84,8 +94,10 @@ class GangPlanner:
 
     # -- grid step (the only backend-switched code) -----------------------
 
-    def _free_grid(self, occ, masks, valid, resid, need, label_ok):
-        """(fits bool [Nn], first free placement int [Nn]; -1 = none)."""
+    def _free_grid(self, occ, masks, valid, resid, need, label_ok, hops):
+        """(fits bool [Nn], best free placement int [Nn]; -1 = none) —
+        "best" minimizes (rank-assignment max hop, placement index),
+        the rank-aware scoring term both backends share."""
         Nn, P = valid.shape
         use = self.options.use_device
         if use != "off" and (use == "on" or Nn * P >= _DEVICE_MIN_CELLS):
@@ -113,18 +125,24 @@ class GangPlanner:
                 re_ = np.zeros((Np, resid.shape[1]), np.int32)
                 re_[:Nn] = resid.astype(np.int32)
                 lo = np.zeros(Np, bool); lo[:Nn] = label_ok      # noqa: E702
+                hp = np.zeros((Np, Pp), np.int32)
+                hp[:Nn, :P] = hops.astype(np.int32)
                 from karpenter_tpu.obs.prof import get_profiler
 
                 with get_profiler().sampled("gang-grid") as probe:
                     fits, first = dev(ol, oh, ml, mh, va, re_,
-                                      need.astype(np.int32), lo)
+                                      need.astype(np.int32), lo, hp)
                     probe.dispatched((fits, first))
                 return (np.asarray(fits)[:Nn],
                         np.asarray(first)[:Nn].astype(np.int64))
         free = valid & ((masks & occ[:, None]) == 0)
         cap_ok = (resid >= need[None, :]).all(axis=1)
         fits = label_ok & cap_ok & free.any(axis=1)
-        first = np.where(fits, np.argmax(free, axis=1), -1)
+        score = np.where(free,
+                         hops.astype(np.int64) * (P + 1)
+                         + np.arange(P, dtype=np.int64)[None, :],
+                         2 ** 30)
+        first = np.where(fits, np.argmin(score, axis=1), -1)
         return fits, first.astype(np.int64)
 
     # -- the plan ----------------------------------------------------------
@@ -151,9 +169,11 @@ class GangPlanner:
             out.placed_gangs.append(gang.name)
             for pn in gang.pod_names:
                 out.placements[pn] = n
+            chips, hop = rank_assignment(catalog, node_off[n], mask)
             assignments.setdefault(n, []).append(GangAssignment(
                 gang=gang.name, placement_mask=mask,
-                pod_names=tuple(gang.pod_names)))
+                pod_names=tuple(gang.pod_names),
+                rank_chips=chips, max_hop=hop))
 
         for gi, gang in enumerate(problem.gangs):
             size = int(problem.gang_size[gi])
@@ -176,11 +196,13 @@ class GangPlanner:
                 if table is not None:
                     masks = table.masks[offs]
                     valid = table.valid[offs]
+                    hops = table.hops[offs]
                 else:
                     masks = np.zeros((len(offs), 1), dtype=np.uint64)
                     valid = np.ones((len(offs), 1), dtype=bool)
+                    hops = np.zeros((len(offs), 1), dtype=np.int32)
                 fits, first = self._free_grid(occ, masks, valid, resid,
-                                              need, label_ok)
+                                              need, label_ok, hops)
                 hit = np.nonzero(fits)[0]
                 if hit.size:
                     n = int(hit[0])                   # oldest node first
@@ -194,7 +216,8 @@ class GangPlanner:
             if not placed and compat.any() and len(node_off) < max_nodes:
                 rank = np.where(compat, off_rank.astype(np.float64), np.inf)
                 best = int(np.argmin(rank))           # first min: det. ties
-                mask = int(table.masks[best, 0]) if table is not None else 0
+                mask = int(table.masks[best, best_placement(table, best)]) \
+                    if table is not None else 0
                 node_off.append(best)
                 node_occ.append(mask)
                 node_resid.append(off_alloc[best] - need)

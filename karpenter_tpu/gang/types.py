@@ -38,6 +38,12 @@ class GangAssignment:
     placement_mask: int              # chip bitmask within the node torus
     pod_names: tuple[str, ...]       # ALL members — partiality is
                                      # structurally unrepresentable
+    # rank-aware placement (MPI-style: rank r <-> chip rank_chips[r],
+    # member pod_names[i] takes rank i mod chips): the slice's chips in
+    # the hop-minimizing rank order, and the achieved max ring-hop —
+    # validated by an independent recount in solver/validate.py
+    rank_chips: tuple[int, ...] = ()
+    max_hop: int = 0
 
 
 @dataclass(slots=True)

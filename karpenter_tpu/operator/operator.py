@@ -155,6 +155,12 @@ class Operator:
         # provisioner builds its solver (make_solver reads them once)
         if self.options.resident_enabled:
             self.options.solver.resident = "on"
+        # the sharded flag resolves the same way: make_solver routes the
+        # provisioner's solves through the sharded continuous-solve
+        # service (streaming admission router + stacked per-shard
+        # resident state over the shard mesh, docs/design/sharded.md)
+        if self.options.sharded_shards > 1:
+            self.options.solver.sharded = self.options.sharded_shards
         self.provisioner = Provisioner(
             self.cluster, self.instance_types, self.actuator,
             ProvisionerOptions(solver=self.options.solver,
@@ -276,6 +282,13 @@ class Operator:
         store = getattr(solver, "resident", None)
         if store is not None:
             out["resident"] = store.stats()
+        # sharded-service health (shard count, mesh width, windows,
+        # rebalances/migrations, backlog skew) — ResilientSolver
+        # delegates `service` to the ShardedSolver primary; absent when
+        # the sharded plane is off
+        service = getattr(solver, "service", None)
+        if service is not None and hasattr(service, "stats"):
+            out["sharded"] = service.stats()
         # crash-recovery block: journal health + what the last restart
         # recovery replayed/fenced (docs/design/recovery.md)
         recovery = {"journal": self.journal.stats()}

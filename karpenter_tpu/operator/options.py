@@ -70,6 +70,12 @@ class Options:
     # preemption/gang/repack — it changes what lives on device between
     # windows and how the repack plane snapshots occupancy
     resident_enabled: bool = False         # KARPENTER_ENABLE_RESIDENT
+    # sharded continuous-solve service (karpenter_tpu/sharded/,
+    # docs/design/sharded.md): opt-in like resident — 0 = off, N > 1 =
+    # shard cluster state across N per-shard device-resident buffers
+    # behind the streaming admission router
+    sharded_shards: int = 0                # KARPENTER_ENABLE_SHARDED /
+                                           # KARPENTER_SHARDS
     repack_min_savings_percent: int = 15   # apply repack only above this
     spot_discount_percent: int = 60        # spot = % of on-demand (options.go:76)
     metrics_port: int = 0                  # 0 = metrics server disabled
@@ -130,6 +136,9 @@ class Options:
                                          False),
             repack_enabled=_getb(env, "KARPENTER_ENABLE_REPACK", False),
             resident_enabled=_getb(env, "KARPENTER_ENABLE_RESIDENT", False),
+            sharded_shards=(_geti(env, "KARPENTER_SHARDS", 2)
+                            if _getb(env, "KARPENTER_ENABLE_SHARDED",
+                                     False) else 0),
             repack_min_savings_percent=_geti(
                 env, "KARPENTER_REPACK_MIN_SAVINGS_PERCENT", 15),
             spot_discount_percent=_geti(env, "KARPENTER_SPOT_DISCOUNT_PERCENT",

@@ -1,11 +1,11 @@
-from karpenter_tpu.parallel.mesh import fleet_mesh, solver_mesh
+from karpenter_tpu.parallel.mesh import fleet_mesh, shard_mesh, solver_mesh
 from karpenter_tpu.parallel.fleet import (
     CooCapacity, FleetProblem, fleet_device_catalog, fleet_pack_inputs,
     fleet_parse_outputs, fleet_solve, fleet_solve_pallas,
     fleet_solve_pallas_sharded, fleet_solve_sharded_offerings,
 )
 
-__all__ = ["fleet_mesh", "solver_mesh", "CooCapacity", "FleetProblem",
-           "fleet_device_catalog", "fleet_pack_inputs",
+__all__ = ["fleet_mesh", "shard_mesh", "solver_mesh", "CooCapacity",
+           "FleetProblem", "fleet_device_catalog", "fleet_pack_inputs",
            "fleet_parse_outputs", "fleet_solve", "fleet_solve_pallas",
            "fleet_solve_pallas_sharded", "fleet_solve_sharded_offerings"]

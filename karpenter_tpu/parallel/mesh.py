@@ -18,6 +18,7 @@ from jax.sharding import Mesh
 
 FLEET_AXIS = "fleet"
 OFFER_AXIS = "offer"
+SHARD_AXIS = "shard"
 
 
 def fleet_mesh(n_devices: int | None = None,
@@ -27,6 +28,31 @@ def fleet_mesh(n_devices: int | None = None,
         devices = jax.devices()
     devices = list(devices)[:n_devices] if n_devices else list(devices)
     return Mesh(np.array(devices), (FLEET_AXIS,))
+
+
+def shard_mesh(num_shards: int, devices: Sequence | None = None) -> Mesh:
+    """1D mesh for the sharded continuous-solve service
+    (karpenter_tpu/sharded): ``num_shards`` logical shards mapped onto
+    up to ``num_shards`` devices.
+
+    Degradation is explicit, never an error: when the host has fewer
+    devices than shards (the 1-device CPU case included), the mesh spans
+    the LARGEST divisor of ``num_shards`` that fits the device count and
+    each device carries ``num_shards / mesh_size`` shards via the vmap
+    inside the shard_map body — shard semantics (and the per-shard plan
+    bits) are identical either way, only the parallel width changes.
+    A 2-shard "virtual mesh" on a 1-device CPU host is exactly this
+    degenerate case, pinned by tests/test_parallel.py.
+    """
+    if num_shards < 1:
+        raise ValueError(f"num_shards must be >= 1, got {num_shards}")
+    devices = list(devices) if devices is not None else jax.devices()
+    width = 1
+    for d in range(min(num_shards, len(devices)), 0, -1):
+        if num_shards % d == 0:
+            width = d
+            break
+    return Mesh(np.array(devices[:width]), (SHARD_AXIS,))
 
 
 def solver_mesh(fleet: int, offer: int, devices: Sequence | None = None) -> Mesh:

@@ -266,23 +266,23 @@ class IntentJournal(NullJournal):
         # record composed but never written — exactly a torn write
         crashpoints.hit("journal.append")
         with self._lock:
-            fh = self._handle()
+            fh = self._handle_locked()
             fh.write(json.dumps(rec, separators=(",", ":")) + "\n")
             fh.flush()
             self._records += 1
             self._unsynced += 1
             metrics.JOURNAL_RECORDS.labels(rec["rec"]).inc()
             if durable or self._unsynced >= self.fsync_interval:
-                self._fsync()
+                self._fsync_locked()
             if self._records > self.max_records:
                 self._compact_locked()
 
-    def _fsync(self) -> None:
+    def _fsync_locked(self) -> None:
         if self._fsync_enabled and self._fh is not None:
             os.fsync(self._fh.fileno())
         self._unsynced = 0
 
-    def _handle(self) -> io.TextIOBase:
+    def _handle_locked(self) -> io.TextIOBase:
         if self._fh is None:
             self._fh = open(self.path, "a", encoding="utf-8")
         return self._fh
@@ -291,7 +291,7 @@ class IntentJournal(NullJournal):
         with self._lock:
             if self._fh is not None:
                 self._fh.flush()
-                self._fsync()
+                self._fsync_locked()
             metrics.JOURNAL_BYTES.set(self._size())
 
     def close(self) -> None:

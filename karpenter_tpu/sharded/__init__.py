@@ -1,0 +1,41 @@
+"""karpenter_tpu.sharded — the sharded continuous-solve service.
+
+Partitions cluster state (pending backlog, per-shard resident solve
+buffers) across a device mesh behind a streaming admission front-end;
+each window is ONE shard_map dispatch of per-shard incremental solves,
+cross-shard rebalance is an on-device psum collective that migrates
+signature-group ownership, and rank-aware gang placement extends the
+gang plane's slice pick to rank-to-chip assignment (gang/topology.py).
+Opt-in behind ``KARPENTER_ENABLE_SHARDED`` (the preempt/gang/resident
+convention); docs/design/sharded.md.
+"""
+
+from __future__ import annotations
+
+import os
+
+from karpenter_tpu.sharded.degraded import ResilientShardedService
+from karpenter_tpu.sharded.router import ShardRouter, signature_key, stable_shard
+from karpenter_tpu.sharded.service import ShardedSolveService
+from karpenter_tpu.sharded.solver import ShardedSolver
+from karpenter_tpu.sharded.types import RebalanceDecision, ShardedPlan
+
+ENV_FLAG = "KARPENTER_ENABLE_SHARDED"
+ENV_SHARDS = "KARPENTER_SHARDS"
+
+
+def sharded_shards(options=None) -> int:
+    """Resolved shard count: ``SolverOptions.sharded`` when forced (>0),
+    else ``KARPENTER_SHARDS`` when ``KARPENTER_ENABLE_SHARDED`` opts in
+    (default 2), else 0 = off."""
+    forced = getattr(options, "sharded", 0) if options is not None else 0
+    if forced:
+        return int(forced)
+    if os.environ.get(ENV_FLAG, "").lower() in ("1", "true", "yes", "on"):
+        return max(int(os.environ.get(ENV_SHARDS, "2") or 2), 1)
+    return 0
+
+
+__all__ = ["ShardedSolveService", "ResilientShardedService", "ShardRouter",
+           "ShardedSolver", "ShardedPlan", "RebalanceDecision",
+           "signature_key", "stable_shard", "sharded_shards"]

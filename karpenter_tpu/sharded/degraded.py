@@ -1,0 +1,83 @@
+"""Sharded degraded mode: host fallback instead of a failed window.
+
+Mirrors ``solver/degraded.ResilientSolver`` / ``gang/degraded``: a
+failed stacked dispatch (dead mesh, Mosaic fault, shape blow-up) must
+never fail the window — the wrapper invalidates the stacked resident
+state (the failed dispatch may have poisoned the donated buffer) and
+re-solves every shard through the greedy host oracle with an ``ERRORS``
+breadcrumb, so dashboards see the degradation while placement keeps
+working.
+"""
+
+from __future__ import annotations
+
+from karpenter_tpu.sharded.types import RebalanceDecision, ShardedPlan
+from karpenter_tpu.utils import metrics
+from karpenter_tpu.utils.logging import get_logger
+
+log = get_logger("sharded.degraded")
+
+
+class ResilientShardedService:
+    """Wraps a :class:`ShardedSolveService`; delegates everything,
+    degrades failed windows and rebalance ticks."""
+
+    def __init__(self, primary):
+        self.primary = primary
+        self.degraded_windows = 0
+        self.degraded_rebalances = 0
+
+    def __getattr__(self, name: str):
+        return getattr(self.primary, name)
+
+    def solve_window(self, catalog, nodepool=None, pods=None) -> ShardedPlan:
+        if pods is None:
+            pods = self.primary.backlog_pods()
+        try:
+            return self.primary.solve_window(catalog, nodepool, pods)
+        except Exception as e:  # noqa: BLE001 — any backend fault degrades
+            log.warning("sharded window degraded to host fallback",
+                        error=str(e)[:200])
+            metrics.ERRORS.labels("sharded", "degraded_window").inc()
+            self.degraded_windows += 1
+            # the donated state may be half-applied: never trust it again
+            self.primary.invalidate("degraded_window")
+            return self.primary.solve_window_host(catalog, nodepool, pods)
+
+    def rebalance(self, pods=None) -> RebalanceDecision:
+        if pods is None:
+            pods = self.primary.backlog_pods()
+        try:
+            return self.primary.rebalance(pods)
+        except Exception as e:  # noqa: BLE001
+            log.warning("rebalance collective degraded to host oracle",
+                        error=str(e)[:200])
+            metrics.ERRORS.labels("sharded", "degraded_rebalance").inc()
+            self.degraded_rebalances += 1
+            return self._rebalance_host(pods)
+
+    def _rebalance_host(self, pods) -> RebalanceDecision:
+        """The numpy oracle applied directly — identical decision by
+        the parity contract, so a degraded tick migrates exactly what
+        the collective would have."""
+        import numpy as np
+
+        from karpenter_tpu.sharded.kernels import rebalance_oracle
+
+        svc = self.primary
+        mat = svc.pressure(pods)
+        donor, receiver, amount, skew = rebalance_oracle(mat)
+        decision = RebalanceDecision(donor=donor, receiver=receiver,
+                                     amount=amount, skew=skew,
+                                     pressure=mat,
+                                     tile=np.zeros((0, 7), np.int32))
+        metrics.SHARD_REBALANCE_SKEW.set(float(skew))
+        if amount > 0 and donor != receiver:
+            decision.moved_keys = svc._apply_migration(pods, decision)
+        with svc._lock:
+            svc.rebalances += 1
+            svc.migrations += len(decision.moved_keys)
+            svc.last_decision = decision
+        if decision.moved_keys:
+            metrics.SHARD_MIGRATIONS.inc(len(decision.moved_keys))
+        return decision

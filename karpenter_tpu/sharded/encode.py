@@ -1,0 +1,96 @@
+"""Lower a routed window to stacked per-shard packed buffers.
+
+Each shard's partition encodes through the ordinary ``solver/encode``
+path (grouping, FFD sort, label-row dedup — nothing forks), then packs
+with :func:`karpenter_tpu.solver.jax_backend.pack_input` exactly as
+``resident/delta.pack_window`` does, except the pad buckets are the
+MAXIMUM over the shards so the per-shard buffers stack into one
+``[S, L]`` tensor for the shard_map dispatch.  Because padding is pure
+zero-fill past each shard's real rows, a shard's padded buffer is
+bit-identical to what ``pack_window`` would produce at the same forced
+buckets — which is what makes the sharded solve bit-identical to the
+single-device path per shard (docs/design/sharded.md, parity contract).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from karpenter_tpu.solver.encode import EncodedProblem, encode, estimate_nodes
+
+
+@dataclass
+class ShardedWindow:
+    """One admitted window, routed and lowered for the stacked solve."""
+
+    problems: list[EncodedProblem]        # one per shard (may be empty)
+    parts: list[list]                     # per-shard PodSpec partitions
+    stacked: np.ndarray                   # int32 [S, L]
+    G_pad: int
+    O_pad: int
+    U_pad: int
+    N: int
+    N_cap: int
+    shard_pods: list[int] = field(default_factory=list)
+
+    @property
+    def num_shards(self) -> int:
+        return int(self.stacked.shape[0])
+
+    @property
+    def shapes(self) -> tuple[int, int, int, int]:
+        return (self.G_pad, self.O_pad, self.U_pad, self.N)
+
+
+def pack_shard_window(problem: EncodedProblem, G_pad: int, O_pad: int,
+                      U_pad: int) -> np.ndarray:
+    """One shard's packed buffer at FORCED pad buckets (the shared
+    ``pack_input`` layout; ``resident/delta.pack_window`` is the
+    self-sizing form of the same lowering)."""
+    from karpenter_tpu.solver.jax_backend import (
+        _pad1, _pad2, dedup_rows, pack_input,
+    )
+
+    if problem.label_rows is not None and problem.label_idx is not None:
+        rows, label_idx = problem.label_rows, problem.label_idx
+    else:
+        label_idx, rows = dedup_rows(problem.compat)
+    return pack_input(_pad2(problem.group_req, G_pad),
+                      _pad1(problem.group_count, G_pad),
+                      _pad1(problem.group_cap, G_pad),
+                      _pad1(label_idx, G_pad),
+                      _pad2(rows, U_pad, O_pad),
+                      group_prio=_pad1(problem.group_prio, G_pad))
+
+
+def encode_shards(parts: list[list], catalog, nodepool=None) -> ShardedWindow:
+    """Encode every shard's partition and stack the packed buffers at
+    the common (max-over-shards) pad buckets."""
+    from karpenter_tpu.solver.jax_backend import dedup_rows
+    from karpenter_tpu.solver.types import (
+        GROUP_BUCKETS, LABELROW_BUCKETS, NODE_BUCKETS, OFFERING_BUCKETS,
+        bucket,
+    )
+
+    problems = [encode(part, catalog, nodepool) for part in parts]
+    G_max = U_max = 1
+    for prob in problems:
+        G_max = max(G_max, prob.num_groups)
+        if prob.label_rows is not None:
+            u = prob.label_rows.shape[0]
+        else:
+            u = dedup_rows(prob.compat)[1].shape[0]
+        U_max = max(U_max, u)
+    G_pad = bucket(G_max, GROUP_BUCKETS)
+    O_pad = bucket(catalog.num_offerings, OFFERING_BUCKETS)
+    U_pad = bucket(U_max, LABELROW_BUCKETS)
+    N_cap = bucket(max(sum(len(p) for p in parts), 1), NODE_BUCKETS)
+    N = max(estimate_nodes(prob, N_cap, NODE_BUCKETS) for prob in problems)
+    stacked = np.stack([pack_shard_window(prob, G_pad, O_pad, U_pad)
+                        for prob in problems])
+    return ShardedWindow(problems=problems, parts=parts, stacked=stacked,
+                         G_pad=G_pad, O_pad=O_pad, U_pad=U_pad, N=N,
+                         N_cap=N_cap,
+                         shard_pods=[len(p) for p in parts])

@@ -1,0 +1,154 @@
+"""Device kernels for the sharded continuous-solve service.
+
+Two entry points, both cached shard_map + jit builders (GL003: a
+per-call rebuild would re-trace and re-compile every window):
+
+- :func:`solve_shards` — every shard's fused delta-apply + packed solve
+  in ONE dispatch over the shard mesh.  The stacked resident state
+  ``[S, L]`` is DONATED (GL006) and returned aliased next to the
+  stacked result buffers, exactly as ``resident/kernels.solve_resident``
+  does for one buffer.  Per shard the body traces the same
+  ``_unpack_problem`` + ``solve_core`` + ``_pack_result_explained``
+  pipeline as ``solve_packed`` — vmapped over the device-local shards —
+  so each shard's result words are bit-identical to the single-device
+  path on that shard's buffer (the parity contract the differential
+  tests and the ``shards-converge`` chaos invariant pin).
+
+- :func:`rebalance_shards` — the cross-shard rebalance collective: a
+  ``psum`` of the per-shard pressure vectors gives every shard the
+  global totals, two-stage pmax/pmin (value, then lowest shard id among
+  ties — the fleet path's deterministic tie-break) picks the donor and
+  receiver shards, and the migration amount is integer arithmetic on
+  the summed pressure.  Every shard computes the identical decision
+  row; the host applies group-ownership moves from it WITHOUT merging
+  any shard state.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from karpenter_tpu.parallel.fleet import shard_map
+from karpenter_tpu.parallel.mesh import SHARD_AXIS
+from karpenter_tpu.solver.jax_backend import (
+    _pack_result_explained, _unpack_problem, solve_core,
+)
+
+_BIG_I32 = jnp.int32(2 ** 31 - 1)
+
+
+@functools.lru_cache(maxsize=32)
+def _solve_shards_jit(mesh: Mesh, S_local: int, G: int, O: int, U: int,
+                      N: int, right_size: bool, compact: int,
+                      dense16: bool, coo16: bool):
+    """Cached jit of the stacked per-shard solve (delta-apply fused)."""
+
+    def one(state_row, didx_row, dval_row, off_alloc, off_price, off_rank):
+        state_row = state_row.at[didx_row].set(dval_row, mode="drop")
+        meta, compat_i, rows_g = _unpack_problem(state_row, off_alloc,
+                                                 G, O, U)
+        node_off, assign, unplaced, cost = solve_core(
+            meta[:, :4], meta[:, 4], meta[:, 5], compat_i > 0,
+            off_alloc, off_price, off_rank, num_nodes=N,
+            right_size=right_size)
+        return state_row, _pack_result_explained(
+            meta, rows_g, compat_i, node_off, assign, unplaced, cost,
+            off_alloc, compact, dense16, coo16)
+
+    def local(states, didx, dval, off_alloc, off_price, off_rank):
+        return jax.vmap(one, in_axes=(0, 0, 0, None, None, None))(
+            states, didx, dval, off_alloc, off_price, off_rank)
+
+    spec, rep = P(SHARD_AXIS), P()
+    return jax.jit(
+        shard_map(local, mesh=mesh,
+                  in_specs=(spec, spec, spec, rep, rep, rep),
+                  out_specs=(spec, spec), check_rep=False),
+        donate_argnums=(0,))
+
+
+def solve_shards(state, didx, dval, off_alloc, off_price, off_rank, *,
+                 mesh: Mesh, G: int, O: int, U: int, N: int,
+                 right_size: bool = True, compact: int = 0,
+                 dense16: bool = False, coo16: bool = False):
+    """Dispatch the stacked sharded solve.  ``state`` int32 [S, L] is
+    donated (pass the device buffer, keep only the returned one);
+    ``didx``/``dval`` int32 [S, D] carry each shard's padded word delta
+    (shard-local indices).  Returns ``(new_state, results [S, Lo])``,
+    both still on device — the caller owns fetch accounting."""
+    S = state.shape[0]
+    width = mesh.shape[SHARD_AXIS]
+    if S % width:
+        raise ValueError(f"shards {S} not divisible by mesh width {width}")
+    f = _solve_shards_jit(mesh, S // width, G, O, U, N, right_size,
+                          compact, dense16, coo16)
+    return f(state, didx, dval, off_alloc, off_price, off_rank)
+
+
+# ---------------------------------------------------------------------------
+# Rebalance collective
+# ---------------------------------------------------------------------------
+
+# pressure vector columns (int32): [0] = pending pods owned by the
+# shard, [1] = signature groups owned, [2] = unplaced pods in the last
+# window (residual pressure).  The donor/receiver pick keys on pods
+# owned; the rest rides along for telemetry and future scoring terms.
+PRESSURE_COLUMNS = 3
+
+
+@functools.lru_cache(maxsize=16)
+def _rebalance_jit(mesh: Mesh, S_local: int):
+    def local(pressure_l):                       # int32 [S_local, K]
+        S = S_local * mesh.shape[SHARD_AXIS]
+        total = lax.psum(jnp.sum(pressure_l, axis=0), SHARD_AXIS)  # [K]
+        my = pressure_l[:, 0]                    # pods owned per shard
+        base = lax.axis_index(SHARD_AXIS).astype(jnp.int32) * S_local
+        ids = base + jnp.arange(S_local, dtype=jnp.int32)
+        gmax = lax.pmax(jnp.max(my), SHARD_AXIS)
+        gmin = lax.pmin(jnp.min(my), SHARD_AXIS)
+        donor = lax.pmin(jnp.min(jnp.where(my == gmax, ids, _BIG_I32)),
+                         SHARD_AXIS)
+        receiver = lax.pmin(jnp.min(jnp.where(my == gmin, ids, _BIG_I32)),
+                            SHARD_AXIS)
+        # move half the imbalance (floor): converges geometrically and
+        # never overshoots into a reverse migration next tick
+        amount = jnp.maximum(gmax - gmin, 0) // 2
+        skew = gmax - gmin
+        mean = total[0] // jnp.int32(S)
+        row = jnp.stack([donor, receiver, amount, skew, gmax, gmin, mean])
+        return jnp.broadcast_to(row[None, :], (S_local, row.shape[0]))
+
+    spec = P(SHARD_AXIS)
+    return jax.jit(shard_map(local, mesh=mesh, in_specs=(spec,),
+                             out_specs=spec, check_rep=False))
+
+
+def rebalance_shards(pressure: np.ndarray, *, mesh: Mesh) -> np.ndarray:
+    """Run the rebalance collective on an int32 [S, K] pressure matrix;
+    returns the int32 [S, 7] decision tile — every row identical by
+    construction (asserted by the parity tests): ``(donor, receiver,
+    amount, skew, max, min, mean)``."""
+    S = pressure.shape[0]
+    width = mesh.shape[SHARD_AXIS]
+    if S % width:
+        raise ValueError(f"shards {S} not divisible by mesh width {width}")
+    f = _rebalance_jit(mesh, S // width)
+    return f(jnp.asarray(pressure.astype(np.int32)))
+
+
+def rebalance_oracle(pressure: np.ndarray) -> tuple[int, int, int, int]:
+    """Numpy parity oracle of the collective's decision: ``(donor,
+    receiver, amount, skew)`` — integer-exact, first-min/first-max
+    tie-breaks matching the two-stage pmin on device."""
+    my = pressure[:, 0].astype(np.int64)
+    gmax, gmin = int(my.max()), int(my.min())
+    donor = int(np.nonzero(my == gmax)[0][0])
+    receiver = int(np.nonzero(my == gmin)[0][0])
+    return donor, receiver, max(gmax - gmin, 0) // 2, gmax - gmin

@@ -1,0 +1,121 @@
+"""Streaming admission front-end: hash pods to shards, own the map.
+
+The router is the ONLY place shard ownership lives.  Pods route by
+their constraint-signature key (the same grouping the encoder applies,
+``apis/pod.py constraint_signature``), so a solve group never splits
+across shards — group ownership is the unit the rebalance collective
+migrates.  The default placement is a stable content hash (blake2b of
+the signature repr: deterministic across processes, seeds, and runs —
+``hash()`` randomization or interning order must never change a shard
+assignment); rebalance migrations override it through :meth:`migrate`
+and the override map IS the mutable state the sharded invariants
+re-derive placement from.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import threading
+
+from karpenter_tpu.apis.pod import PodSpec
+
+
+def signature_key(pod: PodSpec) -> str:
+    """Stable string form of the pod's constraint signature — the
+    routing/grouping key (identical signature => identical key on every
+    host, in every process)."""
+    return repr(pod.constraint_signature())
+
+
+def stable_shard(key: str, num_shards: int) -> int:
+    """Content-hash shard placement: blake2b, NOT ``hash()`` (which is
+    salted per process — a restart would re-shard the whole fleet)."""
+    digest = hashlib.blake2b(key.encode(), digest_size=8).digest()
+    return int.from_bytes(digest, "big") % num_shards
+
+
+def craft_hot_requests(shards: int, shard: int = 0, *, cpu: int = 100,
+                       mem: int = 512, count: int = 1,
+                       limit: int = 4096) -> list[tuple[int, int]]:
+    """``count`` distinct (cpu, mem) request sizes whose constraint
+    signatures all hash onto ``shard`` — the deterministic "hash-hot
+    key" workload generator the chaos profile, bench, smoke, and tests
+    share (hand-rolling the probe loop in each caller is exactly the
+    drift this helper removes).  Scans cpu upward from ``cpu``; raises
+    if ``limit`` probes cannot satisfy ``count`` (cannot happen for
+    shards << limit, by pigeonhole on a uniform hash)."""
+    from karpenter_tpu.apis.pod import ResourceRequests
+
+    out: list[tuple[int, int]] = []
+    for k in range(limit):
+        probe = PodSpec("hot-probe",
+                        requests=ResourceRequests(cpu + k, mem, 0, 1))
+        if stable_shard(signature_key(probe), shards) == shard:
+            out.append((cpu + k, mem))
+            if len(out) == count:
+                return out
+    raise ValueError(f"could not craft {count} hot requests within "
+                     f"{limit} probes")
+
+
+class ShardRouter:
+    """Deterministic pod -> shard placement with migratable ownership."""
+
+    def __init__(self, num_shards: int):
+        if num_shards < 1:
+            raise ValueError(f"num_shards must be >= 1, got {num_shards}")
+        self.num_shards = num_shards
+        self._lock = threading.Lock()
+        # signature key -> shard override (rebalance migrations); absent
+        # keys fall back to the stable hash
+        self._owner: dict[str, int] = {}
+        self.migrations = 0
+
+    def shard_of(self, pod: PodSpec) -> int:
+        return self.shard_of_key(signature_key(pod))
+
+    def shard_of_key(self, key: str) -> int:
+        with self._lock:
+            s = self._owner.get(key)
+        return s if s is not None else stable_shard(key, self.num_shards)
+
+    def partition(self, pods) -> list[list[PodSpec]]:
+        """Disjoint cover of ``pods`` across shards, input order
+        preserved within each shard (the order the per-shard encode
+        sees — part of the determinism contract)."""
+        parts: list[list[PodSpec]] = [[] for _ in range(self.num_shards)]
+        for p in pods:
+            parts[self.shard_of(p)].append(p)
+        return parts
+
+    def migrate(self, key: str, dst: int) -> bool:
+        """Move ownership of one signature group to ``dst``.  Returns
+        False for a no-op (already owned there)."""
+        if not 0 <= dst < self.num_shards:
+            raise ValueError(f"shard {dst} out of range "
+                             f"[0, {self.num_shards})")
+        with self._lock:
+            if self.shard_of_key_locked(key) == dst:
+                return False
+            if stable_shard(key, self.num_shards) == dst:
+                # migrating back home: drop the override instead of
+                # pinning it (the map stays minimal)
+                self._owner.pop(key, None)
+            else:
+                self._owner[key] = dst
+            self.migrations += 1
+            return True
+
+    def shard_of_key_locked(self, key: str) -> int:
+        s = self._owner.get(key)
+        return s if s is not None else stable_shard(key, self.num_shards)
+
+    def overrides(self) -> dict[str, int]:
+        with self._lock:
+            return dict(self._owner)
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {"shards": self.num_shards,
+                    "overrides": len(self._owner),
+                    "migrations": self.migrations}

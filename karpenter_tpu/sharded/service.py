@@ -1,0 +1,495 @@
+"""The sharded continuous-solve service (docs/design/sharded.md).
+
+Ties the plane together: the admission front-end (:class:`ShardRouter`)
+hashes pods to shards, each shard's window state stays DEVICE-RESIDENT
+between windows as one stacked ``[S, L]`` buffer fed by the existing
+delta path (``resident/delta``: changed int32 words only, padded up the
+``DELTA_BUCKETS`` ladder, applied by the fused donated kernel), every
+window is ONE shard_map dispatch over the shard mesh
+(``sharded/kernels.solve_shards``), and the periodic cross-shard
+rebalance is an on-device collective (``rebalance_shards``: psum of the
+per-shard pressure vectors, deterministic donor/receiver pick) whose
+decision the host applies as group-ownership migrations — no host
+merge of shard state, ever.
+
+Parity contract: shard ``s``'s result words are bit-identical to the
+single-device path (``solve_packed``) on shard ``s``'s buffer, so the
+union of per-shard plans equals solving each shard's partition on one
+device, window after window — pinned by the 8-seed churn differential
+in tests/test_sharded.py and the ``shards-converge`` chaos invariant.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+import numpy as np
+
+from karpenter_tpu import obs
+from karpenter_tpu.obs.devtel import get_devtel
+from karpenter_tpu.obs.prof import get_profiler
+from karpenter_tpu.resident.delta import (
+    DELTA_BUCKETS, WindowDelta, pad_delta,
+)
+from karpenter_tpu.sharded.encode import ShardedWindow, encode_shards
+from karpenter_tpu.sharded.router import ShardRouter, signature_key
+from karpenter_tpu.sharded.types import RebalanceDecision, ShardedPlan
+from karpenter_tpu.utils import metrics
+from karpenter_tpu.utils.logging import get_logger
+
+log = get_logger("sharded.service")
+
+
+class ShardedSolveService:
+    """Multi-device resident state + concurrent per-shard solves."""
+
+    def __init__(self, num_shards: int, *, mesh=None,
+                 right_size: bool = True):
+        self.router = ShardRouter(num_shards)
+        self.num_shards = num_shards
+        self.right_size = right_size
+        self._mesh = mesh
+        self._lock = threading.Lock()
+        # stacked resident state: host mirror [S, L] + device buffer,
+        # generation-tracked like resident/store.ResidentBuffer (the
+        # per-shard generalization the tentpole names)
+        self._mirror: np.ndarray | None = None
+        self._dev = None
+        self._generation: tuple | None = None
+        self._shapes: tuple | None = None       # (G_pad, O_pad, U_pad, N)
+        self._pending_reason = ""
+        # streaming admission backlog (keyed, deduped) + last-window
+        # per-shard accounting the rebalance pressure reads
+        self._backlog: dict[str, object] = {}
+        self._last_window: ShardedWindow | None = None
+        self._last_unplaced: list[int] = [0] * num_shards
+        self._device_catalog: dict[tuple, tuple] = {}
+        self.windows = 0
+        self.rebuilds = 0
+        self.invalidations = 0
+        self.rebalances = 0
+        self.migrations = 0
+        self.last_delta: WindowDelta | None = None
+        self.last_decision: RebalanceDecision | None = None
+
+    # -- mesh / catalog ----------------------------------------------------
+
+    @property
+    def mesh(self):
+        if self._mesh is None:
+            from karpenter_tpu.parallel.mesh import shard_mesh
+
+            self._mesh = shard_mesh(self.num_shards)
+        return self._mesh
+
+    def _catalog_tensors(self, catalog, O_pad: int):
+        import jax
+
+        from karpenter_tpu.solver.jax_backend import _pad1, _pad2
+
+        key = (catalog.uid, catalog.generation,
+               catalog.availability_generation, O_pad,
+               getattr(catalog, "risk_generation", 0))
+        cached = self._device_catalog.get(key)
+        if cached is None:
+            # prune dead generations of THIS catalog first (a bumped
+            # generation never comes back — keeping its tensors resident
+            # would hold dead device memory until crowded out), then cap
+            # by count for foreign catalogs
+            for k in [k for k in self._device_catalog
+                      if k[0] == catalog.uid and k != key]:
+                self._device_catalog.pop(k)
+            while len(self._device_catalog) >= 4:
+                self._device_catalog.pop(next(iter(self._device_catalog)))
+            off_alloc = _pad2(catalog.offering_alloc().astype(np.int32),
+                              O_pad)
+            off_price = _pad1(catalog.off_price.astype(np.float32), O_pad)
+            off_rank = _pad1(catalog.offering_rank_price(), O_pad)
+            cached = (jax.device_put(off_alloc), jax.device_put(off_price),
+                      jax.device_put(off_rank))
+            self._device_catalog[key] = cached
+            get_devtel().note_catalog_upload(
+                int(off_alloc.nbytes + off_price.nbytes + off_rank.nbytes))
+        return cached
+
+    # -- streaming admission front-end -------------------------------------
+
+    def admit(self, pods) -> list[int]:
+        """Enqueue pods into the per-shard backlog (deduped by pod key);
+        returns the per-shard admitted counts for this call."""
+        from karpenter_tpu.apis.pod import pod_key
+
+        counts = [0] * self.num_shards
+        with self._lock:
+            for p in pods:
+                key = pod_key(p)
+                if key in self._backlog:
+                    continue
+                self._backlog[key] = p
+                counts[self.router.shard_of(p)] += 1
+        return counts
+
+    def withdraw(self, pod_keys) -> int:
+        """Drop resolved pods from the backlog (bound / deleted)."""
+        n = 0
+        with self._lock:
+            for key in pod_keys:
+                if self._backlog.pop(key, None) is not None:
+                    n += 1
+        return n
+
+    def backlog_pods(self) -> list:
+        with self._lock:
+            return list(self._backlog.values())
+
+    def backlog_keys(self) -> list[str]:
+        with self._lock:
+            return list(self._backlog)
+
+    def sync_backlog(self, live_keys) -> int:
+        """Withdraw every backlog entry NOT in ``live_keys`` — the
+        caller's view of the still-pending set.  Pods that resolved
+        outside this solver (deleted, preempted, bound elsewhere) must
+        not accumulate forever."""
+        live = set(live_keys)
+        return self.withdraw([k for k in self.backlog_keys()
+                              if k not in live])
+
+    # -- resident-state bookkeeping ----------------------------------------
+
+    def invalidate(self, reason: str = "invalidated") -> None:
+        with self._lock:
+            self._mirror = None
+            self._dev = None
+            self._generation = None
+            self._pending_reason = reason
+            self.invalidations += 1
+
+    def _plan_update(self, stacked: np.ndarray, generation: tuple,
+                     shapes: tuple):
+        """The resident decision ladder, REUSED from
+        ``resident/store.plan_update`` (THE one cold/generation/shape/
+        oversized-delta ladder — the sharded plane must not fork its
+        invalidation semantics) over the flat stacked buffer, then the
+        flat word indices split back into shard-local rows.  Returns
+        ``(reason, per_shard_idx)``; non-empty reason = rebuild."""
+        from karpenter_tpu.resident.store import plan_update
+
+        if self._mirror is not None and self._shapes != shapes:
+            # semantic shape key (G/O/U/N pads) — a same-length buffer
+            # with different pads must still rebuild
+            return "shape", None
+
+        from types import SimpleNamespace
+
+        buf = SimpleNamespace(
+            mirror=None if self._mirror is None
+            else self._mirror.reshape(-1),
+            dev=self._dev, generation=self._generation,
+            pending_reason=self._pending_reason)
+        reason, idx = plan_update(buf, stacked.reshape(-1), generation)
+        if reason:
+            return reason, None
+        L = stacked.shape[1]
+        shard = idx // L
+        return "", [idx[shard == s] - s * L
+                    for s in range(stacked.shape[0])]
+
+    # -- the window solve --------------------------------------------------
+
+    def solve_window(self, catalog, nodepool=None, pods=None) -> ShardedPlan:
+        """Route -> encode -> delta-update the stacked resident state ->
+        ONE shard_map dispatch -> per-shard decode.  ``pods`` defaults
+        to the admitted backlog."""
+        import jax
+
+        from karpenter_tpu.sharded.kernels import solve_shards
+
+        t0 = time.perf_counter()
+        if pods is None:
+            pods = self.backlog_pods()
+        parts = self.router.partition(pods)
+        window = encode_shards(parts, catalog, nodepool)
+        if any(p.pref_rows is not None or p.group_var is not None
+               for p in window.problems):
+            # soft-preference and stochastic (chance-constrained)
+            # windows carry semantics the stacked scan kernel does not
+            # implement — dropping them silently would void the
+            # overcommit bound / preference ranking.  Route to the host
+            # oracle, which honors both (the same gate JaxSolver applies
+            # per-path: pallas/flat/resident all defer these windows).
+            return self.solve_window_host(catalog, nodepool, pods,
+                                          window=window)
+        S = window.num_shards
+        L = int(window.stacked.shape[1])
+        stacked = window.stacked
+        gen = (catalog.uid, catalog.generation,
+               catalog.availability_generation)
+        shapes = window.shapes
+        with self._lock:
+            reason, idx = self._plan_update(stacked, gen, shapes)
+            if reason:
+                self._dev = jax.device_put(stacked)
+                self._mirror = stacked.copy()
+                self._generation = gen
+                self._shapes = shapes
+                self._pending_reason = ""
+                self.rebuilds += 1
+                didx = np.full((S, DELTA_BUCKETS[0]), L, dtype=np.int32)
+                dval = np.zeros((S, DELTA_BUCKETS[0]), dtype=np.int32)
+                delta = WindowDelta(mode="rebuild", words=int(stacked.size),
+                                    h2d_bytes=int(stacked.nbytes),
+                                    reason=reason)
+            else:
+                d_max = max(max(int(i.size) for i in idx), 1)
+                pairs = [pad_delta(i, stacked[s][i], L,
+                                   _shared_bucket(d_max))
+                         for s, i in enumerate(idx)]
+                didx = np.stack([p[0] for p in pairs])
+                dval = np.stack([p[1] for p in pairs])
+                words = sum(int(i.size) for i in idx)
+                for s, i in enumerate(idx):
+                    if i.size:
+                        self._mirror[s][i] = stacked[s][i]
+                delta = WindowDelta(
+                    mode="delta" if words else "hit", words=words,
+                    h2d_bytes=int(didx.nbytes + dval.nbytes))
+            state = self._dev
+            self._dev = None      # donated: never dispatch a dead buffer
+        off_alloc, off_price, off_rank = self._catalog_tensors(
+            catalog, window.O_pad)
+        # devtel at DISPATCH level only (GL107): the resident-window
+        # sub-surface stays exclusively the ResidentStore's — the
+        # sharded plane accounts its deltas through its own
+        # karpenter_tpu_sharded_* families and stats()
+        get_devtel().note_dispatch(
+            "sharded-solve",
+            (S, window.G_pad, window.O_pad, window.U_pad, window.N,
+             didx.shape[1], self.right_size),
+            # the stacked state is donated on EVERY dispatch
+            # (donate_argnums on the cached jit) — a rebuild merely
+            # device_puts a fresh buffer first, which is the h2d cost
+            # already accounted above
+            h2d_bytes=delta.h2d_bytes, donated=True)
+        with get_profiler().sampled("sharded-solve") as probe:
+            new_state, out_dev = solve_shards(
+                state, didx, dval, off_alloc, off_price, off_rank,
+                mesh=self.mesh, G=window.G_pad, O=window.O_pad,
+                U=window.U_pad, N=window.N, right_size=self.right_size)
+            probe.dispatched(out_dev)
+        with self._lock:
+            self._dev = new_state
+            self.windows += 1
+            self.last_delta = delta
+            self._last_window = window
+        out_np = np.asarray(out_dev)
+        get_devtel().note_d2h(int(out_np.nbytes))
+        plan = self._decode(window, out_np, backend="sharded")
+        with self._lock:
+            self._last_unplaced = [len(p.unplaced_pods) for p in plan.plans]
+        for s, n in enumerate(window.shard_pods):
+            metrics.SHARD_BACKLOG.labels(str(s)).set(float(n))
+        metrics.SHARDED_SOLVES.labels("device").inc()
+        plan.solve_seconds = time.perf_counter() - t0
+        metrics.SHARDED_SOLVE_DURATION.labels("device").observe(
+            plan.solve_seconds)
+        obs.instant("sharded.window", shards=S, pods=len(pods),
+                    mode=delta.mode, words=delta.words)
+        return plan
+
+    def _decode(self, window: ShardedWindow, out_np: np.ndarray,
+                backend: str) -> ShardedPlan:
+        """Per-shard decode through the shared COO decode path — the
+        same ``decode_plan_entries`` every dense backend uses, so gang
+        chokes / explain folds never fork for the sharded plane."""
+        from karpenter_tpu.solver.encode import decode_plan_entries
+        from karpenter_tpu.solver.jax_backend import (
+            unpack_reason_words, unpack_result,
+        )
+
+        G, N = window.G_pad, window.N
+        plans = []
+        for s, problem in enumerate(window.problems):
+            node_off, assign, unplaced, cost = unpack_result(
+                out_np[s], G, N, 0)
+            words = unpack_reason_words(out_np[s], G, N, 0)
+            gis, ns = np.nonzero(assign)
+            cnts = assign[gis, ns]
+            plans.append(decode_plan_entries(
+                problem, node_off, gis.astype(np.int64),
+                ns.astype(np.int64), cnts.astype(np.int64),
+                unplaced, float(cost), backend, reason_words=words))
+        return ShardedPlan(plans=plans, shard_pods=list(window.shard_pods),
+                           backend=backend)
+
+    # -- host fallback (the degraded wrapper routes here) ------------------
+
+    def solve_window_host(self, catalog, nodepool=None, pods=None,
+                          window: ShardedWindow | None = None) -> ShardedPlan:
+        """Single-device/host path: the same routing and encode, each
+        shard solved one at a time by the greedy host oracle — the
+        degraded contract (``sharded/degraded.py``), the semantic
+        reference the parity tests compare plan content against, and
+        the route for preference/stochastic windows whose semantics the
+        stacked kernel does not carry."""
+        from karpenter_tpu.solver.greedy import GreedySolver
+        from karpenter_tpu.solver.types import SolverOptions
+
+        t0 = time.perf_counter()
+        if window is None:
+            if pods is None:
+                pods = self.backlog_pods()
+            parts = self.router.partition(pods)
+            window = encode_shards(parts, catalog, nodepool)
+        solver = GreedySolver(SolverOptions(backend="greedy"))
+        plans = [solver.solve_encoded(p) for p in window.problems]
+        with self._lock:
+            self._last_window = window
+            self._last_unplaced = [len(p.unplaced_pods) for p in plans]
+            self.windows += 1
+        metrics.SHARDED_SOLVES.labels("host").inc()
+        plan = ShardedPlan(plans=plans, shard_pods=list(window.shard_pods),
+                           backend="sharded-host")
+        plan.solve_seconds = time.perf_counter() - t0
+        metrics.SHARDED_SOLVE_DURATION.labels("host").observe(
+            plan.solve_seconds)
+        return plan
+
+    # -- cross-shard rebalance ---------------------------------------------
+
+    def pressure(self, pods=None) -> np.ndarray:
+        """int32 [S, 3] pressure matrix: pods owned, groups owned,
+        last-window unplaced — the collective's input."""
+        from karpenter_tpu.sharded.kernels import PRESSURE_COLUMNS
+
+        if pods is None:
+            pods = self.backlog_pods()
+        mat = np.zeros((self.num_shards, PRESSURE_COLUMNS), dtype=np.int32)
+        groups: list[set] = [set() for _ in range(self.num_shards)]
+        for p in pods:
+            s = self.router.shard_of(p)
+            mat[s, 0] += 1
+            groups[s].add(signature_key(p))
+        for s, g in enumerate(groups):
+            mat[s, 1] = len(g)
+        with self._lock:
+            for s, u in enumerate(self._last_unplaced[:self.num_shards]):
+                mat[s, 2] = u
+        return mat
+
+    def rebalance(self, pods=None) -> RebalanceDecision:
+        """Run the collective and apply its decision as group-ownership
+        migrations (largest donor groups first, deterministic key
+        tie-break) — the periodic tick of the continuous service."""
+        from karpenter_tpu.sharded.kernels import rebalance_shards
+
+        if pods is None:
+            pods = self.backlog_pods()
+        mat = self.pressure(pods)
+        get_devtel().note_dispatch("rebalance",
+                                   (self.num_shards, mat.shape[1]),
+                                   h2d_bytes=int(mat.nbytes), donated=False)
+        with get_profiler().sampled("rebalance") as probe:
+            tile = rebalance_shards(mat, mesh=self.mesh)
+            probe.dispatched(tile)
+        tile_np = np.asarray(tile)
+        get_devtel().note_d2h(int(tile_np.nbytes))
+        donor, receiver, amount, skew = (int(tile_np[0, 0]),
+                                         int(tile_np[0, 1]),
+                                         int(tile_np[0, 2]),
+                                         int(tile_np[0, 3]))
+        decision = RebalanceDecision(donor=donor, receiver=receiver,
+                                     amount=amount, skew=skew,
+                                     pressure=mat, tile=tile_np)
+        metrics.SHARD_REBALANCE_SKEW.set(float(skew))
+        if amount > 0 and donor != receiver:
+            decision.moved_keys = self._apply_migration(pods, decision)
+        with self._lock:
+            self.rebalances += 1
+            self.migrations += len(decision.moved_keys)
+            self.last_decision = decision
+        if decision.moved_keys:
+            metrics.SHARD_MIGRATIONS.inc(len(decision.moved_keys))
+            obs.instant("sharded.rebalance", donor=donor,
+                        receiver=receiver, skew=skew,
+                        moved=len(decision.moved_keys))
+        return decision
+
+    def _apply_migration(self, pods, decision: RebalanceDecision):
+        """Move whole signature groups (largest pod count first, key
+        ascending on ties) from donor to receiver until the collective's
+        amount is covered — never overshooting past the point where the
+        next move would flip the imbalance."""
+        sizes: dict[str, int] = {}
+        for p in pods:
+            if self.router.shard_of(p) == decision.donor:
+                sizes[signature_key(p)] = sizes.get(signature_key(p), 0) + 1
+        moved: list[str] = []
+        budget = decision.amount
+        for key, n in sorted(sizes.items(), key=lambda kv: (-kv[1], kv[0])):
+            if budget <= 0:
+                break
+            if n > budget:
+                # over-budget move is allowed ONLY as the first move and
+                # ONLY if it still improves the imbalance: moving n pods
+                # changes the donor-receiver gap by 2n, so the new skew
+                # is |skew - 2n| — n >= skew would land a WORSE skew and
+                # the next tick would migrate the same group straight
+                # back (infinite ping-pong, one full resident rebuild
+                # per tick).  A single dominant group that cannot move
+                # without overshooting simply stays put.
+                if moved or n >= decision.skew:
+                    continue
+            if self.router.migrate(key, decision.receiver):
+                moved.append(key)
+                budget -= n
+        if moved:
+            # ownership changed: the routed partition (and therefore the
+            # per-shard packed buffers) changes next window by design —
+            # invalidate so the rebuild is accounted as a migration, not
+            # mistaken for delta noise
+            self.invalidate("rebalance")
+        return moved
+
+    # -- introspection -----------------------------------------------------
+
+    def snapshot_state(self) -> dict | None:
+        """(mirror, device fetch, generation, shapes, overrides) for the
+        ``shards-converge`` invariant — None before any window."""
+        with self._lock:
+            if self._mirror is None or self._dev is None:
+                return None
+            return {"mirror": self._mirror, "device": np.asarray(self._dev),
+                    "generation": self._generation, "shapes": self._shapes,
+                    "overrides": self.router.overrides()}
+
+    def stats(self) -> dict:
+        with self._lock:
+            last = self.last_delta
+            return {
+                "shards": self.num_shards,
+                "mesh_devices": int(self.mesh.shape["shard"]),
+                "windows": self.windows,
+                "rebuilds": self.rebuilds,
+                "invalidations": self.invalidations,
+                "rebalances": self.rebalances,
+                "migrations": self.migrations,
+                "backlog": len(self._backlog),
+                "router": self.router.stats(),
+                "last_mode": last.mode if last else "",
+                "last_delta_words": last.words if last else 0,
+                "last_skew": self.last_decision.skew
+                if self.last_decision else 0,
+            }
+
+
+def _shared_bucket(d_max: int):
+    """All shards pad their delta to ONE rung so the stacked (didx,
+    dval) pair is rectangular (the dispatch shape must be uniform
+    across shards).  ``bucket`` extends past the ladder by next-pow2,
+    so a single shard's delta beyond the last rung still yields one
+    shared rectangular rung instead of a ragged np.stack."""
+    from karpenter_tpu.solver.types import bucket
+
+    return (bucket(max(d_max, 1), DELTA_BUCKETS),)

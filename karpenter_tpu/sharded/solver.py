@@ -1,0 +1,74 @@
+"""Solver-protocol adapter: the sharded service behind ``.solve()``.
+
+``make_solver`` (core/provisioner.py) wraps this in the production
+``ResilientSolver`` exactly like the plain JaxSolver — a failed sharded
+window first degrades inside the plane (host per-shard fallback,
+``sharded/degraded.py``) and, if even that fails, degrades to the
+greedy oracle at the solver layer.  The merged plan flows through the
+unchanged actuation / validation / explain pipeline, so shard-ness is
+invisible downstream of the solve call.
+"""
+
+from __future__ import annotations
+
+import time
+
+from karpenter_tpu import obs
+from karpenter_tpu.solver.types import Plan, SolveRequest, SolverOptions
+from karpenter_tpu.utils import metrics
+
+
+class ShardedSolver:
+    """Routes whole solve requests through the sharded service."""
+
+    def __init__(self, num_shards: int,
+                 options: SolverOptions | None = None):
+        from karpenter_tpu.sharded.degraded import ResilientShardedService
+        from karpenter_tpu.sharded.service import ShardedSolveService
+
+        self.options = options or SolverOptions(backend="jax")
+        self.service = ResilientShardedService(
+            ShardedSolveService(num_shards,
+                                right_size=self.options.right_size))
+        self.last_stats: dict[str, object] = {}
+
+    def solve(self, request: SolveRequest) -> Plan:
+        from karpenter_tpu.apis.pod import pod_key
+
+        t0 = time.perf_counter()
+        with obs.span("solve", backend="sharded",
+                      pods=len(request.pods)) as sp:
+            # the streaming admission front-end tracks the live pending
+            # set: this window IS the current pending ground truth, so
+            # entries that left it any other way (deleted, preempted,
+            # bound elsewhere) are withdrawn first — the backlog must
+            # never outgrow reality — then this window's pods admit and
+            # whatever places below withdraws
+            self.service.sync_backlog(pod_key(p) for p in request.pods)
+            self.service.admit(request.pods)
+            sharded = self.service.solve_window(
+                request.catalog, request.nodepool, request.pods)
+            plan = sharded.merged()
+            placed = {pn for n in plan.nodes for pn in n.pod_names}
+            self.service.withdraw(placed)
+            sp.set("nodes", len(plan.nodes))
+            sp.set("shards", sharded.num_shards)
+            # the periodic rebalance tick: pods left pending ARE the
+            # shard pressure — run the collective on them so a hash-hot
+            # backlog migrates ownership before the next window instead
+            # of skewing one shard forever
+            if plan.unplaced_pods:
+                unplaced = set(plan.unplaced_pods)
+                decision = self.service.rebalance(
+                    [p for p in request.pods if pod_key(p) in unplaced])
+                sp.set("rebalance_moved", len(decision.moved_keys))
+        plan.solve_seconds = time.perf_counter() - t0
+        self.last_stats = {"path": plan.backend,
+                           "shard_pods": list(sharded.shard_pods)}
+        metrics.SOLVE_DURATION.labels("sharded").observe(plan.solve_seconds)
+        metrics.SOLVE_PODS.labels("sharded").observe(len(request.pods))
+        metrics.SOLVE_COST.labels("sharded").set(plan.total_cost_per_hour)
+        return plan
+
+    def stats(self) -> dict:
+        return self.service.stats()

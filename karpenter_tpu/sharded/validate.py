@@ -1,0 +1,124 @@
+"""Independent oracles for the sharded plane (no shared code path with
+the service's own arithmetic — an oracle the service can lie to proves
+nothing).
+
+- :func:`partition_violations` — the routed partition is a DISJOINT
+  COVER: every pod lands on exactly one shard, signature groups never
+  split, every override points at a live shard.
+- :func:`state_violations` — the stacked resident state equals a
+  from-scratch rebuild: re-partition the window's pods with the
+  CURRENT ownership map, re-encode and re-pack each shard at the
+  service's recorded pad shapes, then compare host mirror AND fetched
+  device tensors word-for-word (the ``shards-converge`` chaos
+  invariant's core check).
+- :func:`rebalance_violations` — the applied decision re-derives from
+  the pressure matrix via the numpy oracle (donor/receiver/amount
+  exact), and the moved groups are now owned by the receiver.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from karpenter_tpu.sharded.router import signature_key, stable_shard
+
+
+def partition_violations(service, pods) -> list[str]:
+    out: list[str] = []
+    router = service.router
+    parts = router.partition(pods)
+    seen: dict[str, int] = {}
+    for s, part in enumerate(parts):
+        for p in part:
+            from karpenter_tpu.apis.pod import pod_key
+
+            key = pod_key(p)
+            if key in seen:
+                out.append(f"pod {key} routed to shards {seen[key]} "
+                           f"and {s}")
+            seen[key] = s
+    if len(seen) != len(list(pods)):
+        out.append(f"partition covers {len(seen)} of {len(list(pods))} "
+                   f"pods")
+    # signature groups never split
+    group_shard: dict[str, int] = {}
+    for s, part in enumerate(parts):
+        for p in part:
+            sig = signature_key(p)
+            if group_shard.setdefault(sig, s) != s:
+                out.append(f"signature group {sig[:40]}... split across "
+                           f"shards {group_shard[sig]} and {s}")
+    for key, dst in router.overrides().items():
+        if not 0 <= dst < router.num_shards:
+            out.append(f"override for {key[:40]}... points at dead "
+                       f"shard {dst}")
+        if stable_shard(key, router.num_shards) == dst:
+            out.append(f"override for {key[:40]}... is a no-op (home "
+                       f"shard) — the map must stay minimal")
+    return out
+
+
+def state_violations(service, pods, catalog) -> list[str]:
+    """Word-for-word freshness of the stacked resident state against a
+    ground-truth rebuild (mirror AND device)."""
+    from karpenter_tpu.sharded.encode import pack_shard_window
+    from karpenter_tpu.solver.encode import encode
+
+    snap = service.snapshot_state()
+    if snap is None:
+        return []
+    gen = (catalog.uid, catalog.generation,
+           catalog.availability_generation)
+    out: list[str] = []
+    if snap["generation"] != gen:
+        return [f"sharded state generation {snap['generation']} != "
+                f"catalog generation {gen} (missed invalidation)"]
+    G_pad, O_pad, U_pad, _N = snap["shapes"]
+    parts = service.router.partition(pods)
+    fresh = np.stack([pack_shard_window(encode(part, catalog), G_pad,
+                                        O_pad, U_pad)
+                      for part in parts])
+    mirror = snap["mirror"]
+    if mirror.shape != fresh.shape:
+        return [f"sharded mirror shape {mirror.shape} != rebuild shape "
+                f"{fresh.shape}"]
+    for name, got in (("host mirror", mirror),
+                      ("device tensors", np.asarray(snap["device"]))):
+        if not np.array_equal(got, fresh):
+            for s in range(fresh.shape[0]):
+                diff = int(np.count_nonzero(got[s] != fresh[s]))
+                if diff:
+                    out.append(f"shard {s} {name} diverged from a fresh "
+                               f"ClusterState rebuild ({diff} words "
+                               f"differ)")
+    return out
+
+
+def rebalance_violations(service, decision) -> list[str]:
+    """Re-derive the collective's decision from its recorded pressure
+    matrix; check the applied ownership moves."""
+    from karpenter_tpu.sharded.kernels import rebalance_oracle
+
+    if decision is None:
+        return []
+    out: list[str] = []
+    donor, receiver, amount, skew = rebalance_oracle(decision.pressure)
+    if (donor, receiver, amount, skew) != (decision.donor,
+                                           decision.receiver,
+                                           decision.amount, decision.skew):
+        out.append(f"rebalance decision ({decision.donor}, "
+                   f"{decision.receiver}, {decision.amount}, "
+                   f"{decision.skew}) != host re-derivation "
+                   f"({donor}, {receiver}, {amount}, {skew})")
+    if decision.tile.size:
+        rows = decision.tile[:, :4]
+        if not (rows == rows[0]).all():
+            out.append("rebalance decision tile differs across shards — "
+                       "the collective must replicate one decision")
+    owner = service.router
+    for key in decision.moved_keys:
+        got = owner.shard_of_key(key)
+        if got != decision.receiver:
+            out.append(f"migrated group {key[:40]}... owned by shard "
+                       f"{got}, decision said {decision.receiver}")
+    return out

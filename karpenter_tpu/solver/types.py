@@ -60,6 +60,11 @@ class SolverOptions:
                                     # defers to KARPENTER_ENABLE_RESIDENT
                                     # (opt-in, the preempt/gang
                                     # convention); "on"/"off" force it
+    sharded: int = 0                # sharded continuous-solve service
+                                    # (karpenter_tpu/sharded/): shard
+                                    # count; 0 defers to the
+                                    # KARPENTER_ENABLE_SHARDED /
+                                    # KARPENTER_SHARDS env opt-in
     address: str = ""               # backend "remote": solver sidecar
                                     # gRPC address (host:port)
 

@@ -254,6 +254,38 @@ def validate_gang_plan(plan, pods: Sequence[PodSpec], catalog: CatalogArrays,
                     errors.append(f"node{ni}: gang {a.gang} slice overlaps "
                                   f"another gang's chips")
                 occupied |= a.placement_mask
+                # rank-aware assignment: the rank->chip map must be a
+                # bijection onto exactly the slice's chips, and the
+                # claimed max hop must match an independent recount
+                # over the torus geometry (and never exceed the
+                # provable optimum for the block)
+                if a.rank_chips:
+                    from karpenter_tpu.gang.topology import (
+                        _block_dims, max_hop_of_chips, optimal_max_hop,
+                    )
+
+                    torus = tuple(catalog.type_torus[t]) \
+                        if t < len(catalog.type_torus) else ()
+                    mask_bits = {c for c in range(64)
+                                 if (a.placement_mask >> c) & 1}
+                    if set(a.rank_chips) != mask_bits \
+                            or len(a.rank_chips) != len(mask_bits):
+                        errors.append(
+                            f"node{ni}: gang {a.gang} rank assignment is "
+                            f"not a bijection onto the slice's chips")
+                    else:
+                        recount = max_hop_of_chips(torus, a.rank_chips)
+                        if recount != a.max_hop:
+                            errors.append(
+                                f"node{ni}: gang {a.gang} claims max hop "
+                                f"{a.max_hop}, recount says {recount}")
+                        bound = optimal_max_hop(
+                            _block_dims(torus, a.placement_mask))
+                        if recount > bound:
+                            errors.append(
+                                f"node{ni}: gang {a.gang} rank assignment "
+                                f"hop {recount} exceeds the optimal bound "
+                                f"{bound} for its block")
             for pn in a.pod_names:
                 if pn in seen:
                     errors.append(f"pod {pn} assigned twice")

@@ -412,6 +412,28 @@ REPACK_SAVINGS_FRACTION = Gauge(
     "karpenter_tpu_repack_savings_fraction",
     "Savings fraction of the most recent actuated repack migration plan "
     "(drained node cost / fleet cost at plan time)", ())
+# Sharded continuous-solve service (karpenter_tpu/sharded/).
+SHARDED_SOLVES = Counter(
+    "karpenter_tpu_sharded_solves_total",
+    "Sharded solve windows by mode (device = one stacked shard_map "
+    "dispatch over the shard mesh; degraded = per-shard host fallback "
+    "after a failed dispatch)", ("mode",))
+SHARD_BACKLOG = Gauge(
+    "karpenter_tpu_shard_backlog_pods",
+    "Pending pods owned per shard at the last admitted window (the "
+    "pressure column the rebalance collective keys on)", ("shard",))
+SHARD_MIGRATIONS = Counter(
+    "karpenter_tpu_shard_migrations_total",
+    "Signature-group ownership migrations executed by the cross-shard "
+    "rebalance collective", ())
+SHARD_REBALANCE_SKEW = Gauge(
+    "karpenter_tpu_shard_rebalance_skew_pods",
+    "Pod-count skew (max - min over shards) the last rebalance "
+    "collective observed, before its migrations applied", ())
+SHARDED_SOLVE_DURATION = Histogram(
+    "karpenter_tpu_sharded_solve_seconds",
+    "Wall latency of one sharded solve window (route + encode + "
+    "stacked dispatch + per-shard decode), by mode", ("mode",))
 # SLO ledger plane (karpenter_tpu/obs/ledger.py + obs/slo.py).
 POD_PLACEMENT = Histogram(
     "karpenter_tpu_pod_placement_seconds",

@@ -129,7 +129,12 @@ class TestBenchSkipContract:
             gates = bench.compute_target_met(result)
             assert isinstance(gates, dict) and gates
             for name, value in gates.items():
-                assert value in (True, False, None), (name, value)
+                # a gate is True/False/None — or an explicit skip
+                # string where its target is unreachable by
+                # construction (cpu-fallback; shards sharing a device)
+                assert value in (True, False, None) \
+                    or (isinstance(value, str)
+                        and value.startswith("skipped:")), (name, value)
 
     def test_target_met_gates_fire(self):
         gates = bench.compute_target_met({

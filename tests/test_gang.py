@@ -734,6 +734,116 @@ class TestReviewHardening:
             pricing.close()
 
 
+# -- rank-aware placement (ISSUE 14: rank-to-chip assignment) ---------------
+
+class TestRankAssignment:
+    def _brute_optimum(self, torus, mask, n):
+        import itertools
+
+        from karpenter_tpu.gang.topology import max_hop_of_chips
+
+        cells = sorted(c for c in range(64) if (mask >> c) & 1)
+        best = 99
+        for perm in itertools.permutations(cells[1:]):
+            best = min(best, max_hop_of_chips(torus, (cells[0],) + perm))
+            if best <= 1:
+                break
+        return best
+
+    def test_rank_order_is_bijection_and_optimal(self):
+        import math
+
+        from karpenter_tpu.gang.topology import (
+            max_hop_of_chips, optimal_max_hop, rank_order_coords,
+        )
+
+        for dims in [(1,), (2,), (3,), (4,), (2, 2), (2, 3), (3, 3),
+                     (2, 2, 2), (1, 4), (2, 4), (3, 1, 3), (4, 4)]:
+            order = rank_order_coords(dims)
+            n = math.prod(dims)
+            assert len(order) == n and len(set(order)) == n, dims
+            # recount via chip ids on the identity torus
+            idx = np.arange(n).reshape(dims)
+            chips = tuple(int(idx[c]) for c in order)
+            assert max_hop_of_chips(dims, chips) \
+                == optimal_max_hop(dims), dims
+
+    def test_optimal_hop_matches_brute_force(self):
+        from karpenter_tpu.gang.topology import (
+            enumerate_placements, max_hop_of_chips, rank_chips,
+        )
+
+        for torus, shape in [((4, 4), (2, 2)), ((2, 2, 2), (2, 2, 2)),
+                             ((4, 4), (1, 4)), ((4, 4), (2, 4))]:
+            for mask in enumerate_placements(torus, shape)[:4]:
+                chips = rank_chips(torus, mask)
+                got = max_hop_of_chips(torus, chips)
+                assert got <= self._brute_optimum(torus, mask, len(chips))
+
+    def test_planner_emits_rank_assignments(self, catalog):
+        clear_topology_cache()
+        pods = gang_pods("rank-a", 8, shape="2x2x2")
+        plan = GangPlanner(GangOptions(use_device="off")).plan(
+            encode_gangs(pods, catalog))
+        assert plan.placed_gangs == ["rank-a"]
+        a = plan.nodes[0].assignments[0]
+        assert len(a.rank_chips) == 8
+        assert set(a.rank_chips) == {c for c in range(64)
+                                     if (a.placement_mask >> c) & 1}
+        assert a.max_hop == 1            # 2x2x2: Hamiltonian cycle exists
+
+    def test_planner_and_greedy_agree_on_ranks(self, catalog):
+        clear_topology_cache()
+        pods = []
+        for i, shape in enumerate(["2x2", "2x2x2", "4x4", "2x2"]):
+            pods.extend(gang_pods(f"rk{i}", 4, shape=shape))
+        problem = encode_gangs(pods, catalog)
+        dev = GangPlanner(GangOptions(use_device="auto")).plan(problem)
+        host = GreedyGangPlanner().plan(problem)
+
+        def ranks(plan):
+            return [(a.gang, a.rank_chips, a.max_hop)
+                    for n in plan.nodes for a in n.assignments]
+
+        assert ranks(dev) == ranks(host)
+        assert fingerprint(dev) == fingerprint(host)
+
+    def test_validator_checks_rank_bijection_and_hop(self, catalog):
+        import dataclasses
+
+        clear_topology_cache()
+        pods = gang_pods("rank-v", 4, shape="2x2")
+        plan = GangPlanner(GangOptions(use_device="off")).plan(
+            encode_gangs(pods, catalog))
+        assert validate_gang_plan(plan, pods, catalog) == []
+        node = plan.nodes[0]
+        good = node.assignments[0]
+        # broken bijection: duplicate chip
+        bad = dataclasses.replace(
+            good, rank_chips=(good.rank_chips[0],) * len(good.rank_chips))
+        node.assignments[0] = bad
+        errors = validate_gang_plan(plan, pods, catalog)
+        assert any("bijection" in e for e in errors)
+        # wrong hop claim: recount disagrees
+        node.assignments[0] = dataclasses.replace(good, max_hop=7)
+        errors = validate_gang_plan(plan, pods, catalog)
+        assert any("recount" in e for e in errors)
+        node.assignments[0] = good
+        assert validate_gang_plan(plan, pods, catalog) == []
+
+    def test_slice_table_hops_column(self, catalog):
+        from karpenter_tpu.gang.topology import best_placement, slice_table
+
+        clear_topology_cache()
+        table = slice_table(catalog, (2, 2))
+        assert table.hops.shape == table.masks.shape
+        # every valid placement of a 2x2 block admits a Hamiltonian
+        # cycle -> hop bound 1 everywhere it is valid
+        assert (table.hops[table.valid] == 1).all()
+        o = int(np.nonzero(table.count > 0)[0][0])
+        assert 0 <= best_placement(table, o) < int(table.count[o])
+
+
 def test_clear_topology_cache_is_idempotent():
     clear_topology_cache()
     clear_topology_cache()

@@ -24,6 +24,7 @@ CTRL_PATH = "karpenter_tpu/controllers/_snippet.py"
 CLOUD_PATH = "karpenter_tpu/cloud/_snippet.py"
 REPACK_PATH = "karpenter_tpu/repack/_snippet.py"
 STOCHASTIC_PATH = "karpenter_tpu/stochastic/_snippet.py"
+SHARDED_PATH = "karpenter_tpu/sharded/_snippet.py"
 
 
 def rules_of(src: str, path: str) -> list:
@@ -279,6 +280,48 @@ def test_gl002_stochastic_scope_quantile_kernel_good():
                 hi = jnp.where(feas, hi, mid - 1)
             return lo
         """, "GL002", path=STOCHASTIC_PATH)
+
+
+def test_gl002_sharded_scope_rebalance_collective_bad():
+    """The purity family covers karpenter_tpu/sharded/: a broken
+    rebalance collective that branches on the traced skew (early-out
+    when no imbalance) is exactly the tracer-bool hazard — the psum
+    result is a tracer inside the shard_map body."""
+    assert_flags(
+        """
+        import jax
+        import jax.numpy as jnp
+        from jax import lax
+
+        @jax.jit
+        def rebalance(pressure):
+            total = lax.psum(jnp.sum(pressure, axis=0), "shard")
+            my = pressure[:, 0]
+            gmax = lax.pmax(jnp.max(my), "shard")
+            gmin = lax.pmin(jnp.min(my), "shard")
+            if gmax - gmin == 0:      # traced bool: trace-time error
+                return jnp.zeros(3, jnp.int32)
+            return jnp.stack([gmax, gmin, (gmax - gmin) // 2])
+        """, "GL002", path=SHARDED_PATH)
+
+
+def test_gl002_sharded_scope_rebalance_collective_good():
+    assert_clean(
+        """
+        import jax
+        import jax.numpy as jnp
+        from jax import lax
+
+        @jax.jit
+        def rebalance(pressure):
+            total = lax.psum(jnp.sum(pressure, axis=0), "shard")
+            my = pressure[:, 0]
+            gmax = lax.pmax(jnp.max(my), "shard")
+            gmin = lax.pmin(jnp.min(my), "shard")
+            # branchless: a balanced fleet yields amount 0 on its own
+            amount = jnp.maximum(gmax - gmin, 0) // 2
+            return jnp.stack([gmax, gmin, amount])
+        """, "GL002", path=SHARDED_PATH)
 
 
 def test_gl003_repack_scope_per_plan_jit_bad():

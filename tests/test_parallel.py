@@ -215,3 +215,32 @@ class TestShardedOfferings:
         mesh = solver_mesh(fleet=1, offer=5)
         with pytest.raises(ValueError, match="not divisible"):
             fleet_solve_sharded_offerings(stacked, mesh, num_nodes=N_NODES)
+
+
+class TestShardMeshFallbacks:
+    """parallel/mesh.py shard_mesh degradation (ISSUE 14 satellite):
+    construction on 1-device/CPU hosts, shard-count > device-count, and
+    divisor selection — the deeper shard semantics live in
+    tests/test_sharded.py against the real service."""
+
+    def test_one_device_and_oversubscribed_counts(self):
+        from karpenter_tpu.parallel import shard_mesh
+        from karpenter_tpu.parallel.mesh import SHARD_AXIS
+
+        one = jax.devices()[:1]
+        for shards in (1, 2, 3, 8):
+            mesh = shard_mesh(shards, devices=one)
+            assert mesh.shape[SHARD_AXIS] == 1
+        devs = jax.devices()
+        if len(devs) >= 8:
+            assert shard_mesh(8, devices=devs).shape[SHARD_AXIS] == 8
+            # 6 shards on 8 devices: width = largest divisor <= 8 -> 6
+            assert shard_mesh(6, devices=devs).shape[SHARD_AXIS] == 6
+            # 5 shards on 4 devices: 5 is prime -> width 1
+            assert shard_mesh(5, devices=devs[:4]).shape[SHARD_AXIS] == 1
+
+    def test_zero_shards_rejected(self):
+        from karpenter_tpu.parallel import shard_mesh
+
+        with pytest.raises(ValueError):
+            shard_mesh(0)

@@ -1,0 +1,514 @@
+"""Sharded continuous-solve service tests (karpenter_tpu/sharded/).
+
+Covers the ISSUE-14 acceptance surface: routing determinism, the
+2-shard virtual-mesh parity contract (per-shard result words AND plans
+bit-identical to the single-device path across seeded churn streams),
+the cross-shard rebalance collective (device decision == numpy oracle,
+skew provably drains, ownership migrations land), the per-shard
+resident delta path, the degraded host fallback, the independent
+validators, and the make_solver / provisioner integration.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp  # noqa: E402
+
+from karpenter_tpu.apis.pod import PodSpec, ResourceRequests, pod_key
+from karpenter_tpu.catalog import (
+    CatalogArrays, InstanceTypeProvider, PricingProvider,
+)
+from karpenter_tpu.cloud.fake import FakeCloud, generate_profiles
+from karpenter_tpu.parallel.mesh import SHARD_AXIS, shard_mesh
+from karpenter_tpu.sharded import (
+    ResilientShardedService, ShardedSolveService, ShardRouter,
+    signature_key, stable_shard,
+)
+from karpenter_tpu.sharded.encode import encode_shards
+from karpenter_tpu.sharded.kernels import (
+    rebalance_oracle, rebalance_shards, solve_shards,
+)
+from karpenter_tpu.sharded.validate import (
+    partition_violations, rebalance_violations, state_violations,
+)
+from karpenter_tpu.solver.jax_backend import solve_packed
+
+
+@pytest.fixture(scope="module")
+def catalog():
+    cloud = FakeCloud(profiles=generate_profiles(20))
+    pricing = PricingProvider(cloud)
+    try:
+        itp = InstanceTypeProvider(cloud, pricing)
+        return CatalogArrays.build(itp.list())
+    finally:
+        pricing.close()
+
+
+def make_pods(n, seed=0, prefix="p"):
+    rng = np.random.RandomState(seed)
+    return [PodSpec(f"{prefix}{seed}-{i}",
+                    requests=ResourceRequests(int(rng.randint(100, 900)),
+                                              int(rng.randint(256, 2048)),
+                                              0, 1))
+            for i in range(n)]
+
+
+def hot_pods(n, shards=2, shard=0, prefix="hot"):
+    """Pods whose request signature hashes onto ``shard`` — distinct
+    signatures (so groups stay migratable), same destination."""
+    from karpenter_tpu.sharded.router import craft_hot_requests
+
+    return [PodSpec(f"{prefix}-{i}",
+                    requests=ResourceRequests(cpu, mem, 0, 1))
+            for i, (cpu, mem) in enumerate(
+                craft_hot_requests(shards, shard, count=n))]
+
+
+# -- router -----------------------------------------------------------------
+
+class TestRouter:
+    def test_stable_hash_deterministic(self):
+        pods = make_pods(20, seed=3)
+        a = [stable_shard(signature_key(p), 4) for p in pods]
+        b = [stable_shard(signature_key(p), 4) for p in pods]
+        assert a == b
+        assert all(0 <= s < 4 for s in a)
+
+    def test_partition_is_disjoint_cover(self):
+        router = ShardRouter(3)
+        pods = make_pods(50, seed=1)
+        parts = router.partition(pods)
+        assert sum(len(p) for p in parts) == len(pods)
+        seen = set()
+        for part in parts:
+            for p in part:
+                assert pod_key(p) not in seen
+                seen.add(pod_key(p))
+
+    def test_signature_groups_never_split(self):
+        router = ShardRouter(2)
+        twins = [PodSpec(f"t{i}", requests=ResourceRequests(500, 512, 0, 1))
+                 for i in range(6)]
+        parts = router.partition(twins)
+        assert sorted(len(p) for p in parts) == [0, 6]
+
+    def test_migrate_overrides_and_drops_home(self):
+        router = ShardRouter(2)
+        pod = PodSpec("m", requests=ResourceRequests(300, 512, 0, 1))
+        key = signature_key(pod)
+        home = stable_shard(key, 2)
+        other = 1 - home
+        assert router.migrate(key, other) is True
+        assert router.shard_of(pod) == other
+        assert router.overrides() == {key: other}
+        # back home: the override is dropped, not pinned
+        assert router.migrate(key, home) is True
+        assert router.overrides() == {}
+        assert router.shard_of(pod) == home
+        # no-op migration reports False
+        assert router.migrate(key, home) is False
+        assert router.migrations == 2
+
+    def test_bad_shard_rejected(self):
+        router = ShardRouter(2)
+        with pytest.raises(ValueError):
+            router.migrate("k", 5)
+        with pytest.raises(ValueError):
+            ShardRouter(0)
+
+
+# -- mesh fallback paths (parallel/mesh.py) ---------------------------------
+
+class TestShardMesh:
+    def test_one_device_host_degrades_to_width_1(self):
+        # tier-1 runs plain JAX_PLATFORMS=cpu: exactly this degenerate
+        # case — 2 logical shards vmapped on one device
+        mesh = shard_mesh(2, devices=jax.devices()[:1])
+        assert mesh.shape[SHARD_AXIS] == 1
+
+    def test_width_is_largest_fitting_divisor(self):
+        devs = jax.devices()
+        mesh = shard_mesh(4, devices=devs[:1])
+        assert mesh.shape[SHARD_AXIS] == 1
+        if len(devs) >= 2:
+            assert shard_mesh(4, devices=devs[:2]).shape[SHARD_AXIS] == 2
+            # 3 shards on 2 devices: 2 does not divide 3 -> width 1
+            assert shard_mesh(3, devices=devs[:2]).shape[SHARD_AXIS] == 1
+
+    def test_shard_count_validated(self):
+        with pytest.raises(ValueError):
+            shard_mesh(0)
+
+    def test_solve_rejects_non_divisible(self, catalog):
+        svc = ShardedSolveService(2)
+        parts = svc.router.partition(make_pods(10))
+        w = encode_shards(parts, catalog)
+        ct = svc._catalog_tensors(catalog, w.O_pad)
+        bad = np.zeros((3, w.stacked.shape[1]), np.int32)  # 3 % width...
+        mesh = shard_mesh(2, devices=jax.devices()[:1])
+        # width 1 divides everything; force a fake width-2 check via
+        # the kernel's guard when devices allow
+        if len(jax.devices()) >= 2:
+            mesh2 = shard_mesh(2, devices=jax.devices()[:2])
+            with pytest.raises(ValueError):
+                solve_shards(jax.device_put(bad),
+                             np.zeros((3, 64), np.int32),
+                             np.zeros((3, 64), np.int32), *ct,
+                             mesh=mesh2, G=w.G_pad, O=w.O_pad,
+                             U=w.U_pad, N=w.N)
+
+
+# -- parity: the single-device contract --------------------------------------
+
+class TestParity:
+    def test_churn_streams_bit_identical_words(self, catalog):
+        """8 seeded churn streams on the 2-shard virtual mesh: every
+        window's stacked dispatch equals solve_packed per shard, word
+        for word (the ISSUE-14 parity acceptance)."""
+        for seed in range(8):
+            rng = np.random.RandomState(40 + seed)
+            svc = ShardedSolveService(2)
+            pods = make_pods(40, seed=seed)
+            for _ in range(3):
+                parts = svc.router.partition(pods)
+                w = encode_shards(parts, catalog)
+                ct = svc._catalog_tensors(catalog, w.O_pad)
+                S, L = w.stacked.shape
+                didx = np.full((S, 64), L, np.int32)
+                dval = np.zeros((S, 64), np.int32)
+                _, out = solve_shards(
+                    jax.device_put(w.stacked), didx, dval, *ct,
+                    mesh=svc.mesh, G=w.G_pad, O=w.O_pad, U=w.U_pad,
+                    N=w.N)
+                out = np.asarray(out)
+                for s in range(S):
+                    ref = np.asarray(solve_packed(
+                        jnp.asarray(w.stacked[s]), *ct, G=w.G_pad,
+                        O=w.O_pad, U=w.U_pad, N=w.N))
+                    assert np.array_equal(out[s], ref), \
+                        f"seed {seed} shard {s} diverged"
+                pods = pods[int(rng.randint(1, 8)):] + make_pods(
+                    int(rng.randint(4, 12)), seed=seed * 100 + 7,
+                    prefix="churn")
+
+    def test_sharded_plans_bit_identical_to_single_device(self, catalog):
+        """The pinned 2-shard virtual-mesh plan test: service plans ==
+        decoding the single-device solve of each shard's partition
+        through the same decode path."""
+        from karpenter_tpu.solver.encode import decode_plan_entries
+        from karpenter_tpu.solver.jax_backend import (
+            unpack_reason_words, unpack_result,
+        )
+
+        svc = ShardedSolveService(2)
+        pods = make_pods(60, seed=9)
+        got = svc.solve_window(catalog, pods=pods)
+        parts = svc.router.partition(pods)
+        w = encode_shards(parts, catalog)
+        ct = svc._catalog_tensors(catalog, w.O_pad)
+
+        def fingerprint(plan):
+            return ([(n.instance_type, n.zone, n.capacity_type,
+                      n.offering_index, tuple(n.pod_names))
+                     for n in plan.nodes],
+                    sorted(plan.unplaced_pods),
+                    round(plan.total_cost_per_hour, 6))
+
+        for s, problem in enumerate(w.problems):
+            out = np.asarray(solve_packed(
+                jnp.asarray(w.stacked[s]), *ct, G=w.G_pad, O=w.O_pad,
+                U=w.U_pad, N=w.N))
+            node_off, assign, unplaced, cost = unpack_result(
+                out, w.G_pad, w.N, 0)
+            words = unpack_reason_words(out, w.G_pad, w.N, 0)
+            gis, ns = np.nonzero(assign)
+            ref = decode_plan_entries(
+                problem, node_off, gis.astype(np.int64),
+                ns.astype(np.int64), assign[gis, ns].astype(np.int64),
+                unplaced, float(cost), "single", reason_words=words)
+            assert fingerprint(got.plans[s]) == fingerprint(ref)
+
+    def test_four_shard_mesh_when_devices_allow(self, catalog):
+        if len(jax.devices()) < 4:
+            pytest.skip("needs 4 devices (XLA_FLAGS host platform count)")
+        svc = ShardedSolveService(4)
+        assert svc.mesh.shape[SHARD_AXIS] == 4
+        pods = make_pods(80, seed=2)
+        parts = svc.router.partition(pods)
+        w = encode_shards(parts, catalog)
+        ct = svc._catalog_tensors(catalog, w.O_pad)
+        S, L = w.stacked.shape
+        _, out = solve_shards(
+            jax.device_put(w.stacked), np.full((S, 64), L, np.int32),
+            np.zeros((S, 64), np.int32), *ct, mesh=svc.mesh,
+            G=w.G_pad, O=w.O_pad, U=w.U_pad, N=w.N)
+        out = np.asarray(out)
+        for s in range(S):
+            ref = np.asarray(solve_packed(
+                jnp.asarray(w.stacked[s]), *ct, G=w.G_pad, O=w.O_pad,
+                U=w.U_pad, N=w.N))
+            assert np.array_equal(out[s], ref)
+
+
+# -- resident delta path -----------------------------------------------------
+
+class TestResidentDelta:
+    def test_unchanged_window_is_a_hit(self, catalog):
+        svc = ShardedSolveService(2)
+        pods = make_pods(30, seed=5)
+        svc.admit(pods)
+        svc.solve_window(catalog)
+        assert svc.last_delta.mode == "rebuild"
+        svc.solve_window(catalog)
+        assert svc.last_delta.mode == "hit"
+        assert svc.last_delta.words == 0
+
+    def test_churn_rides_the_delta(self, catalog):
+        svc = ShardedSolveService(2)
+        pods = make_pods(30, seed=6)
+        svc.solve_window(catalog, pods=pods)
+        svc.solve_window(catalog, pods=pods + make_pods(4, seed=99,
+                                                        prefix="new"))
+        assert svc.last_delta.mode == "delta"
+        assert 0 < svc.last_delta.words < svc._mirror.size
+
+    def test_migration_invalidates_with_reason(self, catalog):
+        svc = ShardedSolveService(2)
+        pods = hot_pods(8, shards=2, shard=0)
+        svc.admit(pods)
+        svc.solve_window(catalog)
+        dec = svc.rebalance()
+        assert dec.moved_keys
+        svc.solve_window(catalog)
+        assert svc.last_delta.mode == "rebuild"
+        assert svc.last_delta.reason == "rebalance"
+
+    def test_mirror_matches_device(self, catalog):
+        svc = ShardedSolveService(2)
+        svc.solve_window(catalog, pods=make_pods(25, seed=8))
+        snap = svc.snapshot_state()
+        assert np.array_equal(snap["mirror"], np.asarray(snap["device"]))
+
+
+# -- rebalance collective ----------------------------------------------------
+
+class TestRebalance:
+    def test_decision_matches_oracle(self):
+        mesh = shard_mesh(2, devices=jax.devices()[:1])
+        mat = np.array([[30, 5, 0, ], [4, 2, 0]], np.int32)
+        tile = np.asarray(rebalance_shards(mat, mesh=mesh))
+        assert (tile[:, :4] == tile[0, :4]).all()
+        donor, receiver, amount, skew = rebalance_oracle(mat)
+        assert tuple(int(v) for v in tile[0, :4]) \
+            == (donor, receiver, amount, skew) == (0, 1, 13, 26)
+
+    def test_tie_break_lowest_shard_id(self):
+        mesh = shard_mesh(4, devices=jax.devices()[:1])
+        mat = np.array([[7, 1, 0], [7, 1, 0], [1, 1, 0], [1, 1, 0]],
+                       np.int32)
+        tile = np.asarray(rebalance_shards(mat, mesh=mesh))
+        assert int(tile[0, 0]) == 0 and int(tile[0, 1]) == 2
+
+    def test_skew_drains_within_k_ticks(self, catalog):
+        """The shards-converge promise: hash-hot load on shard 0 is
+        drained by ownership migrations within a few collective ticks."""
+        svc = ShardedSolveService(2)
+        svc.admit(hot_pods(12, shards=2, shard=0) + make_pods(3, seed=1))
+        svc.solve_window(catalog)
+        initial = svc.rebalance().skew
+        assert initial > 1
+        final = initial
+        for _ in range(4):
+            svc.solve_window(catalog)
+            final = svc.rebalance().skew
+        assert final <= max(1, initial // 2)
+        assert svc.migrations > 0
+
+    def test_dominant_group_never_ping_pongs(self, catalog):
+        """One signature group bigger than the skew itself must NOT
+        migrate: moving it would make the imbalance worse and the next
+        tick would bounce it straight back (each bounce invalidating
+        the resident state)."""
+        from karpenter_tpu.sharded.router import craft_hot_requests
+
+        svc = ShardedSolveService(2)
+        (cpu, mem), = craft_hot_requests(2, 0, count=1)
+        # 10 identical pods = ONE group on shard 0; 4 singles on shard 1
+        big = [PodSpec(f"big{i}",
+                       requests=ResourceRequests(cpu, mem, 0, 1))
+               for i in range(10)]
+        small = hot_pods(4, shards=2, shard=1, prefix="small")
+        svc.admit(big + small)
+        for _ in range(3):
+            svc.solve_window(catalog)
+            dec = svc.rebalance()
+            assert dec.moved_keys == [], \
+                "dominant group migrated despite n >= skew"
+        assert svc.migrations == 0 and svc.invalidations == 0
+
+    def test_oracle_validator_catches_tampering(self, catalog):
+        svc = ShardedSolveService(2)
+        svc.admit(hot_pods(8, shards=2, shard=0))
+        svc.solve_window(catalog)
+        dec = svc.rebalance()
+        assert rebalance_violations(svc, dec) == []
+        import dataclasses as dc
+
+        bad = dc.replace(dec, donor=dec.donor + 1)
+        assert rebalance_violations(svc, bad)
+
+
+# -- validators --------------------------------------------------------------
+
+class TestValidators:
+    def test_state_fresh_clean_then_corrupted(self, catalog):
+        svc = ShardedSolveService(2)
+        pods = make_pods(30, seed=11)
+        svc.solve_window(catalog, pods=pods)
+        assert state_violations(svc, pods, catalog) == []
+        svc._mirror[0][3] += 1      # corrupt one word
+        out = state_violations(svc, pods, catalog)
+        assert out and "diverged" in out[0]
+
+    def test_partition_violations_clean(self, catalog):
+        svc = ShardedSolveService(2)
+        pods = make_pods(30, seed=12)
+        assert partition_violations(svc, pods) == []
+
+    def test_stale_generation_detected(self, catalog):
+        svc = ShardedSolveService(2)
+        pods = make_pods(10, seed=13)
+        svc.solve_window(catalog, pods=pods)
+        svc._generation = ("stale", 0, 0)
+        out = state_violations(svc, pods, catalog)
+        assert out and "generation" in out[0]
+
+
+# -- degraded fallback -------------------------------------------------------
+
+class TestDegraded:
+    def test_failed_dispatch_degrades_to_host(self, catalog, monkeypatch):
+        svc = ResilientShardedService(ShardedSolveService(2))
+        pods = make_pods(20, seed=14)
+
+        def boom(*a, **k):
+            raise RuntimeError("mesh died")
+
+        monkeypatch.setattr(svc.primary, "solve_window", boom)
+        plan = svc.solve_window(catalog, pods=pods)
+        assert plan.backend == "sharded-host"
+        assert svc.degraded_windows == 1
+        assert svc.primary.invalidations == 1
+        # pod accounting intact through the fallback
+        placed = {pn for p in plan.plans for n in p.nodes
+                  for pn in n.pod_names}
+        unplaced = {pn for p in plan.plans for pn in p.unplaced_pods}
+        assert placed | unplaced == {pod_key(p) for p in pods}
+
+    def test_degraded_rebalance_uses_oracle(self, catalog, monkeypatch):
+        svc = ResilientShardedService(ShardedSolveService(2))
+        svc.admit(hot_pods(8, shards=2, shard=0))
+
+        def boom(*a, **k):
+            raise RuntimeError("collective died")
+
+        monkeypatch.setattr(svc.primary, "rebalance", boom)
+        dec = svc.rebalance()
+        assert svc.degraded_rebalances == 1
+        assert dec.skew > 0
+        assert rebalance_violations(svc.primary, dec) == []
+
+
+# -- streaming admission -----------------------------------------------------
+
+class TestAdmission:
+    def test_admit_dedupes_and_withdraw_drains(self, catalog):
+        svc = ShardedSolveService(2)
+        pods = make_pods(10, seed=15)
+        counts = svc.admit(pods)
+        assert sum(counts) == 10
+        assert sum(svc.admit(pods)) == 0          # dedup
+        assert svc.withdraw([pod_key(p) for p in pods[:4]]) == 4
+        assert len(svc.backlog_pods()) == 6
+
+
+# -- solver / provisioner integration ----------------------------------------
+
+class TestSolverIntegration:
+    def test_make_solver_routes_sharded(self, catalog):
+        from karpenter_tpu.core.provisioner import make_solver
+        from karpenter_tpu.solver.types import SolveRequest, SolverOptions
+        from karpenter_tpu.solver.validate import validate_plan
+
+        solver = make_solver(SolverOptions(backend="jax", sharded=2))
+        pods = make_pods(40, seed=16)
+        plan = solver.solve(SolveRequest(pods, catalog))
+        assert plan.backend == "sharded"
+        assert validate_plan(plan, pods, catalog) == []
+        placed = {pn for n in plan.nodes for pn in n.pod_names}
+        assert placed | set(plan.unplaced_pods) == {pod_key(p)
+                                                    for p in pods}
+
+    def test_production_solve_ticks_rebalance_on_pending(self, catalog):
+        """The production path must actually run the collective: a
+        window leaving hash-hot pods pending triggers a rebalance tick
+        (the shadow harness must not be the only caller)."""
+        from karpenter_tpu.core.provisioner import make_solver
+        from karpenter_tpu.solver.types import SolveRequest, SolverOptions
+
+        from karpenter_tpu.sharded.router import craft_hot_requests
+
+        solver = make_solver(SolverOptions(backend="jax", sharded=2))
+        # hot signatures that fit nothing: they stay pending, so their
+        # weight IS the shard pressure the tick must see
+        out = [PodSpec(f"stuck{i}",
+                       requests=ResourceRequests(cpu, mem, 0, 1))
+               for i, (cpu, mem) in enumerate(
+                   craft_hot_requests(2, 0, cpu=10 ** 6, count=6))]
+        plan = solver.solve(SolveRequest(out + make_pods(4, seed=21),
+                                         catalog))
+        assert len(plan.unplaced_pods) == 6
+        svc = solver.primary.service
+        assert svc.rebalances >= 1
+        assert svc.last_decision is not None and svc.last_decision.skew > 0
+        # the backlog front-end tracked the window: placed withdrawn,
+        # pending retained
+        assert len(svc.backlog_pods()) == 6
+
+    def test_stochastic_windows_route_to_host(self, catalog):
+        """Chance-constrained windows carry semantics the stacked scan
+        kernel does not implement — they must route to the host oracle
+        (which packs chance-constrained), never silently drop the
+        overcommit bound."""
+        from karpenter_tpu.apis.nodeclaim import NodePool
+        from karpenter_tpu.apis.pod import UsageDistribution
+
+        svc = ShardedSolveService(2)
+        pods = [PodSpec(f"u{i}",
+                        requests=ResourceRequests(1000, 2048, 0, 1),
+                        usage=UsageDistribution(
+                            mean=ResourceRequests(500, 1024, 0, 1),
+                            var=(100 ** 2, 200 ** 2, 0, 0)))
+                for i in range(6)]
+        pool = NodePool(name="default", overcommit=0.05)
+        plan = svc.solve_window(catalog, pool, pods)
+        assert plan.backend == "sharded-host"
+        placed = {pn for p in plan.plans for n in p.nodes
+                  for pn in n.pod_names}
+        unplaced = {pn for p in plan.plans for pn in p.unplaced_pods}
+        assert placed | unplaced == {pod_key(p) for p in pods}
+
+    def test_env_opt_in(self, monkeypatch):
+        from karpenter_tpu.sharded import sharded_shards
+        from karpenter_tpu.solver.types import SolverOptions
+
+        monkeypatch.delenv("KARPENTER_ENABLE_SHARDED", raising=False)
+        assert sharded_shards(SolverOptions()) == 0
+        monkeypatch.setenv("KARPENTER_ENABLE_SHARDED", "true")
+        monkeypatch.setenv("KARPENTER_SHARDS", "4")
+        assert sharded_shards(SolverOptions()) == 4
+        assert sharded_shards(SolverOptions(sharded=3)) == 3

@@ -39,7 +39,11 @@ METRICS: tuple[tuple[str, str], ...] = (
     ("hetero_pipelined_ms", "lower"),
     ("hetero_vs_baseline", "higher"),
     ("repack_tick_p50_ms", "lower"),
+    # warm-only max (the cold first tick — the one-off blue/green
+    # transition — is tracked separately so a 500 ms cold tick stops
+    # polluting the steady-state trajectory)
     ("repack_tick_max_ms", "lower"),
+    ("repack_tick_cold_ms", "lower"),
     ("repack_plan_p50_ms", "lower"),
     ("repack_plan_max_ms", "lower"),
     ("fleet_pods_per_sec", "higher"),
@@ -65,6 +69,13 @@ METRICS: tuple[tuple[str, str], ...] = (
     ("device_time.exec_fetch_decomposed.execute_ms", "lower"),
     ("device_time.exec_fetch_decomposed.fetch_ms", "lower"),
     ("device_time.profiler_overhead_fraction", "lower"),
+    # sharded continuous-solve service (karpenter_tpu/sharded): stacked
+    # dispatch throughput, linearity vs single-shard rate, service-path
+    # warm window wall, and rank-aware gang placement quality
+    ("sharded.agg_pods_per_sec", "higher"),
+    ("sharded.linearity", "higher"),
+    ("sharded.solve_warm_p50_ms", "lower"),
+    ("gang_rank.max_hop", "lower"),
 )
 
 _ROUND_RE = re.compile(r"BENCH_r(\d+)\.json$")
@@ -148,6 +159,19 @@ def render_table(rows: list[dict], prev_name: str, cur_name: str,
     return "\n".join(lines)
 
 
+def gate_flips(prev: dict, cur: dict) -> list[str]:
+    """target_met gates that flipped True -> False between rounds.
+    Skip strings ("skipped: cpu-fallback") and absent gates are "did
+    not run", never a flip — a gate that is unreachable by construction
+    on the CPU fallback must not read as a regression forever
+    (BENCH_r05: speedup_20x / fleet_beats_grouped_host were permanently
+    false there)."""
+    a = prev.get("target_met") or {}
+    b = cur.get("target_met") or {}
+    return [name for name, was in a.items()
+            if was is True and b.get(name) is False]
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--dir", default=".",
@@ -171,7 +195,11 @@ def main(argv=None) -> int:
     (_, prev_name, prev), (_, cur_name, cur) = usable[-2], usable[-1]
     rows = compare(prev, cur, args.threshold)
     print(render_table(rows, prev_name, cur_name, args.threshold))
-    regressions = [r for r in rows if r["regression"]]
+    flips = gate_flips(prev, cur)
+    for name in flips:
+        print(f"GATE FLIP: target_met.{name} was True, now False")
+    regressions = [r for r in rows if r["regression"]] \
+        + [{"metric": f"target_met.{n}"} for n in flips]
     if regressions:
         print(f"\n{len(regressions)} metric(s) regressed more than "
               f"{args.threshold:.0%} — see flags above")

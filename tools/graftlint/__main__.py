@@ -30,6 +30,10 @@ DEFAULT_TARGETS = (
     "karpenter_tpu/gang",
     "karpenter_tpu/resident",
     "karpenter_tpu/explain",
+    "karpenter_tpu/sharded",
+    "karpenter_tpu/repack",
+    "karpenter_tpu/stochastic",
+    "karpenter_tpu/recovery",
     "karpenter_tpu/native.py",
     "bench.py",
     "karpenter_tpu/controllers",

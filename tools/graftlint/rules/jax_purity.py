@@ -32,6 +32,8 @@ FAMILY_A_SCOPE = (
     "karpenter_tpu/repack/**/*",
     "karpenter_tpu/stochastic/*",
     "karpenter_tpu/stochastic/**/*",
+    "karpenter_tpu/sharded/*",
+    "karpenter_tpu/sharded/**/*",
     "karpenter_tpu/native.py",
     "bench.py",
 )

@@ -144,7 +144,8 @@ class TelemetryInKernel(Rule):
     scope = ("karpenter_tpu/solver/*", "karpenter_tpu/parallel/*",
              "karpenter_tpu/preempt/*", "karpenter_tpu/gang/*",
              "karpenter_tpu/resident/*", "karpenter_tpu/explain/*",
-             "karpenter_tpu/repack/*", "karpenter_tpu/stochastic/*")
+             "karpenter_tpu/repack/*", "karpenter_tpu/stochastic/*",
+             "karpenter_tpu/sharded/*")
 
     def check(self, module: SourceModule) -> Iterator[Finding]:
         analysis = analyze(module)
@@ -339,7 +340,7 @@ class BlockingSyncInHotPath(Rule):
     scope = ("karpenter_tpu/solver/*", "karpenter_tpu/parallel/*",
              "karpenter_tpu/preempt/*", "karpenter_tpu/gang/*",
              "karpenter_tpu/resident/*", "karpenter_tpu/repack/*",
-             "karpenter_tpu/stochastic/*")
+             "karpenter_tpu/stochastic/*", "karpenter_tpu/sharded/*")
 
     def check(self, module: SourceModule) -> Iterator[Finding]:
         exempt = self._exempt_ranges(module.tree)

@@ -346,6 +346,44 @@ def main() -> int:
         check(risk_model.rate("bx2-4x16", "us-south-1") == 0.1,
               "risk model reproduces the ledger's counts (1/10)")
 
+        # demo sharded cycle (karpenter_tpu/sharded): one stacked
+        # 2-shard window + one rebalance collective tick on a skewed
+        # backlog, every dispatch force-sampled — the
+        # device_time_seconds{kernel="sharded-solve"|"rebalance"}
+        # families and the karpenter_tpu_sharded_* / shard_* families
+        # below must then be live, not vacuous
+        print("demo sharded cycle (2-shard stacked solve + rebalance)")
+        from karpenter_tpu.sharded import ShardedSolveService
+        from karpenter_tpu.sharded.router import craft_hot_requests
+        from karpenter_tpu.sharded.validate import rebalance_violations
+
+        svc = ShardedSolveService(2)
+        hot = []
+        for made, (hcpu, hmem) in enumerate(
+                craft_hot_requests(2, 0, count=6)):
+            hot.extend(make_pods(
+                2, name_prefix=f"shard{made}",
+                requests=ResourceRequests(hcpu, hmem, 0, 1)))
+        svc.admit(hot)
+        prof.interval = 1
+        try:
+            sh_plan = svc.solve_window(catalog)
+            sh_dec = svc.rebalance()
+        finally:
+            prof.interval = prev_interval
+        check(sum(len(p.nodes) for p in sh_plan.plans) > 0,
+              "sharded demo window opened nodes")
+        check(sh_dec.skew > 0 and sh_dec.moved_keys,
+              f"rebalance collective migrated ownership "
+              f"(skew={sh_dec.skew}, moved={len(sh_dec.moved_keys)})")
+        check(rebalance_violations(svc, sh_dec) == [],
+              "rebalance decision re-derives via the numpy oracle")
+        psnap2 = prof.snapshot()
+        check("sharded-solve" in psnap2["kernels"],
+              "profiler sampled the sharded-solve dispatch")
+        check("rebalance" in psnap2["kernels"],
+              "profiler sampled the rebalance collective")
+
         print("GET /metrics")
         status, ctype, body = _get(port, "/metrics")
         check(status == 200, f"/metrics status 200 (got {status})")
@@ -438,6 +476,23 @@ def main() -> int:
               in text, "spot risk rate gauge carries the learned pair")
         check('karpenter_tpu_spot_risk_interruptions_total{' in text,
               "spot interruption counter carries the ledger history")
+        # sharded plane families (karpenter_tpu/sharded +
+        # docs/design/sharded.md) — live from the demo cycle above,
+        # including the two new prof kernel sites
+        check('karpenter_tpu_sharded_solves_total{mode="device"}' in text,
+              "sharded solve counter saw the demo window")
+        check('karpenter_tpu_shard_backlog_pods{shard="0"}' in text,
+              "per-shard backlog gauge rendered")
+        check("karpenter_tpu_shard_migrations_total" in text,
+              "shard migration counter rendered")
+        check("karpenter_tpu_shard_rebalance_skew_pods" in text,
+              "rebalance skew gauge rendered")
+        check('karpenter_tpu_device_time_seconds_bucket{kernel='
+              '"sharded-solve"' in text,
+              "device_time family carries the sharded-solve kernel")
+        check('karpenter_tpu_device_time_seconds_bucket{kernel='
+              '"rebalance"' in text,
+              "device_time family carries the rebalance collective")
         # crash-recovery plane families (karpenter_tpu/recovery +
         # docs/design/recovery.md) — live: the journal recorded every
         # create/nominate of the waves above
