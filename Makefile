@@ -43,11 +43,16 @@ graftlint-baseline: ## Re-accept current graftlint findings into the debt ledger
 	$(PY) -m tools.graftlint --update-baseline
 
 .PHONY: chaos
-chaos: ## Seeded chaos matrix (profiles x seeds + crashpoint matrix, deterministic; docs/design/chaos.md)
+chaos: ## Seeded chaos matrix (profiles x seeds + crashpoint matrix + whatif determinism, deterministic; docs/design/chaos.md)
 	$(TEST_ENV) $(PY) -m karpenter_tpu.chaos --seeds 4 --rounds 10 \
 		--trace-dir .chaos-traces
 	$(TEST_ENV) $(PY) -m karpenter_tpu.chaos --crash --seeds 3 \
 		--trace-dir .chaos-traces
+	$(TEST_ENV) $(PY) -m karpenter_tpu.whatif --determinism --seeds 2
+
+.PHONY: whatif-determinism
+whatif-determinism: ## Whatif planning determinism: same ledger + seed => byte-identical recommendation digest, run twice (docs/design/whatif.md)
+	$(TEST_ENV) $(PY) -m karpenter_tpu.whatif --determinism --seeds 2
 
 .PHONY: soak
 soak: ## Simulated production day (composed chaos profiles) with SLO gates; report in .soak-report/

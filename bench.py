@@ -1664,6 +1664,166 @@ def run_sharded(num_pods: int = 2000, num_types: int = 100,
     }}
 
 
+def run_whatif(num_pods: int = 10000, num_types: int = 500, K: int = 64,
+               iters: int = 6, parity_seeds: int = 8) -> dict:
+    """What-if planning plane (docs/design/whatif.md):
+
+    - **stacked dispatch**: K candidate futures (forecast waves x chaos
+      perturbations x capacity clamps) solved in ONE vmapped device
+      dispatch against one baseline buffer — warm p50, devtel-counted
+      extra dispatches (must be 0 beyond the stacked launch itself);
+    - **speedup**: the stacked dispatch vs (a) the sequential host
+      ORACLE loop (the degraded path — the `whatif_batched_speedup`
+      gate, >= 5x at K=64) and (b) K sequential single-scenario device
+      solves (informational);
+    - **parity**: `parity_seeds` seeded workloads, every scenario's
+      stacked result words bit-identical to the numpy oracle (cost word
+      up to reduction order) AND the independent validator clean.
+    """
+    from karpenter_tpu.obs.devtel import get_devtel
+    from karpenter_tpu.whatif import Scenario, WhatIfPlanner, build_baseline
+    from karpenter_tpu.whatif.oracle import (
+        solve_scenarios_np, words_equal_except_cost,
+    )
+    from karpenter_tpu.whatif.scenario import (
+        ArrivalWave, lower_scenarios, quota_clamp, spot_storm_mask,
+        zone_blackout_mask,
+    )
+    from karpenter_tpu.whatif.validate import validate_whatif
+
+    pods, catalog = build_workload(num_pods, num_types)
+    from karpenter_tpu.apis.pod import intern_signatures
+
+    intern_signatures(pods)
+    baseline = build_baseline(pods, catalog)
+    G = baseline.problem.num_groups
+
+    def build_menu(k: int, rng) -> list:
+        menu = [Scenario("baseline")]
+        storm = spot_storm_mask(catalog)
+        while len(menu) < k:
+            i = len(menu)
+            gis = rng.choice(G, size=min(8, G), replace=False)
+            wave = ArrivalWave(tuple(
+                (int(g), int(rng.randint(1, 48))) for g in sorted(gis)))
+            kind = i % 4
+            if kind == 0:
+                perts: tuple = (wave,)
+            elif kind == 1:
+                perts = (wave, storm)
+            elif kind == 2:
+                zone = catalog.zones[int(rng.randint(len(catalog.zones)))]
+                perts = (wave, zone_blackout_mask(catalog, zone))
+            else:
+                perts = (wave, quota_clamp(baseline,
+                                           int(rng.randint(2, 8))))
+            menu.append(Scenario(f"s{i}", perts))
+        return menu[:k]
+
+    rng = np.random.RandomState(5)
+    menu = build_menu(K, rng)
+    planner = WhatIfPlanner(max_k=K)
+    plan = planner.plan(baseline, menu)          # warm/compile
+    devtel = get_devtel()
+    d0 = devtel.snapshot()["dispatches"]
+    plan = planner.plan(baseline, menu)
+    stacked_dispatches = devtel.snapshot()["dispatches"] - d0
+    walls = []
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        planner.plan(baseline, menu)
+        walls.append(time.perf_counter() - t0)
+    stacked_s = p50(walls)
+
+    # sequential device loop: the same K perturbed buffers through K
+    # single-scenario solve_packed dispatches (fetch each) — what the
+    # plane replaces
+    import jax.numpy as jnp
+
+    from karpenter_tpu.solver.jax_backend import _pad1, _pad2, solve_packed
+    from karpenter_tpu.whatif.scenario import perturbed_buffer
+
+    alloc = jnp.asarray(_pad2(catalog.offering_alloc().astype(np.int32),
+                              baseline.O_pad))
+    price = jnp.asarray(_pad1(catalog.off_price.astype(np.float32),
+                              baseline.O_pad))
+    rank = jnp.asarray(_pad1(catalog.offering_rank_price(),
+                             baseline.O_pad))
+    bufs = [perturbed_buffer(baseline, s) for s in menu]
+
+    def seq_device_once():
+        for buf in bufs:
+            np.asarray(solve_packed(
+                jnp.asarray(buf), alloc, price, rank, G=baseline.G_pad,
+                O=baseline.O_pad, U=baseline.U_pad, N=plan.N,
+                compact=plan.K_coo, coo16=plan.coo16))
+
+    seq_device_once()                            # warm
+    t0 = time.perf_counter()
+    seq_device_once()
+    seq_device_s = time.perf_counter() - t0
+
+    # sequential host loop (the oracle / degraded path) — measured once:
+    # at bench scale it is the slow side by construction
+    stacked_sc = lower_scenarios(baseline, menu)
+    t0 = time.perf_counter()
+    host_out = solve_scenarios_np(baseline, stacked_sc, N=plan.N,
+                                  compact=plan.K_coo, coo16=plan.coo16)
+    host_s = time.perf_counter() - t0
+    parity_full = all(
+        words_equal_except_cost(plan.raw[k], host_out[k], baseline.G_pad,
+                                plan.N) for k in range(K))
+
+    violations = validate_whatif(plan, max_scenarios=8)
+
+    # seeded differential at small scale: device stack == oracle per
+    # scenario across varied workloads
+    parity_seeds_ok = True
+    for seed in range(parity_seeds):
+        sp, scat = build_workload(400, max(num_types // 5, 20),
+                                  seed=900 + seed)
+        sb = build_baseline(sp, scat)
+        srng = np.random.RandomState(seed)
+        sG = sb.problem.num_groups
+
+        smenu = [Scenario("baseline")]
+        for i in range(7):
+            gis = srng.choice(sG, size=min(4, sG), replace=False)
+            wave = ArrivalWave(tuple(
+                (int(g), int(srng.randint(1, 16)))
+                for g in sorted(gis)))
+            smenu.append(Scenario(
+                f"d{i}", (wave, spot_storm_mask(scat)) if i % 2
+                else (wave,)))
+        splan = WhatIfPlanner().plan(sb, smenu)
+        ssc = splan.stacked
+        sref = solve_scenarios_np(sb, ssc, N=splan.N,
+                                  compact=splan.K_coo,
+                                  coo16=splan.coo16)
+        if not all(words_equal_except_cost(splan.raw[k], sref[k],
+                                           sb.G_pad, splan.N)
+                   for k in range(len(smenu))):
+            parity_seeds_ok = False
+            break
+
+    return {"whatif": {
+        "K": K,
+        "groups": G,
+        "stacked_p50_ms": round(stacked_s * 1000, 3),
+        "stacked_dispatches": int(stacked_dispatches),
+        "extra_dispatches": max(int(stacked_dispatches) - 1, 0),
+        "seq_device_ms": round(seq_device_s * 1000, 3),
+        "seq_host_ms": round(host_s * 1000, 3),
+        "batched_speedup": round(host_s / max(stacked_s, 1e-9), 2),
+        "device_loop_speedup": round(seq_device_s / max(stacked_s, 1e-9),
+                                     2),
+        "parity": bool(parity_full),
+        "parity_seeds_ok": bool(parity_seeds_ok),
+        "validator_violations": len(violations),
+        "delta_rung_words": int(plan.stacked.D),
+    }}
+
+
 _COLD_SCRIPT = r'''
 import json, os, sys, time
 sys.path.insert(0, os.environ["KTPU_REPO"])
@@ -2299,6 +2459,19 @@ def main():
     except Exception as e:  # noqa: BLE001
         result["stochastic_error"] = str(e)[:200]
 
+    try:
+        # ISSUE 15: what-if scenario planning — K futures as one
+        # stacked vmapped dispatch vs the sequential host loop, device
+        # vs numpy-oracle parity, independent-validator acceptance
+        result.update(run_whatif(
+            num_pods=1000 if args.quick else 10000,
+            num_types=100 if args.quick else 500,
+            K=64,
+            iters=3 if args.quick else 6,
+            parity_seeds=4 if args.quick else 8))
+    except Exception as e:  # noqa: BLE001
+        result["whatif_error"] = str(e)[:200]
+
     result["target_met"] = compute_target_met(result)
     print(json.dumps(result))
 
@@ -2467,6 +2640,27 @@ def compute_target_met(result: dict) -> dict:
             (result["gang_rank"]["hop_optimal_seeds_ok"] is True
              and result["gang_rank"]["extra_dispatches"] == 0)
             if "gang_rank" in result else None,
+        # ISSUE 15 acceptance, correctness half (every platform): the
+        # K-scenario stacked solve is ONE devtel-counted dispatch with
+        # per-scenario result words bit-identical to the numpy oracle
+        # and the independent fresh-solve validator clean
+        "whatif_one_dispatch_parity":
+            (result["whatif"]["extra_dispatches"] == 0
+             and result["whatif"]["parity"] is True
+             and result["whatif"]["parity_seeds_ok"] is True
+             and result["whatif"]["validator_violations"] == 0)
+            if "whatif" in result else None,
+        # speedup half: >= 5x over the sequential host loop at K=64.
+        # The win is structural on a real device (one dispatch + one
+        # delta H2D amortizes K tunnel round trips); on the CPU
+        # fallback the stacked compute is exactly K x one solve and no
+        # round trip exists to amortize, so the gate skips there (the
+        # speedup_20x / sharded_linear_scaling precedent) — the
+        # measured ratio still rides bench_compare directionally
+        "whatif_batched_speedup":
+            (skip_cpu if cpu_fallback
+             else result["whatif"]["batched_speedup"] >= 5.0)
+            if "whatif" in result else None,
         "device_time_decomposed_under_1pct_overhead":
             (result["device_time"]["exec_fetch_decomposed"]["execute_ms"]
              > 0.0

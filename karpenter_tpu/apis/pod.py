@@ -324,6 +324,22 @@ class PodSpec:
         object.__setattr__(self, "_sig_cache", sig)
         return sig
 
+    def signature_key(self) -> str:
+        """Stable STRING form of the constraint signature — THE
+        grouping/routing key string shared by the shard router
+        (sharded/router.py), the ledger arrival table
+        (obs/ledger.arrival), and the whatif forecast matching
+        (whatif/scenario.wave_from_forecast).  One definition: if the
+        string form ever changes, every consumer changes with it —
+        forecasted waves silently stop matching baseline groups
+        otherwise.  Memoized like the signature itself: the intake
+        path and the shard router both call this per pod."""
+        cached = getattr(self, "_sig_key", None)
+        if cached is None:
+            cached = repr(self.constraint_signature())
+            object.__setattr__(self, "_sig_key", cached)
+        return cached
+
     def signature_id(self) -> int:
         """Process-wide interned integer for the constraint signature —
         grouping 10k pods by int avoids re-hashing nested tuples on every

@@ -241,6 +241,10 @@ class ChaosHarness:
         from karpenter_tpu.stochastic.risk import refresh_from_ledger
 
         obs.get_ledger().reset_interruption_history()
+        # the arrival-history ring (whatif/forecast.py) is process-global
+        # the same way: a rerun learning run 1's arrival table would
+        # forecast differently, breaking determinism-verify
+        obs.get_ledger().reset_arrival_history()
         refresh_from_ledger(obs.get_ledger())
         # oversubscription (karpenter_tpu/stochastic): arm the default
         # pool's violation-probability bound — every solve window now

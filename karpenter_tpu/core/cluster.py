@@ -199,7 +199,12 @@ class ClusterState:
         # the pod's placement clock starts HERE — this is the API-server
         # intake every path (operator watch, chaos harness, tests) shares,
         # so the SLO ledger's first-seen stamp cannot miss an entry point
-        obs.get_ledger().first_seen(key)
+        ledger = obs.get_ledger()
+        ledger.first_seen(key)
+        # arrival-history stamp (whatif/forecast.py): the signature-group
+        # key is the encoder's grouping, so forecasted waves line up with
+        # baseline solve groups exactly
+        ledger.arrival(pod.signature_key())
         return self.add("pods", key, PendingPod(spec=pod))
 
     def pending_pods(self) -> list[PendingPod]:

@@ -104,11 +104,13 @@ class PlacementLedger:
     WORST_K = 16
 
     def __init__(self, capacity: int = 256, error_capacity: int = 128,
-                 max_open: int = 8192, sample_capacity: int = 4096):
+                 max_open: int = 8192, sample_capacity: int = 4096,
+                 arrival_capacity: int = 16384):
         self.capacity = capacity
         self.error_capacity = error_capacity
         self.max_open = max_open
         self.sample_capacity = sample_capacity
+        self.arrival_capacity = arrival_capacity
         self._lock = threading.Lock()
         self._open: dict[str, PodRecord] = {}
         # preallocated rings, written by index (the hot path never grows
@@ -144,6 +146,15 @@ class PlacementLedger:
         # SpotPreemptionController from ground-truth cloud state
         self._spot_interrupted: dict[tuple[str, str], int] = {}
         self._spot_exposure: dict[tuple[str, str], int] = {}
+        # arrival history ring (karpenter_tpu/whatif/forecast.py learns
+        # per-signature-group arrival rates from it): one (signature
+        # key, virtual hour-of-day) event per pod INTAKE, preallocated
+        # and FIFO-bounded like every other ring.  Independent of the
+        # record lifecycle by design — a pod that resolved, was evicted,
+        # or was dropped from the open map STILL counts as an arrival
+        # (demand happened whether or not its record survived).
+        self._arrival_ring: list = [None] * arrival_capacity
+        self._n_arrivals = 0
 
     # -- context -------------------------------------------------------------
 
@@ -391,6 +402,68 @@ class PlacementLedger:
             self._spot_interrupted.clear()
             self._spot_exposure.clear()
 
+    # -- arrival history (whatif/forecast.py) --------------------------------
+
+    def arrival(self, signature: str, t: float | None = None) -> None:
+        """One pod-intake observation for a constraint-signature group
+        (the same grouping key the encoder and the shard router use).
+        Stamped at ``ClusterState.add_pod`` — the intake every path
+        shares — into the bounded arrival ring, carrying the virtual
+        hour-of-day (the diurnal axis) AND the absolute virtual hour
+        (the recency axis the forecaster's rate EWMA walks)."""
+        t = now() if t is None else t
+        abs_hour = int(t // 3600.0)
+        with self._lock:
+            self._arrival_ring[self._n_arrivals % self.arrival_capacity] = \
+                (signature, abs_hour % 24, abs_hour)
+            self._n_arrivals += 1
+
+    def arrival_history(self) -> dict[str, list[int]]:
+        """Bounded per-(signature-group, virtual-hour) arrival count
+        table — the forecaster's exact learning surface.  Aggregated
+        from the FIFO ring, so counts only ever cover the last
+        ``arrival_capacity`` intakes; resolution/eviction of the pod's
+        lifecycle record never removes its arrival."""
+        with self._lock:
+            events = [e for e in self._arrival_ring if e is not None]
+        table: dict[str, list[int]] = {}
+        for sig, hour, _abs in events:
+            row = table.get(sig)
+            if row is None:
+                row = table[sig] = [0] * 24
+            row[hour] += 1
+        return table
+
+    def arrival_series(self) -> list[tuple[str, int]]:
+        """(signature, absolute virtual hour) events in FIFO order —
+        the chronological axis the forecaster's recency EWMA needs (the
+        hour-of-day table above deliberately loses ordering)."""
+        with self._lock:
+            n = self._n_arrivals
+            cap = self.arrival_capacity
+            if n <= cap:
+                ring = self._arrival_ring[:n]
+            else:
+                start = n % cap
+                ring = self._arrival_ring[start:] \
+                    + self._arrival_ring[:start]
+        return [(e[0], e[2]) for e in ring if e is not None]
+
+    @property
+    def arrival_total(self) -> int:
+        """Arrivals ever observed (monotonic; the ring retains the last
+        ``arrival_capacity`` of them)."""
+        with self._lock:
+            return self._n_arrivals
+
+    def reset_arrival_history(self) -> None:
+        """Chaos-harness hook, like ``reset_interruption_history``:
+        seeded scenarios (and the whatif determinism check) must learn
+        from an empty table on every rerun in one process."""
+        with self._lock:
+            self._arrival_ring = [None] * self.arrival_capacity
+            self._n_arrivals = 0
+
     # -- retention -----------------------------------------------------------
 
     def _retain_locked(self, rec: PodRecord) -> None:
@@ -501,6 +574,7 @@ class PlacementLedger:
                 "error_retained": sum(1 for r in self._err_ring
                                       if r is not None),
                 "dropped_records": self.dropped_records,
+                "arrivals": self._n_arrivals,
                 "outcomes": dict(self.outcome_counts),
                 "transitions": dict(self.transition_counts),
                 "staleness_high_water_s":

@@ -171,6 +171,8 @@ class Operator:
 
         self.manager = ControllerManager(self.cluster,
                                          leader=self.elector.is_leader)
+        # set by _build_controllers under KARPENTER_ENABLE_WHATIF
+        self.whatif = None
         for ctrl in self._build_controllers():
             self.manager.register(ctrl)
         self.metrics_server = None
@@ -247,6 +249,17 @@ class Operator:
 
             ctrls.append(GangAdmissionController(
                 self.cluster, self.provisioner, journal=self.journal))
+        # what-if planning service (karpenter_tpu/whatif): periodic
+        # stacked scenario evaluation + recommendation registry behind
+        # KARPENTER_ENABLE_WHATIF (docs/design/whatif.md)
+        if self.options.whatif_enabled:
+            from karpenter_tpu.whatif.service import (
+                PlanningService, WhatIfController,
+            )
+
+            self.whatif = PlanningService(
+                self.cluster, self.provisioner, journal=self.journal)
+            ctrls.append(WhatIfController(self.whatif))
         # env-gated (controllers.go:238)
         ctrls.append(OrphanCleanupController(
             self.cluster, self.cloud,
@@ -289,6 +302,10 @@ class Operator:
         service = getattr(solver, "service", None)
         if service is not None and hasattr(service, "stats"):
             out["sharded"] = service.stats()
+        # whatif planning block (karpenter_tpu/whatif): tick counts,
+        # registry size, last plan summary — absent when the plane is off
+        if self.whatif is not None:
+            out["whatif"] = self.whatif.snapshot()
         # crash-recovery block: journal health + what the last restart
         # recovery replayed/fenced (docs/design/recovery.md)
         recovery = {"journal": self.journal.stats()}
@@ -460,7 +477,8 @@ class Operator:
             self.metrics_server = MetricsServer(
                 port=self.options.metrics_port,
                 ready_check=lambda: self._started,
-                statusz=self.statusz).start()
+                statusz=self.statusz,
+                whatif=self.whatif).start()
         if self.options.webhook_port and self.webhook_server is None:
             # dedicated TLS admission listener: the API server refuses
             # plaintext webhooks, so /validate-nodeclass must be served

@@ -76,6 +76,11 @@ class Options:
     # behind the streaming admission router
     sharded_shards: int = 0                # KARPENTER_ENABLE_SHARDED /
                                            # KARPENTER_SHARDS
+    # what-if planning service (karpenter_tpu/whatif/,
+    # docs/design/whatif.md): opt-in like the other planes — it runs
+    # periodic stacked scenario solves against the live pending window
+    # and serves /debug/whatif + recommendation metrics
+    whatif_enabled: bool = False           # KARPENTER_ENABLE_WHATIF
     repack_min_savings_percent: int = 15   # apply repack only above this
     spot_discount_percent: int = 60        # spot = % of on-demand (options.go:76)
     metrics_port: int = 0                  # 0 = metrics server disabled
@@ -139,6 +144,7 @@ class Options:
             sharded_shards=(_geti(env, "KARPENTER_SHARDS", 2)
                             if _getb(env, "KARPENTER_ENABLE_SHARDED",
                                      False) else 0),
+            whatif_enabled=_getb(env, "KARPENTER_ENABLE_WHATIF", False),
             repack_min_savings_percent=_geti(
                 env, "KARPENTER_REPACK_MIN_SAVINGS_PERCENT", 15),
             spot_discount_percent=_geti(env, "KARPENTER_SPOT_DISCOUNT_PERCENT",

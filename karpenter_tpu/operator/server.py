@@ -31,6 +31,11 @@ Debug surface (docs/design/observability.md):
   (karpenter_tpu/stochastic/risk.py): per-(type, zone) learned rates
   the solver prices into offering ranking, plus the ledger's raw
   labeled interruption/exposure history;
+- ``GET /debug/whatif[?horizon=H&scenarios=a,b]`` — on-demand what-if
+  evaluation (karpenter_tpu/whatif): the standing scenario menu solved
+  as one stacked dispatch, per-scenario outcomes + ranked capacity
+  recommendations + the bounded audit registry; single-flight (429),
+  503 while the plane is off;
 - ``GET /statusz`` — uptime, build identity, last solve breakdown,
   ledger + recorder + device-telemetry snapshots, leader /
   circuit-breaker state (the operator wires its own extras in via the
@@ -111,11 +116,15 @@ class MetricsServer:
     def __init__(self, host: str = "0.0.0.0", port: int = 8080,
                  ready_check: Callable[[], bool] | None = None,
                  tls_cert: str = "", tls_key: str = "",
-                 statusz: Callable[[], dict] | None = None):
+                 statusz: Callable[[], dict] | None = None,
+                 whatif=None):
         self._ready = ready_check or (lambda: True)
         # operator-supplied /statusz extras (backend, leader, breakers,
         # last solve); the server owns uptime + version
         self._statusz_extra = statusz
+        # whatif PlanningService (karpenter_tpu/whatif) — /debug/whatif
+        # is 503 while the plane is off
+        self._whatif = whatif
         self._started_at = time.time()
         outer = self
 
@@ -157,6 +166,17 @@ class MetricsServer:
                         lambda: outer._debug_explain(self.path))
                 elif self.path.split("?", 1)[0] == "/debug/risk":
                     self._json_endpoint(outer._debug_risk)
+                elif self.path.split("?", 1)[0] == "/debug/whatif":
+                    # single-flight (429 when a stacked evaluation is
+                    # already in flight) — distinct status codes, so it
+                    # can't ride _json_endpoint, same as /debug/profile
+                    try:
+                        code, payload = outer._debug_whatif(self.path)
+                    except Exception as e:  # noqa: BLE001 — debug surface
+                        code, payload = 500, {"error": str(e)[:200]}
+                    self._reply(code,
+                                json.dumps(payload, default=str).encode(),
+                                "application/json")
                 elif self.path.split("?", 1)[0] == "/statusz":
                     self._json_endpoint(outer._statusz)
                 elif self.path == "/healthz":
@@ -310,6 +330,39 @@ class MetricsServer:
                              in sorted(hist["exposure"].items())},
             },
         }
+
+    def _debug_whatif(self, path: str) -> tuple[int, dict]:
+        """On-demand what-if evaluation (karpenter_tpu/whatif,
+        docs/design/whatif.md): ``?horizon=`` overrides the planning
+        horizon (virtual hours), ``?scenarios=a,b`` narrows the
+        standing menu by name.  SINGLE-FLIGHT: a concurrent evaluation
+        returns 429, never a double-launched stacked dispatch.  Also
+        returns the bounded recommendation audit registry."""
+        if self._whatif is None:
+            return 503, {"error": "whatif plane disabled "
+                                  "(KARPENTER_ENABLE_WHATIF)"}
+        q = parse_qs(urlparse(path).query)
+
+        def one(key, default, cast):
+            try:
+                return cast(q[key][0]) if key in q and q[key] else default
+            except (TypeError, ValueError):
+                return default
+
+        horizon = one("horizon", None, int)
+        names_raw = one("scenarios", "", str)
+        names = [n for n in names_raw.split(",") if n] or None
+        payload = self._whatif.evaluate(horizon_hours=horizon,
+                                        scenario_names=names)
+        if payload is None:
+            return 429, {"error": "a whatif evaluation is already in "
+                                  "flight (single-flight)"}
+        if payload.get("error"):
+            # a plane that cannot resolve its inputs is unavailable,
+            # not healthy-with-an-error-field
+            return 503, payload
+        payload["registry"] = self._whatif.recommendations(32)
+        return 200, payload
 
     def _debug_profile(self, path: str) -> tuple[int, dict]:
         """On-demand device-time capture (docs/design/profiling.md):

@@ -23,8 +23,10 @@ from karpenter_tpu.apis.pod import PodSpec
 def signature_key(pod: PodSpec) -> str:
     """Stable string form of the pod's constraint signature — the
     routing/grouping key (identical signature => identical key on every
-    host, in every process)."""
-    return repr(pod.constraint_signature())
+    host, in every process).  Delegates to the ONE definition on
+    PodSpec, shared with the ledger arrival table and the whatif
+    forecast matching."""
+    return pod.signature_key()
 
 
 def stable_shard(key: str, num_shards: int) -> int:

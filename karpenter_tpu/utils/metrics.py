@@ -434,6 +434,28 @@ SHARDED_SOLVE_DURATION = Histogram(
     "karpenter_tpu_sharded_solve_seconds",
     "Wall latency of one sharded solve window (route + encode + "
     "stacked dispatch + per-shard decode), by mode", ("mode",))
+# What-if planning plane (karpenter_tpu/whatif): forecast-driven
+# scenario evaluation as one extra batch dimension over the solver.
+WHATIF_SCENARIOS = Counter(
+    "karpenter_tpu_whatif_scenarios_total",
+    "Scenarios evaluated by the planning service, by mode (device = "
+    "the stacked vmapped dispatch; host = the scenario-at-a-time "
+    "oracle loop, including degraded fallbacks)", ("mode",))
+WHATIF_PLAN_DURATION = Histogram(
+    "karpenter_tpu_whatif_plan_seconds",
+    "Wall latency of one whatif planning pass (forecast + scenario "
+    "lowering + stacked dispatch + decode + ranking), by mode",
+    ("mode",))
+WHATIF_RECOMMENDATIONS = Gauge(
+    "karpenter_tpu_whatif_recommendations",
+    "Capacity-action recommendations currently held in the bounded "
+    "audit registry (positive SLO-risk averted per dollar)", ())
+WHATIF_HORIZON_RISK = Gauge(
+    "karpenter_tpu_whatif_horizon_risk",
+    "Unplaced pods the last planning pass projected for each standing "
+    "action-free scenario over the horizon (cardinality bounded by the "
+    "standing menu: baseline, forecast peak, one threat per chaos "
+    "knob)", ("scenario",))
 # SLO ledger plane (karpenter_tpu/obs/ledger.py + obs/slo.py).
 POD_PLACEMENT = Histogram(
     "karpenter_tpu_pod_placement_seconds",

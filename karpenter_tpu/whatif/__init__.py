@@ -1,0 +1,83 @@
+"""What-if scenario planning: forecast-driven proactive provisioning
+as one extra batch dimension (docs/design/whatif.md).
+
+Everything needed to answer "what happens at tonight's peak / during a
+spot storm / if this NodePool shrinks" already exists in the codebase —
+deterministic chaos profiles, the VirtualClock, the batched packed
+solve, PR-8 resident deltas, the diurnal soak load model — this plane
+turns them into a live product surface:
+
+- :mod:`forecast` — deterministic arrival forecasting from the
+  ledger's bounded per-(signature-group, virtual-hour) arrival table
+  (rate EWMAs + a diurnal profile seeded from the soak load model),
+  journal-persisted like the spot-risk model;
+- :mod:`scenario` — scenario generation as composable perturbations of
+  the packed baseline buffer (forecasted arrival waves x chaos-profile
+  perturbations reused declaratively from :class:`ChaosProfile` x
+  candidate capacity actions), each lowered to a word delta via the
+  PR-8 delta path so K scenarios ship as ONE stacked ``[K, D]`` pair,
+  never K full encodes;
+- :mod:`kernels` + :mod:`planner` — one cached jitted dispatch vmapping
+  delta-apply + ``solve_core`` + ``_pack_result_explained`` over the K
+  axis (stacked inputs donated, prof-sampled ``"whatif"``), decoding
+  per-scenario outcomes (placed/unplaced, explain reason histograms,
+  cost, gang park risk, staleness estimate);
+- :mod:`oracle` — the bit-identical numpy parity twin of the stacked
+  device solve;
+- :mod:`validate` — the independent validator: every scenario's result
+  words must equal a fresh single-scenario solve of the perturbed
+  state, and the perturbed state itself must be well-formed (a broken
+  forecaster's garbage rates are REJECTED here, not served);
+- :mod:`degraded` — :class:`ResilientPlanner`, the scenario-at-a-time
+  host-loop fallback with an ``ERRORS{whatif,...}`` breadcrumb;
+- :mod:`service` — the operator-resident :class:`PlanningService`
+  (opt-in ``KARPENTER_ENABLE_WHATIF``): periodic standing-menu
+  evaluation, (SLO-risk averted per dollar) recommendation ranking, a
+  bounded audit registry, ``GET /debug/whatif`` and the
+  ``karpenter_tpu_whatif_*`` metric families.
+"""
+
+from __future__ import annotations
+
+import os
+
+# scenarios per device dispatch: a K beyond this falls back to chunked
+# dispatches (ceil(K / max) launches) instead of one giant stacked
+# buffer that could OOM the device — tests pin the fallback
+WHATIF_MAX_K = max(1, int(os.environ.get("KARPENTER_WHATIF_MAX_K", "128")))
+
+# default planning horizon (virtual hours) and service cadence
+WHATIF_HORIZON_HOURS = 4
+WHATIF_INTERVAL_S = 60.0
+
+# hard ceiling on the horizon an evaluation accepts (one week): the
+# /debug/whatif ?horizon= knob must not drive an unbounded forecast
+# loop or an OOM-sized scenario stack under the single-flight lock —
+# the same clamp discipline /debug/profile applies to ?duration_s=
+WHATIF_MAX_HORIZON_HOURS = 168
+
+# staleness heuristic: expected seconds per retry window when estimating
+# how long an unplaced backlog takes to drain (planner.ScenarioOutcome)
+WHATIF_RETRY_S = 15.0
+
+from karpenter_tpu.whatif.forecast import ArrivalForecaster  # noqa: E402
+from karpenter_tpu.whatif.planner import (  # noqa: E402
+    ScenarioOutcome, WhatIfBaseline, WhatIfPlan, WhatIfPlanner,
+    build_baseline,
+)
+from karpenter_tpu.whatif.scenario import (  # noqa: E402
+    ArrivalWave, CapClamp, OfferingMask, PreProvision, Scenario,
+    lower_scenarios,
+)
+from karpenter_tpu.whatif.degraded import ResilientPlanner  # noqa: E402
+from karpenter_tpu.whatif.service import PlanningService  # noqa: E402
+from karpenter_tpu.whatif.validate import validate_whatif  # noqa: E402
+
+__all__ = [
+    "WHATIF_MAX_K", "WHATIF_HORIZON_HOURS", "WHATIF_INTERVAL_S",
+    "WHATIF_RETRY_S", "ArrivalForecaster", "ArrivalWave", "CapClamp",
+    "OfferingMask", "PreProvision", "Scenario", "lower_scenarios",
+    "WhatIfBaseline", "WhatIfPlan", "WhatIfPlanner", "ScenarioOutcome",
+    "build_baseline", "ResilientPlanner", "PlanningService",
+    "validate_whatif",
+]

@@ -1,0 +1,44 @@
+"""Degraded mode for the whatif plane: scenario-at-a-time host loop.
+
+Same contract as every other plane's Resilient* wrapper (solver,
+preempt, gang, repack, sharded): a device dispatch that raises — Mosaic
+runtime fault, OOM on an oversized stack, backend gone — degrades to
+the numpy oracle loop with an ``ERRORS{whatif, degraded_*}``
+breadcrumb, never an exception into the planning service's tick.  The
+host loop produces the SAME result words (modulo the float cost word),
+so recommendations keep flowing at host speed while the device path is
+sick.
+"""
+
+from __future__ import annotations
+
+from karpenter_tpu.utils import metrics
+from karpenter_tpu.utils.logging import get_logger
+from karpenter_tpu.whatif.planner import WhatIfPlan, WhatIfPlanner
+
+log = get_logger("whatif.degraded")
+
+
+class ResilientPlanner:
+    """Wraps :class:`WhatIfPlanner`: device plan, host fallback."""
+
+    def __init__(self, planner: WhatIfPlanner | None = None,
+                 device: bool = True):
+        self.planner = planner or WhatIfPlanner()
+        self.device = device
+        self.degraded_plans = 0
+
+    def plan(self, baseline, scenarios) -> WhatIfPlan:
+        if self.device:
+            try:
+                return self.planner.plan(baseline, scenarios)
+            except Exception as e:  # noqa: BLE001 — the degraded contract:
+                # any device failure falls back to the host loop
+                kind = type(e).__name__
+                log.warning("whatif device plan failed; degrading to the "
+                            "host loop", error=str(e)[:200], kind=kind)
+                metrics.ERRORS.labels("whatif", f"degraded_{kind}").inc()
+        plan = self.planner.plan_host(baseline, scenarios)
+        self.degraded_plans += 1
+        plan.backend = "host-degraded" if self.device else "host"
+        return plan

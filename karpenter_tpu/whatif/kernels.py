@@ -1,0 +1,66 @@
+"""Device kernel for the stacked K-scenario solve.
+
+One cached jit (GL003: per-call rebuilds would re-trace every plan)
+vmapping delta-apply + ``_unpack_problem`` + ``solve_core`` +
+``_pack_result_explained`` over the scenario axis: K futures solved in
+ONE device dispatch against ONE baseline buffer.  Per scenario the body
+traces exactly the ``solve_packed`` pipeline on the delta-applied
+buffer, which is what makes each scenario's result words bit-identical
+to a fresh single-scenario solve of the perturbed state — the parity
+contract ``validate_whatif`` and the 8-seed differentials pin
+(docs/design/whatif.md).
+
+The baseline buffer and the stacked delta pair are DONATED (GL006):
+all three are transient per plan — nothing whatif keeps device-resident
+between plans, because the baseline re-derives from the live pending
+window every tick.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+
+from karpenter_tpu.solver.jax_backend import (
+    _pack_result_explained, _unpack_problem, solve_core,
+)
+
+
+@functools.lru_cache(maxsize=32)
+def _solve_scenarios_jit(K: int, D: int, G: int, O: int, U: int, N: int,
+                         right_size: bool, compact: int, dense16: bool,
+                         coo16: bool):
+    """Cached jit of the stacked scenario solve (delta-apply fused)."""
+
+    def one(didx_row, dval_row, base, off_alloc, off_price, off_rank):
+        buf = base.at[didx_row].set(dval_row, mode="drop")
+        meta, compat_i, rows_g = _unpack_problem(buf, off_alloc, G, O, U)
+        node_off, assign, unplaced, cost = solve_core(
+            meta[:, :4], meta[:, 4], meta[:, 5], compat_i > 0,
+            off_alloc, off_price, off_rank, num_nodes=N,
+            right_size=right_size)
+        return _pack_result_explained(
+            meta, rows_g, compat_i, node_off, assign, unplaced, cost,
+            off_alloc, compact, dense16, coo16)
+
+    def stacked(base, didx, dval, off_alloc, off_price, off_rank):
+        return jax.vmap(one, in_axes=(0, 0, None, None, None, None))(
+            didx, dval, base, off_alloc, off_price, off_rank)
+
+    return jax.jit(stacked, donate_argnums=(0, 1, 2))
+
+
+def solve_scenarios(base, didx, dval, off_alloc, off_price, off_rank, *,
+                    G: int, O: int, U: int, N: int,
+                    right_size: bool = True, compact: int = 0,
+                    dense16: bool = False, coo16: bool = False):
+    """Dispatch the stacked scenario solve: ``base`` int32 [L] (the
+    packed baseline) and ``didx``/``dval`` int32 [K, D] (per-scenario
+    word deltas, drop-index padded) are all donated.  Returns the
+    stacked result buffer [K, Lo], still on device — the caller owns
+    fetch accounting."""
+    K, D = int(didx.shape[0]), int(didx.shape[1])
+    f = _solve_scenarios_jit(K, D, G, O, U, N, right_size, compact,
+                             dense16, coo16)
+    return f(base, didx, dval, off_alloc, off_price, off_rank)

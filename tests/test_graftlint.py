@@ -25,6 +25,7 @@ CLOUD_PATH = "karpenter_tpu/cloud/_snippet.py"
 REPACK_PATH = "karpenter_tpu/repack/_snippet.py"
 STOCHASTIC_PATH = "karpenter_tpu/stochastic/_snippet.py"
 SHARDED_PATH = "karpenter_tpu/sharded/_snippet.py"
+WHATIF_PATH = "karpenter_tpu/whatif/_snippet.py"
 
 
 def rules_of(src: str, path: str) -> list:
@@ -322,6 +323,41 @@ def test_gl002_sharded_scope_rebalance_collective_good():
             amount = jnp.maximum(gmax - gmin, 0) // 2
             return jnp.stack([gmax, gmin, amount])
         """, "GL002", path=SHARDED_PATH)
+
+
+def test_gl002_whatif_scope_scenario_kernel_bad():
+    """The purity family covers karpenter_tpu/whatif/: a broken
+    scenario kernel that early-exits on the traced delta (skip the
+    solve when a scenario's delta applied no change) is exactly the
+    tracer-bool hazard — the comparison result is a tracer inside the
+    vmapped body."""
+    assert_flags(
+        """
+        import jax
+        import jax.numpy as jnp
+
+        @jax.jit
+        def solve_scenario(base, didx, dval):
+            buf = base.at[didx].set(dval, mode="drop")
+            if jnp.array_equal(buf, base):   # traced bool: trace error
+                return base
+            return buf * 2
+        """, "GL002", path=WHATIF_PATH)
+
+
+def test_gl002_whatif_scope_scenario_kernel_good():
+    assert_clean(
+        """
+        import jax
+        import jax.numpy as jnp
+
+        @jax.jit
+        def solve_scenario(base, didx, dval):
+            # branchless: a no-op delta solves to the baseline result
+            # on its own — drop-index padding already ignores dead rows
+            buf = base.at[didx].set(dval, mode="drop")
+            return buf * 2
+        """, "GL002", path=WHATIF_PATH)
 
 
 def test_gl003_repack_scope_per_plan_jit_bad():

@@ -1,0 +1,770 @@
+"""What-if planning plane tests (karpenter_tpu/whatif).
+
+Covers the tentpole contracts — K-scenario stacked solve in ONE
+dispatch, bit-identity with fresh single-scenario solves AND the numpy
+oracle (8-seed differential), the load-bearing independent validator
+(broken-forecast falsifiability included), the degraded host fallback —
+plus the satellites: the ledger arrival-history accessor (resolved and
+evicted records still count arrivals, FIFO bound), scenario-composition
+edge cases (cold ledger, K=1 degenerate, emptied-zone x capacity-action
+composition, oversized-K chunking), and service determinism.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from karpenter_tpu.apis.pod import PodSpec, ResourceRequests
+from karpenter_tpu.catalog import (
+    CatalogArrays, InstanceTypeProvider, PricingProvider,
+)
+from karpenter_tpu.cloud.fake import FakeCloud, generate_profiles
+from karpenter_tpu.obs.ledger import PlacementLedger
+from karpenter_tpu.whatif import (
+    ArrivalForecaster, Scenario, WhatIfPlanner, build_baseline,
+    validate_whatif,
+)
+from karpenter_tpu.whatif.degraded import ResilientPlanner
+from karpenter_tpu.whatif.oracle import (
+    solve_scenarios_np, words_equal_except_cost,
+)
+from karpenter_tpu.whatif.scenario import (
+    ArrivalWave, PreProvision, lower_scenarios, perturbed_buffer,
+    quota_clamp, spot_storm_mask, wave_from_forecast, zone_blackout_mask,
+)
+
+
+def make_catalog(num_types: int = 12) -> CatalogArrays:
+    cloud = FakeCloud(profiles=generate_profiles(num_types))
+    pricing = PricingProvider(cloud)
+    catalog = CatalogArrays.build(InstanceTypeProvider(cloud,
+                                                      pricing).list())
+    pricing.close()
+    return catalog
+
+
+def make_pods(n: int, seed: int = 0) -> list[PodSpec]:
+    rng = np.random.RandomState(seed)
+    sizes = [(100, 256), (250, 512), (500, 1024), (1000, 4096)]
+    return [PodSpec(f"wi{seed}-{i}",
+                    requests=ResourceRequests(
+                        *sizes[int(rng.randint(len(sizes)))], 0, 1))
+            for i in range(n)]
+
+
+@pytest.fixture(scope="module")
+def catalog():
+    return make_catalog()
+
+
+@pytest.fixture(scope="module")
+def baseline(catalog):
+    return build_baseline(make_pods(40), catalog)
+
+
+def simple_menu(baseline, catalog, n_wave: int = 7):
+    wave = wave_from_forecast(
+        baseline, {baseline.group_signature(0): n_wave})
+    return [
+        Scenario("baseline"),
+        Scenario("forecast", (wave,)),
+        Scenario("storm", (wave, spot_storm_mask(catalog))),
+        Scenario("blackout",
+                 (wave, zone_blackout_mask(catalog, catalog.zones[0]))),
+    ]
+
+
+# ---------------------------------------------------------------------------
+# Ledger arrival-history accessor (satellite 1)
+# ---------------------------------------------------------------------------
+
+class TestArrivalHistory:
+    def test_counts_by_signature_and_hour(self):
+        ledger = PlacementLedger()
+        ledger.arrival("sigA", t=0.0)
+        ledger.arrival("sigA", t=3600.0 * 5)
+        ledger.arrival("sigB", t=3600.0 * 5 + 10)
+        table = ledger.arrival_history()
+        assert table["sigA"][0] == 1 and table["sigA"][5] == 1
+        assert table["sigB"][5] == 1
+        assert sum(table["sigA"]) == 2
+
+    def test_resolved_and_evicted_records_still_count(self):
+        """Arrivals are demand history, not record lifecycle: resolving
+        a pod, or its open record being dropped at the cap, must not
+        remove its arrival."""
+        ledger = PlacementLedger(max_open=4)
+        for i in range(10):
+            key = f"ns/p{i}"
+            ledger.first_seen(key, t=float(i))
+            ledger.arrival("sig", t=float(i))
+        # 6 of the 10 open records were evicted at the cap
+        assert ledger.stats()["open_records"] == 4
+        assert ledger.dropped_records == 6
+        # resolve the survivors too
+        for i in range(6, 10):
+            ledger.resolve(f"ns/p{i}", t=100.0)
+        assert sum(ledger.arrival_history()["sig"]) == 10
+
+    def test_fifo_bounded_like_every_other_ring(self):
+        ledger = PlacementLedger(arrival_capacity=8)
+        for i in range(20):
+            ledger.arrival(f"sig{i % 2}", t=float(i))
+        table = ledger.arrival_history()
+        assert sum(sum(row) for row in table.values()) == 8
+        assert ledger.arrival_total == 20
+
+    def test_reset_hook(self):
+        ledger = PlacementLedger()
+        ledger.arrival("sig", t=0.0)
+        ledger.reset_arrival_history()
+        assert ledger.arrival_history() == {}
+        assert ledger.arrival_total == 0
+
+    def test_cluster_intake_stamps_arrivals(self):
+        from karpenter_tpu import obs
+        from karpenter_tpu.core.cluster import ClusterState
+
+        ledger = PlacementLedger()
+        with obs.use_ledger(ledger):
+            cs = ClusterState()
+            cs.add_pod(PodSpec("a", requests=ResourceRequests(100, 256,
+                                                              0, 1)))
+            cs.add_pod(PodSpec("b", requests=ResourceRequests(100, 256,
+                                                              0, 1)))
+        table = ledger.arrival_history()
+        # same requests => same constraint signature => one group row
+        assert len(table) == 1
+        assert sum(next(iter(table.values()))) == 2
+
+
+# ---------------------------------------------------------------------------
+# Forecaster
+# ---------------------------------------------------------------------------
+
+class TestForecaster:
+    def test_cold_ledger_no_nan_and_empty_forecast(self):
+        f = ArrivalForecaster.from_ledger(PlacementLedger())
+        assert f.rates() == {}
+        prof = f.diurnal()
+        assert np.isfinite(prof).all()
+        assert abs(float(prof.mean()) - 1.0) < 1e-6
+        assert f.expected_arrivals(4, 9) == {}
+
+    def test_rates_deterministic_and_finite(self):
+        ledger = PlacementLedger()
+        for h in range(24):
+            for _ in range(3 + (h % 4)):
+                ledger.arrival("sig", t=h * 3600.0)
+        f1 = ArrivalForecaster.from_ledger(ledger)
+        f2 = ArrivalForecaster.from_ledger(ledger)
+        assert f1.rates() == f2.rates()
+        rate = f1.rates()["sig"]
+        assert np.isfinite(rate) and rate > 0
+        exp = f1.expected_arrivals(4, 9)
+        assert exp == f2.expected_arrivals(4, 9)
+        assert all(isinstance(v, int) and v > 0 for v in exp.values())
+
+    def test_diurnal_prior_reuses_soak_load_model(self):
+        from karpenter_tpu.chaos.soak import PRODUCTION_DAY
+        from karpenter_tpu.whatif.forecast import soak_diurnal_prior
+
+        prof = soak_diurnal_prior()
+        assert prof.shape == (24,)
+        assert abs(float(prof.mean()) - 1.0) < 1e-6
+        # the overload midday peak must show up as an above-mean stretch
+        assert float(prof.max()) > 1.0 > float(prof.min())
+        # normalization preserves the load-factor ratios of the day
+        loads = [s.load for s in PRODUCTION_DAY]
+        assert float(prof.max()) / float(prof.min()) == pytest.approx(
+            max(loads) / min(loads))
+
+    def test_journal_round_trip(self, tmp_path):
+        from karpenter_tpu.recovery.journal import IntentJournal
+
+        ledger = PlacementLedger()
+        for h in (1, 5, 9):
+            ledger.arrival("sigX", t=h * 3600.0)
+            ledger.arrival("sigY", t=h * 3600.0 + 30)
+        f = ArrivalForecaster.from_ledger(ledger)
+        journal = IntentJournal(str(tmp_path / "j.jsonl"), fsync=False)
+        f.save(journal)
+        loaded = ArrivalForecaster.load(journal)
+        # the TABLE round-trips exactly (same content fingerprint,
+        # same diurnal shape, same signature set); the chronological
+        # series deliberately does not persist, so loaded rates are
+        # the documented mean-hourly fallback — positive for every
+        # signature the original forecast
+        assert loaded.generation == f.generation
+        assert np.allclose(loaded.diurnal(), f.diurnal())
+        assert set(loaded.rates()) == set(f.rates())
+        assert all(v > 0 for v in loaded.rates().values())
+
+
+# ---------------------------------------------------------------------------
+# Scenario lowering
+# ---------------------------------------------------------------------------
+
+class TestScenarioLowering:
+    def test_wave_edits_only_count_words(self, baseline):
+        wave = ArrivalWave(((0, 5), (1, 3)))
+        buf = perturbed_buffer(baseline, Scenario("w", (wave,)))
+        idx = np.nonzero(buf != baseline.packed)[0]
+        assert set(idx.tolist()) == {0 * 8 + 4, 1 * 8 + 4}
+
+    def test_offering_mask_clears_label_bits(self, baseline, catalog):
+        storm = spot_storm_mask(catalog)
+        buf = perturbed_buffer(baseline, Scenario("s", (storm,)))
+        idx = np.nonzero(buf != baseline.packed)[0]
+        assert idx.size > 0
+        assert (idx >= baseline.G_pad * 8).all()
+        # strictly bit-clearing: new words are subsets of old ones
+        for w in idx:
+            assert int(buf[w]) & ~int(baseline.packed[w]) == 0
+
+    def test_shared_rung_and_drop_padding(self, baseline, catalog):
+        st = lower_scenarios(baseline, simple_menu(baseline, catalog))
+        assert st.didx.shape == st.dval.shape
+        assert st.didx.shape[0] == 4
+        # baseline scenario: every row is drop-index padding
+        assert (st.didx[0] == baseline.L).all()
+        assert st.delta_words[0] == 0
+
+    def test_empty_zone_composes_with_action_on_that_zone(
+            self, baseline, catalog):
+        """Perturbation that empties a zone composes with a capacity
+        action on that zone: the action's offering is never opened, so
+        its coverage and discount are zero — composition is
+        well-defined, not an error."""
+        zone = catalog.zones[0]
+        blk = zone_blackout_mask(catalog, zone)
+        off_in_zone = blk.offerings[0]
+        menu = [
+            Scenario("blk", (blk,)),
+            Scenario("blk+pre", (blk,),
+                     action=PreProvision(offering=off_in_zone, count=2)),
+        ]
+        plan = WhatIfPlanner().plan(baseline, menu)
+        o_plain, o_act = plan.outcomes
+        # same solve words (the action is solve-invisible)
+        assert np.array_equal(plan.raw[0], plan.raw[1])
+        assert o_act.action_covered_pods == 0
+        assert o_act.net_cost == pytest.approx(o_act.cost)
+        # and nothing landed in the blacked-out zone's offering
+        assert o_act.offering_node_pods.get(int(off_in_zone)) is None
+        assert not validate_whatif(plan)
+
+    def test_perturbations_from_chaos_profile(self, baseline, catalog):
+        """Declarative ChaosProfile reuse: the profile's storm /
+        blackout / quota knobs map onto scenario perturbations, fully
+        determined by (profile, seed) like the chaos harness itself."""
+        import random
+
+        from karpenter_tpu.chaos.profile import get_profile
+        from karpenter_tpu.whatif.scenario import (
+            perturbations_from_profile,
+        )
+
+        overload = get_profile("overload")
+        p1 = perturbations_from_profile(overload, catalog, baseline,
+                                        random.Random(3))
+        p2 = perturbations_from_profile(overload, catalog, baseline,
+                                        random.Random(3))
+        assert p1 == p2                      # seed-determined
+        kinds = {type(p).__name__ for p in p1}
+        # overload arms storms, blackouts AND an instance quota
+        assert kinds == {"OfferingMask", "CapClamp"}
+        plan = WhatIfPlanner().plan(
+            baseline, [Scenario("baseline"),
+                       Scenario("overload-like", p1)])
+        assert not validate_whatif(plan)
+        calm = perturbations_from_profile(get_profile("calm"), catalog,
+                                          baseline, random.Random(3))
+        assert calm == ()                    # no knobs, no perturbations
+
+    def test_quota_clamp_and_garbage_pass_through(self, baseline):
+        clamp = quota_clamp(baseline, 2)
+        buf = perturbed_buffer(baseline, Scenario("q", (clamp,)))
+        meta = buf[:baseline.G_pad * 8].reshape(baseline.G_pad, 8)
+        assert (meta[:baseline.problem.num_groups, 5] <= 2).all()
+        # garbage is NOT sanitized at lowering — the validator owns it
+        bad = perturbed_buffer(baseline,
+                               Scenario("g", (ArrivalWave(((0, -999),)),)))
+        assert int(bad[4]) < 0
+
+
+# ---------------------------------------------------------------------------
+# Planner: parity, dispatch accounting, chunking
+# ---------------------------------------------------------------------------
+
+class TestPlanner:
+    def test_k1_degenerate_equals_plain_solve_bit_for_bit(
+            self, baseline, catalog):
+        import jax.numpy as jnp
+
+        from karpenter_tpu.solver.jax_backend import (
+            _pad1, _pad2, solve_packed,
+        )
+
+        plan = WhatIfPlanner().plan(baseline, [Scenario("baseline")])
+        ref = np.asarray(solve_packed(
+            jnp.asarray(baseline.packed),
+            jnp.asarray(_pad2(catalog.offering_alloc().astype(np.int32),
+                              baseline.O_pad)),
+            jnp.asarray(_pad1(catalog.off_price.astype(np.float32),
+                              baseline.O_pad)),
+            jnp.asarray(_pad1(catalog.offering_rank_price(),
+                              baseline.O_pad)),
+            G=baseline.G_pad, O=baseline.O_pad, U=baseline.U_pad,
+            N=plan.N, compact=plan.K_coo, coo16=plan.coo16))
+        assert np.array_equal(plan.raw[0], ref)
+
+    def test_one_dispatch_for_k_scenarios(self, baseline, catalog):
+        from karpenter_tpu.obs.devtel import get_devtel
+
+        planner = WhatIfPlanner()
+        menu = simple_menu(baseline, catalog)
+        planner.plan(baseline, menu)          # warm the executable
+        d0 = get_devtel().snapshot()["dispatches"]
+        plan = planner.plan(baseline, menu)
+        assert get_devtel().snapshot()["dispatches"] - d0 == 1
+        assert plan.dispatches == 1
+
+    def test_cap_clamp_scenario_sizes_the_node_axis(self, catalog):
+        """A cap-clamping scenario needs ceil(count/cap) nodes — the
+        shared N must grow with the scenarios' MIN caps, or the FFD
+        runs out of node slots and reports phantom unplaced pods."""
+        pods = [PodSpec(f"cap{i}",
+                        requests=ResourceRequests(100, 256, 0, 1))
+                for i in range(300)]
+        b = build_baseline(pods, catalog)
+        menu = [Scenario("baseline"),
+                Scenario("shrink", (quota_clamp(b, 1),))]
+        plan = WhatIfPlanner().plan(b, menu)
+        assert plan.N >= 300
+        shrink = plan.outcomes[1]
+        assert shrink.unplaced == 0, \
+            "cap=1 must still place every pod (one node each), not " \
+            "report phantom unplaced from an undersized node axis"
+        assert shrink.nodes_open == 300
+
+    def test_oversized_k_chunks_instead_of_one_giant_stack(
+            self, baseline, catalog):
+        planner = WhatIfPlanner(max_k=2)
+        menu = [Scenario(f"s{i}", (ArrivalWave(((0, i + 1),)),))
+                for i in range(5)]
+        plan = planner.plan(baseline, menu)
+        assert plan.dispatches == 3
+        assert len(plan.outcomes) == 5
+        assert planner.chunked_plans >= 1
+        assert not validate_whatif(plan)
+        # chunked results equal the unchunked stack bit-for-bit
+        ref = WhatIfPlanner().plan(baseline, menu)
+        assert np.array_equal(plan.raw, ref.raw)
+
+    @pytest.mark.parametrize("seed", range(8))
+    def test_seeded_differential_device_oracle_and_fresh_solves(
+            self, seed):
+        """8-seed differential: stacked device words == numpy oracle
+        (cost word up to reduction order) AND == fresh single-scenario
+        device solves (exact, via the validator)."""
+        catalog = make_catalog(6 + (seed % 3))
+        rng = np.random.RandomState(seed)
+        baseline = build_baseline(make_pods(20 + seed * 5, seed=seed),
+                                  catalog)
+        G = baseline.problem.num_groups
+        menu = [Scenario("baseline")]
+        for i in range(5):
+            gis = rng.choice(G, size=min(3, G), replace=False)
+            wave = ArrivalWave(tuple(
+                (int(g), int(rng.randint(1, 12))) for g in sorted(gis)))
+            perts: tuple = (wave,)
+            if i % 2:
+                perts += (spot_storm_mask(catalog),)
+            if i == 3:
+                perts += (zone_blackout_mask(
+                    catalog, catalog.zones[int(rng.randint(
+                        len(catalog.zones)))]),)
+            menu.append(Scenario(f"s{i}", perts))
+        plan = WhatIfPlanner().plan(baseline, menu)
+        ref = solve_scenarios_np(baseline, plan.stacked, N=plan.N,
+                                 compact=plan.K_coo, coo16=plan.coo16)
+        for k in range(len(menu)):
+            assert words_equal_except_cost(plan.raw[k], ref[k],
+                                           baseline.G_pad, plan.N), \
+                f"seed {seed} scenario {k} oracle mismatch"
+        assert validate_whatif(plan) == []
+
+    def test_outcome_decode_fields(self, baseline, catalog):
+        plan = WhatIfPlanner().plan(baseline,
+                                    simple_menu(baseline, catalog))
+        base, fc, storm, blk = plan.outcomes
+        assert base.pods == 40 and fc.pods == 47
+        assert base.placed + base.unplaced == base.pods
+        assert base.nodes_open > 0 and base.cost > 0
+        # spot storm forces on-demand capacity: strictly pricier
+        assert storm.cost > fc.cost
+        d = fc.to_dict()
+        for key in ("scenario", "placed", "unplaced", "reasons",
+                    "gang_park_risk", "p99_staleness_est_s",
+                    "cost_per_hour", "delta_words"):
+            assert key in d
+
+
+# ---------------------------------------------------------------------------
+# Validator (load-bearing) + degraded fallback
+# ---------------------------------------------------------------------------
+
+class TestValidator:
+    def test_garbage_forecast_rejected(self, baseline):
+        plan = WhatIfPlanner().plan(
+            baseline, [Scenario("g", (ArrivalWave(((0, -50),)),))])
+        violations = validate_whatif(plan)
+        assert violations and "negative group count" in violations[0]
+
+    def test_huge_positive_garbage_rejected_without_oom(self, baseline):
+        """The positive mirror of the garbage fixture: a huge rate
+        saturates at int32 in the lowering, the node axis stays capped
+        at the production ladder's top rung (no multi-GB allocation),
+        and the count ceiling rejects the scenario."""
+        plan = WhatIfPlanner().plan(
+            baseline,
+            [Scenario("g", (ArrivalWave(((0, 10 ** 12),)),))])
+        from karpenter_tpu.solver.types import NODE_BUCKETS
+
+        assert plan.N <= NODE_BUCKETS[-1]
+        violations = validate_whatif(plan, replay=False)
+        assert violations and "absurd group count" in violations[0]
+
+    def test_tampered_result_words_rejected(self, baseline, catalog):
+        plan = WhatIfPlanner().plan(baseline,
+                                    simple_menu(baseline, catalog))
+        assert validate_whatif(plan) == []
+        plan.raw = plan.raw.copy()     # the device fetch is read-only
+        plan.raw[2, 0] ^= 1            # flip one bit of one node word
+        violations = validate_whatif(plan)
+        assert violations and "differ from a fresh" in violations[0]
+
+    def test_oracle_reference_path(self, baseline, catalog):
+        plan = WhatIfPlanner().plan(baseline,
+                                    simple_menu(baseline, catalog))
+        assert validate_whatif(plan, use_device=False) == []
+
+    def test_host_plan_validates_clean_against_device_reference(
+            self, baseline, catalog):
+        """A degraded/host plan's cost word is a numpy reduction; the
+        validator must compare it masked, not fail the whole plan on
+        reduction order while the device path is sick."""
+        plan = WhatIfPlanner().plan_host(baseline,
+                                         simple_menu(baseline, catalog))
+        assert validate_whatif(plan) == []
+
+    def test_well_formedness_layer_without_replay(self, baseline):
+        plan = WhatIfPlanner().plan(
+            baseline, [Scenario("g", (ArrivalWave(((0, -50),)),))])
+        violations = validate_whatif(plan, replay=False)
+        assert violations and "negative group count" in violations[0]
+
+    def test_out_of_range_delta_rejected(self, baseline, catalog):
+        plan = WhatIfPlanner().plan(baseline, [Scenario("baseline")])
+        plan.stacked.didx[0, 0] = -3
+        violations = validate_whatif(plan)
+        assert violations and "delta index out of range" in violations[0]
+
+
+class TestDegraded:
+    def test_device_failure_degrades_to_host_loop(self, baseline,
+                                                  catalog, monkeypatch):
+        def boom(*a, **k):
+            raise RuntimeError("mosaic fault")
+
+        monkeypatch.setattr("karpenter_tpu.whatif.kernels.solve_scenarios",
+                            boom)
+        rp = ResilientPlanner()
+        menu = simple_menu(baseline, catalog)
+        plan = rp.plan(baseline, menu)
+        assert plan.backend == "host-degraded"
+        assert rp.degraded_plans == 1
+        # the degraded plan still decodes every scenario
+        assert len(plan.outcomes) == len(menu)
+        assert plan.outcomes[0].placed > 0
+
+
+# ---------------------------------------------------------------------------
+# Service: menu, ranking, determinism, falsifiability
+# ---------------------------------------------------------------------------
+
+class _StubCluster:
+    def __init__(self, pods):
+        self._pods = list(pods)
+
+    def pending_pods(self):
+        from types import SimpleNamespace
+
+        return [SimpleNamespace(spec=p) for p in self._pods]
+
+    def list(self, kind, predicate=None):
+        return []
+
+    def get_nodeclass(self, name):
+        return None
+
+
+def make_service(catalog, pods, ledger, **kw):
+    from karpenter_tpu.whatif.service import PlanningService
+
+    return PlanningService(_StubCluster(pods), catalog_fn=lambda: catalog,
+                           seed=7, **kw)
+
+
+def seeded_ledger(pods, per_hour: int = 2) -> PlacementLedger:
+    ledger = PlacementLedger()
+    for h in range(24):
+        for p in pods:
+            for _ in range(per_hour):
+                ledger.arrival(p.signature_key(), t=h * 3600.0)
+    return ledger
+
+
+class TestService:
+    def test_standing_menu_and_recommendations(self, catalog):
+        from karpenter_tpu import obs
+
+        pods = make_pods(30, seed=3)
+        ledger = seeded_ledger(pods)
+        with obs.use_ledger(ledger):
+            svc = make_service(catalog, pods, ledger, validate=True)
+            payload = svc.evaluate(record=True, hour=9)
+        names = [s["scenario"] for s in payload["scenarios"]]
+        assert names[0] == "baseline"
+        assert "forecast-peak" in names and "spot-storm" in names
+        assert payload["dispatches"] == 1
+        assert payload["validation"]["violations"] == []
+        assert payload["recommendations"], "threats must yield a ranked " \
+            "pre-provision action"
+        top = payload["recommendations"][0]
+        assert top["risk_averted"] > 0 and top["cost_per_hour"] > 0
+        assert top["action"]["kind"] == "pre_provision"
+        # the audit pair is complete: before AND projected after
+        assert top["outcome_before"]["scenario"] == top["scenario"]
+        assert top["outcome_after"]["covered_pods"] > 0
+        assert top["outcome_after"]["risk"] == top["risk_after"]
+        assert svc.snapshot()["recommendations"] >= 1
+
+    def test_horizon_clamped(self, catalog):
+        from karpenter_tpu import obs
+        from karpenter_tpu.whatif import WHATIF_MAX_HORIZON_HOURS
+
+        pods = make_pods(10, seed=4)
+        ledger = seeded_ledger(pods)
+        with obs.use_ledger(ledger):
+            svc = make_service(catalog, pods, ledger)
+            payload = svc.evaluate(horizon_hours=10 ** 9, hour=9)
+        assert payload["horizon_hours"] == WHATIF_MAX_HORIZON_HOURS
+
+    def test_single_flight(self, catalog):
+        pods = make_pods(10, seed=4)
+        svc = make_service(catalog, pods, PlacementLedger())
+        svc._flight.acquire()
+        try:
+            assert svc.evaluate() is None
+            assert svc.busy_rejections == 1
+        finally:
+            svc._flight.release()
+
+    def test_determinism_digest(self, catalog):
+        """Same ledger + seed => byte-identical recommendation set —
+        the `make whatif-determinism` contract, in-process."""
+        from karpenter_tpu import obs
+
+        digests = []
+        for _ in range(2):
+            pods = make_pods(30, seed=5)
+            ledger = seeded_ledger(pods)
+            with obs.use_ledger(ledger):
+                svc = make_service(catalog, pods, ledger)
+                svc.evaluate(record=True, hour=9)
+            digests.append(svc.digest())
+        assert digests[0] == digests[1]
+
+    def test_broken_forecast_fixture_rejected(self, catalog, monkeypatch):
+        """Falsifiability: a forecaster returning garbage rates must
+        produce scenarios validate_whatif REJECTS — and the service
+        must refuse to record recommendations from them."""
+        from karpenter_tpu import obs
+
+        class BrokenForecaster(ArrivalForecaster):
+            def expected_arrivals(self, horizon_hours, start_hour=0):
+                # garbage: negative arrivals for every known signature
+                return {sig: -50 for sig in self._counts}
+
+        monkeypatch.setattr(
+            "karpenter_tpu.whatif.service.ArrivalForecaster",
+            BrokenForecaster)
+        pods = make_pods(30, seed=6)
+        ledger = seeded_ledger(pods)
+        with obs.use_ledger(ledger):
+            svc = make_service(catalog, pods, ledger, validate=True)
+            payload = svc.evaluate(record=True, hour=9)
+        assert payload["validation"]["violations"], \
+            "garbage forecast must be rejected by the validator"
+        assert any("negative group count" in v
+                   for v in payload["validation"]["violations"])
+        assert svc.recommendations() == []
+        assert svc.validation_failures == 1
+        # the well-formedness layer is ALWAYS on: even with full
+        # validation off (the production default), garbage never
+        # reaches the registry
+        with obs.use_ledger(ledger):
+            svc2 = make_service(catalog, pods, ledger, validate=False)
+            payload2 = svc2.evaluate(record=True, hour=9)
+        assert payload2["validation"]["violations"]
+        assert svc2.recommendations() == []
+        assert svc2.validation_failures == 1
+
+    def test_digest_does_not_mutate_registry(self, catalog):
+        from karpenter_tpu import obs
+
+        pods = make_pods(30, seed=3)
+        ledger = seeded_ledger(pods)
+        with obs.use_ledger(ledger):
+            svc = make_service(catalog, pods, ledger)
+            svc.evaluate(record=True, hour=9)
+        assert svc.recommendations()
+        svc.digest()
+        rows = svc.recommendations()
+        assert all("p99_staleness_est_s" in r["outcome_before"]
+                   for r in rows), \
+            "a read-only digest must not strip audit-row fields"
+
+    def test_forecast_generation_is_content_derived(self):
+        ledger = PlacementLedger()
+        for i in range(5):
+            ledger.arrival("sig", t=float(i))
+        f1 = ArrivalForecaster.from_ledger(ledger)
+        # same table => same generation (reproducible fingerprint)
+        assert ArrivalForecaster.from_ledger(ledger).generation \
+            == f1.generation
+        ledger.arrival("sig", t=9.0)
+        f2 = ArrivalForecaster.from_ledger(ledger)
+        assert f2.generation != f1.generation
+
+    def test_restart_warm_start_merges_journal_snapshot(
+            self, catalog, tmp_path):
+        """The journal snapshot is actually CONSUMED on restart: a new
+        service with a cold arrival ring still forecasts from the
+        persisted table (max-merge, idempotent)."""
+        from karpenter_tpu import obs
+        from karpenter_tpu.recovery.journal import IntentJournal
+
+        pods = make_pods(20, seed=12)
+        ledger = seeded_ledger(pods)
+        journal = IntentJournal(str(tmp_path / "j.jsonl"), fsync=False)
+        with obs.use_ledger(ledger):
+            svc = make_service(catalog, pods, ledger, journal=journal)
+            svc.evaluate(record=True, hour=9)
+        assert journal.state_map(), "tick persisted the forecast"
+        # restart: fresh process state, COLD ledger
+        journal2 = IntentJournal(str(tmp_path / "j.jsonl"), fsync=False)
+        with obs.use_ledger(PlacementLedger()):
+            svc2 = make_service(catalog, pods, PlacementLedger(),
+                                journal=journal2)
+            payload = svc2.evaluate(hour=9)
+        assert svc2.forecaster.rates(), \
+            "restart must warm-start from the journal snapshot"
+        names = [s["scenario"] for s in payload["scenarios"]]
+        assert "forecast-peak" in names
+
+    def test_journal_writes_only_on_changed_recording_ticks(
+            self, catalog, tmp_path):
+        from karpenter_tpu import obs
+        from karpenter_tpu.recovery.journal import IntentJournal
+
+        pods = make_pods(10, seed=2)
+        ledger = seeded_ledger(pods, per_hour=1)
+        journal = IntentJournal(str(tmp_path / "j.jsonl"), fsync=False)
+        with obs.use_ledger(ledger):
+            svc = make_service(catalog, pods, ledger, journal=journal)
+            svc.evaluate(record=False, hour=9)   # read-only GET
+            n_read = len(journal.state_map())
+            svc.evaluate(record=True, hour=9)    # first tick: saves
+            n_tick = len(journal.state_map())
+            before = journal.stats()["records"]
+            svc.evaluate(record=True, hour=9)    # unchanged table
+            after = journal.stats()["records"]
+        assert n_read == 0, "a read-only evaluation must not journal"
+        assert n_tick > 0
+        assert after == before, "unchanged table must not re-append"
+
+    def test_horizon_risk_gauge_series_hygiene(self, catalog):
+        """Rotated scenario names (the seeded blackout zone changes
+        with the baseline shape) must not leave stale gauge rows."""
+        from karpenter_tpu import obs
+        from karpenter_tpu.utils import metrics
+
+        pods = make_pods(20, seed=13)
+        ledger = seeded_ledger(pods)
+        with obs.use_ledger(ledger):
+            svc = make_service(catalog, pods, ledger)
+            svc.evaluate(record=True, hour=9)
+            names_before = {k[0] for k in
+                            metrics.WHATIF_HORIZON_RISK.samples()}
+            assert "spot-storm" in names_before
+            svc.evaluate(record=True, hour=9,
+                         scenario_names=["baseline"])
+        names_after = {k[0] for k in
+                       metrics.WHATIF_HORIZON_RISK.samples()}
+        assert names_after == {"baseline"}, \
+            f"stale risk rows must be removed (got {names_after})"
+
+    def test_registry_bounded(self, catalog):
+        from karpenter_tpu import obs
+
+        pods = make_pods(30, seed=8)
+        ledger = seeded_ledger(pods)
+        with obs.use_ledger(ledger):
+            svc = make_service(catalog, pods, ledger, registry_cap=3)
+            for _ in range(4):
+                svc.evaluate(record=True, hour=9)
+        assert len(svc.recommendations()) <= 3
+
+
+class TestControllerAndOptions:
+    def test_debug_whatif_503_when_plane_cannot_resolve_inputs(self):
+        """/debug/whatif must not serve an error payload as 200: a
+        plane without a resolvable catalog is unavailable."""
+        from karpenter_tpu.operator.server import MetricsServer
+        from karpenter_tpu.whatif.service import PlanningService
+
+        svc = PlanningService(_StubCluster([]))   # no catalog_fn, no
+        srv = MetricsServer(port=0, whatif=svc)   # provisioner
+        try:
+            code, payload = srv._debug_whatif("/debug/whatif")
+            assert code == 503 and "error" in payload
+        finally:
+            srv._server.server_close()
+
+    def test_env_gate(self):
+        from karpenter_tpu.operator.options import Options
+
+        base = {"TPU_CLOUD_REGION": "us-south",
+                "TPU_CLOUD_API_KEY": "k"}
+        assert Options.from_env(base).whatif_enabled is False
+        on = Options.from_env({**base,
+                               "KARPENTER_ENABLE_WHATIF": "true"})
+        assert on.whatif_enabled is True
+
+    def test_controller_tick_never_raises(self, catalog):
+        from karpenter_tpu.whatif.service import WhatIfController
+
+        pods = make_pods(5, seed=9)
+        svc = make_service(catalog, pods, PlacementLedger())
+
+        def boom(*a, **k):
+            raise RuntimeError("planning exploded")
+
+        svc.evaluate = boom
+        ctrl = WhatIfController(svc, interval=0.1)
+        ctrl.reconcile()              # must swallow + breadcrumb
+        assert svc.last_error.startswith("planning exploded")

@@ -76,6 +76,12 @@ METRICS: tuple[tuple[str, str], ...] = (
     ("sharded.linearity", "higher"),
     ("sharded.solve_warm_p50_ms", "lower"),
     ("gang_rank.max_hop", "lower"),
+    # what-if planning plane (karpenter_tpu/whatif): the stacked
+    # K-scenario dispatch wall and its speedup over the sequential
+    # host loop (>= 5x acceptance gate at K=64)
+    ("whatif.stacked_p50_ms", "lower"),
+    ("whatif.batched_speedup", "higher"),
+    ("whatif.seq_host_ms", "lower"),
 )
 
 _ROUND_RE = re.compile(r"BENCH_r(\d+)\.json$")

@@ -34,6 +34,9 @@ DEFAULT_TARGETS = (
     "karpenter_tpu/repack",
     "karpenter_tpu/stochastic",
     "karpenter_tpu/recovery",
+    # added in the SAME commit that created the package (the PR 11-13
+    # silently-unscanned gap must not repeat)
+    "karpenter_tpu/whatif",
     "karpenter_tpu/native.py",
     "bench.py",
     "karpenter_tpu/controllers",

@@ -34,6 +34,8 @@ FAMILY_A_SCOPE = (
     "karpenter_tpu/stochastic/**/*",
     "karpenter_tpu/sharded/*",
     "karpenter_tpu/sharded/**/*",
+    "karpenter_tpu/whatif/*",
+    "karpenter_tpu/whatif/**/*",
     "karpenter_tpu/native.py",
     "bench.py",
 )

@@ -145,7 +145,7 @@ class TelemetryInKernel(Rule):
              "karpenter_tpu/preempt/*", "karpenter_tpu/gang/*",
              "karpenter_tpu/resident/*", "karpenter_tpu/explain/*",
              "karpenter_tpu/repack/*", "karpenter_tpu/stochastic/*",
-             "karpenter_tpu/sharded/*")
+             "karpenter_tpu/sharded/*", "karpenter_tpu/whatif/*")
 
     def check(self, module: SourceModule) -> Iterator[Finding]:
         analysis = analyze(module)
@@ -340,7 +340,8 @@ class BlockingSyncInHotPath(Rule):
     scope = ("karpenter_tpu/solver/*", "karpenter_tpu/parallel/*",
              "karpenter_tpu/preempt/*", "karpenter_tpu/gang/*",
              "karpenter_tpu/resident/*", "karpenter_tpu/repack/*",
-             "karpenter_tpu/stochastic/*", "karpenter_tpu/sharded/*")
+             "karpenter_tpu/stochastic/*", "karpenter_tpu/sharded/*",
+             "karpenter_tpu/whatif/*")
 
     def check(self, module: SourceModule) -> Iterator[Finding]:
         exempt = self._exempt_ranges(module.tree)

@@ -44,6 +44,11 @@ os.environ.setdefault("KARPENTER_WINDOW_MAX_SECONDS", "1.0")
 # the debug surface, not provisioning backpressure
 os.environ.setdefault("CIRCUIT_BREAKER_RATE_LIMIT_PER_MINUTE", "1000")
 os.environ.setdefault("CIRCUIT_BREAKER_MAX_CONCURRENT_INSTANCES", "1000")
+# whatif planning plane live for the smoke: the demo cycle below
+# forecasts from the seeded arrival ledger, solves a standing scenario
+# menu as one stacked dispatch, and must emit the
+# karpenter_tpu_whatif_* families + /debug/whatif (docs/design/whatif.md)
+os.environ.setdefault("KARPENTER_ENABLE_WHATIF", "1")
 # crash-recovery plane live for the smoke: journal every actuation into
 # a temp dir so /statusz's recovery block and the journal metric
 # families are real, not vacuous (docs/design/recovery.md)
@@ -101,7 +106,7 @@ def main() -> int:
         if op.metrics_server is None:
             op.metrics_server = MetricsServer(
                 port=0, ready_check=lambda: True,
-                statusz=op.statusz).start()
+                statusz=op.statusz, whatif=op.whatif).start()
         port = op.metrics_server.port
         print(f"operator up, metrics server on :{port}")
 
@@ -384,6 +389,38 @@ def main() -> int:
         check("rebalance" in psnap2["kernels"],
               "profiler sampled the rebalance collective")
 
+        # demo whatif cycle (karpenter_tpu/whatif): forecast from the
+        # arrival ledger the waves above seeded, the standing scenario
+        # menu solved as ONE stacked dispatch, at least one
+        # pre-provision recommendation ranked into the audit registry —
+        # the karpenter_tpu_whatif_* families and /debug/whatif below
+        # must then be live, not vacuous.  The quota clamp keeps the
+        # demo backlog pending (same trick as the preemption demo) so
+        # the baseline scenario has live demand to perturb.
+        print("demo whatif cycle (stacked scenario plan)")
+        check(op.whatif is not None,
+              "whatif plane armed (KARPENTER_ENABLE_WHATIF)")
+        saved_quota_wi = op.cloud.instance_quota
+        op.cloud.instance_quota = op.cloud.instance_count()
+        for pod in make_pods(6, name_prefix="wi",
+                             requests=ResourceRequests(700, 2048, 0, 1)):
+            op.cluster.add_pod(pod)
+        wi = op.whatif.tick()
+        op.cloud.instance_quota = saved_quota_wi
+        check(wi is not None, "whatif tick evaluated (not busy)")
+        wi = wi or {}
+        check(len(wi.get("scenarios", [])) >= 3,
+              f"standing menu evaluated >=3 scenarios "
+              f"(got {len(wi.get('scenarios', []))})")
+        check(wi.get("dispatches") == 1,
+              f"menu solved in ONE stacked dispatch "
+              f"(got {wi.get('dispatches')})")
+        check(bool(wi.get("recommendations")),
+              f"at least one capacity recommendation ranked "
+              f"(got {len(wi.get('recommendations', []))})")
+        check((wi.get("forecast") or {}).get("arrivals_observed", 0) > 0,
+              "forecaster learned from the live arrival ledger")
+
         print("GET /metrics")
         status, ctype, body = _get(port, "/metrics")
         check(status == 200, f"/metrics status 200 (got {status})")
@@ -493,6 +530,17 @@ def main() -> int:
         check('karpenter_tpu_device_time_seconds_bucket{kernel='
               '"rebalance"' in text,
               "device_time family carries the rebalance collective")
+        # whatif planning plane families (karpenter_tpu/whatif +
+        # docs/design/whatif.md) — live from the demo cycle above
+        check('karpenter_tpu_whatif_scenarios_total{mode="device"}'
+              in text, "whatif scenario counter saw the stacked plan")
+        check("karpenter_tpu_whatif_plan_seconds" in text,
+              "whatif plan-latency histogram rendered")
+        check("karpenter_tpu_whatif_recommendations" in text,
+              "whatif recommendation-registry gauge rendered")
+        check('karpenter_tpu_whatif_horizon_risk{scenario="baseline"}'
+              in text, "whatif horizon-risk gauge carries the baseline "
+                       "scenario")
         # crash-recovery plane families (karpenter_tpu/recovery +
         # docs/design/recovery.md) — live: the journal recorded every
         # create/nominate of the waves above
@@ -637,6 +685,40 @@ def main() -> int:
               .get("bx2-4x16/us-south-1") == 1,
               "/debug/risk history reproduces the ledger counts")
 
+        print("GET /debug/whatif (on-demand + single-flight)")
+        # deterministic single-flight probe: hold the evaluation lock,
+        # a concurrent request must get 429, never a second stacked
+        # dispatch (the /debug/profile contract)
+        op.whatif._flight.acquire()
+        try:
+            status, _, _body = _get(port, "/debug/whatif?horizon=2")
+            check(status == 429,
+                  f"concurrent /debug/whatif gets 429 (got {status})")
+        finally:
+            op.whatif._flight.release()
+        status, ctype, body = _get(port,
+                                   "/debug/whatif?horizon=2&"
+                                   "scenarios=baseline,spot-storm")
+        check(status == 200, f"/debug/whatif status 200 (got {status})")
+        check(ctype == "application/json",
+              f"/debug/whatif content type (got {ctype!r})")
+        try:
+            wdoc = json.loads(body)
+        except ValueError as e:
+            wdoc = {}
+            check(False, f"/debug/whatif parses as JSON ({e})")
+        for key in ("horizon_hours", "scenarios", "recommendations",
+                    "forecast", "registry", "backend"):
+            check(key in wdoc, f"/debug/whatif has {key!r}")
+        check(wdoc.get("horizon_hours") == 2,
+              "?horizon= override honored")
+        wnames = {s.get("scenario") for s in wdoc.get("scenarios", ())}
+        check(wnames <= {"baseline", "spot-storm"} and "baseline" in
+              wnames,
+              f"?scenarios= narrows the menu (got {sorted(wnames)})")
+        check(bool(wdoc.get("registry")),
+              "/debug/whatif returns the recorded audit registry")
+
         print("GET /statusz")
         status, ctype, body = _get(port, "/statusz")
         check(status == 200, f"/statusz status 200 (got {status})")
@@ -682,6 +764,12 @@ def main() -> int:
               and "duration_s" in slast,
               f"/statusz recovery block carries the boot recovery "
               f"report ({slast})")
+        # whatif planning block (docs/design/whatif.md)
+        swi = doc.get("whatif") or {}
+        check(swi.get("ticks", 0) >= 1
+              and swi.get("recommendations", 0) >= 1
+              and "forecast_generation" in swi,
+              f"/statusz whatif block carries the demo tick ({swi})")
 
         print("GET /debug/traces")
         status, ctype, body = _get(
@@ -703,6 +791,9 @@ def main() -> int:
               f"(roots={sorted(roots)})")
         check("gang.place" in roots,
               f"the demo gang placement trace is retained "
+              f"(roots={sorted(roots)})")
+        check("whatif.plan" in roots,
+              f"the demo whatif plan trace is retained "
               f"(roots={sorted(roots)})")
 
         # trace-id round trip: /debug/slo's worst-pod table prints trace
