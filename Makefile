@@ -35,8 +35,12 @@ lint: ## Ruff lint (config: ruff.toml); under CI=true a missing ruff FAILS
 	fi
 
 .PHONY: graftlint
-graftlint: ## JAX/TPU purity + concurrency static analysis (tools/graftlint)
+graftlint: ## JAX/TPU purity + concurrency + whole-program contract analysis (tools/graftlint)
 	$(PY) -m tools.graftlint
+
+.PHONY: graftlint-diff
+graftlint-diff: ## Fast path: graftlint only files changed vs merge-base with main (CI runs the full scan)
+	$(PY) -m tools.graftlint --diff main
 
 .PHONY: graftlint-baseline
 graftlint-baseline: ## Re-accept current graftlint findings into the debt ledger

@@ -2209,6 +2209,27 @@ def run_stochastic(num_pods: int = 10000, num_types: int = 500,
     }}
 
 
+def run_graftlint() -> dict:
+    """ISSUE 16: static-analysis gate cost — full-scan wall seconds.
+    The GL2xx whole-program pass (parity-pair closures, jit-boundary
+    call graph, lock graph) grows superlinearly with module count, so
+    the trend is tracked like any other latency figure; the gate must
+    stay cheap enough to run per-commit."""
+    from tools.graftlint.__main__ import DEFAULT_TARGETS, REPO_ROOT, _collect
+    from tools.graftlint.engine import default_engine
+
+    t0 = time.perf_counter()
+    files = _collect(REPO_ROOT, list(DEFAULT_TARGETS))
+    found, errors = default_engine().lint_files(REPO_ROOT, files)
+    wall = time.perf_counter() - t0
+    return {"graftlint": {
+        "files": len(files),
+        "findings": len(found),
+        "parse_errors": len(errors),
+        "full_scan_s": round(wall, 3),
+    }}
+
+
 def run_cold_start(timeout_s: float = 560.0,
                    platform: str = "") -> dict:
     """BASELINE cold-start probe (VERDICT round 4 weak #4): the first
@@ -2471,6 +2492,13 @@ def main():
             parity_seeds=4 if args.quick else 8))
     except Exception as e:  # noqa: BLE001
         result["whatif_error"] = str(e)[:200]
+
+    try:
+        # ISSUE 16: graftlint full-scan wall seconds (the whole-program
+        # contract pass must stay cheap enough to gate every commit)
+        result.update(run_graftlint())
+    except Exception as e:  # noqa: BLE001
+        result["graftlint_error"] = str(e)[:200]
 
     result["target_met"] = compute_target_met(result)
     print(json.dumps(result))

@@ -169,8 +169,11 @@ class HTTPClient:
             else:
                 message = envelope.get("message", message)
                 code = envelope.get("code", "")
-        except Exception:
-            pass
+        except Exception as parse_exc:
+            # a malformed error envelope still yields a typed CloudError
+            # from the HTTP status; record why the body was unusable
+            log.debug("unparseable error envelope", operation=operation,
+                      status=e.code, error=str(parse_exc))
         err = parse_error(
             CloudError(f"{operation}: {message}", status_code=e.code,
                        code=code),

@@ -34,6 +34,9 @@ from karpenter_tpu.cloud.iks import pool_to_json, worker_to_json
 from karpenter_tpu.cloud.vpc import (
     image_to_json, instance_to_json, profile_to_json, subnet_to_json,
 )
+from karpenter_tpu.utils.logging import get_logger
+
+log = get_logger("cloud.stub")
 
 
 class StubCloudServer:
@@ -290,6 +293,8 @@ def _make_handler(stub: StubCloudServer):
             except CloudError as e:
                 self._send_error(e)
             except Exception as e:   # stub bug -> visible 500
+                log.error("stub handler crashed", method=method,
+                          path=parsed.path, error=str(e))
                 self._send(500, {"errors": [{"message": str(e),
                                              "code": "internal_error"}]})
 

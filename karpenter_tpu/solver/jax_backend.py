@@ -79,10 +79,10 @@ def _phase(name: str, t0: float, t1: float, parent=None, **attrs) -> None:
     metrics.SOLVE_PHASE.labels(name).observe(
         t1 - t0, exemplar={"trace_id": str(sp.trace_id)})
 
-# plain int: weak-typed in jnp.where, and a module-level jnp constant
-# would initialize the JAX backend at import time (hanging process start
-# whenever the TPU tunnel is slow — the solver must stay import-safe)
-_BIG = 1 << 30
+# the shared fit-count sentinel (solver/types.py): one home module for
+# both sides of every parity pair — a local literal here would drift
+# from the numpy oracles' copy (GL201)
+from karpenter_tpu.solver.types import FIT_BIG as _BIG
 
 # Background fetch pool: through the TPU tunnel, async result copies only
 # LAND while some thread is blocked in a device await (measured: every
@@ -631,8 +631,11 @@ def finish_pallas_solve(meta, compat_i, node_off, assign, alloc8, rank_row,
         node_off = _right_size(node_off, load, assign, compat_i > 0,
                                off_alloc, rank_row[0])
     is_open = node_off >= 0
-    cost = jnp.sum(jnp.where(is_open, off_price[jnp.clip(node_off, 0, None)],
-                             0.0))
+    # the cost word is the ONE value excluded from bit-parity (compared
+    # up to reduction order — docs/design/parity.md), so the float sum
+    # over open-node prices is sanctioned here and nowhere else
+    cost = jnp.sum(  # graftlint: disable=GL202 (cost word)
+        jnp.where(is_open, off_price[jnp.clip(node_off, 0, None)], 0.0))
     return node_off, cost
 
 
@@ -867,7 +870,10 @@ def solve_core(group_req, group_count, group_cap, compat,
                                compat, off_alloc, off_rank,
                                miss_g=miss_g, pref_lambda=pref_lambda)
     is_open = node_off >= 0
-    cost = jnp.sum(jnp.where(is_open, off_price[jnp.clip(node_off, 0, None)], 0.0))
+    # cost word: excluded from bit-parity up to reduction order (see
+    # docs/design/parity.md) — the one sanctioned float reduction
+    cost = jnp.sum(  # graftlint: disable=GL202 (cost word)
+        jnp.where(is_open, off_price[jnp.clip(node_off, 0, None)], 0.0))
     return node_off, assign, unplaced, cost
 
 

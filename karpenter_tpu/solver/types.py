@@ -160,3 +160,11 @@ LABELROW_BUCKETS = (4, 16, 64, 256, 1024, 4096)
 # candidates, window batching, the sidecar's SolveBatch): shrinking
 # batches must land on the same compiled executable across paths
 BATCH_BUCKETS = (2, 4, 8, 16, 32)
+
+# The shared fit-count sentinel: "no capacity constraint" in the
+# per-resource fit division on BOTH sides of every parity pair (device
+# kernels and numpy oracles import this one constant — GL201 forbids
+# re-defining it per module).  Plain int: weak-typed in jnp.where, and
+# any device-typed constant here would initialize the JAX backend at
+# import time (this module must stay numpy-safe for the host oracles).
+FIT_BIG = 1 << 30

@@ -19,9 +19,8 @@ from __future__ import annotations
 
 import numpy as np
 
+from karpenter_tpu.solver.types import FIT_BIG as _BIG
 from karpenter_tpu.stochastic import CHANCE_FIT_MAX, CHANCE_ITERS, zsq_value
-
-_BIG = 1 << 30
 
 
 def _fit_counts_np(resid: np.ndarray, req: np.ndarray) -> np.ndarray:
@@ -198,9 +197,11 @@ def solve_stochastic_host(problem, N: int, z_bp: int,
         node_off = _right_size_np(node_off, load_mean, node_var, assign,
                                   compat, off_alloc, off_rank, zsq)
     is_open = node_off >= 0
-    cost = float(np.where(is_open,
-                          off_price[np.clip(node_off, 0, None)],
-                          np.float32(0.0)).sum())
+    # cost word: excluded from bit-parity up to reduction order (see
+    # docs/design/parity.md) — the one sanctioned float reduction
+    cost = float(np.where(  # graftlint: disable=GL202 (cost word)
+        is_open, off_price[np.clip(node_off, 0, None)],
+        np.float32(0.0)).sum())
     from karpenter_tpu.explain.greedy import reason_words
 
     # reason_words already folds the overcommit_risk bit for stochastic

@@ -33,9 +33,8 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from karpenter_tpu.solver.types import FIT_BIG as _BIG
 from karpenter_tpu.stochastic import CHANCE_FIT_MAX, CHANCE_ITERS, zsq_value
-
-_BIG = 1 << 30
 
 
 def _fit_counts(resid, req):
@@ -280,8 +279,10 @@ def solve_packed_stochastic(packed, sto, kd, kc, off_alloc, off_price,
                                           assign, compat, off_alloc,
                                           off_rank, zsq)
     is_open = node_off >= 0
-    cost = jnp.sum(jnp.where(is_open,
-                             off_price[jnp.clip(node_off, 0, None)], 0.0))
+    # cost word: excluded from bit-parity up to reduction order (see
+    # docs/design/parity.md) — the one sanctioned float reduction
+    cost = jnp.sum(  # graftlint: disable=GL202 (cost word)
+        jnp.where(is_open, off_price[jnp.clip(node_off, 0, None)], 0.0))
     out = _pack_result(node_off, assign, unplaced, cost, compact, dense16,
                        coo16)
     words = _explain_words(meta, rows_g, compat_i,

@@ -23,7 +23,9 @@ from karpenter_tpu.explain import (
     BIT, DEFICIT_CLIP, DEFICIT_MASKED, RESOURCE_BITS,
 )
 
-_BIG = 1 << 30
+# shared with the device side (solver/jax_backend.py) via one home
+# module — the fit sentinel is part of the parity contract (GL201/GL203)
+from karpenter_tpu.solver.types import FIT_BIG as _BIG
 
 
 def unpack_problem_np(packed: np.ndarray, off_alloc: np.ndarray,
@@ -110,9 +112,11 @@ def solve_core_np(meta: np.ndarray, compat_i: np.ndarray,
         node_off = _right_size_np(node_off, node_resid, assign, compat,
                                   off_alloc, off_rank)
     is_open = node_off >= 0
-    cost = float(np.where(is_open,
-                          off_price[np.clip(node_off, 0, None)],
-                          np.float32(0.0)).sum())
+    # cost word: excluded from bit-parity up to reduction order (see
+    # docs/design/parity.md) — the one sanctioned float reduction
+    cost = float(np.where(  # graftlint: disable=GL202 (cost word)
+        is_open, off_price[np.clip(node_off, 0, None)],
+        np.float32(0.0)).sum())
     return node_off, assign, unplaced, cost
 
 

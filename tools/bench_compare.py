@@ -82,6 +82,9 @@ METRICS: tuple[tuple[str, str], ...] = (
     ("whatif.stacked_p50_ms", "lower"),
     ("whatif.batched_speedup", "higher"),
     ("whatif.seq_host_ms", "lower"),
+    # static-analysis gate cost (tools/graftlint): the whole-program
+    # contract pass must stay cheap enough to run per-commit
+    ("graftlint.full_scan_s", "lower"),
 )
 
 _ROUND_RE = re.compile(r"BENCH_r(\d+)\.json$")

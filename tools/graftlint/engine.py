@@ -88,6 +88,14 @@ class Rule:
     def check(self, module: SourceModule) -> Iterator[Finding]:
         raise NotImplementedError
 
+    def check_program(self, program) -> Iterator[Finding]:
+        """Whole-program rules (the GL2xx contracts family) override
+        this instead of ``check``; the engine calls it once per
+        ``lint_files`` run with a ``tools.graftlint.program.Program``
+        built over every parsed module.  Findings are still filtered by
+        ``scope`` and per-line suppressions."""
+        return iter(())
+
     def finding(self, module: SourceModule, node: ast.AST,
                 message: str) -> Finding:
         return Finding(path=module.path, line=node.lineno,
@@ -173,6 +181,7 @@ class LintEngine:
         """-> ([(finding, offending line text)], [unparsable-file errors])."""
         found: list[tuple[Finding, str]] = []
         errors: list[str] = []
+        modules: list[SourceModule] = []
         for p in sorted(set(paths)):
             rel = p.relative_to(root).as_posix()
             try:
@@ -183,9 +192,42 @@ class LintEngine:
                 # py3.12-only f-string that broke every import)
                 errors.append(f"{rel}:{e.lineno}: syntax error: {e.msg}")
                 continue
+            modules.append(module)
             for f in self.lint_module(module):
                 found.append((f, module.line_text(f.line)))
+        found.extend(self.lint_program(modules))
+        found.sort(key=lambda fl: (fl[0].path, fl[0].line, fl[0].col,
+                                   fl[0].rule))
         return found, errors
+
+    def lint_program(self, modules: Sequence[SourceModule],
+                     pairs=None,
+                     only_rules: set | None = None
+                     ) -> list[tuple[Finding, str]]:
+        """Run the whole-program rules over one Program built from every
+        parsed module.  Registry/config errors (ProgramError) propagate:
+        a misdeclared parity pair must fail the gate loudly, not lint as
+        if the pair didn't exist."""
+        program_rules = [
+            r for r in self.rules
+            if type(r).check_program is not Rule.check_program
+            and (only_rules is None or r.id in only_rules)]
+        if not program_rules or not modules:
+            return []
+        from tools.graftlint.program import Program
+
+        program = Program(modules, pairs=pairs)
+        by_path = {m.path: m for m in modules}
+        out: list[tuple[Finding, str]] = []
+        for rule in program_rules:
+            for f in rule.check_program(program):
+                if only_rules is None and not rule.applies_to(f.path):
+                    continue
+                module = by_path.get(f.path)
+                if module is None or module.suppressed(f.line, f.rule):
+                    continue
+                out.append((f, module.line_text(f.line)))
+        return out
 
 
 def default_engine() -> LintEngine:
@@ -205,3 +247,17 @@ def lint_source(text: str, path: str = "karpenter_tpu/solver/_snippet.py",
 def lint_paths(root: Path, paths: Iterable[Path]
                ) -> tuple[list[tuple[Finding, str]], list[str]]:
     return default_engine().lint_files(root, paths)
+
+
+def lint_program_sources(sources: dict[str, str],
+                         pairs=None,
+                         only_rules: set | None = None) -> list[Finding]:
+    """Test/fixture entry point for the whole-program rules: lint a
+    {path: source} dict as one Program.  ``pairs`` substitutes a fixture
+    parity-pair registry for the committed one."""
+    modules = [SourceModule(p, t) for p, t in sorted(sources.items())]
+    found = default_engine().lint_program(modules, pairs=pairs,
+                                          only_rules=only_rules)
+    out = [f for f, _ in found]
+    out.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+    return out

@@ -1,0 +1,167 @@
+"""Parity-pair registry: the declarative kernel <-> oracle contract map.
+
+Every device kernel in this repo has a numpy twin that must stay
+bit-identical (8-seed differential tests enforce the values; the GL2xx
+rules enforce the *structure*: shared constants, no duplicated literals,
+no float reductions on parity-bearing values).  This file is the single
+place that knows which function pairs with which — registering a new
+solve plane means adding one ``PairSpec`` here (docs/design/graftlint.md
+has the recipe).
+
+Symbol syntax: ``"repo/relative/path.py::qualname"`` where qualname is a
+module-level function or class (``"Cls.method"`` also resolves).  A
+``shared`` entry names a constant/helper BOTH sides must reference from
+the same home module (GL203); misspelt symbols are a hard engine error
+(ProgramError), never a silent no-op.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+from collections.abc import Sequence
+
+from tools.graftlint.program import Program, ProgramError, dotted_name
+
+
+@dataclass(frozen=True)
+class PairSpec:
+    """One kernel/oracle contract.  ``device`` may list several entry
+    points lowering to the same oracle (scan/pref/pallas all pair with
+    GreedySolver)."""
+
+    name: str
+    device: tuple[str, ...]
+    oracle: tuple[str, ...]
+    shared: tuple[str, ...] = ()
+
+
+@dataclass
+class ResolvedPair:
+    spec: PairSpec
+    device_roots: list[tuple[str, ast.AST]] = field(default_factory=list)
+    oracle_roots: list[tuple[str, ast.AST]] = field(default_factory=list)
+    # (dotted home module, symbol name) for each `shared` entry
+    shared_syms: list[tuple[str, str]] = field(default_factory=list)
+
+
+# The committed registry — every solve plane's device kernel mapped to
+# its numpy oracle.  Ordering follows the planes' introduction order.
+PAIRS: tuple[PairSpec, ...] = (
+    PairSpec(
+        name="solver-scan",
+        device=("karpenter_tpu/solver/jax_backend.py::solve_packed",),
+        oracle=("karpenter_tpu/solver/greedy.py::GreedySolver",),
+    ),
+    PairSpec(
+        name="solver-pref",
+        device=("karpenter_tpu/solver/jax_backend.py::solve_packed_pref",),
+        oracle=("karpenter_tpu/solver/greedy.py::GreedySolver",),
+    ),
+    PairSpec(
+        name="solver-pallas",
+        device=("karpenter_tpu/solver/jax_backend.py::solve_packed_pallas",),
+        oracle=("karpenter_tpu/solver/greedy.py::GreedySolver",),
+    ),
+    PairSpec(
+        name="stochastic",
+        device=("karpenter_tpu/stochastic/kernel.py::"
+                "solve_packed_stochastic",),
+        oracle=("karpenter_tpu/stochastic/greedy.py::"
+                "solve_stochastic_host",),
+        # the chance-constraint contract: identical z^2 table, identical
+        # iteration count, identical fit-score clamp, one shared
+        # sentinel (arXiv:2207.11122 discipline — see PAPER.md)
+        shared=(
+            "karpenter_tpu/stochastic/__init__.py::CHANCE_FIT_MAX",
+            "karpenter_tpu/stochastic/__init__.py::CHANCE_ITERS",
+            "karpenter_tpu/stochastic/__init__.py::zsq_value",
+            "karpenter_tpu/solver/types.py::FIT_BIG",
+        ),
+    ),
+    PairSpec(
+        name="preempt-fit-grid",
+        device=("karpenter_tpu/preempt/planner.py::_device_fit_grid",),
+        oracle=("karpenter_tpu/preempt/greedy.py::"
+                "GreedyPreemptionPlanner",),
+    ),
+    PairSpec(
+        name="gang-free-grid",
+        device=("karpenter_tpu/gang/planner.py::_device_free_grid",),
+        oracle=("karpenter_tpu/gang/greedy.py::GreedyGangPlanner",),
+    ),
+    PairSpec(
+        name="repack-score-grid",
+        device=("karpenter_tpu/repack/planner.py::_device_score_grid",),
+        oracle=("karpenter_tpu/repack/greedy.py::GreedyRepacker",),
+    ),
+    PairSpec(
+        name="sharded-rebalance",
+        device=("karpenter_tpu/sharded/kernels.py::rebalance_shards",),
+        oracle=("karpenter_tpu/sharded/kernels.py::rebalance_oracle",),
+    ),
+    PairSpec(
+        name="whatif-scenarios",
+        device=("karpenter_tpu/whatif/kernels.py::solve_scenarios",),
+        oracle=("karpenter_tpu/whatif/oracle.py::solve_scenarios_np",),
+        shared=("karpenter_tpu/solver/types.py::FIT_BIG",),
+    ),
+    PairSpec(
+        name="explain-words",
+        device=("karpenter_tpu/solver/jax_backend.py::_explain_words",),
+        oracle=("karpenter_tpu/explain/greedy.py::reason_words",),
+    ),
+)
+
+
+def _split(sym: str) -> tuple[str, str]:
+    path, sep, qual = sym.partition("::")
+    if not sep or not path.endswith(".py") or not qual:
+        raise ProgramError(
+            f"parity registry: malformed symbol {sym!r} "
+            f"(expected 'path/to/file.py::qualname')")
+    return path, qual
+
+
+def resolve_pairs(program: Program,
+                  specs: Sequence[PairSpec] | None = None
+                  ) -> list[ResolvedPair]:
+    """Resolve the registry against one Program.  Pairs whose modules
+    are not all loaded (targeted/partial lint runs) are skipped; a
+    loaded module that lacks a declared symbol is a hard ProgramError —
+    a renamed kernel must update the registry in the same commit."""
+    if specs is None:
+        specs = program.pairs if program.pairs is not None else PAIRS
+    out: list[ResolvedPair] = []
+    for spec in specs:
+        entries = [(kind, _split(s))
+                   for kind, syms in (("device", spec.device),
+                                      ("oracle", spec.oracle),
+                                      ("shared", spec.shared))
+                   for s in syms]
+        if not all(path in program.infos for _, (path, _) in entries):
+            continue
+        rp = ResolvedPair(spec=spec)
+        for kind, (path, qual) in entries:
+            info = program.infos[path]
+            if kind == "shared":
+                if qual not in info.constants \
+                        and qual not in info.functions \
+                        and qual not in info.classes:
+                    raise ProgramError(
+                        f"parity registry: pair '{spec.name}' shared "
+                        f"symbol {path}::{qual} does not exist — fix "
+                        f"the registry or restore the symbol")
+                rp.shared_syms.append((dotted_name(path), qual))
+                continue
+            node = info.functions.get(qual) or info.classes.get(qual)
+            if node is None:
+                raise ProgramError(
+                    f"parity registry: pair '{spec.name}' {kind} symbol "
+                    f"{path}::{qual} does not exist — fix the registry "
+                    f"or restore the symbol")
+            roots = rp.device_roots if kind == "device" \
+                else rp.oracle_roots
+            roots.append((path, node))
+        out.append(rp)
+    return out

@@ -1,0 +1,796 @@
+"""Whole-program model for the GL2xx contract analyses.
+
+Single-file rules see one AST; the contract rules (parity pairs, the
+jit-boundary call graph, the lock-order graph) need to resolve names
+*across* modules: which module a constant really lives in after
+``from x import y as z`` aliasing, which function a cross-module call
+lands in, and which class owns the lock behind ``self.provisioner.
+_solve_lock``.  ``Program`` is that model — a symbol table + import
+resolver + call-graph builder over every parsed module of one lint run,
+shared by all ``check_program`` rules (built once per ``lint_files``).
+
+Stdlib-only like the rest of the engine: everything here is ast walks
+and dict lookups, no imports of the linted code.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from collections import deque
+from dataclasses import dataclass, field
+from collections.abc import Iterable, Sequence
+
+from tools.graftlint.engine import SourceModule
+from tools.graftlint.rules import jaxctx
+from tools.graftlint.rules.concurrency import _CV_NAME_RE, _LOCK_NAME_RE
+from tools.graftlint.rules.jaxctx import attr_chain
+
+# module-level names that count as contract constants for GL201/GL203
+# (the repo convention: ALL_CAPS, optionally underscore-private)
+_CONST_NAME_RE = re.compile(r"^_?[A-Z][A-Z0-9_]*$")
+_LOCK_CTORS = {"Lock", "RLock", "Semaphore", "BoundedSemaphore"}
+_CV_CTOR = "Condition"
+
+
+def dotted_name(path: str) -> str:
+    """repo-relative posix path -> importable dotted module name."""
+    mod = path[:-3] if path.endswith(".py") else path
+    if mod.endswith("/__init__"):
+        mod = mod[: -len("/__init__")]
+    return mod.replace("/", ".")
+
+
+@dataclass(frozen=True)
+class ImportBinding:
+    """One local name bound by an import statement."""
+
+    module: str             # dotted source module (repo or external)
+    name: str | None        # symbol pulled from it; None = module itself
+
+
+@dataclass(frozen=True)
+class FuncRef:
+    """Stable cross-module function identity."""
+
+    path: str               # repo-relative posix path
+    qualname: str           # "f" or "Cls.f"
+
+    @property
+    def label(self) -> str:
+        return f"{self.path}::{self.qualname}"
+
+
+@dataclass(frozen=True)
+class LockId:
+    """Identity of one runtime lock object: the class (or module) that
+    created it plus the attribute it lives under.  ``self._lock`` in two
+    different classes are two locks; ``self.provisioner._solve_lock`` in
+    a controller and ``self._solve_lock`` in Provisioner are one."""
+
+    path: str               # module of the owner
+    owner: str              # class name, or "<module>" for module globals
+    attr: str
+
+    @property
+    def label(self) -> str:
+        return f"{self.path}::{self.owner}.{self.attr}"
+
+
+class ModuleInfo:
+    """Per-module symbol table: imports (with aliasing), module-level
+    constants, functions/methods by qualname, classes, and per-class
+    attribute types recovered from ``__init__`` assignments and
+    annotations."""
+
+    def __init__(self, module: SourceModule):
+        self.module = module
+        self.path = module.path
+        self.dotted = dotted_name(module.path)
+        self.package = self.dotted.rsplit(".", 1)[0] \
+            if "." in self.dotted else self.dotted
+        if module.path.endswith("/__init__.py"):
+            self.package = self.dotted
+        self.imports: dict[str, ImportBinding] = {}
+        # plain `import a.b.c` bindings, keyed by the full dotted prefix
+        self.module_imports: dict[str, str] = {}
+        self.constants: dict[str, ast.Assign] = {}
+        self.functions: dict[str, ast.AST] = {}
+        self.classes: dict[str, ast.ClassDef] = {}
+        # (class name, attr) -> annotation/constructor name chain
+        self.attr_types: dict[tuple[str, str], list[str]] = {}
+        # (class name, cv attr) -> lock attr it wraps (Condition(self.X))
+        self.cv_alias: dict[tuple[str, str], str] = {}
+        # (class name | "<module>", attr) -> lock ctor name
+        self.lock_ctors: dict[tuple[str, str], str] = {}
+        self._scan()
+
+    # -- construction ------------------------------------------------------
+
+    def _scan(self) -> None:
+        tree = self.module.tree
+        for node in tree.body:
+            if isinstance(node, ast.Import):
+                for alias in node.names:
+                    local = alias.asname or alias.name.split(".")[0]
+                    if alias.asname:
+                        self.imports[local] = ImportBinding(alias.name, None)
+                    else:
+                        # `import a.b.c` binds `a`, but attribute chains
+                        # resolve through the full dotted path
+                        self.module_imports[alias.name] = alias.name
+                        self.imports.setdefault(
+                            local, ImportBinding(alias.name.split(".")[0],
+                                                 None))
+            elif isinstance(node, ast.ImportFrom):
+                src = self._from_module(node)
+                if src is None:
+                    continue
+                for alias in node.names:
+                    if alias.name == "*":
+                        continue
+                    local = alias.asname or alias.name
+                    self.imports[local] = ImportBinding(src, alias.name)
+            elif isinstance(node, ast.Assign):
+                for t in node.targets:
+                    if isinstance(t, ast.Name) and \
+                            _CONST_NAME_RE.match(t.id):
+                        self.constants[t.id] = node
+                    if isinstance(t, ast.Name) and \
+                            self._lock_ctor_name(node.value):
+                        self.lock_ctors[("<module>", t.id)] = \
+                            self._lock_ctor_name(node.value)
+        for node in ast.walk(tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                qual = self._qualname(node)
+                if qual is not None:
+                    self.functions[qual] = node
+            elif isinstance(node, ast.ClassDef):
+                self.classes[node.name] = node
+        for cls in self.classes.values():
+            self._scan_class(cls)
+
+    def _from_module(self, node: ast.ImportFrom) -> str | None:
+        if node.level == 0:
+            return node.module
+        # relative import: resolve against this module's package
+        base = self.package.split(".")
+        up = node.level - 1
+        if up > 0:
+            if up >= len(base):
+                return None
+            base = base[:-up]
+        if node.module:
+            base = base + node.module.split(".")
+        return ".".join(base)
+
+    def _qualname(self, fn: ast.AST) -> str | None:
+        """Module functions -> "f", methods -> "Cls.f"; nested defs get
+        no qualname (they are not cross-module call targets)."""
+        for cls in self.classes.values():
+            if fn in cls.body:
+                return f"{cls.name}.{fn.name}"
+        if fn in self.module.tree.body:
+            return fn.name
+        return None
+
+    @staticmethod
+    def _lock_ctor_name(value: ast.AST) -> str | None:
+        if not isinstance(value, ast.Call):
+            return None
+        name = jaxctx.func_terminal_name(value.func)
+        if name in _LOCK_CTORS or name == _CV_CTOR:
+            return name
+        return None
+
+    def _scan_class(self, cls: ast.ClassDef) -> None:
+        # class-level annotations: `x: Provisioner`
+        for stmt in cls.body:
+            if isinstance(stmt, ast.AnnAssign) and \
+                    isinstance(stmt.target, ast.Name):
+                chain = self._annotation_chain(stmt.annotation)
+                if chain:
+                    self.attr_types[(cls.name, stmt.target.id)] = chain
+        params: dict[str, list[str]] = {}
+        init = next((s for s in cls.body
+                     if isinstance(s, ast.FunctionDef)
+                     and s.name == "__init__"), None)
+        if init is not None:
+            for a in init.args.posonlyargs + init.args.args + \
+                    init.args.kwonlyargs:
+                chain = self._annotation_chain(a.annotation)
+                if chain:
+                    params[a.arg] = chain
+        for method in cls.body:
+            if not isinstance(method, (ast.FunctionDef,
+                                       ast.AsyncFunctionDef)):
+                continue
+            for node in ast.walk(method):
+                targets: list[ast.expr] = []
+                value: ast.AST | None = None
+                if isinstance(node, ast.Assign):
+                    targets, value = node.targets, node.value
+                elif isinstance(node, ast.AnnAssign):
+                    targets, value = [node.target], node.value
+                    chain = self._annotation_chain(node.annotation)
+                    if chain and isinstance(node.target, ast.Attribute) \
+                            and isinstance(node.target.value, ast.Name) \
+                            and node.target.value.id == "self":
+                        self.attr_types[(cls.name, node.target.attr)] = chain
+                for t in targets:
+                    if not (isinstance(t, ast.Attribute)
+                            and isinstance(t.value, ast.Name)
+                            and t.value.id == "self"):
+                        continue
+                    attr = t.attr
+                    ctor = self._lock_ctor_name(value) if value else None
+                    if ctor:
+                        self.lock_ctors[(cls.name, attr)] = ctor
+                        if ctor == _CV_CTOR and isinstance(value, ast.Call) \
+                                and value.args:
+                            wrapped = value.args[0]
+                            if isinstance(wrapped, ast.Attribute) and \
+                                    isinstance(wrapped.value, ast.Name) and \
+                                    wrapped.value.id == "self":
+                                self.cv_alias[(cls.name, attr)] = \
+                                    wrapped.attr
+                        continue
+                    if isinstance(value, ast.Call):
+                        chain = attr_chain(value.func)
+                        if chain:
+                            self.attr_types.setdefault(
+                                (cls.name, attr), chain)
+                    elif isinstance(value, ast.Name) and \
+                            value.id in params:
+                        self.attr_types.setdefault(
+                            (cls.name, attr), params[value.id])
+
+    @staticmethod
+    def _annotation_chain(ann: ast.AST | None) -> list[str] | None:
+        if ann is None:
+            return None
+        if isinstance(ann, ast.Constant) and isinstance(ann.value, str):
+            try:
+                ann = ast.parse(ann.value, mode="eval").body
+            except SyntaxError:
+                return None
+        if isinstance(ann, ast.Subscript):        # Optional[X] / list[X]
+            name = jaxctx.func_terminal_name(ann.value)
+            if name in ("Optional",):
+                ann = ann.slice
+        chain = attr_chain(ann)
+        return chain or None
+
+
+class ProgramError(Exception):
+    """Raised for configuration errors the engine must surface as hard
+    failures (e.g. a parity-registry symbol that resolves to nothing)."""
+
+
+class Program:
+    """The whole-program view: every parsed module plus lazily built
+    cross-module analyses (call graph, traced closure, lock graph)."""
+
+    def __init__(self, modules: Iterable[SourceModule],
+                 pairs: Sequence | None = None):
+        # parity-pair registry override for fixtures; None = the
+        # committed registry (tools/graftlint/pairs.py)
+        self.pairs = pairs
+        self.infos: dict[str, ModuleInfo] = {}
+        self.by_dotted: dict[str, ModuleInfo] = {}
+        for m in modules:
+            info = ModuleInfo(m)
+            self.infos[info.path] = info
+            self.by_dotted[info.dotted] = info
+        self._analyses: dict[str, jaxctx.JaxModuleAnalysis] = {}
+        self._local_kernels: dict[str, set[int]] = {}
+        self._traced_origins: dict[
+            str, dict[ast.AST, str]] | None = None
+        self._lock_graph: LockGraph | None = None
+
+    # -- symbol resolution -------------------------------------------------
+
+    def module_of(self, dotted: str) -> ModuleInfo | None:
+        return self.by_dotted.get(dotted)
+
+    def resolve_symbol_home(self, dotted: str, name: str,
+                            _depth: int = 0) -> tuple[str, str]:
+        """Follow re-export chains: where is ``dotted.name`` actually
+        defined?  -> (dotted module, name); external modules are their
+        own home."""
+        info = self.by_dotted.get(dotted)
+        if info is None or _depth > 8:
+            return (dotted, name)
+        if name in info.constants or name in info.functions \
+                or name in info.classes:
+            return (dotted, name)
+        b = info.imports.get(name)
+        if b is not None and b.name is not None:
+            return self.resolve_symbol_home(b.module, b.name, _depth + 1)
+        return (dotted, name)
+
+    def resolve_reference(self, info: ModuleInfo,
+                          node: ast.AST) -> tuple[str, str] | None:
+        """Resolve a Name/Attribute reference to the (dotted home module,
+        symbol) it denotes, following import aliasing.  None for locals,
+        self-attributes, and anything unresolvable."""
+        if isinstance(node, ast.Name):
+            name = node.id
+            if name in info.constants or name in info.functions \
+                    or name in info.classes:
+                return (info.dotted, name)
+            b = info.imports.get(name)
+            if b is not None and b.name is not None:
+                return self.resolve_symbol_home(b.module, b.name)
+            return None
+        if isinstance(node, ast.Attribute):
+            chain = attr_chain(node)
+            if len(chain) < 2 or chain[0] in ("self", "cls"):
+                return None
+            # longest dotted prefix bound by `import a.b.c`
+            for cut in range(len(chain) - 1, 0, -1):
+                prefix = ".".join(chain[:cut])
+                if prefix in info.module_imports:
+                    if cut == len(chain) - 1:
+                        return self.resolve_symbol_home(prefix, chain[-1])
+                    return None
+            b = info.imports.get(chain[0])
+            if b is not None and b.name is None and len(chain) == 2:
+                return self.resolve_symbol_home(b.module, chain[1])
+            return None
+        return None
+
+    def resolve_call(self, info: ModuleInfo, call: ast.Call,
+                     enclosing_class: str | None) -> FuncRef | None:
+        """Resolve a call expression to the function it invokes,
+        anywhere in the program.  Conservative: unresolvable calls
+        return None rather than guessing."""
+        func = call.func
+        if isinstance(func, ast.Name):
+            ref = self.resolve_reference(info, func)
+            return self._as_func(ref)
+        if isinstance(func, ast.Attribute):
+            chain = attr_chain(func)
+            if chain[:1] == ["self"] or chain[:1] == ["cls"]:
+                if enclosing_class is None:
+                    return None
+                if len(chain) == 2:
+                    qual = f"{enclosing_class}.{chain[1]}"
+                    if qual in info.functions:
+                        return FuncRef(info.path, qual)
+                    return None
+                if len(chain) == 3:
+                    owner = self.resolve_attr_class(
+                        info, enclosing_class, chain[1])
+                    if owner is not None:
+                        oinfo, ocls = owner
+                        qual = f"{ocls}.{chain[2]}"
+                        if qual in oinfo.functions:
+                            return FuncRef(oinfo.path, qual)
+                return None
+            ref = self.resolve_reference(info, func)
+            fn = self._as_func(ref)
+            if fn is not None:
+                return fn
+            # ClassName.method / imported_class.method
+            if len(chain) == 2:
+                cref = self.resolve_reference(
+                    info, ast.copy_location(ast.Name(id=chain[0],
+                                                     ctx=ast.Load()), func))
+                if cref is not None:
+                    cinfo = self.by_dotted.get(cref[0])
+                    if cinfo is not None and cref[1] in cinfo.classes:
+                        qual = f"{cref[1]}.{chain[1]}"
+                        if qual in cinfo.functions:
+                            return FuncRef(cinfo.path, qual)
+        return None
+
+    def _as_func(self, ref: tuple[str, str] | None) -> FuncRef | None:
+        if ref is None:
+            return None
+        info = self.by_dotted.get(ref[0])
+        if info is not None and ref[1] in info.functions:
+            return FuncRef(info.path, ref[1])
+        return None
+
+    def resolve_attr_class(self, info: ModuleInfo, cls: str,
+                           attr: str) -> tuple[ModuleInfo, str] | None:
+        """Which program class is ``self.<attr>`` (in class ``cls``) an
+        instance of?  Recovered from __init__ assignments/annotations."""
+        chain = info.attr_types.get((cls, attr))
+        if not chain:
+            return None
+        if len(chain) == 1 and chain[0] in info.classes:
+            return (info, chain[0])
+        node: ast.AST = ast.Name(id=chain[0], ctx=ast.Load())
+        for part in chain[1:]:
+            node = ast.Attribute(value=node, attr=part, ctx=ast.Load())
+        ref = self.resolve_reference(info, node)
+        if ref is None:
+            return None
+        tinfo = self.by_dotted.get(ref[0])
+        if tinfo is not None and ref[1] in tinfo.classes:
+            return (tinfo, ref[1])
+        return None
+
+    def lookup_func(self, path: str, qualname: str) -> ast.AST | None:
+        info = self.infos.get(path)
+        if info is None:
+            return None
+        return info.functions.get(qualname) or info.classes.get(qualname)
+
+    def enclosing_class_of(self, info: ModuleInfo,
+                           fn: ast.AST) -> str | None:
+        for cls in info.classes.values():
+            if fn in cls.body:
+                return cls.name
+        return None
+
+    # -- jit-boundary traced closure (GL204) -------------------------------
+
+    def analysis_of(self, path: str) -> jaxctx.JaxModuleAnalysis:
+        """Program-private jaxctx analysis (NOT the per-file rule cache:
+        the traced-closure builder injects cross-module kernels into
+        these, which must never leak into single-file rule results)."""
+        a = self._analyses.get(path)
+        if a is None:
+            a = jaxctx.JaxModuleAnalysis(self.infos[path].module)
+            self._analyses[path] = a
+            self._local_kernels[path] = {id(fn) for fn in a.kernels}
+        return a
+
+    def traced_origins(self) -> dict[str, dict[ast.AST, str]]:
+        """path -> {fn node: origin label} for functions that execute
+        traced ONLY because a jitted kernel in another module calls them
+        (their own file looks innocent to the single-file rules)."""
+        if self._traced_origins is not None:
+            return self._traced_origins
+        origins: dict[str, dict[ast.AST, str]] = {
+            p: {} for p in self.infos}
+        queue = deque(self.infos)
+        seen_edges: set[tuple[str, int, str, int]] = set()
+        rounds = 0
+        while queue and rounds < 20 * max(1, len(self.infos)):
+            rounds += 1
+            path = queue.popleft()
+            info = self.infos[path]
+            analysis = self.analysis_of(path)
+            for fn, kinfo in list(analysis.kernels.items()):
+                caller_cls = self.enclosing_class_of(info, fn)
+                caller_qual = fn.name if caller_cls is None \
+                    else f"{caller_cls}.{fn.name}"
+                for node in analysis.body_nodes(fn):
+                    if not isinstance(node, ast.Call):
+                        continue
+                    ref = self.resolve_call(info, node, caller_cls)
+                    if ref is None or ref.path == path:
+                        continue
+                    callee = self.lookup_func(ref.path, ref.qualname)
+                    if callee is None or not isinstance(
+                            callee, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)):
+                        continue
+                    edge = (path, id(fn), ref.path, id(callee))
+                    tainted = self._call_taint(
+                        analysis, kinfo, node, callee)
+                    target = self.analysis_of(ref.path)
+                    changed = target._add_kernel(
+                        callee, "called", tainted, set())
+                    if id(callee) not in self._local_kernels[ref.path]:
+                        origins[ref.path].setdefault(
+                            callee,
+                            f"{path}::{caller_qual}")
+                    if changed or edge not in seen_edges:
+                        seen_edges.add(edge)
+                        target._propagate()
+                        if ref.path != path:
+                            queue.append(ref.path)
+        self._traced_origins = origins
+        return origins
+
+    @staticmethod
+    def _call_taint(analysis: jaxctx.JaxModuleAnalysis,
+                    kinfo: jaxctx.KernelInfo, call: ast.Call,
+                    callee: ast.AST) -> set[str]:
+        pos = jaxctx.positional_params(callee)
+        if pos and pos[0] in ("self", "cls"):
+            pos = pos[1:]
+        tainted: set[str] = set()
+        for i, arg in enumerate(call.args):
+            if isinstance(arg, ast.Starred):
+                continue
+            if i < len(pos) and analysis.expr_tainted(arg, kinfo):
+                tainted.add(pos[i])
+        params = set(jaxctx.all_params(callee))
+        for kw in call.keywords:
+            if kw.arg and kw.arg in params and \
+                    analysis.expr_tainted(kw.value, kinfo):
+                tainted.add(kw.arg)
+        return tainted
+
+    # -- reference closure for the parity pairs (GL201/GL203) --------------
+
+    def reference_closure(self, roots: Sequence[tuple[str, ast.AST]]
+                          ) -> set[str]:
+        """Modules forming one side of a parity contract: the modules
+        holding the root functions plus every repo-internal module a
+        root actually references a symbol from (one hop down the
+        import-resolved call/constant graph)."""
+        out: set[str] = set()
+        for path, node in roots:
+            out.add(path)
+            info = self.infos[path]
+            for n in ast.walk(node):
+                if not isinstance(n, (ast.Name, ast.Attribute)):
+                    continue
+                ref = self.resolve_reference(info, n)
+                if ref is None:
+                    continue
+                target = self.by_dotted.get(ref[0])
+                if target is not None:
+                    out.add(target.path)
+        return out
+
+    # -- lock graph (GL205) ------------------------------------------------
+
+    def lock_graph(self) -> "LockGraph":
+        if self._lock_graph is None:
+            self._lock_graph = LockGraph(self)
+        return self._lock_graph
+
+
+# -- lock-order analysis ---------------------------------------------------
+
+
+@dataclass
+class LockEdge:
+    held: LockId
+    acquired: LockId
+    path: str               # module where the ordering happens
+    line: int
+    col: int
+    via: str                # "" for a direct nested `with`, else callee label
+
+
+@dataclass
+class _FuncLocks:
+    """Per-function lock summary."""
+
+    direct: set[LockId] = field(default_factory=set)
+    # calls made anywhere in the body: (callee, node, locks held at call)
+    calls: list[tuple[FuncRef, ast.Call, tuple[LockId, ...]]] = \
+        field(default_factory=list)
+    # direct nested orderings observed lexically
+    edges: list[LockEdge] = field(default_factory=list)
+
+
+class LockGraph:
+    """Acquisition-order graph over every lock the program creates.
+    Edges A->B mean "B was acquired while A was held" (directly nested
+    `with`, or via a call made under A to a function that acquires B,
+    transitively).  A cycle is a lock-order inversion."""
+
+    def __init__(self, program: Program):
+        self.program = program
+        self.summaries: dict[FuncRef, _FuncLocks] = {}
+        for path, info in program.infos.items():
+            for qual, fn in info.functions.items():
+                self.summaries[FuncRef(path, qual)] = \
+                    self._summarize(info, qual, fn)
+        self.transitive = self._settle_transitive()
+        self.edges = self._collect_edges()
+
+    # - per-function scan -
+
+    def _summarize(self, info: ModuleInfo, qual: str,
+                   fn: ast.AST) -> _FuncLocks:
+        cls = qual.split(".")[0] if "." in qual else None
+        out = _FuncLocks()
+
+        def walk(node: ast.AST, held: tuple[LockId, ...]) -> None:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.Lambda)) and node is not fn:
+                return
+            if isinstance(node, (ast.With, ast.AsyncWith)):
+                h = held
+                for item in node.items:
+                    walk(item.context_expr, h)
+                    lid = self._lock_id(info, cls, item.context_expr)
+                    if lid is not None:
+                        out.direct.add(lid)
+                        for prior in h:
+                            if prior != lid:
+                                out.edges.append(LockEdge(
+                                    held=prior, acquired=lid,
+                                    path=info.path,
+                                    line=item.context_expr.lineno,
+                                    col=item.context_expr.col_offset,
+                                    via=""))
+                        if lid not in h:
+                            h = h + (lid,)
+                for stmt in node.body:
+                    walk(stmt, h)
+                return
+            if isinstance(node, ast.Call):
+                ref = self.program.resolve_call(info, node, cls)
+                if ref is not None and ref != FuncRef(info.path, qual):
+                    out.calls.append((ref, node, held))
+            for child in ast.iter_child_nodes(node):
+                walk(child, held)
+
+        for stmt in fn.body:
+            walk(stmt, ())
+        return out
+
+    def _lock_id(self, info: ModuleInfo, cls: str | None,
+                 expr: ast.AST) -> LockId | None:
+        chain = attr_chain(expr)
+        if not chain:
+            return None
+        name = chain[-1]
+        if not (_LOCK_NAME_RE.search(name) or _CV_NAME_RE.search(name)):
+            return None
+        if chain[0] in ("self", "cls") and cls is not None:
+            if len(chain) == 2:
+                return self._owned(info, cls, name)
+            if len(chain) == 3:
+                owner = self.program.resolve_attr_class(info, cls,
+                                                        chain[1])
+                if owner is not None:
+                    oinfo, ocls = owner
+                    return self._owned(oinfo, ocls, name)
+                # unknown owner: keep it distinct per (class, attr path)
+                # rather than aliasing unrelated locks together
+                return LockId(info.path, f"{cls}.{chain[1]}", name)
+            return None
+        if len(chain) == 1:
+            if ("<module>", name) in info.lock_ctors:
+                return LockId(info.path, "<module>", name)
+            b = info.imports.get(name)
+            if b is not None and b.name is not None:
+                home, sym = self.program.resolve_symbol_home(
+                    b.module, b.name)
+                hinfo = self.program.by_dotted.get(home)
+                if hinfo is not None:
+                    return LockId(hinfo.path, "<module>", sym)
+            return None
+        if len(chain) == 2:
+            ref = self.program.resolve_reference(info, expr)
+            if ref is not None:
+                hinfo = self.program.by_dotted.get(ref[0])
+                if hinfo is not None:
+                    return LockId(hinfo.path, "<module>", ref[1])
+        return None
+
+    @staticmethod
+    def _owned(info: ModuleInfo, cls: str, attr: str) -> LockId:
+        # a Condition created around an existing lock IS that lock
+        attr = info.cv_alias.get((cls, attr), attr)
+        return LockId(info.path, cls, attr)
+
+    # - interprocedural -
+
+    def _settle_transitive(self) -> dict[FuncRef, set[LockId]]:
+        trans = {ref: set(s.direct) for ref, s in self.summaries.items()}
+        changed = True
+        rounds = 0
+        while changed and rounds < 50:
+            changed = False
+            rounds += 1
+            for ref, summary in self.summaries.items():
+                acc = trans[ref]
+                before = len(acc)
+                for callee, _, _ in summary.calls:
+                    acc |= trans.get(callee, set())
+                if len(acc) != before:
+                    changed = True
+        return trans
+
+    def _collect_edges(self) -> list[LockEdge]:
+        edges: list[LockEdge] = list(
+            e for s in self.summaries.values() for e in s.edges)
+        for ref, summary in self.summaries.items():
+            for callee, node, held in summary.calls:
+                if not held:
+                    continue
+                for lid in self.transitive.get(callee, ()):  # noqa: B007
+                    for prior in held:
+                        if prior != lid:
+                            edges.append(LockEdge(
+                                held=prior, acquired=lid,
+                                path=ref.path, line=node.lineno,
+                                col=node.col_offset,
+                                via=callee.label))
+        return edges
+
+    def inversions(self) -> list[tuple[LockEdge, LockEdge | None,
+                                       tuple[LockId, ...]]]:
+        """-> [(edge, first opposing edge or None, SCC members)] — one
+        entry per unordered lock pair participating in a cycle."""
+        graph: dict[LockId, set[LockId]] = {}
+        by_pair: dict[tuple[LockId, LockId], LockEdge] = {}
+        for e in self.edges:
+            graph.setdefault(e.held, set()).add(e.acquired)
+            graph.setdefault(e.acquired, set())
+            key = (e.held, e.acquired)
+            prev = by_pair.get(key)
+            if prev is None or (e.path, e.line) < (prev.path, prev.line):
+                by_pair[key] = e
+        sccs = _tarjan(graph)
+        out: list[tuple[LockEdge, LockEdge | None,
+                        tuple[LockId, ...]]] = []
+        reported: set[frozenset[LockId]] = set()
+        for scc in sccs:
+            if len(scc) < 2:
+                continue
+            members = tuple(sorted(scc, key=lambda l: l.label))
+            for (a, b), edge in sorted(
+                    by_pair.items(),
+                    key=lambda kv: (kv[1].path, kv[1].line)):
+                if a not in scc or b not in scc:
+                    continue
+                pair = frozenset((a, b))
+                if pair in reported:
+                    continue
+                reported.add(pair)
+                out.append((edge, by_pair.get((b, a)), members))
+        return out
+
+
+def _tarjan(graph: dict[LockId, set[LockId]]) -> list[set[LockId]]:
+    """Iterative Tarjan SCC (the lock graph is tiny, but recursion
+    depth must not depend on program shape)."""
+    index: dict[LockId, int] = {}
+    low: dict[LockId, int] = {}
+    on_stack: set[LockId] = set()
+    stack: list[LockId] = []
+    sccs: list[set[LockId]] = []
+    counter = [0]
+
+    for root in graph:
+        if root in index:
+            continue
+        work: list[tuple[LockId, list[LockId]]] = [
+            (root, sorted(graph.get(root, ()), key=lambda l: l.label))]
+        index[root] = low[root] = counter[0]
+        counter[0] += 1
+        stack.append(root)
+        on_stack.add(root)
+        path: list[LockId] = [root]
+        while work:
+            node, children = work[-1]
+            if children:
+                child = children.pop(0)
+                if child not in index:
+                    index[child] = low[child] = counter[0]
+                    counter[0] += 1
+                    stack.append(child)
+                    on_stack.add(child)
+                    work.append((child, sorted(graph.get(child, ()),
+                                               key=lambda l: l.label)))
+                    path.append(child)
+                elif child in on_stack:
+                    low[node] = min(low[node], index[child])
+            else:
+                work.pop()
+                path.pop()
+                if work:
+                    parent = work[-1][0]
+                    low[parent] = min(low[parent], low[node])
+                if low[node] == index[node]:
+                    scc: set[LockId] = set()
+                    while True:
+                        w = stack.pop()
+                        on_stack.discard(w)
+                        scc.add(w)
+                        if w == node:
+                            break
+                    sccs.append(scc)
+    return sccs
+
+
+def program_from_sources(sources: dict[str, str],
+                         pairs: Sequence | None = None) -> Program:
+    """Test/fixture entry: build a Program from {path: source} pairs."""
+    return Program((SourceModule(p, t) for p, t in sources.items()),
+                   pairs=pairs)

@@ -2,17 +2,24 @@
 
 Adding a rule = write a ``Rule`` subclass in one of the family modules
 (or a new module) and list it here.  IDs are stable and never reused:
-GL0xx = Family A (JAX/TPU purity), GL1xx = Family B (concurrency).
+GL0xx = Family A (JAX/TPU purity), GL1xx = Family B (concurrency),
+GL2xx = Family C (whole-program contracts — these implement
+``check_program`` over the Program model instead of per-file ``check``).
 """
 
 from __future__ import annotations
 
 
 from tools.graftlint.engine import Rule
-from tools.graftlint.rules import concurrency, jax_purity, observability
 
 
 def all_rules() -> list[type[Rule]]:
+    # imported here, not at module top: contracts -> pairs -> program ->
+    # jaxctx re-enters this package, so the registry must not force the
+    # whole family tree during package init
+    from tools.graftlint.rules import (concurrency, contracts, jax_purity,
+                                       observability)
+
     return [
         # Family A — JAX/TPU purity
         jax_purity.HostSyncInKernel,          # GL001
@@ -32,4 +39,10 @@ def all_rules() -> list[type[Rule]]:
         observability.ReasonEnumDrift,        # GL108
         observability.BlockingSyncInHotPath,  # GL109
         concurrency.UnjournaledMutation,      # GL110
+        # Family C — whole-program contracts
+        contracts.DuplicatedContractConstant,   # GL201
+        contracts.FloatReductionInParityPath,   # GL202
+        contracts.OneSidedContractSymbol,       # GL203
+        contracts.TracedCrossModuleImpurity,    # GL204
+        contracts.LockOrderInversion,           # GL205
     ]

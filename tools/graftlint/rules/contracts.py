@@ -1,0 +1,464 @@
+"""Family C — whole-program contract rules (GL201-GL205).
+
+Single-file rules guard local idiom; these guard the two program-wide
+invariants everything else leans on:
+
+* the **parity contract** — every device kernel is bit-identical to its
+  numpy oracle (GL201 duplicated constants, GL202 float reductions,
+  GL203 one-sided contract symbols, driven by the declarative registry
+  in tools/graftlint/pairs.py), and
+
+* the **execution contracts** — code reached *through* a jit boundary
+  stays pure even when it lives in another file (GL204), and locks are
+  acquired in one global order across every controller call path
+  (GL205).
+
+All five run as ``check_program`` rules over the Program model
+(tools/graftlint/program.py) built once per lint run.
+"""
+
+from __future__ import annotations
+
+import ast
+from collections.abc import Iterator
+
+from tools.graftlint.engine import Finding, Rule, SourceModule
+from tools.graftlint.pairs import ResolvedPair, resolve_pairs
+from tools.graftlint.program import Program
+from tools.graftlint.rules import jaxctx
+from tools.graftlint.rules.jax_purity import (HostSyncInKernel,
+                                              TracerBoolCoercion)
+from tools.graftlint.rules.jaxctx import attr_chain, func_terminal_name
+from tools.graftlint.rules.observability import BlockingSyncInHotPath
+
+CONTRACT_SCOPE = ("karpenter_tpu/*", "karpenter_tpu/**/*", "bench.py")
+
+
+class _ContractRule(Rule):
+    family = "C"
+    scope = CONTRACT_SCOPE
+
+    def check(self, module: SourceModule) -> Iterator[Finding]:
+        return iter(())
+
+    def program_finding(self, path: str, node: ast.AST,
+                        message: str) -> Finding:
+        return Finding(path=path, line=node.lineno,
+                       col=getattr(node, "col_offset", 0),
+                       rule=self.id, message=message)
+
+
+# -- helpers shared by the parity rules ------------------------------------
+
+def _side_functions(program: Program,
+                    roots: list[tuple[str, ast.AST]]
+                    ) -> list[tuple[str, ast.AST]]:
+    """The functions making up one side of a pair: the roots (class
+    roots contribute every method) plus same-module functions they call
+    by name, transitively — the whole local lowering of the kernel."""
+    out: list[tuple[str, ast.AST]] = []
+    seen: set[int] = set()
+    work: list[tuple[str, ast.AST]] = []
+    for path, node in roots:
+        if isinstance(node, ast.ClassDef):
+            for stmt in node.body:
+                if isinstance(stmt, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)):
+                    work.append((path, stmt))
+        else:
+            work.append((path, node))
+    while work:
+        path, fn = work.pop()
+        if id(fn) in seen:
+            continue
+        seen.add(id(fn))
+        out.append((path, fn))
+        info = program.infos[path]
+        local = {f.name: f for q, f in info.functions.items()
+                 if "." not in q}
+        for n in ast.walk(fn):
+            if isinstance(n, ast.Call) and isinstance(n.func, ast.Name) \
+                    and n.func.id in local:
+                work.append((path, local[n.func.id]))
+    return out
+
+
+class DuplicatedContractConstant(_ContractRule):
+    id = "GL201"
+    name = "duplicated-contract-constant"
+    description = (
+        "A module-level constant with the same name is defined "
+        "independently on both sides of a parity pair (device kernel vs "
+        "numpy oracle) instead of being imported from one shared home. "
+        "Two literals that must stay equal WILL drift — the 8-seed "
+        "differential tests only catch it after the fact. Hoist the "
+        "constant into one module and import it (aliasing is fine: "
+        "`from x import FIT_BIG as _BIG`)."
+    )
+
+    def check_program(self, program: Program) -> Iterator[Finding]:
+        for rp in resolve_pairs(program):
+            dev = program.reference_closure(
+                _side_functions(program, rp.device_roots))
+            orc = program.reference_closure(
+                _side_functions(program, rp.oracle_roots))
+            dev_defs = self._defs_by_name(program, dev)
+            orc_defs = self._defs_by_name(program, orc)
+            for cname in sorted(set(dev_defs) & set(orc_defs)):
+                d_paths = {p for p, _ in dev_defs[cname]}
+                o_paths = {p for p, _ in orc_defs[cname]}
+                if d_paths & o_paths:
+                    continue        # defined in a module both sides share
+                d_path, d_node = dev_defs[cname][0]
+                o_path, o_node = orc_defs[cname][0]
+                yield self.program_finding(
+                    d_path, d_node,
+                    f"contract constant `{cname}` of parity pair "
+                    f"'{rp.spec.name}' is defined here AND in the "
+                    f"oracle side at {o_path}:{o_node.lineno} — "
+                    f"duplicated literals drift; hoist to one shared "
+                    f"module and import it on both sides")
+
+    @staticmethod
+    def _defs_by_name(program: Program, closure: set[str]
+                      ) -> dict[str, list[tuple[str, ast.Assign]]]:
+        out: dict[str, list[tuple[str, ast.Assign]]] = {}
+        for path in sorted(closure):
+            for cname, node in program.infos[path].constants.items():
+                out.setdefault(cname, []).append((path, node))
+        return out
+
+
+class FloatReductionInParityPath(_ContractRule):
+    id = "GL202"
+    name = "float-reduction-in-parity-path"
+    description = (
+        "sum/dot/matmul/einsum (or any accumulating reduction) over "
+        "float values inside a parity-registered kernel or oracle. "
+        "Float accumulation order is backend-dependent, so a reduction "
+        "on a parity-bearing float breaks device<->numpy bit-identity; "
+        "the contract is single elementwise IEEE ops only (integer "
+        "reductions are exact and stay legal). Known-excluded words "
+        "(the masked cost word) carry an inline disable with the "
+        "carve-out documented."
+    )
+
+    _REDUCTIONS = {"sum", "nansum", "dot", "vdot", "matmul", "tensordot",
+                   "einsum", "mean", "nanmean", "average", "prod",
+                   "cumsum", "cumprod"}
+    _FLOAT_FUNCS = {"sqrt", "exp", "expm1", "log", "log1p", "log2",
+                    "erf", "erfc", "sigmoid", "float_power", "divide",
+                    "true_divide"}
+    _FLOAT_ATTRS = {"float32", "float64", "floating", "float_", "half",
+                    "bfloat16", "float16"}
+
+    def check_program(self, program: Program) -> Iterator[Finding]:
+        for rp in resolve_pairs(program):
+            for side, roots in (("device", rp.device_roots),
+                                ("oracle", rp.oracle_roots)):
+                for path, fn in _side_functions(program, roots):
+                    yield from self._check_fn(rp, side, path, fn)
+
+    def _check_fn(self, rp: ResolvedPair, side: str, path: str,
+                  fn: ast.AST) -> Iterator[Finding]:
+        floats = self._float_names(fn)
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            name = func_terminal_name(node.func)
+            if name not in self._REDUCTIONS:
+                continue
+            operands: list[ast.AST] = list(node.args) + \
+                [k.value for k in node.keywords if k.arg in (None, "a",
+                                                             "x", "b")]
+            if isinstance(node.func, ast.Attribute) and not (
+                    isinstance(node.func.value, ast.Name)
+                    and node.func.value.id in ("np", "numpy", "jnp",
+                                               "jax", "lax", "math",
+                                               "onp")):
+                operands.append(node.func.value)     # x.sum() receiver
+            if any(self._is_float(o, floats) for o in operands):
+                yield self.program_finding(
+                    path, node,
+                    f"float reduction `{name}` in the {side} side of "
+                    f"parity pair '{rp.spec.name}' — accumulation order "
+                    f"is backend-dependent and breaks device<->oracle "
+                    f"bit-identity; keep parity-bearing float math "
+                    f"single elementwise IEEE ops")
+
+    def _float_names(self, fn: ast.AST) -> set[str]:
+        floats: set[str] = set()
+        for _ in range(3):
+            before = len(floats)
+            for node in ast.walk(fn):
+                targets: list[ast.expr] = []
+                value: ast.AST | None = None
+                if isinstance(node, ast.Assign):
+                    targets, value = node.targets, node.value
+                elif isinstance(node, ast.AugAssign):
+                    targets, value = [node.target], node.value
+                elif isinstance(node, ast.AnnAssign) and node.value:
+                    targets, value = [node.target], node.value
+                if value is None or not self._is_float(value, floats):
+                    continue
+                for t in targets:
+                    for n in ast.walk(t):
+                        if isinstance(n, ast.Name):
+                            floats.add(n.id)
+            if len(floats) == before:
+                break
+        return floats
+
+    # calls whose result is exact (integer or index) no matter the
+    # operand dtype — they launder float taint instead of spreading it
+    _INT_RESULT = {"argmin", "argmax", "argsort", "searchsorted",
+                   "count_nonzero", "nonzero", "sign", "rint", "int"}
+    _MODULE_BASES = {"np", "numpy", "onp", "jnp", "jax", "lax", "math"}
+
+    def _is_float(self, node: ast.AST, floats: set[str]) -> bool:
+        """Structural float taint.  Deliberately launders at exact
+        boundaries: comparisons (bool), argmin/astype(int32) (indices),
+        and bool-mask -> float32 casts (the MXU counting idiom: 0/1
+        floats sum exactly) — only genuinely inexact values spread."""
+        if isinstance(node, ast.Name):
+            return node.id in floats
+        if isinstance(node, ast.Constant):
+            return isinstance(node.value, float)
+        if isinstance(node, ast.Compare):
+            return False
+        if isinstance(node, ast.Lambda):
+            return False
+        if isinstance(node, ast.BinOp):
+            if isinstance(node.op, ast.Div):
+                return True
+            return self._is_float(node.left, floats) \
+                or self._is_float(node.right, floats)
+        if isinstance(node, ast.Attribute):
+            if node.attr in self._FLOAT_ATTRS or \
+                    node.attr in ("inf", "nan"):
+                return True
+            return self._is_float(node.value, floats)
+        if isinstance(node, ast.Call):
+            name = func_terminal_name(node.func)
+            if name in self._FLOAT_FUNCS or name == "float":
+                return True
+            if name in self._INT_RESULT:
+                return False
+            if name == "astype":
+                if not any(self._is_float_dtype(a) for a in node.args):
+                    return False            # cast to int: exact
+                base = node.func.value if isinstance(node.func,
+                                                     ast.Attribute) \
+                    else None
+                # bool-mask -> float32 counting is integer-valued/exact
+                return base is None or not self._is_exact_mask(base)
+            if isinstance(node.func, ast.Attribute):
+                base = node.func.value
+                if isinstance(base, ast.Name) and \
+                        base.id in self._MODULE_BASES:
+                    # np/jnp/lax elementwise ops pass float-ness through
+                    return any(self._is_float(a, floats)
+                               for a in node.args) or \
+                        any(self._is_float(k.value, floats)
+                            for k in node.keywords)
+                # a method on a value (x.clip(...), x.sum()): float iff
+                # the receiver is
+                return self._is_float(base, floats)
+            # a local helper call: its return dtype is unknowable here —
+            # stay precise and don't spread taint through it (the helper
+            # body is checked as its own side function anyway)
+            return False
+        return any(self._is_float(c, floats)
+                   for c in ast.iter_child_nodes(node))
+
+    @classmethod
+    def _is_exact_mask(cls, node: ast.AST) -> bool:
+        """Boolean-valued expressions: comparisons, ~mask, mask & mask."""
+        if isinstance(node, ast.Compare):
+            return True
+        if isinstance(node, ast.UnaryOp) and \
+                isinstance(node.op, (ast.Invert, ast.Not)):
+            # `~compat` / `not x`: boolean-mask idiom regardless of what
+            # the operand name resolves to
+            return True
+        if isinstance(node, ast.BinOp) and \
+                isinstance(node.op, (ast.BitAnd, ast.BitOr, ast.BitXor)):
+            return cls._is_exact_mask(node.left) or \
+                cls._is_exact_mask(node.right)
+        if isinstance(node, ast.BoolOp):
+            return True
+        return False
+
+    def _is_float_dtype(self, node: ast.AST) -> bool:
+        if isinstance(node, ast.Constant) and \
+                isinstance(node.value, str):
+            return "float" in node.value
+        if isinstance(node, ast.Attribute):
+            return node.attr in self._FLOAT_ATTRS
+        return False
+
+
+class OneSidedContractSymbol(_ContractRule):
+    id = "GL203"
+    name = "one-sided-contract-symbol"
+    description = (
+        "A parity pair declares a shared contract symbol (registry "
+        "`shared=`) that only one side actually references: the other "
+        "side either hard-codes the value or silently dropped it — "
+        "either way the contract is no longer machine-checked. Both the "
+        "device kernel and the numpy oracle must resolve the symbol "
+        "from its one home module (import aliasing counts)."
+    )
+
+    def check_program(self, program: Program) -> Iterator[Finding]:
+        for rp in resolve_pairs(program):
+            if not rp.shared_syms:
+                continue
+            dev = program.reference_closure(
+                _side_functions(program, rp.device_roots))
+            orc = program.reference_closure(
+                _side_functions(program, rp.oracle_roots))
+            for home, sym in rp.shared_syms:
+                home_info = program.by_dotted.get(home)
+                home_path = home_info.path if home_info else None
+                d = self._references(program, dev - {home_path}, home, sym)
+                o = self._references(program, orc - {home_path}, home, sym)
+                if d and o:
+                    continue
+                if not d and not o:
+                    path, node = rp.device_roots[0]
+                    yield self.program_finding(
+                        path, node,
+                        f"parity pair '{rp.spec.name}' declares shared "
+                        f"symbol `{home}.{sym}` but NEITHER side "
+                        f"references it — stale registry entry or both "
+                        f"sides hard-code the value")
+                    continue
+                missing, roots = ("oracle", rp.oracle_roots) if not o \
+                    else ("device", rp.device_roots)
+                path, node = roots[0]
+                yield self.program_finding(
+                    path, node,
+                    f"{missing} side of parity pair '{rp.spec.name}' "
+                    f"never references shared contract symbol "
+                    f"`{home}.{sym}` (the other side does) — import it "
+                    f"from its home module instead of hard-coding")
+
+    @staticmethod
+    def _references(program: Program, closure: set[str], home: str,
+                    sym: str) -> bool:
+        target = (home, sym)
+        for path in closure:
+            if path is None:
+                continue
+            info = program.infos[path]
+            for node in ast.walk(info.module.tree):
+                if not isinstance(node, (ast.Name, ast.Attribute)):
+                    continue
+                if program.resolve_reference(info, node) == target:
+                    return True
+        return False
+
+
+class TracedCrossModuleImpurity(_ContractRule):
+    id = "GL204"
+    name = "traced-cross-module-impurity"
+    description = (
+        "Host sync, tracer-bool control flow, or a blocking device sync "
+        "inside a helper that executes traced because a jitted kernel "
+        "in ANOTHER module calls it. The helper's own file looks "
+        "innocent to the single-file purity rules (GL001/GL002/GL109); "
+        "the jit-boundary call graph re-scopes them interprocedurally."
+    )
+
+    def check_program(self, program: Program) -> Iterator[Finding]:
+        origins = program.traced_origins()
+        host_sync = HostSyncInKernel()
+        for path in sorted(origins):
+            fns = origins[path]
+            if not fns:
+                continue
+            analysis = program.analysis_of(path)
+            for fn in sorted(fns, key=lambda f: f.lineno):
+                origin = fns[fn]
+                info = analysis.kernels.get(fn)
+                if info is None:
+                    continue
+                seen: set[tuple[int, int]] = set()
+                for node in analysis.body_nodes(fn):
+                    msg = self._impurity(node, analysis, info, host_sync)
+                    if msg is None:
+                        continue
+                    key = (node.lineno, node.col_offset)
+                    if key in seen:
+                        continue
+                    seen.add(key)
+                    yield self.program_finding(
+                        path, node,
+                        f"{msg} [`{fn.name}` executes traced: called "
+                        f"from jitted `{origin}`]")
+
+    @staticmethod
+    def _impurity(node: ast.AST, analysis: jaxctx.JaxModuleAnalysis,
+                  info: jaxctx.KernelInfo,
+                  host_sync: HostSyncInKernel) -> str | None:
+        if isinstance(node, ast.Call):
+            msg = host_sync._host_sync_message(node, analysis, info)
+            if msg:
+                return msg
+            what = BlockingSyncInHotPath._blocking_sync(node)
+            if what:
+                return (f"blocking device sync `{what}` inside a "
+                        f"traced body")
+            chain = attr_chain(node.func)
+            if chain[-1:] == ["sleep"]:
+                return f"`{'.'.join(chain)}(...)` inside a traced body"
+            return None
+        test: ast.expr | None = None
+        kind = ""
+        if isinstance(node, (ast.If, ast.While)):
+            test, kind = node.test, type(node).__name__.lower()
+        elif isinstance(node, ast.Assert):
+            test, kind = node.test, "assert"
+        elif isinstance(node, ast.IfExp):
+            test, kind = node.test, "conditional expression"
+        if test is None or TracerBoolCoercion._is_staticness_check(test):
+            return None
+        if analysis.expr_tainted(test, info):
+            return (f"`{kind}` on a traced value — use lax.cond/"
+                    f"jnp.where (or mark the argument static)")
+        return None
+
+
+class LockOrderInversion(_ContractRule):
+    id = "GL205"
+    name = "lock-order-inversion"
+    description = (
+        "Two locks are acquired in opposite orders on different call "
+        "paths (directly nested `with`, or via calls made while a lock "
+        "is held — the graph follows self.X.method() through the class "
+        "attribute types). Opposite orderings deadlock the moment both "
+        "paths run concurrently; the controller plane must acquire "
+        "solve lock -> journal lock -> store lock in one global order."
+    )
+
+    def check_program(self, program: Program) -> Iterator[Finding]:
+        graph = program.lock_graph()
+        for edge, reverse, members in graph.inversions():
+            via = f" (via call to {edge.via})" if edge.via else ""
+            if reverse is not None:
+                rvia = f" via {reverse.via}" if reverse.via else ""
+                detail = (f"the opposite order is taken at "
+                          f"{reverse.path}:{reverse.line}{rvia}")
+            else:
+                detail = ("part of an acquisition cycle through " +
+                          ", ".join(m.label for m in members))
+            yield Finding(
+                path=edge.path, line=edge.line, col=edge.col,
+                rule=self.id,
+                message=(
+                    f"lock-order inversion: acquires "
+                    f"{edge.acquired.label} while holding "
+                    f"{edge.held.label}{via}, but {detail} — pick one "
+                    f"global order and take both locks in it on every "
+                    f"path"))

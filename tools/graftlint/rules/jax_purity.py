@@ -386,3 +386,67 @@ class MissingDonation(_FamilyARule):
                     f"({', '.join(nonstatic[:3])}...) but declares no "
                     f"donate_argnums — the transient input buffer stays "
                     f"alive across the call")
+
+    def check_program(self, program) -> Iterator[Finding]:
+        """Call-form jit (`jit(f)`, `partial(jax.jit, ...)(f)`) resolved
+        through the whole-program call graph: the per-file pass only
+        sees decorator form, so an entry point jitted indirectly —
+        possibly from another module — escaped the donation check."""
+        for path in sorted(program.infos):
+            info = program.infos[path]
+            for node in ast.walk(info.module.tree):
+                target = self._jit_call_target(node)
+                if target is None:
+                    continue
+                kwargs = dict(jaxctx.jit_call_kwargs(node))
+                if isinstance(node.func, ast.Call):
+                    kwargs.update(jaxctx.jit_call_kwargs(node.func))
+                ref = program.resolve_reference(info, target)
+                fn = None
+                if ref is not None:
+                    tinfo = program.by_dotted.get(ref[0])
+                    if tinfo is not None:
+                        fn = tinfo.functions.get(ref[1])
+                if fn is None:
+                    continue
+                name = fn.name
+                if not name.startswith(self._ENTRY_PREFIXES):
+                    continue
+                static = set(jaxctx._const_str_seq(
+                    kwargs.get("static_argnames")))
+                pos = jaxctx.positional_params(fn)
+                for i in jaxctx._const_int_seq(
+                        kwargs.get("static_argnums")):
+                    if 0 <= i < len(pos):
+                        static.add(pos[i])
+                nonstatic = [p for p in pos
+                             if p not in static and p not in ("self",
+                                                              "cls")]
+                if not nonstatic:
+                    continue
+                if "donate_argnums" in kwargs or \
+                        "donate_argnames" in kwargs:
+                    continue
+                yield Finding(
+                    path=path, line=node.lineno, col=node.col_offset,
+                    rule=self.id,
+                    message=(
+                        f"call-form jit of solve entry `{name}` "
+                        f"({ref[0]}) takes array buffers "
+                        f"({', '.join(nonstatic[:3])}...) but declares "
+                        f"no donate_argnums — indirect dispatch doesn't "
+                        f"exempt the transient buffer from donation"))
+
+    @staticmethod
+    def _jit_call_target(node: ast.AST) -> ast.AST | None:
+        """For `jit(f, ...)` / `jax.jit(f, ...)` /
+        `partial(jax.jit, ...)(f)` -> the `f` expression."""
+        if not isinstance(node, ast.Call) or not node.args:
+            return None
+        func = node.func
+        if isinstance(func, (ast.Name, ast.Attribute)) and \
+                jaxctx.is_jit_expr(func):
+            return node.args[0]
+        if isinstance(func, ast.Call) and jaxctx.is_jit_expr(func):
+            return node.args[0]
+        return None
