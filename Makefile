@@ -87,6 +87,10 @@ crash-matrix: ## Crashpoint x seed matrix: kill/restart the operator at seeded c
 recovery-check: ## Full recovery-time gate: journal replay (zero duplicate creates) + AOT prewarm + resident rebuild (tools/warm_restart_check.py)
 	JAX_PLATFORMS=cpu $(PY) tools/warm_restart_check.py
 
+.PHONY: failover-check
+failover-check: ## N-1 device failover gate: quarantine a live mesh device mid-stream; sharded service keeps placing, journal converges, device heals (tools/failover_check.py)
+	$(TEST_ENV) $(PY) tools/failover_check.py
+
 .PHONY: chaos-replay
 chaos-replay: ## Replay one failing scenario: make chaos-replay PROFILE=spot-storm SEED=3
 	$(TEST_ENV) $(PY) -m karpenter_tpu.chaos \

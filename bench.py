@@ -2209,6 +2209,105 @@ def run_stochastic(num_pods: int = 10000, num_types: int = 500,
     }}
 
 
+def run_faulttol(num_pods: int = 600, num_types: int = 60,
+                 windows: int = 6, trials: int = 5,
+                 hedge_windows: int = 12) -> dict:
+    """ISSUE 17: device-fault survivability (docs/design/faulttol.md) —
+    what surviving the device costs:
+
+    - **healthy_overhead_fraction**: guard bookkeeping wall over the
+      profiler's estimated dispatch wall on a clean windowed stream
+      (the <1% acceptance gate, also pinned in tests/test_faulttol.py);
+    - **failover_p50_ms**: wall of the first window after a device
+      quarantine — the N-1 mesh remap + stacked rebuild + solve on a
+      multi-device mesh, or the host hedge on a single-device host;
+    - **hedge_rate**: fraction of windows the resilient wrapper served
+      through the host ladder under a seeded fault injector (lower =
+      fewer windows paid the hedge).
+    """
+    import random as pyrandom
+
+    from karpenter_tpu.apis.pod import PodSpec, ResourceRequests
+    from karpenter_tpu.catalog import (
+        CatalogArrays, InstanceTypeProvider, PricingProvider,
+    )
+    from karpenter_tpu.cloud.fake import FakeCloud, generate_profiles
+    from karpenter_tpu.faulttol import get_health_board
+    from karpenter_tpu.faulttol.inject import (
+        FaultyDeviceInjector, clear_injector, install_injector,
+    )
+    from karpenter_tpu.sharded import ShardedSolveService
+    from karpenter_tpu.sharded.degraded import ResilientShardedService
+
+    cloud = FakeCloud(profiles=generate_profiles(num_types))
+    pricing = PricingProvider(cloud)
+    catalog = CatalogArrays.build(InstanceTypeProvider(cloud,
+                                                      pricing).list())
+    pricing.close()
+
+    def stream_pods(rng, n):
+        return [PodSpec(f"ft{rng.randint(1 << 30)}-{i}",
+                        requests=ResourceRequests(
+                            int(rng.randint(100, 900)),
+                            int(rng.randint(256, 2048)), 0, 1))
+                for i in range(n)]
+
+    board = get_health_board()
+    clear_injector()
+    board.reset()
+
+    # -- healthy path: clean stream, guard armed, no injector ------------
+    svc = ShardedSolveService(2)
+    rng = np.random.RandomState(5)
+    svc.admit(stream_pods(rng, num_pods))
+    for _ in range(windows):
+        svc.solve_window(catalog)
+        svc.admit(stream_pods(rng, 32))
+    healthy_overhead = board.healthy_overhead_fraction()
+    guards = board.snapshot()["guards_entered"]
+
+    # -- failover: quarantine a live mesh device mid-stream --------------
+    failover_walls = []
+    for t in range(trials):
+        board.reset()
+        fsvc = ResilientShardedService(ShardedSolveService(2))
+        fsvc.admit(stream_pods(np.random.RandomState(100 + t),
+                               max(num_pods // 2, 64)))
+        fsvc.solve_window(catalog)       # warm: stacked state resident
+        victim = fsvc.mesh.devices.flat[0]
+        vid = f"{victim.platform}:{victim.id}"
+        for _ in range(3):
+            board.record_fault(vid, kind="error", kernel="bench")
+        t0 = time.perf_counter()
+        fsvc.solve_window(catalog)       # remap or host hedge
+        failover_walls.append(time.perf_counter() - t0)
+    board.reset()
+
+    # -- hedge rate: seeded injector, resilient wrapper keeps serving ----
+    hsvc = ResilientShardedService(ShardedSolveService(2))
+    rng = np.random.RandomState(17)
+    hsvc.admit(stream_pods(rng, max(num_pods // 2, 64)))
+    install_injector(FaultyDeviceInjector(
+        pyrandom.Random("bench-faulttol"),
+        {"error": 0.08, "hang": 0.04}))
+    try:
+        for _ in range(hedge_windows):
+            hsvc.solve_window(catalog)
+            hsvc.admit(stream_pods(rng, 16))
+    finally:
+        clear_injector()
+        board.reset()
+
+    return {"faulttol": {
+        "healthy_overhead_fraction": round(healthy_overhead, 6),
+        "guards_entered": int(guards),
+        "failover_p50_ms": round(p50(failover_walls) * 1000, 3),
+        "failover_max_ms": round(max(failover_walls) * 1000, 3),
+        "hedge_rate": round(hsvc.degraded_windows / hedge_windows, 4),
+        "hedge_windows": hedge_windows,
+    }}
+
+
 def run_graftlint() -> dict:
     """ISSUE 16: static-analysis gate cost — full-scan wall seconds.
     The GL2xx whole-program pass (parity-pair closures, jit-boundary
@@ -2494,6 +2593,19 @@ def main():
         result["whatif_error"] = str(e)[:200]
 
     try:
+        # ISSUE 17: device-fault survivability — healthy-path guard
+        # overhead (<1% gate), post-quarantine failover wall, and the
+        # host-hedge rate under a seeded fault injector
+        result.update(run_faulttol(
+            num_pods=200 if args.quick else 600,
+            num_types=30 if args.quick else 60,
+            windows=3 if args.quick else 6,
+            trials=3 if args.quick else 5,
+            hedge_windows=6 if args.quick else 12))
+    except Exception as e:  # noqa: BLE001
+        result["faulttol_error"] = str(e)[:200]
+
+    try:
         # ISSUE 16: graftlint full-scan wall seconds (the whole-program
         # contract pass must stay cheap enough to gate every commit)
         result.update(run_graftlint())
@@ -2700,6 +2812,14 @@ def compute_target_met(result: dict) -> dict:
                   or result["device_time"]["measured_overhead_fraction"]
                   < 0.01))
             if "device_time" in result else None,
+        # ISSUE 17 acceptance: the device_guard seam costs <1% of the
+        # estimated dispatch wall on the healthy path, and the seeded
+        # hedge run never lost a window (every degraded window was
+        # served by the host ladder, never dropped)
+        "faulttol_overhead_under_1pct":
+            (0.0 <= result["faulttol"]["healthy_overhead_fraction"] < 0.01
+             and result["faulttol"]["guards_entered"] > 0)
+            if "faulttol" in result else None,
     }
 
 

@@ -171,6 +171,13 @@ class CrashHarness:
         self.crashes = 0
         self._needs_boot = True
         self.catalog_provider.list(nc)     # warm outside the traced window
+        # warm the native extension here too: load() shells out to make,
+        # and subprocess internals poll via time.sleep — under the
+        # patched clock that advances virtual time nondeterministically
+        # on the FIRST ffd_solve of a fresh process (run 2 hits the
+        # module cache, so only run 1 skews: exactly the digest flake)
+        from karpenter_tpu import native as _native
+        _native.load()
 
     # -- the operator plane (dies on crash) --------------------------------
 

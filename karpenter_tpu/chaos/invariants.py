@@ -72,7 +72,7 @@ class InvariantChecker:
                  trace: EventTrace | None = None, preemption=None,
                  gang=None, resident=None, repack=None,
                  explain_violations: list[str] | None = None,
-                 stochastic=None, sharded=None):
+                 stochastic=None, sharded=None, faulttol=None):
         self.cluster = cluster
         self.cloud = cloud              # ground truth: the UNWRAPPED fake
         self.unavailable = unavailable
@@ -113,6 +113,11 @@ class InvariantChecker:
         # service + window/catalog getters — backs the shards-converge
         # invariant (karpenter_tpu/sharded)
         self.sharded = sharded
+        # faulttol probe (or None): the device-fault profile's health
+        # board, injector and window-accounting ground truth — backs the
+        # no-window-lost (round) and health-converges (final) invariants
+        # (karpenter_tpu/faulttol)
+        self.faulttol = faulttol
 
     # -- round invariants ----------------------------------------------------
 
@@ -128,6 +133,7 @@ class InvariantChecker:
         out.extend(self._repack_plans_valid())
         out.extend(self._risk_model_consistent())
         out.extend(self._shards_converge())
+        out.extend(self._no_window_lost())
         if self.trace is not None:
             self.trace.add("invariants", phase="round", violations=len(out),
                            kinds=sorted({v.invariant for v in out}))
@@ -500,7 +506,59 @@ class InvariantChecker:
                     f"column ({diff} offerings differ)"))
         return out
 
+    def _no_window_lost(self) -> list[Violation]:
+        """Every provisioning beat's window completed — on the device or
+        via the bit-identical host failover — no matter what the device
+        injector did.  Ground truth is the harness's own pump count
+        (``probe.windows_expected``) against the resident store's and
+        sharded service's window accounting: a lost window (a dispatch
+        hang that stalled the loop, a fault that escaped the fallback
+        ladder) shows up as a beat that never accounted."""
+        probe = self.faulttol
+        if probe is None or probe.windows_expected == 0:
+            return []
+        out = []
+        if probe.resident is not None \
+                and probe.resident.windows != probe.windows_expected:
+            out.append(Violation(
+                "no-window-lost",
+                f"resident store accounted {probe.resident.windows} "
+                f"windows over {probe.windows_expected} beats "
+                f"(injector faults: "
+                f"{probe.injector.injected if probe.injector else 0})"))
+        if probe.sharded is not None \
+                and probe.sharded.windows != probe.windows_expected:
+            out.append(Violation(
+                "no-window-lost",
+                f"sharded service accounted {probe.sharded.windows} "
+                f"windows over {probe.windows_expected} beats "
+                f"(degraded: "
+                f"{getattr(probe.sharded, 'degraded_windows', 0)})"))
+        return out
+
     # -- final (eventual) invariants -----------------------------------------
+
+    def _health_converges(self) -> list[Violation]:
+        """After quiesce (injector disarmed, probes succeeding), no
+        device is still quarantined or stuck in probation: the
+        quarantine -> probation -> probe -> healthy machine must have
+        walked every faulted device back."""
+        probe = self.faulttol
+        if probe is None or probe.board is None:
+            return []
+        from karpenter_tpu.faulttol import HEALTHY
+
+        snap = probe.board.snapshot()
+        out = []
+        for device, d in sorted(snap.get("devices", {}).items()):
+            if d["state"] != HEALTHY:
+                out.append(Violation(
+                    "health-converges",
+                    f"device {device} still {d['state']} after quiesce "
+                    f"(faults_in_window={d['faults_in_window']}, "
+                    f"quarantines={d['quarantines']}, "
+                    f"last_kind={d['last_kind']})"))
+        return out
 
     def check_final(self, catalog=None) -> list[Violation]:
         out: list[Violation] = []
@@ -514,6 +572,7 @@ class InvariantChecker:
         out.extend(self._preempted_pods_resolve(catalog))
         out.extend(self._gangs_resolve_or_release(catalog))
         out.extend(self._violation_rate_under_bound())
+        out.extend(self._health_converges())
         if self.trace is not None:
             self.trace.add("invariants", phase="final", violations=len(out),
                            kinds=sorted({v.invariant for v in out}))

@@ -104,6 +104,11 @@ class ChaosProfile:
     # load concentrates and only the rebalance collective can drain it.
     shard_count: int = 0
     shard_hot_rate: float = 0.0
+    # device-fault plane (karpenter_tpu/faulttol): kind -> per-dispatch
+    # probability for the deterministic FaultyDeviceInjector installed
+    # at the device_guard seam (kinds: hang, error, oom, corrupt).
+    # Non-empty arms the no-window-lost + health-converges invariants.
+    device_fault_rates: dict[str, float] = field(default_factory=dict)
     # global live-instance cap imposed on the fake cloud for the chaos
     # window (0 = unlimited); lifts at quiesce.  Demand past the cap is
     # genuine overload: creates fail with quota_exceeded and pending
@@ -238,6 +243,21 @@ PROFILES: dict[str, ChaosProfile] = _profiles(
         pod_waves=6, pods_per_wave=(10, 24),
         preempt_storm_rate=0.35, preempt_storm_frac=0.45,
         error_rates={"create_instance": 0.08}),
+    ChaosProfile(
+        name="device-fault",
+        description="hung/faulted/OOM/corrupt device dispatches injected "
+                    "at the device_guard seam while the sharded plane "
+                    "and resident store keep solving — every window "
+                    "must complete via deadline-bounded host failover "
+                    "(no-window-lost), quarantined devices must recover "
+                    "through probation by quiesce (health-converges), "
+                    "and resident-state-fresh / shards-converge must "
+                    "hold throughout",
+        device_fault_rates={"hang": 0.05, "error": 0.05, "oom": 0.03,
+                            "corrupt": 0.03},
+        shard_count=2,
+        pod_waves=6, pods_per_wave=(8, 24),
+        error_rates={"create_instance": 0.05}),
     ChaosProfile(
         name="fragmentation",
         description="scattered accelerator singletons + parked slice "

@@ -116,6 +116,22 @@ class RepackProbe:
 
 
 @dataclass
+class FaulttolProbe:
+    """What the device-fault invariants need: the process health board
+    (final-state ground truth for health-converges), the injector (the
+    fault schedule actually applied), the resident store and sharded
+    service whose window accounting no-window-lost audits, and the
+    harness's own pump count (``windows_expected``) as the independent
+    beat ledger."""
+
+    board: object
+    injector: object          # FaultyDeviceInjector or None
+    resident: object          # ResidentStore or None
+    sharded: object           # ResilientShardedService or None
+    windows_expected: int = 0
+
+
+@dataclass
 class ScenarioResult:
     profile: str
     seed: int
@@ -126,6 +142,10 @@ class ScenarioResult:
     # flight-recorder span dump (JSON-safe dicts): the causal record
     # behind any violation — dumped next to the event trace on failure
     spans: list = None
+    # the harness itself (post-run inspection: health board, injector
+    # counts, window accounting) — tools/failover_check and the
+    # faulttol tests read it; stays out of any serialized artifact
+    harness: object = None
 
     @property
     def ok(self) -> bool:
@@ -282,8 +302,15 @@ class ChaosHarness:
         self.sharded = None
         if profile.shard_count:
             from karpenter_tpu.sharded import ShardedSolveService
+            from karpenter_tpu.sharded.degraded import (
+                ResilientShardedService,
+            )
 
-            self.sharded = ShardedSolveService(profile.shard_count)
+            # the PRODUCTION degraded wrapper, same as the solver above:
+            # a device-faulted window must degrade to the host oracle,
+            # never fail the pump (no-window-lost)
+            self.sharded = ResilientShardedService(
+                ShardedSolveService(profile.shard_count))
         # migration-first repack plane (fragmentation profile): the
         # PRODUCTION DisruptionController, defrag scoring live, every
         # executed plan logged for the repack-plan-valid invariant
@@ -321,6 +348,26 @@ class ChaosHarness:
                 self.trace.add("config", disabled_controller=ctrl.name)
                 continue
             self.manager.register(ctrl)
+        # device-fault plane (karpenter_tpu/faulttol): pristine health
+        # board per scenario, then the seeded injector for profiles that
+        # arm it — its stream is independent of cloud/world/solver, so a
+        # device-fault schedule never perturbs the other schedules
+        from karpenter_tpu.faulttol import (
+            FaultyDeviceInjector, clear_injector, get_health_board,
+            install_injector,
+        )
+
+        clear_injector()
+        get_health_board().reset()
+        self.injector = None
+        if profile.device_fault_rates:
+            self.injector = FaultyDeviceInjector(
+                random.Random(f"{profile.name}:{seed}:device"),
+                profile.device_fault_rates, trace=self.trace)
+            install_injector(self.injector)
+        self.ft_probe = FaulttolProbe(
+            board=get_health_board(), injector=self.injector,
+            resident=self.resident, sharded=self.sharded)
         gc_grace = GarbageCollectionController.min_instance_age
         reg_timeout = GarbageCollectionController.registration_timeout
         self.checker = InvariantChecker(
@@ -356,10 +403,18 @@ class ChaosHarness:
                     self.nodeclass),
                 model=lambda: self.risk_model,
                 seed=seed)
-            if profile.overcommit_eps else None)
+            if profile.overcommit_eps else None,
+            faulttol=self.ft_probe)
         # warm the catalog before chaos arms (pricing resolution happens
         # here, outside the deterministic traced window)
         self.catalog_provider.list(nc)
+        # warm the native extension before the virtual clock installs:
+        # native.load() shells out to make, and subprocess internals poll
+        # via time.sleep — under the patched clock that advances virtual
+        # time nondeterministically on the FIRST ffd_solve of a fresh
+        # process, skewing the run-1 digest (run 2 hits the module cache)
+        from karpenter_tpu import native as _native
+        _native.load()
 
     def _controllers(self) -> list:
         return [
@@ -408,6 +463,10 @@ class ChaosHarness:
                 self.chaos_cloud.disarm()
                 self.unstable.failure_rate = 0.0
                 self.fake.instance_quota = self._default_quota
+                if self.injector is not None:
+                    # device faults lift with the rest: probation probes
+                    # must succeed so health-converges can hold at final
+                    self.injector.disarm()
                 for q in range(self.quiesce_rounds):
                     self.clock.advance(self.quiesce_step)
                     self.trace.add("round", n=self.rounds + q, t=self._vt(),
@@ -574,6 +633,9 @@ class ChaosHarness:
         # invariant then rebuilds it from ClusterState and compares
         catalog = self.provisioner._catalog_for(self.nodeclass)
         if catalog is not None:
+            # every window handed to the resident/sharded planes is owed
+            # a solve — device-faulted or not (no-window-lost ledger)
+            self.ft_probe.windows_expected += 1
             self.resident.track_window(self._resident_window(), catalog)
         if self.sharded is not None and catalog is not None:
             self._pump_sharded(catalog)
@@ -611,7 +673,8 @@ def run_scenario(profile: ChaosProfile | str, seed: int, *,
     return ScenarioResult(profile=prof.name, seed=seed, rounds=rounds,
                           violations=violations, trace=harness.trace,
                           digest=harness.trace.digest(),
-                          spans=recorder_to_dicts(harness.recorder))
+                          spans=recorder_to_dicts(harness.recorder),
+                          harness=harness)
 
 
 def run_matrix(profile_names: list[str] | None = None,

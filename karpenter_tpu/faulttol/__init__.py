@@ -1,0 +1,48 @@
+"""Device-fault survivability: the device itself as a failure domain.
+
+Every resilience layer before this one treated the *cloud* as the
+fault domain; a hung XLA dispatch stalled the provisioning loop
+forever and a lost device killed the sharded service outright.  This
+package makes every device dispatch survivable:
+
+- :mod:`dispatch` — ``device_guard``, the one shared wrapper around
+  every kernel dispatch site: quarantine-gated admission, profiler-EWMA
+  deadlines on the dispatch->fetch wall, typed fault classification;
+- :mod:`health` — the per-device state machine (healthy -> suspect ->
+  quarantined -> probation) with probe-driven recovery and triage
+  bundles on quarantine;
+- :mod:`deadline` — ``max(floor, k x EWMA)`` deadline derivation;
+- :mod:`inject` — the deterministic ``FaultyDeviceInjector`` behind
+  the chaos ``device-fault`` profile;
+- :mod:`errors` — the ``DeviceFaultError`` family the existing host
+  fallback ladders catch.
+
+See docs/design/faulttol.md.
+"""
+
+from karpenter_tpu.faulttol.deadline import DeadlineModel, get_deadline_model
+from karpenter_tpu.faulttol.dispatch import DeviceGuard, device_guard
+from karpenter_tpu.faulttol.errors import (DeviceCorruptResult,
+                                           DeviceFaultError,
+                                           DeviceQuarantinedError,
+                                           DeviceResourceExhausted,
+                                           DispatchDeadlineExceeded)
+from karpenter_tpu.faulttol.health import (HEALTHY, PROBATION, QUARANTINED,
+                                           SUSPECT, HealthBoard,
+                                           default_device_id, device_ids,
+                                           get_health_board)
+from karpenter_tpu.faulttol.inject import (FaultyDeviceInjector,
+                                           clear_injector, get_injector,
+                                           install_injector)
+
+__all__ = [
+    "DeviceGuard", "device_guard",
+    "DeadlineModel", "get_deadline_model",
+    "DeviceFaultError", "DispatchDeadlineExceeded",
+    "DeviceQuarantinedError", "DeviceResourceExhausted",
+    "DeviceCorruptResult",
+    "HealthBoard", "get_health_board", "default_device_id", "device_ids",
+    "HEALTHY", "SUSPECT", "QUARANTINED", "PROBATION",
+    "FaultyDeviceInjector", "install_injector", "clear_injector",
+    "get_injector",
+]

@@ -1,0 +1,70 @@
+"""Per-kernel dispatch deadlines derived from the profiler's EWMAs.
+
+The PR-10 profiler (obs/prof.py) keeps a per-kernel EWMA split of the
+dispatch/execute/fetch wall.  A deadline is ``max(floor, k * ewma_total)``
+— the floor absorbs cold-compile and first-sample noise, the multiplier
+is a generous p99 proxy over the smoothed mean (the EWMA with alpha 0.3
+tracks the recent regime, so a kernel that legitimately slows re-derives
+its own budget instead of flapping).  Kernels with no samples yet get
+the floor: the first dispatch of a fresh process must not be killed for
+compiling.
+
+Deadlines are advisory walls measured with ``time.monotonic`` READ AT
+CALL TIME — inside a chaos scenario the virtual clock patches it, so a
+CPU-contended CI run measures zero scenario seconds and only *injected*
+hangs can fire (that is what keeps the chaos digest run-twice
+deterministic; see docs/design/faulttol.md).
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def _env_float(name: str, default: float) -> float:
+    try:
+        return float(os.environ.get(name, default))
+    except (TypeError, ValueError):
+        return default
+
+
+# floor: no dispatch is ever given less than this, so cold compiles and
+# scheduler noise cannot fault a healthy device
+DEFAULT_FLOOR_S = _env_float("KARPENTER_DISPATCH_DEADLINE_FLOOR_S", 2.0)
+# multiplier over the EWMA total wall: a p99-style budget over the
+# smoothed mean — 20x leaves room for GC pauses and queueing without
+# letting a truly hung dispatch ride forever
+DEFAULT_MULTIPLIER = _env_float("KARPENTER_DISPATCH_DEADLINE_MULT", 20.0)
+
+
+class DeadlineModel:
+    """``deadline_for(kernel)`` -> seconds; pure readout over the
+    profiler singleton, no state of its own."""
+
+    def __init__(self, floor_s: float | None = None,
+                 multiplier: float | None = None):
+        self.floor_s = DEFAULT_FLOOR_S if floor_s is None else floor_s
+        self.multiplier = (DEFAULT_MULTIPLIER if multiplier is None
+                           else multiplier)
+
+    def deadline_for(self, kernel: str) -> float:
+        from karpenter_tpu.obs.prof import get_profiler
+
+        total = get_profiler().kernel_ewma_total_s(kernel)
+        if total is None or total <= 0.0:
+            return self.floor_s
+        return max(self.floor_s, self.multiplier * total)
+
+    def snapshot(self, kernels) -> dict:
+        """Per-kernel deadline readout for /statusz."""
+        return {k: round(self.deadline_for(k), 6) for k in sorted(kernels)}
+
+
+_MODEL: DeadlineModel | None = None
+
+
+def get_deadline_model() -> DeadlineModel:
+    global _MODEL
+    if _MODEL is None:
+        _MODEL = DeadlineModel()
+    return _MODEL

@@ -1,0 +1,80 @@
+"""Typed device-fault errors raised at the dispatch-guard seam.
+
+Every error derives from :class:`DeviceFaultError` so the existing
+fallback ladders (``ResilientSolver``, ``ResilientShardedService``,
+``ResilientPlanner``, the jax_backend ``_dispatch_*`` return-None
+idiom, the pallas->scan chain) catch the whole family with the broad
+``except Exception`` they already have — the types exist so callers
+that WANT to distinguish (the OOM chunking path, the quarantine gate)
+can, without string-matching XLA messages.
+"""
+
+from __future__ import annotations
+
+
+class DeviceFaultError(RuntimeError):
+    """A device dispatch failed, timed out, or was gated: the caller
+    must fail over to the host oracle for its plane."""
+
+    def __init__(self, message: str, *, kernel: str = "",
+                 device: str = "", kind: str = "fault"):
+        super().__init__(message)
+        self.kernel = kernel
+        self.device = device
+        self.kind = kind
+
+
+class DispatchDeadlineExceeded(DeviceFaultError):
+    """The dispatch->fetch wall blew the per-kernel deadline (a hung
+    XLA dispatch must never stall the provisioning loop)."""
+
+    def __init__(self, message: str, *, kernel: str = "",
+                 device: str = "", deadline_s: float = 0.0,
+                 elapsed_s: float = 0.0):
+        super().__init__(message, kernel=kernel, device=device,
+                         kind="deadline")
+        self.deadline_s = deadline_s
+        self.elapsed_s = elapsed_s
+
+
+class DeviceQuarantinedError(DeviceFaultError):
+    """Dispatch admission was refused: the target device is
+    quarantined.  Raised BEFORE the kernel launches, so a known-bad
+    chip costs the caller nothing but the host fallback."""
+
+    def __init__(self, message: str, *, kernel: str = "",
+                 device: str = ""):
+        super().__init__(message, kernel=kernel, device=device,
+                         kind="quarantined")
+
+
+class DeviceResourceExhausted(DeviceFaultError):
+    """RESOURCE_EXHAUSTED from the runtime (or injected): the caller
+    may step the window down the pad/batch ladder before giving up to
+    the host path."""
+
+    def __init__(self, message: str, *, kernel: str = "",
+                 device: str = ""):
+        super().__init__(message, kernel=kernel, device=device,
+                         kind="oom")
+
+
+class DeviceCorruptResult(DeviceFaultError):
+    """An independent validator rejected a fetched device result
+    (non-finite cost, out-of-range index).  The device state itself is
+    not trusted afterwards."""
+
+    def __init__(self, message: str, *, kernel: str = "",
+                 device: str = ""):
+        super().__init__(message, kernel=kernel, device=device,
+                         kind="corrupt")
+
+
+# RESOURCE_EXHAUSTED classification: the runtime surfaces OOM as an
+# XlaRuntimeError whose message carries the grpc-style status name.
+_OOM_MARKERS = ("RESOURCE_EXHAUSTED", "Out of memory", "out of memory")
+
+
+def is_resource_exhausted(exc: BaseException) -> bool:
+    text = str(exc)
+    return any(m in text for m in _OOM_MARKERS)

@@ -127,14 +127,26 @@ class GangPlanner:
                 lo = np.zeros(Np, bool); lo[:Nn] = label_ok      # noqa: E702
                 hp = np.zeros((Np, Pp), np.int32)
                 hp[:Nn, :P] = hops.astype(np.int32)
+                from karpenter_tpu.faulttol import (DeviceFaultError,
+                                                    device_guard)
                 from karpenter_tpu.obs.prof import get_profiler
 
-                with get_profiler().sampled("gang-grid") as probe:
-                    fits, first = dev(ol, oh, ml, mh, va, re_,
-                                      need.astype(np.int32), lo, hp)
-                    probe.dispatched((fits, first))
-                return (np.asarray(fits)[:Nn],
-                        np.asarray(first)[:Nn].astype(np.int64))
+                try:
+                    with device_guard("gang-grid") as guard:
+                        with get_profiler().sampled("gang-grid") as probe:
+                            fits, first = dev(ol, oh, ml, mh, va, re_,
+                                              need.astype(np.int32), lo, hp)
+                            probe.dispatched((fits, first))
+                        fits, first = guard.fetch((fits, first))
+                except DeviceFaultError:
+                    if use == "on":
+                        # forced-on: surface the fault (same contract as
+                        # the missing-backend raise above) — the
+                        # Resilient wrapper owns the degraded plan
+                        raise
+                else:
+                    return (np.asarray(fits)[:Nn],
+                            np.asarray(first)[:Nn].astype(np.int64))
         free = valid & ((masks & occ[:, None]) == 0)
         cap_ok = (resid >= need[None, :]).all(axis=1)
         fits = label_ok & cap_ok & free.any(axis=1)

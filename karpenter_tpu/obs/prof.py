@@ -266,6 +266,26 @@ class DeviceProfiler:
                 self.dispatches_seen / self.samples)
             return self.overhead_s / est_total if est_total else 0.0
 
+    def kernel_ewma_total_s(self, kernel: str) -> float | None:
+        """EWMA dispatch+execute+fetch wall for one kernel, or None
+        before the first sample.  Cheap (one lock, one dict lookup) —
+        the faulttol deadline model reads this per dispatch."""
+        with self._lock:
+            k = self._kernels.get(kernel)
+            if k is None:
+                return None
+            return k["dispatch_s"] + k["execute_s"] + k["fetch_s"]
+
+    def estimated_total_wall_s(self) -> float:
+        """Estimated total dispatch wall (sampled wall scaled by the
+        sampling ratio) — the denominator the faulttol guard meters its
+        own bookkeeping against, same estimate as overhead_fraction."""
+        with self._lock:
+            if not self.samples or not self.sampled_wall_s:
+                return 0.0
+            return self.sampled_wall_s * (self.dispatches_seen
+                                          / self.samples)
+
     def snapshot(self) -> dict:
         frac = self.overhead_fraction()
         with self._lock:

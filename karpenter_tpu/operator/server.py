@@ -408,6 +408,7 @@ class MetricsServer:
 
     def _statusz(self) -> dict:
         from karpenter_tpu import obs
+        from karpenter_tpu.faulttol import get_health_board
         from karpenter_tpu.obs.devtel import get_devtel
         from karpenter_tpu.obs.prof import get_profiler
         from karpenter_tpu.obs.watchdog import get_watchdog
@@ -436,6 +437,10 @@ class MetricsServer:
             # currently prices per (type, zone) — /debug/risk has the
             # full history
             "risk": get_risk_model().snapshot(),
+            # device-fault plane (docs/design/faulttol.md): per-device
+            # health states, per-kernel dispatch deadlines, and the
+            # guard's healthy-path overhead fraction (<1% gate)
+            "device_health": get_health_board().snapshot(),
         }
         if self._statusz_extra is not None:
             out.update(self._statusz_extra())

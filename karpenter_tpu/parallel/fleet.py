@@ -51,6 +51,7 @@ def shard_map(f, *, mesh, in_specs, out_specs, check_rep=False):
     return _shard_map(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
                       **{_CHECK_KW: check_rep})
 
+from karpenter_tpu.faulttol import device_guard, device_ids
 from karpenter_tpu.obs.devtel import get_devtel
 from karpenter_tpu.obs.prof import get_profiler
 from karpenter_tpu.parallel.mesh import FLEET_AXIS, OFFER_AXIS
@@ -232,12 +233,13 @@ def fleet_solve_pallas(problem: FleetProblem, *, num_nodes: int,
             "fleet-pallas", (C, G, O, U_pad, N, K, right_size),
             h2d_bytes=int(ins.nbytes) if host_input else 0,
             donated=not host_input)
-        with get_profiler().sampled("fleet-pallas") as probe:
-            out_dev = fleet_packed_pallas(
-                dispatch_ins, alloc8_all, rank_all, price_all,
-                C=C, G=G, O=O, U=U_pad, N=N, right_size=right_size,
-                interpret=interpret, compact=K)
-            probe.dispatched(out_dev)
+        with device_guard("fleet-pallas"):
+            with get_profiler().sampled("fleet-pallas") as probe:
+                out_dev = fleet_packed_pallas(
+                    dispatch_ins, alloc8_all, rank_all, price_all,
+                    C=C, G=G, O=O, U=U_pad, N=N, right_size=right_size,
+                    interpret=interpret, compact=K)
+                probe.dispatched(out_dev)
         try:
             out_dev.copy_to_host_async()
         except Exception:  # noqa: BLE001 — cpu arrays
@@ -250,7 +252,8 @@ def fleet_solve_pallas(problem: FleetProblem, *, num_nodes: int,
     def finalize():
         K, dev = K0, out_dev
         while True:
-            out_np = np.asarray(dev)
+            with device_guard("fleet-pallas") as guard:
+                out_np = guard.fetch(dev)
             get_devtel().note_d2h(int(out_np.nbytes))
             if K > 0 and K < coo_state.cap and any(
                     coo_buffer_full(out_np[c], G, N, K) for c in range(C)):
@@ -311,10 +314,12 @@ def fleet_solve_pallas_sharded(problem: FleetProblem, mesh: Mesh, *,
         get_devtel().note_dispatch(
             "fleet-pallas-sharded", (n, C, G, O, U_pad, N, K, right_size),
             h2d_bytes=int(ins.nbytes), donated=False)
-        with get_profiler().sampled("fleet-pallas-sharded") as probe:
-            out_dev = f(jnp.asarray(ins), alloc8_all, rank_all, price_all)
-            probe.dispatched(out_dev)
-        out_np = np.asarray(out_dev)
+        with device_guard("fleet-pallas-sharded",
+                          devices=device_ids(mesh.devices.flat)) as guard:
+            with get_profiler().sampled("fleet-pallas-sharded") as probe:
+                out_dev = f(jnp.asarray(ins), alloc8_all, rank_all, price_all)
+                probe.dispatched(out_dev)
+            out_np = guard.fetch(out_dev)
         get_devtel().note_d2h(int(out_np.nbytes))
         if K > 0 and K < K_cap and any(
                 coo_buffer_full(out_np[c], G, N, K) for c in range(C)):
@@ -338,12 +343,14 @@ def fleet_solve(problem: FleetProblem, mesh: Mesh, *, num_nodes: int,
     get_devtel().note_dispatch(
         "fleet-scan", problem.compat.shape + (num_nodes, right_size),
         h2d_bytes=h2d, donated=h2d == 0)
-    with get_profiler().sampled("fleet-scan") as probe:
-        out = f(problem.group_req, problem.group_count, problem.group_cap,
-                problem.compat, problem.off_alloc, problem.off_price,
-                problem.off_rank)
-        probe.dispatched(out)
-    res = tuple(np.asarray(o) for o in out)
+    with device_guard("fleet-scan",
+                      devices=device_ids(mesh.devices.flat)) as guard:
+        with get_profiler().sampled("fleet-scan") as probe:
+            out = f(problem.group_req, problem.group_count, problem.group_cap,
+                    problem.compat, problem.off_alloc, problem.off_price,
+                    problem.off_rank)
+            probe.dispatched(out)
+        res = guard.fetch(out)
     get_devtel().note_d2h(sum(int(o.nbytes) for o in res))
     return res
 

@@ -103,13 +103,20 @@ class PreemptionPlanner:
                 fp[:Nn, :K] = freed_prefix
                 co = np.zeros((Np, consumed.shape[1]), np.int32)
                 co[:Nn] = consumed
+                from karpenter_tpu.faulttol import (DeviceFaultError,
+                                                    device_guard)
                 from karpenter_tpu.obs.prof import get_profiler
 
-                with get_profiler().sampled("preempt-grid") as probe:
-                    out_dev = dev(r0, fp, co, req.astype(np.int32))
-                    probe.dispatched(out_dev)
-                out = np.asarray(out_dev)
-                return out[:Nn, :K].astype(np.int64)
+                try:
+                    with device_guard("preempt-grid") as guard:
+                        with get_profiler().sampled("preempt-grid") as probe:
+                            out_dev = dev(r0, fp, co, req.astype(np.int32))
+                            probe.dispatched(out_dev)
+                        out = guard.fetch(out_dev)
+                except DeviceFaultError:
+                    pass            # host oracle below: no window lost
+                else:
+                    return out[:Nn, :K].astype(np.int64)
         cap = resid0[:, None, :] + freed_prefix - consumed[:, None, :]
         per = np.where(req[None, None, :] > 0,
                        cap // np.maximum(req, 1)[None, None, :], _FIT_BIG)

@@ -162,7 +162,15 @@ class RepackPlanner:
             # int32 contract: overflow would silently diverge from the
             # host path, so any out-of-range tensor routes to numpy
             if dev is not None and self._i32_safe(p):
-                return self._grid_device(dev, p, tables, S)
+                from karpenter_tpu.faulttol import DeviceFaultError
+
+                try:
+                    return self._grid_device(dev, p, tables, S)
+                except DeviceFaultError:
+                    if use == "on":
+                        # forced-on surfaces the fault (parity contract
+                        # above); auto falls to the host oracle below
+                        raise
         return (*self._grid_numpy(p, tables), "vector")
 
     @staticmethod
@@ -208,18 +216,21 @@ class RepackPlanner:
         real = np.zeros(Np, bool)
         real[:Nn] = True
         tot_pos = np.clip(p.resid, 0, None).sum(axis=0).astype(np.int32)
+        from karpenter_tpu.faulttol import device_guard
         from karpenter_tpu.obs.prof import get_profiler
 
-        with get_profiler().sampled("repack-grid") as probe:
-            kind, score, reopened = dev(
-                rows, alloc, padn(p.price_milli, np.int32),
-                padn(p.movable_all, bool), padn(p.maxpod, np.int32),
-                padn(p.sing_count > 0, bool),
-                padn(p.sing_demand, np.int32), padn(p.sing_max, np.int32),
-                padn(occ_lo, np.int32), padn(occ_hi, np.int32),
-                padn(sing_lo, np.int32), padn(sing_hi, np.int32),
-                m_lo, m_hi, v, tot_pos, real, padn(p.eligible, bool))
-            probe.dispatched((kind, score, reopened))
+        with device_guard("repack-grid") as guard:
+            with get_profiler().sampled("repack-grid") as probe:
+                kind, score, reopened = dev(
+                    rows, alloc, padn(p.price_milli, np.int32),
+                    padn(p.movable_all, bool), padn(p.maxpod, np.int32),
+                    padn(p.sing_count > 0, bool),
+                    padn(p.sing_demand, np.int32), padn(p.sing_max, np.int32),
+                    padn(occ_lo, np.int32), padn(occ_hi, np.int32),
+                    padn(sing_lo, np.int32), padn(sing_hi, np.int32),
+                    m_lo, m_hi, v, tot_pos, real, padn(p.eligible, bool))
+                probe.dispatched((kind, score, reopened))
+            kind, score, reopened = guard.fetch((kind, score, reopened))
         return (np.asarray(kind)[:Nn].astype(np.int64),
                 np.asarray(score)[:Nn].astype(np.int64),
                 np.asarray(reopened)[:Nn].astype(np.int64), "device")

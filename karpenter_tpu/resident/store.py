@@ -38,6 +38,7 @@ from karpenter_tpu.resident.delta import (
     pad_delta, pod_churn,
 )
 from karpenter_tpu import obs
+from karpenter_tpu.faulttol import DeviceFaultError, device_guard
 from karpenter_tpu.obs.devtel import get_devtel
 from karpenter_tpu.obs.prof import get_profiler
 from karpenter_tpu.utils.logging import get_logger
@@ -114,19 +115,29 @@ class ResidentBuffer:
                 return self.dev, delta
             didx, dval = pad_delta(idx, flat[idx], flat.size,
                                    DELTA_BUCKETS)
-            with get_profiler().sampled(kernel) as probe:
-                self.dev = update_resident(self.dev, didx, dval)
-                # fetch=False: the updated buffer stays device-resident
-                # by design — fetching the WHOLE state would measure a
-                # full-buffer D2H the production path never performs
-                probe.dispatched(self.dev, fetch=False)
-            self.mirror[idx] = flat[idx]
-            self.stats["delta"] += 1
-            delta = WindowDelta(
-                mode="delta", words=int(idx.size),
-                h2d_bytes=int(didx.nbytes + dval.nbytes))
-            self._note(kernel, host, delta, generation)
-            return self.dev, delta
+            try:
+                with device_guard(kernel):
+                    with get_profiler().sampled(kernel) as probe:
+                        self.dev = update_resident(self.dev, didx, dval)
+                        # fetch=False: the updated buffer stays device-
+                        # resident by design — fetching the WHOLE state
+                        # would measure a full-buffer D2H the production
+                        # path never performs
+                        probe.dispatched(self.dev, fetch=False)
+            except DeviceFaultError as e:
+                # the donated update faulted mid-flight: the device
+                # buffer can no longer be trusted.  Fall through to the
+                # full host rebuild below — the window is never lost.
+                self.invalidate(f"device_fault:{e.kind}")
+                reason = self.pending_reason
+            else:
+                self.mirror[idx] = flat[idx]
+                self.stats["delta"] += 1
+                delta = WindowDelta(
+                    mode="delta", words=int(idx.size),
+                    h2d_bytes=int(didx.nbytes + dval.nbytes))
+                self._note(kernel, host, delta, generation)
+                return self.dev, delta
         self.dev = jax.device_put(host)
         self.mirror = flat.copy()
         self.generation = generation
@@ -267,13 +278,14 @@ class ResidentStore:
             delta.mode, h2d_bytes=delta.h2d_bytes, words=delta.words,
             reason=delta.reason, resident_bytes=int(flat.nbytes),
             generation=(catalog.uid,) + gen)
-        with get_profiler().sampled("resident") as probe:
-            buf.dev, out = solve_resident(
-                buf.dev, didx, dval, off_alloc, off_price, off_rank,
-                G=prep.G_pad, O=prep.O_pad, U=prep.U_pad, N=prep.N,
-                right_size=right_size, compact=prep.K, dense16=prep.dense16,
-                coo16=prep.coo16)
-            probe.dispatched(out)
+        with device_guard("resident"):
+            with get_profiler().sampled("resident") as probe:
+                buf.dev, out = solve_resident(
+                    buf.dev, didx, dval, off_alloc, off_price, off_rank,
+                    G=prep.G_pad, O=prep.O_pad, U=prep.U_pad, N=prep.N,
+                    right_size=right_size, compact=prep.K,
+                    dense16=prep.dense16, coo16=prep.coo16)
+                probe.dispatched(out)
         self._account(key, delta)
         obs.record("resident.window", t0, obs.now(), mode=delta.mode,
                    words=delta.words, h2d_bytes=delta.h2d_bytes,

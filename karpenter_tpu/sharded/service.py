@@ -27,6 +27,9 @@ import time
 import numpy as np
 
 from karpenter_tpu import obs
+from karpenter_tpu.faulttol import (DeviceCorruptResult, DeviceFaultError,
+                                    device_guard, device_ids,
+                                    get_health_board)
 from karpenter_tpu.obs.devtel import get_devtel
 from karpenter_tpu.obs.prof import get_profiler
 from karpenter_tpu.resident.delta import (
@@ -70,8 +73,12 @@ class ShardedSolveService:
         self.invalidations = 0
         self.rebalances = 0
         self.migrations = 0
+        self.failovers = 0
         self.last_delta: WindowDelta | None = None
         self.last_decision: RebalanceDecision | None = None
+        # the health-board quarantine set this service last remapped
+        # the mesh against (N-1 failover bookkeeping)
+        self._quarantined_seen: frozenset = frozenset()
 
     # -- mesh / catalog ----------------------------------------------------
 
@@ -82,6 +89,54 @@ class ShardedSolveService:
 
             self._mesh = shard_mesh(self.num_shards)
         return self._mesh
+
+    def _refresh_mesh(self) -> None:
+        """N-1 shard failover: when the health board's quarantine set
+        changes, remap the shard mesh onto the surviving devices
+        (largest-divisor ladder in ``shard_mesh`` — extra shards fold
+        into the vmapped axis) and invalidate the stacked resident
+        state so the next window rebuilds every shard from the host
+        mirrors.  Router ownership is untouched: pods stay on their
+        shards; only the shard->device mapping moves."""
+        import jax
+
+        board = get_health_board()
+        board.tick()
+        quarantined = board.quarantined_ids()
+        survivors = [d for d in jax.devices()
+                     if f"{d.platform}:{d.id}" not in quarantined]
+        if not survivors:
+            # raised on EVERY window while nothing is admitted — before
+            # the early-return below, so an all-quarantined stretch
+            # never pays a per-window rebuild only to be refused at
+            # guard admission (straight to the host oracle instead)
+            with self._lock:
+                self._quarantined_seen = quarantined
+            raise DeviceFaultError(
+                "every device is quarantined; the sharded service has "
+                "no survivors to remap onto", kernel="sharded-solve",
+                kind="quarantined")
+        with self._lock:
+            if quarantined == self._quarantined_seen:
+                return
+            prev = self._quarantined_seen
+            self._quarantined_seen = quarantined
+        from karpenter_tpu.parallel.mesh import shard_mesh
+        reason = "device_failover" if len(quarantined) > len(prev) \
+            else "device_recovered"
+        old_width = None if self._mesh is None \
+            else int(self._mesh.shape[next(iter(self._mesh.shape))])
+        self._mesh = shard_mesh(self.num_shards, devices=survivors)
+        self.invalidate(reason)
+        with self._lock:
+            self.failovers += 1
+        board.note_failover(reason)
+        log.warning("shard mesh remapped onto survivors",
+                    reason=reason, survivors=len(survivors),
+                    quarantined=sorted(quarantined), old_width=old_width)
+        obs.instant("sharded.failover", reason=reason,
+                    survivors=len(survivors),
+                    quarantined=len(quarantined))
 
     def _catalog_tensors(self, catalog, O_pad: int):
         import jax
@@ -207,6 +262,7 @@ class ShardedSolveService:
         from karpenter_tpu.sharded.kernels import solve_shards
 
         t0 = time.perf_counter()
+        self._refresh_mesh()
         if pods is None:
             pods = self.backlog_pods()
         parts = self.router.partition(pods)
@@ -255,8 +311,6 @@ class ShardedSolveService:
                 delta = WindowDelta(
                     mode="delta" if words else "hit", words=words,
                     h2d_bytes=int(didx.nbytes + dval.nbytes))
-            state = self._dev
-            self._dev = None      # donated: never dispatch a dead buffer
         off_alloc, off_price, off_rank = self._catalog_tensors(
             catalog, window.O_pad)
         # devtel at DISPATCH level only (GL107): the resident-window
@@ -272,20 +326,41 @@ class ShardedSolveService:
             # device_puts a fresh buffer first, which is the h2d cost
             # already accounted above
             h2d_bytes=delta.h2d_bytes, donated=True)
-        with get_profiler().sampled("sharded-solve") as probe:
-            new_state, out_dev = solve_shards(
-                state, didx, dval, off_alloc, off_price, off_rank,
-                mesh=self.mesh, G=window.G_pad, O=window.O_pad,
-                U=window.U_pad, N=window.N, right_size=self.right_size)
-            probe.dispatched(out_dev)
-        with self._lock:
-            self._dev = new_state
-            self.windows += 1
-            self.last_delta = delta
-            self._last_window = window
-        out_np = np.asarray(out_dev)
-        get_devtel().note_d2h(int(out_np.nbytes))
-        plan = self._decode(window, out_np, backend="sharded")
+        try:
+            # guard admission runs BEFORE the donated state leaves
+            # self._dev: a quarantine refusal must not cost a rebuild
+            with device_guard("sharded-solve",
+                              devices=device_ids(
+                                  self.mesh.devices.flat)) as guard:
+                with self._lock:
+                    state = self._dev
+                    self._dev = None  # donated: never dispatch dead state
+                with get_profiler().sampled("sharded-solve") as probe:
+                    new_state, out_dev = solve_shards(
+                        state, didx, dval, off_alloc, off_price, off_rank,
+                        mesh=self.mesh, G=window.G_pad, O=window.O_pad,
+                        U=window.U_pad, N=window.N,
+                        right_size=self.right_size)
+                    probe.dispatched(out_dev)
+                out_np = guard.fetch(out_dev)
+            with self._lock:
+                self._dev = new_state
+            get_devtel().note_d2h(int(out_np.nbytes))
+            # decode (with its corrupt-result validation) BEFORE the
+            # window is accounted: a rejected result re-solves via the
+            # host oracle and must count as ONE window, not two
+            plan = self._decode(window, out_np, backend="sharded")
+            with self._lock:
+                self.windows += 1
+                self.last_delta = delta
+                self._last_window = window
+        except DeviceFaultError as e:
+            # the donated stacked buffer (and, past the fetch, the new
+            # state) can no longer be trusted; the host mirrors can.
+            # The caller (ResilientShardedService) re-solves this same
+            # window through the host oracle — no window lost.
+            self.invalidate(f"device_fault:{e.kind}")
+            raise
         with self._lock:
             self._last_unplaced = [len(p.unplaced_pods) for p in plan.plans]
         for s, n in enumerate(window.shard_pods):
@@ -314,6 +389,19 @@ class ShardedSolveService:
             node_off, assign, unplaced, cost = unpack_result(
                 out_np[s], G, N, 0)
             words = unpack_reason_words(out_np[s], G, N, 0)
+            if backend == "sharded":
+                # independent corrupt-result validation: a flipped word
+                # in the fetched buffer must never decode into bindings
+                # (non-finite cost, wildly out-of-range offering index
+                # or negative unplaced count = reject the device result)
+                if (not np.isfinite(cost)
+                        or int(node_off.min(initial=0)) < -1
+                        or int(node_off.max(initial=0)) > window.O_pad
+                        or int(unplaced.min(initial=0)) < 0):
+                    raise DeviceCorruptResult(
+                        f"shard {s} device result failed decode "
+                        f"validation (cost={cost!r})",
+                        kernel="sharded-solve")
             gis, ns = np.nonzero(assign)
             cnts = assign[gis, ns]
             plans.append(decode_plan_entries(
@@ -384,16 +472,20 @@ class ShardedSolveService:
         tie-break) — the periodic tick of the continuous service."""
         from karpenter_tpu.sharded.kernels import rebalance_shards
 
+        self._refresh_mesh()
         if pods is None:
             pods = self.backlog_pods()
         mat = self.pressure(pods)
         get_devtel().note_dispatch("rebalance",
                                    (self.num_shards, mat.shape[1]),
                                    h2d_bytes=int(mat.nbytes), donated=False)
-        with get_profiler().sampled("rebalance") as probe:
-            tile = rebalance_shards(mat, mesh=self.mesh)
-            probe.dispatched(tile)
-        tile_np = np.asarray(tile)
+        with device_guard("rebalance",
+                          devices=device_ids(
+                              self.mesh.devices.flat)) as guard:
+            with get_profiler().sampled("rebalance") as probe:
+                tile = rebalance_shards(mat, mesh=self.mesh)
+                probe.dispatched(tile)
+            tile_np = guard.fetch(tile)
         get_devtel().note_d2h(int(tile_np.nbytes))
         donor, receiver, amount, skew = (int(tile_np[0, 0]),
                                          int(tile_np[0, 1]),
@@ -475,6 +567,8 @@ class ShardedSolveService:
                 "invalidations": self.invalidations,
                 "rebalances": self.rebalances,
                 "migrations": self.migrations,
+                "failovers": self.failovers,
+                "quarantined_devices": sorted(self._quarantined_seen),
                 "backlog": len(self._backlog),
                 "router": self.router.stats(),
                 "last_mode": last.mode if last else "",

@@ -56,6 +56,8 @@ from karpenter_tpu.solver.types import (
     OFFERING_BUCKETS, Plan, PlannedNode, SolveRequest, SolverOptions, bucket,
 )
 from karpenter_tpu import obs
+from karpenter_tpu.faulttol import (DeviceFaultError,
+                                    DeviceResourceExhausted, device_guard)
 from karpenter_tpu.obs.devtel import get_devtel
 from karpenter_tpu.obs.prof import get_profiler
 from karpenter_tpu.utils import metrics
@@ -1358,31 +1360,46 @@ class JaxSolver:
             catalog, O_pad)
         dense16_ok = all(p.dense16_ok for p in preps)
         t_disp = time.perf_counter()
-        while True:
-            K, dense16, coo16 = clamp_output_opts(K0, dense16_ok, G_pad, N)
-            t_issue = time.perf_counter()
-            with get_profiler().sampled("scan-batch") as probe:
-                out_dev = solve_packed_batch(
-                    rows, off_alloc, off_price, off_rank,
-                    G=G_pad, O=O_pad, U=U_pad, N=N,
-                    right_size=self.options.right_size,
-                    compact=K, dense16=dense16, coo16=coo16)
-                probe.dispatched(out_dev)
-            t_issued = time.perf_counter()
-            out_np = np.asarray(out_dev)
-            t_fetch = time.perf_counter()
-            if any(coo_buffer_full(out_np[c], G_pad, N, K, coo16)
-                   for c in range(C)) and K0 < K_cap:
-                K0 = grow_coo(K0, K_cap)
-                self._note_coo_growth(G_pad, K0)
-                continue
-            parsed = [unpack_result(out_np[c], G_pad, N, K, dense16, coo16)
-                      for c in range(C)]
-            if any(needs_node_escalation(no, u, N, N_cap)
-                   for no, _, u, _ in parsed):
-                N = min(N_cap, bucket(N * 4, NODE_BUCKETS))
-                continue
-            break
+        try:
+            while True:
+                K, dense16, coo16 = clamp_output_opts(K0, dense16_ok,
+                                                      G_pad, N)
+                t_issue = time.perf_counter()
+                with device_guard("scan-batch") as guard:
+                    with get_profiler().sampled("scan-batch") as probe:
+                        out_dev = solve_packed_batch(
+                            rows, off_alloc, off_price, off_rank,
+                            G=G_pad, O=O_pad, U=U_pad, N=N,
+                            right_size=self.options.right_size,
+                            compact=K, dense16=dense16, coo16=coo16)
+                        probe.dispatched(out_dev)
+                    t_issued = time.perf_counter()
+                    out_np = guard.fetch(out_dev)
+                t_fetch = time.perf_counter()
+                if any(coo_buffer_full(out_np[c], G_pad, N, K, coo16)
+                       for c in range(C)) and K0 < K_cap:
+                    K0 = grow_coo(K0, K_cap)
+                    self._note_coo_growth(G_pad, K0)
+                    continue
+                parsed = [unpack_result(out_np[c], G_pad, N, K, dense16,
+                                        coo16)
+                          for c in range(C)]
+                if any(needs_node_escalation(no, u, N, N_cap)
+                       for no, _, u, _ in parsed):
+                    N = min(N_cap, bucket(N * 4, NODE_BUCKETS))
+                    continue
+                break
+        except DeviceResourceExhausted:
+            if C <= 1:
+                raise
+            # memory-pressure backoff (faulttol): halve the batch down
+            # the C_pad bucket ladder before giving up to the host path
+            # — each half re-pads and re-dispatches independently
+            log.warning("scan-batch RESOURCE_EXHAUSTED; chunking",
+                        batch=C)
+            mid = (C + 1) // 2
+            return (self.solve_encoded_batch(problems[:mid])
+                    + self.solve_encoded_batch(problems[mid:]))
         metrics.SOLVE_PATH.labels("scan-batch").inc()
         metrics.SOLVE_D2H_BYTES.labels("jax").observe(int(out_np.nbytes))
         get_devtel().note_d2h(int(out_np.nbytes))
@@ -1607,15 +1624,17 @@ class JaxSolver:
                 if prep.pref_lambda is None else prep.pref_lambda
             self._note_dispatch("scan-pref", prep, arr, N,
                                 (prep.pref_rows.shape[0], rs))
-            with get_profiler().sampled("scan-pref") as probe:
-                out = solve_packed_pref(
-                    arr, prep.pref_rows, prep.pref_idx,
-                    off_alloc, off_price, off_rank,
-                    G=G_pad, O=O_pad, U=prep.U_pad, N=N,
-                    P=prep.pref_rows.shape[0], right_size=rs,
-                    compact=prep.K, dense16=prep.dense16, coo16=prep.coo16,
-                    lam_bp=int(lam * 10000))
-                probe.dispatched(out)
+            with device_guard("scan-pref"):
+                with get_profiler().sampled("scan-pref") as probe:
+                    out = solve_packed_pref(
+                        arr, prep.pref_rows, prep.pref_idx,
+                        off_alloc, off_price, off_rank,
+                        G=G_pad, O=O_pad, U=prep.U_pad, N=N,
+                        P=prep.pref_rows.shape[0], right_size=rs,
+                        compact=prep.K, dense16=prep.dense16,
+                        coo16=prep.coo16,
+                        lam_bp=int(lam * 10000))
+                    probe.dispatched(out)
             return out, "scan-pref"
         # pallas needs a 128-multiple node axis; never exceed the
         # configured cap to get one — fall back to the scan path instead
@@ -1638,16 +1657,21 @@ class JaxSolver:
                 rs = self.options.right_size if prep.right_size is None \
                     else prep.right_size
                 self._note_dispatch("pallas", prep, arr, Np, (rs,))
-                with get_profiler().sampled("pallas") as probe:
-                    out = solve_packed_pallas(
-                        arr, alloc8, rank_row, price_dev,
-                        G=G_pad, O=O_pad, U=prep.U_pad, N=Np,
-                        right_size=rs,
-                        compact=prep.K, dense16=prep.dense16,
-                        coo16=prep.coo16)
-                    probe.dispatched(out)
+                with device_guard("pallas"):
+                    with get_profiler().sampled("pallas") as probe:
+                        out = solve_packed_pallas(
+                            arr, alloc8, rank_row, price_dev,
+                            G=G_pad, O=O_pad, U=prep.U_pad, N=Np,
+                            right_size=rs,
+                            compact=prep.K, dense16=prep.dense16,
+                            coo16=prep.coo16)
+                        probe.dispatched(out)
                 prep.N = Np
                 return out, "pallas"
+            except DeviceFaultError:
+                # a gated/faulted DEVICE is not a pallas shape failure:
+                # never memoize it, let the window fail over to host
+                raise
             except Exception as e:  # noqa: BLE001
                 log.warning("pallas dispatch failed; scan fallback engaged",
                             error=str(e)[:300], G=G_pad, O=O_pad, N=Np)
@@ -1660,13 +1684,14 @@ class JaxSolver:
         rs = self.options.right_size if prep.right_size is None \
             else prep.right_size
         self._note_dispatch("scan", prep, arr, N, (rs,))
-        with get_profiler().sampled("scan") as probe:
-            out = solve_packed(
-                arr, off_alloc, off_price, off_rank,
-                G=G_pad, O=O_pad, U=prep.U_pad, N=N,
-                right_size=rs,
-                compact=prep.K, dense16=prep.dense16, coo16=prep.coo16)
-            probe.dispatched(out)
+        with device_guard("scan"):
+            with get_profiler().sampled("scan") as probe:
+                out = solve_packed(
+                    arr, off_alloc, off_price, off_rank,
+                    G=G_pad, O=O_pad, U=prep.U_pad, N=N,
+                    right_size=rs,
+                    compact=prep.K, dense16=prep.dense16, coo16=prep.coo16)
+                probe.dispatched(out)
         return out, "scan"
 
     def _dispatch_stochastic(self, prep: "_Prepared", arr):
@@ -1701,16 +1726,23 @@ class JaxSolver:
                     prep.tmpl.sto_grid = prep.sto_grid
             kd, kc = prep.sto_grid
             self._note_dispatch("stochastic", prep, arr, N, (prep.z_bp, rs))
-            with get_profiler().sampled("stochastic") as probe:
-                out = solve_packed_stochastic(
-                    arr, prep.sto, kd, kc, off_alloc, off_price, off_rank,
-                    G=G_pad, O=O_pad, U=prep.U_pad, N=N, z_bp=prep.z_bp,
-                    right_size=rs, compact=prep.K, dense16=prep.dense16,
-                    coo16=prep.coo16)
-                probe.dispatched(out)
+            with device_guard("stochastic"):
+                with get_profiler().sampled("stochastic") as probe:
+                    out = solve_packed_stochastic(
+                        arr, prep.sto, kd, kc, off_alloc, off_price,
+                        off_rank,
+                        G=G_pad, O=O_pad, U=prep.U_pad, N=N, z_bp=prep.z_bp,
+                        right_size=rs, compact=prep.K, dense16=prep.dense16,
+                        coo16=prep.coo16)
+                    probe.dispatched(out)
             metrics.OVERCOMMIT_SOLVES.labels("stochastic").inc()
             metrics.OVERCOMMIT_Z.set(prep.z_bp / 10000.0)
             return out
+        except DeviceFaultError:
+            # device fault, not a quantile-kernel defect: never disarm
+            # the stochastic route for it — the window fails over to
+            # the host oracle instead
+            raise
         except Exception as e:  # noqa: BLE001 — degrade, never fail
             note_degraded(prep, e)
             return None
@@ -2035,26 +2067,28 @@ class BatchPendingSolve:
         if use_pallas:
             alloc8, rank_row, price = solver._device_offerings_pallas(
                 p0.catalog, O)
-            with get_profiler().sampled("pallas-batch") as probe:
-                self._dev = solve_packed_pallas_batch(
-                    self._rows, alloc8, rank_row, price,
-                    C=self._C_pad, G=G, O=O, U=p0.U_pad, N=self._N_run,
-                    right_size=solver.options.right_size,
-                    compact=self._K, dense16=self._dense16,
-                    coo16=self._coo16)
-                probe.dispatched(self._dev)
+            with device_guard("pallas-batch"):
+                with get_profiler().sampled("pallas-batch") as probe:
+                    self._dev = solve_packed_pallas_batch(
+                        self._rows, alloc8, rank_row, price,
+                        C=self._C_pad, G=G, O=O, U=p0.U_pad, N=self._N_run,
+                        right_size=solver.options.right_size,
+                        compact=self._K, dense16=self._dense16,
+                        coo16=self._coo16)
+                    probe.dispatched(self._dev)
             self._path = "pallas-batch"
         else:
             off_alloc, off_price, off_rank = solver._device_offerings(
                 p0.catalog, O)
-            with get_profiler().sampled("scan-batch") as probe:
-                self._dev = solve_packed_batch(
-                    self._rows, off_alloc, off_price, off_rank,
-                    G=G, O=O, U=p0.U_pad, N=self._N_run,
-                    right_size=solver.options.right_size,
-                    compact=self._K, dense16=self._dense16,
-                    coo16=self._coo16)
-                probe.dispatched(self._dev)
+            with device_guard("scan-batch"):
+                with get_profiler().sampled("scan-batch") as probe:
+                    self._dev = solve_packed_batch(
+                        self._rows, off_alloc, off_price, off_rank,
+                        G=G, O=O, U=p0.U_pad, N=self._N_run,
+                        right_size=solver.options.right_size,
+                        compact=self._K, dense16=self._dense16,
+                        coo16=self._coo16)
+                    probe.dispatched(self._dev)
             self._path = "scan-batch"
         get_devtel().note_dispatch(
             self._path,

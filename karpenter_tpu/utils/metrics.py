@@ -624,6 +624,28 @@ WATCHDOG_SUPPRESSED = Counter(
     "Breaches whose triage bundle was suppressed by the rate limit, "
     "by trigger", ("trigger",))
 
+# Device-fault survivability plane (karpenter_tpu/faulttol,
+# docs/design/faulttol.md): health-gated dispatch with deadlines and
+# host failover.
+DEVICE_HEALTH = Gauge(
+    "karpenter_tpu_device_health",
+    "Per-device health state machine position: 0=healthy 1=suspect "
+    "2=quarantined 3=probation (faulttol/health.py)", ("device",))
+DEVICE_DEADLINE_EXCEEDED = Counter(
+    "karpenter_tpu_device_dispatch_deadline_exceeded_total",
+    "Dispatches whose dispatch->fetch wall blew the profiler-EWMA "
+    "deadline (real or injected hang), per kernel — each one failed "
+    "over to the host oracle for its plane", ("kernel",))
+DEVICE_FAILOVERS = Counter(
+    "karpenter_tpu_device_failovers_total",
+    "Shard-mesh failovers by reason (device_failover = quarantine "
+    "remapped the mesh onto survivors, device_recovered = a healed "
+    "device rejoined)", ("reason",))
+DEVICE_QUARANTINES = Counter(
+    "karpenter_tpu_device_quarantines_total",
+    "Health-board transitions into quarantined, per device (each one "
+    "also writes a watchdog triage bundle)", ("device",))
+
 # Crash-recovery plane: write-ahead intent journal + restart reconciler
 # (karpenter_tpu/recovery, docs/design/recovery.md).
 JOURNAL_RECORDS = Counter(

@@ -239,6 +239,7 @@ class WhatIfPlanner:
         import jax
 
         from karpenter_tpu import obs
+        from karpenter_tpu.faulttol import device_guard
         from karpenter_tpu.obs.devtel import get_devtel
         from karpenter_tpu.obs.prof import get_profiler
         from karpenter_tpu.whatif.kernels import solve_scenarios
@@ -264,15 +265,16 @@ class WhatIfPlanner:
                     h2d_bytes=int(baseline.packed.nbytes + didx.nbytes
                                   + dval.nbytes),
                     donated=True)
-                with get_profiler().sampled("whatif") as probe:
-                    out_dev = solve_scenarios(
-                        jax.device_put(baseline.packed), didx, dval, *ct,
-                        G=baseline.G_pad, O=baseline.O_pad,
-                        U=baseline.U_pad, N=N,
-                        right_size=self.right_size, compact=K_coo,
-                        dense16=dense16, coo16=coo16)
-                    probe.dispatched(out_dev)
-                out_np = np.asarray(out_dev)
+                with device_guard("whatif") as guard:
+                    with get_profiler().sampled("whatif") as probe:
+                        out_dev = solve_scenarios(
+                            jax.device_put(baseline.packed), didx, dval, *ct,
+                            G=baseline.G_pad, O=baseline.O_pad,
+                            U=baseline.U_pad, N=N,
+                            right_size=self.right_size, compact=K_coo,
+                            dense16=dense16, coo16=coo16)
+                        probe.dispatched(out_dev)
+                    out_np = guard.fetch(out_dev)
                 get_devtel().note_d2h(int(out_np.nbytes))
                 outs.append(out_np)
                 dispatches += 1

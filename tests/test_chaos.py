@@ -625,8 +625,10 @@ class TestScenarios:
                                       error_rates={})
         harness = ChaosHarness(profile, 1, rounds=8)
         harness.build()
-        # break the applier AFTER build (run() would rebuild and undo it)
-        harness.sharded._apply_migration = lambda pods, dec: []
+        # break the applier AFTER build (run() would rebuild and undo
+        # it) — on the PRIMARY: harness.sharded is the resilient
+        # wrapper, whose __getattr__ delegates reads but not writes
+        harness.sharded.primary._apply_migration = lambda pods, dec: []
         violations = []
         with harness.clock.installed():
             harness._t0 = harness.clock.time()

@@ -1352,3 +1352,109 @@ def test_gl110_out_of_scope_paths_clean():
             def delete_instance(self, instance_id):
                 return self.http.delete_instance(instance_id)
         """, "GL110", CLOUD_PATH)
+
+
+# -- GL111: naked-device-dispatch (karpenter_tpu/faulttol) -------------------
+
+def test_gl111_naked_sampled_dispatch_bad():
+    # a dispatch bracket without the guard: no deadline, no health
+    # gate, no host failover
+    assert_flags(
+        """
+        from karpenter_tpu.obs.prof import get_profiler
+
+        def dispatch(arr):
+            with get_profiler().sampled("scan") as probe:
+                out = solve_packed(arr)
+                probe.dispatched(out)
+            return out
+        """, "GL111", SOLVER_PATH)
+
+
+def test_gl111_guarded_dispatch_good():
+    # the faulttol contract: guard lexically encloses the sampled
+    # bracket (fetch-free form and fetch form both count)
+    assert_clean(
+        """
+        from karpenter_tpu.faulttol import device_guard
+        from karpenter_tpu.obs.prof import get_profiler
+
+        def dispatch(arr):
+            with device_guard("scan") as guard:
+                with get_profiler().sampled("scan") as probe:
+                    out = solve_packed(arr)
+                    probe.dispatched(out)
+                out = guard.fetch(out)
+            return out
+        """, "GL111", SOLVER_PATH)
+
+
+def test_gl111_attribute_guard_call_good():
+    # `faulttol.device_guard(...)` (module-attribute form) counts too
+    assert_clean(
+        """
+        from karpenter_tpu import faulttol
+        from karpenter_tpu.obs.prof import get_profiler
+
+        def dispatch(arr):
+            with faulttol.device_guard("scan"):
+                with get_profiler().sampled("scan") as probe:
+                    probe.dispatched(solve_packed(arr))
+        """, "GL111", PARALLEL_PATH)
+
+
+def test_gl111_guard_not_enclosing_bad():
+    # a guard that CLOSED before the bracket opened does not sanction
+    # it — the enclosure must be lexical
+    assert_flags(
+        """
+        from karpenter_tpu.faulttol import device_guard
+        from karpenter_tpu.obs.prof import get_profiler
+
+        def dispatch(arr):
+            with device_guard("scan"):
+                pass
+            with get_profiler().sampled("scan") as probe:
+                probe.dispatched(solve_packed(arr))
+        """, "GL111", SOLVER_PATH)
+
+
+def test_gl111_warmup_probe_harnesses_exempt():
+    # measurement/warmup harnesses deliberately sync outside the guard
+    # (guarding them would double-record their probes as dispatches)
+    assert_clean(
+        """
+        from karpenter_tpu.obs.prof import get_profiler
+
+        def warmup_solver(arr):
+            with get_profiler().sampled("scan") as probe:
+                probe.dispatched(solve_packed(arr))
+
+        def _probe_device(arr):
+            with get_profiler().sampled("probe") as probe:
+                probe.dispatched(solve_packed(arr))
+        """, "GL111", RESIDENT_PATH)
+
+
+def test_gl111_out_of_scope_paths_clean():
+    # obs/ and controllers/ are not dispatch surfaces
+    assert_clean(
+        """
+        from karpenter_tpu.obs.prof import get_profiler
+
+        def measure(arr):
+            with get_profiler().sampled("scan") as probe:
+                probe.dispatched(arr)
+        """, "GL111", CTRL_PATH)
+
+
+def test_gl111_real_repo_zero_debt():
+    # every sampled dispatch bracket in the repo rides a device_guard:
+    # the rule ships at zero debt, same commit as the faulttol package
+    from tools.graftlint.__main__ import DEFAULT_TARGETS, _collect
+    from tools.graftlint.engine import lint_paths
+
+    root = Path(__file__).resolve().parents[1]
+    findings, _errors = lint_paths(root, _collect(root, list(DEFAULT_TARGETS)))
+    naked = [f for f, _line in findings if f.rule == "GL111"]
+    assert naked == [], [f"{f.path}:{f.line}" for f in naked]

@@ -85,6 +85,13 @@ METRICS: tuple[tuple[str, str], ...] = (
     # static-analysis gate cost (tools/graftlint): the whole-program
     # contract pass must stay cheap enough to run per-commit
     ("graftlint.full_scan_s", "lower"),
+    # device-fault survivability (karpenter_tpu/faulttol): guard
+    # bookkeeping on the healthy path (<1% gate), the first-window
+    # wall after a quarantine (N-1 remap / host hedge), and how often
+    # the seeded hedge run had to serve from the host ladder
+    ("faulttol.healthy_overhead_fraction", "lower"),
+    ("faulttol.failover_p50_ms", "lower"),
+    ("faulttol.hedge_rate", "lower"),
 )
 
 _ROUND_RE = re.compile(r"BENCH_r(\d+)\.json$")

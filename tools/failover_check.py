@@ -1,0 +1,216 @@
+"""CI gate: N-1 device failover keeps the sharded service placing and
+the intent journal converged (docs/design/faulttol.md).
+
+Drives a real windowed stream through a 2-shard
+``ResilientShardedService`` on an 8-virtual-device CPU mesh, then:
+
+1. **mid-stream quarantine** — three faults on a live mesh device walk
+   it healthy → quarantined on the health board;
+2. **keeps placing** — the very next window must remap the shard mesh
+   onto the survivors (``failovers`` counter, stacked-state rebuild
+   reason ``device_failover``, victim gone from the mesh) and windows
+   before/during/after must keep producing placements without ever
+   falling to the degraded host path;
+3. **journal converged** — one window's plan node is actuated through
+   a journal-backed ``Actuator`` before AND after the failover; the
+   gate fails on any open intent or duplicated create;
+4. **recovery** — with a fast probation ladder (tiny recovery/probe
+   timers) the quarantined device must return to healthy via a real
+   probe dispatch and the mesh must remap back (``device_recovered``).
+
+Run locally: ``make failover-check``
+(``XLA_FLAGS=--xla_force_host_platform_device_count=8
+JAX_PLATFORMS=cpu python tools/failover_check.py``).
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+if "--xla_force_host_platform_device_count" not in \
+        os.environ.get("XLA_FLAGS", ""):
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "")
+        + " --xla_force_host_platform_device_count=8").strip()
+
+
+def main() -> int:
+    import numpy as np
+
+    from karpenter_tpu.apis.nodeclaim import NodePool
+    from karpenter_tpu.apis.nodeclass import (
+        InstanceRequirements, NodeClass, NodeClassSpec, PlacementStrategy,
+    )
+    from karpenter_tpu.apis.pod import PodSpec, ResourceRequests
+    from karpenter_tpu.catalog import (
+        CatalogArrays, InstanceTypeProvider, PricingProvider,
+    )
+    from karpenter_tpu.cloud.fake import FakeCloud, generate_profiles
+    from karpenter_tpu.core.actuator import Actuator
+    from karpenter_tpu.core.cluster import ClusterState
+    from karpenter_tpu.faulttol import health as health_mod
+    from karpenter_tpu.faulttol.inject import clear_injector
+    from karpenter_tpu.recovery.journal import IntentJournal
+    from karpenter_tpu.sharded import ShardedSolveService
+    from karpenter_tpu.sharded.degraded import ResilientShardedService
+
+    # fast probation ladder so recovery runs in wall milliseconds, with
+    # triage writes stubbed out (no .triage/ litter from a CI gate)
+    clear_injector()
+    board = health_mod.HealthBoard(
+        recovery_timeout_s=0.2, probe_interval_s=0.02, probe_successes=1,
+        triage_writer=lambda *a, **k: None)
+    health_mod._BOARD = board
+
+    cloud = FakeCloud(profiles=generate_profiles(40))
+    pricing = PricingProvider(cloud)
+    catalog = CatalogArrays.build(InstanceTypeProvider(cloud,
+                                                      pricing).list())
+    pricing.close()
+
+    rng = np.random.RandomState(3)
+
+    def stream(n):
+        return [PodSpec(f"fc{rng.randint(1 << 30)}-{i}",
+                        requests=ResourceRequests(
+                            int(rng.randint(100, 900)),
+                            int(rng.randint(256, 2048)), 0, 1))
+                for i in range(n)]
+
+    svc = ResilientShardedService(ShardedSolveService(2))
+    mesh_ids = lambda: {f"{d.platform}:{d.id}"  # noqa: E731
+                        for d in svc.mesh.devices.flat}
+    failures: list[str] = []
+
+    # journal-backed actuation target (the warm_restart_check idiom)
+    cluster = ClusterState()
+    nc = NodeClass(name="default", spec=NodeClassSpec(
+        region="us-south", image="img-1", vpc="vpc-1",
+        instance_requirements=InstanceRequirements(min_cpu=2),
+        placement_strategy=PlacementStrategy()))
+    nc.status.resolved_image_id = "img-1"
+    nc.status.set_condition("Ready", "True", "FailoverCheck")
+    cluster.add_nodeclass(nc)
+    cluster.add_nodepool(NodePool(name="default",
+                                  nodeclass_name="default"))
+
+    with tempfile.TemporaryDirectory(prefix="ktpu-failover-") as d:
+        journal = IntentJournal(os.path.join(d, "intents.jsonl"),
+                                owner="fc")
+        actuator = Actuator(cloud, cluster, journal=journal)
+
+        # -- pre-fault stream: 3 warm windows, one actuated create ------
+        svc.admit(stream(300))
+        plan = None
+        for _ in range(3):
+            plan = svc.solve_window(catalog)
+            svc.admit(stream(24))
+        pre_placed = len(plan.merged().nodes)
+        if pre_placed == 0:
+            failures.append("pre-fault stream placed nothing "
+                            "(the gate would prove nothing)")
+        else:
+            actuator.create_node(plan.merged().nodes[0], nc, catalog)
+        pre_mesh = mesh_ids()
+
+        # -- mid-stream quarantine of a live mesh device ----------------
+        victim = sorted(pre_mesh)[0]
+        for _ in range(3):
+            board.record_fault(victim, kind="error",
+                               kernel="failover-check")
+        if board.state(victim) != health_mod.QUARANTINED:
+            failures.append(f"three faults did not quarantine {victim} "
+                            f"(state={board.state(victim)})")
+
+        t0 = time.perf_counter()
+        plan = svc.solve_window(catalog)
+        failover_ms = (time.perf_counter() - t0) * 1000
+        post_placed = len(plan.merged().nodes)
+        if svc.failovers < 1 \
+                or board.last_failover_reason != "device_failover":
+            failures.append(
+                f"quarantine did not drive a mesh failover "
+                f"(failovers={svc.failovers}, "
+                f"reason={board.last_failover_reason!r})")
+        if victim in mesh_ids():
+            failures.append(f"victim {victim} still in the remapped "
+                            f"mesh ({sorted(mesh_ids())})")
+        if svc.last_delta is not None \
+                and svc.last_delta.reason != "device_failover":
+            failures.append(
+                f"post-failover rebuild reason is "
+                f"{svc.last_delta.reason!r}, not 'device_failover'")
+        if post_placed == 0:
+            failures.append("first post-failover window placed nothing")
+        else:
+            actuator.create_node(plan.merged().nodes[0], nc, catalog)
+        if svc.degraded_windows != 0:
+            failures.append(
+                f"{svc.degraded_windows} windows fell to the degraded "
+                f"host path — N-1 failover should keep the device path")
+
+        # -- keeps placing at reduced width -----------------------------
+        for _ in range(2):
+            svc.admit(stream(24))
+            plan = svc.solve_window(catalog)
+        if not plan.merged().nodes:
+            failures.append("reduced-width stream stopped placing")
+
+        # -- journal converged across the failover ----------------------
+        by_intent: dict[str, int] = {}
+        for inst in cloud.list_instances():
+            iid = inst.tags.get("karpenter.sh/intent-id", "")
+            if iid:
+                by_intent[iid] = by_intent.get(iid, 0) + 1
+        dupes = sum(1 for n in by_intent.values() if n > 1)
+        open_intents = len(journal.open_intents())
+        if dupes:
+            failures.append(f"{dupes} intents own >1 instance "
+                            f"(idempotency-key dedupe broke)")
+        if open_intents:
+            failures.append(f"journal did not converge "
+                            f"({open_intents} intents left open)")
+        journal.close()
+
+        # -- recovery: probation ladder heals, mesh remaps back ---------
+        time.sleep(0.25)                 # recovery_timeout_s elapses
+        svc.solve_window(catalog)        # tick: quarantined -> probation
+        deadline = time.monotonic() + 5.0
+        while board.state(victim) != health_mod.HEALTHY \
+                and time.monotonic() < deadline:
+            time.sleep(0.03)
+            board.tick()
+        if board.state(victim) != health_mod.HEALTHY:
+            failures.append(f"victim {victim} never healed through the "
+                            f"probation ladder "
+                            f"(state={board.state(victim)})")
+        svc.admit(stream(24))
+        svc.solve_window(catalog)
+        if board.last_failover_reason != "device_recovered":
+            failures.append(
+                f"healed device did not remap back "
+                f"(reason={board.last_failover_reason!r})")
+        if victim not in mesh_ids():
+            failures.append(f"healed victim {victim} missing from the "
+                            f"restored mesh")
+
+    health_mod._BOARD = None
+    for f in failures:
+        print(f"FAIL {f}")
+    if not failures:
+        print(f"failover check ok: {len(pre_mesh)}-device mesh lost "
+              f"{victim}, kept placing (pre={pre_placed} "
+              f"post={post_placed} nodes, failover window "
+              f"{failover_ms:.1f} ms, failovers={svc.failovers}), "
+              f"journal converged, device healed and rejoined")
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

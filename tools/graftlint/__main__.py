@@ -46,6 +46,7 @@ DEFAULT_TARGETS = (
     # added in the SAME commit that created the package (the PR 11-13
     # silently-unscanned gap must not repeat)
     "karpenter_tpu/whatif",
+    "karpenter_tpu/faulttol",
     "karpenter_tpu/native.py",
     "bench.py",
     "karpenter_tpu/controllers",

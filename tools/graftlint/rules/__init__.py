@@ -39,6 +39,7 @@ def all_rules() -> list[type[Rule]]:
         observability.ReasonEnumDrift,        # GL108
         observability.BlockingSyncInHotPath,  # GL109
         concurrency.UnjournaledMutation,      # GL110
+        observability.NakedDeviceDispatch,    # GL111
         # Family C — whole-program contracts
         contracts.DuplicatedContractConstant,   # GL201
         contracts.FloatReductionInParityPath,   # GL202

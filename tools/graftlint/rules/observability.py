@@ -398,3 +398,84 @@ class BlockingSyncInHotPath(Rule):
                     out.append((node.lineno, node.end_lineno
                                 or node.lineno))
         return out
+
+
+# ---------------------------------------------------------------------------
+# GL111 — naked-device-dispatch (karpenter_tpu/faulttol contract)
+# ---------------------------------------------------------------------------
+
+
+class NakedDeviceDispatch(Rule):
+    id = "GL111"
+    name = "naked-device-dispatch"
+    description = (
+        "a device dispatch (a `with get_profiler().sampled(...)` "
+        "bracket) not routed through `with device_guard(...)` "
+        "(karpenter_tpu/faulttol). A naked dispatch has no deadline "
+        "bound, no health-gated admission, and no fault classification: "
+        "a hung or faulted chip stalls or poisons the window instead of "
+        "failing over to the host oracle, and the health board never "
+        "learns the device misbehaved. Every sampled dispatch bracket "
+        "must sit lexically inside a device_guard `with` block; "
+        "warmup/prewarm/compute_handle/_probe harnesses are exempt by "
+        "name (the guard would double-record their deliberate syncs)."
+    )
+    family = "B"
+    scope = ("karpenter_tpu/solver/*", "karpenter_tpu/parallel/*",
+             "karpenter_tpu/preempt/*", "karpenter_tpu/gang/*",
+             "karpenter_tpu/resident/*", "karpenter_tpu/repack/*",
+             "karpenter_tpu/stochastic/*", "karpenter_tpu/sharded/*",
+             "karpenter_tpu/whatif/*")
+
+    def check(self, module: SourceModule) -> Iterator[Finding]:
+        guarded = self._guard_ranges(module.tree)
+        exempt = self._exempt_function_ranges(module.tree)
+        for node in ast.walk(module.tree):
+            if not isinstance(node, (ast.With, ast.AsyncWith)):
+                continue
+            for item in node.items:
+                if not isinstance(item.context_expr, ast.Call):
+                    continue
+                chain = attr_chain(item.context_expr.func)
+                if chain[-1:] != ["sampled"]:
+                    continue
+                if any(a <= node.lineno <= b for a, b in exempt):
+                    continue
+                if any(a <= node.lineno <= b and (node.end_lineno
+                                                  or node.lineno) <= b
+                       for a, b in guarded):
+                    continue
+                yield self.finding(
+                    module, node,
+                    "sampled dispatch bracket outside `with "
+                    "device_guard(...)` — no deadline, no health gate, "
+                    "no host failover; wrap the dispatch in "
+                    "karpenter_tpu.faulttol.device_guard")
+
+    @staticmethod
+    def _guard_ranges(tree: ast.AST) -> list[tuple[int, int]]:
+        """(start, end) line ranges of ``with device_guard(...)``
+        blocks (bare name or attribute call — `faulttol.device_guard`
+        counts)."""
+        out: list[tuple[int, int]] = []
+        for node in ast.walk(tree):
+            if isinstance(node, (ast.With, ast.AsyncWith)):
+                for item in node.items:
+                    if isinstance(item.context_expr, ast.Call):
+                        chain = attr_chain(item.context_expr.func)
+                        if chain[-1:] == ["device_guard"]:
+                            out.append((node.lineno, node.end_lineno
+                                        or node.lineno))
+                            break
+        return out
+
+    @staticmethod
+    def _exempt_function_ranges(tree: ast.AST) -> list[tuple[int, int]]:
+        out: list[tuple[int, int]] = []
+        for node in ast.walk(tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                name = node.name.lower()
+                if any(part in name for part in _GL109_EXEMPT_NAME_PARTS):
+                    out.append((node.lineno, node.end_lineno
+                                or node.lineno))
+        return out

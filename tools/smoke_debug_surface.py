@@ -320,6 +320,55 @@ def main() -> int:
               f"profiler self-overhead metered "
               f"({psnap['overhead_fraction']})")
 
+        # demo device-fault cycle (karpenter_tpu/faulttol): a scripted
+        # injector walks one fake device hang -> error -> error so the
+        # health board quarantines it — the device-health metric
+        # families and the /statusz device_health block below must then
+        # carry live samples, not vacuous zeros (docs/design/faulttol.md)
+        print("demo device-fault cycle (scripted quarantine)")
+        from karpenter_tpu.faulttol import (DeviceQuarantinedError,
+                                            clear_injector, device_guard,
+                                            get_health_board,
+                                            install_injector)
+
+        class _SmokeInjector:
+            script = ["hang", "error", "error"]
+
+            def draw(self, kernel, candidates):
+                if self.script:
+                    return self.script.pop(0), candidates[0]
+                return None
+
+        install_injector(_SmokeInjector())
+        try:
+            fault_raises = 0
+            for _ in range(3):
+                try:
+                    with device_guard("smoke.fault", devices=["cpu:99"]):
+                        pass
+                except Exception:
+                    fault_raises += 1
+            check(fault_raises == 3,
+                  f"all three scripted faults raised typed errors "
+                  f"({fault_raises})")
+        finally:
+            clear_injector()
+        fboard = get_health_board()
+        fdev = fboard.snapshot()["devices"].get("cpu:99") or {}
+        check(fdev.get("state") == "quarantined"
+              and fdev.get("quarantines", 0) >= 1,
+              f"three faults quarantined the victim ({fdev})")
+        refused = False
+        try:
+            with device_guard("smoke.fault", devices=["cpu:99"]):
+                pass
+        except DeviceQuarantinedError:
+            refused = True
+        check(refused, "guard refuses dispatch to the quarantined device")
+        # the reason-labelled failover counter, exactly as the sharded
+        # mesh remap drives it (sharded/service.py _refresh_mesh)
+        fboard.note_failover("device_failover")
+
         # demo stochastic cycle (karpenter_tpu/stochastic): one
         # chance-constrained solve (usage distributions + pool
         # overcommit) and one ledger-learned spot-risk refresh — the
@@ -503,6 +552,18 @@ def main() -> int:
               in text, "watchdog breach counter family rendered")
         check("# TYPE karpenter_tpu_triage_bundles_total counter"
               in text, "triage bundle counter family rendered")
+        # device-fault survivability families (karpenter_tpu/faulttol +
+        # docs/design/faulttol.md) — live from the demo cycle above
+        check('karpenter_tpu_device_health{device="cpu:99"} 2' in text,
+              "device-health gauge pins the quarantined victim at 2")
+        check('karpenter_tpu_device_dispatch_deadline_exceeded_total'
+              '{kernel="smoke.fault"}' in text,
+              "deadline-exceeded counter saw the injected hang")
+        check('karpenter_tpu_device_quarantines_total{device="cpu:99"}'
+              in text, "quarantine counter saw the transition")
+        check('karpenter_tpu_device_failovers_total'
+              '{reason="device_failover"}' in text,
+              "failover counter carries the mesh-remap reason label")
         # stochastic plane families (karpenter_tpu/stochastic +
         # docs/design/stochastic.md) — live from the demo cycle above
         check('karpenter_tpu_overcommit_solves_total{mode="stochastic"}'
@@ -749,6 +810,20 @@ def main() -> int:
         check("breaches" in swd and "bundles" in swd
               and "rate_limit_s" in swd,
               f"/statusz surfaces watchdog state ({swd})")
+        # device-fault survivability block (docs/design/faulttol.md):
+        # the demo quarantine above must be visible here, plus the
+        # deadline table and the healthy-path overhead gate readout
+        sdh = doc.get("device_health") or {}
+        sdev = (sdh.get("devices") or {}).get("cpu:99") or {}
+        check(sdev.get("state") == "quarantined"
+              and sdev.get("last_kind") in ("error", "deadline"),
+              f"/statusz device_health pins the quarantined device "
+              f"({sdev})")
+        check("deadlines_s" in sdh
+              and "healthy_overhead_fraction" in sdh
+              and sdh.get("guards_entered", 0) >= 1,
+              f"/statusz device_health carries deadlines + overhead "
+              f"({sorted(sdh)})")
         srisk = doc.get("risk") or {}
         check("pairs" in srisk and "risk_lambda" in srisk,
               f"/statusz surfaces the spot-risk block ({srisk.keys()})")
