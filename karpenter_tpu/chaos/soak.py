@@ -37,6 +37,7 @@ from karpenter_tpu.obs.ledger import PlacementLedger
 from karpenter_tpu.obs.slo import (
     BROKEN_FIXTURE_SLO, DEFAULT_SOAK_SLOS, Measurement, SLOReport, SLOSpec,
     evaluate_slos, ledger_measurements, slo_summary,
+    telemetry_measurements,
 )
 
 
@@ -85,6 +86,24 @@ SOAK_SLOS: tuple[SLOSpec, ...] = DEFAULT_SOAK_SLOS + (
             threshold=0.0,
             description="no pod is still unresolved when the production "
                         "day ends (stranding, not latency)"),
+    # solver-quality gates from the device telemetry words
+    # (obs/telemetry_words): what the solver itself measured about its
+    # windows, not a host recomputation
+    SLOSpec(name="telemetry-escalation-rate",
+            objective="telemetry_escalations_per_window", threshold=2.0,
+            description="device solve windows re-dispatch (node "
+                        "escalation / COO growth) at most twice per "
+                        "window on average — chronic escalation means "
+                        "the bucket ladders are sized wrong for the "
+                        "day's load"),
+    SLOSpec(name="telemetry-fill-floor",
+            objective="telemetry_min_fill_fraction", threshold=0.05,
+            comparison="ge",
+            description="no plane's mean fill fraction collapses below "
+                        "5% over its retained windows (open nodes exist "
+                        "because pods landed on them — a collapse is a "
+                        "packing regression, the soak twin of the "
+                        "watchdog's live EWMA detector)"),
 )
 
 
@@ -190,16 +209,18 @@ def run_soak(segments: tuple[SoakSegment, ...] = PRODUCTION_DAY, *,
     finally:
         wd.triage_dir = prev_triage
 
-    measurements = ledger_measurements(
-        ledger,
-        extra={
-            "recorder_dropped_fraction": Measurement(
-                value=rec_dropped / max(1, rec_total)),
-            "unresolved_pods": Measurement(
-                value=float(ledger.stats()["open_records"]),
-                violators=[rec.to_dict()
-                           for rec in ledger.open_records(8)]),
-        })
+    extra = {
+        "recorder_dropped_fraction": Measurement(
+            value=rec_dropped / max(1, rec_total)),
+        "unresolved_pods": Measurement(
+            value=float(ledger.stats()["open_records"]),
+            violators=[rec.to_dict()
+                       for rec in ledger.open_records(8)]),
+    }
+    # device telemetry-word quality measurements (process-global ring —
+    # the day's device windows, whatever plane dispatched them)
+    extra.update(telemetry_measurements())
+    measurements = ledger_measurements(ledger, extra=extra)
     report = evaluate_slos(list(slos), measurements, at=day_t)
     # attach each violator's span bundle (its segment's dump)
     for r in report.results:

@@ -338,6 +338,30 @@ class HealthBoard:
             self.healthy_overhead_fraction(), 6)
         return out
 
+    def prune(self, live_devices) -> list[str]:
+        """Series hygiene after a mesh remap: drop board entries — and
+        their ``device_health{device}`` gauge rows — for devices no
+        longer in the live set.  A pre-remap device's row would
+        otherwise linger at its last state forever, exactly the stale-
+        labelset class the LEADER/COST_PER_HOUR render round-trip test
+        pinned in the operator build.  Quarantined devices are KEPT:
+        quarantine is the board saying "this device exists and is
+        sick" — pruning it would erase the recovery state machine."""
+        live = set(live_devices)
+        removed = []
+        with self._lock:
+            for device in list(self._devices):
+                d = self._devices[device]
+                if device in live or d.state in (QUARANTINED, PROBATION):
+                    continue
+                del self._devices[device]
+                metrics.DEVICE_HEALTH.remove(device)
+                removed.append(device)
+        if removed:
+            log.info("health board pruned stale devices",
+                     removed=sorted(removed))
+        return removed
+
     def reset(self) -> None:
         """Scenario isolation: pristine board, stale metric series
         removed (same idiom as the ledger history resets in the chaos

@@ -66,6 +66,10 @@ class DeviceTelemetry:
         # solve already pays; this counter makes the overhead auditable
         # (bench gates it < 5% of solve D2H)
         self.explain_d2h_bytes = 0
+        # telemetry-word share of the fetched result buffers (the fixed
+        # 16-word quality block obs/telemetry_words appends) — same
+        # attribution contract as explain_d2h_bytes, same <5% bench gate
+        self.telemetry_d2h_bytes = 0
         # resident-state accounting (karpenter_tpu/resident/): windows by
         # mode, delta traffic, last rebuild reason — the /statusz and
         # /debug/slo surface for the store's health
@@ -155,6 +159,13 @@ class DeviceTelemetry:
         with self._lock:
             self.explain_d2h_bytes += nbytes
 
+    def note_telemetry_d2h(self, nbytes: int) -> None:
+        """The telemetry-word slice of a fetched result buffer (already
+        counted in note_d2h's total — attribution, not an extra
+        transfer)."""
+        with self._lock:
+            self.telemetry_d2h_bytes += nbytes
+
     def note_resident_window(self, mode: str, *, h2d_bytes: int = 0,
                              words: int = 0, reason: str = "",
                              resident_bytes: int = 0,
@@ -221,6 +232,7 @@ class DeviceTelemetry:
                 "donation_misses": self.donation_misses,
                 "donation_miss_bytes": self.donation_miss_bytes,
                 "explain_d2h_bytes": self.explain_d2h_bytes,
+                "telemetry_d2h_bytes": self.telemetry_d2h_bytes,
                 "resident": {
                     "windows": self.resident_windows,
                     "hits": self.resident_hits,
@@ -244,6 +256,7 @@ class DeviceTelemetry:
             self.catalog_uploads = self.catalog_upload_bytes = 0
             self.donation_misses = self.donation_miss_bytes = 0
             self.explain_d2h_bytes = 0
+            self.telemetry_d2h_bytes = 0
             self.resident_windows = self.resident_hits = 0
             self.resident_deltas = self.resident_rebuilds = 0
             self.resident_invalidations = self.resident_delta_bytes = 0

@@ -254,6 +254,49 @@ def ledger_measurements(ledger: PlacementLedger,
     return out
 
 
+def telemetry_measurements() -> dict[str, Measurement]:
+    """Solver-quality measurements from the device telemetry words
+    (obs/telemetry_words: the per-window slots every solve plane emits
+    inside its fused dispatch), aggregated over the recorder's bounded
+    telemetry ring:
+
+    - ``telemetry_escalations_per_window``: node-escalation + COO-growth
+      re-dispatches per recorded window across all planes — a healthy
+      day re-dispatches rarely; a chronically escalating one is sized
+      wrong;
+    - ``telemetry_min_fill_fraction``: the lowest per-plane mean fill
+      fraction over retained windows, planes with fewer than 8 windows
+      skipped (too few samples to call a collapse).  Open nodes exist
+      because pods landed on them, so a healthy FFD keeps this well
+      above the floor; a collapse is a solver-quality regression, the
+      same signal the watchdog's EWMA detector fires on live.
+    """
+    from karpenter_tpu.obs import telemetry_words
+
+    s = telemetry_words.summary()
+    planes = s.get("planes", {})
+    windows = sum(p["windows"] for p in planes.values())
+    esc = sum(p["escalations"] + p["coo_growths"]
+              for p in planes.values())
+    meaningful = {name: p for name, p in planes.items()
+                  if p["windows"] >= 8}
+    fills = [p["mean_fill_fraction"] for p in meaningful.values()]
+    return {
+        "telemetry_escalations_per_window": Measurement(
+            value=esc / windows if windows else 0.0),
+        "telemetry_min_fill_fraction": Measurement(
+            value=min(fills) if fills else 1.0,
+            violators=[{"pod": f"<plane {name}: mean_fill="
+                               f"{p['mean_fill_fraction']:.4f} over "
+                               f"{p['windows']} windows>",
+                        "trace_id": 0}
+                       for name, p in sorted(meaningful.items(),
+                                             key=lambda kv:
+                                             kv[1]["mean_fill_fraction"])
+                       ][:5]),
+    }
+
+
 # The production-day gate (chaos/soak.py) — thresholds in VIRTUAL
 # seconds for the latency/staleness objectives (soak rounds advance the
 # clock 60s per beat; three beats of queueing is the budget), and real

@@ -130,10 +130,12 @@ class FlightRecorder:
     MAX_OPEN_TRACES = 256
 
     def __init__(self, capacity: int = 64, error_capacity: int = 32,
-                 instant_capacity: int = 256):
+                 instant_capacity: int = 256,
+                 telemetry_capacity: int = 256):
         self.capacity = capacity
         self.error_capacity = error_capacity
         self.instant_capacity = instant_capacity
+        self.telemetry_capacity = telemetry_capacity
         self._lock = threading.Lock()
         # preallocated slots; _n_* monotonically count writes
         self._ring: list = [None] * capacity
@@ -142,6 +144,11 @@ class FlightRecorder:
         self._n_err = 0
         self._instants: list = [None] * instant_capacity
         self._n_instants = 0
+        # per-window solver-quality telemetry (obs/telemetry_words):
+        # decoded slot dicts, one per solve window, same preallocated-
+        # ring discipline as spans — /debug/telemetry reads this
+        self._telemetry: list = [None] * telemetry_capacity
+        self._n_telemetry = 0
         # trace_id -> [spans] completed so far (root still open)
         self._open: dict[int, list] = {}
         # trace_id -> finalized trace tuple, insertion-ordered and
@@ -204,6 +211,14 @@ class FlightRecorder:
             self._instants[self._n_instants % self.instant_capacity] = span
             self._n_instants += 1
 
+    def add_telemetry(self, entry: dict) -> None:
+        """One decoded solve window's telemetry slots (a plain dict,
+        obs/telemetry_words.record_window) into the bounded ring."""
+        with self._lock:
+            self._telemetry[self._n_telemetry
+                            % self.telemetry_capacity] = entry
+            self._n_telemetry += 1
+
     def _finalize_locked(self, trace_id: int, spans: list,
                          root: Span) -> None:
         self._open.pop(trace_id, None)
@@ -240,6 +255,15 @@ class FlightRecorder:
         with self._lock:
             return [s for s in self._instants if s is not None]
 
+    def telemetry(self) -> list:
+        """Retained telemetry entries in write order (oldest first)."""
+        with self._lock:
+            n, cap = self._n_telemetry, self.telemetry_capacity
+            if n <= cap:
+                return [e for e in self._telemetry[:n] if e is not None]
+            start = n % cap
+            return (self._telemetry[start:] + self._telemetry[:start])
+
     def stats(self) -> dict:
         with self._lock:
             retained = sum(1 for t in self._ring if t is not None)
@@ -250,6 +274,9 @@ class FlightRecorder:
                                              if t is not None),
                 "error_traces_total": self._n_err,
                 "instants_total": self._n_instants,
+                "telemetry_windows_total": self._n_telemetry,
+                "telemetry_retained": sum(1 for e in self._telemetry
+                                          if e is not None),
                 "open_traces": len(self._open),
                 "dropped_spans": self.dropped_spans,
                 "capacity": self.capacity,

@@ -101,6 +101,18 @@ class Watchdog:
     # page-worthy signal is a recompile storm in a WARM process
     # (catalog churn exploding the shape buckets)
     RECOMPILE_GRACE_S = 120.0
+    # solver-quality regression (obs/telemetry_words feeds every decoded
+    # window's fill here): a window whose fill collapses below
+    # QUALITY_COLLAPSE x the plane's EWMA baseline — provided the
+    # baseline itself is meaningful (>= QUALITY_MIN_BASELINE_BP; a
+    # near-empty fleet "collapsing" to empty is not a regression) —
+    # after QUALITY_WARMUP windows.  Escalation re-dispatches burst the
+    # way recompiles do: ESCALATION_BURST inside the rolling window.
+    QUALITY_WARMUP = 8
+    QUALITY_COLLAPSE = 0.5
+    QUALITY_MIN_BASELINE_BP = 1000
+    ESCALATION_BURST = 8
+    ESCALATION_WINDOW_S = 60.0
 
     def __init__(self, *, triage_dir: str | None = None,
                  rate_limit_s: float = 300.0, max_bundles: int = 8,
@@ -116,6 +128,10 @@ class Watchdog:
         self._lock = threading.Lock()
         self._baselines: dict[tuple[str, str], Baseline] = {}
         self._recompiles: deque[float] = deque()
+        # solver-quality state (telemetry words): per-plane fill EWMA +
+        # a rolling escalation-event window shaped like the recompile one
+        self._quality: dict[str, Baseline] = {}
+        self._escalations: deque[float] = deque()
         self._last_bundle_t: float | None = None
         self.breaches = 0
         self.bundles = 0
@@ -183,6 +199,63 @@ class Watchdog:
             self.trigger("recompile_burst", detail)
         return burst
 
+    def note_quality(self, plane: str, fill_bp: int, *,
+                     escalations: int = 0) -> bool:
+        """One decoded solve window's quality telemetry
+        (obs/telemetry_words.record_window).  Two detectors:
+
+        - **fill collapse** — the window's dominant fill fraction (basis
+          points) lands below QUALITY_COLLAPSE x the plane's EWMA
+          baseline while the baseline is meaningful: the solver suddenly
+          packs far worse than it just did (a constraint encoding bug, a
+          catalog regression, a quietly degraded lane) even though every
+          latency metric looks healthy.
+        - **escalation burst** — ESCALATION_BURST host-side re-dispatch
+          retries (node escalation / COO growth) inside the rolling
+          window: each retry re-pays the full dispatch RTT, so a storm
+          is a latency cliff with a solver-shaped cause.
+
+        Returns True when either breached.  Breach windows never update
+        the baseline they were judged against (the slow_kernel rule)."""
+        t = now()
+        qdetail = edetail = None
+        with self._lock:
+            b = self._quality.setdefault(plane, Baseline())
+            collapse = (b.n >= self.QUALITY_WARMUP
+                        and b.mean >= self.QUALITY_MIN_BASELINE_BP
+                        and fill_bp < b.mean * self.QUALITY_COLLAPSE)
+            if collapse:
+                self.breaches += 1
+                qdetail = {
+                    "plane": plane, "fill_bp": int(fill_bp),
+                    "baseline_mean_bp": round(b.mean, 1),
+                    "baseline_n": b.n,
+                    "collapse_ratio": self.QUALITY_COLLAPSE,
+                }
+                self.last_breach = qdetail
+            else:
+                b.update(float(fill_bp))
+            if escalations:
+                self._escalations.extend([t] * min(int(escalations), 64))
+                cutoff = t - self.ESCALATION_WINDOW_S
+                while self._escalations and self._escalations[0] < cutoff:
+                    self._escalations.popleft()
+                if len(self._escalations) >= self.ESCALATION_BURST:
+                    count = len(self._escalations)
+                    self._escalations.clear()
+                    self.breaches += 1
+                    edetail = {"plane": plane,
+                               "escalations_in_window": count,
+                               "window_s": self.ESCALATION_WINDOW_S}
+                    self.last_breach = edetail
+        if qdetail is not None:
+            metrics.WATCHDOG_BREACHES.labels(plane, "quality").inc()
+            self.trigger("quality_regression", qdetail)
+        if edetail is not None:
+            metrics.WATCHDOG_BREACHES.labels(plane, "escalation").inc()
+            self.trigger("escalation_burst", edetail)
+        return qdetail is not None or edetail is not None
+
     # -- bundle emission -----------------------------------------------------
 
     def trigger(self, trigger: str, detail: dict) -> str | None:
@@ -221,6 +294,7 @@ class Watchdog:
                 "bundles": self.bundles,
                 "suppressed": self.suppressed,
                 "baselines": len(self._baselines),
+                "quality_baselines": len(self._quality),
                 "recompile_burst_armed": now() >= self._armed_at,
                 "rate_limit_s": self.rate_limit_s,
                 "max_bundles": self.max_bundles,
@@ -233,6 +307,8 @@ class Watchdog:
         with self._lock:
             self._baselines.clear()
             self._recompiles.clear()
+            self._quality.clear()
+            self._escalations.clear()
             self._last_bundle_t = None
             self.breaches = self.bundles = self.suppressed = 0
             self.last_breach = {}

@@ -31,6 +31,10 @@ Debug surface (docs/design/observability.md):
   (karpenter_tpu/stochastic/risk.py): per-(type, zone) learned rates
   the solver prices into offering ranking, plus the ledger's raw
   labeled interruption/exposure history;
+- ``GET /debug/telemetry`` — device telemetry words
+  (karpenter_tpu/obs/telemetry_words): the slot registry, per-plane
+  solve-quality aggregates (fill/slack/placement/escalations), and
+  the recorder's bounded per-window telemetry ring;
 - ``GET /debug/whatif[?horizon=H&scenarios=a,b]`` — on-demand what-if
   evaluation (karpenter_tpu/whatif): the standing scenario menu solved
   as one stacked dispatch, per-scenario outcomes + ranked capacity
@@ -57,6 +61,16 @@ from karpenter_tpu.utils import metrics
 from karpenter_tpu.utils.logging import get_logger
 
 log = get_logger("operator.server")
+
+
+def _telemetry_summary() -> dict:
+    """telemetry_words.summary() that never fails a statusz read."""
+    try:
+        from karpenter_tpu.obs import telemetry_words
+
+        return telemetry_words.summary()
+    except Exception:  # noqa: BLE001 — debug surface
+        return {}
 
 
 def validate_nodeclass_document(doc: dict) -> list:
@@ -166,6 +180,8 @@ class MetricsServer:
                         lambda: outer._debug_explain(self.path))
                 elif self.path.split("?", 1)[0] == "/debug/risk":
                     self._json_endpoint(outer._debug_risk)
+                elif self.path.split("?", 1)[0] == "/debug/telemetry":
+                    self._json_endpoint(outer._debug_telemetry)
                 elif self.path.split("?", 1)[0] == "/debug/whatif":
                     # single-flight (429 when a stacked evaluation is
                     # already in flight) — distinct status codes, so it
@@ -331,6 +347,19 @@ class MetricsServer:
             },
         }
 
+    def _debug_telemetry(self) -> dict:
+        """Device telemetry words (karpenter_tpu/obs/telemetry_words,
+        docs/design/observability.md): the slot registry, per-plane
+        aggregates over the recorder's bounded telemetry ring, and the
+        raw retained window entries — what the solver itself measured
+        about every recent window, no host recomputation."""
+        from karpenter_tpu import obs
+        from karpenter_tpu.obs import telemetry_words
+
+        payload = telemetry_words.summary()
+        payload["ring"] = obs.get_recorder().telemetry()
+        return payload
+
     def _debug_whatif(self, path: str) -> tuple[int, dict]:
         """On-demand what-if evaluation (karpenter_tpu/whatif,
         docs/design/whatif.md): ``?horizon=`` overrides the planning
@@ -427,6 +456,9 @@ class MetricsServer:
             "ledger": ledger.stats(),
             "pending_staleness_s": round(ledger.pending_staleness(), 6),
             "device_telemetry": get_devtel().snapshot(),
+            # per-plane solver-quality aggregates from the device
+            # telemetry words (/debug/telemetry has the raw ring)
+            "solve_quality": _telemetry_summary(),
             "unplaced_reasons": get_registry().summary(),
             # device-profiling plane (docs/design/profiling.md): the
             # per-kernel dispatch/execute/fetch split, the profiler's

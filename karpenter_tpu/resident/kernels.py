@@ -27,7 +27,7 @@ import functools
 import jax
 
 from karpenter_tpu.solver.jax_backend import (
-    _pack_result_explained, _unpack_problem, solve_core,
+    _pack_result_telemetry, _unpack_problem, solve_core,
 )
 
 
@@ -61,6 +61,6 @@ def solve_resident(state, didx, dval, off_alloc, off_price, off_rank, *,
         meta[:, :4], meta[:, 4], meta[:, 5], compat_i > 0,
         off_alloc, off_price, off_rank, num_nodes=N,
         right_size=right_size)
-    return state, _pack_result_explained(meta, rows_g, compat_i, node_off,
+    return state, _pack_result_telemetry(meta, rows_g, compat_i, node_off,
                                          assign, unplaced, cost, off_alloc,
                                          compact, dense16, coo16)

@@ -8,7 +8,7 @@ per-call rebuild would re-trace and re-compile every window):
   ``[S, L]`` is DONATED (GL006) and returned aliased next to the
   stacked result buffers, exactly as ``resident/kernels.solve_resident``
   does for one buffer.  Per shard the body traces the same
-  ``_unpack_problem`` + ``solve_core`` + ``_pack_result_explained``
+  ``_unpack_problem`` + ``solve_core`` + ``_pack_result_telemetry``
   pipeline as ``solve_packed`` — vmapped over the device-local shards —
   so each shard's result words are bit-identical to the single-device
   path on that shard's buffer (the parity contract the differential
@@ -38,7 +38,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 from karpenter_tpu.parallel.fleet import shard_map
 from karpenter_tpu.parallel.mesh import SHARD_AXIS
 from karpenter_tpu.solver.jax_backend import (
-    _pack_result_explained, _unpack_problem, solve_core,
+    _pack_result_telemetry, _unpack_problem, solve_core,
 )
 
 _BIG_I32 = jnp.int32(2 ** 31 - 1)
@@ -58,7 +58,7 @@ def _solve_shards_jit(mesh: Mesh, S_local: int, G: int, O: int, U: int,
             meta[:, :4], meta[:, 4], meta[:, 5], compat_i > 0,
             off_alloc, off_price, off_rank, num_nodes=N,
             right_size=right_size)
-        return state_row, _pack_result_explained(
+        return state_row, _pack_result_telemetry(
             meta, rows_g, compat_i, node_off, assign, unplaced, cost,
             off_alloc, compact, dense16, coo16)
 

@@ -30,6 +30,7 @@ from karpenter_tpu import obs
 from karpenter_tpu.faulttol import (DeviceCorruptResult, DeviceFaultError,
                                     device_guard, device_ids,
                                     get_health_board)
+from karpenter_tpu.obs import telemetry_words
 from karpenter_tpu.obs.devtel import get_devtel
 from karpenter_tpu.obs.prof import get_profiler
 from karpenter_tpu.resident.delta import (
@@ -79,6 +80,11 @@ class ShardedSolveService:
         # the health-board quarantine set this service last remapped
         # the mesh against (N-1 failover bookkeeping)
         self._quarantined_seen: frozenset = frozenset()
+        # shard_backlog_pods label values this service has published —
+        # remap/heal hygiene removes the rows a smaller shard set
+        # leaves behind (stale-labelset class: the LEADER /
+        # COST_PER_HOUR render round-trip precedent)
+        self._backlog_labels: set[str] = set()
 
     # -- mesh / catalog ----------------------------------------------------
 
@@ -131,6 +137,10 @@ class ShardedSolveService:
         with self._lock:
             self.failovers += 1
         board.note_failover(reason)
+        # series hygiene: drop device_health rows for devices that left
+        # the live set entirely (hot-swapped hosts) — quarantined
+        # devices stay on the board so recovery can find them
+        board.prune(f"{d.platform}:{d.id}" for d in jax.devices())
         log.warning("shard mesh remapped onto survivors",
                     reason=reason, survivors=len(survivors),
                     quarantined=sorted(quarantined), old_width=old_width)
@@ -349,7 +359,8 @@ class ShardedSolveService:
             # decode (with its corrupt-result validation) BEFORE the
             # window is accounted: a rejected result re-solves via the
             # host oracle and must count as ONE window, not two
-            plan = self._decode(window, out_np, backend="sharded")
+            plan = self._decode(window, out_np, backend="sharded",
+                                delta_words=delta.words)
             with self._lock:
                 self.windows += 1
                 self.last_delta = delta
@@ -363,8 +374,7 @@ class ShardedSolveService:
             raise
         with self._lock:
             self._last_unplaced = [len(p.unplaced_pods) for p in plan.plans]
-        for s, n in enumerate(window.shard_pods):
-            metrics.SHARD_BACKLOG.labels(str(s)).set(float(n))
+        self._publish_backlog(window.shard_pods)
         metrics.SHARDED_SOLVES.labels("device").inc()
         plan.solve_seconds = time.perf_counter() - t0
         metrics.SHARDED_SOLVE_DURATION.labels("device").observe(
@@ -373,22 +383,46 @@ class ShardedSolveService:
                     mode=delta.mode, words=delta.words)
         return plan
 
+    def _publish_backlog(self, shard_pods) -> None:
+        """Publish shard_backlog_pods AND retire rows a shrunken shard
+        set no longer produces — a stale row would read as a frozen
+        backlog on the dashboard (satellite: series hygiene after N-1
+        failover; pinned by the render round-trip test)."""
+        current = set()
+        for s, n in enumerate(shard_pods):
+            label = str(s)
+            metrics.SHARD_BACKLOG.labels(label).set(float(n))
+            current.add(label)
+        with self._lock:
+            stale = self._backlog_labels - current
+            self._backlog_labels = current
+        for label in stale:
+            metrics.SHARD_BACKLOG.remove(label)
+
     def _decode(self, window: ShardedWindow, out_np: np.ndarray,
-                backend: str) -> ShardedPlan:
+                backend: str, delta_words: int = 0) -> ShardedPlan:
         """Per-shard decode through the shared COO decode path — the
         same ``decode_plan_entries`` every dense backend uses, so gang
         chokes / explain folds never fork for the sharded plane."""
         from karpenter_tpu.solver.encode import decode_plan_entries
-        from karpenter_tpu.solver.jax_backend import (
-            unpack_reason_words, unpack_result,
+        from karpenter_tpu.solver.jax_backend import unpack_result
+        from karpenter_tpu.solver.result_layout import (
+            TELEMETRY_LEN_BYTES, unpack_reason_words,
         )
 
         G, N = window.G_pad, window.N
+        if backend == "sharded":
+            get_devtel().note_telemetry_d2h(
+                len(window.problems) * TELEMETRY_LEN_BYTES)
         plans = []
         for s, problem in enumerate(window.problems):
             node_off, assign, unplaced, cost = unpack_result(
                 out_np[s], G, N, 0)
             words = unpack_reason_words(out_np[s], G, N, 0)
+            if backend == "sharded":
+                telemetry_words.decode_and_record(
+                    out_np[s], G, N, 0, plane="sharded",
+                    delta_words=delta_words)
             if backend == "sharded":
                 # independent corrupt-result validation: a flipped word
                 # in the fetched buffer must never decode into bindings
@@ -495,6 +529,9 @@ class ShardedSolveService:
                                      amount=amount, skew=skew,
                                      pressure=mat, tile=tile_np)
         metrics.SHARD_REBALANCE_SKEW.set(float(skew))
+        # host-sourced telemetry slot: subsequent recorded windows carry
+        # this skew in SLOT_REBALANCE_SKEW
+        telemetry_words.note_rebalance_skew(skew)
         if amount > 0 and donor != receiver:
             decision.moved_keys = self._apply_migration(pods, decision)
         with self._lock:

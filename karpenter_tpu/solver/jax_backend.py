@@ -51,11 +51,24 @@ warnings.filterwarnings(
 
 from karpenter_tpu.solver.encode import BIG_CAP as BIG_CAP_I32
 from karpenter_tpu.solver.encode import EncodedProblem, encode
+# the ONE versioned suffix layout (graftlint GL112): offset arithmetic
+# and telemetry slot indices live in result_layout; result_tail_len /
+# unpack_reason_words are re-exported here because every existing
+# consumer historically imported them from this module
+from karpenter_tpu.solver.result_layout import (
+    BP_SCALE, SLOT_BINDING_GROUPS, SLOT_FILL_ACCEL_BP, SLOT_FILL_CPU_BP,
+    SLOT_FILL_MEM_BP, SLOT_FILL_PODS_BP, SLOT_GROUPS_PLACED,
+    SLOT_GROUPS_UNPLACED, SLOT_NODES_OPEN, SLOT_PODS_UNPLACED,
+    SLOT_SLACK_MEAN_BP, SLOT_SLACK_MIN_BP, TELEMETRY_LEN_BYTES,
+    TELEMETRY_MAGIC, TELEMETRY_SLOT_COUNT, result_tail_len,
+    unpack_reason_words,
+)
 from karpenter_tpu.solver.types import (
     BATCH_BUCKETS, GROUP_BUCKETS, LABELROW_BUCKETS, NODE_BUCKETS,
     OFFERING_BUCKETS, Plan, PlannedNode, SolveRequest, SolverOptions, bucket,
 )
 from karpenter_tpu import obs
+from karpenter_tpu.obs import telemetry_words
 from karpenter_tpu.faulttol import (DeviceFaultError,
                                     DeviceResourceExhausted, device_guard)
 from karpenter_tpu.obs.devtel import get_devtel
@@ -329,17 +342,21 @@ def expand_coo_assign(idx: np.ndarray, cnt: np.ndarray,
 #                 the RESIDENT catalog — at the heterogeneous 10k-group
 #                 regime this shrinks H2D from 8.4 MB ([G,O] bits) to the
 #                 ~0.5 MB meta block.
-# Output layout (int32, length N + G + 1 + (K | 2K | G*N/2 | G*N) + G):
+# Output layout (solver/result_layout.py owns the offsets — suffix v1,
+# total length result_layout.result_len(G, N, K, dense16, coo16)):
 #   [0, N)        node_off        (-1 = unused slot)
 #   [N, N+G)      unplaced per group
 #   [N+G]         cost            (float32 bit pattern)
 #   tail          COO idx[K] + cnt[K] when compact=K, else dense assign [G*N]
-#   [end-G, end)  explain reason words [G] (karpenter_tpu/explain): the
+#   [G]           explain reason words (karpenter_tpu/explain): the
 #                 per-group elimination bitmask, computed by masked
 #                 reductions INSIDE the same dispatch — zero extra
 #                 dispatches, zero extra H2D, G extra int32 words on the
 #                 one D2H the solve already pays (<1% of the result
 #                 buffer at every bucketed shape)
+#   [16]          telemetry block (karpenter_tpu/obs/telemetry_words):
+#                 magic/version word + 15 solver-quality slots, same
+#                 zero-extra-dispatch contract as the reason words
 # ---------------------------------------------------------------------------
 
 def dedup_rows(compat) -> tuple[np.ndarray, np.ndarray]:
@@ -470,17 +487,125 @@ def _explain_words(meta, rows_g, compat_i, unplaced, off_alloc):
     return jnp.where(live_un, bits, 0).astype(jnp.int32)
 
 
-def _pack_result_explained(meta, rows_g, compat_i, node_off, assign,
+def _addmod(a, b, den):
+    """``((a + b) mod den, carry)`` without forming ``a + b`` — both
+    operands are ``< den`` which can itself be near int32 max, so the
+    naive sum overflows.  ``den - b`` never does."""
+    room = den - b
+    wrap = a >= room
+    return jnp.where(wrap, a - room, a + b), wrap.astype(jnp.int32)
+
+
+def _frac_bp(num, den):
+    """``floor(clip(num, 0, den) * BP_SCALE / den)`` in pure int32 by
+    base-10 long division — the device twin of
+    ``obs.telemetry_words.frac_bp_np`` (``num * 10000`` overflows int32
+    for realistic capacity sums, and float division is banned on the
+    parity path, GL202).  Each digit extracts ``floor(10r / den)`` by
+    overflow-safe modular doubling (``10r = ((2r)*2 + r)*2``) — the
+    remainder can be near int32 max, so even ``r * 10`` is unsafe.
+    ``den <= 0`` reads as empty capacity -> 0."""
+    den1 = jnp.maximum(den, 1)
+    num1 = jnp.clip(num, 0, den1)
+    bp = num1 // den1
+    r = num1 - bp * den1
+    for _ in range(4):
+        r0 = r
+        r, c = _addmod(r, r, den1)                  # 2r
+        q = c
+        r, c = _addmod(r, r, den1)                  # 4r
+        q = q * 2 + c
+        r, c = _addmod(r, r0, den1)                 # 5r
+        q = q + c
+        r, c = _addmod(r, r, den1)                  # 10r
+        q = q * 2 + c
+        bp = bp * 10 + q
+    return jnp.clip(bp, 0, BP_SCALE)
+
+
+def _telemetry_words(meta, node_off, assign, unplaced, off_alloc,
+                     binding=None):
+    """The [1 + TELEMETRY_SLOT_COUNT] telemetry block (magic word first)
+    — per-window solver-quality slots computed as masked int32
+    reductions from tensors ALREADY on device for the solve they ride
+    (zero extra dispatches, zero extra H2D; the explain-words pattern
+    generalized).  MUST stay bit-identical to the host oracle
+    ``obs.telemetry_words.telemetry_words_np`` — change one side,
+    change both (registered graftlint parity pair; slot registry and
+    wire layout live in solver/result_layout.py, pinned by GL112).
+
+    Fill and slack are measured in REQUEST units on every lane — the
+    stochastic kernel packs by mean usage, so its request-unit fill may
+    legitimately exceed 100% before clipping; ``binding`` (stochastic
+    lanes only) is the per-group chance-constraint-binding mask.  Host-
+    sourced slots (escalations, coo_growths, delta_words,
+    rebalance_skew) ride the wire as zero."""
+    req = meta[:, :4]
+    count = meta[:, 4]
+    unp = unplaced.astype(jnp.int32)
+    open_mask = node_off >= 0                                    # [N]
+    open_i = open_mask.astype(jnp.int32)
+    safe = jnp.where(open_mask, node_off, 0)
+    caps = off_alloc[safe] * open_i[:, None]                     # [N, R]
+    load = jnp.einsum("gn,gr->nr", assign.astype(jnp.int32), req,
+                      preferred_element_type=jnp.int32)          # [N, R]
+    load = load * open_i[:, None]
+    cap_tot = jnp.sum(caps, axis=0)                              # [R]
+    load_tot = jnp.sum(load, axis=0)
+    fill = jnp.where(cap_tot > 0, _frac_bp(load_tot, cap_tot), 0)
+    # per-open-node slack: min over provisioned resources of the
+    # remaining fraction (dimensions a node does not provision are full
+    # slack, not zero)
+    resid = caps - load
+    node_bp = jnp.min(jnp.where(caps > 0, _frac_bp(resid, caps),
+                                BP_SCALE), axis=1)               # [N]
+    nodes_open = jnp.sum(open_i)
+    any_open = nodes_open > 0
+    slack_min = jnp.where(
+        any_open, jnp.min(jnp.where(open_mask, node_bp, BP_SCALE)), 0)
+    slack_mean = jnp.where(
+        any_open,
+        jnp.sum(jnp.where(open_mask, node_bp, 0))
+        // jnp.maximum(nodes_open, 1), 0)
+    live = count > 0
+    placed_g = live & ((count - unp) > 0)
+    unplaced_g = live & (unp > 0)
+    if binding is None:
+        binding_n = jnp.int32(0)
+    else:
+        binding_n = jnp.sum((binding & live).astype(jnp.int32))
+    zero = jnp.int32(0)
+    slots = [zero] * TELEMETRY_SLOT_COUNT
+    slots[SLOT_FILL_CPU_BP] = fill[0]
+    slots[SLOT_FILL_MEM_BP] = fill[1]
+    slots[SLOT_FILL_ACCEL_BP] = fill[2]
+    slots[SLOT_FILL_PODS_BP] = fill[3]
+    slots[SLOT_SLACK_MIN_BP] = slack_min
+    slots[SLOT_SLACK_MEAN_BP] = slack_mean
+    slots[SLOT_NODES_OPEN] = nodes_open
+    slots[SLOT_GROUPS_PLACED] = jnp.sum(placed_g.astype(jnp.int32))
+    slots[SLOT_GROUPS_UNPLACED] = jnp.sum(unplaced_g.astype(jnp.int32))
+    slots[SLOT_PODS_UNPLACED] = jnp.sum(jnp.where(live, unp, 0))
+    slots[SLOT_BINDING_GROUPS] = binding_n
+    return jnp.stack([jnp.int32(TELEMETRY_MAGIC)]
+                     + slots).astype(jnp.int32)
+
+
+def _pack_result_telemetry(meta, rows_g, compat_i, node_off, assign,
                            unplaced, cost, off_alloc, compact, dense16,
-                           coo16):
-    """Packed result + the appended [G] explain reason words — the ONE
-    finisher every packed entry point (scan, pref, batch, pallas,
-    resident) traces through, so the output wire layout cannot fork."""
+                           coo16, binding=None):
+    """Packed result + the [G] explain reason words + the versioned
+    telemetry block (solver/result_layout.py) — the ONE finisher every
+    packed entry point (scan, pref, batch, pallas, resident, sharded,
+    whatif, stochastic) traces through, so the output wire layout
+    cannot fork."""
     out = _pack_result(node_off, assign, unplaced, cost, compact, dense16,
                        coo16)
     words = _explain_words(meta, rows_g, compat_i,
                            unplaced.astype(jnp.int32), off_alloc)
-    return jnp.concatenate([out, words])
+    tele = _telemetry_words(meta, node_off, assign, unplaced, off_alloc,
+                            binding=binding)
+    return jnp.concatenate([out, words, tele])
 
 
 def pack16_pairs(a):
@@ -572,27 +697,9 @@ def unpack_coo_tail(out: np.ndarray, G: int, N: int, K: int,
     return rest[:K], rest[K:2 * K]
 
 
-def result_tail_len(G: int, N: int, K: int, dense16: bool = False,
-                    coo16: bool = False) -> int:
-    """Words in the assignment tail of a packed result buffer — the ONE
-    offset arithmetic the explain-word reader and the parsers share."""
-    if K > 0:
-        return K if coo16 else 2 * K
-    if dense16:
-        return (G * N) // 2
-    return G * N
-
-
-def unpack_reason_words(out: np.ndarray, G: int, N: int, K: int,
-                        dense16: bool = False,
-                        coo16: bool = False) -> np.ndarray | None:
-    """The appended [G] explain reason words of a packed result buffer
-    (karpenter_tpu/explain), or None for a legacy buffer without them
-    (the bare ``_pack_result`` layout direct kernel callers produce)."""
-    off = N + G + 1 + result_tail_len(G, N, K, dense16, coo16)
-    if out.shape[0] < off + G:
-        return None
-    return out[off:off + G]
+# result_tail_len / unpack_reason_words moved to
+# karpenter_tpu/solver/result_layout.py (re-exported from the import
+# block above) — the suffix offset arithmetic exists exactly once.
 
 
 def unpack_result(out: np.ndarray, G: int, N: int, K: int,
@@ -676,7 +783,7 @@ def solve_packed(packed, off_alloc, off_price, off_rank, *, G: int, O: int,
     node_off, assign, unplaced, cost = solve_core(
         meta[:, :4], meta[:, 4], meta[:, 5], compat_i > 0,
         off_alloc, off_price, off_rank, num_nodes=N, right_size=right_size)
-    return _pack_result_explained(meta, rows_g, compat_i, node_off, assign,
+    return _pack_result_telemetry(meta, rows_g, compat_i, node_off, assign,
                                   unplaced, cost, off_alloc, compact,
                                   dense16, coo16)
 
@@ -704,7 +811,7 @@ def solve_packed_pref(packed, pref_rows, pref_idx, off_alloc, off_price,
         off_alloc, off_price, off_rank, num_nodes=N,
         right_size=right_size, pref_rows=pref_rows, pref_idx=pref_idx,
         pref_lambda=lam_bp / 10000.0)
-    return _pack_result_explained(meta, rows_g, compat_i, node_off, assign,
+    return _pack_result_telemetry(meta, rows_g, compat_i, node_off, assign,
                                   unplaced, cost, off_alloc, compact,
                                   dense16, coo16)
 
@@ -728,7 +835,7 @@ def solve_packed_batch(packed_rows, off_alloc, off_price, off_rank, *,
             meta[:, :4], meta[:, 4], meta[:, 5], compat_i > 0,
             off_alloc, off_price, off_rank, num_nodes=N,
             right_size=right_size)
-        return _pack_result_explained(meta, rows_g, compat_i, node_off,
+        return _pack_result_telemetry(meta, rows_g, compat_i, node_off,
                                       assign, unplaced, cost, off_alloc,
                                       compact, dense16, coo16)
 
@@ -753,7 +860,7 @@ def solve_packed_pallas(packed, alloc8, rank_row, off_price, *, G: int,
     node_off, assign, unplaced, cost = _pallas_core(
         meta, compat_i, alloc8, rank_row, off_price,
         G=G, O=O, N=N, right_size=right_size, interpret=interpret)
-    return _pack_result_explained(meta, rows_g, compat_i, node_off, assign,
+    return _pack_result_telemetry(meta, rows_g, compat_i, node_off, assign,
                                   unplaced, cost, off_alloc, compact,
                                   dense16, coo16)
 
@@ -789,7 +896,7 @@ def solve_packed_pallas_batch(packed_rows, alloc8, rank_row, off_price, *,
         node_off_c, cost = finish_pallas_solve(
             meta, compat_i, node_off_c, assign_c, alloc8, rank_row,
             off_price, right_size)
-        return _pack_result_explained(meta, rows_g, compat_i, node_off_c,
+        return _pack_result_telemetry(meta, rows_g, compat_i, node_off_c,
                                       assign_c, unplaced_c, cost,
                                       off_alloc, compact, dense16, coo16)
 
@@ -1213,6 +1320,7 @@ class JaxSolver:
         receives pre-padded arrays over the wire and has no
         EncodedProblem to decode against."""
         par = obs.current_span()
+        escalations = coo_growths = 0
         while True:
             t_disp = obs.now()
             out_dev, path = self._dispatch(prep, prep.packed)
@@ -1257,6 +1365,7 @@ class JaxSolver:
                                prep.coo16) and prep.K0 < prep.K_cap:
                 prep.grow_K0(grow_coo(prep.K0, prep.K_cap))
                 self._note_coo_growth(prep.G_pad, prep.K0)
+                coo_growths += 1
                 continue
             t_dec = obs.now()
             node_off, assign, unplaced, cost = unpack_result(
@@ -1269,6 +1378,7 @@ class JaxSolver:
             metrics.SOLVE_D2H_BYTES.labels("jax").observe(d2h)
             get_devtel().note_d2h(d2h)
             get_devtel().note_explain_d2h(prep.G_pad * 4)
+            get_devtel().note_telemetry_d2h(TELEMETRY_LEN_BYTES)
             # exec_fetch_s spans async device EXECUTION + D2H together (a
             # separate sync before the fetch would cost one more tunnel
             # round trip); pure chip time is measured out-of-band by
@@ -1282,7 +1392,12 @@ class JaxSolver:
                 "N": prep.N}
             if needs_node_escalation(node_off, unplaced, prep.N, prep.N_cap):
                 prep.escalate_N(bucket(prep.N * 4, NODE_BUCKETS))
+                escalations += 1
                 continue
+            telemetry_words.decode_and_record(
+                out_np, prep.G_pad, prep.N, prep.K, dense16=prep.dense16,
+                coo16=prep.coo16, plane=path, escalations=escalations,
+                coo_growths=coo_growths)
             return node_off, assign, unplaced, cost
 
     def prepare_arrays(self, catalog, group_req, group_count, group_cap,
@@ -1360,6 +1475,7 @@ class JaxSolver:
             catalog, O_pad)
         dense16_ok = all(p.dense16_ok for p in preps)
         t_disp = time.perf_counter()
+        escalations = coo_growths = 0
         try:
             while True:
                 K, dense16, coo16 = clamp_output_opts(K0, dense16_ok,
@@ -1380,6 +1496,7 @@ class JaxSolver:
                        for c in range(C)) and K0 < K_cap:
                     K0 = grow_coo(K0, K_cap)
                     self._note_coo_growth(G_pad, K0)
+                    coo_growths += 1
                     continue
                 parsed = [unpack_result(out_np[c], G_pad, N, K, dense16,
                                         coo16)
@@ -1387,6 +1504,7 @@ class JaxSolver:
                 if any(needs_node_escalation(no, u, N, N_cap)
                        for no, _, u, _ in parsed):
                     N = min(N_cap, bucket(N * 4, NODE_BUCKETS))
+                    escalations += 1
                     continue
                 break
         except DeviceResourceExhausted:
@@ -1404,6 +1522,12 @@ class JaxSolver:
         metrics.SOLVE_D2H_BYTES.labels("jax").observe(int(out_np.nbytes))
         get_devtel().note_d2h(int(out_np.nbytes))
         get_devtel().note_explain_d2h(C * G_pad * 4)
+        get_devtel().note_telemetry_d2h(C * TELEMETRY_LEN_BYTES)
+        for ci in range(C):
+            telemetry_words.decode_and_record(
+                out_np[ci], G_pad, N, K, dense16=dense16, coo16=coo16,
+                plane="scan-batch", escalations=escalations,
+                coo_growths=coo_growths)
         get_devtel().note_dispatch(
             "scan-batch",
             (G_pad, O_pad, U_pad, N, C_pad, K, dense16, coo16,
@@ -1928,6 +2052,7 @@ class PendingSolve:
         dev, path = self._dev, self._path
         fut = self._fut
         t_disp, t_issued = self._t_disp, self._t_issued
+        escalations = coo_growths = 0
         while True:
             try:
                 out_np = _await_dev(dev, fut)
@@ -1963,6 +2088,7 @@ class PendingSolve:
                     and prep.K0 < prep.K_cap:
                 prep.grow_K0(grow_coo(prep.K0, prep.K_cap))
                 solver._note_coo_growth(G, prep.K0)
+                coo_growths += 1
                 t_disp = obs.now()
                 dev, path = solver._dispatch(prep, prep.packed)
                 try:
@@ -1981,6 +2107,7 @@ class PendingSolve:
             metrics.SOLVE_D2H_BYTES.labels("jax").observe(int(out_np.nbytes))
             get_devtel().note_d2h(int(out_np.nbytes))
             get_devtel().note_explain_d2h(G * 4)
+            get_devtel().note_telemetry_d2h(TELEMETRY_LEN_BYTES)
             solver.last_stats = {
                 "path": path, "wall_s": t_fetch - t_disp,
                 "dispatch_s": t_issued - t_disp,
@@ -1990,6 +2117,7 @@ class PendingSolve:
                 "compact": bool(K), "G": G, "O": prep.O_pad, "N": N}
             if needs_node_escalation(node_off, unplaced, N, prep.N_cap):
                 prep.escalate_N(bucket(prep.N * 4, NODE_BUCKETS))
+                escalations += 1
                 t_disp = obs.now()
                 dev, path = solver._dispatch(prep, prep.packed)
                 try:
@@ -2004,6 +2132,10 @@ class PendingSolve:
             t_dec = obs.now()
             words = unpack_reason_words(out_np, G, N, K, prep.dense16,
                                         prep.coo16)
+            telemetry_words.decode_and_record(
+                out_np, G, N, K, dense16=prep.dense16, coo16=prep.coo16,
+                plane=path, escalations=escalations,
+                coo_growths=coo_growths)
             if K > 0:
                 idx, cnt = unpack_coo_tail(out_np, G, N, K, prep.coo16)
                 live = cnt > 0
@@ -2113,6 +2245,7 @@ class BatchPendingSolve:
 
         solver, p0 = self._solver, self._preps[0]
         G, O = p0.G_pad, p0.O_pad
+        escalations = coo_growths = 0
         while True:
             try:
                 out_np = _await_dev(self._dev, self._fut)
@@ -2137,6 +2270,7 @@ class BatchPendingSolve:
                 for pr in self._preps:
                     pr.grow_K0(self._K0)
                 solver._note_coo_growth(G, self._K0)
+                coo_growths += 1
                 self._dispatch()
                 continue
             parsed = []
@@ -2151,12 +2285,19 @@ class BatchPendingSolve:
                 self._N = min(self._N_cap, bucket(N * 4, NODE_BUCKETS))
                 for pr in self._preps:
                     pr.escalate_N(self._N)
+                escalations += 1
                 self._dispatch()
                 continue
             metrics.SOLVE_PATH.labels(self._path).inc()
             metrics.SOLVE_D2H_BYTES.labels("jax").observe(int(out_np.nbytes))
             get_devtel().note_d2h(int(out_np.nbytes))
             get_devtel().note_explain_d2h(self._C * G * 4)
+            get_devtel().note_telemetry_d2h(self._C * TELEMETRY_LEN_BYTES)
+            for c in range(self._C):
+                telemetry_words.decode_and_record(
+                    out_np[c], G, N, K, dense16=self._dense16,
+                    coo16=self._coo16, plane=self._path,
+                    escalations=escalations, coo_growths=coo_growths)
             solver.last_stats = {
                 "path": self._path, "batch": self._C,
                 "batch_pad": self._C_pad,

@@ -101,6 +101,28 @@ def risk_words_np(mean: np.ndarray, var: np.ndarray, count: np.ndarray,
                     np.int32(0)).astype(np.int32)
 
 
+def binding_mask_np(mean: np.ndarray, var: np.ndarray,
+                    compat: np.ndarray, off_alloc: np.ndarray,
+                    z_bp: int) -> np.ndarray:
+    """bool [G]: groups whose chance constraint BINDS — the variance
+    term shrinks the per-node fit below the deterministic bound
+    (kc < kd) on at least one compatible offering, and the group
+    carries variance at all.  Host twin of the kernel's telemetry
+    binding mask (``kernel.py``: same kd/kc grids that feed the risk
+    words), counted into SLOT_BINDING_GROUPS by the telemetry oracle."""
+    G = mean.shape[0]
+    if G == 0 or off_alloc.shape[0] == 0:
+        return np.zeros(G, dtype=bool)
+    zsq = np.float32(zsq_value(z_bp))
+    per_dim = np.where(mean[:, None, :] > 0,
+                       off_alloc[None, :, :]
+                       // np.maximum(mean[:, None, :], 1), _BIG)
+    kd = np.minimum(per_dim.min(axis=2), CHANCE_FIT_MAX).astype(np.int32)
+    kc = _chance_fit_grid_np(off_alloc, mean, var.astype(np.float32),
+                             zsq, kd)
+    return (compat & (kc < kd)).any(axis=1) & (var > 0).any(axis=1)
+
+
 def solve_stochastic_host(problem, N: int, z_bp: int,
                           right_size: bool = True):
     """Run the chance-constrained FFD on the host.

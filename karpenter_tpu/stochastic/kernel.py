@@ -255,7 +255,7 @@ def solve_packed_stochastic(packed, sto, kd, kc, off_alloc, off_price,
     per distinct bound, never per solve."""
     from karpenter_tpu.apis.pod import NUM_RESOURCES
     from karpenter_tpu.solver.jax_backend import (
-        _explain_words, _pack_result, _unpack_problem,
+        _explain_words, _pack_result, _telemetry_words, _unpack_problem,
     )
 
     zsq = jnp.float32(zsq_value(z_bp))
@@ -289,4 +289,12 @@ def solve_packed_stochastic(packed, sto, kd, kc, off_alloc, off_price,
                            unplaced.astype(jnp.int32), off_alloc)
     words = words | _risk_words(var, count, unplaced.astype(jnp.int32),
                                 compat, kd, kc)
-    return jnp.concatenate([out, words])
+    # chance-constraint binding mask for the telemetry block: groups
+    # carrying variance whose chance fit is strictly below the
+    # deterministic fit somewhere compatible — regardless of placement
+    # outcome (the oracle twin: stochastic/greedy.binding_mask_np)
+    binding = jnp.any(compat & (kc < kd), axis=1) \
+        & jnp.any(var > 0, axis=1)
+    tele = _telemetry_words(meta, node_off, assign, unplaced, off_alloc,
+                            binding=binding)
+    return jnp.concatenate([out, words, tele])

@@ -514,6 +514,40 @@ UNPLACED_PODS = Gauge(
     "cardinality is bounded by the UNPLACED_REASONS allowlist; every "
     "reason renders (0 when empty) so counts never linger.", ("reason",))
 
+# Solver-quality telemetry plane (karpenter_tpu/obs/telemetry_words.py):
+# per-window quality slots computed ON DEVICE inside the solve dispatch
+# and decoded from the packed result's telemetry suffix
+# (solver/result_layout.py).  "plane" label = the solve lane that
+# produced the window (scan, pref, batch, pallas, resident, sharded,
+# stochastic, whatif) — bounded cardinality by construction.
+SOLVE_QUALITY_FILL = Gauge(
+    "karpenter_tpu_solve_quality_fill_fraction",
+    "Fleet fill fraction of the last solved window per plane and "
+    "resource (placed request demand over open-node capacity, decoded "
+    "from the device-computed basis-point telemetry slot)",
+    ("plane", "resource"))
+SOLVE_QUALITY_SLACK = Gauge(
+    "karpenter_tpu_solve_quality_slack_fraction",
+    "Per-open-node remaining-capacity fraction of the last solved "
+    "window per plane: min / mean over open nodes of the per-node "
+    "min-over-resources slack", ("plane", "stat"))
+SOLVE_QUALITY_COUNT = Gauge(
+    "karpenter_tpu_solve_quality_count",
+    "Placement-shape counts of the last solved window per plane: "
+    "nodes_open, groups_placed, groups_unplaced, pods_unplaced, "
+    "binding_groups (chance-constraint binding, stochastic lanes)",
+    ("plane", "kind"))
+SOLVE_QUALITY_WINDOWS = Counter(
+    "karpenter_tpu_solve_quality_windows_total",
+    "Solve windows whose telemetry suffix was decoded and recorded, "
+    "per plane", ("plane",))
+SOLVE_QUALITY_ESCALATIONS = Counter(
+    "karpenter_tpu_solve_quality_escalations_total",
+    "Host-side solve retries per plane and kind (node = node-axis "
+    "escalation re-dispatch, coo = COO-bucket growth re-dispatch) — "
+    "the host-sourced telemetry slots, also fed to the watchdog's "
+    "escalation-burst detector", ("plane", "kind"))
+
 # Device telemetry (karpenter_tpu/obs/devtel.py): direct instrumentation
 # for the device-resident-state refactor (ROADMAP item 1).
 JIT_RECOMPILES = Counter(

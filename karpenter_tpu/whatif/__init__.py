@@ -18,7 +18,7 @@ turns them into a live product surface:
   PR-8 delta path so K scenarios ship as ONE stacked ``[K, D]`` pair,
   never K full encodes;
 - :mod:`kernels` + :mod:`planner` — one cached jitted dispatch vmapping
-  delta-apply + ``solve_core`` + ``_pack_result_explained`` over the K
+  delta-apply + ``solve_core`` + ``_pack_result_telemetry`` over the K
   axis (stacked inputs donated, prof-sampled ``"whatif"``), decoding
   per-scenario outcomes (placed/unplaced, explain reason histograms,
   cost, gang park risk, staleness estimate);

@@ -2,7 +2,7 @@
 
 One cached jit (GL003: per-call rebuilds would re-trace every plan)
 vmapping delta-apply + ``_unpack_problem`` + ``solve_core`` +
-``_pack_result_explained`` over the scenario axis: K futures solved in
+``_pack_result_telemetry`` over the scenario axis: K futures solved in
 ONE device dispatch against ONE baseline buffer.  Per scenario the body
 traces exactly the ``solve_packed`` pipeline on the delta-applied
 buffer, which is what makes each scenario's result words bit-identical
@@ -23,7 +23,7 @@ import functools
 import jax
 
 from karpenter_tpu.solver.jax_backend import (
-    _pack_result_explained, _unpack_problem, solve_core,
+    _pack_result_telemetry, _unpack_problem, solve_core,
 )
 
 
@@ -40,7 +40,7 @@ def _solve_scenarios_jit(K: int, D: int, G: int, O: int, U: int, N: int,
             meta[:, :4], meta[:, 4], meta[:, 5], compat_i > 0,
             off_alloc, off_price, off_rank, num_nodes=N,
             right_size=right_size)
-        return _pack_result_explained(
+        return _pack_result_telemetry(
             meta, rows_g, compat_i, node_off, assign, unplaced, cost,
             off_alloc, compact, dense16, coo16)
 

@@ -204,11 +204,14 @@ def compact_assign_np(assign: np.ndarray, K: int):
     return idx, cnt
 
 
-def pack_result_np(node_off, assign, unplaced, cost, words, K: int,
-                   dense16: bool = False, coo16: bool = False
-                   ) -> np.ndarray:
+def pack_result_np(node_off, assign, unplaced, cost, words, telemetry,
+                   K: int, dense16: bool = False,
+                   coo16: bool = False) -> np.ndarray:
     """numpy mirror of _pack_result + the appended reason words (the
-    dense16 pair packing mirrors jax_backend.pack16_pairs)."""
+    dense16 pair packing mirrors jax_backend.pack16_pairs) + the
+    telemetry block (obs/telemetry_words.telemetry_words_np, the full
+    magic-word-led block) — every oracle buffer carries the identical
+    v1 suffix the device finisher emits (solver/result_layout.py)."""
     cost_i = np.asarray([cost], dtype=np.float32).view(np.int32)
     if K > 0:
         idx, cnt = compact_assign_np(assign.astype(np.int32), K)
@@ -220,7 +223,8 @@ def pack_result_np(node_off, assign, unplaced, cost, words, K: int,
         tail = [assign.astype(np.int32).reshape(-1)]
     return np.concatenate([node_off.astype(np.int32),
                            unplaced.astype(np.int32), cost_i]
-                          + tail + [words.astype(np.int32)])
+                          + tail + [words.astype(np.int32),
+                                    telemetry.astype(np.int32)])
 
 
 def solve_packed_np(packed: np.ndarray, off_alloc, off_price, off_rank, *,
@@ -239,8 +243,12 @@ def solve_packed_np(packed: np.ndarray, off_alloc, off_price, off_rank, *,
         right_size=right_size)
     words = explain_words_np(meta, rows_g, compat_i,
                              unplaced.astype(np.int32), off_alloc)
+    from karpenter_tpu.obs.telemetry_words import telemetry_words_np
+
+    telemetry = telemetry_words_np(meta, node_off, assign,
+                                   unplaced.astype(np.int32), off_alloc)
     return pack_result_np(node_off, assign, unplaced, cost, words,
-                          compact, dense16, coo16)
+                          telemetry, compact, dense16, coo16)
 
 
 def solve_scenarios_np(baseline, stacked, *, N: int,

@@ -319,8 +319,11 @@ class WhatIfPlanner:
                 raw: np.ndarray, N: int, K_coo: int, coo16: bool,
                 backend: str, dispatches: int) -> WhatIfPlan:
         from karpenter_tpu.explain import fold_reason
-        from karpenter_tpu.solver.jax_backend import (
-            unpack_reason_words, unpack_result,
+        from karpenter_tpu.obs import telemetry_words
+        from karpenter_tpu.obs.devtel import get_devtel
+        from karpenter_tpu.solver.jax_backend import unpack_result
+        from karpenter_tpu.solver.result_layout import (
+            TELEMETRY_LEN_BYTES, unpack_reason_words,
         )
         from karpenter_tpu.whatif import WHATIF_RETRY_S
 
@@ -329,10 +332,17 @@ class WhatIfPlanner:
         gang_mask = np.asarray(baseline.problem.group_gang) >= 0
         price = np.asarray(baseline.catalog.off_price, dtype=np.float64)
         outcomes: list[ScenarioOutcome] = []
+        if backend == "device":
+            get_devtel().note_telemetry_d2h(
+                len(stacked.scenarios) * TELEMETRY_LEN_BYTES)
         for k, scenario in enumerate(stacked.scenarios):
             node_off, assign, unp, cost = unpack_result(
                 raw[k], G, N, K_coo, coo16=coo16)
             words = unpack_reason_words(raw[k], G, N, K_coo, coo16=coo16)
+            if backend == "device":
+                telemetry_words.decode_and_record(
+                    raw[k], G, N, K_coo, coo16=coo16, plane="whatif",
+                    delta_words=int(stacked.delta_words[k]))
             counts = stacked.counts[k][:G_real]
             unp_r = unp[:G_real].astype(np.int64)
             pods = int(counts.sum())

@@ -1458,3 +1458,107 @@ def test_gl111_real_repo_zero_debt():
     findings, _errors = lint_paths(root, _collect(root, list(DEFAULT_TARGETS)))
     naked = [f for f, _line in findings if f.rule == "GL111"]
     assert naked == [], [f"{f.path}:{f.line}" for f in naked]
+
+# -- GL112: suffix-layout drift (solver/result_layout) -----------------------
+
+_GOOD_LAYOUT = """
+TELEMETRY_SLOT_COUNT = 2
+SLOT_FILL_CPU_BP = 0
+SLOT_NODES_OPEN = 1
+"""
+
+_GOOD_SLOTS = """
+TELEMETRY_SLOTS = (
+    ("fill_cpu_bp", "device"),
+    ("nodes_open", "device"),
+)
+"""
+
+
+def test_gl112_accessor_redefinition_bad():
+    # a plane growing its own copy of the offset arithmetic is exactly
+    # the drift the layout module exists to prevent
+    assert_flags(
+        """
+        def result_tail_len(G, N, K, dense16, coo16):
+            return G * N
+        """, "GL112", "karpenter_tpu/sharded/_snippet.py")
+    assert_flags(
+        """
+        def unpack_telemetry_words(out, G, N, K):
+            return out[-16:]
+        """, "GL112", "karpenter_tpu/whatif/_snippet.py")
+
+
+def test_gl112_importing_accessors_clean():
+    assert_clean(
+        """
+        from karpenter_tpu.solver.result_layout import (
+            result_tail_len, unpack_reason_words, unpack_telemetry_words)
+
+        def decode(out, G, N, K):
+            return unpack_telemetry_words(out, G, N, K)
+        """, "GL112", "karpenter_tpu/sharded/_snippet.py")
+
+
+def test_gl112_cross_file_fixture_pair():
+    from tools.graftlint.rules.observability import (
+        suffix_layout_from_sources)
+
+    assert suffix_layout_from_sources(_GOOD_LAYOUT, _GOOD_SLOTS) == []
+    # name drift: registry renames a slot the layout doesn't know
+    renamed = _GOOD_SLOTS.replace('"nodes_open"', '"nodes_idle"')
+    problems = suffix_layout_from_sources(_GOOD_LAYOUT, renamed)
+    assert problems and "name drift" in problems[0]
+    # position drift: set equality holds but the wire order swapped
+    swapped = """
+TELEMETRY_SLOTS = (
+    ("nodes_open", "device"),
+    ("fill_cpu_bp", "device"),
+)
+"""
+    problems = suffix_layout_from_sources(_GOOD_LAYOUT, swapped)
+    assert problems and any("position" in p for p in problems)
+    # count drift: TELEMETRY_SLOT_COUNT no longer matches the registry
+    miscounted = _GOOD_LAYOUT.replace("TELEMETRY_SLOT_COUNT = 2",
+                                      "TELEMETRY_SLOT_COUNT = 3")
+    problems = suffix_layout_from_sources(miscounted, _GOOD_SLOTS)
+    assert problems and any("TELEMETRY_SLOT_COUNT" in p for p in problems)
+
+
+def test_gl112_computed_values_bad():
+    from tools.graftlint.rules.observability import (
+        suffix_layout_from_sources)
+
+    # a computed SLOT_* or a generator-built registry defeats the AST
+    # check and must be flagged, not silently accepted
+    computed_layout = """
+TELEMETRY_SLOT_COUNT = 2
+SLOT_FILL_CPU_BP = 0
+SLOT_NODES_OPEN = SLOT_FILL_CPU_BP + 1
+"""
+    assert suffix_layout_from_sources(computed_layout, _GOOD_SLOTS)
+    computed_slots = "TELEMETRY_SLOTS = tuple((n, 'device') for n in ())\n"
+    assert suffix_layout_from_sources(_GOOD_LAYOUT, computed_slots)
+
+
+def test_gl112_real_repo_consistent():
+    root = Path(__file__).resolve().parents[1]
+    from tools.graftlint.rules.observability import (
+        suffix_layout_from_sources)
+
+    assert suffix_layout_from_sources(
+        (root / "karpenter_tpu/solver/result_layout.py").read_text(),
+        (root / "karpenter_tpu/obs/telemetry_words.py").read_text()) == []
+
+
+def test_gl112_real_repo_zero_debt():
+    # the suffix accessors have exactly one home; the rule ships at
+    # zero debt in the same commit as the telemetry plane
+    from tools.graftlint.__main__ import DEFAULT_TARGETS, _collect
+    from tools.graftlint.engine import lint_paths
+
+    root = Path(__file__).resolve().parents[1]
+    findings, _errors = lint_paths(root, _collect(root, list(DEFAULT_TARGETS)))
+    drift = [f for f, _line in findings if f.rule == "GL112"]
+    assert drift == [], [f"{f.path}:{f.line}" for f in drift]

@@ -197,3 +197,47 @@ class TestSeriesHygiene:
             actuator.delete_node(c1)
         assert key in metrics.COST_PER_HOUR.samples(), \
             "series dropped while a live claim still has that shape"
+
+    def test_shard_backlog_series_removed_after_rebalance_shrink(self):
+        """Satellite hygiene (ISSUE 18): a shard label that stops being
+        published (mesh shrank after N-1 failover) must drop its
+        series, not freeze at the last value forever."""
+        pytest.importorskip("jax")
+        from karpenter_tpu.sharded import ShardedSolveService
+
+        svc = ShardedSolveService(2)
+        svc._publish_backlog([3, 5])
+        assert ("0",) in metrics.SHARD_BACKLOG.samples()
+        assert ("1",) in metrics.SHARD_BACKLOG.samples()
+        svc._publish_backlog([4])
+        samples = metrics.SHARD_BACKLOG.samples()
+        assert ("0",) in samples and samples[("0",)] == 4.0
+        assert ("1",) not in samples, \
+            "shard_backlog series leaked after the shard went away"
+        # render round-trip stays parseable with the shrunken set
+        fam = parse_exposition(metrics.render())[
+            "karpenter_tpu_shard_backlog_pods"]
+        labels = {dict(ls)["shard"] for (_n, ls) in fam["samples"]}
+        assert labels == {"0"}
+
+    def test_device_health_series_removed_on_prune(self):
+        """HealthBoard.prune (mesh remap) drops rows for departed
+        devices but KEEPS quarantined ones — quarantine is a recovery
+        state machine, not a liveness statement."""
+        from karpenter_tpu.faulttol.health import HealthBoard
+
+        board = HealthBoard(fault_threshold=1)
+        board.record_success("hyg:gone")
+        board.record_success("hyg:alive")
+        board.record_fault("hyg:sick", kind="fault",
+                           kernel="solve")             # -> quarantined
+        for dev in ("hyg:gone", "hyg:alive", "hyg:sick"):
+            assert (dev,) in metrics.DEVICE_HEALTH.samples()
+        removed = board.prune(["hyg:alive"])
+        assert removed == ["hyg:gone"]
+        samples = metrics.DEVICE_HEALTH.samples()
+        assert ("hyg:gone",) not in samples, \
+            "device_health series leaked after the device left the mesh"
+        assert ("hyg:alive",) in samples
+        assert ("hyg:sick",) in samples, \
+            "prune must not erase a quarantined device's recovery state"

@@ -1,0 +1,498 @@
+"""Device telemetry words (karpenter_tpu/obs/telemetry_words, ISSUE 18).
+
+Covers the plane end to end:
+
+- the versioned suffix layout (solver/result_layout): offset algebra,
+  STRICT telemetry decode — an old-layout buffer (wrong length or wrong
+  magic/version word) raises SuffixLayoutError loudly, and
+  decode_and_record turns that into "record nothing", never a failed
+  solve;
+- frac_bp long division vs the float reference, device twin included;
+- DEVICE reduction vs the numpy oracle — bit-identical across 8 seeded
+  differential sequences on the scan lane, the stochastic lane
+  (chance-constraint binding mask included), 2-shard stacked sharded
+  windows, and the whatif K-scenario axis;
+- the host edge: record_window fills the host-sourced slots, publishes
+  the solve_quality metric families, appends to the recorder's bounded
+  telemetry ring, and feeds the watchdog's quality-regression detector;
+- end-to-end wiring: a JaxSolver solve and a batch solve each record a
+  window whose counters agree with the returned plan.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp  # noqa: E402
+
+from karpenter_tpu import obs
+from karpenter_tpu.apis.nodeclaim import NodePool
+from karpenter_tpu.apis.pod import PodSpec, ResourceRequests, UsageDistribution
+from karpenter_tpu.catalog import (
+    CatalogArrays, InstanceTypeProvider, PricingProvider,
+)
+from karpenter_tpu.cloud.fake import FakeCloud, generate_profiles
+from karpenter_tpu.obs.telemetry_words import (
+    SLOT_NAMES, TELEMETRY_SLOTS, decode_and_record, decode_slots,
+    frac_bp_np, note_rebalance_skew, record_window, summary,
+    telemetry_words_np,
+)
+from karpenter_tpu.solver import JaxSolver, SolveRequest, encode
+from karpenter_tpu.solver.jax_backend import (
+    _pad1, _pad2, dedup_rows, pack_input, solve_packed, unpack_result,
+)
+from karpenter_tpu.solver.result_layout import (
+    BP_SCALE, HOST_SLOTS, SLOT_BINDING_GROUPS, SLOT_DELTA_WORDS,
+    SLOT_ESCALATIONS, SLOT_PODS_UNPLACED, SLOT_REBALANCE_SKEW,
+    SUFFIX_VERSION, TELEMETRY_LEN, TELEMETRY_MAGIC, TELEMETRY_SLOT_COUNT,
+    SuffixLayoutError, reason_words_offset, result_len, result_tail_len,
+    telemetry_offset, unpack_reason_words, unpack_telemetry_words,
+)
+from karpenter_tpu.solver.types import (
+    GROUP_BUCKETS, LABELROW_BUCKETS, OFFERING_BUCKETS, SolverOptions,
+    bucket,
+)
+from karpenter_tpu.utils import metrics
+
+
+@pytest.fixture(scope="module")
+def catalog():
+    cloud = FakeCloud()
+    pricing = PricingProvider(cloud)
+    itp = InstanceTypeProvider(cloud, pricing)
+    arrays = CatalogArrays.build(itp.list())
+    pricing.close()
+    return arrays
+
+
+def _pods(n, seed=0, prefix="tp"):
+    rng = np.random.RandomState(seed)
+    sizes = ((500, 1024), (1000, 2048), (2000, 8192), (4000, 16384))
+    out = []
+    for i in range(n):
+        cpu, mem = sizes[rng.randint(len(sizes))]
+        out.append(PodSpec(f"{prefix}{seed}-{i}",
+                           requests=ResourceRequests(cpu, mem, 0, 1)))
+    return out
+
+
+# -- suffix layout + versioning ----------------------------------------------
+
+
+class TestSuffixLayout:
+    @pytest.mark.parametrize("G,N,K,dense16,coo16", [
+        (16, 64, 0, False, False),
+        (16, 64, 0, True, False),
+        (16, 64, 96, False, False),
+        (16, 64, 96, False, True),
+        (1, 1, 0, False, False),
+    ])
+    def test_offset_algebra(self, G, N, K, dense16, coo16):
+        tail = result_tail_len(G, N, K, dense16, coo16)
+        r_off = reason_words_offset(G, N, K, dense16, coo16)
+        t_off = telemetry_offset(G, N, K, dense16, coo16)
+        assert r_off == N + G + 1 + tail
+        assert t_off == r_off + G
+        assert result_len(G, N, K, dense16, coo16) == t_off + TELEMETRY_LEN
+        assert TELEMETRY_LEN == 1 + TELEMETRY_SLOT_COUNT
+
+    def _good_buffer(self, G=4, N=8):
+        out = np.zeros(result_len(G, N, 0), np.int32)
+        out[telemetry_offset(G, N, 0)] = TELEMETRY_MAGIC
+        return out
+
+    def test_good_buffer_decodes(self):
+        out = self._good_buffer()
+        slots = unpack_telemetry_words(out, 4, 8, 0)
+        assert slots.shape == (TELEMETRY_SLOT_COUNT,)
+
+    def test_old_layout_truncated_rejected(self):
+        """A pre-telemetry buffer (explain suffix only) must fail
+        LOUDLY, never mis-decode assignment words as counters."""
+        G, N = 4, 8
+        old = self._good_buffer(G, N)[:reason_words_offset(G, N, 0) + G]
+        with pytest.raises(SuffixLayoutError, match="words"):
+            unpack_telemetry_words(old, G, N, 0)
+
+    def test_wrong_magic_rejected(self):
+        out = self._good_buffer()
+        out[telemetry_offset(4, 8, 0)] = 12345
+        with pytest.raises(SuffixLayoutError, match="magic"):
+            unpack_telemetry_words(out, 4, 8, 0)
+
+    def test_version_bump_rejected(self):
+        """A buffer from a future suffix version (magic tag, bumped
+        version byte) is rejected — both directions of skew fail."""
+        out = self._good_buffer()
+        out[telemetry_offset(4, 8, 0)] = np.int32(
+            (0x7E1E << 16) | (SUFFIX_VERSION + 1))
+        with pytest.raises(SuffixLayoutError, match="version"):
+            unpack_telemetry_words(out, 4, 8, 0)
+
+    def test_decode_and_record_never_raises(self):
+        """Telemetry must never fail a solve: both rejection modes
+        return None from the decode-site entry point."""
+        G, N = 4, 8
+        old = self._good_buffer(G, N)[:reason_words_offset(G, N, 0) + G]
+        assert decode_and_record(old, G, N, 0) is None
+        bad = self._good_buffer(G, N)
+        bad[telemetry_offset(G, N, 0)] = 7
+        assert decode_and_record(bad, G, N, 0) is None
+
+    def test_reason_words_stay_tolerant(self):
+        """unpack_reason_words keeps its historical None-for-legacy
+        semantics — only the telemetry decode is strict."""
+        assert unpack_reason_words(np.zeros(3, np.int32), 4, 8, 0) is None
+
+    def test_registry_shape(self):
+        assert len(TELEMETRY_SLOTS) == TELEMETRY_SLOT_COUNT
+        assert len(SLOT_NAMES) == len(set(SLOT_NAMES))
+        for idx in HOST_SLOTS:
+            assert TELEMETRY_SLOTS[idx][1] == "host"
+        device = [i for i, (_, src) in enumerate(TELEMETRY_SLOTS)
+                  if src == "device"]
+        assert set(device) | set(HOST_SLOTS) == set(
+            range(TELEMETRY_SLOT_COUNT))
+
+
+class TestFracBp:
+    @pytest.mark.parametrize("seed", range(8))
+    def test_long_division_matches_float_reference(self, seed):
+        rng = np.random.RandomState(seed)
+        num = rng.randint(0, 2**31 - 1, size=256).astype(np.int32)
+        den = rng.randint(1, 2**31 - 1, size=256).astype(np.int32)
+        got = frac_bp_np(num, den)
+        # exact int64 reference — the long division exists precisely
+        # because num * BP_SCALE overflows int32
+        want = (np.minimum(num, den).astype(np.int64)
+                * BP_SCALE // den).astype(np.int32)
+        np.testing.assert_array_equal(got, want)
+        assert (got >= 0).all() and (got <= BP_SCALE).all()
+
+    def test_device_twin_bit_identical(self):
+        from karpenter_tpu.solver.jax_backend import _frac_bp
+
+        rng = np.random.RandomState(7)
+        num = rng.randint(0, 2**31 - 1, size=512).astype(np.int32)
+        den = rng.randint(0, 2**31 - 1, size=512).astype(np.int32)
+        den[:8] = 0                                 # degenerate capacity
+        dev = np.asarray(_frac_bp(jnp.asarray(num), jnp.asarray(den)))
+        np.testing.assert_array_equal(dev, frac_bp_np(num, den))
+
+
+# -- device / oracle parity ---------------------------------------------------
+
+
+def _raw_scan(catalog, pods, N=64):
+    """The raw packed-kernel harness (test_explain's pattern): solve on
+    device, return everything the oracle needs."""
+    problem = encode(pods, catalog)
+    G = bucket(problem.num_groups, GROUP_BUCKETS)
+    O = bucket(catalog.num_offerings, OFFERING_BUCKETS)
+    if problem.label_rows is not None:
+        rows, label_idx = problem.label_rows, problem.label_idx
+    else:
+        label_idx, rows = dedup_rows(problem.compat)
+    U = bucket(max(rows.shape[0], 1), LABELROW_BUCKETS)
+    packed = pack_input(_pad2(problem.group_req, G),
+                        _pad1(problem.group_count, G),
+                        _pad1(problem.group_cap, G),
+                        _pad1(label_idx, G), _pad2(rows, U, O),
+                        group_prio=_pad1(problem.group_prio, G))
+    meta = packed[:G * 8].reshape(G, 8).copy()
+    off_alloc = _pad2(catalog.offering_alloc().astype(np.int32), O)
+    off_price = _pad1(catalog.off_price.astype(np.float32), O)
+    off_rank = _pad1(catalog.offering_rank_price(), O)
+    out = np.asarray(solve_packed(packed, off_alloc, off_price,
+                                  off_rank, G=G, O=O, U=U, N=N))
+    node_off, assign, unplaced, _ = unpack_result(out, G, N, 0)
+    return problem, meta, off_alloc, out, node_off, assign, unplaced, G, N
+
+
+class TestScanParity:
+    """The acceptance bar: device telemetry bit-identical to the numpy
+    oracle across 8 seeded sequences."""
+
+    @pytest.mark.parametrize("seed", range(8))
+    def test_device_slots_match_oracle(self, catalog, seed):
+        pods = _pods(100 + seed * 7, seed=seed)
+        pods.append(PodSpec(f"huge{seed}", requests=ResourceRequests(
+            40_000_000, 800_000_000, 0, 1)))
+        _, meta, off_alloc, out, node_off, assign, unplaced, G, N = \
+            _raw_scan(catalog, pods)
+        dev = decode_slots(out, G, N, 0)
+        oracle = telemetry_words_np(meta, node_off, assign, unplaced,
+                                    off_alloc)
+        assert int(oracle[0]) == int(TELEMETRY_MAGIC)
+        np.testing.assert_array_equal(dev, oracle[1:])
+        # host-sourced slots ride the wire as zero on both sides
+        assert all(int(dev[i]) == 0 for i in HOST_SLOTS)
+        # counters agree with the primal outputs
+        assert int(dev[SLOT_PODS_UNPLACED]) == int(unplaced.sum())
+
+    def test_empty_window(self, catalog):
+        """Zero open nodes: fills and slacks read 0, not garbage."""
+        _, meta, off_alloc, out, node_off, assign, unplaced, G, N = \
+            _raw_scan(catalog, [PodSpec("never", requests=ResourceRequests(
+                40_000_000, 800_000_000, 0, 1))])
+        dev = decode_slots(out, G, N, 0)
+        oracle = telemetry_words_np(meta, node_off, assign, unplaced,
+                                    off_alloc)
+        np.testing.assert_array_equal(dev, oracle[1:])
+        assert int(dev[0]) == 0                      # fill_cpu_bp
+
+
+class TestStochasticParity:
+    @pytest.mark.parametrize("seed", range(8))
+    def test_binding_mask_and_slots_match_oracle(self, catalog, seed):
+        from karpenter_tpu.stochastic.greedy import binding_mask_np
+        from karpenter_tpu.stochastic.kernel import (
+            build_fit_grids, solve_packed_stochastic,
+        )
+
+        rng = np.random.RandomState(seed)
+        pods = []
+        for i in range(80):
+            cpu, mem = ((500, 1024), (1000, 2048),
+                        (2000, 4096))[rng.randint(3)]
+            frac, cv = (0.4, 0.5, 0.6)[rng.randint(3)], \
+                (0.1, 0.2, 0.3)[rng.randint(3)]
+            pods.append(PodSpec(
+                f"st{seed}-{i}",
+                requests=ResourceRequests(cpu, mem, 0, 1),
+                usage=UsageDistribution(
+                    mean=ResourceRequests(int(cpu * frac),
+                                          int(mem * frac), 0, 1),
+                    var=(int((cv * cpu) ** 2), int((cv * mem) ** 2),
+                         0, 0))))
+        problem = encode(pods, catalog,
+                         NodePool(name="default", overcommit=0.05))
+        solver = JaxSolver(SolverOptions(backend="jax"))
+        prep = solver._prepare(problem)
+        off_alloc, off_price, off_rank = solver._device_offerings(
+            problem.catalog, prep.O_pad)
+        kd, kc = build_fit_grids(prep.sto, off_alloc, G=prep.G_pad,
+                                 z_bp=prep.z_bp)
+        out = np.asarray(solve_packed_stochastic(
+            prep.packed.copy(), prep.sto.copy(), kd, kc, off_alloc,
+            off_price, off_rank, G=prep.G_pad, O=prep.O_pad,
+            U=prep.U_pad, N=prep.N, z_bp=prep.z_bp, right_size=True))
+        G, N = prep.G_pad, prep.N
+        node_off, assign, unplaced, _ = unpack_result(out, G, N, 0)
+        dev = decode_slots(out, G, N, 0)
+
+        meta = np.asarray(prep.packed)[:G * 8].reshape(G, 8)
+        off_alloc_np = np.asarray(off_alloc)
+        # the device's rebuilt compat: gathered label row AND the
+        # resource-fit term vs the REQUEST vector (_unpack_problem)
+        sto = np.asarray(prep.sto)
+        half = G * 4
+        mean = sto[:half].reshape(G, 4)
+        var = sto[half:2 * half].reshape(G, 4)
+        if problem.label_rows is not None:
+            rows, label_idx = problem.label_rows, problem.label_idx
+        else:
+            label_idx, rows = dedup_rows(problem.compat)
+        rows_g = _pad2(rows, prep.U_pad, prep.O_pad)[
+            np.clip(_pad1(label_idx, G), 0, prep.U_pad - 1)]
+        fit = (off_alloc_np[None, :, :] >= meta[:, None, :4]).all(axis=2)
+        compat = (rows_g > 0) & fit
+        binding = binding_mask_np(mean, var, compat, off_alloc_np,
+                                  prep.z_bp)
+        oracle = telemetry_words_np(meta, node_off, assign, unplaced,
+                                    off_alloc_np, binding=binding)
+        np.testing.assert_array_equal(dev, oracle[1:])
+        assert int(dev[SLOT_BINDING_GROUPS]) == int(
+            (binding & (meta[:, 4] > 0)).sum())
+
+
+class TestShardedParity:
+    @pytest.mark.parametrize("seed", range(8))
+    def test_two_shard_stacked_windows_match_oracle(self, seed):
+        from karpenter_tpu.sharded import ShardedSolveService
+        from karpenter_tpu.sharded.encode import encode_shards
+        from karpenter_tpu.sharded.kernels import solve_shards
+
+        cloud = FakeCloud(profiles=generate_profiles(20))
+        pricing = PricingProvider(cloud)
+        try:
+            cat = CatalogArrays.build(
+                InstanceTypeProvider(cloud, pricing).list())
+        finally:
+            pricing.close()
+        svc = ShardedSolveService(2)
+        pods = _pods(40 + seed * 3, seed=seed, prefix="sh")
+        parts = svc.router.partition(pods)
+        w = encode_shards(parts, cat)
+        ct = svc._catalog_tensors(cat, w.O_pad)
+        S, L = w.stacked.shape
+        _, out = solve_shards(
+            jax.device_put(w.stacked), np.full((S, 64), L, np.int32),
+            np.zeros((S, 64), np.int32), *ct, mesh=svc.mesh,
+            G=w.G_pad, O=w.O_pad, U=w.U_pad, N=w.N)
+        out = np.asarray(out)
+        off_alloc = np.asarray(ct[0])
+        for s in range(S):
+            node_off, assign, unplaced, _ = unpack_result(
+                out[s], w.G_pad, w.N, 0)
+            meta = w.stacked[s][:w.G_pad * 8].reshape(w.G_pad, 8)
+            oracle = telemetry_words_np(meta, node_off, assign,
+                                        unplaced, off_alloc)
+            np.testing.assert_array_equal(
+                decode_slots(out[s], w.G_pad, w.N, 0), oracle[1:],
+                err_msg=f"seed {seed} shard {s}")
+
+
+class TestWhatifParity:
+    @pytest.mark.parametrize("seed", range(8))
+    def test_scenario_axis_matches_oracle(self, seed):
+        from karpenter_tpu.whatif import Scenario, WhatIfPlanner, \
+            build_baseline
+        from karpenter_tpu.whatif.oracle import solve_scenarios_np
+        from karpenter_tpu.whatif.scenario import (
+            ArrivalWave, spot_storm_mask,
+        )
+
+        cloud = FakeCloud(profiles=generate_profiles(6 + seed % 3))
+        pricing = PricingProvider(cloud)
+        try:
+            cat = CatalogArrays.build(
+                InstanceTypeProvider(cloud, pricing).list())
+        finally:
+            pricing.close()
+        rng = np.random.RandomState(seed)
+        baseline = build_baseline(_pods(20 + seed * 4, seed=seed,
+                                        prefix="wi"), cat)
+        G = baseline.problem.num_groups
+        menu = [Scenario("baseline")]
+        for i in range(3):
+            gis = rng.choice(G, size=min(3, G), replace=False)
+            perts: tuple = (ArrivalWave(tuple(
+                (int(g), int(rng.randint(1, 10)))
+                for g in sorted(gis))),)
+            if i % 2:
+                perts += (spot_storm_mask(cat),)
+            menu.append(Scenario(f"s{i}", perts))
+        plan = WhatIfPlanner().plan(baseline, menu)
+        ref = solve_scenarios_np(baseline, plan.stacked, N=plan.N,
+                                 compact=plan.K_coo, coo16=plan.coo16)
+        for k in range(len(menu)):
+            dev = decode_slots(plan.raw[k], baseline.G_pad, plan.N,
+                               plan.K_coo, coo16=plan.coo16)
+            orc = decode_slots(ref[k], baseline.G_pad, plan.N,
+                               plan.K_coo, coo16=plan.coo16)
+            np.testing.assert_array_equal(
+                dev, orc, err_msg=f"seed {seed} scenario {k}")
+
+
+# -- host edge ----------------------------------------------------------------
+
+
+@pytest.fixture()
+def _fresh_ring():
+    obs.reset_recorder(capacity=64)
+    yield
+    obs.reset_recorder(capacity=64)
+
+
+class TestRecordWindow:
+    def _slots(self, **kv):
+        s = np.zeros(TELEMETRY_SLOT_COUNT, np.int32)
+        for name, v in kv.items():
+            s[SLOT_NAMES.index(name)] = v
+        return s
+
+    def test_host_slots_filled_and_ring_appended(self, _fresh_ring):
+        note_rebalance_skew(9)
+        entry = record_window("test-plane",
+                              self._slots(fill_cpu_bp=5000, nodes_open=3),
+                              escalations=2, coo_growths=1,
+                              delta_words=7)
+        assert entry["escalations"] == 2
+        assert entry["coo_growths"] == 1
+        assert entry["delta_words"] == 7
+        assert entry["rebalance_skew"] == 9
+        ring = obs.get_recorder().telemetry()
+        assert ring and ring[-1]["plane"] == "test-plane"
+        assert ring[-1]["fill_cpu_bp"] == 5000
+        note_rebalance_skew(0)
+
+    def test_metric_families_published(self, _fresh_ring):
+        record_window("metrics-plane",
+                      self._slots(fill_mem_bp=2500, slack_min_bp=100,
+                                  pods_unplaced=4),
+                      escalations=1)
+        assert metrics.SOLVE_QUALITY_FILL.labels(
+            "metrics-plane", "mem").get() == 0.25
+        assert metrics.SOLVE_QUALITY_SLACK.labels(
+            "metrics-plane", "min").get() == 0.01
+        assert metrics.SOLVE_QUALITY_COUNT.labels(
+            "metrics-plane", "pods_unplaced").get() == 4.0
+        assert metrics.SOLVE_QUALITY_WINDOWS.labels(
+            "metrics-plane").get() >= 1
+        assert metrics.SOLVE_QUALITY_ESCALATIONS.labels(
+            "metrics-plane", "node").get() >= 1
+
+    def test_watchdog_fill_collapse_breach(self, _fresh_ring):
+        from karpenter_tpu.obs.watchdog import Watchdog, get_watchdog
+
+        wd = get_watchdog()
+        before = wd.breaches
+        # warm the baseline well above QUALITY_MIN_BASELINE_BP, then
+        # collapse the fill: the detector must breach
+        for _ in range(Watchdog.QUALITY_WARMUP + 1):
+            record_window("collapse-plane", self._slots(fill_cpu_bp=8000))
+        record_window("collapse-plane", self._slots(fill_cpu_bp=100))
+        assert wd.breaches > before
+
+    def test_summary_aggregates_planes(self, _fresh_ring):
+        record_window("sum-plane", self._slots(fill_cpu_bp=4000,
+                                               pods_unplaced=2))
+        record_window("sum-plane", self._slots(fill_cpu_bp=6000))
+        s = summary()
+        assert [row["name"] for row in s["slots"]] == list(SLOT_NAMES)
+        p = s["planes"]["sum-plane"]
+        assert p["windows"] == 2
+        assert p["mean_fill_fraction"] == 0.5
+        assert p["mean_pods_unplaced"] == 1.0
+
+
+class TestEndToEnd:
+    def test_solver_records_window(self, catalog, _fresh_ring):
+        solver = JaxSolver(SolverOptions(backend="jax"))
+        pods = _pods(30, seed=1)
+        pods.append(PodSpec("stuck", requests=ResourceRequests(
+            40_000_000, 800_000_000, 0, 1)))
+        plan = solver.solve(SolveRequest(pods, catalog))
+        ring = obs.get_recorder().telemetry()
+        assert ring, "solve recorded no telemetry window"
+        entry = ring[-1]
+        assert entry["plane"] == solver.last_stats["path"]
+        assert entry["pods_unplaced"] == len(plan.unplaced_pods)
+        assert entry["nodes_open"] == len(plan.nodes)
+
+    def test_batch_records_per_window(self, catalog, _fresh_ring):
+        solver = JaxSolver(SolverOptions(backend="jax"))
+        probs = [encode(_pods(12, seed=s, prefix=f"b{s}"), catalog)
+                 for s in range(3)]
+        plans = solver.solve_encoded_batch(probs)
+        ring = [e for e in obs.get_recorder().telemetry()
+                if e["plane"].endswith("-batch")]
+        assert len(ring) == len(plans) == 3
+        for entry, plan in zip(ring, plans):
+            assert entry["pods_unplaced"] == len(plan.unplaced_pods)
+
+    def test_telemetry_d2h_attributed(self, catalog, _fresh_ring):
+        from karpenter_tpu.obs.devtel import get_devtel
+
+        dt = get_devtel()
+        dt.reset()
+        JaxSolver(SolverOptions(backend="jax")).solve(
+            SolveRequest(_pods(10, seed=3), catalog))
+        snap = dt.snapshot()
+        assert snap["telemetry_d2h_bytes"] >= TELEMETRY_LEN * 4
+        # attribution, not addition: telemetry bytes are a slice of the
+        # one result fetch the solve already paid for
+        assert snap["telemetry_d2h_bytes"] <= snap["d2h_bytes"]

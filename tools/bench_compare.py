@@ -55,6 +55,11 @@ METRICS: tuple[tuple[str, str], ...] = (
     ("resident.warm_h2d_max_bytes", "lower"),
     ("explain.solve_warm_p50_ms", "lower"),
     ("explain.d2h_fraction", "lower"),
+    # device telemetry words (obs/telemetry_words): the metrics plane
+    # rides the packed result suffix — its D2H share and wire width
+    # must never creep
+    ("telemetry.d2h_fraction", "lower"),
+    ("telemetry.words_per_window", "lower"),
     # stochastic packing (karpenter_tpu/stochastic): chance-constrained
     # density vs deterministic requests, quantile-check overhead, and
     # the measured violation rate against the epsilon bound

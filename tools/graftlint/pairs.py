@@ -111,6 +111,21 @@ PAIRS: tuple[PairSpec, ...] = (
         device=("karpenter_tpu/solver/jax_backend.py::_explain_words",),
         oracle=("karpenter_tpu/explain/greedy.py::reason_words",),
     ),
+    PairSpec(
+        name="telemetry-words",
+        device=("karpenter_tpu/solver/jax_backend.py::_telemetry_words",),
+        oracle=("karpenter_tpu/obs/telemetry_words.py::"
+                "telemetry_words_np",),
+        # the suffix layout contract: both sides index the telemetry
+        # block through the one layout module (slot positions, magic,
+        # basis-point scale) — GL112 separately pins the slot enum
+        shared=(
+            "karpenter_tpu/solver/result_layout.py::TELEMETRY_MAGIC",
+            "karpenter_tpu/solver/result_layout.py::BP_SCALE",
+            "karpenter_tpu/solver/result_layout.py::"
+            "TELEMETRY_SLOT_COUNT",
+        ),
+    ),
 )
 
 

@@ -40,6 +40,7 @@ def all_rules() -> list[type[Rule]]:
         observability.BlockingSyncInHotPath,  # GL109
         concurrency.UnjournaledMutation,      # GL110
         observability.NakedDeviceDispatch,    # GL111
+        observability.SuffixLayoutDrift,      # GL112
         # Family C — whole-program contracts
         contracts.DuplicatedContractConstant,   # GL201
         contracts.FloatReductionInParityPath,   # GL202

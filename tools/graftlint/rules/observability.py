@@ -479,3 +479,140 @@ class NakedDeviceDispatch(Rule):
                     out.append((node.lineno, node.end_lineno
                                 or node.lineno))
         return out
+
+
+# ---------------------------------------------------------------------------
+# GL112 — suffix-layout drift (karpenter_tpu/solver/result_layout contract)
+# ---------------------------------------------------------------------------
+
+# the suffix accessor surface result_layout OWNS: a second definition of
+# any of these names in another plane re-derives the offset arithmetic
+# the layout module exists to consolidate
+_SUFFIX_ACCESSORS = {
+    "result_tail_len", "reason_words_offset", "telemetry_offset",
+    "result_len", "unpack_reason_words", "unpack_telemetry_words",
+}
+_LAYOUT_MODULE = "karpenter_tpu/solver/result_layout.py"
+_SLOTS_MODULE = "karpenter_tpu/obs/telemetry_words.py"
+
+
+def _slot_constants(tree: ast.AST) -> dict[str, int] | None:
+    """``SLOT_<NAME> = <int>`` module-level literal assignments, keyed
+    by the lowercased slot name (``SLOT_FILL_CPU_BP`` ->
+    ``fill_cpu_bp``).  None when any SLOT_* assignment is not a pure
+    int literal (the AST check cannot read computed values)."""
+    out: dict[str, int] = {}
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Assign):
+            continue
+        for t in node.targets:
+            if isinstance(t, ast.Name) and t.id.startswith("SLOT_"):
+                if not (isinstance(node.value, ast.Constant)
+                        and isinstance(node.value.value, int)):
+                    return None
+                out[t.id[len("SLOT_"):].lower()] = node.value.value
+    return out
+
+
+def suffix_layout_from_sources(layout_src: str,
+                               slots_src: str) -> list[str]:
+    """Pure cross-file form of the GL112 enum check (fixture-testable):
+    drift messages between result_layout's SLOT_* index constants and
+    telemetry_words' TELEMETRY_SLOTS registry literal (empty list =
+    consistent).  The registry's tuple ORDER is the wire order, so each
+    name's position must equal its SLOT_* index — set equality alone
+    would miss two slots swapping places."""
+    problems: list[str] = []
+    ltree = ast.parse(layout_src)
+    stree = ast.parse(slots_src)
+    consts = _slot_constants(ltree)
+    names = _tuple_reason_names(stree, "TELEMETRY_SLOTS")
+    if consts is None or not consts:
+        problems.append("SLOT_* constants missing or not int literals")
+    if names is None:
+        problems.append("TELEMETRY_SLOTS missing or not a literal tuple")
+    if consts and names is not None:
+        if set(consts) != set(names):
+            problems.append(
+                f"TELEMETRY_SLOTS vs SLOT_* name drift: "
+                f"{sorted(set(consts) ^ set(names))}")
+        else:
+            for i, name in enumerate(names):
+                if consts[name] != i:
+                    problems.append(
+                        f"slot {name!r} at registry position {i} but "
+                        f"SLOT_{name.upper()} = {consts[name]}")
+        count = _int_constant(ltree, "TELEMETRY_SLOT_COUNT")
+        if count is not None and count != len(names):
+            problems.append(
+                f"TELEMETRY_SLOT_COUNT = {count} but TELEMETRY_SLOTS "
+                f"has {len(names)} entries")
+    return problems
+
+
+def _int_constant(tree: ast.AST, name: str) -> int | None:
+    node = _assign_node(tree, name)
+    if node is not None and isinstance(node.value, ast.Constant) \
+            and isinstance(node.value.value, int):
+        return node.value.value
+    return None
+
+
+class SuffixLayoutDrift(Rule):
+    id = "GL112"
+    name = "suffix-layout-drift"
+    description = (
+        "The packed-result suffix layout (assignment tail + explain "
+        "reason words + telemetry block) is owned by ONE module: "
+        "karpenter_tpu/solver/result_layout.py. A plane that re-defines "
+        "an accessor (result_tail_len / unpack_reason_words / "
+        "unpack_telemetry_words / *_offset / result_len) re-derives the "
+        "offset arithmetic and silently mis-decodes the moment the "
+        "layout versions. The telemetry slot enum is cross-checked the "
+        "way GL108 pins the reason enum: obs/telemetry_words."
+        "TELEMETRY_SLOTS (the wire-order registry literal) must agree "
+        "bidirectionally — names AND positions — with result_layout's "
+        "SLOT_* index constants and TELEMETRY_SLOT_COUNT."
+    )
+    family = "B"
+    scope = ("karpenter_tpu/solver/*", "karpenter_tpu/resident/*",
+             "karpenter_tpu/stochastic/*", "karpenter_tpu/sharded/*",
+             "karpenter_tpu/whatif/*", "karpenter_tpu/obs/*",
+             "bench.py")
+
+    @staticmethod
+    def _repo_path(rel: str):
+        import pathlib
+
+        return pathlib.Path(__file__).resolve().parents[3] / rel
+
+    def check(self, module: SourceModule) -> Iterator[Finding]:
+        is_layout = module.path.endswith("solver/result_layout.py")
+        if not is_layout:
+            # check A: no plane re-defines the accessor surface
+            for node in ast.walk(module.tree):
+                if isinstance(node, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)) \
+                        and node.name in _SUFFIX_ACCESSORS:
+                    yield self.finding(
+                        module, node,
+                        f"`{node.name}` re-defined outside "
+                        f"solver/result_layout.py — the suffix offset "
+                        f"arithmetic has ONE owner; import it instead "
+                        f"(a local copy mis-decodes the moment the "
+                        f"layout versions)")
+        # check B: the slot enum, from whichever anchor file we're on
+        if is_layout or module.path.endswith("obs/telemetry_words.py"):
+            other_rel = _SLOTS_MODULE if is_layout else _LAYOUT_MODULE
+            other = self._repo_path(other_rel)
+            if not other.exists():
+                return
+            layout_src = module.text if is_layout else other.read_text()
+            slots_src = other.read_text() if is_layout else module.text
+            anchor = (_assign_node(module.tree, "SLOT_FILL_CPU_BP")
+                      if is_layout
+                      else _assign_node(module.tree, "TELEMETRY_SLOTS")) \
+                or module.tree.body[0]
+            for problem in suffix_layout_from_sources(layout_src,
+                                                      slots_src):
+                yield self.finding(module, anchor, problem)

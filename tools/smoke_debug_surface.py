@@ -56,6 +56,10 @@ import tempfile  # noqa: E402
 
 _journal_dir = tempfile.mkdtemp(prefix="ktpu-smoke-journal-")
 os.environ.setdefault("KARPENTER_JOURNAL_DIR", _journal_dir)
+# the scripted solver-quality collapse below writes a REAL triage
+# bundle — keep it out of the checkout's .triage/
+_triage_dir = tempfile.mkdtemp(prefix="ktpu-smoke-triage-")
+os.environ.setdefault("KARPENTER_TRIAGE_DIR", _triage_dir)
 
 
 def _get(port: int, path: str,
@@ -272,6 +276,58 @@ def main() -> int:
               "host-input dispatches counted as donation misses")
         check(0.0 <= snap["executable_cache_hit_ratio"] <= 1.0,
               "executable-cache hit ratio well-formed")
+        check(snap["telemetry_d2h_bytes"] > 0
+              and snap["telemetry_d2h_bytes"] <= snap["d2h_bytes"],
+              f"telemetry words' D2H attributed inside the result fetch "
+              f"(tel={snap['telemetry_d2h_bytes']})")
+
+        # demo solver-quality telemetry cycle (obs/telemetry_words +
+        # docs/design/observability.md): the jax demo solves above
+        # decoded their device telemetry suffix into the recorder's
+        # ring and the solve_quality families; a scripted fill collapse
+        # (warm baseline, then a window packing at a tenth of it) must
+        # then trip the watchdog's quality-regression detector and
+        # write a triage bundle
+        print("demo solver-quality cycle (scripted fill collapse)")
+        import numpy as _np
+
+        from karpenter_tpu.obs.telemetry_words import (
+            SLOT_FILL_CPU_BP, SLOT_NAMES, record_window,
+        )
+        from karpenter_tpu import obs as _kobs
+        from karpenter_tpu.obs.watchdog import get_watchdog
+
+        ring0 = _kobs.get_recorder().telemetry()
+        check(bool(ring0) and all("plane" in e for e in ring0),
+              f"jax demo solves recorded telemetry windows "
+              f"(ring={len(ring0)})")
+        wd = get_watchdog()
+        before_breaches, before_bundles = wd.breaches, wd.bundles
+        warm = _np.zeros(len(SLOT_NAMES), _np.int32)
+        warm[SLOT_FILL_CPU_BP] = 8000
+        for _ in range(wd.QUALITY_WARMUP + 1):
+            record_window("smoke-collapse", warm)
+        collapsed = warm.copy()
+        collapsed[SLOT_FILL_CPU_BP] = 100
+        record_window("smoke-collapse", collapsed)
+        check(wd.breaches > before_breaches,
+              "fill collapse tripped the quality-regression detector")
+        check(wd.bundles > before_bundles
+              and "quality_regression" in wd.last_bundle_path,
+              f"quality breach wrote a triage bundle "
+              f"({wd.last_bundle_path or 'none'})")
+        bundle_ok = False
+        if wd.last_bundle_path:
+            bpath = os.path.join(wd.last_bundle_path, "bundle.json")
+            if os.path.exists(bpath):
+                with open(bpath) as fh:
+                    bman = json.load(fh)
+                bundle_ok = (bman.get("trigger") == "quality_regression"
+                             and bman.get("detail", {}).get("plane")
+                             == "smoke-collapse"
+                             and "device_telemetry" in bman)
+        check(bundle_ok,
+              "triage bundle manifest carries the collapse detail")
 
         # demo resident cycle: two churned windows through a resident-
         # enabled JaxSolver — window 1 rebuilds (cold), window 2 rides
@@ -552,6 +608,27 @@ def main() -> int:
               in text, "watchdog breach counter family rendered")
         check("# TYPE karpenter_tpu_triage_bundles_total counter"
               in text, "triage bundle counter family rendered")
+        # device telemetry words / solver-quality families
+        # (obs/telemetry_words.py + docs/design/observability.md) —
+        # live from the jax demo solves and the scripted collapse above
+        check('karpenter_tpu_solve_quality_fill_fraction{' in text,
+              "solve-quality fill gauge carries live windows")
+        check('karpenter_tpu_solve_quality_slack_fraction{' in text,
+              "solve-quality slack gauge rendered")
+        check('karpenter_tpu_solve_quality_count{' in text
+              and 'kind="pods_unplaced"' in text,
+              "solve-quality count gauge carries the placement shape")
+        check('karpenter_tpu_solve_quality_windows_total{' in text,
+              "solve-quality window counter counted the demo solves")
+        check("# TYPE karpenter_tpu_solve_quality_escalations_total "
+              "counter" in text,
+              "solve-quality escalation counter family rendered")
+        check('karpenter_tpu_watchdog_breaches_total{kernel='
+              '"smoke-collapse",phase="quality"}' in text,
+              "watchdog counted the scripted quality breach")
+        check('karpenter_tpu_triage_bundles_total{trigger='
+              '"quality_regression"}' in text,
+              "triage bundle counter carries the quality trigger")
         # device-fault survivability families (karpenter_tpu/faulttol +
         # docs/design/faulttol.md) — live from the demo cycle above
         check('karpenter_tpu_device_health{device="cpu:99"} 2' in text,
@@ -746,6 +823,38 @@ def main() -> int:
               .get("bx2-4x16/us-south-1") == 1,
               "/debug/risk history reproduces the ledger counts")
 
+        print("GET /debug/telemetry")
+        status, ctype, body = _get(port, "/debug/telemetry")
+        check(status == 200,
+              f"/debug/telemetry status 200 (got {status})")
+        check(ctype == "application/json",
+              f"/debug/telemetry content type (got {ctype!r})")
+        try:
+            tdoc = json.loads(body)
+        except ValueError as e:
+            tdoc = {}
+            check(False, f"/debug/telemetry parses as JSON ({e})")
+        for key in ("slots", "host_slot_indices", "windows_recorded",
+                    "planes", "ring"):
+            check(key in tdoc, f"/debug/telemetry has {key!r}")
+        check(len(tdoc.get("slots", ())) == len(SLOT_NAMES)
+              and all({"index", "name", "source"} <= set(s)
+                      for s in tdoc.get("slots", ())),
+              "/debug/telemetry publishes the full slot registry")
+        check(tdoc.get("windows_recorded", 0) >= 1
+              and bool(tdoc.get("ring")),
+              f"/debug/telemetry retains recorded windows "
+              f"(got {tdoc.get('windows_recorded')})")
+        tplanes = tdoc.get("planes") or {}
+        check("smoke-collapse" in tplanes
+              and tplanes["smoke-collapse"].get("windows", 0)
+              >= wd.QUALITY_WARMUP + 2,
+              f"/debug/telemetry aggregates per plane "
+              f"(planes={sorted(tplanes)})")
+        check(any(p.get("last", {}).get("nodes_open", 0) > 0
+                  for p in tplanes.values()),
+              "a live solve plane reported open nodes in its last window")
+
         print("GET /debug/whatif (on-demand + single-flight)")
         # deterministic single-flight probe: hold the evaluation lock,
         # a concurrent request must get 429, never a second stacked
@@ -810,6 +919,10 @@ def main() -> int:
         check("breaches" in swd and "bundles" in swd
               and "rate_limit_s" in swd,
               f"/statusz surfaces watchdog state ({swd})")
+        sq = doc.get("solve_quality") or {}
+        check("planes" in sq and "smoke-collapse" in sq.get("planes", {}),
+              f"/statusz surfaces the solve-quality aggregates "
+              f"(planes={sorted(sq.get('planes', {}))})")
         # device-fault survivability block (docs/design/faulttol.md):
         # the demo quarantine above must be visible here, plus the
         # deadline table and the healthy-path overhead gate readout
