@@ -1,0 +1,84 @@
+"""Pod-to-pod (anti-)affinity and topology spread — the sixth solver plane.
+
+Per "Affinity-Aware Resource Provisioning for Long-Running Applications"
+(PAPERS.md), inter-pod placement constraints dominate real long-running
+workloads: required affinity ("run with my cache"), anti-affinity
+("never two replicas on one host"), and per-topology-key spread bounds.
+``apis/pod.PodAffinityTerm`` / ``TopologySpreadConstraint`` carry them
+(parse_-style hard-reject validation; both already split constraint
+signatures, so an edge-carrying pod never shares a group row with a
+lookalike).
+
+The lowering is DENSE, never pairwise-per-pod: the encoder maps each
+distinct label selector to a small *selector class* and each group to
+int32 class BITMASKS (``g_sel`` — classes the group's labels match,
+``g_anti``/``g_req`` — classes its terms target), plus one per-class
+spread-bound row.  The device kernel then evaluates every pairwise
+constraint through per-node class presence masks — the PR-9
+``capacity_higher_prio`` reformulation generalized: O(G·N·C) masked
+reductions instead of the naive O(G²·N) pairwise grid — fused into the
+one solve dispatch (zero extra dispatches; the class tensors ride a
+small packed suffix leaf exactly like the stochastic plane's mean/var
+rows, never a (G×G) H2D).
+
+Plane layout (the established encode/kernel/greedy-parity/degraded/
+validate pattern of preempt/, gang/, repack/, and stochastic/):
+
+- ``affinity/encode.py``   — selector classes, group bitmasks, spread
+  bounds, connected components, required-edge depth ranks, the packed
+  suffix leaf, and the zone-scope co-pin prepass;
+- ``affinity/kernel.py``   — the affinity-gated FFD scan (jitted,
+  donated per GL006, prof-sampled), same packed result wire;
+- ``affinity/greedy.py``   — the bit-identical numpy parity oracle;
+- ``affinity/degraded.py`` — unconstrained-scan fallback when the
+  affinity kernel fails (the choke point below still enforces edges,
+  so a degraded window never ships a violating plan);
+- ``affinity/enforce.py``  — the decode choke point: every plan (device
+  OR host, healthy OR degraded) passes the same host-side edge/bound
+  enforcement in ``decode_plan_entries`` (the gang pattern);
+- ``affinity/validate.py`` — the independent validator: edge
+  satisfaction, spread counts re-derived from raw pods, anti-affinity
+  disjointness — shares no code with the solver.
+
+Topology scopes: ``kubernetes.io/hostname`` constraints are enforced
+IN-KERNEL (per-node class masks); ``topology.kubernetes.io/zone``
+constraints are resolved host-side (the encode zone-pin prepass
+co-pins required components, the choke drops violators) — the kernel
+stays a pure per-node scan either way.
+
+Every numeric constant the device kernel and the host oracle share
+lives HERE — change one side, change both is prevented by having only
+one side to change.
+"""
+
+from __future__ import annotations
+
+# Selector-class budget of the device lane: class masks are int32
+# bitmasks, and bit 31 is the sign bit while bit 30 guards the
+# ``~mask`` complement arithmetic — 30 distinct hostname selector
+# classes per window is far above real manifests (clusters reuse a
+# handful of app/tier selectors).  A window exceeding the budget
+# disarms the DEVICE lane only (logged breadcrumb); the decode choke
+# and the validator still enforce every edge host-side.
+MAX_SELECTOR_CLASSES = 30
+
+# Padded class-axis width of the packed suffix leaf and the kernel's
+# per-node count grid — one power-of-two bucket, so the executable
+# cache never fragments on class count.
+C_PAD = 32
+
+# "Unbounded" sentinel for spread-bound rows: large enough that
+# ``bound - node_count`` never binds, small enough that int32
+# arithmetic on it can never overflow.
+AFF_BIG = 1 << 20
+
+
+def affinity_enabled(problem) -> bool:
+    """Does this encoded problem carry the affinity plane?  True when
+    the encoder attached an :class:`~karpenter_tpu.affinity.encode.
+    AffinityIndex` (at least one live inter-group edge or bounded
+    spread class).  The strict-superset gate: every dispatch path
+    checks this before routing to the affinity kernel, and an
+    edge-free window is byte-identical to a build without this plane.
+    """
+    return getattr(problem, "aff", None) is not None

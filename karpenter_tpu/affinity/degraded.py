@@ -1,0 +1,50 @@
+"""Degraded mode: unconstrained-scan fallback.
+
+The affinity-gated kernel can fail the same ways any device kernel can
+(dead tunnel, Mosaic/XLA fault, a poisoned donated buffer).  None of
+those may fail a solve window — the ``ResilientSolver`` convention:
+the dispatch strips the affinity suffix and re-runs the IDENTICAL
+packed buffer through the deterministic scan, with an ``ERRORS``
+breadcrumb so dashboards see every degradation.  Correctness survives
+the fallback: the decode choke point (``affinity/enforce.py``) runs on
+EVERY plan regardless of which kernel produced it, so a degraded
+window drops edge-violating placements honestly instead of shipping
+them — degraded mode costs packing quality, never constraint fidelity.
+"""
+
+from __future__ import annotations
+
+from karpenter_tpu.utils import metrics
+from karpenter_tpu.utils.logging import get_logger
+
+log = get_logger("affinity.degraded")
+
+
+def strip_affinity(prep) -> None:
+    """Disarm the affinity route on a prepared dispatch IN PLACE: the
+    next ``_dispatch`` of this prep (and of its cached template — a
+    broken kernel must not re-break every later window of the same
+    shape) runs the deterministic scan on the unchanged base buffer."""
+    prep.aff = None
+    tmpl = getattr(prep, "tmpl", None)
+    if tmpl is not None:
+        tmpl.aff = None
+
+
+def note_degraded(prep, error: Exception) -> None:
+    """One degradation breadcrumb: log + metric, then strip."""
+    log.warning("affinity kernel failed; unconstrained-scan fallback "
+                "engaged (choke-point enforcement still applies)",
+                error=str(error)[:300],
+                G=prep.G_pad, O=prep.O_pad, N=prep.N)
+    metrics.ERRORS.labels("solver", "affinity_fallback").inc()
+    strip_affinity(prep)
+
+
+def unconstrained_problem(problem):
+    """Problem-level fallback (host paths): the same window with the
+    affinity index dropped — the scan ignores edges, the decode choke
+    still enforces them."""
+    if getattr(problem, "aff", None) is None:
+        return problem
+    return problem.replace(aff=None)

@@ -1,0 +1,249 @@
+"""Device kernel: the affinity-gated FFD scan.
+
+Same shape as ``solver/jax_backend.solve_packed`` — one donated packed
+problem buffer in, one packed result buffer (node_off / unplaced / cost
+/ assign tail / explain words / telemetry) out — plus the small donated
+affinity suffix leaf (``affinity/encode.pack_affinity``).  The scan
+carries three extra pieces of per-node state:
+
+    node_sel   int32 [N]     bitmask of selector classes PRESENT
+    node_anti  int32 [N]     union of anti masks of resident groups
+    node_cnt   int32 [N, C]  per-class resident pod counts
+
+and gates every fill with three masked terms (the PR-9
+``capacity_higher_prio`` per-reduction reformulation — per-node class
+presence instead of the naive O(G²) pairwise grid):
+
+    anti ok    (node_sel & g_anti) == 0  and  (node_anti & g_sel) == 0
+               (both directions — kube enforces anti-affinity
+               symmetrically at schedule time)
+    req ok     (g_req & ~node_sel) == 0  (every required class already
+               resident; groups whose own labels don't satisfy their
+               required classes can NEVER open a node, so kernel
+               placements satisfy required hostname edges BY
+               CONSTRUCTION — the encoder's req_depth sort key packs
+               targets first)
+    spread     fit is clipped to min over the group's bounded member
+               classes of (bound_c - node_cnt[n, c])
+
+Zone-scope terms never reach this kernel: the encode prepass co-pins
+required zone components and the decode choke point
+(``affinity/enforce.py``) drops any residual violation host-side.
+
+Bit-identity with the numpy oracle (affinity/greedy.py) is structural:
+every gate is exact int32 arithmetic — no float enters the affinity
+terms at all — and the scan body mirrors ``jax_backend._ffd_step``
+line for line.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from karpenter_tpu.affinity import AFF_BIG, C_PAD
+from karpenter_tpu.solver.types import FIT_BIG as _BIG
+
+
+def _fit_counts(resid, req):
+    """[X,R] // [R] -> [X]; dims with req==0 are unconstrained (mirror
+    of jax_backend._fit_counts, local so the kernel module has no
+    import-time dependency on the 2k-line backend)."""
+    per_dim = jnp.where(req[None, :] > 0,
+                        resid // jnp.maximum(req[None, :], 1), _BIG)
+    return jnp.min(per_dim, axis=1)
+
+
+def _spread_allowance(node_cnt, member, bounds):
+    """int32 [N]: how many more pods of a group whose member classes are
+    ``member`` ([C] 0/1) each node admits under the per-class bounds —
+    AFF_BIG when no member class is bounded."""
+    live = (member[None, :] > 0) & (bounds[None, :] < AFF_BIG)
+    room = jnp.where(live, bounds[None, :] - node_cnt, AFF_BIG)
+    return jnp.min(room, axis=1)
+
+
+def _ffd_step_affinity(off_alloc, off_rank, bounds, state, inputs):
+    """One group through the affinity-gated scan.  Mirrors
+    jax_backend._ffd_step line for line; the three affinity gates mask
+    the open-node fit, the new-node branch honors ``can_open`` and the
+    per-class bound, and the class state advances with the placement."""
+    node_off, node_resid, node_sel, node_anti, node_cnt, ptr = state
+    req, count, cap, compat_g, g_sel_g, g_anti_g, g_req_g = inputs
+
+    C = node_cnt.shape[1]
+    member = ((g_sel_g >> jnp.arange(C, dtype=jnp.int32)) & 1) \
+        .astype(jnp.int32)                                    # [C] 0/1
+
+    N = node_off.shape[0]
+    is_open = node_off >= 0
+    node_compat = jnp.where(is_open,
+                            compat_g[jnp.clip(node_off, 0, None)], False)
+
+    # ---- fill open nodes, first-fit in age order -------------------------
+    fit = _fit_counts(node_resid, req)
+    fit = jnp.where(node_compat, fit, 0)
+    fit = jnp.minimum(fit, cap)
+    ok_anti = ((node_sel & g_anti_g) == 0) & ((node_anti & g_sel_g) == 0)
+    ok_req = (g_req_g & ~node_sel) == 0
+    fit = jnp.where(ok_anti & ok_req, fit, 0)
+    allow = _spread_allowance(node_cnt, member, bounds)
+    fit = jnp.minimum(fit, jnp.clip(allow, 0, None))
+    cumfit = jnp.cumsum(fit) - fit
+    take = jnp.clip(count - cumfit, 0, fit)
+    placed = jnp.sum(take)
+    node_resid = node_resid - take[:, None] * req[None, :]
+    node_cnt = node_cnt + take[:, None] * member[None, :]
+    node_sel = jnp.where(take > 0, node_sel | g_sel_g, node_sel)
+    node_anti = jnp.where(take > 0, node_anti | g_anti_g, node_anti)
+    rem = count - placed
+
+    # ---- open new nodes with the cheapest-per-pod offering ---------------
+    # a group whose own labels do not satisfy its required classes can
+    # never seed a node: its targets must already be resident
+    can_open = (g_req_g & ~g_sel_g) == 0
+    bound_new = jnp.min(jnp.where((member > 0) & (bounds < AFF_BIG),
+                                  bounds, AFF_BIG))
+    fit_empty = _fit_counts(off_alloc, req)
+    fit_empty = jnp.where(compat_g, fit_empty, 0)
+    fit_empty = jnp.minimum(fit_empty, cap)
+    fit_empty = jnp.minimum(fit_empty, rem)
+    fit_empty = jnp.where(can_open, fit_empty, 0)
+    fit_empty = jnp.minimum(fit_empty, bound_new)
+    cpp = jnp.where(fit_empty > 0, off_rank / fit_empty.astype(jnp.float32),
+                    jnp.inf)
+    best = jnp.argmin(cpp).astype(jnp.int32)
+    bf = fit_empty[best]
+
+    n_new = jnp.where(bf > 0, -(-rem // jnp.maximum(bf, 1)), 0)
+    n_new = jnp.minimum(n_new, N - ptr)
+    idx = jnp.arange(N, dtype=jnp.int32)
+    new_pos = idx - ptr
+    is_new = (new_pos >= 0) & (new_pos < n_new)
+    pods_new = jnp.where(is_new, jnp.clip(rem - new_pos * bf, 0, bf), 0)
+    opened = is_new & (pods_new > 0)
+    node_off = jnp.where(opened, best, node_off)
+    node_resid = jnp.where(
+        opened[:, None],
+        off_alloc[best][None, :] - pods_new[:, None] * req[None, :],
+        node_resid)
+    node_cnt = jnp.where(opened[:, None], pods_new[:, None] * member[None, :],
+                         node_cnt)
+    node_sel = jnp.where(opened, g_sel_g, node_sel)
+    node_anti = jnp.where(opened, g_anti_g, node_anti)
+    ptr = ptr + jnp.sum(opened.astype(jnp.int32))
+    placed_new = jnp.sum(pods_new)
+    unplaced_g = rem - placed_new
+    assign_g = take + pods_new
+    return ((node_off, node_resid, node_sel, node_anti, node_cnt, ptr),
+            (assign_g, unplaced_g))
+
+
+def _right_size_affinity(node_off, load, assign, compat, off_alloc,
+                         off_rank):
+    """Per-node cheapest compatible offering that fits the final load —
+    the base ``jax_backend._right_size`` body (no soft preferences).
+    Offering swaps never move a pod between nodes, so every affinity
+    gate the scan enforced still holds afterwards."""
+    N = node_off.shape[0]
+    is_open = node_off >= 0
+    safe_off = jnp.clip(node_off, 0, None)
+    present = (assign > 0).astype(jnp.float32)               # [G, N]
+    incompat = (~compat).astype(jnp.float32)                 # [G, O]
+    incompat_count = jnp.einsum("gn,go->no", present, incompat,
+                                preferred_element_type=jnp.float32)
+    all_compat = incompat_count < 0.5                        # [N, O]
+    fits = jnp.all(off_alloc[None, :, :] >= load[:, None, :], axis=2)
+    candidate = all_compat & fits & is_open[:, None]
+    rank_eff = jnp.broadcast_to(off_rank[None, :], (N, off_rank.shape[0]))
+    cand_price = jnp.where(candidate, rank_eff, jnp.inf)
+    best = jnp.argmin(cand_price, axis=1).astype(jnp.int32)
+    best_price = jnp.min(cand_price, axis=1)
+    cur_price = jnp.take_along_axis(rank_eff, safe_off[:, None],
+                                    axis=1)[:, 0]
+    improve = is_open & (best_price < cur_price - 1e-9)
+    return jnp.where(improve, best, node_off)
+
+
+def _affinity_words(aff_flag, spread_flag, count, unplaced):
+    """int32 [G] with the two affinity reason bits: set for a live
+    unplaced group that carries (or is targeted by) an armed edge /
+    a bounded spread class.  Mirrored in
+    explain/greedy.affinity_words_np (the parity contract)."""
+    from karpenter_tpu.explain import BIT
+
+    live_un = (count > 0) & (unplaced > 0)
+    bits = jnp.where(live_un & (aff_flag > 0),
+                     jnp.int32(1 << BIT["affinity_unsatisfied"]), 0)
+    bits = bits | jnp.where(live_un & (spread_flag > 0),
+                            jnp.int32(1 << BIT["spread_bound"]), 0)
+    return bits.astype(jnp.int32)
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("G", "O", "U", "N", "right_size",
+                                    "compact", "dense16", "coo16"),
+                   donate_argnames=("packed", "aff"))
+def solve_packed_affinity(packed, aff, off_alloc, off_price, off_rank, *,
+                          G: int, O: int, U: int, N: int,
+                          right_size: bool = True, compact: int = 0,
+                          dense16: bool = False, coo16: bool = False):
+    """Packed-I/O affinity-gated solve.  Buffer contract identical to
+    ``solve_packed`` (the unconstrained fallback re-dispatches the same
+    ``packed`` buffer; the decode choke point still enforces every
+    edge), plus the donated affinity suffix ``aff`` — O(G) class
+    bitmasks and the C_PAD bound row (affinity/encode.pack_affinity),
+    never a (G×G) matrix."""
+    from karpenter_tpu.solver.jax_backend import (
+        _explain_words, _pack_result, _telemetry_words, _unpack_problem,
+    )
+    from karpenter_tpu.apis.pod import NUM_RESOURCES
+
+    meta, compat_i, rows_g = _unpack_problem(packed, off_alloc, G, O, U)
+    g_sel = aff[:G]
+    g_anti = aff[G:2 * G]
+    g_req = aff[2 * G:3 * G]
+    aff_flag = aff[3 * G:4 * G]
+    spread_flag = aff[4 * G:5 * G]
+    bounds = aff[5 * G:5 * G + C_PAD]
+    compat = compat_i > 0
+    count, cap = meta[:, 4], meta[:, 5]
+
+    node_off0 = jnp.full((N,), -1, dtype=jnp.int32)
+    node_resid0 = jnp.zeros((N, NUM_RESOURCES), dtype=jnp.int32)
+    node_sel0 = jnp.zeros((N,), dtype=jnp.int32)
+    node_anti0 = jnp.zeros((N,), dtype=jnp.int32)
+    node_cnt0 = jnp.zeros((N, C_PAD), dtype=jnp.int32)
+    step = functools.partial(_ffd_step_affinity, off_alloc, off_rank,
+                             bounds)
+    ((node_off, node_resid, _sel, _anti, _cnt, _ptr),
+     (assign, unplaced)) = lax.scan(
+        step,
+        (node_off0, node_resid0, node_sel0, node_anti0, node_cnt0,
+         jnp.int32(0)),
+        (meta[:, :4], count, cap, compat, g_sel, g_anti, g_req))
+    if right_size:
+        load = off_alloc[jnp.clip(node_off, 0, None)] - node_resid
+        node_off = _right_size_affinity(node_off, load, assign, compat,
+                                        off_alloc, off_rank)
+    is_open = node_off >= 0
+    # cost word: excluded from bit-parity up to reduction order (see
+    # docs/design/parity.md) — the one sanctioned float reduction
+    cost = jnp.sum(  # graftlint: disable=GL202 (cost word)
+        jnp.where(is_open, off_price[jnp.clip(node_off, 0, None)], 0.0))
+    out = _pack_result(node_off, assign, unplaced, cost, compact, dense16,
+                       coo16)
+    words = _explain_words(meta, rows_g, compat_i,
+                           unplaced.astype(jnp.int32), off_alloc)
+    words = words | _affinity_words(aff_flag, spread_flag, count,
+                                    unplaced.astype(jnp.int32))
+    # telemetry binding mask: constrained groups — any armed edge or
+    # bounded class membership (the oracle twin passes the identical
+    # flags to telemetry_words_np)
+    binding = (aff_flag | spread_flag) > 0
+    tele = _telemetry_words(meta, node_off, assign, unplaced, off_alloc,
+                            binding=binding)
+    return jnp.concatenate([out, words, tele])

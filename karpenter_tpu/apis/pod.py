@@ -224,12 +224,71 @@ def tolerates_soft(tolerations: tuple[Toleration, ...],
     return True
 
 
+# The two topology domains the solver models (node = offering slot,
+# zone = catalog zone).  Any other key is a hard reject: a typo'd
+# topology_key must not silently degrade to "no constraint".
+HOSTNAME_TOPOLOGY_KEY = "kubernetes.io/hostname"
+ZONE_TOPOLOGY_KEY = "topology.kubernetes.io/zone"
+TOPOLOGY_KEYS = frozenset({HOSTNAME_TOPOLOGY_KEY, ZONE_TOPOLOGY_KEY})
+
+
+def _selector_tuple(sel, what: str, allow_empty: bool):
+    """parse_priority-style strictness for one label selector: a
+    tuple/list of (str key, str value) pairs with non-empty keys.
+    Returns the normalized tuple-of-tuples form (signatures and the
+    affinity encoder both key on the exact tuple value)."""
+    if not isinstance(sel, (tuple, list)):
+        raise ValueError(f"bad {what} label_selector {sel!r}: must be a "
+                         f"tuple of (key, value) pairs")
+    out = []
+    for item in sel:
+        if (not isinstance(item, (tuple, list)) or len(item) != 2
+                or not isinstance(item[0], str)
+                or not isinstance(item[1], str) or not item[0]):
+            raise ValueError(f"bad {what} label_selector entry {item!r}: "
+                             f"must be a (non-empty str, str) pair")
+        out.append((item[0], item[1]))
+    if not out and not allow_empty:
+        raise ValueError(f"bad {what}: label_selector must not be empty "
+                         f"(an edge with no selector matches nothing)")
+    return tuple(out)
+
+
+def _topology_key(key, what: str) -> str:
+    if key not in TOPOLOGY_KEYS:
+        raise ValueError(f"bad {what} topology_key {key!r}: must be one "
+                         f"of {sorted(TOPOLOGY_KEYS)}")
+    return key
+
+
 @dataclass(frozen=True)
 class TopologySpreadConstraint:
     max_skew: int = 1
     topology_key: str = "topology.kubernetes.io/zone"
     when_unsatisfiable: str = "DoNotSchedule"  # or ScheduleAnyway
     label_selector: tuple[tuple[str, str], ...] = ()
+
+    def __post_init__(self):
+        # hard-reject at construction (the parse_priority convention):
+        # a zero/negative skew or a bool would flow straight into the
+        # int32 spread-bound tensor as a nonsense cap
+        if isinstance(self.max_skew, bool) \
+                or not isinstance(self.max_skew, int) or self.max_skew < 1:
+            raise ValueError(f"bad topology_spread max_skew "
+                             f"{self.max_skew!r}: must be an int >= 1")
+        _topology_key(self.topology_key, "topology_spread")
+        if self.when_unsatisfiable not in ("DoNotSchedule",
+                                           "ScheduleAnyway"):
+            raise ValueError(
+                f"bad topology_spread when_unsatisfiable "
+                f"{self.when_unsatisfiable!r}: must be DoNotSchedule or "
+                f"ScheduleAnyway")
+        # empty selector stays valid: it self-selects the pod's own
+        # group (the pre-affinity spread semantics)
+        object.__setattr__(
+            self, "label_selector",
+            _selector_tuple(self.label_selector, "topology_spread",
+                            allow_empty=True))
 
 
 @dataclass(frozen=True)
@@ -240,6 +299,21 @@ class PodAffinityTerm:
     label_selector: tuple[tuple[str, str], ...] = ()
     topology_key: str = "kubernetes.io/hostname"
     anti: bool = False
+
+    def __post_init__(self):
+        object.__setattr__(
+            self, "label_selector",
+            _selector_tuple(self.label_selector, "affinity",
+                            allow_empty=False))
+        _topology_key(self.topology_key, "affinity")
+        if not isinstance(self.anti, bool):
+            raise ValueError(f"bad affinity anti {self.anti!r}: must be "
+                             f"a bool")
+
+    def matches(self, labels: tuple[tuple[str, str], ...]) -> bool:
+        """True when every selector pair appears in ``labels``."""
+        lab = dict(labels)
+        return all(lab.get(k) == v for k, v in self.label_selector)
 
 
 def pod_key(pod: "PodSpec") -> str:
@@ -300,6 +374,25 @@ class PodSpec:
                 and not isinstance(self.usage, UsageDistribution):
             raise ValueError(f"bad usage {self.usage!r}: must be a "
                              f"UsageDistribution")
+        for t in self.affinity:
+            if not isinstance(t, PodAffinityTerm):
+                raise ValueError(f"bad affinity term {t!r}: must be a "
+                                 f"PodAffinityTerm")
+            # required hostname affinity to the pod's OWN labels is a
+            # self-edge: it is satisfied by the pod itself on any node
+            # (kube counts the incoming pod), so it can never constrain
+            # anything — reject it as a manifest bug rather than carry
+            # a vacuous edge through the solver
+            if (not t.anti and t.topology_key == HOSTNAME_TOPOLOGY_KEY
+                    and self.labels and t.matches(self.labels)):
+                raise ValueError(
+                    f"bad affinity term {t!r}: required hostname "
+                    f"affinity matching the pod's own labels is a "
+                    f"vacuous self-edge")
+        for c in self.topology_spread:
+            if not isinstance(c, TopologySpreadConstraint):
+                raise ValueError(f"bad topology_spread {c!r}: must be a "
+                                 f"TopologySpreadConstraint")
 
     def scheduling_requirements(self) -> Requirements:
         reqs = Requirements.from_selector(dict(self.node_selector))

@@ -44,6 +44,18 @@ GangAdmissionController):
   was deadline-released to per-pod scheduling (whose members the
   ordinary pods-resolve invariant then covers), or is provably
   unplaceable (no offering fits a member / no torus hosts the slice).
+
+Affinity-plane invariants (armed by ``affinity_wave_rate`` profiles,
+karpenter_tpu/affinity):
+
+- ``affinity-satisfied`` (round): every placed (anti-)affinity edge and
+  bounded hostname spread re-verifies from ClusterState ground truth —
+  anti-affinity pairs never co-located, required edges have a matching
+  pod in scope, per-node matching counts stay under the bound;
+- ``components-never-split`` (round, with a sharded service): the shard
+  ownership map keeps every affinity-connected component on one shard
+  (re-derived from raw pod labels by ``sharded/validate.py``, never
+  from the router's own index).
 """
 
 from __future__ import annotations
@@ -72,7 +84,8 @@ class InvariantChecker:
                  trace: EventTrace | None = None, preemption=None,
                  gang=None, resident=None, repack=None,
                  explain_violations: list[str] | None = None,
-                 stochastic=None, sharded=None, faulttol=None):
+                 stochastic=None, sharded=None, faulttol=None,
+                 affinity: bool = False):
         self.cluster = cluster
         self.cloud = cloud              # ground truth: the UNWRAPPED fake
         self.unavailable = unavailable
@@ -118,6 +131,10 @@ class InvariantChecker:
         # no-window-lost (round) and health-converges (final) invariants
         # (karpenter_tpu/faulttol)
         self.faulttol = faulttol
+        # affinity arming flag: the profile injects affinity ensembles,
+        # so every bound pod's edges re-verify from ClusterState each
+        # round (karpenter_tpu/affinity)
+        self.affinity = affinity
 
     # -- round invariants ----------------------------------------------------
 
@@ -134,6 +151,8 @@ class InvariantChecker:
         out.extend(self._risk_model_consistent())
         out.extend(self._shards_converge())
         out.extend(self._no_window_lost())
+        out.extend(self._affinity_satisfied())
+        out.extend(self._components_never_split())
         if self.trace is not None:
             self.trace.add("invariants", phase="round", violations=len(out),
                            kinds=sorted({v.invariant for v in out}))
@@ -536,6 +555,111 @@ class InvariantChecker:
                 f"{getattr(probe.sharded, 'degraded_windows', 0)})"))
         return out
 
+    def _affinity_satisfied(self) -> list[Violation]:
+        """Every placed (anti-)affinity edge and bounded hostname spread
+        re-verified from ClusterState GROUND TRUTH — bound pods, their
+        raw labels and terms, the claims' node/zone map — never through
+        the solver's index or the plan's own claims.  Mirrors the
+        plane's arming rules (docs/design/affinity.md): self-anti
+        hostname terms, anti terms matching nobody, and ScheduleAnyway /
+        zone-scope spread stay legacy and are not re-checked here."""
+        if not self.affinity:
+            return []
+        from karpenter_tpu.apis.pod import HOSTNAME_TOPOLOGY_KEY, pod_key
+
+        # canonical node id: a pod may be homed by claim name or node
+        # name — fold claim names onto their node so one physical node
+        # never reads as two
+        canon: dict[str, str] = {}
+        zone_of: dict[str, str] = {}
+        for claim in self.cluster.nodeclaims():
+            node = claim.node_name or claim.name
+            canon[claim.name] = node
+            if claim.zone:
+                zone_of[node] = claim.zone
+                zone_of[claim.name] = claim.zone
+        by_node: dict[str, list] = {}
+        for p in self.cluster.list("pods"):
+            if p.bound_node:
+                node = canon.get(p.bound_node, p.bound_node)
+                by_node.setdefault(node, []).append(p.spec)
+        by_zone: dict[str, list] = {}
+        for node, specs in by_node.items():
+            z = zone_of.get(node)
+            if z:
+                by_zone.setdefault(z, []).extend(specs)
+
+        def matches(sel, spec) -> bool:
+            lab = spec.labels_dict
+            return all(lab.get(k) == v for k, v in sel)
+
+        out: list[Violation] = []
+        spreads: dict[tuple, int] = {}   # (selector|sig sentinel) -> skew
+        for node in sorted(by_node):
+            specs = by_node[node]
+            for spec in specs:
+                for t in spec.affinity:
+                    host = t.topology_key == HOSTNAME_TOPOLOGY_KEY
+                    if host and t.anti and matches(t.label_selector, spec):
+                        continue       # legacy self-anti: per-node cap 1
+                    scope = specs if host \
+                        else by_zone.get(zone_of.get(node, ""), specs)
+                    if t.anti:
+                        sig = spec.signature_key()
+                        hit = [q for q in scope
+                               if q is not spec
+                               and q.signature_key() != sig
+                               and matches(t.label_selector, q)]
+                        if hit:
+                            out.append(Violation(
+                                "affinity-satisfied",
+                                f"pod {pod_key(spec)} co-located with "
+                                f"anti-affinity match {pod_key(hit[0])} "
+                                f"in {t.topology_key} scope of {node}"))
+                    elif not any(matches(t.label_selector, q)
+                                 for q in scope):
+                        out.append(Violation(
+                            "affinity-satisfied",
+                            f"pod {pod_key(spec)} bound to {node} with "
+                            f"no {t.topology_key}-scope pod matching its "
+                            f"required selector {t.label_selector}"))
+                for c in spec.topology_spread:
+                    if c.topology_key != HOSTNAME_TOPOLOGY_KEY \
+                            or c.when_unsatisfiable != "DoNotSchedule":
+                        continue       # zone / soft spread: legacy scope
+                    key = c.label_selector or ("#sig",
+                                               spec.signature_key())
+                    prev = spreads.get(key)
+                    spreads[key] = c.max_skew if prev is None \
+                        else min(prev, c.max_skew)
+        for key, skew in sorted(spreads.items()):
+            for node in sorted(by_node):
+                if key and key[0] == "#sig":
+                    n = sum(1 for q in by_node[node]
+                            if q.signature_key() == key[1])
+                else:
+                    n = sum(1 for q in by_node[node] if matches(key, q))
+                if n > skew:
+                    out.append(Violation(
+                        "affinity-satisfied",
+                        f"node {node} holds {n} pods matching spread "
+                        f"selector {key} (max_skew {skew})"))
+        return out
+
+    def _components_never_split(self) -> list[Violation]:
+        """The shard ownership map keeps every affinity-connected
+        component on one shard — checked by the independent
+        ``sharded/validate.component_violations`` oracle (components
+        re-derived from raw pod labels, never from the router's own
+        index)."""
+        if not self.affinity or self.sharded is None:
+            return []
+        from karpenter_tpu.sharded.validate import component_violations
+
+        return [Violation("components-never-split", v)
+                for v in component_violations(self.sharded.service,
+                                              self.sharded.window_pods())]
+
     # -- final (eventual) invariants -----------------------------------------
 
     def _health_converges(self) -> list[Violation]:
@@ -684,17 +808,47 @@ class InvariantChecker:
 
     def _pods_resolve(self, catalog) -> list[Violation]:
         out = []
-        for pending in self.cluster.pending_pods():
-            if pending.bound_node:
-                continue
+        pending_all = [p for p in self.cluster.pending_pods()
+                       if not p.bound_node]
+        for pending in pending_all:
             if catalog is not None and not self._placeable(pending.spec, catalog):
                 continue   # explicitly unplaceable: fits no offering
+            if self._affinity_unplaceable(pending.spec, pending_all):
+                # required edge with no in-window target: the affinity
+                # plane's documented contract (docs/design/affinity.md)
+                # arms such pods honestly unplaceable — edges resolve
+                # WITHIN a solve window, never against already-bound
+                # capacity (that join is the kube-scheduler's, not the
+                # provisioner's)
+                continue
             out.append(Violation(
                 "pods-resolve",
                 f"pod {pending.spec.namespace}/{pending.spec.name} still "
                 f"unbound after quiesce (nominated="
                 f"{pending.nominated_node or '-'})"))
         return out
+
+    @staticmethod
+    def _affinity_unplaceable(spec, pending_all) -> bool:
+        """True when a REQUIRED affinity term arms with no pending
+        target: the selector matches neither the pod's own labels nor
+        any other pending unbound pod — the plane's honest-unplaceable
+        verdict (``affinity_unsatisfied``), by the same arming rules the
+        encoder applies."""
+        if not spec.affinity:
+            return False
+        own = spec.labels_dict
+        for t in spec.affinity:
+            if t.anti:
+                continue           # anti matching nothing is a no-op
+            if all(own.get(k) == v for k, v in t.label_selector):
+                continue           # self-satisfiable (or legacy zone pin)
+            if not any(
+                    all(q.spec.labels_dict.get(k) == v
+                        for k, v in t.label_selector)
+                    for q in pending_all if q.spec is not spec):
+                return True
+        return False
 
     @staticmethod
     def _placeable(pod, catalog) -> bool:

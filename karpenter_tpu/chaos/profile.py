@@ -104,6 +104,19 @@ class ChaosProfile:
     # load concentrates and only the rebalance collective can drain it.
     shard_count: int = 0
     shard_hot_rate: float = 0.0
+    # affinity plane (karpenter_tpu/affinity): probability a wave
+    # arrives as an affinity ensemble — a required hostname-edge pair
+    # of groups, a mutual anti-affinity pair, or a bounded hostname
+    # spread group, drawn from the seeded world stream with per-wave
+    # unique selector labels (edges never reach across waves).  Arms
+    # the affinity-satisfied invariant; with shard_count > 0 also the
+    # components-never-split invariant.
+    affinity_wave_rate: float = 0.0
+    # fixture knob: solve through an affinity-BLIND wrapper (terms
+    # stripped from the solver's view while the cluster keeps them) —
+    # proves affinity-satisfied fires when placement really ignores
+    # the edges
+    break_affinity: bool = False
     # device-fault plane (karpenter_tpu/faulttol): kind -> per-dispatch
     # probability for the deterministic FaultyDeviceInjector installed
     # at the device_guard seam (kinds: hang, error, oom, corrupt).
@@ -259,6 +272,28 @@ PROFILES: dict[str, ChaosProfile] = _profiles(
         pod_waves=6, pods_per_wave=(8, 24),
         error_rates={"create_instance": 0.05}),
     ChaosProfile(
+        name="affinity",
+        description="pod-to-pod (anti-)affinity edges and bounded "
+                    "hostname spread riding most waves, under spot "
+                    "storms and capacity blackouts, with the sharded "
+                    "plane co-routing affinity components — every "
+                    "placed edge must re-verify from ClusterState "
+                    "ground truth (affinity-satisfied) and the shard "
+                    "ownership map must never split a component "
+                    "(components-never-split)",
+        affinity_wave_rate=0.7,
+        shard_count=2,
+        pod_waves=6, pods_per_wave=(6, 16),
+        preempt_storm_rate=0.30, preempt_storm_frac=0.40,
+        capacity_blackout_rate=0.30, capacity_blackout_rounds=3,
+        error_rates={"create_instance": 0.08},
+        # the preemption plane's slack filler nominates pending pods
+        # onto EXISTING claims with no affinity gates (the documented
+        # carve-out the interaction tests pin) — against this profile's
+        # anti-affinity workload it would co-locate antagonists across
+        # windows, so the affinity class owns placement here
+        disable_controllers=("preemption",)),
+    ChaosProfile(
         name="fragmentation",
         description="scattered accelerator singletons + parked slice "
                     "gangs with the migration-first repack plane live — "
@@ -296,6 +331,16 @@ FIXTURE_PROFILES: dict[str, ChaosProfile] = _profiles(
         create_leak_rate=0.50,
         disable_controllers=("nodeclaim.garbagecollection",
                              "node.orphancleanup"),
+        fixture=True),
+    ChaosProfile(
+        name="broken-affinity-fixture",
+        description="affinity waves solved through an affinity-BLIND "
+                    "applier (terms stripped from the solver's view) — "
+                    "the affinity-satisfied invariant MUST fire",
+        affinity_wave_rate=1.0,
+        break_affinity=True,
+        pod_waves=4, pods_per_wave=(6, 12),
+        disable_controllers=("preemption",),
         fixture=True),
 )
 

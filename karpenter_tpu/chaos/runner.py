@@ -246,6 +246,14 @@ class ChaosHarness:
             self.cluster, self.catalog_provider, self.actuator,
             ProvisionerOptions(solver=opts))
         self.provisioner.solver = self.solver
+        # fixture-only broken applier: strip affinity from the solver's
+        # view (the cluster keeps the originals) — the
+        # affinity-satisfied invariant must catch the resulting
+        # co-located antagonists (falsifiability)
+        if profile.break_affinity:
+            from karpenter_tpu.chaos.solver import AffinityBlindSolver
+
+            self.provisioner.solver = AffinityBlindSolver(self.solver)
         # genuine overload: a live-instance quota far below demand makes
         # creates fail until quiesce lifts it — pending pods can only
         # move via the preemption plane meanwhile
@@ -286,6 +294,7 @@ class ChaosHarness:
             self.cluster, self.provisioner, clock=self.clock.time)
         self._gang_backlog: list[tuple[int, list]] = []   # (round, pods)
         self._gang_seq = 0
+        self._aff_seq = 0
         # resident-state store tracked through every pump beat: the
         # chaos matrix exercises the store's delta/invalidation machinery
         # (blackouts bump availability generations, churn drives deltas)
@@ -404,7 +413,8 @@ class ChaosHarness:
                 model=lambda: self.risk_model,
                 seed=seed)
             if profile.overcommit_eps else None,
-            faulttol=self.ft_probe)
+            faulttol=self.ft_probe,
+            affinity=bool(profile.affinity_wave_rate))
         # warm the catalog before chaos arms (pricing resolution happens
         # here, outside the deterministic traced window)
         self.catalog_provider.list(nc)
@@ -507,6 +517,10 @@ class ChaosHarness:
                 and self.rng_world.random() < self.profile.gang_wave_rate:
             self._inject_gang(round_no, prio)
             return
+        if self.profile.affinity_wave_rate \
+                and self.rng_world.random() < self.profile.affinity_wave_rate:
+            self._inject_affinity(round_no, prio)
+            return
         # hash-hot waves (shard-skew profile): craft the wave's request
         # signature so it HASHES onto shard 0 — load concentrates on one
         # shard and only the rebalance collective's ownership migrations
@@ -583,6 +597,67 @@ class ChaosHarness:
         self.trace.add("workload", wave=round_no, shape="gang", gang=name,
                        members=size, arrived=len(arrive_now),
                        slice=shape, mode=mode)
+
+    def _inject_affinity(self, round_no: int, prio: int) -> None:
+        """One affinity ensemble wave (karpenter_tpu/affinity), shape
+        drawn from the seeded world stream:
+
+        - ``required``: an anchor pair plus a follower pair carrying a
+          required hostname edge to the anchors — the whole quad
+          co-locates on ONE node, so a failed create leaves it pending
+          WHOLE (atomic: no half-bound ensemble can strand a required
+          edge across windows);
+        - ``anti``: a mutual hostname anti-affinity pair — must land on
+          two different nodes;
+        - ``spread``: one group self-selected under a bounded hostname
+          spread (max_skew 2) — at most 2 matching pods per node.
+
+        Selector labels are per-wave unique (``affN-...``), so edges
+        never reach across waves and every ensemble re-solves
+        self-contained if its create fails."""
+        from karpenter_tpu.apis.pod import (
+            HOSTNAME_TOPOLOGY_KEY, PodAffinityTerm, TopologySpreadConstraint,
+        )
+
+        self._aff_seq += 1
+        tag = f"aff{self._aff_seq}"
+        shape = ("required", "anti", "spread")[self.rng_world.randrange(3)]
+        req = ResourceRequests(250, 512, 0, 1)
+        if shape == "required":
+            pods = make_pods(2, name_prefix=f"{tag}-anchor", requests=req,
+                             priority=prio, labels=((tag, "anchor"),))
+            pods += make_pods(
+                2, name_prefix=f"{tag}-follower", requests=req,
+                priority=prio, labels=((tag, "follower"),),
+                affinity=(PodAffinityTerm(
+                    label_selector=((tag, "anchor"),),
+                    topology_key=HOSTNAME_TOPOLOGY_KEY),))
+        elif shape == "anti":
+            pods = make_pods(1, name_prefix=f"{tag}-left", requests=req,
+                             priority=prio, labels=((tag, "left"),),
+                             affinity=(PodAffinityTerm(
+                                 label_selector=((tag, "right"),),
+                                 topology_key=HOSTNAME_TOPOLOGY_KEY,
+                                 anti=True),))
+            pods += make_pods(1, name_prefix=f"{tag}-right", requests=req,
+                              priority=prio, labels=((tag, "right"),),
+                              affinity=(PodAffinityTerm(
+                                  label_selector=((tag, "left"),),
+                                  topology_key=HOSTNAME_TOPOLOGY_KEY,
+                                  anti=True),))
+        else:
+            pods = make_pods(
+                4, name_prefix=f"{tag}-spread", requests=req,
+                priority=prio, labels=((tag, "member"),),
+                topology_spread=(TopologySpreadConstraint(
+                    max_skew=2, topology_key=HOSTNAME_TOPOLOGY_KEY,
+                    label_selector=((tag, "member"),)),))
+        for pod in pods:
+            self.cluster.add_pod(pod)
+        obs.instant("pod.event", wave=round_no, affinity=tag,
+                    pods=len(pods), shape=shape)
+        self.trace.add("workload", wave=round_no, shape=f"affinity-{shape}",
+                       tag=tag, pods=len(pods), priority=prio)
 
     def _hot_requests(self, cpu: int, mem: int) -> tuple[int, int]:
         """Smallest cpu bump whose request signature hashes to shard 0

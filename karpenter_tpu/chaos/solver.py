@@ -41,6 +41,28 @@ class UnstableSolver:
         return self.inner.solve(request)
 
 
+class AffinityBlindSolver:
+    """Fixture-only broken applier (``break_affinity`` profiles): solves
+    with every pod's affinity terms and topology-spread constraints
+    STRIPPED, while the cluster keeps the originals — placement then
+    packs antagonists together and busts spread bounds, which is exactly
+    what the ``affinity-satisfied`` invariant must catch (falsifiability:
+    a checker that stays green against this wrapper proves nothing)."""
+
+    def __init__(self, inner):
+        self.inner = inner
+        self.options = getattr(inner, "options", None)
+
+    def solve(self, request: SolveRequest) -> Plan:
+        import dataclasses
+
+        blind = dataclasses.replace(
+            request,
+            pods=[dataclasses.replace(p, affinity=(), topology_spread=())
+                  for p in request.pods])
+        return self.inner.solve(blind)
+
+
 class ValidatingSolver:
     """Runs the independent feasibility oracle on every plan; violations
     accumulate in ``violations`` (drained by the invariant checker).

@@ -67,6 +67,11 @@ REASON_BITS = (
     ("overcommit_risk", 15),        # the chance-constraint variance buffer
                                     # (karpenter_tpu/stochastic) blocked
                                     # density the mean alone would allow
+    ("affinity_unsatisfied", 16),   # a required/anti (anti-)affinity edge
+                                    # (karpenter_tpu/affinity) left no
+                                    # placement for the group
+    ("spread_bound", 17),           # a hostname topology-spread bound
+                                    # clamped the group below its count
 )
 
 BIT = {name: idx for name, idx in REASON_BITS}
@@ -79,7 +84,8 @@ CANONICAL_REASONS = tuple(name for name, _ in REASON_BITS)
 DEVICE_BITS = frozenset((
     "insufficient_cpu", "insufficient_mem", "insufficient_accel",
     "insufficient_pods", "requirements", "capacity_higher_prio",
-    "capacity_exhausted", "overcommit_risk"))
+    "capacity_exhausted", "overcommit_risk", "affinity_unsatisfied",
+    "spread_bound"))
 
 # plane-level bits stamped by controllers (gang/preempt) rather than the
 # solve: a fresh window verdict (registry.note merge=False) REPLACES the
@@ -109,6 +115,12 @@ LADDER = (
     "insufficient_pods",
     "insufficient_mem",
     "insufficient_cpu",
+    # affinity verdicts rank below genuine resource insufficiency (an
+    # offering that can't hold the pod beats any edge story) but above
+    # the capacity catch-alls: "your required edge had no co-resident
+    # target" beats "everything was consumed"
+    "affinity_unsatisfied",
+    "spread_bound",
     # the variance buffer is more specific than the capacity catch-alls:
     # "your p99 usage blocked this" beats "everything was consumed"
     "overcommit_risk",

@@ -36,6 +36,8 @@ _INSUFFICIENT_MASK = (1 << BIT["insufficient_cpu"]) \
     | (1 << BIT["insufficient_mem"]) \
     | (1 << BIT["insufficient_accel"]) \
     | (1 << BIT["insufficient_pods"])
+_AFFINITY_MASK = (1 << BIT["affinity_unsatisfied"]) \
+    | (1 << BIT["spread_bound"])
 
 
 def _label_noavail(reqs, catalog) -> np.ndarray:
@@ -188,7 +190,7 @@ def attach(problem, plan, reason_words_arr=None,
         g = problem.groups[gi]
         m = int(miss[gi])
         near = None
-        if word & (_INSUFFICIENT_MASK | _STATIC_BIT) \
+        if word & (_INSUFFICIENT_MASK | _STATIC_BIT | _AFFINITY_MASK) \
                 or reason in ("zone_affinity", "zone_blackout",
                               "availability", "requirements"):
             near = nearest_miss(problem, gi, precomputed=near_pre())

@@ -136,6 +136,15 @@ def reason_words(problem, unplaced: np.ndarray,
             np.asarray(unplaced[:G], dtype=np.int64), compat,
             catalog.offering_alloc().astype(np.int32),
             z_bp_for(problem.overcommit_eps))
+    if getattr(problem, "aff", None) is not None:
+        # affinity windows: the affinity_unsatisfied / spread_bound bits
+        # via the same masked fold the device kernel runs
+        # (affinity/kernel._affinity_words — the parity contract)
+        from karpenter_tpu.affinity.greedy import affinity_words_np
+
+        words |= affinity_words_np(problem,
+                                   np.asarray(unplaced[:G],
+                                              dtype=np.int64))
     return words
 
 
@@ -164,7 +173,7 @@ def nearest_miss(problem, gi: int, precomputed: tuple | None = None
     deficits = {name: int(max(req[r] - alloc[r], 0))
                 for r, name in enumerate(RESOURCE_NAMES)
                 if req[r] > alloc[r]}
-    return {
+    out = {
         "offering_index": off,
         "instance_type": itype,
         "zone": zone,
@@ -172,3 +181,12 @@ def nearest_miss(problem, gi: int, precomputed: tuple | None = None
         "total_deficit": int(deficit[gi, off]),
         "deficits": deficits,
     }
+    aff = getattr(problem, "aff", None)
+    if aff is not None and gi < len(aff.aff_flag) \
+            and (int(aff.aff_flag[gi]) or int(aff.spread_flag[gi])):
+        # affinity-flagged group: a zero resource deficit does NOT mean
+        # the pod would fit — an edge or spread bound can mask the
+        # offering after every resource check passes.  Say so
+        # explicitly; the "would fit if +X" payload must never lie.
+        out["would_fit_absent_affinity"] = not deficits
+    return out

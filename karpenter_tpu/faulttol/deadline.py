@@ -2,12 +2,17 @@
 
 The PR-10 profiler (obs/prof.py) keeps a per-kernel EWMA split of the
 dispatch/execute/fetch wall.  A deadline is ``max(floor, k * ewma_total)``
-— the floor absorbs cold-compile and first-sample noise, the multiplier
-is a generous p99 proxy over the smoothed mean (the EWMA with alpha 0.3
+— the floor absorbs recompiles and scheduler noise, the multiplier is a
+generous p99 proxy over the smoothed mean (the EWMA with alpha 0.3
 tracks the recent regime, so a kernel that legitimately slows re-derives
 its own budget instead of flapping).  Kernels with no samples yet get
-the floor: the first dispatch of a fresh process must not be killed for
-compiling.
+the larger COLD floor: the first dispatch of a fresh process must not
+be killed for compiling, and a cold jit compile runs 5-30 s on CPU and
+comparable through the TPU tunnel — far past any steady-state wall.
+The warm floor still has to clear a *recompile* (a warm kernel hitting
+a new shape bucket pays compile again while its EWMA sits at
+steady-state milliseconds), which is why it is wall-clock seconds, not
+a multiple of the dispatch wall.
 
 Deadlines are advisory walls measured with ``time.monotonic`` READ AT
 CALL TIME — inside a chaos scenario the virtual clock patches it, so a
@@ -28,9 +33,13 @@ def _env_float(name: str, default: float) -> float:
         return default
 
 
-# floor: no dispatch is ever given less than this, so cold compiles and
-# scheduler noise cannot fault a healthy device
-DEFAULT_FLOOR_S = _env_float("KARPENTER_DISPATCH_DEADLINE_FLOOR_S", 2.0)
+# warm floor: no sampled dispatch is ever given less than this, so
+# recompiles (new shape buckets) and scheduler noise cannot fault a
+# healthy device
+DEFAULT_FLOOR_S = _env_float("KARPENTER_DISPATCH_DEADLINE_FLOOR_S", 10.0)
+# cold floor: kernels with no profiler sample yet are still compiling —
+# the budget must cover a full jit compile, not a steady-state dispatch
+DEFAULT_COLD_FLOOR_S = _env_float("KARPENTER_DISPATCH_COLD_FLOOR_S", 60.0)
 # multiplier over the EWMA total wall: a p99-style budget over the
 # smoothed mean — 20x leaves room for GC pauses and queueing without
 # letting a truly hung dispatch ride forever
@@ -42,17 +51,20 @@ class DeadlineModel:
     profiler singleton, no state of its own."""
 
     def __init__(self, floor_s: float | None = None,
-                 multiplier: float | None = None):
+                 multiplier: float | None = None,
+                 cold_floor_s: float | None = None):
         self.floor_s = DEFAULT_FLOOR_S if floor_s is None else floor_s
         self.multiplier = (DEFAULT_MULTIPLIER if multiplier is None
                            else multiplier)
+        self.cold_floor_s = (DEFAULT_COLD_FLOOR_S if cold_floor_s is None
+                             else cold_floor_s)
 
     def deadline_for(self, kernel: str) -> float:
         from karpenter_tpu.obs.prof import get_profiler
 
         total = get_profiler().kernel_ewma_total_s(kernel)
         if total is None or total <= 0.0:
-            return self.floor_s
+            return max(self.floor_s, self.cold_floor_s)
         return max(self.floor_s, self.multiplier * total)
 
     def snapshot(self, kernels) -> dict:

@@ -473,6 +473,15 @@ class MetricsServer:
             # health states, per-kernel dispatch deadlines, and the
             # guard's healthy-path overhead fraction (<1% gate)
             "device_health": get_health_board().snapshot(),
+            # affinity plane (docs/design/affinity.md): the last encoded
+            # window's armed edge/component census and the running tally
+            # of spread-bound clamps at the decode choke point
+            "affinity": {
+                "edges": int(metrics.AFFINITY_EDGES.get()),
+                "components": int(metrics.AFFINITY_COMPONENTS.get()),
+                "spread_violations_avoided":
+                    int(metrics.AFFINITY_SPREAD_AVOIDED.get()),
+            },
         }
         if self._statusz_extra is not None:
             out.update(self._statusz_extra())

@@ -71,7 +71,14 @@ class ShardRouter:
         # signature key -> shard override (rebalance migrations); absent
         # keys fall back to the stable hash
         self._owner: dict[str, int] = {}
+        # affinity components (karpenter_tpu/affinity): signature key ->
+        # the full member tuple of its component.  Members co-route to
+        # one shard (bind_components) and migrate WHOLE (a split
+        # component would hide inter-group edges from both shards'
+        # solves — the sharded correctness hole this map closes).
+        self._components: dict[str, tuple[str, ...]] = {}
         self.migrations = 0
+        self.components_bound = 0
 
     def shard_of(self, pod: PodSpec) -> int:
         return self.shard_of_key(signature_key(pod))
@@ -90,21 +97,72 @@ class ShardRouter:
             parts[self.shard_of(p)].append(p)
         return parts
 
+    def bind_components(self, pods) -> int:
+        """Co-route affinity components: every signature group linked by
+        an armed inter-group (anti-)affinity edge or a shared bounded
+        spread class lands on ONE shard — the home shard of the
+        lexicographically-smallest member key (the anchor) — through the
+        same override map rebalance migrations use.  Called before every
+        routed partition; edge-free windows are a strict no-op (no map
+        writes, no counter bumps).  Returns the number of multi-group
+        components bound."""
+        from karpenter_tpu.affinity.encode import build_affinity_index
+
+        by_sig: dict[str, PodSpec] = {}
+        for p in pods:
+            by_sig.setdefault(signature_key(p), p)
+        keys = list(by_sig)
+        idx = build_affinity_index([by_sig[k] for k in keys])
+        if idx is None:
+            return 0
+        comps: dict[int, list[str]] = {}
+        for i, k in enumerate(keys):
+            comps.setdefault(int(idx.comp[i]), []).append(k)
+        bound = 0
+        with self._lock:
+            for root in sorted(comps):
+                members = sorted(comps[root])
+                if len(members) < 2:
+                    continue
+                anchor = members[0]
+                dst = self.shard_of_key_locked(anchor)
+                mt = tuple(members)
+                for k in members:
+                    self._components[k] = mt
+                    self._set_owner_locked(k, dst)
+                bound += 1
+            self.components_bound += bound
+        return bound
+
+    def component_of(self, key: str) -> tuple[str, ...]:
+        """The bound component containing ``key`` (a singleton tuple for
+        unbound keys) — the unit every migration moves."""
+        with self._lock:
+            return self._components.get(key, (key,))
+
+    def _set_owner_locked(self, key: str, dst: int) -> None:
+        if self.shard_of_key_locked(key) == dst:
+            return
+        if stable_shard(key, self.num_shards) == dst:
+            # routing back home: drop the override instead of pinning
+            # it (the map stays minimal)
+            self._owner.pop(key, None)
+        else:
+            self._owner[key] = dst
+
     def migrate(self, key: str, dst: int) -> bool:
-        """Move ownership of one signature group to ``dst``.  Returns
-        False for a no-op (already owned there)."""
+        """Move ownership of one signature group — and, when the group
+        belongs to a bound affinity component, of the WHOLE component —
+        to ``dst``.  Returns False for a no-op (already owned there)."""
         if not 0 <= dst < self.num_shards:
             raise ValueError(f"shard {dst} out of range "
                              f"[0, {self.num_shards})")
         with self._lock:
-            if self.shard_of_key_locked(key) == dst:
+            members = self._components.get(key, (key,))
+            if all(self.shard_of_key_locked(k) == dst for k in members):
                 return False
-            if stable_shard(key, self.num_shards) == dst:
-                # migrating back home: drop the override instead of
-                # pinning it (the map stays minimal)
-                self._owner.pop(key, None)
-            else:
-                self._owner[key] = dst
+            for k in members:
+                self._set_owner_locked(k, dst)
             self.migrations += 1
             return True
 
@@ -120,4 +178,6 @@ class ShardRouter:
         with self._lock:
             return {"shards": self.num_shards,
                     "overrides": len(self._owner),
-                    "migrations": self.migrations}
+                    "migrations": self.migrations,
+                    "components": len(set(self._components.values())),
+                    "components_bound": self.components_bound}

@@ -275,16 +275,18 @@ class ShardedSolveService:
         self._refresh_mesh()
         if pods is None:
             pods = self.backlog_pods()
+        self.router.bind_components(pods)
         parts = self.router.partition(pods)
         window = encode_shards(parts, catalog, nodepool)
         if any(p.pref_rows is not None or p.group_var is not None
-               for p in window.problems):
-            # soft-preference and stochastic (chance-constrained)
-            # windows carry semantics the stacked scan kernel does not
-            # implement — dropping them silently would void the
-            # overcommit bound / preference ranking.  Route to the host
-            # oracle, which honors both (the same gate JaxSolver applies
-            # per-path: pallas/flat/resident all defer these windows).
+               or p.aff is not None for p in window.problems):
+            # soft-preference, stochastic (chance-constrained), and
+            # affinity windows carry semantics the stacked scan kernel
+            # does not implement — dropping them silently would void the
+            # overcommit bound / preference ranking / (anti-)affinity
+            # edges.  Route to the host oracle, which honors all three
+            # (the same gate JaxSolver applies per-path: pallas/flat/
+            # resident all defer these windows).
             return self.solve_window_host(catalog, nodepool, pods,
                                           window=window)
         S = window.num_shards
@@ -462,10 +464,17 @@ class ShardedSolveService:
         if window is None:
             if pods is None:
                 pods = self.backlog_pods()
+            self.router.bind_components(pods)
             parts = self.router.partition(pods)
             window = encode_shards(parts, catalog, nodepool)
         solver = GreedySolver(SolverOptions(backend="greedy"))
         plans = [solver.solve_encoded(p) for p in window.problems]
+        # the device-resident stacked state no longer reflects the last
+        # solved window — drop it so the next device window rebuilds
+        # (and the shards-converge freshness oracle never compares a
+        # stale mirror against this window's ground truth)
+        if self._mirror is not None:
+            self.invalidate("host-routed window")
         with self._lock:
             self._last_window = window
             self._last_unplaced = [len(p.unplaced_pods) for p in plans]

@@ -14,6 +14,12 @@ nothing).
 - :func:`rebalance_violations` — the applied decision re-derives from
   the pressure matrix via the numpy oracle (donor/receiver/amount
   exact), and the moved groups are now owned by the receiver.
+- :func:`component_violations` — affinity components never split: the
+  signature groups linked by an armed (anti-)affinity edge or a shared
+  bounded hostname spread class, RE-DERIVED here from raw pod labels
+  and terms (not from ``AffinityIndex`` — the structure under test),
+  all route to one shard (the ``components-never-split`` chaos
+  invariant).
 """
 
 from __future__ import annotations
@@ -55,6 +61,86 @@ def partition_violations(service, pods) -> list[str]:
         if stable_shard(key, router.num_shards) == dst:
             out.append(f"override for {key[:40]}... is a no-op (home "
                        f"shard) — the map must stay minimal")
+    return out
+
+
+def component_violations(service, pods) -> list[str]:
+    """Affinity components never split across shards.
+
+    The components are re-derived HERE from raw pod labels, affinity
+    terms, and spread constraints — selector matching inlined, union
+    by hand — never by asking ``karpenter_tpu.affinity.encode`` for its
+    index (the router binds through that index; an oracle that shares
+    it would confirm its own bugs).  Mirrors the arming rules the plane
+    documents: self-only zone terms, anti terms matching nobody, self
+    hostname-anti, zone-scope spread, ScheduleAnyway spread, and
+    empty-selector spread all stay legacy and never link groups."""
+    from karpenter_tpu.apis.pod import HOSTNAME_TOPOLOGY_KEY
+
+    by_sig: dict[str, object] = {}
+    for p in pods:
+        by_sig.setdefault(signature_key(p), p)
+    keys = list(by_sig)
+    if not keys:
+        return []
+    labels = [by_sig[k].labels_dict for k in keys]
+
+    def matched(selector) -> list[int]:
+        return [i for i, lab in enumerate(labels)
+                if all(lab.get(k) == v for k, v in selector)]
+
+    parent = list(range(len(keys)))
+
+    def find(a: int) -> int:
+        while parent[a] != a:
+            parent[a] = parent[parent[a]]
+            a = parent[a]
+        return a
+
+    def union(a: int, b: int) -> None:
+        ra, rb = find(a), find(b)
+        if ra != rb:
+            parent[max(ra, rb)] = min(ra, rb)
+
+    linked = False
+    for i, k in enumerate(keys):
+        rep = by_sig[k]
+        own = labels[i]
+        for t in rep.affinity:
+            if t.topology_key == HOSTNAME_TOPOLOGY_KEY and t.anti \
+                    and all(own.get(a) == v for a, v in t.label_selector):
+                continue                       # legacy: self anti -> cap 1
+            mem = matched(t.label_selector)
+            others = [h for h in mem if h != i]
+            if not others:
+                continue    # self-only zone pin / no-op anti / lone req
+            for h in others:
+                union(i, h)
+                linked = True
+        for c in rep.topology_spread:
+            if c.topology_key != HOSTNAME_TOPOLOGY_KEY \
+                    or c.when_unsatisfiable != "DoNotSchedule" \
+                    or not c.label_selector:
+                continue       # zone spread / soft / empty-selector: legacy
+            mem = matched(c.label_selector)
+            for h in mem:
+                union(i, h)
+                if h != mem[0]:
+                    union(mem[0], h)
+                linked = linked or h != i
+    if not linked:
+        return []
+    out: list[str] = []
+    comp_shard: dict[int, tuple[int, str]] = {}
+    router = service.router
+    for i, k in enumerate(keys):
+        root = find(i)
+        s = router.shard_of_key(k)
+        prev = comp_shard.setdefault(root, (s, k))
+        if prev[0] != s:
+            out.append(f"affinity component split: {prev[1][:40]}... on "
+                       f"shard {prev[0]}, {k[:40]}... on shard {s} — "
+                       f"inter-group edges are invisible to both solves")
     return out
 
 

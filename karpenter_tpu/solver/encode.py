@@ -39,9 +39,12 @@ from collections.abc import Sequence
 import numpy as np
 
 from karpenter_tpu.apis.nodeclaim import NodePool
+from karpenter_tpu.affinity.encode import (
+    build_affinity_index, hostname_cap, zone_pin_prepass,
+)
 from karpenter_tpu.apis.pod import (
-    NUM_RESOURCES, PodSpec, fingerprint_token as _fp_token, pod_key,
-    tolerates_all,
+    NUM_RESOURCES, PodSpec, ZONE_TOPOLOGY_KEY,
+    fingerprint_token as _fp_token, pod_key, tolerates_all,
 )
 from karpenter_tpu.apis.requirements import (
     CAPACITY_TYPE_ON_DEMAND, CAPACITY_TYPE_SPOT,
@@ -87,7 +90,7 @@ class EncodedProblem:
                  "group_prio", "group_gang", "group_min", "gang_names",
                  "catalog", "rejected", "rejected_reasons", "label_rows",
                  "label_idx", "pref_rows", "pref_idx", "group_mean",
-                 "group_var", "overcommit_eps", "_compat",
+                 "group_var", "overcommit_eps", "aff", "_compat",
                  "_names_idx", "_prep_cache")
 
     def __init__(self, groups: list[PodGroup], group_req: np.ndarray,
@@ -106,7 +109,8 @@ class EncodedProblem:
                  rejected_reasons: dict[str, str] | None = None,
                  group_mean: np.ndarray | None = None,
                  group_var: np.ndarray | None = None,
-                 overcommit_eps: float = 0.0):
+                 overcommit_eps: float = 0.0,
+                 aff=None):
         self.groups = groups
         self.group_req = group_req
         self.group_count = group_count
@@ -151,6 +155,12 @@ class EncodedProblem:
         self.group_mean = group_mean
         self.group_var = group_var
         self.overcommit_eps = overcommit_eps
+        # affinity plane (karpenter_tpu/affinity): the per-window
+        # AffinityIndex (selector classes, group bitmasks, spread
+        # bounds, components) — attached ONLY when at least one
+        # inter-group edge or bounded spread class arms.  None is the
+        # strict-superset gate every edge-free path checks.
+        self.aff = aff
         self._compat = compat
         self._names_idx = None      # (names_arr object [P], gstart int64 [G+1])
         self._prep_cache = None     # jax_backend packed-template cache
@@ -192,7 +202,7 @@ class EncodedProblem:
                       gang_names=self.gang_names,
                       rejected_reasons=self.rejected_reasons,
                       group_mean=self.group_mean, group_var=self.group_var,
-                      overcommit_eps=self.overcommit_eps)
+                      overcommit_eps=self.overcommit_eps, aff=self.aff)
         fields.update(kw)
         return EncodedProblem(**fields)
 
@@ -630,6 +640,43 @@ def _encode_impl(pods: Sequence[PodSpec], catalog: CatalogArrays,
             row_keys[key] = ui
         return ui
 
+    # affinity plane, zone scope: inter-group required/anti zone edges
+    # need their co-pin decided BEFORE per-signature lowering (the pin
+    # feeds the zone-affinity branch below).  Windows with no zone-scope
+    # affinity term skip this entirely, so the legacy encode stays
+    # byte-identical.  Gang and hard-spread signatures are never pinned
+    # here (all-or-nothing / split semantics win; the decode choke keeps
+    # any surviving zone edge honest).
+    aff_zone_pins: dict[int, str] = {}
+    if any(t.topology_key == ZONE_TOPOLOGY_KEY
+           for mem in by_sig.values() for t in mem[0].affinity):
+        zone_sels = [t.label_selector for mem in by_sig.values()
+                     for t in mem[0].affinity
+                     if t.topology_key == ZONE_TOPOLOGY_KEY]
+        pin_entries = []
+        for s, mem in by_sig.items():
+            rep0 = mem[0]
+            lab0 = rep0.labels_dict
+            involved = any(t.topology_key == ZONE_TOPOLOGY_KEY
+                           for t in rep0.affinity) \
+                or any(sel and all(lab0.get(k) == v for k, v in sel)
+                       for sel in zone_sels)
+            if not involved or rep0.gang is not None \
+                    or _zone_spread_constraints(rep0):
+                continue
+            reqs0 = rep0.scheduling_requirements().merged(
+                nodepool.requirements)
+            if any(r.key not in known_keys and not r.matches(pool_labels)
+                   for r in reqs0):
+                continue          # unschedulable here; rejected below
+            req_vec0 = rep0.requests.as_tuple()
+            label0 = _label_compat(reqs0, catalog, mask_cache)
+            nozone0 = label0 & _fit_mask(req_vec0, catalog)
+            vz0 = viable_zones(reqs0, req_vec0, catalog, nozone=nozone0,
+                               cache=mask_cache)
+            pin_entries.append((s, lab0, list(rep0.affinity), list(vz0)))
+        aff_zone_pins = zone_pin_prepass(pin_entries)
+
     for sig, members in by_sig.items():
         rep = members[0]
         hit = _SIG_LOWER_CACHE.get((sig,) + gen_key) if cache_ok else None
@@ -650,6 +697,11 @@ def _encode_impl(pods: Sequence[PodSpec], catalog: CatalogArrays,
             unsat = [r for r in reqs
                      if r.key not in known_keys and not r.matches(pool_labels)]
             cap = 1 if _has_hostname_anti_affinity(rep) else BIG_CAP
+            # empty-selector hostname spread (DoNotSchedule) self-selects
+            # the group: lower straight onto cap_per_node (no plane)
+            hcap = hostname_cap(rep)
+            if hcap is not None:
+                cap = min(cap, hcap)
             req_vec = rep.requests.as_tuple()
             if unsat:
                 if cache_ok:
@@ -729,6 +781,9 @@ def _encode_impl(pods: Sequence[PodSpec], catalog: CatalogArrays,
                     g_var.append(var_row)
 
         spread = _zone_spread_constraints(rep)
+        aff_pin = aff_zone_pins.get(sig)
+        if aff_pin is not None and aff_pin not in live_zones:
+            aff_pin = None        # stale pin: catalog moved under us
         if rep.gang is not None:
             # gang members place all-or-nothing: never spread-split or
             # zone-candidate-split a gang — co-placement is the contract
@@ -751,14 +806,20 @@ def _encode_impl(pods: Sequence[PodSpec], catalog: CatalogArrays,
                 g_var.append(var_row)
         elif spread and len(live_zones) > 1:
             split_subgroups(live_zones, pinned=True)
-        elif _has_zone_affinity(rep) and len(live_zones) > 1:
-            # co-schedule in one zone: an explicit candidate override wins
-            # (zonesplit refinement); default pin is the zone with the
-            # most compatible offering capacity (v1 heuristic; validator
-            # checks zone purity either way)
+        elif (aff_pin is not None or _has_zone_affinity(rep)) \
+                and len(live_zones) > 1:
+            # co-schedule in one zone: an affinity-plane component pin
+            # wins (inter-group zone edges co-route through one zone),
+            # then an explicit candidate override (zonesplit refinement);
+            # default pin is the zone with the most compatible offering
+            # capacity (v1 heuristic; validator checks zone purity
+            # either way)
             override = zone_overrides.get(sig)
-            best = override if override in live_zones else \
-                _best_zone_for(rep, reqs, live_zones, catalog)
+            if aff_pin is not None:
+                best = aff_pin
+            else:
+                best = override if override in live_zones else \
+                    _best_zone_for(rep, reqs, live_zones, catalog)
             groups.append(PodGroup(
                 representative=rep, pod_names=[pod_key(p) for p in members],
                 count=len(members), requirements=reqs, cap_per_node=cap,
@@ -820,13 +881,28 @@ def _encode_impl(pods: Sequence[PodSpec], catalog: CatalogArrays,
         from karpenter_tpu.stochastic.encode import stack_usage
 
         group_mean, group_var = stack_usage(g_mean, g_var)
+    # affinity plane: lower the window's (anti-)affinity terms and
+    # bounded hostname spread classes to the dense index.  None for
+    # edge-free windows — every path below then matches the legacy
+    # encode byte for byte.
+    aff_index = build_affinity_index(
+        [g.representative for g in groups]) if G else None
     if G:
         shares = np.where(mean_alloc[None, :] > 0,
                           group_req.astype(np.float64)
                           / np.maximum(mean_alloc, 1e-12)[None, :],
                           0.0).max(axis=1)
-        order = np.lexsort((np.asarray(g_name), -shares,
-                            -group_prio.astype(np.int64)))
+        if aff_index is not None:
+            # required-edge TARGETS place first (ascending req_depth as
+            # the primary key): required groups never open nodes in the
+            # kernel, so their targets must already be resident by the
+            # time the scan reaches them
+            order = np.lexsort((np.asarray(g_name), -shares,
+                                -group_prio.astype(np.int64),
+                                aff_index.req_depth))
+        else:
+            order = np.lexsort((np.asarray(g_name), -shares,
+                                -group_prio.astype(np.int64)))
         groups = [groups[i] for i in order]
         group_req = np.ascontiguousarray(group_req[order])
         group_count = group_count[order]
@@ -839,6 +915,12 @@ def _encode_impl(pods: Sequence[PodSpec], catalog: CatalogArrays,
         if stochastic:
             group_mean = np.ascontiguousarray(group_mean[order])
             group_var = np.ascontiguousarray(group_var[order])
+        if aff_index is not None:
+            aff_index = aff_index.permute(order)
+            from karpenter_tpu.utils import metrics as _metrics
+            _metrics.AFFINITY_EDGES.set(aff_index.edge_count)
+            _vals, _sizes = np.unique(aff_index.comp, return_counts=True)
+            _metrics.AFFINITY_COMPONENTS.set(int((_sizes > 1).sum()))
 
     label_rows = (np.stack(rows) if rows
                   else np.zeros((0, O), dtype=bool))
@@ -855,7 +937,8 @@ def _encode_impl(pods: Sequence[PodSpec], catalog: CatalogArrays,
         group_gang=group_gang, group_min=group_min,
         gang_names=list(gang_ids), rejected_reasons=rej_reasons,
         group_mean=group_mean, group_var=group_var,
-        overcommit_eps=overcommit_eps if stochastic else 0.0)
+        overcommit_eps=overcommit_eps if stochastic else 0.0,
+        aff=aff_index)
 
 
 def estimate_nodes(problem: EncodedProblem, n_cap: int,
@@ -1005,6 +1088,22 @@ def decode_plan_entries(problem: EncodedProblem, node_off: np.ndarray,
             m = min(G, len(unplaced))
             up[:m] = np.asarray(unplaced[:m], dtype=np.int64)
             np.add.at(up, cnts_dropped[0], cnts_dropped[1])
+            unplaced = up
+    if getattr(problem, "aff", None) is not None and gis.size:
+        # affinity choke point (same contract as the gang choke above):
+        # edge-violating entries are dropped, hostname spread bounds are
+        # clamped, and an edge-violating plan is structurally impossible
+        # downstream of this line regardless of which kernel produced
+        # it — docs/design/affinity.md.
+        from karpenter_tpu.affinity.enforce import enforce_affinity
+
+        node_off, gis, ns, cnts, aff_dropped, cost = enforce_affinity(
+            problem, node_off, gis, ns, cnts, cost)
+        if aff_dropped is not None:
+            up = np.zeros(G, dtype=np.int64)
+            m = min(G, len(unplaced))
+            up[:m] = np.asarray(unplaced[:m], dtype=np.int64)
+            np.add.at(up, aff_dropped[0], aff_dropped[1])
             unplaced = up
     open_idx = np.nonzero(node_off >= 0)[0]
     per_node: dict[int, list[str]] = {}

@@ -384,6 +384,11 @@ def flat_viable(problem: EncodedProblem, options) -> bool:
     mode = getattr(options, "flat_solver", "auto")
     if mode == "off":
         return False
+    if getattr(problem, "aff", None) is not None:
+        # affinity-gated windows own their route (the flat kernel
+        # carries no edge/spread gates); the scan-side affinity kernel
+        # plus the decode choke keep them honest
+        return False
     if not getattr(options, "right_size", True):
         # the flat kernel's bin re-pricing IS a right-size pass; with the
         # option off the scan path must own the solve so configuration

@@ -147,11 +147,13 @@ class GreedySolver:
         if self.options.use_native != "off" \
                 and problem.pref_rows is None \
                 and problem.group_var is None \
+                and problem.aff is None \
                 and not problem.has_gangs:
-            # the C++ twin has no preference-penalty ranking and no
-            # gang transaction; those windows route to the python
-            # oracle (a native partial gang would only be stripped by
-            # the decode choke point, wasting the opened nodes)
+            # the C++ twin has no preference-penalty ranking, no gang
+            # transaction, and no affinity gates; those windows route
+            # to the python oracle (a native partial gang or
+            # edge-violating placement would only be stripped by the
+            # decode choke point, wasting the opened nodes)
             plan = self._solve_native(problem)
             if plan is not None:
                 return plan
@@ -235,6 +237,36 @@ class GreedySolver:
                                          int(problem.group_min[i]))
         failed_gangs: set[int] = set()
 
+        # affinity gates (karpenter_tpu/affinity), mirroring the device
+        # scan's per-node reductions: class-presence for required edges,
+        # symmetric anti exclusion, bounded spread allowance.  Groups
+        # arrive req_depth-sorted (encode's armed lexsort), so required
+        # targets pack before their dependents.  The unarmed path below
+        # is untouched — byte-identity for edge-free windows.
+        aff = problem.aff
+        if aff is not None:
+            from karpenter_tpu.affinity import AFF_BIG
+
+            aff_member = aff.member.T.copy()        # [G, C_all] bool
+            aff_req = aff.req_host                  # [G, C_all] bool
+            aff_anti = aff.anti_host                # [G, C_all] bool
+            aff_bound = aff.host_bound.astype(np.int64)   # [C_all]
+            aff_bounded = aff_bound < AFF_BIG
+            node_cls: list[np.ndarray] = []   # per node member count [C_all]
+            node_anti: list[np.ndarray] = []  # per node accumulated anti
+
+            def _aff_allow(gi: int, cnt: np.ndarray) -> int:
+                """Max additional members of group gi a node with class
+                counts ``cnt`` may take under the spread bounds."""
+                mine = aff_member[gi] & aff_bounded
+                if not mine.any():
+                    return 1 << 40
+                return int((aff_bound[mine] - cnt[mine]).min())
+
+            def _aff_place(gi: int, ni: int, take: int) -> None:
+                node_cls[ni] = node_cls[ni] + aff_member[gi] * take
+                node_anti[ni] = node_anti[ni] | aff_anti[gi]
+
         for gi, group in enumerate(problem.groups):
             req = problem.group_req[gi].astype(np.int64)
             if stochastic:
@@ -255,7 +287,9 @@ class GreedySolver:
                 # place) and only ever extends node_pods, so rollback =
                 # restore lists + truncate pod tails
                 saved = (list(node_offering), list(node_resid),
-                         [len(p) for p in node_pods], list(node_vars))
+                         [len(p) for p in node_pods], list(node_vars),
+                         (list(node_cls), list(node_anti))
+                         if aff is not None else None)
             # soft preferences: penalty-ranked pricing for the new-node
             # choice (same rank_g = rank * (1 + lambda * miss) blend the
             # device scan applies); real cost accounting untouched
@@ -273,6 +307,19 @@ class GreedySolver:
                     break
                 if not compat[node_offering[ni]]:
                     continue
+                if aff is not None:
+                    present = node_cls[ni] > 0
+                    # symmetric anti: the node holds a class this group
+                    # anti-selects, or a resident group anti-selects one
+                    # of this group's classes
+                    if (aff_anti[gi] & present).any() \
+                            or (node_anti[ni] & aff_member[gi]).any():
+                        continue
+                    # required classes must already be present (the
+                    # device scan's ok_req gate — own placement counts
+                    # only on the node it opens)
+                    if (aff_req[gi] & ~present).any():
+                        continue
                 resid = node_resid[ni]
                 if req.max() > 0:
                     fit = int(np.min(np.where(req > 0, resid // np.maximum(req, 1),
@@ -283,6 +330,11 @@ class GreedySolver:
                     fit = _chance_cap(fit, resid, node_vars[ni], req,
                                       gvar, zsq)
                 take = min(fit, cap, len(remaining))
+                if aff is not None:
+                    take = min(take, _aff_allow(gi, node_cls[ni]))
+                    # self-matching armed anti: one member per node
+                    if (aff_anti[gi] & aff_member[gi]).any():
+                        take = min(take, 1)
                 if take <= 0:
                     continue
                 node_resid[ni] = resid - req * take
@@ -290,8 +342,32 @@ class GreedySolver:
                     node_vars[ni] = node_vars[ni] + gvar * take
                 node_pods[ni].extend(remaining[:take])
                 del remaining[:take]
+                if aff is not None:
+                    _aff_place(gi, ni, take)
 
-            if remaining:
+            aff_can_open = True
+            aff_node_cap = 1 << 40
+            aff_extra = 0
+            if aff is not None:
+                # groups with a required edge INTO one of this group's
+                # classes must co-locate here later — size the node for
+                # that dependent closure, not just this batch (the fill
+                # pass still enforces real capacity; a dependent that
+                # does not fit stays honestly unplaced)
+                dep = (aff_req & aff_member[gi][None, :]).any(axis=1)
+                dep[gi] = False
+                if dep.any():
+                    aff_extra = int(np.asarray(problem.group_count)[dep].sum())
+                # a group whose required classes its own members do not
+                # cover can never open a node (the scan's can_open gate:
+                # targets-first ordering makes its edges satisfiable
+                # only by filling)
+                aff_can_open = not (aff_req[gi] & ~aff_member[gi]).any()
+                aff_node_cap = _aff_allow(
+                    gi, np.zeros(aff_member.shape[1], dtype=np.int64))
+                if (aff_anti[gi] & aff_member[gi]).any():
+                    aff_node_cap = min(aff_node_cap, 1)
+            if remaining and aff_can_open and aff_node_cap > 0:
                 # open new nodes with the cheapest-per-pod offering; fit
                 # is capped by the pods actually remaining so
                 # cost-per-pod is judged on the pods a node will really
@@ -306,7 +382,9 @@ class GreedySolver:
                 if stochastic:
                     fit_empty = _chance_cap_empty(fit_empty, off_alloc,
                                                   req, gvar, zsq)
-                fit_empty = np.minimum(fit_empty, min(cap, len(remaining)))
+                fit_empty = np.minimum(
+                    fit_empty,
+                    min(cap, len(remaining) + aff_extra, aff_node_cap))
                 with np.errstate(divide="ignore", invalid="ignore"):
                     cost_per_pod = np.where(fit_empty > 0,
                                             rank_g / fit_empty, np.inf)
@@ -321,12 +399,19 @@ class GreedySolver:
                                          else _NO_VAR)
                         node_pods.append(remaining[:take])
                         del remaining[:take]
+                        if aff is not None:
+                            node_cls.append(
+                                aff_member[gi].astype(np.int64) * take)
+                            node_anti.append(aff_anti[gi].copy())
             if gid >= 0 and remaining:
                 # gang group could not fully place: roll the whole group
                 # back — a partial gang must never survive the oracle
                 node_offering[:] = saved[0]
                 node_resid[:] = saved[1]
                 node_vars[:] = saved[3]
+                if aff is not None:
+                    node_cls[:] = saved[4][0]
+                    node_anti[:] = saved[4][1]
                 del node_pods[len(saved[0]):]
                 for i, n0 in enumerate(saved[2]):
                     del node_pods[i][n0:]
@@ -369,6 +454,40 @@ class GreedySolver:
                 node_offering = [node_offering[i] for i in keep_idx]
                 node_resid = [node_resid[i] for i in keep_idx]
                 node_pods = [node_pods[i] for i in keep_idx]
+
+        if problem.aff is not None:
+            # affinity windows decode through decode_plan_entries so
+            # the affinity choke point (affinity/enforce.py) applies to
+            # the greedy backend too; pod names re-derive correctly
+            # because the loop above consumes each group's pod_names in
+            # node-ascending order (the cursor contract).  The unarmed
+            # path below stays byte-identical.
+            from karpenter_tpu.solver.encode import decode_plan_entries
+
+            owner: dict[str, int] = {}
+            for gi2, g2 in enumerate(problem.groups):
+                for pn in g2.pod_names:
+                    owner[pn] = gi2
+            ent: dict[tuple[int, int], int] = {}
+            for ni, pods in enumerate(node_pods):
+                for pn in pods:
+                    key = (owner[pn], ni)
+                    ent[key] = ent.get(key, 0) + 1
+            keys = sorted(ent)
+            gis = np.array([k[0] for k in keys], dtype=np.int64)
+            ns = np.array([k[1] for k in keys], dtype=np.int64)
+            cnts = np.array([ent[k] for k in keys], dtype=np.int64)
+            un = np.zeros(problem.num_groups, dtype=np.int64)
+            for pn in unplaced:
+                gi2 = owner.get(pn)
+                if gi2 is not None:
+                    un[gi2] += 1
+            node_off_arr = np.asarray(node_offering, dtype=np.int64)
+            total = 0.0
+            for off in node_offering:
+                total += float(off_price[off])
+            return decode_plan_entries(problem, node_off_arr, gis, ns,
+                                       cnts, un, total, "greedy")
 
         nodes = []
         total = 0.0

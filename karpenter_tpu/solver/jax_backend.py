@@ -1063,12 +1063,12 @@ class _Prepared:
     __slots__ = ("catalog", "G_pad", "O_pad", "U_pad", "N", "N_cap", "K0",
                  "K_cap", "K", "dense16_ok", "dense16", "coo16", "packed",
                  "right_size", "pref_rows", "pref_idx", "pref_lambda",
-                 "sto", "z_bp", "sto_grid", "tmpl")
+                 "sto", "z_bp", "sto_grid", "aff", "tmpl")
 
     def __init__(self, *, catalog, G_pad, O_pad, U_pad, N, N_cap, K0, packed,
                  K_cap=None, dense16_ok=False, right_size=None,
                  pref_rows=None, pref_idx=None, pref_lambda=None,
-                 sto=None, z_bp=0):
+                 sto=None, z_bp=0, aff=None):
         self.catalog = catalog
         self.G_pad = G_pad
         self.O_pad = O_pad
@@ -1101,6 +1101,11 @@ class _Prepared:
         # stochastic dispatch and cached on the template — warm solves
         # pass them as inputs instead of recomputing the [G, O, R] grid
         self.sto_grid = None
+        # affinity plane (karpenter_tpu/affinity): the packed selector /
+        # spread suffix leaf.  aff None = unconstrained dispatch (the
+        # strict-superset gate); the degraded fallback disarms it in
+        # place (affinity/degraded.strip_affinity).
+        self.aff = aff
         self.tmpl = None
 
     def clone(self) -> "_Prepared":
@@ -1288,7 +1293,7 @@ class JaxSolver:
         for p in problems:
             prep = None
             batchable = (p.num_groups > 0 and p.pref_rows is None
-                         and p.group_var is None
+                         and p.group_var is None and p.aff is None
                          and not flat_viable(p, self.options))
             if batchable:
                 prep = self._prepare(p)
@@ -1339,6 +1344,17 @@ class JaxSolver:
                     # lazy): disarm the route and re-dispatch the SAME
                     # base buffer deterministically
                     from karpenter_tpu.stochastic.degraded import (
+                        note_degraded,
+                    )
+
+                    note_degraded(prep, e)
+                    out_dev, path = self._dispatch(prep, prep.packed)
+                    out_np = np.asarray(out_dev)
+                elif path == "affinity":
+                    # same contract for the affinity kernel: disarm and
+                    # re-run unconstrained (the decode choke keeps the
+                    # fallback plan edge-honest)
+                    from karpenter_tpu.affinity.degraded import (
                         note_degraded,
                     )
 
@@ -1446,7 +1462,8 @@ class JaxSolver:
         catalog = problems[0].catalog
         if any(p.catalog is not catalog for p in problems[1:]) \
                 or any(p.pref_rows is not None for p in problems) \
-                or any(p.group_var is not None for p in problems):
+                or any(p.group_var is not None for p in problems) \
+                or any(p.aff is not None for p in problems):
             return [self.solve_encoded(p) for p in problems]
         # one common label-row bucket across candidates (their U differs
         # by at most one appended row) so the stacked buffers share length
@@ -1684,11 +1701,23 @@ class JaxSolver:
             sto = pack_stochastic(problem.group_mean, problem.group_var,
                                   G_pad)
             z_bp = z_bp_for(problem.overcommit_eps)
+        aff = None
+        if problem.aff is not None and problem.aff.device_armed:
+            # affinity suffix (karpenter_tpu/affinity): the BASE packed
+            # buffer is unchanged — the unconstrained degraded fallback
+            # re-dispatches it as-is — and the selector/spread words
+            # ride one extra small donated leaf.  Windows whose class
+            # count exceeds the device lane budget stay host-enforced
+            # (device_armed False): the decode choke and the validator
+            # still apply every edge.
+            from karpenter_tpu.affinity.encode import pack_affinity
+
+            aff = pack_affinity(problem.aff, G_pad)
         return _Prepared(catalog=catalog, G_pad=G_pad, O_pad=O_pad,
                          U_pad=U_pad, N=N, N_cap=N_cap, K0=K0, K_cap=K_cap,
                          packed=packed, dense16_ok=max_slots < (1 << 15),
                          pref_rows=pref_rows, pref_idx=pref_idx,
-                         sto=sto, z_bp=z_bp)
+                         sto=sto, z_bp=z_bp, aff=aff)
 
     @staticmethod
     def _note_dispatch(path: str, prep: "_Prepared", arr, N: int,
@@ -1728,9 +1757,19 @@ class JaxSolver:
             out = self._dispatch_stochastic(prep, arr)
             if out is not None:
                 return out, "stochastic"
+        if prep.aff is not None and prep.sto is None:
+            # affinity-gated windows own their route when the stochastic
+            # plane isn't armed (when both are, the quantile kernel wins
+            # the dispatch and the decode choke keeps the plan
+            # edge-honest); a kernel failure degrades to the
+            # unconstrained scan on the SAME base buffer
+            # (affinity/degraded.py)
+            out = self._dispatch_affinity(prep, arr)
+            if out is not None:
+                return out, "affinity"
         if allow_resident and self.resident is not None \
                 and prep.pref_rows is None and prep.sto is None \
-                and isinstance(arr, np.ndarray):
+                and prep.aff is None and isinstance(arr, np.ndarray):
             out = self._dispatch_resident(prep, arr)
             if out is not None:
                 return out, "resident"
@@ -1866,6 +1905,46 @@ class JaxSolver:
             # device fault, not a quantile-kernel defect: never disarm
             # the stochastic route for it — the window fails over to
             # the host oracle instead
+            raise
+        except Exception as e:  # noqa: BLE001 — degrade, never fail
+            note_degraded(prep, e)
+            return None
+
+    def _dispatch_affinity(self, prep: "_Prepared", arr):
+        """One affinity-gated window (affinity/kernel.py): the standard
+        packed buffer plus the donated selector-class/spread suffix
+        leaf.  Returns the device result buffer — same wire layout as
+        the scan path — or None after disarming the affinity route
+        (affinity/degraded.py), so the caller falls through to the
+        unconstrained dispatch: a broken affinity kernel must never
+        fail a solve window (the decode choke point keeps the fallback
+        plan edge-honest either way)."""
+        from karpenter_tpu.affinity.degraded import note_degraded
+        from karpenter_tpu.affinity.kernel import solve_packed_affinity
+
+        catalog, G_pad, O_pad = prep.catalog, prep.G_pad, prep.O_pad
+        N = prep.N
+        prep.K, prep.dense16, prep.coo16 = clamp_output_opts(
+            prep.K0, prep.dense16_ok, G_pad, N)
+        rs = self.options.right_size if prep.right_size is None \
+            else prep.right_size
+        try:
+            off_alloc, off_price, off_rank = self._device_offerings(
+                catalog, O_pad)
+            self._note_dispatch("affinity", prep, arr, N, (rs,))
+            with device_guard("affinity"):
+                with get_profiler().sampled("affinity") as probe:
+                    out = solve_packed_affinity(
+                        arr, prep.aff, off_alloc, off_price, off_rank,
+                        G=G_pad, O=O_pad, U=prep.U_pad, N=N,
+                        right_size=rs, compact=prep.K,
+                        dense16=prep.dense16, coo16=prep.coo16)
+                    probe.dispatched(out)
+            return out
+        except DeviceFaultError:
+            # device fault, not an affinity-kernel defect: never disarm
+            # the affinity route for it — the window fails over to the
+            # host oracle instead
             raise
         except Exception as e:  # noqa: BLE001 — degrade, never fail
             note_degraded(prep, e)
@@ -2062,6 +2141,18 @@ class PendingSolve:
                     # and re-dispatch deterministically (the base
                     # packed buffer is unchanged by construction)
                     from karpenter_tpu.stochastic.degraded import (
+                        note_degraded,
+                    )
+
+                    note_degraded(prep, e)
+                    dev, path = solver._dispatch(prep, prep.packed)
+                    fut = _prefetch(dev)
+                    continue
+                if path == "affinity":
+                    # same contract for the affinity kernel: disarm and
+                    # re-run unconstrained (the decode choke keeps the
+                    # fallback plan edge-honest)
+                    from karpenter_tpu.affinity.degraded import (
                         note_degraded,
                     )
 

@@ -505,7 +505,27 @@ UNPLACED_REASONS = (
     "capacity_higher_prio",
     "capacity_exhausted",
     "overcommit_risk",
+    "affinity_unsatisfied",
+    "spread_bound",
 )
+# Affinity plane (karpenter_tpu/affinity): pod-to-pod (anti-)affinity
+# and topology-spread as dense constraint tensors.
+AFFINITY_EDGES = Gauge(
+    "karpenter_tpu_affinity_edges",
+    "Inter-group (anti-)affinity edges armed in the last encoded window "
+    "(required + anti, both topology scopes; zero for edge-free windows "
+    "— the plane never activates)", ())
+AFFINITY_COMPONENTS = Gauge(
+    "karpenter_tpu_affinity_components",
+    "Multi-group affinity components in the last encoded window "
+    "(union-find over armed edges and bounded spread classes; the "
+    "sharded router co-routes each component to one shard)", ())
+AFFINITY_SPREAD_AVOIDED = Counter(
+    "karpenter_tpu_affinity_spread_violations_avoided_total",
+    "Pods the decode choke point clamped off a node because placing "
+    "them would have exceeded a hostname topology-spread bound "
+    "(affinity/enforce.py; each clamp returns pods to unplaced with "
+    "the spread_bound explain bit)", ())
 UNPLACED_PODS = Gauge(
     "karpenter_tpu_unplaced_pods",
     "Pods currently unplaced by canonical explain reason "

@@ -1,0 +1,700 @@
+"""Affinity plane (karpenter_tpu/affinity, ISSUE 19).
+
+Covers the whole plane:
+
+- PodAffinityTerm / TopologySpreadConstraint strict validation
+  (table-driven, the parse_priority convention);
+- encode lowering: arming rules (strict superset — legacy lowerings
+  never arm), selector classes, components, required-edge depth, the
+  packed device suffix round-trip, the class-budget disarm;
+- DEVICE kernel vs numpy oracle — node_off / assign / unplaced /
+  explain words bit-identical across seeded windows (the parity
+  contract, same discipline as preempt/gang/stochastic);
+- the decode choke point (``enforce_affinity``): anti drops, spread
+  clamps, required-edge fixpoint stranding, node closure with cost
+  leaving the plan, and the gang exemption (gang atomicity supersedes
+  affinity/spread — docs/design/gang.md);
+- the independent validator defect catalog (accepts honest plans,
+  rejects fabricated violations of every rule) + its gang mirror;
+- explain bits 16/17, fold precedence, and end-to-end unplaced
+  reasons (``affinity_unsatisfied`` / ``spread_bound``);
+- degraded fallback: a broken affinity kernel degrades the window to
+  the unconstrained scan, never fails it — and the choke keeps the
+  plan edge-honest anyway;
+- sharded co-routing: ``bind_components`` anchors whole components,
+  churn keeps them together deterministically, and
+  ``component_violations`` is falsifiable by a direct ownership poke;
+- the affinity chaos profile + the broken-affinity fixture
+  (falsifiability: an affinity-blind applier MUST trip
+  affinity-satisfied).
+"""
+
+import dataclasses
+from types import SimpleNamespace
+
+import numpy as np
+import pytest
+
+from karpenter_tpu.affinity import AFF_BIG, C_PAD, MAX_SELECTOR_CLASSES
+from karpenter_tpu.affinity.encode import (
+    build_affinity_index, hostname_cap, pack_affinity, unpack_affinity,
+)
+from karpenter_tpu.affinity.enforce import enforce_affinity
+from karpenter_tpu.affinity.greedy import solve_affinity_host
+from karpenter_tpu.affinity.validate import validate_affinity_plan
+from karpenter_tpu.apis.pod import (
+    HOSTNAME_TOPOLOGY_KEY, ZONE_TOPOLOGY_KEY, PodAffinityTerm, PodSpec,
+    ResourceRequests, TopologySpreadConstraint,
+)
+from karpenter_tpu.apis.podgroup import PodGroup
+from karpenter_tpu.catalog import (
+    CatalogArrays, InstanceTypeProvider, PricingProvider,
+)
+from karpenter_tpu.cloud.fake import FakeCloud
+from karpenter_tpu.solver import GreedySolver, JaxSolver, encode
+from karpenter_tpu.solver.types import Plan, PlannedNode, SolverOptions
+from karpenter_tpu.solver.validate import validate_plan
+
+
+@pytest.fixture(scope="module")
+def catalog():
+    cloud = FakeCloud()
+    pricing = PricingProvider(cloud)
+    itp = InstanceTypeProvider(cloud, pricing)
+    arrays = CatalogArrays.build(itp.list())
+    pricing.close()
+    return arrays
+
+
+def _term(sel, key=HOSTNAME_TOPOLOGY_KEY, anti=False):
+    return PodAffinityTerm(label_selector=sel, topology_key=key, anti=anti)
+
+
+def _spread(skew, sel=(), key=HOSTNAME_TOPOLOGY_KEY,
+            when="DoNotSchedule"):
+    return TopologySpreadConstraint(max_skew=skew, topology_key=key,
+                                    when_unsatisfiable=when,
+                                    label_selector=sel)
+
+
+def _aff_pods(n, seed=0, prefix="ap", services=3):
+    """A mixed affinity ensemble: per service 2 labeled anchors + 2
+    followers carrying a required hostname edge, one mutual anti pair,
+    one bounded hostname spread set, plain filler to ``n``."""
+    rng = np.random.RandomState(seed)
+    out = []
+    for s in range(services):
+        svc = (("svc", f"{prefix}{s}"),)
+        for a in range(2):
+            out.append(PodSpec(
+                f"{prefix}-s{s}-anchor{a}",
+                requests=ResourceRequests(500, 1024, 0, 1),
+                labels=svc + (("role", "anchor"),)))
+        for f in range(2):
+            out.append(PodSpec(
+                f"{prefix}-s{s}-fol{f}",
+                requests=ResourceRequests(250, 512, 0, 1),
+                labels=svc + (("role", "fol"),),
+                affinity=(_term(svc + (("role", "anchor"),)),)))
+    for side, other in (("left", "right"), ("right", "left")):
+        out.append(PodSpec(
+            f"{prefix}-anti-{side}",
+            requests=ResourceRequests(500, 1024, 0, 1),
+            labels=(("anti", side),),
+            affinity=(_term((("anti", other),), anti=True),)))
+    for i in range(6):
+        out.append(PodSpec(
+            f"{prefix}-spr{i}",
+            requests=ResourceRequests(250, 512, 0, 1),
+            labels=(("spread", prefix),),
+            topology_spread=(_spread(2, (("spread", prefix),)),)))
+    sizes = ((500, 1024), (1000, 2048), (2000, 4096))
+    while len(out) < n:
+        cpu, mem = sizes[rng.randint(len(sizes))]
+        out.append(PodSpec(f"{prefix}-fill{len(out)}",
+                           requests=ResourceRequests(cpu, mem, 0, 1)))
+    return out
+
+
+# -- validation (satellite: parse_priority-style strictness) ---------------
+
+@pytest.mark.parametrize("kwargs", [
+    dict(label_selector=()),                       # empty edge selector
+    dict(label_selector="app=x"),                  # not a tuple of pairs
+    dict(label_selector=(("app",),)),              # wrong pair arity
+    dict(label_selector=((1, "x"),)),              # non-str key
+    dict(label_selector=(("app", 2),)),            # non-str value
+    dict(label_selector=(("", "x"),)),             # empty key
+    dict(label_selector=(("app", "x"),),
+         topology_key="rack"),                     # typo'd topology key
+    dict(label_selector=(("app", "x"),), anti=1),  # non-bool anti
+])
+def test_affinity_term_rejects(kwargs):
+    with pytest.raises(ValueError):
+        PodAffinityTerm(**kwargs)
+
+
+@pytest.mark.parametrize("kwargs", [
+    dict(max_skew=0),
+    dict(max_skew=-1),
+    dict(max_skew=True),
+    dict(max_skew="2"),
+    dict(topology_key="kubernetes.io/rack"),
+    dict(when_unsatisfiable="Maybe"),
+    dict(label_selector=(("", "x"),)),
+])
+def test_spread_constraint_rejects(kwargs):
+    with pytest.raises(ValueError):
+        TopologySpreadConstraint(**kwargs)
+
+
+def test_spread_empty_selector_is_valid_self_select():
+    c = TopologySpreadConstraint(max_skew=3)
+    assert c.label_selector == ()
+    t = _term((("app", "x"),), key=ZONE_TOPOLOGY_KEY, anti=True)
+    assert t.matches((("app", "x"), ("tier", "web")))
+    assert not t.matches((("app", "y"),))
+
+
+@pytest.mark.parametrize("kwargs", [
+    dict(affinity=({"sel": "x"},)),
+    dict(affinity="not-a-tuple"),
+    dict(topology_spread=(1,)),
+])
+def test_podspec_rejects_non_term_payloads(kwargs):
+    with pytest.raises(ValueError):
+        PodSpec("p", **kwargs)
+
+
+# -- encode arming rules (strict superset) ----------------------------------
+
+def test_no_terms_no_index(catalog):
+    assert encode(_aff_pods(0, services=0)[:0] or
+                  [PodSpec("plain")], catalog).aff is None
+
+
+def test_anti_matching_nothing_is_noop(catalog):
+    pods = [PodSpec("a", labels=(("app", "x"),),
+                    affinity=(_term((("ghost", "y"),), anti=True),)),
+            PodSpec("b", labels=(("app", "z"),))]
+    assert encode(pods, catalog).aff is None
+
+
+def test_self_only_zone_affinity_keeps_legacy_pin(catalog):
+    pods = [PodSpec("a", labels=(("app", "x"),),
+                    affinity=(_term((("app", "x"),),
+                                    key=ZONE_TOPOLOGY_KEY),))]
+    assert encode(pods, catalog).aff is None
+
+
+def test_schedule_anyway_spread_is_noop(catalog):
+    pods = [PodSpec(f"s{i}", labels=(("app", "x"),),
+                    topology_spread=(_spread(1, (("app", "x"),),
+                                             when="ScheduleAnyway"),))
+            for i in range(4)]
+    assert encode(pods, catalog).aff is None
+
+
+def test_empty_selector_spread_lowers_to_cap():
+    rep = PodSpec("s", topology_spread=(_spread(2), _spread(5)))
+    assert hostname_cap(rep) == 2
+    assert hostname_cap(PodSpec("t")) is None
+    assert build_affinity_index([rep]) is None
+
+
+def test_required_matching_nothing_arms_honest_unplaceable():
+    rep = PodSpec("lonely", labels=(("svc", "a"),),
+                  affinity=(_term((("role", "nowhere"),)),))
+    idx = build_affinity_index([rep, PodSpec("other")])
+    assert idx is not None and idx.device_armed
+    assert idx.aff_flag[0] == 1 and idx.edge_count == 0
+
+
+def test_edges_components_and_depth():
+    anchor = PodSpec("a", labels=(("svc", "x"), ("role", "anchor")))
+    fol = PodSpec("f", labels=(("svc", "x"), ("role", "fol")),
+                  affinity=(_term((("role", "anchor"),)),))
+    lone = PodSpec("l", labels=(("svc", "y"),))
+    idx = build_affinity_index([anchor, fol, lone])
+    assert idx is not None and idx.edge_count == 1
+    assert idx.comp[0] == idx.comp[1] != idx.comp[2]
+    # targets pack first: the anchor's depth rank is below the follower's
+    assert idx.req_depth[1] > idx.req_depth[0]
+    assert idx.req_mat[1, 0] == 1 and idx.req_mat[0, 1] == 0
+
+
+def test_pack_unpack_roundtrip():
+    reps = [PodSpec("a", labels=(("anti", "l"),),
+                    affinity=(_term((("anti", "r"),), anti=True),)),
+            PodSpec("b", labels=(("anti", "r"),),
+                    topology_spread=(_spread(3, (("anti", "r"),)),))]
+    idx = build_affinity_index(reps)
+    G_pad = 8
+    buf = pack_affinity(idx, G_pad)
+    assert buf.shape == (5 * G_pad + C_PAD,) and buf.dtype == np.int32
+    g_sel, g_anti, g_req, aff_flag, spread_flag, bounds = \
+        unpack_affinity(buf, G_pad)
+    assert np.array_equal(g_sel[:2], idx.g_sel)
+    assert np.array_equal(g_anti[:2], idx.g_anti)
+    assert np.array_equal(g_req[:2], idx.g_req)
+    assert np.array_equal(aff_flag[:2], idx.aff_flag)
+    assert np.array_equal(spread_flag[:2], idx.spread_flag)
+    assert np.array_equal(bounds, idx.bounds)
+    assert (g_sel[2:] == 0).all()            # padding groups are empty
+
+
+def test_class_budget_overflow_disarms_device_lane_only():
+    reps = []
+    for i in range(MAX_SELECTOR_CLASSES + 1):
+        reps.append(PodSpec(f"c{i}", labels=(("pair", f"t{i}"),),
+                            affinity=(_term((("pair", f"o{i}"),),
+                                            anti=True),)))
+        reps.append(PodSpec(f"o{i}", labels=(("pair", f"o{i}"),)))
+    idx = build_affinity_index(reps)
+    assert idx is not None and not idx.device_armed
+    assert (idx.g_sel == 0).all() and (idx.g_anti == 0).all()
+    # the host-side matrices keep every edge for the choke + validator
+    assert idx.edge_count == MAX_SELECTOR_CLASSES + 1
+    assert idx.anti_mat.sum() > 0
+
+
+def test_edge_free_window_strict_superset(catalog):
+    """Disarming-only terms leave the plan identical to the plain
+    window — the affinity plane is a strict superset."""
+    def mk(decorated):
+        extra = dict(
+            affinity=(_term((("ghost", "x"),), anti=True),),
+            topology_spread=(_spread(1, (("ghost", "x"),),
+                                     when="ScheduleAnyway"),),
+        ) if decorated else {}
+        return [PodSpec(f"sup{i}",
+                        requests=ResourceRequests(500 + 250 * (i % 3),
+                                                  1024, 0, 1), **extra)
+                for i in range(30)]
+
+    solver = JaxSolver(SolverOptions(backend="jax"))
+    base_problem = encode(mk(False), catalog)
+    deco_problem = encode(mk(True), catalog)
+    assert base_problem.aff is None and deco_problem.aff is None
+    base = solver.solve_encoded(base_problem)
+    assert solver.last_stats["path"] != "affinity"
+    deco = solver.solve_encoded(deco_problem)
+    assert solver.last_stats["path"] != "affinity"
+    assert [(n.instance_type, n.zone, sorted(n.pod_names))
+            for n in deco.nodes] == \
+        [(n.instance_type, n.zone, sorted(n.pod_names))
+         for n in base.nodes]
+    assert deco.total_cost_per_hour == pytest.approx(
+        base.total_cost_per_hour)
+
+
+# -- device/oracle parity ---------------------------------------------------
+
+def _device_run(solver, problem):
+    from karpenter_tpu.affinity.kernel import solve_packed_affinity
+    from karpenter_tpu.solver.jax_backend import (
+        unpack_reason_words, unpack_result,
+    )
+
+    prep = solver._prepare(problem)
+    assert prep.aff is not None
+    off_alloc, off_price, off_rank = solver._device_offerings(
+        problem.catalog, prep.O_pad)
+    out = np.asarray(solve_packed_affinity(
+        prep.packed.copy(), prep.aff.copy(), off_alloc, off_price,
+        off_rank, G=prep.G_pad, O=prep.O_pad, U=prep.U_pad, N=prep.N,
+        right_size=True))
+    node_off, assign, unplaced, cost = unpack_result(
+        out, prep.G_pad, prep.N, 0)
+    words = unpack_reason_words(out, prep.G_pad, prep.N, 0)
+    return prep, node_off, assign, unplaced, cost, words
+
+
+@pytest.mark.parametrize("seed", range(8))
+def test_kernel_oracle_parity(catalog, seed):
+    solver = JaxSolver(SolverOptions(backend="jax"))
+    problem = encode(_aff_pods(40, seed=seed, prefix=f"par{seed}"),
+                     catalog)
+    assert problem.aff is not None and problem.aff.device_armed
+    prep, node_off, assign, unplaced, cost, words = _device_run(
+        solver, problem)
+    G = problem.num_groups
+    h_off, h_assign, h_unp, h_cost, h_words = solve_affinity_host(
+        problem, prep.N, right_size=True)
+    assert np.array_equal(node_off, h_off)
+    assert np.array_equal(assign[:G], h_assign)
+    assert np.array_equal(unplaced[:G], h_unp)
+    assert np.array_equal(words[:G], h_words)
+    assert cost == pytest.approx(h_cost, rel=1e-5)
+
+
+def test_solve_routes_and_validates(catalog):
+    pods = _aff_pods(40, seed=42, prefix="route")
+    solver = JaxSolver(SolverOptions(backend="jax"))
+    plan = solver.solve_encoded(encode(pods, catalog))
+    assert solver.last_stats["path"] == "affinity"
+    assert plan.placed_count + len(plan.unplaced_pods) == len(pods)
+    assert validate_plan(plan, pods, catalog) == []
+    assert validate_affinity_plan(plan, pods) == []
+
+
+def test_greedy_in_loop_gates_validate(catalog):
+    pods = _aff_pods(40, seed=5, prefix="grd")
+    solver = GreedySolver(SolverOptions(backend="greedy",
+                                        use_native="off"))
+    plan = solver.solve_encoded(encode(pods, catalog))
+    assert validate_plan(plan, pods, catalog) == []
+    assert validate_affinity_plan(plan, pods) == []
+    # honesty over quality: a follower the in-loop gate could not seat
+    # next to an anchor is unplaced with the affinity verdict, never
+    # silently violating
+    for pn in plan.unplaced_pods:
+        if "-fol" in pn:
+            assert plan.unplaced_reasons[pn] == "affinity_unsatisfied"
+
+
+# -- decode choke point -----------------------------------------------------
+
+def _choke_problem(catalog, pods):
+    problem = encode(pods, catalog)
+    assert problem.aff is not None
+    gi = {problem.groups[i].representative.name: i
+          for i in range(problem.num_groups)}
+    return problem, gi
+
+
+def test_choke_drops_anti_conflict(catalog):
+    pods = [PodSpec("left", labels=(("anti", "l"),),
+                    affinity=(_term((("anti", "r"),), anti=True),)),
+            PodSpec("right", labels=(("anti", "r"),))]
+    problem, gi = _choke_problem(catalog, pods)
+    node_off = np.array([0, -1], dtype=np.int32)
+    gis = np.array([gi["left"], gi["right"]], dtype=np.int32)
+    ns = np.zeros(2, dtype=np.int32)
+    cnts = np.ones(2, dtype=np.int32)
+    cost = float(problem.catalog.off_price[0])
+    n_off, n_gis, n_ns, n_cnts, dropped, n_cost = enforce_affinity(
+        problem, node_off, gis, ns, cnts, cost)
+    assert dropped is not None
+    dg, dc = dropped
+    assert len(dg) == 1 and dc[0] == 1       # one side dropped whole
+    assert len(n_gis) == 1                   # the other survives
+    assert n_off[0] == 0 and n_cost == cost  # node still open
+
+
+def test_choke_required_fixpoint_strands_dependents(catalog):
+    """anchor <- fol1 <- fol2 with the anchor absent: pass 1 drops
+    fol1, pass 2 strands fol2 — the fixpoint catches the chain, and
+    the emptied node closes with its price leaving the plan."""
+    pods = [PodSpec("fol1", labels=(("role", "mid"),),
+                    affinity=(_term((("role", "anchor"),)),)),
+            PodSpec("fol2", labels=(("role", "leaf"),),
+                    affinity=(_term((("role", "mid"),)),)),
+            PodSpec("anchor", labels=(("role", "anchor"),))]
+    problem, gi = _choke_problem(catalog, pods)
+    node_off = np.array([0, -1], dtype=np.int32)
+    gis = np.array([gi["fol1"], gi["fol2"]], dtype=np.int32)
+    ns = np.zeros(2, dtype=np.int32)
+    cnts = np.ones(2, dtype=np.int32)
+    cost = float(problem.catalog.off_price[0])
+    n_off, n_gis, _ns, _cnts, dropped, n_cost = enforce_affinity(
+        problem, node_off, gis, ns, cnts, cost)
+    assert dropped is not None and len(dropped[0]) == 2
+    assert n_gis.size == 0
+    assert n_off[0] == -1                    # node emptied -> closed
+    assert n_cost == pytest.approx(0.0)
+
+
+def test_choke_clamps_spread_bound(catalog):
+    from karpenter_tpu.utils import metrics
+
+    sel = (("tier", "web"),)
+    pods = [PodSpec("w1", labels=sel, topology_spread=(_spread(2, sel),)),
+            PodSpec("w2", namespace="other", labels=sel)]
+    problem, gi = _choke_problem(catalog, pods)
+    node_off = np.array([0], dtype=np.int32)
+    gis = np.array([gi["w1"], gi["w2"]], dtype=np.int32)
+    ns = np.zeros(2, dtype=np.int32)
+    cnts = np.array([2, 2], dtype=np.int32)  # 4 matching pods, bound 2
+    before = metrics.AFFINITY_SPREAD_AVOIDED.get()
+    _off, n_gis, _ns, n_cnts, dropped, _cost = enforce_affinity(
+        problem, node_off, gis, ns, cnts,
+        float(problem.catalog.off_price[0]))
+    assert dropped is not None and int(dropped[1].sum()) == 2
+    assert int(n_cnts.sum()) == 2            # bound respected
+    assert metrics.AFFINITY_SPREAD_AVOIDED.get() == before + 2
+
+
+def test_choke_gang_exemption_supersedes(catalog):
+    """Gang atomicity supersedes the choke (docs/design/gang.md): gang
+    entries occupy census/room but are never dropped or clamped, even
+    when they exceed a spread bound the non-gang entries must honor."""
+    sel = (("tier", "web"),)
+    gang = PodGroup(name="gg", min_member=1)
+    pods = [PodSpec("gmem", labels=sel,
+                    topology_spread=(_spread(1, sel),), gang=gang),
+            PodSpec("plain", labels=sel,
+                    topology_spread=(_spread(1, sel),))]
+    problem, gi = _choke_problem(catalog, pods)
+    g_gang, g_plain = gi["gmem"], gi["plain"]
+    assert problem.group_gang[g_gang] >= 0
+    assert problem.group_gang[g_plain] < 0
+    node_off = np.array([0], dtype=np.int32)
+    gis = np.array([g_gang, g_plain], dtype=np.int32)
+    ns = np.zeros(2, dtype=np.int32)
+    cnts = np.array([3, 1], dtype=np.int32)  # gang 3x over bound 1
+    _off, n_gis, _ns, n_cnts, dropped, _cost = enforce_affinity(
+        problem, node_off, gis, ns, cnts,
+        float(problem.catalog.off_price[0]))
+    # the gang entry is untouched; the non-gang pod yields to the
+    # census the gang already consumed
+    assert dropped is not None
+    assert g_gang not in dropped[0].tolist()
+    surviving = dict(zip(n_gis.tolist(), n_cnts.tolist()))
+    assert surviving.get(g_gang) == 3
+
+
+def test_validator_mirrors_gang_exemption():
+    sel = (("app", "x"),)
+    node = PlannedNode(instance_type="bx2-2x8", zone="us-south-1",
+                       capacity_type="on-demand", price=1.0,
+                       pod_names=["default/g1", "default/p1"])
+    plan = Plan(nodes=[node])
+    gang_pod = PodSpec("g1", labels=sel,
+                       topology_spread=(_spread(1, sel),),
+                       gang=PodGroup(name="gg", min_member=1))
+    plain_carrier = PodSpec("g1", labels=sel,
+                            topology_spread=(_spread(1, sel),))
+    other = PodSpec("p1", labels=sel)
+    assert validate_affinity_plan(plan, [gang_pod, other]) == []
+    errs = validate_affinity_plan(plan, [plain_carrier, other])
+    assert errs and "spread bound" in errs[0]
+
+
+# -- independent validator defect catalog -----------------------------------
+
+def _one_node_plan(pod_names, zone="us-south-1"):
+    return Plan(nodes=[PlannedNode(
+        instance_type="bx2-2x8", zone=zone, capacity_type="on-demand",
+        price=1.0, pod_names=pod_names)])
+
+
+def test_validator_accepts_honest_plan():
+    anchor = PodSpec("a", labels=(("role", "anchor"),))
+    fol = PodSpec("f", labels=(("role", "fol"),),
+                  affinity=(_term((("role", "anchor"),)),))
+    plan = _one_node_plan(["default/a", "default/f"])
+    assert validate_affinity_plan(plan, [anchor, fol]) == []
+
+
+def test_validator_rejects_missing_required_coresident():
+    fol = PodSpec("f", affinity=(_term((("role", "anchor"),)),))
+    errs = validate_affinity_plan(_one_node_plan(["default/f"]), [fol])
+    assert errs and "required affinity" in errs[0]
+
+
+def test_validator_rejects_anti_coresidents():
+    a = PodSpec("a", labels=(("anti", "l"),),
+                affinity=(_term((("anti", "r"),), anti=True),))
+    b = PodSpec("b", labels=(("anti", "r"),))
+    errs = validate_affinity_plan(
+        _one_node_plan(["default/a", "default/b"]), [a, b])
+    assert errs and "anti-affinity" in errs[0]
+
+
+def test_validator_rejects_zone_anti_across_nodes():
+    a = PodSpec("a", labels=(("anti", "l"),),
+                affinity=(_term((("anti", "r"),),
+                                key=ZONE_TOPOLOGY_KEY, anti=True),))
+    b = PodSpec("b", labels=(("anti", "r"),))
+    plan = Plan(nodes=[
+        PlannedNode(instance_type="bx2-2x8", zone="us-south-1",
+                    capacity_type="on-demand", price=1.0,
+                    pod_names=["default/a"]),
+        PlannedNode(instance_type="bx2-2x8", zone="us-south-1",
+                    capacity_type="on-demand", price=1.0,
+                    pod_names=["default/b"]),
+    ])
+    errs = validate_affinity_plan(plan, [a, b])
+    assert errs and "zone us-south-1" in errs[0]
+    # distinct zones satisfy the anti term
+    plan.nodes[1].zone = "us-south-2"
+    assert validate_affinity_plan(plan, [a, b]) == []
+
+
+def test_validator_rejects_spread_bound_excess():
+    sel = (("tier", "web"),)
+    pods = [PodSpec(f"w{i}", labels=sel,
+                    topology_spread=(_spread(2, sel),)) for i in range(3)]
+    plan = _one_node_plan([f"default/w{i}" for i in range(3)])
+    errs = validate_affinity_plan(plan, pods)
+    assert errs and "spread bound 2 exceeded (3" in errs[0]
+
+
+# -- explain bits -----------------------------------------------------------
+
+def test_affinity_bits_and_fold():
+    from karpenter_tpu.explain import BIT, LADDER, fold_reason, word_for
+
+    assert BIT["affinity_unsatisfied"] == 16
+    assert BIT["spread_bound"] == 17
+    assert "affinity_unsatisfied" in LADDER and "spread_bound" in LADDER
+    w = word_for("affinity_unsatisfied", "capacity_exhausted")
+    assert fold_reason(w) == "affinity_unsatisfied"
+    w2 = word_for("spread_bound", "capacity_exhausted")
+    assert fold_reason(w2) == "spread_bound"
+
+
+def test_lone_required_follower_unplaced_with_reason(catalog):
+    pods = [PodSpec("lonely", labels=(("svc", "x"),),
+                    affinity=(_term((("role", "anchor-nowhere"),)),)),
+            PodSpec("bystander",
+                    requests=ResourceRequests(500, 1024, 0, 1))]
+    problem = encode(pods, catalog)
+    assert problem.aff is not None
+    solver = JaxSolver(SolverOptions(backend="jax"))
+    plan = solver.solve_encoded(problem)
+    assert solver.last_stats["path"] == "affinity"
+    assert "default/lonely" in plan.unplaced_pods
+    assert plan.unplaced_reasons["default/lonely"] == \
+        "affinity_unsatisfied"
+    assert "default/bystander" not in plan.unplaced_pods
+
+
+def test_spread_bound_reason_when_nodes_run_out(catalog):
+    sel = (("spread", "tight"),)
+    pods = [PodSpec(f"t{i}", requests=ResourceRequests(250, 512, 0, 1),
+                    labels=sel, topology_spread=(_spread(1, sel),))
+            for i in range(6)]
+    solver = JaxSolver(SolverOptions(backend="jax", max_nodes=2,
+                                     adaptive_nodes=False))
+    plan = solver.solve_encoded(encode(pods, catalog))
+    assert len(plan.unplaced_pods) == 4      # one per node, two nodes
+    assert set(plan.unplaced_reasons.values()) == {"spread_bound"}
+    assert validate_affinity_plan(plan, pods) == []
+
+
+# -- degraded fallback ------------------------------------------------------
+
+def test_degraded_falls_back_to_unconstrained_scan(catalog, monkeypatch):
+    import karpenter_tpu.affinity.kernel as kernel_mod
+
+    def boom(*a, **k):
+        raise RuntimeError("injected affinity kernel fault")
+
+    monkeypatch.setattr(kernel_mod, "solve_packed_affinity", boom)
+    pods = _aff_pods(31, seed=9, prefix="deg")   # odd size: fresh prep
+    solver = JaxSolver(SolverOptions(backend="jax"))
+    plan = solver.solve_encoded(encode(pods, catalog))
+    assert solver.last_stats["path"] != "affinity"
+    # degraded mode costs packing quality, never constraint fidelity:
+    # the decode choke ran on the unconstrained plan
+    assert validate_affinity_plan(plan, pods) == []
+    assert validate_plan(plan, pods, catalog) == []
+
+
+# -- sharded co-routing -----------------------------------------------------
+
+def _component_pods(tag="cr"):
+    svc = (("svc", tag),)
+    anchor = PodSpec(f"{tag}-anchor", labels=svc + (("role", "anchor"),))
+    fols = [PodSpec(f"{tag}-fol{i}",
+                    requests=ResourceRequests(100 + i, 512, 0, 1),
+                    labels=svc + (("role", "fol"),),
+                    affinity=(_term(svc + (("role", "anchor"),)),))
+            for i in range(3)]
+    return [anchor] + fols
+
+
+def test_router_binds_components_to_one_shard():
+    from karpenter_tpu.sharded.router import (
+        ShardRouter, signature_key, stable_shard,
+    )
+
+    router = ShardRouter(4)
+    pods = _component_pods()
+    plain = PodSpec("plain", requests=ResourceRequests(300, 512, 0, 1))
+    assert router.bind_components(pods + [plain]) == 1
+    shards = {router.shard_of(p) for p in pods}
+    assert len(shards) == 1
+    # the unlinked pod keeps its hash home (no override writes)
+    assert router.shard_of(plain) == stable_shard(
+        signature_key(plain), 4)
+    # edge-free windows are a strict no-op
+    r2 = ShardRouter(4)
+    assert r2.bind_components([plain]) == 0
+    assert r2._owner == {}
+
+
+def test_router_churn_keeps_components_together_deterministically():
+    from karpenter_tpu.sharded.router import ShardRouter
+
+    def churn(router):
+        placements = []
+        pods = _component_pods()
+        for rnd in range(5):
+            # membership churns: drop one follower, add a new one
+            window = [p for p in pods if not p.name.endswith(f"l{rnd}")]
+            window.append(PodSpec(
+                f"cr-new{rnd}",
+                requests=ResourceRequests(200 + rnd, 512, 0, 1),
+                labels=(("svc", "cr"), ("role", "fol")),
+                affinity=(_term((("svc", "cr"), ("role", "anchor"),)),)))
+            router.bind_components(window)
+            shards = {router.shard_of(p) for p in window}
+            assert len(shards) == 1, f"round {rnd} split the component"
+            placements.append(sorted(
+                (p.name, router.shard_of(p)) for p in window))
+        return placements
+
+    assert churn(ShardRouter(4)) == churn(ShardRouter(4))
+
+
+def test_component_violations_falsifiable_by_ownership_poke():
+    from karpenter_tpu.sharded.router import ShardRouter, signature_key
+    from karpenter_tpu.sharded.validate import component_violations
+
+    router = ShardRouter(4)
+    pods = _component_pods()
+    router.bind_components(pods)
+    service = SimpleNamespace(router=router)
+    assert component_violations(service, pods) == []
+    # split the component by hand: the independent union-find must see it
+    key = signature_key(pods[-1])
+    router._owner[key] = (router.shard_of_key(key) + 1) % 4
+    errs = component_violations(service, pods)
+    assert errs and "component split" in errs[0]
+
+
+# -- chaos ------------------------------------------------------------------
+
+def test_affinity_profiles_registered():
+    from karpenter_tpu.chaos.profile import get_profile
+
+    p = get_profile("affinity")
+    assert p.affinity_wave_rate > 0 and p.shard_count > 0
+    assert not p.fixture and not p.break_affinity
+    b = get_profile("broken-affinity-fixture")
+    assert b.fixture and b.break_affinity
+    assert b.affinity_wave_rate == 1.0
+
+
+def test_broken_affinity_fixture_fires():
+    """Falsifiability: affinity waves solved through an affinity-BLIND
+    applier MUST trip affinity-satisfied, with the exact replay named."""
+    from karpenter_tpu.chaos.runner import run_scenario
+
+    res = run_scenario("broken-affinity-fixture", 1, rounds=4)
+    assert not res.ok
+    assert {v.invariant for v in res.violations} == {"affinity-satisfied"}
+    assert "replay: " in res.render_failure()
+
+
+@pytest.mark.slow
+def test_affinity_scenario_clean_and_deterministic():
+    from karpenter_tpu.chaos.runner import run_scenario
+
+    res1 = run_scenario("affinity", seed=2, rounds=4)
+    assert res1.ok, res1.render_failure()
+    res2 = run_scenario("affinity", seed=2, rounds=4)
+    assert res1.digest == res2.digest

@@ -209,9 +209,16 @@ def test_quarantine_writes_triage_bundle():
 
 # -- deadline model ----------------------------------------------------------
 
-def test_deadline_floor_without_samples():
-    model = DeadlineModel(floor_s=2.0, multiplier=20.0)
-    assert model.deadline_for("never-dispatched-kernel") == 2.0
+def test_deadline_cold_floor_without_samples():
+    # a never-sampled kernel is still compiling: it gets the cold floor
+    model = DeadlineModel(floor_s=2.0, multiplier=20.0, cold_floor_s=45.0)
+    assert model.deadline_for("never-dispatched-kernel") == 45.0
+    # the default cold budget covers a full jit compile and always
+    # clears the warm floor
+    default = DeadlineModel()
+    assert default.cold_floor_s >= default.floor_s
+    assert default.deadline_for("never-dispatched-kernel") == \
+        default.cold_floor_s
 
 
 def test_deadline_scales_profiler_ewma(monkeypatch):
@@ -222,8 +229,8 @@ def test_deadline_scales_profiler_ewma(monkeypatch):
     from karpenter_tpu.obs import prof as prof_mod
 
     monkeypatch.setattr(prof_mod, "get_profiler", lambda: StubProf())
-    model = DeadlineModel(floor_s=2.0, multiplier=20.0)
-    assert model.deadline_for("fast") == 2.0       # floor dominates
+    model = DeadlineModel(floor_s=2.0, multiplier=20.0, cold_floor_s=45.0)
+    assert model.deadline_for("fast") == 2.0       # warm floor dominates
     assert model.deadline_for("slow") == pytest.approx(30.0)
 
 

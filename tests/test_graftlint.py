@@ -26,6 +26,7 @@ REPACK_PATH = "karpenter_tpu/repack/_snippet.py"
 STOCHASTIC_PATH = "karpenter_tpu/stochastic/_snippet.py"
 SHARDED_PATH = "karpenter_tpu/sharded/_snippet.py"
 WHATIF_PATH = "karpenter_tpu/whatif/_snippet.py"
+AFFINITY_PATH = "karpenter_tpu/affinity/_snippet.py"
 
 
 def rules_of(src: str, path: str) -> list:
@@ -358,6 +359,39 @@ def test_gl002_whatif_scope_scenario_kernel_good():
             buf = base.at[didx].set(dval, mode="drop")
             return buf * 2
         """, "GL002", path=WHATIF_PATH)
+
+
+def test_gl002_affinity_scope_edge_gate_kernel_bad():
+    """The purity family covers karpenter_tpu/affinity/: a broken
+    affinity kernel that early-exits on the traced armed-edge count
+    (skip the class-count update when no affinity edge is armed) is
+    the tracer-bool hazard — the comparison is a tracer inside the
+    scanned fill step."""
+    assert_flags(
+        """
+        import jax
+        import jax.numpy as jnp
+
+        @jax.jit
+        def fill_step(node_cnt, member, take):
+            if jnp.sum(member) == 0:   # traced bool: trace error
+                return node_cnt
+            return node_cnt + member * take
+        """, "GL002", path=AFFINITY_PATH)
+
+
+def test_gl002_affinity_scope_edge_gate_kernel_good():
+    assert_clean(
+        """
+        import jax
+        import jax.numpy as jnp
+
+        @jax.jit
+        def fill_step(node_cnt, member, take):
+            # branchless: an unarmed group contributes a zero member
+            # row, so the class-count update is already a no-op
+            return node_cnt + member * take
+        """, "GL002", path=AFFINITY_PATH)
 
 
 def test_gl003_repack_scope_per_plan_jit_bad():

@@ -87,6 +87,13 @@ METRICS: tuple[tuple[str, str], ...] = (
     ("whatif.stacked_p50_ms", "lower"),
     ("whatif.batched_speedup", "higher"),
     ("whatif.seq_host_ms", "lower"),
+    # affinity plane (karpenter_tpu/affinity): the (anti-)affinity +
+    # spread-gated window's warm wall, how constrained the bench window
+    # actually is (armed edges per group — a drop to 0 means the plane
+    # silently stopped engaging), and the zero-extra-dispatch contract
+    ("affinity.solve_warm_p50_ms", "lower"),
+    ("affinity.edge_density", "higher"),
+    ("affinity.extra_dispatches", "lower"),
     # static-analysis gate cost (tools/graftlint): the whole-program
     # contract pass must stay cheap enough to run per-commit
     ("graftlint.full_scan_s", "lower"),

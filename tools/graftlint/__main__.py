@@ -47,6 +47,7 @@ DEFAULT_TARGETS = (
     # silently-unscanned gap must not repeat)
     "karpenter_tpu/whatif",
     "karpenter_tpu/faulttol",
+    "karpenter_tpu/affinity",
     "karpenter_tpu/native.py",
     "bench.py",
     "karpenter_tpu/controllers",

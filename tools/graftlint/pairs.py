@@ -107,6 +107,20 @@ PAIRS: tuple[PairSpec, ...] = (
         shared=("karpenter_tpu/solver/types.py::FIT_BIG",),
     ),
     PairSpec(
+        name="affinity",
+        device=("karpenter_tpu/affinity/kernel.py::"
+                "solve_packed_affinity",),
+        oracle=("karpenter_tpu/affinity/greedy.py::solve_affinity_host",),
+        # the affinity-plane contract: class-count padding, the
+        # unbounded-spread sentinel, and the fit clamp all come from one
+        # home each — neither side may re-derive the literals
+        shared=(
+            "karpenter_tpu/affinity/__init__.py::C_PAD",
+            "karpenter_tpu/affinity/__init__.py::AFF_BIG",
+            "karpenter_tpu/solver/types.py::FIT_BIG",
+        ),
+    ),
+    PairSpec(
         name="explain-words",
         device=("karpenter_tpu/solver/jax_backend.py::_explain_words",),
         oracle=("karpenter_tpu/explain/greedy.py::reason_words",),

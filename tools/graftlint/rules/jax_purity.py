@@ -36,6 +36,8 @@ FAMILY_A_SCOPE = (
     "karpenter_tpu/sharded/**/*",
     "karpenter_tpu/whatif/*",
     "karpenter_tpu/whatif/**/*",
+    "karpenter_tpu/affinity/*",
+    "karpenter_tpu/affinity/**/*",
     "karpenter_tpu/native.py",
     "bench.py",
 )

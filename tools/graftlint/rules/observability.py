@@ -145,7 +145,8 @@ class TelemetryInKernel(Rule):
              "karpenter_tpu/preempt/*", "karpenter_tpu/gang/*",
              "karpenter_tpu/resident/*", "karpenter_tpu/explain/*",
              "karpenter_tpu/repack/*", "karpenter_tpu/stochastic/*",
-             "karpenter_tpu/sharded/*", "karpenter_tpu/whatif/*")
+             "karpenter_tpu/sharded/*", "karpenter_tpu/whatif/*",
+             "karpenter_tpu/affinity/*")
 
     def check(self, module: SourceModule) -> Iterator[Finding]:
         analysis = analyze(module)
@@ -341,7 +342,7 @@ class BlockingSyncInHotPath(Rule):
              "karpenter_tpu/preempt/*", "karpenter_tpu/gang/*",
              "karpenter_tpu/resident/*", "karpenter_tpu/repack/*",
              "karpenter_tpu/stochastic/*", "karpenter_tpu/sharded/*",
-             "karpenter_tpu/whatif/*")
+             "karpenter_tpu/whatif/*", "karpenter_tpu/affinity/*")
 
     def check(self, module: SourceModule) -> Iterator[Finding]:
         exempt = self._exempt_ranges(module.tree)
@@ -425,7 +426,7 @@ class NakedDeviceDispatch(Rule):
              "karpenter_tpu/preempt/*", "karpenter_tpu/gang/*",
              "karpenter_tpu/resident/*", "karpenter_tpu/repack/*",
              "karpenter_tpu/stochastic/*", "karpenter_tpu/sharded/*",
-             "karpenter_tpu/whatif/*")
+             "karpenter_tpu/whatif/*", "karpenter_tpu/affinity/*")
 
     def check(self, module: SourceModule) -> Iterator[Finding]:
         guarded = self._guard_ranges(module.tree)

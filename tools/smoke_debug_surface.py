@@ -526,6 +526,53 @@ def main() -> int:
         check((wi.get("forecast") or {}).get("arrivals_observed", 0) > 0,
               "forecaster learned from the live arrival ledger")
 
+        # demo affinity cycle (karpenter_tpu/affinity): one window
+        # carrying required co-location, mutual anti-affinity, and a
+        # hostname spread bound — solved through the fused affinity
+        # kernel and re-checked by the independent validator; the
+        # karpenter_tpu_affinity_* families and the /statusz affinity
+        # block below must then be live, not vacuous
+        # (docs/design/affinity.md)
+        print("demo affinity cycle (dense (anti-)affinity tensors)")
+        from karpenter_tpu.affinity.validate import validate_affinity_plan
+        from karpenter_tpu.apis.pod import (PodAffinityTerm,
+                                            TopologySpreadConstraint)
+
+        # sized so the whole required closure (anchors + followers) fits
+        # one node even after kubelet overhead — a full anchor node
+        # strands later followers honestly (affinity_unsatisfied),
+        # which is the contract, not the demo
+        aff_req = ResourceRequests(100, 128, 0, 1)
+        aff_pods = make_pods(2, name_prefix="aff-anchor",
+                             requests=aff_req,
+                             labels=(("smoke-aff", "anchor"),))
+        aff_pods += make_pods(
+            2, name_prefix="aff-follower", requests=aff_req,
+            affinity=(PodAffinityTerm(
+                label_selector=(("smoke-aff", "anchor"),)),))
+        for side, other in (("left", "right"), ("right", "left")):
+            aff_pods.append(PodSpec(
+                name=f"aff-{side}", requests=aff_req,
+                labels=(("smoke-anti", side),),
+                affinity=(PodAffinityTerm(
+                    label_selector=(("smoke-anti", other),),
+                    anti=True),)))
+        aff_pods += make_pods(
+            4, name_prefix="aff-spread", requests=aff_req,
+            labels=(("smoke-spread", "web"),),
+            topology_spread=(TopologySpreadConstraint(
+                max_skew=2, topology_key="kubernetes.io/hostname",
+                label_selector=(("smoke-spread", "web"),)),))
+        aff_plan = jax_solver.solve(SolveRequest(aff_pods, catalog))
+        check(not aff_plan.unplaced_pods,
+              f"affinity demo placed every pod "
+              f"(unplaced={aff_plan.unplaced_pods})")
+        check(jax_solver.last_stats.get("path") == "affinity",
+              f"affinity demo rode the fused kernel "
+              f"(path={jax_solver.last_stats.get('path')!r})")
+        check(validate_affinity_plan(aff_plan, aff_pods) == [],
+              "independent validator re-derives every edge satisfied")
+
         print("GET /metrics")
         status, ctype, body = _get(port, "/metrics")
         check(status == 200, f"/metrics status 200 (got {status})")
@@ -679,6 +726,14 @@ def main() -> int:
         check('karpenter_tpu_whatif_horizon_risk{scenario="baseline"}'
               in text, "whatif horizon-risk gauge carries the baseline "
                        "scenario")
+        # affinity plane families (karpenter_tpu/affinity +
+        # docs/design/affinity.md) — live from the demo cycle above
+        check("karpenter_tpu_affinity_edges" in text,
+              "affinity edge-census gauge rendered from the demo window")
+        check("karpenter_tpu_affinity_components" in text,
+              "affinity component-census gauge rendered")
+        check("karpenter_tpu_affinity_spread_violations_avoided_total"
+              in text, "spread-clamp counter family rendered")
         # crash-recovery plane families (karpenter_tpu/recovery +
         # docs/design/recovery.md) — live: the journal recorded every
         # create/nominate of the waves above
@@ -940,6 +995,14 @@ def main() -> int:
         srisk = doc.get("risk") or {}
         check("pairs" in srisk and "risk_lambda" in srisk,
               f"/statusz surfaces the spot-risk block ({srisk.keys()})")
+        # affinity block (docs/design/affinity.md): the demo window's
+        # armed edge/component census — edge-free windows never touch
+        # these gauges, so the demo's values must still be visible here
+        saff = doc.get("affinity") or {}
+        check(saff.get("edges", 0) >= 1
+              and saff.get("components", 0) >= 1
+              and "spread_violations_avoided" in saff,
+              f"/statusz affinity block carries the demo census ({saff})")
         # crash-recovery block (docs/design/recovery.md): live journal
         # stats + what the boot recovery replayed
         srec = doc.get("recovery") or {}
