@@ -70,6 +70,10 @@ soak-short: ## CI-sized soak (same composition, fewer rounds)
 soak-sharded-short: ## CI-sized soak with the sharded solve plane armed (2-shard virtual mesh on CPU, same SLO gates)
 	$(TEST_ENV) $(PY) -m karpenter_tpu.chaos --soak --short --sharded 2 --report-dir .soak-report
 
+.PHONY: soak-serving-short
+soak-serving-short: ## CI-sized soak with the serving loop armed (every pump beat rides the ring, same SLO gates)
+	$(TEST_ENV) $(PY) -m karpenter_tpu.chaos --soak --short --serving --report-dir .soak-report
+
 .PHONY: smoke
 smoke: ## Debug-surface smoke: real operator, curl-equivalent checks on /metrics /statusz /debug/traces /debug/slo
 	JAX_PLATFORMS=cpu $(PY) tools/smoke_debug_surface.py
@@ -90,6 +94,10 @@ recovery-check: ## Full recovery-time gate: journal replay (zero duplicate creat
 .PHONY: failover-check
 failover-check: ## N-1 device failover gate: quarantine a live mesh device mid-stream; sharded service keeps placing, journal converges, device heals (tools/failover_check.py)
 	$(TEST_ENV) $(PY) tools/failover_check.py
+
+.PHONY: serving-check
+serving-check: ## Serving-loop gate: 2-shard live delta stream with a mid-stream quarantine; zero lost windows, ring parity vs classic (tools/serving_check.py)
+	$(TEST_ENV) $(PY) tools/serving_check.py
 
 .PHONY: chaos-replay
 chaos-replay: ## Replay one failing scenario: make chaos-replay PROFILE=spot-storm SEED=3

@@ -54,6 +54,12 @@ def main(argv: list[str] | None = None) -> int:
                          "plane with S shards across every segment (the "
                          "`make soak-sharded-short` gate: same SLOs, "
                          "2-shard virtual mesh on CPU)")
+    ap.add_argument("--serving", action="store_true",
+                    help="with --soak: stream every pump beat's window "
+                         "through the persistent device-resident serving "
+                         "loop across every segment (the `make "
+                         "soak-serving-short` gate: same SLOs, ring "
+                         "kicks + depth-1 deferred fetch on CPU)")
     ap.add_argument("--report-dir", default=".soak-report",
                     help="with --soak: burn report + span bundle output")
     ap.add_argument("--crash", action="store_true",
@@ -101,7 +107,8 @@ def main(argv: list[str] | None = None) -> int:
         res = run_soak(SHORT_DAY if args.short else PRODUCTION_DAY,
                        seed=args.seed if args.seed is not None else 1,
                        report_dir=args.report_dir,
-                       shard_count=args.sharded)
+                       shard_count=args.sharded,
+                       serving=args.serving)
         return 0 if res.ok else 1
 
     if args.list_profiles:

@@ -56,6 +56,19 @@ karpenter_tpu/affinity):
   ownership map keeps every affinity-connected component on one shard
   (re-derived from raw pod labels by ``sharded/validate.py``, never
   from the router's own index).
+
+Serving-plane invariants (armed by ``serving`` profiles,
+karpenter_tpu/serving):
+
+- ``no-window-lost-serving`` (round): every window the pump submitted
+  to the ServingLoop came back as a plan — via the ring, the classic
+  fallback, or host failover — the loop's routing ledger balances
+  exactly (ring + classic == windows), and at most the one pipelined
+  window is ever unfetched;
+- ``ring-converges`` (round): the loop's device-resident state, its
+  host mirror, and the independent RingOracle replay of every admitted
+  slot agree word-for-word, under the current catalog generation
+  (delegated to ``serving/validate.ring_state_violations``).
 """
 
 from __future__ import annotations
@@ -85,7 +98,7 @@ class InvariantChecker:
                  gang=None, resident=None, repack=None,
                  explain_violations: list[str] | None = None,
                  stochastic=None, sharded=None, faulttol=None,
-                 affinity: bool = False):
+                 serving=None, affinity: bool = False):
         self.cluster = cluster
         self.cloud = cloud              # ground truth: the UNWRAPPED fake
         self.unavailable = unavailable
@@ -131,6 +144,11 @@ class InvariantChecker:
         # no-window-lost (round) and health-converges (final) invariants
         # (karpenter_tpu/faulttol)
         self.faulttol = faulttol
+        # serving probe (or None): the serving-storm profile's
+        # ServingLoop + submit/receive ledgers — backs the
+        # no-window-lost-serving and ring-converges invariants
+        # (karpenter_tpu/serving)
+        self.serving = serving
         # affinity arming flag: the profile injects affinity ensembles,
         # so every bound pod's edges re-verify from ClusterState each
         # round (karpenter_tpu/affinity)
@@ -151,6 +169,8 @@ class InvariantChecker:
         out.extend(self._risk_model_consistent())
         out.extend(self._shards_converge())
         out.extend(self._no_window_lost())
+        out.extend(self._no_window_lost_serving())
+        out.extend(self._ring_converges())
         out.extend(self._affinity_satisfied())
         out.extend(self._components_never_split())
         if self.trace is not None:
@@ -554,6 +574,54 @@ class InvariantChecker:
                 f"(degraded: "
                 f"{getattr(probe.sharded, 'degraded_windows', 0)})"))
         return out
+
+    def _no_window_lost_serving(self) -> list[Violation]:
+        """Every window the pump submitted to the serving loop came
+        back as a plan — ring, classic fallback, or host failover —
+        no matter what the device injector did.  Ground truth is the
+        harness's own submit/receive ledgers (the probe) against the
+        loop's routing counters: a lost window shows up as a submit
+        that never accounted, a routing ledger that doesn't balance,
+        or a fetch backlog past the one pipelined window."""
+        probe = self.serving
+        if probe is None or probe.windows_expected == 0:
+            return []
+        loop = probe.loop
+        out = []
+        if loop.windows != probe.windows_expected:
+            out.append(Violation(
+                "no-window-lost-serving",
+                f"serving loop accounted {loop.windows} windows over "
+                f"{probe.windows_expected} submitted beats"))
+        if loop.ring_windows + loop.classic_windows != loop.windows:
+            out.append(Violation(
+                "no-window-lost-serving",
+                f"routing ledger leaks: ring {loop.ring_windows} + "
+                f"classic {loop.classic_windows} != "
+                f"windows {loop.windows}"))
+        backlog = probe.windows_expected - probe.plans_received
+        if backlog > 1:
+            out.append(Violation(
+                "no-window-lost-serving",
+                f"{backlog} submitted windows never fetched (pipelining "
+                f"depth is 1 — at most one may be in flight)"))
+        return out
+
+    def _ring_converges(self) -> list[Violation]:
+        """The serving loop's device-resident state, its host mirror,
+        and the independent RingOracle replay of every admitted slot
+        agree word-for-word, under the current catalog generation —
+        delegated to the plane's own independent validator
+        (``serving/validate.ring_state_violations``), same pattern as
+        shards-converge."""
+        probe = self.serving
+        if probe is None:
+            return []
+        from karpenter_tpu.serving.validate import ring_state_violations
+
+        return [Violation("ring-converges", v)
+                for v in ring_state_violations(probe.loop,
+                                               probe.catalog())]
 
     def _affinity_satisfied(self) -> list[Violation]:
         """Every placed (anti-)affinity edge and bounded hostname spread

@@ -117,6 +117,16 @@ class ChaosProfile:
     # proves affinity-satisfied fires when placement really ignores
     # the edges
     break_affinity: bool = False
+    # serving plane (karpenter_tpu/serving): shadow-run a persistent
+    # device-resident ServingLoop through every pump beat — the pending
+    # window encodes, delta-streams through the input ring (depth-1
+    # deferred fetch so every D2H overlaps the next kick) — under the
+    # no-window-lost-serving and ring-converges invariants
+    serving: bool = False
+    # fixture knob: corrupt one host-mirror word after every ring kick
+    # (the device state and replay oracle stay honest) — proves
+    # ring-converges fires when the mirror discipline really breaks
+    break_ring: bool = False
     # device-fault plane (karpenter_tpu/faulttol): kind -> per-dispatch
     # probability for the deterministic FaultyDeviceInjector installed
     # at the device_guard seam (kinds: hang, error, oom, corrupt).
@@ -319,6 +329,23 @@ PROFILES: dict[str, ChaosProfile] = _profiles(
         instance_quota=4,
         disable_controllers=("preemption",),
         error_rates={"create_instance": 0.05}),
+    ChaosProfile(
+        name="serving-storm",
+        description="sustained churn windows streaming through the "
+                    "persistent device-resident serving loop while "
+                    "capacity blackouts bump catalog generations and "
+                    "device faults hit mid-kick — every submitted window "
+                    "must come back as a plan via the ring, the classic "
+                    "fallback, or host failover "
+                    "(no-window-lost-serving), and the ring state must "
+                    "stay word-identical to its host mirror and replay "
+                    "oracle (ring-converges)",
+        serving=True,
+        pod_waves=6, pods_per_wave=(8, 24),
+        capacity_blackout_rate=0.35, capacity_blackout_rounds=3,
+        preempt_storm_rate=0.30, preempt_storm_frac=0.40,
+        device_fault_rates={"hang": 0.04, "error": 0.04, "corrupt": 0.03},
+        error_rates={"create_instance": 0.08}),
 )
 
 # Fixture profiles: deliberately broken worlds the test suite uses to
@@ -341,6 +368,15 @@ FIXTURE_PROFILES: dict[str, ChaosProfile] = _profiles(
         break_affinity=True,
         pod_waves=4, pods_per_wave=(6, 12),
         disable_controllers=("preemption",),
+        fixture=True),
+    ChaosProfile(
+        name="broken-ring",
+        description="serving windows kicked through a ring whose host "
+                    "mirror is corrupted after every dispatch — the "
+                    "ring-converges invariant MUST fire",
+        serving=True,
+        break_ring=True,
+        pod_waves=4, pods_per_wave=(8, 16),
         fixture=True),
 )
 

@@ -132,6 +132,43 @@ class FaulttolProbe:
 
 
 @dataclass
+class ServingProbe:
+    """What the serving invariants need: the harness's ServingLoop
+    (window routing ledger + ring state + replay oracle), a catalog
+    getter for the generation check, the harness's own submit count
+    (``windows_expected``, the independent beat ledger), the plans that
+    actually came back (``plans_received``), and the host-oracle
+    completions the pump took when the loop's own fallback ladder
+    faulted again (still completed — never lost)."""
+
+    loop: object
+    catalog: object           # () -> CatalogArrays | None
+    windows_expected: int = 0
+    plans_received: int = 0
+    host_oracle: int = 0
+
+
+def _make_serving_loop(solver, broken: bool):
+    """The harness's ServingLoop — or, for the ``broken-ring`` fixture,
+    a subclass that flips one host-mirror word after every kick while
+    the device state and replay oracle stay honest: the ring-converges
+    invariant MUST fire (falsifiability, the broken-fixture pattern)."""
+    from karpenter_tpu.serving.service import ServingLoop
+
+    if not broken:
+        return ServingLoop(solver)
+
+    class BrokenRingLoop(ServingLoop):
+        def _kick(self):
+            pend = super()._kick()
+            if self.buf.mirror is not None and self.buf.mirror.size:
+                self.buf.mirror[0] ^= 1
+            return pend
+
+    return BrokenRingLoop(solver)
+
+
+@dataclass
 class ScenarioResult:
     profile: str
     seed: int
@@ -320,6 +357,32 @@ class ChaosHarness:
             # never fail the pump (no-window-lost)
             self.sharded = ResilientShardedService(
                 ShardedSolveService(profile.shard_count))
+        # serving plane (karpenter_tpu/serving): a persistent
+        # device-resident ServingLoop shadow-tracked through every pump
+        # beat — the pending window encodes, delta-streams through the
+        # input ring, and the PREVIOUS beat's plan is fetched after this
+        # beat's kick (depth-1 pipelining: every fetch's D2H overlaps a
+        # later window's compute) — under the no-window-lost-serving
+        # and ring-converges invariants.  The jax CPU backend is real,
+        # same as the sharded/resident planes.
+        self.serving = None
+        self.serving_probe = None
+        self._serving_handles: list = []    # (handle, problem) in flight
+        if profile.serving:
+            from karpenter_tpu.solver.jax_backend import JaxSolver
+
+            self.serving = _make_serving_loop(
+                JaxSolver(SolverOptions(backend="jax")),
+                profile.break_ring)
+            # independent host oracle, the pump's LAST fallback rung: a
+            # classic re-solve after a kick fault can itself fault, and
+            # the window must still complete (no-window-lost-serving)
+            self._serving_host = GreedySolver(
+                SolverOptions(backend="greedy"))
+            self.serving_probe = ServingProbe(
+                loop=self.serving,
+                catalog=lambda: self.provisioner._catalog_for(
+                    self.nodeclass))
         # migration-first repack plane (fragmentation profile): the
         # PRODUCTION DisruptionController, defrag scoring live, every
         # executed plan logged for the repack-plan-valid invariant
@@ -414,6 +477,7 @@ class ChaosHarness:
                 seed=seed)
             if profile.overcommit_eps else None,
             faulttol=self.ft_probe,
+            serving=self.serving_probe,
             affinity=bool(profile.affinity_wave_rate))
         # warm the catalog before chaos arms (pricing resolution happens
         # here, outside the deterministic traced window)
@@ -689,6 +753,59 @@ class ChaosHarness:
                        moved=len(decision.moved_keys),
                        migrations=self.sharded.migrations)
 
+    def _pump_serving(self, window, catalog) -> None:
+        """One shadow beat of the serving loop: encode the beat's
+        PRE-provision pending window (the same window provision_once
+        solved — successive beats share surviving pods, so churn rides
+        the ring as deltas), submit it through the ring, then fetch
+        back to the pipelining depth — ONE window stays in flight
+        across beats while chaos is armed (its D2H overlaps the next
+        beat's kick), and quiesce beats drain fully so the day ends
+        with every window accounted.  A fault that escapes the loop's
+        own fallback ladder (the classic re-solve can fault again)
+        completes on the independent host oracle — the submit ledger
+        the no-window-lost-serving invariant audits."""
+        from karpenter_tpu.faulttol import DeviceFaultError
+        from karpenter_tpu.solver.encode import encode
+
+        probe = self.serving_probe
+        # catalog bumps (blackout generations) invalidate a warm ring
+        # even when this beat routes classic — the stamp stays honest
+        self.serving.track_generation(catalog)
+        problem = encode(window, catalog)
+        probe.windows_expected += 1
+        self._serving_handles.append(
+            (self.serving.submit(problem), problem))
+        keep = 1 if self.chaos_cloud.armed else 0
+        nodes = unplaced = 0
+        while len(self._serving_handles) > keep:
+            handle, prob = self._serving_handles.pop(0)
+            try:
+                plan = handle.result()
+            except DeviceFaultError:
+                # last rung: the fallback ladder itself faulted — the
+                # window still completes, on the host oracle
+                plan = self._serving_host.solve_encoded(prob)
+                probe.host_oracle += 1
+            probe.plans_received += 1
+            nodes += len(plan.nodes)
+            unplaced += len(plan.unplaced_pods)
+        # every number the loop produced rides the event trace, so the
+        # determinism digest covers the serving plane beat for beat
+        st = self.serving.stats()
+        self.trace.add("serving",
+                       windows=st["windows"], ring=st["ring_windows"],
+                       classic=st["classic_windows"],
+                       backpressure=st["backpressured"],
+                       failover=st["host_failovers"],
+                       rebuilds=st["rebuilds"],
+                       invalidations=st["invalidations"],
+                       mode=st["last_mode"],
+                       occupancy=st["output_occupancy"],
+                       fetched=probe.plans_received,
+                       host_oracle=probe.host_oracle,
+                       nodes=nodes, unplaced=unplaced)
+
     def _resident_window(self) -> list:
         """The window the resident store tracks: pending unnominated
         pods, in collection order (the same selection provision_once
@@ -698,6 +815,10 @@ class ChaosHarness:
 
     def _pump(self) -> None:
         """One provisioning + continuation + reconcile beat."""
+        # the serving plane shadow-solves the window provision_once is
+        # about to solve — capture it before the beat binds it away
+        serving_window = self._resident_window() \
+            if self.serving is not None else None
         self.provisioner.provision_once()
         self.kubelet.join_pending(ready=True)
         self.manager.sync(rounds=2)
@@ -714,6 +835,8 @@ class ChaosHarness:
             self.resident.track_window(self._resident_window(), catalog)
         if self.sharded is not None and catalog is not None:
             self._pump_sharded(catalog)
+        if self.serving is not None and catalog is not None:
+            self._pump_serving(serving_window, catalog)
         # spot-risk learning loop (stochastic/risk.py): re-derive the
         # model from the ledger's labeled lifecycle history and price
         # expected eviction cost into offering ranking — checked

@@ -126,7 +126,8 @@ class SoakResult:
             and self.gate_proven
 
 
-def _scaled(profile, load: float, shard_count: int = 0):
+def _scaled(profile, load: float, shard_count: int = 0,
+            serving: bool = False):
     lo, hi = profile.pods_per_wave
     kwargs = {"pods_per_wave": (max(1, round(lo * load)),
                                 max(1, round(hi * load)))}
@@ -137,6 +138,13 @@ def _scaled(profile, load: float, shard_count: int = 0):
         # unchanged; a shard-state divergence surfaces as a chaos
         # violation, which fails the soak like any other
         kwargs["shard_count"] = shard_count
+    if serving:
+        # `make soak-serving-short`: the WHOLE day streams every pump
+        # beat's window through the persistent serving loop (ring
+        # kicks, depth-1 deferred fetch) under the
+        # no-window-lost-serving and ring-converges invariants — same
+        # SLO gates, same failure semantics as the sharded arm
+        kwargs["serving"] = True
     return dataclasses.replace(profile, **kwargs)
 
 
@@ -144,7 +152,7 @@ def run_soak(segments: tuple[SoakSegment, ...] = PRODUCTION_DAY, *,
              seed: int = 1, slos: tuple[SLOSpec, ...] = SOAK_SLOS,
              report_dir: str = ".soak-report",
              triage_dir: str = ".triage", shard_count: int = 0,
-             echo=print) -> SoakResult:
+             serving: bool = False, echo=print) -> SoakResult:
     """Run the composed production day and gate it on the SLOs.  Every
     segment's flight-recorder spans are dumped as a bundle next to the
     burn report, and each violator row names its bundle."""
@@ -175,7 +183,7 @@ def run_soak(segments: tuple[SoakSegment, ...] = PRODUCTION_DAY, *,
                 name = f"{i:02d}-{seg.profile}"
                 ledger.set_context(name)
                 profile = _scaled(get_profile(seg.profile), seg.load,
-                                  shard_count)
+                                  shard_count, serving)
                 clock = VirtualClock()
                 mono0 = clock.monotonic()
                 since = ledger.sample_count

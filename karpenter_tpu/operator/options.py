@@ -70,6 +70,10 @@ class Options:
     # preemption/gang/repack — it changes what lives on device between
     # windows and how the repack plane snapshots occupancy
     resident_enabled: bool = False         # KARPENTER_ENABLE_RESIDENT
+    # persistent device-resident serving loop (karpenter_tpu/serving/,
+    # docs/design/serving.md): opt-in like resident — ring-fed windows
+    # replace per-window dispatch for steady-state traffic
+    serving_enabled: bool = False          # KARPENTER_ENABLE_SERVING
     # sharded continuous-solve service (karpenter_tpu/sharded/,
     # docs/design/sharded.md): opt-in like resident — 0 = off, N > 1 =
     # shard cluster state across N per-shard device-resident buffers
@@ -141,6 +145,7 @@ class Options:
                                          False),
             repack_enabled=_getb(env, "KARPENTER_ENABLE_REPACK", False),
             resident_enabled=_getb(env, "KARPENTER_ENABLE_RESIDENT", False),
+            serving_enabled=_getb(env, "KARPENTER_ENABLE_SERVING", False),
             sharded_shards=(_geti(env, "KARPENTER_SHARDS", 2)
                             if _getb(env, "KARPENTER_ENABLE_SHARDED",
                                      False) else 0),

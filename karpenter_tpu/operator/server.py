@@ -482,6 +482,21 @@ class MetricsServer:
                 "spread_violations_avoided":
                     int(metrics.AFFINITY_SPREAD_AVOIDED.get()),
             },
+            # serving loop (docs/design/serving.md): per-route window
+            # tally, live ring occupancy, and the double-buffer overlap
+            # fraction (0 = fully serialized single-shot behavior)
+            "serving": {
+                "windows": {mode: int(metrics.SERVING_WINDOWS
+                                      .labels(mode).get())
+                            for mode in ("hit", "delta", "rebuild",
+                                         "classic", "backpressure",
+                                         "host_failover")},
+                "ring_occupancy": int(metrics.SERVING_RING_OCCUPANCY.get()),
+                "backpressure_total":
+                    int(metrics.SERVING_BACKPRESSURE.get()),
+                "overlap_fraction":
+                    round(float(metrics.SERVING_OVERLAP.get()), 4),
+            },
         }
         if self._statusz_extra is not None:
             out.update(self._statusz_extra())

@@ -1,0 +1,69 @@
+"""Persistent device-resident solve service: the serving loop.
+
+ROADMAP item 2, the RTT-floor kill: BENCH_r04 measured a single-shot
+solve at 76.7 ms wall of which 70.3 ms is dispatch/exec/fetch round
+trip and only 1.2 ms is compute — the ``speedup_20x`` gate never
+flipped because every window paid the full host->device->host tunnel.
+CvxCluster (PAPERS.md) wins 100-1000x only when the end-to-end serving
+path keeps up with the kernel, and "Priority Matters" frames the
+scheduler as a continuous constraint-solving service, not a per-event
+call.  This package inverts the control flow accordingly: the solver
+state LIVES on device and the host only streams deltas.
+
+- :mod:`karpenter_tpu.serving.ring` — the input/output rings:
+  sequence-numbered slots with host-visible head/tail counters and
+  explicit backpressure when full.  The input ring carries the
+  PR-8/PR-14 ``DELTA_BUCKETS`` padded ``(word index, word value)``
+  pairs (the resident wire format, unchanged); the output ring holds
+  in-flight packed results whose D2H fetch overlaps the NEXT window's
+  compute (double buffering).
+- :mod:`karpenter_tpu.serving.kernels` — the donated loop-iteration
+  kernel: ``serve_window`` fuses delta-apply + ``solve_core`` +
+  ``_pack_result_telemetry`` in ONE dispatch, exactly the
+  ``solve_resident`` body, so a ring-fed window is bit-identical to a
+  classic single-shot ``solve_packed`` on the same state.
+- :mod:`karpenter_tpu.serving.oracle` — the numpy twins
+  (``apply_ring_np`` / ``serve_window_np``) and ``RingOracle``, the
+  host replay the ring-converges invariant and the drain path compare
+  against word-for-word.
+- :mod:`karpenter_tpu.serving.service` — ``ServingLoop`` (the
+  solver-side service: eligibility gate, plan_update-driven
+  delta/rebuild ladder, backpressure -> classic-dispatch fallback,
+  device-fault drain + host failover) and ``ServingPending`` (the
+  deferred fetch handle that rides the classic decode chain).
+- :mod:`karpenter_tpu.serving.validate` — the independent validator:
+  ring-fed vs classic ``solve_packed`` over an 8-seed churn stream,
+  raw packed words AND decoded plans (the PR-14 parity contract).
+
+Every ring kick runs inside ``device_guard`` (faulttol): a fault
+drains the ring and fails over without losing a window.  Opt-in via
+``KARPENTER_ENABLE_SERVING`` (the resident/preempt convention) or
+``SolverOptions.serving="on"``.  Design: docs/design/serving.md.
+"""
+
+from __future__ import annotations
+
+import os
+
+# Default ring capacity (slots): bounds in-flight un-fetched windows.
+# Deep enough that a fetch-lagged stream never backpressures at the
+# bench's depth-2 pipelining, small enough that a stalled consumer
+# surfaces as explicit backpressure instead of unbounded device memory.
+RING_SLOTS = 8
+
+
+def serving_enabled(options=None, env=None) -> bool:
+    """The one gate every wiring point shares: SolverOptions.serving
+    "on"/"off" wins; "auto" defers to KARPENTER_ENABLE_SERVING."""
+    mode = getattr(options, "serving", "auto") if options is not None \
+        else "auto"
+    if mode == "on":
+        return True
+    if mode == "off":
+        return False
+    raw = (os.environ if env is None else env).get(
+        "KARPENTER_ENABLE_SERVING", "")
+    return raw.lower() in ("1", "true", "yes", "on")
+
+
+__all__ = ["RING_SLOTS", "serving_enabled"]

@@ -1,0 +1,77 @@
+"""Donated device kernels for the serving loop.
+
+One loop iteration of the persistent solve service is ONE dispatch:
+``serve_window`` fuses ring-slot delta-apply + ``solve_core`` +
+``_pack_result_telemetry`` with the state buffer DONATED (graftlint
+GL006: the transient state input must alias the output, never double
+the device footprint).  The body is the ``solve_resident`` body — the
+serving plane adds the ring *around* the kernel, never inside it — so
+a ring-fed window on a bit-identical buffer is bit-identical to the
+classic single-shot ``solve_packed`` path (the parity contract
+docs/design/serving.md pins; karpenter_tpu/serving/validate.py is the
+independent 8-seed check).
+
+``apply_ring`` is the standalone scatter: the drain path uses it to
+land already-admitted ring slots into device state without a solve
+(the fault ladder's "every admitted delta lands exactly once" half).
+
+The catalog tensors (off_alloc / off_price / off_rank) are the
+device-RESIDENT cache JaxSolver keys by generation — they are never
+donated (GL006's explicit carve-out).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+
+from karpenter_tpu.resident.delta import DELTA_BUCKETS
+from karpenter_tpu.solver.jax_backend import (
+    _pack_result_telemetry, _unpack_problem, solve_core,
+)
+
+
+@functools.partial(jax.jit, donate_argnames=("state",))
+def apply_ring(state, didx, dval):
+    """Scatter one admitted ring slot into the serving state buffer:
+    padding entries carry an out-of-range index and drop.  The old
+    buffer is donated — the update aliases in place on device."""
+    # trace-time wire-format check: a slot not padded to a
+    # DELTA_BUCKETS rung would silently fragment the executable cache
+    assert didx.shape[0] in DELTA_BUCKETS, \
+        f"ring slot width {didx.shape[0]} is not a DELTA_BUCKETS rung"
+    flat = state.reshape(-1).at[didx].set(dval, mode="drop")
+    return flat.reshape(state.shape)
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("G", "O", "U", "N", "right_size",
+                                    "compact", "dense16", "coo16"),
+                   donate_argnames=("state",))
+def serve_window(state, didx, dval, off_alloc, off_price, off_rank, *,
+                 G: int, O: int, U: int, N: int,
+                 right_size: bool = True, compact: int = 0,
+                 dense16: bool = False, coo16: bool = False):
+    """One serving-loop iteration: ring-slot apply + packed solve in
+    one dispatch.
+
+    Args: ``state`` int32 [L] device-resident packed buffer (donated);
+    ``didx``/``dval`` int32 [D] padded ring-slot word delta (the
+    ``DELTA_BUCKETS`` wire format); catalog tensors as in
+    ``solve_packed``.  Returns ``(new_state, packed_result)`` — the
+    new state stays on device for the next slot, the result buffer
+    streams out through the output ring (top-k COO compressed via the
+    ``compact`` suffix, so the overlapped D2H moves kilobytes).
+    """
+    assert didx.shape[0] in DELTA_BUCKETS, \
+        f"ring slot width {didx.shape[0]} is not a DELTA_BUCKETS rung"
+    state = state.at[didx].set(dval, mode="drop")
+    meta, compat_i, rows_g = _unpack_problem(state, off_alloc, G, O, U)
+    node_off, assign, unplaced, cost = solve_core(
+        meta[:, :4], meta[:, 4], meta[:, 5], compat_i > 0,
+        off_alloc, off_price, off_rank, num_nodes=N,
+        right_size=right_size)
+    return state, _pack_result_telemetry(meta, rows_g, compat_i, node_off,
+                                         assign, unplaced, cost, off_alloc,
+                                         compact, dense16, coo16)

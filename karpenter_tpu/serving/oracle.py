@@ -1,0 +1,105 @@
+"""Numpy twins of the serving-loop kernels + the host ring replay.
+
+The parity registry (tools/graftlint/pairs.py ``PairSpec("serving")``)
+pins these to karpenter_tpu/serving/kernels.py: ``apply_ring_np`` must
+implement the exact drop-scatter semantics of ``apply_ring`` and
+``serve_window_np`` the exact slot-apply-then-solve decomposition of
+``serve_window`` — same ``DELTA_BUCKETS`` wire format (shared from
+karpenter_tpu/resident/delta.py, GL203), no re-derived literals.
+
+``RingOracle`` is the host-side replay the ring-converges invariant
+and the drain path compare against: feed it every ADMITTED slot in
+sequence order and its mirror must equal the device state
+word-for-word (and equal a fresh ClusterState re-encode — the chaos
+``ring-converges`` check closes that triangle).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from karpenter_tpu.resident.delta import DELTA_BUCKETS
+
+
+def apply_ring_np(state: np.ndarray, didx: np.ndarray,
+                  dval: np.ndarray) -> np.ndarray:
+    """Host twin of ``kernels.apply_ring``: scatter one padded ring
+    slot into a copy of ``state``.  Padding entries carry an
+    out-of-range index (one past the buffer end — the
+    ``pad_delta`` drop_index convention) and are dropped, exactly the
+    device ``mode="drop"`` semantics."""
+    flat = np.asarray(state, dtype=np.int32).reshape(-1).copy()
+    didx = np.asarray(didx, dtype=np.int64).reshape(-1)
+    dval = np.asarray(dval, dtype=np.int32).reshape(-1)
+    live = (didx >= 0) & (didx < flat.size)
+    flat[didx[live]] = dval[live]
+    return flat.reshape(np.asarray(state).shape)
+
+
+def serve_window_np(state: np.ndarray, didx: np.ndarray,
+                    dval: np.ndarray, solve_fn):
+    """Host twin of ``kernels.serve_window``: one loop iteration is
+    slot-apply THEN single-shot solve of the updated state — nothing
+    else.  ``solve_fn`` is the classic packed solve of the caller's
+    choosing (the validator passes the device ``solve_packed`` wrapper
+    so word-level parity is literally ring-apply + classic solve).
+    Returns ``(new_state, solve_fn(new_state))``."""
+    new_state = apply_ring_np(state, didx, dval)
+    return new_state, solve_fn(new_state)
+
+
+class RingOracle:
+    """Replay of every admitted ring slot, in sequence order.
+
+    The oracle never sees backpressured/classic-fallback windows (they
+    bypass the ring by definition) and never sees a slot twice — the
+    ``seq`` monotonicity assert is the "exactly once" half of the
+    no-window-lost-serving invariant, host-side."""
+
+    __slots__ = ("mirror", "applied", "last_seq")
+
+    def __init__(self):
+        self.mirror: np.ndarray | None = None
+        self.applied = 0
+        self.last_seq = -1
+
+    def reset(self) -> None:
+        self.mirror = None
+        self.applied = 0
+        self.last_seq = -1
+
+    def rebuild(self, seq: int, flat: np.ndarray) -> None:
+        """A rebuild slot replaces the whole mirror (cold start,
+        generation/shape bump, delta_too_large — the resident
+        ladder)."""
+        assert seq > self.last_seq, \
+            f"ring slot {seq} replayed out of order (last {self.last_seq})"
+        self.mirror = np.asarray(flat, dtype=np.int32).reshape(-1).copy()
+        self.applied += 1
+        self.last_seq = seq
+
+    def apply(self, seq: int, didx: np.ndarray, dval: np.ndarray) -> None:
+        assert self.mirror is not None, \
+            "ring oracle saw a delta slot before any rebuild"
+        assert seq > self.last_seq, \
+            f"ring slot {seq} replayed out of order (last {self.last_seq})"
+        assert np.asarray(didx).size in DELTA_BUCKETS, \
+            f"ring slot {seq} width {np.asarray(didx).size} is not a " \
+            f"DELTA_BUCKETS rung — off-wire-format payload"
+        self.mirror = apply_ring_np(self.mirror, didx, dval)
+        self.applied += 1
+        self.last_seq = seq
+
+    def diverges(self, device_state: np.ndarray) -> int:
+        """Word diff count between the replayed mirror and a drained
+        device state (0 = converged)."""
+        if self.mirror is None:
+            return -1
+        dev = np.asarray(device_state, dtype=np.int32).reshape(-1)
+        if dev.size != self.mirror.size:
+            return max(dev.size, self.mirror.size)
+        return int(np.count_nonzero(dev != self.mirror))
+
+
+__all__ = ["DELTA_BUCKETS", "apply_ring_np", "serve_window_np",
+           "RingOracle"]

@@ -1,0 +1,185 @@
+"""The serving rings: sequence-numbered slots, host-visible head/tail.
+
+Two fixed-capacity rings make the serving loop's flow control explicit
+instead of implicit in JAX's async dispatch queue:
+
+- ``InputRing`` — admitted window deltas on their way to the device.
+  ``push`` uploads the padded ``(word index, word value)`` pair (the
+  resident ``DELTA_BUCKETS`` wire format, unchanged) at admission time
+  — the H2D stream IS the ring fill — and returns the slot's sequence
+  number, or ``None`` when the ring is full: the caller's explicit
+  backpressure signal (ServingLoop falls back to classic per-window
+  dispatch; the window is never dropped).
+- ``OutputRing`` — in-flight packed results between kick and fetch.
+  ``push`` starts the async D2H copy immediately, so by the time the
+  consumer fetches slot N the NEXT window's compute has been kicked
+  and the copy overlapped it (the double-buffer contract
+  ``overlap_fraction`` measures).
+
+Both rings index a fixed slot list by ``seq % capacity`` with
+monotonic ``head`` (next unconsumed) / ``tail`` (next assigned)
+counters — wrap-around is arithmetic, never reallocation, so a
+long-running serving loop touches no allocator on the hot path.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any
+
+import numpy as np
+
+from karpenter_tpu.serving import RING_SLOTS
+
+
+@dataclass(slots=True)
+class InputSlot:
+    """One admitted window delta: device-side padded pair + the host
+    bookkeeping the kick and the replay oracle need."""
+
+    seq: int
+    mode: str                    # "delta" | "hit" | "rebuild"
+    didx: Any                    # device int32 [D]
+    dval: Any                    # device int32 [D]
+    # host copies for the ring oracle replay (ring-converges) — the
+    # exact words the device scatter will apply, kept verbatim
+    host_didx: np.ndarray = field(default=None, repr=False)
+    host_dval: np.ndarray = field(default=None, repr=False)
+    words: int = 0               # live (unpadded) delta words
+    h2d_bytes: int = 0
+    reason: str = ""             # rebuild reason ("" for delta/hit)
+    ctx: Any = None              # (problem, prep) carried to the kick
+
+
+@dataclass(slots=True)
+class OutputSlot:
+    """One in-flight result: kicked, async-copying, not yet fetched."""
+
+    seq: int
+    dev: Any                     # device int32 packed result
+    prep: Any                    # the _Prepared the decode chain needs
+    problem: Any
+    mode: str
+    t_disp: float = 0.0
+    t_issued: float = 0.0
+    kick_seq: int = 0            # loop kick counter at creation time
+    done: bool = False
+
+
+class _Ring:
+    """Shared fixed-capacity machinery: monotonic head/tail, slot list
+    indexed ``seq % capacity``."""
+
+    __slots__ = ("capacity", "head", "tail", "_slots")
+
+    def __init__(self, capacity: int = RING_SLOTS):
+        if capacity < 1:
+            raise ValueError(f"ring capacity must be >= 1, got {capacity}")
+        self.capacity = capacity
+        self.head = 0            # next seq to consume
+        self.tail = 0            # next seq to assign
+        self._slots: list = [None] * capacity
+
+    @property
+    def occupancy(self) -> int:
+        return self.tail - self.head
+
+    @property
+    def full(self) -> bool:
+        return self.occupancy >= self.capacity
+
+    def __len__(self) -> int:
+        return self.occupancy
+
+    def _store(self, slot) -> int:
+        seq = self.tail
+        self._slots[seq % self.capacity] = slot
+        self.tail = seq + 1
+        return seq
+
+    def clear(self) -> list:
+        """Drop every unconsumed slot (fault-drain path); returns them
+        oldest-first so the caller can account for each one."""
+        out = [self._slots[s % self.capacity]
+               for s in range(self.head, self.tail)]
+        for i in range(self.capacity):
+            self._slots[i] = None
+        self.head = self.tail
+        return out
+
+
+class InputRing(_Ring):
+    def push(self, mode: str, didx: np.ndarray, dval: np.ndarray, *,
+             words: int = 0, h2d_bytes: int = 0,
+             reason: str = "") -> int | None:
+        """Admit one window delta: upload the padded pair and take a
+        slot.  Returns the slot's sequence number, or None when the
+        ring is full — the caller's backpressure signal (nothing is
+        uploaded on a refused push)."""
+        if self.full:
+            return None
+        import jax
+
+        host_didx = np.asarray(didx, dtype=np.int32)
+        host_dval = np.asarray(dval, dtype=np.int32)
+        slot = InputSlot(
+            seq=self.tail, mode=mode,
+            didx=jax.device_put(host_didx), dval=jax.device_put(host_dval),
+            host_didx=host_didx, host_dval=host_dval,
+            words=words, h2d_bytes=h2d_bytes, reason=reason)
+        return self._store(slot)
+
+    def pop(self) -> InputSlot | None:
+        """Consume the oldest admitted slot (the kick path)."""
+        if self.occupancy == 0:
+            return None
+        slot = self._slots[self.head % self.capacity]
+        self._slots[self.head % self.capacity] = None
+        self.head += 1
+        return slot
+
+
+class OutputRing(_Ring):
+    def push(self, slot: OutputSlot) -> int | None:
+        """Park one kicked result; starts its async D2H copy so the
+        transfer overlaps the next window's compute.  None when full
+        (the kick path must check BEFORE dispatching)."""
+        if self.full:
+            return None
+        try:
+            slot.dev.copy_to_host_async()
+        except (AttributeError, RuntimeError):
+            pass                 # host arrays / backends without async copy
+        slot.seq = self.tail
+        return self._store(slot)
+
+    def take(self, seq: int) -> OutputSlot | None:
+        """Claim slot ``seq`` for fetching (out-of-order safe: head
+        advances over the contiguous fetched prefix)."""
+        if not (self.head <= seq < self.tail):
+            return None
+        slot = self._slots[seq % self.capacity]
+        if slot is None or slot.done:
+            return None
+        slot.done = True
+        while self.head < self.tail:
+            s = self._slots[self.head % self.capacity]
+            if s is None or s.done:
+                self._slots[self.head % self.capacity] = None
+                self.head += 1
+            else:
+                break
+        return slot
+
+    def pending(self) -> list[OutputSlot]:
+        """Unfetched slots oldest-first (the drain path)."""
+        out = []
+        for s in range(self.head, self.tail):
+            slot = self._slots[s % self.capacity]
+            if slot is not None and not slot.done:
+                out.append(slot)
+        return out
+
+
+__all__ = ["RING_SLOTS", "InputSlot", "OutputSlot", "InputRing",
+           "OutputRing"]

@@ -1,0 +1,481 @@
+"""The serving loop: persistent device-resident solve service.
+
+``ServingLoop`` inverts the per-window dispatch control flow: the
+packed problem state LIVES on device (one donated buffer, the resident
+plane's mirror discipline) and each submitted window only streams its
+padded word delta through the input ring.  One loop iteration is ONE
+dispatch (``serving/kernels.serve_window``: ring-slot apply +
+``solve_core`` + ``_pack_result_telemetry``), and the result parks in
+the output ring whose async D2H overlaps the NEXT window's compute —
+the consumer fetches with lag, so the tunnel round trip the single-shot
+path serializes on is paid concurrently with useful work.
+
+The fallback ladder (every rung falls to the next, no window is ever
+lost or solved twice):
+
+1. ring (hit / delta / rebuild) — eligible steady-state windows;
+2. classic — ineligible windows (preference/stochastic/affinity/flat/
+   empty) and BACKPRESSURED windows (either ring full): the unchanged
+   ``solve_encoded_async`` path, mirror untouched so the next admitted
+   delta re-absorbs the skipped churn;
+3. host failover — a ``DeviceFaultError`` at kick or fetch invalidates
+   the ring state (generation-tracked, the resident contract) and
+   re-solves the window classically, which carries its own faulttol
+   ladder down to the host oracle.
+
+Every kick and fetch runs inside ``device_guard`` (prof sites
+``serving-kick`` / ``serving-fetch``); parity with the classic path is
+bit-level and independently checked (serving/validate.py).
+"""
+
+from __future__ import annotations
+
+from collections import deque
+
+import numpy as np
+
+from karpenter_tpu import obs
+from karpenter_tpu.faulttol import DeviceFaultError, device_guard
+from karpenter_tpu.obs.devtel import get_devtel
+from karpenter_tpu.obs.prof import get_profiler
+from karpenter_tpu.resident.delta import DELTA_BUCKETS, pad_delta
+from karpenter_tpu.resident.store import ResidentBuffer, plan_update
+from karpenter_tpu.serving import RING_SLOTS
+from karpenter_tpu.serving.oracle import RingOracle
+from karpenter_tpu.serving.ring import InputRing, OutputRing, OutputSlot
+from karpenter_tpu.utils import metrics
+from karpenter_tpu.utils.logging import get_logger
+
+log = get_logger("serving.service")
+
+
+class ServingPending:
+    """Deferred handle for one ring-fed window: ``result()`` claims the
+    output-ring slot, fetches, and rides the classic decode chain."""
+
+    __slots__ = ("_loop", "_seq", "_done")
+
+    def __init__(self, loop: "ServingLoop", seq: int):
+        self._loop = loop
+        self._seq = seq
+        self._done = None
+
+    def result(self):
+        if self._done is None:
+            self._done = self._loop._finish(self._seq)
+        return self._done
+
+
+class ServingLoop:
+    """The solver-side serving service (one per JaxSolver when
+    ``serving_enabled``)."""
+
+    def __init__(self, solver, capacity: int = RING_SLOTS):
+        self.solver = solver
+        self.capacity = capacity
+        self.input = InputRing(capacity)
+        self.output = OutputRing(capacity)
+        # the ring state IS a ResidentBuffer: same mirror discipline,
+        # same generation-tracked invalidation, same plan_update ladder
+        self.buf = ResidentBuffer("serving")
+        self.oracle = RingOracle()
+        self.windows = 0             # everything submitted
+        self.ring_windows = 0        # admitted to the ring
+        self.classic_windows = 0     # ineligible -> classic dispatch
+        self.backpressured = 0       # ring full -> classic dispatch
+        self.host_failovers = 0      # fault -> classic re-solve
+        self.rebuilds = 0
+        self.invalidations = 0
+        self.kicks = 0               # dispatch counter (overlap clock)
+        self.fetched = 0
+        self.overlapped = 0          # fetched after a later kick issued
+        self.last_mode = ""
+        self.last_reason = ""
+
+    # -- state ---------------------------------------------------------------
+
+    def invalidate(self, reason: str = "") -> None:
+        """Generation-tracked invalidation: the device state and mirror
+        die together; in-flight OUTPUT slots stay fetchable (their
+        windows were solved against then-valid state)."""
+        self.buf.invalidate(reason)
+        self.oracle.reset()
+        self.input.clear()
+        self.invalidations += 1
+        self.last_reason = reason
+
+    def track_generation(self, catalog) -> None:
+        """Transparent catalog-bump invalidation for idle/classic
+        stretches: a warm ring whose generation stamp no longer matches
+        the catalog dies NOW, not at the next admit — the ring either
+        serves from current state or holds none at all (eligible
+        submits get the same treatment for free via plan_update)."""
+        if self.buf.dev is None or self.buf.generation is None:
+            return
+        gen = (catalog.uid, catalog.generation,
+               catalog.availability_generation)
+        if self.buf.generation != gen:
+            self.invalidate("generation")
+
+    @property
+    def overlap_fraction(self) -> float:
+        return self.overlapped / self.fetched if self.fetched else 0.0
+
+    def stats(self) -> dict:
+        return {
+            "windows": self.windows,
+            "ring_windows": self.ring_windows,
+            "classic_windows": self.classic_windows,
+            "backpressured": self.backpressured,
+            "host_failovers": self.host_failovers,
+            "rebuilds": self.rebuilds,
+            "invalidations": self.invalidations,
+            "kicks": self.kicks,
+            "fetched": self.fetched,
+            "overlapped": self.overlapped,
+            "overlap_fraction": self.overlap_fraction,
+            "input_occupancy": self.input.occupancy,
+            "output_occupancy": self.output.occupancy,
+            "capacity": self.capacity,
+            "last_mode": self.last_mode,
+            "last_reason": self.last_reason,
+        }
+
+    def snapshot_state(self) -> dict | None:
+        """Mirror + fetched device state + oracle replay — the
+        ring-converges invariant's raw material.  None when cold."""
+        if self.buf.mirror is None or self.buf.dev is None:
+            return None
+        return {
+            "generation": self.buf.generation,
+            "mirror": self.buf.mirror.copy(),
+            "device": np.asarray(self.buf.dev).reshape(-1),
+            "oracle": None if self.oracle.mirror is None
+            else self.oracle.mirror.copy(),
+            "seq": self.oracle.last_seq,
+        }
+
+    # -- submission ----------------------------------------------------------
+
+    def submit(self, problem):
+        """Route one window: ring when eligible and there is room,
+        classic otherwise.  Returns a handle with ``result()``."""
+        self.windows += 1
+        if not self._eligible(problem):
+            return self._classic(problem, "classic")
+        prep = self.solver._prepare(problem)
+        if prep.sto is not None or prep.aff is not None \
+                or prep.pref_rows is not None \
+                or not isinstance(prep.packed, np.ndarray):
+            return self._classic(problem, "classic")
+        if self.output.full or self.input.full:
+            # explicit backpressure: the window falls back to classic
+            # dispatch UNTOUCHED (mirror unchanged — the next admitted
+            # delta re-absorbs this window's churn)
+            self.backpressured += 1
+            metrics.SERVING_BACKPRESSURE.inc()
+            return self._classic(problem, "backpressure")
+        return self._admit(problem, prep)
+
+    def serve(self, problems, depth: int = 2):
+        """Depth-bounded streaming iterator: yields Plans in submit
+        order while keeping ``depth`` windows in flight, so every
+        fetch overlaps a later window's compute."""
+        pending = deque()
+        for problem in problems:
+            pending.append(self.submit(problem))
+            while len(pending) >= max(1, depth):
+                yield pending.popleft().result()
+        while pending:
+            yield pending.popleft().result()
+
+    def drain(self) -> dict:
+        """Fetch every in-flight output slot (shutdown / fault ladder).
+        Returns {seq: Plan}."""
+        return {slot.seq: self._finish(slot.seq)
+                for slot in self.output.pending()}
+
+    # -- internals -----------------------------------------------------------
+
+    def _eligible(self, problem) -> bool:
+        from karpenter_tpu.solver.flat import flat_viable
+
+        return (problem.num_groups > 0 and problem.pref_rows is None
+                and problem.group_var is None
+                and getattr(problem, "aff", None) is None
+                and not flat_viable(problem, self.solver.options))
+
+    def _classic(self, problem, mode: str):
+        self.classic_windows += 1
+        self.last_mode = mode
+        metrics.SERVING_WINDOWS.labels(mode).inc()
+        return self.solver.solve_encoded_async(problem)
+
+    def _admit(self, problem, prep):
+        """Delta-encode against the mirror, admit to the input ring,
+        kick.  plan_update is THE shared ladder (resident/store): a
+        reason means rebuild; empty idx means hit."""
+        import jax
+
+        catalog = prep.catalog
+        generation = (catalog.uid, catalog.generation,
+                      catalog.availability_generation)
+        flat = prep.packed.reshape(-1)
+        reason, idx = plan_update(self.buf, flat, generation)
+        if reason:
+            mode, words = "rebuild", int(flat.size)
+            h2d = int(flat.nbytes)
+            didx, dval = pad_delta(np.empty(0, dtype=np.int64),
+                                   np.empty(0, dtype=np.int32),
+                                   flat.size, DELTA_BUCKETS)
+            self.buf.dev = jax.device_put(flat)
+            self.buf.mirror = flat.copy()
+            self.buf.generation = generation
+            self.buf.pending_reason = ""
+            self.buf.stats["rebuild"] += 1
+            self.rebuilds += 1
+            self.last_reason = reason
+        elif idx.size == 0:
+            mode, words, h2d = "hit", 0, 0
+            didx, dval = pad_delta(idx, flat[idx], flat.size, DELTA_BUCKETS)
+            self.buf.stats["hit"] += 1
+        else:
+            didx, dval = pad_delta(idx, flat[idx], flat.size, DELTA_BUCKETS)
+            mode, words = "delta", int(idx.size)
+            h2d = int(didx.nbytes + dval.nbytes)
+            self.buf.mirror[idx] = flat[idx]
+            self.buf.stats["delta"] += 1
+        seq = self.input.push(mode, didx, dval, words=words,
+                              h2d_bytes=h2d, reason=reason)
+        assert seq is not None     # full rings were refused in submit
+        slot = self.input._slots[seq % self.input.capacity]
+        slot.ctx = (problem, prep)
+        if mode == "rebuild":
+            self.oracle.rebuild(seq, flat)
+        else:
+            self.oracle.apply(seq, slot.host_didx, slot.host_dval)
+        self.ring_windows += 1
+        self.last_mode = mode
+        metrics.SERVING_WINDOWS.labels(mode).inc()
+        try:
+            return self._kick()
+        except DeviceFaultError as e:
+            # the donated state (and anything the ring held) can no
+            # longer be trusted: drain bookkeeping, fail the window
+            # over — classic dispatch carries its own faulttol ladder
+            # down to the host oracle.  The window is never lost.
+            self.invalidate(f"device_fault:{e.kind}")
+            self.host_failovers += 1
+            metrics.SERVING_WINDOWS.labels("host_failover").inc()
+            log.warning("serving kick faulted; host failover engaged",
+                        kind=e.kind, seq=seq)
+            return self.solver.solve_encoded_async(problem)
+        except Exception as e:  # noqa: BLE001 — degrade, never fail
+            self.invalidate("dispatch_error")
+            metrics.ERRORS.labels("solver", "serving_fallback").inc()
+            log.warning("serving kick failed; classic fallback engaged",
+                        error=str(e)[:300])
+            return self.solver.solve_encoded_async(problem)
+
+    def _kick(self) -> ServingPending:
+        """Consume the oldest input slot, dispatch one fused loop
+        iteration, park the result in the output ring."""
+        from karpenter_tpu.serving.kernels import serve_window
+        from karpenter_tpu.solver.jax_backend import clamp_output_opts
+
+        slot = self.input.pop()
+        problem, prep = slot.ctx
+        G, O, U, N = prep.G_pad, prep.O_pad, prep.U_pad, prep.N
+        prep.K, prep.dense16, prep.coo16 = clamp_output_opts(
+            prep.K0, prep.dense16_ok, G, N)
+        rs = self.solver.options.right_size if prep.right_size is None \
+            else prep.right_size
+        off_alloc, off_price, off_rank = self.solver._device_offerings(
+            prep.catalog, O)
+        get_devtel().note_dispatch(
+            "serving-kick",
+            (G, O, U, N, prep.K, prep.dense16, prep.coo16, rs,
+             slot.mode == "rebuild"),
+            h2d_bytes=slot.h2d_bytes, donated=slot.mode != "rebuild")
+        t0 = obs.now()
+        state, self.buf.dev = self.buf.dev, None    # donated
+        with device_guard("serving-kick"):
+            with get_profiler().sampled("serving-kick") as probe:
+                new_state, out_dev = serve_window(
+                    state, slot.didx, slot.dval,
+                    off_alloc, off_price, off_rank,
+                    G=G, O=O, U=U, N=N, right_size=rs,
+                    compact=prep.K, dense16=prep.dense16,
+                    coo16=prep.coo16)
+                # fetch=False: the new state stays device-resident and
+                # the RESULT's D2H is the overlapped serving-fetch
+                # site's to account, not the kick's
+                probe.dispatched(new_state, fetch=False)
+        self.buf.dev = new_state
+        oseq = self.output.push(OutputSlot(
+            seq=slot.seq, dev=out_dev, prep=prep, problem=problem,
+            mode=slot.mode, t_disp=t0, t_issued=obs.now(),
+            kick_seq=self.kicks))
+        assert oseq is not None    # full rings were refused in submit
+        self.kicks += 1
+        metrics.SERVING_RING_OCCUPANCY.set(float(self.output.occupancy))
+        obs.instant("serving.kick", seq=slot.seq, mode=slot.mode,
+                    words=slot.words, h2d_bytes=slot.h2d_bytes)
+        return ServingPending(self, oseq)
+
+    def _finish(self, seq: int):
+        """Claim + fetch one output slot and decode through the classic
+        chain (COO growth / node escalation re-dispatch classically —
+        exactly what the single-shot path would have done)."""
+        from karpenter_tpu.solver.jax_backend import PendingSolve
+
+        slot = self.output.take(seq)
+        if slot is None:
+            raise KeyError(f"serving output slot {seq} already fetched "
+                           f"or out of window")
+        self.fetched += 1
+        if self.kicks > slot.kick_seq + 1:
+            # a later window's kick was issued before this fetch began:
+            # its compute overlapped this slot's D2H
+            self.overlapped += 1
+        metrics.SERVING_OVERLAP.set(self.overlap_fraction)
+        t0 = obs.now()
+        try:
+            with device_guard("serving-fetch") as guard:
+                with get_profiler().sampled("serving-fetch") as probe:
+                    probe.dispatched(slot.dev)
+                out_np = guard.fetch(slot.dev)
+        except DeviceFaultError as e:
+            self.invalidate(f"device_fault:{e.kind}")
+            self.host_failovers += 1
+            metrics.SERVING_WINDOWS.labels("host_failover").inc()
+            log.warning("serving fetch faulted; host failover engaged",
+                        kind=e.kind, seq=seq)
+            return self.solver.solve_encoded_async(slot.problem).result()
+        metrics.SERVING_RING_OCCUPANCY.set(float(self.output.occupancy))
+        obs.record("serving.fetch", t0, obs.now(), seq=slot.seq,
+                   mode=slot.mode)
+        pend = PendingSolve(self.solver, slot.problem, prep=slot.prep,
+                            dev=out_np, path="serving",
+                            t_disp=slot.t_disp, t_issued=slot.t_issued)
+        return pend.result()
+
+
+class ShardedServingPending:
+    """Deferred handle for one sharded serving window."""
+
+    __slots__ = ("_loop", "_kick", "_done")
+
+    def __init__(self, loop: "ShardedServingLoop", kick, done=None):
+        self._loop = loop
+        self._kick = kick
+        self._done = done
+
+    def result(self):
+        if self._done is None:
+            self._done = self._loop._finish(self._kick)
+        return self._done
+
+
+class ShardedServingLoop:
+    """Per-shard rings under the one ``jit(shard_map)`` window: kicks
+    ride :meth:`ShardedSolveService._kick_window` (the stacked state
+    advances at dispatch), fetches are deferred so window t's D2H
+    overlaps window t+1's compute.  A fault at either phase fails the
+    window over to the host oracle — never lost."""
+
+    def __init__(self, service, capacity: int = 2):
+        self.service = service
+        self.capacity = max(1, capacity)
+        self._inflight: deque = deque()
+        self.windows = 0
+        self.kicks = 0
+        self.fetched = 0
+        self.overlapped = 0
+        self.host_failovers = 0
+
+    @property
+    def overlap_fraction(self) -> float:
+        return self.overlapped / self.fetched if self.fetched else 0.0
+
+    def submit(self, catalog, nodepool=None, pods=None):
+        """Kick one sharded window; returns a deferred handle.  When
+        ``capacity`` windows are already in flight the oldest is
+        fetched first (bounded ring, implicit drain)."""
+        from karpenter_tpu.sharded.types import ShardedPlan
+
+        while len(self._inflight) >= self.capacity:
+            self._inflight.popleft().result()
+        self.windows += 1
+        try:
+            kick = self.service._kick_window(catalog, nodepool, pods)
+        except DeviceFaultError:
+            self.host_failovers += 1
+            metrics.SERVING_WINDOWS.labels("host_failover").inc()
+            plan = self.service.solve_window_host(catalog, nodepool, pods)
+            return ShardedServingPending(self, None, done=plan)
+        if isinstance(kick, ShardedPlan):
+            # host-routed (pref/sto/aff) window: already decoded
+            metrics.SERVING_WINDOWS.labels("classic").inc()
+            return ShardedServingPending(self, None, done=kick)
+        self.kicks += 1
+        kick_seq = self.kicks
+        pend = ShardedServingPending(self, (kick, kick_seq))
+        self._inflight.append(pend)
+        metrics.SERVING_WINDOWS.labels("delta" if kick.delta.mode == "delta"
+                                       else kick.delta.mode).inc()
+        metrics.SERVING_RING_OCCUPANCY.set(float(len(self._inflight)))
+        return pend
+
+    def drain(self):
+        """Fetch everything still in flight (shutdown / end of stream)."""
+        out = []
+        while self._inflight:
+            out.append(self._inflight.popleft().result())
+        return out
+
+    def _finish(self, kick_ctx):
+        kick, kick_seq = kick_ctx
+        try:
+            self._inflight.remove(
+                next(p for p in self._inflight if p._kick is kick_ctx))
+        except StopIteration:
+            pass
+        self.fetched += 1
+        if self.kicks > kick_seq:
+            self.overlapped += 1
+        metrics.SERVING_OVERLAP.set(self.overlap_fraction)
+        try:
+            plan = self.service._fetch_window(kick)
+        except DeviceFaultError:
+            self.host_failovers += 1
+            metrics.SERVING_WINDOWS.labels("host_failover").inc()
+            # re-solve the SAME window through the host oracle: the
+            # routed/encoded window rides along, so ownership and
+            # shard membership are identical — no window lost
+            plan = self.service.solve_window_host(
+                kick.catalog, kick.nodepool, window=kick.window)
+        metrics.SERVING_RING_OCCUPANCY.set(float(len(self._inflight)))
+        return plan
+
+    def stats(self) -> dict:
+        return {
+            "windows": self.windows,
+            "kicks": self.kicks,
+            "fetched": self.fetched,
+            "overlapped": self.overlapped,
+            "overlap_fraction": self.overlap_fraction,
+            "host_failovers": self.host_failovers,
+            "inflight": len(self._inflight),
+            "capacity": self.capacity,
+        }
+
+
+def serving_loop_of(solver):
+    """The solver's attached ServingLoop, or None (the
+    ``resident_store_of`` convention)."""
+    return getattr(solver, "serving", None)
+
+
+__all__ = ["ServingLoop", "ServingPending", "ShardedServingLoop",
+           "ShardedServingPending", "serving_loop_of"]

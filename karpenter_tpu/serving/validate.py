@@ -1,0 +1,235 @@
+"""Independent parity checks for the serving plane.
+
+The serving loop's whole claim is "the ring changes WHEN the solve
+runs, never WHAT it computes" — so the checks here compare against the
+classic single-shot path itself, not against serving-side arithmetic
+(an oracle the loop can lie to proves nothing):
+
+- :func:`ring_state_violations` — the loop's device state, host
+  mirror, and the :class:`~karpenter_tpu.serving.oracle.RingOracle`
+  replay of every admitted slot agree word-for-word (and, given the
+  catalog, the generation stamp is current).
+- :func:`raw_parity_violations` — the 8-seed churn differential at the
+  WORD level: a ring-fed ``serve_window`` chain (delta slots against a
+  persistent donated state) must produce packed result buffers
+  bit-identical to per-window classic ``solve_packed`` dispatches of
+  the freshly packed state, and the carried state must equal the host
+  re-pack after every window.
+- :func:`plan_parity_violations` — the same differential one level up:
+  DECODED plans from a serving-enabled solver vs a classic solver over
+  identical churned window streams (node set, pod placement, unplaced
+  set, cost — the resident/bench parity key).
+- :func:`sharded_parity_violations` — the 2-shard variant: the
+  deferred-fetch :class:`~karpenter_tpu.serving.service.ShardedServingLoop`
+  vs the same service's synchronous ``solve_window``.
+
+All builders are seeded and deterministic; the checks run on any
+backend (CPU included — bit-identity is a compilation contract, not a
+hardware one).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def _churn_stream(num_pods: int, num_types: int, windows: int, seed: int):
+    """Seeded pod-churn window sequence + catalog (arrivals and
+    departures per window, the repack-loop shape)."""
+    import random as _random
+
+    from karpenter_tpu.apis.pod import PodSpec, ResourceRequests
+    from karpenter_tpu.catalog import (
+        CatalogArrays, InstanceTypeProvider, PricingProvider,
+    )
+    from karpenter_tpu.cloud.fake import FakeCloud, generate_profiles
+
+    cloud = FakeCloud(profiles=generate_profiles(num_types))
+    pricing = PricingProvider(cloud)
+    catalog = CatalogArrays.build(InstanceTypeProvider(cloud, pricing).list())
+    pricing.close()
+
+    rng = _random.Random(f"serving-validate-{seed}")
+    sizes = ((250, 512), (500, 1024), (1000, 4096), (2000, 8192))
+
+    def mk(tag: str, i: int) -> PodSpec:
+        cpu, mem = sizes[rng.randrange(len(sizes))]
+        return PodSpec(f"{tag}-{i}", requests=ResourceRequests(cpu, mem, 0, 1))
+
+    cur = [mk(f"s{seed}w0", i) for i in range(num_pods)]
+    seqs = [list(cur)]
+    for w in range(1, windows):
+        for _ in range(rng.randrange(1, max(2, num_pods // 16))):
+            cur.pop(rng.randrange(len(cur)))
+        cur.extend(mk(f"s{seed}w{w}", i)
+                   for i in range(rng.randrange(1, max(2, num_pods // 16))))
+        seqs.append(list(cur))
+    return seqs, catalog
+
+
+def ring_state_violations(loop, catalog=None) -> list[str]:
+    """Device state == host mirror == oracle replay, word-for-word."""
+    snap = loop.snapshot_state()
+    if snap is None:
+        return []
+    out: list[str] = []
+    if catalog is not None:
+        gen = (catalog.uid, catalog.generation,
+               catalog.availability_generation)
+        if snap["generation"] != gen:
+            return [f"serving state generation {snap['generation']} != "
+                    f"catalog generation {gen} (missed invalidation)"]
+    mirror, device = snap["mirror"], snap["device"]
+    if mirror.size != device.size:
+        return [f"serving mirror size {mirror.size} != device state size "
+                f"{device.size}"]
+    diff = int(np.count_nonzero(mirror != device))
+    if diff:
+        out.append(f"serving host mirror diverged from device state "
+                   f"({diff} words differ)")
+    oracle = snap["oracle"]
+    if oracle is None:
+        out.append("serving oracle replay is cold while the ring is warm "
+                   "— an admitted slot bypassed the replay")
+    else:
+        d = loop.oracle.diverges(device)
+        if d:
+            out.append(f"ring oracle replay diverged from device state "
+                       f"({d} words differ after slot {snap['seq']})")
+    return out
+
+
+def raw_parity_violations(seeds: int = 8, num_pods: int = 48,
+                          num_types: int = 6,
+                          windows: int = 4) -> list[str]:
+    """Word-level churn differential: ring-fed ``serve_window`` chain vs
+    per-window classic ``solve_packed`` of the same freshly packed
+    buffer — raw packed RESULT words and the carried state, both
+    bit-identical, every window, every seed."""
+    import jax
+
+    from karpenter_tpu.resident.delta import DELTA_BUCKETS, pad_delta
+    from karpenter_tpu.serving.kernels import serve_window
+    from karpenter_tpu.serving.oracle import apply_ring_np
+    from karpenter_tpu.solver import JaxSolver, encode
+    from karpenter_tpu.solver.jax_backend import solve_packed
+    from karpenter_tpu.solver.types import SolverOptions
+
+    out: list[str] = []
+    solver = JaxSolver(SolverOptions(backend="jax"))
+    for seed in range(seeds):
+        seqs, catalog = _churn_stream(num_pods, num_types, windows, seed)
+        mirror = None
+        state = None
+        for w, pods in enumerate(seqs):
+            prep = solver._prepare(encode(pods, catalog))
+            flat = prep.packed.reshape(-1)
+            G, O, U, N = prep.G_pad, prep.O_pad, prep.U_pad, prep.N
+            rs = solver.options.right_size
+            off_alloc, off_price, off_rank = solver._device_offerings(
+                catalog, O)
+            if mirror is None or mirror.shape != flat.shape:
+                mirror = flat.copy()
+                state = jax.device_put(flat)
+                idx = np.empty(0, dtype=np.int64)
+            else:
+                idx = np.nonzero(mirror != flat)[0]
+                mirror[idx] = flat[idx]
+            didx, dval = pad_delta(idx, flat[idx], flat.size, DELTA_BUCKETS)
+            state, ring_res = serve_window(
+                state, jax.device_put(didx), jax.device_put(dval),
+                off_alloc, off_price, off_rank, G=G, O=O, U=U, N=N,
+                right_size=rs, compact=prep.K, dense16=prep.dense16,
+                coo16=prep.coo16)
+            classic_res = solve_packed(
+                jax.device_put(flat), off_alloc, off_price, off_rank,
+                G=G, O=O, U=U, N=N, right_size=rs, compact=prep.K,
+                dense16=prep.dense16, coo16=prep.coo16)
+            ring_np = np.asarray(ring_res)
+            classic_np = np.asarray(classic_res)
+            if not np.array_equal(ring_np, classic_np):
+                d = int(np.count_nonzero(ring_np != classic_np))
+                out.append(f"seed {seed} window {w}: ring-fed result "
+                           f"differs from classic solve_packed "
+                           f"({d} of {classic_np.size} words)")
+            state_np = np.asarray(state)
+            expect = apply_ring_np(mirror, didx, dval)
+            if not np.array_equal(state_np, expect):
+                d = int(np.count_nonzero(state_np != expect))
+                out.append(f"seed {seed} window {w}: carried serving "
+                           f"state diverged from the host re-pack "
+                           f"({d} words)")
+    return out
+
+
+def _plan_key(plan):
+    return ([(n.instance_type, n.zone, n.capacity_type,
+              tuple(n.pod_names)) for n in plan.nodes],
+            tuple(plan.unplaced_pods),
+            round(plan.total_cost_per_hour, 9))
+
+
+def plan_parity_violations(seeds: int = 8, num_pods: int = 48,
+                           num_types: int = 6,
+                           windows: int = 4) -> list[str]:
+    """Decoded-plan churn differential: a serving-enabled solver's
+    ``serve_stream`` vs a classic solver, identical window streams."""
+    from karpenter_tpu.solver import JaxSolver, encode
+    from karpenter_tpu.solver.types import SolverOptions
+
+    out: list[str] = []
+    for seed in range(seeds):
+        seqs, catalog = _churn_stream(num_pods, num_types, windows, seed)
+        on = JaxSolver(SolverOptions(backend="jax", serving="on"))
+        off = JaxSolver(SolverOptions(backend="jax", serving="off"))
+        problems = [encode(pods, catalog) for pods in seqs]
+        served = list(on.serving.serve(iter(problems), depth=2))
+        for w, (plan, problem) in enumerate(zip(served, problems)):
+            classic = off.solve_encoded(problem)
+            if _plan_key(plan) != _plan_key(classic):
+                out.append(f"seed {seed} window {w}: serving plan "
+                           f"differs from classic plan "
+                           f"(mode history {on.serving.last_mode!r})")
+        if on.serving.ring_windows == 0:
+            out.append(f"seed {seed}: no window ever rode the ring — "
+                       f"the differential exercised nothing")
+        out.extend(ring_state_violations(on.serving, catalog))
+    return out
+
+
+def sharded_parity_violations(seeds: int = 4, num_pods: int = 64,
+                              num_types: int = 6, windows: int = 3,
+                              num_shards: int = 2) -> list[str]:
+    """2-shard churn differential: deferred-fetch serving windows vs
+    the same service class solving synchronously."""
+    from karpenter_tpu.serving.service import ShardedServingLoop
+    from karpenter_tpu.sharded.service import ShardedSolveService
+
+    out: list[str] = []
+    for seed in range(seeds):
+        seqs, catalog = _churn_stream(num_pods, num_types, windows,
+                                      1000 + seed)
+        serving_svc = ShardedSolveService(num_shards=num_shards)
+        classic_svc = ShardedSolveService(num_shards=num_shards)
+        loop = ShardedServingLoop(serving_svc, capacity=2)
+        handles = [loop.submit(catalog, pods=pods) for pods in seqs]
+        plans = [h.result() for h in handles]
+        for w, (pods, plan) in enumerate(zip(seqs, plans)):
+            classic = classic_svc.solve_window(catalog, pods=pods)
+            if _plan_key(plan.merged()) != _plan_key(classic.merged()):
+                out.append(f"seed {seed} window {w}: 2-shard serving "
+                           f"plan differs from synchronous solve")
+    return out
+
+
+def validate(seeds: int = 8) -> list[str]:
+    """The full independent check (bench + CI entry point)."""
+    out = raw_parity_violations(seeds=seeds)
+    out.extend(plan_parity_violations(seeds=seeds))
+    out.extend(sharded_parity_violations(seeds=max(2, seeds // 4)))
+    return out
+
+
+__all__ = ["ring_state_violations", "raw_parity_violations",
+           "plan_parity_violations", "sharded_parity_violations",
+           "validate"]

@@ -45,6 +45,29 @@ from karpenter_tpu.utils.logging import get_logger
 log = get_logger("sharded.service")
 
 
+class ShardKick:
+    """One dispatched-but-unfetched sharded window: everything the
+    deferred fetch phase needs.  ``solve_window`` fetches immediately;
+    the serving loop (karpenter_tpu/serving) holds the kick so window
+    t's D2H overlaps window t+1's compute — the per-shard output ring
+    under the one shard_map window."""
+
+    __slots__ = ("window", "delta", "out_dev", "devices", "pods_count",
+                 "t0", "catalog", "nodepool", "fetched")
+
+    def __init__(self, window, delta, out_dev, devices, pods_count, t0,
+                 catalog, nodepool):
+        self.window = window
+        self.delta = delta
+        self.out_dev = out_dev
+        self.devices = devices
+        self.pods_count = pods_count
+        self.t0 = t0
+        self.catalog = catalog
+        self.nodepool = nodepool
+        self.fetched = False
+
+
 class ShardedSolveService:
     """Multi-device resident state + concurrent per-shard solves."""
 
@@ -266,7 +289,20 @@ class ShardedSolveService:
     def solve_window(self, catalog, nodepool=None, pods=None) -> ShardedPlan:
         """Route -> encode -> delta-update the stacked resident state ->
         ONE shard_map dispatch -> per-shard decode.  ``pods`` defaults
-        to the admitted backlog."""
+        to the admitted backlog.  Kick + immediate fetch of the same
+        window; the serving loop (karpenter_tpu/serving) drives the two
+        phases separately so window t's fetch overlaps window t+1's
+        compute."""
+        kick = self._kick_window(catalog, nodepool, pods)
+        if isinstance(kick, ShardedPlan):
+            return kick          # host-routed (pref/sto/aff) window
+        return self._fetch_window(kick)
+
+    def _kick_window(self, catalog, nodepool=None, pods=None):
+        """Phase 1: route, encode, delta-update, dispatch.  Returns a
+        :class:`ShardKick` (or a finished :class:`ShardedPlan` for
+        host-routed windows).  The donated stacked state advances at
+        kick time — the returned kick only owes its D2H + decode."""
         import jax
 
         from karpenter_tpu.sharded.kernels import solve_shards
@@ -338,12 +374,11 @@ class ShardedSolveService:
             # device_puts a fresh buffer first, which is the h2d cost
             # already accounted above
             h2d_bytes=delta.h2d_bytes, donated=True)
+        devices = device_ids(self.mesh.devices.flat)
         try:
             # guard admission runs BEFORE the donated state leaves
             # self._dev: a quarantine refusal must not cost a rebuild
-            with device_guard("sharded-solve",
-                              devices=device_ids(
-                                  self.mesh.devices.flat)) as guard:
+            with device_guard("sharded-solve", devices=devices):
                 with self._lock:
                     state = self._dev
                     self._dev = None  # donated: never dispatch dead state
@@ -354,9 +389,35 @@ class ShardedSolveService:
                         U=window.U_pad, N=window.N,
                         right_size=self.right_size)
                     probe.dispatched(out_dev)
-                out_np = guard.fetch(out_dev)
             with self._lock:
                 self._dev = new_state
+        except DeviceFaultError as e:
+            # the donated stacked buffer can no longer be trusted; the
+            # host mirrors can.  The caller (ResilientShardedService or
+            # the serving loop) re-solves this same window through the
+            # host oracle — no window lost.
+            self.invalidate(f"device_fault:{e.kind}")
+            raise
+        try:
+            # overlap seed: start the D2H copy now so a deferred fetch
+            # (the serving loop's output ring) rides it for free
+            out_dev.copy_to_host_async()
+        except (AttributeError, RuntimeError):
+            pass
+        return ShardKick(window=window, delta=delta, out_dev=out_dev,
+                         devices=devices, pods_count=len(pods), t0=t0,
+                         catalog=catalog, nodepool=nodepool)
+
+    def _fetch_window(self, kick: ShardKick) -> ShardedPlan:
+        """Phase 2: bounded fetch + per-shard decode + accounting.  A
+        fault here invalidates and raises exactly as the fused path did
+        — the caller owns the host re-solve of this window."""
+        window, delta = kick.window, kick.delta
+        kick.fetched = True
+        try:
+            with device_guard("sharded-fetch",
+                              devices=kick.devices) as guard:
+                out_np = guard.fetch(kick.out_dev)
             get_devtel().note_d2h(int(out_np.nbytes))
             # decode (with its corrupt-result validation) BEFORE the
             # window is accounted: a rejected result re-solves via the
@@ -368,21 +429,20 @@ class ShardedSolveService:
                 self.last_delta = delta
                 self._last_window = window
         except DeviceFaultError as e:
-            # the donated stacked buffer (and, past the fetch, the new
-            # state) can no longer be trusted; the host mirrors can.
-            # The caller (ResilientShardedService) re-solves this same
-            # window through the host oracle — no window lost.
+            # past the dispatch: the fetched words (and the advanced
+            # resident state) can no longer be trusted
             self.invalidate(f"device_fault:{e.kind}")
             raise
         with self._lock:
             self._last_unplaced = [len(p.unplaced_pods) for p in plan.plans]
         self._publish_backlog(window.shard_pods)
         metrics.SHARDED_SOLVES.labels("device").inc()
-        plan.solve_seconds = time.perf_counter() - t0
+        plan.solve_seconds = time.perf_counter() - kick.t0
         metrics.SHARDED_SOLVE_DURATION.labels("device").observe(
             plan.solve_seconds)
-        obs.instant("sharded.window", shards=S, pods=len(pods),
-                    mode=delta.mode, words=delta.words)
+        obs.instant("sharded.window", shards=window.num_shards,
+                    pods=kick.pods_count, mode=delta.mode,
+                    words=delta.words)
         return plan
 
     def _publish_backlog(self, shard_pods) -> None:

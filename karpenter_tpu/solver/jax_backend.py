@@ -1157,6 +1157,17 @@ class JaxSolver:
             from karpenter_tpu.resident.store import ResidentStore
 
             self.resident = ResidentStore()
+        # persistent serving loop (karpenter_tpu/serving/): eligible
+        # windows stream deltas through a device-side ring instead of
+        # dispatching single-shot.  Opt-in via KARPENTER_ENABLE_SERVING
+        # / SolverOptions.serving.
+        self.serving = None
+        from karpenter_tpu.serving import serving_enabled
+
+        if serving_enabled(self.options):
+            from karpenter_tpu.serving.service import ServingLoop
+
+            self.serving = ServingLoop(self)
 
     # -- public ------------------------------------------------------------
 
@@ -1313,6 +1324,19 @@ class JaxSolver:
             yield from drain_to(depth)
         flush()
         yield from drain_to(0)
+
+    def serve_stream(self, problems, depth: int = 2):
+        """Route an iterable of EncodedProblems through the persistent
+        serving loop (karpenter_tpu/serving/): eligible windows stream
+        ``DELTA_BUCKETS`` deltas into the device-side input ring and one
+        fused kick replaces the whole single-shot dispatch; each fetch
+        overlaps the next window's compute through the output ring.
+        Falls back to :meth:`solve_stream` when serving is disabled —
+        callers need no gate of their own.  Yields Plans in order."""
+        if self.serving is None:
+            yield from self.solve_stream(problems, depth=depth)
+            return
+        yield from self.serving.serve(problems, depth=depth)
 
     def _dispatch_window_batch(self, items) -> "BatchPendingSolve":
         """Stack C prepared same-shape windows into one [C, Li] buffer

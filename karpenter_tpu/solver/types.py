@@ -60,6 +60,12 @@ class SolverOptions:
                                     # defers to KARPENTER_ENABLE_RESIDENT
                                     # (opt-in, the preempt/gang
                                     # convention); "on"/"off" force it
+    serving: str = "auto"           # persistent device-resident solve
+                                    # service (karpenter_tpu/serving/):
+                                    # ring-fed double-buffered windows;
+                                    # "auto" defers to
+                                    # KARPENTER_ENABLE_SERVING (opt-in);
+                                    # "on"/"off" force it
     sharded: int = 0                # sharded continuous-solve service
                                     # (karpenter_tpu/sharded/): shard
                                     # count; 0 defers to the

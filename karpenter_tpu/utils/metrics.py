@@ -615,6 +615,31 @@ RESIDENT_DELTA_BYTES = Histogram(
     (), buckets=(256, 1 << 10, 1 << 12, 1 << 14, 1 << 16, 1 << 18,
                  1 << 20, 1 << 22))
 
+# Serving loop (karpenter_tpu/serving/): the persistent device-resident
+# solve service — ring-fed windows, double-buffered fetch overlap
+# (docs/design/serving.md)
+SERVING_WINDOWS = Counter(
+    "karpenter_tpu_serving_windows_total",
+    "Windows through the serving loop by route: hit/delta/rebuild (ring-"
+    "fed — the resident ladder), classic (ineligible window, unchanged "
+    "single-shot dispatch), backpressure (ring full -> classic "
+    "fallback), host_failover (device fault at kick/fetch -> classic "
+    "re-solve; the window is never lost)", ("mode",))
+SERVING_RING_OCCUPANCY = Gauge(
+    "karpenter_tpu_serving_ring_occupancy",
+    "In-flight un-fetched output-ring slots (kicked windows whose "
+    "result D2H is overlapping later compute); capacity-bounded — at "
+    "capacity the next window backpressures to classic dispatch", ())
+SERVING_BACKPRESSURE = Counter(
+    "karpenter_tpu_serving_backpressure_total",
+    "Windows refused by a full serving ring and re-routed to classic "
+    "per-window dispatch (explicit flow control, never a drop)", ())
+SERVING_OVERLAP = Gauge(
+    "karpenter_tpu_serving_overlap_fraction",
+    "Fraction of fetched serving windows whose result D2H overlapped a "
+    "later window's kicked compute (the double-buffer contract; 0 = "
+    "fully serialized, the single-shot RTT floor)", ())
+
 # Stochastic packing plane (karpenter_tpu/stochastic/): chance-
 # constrained oversubscription + spot-risk-aware placement
 # (docs/design/stochastic.md).

@@ -658,6 +658,36 @@ class TestScenarios:
         assert "replay: " + res.replay in rendered
         assert "no-stale-orphan" in rendered
 
+    def test_serving_storm_loses_no_window(self):
+        """serving-storm: churn windows streaming through the persistent
+        device-resident loop while blackouts bump generations and device
+        faults hit mid-kick — every submitted window comes back
+        (no-window-lost-serving) and the ring stays word-identical to
+        its mirror and replay oracle (ring-converges)."""
+        res = run_scenario("serving-storm", 1, rounds=8)
+        assert res.ok, [v.render() for v in res.violations][:5]
+        beats = res.trace.of_kind("serving")
+        assert beats, "serving-storm never pumped the serving loop"
+        last = beats[-1]
+        # the storm must actually exercise the ring, not just the
+        # classic fallback
+        assert last["ring"] > 0
+        assert last["windows"] == last["ring"] + last["classic"]
+        # determinism: same cell twice => identical digest (ring kicks,
+        # failovers and all ride the event trace)
+        again = run_scenario("serving-storm", 1, rounds=8)
+        assert res.digest == again.digest
+
+    def test_broken_ring_fixture_fails(self):
+        """Falsifiability: a ring whose host mirror is corrupted after
+        every dispatch MUST trip ring-converges, with a replay."""
+        res = run_scenario("broken-ring", 1, rounds=5)
+        assert not res.ok
+        assert "ring-converges" in {v.invariant for v in res.violations}
+        assert res.replay == ("python -m karpenter_tpu.chaos "
+                              "--profile broken-ring --seed 1 --rounds 5")
+        assert "ring-converges" in res.render_failure()
+
     def test_run_matrix_reports_fixture_failure(self, tmp_path):
         lines = []
         results, failures = run_matrix(

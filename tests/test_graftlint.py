@@ -27,6 +27,7 @@ STOCHASTIC_PATH = "karpenter_tpu/stochastic/_snippet.py"
 SHARDED_PATH = "karpenter_tpu/sharded/_snippet.py"
 WHATIF_PATH = "karpenter_tpu/whatif/_snippet.py"
 AFFINITY_PATH = "karpenter_tpu/affinity/_snippet.py"
+SERVING_PATH = "karpenter_tpu/serving/_snippet.py"
 
 
 def rules_of(src: str, path: str) -> list:
@@ -392,6 +393,43 @@ def test_gl002_affinity_scope_edge_gate_kernel_good():
             # row, so the class-count update is already a no-op
             return node_cnt + member * take
         """, "GL002", path=AFFINITY_PATH)
+
+
+def test_gl002_serving_scope_ring_kernel_bad():
+    """The purity family covers karpenter_tpu/serving/: a broken ring
+    kernel that early-outs on the traced delta (skip the solve when
+    the window's delta applied no change) is exactly the tracer-bool
+    hazard — the scatter result is a tracer inside the donated loop
+    body.  The ISSUE's GL002 broken-kernel fixture for the
+    PairSpec(\"serving\") ring pair."""
+    assert_flags(
+        """
+        import jax
+        import jax.numpy as jnp
+
+        @jax.jit
+        def serve_window(state, didx, dval):
+            nxt = state.at[didx].set(dval, mode="drop")
+            if jnp.array_equal(nxt, state):  # traced bool: trace error
+                return state, state
+            return nxt, nxt * 2
+        """, "GL002", path=SERVING_PATH)
+
+
+def test_gl002_serving_scope_ring_kernel_good():
+    assert_clean(
+        """
+        import jax
+        import jax.numpy as jnp
+
+        @jax.jit
+        def serve_window(state, didx, dval):
+            # branchless: drop-index padding already makes a no-op
+            # delta scatter nothing, so the hit window re-solves to
+            # the identical result words on its own
+            nxt = state.at[didx].set(dval, mode="drop")
+            return nxt, nxt * 2
+        """, "GL002", path=SERVING_PATH)
 
 
 def test_gl003_repack_scope_per_plan_jit_bad():

@@ -1,0 +1,380 @@
+"""Serving-loop tests (karpenter_tpu/serving/).
+
+Covers the ISSUE-20 acceptance surface: the ring mechanics (monotonic
+head/tail wrap-around, out-of-order output fetch, explicit backpressure
+instead of drops), delta-apply parity at every ``DELTA_BUCKETS`` rung,
+the routing ladder (hit/delta/rebuild vs classic vs backpressure), the
+full-ring backpressure -> classic fallback -> drain -> resume cycle,
+generation bumps mid-stream, device faults at kick AND fetch failing
+over to a bit-identical host re-solve, empty/no-op windows, the churn
+parity differentials (single-loop and 2-shard), and the independent
+ring-state validator's falsifiability.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+
+from karpenter_tpu.faulttol import health as health_mod
+from karpenter_tpu.faulttol import (
+    DeviceFaultError, clear_injector, get_health_board, install_injector,
+)
+from karpenter_tpu.resident.delta import DELTA_BUCKETS, pad_delta
+from karpenter_tpu.serving import RING_SLOTS, serving_enabled
+from karpenter_tpu.serving.kernels import apply_ring
+from karpenter_tpu.serving.oracle import RingOracle, apply_ring_np
+from karpenter_tpu.serving.ring import InputRing, OutputRing, OutputSlot
+from karpenter_tpu.serving.service import (
+    ServingLoop, ShardedServingLoop, serving_loop_of,
+)
+from karpenter_tpu.serving.validate import (
+    _churn_stream, _plan_key, plan_parity_violations, raw_parity_violations,
+    ring_state_violations, sharded_parity_violations,
+)
+from karpenter_tpu.solver import JaxSolver, encode
+from karpenter_tpu.solver.types import SolverOptions
+
+
+@pytest.fixture(autouse=True)
+def _pristine_faulttol():
+    clear_injector()
+    get_health_board().reset()
+    yield
+    clear_injector()
+    get_health_board().reset()
+    health_mod._BOARD = None
+
+
+def _loop(capacity: int = RING_SLOTS) -> ServingLoop:
+    # a standalone loop over a serving="off" solver: the tests drive
+    # submit/result directly, the solver only contributes the classic
+    # prepare/dispatch/decode chain
+    return ServingLoop(JaxSolver(SolverOptions(backend="jax",
+                                               serving="off")),
+                       capacity=capacity)
+
+
+def _out_slot(payload: int) -> OutputSlot:
+    return OutputSlot(seq=0, dev=np.full(4, payload, np.int32),
+                      prep=None, problem=None, mode="delta")
+
+
+# -- ring mechanics ----------------------------------------------------------
+
+class TestRings:
+    def test_input_ring_fifo_and_wraparound(self):
+        ring = InputRing(capacity=3)
+        d = np.zeros(4, np.int32)
+        # cycle well past capacity: head/tail are monotonic, the slot
+        # list wraps arithmetically, order is FIFO throughout
+        for base in range(0, 9, 3):
+            seqs = [ring.push("delta", d, d) for _ in range(3)]
+            assert seqs == [base, base + 1, base + 2]
+            assert ring.full and ring.push("delta", d, d) is None
+            assert [ring.pop().seq for _ in range(3)] == seqs
+        assert ring.occupancy == 0 and ring.pop() is None
+        assert ring.tail == 9
+
+    def test_input_ring_full_push_uploads_nothing(self):
+        ring = InputRing(capacity=1)
+        d = np.zeros(4, np.int32)
+        assert ring.push("delta", d, d) == 0
+        before = (ring.head, ring.tail)
+        assert ring.push("delta", d, d) is None
+        assert (ring.head, ring.tail) == before
+
+    def test_output_ring_out_of_order_take(self):
+        ring = OutputRing(capacity=4)
+        for i in range(3):
+            assert ring.push(_out_slot(i)) == i
+        # fetch the middle slot first: head must NOT advance past the
+        # unfetched slot 0
+        mid = ring.take(1)
+        assert int(mid.dev[0]) == 1 and ring.head == 0
+        assert ring.take(1) is None          # double-take refused
+        # fetching slot 0 advances head over the contiguous done prefix
+        assert int(ring.take(0).dev[0]) == 0
+        assert ring.head == 2
+        assert int(ring.take(2).dev[0]) == 2
+        assert ring.head == ring.tail and ring.occupancy == 0
+
+    def test_output_ring_take_out_of_window(self):
+        ring = OutputRing(capacity=2)
+        ring.push(_out_slot(7))
+        assert ring.take(5) is None and ring.take(-1) is None
+
+    def test_output_ring_pending_and_clear(self):
+        ring = OutputRing(capacity=4)
+        for i in range(3):
+            ring.push(_out_slot(i))
+        ring.take(1)
+        assert [int(s.dev[0]) for s in ring.pending()] == [0, 2]
+        drained = ring.clear()
+        assert len(drained) == 3             # oldest-first, done included
+        assert ring.occupancy == 0 and ring.pending() == []
+
+    def test_capacity_floor(self):
+        with pytest.raises(ValueError):
+            InputRing(capacity=0)
+
+
+# -- delta-apply kernel vs numpy oracle --------------------------------------
+
+class TestRingKernel:
+    @pytest.mark.parametrize("rung", DELTA_BUCKETS)
+    def test_apply_ring_matches_oracle_at_every_rung(self, rung):
+        """The padded wire format at every DELTA_BUCKETS rung: the
+        device scatter and the numpy oracle agree word-for-word, with
+        the drop-index padding provably inert."""
+        rng = np.random.default_rng(rung)
+        size = max(DELTA_BUCKETS) + 8
+        state = rng.integers(0, 1 << 20, size=size, dtype=np.int32)
+        live = max(1, rung - 1)              # pads up to exactly `rung`
+        idx = rng.choice(size, size=live, replace=False)
+        val = rng.integers(0, 1 << 20, size=live, dtype=np.int32)
+        didx, dval = pad_delta(idx.astype(np.int64), val, size,
+                               DELTA_BUCKETS)
+        assert didx.shape[0] == rung
+        dev = np.asarray(apply_ring(jax.device_put(state),
+                                    jax.device_put(didx),
+                                    jax.device_put(dval)))
+        host = apply_ring_np(state, didx, dval)
+        assert np.array_equal(dev, host)
+        expect = state.copy()
+        expect[idx] = val
+        assert np.array_equal(dev, expect)
+
+    def test_oracle_seq_monotone_and_diverges(self):
+        oracle = RingOracle()
+        state = np.arange(8, dtype=np.int32)
+        assert oracle.diverges(state) == -1   # cold: nothing to compare
+        oracle.rebuild(0, state)
+        didx, dval = pad_delta(np.array([2], dtype=np.int64),
+                               np.array([99], dtype=np.int32),
+                               state.size, DELTA_BUCKETS)
+        oracle.apply(1, didx, dval)
+        applied = state.copy()
+        applied[2] = 99
+        assert oracle.diverges(applied) == 0
+        assert oracle.diverges(state) == 1    # one word differs
+        with pytest.raises(AssertionError):
+            oracle.apply(1, didx, dval)       # seq must be monotone
+
+
+# -- the serving loop's routing ladder ---------------------------------------
+
+class TestServingLoop:
+    def test_mode_ladder_rebuild_delta_hit(self):
+        seqs, catalog = _churn_stream(24, 4, 3, seed=3)
+        loop = _loop()
+        off = JaxSolver(SolverOptions(backend="jax", serving="off"))
+        plans = [loop.submit(encode(p, catalog)).result() for p in seqs]
+        # cold rebuild, then the churned windows ride the delta path
+        assert loop.rebuilds == 1
+        assert loop.ring_windows == 3 and loop.classic_windows == 0
+        # resubmitting the last window unchanged is a no-op hit
+        loop.submit(encode(seqs[-1], catalog)).result()
+        assert loop.last_mode == "hit"
+        assert loop.buf.stats["hit"] >= 1
+        for pods, plan in zip(seqs, plans):
+            assert _plan_key(plan) == _plan_key(
+                off.solve_encoded(encode(pods, catalog)))
+        assert ring_state_violations(loop, catalog) == []
+
+    def test_empty_window_routes_classic(self):
+        _, catalog = _churn_stream(8, 4, 1, seed=4)
+        loop = _loop()
+        plan = loop.submit(encode([], catalog)).result()
+        assert plan.nodes == [] and loop.classic_windows == 1
+        assert loop.ring_windows == 0 and loop.windows == 1
+
+    def test_backpressure_classic_fallback_drain_resume(self):
+        """Full-ring backpressure: the overflowing window falls back to
+        classic dispatch UNTOUCHED (never dropped, mirror unchanged),
+        drain fetches the in-flight slots, and the next submit rides
+        the ring again — every plan still classic-identical."""
+        seqs, catalog = _churn_stream(24, 4, 4, seed=5)
+        loop = _loop(capacity=2)
+        off = JaxSolver(SolverOptions(backend="jax", serving="off"))
+        problems = [encode(p, catalog) for p in seqs]
+        handles = [loop.submit(pr) for pr in problems[:3]]
+        # two slots in flight fill the ring; the third went classic
+        assert loop.backpressured == 1 and loop.classic_windows == 1
+        assert loop.ring_windows == 2
+        plans = {0: handles[0].result(), 1: handles[1].result(),
+                 2: handles[2].result()}
+        assert loop.output.occupancy == 0 and loop.drain() == {}
+        # resume: the freed ring admits the next window as a delta
+        # (the backpressured window's churn re-absorbed by plan_update)
+        plans[3] = loop.submit(problems[3]).result()
+        assert loop.ring_windows == 3 and loop.last_mode == "delta"
+        for w, plan in plans.items():
+            assert _plan_key(plan) == _plan_key(
+                off.solve_encoded(problems[w]))
+        assert loop.windows == loop.ring_windows + loop.classic_windows
+        assert ring_state_violations(loop, catalog) == []
+
+    def test_generation_bump_mid_stream_rebuilds(self):
+        seqs_a, cat_a = _churn_stream(24, 4, 2, seed=6)
+        seqs_b, cat_b = _churn_stream(24, 4, 1, seed=60)
+        loop = _loop()
+        for pods in seqs_a:
+            loop.submit(encode(pods, cat_a)).result()
+        assert loop.rebuilds == 1
+        # a window against a different catalog generation must rebuild,
+        # not delta against stale state
+        loop.submit(encode(seqs_b[0], cat_b)).result()
+        assert loop.rebuilds == 2 and "generation" in loop.last_reason
+        assert ring_state_violations(loop, cat_b) == []
+
+    def test_track_generation_invalidates_warm_ring(self):
+        """The idle/classic-stretch twin of the admit-path ladder: a
+        catalog bump invalidates the warm ring NOW, not at the next
+        eligible submit."""
+        seqs, catalog = _churn_stream(24, 4, 1, seed=7)
+        loop = _loop()
+        loop.submit(encode(seqs[0], catalog)).result()
+        assert loop.buf.dev is not None
+        loop.track_generation(catalog)        # same generation: no-op
+        assert loop.invalidations == 0
+        bumped = type("C", (), {
+            "uid": catalog.uid, "generation": catalog.generation + 1,
+            "availability_generation": catalog.availability_generation})
+        loop.track_generation(bumped)
+        assert loop.invalidations == 1 and loop.buf.dev is None
+        assert loop.last_reason == "generation"
+        loop.track_generation(bumped)         # cold ring: nothing to do
+        assert loop.invalidations == 1
+
+    def test_overlap_counted_with_depth(self):
+        seqs, catalog = _churn_stream(24, 4, 4, seed=8)
+        loop = _loop()
+        plans = list(loop.serve((encode(p, catalog) for p in seqs),
+                                depth=2))
+        assert len(plans) == 4
+        assert loop.overlap_fraction > 0.0
+        assert loop.fetched == loop.ring_windows
+
+    def test_serving_loop_of_and_enabled(self):
+        on = JaxSolver(SolverOptions(backend="jax", serving="on"))
+        off = JaxSolver(SolverOptions(backend="jax", serving="off"))
+        assert serving_loop_of(on) is not None
+        assert serving_loop_of(off) is None
+        assert serving_enabled(SolverOptions(backend="jax",
+                                             serving="on"))
+        assert not serving_enabled(SolverOptions(backend="jax",
+                                                 serving="off"))
+
+
+# -- device faults: the window is never lost ---------------------------------
+
+class _KernelScriptedInjector:
+    """Fault exactly one dispatch of the named guard site; every other
+    dispatch is clean (duck-types FaultyDeviceInjector at the seam)."""
+
+    def __init__(self, kernel: str, kind: str = "error"):
+        self.kernel = kernel
+        self.kind = kind
+        self.injected = 0
+
+    def draw(self, kernel, candidates):
+        if kernel == self.kernel and not self.injected:
+            self.injected += 1
+            return self.kind, candidates[0]
+        return None
+
+    def probe_faults(self, device):
+        return False
+
+
+class TestFaultFailover:
+    def test_fault_mid_kick_host_failover_bit_identical(self):
+        seqs, catalog = _churn_stream(24, 4, 2, seed=9)
+        loop = _loop()
+        off = JaxSolver(SolverOptions(backend="jax", serving="off"))
+        loop.submit(encode(seqs[0], catalog)).result()
+        install_injector(_KernelScriptedInjector("serving-kick"))
+        plan = loop.submit(encode(seqs[1], catalog)).result()
+        clear_injector()
+        assert loop.host_failovers == 1
+        assert loop.invalidations == 1
+        assert loop.last_reason.startswith("device_fault:")
+        assert loop.buf.dev is None           # ring drained, not stale
+        assert _plan_key(plan) == _plan_key(
+            off.solve_encoded(encode(seqs[1], catalog)))
+        # the NEXT window recovers via a cold rebuild, back on the ring
+        plan2 = loop.submit(encode(seqs[1], catalog)).result()
+        assert loop.rebuilds == 2 and loop.host_failovers == 1
+        assert _plan_key(plan2) == _plan_key(
+            off.solve_encoded(encode(seqs[1], catalog)))
+
+    def test_fault_mid_fetch_host_failover_bit_identical(self):
+        seqs, catalog = _churn_stream(24, 4, 2, seed=10)
+        loop = _loop()
+        off = JaxSolver(SolverOptions(backend="jax", serving="off"))
+        loop.submit(encode(seqs[0], catalog)).result()
+        pending = loop.submit(encode(seqs[1], catalog))
+        install_injector(_KernelScriptedInjector("serving-fetch"))
+        plan = pending.result()
+        clear_injector()
+        assert loop.host_failovers == 1
+        assert _plan_key(plan) == _plan_key(
+            off.solve_encoded(encode(seqs[1], catalog)))
+
+    def test_guard_fault_raises_typed_error(self):
+        # the raw seam: a faulted serving kick surfaces as the typed
+        # DeviceFaultError the ladder above classifies on
+        from karpenter_tpu.faulttol import device_guard
+
+        install_injector(_KernelScriptedInjector("serving-kick"))
+        with pytest.raises(DeviceFaultError):
+            with device_guard("serving-kick"):
+                pass
+
+
+# -- parity differentials and falsifiability ---------------------------------
+
+class TestParity:
+    def test_raw_word_churn_parity(self):
+        assert raw_parity_violations(seeds=2, windows=3) == []
+
+    def test_decoded_plan_churn_parity(self):
+        assert plan_parity_violations(seeds=2, windows=3) == []
+
+    def test_sharded_churn_parity(self):
+        assert sharded_parity_violations(seeds=1, windows=2) == []
+
+    def test_ring_state_validator_is_falsifiable(self):
+        seqs, catalog = _churn_stream(24, 4, 2, seed=11)
+        loop = _loop()
+        for pods in seqs:
+            loop.submit(encode(pods, catalog)).result()
+        assert ring_state_violations(loop, catalog) == []
+        loop.buf.mirror[0] ^= 1               # corrupt one mirror word
+        assert any("diverged" in v
+                   for v in ring_state_violations(loop, catalog))
+
+
+# -- the sharded serving loop ------------------------------------------------
+
+class TestShardedServing:
+    def test_deferred_fetch_matches_synchronous(self):
+        from karpenter_tpu.sharded import ShardedSolveService
+
+        seqs, catalog = _churn_stream(48, 4, 3, seed=12)
+        sloop = ShardedServingLoop(ShardedSolveService(2), capacity=2)
+        classic = ShardedSolveService(2)
+        handles = [sloop.submit(catalog, pods=pods) for pods in seqs]
+        plans = [h.result() for h in handles]
+        for pods, plan in zip(seqs, plans):
+            assert _plan_key(plan.merged()) == _plan_key(
+                classic.solve_window(catalog, pods=pods).merged())
+        assert sloop.windows == 3
+        assert sloop.fetched == sloop.kicks
+        assert sloop.drain() == []
+        # depth-2 in-flight window: at least one fetch overlapped a
+        # later kick
+        assert sloop.overlapped >= 1

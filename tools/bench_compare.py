@@ -53,6 +53,14 @@ METRICS: tuple[tuple[str, str], ...] = (
     ("gang_plan_warm_p50_ms", "lower"),
     ("resident.incremental_solve_p50_ms", "lower"),
     ("resident.warm_h2d_max_bytes", "lower"),
+    # serving loop (karpenter_tpu/serving): the persistent device-
+    # resident solve loop — host wall to kick one window into the ring
+    # (the RTT floor the loop exists to kill), the fetch/kick overlap
+    # fraction (0 = fully serialized single-shot behavior), and the
+    # streamed throughput of the depth-2 warm pass
+    ("serving.kick_p50_ms", "lower"),
+    ("serving.overlap_fraction", "higher"),
+    ("serving.pods_per_sec", "higher"),
     ("explain.solve_warm_p50_ms", "lower"),
     ("explain.d2h_fraction", "lower"),
     # device telemetry words (obs/telemetry_words): the metrics plane

@@ -48,6 +48,7 @@ DEFAULT_TARGETS = (
     "karpenter_tpu/whatif",
     "karpenter_tpu/faulttol",
     "karpenter_tpu/affinity",
+    "karpenter_tpu/serving",
     "karpenter_tpu/native.py",
     "bench.py",
     "karpenter_tpu/controllers",

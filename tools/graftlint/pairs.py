@@ -140,6 +140,16 @@ PAIRS: tuple[PairSpec, ...] = (
             "TELEMETRY_SLOT_COUNT",
         ),
     ),
+    PairSpec(
+        name="serving",
+        device=("karpenter_tpu/serving/kernels.py::apply_ring",
+                "karpenter_tpu/serving/kernels.py::serve_window"),
+        oracle=("karpenter_tpu/serving/oracle.py::apply_ring_np",
+                "karpenter_tpu/serving/oracle.py::serve_window_np"),
+        # the ring wire format: both sides pad/drop through the one
+        # DELTA_BUCKETS ladder (resident/delta.py) — no re-derived rungs
+        shared=("karpenter_tpu/resident/delta.py::DELTA_BUCKETS",),
+    ),
 )
 
 

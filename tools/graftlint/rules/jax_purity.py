@@ -38,6 +38,8 @@ FAMILY_A_SCOPE = (
     "karpenter_tpu/whatif/**/*",
     "karpenter_tpu/affinity/*",
     "karpenter_tpu/affinity/**/*",
+    "karpenter_tpu/serving/*",
+    "karpenter_tpu/serving/**/*",
     "karpenter_tpu/native.py",
     "bench.py",
 )

@@ -146,7 +146,7 @@ class TelemetryInKernel(Rule):
              "karpenter_tpu/resident/*", "karpenter_tpu/explain/*",
              "karpenter_tpu/repack/*", "karpenter_tpu/stochastic/*",
              "karpenter_tpu/sharded/*", "karpenter_tpu/whatif/*",
-             "karpenter_tpu/affinity/*")
+             "karpenter_tpu/affinity/*", "karpenter_tpu/serving/*")
 
     def check(self, module: SourceModule) -> Iterator[Finding]:
         analysis = analyze(module)
@@ -342,7 +342,8 @@ class BlockingSyncInHotPath(Rule):
              "karpenter_tpu/preempt/*", "karpenter_tpu/gang/*",
              "karpenter_tpu/resident/*", "karpenter_tpu/repack/*",
              "karpenter_tpu/stochastic/*", "karpenter_tpu/sharded/*",
-             "karpenter_tpu/whatif/*", "karpenter_tpu/affinity/*")
+             "karpenter_tpu/whatif/*", "karpenter_tpu/affinity/*",
+             "karpenter_tpu/serving/*")
 
     def check(self, module: SourceModule) -> Iterator[Finding]:
         exempt = self._exempt_ranges(module.tree)
@@ -426,7 +427,8 @@ class NakedDeviceDispatch(Rule):
              "karpenter_tpu/preempt/*", "karpenter_tpu/gang/*",
              "karpenter_tpu/resident/*", "karpenter_tpu/repack/*",
              "karpenter_tpu/stochastic/*", "karpenter_tpu/sharded/*",
-             "karpenter_tpu/whatif/*", "karpenter_tpu/affinity/*")
+             "karpenter_tpu/whatif/*", "karpenter_tpu/affinity/*",
+             "karpenter_tpu/serving/*")
 
     def check(self, module: SourceModule) -> Iterator[Finding]:
         guarded = self._guard_ranges(module.tree)

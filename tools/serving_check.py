@@ -1,0 +1,159 @@
+"""CI gate: the serving loop keeps the RTT floor dead without losing a
+window (docs/design/serving.md).
+
+Drives a live churned delta stream through the serving plane on an
+8-virtual-device CPU mesh, then:
+
+1. **ring parity vs classic** — every plan a serving-enabled solver
+   streams back must equal the classic single-shot solver's plan for
+   the same window (node set, placements, unplaced set, cost), with the
+   ring actually exercised (ring windows > 0) and fetches overlapping
+   later kicks (overlap fraction > 0);
+2. **2-shard live stream** — the deferred-fetch ``ShardedServingLoop``
+   must match the same service class solving synchronously, window for
+   window;
+3. **mid-stream quarantine** — three faults walk a live mesh device
+   healthy -> quarantined; the very next serving window must remap onto
+   the survivors (``failovers`` counter, victim gone from the mesh) and
+   keep matching a classic service that saw the same quarantine;
+4. **zero lost windows** — every submitted window comes back as a plan
+   and the loop's routing ledger balances exactly (ring + classic ==
+   windows, everything fetched).
+
+Run locally: ``make serving-check``
+(``XLA_FLAGS=--xla_force_host_platform_device_count=8
+JAX_PLATFORMS=cpu python tools/serving_check.py``).
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+if "--xla_force_host_platform_device_count" not in \
+        os.environ.get("XLA_FLAGS", ""):
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "")
+        + " --xla_force_host_platform_device_count=8").strip()
+
+
+def main() -> int:
+    from karpenter_tpu.faulttol import health as health_mod
+    from karpenter_tpu.faulttol.inject import clear_injector
+    from karpenter_tpu.serving.service import ShardedServingLoop
+    from karpenter_tpu.serving.validate import (
+        _churn_stream, _plan_key, ring_state_violations,
+    )
+    from karpenter_tpu.sharded import ShardedSolveService
+    from karpenter_tpu.solver import JaxSolver, encode
+    from karpenter_tpu.solver.types import SolverOptions
+
+    clear_injector()
+    # quarantine must OUTLAST the post-fault stream (recovery itself is
+    # failover-check's gate, not this one); triage writes stubbed
+    board = health_mod.HealthBoard(
+        recovery_timeout_s=60.0, probe_interval_s=0.02, probe_successes=1,
+        triage_writer=lambda *a, **k: None)
+    health_mod._BOARD = board
+    failures: list[str] = []
+
+    # -- 1. single-loop ring parity vs classic over a live churn stream -
+    seqs, catalog = _churn_stream(num_pods=48, num_types=6, windows=6,
+                                  seed=7)
+    on = JaxSolver(SolverOptions(backend="jax", serving="on"))
+    off = JaxSolver(SolverOptions(backend="jax", serving="off"))
+    problems = [encode(pods, catalog) for pods in seqs]
+    served = list(on.serve_stream(iter(problems), depth=2))
+    if len(served) != len(problems):
+        failures.append(f"serving stream returned {len(served)} plans "
+                        f"for {len(problems)} windows (lost windows)")
+    for w, (plan, problem) in enumerate(zip(served, problems)):
+        if _plan_key(plan) != _plan_key(off.solve_encoded(problem)):
+            failures.append(f"window {w}: serving plan != classic plan")
+    loop = on.serving
+    if loop.ring_windows == 0:
+        failures.append("no window ever rode the ring — the stream "
+                        "exercised nothing")
+    if loop.overlap_fraction <= 0.0:
+        failures.append("no fetch ever overlapped a later kick "
+                        f"(overlap_fraction={loop.overlap_fraction})")
+    if loop.ring_windows + loop.classic_windows != loop.windows:
+        failures.append(
+            f"routing ledger leaks: ring {loop.ring_windows} + classic "
+            f"{loop.classic_windows} != windows {loop.windows}")
+    failures.extend(ring_state_violations(loop, catalog))
+
+    # -- 2. 2-shard live delta stream, deferred fetch vs synchronous ----
+    serving_svc = ShardedSolveService(2)
+    classic_svc = ShardedSolveService(2)
+    sloop = ShardedServingLoop(serving_svc, capacity=2)
+    sseqs, scatalog = _churn_stream(num_pods=64, num_types=6, windows=3,
+                                    seed=11)
+    # pre-generate the post-quarantine stream so no wall time elapses
+    # between the quarantine and the windows it must survive
+    post_seqs, _ = _churn_stream(num_pods=64, num_types=6, windows=3,
+                                 seed=12)
+    for w, pods in enumerate(sseqs):
+        plan = sloop.submit(scatalog, pods=pods).result()
+        classic = classic_svc.solve_window(scatalog, pods=pods)
+        if _plan_key(plan.merged()) != _plan_key(classic.merged()):
+            failures.append(f"2-shard window {w}: serving plan != "
+                            f"synchronous plan")
+
+    # -- 3. mid-stream quarantine: remap, keep matching classic ---------
+    mesh_ids = lambda: {f"{d.platform}:{d.id}"  # noqa: E731
+                        for d in serving_svc.mesh.devices.flat}
+    victim = sorted(mesh_ids())[0]
+    for _ in range(3):
+        board.record_fault(victim, kind="error", kernel="serving-check")
+    if board.state(victim) != health_mod.QUARANTINED:
+        failures.append(f"three faults did not quarantine {victim} "
+                        f"(state={board.state(victim)})")
+    for w, pods in enumerate(post_seqs):
+        plan = sloop.submit(scatalog, pods=pods).result()
+        classic = classic_svc.solve_window(scatalog, pods=pods)
+        if plan is None or not plan.plans:
+            failures.append(f"post-quarantine window {w} lost")
+            continue
+        if _plan_key(plan.merged()) != _plan_key(classic.merged()):
+            failures.append(f"post-quarantine window {w}: serving plan "
+                            f"!= synchronous plan")
+    if serving_svc.failovers < 1:
+        failures.append(
+            f"quarantine did not drive a serving mesh failover "
+            f"(failovers={serving_svc.failovers})")
+    if victim in mesh_ids():
+        failures.append(f"victim {victim} still in the remapped serving "
+                        f"mesh ({sorted(mesh_ids())})")
+
+    # -- 4. zero lost windows, everything fetched -----------------------
+    sloop.drain()
+    total = len(sseqs) + len(post_seqs)
+    if sloop.windows != total:
+        failures.append(f"sharded loop accounted {sloop.windows} windows "
+                        f"over {total} submits")
+    if sloop.fetched + sloop.host_failovers < sloop.kicks:
+        failures.append(
+            f"kicked windows never fetched (kicks={sloop.kicks}, "
+            f"fetched={sloop.fetched}, failovers={sloop.host_failovers})")
+
+    health_mod._BOARD = None
+    for f in failures:
+        print(f"FAIL {f}")
+    if not failures:
+        print(f"serving check ok: {loop.windows} single-loop windows "
+              f"(ring={loop.ring_windows} classic={loop.classic_windows} "
+              f"rebuilds={loop.rebuilds} "
+              f"overlap={loop.overlap_fraction:.2f}), "
+              f"{sloop.windows} 2-shard windows through a mid-stream "
+              f"quarantine of {victim} "
+              f"(failovers={serving_svc.failovers}), zero lost windows, "
+              f"parity vs classic held throughout")
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -350,6 +350,41 @@ def main() -> int:
               and 0 < rstats["last_delta_words"] < 64,
               f"warm window rode the delta path ({rstats})")
 
+        # demo serving cycle (karpenter_tpu/serving): three churned
+        # windows stream through the persistent device-resident solve
+        # loop — cold rebuild, then delta kicks, with window N's result
+        # fetch overlapping window N+1's kicked compute; the
+        # karpenter_tpu_serving_* families, the /statusz serving block
+        # and the retained serving.kick/serving.fetch markers below
+        # must then be live, not vacuous (docs/design/serving.md)
+        print("demo serving cycle (persistent device-resident loop)")
+        from karpenter_tpu.serving.validate import ring_state_violations
+        from karpenter_tpu.solver import encode
+
+        srv_solver = JaxSolver(SolverOptions(backend="jax",
+                                             serving="on"))
+        srv_pods = make_pods(6, name_prefix="srv",
+                             requests=ResourceRequests(500, 1024, 0, 1))
+        srv_windows = []
+        for w in range(3):
+            srv_pods = srv_pods + make_pods(
+                1, name_prefix=f"srv-arr{w}",
+                requests=ResourceRequests(250, 512, 0, 1))
+            srv_windows.append(encode(srv_pods, catalog))
+        srv_plans = list(srv_solver.serve_stream(iter(srv_windows),
+                                                 depth=2))
+        srv_loop = srv_solver.serving
+        check(len(srv_plans) == 3 and all(p.nodes for p in srv_plans),
+              "serving demo streamed every window into a plan")
+        check(srv_loop.ring_windows >= 2 and srv_loop.rebuilds >= 1,
+              f"serving demo rode the ring (cold rebuild + deltas; "
+              f"ring={srv_loop.ring_windows})")
+        check(srv_loop.overlap_fraction > 0.0,
+              f"a result fetch overlapped a later kick "
+              f"(overlap={srv_loop.overlap_fraction:.2f})")
+        check(ring_state_violations(srv_loop, catalog) == [],
+              "serving ring re-derives via the numpy oracle")
+
         # demo device-profiling cycle: force the sampling bracket onto
         # one live solve so device_time carries a real dispatch/execute/
         # fetch split, then check the profiler's self-metering
@@ -644,6 +679,18 @@ def main() -> int:
               in text, "resident rebuild reason counted")
         check("karpenter_tpu_resident_delta_bytes" in text,
               "resident delta-bytes histogram rendered")
+        # serving-loop families (karpenter_tpu/serving +
+        # docs/design/serving.md) — live from the demo cycle above
+        check('karpenter_tpu_serving_windows_total{mode="rebuild"} 1'
+              in text and
+              'karpenter_tpu_serving_windows_total{mode="delta"}' in text,
+              "serving window counter saw the cold rebuild + delta kicks")
+        check("karpenter_tpu_serving_ring_occupancy" in text,
+              "serving ring-occupancy gauge rendered")
+        check("# TYPE karpenter_tpu_serving_backpressure_total counter"
+              in text, "serving backpressure counter family rendered")
+        check("karpenter_tpu_serving_overlap_fraction" in text,
+              "serving overlap-fraction gauge rendered")
         # device-profiling families (obs/prof.py + obs/watchdog.py)
         check('karpenter_tpu_device_time_seconds_bucket{kernel=' in text,
               "device_time histogram carries live sampled splits")
@@ -964,6 +1011,14 @@ def main() -> int:
               and "last_delta_words" in sres
               and "last_rebuild_reason" in sres,
               f"/statusz exposes resident-store state ({sres})")
+        # serving block (docs/design/serving.md): the demo stream's
+        # per-route tally, a drained ring, and live fetch/kick overlap
+        ssrv = doc.get("serving") or {}
+        check(ssrv.get("windows", {}).get("rebuild", 0) >= 1
+              and ssrv.get("windows", {}).get("delta", 0) >= 1
+              and ssrv.get("ring_occupancy", -1) == 0
+              and ssrv.get("overlap_fraction", 0) > 0,
+              f"/statusz serving block carries the demo stream ({ssrv})")
         sprof = doc.get("profiler") or {}
         check(sprof.get("samples", 0) >= 1
               and "overhead_fraction" in sprof
@@ -1046,6 +1101,12 @@ def main() -> int:
         check("whatif.plan" in roots,
               f"the demo whatif plan trace is retained "
               f"(roots={sorted(roots)})")
+        check("serving.fetch" in roots,
+              f"the demo serving fetch trace is retained "
+              f"(roots={sorted(roots)})")
+        check(any(i.name == "serving.kick"
+                  for i in _kobs.get_recorder().instants()),
+              "the serving.kick markers landed in the instant ring")
 
         # trace-id round trip: /debug/slo's worst-pod table prints trace
         # ids — the exact-lookup filter must fetch that one bundle
